@@ -1,0 +1,57 @@
+"""SSZ: SimpleSerialize type system, serialization and merkleization.
+
+Public surface mirrors the reference's
+tests/core/pyspec/eth2spec/utils/ssz/{ssz_typing,ssz_impl}.py so spec modules
+and tests read identically, while the implementation is first-party and
+batches merkleization for the device hash kernel.
+"""
+
+from .hashing import hash_bytes, use_device, device_enabled
+from .merkle import (
+    ZERO_CHUNK,
+    zerohashes,
+    merkleize_chunks,
+    mix_in_length,
+    mix_in_selector,
+    get_merkle_proof,
+    is_valid_merkle_branch,
+    pack_bytes,
+)
+from .types import (
+    View,
+    BasicView,
+    boolean,
+    bit,
+    uint,
+    uint8,
+    uint16,
+    uint32,
+    uint64,
+    uint128,
+    uint256,
+    byte,
+    ByteVector,
+    ByteList,
+    Bytes1,
+    Bytes4,
+    Bytes8,
+    Bytes20,
+    Bytes31,
+    Bytes32,
+    Bytes48,
+    Bytes96,
+    Bitvector,
+    Bitlist,
+    List,
+    Vector,
+    Container,
+    Union,
+    SSZException,
+    DeserializationError,
+    serialize,
+    deserialize,
+    hash_tree_root,
+    uint_to_bytes,
+)
+
+__all__ = [k for k in dir() if not k.startswith("_")]

@@ -1,0 +1,56 @@
+"""Hash primitives for SSZ merkleization.
+
+Semantics match the reference's ``hash(x) = sha256(x).digest()``
+(reference: tests/core/pyspec/eth2spec/utils/hash_function.py:8-9).
+
+Two paths:
+  * ``hash_bytes`` — single sha256 on host (hashlib, C speed).
+  * ``hash_pairs_batch`` — hash N 64-byte (left||right) pairs at once.
+    Dispatches to the device kernel (ops.sha256) above a size threshold,
+    otherwise loops hashlib on host. The device path is the TPU hot spot
+    for full-state merkleization.
+"""
+
+from __future__ import annotations
+
+import hashlib
+
+import numpy as np
+
+# Nodes-per-level threshold above which batched pair hashing is routed to the
+# JAX kernel. Tuned on the v5e bench: below this, hashlib's C loop wins.
+_DEVICE_THRESHOLD = 2048
+
+_use_device = False
+
+
+def use_device(enable: bool = True) -> None:
+    """Route large batched hashing onto the accelerator (ssz.use_tpu seam)."""
+    global _use_device
+    _use_device = enable
+
+
+def device_enabled() -> bool:
+    return _use_device
+
+
+def hash_bytes(data: bytes) -> bytes:
+    return hashlib.sha256(data).digest()
+
+
+def _hash_pairs_host(pairs: np.ndarray) -> np.ndarray:
+    """pairs: uint8[N, 64] -> uint8[N, 32] via hashlib."""
+    out = np.empty((pairs.shape[0], 32), dtype=np.uint8)
+    sha = hashlib.sha256
+    for i in range(pairs.shape[0]):
+        out[i] = np.frombuffer(sha(pairs[i].tobytes()).digest(), dtype=np.uint8)
+    return out
+
+
+def hash_pairs_batch(pairs: np.ndarray) -> np.ndarray:
+    """Hash N 64-byte messages. pairs: uint8[N, 64] -> uint8[N, 32]."""
+    if _use_device and pairs.shape[0] >= _DEVICE_THRESHOLD:
+        from eth_consensus_specs_tpu.ops.sha256 import sha256_64B_batch_np
+
+        return sha256_64B_batch_np(pairs)
+    return _hash_pairs_host(pairs)

@@ -1,0 +1,131 @@
+"""Chunk-level merkleization.
+
+Implements the ``merkleize(chunks, limit)`` / ``mix_in_length`` /
+``mix_in_selector`` rules of the SSZ spec (reference:
+ssz/simple-serialize.md:343-433) and the standalone padded-binary-tree
+helpers the reference keeps in utils/merkle_minimal.py:7-91.
+
+The per-level pair hashing is batched (numpy byte matrices) so that large
+trees — the validator registry, balances, randao mixes — can be handed to
+the device kernel in one call per level instead of one hashlib call per node.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from .hashing import hash_bytes, hash_pairs_batch
+
+ZERO_CHUNK = b"\x00" * 32
+
+# zerohashes[i] = root of an all-zero subtree of depth i
+# (reference: utils/merkle_minimal.py:7-9)
+MAX_DEPTH = 64
+zerohashes: list[bytes] = [ZERO_CHUNK]
+for _ in range(MAX_DEPTH - 1):
+    zerohashes.append(hash_bytes(zerohashes[-1] + zerohashes[-1]))
+
+_ZEROHASH_NP = [np.frombuffer(z, dtype=np.uint8) for z in zerohashes]
+
+
+def next_power_of_two(v: int) -> int:
+    if v <= 1:
+        return 1
+    return 1 << (v - 1).bit_length()
+
+
+def _merkleize_array(chunks: np.ndarray, depth: int) -> bytes:
+    """Root of `chunks` (uint8[N,32]) padded with zero-subtrees to 2**depth leaves."""
+    n = chunks.shape[0]
+    if n == 0:
+        return zerohashes[depth]
+    level = chunks
+    for d in range(depth):
+        cnt = level.shape[0]
+        if cnt % 2 == 1:
+            level = np.concatenate([level, _ZEROHASH_NP[d][None, :]], axis=0)
+            cnt += 1
+        pairs = level.reshape(cnt // 2, 64)
+        level = hash_pairs_batch(pairs)
+    return level[0].tobytes()
+
+
+def merkleize_chunks(chunks: list[bytes] | np.ndarray, limit: int | None = None) -> bytes:
+    """Merkleize chunks into a single root.
+
+    `limit` is the chunk limit that fixes the tree depth (lists pad virtually
+    to their capacity with zero subtrees); None means pad to the next power
+    of two of len(chunks) (vectors/containers).
+    Matches reference semantics at ssz/simple-serialize.md:393-414 and
+    utils/merkle_minimal.py:47-91.
+    """
+    if isinstance(chunks, np.ndarray):
+        arr = chunks
+        count = arr.shape[0]
+    else:
+        count = len(chunks)
+        arr = (
+            np.frombuffer(b"".join(chunks), dtype=np.uint8).reshape(count, 32)
+            if count
+            else np.empty((0, 32), dtype=np.uint8)
+        )
+    if limit is None:
+        limit = count
+    if count > limit:
+        raise ValueError(f"merkleize: {count} chunks exceeds limit {limit}")
+    depth = max(limit - 1, 0).bit_length()  # depth of tree with `limit` leaves
+    return _merkleize_array(arr, depth)
+
+
+def mix_in_length(root: bytes, length: int) -> bytes:
+    return hash_bytes(root + length.to_bytes(32, "little"))
+
+
+def mix_in_selector(root: bytes, selector: int) -> bytes:
+    return hash_bytes(root + selector.to_bytes(32, "little"))
+
+
+def pack_bytes(data: bytes) -> np.ndarray:
+    """Right-pad serialized bytes to a whole number of 32-byte chunks."""
+    n = len(data)
+    padded = n + (-n % 32)
+    buf = np.zeros(padded, dtype=np.uint8)
+    if n:
+        buf[:n] = np.frombuffer(data, dtype=np.uint8)
+    return buf.reshape(-1, 32)
+
+
+def get_merkle_proof(chunks: list[bytes], index: int, limit: int | None = None) -> list[bytes]:
+    """Single-leaf Merkle branch (reference: utils/merkle_minimal.py:12-44)."""
+    count = len(chunks)
+    if limit is None:
+        limit = count
+    depth = max(limit - 1, 0).bit_length()
+    # build all levels
+    level_nodes: list[list[bytes]] = [list(chunks)]
+    for d in range(depth):
+        cur = level_nodes[-1]
+        if len(cur) % 2 == 1:
+            cur = cur + [zerohashes[d]]
+            level_nodes[-1] = cur
+        nxt = [hash_bytes(cur[i] + cur[i + 1]) for i in range(0, len(cur), 2)]
+        level_nodes.append(nxt)
+    proof = []
+    idx = index
+    for d in range(depth):
+        sibling = idx ^ 1
+        nodes = level_nodes[d]
+        proof.append(nodes[sibling] if sibling < len(nodes) else zerohashes[d])
+        idx >>= 1
+    return proof
+
+
+def is_valid_merkle_branch(leaf: bytes, branch: list[bytes], depth: int, index: int, root: bytes) -> bool:
+    """Verify a Merkle branch (reference: specs/phase0/beacon-chain.md:793-810)."""
+    value = leaf
+    for i in range(depth):
+        if index // (2**i) % 2:
+            value = hash_bytes(branch[i] + value)
+        else:
+            value = hash_bytes(value + branch[i])
+    return value == root

@@ -1,0 +1,1151 @@
+"""SSZ type system: typed views with serialization + merkleization.
+
+First-party implementation of SimpleSerialize semantics (reference spec:
+ssz/simple-serialize.md:189-433; reference runtime: the external
+`remerkleable` package re-exported via
+tests/core/pyspec/eth2spec/utils/ssz/ssz_typing.py:3-37).
+
+Design notes (TPU-first, not a remerkleable port):
+  * Values are plain Python objects (int/bytes subclasses, element lists),
+    not persistent binary trees; merkleization happens level-synchronously
+    over numpy chunk matrices so large flat regions batch onto the device
+    kernel (ssz/merkle.py + ops/sha256.py).
+  * Every type knows how to expose its leaf chunks as a numpy matrix, which
+    is the seam the columnar/JAX state mirror (ops/state_columns.py) uses.
+  * Root caching: container/list roots are cached and invalidated on
+    mutation through the typed API (the reference gets this from
+    remerkleable's structural sharing; we get it from explicit dirty bits).
+"""
+
+from __future__ import annotations
+
+import io
+from typing import Any
+
+import numpy as np
+
+from .hashing import hash_bytes
+from .merkle import (
+    merkleize_chunks,
+    mix_in_length,
+    mix_in_selector,
+    pack_bytes,
+)
+
+OFFSET_BYTE_LENGTH = 4
+
+
+class SSZException(Exception):
+    pass
+
+
+class DeserializationError(SSZException):
+    pass
+
+
+# ---------------------------------------------------------------------------
+# Base view
+# ---------------------------------------------------------------------------
+
+
+class View:
+    """Common classmethod surface shared by every SSZ type."""
+
+    @classmethod
+    def is_fixed_byte_length(cls) -> bool:
+        raise NotImplementedError
+
+    @classmethod
+    def is_immutable_subtree(cls) -> bool:
+        """True iff instances (and their whole subtree) can never mutate.
+
+        Root caches are only kept on nodes ALL of whose children are
+        immutable subtrees: then the node's own typed setters cover every
+        possible invalidation path. (The reference gets the same guarantee
+        from remerkleable's persistent trees.)
+        """
+        return False
+
+    @classmethod
+    def type_byte_length(cls) -> int:
+        raise NotImplementedError(f"{cls.__name__} is not fixed-size")
+
+    @classmethod
+    def min_byte_length(cls) -> int:
+        return cls.type_byte_length()
+
+    @classmethod
+    def max_byte_length(cls) -> int:
+        return cls.type_byte_length()
+
+    @classmethod
+    def default(cls) -> "View":
+        raise NotImplementedError
+
+    @classmethod
+    def coerce_view(cls, value: Any) -> "View":
+        if isinstance(value, cls):
+            return value
+        return cls(value)  # type: ignore[call-arg]
+
+    def encode_bytes(self) -> bytes:
+        raise NotImplementedError
+
+    @classmethod
+    def decode_bytes(cls, data: bytes) -> "View":
+        raise NotImplementedError
+
+    def get_hash_tree_root(self) -> bytes:
+        raise NotImplementedError
+
+    def copy(self):
+        return self  # immutable by default
+
+    def type_of(self):
+        return self.__class__
+
+
+# ---------------------------------------------------------------------------
+# Basic types
+# ---------------------------------------------------------------------------
+
+
+class BasicView(View):
+    @classmethod
+    def is_fixed_byte_length(cls) -> bool:
+        return True
+
+    @classmethod
+    def is_immutable_subtree(cls) -> bool:
+        return True
+
+    def get_hash_tree_root(self) -> bytes:
+        data = self.encode_bytes()
+        return data + b"\x00" * (32 - len(data))
+
+
+class boolean(int, BasicView):
+    def __new__(cls, value: Any = False):
+        v = int(value)
+        if v not in (0, 1):
+            raise ValueError(f"boolean must be 0 or 1, got {value}")
+        return super().__new__(cls, v)
+
+    @classmethod
+    def type_byte_length(cls) -> int:
+        return 1
+
+    @classmethod
+    def default(cls):
+        return cls(0)
+
+    def encode_bytes(self) -> bytes:
+        return bytes([int(self)])
+
+    @classmethod
+    def decode_bytes(cls, data: bytes):
+        if len(data) != 1 or data[0] not in (0, 1):
+            raise DeserializationError(f"invalid boolean bytes: {data!r}")
+        return cls(data[0])
+
+    def __repr__(self):
+        return f"boolean({int(self)})"
+
+    def __bool__(self):
+        return int(self) == 1
+
+
+class uint(int, BasicView):
+    BITS: int = 0
+
+    def __new__(cls, value: Any = 0):
+        if isinstance(value, bytes):
+            raise ValueError("cannot coerce bytes to uint; use decode_bytes")
+        if isinstance(value, float):
+            raise TypeError(f"cannot coerce float to {cls.__name__} (non-integral values are bugs, not data)")
+        v = int(value)
+        if not 0 <= v < (1 << cls.BITS):
+            raise ValueError(f"value {v} out of range for {cls.__name__}")
+        return super().__new__(cls, v)
+
+    @classmethod
+    def type_byte_length(cls) -> int:
+        return cls.BITS // 8
+
+    @classmethod
+    def default(cls):
+        return cls(0)
+
+    def encode_bytes(self) -> bytes:
+        return int(self).to_bytes(self.BITS // 8, "little")
+
+    @classmethod
+    def decode_bytes(cls, data: bytes):
+        if len(data) != cls.BITS // 8:
+            raise DeserializationError(f"{cls.__name__}: expected {cls.BITS // 8} bytes, got {len(data)}")
+        return cls(int.from_bytes(data, "little"))
+
+    def __repr__(self):
+        return f"{self.__class__.__name__}({int(self)})"
+
+    # Arithmetic deliberately returns plain int (range enforcement happens on
+    # assignment into typed fields) — matching the reference's overflow-as-
+    # invalid semantics (specs/phase0/beacon-chain.md:1339-1344): an
+    # out-of-range result only raises when it lands in the state.
+
+
+class uint8(uint):
+    BITS = 8
+
+
+class uint16(uint):
+    BITS = 16
+
+
+class uint32(uint):
+    BITS = 32
+
+
+class uint64(uint):
+    BITS = 64
+
+
+class uint128(uint):
+    BITS = 128
+
+
+class uint256(uint):
+    BITS = 256
+
+
+byte = uint8
+bit = boolean
+
+
+# ---------------------------------------------------------------------------
+# Parameterized-type machinery
+# ---------------------------------------------------------------------------
+
+_type_cache: dict[tuple, type] = {}
+
+
+def _cached_subclass(key: tuple, builder):
+    if key not in _type_cache:
+        _type_cache[key] = builder()
+    return _type_cache[key]
+
+
+def _coerce_type(t: Any) -> type:
+    if isinstance(t, type) and issubclass(t, View):
+        return t
+    raise TypeError(f"not an SSZ type: {t!r}")
+
+
+# ---------------------------------------------------------------------------
+# Byte vectors / byte lists
+# ---------------------------------------------------------------------------
+
+
+class ByteVector(bytes, View):
+    LENGTH: int = 0
+
+    def __new__(cls, value: Any = None):
+        if cls.LENGTH == 0 and cls is ByteVector:
+            raise TypeError("use ByteVector[N]")
+        if value is None:
+            value = b"\x00" * cls.LENGTH
+        if isinstance(value, str):
+            value = bytes.fromhex(value[2:] if value.startswith("0x") else value)
+        elif isinstance(value, (list, tuple)):
+            value = bytes(value)
+        elif isinstance(value, np.ndarray):
+            value = value.tobytes()
+        if len(value) != cls.LENGTH:
+            raise ValueError(f"{cls.__name__}: expected {cls.LENGTH} bytes, got {len(value)}")
+        return super().__new__(cls, value)
+
+    def __class_getitem__(cls, length: int) -> type:
+        return _cached_subclass(
+            ("ByteVector", length),
+            lambda: type(f"ByteVector[{length}]", (ByteVector,), {"LENGTH": length}),
+        )
+
+    @classmethod
+    def is_fixed_byte_length(cls) -> bool:
+        return True
+
+    @classmethod
+    def is_immutable_subtree(cls) -> bool:
+        return True
+
+    @classmethod
+    def type_byte_length(cls) -> int:
+        return cls.LENGTH
+
+    @classmethod
+    def default(cls):
+        return cls()
+
+    def encode_bytes(self) -> bytes:
+        return bytes(self)
+
+    @classmethod
+    def decode_bytes(cls, data: bytes):
+        try:
+            return cls(data)
+        except ValueError as e:
+            raise DeserializationError(str(e)) from None
+
+    def get_hash_tree_root(self) -> bytes:
+        return merkleize_chunks(pack_bytes(bytes(self)))
+
+    def __repr__(self):
+        return f"{self.__class__.__name__}(0x{bytes(self).hex()})"
+
+
+Bytes1 = ByteVector[1]
+Bytes4 = ByteVector[4]
+Bytes8 = ByteVector[8]
+Bytes20 = ByteVector[20]
+Bytes31 = ByteVector[31]
+Bytes32 = ByteVector[32]
+Bytes48 = ByteVector[48]
+Bytes96 = ByteVector[96]
+
+
+class ByteList(bytes, View):
+    LIMIT: int = 0
+
+    def __new__(cls, value: Any = b""):
+        if isinstance(value, str):
+            value = bytes.fromhex(value[2:] if value.startswith("0x") else value)
+        elif isinstance(value, (list, tuple)):
+            value = bytes(value)
+        if len(value) > cls.LIMIT:
+            raise ValueError(f"{cls.__name__}: {len(value)} bytes exceeds limit {cls.LIMIT}")
+        return super().__new__(cls, value)
+
+    def __class_getitem__(cls, limit: int) -> type:
+        return _cached_subclass(
+            ("ByteList", limit),
+            lambda: type(f"ByteList[{limit}]", (ByteList,), {"LIMIT": limit}),
+        )
+
+    @classmethod
+    def is_fixed_byte_length(cls) -> bool:
+        return False
+
+    @classmethod
+    def is_immutable_subtree(cls) -> bool:
+        return True  # bytes subclass: instances immutable
+
+    @classmethod
+    def min_byte_length(cls) -> int:
+        return 0
+
+    @classmethod
+    def max_byte_length(cls) -> int:
+        return cls.LIMIT
+
+    @classmethod
+    def default(cls):
+        return cls()
+
+    def encode_bytes(self) -> bytes:
+        return bytes(self)
+
+    @classmethod
+    def decode_bytes(cls, data: bytes):
+        try:
+            return cls(data)
+        except ValueError as e:
+            raise DeserializationError(str(e)) from None
+
+    def get_hash_tree_root(self) -> bytes:
+        limit_chunks = (self.LIMIT + 31) // 32
+        root = merkleize_chunks(pack_bytes(bytes(self)), limit=limit_chunks)
+        return mix_in_length(root, len(self))
+
+    def __repr__(self):
+        return f"{self.__class__.__name__}(0x{bytes(self).hex()})"
+
+
+# ---------------------------------------------------------------------------
+# Bitfields
+# ---------------------------------------------------------------------------
+
+
+def _bits_from_args(args) -> list[bool]:
+    if len(args) == 1 and not isinstance(args[0], (bool, int)):
+        args = tuple(args[0])
+    return [bool(b) for b in args]
+
+
+def _bitfield_bytes(bits: list[bool]) -> bytes:
+    n = len(bits)
+    out = bytearray((n + 7) // 8)
+    for i, b in enumerate(bits):
+        if b:
+            out[i // 8] |= 1 << (i % 8)
+    return bytes(out)
+
+
+class Bitvector(View):
+    LENGTH: int = 0
+
+    def __init__(self, *args):
+        bits = _bits_from_args(args)
+        if not bits:
+            bits = [False] * self.LENGTH
+        if len(bits) != self.LENGTH:
+            raise ValueError(f"{self.__class__.__name__}: expected {self.LENGTH} bits, got {len(bits)}")
+        self._bits = bits
+
+    def __class_getitem__(cls, length: int) -> type:
+        if length <= 0:
+            raise TypeError("Bitvector length must be > 0")
+        return _cached_subclass(
+            ("Bitvector", length),
+            lambda: type(f"Bitvector[{length}]", (Bitvector,), {"LENGTH": length}),
+        )
+
+    @classmethod
+    def is_fixed_byte_length(cls) -> bool:
+        return True
+
+    @classmethod
+    def type_byte_length(cls) -> int:
+        return (cls.LENGTH + 7) // 8
+
+    @classmethod
+    def default(cls):
+        return cls()
+
+    def __len__(self):
+        return self.LENGTH
+
+    def __iter__(self):
+        return iter(self._bits)
+
+    def __getitem__(self, i):
+        return self._bits[i]
+
+    def __setitem__(self, i, v):
+        self._bits[i] = bool(v)
+
+    def __eq__(self, other):
+        return isinstance(other, Bitvector) and other.LENGTH == self.LENGTH and other._bits == self._bits
+
+    def __hash__(self):
+        return hash((self.LENGTH, tuple(self._bits)))
+
+    def encode_bytes(self) -> bytes:
+        return _bitfield_bytes(self._bits)
+
+    @classmethod
+    def decode_bytes(cls, data: bytes):
+        if len(data) != cls.type_byte_length():
+            raise DeserializationError(f"{cls.__name__}: wrong byte length {len(data)}")
+        bits = [bool((data[i // 8] >> (i % 8)) & 1) for i in range(cls.LENGTH)]
+        # Excess bits beyond LENGTH must be zero
+        if cls.LENGTH % 8 != 0 and data[-1] >> (cls.LENGTH % 8):
+            raise DeserializationError(f"{cls.__name__}: non-zero padding bits")
+        return cls(bits)
+
+    def get_hash_tree_root(self) -> bytes:
+        limit_chunks = (self.LENGTH + 255) // 256
+        return merkleize_chunks(pack_bytes(self.encode_bytes()), limit=limit_chunks)
+
+    def copy(self):
+        return self.__class__(list(self._bits))
+
+    def __repr__(self):
+        return f"{self.__class__.__name__}({''.join('1' if b else '0' for b in self._bits)})"
+
+
+class Bitlist(View):
+    LIMIT: int = 0
+
+    def __init__(self, *args):
+        bits = _bits_from_args(args)
+        if len(bits) > self.LIMIT:
+            raise ValueError(f"{self.__class__.__name__}: {len(bits)} bits exceeds limit {self.LIMIT}")
+        self._bits = bits
+
+    def __class_getitem__(cls, limit: int) -> type:
+        return _cached_subclass(
+            ("Bitlist", limit),
+            lambda: type(f"Bitlist[{limit}]", (Bitlist,), {"LIMIT": limit}),
+        )
+
+    @classmethod
+    def is_fixed_byte_length(cls) -> bool:
+        return False
+
+    @classmethod
+    def min_byte_length(cls) -> int:
+        return 1
+
+    @classmethod
+    def max_byte_length(cls) -> int:
+        return (cls.LIMIT + 7) // 8 + 1
+
+    @classmethod
+    def default(cls):
+        return cls()
+
+    def __len__(self):
+        return len(self._bits)
+
+    def __iter__(self):
+        return iter(self._bits)
+
+    def __getitem__(self, i):
+        return self._bits[i]
+
+    def __setitem__(self, i, v):
+        self._bits[i] = bool(v)
+
+    def append(self, v):
+        if len(self._bits) >= self.LIMIT:
+            raise ValueError("Bitlist full")
+        self._bits.append(bool(v))
+
+    def __eq__(self, other):
+        return isinstance(other, Bitlist) and other.LIMIT == self.LIMIT and other._bits == self._bits
+
+    def __hash__(self):
+        return hash((self.LIMIT, tuple(self._bits)))
+
+    def encode_bytes(self) -> bytes:
+        # bits + delimiter bit (ssz/simple-serialize.md bitlist encoding)
+        bits = self._bits + [True]
+        return _bitfield_bytes(bits)
+
+    @classmethod
+    def decode_bytes(cls, data: bytes):
+        if len(data) == 0:
+            raise DeserializationError("Bitlist: empty bytes")
+        if data[-1] == 0:
+            raise DeserializationError("Bitlist: missing delimiter bit")
+        total_bits = (len(data) - 1) * 8 + data[-1].bit_length() - 1
+        if total_bits > cls.LIMIT:
+            raise DeserializationError(f"Bitlist: {total_bits} bits exceeds limit {cls.LIMIT}")
+        bits = [bool((data[i // 8] >> (i % 8)) & 1) for i in range(total_bits)]
+        return cls(bits)
+
+    def get_hash_tree_root(self) -> bytes:
+        limit_chunks = (self.LIMIT + 255) // 256
+        root = merkleize_chunks(pack_bytes(_bitfield_bytes(self._bits)), limit=limit_chunks)
+        return mix_in_length(root, len(self._bits))
+
+    def copy(self):
+        return self.__class__(list(self._bits))
+
+    def __repr__(self):
+        return f"{self.__class__.__name__}({''.join('1' if b else '0' for b in self._bits)})"
+
+
+# ---------------------------------------------------------------------------
+# List / Vector
+# ---------------------------------------------------------------------------
+
+
+def _pack_basic_elements(element_type: type, items: list) -> np.ndarray:
+    """Pack a sequence of basic values into 32-byte chunks (fast path)."""
+    if issubclass(element_type, uint):
+        nbytes = element_type.BITS // 8
+        if nbytes <= 8:
+            dt = {1: np.uint8, 2: np.uint16, 4: np.uint32, 8: np.uint64}[nbytes]
+            arr = np.array([int(v) for v in items], dtype=dt)
+            return pack_bytes(arr.tobytes())
+        data = b"".join(int(v).to_bytes(nbytes, "little") for v in items)
+        return pack_bytes(data)
+    if issubclass(element_type, boolean):
+        return pack_bytes(bytes(int(v) for v in items))
+    raise TypeError(f"not a basic type: {element_type}")
+
+
+class _Sequence(View):
+    """Shared element-sequence behavior for List and Vector."""
+
+    ELEMENT_TYPE: type = View
+
+    def __init__(self, *args):
+        if len(args) == 1 and not isinstance(args[0], (int, bytes, str, View)):
+            try:
+                args = tuple(args[0])
+            except TypeError:
+                pass
+        et = self.ELEMENT_TYPE
+        self._items = [et.coerce_view(v) for v in args]
+        self._check_init_length()
+        self._root_cache: bytes | None = None
+
+    def _check_init_length(self):
+        raise NotImplementedError
+
+    def __len__(self):
+        return len(self._items)
+
+    def __iter__(self):
+        return iter(self._items)
+
+    def __getitem__(self, i):
+        if isinstance(i, slice):
+            return self._items[i]
+        if isinstance(i, int) and not -len(self._items) <= i < len(self._items):
+            raise IndexError(f"index {i} out of range for length {len(self._items)}")
+        return self._items[int(i)]
+
+    def __setitem__(self, i, v):
+        if isinstance(i, int) and not -len(self._items) <= i < len(self._items):
+            raise IndexError(f"index {i} out of range for length {len(self._items)}")
+        self._items[int(i)] = self.ELEMENT_TYPE.coerce_view(v)
+        self._root_cache = None
+
+    def __eq__(self, other):
+        return (
+            isinstance(other, _Sequence)
+            and other.ELEMENT_TYPE is self.ELEMENT_TYPE
+            and other._items == self._items
+        )
+
+    def __hash__(self):
+        return hash(tuple(self._items))
+
+    def index(self, v):
+        return self._items.index(self.ELEMENT_TYPE.coerce_view(v))
+
+    def __contains__(self, v):
+        try:
+            return self.ELEMENT_TYPE.coerce_view(v) in self._items
+        except (ValueError, TypeError):
+            return False
+
+    def copy(self):
+        new = self.__class__.__new__(self.__class__)
+        new._items = [v.copy() for v in self._items]
+        new._root_cache = self._root_cache
+        return new
+
+    def _invalidate(self):
+        self._root_cache = None
+
+    # --- serialization (element sequence rules, ssz/simple-serialize.md) ---
+
+    def encode_bytes(self) -> bytes:
+        et = self.ELEMENT_TYPE
+        if issubclass(et, uint) and et.BITS <= 64:
+            nbytes = et.BITS // 8
+            dt = {1: np.uint8, 2: np.uint16, 4: np.uint32, 8: np.uint64}[nbytes]
+            return np.array([int(v) for v in self._items], dtype=dt).tobytes()
+        if et.is_fixed_byte_length():
+            return b"".join(v.encode_bytes() for v in self._items)
+        parts = [v.encode_bytes() for v in self._items]
+        offset = OFFSET_BYTE_LENGTH * len(parts)
+        out = io.BytesIO()
+        for p in parts:
+            out.write(offset.to_bytes(OFFSET_BYTE_LENGTH, "little"))
+            offset += len(p)
+        for p in parts:
+            out.write(p)
+        return out.getvalue()
+
+    @classmethod
+    def _decode_elements(cls, data: bytes, max_count: int, exact_count: int | None = None) -> list:
+        et = cls.ELEMENT_TYPE
+        items: list = []
+        if et.is_fixed_byte_length():
+            elen = et.type_byte_length()
+            if len(data) % elen != 0:
+                raise DeserializationError(f"{cls.__name__}: byte length {len(data)} not a multiple of {elen}")
+            count = len(data) // elen
+            if exact_count is not None and count != exact_count:
+                raise DeserializationError(f"{cls.__name__}: expected {exact_count} elements, got {count}")
+            if count > max_count:
+                raise DeserializationError(f"{cls.__name__}: {count} elements exceeds limit {max_count}")
+            for i in range(count):
+                items.append(et.decode_bytes(data[i * elen : (i + 1) * elen]))
+            return items
+        # variable-size elements: offset table
+        if len(data) == 0:
+            if exact_count not in (None, 0):
+                raise DeserializationError(f"{cls.__name__}: expected {exact_count} elements, got 0")
+            return items
+        if len(data) < OFFSET_BYTE_LENGTH:
+            raise DeserializationError(f"{cls.__name__}: truncated offset table")
+        first_offset = int.from_bytes(data[:OFFSET_BYTE_LENGTH], "little")
+        if first_offset % OFFSET_BYTE_LENGTH != 0 or first_offset == 0:
+            raise DeserializationError(f"{cls.__name__}: bad first offset {first_offset}")
+        count = first_offset // OFFSET_BYTE_LENGTH
+        if exact_count is not None and count != exact_count:
+            raise DeserializationError(f"{cls.__name__}: expected {exact_count} elements, got {count}")
+        if count > max_count:
+            raise DeserializationError(f"{cls.__name__}: {count} elements exceeds limit {max_count}")
+        offsets = [int.from_bytes(data[i * 4 : i * 4 + 4], "little") for i in range(count)]
+        offsets.append(len(data))
+        for i in range(count):
+            if offsets[i] > offsets[i + 1] or offsets[i + 1] > len(data):
+                raise DeserializationError(f"{cls.__name__}: non-monotonic offsets")
+            items.append(et.decode_bytes(data[offsets[i] : offsets[i + 1]]))
+        return items
+
+    def _element_chunks(self) -> np.ndarray:
+        et = self.ELEMENT_TYPE
+        if issubclass(et, BasicView):
+            return _pack_basic_elements(et, self._items)
+        roots = [v.get_hash_tree_root() for v in self._items]
+        if not roots:
+            return np.empty((0, 32), dtype=np.uint8)
+        return np.frombuffer(b"".join(roots), dtype=np.uint8).reshape(len(roots), 32)
+
+    @classmethod
+    def _chunk_limit(cls, capacity: int) -> int:
+        et = cls.ELEMENT_TYPE
+        if issubclass(et, BasicView):
+            return (capacity * et.type_byte_length() + 31) // 32
+        return capacity
+
+
+class List(_Sequence):
+    LIMIT: int = 0
+
+    def __class_getitem__(cls, params) -> type:
+        element_type, limit = params
+        element_type = _coerce_type(element_type)
+        limit = int(limit)
+        return _cached_subclass(
+            ("List", element_type, limit),
+            lambda: type(
+                f"List[{element_type.__name__},{limit}]",
+                (List,),
+                {"ELEMENT_TYPE": element_type, "LIMIT": limit},
+            ),
+        )
+
+    def _check_init_length(self):
+        if len(self._items) > self.LIMIT:
+            raise ValueError(f"{self.__class__.__name__}: {len(self._items)} elements exceeds limit {self.LIMIT}")
+
+    @classmethod
+    def is_fixed_byte_length(cls) -> bool:
+        return False
+
+    @classmethod
+    def min_byte_length(cls) -> int:
+        return 0
+
+    @classmethod
+    def max_byte_length(cls) -> int:
+        et = cls.ELEMENT_TYPE
+        per = et.max_byte_length() + (0 if et.is_fixed_byte_length() else OFFSET_BYTE_LENGTH)
+        return per * cls.LIMIT
+
+    @classmethod
+    def default(cls):
+        return cls()
+
+    def append(self, v):
+        if len(self._items) >= self.LIMIT:
+            raise ValueError(f"{self.__class__.__name__}: append past limit {self.LIMIT}")
+        self._items.append(self.ELEMENT_TYPE.coerce_view(v))
+        self._root_cache = None
+
+    def pop(self, idx: int = -1):
+        if not self._items:
+            raise IndexError("pop from empty List")
+        self._root_cache = None
+        return self._items.pop(idx)
+
+    @classmethod
+    def decode_bytes(cls, data: bytes):
+        return cls(cls._decode_elements(data, cls.LIMIT))
+
+    def get_hash_tree_root(self) -> bytes:
+        if self._root_cache is not None and self.ELEMENT_TYPE.is_immutable_subtree():
+            return self._root_cache
+        root = merkleize_chunks(self._element_chunks(), limit=self._chunk_limit(self.LIMIT))
+        self._root_cache = mix_in_length(root, len(self._items))
+        return self._root_cache
+
+    def __repr__(self):
+        return f"{self.__class__.__name__}({list(self._items)!r})"
+
+
+class Vector(_Sequence):
+    LENGTH: int = 0
+
+    def __class_getitem__(cls, params) -> type:
+        element_type, length = params
+        element_type = _coerce_type(element_type)
+        length = int(length)
+        if length <= 0:
+            raise TypeError("Vector length must be > 0")
+        return _cached_subclass(
+            ("Vector", element_type, length),
+            lambda: type(
+                f"Vector[{element_type.__name__},{length}]",
+                (Vector,),
+                {"ELEMENT_TYPE": element_type, "LENGTH": length},
+            ),
+        )
+
+    def __init__(self, *args):
+        if not args:
+            args = tuple(self.ELEMENT_TYPE.default() for _ in range(self.LENGTH))
+        super().__init__(*args)
+
+    def _check_init_length(self):
+        if len(self._items) != self.LENGTH:
+            raise ValueError(f"{self.__class__.__name__}: expected {self.LENGTH} elements, got {len(self._items)}")
+
+    @classmethod
+    def is_fixed_byte_length(cls) -> bool:
+        return cls.ELEMENT_TYPE.is_fixed_byte_length()
+
+    @classmethod
+    def type_byte_length(cls) -> int:
+        return cls.ELEMENT_TYPE.type_byte_length() * cls.LENGTH
+
+    @classmethod
+    def min_byte_length(cls) -> int:
+        et = cls.ELEMENT_TYPE
+        if et.is_fixed_byte_length():
+            return cls.type_byte_length()
+        return (et.min_byte_length() + OFFSET_BYTE_LENGTH) * cls.LENGTH
+
+    @classmethod
+    def max_byte_length(cls) -> int:
+        et = cls.ELEMENT_TYPE
+        if et.is_fixed_byte_length():
+            return cls.type_byte_length()
+        return (et.max_byte_length() + OFFSET_BYTE_LENGTH) * cls.LENGTH
+
+    @classmethod
+    def default(cls):
+        return cls()
+
+    @classmethod
+    def decode_bytes(cls, data: bytes):
+        return cls(cls._decode_elements(data, cls.LENGTH, exact_count=cls.LENGTH))
+
+    def get_hash_tree_root(self) -> bytes:
+        if self._root_cache is not None and self.ELEMENT_TYPE.is_immutable_subtree():
+            return self._root_cache
+        self._root_cache = merkleize_chunks(
+            self._element_chunks(), limit=self._chunk_limit(self.LENGTH)
+        )
+        return self._root_cache
+
+    def __repr__(self):
+        return f"{self.__class__.__name__}({list(self._items)!r})"
+
+
+# ---------------------------------------------------------------------------
+# Container
+# ---------------------------------------------------------------------------
+
+
+class Container(View):
+    _field_names: tuple[str, ...] = ()
+    _field_types: tuple[type, ...] = ()
+
+    def __init_subclass__(cls, **kwargs):
+        super().__init_subclass__(**kwargs)
+        fields: dict[str, type] = {}
+        for klass in reversed(cls.__mro__):
+            ann = klass.__dict__.get("__annotations__", {})
+            for name, t in ann.items():
+                if name.startswith("_"):
+                    continue
+                fields[name] = _coerce_type(t)
+        cls._field_names = tuple(fields.keys())
+        cls._field_types = tuple(fields.values())
+        # root cache is only safe when every child subtree is immutable:
+        # then __setattr__ covers all invalidation paths
+        cls._cacheable = all(t.is_immutable_subtree() for t in cls._field_types)
+
+    def __init__(self, **kwargs):
+        object.__setattr__(self, "_root_cache", None)
+        values = {}
+        for name, t in zip(self._field_names, self._field_types):
+            if name in kwargs:
+                v = kwargs.pop(name)
+                values[name] = t.coerce_view(v) if not isinstance(v, t) else v
+            else:
+                values[name] = t.default()
+        if kwargs:
+            raise TypeError(f"{self.__class__.__name__}: unknown fields {list(kwargs)}")
+        object.__setattr__(self, "_values", values)
+
+    @classmethod
+    def fields(cls) -> dict[str, type]:
+        return dict(zip(cls._field_names, cls._field_types))
+
+    def __getattr__(self, name):
+        # only called when normal lookup fails
+        values = object.__getattribute__(self, "_values")
+        if name in values:
+            return values[name]
+        raise AttributeError(f"{self.__class__.__name__} has no field {name!r}")
+
+    def __setattr__(self, name, value):
+        if name.startswith("_"):
+            object.__setattr__(self, name, value)
+            return
+        try:
+            idx = self._field_names.index(name)
+        except ValueError:
+            raise AttributeError(f"{self.__class__.__name__} has no field {name!r}") from None
+        t = self._field_types[idx]
+        self._values[name] = t.coerce_view(value) if not isinstance(value, t) else value
+        object.__setattr__(self, "_root_cache", None)
+
+    def __eq__(self, other):
+        return (
+            isinstance(other, Container)
+            and other.__class__._field_names == self._field_names
+            and other.__class__._field_types == self.__class__._field_types
+            and all(other._values[n] == self._values[n] for n in self._field_names)
+        )
+
+    def __hash__(self):
+        return hash(self.get_hash_tree_root())
+
+    @classmethod
+    def is_fixed_byte_length(cls) -> bool:
+        return all(t.is_fixed_byte_length() for t in cls._field_types)
+
+    @classmethod
+    def type_byte_length(cls) -> int:
+        if not cls.is_fixed_byte_length():
+            raise NotImplementedError(f"{cls.__name__} is variable-size")
+        return sum(t.type_byte_length() for t in cls._field_types)
+
+    @classmethod
+    def min_byte_length(cls) -> int:
+        total = 0
+        for t in cls._field_types:
+            if t.is_fixed_byte_length():
+                total += t.type_byte_length()
+            else:
+                total += OFFSET_BYTE_LENGTH + t.min_byte_length()
+        return total
+
+    @classmethod
+    def max_byte_length(cls) -> int:
+        total = 0
+        for t in cls._field_types:
+            if t.is_fixed_byte_length():
+                total += t.type_byte_length()
+            else:
+                total += OFFSET_BYTE_LENGTH + t.max_byte_length()
+        return total
+
+    @classmethod
+    def default(cls):
+        return cls()
+
+    @classmethod
+    def coerce_view(cls, value):
+        if isinstance(value, cls):
+            return value
+        if isinstance(value, Container) and value.__class__._field_names == cls._field_names:
+            return cls(**{n: value._values[n] for n in cls._field_names})
+        raise ValueError(f"cannot coerce {value!r} to {cls.__name__}")
+
+    def encode_bytes(self) -> bytes:
+        fixed_parts: list[bytes | None] = []
+        var_parts: list[bytes] = []
+        for name, t in zip(self._field_names, self._field_types):
+            v = self._values[name]
+            if t.is_fixed_byte_length():
+                fixed_parts.append(v.encode_bytes())
+            else:
+                fixed_parts.append(None)
+                var_parts.append(v.encode_bytes())
+        fixed_len = sum(OFFSET_BYTE_LENGTH if p is None else len(p) for p in fixed_parts)
+        out = io.BytesIO()
+        offset = fixed_len
+        vi = 0
+        for p in fixed_parts:
+            if p is None:
+                out.write(offset.to_bytes(OFFSET_BYTE_LENGTH, "little"))
+                offset += len(var_parts[vi])
+                vi += 1
+            else:
+                out.write(p)
+        for p in var_parts:
+            out.write(p)
+        return out.getvalue()
+
+    @classmethod
+    def decode_bytes(cls, data: bytes):
+        values: dict[str, View] = {}
+        pos = 0
+        offsets: list[tuple[str, type, int]] = []
+        for name, t in zip(cls._field_names, cls._field_types):
+            if t.is_fixed_byte_length():
+                elen = t.type_byte_length()
+                if pos + elen > len(data):
+                    raise DeserializationError(f"{cls.__name__}: truncated at field {name}")
+                values[name] = t.decode_bytes(data[pos : pos + elen])
+                pos += elen
+            else:
+                if pos + OFFSET_BYTE_LENGTH > len(data):
+                    raise DeserializationError(f"{cls.__name__}: truncated offset at field {name}")
+                offsets.append((name, t, int.from_bytes(data[pos : pos + 4], "little")))
+                pos += OFFSET_BYTE_LENGTH
+        if offsets:
+            if offsets[0][2] != pos:
+                raise DeserializationError(f"{cls.__name__}: first offset {offsets[0][2]} != fixed size {pos}")
+            bounds = [o[2] for o in offsets] + [len(data)]
+            for (name, t, start), end in zip(offsets, bounds[1:]):
+                if start > end or end > len(data):
+                    raise DeserializationError(f"{cls.__name__}: bad offsets for field {name}")
+                values[name] = t.decode_bytes(data[start:end])
+        elif pos != len(data):
+            raise DeserializationError(f"{cls.__name__}: {len(data) - pos} trailing bytes")
+        return cls(**values)
+
+    def get_hash_tree_root(self) -> bytes:
+        if self._root_cache is not None and self._cacheable:
+            return self._root_cache
+        roots = b"".join(self._values[n].get_hash_tree_root() for n in self._field_names)
+        chunks = np.frombuffer(roots, dtype=np.uint8).reshape(len(self._field_names), 32)
+        object.__setattr__(self, "_root_cache", merkleize_chunks(chunks))
+        return self._root_cache
+
+    def copy(self):
+        new = self.__class__.__new__(self.__class__)
+        object.__setattr__(new, "_root_cache", self._root_cache)
+        object.__setattr__(new, "_values", {n: v.copy() for n, v in self._values.items()})
+        return new
+
+    def __repr__(self):
+        inner = ", ".join(f"{n}={self._values[n]!r}" for n in self._field_names)
+        return f"{self.__class__.__name__}({inner})"
+
+
+# ---------------------------------------------------------------------------
+# Union
+# ---------------------------------------------------------------------------
+
+
+class Union(View):
+    OPTIONS: tuple[type | None, ...] = ()
+
+    def __init__(self, selector: int, value: Any = None):
+        if not 0 <= selector < len(self.OPTIONS):
+            raise ValueError(f"Union selector {selector} out of range")
+        t = self.OPTIONS[selector]
+        if t is None:
+            if value is not None:
+                raise ValueError("Union None option takes no value")
+            self._value = None
+        else:
+            self._value = t.coerce_view(value)
+        self._selector = selector
+
+    def __class_getitem__(cls, params) -> type:
+        if not isinstance(params, tuple):
+            params = (params,)
+        opts = tuple(None if p is None else _coerce_type(p) for p in params)
+        if len(opts) == 0 or (opts[0] is None and len(opts) == 1):
+            raise TypeError("invalid Union options")
+        return _cached_subclass(
+            ("Union", opts),
+            lambda: type(
+                f"Union[{','.join('None' if o is None else o.__name__ for o in opts)}]",
+                (Union,),
+                {"OPTIONS": opts},
+            ),
+        )
+
+    @property
+    def selector(self) -> int:
+        return self._selector
+
+    @property
+    def value(self):
+        return self._value
+
+    @classmethod
+    def is_fixed_byte_length(cls) -> bool:
+        return False
+
+    @classmethod
+    def min_byte_length(cls) -> int:
+        return 1
+
+    @classmethod
+    def max_byte_length(cls) -> int:
+        return 1 + max((o.max_byte_length() if o else 0) for o in cls.OPTIONS)
+
+    @classmethod
+    def default(cls):
+        t = cls.OPTIONS[0]
+        return cls(0, None if t is None else t.default())
+
+    def __eq__(self, other):
+        return (
+            isinstance(other, Union)
+            and other.OPTIONS == self.OPTIONS
+            and other._selector == self._selector
+            and other._value == self._value
+        )
+
+    def __hash__(self):
+        return hash((self.OPTIONS, self._selector, self._value))
+
+    def encode_bytes(self) -> bytes:
+        body = b"" if self._value is None else self._value.encode_bytes()
+        return bytes([self._selector]) + body
+
+    @classmethod
+    def decode_bytes(cls, data: bytes):
+        if len(data) < 1:
+            raise DeserializationError("Union: empty bytes")
+        selector = data[0]
+        if selector >= len(cls.OPTIONS):
+            raise DeserializationError(f"Union: selector {selector} out of range")
+        t = cls.OPTIONS[selector]
+        if t is None:
+            if len(data) != 1:
+                raise DeserializationError("Union: None option with body")
+            return cls(selector, None)
+        return cls(selector, t.decode_bytes(data[1:]))
+
+    def get_hash_tree_root(self) -> bytes:
+        body_root = b"\x00" * 32 if self._value is None else self._value.get_hash_tree_root()
+        return mix_in_selector(body_root, self._selector)
+
+    def copy(self):
+        return self.__class__(self._selector, None if self._value is None else self._value.copy())
+
+    def __repr__(self):
+        return f"{self.__class__.__name__}(selector={self._selector}, value={self._value!r})"
+
+
+# ---------------------------------------------------------------------------
+# Module-level API (reference surface: utils/ssz/ssz_impl.py:8-37)
+# ---------------------------------------------------------------------------
+
+
+def serialize(obj: View) -> bytes:
+    return obj.encode_bytes()
+
+
+def deserialize(typ: type, data: bytes) -> View:
+    return typ.decode_bytes(data)
+
+
+def hash_tree_root(obj: View) -> Bytes32:
+    if isinstance(obj, View):
+        return Bytes32(obj.get_hash_tree_root())
+    raise TypeError(f"hash_tree_root: not an SSZ value: {obj!r}")
+
+
+def uint_to_bytes(n: uint) -> bytes:
+    return n.encode_bytes()

@@ -1,0 +1,10 @@
+"""Test-session environment: force JAX onto CPU with 8 virtual devices so
+multi-chip sharding (mesh/pjit/shard_map paths) is exercised without TPU
+hardware. Must run before the first `import jax` anywhere in the suite."""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()

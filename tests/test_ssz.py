@@ -1,0 +1,328 @@
+"""SSZ type-system tests: serialization round-trips, known-answer roots, and
+merkleization vs an independent in-test oracle (hashlib-only, no shared code
+paths with ssz/merkle.py's batched implementation)."""
+
+import hashlib
+
+import pytest
+
+from eth_consensus_specs_tpu.ssz import (
+    Bitlist,
+    Bitvector,
+    ByteList,
+    Bytes32,
+    Bytes48,
+    Container,
+    DeserializationError,
+    List,
+    Union,
+    Vector,
+    boolean,
+    deserialize,
+    hash_tree_root,
+    serialize,
+    uint8,
+    uint16,
+    uint64,
+    uint256,
+)
+
+
+def sha(x: bytes) -> bytes:
+    return hashlib.sha256(x).digest()
+
+
+def naive_merkleize(chunks: list[bytes], limit: int) -> bytes:
+    """Independent oracle: full zero-padded binary tree, no batching."""
+    padded = 1 if limit == 0 else 1 << max(limit - 1, 0).bit_length()
+    nodes = list(chunks) + [b"\x00" * 32] * (padded - len(chunks))
+    while len(nodes) > 1:
+        nodes = [sha(nodes[i] + nodes[i + 1]) for i in range(0, len(nodes), 2)]
+    return nodes[0]
+
+
+# --- basic types -----------------------------------------------------------
+
+
+def test_uint_serialization():
+    assert serialize(uint64(0)) == b"\x00" * 8
+    assert serialize(uint64(16)) == (16).to_bytes(8, "little")
+    assert serialize(uint8(255)) == b"\xff"
+    assert serialize(uint256(2**256 - 1)) == b"\xff" * 32
+    assert deserialize(uint64, (12345).to_bytes(8, "little")) == 12345
+
+
+def test_uint_range_checks():
+    with pytest.raises(ValueError):
+        uint8(256)
+    with pytest.raises(ValueError):
+        uint64(-1)
+    with pytest.raises(ValueError):
+        uint64(2**64)
+
+
+def test_uint_hash_tree_root():
+    assert bytes(hash_tree_root(uint64(17))) == (17).to_bytes(8, "little") + b"\x00" * 24
+    assert bytes(hash_tree_root(uint256(5))) == (5).to_bytes(32, "little")
+    assert bytes(hash_tree_root(boolean(True))) == b"\x01" + b"\x00" * 31
+
+
+def test_boolean():
+    assert serialize(boolean(True)) == b"\x01"
+    assert serialize(boolean(False)) == b"\x00"
+    with pytest.raises(ValueError):
+        boolean(2)
+    with pytest.raises(DeserializationError):
+        deserialize(boolean, b"\x02")
+
+
+def test_bytes_types():
+    b = Bytes32(b"\x01" * 32)
+    assert serialize(b) == b"\x01" * 32
+    assert bytes(hash_tree_root(b)) == b"\x01" * 32
+    with pytest.raises(ValueError):
+        Bytes32(b"\x01" * 31)
+    b48 = Bytes48()
+    assert bytes(b48) == b"\x00" * 48
+    # 48 bytes -> two chunks -> one hash
+    assert bytes(hash_tree_root(b48)) == sha(b"\x00" * 64)
+
+
+def test_bytelist():
+    BL = ByteList[100]
+    v = BL(b"hello")
+    assert serialize(v) == b"hello"
+    assert deserialize(BL, b"hello") == v
+    limit_chunks = (100 + 31) // 32  # 4
+    chunk = b"hello" + b"\x00" * 27
+    expect = sha(naive_merkleize([chunk], limit_chunks) + (5).to_bytes(32, "little"))
+    assert bytes(hash_tree_root(v)) == expect
+    with pytest.raises(ValueError):
+        BL(b"x" * 101)
+
+
+# --- bitfields -------------------------------------------------------------
+
+
+def test_bitvector():
+    BV = Bitvector[10]
+    v = BV([1, 0, 1, 0, 0, 0, 0, 0, 1, 1])
+    assert serialize(v) == bytes([0b00000101, 0b00000011])
+    assert deserialize(BV, serialize(v)) == v
+    # padding bits beyond length must be zero on decode
+    with pytest.raises(DeserializationError):
+        deserialize(BV, bytes([0x05, 0xFF]))
+
+
+def test_bitlist():
+    BL = Bitlist[8]
+    v = BL([1, 0, 1])
+    # bits 101 + delimiter at index 3 -> 0b1101 = 13
+    assert serialize(v) == bytes([0b1101])
+    assert deserialize(BL, bytes([0b1101])) == v
+    assert len(v) == 3
+    empty = BL()
+    assert serialize(empty) == b"\x01"
+    assert deserialize(BL, b"\x01") == empty
+    with pytest.raises(DeserializationError):
+        deserialize(BL, b"\x00")  # no delimiter
+    with pytest.raises(DeserializationError):
+        deserialize(Bitlist[3], bytes([0b11111]))  # 4 bits > limit 3
+    chunk = bytes([0b101]) + b"\x00" * 31
+    expect = sha(naive_merkleize([chunk], 1) + (3).to_bytes(32, "little"))
+    assert bytes(hash_tree_root(v)) == expect
+
+
+# --- sequences -------------------------------------------------------------
+
+
+def test_list_uint64():
+    L = List[uint64, 1024]
+    v = L(1, 2, 3)
+    assert serialize(v) == b"".join(i.to_bytes(8, "little") for i in (1, 2, 3))
+    assert deserialize(L, serialize(v)) == v
+    chunks = [
+        (1).to_bytes(8, "little") + (2).to_bytes(8, "little") + (3).to_bytes(8, "little") + b"\x00" * 8
+    ]
+    limit_chunks = 1024 * 8 // 32
+    expect = sha(naive_merkleize(chunks, limit_chunks) + (3).to_bytes(32, "little"))
+    assert bytes(hash_tree_root(v)) == expect
+    v.append(4)
+    assert len(v) == 4
+    assert v[3] == 4
+    with pytest.raises(ValueError):
+        List[uint64, 2](1, 2, 3)
+
+
+def test_list_append_invalidates_root():
+    L = List[uint64, 64]
+    v = L(1)
+    r1 = hash_tree_root(v)
+    v.append(2)
+    r2 = hash_tree_root(v)
+    assert r1 != r2
+    v[1] = 3
+    assert hash_tree_root(v) != r2
+
+
+def test_vector():
+    V = Vector[uint64, 4]
+    v = V(1, 2, 3, 4)
+    assert serialize(v) == b"".join(i.to_bytes(8, "little") for i in (1, 2, 3, 4))
+    assert deserialize(V, serialize(v)) == v
+    chunk = serialize(v)
+    assert bytes(hash_tree_root(v)) == naive_merkleize([chunk], 1)
+    d = V.default()
+    assert list(d) == [0, 0, 0, 0]
+    with pytest.raises(ValueError):
+        V(1, 2, 3)
+    with pytest.raises(DeserializationError):
+        deserialize(V, b"\x00" * 31)
+
+
+def test_vector_of_roots():
+    V = Vector[Bytes32, 2]
+    a, b = Bytes32(b"\xaa" * 32), Bytes32(b"\xbb" * 32)
+    v = V(a, b)
+    assert bytes(hash_tree_root(v)) == sha(bytes(a) + bytes(b))
+
+
+# --- containers ------------------------------------------------------------
+
+
+class Inner(Container):
+    a: uint64
+    b: Bytes32
+
+
+class Outer(Container):
+    x: uint8
+    inner: Inner
+    items: List[uint64, 32]
+
+
+def test_container_basic():
+    c = Inner(a=7, b=Bytes32(b"\x01" * 32))
+    assert c.a == 7
+    data = serialize(c)
+    assert data == (7).to_bytes(8, "little") + b"\x01" * 32
+    assert deserialize(Inner, data) == c
+    expect = sha(bytes(hash_tree_root(uint64(7))) + b"\x01" * 32)
+    assert bytes(hash_tree_root(c)) == expect
+
+
+def test_container_variable_fields():
+    o = Outer(x=1, inner=Inner(a=2), items=List[uint64, 32](5, 6))
+    data = serialize(o)
+    # fixed part: 1 (uint8) + 40 (Inner) + 4 (offset) = 45
+    assert int.from_bytes(data[41:45], "little") == 45
+    rt = deserialize(Outer, data)
+    assert rt == o
+    assert rt.items[1] == 6
+    # container htr = merkleize of 3 field roots
+    roots = [
+        bytes(hash_tree_root(o.x)),
+        bytes(hash_tree_root(o.inner)),
+        bytes(hash_tree_root(o.items)),
+    ]
+    assert bytes(hash_tree_root(o)) == naive_merkleize(roots, 3)
+
+
+def test_container_defaults_and_copy():
+    o = Outer()
+    assert o.x == 0 and o.inner.a == 0 and len(o.items) == 0
+    c = o.copy()
+    c.inner.a = 9
+    c.items.append(1)
+    assert o.inner.a == 0 and len(o.items) == 0
+    assert c.inner.a == 9
+
+
+def test_container_root_cache_invalidation():
+    o = Outer(x=1)
+    r1 = hash_tree_root(o)
+    o.x = 2
+    assert hash_tree_root(o) != r1
+    # nested mutation through attribute access
+    r2 = hash_tree_root(o)
+    o.inner = Inner(a=5)
+    assert hash_tree_root(o) != r2
+
+
+def test_container_unknown_field():
+    with pytest.raises(TypeError):
+        Inner(zzz=1)
+    o = Inner()
+    with pytest.raises(AttributeError):
+        o.zzz = 1
+
+
+def test_container_trailing_bytes_rejected():
+    c = Inner(a=7)
+    with pytest.raises(DeserializationError):
+        deserialize(Inner, serialize(c) + b"\x00")
+
+
+# --- union -----------------------------------------------------------------
+
+
+def test_union():
+    U = Union[None, uint64, Bytes32]
+    v = U(1, 42)
+    assert serialize(v) == b"\x01" + (42).to_bytes(8, "little")
+    assert deserialize(U, serialize(v)) == v
+    n = U(0)
+    assert serialize(n) == b"\x00"
+    expect = sha(bytes(hash_tree_root(uint64(42))) + (1).to_bytes(32, "little"))
+    assert bytes(hash_tree_root(v)) == expect
+    with pytest.raises(DeserializationError):
+        deserialize(U, b"\x05")
+
+
+# --- list of containers (registry-shaped) ----------------------------------
+
+
+def test_list_of_containers():
+    L = List[Inner, 8]
+    v = L(Inner(a=1), Inner(a=2))
+    data = serialize(v)
+    assert deserialize(L, data) == v
+    roots = [bytes(hash_tree_root(e)) for e in v]
+    expect = sha(naive_merkleize(roots, 8) + (2).to_bytes(32, "little"))
+    assert bytes(hash_tree_root(v)) == expect
+
+
+def test_nested_mutation_invalidates_ancestor_roots():
+    """Regression: cached roots must not survive mutations made through a
+    child reference (caught by runtime probing, not the original suite)."""
+    o = Outer(items=List[uint64, 32](1, 2, 3), inner=Inner(a=1))
+    r0 = bytes(hash_tree_root(o))
+    o.items[0] = 99  # mutate child list element through parent reference
+    r1 = bytes(hash_tree_root(o))
+    assert r1 != r0
+    o.inner.a = 42  # mutate grandchild field
+    r2 = bytes(hash_tree_root(o))
+    assert r2 != r1
+    bl = Bitlist[16]([0, 0, 1])
+
+    class WithBits(Container):
+        bits: Bitlist[16]
+
+    class Wrap(Container):
+        lst: List[WithBits, 4]
+
+    w = Wrap(lst=List[WithBits, 4](WithBits(bits=bl)))
+    r0 = bytes(hash_tree_root(w))
+    w.lst[0].bits[0] = True  # three levels deep
+    assert bytes(hash_tree_root(w)) != r0
+
+
+def test_large_list_merkleization_matches_oracle():
+    L = List[uint64, 2**18]
+    n = 1000
+    v = L(range(n))
+    data = serialize(v)
+    chunks = [data[i : i + 32].ljust(32, b"\x00") for i in range(0, len(data), 32)]
+    limit_chunks = 2**18 * 8 // 32
+    expect = sha(naive_merkleize(chunks, limit_chunks) + n.to_bytes(32, "little"))
+    assert bytes(hash_tree_root(v)) == expect
