@@ -14,6 +14,7 @@ from __future__ import annotations
 
 import numpy as np
 
+from . import hashing
 from .hashing import hash_bytes, hash_pairs_batch
 
 ZERO_CHUNK = b"\x00" * 32
@@ -39,6 +40,19 @@ def _merkleize_array(chunks: np.ndarray, depth: int) -> bytes:
     n = chunks.shape[0]
     if n == 0:
         return zerohashes[depth]
+    if hashing.device_enabled():
+        from eth_consensus_specs_tpu.ops.merkle import (
+            device_subtree_worthwhile,
+            merkleize_subtree_device,
+        )
+
+        if device_subtree_worthwhile(n):
+            # whole real subtree on device, then fold virtual zero-depth on host
+            sub_depth = min(depth, max(n - 1, 0).bit_length())
+            root = merkleize_subtree_device(chunks, sub_depth)
+            for d in range(sub_depth, depth):
+                root = hash_bytes(root + zerohashes[d])
+            return root
     level = chunks
     for d in range(depth):
         cnt = level.shape[0]

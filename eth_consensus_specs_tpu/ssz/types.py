@@ -394,9 +394,7 @@ class Bitvector(View):
     LENGTH: int = 0
 
     def __init__(self, *args):
-        bits = _bits_from_args(args)
-        if not bits:
-            bits = [False] * self.LENGTH
+        bits = [False] * self.LENGTH if len(args) == 0 else _bits_from_args(args)
         if len(bits) != self.LENGTH:
             raise ValueError(f"{self.__class__.__name__}: expected {self.LENGTH} bits, got {len(bits)}")
         self._bits = bits
@@ -599,15 +597,16 @@ class _Sequence(View):
         return self._items[int(i)]
 
     def __setitem__(self, i, v):
-        if isinstance(i, int) and not -len(self._items) <= i < len(self._items):
+        if isinstance(i, slice):
+            raise TypeError("slice assignment is not supported; assign elements individually")
+        if not -len(self._items) <= i < len(self._items):
             raise IndexError(f"index {i} out of range for length {len(self._items)}")
         self._items[int(i)] = self.ELEMENT_TYPE.coerce_view(v)
         self._root_cache = None
 
     def __eq__(self, other):
         return (
-            isinstance(other, _Sequence)
-            and other.ELEMENT_TYPE is self.ELEMENT_TYPE
+            other.__class__ is self.__class__
             and other._items == self._items
         )
 
@@ -679,6 +678,8 @@ class _Sequence(View):
         if first_offset % OFFSET_BYTE_LENGTH != 0 or first_offset == 0:
             raise DeserializationError(f"{cls.__name__}: bad first offset {first_offset}")
         count = first_offset // OFFSET_BYTE_LENGTH
+        if first_offset > len(data):
+            raise DeserializationError(f"{cls.__name__}: offset table past end of data")
         if exact_count is not None and count != exact_count:
             raise DeserializationError(f"{cls.__name__}: expected {exact_count} elements, got {count}")
         if count > max_count:

@@ -1,0 +1,3 @@
+"""Runtime support layer: crypto backend switch, hashing, kzg setup tooling,
+merkle helpers, compilation cache. The seam the spec modules import
+(mirrors reference tests/core/pyspec/eth2spec/utils/)."""

@@ -1,0 +1,26 @@
+"""Persistent XLA compilation cache.
+
+The unrolled SHA-256/limb kernels trade compile time for runtime; caching
+compiled executables across processes makes that cost one-time per machine
+instead of one-time per run (bench and test drivers call this first)."""
+
+from __future__ import annotations
+
+import os
+
+_enabled = False
+
+
+def enable_persistent_cache(cache_dir: str | None = None) -> str:
+    global _enabled
+    import jax
+
+    if cache_dir is None:
+        repo_root = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+        cache_dir = os.path.join(repo_root, ".jax_cache")
+    if not _enabled:
+        os.makedirs(cache_dir, exist_ok=True)
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+        _enabled = True
+    return cache_dir

@@ -8,3 +8,7 @@ os.environ["JAX_PLATFORMS"] = "cpu"
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+
+from eth_consensus_specs_tpu.utils.cache import enable_persistent_cache
+
+enable_persistent_cache()
