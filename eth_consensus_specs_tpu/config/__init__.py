@@ -1,0 +1,147 @@
+"""Two-tier configuration system.
+
+* **Presets** (compile-time): structural sizes and limits. They fix every
+  SSZ shape and every jit-time constant — on TPU this is a feature: all
+  shapes are static at trace time. One preset = one merged dict over the
+  per-fork preset files (duplicate keys across files are an error, matching
+  the reference loader's strictness, cf. pysetup/generate_specs.py:66-82).
+
+* **Configs** (runtime): fork schedule, network params, churn — a frozen
+  namespace; changing it never changes compiled shapes (reference analogue:
+  the Configuration NamedTuple, pysetup/helpers.py:128-138).
+
+Value parsing: ints stay ints (arbitrary precision), 0x-prefixed strings
+become `bytes`, names stay strings, lists of mappings (BLOB_SCHEDULE) are
+tuples of frozen namespaces.
+"""
+
+from __future__ import annotations
+
+import os
+from functools import lru_cache
+from types import MappingProxyType
+from typing import Any, Mapping
+
+import yaml
+
+_DATA_DIR = os.path.join(os.path.dirname(os.path.abspath(__file__)), "data")
+
+# Fork lineage: each fork inherits every ancestor's preset constants.
+FORK_ORDER = [
+    "phase0",
+    "altair",
+    "bellatrix",
+    "capella",
+    "deneb",
+    "electra",
+    "fulu",
+    "gloas",
+]
+
+
+def previous_fork(fork: str) -> str | None:
+    i = FORK_ORDER.index(fork)
+    return FORK_ORDER[i - 1] if i > 0 else None
+
+
+def _parse_value(v: Any) -> Any:
+    if isinstance(v, str):
+        if v.startswith("0x"):
+            return bytes.fromhex(v[2:])
+        if v.isdigit():
+            return int(v)
+        if v in ("true", "True", "false", "False"):
+            return v in ("true", "True")
+        return v
+    if isinstance(v, bool):
+        return v
+    if isinstance(v, int):
+        return v
+    if isinstance(v, list):
+        return tuple(_parse_value(e) for e in v)
+    if isinstance(v, dict):
+        return FrozenNamespace({k: _parse_value(x) for k, x in v.items()})
+    return v
+
+
+class FrozenNamespace:
+    """Immutable attribute+mapping view over parsed config values."""
+
+    def __init__(self, values: Mapping[str, Any]):
+        object.__setattr__(self, "_values", MappingProxyType(dict(values)))
+
+    def __getattr__(self, name: str) -> Any:
+        try:
+            return object.__getattribute__(self, "_values")[name]
+        except KeyError:
+            raise AttributeError(name) from None
+
+    def __getitem__(self, name: str) -> Any:
+        return self._values[name]
+
+    def __contains__(self, name: str) -> bool:
+        return name in self._values
+
+    def __iter__(self):
+        return iter(self._values)
+
+    def __setattr__(self, name, value):
+        raise AttributeError("configuration is immutable; derive a new one with replace()")
+
+    def keys(self):
+        return self._values.keys()
+
+    def items(self):
+        return self._values.items()
+
+    def as_dict(self) -> dict:
+        return dict(self._values)
+
+    def replace(self, **overrides) -> "FrozenNamespace":
+        d = dict(self._values)
+        d.update(overrides)
+        return FrozenNamespace(d)
+
+    def __repr__(self):
+        return f"FrozenNamespace({dict(self._values)!r})"
+
+    def __eq__(self, other):
+        return isinstance(other, FrozenNamespace) and other.as_dict() == self.as_dict()
+
+
+def _load_yaml(path: str) -> dict:
+    # BaseLoader keeps every scalar a string so unquoted 0x-hex survives
+    # (safe_load would parse it to int, silently destroying byte values —
+    # the same strictness the reference loader applies).
+    with open(path) as f:
+        raw = yaml.load(f, Loader=yaml.BaseLoader) or {}
+    return {k: _parse_value(v) for k, v in raw.items()}
+
+
+@lru_cache(maxsize=None)
+def load_preset(preset_name: str, fork: str = FORK_ORDER[-1]) -> FrozenNamespace:
+    """Merged preset constants for `fork` and all its ancestors.
+
+    Duplicate keys across fork files are an error (a fork renames rather
+    than redefines, e.g. INACTIVITY_PENALTY_QUOTIENT_ALTAIR).
+    """
+    merged: dict[str, Any] = {}
+    lineage = FORK_ORDER[: FORK_ORDER.index(fork) + 1]
+    for f in lineage:
+        path = os.path.join(_DATA_DIR, "presets", preset_name, f"{f}.yaml")
+        if not os.path.exists(path):
+            continue  # fork preset not yet defined
+        values = _load_yaml(path)
+        dup = merged.keys() & values.keys()
+        if dup:
+            raise ValueError(f"duplicate preset keys across forks: {sorted(dup)}")
+        merged.update(values)
+    if not merged:
+        raise FileNotFoundError(f"no preset files for preset={preset_name}")
+    return FrozenNamespace(merged)
+
+
+@lru_cache(maxsize=None)
+def load_config(config_name: str) -> FrozenNamespace:
+    path = os.path.join(_DATA_DIR, "configs", f"{config_name}.yaml")
+    return FrozenNamespace(_load_yaml(path))

@@ -1,0 +1,11 @@
+"""First-party BLS12-381: fields, curves, pairing, hash-to-curve, signatures.
+
+The reference delegates all of this to native packages (milagro C bindings,
+arkworks Rust bindings, py_ecc; cf. reference
+tests/core/pyspec/eth2spec/utils/bls.py:1-32). None of those exist here, so
+this package IS the host-side oracle: a complete, dependency-free BLS12-381
+implementation used (a) directly as the default signature backend, and
+(b) as the correctness oracle for the TPU limb-arithmetic kernels in ops/.
+"""
+
+from . import fields, curve, pairing  # noqa: F401

@@ -1,0 +1,104 @@
+"""Optimal ate pairing on BLS12-381.
+
+Strategy chosen for a correctness-first host oracle (the batched TPU limb
+kernels in ops/ are benchmarked against this):
+
+* G2 points are untwisted once into E(Fq12) via psi(x,y) = (x*w^-2, y*w^-3)
+  — for the M-twist E': y^2 = x^3 + 4*xi this lands exactly on
+  y^2 = x^3 + 4 (asserted at runtime) — then the Miller loop runs with
+  generic affine line functions entirely in Fq12. Slower than dedicated
+  line-function towers but with far fewer places to be subtly wrong.
+* Negative BLS parameter handled by conjugating f after the loop.
+* Final exponentiation: easy part via conjugate/inverse + frobenius^2,
+  hard part as one integer pow by (p^4 - p^2 + 1)/r.
+
+Reference behavioral parity: GT/pairing surface of py_ecc & arkworks used
+by the reference's utils/bls.py:224-296 (pairing_check).
+"""
+
+from __future__ import annotations
+
+from .curve import Point, g2_infinity
+from .fields import Fq, Fq2, Fq6, Fq12, P, R, BLS_X
+
+# w^-1 in Fq12: w is (0,1) in the (c0,c1) Fq6 split.
+_W = Fq12(Fq6.zero(), Fq6.one())
+_W_INV = _W.inv()
+_W_INV2 = _W_INV * _W_INV
+_W_INV3 = _W_INV2 * _W_INV
+
+_B_FQ12 = Fq12(Fq6(Fq2.from_ints(4, 0), Fq2.zero(), Fq2.zero()), Fq6.zero())
+
+
+def _fq2_to_fq12(a: Fq2) -> Fq12:
+    return Fq12(Fq6(a, Fq2.zero(), Fq2.zero()), Fq6.zero())
+
+
+def _fq_to_fq12(a: Fq) -> Fq12:
+    return _fq2_to_fq12(Fq2(a, Fq(0)))
+
+
+def untwist(q: Point) -> Point:
+    """E'(Fq2) -> E(Fq12)."""
+    if q.is_infinity():
+        return Point.infinity(_B_FQ12)
+    x = _fq2_to_fq12(q.x) * _W_INV2
+    y = _fq2_to_fq12(q.y) * _W_INV3
+    p = Point(x, y, _B_FQ12)
+    assert p.is_on_curve(), "untwist image must satisfy y^2 = x^3 + 4"
+    return p
+
+
+def _line(t: Point, q: Point, px: Fq12, py: Fq12) -> Fq12:
+    """Line through t and q (tangent if t==q), evaluated at (px, py)."""
+    if t.x == q.x:
+        if t.y == q.y:
+            # tangent
+            x_sq = t.x.square()
+            lam = (x_sq + x_sq + x_sq) * (t.y + t.y).inv()
+        else:
+            # vertical
+            return px - t.x
+    else:
+        lam = (q.y - t.y) * (q.x - t.x).inv()
+    return (py - t.y) - lam * (px - t.x)
+
+
+def miller_loop(p: Point, q_untwisted: Point) -> Fq12:
+    """f_{|x|, Q}(P), conjugated for the negative BLS parameter. No final exp."""
+    if p.is_infinity() or q_untwisted.is_infinity():
+        return Fq12.one()
+    px, py = _fq_to_fq12(p.x), _fq_to_fq12(p.y)
+    t = q_untwisted
+    f = Fq12.one()
+    for bit in bin(-BLS_X)[3:]:
+        f = f.square() * _line(t, t, px, py)
+        t = t.double()
+        if bit == "1":
+            f = f * _line(t, q_untwisted, px, py)
+            t = t + q_untwisted
+    return f.conjugate()  # x < 0
+
+
+_HARD_EXP = (P**4 - P**2 + 1) // R
+
+
+def final_exponentiation(f: Fq12) -> Fq12:
+    # easy part: f^((p^6 - 1)(p^2 + 1))
+    f = f.conjugate() * f.inv()
+    f = f.frobenius().frobenius() * f
+    # hard part
+    return f.pow(_HARD_EXP)
+
+
+def pairing(p: Point, q: Point) -> Fq12:
+    """e(P, Q) with P in G1(Fq), Q in G2(Fq2). Full pairing with final exp."""
+    return final_exponentiation(miller_loop(p, untwist(q)))
+
+
+def pairing_check(pairs: list[tuple[Point, Point]]) -> bool:
+    """prod e(P_i, Q_i) == 1, with one shared final exponentiation."""
+    f = Fq12.one()
+    for p, q in pairs:
+        f = f * miller_loop(p, untwist(q))
+    return final_exponentiation(f).is_one()

@@ -1,0 +1,127 @@
+"""BLS signature scheme (minimal-pubkey-size: pubkeys in G1, signatures in
+G2), the construction the consensus spec relies on.
+
+API parity with the verbs the reference's backend switch exposes
+(reference: tests/core/pyspec/eth2spec/utils/bls.py:141-221): Sign, Verify,
+Aggregate, AggregateVerify, FastAggregateVerify, AggregatePKs, KeyValidate,
+SkToPk. Byte formats are the standard 48/96-byte compressed encodings.
+"""
+
+from __future__ import annotations
+
+from .curve import (
+    Point,
+    g1_from_bytes,
+    g1_generator,
+    g1_infinity,
+    g1_to_bytes,
+    g2_from_bytes,
+    g2_infinity,
+    g2_to_bytes,
+    in_subgroup,
+)
+from .fields import R
+from .hash_to_curve import hash_to_g2
+from .pairing import pairing_check
+
+
+def sk_to_pk(sk: int) -> bytes:
+    if not 0 < sk < R:
+        raise ValueError("secret key out of range")
+    return g1_to_bytes(g1_generator().mul(sk))
+
+
+def sign(sk: int, message: bytes) -> bytes:
+    if not 0 < sk < R:
+        raise ValueError("secret key out of range")
+    return g2_to_bytes(hash_to_g2(message).mul(sk))
+
+
+def key_validate(pk_bytes: bytes) -> bool:
+    """Valid compressed encoding, on curve, in subgroup, not infinity."""
+    try:
+        p = g1_from_bytes(bytes(pk_bytes))
+    except ValueError:
+        return False
+    return not p.is_infinity()
+
+
+def _load_pk(pk_bytes: bytes) -> Point | None:
+    try:
+        p = g1_from_bytes(bytes(pk_bytes))
+    except ValueError:
+        return None
+    if p.is_infinity():
+        return None
+    return p
+
+
+def _load_sig(sig_bytes: bytes) -> Point | None:
+    try:
+        return g2_from_bytes(bytes(sig_bytes))
+    except ValueError:
+        return None
+
+
+def verify(pk_bytes: bytes, message: bytes, sig_bytes: bytes) -> bool:
+    pk = _load_pk(pk_bytes)
+    sig = _load_sig(sig_bytes)
+    if pk is None or sig is None:
+        return False
+    g1 = g1_generator()
+    return pairing_check([(pk, hash_to_g2(bytes(message))), (-g1, sig)])
+
+
+def aggregate(signatures: list[bytes]) -> bytes:
+    if len(signatures) == 0:
+        raise ValueError("cannot aggregate zero signatures")
+    acc = g2_infinity()
+    for s in signatures:
+        p = _load_sig(s)
+        if p is None:
+            raise ValueError("invalid signature in aggregate")
+        acc = acc + p
+    return g2_to_bytes(acc)
+
+
+def aggregate_pks(pubkeys: list[bytes]) -> bytes:
+    if len(pubkeys) == 0:
+        raise ValueError("cannot aggregate zero pubkeys")
+    acc = g1_infinity()
+    for pk in pubkeys:
+        p = _load_pk(pk)
+        if p is None:
+            raise ValueError("invalid pubkey in aggregate")
+        acc = acc + p
+    return g1_to_bytes(acc)
+
+
+def aggregate_verify(pks: list[bytes], messages: list[bytes], sig_bytes: bytes) -> bool:
+    if len(pks) != len(messages) or len(pks) == 0:
+        return False
+    sig = _load_sig(sig_bytes)
+    if sig is None:
+        return False
+    pairs = []
+    for pk_b, msg in zip(pks, messages):
+        pk = _load_pk(pk_b)
+        if pk is None:
+            return False
+        pairs.append((pk, hash_to_g2(bytes(msg))))
+    pairs.append((-g1_generator(), sig))
+    return pairing_check(pairs)
+
+
+def fast_aggregate_verify(pks: list[bytes], message: bytes, sig_bytes: bytes) -> bool:
+    if len(pks) == 0:
+        return False
+    acc = g1_infinity()
+    for pk_b in pks:
+        pk = _load_pk(pk_b)
+        if pk is None:
+            return False
+        acc = acc + pk
+    sig = _load_sig(sig_bytes)
+    if sig is None:
+        return False
+    return pairing_check([(acc, hash_to_g2(bytes(message))), (-g1_generator(), sig)])

@@ -1,0 +1,68 @@
+"""Batched BLS verification — the device-backend slot.
+
+The consensus workload's signature hot spot is many independent
+FastAggregateVerify calls per block (<=128 attestations x committee
+aggregates; reference call sites: specs/phase0/beacon-chain.md:776-792,
+specs/altair/beacon-chain.md:575-650). The batching seams:
+
+  1. aggregate pubkey sums (G1 adds) are data-parallel per attestation;
+  2. random-linear-combination batching collapses N pairing checks into
+     one (the algorithmic seam the reference uses for KZG batches,
+     specs/deneb/polynomial-commitments.md:412-463);
+  3. the final pairing runs once per batch on host.
+
+Current state: host group arithmetic through crypto/ with the batch-RLC
+structure in place; the limb-arithmetic device MSM (ops/field_limbs) slots
+in underneath without changing callers. The RLC reduction itself is already
+the right shape for TPU: it is exactly a (scalars x points) MSM.
+"""
+
+from __future__ import annotations
+
+import secrets
+
+from eth_consensus_specs_tpu.crypto import signature as _sig
+from eth_consensus_specs_tpu.crypto.curve import g1_from_bytes, g1_generator, g1_infinity, g2_from_bytes
+from eth_consensus_specs_tpu.crypto.hash_to_curve import hash_to_g2
+from eth_consensus_specs_tpu.crypto.pairing import pairing_check
+
+
+def fast_aggregate_verify_host_pairing(pks: list[bytes], message: bytes, sig: bytes) -> bool:
+    """Single FastAggregateVerify via the host pairing (device MSM slot)."""
+    return _sig.fast_aggregate_verify(pks, message, sig)
+
+
+def batch_verify_aggregates(items: list[tuple[list[bytes], bytes, bytes]]) -> bool:
+    """Verify many (pubkeys, message, aggregate_signature) triples with ONE
+    pairing check via random linear combination:
+
+        prod_i e(r_i * aggpk_i, H(m_i)) * e(-G1, sum_i r_i * sig_i) == 1
+
+    Sound: a forged triple passes only with probability ~1/2^64 over the
+    random r_i. This is the TPU-shaped reduction: all scalar products are
+    one MSM batch.
+    """
+    if not items:
+        return True
+    pairs = []
+    sig_acc = None
+    g1 = g1_generator()
+    for pks, msg, sig_b in items:
+        if len(pks) == 0:
+            return False
+        try:
+            aggpk = g1_infinity()
+            for pk in pks:
+                p = g1_from_bytes(bytes(pk))
+                if p.is_infinity():
+                    return False
+                aggpk = aggpk + p
+            sig = g2_from_bytes(bytes(sig_b))
+        except ValueError:
+            return False
+        r = secrets.randbits(64) | 1
+        pairs.append((aggpk.mul(r), hash_to_g2(bytes(msg))))
+        term = sig.mul(r)
+        sig_acc = term if sig_acc is None else sig_acc + term
+    pairs.append((-g1, sig_acc))
+    return pairing_check(pairs)

@@ -1,0 +1,185 @@
+"""BLS backend switch — the crypto seam of the framework.
+
+Mirrors the reference's multi-backend switch surface (reference:
+tests/core/pyspec/eth2spec/utils/bls.py:57-296): capitalized verb API used
+verbatim by spec code and tests, a `bls_active` kill-switch that replaces
+signature checks with stub-byte equality for fast non-crypto tests, and
+selectable backends. Backends here:
+
+  * "pyspec": the first-party pure-Python oracle (crypto/).
+  * "tpu":    batched device verification (ops/bls_batch) with host fallback
+              for single operations — the reference's milagro/arkworks slot.
+
+Stub mode semantics match the reference's bls_active=False behavior
+(utils/bls.py:71-138): Sign returns a deterministic stub, Verify accepts
+anything shaped right.
+"""
+
+from __future__ import annotations
+
+from functools import wraps
+
+from eth_consensus_specs_tpu.crypto import signature as _sig
+from eth_consensus_specs_tpu.crypto import curve as _curve
+from eth_consensus_specs_tpu.crypto import pairing as _pairing
+from eth_consensus_specs_tpu.crypto.fields import R as CURVE_ORDER
+from eth_consensus_specs_tpu.crypto.hash_to_curve import hash_to_g2 as _hash_to_g2
+
+bls_active = True
+_backend = "pyspec"
+
+STUB_SIGNATURE = b"\x11" * 96
+STUB_PUBKEY = b"\x22" * 48
+G2_POINT_AT_INFINITY = bytes([0xC0]) + b"\x00" * 95
+G1_POINT_AT_INFINITY = bytes([0xC0]) + b"\x00" * 47
+
+
+def use_pyspec() -> None:
+    global _backend
+    _backend = "pyspec"
+
+
+def use_tpu() -> None:
+    """Route batchable verification through the device backend."""
+    global _backend
+    _backend = "tpu"
+
+
+def use_fastest() -> None:
+    use_pyspec()
+
+
+def backend_name() -> str:
+    return _backend
+
+
+def only_with_bls(alt_return=None):
+    """Decorator: run the wrapped check only when bls_active (reference
+    analogue: utils/bls.py:124-138)."""
+
+    def deco(fn):
+        @wraps(fn)
+        def wrapper(*args, **kwargs):
+            if not bls_active:
+                return alt_return
+            return fn(*args, **kwargs)
+
+        return wrapper
+
+    return deco
+
+
+# --- high-level verbs (spec API) ------------------------------------------
+
+
+@only_with_bls(alt_return=STUB_SIGNATURE)
+def Sign(sk: int, message: bytes) -> bytes:
+    return _sig.sign(int(sk), bytes(message))
+
+
+@only_with_bls(alt_return=True)
+def Verify(pk: bytes, message: bytes, sig: bytes) -> bool:
+    return _sig.verify(bytes(pk), bytes(message), bytes(sig))
+
+
+@only_with_bls(alt_return=STUB_SIGNATURE)
+def Aggregate(signatures: list) -> bytes:
+    return _sig.aggregate([bytes(s) for s in signatures])
+
+
+@only_with_bls(alt_return=True)
+def AggregateVerify(pks: list, messages: list, sig: bytes) -> bool:
+    return _sig.aggregate_verify([bytes(p) for p in pks], [bytes(m) for m in messages], bytes(sig))
+
+
+@only_with_bls(alt_return=True)
+def FastAggregateVerify(pks: list, message: bytes, sig: bytes) -> bool:
+    if _backend == "tpu":
+        from eth_consensus_specs_tpu.ops import bls_batch
+
+        return bls_batch.fast_aggregate_verify_host_pairing(
+            [bytes(p) for p in pks], bytes(message), bytes(sig)
+        )
+    return _sig.fast_aggregate_verify([bytes(p) for p in pks], bytes(message), bytes(sig))
+
+
+@only_with_bls(alt_return=STUB_PUBKEY)
+def AggregatePKs(pubkeys: list) -> bytes:
+    return _sig.aggregate_pks([bytes(p) for p in pubkeys])
+
+
+@only_with_bls(alt_return=True)
+def KeyValidate(pk: bytes) -> bool:
+    return _sig.key_validate(bytes(pk))
+
+
+@only_with_bls(alt_return=STUB_PUBKEY)
+def SkToPk(sk: int) -> bytes:
+    return _sig.sk_to_pk(int(sk))
+
+
+# --- low-level group API (reference utils/bls.py:224-296) -----------------
+
+
+def add(a, b):
+    return a + b
+
+
+def multiply(p, k: int):
+    return p.mul(int(k))
+
+
+def neg(p):
+    return -p
+
+
+def multi_exp(points: list, scalars: list):
+    """Sum of scalar*point (host reference MSM; the batched device MSM lives
+    in ops/bls_batch)."""
+    if len(points) == 0 or len(points) != len(scalars):
+        raise ValueError("multi_exp: mismatched inputs")
+    acc = None
+    for p, s in zip(points, scalars):
+        term = p.mul(int(s))
+        acc = term if acc is None else acc + term
+    return acc
+
+
+def pairing_check(pairs: list) -> bool:
+    return _pairing.pairing_check(pairs)
+
+
+def hash_to_G2(message: bytes):
+    return _hash_to_g2(bytes(message))
+
+
+def signature_to_G2(sig: bytes):
+    return _curve.g2_from_bytes(bytes(sig))
+
+
+def pubkey_to_G1(pk: bytes):
+    return _curve.g1_from_bytes(bytes(pk))
+
+
+def G1_to_pubkey(p) -> bytes:
+    return _curve.g1_to_bytes(p)
+
+
+def G2_to_signature(p) -> bytes:
+    return _curve.g2_to_bytes(p)
+
+
+def Z1():
+    return _curve.g1_infinity()
+
+
+def Z2():
+    return _curve.g2_infinity()
+
+
+def G1():
+    return _curve.g1_generator()
+
+
+def G2():
+    return _curve.g2_generator()

@@ -1,0 +1,99 @@
+"""BLS12-381 signature-scheme tests: scheme consistency, serialization
+round-trips, negative cases, batch verification, and the backend switch."""
+
+import pytest
+
+from eth_consensus_specs_tpu.crypto.curve import (
+    g1_from_bytes,
+    g1_generator,
+    g1_to_bytes,
+    g2_from_bytes,
+    g2_generator,
+    g2_to_bytes,
+    in_subgroup,
+)
+from eth_consensus_specs_tpu.ops.bls_batch import batch_verify_aggregates
+from eth_consensus_specs_tpu.utils import bls
+
+
+def setup_module():
+    bls.bls_active = True
+
+
+MSG_A = b"\x12" * 32
+MSG_B = b"\x34" * 32
+
+
+def test_sign_verify_roundtrip():
+    sk = 12345
+    pk = bls.SkToPk(sk)
+    sig = bls.Sign(sk, MSG_A)
+    assert bls.Verify(pk, MSG_A, sig)
+    assert not bls.Verify(pk, MSG_B, sig)
+    assert not bls.Verify(bls.SkToPk(999), MSG_A, sig)
+
+
+def test_signature_deterministic():
+    assert bls.Sign(7, MSG_A) == bls.Sign(7, MSG_A)
+    assert bls.Sign(7, MSG_A) != bls.Sign(8, MSG_A)
+
+
+def test_aggregate_and_fast_aggregate_verify():
+    sks = [1, 2, 3]
+    pks = [bls.SkToPk(sk) for sk in sks]
+    sigs = [bls.Sign(sk, MSG_A) for sk in sks]
+    agg = bls.Aggregate(sigs)
+    assert bls.FastAggregateVerify(pks, MSG_A, agg)
+    assert not bls.FastAggregateVerify(pks, MSG_B, agg)
+    assert not bls.FastAggregateVerify(pks[:2], MSG_A, agg)
+
+
+def test_aggregate_verify_distinct_messages():
+    sks = [5, 6]
+    msgs = [MSG_A, MSG_B]
+    pks = [bls.SkToPk(sk) for sk in sks]
+    agg = bls.Aggregate([bls.Sign(sk, m) for sk, m in zip(sks, msgs)])
+    assert bls.AggregateVerify(pks, msgs, agg)
+    assert not bls.AggregateVerify(pks, [MSG_A, MSG_A], agg)
+
+
+def test_key_validate():
+    assert bls.KeyValidate(bls.SkToPk(42))
+    assert not bls.KeyValidate(bls.G1_POINT_AT_INFINITY)
+    assert not bls.KeyValidate(b"\x00" * 48)
+    assert not bls.KeyValidate(b"\xff" * 48)
+
+
+def test_point_serialization_roundtrip():
+    p = g1_generator().mul(777)
+    assert g1_from_bytes(g1_to_bytes(p)) == p
+    q = g2_generator().mul(888)
+    assert g2_from_bytes(g2_to_bytes(q)) == q
+    assert in_subgroup(q)
+
+
+def test_invalid_signature_bytes_rejected():
+    pk = bls.SkToPk(1)
+    assert not bls.Verify(pk, MSG_A, b"\x00" * 96)
+    assert not bls.Verify(pk, MSG_A, b"\xff" * 96)
+
+
+def test_batch_verify_aggregates():
+    sks1, sks2 = [1, 2], [3, 4]
+    pks1 = [bls.SkToPk(s) for s in sks1]
+    pks2 = [bls.SkToPk(s) for s in sks2]
+    agg1 = bls.Aggregate([bls.Sign(s, MSG_A) for s in sks1])
+    agg2 = bls.Aggregate([bls.Sign(s, MSG_B) for s in sks2])
+    assert batch_verify_aggregates([(pks1, MSG_A, agg1), (pks2, MSG_B, agg2)])
+    # one bad item poisons the batch
+    assert not batch_verify_aggregates([(pks1, MSG_A, agg1), (pks2, MSG_A, agg2)])
+
+
+def test_stub_mode():
+    bls.bls_active = False
+    try:
+        assert bls.Sign(1, MSG_A) == bls.STUB_SIGNATURE
+        assert bls.Verify(b"\x00" * 48, MSG_A, bls.STUB_SIGNATURE)
+        assert bls.FastAggregateVerify([], MSG_A, bls.STUB_SIGNATURE)
+    finally:
+        bls.bls_active = True
