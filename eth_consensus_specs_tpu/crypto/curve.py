@@ -124,8 +124,7 @@ class Point:
 
 
 def _to_jacobian(p: Point):
-    one = type(p.x).one() if hasattr(type(p.x), "one") else p.x * p.x.inv()
-    return p.x, p.y, one
+    return p.x, p.y, type(p.x).one()
 
 
 def _jac_double(X, Y, Z):

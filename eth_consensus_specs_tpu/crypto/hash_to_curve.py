@@ -14,18 +14,15 @@ from __future__ import annotations
 
 import hashlib
 
-from .curve import Point, B2, g2_generator, in_subgroup
+from .curve import Point, B2, in_subgroup
 from .fields import Fq, Fq2, P, R, BLS_X
 
 DST_G2 = b"BLS_SIG_BLS12381G2_XMD:SHA-256_SSWU_RO_POP_"
 
-# Cofactors derived from the curve family structure and verified below.
-# t = x + 1 is the Frobenius trace of E/Fq.
+# G2 cofactor derived from the curve family structure and verified below.
+# t = x + 1 is the Frobenius trace of E/Fq; t2 the trace over Fq2.
 _T = BLS_X + 1
-H1 = (P + 1 - _T) // R  # |E(Fq)| = h1 * r
-# |E'(Fq2)| for the correct sextic twist = p^2 + 1 - (3*f - t2)/2 family;
-# compute by finding which candidate is divisible by r and annihilates G2.
-_T2 = _T * _T - 2 * P  # trace over Fq2
+_T2 = _T * _T - 2 * P
 
 
 def _arbitrary_twist_point() -> Point:

@@ -1,0 +1,73 @@
+"""Per-fork executable spec modules.
+
+The reference compiles markdown into one flat module per fork x preset
+(reference: pysetup/generate_specs.py:252-361). Here the same surface is a
+CLASS HIERARCHY: each fork subclasses its parent and overrides exactly the
+functions/types that fork changes — subclassing IS the fork-composition
+operation (the reference's `combine_spec_objects` dict-union,
+pysetup/helpers.py:351-380, done by the language). `get_spec()` returns a
+cached instance whose bound methods give tests the familiar call shape
+`spec.process_attestation(state, att)`.
+"""
+
+from __future__ import annotations
+
+from functools import lru_cache
+
+from eth_consensus_specs_tpu.config import FORK_ORDER, load_config, load_preset
+
+
+def _spec_class(fork: str):
+    if fork == "phase0":
+        from .phase0 import Phase0Spec
+
+        return Phase0Spec
+    if fork == "altair":
+        from .altair import AltairSpec
+
+        return AltairSpec
+    if fork == "bellatrix":
+        from .bellatrix import BellatrixSpec
+
+        return BellatrixSpec
+    if fork == "capella":
+        from .capella import CapellaSpec
+
+        return CapellaSpec
+    if fork == "deneb":
+        from .deneb import DenebSpec
+
+        return DenebSpec
+    if fork == "electra":
+        from .electra import ElectraSpec
+
+        return ElectraSpec
+    if fork == "fulu":
+        from .fulu import FuluSpec
+
+        return FuluSpec
+    if fork == "gloas":
+        from .gloas import GloasSpec
+
+        return GloasSpec
+    raise ValueError(f"unknown fork {fork!r}")
+
+
+@lru_cache(maxsize=None)
+def get_spec(fork: str = "phase0", preset_name: str = "mainnet", config_name: str | None = None):
+    """Cached spec instance for (fork, preset, config)."""
+    cls = _spec_class(fork)
+    preset = load_preset(preset_name, fork)
+    config = load_config(config_name if config_name is not None else preset_name)
+    return cls(preset, config, preset_name=preset_name)
+
+
+def available_forks() -> list[str]:
+    out = []
+    for f in FORK_ORDER:
+        try:
+            _spec_class(f)
+            out.append(f)
+        except (ValueError, ImportError):
+            break
+    return out

@@ -1,0 +1,1610 @@
+"""phase0: the core beacon-chain state machine, fork choice, genesis and
+honest-validator duties.
+
+Behavioral parity targets (reference, by section):
+  * state machine:  specs/phase0/beacon-chain.md (state_transition :1346,
+    process_epoch :1395+, process_block :1852, operations :1980+)
+  * fork choice:    specs/phase0/fork-choice.md (Store :162, get_head :403,
+    on_block :761) — the modern version with unrealized justification
+  * validator:      specs/phase0/validator.md (duties, aggregation)
+  * weak subj.:     specs/phase0/weak-subjectivity.md
+
+Architecture notes (why this is not a transliteration):
+  * One CLASS per fork; `self.` resolves constants, types and functions so a
+    later fork overrides by subclassing (see forks/__init__.py).
+  * The committee pipeline runs on the whole-permutation form of the
+    swap-or-not shuffle (ops/shuffle.py): one vectorized pass produces the
+    full epoch permutation, cached by (seed, n) — the reference instead
+    LRU-caches the per-index O(rounds) loop (pysetup/spec_builders/
+    phase0.py:48-105). Identity of the two forms is tested.
+  * Epoch accounting (rewards/penalties) also has a columnar fast path
+    (ops/state_columns.py) used when the validator set is large; the
+    object-path here is the semantics oracle.
+"""
+
+from dataclasses import dataclass, field
+
+from eth_consensus_specs_tpu.config import FrozenNamespace
+from eth_consensus_specs_tpu.ssz import (
+    Bitlist,
+    Bitvector,
+    ByteList,
+    ByteVector,
+    Bytes4,
+    Bytes32,
+    Bytes48,
+    Bytes96,
+    Container,
+    List,
+    Vector,
+    boolean,
+    hash_tree_root,
+    uint8,
+    uint32,
+    uint64,
+)
+from eth_consensus_specs_tpu.ssz.hashing import hash_bytes
+from eth_consensus_specs_tpu.ssz.merkle import is_valid_merkle_branch
+from eth_consensus_specs_tpu.utils import bls
+
+# -- aliases (custom types; reference: specs/phase0/beacon-chain.md types table)
+Slot = uint64
+Epoch = uint64
+CommitteeIndex = uint64
+ValidatorIndex = uint64
+Gwei = uint64
+Root = Bytes32
+Version = Bytes4
+DomainType = Bytes4
+ForkDigest = Bytes4
+Domain = Bytes32
+BLSPubkey = Bytes48
+BLSSignature = Bytes96
+
+
+class Phase0Spec:
+    fork_name = "phase0"
+
+    # -- constants (non-preset; beacon-chain.md constants table) -----------
+    GENESIS_SLOT = 0
+    GENESIS_EPOCH = 0
+    FAR_FUTURE_EPOCH = 2**64 - 1
+    BASE_REWARDS_PER_EPOCH = 4
+    DEPOSIT_CONTRACT_TREE_DEPTH = 32
+    JUSTIFICATION_BITS_LENGTH = 4
+    ENDIANNESS = "little"
+    BLS_WITHDRAWAL_PREFIX = b"\x00"
+    ETH1_ADDRESS_WITHDRAWAL_PREFIX = b"\x01"
+
+    DOMAIN_BEACON_PROPOSER = DomainType(b"\x00\x00\x00\x00")
+    DOMAIN_BEACON_ATTESTER = DomainType(b"\x01\x00\x00\x00")
+    DOMAIN_RANDAO = DomainType(b"\x02\x00\x00\x00")
+    DOMAIN_DEPOSIT = DomainType(b"\x03\x00\x00\x00")
+    DOMAIN_VOLUNTARY_EXIT = DomainType(b"\x04\x00\x00\x00")
+    DOMAIN_SELECTION_PROOF = DomainType(b"\x05\x00\x00\x00")
+    DOMAIN_AGGREGATE_AND_PROOF = DomainType(b"\x06\x00\x00\x00")
+    DOMAIN_APPLICATION_MASK = DomainType(b"\x00\x00\x00\x01")
+
+    TARGET_AGGREGATORS_PER_COMMITTEE = 16
+    ATTESTATION_SUBNET_COUNT = 64
+
+    # safe-block / ws defaults
+    SAFETY_DECAY = 10
+
+    def __init__(self, preset: FrozenNamespace, config: FrozenNamespace, preset_name: str = "mainnet"):
+        self.preset = preset
+        self.config = config
+        self.preset_name = preset_name
+        # expose preset constants as attributes (compile-time tier)
+        for k, v in preset.items():
+            setattr(self, k, v)
+        self._shuffle_cache: dict[tuple[bytes, int], object] = {}
+        self._build_types()
+
+    # == type system ======================================================
+
+    def _build_types(self) -> None:
+        """Construct per-preset SSZ container types (static shapes)."""
+        P = self  # preset-sized
+
+        class Fork(Container):
+            previous_version: Version
+            current_version: Version
+            epoch: Epoch
+
+        class ForkData(Container):
+            current_version: Version
+            genesis_validators_root: Root
+
+        class Checkpoint(Container):
+            epoch: Epoch
+            root: Root
+
+        class Validator(Container):
+            pubkey: BLSPubkey
+            withdrawal_credentials: Bytes32
+            effective_balance: Gwei
+            slashed: boolean
+            activation_eligibility_epoch: Epoch
+            activation_epoch: Epoch
+            exit_epoch: Epoch
+            withdrawable_epoch: Epoch
+
+        class AttestationData(Container):
+            slot: Slot
+            index: CommitteeIndex
+            beacon_block_root: Root
+            source: Checkpoint
+            target: Checkpoint
+
+        class IndexedAttestation(Container):
+            attesting_indices: List[ValidatorIndex, P.MAX_VALIDATORS_PER_COMMITTEE]
+            data: AttestationData
+            signature: BLSSignature
+
+        class PendingAttestation(Container):
+            aggregation_bits: Bitlist[P.MAX_VALIDATORS_PER_COMMITTEE]
+            data: AttestationData
+            inclusion_delay: Slot
+            proposer_index: ValidatorIndex
+
+        class Eth1Data(Container):
+            deposit_root: Root
+            deposit_count: uint64
+            block_hash: Bytes32
+
+        class HistoricalBatch(Container):
+            block_roots: Vector[Root, P.SLOTS_PER_HISTORICAL_ROOT]
+            state_roots: Vector[Root, P.SLOTS_PER_HISTORICAL_ROOT]
+
+        class DepositMessage(Container):
+            pubkey: BLSPubkey
+            withdrawal_credentials: Bytes32
+            amount: Gwei
+
+        class DepositData(Container):
+            pubkey: BLSPubkey
+            withdrawal_credentials: Bytes32
+            amount: Gwei
+            signature: BLSSignature
+
+        class BeaconBlockHeader(Container):
+            slot: Slot
+            proposer_index: ValidatorIndex
+            parent_root: Root
+            state_root: Root
+            body_root: Root
+
+        class SigningData(Container):
+            object_root: Root
+            domain: Domain
+
+        class SignedBeaconBlockHeader(Container):
+            message: BeaconBlockHeader
+            signature: BLSSignature
+
+        class ProposerSlashing(Container):
+            signed_header_1: SignedBeaconBlockHeader
+            signed_header_2: SignedBeaconBlockHeader
+
+        class AttesterSlashing(Container):
+            attestation_1: IndexedAttestation
+            attestation_2: IndexedAttestation
+
+        class Attestation(Container):
+            aggregation_bits: Bitlist[P.MAX_VALIDATORS_PER_COMMITTEE]
+            data: AttestationData
+            signature: BLSSignature
+
+        class Deposit(Container):
+            proof: Vector[Bytes32, self.DEPOSIT_CONTRACT_TREE_DEPTH + 1]
+            data: DepositData
+
+        class VoluntaryExit(Container):
+            epoch: Epoch
+            validator_index: ValidatorIndex
+
+        class SignedVoluntaryExit(Container):
+            message: VoluntaryExit
+            signature: BLSSignature
+
+        class BeaconBlockBody(Container):
+            randao_reveal: BLSSignature
+            eth1_data: Eth1Data
+            graffiti: Bytes32
+            proposer_slashings: List[ProposerSlashing, P.MAX_PROPOSER_SLASHINGS]
+            attester_slashings: List[AttesterSlashing, P.MAX_ATTESTER_SLASHINGS]
+            attestations: List[Attestation, P.MAX_ATTESTATIONS]
+            deposits: List[Deposit, P.MAX_DEPOSITS]
+            voluntary_exits: List[SignedVoluntaryExit, P.MAX_VOLUNTARY_EXITS]
+
+        class BeaconBlock(Container):
+            slot: Slot
+            proposer_index: ValidatorIndex
+            parent_root: Root
+            state_root: Root
+            body: BeaconBlockBody
+
+        class SignedBeaconBlock(Container):
+            message: BeaconBlock
+            signature: BLSSignature
+
+        class BeaconState(Container):
+            genesis_time: uint64
+            genesis_validators_root: Root
+            slot: Slot
+            fork: Fork
+            latest_block_header: BeaconBlockHeader
+            block_roots: Vector[Root, P.SLOTS_PER_HISTORICAL_ROOT]
+            state_roots: Vector[Root, P.SLOTS_PER_HISTORICAL_ROOT]
+            historical_roots: List[Root, P.HISTORICAL_ROOTS_LIMIT]
+            eth1_data: Eth1Data
+            eth1_data_votes: List[Eth1Data, P.EPOCHS_PER_ETH1_VOTING_PERIOD * P.SLOTS_PER_EPOCH]
+            eth1_deposit_index: uint64
+            validators: List[Validator, P.VALIDATOR_REGISTRY_LIMIT]
+            balances: List[Gwei, P.VALIDATOR_REGISTRY_LIMIT]
+            randao_mixes: Vector[Bytes32, P.EPOCHS_PER_HISTORICAL_VECTOR]
+            slashings: Vector[Gwei, P.EPOCHS_PER_SLASHINGS_VECTOR]
+            previous_epoch_attestations: List[PendingAttestation, P.MAX_ATTESTATIONS * P.SLOTS_PER_EPOCH]
+            current_epoch_attestations: List[PendingAttestation, P.MAX_ATTESTATIONS * P.SLOTS_PER_EPOCH]
+            justification_bits: Bitvector[self.JUSTIFICATION_BITS_LENGTH]
+            previous_justified_checkpoint: Checkpoint
+            current_justified_checkpoint: Checkpoint
+            finalized_checkpoint: Checkpoint
+
+        class Eth1Block(Container):
+            timestamp: uint64
+            deposit_root: Root
+            deposit_count: uint64
+
+        class AggregateAndProof(Container):
+            aggregator_index: ValidatorIndex
+            aggregate: Attestation
+            selection_proof: BLSSignature
+
+        class SignedAggregateAndProof(Container):
+            message: AggregateAndProof
+            signature: BLSSignature
+
+        for name, typ in list(locals().items()):
+            if isinstance(typ, type) and issubclass(typ, Container):
+                typ.__name__ = name
+                setattr(self, name, typ)
+
+    # == math / serialization helpers =====================================
+
+    @staticmethod
+    def integer_squareroot(n: int) -> int:
+        import math
+
+        if n < 0 or n >= 2**64:
+            raise ValueError("integer_squareroot: input out of uint64 range")
+        return math.isqrt(n)
+
+    @staticmethod
+    def xor(a: bytes, b: bytes) -> Bytes32:
+        return Bytes32(bytes(x ^ y for x, y in zip(a, b)))
+
+    @staticmethod
+    def uint_to_bytes(n, length: int = None) -> bytes:  # type: ignore[assignment]
+        if isinstance(n, uint64) and length is None:
+            return int(n).to_bytes(8, "little")
+        if length is None:
+            length = 8
+        return int(n).to_bytes(length, "little")
+
+    @staticmethod
+    def bytes_to_uint64(data: bytes) -> int:
+        return int.from_bytes(data, "little")
+
+    @staticmethod
+    def hash(data: bytes) -> Bytes32:
+        return Bytes32(hash_bytes(bytes(data)))
+
+    @staticmethod
+    def hash_tree_root(obj) -> Root:
+        return hash_tree_root(obj)
+
+    # == predicates =======================================================
+
+    def is_active_validator(self, validator, epoch: int) -> bool:
+        return validator.activation_epoch <= epoch < validator.exit_epoch
+
+    def is_eligible_for_activation_queue(self, validator) -> bool:
+        return (
+            validator.activation_eligibility_epoch == self.FAR_FUTURE_EPOCH
+            and validator.effective_balance == self.MAX_EFFECTIVE_BALANCE
+        )
+
+    def is_eligible_for_activation(self, state, validator) -> bool:
+        return (
+            validator.activation_eligibility_epoch <= state.finalized_checkpoint.epoch
+            and validator.activation_epoch == self.FAR_FUTURE_EPOCH
+        )
+
+    def is_slashable_validator(self, validator, epoch: int) -> bool:
+        return (not validator.slashed) and (
+            validator.activation_epoch <= epoch < validator.withdrawable_epoch
+        )
+
+    def is_slashable_attestation_data(self, data_1, data_2) -> bool:
+        # double vote or surround vote (reference: beacon-chain.md:759-771)
+        return (data_1 != data_2 and data_1.target.epoch == data_2.target.epoch) or (
+            data_1.source.epoch < data_2.source.epoch and data_2.target.epoch < data_1.target.epoch
+        )
+
+    def is_valid_indexed_attestation(self, state, indexed_attestation) -> bool:
+        indices = list(indexed_attestation.attesting_indices)
+        if len(indices) == 0 or not indices == sorted(set(indices)):
+            return False
+        pubkeys = [state.validators[i].pubkey for i in indices]
+        domain = self.get_domain(state, self.DOMAIN_BEACON_ATTESTER, indexed_attestation.data.target.epoch)
+        signing_root = self.compute_signing_root(indexed_attestation.data, domain)
+        return bls.FastAggregateVerify(pubkeys, signing_root, indexed_attestation.signature)
+
+    def is_valid_merkle_branch(self, leaf, branch, depth: int, index: int, root) -> bool:
+        return is_valid_merkle_branch(bytes(leaf), [bytes(b) for b in branch], depth, int(index), bytes(root))
+
+    # == misc computations ================================================
+
+    def compute_shuffled_index(self, index: int, index_count: int, seed: bytes) -> int:
+        """Single-index swap-or-not (spec form; whole-permutation kernel in
+        ops/shuffle.py is the production path; identity is tested)."""
+        assert index < index_count
+        for current_round in range(self.SHUFFLE_ROUND_COUNT):
+            pivot = self.bytes_to_uint64(
+                self.hash(seed + bytes([current_round]))[:8]
+            ) % index_count
+            flip = (pivot + index_count - index) % index_count
+            position = max(index, flip)
+            source = self.hash(
+                seed + bytes([current_round]) + self.uint_to_bytes(uint32(position // 256), 4)
+            )
+            byte_val = source[(position % 256) // 8]
+            bit = (byte_val >> (position % 8)) % 2
+            index = flip if bit else index
+        return index
+
+    def _shuffle_permutation(self, index_count: int, seed: bytes):
+        """Whole permutation, cached by (seed, n). perm[i] ==
+        compute_shuffled_index(i, n, seed)."""
+        key = (bytes(seed), index_count)
+        if key not in self._shuffle_cache:
+            from eth_consensus_specs_tpu.ops.shuffle import shuffle_permutation
+
+            self._shuffle_cache[key] = shuffle_permutation(
+                index_count, bytes(seed), self.SHUFFLE_ROUND_COUNT
+            )
+            if len(self._shuffle_cache) > 64:
+                self._shuffle_cache.pop(next(iter(self._shuffle_cache)))
+        return self._shuffle_cache[key]
+
+    def compute_proposer_index(self, state, indices, seed: bytes) -> int:
+        assert len(indices) > 0
+        MAX_RANDOM_BYTE = 2**8 - 1
+        total = len(indices)
+        perm = self._shuffle_permutation(total, seed)
+        i = 0
+        while True:
+            candidate_index = indices[int(perm[i % total])]
+            random_byte = self.hash(seed + self.uint_to_bytes(uint64(i // 32)))[i % 32]
+            effective_balance = state.validators[candidate_index].effective_balance
+            if effective_balance * MAX_RANDOM_BYTE >= self.MAX_EFFECTIVE_BALANCE * random_byte:
+                return int(candidate_index)
+            i += 1
+
+    def compute_committee(self, indices, seed: bytes, index: int, count: int):
+        n = len(indices)
+        start = n * index // count
+        end = n * (index + 1) // count
+        perm = self._shuffle_permutation(n, seed)
+        return [indices[int(perm[i])] for i in range(start, end)]
+
+    def compute_epoch_at_slot(self, slot: int) -> int:
+        return int(slot) // self.SLOTS_PER_EPOCH
+
+    def compute_start_slot_at_epoch(self, epoch: int) -> int:
+        return int(epoch) * self.SLOTS_PER_EPOCH
+
+    def compute_activation_exit_epoch(self, epoch: int) -> int:
+        return int(epoch) + 1 + self.MAX_SEED_LOOKAHEAD
+
+    def compute_fork_data_root(self, current_version, genesis_validators_root) -> Root:
+        return hash_tree_root(
+            self.ForkData(
+                current_version=current_version,
+                genesis_validators_root=genesis_validators_root,
+            )
+        )
+
+    def compute_fork_digest(self, current_version, genesis_validators_root) -> ForkDigest:
+        return ForkDigest(
+            bytes(self.compute_fork_data_root(current_version, genesis_validators_root))[:4]
+        )
+
+    def compute_domain(self, domain_type, fork_version=None, genesis_validators_root=None) -> Domain:
+        if fork_version is None:
+            fork_version = self.config.GENESIS_FORK_VERSION
+        if genesis_validators_root is None:
+            genesis_validators_root = Root()
+        fork_data_root = self.compute_fork_data_root(Version(fork_version), genesis_validators_root)
+        return Domain(bytes(domain_type) + bytes(fork_data_root)[:28])
+
+    def compute_signing_root(self, ssz_object, domain) -> Root:
+        return hash_tree_root(
+            self.SigningData(object_root=hash_tree_root(ssz_object), domain=Domain(domain))
+        )
+
+    # == accessors ========================================================
+
+    def get_current_epoch(self, state) -> int:
+        return self.compute_epoch_at_slot(state.slot)
+
+    def get_previous_epoch(self, state) -> int:
+        current = self.get_current_epoch(state)
+        return self.GENESIS_EPOCH if current == self.GENESIS_EPOCH else current - 1
+
+    def get_block_root(self, state, epoch: int) -> Root:
+        return self.get_block_root_at_slot(state, self.compute_start_slot_at_epoch(epoch))
+
+    def get_block_root_at_slot(self, state, slot: int) -> Root:
+        assert slot < state.slot <= slot + self.SLOTS_PER_HISTORICAL_ROOT
+        return state.block_roots[int(slot) % self.SLOTS_PER_HISTORICAL_ROOT]
+
+    def get_randao_mix(self, state, epoch: int) -> Bytes32:
+        return state.randao_mixes[int(epoch) % self.EPOCHS_PER_HISTORICAL_VECTOR]
+
+    def get_active_validator_indices(self, state, epoch: int):
+        return [
+            i for i, v in enumerate(state.validators) if self.is_active_validator(v, epoch)
+        ]
+
+    def get_validator_churn_limit(self, state) -> int:
+        active = self.get_active_validator_indices(state, self.get_current_epoch(state))
+        return max(
+            self.config.MIN_PER_EPOCH_CHURN_LIMIT, len(active) // self.config.CHURN_LIMIT_QUOTIENT
+        )
+
+    def get_seed(self, state, epoch: int, domain_type) -> Bytes32:
+        mix = self.get_randao_mix(
+            state, int(epoch) + self.EPOCHS_PER_HISTORICAL_VECTOR - self.MIN_SEED_LOOKAHEAD - 1
+        )
+        return self.hash(bytes(domain_type) + self.uint_to_bytes(uint64(epoch)) + bytes(mix))
+
+    def get_committee_count_per_slot(self, state, epoch: int) -> int:
+        active = len(self.get_active_validator_indices(state, epoch))
+        return max(
+            1,
+            min(
+                self.MAX_COMMITTEES_PER_SLOT,
+                active // self.SLOTS_PER_EPOCH // self.TARGET_COMMITTEE_SIZE,
+            ),
+        )
+
+    def get_beacon_committee(self, state, slot: int, index: int):
+        epoch = self.compute_epoch_at_slot(slot)
+        committees_per_slot = self.get_committee_count_per_slot(state, epoch)
+        return self.compute_committee(
+            indices=self.get_active_validator_indices(state, epoch),
+            seed=self.get_seed(state, epoch, self.DOMAIN_BEACON_ATTESTER),
+            index=(int(slot) % self.SLOTS_PER_EPOCH) * committees_per_slot + int(index),
+            count=committees_per_slot * self.SLOTS_PER_EPOCH,
+        )
+
+    def get_beacon_proposer_index(self, state) -> int:
+        epoch = self.get_current_epoch(state)
+        seed = self.hash(
+            bytes(self.get_seed(state, epoch, self.DOMAIN_BEACON_PROPOSER))
+            + self.uint_to_bytes(uint64(state.slot))
+        )
+        indices = self.get_active_validator_indices(state, epoch)
+        return self.compute_proposer_index(state, indices, seed)
+
+    def get_total_balance(self, state, indices) -> int:
+        return max(
+            self.EFFECTIVE_BALANCE_INCREMENT,
+            sum(int(state.validators[i].effective_balance) for i in set(indices)),
+        )
+
+    def get_total_active_balance(self, state) -> int:
+        return self.get_total_balance(
+            state, set(self.get_active_validator_indices(state, self.get_current_epoch(state)))
+        )
+
+    def get_domain(self, state, domain_type, epoch=None) -> Domain:
+        epoch = self.get_current_epoch(state) if epoch is None else int(epoch)
+        fork_version = (
+            state.fork.previous_version if epoch < state.fork.epoch else state.fork.current_version
+        )
+        return self.compute_domain(domain_type, fork_version, state.genesis_validators_root)
+
+    def get_indexed_attestation(self, state, attestation):
+        attesting_indices = self.get_attesting_indices(state, attestation)
+        return self.IndexedAttestation(
+            attesting_indices=sorted(attesting_indices),
+            data=attestation.data,
+            signature=attestation.signature,
+        )
+
+    def get_attesting_indices(self, state, attestation):
+        committee = self.get_beacon_committee(state, attestation.data.slot, attestation.data.index)
+        return {
+            int(committee[i]) for i, bit in enumerate(attestation.aggregation_bits) if bit
+        }
+
+    # == mutators =========================================================
+
+    def increase_balance(self, state, index: int, delta: int) -> None:
+        state.balances[int(index)] = int(state.balances[int(index)]) + int(delta)
+
+    def decrease_balance(self, state, index: int, delta: int) -> None:
+        bal = int(state.balances[int(index)])
+        state.balances[int(index)] = 0 if int(delta) > bal else bal - int(delta)
+
+    def initiate_validator_exit(self, state, index: int) -> None:
+        validator = state.validators[int(index)]
+        if validator.exit_epoch != self.FAR_FUTURE_EPOCH:
+            return
+        exit_epochs = [
+            int(v.exit_epoch) for v in state.validators if v.exit_epoch != self.FAR_FUTURE_EPOCH
+        ]
+        exit_queue_epoch = max(
+            exit_epochs + [self.compute_activation_exit_epoch(self.get_current_epoch(state))]
+        )
+        exit_queue_churn = len(
+            [v for v in state.validators if v.exit_epoch == exit_queue_epoch]
+        )
+        if exit_queue_churn >= self.get_validator_churn_limit(state):
+            exit_queue_epoch += 1
+        validator.exit_epoch = exit_queue_epoch
+        validator.withdrawable_epoch = (
+            int(validator.exit_epoch) + self.config.MIN_VALIDATOR_WITHDRAWABILITY_DELAY
+        )
+
+    def slash_validator(self, state, slashed_index: int, whistleblower_index=None) -> None:
+        epoch = self.get_current_epoch(state)
+        self.initiate_validator_exit(state, slashed_index)
+        validator = state.validators[int(slashed_index)]
+        validator.slashed = True
+        validator.withdrawable_epoch = max(
+            int(validator.withdrawable_epoch), epoch + self.EPOCHS_PER_SLASHINGS_VECTOR
+        )
+        state.slashings[epoch % self.EPOCHS_PER_SLASHINGS_VECTOR] = (
+            int(state.slashings[epoch % self.EPOCHS_PER_SLASHINGS_VECTOR])
+            + int(validator.effective_balance)
+        )
+        self.decrease_balance(
+            state, slashed_index, int(validator.effective_balance) // self.MIN_SLASHING_PENALTY_QUOTIENT
+        )
+        # proposer + whistleblower rewards
+        proposer_index = self.get_beacon_proposer_index(state)
+        if whistleblower_index is None:
+            whistleblower_index = proposer_index
+        whistleblower_reward = int(validator.effective_balance) // self.WHISTLEBLOWER_REWARD_QUOTIENT
+        proposer_reward = whistleblower_reward // self.PROPOSER_REWARD_QUOTIENT
+        self.increase_balance(state, proposer_index, proposer_reward)
+        self.increase_balance(state, whistleblower_index, whistleblower_reward - proposer_reward)
+
+    # == genesis ==========================================================
+
+    def initialize_beacon_state_from_eth1(self, eth1_block_hash, eth1_timestamp, deposits):
+        fork = self.Fork(
+            previous_version=Version(self.config.GENESIS_FORK_VERSION),
+            current_version=Version(self.config.GENESIS_FORK_VERSION),
+            epoch=self.GENESIS_EPOCH,
+        )
+        state = self.BeaconState(
+            genesis_time=int(eth1_timestamp) + self.config.GENESIS_DELAY,
+            fork=fork,
+            eth1_data=self.Eth1Data(
+                deposit_count=len(deposits), block_hash=Bytes32(eth1_block_hash)
+            ),
+            latest_block_header=self.BeaconBlockHeader(
+                body_root=hash_tree_root(self.BeaconBlockBody())
+            ),
+            randao_mixes=self.BeaconState.fields()["randao_mixes"](
+                [Bytes32(eth1_block_hash)] * self.EPOCHS_PER_HISTORICAL_VECTOR
+            ),
+        )
+        # apply deposits with an incrementally-updated deposit root
+        leaves = [d.data for d in deposits]
+        DepositDataList = List[self.DepositData, 2**self.DEPOSIT_CONTRACT_TREE_DEPTH]
+        for index, deposit in enumerate(deposits):
+            state.eth1_data.deposit_root = hash_tree_root(DepositDataList(leaves[: index + 1]))
+            self.process_deposit(state, deposit)
+        # finalize activations
+        for index, validator in enumerate(state.validators):
+            balance = int(state.balances[index])
+            validator.effective_balance = min(
+                balance - balance % self.EFFECTIVE_BALANCE_INCREMENT, self.MAX_EFFECTIVE_BALANCE
+            )
+            if validator.effective_balance == self.MAX_EFFECTIVE_BALANCE:
+                validator.activation_eligibility_epoch = self.GENESIS_EPOCH
+                validator.activation_epoch = self.GENESIS_EPOCH
+        state.genesis_validators_root = hash_tree_root(state.validators)
+        return state
+
+    def is_valid_genesis_state(self, state) -> bool:
+        if state.genesis_time < self.config.MIN_GENESIS_TIME:
+            return False
+        return (
+            len(self.get_active_validator_indices(state, self.GENESIS_EPOCH))
+            >= self.config.MIN_GENESIS_ACTIVE_VALIDATOR_COUNT
+        )
+
+    # == state transition =================================================
+
+    def state_transition(self, state, signed_block, validate_result: bool = True):
+        block = signed_block.message
+        self.process_slots(state, block.slot)
+        if validate_result:
+            assert self.verify_block_signature(state, signed_block)
+        self.process_block(state, block)
+        if validate_result:
+            assert block.state_root == hash_tree_root(state), "invalid post-state root"
+
+    def verify_block_signature(self, state, signed_block) -> bool:
+        proposer = state.validators[int(signed_block.message.proposer_index)]
+        signing_root = self.compute_signing_root(
+            signed_block.message, self.get_domain(state, self.DOMAIN_BEACON_PROPOSER)
+        )
+        return bls.Verify(proposer.pubkey, signing_root, signed_block.signature)
+
+    def process_slots(self, state, slot: int) -> None:
+        assert state.slot < slot
+        while state.slot < slot:
+            self.process_slot(state)
+            if (int(state.slot) + 1) % self.SLOTS_PER_EPOCH == 0:
+                self.process_epoch(state)
+            state.slot = int(state.slot) + 1
+
+    def process_slot(self, state) -> None:
+        previous_state_root = hash_tree_root(state)
+        state.state_roots[int(state.slot) % self.SLOTS_PER_HISTORICAL_ROOT] = previous_state_root
+        if state.latest_block_header.state_root == Bytes32():
+            state.latest_block_header.state_root = previous_state_root
+        previous_block_root = hash_tree_root(state.latest_block_header)
+        state.block_roots[int(state.slot) % self.SLOTS_PER_HISTORICAL_ROOT] = previous_block_root
+
+    # -- epoch processing --------------------------------------------------
+
+    def process_epoch(self, state) -> None:
+        self.process_justification_and_finalization(state)
+        self.process_rewards_and_penalties(state)
+        self.process_registry_updates(state)
+        self.process_slashings(state)
+        self.process_eth1_data_reset(state)
+        self.process_effective_balance_updates(state)
+        self.process_slashings_reset(state)
+        self.process_randao_mixes_reset(state)
+        self.process_historical_roots_update(state)
+        self.process_participation_record_updates(state)
+
+    def get_matching_source_attestations(self, state, epoch: int):
+        assert epoch in (self.get_previous_epoch(state), self.get_current_epoch(state))
+        return (
+            state.current_epoch_attestations
+            if epoch == self.get_current_epoch(state)
+            else state.previous_epoch_attestations
+        )
+
+    def get_matching_target_attestations(self, state, epoch: int):
+        return [
+            a
+            for a in self.get_matching_source_attestations(state, epoch)
+            if a.data.target.root == self.get_block_root(state, epoch)
+        ]
+
+    def get_matching_head_attestations(self, state, epoch: int):
+        return [
+            a
+            for a in self.get_matching_target_attestations(state, epoch)
+            if a.data.beacon_block_root == self.get_block_root_at_slot(state, a.data.slot)
+        ]
+
+    def get_unslashed_attesting_indices(self, state, attestations):
+        output = set()
+        for a in attestations:
+            output |= self.get_attesting_indices_from_data(state, a.data, a.aggregation_bits)
+        return {i for i in output if not state.validators[i].slashed}
+
+    def get_attesting_indices_from_data(self, state, data, bits):
+        committee = self.get_beacon_committee(state, data.slot, data.index)
+        return {int(committee[i]) for i, bit in enumerate(bits) if bit}
+
+    def get_attesting_balance(self, state, attestations) -> int:
+        return self.get_total_balance(state, self.get_unslashed_attesting_indices(state, attestations))
+
+    def process_justification_and_finalization(self, state) -> None:
+        # skip the first two epochs (no complete previous epoch to account)
+        if self.get_current_epoch(state) <= self.GENESIS_EPOCH + 1:
+            return
+        previous_attestations = self.get_matching_target_attestations(
+            state, self.get_previous_epoch(state)
+        )
+        current_attestations = self.get_matching_target_attestations(
+            state, self.get_current_epoch(state)
+        )
+        total_active_balance = self.get_total_active_balance(state)
+        previous_target_balance = self.get_attesting_balance(state, previous_attestations)
+        current_target_balance = self.get_attesting_balance(state, current_attestations)
+        self.weigh_justification_and_finalization(
+            state, total_active_balance, previous_target_balance, current_target_balance
+        )
+
+    def weigh_justification_and_finalization(
+        self, state, total_active_balance, previous_epoch_target_balance, current_epoch_target_balance
+    ) -> None:
+        previous_epoch = self.get_previous_epoch(state)
+        current_epoch = self.get_current_epoch(state)
+        old_previous_justified = state.previous_justified_checkpoint
+        old_current_justified = state.current_justified_checkpoint
+
+        state.previous_justified_checkpoint = state.current_justified_checkpoint
+        bits = list(state.justification_bits)
+        bits = [False] + bits[: self.JUSTIFICATION_BITS_LENGTH - 1]
+        if previous_epoch_target_balance * 3 >= total_active_balance * 2:
+            state.current_justified_checkpoint = self.Checkpoint(
+                epoch=previous_epoch, root=self.get_block_root(state, previous_epoch)
+            )
+            bits[1] = True
+        if current_epoch_target_balance * 3 >= total_active_balance * 2:
+            state.current_justified_checkpoint = self.Checkpoint(
+                epoch=current_epoch, root=self.get_block_root(state, current_epoch)
+            )
+            bits[0] = True
+        state.justification_bits = self.BeaconState.fields()["justification_bits"](bits)
+
+        # finalization: 2nd/3rd/4th-most-recent epochs justified chains
+        if all(bits[1:4]) and int(old_previous_justified.epoch) + 3 == current_epoch:
+            state.finalized_checkpoint = old_previous_justified
+        if all(bits[1:3]) and int(old_previous_justified.epoch) + 2 == current_epoch:
+            state.finalized_checkpoint = old_previous_justified
+        if all(bits[0:3]) and int(old_current_justified.epoch) + 2 == current_epoch:
+            state.finalized_checkpoint = old_current_justified
+        if all(bits[0:2]) and int(old_current_justified.epoch) + 1 == current_epoch:
+            state.finalized_checkpoint = old_current_justified
+
+    def get_base_reward(self, state, index: int) -> int:
+        total_balance = self.get_total_active_balance(state)
+        effective_balance = int(state.validators[int(index)].effective_balance)
+        return (
+            effective_balance
+            * self.BASE_REWARD_FACTOR
+            // self.integer_squareroot(total_balance)
+            // self.BASE_REWARDS_PER_EPOCH
+        )
+
+    def get_proposer_reward(self, state, attesting_index: int) -> int:
+        return self.get_base_reward(state, attesting_index) // self.PROPOSER_REWARD_QUOTIENT
+
+    def get_finality_delay(self, state) -> int:
+        return self.get_previous_epoch(state) - int(state.finalized_checkpoint.epoch)
+
+    def is_in_inactivity_leak(self, state) -> bool:
+        return self.get_finality_delay(state) > self.MIN_EPOCHS_TO_INACTIVITY_PENALTY
+
+    def get_eligible_validator_indices(self, state):
+        previous_epoch = self.get_previous_epoch(state)
+        return [
+            i
+            for i, v in enumerate(state.validators)
+            if self.is_active_validator(v, previous_epoch)
+            or (v.slashed and previous_epoch + 1 < v.withdrawable_epoch)
+        ]
+
+    def get_attestation_component_deltas(self, state, attestations):
+        rewards = [0] * len(state.validators)
+        penalties = [0] * len(state.validators)
+        total_balance = self.get_total_active_balance(state)
+        unslashed_attesting_indices = self.get_unslashed_attesting_indices(state, attestations)
+        attesting_balance = self.get_total_balance(state, unslashed_attesting_indices)
+        for index in self.get_eligible_validator_indices(state):
+            if index in unslashed_attesting_indices:
+                increment = self.EFFECTIVE_BALANCE_INCREMENT
+                if self.is_in_inactivity_leak(state):
+                    # optimal-participation credit during leaks
+                    rewards[index] += self.get_base_reward(state, index)
+                else:
+                    reward_numerator = self.get_base_reward(state, index) * (
+                        attesting_balance // increment
+                    )
+                    rewards[index] += reward_numerator // (total_balance // increment)
+            else:
+                penalties[index] += self.get_base_reward(state, index)
+        return rewards, penalties
+
+    def get_source_deltas(self, state):
+        return self.get_attestation_component_deltas(
+            state, self.get_matching_source_attestations(state, self.get_previous_epoch(state))
+        )
+
+    def get_target_deltas(self, state):
+        return self.get_attestation_component_deltas(
+            state, self.get_matching_target_attestations(state, self.get_previous_epoch(state))
+        )
+
+    def get_head_deltas(self, state):
+        return self.get_attestation_component_deltas(
+            state, self.get_matching_head_attestations(state, self.get_previous_epoch(state))
+        )
+
+    def get_inclusion_delay_deltas(self, state):
+        rewards = [0] * len(state.validators)
+        matching_source = self.get_matching_source_attestations(
+            state, self.get_previous_epoch(state)
+        )
+        for index in self.get_unslashed_attesting_indices(state, matching_source):
+            attestation = min(
+                (
+                    a
+                    for a in matching_source
+                    if index in self.get_attesting_indices_from_data(state, a.data, a.aggregation_bits)
+                ),
+                key=lambda a: int(a.inclusion_delay),
+            )
+            rewards[int(attestation.proposer_index)] += self.get_proposer_reward(state, index)
+            max_attester_reward = self.get_base_reward(state, index) - self.get_proposer_reward(
+                state, index
+            )
+            rewards[index] += max_attester_reward // int(attestation.inclusion_delay)
+        return rewards, [0] * len(state.validators)
+
+    def get_inactivity_penalty_deltas(self, state):
+        penalties = [0] * len(state.validators)
+        if self.is_in_inactivity_leak(state):
+            matching_target_attesting_indices = self.get_unslashed_attesting_indices(
+                state, self.get_matching_target_attestations(state, self.get_previous_epoch(state))
+            )
+            for index in self.get_eligible_validator_indices(state):
+                base_reward = self.get_base_reward(state, index)
+                penalties[index] += (
+                    self.BASE_REWARDS_PER_EPOCH * base_reward
+                    - self.get_proposer_reward(state, index)
+                )
+                if index not in matching_target_attesting_indices:
+                    effective_balance = int(state.validators[index].effective_balance)
+                    penalties[index] += (
+                        effective_balance
+                        * self.get_finality_delay(state)
+                        // self.INACTIVITY_PENALTY_QUOTIENT
+                    )
+        return [0] * len(state.validators), penalties
+
+    def get_attestation_deltas(self, state):
+        source_rewards, source_penalties = self.get_source_deltas(state)
+        target_rewards, target_penalties = self.get_target_deltas(state)
+        head_rewards, head_penalties = self.get_head_deltas(state)
+        inclusion_rewards, _ = self.get_inclusion_delay_deltas(state)
+        _, inactivity_penalties = self.get_inactivity_penalty_deltas(state)
+        rewards = [
+            source_rewards[i] + target_rewards[i] + head_rewards[i] + inclusion_rewards[i]
+            for i in range(len(state.validators))
+        ]
+        penalties = [
+            source_penalties[i] + target_penalties[i] + head_penalties[i] + inactivity_penalties[i]
+            for i in range(len(state.validators))
+        ]
+        return rewards, penalties
+
+    def process_rewards_and_penalties(self, state) -> None:
+        if self.get_current_epoch(state) == self.GENESIS_EPOCH:
+            return
+        rewards, penalties = self.get_attestation_deltas(state)
+        for index in range(len(state.validators)):
+            self.increase_balance(state, index, rewards[index])
+            self.decrease_balance(state, index, penalties[index])
+
+    def process_registry_updates(self, state) -> None:
+        current_epoch = self.get_current_epoch(state)
+        for index, validator in enumerate(state.validators):
+            if self.is_eligible_for_activation_queue(validator):
+                validator.activation_eligibility_epoch = current_epoch + 1
+            if (
+                self.is_active_validator(validator, current_epoch)
+                and validator.effective_balance <= self.config.EJECTION_BALANCE
+            ):
+                self.initiate_validator_exit(state, index)
+        activation_queue = sorted(
+            [
+                index
+                for index, validator in enumerate(state.validators)
+                if self.is_eligible_for_activation(state, validator)
+            ],
+            key=lambda index: (int(state.validators[index].activation_eligibility_epoch), index),
+        )
+        for index in activation_queue[: self.get_validator_churn_limit(state)]:
+            state.validators[index].activation_epoch = self.compute_activation_exit_epoch(
+                current_epoch
+            )
+
+    def process_slashings(self, state) -> None:
+        epoch = self.get_current_epoch(state)
+        total_balance = self.get_total_active_balance(state)
+        adjusted_total_slashing_balance = min(
+            sum(int(s) for s in state.slashings) * self.PROPORTIONAL_SLASHING_MULTIPLIER,
+            total_balance,
+        )
+        for index, validator in enumerate(state.validators):
+            if (
+                validator.slashed
+                and epoch + self.EPOCHS_PER_SLASHINGS_VECTOR // 2 == validator.withdrawable_epoch
+            ):
+                increment = self.EFFECTIVE_BALANCE_INCREMENT
+                penalty_numerator = (
+                    int(validator.effective_balance) // increment * adjusted_total_slashing_balance
+                )
+                penalty = penalty_numerator // total_balance * increment
+                self.decrease_balance(state, index, penalty)
+
+    def process_eth1_data_reset(self, state) -> None:
+        next_epoch = self.get_current_epoch(state) + 1
+        if next_epoch % self.EPOCHS_PER_ETH1_VOTING_PERIOD == 0:
+            state.eth1_data_votes = self.BeaconState.fields()["eth1_data_votes"]()
+
+    def process_effective_balance_updates(self, state) -> None:
+        hysteresis_increment = self.EFFECTIVE_BALANCE_INCREMENT // self.HYSTERESIS_QUOTIENT
+        downward_threshold = hysteresis_increment * self.HYSTERESIS_DOWNWARD_MULTIPLIER
+        upward_threshold = hysteresis_increment * self.HYSTERESIS_UPWARD_MULTIPLIER
+        for index, validator in enumerate(state.validators):
+            balance = int(state.balances[index])
+            if (
+                balance + downward_threshold < validator.effective_balance
+                or int(validator.effective_balance) + upward_threshold < balance
+            ):
+                validator.effective_balance = min(
+                    balance - balance % self.EFFECTIVE_BALANCE_INCREMENT,
+                    self.MAX_EFFECTIVE_BALANCE,
+                )
+
+    def process_slashings_reset(self, state) -> None:
+        next_epoch = self.get_current_epoch(state) + 1
+        state.slashings[next_epoch % self.EPOCHS_PER_SLASHINGS_VECTOR] = 0
+
+    def process_randao_mixes_reset(self, state) -> None:
+        current_epoch = self.get_current_epoch(state)
+        next_epoch = current_epoch + 1
+        state.randao_mixes[next_epoch % self.EPOCHS_PER_HISTORICAL_VECTOR] = self.get_randao_mix(
+            state, current_epoch
+        )
+
+    def process_historical_roots_update(self, state) -> None:
+        next_epoch = self.get_current_epoch(state) + 1
+        if next_epoch % (self.SLOTS_PER_HISTORICAL_ROOT // self.SLOTS_PER_EPOCH) == 0:
+            historical_batch = self.HistoricalBatch(
+                block_roots=state.block_roots, state_roots=state.state_roots
+            )
+            state.historical_roots.append(hash_tree_root(historical_batch))
+
+    def process_participation_record_updates(self, state) -> None:
+        state.previous_epoch_attestations = state.current_epoch_attestations
+        state.current_epoch_attestations = self.BeaconState.fields()["current_epoch_attestations"]()
+
+    # -- block processing --------------------------------------------------
+
+    def process_block(self, state, block) -> None:
+        self.process_block_header(state, block)
+        self.process_randao(state, block.body)
+        self.process_eth1_data(state, block.body)
+        self.process_operations(state, block.body)
+
+    def process_block_header(self, state, block) -> None:
+        assert block.slot == state.slot, "block slot must match state slot"
+        assert block.slot > state.latest_block_header.slot, "block must be newer than latest header"
+        assert block.proposer_index == self.get_beacon_proposer_index(state), "wrong proposer"
+        assert block.parent_root == hash_tree_root(state.latest_block_header), "parent mismatch"
+        state.latest_block_header = self.BeaconBlockHeader(
+            slot=block.slot,
+            proposer_index=block.proposer_index,
+            parent_root=block.parent_root,
+            state_root=Bytes32(),
+            body_root=hash_tree_root(block.body),
+        )
+        proposer = state.validators[int(block.proposer_index)]
+        assert not proposer.slashed, "proposer is slashed"
+
+    def process_randao(self, state, body) -> None:
+        epoch = self.get_current_epoch(state)
+        proposer = state.validators[self.get_beacon_proposer_index(state)]
+        signing_root = self.compute_signing_root(
+            uint64(epoch), self.get_domain(state, self.DOMAIN_RANDAO)
+        )
+        assert bls.Verify(proposer.pubkey, signing_root, body.randao_reveal), "bad randao reveal"
+        mix = self.xor(self.get_randao_mix(state, epoch), self.hash(body.randao_reveal))
+        state.randao_mixes[epoch % self.EPOCHS_PER_HISTORICAL_VECTOR] = mix
+
+    def process_eth1_data(self, state, body) -> None:
+        state.eth1_data_votes.append(body.eth1_data)
+        votes = [v for v in state.eth1_data_votes if v == body.eth1_data]
+        if len(votes) * 2 > self.EPOCHS_PER_ETH1_VOTING_PERIOD * self.SLOTS_PER_EPOCH:
+            state.eth1_data = body.eth1_data
+
+    def process_operations(self, state, body) -> None:
+        assert len(body.deposits) == min(
+            self.MAX_DEPOSITS,
+            int(state.eth1_data.deposit_count) - int(state.eth1_deposit_index),
+        ), "wrong deposit count in block"
+        for operation in body.proposer_slashings:
+            self.process_proposer_slashing(state, operation)
+        for operation in body.attester_slashings:
+            self.process_attester_slashing(state, operation)
+        for operation in body.attestations:
+            self.process_attestation(state, operation)
+        for operation in body.deposits:
+            self.process_deposit(state, operation)
+        for operation in body.voluntary_exits:
+            self.process_voluntary_exit(state, operation)
+
+    def process_proposer_slashing(self, state, proposer_slashing) -> None:
+        header_1 = proposer_slashing.signed_header_1.message
+        header_2 = proposer_slashing.signed_header_2.message
+        assert header_1.slot == header_2.slot, "headers not for same slot"
+        assert header_1.proposer_index == header_2.proposer_index, "headers not by same proposer"
+        assert header_1 != header_2, "headers are identical"
+        proposer = state.validators[int(header_1.proposer_index)]
+        assert self.is_slashable_validator(proposer, self.get_current_epoch(state))
+        for signed_header in (proposer_slashing.signed_header_1, proposer_slashing.signed_header_2):
+            domain = self.get_domain(
+                state,
+                self.DOMAIN_BEACON_PROPOSER,
+                self.compute_epoch_at_slot(signed_header.message.slot),
+            )
+            signing_root = self.compute_signing_root(signed_header.message, domain)
+            assert bls.Verify(proposer.pubkey, signing_root, signed_header.signature), "bad header sig"
+        self.slash_validator(state, header_1.proposer_index)
+
+    def process_attester_slashing(self, state, attester_slashing) -> None:
+        attestation_1 = attester_slashing.attestation_1
+        attestation_2 = attester_slashing.attestation_2
+        assert self.is_slashable_attestation_data(attestation_1.data, attestation_2.data)
+        assert self.is_valid_indexed_attestation(state, attestation_1), "attestation_1 invalid"
+        assert self.is_valid_indexed_attestation(state, attestation_2), "attestation_2 invalid"
+        slashed_any = False
+        indices = set(int(i) for i in attestation_1.attesting_indices) & set(
+            int(i) for i in attestation_2.attesting_indices
+        )
+        for index in sorted(indices):
+            if self.is_slashable_validator(
+                state.validators[index], self.get_current_epoch(state)
+            ):
+                self.slash_validator(state, index)
+                slashed_any = True
+        assert slashed_any, "no validator slashed"
+
+    def process_attestation(self, state, attestation) -> None:
+        data = attestation.data
+        assert data.target.epoch in (
+            self.get_previous_epoch(state),
+            self.get_current_epoch(state),
+        ), "target epoch out of range"
+        assert data.target.epoch == self.compute_epoch_at_slot(data.slot), "target/slot mismatch"
+        assert (
+            int(data.slot) + self.MIN_ATTESTATION_INCLUSION_DELAY
+            <= state.slot
+            <= int(data.slot) + self.SLOTS_PER_EPOCH
+        ), "attestation outside inclusion window"
+        assert data.index < self.get_committee_count_per_slot(state, data.target.epoch)
+
+        committee = self.get_beacon_committee(state, data.slot, data.index)
+        assert len(attestation.aggregation_bits) == len(committee), "bitlist/committee length mismatch"
+
+        pending_attestation = self.PendingAttestation(
+            data=data,
+            aggregation_bits=attestation.aggregation_bits,
+            inclusion_delay=int(state.slot) - int(data.slot),
+            proposer_index=self.get_beacon_proposer_index(state),
+        )
+        if data.target.epoch == self.get_current_epoch(state):
+            assert data.source == state.current_justified_checkpoint, "wrong source checkpoint"
+            state.current_epoch_attestations.append(pending_attestation)
+        else:
+            assert data.source == state.previous_justified_checkpoint, "wrong source checkpoint"
+            state.previous_epoch_attestations.append(pending_attestation)
+
+        assert self.is_valid_indexed_attestation(
+            state, self.get_indexed_attestation(state, attestation)
+        ), "invalid aggregate signature"
+
+    def get_validator_from_deposit(self, pubkey, withdrawal_credentials, amount):
+        effective_balance = min(
+            int(amount) - int(amount) % self.EFFECTIVE_BALANCE_INCREMENT, self.MAX_EFFECTIVE_BALANCE
+        )
+        return self.Validator(
+            pubkey=pubkey,
+            withdrawal_credentials=withdrawal_credentials,
+            activation_eligibility_epoch=self.FAR_FUTURE_EPOCH,
+            activation_epoch=self.FAR_FUTURE_EPOCH,
+            exit_epoch=self.FAR_FUTURE_EPOCH,
+            withdrawable_epoch=self.FAR_FUTURE_EPOCH,
+            effective_balance=effective_balance,
+        )
+
+    def add_validator_to_registry(self, state, pubkey, withdrawal_credentials, amount) -> None:
+        state.validators.append(
+            self.get_validator_from_deposit(pubkey, withdrawal_credentials, amount)
+        )
+        state.balances.append(amount)
+
+    def apply_deposit(self, state, pubkey, withdrawal_credentials, amount, signature) -> None:
+        validator_pubkeys = [v.pubkey for v in state.validators]
+        if pubkey not in validator_pubkeys:
+            # new validator: the deposit signature (proof of possession) must
+            # verify under the deposit domain (no fork/state dependence)
+            deposit_message = self.DepositMessage(
+                pubkey=pubkey, withdrawal_credentials=withdrawal_credentials, amount=amount
+            )
+            domain = self.compute_domain(self.DOMAIN_DEPOSIT)
+            signing_root = self.compute_signing_root(deposit_message, domain)
+            if not bls.Verify(pubkey, signing_root, signature):
+                return  # invalid proof-of-possession: deposit is ignored
+            self.add_validator_to_registry(state, pubkey, withdrawal_credentials, amount)
+        else:
+            index = validator_pubkeys.index(pubkey)
+            self.increase_balance(state, index, amount)
+
+    def process_deposit(self, state, deposit) -> None:
+        assert self.is_valid_merkle_branch(
+            leaf=hash_tree_root(deposit.data),
+            branch=deposit.proof,
+            depth=self.DEPOSIT_CONTRACT_TREE_DEPTH + 1,  # +1 for the mixed-in list length
+            index=int(state.eth1_deposit_index),
+            root=state.eth1_data.deposit_root,
+        ), "invalid deposit proof"
+        state.eth1_deposit_index = int(state.eth1_deposit_index) + 1
+        self.apply_deposit(
+            state,
+            pubkey=deposit.data.pubkey,
+            withdrawal_credentials=deposit.data.withdrawal_credentials,
+            amount=deposit.data.amount,
+            signature=deposit.data.signature,
+        )
+
+    def process_voluntary_exit(self, state, signed_voluntary_exit) -> None:
+        voluntary_exit = signed_voluntary_exit.message
+        validator = state.validators[int(voluntary_exit.validator_index)]
+        assert self.is_active_validator(validator, self.get_current_epoch(state)), "not active"
+        assert validator.exit_epoch == self.FAR_FUTURE_EPOCH, "already exiting"
+        assert self.get_current_epoch(state) >= voluntary_exit.epoch, "exit not yet valid"
+        assert (
+            self.get_current_epoch(state)
+            >= int(validator.activation_epoch) + self.config.SHARD_COMMITTEE_PERIOD
+        ), "validator too young to exit"
+        domain = self.get_domain(state, self.DOMAIN_VOLUNTARY_EXIT, voluntary_exit.epoch)
+        signing_root = self.compute_signing_root(voluntary_exit, domain)
+        assert bls.Verify(validator.pubkey, signing_root, signed_voluntary_exit.signature)
+        self.initiate_validator_exit(state, voluntary_exit.validator_index)
+
+    # == fork choice (specs/phase0/fork-choice.md) =========================
+
+    @dataclass
+    class LatestMessage:
+        epoch: int
+        root: Bytes32
+
+    @dataclass
+    class Store:
+        time: int
+        genesis_time: int
+        justified_checkpoint: object
+        finalized_checkpoint: object
+        unrealized_justified_checkpoint: object
+        unrealized_finalized_checkpoint: object
+        proposer_boost_root: Bytes32
+        equivocating_indices: set = field(default_factory=set)
+        blocks: dict = field(default_factory=dict)
+        block_states: dict = field(default_factory=dict)
+        block_timeliness: dict = field(default_factory=dict)
+        checkpoint_states: dict = field(default_factory=dict)
+        latest_messages: dict = field(default_factory=dict)
+        unrealized_justifications: dict = field(default_factory=dict)
+
+    INTERVALS_PER_SLOT = 3
+    PROPOSER_SCORE_BOOST = 40
+
+    def get_forkchoice_store(self, anchor_state, anchor_block):
+        assert anchor_block.state_root == hash_tree_root(anchor_state)
+        anchor_root = hash_tree_root(anchor_block)
+        anchor_epoch = self.get_current_epoch(anchor_state)
+        justified_checkpoint = self.Checkpoint(epoch=anchor_epoch, root=anchor_root)
+        finalized_checkpoint = self.Checkpoint(epoch=anchor_epoch, root=anchor_root)
+        return self.Store(
+            time=int(anchor_state.genesis_time)
+            + self.config.SECONDS_PER_SLOT * int(anchor_state.slot),
+            genesis_time=int(anchor_state.genesis_time),
+            justified_checkpoint=justified_checkpoint,
+            finalized_checkpoint=finalized_checkpoint,
+            unrealized_justified_checkpoint=justified_checkpoint,
+            unrealized_finalized_checkpoint=finalized_checkpoint,
+            proposer_boost_root=Root(),
+            blocks={anchor_root: anchor_block.copy()},
+            block_states={anchor_root: anchor_state.copy()},
+            checkpoint_states={justified_checkpoint: anchor_state.copy()},
+            unrealized_justifications={anchor_root: justified_checkpoint},
+        )
+
+    def get_slots_since_genesis(self, store) -> int:
+        return (store.time - store.genesis_time) // self.config.SECONDS_PER_SLOT
+
+    def get_current_slot(self, store) -> int:
+        return self.GENESIS_SLOT + self.get_slots_since_genesis(store)
+
+    def get_current_store_epoch(self, store) -> int:
+        return self.compute_epoch_at_slot(self.get_current_slot(store))
+
+    def compute_slots_since_epoch_start(self, slot: int) -> int:
+        return int(slot) - self.compute_start_slot_at_epoch(self.compute_epoch_at_slot(slot))
+
+    def get_ancestor(self, store, root, slot: int):
+        block = store.blocks[root]
+        if block.slot > slot:
+            return self.get_ancestor(store, block.parent_root, slot)
+        return root
+
+    def get_checkpoint_block(self, store, root, epoch: int):
+        return self.get_ancestor(store, root, self.compute_start_slot_at_epoch(epoch))
+
+    def get_weight(self, store, root) -> int:
+        state = store.checkpoint_states[store.justified_checkpoint]
+        epoch = self.get_current_store_epoch(store)
+        unslashed_and_active_indices = [
+            i
+            for i in self.get_active_validator_indices(state, epoch)
+            if not state.validators[i].slashed
+        ]
+        attestation_score = sum(
+            int(state.validators[i].effective_balance)
+            for i in unslashed_and_active_indices
+            if (
+                i in store.latest_messages
+                and i not in store.equivocating_indices
+                and self.get_ancestor(
+                    store, store.latest_messages[i].root, store.blocks[root].slot
+                )
+                == root
+            )
+        )
+        if store.proposer_boost_root == Root():
+            return attestation_score
+        proposer_score = 0
+        if self.get_ancestor(store, store.proposer_boost_root, store.blocks[root].slot) == root:
+            committee_weight = self.get_total_active_balance(state) // self.SLOTS_PER_EPOCH
+            proposer_score = (committee_weight * self.config.PROPOSER_SCORE_BOOST) // 100
+        return attestation_score + proposer_score
+
+    def get_voting_source(self, store, block_root):
+        block = store.blocks[block_root]
+        current_epoch = self.get_current_store_epoch(store)
+        block_epoch = self.compute_epoch_at_slot(block.slot)
+        if current_epoch > block_epoch:
+            return store.unrealized_justifications[block_root]
+        head_state = store.block_states[block_root]
+        return head_state.current_justified_checkpoint
+
+    def filter_block_tree(self, store, block_root, blocks: dict) -> bool:
+        block = store.blocks[block_root]
+        children = [root for root in store.blocks if store.blocks[root].parent_root == block_root]
+        if any(children):
+            filter_results = [self.filter_block_tree(store, child, blocks) for child in children]
+            if any(filter_results):
+                blocks[block_root] = block
+                return True
+            return False
+        current_epoch = self.get_current_store_epoch(store)
+        voting_source = self.get_voting_source(store, block_root)
+        correct_justified = (
+            store.justified_checkpoint.epoch == self.GENESIS_EPOCH
+            or voting_source.epoch == store.justified_checkpoint.epoch
+            or int(voting_source.epoch) + 2 >= current_epoch
+        )
+        finalized_checkpoint_block = self.get_checkpoint_block(
+            store, block_root, store.finalized_checkpoint.epoch
+        )
+        correct_finalized = (
+            store.finalized_checkpoint.epoch == self.GENESIS_EPOCH
+            or store.finalized_checkpoint.root == finalized_checkpoint_block
+        )
+        if correct_justified and correct_finalized:
+            blocks[block_root] = block
+            return True
+        return False
+
+    def get_filtered_block_tree(self, store) -> dict:
+        base = store.justified_checkpoint.root
+        blocks: dict = {}
+        self.filter_block_tree(store, base, blocks)
+        return blocks
+
+    def get_head(self, store):
+        blocks = self.get_filtered_block_tree(store)
+        head = store.justified_checkpoint.root
+        while True:
+            children = [root for root in blocks if blocks[root].parent_root == head]
+            if len(children) == 0:
+                return head
+            head = max(children, key=lambda root: (self.get_weight(store, root), bytes(root)))
+
+    def update_checkpoints(self, store, justified_checkpoint, finalized_checkpoint) -> None:
+        if justified_checkpoint.epoch > store.justified_checkpoint.epoch:
+            store.justified_checkpoint = justified_checkpoint
+        if finalized_checkpoint.epoch > store.finalized_checkpoint.epoch:
+            store.finalized_checkpoint = finalized_checkpoint
+
+    def update_unrealized_checkpoints(
+        self, store, unrealized_justified_checkpoint, unrealized_finalized_checkpoint
+    ) -> None:
+        if unrealized_justified_checkpoint.epoch > store.unrealized_justified_checkpoint.epoch:
+            store.unrealized_justified_checkpoint = unrealized_justified_checkpoint
+        if unrealized_finalized_checkpoint.epoch > store.unrealized_finalized_checkpoint.epoch:
+            store.unrealized_finalized_checkpoint = unrealized_finalized_checkpoint
+
+    def compute_pulled_up_tip(self, store, block_root) -> None:
+        state = store.block_states[block_root].copy()
+        self.process_justification_and_finalization(state)
+        store.unrealized_justifications[block_root] = state.current_justified_checkpoint
+        self.update_unrealized_checkpoints(
+            store, state.current_justified_checkpoint, state.finalized_checkpoint
+        )
+        block_epoch = self.compute_epoch_at_slot(store.blocks[block_root].slot)
+        current_epoch = self.get_current_store_epoch(store)
+        if block_epoch < current_epoch:
+            # blocks from prior epochs count as fully realized immediately
+            self.update_checkpoints(
+                store, state.current_justified_checkpoint, state.finalized_checkpoint
+            )
+
+    def on_tick(self, store, time: int) -> None:
+        while (
+            store.time < time
+            and self.get_slots_since_genesis(store)
+            < (time - store.genesis_time) // self.config.SECONDS_PER_SLOT
+        ):
+            previous_time = (
+                store.genesis_time
+                + (self.get_slots_since_genesis(store) + 1) * self.config.SECONDS_PER_SLOT
+            )
+            self.on_tick_per_slot(store, previous_time)
+        self.on_tick_per_slot(store, time)
+
+    def on_tick_per_slot(self, store, time: int) -> None:
+        previous_slot = self.get_current_slot(store)
+        store.time = time
+        current_slot = self.get_current_slot(store)
+        if current_slot > previous_slot:
+            store.proposer_boost_root = Root()
+            if self.compute_slots_since_epoch_start(current_slot) == 0:
+                self.update_checkpoints(
+                    store,
+                    store.unrealized_justified_checkpoint,
+                    store.unrealized_finalized_checkpoint,
+                )
+
+    def is_before_attesting_interval(self, store) -> bool:
+        time_into_slot = (store.time - store.genesis_time) % self.config.SECONDS_PER_SLOT
+        return time_into_slot < self.config.SECONDS_PER_SLOT // self.INTERVALS_PER_SLOT
+
+    def on_block(self, store, signed_block) -> None:
+        block = signed_block.message
+        assert block.parent_root in store.block_states, "unknown parent"
+        state = store.block_states[block.parent_root].copy()
+        assert self.get_current_slot(store) >= block.slot, "block from the future"
+
+        finalized_slot = self.compute_start_slot_at_epoch(store.finalized_checkpoint.epoch)
+        assert block.slot > finalized_slot, "block not after finalized slot"
+        assert (
+            self.get_checkpoint_block(store, block.parent_root, store.finalized_checkpoint.epoch)
+            == store.finalized_checkpoint.root
+        ), "block does not descend from finalized root"
+
+        self.state_transition(state, signed_block, True)
+
+        block_root = hash_tree_root(block)
+        store.blocks[block_root] = block.copy()
+        store.block_states[block_root] = state
+
+        # proposer boost for timely first-seen blocks
+        time_into_slot = (store.time - store.genesis_time) % self.config.SECONDS_PER_SLOT
+        is_before_attesting_interval = (
+            time_into_slot < self.config.SECONDS_PER_SLOT // self.INTERVALS_PER_SLOT
+        )
+        is_timely = self.get_current_slot(store) == block.slot and is_before_attesting_interval
+        store.block_timeliness[block_root] = is_timely
+        is_first_block = store.proposer_boost_root == Root()
+        if is_timely and is_first_block:
+            store.proposer_boost_root = block_root
+
+        self.update_checkpoints(
+            store, state.current_justified_checkpoint, state.finalized_checkpoint
+        )
+        self.compute_pulled_up_tip(store, block_root)
+
+    def validate_target_epoch_against_current_time(self, store, attestation) -> None:
+        target = attestation.data.target
+        current_epoch = self.get_current_store_epoch(store)
+        previous_epoch = max(current_epoch - 1, self.GENESIS_EPOCH)
+        assert target.epoch in (current_epoch, previous_epoch), "target epoch not current/previous"
+
+    def validate_on_attestation(self, store, attestation, is_from_block: bool) -> None:
+        target = attestation.data.target
+        if not is_from_block:
+            self.validate_target_epoch_against_current_time(store, attestation)
+        assert target.epoch == self.compute_epoch_at_slot(attestation.data.slot)
+        assert target.root in store.blocks, "unknown target root"
+        assert attestation.data.beacon_block_root in store.blocks, "unknown head root"
+        assert (
+            store.blocks[attestation.data.beacon_block_root].slot <= attestation.data.slot
+        ), "attestation head newer than attestation slot"
+        assert (
+            target.root
+            == self.get_checkpoint_block(store, attestation.data.beacon_block_root, target.epoch)
+        ), "target does not match head chain"
+        assert self.get_current_slot(store) >= int(attestation.data.slot) + 1, "attestation too new"
+
+    def store_target_checkpoint_state(self, store, target) -> None:
+        if target not in store.checkpoint_states:
+            base_state = store.block_states[target.root].copy()
+            target_slot = self.compute_start_slot_at_epoch(target.epoch)
+            if base_state.slot < target_slot:
+                self.process_slots(base_state, target_slot)
+            store.checkpoint_states[target] = base_state
+
+    def update_latest_messages(self, store, attesting_indices, attestation) -> None:
+        target = attestation.data.target
+        beacon_block_root = attestation.data.beacon_block_root
+        non_equivocating = [i for i in attesting_indices if i not in store.equivocating_indices]
+        for i in non_equivocating:
+            if (
+                i not in store.latest_messages
+                or target.epoch > store.latest_messages[i].epoch
+            ):
+                store.latest_messages[i] = self.LatestMessage(
+                    epoch=int(target.epoch), root=beacon_block_root
+                )
+
+    def on_attestation(self, store, attestation, is_from_block: bool = False) -> None:
+        self.validate_on_attestation(store, attestation, is_from_block)
+        self.store_target_checkpoint_state(store, attestation.data.target)
+        target_state = store.checkpoint_states[attestation.data.target]
+        indexed_attestation = self.get_indexed_attestation(target_state, attestation)
+        assert self.is_valid_indexed_attestation(target_state, indexed_attestation)
+        self.update_latest_messages(store, indexed_attestation.attesting_indices, attestation)
+
+    def on_attester_slashing(self, store, attester_slashing) -> None:
+        attestation_1 = attester_slashing.attestation_1
+        attestation_2 = attester_slashing.attestation_2
+        assert self.is_slashable_attestation_data(attestation_1.data, attestation_2.data)
+        state = store.block_states[store.justified_checkpoint.root]
+        assert self.is_valid_indexed_attestation(state, attestation_1)
+        assert self.is_valid_indexed_attestation(state, attestation_2)
+        indices = set(int(i) for i in attestation_1.attesting_indices) & set(
+            int(i) for i in attestation_2.attesting_indices
+        )
+        store.equivocating_indices.update(indices)
+
+    # == honest validator (specs/phase0/validator.md) ======================
+
+    def get_committee_assignment(self, state, epoch: int, validator_index: int):
+        next_epoch = self.get_current_epoch(state) + 1
+        assert epoch <= next_epoch
+        start_slot = self.compute_start_slot_at_epoch(epoch)
+        committee_count_per_slot = self.get_committee_count_per_slot(state, epoch)
+        for slot in range(start_slot, start_slot + self.SLOTS_PER_EPOCH):
+            for index in range(committee_count_per_slot):
+                committee = self.get_beacon_committee(state, slot, index)
+                if int(validator_index) in [int(c) for c in committee]:
+                    return committee, index, slot
+        return None
+
+    def is_proposer(self, state, validator_index: int) -> bool:
+        return self.get_beacon_proposer_index(state) == int(validator_index)
+
+    def get_epoch_signature(self, state, block, privkey: int) -> BLSSignature:
+        domain = self.get_domain(
+            state, self.DOMAIN_RANDAO, self.compute_epoch_at_slot(block.slot)
+        )
+        signing_root = self.compute_signing_root(
+            uint64(self.compute_epoch_at_slot(block.slot)), domain
+        )
+        return BLSSignature(bls.Sign(privkey, signing_root))
+
+    def compute_new_state_root(self, state, block) -> Root:
+        temp_state = state.copy()
+        signed_block = self.SignedBeaconBlock(message=block)
+        self.state_transition(temp_state, signed_block, validate_result=False)
+        return hash_tree_root(temp_state)
+
+    def get_block_signature(self, state, block, privkey: int) -> BLSSignature:
+        domain = self.get_domain(
+            state, self.DOMAIN_BEACON_PROPOSER, self.compute_epoch_at_slot(block.slot)
+        )
+        return BLSSignature(bls.Sign(privkey, self.compute_signing_root(block, domain)))
+
+    def get_attestation_signature(self, state, attestation_data, privkey: int) -> BLSSignature:
+        domain = self.get_domain(
+            state, self.DOMAIN_BEACON_ATTESTER, attestation_data.target.epoch
+        )
+        return BLSSignature(bls.Sign(privkey, self.compute_signing_root(attestation_data, domain)))
+
+    def get_slot_signature(self, state, slot: int, privkey: int) -> BLSSignature:
+        domain = self.get_domain(
+            state, self.DOMAIN_SELECTION_PROOF, self.compute_epoch_at_slot(slot)
+        )
+        return BLSSignature(bls.Sign(privkey, self.compute_signing_root(uint64(slot), domain)))
+
+    def is_aggregator(self, state, slot: int, index: int, slot_signature) -> bool:
+        committee = self.get_beacon_committee(state, slot, index)
+        modulo = max(1, len(committee) // self.TARGET_AGGREGATORS_PER_COMMITTEE)
+        return self.bytes_to_uint64(self.hash(slot_signature)[:8]) % modulo == 0
+
+    def get_aggregate_signature(self, attestations) -> BLSSignature:
+        return BLSSignature(bls.Aggregate([a.signature for a in attestations]))
+
+    def get_aggregate_and_proof(self, state, aggregator_index, aggregate, privkey: int):
+        return self.AggregateAndProof(
+            aggregator_index=aggregator_index,
+            aggregate=aggregate,
+            selection_proof=self.get_slot_signature(state, aggregate.data.slot, privkey),
+        )
+
+    def get_aggregate_and_proof_signature(self, state, aggregate_and_proof, privkey: int):
+        aggregate = aggregate_and_proof.aggregate
+        domain = self.get_domain(
+            state,
+            self.DOMAIN_AGGREGATE_AND_PROOF,
+            self.compute_epoch_at_slot(aggregate.data.slot),
+        )
+        return BLSSignature(
+            bls.Sign(privkey, self.compute_signing_root(aggregate_and_proof, domain))
+        )
+
+    def get_eth1_vote(self, state, eth1_chain):
+        # period votes tally; fall back to the current eth1_data
+        period_start = (
+            self.compute_start_slot_at_epoch(self.get_current_epoch(state))
+            // self.SLOTS_PER_EPOCH
+        )
+        votes = list(state.eth1_data_votes)
+        if not votes:
+            return state.eth1_data
+        counts = {}
+        for v in votes:
+            counts[hash_tree_root(v)] = counts.get(hash_tree_root(v), 0) + 1
+        best = max(votes, key=lambda v: (counts[hash_tree_root(v)], -votes.index(v)))
+        return best
+
+    def get_randao_reveal(self, state, slot: int, privkey: int) -> BLSSignature:
+        temp_state = state.copy()
+        if temp_state.slot < slot:
+            self.process_slots(temp_state, slot)
+        return self.get_epoch_signature(
+            temp_state, self.BeaconBlock(slot=slot), privkey
+        )
+
+    # == weak subjectivity (specs/phase0/weak-subjectivity.md) =============
+
+    def compute_weak_subjectivity_period(self, state) -> int:
+        ws_period = self.config.MIN_VALIDATOR_WITHDRAWABILITY_DELAY
+        N = len(self.get_active_validator_indices(state, self.get_current_epoch(state)))
+        t = self.get_total_active_balance(state) // N // self.ETH_TO_GWEI
+        T = self.MAX_EFFECTIVE_BALANCE // self.ETH_TO_GWEI
+        delta = self.get_validator_churn_limit(state)
+        Delta = self.MAX_DEPOSITS * self.SLOTS_PER_EPOCH
+        D = self.SAFETY_DECAY
+        if T * (200 + 3 * D) < t * (200 + 12 * D):
+            epochs_for_validator_set_churn = N * (t * (200 + 12 * D) - T * (200 + 3 * D)) // (
+                600 * delta * (2 * t + T)
+            )
+            epochs_for_balance_top_ups = N * (200 + 3 * D) // (600 * Delta)
+            ws_period += max(epochs_for_validator_set_churn, epochs_for_balance_top_ups)
+        else:
+            ws_period += 3 * N * D * t // (200 * Delta * (T - t))
+        return ws_period
+
+    ETH_TO_GWEI = 10**9
+
+    def is_within_weak_subjectivity_period(self, store, ws_state, ws_checkpoint) -> bool:
+        assert ws_state.latest_block_header.state_root == ws_checkpoint.root
+        assert self.compute_epoch_at_slot(ws_state.slot) == ws_checkpoint.epoch
+        ws_period = self.compute_weak_subjectivity_period(ws_state)
+        ws_state_epoch = self.compute_epoch_at_slot(ws_state.slot)
+        current_epoch = self.compute_epoch_at_slot(self.get_current_slot(store))
+        return current_epoch <= ws_state_epoch + ws_period

@@ -1,0 +1,55 @@
+"""Swap-or-not shuffle in whole-permutation form.
+
+The spec defines the shuffle per index: 90 hash-driven rounds deciding, for
+each position, whether it swaps with its mirror around a per-round pivot
+(reference: specs/phase0/beacon-chain.md:816-836; the reference then
+LRU-caches the per-index loop, pysetup/spec_builders/phase0.py:59-88).
+
+Inverted into whole-permutation form, each round is three vectorized steps
+over ALL indices at once:
+    flip  = (pivot - idx) mod n
+    pos   = max(idx, flip)
+    idx   = flip where bit(pos) else idx
+with the decision bits gathered from one 32-byte hash per 256 positions.
+That is a gather + select — exactly the shape the TPU kernel consumes. The
+numpy path below is the host implementation; identity with the per-index
+spec form is property-tested (tests/test_shuffle.py).
+"""
+
+from __future__ import annotations
+
+import hashlib
+
+import numpy as np
+
+
+def shuffle_permutation(index_count: int, seed: bytes, rounds: int) -> np.ndarray:
+    """perm[i] == compute_shuffled_index(i, index_count, seed) for all i."""
+    if index_count == 0:
+        return np.empty(0, dtype=np.int64)
+    n = index_count
+    idx = np.arange(n, dtype=np.int64)
+    num_chunks = (n + 255) // 256
+    sha = hashlib.sha256
+    for rnd in range(rounds):
+        rb = bytes([rnd])
+        pivot = int.from_bytes(sha(seed + rb).digest()[:8], "little") % n
+        # decision-bit sources: one hash per 256-position chunk
+        src = np.frombuffer(
+            b"".join(
+                sha(seed + rb + (c).to_bytes(4, "little")).digest() for c in range(num_chunks)
+            ),
+            dtype=np.uint8,
+        ).reshape(num_chunks, 32)
+        flip = (pivot - idx) % n
+        pos = np.maximum(idx, flip)
+        byte_vals = src[pos // 256, (pos % 256) // 8]
+        bits = (byte_vals >> (pos % 8).astype(np.uint8)) & 1
+        idx = np.where(bits == 1, flip, idx)
+    return idx
+
+
+def shuffle_list(items: list, seed: bytes, rounds: int) -> list:
+    """The shuffled sequence itself: out[i] = items[perm[i]]."""
+    perm = shuffle_permutation(len(items), seed, rounds)
+    return [items[int(p)] for p in perm]

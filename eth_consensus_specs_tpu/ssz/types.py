@@ -241,6 +241,16 @@ def _coerce_type(t: Any) -> type:
     raise TypeError(f"not an SSZ type: {t!r}")
 
 
+def _store_coerce(t: type, value: Any) -> "View":
+    """Coerce for STORAGE inside a composite: mutable values are copied so
+    the stored child never aliases the source (value semantics on store,
+    matching remerkleable's backing copies; reads still alias)."""
+    v = value if isinstance(value, t) else t.coerce_view(value)
+    if not t.is_immutable_subtree():
+        v = v.copy()
+    return v
+
+
 # ---------------------------------------------------------------------------
 # Byte vectors / byte lists
 # ---------------------------------------------------------------------------
@@ -576,7 +586,7 @@ class _Sequence(View):
             except TypeError:
                 pass
         et = self.ELEMENT_TYPE
-        self._items = [et.coerce_view(v) for v in args]
+        self._items = [_store_coerce(et, v) for v in args]
         self._check_init_length()
         self._root_cache: bytes | None = None
 
@@ -601,7 +611,7 @@ class _Sequence(View):
             raise TypeError("slice assignment is not supported; assign elements individually")
         if not -len(self._items) <= i < len(self._items):
             raise IndexError(f"index {i} out of range for length {len(self._items)}")
-        self._items[int(i)] = self.ELEMENT_TYPE.coerce_view(v)
+        self._items[int(i)] = _store_coerce(self.ELEMENT_TYPE, v)
         self._root_cache = None
 
     def __eq__(self, other):
@@ -692,6 +702,16 @@ class _Sequence(View):
             items.append(et.decode_bytes(data[offsets[i] : offsets[i + 1]]))
         return items
 
+    @classmethod
+    def _from_owned_items(cls, items: list):
+        """Wrap a list of already-coerced, exclusively-owned elements
+        (decode paths) without the copy-on-store pass."""
+        new = cls.__new__(cls)
+        new._items = items
+        new._root_cache = None
+        new._check_init_length()
+        return new
+
     def _element_chunks(self) -> np.ndarray:
         et = self.ELEMENT_TYPE
         if issubclass(et, BasicView):
@@ -750,7 +770,7 @@ class List(_Sequence):
     def append(self, v):
         if len(self._items) >= self.LIMIT:
             raise ValueError(f"{self.__class__.__name__}: append past limit {self.LIMIT}")
-        self._items.append(self.ELEMENT_TYPE.coerce_view(v))
+        self._items.append(_store_coerce(self.ELEMENT_TYPE, v))
         self._root_cache = None
 
     def pop(self, idx: int = -1):
@@ -761,7 +781,7 @@ class List(_Sequence):
 
     @classmethod
     def decode_bytes(cls, data: bytes):
-        return cls(cls._decode_elements(data, cls.LIMIT))
+        return cls._from_owned_items(cls._decode_elements(data, cls.LIMIT))
 
     def get_hash_tree_root(self) -> bytes:
         if self._root_cache is not None and self.ELEMENT_TYPE.is_immutable_subtree():
@@ -829,7 +849,9 @@ class Vector(_Sequence):
 
     @classmethod
     def decode_bytes(cls, data: bytes):
-        return cls(cls._decode_elements(data, cls.LENGTH, exact_count=cls.LENGTH))
+        return cls._from_owned_items(
+            cls._decode_elements(data, cls.LENGTH, exact_count=cls.LENGTH)
+        )
 
     def get_hash_tree_root(self) -> bytes:
         if self._root_cache is not None and self.ELEMENT_TYPE.is_immutable_subtree():
@@ -872,8 +894,7 @@ class Container(View):
         values = {}
         for name, t in zip(self._field_names, self._field_types):
             if name in kwargs:
-                v = kwargs.pop(name)
-                values[name] = t.coerce_view(v) if not isinstance(v, t) else v
+                values[name] = _store_coerce(t, kwargs.pop(name))
             else:
                 values[name] = t.default()
         if kwargs:
@@ -900,7 +921,7 @@ class Container(View):
         except ValueError:
             raise AttributeError(f"{self.__class__.__name__} has no field {name!r}") from None
         t = self._field_types[idx]
-        self._values[name] = t.coerce_view(value) if not isinstance(value, t) else value
+        self._values[name] = _store_coerce(t, value)
         object.__setattr__(self, "_root_cache", None)
 
     def __eq__(self, other):
@@ -1008,7 +1029,10 @@ class Container(View):
                 values[name] = t.decode_bytes(data[start:end])
         elif pos != len(data):
             raise DeserializationError(f"{cls.__name__}: {len(data) - pos} trailing bytes")
-        return cls(**values)
+        new = cls.__new__(cls)
+        object.__setattr__(new, "_root_cache", None)
+        object.__setattr__(new, "_values", values)
+        return new
 
     def get_hash_tree_root(self) -> bytes:
         if self._root_cache is not None and self._cacheable:
@@ -1046,7 +1070,7 @@ class Union(View):
                 raise ValueError("Union None option takes no value")
             self._value = None
         else:
-            self._value = t.coerce_view(value)
+            self._value = _store_coerce(t, value)
         self._selector = selector
 
     def __class_getitem__(cls, params) -> type:

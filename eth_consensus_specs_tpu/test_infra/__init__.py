@@ -1,0 +1,8 @@
+"""Conformance-test framework: decorator/fixture engine, dual-mode yield
+protocol, and scenario helpers.
+
+Behavioral model: the reference's eth2spec/test/context.py (decorator
+composition, state fixtures, BLS switches) + tests/infra/yield_generator.py
+(each test is simultaneously a pytest check and a reference-vector
+emitter). See test_infra/context.py for the composition rules.
+"""

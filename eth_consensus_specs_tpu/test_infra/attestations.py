@@ -1,0 +1,166 @@
+"""Attestation scenario helpers (reference analogue:
+test/helpers/attestations.py: get_valid_attestation :103,
+run_attestation_processing :21, next_epoch_with_attestations :329)."""
+
+from __future__ import annotations
+
+from eth_consensus_specs_tpu.ssz import Bitlist, hash_tree_root
+from eth_consensus_specs_tpu.utils import bls
+
+from .context import expect_assertion_error
+from .keys import privkeys
+from .state import latest_block_root, next_slot
+
+
+def build_attestation_data(spec, state, slot: int, index: int):
+    assert state.slot >= slot
+    if slot == state.slot:
+        block_root = latest_block_root(spec, state)
+    else:
+        block_root = spec.get_block_root_at_slot(state, slot)
+    current_epoch_start_slot = spec.compute_start_slot_at_epoch(spec.get_current_epoch(state))
+    if slot < current_epoch_start_slot:
+        epoch_boundary_root = spec.get_block_root(state, spec.get_previous_epoch(state))
+    elif slot == current_epoch_start_slot:
+        epoch_boundary_root = block_root
+    else:
+        epoch_boundary_root = spec.get_block_root(state, spec.get_current_epoch(state))
+    if slot < current_epoch_start_slot:
+        source_checkpoint = state.previous_justified_checkpoint
+    else:
+        source_checkpoint = state.current_justified_checkpoint
+    return spec.AttestationData(
+        slot=slot,
+        index=index,
+        beacon_block_root=block_root,
+        source=source_checkpoint,
+        target=spec.Checkpoint(
+            epoch=spec.compute_epoch_at_slot(slot), root=epoch_boundary_root
+        ),
+    )
+
+
+def get_attestation_signature(spec, state, attestation_data, privkey: int):
+    domain = spec.get_domain(state, spec.DOMAIN_BEACON_ATTESTER, attestation_data.target.epoch)
+    return bls.Sign(privkey, spec.compute_signing_root(attestation_data, domain))
+
+
+def sign_attestation(spec, state, attestation):
+    participants = spec.get_attesting_indices(state, attestation)
+    sigs = [
+        get_attestation_signature(spec, state, attestation.data, privkeys[int(i)])
+        for i in sorted(participants)
+    ]
+    attestation.signature = bls.Aggregate(sigs) if sigs else bls.STUB_SIGNATURE
+
+
+def get_valid_attestation(
+    spec, state, slot=None, index=None, filter_participant_set=None, signed: bool = False
+):
+    # bls-off default keeps construction fast (policy per context.py docs)
+    if slot is None:
+        slot = int(state.slot)
+    if index is None:
+        index = 0
+    data = build_attestation_data(spec, state, slot, index)
+    committee = spec.get_beacon_committee(state, slot, index)
+    participants = set(int(c) for c in committee)
+    if filter_participant_set is not None:
+        participants = filter_participant_set(participants)
+    bits_type = Bitlist[spec.MAX_VALIDATORS_PER_COMMITTEE]
+    bits = bits_type([int(c) in participants for c in committee])
+    attestation = spec.Attestation(aggregation_bits=bits, data=data)
+    if signed:
+        sign_attestation(spec, state, attestation)
+    return attestation
+
+
+def run_attestation_processing(spec, state, attestation, valid: bool = True):
+    """Dual-mode processing runner (reference: attestations.py:21-48)."""
+    yield "pre", state
+    yield "attestation", attestation
+    if not valid:
+        expect_assertion_error(lambda: spec.process_attestation(state, attestation))
+        yield "post", None
+        return
+    current_epoch_count = len(state.current_epoch_attestations)
+    previous_epoch_count = len(state.previous_epoch_attestations)
+    spec.process_attestation(state, attestation)
+    if attestation.data.target.epoch == spec.get_current_epoch(state):
+        assert len(state.current_epoch_attestations) == current_epoch_count + 1
+    else:
+        assert len(state.previous_epoch_attestations) == previous_epoch_count + 1
+    yield "post", state
+
+
+def add_attestations_to_state(spec, state, attestations, slot: int):
+    if state.slot < slot:
+        spec.process_slots(state, slot)
+    for attestation in attestations:
+        spec.process_attestation(state, attestation)
+
+
+def get_valid_attestations_at_slot(spec, state, slot: int, signed: bool = False):
+    """All committees' full attestations for `slot`."""
+    out = []
+    committees_per_slot = spec.get_committee_count_per_slot(
+        state, spec.compute_epoch_at_slot(slot)
+    )
+    for index in range(committees_per_slot):
+        out.append(get_valid_attestation(spec, state, slot, index, signed=signed))
+    return out
+
+
+def next_epoch_with_attestations(
+    spec, state, fill_cur_epoch: bool, fill_prev_epoch: bool, signed: bool = False
+):
+    """Advance one epoch, attaching full attestations per block (reference:
+    attestations.py:329-371). Returns (pre_state, signed_blocks, post_state)."""
+    from .block import build_empty_block_for_next_slot, state_transition_and_sign_block
+
+    assert state.slot % spec.SLOTS_PER_EPOCH == 0
+
+    pre_state = state.copy()
+    signed_blocks = []
+    for _ in range(spec.SLOTS_PER_EPOCH):
+        block = build_empty_block_for_next_slot(spec, state)
+        if fill_cur_epoch and int(state.slot) >= spec.MIN_ATTESTATION_INCLUSION_DELAY:
+            slot_to_attest = int(state.slot) - spec.MIN_ATTESTATION_INCLUSION_DELAY + 1
+            if slot_to_attest >= spec.compute_start_slot_at_epoch(spec.get_current_epoch(state)):
+                for attestation in get_valid_attestations_at_slot(
+                    spec, state, slot_to_attest, signed=signed
+                ):
+                    block.body.attestations.append(attestation)
+        if fill_prev_epoch and int(state.slot) >= spec.SLOTS_PER_EPOCH:
+            slot_to_attest = int(state.slot) - spec.SLOTS_PER_EPOCH + 1
+            for attestation in get_valid_attestations_at_slot(
+                spec, state, slot_to_attest, signed=signed
+            ):
+                block.body.attestations.append(attestation)
+        signed_block = state_transition_and_sign_block(spec, state, block)
+        signed_blocks.append(signed_block)
+    return pre_state, signed_blocks, state
+
+
+def state_transition_with_full_block(
+    spec, state, fill_cur_epoch: bool, fill_prev_epoch: bool, signed: bool = False
+):
+    """One block carrying as many valid attestations as available
+    (reference: attestations.py:344-380)."""
+    from .block import build_empty_block_for_next_slot, state_transition_and_sign_block
+
+    block = build_empty_block_for_next_slot(spec, state)
+    if fill_cur_epoch and int(state.slot) >= spec.MIN_ATTESTATION_INCLUSION_DELAY:
+        slot_to_attest = int(state.slot) - spec.MIN_ATTESTATION_INCLUSION_DELAY + 1
+        if slot_to_attest >= spec.compute_start_slot_at_epoch(spec.get_current_epoch(state)):
+            for attestation in get_valid_attestations_at_slot(
+                spec, state, slot_to_attest, signed=signed
+            ):
+                block.body.attestations.append(attestation)
+    if fill_prev_epoch and int(state.slot) >= spec.SLOTS_PER_EPOCH:
+        slot_to_attest = int(state.slot) - spec.SLOTS_PER_EPOCH + 1
+        for attestation in get_valid_attestations_at_slot(
+            spec, state, slot_to_attest, signed=signed
+        ):
+            block.body.attestations.append(attestation)
+    return state_transition_and_sign_block(spec, state, block)

@@ -1,0 +1,72 @@
+"""Block construction/signing helpers (reference analogue:
+test/helpers/block.py)."""
+
+from __future__ import annotations
+
+from eth_consensus_specs_tpu.ssz import hash_tree_root
+from eth_consensus_specs_tpu.utils import bls
+
+from .keys import privkeys
+from .state import latest_block_root
+
+
+def build_empty_block(spec, state, slot=None, proposer_index=None):
+    if slot is None:
+        slot = int(state.slot)
+    if slot < state.slot:
+        raise ValueError("cannot build a block for a past slot")
+    lookahead_state = state.copy()
+    if slot > lookahead_state.slot:
+        spec.process_slots(lookahead_state, slot)
+    if proposer_index is None:
+        proposer_index = spec.get_beacon_proposer_index(lookahead_state)
+    block = spec.BeaconBlock(
+        slot=slot,
+        proposer_index=proposer_index,
+        parent_root=latest_block_root(spec, lookahead_state),
+    )
+    block.body.eth1_data.deposit_count = state.eth1_deposit_index
+    block.body.randao_reveal = spec.get_epoch_signature(
+        lookahead_state, block, privkeys[int(proposer_index)]
+    )
+    return block
+
+
+def build_empty_block_for_next_slot(spec, state):
+    return build_empty_block(spec, state, int(state.slot) + 1)
+
+
+def sign_block(spec, state, block, proposer_index=None):
+    """Produce SignedBeaconBlock with the proposer's key over the block."""
+    if proposer_index is None:
+        proposer_index = int(block.proposer_index)
+    privkey = privkeys[proposer_index]
+    domain = spec.get_domain(
+        state, spec.DOMAIN_BEACON_PROPOSER, spec.compute_epoch_at_slot(block.slot)
+    )
+    signature = bls.Sign(privkey, spec.compute_signing_root(block, domain))
+    return spec.SignedBeaconBlock(message=block, signature=signature)
+
+
+def transition_unsigned_block(spec, state, block):
+    assert state.slot < block.slot or state.slot == block.slot
+    if state.slot < block.slot:
+        spec.process_slots(state, block.slot)
+    spec.process_block(state, block)
+
+
+def state_transition_and_sign_block(spec, state, block, expect_fail: bool = False):
+    """Fill in the post-state root, sign, and run the full transition on
+    `state` (reference: helpers/state.py transition_and_sign_block)."""
+    pre_state = state.copy()
+    temp_state = state.copy()
+    transition_unsigned_block(spec, temp_state, block)
+    block.state_root = hash_tree_root(temp_state)
+    signed_block = sign_block(spec, pre_state, block)
+    spec.state_transition(state, signed_block)
+    return signed_block
+
+
+def apply_empty_block(spec, state, slot=None):
+    block = build_empty_block(spec, state, slot)
+    return state_transition_and_sign_block(spec, state, block)

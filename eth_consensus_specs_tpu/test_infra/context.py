@@ -1,0 +1,245 @@
+"""Decorator/fixture engine.
+
+Composition model mirrors the reference's (context.py:322-344):
+
+    @with_all_phases            # fork matrix
+    @spec_state_test            # = vector_test + bls_switch + with_state
+    def test_x(spec, state): ...yield parts...
+
+Calling the decorated function with NO arguments runs pytest mode: loop
+selected forks, build the cached genesis state, drain yields, assertions
+fire. Calling with generator_mode=True returns (case meta, parts iterator)
+for the vector generator (gen/ package). BLS is OFF by default for speed
+(the reference default uses its fastest native backend; ours is pure
+Python, so the kill-switch is the default and @always_bls pins the
+signature-relevant tests — same policy knobs, different default).
+"""
+
+from __future__ import annotations
+
+from functools import wraps
+
+from eth_consensus_specs_tpu.forks import available_forks, get_spec
+from eth_consensus_specs_tpu.utils import bls as bls_module
+
+from .genesis import create_genesis_state
+
+DEFAULT_TEST_PRESET = "minimal"
+
+# populated lazily; forks become testable as their spec classes land
+def _default_phases():
+    return available_forks()
+
+
+class SkippedTest(Exception):
+    pass
+
+
+def expect_assertion_error(fn):
+    """Run fn expecting the state transition to reject (reference:
+    context.py:384-395). ValueError covers uint-range rejection, which the
+    spec defines as invalid-transition behavior."""
+    try:
+        fn()
+    except (AssertionError, IndexError, ValueError):
+        return
+    raise AssertionError("expected the operation to be rejected, but it was accepted")
+
+
+# -- balance profiles (reference: context.py default/low/misc balances) ----
+
+
+def default_balances(spec):
+    n = 8 * spec.SLOTS_PER_EPOCH * spec.MAX_COMMITTEES_PER_SLOT
+    return [spec.MAX_EFFECTIVE_BALANCE] * n
+
+
+def scaled_churn_balances_min_churn_limit(spec):
+    n = spec.config.CHURN_LIMIT_QUOTIENT * spec.config.MIN_PER_EPOCH_CHURN_LIMIT
+    return [spec.MAX_EFFECTIVE_BALANCE] * n
+
+
+def low_balances(spec):
+    n = 8 * spec.SLOTS_PER_EPOCH * spec.MAX_COMMITTEES_PER_SLOT
+    low = spec.config.EJECTION_BALANCE
+    return [low] * n
+
+
+def misc_balances(spec):
+    n = 8 * spec.SLOTS_PER_EPOCH * spec.MAX_COMMITTEES_PER_SLOT
+    balances = [spec.MAX_EFFECTIVE_BALANCE * 2 * i // n for i in range(n)]
+    rng = __import__("random").Random(1234)
+    rng.shuffle(balances)
+    return balances
+
+
+def default_activation_threshold(spec):
+    return spec.MAX_EFFECTIVE_BALANCE
+
+
+def zero_activation_threshold(spec):
+    return 0
+
+
+# -- state cache -----------------------------------------------------------
+
+_state_cache: dict = {}
+
+
+def _get_genesis_state(spec, balances_fn, threshold_fn):
+    key = (spec.fork_name, spec.preset_name, balances_fn.__name__, threshold_fn.__name__)
+    if key not in _state_cache:
+        _state_cache[key] = create_genesis_state(
+            spec, balances_fn(spec), threshold_fn(spec)
+        )
+    return _state_cache[key].copy()
+
+
+# -- core decorators -------------------------------------------------------
+
+
+def _drain(gen):
+    """Pytest mode: execute the test body, discarding vector parts."""
+    if gen is None:
+        return
+    for _ in gen:
+        pass
+
+
+def with_phases(phases):
+    """Outermost: the fork matrix. The wrapped callable accepts the pytest
+    no-arg call or generator-mode kwargs."""
+
+    def deco(fn):
+        @wraps(fn)
+        def wrapper(*args, **kwargs):
+            if kwargs.get("generator_mode"):
+                phase = kwargs.pop("phase", phases[0])
+                if phase not in phases:
+                    raise SkippedTest(f"fork {phase} not in {phases}")
+                return fn(*args, phase=phase, **kwargs)
+            run_phases = [p for p in phases if p in _default_phases()]
+            if not run_phases:
+                raise SkippedTest(f"no implemented fork among {phases}")
+            for phase in run_phases:
+                fn(*args, phase=phase, **kwargs)
+
+        wrapper.phases = phases
+        wrapper.inner = fn
+        # pytest must not introspect (spec, state) as fixtures through
+        # __wrapped__; the collected callable takes no arguments
+        wrapper.__signature__ = __import__("inspect").Signature()
+        return wrapper
+
+    return deco
+
+
+def with_all_phases(fn):
+    return with_phases(_default_phases())(fn)
+
+
+def with_presets(presets, reason: str = ""):
+    def deco(fn):
+        @wraps(fn)
+        def wrapper(*args, **kwargs):
+            preset = kwargs.get("preset", DEFAULT_TEST_PRESET)
+            if preset not in presets:
+                raise SkippedTest(f"preset {preset} not supported: {reason}")
+            return fn(*args, **kwargs)
+
+        return wrapper
+
+    return deco
+
+
+def _make_runner(fn, *, needs_state: bool, balances_fn, threshold_fn, bls_default: str):
+    """Shared core of spec_state_test/spec_test variants."""
+
+    @wraps(fn)
+    def runner(
+        *,
+        phase: str = "phase0",
+        preset: str = DEFAULT_TEST_PRESET,
+        generator_mode: bool = False,
+        bls_active: bool | None = None,
+        **extra,
+    ):
+        spec = get_spec(phase, preset)
+        if bls_active is None:
+            bls_active = bls_default == "on"
+        prior = bls_module.bls_active
+        bls_module.bls_active = bls_active
+        try:
+            kwargs = dict(extra)
+            kwargs["spec"] = spec
+            if needs_state:
+                kwargs["state"] = _get_genesis_state(spec, balances_fn, threshold_fn)
+            gen = fn(**kwargs)
+            if generator_mode:
+                # hand the raw generator to the vector machinery
+                return gen
+            _drain(gen)
+        finally:
+            bls_module.bls_active = prior
+
+    return runner
+
+
+def spec_state_test(fn):
+    return _make_runner(
+        fn,
+        needs_state=True,
+        balances_fn=default_balances,
+        threshold_fn=default_activation_threshold,
+        bls_default="off",
+    )
+
+
+def spec_test(fn):
+    return _make_runner(
+        fn,
+        needs_state=False,
+        balances_fn=default_balances,
+        threshold_fn=default_activation_threshold,
+        bls_default="off",
+    )
+
+
+def with_custom_state(balances_fn, threshold_fn):
+    def deco(fn):
+        return _make_runner(
+            fn,
+            needs_state=True,
+            balances_fn=balances_fn,
+            threshold_fn=threshold_fn,
+            bls_default="off",
+        )
+
+    return deco
+
+
+def always_bls(fn):
+    """Pin real signatures on (reference: context.py:413-425)."""
+
+    @wraps(fn)
+    def wrapper(*args, **kwargs):
+        kwargs["bls_active"] = True
+        return fn(*args, **kwargs)
+
+    wrapper.bls = "always"
+    return wrapper
+
+
+def never_bls(fn):
+    @wraps(fn)
+    def wrapper(*args, **kwargs):
+        kwargs["bls_active"] = False
+        return fn(*args, **kwargs)
+
+    wrapper.bls = "never"
+    return wrapper
+
+
+def single_phase(fn):
+    # retained for reference-parity of decorator vocabulary
+    return fn

@@ -1,0 +1,114 @@
+"""Deposit scenario helpers (reference analogue: test/helpers/deposits.py).
+
+Builds real incremental-merkle proofs against a deposit tree (depth 32 +
+mixed-in length), the same structure the production deposit contract
+maintains."""
+
+from __future__ import annotations
+
+from eth_consensus_specs_tpu.ssz import Bytes32, List, hash_tree_root
+from eth_consensus_specs_tpu.ssz.merkle import get_merkle_proof
+from eth_consensus_specs_tpu.utils import bls
+
+from .context import expect_assertion_error
+from .genesis import bls_withdrawal_credentials
+from .keys import privkeys, pubkey
+
+
+def build_deposit_data(spec, pubkey_b, privkey, amount, withdrawal_credentials, signed=False):
+    deposit_data = spec.DepositData(
+        pubkey=pubkey_b,
+        withdrawal_credentials=withdrawal_credentials,
+        amount=amount,
+    )
+    if signed:
+        sign_deposit_data(spec, deposit_data, privkey)
+    return deposit_data
+
+
+def sign_deposit_data(spec, deposit_data, privkey):
+    deposit_message = spec.DepositMessage(
+        pubkey=deposit_data.pubkey,
+        withdrawal_credentials=deposit_data.withdrawal_credentials,
+        amount=deposit_data.amount,
+    )
+    domain = spec.compute_domain(spec.DOMAIN_DEPOSIT)
+    deposit_data.signature = bls.Sign(privkey, spec.compute_signing_root(deposit_message, domain))
+
+
+def _deposit_tree(spec, deposit_data_list):
+    leaves = [bytes(hash_tree_root(d)) for d in deposit_data_list]
+    DepositDataList = List[spec.DepositData, 2**spec.DEPOSIT_CONTRACT_TREE_DEPTH]
+    root = hash_tree_root(DepositDataList(deposit_data_list))
+    return leaves, root
+
+
+def build_deposit_proof(spec, deposit_data_list, index: int):
+    leaves, root = _deposit_tree(spec, deposit_data_list)
+    branch = get_merkle_proof(leaves, index, limit=2**spec.DEPOSIT_CONTRACT_TREE_DEPTH)
+    # mix-in-length layer: the last proof element is the little-endian count
+    length_chunk = len(deposit_data_list).to_bytes(32, "little")
+    return [Bytes32(b) for b in branch] + [Bytes32(length_chunk)], root
+
+
+def build_deposit(spec, deposit_data_list, pubkey_b, privkey, amount, withdrawal_credentials, signed):
+    deposit_data = build_deposit_data(
+        spec, pubkey_b, privkey, amount, withdrawal_credentials, signed
+    )
+    index = len(deposit_data_list)
+    deposit_data_list.append(deposit_data)
+    proof, root = build_deposit_proof(spec, deposit_data_list, index)
+    deposit = spec.Deposit(proof=proof, data=deposit_data)
+    return deposit, root, deposit_data_list
+
+
+def prepare_state_and_deposit(spec, state, validator_index, amount, withdrawal_credentials=None, signed=False):
+    """Create a deposit for `validator_index` and point the state's eth1
+    data at the single-deposit tree."""
+    pre_validator_count = len(state.validators)
+    pubkey_b = pubkey(validator_index)
+    privkey = privkeys[validator_index]
+    if withdrawal_credentials is None:
+        withdrawal_credentials = Bytes32(bls_withdrawal_credentials(spec, validator_index))
+    deposit, root, _ = build_deposit(
+        spec, [], pubkey_b, privkey, amount, withdrawal_credentials, signed
+    )
+    state.eth1_deposit_index = 0
+    state.eth1_data.deposit_root = root
+    state.eth1_data.deposit_count = 1
+    assert pre_validator_count == len(state.validators)
+    return deposit
+
+
+def run_deposit_processing(spec, state, deposit, validator_index, valid=True, effective=True):
+    pre_validator_count = len(state.validators)
+    pre_balance = 0
+    is_top_up = validator_index < pre_validator_count
+    if is_top_up:
+        pre_balance = int(state.balances[validator_index])
+
+    yield "pre", state
+    yield "deposit", deposit
+
+    if not valid:
+        expect_assertion_error(lambda: spec.process_deposit(state, deposit))
+        yield "post", None
+        return
+
+    spec.process_deposit(state, deposit)
+    yield "post", state
+
+    if not effective or not bls.KeyValidate(deposit.data.pubkey):
+        # deposit with bad proof-of-possession: no new validator
+        assert len(state.validators) == pre_validator_count
+        if is_top_up:
+            assert int(state.balances[validator_index]) == pre_balance
+    else:
+        if is_top_up:
+            assert len(state.validators) == pre_validator_count
+            assert int(state.balances[validator_index]) == pre_balance + int(deposit.data.amount)
+        else:
+            assert len(state.validators) == pre_validator_count + 1
+            assert len(state.balances) == pre_validator_count + 1
+            assert int(state.balances[validator_index]) == int(deposit.data.amount)
+    assert state.eth1_deposit_index == state.eth1_data.deposit_count

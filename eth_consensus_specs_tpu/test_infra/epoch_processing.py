@@ -1,0 +1,39 @@
+"""Epoch-processing sub-transition runner (reference analogue:
+test/helpers/epoch_processing.py:7-56): run everything BEFORE the target
+sub-transition, then yield pre/post around it."""
+
+from __future__ import annotations
+
+
+def get_process_calls(spec):
+    return [
+        "process_justification_and_finalization",
+        "process_rewards_and_penalties",
+        "process_registry_updates",
+        "process_slashings",
+        "process_eth1_data_reset",
+        "process_effective_balance_updates",
+        "process_slashings_reset",
+        "process_randao_mixes_reset",
+        "process_historical_roots_update",
+        "process_participation_record_updates",
+    ]
+
+
+def run_epoch_processing_to(spec, state, process_name: str):
+    """Advance to the final slot of the epoch, then run sub-transitions up
+    to (excluding) `process_name`."""
+    slot = int(state.slot) + (spec.SLOTS_PER_EPOCH - int(state.slot) % spec.SLOTS_PER_EPOCH)
+    if int(state.slot) < slot - 1:
+        spec.process_slots(state, slot - 1)
+    for name in get_process_calls(spec):
+        if name == process_name:
+            break
+        getattr(spec, name)(state)
+
+
+def run_epoch_processing_with(spec, state, process_name: str):
+    run_epoch_processing_to(spec, state, process_name)
+    yield "pre", state
+    getattr(spec, process_name)(state)
+    yield "post", state

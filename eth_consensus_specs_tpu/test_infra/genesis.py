@@ -1,0 +1,64 @@
+"""Genesis state factory for tests (reference analogue:
+test/helpers/genesis.py:134 `create_genesis_state`).
+
+Builds a valid post-genesis BeaconState directly (without replaying
+deposits), with deterministic keys and configurable balances. Cached per
+(fork, preset, balances-profile) and handed out as copies — the reference
+gets cheap resets from remerkleable structural sharing (context.py:85-92);
+we get them from Container.copy().
+"""
+
+from __future__ import annotations
+
+from eth_consensus_specs_tpu.ssz import Bytes32, hash_tree_root
+from eth_consensus_specs_tpu.ssz.hashing import hash_bytes
+
+from .keys import pubkey
+
+ETH1_GENESIS_HASH = b"\x42" * 32
+GENESIS_TIME = 1578009600
+
+
+def bls_withdrawal_credentials(spec, index: int) -> bytes:
+    return bytes(spec.BLS_WITHDRAWAL_PREFIX) + hash_bytes(pubkey(index))[1:]
+
+
+def create_genesis_state(spec, validator_balances: list[int], activation_threshold: int):
+    state = spec.BeaconState(
+        genesis_time=GENESIS_TIME,
+        fork=spec.Fork(
+            previous_version=spec.config.GENESIS_FORK_VERSION,
+            current_version=spec.config.GENESIS_FORK_VERSION,
+            epoch=spec.GENESIS_EPOCH,
+        ),
+        eth1_data=spec.Eth1Data(
+            deposit_count=len(validator_balances), block_hash=Bytes32(ETH1_GENESIS_HASH)
+        ),
+        eth1_deposit_index=len(validator_balances),
+        latest_block_header=spec.BeaconBlockHeader(
+            body_root=hash_tree_root(spec.BeaconBlockBody())
+        ),
+        randao_mixes=spec.BeaconState.fields()["randao_mixes"](
+            [Bytes32(ETH1_GENESIS_HASH)] * spec.EPOCHS_PER_HISTORICAL_VECTOR
+        ),
+    )
+    for index, balance in enumerate(validator_balances):
+        effective = min(
+            balance - balance % spec.EFFECTIVE_BALANCE_INCREMENT, spec.MAX_EFFECTIVE_BALANCE
+        )
+        validator = spec.Validator(
+            pubkey=pubkey(index),
+            withdrawal_credentials=Bytes32(bls_withdrawal_credentials(spec, index)),
+            effective_balance=effective,
+            activation_eligibility_epoch=spec.FAR_FUTURE_EPOCH,
+            activation_epoch=spec.FAR_FUTURE_EPOCH,
+            exit_epoch=spec.FAR_FUTURE_EPOCH,
+            withdrawable_epoch=spec.FAR_FUTURE_EPOCH,
+        )
+        if effective >= activation_threshold:
+            validator.activation_eligibility_epoch = spec.GENESIS_EPOCH
+            validator.activation_epoch = spec.GENESIS_EPOCH
+        state.validators.append(validator)
+        state.balances.append(balance)
+    state.genesis_validators_root = hash_tree_root(state.validators)
+    return state

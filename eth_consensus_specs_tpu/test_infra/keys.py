@@ -1,0 +1,48 @@
+"""Deterministic test keypairs (reference analogue: test/helpers/keys.py:3-6).
+
+Privkey of validator i is i+1; pubkeys are derived lazily and cached —
+deriving all 8k keys eagerly would cost seconds of import time with the
+pure-Python curve, and tests touch only the validators they use.
+"""
+
+from __future__ import annotations
+
+from eth_consensus_specs_tpu.utils import bls
+from eth_consensus_specs_tpu.crypto import signature as _sig
+
+KEY_COUNT = 8192
+
+privkeys = list(range(1, KEY_COUNT + 1))
+
+_pubkey_cache: dict[int, bytes] = {}
+
+
+def pubkey(index: int) -> bytes:
+    """Compressed pubkey of validator `index` (0-based)."""
+    if index not in _pubkey_cache:
+        _pubkey_cache[index] = _sig.sk_to_pk(privkeys[index])
+    return _pubkey_cache[index]
+
+
+def privkey_of(index: int) -> int:
+    return privkeys[index]
+
+
+class _LazyPubkeys:
+    """Sequence view so helpers can write pubkeys[i] like the reference."""
+
+    def __getitem__(self, index: int) -> bytes:
+        return pubkey(index)
+
+    def __len__(self) -> int:
+        return KEY_COUNT
+
+
+pubkeys = _LazyPubkeys()
+
+
+def pubkey_to_privkey(pk: bytes) -> int:
+    for i, cached in _pubkey_cache.items():
+        if cached == pk:
+            return privkeys[i]
+    raise KeyError("unknown pubkey (not derived yet)")

@@ -1,0 +1,95 @@
+"""Slashing scenario helpers (reference analogue:
+test/helpers/proposer_slashings.py, attester_slashings.py)."""
+
+from __future__ import annotations
+
+from eth_consensus_specs_tpu.utils import bls
+
+from .attestations import get_valid_attestation, sign_attestation
+from .block import build_empty_block_for_next_slot
+from .keys import privkeys
+
+
+def sign_block_header(spec, state, header, privkey):
+    domain = spec.get_domain(
+        state, spec.DOMAIN_BEACON_PROPOSER, spec.compute_epoch_at_slot(header.slot)
+    )
+    signature = bls.Sign(privkey, spec.compute_signing_root(header, domain))
+    return spec.SignedBeaconBlockHeader(message=header, signature=signature)
+
+
+def get_valid_proposer_slashing(spec, state, signed_1=False, signed_2=False, proposer_index=None):
+    if proposer_index is None:
+        proposer_index = spec.get_beacon_proposer_index(state)
+    privkey = privkeys[int(proposer_index)]
+    slot = int(state.slot)
+
+    header_1 = spec.BeaconBlockHeader(
+        slot=slot,
+        proposer_index=proposer_index,
+        parent_root=b"\x33" * 32,
+        state_root=b"\x44" * 32,
+        body_root=b"\x55" * 32,
+    )
+    header_2 = header_1.copy()
+    header_2.parent_root = b"\x99" * 32
+
+    signed_header_1 = (
+        sign_block_header(spec, state, header_1, privkey)
+        if signed_1
+        else spec.SignedBeaconBlockHeader(message=header_1)
+    )
+    signed_header_2 = (
+        sign_block_header(spec, state, header_2, privkey)
+        if signed_2
+        else spec.SignedBeaconBlockHeader(message=header_2)
+    )
+    return spec.ProposerSlashing(
+        signed_header_1=signed_header_1, signed_header_2=signed_header_2
+    )
+
+
+def get_valid_attester_slashing(spec, state, slot=None, signed_1=False, signed_2=False):
+    attestation_1 = get_valid_attestation(spec, state, slot=slot, signed=signed_1)
+    attestation_2 = attestation_1.copy()
+    attestation_2.data.target.root = b"\x01" * 32  # double vote
+    if signed_2:
+        sign_attestation(spec, state, attestation_2)
+    return spec.AttesterSlashing(
+        attestation_1=spec.get_indexed_attestation(state, attestation_1),
+        attestation_2=spec.get_indexed_attestation(state, attestation_2),
+    )
+
+
+def run_proposer_slashing_processing(spec, state, proposer_slashing, valid=True):
+    from .context import expect_assertion_error
+
+    yield "pre", state
+    yield "proposer_slashing", proposer_slashing
+    if not valid:
+        expect_assertion_error(lambda: spec.process_proposer_slashing(state, proposer_slashing))
+        yield "post", None
+        return
+    proposer_index = int(proposer_slashing.signed_header_1.message.proposer_index)
+    pre_proposer_balance = int(state.balances[proposer_index])
+    spec.process_proposer_slashing(state, proposer_slashing)
+    yield "post", state
+    assert state.validators[proposer_index].slashed
+    assert int(state.balances[proposer_index]) < pre_proposer_balance
+
+
+def run_attester_slashing_processing(spec, state, attester_slashing, valid=True):
+    from .context import expect_assertion_error
+
+    yield "pre", state
+    yield "attester_slashing", attester_slashing
+    if not valid:
+        expect_assertion_error(lambda: spec.process_attester_slashing(state, attester_slashing))
+        yield "post", None
+        return
+    slashable = set(int(i) for i in attester_slashing.attestation_1.attesting_indices) & set(
+        int(i) for i in attester_slashing.attestation_2.attesting_indices
+    )
+    spec.process_attester_slashing(state, attester_slashing)
+    yield "post", state
+    assert any(state.validators[i].slashed for i in slashable)

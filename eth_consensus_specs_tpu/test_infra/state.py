@@ -1,0 +1,46 @@
+"""State-advancement helpers (reference analogue: test/helpers/state.py)."""
+
+from __future__ import annotations
+
+from eth_consensus_specs_tpu.ssz import Bytes32, hash_tree_root
+
+
+def next_slot(spec, state):
+    spec.process_slots(state, int(state.slot) + 1)
+
+
+def next_slots(spec, state, slots: int):
+    if slots > 0:
+        spec.process_slots(state, int(state.slot) + slots)
+
+
+def next_epoch(spec, state):
+    slot = int(state.slot) + spec.SLOTS_PER_EPOCH - int(state.slot) % spec.SLOTS_PER_EPOCH
+    spec.process_slots(state, slot)
+
+
+def transition_to(spec, state, slot: int):
+    assert state.slot <= slot
+    if state.slot < slot:
+        spec.process_slots(state, slot)
+
+
+def transition_to_slot_via_block(spec, state, slot):
+    """Advance by applying an (empty) block at `slot`."""
+    from .block import apply_empty_block
+
+    assert state.slot < slot
+    apply_empty_block(spec, state, slot)
+
+
+def get_state_root(spec, state, slot) -> bytes:
+    assert slot < state.slot <= slot + spec.SLOTS_PER_HISTORICAL_ROOT
+    return state.state_roots[int(slot) % spec.SLOTS_PER_HISTORICAL_ROOT]
+
+
+def latest_block_root(spec, state) -> Bytes32:
+    """Head block root as of this state (fills the pending state root)."""
+    header = state.latest_block_header.copy()
+    if header.state_root == Bytes32():
+        header.state_root = hash_tree_root(state)
+    return hash_tree_root(header)

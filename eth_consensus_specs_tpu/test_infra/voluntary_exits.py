@@ -1,0 +1,45 @@
+"""Voluntary-exit scenario helpers (reference analogue:
+test/helpers/voluntary_exits.py)."""
+
+from __future__ import annotations
+
+from eth_consensus_specs_tpu.utils import bls
+
+from .context import expect_assertion_error
+from .keys import privkeys
+
+
+def sign_voluntary_exit(spec, state, voluntary_exit, privkey):
+    domain = spec.get_domain(state, spec.DOMAIN_VOLUNTARY_EXIT, voluntary_exit.epoch)
+    return spec.SignedVoluntaryExit(
+        message=voluntary_exit,
+        signature=bls.Sign(privkey, spec.compute_signing_root(voluntary_exit, domain)),
+    )
+
+
+def prepare_signed_exits(spec, state, indices):
+    current_epoch = spec.get_current_epoch(state)
+    return [
+        sign_voluntary_exit(
+            spec,
+            state,
+            spec.VoluntaryExit(epoch=current_epoch, validator_index=index),
+            privkeys[int(index)],
+        )
+        for index in indices
+    ]
+
+
+def run_voluntary_exit_processing(spec, state, signed_voluntary_exit, valid=True):
+    validator_index = int(signed_voluntary_exit.message.validator_index)
+    yield "pre", state
+    yield "voluntary_exit", signed_voluntary_exit
+    if not valid:
+        expect_assertion_error(lambda: spec.process_voluntary_exit(state, signed_voluntary_exit))
+        yield "post", None
+        return
+    pre_exit_epoch = state.validators[validator_index].exit_epoch
+    spec.process_voluntary_exit(state, signed_voluntary_exit)
+    yield "post", state
+    assert pre_exit_epoch == spec.FAR_FUTURE_EPOCH
+    assert state.validators[validator_index].exit_epoch < spec.FAR_FUTURE_EPOCH

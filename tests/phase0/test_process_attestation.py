@@ -1,0 +1,115 @@
+"""phase0 attestation processing (reference analogue:
+test/phase0/block_processing/test_process_attestation.py)."""
+
+from eth_consensus_specs_tpu.test_infra.attestations import (
+    get_valid_attestation,
+    run_attestation_processing,
+    sign_attestation,
+)
+from eth_consensus_specs_tpu.test_infra.context import (
+    always_bls,
+    spec_state_test,
+    with_all_phases,
+)
+from eth_consensus_specs_tpu.test_infra.state import next_slots, transition_to
+
+
+@with_all_phases
+@spec_state_test
+def test_one_basic_attestation(spec, state):
+    attestation = get_valid_attestation(spec, state, signed=True)
+    next_slots(spec, state, spec.MIN_ATTESTATION_INCLUSION_DELAY)
+    yield from run_attestation_processing(spec, state, attestation)
+
+
+@with_all_phases
+@always_bls
+@spec_state_test
+def test_one_attestation_with_real_signature(spec, state):
+    attestation = get_valid_attestation(spec, state, signed=True)
+    next_slots(spec, state, spec.MIN_ATTESTATION_INCLUSION_DELAY)
+    yield from run_attestation_processing(spec, state, attestation)
+
+
+@with_all_phases
+@always_bls
+@spec_state_test
+def test_invalid_attestation_signature(spec, state):
+    attestation = get_valid_attestation(spec, state)  # unsigned
+    next_slots(spec, state, spec.MIN_ATTESTATION_INCLUSION_DELAY)
+    yield from run_attestation_processing(spec, state, attestation, valid=False)
+
+
+@with_all_phases
+@spec_state_test
+def test_invalid_before_inclusion_delay(spec, state):
+    attestation = get_valid_attestation(spec, state, signed=True)
+    # state.slot == attestation.data.slot: inclusion delay not yet met
+    yield from run_attestation_processing(spec, state, attestation, valid=False)
+
+
+@with_all_phases
+@spec_state_test
+def test_invalid_after_epoch_slots(spec, state):
+    attestation = get_valid_attestation(spec, state, signed=True)
+    next_slots(spec, state, spec.SLOTS_PER_EPOCH + 1)
+    yield from run_attestation_processing(spec, state, attestation, valid=False)
+
+
+@with_all_phases
+@spec_state_test
+def test_invalid_old_source_epoch(spec, state):
+    next_slots(spec, state, 5 * spec.SLOTS_PER_EPOCH)
+    state.finalized_checkpoint.epoch = 2
+    state.previous_justified_checkpoint.epoch = 3
+    state.current_justified_checkpoint.epoch = 4
+    attestation = get_valid_attestation(spec, state, slot=int(state.slot) - 1)
+    # test logic: flip the source to a stale epoch
+    attestation.data.source.epoch = 2
+    sign_attestation(spec, state, attestation)
+    next_slots(spec, state, spec.MIN_ATTESTATION_INCLUSION_DELAY)
+    yield from run_attestation_processing(spec, state, attestation, valid=False)
+
+
+@with_all_phases
+@spec_state_test
+def test_invalid_wrong_index_for_slot(spec, state):
+    while spec.get_committee_count_per_slot(state, spec.get_current_epoch(state)) >= spec.MAX_COMMITTEES_PER_SLOT:
+        state.validators.pop()
+        state.balances.pop()
+    index = spec.MAX_COMMITTEES_PER_SLOT - 1
+    attestation = get_valid_attestation(spec, state)
+    attestation.data.index = index
+    next_slots(spec, state, spec.MIN_ATTESTATION_INCLUSION_DELAY)
+    yield from run_attestation_processing(spec, state, attestation, valid=False)
+
+
+@with_all_phases
+@spec_state_test
+def test_invalid_mismatched_target_and_slot(spec, state):
+    next_slots(spec, state, spec.SLOTS_PER_EPOCH)
+    attestation = get_valid_attestation(spec, state, slot=int(state.slot) - 1)
+    attestation.data.slot = int(attestation.data.slot) + spec.SLOTS_PER_EPOCH
+    sign_attestation(spec, state, attestation)
+    next_slots(spec, state, spec.MIN_ATTESTATION_INCLUSION_DELAY)
+    yield from run_attestation_processing(spec, state, attestation, valid=False)
+
+
+@with_all_phases
+@spec_state_test
+def test_invalid_extra_aggregation_bit(spec, state):
+    attestation = get_valid_attestation(spec, state, signed=True)
+    attestation.aggregation_bits.append(True)
+    next_slots(spec, state, spec.MIN_ATTESTATION_INCLUSION_DELAY)
+    yield from run_attestation_processing(spec, state, attestation, valid=False)
+
+
+@with_all_phases
+@spec_state_test
+def test_previous_epoch_attestation(spec, state):
+    next_slots(spec, state, spec.SLOTS_PER_EPOCH)
+    attestation = get_valid_attestation(
+        spec, state, slot=int(state.slot) - spec.SLOTS_PER_EPOCH + 1, signed=True
+    )
+    next_slots(spec, state, spec.MIN_ATTESTATION_INCLUSION_DELAY)
+    yield from run_attestation_processing(spec, state, attestation)

@@ -1,0 +1,29 @@
+"""Shuffle identity: the whole-permutation kernel must agree with the
+per-index spec form everywhere, and be a true permutation."""
+
+import numpy as np
+import pytest
+
+from eth_consensus_specs_tpu.forks import get_spec
+from eth_consensus_specs_tpu.ops.shuffle import shuffle_permutation
+
+
+@pytest.mark.parametrize("n", [1, 2, 7, 64, 257, 1000])
+def test_permutation_matches_spec_form(n):
+    spec = get_spec("phase0", "minimal")
+    seed = bytes(range(32))
+    perm = shuffle_permutation(n, seed, spec.SHUFFLE_ROUND_COUNT)
+    for i in range(n):
+        assert int(perm[i]) == spec.compute_shuffled_index(i, n, seed)
+
+
+def test_is_permutation():
+    seed = b"\xaa" * 32
+    perm = shuffle_permutation(5000, seed, 90)
+    assert sorted(perm.tolist()) == list(range(5000))
+
+
+def test_seed_sensitivity():
+    a = shuffle_permutation(256, b"\x01" * 32, 90)
+    b = shuffle_permutation(256, b"\x02" * 32, 90)
+    assert a.tolist() != b.tolist()
