@@ -1,0 +1,117 @@
+"""Driver benchmark — ONE JSON line on stdout.
+
+Primary metric: SSZ merkleization throughput (device tree kernel,
+ops/merkle.py) over a 2**21-chunk leaf level — the size class of a
+~1M-validator registry's balance/leaf levels, the reference's #1 hot spot
+(hash_tree_root(state) twice per slot; reference:
+specs/phase0/beacon-chain.md:1383-1393 via utils/hash_function.py).
+
+Baseline: the reference's exact host path — one hashlib.sha256 call per
+tree node (reference: utils/merkle_minimal.py:47-91 hashes pairwise per
+level) — measured on a 2**16 subtree and scaled per-hash (hashlib cost is
+size-independent per 64B message).
+
+vs_baseline is the speedup of the device tree over that host loop (>1 is
+faster than the reference path). Secondary numbers go to stderr.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import sys
+import time
+
+import numpy as np
+
+
+def host_hashes_per_sec(n_pairs: int = 1 << 16) -> float:
+    rng = np.random.default_rng(0)
+    pairs = rng.integers(0, 256, size=(n_pairs, 64), dtype=np.uint8)
+    blobs = [p.tobytes() for p in pairs]
+    sha = hashlib.sha256
+    t0 = time.perf_counter()
+    for b in blobs:
+        sha(b).digest()
+    dt = time.perf_counter() - t0
+    return n_pairs / dt
+
+
+def device_tree_hashes_per_sec(depth: int = 21, repeats: int = 3) -> tuple[float, float]:
+    import jax
+    import jax.numpy as jnp
+
+    from eth_consensus_specs_tpu.ops.merkle import _tree_root_fused
+
+    rng = np.random.default_rng(1)
+    leaves = jnp.asarray(
+        rng.integers(0, 2**32, size=(1 << depth, 8), dtype=np.uint64).astype(np.uint32)
+    )
+    leaves = jax.device_put(leaves)
+    # warmup/compile
+    jax.block_until_ready(_tree_root_fused(leaves, depth))
+    best = float("inf")
+    for _ in range(repeats):
+        t0 = time.perf_counter()
+        jax.block_until_ready(_tree_root_fused(leaves, depth))
+        best = min(best, time.perf_counter() - t0)
+    n_hashes = (1 << depth) - 1  # logical tree nodes
+    return n_hashes / best, best
+
+
+def bench_epoch_accounting(n_validators: int = 1_000_000) -> float:
+    """Secondary: fused 1M-validator accounting epoch, seconds/epoch."""
+    import jax
+
+    import __graft_entry__ as graft
+    from eth_consensus_specs_tpu.forks import get_spec
+    from eth_consensus_specs_tpu.ops.state_columns import EpochParams, epoch_accounting
+
+    params = EpochParams.from_spec(get_spec("phase0", "mainnet"))
+    cols, just = graft._example_inputs(n_validators)
+    cols = jax.device_put(cols)
+    just = jax.device_put(just)
+    jax.block_until_ready(epoch_accounting(params, cols, just))
+    best = float("inf")
+    for _ in range(3):
+        t0 = time.perf_counter()
+        jax.block_until_ready(epoch_accounting(params, cols, just))
+        best = min(best, time.perf_counter() - t0)
+    return best
+
+
+def main() -> None:
+    from eth_consensus_specs_tpu.utils.cache import enable_persistent_cache
+
+    enable_persistent_cache()
+
+    host_hps = host_hashes_per_sec()
+    print(f"[bench] host hashlib: {host_hps/1e6:.2f} Mhash/s", file=sys.stderr)
+
+    dev_hps, tree_s = device_tree_hashes_per_sec()
+    print(
+        f"[bench] device tree (2^21 chunks): {dev_hps/1e9:.3f} Ghash/s, "
+        f"{tree_s*1e3:.1f} ms/tree",
+        file=sys.stderr,
+    )
+
+    try:
+        epoch_s = bench_epoch_accounting()
+        print(f"[bench] fused epoch @1M validators: {epoch_s*1e3:.1f} ms", file=sys.stderr)
+    except Exception as e:  # secondary metric must not sink the primary
+        print(f"[bench] epoch accounting skipped: {e}", file=sys.stderr)
+
+    print(
+        json.dumps(
+            {
+                "metric": "ssz_merkle_tree_hashes_per_sec",
+                "value": round(dev_hps, 0),
+                "unit": "hash/s",
+                "vs_baseline": round(dev_hps / host_hps, 2),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -19,3 +19,13 @@ Layout:
 """
 
 __version__ = "0.1.0"
+
+# All spec arithmetic is uint64 with overflow-as-invalid semantics
+# (reference: specs/phase0/beacon-chain.md:1339-1344); the framework is
+# unusable under JAX's default 32-bit promotion, so x64 is a hard
+# requirement, enabled here — at the package root, before any backend
+# initializes — rather than deep inside a lazily-imported kernel module.
+import jax as _jax
+
+_jax.config.update("jax_enable_x64", True)
+del _jax

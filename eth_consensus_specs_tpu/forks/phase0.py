@@ -675,10 +675,157 @@ class Phase0Spec:
         self.process_slashings(state)
         self.process_eth1_data_reset(state)
         self.process_effective_balance_updates(state)
+        self._process_epoch_resets(state)
+
+    def _process_epoch_resets(self, state) -> None:
+        """Tail resets shared by the object and columnar epoch paths."""
         self.process_slashings_reset(state)
         self.process_randao_mixes_reset(state)
         self.process_historical_roots_update(state)
         self.process_participation_record_updates(state)
+
+    # -- columnar (device) epoch processing --------------------------------
+
+    def extract_epoch_columns(self, state):
+        """Flatten the object-view state into the columnar arrays consumed by
+        ops/state_columns.epoch_accounting. Participation is pre-reduced to
+        per-component masks here (committee resolution reuses the cached
+        whole-permutation shuffle), so the device kernel sees only dense
+        vectors. Returns (EpochColumns, JustificationState)."""
+        import numpy as np
+
+        from eth_consensus_specs_tpu.ops.state_columns import (
+            EpochColumns,
+            JustificationState,
+        )
+
+        n = len(state.validators)
+        eff = np.empty(n, np.uint64)
+        bal = np.empty(n, np.uint64)
+        slashed = np.empty(n, bool)
+        act = np.empty(n, np.uint64)
+        exitep = np.empty(n, np.uint64)
+        wd = np.empty(n, np.uint64)
+        for i, v in enumerate(state.validators):
+            eff[i] = int(v.effective_balance)
+            slashed[i] = bool(v.slashed)
+            act[i] = int(v.activation_epoch)
+            exitep[i] = int(v.exit_epoch)
+            wd[i] = int(v.withdrawable_epoch)
+        for i, b in enumerate(state.balances):
+            bal[i] = int(b)
+
+        prev_epoch = self.get_previous_epoch(state)
+        cur_epoch = self.get_current_epoch(state)
+        src = np.zeros(n, bool)
+        tgt = np.zeros(n, bool)
+        head = np.zeros(n, bool)
+        cur_tgt = np.zeros(n, bool)
+        proposer = np.zeros(n, np.int64)
+        # min inclusion delay per attester; kernel clamps the non-attester max
+        best = np.full(n, np.iinfo(np.uint64).max, np.uint64)
+
+        prev_target_root = self.get_block_root(state, prev_epoch)
+        for a in state.previous_epoch_attestations:
+            committee = self.get_beacon_committee(state, a.data.slot, a.data.index)
+            attesters = [int(committee[i]) for i, bit in enumerate(a.aggregation_bits) if bit]
+            d = int(a.inclusion_delay)
+            p = int(a.proposer_index)
+            is_tgt = a.data.target.root == prev_target_root
+            is_head = is_tgt and a.data.beacon_block_root == self.get_block_root_at_slot(
+                state, a.data.slot
+            )
+            for idx in attesters:
+                src[idx] = True
+                if is_tgt:
+                    tgt[idx] = True
+                if is_head:
+                    head[idx] = True
+                if d < best[idx]:  # strict: first-listed wins ties, like min()
+                    best[idx] = d
+                    proposer[idx] = p
+        cur_target_root = self.get_block_root(state, cur_epoch)
+        for a in state.current_epoch_attestations:
+            if a.data.target.root != cur_target_root:
+                continue
+            committee = self.get_beacon_committee(state, a.data.slot, a.data.index)
+            for i, bit in enumerate(a.aggregation_bits):
+                if bit:
+                    cur_tgt[int(committee[i])] = True
+
+        cols = EpochColumns(
+            effective_balance=eff,
+            balance=bal,
+            slashed=slashed,
+            activation_epoch=act,
+            exit_epoch=exitep,
+            withdrawable_epoch=wd,
+            src_att=src,
+            tgt_att=tgt,
+            head_att=head,
+            cur_tgt_att=cur_tgt,
+            incl_delay=np.minimum(best, np.uint64(1) << np.uint64(32)),
+            incl_proposer=proposer,
+        )
+        just = JustificationState(
+            current_epoch=np.uint64(cur_epoch),
+            justification_bits=np.array(list(state.justification_bits), bool),
+            prev_justified_epoch=np.uint64(int(state.previous_justified_checkpoint.epoch)),
+            prev_justified_root=np.frombuffer(
+                bytes(state.previous_justified_checkpoint.root), np.uint8
+            ),
+            cur_justified_epoch=np.uint64(int(state.current_justified_checkpoint.epoch)),
+            cur_justified_root=np.frombuffer(
+                bytes(state.current_justified_checkpoint.root), np.uint8
+            ),
+            finalized_epoch=np.uint64(int(state.finalized_checkpoint.epoch)),
+            finalized_root=np.frombuffer(bytes(state.finalized_checkpoint.root), np.uint8),
+            block_root_prev=np.frombuffer(bytes(prev_target_root), np.uint8),
+            block_root_cur=np.frombuffer(bytes(cur_target_root), np.uint8),
+            slashings_sum=np.uint64(sum(int(s) for s in state.slashings)),
+        )
+        return cols, just
+
+    def process_epoch_columnar(self, state) -> None:
+        """Bit-exact process_epoch with the accounting epoch fused on device
+        (ops/state_columns.py; hoisting proof in that module's docstring).
+        Registry updates + the cheap resets stay host-side."""
+        import jax
+        import numpy as np
+
+        from eth_consensus_specs_tpu.ops.state_columns import EpochParams, epoch_accounting
+
+        cols, just = self.extract_epoch_columns(state)
+        res = epoch_accounting(EpochParams.from_spec(self), cols, just)
+        res = jax.tree_util.tree_map(np.asarray, res)  # one device->host sync
+
+        bits_out = [bool(b) for b in res.justification_bits]
+        state.previous_justified_checkpoint = self.Checkpoint(
+            epoch=int(res.prev_justified_epoch), root=Bytes32(res.prev_justified_root.tobytes())
+        )
+        state.current_justified_checkpoint = self.Checkpoint(
+            epoch=int(res.cur_justified_epoch), root=Bytes32(res.cur_justified_root.tobytes())
+        )
+        state.finalized_checkpoint = self.Checkpoint(
+            epoch=int(res.finalized_epoch), root=Bytes32(res.finalized_root.tobytes())
+        )
+        state.justification_bits = self.BeaconState.fields()["justification_bits"](bits_out)
+
+        # registry updates read the post-justification checkpoint but none of
+        # the balance columns the kernel wrote — order is free; spec order kept
+        self.process_registry_updates(state)
+
+        new_bal = [int(x) for x in res.balance]
+        for i in range(len(new_bal)):
+            state.balances[i] = new_bal[i]
+        new_eff = res.effective_balance
+        for i, v in enumerate(state.validators):
+            ne = int(new_eff[i])
+            if int(v.effective_balance) != ne:
+                v.effective_balance = ne
+
+        self.process_eth1_data_reset(state)
+        self._process_epoch_resets(state)
 
     def get_matching_source_attestations(self, state, epoch: int):
         assert epoch in (self.get_previous_epoch(state), self.get_current_epoch(state))

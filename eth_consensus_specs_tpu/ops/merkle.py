@@ -36,9 +36,12 @@ from jax import lax
 from .sha256 import sha256_pair_words
 
 
-@partial(jax.jit, static_argnums=(1,))
-def _tree_root_fused(leaves: jnp.ndarray, depth: int) -> jnp.ndarray:
-    """leaves: uint32[2**depth, 8] -> uint32[8] root. One XLA computation."""
+def tree_root_words(leaves: jnp.ndarray, depth: int) -> jnp.ndarray:
+    """Traceable tree reduction: uint32[2**depth, 8] -> uint32[8] root.
+
+    Plain function so it composes under outer jits / shard_map (the
+    sharded tree in parallel/merkle.py reduces local subtrees with this,
+    then all-gathers the per-device roots)."""
     if depth == 0:
         return leaves[0]
     w = leaves.shape[0] // 2
@@ -49,6 +52,9 @@ def _tree_root_fused(leaves: jnp.ndarray, depth: int) -> jnp.ndarray:
 
     buf = lax.fori_loop(0, depth, level, leaves)
     return buf[0]
+
+
+_tree_root_fused = partial(jax.jit, static_argnums=(1,))(tree_root_words)
 
 
 def merkleize_subtree_device(chunks: np.ndarray, depth: int) -> bytes:

@@ -79,12 +79,49 @@ def _compress(state: list, w: list) -> list:
     return [s + o for s, o in zip(state, [a, b, c, d, e, f, g, h])]
 
 
-def sha256_pair_words(words: jnp.ndarray) -> jnp.ndarray:
-    """Hash a batch of 64-byte messages given as big-endian words.
+def _compress_scan(state8: jnp.ndarray, w16: jnp.ndarray) -> jnp.ndarray:
+    """One compression as a lax.scan over the 64 rounds.
 
-    words: uint32[N, 16] -> uint32[N, 8]. Jit-traceable (inline this into
-    larger fused kernels; for standalone use go through sha256_tiled).
+    state8: uint32[8, N], w16: uint32[16, N]. The rolling 16-word message-
+    schedule window rides in the carry: W[t+16] = W[t] + s0(W[t+1]) +
+    W[t+9] + s1(W[t+14]). Semantically identical to the unrolled form; the
+    graph is ~100x smaller. XLA:CPU chokes for minutes on the unrolled
+    graph, so this is the CPU (test/virtual-mesh) form — TPU keeps the
+    unrolled one, where the fused round chain is the whole point.
     """
+
+    def rnd(carry, k):
+        a, b, c, d, e, f, g, h, win = carry
+        wt = win[0]
+        S1 = _rotr(e, 6) ^ _rotr(e, 11) ^ _rotr(e, 25)
+        ch = (e & f) ^ (~e & g)
+        t1 = h + S1 + ch + k + wt
+        S0 = _rotr(a, 2) ^ _rotr(a, 13) ^ _rotr(a, 22)
+        maj = (a & b) ^ (a & c) ^ (b & c)
+        s0 = _rotr(win[1], 7) ^ _rotr(win[1], 18) ^ (win[1] >> 3)
+        s1 = _rotr(win[14], 17) ^ _rotr(win[14], 19) ^ (win[14] >> 10)
+        wnext = win[0] + s0 + win[9] + s1
+        win = jnp.concatenate([win[1:], wnext[None]], axis=0)
+        return (t1 + S0 + maj, a, b, c, d + t1, e, f, g, win), None
+
+    init = tuple(state8[i] for i in range(8)) + (w16,)
+    (a, b, c, d, e, f, g, h, _), _ = jax.lax.scan(rnd, init, jnp.asarray(_K))
+    out = jnp.stack([a, b, c, d, e, f, g, h])
+    return state8 + out
+
+
+def sha256_pair_words_scan(words: jnp.ndarray) -> jnp.ndarray:
+    """Scan-form batch hash: uint32[N, 16] -> uint32[N, 8]."""
+    n = words.shape[0]
+    state = jnp.broadcast_to(jnp.asarray(_IV)[:, None], (8, n))
+    state = _compress_scan(state, words.T)
+    pad = jnp.broadcast_to(jnp.asarray(_PAD_BLOCK)[:, None], (16, n))
+    state = _compress_scan(state, pad)
+    return state.T
+
+
+def sha256_pair_words_unrolled(words: jnp.ndarray) -> jnp.ndarray:
+    """Unrolled batch hash: uint32[N, 16] -> uint32[N, 8]."""
     n = words.shape[0]
     w = [words[:, i] for i in range(16)]
     state = [jnp.broadcast_to(jnp.uint32(_IV[i]), (n,)) for i in range(8)]
@@ -92,6 +129,20 @@ def sha256_pair_words(words: jnp.ndarray) -> jnp.ndarray:
     pad = [jnp.broadcast_to(jnp.uint32(_PAD_BLOCK[i]), (n,)) for i in range(16)]
     state = _compress(state, pad)
     return jnp.stack(state, axis=-1)
+
+
+def sha256_pair_words(words: jnp.ndarray) -> jnp.ndarray:
+    """Hash a batch of 64-byte messages given as big-endian words.
+
+    words: uint32[N, 16] -> uint32[N, 8]. Jit-traceable (inline this into
+    larger fused kernels; for standalone use go through sha256_tiled).
+    Picks the graph shape per backend: fully unrolled rounds on
+    accelerators (XLA fuses the whole chain; scan carries round-trip HBM),
+    round-scan on CPU (the unrolled graph takes minutes in XLA:CPU).
+    """
+    if jax.default_backend() == "cpu":
+        return sha256_pair_words_scan(words)
+    return sha256_pair_words_unrolled(words)
 
 
 _kernel = jax.jit(sha256_pair_words)

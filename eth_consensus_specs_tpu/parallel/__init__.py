@@ -1,0 +1,42 @@
+"""Device-mesh parallelism.
+
+The reference is a single-process executable spec with no distributed
+backend at all (SURVEY §2.3: no NCCL/MPI/Gloo anywhere); its parallelism is
+*latent* — per-validator, per-signature and per-chunk independence. Here
+those latent axes become explicit mesh axes:
+
+  * ``dp`` — the validator registry: epoch accounting, shuffling, signature
+    batches shard their validator/attestation dimension here.
+  * ``sp`` — the chunk/sequence axis: SSZ merkle leaf levels and field-FFT
+    (KZG/DAS) vectors shard here.
+
+Collectives ride ICI via XLA (psum / all_gather inserted by the SPMD
+partitioner or written explicitly in shard_map kernels); multi-host scaling
+is the same code over a DCN-backed mesh through jax.distributed.
+"""
+
+from __future__ import annotations
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+DP_AXIS = "dp"
+SP_AXIS = "sp"
+
+
+def make_mesh(n_devices: int | None = None, devices=None) -> Mesh:
+    """A 2D (dp, sp) mesh over the first n devices: sp = 2 when the count
+    is even, else 1; dp takes the rest (the validator axis is the big one,
+    so dp dominates by construction)."""
+    if devices is None:
+        devices = jax.devices()
+    if n_devices is not None:
+        devices = devices[:n_devices]
+    n = len(devices)
+    sp = 1
+    if n % 2 == 0 and n >= 2:
+        sp = 2
+    dp = n // sp
+    grid = np.asarray(devices).reshape(dp, sp)
+    return Mesh(grid, (DP_AXIS, SP_AXIS))

@@ -1,0 +1,132 @@
+"""Sharded epoch accounting: the validator axis over the mesh, explicit SPMD.
+
+The columnar epoch kernel (ops/state_columns.py) is embarrassingly parallel
+over validators except for a handful of scalar reductions (total/attesting
+balances) and one scatter-add (proposer micro-rewards). This path runs the
+SAME kernel body under shard_map, swapping the two reduction primitives for
+collective-backed ones:
+
+  * sum        -> local jnp.sum + lax.psum over the mesh axes (ICI all-reduce
+                  of one u64 scalar);
+  * scatter_add -> each shard scatters its contributions into a dense
+                  global-length vector, one psum, then slices its own block
+                  (proposer targets are global indices: attester i's earliest
+                  includer can live on any shard).
+
+Explicit shard_map (not auto-partitioning with NamedSharding annotations)
+is deliberate: the u64 scatter under the SPMD partitioner sends XLA's
+algebraic simplifier into a non-terminating rewrite loop on the CPU backend,
+and on TPU the explicit form pins exactly the collectives we want — two
+psums per epoch, nothing speculative.
+
+Validator columns shard over BOTH mesh axes flattened (dp major, sp minor):
+epoch accounting wants every chip, not just the dp slice.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental.shard_map import shard_map
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from eth_consensus_specs_tpu.ops.state_columns import (
+    EpochColumns,
+    EpochParams,
+    EpochResult,
+    JustificationState,
+    epoch_accounting_impl,
+)
+
+from . import DP_AXIS, SP_AXIS
+
+_VALIDATOR_AXES = (DP_AXIS, SP_AXIS)
+
+
+class MeshReductions:
+    """psum-backed reduction primitives for the epoch kernel under shard_map."""
+
+    def __init__(self, mesh: Mesh, axes=_VALIDATOR_AXES):
+        self.axes = axes
+        self.n_shards = 1
+        for a in axes:
+            self.n_shards *= mesh.shape[a]
+        # dp-major linearized shard id, matching P((dp, sp)) block order
+        self.mesh = mesh
+
+    def _shard_id(self):
+        sid = lax.axis_index(self.axes[0])
+        for a in self.axes[1:]:
+            sid = sid * self.mesh.shape[a] + lax.axis_index(a)
+        return sid
+
+    def sum(self, x: jnp.ndarray) -> jnp.ndarray:
+        return lax.psum(jnp.sum(x), self.axes)
+
+    def scatter_add(self, idx: jnp.ndarray, amounts: jnp.ndarray, local_n: int) -> jnp.ndarray:
+        global_n = local_n * self.n_shards
+        dense = (
+            jnp.zeros(global_n, amounts.dtype)
+            .at[jnp.clip(idx, 0, global_n - 1)]
+            .add(amounts)
+        )
+        dense = lax.psum(dense, self.axes)
+        start = (self._shard_id() * local_n).astype(jnp.int32)
+        return lax.dynamic_slice(dense, (start,), (local_n,))
+
+
+def epoch_specs():
+    """(cols, just, result) PartitionSpec pytrees for shard_map."""
+    vec = P(_VALIDATOR_AXES)
+    rep = P()
+    cols = EpochColumns(*([vec] * len(EpochColumns._fields)))
+    just = JustificationState(*([rep] * len(JustificationState._fields)))
+    result = EpochResult(
+        balance=vec,
+        effective_balance=vec,
+        justification_bits=rep,
+        prev_justified_epoch=rep,
+        prev_justified_root=rep,
+        cur_justified_epoch=rep,
+        cur_justified_root=rep,
+        finalized_epoch=rep,
+        finalized_root=rep,
+        rewards=vec,
+        penalties=vec,
+    )
+    return cols, just, result
+
+
+def sharded_epoch_fn(mesh: Mesh, params: EpochParams):
+    """Traceable shard_map fn: (EpochColumns, JustificationState) ->
+    EpochResult, validator columns sharded over all chips, scalars
+    replicated. Global validator count must divide by the chip count."""
+    cols_spec, just_spec, res_spec = epoch_specs()
+    red = MeshReductions(mesh)
+
+    def local(cols, just):
+        return epoch_accounting_impl(params, cols, just, red)
+
+    return shard_map(
+        local,
+        mesh=mesh,
+        in_specs=(cols_spec, just_spec),
+        out_specs=res_spec,
+        check_rep=False,
+    )
+
+
+def make_sharded_epoch_fn(mesh: Mesh, params: EpochParams):
+    """Jitted sharded epoch with explicit input/output placements."""
+    cols_spec, just_spec, res_spec = epoch_specs()
+    to_sh = lambda tree: jax.tree_util.tree_map(
+        lambda s: NamedSharding(mesh, s), tree, is_leaf=lambda x: isinstance(x, P)
+    )
+    return jax.jit(
+        sharded_epoch_fn(mesh, params),
+        in_shardings=(to_sh(cols_spec), to_sh(just_spec)),
+        out_shardings=to_sh(res_spec),
+    )

@@ -11,10 +11,17 @@ import os
 _enabled = False
 
 
-def enable_persistent_cache(cache_dir: str | None = None) -> str:
+def enable_persistent_cache(cache_dir: str | None = None) -> str | None:
+    """Accelerator backends only. XLA:CPU cache entries are AOT executables
+    pinned to the compiling host's machine features (avx512 etc.); loading
+    one on a different CPU is accepted with a warning and then executes
+    garbage (observed: infinite hang). TPU executables are
+    topology-portable, and that's also where recompiles actually hurt."""
     global _enabled
     import jax
 
+    if jax.default_backend() == "cpu":
+        return None
     if cache_dir is None:
         repo_root = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
         cache_dir = os.path.join(repo_root, ".jax_cache")

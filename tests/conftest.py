@@ -9,6 +9,12 @@ _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
 
+# The axon sitecustomize pins jax_platforms programmatically (config beats
+# env), so force the config back to cpu before any backend initializes.
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
 from eth_consensus_specs_tpu.utils.cache import enable_persistent_cache
 
 enable_persistent_cache()

@@ -1,0 +1,95 @@
+"""Parity: the fused columnar epoch kernel (ops/state_columns.py) must be
+bit-exact with the object-path process_epoch across participation, leak,
+slashing-sweep and genesis scenarios. Equality is asserted on the full
+post-state hash_tree_root, so every mutated field is covered."""
+
+from eth_consensus_specs_tpu.ssz import hash_tree_root
+from eth_consensus_specs_tpu.test_infra.attestations import next_epoch_with_attestations
+from eth_consensus_specs_tpu.test_infra.context import spec_state_test, with_all_phases
+from eth_consensus_specs_tpu.test_infra.state import next_epoch
+
+
+def assert_columnar_parity(spec, state):
+    """Advance to the epoch's final slot, run both epoch paths on copies,
+    compare full post-state roots."""
+    boundary = int(state.slot) + (
+        spec.SLOTS_PER_EPOCH - int(state.slot) % spec.SLOTS_PER_EPOCH
+    )
+    if int(state.slot) < boundary - 1:
+        spec.process_slots(state, boundary - 1)
+    obj_state = state.copy()
+    col_state = state.copy()
+    spec.process_epoch(obj_state)
+    spec.process_epoch_columnar(col_state)
+    assert hash_tree_root(obj_state) == hash_tree_root(col_state)
+
+
+@with_all_phases
+@spec_state_test
+def test_columnar_genesis_epoch(spec, state):
+    # epoch 0: justification and rewards both skipped; resets still run
+    assert_columnar_parity(spec, state)
+
+
+@with_all_phases
+@spec_state_test
+def test_columnar_full_participation(spec, state):
+    next_epoch_with_attestations(spec, state, fill_cur_epoch=False, fill_prev_epoch=True)
+    next_epoch_with_attestations(spec, state, fill_cur_epoch=True, fill_prev_epoch=True)
+    assert_columnar_parity(spec, state)
+
+
+@with_all_phases
+@spec_state_test
+def test_columnar_partial_participation(spec, state):
+    next_epoch_with_attestations(spec, state, fill_cur_epoch=False, fill_prev_epoch=True)
+    # thin out: drop every third attestation from the pending queues
+    state.previous_epoch_attestations = type(state.previous_epoch_attestations)(
+        [a for i, a in enumerate(state.previous_epoch_attestations) if i % 3 != 0]
+    )
+    state.current_epoch_attestations = type(state.current_epoch_attestations)(
+        [a for i, a in enumerate(state.current_epoch_attestations) if i % 3 != 1]
+    )
+    assert_columnar_parity(spec, state)
+
+
+@with_all_phases
+@spec_state_test
+def test_columnar_inactivity_leak(spec, state):
+    # empty epochs past MIN_EPOCHS_TO_INACTIVITY_PENALTY: leak active
+    for _ in range(spec.MIN_EPOCHS_TO_INACTIVITY_PENALTY + 3):
+        next_epoch(spec, state)
+    assert spec.is_in_inactivity_leak(state)
+    assert_columnar_parity(spec, state)
+
+
+@with_all_phases
+@spec_state_test
+def test_columnar_slashings_window(spec, state):
+    # craft validators inside the correlated-slashing penalty window
+    next_epoch(spec, state)
+    next_epoch(spec, state)
+    current_epoch = spec.get_current_epoch(state)
+    for index in (0, 2, 5):
+        validator = state.validators[index]
+        validator.slashed = True
+        validator.exit_epoch = current_epoch
+        validator.withdrawable_epoch = current_epoch + spec.EPOCHS_PER_SLASHINGS_VECTOR // 2
+        state.slashings[current_epoch % spec.EPOCHS_PER_SLASHINGS_VECTOR] = (
+            int(state.slashings[current_epoch % spec.EPOCHS_PER_SLASHINGS_VECTOR])
+            + int(validator.effective_balance)
+        )
+    assert_columnar_parity(spec, state)
+
+
+@with_all_phases
+@spec_state_test
+def test_columnar_mixed_registry(spec, state):
+    # ejections + activation queue + an exited validator, with attestations
+    next_epoch_with_attestations(spec, state, fill_cur_epoch=False, fill_prev_epoch=True)
+    state.validators[1].effective_balance = spec.config.EJECTION_BALANCE
+    state.validators[3].activation_epoch = spec.FAR_FUTURE_EPOCH
+    state.validators[3].activation_eligibility_epoch = spec.get_current_epoch(state)
+    state.validators[4].exit_epoch = spec.get_current_epoch(state)
+    state.validators[4].withdrawable_epoch = spec.get_current_epoch(state) + 2
+    assert_columnar_parity(spec, state)
