@@ -1,0 +1,672 @@
+"""altair: sync committees, participation-flag incentive accounting,
+inactivity scores, and the first hard-fork upgrade path.
+
+Behavioral parity targets (reference, by section):
+  * state machine:  specs/altair/beacon-chain.md (process_sync_aggregate
+    :575, modified process_attestation :509, flag deltas :398,
+    inactivity updates :687, sync committee updates :771)
+  * BLS extensions: specs/altair/bls.md (eth_aggregate_pubkeys :36,
+    eth_fast_aggregate_verify :58)
+  * fork upgrade:   specs/altair/fork.md (upgrade_to_altair,
+    translate_participation)
+
+Architecture notes:
+  * Participation is a columnar uint8 flag vector per epoch — ALREADY the
+    TPU layout: the altair epoch kernel (flag deltas, inactivity) consumes
+    it directly with no committee re-resolution, unlike phase0 where
+    pending attestations must be re-reduced to masks each epoch.
+  * The sync-aggregate fast path keeps the spec's subtract-non-participants
+    trick (majority case: one aggregate key minus the absentees) — the
+    G1-sum shape that ops/bls_batch batches.
+"""
+
+from eth_consensus_specs_tpu.ssz import (
+    Bitvector,
+    Bytes32,
+    Container,
+    List,
+    Vector,
+    hash_tree_root,
+    uint8,
+    uint64,
+)
+from eth_consensus_specs_tpu.utils import bls
+
+from .phase0 import (
+    BLSPubkey,
+    BLSSignature,
+    Domain,
+    DomainType,
+    Epoch,
+    Gwei,
+    Phase0Spec,
+    Root,
+    Slot,
+    ValidatorIndex,
+    Version,
+)
+
+ParticipationFlags = uint8
+
+
+class AltairSpec(Phase0Spec):
+    fork_name = "altair"
+
+    # -- participation flag indices (beacon-chain.md constants) ------------
+    TIMELY_SOURCE_FLAG_INDEX = 0
+    TIMELY_TARGET_FLAG_INDEX = 1
+    TIMELY_HEAD_FLAG_INDEX = 2
+
+    # -- incentivization weights -------------------------------------------
+    TIMELY_SOURCE_WEIGHT = 14
+    TIMELY_TARGET_WEIGHT = 26
+    TIMELY_HEAD_WEIGHT = 14
+    SYNC_REWARD_WEIGHT = 2
+    PROPOSER_WEIGHT = 8
+    WEIGHT_DENOMINATOR = 64
+
+    DOMAIN_SYNC_COMMITTEE = DomainType(b"\x07\x00\x00\x00")
+    DOMAIN_SYNC_COMMITTEE_SELECTION_PROOF = DomainType(b"\x08\x00\x00\x00")
+    DOMAIN_CONTRIBUTION_AND_PROOF = DomainType(b"\x09\x00\x00\x00")
+
+    G2_POINT_AT_INFINITY = bls.G2_POINT_AT_INFINITY
+
+    # honest-validator constants (specs/altair/validator.md)
+    TARGET_AGGREGATORS_PER_SYNC_SUBCOMMITTEE = 16
+    SYNC_COMMITTEE_SUBNET_COUNT = 4
+
+    @property
+    def PARTICIPATION_FLAG_WEIGHTS(self):
+        return [self.TIMELY_SOURCE_WEIGHT, self.TIMELY_TARGET_WEIGHT, self.TIMELY_HEAD_WEIGHT]
+
+    # == type system ======================================================
+
+    def _build_types(self) -> None:
+        super()._build_types()
+        P = self
+
+        class SyncAggregate(Container):
+            sync_committee_bits: Bitvector[P.SYNC_COMMITTEE_SIZE]
+            sync_committee_signature: BLSSignature
+
+        class SyncCommittee(Container):
+            pubkeys: Vector[BLSPubkey, P.SYNC_COMMITTEE_SIZE]
+            aggregate_pubkey: BLSPubkey
+
+        class BeaconBlockBody(Container):
+            randao_reveal: BLSSignature
+            eth1_data: P.Eth1Data
+            graffiti: Bytes32
+            proposer_slashings: List[P.ProposerSlashing, P.MAX_PROPOSER_SLASHINGS]
+            attester_slashings: List[P.AttesterSlashing, P.MAX_ATTESTER_SLASHINGS]
+            attestations: List[P.Attestation, P.MAX_ATTESTATIONS]
+            deposits: List[P.Deposit, P.MAX_DEPOSITS]
+            voluntary_exits: List[P.SignedVoluntaryExit, P.MAX_VOLUNTARY_EXITS]
+            sync_aggregate: SyncAggregate  # [New in Altair]
+
+        class BeaconBlock(Container):
+            slot: Slot
+            proposer_index: ValidatorIndex
+            parent_root: Root
+            state_root: Root
+            body: BeaconBlockBody
+
+        class SignedBeaconBlock(Container):
+            message: BeaconBlock
+            signature: BLSSignature
+
+        class BeaconState(Container):
+            genesis_time: uint64
+            genesis_validators_root: Root
+            slot: Slot
+            fork: P.Fork
+            latest_block_header: P.BeaconBlockHeader
+            block_roots: Vector[Root, P.SLOTS_PER_HISTORICAL_ROOT]
+            state_roots: Vector[Root, P.SLOTS_PER_HISTORICAL_ROOT]
+            historical_roots: List[Root, P.HISTORICAL_ROOTS_LIMIT]
+            eth1_data: P.Eth1Data
+            eth1_data_votes: List[P.Eth1Data, P.EPOCHS_PER_ETH1_VOTING_PERIOD * P.SLOTS_PER_EPOCH]
+            eth1_deposit_index: uint64
+            validators: List[P.Validator, P.VALIDATOR_REGISTRY_LIMIT]
+            balances: List[Gwei, P.VALIDATOR_REGISTRY_LIMIT]
+            randao_mixes: Vector[Bytes32, P.EPOCHS_PER_HISTORICAL_VECTOR]
+            slashings: Vector[Gwei, P.EPOCHS_PER_SLASHINGS_VECTOR]
+            previous_epoch_participation: List[ParticipationFlags, P.VALIDATOR_REGISTRY_LIMIT]
+            current_epoch_participation: List[ParticipationFlags, P.VALIDATOR_REGISTRY_LIMIT]
+            justification_bits: Bitvector[self.JUSTIFICATION_BITS_LENGTH]
+            previous_justified_checkpoint: P.Checkpoint
+            current_justified_checkpoint: P.Checkpoint
+            finalized_checkpoint: P.Checkpoint
+            inactivity_scores: List[uint64, P.VALIDATOR_REGISTRY_LIMIT]
+            current_sync_committee: SyncCommittee
+            next_sync_committee: SyncCommittee
+
+        # honest-validator containers (specs/altair/validator.md)
+        class SyncCommitteeMessage(Container):
+            slot: Slot
+            beacon_block_root: Root
+            validator_index: ValidatorIndex
+            signature: BLSSignature
+
+        class SyncCommitteeContribution(Container):
+            slot: Slot
+            beacon_block_root: Root
+            subcommittee_index: uint64
+            aggregation_bits: Bitvector[P.SYNC_COMMITTEE_SIZE // 4]
+            signature: BLSSignature
+
+        class ContributionAndProof(Container):
+            aggregator_index: ValidatorIndex
+            contribution: SyncCommitteeContribution
+            selection_proof: BLSSignature
+
+        class SignedContributionAndProof(Container):
+            message: ContributionAndProof
+            signature: BLSSignature
+
+        class SyncAggregatorSelectionData(Container):
+            slot: Slot
+            subcommittee_index: uint64
+
+        for name, typ in list(locals().items()):
+            if isinstance(typ, type) and issubclass(typ, Container):
+                typ.__name__ = name
+                setattr(self, name, typ)
+
+    # == BLS extensions (specs/altair/bls.md) ==============================
+
+    def eth_aggregate_pubkeys(self, pubkeys) -> bytes:
+        """Elliptic-curve sum of pubkeys (always real group math — the
+        result lands in state as SyncCommittee.aggregate_pubkey, so it must
+        be deterministic regardless of the bls_active test switch)."""
+        assert len(pubkeys) > 0
+        from eth_consensus_specs_tpu.crypto.curve import g1_from_bytes, g1_to_bytes
+
+        acc = None
+        for pk in pubkeys:
+            p = g1_from_bytes(bytes(pk))  # raises on invalid encodings
+            if p.is_infinity():
+                raise AssertionError("identity pubkey is not a valid key")
+            acc = p if acc is None else acc + p
+        return BLSPubkey(g1_to_bytes(acc))
+
+    def eth_fast_aggregate_verify(self, pubkeys, message, signature) -> bool:
+        if len(pubkeys) == 0 and bytes(signature) == self.G2_POINT_AT_INFINITY:
+            return True
+        return bls.FastAggregateVerify(pubkeys, message, signature)
+
+    # == misc helpers ======================================================
+
+    @staticmethod
+    def add_flag(flags: int, flag_index: int) -> int:
+        return int(flags) | (1 << flag_index)
+
+    @staticmethod
+    def has_flag(flags: int, flag_index: int) -> bool:
+        flag = 1 << flag_index
+        return int(flags) & flag == flag
+
+    def get_index_for_new_validator(self, state) -> int:
+        return len(state.validators)
+
+    @staticmethod
+    def set_or_append_list(lst, index: int, value) -> None:
+        if index == len(lst):
+            lst.append(value)
+        else:
+            lst[index] = value
+
+    def add_validator_to_registry(self, state, pubkey, withdrawal_credentials, amount) -> None:
+        index = self.get_index_for_new_validator(state)
+        validator = self.get_validator_from_deposit(pubkey, withdrawal_credentials, amount)
+        self.set_or_append_list(state.validators, index, validator)
+        self.set_or_append_list(state.balances, index, amount)
+        self.set_or_append_list(state.previous_epoch_participation, index, 0)
+        self.set_or_append_list(state.current_epoch_participation, index, 0)
+        self.set_or_append_list(state.inactivity_scores, index, 0)
+
+    # == sync committee accessors ==========================================
+
+    def get_next_sync_committee_indices(self, state):
+        """Sync committee sampling (with duplicates): shuffled candidate
+        stream filtered by the effective-balance acceptance test
+        (reference: specs/altair/beacon-chain.md:265-291)."""
+        epoch = self.get_current_epoch(state) + 1
+        MAX_RANDOM_BYTE = 2**8 - 1
+        active = self.get_active_validator_indices(state, epoch)
+        n = len(active)
+        seed = self.get_seed(state, epoch, self.DOMAIN_SYNC_COMMITTEE)
+        perm = self._shuffle_permutation(n, seed)
+        out = []
+        i = 0
+        while len(out) < self.SYNC_COMMITTEE_SIZE:
+            candidate = active[int(perm[i % n])]
+            random_byte = self.hash(seed + self.uint_to_bytes(uint64(i // 32)))[i % 32]
+            effective_balance = int(state.validators[candidate].effective_balance)
+            if effective_balance * MAX_RANDOM_BYTE >= self.MAX_EFFECTIVE_BALANCE * random_byte:
+                out.append(candidate)
+            i += 1
+        return out
+
+    def get_next_sync_committee(self, state):
+        indices = self.get_next_sync_committee_indices(state)
+        pubkeys = [state.validators[index].pubkey for index in indices]
+        aggregate_pubkey = self.eth_aggregate_pubkeys(pubkeys)
+        return self.SyncCommittee(pubkeys=pubkeys, aggregate_pubkey=aggregate_pubkey)
+
+    # == incentive accounting ==============================================
+
+    def get_base_reward_per_increment(self, state) -> int:
+        return (
+            self.EFFECTIVE_BALANCE_INCREMENT
+            * self.BASE_REWARD_FACTOR
+            // self.integer_squareroot(self.get_total_active_balance(state))
+        )
+
+    def get_base_reward(self, state, index: int) -> int:
+        increments = (
+            int(state.validators[int(index)].effective_balance)
+            // self.EFFECTIVE_BALANCE_INCREMENT
+        )
+        return increments * self.get_base_reward_per_increment(state)
+
+    def get_unslashed_participating_indices(self, state, flag_index: int, epoch: int):
+        assert epoch in (self.get_previous_epoch(state), self.get_current_epoch(state))
+        if epoch == self.get_current_epoch(state):
+            epoch_participation = state.current_epoch_participation
+        else:
+            epoch_participation = state.previous_epoch_participation
+        return {
+            i
+            for i in self.get_active_validator_indices(state, epoch)
+            if self.has_flag(epoch_participation[i], flag_index)
+            and not state.validators[i].slashed
+        }
+
+    def get_attestation_participation_flag_indices(self, state, data, inclusion_delay: int):
+        if data.target.epoch == self.get_current_epoch(state):
+            justified_checkpoint = state.current_justified_checkpoint
+        else:
+            justified_checkpoint = state.previous_justified_checkpoint
+        is_matching_source = data.source == justified_checkpoint
+        is_matching_target = (
+            is_matching_source and data.target.root == self.get_block_root(state, data.target.epoch)
+        )
+        is_matching_head = (
+            is_matching_target
+            and data.beacon_block_root == self.get_block_root_at_slot(state, data.slot)
+        )
+        assert is_matching_source, "attestation source does not match justified checkpoint"
+
+        participation_flag_indices = []
+        if is_matching_source and inclusion_delay <= self.integer_squareroot(self.SLOTS_PER_EPOCH):
+            participation_flag_indices.append(self.TIMELY_SOURCE_FLAG_INDEX)
+        if is_matching_target and inclusion_delay <= self.SLOTS_PER_EPOCH:
+            participation_flag_indices.append(self.TIMELY_TARGET_FLAG_INDEX)
+        if is_matching_head and inclusion_delay == self.MIN_ATTESTATION_INCLUSION_DELAY:
+            participation_flag_indices.append(self.TIMELY_HEAD_FLAG_INDEX)
+        return participation_flag_indices
+
+    def get_flag_index_deltas(self, state, flag_index: int):
+        rewards = [0] * len(state.validators)
+        penalties = [0] * len(state.validators)
+        previous_epoch = self.get_previous_epoch(state)
+        unslashed_participating_indices = self.get_unslashed_participating_indices(
+            state, flag_index, previous_epoch
+        )
+        weight = self.PARTICIPATION_FLAG_WEIGHTS[flag_index]
+        unslashed_participating_balance = self.get_total_balance(
+            state, unslashed_participating_indices
+        )
+        unslashed_participating_increments = (
+            unslashed_participating_balance // self.EFFECTIVE_BALANCE_INCREMENT
+        )
+        active_increments = (
+            self.get_total_active_balance(state) // self.EFFECTIVE_BALANCE_INCREMENT
+        )
+        for index in self.get_eligible_validator_indices(state):
+            base_reward = self.get_base_reward(state, index)
+            if index in unslashed_participating_indices:
+                if not self.is_in_inactivity_leak(state):
+                    reward_numerator = base_reward * weight * unslashed_participating_increments
+                    rewards[index] += reward_numerator // (
+                        active_increments * self.WEIGHT_DENOMINATOR
+                    )
+            elif flag_index != self.TIMELY_HEAD_FLAG_INDEX:
+                penalties[index] += base_reward * weight // self.WEIGHT_DENOMINATOR
+        return rewards, penalties
+
+    def get_inactivity_penalty_deltas(self, state):
+        rewards = [0] * len(state.validators)
+        penalties = [0] * len(state.validators)
+        previous_epoch = self.get_previous_epoch(state)
+        matching_target_indices = self.get_unslashed_participating_indices(
+            state, self.TIMELY_TARGET_FLAG_INDEX, previous_epoch
+        )
+        for index in self.get_eligible_validator_indices(state):
+            if index not in matching_target_indices:
+                penalty_numerator = int(state.validators[index].effective_balance) * int(
+                    state.inactivity_scores[index]
+                )
+                penalty_denominator = (
+                    self.config.INACTIVITY_SCORE_BIAS * self.INACTIVITY_PENALTY_QUOTIENT_ALTAIR
+                )
+                penalties[index] += penalty_numerator // penalty_denominator
+        return rewards, penalties
+
+    # == mutators ==========================================================
+    # slash_validator itself is inherited; altair only re-points its knobs
+    # (reference: specs/altair/beacon-chain.md:455-488)
+
+    def min_slashing_penalty_quotient(self) -> int:
+        return self.MIN_SLASHING_PENALTY_QUOTIENT_ALTAIR
+
+    def proportional_slashing_multiplier(self) -> int:
+        return self.PROPORTIONAL_SLASHING_MULTIPLIER_ALTAIR
+
+    def whistleblower_proposer_reward(self, whistleblower_reward: int) -> int:
+        return whistleblower_reward * self.PROPOSER_WEIGHT // self.WEIGHT_DENOMINATOR
+
+    # == block processing ==================================================
+
+    def process_block(self, state, block) -> None:
+        self.process_block_header(state, block)
+        self.process_randao(state, block.body)
+        self.process_eth1_data(state, block.body)
+        self.process_operations(state, block.body)
+        self.process_sync_aggregate(state, block.body.sync_aggregate)
+
+    def process_attestation(self, state, attestation) -> None:
+        data = attestation.data
+        assert data.target.epoch in (
+            self.get_previous_epoch(state),
+            self.get_current_epoch(state),
+        ), "target epoch out of range"
+        assert data.target.epoch == self.compute_epoch_at_slot(data.slot), "target/slot mismatch"
+        assert (
+            int(data.slot) + self.MIN_ATTESTATION_INCLUSION_DELAY
+            <= state.slot
+            <= int(data.slot) + self.SLOTS_PER_EPOCH
+        ), "attestation outside inclusion window"
+        assert data.index < self.get_committee_count_per_slot(state, data.target.epoch)
+
+        committee = self.get_beacon_committee(state, data.slot, data.index)
+        assert len(attestation.aggregation_bits) == len(committee), "bitlist length mismatch"
+
+        participation_flag_indices = self.get_attestation_participation_flag_indices(
+            state, data, int(state.slot) - int(data.slot)
+        )
+
+        assert self.is_valid_indexed_attestation(
+            state, self.get_indexed_attestation(state, attestation)
+        ), "invalid aggregate signature"
+
+        if data.target.epoch == self.get_current_epoch(state):
+            epoch_participation = state.current_epoch_participation
+        else:
+            epoch_participation = state.previous_epoch_participation
+
+        proposer_reward_numerator = 0
+        for index in self.get_attesting_indices(state, attestation):
+            for flag_index, weight in enumerate(self.PARTICIPATION_FLAG_WEIGHTS):
+                if flag_index in participation_flag_indices and not self.has_flag(
+                    epoch_participation[index], flag_index
+                ):
+                    epoch_participation[index] = self.add_flag(
+                        epoch_participation[index], flag_index
+                    )
+                    proposer_reward_numerator += self.get_base_reward(state, index) * weight
+
+        proposer_reward_denominator = (
+            (self.WEIGHT_DENOMINATOR - self.PROPOSER_WEIGHT)
+            * self.WEIGHT_DENOMINATOR
+            // self.PROPOSER_WEIGHT
+        )
+        proposer_reward = proposer_reward_numerator // proposer_reward_denominator
+        self.increase_balance(state, self.get_beacon_proposer_index(state), proposer_reward)
+
+    def process_sync_aggregate(self, state, sync_aggregate) -> None:
+        """Verify + reward the per-slot sync committee vote (reference:
+        specs/altair/beacon-chain.md:575-650). The majority fast path keeps
+        one G1 subtraction instead of up to SYNC_COMMITTEE_SIZE additions."""
+        committee_pubkeys = state.current_sync_committee.pubkeys
+        committee_bits = list(sync_aggregate.sync_committee_bits)
+        participating = sum(1 for b in committee_bits if b)
+        if bls.bls_active:  # aggregation + verify elided entirely in stub mode
+            if participating == self.SYNC_COMMITTEE_SIZE:
+                participant_pubkeys = [state.current_sync_committee.aggregate_pubkey]
+            elif participating > self.SYNC_COMMITTEE_SIZE // 2:
+                non_participant_pubkeys = [
+                    pk for pk, bit in zip(committee_pubkeys, committee_bits) if not bit
+                ]
+                non_participant_aggregate = self.eth_aggregate_pubkeys(non_participant_pubkeys)
+                participant_point = bls.add(
+                    bls.pubkey_to_G1(state.current_sync_committee.aggregate_pubkey),
+                    bls.neg(bls.pubkey_to_G1(non_participant_aggregate)),
+                )
+                participant_pubkeys = [BLSPubkey(bls.G1_to_pubkey(participant_point))]
+            else:
+                participant_pubkeys = [
+                    pk for pk, bit in zip(committee_pubkeys, committee_bits) if bit
+                ]
+            previous_slot = max(int(state.slot), 1) - 1
+            domain = self.get_domain(
+                state, self.DOMAIN_SYNC_COMMITTEE, self.compute_epoch_at_slot(previous_slot)
+            )
+            signing_root = self.compute_signing_root(
+                Root(self.get_block_root_at_slot(state, previous_slot)), domain
+            )
+            assert self.eth_fast_aggregate_verify(
+                participant_pubkeys, signing_root, sync_aggregate.sync_committee_signature
+            ), "invalid sync committee signature"
+
+        total_active_increments = (
+            self.get_total_active_balance(state) // self.EFFECTIVE_BALANCE_INCREMENT
+        )
+        total_base_rewards = self.get_base_reward_per_increment(state) * total_active_increments
+        max_participant_rewards = (
+            total_base_rewards * self.SYNC_REWARD_WEIGHT
+            // self.WEIGHT_DENOMINATOR
+            // self.SLOTS_PER_EPOCH
+        )
+        participant_reward = max_participant_rewards // self.SYNC_COMMITTEE_SIZE
+        proposer_reward = (
+            participant_reward * self.PROPOSER_WEIGHT
+            // (self.WEIGHT_DENOMINATOR - self.PROPOSER_WEIGHT)
+        )
+
+        all_pubkeys = [v.pubkey for v in state.validators]
+        committee_indices = [
+            all_pubkeys.index(pubkey) for pubkey in state.current_sync_committee.pubkeys
+        ]
+        proposer_index = self.get_beacon_proposer_index(state)
+        for participant_index, participation_bit in zip(committee_indices, committee_bits):
+            if participation_bit:
+                self.increase_balance(state, participant_index, participant_reward)
+                self.increase_balance(state, proposer_index, proposer_reward)
+            else:
+                self.decrease_balance(state, participant_index, participant_reward)
+
+    # == epoch processing ==================================================
+
+    def process_epoch(self, state) -> None:
+        self.process_justification_and_finalization(state)
+        self.process_inactivity_updates(state)
+        self.process_rewards_and_penalties(state)
+        self.process_registry_updates(state)
+        self.process_slashings(state)
+        self.process_eth1_data_reset(state)
+        self.process_effective_balance_updates(state)
+        self._process_epoch_resets(state)
+
+    def process_epoch_columnar(self, state) -> None:
+        # the phase0 columnar kernel reads pending attestations; the altair
+        # flag-delta kernel is a separate (simpler) fusion, not yet built
+        raise NotImplementedError("columnar epoch kernel for altair lands with ops/flag_deltas")
+
+    def process_justification_and_finalization(self, state) -> None:
+        if self.get_current_epoch(state) <= self.GENESIS_EPOCH + 1:
+            return
+        previous_indices = self.get_unslashed_participating_indices(
+            state, self.TIMELY_TARGET_FLAG_INDEX, self.get_previous_epoch(state)
+        )
+        current_indices = self.get_unslashed_participating_indices(
+            state, self.TIMELY_TARGET_FLAG_INDEX, self.get_current_epoch(state)
+        )
+        total_active_balance = self.get_total_active_balance(state)
+        previous_target_balance = self.get_total_balance(state, previous_indices)
+        current_target_balance = self.get_total_balance(state, current_indices)
+        self.weigh_justification_and_finalization(
+            state, total_active_balance, previous_target_balance, current_target_balance
+        )
+
+    def process_inactivity_updates(self, state) -> None:
+        if self.get_current_epoch(state) == self.GENESIS_EPOCH:
+            return
+        participating = self.get_unslashed_participating_indices(
+            state, self.TIMELY_TARGET_FLAG_INDEX, self.get_previous_epoch(state)
+        )
+        leak_free = not self.is_in_inactivity_leak(state)
+        for index in self.get_eligible_validator_indices(state):
+            score = int(state.inactivity_scores[index])
+            if index in participating:
+                score -= min(1, score)
+            else:
+                score += self.config.INACTIVITY_SCORE_BIAS
+            if leak_free:
+                score -= min(self.config.INACTIVITY_SCORE_RECOVERY_RATE, score)
+            state.inactivity_scores[index] = score
+
+    def process_rewards_and_penalties(self, state) -> None:
+        if self.get_current_epoch(state) == self.GENESIS_EPOCH:
+            return
+        flag_deltas = [
+            self.get_flag_index_deltas(state, flag_index)
+            for flag_index in range(len(self.PARTICIPATION_FLAG_WEIGHTS))
+        ]
+        deltas = flag_deltas + [self.get_inactivity_penalty_deltas(state)]
+        for rewards, penalties in deltas:
+            for index in range(len(state.validators)):
+                self.increase_balance(state, index, rewards[index])
+                self.decrease_balance(state, index, penalties[index])
+
+    def process_slashings(self, state) -> None:
+        epoch = self.get_current_epoch(state)
+        total_balance = self.get_total_active_balance(state)
+        adjusted_total_slashing_balance = min(
+            sum(int(s) for s in state.slashings) * self.proportional_slashing_multiplier(),
+            total_balance,
+        )
+        for index, validator in enumerate(state.validators):
+            if (
+                validator.slashed
+                and epoch + self.EPOCHS_PER_SLASHINGS_VECTOR // 2 == validator.withdrawable_epoch
+            ):
+                increment = self.EFFECTIVE_BALANCE_INCREMENT
+                penalty_numerator = (
+                    int(validator.effective_balance) // increment * adjusted_total_slashing_balance
+                )
+                penalty = penalty_numerator // total_balance * increment
+                self.decrease_balance(state, index, penalty)
+
+    def process_participation_flag_updates(self, state) -> None:
+        state.previous_epoch_participation = state.current_epoch_participation
+        state.current_epoch_participation = self.BeaconState.fields()[
+            "current_epoch_participation"
+        ]([0] * len(state.validators))
+
+    def process_sync_committee_updates(self, state) -> None:
+        next_epoch = self.get_current_epoch(state) + 1
+        if next_epoch % self.EPOCHS_PER_SYNC_COMMITTEE_PERIOD == 0:
+            state.current_sync_committee = state.next_sync_committee
+            state.next_sync_committee = self.get_next_sync_committee(state)
+
+    # phase0's pending-attestation resets do not exist here
+    def process_participation_record_updates(self, state) -> None:  # pragma: no cover
+        raise NotImplementedError("phase0-only; altair uses participation flags")
+
+    def _process_epoch_resets(self, state) -> None:
+        # altair re-sequences the tail (participation flags + sync committee
+        # replace phase0's pending-attestation reset); keep the shared-name
+        # hook coherent for anything driving the pipeline generically
+        self.process_slashings_reset(state)
+        self.process_randao_mixes_reset(state)
+        self.process_historical_roots_update(state)
+        self.process_participation_flag_updates(state)
+        self.process_sync_committee_updates(state)
+
+    # == genesis ===========================================================
+
+    def initialize_beacon_state_from_eth1(self, eth1_block_hash, eth1_timestamp, deposits):
+        state = super().initialize_beacon_state_from_eth1(
+            eth1_block_hash, eth1_timestamp, deposits
+        )
+        # pure-altair genesis fills both sync committees
+        state.current_sync_committee = self.get_next_sync_committee(state)
+        state.next_sync_committee = self.get_next_sync_committee(state)
+        state.fork = self.Fork(
+            previous_version=Version(self.config.ALTAIR_FORK_VERSION),
+            current_version=Version(self.config.ALTAIR_FORK_VERSION),
+            epoch=self.GENESIS_EPOCH,
+        )
+        return state
+
+    # == fork upgrade (specs/altair/fork.md) ===============================
+
+    def translate_participation(self, state, pending_attestations) -> None:
+        for attestation in pending_attestations:
+            data = attestation.data
+            inclusion_delay = int(attestation.inclusion_delay)
+            participation_flag_indices = self.get_attestation_participation_flag_indices(
+                state, data, inclusion_delay
+            )
+            epoch_participation = state.previous_epoch_participation
+            for index in self.get_attesting_indices_from_data(
+                state, data, attestation.aggregation_bits
+            ):
+                for flag_index in participation_flag_indices:
+                    epoch_participation[index] = self.add_flag(
+                        epoch_participation[index], flag_index
+                    )
+
+    def upgrade_from_parent(self, pre):
+        """upgrade_to_altair: carry the phase0 state across the fork
+        boundary, translating pending attestations into participation flags
+        and seeding both sync committees. Field-name-matched containers
+        cross-coerce between the per-fork type families."""
+        epoch = self.compute_epoch_at_slot(int(pre.slot))
+        post = self.BeaconState(
+            genesis_time=pre.genesis_time,
+            genesis_validators_root=pre.genesis_validators_root,
+            slot=pre.slot,
+            fork=self.Fork(
+                previous_version=pre.fork.current_version,
+                current_version=Version(self.config.ALTAIR_FORK_VERSION),
+                epoch=epoch,
+            ),
+            latest_block_header=pre.latest_block_header,
+            block_roots=list(pre.block_roots),
+            state_roots=list(pre.state_roots),
+            historical_roots=list(pre.historical_roots),
+            eth1_data=pre.eth1_data,
+            eth1_data_votes=list(pre.eth1_data_votes),
+            eth1_deposit_index=pre.eth1_deposit_index,
+            validators=list(pre.validators),
+            balances=list(pre.balances),
+            randao_mixes=list(pre.randao_mixes),
+            slashings=list(pre.slashings),
+            previous_epoch_participation=[0] * len(pre.validators),
+            current_epoch_participation=[0] * len(pre.validators),
+            justification_bits=list(pre.justification_bits),
+            previous_justified_checkpoint=pre.previous_justified_checkpoint,
+            current_justified_checkpoint=pre.current_justified_checkpoint,
+            finalized_checkpoint=pre.finalized_checkpoint,
+            inactivity_scores=[0] * len(pre.validators),
+        )
+        self.translate_participation(post, pre.previous_epoch_attestations)
+        # duplicate committee at the boundary; state unchanged between the
+        # two fields, so compute once
+        committee = self.get_next_sync_committee(post)
+        post.current_sync_committee = committee
+        post.next_sync_committee = committee
+        return post

@@ -271,6 +271,21 @@ class Phase0Spec:
                 typ.__name__ = name
                 setattr(self, name, typ)
 
+        # custom-type aliases on the spec surface, as in the generated
+        # reference modules (spec.Root, spec.Slot, ...)
+        self.Slot = Slot
+        self.Epoch = Epoch
+        self.CommitteeIndex = CommitteeIndex
+        self.ValidatorIndex = ValidatorIndex
+        self.Gwei = Gwei
+        self.Root = Root
+        self.Version = Version
+        self.DomainType = DomainType
+        self.ForkDigest = ForkDigest
+        self.Domain = Domain
+        self.BLSPubkey = BLSPubkey
+        self.BLSSignature = BLSSignature
+
     # == math / serialization helpers =====================================
 
     @staticmethod
@@ -561,6 +576,17 @@ class Phase0Spec:
             int(validator.exit_epoch) + self.config.MIN_VALIDATOR_WITHDRAWABILITY_DELAY
         )
 
+    # fork-tunable slashing knobs — later forks re-point these constants
+    # (e.g. *_ALTAIR, *_BELLATRIX) without re-stating the slashing logic
+    def min_slashing_penalty_quotient(self) -> int:
+        return self.MIN_SLASHING_PENALTY_QUOTIENT
+
+    def proportional_slashing_multiplier(self) -> int:
+        return self.PROPORTIONAL_SLASHING_MULTIPLIER
+
+    def whistleblower_proposer_reward(self, whistleblower_reward: int) -> int:
+        return whistleblower_reward // self.PROPOSER_REWARD_QUOTIENT
+
     def slash_validator(self, state, slashed_index: int, whistleblower_index=None) -> None:
         epoch = self.get_current_epoch(state)
         self.initiate_validator_exit(state, slashed_index)
@@ -574,14 +600,16 @@ class Phase0Spec:
             + int(validator.effective_balance)
         )
         self.decrease_balance(
-            state, slashed_index, int(validator.effective_balance) // self.MIN_SLASHING_PENALTY_QUOTIENT
+            state,
+            slashed_index,
+            int(validator.effective_balance) // self.min_slashing_penalty_quotient(),
         )
         # proposer + whistleblower rewards
         proposer_index = self.get_beacon_proposer_index(state)
         if whistleblower_index is None:
             whistleblower_index = proposer_index
         whistleblower_reward = int(validator.effective_balance) // self.WHISTLEBLOWER_REWARD_QUOTIENT
-        proposer_reward = whistleblower_reward // self.PROPOSER_REWARD_QUOTIENT
+        proposer_reward = self.whistleblower_proposer_reward(whistleblower_reward)
         self.increase_balance(state, proposer_index, proposer_reward)
         self.increase_balance(state, whistleblower_index, whistleblower_reward - proposer_reward)
 
@@ -1069,7 +1097,7 @@ class Phase0Spec:
         epoch = self.get_current_epoch(state)
         total_balance = self.get_total_active_balance(state)
         adjusted_total_slashing_balance = min(
-            sum(int(s) for s in state.slashings) * self.PROPORTIONAL_SLASHING_MULTIPLIER,
+            sum(int(s) for s in state.slashings) * self.proportional_slashing_multiplier(),
             total_balance,
         )
         for index, validator in enumerate(state.validators):

@@ -8,6 +8,7 @@ from eth_consensus_specs_tpu.ssz import Bitlist, hash_tree_root
 from eth_consensus_specs_tpu.utils import bls
 
 from .context import expect_assertion_error
+from .forks import is_post_altair
 from .keys import privkeys
 from .state import latest_block_root, next_slot
 
@@ -83,13 +84,30 @@ def run_attestation_processing(spec, state, attestation, valid: bool = True):
         expect_assertion_error(lambda: spec.process_attestation(state, attestation))
         yield "post", None
         return
-    current_epoch_count = len(state.current_epoch_attestations)
-    previous_epoch_count = len(state.previous_epoch_attestations)
-    spec.process_attestation(state, attestation)
-    if attestation.data.target.epoch == spec.get_current_epoch(state):
-        assert len(state.current_epoch_attestations) == current_epoch_count + 1
+    is_current = attestation.data.target.epoch == spec.get_current_epoch(state)
+    if is_post_altair(spec):
+        # flags the attestation is entitled to (may be none, e.g. a wrong
+        # target included late — still a valid attestation)
+        expected_flags = spec.get_attestation_participation_flag_indices(
+            state, attestation.data, int(state.slot) - int(attestation.data.slot)
+        )
+        spec.process_attestation(state, attestation)
+        participation = (
+            state.current_epoch_participation
+            if is_current
+            else state.previous_epoch_participation
+        )
+        for index in spec.get_attesting_indices(state, attestation):
+            for flag_index in expected_flags:
+                assert spec.has_flag(participation[index], flag_index)
     else:
-        assert len(state.previous_epoch_attestations) == previous_epoch_count + 1
+        current_epoch_count = len(state.current_epoch_attestations)
+        previous_epoch_count = len(state.previous_epoch_attestations)
+        spec.process_attestation(state, attestation)
+        if is_current:
+            assert len(state.current_epoch_attestations) == current_epoch_count + 1
+        else:
+            assert len(state.previous_epoch_attestations) == previous_epoch_count + 1
     yield "post", state
 
 

@@ -6,6 +6,7 @@ from __future__ import annotations
 from eth_consensus_specs_tpu.ssz import hash_tree_root
 from eth_consensus_specs_tpu.utils import bls
 
+from .forks import is_post_altair
 from .keys import privkeys
 from .state import latest_block_root
 
@@ -29,6 +30,9 @@ def build_empty_block(spec, state, slot=None, proposer_index=None):
     block.body.randao_reveal = spec.get_epoch_signature(
         lookahead_state, block, privkeys[int(proposer_index)]
     )
+    if is_post_altair(spec):
+        # an empty sync aggregate is valid only with the infinity signature
+        block.body.sync_aggregate.sync_committee_signature = bls.G2_POINT_AT_INFINITY
     return block
 
 

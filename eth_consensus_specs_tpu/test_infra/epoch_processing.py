@@ -6,6 +6,23 @@ from __future__ import annotations
 
 
 def get_process_calls(spec):
+    from .forks import is_post_altair
+
+    if is_post_altair(spec):
+        return [
+            "process_justification_and_finalization",
+            "process_inactivity_updates",
+            "process_rewards_and_penalties",
+            "process_registry_updates",
+            "process_slashings",
+            "process_eth1_data_reset",
+            "process_effective_balance_updates",
+            "process_slashings_reset",
+            "process_randao_mixes_reset",
+            "process_historical_roots_update",
+            "process_participation_flag_updates",
+            "process_sync_committee_updates",
+        ]
     return [
         "process_justification_and_finalization",
         "process_rewards_and_penalties",

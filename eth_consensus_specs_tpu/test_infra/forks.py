@@ -1,0 +1,56 @@
+"""Fork-ordering predicates for fork-aware helpers (reference analogue:
+test/helpers/forks.py is_post_altair/is_post_bellatrix/...)."""
+
+from __future__ import annotations
+
+from eth_consensus_specs_tpu.config import FORK_ORDER
+
+
+def _at_or_after(spec, fork: str) -> bool:
+    return FORK_ORDER.index(spec.fork_name) >= FORK_ORDER.index(fork)
+
+
+def is_post_altair(spec) -> bool:
+    return _at_or_after(spec, "altair")
+
+
+def is_post_bellatrix(spec) -> bool:
+    return _at_or_after(spec, "bellatrix")
+
+
+def is_post_capella(spec) -> bool:
+    return _at_or_after(spec, "capella")
+
+
+def is_post_deneb(spec) -> bool:
+    return _at_or_after(spec, "deneb")
+
+
+def is_post_electra(spec) -> bool:
+    return _at_or_after(spec, "electra")
+
+
+def is_post_fulu(spec) -> bool:
+    return _at_or_after(spec, "fulu")
+
+
+def is_post_gloas(spec) -> bool:
+    return _at_or_after(spec, "gloas")
+
+
+def fork_version_of(spec) -> bytes:
+    """The config fork version for the spec's own fork (phase0 ->
+    GENESIS_FORK_VERSION, altair -> ALTAIR_FORK_VERSION, ...)."""
+    if spec.fork_name == "phase0":
+        return spec.config.GENESIS_FORK_VERSION
+    return spec.config[f"{spec.fork_name.upper()}_FORK_VERSION"]
+
+
+def previous_fork_version_of(spec) -> bytes:
+    idx = FORK_ORDER.index(spec.fork_name)
+    if idx == 0:
+        return spec.config.GENESIS_FORK_VERSION
+    prev = FORK_ORDER[idx - 1]
+    if prev == "phase0":
+        return spec.config.GENESIS_FORK_VERSION
+    return spec.config[f"{prev.upper()}_FORK_VERSION"]

@@ -13,6 +13,7 @@ from __future__ import annotations
 from eth_consensus_specs_tpu.ssz import Bytes32, hash_tree_root
 from eth_consensus_specs_tpu.ssz.hashing import hash_bytes
 
+from .forks import fork_version_of, is_post_altair, previous_fork_version_of
 from .keys import pubkey
 
 ETH1_GENESIS_HASH = b"\x42" * 32
@@ -27,8 +28,8 @@ def create_genesis_state(spec, validator_balances: list[int], activation_thresho
     state = spec.BeaconState(
         genesis_time=GENESIS_TIME,
         fork=spec.Fork(
-            previous_version=spec.config.GENESIS_FORK_VERSION,
-            current_version=spec.config.GENESIS_FORK_VERSION,
+            previous_version=previous_fork_version_of(spec),
+            current_version=fork_version_of(spec),
             epoch=spec.GENESIS_EPOCH,
         ),
         eth1_data=spec.Eth1Data(
@@ -61,4 +62,13 @@ def create_genesis_state(spec, validator_balances: list[int], activation_thresho
         state.validators.append(validator)
         state.balances.append(balance)
     state.genesis_validators_root = hash_tree_root(state.validators)
+    if is_post_altair(spec):
+        n = len(validator_balances)
+        state.previous_epoch_participation = [0] * n
+        state.current_epoch_participation = [0] * n
+        state.inactivity_scores = [0] * n
+        # duplicate committee at genesis, matching upgrade_to_altair
+        committee = spec.get_next_sync_committee(state)
+        state.current_sync_committee = committee
+        state.next_sync_committee = committee
     return state

@@ -1,0 +1,41 @@
+"""phase0 -> altair state upgrade (reference analogue:
+test/altair/fork/test_altair_fork_basic.py; spec: specs/altair/fork.md)."""
+
+from eth_consensus_specs_tpu.forks import get_spec
+from eth_consensus_specs_tpu.ssz import hash_tree_root
+from eth_consensus_specs_tpu.test_infra.attestations import next_epoch_with_attestations
+from eth_consensus_specs_tpu.test_infra.context import spec_state_test, with_phases
+from eth_consensus_specs_tpu.test_infra.state import next_epoch
+
+
+@with_phases(["phase0"])
+@spec_state_test
+def test_upgrade_to_altair_basic(spec, state):
+    altair = get_spec("altair", spec.preset_name)
+    next_epoch(spec, state)
+    post = altair.upgrade_from_parent(state)
+    assert bytes(post.fork.current_version) == bytes(altair.config.ALTAIR_FORK_VERSION)
+    assert bytes(post.fork.previous_version) == bytes(state.fork.current_version)
+    assert int(post.slot) == int(state.slot)
+    assert len(post.inactivity_scores) == len(state.validators)
+    assert all(int(s) == 0 for s in post.inactivity_scores)
+    assert hash_tree_root(post.validators) == hash_tree_root(state.validators)
+    # both committees seeded and identical at the boundary
+    assert hash_tree_root(post.current_sync_committee) == hash_tree_root(
+        post.next_sync_committee
+    )
+
+
+@with_phases(["phase0"])
+@spec_state_test
+def test_upgrade_to_altair_translates_participation(spec, state):
+    altair = get_spec("altair", spec.preset_name)
+    next_epoch(spec, state)
+    next_epoch_with_attestations(spec, state, fill_cur_epoch=False, fill_prev_epoch=True)
+    assert len(state.previous_epoch_attestations) > 0
+    post = altair.upgrade_from_parent(state)
+    flagged = [int(f) for f in post.previous_epoch_participation]
+    assert any(f != 0 for f in flagged)
+    assert all(int(f) == 0 for f in post.current_epoch_participation)
+    # the upgraded state must run under the altair state machine
+    next_epoch(altair, post)

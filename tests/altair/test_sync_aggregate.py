@@ -1,0 +1,139 @@
+"""Sync-aggregate processing (reference analogue:
+test/altair/block_processing/sync_aggregate/*)."""
+
+import pytest
+
+from eth_consensus_specs_tpu.ssz import hash_tree_root
+from eth_consensus_specs_tpu.test_infra.block import (
+    build_empty_block_for_next_slot,
+    state_transition_and_sign_block,
+)
+from eth_consensus_specs_tpu.test_infra.context import (
+    always_bls,
+    expect_assertion_error,
+    spec_state_test,
+    with_phases,
+)
+from eth_consensus_specs_tpu.test_infra.keys import privkeys, pubkey_to_privkey
+from eth_consensus_specs_tpu.test_infra.state import next_slot, transition_to
+from eth_consensus_specs_tpu.utils import bls
+
+
+def compute_sync_committee_signature(spec, state, slot, privkey, block_root=None):
+    domain = spec.get_domain(
+        state, spec.DOMAIN_SYNC_COMMITTEE, spec.compute_epoch_at_slot(slot)
+    )
+    if block_root is None:
+        if slot == state.slot:
+            block_root = build_empty_block_for_next_slot(spec, state).parent_root
+        else:
+            block_root = spec.get_block_root_at_slot(state, slot)
+    signing_root = spec.compute_signing_root(spec.Root(block_root), domain)
+    return bls.Sign(privkey, signing_root)
+
+
+def make_sync_aggregate(spec, state, participation_bits):
+    """Signed aggregate over the previous block root for the current slot."""
+    previous_slot = max(int(state.slot), 1) - 1
+    block_root = spec.get_block_root_at_slot(state, previous_slot)
+    domain = spec.get_domain(
+        state, spec.DOMAIN_SYNC_COMMITTEE, spec.compute_epoch_at_slot(previous_slot)
+    )
+    signing_root = spec.compute_signing_root(spec.Root(block_root), domain)
+    sigs = []
+    for pk, bit in zip(state.current_sync_committee.pubkeys, participation_bits):
+        if bit:
+            sigs.append(bls.Sign(pubkey_to_privkey(bytes(pk)), signing_root))
+    signature = bls.Aggregate(sigs) if sigs else bls.G2_POINT_AT_INFINITY
+    return spec.SyncAggregate(
+        sync_committee_bits=participation_bits, sync_committee_signature=signature
+    )
+
+
+def run_sync_aggregate_processing(spec, state, sync_aggregate, valid=True):
+    yield "pre", state
+    yield "sync_aggregate", sync_aggregate
+    if not valid:
+        expect_assertion_error(lambda: spec.process_sync_aggregate(state, sync_aggregate))
+        yield "post", None
+        return
+    spec.process_sync_aggregate(state, sync_aggregate)
+    yield "post", state
+
+
+@with_phases(["altair"])
+@spec_state_test
+def test_sync_aggregate_full_participation_rewards(spec, state):
+    next_slot(spec, state)
+    bits = [True] * spec.SYNC_COMMITTEE_SIZE
+    aggregate = make_sync_aggregate(spec, state, bits)
+    all_pubkeys = [bytes(v.pubkey) for v in state.validators]
+    committee = [all_pubkeys.index(bytes(pk)) for pk in state.current_sync_committee.pubkeys]
+    pre_balances = [int(state.balances[i]) for i in committee]
+    yield from run_sync_aggregate_processing(spec, state, aggregate)
+    for i, idx in enumerate(committee):
+        assert int(state.balances[idx]) > pre_balances[i]
+
+
+@with_phases(["altair"])
+@spec_state_test
+def test_sync_aggregate_empty_participation_penalties(spec, state):
+    next_slot(spec, state)
+    bits = [False] * spec.SYNC_COMMITTEE_SIZE
+    aggregate = spec.SyncAggregate(
+        sync_committee_bits=bits, sync_committee_signature=bls.G2_POINT_AT_INFINITY
+    )
+    all_pubkeys = [bytes(v.pubkey) for v in state.validators]
+    committee = [all_pubkeys.index(bytes(pk)) for pk in state.current_sync_committee.pubkeys]
+    proposer = spec.get_beacon_proposer_index(state)
+    pre_balances = [int(state.balances[i]) for i in committee]
+    yield from run_sync_aggregate_processing(spec, state, aggregate)
+    for i, idx in enumerate(committee):
+        if idx != proposer:
+            assert int(state.balances[idx]) < pre_balances[i]
+
+
+@with_phases(["altair"])
+@always_bls
+@spec_state_test
+def test_sync_aggregate_half_participation_signature(spec, state):
+    next_slot(spec, state)
+    bits = [i % 2 == 0 for i in range(spec.SYNC_COMMITTEE_SIZE)]
+    aggregate = make_sync_aggregate(spec, state, bits)
+    yield from run_sync_aggregate_processing(spec, state, aggregate)
+
+
+@with_phases(["altair"])
+@always_bls
+@spec_state_test
+def test_sync_aggregate_majority_uses_subtraction_path(spec, state):
+    # >half participation exercises the aggregate-minus-absentees fast path
+    next_slot(spec, state)
+    bits = [i != 0 for i in range(spec.SYNC_COMMITTEE_SIZE)]
+    aggregate = make_sync_aggregate(spec, state, bits)
+    yield from run_sync_aggregate_processing(spec, state, aggregate)
+
+
+@with_phases(["altair"])
+@always_bls
+@spec_state_test
+def test_sync_aggregate_invalid_signature(spec, state):
+    next_slot(spec, state)
+    bits = [True] * spec.SYNC_COMMITTEE_SIZE
+    aggregate = make_sync_aggregate(spec, state, bits)
+    aggregate.sync_committee_signature = bls.Sign(privkeys[0], b"\x13" * 32)
+    yield from run_sync_aggregate_processing(spec, state, aggregate, valid=False)
+
+
+@with_phases(["altair"])
+@always_bls
+@spec_state_test
+def test_sync_aggregate_wrong_bit_invalid(spec, state):
+    # flip one participation bit after signing: signature no longer matches
+    next_slot(spec, state)
+    bits = [i != 0 for i in range(spec.SYNC_COMMITTEE_SIZE)]
+    aggregate = make_sync_aggregate(spec, state, bits)
+    flipped = list(aggregate.sync_committee_bits)
+    flipped[0] = True
+    aggregate.sync_committee_bits = flipped
+    yield from run_sync_aggregate_processing(spec, state, aggregate, valid=False)

@@ -5,7 +5,7 @@ post-state hash_tree_root, so every mutated field is covered."""
 
 from eth_consensus_specs_tpu.ssz import hash_tree_root
 from eth_consensus_specs_tpu.test_infra.attestations import next_epoch_with_attestations
-from eth_consensus_specs_tpu.test_infra.context import spec_state_test, with_all_phases
+from eth_consensus_specs_tpu.test_infra.context import spec_state_test, with_phases
 from eth_consensus_specs_tpu.test_infra.state import next_epoch
 
 
@@ -24,14 +24,14 @@ def assert_columnar_parity(spec, state):
     assert hash_tree_root(obj_state) == hash_tree_root(col_state)
 
 
-@with_all_phases
+@with_phases(["phase0"])
 @spec_state_test
 def test_columnar_genesis_epoch(spec, state):
     # epoch 0: justification and rewards both skipped; resets still run
     assert_columnar_parity(spec, state)
 
 
-@with_all_phases
+@with_phases(["phase0"])
 @spec_state_test
 def test_columnar_full_participation(spec, state):
     next_epoch_with_attestations(spec, state, fill_cur_epoch=False, fill_prev_epoch=True)
@@ -39,7 +39,7 @@ def test_columnar_full_participation(spec, state):
     assert_columnar_parity(spec, state)
 
 
-@with_all_phases
+@with_phases(["phase0"])
 @spec_state_test
 def test_columnar_partial_participation(spec, state):
     next_epoch_with_attestations(spec, state, fill_cur_epoch=False, fill_prev_epoch=True)
@@ -53,7 +53,7 @@ def test_columnar_partial_participation(spec, state):
     assert_columnar_parity(spec, state)
 
 
-@with_all_phases
+@with_phases(["phase0"])
 @spec_state_test
 def test_columnar_inactivity_leak(spec, state):
     # empty epochs past MIN_EPOCHS_TO_INACTIVITY_PENALTY: leak active
@@ -63,7 +63,7 @@ def test_columnar_inactivity_leak(spec, state):
     assert_columnar_parity(spec, state)
 
 
-@with_all_phases
+@with_phases(["phase0"])
 @spec_state_test
 def test_columnar_slashings_window(spec, state):
     # craft validators inside the correlated-slashing penalty window
@@ -82,7 +82,7 @@ def test_columnar_slashings_window(spec, state):
     assert_columnar_parity(spec, state)
 
 
-@with_all_phases
+@with_phases(["phase0"])
 @spec_state_test
 def test_columnar_mixed_registry(spec, state):
     # ejections + activation queue + an exited validator, with attestations
