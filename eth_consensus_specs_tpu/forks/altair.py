@@ -349,7 +349,7 @@ class AltairSpec(Phase0Spec):
                     state.inactivity_scores[index]
                 )
                 penalty_denominator = (
-                    self.config.INACTIVITY_SCORE_BIAS * self.INACTIVITY_PENALTY_QUOTIENT_ALTAIR
+                    self.config.INACTIVITY_SCORE_BIAS * self.inactivity_penalty_quotient()
                 )
                 penalties[index] += penalty_numerator // penalty_denominator
         return rewards, penalties
@@ -357,6 +357,9 @@ class AltairSpec(Phase0Spec):
     # == mutators ==========================================================
     # slash_validator itself is inherited; altair only re-points its knobs
     # (reference: specs/altair/beacon-chain.md:455-488)
+
+    def inactivity_penalty_quotient(self) -> int:
+        return self.INACTIVITY_PENALTY_QUOTIENT_ALTAIR
 
     def min_slashing_penalty_quotient(self) -> int:
         return self.MIN_SLASHING_PENALTY_QUOTIENT_ALTAIR
@@ -550,24 +553,8 @@ class AltairSpec(Phase0Spec):
                 self.increase_balance(state, index, rewards[index])
                 self.decrease_balance(state, index, penalties[index])
 
-    def process_slashings(self, state) -> None:
-        epoch = self.get_current_epoch(state)
-        total_balance = self.get_total_active_balance(state)
-        adjusted_total_slashing_balance = min(
-            sum(int(s) for s in state.slashings) * self.proportional_slashing_multiplier(),
-            total_balance,
-        )
-        for index, validator in enumerate(state.validators):
-            if (
-                validator.slashed
-                and epoch + self.EPOCHS_PER_SLASHINGS_VECTOR // 2 == validator.withdrawable_epoch
-            ):
-                increment = self.EFFECTIVE_BALANCE_INCREMENT
-                penalty_numerator = (
-                    int(validator.effective_balance) // increment * adjusted_total_slashing_balance
-                )
-                penalty = penalty_numerator // total_balance * increment
-                self.decrease_balance(state, index, penalty)
+    # process_slashings is inherited: the proportional_slashing_multiplier()
+    # knob above is altair's entire modification
 
     def process_participation_flag_updates(self, state) -> None:
         state.previous_epoch_participation = state.current_epoch_participation
@@ -601,9 +588,11 @@ class AltairSpec(Phase0Spec):
         state = super().initialize_beacon_state_from_eth1(
             eth1_block_hash, eth1_timestamp, deposits
         )
-        # pure-altair genesis fills both sync committees
-        state.current_sync_committee = self.get_next_sync_committee(state)
-        state.next_sync_committee = self.get_next_sync_committee(state)
+        # pure-altair genesis fills both sync committees (state unchanged
+        # between the fields, so compute once)
+        committee = self.get_next_sync_committee(state)
+        state.current_sync_committee = committee
+        state.next_sync_committee = committee
         state.fork = self.Fork(
             previous_version=Version(self.config.ALTAIR_FORK_VERSION),
             current_version=Version(self.config.ALTAIR_FORK_VERSION),

@@ -1,0 +1,331 @@
+"""bellatrix: the Merge — execution payloads, the ExecutionEngine protocol
+seam, merge-transition predicates, and final penalty parameters.
+
+Behavioral parity targets (reference, by section):
+  * state machine:  specs/bellatrix/beacon-chain.md (ExecutionPayload :152,
+    process_execution_payload :382, predicates :203-222, engine protocol
+    :291-360, final penalty values :64)
+  * fork choice:    specs/bellatrix/fork-choice.md (PowBlock,
+    validate_merge_block)
+  * fork upgrade:   specs/bellatrix/fork.md (upgrade_to_bellatrix)
+
+The execution layer itself is a protocol boundary: consensus only ever
+calls `verify_and_notify_new_payload`. The default NoopExecutionEngine
+accepts everything (as the reference's injected engine does,
+reference: pysetup/spec_builders/bellatrix.py), and tests monkeypatch it
+to exercise invalid-payload paths.
+"""
+
+from dataclasses import dataclass
+
+from eth_consensus_specs_tpu.ssz import (
+    Bitvector,
+    ByteList,
+    ByteVector,
+    Bytes20,
+    Bytes32,
+    Container,
+    List,
+    Vector,
+    hash_tree_root,
+    uint64,
+    uint256,
+)
+
+from .altair import AltairSpec, ParticipationFlags
+from .phase0 import (
+    BLSSignature,
+    Gwei,
+    Root,
+    Slot,
+    ValidatorIndex,
+    Version,
+)
+
+Hash32 = Bytes32
+ExecutionAddress = Bytes20
+
+
+class NoopExecutionEngine:
+    """Stand-in engine: accepts every payload (reference analogue: the
+    NoopExecutionEngine injected into generated specs). Tests monkeypatch
+    the bound spec attribute to simulate engine verdicts."""
+
+    def notify_new_payload(self, execution_payload) -> bool:
+        return True
+
+    def is_valid_block_hash(self, execution_payload) -> bool:
+        return True
+
+    def verify_and_notify_new_payload(self, new_payload_request) -> bool:
+        execution_payload = new_payload_request.execution_payload
+        if b"" in [bytes(tx) for tx in execution_payload.transactions]:
+            return False
+        if not self.is_valid_block_hash(execution_payload):
+            return False
+        if not self.notify_new_payload(execution_payload):
+            return False
+        return True
+
+
+class BellatrixSpec(AltairSpec):
+    fork_name = "bellatrix"
+
+    def __init__(self, *args, **kwargs):
+        super().__init__(*args, **kwargs)
+        self.EXECUTION_ENGINE = NoopExecutionEngine()
+
+    # == type system ======================================================
+
+    def _build_types(self) -> None:
+        super()._build_types()
+        P = self
+        Transaction = ByteList[P.MAX_BYTES_PER_TRANSACTION]
+        self.Transaction = Transaction
+
+        class ExecutionPayload(Container):
+            parent_hash: Hash32
+            fee_recipient: ExecutionAddress
+            state_root: Bytes32
+            receipts_root: Bytes32
+            logs_bloom: ByteVector[P.BYTES_PER_LOGS_BLOOM]
+            prev_randao: Bytes32
+            block_number: uint64
+            gas_limit: uint64
+            gas_used: uint64
+            timestamp: uint64
+            extra_data: ByteList[P.MAX_EXTRA_DATA_BYTES]
+            base_fee_per_gas: uint256
+            block_hash: Hash32
+            transactions: List[Transaction, P.MAX_TRANSACTIONS_PER_PAYLOAD]
+
+        class ExecutionPayloadHeader(Container):
+            parent_hash: Hash32
+            fee_recipient: ExecutionAddress
+            state_root: Bytes32
+            receipts_root: Bytes32
+            logs_bloom: ByteVector[P.BYTES_PER_LOGS_BLOOM]
+            prev_randao: Bytes32
+            block_number: uint64
+            gas_limit: uint64
+            gas_used: uint64
+            timestamp: uint64
+            extra_data: ByteList[P.MAX_EXTRA_DATA_BYTES]
+            base_fee_per_gas: uint256
+            block_hash: Hash32
+            transactions_root: Root
+
+        class BeaconBlockBody(Container):
+            randao_reveal: BLSSignature
+            eth1_data: P.Eth1Data
+            graffiti: Bytes32
+            proposer_slashings: List[P.ProposerSlashing, P.MAX_PROPOSER_SLASHINGS]
+            attester_slashings: List[P.AttesterSlashing, P.MAX_ATTESTER_SLASHINGS]
+            attestations: List[P.Attestation, P.MAX_ATTESTATIONS]
+            deposits: List[P.Deposit, P.MAX_DEPOSITS]
+            voluntary_exits: List[P.SignedVoluntaryExit, P.MAX_VOLUNTARY_EXITS]
+            sync_aggregate: P.SyncAggregate
+            execution_payload: ExecutionPayload  # [New in Bellatrix]
+
+        class BeaconBlock(Container):
+            slot: Slot
+            proposer_index: ValidatorIndex
+            parent_root: Root
+            state_root: Root
+            body: BeaconBlockBody
+
+        class SignedBeaconBlock(Container):
+            message: BeaconBlock
+            signature: BLSSignature
+
+        class BeaconState(Container):
+            genesis_time: uint64
+            genesis_validators_root: Root
+            slot: Slot
+            fork: P.Fork
+            latest_block_header: P.BeaconBlockHeader
+            block_roots: Vector[Root, P.SLOTS_PER_HISTORICAL_ROOT]
+            state_roots: Vector[Root, P.SLOTS_PER_HISTORICAL_ROOT]
+            historical_roots: List[Root, P.HISTORICAL_ROOTS_LIMIT]
+            eth1_data: P.Eth1Data
+            eth1_data_votes: List[P.Eth1Data, P.EPOCHS_PER_ETH1_VOTING_PERIOD * P.SLOTS_PER_EPOCH]
+            eth1_deposit_index: uint64
+            validators: List[P.Validator, P.VALIDATOR_REGISTRY_LIMIT]
+            balances: List[Gwei, P.VALIDATOR_REGISTRY_LIMIT]
+            randao_mixes: Vector[Bytes32, P.EPOCHS_PER_HISTORICAL_VECTOR]
+            slashings: Vector[Gwei, P.EPOCHS_PER_SLASHINGS_VECTOR]
+            previous_epoch_participation: List[ParticipationFlags, P.VALIDATOR_REGISTRY_LIMIT]
+            current_epoch_participation: List[ParticipationFlags, P.VALIDATOR_REGISTRY_LIMIT]
+            justification_bits: Bitvector[self.JUSTIFICATION_BITS_LENGTH]
+            previous_justified_checkpoint: P.Checkpoint
+            current_justified_checkpoint: P.Checkpoint
+            finalized_checkpoint: P.Checkpoint
+            inactivity_scores: List[uint64, P.VALIDATOR_REGISTRY_LIMIT]
+            current_sync_committee: P.SyncCommittee
+            next_sync_committee: P.SyncCommittee
+            latest_execution_payload_header: ExecutionPayloadHeader  # [New in Bellatrix]
+
+        # fork-choice PoW anchor (specs/bellatrix/fork-choice.md)
+        class PowBlock(Container):
+            block_hash: Hash32
+            parent_hash: Hash32
+            total_difficulty: uint256
+
+        for name, typ in list(locals().items()):
+            if isinstance(typ, type) and issubclass(typ, Container):
+                typ.__name__ = name
+                setattr(self, name, typ)
+
+    # == request dataclasses ==============================================
+
+    @dataclass
+    class NewPayloadRequest:
+        execution_payload: object
+
+    # == predicates ========================================================
+
+    def is_merge_transition_complete(self, state) -> bool:
+        return state.latest_execution_payload_header != self.ExecutionPayloadHeader()
+
+    def is_merge_transition_block(self, state, body) -> bool:
+        return not self.is_merge_transition_complete(state) and (
+            body.execution_payload != self.ExecutionPayload()
+        )
+
+    def is_execution_enabled(self, state, body) -> bool:
+        return self.is_merge_transition_block(state, body) or self.is_merge_transition_complete(
+            state
+        )
+
+    # == misc ==============================================================
+
+    def compute_timestamp_at_slot(self, state, slot: int) -> int:
+        slots_since_genesis = int(slot) - self.GENESIS_SLOT
+        return int(state.genesis_time) + slots_since_genesis * self.config.SECONDS_PER_SLOT
+
+    # == penalty knobs (final values) ======================================
+
+    def inactivity_penalty_quotient(self) -> int:
+        return self.INACTIVITY_PENALTY_QUOTIENT_BELLATRIX
+
+    def min_slashing_penalty_quotient(self) -> int:
+        return self.MIN_SLASHING_PENALTY_QUOTIENT_BELLATRIX
+
+    def proportional_slashing_multiplier(self) -> int:
+        return self.PROPORTIONAL_SLASHING_MULTIPLIER_BELLATRIX
+
+    # == block processing ==================================================
+
+    def process_block(self, state, block) -> None:
+        self.process_block_header(state, block)
+        if self.is_execution_enabled(state, block.body):
+            self.process_execution_payload(state, block.body, self.EXECUTION_ENGINE)
+        self.process_randao(state, block.body)
+        self.process_eth1_data(state, block.body)
+        self.process_operations(state, block.body)
+        self.process_sync_aggregate(state, block.body.sync_aggregate)
+
+    def process_execution_payload(self, state, body, execution_engine) -> None:
+        payload = body.execution_payload
+        if self.is_merge_transition_complete(state):
+            assert (
+                payload.parent_hash == state.latest_execution_payload_header.block_hash
+            ), "payload parent mismatch"
+        assert payload.prev_randao == self.get_randao_mix(
+            state, self.get_current_epoch(state)
+        ), "wrong prev_randao"
+        assert payload.timestamp == self.compute_timestamp_at_slot(
+            state, state.slot
+        ), "wrong payload timestamp"
+        assert execution_engine.verify_and_notify_new_payload(
+            self.NewPayloadRequest(execution_payload=payload)
+        ), "execution engine rejected payload"
+        state.latest_execution_payload_header = self.execution_payload_to_header(payload)
+
+    def execution_payload_to_header(self, payload):
+        return self.ExecutionPayloadHeader(
+            parent_hash=payload.parent_hash,
+            fee_recipient=payload.fee_recipient,
+            state_root=payload.state_root,
+            receipts_root=payload.receipts_root,
+            logs_bloom=payload.logs_bloom,
+            prev_randao=payload.prev_randao,
+            block_number=payload.block_number,
+            gas_limit=payload.gas_limit,
+            gas_used=payload.gas_used,
+            timestamp=payload.timestamp,
+            extra_data=payload.extra_data,
+            base_fee_per_gas=payload.base_fee_per_gas,
+            block_hash=payload.block_hash,
+            transactions_root=hash_tree_root(payload.transactions),
+        )
+
+    # == fork choice: merge block validation ===============================
+
+    def get_pow_block(self, block_hash):
+        """Implementation-dependent PoW chain accessor; tests monkeypatch.
+        (reference: specs/bellatrix/fork-choice.md get_pow_block)"""
+        raise NotImplementedError("requires an execution-layer client")
+
+    def is_valid_terminal_pow_block(self, block, parent) -> bool:
+        is_total_difficulty_reached = (
+            int(block.total_difficulty) >= self.config.TERMINAL_TOTAL_DIFFICULTY
+        )
+        is_parent_total_difficulty_valid = (
+            int(parent.total_difficulty) < self.config.TERMINAL_TOTAL_DIFFICULTY
+        )
+        return is_total_difficulty_reached and is_parent_total_difficulty_valid
+
+    def validate_merge_block(self, block) -> None:
+        if bytes(self.config.TERMINAL_BLOCK_HASH) != b"\x00" * 32:
+            # terminal-hash override path
+            assert (
+                self.get_current_store_epoch_for_merge()
+                >= self.config.TERMINAL_BLOCK_HASH_ACTIVATION_EPOCH
+            )
+            assert block.body.execution_payload.parent_hash == Bytes32(
+                self.config.TERMINAL_BLOCK_HASH
+            )
+            return
+        pow_block = self.get_pow_block(block.body.execution_payload.parent_hash)
+        pow_parent = self.get_pow_block(pow_block.parent_hash)
+        assert self.is_valid_terminal_pow_block(pow_block, pow_parent), "invalid terminal block"
+
+    def get_current_store_epoch_for_merge(self) -> int:  # pragma: no cover
+        raise NotImplementedError("bound to a Store by the fork-choice driver")
+
+    # == fork upgrade (specs/bellatrix/fork.md) ============================
+
+    def upgrade_from_parent(self, pre):
+        epoch = self.compute_epoch_at_slot(int(pre.slot))
+        return self.BeaconState(
+            genesis_time=pre.genesis_time,
+            genesis_validators_root=pre.genesis_validators_root,
+            slot=pre.slot,
+            fork=self.Fork(
+                previous_version=pre.fork.current_version,
+                current_version=Version(self.config.BELLATRIX_FORK_VERSION),
+                epoch=epoch,
+            ),
+            latest_block_header=pre.latest_block_header,
+            block_roots=list(pre.block_roots),
+            state_roots=list(pre.state_roots),
+            historical_roots=list(pre.historical_roots),
+            eth1_data=pre.eth1_data,
+            eth1_data_votes=list(pre.eth1_data_votes),
+            eth1_deposit_index=pre.eth1_deposit_index,
+            validators=list(pre.validators),
+            balances=list(pre.balances),
+            randao_mixes=list(pre.randao_mixes),
+            slashings=list(pre.slashings),
+            previous_epoch_participation=list(pre.previous_epoch_participation),
+            current_epoch_participation=list(pre.current_epoch_participation),
+            justification_bits=list(pre.justification_bits),
+            previous_justified_checkpoint=pre.previous_justified_checkpoint,
+            current_justified_checkpoint=pre.current_justified_checkpoint,
+            finalized_checkpoint=pre.finalized_checkpoint,
+            inactivity_scores=list(pre.inactivity_scores),
+            current_sync_committee=pre.current_sync_committee,
+            next_sync_committee=pre.next_sync_committee,
+            latest_execution_payload_header=self.ExecutionPayloadHeader(),
+        )

@@ -66,7 +66,7 @@ class EpochParams:
             proposer_reward_quotient=spec.PROPOSER_REWARD_QUOTIENT,
             min_epochs_to_inactivity_penalty=spec.MIN_EPOCHS_TO_INACTIVITY_PENALTY,
             inactivity_penalty_quotient=spec.INACTIVITY_PENALTY_QUOTIENT,
-            proportional_slashing_multiplier=spec.proportional_slashing_multiplier(),
+            proportional_slashing_multiplier=spec.PROPORTIONAL_SLASHING_MULTIPLIER,
             epochs_per_slashings_vector=spec.EPOCHS_PER_SLASHINGS_VECTOR,
             hysteresis_quotient=spec.HYSTERESIS_QUOTIENT,
             hysteresis_downward_multiplier=spec.HYSTERESIS_DOWNWARD_MULTIPLIER,

@@ -6,7 +6,7 @@ from __future__ import annotations
 from eth_consensus_specs_tpu.ssz import hash_tree_root
 from eth_consensus_specs_tpu.utils import bls
 
-from .forks import is_post_altair
+from .forks import is_post_altair, is_post_bellatrix
 from .keys import privkeys
 from .state import latest_block_root
 
@@ -33,6 +33,10 @@ def build_empty_block(spec, state, slot=None, proposer_index=None):
     if is_post_altair(spec):
         # an empty sync aggregate is valid only with the infinity signature
         block.body.sync_aggregate.sync_committee_signature = bls.G2_POINT_AT_INFINITY
+    if is_post_bellatrix(spec):
+        from .execution_payload import build_empty_execution_payload
+
+        block.body.execution_payload = build_empty_execution_payload(spec, lookahead_state)
     return block
 
 

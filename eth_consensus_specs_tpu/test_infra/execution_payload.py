@@ -1,0 +1,58 @@
+"""Execution-payload construction for tests (reference analogue:
+test/helpers/execution_payload.py — ours skips the RLP/trie machinery the
+reference uses to fake EL data structures; the engine seam is a protocol,
+and the NoopExecutionEngine accepts any well-formed payload, so payloads
+here carry consistent consensus-side fields only)."""
+
+from __future__ import annotations
+
+from eth_consensus_specs_tpu.ssz import Bytes32
+
+GENESIS_BLOCK_HASH = b"\x30" * 32
+DEFAULT_GAS_LIMIT = 30_000_000
+DEFAULT_BASE_FEE = 1_000_000_000
+
+
+def compute_el_block_hash(spec, payload) -> bytes:
+    """Deterministic stand-in for the EL block hash (the engine protocol
+    owns real validation; reference tests fake it with RLP header hashing)."""
+    return spec.hash(
+        bytes(payload.parent_hash)
+        + bytes(payload.prev_randao)
+        + int(payload.block_number).to_bytes(8, "little")
+        + int(payload.timestamp).to_bytes(8, "little")
+    )
+
+
+def genesis_execution_payload_header(spec):
+    """Non-empty header marking the merge complete from genesis (reference:
+    helpers/genesis.py get_sample_genesis_execution_payload_header)."""
+    return spec.ExecutionPayloadHeader(
+        block_hash=Bytes32(GENESIS_BLOCK_HASH),
+        prev_randao=Bytes32(b"\x31" * 32),
+        gas_limit=DEFAULT_GAS_LIMIT,
+        base_fee_per_gas=DEFAULT_BASE_FEE,
+    )
+
+
+def build_empty_execution_payload(spec, state, randao_mix=None):
+    """A payload consistent with `state` at state.slot (call on a state
+    already advanced to the block's slot, before process_randao)."""
+    latest = state.latest_execution_payload_header
+    if randao_mix is None:
+        randao_mix = spec.get_randao_mix(state, spec.get_current_epoch(state))
+    payload = spec.ExecutionPayload(
+        parent_hash=latest.block_hash,
+        fee_recipient=b"\x00" * 20,
+        state_root=latest.state_root,
+        receipts_root=Bytes32(b"\x29" * 32),
+        prev_randao=randao_mix,
+        block_number=int(latest.block_number) + 1,
+        gas_limit=int(latest.gas_limit),
+        gas_used=0,
+        timestamp=spec.compute_timestamp_at_slot(state, state.slot),
+        base_fee_per_gas=int(latest.base_fee_per_gas),
+        transactions=[],
+    )
+    payload.block_hash = Bytes32(compute_el_block_hash(spec, payload))
+    return payload

@@ -13,7 +13,12 @@ from __future__ import annotations
 from eth_consensus_specs_tpu.ssz import Bytes32, hash_tree_root
 from eth_consensus_specs_tpu.ssz.hashing import hash_bytes
 
-from .forks import fork_version_of, is_post_altair, previous_fork_version_of
+from .forks import (
+    fork_version_of,
+    is_post_altair,
+    is_post_bellatrix,
+    previous_fork_version_of,
+)
 from .keys import pubkey
 
 ETH1_GENESIS_HASH = b"\x42" * 32
@@ -71,4 +76,9 @@ def create_genesis_state(spec, validator_balances: list[int], activation_thresho
         committee = spec.get_next_sync_committee(state)
         state.current_sync_committee = committee
         state.next_sync_committee = committee
+    if is_post_bellatrix(spec):
+        from .execution_payload import genesis_execution_payload_header
+
+        # non-empty header: merge complete from genesis in tests
+        state.latest_execution_payload_header = genesis_execution_payload_header(spec)
     return state

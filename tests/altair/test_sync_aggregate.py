@@ -1,13 +1,6 @@
 """Sync-aggregate processing (reference analogue:
 test/altair/block_processing/sync_aggregate/*)."""
 
-import pytest
-
-from eth_consensus_specs_tpu.ssz import hash_tree_root
-from eth_consensus_specs_tpu.test_infra.block import (
-    build_empty_block_for_next_slot,
-    state_transition_and_sign_block,
-)
 from eth_consensus_specs_tpu.test_infra.context import (
     always_bls,
     expect_assertion_error,
@@ -15,21 +8,8 @@ from eth_consensus_specs_tpu.test_infra.context import (
     with_phases,
 )
 from eth_consensus_specs_tpu.test_infra.keys import privkeys, pubkey_to_privkey
-from eth_consensus_specs_tpu.test_infra.state import next_slot, transition_to
+from eth_consensus_specs_tpu.test_infra.state import next_slot
 from eth_consensus_specs_tpu.utils import bls
-
-
-def compute_sync_committee_signature(spec, state, slot, privkey, block_root=None):
-    domain = spec.get_domain(
-        state, spec.DOMAIN_SYNC_COMMITTEE, spec.compute_epoch_at_slot(slot)
-    )
-    if block_root is None:
-        if slot == state.slot:
-            block_root = build_empty_block_for_next_slot(spec, state).parent_root
-        else:
-            block_root = spec.get_block_root_at_slot(state, slot)
-    signing_root = spec.compute_signing_root(spec.Root(block_root), domain)
-    return bls.Sign(privkey, signing_root)
 
 
 def make_sync_aggregate(spec, state, participation_bits):

@@ -1,0 +1,113 @@
+"""Execution-payload processing (reference analogue:
+test/bellatrix/block_processing/test_process_execution_payload.py)."""
+
+from eth_consensus_specs_tpu.ssz import Bytes32
+from eth_consensus_specs_tpu.test_infra.context import (
+    expect_assertion_error,
+    spec_state_test,
+    with_phases,
+)
+from eth_consensus_specs_tpu.test_infra.execution_payload import (
+    build_empty_execution_payload,
+    compute_el_block_hash,
+)
+from eth_consensus_specs_tpu.test_infra.state import next_slot
+
+
+def run_execution_payload_processing(spec, state, payload, valid=True, execution_valid=True):
+    """Dual-mode runner; `execution_valid` drives the (monkeypatched)
+    engine verdict, `valid` the consensus-side checks."""
+
+    class TestEngine(type(spec.EXECUTION_ENGINE)):
+        def notify_new_payload(self, execution_payload) -> bool:
+            return execution_valid
+
+    body = spec.BeaconBlockBody(execution_payload=payload)
+    yield "pre", state
+    yield "execution", {"execution_valid": execution_valid}
+    yield "body", body
+    if not (valid and execution_valid):
+        expect_assertion_error(
+            lambda: spec.process_execution_payload(state, body, TestEngine())
+        )
+        yield "post", None
+        return
+    spec.process_execution_payload(state, body, TestEngine())
+    yield "post", state
+    assert state.latest_execution_payload_header.block_hash == payload.block_hash
+
+
+@with_phases(["bellatrix"])
+@spec_state_test
+def test_execution_payload_success_first_payload(spec, state):
+    next_slot(spec, state)
+    payload = build_empty_execution_payload(spec, state)
+    yield from run_execution_payload_processing(spec, state, payload)
+
+
+@with_phases(["bellatrix"])
+@spec_state_test
+def test_execution_payload_invalid_wrong_randao(spec, state):
+    next_slot(spec, state)
+    payload = build_empty_execution_payload(spec, state)
+    payload.prev_randao = Bytes32(b"\x66" * 32)
+    payload.block_hash = Bytes32(compute_el_block_hash(spec, payload))
+    yield from run_execution_payload_processing(spec, state, payload, valid=False)
+
+
+@with_phases(["bellatrix"])
+@spec_state_test
+def test_execution_payload_invalid_wrong_timestamp(spec, state):
+    next_slot(spec, state)
+    payload = build_empty_execution_payload(spec, state)
+    payload.timestamp = int(payload.timestamp) + 1
+    payload.block_hash = Bytes32(compute_el_block_hash(spec, payload))
+    yield from run_execution_payload_processing(spec, state, payload, valid=False)
+
+
+@with_phases(["bellatrix"])
+@spec_state_test
+def test_execution_payload_invalid_wrong_parent_hash(spec, state):
+    next_slot(spec, state)
+    payload = build_empty_execution_payload(spec, state)
+    payload.parent_hash = Bytes32(b"\x77" * 32)
+    payload.block_hash = Bytes32(compute_el_block_hash(spec, payload))
+    yield from run_execution_payload_processing(spec, state, payload, valid=False)
+
+
+@with_phases(["bellatrix"])
+@spec_state_test
+def test_execution_payload_engine_rejects(spec, state):
+    next_slot(spec, state)
+    payload = build_empty_execution_payload(spec, state)
+    yield from run_execution_payload_processing(
+        spec, state, payload, valid=True, execution_valid=False
+    )
+
+
+@with_phases(["bellatrix"])
+@spec_state_test
+def test_execution_payload_empty_transaction_rejected(spec, state):
+    # verify_and_notify_new_payload itself rejects a zero-length transaction
+    next_slot(spec, state)
+    payload = build_empty_execution_payload(spec, state)
+    payload.transactions = [b""]
+    payload.block_hash = Bytes32(compute_el_block_hash(spec, payload))
+    yield from run_execution_payload_processing(spec, state, payload, valid=False)
+
+
+@with_phases(["bellatrix"])
+@spec_state_test
+def test_merge_transition_predicates(spec, state):
+    # genesis test state is merge-complete; a pre-merge state is not
+    assert spec.is_merge_transition_complete(state)
+    pre_merge = state.copy()
+    pre_merge.latest_execution_payload_header = spec.ExecutionPayloadHeader()
+    assert not spec.is_merge_transition_complete(pre_merge)
+    empty_body = spec.BeaconBlockBody()
+    assert not spec.is_merge_transition_block(pre_merge, empty_body)
+    assert not spec.is_execution_enabled(pre_merge, empty_body)
+    body = spec.BeaconBlockBody()
+    body.execution_payload.block_number = 1
+    assert spec.is_merge_transition_block(pre_merge, body)
+    assert spec.is_execution_enabled(pre_merge, body)

@@ -1,0 +1,24 @@
+"""altair -> bellatrix state upgrade (spec: specs/bellatrix/fork.md)."""
+
+from eth_consensus_specs_tpu.forks import get_spec
+from eth_consensus_specs_tpu.ssz import hash_tree_root
+from eth_consensus_specs_tpu.test_infra.context import spec_state_test, with_phases
+from eth_consensus_specs_tpu.test_infra.state import next_epoch
+
+
+@with_phases(["altair"])
+@spec_state_test
+def test_upgrade_to_bellatrix_basic(spec, state):
+    bell = get_spec("bellatrix", spec.preset_name)
+    next_epoch(spec, state)
+    post = bell.upgrade_from_parent(state)
+    assert bytes(post.fork.current_version) == bytes(bell.config.BELLATRIX_FORK_VERSION)
+    assert bytes(post.fork.previous_version) == bytes(state.fork.current_version)
+    assert hash_tree_root(post.validators) == hash_tree_root(state.validators)
+    assert hash_tree_root(post.current_sync_committee) == hash_tree_root(
+        state.current_sync_committee
+    )
+    # empty payload header: the merge has not happened yet on upgrade
+    assert not bell.is_merge_transition_complete(post)
+    # the upgraded state runs under the bellatrix machine
+    next_epoch(bell, post)
