@@ -280,19 +280,34 @@ class BellatrixSpec(AltairSpec):
         if bytes(self.config.TERMINAL_BLOCK_HASH) != b"\x00" * 32:
             # terminal-hash override path
             assert (
-                self.get_current_store_epoch_for_merge()
+                self.compute_epoch_at_slot(int(block.slot))
                 >= self.config.TERMINAL_BLOCK_HASH_ACTIVATION_EPOCH
-            )
+            ), "terminal block hash override not yet active"
             assert block.body.execution_payload.parent_hash == Bytes32(
                 self.config.TERMINAL_BLOCK_HASH
-            )
+            ), "payload parent is not the terminal block"
             return
         pow_block = self.get_pow_block(block.body.execution_payload.parent_hash)
         pow_parent = self.get_pow_block(pow_block.parent_hash)
         assert self.is_valid_terminal_pow_block(pow_block, pow_parent), "invalid terminal block"
 
-    def get_current_store_epoch_for_merge(self) -> int:  # pragma: no cover
-        raise NotImplementedError("bound to a Store by the fork-choice driver")
+    # == genesis (reference: bellatrix beacon-chain.md Testing section) ====
+
+    def initialize_beacon_state_from_eth1(
+        self, eth1_block_hash, eth1_timestamp, deposits, execution_payload_header=None
+    ):
+        state = super().initialize_beacon_state_from_eth1(
+            eth1_block_hash, eth1_timestamp, deposits
+        )
+        state.fork = self.Fork(
+            previous_version=Version(self.config[f"{self.fork_name.upper()}_FORK_VERSION"]),
+            current_version=Version(self.config[f"{self.fork_name.upper()}_FORK_VERSION"]),
+            epoch=self.GENESIS_EPOCH,
+        )
+        if execution_payload_header is not None:
+            # pre-merge genesis keeps the empty default header
+            state.latest_execution_payload_header = execution_payload_header
+        return state
 
     # == fork upgrade (specs/bellatrix/fork.md) ============================
 

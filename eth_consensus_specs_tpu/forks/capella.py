@@ -1,0 +1,397 @@
+"""capella: withdrawals, BLS-to-execution credential changes, historical
+summaries.
+
+Behavioral parity targets (reference, by section):
+  * state machine:  specs/capella/beacon-chain.md (Withdrawal :96,
+    get_expected_withdrawals :339, process_withdrawals :377,
+    process_bls_to_execution_change :475, historical summaries :307)
+  * fork upgrade:   specs/capella/fork.md (upgrade_to_capella)
+
+Architecture note: the withdrawals sweep is a bounded circular scan over
+the registry — on the columnar path this is a masked window reduction
+(future ops/withdrawals kernel); the object path here is the semantics
+oracle.
+"""
+
+from eth_consensus_specs_tpu.ssz import (
+    Bitvector,
+    ByteList,
+    ByteVector,
+    Bytes32,
+    Container,
+    List,
+    Vector,
+    hash_tree_root,
+    uint64,
+    uint256,
+)
+from eth_consensus_specs_tpu.utils import bls
+
+from .altair import ParticipationFlags
+from .bellatrix import BellatrixSpec, ExecutionAddress, Hash32
+from .phase0 import (
+    BLSPubkey,
+    BLSSignature,
+    DomainType,
+    Gwei,
+    Root,
+    Slot,
+    ValidatorIndex,
+    Version,
+)
+
+WithdrawalIndex = uint64
+
+
+class CapellaSpec(BellatrixSpec):
+    fork_name = "capella"
+
+    DOMAIN_BLS_TO_EXECUTION_CHANGE = DomainType(b"\x0a\x00\x00\x00")
+
+    # == type system ======================================================
+
+    def _build_types(self) -> None:
+        super()._build_types()
+        P = self
+
+        class Withdrawal(Container):
+            index: WithdrawalIndex
+            validator_index: ValidatorIndex
+            address: ExecutionAddress
+            amount: Gwei
+
+        class BLSToExecutionChange(Container):
+            validator_index: ValidatorIndex
+            from_bls_pubkey: BLSPubkey
+            to_execution_address: ExecutionAddress
+
+        class SignedBLSToExecutionChange(Container):
+            message: BLSToExecutionChange
+            signature: BLSSignature
+
+        class HistoricalSummary(Container):
+            # hash_tree_root-compatible with phase0 HistoricalBatch
+            block_summary_root: Root
+            state_summary_root: Root
+
+        class ExecutionPayload(Container):
+            parent_hash: Hash32
+            fee_recipient: ExecutionAddress
+            state_root: Bytes32
+            receipts_root: Bytes32
+            logs_bloom: ByteVector[P.BYTES_PER_LOGS_BLOOM]
+            prev_randao: Bytes32
+            block_number: uint64
+            gas_limit: uint64
+            gas_used: uint64
+            timestamp: uint64
+            extra_data: ByteList[P.MAX_EXTRA_DATA_BYTES]
+            base_fee_per_gas: uint256
+            block_hash: Hash32
+            transactions: List[P.Transaction, P.MAX_TRANSACTIONS_PER_PAYLOAD]
+            withdrawals: List[Withdrawal, P.MAX_WITHDRAWALS_PER_PAYLOAD]  # [New in Capella]
+
+        class ExecutionPayloadHeader(Container):
+            parent_hash: Hash32
+            fee_recipient: ExecutionAddress
+            state_root: Bytes32
+            receipts_root: Bytes32
+            logs_bloom: ByteVector[P.BYTES_PER_LOGS_BLOOM]
+            prev_randao: Bytes32
+            block_number: uint64
+            gas_limit: uint64
+            gas_used: uint64
+            timestamp: uint64
+            extra_data: ByteList[P.MAX_EXTRA_DATA_BYTES]
+            base_fee_per_gas: uint256
+            block_hash: Hash32
+            transactions_root: Root
+            withdrawals_root: Root  # [New in Capella]
+
+        class BeaconBlockBody(Container):
+            randao_reveal: BLSSignature
+            eth1_data: P.Eth1Data
+            graffiti: Bytes32
+            proposer_slashings: List[P.ProposerSlashing, P.MAX_PROPOSER_SLASHINGS]
+            attester_slashings: List[P.AttesterSlashing, P.MAX_ATTESTER_SLASHINGS]
+            attestations: List[P.Attestation, P.MAX_ATTESTATIONS]
+            deposits: List[P.Deposit, P.MAX_DEPOSITS]
+            voluntary_exits: List[P.SignedVoluntaryExit, P.MAX_VOLUNTARY_EXITS]
+            sync_aggregate: P.SyncAggregate
+            execution_payload: ExecutionPayload
+            bls_to_execution_changes: List[
+                SignedBLSToExecutionChange, P.MAX_BLS_TO_EXECUTION_CHANGES
+            ]  # [New in Capella]
+
+        class BeaconBlock(Container):
+            slot: Slot
+            proposer_index: ValidatorIndex
+            parent_root: Root
+            state_root: Root
+            body: BeaconBlockBody
+
+        class SignedBeaconBlock(Container):
+            message: BeaconBlock
+            signature: BLSSignature
+
+        class BeaconState(Container):
+            genesis_time: uint64
+            genesis_validators_root: Root
+            slot: Slot
+            fork: P.Fork
+            latest_block_header: P.BeaconBlockHeader
+            block_roots: Vector[Root, P.SLOTS_PER_HISTORICAL_ROOT]
+            state_roots: Vector[Root, P.SLOTS_PER_HISTORICAL_ROOT]
+            historical_roots: List[Root, P.HISTORICAL_ROOTS_LIMIT]
+            eth1_data: P.Eth1Data
+            eth1_data_votes: List[P.Eth1Data, P.EPOCHS_PER_ETH1_VOTING_PERIOD * P.SLOTS_PER_EPOCH]
+            eth1_deposit_index: uint64
+            validators: List[P.Validator, P.VALIDATOR_REGISTRY_LIMIT]
+            balances: List[Gwei, P.VALIDATOR_REGISTRY_LIMIT]
+            randao_mixes: Vector[Bytes32, P.EPOCHS_PER_HISTORICAL_VECTOR]
+            slashings: Vector[Gwei, P.EPOCHS_PER_SLASHINGS_VECTOR]
+            previous_epoch_participation: List[ParticipationFlags, P.VALIDATOR_REGISTRY_LIMIT]
+            current_epoch_participation: List[ParticipationFlags, P.VALIDATOR_REGISTRY_LIMIT]
+            justification_bits: Bitvector[self.JUSTIFICATION_BITS_LENGTH]
+            previous_justified_checkpoint: P.Checkpoint
+            current_justified_checkpoint: P.Checkpoint
+            finalized_checkpoint: P.Checkpoint
+            inactivity_scores: List[uint64, P.VALIDATOR_REGISTRY_LIMIT]
+            current_sync_committee: P.SyncCommittee
+            next_sync_committee: P.SyncCommittee
+            latest_execution_payload_header: ExecutionPayloadHeader
+            next_withdrawal_index: WithdrawalIndex  # [New in Capella]
+            next_withdrawal_validator_index: ValidatorIndex  # [New in Capella]
+            historical_summaries: List[
+                HistoricalSummary, P.HISTORICAL_ROOTS_LIMIT
+            ]  # [New in Capella]
+
+        for name, typ in list(locals().items()):
+            if isinstance(typ, type) and issubclass(typ, Container):
+                typ.__name__ = name
+                setattr(self, name, typ)
+
+    # == predicates ========================================================
+
+    def has_eth1_withdrawal_credential(self, validator) -> bool:
+        return bytes(validator.withdrawal_credentials)[:1] == self.ETH1_ADDRESS_WITHDRAWAL_PREFIX
+
+    def is_fully_withdrawable_validator(self, validator, balance: int, epoch: int) -> bool:
+        return (
+            self.has_eth1_withdrawal_credential(validator)
+            and int(validator.withdrawable_epoch) <= epoch
+            and int(balance) > 0
+        )
+
+    def is_partially_withdrawable_validator(self, validator, balance: int) -> bool:
+        return (
+            self.has_eth1_withdrawal_credential(validator)
+            and int(validator.effective_balance) == self.MAX_EFFECTIVE_BALANCE
+            and int(balance) > self.MAX_EFFECTIVE_BALANCE
+        )
+
+    # == epoch processing ==================================================
+
+    def process_historical_summaries_update(self, state) -> None:
+        next_epoch = self.get_current_epoch(state) + 1
+        if next_epoch % (self.SLOTS_PER_HISTORICAL_ROOT // self.SLOTS_PER_EPOCH) == 0:
+            state.historical_summaries.append(
+                self.HistoricalSummary(
+                    block_summary_root=hash_tree_root(state.block_roots),
+                    state_summary_root=hash_tree_root(state.state_roots),
+                )
+            )
+
+    # capella swaps historical ROOTS accumulation for summaries
+    def process_historical_roots_update(self, state) -> None:
+        self.process_historical_summaries_update(state)
+
+    # == block processing ==================================================
+
+    def process_block(self, state, block) -> None:
+        self.process_block_header(state, block)
+        self.process_withdrawals(state, block.body.execution_payload)  # [New in Capella]
+        self.process_execution_payload(state, block.body, self.EXECUTION_ENGINE)
+        self.process_randao(state, block.body)
+        self.process_eth1_data(state, block.body)
+        self.process_operations(state, block.body)
+        self.process_sync_aggregate(state, block.body.sync_aggregate)
+
+    def get_expected_withdrawals(self, state):
+        """Bounded circular sweep over the registry collecting full and
+        partial (excess-balance) withdrawals."""
+        epoch = self.get_current_epoch(state)
+        withdrawal_index = int(state.next_withdrawal_index)
+        validator_index = int(state.next_withdrawal_validator_index)
+        withdrawals = []
+        bound = min(len(state.validators), self.MAX_VALIDATORS_PER_WITHDRAWALS_SWEEP)
+        for _ in range(bound):
+            validator = state.validators[validator_index]
+            balance = int(state.balances[validator_index])
+            address = bytes(validator.withdrawal_credentials)[12:]
+            if self.is_fully_withdrawable_validator(validator, balance, epoch):
+                withdrawals.append(
+                    self.Withdrawal(
+                        index=withdrawal_index,
+                        validator_index=validator_index,
+                        address=address,
+                        amount=balance,
+                    )
+                )
+                withdrawal_index += 1
+            elif self.is_partially_withdrawable_validator(validator, balance):
+                withdrawals.append(
+                    self.Withdrawal(
+                        index=withdrawal_index,
+                        validator_index=validator_index,
+                        address=address,
+                        amount=balance - self.MAX_EFFECTIVE_BALANCE,
+                    )
+                )
+                withdrawal_index += 1
+            if len(withdrawals) == self.MAX_WITHDRAWALS_PER_PAYLOAD:
+                break
+            validator_index = (validator_index + 1) % len(state.validators)
+        return withdrawals
+
+    def process_withdrawals(self, state, payload) -> None:
+        expected_withdrawals = self.get_expected_withdrawals(state)
+        assert list(payload.withdrawals) == expected_withdrawals, "withdrawals mismatch"
+
+        for withdrawal in expected_withdrawals:
+            self.decrease_balance(state, withdrawal.validator_index, withdrawal.amount)
+
+        if len(expected_withdrawals) != 0:
+            state.next_withdrawal_index = int(expected_withdrawals[-1].index) + 1
+
+        if len(expected_withdrawals) == self.MAX_WITHDRAWALS_PER_PAYLOAD:
+            # full payload: next sweep resumes right after the last paid index
+            state.next_withdrawal_validator_index = (
+                int(expected_withdrawals[-1].validator_index) + 1
+            ) % len(state.validators)
+        else:
+            # partial payload: jump the whole sweep window
+            state.next_withdrawal_validator_index = (
+                int(state.next_withdrawal_validator_index)
+                + self.MAX_VALIDATORS_PER_WITHDRAWALS_SWEEP
+            ) % len(state.validators)
+
+    def process_execution_payload(self, state, body, execution_engine) -> None:
+        payload = body.execution_payload
+        # capella removes the merge-transition branch: parent always checked
+        assert (
+            payload.parent_hash == state.latest_execution_payload_header.block_hash
+        ), "payload parent mismatch"
+        assert payload.prev_randao == self.get_randao_mix(
+            state, self.get_current_epoch(state)
+        ), "wrong prev_randao"
+        assert payload.timestamp == self.compute_timestamp_at_slot(
+            state, state.slot
+        ), "wrong payload timestamp"
+        assert execution_engine.verify_and_notify_new_payload(
+            self.NewPayloadRequest(execution_payload=payload)
+        ), "execution engine rejected payload"
+        state.latest_execution_payload_header = self.execution_payload_to_header(payload)
+
+    def execution_payload_to_header(self, payload):
+        return self.ExecutionPayloadHeader(
+            parent_hash=payload.parent_hash,
+            fee_recipient=payload.fee_recipient,
+            state_root=payload.state_root,
+            receipts_root=payload.receipts_root,
+            logs_bloom=payload.logs_bloom,
+            prev_randao=payload.prev_randao,
+            block_number=payload.block_number,
+            gas_limit=payload.gas_limit,
+            gas_used=payload.gas_used,
+            timestamp=payload.timestamp,
+            extra_data=payload.extra_data,
+            base_fee_per_gas=payload.base_fee_per_gas,
+            block_hash=payload.block_hash,
+            transactions_root=hash_tree_root(payload.transactions),
+            withdrawals_root=hash_tree_root(payload.withdrawals),
+        )
+
+    def process_operations(self, state, body) -> None:
+        super().process_operations(state, body)
+        for operation in body.bls_to_execution_changes:
+            self.process_bls_to_execution_change(state, operation)
+
+    def process_bls_to_execution_change(self, state, signed_address_change) -> None:
+        address_change = signed_address_change.message
+        assert address_change.validator_index < len(state.validators), "unknown validator"
+        validator = state.validators[int(address_change.validator_index)]
+        creds = bytes(validator.withdrawal_credentials)
+        assert creds[:1] == self.BLS_WITHDRAWAL_PREFIX, "not a BLS credential"
+        assert creds[1:] == self.hash(address_change.from_bls_pubkey)[1:], "pubkey mismatch"
+        # fork-agnostic domain: address changes stay valid across forks
+        domain = self.compute_domain(
+            self.DOMAIN_BLS_TO_EXECUTION_CHANGE,
+            genesis_validators_root=state.genesis_validators_root,
+        )
+        signing_root = self.compute_signing_root(address_change, domain)
+        assert bls.Verify(
+            address_change.from_bls_pubkey, signing_root, signed_address_change.signature
+        ), "bad credential-change signature"
+        validator.withdrawal_credentials = Bytes32(
+            bytes(self.ETH1_ADDRESS_WITHDRAWAL_PREFIX)
+            + b"\x00" * 11
+            + bytes(address_change.to_execution_address)
+        )
+
+    # == fork upgrade (specs/capella/fork.md) ==============================
+
+    def upgrade_from_parent(self, pre):
+        epoch = self.compute_epoch_at_slot(int(pre.slot))
+        pre_header = pre.latest_execution_payload_header
+        header = self.ExecutionPayloadHeader(
+            parent_hash=pre_header.parent_hash,
+            fee_recipient=pre_header.fee_recipient,
+            state_root=pre_header.state_root,
+            receipts_root=pre_header.receipts_root,
+            logs_bloom=pre_header.logs_bloom,
+            prev_randao=pre_header.prev_randao,
+            block_number=pre_header.block_number,
+            gas_limit=pre_header.gas_limit,
+            gas_used=pre_header.gas_used,
+            timestamp=pre_header.timestamp,
+            extra_data=pre_header.extra_data,
+            base_fee_per_gas=pre_header.base_fee_per_gas,
+            block_hash=pre_header.block_hash,
+            transactions_root=pre_header.transactions_root,
+            # withdrawals_root defaults to zero
+        )
+        return self.BeaconState(
+            genesis_time=pre.genesis_time,
+            genesis_validators_root=pre.genesis_validators_root,
+            slot=pre.slot,
+            fork=self.Fork(
+                previous_version=pre.fork.current_version,
+                current_version=Version(self.config.CAPELLA_FORK_VERSION),
+                epoch=epoch,
+            ),
+            latest_block_header=pre.latest_block_header,
+            block_roots=list(pre.block_roots),
+            state_roots=list(pre.state_roots),
+            historical_roots=list(pre.historical_roots),
+            eth1_data=pre.eth1_data,
+            eth1_data_votes=list(pre.eth1_data_votes),
+            eth1_deposit_index=pre.eth1_deposit_index,
+            validators=list(pre.validators),
+            balances=list(pre.balances),
+            randao_mixes=list(pre.randao_mixes),
+            slashings=list(pre.slashings),
+            previous_epoch_participation=list(pre.previous_epoch_participation),
+            current_epoch_participation=list(pre.current_epoch_participation),
+            justification_bits=list(pre.justification_bits),
+            previous_justified_checkpoint=pre.previous_justified_checkpoint,
+            current_justified_checkpoint=pre.current_justified_checkpoint,
+            finalized_checkpoint=pre.finalized_checkpoint,
+            inactivity_scores=list(pre.inactivity_scores),
+            current_sync_committee=pre.current_sync_committee,
+            next_sync_committee=pre.next_sync_committee,
+            latest_execution_payload_header=header,
+            next_withdrawal_index=0,
+            next_withdrawal_validator_index=0,
+            historical_summaries=[],
+        )

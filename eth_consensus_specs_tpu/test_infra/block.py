@@ -6,6 +6,7 @@ from __future__ import annotations
 from eth_consensus_specs_tpu.ssz import hash_tree_root
 from eth_consensus_specs_tpu.utils import bls
 
+from .execution_payload import build_empty_execution_payload
 from .forks import is_post_altair, is_post_bellatrix
 from .keys import privkeys
 from .state import latest_block_root
@@ -34,8 +35,6 @@ def build_empty_block(spec, state, slot=None, proposer_index=None):
         # an empty sync aggregate is valid only with the infinity signature
         block.body.sync_aggregate.sync_committee_signature = bls.G2_POINT_AT_INFINITY
     if is_post_bellatrix(spec):
-        from .execution_payload import build_empty_execution_payload
-
         block.body.execution_payload = build_empty_execution_payload(spec, lookahead_state)
     return block
 

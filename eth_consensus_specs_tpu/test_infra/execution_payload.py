@@ -8,6 +8,8 @@ from __future__ import annotations
 
 from eth_consensus_specs_tpu.ssz import Bytes32
 
+from .forks import is_post_capella
+
 GENESIS_BLOCK_HASH = b"\x30" * 32
 DEFAULT_GAS_LIMIT = 30_000_000
 DEFAULT_BASE_FEE = 1_000_000_000
@@ -54,5 +56,8 @@ def build_empty_execution_payload(spec, state, randao_mix=None):
         base_fee_per_gas=int(latest.base_fee_per_gas),
         transactions=[],
     )
+    if is_post_capella(spec):
+        # process_withdrawals checks the payload against the state's sweep
+        payload.withdrawals = spec.get_expected_withdrawals(state)
     payload.block_hash = Bytes32(compute_el_block_hash(spec, payload))
     return payload

@@ -19,6 +19,7 @@ from .forks import (
     is_post_bellatrix,
     previous_fork_version_of,
 )
+from .execution_payload import genesis_execution_payload_header
 from .keys import pubkey
 
 ETH1_GENESIS_HASH = b"\x42" * 32
@@ -77,8 +78,6 @@ def create_genesis_state(spec, validator_balances: list[int], activation_thresho
         state.current_sync_committee = committee
         state.next_sync_committee = committee
     if is_post_bellatrix(spec):
-        from .execution_payload import genesis_execution_payload_header
-
         # non-empty header: merge complete from genesis in tests
         state.latest_execution_payload_header = genesis_execution_payload_header(spec)
     return state

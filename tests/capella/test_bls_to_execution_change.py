@@ -1,0 +1,92 @@
+"""BLS-to-execution credential changes (reference analogue:
+test/capella/block_processing/test_process_bls_to_execution_change.py)."""
+
+from eth_consensus_specs_tpu.test_infra.context import (
+    always_bls,
+    expect_assertion_error,
+    spec_state_test,
+    with_phases,
+)
+from eth_consensus_specs_tpu.test_infra.keys import privkeys, pubkeys
+from eth_consensus_specs_tpu.utils import bls
+
+TO_ADDRESS = b"\x59" * 20
+
+
+def make_signed_address_change(spec, state, index: int, key_index: int | None = None):
+    """Sign with key `key_index` (defaults to the credential's own key)."""
+    if key_index is None:
+        key_index = index
+    from_pubkey = pubkeys[key_index]
+    change = spec.BLSToExecutionChange(
+        validator_index=index, from_bls_pubkey=from_pubkey, to_execution_address=TO_ADDRESS
+    )
+    domain = spec.compute_domain(
+        spec.DOMAIN_BLS_TO_EXECUTION_CHANGE,
+        genesis_validators_root=state.genesis_validators_root,
+    )
+    signing_root = spec.compute_signing_root(change, domain)
+    return spec.SignedBLSToExecutionChange(
+        message=change, signature=bls.Sign(privkeys[key_index], signing_root)
+    )
+
+
+def run_bls_change_processing(spec, state, signed_change, valid=True):
+    yield "pre", state
+    yield "address_change", signed_change
+    if not valid:
+        expect_assertion_error(
+            lambda: spec.process_bls_to_execution_change(state, signed_change)
+        )
+        yield "post", None
+        return
+    spec.process_bls_to_execution_change(state, signed_change)
+    yield "post", state
+    creds = bytes(state.validators[int(signed_change.message.validator_index)].withdrawal_credentials)
+    assert creds[:1] == spec.ETH1_ADDRESS_WITHDRAWAL_PREFIX
+    assert creds[12:] == bytes(signed_change.message.to_execution_address)
+
+
+@with_phases(["capella"])
+@always_bls
+@spec_state_test
+def test_bls_change_success(spec, state):
+    yield from run_bls_change_processing(spec, state, make_signed_address_change(spec, state, 0))
+
+
+@with_phases(["capella"])
+@always_bls
+@spec_state_test
+def test_bls_change_invalid_wrong_key(spec, state):
+    # credentials commit to key 0; signing (and claiming) key 1 must fail
+    signed = make_signed_address_change(spec, state, 0, key_index=1)
+    yield from run_bls_change_processing(spec, state, signed, valid=False)
+
+
+@with_phases(["capella"])
+@always_bls
+@spec_state_test
+def test_bls_change_invalid_already_eth1(spec, state):
+    state.validators[0].withdrawal_credentials = (
+        bytes(spec.ETH1_ADDRESS_WITHDRAWAL_PREFIX) + b"\x00" * 11 + b"\x11" * 20
+    )
+    signed = make_signed_address_change(spec, state, 0)
+    yield from run_bls_change_processing(spec, state, signed, valid=False)
+
+
+@with_phases(["capella"])
+@always_bls
+@spec_state_test
+def test_bls_change_invalid_bad_signature(spec, state):
+    signed = make_signed_address_change(spec, state, 0)
+    signed.signature = bls.Sign(privkeys[0], b"\x99" * 32)
+    yield from run_bls_change_processing(spec, state, signed, valid=False)
+
+
+@with_phases(["capella"])
+@spec_state_test
+def test_bls_change_then_withdrawable(spec, state):
+    # after the change, the validator has eth1 credentials and can be swept
+    signed = make_signed_address_change(spec, state, 3)
+    spec.process_bls_to_execution_change(state, signed)
+    assert spec.has_eth1_withdrawal_credential(state.validators[3])

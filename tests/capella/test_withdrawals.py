@@ -1,0 +1,118 @@
+"""Withdrawals processing (reference analogue:
+test/capella/block_processing/test_process_withdrawals.py)."""
+
+from eth_consensus_specs_tpu.test_infra.context import (
+    expect_assertion_error,
+    spec_state_test,
+    with_phases,
+)
+from eth_consensus_specs_tpu.test_infra.execution_payload import (
+    build_empty_execution_payload,
+)
+from eth_consensus_specs_tpu.test_infra.state import next_slot
+
+ETH1_ADDRESS = b"\x42" * 20
+
+
+def set_eth1_credentials(spec, state, index: int) -> None:
+    state.validators[index].withdrawal_credentials = (
+        bytes(spec.ETH1_ADDRESS_WITHDRAWAL_PREFIX) + b"\x00" * 11 + ETH1_ADDRESS
+    )
+
+
+def prepare_partial_withdrawal(spec, state, index: int, excess: int = 10**9) -> None:
+    set_eth1_credentials(spec, state, index)
+    state.balances[index] = spec.MAX_EFFECTIVE_BALANCE + excess
+    state.validators[index].effective_balance = spec.MAX_EFFECTIVE_BALANCE
+
+
+def prepare_full_withdrawal(spec, state, index: int) -> None:
+    set_eth1_credentials(spec, state, index)
+    state.validators[index].withdrawable_epoch = spec.get_current_epoch(state)
+    state.validators[index].exit_epoch = spec.get_current_epoch(state)
+
+
+def run_withdrawals_processing(spec, state, payload, valid=True):
+    yield "pre", state
+    yield "execution_payload", payload
+    if not valid:
+        expect_assertion_error(lambda: spec.process_withdrawals(state, payload))
+        yield "post", None
+        return
+    spec.process_withdrawals(state, payload)
+    yield "post", state
+
+
+@with_phases(["capella"])
+@spec_state_test
+def test_withdrawals_none_expected(spec, state):
+    next_slot(spec, state)
+    payload = build_empty_execution_payload(spec, state)
+    assert len(payload.withdrawals) == 0
+    yield from run_withdrawals_processing(spec, state, payload)
+    # partial sweep: index jumps by the sweep window
+    assert int(state.next_withdrawal_validator_index) == (
+        spec.MAX_VALIDATORS_PER_WITHDRAWALS_SWEEP % len(state.validators)
+    )
+
+
+@with_phases(["capella"])
+@spec_state_test
+def test_withdrawals_partial(spec, state):
+    next_slot(spec, state)
+    prepare_partial_withdrawal(spec, state, 1, excess=7 * 10**8)
+    payload = build_empty_execution_payload(spec, state)
+    assert len(payload.withdrawals) == 1
+    assert int(payload.withdrawals[0].amount) == 7 * 10**8
+    pre_balance = int(state.balances[1])
+    yield from run_withdrawals_processing(spec, state, payload)
+    assert int(state.balances[1]) == pre_balance - 7 * 10**8
+    assert int(state.next_withdrawal_index) == 1
+
+
+@with_phases(["capella"])
+@spec_state_test
+def test_withdrawals_full(spec, state):
+    next_slot(spec, state)
+    prepare_full_withdrawal(spec, state, 2)
+    payload = build_empty_execution_payload(spec, state)
+    assert len(payload.withdrawals) == 1
+    assert int(payload.withdrawals[0].amount) == int(state.balances[2])
+    yield from run_withdrawals_processing(spec, state, payload)
+    assert int(state.balances[2]) == 0
+
+
+@with_phases(["capella"])
+@spec_state_test
+def test_withdrawals_full_payload_advances_sweep(spec, state):
+    next_slot(spec, state)
+    for i in range(spec.MAX_WITHDRAWALS_PER_PAYLOAD + 2):
+        prepare_partial_withdrawal(spec, state, i)
+    payload = build_empty_execution_payload(spec, state)
+    assert len(payload.withdrawals) == spec.MAX_WITHDRAWALS_PER_PAYLOAD
+    yield from run_withdrawals_processing(spec, state, payload)
+    # full payload: sweep resumes after the last paid validator
+    last_paid = int(payload.withdrawals[-1].validator_index)
+    assert int(state.next_withdrawal_validator_index) == (last_paid + 1) % len(
+        state.validators
+    )
+
+
+@with_phases(["capella"])
+@spec_state_test
+def test_withdrawals_invalid_missing(spec, state):
+    next_slot(spec, state)
+    prepare_partial_withdrawal(spec, state, 1)
+    payload = build_empty_execution_payload(spec, state)
+    payload.withdrawals = []
+    yield from run_withdrawals_processing(spec, state, payload, valid=False)
+
+
+@with_phases(["capella"])
+@spec_state_test
+def test_withdrawals_invalid_wrong_amount(spec, state):
+    next_slot(spec, state)
+    prepare_partial_withdrawal(spec, state, 1)
+    payload = build_empty_execution_payload(spec, state)
+    payload.withdrawals[0].amount = int(payload.withdrawals[0].amount) + 1
+    yield from run_withdrawals_processing(spec, state, payload, valid=False)
