@@ -1,0 +1,545 @@
+"""deneb: blobs (EIP-4844) with KZG commitments, extended attestation
+inclusion (EIP-7045), fixed exit domain (EIP-7044), activation churn cap
+(EIP-7514), parent-beacon-root in the engine API (EIP-4788).
+
+Behavioral parity targets (reference, by section):
+  * state machine:  specs/deneb/beacon-chain.md (blob commitment checks
+    :428, EIP-7045 process_attestation :375, EIP-7044 exits :492,
+    EIP-7514 registry :522)
+  * KZG:            specs/deneb/polynomial-commitments.md — implemented in
+    crypto/kzg.py and re-exposed as spec methods here
+  * fork choice:    specs/deneb/fork-choice.md (is_data_available gate)
+  * p2p types:      specs/deneb/p2p-interface.md (BlobSidecar, inclusion
+    proof verification)
+
+The blob-proof batch verification is the framework's canonical batching
+seam: N proofs -> one pairing via random linear combination, with all
+scalar*point work in the Pippenger MSM (device kernel slot).
+"""
+
+from eth_consensus_specs_tpu.crypto import kzg as _kzg
+from eth_consensus_specs_tpu.ssz import (
+    Bitvector,
+    ByteList,
+    ByteVector,
+    Bytes32,
+    Bytes48,
+    Container,
+    List,
+    Vector,
+    hash_tree_root,
+    uint64,
+    uint256,
+)
+from eth_consensus_specs_tpu.utils import bls
+
+from .altair import ParticipationFlags
+from .bellatrix import ExecutionAddress, Hash32, NoopExecutionEngine
+from .capella import CapellaSpec, WithdrawalIndex
+from .phase0 import (
+    BLSPubkey,
+    BLSSignature,
+    Gwei,
+    Root,
+    Slot,
+    ValidatorIndex,
+    Version,
+)
+
+KZGCommitment = Bytes48
+KZGProof = Bytes48
+VersionedHash = Bytes32
+BlobIndex = uint64
+
+
+class DenebExecutionEngine(NoopExecutionEngine):
+    """Adds the deneb request-shape checks (versioned hashes, parent root)."""
+
+    def is_valid_block_hash(self, execution_payload, parent_beacon_block_root) -> bool:
+        return True
+
+    def is_valid_versioned_hashes(self, new_payload_request) -> bool:
+        return True
+
+    def notify_new_payload(self, execution_payload, parent_beacon_block_root) -> bool:
+        return True
+
+    def verify_and_notify_new_payload(self, new_payload_request) -> bool:
+        execution_payload = new_payload_request.execution_payload
+        parent_beacon_block_root = new_payload_request.parent_beacon_block_root
+        if b"" in [bytes(tx) for tx in execution_payload.transactions]:
+            return False
+        if not self.is_valid_block_hash(execution_payload, parent_beacon_block_root):
+            return False
+        if not self.is_valid_versioned_hashes(new_payload_request):
+            return False
+        if not self.notify_new_payload(execution_payload, parent_beacon_block_root):
+            return False
+        return True
+
+
+class DenebSpec(CapellaSpec):
+    fork_name = "deneb"
+
+    VERSIONED_HASH_VERSION_KZG = b"\x01"
+
+    # KZG constants (specs/deneb/polynomial-commitments.md)
+    BLS_MODULUS = _kzg.BLS_MODULUS
+    BYTES_PER_FIELD_ELEMENT = _kzg.BYTES_PER_FIELD_ELEMENT
+    BYTES_PER_BLOB = _kzg.BYTES_PER_BLOB
+    BYTES_PER_COMMITMENT = _kzg.BYTES_PER_COMMITMENT
+    BYTES_PER_PROOF = _kzg.BYTES_PER_PROOF
+    G1_POINT_AT_INFINITY = _kzg.G1_POINT_AT_INFINITY
+    KZG_ENDIANNESS = _kzg.KZG_ENDIANNESS
+    PRIMITIVE_ROOT_OF_UNITY = _kzg.PRIMITIVE_ROOT_OF_UNITY
+    FIAT_SHAMIR_PROTOCOL_DOMAIN = _kzg.FIAT_SHAMIR_PROTOCOL_DOMAIN
+    RANDOM_CHALLENGE_KZG_BATCH_DOMAIN = _kzg.RANDOM_CHALLENGE_KZG_BATCH_DOMAIN
+
+    def __init__(self, *args, **kwargs):
+        super().__init__(*args, **kwargs)
+        self.EXECUTION_ENGINE = DenebExecutionEngine()
+
+    # == type system ======================================================
+
+    def _build_types(self) -> None:
+        super()._build_types()
+        P = self
+        Blob = ByteVector[P.BYTES_PER_FIELD_ELEMENT * P.FIELD_ELEMENTS_PER_BLOB]
+        self.Blob = Blob
+        self.KZGCommitment = KZGCommitment
+        self.KZGProof = KZGProof
+
+        class ExecutionPayload(Container):
+            parent_hash: Hash32
+            fee_recipient: ExecutionAddress
+            state_root: Bytes32
+            receipts_root: Bytes32
+            logs_bloom: ByteVector[P.BYTES_PER_LOGS_BLOOM]
+            prev_randao: Bytes32
+            block_number: uint64
+            gas_limit: uint64
+            gas_used: uint64
+            timestamp: uint64
+            extra_data: ByteList[P.MAX_EXTRA_DATA_BYTES]
+            base_fee_per_gas: uint256
+            block_hash: Hash32
+            transactions: List[P.Transaction, P.MAX_TRANSACTIONS_PER_PAYLOAD]
+            withdrawals: List[P.Withdrawal, P.MAX_WITHDRAWALS_PER_PAYLOAD]
+            blob_gas_used: uint64  # [New in Deneb]
+            excess_blob_gas: uint64  # [New in Deneb]
+
+        class ExecutionPayloadHeader(Container):
+            parent_hash: Hash32
+            fee_recipient: ExecutionAddress
+            state_root: Bytes32
+            receipts_root: Bytes32
+            logs_bloom: ByteVector[P.BYTES_PER_LOGS_BLOOM]
+            prev_randao: Bytes32
+            block_number: uint64
+            gas_limit: uint64
+            gas_used: uint64
+            timestamp: uint64
+            extra_data: ByteList[P.MAX_EXTRA_DATA_BYTES]
+            base_fee_per_gas: uint256
+            block_hash: Hash32
+            transactions_root: Root
+            withdrawals_root: Root
+            blob_gas_used: uint64  # [New in Deneb]
+            excess_blob_gas: uint64  # [New in Deneb]
+
+        class BeaconBlockBody(Container):
+            randao_reveal: BLSSignature
+            eth1_data: P.Eth1Data
+            graffiti: Bytes32
+            proposer_slashings: List[P.ProposerSlashing, P.MAX_PROPOSER_SLASHINGS]
+            attester_slashings: List[P.AttesterSlashing, P.MAX_ATTESTER_SLASHINGS]
+            attestations: List[P.Attestation, P.MAX_ATTESTATIONS]
+            deposits: List[P.Deposit, P.MAX_DEPOSITS]
+            voluntary_exits: List[P.SignedVoluntaryExit, P.MAX_VOLUNTARY_EXITS]
+            sync_aggregate: P.SyncAggregate
+            execution_payload: ExecutionPayload
+            bls_to_execution_changes: List[
+                P.SignedBLSToExecutionChange, P.MAX_BLS_TO_EXECUTION_CHANGES
+            ]
+            blob_kzg_commitments: List[
+                KZGCommitment, P.MAX_BLOB_COMMITMENTS_PER_BLOCK
+            ]  # [New in Deneb]
+
+        class BeaconBlock(Container):
+            slot: Slot
+            proposer_index: ValidatorIndex
+            parent_root: Root
+            state_root: Root
+            body: BeaconBlockBody
+
+        class SignedBeaconBlock(Container):
+            message: BeaconBlock
+            signature: BLSSignature
+
+        class BeaconState(Container):
+            genesis_time: uint64
+            genesis_validators_root: Root
+            slot: Slot
+            fork: P.Fork
+            latest_block_header: P.BeaconBlockHeader
+            block_roots: Vector[Root, P.SLOTS_PER_HISTORICAL_ROOT]
+            state_roots: Vector[Root, P.SLOTS_PER_HISTORICAL_ROOT]
+            historical_roots: List[Root, P.HISTORICAL_ROOTS_LIMIT]
+            eth1_data: P.Eth1Data
+            eth1_data_votes: List[P.Eth1Data, P.EPOCHS_PER_ETH1_VOTING_PERIOD * P.SLOTS_PER_EPOCH]
+            eth1_deposit_index: uint64
+            validators: List[P.Validator, P.VALIDATOR_REGISTRY_LIMIT]
+            balances: List[Gwei, P.VALIDATOR_REGISTRY_LIMIT]
+            randao_mixes: Vector[Bytes32, P.EPOCHS_PER_HISTORICAL_VECTOR]
+            slashings: Vector[Gwei, P.EPOCHS_PER_SLASHINGS_VECTOR]
+            previous_epoch_participation: List[ParticipationFlags, P.VALIDATOR_REGISTRY_LIMIT]
+            current_epoch_participation: List[ParticipationFlags, P.VALIDATOR_REGISTRY_LIMIT]
+            justification_bits: Bitvector[self.JUSTIFICATION_BITS_LENGTH]
+            previous_justified_checkpoint: P.Checkpoint
+            current_justified_checkpoint: P.Checkpoint
+            finalized_checkpoint: P.Checkpoint
+            inactivity_scores: List[uint64, P.VALIDATOR_REGISTRY_LIMIT]
+            current_sync_committee: P.SyncCommittee
+            next_sync_committee: P.SyncCommittee
+            latest_execution_payload_header: ExecutionPayloadHeader
+            next_withdrawal_index: WithdrawalIndex
+            next_withdrawal_validator_index: ValidatorIndex
+            historical_summaries: List[P.HistoricalSummary, P.HISTORICAL_ROOTS_LIMIT]
+
+        # p2p containers (specs/deneb/p2p-interface.md)
+        class BlobSidecar(Container):
+            index: BlobIndex
+            blob: Blob
+            kzg_commitment: KZGCommitment
+            kzg_proof: KZGProof
+            signed_block_header: P.SignedBeaconBlockHeader
+            kzg_commitment_inclusion_proof: Vector[
+                Bytes32, P.KZG_COMMITMENT_INCLUSION_PROOF_DEPTH
+            ]
+
+        class BlobIdentifier(Container):
+            block_root: Root
+            index: BlobIndex
+
+        for name, typ in list(locals().items()):
+            if isinstance(typ, type) and issubclass(typ, Container):
+                typ.__name__ = name
+                setattr(self, name, typ)
+
+    # == request dataclasses ==============================================
+
+    class NewPayloadRequest:
+        def __init__(self, execution_payload, versioned_hashes=(), parent_beacon_block_root=b""):
+            self.execution_payload = execution_payload
+            self.versioned_hashes = versioned_hashes
+            self.parent_beacon_block_root = parent_beacon_block_root
+
+    # == KZG surface (delegates to crypto/kzg) =============================
+
+    @staticmethod
+    def blob_to_kzg_commitment(blob) -> bytes:
+        return KZGCommitment(_kzg.blob_to_kzg_commitment(bytes(blob)))
+
+    @staticmethod
+    def compute_kzg_proof(blob, z_bytes):
+        proof, y = _kzg.compute_kzg_proof(bytes(blob), bytes(z_bytes))
+        return KZGProof(proof), Bytes32(y)
+
+    @staticmethod
+    def compute_blob_kzg_proof(blob, commitment_bytes) -> bytes:
+        return KZGProof(_kzg.compute_blob_kzg_proof(bytes(blob), bytes(commitment_bytes)))
+
+    @staticmethod
+    def verify_kzg_proof(commitment_bytes, z_bytes, y_bytes, proof_bytes) -> bool:
+        return _kzg.verify_kzg_proof(
+            bytes(commitment_bytes), bytes(z_bytes), bytes(y_bytes), bytes(proof_bytes)
+        )
+
+    @staticmethod
+    def verify_blob_kzg_proof(blob, commitment_bytes, proof_bytes) -> bool:
+        return _kzg.verify_blob_kzg_proof(
+            bytes(blob), bytes(commitment_bytes), bytes(proof_bytes)
+        )
+
+    @staticmethod
+    def verify_blob_kzg_proof_batch(blobs, commitments, proofs) -> bool:
+        return _kzg.verify_blob_kzg_proof_batch(
+            [bytes(b) for b in blobs],
+            [bytes(c) for c in commitments],
+            [bytes(p) for p in proofs],
+        )
+
+    # == misc ==============================================================
+
+    def kzg_commitment_to_versioned_hash(self, kzg_commitment) -> bytes:
+        return VersionedHash(
+            self.VERSIONED_HASH_VERSION_KZG + self.hash(kzg_commitment)[1:]
+        )
+
+    # == accessors =========================================================
+
+    def get_attestation_participation_flag_indices(self, state, data, inclusion_delay: int):
+        """EIP-7045: the target flag no longer decays with inclusion delay."""
+        if data.target.epoch == self.get_current_epoch(state):
+            justified_checkpoint = state.current_justified_checkpoint
+        else:
+            justified_checkpoint = state.previous_justified_checkpoint
+        is_matching_source = data.source == justified_checkpoint
+        is_matching_target = (
+            is_matching_source and data.target.root == self.get_block_root(state, data.target.epoch)
+        )
+        is_matching_head = (
+            is_matching_target
+            and data.beacon_block_root == self.get_block_root_at_slot(state, data.slot)
+        )
+        assert is_matching_source, "attestation source does not match justified checkpoint"
+
+        participation_flag_indices = []
+        if is_matching_source and inclusion_delay <= self.integer_squareroot(self.SLOTS_PER_EPOCH):
+            participation_flag_indices.append(self.TIMELY_SOURCE_FLAG_INDEX)
+        if is_matching_target:  # [Modified in Deneb:EIP7045]
+            participation_flag_indices.append(self.TIMELY_TARGET_FLAG_INDEX)
+        if is_matching_head and inclusion_delay == self.MIN_ATTESTATION_INCLUSION_DELAY:
+            participation_flag_indices.append(self.TIMELY_HEAD_FLAG_INDEX)
+        return participation_flag_indices
+
+    def get_validator_activation_churn_limit(self, state) -> int:
+        """EIP-7514: cap the activation queue drain."""
+        return min(
+            self.config.MAX_PER_EPOCH_ACTIVATION_CHURN_LIMIT,
+            self.get_validator_churn_limit(state),
+        )
+
+    # == block processing ==================================================
+
+    def process_attestation(self, state, attestation) -> None:
+        data = attestation.data
+        assert data.target.epoch in (
+            self.get_previous_epoch(state),
+            self.get_current_epoch(state),
+        ), "target epoch out of range"
+        assert data.target.epoch == self.compute_epoch_at_slot(data.slot), "target/slot mismatch"
+        # [Modified in Deneb:EIP7045] no upper inclusion bound
+        assert (
+            int(data.slot) + self.MIN_ATTESTATION_INCLUSION_DELAY <= state.slot
+        ), "attestation too recent"
+        assert data.index < self.get_committee_count_per_slot(state, data.target.epoch)
+
+        committee = self.get_beacon_committee(state, data.slot, data.index)
+        assert len(attestation.aggregation_bits) == len(committee), "bitlist length mismatch"
+
+        participation_flag_indices = self.get_attestation_participation_flag_indices(
+            state, data, int(state.slot) - int(data.slot)
+        )
+
+        assert self.is_valid_indexed_attestation(
+            state, self.get_indexed_attestation(state, attestation)
+        ), "invalid aggregate signature"
+
+        if data.target.epoch == self.get_current_epoch(state):
+            epoch_participation = state.current_epoch_participation
+        else:
+            epoch_participation = state.previous_epoch_participation
+
+        proposer_reward_numerator = 0
+        for index in self.get_attesting_indices(state, attestation):
+            for flag_index, weight in enumerate(self.PARTICIPATION_FLAG_WEIGHTS):
+                if flag_index in participation_flag_indices and not self.has_flag(
+                    epoch_participation[index], flag_index
+                ):
+                    epoch_participation[index] = self.add_flag(
+                        epoch_participation[index], flag_index
+                    )
+                    proposer_reward_numerator += self.get_base_reward(state, index) * weight
+
+        proposer_reward_denominator = (
+            (self.WEIGHT_DENOMINATOR - self.PROPOSER_WEIGHT)
+            * self.WEIGHT_DENOMINATOR
+            // self.PROPOSER_WEIGHT
+        )
+        proposer_reward = proposer_reward_numerator // proposer_reward_denominator
+        self.increase_balance(state, self.get_beacon_proposer_index(state), proposer_reward)
+
+    def max_blobs_per_block(self) -> int:
+        return self.config.MAX_BLOBS_PER_BLOCK
+
+    def process_execution_payload(self, state, body, execution_engine) -> None:
+        payload = body.execution_payload
+        assert (
+            payload.parent_hash == state.latest_execution_payload_header.block_hash
+        ), "payload parent mismatch"
+        assert payload.prev_randao == self.get_randao_mix(
+            state, self.get_current_epoch(state)
+        ), "wrong prev_randao"
+        assert payload.timestamp == self.compute_timestamp_at_slot(
+            state, state.slot
+        ), "wrong payload timestamp"
+        # [New in Deneb:EIP4844]
+        assert len(body.blob_kzg_commitments) <= self.max_blobs_per_block(), "too many blobs"
+        versioned_hashes = [
+            self.kzg_commitment_to_versioned_hash(commitment)
+            for commitment in body.blob_kzg_commitments
+        ]
+        assert execution_engine.verify_and_notify_new_payload(
+            self.NewPayloadRequest(
+                execution_payload=payload,
+                versioned_hashes=versioned_hashes,
+                parent_beacon_block_root=state.latest_block_header.parent_root,
+            )
+        ), "execution engine rejected payload"
+        state.latest_execution_payload_header = self.execution_payload_to_header(payload)
+
+    def execution_payload_to_header(self, payload):
+        return self.ExecutionPayloadHeader(
+            parent_hash=payload.parent_hash,
+            fee_recipient=payload.fee_recipient,
+            state_root=payload.state_root,
+            receipts_root=payload.receipts_root,
+            logs_bloom=payload.logs_bloom,
+            prev_randao=payload.prev_randao,
+            block_number=payload.block_number,
+            gas_limit=payload.gas_limit,
+            gas_used=payload.gas_used,
+            timestamp=payload.timestamp,
+            extra_data=payload.extra_data,
+            base_fee_per_gas=payload.base_fee_per_gas,
+            block_hash=payload.block_hash,
+            transactions_root=hash_tree_root(payload.transactions),
+            withdrawals_root=hash_tree_root(payload.withdrawals),
+            blob_gas_used=payload.blob_gas_used,
+            excess_blob_gas=payload.excess_blob_gas,
+        )
+
+    def process_voluntary_exit(self, state, signed_voluntary_exit) -> None:
+        """EIP-7044: exits sign over the fixed capella fork version."""
+        voluntary_exit = signed_voluntary_exit.message
+        validator = state.validators[int(voluntary_exit.validator_index)]
+        assert self.is_active_validator(validator, self.get_current_epoch(state)), "not active"
+        assert validator.exit_epoch == self.FAR_FUTURE_EPOCH, "already exiting"
+        assert self.get_current_epoch(state) >= voluntary_exit.epoch, "exit not yet valid"
+        assert (
+            self.get_current_epoch(state)
+            >= int(validator.activation_epoch) + self.config.SHARD_COMMITTEE_PERIOD
+        ), "validator too young to exit"
+        domain = self.compute_domain(
+            self.DOMAIN_VOLUNTARY_EXIT,
+            self.config.CAPELLA_FORK_VERSION,
+            state.genesis_validators_root,
+        )
+        signing_root = self.compute_signing_root(voluntary_exit, domain)
+        assert bls.Verify(validator.pubkey, signing_root, signed_voluntary_exit.signature)
+        self.initiate_validator_exit(state, voluntary_exit.validator_index)
+
+    # == epoch processing ==================================================
+
+    def process_registry_updates(self, state) -> None:
+        """EIP-7514: activations drain at the capped churn limit."""
+        current_epoch = self.get_current_epoch(state)
+        for index, validator in enumerate(state.validators):
+            if self.is_eligible_for_activation_queue(validator):
+                validator.activation_eligibility_epoch = current_epoch + 1
+            if (
+                self.is_active_validator(validator, current_epoch)
+                and validator.effective_balance <= self.config.EJECTION_BALANCE
+            ):
+                self.initiate_validator_exit(state, index)
+        activation_queue = sorted(
+            [
+                index
+                for index, validator in enumerate(state.validators)
+                if self.is_eligible_for_activation(state, validator)
+            ],
+            key=lambda index: (int(state.validators[index].activation_eligibility_epoch), index),
+        )
+        for index in activation_queue[: self.get_validator_activation_churn_limit(state)]:
+            state.validators[index].activation_epoch = self.compute_activation_exit_epoch(
+                current_epoch
+            )
+
+    # == data availability (specs/deneb/fork-choice.md) ====================
+
+    def retrieve_blobs_and_proofs(self, beacon_block_root):
+        """Networking-dependent blob retrieval; tests monkeypatch (the
+        reference injects the same stub, pysetup/spec_builders/deneb.py)."""
+        raise NotImplementedError("requires the blob-sidecar network layer")
+
+    def is_data_available(self, beacon_block_root, blob_kzg_commitments) -> bool:
+        blobs, proofs = self.retrieve_blobs_and_proofs(beacon_block_root)
+        return self.verify_blob_kzg_proof_batch(blobs, blob_kzg_commitments, proofs)
+
+    def verify_blob_sidecar_inclusion_proof(self, blob_sidecar) -> bool:
+        # gindex of blob_kzg_commitments[index] inside BeaconBlockBody:
+        # body has 12 fields (depth 4); the commitments list adds
+        # ceil(log2(MAX_BLOB_COMMITMENTS)) + 1 (length mix-in) levels
+        field_index = list(self.BeaconBlockBody.fields()).index("blob_kzg_commitments")
+        list_depth = (self.MAX_BLOB_COMMITMENTS_PER_BLOCK - 1).bit_length() + 1
+        gindex = (
+            ((1 << 4 | field_index) << list_depth)
+            | int(blob_sidecar.index)
+        )
+        depth = self.KZG_COMMITMENT_INCLUSION_PROOF_DEPTH
+        return self.is_valid_merkle_branch(
+            leaf=hash_tree_root(blob_sidecar.kzg_commitment),
+            branch=blob_sidecar.kzg_commitment_inclusion_proof,
+            depth=depth,
+            index=gindex & ((1 << depth) - 1),
+            root=blob_sidecar.signed_block_header.message.body_root,
+        )
+
+    # == fork upgrade (specs/deneb/fork.md) ================================
+
+    def upgrade_from_parent(self, pre):
+        epoch = self.compute_epoch_at_slot(int(pre.slot))
+        pre_header = pre.latest_execution_payload_header
+        header = self.ExecutionPayloadHeader(
+            parent_hash=pre_header.parent_hash,
+            fee_recipient=pre_header.fee_recipient,
+            state_root=pre_header.state_root,
+            receipts_root=pre_header.receipts_root,
+            logs_bloom=pre_header.logs_bloom,
+            prev_randao=pre_header.prev_randao,
+            block_number=pre_header.block_number,
+            gas_limit=pre_header.gas_limit,
+            gas_used=pre_header.gas_used,
+            timestamp=pre_header.timestamp,
+            extra_data=pre_header.extra_data,
+            base_fee_per_gas=pre_header.base_fee_per_gas,
+            block_hash=pre_header.block_hash,
+            transactions_root=pre_header.transactions_root,
+            withdrawals_root=pre_header.withdrawals_root,
+            # blob_gas fields default to zero
+        )
+        return self.BeaconState(
+            genesis_time=pre.genesis_time,
+            genesis_validators_root=pre.genesis_validators_root,
+            slot=pre.slot,
+            fork=self.Fork(
+                previous_version=pre.fork.current_version,
+                current_version=Version(self.config.DENEB_FORK_VERSION),
+                epoch=epoch,
+            ),
+            latest_block_header=pre.latest_block_header,
+            block_roots=list(pre.block_roots),
+            state_roots=list(pre.state_roots),
+            historical_roots=list(pre.historical_roots),
+            eth1_data=pre.eth1_data,
+            eth1_data_votes=list(pre.eth1_data_votes),
+            eth1_deposit_index=pre.eth1_deposit_index,
+            validators=list(pre.validators),
+            balances=list(pre.balances),
+            randao_mixes=list(pre.randao_mixes),
+            slashings=list(pre.slashings),
+            previous_epoch_participation=list(pre.previous_epoch_participation),
+            current_epoch_participation=list(pre.current_epoch_participation),
+            justification_bits=list(pre.justification_bits),
+            previous_justified_checkpoint=pre.previous_justified_checkpoint,
+            current_justified_checkpoint=pre.current_justified_checkpoint,
+            finalized_checkpoint=pre.finalized_checkpoint,
+            inactivity_scores=list(pre.inactivity_scores),
+            current_sync_committee=pre.current_sync_committee,
+            next_sync_committee=pre.next_sync_committee,
+            latest_execution_payload_header=header,
+            next_withdrawal_index=pre.next_withdrawal_index,
+            next_withdrawal_validator_index=pre.next_withdrawal_validator_index,
+            historical_summaries=list(pre.historical_summaries),
+        )

@@ -53,7 +53,9 @@ def test_invalid_before_inclusion_delay(spec, state):
 def test_invalid_after_epoch_slots(spec, state):
     attestation = get_valid_attestation(spec, state, signed=True)
     next_slots(spec, state, spec.SLOTS_PER_EPOCH + 1)
-    yield from run_attestation_processing(spec, state, attestation, valid=False)
+    # EIP-7045 (deneb) removes the upper inclusion bound entirely
+    valid = is_post_deneb(spec)
+    yield from run_attestation_processing(spec, state, attestation, valid=valid)
 
 
 @with_all_phases

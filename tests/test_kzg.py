@@ -1,0 +1,89 @@
+"""KZG polynomial-commitment tests against the spec semantics
+(reference analogue: tests/generators/runners/kzg.py vector families).
+Each commit/prove op costs ~1.5 s on the pure-python MSM, so scenarios
+share one blob."""
+
+import hashlib
+
+import pytest
+
+from eth_consensus_specs_tpu.crypto import kzg
+
+
+def make_blob(tag: bytes) -> bytes:
+    out = []
+    for i in range(kzg.FIELD_ELEMENTS_PER_BLOB):
+        h = hashlib.sha256(tag + i.to_bytes(4, "big")).digest()
+        out.append((int.from_bytes(h, "big") % kzg.BLS_MODULUS).to_bytes(32, "big"))
+    return b"".join(out)
+
+
+@pytest.fixture(scope="module")
+def blob_commit_proof():
+    blob = make_blob(b"kzg-test")
+    commitment = kzg.blob_to_kzg_commitment(blob)
+    proof = kzg.compute_blob_kzg_proof(blob, commitment)
+    return blob, commitment, proof
+
+
+def test_blob_roundtrip(blob_commit_proof):
+    blob, commitment, proof = blob_commit_proof
+    assert kzg.verify_blob_kzg_proof(blob, commitment, proof)
+
+
+def test_blob_wrong_proof_rejected(blob_commit_proof):
+    blob, commitment, _ = blob_commit_proof
+    assert not kzg.verify_blob_kzg_proof(blob, commitment, kzg.G1_POINT_AT_INFINITY)
+
+
+def test_blob_wrong_blob_rejected(blob_commit_proof):
+    blob, commitment, proof = blob_commit_proof
+    tampered = b"\x00" * 32 + blob[32:]
+    assert not kzg.verify_blob_kzg_proof(tampered, commitment, proof)
+
+
+def test_point_proof_arbitrary_z(blob_commit_proof):
+    blob, commitment, _ = blob_commit_proof
+    z = (987654321).to_bytes(32, "big")
+    proof, y = kzg.compute_kzg_proof(blob, z)
+    assert kzg.verify_kzg_proof(commitment, z, y, proof)
+    bad_y = ((int.from_bytes(y, "big") + 1) % kzg.BLS_MODULUS).to_bytes(32, "big")
+    assert not kzg.verify_kzg_proof(commitment, z, bad_y, proof)
+
+
+def test_point_proof_in_domain(blob_commit_proof):
+    blob, commitment, _ = blob_commit_proof
+    # z a root of unity: y must equal the blob element at that position
+    z_int = kzg._roots_brp(kzg.FIELD_ELEMENTS_PER_BLOB)[7]
+    z = z_int.to_bytes(32, "big")
+    proof, y = kzg.compute_kzg_proof(blob, z)
+    assert int.from_bytes(y, "big") == kzg.blob_to_polynomial(blob)[7]
+    assert kzg.verify_kzg_proof(commitment, z, y, proof)
+
+
+def test_batch_verify(blob_commit_proof):
+    blob, commitment, proof = blob_commit_proof
+    # batch of 2 (same blob twice is a valid batch) plus the empty batch
+    assert kzg.verify_blob_kzg_proof_batch([blob, blob], [commitment, commitment], [proof, proof])
+    assert kzg.verify_blob_kzg_proof_batch([], [], [])
+    assert not kzg.verify_blob_kzg_proof_batch(
+        [blob, blob], [commitment, commitment], [proof, kzg.G1_POINT_AT_INFINITY]
+    )
+
+
+def test_scalar_out_of_range_rejected():
+    bad = (kzg.BLS_MODULUS).to_bytes(32, "big")
+    with pytest.raises(AssertionError):
+        kzg.bytes_to_bls_field(bad)
+
+
+def test_bit_reversal_permutation_involution():
+    seq = list(range(16))
+    assert kzg.bit_reversal_permutation(kzg.bit_reversal_permutation(seq)) == seq
+
+
+def test_roots_of_unity():
+    roots = kzg.compute_roots_of_unity(4096)
+    assert len(set(roots)) == 4096
+    assert pow(roots[1], 4096, kzg.BLS_MODULUS) == 1
+    assert pow(roots[1], 2048, kzg.BLS_MODULUS) != 1
