@@ -20,7 +20,13 @@ def enable_persistent_cache(cache_dir: str | None = None) -> str | None:
     global _enabled
     import jax
 
-    if jax.default_backend() == "cpu":
+    try:
+        backend = jax.default_backend()
+    except Exception:
+        # Backend init failure (e.g. TPU tunnel down) — the caller decides
+        # how to fall back; cache setup must never be the crash site.
+        return None
+    if backend == "cpu":
         return None
     if cache_dir is None:
         repo_root = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))

@@ -11,6 +11,7 @@ from eth_consensus_specs_tpu.test_infra.context import (
     spec_state_test,
     with_all_phases,
 )
+from eth_consensus_specs_tpu.test_infra.forks import is_post_deneb
 from eth_consensus_specs_tpu.test_infra.state import next_slots, transition_to
 
 
