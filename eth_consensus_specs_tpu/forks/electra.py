@@ -1,0 +1,1278 @@
+"""electra: MaxEB (EIP-7251), execution-layer deposits (EIP-6110),
+execution-layer withdrawals (EIP-7002), committee-bit attestations
+(EIP-7549), blob-count bump (EIP-7691).
+
+Behavioral parity targets (reference, by section):
+  * state machine:  specs/electra/beacon-chain.md
+      - balance-denominated churn :572-600, exit/consolidation queues
+        :734-792, pending deposits :943-1020, consolidations :1022-1047
+      - committee-bit attestations :613-637, :1435-1488
+      - execution requests pipeline :1307-1325, :1389-1426
+      - withdrawals with pending partials :1186-1303
+  * fork upgrade:   specs/electra/fork.md (upgrade_to_electra :42-144)
+
+Architecture note: Electra replaces phase0's count-denominated churn with
+*balance*-denominated queues (exit/consolidation balance accumulators).
+These are scalar state machines — tiny, inherently serial — so they stay
+host-side; the big per-validator scans they gate (registry updates,
+effective-balance updates) remain columnar-kernel targets keyed off the
+same EpochColumns as earlier forks.
+"""
+
+from eth_consensus_specs_tpu.ssz import (
+    Bitlist,
+    Bitvector,
+    Bytes32,
+    Container,
+    List,
+    Vector,
+    hash_tree_root,
+    serialize,
+    uint64,
+)
+from eth_consensus_specs_tpu.utils import bls
+
+from .altair import ParticipationFlags
+from .bellatrix import ExecutionAddress, Hash32
+from .capella import WithdrawalIndex
+from .deneb import DenebExecutionEngine, DenebSpec, KZGCommitment
+from .phase0 import (
+    BLSPubkey,
+    BLSSignature,
+    Epoch,
+    Gwei,
+    Root,
+    Slot,
+    ValidatorIndex,
+    Version,
+    uint64 as _u64,
+)
+
+
+class ElectraExecutionEngine(DenebExecutionEngine):
+    """Adds the EIP-7685 execution-requests list to the payload handshake
+    (reference: specs/electra/beacon-chain.md:1092-1166)."""
+
+    def __init__(self, spec):
+        self._spec = spec
+
+    def is_valid_block_hash(
+        self, execution_payload, parent_beacon_block_root, execution_requests_list
+    ) -> bool:
+        return True
+
+    def notify_new_payload(
+        self, execution_payload, parent_beacon_block_root, execution_requests_list
+    ) -> bool:
+        return True
+
+    def verify_and_notify_new_payload(self, new_payload_request) -> bool:
+        execution_payload = new_payload_request.execution_payload
+        parent_beacon_block_root = new_payload_request.parent_beacon_block_root
+        execution_requests_list = self._spec.get_execution_requests_list(
+            new_payload_request.execution_requests
+        )
+        if b"" in [bytes(tx) for tx in execution_payload.transactions]:
+            return False
+        if not self.is_valid_block_hash(
+            execution_payload, parent_beacon_block_root, execution_requests_list
+        ):
+            return False
+        if not self.is_valid_versioned_hashes(new_payload_request):
+            return False
+        if not self.notify_new_payload(
+            execution_payload, parent_beacon_block_root, execution_requests_list
+        ):
+            return False
+        return True
+
+
+class ElectraSpec(DenebSpec):
+    fork_name = "electra"
+
+    # Constants (specs/electra/beacon-chain.md:125-149)
+    UNSET_DEPOSIT_REQUESTS_START_INDEX = 2**64 - 1
+    FULL_EXIT_REQUEST_AMOUNT = 0
+    COMPOUNDING_WITHDRAWAL_PREFIX = b"\x02"
+    DEPOSIT_REQUEST_TYPE = b"\x00"
+    WITHDRAWAL_REQUEST_TYPE = b"\x01"
+    CONSOLIDATION_REQUEST_TYPE = b"\x02"
+
+    def __init__(self, *args, **kwargs):
+        super().__init__(*args, **kwargs)
+        self.EXECUTION_ENGINE = ElectraExecutionEngine(self)
+
+    # == type system ======================================================
+
+    def _build_types(self) -> None:
+        super()._build_types()
+        P = self
+
+        # New containers (specs/electra/beacon-chain.md:219-310)
+        class PendingDeposit(Container):
+            pubkey: BLSPubkey
+            withdrawal_credentials: Bytes32
+            amount: Gwei
+            signature: BLSSignature
+            slot: Slot
+
+        class PendingPartialWithdrawal(Container):
+            validator_index: ValidatorIndex
+            amount: Gwei
+            withdrawable_epoch: Epoch
+
+        class PendingConsolidation(Container):
+            source_index: ValidatorIndex
+            target_index: ValidatorIndex
+
+        class DepositRequest(Container):
+            pubkey: BLSPubkey
+            withdrawal_credentials: Bytes32
+            amount: Gwei
+            signature: BLSSignature
+            index: uint64
+
+        class WithdrawalRequest(Container):
+            source_address: ExecutionAddress
+            validator_pubkey: BLSPubkey
+            amount: Gwei
+
+        class ConsolidationRequest(Container):
+            source_address: ExecutionAddress
+            source_pubkey: BLSPubkey
+            target_pubkey: BLSPubkey
+
+        class ExecutionRequests(Container):
+            deposits: List[DepositRequest, P.MAX_DEPOSIT_REQUESTS_PER_PAYLOAD]
+            withdrawals: List[WithdrawalRequest, P.MAX_WITHDRAWAL_REQUESTS_PER_PAYLOAD]
+            consolidations: List[ConsolidationRequest, P.MAX_CONSOLIDATION_REQUESTS_PER_PAYLOAD]
+
+        class SingleAttestation(Container):
+            committee_index: uint64
+            attester_index: ValidatorIndex
+            data: P.AttestationData
+            signature: BLSSignature
+
+        # Modified containers (EIP-7549: committee bits move out of data.index)
+        class Attestation(Container):
+            aggregation_bits: Bitlist[
+                P.MAX_VALIDATORS_PER_COMMITTEE * P.MAX_COMMITTEES_PER_SLOT
+            ]  # [Modified in Electra:EIP7549]
+            data: P.AttestationData
+            signature: BLSSignature
+            committee_bits: Bitvector[P.MAX_COMMITTEES_PER_SLOT]  # [New in Electra:EIP7549]
+
+        class IndexedAttestation(Container):
+            attesting_indices: List[
+                ValidatorIndex, P.MAX_VALIDATORS_PER_COMMITTEE * P.MAX_COMMITTEES_PER_SLOT
+            ]  # [Modified in Electra:EIP7549]
+            data: P.AttestationData
+            signature: BLSSignature
+
+        class AttesterSlashing(Container):
+            attestation_1: IndexedAttestation
+            attestation_2: IndexedAttestation
+
+        class BeaconBlockBody(Container):
+            randao_reveal: BLSSignature
+            eth1_data: P.Eth1Data
+            graffiti: Bytes32
+            proposer_slashings: List[P.ProposerSlashing, P.MAX_PROPOSER_SLASHINGS]
+            attester_slashings: List[
+                AttesterSlashing, P.MAX_ATTESTER_SLASHINGS_ELECTRA
+            ]  # [Modified in Electra:EIP7549]
+            attestations: List[
+                Attestation, P.MAX_ATTESTATIONS_ELECTRA
+            ]  # [Modified in Electra:EIP7549]
+            deposits: List[P.Deposit, P.MAX_DEPOSITS]
+            voluntary_exits: List[P.SignedVoluntaryExit, P.MAX_VOLUNTARY_EXITS]
+            sync_aggregate: P.SyncAggregate
+            execution_payload: P.ExecutionPayload
+            bls_to_execution_changes: List[
+                P.SignedBLSToExecutionChange, P.MAX_BLS_TO_EXECUTION_CHANGES
+            ]
+            blob_kzg_commitments: List[KZGCommitment, P.MAX_BLOB_COMMITMENTS_PER_BLOCK]
+            execution_requests: ExecutionRequests  # [New in Electra]
+
+        class BeaconBlock(Container):
+            slot: Slot
+            proposer_index: ValidatorIndex
+            parent_root: Root
+            state_root: Root
+            body: BeaconBlockBody
+
+        class SignedBeaconBlock(Container):
+            message: BeaconBlock
+            signature: BLSSignature
+
+        class BeaconState(Container):
+            genesis_time: uint64
+            genesis_validators_root: Root
+            slot: Slot
+            fork: P.Fork
+            latest_block_header: P.BeaconBlockHeader
+            block_roots: Vector[Root, P.SLOTS_PER_HISTORICAL_ROOT]
+            state_roots: Vector[Root, P.SLOTS_PER_HISTORICAL_ROOT]
+            historical_roots: List[Root, P.HISTORICAL_ROOTS_LIMIT]
+            eth1_data: P.Eth1Data
+            eth1_data_votes: List[P.Eth1Data, P.EPOCHS_PER_ETH1_VOTING_PERIOD * P.SLOTS_PER_EPOCH]
+            eth1_deposit_index: uint64
+            validators: List[P.Validator, P.VALIDATOR_REGISTRY_LIMIT]
+            balances: List[Gwei, P.VALIDATOR_REGISTRY_LIMIT]
+            randao_mixes: Vector[Bytes32, P.EPOCHS_PER_HISTORICAL_VECTOR]
+            slashings: Vector[Gwei, P.EPOCHS_PER_SLASHINGS_VECTOR]
+            previous_epoch_participation: List[ParticipationFlags, P.VALIDATOR_REGISTRY_LIMIT]
+            current_epoch_participation: List[ParticipationFlags, P.VALIDATOR_REGISTRY_LIMIT]
+            justification_bits: Bitvector[self.JUSTIFICATION_BITS_LENGTH]
+            previous_justified_checkpoint: P.Checkpoint
+            current_justified_checkpoint: P.Checkpoint
+            finalized_checkpoint: P.Checkpoint
+            inactivity_scores: List[uint64, P.VALIDATOR_REGISTRY_LIMIT]
+            current_sync_committee: P.SyncCommittee
+            next_sync_committee: P.SyncCommittee
+            latest_execution_payload_header: P.ExecutionPayloadHeader
+            next_withdrawal_index: WithdrawalIndex
+            next_withdrawal_validator_index: ValidatorIndex
+            historical_summaries: List[P.HistoricalSummary, P.HISTORICAL_ROOTS_LIMIT]
+            deposit_requests_start_index: uint64  # [New in Electra:EIP6110]
+            deposit_balance_to_consume: Gwei  # [New in Electra:EIP7251]
+            exit_balance_to_consume: Gwei  # [New in Electra:EIP7251]
+            earliest_exit_epoch: Epoch  # [New in Electra:EIP7251]
+            consolidation_balance_to_consume: Gwei  # [New in Electra:EIP7251]
+            earliest_consolidation_epoch: Epoch  # [New in Electra:EIP7251]
+            pending_deposits: List[
+                PendingDeposit, P.PENDING_DEPOSITS_LIMIT
+            ]  # [New in Electra:EIP7251]
+            pending_partial_withdrawals: List[
+                PendingPartialWithdrawal, P.PENDING_PARTIAL_WITHDRAWALS_LIMIT
+            ]  # [New in Electra:EIP7251]
+            pending_consolidations: List[
+                PendingConsolidation, P.PENDING_CONSOLIDATIONS_LIMIT
+            ]  # [New in Electra:EIP7251]
+
+        for name, typ in list(locals().items()):
+            if isinstance(typ, type) and issubclass(typ, Container):
+                typ.__name__ = name
+                setattr(self, name, typ)
+
+    # == request dataclasses ==============================================
+
+    class NewPayloadRequest:
+        def __init__(
+            self,
+            execution_payload,
+            versioned_hashes=(),
+            parent_beacon_block_root=b"",
+            execution_requests=None,
+        ):
+            self.execution_payload = execution_payload
+            self.versioned_hashes = versioned_hashes
+            self.parent_beacon_block_root = parent_beacon_block_root
+            self.execution_requests = execution_requests
+
+    # == predicates (specs/electra/beacon-chain.md:424-546) ================
+
+    def is_eligible_for_activation_queue(self, validator) -> bool:
+        return (
+            validator.activation_eligibility_epoch == self.FAR_FUTURE_EPOCH
+            # [Modified in Electra:EIP7251]
+            and int(validator.effective_balance) >= self.MIN_ACTIVATION_BALANCE
+        )
+
+    def is_compounding_withdrawal_credential(self, withdrawal_credentials) -> bool:
+        return bytes(withdrawal_credentials)[:1] == self.COMPOUNDING_WITHDRAWAL_PREFIX
+
+    def has_compounding_withdrawal_credential(self, validator) -> bool:
+        return self.is_compounding_withdrawal_credential(validator.withdrawal_credentials)
+
+    def has_execution_withdrawal_credential(self, validator) -> bool:
+        return self.has_eth1_withdrawal_credential(
+            validator
+        ) or self.has_compounding_withdrawal_credential(validator)
+
+    def is_fully_withdrawable_validator(self, validator, balance: int, epoch: int) -> bool:
+        return (
+            # [Modified in Electra:EIP7251]
+            self.has_execution_withdrawal_credential(validator)
+            and int(validator.withdrawable_epoch) <= epoch
+            and int(balance) > 0
+        )
+
+    def is_partially_withdrawable_validator(self, validator, balance: int) -> bool:
+        max_effective_balance = self.get_max_effective_balance(validator)
+        return (
+            # [Modified in Electra:EIP7251]
+            self.has_execution_withdrawal_credential(validator)
+            and int(validator.effective_balance) == max_effective_balance
+            and int(balance) > max_effective_balance
+        )
+
+    # == misc ==============================================================
+
+    def get_committee_indices(self, committee_bits):
+        return [index for index, bit in enumerate(committee_bits) if bit]
+
+    def get_max_effective_balance(self, validator) -> int:
+        if self.has_compounding_withdrawal_credential(validator):
+            return self.MAX_EFFECTIVE_BALANCE_ELECTRA
+        return self.MIN_ACTIVATION_BALANCE
+
+    def compute_proposer_index(self, state, indices, seed: bytes) -> int:
+        """16-bit random-value effective-balance filter against MaxEB
+        (reference: specs/electra/beacon-chain.md:426-455)."""
+        assert len(indices) > 0
+        MAX_RANDOM_VALUE = 2**16 - 1
+        total = len(indices)
+        perm = self._shuffle_permutation(total, seed)
+        i = 0
+        while True:
+            candidate_index = indices[int(perm[i % total])]
+            random_bytes = self.hash(seed + self.uint_to_bytes(_u64(i // 16)))
+            offset = i % 16 * 2
+            random_value = self.bytes_to_uint64(random_bytes[offset : offset + 2])
+            effective_balance = int(state.validators[candidate_index].effective_balance)
+            if (
+                effective_balance * MAX_RANDOM_VALUE
+                >= self.MAX_EFFECTIVE_BALANCE_ELECTRA * random_value
+            ):
+                return int(candidate_index)
+            i += 1
+
+    # == accessors =========================================================
+
+    def get_balance_churn_limit(self, state) -> int:
+        """Balance-denominated churn (reference: beacon-chain.md:572-583)."""
+        churn = max(
+            self.config.MIN_PER_EPOCH_CHURN_LIMIT_ELECTRA,
+            self.get_total_active_balance(state) // self.config.CHURN_LIMIT_QUOTIENT,
+        )
+        return churn - churn % self.EFFECTIVE_BALANCE_INCREMENT
+
+    def get_activation_exit_churn_limit(self, state) -> int:
+        return min(
+            self.config.MAX_PER_EPOCH_ACTIVATION_EXIT_CHURN_LIMIT,
+            self.get_balance_churn_limit(state),
+        )
+
+    def get_consolidation_churn_limit(self, state) -> int:
+        return self.get_balance_churn_limit(state) - self.get_activation_exit_churn_limit(state)
+
+    def get_pending_balance_to_withdraw(self, state, validator_index: int) -> int:
+        return sum(
+            int(withdrawal.amount)
+            for withdrawal in state.pending_partial_withdrawals
+            if withdrawal.validator_index == validator_index
+        )
+
+    def get_attesting_indices(self, state, attestation):
+        """EIP-7549: union over the committees named by committee_bits
+        (reference: beacon-chain.md:613-637)."""
+        output = set()
+        committee_indices = self.get_committee_indices(attestation.committee_bits)
+        committee_offset = 0
+        for committee_index in committee_indices:
+            committee = self.get_beacon_committee(state, attestation.data.slot, committee_index)
+            committee_attesters = {
+                int(attester_index)
+                for i, attester_index in enumerate(committee)
+                if attestation.aggregation_bits[committee_offset + i]
+            }
+            output = output.union(committee_attesters)
+            committee_offset += len(committee)
+        return output
+
+    def get_next_sync_committee_indices(self, state):
+        """16-bit acceptance test against MaxEB (reference:
+        beacon-chain.md:639-674)."""
+        epoch = self.get_current_epoch(state) + 1
+        MAX_RANDOM_VALUE = 2**16 - 1
+        active = self.get_active_validator_indices(state, epoch)
+        n = len(active)
+        seed = self.get_seed(state, epoch, self.DOMAIN_SYNC_COMMITTEE)
+        perm = self._shuffle_permutation(n, seed)
+        out = []
+        i = 0
+        while len(out) < self.SYNC_COMMITTEE_SIZE:
+            candidate = active[int(perm[i % n])]
+            random_bytes = self.hash(seed + self.uint_to_bytes(_u64(i // 16)))
+            offset = i % 16 * 2
+            random_value = self.bytes_to_uint64(random_bytes[offset : offset + 2])
+            effective_balance = int(state.validators[candidate].effective_balance)
+            if (
+                effective_balance * MAX_RANDOM_VALUE
+                >= self.MAX_EFFECTIVE_BALANCE_ELECTRA * random_value
+            ):
+                out.append(candidate)
+            i += 1
+        return out
+
+    # == mutators (specs/electra/beacon-chain.md:676-830) ==================
+
+    def initiate_validator_exit(self, state, index: int) -> None:
+        validator = state.validators[int(index)]
+        if validator.exit_epoch != self.FAR_FUTURE_EPOCH:
+            return
+        # [Modified in Electra:EIP7251] balance-denominated exit queue
+        exit_queue_epoch = self.compute_exit_epoch_and_update_churn(
+            state, int(validator.effective_balance)
+        )
+        validator.exit_epoch = exit_queue_epoch
+        validator.withdrawable_epoch = (
+            int(validator.exit_epoch) + self.config.MIN_VALIDATOR_WITHDRAWABILITY_DELAY
+        )
+
+    def switch_to_compounding_validator(self, state, index: int) -> None:
+        validator = state.validators[int(index)]
+        validator.withdrawal_credentials = Bytes32(
+            self.COMPOUNDING_WITHDRAWAL_PREFIX + bytes(validator.withdrawal_credentials)[1:]
+        )
+        self.queue_excess_active_balance(state, index)
+
+    def queue_excess_active_balance(self, state, index: int) -> None:
+        balance = int(state.balances[int(index)])
+        if balance > self.MIN_ACTIVATION_BALANCE:
+            excess_balance = balance - self.MIN_ACTIVATION_BALANCE
+            state.balances[int(index)] = self.MIN_ACTIVATION_BALANCE
+            validator = state.validators[int(index)]
+            # G2 infinity signature + GENESIS_SLOT mark an internal transfer,
+            # distinguishing it from a pending deposit request
+            state.pending_deposits.append(
+                self.PendingDeposit(
+                    pubkey=validator.pubkey,
+                    withdrawal_credentials=validator.withdrawal_credentials,
+                    amount=excess_balance,
+                    signature=bls.G2_POINT_AT_INFINITY,
+                    slot=self.GENESIS_SLOT,
+                )
+            )
+
+    def compute_exit_epoch_and_update_churn(self, state, exit_balance: int) -> int:
+        earliest_exit_epoch = max(
+            int(state.earliest_exit_epoch),
+            self.compute_activation_exit_epoch(self.get_current_epoch(state)),
+        )
+        per_epoch_churn = self.get_activation_exit_churn_limit(state)
+        if int(state.earliest_exit_epoch) < earliest_exit_epoch:
+            exit_balance_to_consume = per_epoch_churn
+        else:
+            exit_balance_to_consume = int(state.exit_balance_to_consume)
+
+        if exit_balance > exit_balance_to_consume:
+            balance_to_process = exit_balance - exit_balance_to_consume
+            additional_epochs = (balance_to_process - 1) // per_epoch_churn + 1
+            earliest_exit_epoch += additional_epochs
+            exit_balance_to_consume += additional_epochs * per_epoch_churn
+
+        state.exit_balance_to_consume = exit_balance_to_consume - exit_balance
+        state.earliest_exit_epoch = earliest_exit_epoch
+        return int(state.earliest_exit_epoch)
+
+    def compute_consolidation_epoch_and_update_churn(
+        self, state, consolidation_balance: int
+    ) -> int:
+        earliest_consolidation_epoch = max(
+            int(state.earliest_consolidation_epoch),
+            self.compute_activation_exit_epoch(self.get_current_epoch(state)),
+        )
+        per_epoch_consolidation_churn = self.get_consolidation_churn_limit(state)
+        if int(state.earliest_consolidation_epoch) < earliest_consolidation_epoch:
+            consolidation_balance_to_consume = per_epoch_consolidation_churn
+        else:
+            consolidation_balance_to_consume = int(state.consolidation_balance_to_consume)
+
+        if consolidation_balance > consolidation_balance_to_consume:
+            balance_to_process = consolidation_balance - consolidation_balance_to_consume
+            additional_epochs = (balance_to_process - 1) // per_epoch_consolidation_churn + 1
+            earliest_consolidation_epoch += additional_epochs
+            consolidation_balance_to_consume += (
+                additional_epochs * per_epoch_consolidation_churn
+            )
+
+        state.consolidation_balance_to_consume = (
+            consolidation_balance_to_consume - consolidation_balance
+        )
+        state.earliest_consolidation_epoch = earliest_consolidation_epoch
+        return int(state.earliest_consolidation_epoch)
+
+    # electra re-points both slashing quotients (beacon-chain.md:794-830)
+    def min_slashing_penalty_quotient(self) -> int:
+        return self.MIN_SLASHING_PENALTY_QUOTIENT_ELECTRA
+
+    def whistleblower_reward_quotient(self) -> int:
+        return self.WHISTLEBLOWER_REWARD_QUOTIENT_ELECTRA
+
+    # == epoch processing (specs/electra/beacon-chain.md:834-1072) =========
+
+    def process_epoch(self, state) -> None:
+        self.process_justification_and_finalization(state)
+        self.process_inactivity_updates(state)
+        self.process_rewards_and_penalties(state)
+        self.process_registry_updates(state)  # [Modified in Electra:EIP7251]
+        self.process_slashings(state)  # [Modified in Electra:EIP7251]
+        self.process_eth1_data_reset(state)
+        self.process_pending_deposits(state)  # [New in Electra:EIP7251]
+        self.process_pending_consolidations(state)  # [New in Electra:EIP7251]
+        self.process_effective_balance_updates(state)  # [Modified in Electra:EIP7251]
+        self._process_epoch_resets(state)
+
+    def process_registry_updates(self, state) -> None:
+        """Single-pass eligibility/ejection/activation loop (reference:
+        beacon-chain.md:865-891) — activations no longer queue-sorted."""
+        current_epoch = self.get_current_epoch(state)
+        activation_epoch = self.compute_activation_exit_epoch(current_epoch)
+        for index, validator in enumerate(state.validators):
+            if self.is_eligible_for_activation_queue(validator):
+                validator.activation_eligibility_epoch = current_epoch + 1
+            elif (
+                self.is_active_validator(validator, current_epoch)
+                and int(validator.effective_balance) <= self.config.EJECTION_BALANCE
+            ):
+                self.initiate_validator_exit(state, index)
+            elif self.is_eligible_for_activation(state, validator):
+                validator.activation_epoch = activation_epoch
+
+    def process_slashings(self, state) -> None:
+        """Per-increment penalty quantum (reference: beacon-chain.md:893-920)."""
+        epoch = self.get_current_epoch(state)
+        total_balance = self.get_total_active_balance(state)
+        adjusted_total_slashing_balance = min(
+            sum(int(s) for s in state.slashings) * self.proportional_slashing_multiplier(),
+            total_balance,
+        )
+        increment = self.EFFECTIVE_BALANCE_INCREMENT
+        penalty_per_effective_balance_increment = adjusted_total_slashing_balance // (
+            total_balance // increment
+        )
+        for index, validator in enumerate(state.validators):
+            if (
+                validator.slashed
+                and epoch + self.EPOCHS_PER_SLASHINGS_VECTOR // 2 == validator.withdrawable_epoch
+            ):
+                effective_balance_increments = int(validator.effective_balance) // increment
+                # [Modified in Electra:EIP7251]
+                penalty = penalty_per_effective_balance_increment * effective_balance_increments
+                self.decrease_balance(state, index, penalty)
+
+    def apply_pending_deposit(self, state, deposit) -> None:
+        validator_pubkeys = [v.pubkey for v in state.validators]
+        if deposit.pubkey not in validator_pubkeys:
+            # proof of possession — the deposit contract does not check it
+            if self.is_valid_deposit_signature(
+                deposit.pubkey, deposit.withdrawal_credentials, deposit.amount, deposit.signature
+            ):
+                self.add_validator_to_registry(
+                    state, deposit.pubkey, deposit.withdrawal_credentials, deposit.amount
+                )
+        else:
+            validator_index = validator_pubkeys.index(deposit.pubkey)
+            self.increase_balance(state, validator_index, deposit.amount)
+
+    def process_pending_deposits(self, state) -> None:
+        """Drain the deposit queue under finality + churn gates (reference:
+        beacon-chain.md:943-1020)."""
+        next_epoch = self.get_current_epoch(state) + 1
+        available_for_processing = int(
+            state.deposit_balance_to_consume
+        ) + self.get_activation_exit_churn_limit(state)
+        processed_amount = 0
+        next_deposit_index = 0
+        deposits_to_postpone = []
+        is_churn_limit_reached = False
+        finalized_slot = self.compute_start_slot_at_epoch(
+            int(state.finalized_checkpoint.epoch)
+        )
+
+        for deposit in state.pending_deposits:
+            # deposit requests wait until all Eth1-bridge deposits are applied
+            if (
+                int(deposit.slot) > self.GENESIS_SLOT
+                and int(state.eth1_deposit_index) < int(state.deposit_requests_start_index)
+            ):
+                break
+            if int(deposit.slot) > finalized_slot:
+                break
+            if next_deposit_index >= self.MAX_PENDING_DEPOSITS_PER_EPOCH:
+                break
+
+            is_validator_exited = False
+            is_validator_withdrawn = False
+            validator_pubkeys = [v.pubkey for v in state.validators]
+            if deposit.pubkey in validator_pubkeys:
+                validator = state.validators[validator_pubkeys.index(deposit.pubkey)]
+                is_validator_exited = int(validator.exit_epoch) < self.FAR_FUTURE_EPOCH
+                is_validator_withdrawn = int(validator.withdrawable_epoch) < next_epoch
+
+            if is_validator_withdrawn:
+                # balance can never become active again; skip the churn
+                self.apply_pending_deposit(state, deposit)
+            elif is_validator_exited:
+                deposits_to_postpone.append(deposit)
+            else:
+                is_churn_limit_reached = (
+                    processed_amount + int(deposit.amount) > available_for_processing
+                )
+                if is_churn_limit_reached:
+                    break
+                processed_amount += int(deposit.amount)
+                self.apply_pending_deposit(state, deposit)
+
+            next_deposit_index += 1
+
+        state.pending_deposits = (
+            list(state.pending_deposits)[next_deposit_index:] + deposits_to_postpone
+        )
+        if is_churn_limit_reached:
+            state.deposit_balance_to_consume = available_for_processing - processed_amount
+        else:
+            state.deposit_balance_to_consume = 0
+
+    def process_pending_consolidations(self, state) -> None:
+        next_epoch = self.get_current_epoch(state) + 1
+        next_pending_consolidation = 0
+        for pending_consolidation in state.pending_consolidations:
+            source_validator = state.validators[int(pending_consolidation.source_index)]
+            if source_validator.slashed:
+                next_pending_consolidation += 1
+                continue
+            if int(source_validator.withdrawable_epoch) > next_epoch:
+                break
+            # move min(balance, effective) — the excess stays withdrawable
+            source_effective_balance = min(
+                int(state.balances[int(pending_consolidation.source_index)]),
+                int(source_validator.effective_balance),
+            )
+            self.decrease_balance(
+                state, pending_consolidation.source_index, source_effective_balance
+            )
+            self.increase_balance(
+                state, pending_consolidation.target_index, source_effective_balance
+            )
+            next_pending_consolidation += 1
+
+        state.pending_consolidations = list(state.pending_consolidations)[
+            next_pending_consolidation:
+        ]
+
+    def process_effective_balance_updates(self, state) -> None:
+        hysteresis_increment = self.EFFECTIVE_BALANCE_INCREMENT // self.HYSTERESIS_QUOTIENT
+        downward_threshold = hysteresis_increment * self.HYSTERESIS_DOWNWARD_MULTIPLIER
+        upward_threshold = hysteresis_increment * self.HYSTERESIS_UPWARD_MULTIPLIER
+        for index, validator in enumerate(state.validators):
+            balance = int(state.balances[index])
+            # [Modified in Electra:EIP7251] per-validator cap
+            max_effective_balance = self.get_max_effective_balance(validator)
+            if (
+                balance + downward_threshold < validator.effective_balance
+                or int(validator.effective_balance) + upward_threshold < balance
+            ):
+                validator.effective_balance = min(
+                    balance - balance % self.EFFECTIVE_BALANCE_INCREMENT, max_effective_balance
+                )
+
+    # == block processing (specs/electra/beacon-chain.md:1168-1864) ========
+
+    def max_blobs_per_block(self) -> int:
+        return self.config.MAX_BLOBS_PER_BLOCK_ELECTRA  # [Modified in Electra:EIP7691]
+
+    def get_execution_requests_list(self, execution_requests):
+        """EIP-7685 typed flat encoding (reference: beacon-chain.md:1307-1325)."""
+        requests = [
+            (self.DEPOSIT_REQUEST_TYPE, execution_requests.deposits),
+            (self.WITHDRAWAL_REQUEST_TYPE, execution_requests.withdrawals),
+            (self.CONSOLIDATION_REQUEST_TYPE, execution_requests.consolidations),
+        ]
+        return [
+            request_type + serialize(request_data)
+            for request_type, request_data in requests
+            if len(request_data) != 0
+        ]
+
+    def process_execution_payload(self, state, body, execution_engine) -> None:
+        payload = body.execution_payload
+        assert (
+            payload.parent_hash == state.latest_execution_payload_header.block_hash
+        ), "payload parent mismatch"
+        assert payload.prev_randao == self.get_randao_mix(
+            state, self.get_current_epoch(state)
+        ), "wrong prev_randao"
+        assert payload.timestamp == self.compute_timestamp_at_slot(
+            state, state.slot
+        ), "wrong payload timestamp"
+        assert len(body.blob_kzg_commitments) <= self.max_blobs_per_block(), "too many blobs"
+        versioned_hashes = [
+            self.kzg_commitment_to_versioned_hash(commitment)
+            for commitment in body.blob_kzg_commitments
+        ]
+        assert execution_engine.verify_and_notify_new_payload(
+            self.NewPayloadRequest(
+                execution_payload=payload,
+                versioned_hashes=versioned_hashes,
+                parent_beacon_block_root=state.latest_block_header.parent_root,
+                execution_requests=body.execution_requests,  # [New in Electra]
+            )
+        ), "execution engine rejected payload"
+        state.latest_execution_payload_header = self.execution_payload_to_header(payload)
+
+    def process_operations(self, state, body) -> None:
+        """Deposit-cap switchover + the three execution-request op types
+        (reference: beacon-chain.md:1389-1426)."""
+        # [Modified in Electra:EIP6110] former deposit mechanism winds down
+        eth1_deposit_index_limit = min(
+            int(state.eth1_data.deposit_count), int(state.deposit_requests_start_index)
+        )
+        if int(state.eth1_deposit_index) < eth1_deposit_index_limit:
+            assert len(body.deposits) == min(
+                self.MAX_DEPOSITS, eth1_deposit_index_limit - int(state.eth1_deposit_index)
+            ), "wrong deposit count"
+        else:
+            assert len(body.deposits) == 0, "deposits no longer allowed"
+
+        for operation in body.proposer_slashings:
+            self.process_proposer_slashing(state, operation)
+        for operation in body.attester_slashings:
+            self.process_attester_slashing(state, operation)
+        for operation in body.attestations:
+            self.process_attestation(state, operation)
+        for operation in body.deposits:
+            self.process_deposit(state, operation)
+        for operation in body.voluntary_exits:
+            self.process_voluntary_exit(state, operation)
+        for operation in body.bls_to_execution_changes:
+            self.process_bls_to_execution_change(state, operation)
+        for operation in body.execution_requests.deposits:  # [New in Electra:EIP6110]
+            self.process_deposit_request(state, operation)
+        for operation in body.execution_requests.withdrawals:  # [New in Electra:EIP7002]
+            self.process_withdrawal_request(state, operation)
+        for operation in body.execution_requests.consolidations:  # [New in Electra:EIP7251]
+            self.process_consolidation_request(state, operation)
+
+    def process_attestation(self, state, attestation) -> None:
+        """EIP-7549 committee-bit validation (reference:
+        beacon-chain.md:1435-1488)."""
+        data = attestation.data
+        assert data.target.epoch in (
+            self.get_previous_epoch(state),
+            self.get_current_epoch(state),
+        ), "target epoch out of range"
+        assert data.target.epoch == self.compute_epoch_at_slot(data.slot), "target/slot mismatch"
+        assert (
+            int(data.slot) + self.MIN_ATTESTATION_INCLUSION_DELAY <= state.slot
+        ), "attestation too recent"
+
+        # [Modified in Electra:EIP7549]
+        assert data.index == 0, "data.index must be zero post-electra"
+        committee_indices = self.get_committee_indices(attestation.committee_bits)
+        committee_offset = 0
+        for committee_index in committee_indices:
+            assert committee_index < self.get_committee_count_per_slot(
+                state, data.target.epoch
+            ), "committee index out of range"
+            committee = self.get_beacon_committee(state, data.slot, committee_index)
+            committee_attesters = {
+                int(attester_index)
+                for i, attester_index in enumerate(committee)
+                if attestation.aggregation_bits[committee_offset + i]
+            }
+            assert len(committee_attesters) > 0, "empty committee participation"
+            committee_offset += len(committee)
+        assert len(attestation.aggregation_bits) == committee_offset, "bitlist length mismatch"
+
+        participation_flag_indices = self.get_attestation_participation_flag_indices(
+            state, data, int(state.slot) - int(data.slot)
+        )
+        assert self.is_valid_indexed_attestation(
+            state, self.get_indexed_attestation(state, attestation)
+        ), "invalid aggregate signature"
+
+        if data.target.epoch == self.get_current_epoch(state):
+            epoch_participation = state.current_epoch_participation
+        else:
+            epoch_participation = state.previous_epoch_participation
+
+        proposer_reward_numerator = 0
+        for index in self.get_attesting_indices(state, attestation):
+            for flag_index, weight in enumerate(self.PARTICIPATION_FLAG_WEIGHTS):
+                if flag_index in participation_flag_indices and not self.has_flag(
+                    epoch_participation[index], flag_index
+                ):
+                    epoch_participation[index] = self.add_flag(
+                        epoch_participation[index], flag_index
+                    )
+                    proposer_reward_numerator += self.get_base_reward(state, index) * weight
+
+        proposer_reward_denominator = (
+            (self.WEIGHT_DENOMINATOR - self.PROPOSER_WEIGHT)
+            * self.WEIGHT_DENOMINATOR
+            // self.PROPOSER_WEIGHT
+        )
+        proposer_reward = proposer_reward_numerator // proposer_reward_denominator
+        self.increase_balance(state, self.get_beacon_proposer_index(state), proposer_reward)
+
+    def get_validator_from_deposit(self, pubkey, withdrawal_credentials, amount):
+        """New validators start at effective balance 0 until their pending
+        deposit lands (reference: beacon-chain.md:1498-1518)."""
+        validator = self.Validator(
+            pubkey=pubkey,
+            withdrawal_credentials=withdrawal_credentials,
+            effective_balance=0,
+            slashed=False,
+            activation_eligibility_epoch=self.FAR_FUTURE_EPOCH,
+            activation_epoch=self.FAR_FUTURE_EPOCH,
+            exit_epoch=self.FAR_FUTURE_EPOCH,
+            withdrawable_epoch=self.FAR_FUTURE_EPOCH,
+        )
+        # [Modified in Electra:EIP7251]
+        max_effective_balance = self.get_max_effective_balance(validator)
+        validator.effective_balance = min(
+            int(amount) - int(amount) % self.EFFECTIVE_BALANCE_INCREMENT, max_effective_balance
+        )
+        return validator
+
+    def is_valid_deposit_signature(
+        self, pubkey, withdrawal_credentials, amount, signature
+    ) -> bool:
+        deposit_message = self.DepositMessage(
+            pubkey=pubkey, withdrawal_credentials=withdrawal_credentials, amount=amount
+        )
+        domain = self.compute_domain(self.DOMAIN_DEPOSIT)  # deposits valid across forks
+        signing_root = self.compute_signing_root(deposit_message, domain)
+        return bls.Verify(pubkey, signing_root, signature)
+
+    def apply_deposit(self, state, pubkey, withdrawal_credentials, amount, signature) -> None:
+        validator_pubkeys = [v.pubkey for v in state.validators]
+        if pubkey not in validator_pubkeys:
+            if self.is_valid_deposit_signature(pubkey, withdrawal_credentials, amount, signature):
+                # [Modified in Electra:EIP7251] registry entry with 0 balance
+                self.add_validator_to_registry(state, pubkey, withdrawal_credentials, 0)
+            else:
+                return
+        # [Modified in Electra:EIP7251] balance flows through the queue
+        state.pending_deposits.append(
+            self.PendingDeposit(
+                pubkey=pubkey,
+                withdrawal_credentials=withdrawal_credentials,
+                amount=amount,
+                signature=signature,
+                slot=self.GENESIS_SLOT,  # distinguishes from a deposit request
+            )
+        )
+
+    def process_voluntary_exit(self, state, signed_voluntary_exit) -> None:
+        voluntary_exit = signed_voluntary_exit.message
+        validator = state.validators[int(voluntary_exit.validator_index)]
+        assert self.is_active_validator(validator, self.get_current_epoch(state)), "not active"
+        assert validator.exit_epoch == self.FAR_FUTURE_EPOCH, "already exiting"
+        assert self.get_current_epoch(state) >= voluntary_exit.epoch, "exit not yet valid"
+        assert (
+            self.get_current_epoch(state)
+            >= int(validator.activation_epoch) + self.config.SHARD_COMMITTEE_PERIOD
+        ), "validator too young to exit"
+        # [New in Electra:EIP7251] no exit while partial withdrawals pend
+        assert (
+            self.get_pending_balance_to_withdraw(state, int(voluntary_exit.validator_index)) == 0
+        ), "pending withdrawals in queue"
+        domain = self.compute_domain(
+            self.DOMAIN_VOLUNTARY_EXIT,
+            self.config.CAPELLA_FORK_VERSION,
+            state.genesis_validators_root,
+        )
+        signing_root = self.compute_signing_root(voluntary_exit, domain)
+        assert bls.Verify(validator.pubkey, signing_root, signed_voluntary_exit.signature)
+        self.initiate_validator_exit(state, voluntary_exit.validator_index)
+
+    # == withdrawals (specs/electra/beacon-chain.md:1186-1303) =============
+
+    def get_expected_withdrawals(self, state):
+        """Pending-partial queue drain, then the capella-style sweep.
+        Returns (withdrawals, processed_partial_withdrawals_count)."""
+        epoch = self.get_current_epoch(state)
+        withdrawal_index = int(state.next_withdrawal_index)
+        validator_index = int(state.next_withdrawal_validator_index)
+        withdrawals = []
+        processed_partial_withdrawals_count = 0
+
+        # [New in Electra:EIP7251] consume pending partial withdrawals
+        for withdrawal in state.pending_partial_withdrawals:
+            if (
+                int(withdrawal.withdrawable_epoch) > epoch
+                or len(withdrawals) == self.MAX_PENDING_PARTIALS_PER_WITHDRAWALS_SWEEP
+            ):
+                break
+            validator = state.validators[int(withdrawal.validator_index)]
+            has_sufficient_effective_balance = (
+                int(validator.effective_balance) >= self.MIN_ACTIVATION_BALANCE
+            )
+            total_withdrawn = sum(
+                int(w.amount)
+                for w in withdrawals
+                if w.validator_index == withdrawal.validator_index
+            )
+            balance = int(state.balances[int(withdrawal.validator_index)]) - total_withdrawn
+            has_excess_balance = balance > self.MIN_ACTIVATION_BALANCE
+            if (
+                validator.exit_epoch == self.FAR_FUTURE_EPOCH
+                and has_sufficient_effective_balance
+                and has_excess_balance
+            ):
+                withdrawable_balance = min(
+                    balance - self.MIN_ACTIVATION_BALANCE, int(withdrawal.amount)
+                )
+                withdrawals.append(
+                    self.Withdrawal(
+                        index=withdrawal_index,
+                        validator_index=withdrawal.validator_index,
+                        address=bytes(validator.withdrawal_credentials)[12:],
+                        amount=withdrawable_balance,
+                    )
+                )
+                withdrawal_index += 1
+            processed_partial_withdrawals_count += 1
+
+        # sweep for the remaining (full + excess-balance) withdrawals
+        bound = min(len(state.validators), self.MAX_VALIDATORS_PER_WITHDRAWALS_SWEEP)
+        for _ in range(bound):
+            validator = state.validators[validator_index]
+            # [Modified in Electra:EIP7251] account amounts already queued
+            total_withdrawn = sum(
+                int(w.amount) for w in withdrawals if w.validator_index == validator_index
+            )
+            balance = int(state.balances[validator_index]) - total_withdrawn
+            address = bytes(validator.withdrawal_credentials)[12:]
+            if self.is_fully_withdrawable_validator(validator, balance, epoch):
+                withdrawals.append(
+                    self.Withdrawal(
+                        index=withdrawal_index,
+                        validator_index=validator_index,
+                        address=address,
+                        amount=balance,
+                    )
+                )
+                withdrawal_index += 1
+            elif self.is_partially_withdrawable_validator(validator, balance):
+                withdrawals.append(
+                    self.Withdrawal(
+                        index=withdrawal_index,
+                        validator_index=validator_index,
+                        address=address,
+                        amount=balance - self.get_max_effective_balance(validator),
+                    )
+                )
+                withdrawal_index += 1
+            if len(withdrawals) == self.MAX_WITHDRAWALS_PER_PAYLOAD:
+                break
+            validator_index = (validator_index + 1) % len(state.validators)
+        return withdrawals, processed_partial_withdrawals_count
+
+    def process_withdrawals(self, state, payload) -> None:
+        # [Modified in Electra:EIP7251]
+        expected_withdrawals, processed_partial_withdrawals_count = (
+            self.get_expected_withdrawals(state)
+        )
+        assert list(payload.withdrawals) == expected_withdrawals, "withdrawals mismatch"
+
+        for withdrawal in expected_withdrawals:
+            self.decrease_balance(state, withdrawal.validator_index, withdrawal.amount)
+
+        # [New in Electra:EIP7251]
+        state.pending_partial_withdrawals = list(state.pending_partial_withdrawals)[
+            processed_partial_withdrawals_count:
+        ]
+
+        if len(expected_withdrawals) != 0:
+            state.next_withdrawal_index = int(expected_withdrawals[-1].index) + 1
+
+        if len(expected_withdrawals) == self.MAX_WITHDRAWALS_PER_PAYLOAD:
+            state.next_withdrawal_validator_index = (
+                int(expected_withdrawals[-1].validator_index) + 1
+            ) % len(state.validators)
+        else:
+            state.next_withdrawal_validator_index = (
+                int(state.next_withdrawal_validator_index)
+                + self.MAX_VALIDATORS_PER_WITHDRAWALS_SWEEP
+            ) % len(state.validators)
+
+    # == execution-layer requests (beacon-chain.md:1655-1864) ==============
+
+    def process_withdrawal_request(self, state, withdrawal_request) -> None:
+        amount = int(withdrawal_request.amount)
+        is_full_exit_request = amount == self.FULL_EXIT_REQUEST_AMOUNT
+
+        if (
+            len(state.pending_partial_withdrawals) == self.PENDING_PARTIAL_WITHDRAWALS_LIMIT
+            and not is_full_exit_request
+        ):
+            return
+
+        validator_pubkeys = [v.pubkey for v in state.validators]
+        request_pubkey = withdrawal_request.validator_pubkey
+        if request_pubkey not in validator_pubkeys:
+            return
+        index = validator_pubkeys.index(request_pubkey)
+        validator = state.validators[index]
+
+        has_correct_credential = self.has_execution_withdrawal_credential(validator)
+        is_correct_source_address = (
+            bytes(validator.withdrawal_credentials)[12:]
+            == bytes(withdrawal_request.source_address)
+        )
+        if not (has_correct_credential and is_correct_source_address):
+            return
+        if not self.is_active_validator(validator, self.get_current_epoch(state)):
+            return
+        if validator.exit_epoch != self.FAR_FUTURE_EPOCH:
+            return
+        if (
+            self.get_current_epoch(state)
+            < int(validator.activation_epoch) + self.config.SHARD_COMMITTEE_PERIOD
+        ):
+            return
+
+        pending_balance_to_withdraw = self.get_pending_balance_to_withdraw(state, index)
+
+        if is_full_exit_request:
+            if pending_balance_to_withdraw == 0:
+                self.initiate_validator_exit(state, index)
+            return
+
+        has_sufficient_effective_balance = (
+            int(validator.effective_balance) >= self.MIN_ACTIVATION_BALANCE
+        )
+        has_excess_balance = (
+            int(state.balances[index])
+            > self.MIN_ACTIVATION_BALANCE + pending_balance_to_withdraw
+        )
+        # partial withdrawals only for compounding credentials
+        if (
+            self.has_compounding_withdrawal_credential(validator)
+            and has_sufficient_effective_balance
+            and has_excess_balance
+        ):
+            to_withdraw = min(
+                int(state.balances[index])
+                - self.MIN_ACTIVATION_BALANCE
+                - pending_balance_to_withdraw,
+                amount,
+            )
+            exit_queue_epoch = self.compute_exit_epoch_and_update_churn(state, to_withdraw)
+            withdrawable_epoch = (
+                exit_queue_epoch + self.config.MIN_VALIDATOR_WITHDRAWABILITY_DELAY
+            )
+            state.pending_partial_withdrawals.append(
+                self.PendingPartialWithdrawal(
+                    validator_index=index,
+                    amount=to_withdraw,
+                    withdrawable_epoch=withdrawable_epoch,
+                )
+            )
+
+    def process_deposit_request(self, state, deposit_request) -> None:
+        if int(state.deposit_requests_start_index) == self.UNSET_DEPOSIT_REQUESTS_START_INDEX:
+            state.deposit_requests_start_index = deposit_request.index
+        state.pending_deposits.append(
+            self.PendingDeposit(
+                pubkey=deposit_request.pubkey,
+                withdrawal_credentials=deposit_request.withdrawal_credentials,
+                amount=deposit_request.amount,
+                signature=deposit_request.signature,
+                slot=state.slot,
+            )
+        )
+
+    def is_valid_switch_to_compounding_request(self, state, consolidation_request) -> bool:
+        if consolidation_request.source_pubkey != consolidation_request.target_pubkey:
+            return False
+        source_pubkey = consolidation_request.source_pubkey
+        validator_pubkeys = [v.pubkey for v in state.validators]
+        if source_pubkey not in validator_pubkeys:
+            return False
+        source_validator = state.validators[validator_pubkeys.index(source_pubkey)]
+        if bytes(source_validator.withdrawal_credentials)[12:] != bytes(
+            consolidation_request.source_address
+        ):
+            return False
+        if not self.has_eth1_withdrawal_credential(source_validator):
+            return False
+        if not self.is_active_validator(source_validator, self.get_current_epoch(state)):
+            return False
+        if source_validator.exit_epoch != self.FAR_FUTURE_EPOCH:
+            return False
+        return True
+
+    def process_consolidation_request(self, state, consolidation_request) -> None:
+        if self.is_valid_switch_to_compounding_request(state, consolidation_request):
+            validator_pubkeys = [v.pubkey for v in state.validators]
+            source_index = validator_pubkeys.index(consolidation_request.source_pubkey)
+            self.switch_to_compounding_validator(state, source_index)
+            return
+
+        # source == target would be a disguised exit
+        if consolidation_request.source_pubkey == consolidation_request.target_pubkey:
+            return
+        if len(state.pending_consolidations) == self.PENDING_CONSOLIDATIONS_LIMIT:
+            return
+        if self.get_consolidation_churn_limit(state) <= self.MIN_ACTIVATION_BALANCE:
+            return
+
+        validator_pubkeys = [v.pubkey for v in state.validators]
+        if consolidation_request.source_pubkey not in validator_pubkeys:
+            return
+        if consolidation_request.target_pubkey not in validator_pubkeys:
+            return
+        source_index = validator_pubkeys.index(consolidation_request.source_pubkey)
+        target_index = validator_pubkeys.index(consolidation_request.target_pubkey)
+        source_validator = state.validators[source_index]
+        target_validator = state.validators[target_index]
+
+        has_correct_credential = self.has_execution_withdrawal_credential(source_validator)
+        is_correct_source_address = (
+            bytes(source_validator.withdrawal_credentials)[12:]
+            == bytes(consolidation_request.source_address)
+        )
+        if not (has_correct_credential and is_correct_source_address):
+            return
+        if not self.has_compounding_withdrawal_credential(target_validator):
+            return
+        current_epoch = self.get_current_epoch(state)
+        if not self.is_active_validator(source_validator, current_epoch):
+            return
+        if not self.is_active_validator(target_validator, current_epoch):
+            return
+        if source_validator.exit_epoch != self.FAR_FUTURE_EPOCH:
+            return
+        if target_validator.exit_epoch != self.FAR_FUTURE_EPOCH:
+            return
+        if current_epoch < int(source_validator.activation_epoch) + self.config.SHARD_COMMITTEE_PERIOD:
+            return
+        if self.get_pending_balance_to_withdraw(state, source_index) > 0:
+            return
+
+        source_validator.exit_epoch = self.compute_consolidation_epoch_and_update_churn(
+            state, int(source_validator.effective_balance)
+        )
+        source_validator.withdrawable_epoch = (
+            int(source_validator.exit_epoch) + self.config.MIN_VALIDATOR_WITHDRAWABILITY_DELAY
+        )
+        state.pending_consolidations.append(
+            self.PendingConsolidation(source_index=source_index, target_index=target_index)
+        )
+
+    # == honest validator (specs/electra/validator.md:125-147) =============
+
+    def compute_on_chain_aggregate(self, network_aggregates):
+        """Merge same-data single-committee aggregates into one on-chain
+        attestation (EIP-7549)."""
+        aggregates = sorted(
+            network_aggregates,
+            key=lambda a: self.get_committee_indices(a.committee_bits)[0],
+        )
+        data = aggregates[0].data
+        bits_type = self.Attestation.fields()["aggregation_bits"]
+        aggregation_bits = bits_type(
+            [bool(b) for a in aggregates for b in a.aggregation_bits]
+        )
+        signature = bls.Aggregate([a.signature for a in aggregates])
+        committee_indices = [
+            self.get_committee_indices(a.committee_bits)[0] for a in aggregates
+        ]
+        committee_bits = self.Attestation.fields()["committee_bits"](
+            [index in committee_indices for index in range(self.MAX_COMMITTEES_PER_SLOT)]
+        )
+        return self.Attestation(
+            aggregation_bits=aggregation_bits,
+            data=data,
+            committee_bits=committee_bits,
+            signature=signature,
+        )
+
+    # == fork upgrade (specs/electra/fork.md:42-144) =======================
+
+    def upgrade_from_parent(self, pre):
+        epoch = self.compute_epoch_at_slot(int(pre.slot))
+
+        earliest_exit_epoch = self.compute_activation_exit_epoch(epoch)
+        for validator in pre.validators:
+            if validator.exit_epoch != self.FAR_FUTURE_EPOCH:
+                if int(validator.exit_epoch) > earliest_exit_epoch:
+                    earliest_exit_epoch = int(validator.exit_epoch)
+        earliest_exit_epoch += 1
+
+        post = self.BeaconState(
+            genesis_time=pre.genesis_time,
+            genesis_validators_root=pre.genesis_validators_root,
+            slot=pre.slot,
+            fork=self.Fork(
+                previous_version=pre.fork.current_version,
+                current_version=Version(self.config.ELECTRA_FORK_VERSION),
+                epoch=epoch,
+            ),
+            latest_block_header=pre.latest_block_header,
+            block_roots=list(pre.block_roots),
+            state_roots=list(pre.state_roots),
+            historical_roots=list(pre.historical_roots),
+            eth1_data=pre.eth1_data,
+            eth1_data_votes=list(pre.eth1_data_votes),
+            eth1_deposit_index=pre.eth1_deposit_index,
+            validators=list(pre.validators),
+            balances=list(pre.balances),
+            randao_mixes=list(pre.randao_mixes),
+            slashings=list(pre.slashings),
+            previous_epoch_participation=list(pre.previous_epoch_participation),
+            current_epoch_participation=list(pre.current_epoch_participation),
+            justification_bits=list(pre.justification_bits),
+            previous_justified_checkpoint=pre.previous_justified_checkpoint,
+            current_justified_checkpoint=pre.current_justified_checkpoint,
+            finalized_checkpoint=pre.finalized_checkpoint,
+            inactivity_scores=list(pre.inactivity_scores),
+            current_sync_committee=pre.current_sync_committee,
+            next_sync_committee=pre.next_sync_committee,
+            latest_execution_payload_header=pre.latest_execution_payload_header,
+            next_withdrawal_index=pre.next_withdrawal_index,
+            next_withdrawal_validator_index=pre.next_withdrawal_validator_index,
+            historical_summaries=list(pre.historical_summaries),
+            deposit_requests_start_index=self.UNSET_DEPOSIT_REQUESTS_START_INDEX,
+            deposit_balance_to_consume=0,
+            exit_balance_to_consume=0,
+            earliest_exit_epoch=earliest_exit_epoch,
+            consolidation_balance_to_consume=0,
+            earliest_consolidation_epoch=self.compute_activation_exit_epoch(epoch),
+            pending_deposits=[],
+            pending_partial_withdrawals=[],
+            pending_consolidations=[],
+        )
+        post.exit_balance_to_consume = self.get_activation_exit_churn_limit(post)
+        post.consolidation_balance_to_consume = self.get_consolidation_churn_limit(post)
+
+        # not-yet-active validators re-enter through the deposit queue
+        pre_activation = sorted(
+            [
+                index
+                for index, validator in enumerate(post.validators)
+                if validator.activation_epoch == self.FAR_FUTURE_EPOCH
+            ],
+            key=lambda index: (
+                int(post.validators[index].activation_eligibility_epoch),
+                index,
+            ),
+        )
+        for index in pre_activation:
+            balance = int(post.balances[index])
+            post.balances[index] = 0
+            validator = post.validators[index]
+            validator.effective_balance = 0
+            validator.activation_eligibility_epoch = self.FAR_FUTURE_EPOCH
+            post.pending_deposits.append(
+                self.PendingDeposit(
+                    pubkey=validator.pubkey,
+                    withdrawal_credentials=validator.withdrawal_credentials,
+                    amount=balance,
+                    signature=bls.G2_POINT_AT_INFINITY,
+                    slot=self.GENESIS_SLOT,
+                )
+            )
+
+        # early compounding adopters go through the activation churn
+        for index, validator in enumerate(post.validators):
+            if self.has_compounding_withdrawal_credential(validator):
+                self.queue_excess_active_balance(post, index)
+
+        return post

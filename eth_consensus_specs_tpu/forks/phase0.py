@@ -587,6 +587,9 @@ class Phase0Spec:
     def whistleblower_proposer_reward(self, whistleblower_reward: int) -> int:
         return whistleblower_reward // self.PROPOSER_REWARD_QUOTIENT
 
+    def whistleblower_reward_quotient(self) -> int:
+        return self.WHISTLEBLOWER_REWARD_QUOTIENT
+
     def slash_validator(self, state, slashed_index: int, whistleblower_index=None) -> None:
         epoch = self.get_current_epoch(state)
         self.initiate_validator_exit(state, slashed_index)
@@ -608,7 +611,7 @@ class Phase0Spec:
         proposer_index = self.get_beacon_proposer_index(state)
         if whistleblower_index is None:
             whistleblower_index = proposer_index
-        whistleblower_reward = int(validator.effective_balance) // self.WHISTLEBLOWER_REWARD_QUOTIENT
+        whistleblower_reward = int(validator.effective_balance) // self.whistleblower_reward_quotient()
         proposer_reward = self.whistleblower_proposer_reward(whistleblower_reward)
         self.increase_balance(state, proposer_index, proposer_reward)
         self.increase_balance(state, whistleblower_index, whistleblower_reward - proposer_reward)

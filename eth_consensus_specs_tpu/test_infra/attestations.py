@@ -4,11 +4,11 @@ run_attestation_processing :21, next_epoch_with_attestations :329)."""
 
 from __future__ import annotations
 
-from eth_consensus_specs_tpu.ssz import Bitlist, hash_tree_root
+from eth_consensus_specs_tpu.ssz import hash_tree_root
 from eth_consensus_specs_tpu.utils import bls
 
 from .context import expect_assertion_error
-from .forks import is_post_altair
+from .forks import is_post_altair, is_post_electra
 from .keys import privkeys
 from .state import latest_block_root, next_slot
 
@@ -30,6 +30,8 @@ def build_attestation_data(spec, state, slot: int, index: int):
         source_checkpoint = state.previous_justified_checkpoint
     else:
         source_checkpoint = state.current_justified_checkpoint
+    if is_post_electra(spec):
+        index = 0  # EIP-7549: committee index moves to Attestation.committee_bits
     return spec.AttestationData(
         slot=slot,
         index=index,
@@ -68,9 +70,13 @@ def get_valid_attestation(
     participants = set(int(c) for c in committee)
     if filter_participant_set is not None:
         participants = filter_participant_set(participants)
-    bits_type = Bitlist[spec.MAX_VALIDATORS_PER_COMMITTEE]
+    bits_type = spec.Attestation.fields()["aggregation_bits"]
     bits = bits_type([int(c) in participants for c in committee])
     attestation = spec.Attestation(aggregation_bits=bits, data=data)
+    if is_post_electra(spec):
+        # single-committee attestation: the committee is named via
+        # committee_bits, not data.index (EIP-7549)
+        attestation.committee_bits[int(index)] = True
     if signed:
         sign_attestation(spec, state, attestation)
     return attestation
@@ -119,13 +125,19 @@ def add_attestations_to_state(spec, state, attestations, slot: int):
 
 
 def get_valid_attestations_at_slot(spec, state, slot: int, signed: bool = False):
-    """All committees' full attestations for `slot`."""
-    out = []
+    """All committees' full attestations for `slot`. Post-electra the
+    per-committee aggregates merge into ONE on-chain attestation
+    (EIP-7549 compute_on_chain_aggregate) so block inclusion stays within
+    MAX_ATTESTATIONS_ELECTRA regardless of committee count."""
     committees_per_slot = spec.get_committee_count_per_slot(
         state, spec.compute_epoch_at_slot(slot)
     )
-    for index in range(committees_per_slot):
-        out.append(get_valid_attestation(spec, state, slot, index, signed=signed))
+    out = [
+        get_valid_attestation(spec, state, slot, index, signed=signed)
+        for index in range(committees_per_slot)
+    ]
+    if is_post_electra(spec):
+        return [spec.compute_on_chain_aggregate(out)]
     return out
 
 

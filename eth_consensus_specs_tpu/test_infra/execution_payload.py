@@ -8,7 +8,7 @@ from __future__ import annotations
 
 from eth_consensus_specs_tpu.ssz import Bytes32
 
-from .forks import is_post_capella
+from .forks import is_post_capella, is_post_electra
 
 GENESIS_BLOCK_HASH = b"\x30" * 32
 DEFAULT_GAS_LIMIT = 30_000_000
@@ -56,7 +56,10 @@ def build_empty_execution_payload(spec, state, randao_mix=None):
         base_fee_per_gas=int(latest.base_fee_per_gas),
         transactions=[],
     )
-    if is_post_capella(spec):
+    if is_post_electra(spec):
+        # electra returns (withdrawals, processed_partials_count)
+        payload.withdrawals = spec.get_expected_withdrawals(state)[0]
+    elif is_post_capella(spec):
         # process_withdrawals checks the payload against the state's sweep
         payload.withdrawals = spec.get_expected_withdrawals(state)
     payload.block_hash = Bytes32(compute_el_block_hash(spec, payload))

@@ -17,6 +17,7 @@ from .forks import (
     fork_version_of,
     is_post_altair,
     is_post_bellatrix,
+    is_post_electra,
     previous_fork_version_of,
 )
 from .execution_payload import genesis_execution_payload_header
@@ -50,12 +51,25 @@ def create_genesis_state(spec, validator_balances: list[int], activation_thresho
         ),
     )
     for index, balance in enumerate(validator_balances):
-        effective = min(
-            balance - balance % spec.EFFECTIVE_BALANCE_INCREMENT, spec.MAX_EFFECTIVE_BALANCE
-        )
+        if is_post_electra(spec):
+            # compounding credentials for above-MinEB balances, mirroring
+            # reference helpers/genesis.py build_mock_validator
+            if balance > spec.MIN_ACTIVATION_BALANCE:
+                creds = (
+                    bytes(spec.COMPOUNDING_WITHDRAWAL_PREFIX)
+                    + b"\x00" * 11
+                    + hash_bytes(pubkey(index))[12:]
+                )
+            else:
+                creds = bls_withdrawal_credentials(spec, index)
+            max_effective = spec.MAX_EFFECTIVE_BALANCE_ELECTRA
+        else:
+            creds = bls_withdrawal_credentials(spec, index)
+            max_effective = spec.MAX_EFFECTIVE_BALANCE
+        effective = min(balance - balance % spec.EFFECTIVE_BALANCE_INCREMENT, max_effective)
         validator = spec.Validator(
             pubkey=pubkey(index),
-            withdrawal_credentials=Bytes32(bls_withdrawal_credentials(spec, index)),
+            withdrawal_credentials=Bytes32(creds),
             effective_balance=effective,
             activation_eligibility_epoch=spec.FAR_FUTURE_EPOCH,
             activation_epoch=spec.FAR_FUTURE_EPOCH,
@@ -80,4 +94,6 @@ def create_genesis_state(spec, validator_balances: list[int], activation_thresho
     if is_post_bellatrix(spec):
         # non-empty header: merge complete from genesis in tests
         state.latest_execution_payload_header = genesis_execution_payload_header(spec)
+    if is_post_electra(spec):
+        state.deposit_requests_start_index = spec.UNSET_DEPOSIT_REQUESTS_START_INDEX
     return state

@@ -1,0 +1,164 @@
+"""Electra epoch-processing and helper deltas: balance churn, registry
+single-pass activation, MaxEB effective-balance updates, slashing quotients,
+withdrawals with pending partials (spec: specs/electra/beacon-chain.md:
+548-611, 865-920, 1049-1072, 1186-1303)."""
+
+from eth_consensus_specs_tpu.test_infra.block import (
+    build_empty_block_for_next_slot,
+    state_transition_and_sign_block,
+)
+from eth_consensus_specs_tpu.test_infra.context import spec_state_test, with_phases
+from eth_consensus_specs_tpu.test_infra.state import next_epoch
+
+ELECTRA = ["electra"]
+
+
+@with_phases(ELECTRA)
+@spec_state_test
+def test_balance_churn_limits(spec, state):
+    churn = spec.get_balance_churn_limit(state)
+    assert churn % spec.EFFECTIVE_BALANCE_INCREMENT == 0
+    assert churn >= spec.config.MIN_PER_EPOCH_CHURN_LIMIT_ELECTRA
+    ae = spec.get_activation_exit_churn_limit(state)
+    assert ae == min(spec.config.MAX_PER_EPOCH_ACTIVATION_EXIT_CHURN_LIMIT, churn)
+    assert spec.get_consolidation_churn_limit(state) == churn - ae
+
+
+@with_phases(ELECTRA)
+@spec_state_test
+def test_registry_single_pass_activation(spec, state):
+    """Eligible validators activate in the same epoch sweep, uncapped by the
+    old per-count churn (EIP-7251 moves rate limiting to the deposit queue)."""
+    current_epoch = spec.get_current_epoch(state)
+    n = 5
+    for i in range(n):
+        v = state.validators[i]
+        v.activation_epoch = spec.FAR_FUTURE_EPOCH
+        v.activation_eligibility_epoch = 0
+    state.finalized_checkpoint.epoch = current_epoch  # eligibility is finalized
+    spec.process_registry_updates(state)
+    expected = spec.compute_activation_exit_epoch(current_epoch)
+    for i in range(n):
+        assert int(state.validators[i].activation_epoch) == expected
+
+
+@with_phases(ELECTRA)
+@spec_state_test
+def test_effective_balance_cap_compounding(spec, state):
+    """Compounding credentials raise the EB ceiling to MaxEB."""
+    index = 0
+    state.validators[index].withdrawal_credentials = (
+        bytes(spec.COMPOUNDING_WITHDRAWAL_PREFIX)
+        + bytes(state.validators[index].withdrawal_credentials)[1:]
+    )
+    state.balances[index] = 100 * 10**9  # 100 ETH
+    spec.process_effective_balance_updates(state)
+    assert int(state.validators[index].effective_balance) == 100 * 10**9
+
+    other = 1  # 0x00 creds keep the MinEB ceiling
+    state.balances[other] = 100 * 10**9
+    spec.process_effective_balance_updates(state)
+    assert int(state.validators[other].effective_balance) == spec.MIN_ACTIVATION_BALANCE
+
+
+@with_phases(ELECTRA)
+@spec_state_test
+def test_slashing_quotients(spec, state):
+    assert spec.min_slashing_penalty_quotient() == spec.MIN_SLASHING_PENALTY_QUOTIENT_ELECTRA
+    assert spec.whistleblower_reward_quotient() == spec.WHISTLEBLOWER_REWARD_QUOTIENT_ELECTRA
+    index = 4
+    balance_before = int(state.balances[index])
+    spec.slash_validator(state, index)
+    eff = int(state.validators[index].effective_balance)
+    expected_penalty = eff // spec.MIN_SLASHING_PENALTY_QUOTIENT_ELECTRA
+    assert int(state.balances[index]) == balance_before - expected_penalty
+
+
+@with_phases(ELECTRA)
+@spec_state_test
+def test_exit_churn_balance_accumulator(spec, state):
+    """compute_exit_epoch_and_update_churn spreads a large exit over epochs."""
+    per_epoch = spec.get_activation_exit_churn_limit(state)
+    base_epoch = spec.compute_activation_exit_epoch(spec.get_current_epoch(state))
+    # small exit fits in the first epoch
+    e1 = spec.compute_exit_epoch_and_update_churn(state, spec.MIN_ACTIVATION_BALANCE)
+    assert e1 == base_epoch
+    # an exit larger than the remaining churn pushes the epoch out
+    e2 = spec.compute_exit_epoch_and_update_churn(state, per_epoch * 3)
+    assert e2 > e1
+
+
+@with_phases(ELECTRA)
+@spec_state_test
+def test_expected_withdrawals_pending_partial(spec, state):
+    index = 1
+    address = b"\x42" * 20
+    state.validators[index].withdrawal_credentials = (
+        bytes(spec.COMPOUNDING_WITHDRAWAL_PREFIX) + b"\x00" * 11 + address
+    )
+    amount = spec.EFFECTIVE_BALANCE_INCREMENT
+    state.balances[index] = spec.MIN_ACTIVATION_BALANCE + 2 * amount
+    state.validators[index].effective_balance = spec.MIN_ACTIVATION_BALANCE
+    state.pending_partial_withdrawals.append(
+        spec.PendingPartialWithdrawal(
+            validator_index=index,
+            amount=amount,
+            withdrawable_epoch=spec.get_current_epoch(state),
+        )
+    )
+    withdrawals, processed = spec.get_expected_withdrawals(state)
+    assert processed == 1
+    assert any(
+        int(w.validator_index) == index and int(w.amount) == amount for w in withdrawals
+    )
+
+
+@with_phases(ELECTRA)
+@spec_state_test
+def test_full_block_with_pending_partial_withdrawal(spec, state):
+    """End-to-end: a queued partial withdrawal pays out through a block."""
+    index = 1
+    address = b"\x42" * 20
+    state.validators[index].withdrawal_credentials = (
+        bytes(spec.COMPOUNDING_WITHDRAWAL_PREFIX) + b"\x00" * 11 + address
+    )
+    amount = spec.EFFECTIVE_BALANCE_INCREMENT
+    state.balances[index] = spec.MIN_ACTIVATION_BALANCE + 2 * amount
+    state.validators[index].effective_balance = spec.MIN_ACTIVATION_BALANCE
+    state.pending_partial_withdrawals.append(
+        spec.PendingPartialWithdrawal(
+            validator_index=index,
+            amount=amount,
+            withdrawable_epoch=spec.get_current_epoch(state),
+        )
+    )
+    balance_before = int(state.balances[index])
+    block = build_empty_block_for_next_slot(spec, state)
+    signed = state_transition_and_sign_block(spec, state, block)
+    assert len(state.pending_partial_withdrawals) == 0
+    assert int(state.balances[index]) == balance_before - amount
+    yield "blocks", [signed]
+    yield "post", state
+
+
+@with_phases(ELECTRA)
+@spec_state_test
+def test_epoch_transition_runs_pending_queues(spec, state):
+    """process_epoch drains pending deposits in fork order."""
+    amount = spec.EFFECTIVE_BALANCE_INCREMENT
+    v = state.validators[0]
+    from eth_consensus_specs_tpu.utils import bls as _bls
+
+    state.pending_deposits.append(
+        spec.PendingDeposit(
+            pubkey=v.pubkey,
+            withdrawal_credentials=v.withdrawal_credentials,
+            amount=amount,
+            signature=_bls.G2_POINT_AT_INFINITY,
+            slot=spec.GENESIS_SLOT,
+        )
+    )
+    balance_before = int(state.balances[0])
+    next_epoch(spec, state)
+    assert int(state.balances[0]) >= balance_before + amount  # + any rewards
+    assert len(state.pending_deposits) == 0

@@ -1,0 +1,243 @@
+"""Execution-layer requests: withdrawal, deposit, consolidation (spec:
+specs/electra/beacon-chain.md:1653-1864; reference analogue:
+test/electra/block_processing/test_process_{withdrawal,deposit,
+consolidation}_request.py)."""
+
+from eth_consensus_specs_tpu.test_infra.context import spec_state_test, with_phases
+from eth_consensus_specs_tpu.test_infra.keys import pubkey
+from eth_consensus_specs_tpu.test_infra.state import next_epoch
+
+ELECTRA = ["electra"]
+
+
+def _execution_creds(spec, state, index: int, prefix: bytes):
+    address = b"\x42" * 20
+    state.validators[index].withdrawal_credentials = prefix + b"\x00" * 11 + address
+    return address
+
+
+def _age_validator(spec, state, index: int):
+    """Make the validator old enough to exit."""
+    state.validators[index].activation_epoch = 0
+    if spec.get_current_epoch(state) < spec.config.SHARD_COMMITTEE_PERIOD:
+        state.slot = spec.config.SHARD_COMMITTEE_PERIOD * spec.SLOTS_PER_EPOCH
+
+
+# == withdrawal requests ===================================================
+
+
+@with_phases(ELECTRA)
+@spec_state_test
+def test_withdrawal_request_full_exit(spec, state):
+    index = 1
+    address = _execution_creds(spec, state, index, spec.ETH1_ADDRESS_WITHDRAWAL_PREFIX)
+    _age_validator(spec, state, index)
+    req = spec.WithdrawalRequest(
+        source_address=address,
+        validator_pubkey=state.validators[index].pubkey,
+        amount=spec.FULL_EXIT_REQUEST_AMOUNT,
+    )
+    spec.process_withdrawal_request(state, req)
+    assert state.validators[index].exit_epoch != spec.FAR_FUTURE_EPOCH
+
+
+@with_phases(ELECTRA)
+@spec_state_test
+def test_withdrawal_request_wrong_source_ignored(spec, state):
+    index = 1
+    _execution_creds(spec, state, index, spec.ETH1_ADDRESS_WITHDRAWAL_PREFIX)
+    _age_validator(spec, state, index)
+    req = spec.WithdrawalRequest(
+        source_address=b"\x99" * 20,  # not the credentialed address
+        validator_pubkey=state.validators[index].pubkey,
+        amount=spec.FULL_EXIT_REQUEST_AMOUNT,
+    )
+    spec.process_withdrawal_request(state, req)
+    assert state.validators[index].exit_epoch == spec.FAR_FUTURE_EPOCH
+
+
+@with_phases(ELECTRA)
+@spec_state_test
+def test_withdrawal_request_partial_compounding(spec, state):
+    index = 1
+    address = _execution_creds(spec, state, index, spec.COMPOUNDING_WITHDRAWAL_PREFIX)
+    _age_validator(spec, state, index)
+    excess = 3 * spec.EFFECTIVE_BALANCE_INCREMENT
+    state.balances[index] = spec.MIN_ACTIVATION_BALANCE + excess
+    amount = spec.EFFECTIVE_BALANCE_INCREMENT
+    req = spec.WithdrawalRequest(
+        source_address=address,
+        validator_pubkey=state.validators[index].pubkey,
+        amount=amount,
+    )
+    spec.process_withdrawal_request(state, req)
+    assert len(state.pending_partial_withdrawals) == 1
+    pw = state.pending_partial_withdrawals[0]
+    assert int(pw.validator_index) == index
+    assert int(pw.amount) == amount
+    # validator keeps FAR_FUTURE exit (partial, not full)
+    assert state.validators[index].exit_epoch == spec.FAR_FUTURE_EPOCH
+
+
+@with_phases(ELECTRA)
+@spec_state_test
+def test_withdrawal_request_partial_needs_compounding_creds(spec, state):
+    """0x01 credentials cannot take partial withdrawals via requests."""
+    index = 1
+    address = _execution_creds(spec, state, index, spec.ETH1_ADDRESS_WITHDRAWAL_PREFIX)
+    _age_validator(spec, state, index)
+    state.balances[index] = spec.MIN_ACTIVATION_BALANCE + spec.EFFECTIVE_BALANCE_INCREMENT
+    req = spec.WithdrawalRequest(
+        source_address=address,
+        validator_pubkey=state.validators[index].pubkey,
+        amount=spec.EFFECTIVE_BALANCE_INCREMENT,
+    )
+    spec.process_withdrawal_request(state, req)
+    assert len(state.pending_partial_withdrawals) == 0
+
+
+@with_phases(ELECTRA)
+@spec_state_test
+def test_withdrawal_request_exit_blocked_by_pending_partials(spec, state):
+    index = 1
+    address = _execution_creds(spec, state, index, spec.COMPOUNDING_WITHDRAWAL_PREFIX)
+    _age_validator(spec, state, index)
+    state.pending_partial_withdrawals.append(
+        spec.PendingPartialWithdrawal(
+            validator_index=index, amount=1, withdrawable_epoch=10**6
+        )
+    )
+    req = spec.WithdrawalRequest(
+        source_address=address,
+        validator_pubkey=state.validators[index].pubkey,
+        amount=spec.FULL_EXIT_REQUEST_AMOUNT,
+    )
+    spec.process_withdrawal_request(state, req)
+    assert state.validators[index].exit_epoch == spec.FAR_FUTURE_EPOCH
+
+
+# == deposit requests ======================================================
+
+
+@with_phases(ELECTRA)
+@spec_state_test
+def test_deposit_request_sets_start_index_and_queues(spec, state):
+    assert int(state.deposit_requests_start_index) == spec.UNSET_DEPOSIT_REQUESTS_START_INDEX
+    req = spec.DepositRequest(
+        pubkey=pubkey(300),
+        withdrawal_credentials=b"\x01" + b"\x00" * 11 + b"\x11" * 20,
+        amount=spec.MIN_ACTIVATION_BALANCE,
+        signature=b"\x00" * 96,
+        index=77,
+    )
+    spec.process_deposit_request(state, req)
+    assert int(state.deposit_requests_start_index) == 77
+    assert len(state.pending_deposits) == 1
+    assert int(state.pending_deposits[0].slot) == int(state.slot)
+    # second request does not move the start index
+    req2 = spec.DepositRequest(
+        pubkey=pubkey(301),
+        withdrawal_credentials=b"\x01" + b"\x00" * 11 + b"\x11" * 20,
+        amount=spec.MIN_ACTIVATION_BALANCE,
+        signature=b"\x00" * 96,
+        index=78,
+    )
+    spec.process_deposit_request(state, req2)
+    assert int(state.deposit_requests_start_index) == 77
+    assert len(state.pending_deposits) == 2
+
+
+# == consolidation requests ================================================
+
+
+@with_phases(ELECTRA)
+@spec_state_test
+def test_consolidation_request_basic(spec, state):
+    source, target = 1, 2
+    src_addr = _execution_creds(spec, state, source, spec.ETH1_ADDRESS_WITHDRAWAL_PREFIX)
+    _execution_creds(spec, state, target, spec.COMPOUNDING_WITHDRAWAL_PREFIX)
+    _age_validator(spec, state, source)
+    req = spec.ConsolidationRequest(
+        source_address=src_addr,
+        source_pubkey=state.validators[source].pubkey,
+        target_pubkey=state.validators[target].pubkey,
+    )
+    spec.process_consolidation_request(state, req)
+    assert len(state.pending_consolidations) == 1
+    pc = state.pending_consolidations[0]
+    assert int(pc.source_index) == source and int(pc.target_index) == target
+    assert state.validators[source].exit_epoch != spec.FAR_FUTURE_EPOCH
+
+
+@with_phases(ELECTRA)
+@spec_state_test
+def test_consolidation_request_switch_to_compounding(spec, state):
+    index = 1
+    addr = _execution_creds(spec, state, index, spec.ETH1_ADDRESS_WITHDRAWAL_PREFIX)
+    excess = 2 * spec.EFFECTIVE_BALANCE_INCREMENT
+    state.balances[index] = spec.MIN_ACTIVATION_BALANCE + excess
+    pk = state.validators[index].pubkey
+    req = spec.ConsolidationRequest(
+        source_address=addr, source_pubkey=pk, target_pubkey=pk
+    )
+    spec.process_consolidation_request(state, req)
+    assert spec.has_compounding_withdrawal_credential(state.validators[index])
+    # excess balance entered the deposit queue
+    assert int(state.balances[index]) == spec.MIN_ACTIVATION_BALANCE
+    assert len(state.pending_deposits) == 1
+    assert int(state.pending_deposits[0].amount) == excess
+    # no pending consolidation for a self-switch
+    assert len(state.pending_consolidations) == 0
+
+
+@with_phases(ELECTRA)
+@spec_state_test
+def test_consolidation_request_target_needs_compounding(spec, state):
+    source, target = 1, 2
+    src_addr = _execution_creds(spec, state, source, spec.ETH1_ADDRESS_WITHDRAWAL_PREFIX)
+    _execution_creds(spec, state, target, spec.ETH1_ADDRESS_WITHDRAWAL_PREFIX)
+    _age_validator(spec, state, source)
+    req = spec.ConsolidationRequest(
+        source_address=src_addr,
+        source_pubkey=state.validators[source].pubkey,
+        target_pubkey=state.validators[target].pubkey,
+    )
+    spec.process_consolidation_request(state, req)
+    assert len(state.pending_consolidations) == 0
+    assert state.validators[source].exit_epoch == spec.FAR_FUTURE_EPOCH
+
+
+# == pending consolidation sweep ===========================================
+
+
+@with_phases(ELECTRA)
+@spec_state_test
+def test_process_pending_consolidations_moves_balance(spec, state):
+    source, target = 1, 2
+    state.validators[source].withdrawable_epoch = spec.get_current_epoch(state)
+    state.pending_consolidations.append(
+        spec.PendingConsolidation(source_index=source, target_index=target)
+    )
+    src_balance = int(state.balances[source])
+    tgt_balance = int(state.balances[target])
+    eff = int(state.validators[source].effective_balance)
+    moved = min(src_balance, eff)
+    spec.process_pending_consolidations(state)
+    assert int(state.balances[source]) == src_balance - moved
+    assert int(state.balances[target]) == tgt_balance + moved
+    assert len(state.pending_consolidations) == 0
+
+
+@with_phases(ELECTRA)
+@spec_state_test
+def test_process_pending_consolidations_skips_slashed(spec, state):
+    source, target = 1, 2
+    state.validators[source].slashed = True
+    state.validators[source].withdrawable_epoch = spec.get_current_epoch(state)
+    state.pending_consolidations.append(
+        spec.PendingConsolidation(source_index=source, target_index=target)
+    )
+    src_balance = int(state.balances[source])
+    spec.process_pending_consolidations(state)
+    assert int(state.balances[source]) == src_balance  # nothing moved
+    assert len(state.pending_consolidations) == 0  # but the entry is consumed

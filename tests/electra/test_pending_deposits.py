@@ -1,0 +1,130 @@
+"""Pending-deposit queue processing (spec:
+specs/electra/beacon-chain.md:922-1020; reference analogue:
+test/electra/epoch_processing/test_process_pending_deposits.py)."""
+
+from eth_consensus_specs_tpu.test_infra.context import spec_state_test, with_phases
+from eth_consensus_specs_tpu.test_infra.keys import pubkey
+from eth_consensus_specs_tpu.utils import bls
+
+ELECTRA = ["electra"]
+
+
+def _pending_deposit_for(spec, state, index: int, amount: int):
+    v = state.validators[index]
+    return spec.PendingDeposit(
+        pubkey=v.pubkey,
+        withdrawal_credentials=v.withdrawal_credentials,
+        amount=amount,
+        signature=bls.G2_POINT_AT_INFINITY,
+        slot=spec.GENESIS_SLOT,
+    )
+
+
+@with_phases(ELECTRA)
+@spec_state_test
+def test_pending_deposit_applied_to_existing_validator(spec, state):
+    amount = spec.EFFECTIVE_BALANCE_INCREMENT
+    state.pending_deposits.append(_pending_deposit_for(spec, state, 0, amount))
+    balance_before = int(state.balances[0])
+    spec.process_pending_deposits(state)
+    assert int(state.balances[0]) == balance_before + amount
+    assert len(state.pending_deposits) == 0
+    assert int(state.deposit_balance_to_consume) == 0
+
+
+@with_phases(ELECTRA)
+@spec_state_test
+def test_pending_deposit_not_finalized_waits(spec, state):
+    """A deposit with slot beyond the finalized slot stays queued."""
+    amount = spec.EFFECTIVE_BALANCE_INCREMENT
+    pd = _pending_deposit_for(spec, state, 0, amount)
+    pd.slot = 10_000  # far beyond finalized
+    state.pending_deposits.append(pd)
+    balance_before = int(state.balances[0])
+    spec.process_pending_deposits(state)
+    assert int(state.balances[0]) == balance_before
+    assert len(state.pending_deposits) == 1
+
+
+@with_phases(ELECTRA)
+@spec_state_test
+def test_pending_deposit_exited_validator_postponed(spec, state):
+    amount = spec.EFFECTIVE_BALANCE_INCREMENT
+    exit_epoch = spec.get_current_epoch(state) + 10
+    state.validators[0].exit_epoch = exit_epoch
+    state.validators[0].withdrawable_epoch = exit_epoch + 100
+    state.pending_deposits.append(_pending_deposit_for(spec, state, 0, amount))
+    balance_before = int(state.balances[0])
+    spec.process_pending_deposits(state)
+    # postponed: still queued, balance untouched
+    assert int(state.balances[0]) == balance_before
+    assert len(state.pending_deposits) == 1
+
+
+@with_phases(ELECTRA)
+@spec_state_test
+def test_pending_deposit_withdrawn_validator_applied_without_churn(spec, state):
+    amount = spec.EFFECTIVE_BALANCE_INCREMENT
+    state.validators[0].exit_epoch = 0
+    state.validators[0].withdrawable_epoch = 0
+    state.pending_deposits.append(_pending_deposit_for(spec, state, 0, amount))
+    balance_before = int(state.balances[0])
+    spec.process_pending_deposits(state)
+    assert int(state.balances[0]) == balance_before + amount
+    assert len(state.pending_deposits) == 0
+
+
+@with_phases(ELECTRA)
+@spec_state_test
+def test_pending_deposit_churn_limit_carries_over(spec, state):
+    """Deposits beyond the activation-exit churn stay queued and the unused
+    allowance accumulates in deposit_balance_to_consume."""
+    churn = spec.get_activation_exit_churn_limit(state)
+    big = churn + spec.EFFECTIVE_BALANCE_INCREMENT
+    state.pending_deposits.append(_pending_deposit_for(spec, state, 0, big))
+    balance_before = int(state.balances[0])
+    spec.process_pending_deposits(state)
+    assert int(state.balances[0]) == balance_before  # did not fit this epoch
+    assert len(state.pending_deposits) == 1
+    assert int(state.deposit_balance_to_consume) == churn
+    # next epoch the accumulated churn covers it
+    spec.process_pending_deposits(state)
+    assert int(state.balances[0]) == balance_before + big
+    assert len(state.pending_deposits) == 0
+    assert int(state.deposit_balance_to_consume) == 0
+
+
+@with_phases(ELECTRA)
+@spec_state_test
+def test_pending_deposit_max_per_epoch(spec, state):
+    amount = spec.EFFECTIVE_BALANCE_INCREMENT
+    count = spec.MAX_PENDING_DEPOSITS_PER_EPOCH + 2
+    for _ in range(count):
+        state.pending_deposits.append(_pending_deposit_for(spec, state, 0, amount))
+    spec.process_pending_deposits(state)
+    assert len(state.pending_deposits) == 2
+
+
+@with_phases(ELECTRA)
+@spec_state_test
+def test_pending_deposit_new_validator_infinity_signature(spec, state):
+    """A queued transfer (infinity signature) for an unknown pubkey fails
+    proof-of-possession and is dropped without creating a validator."""
+    n_before = len(state.validators)
+    new_pub = pubkey(n_before + 7)
+    pd = spec.PendingDeposit(
+        pubkey=new_pub,
+        withdrawal_credentials=b"\x01" + b"\x00" * 11 + b"\x22" * 20,
+        amount=spec.MIN_ACTIVATION_BALANCE,
+        signature=bls.G2_POINT_AT_INFINITY,
+        slot=spec.GENESIS_SLOT,
+    )
+    state.pending_deposits.append(pd)
+    prior = bls.bls_active
+    bls.bls_active = True  # signature check must actually run
+    try:
+        spec.process_pending_deposits(state)
+    finally:
+        bls.bls_active = prior
+    assert len(state.validators) == n_before
+    assert len(state.pending_deposits) == 0

@@ -1,0 +1,107 @@
+"""EIP-7549 committee-bit attestations (reference analogue:
+test/electra/block_processing/test_process_attestation.py; spec:
+specs/electra/beacon-chain.md:1435-1488)."""
+
+from eth_consensus_specs_tpu.ssz import Bitlist
+from eth_consensus_specs_tpu.test_infra.attestations import (
+    get_valid_attestation,
+    run_attestation_processing,
+    sign_attestation,
+)
+from eth_consensus_specs_tpu.test_infra.context import (
+    always_bls,
+    spec_state_test,
+    with_phases,
+)
+from eth_consensus_specs_tpu.test_infra.state import next_slots
+
+ELECTRA_ONWARD = ["electra"]
+
+
+@with_phases(ELECTRA_ONWARD)
+@spec_state_test
+def test_one_basic_attestation(spec, state):
+    attestation = get_valid_attestation(spec, state, signed=True)
+    next_slots(spec, state, spec.MIN_ATTESTATION_INCLUSION_DELAY)
+    yield from run_attestation_processing(spec, state, attestation)
+
+
+@with_phases(ELECTRA_ONWARD)
+@always_bls
+@spec_state_test
+def test_one_attestation_real_signature(spec, state):
+    attestation = get_valid_attestation(spec, state, signed=True)
+    next_slots(spec, state, spec.MIN_ATTESTATION_INCLUSION_DELAY)
+    yield from run_attestation_processing(spec, state, attestation)
+
+
+@with_phases(ELECTRA_ONWARD)
+@spec_state_test
+def test_invalid_nonzero_data_index(spec, state):
+    attestation = get_valid_attestation(spec, state, signed=True)
+    next_slots(spec, state, spec.MIN_ATTESTATION_INCLUSION_DELAY)
+    attestation.data.index = 1  # post-electra data.index must be 0
+    yield from run_attestation_processing(spec, state, attestation, valid=False)
+
+
+@with_phases(ELECTRA_ONWARD)
+@spec_state_test
+def test_invalid_committee_index_out_of_range(spec, state):
+    # shrink the active set so committee_count < MAX_COMMITTEES_PER_SLOT,
+    # leaving head-room in the bitvector for an out-of-range index
+    target_active = 2 * spec.SLOTS_PER_EPOCH * spec.TARGET_COMMITTEE_SIZE
+    for i in range(target_active, len(state.validators)):
+        state.validators[i].exit_epoch = 0
+        state.validators[i].withdrawable_epoch = 0
+    committee_count = spec.get_committee_count_per_slot(
+        state, spec.get_current_epoch(state)
+    )
+    assert committee_count < spec.MAX_COMMITTEES_PER_SLOT
+    attestation = get_valid_attestation(spec, state, signed=True)
+    next_slots(spec, state, spec.MIN_ATTESTATION_INCLUSION_DELAY)
+    attestation.committee_bits = spec.Attestation.fields()["committee_bits"]()
+    attestation.committee_bits[committee_count] = True
+    yield from run_attestation_processing(spec, state, attestation, valid=False)
+
+
+@with_phases(ELECTRA_ONWARD)
+@spec_state_test
+def test_invalid_too_many_committee_bits(spec, state):
+    """Extra committee bit set -> bitlist length no longer matches."""
+    attestation = get_valid_attestation(spec, state, signed=True)
+    next_slots(spec, state, spec.MIN_ATTESTATION_INCLUSION_DELAY)
+    attestation.committee_bits[1] = True
+    yield from run_attestation_processing(spec, state, attestation, valid=False)
+
+
+@with_phases(ELECTRA_ONWARD)
+@spec_state_test
+def test_invalid_empty_participation(spec, state):
+    attestation = get_valid_attestation(
+        spec, state, filter_participant_set=lambda _: set()
+    )
+    next_slots(spec, state, spec.MIN_ATTESTATION_INCLUSION_DELAY)
+    yield from run_attestation_processing(spec, state, attestation, valid=False)
+
+
+@with_phases(ELECTRA_ONWARD)
+@spec_state_test
+def test_multi_committee_aggregate(spec, state):
+    """One attestation carrying two committees' participation."""
+    committees_per_slot = spec.get_committee_count_per_slot(
+        state, spec.get_current_epoch(state)
+    )
+    if committees_per_slot < 2:
+        return  # preset too small for a multi-committee aggregate
+    slot = int(state.slot)
+    c0 = spec.get_beacon_committee(state, slot, 0)
+    c1 = spec.get_beacon_committee(state, slot, 1)
+    attestation = get_valid_attestation(spec, state, slot=slot, index=0)
+    attestation.committee_bits[1] = True
+    bits_type = Bitlist[spec.MAX_VALIDATORS_PER_COMMITTEE * spec.MAX_COMMITTEES_PER_SLOT]
+    attestation.aggregation_bits = bits_type([True] * (len(c0) + len(c1)))
+    sign_attestation(spec, state, attestation)
+    next_slots(spec, state, spec.MIN_ATTESTATION_INCLUSION_DELAY)
+    attesting = spec.get_attesting_indices(state, attestation)
+    assert attesting == {int(i) for i in c0} | {int(i) for i in c1}
+    yield from run_attestation_processing(spec, state, attestation)

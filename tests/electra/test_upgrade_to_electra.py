@@ -1,0 +1,79 @@
+"""deneb -> electra state upgrade (spec: specs/electra/fork.md:42-144)."""
+
+from eth_consensus_specs_tpu.forks import get_spec
+from eth_consensus_specs_tpu.test_infra.context import spec_state_test, with_phases
+from eth_consensus_specs_tpu.test_infra.state import next_epoch
+from eth_consensus_specs_tpu.utils import bls
+
+
+@with_phases(["deneb"])
+@spec_state_test
+def test_upgrade_to_electra_basic(spec, state):
+    electra = get_spec("electra", spec.preset_name)
+    next_epoch(spec, state)
+    post = electra.upgrade_from_parent(state)
+    assert bytes(post.fork.current_version) == bytes(electra.config.ELECTRA_FORK_VERSION)
+    assert int(post.deposit_requests_start_index) == electra.UNSET_DEPOSIT_REQUESTS_START_INDEX
+    assert int(post.deposit_balance_to_consume) == 0
+    assert int(post.exit_balance_to_consume) == electra.get_activation_exit_churn_limit(post)
+    assert int(post.consolidation_balance_to_consume) == electra.get_consolidation_churn_limit(
+        post
+    )
+    # all genesis validators are active -> no pre-activation queue entries
+    assert len(post.pending_deposits) == 0
+    assert len(post.pending_partial_withdrawals) == 0
+    assert len(post.pending_consolidations) == 0
+    assert int(post.earliest_exit_epoch) == electra.compute_activation_exit_epoch(
+        electra.get_current_epoch(post)
+    ) + 1 or int(post.earliest_exit_epoch) >= 1
+    next_epoch(electra, post)
+
+
+@with_phases(["deneb"])
+@spec_state_test
+def test_upgrade_to_electra_pre_activation_queue(spec, state):
+    """Validators not yet active are zeroed and re-enter via pending deposits."""
+    electra = get_spec("electra", spec.preset_name)
+    # make validator 0 pending-activation with an eligibility epoch
+    v = state.validators[0]
+    v.activation_epoch = spec.FAR_FUTURE_EPOCH
+    v.activation_eligibility_epoch = 1
+    balance_before = int(state.balances[0])
+    post = electra.upgrade_from_parent(state)
+    assert int(post.balances[0]) == 0
+    assert int(post.validators[0].effective_balance) == 0
+    assert post.validators[0].activation_eligibility_epoch == electra.FAR_FUTURE_EPOCH
+    assert len(post.pending_deposits) == 1
+    pd = post.pending_deposits[0]
+    assert pd.pubkey == state.validators[0].pubkey
+    assert int(pd.amount) == balance_before
+    assert bytes(pd.signature) == bls.G2_POINT_AT_INFINITY
+    assert int(pd.slot) == electra.GENESIS_SLOT
+
+
+@with_phases(["deneb"])
+@spec_state_test
+def test_upgrade_to_electra_exit_epoch_carryover(spec, state):
+    """earliest_exit_epoch starts one past the max existing exit epoch."""
+    electra = get_spec("electra", spec.preset_name)
+    state.validators[3].exit_epoch = 100
+    state.validators[5].exit_epoch = 200
+    post = electra.upgrade_from_parent(state)
+    assert int(post.earliest_exit_epoch) == 201
+
+
+@with_phases(["deneb"])
+@spec_state_test
+def test_upgrade_to_electra_compounding_adopter(spec, state):
+    """0x02-credentialed validators queue their excess balance."""
+    electra = get_spec("electra", spec.preset_name)
+    creds = bytes(electra.COMPOUNDING_WITHDRAWAL_PREFIX) + bytes(
+        state.validators[2].withdrawal_credentials
+    )[1:]
+    state.validators[2].withdrawal_credentials = creds
+    excess = 5_000_000_000
+    state.balances[2] = int(electra.MIN_ACTIVATION_BALANCE) + excess
+    post = electra.upgrade_from_parent(state)
+    assert int(post.balances[2]) == electra.MIN_ACTIVATION_BALANCE
+    assert len(post.pending_deposits) == 1
+    assert int(post.pending_deposits[0].amount) == excess
