@@ -1,13 +1,22 @@
-"""Hash-to-G2 for BLS signatures.
+"""Hash-to-G2 for BLS signatures — RFC 9380 ciphersuite
+``BLS12381G2_XMD:SHA-256_SSWU_RO_`` (the scheme the reference's milagro/
+arkworks/py_ecc backends implement; reference seam: utils/bls.py:57-68).
 
-`expand_message_xmd` follows RFC 9380 exactly. The field-to-curve map is a
-deterministic try-and-increment (x += 1 until x^3 + b is square) followed by
-cofactor clearing — NOT the RFC's SSWU+isogeny ciphersuite. It yields a
-secure-for-testing, fully deterministic BLS scheme that is self-consistent
-across this framework (Sign/Verify/Aggregate all interoperate); byte-level
-interop with external RFC-9380 signers is a known TODO tracked for the SSWU
-constants. Cofactors are *verified* at import against the Hasse bound and
-group structure rather than trusted.
+Pipeline (RFC 9380 §3): expand_message_xmd → hash_to_field(Fq2, m=2, L=64)
+→ simplified-SWU on the 3-isogenous curve E2' (§6.6.2) → 3-isogeny back to
+E2 (Appendix E.3) → add the two mapped points on E2 → clear cofactor by
+h_eff (§8.8.2).
+
+All ciphersuite constants (A', B', Z, isogeny coefficients, h_eff) are the
+published public parameters. They are cross-validated at import time by
+structural invariants that fail loudly on any transcription error:
+
+  * A'/B'/Z consistency: SSWU outputs land exactly on E2' for sample inputs,
+  * the isogeny maps E2' points onto E2 (a rational map with a wrong
+    coefficient almost surely leaves the curve),
+  * the isogeny is a homomorphism: iso(2P) == iso(P) + iso(P) on E2,
+  * h_eff·P lands in the r-torsion for a generic E2 point and
+    h_eff % r != 0 (so clearing is non-degenerate).
 """
 
 from __future__ import annotations
@@ -15,69 +24,111 @@ from __future__ import annotations
 import hashlib
 
 from .curve import Point, B2, in_subgroup
-from .fields import Fq, Fq2, P, R, BLS_X
+from .fields import Fq, Fq2, P, R
 
+# Ethereum's proof-of-possession ciphersuite DST (the POP_ tag is part of
+# the ciphersuite ID; reference backends sign under this exact domain)
 DST_G2 = b"BLS_SIG_BLS12381G2_XMD:SHA-256_SSWU_RO_POP_"
 
-# G2 cofactor derived from the curve family structure and verified below.
-# t = x + 1 is the Frobenius trace of E/Fq; t2 the trace over Fq2.
-_T = BLS_X + 1
-_T2 = _T * _T - 2 * P
+# == ciphersuite curve parameters (RFC 9380 §8.8.2) ========================
+
+# E2': y^2 = x^3 + A' x + B', the 3-isogenous SSWU-friendly curve
+A_PRIME = Fq2.from_ints(0, 240)
+B_PRIME = Fq2.from_ints(1012, 1012)
+# Z = -(2 + u)
+Z_SSWU = Fq2(Fq(-2), Fq(-1))
+
+# h_eff for G2 cofactor clearing (RFC 9380 §8.8.2)
+H_EFF = 0xBC69F08F2EE75B3584C6A0EA91B352888E2A8E9145AD7689986FF031508FFE1329C2F178731DB956D82BF015D1212B02EC0EC69D7477C1AE954CBC06689F6A359894C0ADEBBF6B4E8020005AAA95551
+
+# == 3-isogeny map E2' -> E2 (RFC 9380 Appendix E.3) =======================
+
+_K1 = [  # x numerator, degree 3
+    Fq2.from_ints(
+        0x5C759507E8E333EBB5B7A9A47D7ED8532C52D39FD3A042A88B58423C50AE15D5C2638E343D9C71C6238AAAAAAAA97D6,
+        0x5C759507E8E333EBB5B7A9A47D7ED8532C52D39FD3A042A88B58423C50AE15D5C2638E343D9C71C6238AAAAAAAA97D6,
+    ),
+    Fq2.from_ints(
+        0,
+        0x11560BF17BAA99BC32126FCED787C88F984F87ADF7AE0C7F9A208C6B4F20A4181472AAA9CB8D555526A9FFFFFFFFC71A,
+    ),
+    Fq2.from_ints(
+        0x11560BF17BAA99BC32126FCED787C88F984F87ADF7AE0C7F9A208C6B4F20A4181472AAA9CB8D555526A9FFFFFFFFC71E,
+        0x8AB05F8BDD54CDE190937E76BC3E447CC27C3D6FBD7063FCD104635A790520C0A395554E5C6AAAA9354FFFFFFFFE38D,
+    ),
+    Fq2.from_ints(
+        0x171D6541FA38CCFAED6DEA691F5FB614CB14B4E7F4E810AA22D6108F142B85757098E38D0F671C7188E2AAAAAAAA5ED1,
+        0,
+    ),
+]
+_K2 = [  # x denominator, monic degree 2: x^2 + k21 x + k20
+    Fq2.from_ints(
+        0,
+        0x1A0111EA397FE69A4B1BA7B6434BACD764774B84F38512BF6730D2A0F6B0F6241EABFFFEB153FFFFB9FEFFFFFFFFAA63,
+    ),
+    Fq2.from_ints(
+        0xC,
+        0x1A0111EA397FE69A4B1BA7B6434BACD764774B84F38512BF6730D2A0F6B0F6241EABFFFEB153FFFFB9FEFFFFFFFFAA9F,
+    ),
+    Fq2.one(),
+]
+_K3 = [  # y numerator, degree 3
+    Fq2.from_ints(
+        0x1530477C7AB4113B59A4C18B076D11930F7DA5D4A07F649BF54439D87D27E500FC8C25EBF8C92F6812CFC71C71C6D706,
+        0x1530477C7AB4113B59A4C18B076D11930F7DA5D4A07F649BF54439D87D27E500FC8C25EBF8C92F6812CFC71C71C6D706,
+    ),
+    Fq2.from_ints(
+        0,
+        0x5C759507E8E333EBB5B7A9A47D7ED8532C52D39FD3A042A88B58423C50AE15D5C2638E343D9C71C6238AAAAAAAA97BE,
+    ),
+    Fq2.from_ints(
+        0x11560BF17BAA99BC32126FCED787C88F984F87ADF7AE0C7F9A208C6B4F20A4181472AAA9CB8D555526A9FFFFFFFFC71C,
+        0x8AB05F8BDD54CDE190937E76BC3E447CC27C3D6FBD7063FCD104635A790520C0A395554E5C6AAAA9354FFFFFFFFE38F,
+    ),
+    Fq2.from_ints(
+        0x124C9AD43B6CF79BFBF7043DE3811AD0761B0F37A1E26286B0E977C69AA274524E79097A56DC4BD9E1B371C71C718B10,
+        0,
+    ),
+]
+_K4 = [  # y denominator, monic degree 3: x^3 + k42 x^2 + k41 x + k40
+    Fq2.from_ints(
+        0x1A0111EA397FE69A4B1BA7B6434BACD764774B84F38512BF6730D2A0F6B0F6241EABFFFEB153FFFFB9FEFFFFFFFFA8FB,
+        0x1A0111EA397FE69A4B1BA7B6434BACD764774B84F38512BF6730D2A0F6B0F6241EABFFFEB153FFFFB9FEFFFFFFFFA8FB,
+    ),
+    Fq2.from_ints(
+        0,
+        0x1A0111EA397FE69A4B1BA7B6434BACD764774B84F38512BF6730D2A0F6B0F6241EABFFFEB153FFFFB9FEFFFFFFFFA9D3,
+    ),
+    Fq2.from_ints(
+        0x12,
+        0x1A0111EA397FE69A4B1BA7B6434BACD764774B84F38512BF6730D2A0F6B0F6241EABFFFEB153FFFFB9FEFFFFFFFFAA99,
+    ),
+    Fq2.one(),
+]
 
 
-def _arbitrary_twist_point() -> Point:
-    """Some point on E'(Fq2) NOT constructed from the generator — generic
-    order, used to discriminate the true group order among candidates."""
-    x = Fq2.from_ints(1, 1)
-    one = Fq2.from_ints(1, 0)
-    while True:
-        y2 = x.square() * x + B2
-        y = y2.sqrt()
-        if y is not None:
-            return Point(x, y, B2)
-        x = x + one
+def _horner(coeffs: list[Fq2], x: Fq2) -> Fq2:
+    acc = coeffs[-1]
+    for c in reversed(coeffs[:-1]):
+        acc = acc * x + c
+    return acc
 
 
-def _find_h2() -> int:
-    # Candidate twist orders: |E'(Fq2)| = p^2 + 1 - tw where tw ranges over
-    # the sextic-twist trace family {(+-t2 +- 3f)/2, +-t2} with
-    # 3f^2 = 4p^2 - t2^2 (CM discriminant -3). The true order must
-    # annihilate a generic point, be divisible by r, and satisfy Hasse.
-    disc = 4 * P * P - _T2 * _T2
-    assert disc % 3 == 0
-    f2 = disc // 3
-    f = _isqrt(f2)
-    assert f * f == f2, "twist discriminant must be -3 * square"
-    probe = _arbitrary_twist_point()
-    candidates = [
-        _T2,
-        -_T2,
-        (_T2 + 3 * f) // 2,
-        (_T2 - 3 * f) // 2,
-        (-_T2 + 3 * f) // 2,
-        (-_T2 - 3 * f) // 2,
-    ]
-    for tw in candidates:
-        order = P * P + 1 - tw
-        if order <= 0 or order % R != 0:
-            continue
-        if abs(tw) > 2 * _isqrt(P * P):
-            continue
-        if probe.mul(order).is_infinity():
-            return order // R
-    raise AssertionError("no valid twist order found")
+def iso_map_g2(x: Fq2, y: Fq2) -> Point:
+    """Evaluate the 3-isogeny E2' -> E2 at an affine (x, y)."""
+    x_num = _horner(_K1, x)
+    x_den = _horner(_K2, x)
+    y_num = _horner(_K3, x)
+    y_den = _horner(_K4, x)
+    if x_den.is_zero() or y_den.is_zero():
+        # the isogeny's poles are the kernel; they map to O
+        return Point.infinity(B2)
+    xo = x_num * x_den.inv()
+    yo = y * y_num * y_den.inv()
+    return Point(xo, yo, B2)
 
 
-def _isqrt(n: int) -> int:
-    import math
-
-    return math.isqrt(n)
-
-
-H2 = _find_h2()
-
-# sanity: Hasse bound for E'(Fq2)
-assert abs(P * P + 1 - H2 * R) <= 2 * P, "G2 cofactor fails Hasse bound"
+# == RFC 9380 primitives ====================================================
 
 
 def expand_message_xmd(msg: bytes, dst: bytes, len_in_bytes: int) -> bytes:
@@ -112,27 +163,96 @@ def hash_to_field_fq2(msg: bytes, count: int, dst: bytes = DST_G2) -> list[Fq2]:
     return out
 
 
-def _map_to_curve_increment(u: Fq2) -> Point:
-    """Deterministic try-and-increment: first x >= u with (x^3+b) square."""
-    x = u
-    one = Fq2.from_ints(1, 0)
-    while True:
-        y2 = x.square() * x + B2
-        y = y2.sqrt()
-        if y is not None:
-            if y.sign():
-                y = -y
-            return Point(x, y, B2)
-        x = x + one
+def _sgn0(x: Fq2) -> int:
+    """RFC 9380 §4.1 sgn0 for m=2: parity of the first nonzero limb."""
+    sign_0 = x.c0.n & 1
+    zero_0 = x.c0.n == 0
+    sign_1 = x.c1.n & 1
+    return sign_0 | (int(zero_0) & sign_1)
+
+
+def map_to_curve_sswu_g2(u: Fq2) -> tuple[Fq2, Fq2]:
+    """Simplified SWU on E2' (RFC 9380 §6.6.2). Returns affine (x', y')."""
+    A, B, Z = A_PRIME, B_PRIME, Z_SSWU
+    u2 = u.square()
+    tv1 = Z * u2
+    tv2 = tv1.square() + tv1  # Z^2 u^4 + Z u^2
+    if tv2.is_zero():
+        # exceptional case: x1 = B / (Z * A)
+        x1 = B * (Z * A).inv()
+    else:
+        x1 = (-B) * A.inv() * (Fq2.one() + tv2.inv())
+    gx1 = (x1.square() + A) * x1 + B
+    y1 = gx1.sqrt()
+    if y1 is not None:
+        x, y = x1, y1
+    else:
+        x2 = tv1 * x1
+        gx2 = (x2.square() + A) * x2 + B
+        y2 = gx2.sqrt()
+        assert y2 is not None, "SSWU: neither gx1 nor gx2 is square (impossible)"
+        x, y = x2, y2
+    if _sgn0(u) != _sgn0(y):
+        y = -y
+    return x, y
+
+
+def map_to_curve_g2(u: Fq2) -> Point:
+    """SSWU + isogeny: field element -> point on E2."""
+    xp, yp = map_to_curve_sswu_g2(u)
+    return iso_map_g2(xp, yp)
 
 
 def clear_cofactor_g2(p: Point) -> Point:
-    return p.mul(H2)
+    return p.mul(H_EFF)
 
 
 def hash_to_g2(msg: bytes, dst: bytes = DST_G2) -> Point:
+    """RFC 9380 hash_to_curve for BLS12381G2_XMD:SHA-256_SSWU_RO_.
+
+    Subgroup membership of the result is structurally guaranteed by the
+    h_eff clearing validated once at import, not re-proven per call."""
     u0, u1 = hash_to_field_fq2(msg, 2, dst)
-    q = _map_to_curve_increment(u0) + _map_to_curve_increment(u1)
-    r = clear_cofactor_g2(q)
-    assert in_subgroup(r)
-    return r
+    q = map_to_curve_g2(u0) + map_to_curve_g2(u1)
+    return clear_cofactor_g2(q)
+
+
+# == import-time structural validation =====================================
+
+
+def _on_e2_prime(x: Fq2, y: Fq2) -> bool:
+    return y.square() == (x.square() + A_PRIME) * x + B_PRIME
+
+
+def _validate_ciphersuite() -> None:
+    probes = [
+        Fq2.from_ints(1, 2),
+        Fq2.from_ints(0x1234567, 0),
+        Fq2.from_ints(0, 0xDEADBEEF),
+        hash_to_field_fq2(b"validation", 1)[0],
+    ]
+    for u in probes:
+        xp, yp = map_to_curve_sswu_g2(u)
+        assert _on_e2_prime(xp, yp), "SSWU output not on E2' (A'/B'/Z wrong)"
+        q = iso_map_g2(xp, yp)
+        assert q.is_on_curve(), "isogeny image not on E2 (isogeny constants wrong)"
+    # homomorphism probe: double a point on E2' (general Weierstrass law,
+    # a = A') and require iso(2P') == 2 * iso(P'). A 3-isogeny is a group
+    # morphism; a wrong coefficient that still lands on E2 breaks this.
+    xp, yp = map_to_curve_sswu_g2(probes[0])
+    lam = (xp.square() + xp.square() + xp.square() + A_PRIME) * (yp + yp).inv()
+    x2 = lam.square() - xp - xp
+    y2 = lam * (xp - x2) - yp
+    assert _on_e2_prime(x2, y2)
+    assert iso_map_g2(x2, y2) == iso_map_g2(xp, yp).double(), (
+        "isogeny is not a homomorphism (isogeny constants wrong)"
+    )
+    s = iso_map_g2(x2, y2) + iso_map_g2(*map_to_curve_sswu_g2(probes[3]))
+    # cofactor clearing: lands in the r-torsion, and is non-degenerate
+    assert H_EFF % R != 0, "h_eff must not be divisible by r"
+    cleared = clear_cofactor_g2(s)
+    assert in_subgroup(cleared), "h_eff fails to clear the G2 cofactor"
+    assert not cleared.is_infinity()
+
+
+_validate_ciphersuite()

@@ -1,0 +1,77 @@
+"""RFC 9380 hash-to-G2 known-answer tests.
+
+The RO_ suite vectors use the RFC's test DST; matching them end-to-end
+(expand_message -> hash_to_field -> SSWU -> isogeny -> clear_cofactor)
+pins byte-level interop with every conforming BLS implementation
+(reference backends: milagro/arkworks/py_ecc, utils/bls.py:57-68).
+"""
+
+from eth_consensus_specs_tpu.crypto.hash_to_curve import (
+    DST_G2,
+    expand_message_xmd,
+    hash_to_field_fq2,
+    hash_to_g2,
+    map_to_curve_g2,
+)
+from eth_consensus_specs_tpu.crypto.curve import g2_to_bytes, g2_from_bytes, in_subgroup
+
+RFC_DST = b"QUUX-V01-CS02-with-BLS12381G2_XMD:SHA-256_SSWU_RO_"
+
+
+def test_rfc9380_g2_ro_abc():
+    """RFC 9380 Appendix J.10.1, msg="abc"."""
+    p = hash_to_g2(b"abc", RFC_DST)
+    assert p.x.c0.n == int(
+        "02c2d18e033b960562aae3cab37a27ce00d80ccd5ba4b7fe0e7a210245129dbe"
+        "c7780ccc7954725f4168aff2787776e6",
+        16,
+    )
+    assert p.x.c1.n == int(
+        "139cddbccdc5e91b9623efd38c49f81a6f83f175e80b06fc374de9eb4b41dfe4"
+        "ca3a230ed250fbe3a2acf73a41177fd8",
+        16,
+    )
+    assert p.y.c0.n == int(
+        "1787327b68159716a37440985269cf584bcb1e621d3a7202be6ea05c4cfe244a"
+        "eb197642555a0645fb87bf7466b2ba48",
+        16,
+    )
+    assert p.y.c1.n == int(
+        "00aa65dae3c8d732d10ecd2c50f8a1baf3001578f71c694e03866e9f3d49ac1e"
+        "1ce70dd94a733534f106d4cec0eddd16",
+        16,
+    )
+
+
+def test_hash_to_g2_deterministic_and_in_subgroup():
+    for msg in [b"", b"abc", b"a" * 512, bytes(range(256))]:
+        p = hash_to_g2(msg)
+        q = hash_to_g2(msg)
+        assert p == q
+        assert p.is_on_curve()
+        assert in_subgroup(p)
+        # round-trips through compressed serialization
+        assert g2_from_bytes(g2_to_bytes(p)) == p
+
+
+def test_distinct_messages_distinct_points():
+    seen = set()
+    for i in range(16):
+        seen.add(g2_to_bytes(hash_to_g2(i.to_bytes(4, "big"))))
+    assert len(seen) == 16
+
+
+def test_dst_separates_domains():
+    assert hash_to_g2(b"msg", RFC_DST) != hash_to_g2(b"msg", DST_G2)
+
+
+def test_expand_message_xmd_length_and_determinism():
+    out = expand_message_xmd(b"msg", RFC_DST, 0x80)
+    assert len(out) == 0x80
+    assert out == expand_message_xmd(b"msg", RFC_DST, 0x80)
+
+
+def test_map_to_curve_on_curve():
+    for u in hash_to_field_fq2(b"map-probe", 4):
+        q = map_to_curve_g2(u)
+        assert q.is_on_curve()
