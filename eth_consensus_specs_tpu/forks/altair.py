@@ -502,10 +502,65 @@ class AltairSpec(Phase0Spec):
         self.process_effective_balance_updates(state)
         self._process_epoch_resets(state)
 
+    def extract_epoch_columns(self, state):
+        """Flatten the object state into the flag-based columnar arrays for
+        ops/altair_epoch. Participation is already columnar in altair+
+        (uint8 flag lists), so no committee resolution is needed — the
+        extraction is a plain O(N) copy. Returns
+        (AltairEpochColumns, JustificationState)."""
+        import numpy as np
+
+        from eth_consensus_specs_tpu.ops.altair_epoch import AltairEpochColumns
+
+        eff, bal, slashed, act, exitep, wd = self._registry_columns(state)
+        n = len(state.validators)
+        prev_flags = np.fromiter(
+            (int(f) for f in state.previous_epoch_participation), np.uint8, n
+        )
+        cur_flags = np.fromiter(
+            (int(f) for f in state.current_epoch_participation), np.uint8, n
+        )
+        cur_tgt = ((cur_flags >> self.TIMELY_TARGET_FLAG_INDEX) & 1).astype(bool)
+        scores = np.fromiter((int(s) for s in state.inactivity_scores), np.uint64, n)
+
+        cols = AltairEpochColumns(
+            effective_balance=eff,
+            balance=bal,
+            slashed=slashed,
+            activation_epoch=act,
+            exit_epoch=exitep,
+            withdrawable_epoch=wd,
+            prev_flags=prev_flags,
+            cur_tgt_att=cur_tgt,
+            inactivity_scores=scores,
+        )
+        return cols, self._justification_state(state)
+
+    def _writeback_extra(self, state, res) -> None:
+        new_scores = res.inactivity_scores
+        for i in range(len(new_scores)):
+            ns = int(new_scores[i])
+            if int(state.inactivity_scores[i]) != ns:
+                state.inactivity_scores[i] = ns
+
     def process_epoch_columnar(self, state) -> None:
-        # the phase0 columnar kernel reads pending attestations; the altair
-        # flag-delta kernel is a separate (simpler) fusion, not yet built
-        raise NotImplementedError("columnar epoch kernel for altair lands with ops/flag_deltas")
+        """Bit-exact process_epoch with the flag-based accounting epoch
+        fused on device (ops/altair_epoch.py). Registry updates + resets
+        stay host-side; the hoisting argument is in the kernel docstring.
+        Sync-committee resampling inside the resets reads the POST-update
+        effective balances — the shared writeback keeps that ordering."""
+        import jax
+        import numpy as np
+
+        from eth_consensus_specs_tpu.ops.altair_epoch import (
+            AltairEpochParams,
+            altair_epoch_accounting,
+        )
+
+        cols, just = self.extract_epoch_columns(state)
+        res = altair_epoch_accounting(AltairEpochParams.from_spec(self), cols, just)
+        res = jax.tree_util.tree_map(np.asarray, res)  # one device->host sync
+        self._writeback_accounting(state, res)
 
     def process_justification_and_finalization(self, state) -> None:
         if self.get_current_epoch(state) <= self.GENESIS_EPOCH + 1:

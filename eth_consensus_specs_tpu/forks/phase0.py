@@ -717,18 +717,10 @@ class Phase0Spec:
 
     # -- columnar (device) epoch processing --------------------------------
 
-    def extract_epoch_columns(self, state):
-        """Flatten the object-view state into the columnar arrays consumed by
-        ops/state_columns.epoch_accounting. Participation is pre-reduced to
-        per-component masks here (committee resolution reuses the cached
-        whole-permutation shuffle), so the device kernel sees only dense
-        vectors. Returns (EpochColumns, JustificationState)."""
+    def _registry_columns(self, state):
+        """Per-validator registry arrays shared by every fork's columnar
+        extractor: (eff, bal, slashed, activation, exit, withdrawable)."""
         import numpy as np
-
-        from eth_consensus_specs_tpu.ops.state_columns import (
-            EpochColumns,
-            JustificationState,
-        )
 
         n = len(state.validators)
         eff = np.empty(n, np.uint64)
@@ -745,6 +737,87 @@ class Phase0Spec:
             wd[i] = int(v.withdrawable_epoch)
         for i, b in enumerate(state.balances):
             bal[i] = int(b)
+        return eff, bal, slashed, act, exitep, wd
+
+    def _justification_state(self, state):
+        """Scalar JustificationState snapshot (fork-independent)."""
+        import numpy as np
+
+        from eth_consensus_specs_tpu.ops.state_columns import JustificationState
+
+        prev_epoch = self.get_previous_epoch(state)
+        cur_epoch = self.get_current_epoch(state)
+        return JustificationState(
+            current_epoch=np.uint64(cur_epoch),
+            justification_bits=np.array(list(state.justification_bits), bool),
+            prev_justified_epoch=np.uint64(int(state.previous_justified_checkpoint.epoch)),
+            prev_justified_root=np.frombuffer(
+                bytes(state.previous_justified_checkpoint.root), np.uint8
+            ),
+            cur_justified_epoch=np.uint64(int(state.current_justified_checkpoint.epoch)),
+            cur_justified_root=np.frombuffer(
+                bytes(state.current_justified_checkpoint.root), np.uint8
+            ),
+            finalized_epoch=np.uint64(int(state.finalized_checkpoint.epoch)),
+            finalized_root=np.frombuffer(bytes(state.finalized_checkpoint.root), np.uint8),
+            block_root_prev=np.frombuffer(
+                bytes(self.get_block_root(state, prev_epoch)), np.uint8
+            ),
+            block_root_cur=np.frombuffer(
+                bytes(self.get_block_root(state, cur_epoch)), np.uint8
+            ),
+            slashings_sum=np.uint64(sum(int(s) for s in state.slashings)),
+        )
+
+    def _writeback_extra(self, state, res) -> None:
+        """Fork hook: write back kernel outputs beyond balances/effective
+        balances (altair+ adds inactivity scores)."""
+
+    def _writeback_accounting(self, state, res) -> None:
+        """Apply a columnar EpochResult back onto the object state in spec
+        order: justification scalars, registry updates (which must see the
+        PRE-update effective balances and POST-justification checkpoint),
+        balance/effective-balance columns, fork extras, then the resets."""
+        state.previous_justified_checkpoint = self.Checkpoint(
+            epoch=int(res.prev_justified_epoch), root=Bytes32(res.prev_justified_root.tobytes())
+        )
+        state.current_justified_checkpoint = self.Checkpoint(
+            epoch=int(res.cur_justified_epoch), root=Bytes32(res.cur_justified_root.tobytes())
+        )
+        state.finalized_checkpoint = self.Checkpoint(
+            epoch=int(res.finalized_epoch), root=Bytes32(res.finalized_root.tobytes())
+        )
+        state.justification_bits = self.BeaconState.fields()["justification_bits"](
+            [bool(b) for b in res.justification_bits]
+        )
+
+        self.process_registry_updates(state)
+
+        new_bal = [int(x) for x in res.balance]
+        for i in range(len(new_bal)):
+            state.balances[i] = new_bal[i]
+        new_eff = res.effective_balance
+        for i, v in enumerate(state.validators):
+            ne = int(new_eff[i])
+            if int(v.effective_balance) != ne:
+                v.effective_balance = ne
+
+        self._writeback_extra(state, res)
+        self.process_eth1_data_reset(state)
+        self._process_epoch_resets(state)
+
+    def extract_epoch_columns(self, state):
+        """Flatten the object-view state into the columnar arrays consumed by
+        ops/state_columns.epoch_accounting. Participation is pre-reduced to
+        per-component masks here (committee resolution reuses the cached
+        whole-permutation shuffle), so the device kernel sees only dense
+        vectors. Returns (EpochColumns, JustificationState)."""
+        import numpy as np
+
+        from eth_consensus_specs_tpu.ops.state_columns import EpochColumns
+
+        eff, bal, slashed, act, exitep, wd = self._registry_columns(state)
+        n = len(state.validators)
 
         prev_epoch = self.get_previous_epoch(state)
         cur_epoch = self.get_current_epoch(state)
@@ -798,24 +871,7 @@ class Phase0Spec:
             incl_delay=np.minimum(best, np.uint64(1) << np.uint64(32)),
             incl_proposer=proposer,
         )
-        just = JustificationState(
-            current_epoch=np.uint64(cur_epoch),
-            justification_bits=np.array(list(state.justification_bits), bool),
-            prev_justified_epoch=np.uint64(int(state.previous_justified_checkpoint.epoch)),
-            prev_justified_root=np.frombuffer(
-                bytes(state.previous_justified_checkpoint.root), np.uint8
-            ),
-            cur_justified_epoch=np.uint64(int(state.current_justified_checkpoint.epoch)),
-            cur_justified_root=np.frombuffer(
-                bytes(state.current_justified_checkpoint.root), np.uint8
-            ),
-            finalized_epoch=np.uint64(int(state.finalized_checkpoint.epoch)),
-            finalized_root=np.frombuffer(bytes(state.finalized_checkpoint.root), np.uint8),
-            block_root_prev=np.frombuffer(bytes(prev_target_root), np.uint8),
-            block_root_cur=np.frombuffer(bytes(cur_target_root), np.uint8),
-            slashings_sum=np.uint64(sum(int(s) for s in state.slashings)),
-        )
-        return cols, just
+        return cols, self._justification_state(state)
 
     def process_epoch_columnar(self, state) -> None:
         """Bit-exact process_epoch with the accounting epoch fused on device
@@ -829,34 +885,7 @@ class Phase0Spec:
         cols, just = self.extract_epoch_columns(state)
         res = epoch_accounting(EpochParams.from_spec(self), cols, just)
         res = jax.tree_util.tree_map(np.asarray, res)  # one device->host sync
-
-        bits_out = [bool(b) for b in res.justification_bits]
-        state.previous_justified_checkpoint = self.Checkpoint(
-            epoch=int(res.prev_justified_epoch), root=Bytes32(res.prev_justified_root.tobytes())
-        )
-        state.current_justified_checkpoint = self.Checkpoint(
-            epoch=int(res.cur_justified_epoch), root=Bytes32(res.cur_justified_root.tobytes())
-        )
-        state.finalized_checkpoint = self.Checkpoint(
-            epoch=int(res.finalized_epoch), root=Bytes32(res.finalized_root.tobytes())
-        )
-        state.justification_bits = self.BeaconState.fields()["justification_bits"](bits_out)
-
-        # registry updates read the post-justification checkpoint but none of
-        # the balance columns the kernel wrote — order is free; spec order kept
-        self.process_registry_updates(state)
-
-        new_bal = [int(x) for x in res.balance]
-        for i in range(len(new_bal)):
-            state.balances[i] = new_bal[i]
-        new_eff = res.effective_balance
-        for i, v in enumerate(state.validators):
-            ne = int(new_eff[i])
-            if int(v.effective_balance) != ne:
-                v.effective_balance = ne
-
-        self.process_eth1_data_reset(state)
-        self._process_epoch_resets(state)
+        self._writeback_accounting(state, res)
 
     def get_matching_source_attestations(self, state, epoch: int):
         assert epoch in (self.get_previous_epoch(state), self.get_current_epoch(state))

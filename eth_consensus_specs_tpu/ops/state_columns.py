@@ -169,39 +169,18 @@ def _total_balance(mask, eff, increment, red) -> jnp.ndarray:
     return jnp.maximum(s, increment)
 
 
-def epoch_accounting_impl(
-    params: EpochParams,
-    cols: EpochColumns,
-    just: JustificationState,
-    red: LocalReductions = _LOCAL,
-) -> EpochResult:
-    """The fused accounting epoch: justification/finalization, attestation
-    rewards & penalties, slashing penalties, effective-balance hysteresis.
+def justification_update(just, prev_tgt_bal, cur_tgt_bal, total_active):
+    """Branch-free weigh_justification_and_finalization (reference:
+    specs/phase0/beacon-chain.md:1466-1525) — identical scalar machine for
+    every fork; only the target-balance inputs are fork-specific.
 
-    Everything is branch-free; genesis-epoch guards are `where` masks so a
-    single compiled executable serves every epoch.
+    Returns (bits, prev_je, prev_jr, cur_je, cur_jr, fin_e, fin_r) with the
+    genesis guard (epoch <= 1 leaves everything unchanged) applied.
     """
-    p = params
-    n = cols.balance.shape[0]
     one = jnp.asarray(1, U64)
-    zero = jnp.asarray(0, U64)
-    incr = jnp.asarray(p.effective_balance_increment, U64)
-
     cur_epoch = just.current_epoch
-    prev_epoch = jnp.where(cur_epoch > 0, cur_epoch - one, zero)
-
-    eff = cols.effective_balance
-    not_slashed = ~cols.slashed
-    active_cur = (cols.activation_epoch <= cur_epoch) & (cur_epoch < cols.exit_epoch)
-    active_prev = (cols.activation_epoch <= prev_epoch) & (prev_epoch < cols.exit_epoch)
-    eligible = active_prev | (cols.slashed & (prev_epoch + one < cols.withdrawable_epoch))
-
-    total_active = _total_balance(active_cur, eff, incr, red)
-
-    # -- justification & finalization (scalar; skipped for epochs 0,1) ----
+    prev_epoch = jnp.where(cur_epoch > 0, cur_epoch - one, jnp.asarray(0, U64))
     do_justif = cur_epoch > one
-    prev_tgt_bal = _total_balance(cols.tgt_att & not_slashed, eff, incr, red)
-    cur_tgt_bal = _total_balance(cols.cur_tgt_att & not_slashed, eff, incr, red)
 
     old_bits = just.justification_bits
     old_prev_je, old_prev_jr = just.prev_justified_epoch, just.prev_justified_root
@@ -239,13 +218,58 @@ def epoch_accounting_impl(
     fin_e = jnp.where(c12, old_cur_je, fin_e)
     fin_r = jnp.where(c12, old_cur_jr, fin_r)
 
-    out_bits = jnp.where(do_justif, new_bits, old_bits)
-    out_prev_je = jnp.where(do_justif, old_cur_je, old_prev_je)
-    out_prev_jr = jnp.where(do_justif, old_cur_jr, old_prev_jr)
-    out_cur_je = jnp.where(do_justif, new_cur_je, old_cur_je)
-    out_cur_jr = jnp.where(do_justif, new_cur_jr, old_cur_jr)
-    out_fin_e = jnp.where(do_justif, fin_e, just.finalized_epoch)
-    out_fin_r = jnp.where(do_justif, fin_r, just.finalized_root)
+    return (
+        jnp.where(do_justif, new_bits, old_bits),
+        jnp.where(do_justif, old_cur_je, old_prev_je),
+        jnp.where(do_justif, old_cur_jr, old_prev_jr),
+        jnp.where(do_justif, new_cur_je, old_cur_je),
+        jnp.where(do_justif, new_cur_jr, old_cur_jr),
+        jnp.where(do_justif, fin_e, just.finalized_epoch),
+        jnp.where(do_justif, fin_r, just.finalized_root),
+    )
+
+
+def epoch_accounting_impl(
+    params: EpochParams,
+    cols: EpochColumns,
+    just: JustificationState,
+    red: LocalReductions = _LOCAL,
+) -> EpochResult:
+    """The fused accounting epoch: justification/finalization, attestation
+    rewards & penalties, slashing penalties, effective-balance hysteresis.
+
+    Everything is branch-free; genesis-epoch guards are `where` masks so a
+    single compiled executable serves every epoch.
+    """
+    p = params
+    n = cols.balance.shape[0]
+    one = jnp.asarray(1, U64)
+    zero = jnp.asarray(0, U64)
+    incr = jnp.asarray(p.effective_balance_increment, U64)
+
+    cur_epoch = just.current_epoch
+    prev_epoch = jnp.where(cur_epoch > 0, cur_epoch - one, zero)
+
+    eff = cols.effective_balance
+    not_slashed = ~cols.slashed
+    active_cur = (cols.activation_epoch <= cur_epoch) & (cur_epoch < cols.exit_epoch)
+    active_prev = (cols.activation_epoch <= prev_epoch) & (prev_epoch < cols.exit_epoch)
+    eligible = active_prev | (cols.slashed & (prev_epoch + one < cols.withdrawable_epoch))
+
+    total_active = _total_balance(active_cur, eff, incr, red)
+
+    # -- justification & finalization (scalar; skipped for epochs 0,1) ----
+    prev_tgt_bal = _total_balance(cols.tgt_att & not_slashed, eff, incr, red)
+    cur_tgt_bal = _total_balance(cols.cur_tgt_att & not_slashed, eff, incr, red)
+    (
+        out_bits,
+        out_prev_je,
+        out_prev_jr,
+        out_cur_je,
+        out_cur_jr,
+        out_fin_e,
+        out_fin_r,
+    ) = justification_update(just, prev_tgt_bal, cur_tgt_bal, total_active)
 
     # -- rewards & penalties (uses the POST-justification finalized epoch) --
     sqrt_total = isqrt_u64(total_active)

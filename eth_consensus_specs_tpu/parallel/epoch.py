@@ -33,6 +33,12 @@ from jax import lax
 from jax.experimental.shard_map import shard_map
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from eth_consensus_specs_tpu.ops.altair_epoch import (
+    AltairEpochColumns,
+    AltairEpochParams,
+    AltairEpochResult,
+    altair_epoch_accounting_impl,
+)
 from eth_consensus_specs_tpu.ops.state_columns import (
     EpochColumns,
     EpochParams,
@@ -109,6 +115,46 @@ def sharded_epoch_fn(mesh: Mesh, params: EpochParams):
 
     def local(cols, just):
         return epoch_accounting_impl(params, cols, just, red)
+
+    return shard_map(
+        local,
+        mesh=mesh,
+        in_specs=(cols_spec, just_spec),
+        out_specs=res_spec,
+        check_rep=False,
+    )
+
+
+def altair_epoch_specs():
+    """(cols, just, result) PartitionSpec pytrees for the altair+ kernel."""
+    vec = P(_VALIDATOR_AXES)
+    rep = P()
+    cols = AltairEpochColumns(*([vec] * len(AltairEpochColumns._fields)))
+    just = JustificationState(*([rep] * len(JustificationState._fields)))
+    result = AltairEpochResult(
+        balance=vec,
+        effective_balance=vec,
+        inactivity_scores=vec,
+        justification_bits=rep,
+        prev_justified_epoch=rep,
+        prev_justified_root=rep,
+        cur_justified_epoch=rep,
+        cur_justified_root=rep,
+        finalized_epoch=rep,
+        finalized_root=rep,
+    )
+    return cols, just, result
+
+
+def sharded_altair_epoch_fn(mesh: Mesh, params: AltairEpochParams):
+    """Altair+ flag-based epoch kernel under shard_map — same collective
+    shape as the phase0 path minus the proposer scatter (flags carry no
+    inclusion-proposer attribution), so it is pure psum reductions."""
+    cols_spec, just_spec, res_spec = altair_epoch_specs()
+    red = MeshReductions(mesh)
+
+    def local(cols, just):
+        return altair_epoch_accounting_impl(params, cols, just, red)
 
     return shard_map(
         local,
