@@ -253,6 +253,8 @@ class Phase0Spec:
             finalized_checkpoint: Checkpoint
 
         class Eth1Block(Container):
+            # honest-validator abstraction of an eth1 block
+            # (reference: specs/phase0/validator.md:121-126)
             timestamp: uint64
             deposit_root: Root
             deposit_count: uint64
@@ -1763,20 +1765,51 @@ class Phase0Spec:
             bls.Sign(privkey, self.compute_signing_root(aggregate_and_proof, domain))
         )
 
-    def get_eth1_vote(self, state, eth1_chain):
-        # period votes tally; fall back to the current eth1_data
-        period_start = (
-            self.compute_start_slot_at_epoch(self.get_current_epoch(state))
-            // self.SLOTS_PER_EPOCH
+    def compute_time_at_slot(self, state, slot: int) -> int:
+        return int(state.genesis_time) + int(slot) * self.config.SECONDS_PER_SLOT
+
+    def voting_period_start_time(self, state) -> int:
+        eth1_voting_period_start_slot = int(state.slot) - int(state.slot) % (
+            self.EPOCHS_PER_ETH1_VOTING_PERIOD * self.SLOTS_PER_EPOCH
         )
-        votes = list(state.eth1_data_votes)
-        if not votes:
-            return state.eth1_data
-        counts = {}
-        for v in votes:
-            counts[hash_tree_root(v)] = counts.get(hash_tree_root(v), 0) + 1
-        best = max(votes, key=lambda v: (counts[hash_tree_root(v)], -votes.index(v)))
-        return best
+        return self.compute_time_at_slot(state, eth1_voting_period_start_slot)
+
+    def is_candidate_block(self, block, period_start: int) -> bool:
+        follow_time = self.config.SECONDS_PER_ETH1_BLOCK * self.config.ETH1_FOLLOW_DISTANCE
+        return (
+            int(block.timestamp) + follow_time <= period_start
+            and int(block.timestamp) + follow_time * 2 >= period_start
+        )
+
+    def get_eth1_data(self, block):
+        return self.Eth1Data(
+            deposit_root=block.deposit_root,
+            deposit_count=block.deposit_count,
+            block_hash=hash_tree_root(block),
+        )
+
+    def get_eth1_vote(self, state, eth1_chain):
+        """Majority vote over the voting-period candidate window
+        (reference: specs/phase0/validator.md:479-510)."""
+        period_start = self.voting_period_start_time(state)
+        votes_to_consider = [
+            self.get_eth1_data(block)
+            for block in eth1_chain
+            if (
+                self.is_candidate_block(block, period_start)
+                # never move back to an earlier deposit contract state
+                and int(self.get_eth1_data(block).deposit_count)
+                >= int(state.eth1_data.deposit_count)
+            )
+        ]
+        valid_votes = [vote for vote in state.eth1_data_votes if vote in votes_to_consider]
+        default_vote = votes_to_consider[-1] if any(votes_to_consider) else state.eth1_data
+        return max(
+            valid_votes,
+            # tiebreak by earliest vote among equal counts
+            key=lambda v: (valid_votes.count(v), -valid_votes.index(v)),
+            default=default_vote,
+        )
 
     def get_randao_reveal(self, state, slot: int, privkey: int) -> BLSSignature:
         temp_state = state.copy()

@@ -1,0 +1,86 @@
+"""Fork-choice scenario drivers (reference analogue:
+test/helpers/fork_choice.py — get_genesis_forkchoice_store :17,
+tick_and_add_block :40, step semantics per
+tests/formats/fork_choice/README.md:28-80)."""
+
+from __future__ import annotations
+
+from eth_consensus_specs_tpu.ssz import hash_tree_root
+
+from .context import expect_assertion_error
+
+
+def get_genesis_forkchoice_store(spec, genesis_state):
+    assert int(genesis_state.slot) == spec.GENESIS_SLOT
+    genesis_block = spec.BeaconBlock(state_root=hash_tree_root(genesis_state))
+    return spec.get_forkchoice_store(genesis_state, genesis_block), hash_tree_root(
+        genesis_block
+    )
+
+
+def tick_to_slot(spec, store, slot: int) -> None:
+    time = store.genesis_time + int(slot) * spec.config.SECONDS_PER_SLOT
+    spec.on_tick(store, time)
+
+
+def tick_seconds(spec, store, seconds: int) -> None:
+    spec.on_tick(store, store.time + int(seconds))
+
+
+def add_block(spec, store, signed_block, valid: bool = True):
+    """Apply a block, then feed its carried attestations and slashings into
+    the store, as clients do (reference: fork_choice.py add_block feeds
+    body.attestations with is_from_block=True)."""
+    if not valid:
+        expect_assertion_error(lambda: spec.on_block(store, signed_block))
+        return None
+    spec.on_block(store, signed_block)
+    for attestation in signed_block.message.body.attestations:
+        spec.on_attestation(store, attestation, is_from_block=True)
+    for slashing in signed_block.message.body.attester_slashings:
+        spec.on_attester_slashing(store, slashing)
+    return hash_tree_root(signed_block.message)
+
+
+def tick_and_add_block(spec, store, signed_block, valid: bool = True):
+    """Advance the store clock to the block's slot, then apply it."""
+    if int(signed_block.message.slot) > spec.get_current_slot(store):
+        tick_to_slot(spec, store, int(signed_block.message.slot))
+    return add_block(spec, store, signed_block, valid=valid)
+
+
+def add_attestation(spec, store, attestation, valid: bool = True, is_from_block: bool = False):
+    if not valid:
+        expect_assertion_error(
+            lambda: spec.on_attestation(store, attestation, is_from_block)
+        )
+        return
+    spec.on_attestation(store, attestation, is_from_block)
+
+
+def build_and_add_block(spec, store, state, valid: bool = True):
+    """Build an empty block on `state`'s head, run it through the store and
+    the state. Returns (signed_block, root)."""
+    from .block import build_empty_block_for_next_slot, state_transition_and_sign_block
+
+    block = build_empty_block_for_next_slot(spec, state)
+    signed = state_transition_and_sign_block(spec, state, block)
+    root = tick_and_add_block(spec, store, signed)
+    return signed, root
+
+
+def apply_next_epoch_with_attestations(spec, store, state):
+    """Advance a full epoch of blocks carrying attestations through both
+    the state and the store (reference: fork_choice.py
+    apply_next_epoch_with_attestations)."""
+    from .attestations import next_epoch_with_attestations
+
+    _, signed_blocks, post_state = next_epoch_with_attestations(
+        spec, state, fill_cur_epoch=True, fill_prev_epoch=True
+    )
+    last_root = None
+    for signed_block in signed_blocks:
+        last_root = tick_and_add_block(spec, store, signed_block)
+    # realize unrealized checkpoints at the epoch boundary tick
+    tick_to_slot(spec, store, int(post_state.slot))
+    return post_state, last_root

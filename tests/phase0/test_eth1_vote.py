@@ -1,0 +1,77 @@
+"""get_eth1_vote window/tally semantics (reference:
+specs/phase0/validator.md:461-510)."""
+
+from eth_consensus_specs_tpu.test_infra.context import spec_state_test, with_all_phases
+from eth_consensus_specs_tpu.test_infra.state import next_slots
+
+
+def _candidate_chain(spec, state, count: int):
+    """Eth1 blocks whose timestamps land inside the candidate window
+    [period_start - 2*follow_time, period_start - follow_time]."""
+    period_start = spec.voting_period_start_time(state)
+    follow_time = spec.config.SECONDS_PER_ETH1_BLOCK * spec.config.ETH1_FOLLOW_DISTANCE
+    base = period_start - 2 * follow_time
+    deposit_count = int(state.eth1_data.deposit_count)
+    return [
+        spec.Eth1Block(
+            timestamp=base + i,
+            deposit_root=b"\x01" * 32,
+            deposit_count=deposit_count + i,
+        )
+        for i in range(count)
+    ]
+
+
+@with_all_phases
+@spec_state_test
+def test_eth1_vote_default_is_latest_candidate(spec, state):
+    chain = _candidate_chain(spec, state, 4)
+    vote = spec.get_eth1_vote(state, chain)
+    assert vote == spec.get_eth1_data(chain[-1])
+
+
+@with_all_phases
+@spec_state_test
+def test_eth1_vote_no_candidates_falls_back_to_state(spec, state):
+    period_start = spec.voting_period_start_time(state)
+    # too recent: inside the follow distance
+    recent = spec.Eth1Block(
+        timestamp=period_start, deposit_root=b"\x01" * 32, deposit_count=10**6
+    )
+    vote = spec.get_eth1_vote(state, [recent])
+    assert vote == state.eth1_data
+
+
+@with_all_phases
+@spec_state_test
+def test_eth1_vote_majority_wins(spec, state):
+    chain = _candidate_chain(spec, state, 3)
+    d0, d1 = spec.get_eth1_data(chain[0]), spec.get_eth1_data(chain[1])
+    state.eth1_data_votes.append(d1)
+    state.eth1_data_votes.append(d0)
+    state.eth1_data_votes.append(d0)
+    vote = spec.get_eth1_vote(state, chain)
+    assert vote == d0
+
+
+@with_all_phases
+@spec_state_test
+def test_eth1_vote_tie_broken_by_first_cast(spec, state):
+    chain = _candidate_chain(spec, state, 3)
+    d0, d1 = spec.get_eth1_data(chain[0]), spec.get_eth1_data(chain[1])
+    state.eth1_data_votes.append(d1)
+    state.eth1_data_votes.append(d0)
+    vote = spec.get_eth1_vote(state, chain)
+    assert vote == d1  # earliest cast wins the tie
+
+
+@with_all_phases
+@spec_state_test
+def test_eth1_vote_ignores_lower_deposit_count(spec, state):
+    state.eth1_data.deposit_count = 100
+    chain = _candidate_chain(spec, state, 3)
+    chain[0].deposit_count = 5  # would roll the contract state back
+    stale_vote = spec.get_eth1_data(chain[0])
+    state.eth1_data_votes.append(stale_vote)
+    vote = spec.get_eth1_vote(state, chain)
+    assert vote != stale_vote

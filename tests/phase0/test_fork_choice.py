@@ -1,0 +1,405 @@
+"""Fork-choice scenario tests: on_tick/on_block/on_attestation/
+on_attester_slashing/get_head over the full fork matrix (reference
+analogue: eth2spec/test/phase0/fork_choice/ + unittests; step semantics
+per tests/formats/fork_choice/README.md:28-80)."""
+
+from eth_consensus_specs_tpu.ssz import hash_tree_root
+from eth_consensus_specs_tpu.test_infra.attestations import (
+    get_valid_attestation,
+    sign_attestation,
+)
+from eth_consensus_specs_tpu.test_infra.block import (
+    build_empty_block_for_next_slot,
+    sign_block,
+    state_transition_and_sign_block,
+)
+from eth_consensus_specs_tpu.test_infra.context import (
+    spec_state_test,
+    with_all_phases,
+)
+from eth_consensus_specs_tpu.test_infra.fork_choice import (
+    add_attestation,
+    add_block,
+    apply_next_epoch_with_attestations,
+    build_and_add_block,
+    get_genesis_forkchoice_store,
+    tick_and_add_block,
+    tick_to_slot,
+)
+
+
+# == basic head / store construction =======================================
+
+
+@with_all_phases
+@spec_state_test
+def test_genesis_head(spec, state):
+    store, genesis_root = get_genesis_forkchoice_store(spec, state)
+    assert spec.get_head(store) == genesis_root
+    assert store.justified_checkpoint.root == genesis_root
+    assert store.finalized_checkpoint.root == genesis_root
+
+
+@with_all_phases
+@spec_state_test
+def test_chain_of_blocks_head_follows(spec, state):
+    store, _ = get_genesis_forkchoice_store(spec, state)
+    last_root = None
+    for _ in range(3):
+        _, last_root = build_and_add_block(spec, store, state)
+    assert spec.get_head(store) == last_root
+
+
+@with_all_phases
+@spec_state_test
+def test_split_tie_broken_by_root(spec, state):
+    """Two same-slot children with no votes: lexicographically larger root."""
+    store, _ = get_genesis_forkchoice_store(spec, state)
+    state_a = state.copy()
+    state_b = state.copy()
+    block_a = build_empty_block_for_next_slot(spec, state_a)
+    signed_a = state_transition_and_sign_block(spec, state_a, block_a)
+    block_b = build_empty_block_for_next_slot(spec, state_b)
+    block_b.body.graffiti = b"\x42" * 32  # differentiate the sibling
+    signed_b = state_transition_and_sign_block(spec, state_b, block_b)
+    # tick past the attesting interval so neither block earns proposer boost
+    time = (
+        store.genesis_time
+        + int(block_a.slot) * spec.config.SECONDS_PER_SLOT
+        + spec.config.SECONDS_PER_SLOT // spec.INTERVALS_PER_SLOT
+    )
+    spec.on_tick(store, time)
+    root_a = add_block(spec, store, signed_a)
+    root_b = add_block(spec, store, signed_b)
+    assert store.proposer_boost_root == spec.Root()
+    expected = max(root_a, root_b, key=bytes)
+    assert spec.get_head(store) == expected
+
+
+@with_all_phases
+@spec_state_test
+def test_attestation_steers_head(spec, state):
+    """A vote on the lexicographically smaller branch flips the head."""
+    store, _ = get_genesis_forkchoice_store(spec, state)
+    state_a = state.copy()
+    state_b = state.copy()
+    block_a = build_empty_block_for_next_slot(spec, state_a)
+    signed_a = state_transition_and_sign_block(spec, state_a, block_a)
+    block_b = build_empty_block_for_next_slot(spec, state_b)
+    block_b.body.graffiti = b"\x42" * 32
+    signed_b = state_transition_and_sign_block(spec, state_b, block_b)
+    root_a = tick_and_add_block(spec, store, signed_a)
+    root_b = add_block(spec, store, signed_b)
+    loser = min(root_a, root_b, key=bytes)
+    loser_state = state_a if loser == root_a else state_b
+    attestation = get_valid_attestation(
+        spec, loser_state, slot=int(loser_state.slot), signed=True
+    )
+    # attestations are only valid for the store one slot later
+    tick_to_slot(spec, store, int(loser_state.slot) + 1)
+    add_attestation(spec, store, attestation)
+    assert spec.get_head(store) == loser
+
+
+# == on_block validity =====================================================
+
+
+@with_all_phases
+@spec_state_test
+def test_on_block_future_block_invalid(spec, state):
+    store, _ = get_genesis_forkchoice_store(spec, state)
+    block = build_empty_block_for_next_slot(spec, state)
+    signed = state_transition_and_sign_block(spec, state, block)
+    # store clock still at genesis slot -> block is from the future
+    add_block(spec, store, signed, valid=False)
+
+
+@with_all_phases
+@spec_state_test
+def test_on_block_unknown_parent_invalid(spec, state):
+    store, _ = get_genesis_forkchoice_store(spec, state)
+    block = build_empty_block_for_next_slot(spec, state)
+    block.parent_root = b"\x66" * 32
+    signed = sign_block(spec, state, block)
+    tick_to_slot(spec, store, int(block.slot))
+    add_block(spec, store, signed, valid=False)
+
+
+@with_all_phases
+@spec_state_test
+def test_on_block_bad_signature_invalid(spec, state):
+    from eth_consensus_specs_tpu.utils import bls as bls_mod
+
+    prior = bls_mod.bls_active
+    bls_mod.bls_active = True
+    try:
+        store, _ = get_genesis_forkchoice_store(spec, state)
+        block = build_empty_block_for_next_slot(spec, state)
+        temp = state.copy()
+        signed = state_transition_and_sign_block(spec, temp, block)
+        bad = spec.SignedBeaconBlock(message=signed.message, signature=b"\x11" * 96)
+        tick_to_slot(spec, store, int(block.slot))
+        add_block(spec, store, bad, valid=False)
+    finally:
+        bls_mod.bls_active = prior
+
+
+@with_all_phases
+@spec_state_test
+def test_on_block_skip_slots_valid(spec, state):
+    from eth_consensus_specs_tpu.test_infra.block import build_empty_block
+
+    store, _ = get_genesis_forkchoice_store(spec, state)
+    block = build_empty_block(spec, state, slot=int(state.slot) + 4)  # skip ahead
+    signed = state_transition_and_sign_block(spec, state, block)
+    root = tick_and_add_block(spec, store, signed)
+    assert spec.get_head(store) == root
+
+
+# == proposer boost ========================================================
+
+
+@with_all_phases
+@spec_state_test
+def test_proposer_boost_applied_when_timely(spec, state):
+    store, _ = get_genesis_forkchoice_store(spec, state)
+    block = build_empty_block_for_next_slot(spec, state)
+    signed = state_transition_and_sign_block(spec, state, block)
+    # tick to the block's slot start: within the attesting interval
+    tick_to_slot(spec, store, int(block.slot))
+    root = add_block(spec, store, signed)
+    assert store.proposer_boost_root == root
+    assert spec.get_weight(store, root) > 0  # boost weight with zero votes
+
+
+@with_all_phases
+@spec_state_test
+def test_proposer_boost_not_applied_when_late(spec, state):
+    store, _ = get_genesis_forkchoice_store(spec, state)
+    block = build_empty_block_for_next_slot(spec, state)
+    signed = state_transition_and_sign_block(spec, state, block)
+    # tick past the attesting interval within the block's slot
+    time = (
+        store.genesis_time
+        + int(block.slot) * spec.config.SECONDS_PER_SLOT
+        + spec.config.SECONDS_PER_SLOT // spec.INTERVALS_PER_SLOT
+    )
+    spec.on_tick(store, time)
+    root = add_block(spec, store, signed)
+    assert store.proposer_boost_root != root
+    assert spec.get_weight(store, root) == 0
+
+
+@with_all_phases
+@spec_state_test
+def test_proposer_boost_cleared_next_slot(spec, state):
+    store, _ = get_genesis_forkchoice_store(spec, state)
+    block = build_empty_block_for_next_slot(spec, state)
+    signed = state_transition_and_sign_block(spec, state, block)
+    tick_to_slot(spec, store, int(block.slot))
+    root = add_block(spec, store, signed)
+    assert store.proposer_boost_root == root
+    tick_to_slot(spec, store, int(block.slot) + 1)
+    assert store.proposer_boost_root == spec.Root()
+
+
+@with_all_phases
+@spec_state_test
+def test_proposer_boost_only_first_block(spec, state):
+    store, _ = get_genesis_forkchoice_store(spec, state)
+    state_a, state_b = state.copy(), state.copy()
+    block_a = build_empty_block_for_next_slot(spec, state_a)
+    signed_a = state_transition_and_sign_block(spec, state_a, block_a)
+    block_b = build_empty_block_for_next_slot(spec, state_b)
+    block_b.body.graffiti = b"\x77" * 32
+    signed_b = state_transition_and_sign_block(spec, state_b, block_b)
+    tick_to_slot(spec, store, int(block_a.slot))
+    root_a = add_block(spec, store, signed_a)
+    add_block(spec, store, signed_b)
+    assert store.proposer_boost_root == root_a  # second timely block ignored
+
+
+@with_all_phases
+@spec_state_test
+def test_proposer_boost_flips_split(spec, state):
+    """With no votes, the boosted sibling wins even with a smaller root."""
+    store, _ = get_genesis_forkchoice_store(spec, state)
+    state_a, state_b = state.copy(), state.copy()
+    block_a = build_empty_block_for_next_slot(spec, state_a)
+    signed_a = state_transition_and_sign_block(spec, state_a, block_a)
+    block_b = build_empty_block_for_next_slot(spec, state_b)
+    block_b.body.graffiti = b"\x42" * 32
+    signed_b = state_transition_and_sign_block(spec, state_b, block_b)
+    # add the non-boosted one late (before its slot's attesting deadline has
+    # passed the store already ticked), then re-tick and boost the other
+    tick_to_slot(spec, store, int(block_a.slot))
+    root_a = add_block(spec, store, signed_a)  # timely: boosted
+    root_b = add_block(spec, store, signed_b)  # second: no boost
+    if root_a < root_b:
+        # boost must override the tie-break that favors root_b
+        assert spec.get_head(store) == root_a
+
+
+# == on_attestation validity ===============================================
+
+
+@with_all_phases
+@spec_state_test
+def test_on_attestation_previous_epoch_ok(spec, state):
+    store, _ = get_genesis_forkchoice_store(spec, state)
+    signed, root = build_and_add_block(spec, store, state)
+    attestation = get_valid_attestation(spec, state, slot=int(state.slot), signed=True)
+    tick_to_slot(spec, store, int(state.slot) + spec.SLOTS_PER_EPOCH)
+    add_attestation(spec, store, attestation)
+    assert spec.get_head(store) == root
+
+
+@with_all_phases
+@spec_state_test
+def test_on_attestation_same_slot_invalid(spec, state):
+    """Attestations only count from the slot after their own."""
+    store, _ = get_genesis_forkchoice_store(spec, state)
+    signed, root = build_and_add_block(spec, store, state)
+    attestation = get_valid_attestation(spec, state, slot=int(state.slot), signed=True)
+    # store still at the attestation's slot
+    add_attestation(spec, store, attestation, valid=False)
+
+
+@with_all_phases
+@spec_state_test
+def test_on_attestation_unknown_head_invalid(spec, state):
+    store, _ = get_genesis_forkchoice_store(spec, state)
+    build_and_add_block(spec, store, state)
+    attestation = get_valid_attestation(spec, state, slot=int(state.slot), signed=True)
+    attestation.data.beacon_block_root = b"\x99" * 32
+    tick_to_slot(spec, store, int(state.slot) + 1)
+    add_attestation(spec, store, attestation, valid=False)
+
+
+@with_all_phases
+@spec_state_test
+def test_on_attestation_stale_target_invalid(spec, state):
+    """Targets older than the previous epoch are rejected off-block."""
+    store, _ = get_genesis_forkchoice_store(spec, state)
+    build_and_add_block(spec, store, state)
+    attestation = get_valid_attestation(spec, state, slot=int(state.slot), signed=True)
+    tick_to_slot(spec, store, int(state.slot) + 3 * spec.SLOTS_PER_EPOCH)
+    add_attestation(spec, store, attestation, valid=False)
+
+
+@with_all_phases
+@spec_state_test
+def test_latest_messages_update_only_newer_target(spec, state):
+    store, _ = get_genesis_forkchoice_store(spec, state)
+    signed, root = build_and_add_block(spec, store, state)
+    attestation = get_valid_attestation(spec, state, slot=int(state.slot), signed=True)
+    tick_to_slot(spec, store, int(state.slot) + 1)
+    add_attestation(spec, store, attestation)
+    target_epoch = int(attestation.data.target.epoch)
+    attesters = spec.get_attesting_indices(
+        store.checkpoint_states[attestation.data.target], attestation
+    )
+    for i in attesters:
+        assert int(store.latest_messages[i].epoch) == target_epoch
+        assert store.latest_messages[i].root == attestation.data.beacon_block_root
+    # re-applying the same (equal-epoch) vote does not overwrite
+    snapshot = dict(store.latest_messages)
+    add_attestation(spec, store, attestation)
+    assert store.latest_messages == snapshot
+
+
+# == equivocation ==========================================================
+
+
+@with_all_phases
+@spec_state_test
+def test_on_attester_slashing_discounts_votes(spec, state):
+    store, _ = get_genesis_forkchoice_store(spec, state)
+    signed, root = build_and_add_block(spec, store, state)
+    attestation = get_valid_attestation(spec, state, slot=int(state.slot), signed=True)
+    tick_to_slot(spec, store, int(state.slot) + 1)
+    add_attestation(spec, store, attestation)
+    weight_before = spec.get_weight(store, root)
+    assert weight_before > 0
+
+    # craft a double vote (same target epoch, different data) by the same
+    # committee and feed it as an equivocation proof
+    att2 = attestation.copy()
+    att2.data.beacon_block_root = store.blocks[root].parent_root
+    sign_attestation(spec, state, att2)
+    target_state = store.checkpoint_states[attestation.data.target]
+    slashing = spec.AttesterSlashing(
+        attestation_1=spec.get_indexed_attestation(target_state, attestation),
+        attestation_2=spec.get_indexed_attestation(target_state, att2),
+    )
+    spec.on_attester_slashing(store, slashing)
+    attesters = set(spec.get_attesting_indices(target_state, attestation))
+    assert attesters <= store.equivocating_indices
+    assert spec.get_weight(store, root) < weight_before
+
+
+# == justification / finalization through the store =======================
+
+
+@with_all_phases
+@spec_state_test
+def test_justification_realized_across_epochs(spec, state):
+    store, _ = get_genesis_forkchoice_store(spec, state)
+    # justification realizes at epoch 3, finalization at epoch 4
+    for _ in range(4):
+        state, last_root = apply_next_epoch_with_attestations(spec, store, state)
+    assert int(store.justified_checkpoint.epoch) > 0
+    assert int(store.finalized_checkpoint.epoch) > 0
+    assert spec.get_head(store) == last_root
+
+
+@with_all_phases
+@spec_state_test
+def test_unrealized_justification_pulled_up(spec, state):
+    """A prior-epoch block's unrealized justification realizes immediately
+    on import (compute_pulled_up_tip prior-epoch branch)."""
+    store, _ = get_genesis_forkchoice_store(spec, state)
+    for _ in range(3):
+        state, _ = apply_next_epoch_with_attestations(spec, store, state)
+    assert int(store.justified_checkpoint.epoch) >= 1
+    for root, cp in store.unrealized_justifications.items():
+        assert int(cp.epoch) <= int(store.unrealized_justified_checkpoint.epoch)
+
+
+@with_all_phases
+@spec_state_test
+def test_get_ancestor_walks_to_slot(spec, state):
+    store, genesis_root = get_genesis_forkchoice_store(spec, state)
+    roots = [genesis_root]
+    for _ in range(4):
+        _, root = build_and_add_block(spec, store, state)
+        roots.append(root)
+    tip = roots[-1]
+    for slot, expected in enumerate(roots):
+        assert spec.get_ancestor(store, tip, slot) == expected
+    assert spec.get_checkpoint_block(store, tip, 0) == genesis_root
+
+
+@with_all_phases
+@spec_state_test
+def test_filtered_block_tree_contains_chain(spec, state):
+    store, genesis_root = get_genesis_forkchoice_store(spec, state)
+    roots = []
+    for _ in range(3):
+        _, root = build_and_add_block(spec, store, state)
+        roots.append(root)
+    tree = spec.get_filtered_block_tree(store)
+    assert genesis_root in tree
+    for root in roots:
+        assert root in tree
+
+
+@with_all_phases
+@spec_state_test
+def test_on_tick_advances_slots(spec, state):
+    store, _ = get_genesis_forkchoice_store(spec, state)
+    assert spec.get_current_slot(store) == 0
+    tick_to_slot(spec, store, 5)
+    assert spec.get_current_slot(store) == 5
+    tick_to_slot(spec, store, 5 + spec.SLOTS_PER_EPOCH)
+    assert spec.get_current_store_epoch(store) == 1
