@@ -1,0 +1,9 @@
+"""SSZ debug/fuzz tooling (reference analogue: eth2spec/debug/ —
+encode.py, decode.py, random_value.py; consumed by the ssz_static
+vector family)."""
+
+from .encode import encode
+from .decode import decode
+from .random_value import RandomizationMode, get_random_ssz_object
+
+__all__ = ["encode", "decode", "RandomizationMode", "get_random_ssz_object"]

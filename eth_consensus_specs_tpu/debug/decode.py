@@ -1,0 +1,44 @@
+"""Plain python structure -> SSZ view (reference: eth2spec/debug/decode.py).
+Inverse of debug/encode.py."""
+
+from __future__ import annotations
+
+from eth_consensus_specs_tpu.ssz.types import (
+    Bitlist,
+    Bitvector,
+    ByteList,
+    ByteVector,
+    Container,
+    List,
+    Union,
+    Vector,
+    boolean,
+    uint,
+)
+
+
+def _bits_from_hex(typ, data: str):
+    return typ.decode_bytes(bytes.fromhex(data[2:]))
+
+
+def decode(data, typ):
+    if issubclass(typ, boolean):
+        return typ(bool(data))
+    if issubclass(typ, uint):
+        return typ(int(data))
+    if issubclass(typ, (ByteVector, ByteList)):
+        return typ(bytes.fromhex(data[2:]))
+    if issubclass(typ, (Bitvector, Bitlist)):
+        return _bits_from_hex(typ, data)
+    if issubclass(typ, Union):
+        selector = int(data["selector"])
+        opt = typ.OPTIONS[selector]
+        if opt is None or data["value"] is None:
+            return typ(selector)
+        return typ(selector, decode(data["value"], opt))
+    if issubclass(typ, Container):
+        fields = typ.fields()
+        return typ(**{name: decode(data[name], ftyp) for name, ftyp in fields.items()})
+    if issubclass(typ, (List, Vector)):
+        return typ([decode(v, typ.ELEMENT_TYPE) for v in data])
+    raise TypeError(f"cannot decode into {typ}")

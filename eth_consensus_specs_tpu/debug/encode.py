@@ -1,0 +1,40 @@
+"""SSZ view -> plain python structure (reference: eth2spec/debug/encode.py).
+
+Matches the reference's YAML-side conventions: uints as ints (strings for
+>64-bit in yaml handled by the dumper), byte types as 0x-hex strings,
+bitlists/bitvectors as hex of their serialization, containers as dicts.
+"""
+
+from __future__ import annotations
+
+from eth_consensus_specs_tpu.ssz.types import (
+    Bitlist,
+    Bitvector,
+    ByteList,
+    ByteVector,
+    Container,
+    Union,
+    View,
+    boolean,
+    uint,
+    _Sequence,
+)
+
+
+def encode(value):
+    if isinstance(value, boolean):
+        return bool(value)
+    if isinstance(value, uint):
+        return int(value)
+    if isinstance(value, (ByteVector, ByteList)):
+        return "0x" + bytes(value).hex()
+    if isinstance(value, (Bitvector, Bitlist)):
+        return "0x" + value.encode_bytes().hex()
+    if isinstance(value, Union):
+        inner = None if value.value is None else encode(value.value)
+        return {"selector": int(value.selector), "value": inner}
+    if isinstance(value, Container):
+        return {name: encode(getattr(value, name)) for name in value.fields()}
+    if isinstance(value, _Sequence):
+        return [encode(v) for v in value]
+    raise TypeError(f"cannot encode {type(value)}")

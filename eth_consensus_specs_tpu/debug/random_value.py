@@ -1,0 +1,147 @@
+"""Random SSZ value fuzzing (reference: eth2spec/debug/random_value.py,
+210 lines — same mode vocabulary: random / zero / max / nil-count /
+one-count / max-count; used by the ssz_static family)."""
+
+from __future__ import annotations
+
+from enum import Enum
+from random import Random
+
+from eth_consensus_specs_tpu.ssz.types import (
+    Bitlist,
+    Bitvector,
+    ByteList,
+    ByteVector,
+    Container,
+    List,
+    Union,
+    Vector,
+    boolean,
+    uint,
+)
+
+UINT_BYTE_SIZES = (1, 2, 4, 8, 16, 32)
+
+
+class RandomizationMode(Enum):
+    mode_random = 0
+    mode_zero = 1
+    mode_max = 2
+    mode_nil_count = 3
+    mode_one_count = 4
+    mode_max_count = 5
+
+    def is_changing(self) -> bool:
+        return self.value in (0, 4, 5)
+
+
+def get_random_ssz_object(
+    rng: Random,
+    typ,
+    max_bytes_length: int = 1024,
+    max_list_length: int = 8,
+    mode: RandomizationMode = RandomizationMode.mode_random,
+    chaos: bool = False,
+):
+    """Instance of `typ` randomized per `mode`. `chaos` re-rolls the mode
+    per element, like the reference's chaos setting."""
+    if chaos:
+        mode = rng.choice(list(RandomizationMode))
+    if issubclass(typ, boolean):
+        if mode == RandomizationMode.mode_zero:
+            return typ(False)
+        if mode == RandomizationMode.mode_max:
+            return typ(True)
+        return typ(rng.choice((True, False)))
+    if issubclass(typ, uint):
+        byte_len = typ.BITS // 8
+        if mode == RandomizationMode.mode_zero:
+            return typ(0)
+        if mode == RandomizationMode.mode_max:
+            return typ(2**typ.BITS - 1)
+        return typ(rng.randint(0, 2**typ.BITS - 1))
+    if issubclass(typ, ByteVector):
+        if mode == RandomizationMode.mode_zero:
+            return typ(b"\x00" * typ.LENGTH)
+        if mode == RandomizationMode.mode_max:
+            return typ(b"\xff" * typ.LENGTH)
+        return typ(bytes(rng.randint(0, 255) for _ in range(typ.LENGTH)))
+    if issubclass(typ, ByteList):
+        if mode == RandomizationMode.mode_nil_count:
+            return typ(b"")
+        if mode == RandomizationMode.mode_max_count:
+            length = min(typ.LIMIT, max_bytes_length)
+        elif mode == RandomizationMode.mode_one_count:
+            length = min(typ.LIMIT, 1)
+        else:
+            length = rng.randint(0, min(typ.LIMIT, max_bytes_length))
+        if mode == RandomizationMode.mode_zero:
+            return typ(b"\x00" * length)
+        if mode == RandomizationMode.mode_max:
+            return typ(b"\xff" * length)
+        return typ(bytes(rng.randint(0, 255) for _ in range(length)))
+    if issubclass(typ, Bitvector):
+        if mode == RandomizationMode.mode_zero:
+            return typ([False] * typ.LENGTH)
+        if mode == RandomizationMode.mode_max:
+            return typ([True] * typ.LENGTH)
+        return typ([rng.choice((True, False)) for _ in range(typ.LENGTH)])
+    if issubclass(typ, Bitlist):
+        if mode == RandomizationMode.mode_nil_count:
+            length = 0
+        elif mode == RandomizationMode.mode_one_count:
+            length = min(typ.LIMIT, 1)
+        elif mode == RandomizationMode.mode_max_count:
+            length = min(typ.LIMIT, max_list_length)
+        else:
+            length = rng.randint(0, min(typ.LIMIT, max_list_length))
+        if mode == RandomizationMode.mode_zero:
+            return typ([False] * length)
+        if mode == RandomizationMode.mode_max:
+            return typ([True] * length)
+        return typ([rng.choice((True, False)) for _ in range(length)])
+    if issubclass(typ, Vector):
+        return typ(
+            [
+                get_random_ssz_object(
+                    rng, typ.ELEMENT_TYPE, max_bytes_length, max_list_length, mode, chaos
+                )
+                for _ in range(typ.LENGTH)
+            ]
+        )
+    if issubclass(typ, List):
+        if mode == RandomizationMode.mode_nil_count:
+            length = 0
+        elif mode == RandomizationMode.mode_one_count:
+            length = min(typ.LIMIT, 1)
+        elif mode == RandomizationMode.mode_max_count:
+            length = min(typ.LIMIT, max_list_length)
+        else:
+            length = rng.randint(0, min(typ.LIMIT, max_list_length))
+        return typ(
+            [
+                get_random_ssz_object(
+                    rng, typ.ELEMENT_TYPE, max_bytes_length, max_list_length, mode, chaos
+                )
+                for _ in range(length)
+            ]
+        )
+    if issubclass(typ, Union):
+        selector = rng.randrange(len(typ.OPTIONS)) if mode.is_changing() else 0
+        opt = typ.OPTIONS[selector]
+        if opt is None:
+            return typ(selector)
+        return typ(
+            selector,
+            get_random_ssz_object(rng, opt, max_bytes_length, max_list_length, mode, chaos),
+        )
+    if issubclass(typ, Container):
+        return typ(
+            **{
+                name: get_random_ssz_object(
+                    rng, ftyp, max_bytes_length, max_list_length, mode, chaos
+                )
+                for name, ftyp in typ.fields().items()
+            }
+        )
+    raise TypeError(f"cannot randomize {typ}")

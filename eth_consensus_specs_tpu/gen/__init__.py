@@ -1,0 +1,21 @@
+"""Test-vector generation (L5 of the reference's layer map).
+
+The reference's conformance machine (reference:
+eth2spec/gen_helpers/gen_base/gen_runner.py:113-320, gen_from_tests/
+gen.py:19-71, gen_base/dumper.py:48-78) re-runs the decorated test
+functions in generator mode and serializes their yielded parts into the
+canonical `config/fork/runner/handler/suite/case` tree of
+`.ssz_snappy` + `.yaml` files (format: reference tests/formats/README.md).
+
+This package is the tpu-native equivalent: `discover` walks the repo's
+test modules, `run_generator` executes cases (the same decorated callables
+pytest runs, with ``generator_mode=True``), and `Dumper` writes the tree.
+Snappy framing is first-party (gen/snappy_codec.py) since no snappy
+binding is baked into the image.
+"""
+
+from .dumper import Dumper
+from .gen_from_tests import discover_test_cases
+from .gen_runner import run_generator
+
+__all__ = ["Dumper", "discover_test_cases", "run_generator"]

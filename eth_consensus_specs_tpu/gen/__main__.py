@@ -1,0 +1,32 @@
+"""CLI vector generator: `python -m eth_consensus_specs_tpu.gen`
+(reference analogue: `make reftests` -> tests/generators/main.py)."""
+
+from __future__ import annotations
+
+import argparse
+import json
+
+from .gen_from_tests import discover_test_cases
+from .gen_runner import run_generator
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser(description="generate consensus test vectors")
+    parser.add_argument("--output", "-o", default="test_vectors", help="output directory")
+    parser.add_argument("--presets", nargs="*", default=["minimal"])
+    parser.add_argument("--forks", nargs="*", default=None)
+    parser.add_argument("--runners", nargs="*", default=None)
+    parser.add_argument("--verbose", "-v", action="store_true")
+    args = parser.parse_args()
+
+    cases = discover_test_cases(
+        presets=tuple(args.presets),
+        forks=tuple(args.forks) if args.forks else None,
+        runners=tuple(args.runners) if args.runners else None,
+    )
+    stats = run_generator(cases, args.output, verbose=args.verbose)
+    print(json.dumps({"cases": len(cases), **stats}))
+
+
+if __name__ == "__main__":
+    main()

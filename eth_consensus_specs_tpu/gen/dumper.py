@@ -1,0 +1,88 @@
+"""Vector part writer (reference analogue: gen_base/dumper.py:48-78 +
+the type-tagging of tests/infra/yield_generator.py:10-43).
+
+A test case's yielded parts land in one case directory:
+
+  * SSZ views / bytes  -> `<name>.ssz_snappy`
+  * lists of views     -> `<name>_<i>.ssz_snappy` + meta `<name>_count`
+  * plain values       -> collected into `meta.yaml`
+  * `post` = None      -> omitted (the invalid-case convention, reference
+                          tests/formats/operations/README.md:24-28)
+"""
+
+from __future__ import annotations
+
+import os
+
+import yaml
+
+from eth_consensus_specs_tpu.ssz import serialize
+from eth_consensus_specs_tpu.ssz.types import View
+
+from .snappy_codec import frame_compress
+
+
+def _is_view(value) -> bool:
+    return isinstance(value, View)
+
+
+class Dumper:
+    def __init__(self, output_dir: str):
+        self.output_dir = output_dir
+
+    def case_dir(self, case) -> str:
+        return os.path.join(
+            self.output_dir,
+            case.preset,
+            case.fork,
+            case.runner,
+            case.handler,
+            case.suite,
+            case.case_name,
+        )
+
+    def dump_ssz(self, case_dir: str, name: str, encoded: bytes) -> None:
+        with open(os.path.join(case_dir, f"{name}.ssz_snappy"), "wb") as f:
+            f.write(frame_compress(encoded))
+
+    def dump_meta(self, case_dir: str, meta: dict) -> None:
+        if not meta:
+            return
+        with open(os.path.join(case_dir, "meta.yaml"), "w") as f:
+            yaml.safe_dump(meta, f, default_flow_style=None)
+
+    def dump_case(self, case, parts) -> str:
+        """Write all (name, value) parts of one executed case; returns the
+        case directory."""
+        case_dir = self.case_dir(case)
+        os.makedirs(case_dir, exist_ok=True)
+        meta: dict = {}
+        for name, value in parts:
+            if value is None:
+                continue  # invalid-case convention: no post state emitted
+            if _is_view(value):
+                self.dump_ssz(case_dir, name, serialize(value))
+            elif isinstance(value, (bytes, bytearray)):
+                self.dump_ssz(case_dir, name, bytes(value))
+            elif isinstance(value, (list, tuple)) and (not value or _is_view(value[0])):
+                # view lists (incl. empty: the zero-block sanity convention
+                # still needs `<name>_count: 0` in meta)
+                meta[f"{name}_count"] = len(value)
+                for i, item in enumerate(value):
+                    self.dump_ssz(case_dir, f"{name}_{i}", serialize(item))
+            else:
+                meta[name] = _yamlable(value)
+        self.dump_meta(case_dir, meta)
+        return case_dir
+
+
+def _yamlable(value):
+    if isinstance(value, dict):
+        return {k: _yamlable(v) for k, v in value.items()}
+    if isinstance(value, (list, tuple)):
+        return [_yamlable(v) for v in value]
+    if isinstance(value, bool):
+        return value
+    if isinstance(value, int):
+        return int(value)
+    return str(value)

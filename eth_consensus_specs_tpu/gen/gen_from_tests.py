@@ -1,0 +1,118 @@
+"""Discover decorated test functions and wrap them as generator cases
+(reference analogue: gen_from_tests/gen.py:19-71, 77-134)."""
+
+from __future__ import annotations
+
+import importlib
+import inspect
+import pkgutil
+from dataclasses import dataclass, field
+from typing import Callable
+
+
+@dataclass
+class TestCase:
+    preset: str
+    fork: str
+    runner: str
+    handler: str
+    suite: str
+    case_name: str
+    case_fn: Callable  # () -> iterator of (name, value) parts
+    # reference meta convention (tests/formats/README.md): 1 = signatures
+    # must be checked, 2 = checks must be skipped, 0/absent = optional
+    bls_setting: int = 0
+
+
+# module-basename -> (runner, handler) taxonomy; anything unmapped lands
+# under runner="tests" with the module name as handler
+_RUNNER_MAP = {
+    "test_process_attestation": ("operations", "attestation"),
+    "test_withdrawals": ("operations", "withdrawals"),
+    "test_bls_to_execution_change": ("operations", "bls_to_execution_change"),
+    "test_execution_payload": ("operations", "execution_payload"),
+    "test_blob_processing": ("operations", "execution_payload"),
+    "test_execution_requests": ("operations", "execution_requests"),
+    "test_pending_deposits": ("epoch_processing", "pending_deposits"),
+    "test_epoch_processing": ("epoch_processing", "epoch_processing"),
+    "test_sanity": ("sanity", "blocks"),
+    "test_sync_aggregate": ("operations", "sync_aggregate"),
+    "test_fork_choice": ("fork_choice", "on_block"),
+}
+
+
+def _iter_test_modules(package_name: str = "tests"):
+    pkg = importlib.import_module(package_name)
+    for modinfo in pkgutil.walk_packages(pkg.__path__, prefix=f"{package_name}."):
+        basename = modinfo.name.rsplit(".", 1)[-1]
+        if not basename.startswith("test_"):
+            continue
+        yield importlib.import_module(modinfo.name)
+
+
+def discover_test_cases(
+    presets=("minimal",),
+    forks=None,
+    runners=None,
+    package: str = "tests",
+):
+    """Walk the repo's test package; every fork-matrixed test function
+    becomes one TestCase per (preset, fork) it supports."""
+    from eth_consensus_specs_tpu.forks import available_forks
+
+    all_forks = available_forks()
+    # key -> (module_fork_segment, TestCase); same-named tests in a fork's
+    # own module dir (tests/<fork>/...) override fork-generic ones so a
+    # fork's vector comes from its most specific test definition
+    selected: dict[tuple, tuple[str | None, TestCase]] = {}
+    for module in _iter_test_modules(package):
+        parts = module.__name__.split(".")
+        basename = parts[-1]
+        module_fork = parts[-2] if len(parts) >= 2 and parts[-2] in all_forks else None
+        runner, handler = _RUNNER_MAP.get(basename, ("tests", basename.removeprefix("test_")))
+        if runners is not None and runner not in runners:
+            continue
+        for name, fn in inspect.getmembers(module, callable):
+            if not name.startswith("test_"):
+                continue
+            phases = getattr(fn, "phases", None)
+            if phases is None:
+                continue  # not a fork-matrixed spec test
+            for preset in presets:
+                for fork in phases:
+                    if fork not in all_forks:
+                        continue
+                    if forks is not None and fork not in forks:
+                        continue
+                    case_name = name.removeprefix("test_")
+                    case_handler = handler
+                    if runner == "sanity" and case_name.startswith("slots"):
+                        # slot-advance cases have their own format
+                        # (reference tests/formats/sanity/slots.md)
+                        case_handler = "slots"
+                    bls_marker = getattr(fn, "bls", None)
+                    bls_setting = {"always": 1, "never": 2}.get(bls_marker, 0)
+                    case = TestCase(
+                        preset=preset,
+                        fork=fork,
+                        runner=runner,
+                        handler=case_handler,
+                        suite="pyspec_tests",
+                        case_name=case_name,
+                        case_fn=(
+                            lambda fn=fn, fork=fork, preset=preset: fn(
+                                generator_mode=True, phase=fork, preset=preset
+                            )
+                        ),
+                        bls_setting=bls_setting,
+                    )
+                    key = (preset, fork, runner, case_handler, case_name)
+                    prev = selected.get(key)
+                    if prev is not None:
+                        prev_fork_seg = prev[0]
+                        if prev_fork_seg == fork:
+                            continue  # keep the fork-specific definition
+                        if module_fork != fork:
+                            continue  # neither specific: keep the first
+                    selected[key] = (module_fork, case)
+    return [case for _, case in selected.values()]

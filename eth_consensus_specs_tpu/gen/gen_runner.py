@@ -1,0 +1,75 @@
+"""Vector-generation executor (reference analogue:
+gen_base/gen_runner.py:113-320 — ours is sequential; the reference's
+pathos process pool parallelizes python-process-bound crypto that is not
+this framework's bottleneck)."""
+
+from __future__ import annotations
+
+import sys
+import traceback
+
+from .dumper import Dumper
+from .gen_from_tests import TestCase
+
+
+class SkippedCase(Exception):
+    pass
+
+
+def execute_case(case: TestCase, dumper: Dumper) -> str | None:
+    """Run one case in generator mode and dump its parts. Returns the case
+    dir, or None if the case was skipped."""
+    from eth_consensus_specs_tpu.test_infra.context import SkippedTest
+
+    try:
+        gen = case.case_fn()
+        if gen is None:
+            return None  # test yielded nothing (pure-assertion case)
+        # snapshot each part AT YIELD TIME: tests yield live state objects
+        # ("pre" and "post" are often the same mutated instance), so views
+        # must be copied before the generator advances (the reference
+        # serializes eagerly for the same reason, yield_generator.py:10-43)
+        parts = []
+        for name, value in gen:
+            parts.append((name, _snapshot(value)))
+    except SkippedTest:
+        return None
+    if not parts:
+        # plain-assertion test (no yielded vector parts): nothing to emit
+        return None
+    if case.bls_setting:
+        parts.insert(0, ("bls_setting", case.bls_setting))
+    return dumper.dump_case(case, parts)
+
+
+def _snapshot(value):
+    # deep-copy view lists BEFORE the generic .copy() check — list.copy()
+    # is shallow and would alias the contained views
+    if isinstance(value, (list, tuple)):
+        return [_snapshot(v) for v in value]
+    if hasattr(value, "copy") and callable(value.copy):
+        return value.copy()
+    return value
+
+
+def run_generator(cases, output_dir: str, verbose: bool = False) -> dict:
+    """Execute all cases; returns {written, skipped, failed} counts."""
+    dumper = Dumper(output_dir)
+    written = skipped = failed = 0
+    for case in cases:
+        try:
+            out = execute_case(case, dumper)
+        except Exception:
+            failed += 1
+            if verbose:
+                print(f"[gen] FAILED {case.runner}/{case.handler}/{case.case_name}",
+                      file=sys.stderr)
+                traceback.print_exc()
+            continue
+        if out is None:
+            skipped += 1
+        else:
+            written += 1
+            if verbose:
+                print(f"[gen] wrote {out}", file=sys.stderr)
+    return {"written": written, "skipped": skipped, "failed": failed}

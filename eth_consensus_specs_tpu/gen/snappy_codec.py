@@ -1,0 +1,134 @@
+"""First-party snappy FRAMING format codec (no external binding).
+
+Writer emits uncompressed chunks (type 0x01) — every conforming snappy
+decoder must accept them, so the produced ``.ssz_snappy`` files are valid
+for any consensus-layer client. Reader handles both uncompressed and
+compressed (type 0x00) chunks, with a full snappy BLOCK format
+decompressor, so upstream-released vectors (which use compressed chunks)
+can be ingested too.
+
+Framing format: stream identifier "sNaPpY", per-chunk masked CRC-32C of
+the uncompressed data. Reference consumer: gen_base/dumper.py:66-71
+(python-snappy `compress`).
+"""
+
+from __future__ import annotations
+
+_STREAM_IDENTIFIER = b"\xff\x06\x00\x00sNaPpY"
+_MAX_UNCOMPRESSED_CHUNK = 65536
+
+# CRC-32C (Castagnoli), reflected polynomial 0x82F63B78
+_CRC_TABLE = []
+for _n in range(256):
+    _c = _n
+    for _ in range(8):
+        _c = (_c >> 1) ^ 0x82F63B78 if _c & 1 else _c >> 1
+    _CRC_TABLE.append(_c)
+
+
+def crc32c(data: bytes) -> int:
+    crc = 0xFFFFFFFF
+    for b in data:
+        crc = _CRC_TABLE[(crc ^ b) & 0xFF] ^ (crc >> 8)
+    return crc ^ 0xFFFFFFFF
+
+
+def _masked_crc(data: bytes) -> int:
+    crc = crc32c(data)
+    return (((crc >> 15) | (crc << 17)) + 0xA282EAD8) & 0xFFFFFFFF
+
+
+def frame_compress(data: bytes) -> bytes:
+    """Snappy frame stream holding `data` in uncompressed chunks."""
+    out = [_STREAM_IDENTIFIER]
+    starts = range(0, len(data), _MAX_UNCOMPRESSED_CHUNK) if data else [0]
+    for i in starts:
+        chunk = data[i : i + _MAX_UNCOMPRESSED_CHUNK]
+        body = _masked_crc(chunk).to_bytes(4, "little") + chunk
+        out.append(b"\x01" + len(body).to_bytes(3, "little") + body)
+    return b"".join(out)
+
+
+def _uvarint(data: bytes, pos: int) -> tuple[int, int]:
+    shift = 0
+    result = 0
+    while True:
+        b = data[pos]
+        pos += 1
+        result |= (b & 0x7F) << shift
+        if not b & 0x80:
+            return result, pos
+        shift += 7
+
+
+def block_decompress(data: bytes) -> bytes:
+    """Snappy BLOCK format decompressor (tag-stream parser)."""
+    expected_len, pos = _uvarint(data, 0)
+    out = bytearray()
+    n = len(data)
+    while pos < n:
+        tag = data[pos]
+        pos += 1
+        kind = tag & 0b11
+        if kind == 0:  # literal
+            length = (tag >> 2) + 1
+            if length > 60:
+                extra = length - 60
+                length = int.from_bytes(data[pos : pos + extra], "little") + 1
+                pos += extra
+            out += data[pos : pos + length]
+            pos += length
+        else:
+            if kind == 1:  # copy, 1-byte offset
+                length = ((tag >> 2) & 0b111) + 4
+                offset = ((tag >> 5) << 8) | data[pos]
+                pos += 1
+            elif kind == 2:  # copy, 2-byte offset
+                length = (tag >> 2) + 1
+                offset = int.from_bytes(data[pos : pos + 2], "little")
+                pos += 2
+            else:  # copy, 4-byte offset
+                length = (tag >> 2) + 1
+                offset = int.from_bytes(data[pos : pos + 4], "little")
+                pos += 4
+            if offset == 0 or offset > len(out):
+                raise ValueError("snappy: invalid copy offset")
+            # overlapping copies are byte-at-a-time semantics
+            start = len(out) - offset
+            for i in range(length):
+                out.append(out[start + i])
+    if len(out) != expected_len:
+        raise ValueError(f"snappy: expected {expected_len} bytes, got {len(out)}")
+    return bytes(out)
+
+
+def frame_decompress(data: bytes) -> bytes:
+    if not data.startswith(_STREAM_IDENTIFIER):
+        raise ValueError("snappy: missing stream identifier")
+    pos = len(_STREAM_IDENTIFIER)
+    out = bytearray()
+    n = len(data)
+    while pos < n:
+        chunk_type = data[pos]
+        length = int.from_bytes(data[pos + 1 : pos + 4], "little")
+        body = data[pos + 4 : pos + 4 + length]
+        pos += 4 + length
+        if chunk_type == 0x00:  # compressed
+            crc = int.from_bytes(body[:4], "little")
+            chunk = block_decompress(body[4:])
+            if _masked_crc(chunk) != crc:
+                raise ValueError("snappy: chunk checksum mismatch")
+            out += chunk
+        elif chunk_type == 0x01:  # uncompressed
+            crc = int.from_bytes(body[:4], "little")
+            chunk = body[4:]
+            if _masked_crc(chunk) != crc:
+                raise ValueError("snappy: chunk checksum mismatch")
+            out += chunk
+        elif chunk_type == 0xFF:  # repeated stream identifier
+            continue
+        elif 0x80 <= chunk_type <= 0xFE:  # padding/skippable
+            continue
+        else:
+            raise ValueError(f"snappy: unknown chunk type {chunk_type:#x}")
+    return bytes(out)

@@ -167,20 +167,25 @@ def _make_runner(fn, *, needs_state: bool, balances_fn, threshold_fn, bls_defaul
         spec = get_spec(phase, preset)
         if bls_active is None:
             bls_active = bls_default == "on"
-        prior = bls_module.bls_active
-        bls_module.bls_active = bls_active
-        try:
-            kwargs = dict(extra)
-            kwargs["spec"] = spec
-            if needs_state:
-                kwargs["state"] = _get_genesis_state(spec, balances_fn, threshold_fn)
-            gen = fn(**kwargs)
-            if generator_mode:
-                # hand the raw generator to the vector machinery
-                return gen
-            _drain(gen)
-        finally:
-            bls_module.bls_active = prior
+        # the test body executes lazily during iteration, so the bls switch
+        # must wrap the CONSUMER's loop, not this call
+        def _generator():
+            prior = bls_module.bls_active
+            bls_module.bls_active = bls_active
+            try:
+                kwargs = dict(extra)
+                kwargs["spec"] = spec
+                if needs_state:
+                    kwargs["state"] = _get_genesis_state(spec, balances_fn, threshold_fn)
+                gen = fn(**kwargs)
+                if gen is not None:
+                    yield from gen
+            finally:
+                bls_module.bls_active = prior
+
+        if generator_mode:
+            return _generator()
+        _drain(_generator())
 
     return runner
 

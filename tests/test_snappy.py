@@ -1,0 +1,52 @@
+"""First-party snappy framing codec (gen/snappy_codec.py)."""
+
+import random
+
+from eth_consensus_specs_tpu.gen.snappy_codec import (
+    block_decompress,
+    crc32c,
+    frame_compress,
+    frame_decompress,
+)
+
+
+def test_crc32c_known_answers():
+    # published CRC-32C vectors
+    assert crc32c(b"") == 0x00000000
+    assert crc32c(b"123456789") == 0xE3069283
+    assert crc32c(b"\x00" * 32) == 0x8A9136AA
+
+
+def test_frame_round_trip():
+    rng = random.Random(1)
+    for size in (0, 1, 100, 65536, 65537, 300_000):
+        data = bytes(rng.randint(0, 255) for _ in range(min(size, 4096))) * (
+            max(1, size // 4096)
+        )
+        data = data[:size]
+        assert frame_decompress(frame_compress(data)) == data
+
+
+def test_block_decompress_literals():
+    # hand-built block: preamble varint 5, literal tag (len 5)
+    block = bytes([5, (5 - 1) << 2]) + b"hello"
+    assert block_decompress(block) == b"hello"
+
+
+def test_block_decompress_copy():
+    # "ababab": literal "ab" then copy offset=2 len=4 (1-byte-offset tag)
+    # tag kind 1: len 4..11 -> (len-4)<<2 | (offset>>8)<<5 | 0b01
+    block = bytes([6, (2 - 1) << 2]) + b"ab" + bytes([0b001, 2])
+    assert block_decompress(block) == b"ababab"
+
+
+def test_block_decompress_long_literal():
+    data = bytes(range(256)) * 2
+    # literal with 2-byte extra length (tag 61<<2); preamble varint = 512
+    block = (
+        bytes([0x80, 0x04])
+        + bytes([61 << 2])
+        + (len(data) - 1).to_bytes(2, "little")
+        + data
+    )
+    assert block_decompress(block) == data
