@@ -1,0 +1,40 @@
+# Developer entry points (reference analogue: Makefile:191-359)
+
+PYTHON ?= python
+
+.PHONY: help install test test-fast lint reftests bench multichip clean
+
+help:
+	@echo "install    - editable install with test extras"
+	@echo "test       - full pytest suite (CPU, 8 virtual devices)"
+	@echo "test-fast  - suite minus the slow fork-choice scenarios"
+	@echo "lint       - ruff check (if installed)"
+	@echo "reftests   - emit test vectors to ./test_vectors"
+	@echo "bench      - run the driver benchmark"
+	@echo "multichip  - 8-virtual-device sharding dry run"
+	@echo "clean      - remove caches and generated vectors"
+
+install:
+	$(PYTHON) -m pip install -e .[test]
+
+test:
+	$(PYTHON) -m pytest tests/ -q
+
+test-fast:
+	$(PYTHON) -m pytest tests/ -q --ignore=tests/phase0/test_fork_choice.py
+
+lint:
+	-$(PYTHON) -m ruff check eth_consensus_specs_tpu/ tests/
+
+reftests:
+	$(PYTHON) -m eth_consensus_specs_tpu.gen -o test_vectors -v
+
+bench:
+	$(PYTHON) bench.py
+
+multichip:
+	$(PYTHON) -c "import __graft_entry__ as g; g.dryrun_multichip(8); print('ok')"
+
+clean:
+	rm -rf .pytest_cache .jax_cache test_vectors
+	find . -name __pycache__ -type d -exec rm -rf {} +

@@ -43,28 +43,66 @@ RANDOM_CHALLENGE_KZG_BATCH_DOMAIN = b"RCKZGBATCH___V1_"
 
 
 class TrustedSetup:
-    """Decompressed setup points, loaded once per process."""
+    """Decompressed setup points, loaded once per process.
 
-    def __init__(self, path: str):
+    `verify_subgroups` must be True for external files: an on-curve point
+    outside the r-torsion silently breaks every pairing-based check. The
+    waiver is safe only for the self-generated setup (we computed those
+    points as multiples of the generator ourselves)."""
+
+    def __init__(self, path: str, verify_subgroups: bool = True):
         with open(path) as f:
             raw = json.load(f)
+        check = verify_subgroups
         self.g1_monomial = [
-            g1_from_bytes(bytes.fromhex(h[2:]), subgroup_check=False)
+            g1_from_bytes(bytes.fromhex(h[2:]), subgroup_check=check)
             for h in raw["g1_monomial"]
         ]
         self.g1_lagrange = [
-            g1_from_bytes(bytes.fromhex(h[2:]), subgroup_check=False)
+            g1_from_bytes(bytes.fromhex(h[2:]), subgroup_check=check)
             for h in raw["g1_lagrange"]
         ]
         self.g2_monomial = [
-            g2_from_bytes(bytes.fromhex(h[2:]), subgroup_check=False)
+            g2_from_bytes(bytes.fromhex(h[2:]), subgroup_check=check)
             for h in raw["g2_monomial"]
         ]
 
 
+_UNSET = object()
+_setup_override: list = [_UNSET]
+# loaded setups keyed by (path, verify): subgroup-checking a ceremony file
+# costs ~45s pure-Python, so switching between setups must not re-verify
+_loaded_setups: dict = {}
+
+
+def _load_setup(path: str, verify_subgroups: bool) -> "TrustedSetup":
+    key = (path, verify_subgroups)
+    if key not in _loaded_setups:
+        _loaded_setups[key] = TrustedSetup(path, verify_subgroups=verify_subgroups)
+    return _loaded_setups[key]
+
+
+def set_trusted_setup(path: str | None) -> None:
+    """Point KZG at an external trusted-setup JSON (the ceremony testing
+    setup format: g1_monomial / g1_lagrange / g2_monomial hex arrays —
+    e.g. the reference's presets/*/trusted_setups/trusted_setup_4096.json)
+    so official deneb KZG vectors can validate this implementation
+    end-to-end. None forces the self-generated insecure testing setup,
+    overriding even the ETH_CONSENSUS_TRUSTED_SETUP env var."""
+    _setup_override[0] = path
+    get_setup.cache_clear()
+
+
 @lru_cache(maxsize=1)
 def get_setup() -> TrustedSetup:
-    return TrustedSetup(setup_path(FIELD_ELEMENTS_PER_BLOB))
+    import os
+
+    override = _setup_override[0]
+    if override is _UNSET:
+        override = os.environ.get("ETH_CONSENSUS_TRUSTED_SETUP")
+    if override:
+        return _load_setup(override, verify_subgroups=True)
+    return _load_setup(setup_path(FIELD_ELEMENTS_PER_BLOB), verify_subgroups=False)
 
 
 # == bit-reversal permutation (spec :119-151) ===============================

@@ -434,11 +434,26 @@ class AltairSpec(Phase0Spec):
         one G1 subtraction instead of up to SYNC_COMMITTEE_SIZE additions."""
         committee_pubkeys = state.current_sync_committee.pubkeys
         committee_bits = list(sync_aggregate.sync_committee_bits)
-        participating = sum(1 for b in committee_bits if b)
-        if bls.bls_active:  # aggregation + verify elided entirely in stub mode
+        # participant collection + signing-root derivation always execute
+        # (reference structure: only the signature check sits behind the
+        # bls switch); the EC work lives inside the gated verify below
+        participant_pubkeys = [
+            pk for pk, bit in zip(committee_pubkeys, committee_bits) if bit
+        ]
+        previous_slot = max(int(state.slot), 1) - 1
+        domain = self.get_domain(
+            state, self.DOMAIN_SYNC_COMMITTEE, self.compute_epoch_at_slot(previous_slot)
+        )
+        signing_root = self.compute_signing_root(
+            Root(self.get_block_root_at_slot(state, previous_slot)), domain
+        )
+        if bls.bls_active:
+            participating = len(participant_pubkeys)
             if participating == self.SYNC_COMMITTEE_SIZE:
-                participant_pubkeys = [state.current_sync_committee.aggregate_pubkey]
+                verify_keys = [state.current_sync_committee.aggregate_pubkey]
             elif participating > self.SYNC_COMMITTEE_SIZE // 2:
+                # majority fast path: one G1 subtraction instead of up to
+                # SYNC_COMMITTEE_SIZE additions
                 non_participant_pubkeys = [
                     pk for pk, bit in zip(committee_pubkeys, committee_bits) if not bit
                 ]
@@ -447,20 +462,11 @@ class AltairSpec(Phase0Spec):
                     bls.pubkey_to_G1(state.current_sync_committee.aggregate_pubkey),
                     bls.neg(bls.pubkey_to_G1(non_participant_aggregate)),
                 )
-                participant_pubkeys = [BLSPubkey(bls.G1_to_pubkey(participant_point))]
+                verify_keys = [BLSPubkey(bls.G1_to_pubkey(participant_point))]
             else:
-                participant_pubkeys = [
-                    pk for pk, bit in zip(committee_pubkeys, committee_bits) if bit
-                ]
-            previous_slot = max(int(state.slot), 1) - 1
-            domain = self.get_domain(
-                state, self.DOMAIN_SYNC_COMMITTEE, self.compute_epoch_at_slot(previous_slot)
-            )
-            signing_root = self.compute_signing_root(
-                Root(self.get_block_root_at_slot(state, previous_slot)), domain
-            )
+                verify_keys = participant_pubkeys
             assert self.eth_fast_aggregate_verify(
-                participant_pubkeys, signing_root, sync_aggregate.sync_committee_signature
+                verify_keys, signing_root, sync_aggregate.sync_committee_signature
             ), "invalid sync committee signature"
 
         total_active_increments = (

@@ -1,0 +1,75 @@
+"""KZG against the ceremony testing trusted setup (when available).
+
+The framework defaults to a self-generated insecure setup; this suite
+re-runs the commit/prove/verify cycle under the official-format ceremony
+testing setup file so commitments/proofs are cross-checkable with
+published deneb KZG vectors (ADVICE r1; reference:
+presets/mainnet/trusted_setups/trusted_setup_4096.json)."""
+
+import os
+
+import pytest
+
+from eth_consensus_specs_tpu.crypto import kzg
+
+CEREMONY_SETUP = "/root/reference/presets/mainnet/trusted_setups/trusted_setup_4096.json"
+
+pytestmark = pytest.mark.skipif(
+    not os.path.exists(CEREMONY_SETUP), reason="ceremony setup file not present"
+)
+
+
+@pytest.fixture(autouse=True)
+def _ceremony_setup():
+    kzg.set_trusted_setup(CEREMONY_SETUP)
+    yield
+    kzg.set_trusted_setup(None)
+
+
+def _blob(seed: int) -> bytes:
+    # valid field elements: keep each 32-byte chunk < BLS_MODULUS
+    out = bytearray()
+    for i in range(kzg.FIELD_ELEMENTS_PER_BLOB):
+        v = (seed * 2_654_435_761 + i) % kzg.BLS_MODULUS
+        out += v.to_bytes(32, kzg.KZG_ENDIANNESS)
+    return bytes(out)
+
+
+def test_known_commitment_for_zero_blob():
+    """The zero polynomial commits to the point at infinity under ANY
+    setup — a setup-independent known answer proving the ceremony file
+    parsed into usable points."""
+    commitment = kzg.blob_to_kzg_commitment(b"\x00" * kzg.BYTES_PER_BLOB)
+    assert commitment == kzg.G1_POINT_AT_INFINITY
+
+
+def test_commit_prove_verify_under_ceremony_setup():
+    blob = _blob(7)
+    commitment = kzg.blob_to_kzg_commitment(blob)
+    proof = kzg.compute_blob_kzg_proof(blob, commitment)
+    assert kzg.verify_blob_kzg_proof(blob, commitment, proof)
+    # a different blob under the same commitment/proof must fail
+    assert not kzg.verify_blob_kzg_proof(_blob(8), commitment, proof)
+    # tampered commitment (a different valid commitment) must fail
+    other_commitment = kzg.blob_to_kzg_commitment(_blob(8))
+    assert not kzg.verify_blob_kzg_proof(blob, other_commitment, proof)
+
+
+def test_point_eval_under_ceremony_setup():
+    blob = _blob(3)
+    commitment = kzg.blob_to_kzg_commitment(blob)
+    z = (123456789).to_bytes(32, kzg.KZG_ENDIANNESS)
+    proof, y = kzg.compute_kzg_proof(blob, z)
+    assert kzg.verify_kzg_proof(commitment, z, y, proof)
+    wrong_y = ((int.from_bytes(y, "big") + 1) % kzg.BLS_MODULUS).to_bytes(32, "big")
+    assert not kzg.verify_kzg_proof(commitment, z, wrong_y, proof)
+
+
+def test_setup_differs_from_insecure_default():
+    """Ceremony and insecure setups must produce different commitments for
+    the same nonzero blob (otherwise the override is not taking effect)."""
+    blob = _blob(1)
+    under_ceremony = kzg.blob_to_kzg_commitment(blob)
+    kzg.set_trusted_setup(None)
+    under_insecure = kzg.blob_to_kzg_commitment(blob)
+    assert under_ceremony != under_insecure
