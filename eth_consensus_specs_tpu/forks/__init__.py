@@ -62,6 +62,30 @@ def get_spec(fork: str = "phase0", preset_name: str = "mainnet", config_name: st
     return cls(preset, config, preset_name=preset_name)
 
 
+@lru_cache(maxsize=None)
+def _get_spec_overridden(fork: str, preset_name: str, config_name: str | None, items: tuple):
+    cls = _spec_class(fork)
+    preset = load_preset(preset_name, fork)
+    config = load_config(config_name if config_name is not None else preset_name)
+    return cls(preset, config.replace(**dict(items)), preset_name=preset_name)
+
+
+def get_spec_with_overrides(
+    fork: str,
+    preset_name: str = "mainnet",
+    config_name: str | None = None,
+    config_overrides: dict | None = None,
+):
+    """Spec instance with runtime-config overrides (the reference analogue:
+    with_config_overrides rebuilding the Configuration NamedTuple,
+    context.py:714-783). Cached per override set."""
+    if not config_overrides:
+        return get_spec(fork, preset_name, config_name)
+    return _get_spec_overridden(
+        fork, preset_name, config_name, tuple(sorted(config_overrides.items()))
+    )
+
+
 def available_forks() -> list[str]:
     out = []
     for f in FORK_ORDER:

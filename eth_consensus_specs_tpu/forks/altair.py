@@ -49,7 +49,10 @@ from .phase0 import (
 ParticipationFlags = uint8
 
 
-class AltairSpec(Phase0Spec):
+from .light_client import LightClientMixin
+
+
+class AltairSpec(LightClientMixin, Phase0Spec):
     fork_name = "altair"
 
     # -- participation flag indices (beacon-chain.md constants) ------------

@@ -47,6 +47,9 @@ class CapellaSpec(BellatrixSpec):
     fork_name = "capella"
 
     DOMAIN_BLS_TO_EXECUTION_CHANGE = DomainType(b"\x0a\x00\x00\x00")
+    # light-client headers carry the execution header + proof from capella on
+    # (specs/capella/light-client/sync-protocol.md:51-57)
+    _light_client_has_execution = True
 
     # == type system ======================================================
 

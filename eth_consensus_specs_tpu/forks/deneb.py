@@ -269,6 +269,37 @@ class DenebSpec(CapellaSpec):
             [bytes(p) for p in proofs],
         )
 
+    # == light client (specs/deneb/light-client/sync-protocol.md) ==========
+
+    def get_lc_execution_root(self, header):
+        """[Modified in Deneb] capella-era headers must hash the CAPELLA
+        header shape (15 fields, depth-4 tree) — re-serializing the stored
+        deneb-typed execution into the era's container so the leaf matches
+        the execution_branch rooted in the era's body_root."""
+        epoch = self.compute_epoch_at_slot(header.beacon.slot)
+        if epoch >= self.config.DENEB_FORK_EPOCH:
+            return hash_tree_root(header.execution)
+        if epoch >= self.config.CAPELLA_FORK_EPOCH:
+            from eth_consensus_specs_tpu.forks import get_spec
+
+            capella_type = get_spec("capella", self.preset_name).ExecutionPayloadHeader
+            execution_header = capella_type(
+                **{
+                    name: getattr(header.execution, name)
+                    for name in capella_type.fields()
+                }
+            )
+            return hash_tree_root(execution_header)
+        return Bytes32()
+
+    def is_valid_light_client_header(self, header) -> bool:
+        epoch = self.compute_epoch_at_slot(header.beacon.slot)
+        if epoch < self.config.DENEB_FORK_EPOCH:
+            # [New in Deneb:EIP4844] blob gas fields must be unset pre-fork
+            if header.execution.blob_gas_used != 0 or header.execution.excess_blob_gas != 0:
+                return False
+        return super().is_valid_light_client_header(header)
+
     # == misc ==============================================================
 
     def kzg_commitment_to_versioned_hash(self, kzg_commitment) -> bytes:

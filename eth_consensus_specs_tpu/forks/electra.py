@@ -90,6 +90,12 @@ class ElectraExecutionEngine(DenebExecutionEngine):
 class ElectraSpec(DenebSpec):
     fork_name = "electra"
 
+    # Light client: the electra BeaconState grows past 32 fields, deepening
+    # every state-rooted gindex (specs/electra/light-client/sync-protocol.md:56-58)
+    FINALIZED_ROOT_GINDEX_ELECTRA = 169
+    CURRENT_SYNC_COMMITTEE_GINDEX_ELECTRA = 86
+    NEXT_SYNC_COMMITTEE_GINDEX_ELECTRA = 87
+
     # Constants (specs/electra/beacon-chain.md:125-149)
     UNSET_DEPOSIT_REQUESTS_START_INDEX = 2**64 - 1
     FULL_EXIT_REQUEST_AMOUNT = 0
@@ -1182,6 +1188,101 @@ class ElectraSpec(DenebSpec):
             data=data,
             committee_bits=committee_bits,
             signature=signature,
+        )
+
+    # == light client (specs/electra/light-client/sync-protocol.md) ========
+
+    def _lc_max_gindices(self) -> tuple:
+        return (
+            self.FINALIZED_ROOT_GINDEX_ELECTRA,
+            self.CURRENT_SYNC_COMMITTEE_GINDEX_ELECTRA,
+            self.NEXT_SYNC_COMMITTEE_GINDEX_ELECTRA,
+        )
+
+    def finalized_root_gindex_at_slot(self, slot: int) -> int:
+        epoch = self.compute_epoch_at_slot(slot)
+        if epoch >= self.config.ELECTRA_FORK_EPOCH:  # [Modified in Electra]
+            return self.FINALIZED_ROOT_GINDEX_ELECTRA
+        return self.FINALIZED_ROOT_GINDEX
+
+    def current_sync_committee_gindex_at_slot(self, slot: int) -> int:
+        epoch = self.compute_epoch_at_slot(slot)
+        if epoch >= self.config.ELECTRA_FORK_EPOCH:  # [Modified in Electra]
+            return self.CURRENT_SYNC_COMMITTEE_GINDEX_ELECTRA
+        return self.CURRENT_SYNC_COMMITTEE_GINDEX
+
+    def next_sync_committee_gindex_at_slot(self, slot: int) -> int:
+        epoch = self.compute_epoch_at_slot(slot)
+        if epoch >= self.config.ELECTRA_FORK_EPOCH:  # [Modified in Electra]
+            return self.NEXT_SYNC_COMMITTEE_GINDEX_ELECTRA
+        return self.NEXT_SYNC_COMMITTEE_GINDEX
+
+    # light-client object upgrades (specs/electra/light-client/fork.md:41-119):
+    # pre-electra branches zero-extend to the deeper electra gindices
+
+    def upgrade_lc_header_to_electra(self, pre):
+        return self.LightClientHeader(
+            beacon=pre.beacon,
+            execution=pre.execution,
+            execution_branch=pre.execution_branch,
+        )
+
+    def upgrade_lc_bootstrap_to_electra(self, pre):
+        return self.LightClientBootstrap(
+            header=self.upgrade_lc_header_to_electra(pre.header),
+            current_sync_committee=pre.current_sync_committee,
+            current_sync_committee_branch=self.normalize_merkle_branch(
+                pre.current_sync_committee_branch,
+                self.CURRENT_SYNC_COMMITTEE_GINDEX_ELECTRA,
+            ),
+        )
+
+    def upgrade_lc_update_to_electra(self, pre):
+        return self.LightClientUpdate(
+            attested_header=self.upgrade_lc_header_to_electra(pre.attested_header),
+            next_sync_committee=pre.next_sync_committee,
+            next_sync_committee_branch=self.normalize_merkle_branch(
+                pre.next_sync_committee_branch, self.NEXT_SYNC_COMMITTEE_GINDEX_ELECTRA
+            ),
+            finalized_header=self.upgrade_lc_header_to_electra(pre.finalized_header),
+            finality_branch=self.normalize_merkle_branch(
+                pre.finality_branch, self.FINALIZED_ROOT_GINDEX_ELECTRA
+            ),
+            sync_aggregate=pre.sync_aggregate,
+            signature_slot=pre.signature_slot,
+        )
+
+    def upgrade_lc_finality_update_to_electra(self, pre):
+        return self.LightClientFinalityUpdate(
+            attested_header=self.upgrade_lc_header_to_electra(pre.attested_header),
+            finalized_header=self.upgrade_lc_header_to_electra(pre.finalized_header),
+            finality_branch=self.normalize_merkle_branch(
+                pre.finality_branch, self.FINALIZED_ROOT_GINDEX_ELECTRA
+            ),
+            sync_aggregate=pre.sync_aggregate,
+            signature_slot=pre.signature_slot,
+        )
+
+    def upgrade_lc_optimistic_update_to_electra(self, pre):
+        return self.LightClientOptimisticUpdate(
+            attested_header=self.upgrade_lc_header_to_electra(pre.attested_header),
+            sync_aggregate=pre.sync_aggregate,
+            signature_slot=pre.signature_slot,
+        )
+
+    def upgrade_lc_store_to_electra(self, pre):
+        if pre.best_valid_update is None:
+            best_valid_update = None
+        else:
+            best_valid_update = self.upgrade_lc_update_to_electra(pre.best_valid_update)
+        return self.LightClientStore(
+            finalized_header=self.upgrade_lc_header_to_electra(pre.finalized_header),
+            current_sync_committee=pre.current_sync_committee,
+            next_sync_committee=pre.next_sync_committee,
+            best_valid_update=best_valid_update,
+            optimistic_header=self.upgrade_lc_header_to_electra(pre.optimistic_header),
+            previous_max_active_participants=pre.previous_max_active_participants,
+            current_max_active_participants=pre.current_max_active_participants,
         )
 
     # == fork upgrade (specs/electra/fork.md:42-144) =======================

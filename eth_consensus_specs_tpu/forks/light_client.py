@@ -1,0 +1,624 @@
+"""Altair light-client sync protocol.
+
+Behavioral parity targets (reference, by section):
+  * sync protocol:  specs/altair/light-client/sync-protocol.md
+      - containers :87-171, validation :372-456, application :458-548,
+        force update :480-499, finality/optimistic wrappers :550-595
+  * full node:      specs/altair/light-client/full-node.md
+      - bootstrap :62-78, update :109-168, derived updates :189-220
+
+The hardcoded gindices (105 / 54 / 55) are the altair+ BeaconState
+positions of finalized_checkpoint.root and the two sync committees
+(reference inlines the same constants, pysetup/spec_builders/altair.py:
+40-45); proofs are produced by the generic gindex walker in
+ssz/merkle.py:compute_merkle_proof, so full-node and light-client sides
+are two independent code paths meeting at the branch bytes.
+
+Mixed into AltairSpec — every later fork inherits the protocol surface.
+"""
+
+from dataclasses import dataclass
+from typing import Optional
+
+from eth_consensus_specs_tpu.ssz import Bytes32, Container, Vector, hash_tree_root
+from eth_consensus_specs_tpu.ssz.merkle import compute_merkle_proof
+from eth_consensus_specs_tpu.utils import bls
+
+from .phase0 import Slot
+
+
+def floorlog2(x: int) -> int:
+    assert x > 0
+    return int(x).bit_length() - 1
+
+
+class LightClientMixin:
+    # Constants (sync-protocol.md:68-74). MIN_SYNC_COMMITTEE_PARTICIPANTS
+    # and UPDATE_TIMEOUT arrive from the altair preset files.
+    FINALIZED_ROOT_GINDEX = 105
+    CURRENT_SYNC_COMMITTEE_GINDEX = 54
+    NEXT_SYNC_COMMITTEE_GINDEX = 55
+    # capella+ (specs/capella/light-client/sync-protocol.md:44)
+    EXECUTION_PAYLOAD_GINDEX = 25
+    # capella adds execution data to the header (fork classes flip this)
+    _light_client_has_execution = False
+
+    def __init__(self, *args, **kwargs):
+        # LC containers reference the FINAL fork types (ExecutionPayloadHeader
+        # changes per fork), so they build after the whole _build_types chain
+        super().__init__(*args, **kwargs)
+        self._build_light_client_types()
+
+    def _lc_max_gindices(self) -> tuple:
+        """(finalized_root, current_sc, next_sc) gindices sizing the branch
+        vectors — electra's deeper state overrides these."""
+        return (
+            self.FINALIZED_ROOT_GINDEX,
+            self.CURRENT_SYNC_COMMITTEE_GINDEX,
+            self.NEXT_SYNC_COMMITTEE_GINDEX,
+        )
+
+    # == type system =======================================================
+
+    def _build_light_client_types(self) -> None:
+        P = self
+        fin_g, cur_g, next_g = self._lc_max_gindices()
+        FinalityBranch = Vector[Bytes32, floorlog2(fin_g)]
+        CurrentSyncCommitteeBranch = Vector[Bytes32, floorlog2(cur_g)]
+        NextSyncCommitteeBranch = Vector[Bytes32, floorlog2(next_g)]
+        ExecutionBranch = Vector[Bytes32, floorlog2(self.EXECUTION_PAYLOAD_GINDEX)]
+        self.FinalityBranch = FinalityBranch
+        self.CurrentSyncCommitteeBranch = CurrentSyncCommitteeBranch
+        self.NextSyncCommitteeBranch = NextSyncCommitteeBranch
+        self.ExecutionBranch = ExecutionBranch
+
+        if self._light_client_has_execution:
+
+            class LightClientHeader(Container):
+                beacon: P.BeaconBlockHeader
+                execution: P.ExecutionPayloadHeader  # [New in Capella]
+                execution_branch: ExecutionBranch  # [New in Capella]
+
+        else:
+
+            class LightClientHeader(Container):
+                beacon: P.BeaconBlockHeader
+
+        class LightClientBootstrap(Container):
+            header: LightClientHeader
+            current_sync_committee: P.SyncCommittee
+            current_sync_committee_branch: CurrentSyncCommitteeBranch
+
+        class LightClientUpdate(Container):
+            attested_header: LightClientHeader
+            next_sync_committee: P.SyncCommittee
+            next_sync_committee_branch: NextSyncCommitteeBranch
+            finalized_header: LightClientHeader
+            finality_branch: FinalityBranch
+            sync_aggregate: P.SyncAggregate
+            signature_slot: Slot
+
+        class LightClientFinalityUpdate(Container):
+            attested_header: LightClientHeader
+            finalized_header: LightClientHeader
+            finality_branch: FinalityBranch
+            sync_aggregate: P.SyncAggregate
+            signature_slot: Slot
+
+        class LightClientOptimisticUpdate(Container):
+            attested_header: LightClientHeader
+            sync_aggregate: P.SyncAggregate
+            signature_slot: Slot
+
+        for name, typ in list(locals().items()):
+            if isinstance(typ, type) and issubclass(typ, Container):
+                typ.__name__ = name
+                setattr(self, name, typ)
+
+    @dataclass
+    class LightClientStore:
+        finalized_header: object
+        current_sync_committee: object
+        next_sync_committee: object
+        best_valid_update: Optional[object]
+        optimistic_header: object
+        previous_max_active_participants: int
+        current_max_active_participants: int
+
+    # == helpers (sync-protocol.md:173-320) ================================
+
+    def compute_sync_committee_period(self, epoch: int) -> int:
+        return int(epoch) // self.EPOCHS_PER_SYNC_COMMITTEE_PERIOD
+
+    def compute_sync_committee_period_at_slot(self, slot: int) -> int:
+        return self.compute_sync_committee_period(self.compute_epoch_at_slot(slot))
+
+    def compute_fork_version(self, epoch: int):
+        """Fork version active at `epoch` per the config's fork schedule."""
+        from eth_consensus_specs_tpu.config import FORK_ORDER
+
+        version = self.config.GENESIS_FORK_VERSION
+        for fork in FORK_ORDER[1:]:
+            fork_epoch = getattr(self.config, f"{fork.upper()}_FORK_EPOCH", None)
+            if fork_epoch is None:
+                break
+            if epoch >= fork_epoch:
+                version = getattr(self.config, f"{fork.upper()}_FORK_VERSION")
+        return version
+
+    def finalized_root_gindex_at_slot(self, _slot: int) -> int:
+        return self.FINALIZED_ROOT_GINDEX
+
+    def current_sync_committee_gindex_at_slot(self, _slot: int) -> int:
+        return self.CURRENT_SYNC_COMMITTEE_GINDEX
+
+    def next_sync_committee_gindex_at_slot(self, _slot: int) -> int:
+        return self.NEXT_SYNC_COMMITTEE_GINDEX
+
+    @staticmethod
+    def normalize_merkle_branch(branch, gindex: int) -> list:
+        """Zero-extend a branch to the depth of `gindex` (electra LC spec
+        normalize_merkle_branch; consumed by the electra upgrade_lc_*
+        helpers when pre-electra objects re-home to the deeper state)."""
+        depth = floorlog2(gindex)
+        num_extra = depth - len(branch)
+        return [Bytes32()] * num_extra + [bytes(b) for b in branch]
+
+    def get_lc_execution_root(self, header):
+        """capella+ (specs/capella/light-client/sync-protocol.md:129-135)."""
+        epoch = self.compute_epoch_at_slot(header.beacon.slot)
+        if epoch >= self.config.CAPELLA_FORK_EPOCH:
+            return hash_tree_root(header.execution)
+        return Bytes32()
+
+    def is_valid_light_client_header(self, header) -> bool:
+        if not self._light_client_has_execution:
+            return True  # altair/bellatrix: nothing beyond the beacon header
+        # capella+ (specs/capella/light-client/sync-protocol.md:141-156)
+        epoch = self.compute_epoch_at_slot(header.beacon.slot)
+        if epoch < self.config.CAPELLA_FORK_EPOCH:
+            return (
+                header.execution == self.ExecutionPayloadHeader()
+                and header.execution_branch == self.ExecutionBranch()
+            )
+        return self.is_valid_merkle_branch(
+            leaf=self.get_lc_execution_root(header),
+            branch=header.execution_branch,
+            depth=floorlog2(self.EXECUTION_PAYLOAD_GINDEX),
+            index=self.get_subtree_index(self.EXECUTION_PAYLOAD_GINDEX),
+            root=header.beacon.body_root,
+        )
+
+    def is_sync_committee_update(self, update) -> bool:
+        return update.next_sync_committee_branch != self.NextSyncCommitteeBranch()
+
+    def is_finality_update(self, update) -> bool:
+        return update.finality_branch != self.FinalityBranch()
+
+    def is_better_update(self, new_update, old_update) -> bool:
+        """Update preference order (sync-protocol.md:217-271)."""
+        max_active_participants = len(new_update.sync_aggregate.sync_committee_bits)
+        new_num_active = sum(map(bool, new_update.sync_aggregate.sync_committee_bits))
+        old_num_active = sum(map(bool, old_update.sync_aggregate.sync_committee_bits))
+        new_has_supermajority = new_num_active * 3 >= max_active_participants * 2
+        old_has_supermajority = old_num_active * 3 >= max_active_participants * 2
+        if new_has_supermajority != old_has_supermajority:
+            return new_has_supermajority
+        if not new_has_supermajority and new_num_active != old_num_active:
+            return new_num_active > old_num_active
+
+        new_has_relevant_sync_committee = self.is_sync_committee_update(new_update) and (
+            self.compute_sync_committee_period_at_slot(new_update.attested_header.beacon.slot)
+            == self.compute_sync_committee_period_at_slot(new_update.signature_slot)
+        )
+        old_has_relevant_sync_committee = self.is_sync_committee_update(old_update) and (
+            self.compute_sync_committee_period_at_slot(old_update.attested_header.beacon.slot)
+            == self.compute_sync_committee_period_at_slot(old_update.signature_slot)
+        )
+        if new_has_relevant_sync_committee != old_has_relevant_sync_committee:
+            return new_has_relevant_sync_committee
+
+        new_has_finality = self.is_finality_update(new_update)
+        old_has_finality = self.is_finality_update(old_update)
+        if new_has_finality != old_has_finality:
+            return new_has_finality
+
+        if new_has_finality:
+            new_sc_finality = self.compute_sync_committee_period_at_slot(
+                new_update.finalized_header.beacon.slot
+            ) == self.compute_sync_committee_period_at_slot(
+                new_update.attested_header.beacon.slot
+            )
+            old_sc_finality = self.compute_sync_committee_period_at_slot(
+                old_update.finalized_header.beacon.slot
+            ) == self.compute_sync_committee_period_at_slot(
+                old_update.attested_header.beacon.slot
+            )
+            if new_sc_finality != old_sc_finality:
+                return new_sc_finality
+
+        if new_num_active != old_num_active:
+            return new_num_active > old_num_active
+        if new_update.attested_header.beacon.slot != old_update.attested_header.beacon.slot:
+            return (
+                new_update.attested_header.beacon.slot
+                < old_update.attested_header.beacon.slot
+            )
+        return new_update.signature_slot < old_update.signature_slot
+
+    def is_next_sync_committee_known(self, store) -> bool:
+        return store.next_sync_committee != self.SyncCommittee()
+
+    def get_safety_threshold(self, store) -> int:
+        return (
+            max(
+                store.previous_max_active_participants,
+                store.current_max_active_participants,
+            )
+            // 2
+        )
+
+    @staticmethod
+    def get_subtree_index(generalized_index: int) -> int:
+        return generalized_index % 2 ** floorlog2(generalized_index)
+
+    def is_valid_normalized_merkle_branch(self, leaf, branch, gindex: int, root) -> bool:
+        depth = floorlog2(gindex)
+        index = self.get_subtree_index(gindex)
+        num_extra = len(branch) - depth
+        for i in range(num_extra):
+            if bytes(branch[i]) != bytes(Bytes32()):
+                return False
+        return self.is_valid_merkle_branch(leaf, branch[num_extra:], depth, index, root)
+
+    # == initialization (sync-protocol.md:329-354) =========================
+
+    def initialize_light_client_store(self, trusted_block_root, bootstrap):
+        assert self.is_valid_light_client_header(bootstrap.header)
+        assert hash_tree_root(bootstrap.header.beacon) == trusted_block_root
+
+        assert self.is_valid_normalized_merkle_branch(
+            leaf=hash_tree_root(bootstrap.current_sync_committee),
+            branch=bootstrap.current_sync_committee_branch,
+            gindex=self.current_sync_committee_gindex_at_slot(bootstrap.header.beacon.slot),
+            root=bootstrap.header.beacon.state_root,
+        ), "invalid current sync committee branch"
+
+        return self.LightClientStore(
+            finalized_header=bootstrap.header,
+            current_sync_committee=bootstrap.current_sync_committee,
+            next_sync_committee=self.SyncCommittee(),
+            best_valid_update=None,
+            optimistic_header=bootstrap.header,
+            previous_max_active_participants=0,
+            current_max_active_participants=0,
+        )
+
+    # == update validation / application (sync-protocol.md:372-548) ========
+
+    def validate_light_client_update(
+        self, store, update, current_slot: int, genesis_validators_root
+    ) -> None:
+        sync_aggregate = update.sync_aggregate
+        num_active = sum(map(bool, sync_aggregate.sync_committee_bits))
+        assert num_active >= self.MIN_SYNC_COMMITTEE_PARTICIPANTS, "too few participants"
+
+        assert self.is_valid_light_client_header(update.attested_header)
+        update_attested_slot = int(update.attested_header.beacon.slot)
+        update_finalized_slot = int(update.finalized_header.beacon.slot)
+        assert (
+            current_slot >= int(update.signature_slot) > update_attested_slot >= update_finalized_slot
+        ), "slots out of order"
+        store_period = self.compute_sync_committee_period_at_slot(
+            store.finalized_header.beacon.slot
+        )
+        update_signature_period = self.compute_sync_committee_period_at_slot(
+            update.signature_slot
+        )
+        if self.is_next_sync_committee_known(store):
+            assert update_signature_period in (
+                store_period,
+                store_period + 1,
+            ), "update skips a sync committee period"
+        else:
+            assert update_signature_period == store_period, "next committee unknown"
+
+        update_attested_period = self.compute_sync_committee_period_at_slot(
+            update_attested_slot
+        )
+        update_has_next_sync_committee = not self.is_next_sync_committee_known(store) and (
+            self.is_sync_committee_update(update) and update_attested_period == store_period
+        )
+        assert (
+            update_attested_slot > int(store.finalized_header.beacon.slot)
+            or update_has_next_sync_committee
+        ), "update not relevant"
+
+        if not self.is_finality_update(update):
+            assert update.finalized_header == self.LightClientHeader()
+        else:
+            if update_finalized_slot == self.GENESIS_SLOT:
+                assert update.finalized_header == self.LightClientHeader()
+                finalized_root = Bytes32()
+            else:
+                assert self.is_valid_light_client_header(update.finalized_header)
+                finalized_root = hash_tree_root(update.finalized_header.beacon)
+            assert self.is_valid_normalized_merkle_branch(
+                leaf=finalized_root,
+                branch=update.finality_branch,
+                gindex=self.finalized_root_gindex_at_slot(update_attested_slot),
+                root=update.attested_header.beacon.state_root,
+            ), "invalid finality branch"
+
+        if not self.is_sync_committee_update(update):
+            assert update.next_sync_committee == self.SyncCommittee()
+        else:
+            if update_attested_period == store_period and self.is_next_sync_committee_known(
+                store
+            ):
+                assert update.next_sync_committee == store.next_sync_committee
+            assert self.is_valid_normalized_merkle_branch(
+                leaf=hash_tree_root(update.next_sync_committee),
+                branch=update.next_sync_committee_branch,
+                gindex=self.next_sync_committee_gindex_at_slot(update_attested_slot),
+                root=update.attested_header.beacon.state_root,
+            ), "invalid next sync committee branch"
+
+        if update_signature_period == store_period:
+            sync_committee = store.current_sync_committee
+        else:
+            sync_committee = store.next_sync_committee
+        participant_pubkeys = [
+            pubkey
+            for (bit, pubkey) in zip(
+                sync_aggregate.sync_committee_bits, sync_committee.pubkeys
+            )
+            if bit
+        ]
+        fork_version_slot = max(int(update.signature_slot), 1) - 1
+        fork_version = self.compute_fork_version(
+            self.compute_epoch_at_slot(fork_version_slot)
+        )
+        domain = self.compute_domain(
+            self.DOMAIN_SYNC_COMMITTEE, fork_version, genesis_validators_root
+        )
+        signing_root = self.compute_signing_root(update.attested_header.beacon, domain)
+        assert bls.FastAggregateVerify(
+            participant_pubkeys, signing_root, sync_aggregate.sync_committee_signature
+        ), "invalid sync aggregate signature"
+
+    def apply_light_client_update(self, store, update) -> None:
+        store_period = self.compute_sync_committee_period_at_slot(
+            store.finalized_header.beacon.slot
+        )
+        update_finalized_period = self.compute_sync_committee_period_at_slot(
+            update.finalized_header.beacon.slot
+        )
+        if not self.is_next_sync_committee_known(store):
+            assert update_finalized_period == store_period
+            store.next_sync_committee = update.next_sync_committee
+        elif update_finalized_period == store_period + 1:
+            store.current_sync_committee = store.next_sync_committee
+            store.next_sync_committee = update.next_sync_committee
+            store.previous_max_active_participants = store.current_max_active_participants
+            store.current_max_active_participants = 0
+        if int(update.finalized_header.beacon.slot) > int(store.finalized_header.beacon.slot):
+            store.finalized_header = update.finalized_header
+            if int(store.finalized_header.beacon.slot) > int(
+                store.optimistic_header.beacon.slot
+            ):
+                store.optimistic_header = store.finalized_header
+
+    def process_light_client_store_force_update(self, store, current_slot: int) -> None:
+        if (
+            current_slot > int(store.finalized_header.beacon.slot) + self.UPDATE_TIMEOUT
+            and store.best_valid_update is not None
+        ):
+            # during long non-finality the attested header stands in for the
+            # finalized one so period progression cannot stall
+            if int(store.best_valid_update.finalized_header.beacon.slot) <= int(
+                store.finalized_header.beacon.slot
+            ):
+                store.best_valid_update.finalized_header = (
+                    store.best_valid_update.attested_header
+                )
+            self.apply_light_client_update(store, store.best_valid_update)
+            store.best_valid_update = None
+
+    def process_light_client_update(
+        self, store, update, current_slot: int, genesis_validators_root
+    ) -> None:
+        self.validate_light_client_update(
+            store, update, current_slot, genesis_validators_root
+        )
+        sync_committee_bits = update.sync_aggregate.sync_committee_bits
+        num_active = sum(map(bool, sync_committee_bits))
+
+        if store.best_valid_update is None or self.is_better_update(
+            update, store.best_valid_update
+        ):
+            store.best_valid_update = update.copy()
+
+        store.current_max_active_participants = max(
+            store.current_max_active_participants, num_active
+        )
+
+        if num_active > self.get_safety_threshold(store) and int(
+            update.attested_header.beacon.slot
+        ) > int(store.optimistic_header.beacon.slot):
+            store.optimistic_header = update.attested_header
+
+        update_has_finalized_next_sync_committee = (
+            not self.is_next_sync_committee_known(store)
+            and self.is_sync_committee_update(update)
+            and self.is_finality_update(update)
+            and (
+                self.compute_sync_committee_period_at_slot(
+                    update.finalized_header.beacon.slot
+                )
+                == self.compute_sync_committee_period_at_slot(
+                    update.attested_header.beacon.slot
+                )
+            )
+        )
+        if num_active * 3 >= len(sync_committee_bits) * 2 and (
+            int(update.finalized_header.beacon.slot) > int(store.finalized_header.beacon.slot)
+            or update_has_finalized_next_sync_committee
+        ):
+            self.apply_light_client_update(store, update)
+            store.best_valid_update = None
+
+    def process_light_client_finality_update(
+        self, store, finality_update, current_slot: int, genesis_validators_root
+    ) -> None:
+        update = self.LightClientUpdate(
+            attested_header=finality_update.attested_header,
+            next_sync_committee=self.SyncCommittee(),
+            next_sync_committee_branch=self.NextSyncCommitteeBranch(),
+            finalized_header=finality_update.finalized_header,
+            finality_branch=finality_update.finality_branch,
+            sync_aggregate=finality_update.sync_aggregate,
+            signature_slot=finality_update.signature_slot,
+        )
+        self.process_light_client_update(
+            store, update, current_slot, genesis_validators_root
+        )
+
+    def process_light_client_optimistic_update(
+        self, store, optimistic_update, current_slot: int, genesis_validators_root
+    ) -> None:
+        update = self.LightClientUpdate(
+            attested_header=optimistic_update.attested_header,
+            next_sync_committee=self.SyncCommittee(),
+            next_sync_committee_branch=self.NextSyncCommitteeBranch(),
+            finalized_header=self.LightClientHeader(),
+            finality_branch=self.FinalityBranch(),
+            sync_aggregate=optimistic_update.sync_aggregate,
+            signature_slot=optimistic_update.signature_slot,
+        )
+        self.process_light_client_update(
+            store, update, current_slot, genesis_validators_root
+        )
+
+    # == full-node side (full-node.md) =====================================
+
+    def block_to_light_client_header(self, block):
+        beacon = self.BeaconBlockHeader(
+            slot=block.message.slot,
+            proposer_index=block.message.proposer_index,
+            parent_root=block.message.parent_root,
+            state_root=block.message.state_root,
+            body_root=hash_tree_root(block.message.body),
+        )
+        if not self._light_client_has_execution:
+            return self.LightClientHeader(beacon=beacon)
+        # capella+ (specs/capella/light-client/full-node.md:21-60): attach
+        # the execution header + its proof within the block body
+        epoch = self.compute_epoch_at_slot(block.message.slot)
+        if epoch >= self.config.CAPELLA_FORK_EPOCH:
+            execution = self.execution_payload_to_header(block.message.body.execution_payload)
+            execution_branch = compute_merkle_proof(
+                block.message.body, self.EXECUTION_PAYLOAD_GINDEX
+            )
+            return self.LightClientHeader(
+                beacon=beacon, execution=execution, execution_branch=execution_branch
+            )
+        return self.LightClientHeader(beacon=beacon)
+
+    def create_light_client_bootstrap(self, state, block):
+        assert (
+            self.compute_epoch_at_slot(state.slot) >= self.config.ALTAIR_FORK_EPOCH
+        ), "pre-altair state"
+        assert state.slot == state.latest_block_header.slot
+        header = state.latest_block_header.copy()
+        header.state_root = hash_tree_root(state)
+        assert hash_tree_root(header) == hash_tree_root(block.message)
+
+        return self.LightClientBootstrap(
+            header=self.block_to_light_client_header(block),
+            current_sync_committee=state.current_sync_committee,
+            current_sync_committee_branch=compute_merkle_proof(
+                state, self.current_sync_committee_gindex_at_slot(state.slot)
+            ),
+        )
+
+    def create_light_client_update(
+        self, state, block, attested_state, attested_block, finalized_block
+    ):
+        assert (
+            self.compute_epoch_at_slot(attested_state.slot) >= self.config.ALTAIR_FORK_EPOCH
+        )
+        sync_aggregate = block.message.body.sync_aggregate
+        assert (
+            sum(map(bool, sync_aggregate.sync_committee_bits))
+            >= self.MIN_SYNC_COMMITTEE_PARTICIPANTS
+        )
+
+        assert state.slot == state.latest_block_header.slot
+        header = state.latest_block_header.copy()
+        header.state_root = hash_tree_root(state)
+        assert hash_tree_root(header) == hash_tree_root(block.message)
+        update_signature_period = self.compute_sync_committee_period_at_slot(
+            block.message.slot
+        )
+
+        assert attested_state.slot == attested_state.latest_block_header.slot
+        attested_header = attested_state.latest_block_header.copy()
+        attested_header.state_root = hash_tree_root(attested_state)
+        assert (
+            hash_tree_root(attested_header)
+            == hash_tree_root(attested_block.message)
+            == block.message.parent_root
+        )
+        update_attested_period = self.compute_sync_committee_period_at_slot(
+            attested_block.message.slot
+        )
+
+        update = self.LightClientUpdate()
+        update.attested_header = self.block_to_light_client_header(attested_block)
+
+        # next committee is only useful when signed by the current committee
+        if update_attested_period == update_signature_period:
+            update.next_sync_committee = attested_state.next_sync_committee
+            update.next_sync_committee_branch = self.NextSyncCommitteeBranch(
+                compute_merkle_proof(
+                    attested_state,
+                    self.next_sync_committee_gindex_at_slot(attested_state.slot),
+                )
+            )
+
+        if finalized_block is not None:
+            if finalized_block.message.slot != self.GENESIS_SLOT:
+                update.finalized_header = self.block_to_light_client_header(finalized_block)
+                assert (
+                    hash_tree_root(update.finalized_header.beacon)
+                    == attested_state.finalized_checkpoint.root
+                )
+            else:
+                assert attested_state.finalized_checkpoint.root == Bytes32()
+            update.finality_branch = self.FinalityBranch(
+                compute_merkle_proof(
+                    attested_state,
+                    self.finalized_root_gindex_at_slot(attested_state.slot),
+                )
+            )
+
+        update.sync_aggregate = sync_aggregate
+        update.signature_slot = block.message.slot
+        return update
+
+    def create_light_client_finality_update(self, update):
+        return self.LightClientFinalityUpdate(
+            attested_header=update.attested_header,
+            finalized_header=update.finalized_header,
+            finality_branch=update.finality_branch,
+            sync_aggregate=update.sync_aggregate,
+            signature_slot=update.signature_slot,
+        )
+
+    def create_light_client_optimistic_update(self, update):
+        return self.LightClientOptimisticUpdate(
+            attested_header=update.attested_header,
+            sync_aggregate=update.sync_aggregate,
+            signature_slot=update.signature_slot,
+        )

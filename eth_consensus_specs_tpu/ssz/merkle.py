@@ -134,6 +134,38 @@ def get_merkle_proof(chunks: list[bytes], index: int, limit: int | None = None) 
     return proof
 
 
+def compute_merkle_proof(value, gindex: int) -> list[bytes]:
+    """Merkle proof for the subtree at generalized index `gindex` within an
+    SSZ Container value, bottom-up (the order is_valid_merkle_branch
+    consumes). The gindex path must align with container-field boundaries
+    (nested containers recurse), which covers the spec's hardcoded light-
+    client gindices (reference: ssz/merkle-proofs.md gindex algebra;
+    pysetup/spec_builders/altair.py:40-45 hardcodes the same values)."""
+    from .types import Container, hash_tree_root  # lazy: avoid import cycle
+
+    path = bin(int(gindex))[3:]  # binary digits after the leading 1
+    proof: list[bytes] = []
+    while path:
+        if not isinstance(value, Container):
+            raise TypeError(
+                f"gindex path descends into non-container {type(value).__name__}"
+            )
+        fields = list(type(value).fields())
+        depth = max(len(fields) - 1, 0).bit_length()
+        if len(path) < depth:
+            raise ValueError("gindex path ends inside a container's chunk tree")
+        field_index = int(path[:depth], 2)
+        if field_index >= len(fields):
+            raise ValueError(f"gindex selects padding chunk {field_index}")
+        chunks = [bytes(hash_tree_root(getattr(value, name))) for name in fields]
+        # walking top-down: each new segment is DEEPER than what's
+        # accumulated, and bottom-up order puts deeper siblings first
+        proof = get_merkle_proof(chunks, field_index, limit=1 << depth) + proof
+        value = getattr(value, fields[field_index])
+        path = path[depth:]
+    return proof
+
+
 def is_valid_merkle_branch(leaf: bytes, branch: list[bytes], depth: int, index: int, root: bytes) -> bool:
     """Verify a Merkle branch (reference: specs/phase0/beacon-chain.md:793-810)."""
     value = leaf

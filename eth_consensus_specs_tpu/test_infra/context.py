@@ -19,7 +19,11 @@ from __future__ import annotations
 
 from functools import wraps
 
-from eth_consensus_specs_tpu.forks import available_forks, get_spec
+from eth_consensus_specs_tpu.forks import (
+    available_forks,
+    get_spec,
+    get_spec_with_overrides,
+)
 from eth_consensus_specs_tpu.utils import bls as bls_module
 
 from .genesis import create_genesis_state
@@ -86,8 +90,14 @@ def zero_activation_threshold(spec):
 _state_cache: dict = {}
 
 
-def _get_genesis_state(spec, balances_fn, threshold_fn):
-    key = (spec.fork_name, spec.preset_name, balances_fn.__name__, threshold_fn.__name__)
+def _get_genesis_state(spec, balances_fn, threshold_fn, cache_extra=()):
+    key = (
+        spec.fork_name,
+        spec.preset_name,
+        balances_fn.__name__,
+        threshold_fn.__name__,
+        cache_extra,
+    )
     if key not in _state_cache:
         _state_cache[key] = create_genesis_state(
             spec, balances_fn(spec), threshold_fn(spec)
@@ -152,7 +162,22 @@ def with_presets(presets, reason: str = ""):
     return deco
 
 
-def _make_runner(fn, *, needs_state: bool, balances_fn, threshold_fn, bls_default: str):
+def _matching_config_overrides(phase: str) -> dict:
+    """Fork epochs up to `phase` pinned to genesis so config-driven fork
+    checks agree with the state's fork version (reference:
+    context.py:355-366 config_fork_epoch_overrides)."""
+    from eth_consensus_specs_tpu.config import FORK_ORDER
+
+    overrides = {}
+    for f in FORK_ORDER[1:]:
+        overrides[f"{f.upper()}_FORK_EPOCH"] = 0
+        if f == phase:
+            break
+    return overrides
+
+
+def _make_runner(fn, *, needs_state: bool, balances_fn, threshold_fn, bls_default: str,
+                 matching_config: bool = False):
     """Shared core of spec_state_test/spec_test variants."""
 
     @wraps(fn)
@@ -164,7 +189,18 @@ def _make_runner(fn, *, needs_state: bool, balances_fn, threshold_fn, bls_defaul
         bls_active: bool | None = None,
         **extra,
     ):
-        spec = get_spec(phase, preset)
+        config_overrides = extra.pop("config_overrides", None)
+        if matching_config and phase != "phase0":
+            config_overrides = {
+                **_matching_config_overrides(phase),
+                **(config_overrides or {}),
+            }
+        if config_overrides:
+            spec = get_spec_with_overrides(phase, preset, config_overrides=config_overrides)
+            cache_extra = tuple(sorted(config_overrides.items()))
+        else:
+            spec = get_spec(phase, preset)
+            cache_extra = ()
         if bls_active is None:
             bls_active = bls_default == "on"
         # the test body executes lazily during iteration, so the bls switch
@@ -176,7 +212,9 @@ def _make_runner(fn, *, needs_state: bool, balances_fn, threshold_fn, bls_defaul
                 kwargs = dict(extra)
                 kwargs["spec"] = spec
                 if needs_state:
-                    kwargs["state"] = _get_genesis_state(spec, balances_fn, threshold_fn)
+                    kwargs["state"] = _get_genesis_state(
+                        spec, balances_fn, threshold_fn, cache_extra
+                    )
                 gen = fn(**kwargs)
                 if gen is not None:
                     yield from gen
@@ -200,6 +238,19 @@ def spec_state_test(fn):
     )
 
 
+def spec_state_test_with_matching_config(fn):
+    """spec_state_test whose config schedules every fork up to the tested
+    one at genesis (reference: context.py:380-381)."""
+    return _make_runner(
+        fn,
+        needs_state=True,
+        balances_fn=default_balances,
+        threshold_fn=default_activation_threshold,
+        bls_default="off",
+        matching_config=True,
+    )
+
+
 def spec_test(fn):
     return _make_runner(
         fn,
@@ -219,6 +270,21 @@ def with_custom_state(balances_fn, threshold_fn):
             threshold_fn=threshold_fn,
             bls_default="off",
         )
+
+    return deco
+
+
+def with_config_overrides(overrides: dict):
+    """Run the test under a spec whose runtime config has `overrides`
+    applied (reference: context.py:714-783)."""
+
+    def deco(fn):
+        @wraps(fn)
+        def wrapper(*args, **kwargs):
+            kwargs["config_overrides"] = overrides
+            return fn(*args, **kwargs)
+
+        return wrapper
 
     return deco
 

@@ -1,0 +1,339 @@
+"""Altair light-client sync protocol: bootstrap, update validation,
+finality/optimistic processing, force update (reference analogue:
+eth2spec/test/altair/light_client/; spec:
+specs/altair/light-client/sync-protocol.md, full-node.md)."""
+
+from eth_consensus_specs_tpu.ssz import Bytes32, hash_tree_root
+from eth_consensus_specs_tpu.ssz.merkle import compute_merkle_proof
+from eth_consensus_specs_tpu.test_infra.attestations import next_epoch_with_attestations
+from eth_consensus_specs_tpu.test_infra.block import (
+    build_empty_block_for_next_slot,
+    state_transition_and_sign_block,
+)
+from eth_consensus_specs_tpu.test_infra.context import (
+    always_bls,
+    expect_assertion_error,
+    spec_state_test_with_matching_config,
+    with_phases,
+)
+
+# the protocol is altair-born; capella/deneb/electra refine the header and
+# (electra) the state gindices — the matrix covers each shape
+LC_FORKS = ["altair", "capella", "deneb", "electra"]
+
+
+def _signed_block_for_state(spec, state):
+    """An empty signed block on top of `state` (mutates state)."""
+    block = build_empty_block_for_next_slot(spec, state)
+    return state_transition_and_sign_block(spec, state, block)
+
+
+def _bootstrap_store(spec, state):
+    """Advance one block, build a bootstrap at the head, initialize."""
+    signed = _signed_block_for_state(spec, state)
+    bootstrap = spec.create_light_client_bootstrap(state, signed)
+    trusted_root = hash_tree_root(signed.message)
+    store = spec.initialize_light_client_store(trusted_root, bootstrap)
+    return store, signed
+
+
+# == gindex proofs =========================================================
+
+
+@with_phases(LC_FORKS)
+@spec_state_test_with_matching_config
+def test_sync_committee_gindex_proofs(spec, state):
+    root = hash_tree_root(state)
+    for gindex, leaf_obj in (
+        (spec.current_sync_committee_gindex_at_slot(state.slot), state.current_sync_committee),
+        (spec.next_sync_committee_gindex_at_slot(state.slot), state.next_sync_committee),
+    ):
+        branch = compute_merkle_proof(state, gindex)
+        assert spec.is_valid_normalized_merkle_branch(
+            hash_tree_root(leaf_obj), branch, gindex, root
+        )
+
+
+@with_phases(LC_FORKS)
+@spec_state_test_with_matching_config
+def test_finalized_root_gindex_proof(spec, state):
+    state.finalized_checkpoint.root = b"\x21" * 32
+    gindex = spec.finalized_root_gindex_at_slot(state.slot)
+    root = hash_tree_root(state)
+    branch = compute_merkle_proof(state, gindex)
+    assert spec.is_valid_normalized_merkle_branch(
+        Bytes32(state.finalized_checkpoint.root), branch, gindex, root
+    )
+    # a tampered branch fails
+    bad = list(branch)
+    bad[0] = b"\x66" * 32
+    assert not spec.is_valid_normalized_merkle_branch(
+        Bytes32(state.finalized_checkpoint.root), bad, gindex, root
+    )
+
+
+# == bootstrap =============================================================
+
+
+@with_phases(LC_FORKS)
+@spec_state_test_with_matching_config
+def test_bootstrap_initializes_store(spec, state):
+    store, signed = _bootstrap_store(spec, state)
+    assert hash_tree_root(store.finalized_header.beacon) == hash_tree_root(signed.message)
+    assert store.current_sync_committee == state.current_sync_committee
+    assert not spec.is_next_sync_committee_known(store)
+    assert store.best_valid_update is None
+
+
+@with_phases(LC_FORKS)
+@spec_state_test_with_matching_config
+def test_bootstrap_wrong_trusted_root_rejected(spec, state):
+    signed = _signed_block_for_state(spec, state)
+    bootstrap = spec.create_light_client_bootstrap(state, signed)
+    expect_assertion_error(
+        lambda: spec.initialize_light_client_store(b"\x13" * 32, bootstrap)
+    )
+
+
+@with_phases(LC_FORKS)
+@spec_state_test_with_matching_config
+def test_bootstrap_bad_committee_branch_rejected(spec, state):
+    signed = _signed_block_for_state(spec, state)
+    bootstrap = spec.create_light_client_bootstrap(state, signed)
+    bootstrap.current_sync_committee_branch[0] = b"\x99" * 32
+    expect_assertion_error(
+        lambda: spec.initialize_light_client_store(
+            hash_tree_root(signed.message), bootstrap
+        )
+    )
+
+
+# == updates ===============================================================
+
+
+def _advance_with_light_client_update(spec, state):
+    """Build (attested block, signature block) pair + update on top of the
+    current state. Returns (update, signature_block_slot)."""
+    attested_block = _signed_block_for_state(spec, state)
+    attested_state_post = state.copy()  # state AFTER attested block
+
+    sig_state = state.copy()
+    signature_block = build_empty_block_for_next_slot(spec, sig_state)
+    # full sync-committee participation signs the attested header
+    for i in range(spec.SYNC_COMMITTEE_SIZE):
+        signature_block.body.sync_aggregate.sync_committee_bits[i] = True
+    from eth_consensus_specs_tpu.test_infra.keys import privkeys
+    from eth_consensus_specs_tpu.utils import bls as bls_mod
+
+    # sign the PREVIOUS block root (= attested block) per the sync protocol
+    prev_slot = int(signature_block.slot) - 1
+    domain = spec.get_domain(
+        sig_state, spec.DOMAIN_SYNC_COMMITTEE, spec.compute_epoch_at_slot(prev_slot)
+    )
+    signing_root = spec.compute_signing_root(
+        hash_tree_root(attested_block.message), domain
+    )
+    committee_pubkeys = list(sig_state.current_sync_committee.pubkeys)
+    all_pubkeys = [v.pubkey for v in sig_state.validators]
+    sigs = []
+    for pk in committee_pubkeys:
+        idx = all_pubkeys.index(pk)
+        sigs.append(bls_mod.Sign(privkeys[idx], signing_root))
+    signature_block.body.sync_aggregate.sync_committee_signature = bls_mod.Aggregate(sigs)
+    signed_sig_block = state_transition_and_sign_block(spec, sig_state, signature_block)
+
+    update = spec.create_light_client_update(
+        sig_state, signed_sig_block, attested_state_post, attested_block, None
+    )
+    return update, sig_state
+
+
+@with_phases(LC_FORKS)
+@spec_state_test_with_matching_config
+def test_process_optimistic_update(spec, state):
+    store, _ = _bootstrap_store(spec, state)
+    update, sig_state = _advance_with_light_client_update(spec, state)
+    optimistic = spec.create_light_client_optimistic_update(update)
+    current_slot = int(sig_state.slot) + 1
+    spec.process_light_client_optimistic_update(
+        store, optimistic, current_slot, sig_state.genesis_validators_root
+    )
+    assert hash_tree_root(store.optimistic_header.beacon) == hash_tree_root(
+        update.attested_header.beacon
+    )
+    # optimistic update alone does not advance finality
+    assert int(store.finalized_header.beacon.slot) < int(
+        store.optimistic_header.beacon.slot
+    )
+
+
+@with_phases(LC_FORKS)
+@spec_state_test_with_matching_config
+def test_process_update_tracks_best_valid(spec, state):
+    store, _ = _bootstrap_store(spec, state)
+    update, sig_state = _advance_with_light_client_update(spec, state)
+    current_slot = int(sig_state.slot) + 1
+    spec.process_light_client_update(
+        store, update, current_slot, sig_state.genesis_validators_root
+    )
+    assert store.best_valid_update is not None
+    assert store.current_max_active_participants == spec.SYNC_COMMITTEE_SIZE
+
+
+@with_phases(LC_FORKS)
+@spec_state_test_with_matching_config
+def test_validate_update_rejects_future_signature_slot(spec, state):
+    store, _ = _bootstrap_store(spec, state)
+    update, sig_state = _advance_with_light_client_update(spec, state)
+    current_slot = int(update.signature_slot) - 1  # clock behind signature
+    expect_assertion_error(
+        lambda: spec.validate_light_client_update(
+            store, update, current_slot, sig_state.genesis_validators_root
+        )
+    )
+
+
+@with_phases(["altair"])
+@always_bls
+@spec_state_test_with_matching_config
+def test_validate_update_rejects_bad_signature(spec, state):
+    store, _ = _bootstrap_store(spec, state)
+    update, sig_state = _advance_with_light_client_update(spec, state)
+    update.sync_aggregate.sync_committee_signature = b"\x11" * 96
+    current_slot = int(sig_state.slot) + 1
+    expect_assertion_error(
+        lambda: spec.validate_light_client_update(
+            store, update, current_slot, sig_state.genesis_validators_root
+        )
+    )
+
+
+@with_phases(LC_FORKS)
+@spec_state_test_with_matching_config
+def test_validate_update_rejects_empty_participation(spec, state):
+    store, _ = _bootstrap_store(spec, state)
+    update, sig_state = _advance_with_light_client_update(spec, state)
+    bits_type = type(update.sync_aggregate.sync_committee_bits)
+    update.sync_aggregate.sync_committee_bits = bits_type()  # all zero
+    current_slot = int(sig_state.slot) + 1
+    expect_assertion_error(
+        lambda: spec.validate_light_client_update(
+            store, update, current_slot, sig_state.genesis_validators_root
+        )
+    )
+
+
+@with_phases(LC_FORKS)
+@spec_state_test_with_matching_config
+def test_is_better_update_prefers_participation(spec, state):
+    store, _ = _bootstrap_store(spec, state)
+    update, sig_state = _advance_with_light_client_update(spec, state)
+    weaker = update.copy()
+    # drop half the participation bits (below supermajority)
+    for i in range(spec.SYNC_COMMITTEE_SIZE * 2 // 3):
+        weaker.sync_aggregate.sync_committee_bits[i] = False
+    assert spec.is_better_update(update, weaker)
+    assert not spec.is_better_update(weaker, update)
+
+
+@with_phases(LC_FORKS)
+@spec_state_test_with_matching_config
+def test_force_update_applies_best(spec, state):
+    store, _ = _bootstrap_store(spec, state)
+    update, sig_state = _advance_with_light_client_update(spec, state)
+    current_slot = int(sig_state.slot) + 1
+    spec.process_light_client_update(
+        store, update, current_slot, sig_state.genesis_validators_root
+    )
+    assert store.best_valid_update is not None
+    finalized_before = int(store.finalized_header.beacon.slot)
+    # no finality progress for longer than the update timeout
+    far_future_slot = current_slot + spec.UPDATE_TIMEOUT + 1
+    spec.process_light_client_store_force_update(store, far_future_slot)
+    assert store.best_valid_update is None
+    assert int(store.finalized_header.beacon.slot) > finalized_before
+
+
+@with_phases(["deneb"])
+@spec_state_test_with_matching_config
+def test_capella_era_header_execution_root(spec, state):
+    """Deneb's get_lc_execution_root re-projects capella-era headers into
+    the capella container shape (deneb LC spec [Modified in Deneb])."""
+    from eth_consensus_specs_tpu.forks import get_spec
+
+    capella = get_spec("capella", spec.preset_name)
+    # a capella-era execution header lifted into the deneb type with
+    # blob-gas fields zero
+    deneb_exec = spec.ExecutionPayloadHeader(
+        block_number=7, gas_limit=30_000_000, block_hash=b"\x31" * 32
+    )
+    header = spec.LightClientHeader(beacon=spec.BeaconBlockHeader(slot=0))
+    header.execution = deneb_exec
+    # pin the header's epoch into the capella era via config: matching
+    # config sets DENEB_FORK_EPOCH=0, so craft the comparison directly
+    capella_exec = capella.ExecutionPayloadHeader(
+        **{name: getattr(deneb_exec, name) for name in capella.ExecutionPayloadHeader.fields()}
+    )
+    from eth_consensus_specs_tpu.forks import get_spec_with_overrides
+
+    shifted = get_spec_with_overrides(
+        "deneb",
+        spec.preset_name,
+        config_overrides={
+            "ALTAIR_FORK_EPOCH": 0,
+            "BELLATRIX_FORK_EPOCH": 0,
+            "CAPELLA_FORK_EPOCH": 0,
+            "DENEB_FORK_EPOCH": 100,  # header slot 0 is capella-era
+        },
+    )
+    header2 = shifted.LightClientHeader(beacon=shifted.BeaconBlockHeader(slot=0))
+    header2.execution = shifted.ExecutionPayloadHeader(
+        block_number=7, gas_limit=30_000_000, block_hash=b"\x31" * 32
+    )
+    assert bytes(shifted.get_lc_execution_root(header2)) == bytes(
+        hash_tree_root(capella_exec)
+    )
+
+
+@with_phases(["electra"])
+@spec_state_test_with_matching_config
+def test_upgrade_lc_objects_to_electra(spec, state):
+    """Pre-electra LC objects re-home with zero-extended branches."""
+    from eth_consensus_specs_tpu.forks import get_spec_with_overrides
+
+    deneb = get_spec_with_overrides(
+        "deneb",
+        spec.preset_name,
+        config_overrides={
+            "ALTAIR_FORK_EPOCH": 0,
+            "BELLATRIX_FORK_EPOCH": 0,
+            "CAPELLA_FORK_EPOCH": 0,
+            "DENEB_FORK_EPOCH": 0,
+        },
+    )
+    from eth_consensus_specs_tpu.test_infra.context import (
+        default_activation_threshold,
+        default_balances,
+    )
+    from eth_consensus_specs_tpu.test_infra.genesis import create_genesis_state
+
+    dstate = create_genesis_state(
+        deneb, default_balances(deneb), default_activation_threshold(deneb)
+    )
+    signed = _signed_block_for_state(deneb, dstate)
+    bootstrap = deneb.create_light_client_bootstrap(dstate, signed)
+    upgraded = spec.upgrade_lc_bootstrap_to_electra(bootstrap)
+    # branch zero-extends by one level (altair depth 5 -> electra depth 6)
+    assert len(upgraded.current_sync_committee_branch) == len(
+        bootstrap.current_sync_committee_branch
+    ) + 1
+    assert bytes(upgraded.current_sync_committee_branch[0]) == b"\x00" * 32
+    assert upgraded.current_sync_committee == bootstrap.current_sync_committee
+    # store upgrade carries headers + counters over
+    store = deneb.initialize_light_client_store(
+        hash_tree_root(signed.message), bootstrap
+    )
+    estore = spec.upgrade_lc_store_to_electra(store)
+    assert hash_tree_root(estore.finalized_header.beacon) == hash_tree_root(
+        store.finalized_header.beacon
+    )
