@@ -175,6 +175,40 @@ def bench_device_resident_epochs(
     return best / epochs, best
 
 
+def bench_batch_verify(n_aggregates: int = 16, committee: int = 8) -> tuple[float, float]:
+    """Secondary: aggregate-signature batch verification throughput under
+    the tpu backend (device G1 MSM for the RLC combine, one host pairing
+    per batch). Returns (aggregates_per_sec, seconds_per_batch)."""
+    from eth_consensus_specs_tpu.crypto import signature as sig_mod
+    from eth_consensus_specs_tpu.ops.bls_batch import batch_verify_aggregates
+    from eth_consensus_specs_tpu.utils import bls
+
+    items = []
+    sk = 1
+    for i in range(n_aggregates):
+        msg = i.to_bytes(32, "big")
+        group = list(range(sk, sk + committee))
+        sk += committee
+        pks = [sig_mod.sk_to_pk(k) for k in group]
+        sigs = [sig_mod.sign(k, msg) for k in group]
+        items.append((pks, msg, sig_mod.aggregate(sigs)))
+
+    bls.use_tpu()
+    try:
+        if not batch_verify_aggregates(items):  # warm (compiles the MSM)
+            raise RuntimeError("batch verification rejected valid signatures")
+        best = float("inf")
+        for _ in range(2):
+            t0 = time.perf_counter()
+            ok = batch_verify_aggregates(items)
+            best = min(best, time.perf_counter() - t0)
+            if not ok:
+                raise RuntimeError("batch verification rejected valid signatures")
+    finally:
+        bls.use_pyspec()
+    return n_aggregates / best, best
+
+
 def _probe_accelerator(retries: int = 2) -> bool:
     """Check in a subprocess whether the accelerator backend can initialize.
 
@@ -234,6 +268,10 @@ def _run_section(section: str, on_cpu: bool) -> None:
         epochs = 4 if on_cpu else 8
         per_epoch_s, total_s = bench_device_resident_epochs(n_validators=n, epochs=epochs)
         print(json.dumps({"per_epoch_s": per_epoch_s, "total_s": total_s, "n": n, "epochs": epochs}))
+    elif section == "bls":
+        n = 4 if on_cpu else 16
+        aggs_per_sec, batch_s = bench_batch_verify(n_aggregates=n)
+        print(json.dumps({"aggs_per_sec": aggs_per_sec, "batch_s": batch_s, "n": n}))
     else:
         raise SystemExit(f"unknown section {section}")
 
@@ -301,6 +339,15 @@ def main() -> None:
             f"[bench] device-resident epoch+root @{resident['n']} validators: "
             f"{resident['per_epoch_s']*1e3:.2f} ms/epoch "
             f"({resident['epochs']} epochs chained: {resident['total_s']*1e3:.1f} ms)",
+            file=sys.stderr,
+        )
+
+    bls_res = _section_in_subprocess("bls", on_cpu, timeout_s=480)
+    if bls_res is not None:
+        print(
+            f"[bench] RLC batch verify ({bls_res['n']} aggregates): "
+            f"{bls_res['aggs_per_sec']:.1f} aggregates/s "
+            f"({bls_res['batch_s']*1e3:.0f} ms/batch, one pairing)",
             file=sys.stderr,
         )
 

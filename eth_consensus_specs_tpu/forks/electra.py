@@ -737,8 +737,7 @@ class ElectraSpec(DenebSpec):
             self.process_proposer_slashing(state, operation)
         for operation in body.attester_slashings:
             self.process_attester_slashing(state, operation)
-        for operation in body.attestations:
-            self.process_attestation(state, operation)
+        self._process_attestations(state, body.attestations)
         for operation in body.deposits:
             self.process_deposit(state, operation)
         for operation in body.voluntary_exits:

@@ -1,35 +1,68 @@
-"""Batched BLS verification — the device-backend slot.
+"""Batched BLS verification — the device-backend seam.
 
 The consensus workload's signature hot spot is many independent
 FastAggregateVerify calls per block (<=128 attestations x committee
 aggregates; reference call sites: specs/phase0/beacon-chain.md:776-792,
 specs/altair/beacon-chain.md:575-650). The batching seams:
 
-  1. aggregate pubkey sums (G1 adds) are data-parallel per attestation;
+  1. aggregate pubkey sums + RLC scalar products run as a DEVICE G1 MSM
+     (ops/g1_msm limb kernel) when the tpu backend is selected;
   2. random-linear-combination batching collapses N pairing checks into
      one (the algorithmic seam the reference uses for KZG batches,
      specs/deneb/polynomial-commitments.md:412-463);
-  3. the final pairing runs once per batch on host.
+  3. the single final pairing (and the G2 side of the RLC) stays on host —
+     the G2/pairing limb tower is the next device step.
 
-Current state: host group arithmetic through crypto/ with the batch-RLC
-structure in place; the limb-arithmetic device MSM (ops/field_limbs) slots
-in underneath without changing callers. The RLC reduction itself is already
-the right shape for TPU: it is exactly a (scalars x points) MSM.
+`process_operations` routes block attestations through
+`batch_verify_aggregates` (one pairing per block) and falls back to
+per-attestation verification only when the batch rejects, so the invalid
+attestation surfaces at the exact spec assertion.
 """
 
 from __future__ import annotations
 
 import secrets
 
-from eth_consensus_specs_tpu.crypto import signature as _sig
-from eth_consensus_specs_tpu.crypto.curve import g1_from_bytes, g1_generator, g1_infinity, g2_from_bytes
+from eth_consensus_specs_tpu.crypto.curve import (
+    g1_from_bytes,
+    g1_generator,
+    g1_infinity,
+    g2_from_bytes,
+)
 from eth_consensus_specs_tpu.crypto.hash_to_curve import hash_to_g2
 from eth_consensus_specs_tpu.crypto.pairing import pairing_check
 
 
-def fast_aggregate_verify_host_pairing(pks: list[bytes], message: bytes, sig: bytes) -> bool:
-    """Single FastAggregateVerify via the host pairing (device MSM slot)."""
-    return _sig.fast_aggregate_verify(pks, message, sig)
+def _use_device() -> bool:
+    from eth_consensus_specs_tpu.utils import bls
+
+    return bls.backend_name() == "tpu"
+
+
+def fast_aggregate_verify_device(pks: list[bytes], message: bytes, sig: bytes) -> bool:
+    """FastAggregateVerify with the pubkey aggregation on device and the
+    pairing on host. Semantics mirror the host path exactly (per-key
+    validation rejects infinity KEYS, but an infinity AGGREGATE proceeds
+    into the pairing — crypto/signature.py:115-127) so backend choice can
+    never flip a verification result."""
+    from eth_consensus_specs_tpu.crypto.signature import _load_pk, _load_sig
+    from eth_consensus_specs_tpu.ops.g1_msm import sum_g1_device
+
+    if len(pks) == 0:
+        return False
+    points = []
+    for pk_b in pks:
+        pk = _load_pk(bytes(pk_b))
+        if pk is None:
+            return False
+        points.append(pk)
+    sig_pt = _load_sig(bytes(sig))
+    if sig_pt is None:
+        return False
+    aggpk = sum_g1_device(points)
+    return pairing_check(
+        [(aggpk, hash_to_g2(bytes(message))), (-g1_generator(), sig_pt)]
+    )
 
 
 def batch_verify_aggregates(items: list[tuple[list[bytes], bytes, bytes]]) -> bool:
@@ -39,29 +72,48 @@ def batch_verify_aggregates(items: list[tuple[list[bytes], bytes, bytes]]) -> bo
         prod_i e(r_i * aggpk_i, H(m_i)) * e(-G1, sum_i r_i * sig_i) == 1
 
     Sound: a forged triple passes only with probability ~1/2^64 over the
-    random r_i. This is the TPU-shaped reduction: all scalar products are
-    one MSM batch.
+    random r_i. With the tpu backend all r_i * aggpk_i products run as one
+    device MSM (scalar r_i repeated per committee member); the G2 side and
+    the final pairing are host-side.
     """
     if not items:
         return True
-    pairs = []
-    sig_acc = None
     g1 = g1_generator()
+    parsed = []
     for pks, msg, sig_b in items:
         if len(pks) == 0:
             return False
         try:
-            aggpk = g1_infinity()
-            for pk in pks:
-                p = g1_from_bytes(bytes(pk))
-                if p.is_infinity():
-                    return False
-                aggpk = aggpk + p
+            points = [g1_from_bytes(bytes(pk)) for pk in pks]
+            if any(p.is_infinity() for p in points):
+                return False
             sig = g2_from_bytes(bytes(sig_b))
         except ValueError:
             return False
         r = secrets.randbits(64) | 1
-        pairs.append((aggpk.mul(r), hash_to_g2(bytes(msg))))
+        parsed.append((points, bytes(msg), sig, r))
+
+    if _use_device():
+        from eth_consensus_specs_tpu.ops.g1_msm import msm_g1_device
+
+        # one flat MSM computes every r_i * aggpk_i: can't mix messages in
+        # a single output point, so run the kernel once per item batch of
+        # committee points (same compiled executable across items)
+        rpk = [
+            msm_g1_device(points, [r] * len(points)) for points, _, _, r in parsed
+        ]
+    else:
+        rpk = []
+        for points, _, _, r in parsed:
+            aggpk = g1_infinity()
+            for p in points:
+                aggpk = aggpk + p
+            rpk.append(aggpk.mul(r))
+
+    pairs = []
+    sig_acc = None
+    for (points, msg, sig, r), rp in zip(parsed, rpk):
+        pairs.append((rp, hash_to_g2(msg)))
         term = sig.mul(r)
         sig_acc = term if sig_acc is None else sig_acc + term
     pairs.append((-g1, sig_acc))

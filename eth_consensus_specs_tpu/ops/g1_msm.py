@@ -1,0 +1,232 @@
+"""Device G1 multi-scalar multiplication over limb arithmetic.
+
+The hot BLS reductions (aggregate-pubkey sums, KZG commitment MSMs, the
+RLC batch-verification combine) are all sum_i k_i * P_i over G1. Here the
+whole MSM runs on device: branchless Jacobian point arithmetic (a = 0
+short-Weierstrass, infinity encoded as Z = 0, every case handled by
+`where` masks so there is no data-dependent control flow), a vmapped
+256-bit double-and-add per (scalar, point) lane, then a log2 pairwise
+tree reduction — the same shape as the merkle tree reduce, but over
+point adds (reference native analogue: arkworks `multiexp_unchecked`
+behind utils/bls.py:262-296).
+
+Doubling is dbl-2009-l (2M+5S), addition add-2007-bl (11M+5S); both are
+composed from ops/field_limbs Montgomery primitives, so one MSM lane is
+~20k u64 lane-multiplies per scalar bit — embarrassingly parallel across
+points, which is exactly what the VPU wants.
+
+Conversion boundary: affine crypto/curve.Point <-> Montgomery limb arrays
+on host; the single final Jacobian->affine inversion also stays host-side
+(one modular inverse per MSM, not worth a device Fermat chain yet).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import numpy as np
+
+import eth_consensus_specs_tpu  # noqa: F401  (enables x64)
+import jax.numpy as jnp
+from jax import lax
+
+from eth_consensus_specs_tpu.crypto.curve import Point, B1, g1_infinity
+from eth_consensus_specs_tpu.crypto.fields import Fq, P as P_INT
+
+from .field_limbs import (
+    N_LIMBS,
+    add_mod,
+    from_mont_int,
+    is_zero,
+    mont_mul,
+    mont_sqr,
+    sub_mod,
+    to_mont,
+)
+
+SCALAR_BITS = 256
+
+
+def _dbl(X, Y, Z):
+    """dbl-2009-l (a=0). Infinity (Z=0) and Y=0 both yield Z3=0."""
+    A = mont_sqr(X)
+    B = mont_sqr(Y)
+    C = mont_sqr(B)
+    t = mont_sqr(add_mod(X, B))
+    D = sub_mod(sub_mod(t, A), C)
+    D = add_mod(D, D)  # 2*((X+B)^2 - A - C)
+    E = add_mod(add_mod(A, A), A)  # 3A
+    F = mont_sqr(E)
+    X3 = sub_mod(F, add_mod(D, D))
+    C8 = add_mod(C, C)
+    C8 = add_mod(C8, C8)
+    C8 = add_mod(C8, C8)
+    Y3 = sub_mod(mont_mul(E, sub_mod(D, X3)), C8)
+    YZ = mont_mul(Y, Z)
+    Z3 = add_mod(YZ, YZ)
+    return X3, Y3, Z3
+
+
+def _select(mask, a, b):
+    """Per-lane select over limb arrays: mask ? a : b."""
+    return jnp.where(mask[..., None], a, b)
+
+
+def _add(X1, Y1, Z1, X2, Y2, Z2):
+    """Complete Jacobian add via masked case analysis (add-2007-bl core)."""
+    Z1Z1 = mont_sqr(Z1)
+    Z2Z2 = mont_sqr(Z2)
+    U1 = mont_mul(X1, Z2Z2)
+    U2 = mont_mul(X2, Z1Z1)
+    S1 = mont_mul(mont_mul(Y1, Z2), Z2Z2)
+    S2 = mont_mul(mont_mul(Y2, Z1), Z1Z1)
+    H = sub_mod(U2, U1)
+    rr = sub_mod(S2, S1)
+    r2 = add_mod(rr, rr)
+    HH = add_mod(H, H)
+    I = mont_sqr(HH)
+    J = mont_mul(H, I)
+    V = mont_mul(U1, I)
+    X3 = sub_mod(sub_mod(mont_sqr(r2), J), add_mod(V, V))
+    SJ = mont_mul(S1, J)
+    Y3 = sub_mod(mont_mul(r2, sub_mod(V, X3)), add_mod(SJ, SJ))
+    ZZ = sub_mod(sub_mod(mont_sqr(add_mod(Z1, Z2)), Z1Z1), Z2Z2)
+    Z3 = mont_mul(ZZ, H)
+
+    p1_inf = is_zero(Z1)
+    p2_inf = is_zero(Z2)
+    same_x = is_zero(H)
+    same_y = is_zero(rr)
+
+    dX, dY, dZ = _dbl(X1, Y1, Z1)
+
+    # default: generic add; same point: double; opposite points: infinity
+    outX = _select(same_x & same_y, dX, X3)
+    outY = _select(same_x & same_y, dY, Y3)
+    outZ = _select(same_x & same_y, dZ, _select(same_x, jnp.zeros_like(Z3), Z3))
+    # either input at infinity: pass the other through
+    outX = _select(p1_inf, X2, _select(p2_inf, X1, outX))
+    outY = _select(p1_inf, Y2, _select(p2_inf, Y1, outY))
+    outZ = _select(p1_inf, Z2, _select(p2_inf, Z1, outZ))
+    return outX, outY, outZ
+
+
+def _scalar_mul_lane(bits, X, Y, Z):
+    """Double-and-add over MSB-first `bits` (u64[256]) for one lane; runs
+    under vmap so every op broadcasts across lanes."""
+
+    def body(i, acc):
+        aX, aY, aZ = acc
+        aX, aY, aZ = _dbl(aX, aY, aZ)
+        sX, sY, sZ = _add(aX, aY, aZ, X, Y, Z)
+        take = bits[i] != 0
+        return (
+            _select(take, sX, aX),
+            _select(take, sY, aY),
+            _select(take, sZ, aZ),
+        )
+
+    inf = (jnp.zeros_like(X), jnp.zeros_like(Y), jnp.zeros_like(Z))
+    return lax.fori_loop(0, SCALAR_BITS, body, inf)
+
+
+def _tree_sum(mX, mY, mZ):
+    """Pairwise point-sum of N (power-of-two) Jacobian lanes."""
+    n = mX.shape[0]
+    while n > 1:
+        half = n // 2
+        mX, mY, mZ = _add(
+            mX[:half], mY[:half], mZ[:half], mX[half:], mY[half:], mZ[half:]
+        )
+        n = half
+    return mX[0], mY[0], mZ[0]
+
+
+@jax.jit
+def msm_kernel(bits, X, Y, Z):
+    """MSM over N (power-of-two) lanes: bits u64[N,256], X/Y/Z u64[N,13]
+    (Montgomery). Returns Jacobian (X,Y,Z) u64[13] of sum_i k_i * P_i."""
+    mX, mY, mZ = jax.vmap(_scalar_mul_lane)(bits, X, Y, Z)
+    return _tree_sum(mX, mY, mZ)
+
+
+@jax.jit
+def sum_kernel(X, Y, Z):
+    """Plain point sum over N (power-of-two) lanes — the unit-scalar MSM
+    without the 256-bit double-and-add (aggregate-pubkey fast path)."""
+    return _tree_sum(X, Y, Z)
+
+
+# == host conversion boundary ==============================================
+
+
+def _points_to_limbs(points: list) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
+    n = len(points)
+    X = np.zeros((n, N_LIMBS), np.uint64)
+    Y = np.zeros((n, N_LIMBS), np.uint64)
+    Z = np.zeros((n, N_LIMBS), np.uint64)
+    one = to_mont(1)
+    for i, p in enumerate(points):
+        if p.is_infinity():
+            continue  # Z stays zero
+        X[i] = to_mont(p.x.n)
+        Y[i] = to_mont(p.y.n)
+        Z[i] = one
+    return X, Y, Z
+
+
+def _scalars_to_bits(scalars: list[int]) -> np.ndarray:
+    n = len(scalars)
+    bits = np.zeros((n, SCALAR_BITS), np.uint64)
+    for i, k in enumerate(scalars):
+        k = int(k)
+        assert 0 <= k < (1 << SCALAR_BITS)
+        for j in range(SCALAR_BITS):
+            bits[i, j] = (k >> (SCALAR_BITS - 1 - j)) & 1
+    return bits
+
+
+def _jacobian_to_point(X, Y, Z) -> Point:
+    z = from_mont_int(np.asarray(Z))
+    if z == 0:
+        return g1_infinity()
+    x = from_mont_int(np.asarray(X))
+    y = from_mont_int(np.asarray(Y))
+    zinv = pow(z, P_INT - 2, P_INT)
+    zinv2 = zinv * zinv % P_INT
+    return Point(Fq(x * zinv2 % P_INT), Fq(y * zinv2 % P_INT * zinv % P_INT), B1)
+
+
+def _pad_pow2(arrs, n):
+    """Pad lane arrays to the next power of two with infinity lanes (Z=0,
+    zero scalars) — ONE compiled executable per pow2 bucket instead of one
+    per exact committee size."""
+    cap = 1 << max(n - 1, 0).bit_length() if n > 1 else 1
+    if cap == n:
+        return arrs
+    return [np.concatenate([a, np.zeros((cap - n,) + a.shape[1:], a.dtype)]) for a in arrs]
+
+
+def msm_g1_device(points: list, scalars: list[int]) -> Point:
+    """Device MSM entry: sum_i scalars[i] * points[i] over G1."""
+    assert len(points) == len(scalars)
+    if not points:
+        return g1_infinity()
+    X, Y, Z = _points_to_limbs(points)
+    if all(int(k) == 1 for k in scalars):
+        # aggregate-pubkey fast path: tree sum only, no scalar loop
+        X, Y, Z = _pad_pow2([X, Y, Z], len(points))
+        rX, rY, rZ = sum_kernel(jnp.asarray(X), jnp.asarray(Y), jnp.asarray(Z))
+    else:
+        bits = _scalars_to_bits(scalars)
+        bits, X, Y, Z = _pad_pow2([bits, X, Y, Z], len(points))
+        rX, rY, rZ = msm_kernel(
+            jnp.asarray(bits), jnp.asarray(X), jnp.asarray(Y), jnp.asarray(Z)
+        )
+    return _jacobian_to_point(np.asarray(rX), np.asarray(rY), np.asarray(rZ))
+
+
+def sum_g1_device(points: list) -> Point:
+    """Device point sum (unit-scalar MSM): sum_i points[i]."""
+    return msm_g1_device(points, [1] * len(points))

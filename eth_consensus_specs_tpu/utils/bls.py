@@ -97,7 +97,7 @@ def FastAggregateVerify(pks: list, message: bytes, sig: bytes) -> bool:
     if _backend == "tpu":
         from eth_consensus_specs_tpu.ops import bls_batch
 
-        return bls_batch.fast_aggregate_verify_host_pairing(
+        return bls_batch.fast_aggregate_verify_device(
             [bytes(p) for p in pks], bytes(message), bytes(sig)
         )
     return _sig.fast_aggregate_verify([bytes(p) for p in pks], bytes(message), bytes(sig))

@@ -1,0 +1,137 @@
+"""Device G1 MSM kernel (ops/g1_msm) vs the host Pippenger oracle, and the
+live batch-verification seam it feeds (one RLC pairing per block)."""
+
+import random
+
+import pytest
+
+from eth_consensus_specs_tpu.crypto.curve import g1_generator, g1_infinity
+from eth_consensus_specs_tpu.crypto.fields import R
+from eth_consensus_specs_tpu.crypto.msm import msm_g1
+from eth_consensus_specs_tpu.ops.bls_batch import batch_verify_aggregates
+from eth_consensus_specs_tpu.ops.g1_msm import msm_g1_device
+from eth_consensus_specs_tpu.utils import bls
+
+G = g1_generator()
+
+
+def _random_points(rng, n):
+    return [G.mul(rng.randrange(1, R)) for _ in range(n)]
+
+
+def test_msm_device_matches_host_oracle():
+    rng = random.Random(7)
+    pts = _random_points(rng, 8)
+    ks = [rng.randrange(R) for _ in range(8)]
+    assert msm_g1_device(pts, ks) == msm_g1(pts, ks)
+
+
+def test_msm_device_edge_cases():
+    assert msm_g1_device([], []) == g1_infinity()
+    assert msm_g1_device([G], [0]) == g1_infinity()
+    assert msm_g1_device([g1_infinity()], [12345]) == g1_infinity()
+    assert msm_g1_device([G], [1]) == G
+    assert msm_g1_device([G, G], [1, R - 1]) == g1_infinity()  # k + (r-k) = 0
+    assert msm_g1_device([G, G], [2, 3]) == G.mul(5)
+
+
+def test_msm_device_duplicate_points_and_small_scalars():
+    rng = random.Random(3)
+    p = G.mul(777)
+    pts = [p, p, p, G]
+    ks = [1, 1, 2, rng.randrange(R)]
+    assert msm_g1_device(pts, ks) == msm_g1(pts, ks)
+
+
+def test_fast_aggregate_verify_device_backend():
+    """bls.use_tpu() must execute the device kernel and still verify."""
+    from eth_consensus_specs_tpu.crypto import signature as sig_mod
+
+    prior_active, prior_backend = bls.bls_active, bls.backend_name()
+    bls.bls_active = True
+    bls.use_tpu()
+    try:
+        sks = [11, 22, 33]
+        msg = b"batched world"
+        pks = [sig_mod.sk_to_pk(sk) for sk in sks]
+        agg = bls.Aggregate([bls.Sign(sk, msg) for sk in sks])
+        assert bls.FastAggregateVerify(pks, msg, agg)
+        assert not bls.FastAggregateVerify(pks, msg + b"!", agg)
+    finally:
+        bls.bls_active = prior_active
+        if prior_backend == "pyspec":
+            bls.use_pyspec()
+
+
+@pytest.mark.parametrize("backend", ["pyspec", "tpu"])
+def test_batch_verify_aggregates(backend):
+    from eth_consensus_specs_tpu.crypto import signature as sig_mod
+
+    prior_backend = bls.backend_name()
+    getattr(bls, f"use_{backend}")()
+    try:
+        items = []
+        for group in ([1, 2], [3, 4, 5], [6]):
+            msg = bytes([len(group)]) * 32
+            pks = [sig_mod.sk_to_pk(sk) for sk in group]
+            sigs = [sig_mod.sign(sk, msg) for sk in group]
+            items.append((pks, msg, sig_mod.aggregate(sigs)))
+        assert batch_verify_aggregates(items)
+        # one tampered signature sinks the whole batch
+        bad = list(items)
+        bad[1] = (bad[1][0], bad[1][1], bad[0][2])
+        assert not batch_verify_aggregates(bad)
+        assert batch_verify_aggregates([])
+    finally:
+        getattr(bls, f"use_{prior_backend}")()
+
+
+def test_block_attestations_batch_seam():
+    """A block carrying several signed attestations verifies through the
+    batch path (preverified flag live during process_attestation), and a
+    corrupted signature still fails at the spec assertion."""
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    from eth_consensus_specs_tpu.forks import get_spec
+    from eth_consensus_specs_tpu.test_infra.attestations import (
+        get_valid_attestations_at_slot,
+    )
+    from eth_consensus_specs_tpu.test_infra.block import (
+        build_empty_block_for_next_slot,
+        state_transition_and_sign_block,
+    )
+    from eth_consensus_specs_tpu.test_infra.context import (
+        default_activation_threshold,
+        default_balances,
+    )
+    from eth_consensus_specs_tpu.test_infra.genesis import create_genesis_state
+    from eth_consensus_specs_tpu.test_infra.state import next_slots
+
+    spec = get_spec("phase0", "minimal")
+    prior_active = bls.bls_active
+    bls.bls_active = False
+    state = create_genesis_state(
+        spec, default_balances(spec), default_activation_threshold(spec)
+    )
+    next_slots(spec, state, 1)
+    bls.bls_active = True
+    bls.use_tpu()
+    try:
+        attestations = get_valid_attestations_at_slot(
+            spec, state, int(state.slot), signed=True
+        )
+        assert len(attestations) >= 2
+        next_slots(spec, state, spec.MIN_ATTESTATION_INCLUSION_DELAY)
+        ok_state = state.copy()
+        assert spec._batch_verify_attestations(ok_state, attestations)
+        for attestation in attestations:
+            spec.process_attestation(ok_state, attestation)  # sequential path
+
+        # corrupt one signature: batch returns False, sequential rejects
+        bad = [a.copy() for a in attestations]
+        bad[1].signature = bad[0].signature
+        assert not spec._batch_verify_attestations(state, bad)
+    finally:
+        bls.bls_active = prior_active
+        bls.use_pyspec()
