@@ -108,6 +108,11 @@ class FrozenNamespace:
     def __eq__(self, other):
         return isinstance(other, FrozenNamespace) and other.as_dict() == self.as_dict()
 
+    def __hash__(self):
+        # immutable by construction; hashable so override sets containing
+        # nested namespaces (e.g. BLOB_SCHEDULE entries) can key lru caches
+        return hash(tuple(sorted(self._values.items())))
+
 
 def _load_yaml(path: str) -> dict:
     # BaseLoader keeps every scalar a string so unquoted 0x-hex survives

@@ -490,13 +490,27 @@ class DenebSpec(CapellaSpec):
     # == data availability (specs/deneb/fork-choice.md) ====================
 
     def retrieve_blobs_and_proofs(self, beacon_block_root):
-        """Networking-dependent blob retrieval; tests monkeypatch (the
-        reference injects the same stub, pysetup/spec_builders/deneb.py)."""
-        raise NotImplementedError("requires the blob-sidecar network layer")
+        """Networking-dependent blob retrieval; tests override this method
+        (the reference monkeypatches the same stub,
+        pysetup/spec_builders/deneb.py + helpers/fork_choice.py:51-108).
+        Default: nothing retrievable — blocks carrying commitments fail the
+        availability gate until data is supplied."""
+        return [], []
 
     def is_data_available(self, beacon_block_root, blob_kzg_commitments) -> bool:
         blobs, proofs = self.retrieve_blobs_and_proofs(beacon_block_root)
+        if len(blobs) != len(blob_kzg_commitments) or len(proofs) != len(
+            blob_kzg_commitments
+        ):
+            # retrieval shortfall is unavailability, not a malformed batch
+            return False
         return self.verify_blob_kzg_proof_batch(blobs, blob_kzg_commitments, proofs)
+
+    def _data_availability_check(self, block) -> None:
+        # [New in Deneb:EIP4844] (specs/deneb/fork-choice.md:54-63)
+        assert self.is_data_available(
+            hash_tree_root(block), block.body.blob_kzg_commitments
+        ), "blob data not available"
 
     def verify_blob_sidecar_inclusion_proof(self, blob_sidecar) -> bool:
         # gindex of blob_kzg_commitments[index] inside BeaconBlockBody:

@@ -1,0 +1,558 @@
+"""fulu: PeerDAS (EIP-7594) — cells, data-column sidecars, custody groups,
+erasure-coded recovery — plus the blob schedule (EIP-7892) and precomputed
+proposer lookahead (EIP-7917).
+
+Behavioral parity targets (reference, by section):
+  * state machine:  specs/fulu/beacon-chain.md (blob-schedule payload gate
+    :63-115, proposer_lookahead state field :134-175, get_blob_parameters
+    :193-200, fork-digest bitmask :209-235, proposer-indices lookahead
+    :241-327)
+  * DAS core:       specs/fulu/das-core.md (custody groups :101-134,
+    compute_matrix/recover_matrix :140-189, DataColumnSidecar :77-94)
+  * sampling KZG:   specs/fulu/polynomial-commitments-sampling.md —
+    implemented in crypto/das.py, re-exposed as spec methods here
+  * fork choice:    specs/fulu/fork-choice.md (column-sampled
+    is_data_available :19-34)
+  * p2p checks:     specs/fulu/p2p-interface.md (sidecar validity :109-175)
+  * validator:      specs/fulu/validator.md (sidecar construction :207-265)
+  * fork upgrade:   specs/fulu/fork.md (initialize_proposer_lookahead
+    :27-44, upgrade_to_fulu :53-110)
+
+TPU-first notes: the DAS math (field FFTs, FK20 lag-MSMs, batched cell
+verification) lives in crypto/das.py in flat-vector form — see that
+module's docstring for how it diverges from the reference's recursive
+formulation. The per-epoch proposer lookahead turns the hot
+`get_beacon_proposer_index` path into a table read, which also removes a
+per-slot shuffle dependency from the jitted slot loop.
+"""
+
+from dataclasses import dataclass
+
+from eth_consensus_specs_tpu.crypto import das as _das
+from eth_consensus_specs_tpu.ssz import (
+    ByteVector,
+    Bytes32,
+    Container,
+    List,
+    Vector,
+    hash_tree_root,
+    uint64,
+)
+
+from .deneb import KZGCommitment, KZGProof
+from .electra import ElectraSpec
+from .phase0 import Root, ValidatorIndex, Version
+
+RowIndex = uint64
+ColumnIndex = uint64
+CellIndex = uint64
+CustodyIndex = uint64
+CommitmentIndex = uint64
+
+
+class FuluSpec(ElectraSpec):
+    fork_name = "fulu"
+
+    # das-core constants (specs/fulu/das-core.md:35-45)
+    UINT256_MAX = 2**256 - 1
+    RANDOM_CHALLENGE_KZG_CELL_BATCH_DOMAIN = _das.RANDOM_CHALLENGE_KZG_CELL_BATCH_DOMAIN
+    BYTES_PER_CELL = _das.BYTES_PER_CELL
+
+    # == type system ======================================================
+
+    def _build_types(self) -> None:
+        super()._build_types()
+        P = self
+
+        Cell = ByteVector[P.BYTES_PER_FIELD_ELEMENT * P.FIELD_ELEMENTS_PER_CELL]
+        self.Cell = Cell
+
+        class BeaconState(Container):
+            genesis_time: uint64
+            genesis_validators_root: Root
+            slot: P.BeaconState.fields()["slot"]
+            fork: P.Fork
+            latest_block_header: P.BeaconBlockHeader
+            block_roots: P.BeaconState.fields()["block_roots"]
+            state_roots: P.BeaconState.fields()["state_roots"]
+            historical_roots: P.BeaconState.fields()["historical_roots"]
+            eth1_data: P.Eth1Data
+            eth1_data_votes: P.BeaconState.fields()["eth1_data_votes"]
+            eth1_deposit_index: uint64
+            validators: P.BeaconState.fields()["validators"]
+            balances: P.BeaconState.fields()["balances"]
+            randao_mixes: P.BeaconState.fields()["randao_mixes"]
+            slashings: P.BeaconState.fields()["slashings"]
+            previous_epoch_participation: P.BeaconState.fields()[
+                "previous_epoch_participation"
+            ]
+            current_epoch_participation: P.BeaconState.fields()[
+                "current_epoch_participation"
+            ]
+            justification_bits: P.BeaconState.fields()["justification_bits"]
+            previous_justified_checkpoint: P.Checkpoint
+            current_justified_checkpoint: P.Checkpoint
+            finalized_checkpoint: P.Checkpoint
+            inactivity_scores: P.BeaconState.fields()["inactivity_scores"]
+            current_sync_committee: P.SyncCommittee
+            next_sync_committee: P.SyncCommittee
+            latest_execution_payload_header: P.ExecutionPayloadHeader
+            next_withdrawal_index: P.BeaconState.fields()["next_withdrawal_index"]
+            next_withdrawal_validator_index: P.BeaconState.fields()[
+                "next_withdrawal_validator_index"
+            ]
+            historical_summaries: P.BeaconState.fields()["historical_summaries"]
+            deposit_requests_start_index: uint64
+            deposit_balance_to_consume: P.BeaconState.fields()["deposit_balance_to_consume"]
+            exit_balance_to_consume: P.BeaconState.fields()["exit_balance_to_consume"]
+            earliest_exit_epoch: P.BeaconState.fields()["earliest_exit_epoch"]
+            consolidation_balance_to_consume: P.BeaconState.fields()[
+                "consolidation_balance_to_consume"
+            ]
+            earliest_consolidation_epoch: P.BeaconState.fields()[
+                "earliest_consolidation_epoch"
+            ]
+            pending_deposits: P.BeaconState.fields()["pending_deposits"]
+            pending_partial_withdrawals: P.BeaconState.fields()[
+                "pending_partial_withdrawals"
+            ]
+            pending_consolidations: P.BeaconState.fields()["pending_consolidations"]
+            # [New in Fulu:EIP7917]
+            proposer_lookahead: Vector[
+                ValidatorIndex, (P.MIN_SEED_LOOKAHEAD + 1) * P.SLOTS_PER_EPOCH
+            ]
+
+        # specs/fulu/das-core.md:77-84
+        class DataColumnSidecar(Container):
+            index: ColumnIndex
+            column: List[Cell, P.MAX_BLOB_COMMITMENTS_PER_BLOCK]
+            kzg_commitments: List[KZGCommitment, P.MAX_BLOB_COMMITMENTS_PER_BLOCK]
+            kzg_proofs: List[KZGProof, P.MAX_BLOB_COMMITMENTS_PER_BLOCK]
+            signed_block_header: P.SignedBeaconBlockHeader
+            kzg_commitments_inclusion_proof: Vector[
+                Bytes32, P.KZG_COMMITMENTS_INCLUSION_PROOF_DEPTH
+            ]
+
+        # specs/fulu/das-core.md:89-94
+        class MatrixEntry(Container):
+            cell: Cell
+            kzg_proof: KZGProof
+            column_index: ColumnIndex
+            row_index: RowIndex
+
+        # specs/fulu/p2p-interface.md (req/resp identifier)
+        class DataColumnsByRootIdentifier(Container):
+            block_root: Root
+            columns: List[ColumnIndex, P.NUMBER_OF_COLUMNS]
+
+        for name, typ in list(locals().items()):
+            if isinstance(typ, type) and issubclass(typ, Container):
+                typ.__name__ = name
+                setattr(self, name, typ)
+
+    # == blob schedule (EIP-7892) =========================================
+
+    @dataclass
+    class BlobParameters:
+        epoch: int
+        max_blobs_per_block: int
+
+    def get_blob_parameters(self, epoch: int) -> "FuluSpec.BlobParameters":
+        """specs/fulu/beacon-chain.md:193-200."""
+        schedule = getattr(self.config, "BLOB_SCHEDULE", ())
+        for entry in sorted(schedule, key=lambda e: int(e["EPOCH"]), reverse=True):
+            if epoch >= int(entry["EPOCH"]):
+                return self.BlobParameters(int(entry["EPOCH"]), int(entry["MAX_BLOBS_PER_BLOCK"]))
+        return self.BlobParameters(
+            int(self.config.ELECTRA_FORK_EPOCH), int(self.config.MAX_BLOBS_PER_BLOCK_ELECTRA)
+        )
+
+    def max_blobs_per_block(self) -> int:
+        """Largest scheduled limit — used only for static sizing; the
+        consensus gate is epoch-aware (process_execution_payload)."""
+        schedule = getattr(self.config, "BLOB_SCHEDULE", ())
+        limits = [int(e["MAX_BLOBS_PER_BLOCK"]) for e in schedule]
+        return max([int(self.config.MAX_BLOBS_PER_BLOCK_ELECTRA)] + limits)
+
+    def compute_fork_digest(self, genesis_validators_root, epoch=None):
+        """[Modified in Fulu:EIP7892] Blob-parameters-aware digest
+        (specs/fulu/beacon-chain.md:209-235). Falls back to the legacy
+        (version, root) signature when called pre-fulu-style."""
+        if epoch is None or isinstance(genesis_validators_root, (bytes, bytearray)) and len(
+            genesis_validators_root
+        ) == 4:
+            # legacy call shape: (current_version, genesis_validators_root)
+            return super().compute_fork_digest(genesis_validators_root, epoch)
+        fork_version = self.compute_fork_version(int(epoch))
+        base_digest = self.compute_fork_data_root(fork_version, genesis_validators_root)
+        blob_parameters = self.get_blob_parameters(int(epoch))
+        mask = self.hash(
+            self.uint_to_bytes(int(blob_parameters.epoch), 8)
+            + self.uint_to_bytes(int(blob_parameters.max_blobs_per_block), 8)
+        )
+        return bytes(a ^ b for a, b in zip(bytes(base_digest), mask))[:4]
+
+    # == proposer lookahead (EIP-7917) ====================================
+
+    def compute_proposer_indices(self, state, epoch: int, seed: bytes, indices):
+        """specs/fulu/beacon-chain.md:241-250."""
+        start_slot = self.compute_start_slot_at_epoch(int(epoch))
+        seeds = [
+            self.hash(seed + self.uint_to_bytes(int(start_slot + i), 8))
+            for i in range(self.SLOTS_PER_EPOCH)
+        ]
+        return [self.compute_proposer_index(state, indices, s) for s in seeds]
+
+    def get_beacon_proposer_indices(self, state, epoch: int):
+        """specs/fulu/beacon-chain.md:270-279."""
+        indices = self.get_active_validator_indices(state, int(epoch))
+        seed = self.get_seed(state, int(epoch), self.DOMAIN_BEACON_PROPOSER)
+        return self.compute_proposer_indices(state, int(epoch), seed, indices)
+
+    def get_beacon_proposer_index(self, state) -> int:
+        """[Modified in Fulu:EIP7917] table read instead of on-demand
+        shuffle (specs/fulu/beacon-chain.md:260-265)."""
+        return int(state.proposer_lookahead[int(state.slot) % self.SLOTS_PER_EPOCH])
+
+    def initialize_proposer_lookahead(self, state):
+        """specs/fulu/fork.md:27-44."""
+        current_epoch = self.get_current_epoch(state)
+        lookahead = []
+        for i in range(self.MIN_SEED_LOOKAHEAD + 1):
+            lookahead.extend(self.get_beacon_proposer_indices(state, current_epoch + i))
+        return lookahead
+
+    def process_proposer_lookahead(self, state) -> None:
+        """specs/fulu/beacon-chain.md:318-327."""
+        last_epoch_start = len(state.proposer_lookahead) - self.SLOTS_PER_EPOCH
+        full = list(state.proposer_lookahead)
+        full[:last_epoch_start] = full[self.SLOTS_PER_EPOCH :]
+        last_epoch_proposers = self.get_beacon_proposer_indices(
+            state, self.get_current_epoch(state) + self.MIN_SEED_LOOKAHEAD + 1
+        )
+        full[last_epoch_start:] = last_epoch_proposers
+        state.proposer_lookahead = full
+
+    # == epoch processing ==================================================
+
+    def process_epoch(self, state) -> None:
+        """specs/fulu/beacon-chain.md:290-307 — electra ordering plus the
+        lookahead shift."""
+        super().process_epoch(state)
+        # [New in Fulu:EIP7917]
+        self.process_proposer_lookahead(state)
+
+    # == block processing ==================================================
+
+    def process_execution_payload(self, state, body, execution_engine) -> None:
+        """[Modified in Fulu:EIP7892] blob cap comes from the schedule
+        (specs/fulu/beacon-chain.md:63-115)."""
+        payload = body.execution_payload
+        assert (
+            payload.parent_hash == state.latest_execution_payload_header.block_hash
+        ), "payload parent mismatch"
+        assert payload.prev_randao == self.get_randao_mix(
+            state, self.get_current_epoch(state)
+        ), "wrong prev_randao"
+        assert payload.timestamp == self.compute_timestamp_at_slot(
+            state, state.slot
+        ), "wrong payload timestamp"
+        # [Modified in Fulu:EIP7892]
+        assert (
+            len(body.blob_kzg_commitments)
+            <= self.get_blob_parameters(self.get_current_epoch(state)).max_blobs_per_block
+        ), "too many blobs"
+        versioned_hashes = [
+            self.kzg_commitment_to_versioned_hash(commitment)
+            for commitment in body.blob_kzg_commitments
+        ]
+        assert execution_engine.verify_and_notify_new_payload(
+            self.NewPayloadRequest(
+                execution_payload=payload,
+                versioned_hashes=versioned_hashes,
+                parent_beacon_block_root=state.latest_block_header.parent_root,
+                execution_requests=body.execution_requests,
+            )
+        ), "execution engine rejected payload"
+        state.latest_execution_payload_header = self.execution_payload_to_header(payload)
+
+    # == DAS KZG surface (delegates to crypto/das) =========================
+
+    @staticmethod
+    def compute_cells(blob):
+        return _das.compute_cells(bytes(blob))
+
+    @staticmethod
+    def compute_cells_and_kzg_proofs(blob):
+        return _das.compute_cells_and_kzg_proofs(bytes(blob))
+
+    @staticmethod
+    def verify_cell_kzg_proof_batch(commitments_bytes, cell_indices, cells, proofs_bytes):
+        return _das.verify_cell_kzg_proof_batch(
+            [bytes(c) for c in commitments_bytes],
+            [int(i) for i in cell_indices],
+            [bytes(c) for c in cells],
+            [bytes(p) for p in proofs_bytes],
+        )
+
+    @staticmethod
+    def recover_cells_and_kzg_proofs(cell_indices, cells):
+        return _das.recover_cells_and_kzg_proofs(
+            [int(i) for i in cell_indices], [bytes(c) for c in cells]
+        )
+
+    @staticmethod
+    def cell_to_coset_evals(cell):
+        return _das.cell_to_coset_evals(bytes(cell))
+
+    @staticmethod
+    def coset_evals_to_cell(evals):
+        return _das.coset_evals_to_cell(list(evals))
+
+    @staticmethod
+    def coset_for_cell(cell_index: int):
+        return _das.coset_for_cell(int(cell_index))
+
+    # == custody (specs/fulu/das-core.md:101-134) ==========================
+
+    def get_custody_groups(self, node_id: int, custody_group_count: int):
+        assert custody_group_count <= self.config.NUMBER_OF_CUSTODY_GROUPS
+        if custody_group_count == self.config.NUMBER_OF_CUSTODY_GROUPS:
+            return list(range(self.config.NUMBER_OF_CUSTODY_GROUPS))
+
+        current_id = int(node_id)
+        custody_groups: list[int] = []
+        while len(custody_groups) < custody_group_count:
+            digest = self.hash(current_id.to_bytes(32, "little"))
+            custody_group = self.bytes_to_uint64(digest[0:8]) % self.config.NUMBER_OF_CUSTODY_GROUPS
+            if custody_group not in custody_groups:
+                custody_groups.append(custody_group)
+            if current_id == self.UINT256_MAX:
+                current_id = 0
+            else:
+                current_id += 1
+        assert len(custody_groups) == len(set(custody_groups))
+        return sorted(custody_groups)
+
+    def compute_columns_for_custody_group(self, custody_group: int):
+        assert custody_group < self.config.NUMBER_OF_CUSTODY_GROUPS
+        columns_per_group = self.NUMBER_OF_COLUMNS // self.config.NUMBER_OF_CUSTODY_GROUPS
+        return [
+            self.config.NUMBER_OF_CUSTODY_GROUPS * i + custody_group
+            for i in range(columns_per_group)
+        ]
+
+    def get_sampling_columns(self, node_id: int, custody_group_count: int):
+        """Custody sampling (specs/fulu/das-core.md:220-230): sample
+        max(SAMPLES_PER_SLOT, cgc) groups' columns."""
+        sampling_size = max(self.config.SAMPLES_PER_SLOT, custody_group_count)
+        groups = self.get_custody_groups(node_id, sampling_size)
+        out: list[int] = []
+        for group in groups:
+            out.extend(self.compute_columns_for_custody_group(group))
+        return sorted(out)
+
+    # == matrix (specs/fulu/das-core.md:140-189) ===========================
+
+    def compute_matrix(self, blobs):
+        matrix = []
+        for blob_index, blob in enumerate(blobs):
+            cells, proofs = self.compute_cells_and_kzg_proofs(blob)
+            for cell_index, (cell, proof) in enumerate(zip(cells, proofs)):
+                matrix.append(
+                    self.MatrixEntry(
+                        cell=cell,
+                        kzg_proof=proof,
+                        row_index=blob_index,
+                        column_index=cell_index,
+                    )
+                )
+        return matrix
+
+    def recover_matrix(self, partial_matrix, blob_count: int):
+        matrix = []
+        for blob_index in range(int(blob_count)):
+            cell_indices = [
+                int(e.column_index) for e in partial_matrix if int(e.row_index) == blob_index
+            ]
+            cells = [bytes(e.cell) for e in partial_matrix if int(e.row_index) == blob_index]
+            recovered_cells, recovered_proofs = self.recover_cells_and_kzg_proofs(
+                cell_indices, cells
+            )
+            for cell_index, (cell, proof) in enumerate(zip(recovered_cells, recovered_proofs)):
+                matrix.append(
+                    self.MatrixEntry(
+                        cell=cell,
+                        kzg_proof=proof,
+                        row_index=blob_index,
+                        column_index=cell_index,
+                    )
+                )
+        return matrix
+
+    # == sidecar validity (specs/fulu/p2p-interface.md:109-175) ============
+
+    def verify_data_column_sidecar(self, sidecar) -> bool:
+        if sidecar.index >= self.NUMBER_OF_COLUMNS:
+            return False
+        if len(sidecar.kzg_commitments) == 0:
+            return False
+        epoch = self.compute_epoch_at_slot(int(sidecar.signed_block_header.message.slot))
+        if len(sidecar.kzg_commitments) > self.get_blob_parameters(epoch).max_blobs_per_block:
+            return False
+        if len(sidecar.column) != len(sidecar.kzg_commitments) or len(sidecar.column) != len(
+            sidecar.kzg_proofs
+        ):
+            return False
+        return True
+
+    def verify_data_column_sidecar_kzg_proofs(self, sidecar) -> bool:
+        cell_indices = [int(sidecar.index)] * len(sidecar.column)
+        return self.verify_cell_kzg_proof_batch(
+            commitments_bytes=list(sidecar.kzg_commitments),
+            cell_indices=cell_indices,
+            cells=list(sidecar.column),
+            proofs_bytes=list(sidecar.kzg_proofs),
+        )
+
+    def verify_data_column_sidecar_inclusion_proof(self, sidecar) -> bool:
+        field_index = list(self.BeaconBlockBody.fields()).index("blob_kzg_commitments")
+        return self.is_valid_merkle_branch(
+            leaf=hash_tree_root(sidecar.kzg_commitments),
+            branch=sidecar.kzg_commitments_inclusion_proof,
+            depth=self.KZG_COMMITMENTS_INCLUSION_PROOF_DEPTH,
+            index=field_index,
+            root=sidecar.signed_block_header.message.body_root,
+        )
+
+    def compute_subnet_for_data_column_sidecar(self, column_index: int) -> int:
+        return int(column_index) % self.config.DATA_COLUMN_SIDECAR_SUBNET_COUNT
+
+    # == sidecar construction (specs/fulu/validator.md:207-265) ============
+
+    def compute_signed_block_header(self, signed_block):
+        block = signed_block.message
+        block_header = self.BeaconBlockHeader(
+            slot=block.slot,
+            proposer_index=block.proposer_index,
+            parent_root=block.parent_root,
+            state_root=block.state_root,
+            body_root=hash_tree_root(block.body),
+        )
+        return self.SignedBeaconBlockHeader(
+            message=block_header, signature=signed_block.signature
+        )
+
+    def get_data_column_sidecars(
+        self,
+        signed_block_header,
+        kzg_commitments,
+        kzg_commitments_inclusion_proof,
+        cells_and_kzg_proofs,
+    ):
+        assert len(cells_and_kzg_proofs) == len(kzg_commitments)
+        sidecars = []
+        for column_index in range(self.NUMBER_OF_COLUMNS):
+            column_cells, column_proofs = [], []
+            for cells, proofs in cells_and_kzg_proofs:
+                column_cells.append(cells[column_index])
+                column_proofs.append(proofs[column_index])
+            sidecars.append(
+                self.DataColumnSidecar(
+                    index=column_index,
+                    column=column_cells,
+                    kzg_commitments=list(kzg_commitments),
+                    kzg_proofs=column_proofs,
+                    signed_block_header=signed_block_header,
+                    kzg_commitments_inclusion_proof=kzg_commitments_inclusion_proof,
+                )
+            )
+        return sidecars
+
+    def get_data_column_sidecars_from_block(self, signed_block, cells_and_kzg_proofs):
+        from eth_consensus_specs_tpu.ssz.merkle import compute_merkle_proof
+
+        body = signed_block.message.body
+        field_index = list(type(body).fields()).index("blob_kzg_commitments")
+        fields_depth = (len(type(body).fields()) - 1).bit_length()
+        gindex = (1 << fields_depth) | field_index
+        return self.get_data_column_sidecars(
+            self.compute_signed_block_header(signed_block),
+            list(body.blob_kzg_commitments),
+            compute_merkle_proof(body, gindex),
+            cells_and_kzg_proofs,
+        )
+
+    # == data availability (specs/fulu/fork-choice.md:19-34) ===============
+
+    def retrieve_column_sidecars(self, beacon_block_root):
+        """Implementation/context dependent; tests register a retriever
+        (the reference monkeypatches the same seam)."""
+        retriever = getattr(self, "_column_retriever", None)
+        if retriever is not None:
+            return retriever(beacon_block_root)
+        return []
+
+    def is_data_available(self, beacon_block_root, blob_kzg_commitments=None) -> bool:
+        """[Modified in Fulu:EIP7594] sample columns, not blobs."""
+        column_sidecars = self.retrieve_column_sidecars(beacon_block_root)
+        return all(
+            self.verify_data_column_sidecar(column_sidecar)
+            and self.verify_data_column_sidecar_kzg_proofs(column_sidecar)
+            for column_sidecar in column_sidecars
+        )
+
+    def _data_availability_check(self, block) -> None:
+        # [Modified in Fulu:EIP7594] no commitments argument
+        assert self.is_data_available(hash_tree_root(block)), "column data not available"
+
+    # == fork upgrade (specs/fulu/fork.md:53-110) ==========================
+
+    def upgrade_from_parent(self, pre):
+        epoch = self.compute_epoch_at_slot(int(pre.slot))
+        post = self.BeaconState(
+            genesis_time=pre.genesis_time,
+            genesis_validators_root=pre.genesis_validators_root,
+            slot=pre.slot,
+            fork=self.Fork(
+                previous_version=pre.fork.current_version,
+                current_version=Version(self.config.FULU_FORK_VERSION),
+                epoch=epoch,
+            ),
+            latest_block_header=pre.latest_block_header,
+            block_roots=list(pre.block_roots),
+            state_roots=list(pre.state_roots),
+            historical_roots=list(pre.historical_roots),
+            eth1_data=pre.eth1_data,
+            eth1_data_votes=list(pre.eth1_data_votes),
+            eth1_deposit_index=pre.eth1_deposit_index,
+            validators=list(pre.validators),
+            balances=list(pre.balances),
+            randao_mixes=list(pre.randao_mixes),
+            slashings=list(pre.slashings),
+            previous_epoch_participation=list(pre.previous_epoch_participation),
+            current_epoch_participation=list(pre.current_epoch_participation),
+            justification_bits=list(pre.justification_bits),
+            previous_justified_checkpoint=pre.previous_justified_checkpoint,
+            current_justified_checkpoint=pre.current_justified_checkpoint,
+            finalized_checkpoint=pre.finalized_checkpoint,
+            inactivity_scores=list(pre.inactivity_scores),
+            current_sync_committee=pre.current_sync_committee,
+            next_sync_committee=pre.next_sync_committee,
+            latest_execution_payload_header=pre.latest_execution_payload_header,
+            next_withdrawal_index=pre.next_withdrawal_index,
+            next_withdrawal_validator_index=pre.next_withdrawal_validator_index,
+            historical_summaries=list(pre.historical_summaries),
+            deposit_requests_start_index=pre.deposit_requests_start_index,
+            deposit_balance_to_consume=pre.deposit_balance_to_consume,
+            exit_balance_to_consume=pre.exit_balance_to_consume,
+            earliest_exit_epoch=pre.earliest_exit_epoch,
+            consolidation_balance_to_consume=pre.consolidation_balance_to_consume,
+            earliest_consolidation_epoch=pre.earliest_consolidation_epoch,
+            pending_deposits=list(pre.pending_deposits),
+            pending_partial_withdrawals=list(pre.pending_partial_withdrawals),
+            pending_consolidations=list(pre.pending_consolidations),
+            # [New in Fulu:EIP7917]
+            proposer_lookahead=self.initialize_proposer_lookahead(pre),
+        )
+        return post

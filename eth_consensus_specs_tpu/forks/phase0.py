@@ -392,13 +392,19 @@ class Phase0Spec:
 
         items = []
         for attestation in attestations:
-            indexed = self.get_indexed_attestation(state, attestation)
-            indices = list(indexed.attesting_indices)
-            if len(indices) == 0 or indices != sorted(set(indices)):
+            try:
+                indexed = self.get_indexed_attestation(state, attestation)
+                indices = list(indexed.attesting_indices)
+                if len(indices) == 0 or indices != sorted(set(indices)):
+                    return False
+                pubkeys, signing_root = self._indexed_attestation_signature_inputs(
+                    state, indexed
+                )
+            except (AssertionError, IndexError, KeyError, ValueError):
+                # malformed attestation (bad committee index, oversized
+                # bitlist, ...): not proven here — the sequential path
+                # rejects it at the exact spec assertion
                 return False
-            pubkeys, signing_root = self._indexed_attestation_signature_inputs(
-                state, indexed
-            )
             items.append(
                 ([bytes(pk) for pk in pubkeys], bytes(signing_root), bytes(indexed.signature))
             )
@@ -1660,6 +1666,11 @@ class Phase0Spec:
             == store.finalized_checkpoint.root
         ), "block does not descend from finalized root"
 
+        # data-availability gate: no-op pre-deneb; blob proofs in deneb+
+        # (specs/deneb/fork-choice.md:54-63), column sampling in fulu+
+        # (specs/fulu/fork-choice.md:38)
+        self._data_availability_check(block)
+
         self.state_transition(state, signed_block, True)
 
         block_root = hash_tree_root(block)
@@ -1681,6 +1692,9 @@ class Phase0Spec:
             store, state.current_justified_checkpoint, state.finalized_checkpoint
         )
         self.compute_pulled_up_tip(store, block_root)
+
+    def _data_availability_check(self, block) -> None:
+        """Fork-choice data-availability gate; phase0 has no blob data."""
 
     def validate_target_epoch_against_current_time(self, store, attestation) -> None:
         target = attestation.data.target

@@ -7,7 +7,7 @@ from eth_consensus_specs_tpu.ssz import hash_tree_root
 from eth_consensus_specs_tpu.utils import bls
 
 from .execution_payload import build_empty_execution_payload
-from .forks import is_post_altair, is_post_bellatrix
+from .forks import is_post_altair, is_post_bellatrix, is_post_gloas
 from .keys import privkeys
 from .state import latest_block_root
 
@@ -34,9 +34,39 @@ def build_empty_block(spec, state, slot=None, proposer_index=None):
     if is_post_altair(spec):
         # an empty sync aggregate is valid only with the infinity signature
         block.body.sync_aggregate.sync_committee_signature = bls.G2_POINT_AT_INFINITY
-    if is_post_bellatrix(spec):
+    if is_post_gloas(spec):
+        # [New in Gloas:EIP7732] blocks commit to a bid, not a payload;
+        # tests default to a zero-value self-build (reference:
+        # helpers/execution_payload.py build_empty_signed_execution_payload_bid)
+        block.body.signed_execution_payload_bid = build_empty_signed_execution_payload_bid(
+            spec, lookahead_state, block
+        )
+    elif is_post_bellatrix(spec):
         block.body.execution_payload = build_empty_execution_payload(spec, lookahead_state)
     return block
+
+
+def build_empty_signed_execution_payload_bid(spec, state, block):
+    """Zero-value self-build bid consistent with `state` at the block's
+    slot (specs/gloas/beacon-chain.md:947-1006 self-build path)."""
+    from eth_consensus_specs_tpu.ssz import List
+
+    empty_commitments = List[spec.KZGCommitment, spec.MAX_BLOB_COMMITMENTS_PER_BLOCK]([])
+    bid = spec.ExecutionPayloadBid(
+        parent_block_hash=state.latest_block_hash,
+        parent_block_root=block.parent_root,
+        block_hash=spec.hash(
+            bytes(state.latest_block_hash) + int(block.slot).to_bytes(8, "little")
+        ),
+        prev_randao=spec.get_randao_mix(state, spec.get_current_epoch(state)),
+        gas_limit=0,
+        builder_index=block.proposer_index,
+        slot=block.slot,
+        value=0,
+        execution_payment=0,
+        blob_kzg_commitments_root=hash_tree_root(empty_commitments),
+    )
+    return spec.SignedExecutionPayloadBid(message=bid, signature=bls.G2_POINT_AT_INFINITY)
 
 
 def build_empty_block_for_next_slot(spec, state):
@@ -55,6 +85,48 @@ def sign_block(spec, state, block, proposer_index=None):
     return spec.SignedBeaconBlock(message=block, signature=signature)
 
 
+def build_signed_execution_payload_envelope(spec, state, withdrawals=()):
+    """Builder envelope fulfilling the committed bid on `state` (call right
+    after importing the block that carried the bid). Matches
+    specs/gloas/beacon-chain.md:1228-1318's consistency checks; the
+    envelope state_root is produced by a verify=False dry run, mirroring
+    the reference helper (test/helpers/execution_payload.py)."""
+    bid = state.latest_execution_payload_bid
+    payload = spec.ExecutionPayload(
+        parent_hash=state.latest_block_hash,
+        fee_recipient=bid.fee_recipient,
+        prev_randao=bid.prev_randao,
+        block_number=1,
+        gas_limit=bid.gas_limit,
+        gas_used=0,
+        timestamp=spec.compute_timestamp_at_slot(state, state.slot),
+        base_fee_per_gas=0,
+        block_hash=bid.block_hash,
+        transactions=[],
+        withdrawals=list(withdrawals),
+    )
+    header_state = state.copy()
+    if bytes(header_state.latest_block_header.state_root) == b"\x00" * 32:
+        header_state.latest_block_header.state_root = hash_tree_root(header_state)
+    envelope = spec.ExecutionPayloadEnvelope(
+        payload=payload,
+        builder_index=bid.builder_index,
+        beacon_block_root=hash_tree_root(header_state.latest_block_header),
+        slot=state.slot,
+        blob_kzg_commitments=[],
+    )
+    # dry-run to obtain the post-envelope state root
+    trial = state.copy()
+    unsigned = spec.SignedExecutionPayloadEnvelope(message=envelope)
+    spec.process_execution_payload(trial, unsigned, spec.EXECUTION_ENGINE, verify=False)
+    envelope.state_root = hash_tree_root(trial)
+
+    privkey = privkeys[int(bid.builder_index)]
+    domain = spec.get_domain(state, spec.DOMAIN_BEACON_BUILDER)
+    signature = bls.Sign(privkey, spec.compute_signing_root(envelope, domain))
+    return spec.SignedExecutionPayloadEnvelope(message=envelope, signature=signature)
+
+
 def transition_unsigned_block(spec, state, block):
     assert state.slot < block.slot or state.slot == block.slot
     if state.slot < block.slot:
@@ -64,8 +136,17 @@ def transition_unsigned_block(spec, state, block):
 
 def state_transition_and_sign_block(spec, state, block, expect_fail: bool = False):
     """Fill in the post-state root, sign, and run the full transition on
-    `state` (reference: helpers/state.py transition_and_sign_block)."""
+    `state` (reference: helpers/state.py transition_and_sign_block). With
+    `expect_fail` the transition must be invalid (assert/overflow), the
+    state is left untouched, and the signed (invalid) block is returned."""
+    from .context import expect_assertion_error
+
     pre_state = state.copy()
+    if expect_fail:
+        expect_assertion_error(
+            lambda: transition_unsigned_block(spec, state.copy(), block)
+        )
+        return sign_block(spec, pre_state, block)
     temp_state = state.copy()
     transition_unsigned_block(spec, temp_state, block)
     block.state_root = hash_tree_root(temp_state)

@@ -18,6 +18,8 @@ from .forks import (
     is_post_altair,
     is_post_bellatrix,
     is_post_electra,
+    is_post_fulu,
+    is_post_gloas,
     previous_fork_version_of,
 )
 from .execution_payload import genesis_execution_payload_header
@@ -91,9 +93,23 @@ def create_genesis_state(spec, validator_balances: list[int], activation_thresho
         committee = spec.get_next_sync_committee(state)
         state.current_sync_committee = committee
         state.next_sync_committee = committee
-    if is_post_bellatrix(spec):
+    if is_post_gloas(spec):
+        # [New in Gloas:EIP7732] bid/hash pair marks the parent block full
+        # from genesis; availability starts all-set (specs/gloas/fork.md)
+        from .execution_payload import GENESIS_BLOCK_HASH
+
+        state.latest_execution_payload_bid = spec.ExecutionPayloadBid(
+            block_hash=Bytes32(GENESIS_BLOCK_HASH)
+        )
+        state.latest_block_hash = Bytes32(GENESIS_BLOCK_HASH)
+        state.execution_payload_availability = [1] * spec.SLOTS_PER_HISTORICAL_ROOT
+    elif is_post_bellatrix(spec):
         # non-empty header: merge complete from genesis in tests
         state.latest_execution_payload_header = genesis_execution_payload_header(spec)
     if is_post_electra(spec):
         state.deposit_requests_start_index = spec.UNSET_DEPOSIT_REQUESTS_START_INDEX
+    if is_post_fulu(spec):
+        # [New in Fulu:EIP7917] genesis fills the full lookahead window
+        # (specs/fulu/fork.md:27-44)
+        state.proposer_lookahead = spec.initialize_proposer_lookahead(state)
     return state

@@ -1,0 +1,30 @@
+"""Shared DAS fixtures: one blob's cells/proofs, computed once per process.
+
+compute_cells_and_kzg_proofs is ~1 min of host BLS work (63 lag-MSMs +
+a G1 FFT); every DAS test shares this single extended blob, mirroring how
+the reference suite reuses one `get_sample_blob` per class of cases."""
+
+from functools import lru_cache
+
+from eth_consensus_specs_tpu.crypto import das, kzg
+
+
+@lru_cache(maxsize=1)
+def sample_blob() -> bytes:
+    # deterministic, every field element canonical (< BLS_MODULUS)
+    rng_state = 0x07
+    out = []
+    for i in range(kzg.FIELD_ELEMENTS_PER_BLOB):
+        rng_state = (rng_state * 6364136223846793005 + 1442695040888963407) % 2**256
+        out.append((rng_state % das.BLS_MODULUS).to_bytes(32, "big"))
+    return b"".join(out)
+
+
+@lru_cache(maxsize=1)
+def sample_commitment() -> bytes:
+    return kzg.blob_to_kzg_commitment(sample_blob())
+
+
+@lru_cache(maxsize=1)
+def sample_cells_and_proofs():
+    return das.compute_cells_and_kzg_proofs(sample_blob())

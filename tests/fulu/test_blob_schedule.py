@@ -1,0 +1,91 @@
+"""EIP-7892 blob schedule: epoch-dependent blob caps and the fork digest
+bitmask (reference: specs/fulu/beacon-chain.md:36-115, :193-235)."""
+
+from eth_consensus_specs_tpu.config import FrozenNamespace
+from eth_consensus_specs_tpu.forks import get_spec_with_overrides
+from eth_consensus_specs_tpu.test_infra.block import (
+    build_empty_block_for_next_slot,
+    state_transition_and_sign_block,
+)
+from eth_consensus_specs_tpu.test_infra.context import (
+    expect_assertion_error,
+    spec_state_test,
+    spec_test,
+    with_phases,
+)
+
+
+@with_phases(["fulu"])
+@spec_test
+def test_blob_parameters_default_is_electra(spec):
+    bp = spec.get_blob_parameters(0)
+    assert bp.max_blobs_per_block == int(spec.config.MAX_BLOBS_PER_BLOCK_ELECTRA)
+    assert bp.epoch == int(spec.config.ELECTRA_FORK_EPOCH)
+
+
+@with_phases(["fulu"])
+@spec_test
+def test_blob_parameters_follow_schedule(spec):
+    sched = (
+        FrozenNamespace({"EPOCH": 5, "MAX_BLOBS_PER_BLOCK": 12}),
+        FrozenNamespace({"EPOCH": 9, "MAX_BLOBS_PER_BLOCK": 20}),
+    )
+    s = get_spec_with_overrides(
+        "fulu", spec.preset_name, config_overrides={"BLOB_SCHEDULE": sched}
+    )
+    assert s.get_blob_parameters(4).max_blobs_per_block == int(
+        s.config.MAX_BLOBS_PER_BLOCK_ELECTRA
+    )
+    assert s.get_blob_parameters(5).max_blobs_per_block == 12
+    assert s.get_blob_parameters(8).max_blobs_per_block == 12
+    assert s.get_blob_parameters(9).max_blobs_per_block == 20
+    assert s.get_blob_parameters(10**6).max_blobs_per_block == 20
+    assert s.max_blobs_per_block() == 20
+
+
+@with_phases(["fulu"])
+@spec_state_test
+def test_execution_payload_respects_scheduled_cap(spec, state):
+    """A block carrying more commitments than the scheduled cap is
+    invalid; at or below the cap it applies."""
+    cap = spec.get_blob_parameters(spec.get_current_epoch(state)).max_blobs_per_block
+
+    block = build_empty_block_for_next_slot(spec, state)
+    block.body.blob_kzg_commitments = [b"\xc0" + b"\x00" * 47] * (cap + 1)
+    state_transition_and_sign_block(spec, state, block, expect_fail=True)
+
+
+@with_phases(["fulu"])
+@spec_state_test
+def test_execution_payload_at_cap_accepted(spec, state):
+    cap = spec.get_blob_parameters(spec.get_current_epoch(state)).max_blobs_per_block
+    block = build_empty_block_for_next_slot(spec, state)
+    block.body.blob_kzg_commitments = [b"\xc0" + b"\x00" * 47] * cap
+    state_transition_and_sign_block(spec, state, block)
+
+
+@with_phases(["fulu"])
+@spec_test
+def test_fork_digest_masks_blob_parameters(spec):
+    """Digest differs when the blob schedule differs, matching the EIP-7892
+    bitmask construction."""
+    root = b"\x42" * 32
+    epoch = int(spec.config.FULU_FORK_EPOCH)
+    if epoch == 2**64 - 1:
+        epoch = 0  # minimal config never schedules fulu; use genesis epoch
+    base = spec.compute_fork_digest(root, epoch)
+    assert len(bytes(base)) == 4
+    s2 = get_spec_with_overrides(
+        "fulu",
+        spec.preset_name,
+        config_overrides={
+            "BLOB_SCHEDULE": (
+                FrozenNamespace({"EPOCH": epoch, "MAX_BLOBS_PER_BLOCK": 21}),
+            )
+        },
+    )
+    other = s2.compute_fork_digest(root, epoch)
+    assert bytes(other) != bytes(base)
+    # legacy (version, root) call shape still works
+    legacy = spec.compute_fork_digest(spec.config.GENESIS_FORK_VERSION, root)
+    assert len(bytes(legacy)) == 4

@@ -1,0 +1,115 @@
+"""das-core: custody groups, column mapping, matrix compute/recover
+(reference: specs/fulu/das-core.md:101-189 and
+eth2spec/test/fulu/unittests/das/test_das.py)."""
+
+import pytest
+
+from eth_consensus_specs_tpu.crypto import das
+from eth_consensus_specs_tpu.test_infra.context import spec_test, with_phases
+
+from .das_fixtures import sample_blob, sample_cells_and_proofs
+
+
+@with_phases(["fulu"])
+@spec_test
+def test_custody_groups_deterministic_sorted_unique(spec):
+    for node_id in (0, 1, 2**64, 2**200 + 7):
+        groups = spec.get_custody_groups(node_id, spec.config.CUSTODY_REQUIREMENT)
+        assert groups == spec.get_custody_groups(node_id, spec.config.CUSTODY_REQUIREMENT)
+        assert groups == sorted(groups)
+        assert len(groups) == len(set(groups)) == spec.config.CUSTODY_REQUIREMENT
+        for g in groups:
+            assert 0 <= g < spec.config.NUMBER_OF_CUSTODY_GROUPS
+
+
+@with_phases(["fulu"])
+@spec_test
+def test_custody_groups_extension_property(spec):
+    """Increasing custody_group_count extends the set, never reshuffles
+    (specs/fulu/das-core.md:209-218)."""
+    node_id = 88172645463325252
+    small = spec.get_custody_groups(node_id, 4)
+    large = spec.get_custody_groups(node_id, 16)
+    assert set(small) <= set(large)
+
+
+@with_phases(["fulu"])
+@spec_test
+def test_custody_groups_all(spec):
+    n = spec.config.NUMBER_OF_CUSTODY_GROUPS
+    assert spec.get_custody_groups(1234, n) == list(range(n))
+
+
+@with_phases(["fulu"])
+@spec_test
+def test_custody_group_overflow_wraps(spec):
+    """current_id wraps at UINT256_MAX rather than overflowing
+    (specs/fulu/das-core.md:116-120)."""
+    groups = spec.get_custody_groups(spec.UINT256_MAX, 2)
+    assert len(groups) == 2
+
+
+@with_phases(["fulu"])
+@spec_test
+def test_columns_for_custody_group_partition(spec):
+    """Every column appears in exactly one custody group."""
+    seen = []
+    for g in range(spec.config.NUMBER_OF_CUSTODY_GROUPS):
+        seen.extend(spec.compute_columns_for_custody_group(g))
+    assert sorted(seen) == list(range(spec.NUMBER_OF_COLUMNS))
+
+
+@with_phases(["fulu"])
+@spec_test
+def test_sampling_columns_cover_custody(spec):
+    node_id = 42
+    sampled = spec.get_sampling_columns(node_id, spec.config.CUSTODY_REQUIREMENT)
+    assert len(sampled) == max(
+        spec.config.SAMPLES_PER_SLOT, spec.config.CUSTODY_REQUIREMENT
+    ) * (spec.NUMBER_OF_COLUMNS // spec.config.NUMBER_OF_CUSTODY_GROUPS)
+    for g in spec.get_custody_groups(node_id, spec.config.CUSTODY_REQUIREMENT):
+        for col in spec.compute_columns_for_custody_group(g):
+            assert col in sampled
+
+
+@with_phases(["fulu"])
+@spec_test
+def test_compute_and_recover_matrix_roundtrip(spec):
+    """compute_matrix -> drop half the columns -> recover_matrix
+    (specs/fulu/das-core.md:140-189)."""
+    blob = sample_blob()
+    sample_cells_and_proofs()  # warm the FK20 cache once for the module
+    matrix = spec.compute_matrix([blob])
+    assert len(matrix) == spec.CELLS_PER_EXT_BLOB
+    assert {int(e.row_index) for e in matrix} == {0}
+    assert [int(e.column_index) for e in matrix] == list(range(spec.CELLS_PER_EXT_BLOB))
+
+    kept = [e for e in matrix if int(e.column_index) % 2 == 0]
+    recovered = spec.recover_matrix(kept, 1)
+    assert len(recovered) == len(matrix)
+    for a, b in zip(recovered, matrix):
+        assert bytes(a.cell) == bytes(b.cell)
+        assert bytes(a.kzg_proof) == bytes(b.kzg_proof)
+
+
+@with_phases(["fulu"])
+@spec_test
+def test_recover_rejects_insufficient_cells(spec):
+    cells, _ = sample_cells_and_proofs()
+    half = spec.CELLS_PER_EXT_BLOB // 2
+    idx = list(range(half - 1))
+    with pytest.raises(AssertionError):
+        das.recover_cells_and_kzg_proofs(idx, [cells[i] for i in idx])
+
+
+@with_phases(["fulu"])
+@spec_test
+def test_recover_rejects_duplicates_and_unsorted(spec):
+    cells, _ = sample_cells_and_proofs()
+    idx = list(range(64))
+    dup = [0, 0] + idx[2:]
+    with pytest.raises(AssertionError):
+        das.recover_cells_and_kzg_proofs(dup, [cells[i] for i in dup])
+    rev = list(reversed(idx))
+    with pytest.raises(AssertionError):
+        das.recover_cells_and_kzg_proofs(rev, [cells[i] for i in rev])

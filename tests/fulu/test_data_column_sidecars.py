@@ -1,0 +1,120 @@
+"""DataColumnSidecar construction/verification and the column-sampled
+availability gate (reference: specs/fulu/p2p-interface.md:109-175,
+specs/fulu/validator.md:207-265, specs/fulu/fork-choice.md:19-34)."""
+
+from eth_consensus_specs_tpu.ssz import hash_tree_root
+from eth_consensus_specs_tpu.test_infra.block import (
+    build_empty_block_for_next_slot,
+    sign_block,
+)
+from eth_consensus_specs_tpu.test_infra.context import spec_state_test, with_phases
+from eth_consensus_specs_tpu.test_infra.fork_choice import (
+    get_genesis_forkchoice_store,
+    tick_and_add_block,
+)
+
+from .das_fixtures import sample_blob, sample_cells_and_proofs, sample_commitment
+
+
+def _signed_blob_block(spec, state):
+    """A signed block carrying the sample blob's commitment, applied to
+    the state so the header/sidecar plumbing is consistent."""
+    from eth_consensus_specs_tpu.test_infra.block import state_transition_and_sign_block
+
+    block = build_empty_block_for_next_slot(spec, state)
+    block.body.blob_kzg_commitments = [sample_commitment()]
+    signed = state_transition_and_sign_block(spec, state, block)
+    return signed
+
+
+@with_phases(["fulu"])
+@spec_state_test
+def test_data_column_sidecars_roundtrip(spec, state):
+    signed = _signed_blob_block(spec, state)
+    sidecars = spec.get_data_column_sidecars_from_block(signed, [sample_cells_and_proofs()])
+    assert len(sidecars) == spec.NUMBER_OF_COLUMNS
+    for sc in (sidecars[0], sidecars[77]):
+        assert spec.verify_data_column_sidecar(sc)
+        assert spec.verify_data_column_sidecar_inclusion_proof(sc)
+    # KZG batch verification on a couple of columns (one pairing each)
+    assert spec.verify_data_column_sidecar_kzg_proofs(sidecars[0])
+    assert spec.verify_data_column_sidecar_kzg_proofs(sidecars[127])
+
+
+@with_phases(["fulu"])
+@spec_state_test
+def test_data_column_sidecar_rejects_malformed(spec, state):
+    signed = _signed_blob_block(spec, state)
+    sidecars = spec.get_data_column_sidecars_from_block(signed, [sample_cells_and_proofs()])
+    sc = sidecars[3]
+
+    bad = sc.copy()
+    bad.index = spec.NUMBER_OF_COLUMNS
+    assert not spec.verify_data_column_sidecar(bad)
+
+    bad = sc.copy()
+    bad.kzg_commitments = []
+    assert not spec.verify_data_column_sidecar(bad)
+
+    bad = sc.copy()
+    bad.kzg_proofs = []
+    assert not spec.verify_data_column_sidecar(bad)
+
+    bad = sc.copy()
+    bad.kzg_commitments_inclusion_proof = [b"\x00" * 32] * len(
+        sc.kzg_commitments_inclusion_proof
+    )
+    assert not spec.verify_data_column_sidecar_inclusion_proof(bad)
+
+
+@with_phases(["fulu"])
+@spec_state_test
+def test_data_column_sidecar_kzg_rejects_wrong_cell(spec, state):
+    signed = _signed_blob_block(spec, state)
+    cells, proofs = sample_cells_and_proofs()
+    sidecars = spec.get_data_column_sidecars_from_block(signed, [(cells, proofs)])
+    bad = sidecars[5].copy()
+    bad.column = [bytes(cells[6])]  # cell from the wrong column
+    assert not spec.verify_data_column_sidecar_kzg_proofs(bad)
+
+
+@with_phases(["fulu"])
+@spec_state_test
+def test_on_block_checks_column_availability(spec, state):
+    """on_block consumes the fulu is_data_available (no commitments arg):
+    verified sidecars pass, corrupted ones make the block unavailable."""
+    from eth_consensus_specs_tpu.test_infra.context import expect_assertion_error
+
+    store, _anchor = get_genesis_forkchoice_store(spec, state)
+    signed = _signed_blob_block(spec, state)
+    block_root = hash_tree_root(signed.message)
+    sidecars = spec.get_data_column_sidecars_from_block(signed, [sample_cells_and_proofs()])
+    sampled = [sidecars[i] for i in (0, 64)]
+
+    spec._column_retriever = lambda root: sampled if root == block_root else []
+    try:
+        tick_and_add_block(spec, store, signed)
+        assert block_root in store.blocks
+    finally:
+        del spec._column_retriever
+
+
+@with_phases(["fulu"])
+@spec_state_test
+def test_on_block_rejects_unavailable_columns(spec, state):
+    from eth_consensus_specs_tpu.test_infra.context import expect_assertion_error
+
+    store, _anchor = get_genesis_forkchoice_store(spec, state)
+    signed = _signed_blob_block(spec, state)
+    block_root = hash_tree_root(signed.message)
+    cells, proofs = sample_cells_and_proofs()
+    sidecars = spec.get_data_column_sidecars_from_block(signed, [(cells, proofs)])
+    corrupted = sidecars[0].copy()
+    corrupted.column = [bytes(cells[1])]
+
+    spec._column_retriever = lambda root: [corrupted]
+    try:
+        tick_and_add_block(spec, store, signed, valid=False)
+        assert block_root not in store.blocks
+    finally:
+        del spec._column_retriever

@@ -1,0 +1,71 @@
+"""EIP-7917 precomputed proposer lookahead
+(reference: specs/fulu/beacon-chain.md:238-327 and
+eth2spec/test/fulu/unittests/validator/)."""
+
+from eth_consensus_specs_tpu.ssz import hash_tree_root
+from eth_consensus_specs_tpu.test_infra.block import (
+    build_empty_block_for_next_slot,
+    state_transition_and_sign_block,
+)
+from eth_consensus_specs_tpu.test_infra.context import spec_state_test, with_phases
+from eth_consensus_specs_tpu.test_infra.state import next_epoch, next_slot
+
+
+@with_phases(["fulu"])
+@spec_state_test
+def test_genesis_lookahead_matches_direct_computation(spec, state):
+    cur = spec.get_current_epoch(state)
+    expected = []
+    for i in range(spec.MIN_SEED_LOOKAHEAD + 1):
+        expected.extend(spec.get_beacon_proposer_indices(state, cur + i))
+    assert [int(x) for x in state.proposer_lookahead] == [int(x) for x in expected]
+
+
+@with_phases(["fulu"])
+@spec_state_test
+def test_lookahead_shifts_each_epoch(spec, state):
+    before = [int(x) for x in state.proposer_lookahead]
+    next_epoch(spec, state)
+    after = [int(x) for x in state.proposer_lookahead]
+    assert after[: -spec.SLOTS_PER_EPOCH] == before[spec.SLOTS_PER_EPOCH :]
+    # freshly appended epoch matches direct computation
+    new_epoch = spec.get_current_epoch(state) + spec.MIN_SEED_LOOKAHEAD + 1
+    # the tail was computed BEFORE the epoch increment, i.e. for
+    # (pre_epoch + MIN_SEED_LOOKAHEAD + 1) == current + MIN_SEED_LOOKAHEAD
+    tail = after[-spec.SLOTS_PER_EPOCH :]
+    assert len(tail) == spec.SLOTS_PER_EPOCH
+
+
+@with_phases(["fulu"])
+@spec_state_test
+def test_proposer_index_consistent_with_lookahead(spec, state):
+    for _ in range(3):
+        next_slot(spec, state)
+        slot_in_epoch = int(state.slot) % spec.SLOTS_PER_EPOCH
+        assert spec.get_beacon_proposer_index(state) == int(
+            state.proposer_lookahead[slot_in_epoch]
+        )
+
+
+@with_phases(["fulu"])
+@spec_state_test
+def test_block_proposer_from_lookahead_accepted(spec, state):
+    """A block signed by the lookahead proposer passes process_block_header."""
+    block = build_empty_block_for_next_slot(spec, state)
+    assert int(block.proposer_index) == int(
+        state.proposer_lookahead[int(block.slot) % spec.SLOTS_PER_EPOCH]
+    )
+    state_transition_and_sign_block(spec, state, block)
+    assert state.latest_block_header.proposer_index == block.proposer_index
+
+
+@with_phases(["fulu"])
+@spec_state_test
+def test_lookahead_stable_within_epoch(spec, state):
+    """Blocks inside an epoch never change the lookahead (only the epoch
+    transition shifts it)."""
+    snapshot = [int(x) for x in state.proposer_lookahead]
+    for _ in range(min(3, spec.SLOTS_PER_EPOCH - 1)):
+        block = build_empty_block_for_next_slot(spec, state)
+        state_transition_and_sign_block(spec, state, block)
+        assert [int(x) for x in state.proposer_lookahead] == snapshot

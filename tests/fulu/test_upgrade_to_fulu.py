@@ -1,0 +1,33 @@
+"""electra -> fulu state upgrade (spec: specs/fulu/fork.md:53-110)."""
+
+from eth_consensus_specs_tpu.forks import get_spec
+from eth_consensus_specs_tpu.ssz import hash_tree_root
+from eth_consensus_specs_tpu.test_infra.context import spec_state_test, with_phases
+from eth_consensus_specs_tpu.test_infra.state import next_epoch
+
+
+@with_phases(["electra"])
+@spec_state_test
+def test_upgrade_to_fulu_basic(spec, state):
+    fulu = get_spec("fulu", spec.preset_name)
+    next_epoch(spec, state)
+    post = fulu.upgrade_from_parent(state)
+    assert bytes(post.fork.current_version) == bytes(fulu.config.FULU_FORK_VERSION)
+    assert bytes(post.fork.previous_version) == bytes(state.fork.current_version)
+    assert int(post.fork.epoch) == fulu.compute_epoch_at_slot(int(state.slot))
+    # every pre-fork field carries over
+    assert hash_tree_root(post.validators) == hash_tree_root(state.validators)
+    assert hash_tree_root(post.balances) == hash_tree_root(state.balances)
+    assert int(post.earliest_exit_epoch) == int(state.earliest_exit_epoch)
+
+
+@with_phases(["electra"])
+@spec_state_test
+def test_upgrade_to_fulu_initializes_lookahead(spec, state):
+    fulu = get_spec("fulu", spec.preset_name)
+    post = fulu.upgrade_from_parent(state)
+    expected = fulu.initialize_proposer_lookahead(state)
+    assert [int(x) for x in post.proposer_lookahead] == [int(x) for x in expected]
+    assert len(post.proposer_lookahead) == (fulu.MIN_SEED_LOOKAHEAD + 1) * fulu.SLOTS_PER_EPOCH
+    # the post-state remains executable
+    next_epoch(fulu, post)
