@@ -1,0 +1,1029 @@
+"""gloas: ePBS — enshrined proposer-builder separation (EIP-7732).
+
+The state transition splits in two: importing a signed beacon block (which
+commits to a builder's *bid*) and separately importing the builder's
+signed execution payload *envelope*. A payload-timeliness committee (PTC)
+attests whether the payload actually appeared; builder payments settle
+through a two-epoch pending-payment window weighted by same-slot
+attestations.
+
+Behavioral parity targets (reference, by section):
+  * containers:     specs/gloas/beacon-chain.md:128-319
+  * predicates:     :321-408 (builder credentials, same-slot attestation,
+    indexed payload attestation, parent-block-full)
+  * selection:      :440-530 (balance-weighted selection/acceptance,
+    proposer indices, sync committee)
+  * accessors:      :532-634 (participation flags with payload matching,
+    get_ptc, payment quorum)
+  * transition:     :636-735 (split transition, process_slot availability
+    reset, builder pending payments, bid processing, state-only
+    withdrawals, payload-attestation op, envelope processing :1221-1318)
+  * fork upgrade:   specs/gloas/fork.md:34-110
+
+TPU-first notes: balance-weighted selection is the same 16-bit
+acceptance-sampling kernel electra introduced for proposers, reused for
+three committees — one vectorizable primitive instead of three loops. The
+per-slot payment weights live in a fixed 2*SLOTS_PER_EPOCH vector, i.e. a
+static-shape accumulator a fused attestation kernel can scatter-add into.
+"""
+
+from eth_consensus_specs_tpu.ssz import (
+    Bitvector,
+    Bytes32,
+    Container,
+    List,
+    Vector,
+    boolean,
+    hash_tree_root,
+    uint64,
+)
+from eth_consensus_specs_tpu.utils import bls
+
+from .bellatrix import ExecutionAddress, Hash32
+from .capella import WithdrawalIndex
+from .deneb import KZGCommitment
+from .fulu import FuluSpec
+from .phase0 import (
+    BLSSignature,
+    Epoch,
+    Gwei,
+    Root,
+    Slot,
+    ValidatorIndex,
+    Version,
+)
+
+
+class GloasSpec(FuluSpec):
+    fork_name = "gloas"
+
+    # Domain types (specs/gloas/beacon-chain.md:88-93)
+    DOMAIN_BEACON_BUILDER = b"\x1b\x00\x00\x00"
+    DOMAIN_PTC_ATTESTER = b"\x0c\x00\x00\x00"
+
+    # Misc (:95-100)
+    BUILDER_PAYMENT_THRESHOLD_NUMERATOR = 6
+    BUILDER_PAYMENT_THRESHOLD_DENOMINATOR = 10
+
+    # Withdrawal prefixes (:102-106)
+    BUILDER_WITHDRAWAL_PREFIX = b"\x03"
+
+    # == type system ======================================================
+
+    def _build_types(self) -> None:
+        super()._build_types()
+        P = self
+
+        # New containers (:130-231)
+        class BuilderPendingWithdrawal(Container):
+            fee_recipient: ExecutionAddress
+            amount: Gwei
+            builder_index: ValidatorIndex
+            withdrawable_epoch: Epoch
+
+        class BuilderPendingPayment(Container):
+            weight: Gwei
+            withdrawal: BuilderPendingWithdrawal
+
+        class PayloadAttestationData(Container):
+            beacon_block_root: Root
+            slot: Slot
+            payload_present: boolean
+            blob_data_available: boolean
+
+        class PayloadAttestation(Container):
+            aggregation_bits: Bitvector[P.PTC_SIZE]
+            data: PayloadAttestationData
+            signature: BLSSignature
+
+        class PayloadAttestationMessage(Container):
+            validator_index: ValidatorIndex
+            data: PayloadAttestationData
+            signature: BLSSignature
+
+        class IndexedPayloadAttestation(Container):
+            attesting_indices: List[ValidatorIndex, P.PTC_SIZE]
+            data: PayloadAttestationData
+            signature: BLSSignature
+
+        class ExecutionPayloadBid(Container):
+            parent_block_hash: Hash32
+            parent_block_root: Root
+            block_hash: Hash32
+            prev_randao: Bytes32
+            fee_recipient: ExecutionAddress
+            gas_limit: uint64
+            builder_index: ValidatorIndex
+            slot: Slot
+            value: Gwei
+            execution_payment: Gwei
+            blob_kzg_commitments_root: Root
+
+        class SignedExecutionPayloadBid(Container):
+            message: ExecutionPayloadBid
+            signature: BLSSignature
+
+        class ExecutionPayloadEnvelope(Container):
+            payload: P.ExecutionPayload
+            execution_requests: P.ExecutionRequests
+            builder_index: ValidatorIndex
+            beacon_block_root: Root
+            slot: Slot
+            blob_kzg_commitments: List[KZGCommitment, P.MAX_BLOB_COMMITMENTS_PER_BLOCK]
+            state_root: Root
+
+        class SignedExecutionPayloadEnvelope(Container):
+            message: ExecutionPayloadEnvelope
+            signature: BLSSignature
+
+        # Modified containers (:233-319)
+        class BeaconBlockBody(Container):
+            randao_reveal: BLSSignature
+            eth1_data: P.Eth1Data
+            graffiti: Bytes32
+            proposer_slashings: List[P.ProposerSlashing, P.MAX_PROPOSER_SLASHINGS]
+            attester_slashings: List[P.AttesterSlashing, P.MAX_ATTESTER_SLASHINGS_ELECTRA]
+            attestations: List[P.Attestation, P.MAX_ATTESTATIONS_ELECTRA]
+            deposits: List[P.Deposit, P.MAX_DEPOSITS]
+            voluntary_exits: List[P.SignedVoluntaryExit, P.MAX_VOLUNTARY_EXITS]
+            sync_aggregate: P.SyncAggregate
+            bls_to_execution_changes: List[
+                P.SignedBLSToExecutionChange, P.MAX_BLS_TO_EXECUTION_CHANGES
+            ]
+            # [New in Gloas:EIP7732] (payload/commitments/requests removed)
+            signed_execution_payload_bid: SignedExecutionPayloadBid
+            payload_attestations: List[PayloadAttestation, P.MAX_PAYLOAD_ATTESTATIONS]
+
+        class BeaconBlock(Container):
+            slot: Slot
+            proposer_index: ValidatorIndex
+            parent_root: Root
+            state_root: Root
+            body: BeaconBlockBody
+
+        class SignedBeaconBlock(Container):
+            message: BeaconBlock
+            signature: BLSSignature
+
+        class BeaconState(Container):
+            genesis_time: uint64
+            genesis_validators_root: Root
+            slot: Slot
+            fork: P.Fork
+            latest_block_header: P.BeaconBlockHeader
+            block_roots: P.BeaconState.fields()["block_roots"]
+            state_roots: P.BeaconState.fields()["state_roots"]
+            historical_roots: P.BeaconState.fields()["historical_roots"]
+            eth1_data: P.Eth1Data
+            eth1_data_votes: P.BeaconState.fields()["eth1_data_votes"]
+            eth1_deposit_index: uint64
+            validators: P.BeaconState.fields()["validators"]
+            balances: P.BeaconState.fields()["balances"]
+            randao_mixes: P.BeaconState.fields()["randao_mixes"]
+            slashings: P.BeaconState.fields()["slashings"]
+            previous_epoch_participation: P.BeaconState.fields()[
+                "previous_epoch_participation"
+            ]
+            current_epoch_participation: P.BeaconState.fields()[
+                "current_epoch_participation"
+            ]
+            justification_bits: P.BeaconState.fields()["justification_bits"]
+            previous_justified_checkpoint: P.Checkpoint
+            current_justified_checkpoint: P.Checkpoint
+            finalized_checkpoint: P.Checkpoint
+            inactivity_scores: P.BeaconState.fields()["inactivity_scores"]
+            current_sync_committee: P.SyncCommittee
+            next_sync_committee: P.SyncCommittee
+            # [New in Gloas:EIP7732] (latest_execution_payload_header removed)
+            latest_execution_payload_bid: ExecutionPayloadBid
+            next_withdrawal_index: WithdrawalIndex
+            next_withdrawal_validator_index: ValidatorIndex
+            historical_summaries: P.BeaconState.fields()["historical_summaries"]
+            deposit_requests_start_index: uint64
+            deposit_balance_to_consume: Gwei
+            exit_balance_to_consume: Gwei
+            earliest_exit_epoch: Epoch
+            consolidation_balance_to_consume: Gwei
+            earliest_consolidation_epoch: Epoch
+            pending_deposits: P.BeaconState.fields()["pending_deposits"]
+            pending_partial_withdrawals: P.BeaconState.fields()[
+                "pending_partial_withdrawals"
+            ]
+            pending_consolidations: P.BeaconState.fields()["pending_consolidations"]
+            proposer_lookahead: P.BeaconState.fields()["proposer_lookahead"]
+            # [New in Gloas:EIP7732]
+            execution_payload_availability: Bitvector[P.SLOTS_PER_HISTORICAL_ROOT]
+            builder_pending_payments: Vector[BuilderPendingPayment, 2 * P.SLOTS_PER_EPOCH]
+            builder_pending_withdrawals: List[
+                BuilderPendingWithdrawal, P.BUILDER_PENDING_WITHDRAWALS_LIMIT
+            ]
+            latest_block_hash: Hash32
+            latest_withdrawals_root: Root
+
+        for name, typ in list(locals().items()):
+            if isinstance(typ, type) and issubclass(typ, Container):
+                typ.__name__ = name
+                setattr(self, name, typ)
+
+    # == predicates (:323-408) =============================================
+
+    def is_builder_withdrawal_credential(self, withdrawal_credentials) -> bool:
+        return bytes(withdrawal_credentials)[:1] == self.BUILDER_WITHDRAWAL_PREFIX
+
+    def has_builder_withdrawal_credential(self, validator) -> bool:
+        return self.is_builder_withdrawal_credential(validator.withdrawal_credentials)
+
+    def has_compounding_withdrawal_credential(self, validator) -> bool:
+        """[Modified in Gloas] builders compound too."""
+        if self.is_compounding_withdrawal_credential(validator.withdrawal_credentials):
+            return True
+        return self.is_builder_withdrawal_credential(validator.withdrawal_credentials)
+
+    def is_attestation_same_slot(self, state, data) -> bool:
+        """Attestation votes for the block proposed at its own slot (:362-374)."""
+        if int(data.slot) == 0:
+            return True
+        blockroot = bytes(data.beacon_block_root)
+        slot_blockroot = bytes(self.get_block_root_at_slot(state, int(data.slot)))
+        prev_blockroot = bytes(self.get_block_root_at_slot(state, int(data.slot) - 1))
+        return blockroot == slot_blockroot and blockroot != prev_blockroot
+
+    def is_valid_indexed_payload_attestation(self, state, indexed_payload_attestation) -> bool:
+        """(:379-396)"""
+        indices = [int(i) for i in indexed_payload_attestation.attesting_indices]
+        if len(indices) == 0 or indices != sorted(indices):
+            return False
+        pubkeys = [state.validators[i].pubkey for i in indices]
+        domain = self.get_domain(state, self.DOMAIN_PTC_ATTESTER, None)
+        signing_root = self.compute_signing_root(indexed_payload_attestation.data, domain)
+        return bls.FastAggregateVerify(
+            pubkeys, signing_root, indexed_payload_attestation.signature
+        )
+
+    def is_parent_block_full(self, state) -> bool:
+        """(:406-408)"""
+        return bytes(state.latest_execution_payload_bid.block_hash) == bytes(
+            state.latest_block_hash
+        )
+
+    # == misc (:410-509) ===================================================
+
+    def get_pending_balance_to_withdraw(self, state, validator_index: int) -> int:
+        """[Modified in Gloas] include builder payments/withdrawals (:418-437)."""
+        validator_index = int(validator_index)
+        return (
+            sum(
+                int(w.amount)
+                for w in state.pending_partial_withdrawals
+                if int(w.validator_index) == validator_index
+            )
+            + sum(
+                int(w.amount)
+                for w in state.builder_pending_withdrawals
+                if int(w.builder_index) == validator_index
+            )
+            + sum(
+                int(p.withdrawal.amount)
+                for p in state.builder_pending_payments
+                if int(p.withdrawal.builder_index) == validator_index
+            )
+        )
+
+    def compute_balance_weighted_acceptance(self, state, index: int, seed: bytes, i: int) -> bool:
+        """16-bit effective-balance acceptance sampling (:474-487)."""
+        MAX_RANDOM_VALUE = 2**16 - 1
+        random_bytes = self.hash(seed + self.uint_to_bytes(i // 16, 8))
+        offset = i % 16 * 2
+        random_value = self.bytes_to_uint64(random_bytes[offset : offset + 2])
+        effective_balance = int(state.validators[int(index)].effective_balance)
+        return (
+            effective_balance * MAX_RANDOM_VALUE
+            >= self.MAX_EFFECTIVE_BALANCE_ELECTRA * random_value
+        )
+
+    def compute_balance_weighted_selection(
+        self, state, indices, seed: bytes, size: int, shuffle_indices: bool
+    ):
+        """(:443-468); the swap-or-not walk uses the cached whole
+        permutation (ops/shuffle) instead of per-index hashing."""
+        total = len(indices)
+        assert total > 0
+        perm = self._shuffle_permutation(total, seed) if shuffle_indices else None
+        selected = []
+        i = 0
+        while len(selected) < size:
+            next_index = i % total
+            if shuffle_indices:
+                next_index = int(perm[next_index])
+            candidate_index = int(indices[next_index])
+            if self.compute_balance_weighted_acceptance(state, candidate_index, seed, i):
+                selected.append(candidate_index)
+            i += 1
+        return selected
+
+    def compute_proposer_indices(self, state, epoch: int, seed: bytes, indices):
+        """[Modified in Gloas] via balance-weighted selection (:496-508)."""
+        start_slot = self.compute_start_slot_at_epoch(int(epoch))
+        seeds = [
+            self.hash(seed + self.uint_to_bytes(int(start_slot + i), 8))
+            for i in range(self.SLOTS_PER_EPOCH)
+        ]
+        return [
+            self.compute_balance_weighted_selection(
+                state, indices, s, size=1, shuffle_indices=True
+            )[0]
+            for s in seeds
+        ]
+
+    # == accessors (:511-634) ==============================================
+
+    def get_next_sync_committee_indices(self, state):
+        """[Modified in Gloas] balance-weighted selection (:520-529)."""
+        epoch = self.get_current_epoch(state) + 1
+        seed = self.get_seed(state, epoch, self.DOMAIN_SYNC_COMMITTEE)
+        indices = self.get_active_validator_indices(state, epoch)
+        return self.compute_balance_weighted_selection(
+            state, indices, seed, size=self.SYNC_COMMITTEE_SIZE, shuffle_indices=True
+        )
+
+    def get_attestation_participation_flag_indices(self, state, data, inclusion_delay: int):
+        """[Modified in Gloas] head requires payload matching (:538-581)."""
+        if data.target.epoch == self.get_current_epoch(state):
+            justified_checkpoint = state.current_justified_checkpoint
+        else:
+            justified_checkpoint = state.previous_justified_checkpoint
+        is_matching_source = data.source == justified_checkpoint
+
+        target_root = self.get_block_root(state, data.target.epoch)
+        is_matching_target = is_matching_source and bytes(data.target.root) == bytes(target_root)
+
+        # [New in Gloas:EIP7732]
+        if self.is_attestation_same_slot(state, data):
+            assert data.index == 0, "same-slot attestation index must be 0"
+            payload_matches = True
+        else:
+            slot_index = int(data.slot) % self.SLOTS_PER_HISTORICAL_ROOT
+            payload_index = int(state.execution_payload_availability[slot_index])
+            payload_matches = int(data.index) == payload_index
+
+        head_root = self.get_block_root_at_slot(state, data.slot)
+        head_root_matches = bytes(data.beacon_block_root) == bytes(head_root)
+        is_matching_head = is_matching_target and head_root_matches and payload_matches
+
+        assert is_matching_source, "attestation source does not match justified checkpoint"
+
+        participation_flag_indices = []
+        if is_matching_source and inclusion_delay <= self.integer_squareroot(
+            self.SLOTS_PER_EPOCH
+        ):
+            participation_flag_indices.append(self.TIMELY_SOURCE_FLAG_INDEX)
+        if is_matching_target:
+            participation_flag_indices.append(self.TIMELY_TARGET_FLAG_INDEX)
+        if is_matching_head and inclusion_delay == self.MIN_ATTESTATION_INCLUSION_DELAY:
+            participation_flag_indices.append(self.TIMELY_HEAD_FLAG_INDEX)
+        return participation_flag_indices
+
+    def get_ptc(self, state, slot: int):
+        """Payload-timeliness committee (:587-602)."""
+        epoch = self.compute_epoch_at_slot(int(slot))
+        seed = self.hash(
+            self.get_seed(state, epoch, self.DOMAIN_PTC_ATTESTER)
+            + self.uint_to_bytes(int(slot), 8)
+        )
+        indices = []
+        committees_per_slot = self.get_committee_count_per_slot(state, epoch)
+        for i in range(committees_per_slot):
+            committee = self.get_beacon_committee(state, int(slot), i)
+            indices.extend(int(v) for v in committee)
+        return self.compute_balance_weighted_selection(
+            state, indices, seed, size=self.PTC_SIZE, shuffle_indices=False
+        )
+
+    def get_indexed_payload_attestation(self, state, slot: int, payload_attestation):
+        """(:607-622)"""
+        ptc = self.get_ptc(state, int(slot))
+        bits = payload_attestation.aggregation_bits
+        attesting_indices = [index for i, index in enumerate(ptc) if bits[i]]
+        return self.IndexedPayloadAttestation(
+            attesting_indices=sorted(attesting_indices),
+            data=payload_attestation.data,
+            signature=payload_attestation.signature,
+        )
+
+    def get_builder_payment_quorum_threshold(self, state) -> int:
+        """(:627-634)"""
+        per_slot_balance = self.get_total_active_balance(state) // self.SLOTS_PER_EPOCH
+        quorum = per_slot_balance * self.BUILDER_PAYMENT_THRESHOLD_NUMERATOR
+        return quorum // self.BUILDER_PAYMENT_THRESHOLD_DENOMINATOR
+
+    # == slot processing (:655-671) ========================================
+
+    def process_slot(self, state) -> None:
+        super().process_slot(state)
+        # [New in Gloas:EIP7732] unset the next payload availability
+        availability = list(state.execution_payload_availability)
+        availability[(int(state.slot) + 1) % self.SLOTS_PER_HISTORICAL_ROOT] = 0
+        state.execution_payload_availability = availability
+
+    # == epoch processing (:675-717) =======================================
+
+    def process_epoch(self, state) -> None:
+        self.process_justification_and_finalization(state)
+        self.process_inactivity_updates(state)
+        self.process_rewards_and_penalties(state)
+        self.process_registry_updates(state)
+        self.process_slashings(state)
+        self.process_eth1_data_reset(state)
+        self.process_pending_deposits(state)
+        self.process_pending_consolidations(state)
+        # [New in Gloas:EIP7732]
+        self.process_builder_pending_payments(state)
+        self.process_effective_balance_updates(state)
+        self._process_epoch_resets(state)
+        # [New in Fulu:EIP7917]
+        self.process_proposer_lookahead(state)
+
+    def process_builder_pending_payments(self, state) -> None:
+        """Settle above-quorum payments from the previous epoch (:701-717)."""
+        quorum = self.get_builder_payment_quorum_threshold(state)
+        payments = list(state.builder_pending_payments)
+        for payment in payments[: self.SLOTS_PER_EPOCH]:
+            if int(payment.weight) > quorum:
+                amount = int(payment.withdrawal.amount)
+                exit_queue_epoch = self.compute_exit_epoch_and_update_churn(state, amount)
+                withdrawable_epoch = (
+                    int(exit_queue_epoch) + self.config.MIN_VALIDATOR_WITHDRAWABILITY_DELAY
+                )
+                withdrawal = payment.withdrawal.copy()
+                withdrawal.withdrawable_epoch = withdrawable_epoch
+                state.builder_pending_withdrawals.append(withdrawal)
+        state.builder_pending_payments = payments[self.SLOTS_PER_EPOCH :] + [
+            self.BuilderPendingPayment() for _ in range(self.SLOTS_PER_EPOCH)
+        ]
+
+    # == block processing (:719-735) =======================================
+
+    def process_block(self, state, block) -> None:
+        self.process_block_header(state, block)
+        # [Modified in Gloas:EIP7732] withdrawals are state-deterministic
+        self.process_withdrawals(state)
+        # [New in Gloas:EIP7732]
+        self.process_execution_payload_bid(state, block)
+        self.process_randao(state, block.body)
+        self.process_eth1_data(state, block.body)
+        self.process_operations(state, block.body)
+        self.process_sync_aggregate(state, block.body.sync_aggregate)
+
+    # == withdrawals (:739-927) ============================================
+
+    def is_builder_payment_withdrawable(self, state, withdrawal) -> bool:
+        """(:742-750)"""
+        builder = state.validators[int(withdrawal.builder_index)]
+        current_epoch = self.compute_epoch_at_slot(int(state.slot))
+        return int(builder.withdrawable_epoch) >= current_epoch or not builder.slashed
+
+    def get_expected_withdrawals(self, state):
+        """[Modified in Gloas] builder sweep first; returns
+        (withdrawals, builder_count, partials_count) (:756-864)."""
+        epoch = self.get_current_epoch(state)
+        withdrawal_index = int(state.next_withdrawal_index)
+        validator_index = int(state.next_withdrawal_validator_index)
+        withdrawals = []
+        processed_partial_withdrawals_count = 0
+        processed_builder_withdrawals_count = 0
+
+        # [New in Gloas:EIP7732] sweep for builder payments
+        for withdrawal in state.builder_pending_withdrawals:
+            if (
+                int(withdrawal.withdrawable_epoch) > epoch
+                or len(withdrawals) + 1 == self.MAX_WITHDRAWALS_PER_PAYLOAD
+            ):
+                break
+            if self.is_builder_payment_withdrawable(state, withdrawal):
+                builder_index = int(withdrawal.builder_index)
+                total_withdrawn = sum(
+                    int(w.amount) for w in withdrawals if int(w.validator_index) == builder_index
+                )
+                balance = int(state.balances[builder_index]) - total_withdrawn
+                builder = state.validators[builder_index]
+                if builder.slashed:
+                    withdrawable_balance = min(balance, int(withdrawal.amount))
+                elif balance > self.MIN_ACTIVATION_BALANCE:
+                    withdrawable_balance = min(
+                        balance - self.MIN_ACTIVATION_BALANCE, int(withdrawal.amount)
+                    )
+                else:
+                    withdrawable_balance = 0
+                if withdrawable_balance > 0:
+                    withdrawals.append(
+                        self.Withdrawal(
+                            index=withdrawal_index,
+                            validator_index=builder_index,
+                            address=withdrawal.fee_recipient,
+                            amount=withdrawable_balance,
+                        )
+                    )
+                    withdrawal_index += 1
+            processed_builder_withdrawals_count += 1
+
+        # sweep for pending partial withdrawals
+        bound = min(
+            len(withdrawals) + self.MAX_PENDING_PARTIALS_PER_WITHDRAWALS_SWEEP,
+            self.MAX_WITHDRAWALS_PER_PAYLOAD - 1,
+        )
+        for withdrawal in state.pending_partial_withdrawals:
+            if int(withdrawal.withdrawable_epoch) > epoch or len(withdrawals) == bound:
+                break
+            validator = state.validators[int(withdrawal.validator_index)]
+            has_sufficient_effective_balance = (
+                int(validator.effective_balance) >= self.MIN_ACTIVATION_BALANCE
+            )
+            total_withdrawn = sum(
+                int(w.amount)
+                for w in withdrawals
+                if int(w.validator_index) == int(withdrawal.validator_index)
+            )
+            balance = int(state.balances[int(withdrawal.validator_index)]) - total_withdrawn
+            has_excess_balance = balance > self.MIN_ACTIVATION_BALANCE
+            if (
+                int(validator.exit_epoch) == self.FAR_FUTURE_EPOCH
+                and has_sufficient_effective_balance
+                and has_excess_balance
+            ):
+                withdrawable_balance = min(
+                    balance - self.MIN_ACTIVATION_BALANCE, int(withdrawal.amount)
+                )
+                withdrawals.append(
+                    self.Withdrawal(
+                        index=withdrawal_index,
+                        validator_index=withdrawal.validator_index,
+                        address=ExecutionAddress(bytes(validator.withdrawal_credentials)[12:]),
+                        amount=withdrawable_balance,
+                    )
+                )
+                withdrawal_index += 1
+            processed_partial_withdrawals_count += 1
+
+        # sweep for remaining
+        bound = min(len(state.validators), self.MAX_VALIDATORS_PER_WITHDRAWALS_SWEEP)
+        for _ in range(bound):
+            validator = state.validators[validator_index]
+            total_withdrawn = sum(
+                int(w.amount) for w in withdrawals if int(w.validator_index) == validator_index
+            )
+            balance = int(state.balances[validator_index]) - total_withdrawn
+            if self.is_fully_withdrawable_validator(validator, balance, epoch):
+                withdrawals.append(
+                    self.Withdrawal(
+                        index=withdrawal_index,
+                        validator_index=validator_index,
+                        address=ExecutionAddress(bytes(validator.withdrawal_credentials)[12:]),
+                        amount=balance,
+                    )
+                )
+                withdrawal_index += 1
+            elif self.is_partially_withdrawable_validator(validator, balance):
+                withdrawals.append(
+                    self.Withdrawal(
+                        index=withdrawal_index,
+                        validator_index=validator_index,
+                        address=ExecutionAddress(bytes(validator.withdrawal_credentials)[12:]),
+                        amount=balance - self.get_max_effective_balance(validator),
+                    )
+                )
+                withdrawal_index += 1
+            if len(withdrawals) == self.MAX_WITHDRAWALS_PER_PAYLOAD:
+                break
+            validator_index = (validator_index + 1) % len(state.validators)
+
+        return (
+            withdrawals,
+            processed_builder_withdrawals_count,
+            processed_partial_withdrawals_count,
+        )
+
+    def process_withdrawals(self, state, payload=None) -> None:
+        """[Modified in Gloas] state-only; payload honors
+        latest_withdrawals_root later (:877-926)."""
+        # [New in Gloas:EIP7732] no-op when the parent block was empty
+        if not self.is_parent_block_full(state):
+            return
+
+        (
+            withdrawals,
+            processed_builder_withdrawals_count,
+            processed_partial_withdrawals_count,
+        ) = self.get_expected_withdrawals(state)
+        withdrawals_list = List[self.Withdrawal, self.MAX_WITHDRAWALS_PER_PAYLOAD](withdrawals)
+        state.latest_withdrawals_root = hash_tree_root(withdrawals_list)
+        for withdrawal in withdrawals:
+            self.decrease_balance(state, int(withdrawal.validator_index), int(withdrawal.amount))
+
+        # update the pending builder withdrawals
+        remaining = [
+            w
+            for w in list(state.builder_pending_withdrawals)[
+                :processed_builder_withdrawals_count
+            ]
+            if not self.is_builder_payment_withdrawable(state, w)
+        ]
+        state.builder_pending_withdrawals = remaining + list(
+            state.builder_pending_withdrawals
+        )[processed_builder_withdrawals_count:]
+
+        state.pending_partial_withdrawals = list(state.pending_partial_withdrawals)[
+            processed_partial_withdrawals_count:
+        ]
+
+        if len(withdrawals) != 0:
+            state.next_withdrawal_index = int(withdrawals[-1].index) + 1
+
+        if len(withdrawals) == self.MAX_WITHDRAWALS_PER_PAYLOAD:
+            state.next_withdrawal_validator_index = (
+                int(withdrawals[-1].validator_index) + 1
+            ) % len(state.validators)
+        else:
+            next_index = (
+                int(state.next_withdrawal_validator_index)
+                + self.MAX_VALIDATORS_PER_WITHDRAWALS_SWEEP
+            )
+            state.next_withdrawal_validator_index = next_index % len(state.validators)
+
+    # == execution payload bid (:931-1007) =================================
+
+    def verify_execution_payload_bid_signature(self, state, signed_bid) -> bool:
+        builder = state.validators[int(signed_bid.message.builder_index)]
+        signing_root = self.compute_signing_root(
+            signed_bid.message, self.get_domain(state, self.DOMAIN_BEACON_BUILDER)
+        )
+        return bls.Verify(builder.pubkey, signing_root, signed_bid.signature)
+
+    def process_execution_payload_bid(self, state, block) -> None:
+        signed_bid = block.body.signed_execution_payload_bid
+        bid = signed_bid.message
+        builder_index = int(bid.builder_index)
+        builder = state.validators[builder_index]
+
+        amount = int(bid.value)
+        # self-builds bid zero and carry the infinity signature
+        if builder_index == int(block.proposer_index):
+            assert amount == 0, "self-build bid must be zero"
+            assert bytes(signed_bid.signature) == bls.G2_POINT_AT_INFINITY, (
+                "self-build must use infinity signature"
+            )
+        else:
+            assert self.has_builder_withdrawal_credential(builder), "not a builder credential"
+            assert self.verify_execution_payload_bid_signature(state, signed_bid), (
+                "invalid bid signature"
+            )
+
+        assert self.is_active_validator(builder, self.get_current_epoch(state)), (
+            "builder not active"
+        )
+        assert not builder.slashed, "builder slashed"
+
+        pending_payments = sum(
+            int(p.withdrawal.amount)
+            for p in state.builder_pending_payments
+            if int(p.withdrawal.builder_index) == builder_index
+        )
+        pending_withdrawals = sum(
+            int(w.amount)
+            for w in state.builder_pending_withdrawals
+            if int(w.builder_index) == builder_index
+        )
+        assert (
+            amount == 0
+            or int(state.balances[builder_index])
+            >= amount + pending_payments + pending_withdrawals + self.MIN_ACTIVATION_BALANCE
+        ), "builder cannot cover bid"
+
+        assert int(bid.slot) == int(block.slot), "bid for wrong slot"
+        assert bytes(bid.parent_block_hash) == bytes(state.latest_block_hash), (
+            "bid parent hash mismatch"
+        )
+        assert bytes(bid.parent_block_root) == bytes(block.parent_root), (
+            "bid parent root mismatch"
+        )
+        assert bytes(bid.prev_randao) == bytes(
+            self.get_randao_mix(state, self.get_current_epoch(state))
+        ), "bid randao mismatch"
+
+        if amount > 0:
+            pending_payment = self.BuilderPendingPayment(
+                weight=0,
+                withdrawal=self.BuilderPendingWithdrawal(
+                    fee_recipient=bid.fee_recipient,
+                    amount=amount,
+                    builder_index=builder_index,
+                    withdrawable_epoch=self.FAR_FUTURE_EPOCH,
+                ),
+            )
+            state.builder_pending_payments[
+                self.SLOTS_PER_EPOCH + int(bid.slot) % self.SLOTS_PER_EPOCH
+            ] = pending_payment
+
+        state.latest_execution_payload_bid = bid
+
+    # == operations (:1011-1204) ===========================================
+
+    def process_operations(self, state, body) -> None:
+        """[Modified in Gloas] PTC attestations in; request ops move to the
+        envelope (:1018-1050)."""
+        eth1_deposit_index_limit = min(
+            int(state.eth1_data.deposit_count), int(state.deposit_requests_start_index)
+        )
+        if int(state.eth1_deposit_index) < eth1_deposit_index_limit:
+            assert len(body.deposits) == min(
+                self.MAX_DEPOSITS, eth1_deposit_index_limit - int(state.eth1_deposit_index)
+            ), "wrong deposit count"
+        else:
+            assert len(body.deposits) == 0, "deposits no longer allowed"
+
+        for operation in body.proposer_slashings:
+            self.process_proposer_slashing(state, operation)
+        for operation in body.attester_slashings:
+            self.process_attester_slashing(state, operation)
+        # batch-verification seam: one RLC pairing per block (phase0.py)
+        self._process_attestations(state, body.attestations)
+        for operation in body.deposits:
+            self.process_deposit(state, operation)
+        for operation in body.voluntary_exits:
+            self.process_voluntary_exit(state, operation)
+        for operation in body.bls_to_execution_changes:
+            self.process_bls_to_execution_change(state, operation)
+        # [New in Gloas:EIP7732]
+        for operation in body.payload_attestations:
+            self.process_payload_attestation(state, operation)
+
+    def process_attestation(self, state, attestation) -> None:
+        """[Modified in Gloas] index signals payload availability; same-slot
+        attesters add weight to the slot's builder payment (:1061-1142)."""
+        data = attestation.data
+        assert data.target.epoch in (
+            self.get_previous_epoch(state),
+            self.get_current_epoch(state),
+        ), "target epoch out of range"
+        assert data.target.epoch == self.compute_epoch_at_slot(data.slot), "target/slot mismatch"
+        assert (
+            int(data.slot) + self.MIN_ATTESTATION_INCLUSION_DELAY <= state.slot
+        ), "attestation too recent"
+
+        # [Modified in Gloas:EIP7732]
+        assert int(data.index) < 2, "index must encode payload availability (0/1)"
+        committee_indices = self.get_committee_indices(attestation.committee_bits)
+        committee_offset = 0
+        for committee_index in committee_indices:
+            assert committee_index < self.get_committee_count_per_slot(
+                state, data.target.epoch
+            ), "committee index out of range"
+            committee = self.get_beacon_committee(state, data.slot, committee_index)
+            committee_attesters = {
+                int(attester_index)
+                for i, attester_index in enumerate(committee)
+                if attestation.aggregation_bits[committee_offset + i]
+            }
+            assert len(committee_attesters) > 0, "empty committee participation"
+            committee_offset += len(committee)
+        assert len(attestation.aggregation_bits) == committee_offset, "bitlist length mismatch"
+
+        participation_flag_indices = self.get_attestation_participation_flag_indices(
+            state, data, int(state.slot) - int(data.slot)
+        )
+        assert self.is_valid_indexed_attestation(
+            state, self.get_indexed_attestation(state, attestation)
+        ), "invalid aggregate signature"
+
+        # [Modified in Gloas:EIP7732]
+        if data.target.epoch == self.get_current_epoch(state):
+            current_epoch_target = True
+            epoch_participation = state.current_epoch_participation
+            payment_index = self.SLOTS_PER_EPOCH + int(data.slot) % self.SLOTS_PER_EPOCH
+        else:
+            current_epoch_target = False
+            epoch_participation = state.previous_epoch_participation
+            payment_index = int(data.slot) % self.SLOTS_PER_EPOCH
+        payment = state.builder_pending_payments[payment_index].copy()
+
+        proposer_reward_numerator = 0
+        for index in self.get_attesting_indices(state, attestation):
+            will_set_new_flag = False
+            for flag_index, weight in enumerate(self.PARTICIPATION_FLAG_WEIGHTS):
+                if flag_index in participation_flag_indices and not self.has_flag(
+                    epoch_participation[index], flag_index
+                ):
+                    epoch_participation[index] = self.add_flag(
+                        epoch_participation[index], flag_index
+                    )
+                    proposer_reward_numerator += self.get_base_reward(state, index) * weight
+                    will_set_new_flag = True
+
+            # [New in Gloas:EIP7732] same-slot attesters weight the payment
+            if (
+                will_set_new_flag
+                and self.is_attestation_same_slot(state, data)
+                and int(payment.withdrawal.amount) > 0
+            ):
+                payment.weight = int(payment.weight) + int(
+                    state.validators[index].effective_balance
+                )
+
+        proposer_reward_denominator = (
+            (self.WEIGHT_DENOMINATOR - self.PROPOSER_WEIGHT)
+            * self.WEIGHT_DENOMINATOR
+            // self.PROPOSER_WEIGHT
+        )
+        proposer_reward = proposer_reward_numerator // proposer_reward_denominator
+        self.increase_balance(state, self.get_beacon_proposer_index(state), proposer_reward)
+
+        # [New in Gloas:EIP7732]
+        state.builder_pending_payments[payment_index] = payment
+
+    def process_payload_attestation(self, state, payload_attestation) -> None:
+        """(:1149-1163)"""
+        data = payload_attestation.data
+        assert bytes(data.beacon_block_root) == bytes(state.latest_block_header.parent_root), (
+            "payload attestation not for parent block"
+        )
+        assert int(data.slot) + 1 == int(state.slot), "payload attestation not for previous slot"
+        indexed_payload_attestation = self.get_indexed_payload_attestation(
+            state, int(data.slot), payload_attestation
+        )
+        assert self.is_valid_indexed_payload_attestation(
+            state, indexed_payload_attestation
+        ), "invalid payload attestation signature"
+
+    def process_proposer_slashing(self, state, proposer_slashing) -> None:
+        """[Modified in Gloas] voids the slot's pending builder payment
+        (:1170-1203)."""
+        super().process_proposer_slashing(state, proposer_slashing)
+        slot = int(proposer_slashing.signed_header_1.message.slot)
+        proposal_epoch = self.compute_epoch_at_slot(slot)
+        if proposal_epoch == self.get_current_epoch(state):
+            payment_index = self.SLOTS_PER_EPOCH + slot % self.SLOTS_PER_EPOCH
+            state.builder_pending_payments[payment_index] = self.BuilderPendingPayment()
+        elif proposal_epoch == self.get_previous_epoch(state):
+            payment_index = slot % self.SLOTS_PER_EPOCH
+            state.builder_pending_payments[payment_index] = self.BuilderPendingPayment()
+
+    # == execution payload (envelope) processing (:1208-1318) ==============
+
+    def verify_execution_payload_envelope_signature(self, state, signed_envelope) -> bool:
+        builder = state.validators[int(signed_envelope.message.builder_index)]
+        signing_root = self.compute_signing_root(
+            signed_envelope.message, self.get_domain(state, self.DOMAIN_BEACON_BUILDER)
+        )
+        return bls.Verify(builder.pubkey, signing_root, signed_envelope.signature)
+
+    def process_execution_payload(self, state, signed_envelope, execution_engine, verify=True):
+        """[Modified in Gloas] independent transition step importing the
+        builder's payload envelope (:1228-1318)."""
+        envelope = signed_envelope.message
+        payload = envelope.payload
+
+        if verify:
+            assert self.verify_execution_payload_envelope_signature(
+                state, signed_envelope
+            ), "invalid envelope signature"
+
+        previous_state_root = hash_tree_root(state)
+        if bytes(state.latest_block_header.state_root) == b"\x00" * 32:
+            state.latest_block_header.state_root = previous_state_root
+
+        assert bytes(envelope.beacon_block_root) == bytes(
+            hash_tree_root(state.latest_block_header)
+        ), "envelope not for latest block"
+        assert int(envelope.slot) == int(state.slot), "envelope for wrong slot"
+
+        committed_bid = state.latest_execution_payload_bid
+        assert int(envelope.builder_index) == int(committed_bid.builder_index), (
+            "wrong builder"
+        )
+        assert bytes(committed_bid.blob_kzg_commitments_root) == bytes(
+            hash_tree_root(envelope.blob_kzg_commitments)
+        ), "commitments root mismatch"
+        assert bytes(committed_bid.prev_randao) == bytes(payload.prev_randao), (
+            "randao mismatch"
+        )
+        assert bytes(hash_tree_root(payload.withdrawals)) == bytes(
+            state.latest_withdrawals_root
+        ), "withdrawals root mismatch"
+        assert int(committed_bid.gas_limit) == int(payload.gas_limit), "gas limit mismatch"
+        assert bytes(committed_bid.block_hash) == bytes(payload.block_hash), (
+            "block hash mismatch"
+        )
+        assert bytes(payload.parent_hash) == bytes(state.latest_block_hash), (
+            "payload parent mismatch"
+        )
+        assert payload.timestamp == self.compute_timestamp_at_slot(state, state.slot), (
+            "wrong payload timestamp"
+        )
+        assert (
+            len(envelope.blob_kzg_commitments)
+            <= self.get_blob_parameters(self.get_current_epoch(state)).max_blobs_per_block
+        ), "too many blobs"
+        versioned_hashes = [
+            self.kzg_commitment_to_versioned_hash(commitment)
+            for commitment in envelope.blob_kzg_commitments
+        ]
+        requests = envelope.execution_requests
+        assert execution_engine.verify_and_notify_new_payload(
+            self.NewPayloadRequest(
+                execution_payload=payload,
+                versioned_hashes=versioned_hashes,
+                parent_beacon_block_root=state.latest_block_header.parent_root,
+                execution_requests=requests,
+            )
+        ), "execution engine rejected payload"
+
+        for operation in requests.deposits:
+            self.process_deposit_request(state, operation)
+        for operation in requests.withdrawals:
+            self.process_withdrawal_request(state, operation)
+        for operation in requests.consolidations:
+            self.process_consolidation_request(state, operation)
+
+        # queue the builder payment
+        payment_index = self.SLOTS_PER_EPOCH + int(state.slot) % self.SLOTS_PER_EPOCH
+        payment = state.builder_pending_payments[payment_index].copy()
+        amount = int(payment.withdrawal.amount)
+        if amount > 0:
+            exit_queue_epoch = self.compute_exit_epoch_and_update_churn(state, amount)
+            withdrawal = payment.withdrawal.copy()
+            withdrawal.withdrawable_epoch = (
+                int(exit_queue_epoch) + self.config.MIN_VALIDATOR_WITHDRAWABILITY_DELAY
+            )
+            state.builder_pending_withdrawals.append(withdrawal)
+        state.builder_pending_payments[payment_index] = self.BuilderPendingPayment()
+
+        # cache the execution payload hash + availability
+        availability = list(state.execution_payload_availability)
+        availability[int(state.slot) % self.SLOTS_PER_HISTORICAL_ROOT] = 1
+        state.execution_payload_availability = availability
+        state.latest_block_hash = payload.block_hash
+
+        if verify:
+            assert bytes(envelope.state_root) == bytes(hash_tree_root(state)), (
+                "envelope state root mismatch"
+            )
+
+    # == fork upgrade (specs/gloas/fork.md:34-110) =========================
+
+    def upgrade_from_parent(self, pre):
+        epoch = self.compute_epoch_at_slot(int(pre.slot))
+        post = self.BeaconState(
+            genesis_time=pre.genesis_time,
+            genesis_validators_root=pre.genesis_validators_root,
+            slot=pre.slot,
+            fork=self.Fork(
+                previous_version=pre.fork.current_version,
+                current_version=Version(self.config.GLOAS_FORK_VERSION),
+                epoch=epoch,
+            ),
+            latest_block_header=pre.latest_block_header,
+            block_roots=list(pre.block_roots),
+            state_roots=list(pre.state_roots),
+            historical_roots=list(pre.historical_roots),
+            eth1_data=pre.eth1_data,
+            eth1_data_votes=list(pre.eth1_data_votes),
+            eth1_deposit_index=pre.eth1_deposit_index,
+            validators=list(pre.validators),
+            balances=list(pre.balances),
+            randao_mixes=list(pre.randao_mixes),
+            slashings=list(pre.slashings),
+            previous_epoch_participation=list(pre.previous_epoch_participation),
+            current_epoch_participation=list(pre.current_epoch_participation),
+            justification_bits=list(pre.justification_bits),
+            previous_justified_checkpoint=pre.previous_justified_checkpoint,
+            current_justified_checkpoint=pre.current_justified_checkpoint,
+            finalized_checkpoint=pre.finalized_checkpoint,
+            inactivity_scores=list(pre.inactivity_scores),
+            current_sync_committee=pre.current_sync_committee,
+            next_sync_committee=pre.next_sync_committee,
+            # [New in Gloas:EIP7732]
+            latest_execution_payload_bid=self.ExecutionPayloadBid(
+                block_hash=pre.latest_execution_payload_header.block_hash,
+            ),
+            next_withdrawal_index=pre.next_withdrawal_index,
+            next_withdrawal_validator_index=pre.next_withdrawal_validator_index,
+            historical_summaries=list(pre.historical_summaries),
+            deposit_requests_start_index=pre.deposit_requests_start_index,
+            deposit_balance_to_consume=pre.deposit_balance_to_consume,
+            exit_balance_to_consume=pre.exit_balance_to_consume,
+            earliest_exit_epoch=pre.earliest_exit_epoch,
+            consolidation_balance_to_consume=pre.consolidation_balance_to_consume,
+            earliest_consolidation_epoch=pre.earliest_consolidation_epoch,
+            pending_deposits=list(pre.pending_deposits),
+            pending_partial_withdrawals=list(pre.pending_partial_withdrawals),
+            pending_consolidations=list(pre.pending_consolidations),
+            proposer_lookahead=list(pre.proposer_lookahead),
+            # [New in Gloas:EIP7732]
+            execution_payload_availability=[1] * self.SLOTS_PER_HISTORICAL_ROOT,
+            builder_pending_payments=[
+                self.BuilderPendingPayment() for _ in range(2 * self.SLOTS_PER_EPOCH)
+            ],
+            builder_pending_withdrawals=[],
+            latest_block_hash=pre.latest_execution_payload_header.block_hash,
+            latest_withdrawals_root=Root(),
+        )
+        return post

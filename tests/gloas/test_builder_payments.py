@@ -1,0 +1,107 @@
+"""Builder pending-payment settlement and payment weighting
+(reference: specs/gloas/beacon-chain.md:698-717, :1093-1141, :624-634)."""
+
+from eth_consensus_specs_tpu.test_infra.attestations import (
+    get_valid_attestation,
+)
+from eth_consensus_specs_tpu.test_infra.context import (
+    spec_state_test,
+    with_phases,
+)
+from eth_consensus_specs_tpu.test_infra.state import next_epoch, next_slot, next_slots
+
+
+def _seed_payment(spec, state, slot: int, amount: int, current_epoch: bool = True):
+    index = (spec.SLOTS_PER_EPOCH if current_epoch else 0) + slot % spec.SLOTS_PER_EPOCH
+    payment = state.builder_pending_payments[index].copy()
+    payment.withdrawal.amount = amount
+    payment.withdrawal.builder_index = 0
+    payment.withdrawal.fee_recipient = b"\x77" * 20
+    payment.withdrawal.withdrawable_epoch = spec.FAR_FUTURE_EPOCH
+    state.builder_pending_payments[index] = payment
+    return index
+
+
+@with_phases(["gloas"])
+@spec_state_test
+def test_quorum_threshold_value(spec, state):
+    per_slot = spec.get_total_active_balance(state) // spec.SLOTS_PER_EPOCH
+    expected = per_slot * spec.BUILDER_PAYMENT_THRESHOLD_NUMERATOR
+    expected //= spec.BUILDER_PAYMENT_THRESHOLD_DENOMINATOR
+    assert spec.get_builder_payment_quorum_threshold(state) == expected
+
+
+@with_phases(["gloas"])
+@spec_state_test
+def test_above_quorum_payment_settles_at_epoch(spec, state):
+    quorum = spec.get_builder_payment_quorum_threshold(state)
+    idx = _seed_payment(spec, state, 0, spec.EFFECTIVE_BALANCE_INCREMENT, current_epoch=False)
+    payment = state.builder_pending_payments[idx].copy()
+    payment.weight = quorum + 1
+    state.builder_pending_payments[idx] = payment
+
+    spec.process_builder_pending_payments(state)
+    assert len(state.builder_pending_withdrawals) == 1
+    assert int(state.builder_pending_withdrawals[0].amount) == spec.EFFECTIVE_BALANCE_INCREMENT
+    # window shifted: last epoch's boxes are all empty defaults
+    for p in list(state.builder_pending_payments)[spec.SLOTS_PER_EPOCH :]:
+        assert int(p.withdrawal.amount) == 0
+
+
+@with_phases(["gloas"])
+@spec_state_test
+def test_below_quorum_payment_dropped(spec, state):
+    quorum = spec.get_builder_payment_quorum_threshold(state)
+    idx = _seed_payment(spec, state, 0, spec.EFFECTIVE_BALANCE_INCREMENT, current_epoch=False)
+    payment = state.builder_pending_payments[idx].copy()
+    payment.weight = quorum  # strictly-greater required
+    state.builder_pending_payments[idx] = payment
+
+    spec.process_builder_pending_payments(state)
+    assert len(state.builder_pending_withdrawals) == 0
+
+
+@with_phases(["gloas"])
+@spec_state_test
+def test_same_slot_attestation_weights_payment(spec, state):
+    """Attesters for the current slot's block add their effective balance
+    to that slot's pending payment (:1119-1127). Same-slot requires a real
+    block at the attested slot (root differs from the previous slot)."""
+    from eth_consensus_specs_tpu.test_infra.block import (
+        build_empty_block_for_next_slot,
+        state_transition_and_sign_block,
+    )
+
+    block = build_empty_block_for_next_slot(spec, state)
+    state_transition_and_sign_block(spec, state, block)
+    attestation = get_valid_attestation(spec, state, signed=True)
+    next_slot(spec, state)  # satisfy MIN_ATTESTATION_INCLUSION_DELAY
+    assert spec.is_attestation_same_slot(state, attestation.data)
+
+    slot = int(attestation.data.slot)
+    idx = _seed_payment(spec, state, slot, spec.EFFECTIVE_BALANCE_INCREMENT)
+    before = int(state.builder_pending_payments[idx].weight)
+
+    spec.process_attestation(state, attestation)
+    after = int(state.builder_pending_payments[idx].weight)
+    attesters = spec.get_attesting_indices(state, attestation)
+    expected = sum(int(state.validators[i].effective_balance) for i in attesters)
+    assert after - before == expected
+
+
+@with_phases(["gloas"])
+@spec_state_test
+def test_attestation_without_payment_adds_no_weight(spec, state):
+    from eth_consensus_specs_tpu.test_infra.block import (
+        build_empty_block_for_next_slot,
+        state_transition_and_sign_block,
+    )
+
+    block = build_empty_block_for_next_slot(spec, state)
+    state_transition_and_sign_block(spec, state, block)
+    attestation = get_valid_attestation(spec, state, signed=True)
+    next_slot(spec, state)
+    slot = int(attestation.data.slot)
+    idx = spec.SLOTS_PER_EPOCH + slot % spec.SLOTS_PER_EPOCH
+    spec.process_attestation(state, attestation)
+    assert int(state.builder_pending_payments[idx].weight) == 0

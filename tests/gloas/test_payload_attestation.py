@@ -1,0 +1,127 @@
+"""PTC payload attestations (reference: specs/gloas/beacon-chain.md:584-622,
+:1146-1163)."""
+
+from eth_consensus_specs_tpu.ssz import hash_tree_root
+from eth_consensus_specs_tpu.test_infra.block import (
+    build_empty_block_for_next_slot,
+    state_transition_and_sign_block,
+)
+from eth_consensus_specs_tpu.test_infra.context import (
+    expect_assertion_error,
+    spec_state_test,
+    with_phases,
+)
+from eth_consensus_specs_tpu.test_infra.keys import privkeys
+from eth_consensus_specs_tpu.test_infra.state import next_slot
+from eth_consensus_specs_tpu.utils import bls
+
+
+def _valid_payload_attestation(spec, state, payload_present=True):
+    """PTC attestation for the parent block at the previous slot."""
+    data = spec.PayloadAttestationData(
+        beacon_block_root=state.latest_block_header.parent_root,
+        slot=int(state.slot) - 1,
+        payload_present=payload_present,
+        blob_data_available=payload_present,
+    )
+    ptc = spec.get_ptc(state, int(data.slot))
+    bits = [True] * len(ptc)
+    domain = spec.get_domain(state, spec.DOMAIN_PTC_ATTESTER, None)
+    signing_root = spec.compute_signing_root(data, domain)
+    sigs = [bls.Sign(privkeys[i], signing_root) for i in sorted(set(ptc))]
+    return spec.PayloadAttestation(
+        aggregation_bits=bits, data=data, signature=bls.Aggregate(sigs)
+    )
+
+
+def _advance_two_blocks(spec, state):
+    for _ in range(2):
+        block = build_empty_block_for_next_slot(spec, state)
+        state_transition_and_sign_block(spec, state, block)
+
+
+@with_phases(["gloas"])
+@spec_state_test
+def test_ptc_is_deterministic_and_sized(spec, state):
+    next_slot(spec, state)
+    ptc = spec.get_ptc(state, int(state.slot))
+    assert len(ptc) == spec.PTC_SIZE
+    assert ptc == spec.get_ptc(state, int(state.slot))
+    for v in ptc:
+        assert 0 <= int(v) < len(state.validators)
+
+
+@with_phases(["gloas"])
+@spec_state_test
+def test_process_payload_attestation_basic(spec, state):
+    _advance_two_blocks(spec, state)
+    att = _valid_payload_attestation(spec, state)
+    spec.process_payload_attestation(state, att)
+
+
+@with_phases(["gloas"])
+@spec_state_test
+def test_payload_attestation_wrong_root_invalid(spec, state):
+    _advance_two_blocks(spec, state)
+    att = _valid_payload_attestation(spec, state)
+    att.data.beacon_block_root = b"\x21" * 32
+    expect_assertion_error(lambda: spec.process_payload_attestation(state, att))
+
+
+@with_phases(["gloas"])
+@spec_state_test
+def test_payload_attestation_wrong_slot_invalid(spec, state):
+    _advance_two_blocks(spec, state)
+    att = _valid_payload_attestation(spec, state)
+    att.data.slot = int(state.slot)  # must be previous slot
+    expect_assertion_error(lambda: spec.process_payload_attestation(state, att))
+
+
+@with_phases(["gloas"])
+@spec_state_test
+def test_indexed_payload_attestation_sorted(spec, state):
+    _advance_two_blocks(spec, state)
+    att = _valid_payload_attestation(spec, state)
+    indexed = spec.get_indexed_payload_attestation(state, int(att.data.slot), att)
+    idx = [int(i) for i in indexed.attesting_indices]
+    assert idx == sorted(idx)
+    assert spec.is_valid_indexed_payload_attestation(state, indexed)
+
+
+@with_phases(["gloas"])
+@spec_state_test
+def test_indexed_payload_attestation_empty_invalid(spec, state):
+    _advance_two_blocks(spec, state)
+    att = _valid_payload_attestation(spec, state)
+    att.aggregation_bits = [False] * spec.PTC_SIZE
+    indexed = spec.get_indexed_payload_attestation(state, int(att.data.slot), att)
+    assert not spec.is_valid_indexed_payload_attestation(state, indexed)
+
+
+@with_phases(["gloas"])
+@spec_state_test
+def test_block_carries_payload_attestation(spec, state):
+    """End-to-end: a block including a PTC attestation for its parent."""
+    block = build_empty_block_for_next_slot(spec, state)
+    state_transition_and_sign_block(spec, state, block)
+
+    block = build_empty_block_for_next_slot(spec, state)
+    # data targets the parent block (previous slot) as seen when the new
+    # block's header is in place during processing
+    probe = state.copy()
+    spec.process_slots(probe, block.slot)
+    data = spec.PayloadAttestationData(
+        beacon_block_root=block.parent_root,
+        slot=int(block.slot) - 1,
+        payload_present=False,
+        blob_data_available=False,
+    )
+    ptc = spec.get_ptc(probe, int(data.slot))
+    domain = spec.get_domain(probe, spec.DOMAIN_PTC_ATTESTER, None)
+    signing_root = spec.compute_signing_root(data, domain)
+    sigs = [bls.Sign(privkeys[i], signing_root) for i in sorted(set(ptc))]
+    att = spec.PayloadAttestation(
+        aggregation_bits=[True] * len(ptc), data=data, signature=bls.Aggregate(sigs)
+    )
+    block.body.payload_attestations = [att]
+    state_transition_and_sign_block(spec, state, block)

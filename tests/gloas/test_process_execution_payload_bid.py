@@ -1,0 +1,149 @@
+"""Execution payload bid processing (EIP-7732)
+(reference: specs/gloas/beacon-chain.md:944-1007 and
+eth2spec/test/gloas/block_processing/test_process_execution_payload_bid.py)."""
+
+from eth_consensus_specs_tpu.test_infra.block import (
+    build_empty_block_for_next_slot,
+    build_empty_signed_execution_payload_bid,
+    state_transition_and_sign_block,
+)
+from eth_consensus_specs_tpu.test_infra.context import (
+    expect_assertion_error,
+    spec_state_test,
+    with_phases,
+)
+from eth_consensus_specs_tpu.test_infra.state import next_slot
+from eth_consensus_specs_tpu.utils import bls
+
+
+def _prepared_block(spec, state):
+    """Block for the next slot with a fresh self-build bid; state advanced
+    to the block's slot so process_execution_payload_bid can run directly."""
+    block = build_empty_block_for_next_slot(spec, state)
+    spec.process_slots(state, block.slot)
+    return block
+
+
+def _make_builder(spec, state, index: int, balance: int):
+    creds = bytes(spec.BUILDER_WITHDRAWAL_PREFIX) + b"\x00" * 11 + b"\x42" * 20
+    state.validators[index].withdrawal_credentials = creds
+    state.balances[index] = balance
+    state.validators[index].effective_balance = min(
+        balance - balance % spec.EFFECTIVE_BALANCE_INCREMENT, spec.MAX_EFFECTIVE_BALANCE_ELECTRA
+    )
+
+
+@with_phases(["gloas"])
+@spec_state_test
+def test_self_build_zero_bid(spec, state):
+    block = _prepared_block(spec, state)
+    spec.process_execution_payload_bid(state, block)
+    bid = block.body.signed_execution_payload_bid.message
+    assert state.latest_execution_payload_bid == bid
+
+
+@with_phases(["gloas"])
+@spec_state_test
+def test_self_build_nonzero_value_invalid(spec, state):
+    block = _prepared_block(spec, state)
+    block.body.signed_execution_payload_bid.message.value = 1
+    expect_assertion_error(lambda: spec.process_execution_payload_bid(state, block))
+
+
+@with_phases(["gloas"])
+@spec_state_test
+def test_self_build_wrong_signature_invalid(spec, state):
+    block = _prepared_block(spec, state)
+    block.body.signed_execution_payload_bid.signature = b"\x11" * 96
+    expect_assertion_error(lambda: spec.process_execution_payload_bid(state, block))
+
+
+@with_phases(["gloas"])
+@spec_state_test
+def test_builder_bid_records_pending_payment(spec, state):
+    block = _prepared_block(spec, state)
+    proposer = int(block.proposer_index)
+    builder_index = (proposer + 1) % len(state.validators)
+    _make_builder(spec, state, builder_index, 2 * spec.MIN_ACTIVATION_BALANCE)
+
+    bid = block.body.signed_execution_payload_bid.message
+    bid.builder_index = builder_index
+    bid.value = spec.EFFECTIVE_BALANCE_INCREMENT
+    signed = spec.SignedExecutionPayloadBid(message=bid, signature=b"\x00" * 96)
+    # bls is off in this suite: Verify stubs true, matching the reference's
+    # bls_switch convention for non-@always_bls tests
+    block.body.signed_execution_payload_bid = signed
+
+    spec.process_execution_payload_bid(state, block)
+    payment = state.builder_pending_payments[
+        spec.SLOTS_PER_EPOCH + int(bid.slot) % spec.SLOTS_PER_EPOCH
+    ]
+    assert int(payment.withdrawal.amount) == int(bid.value)
+    assert int(payment.withdrawal.builder_index) == builder_index
+    assert int(payment.weight) == 0
+
+
+@with_phases(["gloas"])
+@spec_state_test
+def test_builder_bid_without_builder_credential_invalid(spec, state):
+    block = _prepared_block(spec, state)
+    proposer = int(block.proposer_index)
+    builder_index = (proposer + 1) % len(state.validators)
+    # no 0x03 credential installed
+    bid = block.body.signed_execution_payload_bid.message
+    bid.builder_index = builder_index
+    bid.value = 0
+    block.body.signed_execution_payload_bid = spec.SignedExecutionPayloadBid(
+        message=bid, signature=b"\x00" * 96
+    )
+    expect_assertion_error(lambda: spec.process_execution_payload_bid(state, block))
+
+
+@with_phases(["gloas"])
+@spec_state_test
+def test_builder_bid_insufficient_balance_invalid(spec, state):
+    block = _prepared_block(spec, state)
+    proposer = int(block.proposer_index)
+    builder_index = (proposer + 1) % len(state.validators)
+    _make_builder(spec, state, builder_index, spec.MIN_ACTIVATION_BALANCE)  # no excess
+
+    bid = block.body.signed_execution_payload_bid.message
+    bid.builder_index = builder_index
+    bid.value = spec.EFFECTIVE_BALANCE_INCREMENT
+    block.body.signed_execution_payload_bid = spec.SignedExecutionPayloadBid(
+        message=bid, signature=b"\x00" * 96
+    )
+    expect_assertion_error(lambda: spec.process_execution_payload_bid(state, block))
+
+
+@with_phases(["gloas"])
+@spec_state_test
+def test_slashed_builder_invalid(spec, state):
+    block = _prepared_block(spec, state)
+    proposer = int(block.proposer_index)
+    builder_index = (proposer + 1) % len(state.validators)
+    _make_builder(spec, state, builder_index, 2 * spec.MIN_ACTIVATION_BALANCE)
+    state.validators[builder_index].slashed = True
+
+    bid = block.body.signed_execution_payload_bid.message
+    bid.builder_index = builder_index
+    block.body.signed_execution_payload_bid = spec.SignedExecutionPayloadBid(
+        message=bid, signature=b"\x00" * 96
+    )
+    expect_assertion_error(lambda: spec.process_execution_payload_bid(state, block))
+
+
+@with_phases(["gloas"])
+@spec_state_test
+def test_bid_wrong_parent_hash_invalid(spec, state):
+    block = _prepared_block(spec, state)
+    block.body.signed_execution_payload_bid.message.parent_block_hash = b"\x13" * 32
+    expect_assertion_error(lambda: spec.process_execution_payload_bid(state, block))
+
+
+@with_phases(["gloas"])
+@spec_state_test
+def test_bid_wrong_slot_invalid(spec, state):
+    block = _prepared_block(spec, state)
+    block.body.signed_execution_payload_bid.message.slot = int(block.slot) + 1
+    expect_assertion_error(lambda: spec.process_execution_payload_bid(state, block))

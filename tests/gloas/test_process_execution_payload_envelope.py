@@ -1,0 +1,125 @@
+"""Envelope import — the second half of the split transition
+(reference: specs/gloas/beacon-chain.md:1221-1318 and
+eth2spec/test/gloas/block_processing/test_process_execution_payload.py)."""
+
+from eth_consensus_specs_tpu.ssz import hash_tree_root
+from eth_consensus_specs_tpu.test_infra.block import (
+    build_empty_block_for_next_slot,
+    build_signed_execution_payload_envelope,
+    state_transition_and_sign_block,
+)
+from eth_consensus_specs_tpu.test_infra.context import (
+    expect_assertion_error,
+    spec_state_test,
+    with_phases,
+)
+
+
+def _state_with_committed_bid(spec, state):
+    block = build_empty_block_for_next_slot(spec, state)
+    state_transition_and_sign_block(spec, state, block)
+    return block
+
+
+@with_phases(["gloas"])
+@spec_state_test
+def test_envelope_import_basic(spec, state):
+    _state_with_committed_bid(spec, state)
+    env = build_signed_execution_payload_envelope(spec, state)
+    spec.process_execution_payload(state, env, spec.EXECUTION_ENGINE)
+    assert spec.is_parent_block_full(state)
+    slot_index = int(state.slot) % spec.SLOTS_PER_HISTORICAL_ROOT
+    assert int(state.execution_payload_availability[slot_index]) == 1
+    assert bytes(state.latest_block_hash) == bytes(env.message.payload.block_hash)
+
+
+@with_phases(["gloas"])
+@spec_state_test
+def test_envelope_wrong_builder_invalid(spec, state):
+    _state_with_committed_bid(spec, state)
+    env = build_signed_execution_payload_envelope(spec, state)
+    env.message.builder_index = (int(env.message.builder_index) + 1) % len(state.validators)
+    expect_assertion_error(
+        lambda: spec.process_execution_payload(state, env, spec.EXECUTION_ENGINE)
+    )
+
+
+@with_phases(["gloas"])
+@spec_state_test
+def test_envelope_wrong_slot_invalid(spec, state):
+    _state_with_committed_bid(spec, state)
+    env = build_signed_execution_payload_envelope(spec, state)
+    env.message.slot = int(state.slot) + 1
+    expect_assertion_error(
+        lambda: spec.process_execution_payload(state, env, spec.EXECUTION_ENGINE)
+    )
+
+
+@with_phases(["gloas"])
+@spec_state_test
+def test_envelope_wrong_block_hash_invalid(spec, state):
+    _state_with_committed_bid(spec, state)
+    env = build_signed_execution_payload_envelope(spec, state)
+    env.message.payload.block_hash = b"\x66" * 32
+    expect_assertion_error(
+        lambda: spec.process_execution_payload(state, env, spec.EXECUTION_ENGINE)
+    )
+
+
+@with_phases(["gloas"])
+@spec_state_test
+def test_envelope_commitments_root_mismatch_invalid(spec, state):
+    _state_with_committed_bid(spec, state)
+    env = build_signed_execution_payload_envelope(spec, state)
+    env.message.blob_kzg_commitments = [b"\xc0" + b"\x00" * 47]
+    expect_assertion_error(
+        lambda: spec.process_execution_payload(state, env, spec.EXECUTION_ENGINE)
+    )
+
+
+@with_phases(["gloas"])
+@spec_state_test
+def test_envelope_withdrawals_root_mismatch_invalid(spec, state):
+    _state_with_committed_bid(spec, state)
+    env = build_signed_execution_payload_envelope(spec, state)
+    env.message.payload.withdrawals = [
+        spec.Withdrawal(index=0, validator_index=0, address=b"\x01" * 20, amount=1)
+    ]
+    expect_assertion_error(
+        lambda: spec.process_execution_payload(state, env, spec.EXECUTION_ENGINE)
+    )
+
+
+@with_phases(["gloas"])
+@spec_state_test
+def test_envelope_state_root_mismatch_invalid(spec, state):
+    _state_with_committed_bid(spec, state)
+    env = build_signed_execution_payload_envelope(spec, state)
+    env.message.state_root = b"\x99" * 32
+    expect_assertion_error(
+        lambda: spec.process_execution_payload(state, env, spec.EXECUTION_ENGINE)
+    )
+
+
+@with_phases(["gloas"])
+@spec_state_test
+def test_envelope_queues_builder_payment(spec, state):
+    """A pending payment for the current slot becomes a pending withdrawal
+    when the payload is revealed (:1298-1309)."""
+    block = _state_with_committed_bid(spec, state)
+    payment_index = spec.SLOTS_PER_EPOCH + int(state.slot) % spec.SLOTS_PER_EPOCH
+    payment = state.builder_pending_payments[payment_index].copy()
+    payment.withdrawal.amount = spec.EFFECTIVE_BALANCE_INCREMENT
+    payment.withdrawal.builder_index = int(block.proposer_index)
+    payment.withdrawal.withdrawable_epoch = spec.FAR_FUTURE_EPOCH
+    state.builder_pending_payments[payment_index] = payment
+
+    env = build_signed_execution_payload_envelope(spec, state)
+    spec.process_execution_payload(state, env, spec.EXECUTION_ENGINE)
+
+    assert len(state.builder_pending_withdrawals) == 1
+    w = state.builder_pending_withdrawals[0]
+    assert int(w.amount) == spec.EFFECTIVE_BALANCE_INCREMENT
+    assert int(w.withdrawable_epoch) < spec.FAR_FUTURE_EPOCH
+    # the slot's payment box is cleared
+    assert int(state.builder_pending_payments[payment_index].withdrawal.amount) == 0

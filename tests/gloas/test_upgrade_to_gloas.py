@@ -1,0 +1,37 @@
+"""fulu -> gloas state upgrade (spec: specs/gloas/fork.md:34-110)."""
+
+from eth_consensus_specs_tpu.forks import get_spec
+from eth_consensus_specs_tpu.ssz import hash_tree_root
+from eth_consensus_specs_tpu.test_infra.context import spec_state_test, with_phases
+from eth_consensus_specs_tpu.test_infra.state import next_epoch
+
+
+@with_phases(["fulu"])
+@spec_state_test
+def test_upgrade_to_gloas_basic(spec, state):
+    gloas = get_spec("gloas", spec.preset_name)
+    next_epoch(spec, state)
+    pre_header_hash = bytes(state.latest_execution_payload_header.block_hash)
+    post = gloas.upgrade_from_parent(state)
+    assert bytes(post.fork.current_version) == bytes(gloas.config.GLOAS_FORK_VERSION)
+    assert bytes(post.latest_execution_payload_bid.block_hash) == pre_header_hash
+    assert bytes(post.latest_block_hash) == pre_header_hash
+    assert gloas.is_parent_block_full(post)
+    assert all(int(b) == 1 for b in post.execution_payload_availability)
+    assert len(post.builder_pending_withdrawals) == 0
+    assert all(
+        int(p.withdrawal.amount) == 0 for p in post.builder_pending_payments
+    )
+    assert hash_tree_root(post.validators) == hash_tree_root(state.validators)
+    # the post-state remains executable
+    next_epoch(gloas, post)
+
+
+@with_phases(["fulu"])
+@spec_state_test
+def test_upgrade_to_gloas_preserves_lookahead(spec, state):
+    gloas = get_spec("gloas", spec.preset_name)
+    post = gloas.upgrade_from_parent(state)
+    assert [int(x) for x in post.proposer_lookahead] == [
+        int(x) for x in state.proposer_lookahead
+    ]
