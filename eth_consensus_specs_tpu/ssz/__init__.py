@@ -53,5 +53,24 @@ from .types import (
     hash_tree_root,
     uint_to_bytes,
 )
+from .progressive import (
+    ProgressiveBitlist,
+    ProgressiveByteList,
+    ProgressiveContainer,
+    ProgressiveList,
+    merkleize_progressive,
+    mix_in_active_fields,
+)
+from .gindex import (
+    GeneralizedIndex,
+    get_generalized_index,
+    concat_generalized_indices,
+    get_subtree_index,
+    get_helper_indices,
+    calculate_merkle_root,
+    calculate_multi_merkle_root,
+    verify_merkle_proof,
+    verify_merkle_multiproof,
+)
 
 __all__ = [k for k in dir() if not k.startswith("_")]

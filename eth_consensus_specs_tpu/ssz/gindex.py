@@ -1,0 +1,238 @@
+"""Generalized-index algebra and Merkle (multi)proofs.
+
+Behavioral parity target: ssz/merkle-proofs.md — the path→gindex mapping
+(:71-195), gindex helpers (:195-241), helper-index computation and
+single/multi-item proof verification (:243-380). `compute_merkle_proof`
+(the prover side used by the light-client protocol) lives in
+ssz/merkle.py; this module is the consumer-side algebra plus the
+type-directed gindex derivation over the first-party SSZ type system.
+
+The object→index mapping works on this package's types: `Container`
+fields, `List`/`Vector` elements (with length mix-in for lists),
+`ByteList`/`ByteVector` byte positions, and `Bitlist`/`Bitvector` bits —
+mirroring the reference's chunk-count rules exactly so hardcoded spec
+gindices (e.g. the light-client ones) agree.
+"""
+
+from __future__ import annotations
+
+from .hashing import hash_bytes
+from .types import (
+    BasicView,
+    Bitlist,
+    Bitvector,
+    ByteList,
+    ByteVector,
+    Container,
+    List,
+    Vector,
+    uint64,
+)
+
+GeneralizedIndex = int
+
+
+def get_power_of_two_ceil(x: int) -> int:
+    if x <= 1:
+        return 1
+    return 1 << (x - 1).bit_length()
+
+
+# == SSZ object -> index (ssz/merkle-proofs.md:71-195) ======================
+
+
+def item_length(typ) -> int:
+    """Bytes per element: basic types their width, compound types a hash."""
+    if isinstance(typ, type) and issubclass(typ, BasicView):
+        return typ.type_byte_length()
+    return 32
+
+
+def get_elem_type(typ, index_or_variable_name):
+    """Element type at an index (`7` for x[7]) or field name (`"foo"`)."""
+    if isinstance(typ, type) and issubclass(typ, Container):
+        return typ.fields()[index_or_variable_name]
+    if isinstance(typ, type) and issubclass(typ, (ByteList, ByteVector)):
+        from .types import uint8
+
+        return uint8
+    if isinstance(typ, type) and issubclass(typ, (Bitlist, Bitvector)):
+        from .types import boolean
+
+        return boolean
+    return typ.ELEMENT_TYPE
+
+
+def chunk_count(typ) -> int:
+    """Top-level chunk count of a type (ssz/merkle-proofs.md:121-141)."""
+    if isinstance(typ, type) and issubclass(typ, BasicView):
+        return 1
+    if isinstance(typ, type) and issubclass(typ, Bitvector):
+        return (typ.LENGTH + 255) // 256
+    if isinstance(typ, type) and issubclass(typ, Bitlist):
+        return (typ.LIMIT + 255) // 256
+    if isinstance(typ, type) and issubclass(typ, ByteVector):
+        return (typ.LENGTH + 31) // 32
+    if isinstance(typ, type) and issubclass(typ, ByteList):
+        return (typ.LIMIT + 31) // 32
+    if isinstance(typ, type) and issubclass(typ, Vector):
+        return (typ.LENGTH * item_length(typ.ELEMENT_TYPE) + 31) // 32
+    if isinstance(typ, type) and issubclass(typ, List):
+        return (typ.LIMIT * item_length(typ.ELEMENT_TYPE) + 31) // 32
+    if isinstance(typ, type) and issubclass(typ, Container):
+        return len(typ.fields())
+    raise TypeError(f"type not supported: {typ}")
+
+
+def get_item_position(typ, index_or_variable_name) -> tuple[int, int, int]:
+    """(chunk index, start byte in chunk, end byte in chunk)."""
+    if isinstance(typ, type) and issubclass(typ, Container):
+        names = list(typ.fields())
+        pos = names.index(index_or_variable_name)
+        return pos, 0, item_length(get_elem_type(typ, index_or_variable_name))
+    if isinstance(typ, type) and issubclass(
+        typ, (List, Vector, ByteList, ByteVector, Bitlist, Bitvector)
+    ):
+        index = int(index_or_variable_name)
+        elem_len = item_length(get_elem_type(typ, index))
+        if isinstance(typ, type) and issubclass(typ, (Bitlist, Bitvector)):
+            # bit-packed: 256 bits per chunk
+            return index // 256, (index % 256) // 8, (index % 256) // 8 + 1
+        start = index * elem_len
+        return start // 32, start % 32, start % 32 + elem_len
+    raise TypeError("only lists/vectors/containers supported")
+
+
+def _is_list_like(typ) -> bool:
+    return isinstance(typ, type) and issubclass(typ, (List, ByteList, Bitlist))
+
+
+def get_generalized_index(typ, *path) -> GeneralizedIndex:
+    """Path (e.g. `(7, "foo", 3)` or `("y", "__len__")`) → gindex
+    (ssz/merkle-proofs.md:166-193)."""
+    root = 1
+    for p in path:
+        assert not (isinstance(typ, type) and issubclass(typ, BasicView)), (
+            "path descends into a basic type"
+        )
+        if p == "__len__":
+            assert _is_list_like(typ), "__len__ only applies to lists"
+            typ = uint64
+            root = root * 2 + 1
+        else:
+            pos, _, _ = get_item_position(typ, p)
+            base_index = 2 if _is_list_like(typ) else 1
+            root = root * base_index * get_power_of_two_ceil(chunk_count(typ)) + pos
+            typ = get_elem_type(typ, p)
+    return root
+
+
+# == gindex helpers (ssz/merkle-proofs.md:195-241) ==========================
+
+
+def get_generalized_index_length(index: GeneralizedIndex) -> int:
+    return int(index).bit_length() - 1
+
+
+def get_generalized_index_bit(index: GeneralizedIndex, position: int) -> bool:
+    return (int(index) & (1 << position)) > 0
+
+
+def generalized_index_sibling(index: GeneralizedIndex) -> GeneralizedIndex:
+    return int(index) ^ 1
+
+
+def generalized_index_child(index: GeneralizedIndex, right_side: bool) -> GeneralizedIndex:
+    return int(index) * 2 + int(bool(right_side))
+
+
+def generalized_index_parent(index: GeneralizedIndex) -> GeneralizedIndex:
+    return int(index) // 2
+
+
+def get_power_of_two_floor(x: int) -> int:
+    if x <= 1:
+        return 1
+    return 1 << (x.bit_length() - 1)
+
+
+def concat_generalized_indices(*indices: GeneralizedIndex) -> GeneralizedIndex:
+    """Index of the node reached by successively navigating each gindex
+    inside the previous one's subtree (ssz/merkle-proofs.md:18-33)."""
+    o = 1
+    for i in indices:
+        i = int(i)
+        floor = get_power_of_two_floor(i)
+        o = o * floor + (i - floor)
+    return o
+
+
+def get_subtree_index(generalized_index: GeneralizedIndex) -> int:
+    return int(generalized_index) % (1 << get_generalized_index_length(generalized_index))
+
+
+# == multiproof helper indices (ssz/merkle-proofs.md:266-303) ===============
+
+
+def get_branch_indices(tree_index: GeneralizedIndex) -> list[GeneralizedIndex]:
+    o = [generalized_index_sibling(tree_index)]
+    while o[-1] > 1:
+        o.append(generalized_index_sibling(generalized_index_parent(o[-1])))
+    return o[:-1]
+
+
+def get_path_indices(tree_index: GeneralizedIndex) -> list[GeneralizedIndex]:
+    o = [int(tree_index)]
+    while o[-1] > 1:
+        o.append(generalized_index_parent(o[-1]))
+    return o[:-1]
+
+
+def get_helper_indices(indices) -> list[GeneralizedIndex]:
+    all_helper_indices: set[int] = set()
+    all_path_indices: set[int] = set()
+    for index in indices:
+        all_helper_indices |= set(get_branch_indices(index))
+        all_path_indices |= set(get_path_indices(index))
+    return sorted(all_helper_indices - all_path_indices, reverse=True)
+
+
+# == proof verification (ssz/merkle-proofs.md:305-380) ======================
+
+
+def calculate_merkle_root(leaf: bytes, proof, index: GeneralizedIndex) -> bytes:
+    assert len(proof) == get_generalized_index_length(index), "proof length mismatch"
+    leaf = bytes(leaf)
+    for i, h in enumerate(proof):
+        if get_generalized_index_bit(index, i):
+            leaf = hash_bytes(bytes(h) + leaf)
+        else:
+            leaf = hash_bytes(leaf + bytes(h))
+    return leaf
+
+
+def verify_merkle_proof(leaf: bytes, proof, index: GeneralizedIndex, root: bytes) -> bool:
+    return calculate_merkle_root(leaf, proof, index) == bytes(root)
+
+
+def calculate_multi_merkle_root(leaves, proof, indices) -> bytes:
+    assert len(leaves) == len(indices), "leaves/indices mismatch"
+    helper_indices = get_helper_indices(indices)
+    assert len(proof) == len(helper_indices), "proof length mismatch"
+    objects: dict[int, bytes] = {
+        **{int(index): bytes(node) for index, node in zip(indices, leaves)},
+        **{int(index): bytes(node) for index, node in zip(helper_indices, proof)},
+    }
+    keys = sorted(objects.keys(), reverse=True)
+    pos = 0
+    while pos < len(keys):
+        k = keys[pos]
+        if k in objects and k ^ 1 in objects and k // 2 not in objects:
+            objects[k // 2] = hash_bytes(objects[(k | 1) ^ 1] + objects[k | 1])
+            keys.append(k // 2)
+        pos += 1
+    return objects[1]
+
+
+def verify_merkle_multiproof(leaves, proof, indices, root: bytes) -> bool:
+    return calculate_multi_merkle_root(leaves, proof, indices) == bytes(root)

@@ -1,0 +1,151 @@
+"""Progressive SSZ types (EIP-7916 progressive lists/bitlists, EIP-7495
+progressive containers).
+
+Behavioral parity target: ssz/simple-serialize.md — `merkleize_progressive`
+(:386-395), `mix_in_active_fields` (:396-398), the progressive
+hash-tree-root rules (:404-433), and the type definitions (:58-99).
+
+A progressive list has no compile-time limit: its Merkle shape grows as a
+chain of 4x-larger binary subtrees, so the root is stable as the value
+grows (no pre-committed capacity). Serialization is identical to the
+corresponding unlimited list/bitlist.
+
+TPU note: each progressive subtree is a fixed-shape balanced tree
+(1, 4, 16, ... leaves), so the device tree kernel (ops/merkle.py) applies
+per subtree; the spine is a tiny O(log4 n) host fold.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from .hashing import hash_bytes
+from .merkle import merkleize_chunks, mix_in_length
+from .types import (
+    Bitlist,
+    ByteList,
+    Container,
+    List,
+    _bitfield_bytes,
+    pack_bytes,
+)
+
+_UNLIMITED = 2**63  # effectively no limit for decode-count checks
+
+
+def merkleize_progressive(chunks, num_leaves: int = 1) -> bytes:
+    """Recursive progressive merkleization (ssz/simple-serialize.md:386-395):
+    hash(progressive-rest, balanced-first-num_leaves)."""
+    if isinstance(chunks, np.ndarray):
+        n = chunks.shape[0]
+    else:
+        chunks = list(chunks)
+        n = len(chunks)
+    if n == 0:
+        return b"\x00" * 32
+    a = merkleize_progressive(chunks[num_leaves:], num_leaves * 4)
+    b = merkleize_chunks(chunks[:num_leaves], limit=num_leaves)
+    return hash_bytes(a + b)
+
+
+def mix_in_active_fields(root: bytes, active_fields) -> bytes:
+    """ssz/simple-serialize.md:396-398 — active_fields ≤ 256 bits, packed
+    as a bitvector chunk."""
+    bits = [bool(b) for b in active_fields]
+    assert len(bits) <= 256, "active_fields restricted to 256 bits"
+    packed = _bitfield_bytes(bits)
+    return hash_bytes(bytes(root) + packed.ljust(32, b"\x00"))
+
+
+# == ProgressiveList[type] ==================================================
+
+
+class ProgressiveList(List):
+    """Variable-length list without a limit; progressive Merkle shape
+    (ssz/simple-serialize.md:76-84)."""
+
+    LIMIT: int = _UNLIMITED
+
+    def __class_getitem__(cls, element_type) -> type:
+        from .types import _cached_subclass, _coerce_type
+
+        element_type = _coerce_type(element_type)
+        return _cached_subclass(
+            ("ProgressiveList", element_type),
+            lambda: type(
+                f"ProgressiveList[{element_type.__name__}]",
+                (ProgressiveList,),
+                {"ELEMENT_TYPE": element_type, "LIMIT": _UNLIMITED},
+            ),
+        )
+
+    def _check_init_length(self):
+        pass
+
+    @classmethod
+    def max_byte_length(cls) -> int:
+        raise TypeError("progressive lists have no maximum byte length")
+
+    def get_hash_tree_root(self) -> bytes:
+        if self._root_cache is None:
+            root = merkleize_progressive(self._element_chunks())
+            self._root_cache = mix_in_length(root, len(self._items))
+        return self._root_cache
+
+
+class ProgressiveByteList(ByteList):
+    """`ProgressiveList[byte]` alias shape (ssz/simple-serialize.md:120)."""
+
+    LIMIT: int = _UNLIMITED
+
+    def get_hash_tree_root(self) -> bytes:
+        root = merkleize_progressive(pack_bytes(bytes(self)))
+        return mix_in_length(root, len(self))
+
+
+class ProgressiveBitlist(Bitlist):
+    """Unlimited bitlist with progressive merkleization
+    (ssz/simple-serialize.md:85-92, :417-418)."""
+
+    LIMIT: int = _UNLIMITED
+
+    def get_hash_tree_root(self) -> bytes:
+        root = merkleize_progressive(pack_bytes(_bitfield_bytes(self._bits)))
+        return mix_in_length(root, len(self._bits))
+
+
+# == ProgressiveContainer(active_fields) ====================================
+
+
+def ProgressiveContainer(active_fields):
+    """Class factory: a container whose root commits to an active-fields
+    bitvector over a progressive field tree (ssz/simple-serialize.md:58-75,
+    :154-160, :421-422). Subclass it with field annotations; the number of
+    fields must equal the number of set bits."""
+    bits = [bool(b) for b in active_fields]
+    assert len(bits) > 0, "ProgressiveContainer with no configuration is illegal"
+    assert len(bits) <= 256, "active_fields restricted to 256 bits"
+    assert bits[-1], "active_fields must not end in 0"
+
+    n_active = sum(bits)
+
+    class _ProgressiveContainerBase(Container):
+        ACTIVE_FIELDS = tuple(bits)
+
+        def __init_subclass__(cls, **kwargs):
+            super().__init_subclass__(**kwargs)
+            fields = cls.fields()
+            if fields and len(fields) != n_active:
+                raise TypeError(
+                    f"{cls.__name__}: {len(fields)} fields != "
+                    f"{n_active} active bits in active_fields"
+                )
+
+        def get_hash_tree_root(self) -> bytes:
+            roots = [
+                bytes(self._values[name].get_hash_tree_root())
+                for name in type(self).fields()
+            ]
+            return mix_in_active_fields(merkleize_progressive(roots), self.ACTIVE_FIELDS)
+
+    return _ProgressiveContainerBase

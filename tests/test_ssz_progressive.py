@@ -1,0 +1,126 @@
+"""Progressive SSZ types (EIP-7916/EIP-7495)
+(reference: ssz/simple-serialize.md:58-99, :386-433)."""
+
+import pytest
+
+from eth_consensus_specs_tpu.ssz import (
+    Bytes32,
+    Container,
+    hash_tree_root,
+    serialize,
+    uint8,
+    uint64,
+)
+from eth_consensus_specs_tpu.ssz.hashing import hash_bytes
+from eth_consensus_specs_tpu.ssz.merkle import merkleize_chunks, mix_in_length
+from eth_consensus_specs_tpu.ssz.progressive import (
+    ProgressiveBitlist,
+    ProgressiveByteList,
+    ProgressiveContainer,
+    ProgressiveList,
+    merkleize_progressive,
+    mix_in_active_fields,
+)
+
+
+def test_merkleize_progressive_base_cases():
+    assert merkleize_progressive([]) == b"\x00" * 32
+    chunk = b"\x05" * 32
+    # one chunk: hash(progressive(rest=[], 4), merkleize([chunk], 1))
+    expected = hash_bytes(b"\x00" * 32 + chunk)
+    assert merkleize_progressive([chunk]) == expected
+
+
+def test_merkleize_progressive_recursion_shape():
+    chunks = [bytes([i]) * 32 for i in range(6)]
+    # spec recursion: hash(progressive(chunks[1:], 4), merkleize(chunks[:1], 1))
+    inner = merkleize_progressive(chunks[1:], 4)
+    expected = hash_bytes(inner + merkleize_chunks(chunks[:1], limit=1))
+    assert merkleize_progressive(chunks) == expected
+    # and the inner level: hash(progressive(chunks[5:], 16), merkleize(chunks[1:5], 4))
+    inner2 = hash_bytes(
+        merkleize_progressive(chunks[5:], 16) + merkleize_chunks(chunks[1:5], limit=4)
+    )
+    assert inner == inner2
+
+
+def test_progressive_list_root_stability():
+    """Roots are a pure function of contents — no capacity commitment."""
+    PL = ProgressiveList[uint64]
+    assert PL(range(10)).get_hash_tree_root() == PL(range(10)).get_hash_tree_root()
+    assert PL(range(10)).get_hash_tree_root() != PL(range(11)).get_hash_tree_root()
+    assert PL([]).get_hash_tree_root() == mix_in_length(b"\x00" * 32, 0)
+
+
+def test_progressive_list_serialization_roundtrip():
+    PL = ProgressiveList[uint64]
+    v = PL(range(1000))
+    data = serialize(v)
+    assert len(data) == 8000
+    assert list(PL.decode_bytes(data)) == list(v)
+
+
+def test_progressive_list_of_composite():
+    class Pair(Container):
+        a: uint64
+        b: Bytes32
+
+    PL = ProgressiveList[Pair]
+    v = PL([Pair(a=i, b=bytes([i]) * 32) for i in range(5)])
+    roots = [bytes(hash_tree_root(p)) for p in v]
+    expected = mix_in_length(merkleize_progressive(roots), 5)
+    assert v.get_hash_tree_root() == expected
+    assert list(PL.decode_bytes(serialize(v))) == list(v)
+
+
+def test_progressive_list_append_unbounded():
+    PL = ProgressiveList[uint8]
+    v = PL([])
+    for i in range(300):
+        v.append(i % 256)
+    assert len(v) == 300
+
+
+def test_progressive_bitlist():
+    bits = [True, False] * 500
+    v = ProgressiveBitlist(bits)
+    data = serialize(v)
+    assert ProgressiveBitlist.decode_bytes(data) == v
+    assert v.get_hash_tree_root() != ProgressiveBitlist(bits + [True]).get_hash_tree_root()
+
+
+def test_progressive_byte_list():
+    v = ProgressiveByteList(b"\xab" * 100)
+    from eth_consensus_specs_tpu.ssz.merkle import pack_bytes
+
+    expected = mix_in_length(merkleize_progressive(pack_bytes(b"\xab" * 100)), 100)
+    assert v.get_hash_tree_root() == expected
+
+
+def test_progressive_container_root():
+    class PC(ProgressiveContainer([1, 0, 1])):
+        a: uint64
+        b: Bytes32
+
+    x = PC(a=5, b=b"\x01" * 32)
+    roots = [bytes(hash_tree_root(x.a)), bytes(hash_tree_root(x.b))]
+    expected = mix_in_active_fields(merkleize_progressive(roots), [1, 0, 1])
+    assert x.get_hash_tree_root() == expected
+    # same fields, different active positions -> different root
+    class PC2(ProgressiveContainer([1, 1])):
+        a: uint64
+        b: Bytes32
+
+    assert PC2(a=5, b=b"\x01" * 32).get_hash_tree_root() != x.get_hash_tree_root()
+
+
+def test_progressive_container_validation():
+    with pytest.raises(AssertionError):
+        ProgressiveContainer([])
+    with pytest.raises(AssertionError):
+        ProgressiveContainer([1, 0])  # must not end in 0
+    with pytest.raises(AssertionError):
+        ProgressiveContainer([1] * 257)
+    with pytest.raises(TypeError):
+        class Bad(ProgressiveContainer([1, 0, 1])):
+            a: uint64  # 1 field vs 2 active bits
