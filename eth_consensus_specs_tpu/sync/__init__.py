@@ -1,0 +1,13 @@
+"""Sync-protocol modules (reference: /root/reference/sync/)."""
+
+from .optimistic import (
+    SAFE_SLOTS_TO_IMPORT_OPTIMISTICALLY,
+    OptimisticStore,
+    get_optimistic_store,
+    is_execution_block,
+    is_optimistic,
+    is_optimistic_candidate_block,
+    latest_verified_ancestor,
+)
+
+__all__ = [k for k in dir() if not k.startswith("_")]

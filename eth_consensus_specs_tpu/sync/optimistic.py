@@ -1,0 +1,174 @@
+"""Optimistic sync: importing blocks before their execution payloads are
+validated.
+
+Behavioral parity target: sync/optimistic.md — constants (:45-49), the
+OptimisticStore + helper functions (:83-122), optimistic-candidate rules
+(:139-156), and the NOT_VALIDATED→{VALID,INVALIDATED} transition rules
+(:160-236, prose in the reference; executable here).
+
+The store only *tracks* validation state; the fork-choice Store stays the
+single source of block truth. `mark_valid`/`mark_invalidated` implement
+the mandated propagation (validity flows to ancestors, invalidity to
+descendants) and `process_invalid_payload_status` applies the engine's
+`latestValidHash` semantics table (:215-232).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, Optional, Set
+
+from eth_consensus_specs_tpu.ssz import hash_tree_root
+
+# sync/optimistic.md:45-49 (MUST be user-configurable)
+SAFE_SLOTS_TO_IMPORT_OPTIMISTICALLY = 128
+
+ZERO_ROOT = b"\x00" * 32
+
+
+@dataclass
+class OptimisticStore:
+    """sync/optimistic.md:83-90."""
+
+    optimistic_roots: Set[bytes]
+    head_block_root: bytes
+    blocks: Dict[bytes, object] = field(default_factory=dict)
+    block_states: Dict[bytes, object] = field(default_factory=dict)
+
+
+def get_optimistic_store(anchor_block, anchor_state) -> OptimisticStore:
+    """Bootstrap from a fully-verified anchor (cf. the reference test
+    helper get_optimistic_store, test/utils/randomized_block_tests.py)."""
+    root = bytes(hash_tree_root(anchor_block))
+    return OptimisticStore(
+        optimistic_roots=set(),
+        head_block_root=root,
+        blocks={root: anchor_block.copy()},
+        block_states={root: anchor_state.copy()},
+    )
+
+
+def is_optimistic(opt_store: OptimisticStore, block) -> bool:
+    """sync/optimistic.md:93-94."""
+    return bytes(hash_tree_root(block)) in opt_store.optimistic_roots
+
+
+def latest_verified_ancestor(opt_store: OptimisticStore, block):
+    """First non-optimistic ancestor (sync/optimistic.md:98-103). The
+    block parameter is assumed never INVALIDATED."""
+    while True:
+        if not is_optimistic(opt_store, block) or bytes(block.parent_root) == ZERO_ROOT:
+            return block
+        block = opt_store.blocks[bytes(block.parent_root)]
+
+
+def is_execution_block(block) -> bool:
+    """sync/optimistic.md:107-108."""
+    payload = block.body.execution_payload
+    return payload != type(payload)()
+
+
+def is_optimistic_candidate_block(opt_store: OptimisticStore, current_slot: int, block) -> bool:
+    """Merge-block poisoning guard (sync/optimistic.md:112-121)."""
+    if is_execution_block(opt_store.blocks[bytes(block.parent_root)]):
+        return True
+    if int(block.slot) + SAFE_SLOTS_TO_IMPORT_OPTIMISTICALLY <= int(current_slot):
+        return True
+    return False
+
+
+# == status transitions (sync/optimistic.md:160-236) ========================
+
+
+def add_optimistic_block(opt_store: OptimisticStore, block, state) -> None:
+    """Record a block imported with a NOT_VALIDATED payload status."""
+    root = bytes(hash_tree_root(block))
+    opt_store.blocks[root] = block.copy()
+    opt_store.block_states[root] = state.copy()
+    opt_store.optimistic_roots.add(root)
+
+
+def add_verified_block(opt_store: OptimisticStore, block, state) -> None:
+    """Record a block whose payload the engine reported VALID."""
+    root = bytes(hash_tree_root(block))
+    opt_store.blocks[root] = block.copy()
+    opt_store.block_states[root] = state.copy()
+    opt_store.optimistic_roots.discard(root)
+
+
+def mark_valid(opt_store: OptimisticStore, block_root: bytes) -> None:
+    """NOT_VALIDATED -> VALID; validity propagates to every ancestor
+    (sync/optimistic.md:189-193)."""
+    block_root = bytes(block_root)
+    assert block_root in opt_store.blocks, "unknown block"
+    root = block_root
+    while root in opt_store.optimistic_roots:
+        opt_store.optimistic_roots.discard(root)
+        parent = bytes(opt_store.blocks[root].parent_root)
+        if parent not in opt_store.blocks:
+            break
+        root = parent
+
+
+def _descendants(opt_store: OptimisticStore, root: bytes) -> Set[bytes]:
+    children: Dict[bytes, list] = {}
+    for r, b in opt_store.blocks.items():
+        children.setdefault(bytes(b.parent_root), []).append(r)
+    out: Set[bytes] = set()
+    frontier = [root]
+    while frontier:
+        cur = frontier.pop()
+        out.add(cur)
+        frontier.extend(children.get(cur, []))
+    return out
+
+
+def mark_invalidated(opt_store: OptimisticStore, block_root: bytes) -> Set[bytes]:
+    """NOT_VALIDATED -> INVALIDATED; invalidity propagates to every
+    descendant, which are removed from the block tree
+    (sync/optimistic.md:195-200, :282-287). Returns the removed roots."""
+    block_root = bytes(block_root)
+    assert block_root in opt_store.blocks, "unknown block"
+    removed = _descendants(opt_store, block_root)
+    for root in removed:
+        opt_store.optimistic_roots.discard(root)
+        opt_store.blocks.pop(root, None)
+        opt_store.block_states.pop(root, None)
+    return removed
+
+
+def process_invalid_payload_status(
+    opt_store: OptimisticStore, block_root: bytes, latest_valid_hash: Optional[bytes]
+) -> Set[bytes]:
+    """Apply the engine's INVALID verdict per the latestValidHash table
+    (sync/optimistic.md:215-232). Returns the invalidated roots."""
+    block_root = bytes(block_root)
+    assert block_root in opt_store.blocks, "unknown block"
+
+    # chain from anchor to the offending block
+    chain = []
+    root = block_root
+    while root in opt_store.blocks:
+        chain.append(root)
+        root = bytes(opt_store.blocks[root].parent_root)
+    chain.reverse()
+
+    if latest_valid_hash is None:
+        invalid_root = block_root
+    elif bytes(latest_valid_hash) == b"\x00" * 32:
+        # first execution-enabled block in the chain
+        invalid_root = block_root
+        for r in chain:
+            if is_execution_block(opt_store.blocks[r]):
+                invalid_root = r
+                break
+    else:
+        # child of the block carrying latestValidHash; unknown hash -> null
+        invalid_root = block_root
+        for i, r in enumerate(chain):
+            payload = opt_store.blocks[r].body.execution_payload
+            if bytes(payload.block_hash) == bytes(latest_valid_hash):
+                if i + 1 < len(chain):
+                    invalid_root = chain[i + 1]
+                break
+    return mark_invalidated(opt_store, invalid_root)

@@ -1,0 +1,121 @@
+"""Optimistic sync (reference: sync/optimistic.md and
+eth2spec/test/bellatrix/sync/test_optimistic.py)."""
+
+from eth_consensus_specs_tpu.ssz import hash_tree_root
+from eth_consensus_specs_tpu.sync import optimistic as opt
+from eth_consensus_specs_tpu.test_infra.block import (
+    build_empty_block_for_next_slot,
+    state_transition_and_sign_block,
+)
+from eth_consensus_specs_tpu.test_infra.context import spec_state_test, with_phases
+
+
+def _chain(spec, state, n):
+    """Build n linked blocks on `state`, returning their message blocks."""
+    blocks = []
+    for _ in range(n):
+        block = build_empty_block_for_next_slot(spec, state)
+        state_transition_and_sign_block(spec, state, block)
+        blocks.append((block, state.copy()))
+    return blocks
+
+
+def _store_with_chain(spec, state, n):
+    genesis_block = spec.BeaconBlock(state_root=hash_tree_root(state))
+    store = opt.get_optimistic_store(genesis_block, state)
+    blocks = _chain(spec, state, n)
+    for block, post in blocks:
+        opt.add_optimistic_block(store, block, post)
+    return store, [b for b, _ in blocks]
+
+
+@with_phases(["bellatrix"])
+@spec_state_test
+def test_is_execution_block(spec, state):
+    block = build_empty_block_for_next_slot(spec, state)
+    assert opt.is_execution_block(block)  # test genesis is post-merge
+    empty = spec.BeaconBlock()
+    assert not opt.is_execution_block(empty)
+
+
+@with_phases(["bellatrix"])
+@spec_state_test
+def test_optimistic_candidate_parent_execution(spec, state):
+    store, blocks = _store_with_chain(spec, state, 2)
+    # parent (block[0]) has execution enabled -> candidate at any slot
+    assert opt.is_optimistic_candidate_block(store, int(blocks[1].slot), blocks[1])
+
+
+@with_phases(["bellatrix"])
+@spec_state_test
+def test_optimistic_candidate_safe_slots(spec, state):
+    genesis_block = spec.BeaconBlock(state_root=hash_tree_root(state))
+    store = opt.get_optimistic_store(genesis_block, state)
+    # pre-merge parent: candidate only when the clock is far ahead
+    child = spec.BeaconBlock(slot=1, parent_root=hash_tree_root(genesis_block))
+    # make the anchor parent non-execution
+    store.blocks[bytes(hash_tree_root(genesis_block))] = spec.BeaconBlock()
+    assert not opt.is_optimistic_candidate_block(store, 1, child)
+    safe = 1 + opt.SAFE_SLOTS_TO_IMPORT_OPTIMISTICALLY
+    assert opt.is_optimistic_candidate_block(store, safe, child)
+
+
+@with_phases(["bellatrix"])
+@spec_state_test
+def test_latest_verified_ancestor(spec, state):
+    store, blocks = _store_with_chain(spec, state, 3)
+    assert opt.is_optimistic(store, blocks[-1])
+    # nothing verified yet beyond the anchor: walk back to genesis
+    anchor = opt.latest_verified_ancestor(store, blocks[-1])
+    assert int(anchor.slot) == 0
+    # verify the middle block -> it becomes the latest verified ancestor
+    opt.mark_valid(store, hash_tree_root(blocks[1]))
+    anchor = opt.latest_verified_ancestor(store, blocks[-1])
+    assert hash_tree_root(anchor) == hash_tree_root(blocks[1])
+
+
+@with_phases(["bellatrix"])
+@spec_state_test
+def test_mark_valid_propagates_to_ancestors(spec, state):
+    store, blocks = _store_with_chain(spec, state, 3)
+    opt.mark_valid(store, hash_tree_root(blocks[-1]))
+    assert store.optimistic_roots == set()
+
+
+@with_phases(["bellatrix"])
+@spec_state_test
+def test_mark_invalidated_propagates_to_descendants(spec, state):
+    store, blocks = _store_with_chain(spec, state, 3)
+    removed = opt.mark_invalidated(store, hash_tree_root(blocks[1]))
+    assert len(removed) == 2  # blocks[1] and blocks[2]
+    assert bytes(hash_tree_root(blocks[0])) in store.blocks
+    assert bytes(hash_tree_root(blocks[1])) not in store.blocks
+    assert bytes(hash_tree_root(blocks[2])) not in store.blocks
+    assert not any(r in store.optimistic_roots for r in removed)
+
+
+@with_phases(["bellatrix"])
+@spec_state_test
+def test_invalid_payload_status_null_hash(spec, state):
+    """latestValidHash null -> only the block in question (and its
+    descendants) are invalidated."""
+    store, blocks = _store_with_chain(spec, state, 3)
+    removed = opt.process_invalid_payload_status(
+        store, hash_tree_root(blocks[2]), latest_valid_hash=None
+    )
+    assert removed == {bytes(hash_tree_root(blocks[2]))}
+
+
+@with_phases(["bellatrix"])
+@spec_state_test
+def test_invalid_payload_status_known_hash(spec, state):
+    """latestValidHash pointing at blocks[0]'s payload invalidates from
+    its child onward."""
+    store, blocks = _store_with_chain(spec, state, 3)
+    lvh = bytes(blocks[0].body.execution_payload.block_hash)
+    removed = opt.process_invalid_payload_status(
+        store, hash_tree_root(blocks[2]), latest_valid_hash=lvh
+    )
+    assert bytes(hash_tree_root(blocks[0])) not in removed
+    assert bytes(hash_tree_root(blocks[1])) in removed
+    assert bytes(hash_tree_root(blocks[2])) in removed
