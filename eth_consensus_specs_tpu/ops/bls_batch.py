@@ -72,9 +72,12 @@ def batch_verify_aggregates(items: list[tuple[list[bytes], bytes, bytes]]) -> bo
         prod_i e(r_i * aggpk_i, H(m_i)) * e(-G1, sum_i r_i * sig_i) == 1
 
     Sound: a forged triple passes only with probability ~1/2^64 over the
-    random r_i. With the tpu backend all r_i * aggpk_i products run as one
-    device MSM (scalar r_i repeated per committee member); the G2 side and
-    the final pairing are host-side.
+    random r_i. With the tpu backend each item's committee pubkeys sum in
+    the device pairwise-sum kernel (one dispatch per item; the compiled
+    executable is shared across same-pow2 committee sizes) and the 64-bit
+    r_i multiply happens host-side on the single aggregate point; the G2
+    side (hash-to-curve, memoized per distinct message) and the final
+    pairing are host-side.
     """
     if not items:
         return True
@@ -94,14 +97,14 @@ def batch_verify_aggregates(items: list[tuple[list[bytes], bytes, bytes]]) -> bo
         parsed.append((points, bytes(msg), sig, r))
 
     if _use_device():
-        from eth_consensus_specs_tpu.ops.g1_msm import msm_g1_device
+        from eth_consensus_specs_tpu.ops.g1_msm import sum_g1_device
 
-        # one flat MSM computes every r_i * aggpk_i: can't mix messages in
-        # a single output point, so run the kernel once per item batch of
-        # committee points (same compiled executable across items)
-        rpk = [
-            msm_g1_device(points, [r] * len(points)) for points, _, _, r in parsed
-        ]
+        # the scalar is uniform within an item, so r_i * aggpk_i factors to
+        # r_i * sum(points): the device pairwise-sum kernel does the O(n)
+        # group work, and the single 64-bit host multiply replaces an
+        # n-lane 256-bit double-and-add (4x fewer device iterations, one
+        # point-mul instead of n)
+        rpk = [sum_g1_device(points).mul(r) for points, _, _, r in parsed]
     else:
         rpk = []
         for points, _, _, r in parsed:
@@ -110,11 +113,18 @@ def batch_verify_aggregates(items: list[tuple[list[bytes], bytes, bytes]]) -> bo
                 aggpk = aggpk + p
             rpk.append(aggpk.mul(r))
 
-    pairs = []
+    # memoize hash-to-curve per distinct message and merge same-message
+    # items into one pairing input (block attestations often share
+    # AttestationData): k items with m distinct messages -> m+1 pairs
+    h2_cache: dict[bytes, object] = {}
+    merged: dict[bytes, object] = {}
     sig_acc = None
     for (points, msg, sig, r), rp in zip(parsed, rpk):
-        pairs.append((rp, hash_to_g2(msg)))
+        if msg not in h2_cache:
+            h2_cache[msg] = hash_to_g2(msg)
+        merged[msg] = rp if msg not in merged else merged[msg] + rp
         term = sig.mul(r)
         sig_acc = term if sig_acc is None else sig_acc + term
+    pairs = [(rp, h2_cache[msg]) for msg, rp in merged.items()]
     pairs.append((-g1, sig_acc))
     return pairing_check(pairs)

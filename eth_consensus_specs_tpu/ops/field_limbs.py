@@ -70,21 +70,21 @@ ONE_MONT = to_mont(1)
 
 # == device kernels (elementwise over leading batch dims) ==================
 
-# anti-diagonal contraction tensor: CONV[k, i, j] = 1 iff i + j == k.
-# The schoolbook limb product becomes one einsum against it.
-_CONV = np.zeros((2 * N_LIMBS - 1, N_LIMBS, N_LIMBS), np.uint64)
-for _k in range(2 * N_LIMBS - 1):
-    for _i in range(N_LIMBS):
-        _j = _k - _i
-        if 0 <= _j < N_LIMBS:
-            _CONV[_k, _i, _j] = 1
-
-
 def _limb_product(a, b):
     """Full 25-column schoolbook product, columns NOT carried.
-    Column magnitude <= 13 * (2^30-1)^2 + carries < 2^64."""
+    Column magnitude <= 13 * (2^30-1)^2 + carries < 2^64.
+
+    The anti-diagonal accumulation is an unrolled pad-shift-add (13 static
+    rows), NOT a dot/einsum: XLA:TPU cannot lower u64 dot_general ("u64
+    dot" hits the unimplemented X64-rewrite path at compile time), while
+    elementwise u64 multiplies/adds lower fine on every backend."""
     partials = a[..., :, None] * b[..., None, :]
-    return jnp.einsum("...ij,kij->...k", partials, jnp.asarray(_CONV))
+    batch_pad = [(0, 0)] * (partials.ndim - 2)
+    out = None
+    for i in range(N_LIMBS):
+        row = jnp.pad(partials[..., i, :], batch_pad + [(i, N_LIMBS - 1 - i)])
+        out = row if out is None else out + row
+    return out
 
 
 def _carry_sweep(t):

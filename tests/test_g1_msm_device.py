@@ -92,6 +92,7 @@ def test_block_attestations_batch_seam():
     corrupted signature still fails at the spec assertion."""
     import jax
 
+    prior_platforms = jax.config.jax_platforms
     jax.config.update("jax_platforms", "cpu")
     from eth_consensus_specs_tpu.forks import get_spec
     from eth_consensus_specs_tpu.test_infra.attestations import (
@@ -135,3 +136,4 @@ def test_block_attestations_batch_seam():
     finally:
         bls.bls_active = prior_active
         bls.use_pyspec()
+        jax.config.update("jax_platforms", prior_platforms)
