@@ -113,18 +113,15 @@ def batch_verify_aggregates(items: list[tuple[list[bytes], bytes, bytes]]) -> bo
                 aggpk = aggpk + p
             rpk.append(aggpk.mul(r))
 
-    # memoize hash-to-curve per distinct message and merge same-message
-    # items into one pairing input (block attestations often share
-    # AttestationData): k items with m distinct messages -> m+1 pairs
-    h2_cache: dict[bytes, object] = {}
+    # merge same-message items into one pairing input (block attestations
+    # often share AttestationData): k items with m distinct messages ->
+    # m+1 pairs, one hash-to-curve per distinct message
     merged: dict[bytes, object] = {}
     sig_acc = None
     for (points, msg, sig, r), rp in zip(parsed, rpk):
-        if msg not in h2_cache:
-            h2_cache[msg] = hash_to_g2(msg)
         merged[msg] = rp if msg not in merged else merged[msg] + rp
         term = sig.mul(r)
         sig_acc = term if sig_acc is None else sig_acc + term
-    pairs = [(rp, h2_cache[msg]) for msg, rp in merged.items()]
+    pairs = [(rp, hash_to_g2(msg)) for msg, rp in merged.items()]
     pairs.append((-g1, sig_acc))
     return pairing_check(pairs)

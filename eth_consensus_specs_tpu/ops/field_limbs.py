@@ -4,9 +4,11 @@ TPUs have no wide-integer units, so Fp (381-bit) elements live as 13x30-bit
 limbs in uint64 lanes: a 30x30-bit partial product is <2^60 and a column of
 13 such products plus carries stays under 2^64, so schoolbook accumulation
 never overflows a lane. Multiplication is Montgomery (R = 2^390) in
-separated (SOS) form: ONE einsum for the full 25-column product, then a
-13-step lax.scan reduction — the graph stays ~100 HLO ops per multiply
-(an unrolled CIOS was ~25x bigger and made XLA compile times explode).
+separated (SOS) form: an unrolled pad-shift-add for the full 25-column
+product (13 static rows — NOT a dot/einsum, which XLA:TPU cannot lower
+for u64), then a 13-step lax.scan reduction — the graph stays ~100 HLO
+ops per multiply (an unrolled CIOS was ~25x bigger and made XLA compile
+times explode).
 
 Values are kept in the REDUNDANT range [0, 2p): R > 4p, so Montgomery
 outputs stay < 2p without any conditional subtraction, and only additions

@@ -1,0 +1,93 @@
+"""Finality scenarios: Casper FFG justification/finalization rules driven
+through whole epochs of blocks-with-attestations
+(reference: eth2spec/test/phase0/finality/test_finality.py).
+
+Timing note: with the genesis guard (`current_epoch <= GENESIS_EPOCH + 1`
+skips justification processing), the first two transitions evaluate
+nothing; epochs 1 and 2 justify together at the 2->3 transition."""
+
+from eth_consensus_specs_tpu.test_infra.attestations import next_epoch_with_attestations
+from eth_consensus_specs_tpu.test_infra.context import spec_state_test, with_all_phases
+from eth_consensus_specs_tpu.test_infra.state import next_epoch
+
+
+def _epoch(spec, state):
+    return int(spec.get_current_epoch(state))
+
+
+@with_all_phases
+@spec_state_test
+def test_no_finality_at_genesis_epochs(spec, state):
+    """The genesis guard blocks justification for the first two epochs."""
+    for _ in range(2):
+        next_epoch_with_attestations(spec, state, True, False)
+    assert int(state.current_justified_checkpoint.epoch) == spec.GENESIS_EPOCH
+    assert int(state.finalized_checkpoint.epoch) == spec.GENESIS_EPOCH
+
+
+@with_all_phases
+@spec_state_test
+def test_finality_rule_4(spec, state):
+    """Consecutive current-epoch justification finalizes the older of the
+    pair (rule 4): after 4 full epochs, justified=3, finalized=2."""
+    for _ in range(4):
+        next_epoch_with_attestations(spec, state, True, False)
+    assert _epoch(spec, state) == 4
+    assert int(state.current_justified_checkpoint.epoch) == 3
+    assert int(state.finalized_checkpoint.epoch) == 2
+    assert [int(b) for b in state.justification_bits] == [1, 1, 1, 0]
+
+
+@with_all_phases
+@spec_state_test
+def test_finality_rule_1_previous_epoch_attestations(spec, state):
+    """Justification exclusively through previous-epoch attestations lags
+    one epoch; finalization follows via rule 1 (prev_justified with bits
+    [1..3] set)."""
+    for _ in range(2):
+        next_epoch_with_attestations(spec, state, True, False)
+    for _ in range(3):
+        next_epoch_with_attestations(spec, state, False, True)
+    assert _epoch(spec, state) == 5
+    assert int(state.current_justified_checkpoint.epoch) == 3
+    assert int(state.finalized_checkpoint.epoch) == 1
+    assert [int(b) for b in state.justification_bits] == [0, 1, 1, 1]
+
+
+@with_all_phases
+@spec_state_test
+def test_no_attestations_no_justification(spec, state):
+    """Empty epochs never move the checkpoints."""
+    before = state.current_justified_checkpoint.copy()
+    for _ in range(3):
+        next_epoch(spec, state)
+    assert state.current_justified_checkpoint == before
+    assert int(state.finalized_checkpoint.epoch) == spec.GENESIS_EPOCH
+
+
+@with_all_phases
+@spec_state_test
+def test_justification_bits_rotate(spec, state):
+    """The 4-bit justification window shifts every epoch."""
+    for _ in range(3):
+        next_epoch_with_attestations(spec, state, True, False)
+    assert [int(b) for b in state.justification_bits] == [1, 1, 0, 0]
+    next_epoch(spec, state)  # an empty epoch shifts the window
+    assert [int(b) for b in state.justification_bits] == [0, 1, 1, 0]
+
+
+@with_all_phases
+@spec_state_test
+def test_finality_stalls_then_recovers(spec, state):
+    """Finality stops during an empty period and resumes once attestations
+    return (the liveness half of the FFG story)."""
+    for _ in range(4):
+        next_epoch_with_attestations(spec, state, True, False)
+    finalized_before = int(state.finalized_checkpoint.epoch)
+    assert finalized_before == 2
+    for _ in range(2):
+        next_epoch(spec, state)
+    assert int(state.finalized_checkpoint.epoch) == finalized_before
+    for _ in range(3):
+        next_epoch_with_attestations(spec, state, True, False)
+    assert int(state.finalized_checkpoint.epoch) > finalized_before

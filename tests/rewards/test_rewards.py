@@ -1,0 +1,124 @@
+"""Reward/penalty accounting at the delta level
+(reference: eth2spec/test/phase0/rewards/* via rewards/helpers; altair+
+flag-delta semantics specs/altair/beacon-chain.md:398-486)."""
+
+from eth_consensus_specs_tpu.test_infra.attestations import next_epoch_with_attestations
+from eth_consensus_specs_tpu.test_infra.context import (
+    spec_state_test,
+    with_all_phases,
+    with_phases,
+)
+from eth_consensus_specs_tpu.test_infra.forks import is_post_altair
+from eth_consensus_specs_tpu.test_infra.state import next_epoch
+
+ALTAIR_ON = ["altair", "bellatrix", "capella", "deneb", "electra", "fulu", "gloas"]
+
+
+@with_phases(ALTAIR_ON)
+@spec_state_test
+def test_flag_deltas_full_participation(spec, state):
+    """Every unslashed active validator with all flags earns every flag's
+    reward component; no penalties."""
+    next_epoch_with_attestations(spec, state, True, False)
+    next_epoch_with_attestations(spec, state, True, False)
+    # previous epoch now has full participation recorded
+    for flag_index in range(len(spec.PARTICIPATION_FLAG_WEIGHTS)):
+        rewards, penalties = spec.get_flag_index_deltas(state, flag_index)
+        participating = spec.get_unslashed_participating_indices(
+            state, flag_index, spec.get_previous_epoch(state)
+        )
+        assert len(participating) > 0
+        for index in range(len(state.validators)):
+            if index in participating:
+                assert rewards[index] > 0, (flag_index, index)
+                assert penalties[index] == 0
+            else:
+                assert rewards[index] == 0
+
+
+@with_phases(ALTAIR_ON)
+@spec_state_test
+def test_flag_deltas_empty_participation(spec, state):
+    """No participation: zero rewards; head flag carries no penalty, the
+    source/target flags penalize everyone active."""
+    next_epoch(spec, state)
+    next_epoch(spec, state)
+    for flag_index in range(len(spec.PARTICIPATION_FLAG_WEIGHTS)):
+        rewards, penalties = spec.get_flag_index_deltas(state, flag_index)
+        assert all(r == 0 for r in rewards)
+        if flag_index == spec.TIMELY_HEAD_FLAG_INDEX:
+            assert all(p == 0 for p in penalties)
+        else:
+            active = spec.get_active_validator_indices(state, spec.get_previous_epoch(state))
+            for index in active:
+                assert penalties[index] > 0
+
+
+@with_phases(ALTAIR_ON)
+@spec_state_test
+def test_inactivity_deltas_zero_outside_leak(spec, state):
+    """Inactivity penalties only bite while scores are nonzero; with full
+    participation and zero scores the deltas vanish."""
+    next_epoch_with_attestations(spec, state, True, False)
+    next_epoch_with_attestations(spec, state, True, False)
+    rewards, penalties = spec.get_inactivity_penalty_deltas(state)
+    assert all(r == 0 for r in rewards)
+    assert all(p == 0 for p in penalties)
+
+
+@with_phases(ALTAIR_ON)
+@spec_state_test
+def test_inactivity_scores_grow_in_leak(spec, state):
+    """Past MIN_EPOCHS_TO_INACTIVITY_PENALTY without finality, the scores
+    of non-participants climb by INACTIVITY_SCORE_BIAS per epoch."""
+    # age the chain without attestations until in a leak
+    for _ in range(spec.MIN_EPOCHS_TO_INACTIVITY_PENALTY + 2):
+        next_epoch(spec, state)
+    assert spec.is_in_inactivity_leak(state)
+    before = [int(s) for s in state.inactivity_scores]
+    next_epoch(spec, state)
+    after = [int(s) for s in state.inactivity_scores]
+    active = set(spec.get_active_validator_indices(state, spec.get_previous_epoch(state)))
+    for i in range(len(after)):
+        if i in active:
+            assert after[i] == before[i] + spec.config.INACTIVITY_SCORE_BIAS
+    # and the inactivity deltas now penalize proportionally to the score
+    rewards, penalties = spec.get_inactivity_penalty_deltas(state)
+    assert all(r == 0 for r in rewards)
+    assert any(p > 0 for p in penalties)
+
+
+@with_phases(ALTAIR_ON)
+@spec_state_test
+def test_rewards_and_penalties_conservation(spec, state):
+    """process_rewards_and_penalties applies exactly the sum of flag and
+    inactivity deltas to every balance."""
+    next_epoch_with_attestations(spec, state, True, False)
+    next_epoch_with_attestations(spec, state, True, False)
+    expected = [int(b) for b in state.balances]
+    for flag_index in range(len(spec.PARTICIPATION_FLAG_WEIGHTS)):
+        rewards, penalties = spec.get_flag_index_deltas(state, flag_index)
+        for i in range(len(expected)):
+            expected[i] = max(0, expected[i] + rewards[i] - penalties[i])
+    rewards, penalties = spec.get_inactivity_penalty_deltas(state)
+    for i in range(len(expected)):
+        expected[i] = max(0, expected[i] + rewards[i] - penalties[i])
+    spec.process_rewards_and_penalties(state)
+    assert [int(b) for b in state.balances] == expected
+
+
+@with_phases(["phase0"])
+@spec_state_test
+def test_phase0_attestation_deltas_full(spec, state):
+    """phase0 pending-attestation path: full participation earns positive
+    head/target/source components for every attester."""
+    next_epoch_with_attestations(spec, state, True, False)
+    next_epoch_with_attestations(spec, state, True, False)
+    rewards, penalties = spec.get_attestation_deltas(state)
+    attesters = spec.get_unslashed_attesting_indices(
+        state, spec.get_matching_source_attestations(state, spec.get_previous_epoch(state))
+    )
+    assert len(attesters) > 0
+    for index in attesters:
+        assert rewards[index] > 0
+        assert penalties[index] == 0
