@@ -1,0 +1,64 @@
+"""In-development feature forks (reference: specs/_features/).
+
+Each feature is an executable spec subclassing its base fork, exactly like
+the mainline forks — `get_feature_spec("eip6914", "minimal")` gives the
+familiar `spec.process_...` surface. Features are NOT part of FORK_ORDER
+(they fork off specific mainline forks, not each other), mirroring how the
+reference keeps them outside the sequential upgrade DAG
+(pysetup/md_doc_paths.py:18-31)."""
+
+from __future__ import annotations
+
+from functools import lru_cache
+
+from eth_consensus_specs_tpu.config import load_config, load_preset
+
+FEATURE_BASE_FORK = {
+    "eip6914": "capella",
+    "eip7441": "capella",
+    "eip7805": "fulu",
+    "eip7928": "fulu",
+}
+# (eip6800 Verkle and eip8025 zkEVM remain unimplemented: both hinge on
+# external proof systems with unstable upstream specs)
+
+
+def _feature_class(name: str):
+    if name == "eip6914":
+        from .eip6914 import EIP6914Spec
+
+        return EIP6914Spec
+    if name == "eip7441":
+        from .eip7441 import EIP7441Spec
+
+        return EIP7441Spec
+    if name == "eip7805":
+        from .eip7805 import EIP7805Spec
+
+        return EIP7805Spec
+    if name == "eip7928":
+        from .eip7928 import EIP7928Spec
+
+        return EIP7928Spec
+    raise ValueError(f"unknown feature {name!r}")
+
+
+@lru_cache(maxsize=None)
+def get_feature_spec(name: str, preset_name: str = "mainnet"):
+    import os
+
+    from eth_consensus_specs_tpu.config import _DATA_DIR, _load_yaml
+
+    cls = _feature_class(name)
+    preset = load_preset(preset_name, FEATURE_BASE_FORK[name])
+    feature_file = os.path.join(
+        _DATA_DIR, "presets", preset_name, "features", f"{name}.yaml"
+    )
+    if os.path.exists(feature_file):
+        preset = preset.replace(**_load_yaml(feature_file))
+    config = load_config(preset_name)
+    return cls(preset, config, preset_name=preset_name)
+
+
+def available_features() -> list[str]:
+    return sorted(FEATURE_BASE_FORK)

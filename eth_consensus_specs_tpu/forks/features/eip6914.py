@@ -1,0 +1,35 @@
+"""EIP-6914: reuse fully-withdrawn validator indices for new deposits.
+
+Behavioral parity target: specs/_features/eip6914/beacon-chain.md
+(is_reusable_validator :45-50, get_index_for_new_validator :57-62) and
+fork-choice.md (on_reused_index :36-38)."""
+
+from eth_consensus_specs_tpu.forks.capella import CapellaSpec
+
+
+class EIP6914Spec(CapellaSpec):
+    fork_name = "eip6914"
+
+    # preset (specs/_features/eip6914/beacon-chain.md:31-34)
+    SAFE_EPOCHS_TO_REUSE_INDEX = 2**16
+
+    def is_reusable_validator(self, validator, balance: int, epoch: int) -> bool:
+        """Index can be re-assigned once long-withdrawn and drained."""
+        return (
+            int(epoch) > int(validator.withdrawable_epoch) + self.SAFE_EPOCHS_TO_REUSE_INDEX
+            and int(balance) == 0
+        )
+
+    def get_index_for_new_validator(self, state) -> int:
+        """[Modified in EIP6914] scan for a reusable slot before growing."""
+        for index, validator in enumerate(state.validators):
+            if self.is_reusable_validator(
+                validator, state.balances[index], self.get_current_epoch(state)
+            ):
+                return index
+        return len(state.validators)
+
+    def on_reused_index(self, store, index: int) -> None:
+        """Fork choice: a reused index sheds its equivocation record
+        (specs/_features/eip6914/fork-choice.md:36-38)."""
+        store.equivocating_indices.discard(int(index))

@@ -1,0 +1,406 @@
+"""EIP-7441 (Whisk): single secret leader election via shuffled trackers.
+
+Behavioral parity target: specs/_features/eip7441/beacon-chain.md — the
+whisk state fields and tracker selection (:136-237), opening-proof block
+header (:244-279), shuffle processing (:283-346), registration
+(:348-372), deposit-time tracker creation (:389-434), and the
+header-derived proposer index (:436-446).
+
+Proof backends — first-party, pluggable (the REFERENCE itself delegates
+both proofs to the external `curdleproofs` package, which is not part of
+its tree; pysetup/spec_builders/eip7441.py:12):
+
+* Tracker/opening proofs are REAL Chaum-Pedersen discrete-log-equality
+  proofs (Fiat-Shamir): prove knowledge of k with k_r_G == k * r_G and
+  k_commitment == k * G. Sound and complete; 128-byte serialization.
+
+* Shuffle proofs use a TRANSPARENT backend: the serialized proof reveals
+  the permutation and per-element rerandomization scalars, and the
+  verifier checks post[i] == s_i * pre[perm[i]] componentwise. This is
+  binding (exactly the shuffle relation curdleproofs proves) but NOT
+  zero-knowledge — a production deployment swaps in a curdleproofs-class
+  prover behind the same byte-level interface.
+"""
+
+from eth_consensus_specs_tpu.crypto.curve import (
+    Point,
+    g1_from_bytes,
+    g1_generator,
+    g1_to_bytes,
+)
+from eth_consensus_specs_tpu.crypto.fields import R as BLS_MODULUS
+from eth_consensus_specs_tpu.forks.capella import CapellaSpec
+from eth_consensus_specs_tpu.forks.phase0 import BLSSignature, Bytes32 as _B32, Root
+from eth_consensus_specs_tpu.ssz import (
+    ByteList,
+    Bytes32,
+    Bytes48,
+    Container,
+    List,
+    Vector,
+    hash_tree_root,
+)
+
+BLSG1Point = Bytes48
+
+
+class EIP7441Spec(CapellaSpec):
+    fork_name = "eip7441"
+
+    # Domain types (specs/_features/eip7441/beacon-chain.md:37-43)
+    DOMAIN_CANDIDATE_SELECTION = b"\x07\x00\x00\x00"
+    DOMAIN_SHUFFLE = b"\x07\x10\x00\x00"
+    DOMAIN_PROPOSER_SELECTION = b"\x07\x20\x00\x00"
+
+    BLS_MODULUS = BLS_MODULUS
+
+    @property
+    def BLS_G1_GENERATOR(self) -> bytes:
+        return g1_to_bytes(g1_generator())
+
+    # == type system ======================================================
+
+    def _build_types(self) -> None:
+        super()._build_types()
+        P = self
+
+        WhiskShuffleProof = ByteList[P.MAX_SHUFFLE_PROOF_SIZE]
+        WhiskTrackerProof = ByteList[P.MAX_OPENING_PROOF_SIZE]
+        self.WhiskShuffleProof = WhiskShuffleProof
+        self.WhiskTrackerProof = WhiskTrackerProof
+
+        class WhiskTracker(Container):
+            r_G: BLSG1Point
+            k_r_G: BLSG1Point
+
+        class BeaconBlockBody(Container):
+            randao_reveal: BLSSignature
+            eth1_data: P.Eth1Data
+            graffiti: Bytes32
+            proposer_slashings: P.BeaconBlockBody.fields()["proposer_slashings"]
+            attester_slashings: P.BeaconBlockBody.fields()["attester_slashings"]
+            attestations: P.BeaconBlockBody.fields()["attestations"]
+            deposits: P.BeaconBlockBody.fields()["deposits"]
+            voluntary_exits: P.BeaconBlockBody.fields()["voluntary_exits"]
+            sync_aggregate: P.SyncAggregate
+            execution_payload: P.ExecutionPayload
+            bls_to_execution_changes: P.BeaconBlockBody.fields()["bls_to_execution_changes"]
+            # [New in EIP7441]
+            whisk_opening_proof: WhiskTrackerProof
+            whisk_post_shuffle_trackers: Vector[WhiskTracker, P.VALIDATORS_PER_SHUFFLE]
+            whisk_shuffle_proof: WhiskShuffleProof
+            whisk_registration_proof: WhiskTrackerProof
+            whisk_tracker: WhiskTracker
+            whisk_k_commitment: BLSG1Point
+
+        class BeaconBlock(Container):
+            slot: P.BeaconBlock.fields()["slot"]
+            proposer_index: P.BeaconBlock.fields()["proposer_index"]
+            parent_root: Root
+            state_root: Root
+            body: BeaconBlockBody
+
+        class SignedBeaconBlock(Container):
+            message: BeaconBlock
+            signature: BLSSignature
+
+        fields = dict(P.BeaconState.fields())
+        fields["whisk_candidate_trackers"] = Vector[WhiskTracker, P.CANDIDATE_TRACKERS_COUNT]
+        fields["whisk_proposer_trackers"] = Vector[WhiskTracker, P.PROPOSER_TRACKERS_COUNT]
+        fields["whisk_trackers"] = List[WhiskTracker, P.VALIDATOR_REGISTRY_LIMIT]
+        fields["whisk_k_commitments"] = List[BLSG1Point, P.VALIDATOR_REGISTRY_LIMIT]
+        BeaconState = type("BeaconState", (Container,), {"__annotations__": fields})
+
+        for name, typ in list(locals().items()):
+            if isinstance(typ, type) and issubclass(typ, Container) and typ.fields():
+                typ.__name__ = name
+                setattr(self, name, typ)
+        self.BeaconState = BeaconState
+
+    # == proof backend ====================================================
+
+    def _fiat_shamir(self, *parts: bytes) -> int:
+        data = b"WHISKDLEQ" + b"".join(bytes(p) for p in parts)
+        return int.from_bytes(self.hash(data), "big") % BLS_MODULUS
+
+    def whisk_generate_opening_proof(self, k: int, tracker) -> bytes:
+        """Prover half of the Chaum-Pedersen DLEQ (test/validator side)."""
+        r_G = g1_from_bytes(bytes(tracker.r_G))
+        g = g1_generator()
+        # deterministic nonce from (k, tracker): no RNG in tests
+        t = self._fiat_shamir(
+            int(k).to_bytes(32, "big"), bytes(tracker.r_G), bytes(tracker.k_r_G), b"nonce"
+        )
+        a1 = r_G.mul(t)
+        a2 = g.mul(t)
+        c = self._fiat_shamir(
+            bytes(tracker.r_G), bytes(tracker.k_r_G), g1_to_bytes(a1), g1_to_bytes(a2)
+        )
+        s = (t + c * int(k)) % BLS_MODULUS
+        return g1_to_bytes(a1) + g1_to_bytes(a2) + s.to_bytes(32, "big")
+
+    def IsValidWhiskOpeningProof(self, tracker, k_commitment, tracker_proof) -> bool:
+        """Verify knowledge of k with tracker.k_r_G == k * tracker.r_G and
+        k_commitment == k * G (beacon-chain.md:124-132)."""
+        proof = bytes(tracker_proof)
+        if len(proof) != 128:
+            return False
+        try:
+            a1 = g1_from_bytes(proof[0:48])
+            a2 = g1_from_bytes(proof[48:96])
+            r_G = g1_from_bytes(bytes(tracker.r_G))
+            k_r_G = g1_from_bytes(bytes(tracker.k_r_G))
+            k_C = g1_from_bytes(bytes(k_commitment))
+        except (ValueError, AssertionError):
+            return False
+        s = int.from_bytes(proof[96:128], "big")
+        c = self._fiat_shamir(bytes(tracker.r_G), bytes(tracker.k_r_G), proof[0:48], proof[48:96])
+        return r_G.mul(s) == a1 + k_r_G.mul(c) and g1_generator().mul(s) == a2 + k_C.mul(c)
+
+    def whisk_generate_shuffle_proof(self, pre_shuffle_trackers, permutation, scalars):
+        """Transparent shuffle: post[i] = scalars[i] * pre[permutation[i]];
+        the proof serializes (permutation, scalars)."""
+        assert len(permutation) == len(scalars) == len(pre_shuffle_trackers)
+        post = []
+        proof = b""
+        for i, (p, s) in enumerate(zip(permutation, scalars)):
+            src = pre_shuffle_trackers[int(p)]
+            post.append(
+                self.WhiskTracker(
+                    r_G=g1_to_bytes(g1_from_bytes(bytes(src.r_G)).mul(int(s))),
+                    k_r_G=g1_to_bytes(g1_from_bytes(bytes(src.k_r_G)).mul(int(s))),
+                )
+            )
+            proof += int(p).to_bytes(8, "little") + int(s).to_bytes(32, "big")
+        return post, proof
+
+    def IsValidWhiskShuffleProof(
+        self, pre_shuffle_trackers, post_shuffle_trackers, shuffle_proof
+    ) -> bool:
+        """Verify post is a rerandomized permutation of pre
+        (beacon-chain.md:106-121; transparent backend, see module doc)."""
+        proof = bytes(shuffle_proof)
+        n = len(pre_shuffle_trackers)
+        if len(proof) != n * 40 or len(post_shuffle_trackers) != n:
+            return False
+        seen = set()
+        for i in range(n):
+            p = int.from_bytes(proof[i * 40 : i * 40 + 8], "little")
+            s = int.from_bytes(proof[i * 40 + 8 : i * 40 + 40], "big")
+            if p >= n or p in seen or s % BLS_MODULUS == 0:
+                return False
+            seen.add(p)
+            try:
+                src_r = g1_from_bytes(bytes(pre_shuffle_trackers[p].r_G))
+                src_krg = g1_from_bytes(bytes(pre_shuffle_trackers[p].k_r_G))
+            except (ValueError, AssertionError):
+                return False
+            post = post_shuffle_trackers[i]
+            if bytes(post.r_G) != g1_to_bytes(src_r.mul(s)):
+                return False
+            if bytes(post.k_r_G) != g1_to_bytes(src_krg.mul(s)):
+                return False
+        return True
+
+    # == tracker selection (beacon-chain.md:186-237) =======================
+
+    def select_whisk_proposer_trackers(self, state, epoch: int) -> None:
+        proposer_seed = self.get_seed(
+            state,
+            max(int(epoch) - self.config.PROPOSER_SELECTION_GAP, 0),
+            self.DOMAIN_PROPOSER_SELECTION,
+        )
+        perm = self._shuffle_permutation(
+            len(state.whisk_candidate_trackers), proposer_seed
+        )
+        for i in range(self.PROPOSER_TRACKERS_COUNT):
+            state.whisk_proposer_trackers[i] = state.whisk_candidate_trackers[
+                int(perm[i])
+            ]
+
+    def select_whisk_candidate_trackers(self, state, epoch: int) -> None:
+        active_validator_indices = self.get_active_validator_indices(state, int(epoch))
+        for i in range(self.CANDIDATE_TRACKERS_COUNT):
+            seed = self.hash(
+                self.get_seed(state, int(epoch), self.DOMAIN_CANDIDATE_SELECTION)
+                + self.uint_to_bytes(i, 8)
+            )
+            candidate_index = self.compute_proposer_index(
+                state, active_validator_indices, seed
+            )  # sample by effective balance
+            state.whisk_candidate_trackers[i] = state.whisk_trackers[candidate_index]
+
+    def process_whisk_updates(self, state) -> None:
+        next_epoch = self.get_current_epoch(state) + 1
+        if next_epoch % self.config.EPOCHS_PER_SHUFFLING_PHASE == 0:
+            self.select_whisk_proposer_trackers(state, next_epoch)
+            self.select_whisk_candidate_trackers(state, next_epoch)
+
+    def process_epoch(self, state) -> None:
+        super().process_epoch(state)
+        # [New in EIP7441]
+        self.process_whisk_updates(state)
+
+    # == block processing (beacon-chain.md:244-387) ========================
+
+    def process_whisk_opening_proof(self, state, block) -> None:
+        tracker = state.whisk_proposer_trackers[
+            int(state.slot) % self.PROPOSER_TRACKERS_COUNT
+        ]
+        k_commitment = state.whisk_k_commitments[int(block.proposer_index)]
+        assert self.IsValidWhiskOpeningProof(
+            tracker, k_commitment, block.body.whisk_opening_proof
+        ), "invalid whisk opening proof"
+
+    def process_block_header(self, state, block) -> None:
+        """[Modified in EIP7441] no proposer-index equality check; the
+        opening proof authorizes the proposer (beacon-chain.md:254-279)."""
+        assert block.slot == state.slot, "block/state slot mismatch"
+        assert block.slot > state.latest_block_header.slot, "block not newer than header"
+        assert bytes(block.parent_root) == bytes(
+            hash_tree_root(state.latest_block_header)
+        ), "parent root mismatch"
+        state.latest_block_header = self.BeaconBlockHeader(
+            slot=block.slot,
+            proposer_index=block.proposer_index,
+            parent_root=block.parent_root,
+            state_root=_B32(),
+            body_root=hash_tree_root(block.body),
+        )
+        proposer = state.validators[int(block.proposer_index)]
+        assert not proposer.slashed, "proposer is slashed"
+        # [New in EIP7441]
+        self.process_whisk_opening_proof(state, block)
+
+    def get_shuffle_indices(self, randao_reveal) -> list[int]:
+        indices = []
+        for i in range(self.VALIDATORS_PER_SHUFFLE):
+            pre_image = bytes(randao_reveal) + self.uint_to_bytes(i, 8)
+            indices.append(
+                self.bytes_to_uint64(self.hash(pre_image)[0:8])
+                % self.CANDIDATE_TRACKERS_COUNT
+            )
+        return indices
+
+    def process_shuffled_trackers(self, state, body) -> None:
+        shuffle_epoch = self.get_current_epoch(state) % self.config.EPOCHS_PER_SHUFFLING_PHASE
+        if (
+            shuffle_epoch + self.config.PROPOSER_SELECTION_GAP + 1
+            >= self.config.EPOCHS_PER_SHUFFLING_PHASE
+        ):
+            # cooldown: trackers must be zeroed
+            assert body.whisk_post_shuffle_trackers == type(
+                body.whisk_post_shuffle_trackers
+            )(), "cooldown requires zero trackers"
+            assert bytes(body.whisk_shuffle_proof) == b"", "cooldown requires empty proof"
+        else:
+            shuffle_indices = self.get_shuffle_indices(body.randao_reveal)
+            pre_shuffle_trackers = [
+                state.whisk_candidate_trackers[i] for i in shuffle_indices
+            ]
+            assert self.IsValidWhiskShuffleProof(
+                pre_shuffle_trackers,
+                list(body.whisk_post_shuffle_trackers),
+                body.whisk_shuffle_proof,
+            ), "invalid shuffle proof"
+            for i, shuffle_index in enumerate(shuffle_indices):
+                state.whisk_candidate_trackers[shuffle_index] = (
+                    body.whisk_post_shuffle_trackers[i]
+                )
+
+    def is_k_commitment_unique(self, state, k_commitment) -> bool:
+        return all(
+            bytes(c) != bytes(k_commitment) for c in state.whisk_k_commitments
+        )
+
+    def process_whisk_registration(self, state, body) -> None:
+        proposer_index = self.get_beacon_proposer_index(state)
+        if bytes(state.whisk_trackers[proposer_index].r_G) == self.BLS_G1_GENERATOR:
+            # first Whisk proposal
+            assert bytes(body.whisk_tracker.r_G) != self.BLS_G1_GENERATOR, (
+                "registration tracker must be fresh"
+            )
+            assert self.is_k_commitment_unique(state, body.whisk_k_commitment), (
+                "k commitment not unique"
+            )
+            assert self.IsValidWhiskOpeningProof(
+                body.whisk_tracker, body.whisk_k_commitment, body.whisk_registration_proof
+            ), "invalid registration proof"
+            state.whisk_trackers[proposer_index] = body.whisk_tracker
+            state.whisk_k_commitments[proposer_index] = body.whisk_k_commitment
+        else:
+            assert bytes(body.whisk_registration_proof) == b"", "unexpected proof"
+            assert body.whisk_tracker == self.WhiskTracker(), "unexpected tracker"
+            assert bytes(body.whisk_k_commitment) == bytes(BLSG1Point()), (
+                "unexpected commitment"
+            )
+
+    def process_block(self, state, block) -> None:
+        self.process_block_header(state, block)
+        self.process_withdrawals(state, block.body.execution_payload)
+        self.process_execution_payload(state, block.body, self.EXECUTION_ENGINE)
+        self.process_randao(state, block.body)
+        self.process_eth1_data(state, block.body)
+        self.process_operations(state, block.body)
+        self.process_sync_aggregate(state, block.body.sync_aggregate)
+        # [New in EIP7441]
+        self.process_shuffled_trackers(state, block.body)
+        self.process_whisk_registration(state, block.body)
+
+    # == deposits (beacon-chain.md:392-434) ================================
+
+    def get_initial_whisk_k(self, validator_index: int, counter: int) -> int:
+        return (
+            int.from_bytes(
+                self.hash(
+                    self.uint_to_bytes(int(validator_index), 8)
+                    + self.uint_to_bytes(int(counter), 8)
+                ),
+                "little",
+            )
+            % BLS_MODULUS
+        )
+
+    def get_unique_whisk_k(self, state, validator_index: int) -> int:
+        counter = 0
+        while True:
+            k = self.get_initial_whisk_k(validator_index, counter)
+            if self.is_k_commitment_unique(state, self.get_k_commitment(k)):
+                return k
+            counter += 1
+
+    def get_k_commitment(self, k: int) -> bytes:
+        return g1_to_bytes(g1_generator().mul(int(k)))
+
+    def get_initial_tracker(self, k: int) -> "Container":
+        return self.WhiskTracker(
+            r_G=self.BLS_G1_GENERATOR, k_r_G=g1_to_bytes(g1_generator().mul(int(k)))
+        )
+
+    def add_validator_to_registry(self, state, pubkey, withdrawal_credentials, amount) -> None:
+        super().add_validator_to_registry(state, pubkey, withdrawal_credentials, amount)
+        # [New in EIP7441]
+        k = self.get_unique_whisk_k(state, len(state.validators) - 1)
+        state.whisk_trackers.append(self.get_initial_tracker(k))
+        state.whisk_k_commitments.append(self.get_k_commitment(k))
+
+    # == proposer index (beacon-chain.md:439-446) ==========================
+
+    def get_beacon_proposer_index(self, state) -> int:
+        assert int(state.latest_block_header.slot) == int(state.slot), (
+            "proposer index only known during block processing"
+        )
+        return int(state.latest_block_header.proposer_index)
+
+    # == test/genesis bootstrap ===========================================
+
+    def initialize_feature_state(self, state) -> None:
+        """Fill the whisk fields on a fresh genesis state: every validator
+        gets a deterministic k and initial tracker, candidates/proposers
+        selected for phase 0 (mirrors fork.md's upgrade semantics)."""
+        for index in range(len(state.validators)):
+            k = self.get_unique_whisk_k(state, index)
+            state.whisk_trackers.append(self.get_initial_tracker(k))
+            state.whisk_k_commitments.append(self.get_k_commitment(k))
+        self.select_whisk_candidate_trackers(state, self.get_current_epoch(state))
+        self.select_whisk_proposer_trackers(state, self.get_current_epoch(state))

@@ -1,0 +1,148 @@
+"""EIP-7805 (FOCIL): fork-choice enforced, committee-based inclusion
+lists.
+
+Behavioral parity targets:
+  * beacon chain: specs/_features/eip7805/beacon-chain.md (containers
+    :54-71, signature predicate :78-92, committee accessor :96-111)
+  * inclusion-list store: specs/_features/eip7805/inclusion-list.md
+    (store :27-37, process_inclusion_list :56-79, transaction collection
+    :88-104)
+  * fork choice (subset): specs/_features/eip7805/fork-choice.md
+    (on_inclusion_list validation + equivocator tracking)
+"""
+
+from dataclasses import dataclass, field
+from typing import Dict, Set, Tuple
+
+from eth_consensus_specs_tpu.forks.fulu import FuluSpec
+from eth_consensus_specs_tpu.forks.phase0 import BLSSignature, Root, Slot, ValidatorIndex
+from eth_consensus_specs_tpu.ssz import Container, List, hash_tree_root
+from eth_consensus_specs_tpu.utils import bls
+
+
+class EIP7805Spec(FuluSpec):
+    fork_name = "eip7805"
+
+    # specs/_features/eip7805/beacon-chain.md:37-40
+    DOMAIN_INCLUSION_LIST_COMMITTEE = b"\x0c\x00\x00\x00"
+
+    def _build_types(self) -> None:
+        super()._build_types()
+        P = self
+
+        class InclusionList(Container):
+            slot: Slot
+            validator_index: ValidatorIndex
+            inclusion_list_committee_root: Root
+            transactions: List[P.Transaction, P.MAX_TRANSACTIONS_PER_PAYLOAD]
+
+        class SignedInclusionList(Container):
+            message: InclusionList
+            signature: BLSSignature
+
+        for name, typ in list(locals().items()):
+            if isinstance(typ, type) and issubclass(typ, Container):
+                typ.__name__ = name
+                setattr(self, name, typ)
+
+    # == predicates/accessors (beacon-chain.md:78-111) =====================
+
+    def is_valid_inclusion_list_signature(self, state, signed_inclusion_list) -> bool:
+        message = signed_inclusion_list.message
+        index = int(message.validator_index)
+        pubkey = state.validators[index].pubkey
+        domain = self.get_domain(
+            state,
+            self.DOMAIN_INCLUSION_LIST_COMMITTEE,
+            self.compute_epoch_at_slot(int(message.slot)),
+        )
+        signing_root = self.compute_signing_root(message, domain)
+        return bls.Verify(pubkey, signing_root, signed_inclusion_list.signature)
+
+    def get_inclusion_list_committee(self, state, slot: int):
+        epoch = self.compute_epoch_at_slot(int(slot))
+        seed = self.get_seed(state, epoch, self.DOMAIN_INCLUSION_LIST_COMMITTEE)
+        indices = self.get_active_validator_indices(state, epoch)
+        start = (int(slot) % self.SLOTS_PER_EPOCH) * self.INCLUSION_LIST_COMMITTEE_SIZE
+        end = start + self.INCLUSION_LIST_COMMITTEE_SIZE
+        perm = self._shuffle_permutation(len(indices), seed)
+        return [int(indices[int(perm[i % len(indices)])]) for i in range(start, end)]
+
+    # == inclusion-list store (inclusion-list.md) ==========================
+
+    @dataclass
+    class InclusionListStore:
+        inclusion_lists: Dict[Tuple[int, bytes], set] = field(default_factory=dict)
+        equivocators: Dict[Tuple[int, bytes], Set[int]] = field(default_factory=dict)
+
+    def get_inclusion_list_store(self) -> "EIP7805Spec.InclusionListStore":
+        return self.InclusionListStore()
+
+    def process_inclusion_list(
+        self, store, inclusion_list, is_before_view_freeze_deadline: bool
+    ) -> None:
+        """Equivocation-aware ingest (inclusion-list.md:56-79)."""
+        key = (int(inclusion_list.slot), bytes(inclusion_list.inclusion_list_committee_root))
+        equivocators = store.equivocators.setdefault(key, set())
+        stored = store.inclusion_lists.setdefault(key, set())
+
+        if int(inclusion_list.validator_index) in equivocators:
+            return
+
+        for stored_inclusion_list in stored:
+            if int(stored_inclusion_list.validator_index) != int(
+                inclusion_list.validator_index
+            ):
+                continue
+            if stored_inclusion_list != inclusion_list:
+                equivocators.add(int(inclusion_list.validator_index))
+                stored.remove(stored_inclusion_list)
+            return
+
+        if is_before_view_freeze_deadline:
+            stored.add(inclusion_list)
+
+    def get_inclusion_list_transactions(self, store, state, slot: int):
+        """Deduplicated transactions from timely, non-equivocating lists
+        (inclusion-list.md:88-104)."""
+        committee = self.get_inclusion_list_committee(state, int(slot))
+        committee_root = bytes(
+            hash_tree_root(
+                self._committee_vector_type()(committee)
+            )
+        )
+        key = (int(slot), committee_root)
+        txs = [
+            bytes(transaction)
+            for inclusion_list in store.inclusion_lists.get(key, set())
+            for transaction in inclusion_list.transactions
+        ]
+        return list(set(txs))
+
+    def _committee_vector_type(self):
+        from eth_consensus_specs_tpu.ssz import Vector
+
+        return Vector[ValidatorIndex, self.INCLUSION_LIST_COMMITTEE_SIZE]
+
+    # == fork-choice hook (fork-choice.md subset) ==========================
+
+    def on_inclusion_list(
+        self, store, inclusion_store, state, signed_inclusion_list,
+        is_before_view_freeze_deadline: bool,
+    ) -> None:
+        """Validate and ingest a gossiped inclusion list: committee
+        membership + root match + signature, then store-level
+        equivocation processing."""
+        message = signed_inclusion_list.message
+        committee = self.get_inclusion_list_committee(state, int(message.slot))
+        assert int(message.validator_index) in committee, "not in committee"
+        committee_root = bytes(hash_tree_root(self._committee_vector_type()(committee)))
+        assert bytes(message.inclusion_list_committee_root) == committee_root, (
+            "committee root mismatch"
+        )
+        assert self.is_valid_inclusion_list_signature(state, signed_inclusion_list), (
+            "bad signature"
+        )
+        self.process_inclusion_list(
+            inclusion_store, message, is_before_view_freeze_deadline
+        )

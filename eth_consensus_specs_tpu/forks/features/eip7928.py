@@ -1,0 +1,109 @@
+"""EIP-7928: block-level access lists in the execution payload.
+
+Behavioral parity target: specs/_features/eip7928/beacon-chain.md — the
+BlockAccessList payload field (:25-56), header root (:58-81), modified
+process_execution_payload hashing the access list into the header
+(:144-198), and fork.md's upgrade."""
+
+from eth_consensus_specs_tpu.forks.fulu import FuluSpec
+from eth_consensus_specs_tpu.ssz import ByteList, Bytes32, ByteVector, Container, List, hash_tree_root, uint64, uint256
+from eth_consensus_specs_tpu.forks.bellatrix import ExecutionAddress, Hash32
+from eth_consensus_specs_tpu.forks.phase0 import Root
+
+
+class EIP7928Spec(FuluSpec):
+    fork_name = "eip7928"
+
+    def _build_types(self) -> None:
+        super()._build_types()
+        P = self
+
+        # RLP-encoded block access list (specs/_features/eip7928/beacon-chain.md:25-29)
+        BlockAccessList = ByteList[P.MAX_BYTES_PER_TRANSACTION]
+        self.BlockAccessList = BlockAccessList
+
+        class ExecutionPayload(Container):
+            parent_hash: Hash32
+            fee_recipient: ExecutionAddress
+            state_root: Bytes32
+            receipts_root: Bytes32
+            logs_bloom: ByteVector[P.BYTES_PER_LOGS_BLOOM]
+            prev_randao: Bytes32
+            block_number: uint64
+            gas_limit: uint64
+            gas_used: uint64
+            timestamp: uint64
+            extra_data: ByteList[P.MAX_EXTRA_DATA_BYTES]
+            base_fee_per_gas: uint256
+            block_hash: Hash32
+            transactions: List[P.Transaction, P.MAX_TRANSACTIONS_PER_PAYLOAD]
+            withdrawals: List[P.Withdrawal, P.MAX_WITHDRAWALS_PER_PAYLOAD]
+            blob_gas_used: uint64
+            excess_blob_gas: uint64
+            block_access_list: BlockAccessList  # [New in EIP7928]
+
+        class ExecutionPayloadHeader(Container):
+            parent_hash: Hash32
+            fee_recipient: ExecutionAddress
+            state_root: Bytes32
+            receipts_root: Bytes32
+            logs_bloom: ByteVector[P.BYTES_PER_LOGS_BLOOM]
+            prev_randao: Bytes32
+            block_number: uint64
+            gas_limit: uint64
+            gas_used: uint64
+            timestamp: uint64
+            extra_data: ByteList[P.MAX_EXTRA_DATA_BYTES]
+            base_fee_per_gas: uint256
+            block_hash: Hash32
+            transactions_root: Root
+            withdrawals_root: Root
+            blob_gas_used: uint64
+            excess_blob_gas: uint64
+            block_access_list_root: Root  # [New in EIP7928]
+
+        class BeaconBlockBody(Container):
+            randao_reveal: P.BeaconBlockBody.fields()["randao_reveal"]
+            eth1_data: P.Eth1Data
+            graffiti: Bytes32
+            proposer_slashings: P.BeaconBlockBody.fields()["proposer_slashings"]
+            attester_slashings: P.BeaconBlockBody.fields()["attester_slashings"]
+            attestations: P.BeaconBlockBody.fields()["attestations"]
+            deposits: P.BeaconBlockBody.fields()["deposits"]
+            voluntary_exits: P.BeaconBlockBody.fields()["voluntary_exits"]
+            sync_aggregate: P.SyncAggregate
+            execution_payload: ExecutionPayload  # [Modified in EIP7928]
+            bls_to_execution_changes: P.BeaconBlockBody.fields()["bls_to_execution_changes"]
+            blob_kzg_commitments: P.BeaconBlockBody.fields()["blob_kzg_commitments"]
+            execution_requests: P.ExecutionRequests
+
+        class BeaconBlock(Container):
+            slot: P.BeaconBlock.fields()["slot"]
+            proposer_index: P.BeaconBlock.fields()["proposer_index"]
+            parent_root: Root
+            state_root: Root
+            body: BeaconBlockBody
+
+        class SignedBeaconBlock(Container):
+            message: BeaconBlock
+            signature: P.SignedBeaconBlock.fields()["signature"]
+
+        # rebuild the state with the modified header type, field-for-field
+        fields = dict(P.BeaconState.fields())
+        fields["latest_execution_payload_header"] = ExecutionPayloadHeader
+        BeaconState = type("BeaconState", (Container,), {"__annotations__": fields})
+
+        for name, typ in list(locals().items()):
+            if isinstance(typ, type) and issubclass(typ, Container) and typ.fields():
+                typ.__name__ = name
+                setattr(self, name, typ)
+        self.BeaconState = BeaconState
+
+    def execution_payload_to_header(self, payload):
+        """[Modified in EIP7928] commit to the access list
+        (specs/_features/eip7928/beacon-chain.md:180-198)."""
+        header = super().execution_payload_to_header(payload)
+        return self.ExecutionPayloadHeader(
+            **{name: getattr(header, name) for name in header.fields() if name != "block_access_list_root"},
+            block_access_list_root=hash_tree_root(payload.block_access_list),
+        )

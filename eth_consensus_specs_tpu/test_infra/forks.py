@@ -6,8 +6,18 @@ from __future__ import annotations
 from eth_consensus_specs_tpu.config import FORK_ORDER
 
 
+def _lineage_fork(spec) -> str:
+    """Mainline fork the spec sits on: itself, or its base fork for
+    feature specs (specs/_features/* fork off specific mainline forks)."""
+    if spec.fork_name in FORK_ORDER:
+        return spec.fork_name
+    from eth_consensus_specs_tpu.forks.features import FEATURE_BASE_FORK
+
+    return FEATURE_BASE_FORK[spec.fork_name]
+
+
 def _at_or_after(spec, fork: str) -> bool:
-    return FORK_ORDER.index(spec.fork_name) >= FORK_ORDER.index(fork)
+    return FORK_ORDER.index(_lineage_fork(spec)) >= FORK_ORDER.index(fork)
 
 
 def is_post_altair(spec) -> bool:
@@ -40,14 +50,28 @@ def is_post_gloas(spec) -> bool:
 
 def fork_version_of(spec) -> bytes:
     """The config fork version for the spec's own fork (phase0 ->
-    GENESIS_FORK_VERSION, altair -> ALTAIR_FORK_VERSION, ...)."""
-    if spec.fork_name == "phase0":
+    GENESIS_FORK_VERSION, altair -> ALTAIR_FORK_VERSION, ...). Feature
+    specs use their own EIPxxxx_FORK_VERSION when configured, else the
+    base fork's."""
+    name = spec.fork_name
+    if name not in FORK_ORDER:
+        key = f"{name.upper()}_FORK_VERSION"
+        if key in spec.config:
+            return spec.config[key]
+        name = _lineage_fork(spec)
+    if name == "phase0":
         return spec.config.GENESIS_FORK_VERSION
-    return spec.config[f"{spec.fork_name.upper()}_FORK_VERSION"]
+    return spec.config[f"{name.upper()}_FORK_VERSION"]
 
 
 def previous_fork_version_of(spec) -> bytes:
-    idx = FORK_ORDER.index(spec.fork_name)
+    lineage = _lineage_fork(spec)
+    if spec.fork_name not in FORK_ORDER:
+        # a feature forks off its base fork
+        if lineage == "phase0":
+            return spec.config.GENESIS_FORK_VERSION
+        return spec.config[f"{lineage.upper()}_FORK_VERSION"]
+    idx = FORK_ORDER.index(lineage)
     if idx == 0:
         return spec.config.GENESIS_FORK_VERSION
     prev = FORK_ORDER[idx - 1]

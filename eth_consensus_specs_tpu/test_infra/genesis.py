@@ -112,4 +112,7 @@ def create_genesis_state(spec, validator_balances: list[int], activation_thresho
         # [New in Fulu:EIP7917] genesis fills the full lookahead window
         # (specs/fulu/fork.md:27-44)
         state.proposer_lookahead = spec.initialize_proposer_lookahead(state)
+    if hasattr(spec, "initialize_feature_state"):
+        # feature forks (e.g. whisk) bootstrap their extra fields
+        spec.initialize_feature_state(state)
     return state

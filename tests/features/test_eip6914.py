@@ -1,0 +1,51 @@
+"""EIP-6914 validator-index reuse
+(reference: specs/_features/eip6914/ and
+eth2spec/test/eip6914/unittests/)."""
+
+from eth_consensus_specs_tpu.forks.features import get_feature_spec
+from eth_consensus_specs_tpu.test_infra.context import default_balances, default_activation_threshold
+from eth_consensus_specs_tpu.test_infra.genesis import create_genesis_state
+
+
+def _spec_state():
+    spec = get_feature_spec("eip6914", "minimal")
+    state = create_genesis_state(
+        spec, default_balances(spec), default_activation_threshold(spec)
+    )
+    return spec, state
+
+
+def test_is_reusable_validator_rules():
+    spec, state = _spec_state()
+    v = state.validators[0]
+    epoch = spec.get_current_epoch(state)
+    # active validator: not reusable
+    assert not spec.is_reusable_validator(v, state.balances[0], epoch)
+    # withdrawn long ago but balance remains: not reusable
+    v.withdrawable_epoch = 0
+    assert not spec.is_reusable_validator(v, state.balances[0], spec.SAFE_EPOCHS_TO_REUSE_INDEX + 1)
+    # withdrawn long ago and drained: reusable
+    assert spec.is_reusable_validator(v, 0, spec.SAFE_EPOCHS_TO_REUSE_INDEX + 1)
+    # not yet past the safety window
+    assert not spec.is_reusable_validator(v, 0, spec.SAFE_EPOCHS_TO_REUSE_INDEX)
+
+
+def test_get_index_for_new_validator_reuses_slot():
+    spec, state = _spec_state()
+    assert spec.get_index_for_new_validator(state) == len(state.validators)
+    # drain + age validator 3
+    state.validators[3].withdrawable_epoch = 0
+    state.balances[3] = 0
+    state.slot = (spec.SAFE_EPOCHS_TO_REUSE_INDEX + 2) * spec.SLOTS_PER_EPOCH
+    assert spec.get_index_for_new_validator(state) == 3
+
+
+def test_on_reused_index_clears_equivocation():
+    spec, state = _spec_state()
+    from eth_consensus_specs_tpu.test_infra.fork_choice import get_genesis_forkchoice_store
+
+    store, _ = get_genesis_forkchoice_store(spec, state)
+    store.equivocating_indices.add(7)
+    spec.on_reused_index(store, 7)
+    assert 7 not in store.equivocating_indices
+    spec.on_reused_index(store, 9)  # absent index is a no-op

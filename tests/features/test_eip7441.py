@@ -1,0 +1,177 @@
+"""EIP-7441 Whisk SSLE
+(reference: specs/_features/eip7441/beacon-chain.md; proofs are the
+first-party backends described in forks/features/eip7441.py)."""
+
+import pytest
+
+from eth_consensus_specs_tpu.crypto.curve import g1_from_bytes, g1_generator, g1_to_bytes
+from eth_consensus_specs_tpu.forks.features import get_feature_spec
+from eth_consensus_specs_tpu.test_infra.block import (
+    build_empty_block,
+    state_transition_and_sign_block,
+)
+from eth_consensus_specs_tpu.test_infra.context import (
+    default_activation_threshold,
+    default_balances,
+)
+from eth_consensus_specs_tpu.test_infra.genesis import create_genesis_state
+from eth_consensus_specs_tpu.utils import bls
+
+
+def _spec_state():
+    bls.bls_active = False
+    spec = get_feature_spec("eip7441", "minimal")
+    state = create_genesis_state(
+        spec, default_balances(spec), default_activation_threshold(spec)
+    )
+    return spec, state
+
+
+_validator_k_cache: dict[int, int] = {}
+
+
+def _validator_k(spec, index: int) -> int:
+    """Replay the deterministic genesis k assignment for validator
+    `index` (uniqueness trial matches initialize_feature_state order)."""
+    for i in range(index + 1):
+        if i in _validator_k_cache:
+            continue
+        counter = 0
+        while True:
+            k = spec.get_initial_whisk_k(i, counter)
+            commitment = spec.get_k_commitment(k)
+            earlier = [
+                spec.get_k_commitment(_validator_k_cache[j]) for j in range(i)
+            ]
+            if all(bytes(e) != bytes(commitment) for e in earlier):
+                _validator_k_cache[i] = k
+                break
+            counter += 1
+    return _validator_k_cache[index]
+
+
+def _proposer_for_slot(spec, state, slot: int):
+    """Find (validator_index, k) able to open the slot's proposer tracker."""
+    tracker = state.whisk_proposer_trackers[slot % spec.PROPOSER_TRACKERS_COUNT]
+    for index in range(len(state.validators)):
+        if bytes(state.whisk_k_commitments[index]) == bytes(tracker.k_r_G) and bytes(
+            tracker.r_G
+        ) == spec.BLS_G1_GENERATOR:
+            return index, _validator_k(spec, index)
+    raise AssertionError("no initial-tracker proposer for this slot")
+
+
+def test_opening_proof_roundtrip():
+    spec, state = _spec_state()
+    idx, k = _proposer_for_slot(spec, state, 1)
+    tracker = state.whisk_proposer_trackers[1 % spec.PROPOSER_TRACKERS_COUNT]
+    commitment = state.whisk_k_commitments[idx]
+    proof = spec.whisk_generate_opening_proof(k, tracker)
+    assert spec.IsValidWhiskOpeningProof(tracker, commitment, proof)
+    # wrong k fails
+    bad = spec.whisk_generate_opening_proof(k + 1, tracker)
+    assert not spec.IsValidWhiskOpeningProof(tracker, commitment, bad)
+    # tampered proof fails
+    tampered = bytearray(proof)
+    tampered[-1] ^= 1
+    assert not spec.IsValidWhiskOpeningProof(tracker, commitment, bytes(tampered))
+
+
+def test_shuffle_proof_roundtrip():
+    spec, state = _spec_state()
+    pre = [state.whisk_candidate_trackers[i] for i in range(spec.VALIDATORS_PER_SHUFFLE)]
+    perm = list(reversed(range(len(pre))))
+    scalars = [3 + i for i in range(len(pre))]
+    post, proof = spec.whisk_generate_shuffle_proof(pre, perm, scalars)
+    assert spec.IsValidWhiskShuffleProof(pre, post, proof)
+    # tampering with a post tracker fails
+    bad_post = [t.copy() for t in post]
+    bad_post[0].r_G = g1_to_bytes(g1_generator())
+    assert not spec.IsValidWhiskShuffleProof(pre, bad_post, proof)
+    # non-permutation (duplicate source) fails
+    dup_proof = proof[:40] + proof[:40] + proof[80:]
+    assert not spec.IsValidWhiskShuffleProof(pre, post, dup_proof)
+
+
+def test_whisk_full_block():
+    """A block carrying an opening proof, an identity shuffle, and a
+    first-proposal registration applies end to end."""
+    spec, state = _spec_state()
+    slot = 1
+    idx, k = _proposer_for_slot(spec, state, slot)
+    block = build_empty_block(spec, state, slot=slot, proposer_index=idx)
+
+    # opening proof over the slot's proposer tracker
+    tracker = state.whisk_proposer_trackers[slot % spec.PROPOSER_TRACKERS_COUNT]
+    block.body.whisk_opening_proof = spec.whisk_generate_opening_proof(k, tracker)
+
+    # shuffle: permute the randao-derived candidates (transparent proof)
+    shuffle_indices = spec.get_shuffle_indices(block.body.randao_reveal)
+    pre = [state.whisk_candidate_trackers[i] for i in shuffle_indices]
+    perm = list(range(len(pre)))
+    scalars = [2] * len(pre)
+    post, proof = spec.whisk_generate_shuffle_proof(pre, perm, scalars)
+    block.body.whisk_post_shuffle_trackers = post
+    block.body.whisk_shuffle_proof = proof
+
+    # first proposal: register a fresh tracker under a new secret
+    k_new = 0x1234567
+    r = 0xABCDEF
+    g = g1_generator()
+    fresh = spec.WhiskTracker(
+        r_G=g1_to_bytes(g.mul(r)), k_r_G=g1_to_bytes(g.mul(r * k_new % spec.BLS_MODULUS))
+    )
+    block.body.whisk_k_commitment = spec.get_k_commitment(k_new)
+    block.body.whisk_registration_proof = spec.whisk_generate_opening_proof(k_new, fresh)
+    block.body.whisk_tracker = fresh
+
+    state_transition_and_sign_block(spec, state, block)
+    assert int(state.slot) == slot
+    assert bytes(state.whisk_trackers[idx].r_G) == bytes(fresh.r_G)
+    assert bytes(state.whisk_k_commitments[idx]) == bytes(spec.get_k_commitment(k_new))
+    # the shuffled candidates were rerandomized in place
+    for i, si in enumerate(shuffle_indices):
+        assert bytes(state.whisk_candidate_trackers[si].r_G) == bytes(post[i].r_G)
+
+
+def test_whisk_block_rejects_bad_opening():
+    spec, state = _spec_state()
+    slot = 1
+    idx, k = _proposer_for_slot(spec, state, slot)
+    block = build_empty_block(spec, state, slot=slot, proposer_index=idx)
+    block.body.whisk_opening_proof = b"\x00" * 128  # garbage
+    from eth_consensus_specs_tpu.test_infra.context import expect_assertion_error
+
+    from eth_consensus_specs_tpu.test_infra.block import transition_unsigned_block
+
+    expect_assertion_error(lambda: transition_unsigned_block(spec, state.copy(), block))
+
+
+def test_registration_requires_unique_commitment():
+    spec, state = _spec_state()
+    slot = 1
+    idx, k = _proposer_for_slot(spec, state, slot)
+    block = build_empty_block(spec, state, slot=slot, proposer_index=idx)
+    tracker = state.whisk_proposer_trackers[slot % spec.PROPOSER_TRACKERS_COUNT]
+    block.body.whisk_opening_proof = spec.whisk_generate_opening_proof(k, tracker)
+    shuffle_indices = spec.get_shuffle_indices(block.body.randao_reveal)
+    pre = [state.whisk_candidate_trackers[i] for i in shuffle_indices]
+    post, proof = spec.whisk_generate_shuffle_proof(
+        pre, list(range(len(pre))), [2] * len(pre)
+    )
+    block.body.whisk_post_shuffle_trackers = post
+    block.body.whisk_shuffle_proof = proof
+    # register with ANOTHER validator's existing k -> non-unique commitment
+    other_k = _validator_k(spec, (idx + 1) % len(state.validators))
+    fresh = spec.WhiskTracker(
+        r_G=g1_to_bytes(g1_generator().mul(5)),
+        k_r_G=g1_to_bytes(g1_generator().mul(5 * other_k % spec.BLS_MODULUS)),
+    )
+    block.body.whisk_tracker = fresh
+    block.body.whisk_k_commitment = spec.get_k_commitment(other_k)
+    block.body.whisk_registration_proof = spec.whisk_generate_opening_proof(other_k, fresh)
+
+    from eth_consensus_specs_tpu.test_infra.block import transition_unsigned_block
+    from eth_consensus_specs_tpu.test_infra.context import expect_assertion_error
+
+    expect_assertion_error(lambda: transition_unsigned_block(spec, state.copy(), block))

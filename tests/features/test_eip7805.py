@@ -1,0 +1,130 @@
+"""EIP-7805 FOCIL inclusion lists
+(reference: specs/_features/eip7805/ and eth2spec/test/eip7805/)."""
+
+import pytest
+
+from eth_consensus_specs_tpu.forks.features import get_feature_spec
+from eth_consensus_specs_tpu.ssz import hash_tree_root
+from eth_consensus_specs_tpu.test_infra.context import (
+    default_activation_threshold,
+    default_balances,
+)
+from eth_consensus_specs_tpu.test_infra.genesis import create_genesis_state
+from eth_consensus_specs_tpu.test_infra.keys import privkeys
+from eth_consensus_specs_tpu.utils import bls
+
+
+def _spec_state():
+    bls.bls_active = False
+    spec = get_feature_spec("eip7805", "minimal")
+    state = create_genesis_state(
+        spec, default_balances(spec), default_activation_threshold(spec)
+    )
+    return spec, state
+
+
+def _committee_root(spec, committee):
+    return hash_tree_root(spec._committee_vector_type()(committee))
+
+
+def test_committee_is_deterministic_and_sized():
+    spec, state = _spec_state()
+    committee = spec.get_inclusion_list_committee(state, 3)
+    assert len(committee) == spec.INCLUSION_LIST_COMMITTEE_SIZE
+    assert committee == spec.get_inclusion_list_committee(state, 3)
+    assert committee != spec.get_inclusion_list_committee(state, 4)
+
+
+def test_signature_validation():
+    spec, state = _spec_state()
+    committee = spec.get_inclusion_list_committee(state, 1)
+    idx = committee[0]
+    message = spec.InclusionList(
+        slot=1,
+        validator_index=idx,
+        inclusion_list_committee_root=_committee_root(spec, committee),
+        transactions=[b"\x01"],
+    )
+    bls.bls_active = True
+    try:
+        domain = spec.get_domain(
+            state, spec.DOMAIN_INCLUSION_LIST_COMMITTEE, spec.compute_epoch_at_slot(1)
+        )
+        signing_root = spec.compute_signing_root(message, domain)
+        sig = bls.Sign(privkeys[idx], signing_root)
+        signed = spec.SignedInclusionList(message=message, signature=sig)
+        assert spec.is_valid_inclusion_list_signature(state, signed)
+        wrong = spec.SignedInclusionList(message=message, signature=b"\x11" * 96)
+        assert not spec.is_valid_inclusion_list_signature(state, wrong)
+    finally:
+        bls.bls_active = False
+
+
+def test_store_collects_and_dedupes_transactions():
+    spec, state = _spec_state()
+    committee = spec.get_inclusion_list_committee(state, 1)
+    root = _committee_root(spec, committee)
+    store = spec.get_inclusion_list_store()
+    il1 = spec.InclusionList(
+        slot=1, validator_index=committee[0],
+        inclusion_list_committee_root=root, transactions=[b"\xaa", b"\xbb"],
+    )
+    il2 = spec.InclusionList(
+        slot=1, validator_index=committee[1],
+        inclusion_list_committee_root=root, transactions=[b"\xbb", b"\xcc"],
+    )
+    spec.process_inclusion_list(store, il1, True)
+    spec.process_inclusion_list(store, il2, True)
+    txs = sorted(spec.get_inclusion_list_transactions(store, state, 1))
+    assert txs == [b"\xaa", b"\xbb", b"\xcc"]
+
+
+def test_equivocation_removes_validator_lists():
+    spec, state = _spec_state()
+    committee = spec.get_inclusion_list_committee(state, 1)
+    root = _committee_root(spec, committee)
+    store = spec.get_inclusion_list_store()
+    il = spec.InclusionList(
+        slot=1, validator_index=committee[0],
+        inclusion_list_committee_root=root, transactions=[b"\xaa"],
+    )
+    spec.process_inclusion_list(store, il, True)
+    altered = il.copy()
+    altered.transactions = [b"\xff"]
+    spec.process_inclusion_list(store, altered, True)
+    key = (1, bytes(root))
+    assert committee[0] in store.equivocators[key]
+    assert spec.get_inclusion_list_transactions(store, state, 1) == []
+    # further lists from the equivocator are ignored
+    spec.process_inclusion_list(store, il, True)
+    assert spec.get_inclusion_list_transactions(store, state, 1) == []
+
+
+def test_late_lists_not_stored():
+    spec, state = _spec_state()
+    committee = spec.get_inclusion_list_committee(state, 1)
+    root = _committee_root(spec, committee)
+    store = spec.get_inclusion_list_store()
+    il = spec.InclusionList(
+        slot=1, validator_index=committee[0],
+        inclusion_list_committee_root=root, transactions=[b"\xaa"],
+    )
+    spec.process_inclusion_list(store, il, is_before_view_freeze_deadline=False)
+    assert spec.get_inclusion_list_transactions(store, state, 1) == []
+
+
+def test_on_inclusion_list_validates_membership():
+    spec, state = _spec_state()
+    committee = spec.get_inclusion_list_committee(state, 1)
+    root = _committee_root(spec, committee)
+    store = spec.get_inclusion_list_store()
+    non_member = next(
+        i for i in range(len(state.validators)) if i not in committee
+    )
+    message = spec.InclusionList(
+        slot=1, validator_index=non_member,
+        inclusion_list_committee_root=root, transactions=[],
+    )
+    signed = spec.SignedInclusionList(message=message)
+    with pytest.raises(AssertionError):
+        spec.on_inclusion_list(None, store, state, signed, True)

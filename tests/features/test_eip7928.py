@@ -1,0 +1,52 @@
+"""EIP-7928 block access lists
+(reference: specs/_features/eip7928/beacon-chain.md)."""
+
+from eth_consensus_specs_tpu.forks.features import get_feature_spec
+from eth_consensus_specs_tpu.ssz import hash_tree_root
+from eth_consensus_specs_tpu.test_infra.block import (
+    build_empty_block_for_next_slot,
+    state_transition_and_sign_block,
+)
+from eth_consensus_specs_tpu.test_infra.context import (
+    default_activation_threshold,
+    default_balances,
+)
+from eth_consensus_specs_tpu.test_infra.genesis import create_genesis_state
+from eth_consensus_specs_tpu.utils import bls
+
+
+def _spec_state():
+    bls.bls_active = False
+    spec = get_feature_spec("eip7928", "minimal")
+    state = create_genesis_state(
+        spec, default_balances(spec), default_activation_threshold(spec)
+    )
+    return spec, state
+
+
+def test_payload_carries_access_list():
+    spec, state = _spec_state()
+    block = build_empty_block_for_next_slot(spec, state)
+    bal = b"\xde\xad\xbe\xef" * 8
+    block.body.execution_payload.block_access_list = bal
+    state_transition_and_sign_block(spec, state, block)
+    header = state.latest_execution_payload_header
+    assert bytes(header.block_access_list_root) == bytes(
+        hash_tree_root(spec.BlockAccessList(bal))
+    )
+
+
+def test_empty_access_list_root_differs_from_nonempty():
+    spec, state = _spec_state()
+    empty_root = hash_tree_root(spec.BlockAccessList(b""))
+    nonempty_root = hash_tree_root(spec.BlockAccessList(b"\x01"))
+    assert bytes(empty_root) != bytes(nonempty_root)
+
+
+def test_header_round_trips_through_blocks():
+    spec, state = _spec_state()
+    for i in range(2):
+        block = build_empty_block_for_next_slot(spec, state)
+        block.body.execution_payload.block_access_list = bytes([i]) * 4
+        state_transition_and_sign_block(spec, state, block)
+    assert int(state.slot) == 2
