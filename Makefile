@@ -2,7 +2,7 @@
 
 PYTHON ?= python
 
-.PHONY: help install test test-fast lint reftests bench multichip clean
+.PHONY: help install test test-fast lint reftests bench multichip serve_docs coverage clean
 
 help:
 	@echo "install    - editable install with test extras"
@@ -34,6 +34,12 @@ bench:
 
 multichip:
 	$(PYTHON) -c "import __graft_entry__ as g; g.dryrun_multichip(8); print('ok')"
+
+serve_docs:
+	$(PYTHON) -m mkdocs serve
+
+coverage:
+	$(PYTHON) scripts/spec_coverage.py
 
 clean:
 	rm -rf .pytest_cache .jax_cache test_vectors

@@ -1,0 +1,46 @@
+"""Tracing/profiling helpers (SURVEY §5: the reference has none — the TPU
+build adds real tracing via the jax profiler).
+
+`trace(logdir)` wraps a region in a jax profiler trace viewable in
+TensorBoard/Perfetto; `timed(fn)` gives quick wall-clock numbers with
+`block_until_ready` so async dispatch doesn't lie."""
+
+from __future__ import annotations
+
+import contextlib
+import time
+
+
+@contextlib.contextmanager
+def trace(logdir: str, create_perfetto_link: bool = False):
+    """Profile a region: `with trace("/tmp/jax-trace"): step(...)`."""
+    import jax
+
+    jax.profiler.start_trace(logdir, create_perfetto_link=create_perfetto_link)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()
+
+
+def timed(fn, *args, repeats: int = 3, warmup: int = 1, **kwargs):
+    """(best_seconds, result) over `repeats` runs after `warmup` calls;
+    blocks on device results so dispatch isn't measured as compute."""
+    import jax
+
+    result = None
+    for _ in range(max(warmup, 0)):
+        result = jax.block_until_ready(fn(*args, **kwargs))
+    best = float("inf")
+    for _ in range(max(repeats, 1)):
+        t0 = time.perf_counter()
+        result = jax.block_until_ready(fn(*args, **kwargs))
+        best = min(best, time.perf_counter() - t0)
+    return best, result
+
+
+def annotate(name: str):
+    """Named sub-region inside a trace (shows as a span in the viewer)."""
+    import jax
+
+    return jax.profiler.TraceAnnotation(name)

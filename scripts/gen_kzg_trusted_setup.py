@@ -1,0 +1,26 @@
+#!/usr/bin/env python
+"""Regenerate the INSECURE testing trusted setup (reference analogue:
+scripts/gen_kzg_trusted_setups.py).
+
+Usage: python scripts/gen_kzg_trusted_setup.py [--g1 4096]
+"""
+
+import argparse
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--g1", type=int, default=4096, help="G1 monomial/lagrange count")
+    args = parser.parse_args()
+
+    from eth_consensus_specs_tpu.crypto import kzg_setup
+
+    print(f"trusted setup written to {kzg_setup.write_setup(n=args.g1)}")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,45 @@
+#!/usr/bin/env python
+"""Report the implemented spec surface: forks, features, per-fork method
+counts, and test-function counts (reference analogue: the docs indices
+scripts/gen_spec_indices.py builds).
+
+Usage: python scripts/spec_coverage.py
+"""
+
+import sys
+from pathlib import Path
+
+ROOT = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(ROOT))
+
+
+def main() -> None:
+    import os
+
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    from eth_consensus_specs_tpu.forks import available_forks, get_spec
+    from eth_consensus_specs_tpu.forks.features import available_features, get_feature_spec
+
+    print(f"{'fork':<12} {'spec methods':>12} {'containers':>11}")
+    for fork in available_forks():
+        spec = get_spec(fork, "minimal")
+        methods = [n for n in dir(spec) if callable(getattr(spec, n)) and not n.startswith("_")]
+        containers = [
+            n for n in vars(spec) if isinstance(getattr(spec, n), type)
+        ]
+        print(f"{fork:<12} {len(methods):>12} {len(containers):>11}")
+    for feat in available_features():
+        spec = get_feature_spec(feat, "minimal")
+        methods = [n for n in dir(spec) if callable(getattr(spec, n)) and not n.startswith("_")]
+        print(f"{feat:<12} {len(methods):>12}")
+
+    n_tests = 0
+    for path in (ROOT / "tests").rglob("test_*.py"):
+        n_tests += sum(
+            1 for line in path.read_text().splitlines() if line.startswith("def test_")
+        )
+    print(f"\ntest functions: {n_tests}")
+
+
+if __name__ == "__main__":
+    main()
