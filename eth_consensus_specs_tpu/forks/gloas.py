@@ -27,6 +27,8 @@ per-slot payment weights live in a fixed 2*SLOTS_PER_EPOCH vector, i.e. a
 static-shape accumulator a fused attestation kernel can scatter-add into.
 """
 
+from dataclasses import dataclass, field
+
 from eth_consensus_specs_tpu.ssz import (
     Bitvector,
     Bytes32,
@@ -966,6 +968,362 @@ class GloasSpec(FuluSpec):
             assert bytes(envelope.state_root) == bytes(hash_tree_root(state)), (
                 "envelope state root mismatch"
             )
+
+    # == fork choice (specs/gloas/fork-choice.md) ==========================
+    #
+    # The block tree becomes a DAG over (root, payload_status) nodes: each
+    # beacon block can be extended on its EMPTY branch (payload never
+    # revealed) or its FULL branch (envelope imported), and LMD votes carry
+    # the attested payload availability in data.index.
+
+    PAYLOAD_STATUS_PENDING = 0
+    PAYLOAD_STATUS_EMPTY = 1
+    PAYLOAD_STATUS_FULL = 2
+
+    @property
+    def PAYLOAD_TIMELY_THRESHOLD(self) -> int:
+        return self.PTC_SIZE // 2
+
+    @dataclass(frozen=True)
+    class ForkChoiceNode:
+        root: bytes
+        payload_status: int
+
+    @dataclass(frozen=True)
+    class LatestMessage:
+        """[Modified in Gloas] slot-granular vote with payload flag
+        (fork-choice.md:74-84)."""
+
+        slot: int
+        root: bytes
+        payload_present: bool
+
+    @dataclass
+    class Store(FuluSpec.Store):
+        """[Modified in Gloas] adds execution_payload_states + ptc_vote
+        (fork-choice.md:117-137); populated by get_forkchoice_store."""
+
+        execution_payload_states: dict = field(default_factory=dict)
+        ptc_vote: dict = field(default_factory=dict)
+
+    def get_forkchoice_store(self, anchor_state, anchor_block):
+        store = super().get_forkchoice_store(anchor_state, anchor_block)
+        anchor_root = bytes(hash_tree_root(anchor_block))
+        # [New in Gloas:EIP7732] (fork-choice.md:163-165)
+        store.execution_payload_states = {anchor_root: anchor_state.copy()}
+        store.ptc_vote = {anchor_root: [False] * self.PTC_SIZE}
+        return store
+
+    def update_latest_messages(self, store, attesting_indices, attestation) -> None:
+        """[Modified in Gloas] slot-keyed messages (fork-choice.md:95-108)."""
+        slot = int(attestation.data.slot)
+        beacon_block_root = bytes(attestation.data.beacon_block_root)
+        payload_present = int(attestation.data.index) == 1
+        non_equivocating = [i for i in attesting_indices if i not in store.equivocating_indices]
+        for i in non_equivocating:
+            if i not in store.latest_messages or slot > store.latest_messages[i].slot:
+                store.latest_messages[i] = self.LatestMessage(
+                    slot=slot, root=beacon_block_root, payload_present=payload_present
+                )
+
+    def notify_ptc_messages(self, store, state, payload_attestations) -> None:
+        """Ingest block-carried PTC attestations (fork-choice.md:172-194)."""
+        if int(state.slot) == 0:
+            return
+        for payload_attestation in payload_attestations:
+            indexed = self.get_indexed_payload_attestation(
+                state, int(state.slot) - 1, payload_attestation
+            )
+            for idx in indexed.attesting_indices:
+                self.on_payload_attestation_message(
+                    store,
+                    self.PayloadAttestationMessage(
+                        validator_index=idx, data=payload_attestation.data
+                    ),
+                    is_from_block=True,
+                )
+
+    def is_payload_timely(self, store, root) -> bool:
+        """(fork-choice.md:200-213)"""
+        root = bytes(root)
+        assert root in store.ptc_vote, "unknown block for PTC vote"
+        if root not in store.execution_payload_states:
+            return False
+        return sum(store.ptc_vote[root]) > self.PAYLOAD_TIMELY_THRESHOLD
+
+    def get_parent_payload_status(self, store, block) -> int:
+        """(fork-choice.md:219-223)"""
+        parent = store.blocks[bytes(block.parent_root)]
+        parent_block_hash = bytes(block.body.signed_execution_payload_bid.message.parent_block_hash)
+        message_block_hash = bytes(parent.body.signed_execution_payload_bid.message.block_hash)
+        return (
+            self.PAYLOAD_STATUS_FULL
+            if parent_block_hash == message_block_hash
+            else self.PAYLOAD_STATUS_EMPTY
+        )
+
+    def is_parent_node_full(self, store, block) -> bool:
+        return self.get_parent_payload_status(store, block) == self.PAYLOAD_STATUS_FULL
+
+    def get_ancestor(self, store, root, slot: int):
+        """[Modified in Gloas] returns a ForkChoiceNode carrying whether
+        the chain passes through the ancestor's EMPTY or FULL branch
+        (fork-choice.md:239-256)."""
+        root = bytes(root)
+        block = store.blocks[root]
+        if int(block.slot) <= int(slot):
+            return self.ForkChoiceNode(root=root, payload_status=self.PAYLOAD_STATUS_PENDING)
+        parent = store.blocks[bytes(block.parent_root)]
+        if int(parent.slot) > int(slot):
+            return self.get_ancestor(store, block.parent_root, slot)
+        return self.ForkChoiceNode(
+            root=bytes(block.parent_root),
+            payload_status=self.get_parent_payload_status(store, block),
+        )
+
+    def get_checkpoint_block(self, store, root, epoch: int):
+        """[Modified in Gloas] unwraps the node (fork-choice.md:264-269)."""
+        epoch_first_slot = self.compute_start_slot_at_epoch(int(epoch))
+        return self.get_ancestor(store, root, epoch_first_slot).root
+
+    def is_supporting_vote(self, store, node, message) -> bool:
+        """(fork-choice.md:275-296)"""
+        block = store.blocks[bytes(node.root)]
+        if bytes(node.root) == bytes(message.root):
+            if node.payload_status == self.PAYLOAD_STATUS_PENDING:
+                return True
+            if int(message.slot) <= int(block.slot):
+                return False
+            if message.payload_present:
+                return node.payload_status == self.PAYLOAD_STATUS_FULL
+            return node.payload_status == self.PAYLOAD_STATUS_EMPTY
+        ancestor = self.get_ancestor(store, message.root, int(block.slot))
+        return bytes(node.root) == bytes(ancestor.root) and (
+            node.payload_status == self.PAYLOAD_STATUS_PENDING
+            or node.payload_status == ancestor.payload_status
+        )
+
+    def should_extend_payload(self, store, root) -> bool:
+        """(fork-choice.md:308-315)"""
+        proposer_root = bytes(store.proposer_boost_root)
+        return (
+            self.is_payload_timely(store, root)
+            or proposer_root == b"\x00" * 32
+            or bytes(store.blocks[proposer_root].parent_root) != bytes(root)
+            or self.is_parent_node_full(store, store.blocks[proposer_root])
+        )
+
+    def get_payload_status_tiebreaker(self, store, node) -> int:
+        """(fork-choice.md:321-332)"""
+        if (
+            node.payload_status == self.PAYLOAD_STATUS_PENDING
+            or int(store.blocks[bytes(node.root)].slot) + 1 != self.get_current_slot(store)
+        ):
+            return node.payload_status
+        if node.payload_status == self.PAYLOAD_STATUS_EMPTY:
+            return 1
+        return 2 if self.should_extend_payload(store, node.root) else 0
+
+    def get_proposer_score(self, store) -> int:
+        state = store.checkpoint_states[store.justified_checkpoint]
+        committee_weight = self.get_total_active_balance(state) // self.SLOTS_PER_EPOCH
+        return (committee_weight * self.config.PROPOSER_SCORE_BOOST) // 100
+
+    def get_weight(self, store, node) -> int:
+        """[Modified in Gloas] weight of a (root, payload_status) node
+        (fork-choice.md:338-380)."""
+        if not isinstance(node, self.ForkChoiceNode):
+            node = self.ForkChoiceNode(
+                root=bytes(node), payload_status=self.PAYLOAD_STATUS_PENDING
+            )
+        if (
+            node.payload_status == self.PAYLOAD_STATUS_PENDING
+            or int(store.blocks[bytes(node.root)].slot) + 1 != self.get_current_slot(store)
+        ):
+            state = store.checkpoint_states[store.justified_checkpoint]
+            unslashed_and_active_indices = [
+                i
+                for i in self.get_active_validator_indices(
+                    state, self.get_current_epoch(state)
+                )
+                if not state.validators[i].slashed
+            ]
+            attestation_score = sum(
+                int(state.validators[i].effective_balance)
+                for i in unslashed_and_active_indices
+                if (
+                    i in store.latest_messages
+                    and i not in store.equivocating_indices
+                    and self.is_supporting_vote(store, node, store.latest_messages[i])
+                )
+            )
+            if bytes(store.proposer_boost_root) == b"\x00" * 32:
+                return attestation_score
+            proposer_score = 0
+            message = self.LatestMessage(
+                slot=self.get_current_slot(store),
+                root=bytes(store.proposer_boost_root),
+                payload_present=False,
+            )
+            if self.is_supporting_vote(store, node, message):
+                proposer_score = self.get_proposer_score(store)
+            return attestation_score + proposer_score
+        return 0
+
+    def get_node_children(self, store, blocks, node):
+        """(fork-choice.md:386-402)"""
+        if node.payload_status == self.PAYLOAD_STATUS_PENDING:
+            children = [
+                self.ForkChoiceNode(
+                    root=bytes(node.root), payload_status=self.PAYLOAD_STATUS_EMPTY
+                )
+            ]
+            if bytes(node.root) in store.execution_payload_states:
+                children.append(
+                    self.ForkChoiceNode(
+                        root=bytes(node.root), payload_status=self.PAYLOAD_STATUS_FULL
+                    )
+                )
+            return children
+        return [
+            self.ForkChoiceNode(root=bytes(root), payload_status=self.PAYLOAD_STATUS_PENDING)
+            for root in blocks.keys()
+            if (
+                bytes(blocks[root].parent_root) == bytes(node.root)
+                and node.payload_status == self.get_parent_payload_status(store, blocks[root])
+            )
+        ]
+
+    def get_head(self, store):
+        """[Modified in Gloas] LMD-GHOST over (root, payload_status) nodes;
+        returns the head ForkChoiceNode (fork-choice.md:411-433)."""
+        blocks = self.get_filtered_block_tree(store)
+        head = self.ForkChoiceNode(
+            root=bytes(store.justified_checkpoint.root),
+            payload_status=self.PAYLOAD_STATUS_PENDING,
+        )
+        while True:
+            children = self.get_node_children(store, blocks, head)
+            if len(children) == 0:
+                return head
+            head = max(
+                children,
+                key=lambda child: (
+                    self.get_weight(store, child),
+                    bytes(child.root),
+                    self.get_payload_status_tiebreaker(store, child),
+                ),
+            )
+
+    def get_head_root(self, store) -> bytes:
+        return bytes(self.get_head(store).root)
+
+    def validate_on_attestation(self, store, attestation, is_from_block: bool) -> None:
+        """[Modified in Gloas] index encodes payload availability
+        (fork-choice.md:634-672)."""
+        target = attestation.data.target
+        if not is_from_block:
+            self.validate_target_epoch_against_current_time(store, attestation)
+        assert target.epoch == self.compute_epoch_at_slot(attestation.data.slot)
+        assert bytes(target.root) in store.blocks, "unknown target root"
+        assert bytes(attestation.data.beacon_block_root) in store.blocks, "unknown head root"
+        block_slot = int(store.blocks[bytes(attestation.data.beacon_block_root)].slot)
+        assert block_slot <= int(attestation.data.slot), "attestation older than its block"
+        # [New in Gloas:EIP7732]
+        assert int(attestation.data.index) in (0, 1), "index must encode availability"
+        if block_slot == int(attestation.data.slot):
+            assert int(attestation.data.index) == 0, "same-slot attestation index must be 0"
+        assert bytes(target.root) == bytes(
+            self.get_checkpoint_block(store, attestation.data.beacon_block_root, target.epoch)
+        ), "target does not match head chain"
+        assert self.get_current_slot(store) >= int(attestation.data.slot) + 1, (
+            "attestation too new"
+        )
+
+    def on_block(self, store, signed_block) -> None:
+        """[Modified in Gloas] pre-state selection follows the parent's
+        payload status; DA checking moves to the envelope
+        (fork-choice.md:496-563)."""
+        block = signed_block.message
+        assert bytes(block.parent_root) in store.block_states, "unknown parent"
+
+        parent_block = store.blocks[bytes(block.parent_root)]
+        bid = block.body.signed_execution_payload_bid.message
+        parent_bid = parent_block.body.signed_execution_payload_bid.message
+        if self.is_parent_node_full(store, block):
+            assert bytes(block.parent_root) in store.execution_payload_states, (
+                "parent payload state missing"
+            )
+            state = store.execution_payload_states[bytes(block.parent_root)].copy()
+        else:
+            assert bytes(bid.parent_block_hash) == bytes(parent_bid.parent_block_hash), (
+                "empty-parent bid must chain the grandparent hash"
+            )
+            state = store.block_states[bytes(block.parent_root)].copy()
+
+        assert self.get_current_slot(store) >= block.slot, "block from the future"
+        finalized_slot = self.compute_start_slot_at_epoch(store.finalized_checkpoint.epoch)
+        assert block.slot > finalized_slot, "block not after finalized slot"
+        assert bytes(
+            self.get_checkpoint_block(store, block.parent_root, store.finalized_checkpoint.epoch)
+        ) == bytes(store.finalized_checkpoint.root), "block does not descend from finalized root"
+
+        block_root = bytes(hash_tree_root(block))
+        self.state_transition(state, signed_block, True)
+
+        store.blocks[block_root] = block.copy()
+        store.block_states[block_root] = state
+        # [New in Gloas:EIP7732]
+        store.ptc_vote[block_root] = [False] * self.PTC_SIZE
+        self.notify_ptc_messages(store, state, block.body.payload_attestations)
+
+        time_into_slot = (store.time - store.genesis_time) % self.config.SECONDS_PER_SLOT
+        is_before_attesting_interval = (
+            time_into_slot < self.config.SECONDS_PER_SLOT // self.INTERVALS_PER_SLOT
+        )
+        is_timely = self.get_current_slot(store) == block.slot and is_before_attesting_interval
+        store.block_timeliness[block_root] = is_timely
+        if is_timely and bytes(store.proposer_boost_root) == b"\x00" * 32:
+            store.proposer_boost_root = block_root
+
+        self.update_checkpoints(
+            store, state.current_justified_checkpoint, state.finalized_checkpoint
+        )
+        self.compute_pulled_up_tip(store, block_root)
+
+    def on_execution_payload(self, store, signed_envelope) -> None:
+        """Import a builder envelope into the store (fork-choice.md:567-592)."""
+        envelope = signed_envelope.message
+        root = bytes(envelope.beacon_block_root)
+        assert root in store.block_states, "unknown beacon block"
+        # [Modified in Fulu:EIP7594] column-sampled availability
+        assert self.is_data_available(root), "column data not available"
+        state = store.block_states[root].copy()
+        self.process_execution_payload(state, signed_envelope, self.EXECUTION_ENGINE)
+        store.execution_payload_states[root] = state
+
+    def on_payload_attestation_message(
+        self, store, ptc_message, is_from_block: bool = False
+    ) -> None:
+        """(fork-choice.md:595-631)"""
+        data = ptc_message.data
+        state = store.block_states[bytes(data.beacon_block_root)]
+        ptc = self.get_ptc(state, int(data.slot))
+        if int(data.slot) != int(state.slot):
+            return
+        assert int(ptc_message.validator_index) in ptc, "attester not in PTC"
+        if not is_from_block:
+            assert int(data.slot) == self.get_current_slot(store), "PTC message not current"
+            assert self.is_valid_indexed_payload_attestation(
+                state,
+                self.IndexedPayloadAttestation(
+                    attesting_indices=[ptc_message.validator_index],
+                    data=data,
+                    signature=ptc_message.signature,
+                ),
+            ), "invalid PTC message signature"
+        ptc_index = ptc.index(int(ptc_message.validator_index))
+        store.ptc_vote[bytes(data.beacon_block_root)][ptc_index] = bool(data.payload_present)
 
     # == fork upgrade (specs/gloas/fork.md:34-110) =========================
 

@@ -1516,10 +1516,11 @@ class Phase0Spec:
 
     def get_weight(self, store, root) -> int:
         state = store.checkpoint_states[store.justified_checkpoint]
-        epoch = self.get_current_store_epoch(store)
+        # active set at the justified state's own epoch (reference:
+        # specs/phase0/fork-choice.md:283-288 uses get_current_epoch(state))
         unslashed_and_active_indices = [
             i
-            for i in self.get_active_validator_indices(state, epoch)
+            for i in self.get_active_validator_indices(state, self.get_current_epoch(state))
             if not state.validators[i].slashed
         ]
         attestation_score = sum(
@@ -1593,6 +1594,11 @@ class Phase0Spec:
             if len(children) == 0:
                 return head
             head = max(children, key=lambda root: (self.get_weight(store, root), bytes(root)))
+
+    def get_head_root(self, store) -> bytes:
+        """Fork-agnostic head accessor: pre-gloas the head IS the root;
+        gloas overrides to unwrap its (root, payload_status) node."""
+        return bytes(self.get_head(store))
 
     def update_checkpoints(self, store, justified_checkpoint, finalized_checkpoint) -> None:
         if justified_checkpoint.epoch > store.justified_checkpoint.epoch:

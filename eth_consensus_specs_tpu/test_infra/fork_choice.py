@@ -13,6 +13,12 @@ from .context import expect_assertion_error
 def get_genesis_forkchoice_store(spec, genesis_state):
     assert int(genesis_state.slot) == spec.GENESIS_SLOT
     genesis_block = spec.BeaconBlock(state_root=hash_tree_root(genesis_state))
+    if hasattr(genesis_state, "latest_execution_payload_bid"):
+        # [Gloas:EIP7732] the anchor's bid must mirror the state's committed
+        # bid so children correctly read the genesis parent as FULL
+        genesis_block.body.signed_execution_payload_bid.message = (
+            genesis_state.latest_execution_payload_bid.copy()
+        )
     return spec.get_forkchoice_store(genesis_state, genesis_block), hash_tree_root(
         genesis_block
     )

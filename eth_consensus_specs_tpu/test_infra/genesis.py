@@ -103,6 +103,14 @@ def create_genesis_state(spec, validator_balances: list[int], activation_thresho
         )
         state.latest_block_hash = Bytes32(GENESIS_BLOCK_HASH)
         state.execution_payload_availability = [1] * spec.SLOTS_PER_HISTORICAL_ROOT
+        # the genesis header must commit to a body carrying the same bid,
+        # so the anchor block the fork-choice store builds hashes to the
+        # header root children chain from
+        genesis_body = spec.BeaconBlockBody()
+        genesis_body.signed_execution_payload_bid.message = (
+            state.latest_execution_payload_bid.copy()
+        )
+        state.latest_block_header.body_root = hash_tree_root(genesis_body)
     elif is_post_bellatrix(spec):
         # non-empty header: merge complete from genesis in tests
         state.latest_execution_payload_header = genesis_execution_payload_header(spec)

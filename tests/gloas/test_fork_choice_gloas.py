@@ -1,0 +1,169 @@
+"""Gloas payload-status-aware fork choice
+(reference: specs/gloas/fork-choice.md and
+eth2spec/test/gloas/fork_choice/)."""
+
+from eth_consensus_specs_tpu.ssz import hash_tree_root
+from eth_consensus_specs_tpu.test_infra.block import (
+    build_empty_block_for_next_slot,
+    build_signed_execution_payload_envelope,
+    state_transition_and_sign_block,
+)
+from eth_consensus_specs_tpu.test_infra.context import (
+    expect_assertion_error,
+    spec_state_test,
+    with_phases,
+)
+from eth_consensus_specs_tpu.test_infra.fork_choice import (
+    get_genesis_forkchoice_store,
+    tick_and_add_block,
+)
+
+
+def _add_block(spec, store, working_state):
+    block = build_empty_block_for_next_slot(spec, working_state)
+    signed = state_transition_and_sign_block(spec, working_state, block)
+    root = tick_and_add_block(spec, store, signed)
+    return root, signed
+
+
+@with_phases(["gloas"])
+@spec_state_test
+def test_store_tracks_payload_state_maps(spec, state):
+    store, anchor = get_genesis_forkchoice_store(spec, state)
+    assert bytes(anchor) in store.execution_payload_states
+    assert bytes(anchor) in store.ptc_vote
+    working = state.copy()
+    root, _ = _add_block(spec, store, working)
+    assert root in store.ptc_vote
+    assert store.ptc_vote[root] == [False] * spec.PTC_SIZE
+    # no envelope imported yet -> no payload state
+    assert root not in store.execution_payload_states
+
+
+@with_phases(["gloas"])
+@spec_state_test
+def test_head_empty_until_payload_reveal(spec, state):
+    store, _ = get_genesis_forkchoice_store(spec, state)
+    working = state.copy()
+    root, _ = _add_block(spec, store, working)
+    head = spec.get_head(store)
+    assert bytes(head.root) == root
+    assert head.payload_status == spec.PAYLOAD_STATUS_EMPTY
+
+    env = build_signed_execution_payload_envelope(spec, working)
+    spec.on_execution_payload(store, env)
+    assert root in store.execution_payload_states
+    # FULL branch now exists as a child of the PENDING node
+    node = spec.ForkChoiceNode(root=root, payload_status=spec.PAYLOAD_STATUS_PENDING)
+    children = spec.get_node_children(store, spec.get_filtered_block_tree(store), node)
+    statuses = {c.payload_status for c in children}
+    assert statuses == {spec.PAYLOAD_STATUS_EMPTY, spec.PAYLOAD_STATUS_FULL}
+
+
+@with_phases(["gloas"])
+@spec_state_test
+def test_on_execution_payload_unknown_block_invalid(spec, state):
+    store, _ = get_genesis_forkchoice_store(spec, state)
+    working = state.copy()
+    _add_block(spec, store, working)
+    env = build_signed_execution_payload_envelope(spec, working)
+    env.message.beacon_block_root = b"\x13" * 32
+    expect_assertion_error(lambda: spec.on_execution_payload(store, env))
+
+
+@with_phases(["gloas"])
+@spec_state_test
+def test_ptc_votes_make_payload_timely(spec, state):
+    store, _ = get_genesis_forkchoice_store(spec, state)
+    working = state.copy()
+    root, _ = _add_block(spec, store, working)
+    env = build_signed_execution_payload_envelope(spec, working)
+    spec.on_execution_payload(store, env)
+    assert not spec.is_payload_timely(store, root)
+
+    block_state = store.block_states[root]
+    ptc = spec.get_ptc(block_state, int(block_state.slot))
+    data = spec.PayloadAttestationData(
+        beacon_block_root=root,
+        slot=int(block_state.slot),
+        payload_present=True,
+        blob_data_available=True,
+    )
+    for v in dict.fromkeys(ptc):  # unique validators, preserve order
+        msg = spec.PayloadAttestationMessage(validator_index=v, data=data)
+        spec.on_payload_attestation_message(store, msg, is_from_block=True)
+    assert spec.is_payload_timely(store, root)
+
+
+@with_phases(["gloas"])
+@spec_state_test
+def test_ptc_message_from_non_member_invalid(spec, state):
+    store, _ = get_genesis_forkchoice_store(spec, state)
+    working = state.copy()
+    root, _ = _add_block(spec, store, working)
+    block_state = store.block_states[root]
+    ptc = spec.get_ptc(block_state, int(block_state.slot))
+    outsider = next(i for i in range(len(state.validators)) if i not in ptc)
+    data = spec.PayloadAttestationData(
+        beacon_block_root=root,
+        slot=int(block_state.slot),
+        payload_present=True,
+        blob_data_available=True,
+    )
+    msg = spec.PayloadAttestationMessage(validator_index=outsider, data=data)
+    expect_assertion_error(
+        lambda: spec.on_payload_attestation_message(store, msg, is_from_block=True)
+    )
+
+
+@with_phases(["gloas"])
+@spec_state_test
+def test_chain_over_full_parent(spec, state):
+    """Build -> reveal -> build: the second block chains on the FULL branch
+    and the head follows it."""
+    store, _ = get_genesis_forkchoice_store(spec, state)
+    working = state.copy()
+    r1, _ = _add_block(spec, store, working)
+    env = build_signed_execution_payload_envelope(spec, working)
+    spec.on_execution_payload(store, env)
+    spec.process_execution_payload(working, env, spec.EXECUTION_ENGINE)
+
+    r2, blk2 = _add_block(spec, store, working)
+    assert spec.get_parent_payload_status(store, blk2.message) == spec.PAYLOAD_STATUS_FULL
+    head = spec.get_head(store)
+    assert bytes(head.root) == r2
+
+
+@with_phases(["gloas"])
+@spec_state_test
+def test_chain_over_empty_parent(spec, state):
+    """Without a payload reveal the child must chain the grandparent hash
+    (EMPTY branch) and on_block accepts it from the consensus state."""
+    store, _ = get_genesis_forkchoice_store(spec, state)
+    working = state.copy()
+    r1, _ = _add_block(spec, store, working)
+    # no envelope: next block sees parent EMPTY, latest_block_hash unchanged
+    r2, blk2 = _add_block(spec, store, working)
+    assert spec.get_parent_payload_status(store, blk2.message) == spec.PAYLOAD_STATUS_EMPTY
+    head = spec.get_head(store)
+    assert bytes(head.root) == r2
+
+
+@with_phases(["gloas"])
+@spec_state_test
+def test_get_ancestor_carries_payload_status(spec, state):
+    store, _ = get_genesis_forkchoice_store(spec, state)
+    working = state.copy()
+    r1, _ = _add_block(spec, store, working)
+    env = build_signed_execution_payload_envelope(spec, working)
+    spec.on_execution_payload(store, env)
+    spec.process_execution_payload(working, env, spec.EXECUTION_ENGINE)
+    r2, _ = _add_block(spec, store, working)
+
+    node = spec.get_ancestor(store, r2, int(store.blocks[r1].slot))
+    assert bytes(node.root) == r1
+    assert node.payload_status == spec.PAYLOAD_STATUS_FULL
+    # at its own slot: PENDING
+    node = spec.get_ancestor(store, r2, int(store.blocks[r2].slot))
+    assert bytes(node.root) == r2
+    assert node.payload_status == spec.PAYLOAD_STATUS_PENDING
