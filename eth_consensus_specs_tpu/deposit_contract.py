@@ -1,0 +1,133 @@
+"""Executable model of the production deposit contract.
+
+Behavioral parity target: solidity_deposit_contract/deposit_contract.sol —
+the incremental Merkle tree (branch/zero_hashes update :69-79, :101-140),
+`get_deposit_root` with the little-endian count mix-in (:80-96), and the
+DepositEvent data layout checks (pubkey/credential/amount/signature
+lengths, :104-117). The spec-side `deposit-contract.md` constants
+(DEPOSIT_CONTRACT_TREE_DEPTH = 32) apply.
+
+The hot loops (branch insert, root fold) run in the native C layer
+(native/sha256_merkle.c) when a compiler is available, with a pure-Python
+hashlib fallback — the same layering the reference gets from its C-backed
+hashlib. The key cross-check (tested): the contract root equals
+`hash_tree_root(List[DepositData, 2**32](deposits))`, which is how the
+consensus spec consumes `state.eth1_data.deposit_root`.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import hashlib
+
+from eth_consensus_specs_tpu import native
+
+DEPOSIT_CONTRACT_TREE_DEPTH = 32
+MAX_DEPOSIT_COUNT = 2**DEPOSIT_CONTRACT_TREE_DEPTH - 1
+
+
+def _sha(data: bytes) -> bytes:
+    return hashlib.sha256(data).digest()
+
+
+_ZEROHASHES = [b"\x00" * 32]
+for _ in range(DEPOSIT_CONTRACT_TREE_DEPTH - 1):
+    _ZEROHASHES.append(_sha(_ZEROHASHES[-1] + _ZEROHASHES[-1]))
+_ZEROHASHES_FLAT = b"".join(_ZEROHASHES)
+
+
+class DepositContract:
+    """Incremental-Merkle deposit accumulator (deposit_contract.sol:64-141)."""
+
+    def __init__(self):
+        self.branch = [b"\x00" * 32] * DEPOSIT_CONTRACT_TREE_DEPTH
+        self.deposit_count = 0
+
+    # == views =============================================================
+
+    def get_deposit_count(self) -> bytes:
+        """uint64 little-endian, as the contract returns it (:97-99)."""
+        return self.deposit_count.to_bytes(8, "little")
+
+    def get_deposit_root(self) -> bytes:
+        lib = native.get_lib()
+        if lib is not None:
+            out = (ctypes.c_uint8 * 32)()
+            branch = (ctypes.c_uint8 * (32 * DEPOSIT_CONTRACT_TREE_DEPTH)).from_buffer_copy(
+                b"".join(self.branch)
+            )
+            zeros = (ctypes.c_uint8 * len(_ZEROHASHES_FLAT)).from_buffer_copy(
+                _ZEROHASHES_FLAT
+            )
+            lib.deposit_tree_root(
+                branch, zeros, self.deposit_count, DEPOSIT_CONTRACT_TREE_DEPTH, out
+            )
+            return bytes(out)
+        node = b"\x00" * 32
+        size = self.deposit_count
+        for height in range(DEPOSIT_CONTRACT_TREE_DEPTH):
+            if size & 1:
+                node = _sha(self.branch[height] + node)
+            else:
+                node = _sha(node + _ZEROHASHES[height])
+            size >>= 1
+        return _sha(node + self.get_deposit_count() + b"\x00" * 24)
+
+    # == mutation ==========================================================
+
+    def deposit(
+        self,
+        pubkey: bytes,
+        withdrawal_credentials: bytes,
+        amount_gwei: int,
+        signature: bytes,
+    ) -> bytes:
+        """Insert a deposit; returns its leaf (DepositData root). Mirrors
+        the contract's input checks and leaf construction (:101-140)."""
+        assert len(pubkey) == 48, "invalid pubkey length"
+        assert len(withdrawal_credentials) == 32, "invalid credentials length"
+        assert len(signature) == 96, "invalid signature length"
+        assert amount_gwei >= 1_000_000_000, "deposit value too low"
+        assert self.deposit_count < MAX_DEPOSIT_COUNT, "merkle tree full"
+
+        amount = int(amount_gwei).to_bytes(8, "little")
+        pubkey_root = _sha(pubkey + b"\x00" * 16)
+        signature_root = _sha(
+            _sha(signature[:64]) + _sha(signature[64:] + b"\x00" * 32)
+        )
+        node = _sha(
+            _sha(pubkey_root + withdrawal_credentials)
+            + _sha(amount + b"\x00" * 24 + signature_root)
+        )
+        self._insert(node)
+        return node
+
+    def insert_leaf(self, leaf: bytes) -> None:
+        """Insert a precomputed DepositData root (test/vector ingestion)."""
+        assert len(leaf) == 32
+        assert self.deposit_count < MAX_DEPOSIT_COUNT, "merkle tree full"
+        self._insert(bytes(leaf))
+
+    def _insert(self, node: bytes) -> None:
+        lib = native.get_lib()
+        if lib is not None:
+            branch = bytearray(b"".join(self.branch))
+            buf = (ctypes.c_uint8 * len(branch)).from_buffer(branch)
+            leaf = (ctypes.c_uint8 * 32).from_buffer_copy(node)
+            lib.deposit_tree_insert(
+                buf, self.deposit_count, leaf, DEPOSIT_CONTRACT_TREE_DEPTH
+            )
+            self.branch = [
+                bytes(branch[32 * i : 32 * (i + 1)])
+                for i in range(DEPOSIT_CONTRACT_TREE_DEPTH)
+            ]
+            self.deposit_count += 1
+            return
+        size = self.deposit_count + 1
+        for height in range(DEPOSIT_CONTRACT_TREE_DEPTH):
+            if size & 1:
+                self.branch[height] = node
+                break
+            node = _sha(self.branch[height] + node)
+            size >>= 1
+        self.deposit_count += 1

@@ -1132,10 +1132,6 @@ class GloasSpec(FuluSpec):
     def get_weight(self, store, node) -> int:
         """[Modified in Gloas] weight of a (root, payload_status) node
         (fork-choice.md:338-380)."""
-        if not isinstance(node, self.ForkChoiceNode):
-            node = self.ForkChoiceNode(
-                root=bytes(node), payload_status=self.PAYLOAD_STATUS_PENDING
-            )
         if (
             node.payload_status == self.PAYLOAD_STATUS_PENDING
             or int(store.blocks[bytes(node.root)].slot) + 1 != self.get_current_slot(store)
@@ -1277,11 +1273,9 @@ class GloasSpec(FuluSpec):
         store.ptc_vote[block_root] = [False] * self.PTC_SIZE
         self.notify_ptc_messages(store, state, block.body.payload_attestations)
 
-        time_into_slot = (store.time - store.genesis_time) % self.config.SECONDS_PER_SLOT
-        is_before_attesting_interval = (
-            time_into_slot < self.config.SECONDS_PER_SLOT // self.INTERVALS_PER_SLOT
-        )
-        is_timely = self.get_current_slot(store) == block.slot and is_before_attesting_interval
+        is_timely = self.get_current_slot(
+            store
+        ) == block.slot and self.is_before_attesting_interval(store)
         store.block_timeliness[block_root] = is_timely
         if is_timely and bytes(store.proposer_boost_root) == b"\x00" * 32:
             store.proposer_boost_root = block_root

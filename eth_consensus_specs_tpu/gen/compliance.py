@@ -1,0 +1,193 @@
+"""Model-based fork-choice compliance generation.
+
+Reference analogue: tests/generators/compliance_runners/fork_choice/ —
+there, MiniZinc constraint models enumerate block-tree shapes and
+justification links, instantiators realize them into on_tick/on_block/
+on_attestation event sequences, and mutation operators reorder/duplicate
+events. MiniZinc is an external C++ solver; here the (small) constraint
+space is enumerated directly in Python — same artifact, no solver
+dependency:
+
+  1. `enumerate_block_trees` — all parent-vector trees of n blocks under
+     branching constraints (the "block tree shape" model, block_tree.mzn).
+  2. `instantiate_scenario` — realize a tree into slots/blocks/attestation
+     events against a real genesis state.
+  3. mutations — event reordering (parent-after-child redelivery) and
+     attestation duplication (mutation_operators.py analogue).
+  4. `run_scenario` — replay events into a fresh Store, asserting the
+     universal invariants (head known, head descends from justified root,
+     store time monotone).
+
+The produced step sequences follow the fork-choice vector format
+(tests/formats/fork_choice/README.md:28-80): a list of dicts with `tick`/
+`block`/`attestation`/`checks` entries, consumable by the dumper."""
+
+from __future__ import annotations
+
+import random
+from typing import Iterator
+
+from eth_consensus_specs_tpu.ssz import hash_tree_root
+
+
+def enumerate_block_trees(n_blocks: int, max_children: int = 2) -> Iterator[tuple[int, ...]]:
+    """All rooted trees over n_blocks nodes as parent vectors
+    (parents[i] < i; node 0 is the anchor/genesis). The branching bound
+    mirrors the compliance model's shape constraints."""
+    assert n_blocks >= 1
+
+    def rec(parents: list[int]) -> Iterator[tuple[int, ...]]:
+        i = len(parents)
+        if i == n_blocks:
+            yield tuple(parents)
+            return
+        for p in range(i):
+            # parents[0] is node 0's placeholder, not a child edge
+            if parents[1:].count(p) < max_children:
+                yield from rec(parents + [p])
+
+    # node 0 has no parent; start enumeration at node 1
+    if n_blocks == 1:
+        yield (0,)
+        return
+    for tree in rec([0]):
+        yield tree
+
+
+def instantiate_scenario(spec, genesis_state, tree: tuple[int, ...], *, attest: bool = True,
+                         rng: random.Random | None = None) -> list[dict]:
+    """Realize a parent-vector tree into an ordered event sequence.
+
+    Returns fork-choice steps: [{"tick": t}, {"block": signed_block},
+    {"attestation": att}, ..., {"checks": {...}}]."""
+    from eth_consensus_specs_tpu.test_infra.attestations import get_valid_attestation
+    from eth_consensus_specs_tpu.test_infra.block import (
+        build_empty_block,
+        state_transition_and_sign_block,
+    )
+
+    rng = rng or random.Random(1)
+    n = len(tree)
+    # assign slots: each child lands 1 slot after its parent, with sibling
+    # separation so proposers differ (branch i gets +index skew)
+    states = {0: genesis_state.copy()}
+    slots = {0: int(genesis_state.slot)}
+    steps: list[dict] = []
+    blocks = {}
+    sibling_rank: dict[int, int] = {}
+    for i in range(1, n):
+        parent = tree[i]
+        rank = sibling_rank.get(parent, 0)
+        sibling_rank[parent] = rank + 1
+        slot = slots[parent] + 1 + rank  # siblings at distinct slots
+        parent_state = states[parent].copy()
+        block = build_empty_block(spec, parent_state, slot=slot)
+        if attest and rng.random() < 0.5 and slot >= 2:
+            probe = parent_state.copy()
+            att_slot = slot - 1
+            if att_slot > int(probe.slot):
+                spec.process_slots(probe, att_slot)
+            try:
+                att = get_valid_attestation(spec, probe, slot=att_slot)
+                block.body.attestations.append(att)
+            except (AssertionError, IndexError, ValueError):
+                pass
+        signed = state_transition_and_sign_block(spec, parent_state, block)
+        states[i] = parent_state
+        slots[i] = slot
+        blocks[i] = signed
+        steps.append({"tick": slot})
+        steps.append({"block": signed})
+    steps.append({"checks": {"head_known": True, "descends_from_justified": True}})
+    return steps
+
+
+def mutate_reorder_parent_after_child(steps: list[dict], rng: random.Random) -> list[dict]:
+    """Deliver one block before its parent, then redeliver in order — the
+    'unknown parent' delay path (mutation_operators.py analogue). The
+    moved block must be a LEAF (moving an inner block would orphan its
+    children) with a non-genesis parent (so the early delivery genuinely
+    fails); trees with no such block return unmutated."""
+    block_idx = [i for i, s in enumerate(steps) if "block" in s]
+    if len(block_idx) < 2:
+        return list(steps)
+    roots = {i: bytes(hash_tree_root(steps[i]["block"].message)) for i in block_idx}
+    parents = {i: bytes(steps[i]["block"].message.parent_root) for i in block_idx}
+    genesis_root = parents[block_idx[0]]
+    candidates = [
+        i
+        for i in block_idx
+        if parents[i] != genesis_root and roots[i] not in parents.values()
+    ]
+    if not candidates:
+        return list(steps)
+    j = rng.choice(candidates)
+    out = []
+    early = dict(steps[j])
+    early["expect_invalid"] = True
+    inserted = False
+    for i, s in enumerate(steps):
+        if i == j:
+            continue
+        if not inserted and "block" in s:
+            out.append(early)
+            inserted = True
+        out.append(s)
+    out.insert(len(out) - 1, {k: v for k, v in steps[j].items()})
+    return out
+
+
+def mutate_duplicate_attestations(steps: list[dict], rng: random.Random) -> list[dict]:
+    """Duplicate on_attestation deliveries (idempotence probe)."""
+    out = []
+    for s in steps:
+        out.append(s)
+        if "attestation" in s:
+            out.append(dict(s))
+    return out
+
+
+MUTATIONS = (mutate_reorder_parent_after_child, mutate_duplicate_attestations)
+
+
+def run_scenario(spec, genesis_state, steps: list[dict]) -> dict:
+    """Replay a step sequence into a fresh store, asserting the universal
+    invariants. Returns {'head': root, 'applied': n, 'rejected': n}."""
+    from eth_consensus_specs_tpu.test_infra.fork_choice import (
+        get_genesis_forkchoice_store,
+    )
+
+    store, _anchor = get_genesis_forkchoice_store(spec, genesis_state)
+    applied = rejected = 0
+    for step in steps:
+        if "tick" in step:
+            time = store.genesis_time + int(step["tick"]) * spec.config.SECONDS_PER_SLOT
+            if time > store.time:
+                spec.on_tick(store, time)
+        elif "block" in step:
+            try:
+                spec.on_block(store, step["block"])
+                applied += 1
+            except (AssertionError, KeyError, IndexError):
+                rejected += 1
+                assert step.get("expect_invalid"), "valid block rejected"
+        elif "attestation" in step:
+            try:
+                spec.on_attestation(store, step["attestation"])
+            except AssertionError:
+                if not step.get("expect_invalid"):
+                    raise
+        elif "checks" in step:
+            head = spec.get_head_root(store)
+            if step["checks"].get("head_known"):
+                assert head in store.blocks
+            if step["checks"].get("descends_from_justified"):
+                justified_root = bytes(store.justified_checkpoint.root)
+                root = head
+                while root != justified_root:
+                    block = store.blocks[root]
+                    parent = bytes(block.parent_root)
+                    if parent == root or parent not in store.blocks:
+                        raise AssertionError("head does not descend from justified root")
+                    root = parent
+    return {"head": spec.get_head_root(store), "applied": applied, "rejected": rejected}

@@ -1,0 +1,82 @@
+"""ctypes loader for the native runtime (sha256_merkle.c).
+
+Compiles the shared object on first use with the system C compiler into
+the package directory (a one-time ~1s cost), mirroring how the reference
+leans on prebuilt C cores (hashlib/milagro) behind Python bindings. Set
+``ETH_SPECS_TPU_NO_NATIVE=1`` to force the pure-Python fallbacks; all
+callers degrade gracefully when no compiler is available."""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import sysconfig
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_DIR, "sha256_merkle.c")
+_LIB = os.path.join(_DIR, "_sha256_merkle.so")
+
+_lib: ctypes.CDLL | None = None
+_tried = False
+
+
+def _compile() -> bool:
+    cc = os.environ.get("CC") or sysconfig.get_config_var("CC") or "cc"
+    cmd = cc.split() + ["-O2", "-fPIC", "-shared", "-o", _LIB, _SRC]
+    try:
+        subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+        return True
+    except (OSError, subprocess.SubprocessError):
+        return False
+
+
+def get_lib() -> ctypes.CDLL | None:
+    """The loaded native library, or None when unavailable/disabled."""
+    global _lib, _tried
+    if _lib is not None or _tried:
+        return _lib
+    _tried = True
+    if os.environ.get("ETH_SPECS_TPU_NO_NATIVE"):
+        return None
+    if not os.path.exists(_LIB) or os.path.getmtime(_LIB) < os.path.getmtime(_SRC):
+        if not _compile():
+            return None
+    try:
+        lib = ctypes.CDLL(_LIB)
+    except OSError:
+        return None
+    u8p = ctypes.POINTER(ctypes.c_uint8)
+    lib.sha256_pair.argtypes = [u8p, u8p]
+    lib.sha256_pairs.argtypes = [u8p, u8p, ctypes.c_uint64]
+    lib.merkle_level.argtypes = [u8p, u8p, ctypes.c_uint64]
+    lib.deposit_tree_insert.argtypes = [u8p, ctypes.c_uint64, u8p, ctypes.c_uint32]
+    lib.deposit_tree_root.argtypes = [u8p, u8p, ctypes.c_uint64, ctypes.c_uint32, u8p]
+    _lib = lib
+    return _lib
+
+
+def available() -> bool:
+    return get_lib() is not None
+
+
+def _buf(data: bytes):
+    return (ctypes.c_uint8 * len(data)).from_buffer_copy(data)
+
+
+def sha256_pair(data64: bytes) -> bytes:
+    lib = get_lib()
+    assert lib is not None and len(data64) == 64
+    out = (ctypes.c_uint8 * 32)()
+    lib.sha256_pair(_buf(data64), out)
+    return bytes(out)
+
+
+def sha256_pairs(data: bytes) -> bytes:
+    """Concatenated 64-byte messages -> concatenated 32-byte digests."""
+    lib = get_lib()
+    assert lib is not None and len(data) % 64 == 0
+    n = len(data) // 64
+    out = (ctypes.c_uint8 * (32 * n))()
+    lib.sha256_pairs(_buf(data), out, n)
+    return bytes(out)

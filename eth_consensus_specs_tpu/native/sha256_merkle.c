@@ -1,0 +1,136 @@
+/* Native runtime: SHA-256 compression + incremental-Merkle deposit tree.
+ *
+ * The reference's one production artifact is the Solidity incremental
+ * Merkle deposit contract (solidity_deposit_contract/deposit_contract.sol);
+ * its native-crypto runtime (milagro/hashlib C cores) sits behind Python
+ * bindings. This file is the equivalent native layer here: a standalone
+ * SHA-256 with batch pair hashing (host-side merkleization fallback) and
+ * the branch/zero-hash incremental insert + root algorithms
+ * (deposit_contract.sol:69-96), loaded through ctypes (no pybind11).
+ */
+
+#include <stdint.h>
+#include <string.h>
+
+#define ROTR(x, n) (((x) >> (n)) | ((x) << (32 - (n))))
+
+static const uint32_t K[64] = {
+    0x428a2f98, 0x71374491, 0xb5c0fbcf, 0xe9b5dba5, 0x3956c25b, 0x59f111f1,
+    0x923f82a4, 0xab1c5ed5, 0xd807aa98, 0x12835b01, 0x243185be, 0x550c7dc3,
+    0x72be5d74, 0x80deb1fe, 0x9bdc06a7, 0xc19bf174, 0xe49b69c1, 0xefbe4786,
+    0x0fc19dc6, 0x240ca1cc, 0x2de92c6f, 0x4a7484aa, 0x5cb0a9dc, 0x76f988da,
+    0x983e5152, 0xa831c66d, 0xb00327c8, 0xbf597fc7, 0xc6e00bf3, 0xd5a79147,
+    0x06ca6351, 0x14292967, 0x27b70a85, 0x2e1b2138, 0x4d2c6dfc, 0x53380d13,
+    0x650a7354, 0x766a0abb, 0x81c2c92e, 0x92722c85, 0xa2bfe8a1, 0xa81a664b,
+    0xc24b8b70, 0xc76c51a3, 0xd192e819, 0xd6990624, 0xf40e3585, 0x106aa070,
+    0x19a4c116, 0x1e376c08, 0x2748774c, 0x34b0bcb5, 0x391c0cb3, 0x4ed8aa4a,
+    0x5b9cca4f, 0x682e6ff3, 0x748f82ee, 0x78a5636f, 0x84c87814, 0x8cc70208,
+    0x90befffa, 0xa4506ceb, 0xbef9a3f7, 0xc67178f2};
+
+static const uint32_t H0[8] = {0x6a09e667, 0xbb67ae85, 0x3c6ef372, 0xa54ff53a,
+                               0x510e527f, 0x9b05688c, 0x1f83d9ab, 0x5be0cd19};
+
+static void compress(uint32_t state[8], const uint8_t block[64]) {
+    uint32_t w[64];
+    for (int i = 0; i < 16; i++)
+        w[i] = ((uint32_t)block[4 * i] << 24) | ((uint32_t)block[4 * i + 1] << 16) |
+               ((uint32_t)block[4 * i + 2] << 8) | (uint32_t)block[4 * i + 3];
+    for (int i = 16; i < 64; i++) {
+        uint32_t s0 = ROTR(w[i - 15], 7) ^ ROTR(w[i - 15], 18) ^ (w[i - 15] >> 3);
+        uint32_t s1 = ROTR(w[i - 2], 17) ^ ROTR(w[i - 2], 19) ^ (w[i - 2] >> 10);
+        w[i] = w[i - 16] + s0 + w[i - 7] + s1;
+    }
+    uint32_t a = state[0], b = state[1], c = state[2], d = state[3];
+    uint32_t e = state[4], f = state[5], g = state[6], h = state[7];
+    for (int i = 0; i < 64; i++) {
+        uint32_t S1 = ROTR(e, 6) ^ ROTR(e, 11) ^ ROTR(e, 25);
+        uint32_t ch = (e & f) ^ (~e & g);
+        uint32_t t1 = h + S1 + ch + K[i] + w[i];
+        uint32_t S0 = ROTR(a, 2) ^ ROTR(a, 13) ^ ROTR(a, 22);
+        uint32_t maj = (a & b) ^ (a & c) ^ (b & c);
+        uint32_t t2 = S0 + maj;
+        h = g; g = f; f = e; e = d + t1;
+        d = c; c = b; b = a; a = t1 + t2;
+    }
+    state[0] += a; state[1] += b; state[2] += c; state[3] += d;
+    state[4] += e; state[5] += f; state[6] += g; state[7] += h;
+}
+
+/* SHA-256 of exactly 64 bytes (one Merkle pair): the padding block is
+ * constant, so hash = compress(compress(H0, msg), PAD64). */
+void sha256_pair(const uint8_t *in64, uint8_t *out32) {
+    uint32_t st[8];
+    memcpy(st, H0, sizeof st);
+    compress(st, in64);
+    uint8_t pad[64] = {0};
+    pad[0] = 0x80;
+    pad[62] = 0x02; /* bit length 512 = 0x0200, big-endian in last 8 bytes */
+    compress(st, pad);
+    for (int i = 0; i < 8; i++) {
+        out32[4 * i] = (uint8_t)(st[i] >> 24);
+        out32[4 * i + 1] = (uint8_t)(st[i] >> 16);
+        out32[4 * i + 2] = (uint8_t)(st[i] >> 8);
+        out32[4 * i + 3] = (uint8_t)st[i];
+    }
+}
+
+/* n independent 64-byte messages -> n 32-byte digests. */
+void sha256_pairs(const uint8_t *in, uint8_t *out, uint64_t n) {
+    for (uint64_t i = 0; i < n; i++)
+        sha256_pair(in + 64 * i, out + 32 * i);
+}
+
+/* One level of a Merkle tree: 2n chunks in, n parents out (in-place safe
+ * when out == in). */
+void merkle_level(const uint8_t *chunks, uint8_t *out, uint64_t n_pairs) {
+    for (uint64_t i = 0; i < n_pairs; i++)
+        sha256_pair(chunks + 64 * i, out + 32 * i);
+}
+
+/* Incremental deposit-tree insert (deposit_contract.sol:101-140): update
+ * `branch` (depth x 32 bytes) in place for leaf number `index` (0-based
+ * BEFORE increment, i.e. deposit_count prior to this deposit). */
+void deposit_tree_insert(uint8_t *branch, uint64_t index, const uint8_t *leaf,
+                         uint32_t depth) {
+    uint8_t node[32];
+    uint8_t buf[64];
+    memcpy(node, leaf, 32);
+    uint64_t size = index + 1;
+    for (uint32_t h = 0; h < depth; h++) {
+        if (size & 1) {
+            memcpy(branch + 32 * h, node, 32);
+            return;
+        }
+        memcpy(buf, branch + 32 * h, 32);
+        memcpy(buf + 32, node, 32);
+        sha256_pair(buf, node);
+        size >>= 1;
+    }
+}
+
+/* Deposit root with length mix-in (deposit_contract.sol:80-96). The
+ * zero-hash table (zh[h] = H(zh[h-1] || zh[h-1]), zh[0] = 0) is passed in
+ * so callers control it. */
+void deposit_tree_root(const uint8_t *branch, const uint8_t *zerohashes,
+                       uint64_t deposit_count, uint32_t depth, uint8_t *out32) {
+    uint8_t node[32] = {0};
+    uint8_t buf[64];
+    uint64_t size = deposit_count;
+    for (uint32_t h = 0; h < depth; h++) {
+        if (size & 1) {
+            memcpy(buf, branch + 32 * h, 32);
+            memcpy(buf + 32, node, 32);
+        } else {
+            memcpy(buf, node, 32);
+            memcpy(buf + 32, zerohashes + 32 * h, 32);
+        }
+        sha256_pair(buf, node);
+        size >>= 1;
+    }
+    /* mix in the count: H(root || uint64-LE count padded to 32 bytes) */
+    memcpy(buf, node, 32);
+    memset(buf + 32, 0, 32);
+    for (int i = 0; i < 8; i++)
+        buf[32 + i] = (uint8_t)(deposit_count >> (8 * i));
+    sha256_pair(buf, out32);
+}

@@ -1,0 +1,127 @@
+"""Deposit contract model + native runtime parity
+(reference: solidity_deposit_contract/deposit_contract.sol and its
+foundry tests; spec constants from specs/phase0/deposit-contract.md)."""
+
+import hashlib
+import os
+import random
+
+import pytest
+
+from eth_consensus_specs_tpu import native
+from eth_consensus_specs_tpu.deposit_contract import (
+    DEPOSIT_CONTRACT_TREE_DEPTH,
+    DepositContract,
+)
+from eth_consensus_specs_tpu.forks import get_spec
+from eth_consensus_specs_tpu.ssz import List, hash_tree_root
+from eth_consensus_specs_tpu.test_infra.deposits import build_deposit_data
+from eth_consensus_specs_tpu.test_infra.genesis import bls_withdrawal_credentials
+from eth_consensus_specs_tpu.test_infra.keys import privkeys, pubkeys
+
+
+def _contract_and_ssz_roots(spec, n):
+    contract = DepositContract()
+    data_list = []
+    for i in range(n):
+        data = build_deposit_data(
+            spec,
+            pubkeys[i],
+            privkeys[i],
+            spec.MAX_EFFECTIVE_BALANCE,
+            bls_withdrawal_credentials(spec, i),
+            signed=True,
+        )
+        data_list.append(data)
+        contract.deposit(
+            bytes(data.pubkey),
+            bytes(data.withdrawal_credentials),
+            int(data.amount),
+            bytes(data.signature),
+        )
+    DepositDataList = List[spec.DepositData, 2**DEPOSIT_CONTRACT_TREE_DEPTH]
+    return contract, bytes(hash_tree_root(DepositDataList(data_list)))
+
+
+def test_contract_root_matches_ssz_list_root():
+    """The invariant the consensus layer relies on: the contract's root
+    equals hash_tree_root(List[DepositData, 2**32]) of the same deposits."""
+    spec = get_spec("phase0", "minimal")
+    for n in (1, 2, 3, 7, 8):
+        contract, ssz_root = _contract_and_ssz_roots(spec, n)
+        assert contract.get_deposit_root() == ssz_root, n
+        assert contract.get_deposit_count() == n.to_bytes(8, "little")
+
+
+def test_empty_contract_root():
+    spec = get_spec("phase0", "minimal")
+    contract = DepositContract()
+    DepositDataList = List[spec.DepositData, 2**DEPOSIT_CONTRACT_TREE_DEPTH]
+    assert contract.get_deposit_root() == bytes(hash_tree_root(DepositDataList([])))
+
+
+def test_deposit_leaf_is_deposit_data_root():
+    spec = get_spec("phase0", "minimal")
+    data = build_deposit_data(
+        spec, pubkeys[0], privkeys[0], spec.MAX_EFFECTIVE_BALANCE,
+        bls_withdrawal_credentials(spec, 0), signed=True,
+    )
+    contract = DepositContract()
+    leaf = contract.deposit(
+        bytes(data.pubkey), bytes(data.withdrawal_credentials),
+        int(data.amount), bytes(data.signature),
+    )
+    assert leaf == bytes(hash_tree_root(data))
+
+
+def test_deposit_input_validation():
+    contract = DepositContract()
+    with pytest.raises(AssertionError):
+        contract.deposit(b"\x00" * 47, b"\x00" * 32, 10**9, b"\x00" * 96)
+    with pytest.raises(AssertionError):
+        contract.deposit(b"\x00" * 48, b"\x00" * 31, 10**9, b"\x00" * 96)
+    with pytest.raises(AssertionError):
+        contract.deposit(b"\x00" * 48, b"\x00" * 32, 10**9, b"\x00" * 95)
+    with pytest.raises(AssertionError):
+        contract.deposit(b"\x00" * 48, b"\x00" * 32, 10**9 - 1, b"\x00" * 96)
+
+
+def test_native_and_python_paths_agree():
+    if not native.available():
+        pytest.skip("no C compiler available")
+    rng = random.Random(5)
+    leaves = [bytes(rng.randrange(256) for _ in range(32)) for _ in range(9)]
+
+    os.environ["ETH_SPECS_TPU_NO_NATIVE"] = "1"
+    try:
+        py_contract = DepositContract.__new__(DepositContract)
+        py_contract.__init__()
+        # force the python path regardless of the cached lib
+        import eth_consensus_specs_tpu.native as nat
+
+        saved = nat._lib
+        nat._lib = None
+        nat._tried = True
+        for leaf in leaves:
+            py_contract.insert_leaf(leaf)
+        py_root = py_contract.get_deposit_root()
+    finally:
+        nat._lib = saved
+        nat._tried = True
+        del os.environ["ETH_SPECS_TPU_NO_NATIVE"]
+
+    c_contract = DepositContract()
+    for leaf in leaves:
+        c_contract.insert_leaf(leaf)
+    assert c_contract.get_deposit_root() == py_root
+
+
+def test_native_sha256_matches_hashlib():
+    if not native.available():
+        pytest.skip("no C compiler available")
+    rng = random.Random(6)
+    msgs = [bytes(rng.randrange(256) for _ in range(64)) for _ in range(32)]
+    flat = b"".join(msgs)
+    digests = native.sha256_pairs(flat)
+    for i, msg in enumerate(msgs):
+        assert digests[32 * i : 32 * (i + 1)] == hashlib.sha256(msg).digest()

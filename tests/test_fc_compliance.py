@@ -1,0 +1,69 @@
+"""Model-based fork-choice compliance scenarios
+(reference: tests/generators/compliance_runners/fork_choice/)."""
+
+import random
+
+from eth_consensus_specs_tpu.gen.compliance import (
+    MUTATIONS,
+    enumerate_block_trees,
+    instantiate_scenario,
+    mutate_reorder_parent_after_child,
+    run_scenario,
+)
+from eth_consensus_specs_tpu.test_infra.context import spec_state_test, with_phases
+
+
+def test_enumerate_block_trees_counts():
+    # n=1: just the root; n=2: one tree; n=3: chain + fork = 2
+    assert list(enumerate_block_trees(1)) == [(0,)]
+    assert list(enumerate_block_trees(2)) == [(0, 0)]
+    assert sorted(enumerate_block_trees(3)) == [(0, 0, 0), (0, 0, 1)]
+    # n=4 with branching cap 2: parents[i] < i with count(p) <= 2
+    trees = list(enumerate_block_trees(4))
+    assert len(trees) == len(set(trees))
+    for tree in trees:
+        assert all(tree[i] < i for i in range(1, 4))
+        # tree[0] is node 0's placeholder, not a child edge
+        assert all(tree[1:].count(p) <= 2 for p in range(4))
+
+
+@with_phases(["phase0", "altair", "electra"])
+@spec_state_test
+def test_all_four_block_trees_replay(spec, state):
+    """Every 4-node tree shape instantiates and replays cleanly with the
+    universal invariants holding."""
+    rng = random.Random(7)
+    for tree in enumerate_block_trees(4):
+        steps = instantiate_scenario(spec, state, tree, rng=rng)
+        result = run_scenario(spec, state, steps)
+        assert result["applied"] == len(tree) - 1
+        assert result["rejected"] == 0
+
+
+@with_phases(["phase0", "electra"])
+@spec_state_test
+def test_mutated_scenarios_replay(spec, state):
+    """Mutations (parent-after-child reordering, duplicated attestations)
+    keep the store consistent: the early orphan is rejected, the ordered
+    redelivery lands, and the final head invariants hold."""
+    rng = random.Random(11)
+    for tree in [(0, 0, 1), (0, 0, 0), (0, 0, 1, 2)]:
+        base = instantiate_scenario(spec, state, tree, rng=rng)
+        for mutate in MUTATIONS:
+            steps = mutate(base, rng)
+            result = run_scenario(spec, state, steps)
+            assert result["applied"] == len(tree) - 1
+
+
+@with_phases(["phase0"])
+@spec_state_test
+def test_forked_tree_head_is_leaf(spec, state):
+    rng = random.Random(3)
+    steps = instantiate_scenario(spec, state, (0, 0, 0), attest=False, rng=rng)
+    result = run_scenario(spec, state, steps)
+    # two siblings: head must be one of them (max root tiebreak), not genesis
+    import eth_consensus_specs_tpu.ssz as ssz
+
+    blocks = [s["block"].message for s in steps if "block" in s]
+    leaf_roots = {bytes(ssz.hash_tree_root(b)) for b in blocks}
+    assert result["head"] in leaf_roots
