@@ -21,6 +21,7 @@ import ctypes
 import hashlib
 
 from eth_consensus_specs_tpu import native
+from eth_consensus_specs_tpu.ssz.merkle import zerohashes
 
 DEPOSIT_CONTRACT_TREE_DEPTH = 32
 MAX_DEPOSIT_COUNT = 2**DEPOSIT_CONTRACT_TREE_DEPTH - 1
@@ -30,9 +31,7 @@ def _sha(data: bytes) -> bytes:
     return hashlib.sha256(data).digest()
 
 
-_ZEROHASHES = [b"\x00" * 32]
-for _ in range(DEPOSIT_CONTRACT_TREE_DEPTH - 1):
-    _ZEROHASHES.append(_sha(_ZEROHASHES[-1] + _ZEROHASHES[-1]))
+_ZEROHASHES = [bytes(h) for h in zerohashes[:DEPOSIT_CONTRACT_TREE_DEPTH]]
 _ZEROHASHES_FLAT = b"".join(_ZEROHASHES)
 
 

@@ -14,16 +14,24 @@ from functools import lru_cache
 from eth_consensus_specs_tpu.config import load_config, load_preset
 
 FEATURE_BASE_FORK = {
+    "eip6800": "deneb",
     "eip6914": "capella",
     "eip7441": "capella",
     "eip7805": "fulu",
     "eip7928": "fulu",
+    "eip8025": "fulu",
 }
-# (eip6800 Verkle and eip8025 zkEVM remain unimplemented: both hinge on
-# external proof systems with unstable upstream specs)
 
 
 def _feature_class(name: str):
+    if name == "eip6800":
+        from .eip6800 import EIP6800Spec
+
+        return EIP6800Spec
+    if name == "eip8025":
+        from .eip8025 import EIP8025Spec
+
+        return EIP8025Spec
     if name == "eip6914":
         from .eip6914 import EIP6914Spec
 

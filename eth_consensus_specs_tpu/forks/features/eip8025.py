@@ -1,0 +1,171 @@
+"""EIP-8025: stateless validation with zkEVM execution proofs.
+
+Behavioral parity targets:
+  * beacon chain: specs/_features/eip8025/beacon-chain.md — proof
+    containers (:67-82), verify_execution_proof(s) (:93-147), and the
+    stateless_validation branch of process_execution_payload (:151-216)
+  * proof system: specs/_features/eip8025/zkevm.md — the MOCK proof
+    system the reference itself specifies (proof_data is a hash of the
+    public inputs; verification checks sizes + input binding), kept
+    byte-identical here. Built on fulu.
+"""
+
+from eth_consensus_specs_tpu.forks.fulu import FuluSpec
+from eth_consensus_specs_tpu.forks.phase0 import BLSSignature, Root, ValidatorIndex
+from eth_consensus_specs_tpu.ssz import ByteList, Container, hash_tree_root, uint8
+from eth_consensus_specs_tpu.utils import bls
+
+from .eip6800 import Hash32
+
+
+class EIP8025Spec(FuluSpec):
+    fork_name = "eip8025"
+
+    # constants (beacon-chain.md:42-56, zkevm.md:44-50)
+    MAX_EXECUTION_PROOFS_PER_PAYLOAD = 4
+    DOMAIN_EXECUTION_PROOF = b"\x0b\x00\x00\x00"
+    MAX_PROOF_SIZE = 307200
+    MAX_PROVING_KEY_SIZE = 2**28
+    MAX_VERIFICATION_KEY_SIZE = 2**20
+    MAX_WITNESS_SIZE = 314572800
+
+    @property
+    def PROGRAM(self) -> bytes:
+        return b"DEFAULT__PROGRAM"
+
+    # configuration (beacon-chain.md:58-62)
+    MIN_REQUIRED_EXECUTION_PROOFS = 1
+
+    def _build_types(self) -> None:
+        super()._build_types()
+        P = self
+
+        ProgramBytecode = ByteList[16]
+        ProofID = uint8
+        self.ProgramBytecode = ProgramBytecode
+        self.ProofID = ProofID
+
+        class PublicInput(Container):
+            block_hash: Hash32
+            parent_hash: Hash32
+
+        class ZKEVMProof(Container):
+            proof_data: ByteList[P.MAX_PROOF_SIZE]
+            proof_type: ProofID
+            public_inputs: PublicInput
+
+        class ExecutionProof(Container):
+            beacon_root: Root
+            zk_proof: ZKEVMProof
+            validator_index: ValidatorIndex
+
+        class SignedExecutionProof(Container):
+            message: ExecutionProof
+            signature: BLSSignature
+
+        for name, typ in list(locals().items()):
+            if isinstance(typ, type) and issubclass(typ, Container):
+                typ.__name__ = name
+                setattr(self, name, typ)
+
+    # == zkEVM mock proof system (zkevm.md) ================================
+
+    def generate_verification_key(self, program_bytecode: bytes, proof_id: int) -> bytes:
+        return bytes(program_bytecode) + int(proof_id).to_bytes(1, "little")
+
+    def generate_proving_key(self, program_bytecode: bytes, proof_id: int) -> bytes:
+        return bytes(program_bytecode) + int(proof_id).to_bytes(1, "little")
+
+    def generate_keys(self, program_bytecode: bytes, proof_id: int):
+        return (
+            self.generate_proving_key(program_bytecode, proof_id),
+            self.generate_verification_key(program_bytecode, proof_id),
+        )
+
+    def verify_execution_proof_impl(self, proof, verification_key: bytes) -> bool:
+        if len(proof.proof_data) > self.MAX_PROOF_SIZE:
+            return False
+        return True
+
+    def generate_zkevm_proof(self, block_hash: bytes, parent_hash: bytes, proof_id: int):
+        """generate_execution_proof_impl folded into the public entry
+        (zkevm.md:150-170): proof_data = H(block || parent || id)."""
+        public_inputs = self.PublicInput(block_hash=block_hash, parent_hash=parent_hash)
+        proof_data = self.hash(
+            bytes(block_hash) + bytes(parent_hash) + int(proof_id).to_bytes(1, "little")
+        )
+        return self.ZKEVMProof(
+            proof_data=proof_data, proof_type=proof_id, public_inputs=public_inputs
+        )
+
+    def verify_zkevm_proof(
+        self, zk_proof, parent_hash: bytes, block_hash: bytes, program_bytecode: bytes
+    ) -> bool:
+        if bytes(zk_proof.public_inputs.block_hash) != bytes(block_hash):
+            return False
+        if bytes(zk_proof.public_inputs.parent_hash) != bytes(parent_hash):
+            return False
+        _, verification_key = self.generate_keys(program_bytecode, int(zk_proof.proof_type))
+        return self.verify_execution_proof_impl(zk_proof, verification_key)
+
+    # == execution proof functions (beacon-chain.md:93-147) ================
+
+    def verify_execution_proof(
+        self, signed_proof, parent_hash, block_hash, state, el_program: bytes
+    ) -> bool:
+        proof_message = signed_proof.message
+        validator = state.validators[int(proof_message.validator_index)]
+        signing_root = self.compute_signing_root(
+            proof_message, self.get_domain(state, self.DOMAIN_EXECUTION_PROOF)
+        )
+        if not bls.Verify(validator.pubkey, signing_root, signed_proof.signature):
+            return False
+        program_bytecode = bytes(el_program) + int(
+            proof_message.zk_proof.proof_type
+        ).to_bytes(1, "little")
+        return self.verify_zkevm_proof(
+            proof_message.zk_proof, parent_hash, block_hash, program_bytecode
+        )
+
+    def retrieve_execution_proofs(self, block_hash):
+        """Implementation/context dependent; tests override."""
+        return []
+
+    def verify_execution_proofs(self, parent_hash, block_hash, state) -> bool:
+        signed_execution_proofs = self.retrieve_execution_proofs(block_hash)
+        if len(signed_execution_proofs) < self.MIN_REQUIRED_EXECUTION_PROOFS:
+            return False
+        for signed_proof in signed_execution_proofs:
+            if not self.verify_execution_proof(
+                signed_proof, parent_hash, block_hash, state, self.PROGRAM
+            ):
+                return False
+        return True
+
+    # == payload processing (beacon-chain.md:151-216) ======================
+
+    def process_execution_payload(
+        self, state, body, execution_engine, stateless_validation: bool = False
+    ) -> None:
+        """[Modified in EIP8025] optional stateless validation path."""
+        if not stateless_validation:
+            return super().process_execution_payload(state, body, execution_engine)
+        payload = body.execution_payload
+        assert (
+            payload.parent_hash == state.latest_execution_payload_header.block_hash
+        ), "payload parent mismatch"
+        assert payload.prev_randao == self.get_randao_mix(
+            state, self.get_current_epoch(state)
+        ), "wrong prev_randao"
+        assert payload.timestamp == self.compute_timestamp_at_slot(
+            state, state.slot
+        ), "wrong payload timestamp"
+        assert (
+            len(body.blob_kzg_commitments)
+            <= self.get_blob_parameters(self.get_current_epoch(state)).max_blobs_per_block
+        ), "too many blobs"
+        # [New in EIP8025] execution proofs replace the engine call
+        assert self.verify_execution_proofs(
+            payload.parent_hash, payload.block_hash, state
+        ), "insufficient or invalid execution proofs"
+        state.latest_execution_payload_header = self.execution_payload_to_header(payload)

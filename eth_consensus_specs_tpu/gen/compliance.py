@@ -82,7 +82,8 @@ def instantiate_scenario(spec, genesis_state, tree: tuple[int, ...], *, attest: 
         slot = slots[parent] + 1 + rank  # siblings at distinct slots
         parent_state = states[parent].copy()
         block = build_empty_block(spec, parent_state, slot=slot)
-        if attest and rng.random() < 0.5 and slot >= 2:
+        if attest and rng.random() < 0.3 and slot >= 2:
+            # embedded attestation (carried in the block body)
             probe = parent_state.copy()
             att_slot = slot - 1
             if att_slot > int(probe.slot):
@@ -98,6 +99,17 @@ def instantiate_scenario(spec, genesis_state, tree: tuple[int, ...], *, attest: 
         blocks[i] = signed
         steps.append({"tick": slot})
         steps.append({"block": signed})
+        if attest and rng.random() < 0.5:
+            # standalone on-the-wire attestation for this block (valid for
+            # the store from the NEXT slot)
+            try:
+                att = get_valid_attestation(
+                    spec, parent_state, slot=slot, signed=True
+                )
+                steps.append({"tick": slot + 1})
+                steps.append({"attestation": att})
+            except (AssertionError, IndexError, ValueError):
+                pass
     steps.append({"checks": {"head_known": True, "descends_from_justified": True}})
     return steps
 
@@ -122,18 +134,25 @@ def mutate_reorder_parent_after_child(steps: list[dict], rng: random.Random) -> 
     if not candidates:
         return list(steps)
     j = rng.choice(candidates)
+    moved_root = roots[j]
     out = []
     early = dict(steps[j])
     early["expect_invalid"] = True
     inserted = False
+    deferred: list[dict] = [{k: v for k, v in steps[j].items()}]
     for i, s in enumerate(steps):
         if i == j:
+            continue
+        if "attestation" in s and bytes(s["attestation"].data.beacon_block_root) == moved_root:
+            # votes for the delayed block only land once it is known
+            deferred.append(dict(s))
             continue
         if not inserted and "block" in s:
             out.append(early)
             inserted = True
         out.append(s)
-    out.insert(len(out) - 1, {k: v for k, v in steps[j].items()})
+    for s in deferred:
+        out.insert(len(out) - 1, s)
     return out
 
 

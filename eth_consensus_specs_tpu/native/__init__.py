@@ -23,11 +23,20 @@ _tried = False
 
 def _compile() -> bool:
     cc = os.environ.get("CC") or sysconfig.get_config_var("CC") or "cc"
-    cmd = cc.split() + ["-O2", "-fPIC", "-shared", "-o", _LIB, _SRC]
+    # link to a per-process temp name, then atomically rename: concurrent
+    # first-use compilations (pytest-xdist, parallel imports) must never
+    # let a reader dlopen a partially written object
+    tmp = f"{_LIB}.{os.getpid()}.tmp"
+    cmd = cc.split() + ["-O2", "-fPIC", "-shared", "-o", tmp, _SRC]
     try:
         subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+        os.replace(tmp, _LIB)
         return True
     except (OSError, subprocess.SubprocessError):
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
         return False
 
 

@@ -67,9 +67,17 @@ def expect_assertion_error(fn):
 # -- balance profiles (reference: context.py default/low/misc balances) ----
 
 
+def _default_validator_count(spec) -> int:
+    # capped below the deterministic key count so the mainnet preset
+    # (which would want 8*32*64 = 16k validators) stays drivable with the
+    # 8k keys, leaving spare keys for tests that add NEW validators
+    from .keys import KEY_COUNT
+
+    return min(8 * spec.SLOTS_PER_EPOCH * spec.MAX_COMMITTEES_PER_SLOT, KEY_COUNT - 64)
+
+
 def default_balances(spec):
-    n = 8 * spec.SLOTS_PER_EPOCH * spec.MAX_COMMITTEES_PER_SLOT
-    return [spec.MAX_EFFECTIVE_BALANCE] * n
+    return [spec.MAX_EFFECTIVE_BALANCE] * _default_validator_count(spec)
 
 
 def scaled_churn_balances_min_churn_limit(spec):
@@ -78,13 +86,12 @@ def scaled_churn_balances_min_churn_limit(spec):
 
 
 def low_balances(spec):
-    n = 8 * spec.SLOTS_PER_EPOCH * spec.MAX_COMMITTEES_PER_SLOT
     low = spec.config.EJECTION_BALANCE
-    return [low] * n
+    return [low] * _default_validator_count(spec)
 
 
 def misc_balances(spec):
-    n = 8 * spec.SLOTS_PER_EPOCH * spec.MAX_COMMITTEES_PER_SLOT
+    n = _default_validator_count(spec)
     balances = [spec.MAX_EFFECTIVE_BALANCE * 2 * i // n for i in range(n)]
     rng = __import__("random").Random(1234)
     rng.shuffle(balances)

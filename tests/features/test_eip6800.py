@@ -1,0 +1,60 @@
+"""EIP-6800 Verkle execution witnesses
+(reference: specs/_features/eip6800/beacon-chain.md)."""
+
+from eth_consensus_specs_tpu.forks.features import get_feature_spec
+from eth_consensus_specs_tpu.ssz import hash_tree_root, serialize
+from eth_consensus_specs_tpu.test_infra.block import (
+    build_empty_block_for_next_slot,
+    state_transition_and_sign_block,
+)
+from eth_consensus_specs_tpu.test_infra.context import (
+    default_activation_threshold,
+    default_balances,
+)
+from eth_consensus_specs_tpu.test_infra.genesis import create_genesis_state
+from eth_consensus_specs_tpu.utils import bls
+
+
+def _spec_state():
+    bls.bls_active = False
+    spec = get_feature_spec("eip6800", "minimal")
+    state = create_genesis_state(
+        spec, default_balances(spec), default_activation_threshold(spec)
+    )
+    return spec, state
+
+
+def test_witness_types_roundtrip():
+    spec, _ = _spec_state()
+    OptionalBytes32 = spec.SuffixStateDiff.fields()["current_value"]
+    diff = spec.SuffixStateDiff(
+        suffix=b"\x07",
+        current_value=OptionalBytes32(selector=1, value=b"\x01" * 32),
+        new_value=OptionalBytes32(selector=0, value=None),
+    )
+    stem_diff = spec.StemStateDiff(stem=b"\x02" * 31, suffix_diffs=[diff])
+    witness = spec.ExecutionWitness(state_diff=[stem_diff])
+    data = serialize(witness)
+    back = spec.ExecutionWitness.decode_bytes(data)
+    assert hash_tree_root(back) == hash_tree_root(witness)
+
+
+def test_header_commits_to_witness():
+    spec, state = _spec_state()
+    block = build_empty_block_for_next_slot(spec, state)
+    diff = spec.StemStateDiff(stem=b"\x09" * 31)
+    block.body.execution_payload.execution_witness = spec.ExecutionWitness(
+        state_diff=[diff]
+    )
+    state_transition_and_sign_block(spec, state, block)
+    header = state.latest_execution_payload_header
+    assert bytes(header.execution_witness_root) == bytes(
+        hash_tree_root(block.body.execution_payload.execution_witness)
+    )
+
+
+def test_empty_witness_block_applies():
+    spec, state = _spec_state()
+    block = build_empty_block_for_next_slot(spec, state)
+    state_transition_and_sign_block(spec, state, block)
+    assert int(state.slot) == 1

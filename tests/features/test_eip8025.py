@@ -1,0 +1,94 @@
+"""EIP-8025 zkEVM execution proofs
+(reference: specs/_features/eip8025/{beacon-chain,zkevm}.md)."""
+
+from eth_consensus_specs_tpu.forks.features import get_feature_spec
+from eth_consensus_specs_tpu.test_infra.block import (
+    build_empty_block_for_next_slot,
+    state_transition_and_sign_block,
+)
+from eth_consensus_specs_tpu.test_infra.context import (
+    default_activation_threshold,
+    default_balances,
+    expect_assertion_error,
+)
+from eth_consensus_specs_tpu.test_infra.genesis import create_genesis_state
+from eth_consensus_specs_tpu.test_infra.keys import privkeys
+from eth_consensus_specs_tpu.utils import bls
+
+
+def _spec_state():
+    bls.bls_active = False
+    spec = get_feature_spec("eip8025", "minimal")
+    state = create_genesis_state(
+        spec, default_balances(spec), default_activation_threshold(spec)
+    )
+    return spec, state
+
+
+def _signed_proof(spec, state, block_hash, parent_hash, validator_index=0, proof_id=1):
+    zk = spec.generate_zkevm_proof(block_hash, parent_hash, proof_id)
+    message = spec.ExecutionProof(
+        beacon_root=b"\x00" * 32, zk_proof=zk, validator_index=validator_index
+    )
+    signing_root = spec.compute_signing_root(
+        message, spec.get_domain(state, spec.DOMAIN_EXECUTION_PROOF)
+    )
+    sig = bls.Sign(privkeys[validator_index], signing_root)
+    return spec.SignedExecutionProof(message=message, signature=sig)
+
+
+def test_zkevm_proof_roundtrip():
+    spec, state = _spec_state()
+    bh, ph = b"\x01" * 32, b"\x02" * 32
+    zk = spec.generate_zkevm_proof(bh, ph, 1)
+    assert spec.verify_zkevm_proof(zk, ph, bh, spec.PROGRAM + b"\x01")
+    # wrong block hash binding fails
+    assert not spec.verify_zkevm_proof(zk, ph, b"\x03" * 32, spec.PROGRAM + b"\x01")
+    assert not spec.verify_zkevm_proof(zk, b"\x04" * 32, bh, spec.PROGRAM + b"\x01")
+
+
+def test_verify_execution_proof_signature_gate():
+    spec, state = _spec_state()
+    bh, ph = b"\x01" * 32, b"\x02" * 32
+    bls.bls_active = True
+    try:
+        signed = _signed_proof(spec, state, bh, ph)
+        assert spec.verify_execution_proof(signed, ph, bh, state, spec.PROGRAM)
+        bad = spec.SignedExecutionProof(message=signed.message, signature=b"\x11" * 96)
+        assert not spec.verify_execution_proof(bad, ph, bh, state, spec.PROGRAM)
+    finally:
+        bls.bls_active = False
+
+
+def test_stateless_validation_path():
+    spec, state = _spec_state()
+    block = build_empty_block_for_next_slot(spec, state)
+    payload = block.body.execution_payload
+    probe = state.copy()
+    spec.process_slots(probe, block.slot)
+
+    # no proofs retrievable -> stateless validation rejects
+    expect_assertion_error(
+        lambda: spec.process_execution_payload(
+            probe.copy(), block.body, spec.EXECUTION_ENGINE, stateless_validation=True
+        )
+    )
+
+    # register a retriever with a valid proof -> accepted
+    signed = _signed_proof(
+        spec, probe, bytes(payload.block_hash), bytes(payload.parent_hash)
+    )
+    spec.retrieve_execution_proofs = lambda block_hash: [signed]
+    try:
+        spec.process_execution_payload(
+            probe.copy(), block.body, spec.EXECUTION_ENGINE, stateless_validation=True
+        )
+    finally:
+        del spec.retrieve_execution_proofs
+
+
+def test_stateful_path_unchanged():
+    spec, state = _spec_state()
+    block = build_empty_block_for_next_slot(spec, state)
+    state_transition_and_sign_block(spec, state, block)
+    assert int(state.slot) == 1

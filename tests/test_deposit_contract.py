@@ -92,23 +92,19 @@ def test_native_and_python_paths_agree():
     rng = random.Random(5)
     leaves = [bytes(rng.randrange(256) for _ in range(32)) for _ in range(9)]
 
-    os.environ["ETH_SPECS_TPU_NO_NATIVE"] = "1"
-    try:
-        py_contract = DepositContract.__new__(DepositContract)
-        py_contract.__init__()
-        # force the python path regardless of the cached lib
-        import eth_consensus_specs_tpu.native as nat
+    import eth_consensus_specs_tpu.native as nat
 
-        saved = nat._lib
-        nat._lib = None
-        nat._tried = True
+    saved = nat._lib
+    nat._lib = None  # forces the pure-Python fallback (get_lib caches)
+    nat._tried = True
+    try:
+        py_contract = DepositContract()
         for leaf in leaves:
             py_contract.insert_leaf(leaf)
         py_root = py_contract.get_deposit_root()
     finally:
         nat._lib = saved
         nat._tried = True
-        del os.environ["ETH_SPECS_TPU_NO_NATIVE"]
 
     c_contract = DepositContract()
     for leaf in leaves:
