@@ -509,6 +509,16 @@ class ElectraSpec(DenebSpec):
 
     # == epoch processing (specs/electra/beacon-chain.md:834-1072) =========
 
+    def process_epoch_columnar(self, state) -> None:
+        """Electra interleaves the pending deposit/consolidation queues
+        BETWEEN the slashings sweep and the effective-balance update
+        (process_epoch below), an ordering the fused altair kernel cannot
+        honor in one device call (ops/altair_epoch.py module docstring).
+        Fall back to the object path for correctness; the raw kernel
+        already supports electra semantics (per-increment slashing,
+        MaxEB column) for the split fusion to build on."""
+        self.process_epoch(state)
+
     def process_epoch(self, state) -> None:
         self.process_justification_and_finalization(state)
         self.process_inactivity_updates(state)

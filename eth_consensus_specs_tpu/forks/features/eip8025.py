@@ -5,17 +5,19 @@ Behavioral parity targets:
     containers (:67-82), verify_execution_proof(s) (:93-147), and the
     stateless_validation branch of process_execution_payload (:151-216)
   * proof system: specs/_features/eip8025/zkevm.md — the MOCK proof
-    system the reference itself specifies (proof_data is a hash of the
-    public inputs; verification checks sizes + input binding), kept
-    byte-identical here. Built on fulu.
+    system the reference itself specifies, kept byte-identical here:
+    verification binds the proof's public_inputs to the claimed
+    parent/block hashes, while verify_execution_proof_impl is the
+    reference's intentional size-check-only placeholder (proof_data is
+    NOT cryptographically verified — true of the upstream spec too; a
+    real proof system slots in behind the same interface). Built on fulu.
 """
 
+from eth_consensus_specs_tpu.forks.bellatrix import Hash32
 from eth_consensus_specs_tpu.forks.fulu import FuluSpec
 from eth_consensus_specs_tpu.forks.phase0 import BLSSignature, Root, ValidatorIndex
 from eth_consensus_specs_tpu.ssz import ByteList, Container, hash_tree_root, uint8
 from eth_consensus_specs_tpu.utils import bls
-
-from .eip6800 import Hash32
 
 
 class EIP8025Spec(FuluSpec):

@@ -17,13 +17,15 @@ in one jitted function over flag/score/balance columns. All control flow is
 process_registry_updates (it never touches balance columns or the slashing
 predicate — see ops/state_columns.py docstring).
 
-Serves altair, bellatrix, capella and deneb: the only per-fork deltas in
-this region are the two quotient knobs (inactivity penalty quotient,
-proportional slashing multiplier), which enter as compile-time params via
-the spec's fork hooks. Electra changes the epoch *structure* (pending
-deposit/consolidation queues between slashings and the effective-balance
-update, per-validator MaxEB) and gets its own wrapper when its columnar
-path lands.
+RAW-KERNEL fork coverage: altair through electra+ semantics — the two
+quotient knobs enter via the spec's fork hooks, electra's per-increment
+slashing rounding via `electra_slashing`, and EIP-7251's per-validator
+MaxEB as an optional column. The SPEC-LEVEL columnar wrapper
+(`process_epoch_columnar`) remains altair→deneb: electra interleaves the
+pending deposit/consolidation queues BETWEEN the slashings sweep and the
+effective-balance update, which this fused kernel cannot honor without a
+split — electra's wrapper falls back to the object path (forks/electra.py)
+until the two-phase fusion lands.
 
 Sequential balance application (reward_k then clamped penalty_k, k over
 src/tgt/head/inactivity) exactly mirrors the object path's delta-list
@@ -50,6 +52,19 @@ from .state_columns import (
     justification_update,
 )
 
+
+def _is_post_electra(spec) -> bool:
+    from eth_consensus_specs_tpu.config import FORK_ORDER
+
+    lineage = spec.fork_name
+    if lineage not in FORK_ORDER:
+        # feature forks carry their base fork's epoch semantics
+        from eth_consensus_specs_tpu.forks.features import FEATURE_BASE_FORK
+
+        lineage = FEATURE_BASE_FORK.get(lineage, "phase0")
+    return FORK_ORDER.index(lineage) >= FORK_ORDER.index("electra")
+
+
 U64 = jnp.uint64
 
 
@@ -73,6 +88,10 @@ class AltairEpochParams:
     hysteresis_downward_multiplier: int
     hysteresis_upward_multiplier: int
     max_effective_balance: int
+    # [Electra:EIP7251] per-increment penalty quantum replaces altair's
+    # per-validator rounding (specs/electra/beacon-chain.md:893-920); the
+    # per-validator effective-balance ceiling moves into a column
+    electra_slashing: bool = False
 
     @classmethod
     def from_spec(cls, spec) -> "AltairEpochParams":
@@ -92,6 +111,7 @@ class AltairEpochParams:
             hysteresis_downward_multiplier=spec.HYSTERESIS_DOWNWARD_MULTIPLIER,
             hysteresis_upward_multiplier=spec.HYSTERESIS_UPWARD_MULTIPLIER,
             max_effective_balance=spec.MAX_EFFECTIVE_BALANCE,
+            electra_slashing=_is_post_electra(spec),
         )
 
 
@@ -107,6 +127,9 @@ class AltairEpochColumns(NamedTuple):
     prev_flags: jnp.ndarray  # u8[N] previous_epoch_participation bitfield
     cur_tgt_att: jnp.ndarray  # bool[N] current-epoch TIMELY_TARGET flag
     inactivity_scores: jnp.ndarray  # u64[N]
+    # [Electra:EIP7251] per-validator effective-balance ceiling (32 ETH or
+    # 2048 ETH by credential type); None pre-electra -> the scalar param
+    max_effective_balance: jnp.ndarray | None = None  # u64[N]
 
 
 class AltairEpochResult(NamedTuple):
@@ -227,7 +250,13 @@ def altair_epoch_accounting_impl(
     )
     half_vec = jnp.asarray(p.epochs_per_slashings_vector // 2, U64)
     slash_now = cols.slashed & (cur_epoch + half_vec == cols.withdrawable_epoch)
-    slash_penalty = (eff // incr) * adj_slash // total_active * incr
+    if p.electra_slashing:
+        # [Electra:EIP7251] shared per-increment quantum, then scale by the
+        # validator's increments (different rounding from altair)
+        penalty_per_increment = adj_slash // (total_active // incr)
+        slash_penalty = penalty_per_increment * (eff // incr)
+    else:
+        slash_penalty = (eff // incr) * adj_slash // total_active * incr
     bal = bal - jnp.minimum(bal, jnp.where(slash_now, slash_penalty, zero))
 
     # -- effective-balance hysteresis -------------------------------------
@@ -235,11 +264,12 @@ def altair_epoch_accounting_impl(
     down = hyst * jnp.asarray(p.hysteresis_downward_multiplier, U64)
     up = hyst * jnp.asarray(p.hysteresis_upward_multiplier, U64)
     crossed = (bal + down < eff) | (eff + up < bal)
-    new_eff = jnp.where(
-        crossed,
-        jnp.minimum(bal - bal % incr, jnp.asarray(p.max_effective_balance, U64)),
-        eff,
+    eff_ceiling = (
+        cols.max_effective_balance
+        if cols.max_effective_balance is not None
+        else jnp.asarray(p.max_effective_balance, U64)
     )
+    new_eff = jnp.where(crossed, jnp.minimum(bal - bal % incr, eff_ceiling), eff)
 
     return AltairEpochResult(
         balance=bal,

@@ -136,11 +136,16 @@ def sharded_epoch_fn(mesh: Mesh, params: EpochParams):
     )
 
 
-def altair_epoch_specs():
-    """(cols, just, result) PartitionSpec pytrees for the altair+ kernel."""
+def altair_epoch_specs(with_max_effective_balance: bool = False):
+    """(cols, just, result) PartitionSpec pytrees for the altair+ kernel.
+    The optional electra MaxEB column shards like the other validator
+    vectors when present; None (pre-electra) contributes no leaves."""
     vec = P(_VALIDATOR_AXES)
     rep = P()
-    cols = AltairEpochColumns(*([vec] * len(AltairEpochColumns._fields)))
+    cols = AltairEpochColumns(
+        **{f: vec for f in AltairEpochColumns._fields if f != "max_effective_balance"},
+        max_effective_balance=vec if with_max_effective_balance else None,
+    )
     just = JustificationState(*([rep] * len(JustificationState._fields)))
     result = AltairEpochResult(
         balance=vec,
@@ -157,11 +162,13 @@ def altair_epoch_specs():
     return cols, just, result
 
 
-def sharded_altair_epoch_fn(mesh: Mesh, params: AltairEpochParams):
+def sharded_altair_epoch_fn(
+    mesh: Mesh, params: AltairEpochParams, with_max_effective_balance: bool = False
+):
     """Altair+ flag-based epoch kernel under shard_map — same collective
     shape as the phase0 path minus the proposer scatter (flags carry no
     inclusion-proposer attribution), so it is pure psum reductions."""
-    cols_spec, just_spec, res_spec = altair_epoch_specs()
+    cols_spec, just_spec, res_spec = altair_epoch_specs(with_max_effective_balance)
     red = MeshReductions(mesh)
 
     def local(cols, just):

@@ -1,0 +1,92 @@
+"""Electra accounting-kernel semantics: per-increment slashing rounding
+and the per-validator MaxEB ceiling
+(reference: specs/electra/beacon-chain.md:893-920 process_slashings,
+:921-941 process_effective_balance_updates)."""
+
+import numpy as np
+
+from eth_consensus_specs_tpu.forks import get_spec
+from eth_consensus_specs_tpu.ops.altair_epoch import (
+    AltairEpochParams,
+    altair_epoch_accounting,
+)
+
+import __graft_entry__ as graft
+
+
+def _run(fork: str, electra_cols: bool):
+    spec = get_spec(fork, "mainnet")
+    params = AltairEpochParams.from_spec(spec)
+    cols, just = graft._example_altair_inputs(512, electra=electra_cols)
+    res = altair_epoch_accounting(params, cols, just)
+    return spec, params, cols, just, res
+
+
+def test_electra_slashing_rounding_differs_from_deneb():
+    _, p_deneb, cols, just, res_deneb = _run("deneb", False)
+    _, p_electra, _, _, res_electra = _run("electra", False)
+    assert p_electra.electra_slashing and not p_deneb.electra_slashing
+    # same inputs, different slashing rounding -> some slashed balances differ
+    assert not np.array_equal(np.asarray(res_deneb.balance), np.asarray(res_electra.balance))
+
+
+def test_electra_slashing_matches_spec_formula():
+    """deneb and electra params differ ONLY in the slashing rounding for
+    these inputs, so the per-validator balance delta between the two runs
+    must equal exactly altair_penalty - electra_penalty at slashed
+    validators inside the penalty window, and zero elsewhere."""
+    spec_d, p_d, cols, just, res_d = _run("deneb", False)
+    spec_e, p_e, _, _, res_e = _run("electra", False)
+    assert (
+        p_d.inactivity_penalty_quotient == p_e.inactivity_penalty_quotient
+        and p_d.proportional_slashing_multiplier == p_e.proportional_slashing_multiplier
+    ), "precondition: only the slashing rounding differs"
+
+    incr = spec_e.EFFECTIVE_BALANCE_INCREMENT
+    eff = [int(x) for x in np.asarray(cols.effective_balance)]
+    active = (np.asarray(cols.activation_epoch) <= int(just.current_epoch)) & (
+        int(just.current_epoch) < np.asarray(cols.exit_epoch)
+    )
+    total = max(sum(e for e, a in zip(eff, active) if a) // incr * incr, incr)
+    adjusted = min(int(just.slashings_sum) * p_e.proportional_slashing_multiplier, total)
+    per_increment = adjusted // (total // incr)
+    half = p_e.epochs_per_slashings_vector // 2
+    slash_now = np.asarray(cols.slashed) & (
+        int(just.current_epoch) + half == np.asarray(cols.withdrawable_epoch)
+    )
+
+    bal_d = np.asarray(res_d.balance)
+    bal_e = np.asarray(res_e.balance)
+    for i in range(len(eff)):
+        if slash_now[i]:
+            altair_penalty = eff[i] // incr * adjusted // total * incr
+            electra_penalty = per_increment * (eff[i] // incr)
+            assert int(bal_d[i]) - int(bal_e[i]) == electra_penalty - altair_penalty, i
+        else:
+            assert bal_d[i] == bal_e[i], i
+    assert slash_now.any(), "fixture must exercise the slashing window"
+
+
+def test_per_validator_max_effective_balance_caps_hysteresis():
+    spec, params, cols, just, res = _run("electra", True)
+    eff_out = np.asarray(res.effective_balance)
+    max_eff = np.asarray(cols.max_effective_balance)
+    assert (eff_out <= max_eff).all()
+    # without the column, everything is capped at the scalar 32 ETH
+    _, _, cols0, _, res0 = _run("electra", False)
+    assert (np.asarray(res0.effective_balance) <= 32_000_000_000).all()
+
+
+def test_column_and_scalar_agree_when_uniform():
+    """A uniform 32-ETH MaxEB column must reproduce the scalar path
+    bit-exactly."""
+    spec = get_spec("electra", "mainnet")
+    params = AltairEpochParams.from_spec(spec)
+    cols, just = graft._example_altair_inputs(256, electra=False)
+    uniform = cols._replace(
+        max_effective_balance=np.full(256, 32_000_000_000, np.uint64)
+    )
+    a = altair_epoch_accounting(params, cols, just)
+    b = altair_epoch_accounting(params, uniform, just)
+    for x, y in zip(a, b):
+        np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
