@@ -44,6 +44,28 @@ def previous_fork(fork: str) -> str | None:
     return FORK_ORDER[i - 1] if i > 0 else None
 
 
+def fork_lineage(fork_name: str) -> str:
+    """Mainline fork a spec's semantics sit on: itself for mainline forks,
+    the registered base fork for features. Unknown names are a hard error
+    (a feature module missing its FEATURE_BASE_FORK entry must not be
+    silently treated as phase0)."""
+    if fork_name in FORK_ORDER:
+        return fork_name
+    from eth_consensus_specs_tpu.forks.features import FEATURE_BASE_FORK
+
+    try:
+        return FEATURE_BASE_FORK[fork_name]
+    except KeyError:
+        raise KeyError(
+            f"{fork_name!r} is neither a mainline fork nor a registered feature"
+        ) from None
+
+
+def is_post_fork(fork_name: str, target: str) -> bool:
+    """True when `fork_name`'s lineage is at or after `target`."""
+    return FORK_ORDER.index(fork_lineage(fork_name)) >= FORK_ORDER.index(target)
+
+
 def _parse_value(v: Any) -> Any:
     if isinstance(v, str):
         if v.startswith("0x"):

@@ -70,3 +70,17 @@ def get_feature_spec(name: str, preset_name: str = "mainnet"):
 
 def available_features() -> list[str]:
     return sorted(FEATURE_BASE_FORK)
+
+
+def carry_state_fields(pre) -> dict:
+    """Field dict of a state for cross-type reconstruction in feature
+    upgrades: sequence views become plain lists so the target fork's
+    (differently parametrized) sequence types re-coerce element-wise."""
+    from eth_consensus_specs_tpu.ssz import Bitlist, Bitvector, List, Vector
+
+    return {
+        name: list(getattr(pre, name))
+        if issubclass(t, (List, Vector, Bitlist, Bitvector))
+        else getattr(pre, name)
+        for name, t in pre.fields().items()
+    }

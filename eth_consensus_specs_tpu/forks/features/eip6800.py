@@ -163,3 +163,20 @@ class EIP6800Spec(DenebSpec):
             },
             execution_witness_root=hash_tree_root(payload.execution_witness),
         )
+
+    def upgrade_from_parent(self, pre):
+        """deneb -> eip6800 (specs/_features/eip6800/fork.md): the stored
+        header grows the zero witness root; everything else carries."""
+        from eth_consensus_specs_tpu.forks.features import carry_state_fields
+
+        fields = carry_state_fields(pre)
+        pre_header = pre.latest_execution_payload_header
+        fields["latest_execution_payload_header"] = self.ExecutionPayloadHeader(
+            **{name: getattr(pre_header, name) for name in pre_header.fields()}
+        )
+        fields["fork"] = self.Fork(
+            previous_version=pre.fork.current_version,
+            current_version=self.config.EIP6800_FORK_VERSION,
+            epoch=self.get_current_epoch(pre),
+        )
+        return self.BeaconState(**fields)

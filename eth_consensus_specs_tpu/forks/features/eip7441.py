@@ -392,6 +392,48 @@ class EIP7441Spec(CapellaSpec):
         )
         return int(state.latest_block_header.proposer_index)
 
+    # == fork upgrade (specs/_features/eip7441/fork.md:55-119) =============
+
+    def upgrade_from_parent(self, pre):
+        """capella -> whisk. Initial k's use counter 0 directly as fork.md
+        does (collisions are negligible); the reference document's stale
+        `validators=[]` is corrected to carry the registry."""
+        ks = [
+            self.get_initial_whisk_k(validator_index, 0)
+            for validator_index in range(len(pre.validators))
+        ]
+        whisk_k_commitments = [self.get_k_commitment(k) for k in ks]
+        whisk_trackers = [self.get_initial_tracker(k) for k in ks]
+
+        from eth_consensus_specs_tpu.forks.features import carry_state_fields
+
+        epoch = self.get_current_epoch(pre)
+        fields = carry_state_fields(pre)
+        fields["fork"] = self.Fork(
+            previous_version=pre.fork.current_version,
+            current_version=self.config.EIP7441_FORK_VERSION,
+            epoch=epoch,
+        )
+        post = self.BeaconState(
+            **fields,
+            whisk_proposer_trackers=[
+                self.WhiskTracker() for _ in range(self.PROPOSER_TRACKERS_COUNT)
+            ],
+            whisk_candidate_trackers=[
+                self.WhiskTracker() for _ in range(self.CANDIDATE_TRACKERS_COUNT)
+            ],
+            whisk_trackers=whisk_trackers,
+            whisk_k_commitments=whisk_k_commitments,
+        )
+        # candidate selection with an older epoch, then proposers, then a
+        # final candidate round for the upcoming shuffling phase
+        self.select_whisk_candidate_trackers(
+            post, max(epoch - (self.config.PROPOSER_SELECTION_GAP + 1), 0)
+        )
+        self.select_whisk_proposer_trackers(post, epoch)
+        self.select_whisk_candidate_trackers(post, epoch)
+        return post
+
     # == test/genesis bootstrap ===========================================
 
     def initialize_feature_state(self, state) -> None:

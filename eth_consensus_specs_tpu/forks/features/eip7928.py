@@ -107,3 +107,20 @@ class EIP7928Spec(FuluSpec):
             **{name: getattr(header, name) for name in header.fields() if name != "block_access_list_root"},
             block_access_list_root=hash_tree_root(payload.block_access_list),
         )
+
+    def upgrade_from_parent(self, pre):
+        """fulu -> eip7928 (specs/_features/eip7928/fork.md): the stored
+        header grows the zero access-list root; everything else carries."""
+        from eth_consensus_specs_tpu.forks.features import carry_state_fields
+
+        fields = carry_state_fields(pre)
+        pre_header = pre.latest_execution_payload_header
+        fields["latest_execution_payload_header"] = self.ExecutionPayloadHeader(
+            **{name: getattr(pre_header, name) for name in pre_header.fields()}
+        )
+        fields["fork"] = self.Fork(
+            previous_version=pre.fork.current_version,
+            current_version=self.config.EIP7928_FORK_VERSION,
+            epoch=self.get_current_epoch(pre),
+        )
+        return self.BeaconState(**fields)

@@ -54,15 +54,9 @@ from .state_columns import (
 
 
 def _is_post_electra(spec) -> bool:
-    from eth_consensus_specs_tpu.config import FORK_ORDER
+    from eth_consensus_specs_tpu.config import is_post_fork
 
-    lineage = spec.fork_name
-    if lineage not in FORK_ORDER:
-        # feature forks carry their base fork's epoch semantics
-        from eth_consensus_specs_tpu.forks.features import FEATURE_BASE_FORK
-
-        lineage = FEATURE_BASE_FORK.get(lineage, "phase0")
-    return FORK_ORDER.index(lineage) >= FORK_ORDER.index("electra")
+    return is_post_fork(spec.fork_name, "electra")
 
 
 U64 = jnp.uint64

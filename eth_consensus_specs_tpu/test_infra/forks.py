@@ -7,17 +7,16 @@ from eth_consensus_specs_tpu.config import FORK_ORDER
 
 
 def _lineage_fork(spec) -> str:
-    """Mainline fork the spec sits on: itself, or its base fork for
-    feature specs (specs/_features/* fork off specific mainline forks)."""
-    if spec.fork_name in FORK_ORDER:
-        return spec.fork_name
-    from eth_consensus_specs_tpu.forks.features import FEATURE_BASE_FORK
+    """Mainline fork the spec sits on (shared logic: config.fork_lineage)."""
+    from eth_consensus_specs_tpu.config import fork_lineage
 
-    return FEATURE_BASE_FORK[spec.fork_name]
+    return fork_lineage(spec.fork_name)
 
 
 def _at_or_after(spec, fork: str) -> bool:
-    return FORK_ORDER.index(_lineage_fork(spec)) >= FORK_ORDER.index(fork)
+    from eth_consensus_specs_tpu.config import is_post_fork
+
+    return is_post_fork(spec.fork_name, fork)
 
 
 def is_post_altair(spec) -> bool:

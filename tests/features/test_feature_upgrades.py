@@ -1,0 +1,66 @@
+"""Feature fork upgrades (reference: specs/_features/*/fork.md)."""
+
+from eth_consensus_specs_tpu.forks import get_spec
+from eth_consensus_specs_tpu.forks.features import get_feature_spec
+from eth_consensus_specs_tpu.ssz import hash_tree_root
+from eth_consensus_specs_tpu.test_infra.context import (
+    default_activation_threshold,
+    default_balances,
+)
+from eth_consensus_specs_tpu.test_infra.genesis import create_genesis_state
+from eth_consensus_specs_tpu.utils import bls
+
+
+def test_upgrade_to_eip7441():
+    bls.bls_active = False
+    capella = get_spec("capella", "minimal")
+    whisk = get_feature_spec("eip7441", "minimal")
+    pre = create_genesis_state(
+        capella, default_balances(capella), default_activation_threshold(capella)
+    )
+    post = whisk.upgrade_from_parent(pre)
+    assert bytes(post.fork.current_version) == bytes(whisk.config.EIP7441_FORK_VERSION)
+    assert len(post.whisk_trackers) == len(pre.validators)
+    assert len(post.whisk_k_commitments) == len(pre.validators)
+    assert len(post.whisk_proposer_trackers) == whisk.PROPOSER_TRACKERS_COUNT
+    # registry carries over (the reference doc's stale `validators=[]` is
+    # corrected)
+    assert hash_tree_root(post.validators) == hash_tree_root(pre.validators)
+    # initial trackers are (G, k*G) with the counter-0 k
+    k0 = whisk.get_initial_whisk_k(0, 0)
+    assert bytes(post.whisk_k_commitments[0]) == whisk.get_k_commitment(k0)
+    assert bytes(post.whisk_trackers[0].r_G) == whisk.BLS_G1_GENERATOR
+    # candidate/proposer trackers were selected (non-zero)
+    assert any(
+        bytes(t.r_G) != b"\x00" * 48 for t in post.whisk_candidate_trackers
+    )
+
+
+def test_upgrade_to_eip7928():
+    bls.bls_active = False
+    fulu = get_spec("fulu", "minimal")
+    feat = get_feature_spec("eip7928", "minimal")
+    pre = create_genesis_state(
+        fulu, default_balances(fulu), default_activation_threshold(fulu)
+    )
+    post = feat.upgrade_from_parent(pre)
+    assert bytes(post.fork.current_version) == bytes(feat.config.EIP7928_FORK_VERSION)
+    hdr = post.latest_execution_payload_header
+    assert bytes(hdr.block_access_list_root) == b"\x00" * 32
+    assert bytes(hdr.block_hash) == bytes(pre.latest_execution_payload_header.block_hash)
+    assert hash_tree_root(post.validators) == hash_tree_root(pre.validators)
+
+
+def test_upgrade_to_eip6800():
+    bls.bls_active = False
+    deneb = get_spec("deneb", "minimal")
+    feat = get_feature_spec("eip6800", "minimal")
+    pre = create_genesis_state(
+        deneb, default_balances(deneb), default_activation_threshold(deneb)
+    )
+    post = feat.upgrade_from_parent(pre)
+    assert bytes(post.fork.current_version) == bytes(feat.config.EIP6800_FORK_VERSION)
+    assert bytes(post.fork.previous_version) == bytes(pre.fork.current_version)
+    hdr = post.latest_execution_payload_header
+    assert bytes(hdr.execution_witness_root) == b"\x00" * 32
+    assert hash_tree_root(post.validators) == hash_tree_root(pre.validators)
