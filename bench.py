@@ -12,7 +12,14 @@ level) — measured on a 2**16 subtree and scaled per-hash (hashlib cost is
 size-independent per 64B message).
 
 vs_baseline is the speedup of the device tree over that host loop (>1 is
-faster than the reference path). Secondary numbers go to stderr.
+faster than the reference path). Secondary numbers go to stderr and into
+the JSON payload's "secondary" object.
+
+Methodology (round-3 fix): every device section uses CHAINED-DEPENDENCY
+timing — K iterations inside one jit where iteration i+1 consumes
+iteration i's output — so the number is sustained throughput; a lone
+dispatch's apparently-instant completion (round-2 verdict: ~7x inflation)
+cannot leak in.
 """
 
 from __future__ import annotations
@@ -38,14 +45,18 @@ def host_hashes_per_sec(n_pairs: int = 1 << 16) -> float:
 
 
 def device_tree_hashes_per_sec(
-    depth: int = 21, repeats: int = 3
+    depth: int = 21, chain: int = 16, repeats: int = 3
 ) -> tuple[float, float]:
-    """Per-tree latency over FRESH inputs each repeat. The input is
-    re-salted on device before every timed call (separate executable), so
-    any (executable, input) result caching in the backend/tunnel cannot
-    return a stale answer and deflate the measurement."""
+    """Sustained per-tree time via CHAINED-DEPENDENCY timing: `chain` trees
+    run inside one jit, each tree's leaves XORed with the previous tree's
+    root, so no tree can start before the previous one finishes and a lone
+    dispatch's apparent completion cannot deflate the number (round-2
+    verdict: single-call block_until_ready under-measured ~7x on this
+    platform).  Inputs are re-salted between repeats to defeat any
+    (executable, input) result caching."""
     import jax
     import jax.numpy as jnp
+    from jax import lax
 
     from eth_consensus_specs_tpu.ops.merkle import _tree_root_fused
 
@@ -55,44 +66,66 @@ def device_tree_hashes_per_sec(
             rng.integers(0, 2**32, size=(1 << depth, 8), dtype=np.uint64).astype(np.uint32)
         )
     )
-    salt_fn = jax.jit(lambda x, s: x ^ s)
 
-    jax.block_until_ready(_tree_root_fused(base, depth))  # compile + warm
+    @jax.jit
+    def run(lv, acc0):
+        def body(_, carry):
+            lv, acc = carry
+            fresh = lv ^ acc  # (N, 8) ^ (8,): every leaf depends on the prior root
+            return lv, _tree_root_fused(fresh, depth)
+
+        return lax.fori_loop(0, chain, body, (lv, acc0))[1]
+
+    warm = jnp.zeros(8, jnp.uint32)
+    jax.block_until_ready(run(base, warm))  # compile + warm
     best = float("inf")
     for i in range(repeats):
-        lv = salt_fn(base, jnp.uint32(i + 1))
-        jax.block_until_ready(lv)
+        salt = jnp.full(8, np.uint32(i + 1), jnp.uint32)
         t0 = time.perf_counter()
-        jax.block_until_ready(_tree_root_fused(lv, depth))
+        jax.block_until_ready(run(base, salt))
         best = min(best, time.perf_counter() - t0)
+    per_tree = best / chain
     n_hashes = (1 << depth) - 1  # logical tree nodes
-    return n_hashes / best, best
+    return n_hashes / per_tree, per_tree
 
 
-def bench_epoch_accounting(n_validators: int = 1_000_000) -> float:
-    """Secondary: fused 1M-validator accounting epoch, seconds/epoch."""
+def bench_epoch_accounting(n_validators: int = 1_000_000, chain: int = 8) -> float:
+    """Secondary: fused 1M-validator accounting epoch, sustained
+    seconds/epoch via chained-dependency timing (each epoch consumes the
+    previous epoch's balances inside one jit)."""
     import jax
+    import jax.numpy as jnp
+    from jax import lax
 
     import __graft_entry__ as graft
     from eth_consensus_specs_tpu.forks import get_spec
     from eth_consensus_specs_tpu.ops.state_columns import EpochParams, epoch_accounting
 
-    import jax.numpy as jnp
-
     params = EpochParams.from_spec(get_spec("phase0", "mainnet"))
     cols, just = graft._example_inputs(n_validators)
     cols = jax.device_put(cols)
     just = jax.device_put(just)
+
+    @jax.jit
+    def run(cols, just):
+        def body(_, c):
+            res = epoch_accounting(params, c, just)
+            return c._replace(
+                balance=res.balance, effective_balance=res.effective_balance
+            )
+
+        return lax.fori_loop(0, chain, body, cols).balance
+
     salt_fn = jax.jit(lambda c, s: c._replace(balance=c.balance + s))
-    jax.block_until_ready(epoch_accounting(params, cols, just))
+    jax.block_until_ready(run(cols, just))
     best = float("inf")
     for i in range(3):
         fresh = salt_fn(cols, jnp.uint64(i + 1))  # defeat result caching
         jax.block_until_ready(fresh)
         t0 = time.perf_counter()
-        jax.block_until_ready(epoch_accounting(params, fresh, just))
+        jax.block_until_ready(run(fresh, just))
         best = min(best, time.perf_counter() - t0)
-    return best
+    return best / chain
 
 
 def bench_device_resident_epochs(
@@ -356,6 +389,20 @@ def main() -> None:
         "value": round(dev_hps, 0),
         "unit": "hash/s",
         "vs_baseline": round(dev_hps / host_hps, 2) if host_hps else 0.0,
+        "method": (
+            "chained-dependency timing: K data-dependent iterations inside one "
+            "jit, wall-clock/K (sustained, not single-dispatch latency)"
+        ),
+        "secondary": {
+            "host_hashlib_hashes_per_sec": round(host_hps, 0),
+            "bls_aggregates_per_sec": (
+                round(bls_res["aggs_per_sec"], 1) if bls_res else None
+            ),
+            "resident_epoch_plus_root_ms": (
+                round(resident["per_epoch_s"] * 1e3, 3) if resident else None
+            ),
+            "fused_epoch_ms": round(epoch["epoch_s"] * 1e3, 3) if epoch else None,
+        },
     }
     if error is not None:
         result["error"] = error

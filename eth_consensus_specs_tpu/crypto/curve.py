@@ -98,11 +98,29 @@ class Point:
         return self + (-o)
 
     def mul(self, k: int) -> "Point":
-        """Scalar multiplication (Jacobian double-and-add internally)."""
+        """Scalar multiplication (Jacobian double-and-add internally;
+        routed through the native core for the two curve groups)."""
+        k = int(k)
         if k < 0:
             return (-self).mul(-k)
         if k == 0 or self.is_infinity():
             return Point.infinity(self.b)
+        from eth_consensus_specs_tpu.crypto import native_bridge as nb
+
+        if nb.enabled():
+            if isinstance(self.x, Fq):
+                r = nb.g1_mul((self.x.n, self.y.n), k)
+                return (
+                    Point.infinity(self.b)
+                    if r is None
+                    else Point(Fq(r[0]), Fq(r[1]), self.b)
+                )
+            if isinstance(self.x, Fq2):
+                r = nb.g2_mul(((self.x.c0.n, self.x.c1.n), (self.y.c0.n, self.y.c1.n)), k)
+                if r is None:
+                    return Point.infinity(self.b)
+                (x0, x1), (y0, y1) = r
+                return Point(Fq2(Fq(x0), Fq(x1)), Fq2(Fq(y0), Fq(y1)), self.b)
         jx, jy, jz = _to_jacobian(self)
         rx, ry, rz = None, None, None  # infinity
         while k:
@@ -203,7 +221,16 @@ def g2_infinity() -> Point:
 
 
 def in_subgroup(p: Point) -> bool:
-    """Order check: r*P == O (slow but exact; the prime-order subgroup)."""
+    """Order check: r*P == O (exact; native-accelerated for G1/G2)."""
+    if p.is_infinity():
+        return True
+    from eth_consensus_specs_tpu.crypto import native_bridge as nb
+
+    if nb.enabled():
+        if isinstance(p.x, Fq):
+            return nb.g1_in_subgroup((p.x.n, p.y.n))
+        if isinstance(p.x, Fq2):
+            return nb.g2_in_subgroup(((p.x.c0.n, p.x.c1.n), (p.y.c0.n, p.y.c1.n)))
     return p.mul(R).is_infinity()
 
 

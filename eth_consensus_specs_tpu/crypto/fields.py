@@ -17,6 +17,20 @@ R = 0x73EDA753299D7D483339D80809A1D805_53BDA402FFFE5BFEFFFFFFFF00000001
 # BLS parameter x (loop count); negative for BLS12-381
 BLS_X = -0xD201000000010000
 
+_NB = None
+
+
+def _bridge():
+    """The native bridge, lazily imported (no cycle: the bridge only talks
+    raw ints).  Inversion and sqrt — the two pow-sized field ops — route
+    through the C core when it is available."""
+    global _NB
+    if _NB is None:
+        from eth_consensus_specs_tpu.crypto import native_bridge as _NB_mod
+
+        _NB = _NB_mod
+    return _NB
+
 
 class Fq:
     __slots__ = ("n",)
@@ -39,6 +53,9 @@ class Fq:
     def inv(self):
         if self.n == 0:
             raise ZeroDivisionError("Fq inverse of zero")
+        nb = _bridge()
+        if nb.enabled():
+            return Fq(nb.fq_inv(self.n))
         return Fq(pow(self.n, P - 2, P))
 
     def square(self):
@@ -55,6 +72,10 @@ class Fq:
 
     def sqrt(self):
         """Square root (p % 4 == 3 branch). Returns None if non-residue."""
+        nb = _bridge()
+        if nb.enabled():
+            c = nb.fq_sqrt(self.n)
+            return None if c is None else Fq(c)
         c = pow(self.n, (P + 1) // 4, P)
         if c * c % P == self.n:
             return Fq(c)
@@ -114,6 +135,12 @@ class Fq2:
         return Fq2(self.c0, -self.c1)
 
     def inv(self):
+        nb = _bridge()
+        if nb.enabled():
+            if self.is_zero():
+                raise ZeroDivisionError("Fq2 inverse of zero")
+            c0, c1 = nb.fq2_inv(self.c0.n, self.c1.n)
+            return Fq2(Fq(c0), Fq(c1))
         norm = self.c0.square() + self.c1.square()
         ninv = norm.inv()
         return Fq2(self.c0 * ninv, -(self.c1 * ninv))
@@ -139,6 +166,10 @@ class Fq2:
 
     def sqrt(self):
         """Square root in Fq2 via the norm method; None if non-residue."""
+        nb = _bridge()
+        if nb.enabled():
+            r = nb.fq2_sqrt(self.c0.n, self.c1.n)
+            return None if r is None else Fq2(Fq(r[0]), Fq(r[1]))
         if self.is_zero():
             return Fq2.zero()
         a, b = self.c0, self.c1

@@ -204,6 +204,14 @@ def map_to_curve_g2(u: Fq2) -> Point:
 
 
 def clear_cofactor_g2(p: Point) -> Point:
+    from eth_consensus_specs_tpu.crypto import native_bridge as nb
+
+    if nb.enabled() and not p.is_infinity():
+        raw = nb.g2_clear_cofactor(((p.x.c0.n, p.x.c1.n), (p.y.c0.n, p.y.c1.n)))
+        if raw is None:
+            return Point.infinity(B2)
+        (x0, x1), (y0, y1) = raw
+        return Point(Fq2(Fq(x0), Fq(x1)), Fq2(Fq(y0), Fq(y1)), B2)
     return p.mul(H_EFF)
 
 

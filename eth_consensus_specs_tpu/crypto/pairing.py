@@ -91,13 +91,49 @@ def final_exponentiation(f: Fq12) -> Fq12:
     return f.pow(_HARD_EXP)
 
 
+def _nb():
+    from eth_consensus_specs_tpu.crypto import native_bridge
+
+    return native_bridge
+
+
+def _g1_raw(p: Point):
+    return None if p.is_infinity() else (p.x.n, p.y.n)
+
+
+def _g2_raw(q: Point):
+    if q.is_infinity():
+        return None
+    return ((q.x.c0.n, q.x.c1.n), (q.y.c0.n, q.y.c1.n))
+
+
+def _is_g1(p: Point) -> bool:
+    return p.is_infinity() or isinstance(p.x, Fq)
+
+
+def _is_g2(q: Point) -> bool:
+    return q.is_infinity() or isinstance(q.x, Fq2)
+
+
 def pairing(p: Point, q: Point) -> Fq12:
-    """e(P, Q) with P in G1(Fq), Q in G2(Fq2). Full pairing with final exp."""
+    """e(P, Q) with P in G1(Fq), Q in G2(Fq2). Full pairing with final exp.
+
+    The native path returns the identical GT element (the C Miller loop
+    mirrors this module's factor ordering exactly)."""
+    nb = _nb()
+    if nb.enabled() and not p.is_infinity() and not q.is_infinity():
+        coeffs = nb.pairing_gt_coeffs(_g1_raw(p), _g2_raw(q))
+        from .fields import Fq, Fq2
+
+        return Fq12.from_coeffs([Fq2(Fq(c0), Fq(c1)) for c0, c1 in coeffs])
     return final_exponentiation(miller_loop(p, untwist(q)))
 
 
 def pairing_check(pairs: list[tuple[Point, Point]]) -> bool:
     """prod e(P_i, Q_i) == 1, with one shared final exponentiation."""
+    nb = _nb()
+    if nb.enabled() and all(_is_g1(p) and _is_g2(q) for p, q in pairs):
+        return nb.pairing_check_raw([(_g1_raw(p), _g2_raw(q)) for p, q in pairs])
     f = Fq12.one()
     for p, q in pairs:
         f = f * miller_loop(p, untwist(q))

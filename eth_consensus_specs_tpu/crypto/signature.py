@@ -46,13 +46,37 @@ def key_validate(pk_bytes: bytes) -> bool:
     return not p.is_infinity()
 
 
+# Pubkey decompression (sqrt + subgroup check) is the per-operation fixed
+# cost of every verification, and validator pubkeys repeat constantly —
+# the reference leans on milagro doing this in C; we add a bounded cache on
+# top of the native path (same effect as the reference's LRU-cached
+# committee pipelines keeping pk objects alive).
+_PK_CACHE: dict[bytes, Point | None] = {}
+_PK_CACHE_MAX = 1 << 16
+
+
 def _load_pk(pk_bytes: bytes) -> Point | None:
+    from eth_consensus_specs_tpu.crypto import native_bridge as nb
+
+    key = bytes(pk_bytes)
+    # the cache holds natively-decompressed points; consulting it with the
+    # bridge disabled would let cached native results masquerade as the
+    # pure-Python oracle in cross-check tests
+    use_cache = nb.enabled()
+    if use_cache:
+        hit = _PK_CACHE.get(key, False)
+        if hit is not False:
+            return hit
     try:
-        p = g1_from_bytes(bytes(pk_bytes))
+        p = g1_from_bytes(key)
     except ValueError:
-        return None
-    if p.is_infinity():
-        return None
+        p = None
+    if p is not None and p.is_infinity():
+        p = None
+    if use_cache:
+        if len(_PK_CACHE) >= _PK_CACHE_MAX:
+            _PK_CACHE.clear()
+        _PK_CACHE[key] = p
     return p
 
 
@@ -72,28 +96,70 @@ def verify(pk_bytes: bytes, message: bytes, sig_bytes: bytes) -> bool:
     return pairing_check([(pk, hash_to_g2(bytes(message))), (-g1, sig)])
 
 
+def _sum_g2(points: list[Point]) -> Point:
+    from eth_consensus_specs_tpu.crypto import native_bridge as nb
+    from .fields import Fq, Fq2
+    from .curve import B2, Point as _P
+
+    if nb.enabled():
+        raw = nb.g2_aggregate(
+            [
+                None
+                if p.is_infinity()
+                else ((p.x.c0.n, p.x.c1.n), (p.y.c0.n, p.y.c1.n))
+                for p in points
+            ]
+        )
+        if raw is None:
+            return g2_infinity()
+        (x0, x1), (y0, y1) = raw
+        return _P(Fq2(Fq(x0), Fq(x1)), Fq2(Fq(y0), Fq(y1)), B2)
+    acc = g2_infinity()
+    for p in points:
+        acc = acc + p
+    return acc
+
+
+def _sum_g1(points: list[Point]) -> Point:
+    from eth_consensus_specs_tpu.crypto import native_bridge as nb
+    from .fields import Fq
+    from .curve import B1, Point as _P
+
+    if nb.enabled():
+        raw = nb.g1_aggregate(
+            [None if p.is_infinity() else (p.x.n, p.y.n) for p in points]
+        )
+        if raw is None:
+            return g1_infinity()
+        return _P(Fq(raw[0]), Fq(raw[1]), B1)
+    acc = g1_infinity()
+    for p in points:
+        acc = acc + p
+    return acc
+
+
 def aggregate(signatures: list[bytes]) -> bytes:
     if len(signatures) == 0:
         raise ValueError("cannot aggregate zero signatures")
-    acc = g2_infinity()
+    points = []
     for s in signatures:
         p = _load_sig(s)
         if p is None:
             raise ValueError("invalid signature in aggregate")
-        acc = acc + p
-    return g2_to_bytes(acc)
+        points.append(p)
+    return g2_to_bytes(_sum_g2(points))
 
 
 def aggregate_pks(pubkeys: list[bytes]) -> bytes:
     if len(pubkeys) == 0:
         raise ValueError("cannot aggregate zero pubkeys")
-    acc = g1_infinity()
+    points = []
     for pk in pubkeys:
         p = _load_pk(pk)
         if p is None:
             raise ValueError("invalid pubkey in aggregate")
-        acc = acc + p
-    return g1_to_bytes(acc)
+        points.append(p)
+    return g1_to_bytes(_sum_g1(points))
 
 
 def aggregate_verify(pks: list[bytes], messages: list[bytes], sig_bytes: bytes) -> bool:
@@ -115,12 +181,13 @@ def aggregate_verify(pks: list[bytes], messages: list[bytes], sig_bytes: bytes) 
 def fast_aggregate_verify(pks: list[bytes], message: bytes, sig_bytes: bytes) -> bool:
     if len(pks) == 0:
         return False
-    acc = g1_infinity()
+    points = []
     for pk_b in pks:
         pk = _load_pk(pk_b)
         if pk is None:
             return False
-        acc = acc + pk
+        points.append(pk)
+    acc = _sum_g1(points)
     sig = _load_sig(sig_bytes)
     if sig is None:
         return False

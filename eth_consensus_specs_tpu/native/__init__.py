@@ -21,16 +21,17 @@ _lib: ctypes.CDLL | None = None
 _tried = False
 
 
-def _compile() -> bool:
+def _compile_shared(src: str, out: str, opt: str = "-O2", timeout: int = 120) -> bool:
+    """Compile a .c source into a shared object. Links to a per-process
+    temp name, then atomically renames: concurrent first-use compilations
+    (pytest-xdist, parallel imports) must never let a reader dlopen a
+    partially written object."""
     cc = os.environ.get("CC") or sysconfig.get_config_var("CC") or "cc"
-    # link to a per-process temp name, then atomically rename: concurrent
-    # first-use compilations (pytest-xdist, parallel imports) must never
-    # let a reader dlopen a partially written object
-    tmp = f"{_LIB}.{os.getpid()}.tmp"
-    cmd = cc.split() + ["-O2", "-fPIC", "-shared", "-o", tmp, _SRC]
+    tmp = f"{out}.{os.getpid()}.tmp"
+    cmd = cc.split() + [opt, "-fPIC", "-shared", "-o", tmp, src]
     try:
-        subprocess.run(cmd, check=True, capture_output=True, timeout=120)
-        os.replace(tmp, _LIB)
+        subprocess.run(cmd, check=True, capture_output=True, timeout=timeout)
+        os.replace(tmp, out)
         return True
     except (OSError, subprocess.SubprocessError):
         try:
@@ -38,6 +39,10 @@ def _compile() -> bool:
         except OSError:
             pass
         return False
+
+
+def _compile() -> bool:
+    return _compile_shared(_SRC, _LIB)
 
 
 def get_lib() -> ctypes.CDLL | None:
@@ -89,3 +94,70 @@ def sha256_pairs(data: bytes) -> bytes:
     out = (ctypes.c_uint8 * (32 * n))()
     lib.sha256_pairs(_buf(data), out, n)
     return bytes(out)
+
+
+# --- BLS12-381 native core (bls12_381.c) -----------------------------------
+
+_BLS_SRC = os.path.join(_DIR, "bls12_381.c")
+_BLS_LIB_PATH = os.path.join(_DIR, "_bls12_381.so")
+
+_bls_lib: ctypes.CDLL | None = None
+_bls_tried = False
+
+
+def _compile_bls() -> bool:
+    return _compile_shared(_BLS_SRC, _BLS_LIB_PATH, opt="-O3", timeout=300)
+
+
+def get_bls_lib() -> ctypes.CDLL | None:
+    """The native BLS12-381 library, or None when unavailable/disabled."""
+    global _bls_lib, _bls_tried
+    if _bls_lib is not None or _bls_tried:
+        return _bls_lib
+    _bls_tried = True
+    if os.environ.get("ETH_SPECS_TPU_NO_NATIVE"):
+        return None
+    hdr = os.path.join(_DIR, "bls12_381_consts.h")
+    newest_src = max(os.path.getmtime(_BLS_SRC), os.path.getmtime(hdr))
+    if not os.path.exists(_BLS_LIB_PATH) or os.path.getmtime(_BLS_LIB_PATH) < newest_src:
+        if not _compile_bls():
+            return None
+    try:
+        lib = ctypes.CDLL(_BLS_LIB_PATH)
+    except OSError:
+        return None
+    u8p = ctypes.POINTER(ctypes.c_uint8)
+    c = ctypes
+    lib.bls_selftest.restype = c.c_int
+    lib.bls_g1_mul.argtypes = [u8p, c.c_uint8, u8p, u8p, u8p]
+    lib.bls_g2_mul.argtypes = [u8p, c.c_uint8, u8p, u8p, u8p]
+    lib.bls_g1_mul_wide.argtypes = [u8p, c.c_uint8, u8p, c.c_uint64, u8p, u8p]
+    lib.bls_g2_mul_wide.argtypes = [u8p, c.c_uint8, u8p, c.c_uint64, u8p, u8p]
+    lib.bls_g1_aggregate.argtypes = [c.c_uint64, u8p, u8p, u8p, u8p]
+    lib.bls_g2_aggregate.argtypes = [c.c_uint64, u8p, u8p, u8p, u8p]
+    lib.bls_g1_msm.argtypes = [c.c_uint64, u8p, u8p, u8p, u8p, u8p]
+    lib.bls_g2_msm.argtypes = [c.c_uint64, u8p, u8p, u8p, u8p, u8p]
+    lib.bls_g1_in_subgroup.argtypes = [u8p]
+    lib.bls_g1_in_subgroup.restype = c.c_int
+    lib.bls_g2_in_subgroup.argtypes = [u8p]
+    lib.bls_g2_in_subgroup.restype = c.c_int
+    lib.bls_g2_clear_cofactor.argtypes = [u8p, u8p, u8p]
+    lib.bls_g1_on_curve.argtypes = [u8p]
+    lib.bls_g1_on_curve.restype = c.c_int
+    lib.bls_g2_on_curve.argtypes = [u8p]
+    lib.bls_g2_on_curve.restype = c.c_int
+    lib.bls_pairing_check.argtypes = [c.c_uint64, u8p, u8p, u8p]
+    lib.bls_pairing_check.restype = c.c_int
+    lib.bls_pairing.argtypes = [u8p, u8p, u8p]
+    lib.bls_fp_sqrt.argtypes = [u8p, u8p]
+    lib.bls_fp_sqrt.restype = c.c_int
+    lib.bls_fp2_sqrt.argtypes = [u8p, u8p]
+    lib.bls_fp2_sqrt.restype = c.c_int
+    lib.bls_fp_inv.argtypes = [u8p, u8p]
+    lib.bls_fp_inv.restype = c.c_int
+    lib.bls_fp2_inv.argtypes = [u8p, u8p]
+    lib.bls_fp2_inv.restype = c.c_int
+    if lib.bls_selftest() != 0:
+        return None
+    _bls_lib = lib
+    return _bls_lib

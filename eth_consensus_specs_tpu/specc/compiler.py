@@ -1,0 +1,271 @@
+"""Fork composition + module assembly for compiled reference specs.
+
+Mirrors the reference pipeline (pysetup/generate_specs.py:95-135):
+
+  collect docs of the fork lineage -> merge objects (later fork wins) ->
+  substitute preset/config values -> emit one executable namespace.
+
+Differences by design: composition happens on parsed dicts instead of
+emitted source; classes are exec'd once, topologically sorted, at the end
+(the reference's dependency_order_class_objects fixpoint,
+pysetup/helpers.py:310-338), so every container's fields bind to the
+final version of their types; functions are exec'd with deferred
+annotations so excluded layers (fork-choice stores, validator duties)
+never produce import-time NameErrors.
+"""
+
+from __future__ import annotations
+
+import ast
+import json
+import os
+import types
+from functools import lru_cache
+
+from eth_consensus_specs_tpu.config import load_config, load_preset
+
+from .parser import ParsedDoc, parse_doc
+from .preamble import build_namespace
+
+REFERENCE_SPECS = os.environ.get("ETH_SPECS_REFERENCE", "/root/reference")
+
+# Fork lineage and the per-fork document sets compiled into the oracle.
+# beacon-chain + fork (upgrade) + the crypto documents containers depend
+# on; fork-choice/validator/p2p/light-client are out of the v1 oracle
+# scope (reference doc map: pysetup/md_doc_paths.py:78-96).
+CHAIN = ["phase0", "altair", "bellatrix", "capella", "deneb", "electra"]
+DOC_SETS: dict[str, list[str]] = {
+    "phase0": ["beacon-chain.md"],
+    "altair": ["beacon-chain.md", "bls.md", "fork.md"],
+    "bellatrix": ["beacon-chain.md", "fork.md"],
+    "capella": ["beacon-chain.md", "fork.md"],
+    "deneb": ["polynomial-commitments.md", "beacon-chain.md", "fork.md"],
+    "electra": ["beacon-chain.md", "fork.md"],
+}
+
+_FUTURE = "from __future__ import annotations\n"
+
+
+def compiled_forks() -> list[str]:
+    return list(CHAIN)
+
+
+def _doc_paths(fork: str) -> list[str]:
+    base = os.path.join(REFERENCE_SPECS, "specs", fork)
+    return [os.path.join(base, name) for name in DOC_SETS[fork]]
+
+
+def _coerce(default, raw):
+    """Coerce a preset/config value onto the type the markdown expression
+    evaluates to (the reference substitutes yaml text at build time,
+    pysetup/md_to_spec.py preset handling)."""
+    if default is None:
+        return raw
+    cls = type(default)
+    try:
+        if isinstance(default, bytes):
+            if isinstance(raw, bytes):
+                return cls(raw)
+            if isinstance(raw, str) and raw.startswith("0x"):
+                return cls(bytes.fromhex(raw[2:]))
+            return cls(raw)
+        if isinstance(default, bool):
+            return bool(raw)
+        if isinstance(default, int):
+            return cls(int(raw))
+    except Exception:
+        return raw
+    return raw
+
+
+def _class_deps(name: str, code: str, universe: set[str]) -> set[str]:
+    deps: set[str] = set()
+    try:
+        tree = ast.parse(code)
+    except SyntaxError:
+        return deps
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Name) and node.id in universe and node.id != name:
+            deps.add(node.id)
+    return deps
+
+
+def _topo_classes(classes: dict[str, str], order: dict[str, int]) -> list[str]:
+    universe = set(classes)
+    deps = {n: _class_deps(n, c, universe) for n, c in classes.items()}
+    placed: list[str] = []
+    done: set[str] = set()
+    pending = sorted(classes, key=lambda n: order[n])
+    while pending:
+        progressed = False
+        remaining = []
+        for n in pending:
+            if deps[n] <= done:
+                placed.append(n)
+                done.add(n)
+                progressed = True
+            else:
+                remaining.append(n)
+        if not progressed:
+            # cycle (mutually recursive annotations) — fall back to
+            # encounter order for the rest
+            placed.extend(remaining)
+            break
+        pending = remaining
+    return placed
+
+
+def _load_trusted_setup(preset_name: str) -> dict:
+    """The reference inlines the ceremony trusted setup into generated
+    modules (pysetup/md_to_spec.py:521-563); load the same JSON artifact
+    from the mounted reference tree."""
+    path = os.path.join(
+        REFERENCE_SPECS, "presets", preset_name, "trusted_setups", "trusted_setup_4096.json"
+    )
+    if not os.path.exists(path):
+        return {}
+    with open(path) as fh:
+        data = json.load(fh)
+    out = {}
+    from eth_consensus_specs_tpu import ssz
+
+    def _pts(key, cls):
+        vals = data.get(key)
+        if vals is None:
+            return None
+        return tuple(cls(bytes.fromhex(v[2:] if v.startswith("0x") else v)) for v in vals)
+
+    g1m = _pts("g1_monomial", ssz.Bytes48)
+    g1l = _pts("g1_lagrange", ssz.Bytes48)
+    g2m = _pts("g2_monomial", ssz.Bytes96)
+    if g1m:
+        out["KZG_SETUP_G1_MONOMIAL"] = g1m
+    if g1l:
+        out["KZG_SETUP_G1_LAGRANGE"] = g1l
+    if g2m:
+        out["KZG_SETUP_G2_MONOMIAL"] = g2m
+    return out
+
+
+class CompileReport:
+    """What the compiler skipped — surfaced so parity tests can assert the
+    skip list stays small and name-addressed."""
+
+    def __init__(self):
+        self.skipped_constants: list[tuple[str, str, str]] = []
+        self.skipped_types: list[tuple[str, str, str]] = []
+        self.protocol_methods: list[str] = []
+
+
+@lru_cache(maxsize=None)
+def compile_fork(
+    fork: str, preset_name: str = "minimal", config_name: str | None = None
+) -> types.ModuleType:
+    """Compile the reference markdown lineage of `fork` into an executable
+    module bound to this framework's runtime."""
+    if fork not in CHAIN:
+        raise ValueError(f"fork {fork!r} not in compiled lineage {CHAIN}")
+    lineage = CHAIN[: CHAIN.index(fork) + 1]
+
+    preset = load_preset(preset_name, fork)
+    config = load_config(config_name if config_name is not None else preset_name)
+    preset_vals = dict(preset.items()) if hasattr(preset, "items") else dict(vars(preset))
+    config_vals = dict(config.items()) if hasattr(config, "items") else dict(vars(config))
+
+    mod = types.ModuleType(f"ref_spec_{fork}_{preset_name}")
+    ns = mod.__dict__
+    ns.update(build_namespace())
+    report = CompileReport()
+    ns["__specc_report__"] = report
+    ns["fork"] = fork
+
+    docs: list[ParsedDoc] = []
+    for f in lineage:
+        for path in _doc_paths(f):
+            if os.path.exists(path):
+                docs.append(parse_doc(path))
+
+    # pass 1: custom types + constants in document order (later forks
+    # override by re-evaluating the same name).  Definitions whose value
+    # expression references a not-yet-defined name (custom types placed
+    # before the preset table that sizes them, e.g. bellatrix's
+    # Transaction = ByteList[MAX_BYTES_PER_TRANSACTION]) are deferred and
+    # retried to a fixpoint — the reference gets the same effect from its
+    # class dependency-ordering fixpoint (pysetup/helpers.py:310-338).
+    def _apply_item(kind: str, name: str, expr: str) -> str | None:
+        """Returns None on success, else the failure reason."""
+        if kind == "ctype":
+            try:
+                base = eval(expr, ns)  # noqa: S307 - spec text, trusted input set
+            except Exception as e:
+                return str(e)
+            ns[name] = types.new_class(name, (base,), {}) if isinstance(base, type) else base
+            return None
+        default = None
+        try:
+            default = eval(expr, ns)  # noqa: S307
+        except Exception as e:
+            if name not in preset_vals and name not in config_vals:
+                return str(e)
+        if name in preset_vals:
+            ns[name] = _coerce(default, preset_vals[name])
+        elif name in config_vals:
+            ns[name] = _coerce(default, config_vals[name])
+        else:
+            ns[name] = default
+        return None
+
+    pending: list[tuple[str, str, str]] = []
+    for doc in docs:
+        for kind, name, expr in doc.table_items:
+            if _apply_item(kind, name, expr) is not None:
+                pending.append((kind, name, expr))
+    while pending:
+        still: list[tuple[str, str, str]] = []
+        reasons: dict[tuple[str, str], str] = {}
+        for kind, name, expr in pending:
+            reason = _apply_item(kind, name, expr)
+            if reason is not None:
+                still.append((kind, name, expr))
+                reasons[(kind, name)] = reason
+        if len(still) == len(pending):
+            for kind, name, expr in still:
+                target = report.skipped_types if kind == "ctype" else report.skipped_constants
+                target.append((name, expr, reasons[(kind, name)]))
+            break
+        pending = still
+
+    # trusted setup globals (deneb+ polynomial commitments)
+    if "deneb" in lineage:
+        ns.update(_load_trusted_setup(preset_name))
+
+    # pass 2: classes — override by name across the lineage, then one
+    # topologically-ordered exec
+    classes: dict[str, str] = {}
+    order: dict[str, int] = {}
+    counter = 0
+    for doc in docs:
+        for name, code in doc.classes.items():
+            if name not in order:
+                order[name] = counter
+                counter += 1
+            classes[name] = code
+    for name in _topo_classes(classes, order):
+        # dont_inherit: this module's own `from __future__ import
+        # annotations` must NOT leak into spec class bodies — container
+        # fields need eagerly-evaluated type annotations
+        exec(compile(classes[name], f"<spec:{name}>", "exec", dont_inherit=True), ns)  # noqa: S102
+
+    # pass 3: functions (late-bound globals; deferred annotations)
+    functions: dict[str, str] = {}
+    for doc in docs:
+        functions.update(doc.functions)
+        report.protocol_methods.extend(doc.protocol_methods)
+    for name, code in functions.items():
+        exec(  # noqa: S102
+            compile(_FUTURE + code, f"<spec:{name}>", "exec", dont_inherit=True), ns
+        )
+
+    ns["preset"] = preset
+    ns["config"] = config
+    return mod

@@ -1,0 +1,120 @@
+"""Markdown spec-document parser.
+
+Extracts the executable payload of a reference spec document — fenced
+``python`` blocks and definition tables — with a line scanner (the
+reference walks a marko AST instead: pysetup/md_to_spec.py:60-120).
+
+Classification rules:
+
+* fenced block starting ``def name(`` — a spec function; if its first
+  parameter is ``self`` it is a protocol method (reference collects these
+  into protocol classes, md_to_spec.py "protocols" bucket) and is recorded
+  separately,
+* fenced block whose last decorator-free line starts ``class name(`` — an
+  SSZ container / dataclass / protocol class,
+* table row ``| `NAME` | `value` |`` with an ALL_CAPS name — a constant
+  (preset/config membership decided later against the framework's own
+  loaders),
+* table row with a CamelCase name whose value cell is a type expression —
+  a custom type alias (``Slot`` -> ``uint64``; reference:
+  specs/phase0/beacon-chain.md "Custom types").
+"""
+
+from __future__ import annotations
+
+import re
+from dataclasses import dataclass, field
+
+
+@dataclass
+class ParsedDoc:
+    path: str
+    functions: dict[str, str] = field(default_factory=dict)
+    protocol_methods: dict[str, str] = field(default_factory=dict)
+    classes: dict[str, str] = field(default_factory=dict)
+    constants: list[tuple[str, str]] = field(default_factory=list)
+    custom_types: list[tuple[str, str]] = field(default_factory=list)
+    # unified document-order stream of table definitions:
+    # ("const" | "ctype", name, value-expression)
+    table_items: list[tuple[str, str, str]] = field(default_factory=list)
+
+
+_CONST_NAME = re.compile(r"^[A-Z][A-Z0-9_]*$")
+_TYPE_NAME = re.compile(r"^[A-Z][A-Za-z0-9]*$")
+# a type-alias value cell: identifier, optionally subscripted (uint64,
+# Bytes32, ByteList[MAX_BYTES_PER_TRANSACTION], ...)
+_TYPE_VALUE = re.compile(r"^[A-Za-z_][A-Za-z0-9_]*(\[.*\])?$")
+_DEF_RE = re.compile(r"^def\s+([A-Za-z_][A-Za-z0-9_]*)\s*\(\s*([A-Za-z_][A-Za-z0-9_]*)?")
+_CLASS_RE = re.compile(r"^class\s+([A-Za-z_][A-Za-z0-9_]*)\s*[(:]")
+_BACKTICK = re.compile(r"`([^`]+)`")
+
+
+def _classify_block(code: str, doc: ParsedDoc) -> None:
+    lines = code.strip().splitlines()
+    if not lines:
+        return
+    first_code = 0
+    while first_code < len(lines) and lines[first_code].lstrip().startswith("@"):
+        first_code += 1
+    if first_code >= len(lines):
+        return
+    head = lines[first_code]
+    m = _CLASS_RE.match(head)
+    if m:
+        doc.classes[m.group(1)] = code
+        return
+    m = _DEF_RE.match(head)
+    if m:
+        name, first_arg = m.group(1), m.group(2)
+        if first_arg == "self":
+            doc.protocol_methods[name] = code
+        else:
+            doc.functions[name] = code
+        return
+    # module-level assignment blocks (rare; e.g. trusted-setup injection
+    # markers) — ignored; the preamble provides runtime globals.
+
+
+def _cells(row: str) -> list[str]:
+    parts = row.strip().strip("|").split("|")
+    return [p.strip() for p in parts]
+
+
+def _first_backtick(cell: str) -> str | None:
+    m = _BACKTICK.search(cell)
+    return m.group(1) if m else None
+
+
+def parse_doc(path: str) -> ParsedDoc:
+    doc = ParsedDoc(path=path)
+    with open(path, encoding="utf-8") as fh:
+        lines = fh.read().splitlines()
+    i = 0
+    n = len(lines)
+    while i < n:
+        line = lines[i]
+        if line.strip().startswith("```python"):
+            j = i + 1
+            block: list[str] = []
+            while j < n and not lines[j].strip().startswith("```"):
+                block.append(lines[j])
+                j += 1
+            _classify_block("\n".join(block), doc)
+            i = j + 1
+            continue
+        if line.lstrip().startswith("|"):
+            cells = _cells(line)
+            if len(cells) >= 2:
+                name = _first_backtick(cells[0])
+                value = _first_backtick(cells[1])
+                if name and value and not set(name) <= set("-: "):
+                    if _CONST_NAME.match(name):
+                        doc.constants.append((name, value))
+                        doc.table_items.append(("const", name, value))
+                    elif _TYPE_NAME.match(name) and _TYPE_VALUE.match(value):
+                        doc.custom_types.append((name, value))
+                        doc.table_items.append(("ctype", name, value))
+            i += 1
+            continue
+        i += 1
+    return doc

@@ -1,0 +1,118 @@
+"""Runtime environment for compiled reference-spec modules.
+
+The reference's generated modules import only the L2 runtime layer
+(reference: pysetup/spec_builders/phase0.py:20-26 — bls, hash,
+hash_tree_root/serialize, SSZ types, copy, uint_to_bytes) plus builder-
+injected "sundry functions" (floorlog2/ceillog2, the Noop execution
+engine, deneb.py:46-79).  This module assembles the same surface from this
+framework's first-party runtime.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Dict, NamedTuple, Optional, Protocol, Sequence, Set, Tuple
+
+from eth_consensus_specs_tpu import ssz
+from eth_consensus_specs_tpu.ssz.hashing import hash_bytes
+from eth_consensus_specs_tpu.utils import bls
+
+
+def floorlog2(x: int) -> ssz.uint64:
+    if x < 1:
+        raise ValueError(f"floorlog2 accepts only positive values, x={x}")
+    return ssz.uint64(int(x).bit_length() - 1)
+
+
+def ceillog2(x: int) -> ssz.uint64:
+    if x < 1:
+        raise ValueError(f"ceillog2 accepts only positive values, x={x}")
+    return ssz.uint64((int(x) - 1).bit_length())
+
+
+def _copy(v):
+    return v.copy() if hasattr(v, "copy") else v
+
+
+class _NoopExecutionEngine:
+    """Behavioral match of the reference's NoopExecutionEngine
+    (pysetup/spec_builders/deneb.py:46-79): every verification answers
+    True, payload building is unsupported."""
+
+    def notify_new_payload(self, *args, **kwargs) -> bool:
+        return True
+
+    def notify_forkchoice_updated(self, *args, **kwargs):
+        return None
+
+    def get_payload(self, payload_id):
+        raise NotImplementedError("no payload building in the noop engine")
+
+    def is_valid_block_hash(self, *args, **kwargs) -> bool:
+        return True
+
+    def is_valid_versioned_hashes(self, *args, **kwargs) -> bool:
+        return True
+
+    def verify_and_notify_new_payload(self, new_payload_request) -> bool:
+        return True
+
+
+def build_namespace() -> dict:
+    """Base globals for a compiled spec module (types + runtime verbs)."""
+    ns: dict[str, Any] = {
+        # typing surface used by spec code
+        "Any": Any,
+        "Dict": Dict,
+        "Optional": Optional,
+        "Sequence": Sequence,
+        "Set": Set,
+        "Tuple": Tuple,
+        "NamedTuple": NamedTuple,
+        "Protocol": Protocol,
+        "dataclass": dataclass,
+        "field": field,
+        # SSZ type system (first-party remerkleable-compatible surface)
+        "boolean": ssz.boolean,
+        "bit": ssz.bit,
+        "uint8": ssz.uint8,
+        "uint16": ssz.uint16,
+        "uint32": ssz.uint32,
+        "uint64": ssz.uint64,
+        "uint128": ssz.uint128,
+        "uint256": ssz.uint256,
+        "byte": ssz.byte,
+        "Bytes1": ssz.Bytes1,
+        "Bytes4": ssz.Bytes4,
+        "Bytes8": ssz.Bytes8,
+        "Bytes20": ssz.Bytes20,
+        "Bytes31": ssz.Bytes31,
+        "Bytes32": ssz.Bytes32,
+        "Bytes48": ssz.Bytes48,
+        "Bytes96": ssz.Bytes96,
+        "ByteList": ssz.ByteList,
+        "ByteVector": ssz.ByteVector,
+        "Bitlist": ssz.Bitlist,
+        "Bitvector": ssz.Bitvector,
+        "List": ssz.List,
+        "Vector": ssz.Vector,
+        "Container": ssz.Container,
+        "Union": ssz.Union,
+        "ProgressiveList": ssz.ProgressiveList,
+        "ProgressiveBitlist": ssz.ProgressiveBitlist,
+        "ProgressiveContainer": ssz.ProgressiveContainer,
+        "ProgressiveByteList": ssz.ProgressiveByteList,
+        # runtime verbs (reference L2 layer)
+        "bls": bls,
+        "hash": lambda data: ssz.Bytes32(hash_bytes(bytes(data))),
+        "hash_tree_root": ssz.hash_tree_root,
+        "serialize": ssz.serialize,
+        "uint_to_bytes": ssz.uint_to_bytes,
+        "copy": _copy,
+        "floorlog2": floorlog2,
+        "ceillog2": ceillog2,
+        # execution engine seam (bellatrix+)
+        "EXECUTION_ENGINE": _NoopExecutionEngine(),
+        "NoopExecutionEngine": _NoopExecutionEngine,
+    }
+    return ns

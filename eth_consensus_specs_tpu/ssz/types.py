@@ -268,8 +268,13 @@ class ByteVector(bytes, View):
             value = bytes.fromhex(value[2:] if value.startswith("0x") else value)
         elif isinstance(value, (list, tuple)):
             value = bytes(value)
-        elif isinstance(value, np.ndarray):
-            value = value.tobytes()
+        elif isinstance(value, (int, bool)):
+            # bytes(n) would silently mean n zero bytes — always a bug here
+            raise TypeError(f"cannot coerce {type(value).__name__} to {cls.__name__}")
+        elif not isinstance(value, (bytes, bytearray, memoryview)):
+            # generators/iterables: spec code builds roots like
+            # Bytes32(a ^ b for a, b in zip(x, y)) (phase0 `xor`)
+            value = bytes(value)
         if len(value) != cls.LENGTH:
             raise ValueError(f"{cls.__name__}: expected {cls.LENGTH} bytes, got {len(value)}")
         return super().__new__(cls, value)
@@ -330,6 +335,10 @@ class ByteList(bytes, View):
         if isinstance(value, str):
             value = bytes.fromhex(value[2:] if value.startswith("0x") else value)
         elif isinstance(value, (list, tuple)):
+            value = bytes(value)
+        elif isinstance(value, (int, bool)):
+            raise TypeError(f"cannot coerce {type(value).__name__} to {cls.__name__}")
+        elif not isinstance(value, (bytes, bytearray, memoryview)):
             value = bytes(value)
         if len(value) > cls.LIMIT:
             raise ValueError(f"{cls.__name__}: {len(value)} bytes exceeds limit {cls.LIMIT}")
@@ -439,6 +448,14 @@ class Bitvector(View):
         return self._bits[i]
 
     def __setitem__(self, i, v):
+        if isinstance(i, slice):
+            # spec code shifts justification bits with slice assignment
+            # (specs/phase0/beacon-chain.md weigh_justification_and_finalization)
+            vals = [bool(b) for b in v]
+            if len(range(*i.indices(self.LENGTH))) != len(vals):
+                raise ValueError("Bitvector slice assignment must preserve length")
+            self._bits[i] = vals
+            return
         self._bits[i] = bool(v)
 
     def __eq__(self, other):
@@ -512,6 +529,12 @@ class Bitlist(View):
         return self._bits[i]
 
     def __setitem__(self, i, v):
+        if isinstance(i, slice):
+            vals = [bool(b) for b in v]
+            if len(range(*i.indices(len(self._bits)))) != len(vals):
+                raise ValueError("Bitlist slice assignment must preserve length")
+            self._bits[i] = vals
+            return
         self._bits[i] = bool(v)
 
     def append(self, v):
@@ -615,16 +638,28 @@ class _Sequence(View):
         self._root_cache = None
 
     def __eq__(self, other):
-        return (
-            other.__class__ is self.__class__
-            and other._items == self._items
-        )
+        if other.__class__ is self.__class__:
+            return other._items == self._items
+        if isinstance(other, (list, tuple)):
+            # plain-sequence equality is part of the remerkleable-compatible
+            # surface: spec code compares lists to `sorted(...)` results
+            # (e.g. is_valid_indexed_attestation,
+            # specs/phase0/beacon-chain.md:776-792)
+            return len(other) == len(self._items) and all(
+                a == b for a, b in zip(self._items, other)
+            )
+        return NotImplemented
 
     def __hash__(self):
         return hash(tuple(self._items))
 
     def index(self, v):
         return self._items.index(self.ELEMENT_TYPE.coerce_view(v))
+
+    def count(self, v):
+        # list-protocol count (spec: eth1_data_votes.count(body.eth1_data),
+        # specs/phase0/beacon-chain.md process_eth1_data)
+        return sum(1 for item in self._items if item == v)
 
     def __contains__(self, v):
         try:

@@ -134,10 +134,36 @@ def neg(p):
 
 
 def multi_exp(points: list, scalars: list):
-    """Sum of scalar*point (host reference MSM; the batched device MSM lives
-    in ops/bls_batch)."""
+    """Sum of scalar*point (native Pippenger MSM when available; the batched
+    device MSM lives in ops/bls_batch)."""
     if len(points) == 0 or len(points) != len(scalars):
         raise ValueError("multi_exp: mismatched inputs")
+    from eth_consensus_specs_tpu.crypto import native_bridge as nb
+    from eth_consensus_specs_tpu.crypto.fields import Fq, Fq2
+
+    if nb.enabled():
+        reduced = [int(s) % CURVE_ORDER for s in scalars]
+        if all(p.is_infinity() or isinstance(p.x, Fq) for p in points):
+            raw = nb.g1_msm(
+                [None if p.is_infinity() else (p.x.n, p.y.n) for p in points], reduced
+            )
+            if raw is None:
+                return _curve.g1_infinity()
+            return _curve.Point(Fq(raw[0]), Fq(raw[1]), _curve.B1)
+        if all(p.is_infinity() or isinstance(p.x, Fq2) for p in points):
+            raw = nb.g2_msm(
+                [
+                    None
+                    if p.is_infinity()
+                    else ((p.x.c0.n, p.x.c1.n), (p.y.c0.n, p.y.c1.n))
+                    for p in points
+                ],
+                reduced,
+            )
+            if raw is None:
+                return _curve.g2_infinity()
+            (x0, x1), (y0, y1) = raw
+            return _curve.Point(Fq2(Fq(x0), Fq(x1)), Fq2(Fq(y0), Fq(y1)), _curve.B2)
     acc = None
     for p, s in zip(points, scalars):
         term = p.mul(int(s))

