@@ -18,7 +18,13 @@ install:
 	$(PYTHON) -m pip install -e .[test]
 
 test:
+	$(PYTHON) -m pytest tests/ -q -p xdist -n auto
+
+test-serial:
 	$(PYTHON) -m pytest tests/ -q
+
+parity:
+	$(PYTHON) -m pytest tests/parity/ -q
 
 test-fast:
 	$(PYTHON) -m pytest tests/ -q --ignore=tests/phase0/test_fork_choice.py

@@ -133,77 +133,33 @@ def bench_device_resident_epochs(
 ) -> tuple[float, float]:
     """The BASELINE.json stepping stone: accounting epoch + balance-column
     SSZ subtree root at ~1M validators, state DEVICE-RESIDENT across
-    epochs — one jitted fori_loop carries the columns epoch to epoch with
-    zero host transfers (no per-epoch extraction). Returns
+    epochs through the PUBLIC framework API (parallel/resident.py
+    run_epochs — not bench-local code).  Chained-dependency by
+    construction: each epoch consumes the previous epoch's balances and
+    the per-epoch root xor-chains into the carry.  Returns
     (seconds_per_epoch_with_root, seconds_total)."""
     import jax
     import jax.numpy as jnp
-    from jax import lax
 
     import __graft_entry__ as graft
     from eth_consensus_specs_tpu.forks import get_spec
-    from eth_consensus_specs_tpu.ops.altair_epoch import (
-        AltairEpochParams,
-        altair_epoch_accounting_impl,
-    )
-    from eth_consensus_specs_tpu.ops.merkle import tree_root_words
+    from eth_consensus_specs_tpu.parallel import resident
 
     spec = get_spec("deneb", "mainnet")
-    params = AltairEpochParams.from_spec(spec)
     cols, just = graft._example_altair_inputs(n_validators)
     cols = jax.device_put(cols)
     just = jax.device_put(just)
 
-    # balances column as SSZ chunk words: u64[N] -> (N/4) 32-byte chunks,
-    # big-endian u32 words of the little-endian u64 byte stream
-    assert n_validators % 4 == 0
-    depth = (n_validators // 4 - 1).bit_length()
-
-    def balance_leaves(bal):
-        w = jax.lax.bitcast_convert_type(bal, jnp.uint32)  # (N, 2) LE words
-        w = w.reshape(n_validators // 4, 8)
-        # byteswap each u32: LE u64 bytes -> BE u32 message words
-        return (
-            ((w & 0xFF) << 24)
-            | ((w & 0xFF00) << 8)
-            | ((w >> 8) & 0xFF00)
-            | ((w >> 24) & 0xFF)
-        )
-
-    @jax.jit
-    def run(cols, just):
-        def body(_, carry):
-            cols, just, acc = carry
-            res = altair_epoch_accounting_impl(params, cols, just)
-            cols = cols._replace(
-                balance=res.balance,
-                effective_balance=res.effective_balance,
-                inactivity_scores=res.inactivity_scores,
-            )
-            just = just._replace(
-                current_epoch=just.current_epoch + jnp.uint64(1),
-                justification_bits=res.justification_bits,
-                prev_justified_epoch=res.prev_justified_epoch,
-                prev_justified_root=res.prev_justified_root,
-                cur_justified_epoch=res.cur_justified_epoch,
-                cur_justified_root=res.cur_justified_root,
-                finalized_epoch=res.finalized_epoch,
-                finalized_root=res.finalized_root,
-            )
-            root = tree_root_words(balance_leaves(cols.balance), depth)
-            return cols, just, acc ^ root
-
-        cols, just, acc = lax.fori_loop(0, epochs, body, (cols, just, jnp.zeros(8, jnp.uint32)))
-        return cols.balance, acc
-
     salt_fn = jax.jit(lambda c, s: c._replace(balance=c.balance + s))
-    jax.block_until_ready(run(cols, just))  # compile + warm
+    jax.block_until_ready(
+        resident.run_epochs(spec, cols, just, epochs).root_acc
+    )  # compile + warm
     best = float("inf")
     for i in range(3):
         fresh = salt_fn(cols, jnp.uint64(i + 1))  # defeat result caching
         jax.block_until_ready(fresh)
         t0 = time.perf_counter()
-        jax.block_until_ready(run(fresh, just))
+        jax.block_until_ready(resident.run_epochs(spec, fresh, just, epochs).root_acc)
         best = min(best, time.perf_counter() - t0)
     return best / epochs, best
 

@@ -82,6 +82,32 @@ class AltairSpec(LightClientMixin, Phase0Spec):
     def PARTICIPATION_FLAG_WEIGHTS(self):
         return [self.TIMELY_SOURCE_WEIGHT, self.TIMELY_TARGET_WEIGHT, self.TIMELY_HEAD_WEIGHT]
 
+    # == networking helpers ================================================
+
+    def compute_sync_committee_period(self, epoch: int) -> int:
+        return int(epoch) // self.EPOCHS_PER_SYNC_COMMITTEE_PERIOD
+
+    def compute_subnets_for_sync_committee(self, state, validator_index: int) -> set:
+        """Sync-committee gossip subnets for a validator (reference:
+        specs/altair/validator.md:378-397)."""
+        next_slot_epoch = self.compute_epoch_at_slot(int(state.slot) + 1)
+        if self.compute_sync_committee_period(
+            self.get_current_epoch(state)
+        ) == self.compute_sync_committee_period(next_slot_epoch):
+            sync_committee = state.current_sync_committee
+        else:
+            sync_committee = state.next_sync_committee
+        target_pubkey = state.validators[validator_index].pubkey
+        sync_committee_indices = [
+            index
+            for index, pubkey in enumerate(sync_committee.pubkeys)
+            if pubkey == target_pubkey
+        ]
+        return {
+            index // (self.SYNC_COMMITTEE_SIZE // self.SYNC_COMMITTEE_SUBNET_COUNT)
+            for index in sync_committee_indices
+        }
+
     # == type system ======================================================
 
     def _build_types(self) -> None:
@@ -502,6 +528,19 @@ class AltairSpec(LightClientMixin, Phase0Spec):
     # == epoch processing ==================================================
 
     def process_epoch(self, state) -> None:
+        """DEFAULT spec path: the fused columnar epoch (device when an
+        accelerator is attached).  The per-validator object pipeline stays
+        available as process_epoch_object — it is the oracle the columnar
+        tests compare against — and takes over when
+        ETH_SPECS_TPU_OBJECT_EPOCH=1."""
+        import os
+
+        if os.environ.get("ETH_SPECS_TPU_OBJECT_EPOCH") == "1":
+            self.process_epoch_object(state)
+        else:
+            self.process_epoch_columnar(state)
+
+    def process_epoch_object(self, state) -> None:
         self.process_justification_and_finalization(state)
         self.process_inactivity_updates(state)
         self.process_rewards_and_penalties(state)

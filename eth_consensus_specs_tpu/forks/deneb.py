@@ -302,6 +302,10 @@ class DenebSpec(CapellaSpec):
 
     # == misc ==============================================================
 
+    def compute_subnet_for_blob_sidecar(self, blob_index: int) -> int:
+        """reference: specs/deneb/validator.md:197-199."""
+        return int(blob_index) % int(self.config.BLOB_SIDECAR_SUBNET_COUNT)
+
     def kzg_commitment_to_versioned_hash(self, kzg_commitment) -> bytes:
         return VersionedHash(
             self.VERSIONED_HASH_VERSION_KZG + self.hash(kzg_commitment)[1:]

@@ -500,6 +500,11 @@ class ElectraSpec(DenebSpec):
         state.earliest_consolidation_epoch = earliest_consolidation_epoch
         return int(state.earliest_consolidation_epoch)
 
+    def compute_subnet_for_blob_sidecar(self, blob_index: int) -> int:
+        """[Modified in Electra:EIP7691] reference:
+        specs/electra/validator.md:321-323."""
+        return int(blob_index) % int(self.config.BLOB_SIDECAR_SUBNET_COUNT_ELECTRA)
+
     # electra re-points both slashing quotients (beacon-chain.md:794-830)
     def min_slashing_penalty_quotient(self) -> int:
         return self.MIN_SLASHING_PENALTY_QUOTIENT_ELECTRA
@@ -510,16 +515,40 @@ class ElectraSpec(DenebSpec):
     # == epoch processing (specs/electra/beacon-chain.md:834-1072) =========
 
     def process_epoch_columnar(self, state) -> None:
-        """Electra interleaves the pending deposit/consolidation queues
-        BETWEEN the slashings sweep and the effective-balance update
-        (process_epoch below), an ordering the fused altair kernel cannot
-        honor in one device call (ops/altair_epoch.py module docstring).
-        Fall back to the object path for correctness; the raw kernel
-        already supports electra semantics (per-increment slashing,
-        MaxEB column) for the split fusion to build on."""
-        self.process_epoch(state)
+        """TWO-PHASE electra fusion (replaces round-2's object-path
+        fallback): phase A runs justification + inactivity + rewards +
+        the slashings sweep fused on device with the effective-balance
+        hysteresis EXCLUDED; the pending deposit/consolidation queues —
+        which the spec interleaves between slashings and the
+        effective-balance update (specs/electra/beacon-chain.md:943,1022)
+        and which touch O(queue) entries, not O(N) — run host-side in
+        exact spec order; the hysteresis then runs over the post-queue
+        balances.  Bit-exact vs process_epoch_object by the columnar
+        oracle tests."""
+        import jax
+        import numpy as np
 
-    def process_epoch(self, state) -> None:
+        from eth_consensus_specs_tpu.ops.altair_epoch import (
+            AltairEpochParams,
+            altair_epoch_accounting_phase_a,
+        )
+
+        cols, just = self.extract_epoch_columns(state)
+        res = altair_epoch_accounting_phase_a(
+            AltairEpochParams.from_spec(self), cols, just, include_effective_balance=False
+        )
+        res = jax.tree_util.tree_map(np.asarray, res)  # one device->host sync
+        self._writeback_justification(state, res)
+        self.process_registry_updates(state)  # [Modified in Electra:EIP7251]
+        self._writeback_balances(state, res, include_eff=False)
+        self._writeback_extra(state, res)  # inactivity scores
+        self.process_eth1_data_reset(state)
+        self.process_pending_deposits(state)  # [New in Electra:EIP7251]
+        self.process_pending_consolidations(state)  # [New in Electra:EIP7251]
+        self.process_effective_balance_updates(state)  # [Modified in Electra:EIP7251]
+        self._process_epoch_resets(state)
+
+    def process_epoch_object(self, state) -> None:
         self.process_justification_and_finalization(state)
         self.process_inactivity_updates(state)
         self.process_rewards_and_penalties(state)

@@ -21,11 +21,11 @@ RAW-KERNEL fork coverage: altair through electra+ semantics — the two
 quotient knobs enter via the spec's fork hooks, electra's per-increment
 slashing rounding via `electra_slashing`, and EIP-7251's per-validator
 MaxEB as an optional column. The SPEC-LEVEL columnar wrapper
-(`process_epoch_columnar`) remains altair→deneb: electra interleaves the
-pending deposit/consolidation queues BETWEEN the slashings sweep and the
-effective-balance update, which this fused kernel cannot honor without a
-split — electra's wrapper falls back to the object path (forks/electra.py)
-until the two-phase fusion lands.
+(`process_epoch_columnar`) covers altair→electra and IS the default
+process_epoch: altair→deneb run the full fusion; electra runs the
+TWO-PHASE split (`altair_epoch_accounting_phase_a` without the
+effective-balance step, host-side pending deposit/consolidation queues in
+spec order, hysteresis after — forks/electra.py process_epoch_columnar).
 
 Sequential balance application (reward_k then clamped penalty_k, k over
 src/tgt/head/inactivity) exactly mirrors the object path's delta-list
@@ -144,6 +144,7 @@ def altair_epoch_accounting_impl(
     cols: AltairEpochColumns,
     just: JustificationState,
     red: LocalReductions = _LOCAL,
+    include_effective_balance: bool = True,
 ) -> AltairEpochResult:
     p = params
     one = jnp.asarray(1, U64)
@@ -254,16 +255,22 @@ def altair_epoch_accounting_impl(
     bal = bal - jnp.minimum(bal, jnp.where(slash_now, slash_penalty, zero))
 
     # -- effective-balance hysteresis -------------------------------------
-    hyst = incr // jnp.asarray(p.hysteresis_quotient, U64)
-    down = hyst * jnp.asarray(p.hysteresis_downward_multiplier, U64)
-    up = hyst * jnp.asarray(p.hysteresis_upward_multiplier, U64)
-    crossed = (bal + down < eff) | (eff + up < bal)
-    eff_ceiling = (
-        cols.max_effective_balance
-        if cols.max_effective_balance is not None
-        else jnp.asarray(p.max_effective_balance, U64)
-    )
-    new_eff = jnp.where(crossed, jnp.minimum(bal - bal % incr, eff_ceiling), eff)
+    # electra's TWO-PHASE split runs this step host-side AFTER the pending
+    # deposit/consolidation queues (spec ordering,
+    # specs/electra/beacon-chain.md:943,1022) — phase A returns eff as-is
+    if include_effective_balance:
+        hyst = incr // jnp.asarray(p.hysteresis_quotient, U64)
+        down = hyst * jnp.asarray(p.hysteresis_downward_multiplier, U64)
+        up = hyst * jnp.asarray(p.hysteresis_upward_multiplier, U64)
+        crossed = (bal + down < eff) | (eff + up < bal)
+        eff_ceiling = (
+            cols.max_effective_balance
+            if cols.max_effective_balance is not None
+            else jnp.asarray(p.max_effective_balance, U64)
+        )
+        new_eff = jnp.where(crossed, jnp.minimum(bal - bal % incr, eff_ceiling), eff)
+    else:
+        new_eff = eff
 
     return AltairEpochResult(
         balance=bal,
@@ -280,3 +287,8 @@ def altair_epoch_accounting_impl(
 
 
 altair_epoch_accounting = partial(jax.jit, static_argnums=(0,))(altair_epoch_accounting_impl)
+# phase A of the electra two-phase fusion: accounting without the
+# effective-balance hysteresis (that runs after the host-side queues)
+altair_epoch_accounting_phase_a = partial(
+    jax.jit, static_argnums=(0,), static_argnames=("include_effective_balance",)
+)(altair_epoch_accounting_impl)

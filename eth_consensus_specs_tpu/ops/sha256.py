@@ -131,6 +131,22 @@ def sha256_pair_words_unrolled(words: jnp.ndarray) -> jnp.ndarray:
     return jnp.stack(state, axis=-1)
 
 
+def sha256_single_block(words: jnp.ndarray) -> jnp.ndarray:
+    """Hash a batch of messages that fit one fully-padded block.
+
+    words: uint32[N, 16] (padding already applied by the caller) ->
+    uint32[N, 8]. One compression instead of sha256_pair_words' two —
+    the shape of the shuffle's decision-bit hashes (33/37-byte messages,
+    specs/phase0/beacon-chain.md:816-836)."""
+    n = words.shape[0]
+    if jax.default_backend() == "cpu":
+        state = jnp.broadcast_to(jnp.asarray(_IV)[:, None], (8, n))
+        return _compress_scan(state, words.T).T
+    w = [words[:, i] for i in range(16)]
+    state = [jnp.broadcast_to(jnp.uint32(_IV[i]), (n,)) for i in range(8)]
+    return jnp.stack(_compress(state, w), axis=-1)
+
+
 def sha256_pair_words(words: jnp.ndarray) -> jnp.ndarray:
     """Hash a batch of 64-byte messages given as big-endian words.
 

@@ -19,6 +19,7 @@ spec form is property-tested (tests/test_shuffle.py).
 from __future__ import annotations
 
 import hashlib
+from functools import lru_cache as _lru_cache
 
 import numpy as np
 
@@ -53,3 +54,88 @@ def shuffle_list(items: list, seed: bytes, rounds: int) -> list:
     """The shuffled sequence itself: out[i] = items[perm[i]]."""
     perm = shuffle_permutation(len(items), seed, rounds)
     return [items[int(p)] for p in perm]
+
+
+# --- device kernel ---------------------------------------------------------
+
+
+def _single_block_words(messages: list[bytes]) -> np.ndarray:
+    """Pack sub-56-byte messages into padded single SHA-256 blocks as
+    big-endian uint32[len(messages), 16]."""
+    out = np.zeros((len(messages), 64), dtype=np.uint8)
+    for i, m in enumerate(messages):
+        out[i, : len(m)] = np.frombuffer(m, dtype=np.uint8)
+        out[i, len(m)] = 0x80
+        bitlen = len(m) * 8
+        out[i, 60:64] = np.frombuffer(np.array([bitlen], ">u4").tobytes(), np.uint8)
+    return out.view(">u4").astype(np.uint32).reshape(len(messages), 16)
+
+
+@_lru_cache(maxsize=None)
+def _device_shuffle_kernel(n: int, rounds: int, num_chunks: int):
+    """One compiled executable per (n, rounds) shape — seeds change every
+    epoch, so the kernel must take (blocks, pivots) as traced arguments
+    rather than closing over them (a per-seed closure would retrace)."""
+    import jax
+    import jax.numpy as jnp
+
+    from .sha256 import sha256_single_block
+
+    @jax.jit
+    def run(blocks, pivots):
+        digests = sha256_single_block(blocks)  # (rounds*chunks, 8) BE words
+        digests = digests.reshape(rounds, num_chunks, 8)
+        idx0 = jnp.arange(n, dtype=jnp.int32)
+
+        def body(r, idx):
+            pivot = pivots[r]
+            flip = jnp.mod(pivot - idx, jnp.int32(n))
+            pos = jnp.maximum(idx, flip)
+            byte_idx = (pos % 256) // 8
+            word = digests[r, pos // 256, byte_idx // 4]
+            byte_val = (word >> (8 * (3 - (byte_idx % 4))).astype(jnp.uint32)) & 0xFF
+            bit = (byte_val >> (pos % 8).astype(jnp.uint32)) & 1
+            return jnp.where(bit == 1, flip, idx)
+
+        return jax.lax.fori_loop(0, rounds, body, idx0)
+
+    return run
+
+
+def shuffle_permutation_device(index_count: int, seed: bytes, rounds: int):
+    """Whole-permutation swap-or-not ON DEVICE, bit-equal to
+    shuffle_permutation / compute_shuffled_index.
+
+    The decision-bit hashes (rounds x ceil(n/256) single-block messages)
+    are batched through the vectorized SHA-256 kernel; the 90 rounds of
+    flip/gather/select over all n lanes run inside one jitted fori_loop —
+    the reference's per-index 90-round loop
+    (specs/phase0/beacon-chain.md:816-836, LRU-cached per index in
+    pysetup/spec_builders/phase0.py:59-88) becomes ~90 fused gathers.
+    Returns a device int32 array; np.asarray(...) for the host view."""
+    if index_count == 0:
+        import jax.numpy as jnp
+
+        return jnp.empty(0, dtype=np.int32)
+    n = index_count
+    num_chunks = (n + 255) // 256
+    sha = hashlib.sha256
+
+    # pivots: 90 tiny host hashes (negligible; keeps uint64 mod off device)
+    pivots = np.array(
+        [
+            int.from_bytes(sha(seed + bytes([r])).digest()[:8], "little") % n
+            for r in range(rounds)
+        ],
+        dtype=np.int64,
+    ).astype(np.int32)
+
+    # decision-bit source blocks for every (round, chunk)
+    msgs = [
+        seed + bytes([r]) + c.to_bytes(4, "little")
+        for r in range(rounds)
+        for c in range(num_chunks)
+    ]
+    blocks = _single_block_words(msgs)
+
+    return _device_shuffle_kernel(n, rounds, num_chunks)(blocks, pivots)

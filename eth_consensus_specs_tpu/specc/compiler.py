@@ -179,6 +179,12 @@ def compile_fork(
     ns["__specc_report__"] = report
     ns["fork"] = fork
 
+    # fork upgrade functions address the previous fork's spec as a module
+    # (e.g. `deneb.get_current_epoch(pre)` in electra's fork.md) — the
+    # reference's generated modules import their ancestors the same way
+    for ancestor in lineage[:-1]:
+        ns[ancestor] = compile_fork(ancestor, preset_name, config_name)
+
     docs: list[ParsedDoc] = []
     for f in lineage:
         for path in _doc_paths(f):
@@ -199,7 +205,12 @@ def compile_fork(
                 base = eval(expr, ns)  # noqa: S307 - spec text, trusted input set
             except Exception as e:
                 return str(e)
-            ns[name] = types.new_class(name, (base,), {}) if isinstance(base, type) else base
+            # alias, not subclass: type identity must unify across compiled
+            # modules and with the framework's own types (Root IS Bytes32),
+            # or cross-fork coercion in upgrade functions would see foreign
+            # classes (the reference's aliases are SSZ-identical subclasses
+            # within ONE flat module, so it never crosses this boundary)
+            ns[name] = base
             return None
         default = None
         try:
@@ -265,6 +276,19 @@ def compile_fork(
         exec(  # noqa: S102
             compile(_FUTURE + code, f"<spec:{name}>", "exec", dont_inherit=True), ns
         )
+
+    # builder overrides: the reference's per-fork spec builders replace a
+    # few markdown functions whose in-document bodies are explicitly
+    # demonstrative (pysetup/spec_builders/altair.py:47-51 swaps
+    # eth_aggregate_pubkeys' "interpret + as point addition" sketch for a
+    # real aggregation call)
+    if "altair" in lineage:
+        _bls = ns["bls"]
+
+        def eth_aggregate_pubkeys(pubkeys):
+            return _bls.AggregatePKs(list(pubkeys))
+
+        ns["eth_aggregate_pubkeys"] = eth_aggregate_pubkeys
 
     ns["preset"] = preset
     ns["config"] = config

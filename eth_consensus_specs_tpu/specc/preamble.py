@@ -34,6 +34,17 @@ def _copy(v):
     return v.copy() if hasattr(v, "copy") else v
 
 
+def _uint_to_bytes(n) -> bytes:
+    """remerkleable arithmetic preserves the uint type; this framework's
+    returns plain int (range checks on assignment).  At every reference
+    call site the degraded value originated as uint64 (narrower types are
+    always constructed explicitly, e.g. uint_to_bytes(uint8(round))), so
+    re-typing plain ints as uint64 reproduces the reference encoding."""
+    if isinstance(n, ssz.uint):
+        return ssz.uint_to_bytes(n)
+    return ssz.uint64(n).encode_bytes()
+
+
 class _NoopExecutionEngine:
     """Behavioral match of the reference's NoopExecutionEngine
     (pysetup/spec_builders/deneb.py:46-79): every verification answers
@@ -107,7 +118,7 @@ def build_namespace() -> dict:
         "hash": lambda data: ssz.Bytes32(hash_bytes(bytes(data))),
         "hash_tree_root": ssz.hash_tree_root,
         "serialize": ssz.serialize,
-        "uint_to_bytes": ssz.uint_to_bytes,
+        "uint_to_bytes": _uint_to_bytes,
         "copy": _copy,
         "floorlog2": floorlog2,
         "ceillog2": ceillog2,

@@ -542,6 +542,12 @@ class Bitlist(View):
             raise ValueError("Bitlist full")
         self._bits.append(bool(v))
 
+    def to_numpy(self):
+        """Dense bool array of the bits (columnar extraction fast path)."""
+        import numpy as _np
+
+        return _np.array(self._bits, dtype=bool)
+
     def __eq__(self, other):
         return isinstance(other, Bitlist) and other.LIMIT == self.LIMIT and other._bits == self._bits
 
@@ -603,7 +609,10 @@ class _Sequence(View):
     ELEMENT_TYPE: type = View
 
     def __init__(self, *args):
-        if len(args) == 1 and not isinstance(args[0], (int, bytes, str, View)):
+        if len(args) == 1 and (
+            isinstance(args[0], _Sequence)  # a sequence view always means "these elements"
+            or not isinstance(args[0], (int, bytes, str, View))
+        ):
             try:
                 args = tuple(args[0])
             except TypeError:

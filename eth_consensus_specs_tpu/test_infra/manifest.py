@@ -1,0 +1,61 @@
+"""Vector-location manifest metadata for test functions.
+
+The emitted vector tree is addressed as config/fork/runner/handler/suite/
+case (reference: tests/formats/README.md); most coordinates derive from a
+test's module path and name, but some tests must pin parts explicitly.
+The reference attaches a Manifest dataclass via an @manifest decorator
+(reference: tests/infra/manifest.py:7-73); here the same capability is a
+single frozen record with field-wise merge and a decorator that stacks
+(the innermost decorator's explicit fields win).
+
+gen/gen_from_tests.py consults ``vector_location_of`` when wrapping a test
+function as a vector case.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, fields, replace
+from typing import Callable
+
+_ATTR = "__vector_location__"
+
+
+@dataclass(frozen=True)
+class VectorLocation:
+    fork: str | None = None
+    preset: str | None = None
+    runner: str | None = None
+    handler: str | None = None
+    suite: str | None = None
+    case: str | None = None
+
+    def merged_over(self, defaults: "VectorLocation") -> "VectorLocation":
+        """Fill unset fields from `defaults` (explicit values win)."""
+        updates = {
+            f.name: getattr(defaults, f.name)
+            for f in fields(self)
+            if getattr(self, f.name) is None
+        }
+        return replace(self, **updates)
+
+    def is_complete(self) -> bool:
+        return all(getattr(self, f.name) is not None for f in fields(self))
+
+
+def manifest(**coords) -> Callable:
+    """Attach vector-tree coordinates to a test function.
+
+    Stacks: an outer @manifest only fills fields the existing location
+    leaves unset."""
+    loc = VectorLocation(**coords)
+
+    def deco(fn):
+        existing = getattr(fn, _ATTR, None)
+        setattr(fn, _ATTR, existing.merged_over(loc) if existing else loc)
+        return fn
+
+    return deco
+
+
+def vector_location_of(fn) -> VectorLocation:
+    return getattr(fn, _ATTR, VectorLocation())

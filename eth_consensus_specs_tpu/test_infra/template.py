@@ -1,0 +1,49 @@
+"""Template-test metaprogramming: one parameterized factory expands into
+many pytest-discoverable test functions.
+
+This is what lets upgrade coverage scale across the fork matrix without
+hand-writing each (pre, post) pair (the reference's @template_test /
+template_test_upgrades_from, tests/infra/template_test.py:14-55).  The
+design here: a factory returns (test_fn, name); ``instantiate`` binds it
+into a target module's namespace; ``for_each_upgrade`` iterates the fork
+lineage so one factory covers every upgrade from a starting fork onward.
+"""
+
+from __future__ import annotations
+
+import sys
+from typing import Callable, Iterator
+
+from eth_consensus_specs_tpu.config import FORK_ORDER
+
+
+def instantiate(factory: Callable, *args, module=None, **kwargs):
+    """Run a (fn, name) factory and register the test in `module` (default:
+    the caller's module)."""
+    if module is None:
+        caller = sys._getframe(1)
+        module = sys.modules[caller.f_globals["__name__"]]
+    fn, name = factory(*args, **kwargs)
+    fn.__name__ = name
+    setattr(module, name, fn)
+    return fn
+
+
+def upgrade_pairs_from(first_post: str) -> Iterator[tuple[str, str]]:
+    """(pre, post) fork pairs for every upgrade whose post fork is at or
+    after `first_post` (mainline lineage only)."""
+    mainline = [f for f in FORK_ORDER if not f.startswith("eip")]
+    start = mainline.index(first_post)
+    for i in range(start, len(mainline)):
+        yield mainline[i - 1], mainline[i]
+
+
+def for_each_upgrade(factory: Callable, first_post: str = "altair", module=None) -> None:
+    """Instantiate an upgrade-test factory for every (pre, post) pair from
+    `first_post` onward.  The factory signature is (pre_fork, post_fork) ->
+    (test_fn, name)."""
+    if module is None:
+        caller = sys._getframe(1)
+        module = sys.modules[caller.f_globals["__name__"]]
+    for pre, post in upgrade_pairs_from(first_post):
+        instantiate(factory, pre, post, module=module)

@@ -10,7 +10,7 @@ from eth_consensus_specs_tpu.test_infra.attestations import next_epoch_with_atte
 from eth_consensus_specs_tpu.test_infra.context import spec_state_test, with_phases
 from eth_consensus_specs_tpu.test_infra.state import next_epoch, next_slots
 
-FLAG_FORKS = ["altair", "bellatrix", "capella", "deneb"]
+FLAG_FORKS = ["altair", "bellatrix", "capella", "deneb", "electra", "fulu"]
 
 
 def assert_columnar_parity(spec, state):
@@ -21,7 +21,7 @@ def assert_columnar_parity(spec, state):
         spec.process_slots(state, boundary - 1)
     obj_state = state.copy()
     col_state = state.copy()
-    spec.process_epoch(obj_state)
+    spec.process_epoch_object(obj_state)
     spec.process_epoch_columnar(col_state)
     assert hash_tree_root(obj_state) == hash_tree_root(col_state)
 

@@ -1,0 +1,126 @@
+"""Networking helper coverage (the reference's `networking` runner
+category): subnet selection for attestations, long-lived subscriptions,
+sync committees, blob and data-column sidecars.
+
+reference: specs/phase0/validator.md:703-714, p2p-interface.md:1344-1361,
+altair/validator.md:378-397, deneb/validator.md:197, electra/validator.md:321,
+fulu/p2p-interface.md:173."""
+
+import pytest
+
+from eth_consensus_specs_tpu.forks import get_spec
+from eth_consensus_specs_tpu.test_infra.context import spec_state_test, with_phases
+from eth_consensus_specs_tpu.test_infra.forks import is_post_electra
+
+ALL_FORKS = ["phase0", "altair", "bellatrix", "capella", "deneb", "electra", "fulu", "gloas"]
+
+
+@pytest.mark.parametrize("fork", ALL_FORKS)
+def test_compute_subnet_for_attestation_range_and_layout(fork):
+    spec = get_spec(fork, "minimal")
+    count = int(spec.config.ATTESTATION_SUBNET_COUNT)
+    seen = set()
+    cps = 4
+    for slot in range(int(spec.SLOTS_PER_EPOCH)):
+        for index in range(cps):
+            subnet = spec.compute_subnet_for_attestation(cps, slot, index)
+            assert 0 <= subnet < count
+            seen.add(subnet)
+    # consecutive committees in one slot get consecutive subnets
+    assert spec.compute_subnet_for_attestation(cps, 0, 1) == (
+        spec.compute_subnet_for_attestation(cps, 0, 0) + 1
+    ) % count
+    # next slot advances by committees_per_slot
+    assert spec.compute_subnet_for_attestation(cps, 1, 0) == (
+        spec.compute_subnet_for_attestation(cps, 0, 0) + cps
+    ) % count
+
+
+@pytest.mark.parametrize("fork", ["phase0", "electra"])
+def test_compute_subscribed_subnets_deterministic_and_bounded(fork):
+    spec = get_spec(fork, "minimal")
+    cfg = spec.config
+    node_id = 0xDEADBEEF << 200
+    subnets = spec.compute_subscribed_subnets(node_id, epoch=100)
+    assert len(subnets) == int(cfg.SUBNETS_PER_NODE)
+    assert all(0 <= s < int(cfg.ATTESTATION_SUBNET_COUNT) for s in subnets)
+    assert subnets == spec.compute_subscribed_subnets(node_id, epoch=100)
+    # subscriptions rotate across periods but are stable inside one
+    period = int(cfg.EPOCHS_PER_SUBNET_SUBSCRIPTION)
+    node_offset = node_id % period
+    same_period_epoch = 100 + (period - 1 - ((100 + node_offset) % period))
+    assert subnets == spec.compute_subscribed_subnets(node_id, same_period_epoch)
+    rotations = {
+        tuple(spec.compute_subscribed_subnets(node_id, e)) for e in range(0, period * 8, period)
+    }
+    assert len(rotations) > 1
+
+
+def test_subscribed_subnet_indices_are_consecutive_on_ring():
+    spec = get_spec("phase0", "minimal")
+    cfg = spec.config
+    node_id = 12345
+    subnets = spec.compute_subscribed_subnets(node_id, epoch=7)
+    count = int(cfg.ATTESTATION_SUBNET_COUNT)
+    for a, b in zip(subnets, subnets[1:]):
+        assert b == (a + 1) % count
+
+
+@with_phases(["altair", "bellatrix", "capella", "deneb", "electra"])
+@spec_state_test
+def test_compute_subnets_for_sync_committee(spec, state):
+    member_pk = state.current_sync_committee.pubkeys[0]
+    member_index = next(
+        i for i, v in enumerate(state.validators) if bytes(v.pubkey) == bytes(member_pk)
+    )
+    subnets = spec.compute_subnets_for_sync_committee(state, member_index)
+    bound = spec.SYNC_COMMITTEE_SUBNET_COUNT
+    assert subnets and all(0 <= s < bound for s in subnets)
+    # a validator in no sync committee gets no subnets
+    committee_pks = {bytes(pk) for pk in state.current_sync_committee.pubkeys} | {
+        bytes(pk) for pk in state.next_sync_committee.pubkeys
+    }
+    outsider = next(
+        (
+            i
+            for i, v in enumerate(state.validators)
+            if bytes(v.pubkey) not in committee_pks
+        ),
+        None,
+    )
+    if outsider is not None:
+        assert spec.compute_subnets_for_sync_committee(state, outsider) == set()
+
+
+@pytest.mark.parametrize("fork,expected_count_key", [
+    ("deneb", "BLOB_SIDECAR_SUBNET_COUNT"),
+    ("electra", "BLOB_SIDECAR_SUBNET_COUNT_ELECTRA"),
+    ("fulu", "BLOB_SIDECAR_SUBNET_COUNT_ELECTRA"),
+])
+def test_compute_subnet_for_blob_sidecar(fork, expected_count_key):
+    spec = get_spec(fork, "minimal")
+    count = int(getattr(spec.config, expected_count_key))
+    assert spec.compute_subnet_for_blob_sidecar(0) == 0
+    assert spec.compute_subnet_for_blob_sidecar(count) == 0
+    assert spec.compute_subnet_for_blob_sidecar(count + 3) == 3
+    if is_post_electra(spec):
+        assert count == 9
+
+
+@pytest.mark.parametrize("fork", ["fulu", "gloas"])
+def test_compute_subnet_for_data_column_sidecar(fork):
+    spec = get_spec(fork, "minimal")
+    count = int(spec.config.DATA_COLUMN_SIDECAR_SUBNET_COUNT)
+    for col in [0, 1, count - 1, count, 3 * count + 5]:
+        assert spec.compute_subnet_for_data_column_sidecar(col) == col % count
+
+
+@pytest.mark.parametrize("fork", ALL_FORKS)
+def test_fork_digest_distinct_per_fork(fork):
+    spec = get_spec(fork, "minimal")
+    from eth_consensus_specs_tpu.test_infra.forks import fork_version_of
+
+    digest = spec.compute_fork_digest(fork_version_of(spec), b"\x00" * 32)
+    assert len(bytes(digest)) == 4
+    other = spec.compute_fork_digest(b"\xff\xff\xff\xff", b"\x00" * 32)
+    assert bytes(digest) != bytes(other)

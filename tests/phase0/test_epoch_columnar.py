@@ -19,7 +19,7 @@ def assert_columnar_parity(spec, state):
         spec.process_slots(state, boundary - 1)
     obj_state = state.copy()
     col_state = state.copy()
-    spec.process_epoch(obj_state)
+    spec.process_epoch_object(obj_state)
     spec.process_epoch_columnar(col_state)
     assert hash_tree_root(obj_state) == hash_tree_root(col_state)
 

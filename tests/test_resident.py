@@ -1,0 +1,73 @@
+"""Device-resident multi-epoch API (parallel/resident.py)."""
+
+import jax
+import numpy as np
+import pytest
+
+from eth_consensus_specs_tpu.forks import get_spec
+from eth_consensus_specs_tpu.parallel import resident
+from eth_consensus_specs_tpu.test_infra.genesis import create_genesis_state
+from eth_consensus_specs_tpu.utils import bls
+
+
+@pytest.fixture(scope="module")
+def altair_state():
+    spec = get_spec("altair", "minimal")
+    prev = bls.bls_active
+    bls.bls_active = False
+    try:
+        state = create_genesis_state(
+            spec, [spec.MAX_EFFECTIVE_BALANCE] * 64, spec.MAX_EFFECTIVE_BALANCE
+        )
+        # park one slot before an epoch boundary — the phase at which the
+        # columnar extraction runs inside process_slots
+        spec.process_slots(state, 2 * int(spec.SLOTS_PER_EPOCH) - 1)
+    finally:
+        bls.bls_active = prev
+    return spec, state
+
+
+def test_chaining_consistency(altair_state):
+    """run_epochs(2) == run_epochs(1) applied twice."""
+    spec, state = altair_state
+    cols, just = resident.ingest(spec, state)
+    two = resident.run_epochs(spec, cols, just, 2, with_root=False)
+    one = resident.run_epochs(spec, cols, just, 1, with_root=False)
+    one_again = resident.run_epochs(spec, one.cols, one.just, 1, with_root=False)
+    assert (np.asarray(two.cols.balance) == np.asarray(one_again.cols.balance)).all()
+    assert int(two.just.current_epoch) == int(one_again.just.current_epoch)
+
+
+def test_root_chain_changes_with_balances(altair_state):
+    spec, state = altair_state
+    cols, just = resident.ingest(spec, state)
+    a = resident.run_epochs(spec, cols, just, 1, with_root=True)
+    salted = cols._replace(balance=cols.balance + jax.numpy.uint64(1))
+    b = resident.run_epochs(spec, salted, just, 1, with_root=True)
+    assert bytes(np.asarray(a.root_acc)) != bytes(np.asarray(b.root_acc))
+
+
+def test_single_epoch_matches_kernel(altair_state):
+    """One resident epoch == one direct kernel application."""
+    from eth_consensus_specs_tpu.ops.altair_epoch import (
+        AltairEpochParams,
+        altair_epoch_accounting,
+    )
+
+    spec, state = altair_state
+    cols, just = resident.ingest(spec, state)
+    res = altair_epoch_accounting(AltairEpochParams.from_spec(spec), cols, just)
+    out = resident.run_epochs(spec, cols, just, 1, with_root=False)
+    assert (np.asarray(res.balance) == np.asarray(out.cols.balance)).all()
+    assert (np.asarray(res.effective_balance) == np.asarray(out.cols.effective_balance)).all()
+
+
+def test_writeback_applies(altair_state):
+    spec, state = altair_state
+    work = state.copy()
+    cols, just = resident.ingest(spec, work)
+    carry = resident.run_epochs(spec, cols, just, 1, with_root=False)
+    resident.writeback(spec, work, carry)
+    assert [int(b) for b in work.balances] == [
+        int(x) for x in np.asarray(carry.cols.balance)
+    ]

@@ -27,3 +27,18 @@ def test_seed_sensitivity():
     a = shuffle_permutation(256, b"\x01" * 32, 90)
     b = shuffle_permutation(256, b"\x02" * 32, 90)
     assert a.tolist() != b.tolist()
+
+
+@pytest.mark.parametrize("n", [1, 255, 256, 257, 1000, 4096])
+def test_device_permutation_bit_equal(n):
+    """shuffle_permutation_device == host whole-permutation form ==
+    compute_shuffled_index (via the host test above), incl. chunk-boundary
+    sizes. 90 mainnet rounds."""
+    import numpy as np
+
+    from eth_consensus_specs_tpu.ops.shuffle import shuffle_permutation_device
+
+    seed = b"\x5a" * 32
+    host = shuffle_permutation(n, seed, 90)
+    dev = np.asarray(shuffle_permutation_device(n, seed, 90))
+    assert (host == dev).all()
