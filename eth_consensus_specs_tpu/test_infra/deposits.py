@@ -95,10 +95,29 @@ def run_deposit_processing(spec, state, deposit, validator_index, valid=True, ef
         yield "post", None
         return
 
+    pre_pending = len(getattr(state, "pending_deposits", []))
     spec.process_deposit(state, deposit)
     yield "post", state
 
-    if not effective or not bls.KeyValidate(deposit.data.pubkey):
+    from .forks import is_post_electra
+
+    if is_post_electra(spec):
+        # [Electra:EIP7251] deposits defer to the pending queue: balances
+        # only move at epoch processing (specs/electra/beacon-chain.md
+        # apply_deposit). A new validator with a bad proof-of-possession
+        # is neither added nor enqueued; otherwise exactly one queue entry
+        # lands (new validators join the registry with a zero balance).
+        if not is_top_up and (not effective or not bls.KeyValidate(deposit.data.pubkey)):
+            assert len(state.validators) == pre_validator_count
+            assert len(state.pending_deposits) == pre_pending
+        else:
+            assert len(state.pending_deposits) == pre_pending + 1
+            if is_top_up:
+                assert int(state.balances[validator_index]) == pre_balance
+            else:
+                assert len(state.validators) == pre_validator_count + 1
+                assert int(state.balances[validator_index]) == 0
+    elif not effective or not bls.KeyValidate(deposit.data.pubkey):
         # deposit with bad proof-of-possession: no new validator
         assert len(state.validators) == pre_validator_count
         if is_top_up:

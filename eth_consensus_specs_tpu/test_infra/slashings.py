@@ -75,7 +75,19 @@ def run_proposer_slashing_processing(spec, state, proposer_slashing, valid=True)
     spec.process_proposer_slashing(state, proposer_slashing)
     yield "post", state
     assert state.validators[proposer_index].slashed
-    assert int(state.balances[proposer_index]) < pre_proposer_balance
+    # [Electra:EIP7251] both quotients are 4096, so a validator slashed in
+    # its own proposal earns back exactly the penalty as whistleblower+
+    # proposer reward — net zero; every other case strictly decreases
+    eff = int(state.validators[proposer_index].effective_balance)
+    penalty = eff // spec.min_slashing_penalty_quotient()
+    whistleblower = eff // spec.whistleblower_reward_quotient()
+    if proposer_index == int(spec.get_beacon_proposer_index(state)):
+        assert (
+            int(state.balances[proposer_index])
+            == pre_proposer_balance - penalty + whistleblower
+        )
+    else:
+        assert int(state.balances[proposer_index]) < pre_proposer_balance
 
 
 def run_attester_slashing_processing(spec, state, attester_slashing, valid=True):

@@ -1,0 +1,102 @@
+"""Inactivity-score update table (spec: specs/altair/beacon-chain.md
+process_inactivity_updates; reference analogue:
+test/altair/epoch_processing/test_process_inactivity_updates.py)."""
+
+from eth_consensus_specs_tpu.test_infra.attestations import (
+    next_epoch_with_attestations,
+)
+from eth_consensus_specs_tpu.test_infra.context import spec_state_test, with_phases
+from eth_consensus_specs_tpu.test_infra.state import next_epoch
+
+ALTAIR_PLUS = ["altair", "deneb", "electra"]
+
+
+def _boundary(spec, state):
+    target = int(state.slot) + int(spec.SLOTS_PER_EPOCH) - int(state.slot) % int(
+        spec.SLOTS_PER_EPOCH
+    )
+    spec.process_slots(state, target)
+
+
+@with_phases(ALTAIR_PLUS)
+@spec_state_test
+def test_scores_zero_at_genesis_epoch_boundary(spec, state):
+    _boundary(spec, state)
+    assert all(int(s) == 0 for s in state.inactivity_scores)
+
+
+@with_phases(ALTAIR_PLUS)
+@spec_state_test
+def test_nonparticipation_raises_scores(spec, state):
+    next_epoch(spec, state)
+    next_epoch(spec, state)  # prev epoch now has zero participation
+    _boundary(spec, state)
+    bias = int(spec.config.INACTIVITY_SCORE_BIAS)
+    recovery = int(spec.config.INACTIVITY_SCORE_RECOVERY_RATE)
+    expected = max(bias - recovery, 0)  # leak-free recovery applies
+    assert all(int(s) == expected for s in state.inactivity_scores)
+
+
+@with_phases(["altair"])
+@spec_state_test
+def test_full_participation_keeps_scores_zero(spec, state):
+    next_epoch(spec, state)
+    _, _, state = next_epoch_with_attestations(spec, state, True, False)
+    _boundary(spec, state)
+    assert all(int(s) == 0 for s in state.inactivity_scores)
+
+
+@with_phases(["altair"])
+@spec_state_test
+def test_participating_score_decrements(spec, state):
+    next_epoch(spec, state)
+    for i in range(len(state.inactivity_scores)):
+        state.inactivity_scores[i] = 10
+    _, _, state = next_epoch_with_attestations(spec, state, True, False)
+    _boundary(spec, state)
+    # -1 for participation, then leak-free recovery
+    recovery = int(spec.config.INACTIVITY_SCORE_RECOVERY_RATE)
+    expected = max(10 - 1 - recovery, 0)
+    assert all(int(s) == expected for s in state.inactivity_scores)
+
+
+@with_phases(["altair"])
+@spec_state_test
+def test_score_floors_at_zero(spec, state):
+    next_epoch(spec, state)
+    _, _, state = next_epoch_with_attestations(spec, state, True, False)
+    _boundary(spec, state)
+    assert all(int(s) >= 0 for s in state.inactivity_scores)
+
+
+@with_phases(["altair"])
+@spec_state_test
+def test_leak_blocks_recovery(spec, state):
+    """Once the inactivity leak is on, the recovery-rate decrement is
+    withheld: one more non-participating epoch adds exactly +bias."""
+    next_epoch(spec, state)
+    for _ in range(int(spec.MIN_EPOCHS_TO_INACTIVITY_PENALTY) + 2):
+        next_epoch(spec, state)
+    assert spec.is_in_inactivity_leak(state)
+    snapshot = [int(s) for s in state.inactivity_scores]
+    next_epoch(spec, state)
+    bias = int(spec.config.INACTIVITY_SCORE_BIAS)
+    assert [int(s) for s in state.inactivity_scores] == [s + bias for s in snapshot]
+
+
+@with_phases(["altair"])
+@spec_state_test
+def test_exited_validators_score_untouched(spec, state):
+    """A fully exited, non-slashed validator is not eligible — its score
+    freezes once the previous epoch is past its exit."""
+    next_epoch(spec, state)
+    idx = 3
+    state.validators[idx].exit_epoch = spec.get_current_epoch(state)
+    state.validators[idx].withdrawable_epoch = spec.get_current_epoch(state)
+    # advance until prev_epoch >= exit_epoch (eligibility gone)
+    next_epoch(spec, state)
+    next_epoch(spec, state)
+    frozen = int(state.inactivity_scores[idx])
+    next_epoch(spec, state)
+    _boundary(spec, state)
+    assert int(state.inactivity_scores[idx]) == frozen

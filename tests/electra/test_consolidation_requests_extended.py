@@ -1,0 +1,234 @@
+"""Consolidation-request ignore table — EL-triggered requests never fail a
+block; every invalid condition silently leaves the state unchanged
+(spec: specs/electra/beacon-chain.md process_consolidation_request;
+reference analogue: test/electra/block_processing/
+test_process_consolidation_request.py)."""
+
+from eth_consensus_specs_tpu import ssz
+from eth_consensus_specs_tpu.test_infra.context import spec_state_test, with_phases
+from eth_consensus_specs_tpu.test_infra.keys import pubkey
+from eth_consensus_specs_tpu.test_infra.state import next_slots
+
+ELECTRA = ["electra"]
+
+
+def _compounding_creds(spec, state, index: int, tag: int):
+    address = bytes([0x60 + tag]) * 20
+    state.validators[index].withdrawal_credentials = (
+        spec.COMPOUNDING_WITHDRAWAL_PREFIX + b"\x00" * 11 + address
+    )
+    return address
+
+
+def _eth1_creds(spec, state, index: int, tag: int):
+    address = bytes([0x60 + tag]) * 20
+    state.validators[index].withdrawal_credentials = (
+        spec.ETH1_ADDRESS_WITHDRAWAL_PREFIX + b"\x00" * 11 + address
+    )
+    return address
+
+
+def _age(spec, state):
+    next_slots(
+        spec, state, int(spec.config.SHARD_COMMITTEE_PERIOD) * int(spec.SLOTS_PER_EPOCH)
+    )
+
+
+def _request(spec, state, src: int, dst: int, source_address=None):
+    return spec.ConsolidationRequest(
+        source_address=(
+            source_address
+            if source_address is not None
+            else bytes(state.validators[src].withdrawal_credentials)[12:]
+        ),
+        source_pubkey=state.validators[src].pubkey,
+        target_pubkey=state.validators[dst].pubkey,
+    )
+
+
+def _assert_ignored(spec, state, req):
+    pre = bytes(ssz.hash_tree_root(state))
+    spec.process_consolidation_request(state, req)
+    assert bytes(ssz.hash_tree_root(state)) == pre
+
+
+@with_phases(ELECTRA)
+@spec_state_test
+def test_consolidation_enqueues(spec, state):
+    _compounding_creds(spec, state, 1, 1)
+    _compounding_creds(spec, state, 2, 2)
+    _age(spec, state)
+    req = _request(spec, state, 1, 2)
+    spec.process_consolidation_request(state, req)
+    assert len(state.pending_consolidations) == 1
+    assert state.validators[1].exit_epoch != spec.FAR_FUTURE_EPOCH
+
+
+@with_phases(ELECTRA)
+@spec_state_test
+def test_consolidation_ignored_same_source_target_noncompounding(spec, state):
+    """source == target with eth1 creds is a disguised exit — ignored
+    (with compounding creds it is a switch request instead)."""
+    _eth1_creds(spec, state, 1, 1)
+    _age(spec, state)
+    req = _request(spec, state, 1, 1)
+    pre_pending = len(state.pending_consolidations)
+    spec.process_consolidation_request(state, req)
+    assert len(state.pending_consolidations) == pre_pending
+    assert state.validators[1].exit_epoch == spec.FAR_FUTURE_EPOCH
+
+
+@with_phases(ELECTRA)
+@spec_state_test
+def test_consolidation_ignored_unknown_source(spec, state):
+    _compounding_creds(spec, state, 2, 2)
+    _age(spec, state)
+    req = spec.ConsolidationRequest(
+        source_address=b"\x61" * 20,
+        source_pubkey=pubkey(len(state.validators) + 7),  # no such validator
+        target_pubkey=state.validators[2].pubkey,
+    )
+    _assert_ignored(spec, state, req)
+
+
+@with_phases(ELECTRA)
+@spec_state_test
+def test_consolidation_ignored_unknown_target(spec, state):
+    _compounding_creds(spec, state, 1, 1)
+    _age(spec, state)
+    req = spec.ConsolidationRequest(
+        source_address=bytes(state.validators[1].withdrawal_credentials)[12:],
+        source_pubkey=state.validators[1].pubkey,
+        target_pubkey=pubkey(len(state.validators) + 7),
+    )
+    _assert_ignored(spec, state, req)
+
+
+@with_phases(ELECTRA)
+@spec_state_test
+def test_consolidation_ignored_wrong_source_address(spec, state):
+    _compounding_creds(spec, state, 1, 1)
+    _compounding_creds(spec, state, 2, 2)
+    _age(spec, state)
+    req = _request(spec, state, 1, 2, source_address=b"\x99" * 20)
+    _assert_ignored(spec, state, req)
+
+
+@with_phases(ELECTRA)
+@spec_state_test
+def test_consolidation_ignored_source_without_execution_creds(spec, state):
+    # source keeps its default BLS credentials
+    _compounding_creds(spec, state, 2, 2)
+    _age(spec, state)
+    req = _request(spec, state, 1, 2, source_address=b"\x61" * 20)
+    _assert_ignored(spec, state, req)
+
+
+@with_phases(ELECTRA)
+@spec_state_test
+def test_consolidation_ignored_target_not_compounding(spec, state):
+    _compounding_creds(spec, state, 1, 1)
+    _eth1_creds(spec, state, 2, 2)
+    _age(spec, state)
+    req = _request(spec, state, 1, 2)
+    _assert_ignored(spec, state, req)
+
+
+@with_phases(ELECTRA)
+@spec_state_test
+def test_consolidation_ignored_inactive_source(spec, state):
+    _compounding_creds(spec, state, 1, 1)
+    _compounding_creds(spec, state, 2, 2)
+    _age(spec, state)
+    state.validators[1].activation_epoch = spec.FAR_FUTURE_EPOCH
+    req = _request(spec, state, 1, 2)
+    _assert_ignored(spec, state, req)
+
+
+@with_phases(ELECTRA)
+@spec_state_test
+def test_consolidation_ignored_inactive_target(spec, state):
+    _compounding_creds(spec, state, 1, 1)
+    _compounding_creds(spec, state, 2, 2)
+    _age(spec, state)
+    state.validators[2].activation_epoch = spec.FAR_FUTURE_EPOCH
+    req = _request(spec, state, 1, 2)
+    _assert_ignored(spec, state, req)
+
+
+@with_phases(ELECTRA)
+@spec_state_test
+def test_consolidation_ignored_exiting_source(spec, state):
+    _compounding_creds(spec, state, 1, 1)
+    _compounding_creds(spec, state, 2, 2)
+    _age(spec, state)
+    state.validators[1].exit_epoch = spec.get_current_epoch(state) + 10
+    req = _request(spec, state, 1, 2)
+    _assert_ignored(spec, state, req)
+
+
+@with_phases(ELECTRA)
+@spec_state_test
+def test_consolidation_ignored_exiting_target(spec, state):
+    _compounding_creds(spec, state, 1, 1)
+    _compounding_creds(spec, state, 2, 2)
+    _age(spec, state)
+    state.validators[2].exit_epoch = spec.get_current_epoch(state) + 10
+    req = _request(spec, state, 1, 2)
+    _assert_ignored(spec, state, req)
+
+
+@with_phases(ELECTRA)
+@spec_state_test
+def test_consolidation_ignored_queue_full(spec, state):
+    _compounding_creds(spec, state, 1, 1)
+    _compounding_creds(spec, state, 2, 2)
+    _age(spec, state)
+    filler = spec.PendingConsolidation(source_index=3, target_index=4)
+    while len(state.pending_consolidations) < spec.PENDING_CONSOLIDATIONS_LIMIT:
+        state.pending_consolidations.append(filler)
+    req = _request(spec, state, 1, 2)
+    pre = len(state.pending_consolidations)
+    spec.process_consolidation_request(state, req)
+    assert len(state.pending_consolidations) == pre
+    assert state.validators[1].exit_epoch == spec.FAR_FUTURE_EPOCH
+
+
+@with_phases(ELECTRA)
+@spec_state_test
+def test_switch_to_compounding_via_self_request(spec, state):
+    """source == target with eth1 creds on source + compounding request."""
+    _eth1_creds(spec, state, 5, 5)
+    _age(spec, state)
+    req = _request(spec, state, 5, 5)
+    spec.process_consolidation_request(state, req)
+    assert bytes(state.validators[5].withdrawal_credentials)[:1] == bytes(
+        spec.COMPOUNDING_WITHDRAWAL_PREFIX
+    )
+    assert len(state.pending_consolidations) == 0
+
+
+@with_phases(ELECTRA)
+@spec_state_test
+def test_switch_request_wrong_address_ignored(spec, state):
+    _eth1_creds(spec, state, 5, 5)
+    _age(spec, state)
+    req = _request(spec, state, 5, 5, source_address=b"\x98" * 20)
+    _assert_ignored(spec, state, req)
+
+
+@with_phases(ELECTRA)
+@spec_state_test
+def test_consolidation_source_exit_epoch_set_by_churn(spec, state):
+    _compounding_creds(spec, state, 1, 1)
+    _compounding_creds(spec, state, 2, 2)
+    _age(spec, state)
+    req = _request(spec, state, 1, 2)
+    spec.process_consolidation_request(state, req)
+    exit_epoch = int(state.validators[1].exit_epoch)
+    assert exit_epoch >= int(
+        spec.compute_activation_exit_epoch(spec.get_current_epoch(state))
+    )
+    assert int(state.validators[1].withdrawable_epoch) == exit_epoch + int(
+        spec.config.MIN_VALIDATOR_WITHDRAWABILITY_DELAY
+    )

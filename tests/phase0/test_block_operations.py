@@ -1,0 +1,489 @@
+"""Per-operation valid/invalid tables for the phase0 block operations —
+proposer slashings, attester slashings, voluntary exits, deposits, block
+header, randao, eth1 data (reference analogue: one file per operation
+under test/phase0/block_processing/, e.g. test_process_proposer_slashing.py,
+test_process_voluntary_exit.py; spec: specs/phase0/beacon-chain.md:1852+)."""
+
+from eth_consensus_specs_tpu import ssz
+from eth_consensus_specs_tpu.test_infra.block import build_empty_block_for_next_slot
+from eth_consensus_specs_tpu.test_infra.context import (
+    always_bls,
+    expect_assertion_error,
+    spec_state_test,
+    with_all_phases,
+    with_phases,
+)
+from eth_consensus_specs_tpu.test_infra.deposits import (
+    build_deposit,
+    prepare_state_and_deposit,
+    run_deposit_processing,
+)
+from eth_consensus_specs_tpu.test_infra.keys import privkey_of, pubkey
+from eth_consensus_specs_tpu.test_infra.slashings import (
+    get_valid_attester_slashing,
+    get_valid_proposer_slashing,
+    run_attester_slashing_processing,
+    run_proposer_slashing_processing,
+)
+from eth_consensus_specs_tpu.test_infra.state import next_epoch, next_slots
+from eth_consensus_specs_tpu.test_infra.voluntary_exits import (
+    prepare_signed_exits,
+    run_voluntary_exit_processing,
+)
+from eth_consensus_specs_tpu.utils import bls
+
+PHASE0 = ["phase0"]
+
+
+# == proposer slashings ====================================================
+
+
+@with_all_phases
+@spec_state_test
+def test_proposer_slashing_basic(spec, state):
+    slashing = get_valid_proposer_slashing(spec, state, signed_1=True, signed_2=True)
+    yield from run_proposer_slashing_processing(spec, state, slashing)
+    assert state.validators[slashing.signed_header_1.message.proposer_index].slashed
+
+
+@with_phases(PHASE0)
+@spec_state_test
+def test_proposer_slashing_slashed_balance_decreases(spec, state):
+    slashing = get_valid_proposer_slashing(spec, state, signed_1=True, signed_2=True)
+    idx = int(slashing.signed_header_1.message.proposer_index)
+    pre = int(state.balances[idx])
+    yield from run_proposer_slashing_processing(spec, state, slashing)
+    assert int(state.balances[idx]) < pre
+
+
+@with_phases(PHASE0)
+@spec_state_test
+def test_proposer_slashing_invalid_identical_headers(spec, state):
+    slashing = get_valid_proposer_slashing(spec, state, signed_1=True, signed_2=True)
+    slashing.signed_header_2 = slashing.signed_header_1.copy()
+    yield from run_proposer_slashing_processing(spec, state, slashing, valid=False)
+
+
+@with_phases(PHASE0)
+@spec_state_test
+def test_proposer_slashing_invalid_different_slots(spec, state):
+    slashing = get_valid_proposer_slashing(spec, state, signed_1=True, signed_2=True)
+    slashing.signed_header_2.message.slot = slashing.signed_header_1.message.slot + 1
+    yield from run_proposer_slashing_processing(spec, state, slashing, valid=False)
+
+
+@with_phases(PHASE0)
+@spec_state_test
+def test_proposer_slashing_invalid_different_proposers(spec, state):
+    slashing = get_valid_proposer_slashing(spec, state, signed_1=True, signed_2=True)
+    slashing.signed_header_2.message.proposer_index = (
+        int(slashing.signed_header_1.message.proposer_index) + 1
+    )
+    yield from run_proposer_slashing_processing(spec, state, slashing, valid=False)
+
+
+@with_phases(PHASE0)
+@spec_state_test
+def test_proposer_slashing_invalid_already_slashed(spec, state):
+    slashing = get_valid_proposer_slashing(spec, state, signed_1=True, signed_2=True)
+    idx = int(slashing.signed_header_1.message.proposer_index)
+    state.validators[idx].slashed = True
+    yield from run_proposer_slashing_processing(spec, state, slashing, valid=False)
+
+
+@with_phases(PHASE0)
+@spec_state_test
+def test_proposer_slashing_invalid_withdrawn_proposer(spec, state):
+    slashing = get_valid_proposer_slashing(spec, state, signed_1=True, signed_2=True)
+    idx = int(slashing.signed_header_1.message.proposer_index)
+    state.validators[idx].withdrawable_epoch = spec.get_current_epoch(state)
+    yield from run_proposer_slashing_processing(spec, state, slashing, valid=False)
+
+
+@with_phases(PHASE0)
+@spec_state_test
+def test_proposer_slashing_invalid_proposer_index_out_of_range(spec, state):
+    slashing = get_valid_proposer_slashing(spec, state, signed_1=True, signed_2=True)
+    bad = len(state.validators)
+    slashing.signed_header_1.message.proposer_index = bad
+    slashing.signed_header_2.message.proposer_index = bad
+    yield from run_proposer_slashing_processing(spec, state, slashing, valid=False)
+
+
+@with_phases(PHASE0)
+@always_bls
+@spec_state_test
+def test_proposer_slashing_invalid_sig_1(spec, state):
+    slashing = get_valid_proposer_slashing(spec, state, signed_1=False, signed_2=True)
+    yield from run_proposer_slashing_processing(spec, state, slashing, valid=False)
+
+
+@with_phases(PHASE0)
+@always_bls
+@spec_state_test
+def test_proposer_slashing_invalid_sig_2(spec, state):
+    slashing = get_valid_proposer_slashing(spec, state, signed_1=True, signed_2=False)
+    yield from run_proposer_slashing_processing(spec, state, slashing, valid=False)
+
+
+# == attester slashings ====================================================
+
+
+@with_all_phases
+@spec_state_test
+def test_attester_slashing_basic(spec, state):
+    slashing = get_valid_attester_slashing(spec, state, signed_1=True, signed_2=True)
+    yield from run_attester_slashing_processing(spec, state, slashing)
+
+
+@with_phases(PHASE0)
+@spec_state_test
+def test_attester_slashing_invalid_same_data_not_slashable(spec, state):
+    slashing = get_valid_attester_slashing(spec, state, signed_1=True, signed_2=True)
+    slashing.attestation_2.data = slashing.attestation_1.data.copy()
+    yield from run_attester_slashing_processing(spec, state, slashing, valid=False)
+
+
+@with_phases(PHASE0)
+@spec_state_test
+def test_attester_slashing_invalid_no_intersection(spec, state):
+    slashing = get_valid_attester_slashing(spec, state, signed_1=True, signed_2=True)
+    empty = type(slashing.attestation_2.attesting_indices)([])
+    slashing.attestation_2.attesting_indices = empty
+    yield from run_attester_slashing_processing(spec, state, slashing, valid=False)
+
+
+@with_phases(PHASE0)
+@spec_state_test
+def test_attester_slashing_invalid_unsorted_indices(spec, state):
+    slashing = get_valid_attester_slashing(spec, state, signed_1=True, signed_2=True)
+    idx = [int(i) for i in slashing.attestation_1.attesting_indices]
+    if len(idx) < 2:
+        # widen with a duplicate to break sortedness deterministically
+        idx = idx + idx
+    slashing.attestation_1.attesting_indices = type(
+        slashing.attestation_1.attesting_indices
+    )(list(reversed(idx)))
+    yield from run_attester_slashing_processing(spec, state, slashing, valid=False)
+
+
+@with_phases(PHASE0)
+@spec_state_test
+def test_attester_slashing_all_intersecting_slashed(spec, state):
+    slashing = get_valid_attester_slashing(spec, state, signed_1=True, signed_2=True)
+    both = set(int(i) for i in slashing.attestation_1.attesting_indices) & set(
+        int(i) for i in slashing.attestation_2.attesting_indices
+    )
+    yield from run_attester_slashing_processing(spec, state, slashing)
+    for i in both:
+        assert state.validators[i].slashed
+
+
+@with_phases(PHASE0)
+@spec_state_test
+def test_attester_slashing_invalid_when_all_already_slashed(spec, state):
+    slashing = get_valid_attester_slashing(spec, state, signed_1=True, signed_2=True)
+    for i in set(int(i) for i in slashing.attestation_1.attesting_indices) | set(
+        int(i) for i in slashing.attestation_2.attesting_indices
+    ):
+        state.validators[i].slashed = True
+    # slashable data, but no NEW validator gets slashed -> invalid
+    yield from run_attester_slashing_processing(spec, state, slashing, valid=False)
+
+
+# == voluntary exits =======================================================
+
+
+def _age_state(spec, state):
+    next_slots(
+        spec,
+        state,
+        int(spec.config.SHARD_COMMITTEE_PERIOD) * int(spec.SLOTS_PER_EPOCH),
+    )
+
+
+@with_all_phases
+@spec_state_test
+def test_voluntary_exit_basic(spec, state):
+    _age_state(spec, state)
+    (signed_exit,) = prepare_signed_exits(spec, state, [2])
+    yield from run_voluntary_exit_processing(spec, state, signed_exit)
+    assert state.validators[2].exit_epoch != spec.FAR_FUTURE_EPOCH
+
+
+@with_phases(PHASE0)
+@spec_state_test
+def test_voluntary_exit_invalid_not_active_long_enough(spec, state):
+    (signed_exit,) = prepare_signed_exits(spec, state, [2])
+    yield from run_voluntary_exit_processing(spec, state, signed_exit, valid=False)
+
+
+@with_phases(PHASE0)
+@spec_state_test
+def test_voluntary_exit_invalid_future_epoch(spec, state):
+    _age_state(spec, state)
+    (signed_exit,) = prepare_signed_exits(spec, state, [2])
+    signed_exit.message.epoch = spec.get_current_epoch(state) + 10
+    yield from run_voluntary_exit_processing(spec, state, signed_exit, valid=False)
+
+
+@with_phases(PHASE0)
+@spec_state_test
+def test_voluntary_exit_invalid_already_exited(spec, state):
+    _age_state(spec, state)
+    state.validators[2].exit_epoch = spec.get_current_epoch(state) + 5
+    (signed_exit,) = prepare_signed_exits(spec, state, [2])
+    yield from run_voluntary_exit_processing(spec, state, signed_exit, valid=False)
+
+
+@with_phases(PHASE0)
+@spec_state_test
+def test_voluntary_exit_invalid_unknown_validator(spec, state):
+    _age_state(spec, state)
+    (signed_exit,) = prepare_signed_exits(spec, state, [2])
+    signed_exit.message.validator_index = len(state.validators)
+    yield from run_voluntary_exit_processing(spec, state, signed_exit, valid=False)
+
+
+@with_phases(PHASE0)
+@spec_state_test
+def test_voluntary_exit_invalid_inactive_validator(spec, state):
+    _age_state(spec, state)
+    state.validators[2].activation_epoch = spec.FAR_FUTURE_EPOCH
+    (signed_exit,) = prepare_signed_exits(spec, state, [2])
+    yield from run_voluntary_exit_processing(spec, state, signed_exit, valid=False)
+
+
+@with_phases(PHASE0)
+@always_bls
+@spec_state_test
+def test_voluntary_exit_invalid_signature(spec, state):
+    _age_state(spec, state)
+    (signed_exit,) = prepare_signed_exits(spec, state, [2])
+    signed_exit.signature = spec.BLSSignature(b"\x01" * 96)
+    yield from run_voluntary_exit_processing(spec, state, signed_exit, valid=False)
+
+
+@with_phases(PHASE0)
+@spec_state_test
+def test_voluntary_exit_ordering_churn(spec, state):
+    """Multiple exits in one epoch share the same computed exit epoch up to
+    the churn limit."""
+    _age_state(spec, state)
+    exits = prepare_signed_exits(spec, state, [1, 2])
+    for signed_exit in exits:
+        yield from run_voluntary_exit_processing(spec, state, signed_exit)
+    assert int(state.validators[1].exit_epoch) <= int(state.validators[2].exit_epoch)
+
+
+# == deposits ==============================================================
+
+
+@with_all_phases
+@spec_state_test
+def test_deposit_new_validator_top_level(spec, state):
+    index = len(state.validators)
+    amount = spec.MAX_EFFECTIVE_BALANCE
+    deposit = prepare_state_and_deposit(spec, state, index, amount, signed=True)
+    yield from run_deposit_processing(spec, state, deposit, index)
+
+
+@with_phases(PHASE0)
+@spec_state_test
+def test_deposit_top_up(spec, state):
+    amount = int(spec.MAX_EFFECTIVE_BALANCE) // 4
+    deposit = prepare_state_and_deposit(spec, state, 3, amount, signed=True)
+    pre = int(state.balances[3])
+    yield from run_deposit_processing(spec, state, deposit, 3)
+    assert int(state.balances[3]) == pre + amount
+
+
+@with_phases(PHASE0)
+@spec_state_test
+def test_deposit_invalid_proof(spec, state):
+    index = len(state.validators)
+    deposit = prepare_state_and_deposit(
+        spec, state, index, spec.MAX_EFFECTIVE_BALANCE, signed=True
+    )
+    deposit.proof[3] = ssz.Bytes32(b"\x07" * 32)
+    yield from run_deposit_processing(spec, state, deposit, index, valid=False)
+
+
+@with_phases(PHASE0)
+@spec_state_test
+def test_deposit_invalid_wrong_index(spec, state):
+    index = len(state.validators)
+    deposit = prepare_state_and_deposit(
+        spec, state, index, spec.MAX_EFFECTIVE_BALANCE, signed=True
+    )
+    state.eth1_deposit_index += 1  # proof targets the wrong leaf index now
+    yield from run_deposit_processing(spec, state, deposit, index, valid=False)
+
+
+@with_phases(PHASE0)
+@always_bls
+@spec_state_test
+def test_deposit_bad_signature_new_validator_ignored(spec, state):
+    """An invalid deposit signature does NOT fail the block — the deposit
+    is skipped (proof of possession failure is non-fatal, beacon-chain.md
+    apply_deposit)."""
+    index = len(state.validators)
+    deposit = prepare_state_and_deposit(
+        spec, state, index, spec.MAX_EFFECTIVE_BALANCE, signed=False
+    )
+    yield from run_deposit_processing(spec, state, deposit, index, effective=False)
+    assert len(state.validators) == index  # not added
+
+
+@with_phases(PHASE0)
+@spec_state_test
+def test_deposit_top_up_ignores_signature(spec, state):
+    """Top-ups skip the proof-of-possession check entirely."""
+    amount = int(spec.MAX_EFFECTIVE_BALANCE) // 8
+    deposit = prepare_state_and_deposit(spec, state, 4, amount, signed=False)
+    pre = int(state.balances[4])
+    yield from run_deposit_processing(spec, state, deposit, 4)
+    assert int(state.balances[4]) == pre + amount
+
+
+@with_phases(PHASE0)
+@spec_state_test
+def test_deposit_max_effective_balance_cap(spec, state):
+    index = len(state.validators)
+    amount = int(spec.MAX_EFFECTIVE_BALANCE) * 3
+    deposit = prepare_state_and_deposit(spec, state, index, amount, signed=True)
+    yield from run_deposit_processing(spec, state, deposit, index)
+    assert int(state.validators[index].effective_balance) == int(
+        spec.MAX_EFFECTIVE_BALANCE
+    )
+
+
+# == block header ==========================================================
+
+
+@with_all_phases
+@spec_state_test
+def test_block_header_basic(spec, state):
+    block = build_empty_block_for_next_slot(spec, state)
+    spec.process_slots(state, int(block.slot))
+    spec.process_block_header(state, block)
+    assert int(state.latest_block_header.slot) == int(block.slot)
+
+
+@with_phases(PHASE0)
+@spec_state_test
+def test_block_header_invalid_slot(spec, state):
+    block = build_empty_block_for_next_slot(spec, state)
+    spec.process_slots(state, int(block.slot))
+    block.slot = block.slot + 1
+    expect_assertion_error(lambda: spec.process_block_header(state, block))
+
+
+@with_phases(PHASE0)
+@spec_state_test
+def test_block_header_invalid_proposer(spec, state):
+    block = build_empty_block_for_next_slot(spec, state)
+    spec.process_slots(state, int(block.slot))
+    block.proposer_index = (int(block.proposer_index) + 1) % len(state.validators)
+    expect_assertion_error(lambda: spec.process_block_header(state, block))
+
+
+@with_phases(PHASE0)
+@spec_state_test
+def test_block_header_invalid_parent_root(spec, state):
+    block = build_empty_block_for_next_slot(spec, state)
+    spec.process_slots(state, int(block.slot))
+    block.parent_root = b"\xaa" * 32
+    expect_assertion_error(lambda: spec.process_block_header(state, block))
+
+
+@with_phases(PHASE0)
+@spec_state_test
+def test_block_header_invalid_slashed_proposer(spec, state):
+    block = build_empty_block_for_next_slot(spec, state)
+    spec.process_slots(state, int(block.slot))
+    state.validators[int(block.proposer_index)].slashed = True
+    expect_assertion_error(lambda: spec.process_block_header(state, block))
+
+
+@with_phases(PHASE0)
+@spec_state_test
+def test_block_header_invalid_multiple_in_slot(spec, state):
+    block = build_empty_block_for_next_slot(spec, state)
+    spec.process_slots(state, int(block.slot))
+    spec.process_block_header(state, block)
+    # a second header for the same slot must fail (parent root mismatch)
+    expect_assertion_error(lambda: spec.process_block_header(state, block))
+
+
+# == randao ================================================================
+
+
+@with_phases(PHASE0)
+@always_bls
+@spec_state_test
+def test_randao_valid_reveal(spec, state):
+    block = build_empty_block_for_next_slot(spec, state)
+    spec.process_slots(state, int(block.slot))
+    proposer = int(spec.get_beacon_proposer_index(state))
+    epoch = spec.get_current_epoch(state)
+    domain = spec.get_domain(state, spec.DOMAIN_RANDAO, epoch)
+    signing_root = spec.compute_signing_root(spec.Epoch(epoch), domain)
+    block.body.randao_reveal = bls.Sign(privkey_of(proposer), signing_root)
+    pre_mix = bytes(spec.get_randao_mix(state, epoch))
+    spec.process_randao(state, block.body)
+    assert bytes(spec.get_randao_mix(state, epoch)) != pre_mix
+
+
+@with_phases(PHASE0)
+@always_bls
+@spec_state_test
+def test_randao_invalid_reveal(spec, state):
+    block = build_empty_block_for_next_slot(spec, state)
+    spec.process_slots(state, int(block.slot))
+    block.body.randao_reveal = spec.BLSSignature(b"\x02" * 96)
+    expect_assertion_error(lambda: spec.process_randao(state, block.body))
+
+
+@with_phases(PHASE0)
+@always_bls
+@spec_state_test
+def test_randao_invalid_wrong_epoch_reveal(spec, state):
+    block = build_empty_block_for_next_slot(spec, state)
+    spec.process_slots(state, int(block.slot))
+    proposer = int(spec.get_beacon_proposer_index(state))
+    epoch = spec.get_current_epoch(state)
+    domain = spec.get_domain(state, spec.DOMAIN_RANDAO, epoch)
+    signing_root = spec.compute_signing_root(spec.Epoch(epoch + 1), domain)
+    block.body.randao_reveal = bls.Sign(privkey_of(proposer), signing_root)
+    expect_assertion_error(lambda: spec.process_randao(state, block.body))
+
+
+# == eth1 data =============================================================
+
+
+@with_phases(PHASE0)
+@spec_state_test
+def test_eth1_data_vote_accumulates(spec, state):
+    block = build_empty_block_for_next_slot(spec, state)
+    pre_votes = len(state.eth1_data_votes)
+    spec.process_eth1_data(state, block.body)
+    assert len(state.eth1_data_votes) == pre_votes + 1
+
+
+@with_phases(PHASE0)
+@spec_state_test
+def test_eth1_data_majority_adopts(spec, state):
+    block = build_empty_block_for_next_slot(spec, state)
+    new_data = spec.Eth1Data(
+        deposit_root=b"\x11" * 32,
+        deposit_count=int(state.eth1_data.deposit_count) + 1,
+        block_hash=b"\x22" * 32,
+    )
+    block.body.eth1_data = new_data
+    needed = int(spec.EPOCHS_PER_ETH1_VOTING_PERIOD) * int(spec.SLOTS_PER_EPOCH)
+    for _ in range(needed // 2 + 1):
+        spec.process_eth1_data(state, block.body)
+    assert bytes(ssz.hash_tree_root(state.eth1_data)) == bytes(
+        ssz.hash_tree_root(new_data)
+    )

@@ -1,0 +1,211 @@
+"""Epoch sub-transition tables: registry updates, slashings reset, randao
+mixes, historical roots, eth1-vote reset (reference analogue: one file per
+sub-transition under test/phase0/epoch_processing/; spec:
+specs/phase0/beacon-chain.md:1724-1846)."""
+
+from eth_consensus_specs_tpu.test_infra.context import (
+    spec_state_test,
+    with_all_phases,
+    with_phases,
+)
+from eth_consensus_specs_tpu.test_infra.state import next_epoch, next_slots
+
+PHASE0 = ["phase0"]
+
+
+def _run_to_boundary(spec, state):
+    target = int(state.slot) + int(spec.SLOTS_PER_EPOCH) - int(state.slot) % int(
+        spec.SLOTS_PER_EPOCH
+    )
+    spec.process_slots(state, target)
+
+
+# == registry updates ======================================================
+
+
+@with_all_phases
+@spec_state_test
+def test_registry_new_deposit_enters_activation_queue(spec, state):
+    index = 2
+    state.validators[index].activation_eligibility_epoch = spec.FAR_FUTURE_EPOCH
+    state.validators[index].activation_epoch = spec.FAR_FUTURE_EPOCH
+    _run_to_boundary(spec, state)
+    assert (
+        state.validators[index].activation_eligibility_epoch != spec.FAR_FUTURE_EPOCH
+    )
+
+
+@with_phases(PHASE0)
+@spec_state_test
+def test_registry_low_balance_not_eligible(spec, state):
+    index = 2
+    state.validators[index].activation_eligibility_epoch = spec.FAR_FUTURE_EPOCH
+    state.validators[index].activation_epoch = spec.FAR_FUTURE_EPOCH
+    state.validators[index].effective_balance = spec.EFFECTIVE_BALANCE_INCREMENT
+    _run_to_boundary(spec, state)
+    assert (
+        state.validators[index].activation_eligibility_epoch == spec.FAR_FUTURE_EPOCH
+    )
+
+
+@with_phases(PHASE0)
+@spec_state_test
+def test_registry_ejection_below_ejection_balance(spec, state):
+    index = 3
+    state.validators[index].effective_balance = spec.config.EJECTION_BALANCE
+    assert state.validators[index].exit_epoch == spec.FAR_FUTURE_EPOCH
+    _run_to_boundary(spec, state)
+    assert state.validators[index].exit_epoch != spec.FAR_FUTURE_EPOCH
+
+
+@with_phases(PHASE0)
+@spec_state_test
+def test_registry_no_ejection_at_threshold_plus_increment(spec, state):
+    index = 3
+    state.validators[index].effective_balance = int(spec.config.EJECTION_BALANCE) + int(
+        spec.EFFECTIVE_BALANCE_INCREMENT
+    )
+    _run_to_boundary(spec, state)
+    assert state.validators[index].exit_epoch == spec.FAR_FUTURE_EPOCH
+
+
+@with_phases(PHASE0)
+@spec_state_test
+def test_registry_activation_after_finality_delay(spec, state):
+    """An eligible validator activates only once its eligibility epoch is
+    finalized."""
+    index = 4
+    state.validators[index].activation_eligibility_epoch = spec.FAR_FUTURE_EPOCH
+    state.validators[index].activation_epoch = spec.FAR_FUTURE_EPOCH
+    _run_to_boundary(spec, state)  # becomes eligible
+    assert state.validators[index].activation_epoch == spec.FAR_FUTURE_EPOCH
+    # force finality past the eligibility epoch
+    state.finalized_checkpoint.epoch = spec.get_current_epoch(state) + 1
+    _run_to_boundary(spec, state)
+    assert state.validators[index].activation_epoch != spec.FAR_FUTURE_EPOCH
+
+
+@with_phases(PHASE0)
+@spec_state_test
+def test_registry_churn_limits_activations(spec, state):
+    """More pending activations than the churn limit: only churn-many
+    activate per epoch (phase0 queue semantics).  The applicable limit is
+    computed over the active set AT the epoch transition (after the
+    deactivations below), so derive the expectation from a probe copy."""
+    pending = int(spec.get_validator_churn_limit(state)) + 2
+    eligible_epoch = int(spec.get_current_epoch(state))
+    for i in range(pending):
+        state.validators[i].activation_eligibility_epoch = max(eligible_epoch, 1)
+        state.validators[i].activation_epoch = spec.FAR_FUTURE_EPOCH
+    state.finalized_checkpoint.epoch = eligible_epoch + 1
+    expected_churn = int(spec.get_validator_churn_limit(state))
+    _run_to_boundary(spec, state)
+    activated = sum(
+        1
+        for i in range(pending)
+        if state.validators[i].activation_epoch != spec.FAR_FUTURE_EPOCH
+    )
+    assert activated == min(expected_churn, pending)
+    assert activated < pending  # the queue is genuinely capped
+
+
+# == slashings / randao / historical / eth1 resets =========================
+
+
+@with_phases(PHASE0)
+@spec_state_test
+def test_slashings_vector_slot_resets(spec, state):
+    epoch = int(spec.get_current_epoch(state))
+    vec = int(spec.EPOCHS_PER_SLASHINGS_VECTOR)
+    target_slot_index = (epoch + 1) % vec
+    state.slashings[target_slot_index] = 12345
+    _run_to_boundary(spec, state)
+    assert int(state.slashings[target_slot_index]) == 0
+
+
+@with_phases(PHASE0)
+@spec_state_test
+def test_randao_mix_carried_forward(spec, state):
+    epoch = int(spec.get_current_epoch(state))
+    vec = int(spec.EPOCHS_PER_HISTORICAL_VECTOR)
+    current_mix = bytes(state.randao_mixes[epoch % vec])
+    _run_to_boundary(spec, state)
+    assert bytes(state.randao_mixes[(epoch + 1) % vec]) == current_mix
+
+
+@with_phases(PHASE0)
+@spec_state_test
+def test_historical_roots_accumulate_at_period(spec, state):
+    pre = len(state.historical_roots) if hasattr(state, "historical_roots") else None
+    period_slots = int(spec.SLOTS_PER_HISTORICAL_ROOT)
+    spec.process_slots(state, period_slots)
+    if pre is not None:
+        assert len(state.historical_roots) == pre + 1
+
+
+@with_phases(PHASE0)
+@spec_state_test
+def test_eth1_data_votes_reset_at_voting_period(spec, state):
+    block_body_like = spec.Eth1Data(
+        deposit_root=b"\x01" * 32, deposit_count=1, block_hash=b"\x02" * 32
+    )
+    state.eth1_data_votes.append(block_body_like)
+    period_slots = int(spec.EPOCHS_PER_ETH1_VOTING_PERIOD) * int(spec.SLOTS_PER_EPOCH)
+    spec.process_slots(state, period_slots)
+    assert len(state.eth1_data_votes) == 0
+
+
+@with_phases(PHASE0)
+@spec_state_test
+def test_participation_rotates(spec, state):
+    next_epoch(spec, state)
+    from eth_consensus_specs_tpu.test_infra.attestations import (
+        get_valid_attestations_at_slot,
+    )
+
+    atts = get_valid_attestations_at_slot(spec, state, int(state.slot))
+    next_slots(spec, state, int(spec.MIN_ATTESTATION_INCLUSION_DELAY))
+    for a in atts:
+        spec.process_attestation(state, a)
+    assert len(state.current_epoch_attestations) > 0
+    _run_to_boundary(spec, state)
+    # current rotated into previous; current cleared
+    assert len(state.current_epoch_attestations) == 0
+
+
+@with_phases(PHASE0)
+@spec_state_test
+def test_effective_balance_hysteresis_downward(spec, state):
+    index = 5
+    incr = int(spec.EFFECTIVE_BALANCE_INCREMENT)
+    hyst = incr // int(spec.HYSTERESIS_QUOTIENT)
+    down = hyst * int(spec.HYSTERESIS_DOWNWARD_MULTIPLIER)
+    # drop the balance just past the downward threshold
+    state.balances[index] = int(state.validators[index].effective_balance) - down - 1
+    pre_eff = int(state.validators[index].effective_balance)
+    _run_to_boundary(spec, state)
+    assert int(state.validators[index].effective_balance) < pre_eff
+
+
+@with_phases(PHASE0)
+@spec_state_test
+def test_effective_balance_hysteresis_no_move_within_band(spec, state):
+    index = 5
+    incr = int(spec.EFFECTIVE_BALANCE_INCREMENT)
+    hyst = incr // int(spec.HYSTERESIS_QUOTIENT)
+    down = hyst * int(spec.HYSTERESIS_DOWNWARD_MULTIPLIER)
+    state.balances[index] = int(state.validators[index].effective_balance) - down + 1
+    pre_eff = int(state.validators[index].effective_balance)
+    _run_to_boundary(spec, state)
+    assert int(state.validators[index].effective_balance) == pre_eff
+
+
+@with_phases(PHASE0)
+@spec_state_test
+def test_justification_bits_shift_each_epoch(spec, state):
+    next_epoch(spec, state)
+    next_epoch(spec, state)
+    bits_before = list(state.justification_bits)
+    _run_to_boundary(spec, state)
+    bits_after = list(state.justification_bits)
+    assert bits_after[1:] == bits_before[: len(bits_before) - 1]
