@@ -26,8 +26,20 @@ test-serial:
 parity:
 	$(PYTHON) -m pytest tests/parity/ -q
 
+# mainnet-SHAPED smoke: full 16,384-validator genesis, 64-committee slots,
+# mainnet preset — a driver-runnable subset (not nightly-only).  The
+# attestation-dense suites stay in `make test` under SPEC_TEST_PRESET.
+mainnet-smoke:
+	SPEC_TEST_PRESET=mainnet $(PYTHON) -m pytest \
+	  tests/phase0/test_sanity.py -k "empty_block or slots or invalid_state_root" \
+	  -q
+	SPEC_TEST_PRESET=mainnet $(PYTHON) -m pytest \
+	  tests/phase0/test_process_attestation.py -k "one_basic" \
+	  tests/phase0/test_block_operations.py -k "voluntary_exit_basic or proposer_slashing_basic" \
+	  -q
+
 test-fast:
-	$(PYTHON) -m pytest tests/ -q --ignore=tests/phase0/test_fork_choice.py
+	$(PYTHON) -m pytest tests/ -q -m "not slow" --ignore=tests/phase0/test_fork_choice.py
 
 lint:
 	-$(PYTHON) -m ruff check eth_consensus_specs_tpu/ tests/

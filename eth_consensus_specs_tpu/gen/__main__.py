@@ -19,11 +19,20 @@ def main() -> None:
     parser.add_argument("--verbose", "-v", action="store_true")
     args = parser.parse_args()
 
+    runners = tuple(args.runners) if args.runners else None
     cases = discover_test_cases(
         presets=tuple(args.presets),
         forks=tuple(args.forks) if args.forks else None,
-        runners=tuple(args.runners) if args.runners else None,
+        runners=runners,
     )
+    # dedicated direct-computation runners (bls/kzg/shuffling/ssz_generic);
+    # the --forks filter applies to their cases like any other
+    from .runners import get_runner_cases
+
+    runner_cases = get_runner_cases(presets=tuple(args.presets), runners=runners)
+    if args.forks:
+        runner_cases = [c for c in runner_cases if c.fork in args.forks]
+    cases = list(cases) + runner_cases
     stats = run_generator(cases, args.output, verbose=args.verbose)
     print(json.dumps({"cases": len(cases), **stats}))
 

@@ -60,6 +60,13 @@ class Dumper:
         for name, value in parts:
             if value is None:
                 continue  # invalid-case convention: no post state emitted
+            if name.endswith(".yaml"):
+                # standalone yaml part (the bls/shuffling/ssz_generic
+                # format families dump `data.yaml` per case, reference
+                # tests/formats/{bls,shuffling}/README.md)
+                with open(os.path.join(case_dir, name), "w") as f:
+                    yaml.safe_dump(_yamlable(value), f, default_flow_style=None)
+                continue
             if _is_view(value):
                 self.dump_ssz(case_dir, name, serialize(value))
             elif isinstance(value, (bytes, bytearray)):

@@ -1,0 +1,32 @@
+"""Dedicated vector runners — vectors computed directly, not replayed from
+decorated tests (reference analogue: tests/generators/runners/{bls,kzg,
+shuffling,ssz_generic}.py; tests/generators/main.py:6-20 loads 19 such
+runner modules and merges their cases with the from-tests bridge).
+
+Each module exposes ``get_test_cases(presets) -> list[TestCase]``; the
+CLI merges them with the from-tests discovery under --runners filtering.
+Formats are documented per runner in docs/formats.md.
+"""
+
+from __future__ import annotations
+
+from . import bls as bls_runner
+from . import kzg as kzg_runner
+from . import shuffling as shuffling_runner
+from . import ssz_generic as ssz_generic_runner
+
+RUNNER_MODULES = {
+    "bls": bls_runner,
+    "kzg": kzg_runner,
+    "shuffling": shuffling_runner,
+    "ssz_generic": ssz_generic_runner,
+}
+
+
+def get_runner_cases(presets=("minimal",), runners=None) -> list:
+    cases = []
+    for name, mod in RUNNER_MODULES.items():
+        if runners is not None and name not in runners:
+            continue
+        cases.extend(mod.get_test_cases(presets))
+    return cases

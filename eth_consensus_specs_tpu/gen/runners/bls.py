@@ -1,0 +1,159 @@
+"""BLS runner: sign/verify/aggregate vectors computed directly against the
+framework's BLS core (reference: tests/generators/runners/bls.py; format:
+tests/formats/bls/README.md — one data.yaml with {input, output} per case).
+
+Fork/preset-independent crypto; emitted once under the phase0/general
+coordinates like the reference's `general` config runners."""
+
+from __future__ import annotations
+
+from eth_consensus_specs_tpu.utils import bls
+
+from ..gen_from_tests import TestCase
+
+_PRIVKEYS = [1, 2, 3, 12345, 2**200 + 7]
+_MESSAGES = [b"\x00" * 32, b"\xab" * 32, b"\x56" * 32]
+
+
+def _hex(b: bytes) -> str:
+    return "0x" + bytes(b).hex()
+
+
+def _sign_cases():
+    for i, sk in enumerate(_PRIVKEYS):
+        for j, msg in enumerate(_MESSAGES):
+            sig = bls.Sign(sk, msg)
+            yield (
+                f"sign_case_{i}_{j}",
+                {
+                    "input": {
+                        "privkey": _hex(sk.to_bytes(32, "big")),
+                        "message": _hex(msg),
+                    },
+                    "output": _hex(sig),
+                },
+            )
+
+
+def _verify_cases():
+    sk, other = _PRIVKEYS[0], _PRIVKEYS[1]
+    msg = _MESSAGES[1]
+    pk = bls.SkToPk(sk)
+    sig = bls.Sign(sk, msg)
+    yield (
+        "verify_valid",
+        {
+            "input": {"pubkey": _hex(pk), "message": _hex(msg), "signature": _hex(sig)},
+            "output": True,
+        },
+    )
+    yield (
+        "verify_wrong_pubkey",
+        {
+            "input": {
+                "pubkey": _hex(bls.SkToPk(other)),
+                "message": _hex(msg),
+                "signature": _hex(sig),
+            },
+            "output": False,
+        },
+    )
+    yield (
+        "verify_tampered_signature",
+        {
+            "input": {
+                "pubkey": _hex(pk),
+                "message": _hex(msg),
+                "signature": _hex(b"\x01" + bytes(sig)[1:]),
+            },
+            "output": False,
+        },
+    )
+    yield (
+        "verify_infinity_pubkey",
+        {
+            "input": {
+                "pubkey": _hex(bls.G1_POINT_AT_INFINITY),
+                "message": _hex(msg),
+                "signature": _hex(bls.G2_POINT_AT_INFINITY),
+            },
+            "output": False,
+        },
+    )
+
+
+def _aggregate_cases():
+    msg = _MESSAGES[0]
+    sigs = [bls.Sign(sk, msg) for sk in _PRIVKEYS[:3]]
+    yield (
+        "aggregate_3",
+        {"input": [_hex(s) for s in sigs], "output": _hex(bls.Aggregate(sigs))},
+    )
+    pks = [bls.SkToPk(sk) for sk in _PRIVKEYS[:3]]
+    agg_sig = bls.Aggregate(sigs)
+    yield (
+        "fast_aggregate_verify_valid",
+        {
+            "input": {
+                "pubkeys": [_hex(p) for p in pks],
+                "message": _hex(msg),
+                "signature": _hex(agg_sig),
+            },
+            "output": True,
+        },
+    )
+    yield (
+        "fast_aggregate_verify_extra_pubkey",
+        {
+            "input": {
+                "pubkeys": [_hex(p) for p in pks + [bls.SkToPk(_PRIVKEYS[3])]],
+                "message": _hex(msg),
+                "signature": _hex(agg_sig),
+            },
+            "output": False,
+        },
+    )
+    msgs = [bytes([i]) * 32 for i in range(3)]
+    per_msg_sigs = [bls.Sign(sk, m) for sk, m in zip(_PRIVKEYS[:3], msgs)]
+    yield (
+        "aggregate_verify_valid",
+        {
+            "input": {
+                "pubkeys": [_hex(p) for p in pks],
+                "messages": [_hex(m) for m in msgs],
+                "signature": _hex(bls.Aggregate(per_msg_sigs)),
+            },
+            "output": True,
+        },
+    )
+
+
+def get_test_cases(presets=("minimal",)) -> list[TestCase]:
+    prev = bls.bls_active
+    bls.bls_active = True
+    try:
+        all_cases = list(_sign_cases()) + list(_verify_cases()) + list(_aggregate_cases())
+    finally:
+        bls.bls_active = prev
+    out = []
+    _HANDLERS = (
+        "fast_aggregate_verify",
+        "aggregate_verify",
+        "aggregate",
+        "verify",
+        "sign",
+    )
+    for name, payload in all_cases:
+        handler = next(h for h in _HANDLERS if name.startswith(h))
+        out.append(
+            TestCase(
+                preset="general",
+                fork="phase0",
+                runner="bls",
+                handler=handler,
+                suite="bls",
+                case_name=name,
+                case_fn=(lambda payload=payload: iter([("data.yaml", payload)])),
+            )
+        )
+    return out

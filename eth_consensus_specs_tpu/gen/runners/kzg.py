@@ -1,0 +1,119 @@
+"""KZG runner: blob commitment / proof vectors computed directly
+(reference: tests/generators/runners/kzg.py; formats:
+tests/formats/kzg_4844/README.md — data.yaml with {input, output}).
+
+Uses whichever trusted setup the framework has active (the ceremony
+setup when loaded, else the insecure testing setup) — vectors are
+self-consistent either way."""
+
+from __future__ import annotations
+
+import hashlib
+
+from ..gen_from_tests import TestCase
+
+
+def _make_blob(tag: bytes):
+    from eth_consensus_specs_tpu.crypto import kzg
+
+    out = []
+    for i in range(kzg.FIELD_ELEMENTS_PER_BLOB):
+        h = hashlib.sha256(tag + i.to_bytes(4, "big")).digest()
+        out.append((int.from_bytes(h, "big") % kzg.BLS_MODULUS).to_bytes(32, "big"))
+    return b"".join(out)
+
+
+def _hex(b: bytes) -> str:
+    return "0x" + bytes(b).hex()
+
+
+def _build_cases():
+    from eth_consensus_specs_tpu.crypto import kzg
+
+    blob = _make_blob(b"kzg-runner")
+    commitment = kzg.blob_to_kzg_commitment(blob)
+    z = (7).to_bytes(32, "big")
+    proof, y = kzg.compute_kzg_proof(blob, z)
+    blob_proof = kzg.compute_blob_kzg_proof(blob, commitment)
+
+    yield (
+        "blob_to_kzg_commitment",
+        "blob_to_kzg_commitment_case_0",
+        {"input": {"blob": _hex(blob)}, "output": _hex(commitment)},
+    )
+    yield (
+        "compute_kzg_proof",
+        "compute_kzg_proof_case_0",
+        {
+            "input": {"blob": _hex(blob), "z": _hex(z)},
+            "output": [_hex(proof), _hex(y)],
+        },
+    )
+    yield (
+        "verify_kzg_proof",
+        "verify_kzg_proof_valid",
+        {
+            "input": {
+                "commitment": _hex(commitment),
+                "z": _hex(z),
+                "y": _hex(y),
+                "proof": _hex(proof),
+            },
+            "output": True,
+        },
+    )
+    wrong_y = (int.from_bytes(y, "big") + 1).to_bytes(32, "big")
+    yield (
+        "verify_kzg_proof",
+        "verify_kzg_proof_wrong_y",
+        {
+            "input": {
+                "commitment": _hex(commitment),
+                "z": _hex(z),
+                "y": _hex(wrong_y),
+                "proof": _hex(proof),
+            },
+            "output": False,
+        },
+    )
+    yield (
+        "verify_blob_kzg_proof",
+        "verify_blob_kzg_proof_valid",
+        {
+            "input": {
+                "blob": _hex(blob),
+                "commitment": _hex(commitment),
+                "proof": _hex(blob_proof),
+            },
+            "output": True,
+        },
+    )
+    yield (
+        "verify_blob_kzg_proof_batch",
+        "verify_blob_kzg_proof_batch_valid",
+        {
+            "input": {
+                "blobs": [_hex(blob)],
+                "commitments": [_hex(commitment)],
+                "proofs": [_hex(blob_proof)],
+            },
+            "output": True,
+        },
+    )
+
+
+def get_test_cases(presets=("minimal",)) -> list[TestCase]:
+    out = []
+    for handler, name, payload in _build_cases():
+        out.append(
+            TestCase(
+                preset="general",
+                fork="deneb",
+                runner="kzg",
+                handler=handler,
+                suite="kzg-mainnet",
+                case_name=name,
+                case_fn=(lambda payload=payload: iter([("data.yaml", payload)])),
+            )
+        )
+    return out

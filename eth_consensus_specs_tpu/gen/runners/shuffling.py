@@ -1,0 +1,50 @@
+"""Shuffling runner: whole-permutation vectors computed directly
+(reference: tests/generators/runners/shuffling.py:16-58; format:
+tests/formats/shuffling/README.md — {seed, count, mapping} in mapping.yaml)."""
+
+from __future__ import annotations
+
+from eth_consensus_specs_tpu.ops.shuffle import shuffle_permutation
+
+from ..gen_from_tests import TestCase
+
+_COUNTS = [0, 1, 2, 3, 5, 16, 100, 333]
+
+
+def _case_fn(seed: bytes, count: int, rounds: int):
+    def run():
+        perm = shuffle_permutation(count, seed, rounds)
+        yield (
+            "mapping.yaml",
+            {
+                "seed": "0x" + seed.hex(),
+                "count": count,
+                "mapping": [int(x) for x in perm],
+            },
+        )
+
+    return run
+
+
+def get_test_cases(presets=("minimal",)) -> list[TestCase]:
+    from eth_consensus_specs_tpu.forks import get_spec
+
+    cases = []
+    for preset in presets:
+        spec = get_spec("phase0", preset)
+        rounds = int(spec.SHUFFLE_ROUND_COUNT)
+        for seed_i in range(4):
+            seed = bytes([seed_i]) * 32
+            for count in _COUNTS:
+                cases.append(
+                    TestCase(
+                        preset=preset,
+                        fork="phase0",
+                        runner="shuffling",
+                        handler="core",
+                        suite="shuffle",
+                        case_name=f"shuffle_0x{seed[:2].hex()}_{count}",
+                        case_fn=_case_fn(seed, count, rounds),
+                    )
+                )
+    return cases

@@ -1,0 +1,100 @@
+"""ssz_generic runner: serialization vectors for the base type system
+(reference: tests/generators/runners/ssz_generic.py; formats:
+tests/formats/ssz_generic/README.md — serialized.ssz_snappy + value/meta
+for valid cases, lone serialized bytes for invalid ones).
+
+NOTE: no `from __future__ import annotations` here — the Container
+definitions below need eagerly-evaluated field annotations."""
+
+from eth_consensus_specs_tpu import ssz
+
+from ..gen_from_tests import TestCase
+
+
+class _SingleFieldContainer(ssz.Container):
+    A: ssz.uint64
+
+
+class _FixedContainer(ssz.Container):
+    A: ssz.uint8
+    B: ssz.uint64
+    C: ssz.uint32
+
+
+class _VarContainer(ssz.Container):
+    A: ssz.uint16
+    B: ssz.List[ssz.uint16, 1024]
+
+
+def _valid_cases():
+    yield "uints", "uint64_max", ssz.uint64(2**64 - 1)
+    yield "uints", "uint64_zero", ssz.uint64(0)
+    yield "uints", "uint256_big", ssz.uint256(2**255 + 12345)
+    yield "boolean", "true", ssz.boolean(True)
+    yield "boolean", "false", ssz.boolean(False)
+    yield "basic_vector", "vec_uint64_4", ssz.Vector[ssz.uint64, 4]([1, 2, 3, 2**63])
+    yield "bitvector", "bitvec_9", ssz.Bitvector[9]([True, False] * 4 + [True])
+    yield "bitlist", "bitlist_7_of_16", ssz.Bitlist[16]([True] * 7)
+    yield "bitlist", "bitlist_empty", ssz.Bitlist[16]([])
+    yield "containers", "single_field", _SingleFieldContainer(A=7)
+    yield "containers", "fixed", _FixedContainer(A=1, B=2**40, C=3)
+    yield "containers", "variable", _VarContainer(A=9, B=[1, 2, 3])
+
+
+def _invalid_cases():
+    # (handler, name, raw serialized bytes that must FAIL deserialization)
+    yield "uints", "uint64_too_short", ssz.uint64, b"\x01" * 7
+    yield "uints", "uint64_too_long", ssz.uint64, b"\x01" * 9
+    yield "bitvector", "bitvec_9_high_padding_bits", ssz.Bitvector[9], b"\xff\xff"
+    yield "bitlist", "bitlist_no_delimiter", ssz.Bitlist[16], b"\x00\x00\x00"
+    yield "containers", "fixed_truncated", _FixedContainer, b"\x01\x02"
+
+
+def _valid_fn(value):
+    def run():
+        yield "serialized", bytes(ssz.serialize(value))
+        yield "root.yaml", {"root": "0x" + bytes(ssz.hash_tree_root(value)).hex()}
+
+    return run
+
+
+def _invalid_fn(typ, raw):
+    def run():
+        try:
+            ssz.deserialize(typ, raw)
+        except (ssz.DeserializationError, ValueError, IndexError):
+            pass
+        else:  # pragma: no cover - generator sanity
+            raise AssertionError("invalid-case bytes unexpectedly deserialized")
+        yield "serialized", raw
+
+    return run
+
+
+def get_test_cases(presets=("minimal",)) -> list[TestCase]:
+    out = []
+    for handler, name, value in _valid_cases():
+        out.append(
+            TestCase(
+                preset="general",
+                fork="phase0",
+                runner="ssz_generic",
+                handler=handler,
+                suite="valid",
+                case_name=name,
+                case_fn=_valid_fn(value),
+            )
+        )
+    for handler, name, typ, raw in _invalid_cases():
+        out.append(
+            TestCase(
+                preset="general",
+                fork="phase0",
+                runner="ssz_generic",
+                handler=handler,
+                suite="invalid",
+                case_name=name,
+                case_fn=_invalid_fn(typ, raw),
+            )
+        )
+    return out

@@ -68,9 +68,9 @@ def expect_assertion_error(fn):
 
 
 def _default_validator_count(spec) -> int:
-    # capped below the deterministic key count so the mainnet preset
-    # (which would want 8*32*64 = 16k validators) stays drivable with the
-    # 8k keys, leaving spare keys for tests that add NEW validators
+    # mainnet preset now gets its full 8*32*64 = 16,384 validators —
+    # mainnet-SHAPED committees (>= MIN_GENESIS_ACTIVE_VALIDATOR_COUNT,
+    # configs/mainnet.yaml:27) — since the key space is 32k and lazy
     from .keys import KEY_COUNT
 
     return min(8 * spec.SLOTS_PER_EPOCH * spec.MAX_COMMITTEES_PER_SLOT, KEY_COUNT - 64)

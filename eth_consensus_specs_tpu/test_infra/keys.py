@@ -10,7 +10,10 @@ from __future__ import annotations
 from eth_consensus_specs_tpu.utils import bls
 from eth_consensus_specs_tpu.crypto import signature as _sig
 
-KEY_COUNT = 8192
+# 32k keys cover mainnet-shaped validator sets (MIN_GENESIS 16,384,
+# configs/mainnet.yaml:27) with headroom for deposit tests; derivation is
+# lazy and the native G1 path makes a full mainnet set derive in seconds
+KEY_COUNT = 32768
 
 privkeys = list(range(1, KEY_COUNT + 1))
 

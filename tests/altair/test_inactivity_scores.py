@@ -11,17 +11,10 @@ from eth_consensus_specs_tpu.test_infra.state import next_epoch
 ALTAIR_PLUS = ["altair", "deneb", "electra"]
 
 
-def _boundary(spec, state):
-    target = int(state.slot) + int(spec.SLOTS_PER_EPOCH) - int(state.slot) % int(
-        spec.SLOTS_PER_EPOCH
-    )
-    spec.process_slots(state, target)
-
-
 @with_phases(ALTAIR_PLUS)
 @spec_state_test
 def test_scores_zero_at_genesis_epoch_boundary(spec, state):
-    _boundary(spec, state)
+    next_epoch(spec, state)
     assert all(int(s) == 0 for s in state.inactivity_scores)
 
 
@@ -30,7 +23,7 @@ def test_scores_zero_at_genesis_epoch_boundary(spec, state):
 def test_nonparticipation_raises_scores(spec, state):
     next_epoch(spec, state)
     next_epoch(spec, state)  # prev epoch now has zero participation
-    _boundary(spec, state)
+    next_epoch(spec, state)
     bias = int(spec.config.INACTIVITY_SCORE_BIAS)
     recovery = int(spec.config.INACTIVITY_SCORE_RECOVERY_RATE)
     expected = max(bias - recovery, 0)  # leak-free recovery applies
@@ -42,7 +35,7 @@ def test_nonparticipation_raises_scores(spec, state):
 def test_full_participation_keeps_scores_zero(spec, state):
     next_epoch(spec, state)
     _, _, state = next_epoch_with_attestations(spec, state, True, False)
-    _boundary(spec, state)
+    next_epoch(spec, state)
     assert all(int(s) == 0 for s in state.inactivity_scores)
 
 
@@ -53,7 +46,7 @@ def test_participating_score_decrements(spec, state):
     for i in range(len(state.inactivity_scores)):
         state.inactivity_scores[i] = 10
     _, _, state = next_epoch_with_attestations(spec, state, True, False)
-    _boundary(spec, state)
+    next_epoch(spec, state)
     # -1 for participation, then leak-free recovery
     recovery = int(spec.config.INACTIVITY_SCORE_RECOVERY_RATE)
     expected = max(10 - 1 - recovery, 0)
@@ -65,7 +58,7 @@ def test_participating_score_decrements(spec, state):
 def test_score_floors_at_zero(spec, state):
     next_epoch(spec, state)
     _, _, state = next_epoch_with_attestations(spec, state, True, False)
-    _boundary(spec, state)
+    next_epoch(spec, state)
     assert all(int(s) >= 0 for s in state.inactivity_scores)
 
 
@@ -98,5 +91,5 @@ def test_exited_validators_score_untouched(spec, state):
     next_epoch(spec, state)
     frozen = int(state.inactivity_scores[idx])
     next_epoch(spec, state)
-    _boundary(spec, state)
+    next_epoch(spec, state)
     assert int(state.inactivity_scores[idx]) == frozen

@@ -14,18 +14,17 @@ from eth_consensus_specs_tpu.test_infra.context import (
     with_phases,
 )
 from eth_consensus_specs_tpu.test_infra.deposits import (
-    build_deposit,
     prepare_state_and_deposit,
     run_deposit_processing,
 )
-from eth_consensus_specs_tpu.test_infra.keys import privkey_of, pubkey
+from eth_consensus_specs_tpu.test_infra.keys import privkey_of
 from eth_consensus_specs_tpu.test_infra.slashings import (
     get_valid_attester_slashing,
     get_valid_proposer_slashing,
     run_attester_slashing_processing,
     run_proposer_slashing_processing,
 )
-from eth_consensus_specs_tpu.test_infra.state import next_epoch, next_slots
+from eth_consensus_specs_tpu.test_infra.state import next_slots
 from eth_consensus_specs_tpu.test_infra.voluntary_exits import (
     prepare_signed_exits,
     run_voluntary_exit_processing,

@@ -13,13 +13,6 @@ from eth_consensus_specs_tpu.test_infra.state import next_epoch, next_slots
 PHASE0 = ["phase0"]
 
 
-def _run_to_boundary(spec, state):
-    target = int(state.slot) + int(spec.SLOTS_PER_EPOCH) - int(state.slot) % int(
-        spec.SLOTS_PER_EPOCH
-    )
-    spec.process_slots(state, target)
-
-
 # == registry updates ======================================================
 
 
@@ -29,7 +22,7 @@ def test_registry_new_deposit_enters_activation_queue(spec, state):
     index = 2
     state.validators[index].activation_eligibility_epoch = spec.FAR_FUTURE_EPOCH
     state.validators[index].activation_epoch = spec.FAR_FUTURE_EPOCH
-    _run_to_boundary(spec, state)
+    next_epoch(spec, state)
     assert (
         state.validators[index].activation_eligibility_epoch != spec.FAR_FUTURE_EPOCH
     )
@@ -42,7 +35,7 @@ def test_registry_low_balance_not_eligible(spec, state):
     state.validators[index].activation_eligibility_epoch = spec.FAR_FUTURE_EPOCH
     state.validators[index].activation_epoch = spec.FAR_FUTURE_EPOCH
     state.validators[index].effective_balance = spec.EFFECTIVE_BALANCE_INCREMENT
-    _run_to_boundary(spec, state)
+    next_epoch(spec, state)
     assert (
         state.validators[index].activation_eligibility_epoch == spec.FAR_FUTURE_EPOCH
     )
@@ -54,7 +47,7 @@ def test_registry_ejection_below_ejection_balance(spec, state):
     index = 3
     state.validators[index].effective_balance = spec.config.EJECTION_BALANCE
     assert state.validators[index].exit_epoch == spec.FAR_FUTURE_EPOCH
-    _run_to_boundary(spec, state)
+    next_epoch(spec, state)
     assert state.validators[index].exit_epoch != spec.FAR_FUTURE_EPOCH
 
 
@@ -65,7 +58,7 @@ def test_registry_no_ejection_at_threshold_plus_increment(spec, state):
     state.validators[index].effective_balance = int(spec.config.EJECTION_BALANCE) + int(
         spec.EFFECTIVE_BALANCE_INCREMENT
     )
-    _run_to_boundary(spec, state)
+    next_epoch(spec, state)
     assert state.validators[index].exit_epoch == spec.FAR_FUTURE_EPOCH
 
 
@@ -77,11 +70,11 @@ def test_registry_activation_after_finality_delay(spec, state):
     index = 4
     state.validators[index].activation_eligibility_epoch = spec.FAR_FUTURE_EPOCH
     state.validators[index].activation_epoch = spec.FAR_FUTURE_EPOCH
-    _run_to_boundary(spec, state)  # becomes eligible
+    next_epoch(spec, state)  # becomes eligible
     assert state.validators[index].activation_epoch == spec.FAR_FUTURE_EPOCH
     # force finality past the eligibility epoch
     state.finalized_checkpoint.epoch = spec.get_current_epoch(state) + 1
-    _run_to_boundary(spec, state)
+    next_epoch(spec, state)
     assert state.validators[index].activation_epoch != spec.FAR_FUTURE_EPOCH
 
 
@@ -99,7 +92,7 @@ def test_registry_churn_limits_activations(spec, state):
         state.validators[i].activation_epoch = spec.FAR_FUTURE_EPOCH
     state.finalized_checkpoint.epoch = eligible_epoch + 1
     expected_churn = int(spec.get_validator_churn_limit(state))
-    _run_to_boundary(spec, state)
+    next_epoch(spec, state)
     activated = sum(
         1
         for i in range(pending)
@@ -119,7 +112,7 @@ def test_slashings_vector_slot_resets(spec, state):
     vec = int(spec.EPOCHS_PER_SLASHINGS_VECTOR)
     target_slot_index = (epoch + 1) % vec
     state.slashings[target_slot_index] = 12345
-    _run_to_boundary(spec, state)
+    next_epoch(spec, state)
     assert int(state.slashings[target_slot_index]) == 0
 
 
@@ -129,7 +122,7 @@ def test_randao_mix_carried_forward(spec, state):
     epoch = int(spec.get_current_epoch(state))
     vec = int(spec.EPOCHS_PER_HISTORICAL_VECTOR)
     current_mix = bytes(state.randao_mixes[epoch % vec])
-    _run_to_boundary(spec, state)
+    next_epoch(spec, state)
     assert bytes(state.randao_mixes[(epoch + 1) % vec]) == current_mix
 
 
@@ -168,7 +161,7 @@ def test_participation_rotates(spec, state):
     for a in atts:
         spec.process_attestation(state, a)
     assert len(state.current_epoch_attestations) > 0
-    _run_to_boundary(spec, state)
+    next_epoch(spec, state)
     # current rotated into previous; current cleared
     assert len(state.current_epoch_attestations) == 0
 
@@ -183,7 +176,7 @@ def test_effective_balance_hysteresis_downward(spec, state):
     # drop the balance just past the downward threshold
     state.balances[index] = int(state.validators[index].effective_balance) - down - 1
     pre_eff = int(state.validators[index].effective_balance)
-    _run_to_boundary(spec, state)
+    next_epoch(spec, state)
     assert int(state.validators[index].effective_balance) < pre_eff
 
 
@@ -196,7 +189,7 @@ def test_effective_balance_hysteresis_no_move_within_band(spec, state):
     down = hyst * int(spec.HYSTERESIS_DOWNWARD_MULTIPLIER)
     state.balances[index] = int(state.validators[index].effective_balance) - down + 1
     pre_eff = int(state.validators[index].effective_balance)
-    _run_to_boundary(spec, state)
+    next_epoch(spec, state)
     assert int(state.validators[index].effective_balance) == pre_eff
 
 
@@ -206,6 +199,6 @@ def test_justification_bits_shift_each_epoch(spec, state):
     next_epoch(spec, state)
     next_epoch(spec, state)
     bits_before = list(state.justification_bits)
-    _run_to_boundary(spec, state)
+    next_epoch(spec, state)
     bits_after = list(state.justification_bits)
     assert bits_after[1:] == bits_before[: len(bits_before) - 1]
