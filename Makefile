@@ -31,11 +31,9 @@ parity:
 # attestation-dense suites stay in `make test` under SPEC_TEST_PRESET.
 mainnet-smoke:
 	SPEC_TEST_PRESET=mainnet $(PYTHON) -m pytest \
-	  tests/phase0/test_sanity.py -k "empty_block or slots or invalid_state_root" \
-	  -q
-	SPEC_TEST_PRESET=mainnet $(PYTHON) -m pytest \
-	  tests/phase0/test_process_attestation.py -k "one_basic" \
-	  tests/phase0/test_block_operations.py -k "voluntary_exit_basic or proposer_slashing_basic" \
+	  tests/phase0/test_sanity.py tests/phase0/test_process_attestation.py \
+	  tests/phase0/test_block_operations.py \
+	  -k "empty_block or slots_1 or invalid_state_root or one_basic or proposer_slashing_basic or deposit_top_up" \
 	  -q
 
 test-fast:

@@ -164,6 +164,49 @@ def bench_device_resident_epochs(
     return best / epochs, best
 
 
+def bench_das_fft(batch: int = 16, n: int = 8192, chain: int = 8) -> tuple[float, float]:
+    """Secondary: batched 8192-point BLS-scalar-field FFT (the DAS erasure
+    recovery kernel, ops/fr_fft.py), chained-dependency timed: K rounds
+    inside one jit, each round re-transforming its own output.  Returns
+    (ffts_per_sec, seconds_per_round_of_batch)."""
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    from eth_consensus_specs_tpu.crypto.kzg import compute_roots_of_unity
+    from eth_consensus_specs_tpu.ops import fr_fft
+    from eth_consensus_specs_tpu.ops.fr_fft import FR
+
+    roots = tuple(compute_roots_of_unity(n))
+    rev = jnp.asarray(fr_fft._bit_reversal_indices(n))
+    twiddles = [jnp.asarray(t) for t in fr_fft._stage_twiddles(roots, n)]
+
+    rng = np.random.default_rng(7)
+    vals = FR.ints_to_mont_batch(
+        rng.integers(1, 1 << 62, size=(batch, n), dtype=np.int64)
+    )
+
+    @jax.jit
+    def run(v):
+        def body(_, v):
+            # the SAME kernel body the DAS path runs (fr_fft.fft_stages),
+            # re-transforming its own output for the dependency chain
+            return fr_fft.fft_stages(jnp.take(v, rev, axis=1), twiddles, n)
+
+        return lax.fori_loop(0, chain, body, v)
+
+    dev = jax.device_put(jnp.asarray(vals))
+    jax.block_until_ready(run(dev))  # compile + warm
+    best = float("inf")
+    for i in range(2):
+        salted = dev + jnp.uint64(0)  # fresh buffer identity
+        t0 = time.perf_counter()
+        jax.block_until_ready(run(salted))
+        best = min(best, time.perf_counter() - t0)
+    per_round = best / chain
+    return batch / per_round, per_round
+
+
 def bench_batch_verify(n_aggregates: int = 16, committee: int = 8) -> tuple[float, float]:
     """Secondary: aggregate-signature batch verification throughput under
     the tpu backend (device G1 MSM for the RLC combine, one host pairing
@@ -261,6 +304,11 @@ def _run_section(section: str, on_cpu: bool) -> None:
         n = 4 if on_cpu else 16
         aggs_per_sec, batch_s = bench_batch_verify(n_aggregates=n)
         print(json.dumps({"aggs_per_sec": aggs_per_sec, "batch_s": batch_s, "n": n}))
+    elif section == "das":
+        batch = 2 if on_cpu else 16
+        n = 1024 if on_cpu else 8192
+        ffts_per_sec, round_s = bench_das_fft(batch=batch, n=n)
+        print(json.dumps({"ffts_per_sec": ffts_per_sec, "round_s": round_s, "batch": batch, "n": n}))
     else:
         raise SystemExit(f"unknown section {section}")
 
@@ -340,6 +388,15 @@ def main() -> None:
             file=sys.stderr,
         )
 
+    das_res = _section_in_subprocess("das", on_cpu, timeout_s=480)
+    if das_res is not None:
+        print(
+            f"[bench] DAS field FFT ({das_res['batch']}x{das_res['n']}-point batch): "
+            f"{das_res['ffts_per_sec']:.1f} FFTs/s "
+            f"({das_res['round_s']*1e3:.1f} ms/batch-round)",
+            file=sys.stderr,
+        )
+
     result = {
         "metric": "ssz_merkle_tree_hashes_per_sec",
         "value": round(dev_hps, 0),
@@ -358,6 +415,7 @@ def main() -> None:
                 round(resident["per_epoch_s"] * 1e3, 3) if resident else None
             ),
             "fused_epoch_ms": round(epoch["epoch_s"] * 1e3, 3) if epoch else None,
+            "das_ffts_per_sec": round(das_res["ffts_per_sec"], 1) if das_res else None,
         },
     }
     if error is not None:

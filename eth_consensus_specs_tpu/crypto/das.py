@@ -124,9 +124,29 @@ def _fft_iter(vals: list[int], roots: tuple[int, ...]) -> list[int]:
     return out
 
 
+# Device routing: the batched limb-FFT kernel (ops/fr_fft.py) is bit-exact
+# with the host form below and becomes worthwhile from a few hundred
+# points; the host loop stays the oracle (tests/test_fr_fft.py).
+_DEVICE_FFT = False
+_DEVICE_FFT_MIN = 512
+
+
+def set_device_fft(enabled: bool) -> None:
+    global _DEVICE_FFT
+    _DEVICE_FFT = bool(enabled)
+
+
+def device_fft_enabled() -> bool:
+    return _DEVICE_FFT
+
+
 def fft_field(vals, roots_of_unity, inv: bool = False) -> list[int]:
     """specs/fulu/polynomial-commitments-sampling.md:158-171."""
     roots = tuple(roots_of_unity)
+    if _DEVICE_FFT and len(roots) >= _DEVICE_FFT_MIN:
+        from eth_consensus_specs_tpu.ops.fr_fft import fft_field_device
+
+        return fft_field_device(list(vals), roots, inv=inv)
     if inv:
         invlen = pow(len(vals), _P - 2, _P)
         inv_roots = (roots[0],) + roots[:0:-1]

@@ -9,6 +9,7 @@ self-consistent either way."""
 from __future__ import annotations
 
 import hashlib
+from functools import lru_cache as _lru_cache
 
 from ..gen_from_tests import TestCase
 
@@ -27,7 +28,24 @@ def _hex(b: bytes) -> str:
     return "0x" + bytes(b).hex()
 
 
-def _build_cases():
+def _active_setup_suite() -> str:
+    """Name the suite after the setup that produced the vectors — insecure-
+    setup vectors must never masquerade as mainnet-setup ones."""
+    import os
+
+    from eth_consensus_specs_tpu.crypto.kzg import _setup_override, _UNSET
+
+    override = _setup_override[0]
+    if override is _UNSET:
+        override = os.environ.get("ETH_CONSENSUS_TRUSTED_SETUP")
+    return "kzg-mainnet" if override else "kzg-insecure-setup"
+
+
+@_lru_cache(maxsize=1)
+def _shared_artifacts():
+    """Blob/commitment/proofs computed ONCE, lazily at case execution (not
+    at discovery — a --forks-filtered run must not pay the KZG cost, and a
+    setup failure must fail cases, not the CLI)."""
     from eth_consensus_specs_tpu.crypto import kzg
 
     blob = _make_blob(b"kzg-runner")
@@ -35,6 +53,11 @@ def _build_cases():
     z = (7).to_bytes(32, "big")
     proof, y = kzg.compute_kzg_proof(blob, z)
     blob_proof = kzg.compute_blob_kzg_proof(blob, commitment)
+    return blob, commitment, z, proof, y, blob_proof
+
+
+def _build_cases():
+    blob, commitment, z, proof, y, blob_proof = _shared_artifacts()
 
     yield (
         "blob_to_kzg_commitment",
@@ -102,18 +125,39 @@ def _build_cases():
     )
 
 
+# (handler, case_name) index — enumerable WITHOUT computing any crypto
+_CASE_INDEX = [
+    ("blob_to_kzg_commitment", "blob_to_kzg_commitment_case_0"),
+    ("compute_kzg_proof", "compute_kzg_proof_case_0"),
+    ("verify_kzg_proof", "verify_kzg_proof_valid"),
+    ("verify_kzg_proof", "verify_kzg_proof_wrong_y"),
+    ("verify_blob_kzg_proof", "verify_blob_kzg_proof_valid"),
+    ("verify_blob_kzg_proof_batch", "verify_blob_kzg_proof_batch_valid"),
+]
+
+
+def _case_payload(case_name: str):
+    for _handler, name, payload in _build_cases():
+        if name == case_name:
+            return payload
+    raise KeyError(case_name)
+
+
 def get_test_cases(presets=("minimal",)) -> list[TestCase]:
+    suite = _active_setup_suite()
     out = []
-    for handler, name, payload in _build_cases():
+    for handler, name in _CASE_INDEX:
         out.append(
             TestCase(
                 preset="general",
                 fork="deneb",
                 runner="kzg",
                 handler=handler,
-                suite="kzg-mainnet",
+                suite=suite,
                 case_name=name,
-                case_fn=(lambda payload=payload: iter([("data.yaml", payload)])),
+                # computed lazily at EXECUTION, inside run_generator's
+                # per-case error handling; artifacts shared via lru_cache
+                case_fn=(lambda name=name: iter([("data.yaml", _case_payload(name))])),
             )
         )
     return out

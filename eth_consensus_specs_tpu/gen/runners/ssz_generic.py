@@ -52,8 +52,13 @@ def _invalid_cases():
 
 def _valid_fn(value):
     def run():
+        from eth_consensus_specs_tpu.debug.encode import encode
+
+        # reference part names (tests/formats/ssz_generic/README.md):
+        # serialized bytes + meta.yaml root + value.yaml object form
         yield "serialized", bytes(ssz.serialize(value))
-        yield "root.yaml", {"root": "0x" + bytes(ssz.hash_tree_root(value)).hex()}
+        yield "root", "0x" + bytes(ssz.hash_tree_root(value)).hex()
+        yield "value.yaml", encode(value)
 
     return run
 

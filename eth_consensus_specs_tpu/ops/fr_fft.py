@@ -1,0 +1,118 @@
+"""Batched BLS-scalar-field FFT on device — the DAS recovery kernel.
+
+The 8192-point radix-2 FFT over the 255-bit scalar field is the most
+TPU-shaped math in the spec (SURVEY §2.3; reference:
+specs/fulu/polynomial-commitments-sampling.md:155-209,779): thousands of
+independent butterflies per stage, 13 static stages, no data-dependent
+control flow.  Elements live as 9x30-bit Montgomery limbs in uint64 lanes
+(ops/limb_field.py); all log2(n) stages run inside ONE jit with the
+stage loop unrolled (static shapes per stage), so XLA fuses the butterfly
+chain, and a leading batch axis amortizes recovery over many columns at
+once.
+
+Bit-exact with the host oracle crypto/das.fft_field (same DIT butterfly
+order: both equal the textbook DFT in exact modular arithmetic)."""
+
+from __future__ import annotations
+
+from functools import lru_cache
+
+import numpy as np
+
+import eth_consensus_specs_tpu  # noqa: F401  (enables x64)
+import jax
+import jax.numpy as jnp
+
+from .limb_field import LimbField
+
+# BLS12-381 scalar field (the polynomial / erasure-coding field)
+BLS_MODULUS = 0x73EDA753299D7D483339D80809A1D80553BDA402FFFE5BFEFFFFFFFF00000001
+
+FR = LimbField(BLS_MODULUS)
+
+
+@lru_cache(maxsize=None)
+def _bit_reversal_indices(n: int) -> np.ndarray:
+    bits = n.bit_length() - 1
+    out = np.zeros(n, np.int32)
+    for i in range(n):
+        out[i] = int(format(i, f"0{bits}b")[::-1], 2) if bits else 0
+    return out
+
+
+@lru_cache(maxsize=None)
+def _stage_twiddles(roots: tuple, n: int) -> list[np.ndarray]:
+    """Montgomery twiddle tables per DIT stage: stage with half-size m uses
+    w[k] = roots[k * (n // (2m))] for k in range(m)."""
+    tables = []
+    m = 1
+    while m < n:
+        stride = n // (2 * m)
+        tables.append(
+            np.stack([FR.to_mont(roots[k * stride] % BLS_MODULUS) for k in range(m)])
+        )
+        m *= 2
+    return tables
+
+
+def fft_stages(vals, twiddles, n: int):
+    """The DIT butterfly stage chain over bit-reversed input — the single
+    shared kernel body (also what bench.py's chained measurement runs).
+
+    vals: [B, n, L] Montgomery limbs; twiddles: one [m, L] table per stage."""
+    out = vals
+    m = 1
+    for t in twiddles:
+        # [B, n/(2m), 2, m, L]: axis-2 selects the (a, b) halves
+        shaped = out.reshape(out.shape[0], n // (2 * m), 2, m, FR.n_limbs)
+        a = shaped[:, :, 0]
+        b = FR.mont_mul(shaped[:, :, 1], t)  # t broadcasts [m, L]
+        merged = jnp.stack([FR.add_mod(a, b), FR.sub_mod(a, b)], axis=2)
+        out = merged.reshape(out.shape[0], n, FR.n_limbs)
+        m *= 2
+    return out
+
+
+@lru_cache(maxsize=None)
+def _compiled_fft(n: int, n_stages: int):
+    """One executable per size; twiddles enter as traced args so coset
+    variants and inverse roots reuse the same compilation."""
+
+    @jax.jit
+    def run(vals, *twiddles):
+        return fft_stages(vals, list(twiddles), n)
+
+    return run
+
+
+def batch_fft_mont(vals_mont: jnp.ndarray, roots: tuple) -> jnp.ndarray:
+    """[B, n, L] Montgomery limbs -> DFT, natural order in and out."""
+    n = vals_mont.shape[1]
+    assert n & (n - 1) == 0 and n == len(roots)
+    rev = jnp.asarray(_bit_reversal_indices(n))
+    vals = jnp.take(vals_mont, rev, axis=1)
+    twiddles = [jnp.asarray(t) for t in _stage_twiddles(tuple(roots), n)]
+    return _compiled_fft(n, len(twiddles))(vals, *twiddles)
+
+
+def batch_fft_field(batches, roots_of_unity, inv: bool = False) -> list[list[int]]:
+    """Many same-length FFTs at once; bit-exact with crypto/das.fft_field
+    applied row-wise (host ints in, host ints out)."""
+    roots = tuple(int(r) for r in roots_of_unity)
+    n = len(roots)
+    arr = FR.ints_to_mont_batch([[int(x) % BLS_MODULUS for x in row] for row in batches])
+    if inv:
+        inv_roots = (roots[0],) + roots[:0:-1]
+        out = batch_fft_mont(jnp.asarray(arr), inv_roots)
+        invlen_mont = jnp.asarray(FR.to_mont(pow(n, BLS_MODULUS - 2, BLS_MODULUS)))
+        out = FR.mont_mul(out, invlen_mont)
+    else:
+        out = batch_fft_mont(jnp.asarray(arr), roots)
+    flat = FR.mont_batch_to_ints(np.asarray(out))
+    b = len(batches)
+    return [flat[i * n : (i + 1) * n] for i in range(b)]
+
+
+def fft_field_device(vals, roots_of_unity, inv: bool = False) -> list[int]:
+    """Drop-in device twin of crypto/das.fft_field (single vector)."""
+    return batch_fft_field([list(vals)], roots_of_unity, inv=inv)[0]

@@ -1,0 +1,74 @@
+"""Device scalar-field FFT (ops/fr_fft.py) vs the host DAS oracle."""
+
+import random
+
+import pytest
+
+from eth_consensus_specs_tpu.crypto import das
+from eth_consensus_specs_tpu.crypto.kzg import compute_roots_of_unity
+from eth_consensus_specs_tpu.ops.fr_fft import (
+    BLS_MODULUS,
+    FR,
+    batch_fft_field,
+    fft_field_device,
+)
+
+_rng = random.Random(20260730)
+
+
+@pytest.mark.parametrize("n", [2, 8, 64, 512])
+@pytest.mark.parametrize("inv", [False, True])
+def test_fft_matches_host(n, inv):
+    roots = compute_roots_of_unity(n)
+    vals = [_rng.randrange(BLS_MODULUS) for _ in range(n)]
+    assert fft_field_device(vals, roots, inv=inv) == das.fft_field(vals, roots, inv=inv)
+
+
+def test_fft_roundtrip():
+    n = 256
+    roots = compute_roots_of_unity(n)
+    vals = [_rng.randrange(BLS_MODULUS) for _ in range(n)]
+    assert fft_field_device(fft_field_device(vals, roots), roots, inv=True) == vals
+
+
+def test_batch_matches_rowwise():
+    n = 128
+    roots = compute_roots_of_unity(n)
+    batches = [[_rng.randrange(BLS_MODULUS) for _ in range(n)] for _ in range(5)]
+    outs = batch_fft_field(batches, roots)
+    for row, out in zip(batches, outs):
+        assert out == das.fft_field(row, roots)
+
+
+def test_limb_field_arithmetic():
+    for _ in range(10):
+        a = _rng.randrange(BLS_MODULUS)
+        b = _rng.randrange(BLS_MODULUS)
+        am, bm = FR.ints_to_mont_batch([a]), FR.ints_to_mont_batch([b])
+        import jax.numpy as jnp
+
+        prod = FR.mont_mul(jnp.asarray(am), jnp.asarray(bm))
+        assert FR.mont_batch_to_ints(prod)[0] == a * b % BLS_MODULUS
+        s = FR.add_mod(jnp.asarray(am), jnp.asarray(bm))
+        assert FR.mont_batch_to_ints(s)[0] == (a + b) % BLS_MODULUS
+        d = FR.sub_mod(jnp.asarray(am), jnp.asarray(bm))
+        assert FR.mont_batch_to_ints(d)[0] == (a - b) % BLS_MODULUS
+
+
+def test_das_device_routing_bit_exact():
+    """coset_fft + recovery through the routed fft_field with the device
+    kernel on must equal the pure-host path."""
+    n = das.FIELD_ELEMENTS_PER_CELL * 8
+    # build a recoverable scenario at natural spec size? full 8192-recovery
+    # is exercised in tests/fulu; here route a 512-point coset round-trip
+    roots = compute_roots_of_unity(512)
+    vals = [_rng.randrange(BLS_MODULUS) for _ in range(512)]
+    host = das.coset_fft_field(vals, roots)
+    das.set_device_fft(True)
+    try:
+        dev = das.coset_fft_field(vals, roots)
+        dev_rt = das.coset_fft_field(dev, roots, inv=True)
+    finally:
+        das.set_device_fft(False)
+    assert dev == host
+    assert dev_rt == vals
