@@ -136,33 +136,82 @@ def get_merkle_proof(chunks: list[bytes], index: int, limit: int | None = None) 
 
 def compute_merkle_proof(value, gindex: int) -> list[bytes]:
     """Merkle proof for the subtree at generalized index `gindex` within an
-    SSZ Container value, bottom-up (the order is_valid_merkle_branch
-    consumes). The gindex path must align with container-field boundaries
-    (nested containers recurse), which covers the spec's hardcoded light-
-    client gindices (reference: ssz/merkle-proofs.md gindex algebra;
-    pysetup/spec_builders/altair.py:40-45 hardcodes the same values)."""
-    from .types import Container, hash_tree_root  # lazy: avoid import cycle
+    SSZ value, bottom-up (the order is_valid_merkle_branch consumes).
+
+    Descends through Containers (field boundaries), Lists (length mix-in +
+    data subtree — the deneb blob-sidecar inclusion-proof shape, reference
+    test/deneb/unittests/test_single_merkle_proof.py) and Vectors; paths
+    into packed basic-element sequences end at the packed chunk.  Covers
+    the spec's hardcoded light-client gindices (reference:
+    ssz/merkle-proofs.md; pysetup/spec_builders/altair.py:40-45)."""
+    from .types import (  # lazy: avoid import cycle
+        BasicView,
+        Container,
+        List as SSZList,
+        Vector as SSZVector,
+        _pack_basic_elements,
+        hash_tree_root,
+    )
 
     path = bin(int(gindex))[3:]  # binary digits after the leading 1
     proof: list[bytes] = []
     while path:
-        if not isinstance(value, Container):
-            raise TypeError(
-                f"gindex path descends into non-container {type(value).__name__}"
-            )
-        fields = list(type(value).fields())
-        depth = max(len(fields) - 1, 0).bit_length()
-        if len(path) < depth:
-            raise ValueError("gindex path ends inside a container's chunk tree")
-        field_index = int(path[:depth], 2)
-        if field_index >= len(fields):
-            raise ValueError(f"gindex selects padding chunk {field_index}")
-        chunks = [bytes(hash_tree_root(getattr(value, name))) for name in fields]
-        # walking top-down: each new segment is DEEPER than what's
-        # accumulated, and bottom-up order puts deeper siblings first
-        proof = get_merkle_proof(chunks, field_index, limit=1 << depth) + proof
-        value = getattr(value, fields[field_index])
-        path = path[depth:]
+        if isinstance(value, Container):
+            fields = list(type(value).fields())
+            depth = max(len(fields) - 1, 0).bit_length()
+            if len(path) < depth:
+                raise ValueError("gindex path ends inside a container's chunk tree")
+            field_index = int(path[:depth], 2)
+            if field_index >= len(fields):
+                raise ValueError(f"gindex selects padding chunk {field_index}")
+            chunks = [bytes(hash_tree_root(getattr(value, name))) for name in fields]
+            # walking top-down: each new segment is DEEPER than what's
+            # accumulated, and bottom-up order puts deeper siblings first
+            proof = get_merkle_proof(chunks, field_index, limit=1 << depth) + proof
+            value = getattr(value, fields[field_index])
+            path = path[depth:]
+            continue
+        if isinstance(value, (SSZList, SSZVector)):
+            typ = type(value)
+            elem = typ.ELEMENT_TYPE
+            basic = issubclass(elem, BasicView)
+            if basic:
+                per_chunk = 32 // elem.type_byte_length()
+                limit = typ.LIMIT if isinstance(value, SSZList) else typ.LENGTH
+                limit_chunks = (limit + per_chunk - 1) // per_chunk
+                data = bytes(_pack_basic_elements(elem, list(value)).tobytes())
+                chunks = [
+                    data[i : i + 32] for i in range(0, len(data), 32)
+                ] or [ZERO_CHUNK]
+            else:
+                limit_chunks = typ.LIMIT if isinstance(value, SSZList) else typ.LENGTH
+                chunks = [bytes(hash_tree_root(v)) for v in value] or []
+            depth = max(limit_chunks - 1, 0).bit_length()
+            is_list = isinstance(value, SSZList)
+            need = depth + (1 if is_list else 0)
+            if len(path) < need:
+                raise ValueError("gindex path ends inside a sequence's chunk tree")
+            if is_list:
+                if path[0] != "0":
+                    raise ValueError("gindex selects the length mix-in, not an element")
+                path = path[1:]
+            chunk_index = int(path[:depth], 2)
+            seg = get_merkle_proof(chunks, chunk_index, limit=limit_chunks)
+            if is_list:
+                seg = seg + [len(value).to_bytes(32, "little")]
+            proof = seg + proof
+            path = path[depth:]
+            if basic:
+                if path:
+                    raise ValueError("gindex descends past a packed basic chunk")
+                return proof
+            if chunk_index >= len(value):
+                raise ValueError(f"gindex selects padding element {chunk_index}")
+            value = value[chunk_index]
+            continue
+        raise TypeError(
+            f"gindex path descends into unsupported type {type(value).__name__}"
+        )
     return proof
 
 

@@ -1,0 +1,124 @@
+"""Withdrawal-sweep tables (spec: specs/capella/beacon-chain.md
+get_expected_withdrawals/process_withdrawals; reference analogue:
+test/capella/block_processing/test_process_withdrawals.py)."""
+
+from eth_consensus_specs_tpu.test_infra.context import spec_state_test, with_phases
+from eth_consensus_specs_tpu.test_infra.execution_payload import (
+    build_empty_execution_payload,
+)
+from eth_consensus_specs_tpu.test_infra.forks import is_post_electra
+
+CAPELLA_PLUS = ["capella", "deneb", "electra"]
+
+
+def _eth1_creds(spec, state, index: int, tag: int = 0x51):
+    address = bytes([tag]) * 20
+    state.validators[index].withdrawal_credentials = (
+        spec.ETH1_ADDRESS_WITHDRAWAL_PREFIX + b"\x00" * 11 + address
+    )
+    return address
+
+
+def _withdrawals_of(spec, state):
+    w = spec.get_expected_withdrawals(state)
+    return w[0] if isinstance(w, tuple) else w
+
+
+@with_phases(CAPELLA_PLUS)
+@spec_state_test
+def test_no_withdrawals_without_execution_creds(spec, state):
+    assert list(_withdrawals_of(spec, state)) == []
+
+
+@with_phases(CAPELLA_PLUS)
+@spec_state_test
+def test_full_withdrawal_when_withdrawable(spec, state):
+    idx = 2
+    _eth1_creds(spec, state, idx)
+    state.validators[idx].withdrawable_epoch = spec.get_current_epoch(state)
+    ws = _withdrawals_of(spec, state)
+    assert [int(w.validator_index) for w in ws] == [idx]
+    assert int(ws[0].amount) == int(state.balances[idx])
+
+
+@with_phases(CAPELLA_PLUS)
+@spec_state_test
+def test_partial_withdrawal_above_max(spec, state):
+    idx = 3
+    _eth1_creds(spec, state, idx)
+    excess = 7 * 10**9
+    state.balances[idx] = int(spec.MAX_EFFECTIVE_BALANCE) + excess
+    ws = _withdrawals_of(spec, state)
+    assert [int(w.validator_index) for w in ws] == [idx]
+    assert int(ws[0].amount) == excess
+
+
+@with_phases(CAPELLA_PLUS)
+@spec_state_test
+def test_withdrawal_address_comes_from_credentials(spec, state):
+    idx = 4
+    address = _eth1_creds(spec, state, idx, tag=0x77)
+    state.validators[idx].withdrawable_epoch = spec.get_current_epoch(state)
+    ws = _withdrawals_of(spec, state)
+    assert bytes(ws[0].address) == address
+
+
+@with_phases(CAPELLA_PLUS)
+@spec_state_test
+def test_withdrawal_indices_are_sequential(spec, state):
+    for i, idx in enumerate((2, 3)):
+        _eth1_creds(spec, state, idx, tag=0x60 + i)
+        state.validators[idx].withdrawable_epoch = spec.get_current_epoch(state)
+    ws = _withdrawals_of(spec, state)
+    assert len(ws) == 2
+    assert int(ws[1].index) == int(ws[0].index) + 1
+
+
+@with_phases(CAPELLA_PLUS)
+@spec_state_test
+def test_process_withdrawals_applies_and_advances_sweep(spec, state):
+    idx = 5
+    _eth1_creds(spec, state, idx)
+    state.validators[idx].withdrawable_epoch = spec.get_current_epoch(state)
+    pre_balance = int(state.balances[idx])
+    payload = build_empty_execution_payload(spec, state)
+    spec.process_withdrawals(state, payload)
+    assert int(state.balances[idx]) == 0 or int(state.balances[idx]) < pre_balance
+    assert int(state.next_withdrawal_index) >= 1
+
+
+@with_phases(CAPELLA_PLUS)
+@spec_state_test
+def test_process_withdrawals_rejects_mismatched_list(spec, state):
+    from eth_consensus_specs_tpu.test_infra.context import expect_assertion_error
+
+    idx = 5
+    _eth1_creds(spec, state, idx)
+    state.validators[idx].withdrawable_epoch = spec.get_current_epoch(state)
+    payload = build_empty_execution_payload(spec, state)
+    payload.withdrawals = type(payload.withdrawals)([])  # drop the expected one
+    expect_assertion_error(lambda: spec.process_withdrawals(state, payload))
+
+
+@with_phases(["electra"])
+@spec_state_test
+def test_electra_partial_sweep_respects_pending_queue_cap(spec, state):
+    """Electra bounds processed pending partial withdrawals per sweep."""
+    idx = 6
+    _eth1_creds(spec, state, idx)
+    state.balances[idx] = int(spec.MAX_EFFECTIVE_BALANCE) + 10**9
+    ws = _withdrawals_of(spec, state)
+    assert is_post_electra(spec)
+    assert len(ws) <= int(spec.MAX_WITHDRAWALS_PER_PAYLOAD)
+
+
+@with_phases(CAPELLA_PLUS)
+@spec_state_test
+def test_sweep_bound_limits_scan(spec, state):
+    """No more than MAX_WITHDRAWALS_PER_PAYLOAD come out of one sweep."""
+    count = int(spec.MAX_WITHDRAWALS_PER_PAYLOAD) + 2
+    for k in range(count):
+        _eth1_creds(spec, state, k, tag=0x30 + k)
+        state.validators[k].withdrawable_epoch = spec.get_current_epoch(state)
+    ws = _withdrawals_of(spec, state)
+    assert len(ws) == int(spec.MAX_WITHDRAWALS_PER_PAYLOAD)

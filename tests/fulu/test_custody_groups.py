@@ -1,0 +1,66 @@
+"""Custody-group assignment tables (spec: specs/fulu/das-core.md
+get_custody_groups / compute_columns_for_custody_group; reference
+analogue: test/fulu/unittests/das/test_das.py)."""
+
+from eth_consensus_specs_tpu.test_infra.context import spec_test, with_phases
+
+FULU = ["fulu", "gloas"]
+
+
+@with_phases(FULU)
+@spec_test
+def test_custody_groups_deterministic_and_sized(spec):
+    node_id = 0x1234_5678_9ABC_DEF0 << 180
+    count = int(spec.config.CUSTODY_REQUIREMENT)
+    groups = spec.get_custody_groups(node_id, count)
+    assert len(groups) == count
+    assert groups == spec.get_custody_groups(node_id, count)
+    assert len(set(int(g) for g in groups)) == count  # no duplicates
+    assert all(
+        0 <= int(g) < int(spec.config.NUMBER_OF_CUSTODY_GROUPS) for g in groups
+    )
+
+
+@with_phases(FULU)
+@spec_test
+def test_custody_groups_sorted(spec):
+    groups = spec.get_custody_groups(987654321, 6)
+    assert [int(g) for g in groups] == sorted(int(g) for g in groups)
+
+
+@with_phases(FULU)
+@spec_test
+def test_custody_groups_full_coverage(spec):
+    total = int(spec.config.NUMBER_OF_CUSTODY_GROUPS)
+    groups = spec.get_custody_groups(42, total)
+    assert [int(g) for g in groups] == list(range(total))
+
+
+@with_phases(FULU)
+@spec_test
+def test_custody_groups_differ_across_nodes(spec):
+    a = spec.get_custody_groups(1, 4)
+    b = spec.get_custody_groups(2, 4)
+    assert a != b  # overwhelmingly likely by construction
+
+
+@with_phases(FULU)
+@spec_test
+def test_columns_for_custody_group_partition(spec):
+    """Every column belongs to exactly one custody group."""
+    total_groups = int(spec.config.NUMBER_OF_CUSTODY_GROUPS)
+    seen: set[int] = set()
+    for g in range(total_groups):
+        cols = [int(c) for c in spec.compute_columns_for_custody_group(g)]
+        assert not (seen & set(cols))
+        seen |= set(cols)
+    assert len(seen) == int(spec.NUMBER_OF_COLUMNS)
+
+
+@with_phases(FULU)
+@spec_test
+def test_custody_group_count_exceeding_total_rejected(spec):
+    from eth_consensus_specs_tpu.test_infra.context import expect_assertion_error
+
+    total = int(spec.config.NUMBER_OF_CUSTODY_GROUPS)
+    expect_assertion_error(lambda: spec.get_custody_groups(7, total + 1))

@@ -1,0 +1,133 @@
+"""Single-merkle-proof suites (reference analogue: the `merkle_proof`
+runner — test/deneb/unittests/test_single_merkle_proof.py and the
+light_client proof families; spec: ssz/merkle-proofs.md)."""
+
+import pytest
+
+from eth_consensus_specs_tpu import ssz
+from eth_consensus_specs_tpu.forks import get_spec
+from eth_consensus_specs_tpu.ssz.gindex import (
+    get_generalized_index,
+    get_generalized_index_length,
+)
+from eth_consensus_specs_tpu.ssz.merkle import compute_merkle_proof, is_valid_merkle_branch
+from eth_consensus_specs_tpu.test_infra.genesis import create_genesis_state
+from eth_consensus_specs_tpu.utils import bls
+
+
+@pytest.fixture(scope="module")
+def deneb_state():
+    spec = get_spec("deneb", "minimal")
+    prev = bls.bls_active
+    bls.bls_active = False
+    try:
+        state = create_genesis_state(
+            spec, [spec.MAX_EFFECTIVE_BALANCE] * 32, spec.MAX_EFFECTIVE_BALANCE
+        )
+    finally:
+        bls.bls_active = prev
+    return spec, state
+
+
+def _verify_gindex_proof(obj, gindex, leaf_root, proof):
+    depth = get_generalized_index_length(gindex)
+    index = int(gindex) - (1 << depth)
+    return is_valid_merkle_branch(
+        leaf_root, proof, depth, index, bytes(ssz.hash_tree_root(obj))
+    )
+
+
+@pytest.mark.parametrize(
+    "path",
+    [
+        ("slot",),
+        ("fork", "current_version"),
+        ("latest_block_header", "state_root"),
+        ("finalized_checkpoint", "root"),
+    ],
+)
+def test_state_field_proofs_verify(deneb_state, path):
+    spec, state = deneb_state
+    gindex = get_generalized_index(type(state), *path)
+    proof = compute_merkle_proof(state, gindex)
+    target = state
+    for p in path:
+        target = getattr(target, p)
+    assert _verify_gindex_proof(state, gindex, bytes(ssz.hash_tree_root(target)), proof)
+
+
+def test_blob_commitment_inclusion_proof_shape(deneb_state):
+    """The deneb blob-sidecar inclusion proof: commitment leaf inside the
+    BeaconBlockBody tree (reference: test_single_merkle_proof.py)."""
+    spec, state = deneb_state
+    body = spec.BeaconBlockBody()
+    body.blob_kzg_commitments.append(b"\xbb" * 48)
+    gindex = get_generalized_index(type(body), "blob_kzg_commitments", 0)
+    proof = compute_merkle_proof(body, gindex)
+    assert len(proof) == get_generalized_index_length(gindex)
+    assert _verify_gindex_proof(
+        body, gindex, bytes(ssz.hash_tree_root(body.blob_kzg_commitments[0])), proof
+    )
+
+
+def test_proof_rejects_wrong_leaf(deneb_state):
+    spec, state = deneb_state
+    gindex = get_generalized_index(type(state), "slot")
+    proof = compute_merkle_proof(state, gindex)
+    assert not _verify_gindex_proof(state, gindex, b"\xff" * 32, proof)
+
+
+def test_proof_rejects_tampered_branch(deneb_state):
+    spec, state = deneb_state
+    gindex = get_generalized_index(type(state), "finalized_checkpoint", "root")
+    proof = list(compute_merkle_proof(state, gindex))
+    proof[0] = b"\x00" * 32 if bytes(proof[0]) != b"\x00" * 32 else b"\x01" * 32
+    assert not _verify_gindex_proof(
+        state, gindex, bytes(state.finalized_checkpoint.root), proof
+    )
+
+
+def test_light_client_gindices_match_spec_constants(deneb_state):
+    """The hardcoded light-client gindices in the reference
+    (pysetup/spec_builders/altair.py:40-45) must equal what the gindex
+    algebra derives from the state layout."""
+    spec, state = deneb_state
+    finalized = get_generalized_index(type(state), "finalized_checkpoint", "root")
+    next_sc = get_generalized_index(type(state), "next_sync_committee")
+    current_sc = get_generalized_index(type(state), "current_sync_committee")
+    # altair state layout: known published generalized indices
+    assert int(finalized) == 105
+    assert int(next_sc) == 55
+    assert int(current_sc) == 54
+
+
+def test_deposit_branch_matches_contract_depth(deneb_state):
+    spec, state = deneb_state
+    gindex = get_generalized_index(
+        type(state.eth1_data), "deposit_root"
+    )
+    proof = compute_merkle_proof(state.eth1_data, gindex)
+    assert _verify_gindex_proof(
+        state.eth1_data, gindex, bytes(state.eth1_data.deposit_root), proof
+    )
+
+
+def test_packed_basic_list_chunk_proof(deneb_state):
+    """Proof for a packed uint64 chunk inside state.balances (gindex path
+    ends AT the packed chunk, ssz/merkle-proofs.md)."""
+    spec, state = deneb_state
+    gindex = get_generalized_index(type(state), "balances", 0)
+    proof = compute_merkle_proof(state, gindex)
+    chunk = b"".join(
+        int(b).to_bytes(8, "little") for b in list(state.balances)[:4]
+    ).ljust(32, b"\x00")
+    assert _verify_gindex_proof(state, gindex, chunk, proof)
+
+
+def test_vector_element_proof(deneb_state):
+    spec, state = deneb_state
+    gindex = get_generalized_index(type(state), "block_roots", 3)
+    proof = compute_merkle_proof(state, gindex)
+    assert _verify_gindex_proof(
+        state, gindex, bytes(state.block_roots[3]), proof
+    )
