@@ -9,6 +9,7 @@ callers degrade gracefully when no compiler is available."""
 from __future__ import annotations
 
 import ctypes
+import hashlib
 import os
 import subprocess
 import sysconfig
@@ -21,28 +22,56 @@ _lib: ctypes.CDLL | None = None
 _tried = False
 
 
-def _compile_shared(src: str, out: str, opt: str = "-O2", timeout: int = 120) -> bool:
-    """Compile a .c source into a shared object. Links to a per-process
-    temp name, then atomically renames: concurrent first-use compilations
-    (pytest-xdist, parallel imports) must never let a reader dlopen a
-    partially written object."""
+def _src_digest(*srcs: str) -> str:
+    h = hashlib.sha256()
+    for s in srcs:
+        with open(s, "rb") as f:
+            h.update(f.read())
+    return h.hexdigest()
+
+
+def _ensure_shared(out: str, srcs: tuple[str, ...], opt: str, timeout: int) -> bool:
+    """Compile ``srcs[0]`` into ``out`` unless an object built from exactly
+    these sources already exists. Freshness is a content-hash stamp file
+    (``out + '.sha256'``), not mtimes: git does not preserve mtimes, so a
+    stale or foreign binary must never silently win over the audited source
+    for consensus-critical code. Links to a per-process temp name, then
+    atomically renames: concurrent first-use compilations (pytest-xdist,
+    parallel imports) must never let a reader dlopen a partial object."""
+    want = _src_digest(*srcs)
+    stamp = out + ".sha256"
+    try:
+        with open(stamp) as f:
+            if f.read().strip() == want and os.path.exists(out):
+                return True
+    except OSError:
+        pass
     cc = os.environ.get("CC") or sysconfig.get_config_var("CC") or "cc"
     tmp = f"{out}.{os.getpid()}.tmp"
-    cmd = cc.split() + [opt, "-fPIC", "-shared", "-o", tmp, src]
+    cmd = cc.split() + [opt, "-fPIC", "-shared", "-o", tmp, srcs[0]]
     try:
         subprocess.run(cmd, check=True, capture_output=True, timeout=timeout)
         os.replace(tmp, out)
-        return True
     except (OSError, subprocess.SubprocessError):
         try:
             os.unlink(tmp)
         except OSError:
             pass
         return False
+    # Stamp failure must not discard a successfully installed library —
+    # worst case the next process recompiles once more.
+    try:
+        stamp_tmp = f"{stamp}.{os.getpid()}.tmp"
+        with open(stamp_tmp, "w") as f:
+            f.write(want)
+        os.replace(stamp_tmp, stamp)
+    except OSError:
+        pass
+    return True
 
 
 def _compile() -> bool:
-    return _compile_shared(_SRC, _LIB)
+    return _ensure_shared(_LIB, (_SRC,), "-O2", 120)
 
 
 def get_lib() -> ctypes.CDLL | None:
@@ -53,9 +82,8 @@ def get_lib() -> ctypes.CDLL | None:
     _tried = True
     if os.environ.get("ETH_SPECS_TPU_NO_NATIVE"):
         return None
-    if not os.path.exists(_LIB) or os.path.getmtime(_LIB) < os.path.getmtime(_SRC):
-        if not _compile():
-            return None
+    if not _compile():
+        return None
     try:
         lib = ctypes.CDLL(_LIB)
     except OSError:
@@ -106,7 +134,8 @@ _bls_tried = False
 
 
 def _compile_bls() -> bool:
-    return _compile_shared(_BLS_SRC, _BLS_LIB_PATH, opt="-O3", timeout=300)
+    hdr = os.path.join(_DIR, "bls12_381_consts.h")
+    return _ensure_shared(_BLS_LIB_PATH, (_BLS_SRC, hdr), "-O3", 300)
 
 
 def get_bls_lib() -> ctypes.CDLL | None:
@@ -117,11 +146,8 @@ def get_bls_lib() -> ctypes.CDLL | None:
     _bls_tried = True
     if os.environ.get("ETH_SPECS_TPU_NO_NATIVE"):
         return None
-    hdr = os.path.join(_DIR, "bls12_381_consts.h")
-    newest_src = max(os.path.getmtime(_BLS_SRC), os.path.getmtime(hdr))
-    if not os.path.exists(_BLS_LIB_PATH) or os.path.getmtime(_BLS_LIB_PATH) < newest_src:
-        if not _compile_bls():
-            return None
+    if not _compile_bls():
+        return None
     try:
         lib = ctypes.CDLL(_BLS_LIB_PATH)
     except OSError:

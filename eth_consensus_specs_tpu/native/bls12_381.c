@@ -1154,6 +1154,12 @@ static int final_exp_is_one_fast(const fp12 *f) {
 
 static int g_initialized = 0;
 
+/* Runs at dlopen time (single-threaded, before ctypes returns the handle),
+ * so no caller can ever observe partially-built Frobenius/psi tables even
+ * though ctypes releases the GIL around calls. ensure_init() stays as a
+ * belt-and-braces guard for non-dlopen embeddings. */
+__attribute__((constructor)) static void bls_init_ctor(void);
+
 static void ensure_init(void) {
     if (g_initialized) return;
     /* gamma powers for frobenius^2 */
@@ -1178,6 +1184,8 @@ static void ensure_init(void) {
     fp_from_plain(&PSI_Y.c1, PSI_Y_C1);
     g_initialized = 1;
 }
+
+__attribute__((constructor)) static void bls_init_ctor(void) { ensure_init(); }
 
 /* ------------------------------------------------------- byte helpers --- */
 

@@ -29,6 +29,54 @@ from .preamble import build_namespace
 
 REFERENCE_SPECS = os.environ.get("ETH_SPECS_REFERENCE", "/root/reference")
 
+# Content pins: the oracle exec()s code parsed out of the (untrusted)
+# reference tree, so every consumed file is pinned by sha256 in pins.json
+# (regenerate with scripts/update_specc_pins.py). A mismatching or
+# unpinned file refuses to compile unless ETH_SPECS_ALLOW_UNPINNED=1 —
+# the executable oracle must not silently change when the tree does.
+_PINS_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)), "pins.json")
+
+
+@lru_cache(maxsize=None)
+def _load_pins() -> dict:
+    # pins.json is a committed artifact: failing to read it is
+    # indistinguishable from tampering, so fail loudly (no silent {}).
+    with open(_PINS_PATH) as fh:
+        return json.load(fh)
+
+
+def _verify_pinned(path: str) -> None:
+    if os.environ.get("ETH_SPECS_ALLOW_UNPINNED"):
+        return
+    pins = _load_pins()
+    rel = os.path.relpath(path, REFERENCE_SPECS)
+    import hashlib
+
+    with open(path, "rb") as fh:
+        got = hashlib.sha256(fh.read()).hexdigest()
+    want = pins.get(rel)
+    if want is None:
+        raise RuntimeError(
+            f"specc: {rel} is not in pins.json — refusing to exec unpinned "
+            "reference content (set ETH_SPECS_ALLOW_UNPINNED=1 to override, "
+            "or run scripts/update_specc_pins.py after auditing)"
+        )
+    if got != want:
+        raise RuntimeError(
+            f"specc: {rel} content hash {got[:16]}… != pinned {want[:16]}… — "
+            "the reference tree changed under the oracle"
+        )
+
+
+def _require_absent_unpinned(path: str) -> None:
+    """A pinned file that has *disappeared* is as suspicious as a modified
+    one — deletion must not silently shrink the compiled oracle."""
+    if os.environ.get("ETH_SPECS_ALLOW_UNPINNED"):
+        return
+    rel = os.path.relpath(path, REFERENCE_SPECS)
+    if rel in _load_pins():
+        raise RuntimeError(f"specc: pinned reference file {rel} is missing from the tree")
+
 # Fork lineage and the per-fork document sets compiled into the oracle.
 # beacon-chain + fork (upgrade) + the crypto documents containers depend
 # on; fork-choice/validator/p2p/light-client are out of the v1 oracle
@@ -123,7 +171,9 @@ def _load_trusted_setup(preset_name: str) -> dict:
         REFERENCE_SPECS, "presets", preset_name, "trusted_setups", "trusted_setup_4096.json"
     )
     if not os.path.exists(path):
+        _require_absent_unpinned(path)
         return {}
+    _verify_pinned(path)
     with open(path) as fh:
         data = json.load(fh)
     out = {}
@@ -189,7 +239,10 @@ def compile_fork(
     for f in lineage:
         for path in _doc_paths(f):
             if os.path.exists(path):
+                _verify_pinned(path)
                 docs.append(parse_doc(path))
+            else:
+                _require_absent_unpinned(path)
 
     # pass 1: custom types + constants in document order (later forks
     # override by re-evaluating the same name).  Definitions whose value

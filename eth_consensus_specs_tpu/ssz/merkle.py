@@ -161,7 +161,7 @@ def compute_merkle_proof(value, gindex: int) -> list[bytes]:
             depth = max(len(fields) - 1, 0).bit_length()
             if len(path) < depth:
                 raise ValueError("gindex path ends inside a container's chunk tree")
-            field_index = int(path[:depth], 2)
+            field_index = int(path[:depth], 2) if depth else 0
             if field_index >= len(fields):
                 raise ValueError(f"gindex selects padding chunk {field_index}")
             chunks = [bytes(hash_tree_root(getattr(value, name))) for name in fields]
@@ -195,7 +195,7 @@ def compute_merkle_proof(value, gindex: int) -> list[bytes]:
                 if path[0] != "0":
                     raise ValueError("gindex selects the length mix-in, not an element")
                 path = path[1:]
-            chunk_index = int(path[:depth], 2)
+            chunk_index = int(path[:depth], 2) if depth else 0
             seg = get_merkle_proof(chunks, chunk_index, limit=limit_chunks)
             if is_list:
                 seg = seg + [len(value).to_bytes(32, "little")]

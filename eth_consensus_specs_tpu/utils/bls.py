@@ -141,8 +141,13 @@ def multi_exp(points: list, scalars: list):
     from eth_consensus_specs_tpu.crypto import native_bridge as nb
     from eth_consensus_specs_tpu.crypto.fields import Fq, Fq2
 
-    if nb.enabled():
-        reduced = [int(s) % CURVE_ORDER for s in scalars]
+    # Only take the native MSM when every scalar is already < r: for points
+    # with a cofactor component [s]P != [s mod r]P, so reducing here would
+    # diverge from the pure path's unreduced p.mul(s). Out-of-range scalars
+    # (never produced by spec code) fall through to the bit-exact pure path.
+    lifted = [int(s) for s in scalars]
+    if nb.enabled() and all(0 <= s < CURVE_ORDER for s in lifted):
+        reduced = lifted
         if all(p.is_infinity() or isinstance(p.x, Fq) for p in points):
             raw = nb.g1_msm(
                 [None if p.is_infinity() else (p.x.n, p.y.n) for p in points], reduced

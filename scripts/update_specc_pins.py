@@ -1,0 +1,41 @@
+#!/usr/bin/env python
+"""Regenerate specc/pins.json — the sha256 manifest of every reference file
+the spec-oracle compiler is allowed to exec code from.
+
+Run after auditing a reference-tree change. The compiler refuses unpinned
+or hash-mismatching files (specc/compiler.py:_verify_pinned)."""
+
+import hashlib
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+from eth_consensus_specs_tpu.specc import compiler as c
+
+
+def main() -> None:
+    paths: set[str] = set()
+    for fork in c.DOC_SETS:
+        for p in c._doc_paths(fork):
+            if os.path.exists(p):
+                paths.add(p)
+    for preset in ("minimal", "mainnet"):
+        ts = os.path.join(
+            c.REFERENCE_SPECS, "presets", preset, "trusted_setups", "trusted_setup_4096.json"
+        )
+        if os.path.exists(ts):
+            paths.add(ts)
+    pins = {}
+    for p in sorted(paths):
+        with open(p, "rb") as fh:
+            pins[os.path.relpath(p, c.REFERENCE_SPECS)] = hashlib.sha256(fh.read()).hexdigest()
+    with open(c._PINS_PATH, "w") as fh:
+        json.dump(pins, fh, indent=1, sort_keys=True)
+        fh.write("\n")
+    print(f"pinned {len(pins)} files -> {c._PINS_PATH}")
+
+
+if __name__ == "__main__":
+    main()
