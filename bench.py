@@ -241,46 +241,25 @@ def bench_batch_verify(n_aggregates: int = 16, committee: int = 8) -> tuple[floa
     return n_aggregates / best, best
 
 
-def _probe_accelerator(retries: int = 2) -> bool:
-    """Check in a subprocess whether the accelerator backend can initialize.
+def _run_section(section: str, on_cpu: bool, no_cache: bool = False) -> None:
+    """Child mode: run one device-bench section, print a JSON fragment.
 
-    A failed in-process init can leave jax's backend registry poisoned, so
-    the probe must not run in this interpreter. Retries cover transient
-    tunnel hiccups."""
-    import subprocess
+    The fragment always carries the backend the section ACTUALLY ran on —
+    the parent refuses to label a silently-CPU-executed attempt as an
+    accelerator measurement."""
+    import os
 
-    for attempt in range(retries):
-        try:
-            out = subprocess.run(
-                [sys.executable, "-c", "import jax; print(jax.default_backend())"],
-                capture_output=True,
-                timeout=120,
-                text=True,
-            )
-            backend = out.stdout.strip().splitlines()[-1] if out.stdout.strip() else ""
-            if out.returncode == 0 and backend and backend != "cpu":
-                return True
-            print(
-                f"[bench] accelerator probe {attempt+1}/{retries}: rc={out.returncode} "
-                f"backend={backend!r}",
-                file=sys.stderr,
-            )
-        except Exception as e:
-            print(f"[bench] accelerator probe {attempt+1}/{retries} failed: {e}", file=sys.stderr)
-        time.sleep(2)
-    return False
-
-
-def _run_section(section: str, on_cpu: bool) -> None:
-    """Child mode: run one device-bench section, print a JSON fragment."""
     if on_cpu:
-        import os
-
+        # env before the import, config after it: the axon sitecustomize
+        # pins jax_platforms programmatically (config beats env)
         os.environ["JAX_PLATFORMS"] = "cpu"
-        import jax
+    import jax
 
+    if on_cpu:
         jax.config.update("jax_platforms", "cpu")
-    else:
+    elif not no_cache:
+        # --nocache: a corrupt/stale .jax_cache entry must not be able to
+        # hang every accelerator attempt (round-3 failure mode)
         from eth_consensus_specs_tpu.utils.cache import enable_persistent_cache
 
         enable_persistent_cache()
@@ -290,30 +269,34 @@ def _run_section(section: str, on_cpu: bool) -> None:
     if section == "tree":
         depth = 16 if on_cpu else 21
         hps, tree_s = device_tree_hashes_per_sec(depth=depth)
-        print(json.dumps({"hps": hps, "tree_s": tree_s, "depth": depth}))
+        payload = {"hps": hps, "tree_s": tree_s, "depth": depth}
     elif section == "epoch":
         n = 1 << 16 if on_cpu else 1_000_000
         epoch_s = bench_epoch_accounting(n_validators=n)
-        print(json.dumps({"epoch_s": epoch_s, "n": n}))
+        payload = {"epoch_s": epoch_s, "n": n}
     elif section == "resident":
         n = 1 << 16 if on_cpu else 1 << 20
         epochs = 4 if on_cpu else 8
         per_epoch_s, total_s = bench_device_resident_epochs(n_validators=n, epochs=epochs)
-        print(json.dumps({"per_epoch_s": per_epoch_s, "total_s": total_s, "n": n, "epochs": epochs}))
+        payload = {"per_epoch_s": per_epoch_s, "total_s": total_s, "n": n, "epochs": epochs}
     elif section == "bls":
         n = 4 if on_cpu else 16
         aggs_per_sec, batch_s = bench_batch_verify(n_aggregates=n)
-        print(json.dumps({"aggs_per_sec": aggs_per_sec, "batch_s": batch_s, "n": n}))
+        payload = {"aggs_per_sec": aggs_per_sec, "batch_s": batch_s, "n": n}
     elif section == "das":
         batch = 2 if on_cpu else 16
         n = 1024 if on_cpu else 8192
         ffts_per_sec, round_s = bench_das_fft(batch=batch, n=n)
-        print(json.dumps({"ffts_per_sec": ffts_per_sec, "round_s": round_s, "batch": batch, "n": n}))
+        payload = {"ffts_per_sec": ffts_per_sec, "round_s": round_s, "batch": batch, "n": n}
     else:
         raise SystemExit(f"unknown section {section}")
+    payload["backend"] = jax.default_backend()
+    print(json.dumps(payload))
 
 
-def _section_in_subprocess(section: str, on_cpu: bool, timeout_s: int) -> dict | None:
+def _section_in_subprocess(
+    section: str, on_cpu: bool, timeout_s: int, no_cache: bool = False
+) -> dict | None:
     """Run a bench section in its own process with a hard timeout — a hung
     device tunnel must never prevent the final JSON line."""
     import subprocess
@@ -321,6 +304,8 @@ def _section_in_subprocess(section: str, on_cpu: bool, timeout_s: int) -> dict |
     cmd = [sys.executable, __file__, "--section", section]
     if on_cpu:
         cmd.append("--cpu")
+    if no_cache:
+        cmd.append("--nocache")
     try:
         out = subprocess.run(cmd, capture_output=True, text=True, timeout=timeout_s)
     except subprocess.TimeoutExpired:
@@ -336,10 +321,108 @@ def _section_in_subprocess(section: str, on_cpu: bool, timeout_s: int) -> dict |
         return None
 
 
+# Accelerator attempts get the SAME budget as a full section (the round-3
+# probe gave itself 120s while sections got 480s, and one slow backend
+# boot wrote off the whole round). A bounded number of failed attempts is
+# spread across the run — tree (with the persistent cache), tree again
+# with --nocache (a corrupt cache entry must not doom every attempt), and
+# one mid-run retry — so a tunnel that comes up late is still caught.
+import os as _os
+
+_ACC_TIMEOUT_S = int(_os.environ.get("ETH_SPECS_BENCH_ACC_TIMEOUT", "480"))
+_CPU_TIMEOUT_S = int(_os.environ.get("ETH_SPECS_BENCH_CPU_TIMEOUT", "300"))
+_MAX_ACC_FAILURES = 3
+
+_LKG_PATH = __file__.rsplit("/", 1)[0] + "/BENCH_LKG.json"
+
+
+class _AccState:
+    def __init__(self):
+        self.failures = 0
+        self.succeeded = False
+        self.backend = None
+
+    @property
+    def dead(self) -> bool:
+        # an early success does NOT exempt later failures from the budget:
+        # a tunnel that dies mid-run must not burn 480s on every remaining
+        # section
+        return self.failures >= _MAX_ACC_FAILURES
+
+
+def _run_section_auto(section: str, acc: _AccState) -> tuple[dict | None, str]:
+    """Try the accelerator first (subject to the failure budget), fall back
+    to XLA:CPU. Returns (fragment, 'accelerator'|'cpu'|'none')."""
+    attempts: list[bool] = []  # no_cache flags for accelerator attempts
+    if not acc.dead:
+        attempts.append(False)
+        # a corrupt persistent-cache entry must not hang every attempt:
+        # retry the FIRST section once more bypassing the cache
+        if not acc.succeeded and acc.failures == 0:
+            attempts.append(True)
+    for no_cache in attempts:
+        frag = _section_in_subprocess(section, on_cpu=False, timeout_s=_ACC_TIMEOUT_S, no_cache=no_cache)
+        if frag is not None and frag.get("backend") not in (None, "cpu"):
+            acc.succeeded = True
+            acc.backend = frag["backend"]
+            return frag, "accelerator"
+        if frag is not None:
+            # child ran but silently on CPU — honest but not an accelerator number
+            print(
+                f"[bench] section {section}: accelerator attempt executed on "
+                f"backend={frag.get('backend')!r}; treating as fallback",
+                file=sys.stderr,
+            )
+        acc.failures += 1
+        if acc.dead:
+            break
+    frag = _section_in_subprocess(section, on_cpu=True, timeout_s=_CPU_TIMEOUT_S)
+    return frag, ("cpu" if frag is not None else "none")
+
+
+def _load_lkg() -> dict | None:
+    try:
+        with open(_LKG_PATH) as fh:
+            return json.load(fh)
+    except (OSError, json.JSONDecodeError):
+        return None
+
+
+def _store_lkg(section_updates: dict) -> None:
+    """Merge accelerator-measured numbers into BENCH_LKG.json so a later
+    fallback run can report the last KNOWN device performance alongside the
+    honestly-labeled live CPU measurement. Provenance is PER SECTION (each
+    entry keeps its own backend + timestamp) — numbers from different runs
+    are never silently presented as one measurement."""
+    cur = _load_lkg() or {}
+    sections = cur.setdefault("sections", {})
+    now = time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
+    for name, entry in section_updates.items():
+        entry["measured_utc"] = now
+        sections[name] = entry
+    cur["note"] = (
+        "last-known-good ACCELERATOR measurements, per section with "
+        "individual provenance; updated automatically by bench.py whenever "
+        "a section executes on an accelerator backend"
+    )
+    try:
+        tmp = _LKG_PATH + ".tmp"
+        with open(tmp, "w") as fh:
+            json.dump(cur, fh, indent=1, sort_keys=True)
+            fh.write("\n")
+        import os
+
+        os.replace(tmp, _LKG_PATH)
+    except OSError as e:
+        print(f"[bench] could not update BENCH_LKG.json: {e}", file=sys.stderr)
+
+
 def main() -> None:
     if "--section" in sys.argv:
         idx = sys.argv.index("--section")
-        _run_section(sys.argv[idx + 1], on_cpu="--cpu" in sys.argv)
+        _run_section(
+            sys.argv[idx + 1], on_cpu="--cpu" in sys.argv, no_cache="--nocache" in sys.argv
+        )
         return
 
     error = None
@@ -347,61 +430,79 @@ def main() -> None:
     host_hps = host_hashes_per_sec()
     print(f"[bench] host hashlib: {host_hps/1e6:.2f} Mhash/s", file=sys.stderr)
 
-    on_cpu = not _probe_accelerator()
-    if on_cpu:
-        error = "accelerator backend unavailable; measured on XLA:CPU fallback"
-        print(f"[bench] {error}", file=sys.stderr)
+    acc = _AccState()
+    platforms: dict[str, str] = {}
 
-    tree = _section_in_subprocess("tree", on_cpu, timeout_s=480)
+    # The first accelerator ATTEMPT is the probe — full section budget, on
+    # the real workload, with a --nocache retry (round-3 lesson: two 120s
+    # import probes decided the whole round).
+    tree, src = _run_section_auto("tree", acc)
+    platforms["tree"] = src
     if tree is not None:
         dev_hps, tree_s = tree["hps"], tree["tree_s"]
         print(
-            f"[bench] device tree (2^{tree['depth']} chunks): {dev_hps/1e9:.3f} Ghash/s, "
-            f"{tree_s*1e3:.1f} ms/tree",
+            f"[bench] device tree (2^{tree['depth']} chunks, {src}): "
+            f"{dev_hps/1e9:.3f} Ghash/s, {tree_s*1e3:.1f} ms/tree",
             file=sys.stderr,
         )
-    elif error is None:
-        error = "device tree bench failed or timed out"
+    else:
+        error = "device tree bench failed or timed out on every backend"
 
-    epoch = _section_in_subprocess("epoch", on_cpu, timeout_s=300)
+    epoch, src = _run_section_auto("epoch", acc)
+    platforms["epoch"] = src
     if epoch is not None:
         print(
-            f"[bench] fused epoch @{epoch['n']} validators: {epoch['epoch_s']*1e3:.1f} ms",
+            f"[bench] fused epoch @{epoch['n']} validators ({src}): "
+            f"{epoch['epoch_s']*1e3:.1f} ms",
             file=sys.stderr,
         )
 
-    resident = _section_in_subprocess("resident", on_cpu, timeout_s=480)
+    resident, src = _run_section_auto("resident", acc)
+    platforms["resident"] = src
     if resident is not None:
         print(
-            f"[bench] device-resident epoch+root @{resident['n']} validators: "
+            f"[bench] device-resident epoch+root @{resident['n']} validators ({src}): "
             f"{resident['per_epoch_s']*1e3:.2f} ms/epoch "
             f"({resident['epochs']} epochs chained: {resident['total_s']*1e3:.1f} ms)",
             file=sys.stderr,
         )
 
-    bls_res = _section_in_subprocess("bls", on_cpu, timeout_s=480)
+    bls_res, src = _run_section_auto("bls", acc)
+    platforms["bls"] = src
     if bls_res is not None:
         print(
-            f"[bench] RLC batch verify ({bls_res['n']} aggregates): "
+            f"[bench] RLC batch verify ({bls_res['n']} aggregates, {src}): "
             f"{bls_res['aggs_per_sec']:.1f} aggregates/s "
             f"({bls_res['batch_s']*1e3:.0f} ms/batch, one pairing)",
             file=sys.stderr,
         )
 
-    das_res = _section_in_subprocess("das", on_cpu, timeout_s=480)
+    das_res, src = _run_section_auto("das", acc)
+    platforms["das"] = src
     if das_res is not None:
         print(
-            f"[bench] DAS field FFT ({das_res['batch']}x{das_res['n']}-point batch): "
+            f"[bench] DAS field FFT ({das_res['batch']}x{das_res['n']}-point batch, {src}): "
             f"{das_res['ffts_per_sec']:.1f} FFTs/s "
             f"({das_res['round_s']*1e3:.1f} ms/batch-round)",
             file=sys.stderr,
         )
+
+    on_acc = platforms.get("tree") == "accelerator"
+    if not on_acc and error is None:
+        error = (
+            "accelerator backend unavailable after "
+            f"{acc.failures} full-budget attempts; primary metric measured on "
+            "XLA:CPU fallback (NOT a device regression — see last_known_good)"
+        )
+        print(f"[bench] {error}", file=sys.stderr)
 
     result = {
         "metric": "ssz_merkle_tree_hashes_per_sec",
         "value": round(dev_hps, 0),
         "unit": "hash/s",
         "vs_baseline": round(dev_hps / host_hps, 2) if host_hps else 0.0,
+        "platform": (acc.backend or "unknown") if on_acc else "cpu-fallback",
+        "section_platforms": platforms,
         "method": (
             "chained-dependency timing: K data-dependent iterations inside one "
             "jit, wall-clock/K (sustained, not single-dispatch latency)"
@@ -418,6 +519,41 @@ def main() -> None:
             "das_ffts_per_sec": round(das_res["ffts_per_sec"], 1) if das_res else None,
         },
     }
+
+    # Persist accelerator-measured numbers; surface them when falling back.
+    acc_update: dict = {}
+    if platforms.get("tree") == "accelerator" and tree is not None:
+        acc_update["tree"] = {
+            "ssz_merkle_tree_hashes_per_sec": round(dev_hps, 0),
+            "vs_host_hashlib": round(dev_hps / host_hps, 2),
+            "backend": tree.get("backend"),
+        }
+    if platforms.get("epoch") == "accelerator" and epoch is not None:
+        acc_update["epoch"] = {
+            "fused_epoch_ms": round(epoch["epoch_s"] * 1e3, 3),
+            "backend": epoch.get("backend"),
+        }
+    if platforms.get("resident") == "accelerator" and resident is not None:
+        acc_update["resident"] = {
+            "resident_epoch_plus_root_ms": round(resident["per_epoch_s"] * 1e3, 3),
+            "backend": resident.get("backend"),
+        }
+    if platforms.get("bls") == "accelerator" and bls_res is not None:
+        acc_update["bls"] = {
+            "bls_aggregates_per_sec": round(bls_res["aggs_per_sec"], 1),
+            "backend": bls_res.get("backend"),
+        }
+    if platforms.get("das") == "accelerator" and das_res is not None:
+        acc_update["das"] = {
+            "das_ffts_per_sec": round(das_res["ffts_per_sec"], 1),
+            "backend": das_res.get("backend"),
+        }
+    if acc_update:
+        _store_lkg(acc_update)
+    if not on_acc:
+        lkg = _load_lkg()
+        if lkg is not None:
+            result["last_known_good"] = lkg
     if error is not None:
         result["error"] = error
     print(json.dumps(result))

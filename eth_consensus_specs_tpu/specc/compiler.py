@@ -45,16 +45,19 @@ def _load_pins() -> dict:
         return json.load(fh)
 
 
-def _verify_pinned(path: str) -> None:
+def _read_pinned(path: str) -> bytes:
+    """Read a reference file ONCE, verify its pin, and return the verified
+    bytes — the caller must parse these bytes, never reopen the path (no
+    check-then-use window for a concurrent writer to exploit)."""
+    with open(path, "rb") as fh:
+        data = fh.read()
     if os.environ.get("ETH_SPECS_ALLOW_UNPINNED"):
-        return
-    pins = _load_pins()
-    rel = os.path.relpath(path, REFERENCE_SPECS)
+        return data
     import hashlib
 
-    with open(path, "rb") as fh:
-        got = hashlib.sha256(fh.read()).hexdigest()
-    want = pins.get(rel)
+    rel = os.path.relpath(path, REFERENCE_SPECS)
+    got = hashlib.sha256(data).hexdigest()
+    want = _load_pins().get(rel)
     if want is None:
         raise RuntimeError(
             f"specc: {rel} is not in pins.json — refusing to exec unpinned "
@@ -66,6 +69,7 @@ def _verify_pinned(path: str) -> None:
             f"specc: {rel} content hash {got[:16]}… != pinned {want[:16]}… — "
             "the reference tree changed under the oracle"
         )
+    return data
 
 
 def _require_absent_unpinned(path: str) -> None:
@@ -173,9 +177,7 @@ def _load_trusted_setup(preset_name: str) -> dict:
     if not os.path.exists(path):
         _require_absent_unpinned(path)
         return {}
-    _verify_pinned(path)
-    with open(path) as fh:
-        data = json.load(fh)
+    data = json.loads(_read_pinned(path))
     out = {}
     from eth_consensus_specs_tpu import ssz
 
@@ -239,8 +241,7 @@ def compile_fork(
     for f in lineage:
         for path in _doc_paths(f):
             if os.path.exists(path):
-                _verify_pinned(path)
-                docs.append(parse_doc(path))
+                docs.append(parse_doc(path, text=_read_pinned(path).decode("utf-8")))
             else:
                 _require_absent_unpinned(path)
 

@@ -85,10 +85,15 @@ def _first_backtick(cell: str) -> str | None:
     return m.group(1) if m else None
 
 
-def parse_doc(path: str) -> ParsedDoc:
+def parse_doc(path: str, text: str | None = None) -> ParsedDoc:
+    """Parse a spec markdown document. When `text` is given, the path is
+    used only for labeling — the caller already read (and content-pinned)
+    the bytes, and the verified bytes must be the consumed bytes."""
     doc = ParsedDoc(path=path)
-    with open(path, encoding="utf-8") as fh:
-        lines = fh.read().splitlines()
+    if text is None:
+        with open(path, encoding="utf-8") as fh:
+            text = fh.read()
+    lines = text.splitlines()
     i = 0
     n = len(lines)
     while i < n:
