@@ -525,7 +525,7 @@ def main() -> None:
     if platforms.get("tree") == "accelerator" and tree is not None:
         acc_update["tree"] = {
             "ssz_merkle_tree_hashes_per_sec": round(dev_hps, 0),
-            "vs_host_hashlib": round(dev_hps / host_hps, 2),
+            "vs_host_hashlib": round(dev_hps / host_hps, 2) if host_hps else None,
             "backend": tree.get("backend"),
         }
     if platforms.get("epoch") == "accelerator" and epoch is not None:

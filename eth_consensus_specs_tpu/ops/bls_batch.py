@@ -10,8 +10,10 @@ specs/altair/beacon-chain.md:575-650). The batching seams:
   2. random-linear-combination batching collapses N pairing checks into
      one (the algorithmic seam the reference uses for KZG batches,
      specs/deneb/polynomial-commitments.md:412-463);
-  3. the single final pairing (and the G2 side of the RLC) stays on host —
-     the G2/pairing limb tower is the next device step.
+  3. the Miller accumulation and final-exponentiation membership check
+     run on DEVICE too (ops/pairing_device — host prepares per-Q line
+     coefficients, the device runs the batched fixed-structure loop);
+     only hash-to-curve and the 64-bit G2 RLC multiplies stay host-side.
 
 `process_operations` routes block attestations through
 `batch_verify_aggregates` (one pairing per block) and falls back to
@@ -39,6 +41,18 @@ def _use_device() -> bool:
     return bls.backend_name() == "tpu"
 
 
+def _pairing_check_routed(pairs) -> bool:
+    """Device Miller loop + membership check under the tpu backend; the
+    host/native pairing elsewhere. Both are bit-equivalent implementations
+    of the same check (tests/test_pairing_device.py), so routing can never
+    flip a verification result."""
+    if _use_device():
+        from eth_consensus_specs_tpu.ops.pairing_device import pairing_check_device
+
+        return pairing_check_device(pairs)
+    return pairing_check(pairs)
+
+
 def fast_aggregate_verify_device(pks: list[bytes], message: bytes, sig: bytes) -> bool:
     """FastAggregateVerify with the pubkey aggregation on device and the
     pairing on host. Semantics mirror the host path exactly (per-key
@@ -60,7 +74,7 @@ def fast_aggregate_verify_device(pks: list[bytes], message: bytes, sig: bytes) -
     if sig_pt is None:
         return False
     aggpk = sum_g1_device(points)
-    return pairing_check(
+    return _pairing_check_routed(
         [(aggpk, hash_to_g2(bytes(message))), (-g1_generator(), sig_pt)]
     )
 
@@ -124,4 +138,4 @@ def batch_verify_aggregates(items: list[tuple[list[bytes], bytes, bytes]]) -> bo
         sig_acc = term if sig_acc is None else sig_acc + term
     pairs = [(rp, hash_to_g2(msg)) for msg, rp in merged.items()]
     pairs.append((-g1, sig_acc))
-    return pairing_check(pairs)
+    return _pairing_check_routed(pairs)

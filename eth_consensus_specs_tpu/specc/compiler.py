@@ -83,9 +83,10 @@ def _require_absent_unpinned(path: str) -> None:
 
 # Fork lineage and the per-fork document sets compiled into the oracle.
 # beacon-chain + fork (upgrade) + the crypto documents containers depend
-# on; fork-choice/validator/p2p/light-client are out of the v1 oracle
-# scope (reference doc map: pysetup/md_doc_paths.py:78-96).
-CHAIN = ["phase0", "altair", "bellatrix", "capella", "deneb", "electra"]
+# on, through the full lineage phase0..gloas including the fulu DAS math;
+# validator/p2p/light-client stay out of the oracle scope (reference doc
+# map: pysetup/md_doc_paths.py:78-96).
+CHAIN = ["phase0", "altair", "bellatrix", "capella", "deneb", "electra", "fulu", "gloas"]
 DOC_SETS: dict[str, list[str]] = {
     "phase0": ["beacon-chain.md"],
     "altair": ["beacon-chain.md", "bls.md", "fork.md"],
@@ -93,18 +94,107 @@ DOC_SETS: dict[str, list[str]] = {
     "capella": ["beacon-chain.md", "fork.md"],
     "deneb": ["polynomial-commitments.md", "beacon-chain.md", "fork.md"],
     "electra": ["beacon-chain.md", "fork.md"],
+    "fulu": [
+        "polynomial-commitments-sampling.md",
+        "das-core.md",
+        "beacon-chain.md",
+        "fork.md",
+    ],
+    "gloas": ["beacon-chain.md", "fork.md"],
+}
+
+# fork-choice documents, compiled on request (compile_fork(..., fork_choice
+# =True)) on top of the beacon-chain lineage — the reference compiles
+# fork-choice.md per fork into the same flat module
+# (pysetup/md_doc_paths.py:36-77). Not every fork modifies fork choice.
+FC_DOCS: dict[str, list[str]] = {
+    # validator.md precedes fork-choice.md: the handlers read timing
+    # constants defined in the honest-validator doc (ATTESTATION_DUE_BPS,
+    # reference specs/phase0/validator.md:113 used by fork-choice.md:482)
+    "phase0": ["validator.md", "fork-choice.md"],
+    "altair": ["validator.md", "fork-choice.md"],
+    "bellatrix": ["validator.md", "fork-choice.md"],
+    "capella": ["validator.md", "fork-choice.md"],
+    "deneb": ["validator.md", "fork-choice.md"],
+    "electra": ["validator.md", "fork-choice.md"],
+    "fulu": ["validator.md", "fork-choice.md"],
+    "gloas": ["validator.md", "fork-choice.md"],
 }
 
 _FUTURE = "from __future__ import annotations\n"
 
+# Definitions the reference keeps in documents outside the oracle doc set
+# (p2p-interface tables marked `<!-- predefined -->`), with the exact
+# expressions from those tables, as (kind, name, expr) fixpoint items.
+_PREDEFINED: dict[str, list[tuple[str, str, str]]] = {
+    # NodeID/SubnetID custom types (specs/phase0/p2p-interface.md:235-236)
+    "phase0": [
+        ("ctype", "NodeID", "uint256"),
+        ("ctype", "SubnetID", "uint64"),
+    ],
+    "fulu": [
+        (
+            "const",
+            "KZG_COMMITMENTS_INCLUSION_PROOF_DEPTH",
+            "uint64(floorlog2(get_generalized_index(BeaconBlockBody, 'blob_kzg_commitments')))",
+        ),
+    ],
+}
+
+# Classes the reference's per-fork spec builders inject instead of the
+# `<!-- predefined-type -->` table aliases (pysetup/spec_builders/deneb.py
+# classes(): BLSFieldElement(bls.Scalar), Polynomial; fulu.py classes():
+# PolynomialCoeff, Coset, CosetEvals). Semantically equivalent first-party
+# definitions; they override the table alias during the class fixpoint.
+_BUILDER_CLASSES: dict[str, list[tuple[str, str]]] = {
+    "deneb": [
+        ("BLSFieldElement", "class BLSFieldElement(bls.Scalar):\n    pass\n"),
+        (
+            "Polynomial",
+            "class Polynomial(list):\n"
+            "    def __init__(self, evals=None):\n"
+            "        if evals is None:\n"
+            "            evals = [BLSFieldElement(0)] * FIELD_ELEMENTS_PER_BLOB\n"
+            "        if len(evals) != FIELD_ELEMENTS_PER_BLOB:\n"
+            "            raise ValueError('expected FIELD_ELEMENTS_PER_BLOB evals')\n"
+            "        super().__init__(evals)\n",
+        ),
+    ],
+    "fulu": [
+        (
+            "PolynomialCoeff",
+            "class PolynomialCoeff(list):\n"
+            "    def __init__(self, coeffs):\n"
+            "        if len(coeffs) > FIELD_ELEMENTS_PER_EXT_BLOB:\n"
+            "            raise ValueError('expected <= FIELD_ELEMENTS_PER_EXT_BLOB coeffs')\n"
+            "        super().__init__(coeffs)\n",
+        ),
+        (
+            "Coset",
+            "class Coset(list):\n"
+            "    def __init__(self, coeffs=None):\n"
+            "        if coeffs is None:\n"
+            "            coeffs = [BLSFieldElement(0)] * FIELD_ELEMENTS_PER_CELL\n"
+            "        if len(coeffs) != FIELD_ELEMENTS_PER_CELL:\n"
+            "            raise ValueError('expected FIELD_ELEMENTS_PER_CELL coeffs')\n"
+            "        super().__init__(coeffs)\n",
+        ),
+        (
+            "CosetEvals",
+            "class CosetEvals(list):\n"
+            "    def __init__(self, evals=None):\n"
+            "        if evals is None:\n"
+            "            evals = [BLSFieldElement(0)] * FIELD_ELEMENTS_PER_CELL\n"
+            "        if len(evals) != FIELD_ELEMENTS_PER_CELL:\n"
+            "            raise ValueError('expected FIELD_ELEMENTS_PER_CELL coeffs')\n"
+            "        super().__init__(evals)\n",
+        ),
+    ],
+}
+
 
 def compiled_forks() -> list[str]:
     return list(CHAIN)
-
-
-def _doc_paths(fork: str) -> list[str]:
-    base = os.path.join(REFERENCE_SPECS, "specs", fork)
-    return [os.path.join(base, name) for name in DOC_SETS[fork]]
 
 
 def _coerce(default, raw):
@@ -211,10 +301,15 @@ class CompileReport:
 
 @lru_cache(maxsize=None)
 def compile_fork(
-    fork: str, preset_name: str = "minimal", config_name: str | None = None
+    fork: str,
+    preset_name: str = "minimal",
+    config_name: str | None = None,
+    fork_choice: bool = False,
 ) -> types.ModuleType:
     """Compile the reference markdown lineage of `fork` into an executable
-    module bound to this framework's runtime."""
+    module bound to this framework's runtime. With ``fork_choice=True`` the
+    lineage's fork-choice.md documents (Store + handlers) compile into the
+    same namespace, mirroring the reference's flat per-fork module."""
     if fork not in CHAIN:
         raise ValueError(f"fork {fork!r} not in compiled lineage {CHAIN}")
     lineage = CHAIN[: CHAIN.index(fork) + 1]
@@ -238,8 +333,14 @@ def compile_fork(
         ns[ancestor] = compile_fork(ancestor, preset_name, config_name)
 
     docs: list[ParsedDoc] = []
-    for f in lineage:
-        for path in _doc_paths(f):
+    doc_names: list[list[str]] = [list(DOC_SETS[f]) for f in lineage]
+    if fork_choice:
+        for i, f in enumerate(lineage):
+            doc_names[i] += FC_DOCS[f]
+    for f, names in zip(lineage, doc_names):
+        base = os.path.join(REFERENCE_SPECS, "specs", f)
+        for name in names:
+            path = os.path.join(base, name)
             if os.path.exists(path):
                 docs.append(parse_doc(path, text=_read_pinned(path).decode("utf-8")))
             else:
@@ -285,41 +386,91 @@ def compile_fork(
         for kind, name, expr in doc.table_items:
             if _apply_item(kind, name, expr) is not None:
                 pending.append((kind, name, expr))
-    while pending:
+    # "predefined" constants the reference keeps in documents outside the
+    # oracle doc set (p2p-interface tables marked `<!-- predefined -->`);
+    # same expressions, evaluated through the fixpoint like any table row
+    for f in lineage:
+        for kind, name, expr in _PREDEFINED.get(f, ()):
+            if _apply_item(kind, name, expr) is not None:
+                pending.append((kind, name, expr))
+    skip_reasons: dict[tuple[str, str], str] = {}
+
+    def _retry_pending() -> bool:
+        """One sweep over deferred table items; True if any landed."""
+        nonlocal pending
+        progressed = False
         still: list[tuple[str, str, str]] = []
-        reasons: dict[tuple[str, str], str] = {}
         for kind, name, expr in pending:
             reason = _apply_item(kind, name, expr)
-            if reason is not None:
+            if reason is None:
+                progressed = True
+            else:
+                skip_reasons[(kind, name)] = reason
                 still.append((kind, name, expr))
-                reasons[(kind, name)] = reason
-        if len(still) == len(pending):
-            for kind, name, expr in still:
-                target = report.skipped_types if kind == "ctype" else report.skipped_constants
-                target.append((name, expr, reasons[(kind, name)]))
-            break
         pending = still
+        return progressed
+
+    while pending and _retry_pending():
+        pass
+
+    # config vars with no markdown table definition (BLOB_SCHEDULE lives
+    # only in configs/*.yaml; the reference exposes EVERY config key on the
+    # module via its config.NAME rewrite, pysetup/helpers.py:94-98)
+    for cname, cval in config_vals.items():
+        ns.setdefault(cname, cval)
 
     # trusted setup globals (deneb+ polynomial commitments)
     if "deneb" in lineage:
         ns.update(_load_trusted_setup(preset_name))
 
-    # pass 2: classes — override by name across the lineage, then one
-    # topologically-ordered exec
+    # pass 2: classes — override by name across the lineage, then a
+    # topologically-ordered exec. A class may need a constant that itself
+    # needs an earlier class (fulu's DataColumnSidecar sizes a Vector by
+    # KZG_COMMITMENTS_INCLUSION_PROOF_DEPTH = f(BeaconBlockBody) — the
+    # reference's predefined p2p constants), so deferred table items are
+    # retried between class sweeps to a joint fixpoint.
     classes: dict[str, str] = {}
     order: dict[str, int] = {}
     counter = 0
+    for f in lineage:
+        for name, code in _BUILDER_CLASSES.get(f, ()):
+            if name not in order:
+                order[name] = counter
+                counter += 1
+            classes[name] = code
     for doc in docs:
         for name, code in doc.classes.items():
             if name not in order:
                 order[name] = counter
                 counter += 1
             classes[name] = code
-    for name in _topo_classes(classes, order):
-        # dont_inherit: this module's own `from __future__ import
-        # annotations` must NOT leak into spec class bodies — container
-        # fields need eagerly-evaluated type annotations
-        exec(compile(classes[name], f"<spec:{name}>", "exec", dont_inherit=True), ns)  # noqa: S102
+    remaining = _topo_classes(classes, order)
+    while remaining:
+        progressed = False
+        deferred: list[str] = []
+        for name in remaining:
+            try:
+                # dont_inherit: this module's own `from __future__ import
+                # annotations` must NOT leak into spec class bodies —
+                # container fields need eagerly-evaluated type annotations
+                exec(compile(classes[name], f"<spec:{name}>", "exec", dont_inherit=True), ns)  # noqa: S102
+                progressed = True
+            except NameError:
+                deferred.append(name)
+        if _retry_pending():
+            progressed = True
+        if not progressed:
+            # re-raise the first failure with its real error
+            exec(compile(classes[deferred[0]], f"<spec:{deferred[0]}>", "exec", dont_inherit=True), ns)  # noqa: S102
+        remaining = deferred
+    # tail sweep: constants chained behind other just-landed constants
+    while pending and _retry_pending():
+        pass
+    for kind, name, expr in pending:
+        target = report.skipped_types if kind == "ctype" else report.skipped_constants
+        target.append(
+            (name, expr, skip_reasons.get((kind, name), "unresolved after fixpoint"))
+        )
 
     # pass 3: functions (late-bound globals; deferred annotations)
     functions: dict[str, str] = {}
@@ -343,6 +494,13 @@ def compile_fork(
             return _bls.AggregatePKs(list(pubkeys))
 
         ns["eth_aggregate_pubkeys"] = eth_aggregate_pubkeys
+    if fork_choice and "deneb" in lineage:
+        # data-availability retrieval stubs the reference injects per fork
+        # builder (pysetup/spec_builders/deneb.py:38-43, fulu.py:46) —
+        # tests monkeypatch these exactly as the reference's do
+        ns.setdefault("retrieve_blobs_and_proofs", lambda beacon_block_root: ([], []))
+    if fork_choice and "fulu" in lineage:
+        ns.setdefault("retrieve_column_sidecars", lambda beacon_block_root: [])
 
     ns["preset"] = preset
     ns["config"] = config

@@ -45,6 +45,12 @@ def _uint_to_bytes(n) -> bytes:
     return ssz.uint64(n).encode_bytes()
 
 
+def _get_generalized_index(typ, *path):
+    from eth_consensus_specs_tpu.ssz.gindex import get_generalized_index
+
+    return get_generalized_index(typ, *path)
+
+
 class _NoopExecutionEngine:
     """Behavioral match of the reference's NoopExecutionEngine
     (pysetup/spec_builders/deneb.py:46-79): every verification answers
@@ -117,6 +123,7 @@ def build_namespace() -> dict:
         "bls": bls,
         "hash": lambda data: ssz.Bytes32(hash_bytes(bytes(data))),
         "hash_tree_root": ssz.hash_tree_root,
+        "get_generalized_index": _get_generalized_index,
         "serialize": ssz.serialize,
         "uint_to_bytes": _uint_to_bytes,
         "copy": _copy,

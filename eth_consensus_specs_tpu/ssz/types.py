@@ -640,7 +640,22 @@ class _Sequence(View):
 
     def __setitem__(self, i, v):
         if isinstance(i, slice):
-            raise TypeError("slice assignment is not supported; assign elements individually")
+            # length-preserving slice assignment (spec code shifts windows,
+            # e.g. fulu process_proposer_lookahead,
+            # specs/fulu/beacon-chain.md:318-326)
+            idxs = range(*i.indices(len(self._items)))
+            vals = list(v)
+            if len(vals) != len(idxs):
+                raise ValueError(
+                    f"slice assignment must preserve length ({len(idxs)} != {len(vals)})"
+                )
+            # coerce BEFORE mutating: a mid-loop coercion failure must not
+            # leave a half-modified sequence with a stale cached root
+            coerced = [_store_coerce(self.ELEMENT_TYPE, val) for val in vals]
+            for j, val in zip(idxs, coerced):
+                self._items[j] = val
+            self._root_cache = None
+            return
         if not -len(self._items) <= i < len(self._items):
             raise IndexError(f"index {i} out of range for length {len(self._items)}")
         self._items[int(i)] = _store_coerce(self.ELEMENT_TYPE, v)

@@ -200,6 +200,91 @@ def G2_to_signature(p) -> bytes:
     return _curve.g2_to_bytes(p)
 
 
+class Scalar:
+    """Field element mod the curve order r — the reference's `bls.Scalar`
+    surface (reference utils/bls.py:35-54 py_ecc_Scalar / arkworks Scalar)
+    that the deneb/fulu polynomial markdown builds `BLSFieldElement` on.
+    Arithmetic reduces mod r; int operands coerce."""
+
+    field_modulus = CURVE_ORDER
+    __slots__ = ("n",)
+
+    def __init__(self, value):
+        self.n = int(value) % CURVE_ORDER
+
+    def _coerce(self, o):
+        if isinstance(o, Scalar):
+            return o.n
+        return int(o)
+
+    def __add__(self, o):
+        return type(self)(self.n + self._coerce(o))
+
+    __radd__ = __add__
+
+    def __sub__(self, o):
+        return type(self)(self.n - self._coerce(o))
+
+    def __rsub__(self, o):
+        return type(self)(self._coerce(o) - self.n)
+
+    def __mul__(self, o):
+        return type(self)(self.n * self._coerce(o))
+
+    __rmul__ = __mul__
+
+    def __neg__(self):
+        return type(self)(-self.n)
+
+    def __pow__(self, e):
+        return type(self)(pow(self.n, int(e), CURVE_ORDER))
+
+    def pow(self, exp):
+        return self ** int(exp)
+
+    def inverse(self):
+        return type(self)(pow(self.n, CURVE_ORDER - 2, CURVE_ORDER))
+
+    def __truediv__(self, o):
+        return self * type(self)(self._coerce(o)).inverse()
+
+    def __eq__(self, o):
+        if isinstance(o, (Scalar, int)):
+            return self.n == self._coerce(o) % CURVE_ORDER
+        return NotImplemented
+
+    def __hash__(self):
+        return hash(("Scalar", self.n))
+
+    def __int__(self):
+        return self.n
+
+    def __index__(self):
+        return self.n
+
+    def __repr__(self):
+        return f"Scalar({self.n})"
+
+
+# Serialization aliases under the reference's KZG-facing names (reference
+# utils/bls.py:345-392; the deneb polynomial-commitments markdown calls
+# bls.G1_to_bytes48 / bls.bytes48_to_G1 / bls.bytes96_to_G2 directly).
+def G1_to_bytes48(p) -> bytes:
+    return _curve.g1_to_bytes(p)
+
+
+def bytes48_to_G1(b: bytes):
+    return _curve.g1_from_bytes(bytes(b))
+
+
+def G2_to_bytes96(p) -> bytes:
+    return _curve.g2_to_bytes(p)
+
+
+def bytes96_to_G2(b: bytes):
+    return _curve.g2_from_bytes(bytes(b))
+
+
 def Z1():
     return _curve.g1_infinity()
 

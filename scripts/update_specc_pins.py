@@ -3,7 +3,7 @@
 the spec-oracle compiler is allowed to exec code from.
 
 Run after auditing a reference-tree change. The compiler refuses unpinned
-or hash-mismatching files (specc/compiler.py:_verify_pinned)."""
+or hash-mismatching files (specc/compiler.py:_read_pinned)."""
 
 import hashlib
 import json
@@ -18,7 +18,9 @@ from eth_consensus_specs_tpu.specc import compiler as c
 def main() -> None:
     paths: set[str] = set()
     for fork in c.DOC_SETS:
-        for p in c._doc_paths(fork):
+        names = list(c.DOC_SETS[fork]) + list(c.FC_DOCS.get(fork, []))
+        for name in names:
+            p = os.path.join(c.REFERENCE_SPECS, "specs", fork, name)
             if os.path.exists(p):
                 paths.add(p)
     for preset in ("minimal", "mainnet"):

@@ -25,18 +25,52 @@ from eth_consensus_specs_tpu.specc import compile_fork, compiled_forks
 from eth_consensus_specs_tpu.test_infra.genesis import create_genesis_state
 from eth_consensus_specs_tpu.utils import bls
 
-PARITY_FORKS = compiled_forks()  # phase0 .. electra
+PARITY_FORKS = compiled_forks()  # phase0 .. gloas
+
+# Preset axis: the reference builds every fork x {minimal, mainnet}
+# (reference Makefile:5-17). test_parity.py runs under minimal; the
+# mainnet re-collection module flips this seam for the same cases.
+_CURRENT_PRESET = "minimal"
+
+
+class preset_override:
+    def __init__(self, preset: str):
+        self.preset = preset
+
+    def __enter__(self):
+        global _CURRENT_PRESET
+        self._prev = _CURRENT_PRESET
+        _CURRENT_PRESET = self.preset
+
+    def __exit__(self, *exc):
+        global _CURRENT_PRESET
+        _CURRENT_PRESET = self._prev
+
+
+def current_preset() -> str:
+    return _CURRENT_PRESET
+
+
+def specs(fork: str, preset: str | None = None):
+    """(class-spec, compiled-reference-spec) pair for a fork."""
+    return _specs(fork, preset or _CURRENT_PRESET)
 
 
 @lru_cache(maxsize=None)
-def specs(fork: str):
-    """(class-spec, compiled-reference-spec) pair for a fork, minimal preset."""
-    return get_spec(fork, "minimal"), compile_fork(fork, "minimal")
+def _specs(fork: str, preset: str):
+    return get_spec(fork, preset), compile_fork(fork, preset)
 
 
-@lru_cache(maxsize=None)
-def _genesis_bytes(fork: str, n_validators: int = 64) -> bytes:
+def genesis_state(fork: str):
+    """Fresh framework-side genesis state (deserialized from the cached
+    serialization, so mutation in one test never leaks into another)."""
     spec, _ = specs(fork)
+    return ssz.deserialize(spec.BeaconState, _genesis_bytes(fork, _CURRENT_PRESET))
+
+
+@lru_cache(maxsize=None)
+def _genesis_bytes(fork: str, preset: str, n_validators: int = 64) -> bytes:
+    spec, _ = specs(fork, preset)
     prev = bls.bls_active
     bls.bls_active = False
     try:
@@ -46,13 +80,6 @@ def _genesis_bytes(fork: str, n_validators: int = 64) -> bytes:
     finally:
         bls.bls_active = prev
     return bytes(ssz.serialize(state))
-
-
-def genesis_state(fork: str):
-    """Fresh framework-side genesis state (deserialized from the cached
-    serialization, so mutation in one test never leaks into another)."""
-    spec, _ = specs(fork)
-    return ssz.deserialize(spec.BeaconState, _genesis_bytes(fork))
 
 
 def to_ref(ref, obj, type_name: str | None = None):

@@ -1,0 +1,148 @@
+"""Fork-choice parity: this framework's Store/handlers vs the reference's
+fork-choice.md compiled by specc (Store dataclass + on_tick/on_block/
+on_attestation; reference: specs/phase0/fork-choice.md:162-811 and the
+per-fork fork-choice deltas through gloas).
+
+A replayed event sequence — ticks, signed blocks, attestations — is fed to
+both stores; agreement is asserted on head root, justified/finalized
+checkpoints, and the proposer-boost root after every step (the observable
+surface the reference's fork_choice vector format checks:
+tests/formats/fork_choice/README.md)."""
+
+from __future__ import annotations
+
+import pytest
+
+from eth_consensus_specs_tpu import ssz
+from eth_consensus_specs_tpu.specc import compile_fork
+from eth_consensus_specs_tpu.test_infra import attestations as att_h
+from eth_consensus_specs_tpu.test_infra.block import (
+    build_empty_block_for_next_slot,
+    state_transition_and_sign_block,
+)
+from eth_consensus_specs_tpu.utils import bls
+
+from .helpers import PARITY_FORKS, current_preset, genesis_state, specs, to_ref
+
+
+@pytest.fixture(autouse=True)
+def _bls_off():
+    prev = bls.bls_active
+    bls.bls_active = False
+    yield
+    bls.bls_active = prev
+
+
+FC_FORKS = [f for f in PARITY_FORKS if f != "gloas"]
+# gloas restructures on_block around payload envelopes (bids processed in
+# the block, payloads revealed separately); its replay needs envelope
+# events and is covered by test_gloas_store_bootstrap below.
+
+
+def _ref_fc(fork: str):
+    return compile_fork(fork, current_preset(), None, True)
+
+
+def _bootstrap(spec, ref, fork):
+    state = genesis_state(fork)
+    block = spec.BeaconBlock(state_root=ssz.hash_tree_root(state))
+    store = spec.get_forkchoice_store(state.copy(), block)
+    ref_state = to_ref(ref, state, "BeaconState")
+    ref_block = to_ref(ref, block, "BeaconBlock")
+    ref_store = ref.get_forkchoice_store(ref_state, ref_block)
+    return state, store, ref_store
+
+
+def _assert_store_agreement(spec, ref, store, ref_store, ctx=""):
+    ours_head = bytes(spec.get_head_root(store))
+    theirs_head = bytes(ref.get_head(ref_store))
+    assert ours_head == theirs_head, f"head diverged {ctx}"
+    for cp in ("justified_checkpoint", "finalized_checkpoint"):
+        ours = getattr(store, cp)
+        theirs = getattr(ref_store, cp)
+        assert (int(ours.epoch), bytes(ours.root)) == (
+            int(theirs.epoch),
+            bytes(theirs.root),
+        ), f"{cp} diverged {ctx}"
+    assert bytes(store.proposer_boost_root) == bytes(ref_store.proposer_boost_root), (
+        f"proposer_boost_root diverged {ctx}"
+    )
+
+
+@pytest.mark.parametrize("fork", FC_FORKS)
+def test_store_bootstrap_parity(fork):
+    spec, _ = specs(fork)
+    ref = _ref_fc(fork)
+    _, store, ref_store = _bootstrap(spec, ref, fork)
+    _assert_store_agreement(spec, ref, store, ref_store, "at anchor")
+    assert int(store.time) == int(ref_store.time)
+
+
+@pytest.mark.parametrize("fork", FC_FORKS)
+def test_on_tick_on_block_replay_parity(fork):
+    """One epoch of blocks driven through both stores tick by tick."""
+    spec, _ = specs(fork)
+    ref = _ref_fc(fork)
+    state, store, ref_store = _bootstrap(spec, ref, fork)
+    seconds_per_slot = int(spec.config.SECONDS_PER_SLOT)
+    genesis_time = int(store.genesis_time)
+    for _ in range(int(spec.SLOTS_PER_EPOCH)):
+        target_slot = int(state.slot) + 1
+        t = genesis_time + target_slot * seconds_per_slot
+        spec.on_tick(store, t)
+        ref.on_tick(ref_store, t)
+        block = build_empty_block_for_next_slot(spec, state)
+        signed = state_transition_and_sign_block(spec, state, block)
+        spec.on_block(store, signed)
+        ref.on_block(ref_store, to_ref(ref, signed, "SignedBeaconBlock"))
+        _assert_store_agreement(spec, ref, store, ref_store, f"at slot {target_slot}")
+
+
+@pytest.mark.parametrize("fork", ["phase0", "altair", "electra"])
+def test_on_attestation_parity(fork):
+    """A valid unaggregated attestation shifts latest messages (and thus
+    potentially the head) identically in both stores."""
+    spec, _ = specs(fork)
+    ref = _ref_fc(fork)
+    state, store, ref_store = _bootstrap(spec, ref, fork)
+    seconds_per_slot = int(spec.config.SECONDS_PER_SLOT)
+    genesis_time = int(store.genesis_time)
+    # two competing chains is overkill here; one block + attestation to it
+    t = genesis_time + (int(state.slot) + 1) * seconds_per_slot
+    spec.on_tick(store, t)
+    ref.on_tick(ref_store, t)
+    block = build_empty_block_for_next_slot(spec, state)
+    signed = state_transition_and_sign_block(spec, state, block)
+    spec.on_block(store, signed)
+    ref.on_block(ref_store, to_ref(ref, signed, "SignedBeaconBlock"))
+    att = att_h.get_valid_attestation(spec, state, signed=True)
+    # move past the attestation slot so it is no longer "from the future"
+    t2 = genesis_time + (int(att.data.slot) + 2) * seconds_per_slot
+    spec.on_tick(store, t2)
+    ref.on_tick(ref_store, t2)
+    spec.on_attestation(store, att)
+    ref.on_attestation(ref_store, to_ref(ref, att, "Attestation"))
+    _assert_store_agreement(spec, ref, store, ref_store, "after attestation")
+    lm_ours = {int(k): (int(v.epoch), bytes(v.root)) for k, v in store.latest_messages.items()}
+    lm_theirs = {
+        int(k): (int(v.epoch), bytes(v.root)) for k, v in ref_store.latest_messages.items()
+    }
+    assert lm_ours == lm_theirs
+
+
+def test_gloas_store_bootstrap():
+    """gloas bootstraps its restructured store (payload-status tracking)
+    from the same anchor on both sides."""
+    fork = "gloas"
+    spec, _ = specs(fork)
+    ref = _ref_fc(fork)
+    state = genesis_state(fork)
+    block = spec.BeaconBlock(state_root=ssz.hash_tree_root(state))
+    store = spec.get_forkchoice_store(state.copy(), block)
+    ref_store = ref.get_forkchoice_store(
+        to_ref(ref, state, "BeaconState"), to_ref(ref, block, "BeaconBlock")
+    )
+    # gloas get_head returns a ForkChoiceNode (root + payload status)
+    theirs = ref.get_head(ref_store)
+    assert bytes(spec.get_head_root(store)) == bytes(theirs.root)
+    assert int(store.time) == int(ref_store.time)

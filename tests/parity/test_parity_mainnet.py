@@ -1,0 +1,27 @@
+"""The parity suite re-collected under the MAINNET preset.
+
+The reference builds and nightly-tests every fork under mainnet as well as
+minimal (reference Makefile:5-17, .github/workflows/nightly-tests.yml:25-50);
+this module replays the differential-parity cases against mainnet-preset
+compiled oracles. The randomized-chain cases stay minimal-only (they walk
+2 epochs x 3 seeds x 8 forks; at 32 slots/epoch that is wall-clock, not
+coverage).
+"""
+
+from __future__ import annotations
+
+import pytest
+
+from . import helpers
+from .test_parity import *  # noqa: F401,F403 — re-collect the suite
+from .test_parity import _bls_off  # noqa: F401 — star-import skips _names
+
+# drop the long randomized chains from the mainnet lane
+test_randomized_chain_parity = None  # noqa: F811
+del test_randomized_chain_parity
+
+
+@pytest.fixture(autouse=True)
+def _mainnet():
+    with helpers.preset_override("mainnet"):
+        yield

@@ -1,8 +1,8 @@
 """Device Fq2/Fq6/Fq12 tower vs the host oracle (crypto/fields.py).
 
 Every op is checked batched over random elements for bit-exact agreement
-after canonicalization (the limb kernel's redundant [0, 2p) range is
-normalized at the host boundary)."""
+after canonicalization (the lazy limb kernel's redundant values are
+normalized at the host boundary — from_mont_int reduces mod p)."""
 
 import random
 
@@ -11,6 +11,8 @@ import pytest
 
 from eth_consensus_specs_tpu.crypto.fields import P, Fq, Fq2, Fq6, Fq12
 from eth_consensus_specs_tpu.ops import fq12_tower as tw
+from eth_consensus_specs_tpu.ops import lazy_limbs as lz
+from eth_consensus_specs_tpu.ops.lazy_limbs import lf
 
 rng = random.Random(1234)
 
@@ -36,6 +38,11 @@ def limbs_to_fq6(arr) -> Fq6:
     return Fq6(*[tw.limbs_to_fq2(a[i]) for i in range(3)])
 
 
+def out(x) -> np.ndarray:
+    """LF -> host array (lazy values are fine: from_mont_int reduces)."""
+    return np.asarray(lz.norm(x).v)
+
+
 BATCH = 4
 
 
@@ -43,38 +50,39 @@ class TestFq2:
     def test_mul_sqr_inv(self):
         xs = [rand_fq2() for _ in range(BATCH)]
         ys = [rand_fq2() for _ in range(BATCH)]
-        dx = np.stack([tw.fq2_to_limbs(x) for x in xs])
-        dy = np.stack([tw.fq2_to_limbs(y) for y in ys])
-        got_mul = tw.fq2_mul(dx, dy)
-        got_sqr = tw.fq2_sqr(dx)
-        got_inv = tw.fq2_inv(dx)
-        got_xi = tw.fq2_mul_xi(dx)
-        for i, (x, y) in enumerate(zip(xs, ys)):
-            assert tw.limbs_to_fq2(np.asarray(got_mul)[i]) == x * y
-            assert tw.limbs_to_fq2(np.asarray(got_sqr)[i]) == x.square()
-            assert tw.limbs_to_fq2(np.asarray(got_inv)[i]) == x.inv()
-            from eth_consensus_specs_tpu.crypto.fields import XI
+        dx = lf(np.stack([tw.fq2_to_limbs(x) for x in xs]), val=P - 1)
+        dy = lf(np.stack([tw.fq2_to_limbs(y) for y in ys]), val=P - 1)
+        got_mul = out(tw.fq2_mul(dx, dy))
+        got_sqr = out(tw.fq2_sqr(dx))
+        got_inv = out(tw.fq2_inv(dx))
+        got_xi = out(tw.fq2_mul_xi(dx))
+        from eth_consensus_specs_tpu.crypto.fields import XI
 
-            assert tw.limbs_to_fq2(np.asarray(got_xi)[i]) == x * XI
+        for i, (x, y) in enumerate(zip(xs, ys)):
+            assert tw.limbs_to_fq2(got_mul[i]) == x * y
+            assert tw.limbs_to_fq2(got_sqr[i]) == x.square()
+            assert tw.limbs_to_fq2(got_inv[i]) == x.inv()
+            assert tw.limbs_to_fq2(got_xi[i]) == x * XI
 
     def test_conj_neg_addsub(self):
         x, y = rand_fq2(), rand_fq2()
-        dx, dy = tw.fq2_to_limbs(x), tw.fq2_to_limbs(y)
-        assert tw.limbs_to_fq2(tw.fq2_add(dx, dy)) == x + y
-        assert tw.limbs_to_fq2(tw.fq2_sub(dx, dy)) == x - y
-        assert tw.limbs_to_fq2(tw.fq2_conj(dx)) == x.conjugate()
-        assert tw.limbs_to_fq2(tw.fq2_neg(dx)) == -x
+        dx = lf(tw.fq2_to_limbs(x), val=P - 1)
+        dy = lf(tw.fq2_to_limbs(y), val=P - 1)
+        assert tw.limbs_to_fq2(out(tw.fq2_add(dx, dy))) == x + y
+        assert tw.limbs_to_fq2(out(tw.fq2_sub(dx, dy))) == x - y
+        assert tw.limbs_to_fq2(out(tw.fq2_conj(dx))) == x.conjugate()
+        assert tw.limbs_to_fq2(out(tw.fq2_neg(dx))) == -x
 
 
 class TestFq6:
     def test_mul_inv_v(self):
         xs = [rand_fq6() for _ in range(BATCH)]
         ys = [rand_fq6() for _ in range(BATCH)]
-        dx = np.stack([fq6_to_limbs(x) for x in xs])
-        dy = np.stack([fq6_to_limbs(y) for y in ys])
-        got_mul = np.asarray(tw.fq6_mul(dx, dy))
-        got_inv = np.asarray(tw.fq6_inv(dx))
-        got_v = np.asarray(tw.fq6_mul_v(dx))
+        dx = lf(np.stack([fq6_to_limbs(x) for x in xs]), val=P - 1)
+        dy = lf(np.stack([fq6_to_limbs(y) for y in ys]), val=P - 1)
+        got_mul = out(tw.fq6_mul(dx, dy))
+        got_inv = out(tw.fq6_inv(dx))
+        got_v = out(tw.fq6_mul_v(dx))
         for i, (x, y) in enumerate(zip(xs, ys)):
             assert limbs_to_fq6(got_mul[i]) == x * y
             assert limbs_to_fq6(got_inv[i]) * x == Fq6.one()
@@ -85,12 +93,12 @@ class TestFq12:
     def test_mul_sqr_inv_conj(self):
         xs = [rand_fq12() for _ in range(BATCH)]
         ys = [rand_fq12() for _ in range(BATCH)]
-        dx = np.stack([tw.fq12_to_limbs(x) for x in xs])
-        dy = np.stack([tw.fq12_to_limbs(y) for y in ys])
-        got_mul = np.asarray(tw.fq12_mul(dx, dy))
-        got_sqr = np.asarray(tw.fq12_sqr(dx))
-        got_inv = np.asarray(tw.fq12_inv(dx))
-        got_conj = np.asarray(tw.fq12_conj(dx))
+        dx = lf(np.stack([tw.fq12_to_limbs(x) for x in xs]), val=P - 1)
+        dy = lf(np.stack([tw.fq12_to_limbs(y) for y in ys]), val=P - 1)
+        got_mul = out(tw.fq12_mul(dx, dy))
+        got_sqr = out(tw.fq12_sqr(dx))
+        got_inv = out(tw.fq12_inv(dx))
+        got_conj = out(tw.fq12_conj(dx))
         for i, (x, y) in enumerate(zip(xs, ys)):
             assert tw.limbs_to_fq12(got_mul[i]) == x * y
             assert tw.limbs_to_fq12(got_sqr[i]) == x.square()
@@ -99,27 +107,29 @@ class TestFq12:
 
     def test_frobenius(self):
         x = rand_fq12()
-        dx = tw.fq12_to_limbs(x)
-        assert tw.limbs_to_fq12(np.asarray(tw.fq12_frobenius(dx))) == x.frobenius()
+        dx = lf(tw.fq12_to_limbs(x), val=P - 1)
+        assert tw.limbs_to_fq12(out(tw.fq12_frobenius(dx))) == x.frobenius()
         assert (
-            tw.limbs_to_fq12(np.asarray(tw.fq12_frobenius2(dx)))
+            tw.limbs_to_fq12(out(tw.fq12_frobenius2(dx)))
             == x.frobenius().frobenius()
         )
 
     def test_powx_matches_pow(self):
-        from eth_consensus_specs_tpu.crypto.fields import BLS_X, R
+        from eth_consensus_specs_tpu.crypto.fields import BLS_X
 
         # powx assumes the cyclotomic subgroup (inverse == conjugate):
         # use a pairing-like element g^((p^6-1)(p^2+1)) to land there
         g = rand_fq12()
         m = g.conjugate() * g.inv()
         m = m.frobenius().frobenius() * m
-        dm = tw.fq12_to_limbs(m)
-        got = tw.limbs_to_fq12(np.asarray(tw.fq12_powx(dm)))
+        dm = lf(tw.fq12_to_limbs(m), val=P - 1)
+        got = tw.limbs_to_fq12(out(tw.fq12_powx(dm)))
         assert got == m.pow(BLS_X)
 
     def test_is_one(self):
         one = tw.fq12_one()
         assert bool(np.asarray(tw.fq12_is_one(one)))
         x = rand_fq12()
-        assert not bool(np.asarray(tw.fq12_is_one(tw.fq12_to_limbs(x))))
+        assert not bool(
+            np.asarray(tw.fq12_is_one(lf(tw.fq12_to_limbs(x), val=P - 1)))
+        )

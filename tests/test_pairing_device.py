@@ -1,0 +1,62 @@
+"""Device pairing vs the host oracle (crypto/pairing.py).
+
+The Miller value must match BIT-FOR-BIT (same line model and step order);
+the exact final exponentiation must reproduce the oracle GT element; and
+the fast membership check must agree with pairing_check on valid and
+tampered pairings (the bilinearity relation e(aG1, bG2) = e(abG1, G2))."""
+
+import numpy as np
+
+from eth_consensus_specs_tpu.crypto import pairing as host_pairing
+from eth_consensus_specs_tpu.crypto.curve import g1_generator, g2_generator, g1_infinity, g2_infinity
+from eth_consensus_specs_tpu.ops import pairing_device as dev
+
+
+def test_miller_value_bit_exact():
+    p = g1_generator().mul(7)
+    q = g2_generator().mul(11)
+    got = dev.miller_loop_device(p, q)
+    want = host_pairing.miller_loop(p, host_pairing.untwist(q))
+    assert got == want
+
+
+def test_pairing_gt_parity():
+    p = g1_generator().mul(5)
+    q = g2_generator().mul(9)
+    got = dev.pairing_device(p, q)
+    want = host_pairing.pairing(p, q)
+    assert got == want
+
+
+def test_pairing_check_bilinearity():
+    a, b = 23, 41
+    g1, g2 = g1_generator(), g2_generator()
+    # e(aG1, bG2) * e(-abG1, G2) == 1
+    good = [(g1.mul(a), g2.mul(b)), (-(g1.mul(a * b)), g2)]
+    assert dev.pairing_check_device(good)
+    bad = [(g1.mul(a), g2.mul(b)), (-(g1.mul(a * b + 1)), g2)]
+    assert not dev.pairing_check_device(bad)
+    # host oracle agrees
+    assert host_pairing.pairing_check(good)
+    assert not host_pairing.pairing_check(bad)
+
+
+def test_infinity_handling():
+    g1, g2 = g1_generator(), g2_generator()
+    # e(O, Q) = e(P, O) = 1 -> check passes with only-infinity pairs
+    assert dev.pairing_check_device([(g1_infinity(), g2), (g1, g2_infinity())])
+
+
+def test_signature_verify_shape():
+    """A real BLS signature relation through the device check."""
+    from eth_consensus_specs_tpu.crypto import signature as sig
+    from eth_consensus_specs_tpu.crypto.curve import g1_from_bytes, g2_from_bytes
+    from eth_consensus_specs_tpu.crypto.hash_to_curve import hash_to_g2
+
+    sk = 42
+    msg = b"\x07" * 32
+    pk = g1_from_bytes(sig.sk_to_pk(sk))
+    s = g2_from_bytes(sig.sign(sk, msg))
+    h = hash_to_g2(msg)
+    assert dev.pairing_check_device([(pk, h), (-g1_generator(), s)])
+    assert not dev.pairing_check_device([(pk, hash_to_g2(b"\x08" * 32)), (-g1_generator(), s)])
