@@ -253,6 +253,9 @@ def _run_section(section: str, on_cpu: bool, no_cache: bool = False) -> None:
         # env before the import, config after it: the axon sitecustomize
         # pins jax_platforms programmatically (config beats env)
         os.environ["JAX_PLATFORMS"] = "cpu"
+        # the device pairing's one-time compile dwarfs the CPU budget;
+        # fall back to the native host pairing for the bls section
+        os.environ["ETH_SPECS_TPU_NO_DEVICE_PAIRING"] = "1"
     import jax
 
     if on_cpu:
