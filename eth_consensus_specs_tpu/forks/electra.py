@@ -543,10 +543,18 @@ class ElectraSpec(DenebSpec):
         self._writeback_balances(state, res, include_eff=False)
         self._writeback_extra(state, res)  # inactivity scores
         self.process_eth1_data_reset(state)
-        self.process_pending_deposits(state)  # [New in Electra:EIP7251]
-        self.process_pending_consolidations(state)  # [New in Electra:EIP7251]
+        self._process_pending_queues(state)
         self.process_effective_balance_updates(state)  # [Modified in Electra:EIP7251]
         self._process_epoch_resets(state)
+
+    def _process_pending_queues(self, state) -> None:
+        """The O(queue) host-side sub-transitions the spec interleaves
+        between the slashings sweep and the effective-balance hysteresis
+        (specs/electra/beacon-chain.md:943,1022). A hook so later forks
+        (gloas builder payments) extend the interleave in BOTH the
+        columnar and the object epoch identically."""
+        self.process_pending_deposits(state)  # [New in Electra:EIP7251]
+        self.process_pending_consolidations(state)  # [New in Electra:EIP7251]
 
     def process_epoch_object(self, state) -> None:
         self.process_justification_and_finalization(state)
@@ -555,8 +563,7 @@ class ElectraSpec(DenebSpec):
         self.process_registry_updates(state)  # [Modified in Electra:EIP7251]
         self.process_slashings(state)  # [Modified in Electra:EIP7251]
         self.process_eth1_data_reset(state)
-        self.process_pending_deposits(state)  # [New in Electra:EIP7251]
-        self.process_pending_consolidations(state)  # [New in Electra:EIP7251]
+        self._process_pending_queues(state)
         self.process_effective_balance_updates(state)  # [Modified in Electra:EIP7251]
         self._process_epoch_resets(state)
 

@@ -429,21 +429,16 @@ class GloasSpec(FuluSpec):
 
     # == epoch processing (:675-717) =======================================
 
-    def process_epoch(self, state) -> None:
-        self.process_justification_and_finalization(state)
-        self.process_inactivity_updates(state)
-        self.process_rewards_and_penalties(state)
-        self.process_registry_updates(state)
-        self.process_slashings(state)
-        self.process_eth1_data_reset(state)
-        self.process_pending_deposits(state)
-        self.process_pending_consolidations(state)
+    # process_epoch is INHERITED (fulu's columnar-by-default dispatch +
+    # lookahead shift); the gloas delta — builder payment settlement
+    # between the consolidation queue and the effective-balance
+    # hysteresis (:675-717) — rides the electra queue-interleave hook so
+    # the fused device epoch IS the default for the newest fork too.
+
+    def _process_pending_queues(self, state) -> None:
+        super()._process_pending_queues(state)
         # [New in Gloas:EIP7732]
         self.process_builder_pending_payments(state)
-        self.process_effective_balance_updates(state)
-        self._process_epoch_resets(state)
-        # [New in Fulu:EIP7917]
-        self.process_proposer_lookahead(state)
 
     def process_builder_pending_payments(self, state) -> None:
         """Settle above-quorum payments from the previous epoch (:701-717)."""
