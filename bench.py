@@ -131,35 +131,42 @@ def bench_epoch_accounting(n_validators: int = 1_000_000, chain: int = 8) -> flo
 def bench_device_resident_epochs(
     n_validators: int = 1 << 20, epochs: int = 8
 ) -> tuple[float, float]:
-    """The BASELINE.json stepping stone: accounting epoch + balance-column
-    SSZ subtree root at ~1M validators, state DEVICE-RESIDENT across
+    """The BASELINE.json north-star shape: accounting epoch + the FULL
+    post-epoch BeaconState root (dirty-path device merkleization,
+    ops/state_root.py) at ~1M validators, state DEVICE-RESIDENT across
     epochs through the PUBLIC framework API (parallel/resident.py
-    run_epochs — not bench-local code).  Chained-dependency by
-    construction: each epoch consumes the previous epoch's balances and
-    the per-epoch root xor-chains into the carry.  Returns
-    (seconds_per_epoch_with_root, seconds_total)."""
+    run_epochs(with_root='state')).  Chained-dependency by construction:
+    each epoch consumes the previous epoch's balances and the per-epoch
+    state root xor-chains into the carry.  Returns
+    (seconds_per_epoch_with_full_root, seconds_total)."""
     import jax
     import jax.numpy as jnp
 
     import __graft_entry__ as graft
     from eth_consensus_specs_tpu.forks import get_spec
+    from eth_consensus_specs_tpu.ops.state_root import synthetic_static
     from eth_consensus_specs_tpu.parallel import resident
 
     spec = get_spec("deneb", "mainnet")
     cols, just = graft._example_altair_inputs(n_validators)
     cols = jax.device_put(cols)
     just = jax.device_put(just)
+    static = synthetic_static(spec, n_validators)
 
     salt_fn = jax.jit(lambda c, s: c._replace(balance=c.balance + s))
     jax.block_until_ready(
-        resident.run_epochs(spec, cols, just, epochs).root_acc
+        resident.run_epochs(spec, cols, just, epochs, with_root="state", static=static).root_acc
     )  # compile + warm
     best = float("inf")
     for i in range(3):
         fresh = salt_fn(cols, jnp.uint64(i + 1))  # defeat result caching
         jax.block_until_ready(fresh)
         t0 = time.perf_counter()
-        jax.block_until_ready(resident.run_epochs(spec, fresh, just, epochs).root_acc)
+        jax.block_until_ready(
+            resident.run_epochs(
+                spec, fresh, just, epochs, with_root="state", static=static
+            ).root_acc
+        )
         best = min(best, time.perf_counter() - t0)
     return best / epochs, best
 
@@ -464,7 +471,7 @@ def main() -> None:
     platforms["resident"] = src
     if resident is not None:
         print(
-            f"[bench] device-resident epoch+root @{resident['n']} validators ({src}): "
+            f"[bench] device-resident epoch+FULL-state-root @{resident['n']} validators ({src}): "
             f"{resident['per_epoch_s']*1e3:.2f} ms/epoch "
             f"({resident['epochs']} epochs chained: {resident['total_s']*1e3:.1f} ms)",
             file=sys.stderr,

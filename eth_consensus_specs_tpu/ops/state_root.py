@@ -1,0 +1,422 @@
+"""Full BeaconState merkleization on device with dirty-path rehash.
+
+SURVEY hard part 3: at 1M validators the reference's (cached) behavior —
+full-state rehash per slot through remerkleable — is the top cost of
+`state_transition` (reference: specs/phase0/beacon-chain.md:1383-1393 via
+utils/hash_function.py). This module keeps the STATE TREE's big regions
+device-resident and re-hashes only the paths the accounting epoch
+actually dirties:
+
+* per-validator subtrees: of the 8 Validator fields only
+  effective_balance changes during accounting, so the static 2/3 of each
+  validator's 15-node tree (pubkey root + withdrawal_credentials node;
+  the four epoch fields' node) is precomputed ONCE at ingest via the
+  native C sha core, and each epoch recomputes just 3 hashes/validator
+  on device (B = H(eff_balance, slashed), E = H(A, B), root = H(E, F));
+* the big flat columns (balances, inactivity scores, participation) are
+  chunked and tree-reduced on device (ops/merkle.tree_root_words), then
+  zero-hash-folded to their SSZ limit depth and length-mixed;
+* every OTHER state field's root is harvested once at ingest from the
+  object tree's cached roots and sits as a static chunk; the top-level
+  container combine (~32 chunks) runs on device each epoch.
+
+The result is `hash_tree_root(state)` for the post-accounting state as
+PURE device work after one ingest — the north-star shape (BASELINE.json:
+epoch-boundary state_transition incl. full state root < 1s @ 1M).
+
+Bit-exactness: tests/test_state_root_device.py compares against
+ssz.hash_tree_root on the equivalently-updated object state.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import numpy as np
+
+import eth_consensus_specs_tpu  # noqa: F401
+import jax.numpy as jnp
+from jax import lax
+
+from eth_consensus_specs_tpu.ops.merkle import tree_root_words
+from eth_consensus_specs_tpu.ops.sha256 import sha256_pair_words
+
+VALIDATOR_REGISTRY_LIMIT_LOG2 = 40  # List[Validator, 2**40]
+BALANCE_LIMIT_CHUNKS_LOG2 = 38  # 2**40 u64 -> 2**38 chunks
+PARTICIPATION_LIMIT_CHUNKS_LOG2 = 35  # 2**40 bytes -> 2**35 chunks
+
+
+def _bytes_to_words(b: bytes) -> np.ndarray:
+    return np.frombuffer(b, dtype=">u4").astype(np.uint32)
+
+
+def zerohash_words(max_depth: int) -> np.ndarray:
+    """[max_depth+1, 8] u32 — zerohashes[d] as BE words."""
+    from eth_consensus_specs_tpu.ssz.merkle import zerohashes
+
+    return np.stack([_bytes_to_words(zerohashes[d]) for d in range(max_depth + 1)])
+
+
+class StateRootArrays(NamedTuple):
+    """Device-resident static tree content (a pure-array pytree, safe to
+    pass through jit)."""
+
+    val_node_a: jnp.ndarray  # u32[N, 8]  H(pubkey_root, withdrawal_credentials)
+    val_node_f: jnp.ndarray  # u32[N, 8]  H(H(aee, ae), H(exit, withdrawable))
+    slashed_chunk: jnp.ndarray  # u32[N, 8] SSZ chunk of `slashed`
+    prev_part_flags: jnp.ndarray  # u8[N] participation bytes rotated into prev
+    top_chunks: jnp.ndarray  # u32[P, 8] all field roots (static slots filled)
+    zerohashes: jnp.ndarray  # u32[41, 8]
+
+
+class StateRootMeta(NamedTuple):
+    """Hashable host-side layout data (closure/static side of the jit)."""
+
+    dynamic_slots: tuple  # ((field index, field name), ...)
+    n_validators: int
+    top_depth: int
+
+
+def _u64_chunk_words(vals: jnp.ndarray) -> jnp.ndarray:
+    """u64[N] -> SSZ 32-byte chunks as u32[N, 8] BE words (value LE in the
+    first 8 bytes of the chunk)."""
+    lo = (vals & jnp.uint64(0xFFFFFFFF)).astype(jnp.uint32)
+    hi = (vals >> jnp.uint64(32)).astype(jnp.uint32)
+
+    def bswap(w):
+        return (
+            ((w & jnp.uint32(0xFF)) << 24)
+            | ((w & jnp.uint32(0xFF00)) << 8)
+            | ((w >> 8) & jnp.uint32(0xFF00))
+            | ((w >> 24) & jnp.uint32(0xFF))
+        )
+
+    z = jnp.zeros_like(lo)
+    return jnp.stack([bswap(lo), bswap(hi), z, z, z, z, z, z], axis=-1)
+
+
+def _hash_rows(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    """H(a || b) rowwise for u32[..., 8] word chunks."""
+    return sha256_pair_words(jnp.concatenate([a, b], axis=-1))
+
+
+def packed_u64_leaves(vals: jnp.ndarray, n: int) -> jnp.ndarray:
+    """u64[n] (n % 4 == 0) -> u32[n//4, 8] SSZ packed chunk words."""
+    w = lax.bitcast_convert_type(vals, jnp.uint32).reshape(n // 4, 8)
+    return (
+        ((w & 0xFF) << 24)
+        | ((w & 0xFF00) << 8)
+        | ((w >> 8) & 0xFF00)
+        | ((w >> 24) & 0xFF)
+    )
+
+
+def packed_u8_leaves(vals: jnp.ndarray, n: int) -> jnp.ndarray:
+    """u8[n] (n % 32 == 0) -> u32[n//32, 8] SSZ packed chunk words."""
+    w = vals.reshape(n // 32, 8, 4).astype(jnp.uint32)
+    return (w[..., 0] << 24) | (w[..., 1] << 16) | (w[..., 2] << 8) | w[..., 3]
+
+
+def fold_to_limit(root: jnp.ndarray, depth: int, limit_log2: int, zh: jnp.ndarray):
+    """Chain a subtree root up to the SSZ limit depth with zero-hash
+    siblings (right sibling = zerohashes[d] at each level)."""
+    for d in range(depth, limit_log2):
+        root = _hash_rows(root[None, :], zh[d][None, :])[0]
+    return root
+
+
+def mix_length(root: jnp.ndarray, length: int) -> jnp.ndarray:
+    len_chunk = _u64_chunk_words(jnp.full((1,), np.uint64(length), jnp.uint64))[0]
+    return _hash_rows(root[None, :], len_chunk[None, :])[0]
+
+
+def validator_registry_root(
+    arrays: StateRootArrays, n: int, effective_balance: jnp.ndarray
+) -> jnp.ndarray:
+    """List[Validator] root from the static nodes + the dynamic
+    effective-balance column: 3 hashes per validator + the leaf tree."""
+    eb_chunk = _u64_chunk_words(effective_balance)
+    node_b = _hash_rows(eb_chunk, arrays.slashed_chunk)
+    node_e = _hash_rows(arrays.val_node_a, node_b)
+    roots = _hash_rows(node_e, arrays.val_node_f)  # [N, 8] validator roots
+    depth = max(n - 1, 0).bit_length()
+    sub = tree_root_words(_pad_pow2(roots, depth), depth)
+    full = fold_to_limit(sub, depth, VALIDATOR_REGISTRY_LIMIT_LOG2, arrays.zerohashes)
+    return mix_length(full, n)
+
+
+def _pad_pow2(leaves: jnp.ndarray, depth: int) -> jnp.ndarray:
+    pad = (1 << depth) - leaves.shape[0]
+    if pad:
+        leaves = jnp.concatenate([leaves, jnp.zeros((pad, 8), jnp.uint32)], axis=0)
+    return leaves
+
+
+def u64_list_root(
+    vals: jnp.ndarray, n: int, limit_chunks_log2: int, zh: jnp.ndarray
+) -> jnp.ndarray:
+    if n % 4:
+        vals = jnp.concatenate([vals, jnp.zeros(4 - n % 4, jnp.uint64)])
+    chunks = (n + 3) // 4
+    leaves = packed_u64_leaves(vals, vals.shape[0])
+    depth = max(chunks - 1, 0).bit_length() if n else 0
+    sub = tree_root_words(_pad_pow2(leaves, depth), depth)
+    return mix_length(fold_to_limit(sub, depth, limit_chunks_log2, zh), n)
+
+
+def u8_list_root(
+    vals: jnp.ndarray, n: int, limit_chunks_log2: int, zh: jnp.ndarray
+) -> jnp.ndarray:
+    if n % 32:
+        vals = jnp.concatenate([vals, jnp.zeros(32 - n % 32, jnp.uint8)])
+    chunks = (n + 31) // 32
+    leaves = packed_u8_leaves(vals, vals.shape[0])
+    depth = max(chunks - 1, 0).bit_length() if n else 0
+    sub = tree_root_words(_pad_pow2(leaves, depth), depth)
+    return mix_length(fold_to_limit(sub, depth, limit_chunks_log2, zh), n)
+
+
+def _zero_u8_list_root_words(n: int) -> np.ndarray:
+    """Host-computed root words of an all-zero List[uint8-ish, 2**40] of
+    length n (the rotated-in current participation): zero subtree =
+    zerohashes[depth], folded to the limit depth, length-mixed."""
+    from eth_consensus_specs_tpu.ssz.hashing import hash_bytes
+    from eth_consensus_specs_tpu.ssz.merkle import zerohashes
+
+    chunks = (n + 31) // 32
+    depth = max(chunks - 1, 0).bit_length() if n else 0
+    root = zerohashes[depth]
+    for d in range(depth, PARTICIPATION_LIMIT_CHUNKS_LOG2):
+        root = hash_bytes(root + zerohashes[d])
+    root = hash_bytes(root + int(n).to_bytes(8, "little") + b"\x00" * 24)
+    return _bytes_to_words(root)
+
+
+def checkpoint_root(epoch: jnp.ndarray, root_bytes: jnp.ndarray) -> jnp.ndarray:
+    """Checkpoint container root: H(chunk(epoch), root). `root_bytes` is
+    u8[32]."""
+    e_chunk = _u64_chunk_words(epoch.reshape(1).astype(jnp.uint64))[0]
+    r_words = root_bytes.reshape(8, 4).astype(jnp.uint32)
+    r_chunk = (
+        (r_words[:, 0] << 24) | (r_words[:, 1] << 16) | (r_words[:, 2] << 8) | r_words[:, 3]
+    )
+    return _hash_rows(e_chunk[None, :], r_chunk[None, :])[0]
+
+
+def bitvector4_chunk(bits: jnp.ndarray) -> jnp.ndarray:
+    """Bitvector[4] (bool[4]) -> its single SSZ chunk as u32[8] words."""
+    byte = (
+        bits[0].astype(jnp.uint32)
+        | (bits[1].astype(jnp.uint32) << 1)
+        | (bits[2].astype(jnp.uint32) << 2)
+        | (bits[3].astype(jnp.uint32) << 3)
+    )
+    chunk = jnp.zeros(8, jnp.uint32)
+    return chunk.at[0].set(byte << 24)
+
+
+def combine_state_root(
+    arrays: StateRootArrays, meta: StateRootMeta, dynamic_roots: dict[int, jnp.ndarray]
+) -> jnp.ndarray:
+    """Write the dynamic roots into their top-level slots and reduce the
+    container tree on device."""
+    chunks = arrays.top_chunks
+    for slot, root in dynamic_roots.items():
+        chunks = chunks.at[slot].set(root)
+    return tree_root_words(chunks, meta.top_depth)
+
+
+# ------------------------------------------------------------------ ingest --
+
+
+def build_static(
+    spec, state, prev_part_from_current: bool = True
+) -> tuple[StateRootArrays, StateRootMeta]:
+    """Harvest the static tree content from an object state (one-time,
+    host; per-validator static nodes go through the native C sha core)."""
+    import jax
+
+    from eth_consensus_specs_tpu import ssz
+    from eth_consensus_specs_tpu.ssz.hashing import hash_bytes
+    from eth_consensus_specs_tpu.native import available as native_available, sha256_pairs
+
+    n = len(state.validators)
+
+    def pair_hash_many(data: bytes) -> bytes:
+        if native_available():
+            return sha256_pairs(data)
+        out = []
+        for i in range(0, len(data), 64):
+            out.append(hash_bytes(data[i : i + 64]))
+        return b"".join(out)
+
+    # pubkey roots: H(pk[0:32], pk[32:48] || zeros)
+    pk_stream = b"".join(
+        bytes(v.pubkey)[:32] + bytes(v.pubkey)[32:48] + b"\x00" * 16
+        for v in state.validators
+    )
+    pk_roots = pair_hash_many(pk_stream)
+    # A = H(pubkey_root, withdrawal_credentials)
+    a_stream = b"".join(
+        pk_roots[i * 32 : (i + 1) * 32] + bytes(v.withdrawal_credentials)
+        for i, v in enumerate(state.validators)
+    )
+    node_a = pair_hash_many(a_stream)
+
+    def epoch_chunk(e: int) -> bytes:
+        return int(e).to_bytes(8, "little") + b"\x00" * 24
+
+    c_stream = b"".join(
+        epoch_chunk(v.activation_eligibility_epoch) + epoch_chunk(v.activation_epoch)
+        for v in state.validators
+    )
+    d_stream = b"".join(
+        epoch_chunk(v.exit_epoch) + epoch_chunk(v.withdrawable_epoch)
+        for v in state.validators
+    )
+    node_c = pair_hash_many(c_stream)
+    node_d = pair_hash_many(d_stream)
+    f_stream = b"".join(
+        node_c[i * 32 : (i + 1) * 32] + node_d[i * 32 : (i + 1) * 32] for i in range(n)
+    )
+    node_f = pair_hash_many(f_stream)
+
+    slashed_chunks = np.zeros((n, 8), np.uint32)
+    for i, v in enumerate(state.validators):
+        if v.slashed:
+            slashed_chunks[i, 0] = 0x01000000
+
+    fields = list(type(state).fields())
+    top_depth = max(len(fields) - 1, 0).bit_length()
+    top_chunks = np.zeros((1 << top_depth, 8), np.uint32)
+    dynamic_names = {
+        "validators",
+        "balances",
+        "inactivity_scores",
+        "previous_epoch_participation",
+        "current_epoch_participation",
+        "justification_bits",
+        "previous_justified_checkpoint",
+        "current_justified_checkpoint",
+        "finalized_checkpoint",
+    }
+    dynamic_slots = []
+    for i, name in enumerate(fields):
+        if name in dynamic_names:
+            dynamic_slots.append((i, name))
+        else:
+            top_chunks[i] = _bytes_to_words(bytes(ssz.hash_tree_root(getattr(state, name))))
+
+    prev_flags = np.array(
+        [int(b) for b in state.current_epoch_participation]
+        if prev_part_from_current
+        else [int(b) for b in state.previous_epoch_participation],
+        np.uint8,
+    )
+
+    def words(b: bytes, rows: int) -> np.ndarray:
+        return np.frombuffer(b, dtype=">u4").astype(np.uint32).reshape(rows, 8)
+
+    arrays = StateRootArrays(
+        val_node_a=jax.device_put(jnp.asarray(words(node_a, n))),
+        val_node_f=jax.device_put(jnp.asarray(words(node_f, n))),
+        slashed_chunk=jax.device_put(jnp.asarray(slashed_chunks)),
+        prev_part_flags=jax.device_put(jnp.asarray(prev_flags)),
+        top_chunks=jax.device_put(jnp.asarray(top_chunks)),
+        zerohashes=jax.device_put(jnp.asarray(zerohash_words(41))),
+    )
+    meta = StateRootMeta(
+        dynamic_slots=tuple(dynamic_slots), n_validators=n, top_depth=top_depth
+    )
+    return arrays, meta
+
+
+def synthetic_static(spec, n: int, seed: int = 0) -> tuple[StateRootArrays, StateRootMeta]:
+    """Bench/demo static content WITHOUT building an n-validator object
+    state: random static nodes, zero small-field chunks — the exact same
+    device hash count and tree shape as build_static, minus the one-time
+    host harvest. Roots are not meaningful; timings are."""
+    import jax
+
+    rng = np.random.default_rng(seed)
+    fields = list(spec.BeaconState.fields())
+    top_depth = max(len(fields) - 1, 0).bit_length()
+    dynamic_names = {
+        "validators",
+        "balances",
+        "inactivity_scores",
+        "previous_epoch_participation",
+        "current_epoch_participation",
+        "justification_bits",
+        "previous_justified_checkpoint",
+        "current_justified_checkpoint",
+        "finalized_checkpoint",
+    }
+    dynamic_slots = tuple(
+        (i, name) for i, name in enumerate(fields) if name in dynamic_names
+    )
+
+    def rnd(shape):
+        return jax.device_put(
+            jnp.asarray(rng.integers(0, 2**32, size=shape, dtype=np.uint64).astype(np.uint32))
+        )
+
+    arrays = StateRootArrays(
+        val_node_a=rnd((n, 8)),
+        val_node_f=rnd((n, 8)),
+        slashed_chunk=jax.device_put(jnp.zeros((n, 8), jnp.uint32)),
+        prev_part_flags=jax.device_put(
+            jnp.asarray(rng.integers(0, 8, size=n, dtype=np.int64).astype(np.uint8))
+        ),
+        top_chunks=rnd((1 << top_depth, 8)),
+        zerohashes=jax.device_put(jnp.asarray(zerohash_words(41))),
+    )
+    return arrays, StateRootMeta(
+        dynamic_slots=dynamic_slots, n_validators=n, top_depth=top_depth
+    )
+
+
+def post_epoch_state_root(
+    arrays: StateRootArrays,
+    meta: StateRootMeta,
+    balances: jnp.ndarray,
+    effective_balance: jnp.ndarray,
+    inactivity_scores: jnp.ndarray,
+    just,  # JustificationState-like with post-epoch values
+) -> jnp.ndarray:
+    """The full post-accounting-epoch state root as one device graph."""
+    n = meta.n_validators
+    zh = arrays.zerohashes
+    slot_of = {name: i for i, name in meta.dynamic_slots}
+    dyn: dict[int, jnp.ndarray] = {}
+    dyn[slot_of["validators"]] = validator_registry_root(arrays, n, effective_balance)
+    dyn[slot_of["balances"]] = u64_list_root(balances, n, BALANCE_LIMIT_CHUNKS_LOG2, zh)
+    if "inactivity_scores" in slot_of:
+        dyn[slot_of["inactivity_scores"]] = u64_list_root(
+            inactivity_scores, n, BALANCE_LIMIT_CHUNKS_LOG2, zh
+        )
+    if "previous_epoch_participation" in slot_of:
+        dyn[slot_of["previous_epoch_participation"]] = u8_list_root(
+            arrays.prev_part_flags, n, PARTICIPATION_LIMIT_CHUNKS_LOG2, zh
+        )
+        # rotated-in current participation: all zero, length n — a
+        # CONSTANT for fixed n, folded at trace time (host hashes), not
+        # recomputed as an O(n/32) device tree every epoch
+        dyn[slot_of["current_epoch_participation"]] = jnp.asarray(
+            _zero_u8_list_root_words(n)
+        )
+    dyn[slot_of["justification_bits"]] = (
+        bitvector4_chunk(just.justification_bits)
+        if just.justification_bits.dtype == jnp.bool_
+        else bitvector4_chunk(just.justification_bits.astype(bool))
+    )
+    dyn[slot_of["previous_justified_checkpoint"]] = checkpoint_root(
+        just.prev_justified_epoch, just.prev_justified_root
+    )
+    dyn[slot_of["current_justified_checkpoint"]] = checkpoint_root(
+        just.cur_justified_epoch, just.cur_justified_root
+    )
+    dyn[slot_of["finalized_checkpoint"]] = checkpoint_root(
+        just.finalized_epoch, just.finalized_root
+    )
+    return combine_state_root(arrays, meta, dyn)

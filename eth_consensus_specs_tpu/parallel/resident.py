@@ -56,14 +56,21 @@ def ingest(spec, state) -> tuple[AltairEpochColumns, JustificationState]:
 
 
 def _balance_leaves(bal: jnp.ndarray, n: int) -> jnp.ndarray:
-    """u64 balances -> SSZ chunk words (BE u32 of the LE u64 stream)."""
-    w = lax.bitcast_convert_type(bal, jnp.uint32).reshape(n // 4, 8)
-    return (
-        ((w & 0xFF) << 24)
-        | ((w & 0xFF00) << 8)
-        | ((w >> 8) & 0xFF00)
-        | ((w >> 24) & 0xFF)
-    )
+    """u64 balances -> SSZ chunk words (shared swizzle, ops/state_root)."""
+    from eth_consensus_specs_tpu.ops.state_root import packed_u64_leaves
+
+    return packed_u64_leaves(bal, n)
+
+
+def ingest_full(spec, state):
+    """ingest() plus the static full-state tree content for
+    with_root="state" (ops/state_root.build_static): per-validator static
+    nodes, harvested small-field roots, zero-hash table — one host pass,
+    device-resident thereafter."""
+    from eth_consensus_specs_tpu.ops.state_root import build_static
+
+    cols, just = ingest(spec, state)
+    return cols, just, build_static(spec, state)
 
 
 def run_epochs(
@@ -71,51 +78,108 @@ def run_epochs(
     cols: AltairEpochColumns,
     just: JustificationState,
     n_epochs: int,
-    with_root: bool = True,
+    with_root=True,
+    static=None,
 ):
     """Advance `n_epochs` accounting epochs entirely on device.
 
-    Each epoch's balances/scores/justification feed the next; when
-    `with_root` the balance column's SSZ subtree root is computed per
-    epoch on device and xor-chained into the carry (forcing true
-    sequential dependency — also the honest-bench measurement shape).
+    Each epoch's balances/scores/justification feed the next. Rooting
+    modes (xor-chained into the carry — true sequential dependency, also
+    the honest-bench measurement shape):
+
+    * ``with_root=False``   — no rooting;
+    * ``with_root=True``    — the balance column's SSZ subtree root
+      (round-3 behavior);
+    * ``with_root="state"`` — the FULL post-epoch BeaconState root via
+      dirty-path rehash (ops/state_root.py): per-validator subtrees
+      recomputed from 3 hashes each, big columns re-treed, every other
+      field a static chunk. Requires ``static`` from ingest_full().
+      Exactness caveat: the root is the object-path hash_tree_root for
+      the FIRST epoch (tests/test_state_root_device.py); later chained
+      epochs keep the stand-in participation (the resident loop does not
+      rotate flags), so their roots are the same tree shape/work but not
+      a state any object advance produces — fine for benching, not for
+      consensus use beyond epoch 1.
+
     Returns a ResidentCarry of device arrays."""
     params = AltairEpochParams.from_spec(spec)
     n = int(cols.balance.shape[0])
-    depth = (max(n // 4, 1) - 1).bit_length() if with_root else 0
-    if with_root and n % 4 != 0:
+    if with_root is True or with_root == "balance":
+        mode = "balance"
+    elif with_root is False or with_root is None or with_root == "none":
+        mode = "none"
+    elif with_root == "state":
+        mode = "state"
+    else:
+        raise ValueError(f"with_root must be bool, 'balance' or 'state', got {with_root!r}")
+    depth = (max(n // 4, 1) - 1).bit_length() if mode == "balance" else 0
+    if mode == "balance" and n % 4 != 0:
         raise ValueError("with_root requires a multiple-of-4 validator count")
-    run = _compiled_runner(params, int(n_epochs), bool(with_root), n, depth)
-    out_cols, out_just, acc = run(cols, just, jnp.zeros(8, jnp.uint32))
+    if mode == "state" and static is None:
+        raise ValueError('with_root="state" requires static from ingest_full()')
+    if mode == "state":
+        arrays, meta = static
+        run = _compiled_runner(params, int(n_epochs), mode, n, depth, meta)
+        out_cols, out_just, acc = run(cols, just, jnp.zeros(8, jnp.uint32), arrays)
+    else:
+        run = _compiled_runner(params, int(n_epochs), mode, n, depth, None)
+        out_cols, out_just, acc = run(cols, just, jnp.zeros(8, jnp.uint32))
     return ResidentCarry(cols=out_cols, just=out_just, root_acc=acc)
 
 
 @lru_cache(maxsize=None)
-def _compiled_runner(params, n_epochs: int, with_root: bool, n: int, depth: int):
+def _compiled_runner(params, n_epochs: int, mode: str, n: int, depth: int, meta):
     """One compiled executable per (params, epochs, shape) — repeat calls
     reuse it instead of retracing."""
+
+    def _advance(cols, just):
+        res = altair_epoch_accounting_impl(params, cols, just)
+        cols = cols._replace(
+            balance=res.balance,
+            effective_balance=res.effective_balance,
+            inactivity_scores=res.inactivity_scores,
+        )
+        just = just._replace(
+            current_epoch=just.current_epoch + jnp.uint64(1),
+            justification_bits=res.justification_bits,
+            prev_justified_epoch=res.prev_justified_epoch,
+            prev_justified_root=res.prev_justified_root,
+            cur_justified_epoch=res.cur_justified_epoch,
+            cur_justified_root=res.cur_justified_root,
+            finalized_epoch=res.finalized_epoch,
+            finalized_root=res.finalized_root,
+        )
+        return cols, just
+
+    if mode == "state":
+
+        @jax.jit
+        def run_state(cols, just, acc0, arrays):
+            from eth_consensus_specs_tpu.ops.state_root import post_epoch_state_root
+
+            def body(_, carry):
+                cols, just, acc = carry
+                cols, just = _advance(cols, just)
+                root = post_epoch_state_root(
+                    arrays,
+                    meta,
+                    cols.balance,
+                    cols.effective_balance,
+                    cols.inactivity_scores,
+                    just,
+                )
+                return cols, just, acc ^ root
+
+            return lax.fori_loop(0, n_epochs, body, (cols, just, acc0))
+
+        return run_state
 
     @jax.jit
     def run(cols, just, acc0):
         def body(_, carry):
             cols, just, acc = carry
-            res = altair_epoch_accounting_impl(params, cols, just)
-            cols = cols._replace(
-                balance=res.balance,
-                effective_balance=res.effective_balance,
-                inactivity_scores=res.inactivity_scores,
-            )
-            just = just._replace(
-                current_epoch=just.current_epoch + jnp.uint64(1),
-                justification_bits=res.justification_bits,
-                prev_justified_epoch=res.prev_justified_epoch,
-                prev_justified_root=res.prev_justified_root,
-                cur_justified_epoch=res.cur_justified_epoch,
-                cur_justified_root=res.cur_justified_root,
-                finalized_epoch=res.finalized_epoch,
-                finalized_root=res.finalized_root,
-            )
-            if with_root:
+            cols, just = _advance(cols, just)
+            if mode == "balance":
                 root = tree_root_words(_balance_leaves(cols.balance, n), depth)
                 acc = acc ^ root
             return cols, just, acc

@@ -1,0 +1,74 @@
+"""Full-state device merkleization (ops/state_root.py via
+parallel/resident.py) vs ssz.hash_tree_root on the equivalently-updated
+object state — SURVEY hard part 3's bit-exactness gate."""
+
+import numpy as np
+
+from eth_consensus_specs_tpu import ssz
+from eth_consensus_specs_tpu.parallel import resident
+from eth_consensus_specs_tpu.test_infra.attestations import next_epoch_with_attestations
+from eth_consensus_specs_tpu.test_infra.context import spec_state_test, with_phases
+
+
+def _root_bytes(acc) -> bytes:
+    return np.asarray(acc).astype(">u4", order="C").view(np.uint8).tobytes()
+
+
+def _to_boundary(spec, state):
+    from eth_consensus_specs_tpu.test_infra.state import next_slots
+
+    boundary = int(state.slot) + (
+        spec.SLOTS_PER_EPOCH - int(state.slot) % spec.SLOTS_PER_EPOCH
+    )
+    if int(state.slot) < boundary - 1:
+        next_slots(spec, state, boundary - 1 - int(state.slot))
+
+
+def _device_vs_object(spec, state):
+    _to_boundary(spec, state)
+    cols, just, static = resident.ingest_full(spec, state)
+    carry = resident.run_epochs(spec, cols, just, 1, with_root="state", static=static)
+    device_root = _root_bytes(carry.root_acc)
+
+    expected = state.copy()
+    old_current = list(expected.current_epoch_participation)
+    resident.writeback(spec, expected, carry)
+    # the accounting epoch's participation rotation
+    part_t = type(expected.current_epoch_participation)
+    expected.previous_epoch_participation = part_t(old_current)
+    expected.current_epoch_participation = part_t([0] * len(old_current))
+    assert bytes(ssz.hash_tree_root(expected)) == device_root
+
+
+@with_phases(["altair", "deneb"])
+@spec_state_test
+def test_state_root_genesis_epoch(spec, state):
+    _device_vs_object(spec, state)
+
+
+@with_phases(["altair", "deneb"])
+@spec_state_test
+def test_state_root_after_participation(spec, state):
+    next_epoch_with_attestations(spec, state, fill_cur_epoch=False, fill_prev_epoch=True)
+    # dirty some balances/validators so every dynamic subtree moves
+    for i in range(0, len(state.validators), 3):
+        state.balances[i] = int(state.balances[i]) - 12345
+    state.validators[2].slashed = True
+    _device_vs_object(spec, state)
+
+
+@with_phases(["altair"])
+@spec_state_test
+def test_state_root_multi_epoch_chain(spec, state):
+    """Three chained epochs: the xor-accumulated roots must equal the
+    xor of three independently computed object roots is impractical to
+    reconstruct midway, so instead run 1 epoch twice from the same state
+    and check determinism + non-triviality."""
+    _to_boundary(spec, state)
+    cols, just, static = resident.ingest_full(spec, state)
+    c1 = resident.run_epochs(spec, cols, just, 1, with_root="state", static=static)
+    c2 = resident.run_epochs(spec, cols, just, 1, with_root="state", static=static)
+    assert _root_bytes(c1.root_acc) == _root_bytes(c2.root_acc)
+    assert _root_bytes(c1.root_acc) != b"\x00" * 32
+    c3 = resident.run_epochs(spec, cols, just, 3, with_root="state", static=static)
+    assert _root_bytes(c3.root_acc) != _root_bytes(c1.root_acc)
