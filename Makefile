@@ -6,8 +6,9 @@ PYTHON ?= python
 
 help:
 	@echo "install    - editable install with test extras"
-	@echo "test       - full pytest suite (CPU, 8 virtual devices)"
-	@echo "test-fast  - suite minus the slow fork-choice scenarios"
+	@echo "test       - FAST lane: suite minus @slow (CPU, 8 virtual devices)"
+	@echo "test-full  - everything incl. @slow (the nightly lane)"
+	@echo "test-slow  - only the @slow modules"
 	@echo "lint       - ruff check (if installed)"
 	@echo "reftests   - emit test vectors to ./test_vectors"
 	@echo "bench      - run the driver benchmark"
@@ -17,11 +18,21 @@ help:
 install:
 	$(PYTHON) -m pip install -e .[test]
 
+# The default lane mirrors the reference's split: `make test` is the
+# developer loop (reference Makefile:227-249), the heavy device-compile /
+# pure-python-crypto / mainnet differential modules run nightly
+# (reference .github/workflows/nightly-tests.yml).
 test:
+	$(PYTHON) -m pytest tests/ -q -m "not slow" -p xdist -n auto
+
+test-full:
 	$(PYTHON) -m pytest tests/ -q -p xdist -n auto
 
+test-slow:
+	$(PYTHON) -m pytest tests/ -q -m slow -p xdist -n auto
+
 test-serial:
-	$(PYTHON) -m pytest tests/ -q
+	$(PYTHON) -m pytest tests/ -q -m "not slow"
 
 parity:
 	$(PYTHON) -m pytest tests/parity/ -q
@@ -36,8 +47,7 @@ mainnet-smoke:
 	  -k "empty_block or slots_1 or invalid_state_root or one_basic or proposer_slashing_basic or deposit_top_up" \
 	  -q
 
-test-fast:
-	$(PYTHON) -m pytest tests/ -q -m "not slow" --ignore=tests/phase0/test_fork_choice.py
+test-fast: test
 
 lint:
 	-$(PYTHON) -m ruff check eth_consensus_specs_tpu/ tests/

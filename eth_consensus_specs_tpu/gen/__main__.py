@@ -17,6 +17,12 @@ def main() -> None:
     parser.add_argument("--forks", nargs="*", default=None)
     parser.add_argument("--runners", nargs="*", default=None)
     parser.add_argument("--verbose", "-v", action="store_true")
+    parser.add_argument(
+        "--workers",
+        default=None,
+        help='process-pool size or "auto" (reference: pathos pool, '
+        "gen_base/gen_runner.py:288-302); default sequential",
+    )
     args = parser.parse_args()
 
     runners = tuple(args.runners) if args.runners else None
@@ -33,7 +39,10 @@ def main() -> None:
     if args.forks:
         runner_cases = [c for c in runner_cases if c.fork in args.forks]
     cases = list(cases) + runner_cases
-    stats = run_generator(cases, args.output, verbose=args.verbose)
+    workers = args.workers
+    if workers is not None and workers != "auto":
+        workers = int(workers)
+    stats = run_generator(cases, args.output, verbose=args.verbose, workers=workers)
     print(json.dumps({"cases": len(cases), **stats}))
 
 

@@ -24,8 +24,10 @@ class TestCase:
     bls_setting: int = 0
 
 
-# module-basename -> (runner, handler) taxonomy; anything unmapped lands
-# under runner="tests" with the module name as handler
+# FALLBACK module-basename -> (runner, handler) taxonomy. The primary
+# source of coordinates is a test's @manifest vector location
+# (test_infra/manifest.py, mirrored from reference tests/infra/
+# manifest.py:7-73); this map only fills what a test leaves unpinned.
 _RUNNER_MAP = {
     "test_process_attestation": ("operations", "attestation"),
     "test_withdrawals": ("operations", "withdrawals"),
@@ -38,7 +40,19 @@ _RUNNER_MAP = {
     "test_sanity": ("sanity", "blocks"),
     "test_sync_aggregate": ("operations", "sync_aggregate"),
     "test_fork_choice": ("fork_choice", "on_block"),
+    "test_fork_choice_gloas": ("fork_choice", "on_block"),
+    "test_finality": ("finality", "finality"),
+    "test_genesis": ("genesis", "initialization"),
+    "test_rewards": ("rewards", "basic"),
+    "test_reward_components": ("rewards", "components"),
+    "test_light_client": ("light_client", "sync"),
+    "test_random_blocks": ("random", "random"),
 }
+
+# basename-prefix fallbacks applied before the catch-all "tests" runner
+_RUNNER_PREFIX_MAP = [
+    ("test_upgrade_to_", ("transition", "core")),
+]
 
 
 def _iter_test_modules(package_name: str = "tests"):
@@ -69,7 +83,13 @@ def discover_test_cases(
         parts = module.__name__.split(".")
         basename = parts[-1]
         module_fork = parts[-2] if len(parts) >= 2 and parts[-2] in all_forks else None
-        runner, handler = _RUNNER_MAP.get(basename, ("tests", basename.removeprefix("test_")))
+        mapped = _RUNNER_MAP.get(basename)
+        if mapped is None:
+            for prefix, target in _RUNNER_PREFIX_MAP:
+                if basename.startswith(prefix):
+                    mapped = target
+                    break
+        runner, handler = mapped or ("tests", basename.removeprefix("test_"))
         if runners is not None and runner not in runners:
             continue
         for name, fn in inspect.getmembers(module, callable):
@@ -78,15 +98,29 @@ def discover_test_cases(
             phases = getattr(fn, "phases", None)
             if phases is None:
                 continue  # not a fork-matrixed spec test
+            # explicit @manifest coordinates win over the module-map
+            # fallback (the seam the reference's Manifest provides)
+            from eth_consensus_specs_tpu.test_infra.manifest import vector_location_of
+
+            loc = vector_location_of(fn)
             for preset in presets:
+                if loc.preset is not None and preset != loc.preset:
+                    continue
                 for fork in phases:
                     if fork not in all_forks:
                         continue
                     if forks is not None and fork not in forks:
                         continue
-                    case_name = name.removeprefix("test_")
-                    case_handler = handler
-                    if runner == "sanity" and case_name.startswith("slots"):
+                    if loc.fork is not None and fork != loc.fork:
+                        continue
+                    case_name = loc.case or name.removeprefix("test_")
+                    case_handler = loc.handler or handler
+                    case_runner = loc.runner or runner
+                    if (
+                        loc.handler is None
+                        and case_runner == "sanity"
+                        and case_name.startswith("slots")
+                    ):
                         # slot-advance cases have their own format
                         # (reference tests/formats/sanity/slots.md)
                         case_handler = "slots"
@@ -95,9 +129,9 @@ def discover_test_cases(
                     case = TestCase(
                         preset=preset,
                         fork=fork,
-                        runner=runner,
+                        runner=case_runner,
                         handler=case_handler,
-                        suite="pyspec_tests",
+                        suite=loc.suite or "pyspec_tests",
                         case_name=case_name,
                         case_fn=(
                             lambda fn=fn, fork=fork, preset=preset: fn(
@@ -106,7 +140,7 @@ def discover_test_cases(
                         ),
                         bls_setting=bls_setting,
                     )
-                    key = (preset, fork, runner, case_handler, case_name)
+                    key = (preset, fork, case_runner, case_handler, case_name)
                     prev = selected.get(key)
                     if prev is not None:
                         prev_fork_seg = prev[0]

@@ -1,11 +1,21 @@
 """Vector-generation executor (reference analogue:
-gen_base/gen_runner.py:113-320 — ours is sequential; the reference's
-pathos process pool parallelizes python-process-bound crypto that is not
-this framework's bottleneck)."""
+gen_base/gen_runner.py:113-320).
+
+Two modes:
+
+* sequential (default) — simple, in-process;
+* process pool (``workers=N`` or ``"auto"``) — mirrors the reference's
+  pathos pool with ``maxtasksperchild`` recycling, live progress and
+  per-worker RSS telemetry (reference gen_runner.py:183-302). Cases are
+  addressed by coordinate key and re-discovered inside each worker (the
+  case closures themselves don't pickle, exactly why the reference uses
+  a dill-based pool; re-discovery is one import pass per worker)."""
 
 from __future__ import annotations
 
+import os
 import sys
+import time
 import traceback
 
 from .dumper import Dumper
@@ -52,8 +62,19 @@ def _snapshot(value):
     return value
 
 
-def run_generator(cases, output_dir: str, verbose: bool = False) -> dict:
-    """Execute all cases; returns {written, skipped, failed} counts."""
+def run_generator(
+    cases, output_dir: str, verbose: bool = False, workers: int | str | None = None
+) -> dict:
+    """Execute all cases; returns {written, skipped, failed} counts.
+
+    ``workers``: None/0/1 = sequential; an int or "auto" = process pool."""
+    if workers in (None, 0, 1):
+        return _run_sequential(cases, output_dir, verbose)
+    n_workers = os.cpu_count() - 1 if workers == "auto" else int(workers)
+    return _run_pool(cases, output_dir, verbose, max(n_workers, 1))
+
+
+def _run_sequential(cases, output_dir: str, verbose: bool) -> dict:
     dumper = Dumper(output_dir)
     written = skipped = failed = 0
     for case in cases:
@@ -73,3 +94,82 @@ def run_generator(cases, output_dir: str, verbose: bool = False) -> dict:
             if verbose:
                 print(f"[gen] wrote {out}", file=sys.stderr)
     return {"written": written, "skipped": skipped, "failed": failed}
+
+
+def case_key(case: TestCase) -> tuple:
+    return (case.preset, case.fork, case.runner, case.handler, case.case_name)
+
+
+_WORKER_CASES: dict | None = None
+_WORKER_DUMPER: Dumper | None = None
+
+
+def _pool_init(output_dir: str, presets: tuple, forks: tuple | None, package: str):
+    """Worker initializer: rebuild the case index once per worker
+    process (closures don't pickle; coordinates do)."""
+    global _WORKER_CASES, _WORKER_DUMPER
+    from .gen_from_tests import discover_test_cases
+    from .runners import get_runner_cases
+
+    found = discover_test_cases(
+        presets=presets, forks=list(forks) if forks else None, package=package
+    )
+    found += get_runner_cases(presets=presets)
+    _WORKER_CASES = {case_key(c): c for c in found}
+    _WORKER_DUMPER = Dumper(output_dir)
+
+
+def _pool_exec(key: tuple) -> tuple:
+    """Run one case in the worker; returns (key, status, rss_mb)."""
+    import resource
+
+    case = _WORKER_CASES.get(key)
+    rss = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss // 1024
+    if case is None:
+        return key, "failed", rss
+    try:
+        out = execute_case(case, _WORKER_DUMPER)
+    except Exception:
+        traceback.print_exc()
+        return key, "failed", rss
+    return key, ("written" if out is not None else "skipped"), rss
+
+
+def _run_pool(cases, output_dir: str, verbose: bool, n_workers: int) -> dict:
+    """Process-parallel execution with progress + RSS telemetry. Workers
+    recycle after 100 cases (the reference's maxtasksperchild leak guard,
+    gen_runner.py:288)."""
+    import multiprocessing as mp
+
+    presets = tuple(sorted({c.preset for c in cases}))
+    forks = tuple(sorted({c.fork for c in cases}))
+    ctx = mp.get_context("fork")
+    counts = {"written": 0, "skipped": 0, "failed": 0}
+    keys = [case_key(c) for c in cases]
+    t0 = time.monotonic()
+    last_print = 0.0
+    max_rss = 0
+    with ctx.Pool(
+        processes=n_workers,
+        initializer=_pool_init,
+        initargs=(output_dir, presets, forks, "tests"),
+        maxtasksperchild=100,
+    ) as pool:
+        for i, (key, status, rss) in enumerate(
+            pool.imap_unordered(_pool_exec, keys, chunksize=4), start=1
+        ):
+            counts[status] += 1
+            max_rss = max(max_rss, rss)
+            if status == "failed" and verbose:
+                print(f"[gen] FAILED {'/'.join(map(str, key))}", file=sys.stderr)
+            now = time.monotonic()
+            if verbose and (now - last_print > 2 or i == len(keys)):
+                last_print = now
+                rate = i / max(now - t0, 1e-9)
+                print(
+                    f"[gen] {i}/{len(keys)} ({rate:.1f} case/s, "
+                    f"worker rss {max_rss} MB, "
+                    f"w={counts['written']} s={counts['skipped']} f={counts['failed']})",
+                    file=sys.stderr,
+                )
+    return counts

@@ -9,6 +9,9 @@ import random
 
 import pytest
 
+# pure-python 8192-point DAS math — nightly/full lane (make test-full)
+pytestmark = pytest.mark.slow
+
 from eth_consensus_specs_tpu.utils import bls
 
 from .helpers import specs

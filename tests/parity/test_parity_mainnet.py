@@ -12,6 +12,9 @@ from __future__ import annotations
 
 import pytest
 
+# mainnet-preset differential lane — nightly/full lane (make test-full)
+pytestmark = pytest.mark.slow
+
 from . import helpers
 from .test_parity import *  # noqa: F401,F403 — re-collect the suite
 from .test_parity import _bls_off  # noqa: F401 — star-import skips _names

@@ -3,6 +3,11 @@ on_attester_slashing/get_head over the full fork matrix (reference
 analogue: eth2spec/test/phase0/fork_choice/ + unittests; step semantics
 per tests/formats/fork_choice/README.md:28-80)."""
 
+import pytest
+
+# fork-choice scenario walks — nightly/full lane (make test-full)
+pytestmark = pytest.mark.slow
+
 from eth_consensus_specs_tpu.ssz import hash_tree_root
 from eth_consensus_specs_tpu.test_infra.attestations import (
     get_valid_attestation,

@@ -4,6 +4,9 @@ import random
 
 import pytest
 
+# heavy device-compile / pure-python crypto — nightly lane (make test-full)
+pytestmark = pytest.mark.slow
+
 from eth_consensus_specs_tpu.crypto import das
 from eth_consensus_specs_tpu.crypto.kzg import compute_roots_of_unity
 from eth_consensus_specs_tpu.ops.fr_fft import (

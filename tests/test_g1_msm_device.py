@@ -5,6 +5,9 @@ import random
 
 import pytest
 
+# heavy device-compile / pure-python crypto — nightly lane (make test-full)
+pytestmark = pytest.mark.slow
+
 from eth_consensus_specs_tpu.crypto.curve import g1_generator, g1_infinity
 from eth_consensus_specs_tpu.crypto.fields import R
 from eth_consensus_specs_tpu.crypto.msm import msm_g1

@@ -7,6 +7,9 @@ import hashlib
 
 import pytest
 
+# heavy device-compile / pure-python crypto — nightly lane (make test-full)
+pytestmark = pytest.mark.slow
+
 from eth_consensus_specs_tpu.crypto import kzg
 
 

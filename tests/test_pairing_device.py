@@ -5,6 +5,11 @@ the exact final exponentiation must reproduce the oracle GT element; and
 the fast membership check must agree with pairing_check on valid and
 tampered pairings (the bilinearity relation e(aG1, bG2) = e(abG1, G2))."""
 
+import pytest
+
+# device pairing compiles are minutes-scale — nightly/full lane (make test-full)
+pytestmark = pytest.mark.slow
+
 import numpy as np
 
 from eth_consensus_specs_tpu.crypto import pairing as host_pairing

@@ -2,6 +2,11 @@
 parallel/resident.py) vs ssz.hash_tree_root on the equivalently-updated
 object state — SURVEY hard part 3's bit-exactness gate."""
 
+import pytest
+
+# full-state root compiles are minutes-scale — nightly/full lane (make test-full)
+pytestmark = pytest.mark.slow
+
 import numpy as np
 
 from eth_consensus_specs_tpu import ssz

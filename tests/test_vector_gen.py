@@ -109,3 +109,48 @@ def test_generator_sanity_blocks_replay(tmp_path):
         assert hash_tree_root(pre) == hash_tree_root(post), case_dir.name
         replayed += 1
     assert replayed > 0
+
+
+def test_manifest_overrides_runner_map(tmp_path):
+    """@manifest coordinates must win over the module-map fallback
+    (the seam the reference's Manifest provides, tests/infra/manifest.py)."""
+    from eth_consensus_specs_tpu.gen.gen_from_tests import discover_test_cases
+    from eth_consensus_specs_tpu.test_infra.manifest import vector_location_of
+
+    cases = discover_test_cases(presets=("minimal",), forks=["phase0"])
+    by_name = {}
+    for c in cases:
+        by_name.setdefault(c.case_name, c)
+    # upgrade tests are pinned via the prefix map to transition/core
+    transitions = [c for c in cases if c.runner == "transition"]
+    assert all(c.handler == "core" for c in transitions)
+
+    # a function-level @manifest must override both coordinates
+    import types
+
+    from eth_consensus_specs_tpu.gen import gen_from_tests as g
+    from eth_consensus_specs_tpu.test_infra.manifest import manifest
+
+    mod = types.ModuleType("tests.test_manifest_probe")
+
+    @manifest(runner="pinned_runner", handler="pinned_handler", suite="special")
+    def test_probe(generator_mode=False, phase=None, preset=None):
+        return iter(())
+
+    test_probe.phases = ["phase0"]
+    mod.test_probe = test_probe
+
+    real_iter = g._iter_test_modules
+    g._iter_test_modules = lambda package_name="tests": iter([mod])
+    try:
+        found = g.discover_test_cases(presets=("minimal",))
+    finally:
+        g._iter_test_modules = real_iter
+    assert len(found) == 1
+    case = found[0]
+    assert (case.runner, case.handler, case.suite) == (
+        "pinned_runner",
+        "pinned_handler",
+        "special",
+    )
+    assert vector_location_of(test_probe).runner == "pinned_runner"
