@@ -1,0 +1,187 @@
+"""Light-client update RANKING and validation tables (reference analogue:
+eth2spec/test/altair/light_client/test_update_ranking.py and
+test_sync.py invalid tables; spec:
+specs/altair/light-client/sync-protocol.md `is_better_update` and
+`validate_light_client_update`)."""
+
+from eth_consensus_specs_tpu.ssz import hash_tree_root
+from eth_consensus_specs_tpu.test_infra.context import (
+    always_bls,
+    expect_assertion_error,
+    spec_state_test_with_matching_config,
+    with_phases,
+)
+
+from .test_light_client import (
+    LC_FORKS,
+    _advance_with_light_client_update,
+    _bootstrap_store,
+)
+
+
+def _update_pair(spec, state):
+    store, _ = _bootstrap_store(spec, state)
+    update, sig_state = _advance_with_light_client_update(spec, state)
+    return store, update, sig_state
+
+
+def _strip_supermajority(spec, update):
+    u = update.copy()
+    # leave just over half (>= min participants, < 2/3)
+    keep = spec.SYNC_COMMITTEE_SIZE // 2 + 1
+    for i in range(keep, spec.SYNC_COMMITTEE_SIZE):
+        u.sync_aggregate.sync_committee_bits[i] = False
+    return u
+
+
+def _strip_finality(spec, update):
+    u = update.copy()
+    u.finalized_header = type(u.finalized_header)()
+    u.finality_branch = type(u.finality_branch)(
+        [b"\x00" * 32 for _ in range(len(u.finality_branch))]
+    )
+    return u
+
+
+# == is_better_update decision table =======================================
+
+
+@with_phases(LC_FORKS)
+@spec_state_test_with_matching_config
+def test_ranking_supermajority_beats_participation_count(spec, state):
+    _, update, _ = _update_pair(spec, state)
+    sub = _strip_supermajority(spec, update)
+    assert spec.is_better_update(update, sub)
+    assert not spec.is_better_update(sub, update)
+
+
+@with_phases(LC_FORKS)
+@spec_state_test_with_matching_config
+def test_ranking_equal_updates_not_better(spec, state):
+    _, update, _ = _update_pair(spec, state)
+    assert not spec.is_better_update(update.copy(), update)
+
+
+@with_phases(LC_FORKS)
+@spec_state_test_with_matching_config
+def test_ranking_among_non_supermajority_more_bits_win(spec, state):
+    _, update, _ = _update_pair(spec, state)
+    a = _strip_supermajority(spec, update)
+    b = a.copy()
+    b.sync_aggregate.sync_committee_bits[0] = False  # one fewer bit
+    assert spec.is_better_update(a, b)
+    assert not spec.is_better_update(b, a)
+
+
+@with_phases(LC_FORKS)
+@spec_state_test_with_matching_config
+def test_ranking_finality_preferred(spec, state):
+    _, update, _ = _update_pair(spec, state)
+    if not spec.is_finality_update(update):
+        return  # no finality progress at genesis-era updates in this fork
+    no_fin = _strip_finality(spec, update)
+    assert spec.is_better_update(update, no_fin)
+    assert not spec.is_better_update(no_fin, update)
+
+
+@with_phases(LC_FORKS)
+@spec_state_test_with_matching_config
+def test_ranking_older_attested_slot_tiebreak(spec, state):
+    _, update, _ = _update_pair(spec, state)
+    older = update.copy()
+    newer = update.copy()
+    newer.attested_header.beacon.slot = int(update.attested_header.beacon.slot) + 1
+    # all else equal: the OLDER attested header wins the final tiebreak
+    assert spec.is_better_update(older, newer)
+
+
+# == validate_light_client_update invalid table ============================
+
+
+def _process(spec, store, update, sig_state, current_slot=None):
+    slot = int(sig_state.slot) + 1 if current_slot is None else current_slot
+    spec.process_light_client_update(
+        store, update, slot, sig_state.genesis_validators_root
+    )
+
+
+@with_phases(LC_FORKS)
+@spec_state_test_with_matching_config
+def test_invalid_bad_finality_branch(spec, state):
+    store, update, sig_state = _update_pair(spec, state)
+    bad = update.copy()
+    if not spec.is_finality_update(bad):
+        return
+    bad.finality_branch[0] = b"\x13" * 32
+    expect_assertion_error(lambda: _process(spec, store, bad, sig_state))
+
+
+@with_phases(LC_FORKS)
+@spec_state_test_with_matching_config
+def test_invalid_finalized_header_mismatch(spec, state):
+    store, update, sig_state = _update_pair(spec, state)
+    bad = update.copy()
+    if not spec.is_finality_update(bad):
+        return
+    bad.finalized_header.beacon.state_root = b"\x55" * 32
+    expect_assertion_error(lambda: _process(spec, store, bad, sig_state))
+
+
+@with_phases(LC_FORKS)
+@spec_state_test_with_matching_config
+def test_invalid_signature_slot_not_after_attested(spec, state):
+    store, update, sig_state = _update_pair(spec, state)
+    bad = update.copy()
+    bad.signature_slot = bad.attested_header.beacon.slot  # must be strictly after
+    expect_assertion_error(lambda: _process(spec, store, bad, sig_state))
+
+
+@with_phases(LC_FORKS)
+@spec_state_test_with_matching_config
+def test_invalid_update_from_the_future(spec, state):
+    store, update, sig_state = _update_pair(spec, state)
+    # current slot BEFORE the signature slot: not yet processable
+    expect_assertion_error(
+        lambda: _process(
+            spec, store, update, sig_state, current_slot=int(update.signature_slot) - 1
+        )
+    )
+
+
+@with_phases(LC_FORKS)
+@always_bls
+@spec_state_test_with_matching_config
+def test_invalid_flipped_participation_signature(spec, state):
+    store, update, sig_state = _update_pair(spec, state)
+    bad = update.copy()
+    # claim LESS participation than was signed: aggregate no longer matches
+    bad.sync_aggregate.sync_committee_bits[0] = False
+    expect_assertion_error(lambda: _process(spec, store, bad, sig_state))
+
+
+@with_phases(LC_FORKS)
+@spec_state_test_with_matching_config
+def test_optimistic_update_advances_only_optimistic_head(spec, state):
+    store, update, sig_state = _update_pair(spec, state)
+    pre_finalized = hash_tree_root(store.finalized_header.beacon)
+    optimistic = spec.create_light_client_optimistic_update(update)
+    spec.process_light_client_optimistic_update(
+        store, optimistic, int(sig_state.slot) + 1, sig_state.genesis_validators_root
+    )
+    assert hash_tree_root(store.optimistic_header.beacon) == hash_tree_root(
+        update.attested_header.beacon
+    )
+    assert hash_tree_root(store.finalized_header.beacon) == pre_finalized
+
+
+@with_phases(LC_FORKS)
+@spec_state_test_with_matching_config
+def test_finality_update_shape_roundtrip(spec, state):
+    _, update, _ = _update_pair(spec, state)
+    fin = spec.create_light_client_finality_update(update)
+    assert hash_tree_root(fin.attested_header.beacon) == hash_tree_root(
+        update.attested_header.beacon
+    )
+    assert bytes(fin.sync_aggregate.sync_committee_signature) == bytes(
+        update.sync_aggregate.sync_committee_signature
+    )

@@ -1,0 +1,156 @@
+"""Dense per-mutation invalid table for `process_attestation`, all forks
+(reference analogue: the ~30-variant table in
+test/phase0/block_processing/test_process_attestation.py and its
+altair/electra extensions — each variant one function, one mutation,
+invalid-as-outcome per specs/phase0/beacon-chain.md:1980-2006)."""
+
+from eth_consensus_specs_tpu.test_infra.attestations import (
+    get_valid_attestation,
+    run_attestation_processing,
+)
+from eth_consensus_specs_tpu.test_infra.context import (
+    spec_state_test,
+    with_all_phases,
+)
+from eth_consensus_specs_tpu.test_infra.forks import is_post_electra
+from eth_consensus_specs_tpu.test_infra.state import next_slots
+
+
+def _fresh(spec, state, signed=True):
+    next_slots(spec, state, 10)
+    att = get_valid_attestation(spec, state, signed=signed)
+    next_slots(spec, state, int(spec.MIN_ATTESTATION_INCLUSION_DELAY))
+    return att
+
+
+@with_all_phases
+@spec_state_test
+def test_invalid_source_root_mismatch(spec, state):
+    att = _fresh(spec, state)
+    att.data.source.root = b"\x42" * 32
+    yield from run_attestation_processing(spec, state, att, valid=False)
+
+
+@with_all_phases
+@spec_state_test
+def test_invalid_source_epoch_too_new(spec, state):
+    att = _fresh(spec, state)
+    att.data.source.epoch = spec.get_current_epoch(state) + 10
+    yield from run_attestation_processing(spec, state, att, valid=False)
+
+
+@with_all_phases
+@spec_state_test
+def test_invalid_target_epoch_in_future(spec, state):
+    att = _fresh(spec, state)
+    att.data.target.epoch = spec.get_current_epoch(state) + 1
+    yield from run_attestation_processing(spec, state, att, valid=False)
+
+
+@with_all_phases
+@spec_state_test
+def test_invalid_target_epoch_old(spec, state):
+    att = _fresh(spec, state)
+    # push well past both current and previous epoch
+    next_slots(spec, state, 3 * int(spec.SLOTS_PER_EPOCH))
+    yield from run_attestation_processing(spec, state, att, valid=False)
+
+
+@with_all_phases
+@spec_state_test
+def test_invalid_empty_aggregation_bits(spec, state):
+    att = _fresh(spec, state)
+    for i in range(len(att.aggregation_bits)):
+        att.aggregation_bits[i] = False
+    yield from run_attestation_processing(spec, state, att, valid=False)
+
+
+@with_all_phases
+@spec_state_test
+def test_invalid_committee_index_out_of_range(spec, state):
+    att = _fresh(spec, state)
+    if is_post_electra(spec):
+        bits = att.committee_bits
+        n_committees = int(
+            spec.get_committee_count_per_slot(state, att.data.target.epoch)
+        )
+        for i in range(len(bits)):
+            bits[i] = False
+        if n_committees < len(bits):
+            bits[len(bits) - 1] = True  # a committee index that doesn't exist
+        # else: all bits cleared — committee_offset 0 != len(aggregation_bits)
+    else:
+        att.data.index = 64
+    yield from run_attestation_processing(spec, state, att, valid=False)
+
+
+@with_all_phases
+@spec_state_test
+def test_invalid_beacon_block_root_mismatch_is_valid(spec, state):
+    """A wrong LMD vote (beacon_block_root) is NOT checked by
+    process_attestation — the attestation stays valid (it just earns no
+    head credit); guards against over-strict implementations."""
+    att = _fresh(spec, state, signed=False)
+    att.data.beacon_block_root = b"\x13" * 32
+    from eth_consensus_specs_tpu.utils import bls
+
+    prev = bls.bls_active
+    bls.bls_active = False
+    try:
+        yield from run_attestation_processing(spec, state, att, valid=True)
+    finally:
+        bls.bls_active = prev
+
+
+@with_all_phases
+@spec_state_test
+def test_invalid_inclusion_exactly_one_slot_early(spec, state):
+    next_slots(spec, state, 10)
+    att = get_valid_attestation(spec, state, signed=True)
+    next_slots(spec, state, int(spec.MIN_ATTESTATION_INCLUSION_DELAY) - 1)
+    yield from run_attestation_processing(spec, state, att, valid=False)
+
+
+@with_all_phases
+@spec_state_test
+def test_valid_inclusion_at_exact_delay(spec, state):
+    next_slots(spec, state, 10)
+    att = get_valid_attestation(spec, state, signed=True)
+    next_slots(spec, state, int(spec.MIN_ATTESTATION_INCLUSION_DELAY))
+    yield from run_attestation_processing(spec, state, att, valid=True)
+
+
+@with_all_phases
+@spec_state_test
+def test_valid_inclusion_at_epoch_boundary_edge(spec, state):
+    next_slots(spec, state, 10)
+    att = get_valid_attestation(spec, state, signed=True)
+    # phase0: must be included within SLOTS_PER_EPOCH; land exactly there
+    next_slots(spec, state, int(spec.SLOTS_PER_EPOCH))
+    yield from run_attestation_processing(spec, state, att, valid=True)
+
+
+@with_all_phases
+@spec_state_test
+def test_invalid_aggregation_bits_too_short(spec, state):
+    att = _fresh(spec, state)
+    bits_t = type(att.aggregation_bits)
+    shorter = list(att.aggregation_bits)[:-1]
+    try:
+        att.aggregation_bits = bits_t(shorter)
+    except Exception:
+        # type rejects at construction: equally a fail-closed outcome
+        return
+    yield from run_attestation_processing(spec, state, att, valid=False)
+
+
+@with_all_phases
+@spec_state_test
+def test_invalid_source_mismatch_previous_epoch(spec, state):
+    # previous-epoch attestation must check against previous_justified
+    next_slots(spec, state, int(spec.SLOTS_PER_EPOCH) + 2)
+    att = get_valid_attestation(
+        spec, state, slot=int(state.slot) - int(spec.SLOTS_PER_EPOCH), signed=True
+    )
+    att.data.source.epoch = spec.get_current_epoch(state)
+    yield from run_attestation_processing(spec, state, att, valid=False)

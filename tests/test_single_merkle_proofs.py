@@ -1,0 +1,167 @@
+"""Single-merkle-proof suites: inclusion branches for consensus objects
+(reference analogue: test/deneb/unittests/test_single_merkle_proof.py,
+test/fulu/unittests/ sidecar proofs, and the light-client proof suites;
+proofs from ssz/merkle.compute_merkle_proof verified with the spec's own
+is_valid_merkle_branch / normalized-branch verifiers)."""
+
+from eth_consensus_specs_tpu import ssz
+from eth_consensus_specs_tpu.ssz import hash_tree_root
+from eth_consensus_specs_tpu.ssz.gindex import get_generalized_index
+from eth_consensus_specs_tpu.ssz.merkle import compute_merkle_proof
+from eth_consensus_specs_tpu.test_infra.context import (
+    spec_state_test,
+    spec_state_test_with_matching_config,
+    with_phases,
+)
+
+BLOB_FORKS = ["deneb", "electra", "fulu"]
+LC_STATE_FORKS = ["altair", "capella", "deneb", "electra"]
+
+
+def _floorlog2(x: int) -> int:
+    return int(x).bit_length() - 1
+
+
+# == blob_kzg_commitments inclusion in BeaconBlockBody (deneb..fulu) =======
+
+
+@with_phases(BLOB_FORKS)
+@spec_state_test
+def test_blob_commitments_inclusion_proof(spec, state):
+    body = spec.BeaconBlockBody()
+    body.blob_kzg_commitments.append(b"\x01" * 48)
+    gindex = get_generalized_index(type(body), "blob_kzg_commitments")
+    branch = compute_merkle_proof(body, gindex)
+    assert len(branch) == _floorlog2(gindex)
+    leaf = hash_tree_root(body.blob_kzg_commitments)
+    root = hash_tree_root(body)
+    assert spec.is_valid_merkle_branch(
+        leaf, branch, _floorlog2(gindex), int(gindex) % (1 << _floorlog2(gindex)), root
+    )
+
+
+@with_phases(BLOB_FORKS)
+@spec_state_test
+def test_blob_commitments_proof_rejects_tamper(spec, state):
+    body = spec.BeaconBlockBody()
+    body.blob_kzg_commitments.append(b"\x02" * 48)
+    gindex = get_generalized_index(type(body), "blob_kzg_commitments")
+    branch = list(compute_merkle_proof(body, gindex))
+    branch[2] = b"\x77" * 32
+    leaf = hash_tree_root(body.blob_kzg_commitments)
+    root = hash_tree_root(body)
+    assert not spec.is_valid_merkle_branch(
+        leaf, branch, _floorlog2(gindex), int(gindex) % (1 << _floorlog2(gindex)), root
+    )
+
+
+@with_phases(BLOB_FORKS)
+@spec_state_test
+def test_single_commitment_element_proof(spec, state):
+    """Proof of ONE commitment element inside the list (the blob sidecar
+    shape: list element + length mix-in on the path)."""
+    body = spec.BeaconBlockBody()
+    for i in range(3):
+        body.blob_kzg_commitments.append(bytes([i + 1]) * 48)
+    gindex = get_generalized_index(type(body), "blob_kzg_commitments", 1)
+    branch = compute_merkle_proof(body, gindex)
+    leaf = hash_tree_root(ssz.Bytes48(bytes([2]) * 48))
+    root = hash_tree_root(body)
+    assert spec.is_valid_merkle_branch(
+        leaf, branch, _floorlog2(gindex), int(gindex) % (1 << _floorlog2(gindex)), root
+    )
+
+
+# == fulu DataColumnSidecar commitment inclusion ===========================
+
+
+@with_phases(["fulu"])
+@spec_state_test
+def test_data_column_sidecar_inclusion_depth_matches_spec(spec, state):
+    body = spec.BeaconBlockBody()
+    gindex = get_generalized_index(type(body), "blob_kzg_commitments")
+    # the p2p constant the sidecar Vector is sized by (fulu
+    # p2p-interface.md:82) must equal the real tree depth
+    assert _floorlog2(gindex) == int(spec.KZG_COMMITMENTS_INCLUSION_PROOF_DEPTH)
+
+
+# == light-client state branches (altair..electra) =========================
+
+
+@with_phases(LC_STATE_FORKS)
+@spec_state_test_with_matching_config
+def test_next_sync_committee_branch_depth(spec, state):
+    raw_gindex = get_generalized_index(type(state), "next_sync_committee")
+    branch = spec.normalize_merkle_branch(
+        compute_merkle_proof(state, raw_gindex),
+        spec.next_sync_committee_gindex_at_slot(state.slot),
+    )
+    assert spec.is_valid_normalized_merkle_branch(
+        hash_tree_root(state.next_sync_committee),
+        branch,
+        spec.next_sync_committee_gindex_at_slot(state.slot),
+        hash_tree_root(state),
+    )
+
+
+@with_phases(LC_STATE_FORKS)
+@spec_state_test_with_matching_config
+def test_finality_branch_wrong_leaf_rejected(spec, state):
+    raw_gindex = get_generalized_index(type(state), "finalized_checkpoint", "root")
+    gindex = spec.finalized_root_gindex_at_slot(state.slot)
+    branch = spec.normalize_merkle_branch(compute_merkle_proof(state, raw_gindex), gindex)
+    wrong_leaf = ssz.Bytes32(b"\x31" * 32)
+    assert not spec.is_valid_normalized_merkle_branch(
+        wrong_leaf, branch, gindex, hash_tree_root(state)
+    )
+
+
+@with_phases(LC_STATE_FORKS)
+@spec_state_test
+def test_state_field_proofs_roundtrip(spec, state):
+    """Container-field proofs across a handful of BeaconState fields."""
+    for field in ("fork", "latest_block_header", "finalized_checkpoint"):
+        gindex = get_generalized_index(type(state), field)
+        branch = compute_merkle_proof(state, gindex)
+        leaf = hash_tree_root(getattr(state, field))
+        assert spec.is_valid_merkle_branch(
+            leaf,
+            branch,
+            _floorlog2(gindex),
+            int(gindex) % (1 << _floorlog2(gindex)),
+            hash_tree_root(state),
+        )
+
+
+@with_phases(["capella", "deneb", "electra"])
+@spec_state_test
+def test_execution_payload_header_field_proof(spec, state):
+    """Execution branch of the LC header (capella+): payload header root
+    inside the block body."""
+    body = spec.BeaconBlockBody()
+    gindex = get_generalized_index(type(body), "execution_payload")
+    branch = compute_merkle_proof(body, gindex)
+    leaf = hash_tree_root(body.execution_payload)
+    assert spec.is_valid_merkle_branch(
+        leaf,
+        branch,
+        _floorlog2(gindex),
+        int(gindex) % (1 << _floorlog2(gindex)),
+        hash_tree_root(body),
+    )
+
+
+@with_phases(["altair"])
+@spec_state_test
+def test_deep_gindex_proof_through_checkpoint(spec, state):
+    """Multi-segment path: state -> finalized_checkpoint -> root."""
+    state.finalized_checkpoint.root = b"\x2b" * 32
+    gindex = get_generalized_index(type(state), "finalized_checkpoint", "root")
+    branch = compute_merkle_proof(state, gindex)
+    assert spec.is_valid_merkle_branch(
+        ssz.Bytes32(state.finalized_checkpoint.root),
+        branch,
+        _floorlog2(gindex),
+        int(gindex) % (1 << _floorlog2(gindex)),
+        hash_tree_root(state),
+    )
