@@ -1,0 +1,169 @@
+"""Dense execution-payload mutation table, bellatrix..deneb (reference
+analogue: the ~25-variant tables in test/bellatrix/block_processing/
+test_process_execution_payload.py and its capella/deneb revisions)."""
+
+from eth_consensus_specs_tpu.test_infra.context import (
+    spec_state_test,
+    with_phases,
+)
+from eth_consensus_specs_tpu.test_infra.execution_payload import (
+    build_empty_execution_payload,
+    compute_el_block_hash,
+)
+from eth_consensus_specs_tpu.test_infra.state import next_slot, next_slots
+
+EL_FORKS = ["bellatrix", "capella", "deneb"]
+
+
+def run_execution_payload_processing(spec, state, payload, valid=True, execution_valid=True):
+    """Fork-generic dual-mode runner (the bellatrix-only runner in
+    test_execution_payload.py predates the deneb engine signature)."""
+    from eth_consensus_specs_tpu.test_infra.context import expect_assertion_error
+
+    class TestEngine(type(spec.EXECUTION_ENGINE)):
+        def notify_new_payload(self, *args, **kwargs) -> bool:
+            return execution_valid
+
+        def verify_and_notify_new_payload(self, *args, **kwargs) -> bool:
+            return execution_valid
+
+    body = spec.BeaconBlockBody(execution_payload=payload)
+    yield "pre", state
+    yield "execution", {"execution_valid": execution_valid}
+    yield "body", body
+    if not (valid and execution_valid):
+        expect_assertion_error(
+            lambda: spec.process_execution_payload(state, body, TestEngine())
+        )
+        yield "post", None
+        return
+    spec.process_execution_payload(state, body, TestEngine())
+    yield "post", state
+    assert state.latest_execution_payload_header.block_hash == payload.block_hash
+
+
+def _payload(spec, state):
+    next_slot(spec, state)
+    return build_empty_execution_payload(spec, state)
+
+
+@with_phases(EL_FORKS)
+@spec_state_test
+def test_payload_basic_success(spec, state):
+    payload = _payload(spec, state)
+    yield from run_execution_payload_processing(spec, state, payload)
+
+
+@with_phases(EL_FORKS)
+@spec_state_test
+def test_payload_second_in_a_row(spec, state):
+    payload = _payload(spec, state)
+    for part in run_execution_payload_processing(spec, state, payload):
+        pass
+    next_slot(spec, state)
+    payload2 = build_empty_execution_payload(spec, state)
+    yield from run_execution_payload_processing(spec, state, payload2)
+
+
+@with_phases(EL_FORKS)
+@spec_state_test
+def test_invalid_bad_parent_hash_regular_payload(spec, state):
+    payload = _payload(spec, state)
+    payload.parent_hash = b"\x55" * 32
+    payload.block_hash = compute_el_block_hash(spec, payload)
+    yield from run_execution_payload_processing(spec, state, payload, valid=False)
+
+
+@with_phases(EL_FORKS)
+@spec_state_test
+def test_invalid_randao_of_wrong_epoch(spec, state):
+    payload = _payload(spec, state)
+    # a PAST epoch's mix: wrong after enough slots
+    next_slots(spec, state, 2 * int(spec.SLOTS_PER_EPOCH))
+    wrong = spec.get_randao_mix(state, spec.get_current_epoch(state) - 2)
+    payload.prev_randao = wrong
+    payload.block_hash = compute_el_block_hash(spec, payload)
+    yield from run_execution_payload_processing(spec, state, payload, valid=False)
+
+
+@with_phases(EL_FORKS)
+@spec_state_test
+def test_invalid_timestamp_past(spec, state):
+    payload = _payload(spec, state)
+    payload.timestamp = int(payload.timestamp) - 1
+    payload.block_hash = compute_el_block_hash(spec, payload)
+    yield from run_execution_payload_processing(spec, state, payload, valid=False)
+
+
+@with_phases(EL_FORKS)
+@spec_state_test
+def test_invalid_timestamp_future(spec, state):
+    payload = _payload(spec, state)
+    payload.timestamp = int(payload.timestamp) + int(spec.config.SECONDS_PER_SLOT)
+    payload.block_hash = compute_el_block_hash(spec, payload)
+    yield from run_execution_payload_processing(spec, state, payload, valid=False)
+
+
+@with_phases(EL_FORKS)
+@spec_state_test
+def test_invalid_engine_verdict_false(spec, state):
+    payload = _payload(spec, state)
+    yield from run_execution_payload_processing(
+        spec, state, payload, execution_valid=False
+    )
+
+
+@with_phases(EL_FORKS)
+@spec_state_test
+def test_payload_with_gas_fields_mutated_still_valid(spec, state):
+    """gas_used/gas_limit are EL-validated, not consensus-checked: a
+    mutated-but-hash-consistent payload must still pass."""
+    payload = _payload(spec, state)
+    payload.gas_used = 21_000
+    payload.gas_limit = 30_000_000
+    payload.block_hash = compute_el_block_hash(spec, payload)
+    yield from run_execution_payload_processing(spec, state, payload)
+
+
+@with_phases(EL_FORKS)
+@spec_state_test
+def test_payload_nonzero_extra_data_valid(spec, state):
+    payload = _payload(spec, state)
+    payload.extra_data = b"framework"
+    payload.block_hash = compute_el_block_hash(spec, payload)
+    yield from run_execution_payload_processing(spec, state, payload)
+
+
+@with_phases(EL_FORKS)
+@spec_state_test
+def test_payload_fee_recipient_arbitrary_valid(spec, state):
+    payload = _payload(spec, state)
+    payload.fee_recipient = b"\xaa" * 20
+    payload.block_hash = compute_el_block_hash(spec, payload)
+    yield from run_execution_payload_processing(spec, state, payload)
+
+
+@with_phases(["capella", "deneb"])
+@spec_state_test
+def test_invalid_withdrawals_mismatch_in_payload(spec, state):
+    """capella+: process_withdrawals runs before the payload import; a
+    payload whose withdrawals differ from the state's expectation fails
+    the block path (driven through process_withdrawals)."""
+    from eth_consensus_specs_tpu.test_infra.context import expect_assertion_error
+
+    next_slot(spec, state)
+    payload = build_empty_execution_payload(spec, state)
+    w = spec.Withdrawal(index=0, validator_index=0, address=b"\x01" * 20, amount=1)
+    payload.withdrawals.append(w)
+    payload.block_hash = compute_el_block_hash(spec, payload)
+    expect_assertion_error(lambda: spec.process_withdrawals(state, payload))
+
+
+@with_phases(["deneb"])
+@spec_state_test
+def test_deneb_payload_with_blob_fields(spec, state):
+    payload = _payload(spec, state)
+    payload.blob_gas_used = 0
+    payload.excess_blob_gas = 0
+    payload.block_hash = compute_el_block_hash(spec, payload)
+    yield from run_execution_payload_processing(spec, state, payload)

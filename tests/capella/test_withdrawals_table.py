@@ -1,0 +1,220 @@
+"""Dense withdrawal-sweep and BLS-to-execution-change tables, capella+
+(reference analogue: test/capella/block_processing/test_process_withdrawals.py
+~40 variants and test_process_bls_to_execution_change.py)."""
+
+from eth_consensus_specs_tpu.ssz.hashing import hash_bytes
+from eth_consensus_specs_tpu.test_infra.context import (
+    always_bls,
+    expect_assertion_error,
+    spec_state_test,
+    with_phases,
+)
+from eth_consensus_specs_tpu.test_infra.execution_payload import (
+    build_empty_execution_payload,
+    compute_el_block_hash,
+)
+from eth_consensus_specs_tpu.test_infra.keys import privkeys, pubkeys
+from eth_consensus_specs_tpu.test_infra.state import next_slot
+from eth_consensus_specs_tpu.utils import bls
+
+CAPELLA_FORKS = ["capella", "deneb"]
+
+
+def _eth1_credentials(spec, state, idx: int, address: bytes = b"\x42" * 20):
+    state.validators[idx].withdrawal_credentials = (
+        bytes(spec.ETH1_ADDRESS_WITHDRAWAL_PREFIX) + b"\x00" * 11 + address
+    )
+
+
+def _fully_withdrawable(spec, state, idx: int):
+    _eth1_credentials(spec, state, idx)
+    state.validators[idx].withdrawable_epoch = spec.get_current_epoch(state)
+
+
+def _partially_withdrawable(spec, state, idx: int):
+    _eth1_credentials(spec, state, idx)
+    state.balances[idx] = int(spec.MAX_EFFECTIVE_BALANCE) + 1_000_000
+    state.validators[idx].effective_balance = spec.MAX_EFFECTIVE_BALANCE
+
+
+def _apply_expected(spec, state):
+    next_slot(spec, state)
+    # build_empty_execution_payload already carries the expected sweep
+    payload = build_empty_execution_payload(spec, state)
+    return payload, list(payload.withdrawals)
+
+
+@with_phases(CAPELLA_FORKS)
+@spec_state_test
+def test_sweep_full_withdrawal_zeroes_balance(spec, state):
+    _fully_withdrawable(spec, state, 1)
+    payload, expected = _apply_expected(spec, state)
+    assert len(expected) == 1
+    spec.process_withdrawals(state, payload)
+    assert int(state.balances[1]) == 0
+
+
+@with_phases(CAPELLA_FORKS)
+@spec_state_test
+def test_sweep_partial_withdrawal_to_max_effective(spec, state):
+    _partially_withdrawable(spec, state, 2)
+    payload, expected = _apply_expected(spec, state)
+    assert len(expected) == 1
+    spec.process_withdrawals(state, payload)
+    assert int(state.balances[2]) == int(spec.MAX_EFFECTIVE_BALANCE)
+
+
+@with_phases(CAPELLA_FORKS)
+@spec_state_test
+def test_sweep_mixed_full_and_partial(spec, state):
+    _fully_withdrawable(spec, state, 1)
+    _partially_withdrawable(spec, state, 2)
+    payload, expected = _apply_expected(spec, state)
+    assert len(expected) == 2
+    spec.process_withdrawals(state, payload)
+    assert int(state.next_withdrawal_index) == 2
+
+
+@with_phases(CAPELLA_FORKS)
+@spec_state_test
+def test_sweep_advances_validator_cursor(spec, state):
+    _fully_withdrawable(spec, state, 3)
+    payload, expected = _apply_expected(spec, state)
+    pre_cursor = int(state.next_withdrawal_validator_index)
+    spec.process_withdrawals(state, payload)
+    assert int(state.next_withdrawal_validator_index) != pre_cursor
+
+
+@with_phases(CAPELLA_FORKS)
+@spec_state_test
+def test_invalid_extra_withdrawal_in_payload(spec, state):
+    payload, expected = _apply_expected(spec, state)
+    payload.withdrawals.append(
+        spec.Withdrawal(index=99, validator_index=0, address=b"\x01" * 20, amount=1)
+    )
+    expect_assertion_error(lambda: spec.process_withdrawals(state, payload))
+
+
+@with_phases(CAPELLA_FORKS)
+@spec_state_test
+def test_invalid_withdrawal_wrong_validator_index(spec, state):
+    _fully_withdrawable(spec, state, 1)
+    payload, expected = _apply_expected(spec, state)
+    payload.withdrawals[0].validator_index = 7
+    expect_assertion_error(lambda: spec.process_withdrawals(state, payload))
+
+
+@with_phases(CAPELLA_FORKS)
+@spec_state_test
+def test_invalid_withdrawal_wrong_address(spec, state):
+    _fully_withdrawable(spec, state, 1)
+    payload, expected = _apply_expected(spec, state)
+    payload.withdrawals[0].address = b"\x99" * 20
+    expect_assertion_error(lambda: spec.process_withdrawals(state, payload))
+
+
+@with_phases(CAPELLA_FORKS)
+@spec_state_test
+def test_invalid_withdrawal_wrong_index_counter(spec, state):
+    _fully_withdrawable(spec, state, 1)
+    payload, expected = _apply_expected(spec, state)
+    payload.withdrawals[0].index = int(payload.withdrawals[0].index) + 1
+    expect_assertion_error(lambda: spec.process_withdrawals(state, payload))
+
+
+# == BLS-to-execution change table =========================================
+
+
+def _signed_change(spec, state, idx: int, from_privkey=None, to_address=b"\x11" * 20):
+    from_privkey = privkeys[idx] if from_privkey is None else from_privkey
+    from_pubkey = pubkeys[idx] if from_privkey is privkeys[idx] else bls.SkToPk(from_privkey)
+    change = spec.BLSToExecutionChange(
+        validator_index=idx,
+        from_bls_pubkey=from_pubkey,
+        to_execution_address=to_address,
+    )
+    state.validators[idx].withdrawal_credentials = (
+        bytes(spec.BLS_WITHDRAWAL_PREFIX) + hash_bytes(bytes(from_pubkey))[1:]
+    )
+    domain = spec.compute_domain(
+        spec.DOMAIN_BLS_TO_EXECUTION_CHANGE,
+        spec.config.GENESIS_FORK_VERSION,
+        state.genesis_validators_root,
+    )
+    sig = bls.Sign(from_privkey, spec.compute_signing_root(change, domain))
+    return spec.SignedBLSToExecutionChange(message=change, signature=sig)
+
+
+@with_phases(CAPELLA_FORKS)
+@always_bls
+@spec_state_test
+def test_change_applies_eth1_prefix(spec, state):
+    signed = _signed_change(spec, state, 4)
+    spec.process_bls_to_execution_change(state, signed)
+    creds = bytes(state.validators[4].withdrawal_credentials)
+    assert creds[:1] == bytes(spec.ETH1_ADDRESS_WITHDRAWAL_PREFIX)
+    assert creds[12:] == b"\x11" * 20
+
+
+@with_phases(CAPELLA_FORKS)
+@always_bls
+@spec_state_test
+def test_invalid_change_wrong_pubkey_hash(spec, state):
+    signed = _signed_change(spec, state, 4)
+    state.validators[4].withdrawal_credentials = (
+        bytes(spec.BLS_WITHDRAWAL_PREFIX) + hash_bytes(bytes(pubkeys[5]))[1:]
+    )
+    expect_assertion_error(lambda: spec.process_bls_to_execution_change(state, signed))
+
+
+@with_phases(CAPELLA_FORKS)
+@always_bls
+@spec_state_test
+def test_invalid_change_already_eth1_credentials(spec, state):
+    signed = _signed_change(spec, state, 4)
+    _eth1_credentials(spec, state, 4)
+    expect_assertion_error(lambda: spec.process_bls_to_execution_change(state, signed))
+
+
+@with_phases(CAPELLA_FORKS)
+@always_bls
+@spec_state_test
+def test_invalid_change_bad_signature(spec, state):
+    signed = _signed_change(spec, state, 4)
+    signed.signature = bls.Sign(privkeys[9], b"\x00" * 32)
+    expect_assertion_error(lambda: spec.process_bls_to_execution_change(state, signed))
+
+
+@with_phases(CAPELLA_FORKS)
+@always_bls
+@spec_state_test
+def test_invalid_change_out_of_range_index(spec, state):
+    signed = _signed_change(spec, state, 4)
+    signed.message.validator_index = len(state.validators) + 5
+    expect_assertion_error(lambda: spec.process_bls_to_execution_change(state, signed))
+
+
+@with_phases(CAPELLA_FORKS)
+@always_bls
+@spec_state_test
+def test_change_signature_checked_against_genesis_fork(spec, state):
+    """The change domain pins GENESIS_FORK_VERSION even after forks —
+    signing with the current fork version must fail."""
+    signed = _signed_change(spec, state, 4)
+    wrong_domain = spec.compute_domain(
+        spec.DOMAIN_BLS_TO_EXECUTION_CHANGE,
+        state.fork.current_version,
+        state.genesis_validators_root,
+    )
+    if bytes(wrong_domain) == bytes(
+        spec.compute_domain(
+            spec.DOMAIN_BLS_TO_EXECUTION_CHANGE,
+            spec.config.GENESIS_FORK_VERSION,
+            state.genesis_validators_root,
+        )
+    ):
+        return  # fork version equals genesis (pure-capella genesis state)
+    signed.signature = bls.Sign(
+        privkeys[4], spec.compute_signing_root(signed.message, wrong_domain)
+    )
+    expect_assertion_error(lambda: spec.process_bls_to_execution_change(state, signed))

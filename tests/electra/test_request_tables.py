@@ -1,0 +1,245 @@
+"""Dense tables for the electra execution-layer request operations —
+withdrawal requests (EIP-7002), consolidation requests (EIP-7251),
+deposit requests (EIP-6110) (reference analogue:
+test/electra/block_processing/test_process_withdrawal_request.py ~30
+variants, test_process_consolidation_request.py ~40 variants)."""
+
+from eth_consensus_specs_tpu.test_infra.context import (
+    expect_assertion_error,
+    spec_state_test,
+    with_phases,
+)
+from eth_consensus_specs_tpu.test_infra.keys import pubkeys
+from eth_consensus_specs_tpu.test_infra.state import next_slots
+
+ELECTRA_FORKS = ["electra", "fulu"]
+
+
+def _mature(spec, state):
+    next_slots(
+        spec, state, int(spec.config.SHARD_COMMITTEE_PERIOD) * int(spec.SLOTS_PER_EPOCH)
+    )
+
+
+def _eth1_creds(spec, state, idx: int, address=b"\x44" * 20, compounding=False):
+    prefix = (
+        spec.COMPOUNDING_WITHDRAWAL_PREFIX if compounding else spec.ETH1_ADDRESS_WITHDRAWAL_PREFIX
+    )
+    state.validators[idx].withdrawal_credentials = bytes(prefix) + b"\x00" * 11 + address
+
+
+def _withdrawal_request(spec, state, idx: int, amount=None, address=b"\x44" * 20):
+    return spec.WithdrawalRequest(
+        source_address=address,
+        validator_pubkey=state.validators[idx].pubkey,
+        amount=spec.FULL_EXIT_REQUEST_AMOUNT if amount is None else amount,
+    )
+
+
+# == withdrawal requests (EIP-7002) ========================================
+
+
+@with_phases(ELECTRA_FORKS)
+@spec_state_test
+def test_withdrawal_request_full_exit(spec, state):
+    _mature(spec, state)
+    _eth1_creds(spec, state, 3)
+    req = _withdrawal_request(spec, state, 3)
+    spec.process_withdrawal_request(state, req)
+    assert int(state.validators[3].exit_epoch) != int(spec.FAR_FUTURE_EPOCH)
+
+
+@with_phases(ELECTRA_FORKS)
+@spec_state_test
+def test_withdrawal_request_wrong_source_address_noop(spec, state):
+    _mature(spec, state)
+    _eth1_creds(spec, state, 3)
+    req = _withdrawal_request(spec, state, 3, address=b"\x55" * 20)
+    spec.process_withdrawal_request(state, req)  # EL requests no-op, not assert
+    assert int(state.validators[3].exit_epoch) == int(spec.FAR_FUTURE_EPOCH)
+
+
+@with_phases(ELECTRA_FORKS)
+@spec_state_test
+def test_withdrawal_request_unknown_pubkey_noop(spec, state):
+    _mature(spec, state)
+    _eth1_creds(spec, state, 3)
+    req = spec.WithdrawalRequest(
+        source_address=b"\x44" * 20,
+        validator_pubkey=pubkeys[len(state.validators) + 10],
+        amount=spec.FULL_EXIT_REQUEST_AMOUNT,
+    )
+    pre = state.copy()
+    spec.process_withdrawal_request(state, req)
+    assert state.validators == pre.validators
+
+
+@with_phases(ELECTRA_FORKS)
+@spec_state_test
+def test_withdrawal_request_not_active_long_enough_noop(spec, state):
+    _eth1_creds(spec, state, 3)  # NO maturity advance
+    req = _withdrawal_request(spec, state, 3)
+    spec.process_withdrawal_request(state, req)
+    assert int(state.validators[3].exit_epoch) == int(spec.FAR_FUTURE_EPOCH)
+
+
+@with_phases(ELECTRA_FORKS)
+@spec_state_test
+def test_withdrawal_request_already_exiting_noop(spec, state):
+    _mature(spec, state)
+    _eth1_creds(spec, state, 3)
+    spec.initiate_validator_exit(state, 3)
+    exit_epoch = int(state.validators[3].exit_epoch)
+    req = _withdrawal_request(spec, state, 3)
+    spec.process_withdrawal_request(state, req)
+    assert int(state.validators[3].exit_epoch) == exit_epoch
+
+
+@with_phases(ELECTRA_FORKS)
+@spec_state_test
+def test_partial_withdrawal_request_compounding(spec, state):
+    _mature(spec, state)
+    _eth1_creds(spec, state, 3, compounding=True)
+    state.balances[3] = int(spec.MIN_ACTIVATION_BALANCE) + 2_000_000
+    req = _withdrawal_request(spec, state, 3, amount=1_000_000)
+    pre_len = len(state.pending_partial_withdrawals)
+    spec.process_withdrawal_request(state, req)
+    assert len(state.pending_partial_withdrawals) == pre_len + 1
+
+
+@with_phases(ELECTRA_FORKS)
+@spec_state_test
+def test_partial_withdrawal_request_non_compounding_noop(spec, state):
+    _mature(spec, state)
+    _eth1_creds(spec, state, 3, compounding=False)
+    state.balances[3] = int(spec.MIN_ACTIVATION_BALANCE) + 2_000_000
+    req = _withdrawal_request(spec, state, 3, amount=1_000_000)
+    pre_len = len(state.pending_partial_withdrawals)
+    spec.process_withdrawal_request(state, req)
+    assert len(state.pending_partial_withdrawals) == pre_len
+
+
+# == consolidation requests (EIP-7251) =====================================
+
+
+def _consolidation(spec, state, src: int, dst: int, address=None):
+    addr = (
+        bytes(state.validators[src].withdrawal_credentials[12:])
+        if address is None
+        else address
+    )
+    return spec.ConsolidationRequest(
+        source_address=addr,
+        source_pubkey=state.validators[src].pubkey,
+        target_pubkey=state.validators[dst].pubkey,
+    )
+
+
+def _consolidation_ready(spec, state, src=1, dst=2):
+    for idx in (src, dst):
+        _eth1_creds(spec, state, idx, address=bytes([0x30 + idx]) * 20, compounding=True)
+    _mature(spec, state)
+
+
+@with_phases(ELECTRA_FORKS)
+@spec_state_test
+def test_consolidation_basic(spec, state):
+    _consolidation_ready(spec, state)
+    req = _consolidation(spec, state, 1, 2)
+    pre_len = len(state.pending_consolidations)
+    spec.process_consolidation_request(state, req)
+    assert len(state.pending_consolidations) == pre_len + 1
+    assert int(state.validators[1].exit_epoch) != int(spec.FAR_FUTURE_EPOCH)
+
+
+@with_phases(ELECTRA_FORKS)
+@spec_state_test
+def test_consolidation_self_is_noop(spec, state):
+    _consolidation_ready(spec, state)
+    req = _consolidation(spec, state, 1, 1)
+    pre_len = len(state.pending_consolidations)
+    spec.process_consolidation_request(state, req)
+    assert len(state.pending_consolidations) == pre_len
+
+
+@with_phases(ELECTRA_FORKS)
+@spec_state_test
+def test_consolidation_wrong_source_address_noop(spec, state):
+    _consolidation_ready(spec, state)
+    req = _consolidation(spec, state, 1, 2, address=b"\x77" * 20)
+    pre_len = len(state.pending_consolidations)
+    spec.process_consolidation_request(state, req)
+    assert len(state.pending_consolidations) == pre_len
+
+
+@with_phases(ELECTRA_FORKS)
+@spec_state_test
+def test_consolidation_target_without_compounding_noop(spec, state):
+    _consolidation_ready(spec, state)
+    _eth1_creds(spec, state, 2, address=b"\x32" * 20, compounding=False)
+    req = _consolidation(spec, state, 1, 2)
+    pre_len = len(state.pending_consolidations)
+    spec.process_consolidation_request(state, req)
+    assert len(state.pending_consolidations) == pre_len
+
+
+@with_phases(ELECTRA_FORKS)
+@spec_state_test
+def test_consolidation_exiting_source_noop(spec, state):
+    _consolidation_ready(spec, state)
+    spec.initiate_validator_exit(state, 1)
+    req = _consolidation(spec, state, 1, 2)
+    pre_len = len(state.pending_consolidations)
+    spec.process_consolidation_request(state, req)
+    assert len(state.pending_consolidations) == pre_len
+
+
+# == deposit requests (EIP-6110) ===========================================
+
+
+@with_phases(ELECTRA_FORKS)
+@spec_state_test
+def test_deposit_request_appends_pending(spec, state):
+    req = spec.DepositRequest(
+        pubkey=pubkeys[len(state.validators)],
+        withdrawal_credentials=b"\x00" * 32,
+        amount=spec.MIN_ACTIVATION_BALANCE,
+        signature=b"\x00" * 96,
+        index=0,
+    )
+    pre_len = len(state.pending_deposits)
+    spec.process_deposit_request(state, req)
+    assert len(state.pending_deposits) == pre_len + 1
+
+
+@with_phases(ELECTRA_FORKS)
+@spec_state_test
+def test_deposit_request_sets_start_index(spec, state):
+    assert int(state.deposit_requests_start_index) == int(
+        spec.UNSET_DEPOSIT_REQUESTS_START_INDEX
+    )
+    req = spec.DepositRequest(
+        pubkey=pubkeys[0],
+        withdrawal_credentials=b"\x00" * 32,
+        amount=spec.MIN_ACTIVATION_BALANCE,
+        signature=b"\x00" * 96,
+        index=7,
+    )
+    spec.process_deposit_request(state, req)
+    assert int(state.deposit_requests_start_index) == 7
+
+
+@with_phases(ELECTRA_FORKS)
+@spec_state_test
+def test_deposit_request_topup_existing_validator(spec, state):
+    req = spec.DepositRequest(
+        pubkey=state.validators[0].pubkey,
+        withdrawal_credentials=b"\x00" * 32,
+        amount=1_000_000,
+        signature=b"\x00" * 96,
+        index=0,
+    )
+    pre_len = len(state.pending_deposits)
+    spec.process_deposit_request(state, req)
+    # top-ups also ride the pending queue post-electra
+    assert len(state.pending_deposits) == pre_len + 1
