@@ -1,0 +1,135 @@
+"""Sync-aggregate processing table, altair+ (reference analogue:
+test/altair/block_processing/sync_aggregate/ ~40 variants — rewards,
+participation shapes, signature validity)."""
+
+from eth_consensus_specs_tpu.test_infra.context import (
+    always_bls,
+    expect_assertion_error,
+    spec_state_test,
+    with_phases,
+)
+from eth_consensus_specs_tpu.test_infra.keys import pubkey_to_privkey
+from eth_consensus_specs_tpu.test_infra.state import next_slots
+from eth_consensus_specs_tpu.utils import bls
+
+SYNC_FORKS = ["altair", "bellatrix", "capella", "deneb", "electra", "fulu"]
+
+
+def _signed_aggregate(spec, state, bits):
+    prev_slot = int(state.slot) - 1
+    root = spec.get_block_root_at_slot(state, prev_slot)
+    domain = spec.get_domain(
+        state, spec.DOMAIN_SYNC_COMMITTEE, spec.compute_epoch_at_slot(prev_slot)
+    )
+    signing_root = spec.compute_signing_root(spec.Root(root), domain)
+    sigs = [
+        bls.Sign(pubkey_to_privkey(bytes(pk)), signing_root)
+        for pk, bit in zip(state.current_sync_committee.pubkeys, bits)
+        if bit
+    ]
+    agg = bls.Aggregate(sigs) if sigs else spec.BLSSignature(b"\xc0" + b"\x00" * 95)
+    return spec.SyncAggregate(sync_committee_bits=bits, sync_committee_signature=agg)
+
+
+@with_phases(SYNC_FORKS)
+@always_bls
+@spec_state_test
+def test_sync_full_participation_rewards_everyone(spec, state):
+    next_slots(spec, state, 1)
+    n = int(spec.SYNC_COMMITTEE_SIZE)
+    agg = _signed_aggregate(spec, state, [True] * n)
+    proposer = int(spec.get_beacon_proposer_index(state))
+    pre_proposer = int(state.balances[proposer])
+    spec.process_sync_aggregate(state, agg)
+    assert int(state.balances[proposer]) > pre_proposer
+
+
+@with_phases(SYNC_FORKS)
+@always_bls
+@spec_state_test
+def test_sync_half_participation(spec, state):
+    next_slots(spec, state, 1)
+    n = int(spec.SYNC_COMMITTEE_SIZE)
+    bits = [i % 2 == 0 for i in range(n)]
+    agg = _signed_aggregate(spec, state, bits)
+    spec.process_sync_aggregate(state, agg)
+
+
+@with_phases(SYNC_FORKS)
+@always_bls
+@spec_state_test
+def test_sync_nonparticipants_penalized(spec, state):
+    next_slots(spec, state, 1)
+    n = int(spec.SYNC_COMMITTEE_SIZE)
+    bits = [False] * n
+    bits[0] = True
+    agg = _signed_aggregate(spec, state, bits)
+    # a non-participating committee member loses balance
+    all_pubkeys = [bytes(v.pubkey) for v in state.validators]
+    missing_pk = bytes(state.current_sync_committee.pubkeys[1])
+    missing_idx = all_pubkeys.index(missing_pk)
+    pre = int(state.balances[missing_idx])
+    spec.process_sync_aggregate(state, agg)
+    assert int(state.balances[missing_idx]) < pre
+
+
+@with_phases(SYNC_FORKS)
+@always_bls
+@spec_state_test
+def test_sync_invalid_signature_rejected(spec, state):
+    next_slots(spec, state, 1)
+    n = int(spec.SYNC_COMMITTEE_SIZE)
+    agg = _signed_aggregate(spec, state, [True] * n)
+    agg.sync_committee_signature = bls.Sign(123456, b"\x42" * 32)
+    expect_assertion_error(lambda: spec.process_sync_aggregate(state, agg))
+
+
+@with_phases(SYNC_FORKS)
+@always_bls
+@spec_state_test
+def test_sync_invalid_extra_participant_claimed(spec, state):
+    next_slots(spec, state, 1)
+    n = int(spec.SYNC_COMMITTEE_SIZE)
+    bits = [False] * n
+    bits[0] = True
+    agg = _signed_aggregate(spec, state, bits)
+    agg.sync_committee_bits[1] = True  # claims a signer who didn't sign
+    expect_assertion_error(lambda: spec.process_sync_aggregate(state, agg))
+
+
+@with_phases(SYNC_FORKS)
+@always_bls
+@spec_state_test
+def test_sync_empty_participation_infinity_signature_ok(spec, state):
+    next_slots(spec, state, 1)
+    n = int(spec.SYNC_COMMITTEE_SIZE)
+    agg = _signed_aggregate(spec, state, [False] * n)
+    spec.process_sync_aggregate(state, agg)  # G2 infinity over empty set
+
+
+@with_phases(SYNC_FORKS)
+@always_bls
+@spec_state_test
+def test_sync_empty_participation_nonzero_signature_rejected(spec, state):
+    next_slots(spec, state, 1)
+    n = int(spec.SYNC_COMMITTEE_SIZE)
+    agg = _signed_aggregate(spec, state, [False] * n)
+    agg.sync_committee_signature = bls.Sign(99, b"\x01" * 32)
+    expect_assertion_error(lambda: spec.process_sync_aggregate(state, agg))
+
+
+@with_phases(SYNC_FORKS)
+@always_bls
+@spec_state_test
+def test_sync_rewards_conserved_modulo_proposer_cut(spec, state):
+    """Total balance delta equals proposer reward inflow minus
+    non-participant penalties (conservation sanity)."""
+    next_slots(spec, state, 1)
+    n = int(spec.SYNC_COMMITTEE_SIZE)
+    bits = [i % 3 != 0 for i in range(n)]
+    agg = _signed_aggregate(spec, state, bits)
+    pre_total = sum(int(b) for b in state.balances)
+    spec.process_sync_aggregate(state, agg)
+    post_total = sum(int(b) for b in state.balances)
+    # participant rewards + proposer cut are newly minted; penalties burn
+    assert post_total != pre_total or all(bits)

@@ -1,0 +1,98 @@
+"""PeerDAS unit tables — custody group math, column assignment bounds,
+matrix indexing, cell bound checks (reference analogue:
+test/fulu/unittests/das/test_das.py and networking custody tests; spec:
+specs/fulu/das-core.md:101-190)."""
+
+import pytest
+
+from eth_consensus_specs_tpu.test_infra.context import (
+    spec_state_test,
+    with_phases,
+)
+
+FULU = ["fulu", "gloas"]
+
+
+@with_phases(FULU)
+@spec_state_test
+def test_custody_groups_deterministic(spec, state):
+    node = 123456789
+    a = spec.get_custody_groups(node, 4)
+    b = spec.get_custody_groups(node, 4)
+    assert [int(g) for g in a] == [int(g) for g in b]
+
+
+@with_phases(FULU)
+@spec_state_test
+def test_custody_groups_sorted_unique(spec, state):
+    groups = [int(g) for g in spec.get_custody_groups(987654321, 6)]
+    assert groups == sorted(groups)
+    assert len(set(groups)) == len(groups)
+
+
+@with_phases(FULU)
+@spec_state_test
+def test_custody_groups_full_count_is_identity(spec, state):
+    n = int(spec.config.NUMBER_OF_CUSTODY_GROUPS)
+    groups = [int(g) for g in spec.get_custody_groups(42, n)]
+    assert groups == list(range(n))
+
+
+@with_phases(FULU)
+@spec_state_test
+def test_custody_groups_count_over_limit_rejected(spec, state):
+    n = int(spec.config.NUMBER_OF_CUSTODY_GROUPS)
+    with pytest.raises(AssertionError):
+        spec.get_custody_groups(42, n + 1)
+
+
+@with_phases(FULU)
+@spec_state_test
+def test_custody_groups_prefix_property(spec, state):
+    """A node's custody set grows monotonically with the count — the
+    first k groups of count k+1 contain the count-k set."""
+    node = 0xDEADBEEF
+    small = {int(g) for g in spec.get_custody_groups(node, 2)}
+    large = {int(g) for g in spec.get_custody_groups(node, 5)}
+    assert small <= large
+
+
+@with_phases(FULU)
+@spec_state_test
+def test_columns_for_custody_group_disjoint_cover(spec, state):
+    n = int(spec.config.NUMBER_OF_CUSTODY_GROUPS)
+    all_cols: list[int] = []
+    for g in range(n):
+        all_cols += [int(c) for c in spec.compute_columns_for_custody_group(g)]
+    assert sorted(all_cols) == list(range(int(spec.NUMBER_OF_COLUMNS)))
+
+
+@with_phases(FULU)
+@spec_state_test
+def test_columns_for_custody_group_out_of_range(spec, state):
+    n = int(spec.config.NUMBER_OF_CUSTODY_GROUPS)
+    with pytest.raises(AssertionError):
+        spec.compute_columns_for_custody_group(n)
+
+
+@with_phases(["fulu"])
+@spec_state_test
+def test_cell_coset_roundtrip(spec, state):
+    from .das_fixtures import sample_cells_and_proofs
+
+    cells, _ = sample_cells_and_proofs()
+    evals = spec.cell_to_coset_evals(cells[3])
+    back = spec.coset_evals_to_cell(evals)
+    assert bytes(back) == bytes(cells[3])
+
+
+@with_phases(["fulu"])
+@spec_state_test
+def test_recovery_needs_at_least_half_the_cells(spec, state):
+    from .das_fixtures import sample_cells_and_proofs
+
+    cells, _ = sample_cells_and_proofs()
+    half = int(spec.CELLS_PER_EXT_BLOB) // 2
+    idxs = list(range(half - 1))  # one short of the recovery threshold
+    with pytest.raises(AssertionError):
+        spec.recover_cells_and_kzg_proofs(idxs, [cells[i] for i in idxs])

@@ -1,0 +1,183 @@
+"""Additional per-sub-transition epoch tables across the fork matrix
+(reference analogue: test/<fork>/epoch_processing/ one-file-per-handler
+density — slashings windows, effective-balance hysteresis bands,
+justification bit patterns, participation resets)."""
+
+from eth_consensus_specs_tpu.test_infra.attestations import next_epoch_with_attestations
+from eth_consensus_specs_tpu.test_infra.context import (
+    spec_state_test,
+    with_all_phases,
+    with_phases,
+)
+from eth_consensus_specs_tpu.test_infra.epoch_processing import run_epoch_processing_to
+from eth_consensus_specs_tpu.test_infra.forks import is_post_altair
+from eth_consensus_specs_tpu.test_infra.state import next_epoch
+
+PRE_ALTAIR = ["phase0"]
+POST_ALTAIR = ["altair", "bellatrix", "capella", "deneb", "electra", "fulu", "gloas"]
+
+
+# == slashings sweep window =================================================
+
+
+@with_all_phases
+@spec_state_test
+def test_slashings_penalty_applied_at_window_midpoint(spec, state):
+    run_epoch_processing_to(spec, state, "process_slashings")
+    epoch = spec.get_current_epoch(state)
+    half = int(spec.EPOCHS_PER_SLASHINGS_VECTOR) // 2
+    # enough correlated slashings that the quotient doesn't round to zero
+    # even under phase0's multiplier of 1 (penalty floors at increments)
+    for idx in range(1, 9):
+        v = state.validators[idx]
+        v.slashed = True
+        v.withdrawable_epoch = epoch + half  # exactly in the penalty window
+        state.slashings[0] = int(state.slashings[0]) + int(v.effective_balance)
+    pre = int(state.balances[1])
+    spec.process_slashings(state)
+    assert int(state.balances[1]) < pre
+
+
+@with_all_phases
+@spec_state_test
+def test_slashings_no_penalty_outside_window(spec, state):
+    run_epoch_processing_to(spec, state, "process_slashings")
+    epoch = spec.get_current_epoch(state)
+    v = state.validators[1]
+    v.slashed = True
+    v.withdrawable_epoch = epoch + 100  # outside the window
+    state.slashings[0] = int(v.effective_balance)
+    pre = int(state.balances[1])
+    spec.process_slashings(state)
+    assert int(state.balances[1]) == pre
+
+
+@with_all_phases
+@spec_state_test
+def test_slashings_scale_with_total_slashed(spec, state):
+    run_epoch_processing_to(spec, state, "process_slashings")
+    epoch = spec.get_current_epoch(state)
+    half = int(spec.EPOCHS_PER_SLASHINGS_VECTOR) // 2
+    for idx in (1, 2, 3, 4):
+        v = state.validators[idx]
+        v.slashed = True
+        v.withdrawable_epoch = epoch + half
+        state.slashings[0] = int(state.slashings[0]) + int(v.effective_balance)
+    pre = int(state.balances[1])
+    spec.process_slashings(state)
+    # heavier total slashings => a real penalty for each
+    assert int(state.balances[1]) < pre
+
+
+# == effective-balance hysteresis ==========================================
+
+
+@with_all_phases
+@spec_state_test
+def test_hysteresis_no_update_within_band(spec, state):
+    run_epoch_processing_to(spec, state, "process_effective_balance_updates")
+    inc = int(spec.EFFECTIVE_BALANCE_INCREMENT)
+    # drop balance slightly: within the downward hysteresis band
+    state.balances[1] = int(state.validators[1].effective_balance) - inc // 4
+    pre = int(state.validators[1].effective_balance)
+    spec.process_effective_balance_updates(state)
+    assert int(state.validators[1].effective_balance) == pre
+
+
+@with_all_phases
+@spec_state_test
+def test_hysteresis_downward_update_past_band(spec, state):
+    run_epoch_processing_to(spec, state, "process_effective_balance_updates")
+    inc = int(spec.EFFECTIVE_BALANCE_INCREMENT)
+    state.balances[1] = int(state.validators[1].effective_balance) - 2 * inc
+    spec.process_effective_balance_updates(state)
+    assert int(state.validators[1].effective_balance) < int(spec.MAX_EFFECTIVE_BALANCE)
+
+
+@with_all_phases
+@spec_state_test
+def test_hysteresis_upward_needs_full_increment_plus_band(spec, state):
+    run_epoch_processing_to(spec, state, "process_effective_balance_updates")
+    inc = int(spec.EFFECTIVE_BALANCE_INCREMENT)
+    state.validators[1].effective_balance = int(spec.MAX_EFFECTIVE_BALANCE) - 2 * inc
+    state.balances[1] = int(spec.MAX_EFFECTIVE_BALANCE) - inc + inc // 2
+    spec.process_effective_balance_updates(state)
+    # rose by one increment (not to the unrounded balance)
+    assert (
+        int(state.validators[1].effective_balance) == int(spec.MAX_EFFECTIVE_BALANCE) - inc
+    )
+
+
+# == justification bit patterns ============================================
+
+
+@with_all_phases
+@spec_state_test
+def test_justification_both_epochs_justify_and_finalize(spec, state):
+    next_epoch(spec, state)
+    _, _, state2 = next_epoch_with_attestations(spec, state, True, True)
+    _, _, state3 = next_epoch_with_attestations(spec, state2, True, True)
+    _, _, state4 = next_epoch_with_attestations(spec, state3, True, True)
+    assert int(state4.finalized_checkpoint.epoch) > 0
+
+
+@with_all_phases
+@spec_state_test
+def test_justification_without_supermajority_stalls(spec, state):
+    next_epoch(spec, state)
+    next_epoch(spec, state)  # empty epochs: no attestations at all
+    next_epoch(spec, state)
+    assert int(state.current_justified_checkpoint.epoch) == 0
+    assert int(state.finalized_checkpoint.epoch) == 0
+
+
+# == participation / pending-attestation resets ============================
+
+
+@with_phases(POST_ALTAIR)
+@spec_state_test
+def test_participation_rotates_at_epoch(spec, state):
+    next_epoch(spec, state)
+    for i in range(4):
+        state.current_epoch_participation[i] = 0b0000_0111
+    boundary = int(state.slot) + (
+        spec.SLOTS_PER_EPOCH - int(state.slot) % spec.SLOTS_PER_EPOCH
+    )
+    spec.process_slots(state, boundary)
+    assert int(state.previous_epoch_participation[0]) == 0b0000_0111
+    assert int(state.current_epoch_participation[0]) == 0
+
+
+@with_phases(PRE_ALTAIR)
+@spec_state_test
+def test_pending_attestations_rotate_at_epoch(spec, state):
+    next_epoch(spec, state)
+    _, _, state2 = next_epoch_with_attestations(spec, state, True, False)
+    assert len(state2.previous_epoch_attestations) > 0
+    assert len(state2.current_epoch_attestations) == 0
+
+
+# == inactivity scores (altair+) ===========================================
+
+
+@with_phases(POST_ALTAIR)
+@spec_state_test
+def test_inactivity_scores_rise_in_leak(spec, state):
+    for _ in range(int(spec.MIN_EPOCHS_TO_INACTIVITY_PENALTY) + 2):
+        next_epoch(spec, state)
+    run_epoch_processing_to(spec, state, "process_inactivity_updates")
+    pre = [int(s) for s in state.inactivity_scores[:8]]
+    spec.process_inactivity_updates(state)
+    post = [int(s) for s in state.inactivity_scores[:8]]
+    assert any(b > a for a, b in zip(pre, post))
+
+
+@with_phases(POST_ALTAIR)
+@spec_state_test
+def test_inactivity_scores_decay_when_finalizing(spec, state):
+    next_epoch(spec, state)
+    for i in range(len(state.inactivity_scores)):
+        state.inactivity_scores[i] = 8
+    _, _, state2 = next_epoch_with_attestations(spec, state, True, True)
+    _, _, state3 = next_epoch_with_attestations(spec, state2, True, True)
+    assert any(int(s) < 8 for s in state3.inactivity_scores)

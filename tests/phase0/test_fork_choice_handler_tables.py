@@ -1,0 +1,144 @@
+"""Fork-choice handler tables: on_attestation / on_attester_slashing /
+on_block edge validation (reference analogue:
+test/phase0/fork_choice/test_on_attestation.py ~20 variants,
+test_on_attester_slashing.py; spec: specs/phase0/fork-choice.md)."""
+
+from eth_consensus_specs_tpu.ssz import hash_tree_root
+from eth_consensus_specs_tpu.test_infra.attestations import get_valid_attestation
+from eth_consensus_specs_tpu.test_infra.block import (
+    build_empty_block_for_next_slot,
+    state_transition_and_sign_block,
+)
+from eth_consensus_specs_tpu.test_infra.context import (
+    expect_assertion_error,
+    spec_state_test,
+    with_phases,
+)
+from eth_consensus_specs_tpu.test_infra.slashings import get_valid_attester_slashing
+from eth_consensus_specs_tpu.test_infra.state import next_slots
+
+FC_FORKS = ["phase0", "altair", "deneb", "electra"]
+
+
+def _store_with_block(spec, state):
+    anchor = spec.BeaconBlock(state_root=hash_tree_root(state))
+    store = spec.get_forkchoice_store(state.copy(), anchor)
+    t = int(store.genesis_time) + (int(state.slot) + 1) * int(spec.config.SECONDS_PER_SLOT)
+    spec.on_tick(store, t)
+    block = build_empty_block_for_next_slot(spec, state)
+    signed = state_transition_and_sign_block(spec, state, block)
+    spec.on_block(store, signed)
+    return store, signed
+
+
+def _tick_to(spec, store, state, slot):
+    t = int(store.genesis_time) + int(slot) * int(spec.config.SECONDS_PER_SLOT)
+    spec.on_tick(store, t)
+
+
+@with_phases(FC_FORKS)
+@spec_state_test
+def test_on_attestation_updates_latest_messages(spec, state):
+    store, _ = _store_with_block(spec, state)
+    att = get_valid_attestation(spec, state, signed=True)
+    _tick_to(spec, store, state, int(att.data.slot) + 2)
+    spec.on_attestation(store, att)
+    assert len(store.latest_messages) > 0
+
+
+@with_phases(FC_FORKS)
+@spec_state_test
+def test_on_attestation_same_slot_rejected(spec, state):
+    """An attestation for the current slot is too new (must wait a slot)."""
+    store, _ = _store_with_block(spec, state)
+    att = get_valid_attestation(spec, state, signed=True)
+    _tick_to(spec, store, state, int(att.data.slot))
+    expect_assertion_error(lambda: spec.on_attestation(store, att))
+
+
+@with_phases(FC_FORKS)
+@spec_state_test
+def test_on_attestation_unknown_beacon_block_rejected(spec, state):
+    store, _ = _store_with_block(spec, state)
+    att = get_valid_attestation(spec, state, signed=True)
+    att.data.beacon_block_root = b"\x99" * 32
+    _tick_to(spec, store, state, int(att.data.slot) + 2)
+    expect_assertion_error(lambda: spec.on_attestation(store, att))
+
+
+@with_phases(FC_FORKS)
+@spec_state_test
+def test_on_attestation_future_target_epoch_rejected(spec, state):
+    store, _ = _store_with_block(spec, state)
+    att = get_valid_attestation(spec, state, signed=True)
+    att.data.target.epoch = spec.get_current_epoch(state) + 1
+    _tick_to(spec, store, state, int(att.data.slot) + 2)
+    expect_assertion_error(lambda: spec.on_attestation(store, att))
+
+
+@with_phases(FC_FORKS)
+@spec_state_test
+def test_on_attestation_from_block_skips_time_checks(spec, state):
+    """is_from_block relaxes the one-slot-delay gossip rule."""
+    store, _ = _store_with_block(spec, state)
+    att = get_valid_attestation(spec, state, signed=True)
+    _tick_to(spec, store, state, int(att.data.slot) + 1)
+    spec.on_attestation(store, att, is_from_block=True)
+
+
+@with_phases(FC_FORKS)
+@spec_state_test
+def test_on_attester_slashing_marks_equivocators(spec, state):
+    store, _ = _store_with_block(spec, state)
+    slashing = get_valid_attester_slashing(spec, state, signed_1=True, signed_2=True)
+    spec.on_attester_slashing(store, slashing)
+    expected = set(int(i) for i in slashing.attestation_1.attesting_indices) & set(
+        int(i) for i in slashing.attestation_2.attesting_indices
+    )
+    assert expected and expected <= set(int(i) for i in store.equivocating_indices)
+
+
+@with_phases(FC_FORKS)
+@spec_state_test
+def test_equivocators_excluded_from_head_weight(spec, state):
+    store, signed = _store_with_block(spec, state)
+    att = get_valid_attestation(spec, state, signed=True)
+    _tick_to(spec, store, state, int(att.data.slot) + 2)
+    spec.on_attestation(store, att)
+    # mark all attesters as equivocating: weight contribution must vanish
+    for idx in list(store.latest_messages):
+        store.equivocating_indices.add(int(idx))
+    head = spec.get_head_root(store)
+    assert head is not None  # head still computable with zero weights
+
+
+@with_phases(FC_FORKS)
+@spec_state_test
+def test_on_block_future_slot_rejected(spec, state):
+    anchor = spec.BeaconBlock(state_root=hash_tree_root(state))
+    store = spec.get_forkchoice_store(state.copy(), anchor)
+    # do NOT tick: store.time stays at genesis while the block is for slot+1
+    block = build_empty_block_for_next_slot(spec, state)
+    signed = state_transition_and_sign_block(spec, state, block)
+    expect_assertion_error(lambda: spec.on_block(store, signed))
+
+
+@with_phases(FC_FORKS)
+@spec_state_test
+def test_on_block_unknown_parent_rejected(spec, state):
+    store, _ = _store_with_block(spec, state)
+    _tick_to(spec, store, state, int(state.slot) + 1)
+    block = build_empty_block_for_next_slot(spec, state)
+    block.parent_root = b"\x13" * 32
+    signed = spec.SignedBeaconBlock(message=block)
+    expect_assertion_error(lambda: spec.on_block(store, signed))
+
+
+@with_phases(FC_FORKS)
+@spec_state_test
+def test_proposer_boost_set_for_timely_block(spec, state):
+    store, signed = _store_with_block(spec, state)
+    # the timely on_block above (tick exactly at slot start) boosts
+    assert bytes(store.proposer_boost_root) == bytes(
+        hash_tree_root(signed.message)
+    )
