@@ -5,6 +5,11 @@ hash_tree_root, so every mutated field (balances, effective balances,
 inactivity scores, justification, participation rotation, sync-committee
 resampling) is covered."""
 
+import pytest
+
+# device epoch kernel compiles — nightly lane (make test-full)
+pytestmark = pytest.mark.slow
+
 from eth_consensus_specs_tpu.ssz import hash_tree_root
 from eth_consensus_specs_tpu.test_infra.attestations import next_epoch_with_attestations
 from eth_consensus_specs_tpu.test_infra.context import spec_state_test, with_phases

@@ -6,6 +6,11 @@ Timing note: with the genesis guard (`current_epoch <= GENESIS_EPOCH + 1`
 skips justification processing), the first two transitions evaluate
 nothing; epochs 1 and 2 justify together at the 2->3 transition."""
 
+import pytest
+
+# multi-epoch finality walks — nightly lane (make test-full)
+pytestmark = pytest.mark.slow
+
 from eth_consensus_specs_tpu.test_infra.attestations import next_epoch_with_attestations
 from eth_consensus_specs_tpu.test_infra.context import spec_state_test, with_all_phases
 from eth_consensus_specs_tpu.test_infra.state import next_epoch

@@ -6,6 +6,9 @@ import random
 
 import pytest
 
+# pure-python cell proofs/verification — nightly lane (make test-full)
+pytestmark = pytest.mark.slow
+
 from eth_consensus_specs_tpu.crypto import das, kzg
 
 from .das_fixtures import sample_blob, sample_cells_and_proofs, sample_commitment

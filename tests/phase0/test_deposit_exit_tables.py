@@ -1,0 +1,181 @@
+"""Deposit and voluntary-exit mutation tables, all forks (reference
+analogue: test/phase0/block_processing/test_process_deposit.py ~20
+variants and test_process_voluntary_exit.py ~15 variants)."""
+
+from eth_consensus_specs_tpu import ssz
+from eth_consensus_specs_tpu.test_infra.context import (
+    always_bls,
+    expect_assertion_error,
+    spec_state_test,
+    with_all_phases,
+)
+from eth_consensus_specs_tpu.test_infra.deposits import (
+    prepare_state_and_deposit,
+    run_deposit_processing,
+)
+from eth_consensus_specs_tpu.test_infra.keys import privkeys, pubkeys
+from eth_consensus_specs_tpu.test_infra.state import next_slots
+from eth_consensus_specs_tpu.test_infra.voluntary_exits import prepare_signed_exits
+from eth_consensus_specs_tpu.utils import bls
+
+
+# == deposits ==============================================================
+
+
+@with_all_phases
+@spec_state_test
+def test_deposit_max_effective_cap(spec, state):
+    index = len(state.validators)
+    amount = int(spec.MAX_EFFECTIVE_BALANCE) * 2
+    deposit = prepare_state_and_deposit(spec, state, index, amount, signed=True)
+    yield from run_deposit_processing(spec, state, deposit, index)
+    from eth_consensus_specs_tpu.test_infra.forks import is_post_electra
+
+    if is_post_electra(spec):
+        # electra defers crediting through the pending-deposit queue
+        assert any(int(p.amount) == amount for p in state.pending_deposits)
+    else:
+        # balance records the full amount; effective balance caps
+        assert int(state.balances[index]) == amount
+
+
+@with_all_phases
+@spec_state_test
+def test_deposit_minimal_amount_new_validator(spec, state):
+    index = len(state.validators)
+    amount = int(spec.config.EJECTION_BALANCE) // 2
+    deposit = prepare_state_and_deposit(spec, state, index, amount, signed=True)
+    yield from run_deposit_processing(spec, state, deposit, index)
+
+
+@with_all_phases
+@always_bls
+@spec_state_test
+def test_deposit_invalid_signature_new_validator_ignored(spec, state):
+    """A bad proof-of-possession does NOT fail the block — the deposit is
+    simply skipped for a NEW validator (fail-open is consensus here)."""
+    index = len(state.validators)
+    amount = int(spec.MAX_EFFECTIVE_BALANCE)
+    deposit = prepare_state_and_deposit(spec, state, index, amount, signed=False)
+    pre_count = len(state.validators)
+    spec.process_deposit(state, deposit)
+    assert len(state.validators) == pre_count  # not onboarded, no assert
+
+
+@with_all_phases
+@always_bls
+@spec_state_test
+def test_deposit_topup_needs_no_signature(spec, state):
+    index = 5
+    amount = 1_000_000
+    deposit = prepare_state_and_deposit(spec, state, index, amount, signed=False)
+    pre = int(state.balances[index])
+    spec.process_deposit(state, deposit)
+    from eth_consensus_specs_tpu.test_infra.forks import is_post_electra
+
+    if is_post_electra(spec):
+        # electra routes top-ups through the pending queue
+        assert any(
+            int(p.amount) == amount for p in state.pending_deposits
+        )
+    else:
+        assert int(state.balances[index]) == pre + amount
+
+
+@with_all_phases
+@spec_state_test
+def test_deposit_invalid_merkle_proof_wrong_leaf(spec, state):
+    index = len(state.validators)
+    deposit = prepare_state_and_deposit(
+        spec, state, index, int(spec.MAX_EFFECTIVE_BALANCE), signed=True
+    )
+    deposit.data.amount = int(deposit.data.amount) + 1  # breaks the leaf
+    expect_assertion_error(lambda: spec.process_deposit(state, deposit))
+
+
+@with_all_phases
+@spec_state_test
+def test_deposit_invalid_eth1_index_mismatch(spec, state):
+    index = len(state.validators)
+    deposit = prepare_state_and_deposit(
+        spec, state, index, int(spec.MAX_EFFECTIVE_BALANCE), signed=True
+    )
+    state.eth1_deposit_index = int(state.eth1_deposit_index) + 1
+    expect_assertion_error(lambda: spec.process_deposit(state, deposit))
+
+
+# == voluntary exits =======================================================
+
+
+def _matured(spec, state):
+    next_slots(
+        spec, state, int(spec.config.SHARD_COMMITTEE_PERIOD) * int(spec.SLOTS_PER_EPOCH)
+    )
+
+
+@with_all_phases
+@spec_state_test
+def test_exit_sets_withdrawable_delay(spec, state):
+    _matured(spec, state)
+    (signed,) = prepare_signed_exits(spec, state, [2])
+    spec.process_voluntary_exit(state, signed)
+    v = state.validators[2]
+    assert int(v.withdrawable_epoch) == int(v.exit_epoch) + int(
+        spec.config.MIN_VALIDATOR_WITHDRAWABILITY_DELAY
+    )
+
+
+@with_all_phases
+@spec_state_test
+def test_exit_queue_fills_in_order(spec, state):
+    _matured(spec, state)
+    exits = prepare_signed_exits(spec, state, [2, 3, 4])
+    for signed in exits:
+        spec.process_voluntary_exit(state, signed)
+    epochs = [int(state.validators[i].exit_epoch) for i in (2, 3, 4)]
+    assert epochs == sorted(epochs)
+
+
+@with_all_phases
+@spec_state_test
+def test_exit_invalid_future_epoch(spec, state):
+    from eth_consensus_specs_tpu.test_infra.voluntary_exits import sign_voluntary_exit
+
+    _matured(spec, state)
+    exit_msg = spec.VoluntaryExit(
+        epoch=spec.get_current_epoch(state) + 5, validator_index=2
+    )
+    signed = sign_voluntary_exit(spec, state, exit_msg, privkeys[2])
+    expect_assertion_error(lambda: spec.process_voluntary_exit(state, signed))
+
+
+@with_all_phases
+@spec_state_test
+def test_exit_invalid_not_active(spec, state):
+    _matured(spec, state)
+    state.validators[2].activation_epoch = spec.get_current_epoch(state) + 10
+    (signed,) = prepare_signed_exits(spec, state, [2])
+    expect_assertion_error(lambda: spec.process_voluntary_exit(state, signed))
+
+
+@with_all_phases
+@always_bls
+@spec_state_test
+def test_exit_invalid_signature_wrong_key(spec, state):
+    _matured(spec, state)
+    (signed,) = prepare_signed_exits(spec, state, [2])
+    exit_msg = signed.message
+    domain = spec.get_domain(state, spec.DOMAIN_VOLUNTARY_EXIT, exit_msg.epoch)
+    signed.signature = bls.Sign(
+        privkeys[7], spec.compute_signing_root(exit_msg, domain)
+    )
+    expect_assertion_error(lambda: spec.process_voluntary_exit(state, signed))
+
+
+@with_all_phases
+@spec_state_test
+def test_exit_invalid_duplicate(spec, state):
+    _matured(spec, state)
+    (signed,) = prepare_signed_exits(spec, state, [2])
+    spec.process_voluntary_exit(state, signed)
+    expect_assertion_error(lambda: spec.process_voluntary_exit(state, signed))

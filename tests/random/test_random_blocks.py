@@ -4,6 +4,11 @@ transition stays consistent and deterministic
 (reference: eth2spec/test/utils/randomized_block_tests.py + the per-fork
 random/ suites)."""
 
+import pytest
+
+# randomized multi-epoch chains — nightly lane (make test-full)
+pytestmark = pytest.mark.slow
+
 import random
 
 from eth_consensus_specs_tpu.ssz import hash_tree_root

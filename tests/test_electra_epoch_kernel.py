@@ -3,6 +3,11 @@ and the per-validator MaxEB ceiling
 (reference: specs/electra/beacon-chain.md:893-920 process_slashings,
 :921-941 process_effective_balance_updates)."""
 
+import pytest
+
+# device epoch kernel compiles — nightly lane (make test-full)
+pytestmark = pytest.mark.slow
+
 import numpy as np
 
 from eth_consensus_specs_tpu.forks import get_spec

@@ -1,6 +1,11 @@
 """Model-based fork-choice compliance scenarios
 (reference: tests/generators/compliance_runners/fork_choice/)."""
 
+import pytest
+
+# fork-choice compliance enumeration — nightly lane (make test-full)
+pytestmark = pytest.mark.slow
+
 import random
 
 from eth_consensus_specs_tpu.gen.compliance import (

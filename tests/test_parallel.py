@@ -7,6 +7,9 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 import pytest
+
+# 8-virtual-device mesh compiles — nightly lane (make test-full)
+pytestmark = pytest.mark.slow
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from eth_consensus_specs_tpu.forks import get_spec

@@ -4,6 +4,9 @@ import jax
 import numpy as np
 import pytest
 
+# device resident-loop compiles — nightly lane (make test-full)
+pytestmark = pytest.mark.slow
+
 from eth_consensus_specs_tpu.forks import get_spec
 from eth_consensus_specs_tpu.parallel import resident
 from eth_consensus_specs_tpu.test_infra.genesis import create_genesis_state
