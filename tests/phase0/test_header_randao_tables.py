@@ -1,0 +1,153 @@
+"""Block-header / randao / eth1-data mutation tables, all forks
+(reference analogue: test/phase0/block_processing/
+test_process_block_header.py ~10 variants, test_process_randao.py,
+test_process_eth1_data.py)."""
+
+from eth_consensus_specs_tpu.ssz import hash_tree_root
+from eth_consensus_specs_tpu.test_infra.block import build_empty_block_for_next_slot
+from eth_consensus_specs_tpu.test_infra.context import (
+    always_bls,
+    expect_assertion_error,
+    spec_state_test,
+    with_all_phases,
+)
+from eth_consensus_specs_tpu.test_infra.keys import privkey_of
+from eth_consensus_specs_tpu.utils import bls
+
+
+def _ready_block(spec, state):
+    block = build_empty_block_for_next_slot(spec, state)
+    spec.process_slots(state, int(block.slot))
+    return block
+
+
+# == process_block_header ==================================================
+
+
+@with_all_phases
+@spec_state_test
+def test_header_invalid_slot_mismatch(spec, state):
+    block = _ready_block(spec, state)
+    block.slot = int(block.slot) + 1
+    expect_assertion_error(lambda: spec.process_block_header(state, block))
+
+
+@with_all_phases
+@spec_state_test
+def test_header_invalid_wrong_proposer(spec, state):
+    block = _ready_block(spec, state)
+    block.proposer_index = (int(block.proposer_index) + 3) % len(state.validators)
+    expect_assertion_error(lambda: spec.process_block_header(state, block))
+
+
+@with_all_phases
+@spec_state_test
+def test_header_invalid_parent_root(spec, state):
+    block = _ready_block(spec, state)
+    block.parent_root = b"\x29" * 32
+    expect_assertion_error(lambda: spec.process_block_header(state, block))
+
+
+@with_all_phases
+@spec_state_test
+def test_header_invalid_slot_not_newer_than_latest(spec, state):
+    block = _ready_block(spec, state)
+    spec.process_block_header(state, block)
+    # a second block for the SAME slot must fail the "newer" check
+    dup = block.copy()
+    dup.parent_root = hash_tree_root(state.latest_block_header)
+    expect_assertion_error(lambda: spec.process_block_header(state, dup))
+
+
+@with_all_phases
+@spec_state_test
+def test_header_invalid_proposer_slashed(spec, state):
+    block = _ready_block(spec, state)
+    state.validators[int(block.proposer_index)].slashed = True
+    expect_assertion_error(lambda: spec.process_block_header(state, block))
+
+
+@with_all_phases
+@spec_state_test
+def test_header_records_body_root(spec, state):
+    block = _ready_block(spec, state)
+    spec.process_block_header(state, block)
+    assert bytes(state.latest_block_header.body_root) == bytes(
+        hash_tree_root(block.body)
+    )
+    assert bytes(state.latest_block_header.state_root) == b"\x00" * 32
+
+
+# == process_randao ========================================================
+
+
+def _signed_reveal(spec, state, privkey=None, epoch=None):
+    proposer = int(spec.get_beacon_proposer_index(state))
+    epoch = spec.get_current_epoch(state) if epoch is None else epoch
+    privkey = privkey_of(proposer) if privkey is None else privkey
+    domain = spec.get_domain(state, spec.DOMAIN_RANDAO, epoch)
+    return bls.Sign(privkey, spec.compute_signing_root(spec.Epoch(epoch), domain))
+
+
+@with_all_phases
+@always_bls
+@spec_state_test
+def test_randao_updates_mix(spec, state):
+    block = _ready_block(spec, state)
+    block.body.randao_reveal = _signed_reveal(spec, state)
+    epoch = spec.get_current_epoch(state)
+    pre_mix = bytes(spec.get_randao_mix(state, epoch))
+    spec.process_randao(state, block.body)
+    assert bytes(spec.get_randao_mix(state, epoch)) != pre_mix
+
+
+@with_all_phases
+@always_bls
+@spec_state_test
+def test_randao_invalid_wrong_key(spec, state):
+    block = _ready_block(spec, state)
+    proposer = int(spec.get_beacon_proposer_index(state))
+    block.body.randao_reveal = _signed_reveal(
+        spec, state, privkey=privkey_of(proposer + 1)
+    )
+    expect_assertion_error(lambda: spec.process_randao(state, block.body))
+
+
+@with_all_phases
+@always_bls
+@spec_state_test
+def test_randao_invalid_wrong_epoch_signed(spec, state):
+    block = _ready_block(spec, state)
+    block.body.randao_reveal = _signed_reveal(
+        spec, state, epoch=spec.get_current_epoch(state) + 1
+    )
+    expect_assertion_error(lambda: spec.process_randao(state, block.body))
+
+
+# == process_eth1_data =====================================================
+
+
+@with_all_phases
+@spec_state_test
+def test_eth1_vote_accumulates(spec, state):
+    block = _ready_block(spec, state)
+    pre = len(state.eth1_data_votes)
+    spec.process_eth1_data(state, block.body)
+    assert len(state.eth1_data_votes) == pre + 1
+
+
+@with_all_phases
+@spec_state_test
+def test_eth1_majority_adopts_data(spec, state):
+    block = _ready_block(spec, state)
+    new_data = spec.Eth1Data(
+        deposit_root=b"\x77" * 32,
+        deposit_count=int(state.eth1_data.deposit_count),
+        block_hash=b"\x88" * 32,
+    )
+    block.body.eth1_data = new_data
+    period_slots = int(spec.EPOCHS_PER_ETH1_VOTING_PERIOD) * int(spec.SLOTS_PER_EPOCH)
+    needed = period_slots // 2 + 1  # votes*2 > period_slots
+    for _ in range(needed):
+        spec.process_eth1_data(state, block.body)
+    assert bytes(state.eth1_data.block_hash) == b"\x88" * 32

@@ -1,0 +1,179 @@
+"""Proposer/attester-slashing mutation tables, all forks (reference
+analogue: test/phase0/block_processing/test_process_proposer_slashing.py
+~15 variants and test_process_attester_slashing.py ~20 variants)."""
+
+from eth_consensus_specs_tpu.test_infra.context import (
+    always_bls,
+    expect_assertion_error,
+    spec_state_test,
+    with_all_phases,
+)
+from eth_consensus_specs_tpu.test_infra.keys import privkey_of
+from eth_consensus_specs_tpu.test_infra.slashings import (
+    get_valid_attester_slashing,
+    get_valid_proposer_slashing,
+)
+from eth_consensus_specs_tpu.test_infra.state import next_epoch
+from eth_consensus_specs_tpu.utils import bls
+
+
+# == proposer slashings ====================================================
+
+
+@with_all_phases
+@spec_state_test
+def test_proposer_invalid_different_slots(spec, state):
+    s = get_valid_proposer_slashing(spec, state, signed_1=True, signed_2=True)
+    s.signed_header_2.message.slot = int(s.signed_header_1.message.slot) + 1
+    expect_assertion_error(lambda: spec.process_proposer_slashing(state, s))
+
+
+@with_all_phases
+@spec_state_test
+def test_proposer_invalid_different_proposers(spec, state):
+    s = get_valid_proposer_slashing(spec, state, signed_1=True, signed_2=True)
+    s.signed_header_2.message.proposer_index = (
+        int(s.signed_header_1.message.proposer_index) + 1
+    )
+    expect_assertion_error(lambda: spec.process_proposer_slashing(state, s))
+
+
+@with_all_phases
+@spec_state_test
+def test_proposer_invalid_already_slashed(spec, state):
+    s = get_valid_proposer_slashing(spec, state, signed_1=True, signed_2=True)
+    idx = int(s.signed_header_1.message.proposer_index)
+    state.validators[idx].slashed = True
+    expect_assertion_error(lambda: spec.process_proposer_slashing(state, s))
+
+
+@with_all_phases
+@spec_state_test
+def test_proposer_invalid_withdrawn_proposer(spec, state):
+    s = get_valid_proposer_slashing(spec, state, signed_1=True, signed_2=True)
+    idx = int(s.signed_header_1.message.proposer_index)
+    state.validators[idx].withdrawable_epoch = spec.get_current_epoch(state)
+    expect_assertion_error(lambda: spec.process_proposer_slashing(state, s))
+
+
+@with_all_phases
+@spec_state_test
+def test_proposer_invalid_unknown_index(spec, state):
+    s = get_valid_proposer_slashing(spec, state, signed_1=True, signed_2=True)
+    big = len(state.validators) + 9
+    s.signed_header_1.message.proposer_index = big
+    s.signed_header_2.message.proposer_index = big
+    expect_assertion_error(lambda: spec.process_proposer_slashing(state, s))
+
+
+@with_all_phases
+@always_bls
+@spec_state_test
+def test_proposer_invalid_sig_1(spec, state):
+    s = get_valid_proposer_slashing(spec, state, signed_1=True, signed_2=True)
+    idx = int(s.signed_header_1.message.proposer_index)
+    domain = spec.get_domain(
+        state,
+        spec.DOMAIN_BEACON_PROPOSER,
+        spec.compute_epoch_at_slot(int(s.signed_header_1.message.slot)),
+    )
+    s.signed_header_1.signature = bls.Sign(
+        privkey_of(idx + 1),
+        spec.compute_signing_root(s.signed_header_1.message, domain),
+    )
+    expect_assertion_error(lambda: spec.process_proposer_slashing(state, s))
+
+
+@with_all_phases
+@spec_state_test
+def test_proposer_slashing_proposer_rewarded(spec, state):
+    s = get_valid_proposer_slashing(spec, state, signed_1=True, signed_2=True)
+    whistleblower = int(spec.get_beacon_proposer_index(state))
+    pre = int(state.balances[whistleblower])
+    spec.process_proposer_slashing(state, s)
+    slashed_idx = int(s.signed_header_1.message.proposer_index)
+    if whistleblower != slashed_idx:
+        assert int(state.balances[whistleblower]) > pre
+
+
+# == attester slashings ====================================================
+
+
+@with_all_phases
+@spec_state_test
+def test_attester_invalid_not_slashable_same_data(spec, state):
+    s = get_valid_attester_slashing(spec, state, signed_1=True, signed_2=True)
+    s.attestation_2 = s.attestation_1.copy()
+    expect_assertion_error(lambda: spec.process_attester_slashing(state, s))
+
+
+@with_all_phases
+@spec_state_test
+def test_attester_invalid_unsorted_indices(spec, state):
+    s = get_valid_attester_slashing(spec, state, signed_1=True, signed_2=True)
+    idxs = [int(i) for i in s.attestation_1.attesting_indices]
+    if len(idxs) < 2:
+        return
+    idxs[0], idxs[1] = idxs[1], idxs[0]
+    s.attestation_1.attesting_indices = type(s.attestation_1.attesting_indices)(idxs)
+    expect_assertion_error(lambda: spec.process_attester_slashing(state, s))
+
+
+@with_all_phases
+@spec_state_test
+def test_attester_invalid_duplicate_indices(spec, state):
+    s = get_valid_attester_slashing(spec, state, signed_1=True, signed_2=True)
+    idxs = [int(i) for i in s.attestation_1.attesting_indices]
+    if not idxs:
+        return
+    dup = sorted(idxs + [idxs[0]])
+    s.attestation_1.attesting_indices = type(s.attestation_1.attesting_indices)(dup)
+    expect_assertion_error(lambda: spec.process_attester_slashing(state, s))
+
+
+@with_all_phases
+@spec_state_test
+def test_attester_surround_vote_is_slashable(spec, state):
+    next_epoch(spec, state)
+    s = get_valid_attester_slashing(spec, state, signed_1=True, signed_2=True)
+    a1, a2 = s.attestation_1.data, s.attestation_2.data
+    # craft a surround: source(a1) < source(a2) and target(a1) > target(a2)
+    a1.source.epoch = 0
+    a1.target.epoch = spec.get_current_epoch(state)
+    a2.source.epoch = int(a1.source.epoch) + 1
+    a2.target.epoch = int(a1.target.epoch) - 1
+    assert spec.is_slashable_attestation_data(a1, a2)
+
+
+@with_all_phases
+@spec_state_test
+def test_attester_double_vote_is_slashable(spec, state):
+    s = get_valid_attester_slashing(spec, state, signed_1=True, signed_2=True)
+    assert spec.is_slashable_attestation_data(
+        s.attestation_1.data, s.attestation_2.data
+    )
+
+
+@with_all_phases
+@spec_state_test
+def test_attester_same_data_not_slashable(spec, state):
+    s = get_valid_attester_slashing(spec, state, signed_1=True, signed_2=True)
+    assert not spec.is_slashable_attestation_data(
+        s.attestation_1.data, s.attestation_1.data
+    )
+
+
+@with_all_phases
+@spec_state_test
+def test_attester_slashing_decreases_balances(spec, state):
+    s = get_valid_attester_slashing(spec, state, signed_1=True, signed_2=True)
+    common = set(int(i) for i in s.attestation_1.attesting_indices) & set(
+        int(i) for i in s.attestation_2.attesting_indices
+    )
+    proposer = int(spec.get_beacon_proposer_index(state))
+    pre = {i: int(state.balances[i]) for i in common}
+    spec.process_attester_slashing(state, s)
+    for i in common:
+        if i != proposer:  # the proposer also collects whistleblower cuts
+            assert int(state.balances[i]) < pre[i]
+        assert state.validators[i].slashed

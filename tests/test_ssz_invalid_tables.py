@@ -1,0 +1,153 @@
+"""SSZ deserialization invalid tables (reference analogue: the
+ssz_generic `invalid/` vector classes — truncated/padded/overlong
+encodings, bad offsets, non-canonical bitlists; spec:
+ssz/simple-serialize.md)."""
+
+import pytest
+
+from eth_consensus_specs_tpu import ssz
+
+
+class Simple(ssz.Container):
+    a: ssz.uint64
+    b: ssz.uint32
+
+
+class WithList(ssz.Container):
+    a: ssz.uint8
+    items: ssz.List[ssz.uint64, 8]
+
+
+class WithBits(ssz.Container):
+    bits: ssz.Bitlist[16]
+
+
+def _de(typ, data: bytes):
+    return ssz.deserialize(typ, data)
+
+
+# == fixed-size shapes =====================================================
+
+
+def test_uint64_exact_size_required():
+    assert int(_de(ssz.uint64, (7).to_bytes(8, "little"))) == 7
+    with pytest.raises(Exception):
+        _de(ssz.uint64, b"\x01" * 7)
+    with pytest.raises(Exception):
+        _de(ssz.uint64, b"\x01" * 9)
+
+
+def test_boolean_canonical_bytes_only():
+    assert bool(_de(ssz.boolean, b"\x00")) is False
+    assert bool(_de(ssz.boolean, b"\x01")) is True
+    with pytest.raises(Exception):
+        _de(ssz.boolean, b"\x02")
+
+
+def test_fixed_container_truncated():
+    good = ssz.serialize(Simple(a=ssz.uint64(1), b=ssz.uint32(2)))
+    with pytest.raises(Exception):
+        _de(Simple, bytes(good)[:-1])
+
+
+def test_fixed_container_trailing_garbage():
+    good = ssz.serialize(Simple(a=ssz.uint64(1), b=ssz.uint32(2)))
+    with pytest.raises(Exception):
+        _de(Simple, bytes(good) + b"\x00")
+
+
+def test_bytes32_roundtrip_and_size():
+    v = ssz.Bytes32(b"\x11" * 32)
+    assert bytes(_de(ssz.Bytes32, ssz.serialize(v))) == b"\x11" * 32
+    with pytest.raises(Exception):
+        _de(ssz.Bytes32, b"\x11" * 31)
+
+
+# == variable-size shapes ==================================================
+
+
+def _with_list_bytes(items):
+    return bytes(ssz.serialize(WithList(a=ssz.uint8(3), items=items)))
+
+
+def test_list_offset_past_end_rejected():
+    good = bytearray(_with_list_bytes([1, 2]))
+    # the 4-byte offset sits right after the uint8 field
+    good[1:5] = (len(good) + 40).to_bytes(4, "little")
+    with pytest.raises(Exception):
+        _de(WithList, bytes(good))
+
+
+def test_list_offset_before_fixed_part_rejected():
+    good = bytearray(_with_list_bytes([1, 2]))
+    good[1:5] = (0).to_bytes(4, "little")
+    with pytest.raises(Exception):
+        _de(WithList, bytes(good))
+
+
+def test_list_over_limit_rejected():
+    # 9 elements on a limit-8 list
+    fixed = b"\x03" + (5).to_bytes(4, "little")
+    body = b"".join(i.to_bytes(8, "little") for i in range(9))
+    with pytest.raises(Exception):
+        _de(WithList, fixed + body)
+
+
+def test_list_ragged_tail_rejected():
+    fixed = b"\x03" + (5).to_bytes(4, "little")
+    body = (1).to_bytes(8, "little") + b"\x01\x02\x03"  # 3 stray bytes
+    with pytest.raises(Exception):
+        _de(WithList, fixed + body)
+
+
+def test_empty_list_roundtrip():
+    enc = _with_list_bytes([])
+    out = _de(WithList, enc)
+    assert list(out.items) == []
+
+
+# == bitlists ==============================================================
+
+
+def test_bitlist_missing_delimiter_rejected():
+    with pytest.raises(Exception):
+        _de(ssz.Bitlist[16], b"\x00")  # all-zero byte: no sentinel bit
+
+
+def test_bitlist_over_limit_rejected():
+    # 17 bits on a limit-16 bitlist: 2 data bytes + sentinel in byte 3
+    with pytest.raises(Exception):
+        _de(ssz.Bitlist[16], b"\xff\xff\x03")
+
+
+def test_bitlist_exact_limit_ok():
+    out = _de(ssz.Bitlist[16], b"\xff\xff\x01")
+    assert len(out) == 16 and all(bool(b) for b in out)
+
+
+def test_bitvector_excess_bits_rejected():
+    with pytest.raises(Exception):
+        _de(ssz.Bitvector[4], b"\x1f")  # bit 4 set on a 4-bit vector
+
+
+def test_bitlist_empty_is_single_sentinel():
+    out = _de(ssz.Bitlist[16], b"\x01")
+    assert len(out) == 0
+    assert bytes(ssz.serialize(ssz.Bitlist[16]([]))) == b"\x01"
+
+
+# == unions ================================================================
+
+
+def test_union_bad_selector_rejected():
+    U = ssz.Union[ssz.uint8, ssz.uint16]
+    good = ssz.serialize(U(selector=0, value=ssz.uint8(5)))
+    assert int(_de(U, bytes(good)).value) == 5
+    with pytest.raises(Exception):
+        _de(U, b"\x07\x05")  # selector 7 out of range
+
+
+def test_union_empty_body_rejected():
+    U = ssz.Union[ssz.uint8, ssz.uint16]
+    with pytest.raises(Exception):
+        _de(U, b"")
