@@ -1,0 +1,97 @@
+"""Genesis initialization/validity tables (reference analogue:
+test/phase0/genesis/test_initialization.py and test_validity.py; spec:
+specs/phase0/beacon-chain.md:1276-1337)."""
+
+from eth_consensus_specs_tpu.test_infra.context import (
+    spec_state_test,
+    with_phases,
+)
+from eth_consensus_specs_tpu.test_infra.deposits import build_deposit
+from eth_consensus_specs_tpu.test_infra.genesis import bls_withdrawal_credentials
+from eth_consensus_specs_tpu.test_infra.keys import privkeys, pubkeys
+
+PHASE0 = ["phase0"]
+
+
+def _genesis_inputs(spec, count):
+    deposit_data_list = []
+    deposits = []
+    for i in range(count):
+        deposit, _root, deposit_data_list = build_deposit(
+            spec,
+            deposit_data_list,
+            pubkeys[i],
+            privkeys[i],
+            int(spec.MAX_EFFECTIVE_BALANCE),
+            bls_withdrawal_credentials(spec, i),
+            signed=True,
+        )
+        deposits.append(deposit)
+    eth1_block_hash = b"\x12" * 32
+    eth1_timestamp = int(spec.config.MIN_GENESIS_TIME)
+    return eth1_block_hash, eth1_timestamp, deposits
+
+
+@with_phases(PHASE0)
+@spec_state_test
+def test_initialize_sets_genesis_time_with_delay(spec, state):
+    h, t, deposits = _genesis_inputs(spec, 4)
+    out = spec.initialize_beacon_state_from_eth1(h, t, deposits)
+    assert int(out.genesis_time) == t + int(spec.config.GENESIS_DELAY)
+
+
+@with_phases(PHASE0)
+@spec_state_test
+def test_initialize_onboards_all_deposits(spec, state):
+    h, t, deposits = _genesis_inputs(spec, 6)
+    out = spec.initialize_beacon_state_from_eth1(h, t, deposits)
+    assert len(out.validators) == 6
+    assert int(out.eth1_deposit_index) == 6
+
+
+@with_phases(PHASE0)
+@spec_state_test
+def test_initialize_activates_full_balance_validators(spec, state):
+    h, t, deposits = _genesis_inputs(spec, 4)
+    out = spec.initialize_beacon_state_from_eth1(h, t, deposits)
+    for v in out.validators:
+        assert int(v.activation_epoch) == int(spec.GENESIS_EPOCH)
+        assert int(v.effective_balance) == int(spec.MAX_EFFECTIVE_BALANCE)
+
+
+@with_phases(PHASE0)
+@spec_state_test
+def test_initialize_eth1_data_recorded(spec, state):
+    h, t, deposits = _genesis_inputs(spec, 4)
+    out = spec.initialize_beacon_state_from_eth1(h, t, deposits)
+    assert bytes(out.eth1_data.block_hash) == h
+    assert int(out.eth1_data.deposit_count) == 4
+
+
+@with_phases(PHASE0)
+@spec_state_test
+def test_validity_needs_min_validator_count(spec, state):
+    h, t, deposits = _genesis_inputs(
+        spec, int(spec.config.MIN_GENESIS_ACTIVE_VALIDATOR_COUNT)
+    )
+    out = spec.initialize_beacon_state_from_eth1(h, t, deposits)
+    assert spec.is_valid_genesis_state(out)
+
+
+@with_phases(PHASE0)
+@spec_state_test
+def test_validity_too_few_validators(spec, state):
+    need = int(spec.config.MIN_GENESIS_ACTIVE_VALIDATOR_COUNT)
+    h, t, deposits = _genesis_inputs(spec, max(need - 1, 1))
+    out = spec.initialize_beacon_state_from_eth1(h, t, deposits)
+    assert not spec.is_valid_genesis_state(out)
+
+
+@with_phases(PHASE0)
+@spec_state_test
+def test_validity_too_early_time(spec, state):
+    need = int(spec.config.MIN_GENESIS_ACTIVE_VALIDATOR_COUNT)
+    h, t, deposits = _genesis_inputs(spec, need)
+    out = spec.initialize_beacon_state_from_eth1(h, t, deposits)
+    out.genesis_time = int(spec.config.MIN_GENESIS_TIME) - 1
+    assert not spec.is_valid_genesis_state(out)

@@ -1,0 +1,114 @@
+"""Networking pure-function tables — fork digests, domains, subnet
+subscription (reference analogue: the `networking` vector runner and
+test/phase0/unittests/test_networking.py; spec:
+specs/phase0/p2p-interface.md:1344+, validator.md subnet math)."""
+
+from eth_consensus_specs_tpu.test_infra.context import (
+    spec_state_test,
+    with_all_phases,
+    with_phases,
+)
+
+
+@with_all_phases
+@spec_state_test
+def test_fork_digest_depends_on_version(spec, state):
+    root = bytes(state.genesis_validators_root)
+    a = bytes(spec.compute_fork_digest(b"\x00\x00\x00\x00", root))
+    b = bytes(spec.compute_fork_digest(b"\x01\x00\x00\x00", root))
+    assert a != b and len(a) == 4
+
+
+@with_all_phases
+@spec_state_test
+def test_fork_digest_depends_on_genesis_root(spec, state):
+    v = b"\x00\x00\x00\x00"
+    a = bytes(spec.compute_fork_digest(v, b"\x01" * 32))
+    b = bytes(spec.compute_fork_digest(v, b"\x02" * 32))
+    assert a != b
+
+
+@with_all_phases
+@spec_state_test
+def test_fork_data_root_prefix_is_digest(spec, state):
+    root = bytes(state.genesis_validators_root)
+    v = bytes(state.fork.current_version)
+    data_root = bytes(spec.compute_fork_data_root(v, root))
+    digest = bytes(spec.compute_fork_digest(v, root)) if not hasattr(
+        spec, "get_blob_parameters"
+    ) else None
+    if digest is not None:
+        assert data_root[:4] == digest
+
+
+@with_all_phases
+@spec_state_test
+def test_compute_domain_mixes_fork_digest(spec, state):
+    root = bytes(state.genesis_validators_root)
+    d1 = bytes(
+        spec.compute_domain(spec.DOMAIN_BEACON_PROPOSER, b"\x00\x00\x00\x00", root)
+    )
+    d2 = bytes(
+        spec.compute_domain(spec.DOMAIN_BEACON_PROPOSER, b"\x09\x00\x00\x00", root)
+    )
+    assert d1[:4] == bytes(spec.DOMAIN_BEACON_PROPOSER)
+    assert d1 != d2
+
+
+@with_all_phases
+@spec_state_test
+def test_get_domain_previous_epoch_uses_previous_fork(spec, state):
+    """After a fork-version bump, messages for the previous epoch verify
+    under the PREVIOUS version."""
+    state.fork.epoch = spec.get_current_epoch(state)
+    state.fork.previous_version = b"\x0a\x00\x00\x00"
+    state.fork.current_version = b"\x0b\x00\x00\x00"
+    if int(spec.get_current_epoch(state)) == 0:
+        return
+    d_prev = bytes(
+        spec.get_domain(
+            state, spec.DOMAIN_BEACON_ATTESTER, spec.get_previous_epoch(state)
+        )
+    )
+    root = bytes(state.genesis_validators_root)
+    expected = bytes(
+        spec.compute_domain(
+            spec.DOMAIN_BEACON_ATTESTER, b"\x0a\x00\x00\x00", root
+        )
+    )
+    assert d_prev == expected
+
+
+@with_all_phases
+@spec_state_test
+def test_subscribed_subnets_deterministic_shape(spec, state):
+    node = 0x1234_5678_9ABC
+    epoch = spec.get_current_epoch(state)
+    subs = [int(s) for s in spec.compute_subscribed_subnets(node, epoch)]
+    assert len(subs) == int(spec.config.SUBNETS_PER_NODE)
+    assert subs == [int(s) for s in spec.compute_subscribed_subnets(node, epoch)]
+    assert all(0 <= s < int(spec.config.ATTESTATION_SUBNET_COUNT) for s in subs)
+
+
+@with_all_phases
+@spec_state_test
+def test_subscribed_subnets_node_dependence(spec, state):
+    epoch = spec.get_current_epoch(state)
+    base = [int(s) for s in spec.compute_subscribed_subnets(1, epoch)]
+    # some node among a spread of ids lands on different subnets
+    assert any(
+        [int(s) for s in spec.compute_subscribed_subnets(node, epoch)] != base
+        for node in (2, 3**50, 2**200, 2**255 - 19)
+    )
+
+
+@with_phases(["fulu", "gloas"])
+@spec_state_test
+def test_fulu_fork_digest_epoch_dependent_on_bpo(spec, state):
+    """Fulu's digest folds the blob schedule: with an empty schedule the
+    digest is stable across epochs."""
+    root = bytes(state.genesis_validators_root)
+    a = bytes(spec.compute_fork_digest(root, spec.get_current_epoch(state)))
+    b = bytes(spec.compute_fork_digest(root, spec.get_current_epoch(state) + 1))
+    if not len(spec.config.BLOB_SCHEDULE):
+        assert a == b

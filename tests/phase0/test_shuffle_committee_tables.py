@@ -1,0 +1,118 @@
+"""Shuffle / committee / proposer accessor tables (reference analogue:
+test/phase0/unittests/validator/ and the shuffling vector runner; spec:
+specs/phase0/beacon-chain.md:816-876)."""
+
+from eth_consensus_specs_tpu.test_infra.context import (
+    spec_state_test,
+    with_all_phases,
+    with_phases,
+)
+from eth_consensus_specs_tpu.test_infra.state import next_epoch
+
+
+@with_all_phases
+@spec_state_test
+def test_shuffled_index_is_permutation(spec, state):
+    n = 64
+    seed = b"\x22" * 32
+    out = [int(spec.compute_shuffled_index(i, n, seed)) for i in range(n)]
+    assert sorted(out) == list(range(n))
+
+
+@with_all_phases
+@spec_state_test
+def test_shuffled_index_seed_sensitivity(spec, state):
+    n = 64
+    a = [int(spec.compute_shuffled_index(i, n, b"\x01" * 32)) for i in range(n)]
+    b = [int(spec.compute_shuffled_index(i, n, b"\x02" * 32)) for i in range(n)]
+    assert a != b
+
+
+@with_all_phases
+@spec_state_test
+def test_shuffled_index_single_element_fixed(spec, state):
+    assert int(spec.compute_shuffled_index(0, 1, b"\x05" * 32)) == 0
+
+
+@with_all_phases
+@spec_state_test
+def test_shuffled_index_out_of_range_rejected(spec, state):
+    from eth_consensus_specs_tpu.test_infra.context import expect_assertion_error
+
+    expect_assertion_error(lambda: spec.compute_shuffled_index(64, 64, b"\x01" * 32))
+
+
+@with_all_phases
+@spec_state_test
+def test_committees_partition_active_set(spec, state):
+    epoch = spec.get_current_epoch(state)
+    slots = int(spec.SLOTS_PER_EPOCH)
+    seen: list[int] = []
+    for slot in range(int(state.slot), int(state.slot) + slots):
+        count = int(spec.get_committee_count_per_slot(state, epoch))
+        for index in range(count):
+            seen += [int(v) for v in spec.get_beacon_committee(state, slot, index)]
+    active = spec.get_active_validator_indices(state, epoch)
+    assert sorted(seen) == sorted(int(i) for i in active)
+
+
+@with_all_phases
+@spec_state_test
+def test_committee_stable_within_epoch(spec, state):
+    slot = int(state.slot)
+    a = [int(v) for v in spec.get_beacon_committee(state, slot, 0)]
+    b = [int(v) for v in spec.get_beacon_committee(state, slot, 0)]
+    assert a == b
+
+
+@with_all_phases
+@spec_state_test
+def test_proposer_is_active_validator(spec, state):
+    epoch = spec.get_current_epoch(state)
+    proposer = int(spec.get_beacon_proposer_index(state))
+    active = [int(i) for i in spec.get_active_validator_indices(state, epoch)]
+    assert proposer in active
+
+
+@with_all_phases
+@spec_state_test
+def test_total_active_balance_matches_sum(spec, state):
+    epoch = spec.get_current_epoch(state)
+    active = spec.get_active_validator_indices(state, epoch)
+    expected = max(
+        int(spec.EFFECTIVE_BALANCE_INCREMENT),
+        sum(int(state.validators[int(i)].effective_balance) for i in active),
+    )
+    assert int(spec.get_total_active_balance(state)) == expected
+
+
+@with_all_phases
+@spec_state_test
+def test_seed_changes_across_epochs(spec, state):
+    e0 = spec.get_current_epoch(state)
+    s0 = bytes(spec.get_seed(state, e0, spec.DOMAIN_BEACON_ATTESTER))
+    next_epoch(spec, state)
+    next_epoch(spec, state)
+    e1 = spec.get_current_epoch(state)
+    s1 = bytes(spec.get_seed(state, e1, spec.DOMAIN_BEACON_ATTESTER))
+    assert s0 != s1
+
+
+@with_all_phases
+@spec_state_test
+def test_seed_domain_separation(spec, state):
+    e = spec.get_current_epoch(state)
+    a = bytes(spec.get_seed(state, e, spec.DOMAIN_BEACON_ATTESTER))
+    b = bytes(spec.get_seed(state, e, spec.DOMAIN_BEACON_PROPOSER))
+    assert a != b
+
+
+@with_phases(["fulu", "gloas"])
+@spec_state_test
+def test_lookahead_matches_live_computation(spec, state):
+    """EIP-7917: the precomputed lookahead equals the directly computed
+    proposer for the current slot."""
+    proposer = int(spec.get_beacon_proposer_index(state))
+    assert proposer == int(
+        state.proposer_lookahead[int(state.slot) % int(spec.SLOTS_PER_EPOCH)]
+    )
