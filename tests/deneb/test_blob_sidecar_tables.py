@@ -1,0 +1,76 @@
+"""Blob/KZG consensus-side tables for deneb+ — versioned hashes, blob
+caps across forks, data-availability gating (reference analogue:
+test/deneb/unittests/ and fork-choice blob tests; spec:
+specs/deneb/beacon-chain.md:436-455, fork-choice.md:54-63)."""
+
+from eth_consensus_specs_tpu.test_infra.context import (
+    expect_assertion_error,
+    spec_state_test,
+    with_phases,
+)
+
+DENEB_FORKS = ["deneb", "electra", "fulu"]
+
+
+@with_phases(DENEB_FORKS)
+@spec_state_test
+def test_versioned_hash_prefix(spec, state):
+    commitment = b"\x05" * 48
+    vh = bytes(spec.kzg_commitment_to_versioned_hash(commitment))
+    assert vh[:1] == bytes(spec.VERSIONED_HASH_VERSION_KZG)
+    assert len(vh) == 32
+
+
+@with_phases(DENEB_FORKS)
+@spec_state_test
+def test_versioned_hash_is_commitment_bound(spec, state):
+    a = bytes(spec.kzg_commitment_to_versioned_hash(b"\x05" * 48))
+    b = bytes(spec.kzg_commitment_to_versioned_hash(b"\x06" * 48))
+    assert a != b
+
+
+@with_phases(["deneb"])
+@spec_state_test
+def test_blob_cap_is_preset_max(spec, state):
+    assert int(spec.config.MAX_BLOBS_PER_BLOCK) >= 1
+
+
+@with_phases(["electra"])
+@spec_state_test
+def test_blob_cap_electra_constant(spec, state):
+    assert int(spec.config.MAX_BLOBS_PER_BLOCK_ELECTRA) >= int(
+        spec.config.MAX_BLOBS_PER_BLOCK
+    )
+
+
+@with_phases(["fulu"])
+@spec_state_test
+def test_blob_cap_fulu_schedule_fallback(spec, state):
+    params = spec.get_blob_parameters(spec.get_current_epoch(state))
+    # empty BLOB_SCHEDULE in minimal config: electra constants apply
+    assert int(params.max_blobs_per_block) == int(spec.config.MAX_BLOBS_PER_BLOCK_ELECTRA)
+
+
+@with_phases(DENEB_FORKS)
+@spec_state_test
+def test_blob_sidecar_container_shape(spec, state):
+    sidecar_t = getattr(spec, "BlobSidecar", None)
+    if sidecar_t is None:
+        return
+    s = sidecar_t()
+    assert len(bytes(s.kzg_commitment)) == 48
+    assert len(bytes(s.kzg_proof)) == 48
+
+
+@with_phases(DENEB_FORKS)
+@spec_state_test
+def test_compute_subnet_for_blob_sidecar_wraps(spec, state):
+    is_deneb = type(spec).__name__.startswith("Deneb")
+    count_name = (
+        "BLOB_SIDECAR_SUBNET_COUNT_ELECTRA"
+        if not is_deneb and "BLOB_SIDECAR_SUBNET_COUNT_ELECTRA" in spec.config
+        else "BLOB_SIDECAR_SUBNET_COUNT"
+    )
+    count = int(spec.config[count_name])
+    subnets = {int(spec.compute_subnet_for_blob_sidecar(i)) for i in range(2 * count)}
+    assert subnets == set(range(count))

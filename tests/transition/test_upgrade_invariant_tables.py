@@ -1,0 +1,161 @@
+"""Upgrade invariant tables — properties every fork boundary must
+preserve, written out per upgrade edge (reference analogue:
+test/<fork>/fork/test_<fork>_fork_basic.py families: one file per
+upgrade with basic/randomized/large-validator variants)."""
+
+from eth_consensus_specs_tpu.ssz import hash_tree_root
+from eth_consensus_specs_tpu.test_infra.context import (
+    spec_state_test,
+    with_phases,
+)
+from eth_consensus_specs_tpu.test_infra.state import next_epoch
+from eth_consensus_specs_tpu.utils import bls
+
+UPGRADES = [
+    ("phase0", "altair"),
+    ("altair", "bellatrix"),
+    ("bellatrix", "capella"),
+    ("capella", "deneb"),
+    ("deneb", "electra"),
+    ("electra", "fulu"),
+    ("fulu", "gloas"),
+]
+
+
+def _upgraded(pre_fork: str, post_fork: str, mutate=None):
+    from eth_consensus_specs_tpu.forks import get_spec
+    from eth_consensus_specs_tpu.test_infra.genesis import create_genesis_state
+
+    pre_spec = get_spec(pre_fork, "minimal")
+    post_spec = get_spec(post_fork, "minimal")
+    prev = bls.bls_active
+    bls.bls_active = False
+    try:
+        state = create_genesis_state(
+            pre_spec,
+            [pre_spec.MAX_EFFECTIVE_BALANCE] * 64,
+            pre_spec.MAX_EFFECTIVE_BALANCE,
+        )
+        next_epoch(pre_spec, state)
+        if mutate:
+            mutate(pre_spec, state)
+        post = post_spec.upgrade_from_parent(state.copy())
+    finally:
+        bls.bls_active = prev
+    return pre_spec, post_spec, state, post
+
+
+def _check_upgrade_preserves(pre_fork, post_fork):
+    pre_spec, post_spec, pre, post = _upgraded(pre_fork, post_fork)
+    # registry, balances and randao history survive byte-identically
+    assert len(post.validators) == len(pre.validators)
+    assert [int(b) for b in post.balances] == [int(b) for b in pre.balances]
+    assert bytes(hash_tree_root(post.randao_mixes)) == bytes(
+        hash_tree_root(pre.randao_mixes)
+    )
+    # fork record: previous <- old current, epoch = current epoch
+    assert bytes(post.fork.previous_version) == bytes(pre.fork.current_version)
+    assert int(post.fork.epoch) == int(pre_spec.get_current_epoch(pre))
+    # slot and genesis identity unchanged
+    assert int(post.slot) == int(pre.slot)
+    assert bytes(post.genesis_validators_root) == bytes(pre.genesis_validators_root)
+
+
+def _check_upgraded_state_advances(pre_fork, post_fork):
+    _, post_spec, _, post = _upgraded(pre_fork, post_fork)
+    next_epoch(post_spec, post)
+    assert int(post.slot) % int(post_spec.SLOTS_PER_EPOCH) == 0
+
+
+def _check_upgrade_with_slashed_validators(pre_fork, post_fork):
+    def mutate(spec, state):
+        for i in (0, 3):
+            state.validators[i].slashed = True
+
+    _, post_spec, pre, post = _upgraded(pre_fork, post_fork, mutate)
+    assert post.validators[0].slashed and post.validators[3].slashed
+
+
+def test_upgrade_preserves_phase0_altair():
+    _check_upgrade_preserves("phase0", "altair")
+
+
+def test_upgrade_preserves_altair_bellatrix():
+    _check_upgrade_preserves("altair", "bellatrix")
+
+
+def test_upgrade_preserves_bellatrix_capella():
+    _check_upgrade_preserves("bellatrix", "capella")
+
+
+def test_upgrade_preserves_capella_deneb():
+    _check_upgrade_preserves("capella", "deneb")
+
+
+def test_upgrade_preserves_deneb_electra():
+    _check_upgrade_preserves("deneb", "electra")
+
+
+def test_upgrade_preserves_electra_fulu():
+    _check_upgrade_preserves("electra", "fulu")
+
+
+def test_upgrade_preserves_fulu_gloas():
+    _check_upgrade_preserves("fulu", "gloas")
+
+
+def test_upgrade_advances_phase0_altair():
+    _check_upgraded_state_advances("phase0", "altair")
+
+
+def test_upgrade_advances_capella_deneb():
+    _check_upgraded_state_advances("capella", "deneb")
+
+
+def test_upgrade_advances_deneb_electra():
+    _check_upgraded_state_advances("deneb", "electra")
+
+
+def test_upgrade_advances_electra_fulu():
+    _check_upgraded_state_advances("electra", "fulu")
+
+
+def test_upgrade_advances_fulu_gloas():
+    _check_upgraded_state_advances("fulu", "gloas")
+
+
+def test_upgrade_slashed_phase0_altair():
+    _check_upgrade_with_slashed_validators("phase0", "altair")
+
+
+def test_upgrade_slashed_deneb_electra():
+    _check_upgrade_with_slashed_validators("deneb", "electra")
+
+
+def test_upgrade_slashed_fulu_gloas():
+    _check_upgrade_with_slashed_validators("fulu", "gloas")
+
+
+def test_electra_upgrade_builds_pending_deposit_queue():
+    """deneb->electra: unfinalized deposits convert into the new pending
+    queue structures and churn fields initialize."""
+    _, post_spec, pre, post = _upgraded("deneb", "electra")
+    assert int(post.deposit_requests_start_index) == int(
+        post_spec.UNSET_DEPOSIT_REQUESTS_START_INDEX
+    )
+    assert int(post.earliest_exit_epoch) >= 0
+
+
+def test_fulu_upgrade_initializes_lookahead():
+    _, post_spec, pre, post = _upgraded("electra", "fulu")
+    n = int(post_spec.SLOTS_PER_EPOCH)
+    looked = [int(x) for x in post.proposer_lookahead]
+    assert len(looked) == (int(post_spec.MIN_SEED_LOOKAHEAD) + 1) * n
+    # entries are valid validator indices
+    assert all(0 <= i < len(post.validators) for i in looked)
+
+
+def test_gloas_upgrade_initializes_builder_fields():
+    _, post_spec, pre, post = _upgraded("fulu", "gloas")
+    assert len(post.builder_pending_payments) == 2 * int(post_spec.SLOTS_PER_EPOCH)
+    assert len(post.builder_pending_withdrawals) == 0
