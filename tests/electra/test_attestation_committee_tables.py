@@ -1,0 +1,106 @@
+"""EIP-7549 committee-bit attestation combination tables, electra+
+(reference analogue: test/electra/block_processing/
+test_process_attestation.py multi-committee variants)."""
+
+from eth_consensus_specs_tpu.test_infra.attestations import (
+    get_valid_attestation,
+    get_valid_attestations_at_slot,
+    run_attestation_processing,
+)
+from eth_consensus_specs_tpu.test_infra.context import (
+    expect_assertion_error,
+    spec_state_test,
+    with_phases,
+)
+from eth_consensus_specs_tpu.test_infra.state import next_slots
+
+ELECTRA_FORKS = ["electra", "fulu"]
+
+
+def _fresh(spec, state):
+    next_slots(spec, state, 10)
+    atts = get_valid_attestations_at_slot(spec, state, int(state.slot))
+    next_slots(spec, state, int(spec.MIN_ATTESTATION_INCLUSION_DELAY))
+    return atts
+
+
+@with_phases(ELECTRA_FORKS)
+@spec_state_test
+def test_single_committee_attestation(spec, state):
+    next_slots(spec, state, 10)
+    att = get_valid_attestation(spec, state, signed=True)
+    next_slots(spec, state, int(spec.MIN_ATTESTATION_INCLUSION_DELAY))
+    assert sum(map(bool, att.committee_bits)) == 1
+    yield from run_attestation_processing(spec, state, att)
+
+
+@with_phases(ELECTRA_FORKS)
+@spec_state_test
+def test_on_chain_aggregate_all_committees(spec, state):
+    """The electra on-chain form: one attestation spanning EVERY slot
+    committee via compute_on_chain_aggregate semantics."""
+    next_slots(spec, state, 10)
+    slot = int(state.slot)
+    atts = get_valid_attestations_at_slot(spec, state, slot, signed=True)
+    next_slots(spec, state, int(spec.MIN_ATTESTATION_INCLUSION_DELAY))
+    if len(atts) == 1:
+        att = atts[0]
+    elif hasattr(spec, "compute_on_chain_aggregate"):
+        att = spec.compute_on_chain_aggregate(atts)
+    else:
+        return
+    assert sum(map(bool, att.committee_bits)) >= 1
+    yield from run_attestation_processing(spec, state, att)
+
+
+@with_phases(ELECTRA_FORKS)
+@spec_state_test
+def test_invalid_nonzero_data_index(spec, state):
+    next_slots(spec, state, 10)
+    att = get_valid_attestation(spec, state, signed=True)
+    next_slots(spec, state, int(spec.MIN_ATTESTATION_INCLUSION_DELAY))
+    att.data.index = 1  # must be 0 post-electra
+    yield from run_attestation_processing(spec, state, att, valid=False)
+
+
+@with_phases(ELECTRA_FORKS)
+@spec_state_test
+def test_invalid_zero_committee_bits(spec, state):
+    next_slots(spec, state, 10)
+    att = get_valid_attestation(spec, state, signed=True)
+    next_slots(spec, state, int(spec.MIN_ATTESTATION_INCLUSION_DELAY))
+    for i in range(len(att.committee_bits)):
+        att.committee_bits[i] = False
+    yield from run_attestation_processing(spec, state, att, valid=False)
+
+
+@with_phases(ELECTRA_FORKS)
+@spec_state_test
+def test_invalid_bits_shorter_than_committee_span(spec, state):
+    next_slots(spec, state, 10)
+    att = get_valid_attestation(spec, state, signed=True)
+    next_slots(spec, state, int(spec.MIN_ATTESTATION_INCLUSION_DELAY))
+    bits_t = type(att.aggregation_bits)
+    att.aggregation_bits = bits_t(list(att.aggregation_bits)[:-1])
+    yield from run_attestation_processing(spec, state, att, valid=False)
+
+
+@with_phases(ELECTRA_FORKS)
+@spec_state_test
+def test_invalid_empty_participation_in_claimed_committee(spec, state):
+    next_slots(spec, state, 10)
+    att = get_valid_attestation(spec, state, signed=True)
+    next_slots(spec, state, int(spec.MIN_ATTESTATION_INCLUSION_DELAY))
+    for i in range(len(att.aggregation_bits)):
+        att.aggregation_bits[i] = False
+    yield from run_attestation_processing(spec, state, att, valid=False)
+
+
+@with_phases(ELECTRA_FORKS)
+@spec_state_test
+def test_get_attesting_indices_matches_bits(spec, state):
+    next_slots(spec, state, 10)
+    att = get_valid_attestation(spec, state, signed=True)
+    next_slots(spec, state, int(spec.MIN_ATTESTATION_INCLUSION_DELAY))
+    idxs = spec.get_attesting_indices(state, att)
+    assert len(idxs) == sum(map(bool, att.aggregation_bits))

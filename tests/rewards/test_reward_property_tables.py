@@ -1,0 +1,114 @@
+"""Reward/penalty property tables across the fork matrix (reference
+analogue: test/phase0/rewards/ full/half/quarter participation classes
+and leak variants)."""
+
+from eth_consensus_specs_tpu.test_infra.attestations import next_epoch_with_attestations
+from eth_consensus_specs_tpu.test_infra.context import (
+    spec_state_test,
+    with_all_phases,
+    with_phases,
+)
+from eth_consensus_specs_tpu.test_infra.forks import is_post_altair
+from eth_consensus_specs_tpu.test_infra.state import next_epoch
+
+POST_ALTAIR = ["altair", "bellatrix", "capella", "deneb", "electra", "fulu", "gloas"]
+
+
+def _epoch_delta(spec, state, fill=True):
+    next_epoch(spec, state)
+    pre = [int(b) for b in state.balances]
+    _, _, out = next_epoch_with_attestations(spec, state, False, fill)
+    # cross one more boundary so prev-epoch rewards apply
+    _, _, out = next_epoch_with_attestations(spec, out, False, fill)
+    post = [int(b) for b in out.balances]
+    return pre, post, out
+
+
+@with_all_phases
+@spec_state_test
+def test_full_participation_rewards_majority(spec, state):
+    pre, post, _ = _epoch_delta(spec, state, fill=True)
+    gained = sum(1 for a, b in zip(pre, post) if b > a)
+    assert gained > len(pre) // 2
+
+
+@with_all_phases
+@spec_state_test
+def test_no_participation_penalizes(spec, state):
+    next_epoch(spec, state)
+    next_epoch(spec, state)
+    pre = [int(b) for b in state.balances]
+    next_epoch(spec, state)  # an epoch with zero attestations
+    post = [int(b) for b in state.balances]
+    assert sum(post) < sum(pre) or post == pre  # penalties (or none at genesis-edge)
+
+
+@with_phases(POST_ALTAIR)
+@spec_state_test
+def test_participation_flags_drive_rewards(spec, state):
+    next_epoch(spec, state)
+    next_epoch(spec, state)
+    # hand-set full prev participation for half the validators
+    n = len(state.validators)
+    for i in range(n):
+        state.previous_epoch_participation[i] = 0b0000_0111 if i < n // 2 else 0
+    pre = [int(b) for b in state.balances]
+    boundary = int(state.slot) + (
+        spec.SLOTS_PER_EPOCH - int(state.slot) % spec.SLOTS_PER_EPOCH
+    )
+    spec.process_slots(state, boundary)
+    post = [int(b) for b in state.balances]
+    flagged = sum(post[i] - pre[i] for i in range(n // 2))
+    unflagged = sum(post[i] - pre[i] for i in range(n // 2, n))
+    assert flagged > unflagged
+
+
+@with_phases(POST_ALTAIR)
+@spec_state_test
+def test_leak_burns_unflagged_only_more(spec, state):
+    # drive into an inactivity leak
+    for _ in range(int(spec.MIN_EPOCHS_TO_INACTIVITY_PENALTY) + 3):
+        next_epoch(spec, state)
+    n = len(state.validators)
+    for i in range(n // 2):
+        state.previous_epoch_participation[i] = 0b0000_0111
+    for i in range(n // 2):
+        state.inactivity_scores[i] = 0
+    pre = [int(b) for b in state.balances]
+    boundary = int(state.slot) + (
+        spec.SLOTS_PER_EPOCH - int(state.slot) % spec.SLOTS_PER_EPOCH
+    )
+    spec.process_slots(state, boundary)
+    post = [int(b) for b in state.balances]
+    loss_flagged = sum(pre[i] - post[i] for i in range(n // 2))
+    loss_unflagged = sum(pre[i] - post[i] for i in range(n // 2, n))
+    assert loss_unflagged > loss_flagged
+
+
+@with_all_phases
+@spec_state_test
+def test_rewards_zero_for_exited_validators(spec, state):
+    next_epoch(spec, state)
+    idx = 2
+    state.validators[idx].exit_epoch = spec.get_current_epoch(state)
+    state.validators[idx].withdrawable_epoch = spec.get_current_epoch(state) + 1
+    pre = int(state.balances[idx])
+    _, _, out = next_epoch_with_attestations(spec, state, False, True)
+    # an exited validator neither earns attestation rewards nor pays
+    # attestation penalties after withdrawability
+    assert abs(int(out.balances[idx]) - pre) <= pre // 1000
+
+
+@with_phases(POST_ALTAIR)
+@spec_state_test
+def test_slashed_validators_cannot_earn(spec, state):
+    next_epoch(spec, state)
+    idx = 3
+    state.validators[idx].slashed = True
+    state.previous_epoch_participation[idx] = 0b0000_0111
+    pre = int(state.balances[idx])
+    boundary = int(state.slot) + (
+        spec.SLOTS_PER_EPOCH - int(state.slot) % spec.SLOTS_PER_EPOCH
+    )
+    spec.process_slots(state, boundary)
+    assert int(state.balances[idx]) <= pre
