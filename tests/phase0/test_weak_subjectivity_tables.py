@@ -1,0 +1,90 @@
+"""Weak-subjectivity and checkpoint-sync tables (reference analogue:
+test/phase0/unittests/test_weak_subjectivity.py; spec:
+specs/phase0/weak-subjectivity.md)."""
+
+from eth_consensus_specs_tpu.ssz import hash_tree_root
+from eth_consensus_specs_tpu.test_infra.context import (
+    expect_assertion_error,
+    spec_state_test,
+    with_all_phases,
+)
+from eth_consensus_specs_tpu.test_infra.state import next_epoch
+
+
+@with_all_phases
+@spec_state_test
+def test_ws_period_at_least_withdrawability_delay(spec, state):
+    period = int(spec.compute_weak_subjectivity_period(state))
+    assert period >= int(spec.config.MIN_VALIDATOR_WITHDRAWABILITY_DELAY)
+
+
+@with_all_phases
+@spec_state_test
+def test_ws_period_grows_with_balance_concentration(spec, state):
+    base = int(spec.compute_weak_subjectivity_period(state))
+    # halve the validator count's effective stake: period shouldn't grow
+    for i in range(len(state.validators) // 2):
+        state.validators[i].effective_balance = 0
+    thinner = int(spec.compute_weak_subjectivity_period(state))
+    assert thinner <= base or thinner >= int(
+        spec.config.MIN_VALIDATOR_WITHDRAWABILITY_DELAY
+    )
+
+
+@with_all_phases
+@spec_state_test
+def test_within_ws_period_fresh_checkpoint(spec, state):
+    next_epoch(spec, state)  # backfill latest_block_header.state_root
+    anchor = spec.BeaconBlock(slot=state.slot, state_root=hash_tree_root(state))
+    store = spec.get_forkchoice_store(state.copy(), anchor)
+    cp = spec.Checkpoint(
+        epoch=spec.compute_epoch_at_slot(int(state.slot)),
+        root=state.latest_block_header.state_root,
+    )
+    assert spec.is_within_weak_subjectivity_period(store, state.copy(), cp)
+
+
+@with_all_phases
+@spec_state_test
+def test_outside_ws_period_stale_checkpoint(spec, state):
+    next_epoch(spec, state)
+    anchor = spec.BeaconBlock(slot=state.slot, state_root=hash_tree_root(state))
+    store = spec.get_forkchoice_store(state.copy(), anchor)
+    period = int(spec.compute_weak_subjectivity_period(state))
+    # pretend the store clock is far past the checkpoint epoch
+    store.time = int(store.time) + (
+        (period + 2) * int(spec.SLOTS_PER_EPOCH) * int(spec.config.SECONDS_PER_SLOT)
+    )
+    cp = spec.Checkpoint(
+        epoch=spec.compute_epoch_at_slot(int(state.slot)),
+        root=state.latest_block_header.state_root,
+    )
+    assert not spec.is_within_weak_subjectivity_period(store, state.copy(), cp)
+
+
+@with_all_phases
+@spec_state_test
+def test_ws_checkpoint_mismatched_state_rejected(spec, state):
+    anchor = spec.BeaconBlock(state_root=hash_tree_root(state))
+    store = spec.get_forkchoice_store(state.copy(), anchor)
+    cp = spec.Checkpoint(epoch=spec.get_current_epoch(state), root=b"\x31" * 32)
+    expect_assertion_error(
+        lambda: spec.is_within_weak_subjectivity_period(store, state.copy(), cp)
+    )
+
+
+@with_all_phases
+@spec_state_test
+def test_forkchoice_store_bootstrap_from_advanced_state(spec, state):
+    """Checkpoint sync: bootstrapping from a mid-chain state anchors the
+    store at that state's epoch boundary."""
+    next_epoch(spec, state)
+    next_epoch(spec, state)
+    anchor = spec.BeaconBlock(
+        slot=state.slot, state_root=hash_tree_root(state)
+    )
+    store = spec.get_forkchoice_store(state.copy(), anchor)
+    assert int(store.finalized_checkpoint.epoch) == int(
+        spec.get_current_epoch(state)
+    )
+    assert bytes(spec.get_head_root(store)) == bytes(hash_tree_root(anchor))
