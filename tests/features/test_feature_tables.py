@@ -1,0 +1,147 @@
+"""Feature-fork property tables — FOCIL inclusion lists (eip7805),
+validator index reuse (eip6914), execution proofs (eip8025), Verkle
+types (eip6800) (reference analogue: the per-feature suites under
+test/_features/...)."""
+
+from eth_consensus_specs_tpu.forks.features import get_feature_spec as get_spec
+from eth_consensus_specs_tpu.test_infra.genesis import create_genesis_state
+from eth_consensus_specs_tpu.utils import bls
+
+
+def _state(spec, n=64):
+    prev = bls.bls_active
+    bls.bls_active = False
+    try:
+        return create_genesis_state(
+            spec, [spec.MAX_EFFECTIVE_BALANCE] * n, spec.MAX_EFFECTIVE_BALANCE
+        )
+    finally:
+        bls.bls_active = prev
+
+
+# == eip7805 (FOCIL) =======================================================
+
+
+def test_focil_committee_deterministic():
+    spec = get_spec("eip7805", "minimal")
+    state = _state(spec)
+    a = [int(i) for i in spec.get_inclusion_list_committee(state, state.slot)]
+    b = [int(i) for i in spec.get_inclusion_list_committee(state, state.slot)]
+    assert a == b
+    assert len(a) == int(spec.INCLUSION_LIST_COMMITTEE_SIZE)
+
+
+def test_focil_committee_members_are_validators():
+    spec = get_spec("eip7805", "minimal")
+    state = _state(spec)
+    comm = [int(i) for i in spec.get_inclusion_list_committee(state, state.slot)]
+    assert all(0 <= i < len(state.validators) for i in comm)
+
+
+def test_focil_store_accepts_committee_member_list():
+    spec = get_spec("eip7805", "minimal")
+    state = _state(spec)
+    store = spec.get_inclusion_list_store()
+    comm = [int(i) for i in spec.get_inclusion_list_committee(state, state.slot)]
+    from eth_consensus_specs_tpu.ssz import hash_tree_root
+
+    root = hash_tree_root(spec._committee_vector_type()(comm))
+    il = spec.InclusionList(
+        slot=state.slot,
+        validator_index=comm[0],
+        inclusion_list_committee_root=root,
+        transactions=[],
+    )
+    spec.process_inclusion_list(store, il, True)
+    assert True  # no exception: accepted into the store
+
+
+def test_focil_transactions_deduplicated():
+    spec = get_spec("eip7805", "minimal")
+    state = _state(spec)
+    store = spec.get_inclusion_list_store()
+    comm = [int(i) for i in spec.get_inclusion_list_committee(state, state.slot)]
+    from eth_consensus_specs_tpu.ssz import hash_tree_root
+
+    root = hash_tree_root(spec._committee_vector_type()(comm))
+    tx = b"\x01\x02\x03"
+    for v in comm[:2]:
+        il = spec.InclusionList(
+            slot=state.slot,
+            validator_index=v,
+            inclusion_list_committee_root=root,
+            transactions=[tx],
+        )
+        spec.process_inclusion_list(store, il, True)
+    txs = spec.get_inclusion_list_transactions(store, state, state.slot)
+    assert list(txs).count(tx) == 1
+
+
+# == eip6914 (validator index reuse) =======================================
+
+
+def test_reuse_requires_withdrawable_and_empty():
+    spec = get_spec("eip6914", "minimal")
+    state = _state(spec)
+    epoch = spec.get_current_epoch(state)
+    v = state.validators[1]
+    assert not spec.is_reusable_validator(v, int(state.balances[1]), epoch)
+    v.withdrawable_epoch = 0
+    v.exit_epoch = 0
+    assert spec.is_reusable_validator(v, 0, int(spec.SAFE_EPOCHS_TO_REUSE_INDEX) + 1)
+
+
+def test_new_validator_reuses_reusable_slot():
+    spec = get_spec("eip6914", "minimal")
+    state = _state(spec)
+    epoch = spec.get_current_epoch(state) + int(spec.SAFE_EPOCHS_TO_REUSE_INDEX) + 1
+    # fast-forward the clock by faking slot
+    state.slot = int(epoch) * int(spec.SLOTS_PER_EPOCH)
+    v = state.validators[2]
+    v.withdrawable_epoch = 0
+    v.exit_epoch = 0
+    state.balances[2] = 0
+    assert int(spec.get_index_for_new_validator(state)) == 2
+
+
+def test_no_reusable_slot_appends():
+    spec = get_spec("eip6914", "minimal")
+    state = _state(spec)
+    assert int(spec.get_index_for_new_validator(state)) == len(state.validators)
+
+
+# == eip8025 (execution proofs) ============================================
+
+
+def test_execution_proof_keygen_deterministic():
+    spec = get_spec("eip8025", "minimal")
+    vk1 = spec.generate_verification_key(b"\x00\x01", 1)
+    vk2 = spec.generate_verification_key(b"\x00\x01", 1)
+    assert bytes(vk1) == bytes(vk2)
+    assert bytes(vk1) != bytes(spec.generate_verification_key(b"\x00\x01", 2))
+
+
+def test_execution_proof_roundtrip():
+    spec = get_spec("eip8025", "minimal")
+    block_hash, parent_hash = b"\x11" * 32, b"\x22" * 32
+    proof = spec.generate_zkevm_proof(block_hash, parent_hash, 1)
+    assert spec.verify_zkevm_proof(proof, parent_hash, block_hash, spec.PROGRAM)
+    # tampered public input fails
+    assert not spec.verify_zkevm_proof(proof, parent_hash, b"\x33" * 32, spec.PROGRAM)
+
+
+# == eip6800 (Verkle) ======================================================
+
+
+def test_verkle_payload_carries_execution_witness():
+    spec = get_spec("eip6800", "minimal")
+    payload = spec.ExecutionPayload()
+    assert hasattr(payload, "execution_witness")
+
+
+def test_verkle_types_merkleize():
+    from eth_consensus_specs_tpu.ssz import hash_tree_root
+
+    spec = get_spec("eip6800", "minimal")
+    w = spec.ExecutionWitness()
+    assert len(bytes(hash_tree_root(w))) == 32
