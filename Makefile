@@ -35,6 +35,9 @@ test-serial:
 	$(PYTHON) -m pytest tests/ -q -m "not slow"
 
 parity:
+	$(PYTHON) -m pytest tests/parity/ -q -m "not slow"
+
+parity-full:
 	$(PYTHON) -m pytest tests/parity/ -q
 
 # mainnet-SHAPED smoke: full 16,384-validator genesis, 64-committee slots,
