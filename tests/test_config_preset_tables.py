@@ -121,3 +121,63 @@ def test_gloas_builder_constants_sane():
         spec.BUILDER_PAYMENT_THRESHOLD_DENOMINATOR
     )
     assert int(spec.PTC_SIZE) >= 1
+
+
+@pytest.mark.parametrize("preset", PRESETS)
+def test_churn_limit_invariants(preset):
+    spec = get_spec("phase0", preset)
+    assert int(spec.config.MIN_PER_EPOCH_CHURN_LIMIT) >= 1
+    assert int(spec.config.CHURN_LIMIT_QUOTIENT) >= 1
+
+
+@pytest.mark.parametrize("preset", PRESETS)
+def test_electra_churn_limits_are_increment_multiples(preset):
+    spec = get_spec("electra", preset)
+    inc = int(spec.EFFECTIVE_BALANCE_INCREMENT)
+    assert int(spec.config.MIN_PER_EPOCH_CHURN_LIMIT_ELECTRA) % inc == 0
+    assert int(spec.config.MAX_PER_EPOCH_ACTIVATION_EXIT_CHURN_LIMIT) % inc == 0
+
+
+@pytest.mark.parametrize("preset", PRESETS)
+def test_sync_committee_constants(preset):
+    spec = get_spec("altair", preset)
+    assert int(spec.SYNC_COMMITTEE_SIZE) >= 1
+    assert int(spec.EPOCHS_PER_SYNC_COMMITTEE_PERIOD) >= 1
+
+
+@pytest.mark.parametrize("preset", PRESETS)
+def test_inactivity_and_hysteresis_quotients(preset):
+    spec = get_spec("altair", preset)
+    assert int(spec.config.INACTIVITY_SCORE_BIAS) >= 1
+    assert int(spec.config.INACTIVITY_SCORE_RECOVERY_RATE) >= 1
+    assert int(spec.HYSTERESIS_DOWNWARD_MULTIPLIER) < int(
+        spec.HYSTERESIS_UPWARD_MULTIPLIER
+    )
+
+
+def test_intervals_and_due_bps_sane():
+    fc = None
+    from eth_consensus_specs_tpu.specc import compile_fork
+
+    fc = compile_fork("phase0", "minimal", None, True)
+    assert int(fc.ATTESTATION_DUE_BPS) < 10_000
+
+
+@pytest.mark.parametrize("preset", PRESETS)
+def test_whistleblower_quotients_positive(preset):
+    spec = get_spec("phase0", preset)
+    assert int(spec.WHISTLEBLOWER_REWARD_QUOTIENT) >= 1
+    assert int(spec.PROPOSER_REWARD_QUOTIENT) >= 1
+
+
+@pytest.mark.parametrize("preset", PRESETS)
+def test_max_operations_per_block_positive(preset):
+    spec = get_spec("phase0", preset)
+    for name in (
+        "MAX_ATTESTATIONS",
+        "MAX_DEPOSITS",
+        "MAX_PROPOSER_SLASHINGS",
+        "MAX_ATTESTER_SLASHINGS",
+        "MAX_VOLUNTARY_EXITS",
+    ):
+        assert int(getattr(spec, name)) >= 1
