@@ -1,28 +1,178 @@
-"""Execution-payload construction for tests (reference analogue:
-test/helpers/execution_payload.py — ours skips the RLP/trie machinery the
-reference uses to fake EL data structures; the engine seam is a protocol,
-and the NoopExecutionEngine accepts any well-formed payload, so payloads
-here carry consistent consensus-side fields only)."""
+"""Execution-payload construction for tests, with real EL data structures.
+
+Reference analogue: test/helpers/execution_payload.py. Like the reference,
+the EL block hash is the keccak-256 of the RLP-encoded execution block
+header (reference: execution_payload.py:121-190), transaction/withdrawal
+roots are EIP-2718-style Merkle-Patricia trie roots over rlp(index)=>data
+(reference: :100-110), and electra's requests_hash follows EIP-7685
+(reference: :113-118). The reference gets keccak/RLP/trie from the
+eth-hash/rlp/trie pip packages; here they are first-party
+(utils/keccak.py, utils/rlp.py, utils/mpt.py) since none of those exist
+in this environment.
+"""
 
 from __future__ import annotations
 
-from eth_consensus_specs_tpu.ssz import Bytes32
+from hashlib import sha256
 
-from .forks import is_post_capella, is_post_electra
+from eth_consensus_specs_tpu.ssz import Bytes32, hash_tree_root
+from eth_consensus_specs_tpu.utils.keccak import keccak_256
+from eth_consensus_specs_tpu.utils.mpt import indexed_trie_root
+from eth_consensus_specs_tpu.utils.rlp import rlp_encode
+
+from .forks import is_post_capella, is_post_deneb, is_post_electra, is_post_gloas
 
 GENESIS_BLOCK_HASH = b"\x30" * 32
 DEFAULT_GAS_LIMIT = 30_000_000
 DEFAULT_BASE_FEE = 1_000_000_000
 
+# keccak256(rlp([])) — the ommers hash of every post-merge block
+# (reference: execution_payload.py:139-142 hardcodes the same constant).
+EMPTY_OMMERS_HASH = keccak_256(rlp_encode([]))
 
-def compute_el_block_hash(spec, payload) -> bytes:
-    """Deterministic stand-in for the EL block hash (the engine protocol
-    owns real validation; reference tests fake it with RLP header hashing)."""
-    return spec.hash(
-        bytes(payload.parent_hash)
-        + bytes(payload.prev_randao)
-        + int(payload.block_number).to_bytes(8, "little")
-        + int(payload.timestamp).to_bytes(8, "little")
+
+def transactions_trie_root(transactions) -> bytes:
+    """EIP-2718: patriciaTrie(rlp(index) => transaction) root
+    (reference: execution_payload.py:100-110)."""
+    return indexed_trie_root([bytes(tx) for tx in transactions])
+
+
+def withdrawal_rlp(withdrawal) -> bytes:
+    """EIP-4895 withdrawal encoding (reference: execution_payload.py:194-210)."""
+    return rlp_encode(
+        [
+            int(withdrawal.index),
+            int(withdrawal.validator_index),
+            bytes(withdrawal.address),
+            int(withdrawal.amount),
+        ]
+    )
+
+
+def withdrawals_trie_root(withdrawals) -> bytes:
+    return indexed_trie_root([withdrawal_rlp(w) for w in withdrawals])
+
+
+def deposit_request_rlp_bytes(request) -> bytes:
+    """EIP-6110 typed request payload (reference: execution_payload.py:213-230)."""
+    return b"\x00" + rlp_encode(
+        [
+            bytes(request.pubkey),
+            bytes(request.withdrawal_credentials),
+            int(request.amount),
+            bytes(request.signature),
+            int(request.index),
+        ]
+    )
+
+
+def withdrawal_request_rlp_bytes(request) -> bytes:
+    """EIP-7002 typed request payload (reference: execution_payload.py:233-245).
+
+    Note the EL's on-chain encoding also carries the amount; the reference
+    test fake encodes only (source_address, pubkey) and parity with it is
+    what matters here.
+    """
+    return b"\x01" + rlp_encode(
+        [bytes(request.source_address), bytes(request.validator_pubkey)]
+    )
+
+
+def consolidation_request_rlp_bytes(request) -> bytes:
+    """EIP-7251 typed request payload (reference: execution_payload.py:248-262)."""
+    return b"\x02" + rlp_encode(
+        [
+            bytes(request.source_address),
+            bytes(request.source_pubkey),
+            bytes(request.target_pubkey),
+        ]
+    )
+
+
+def compute_requests_hash(block_requests) -> bytes:
+    """EIP-7685 commitment: sha256 over sha256 of each non-empty request
+    (reference: execution_payload.py:113-118)."""
+    outer = sha256()
+    for request in block_requests:
+        if len(request) > 1:
+            outer.update(sha256(bytes(request)).digest())
+    return outer.digest()
+
+
+def compute_el_header_block_hash(
+    spec,
+    payload,
+    parent_beacon_block_root=None,
+    requests_hash=None,
+) -> bytes:
+    """keccak256(rlp(execution block header)) per EIP-3675/4895/4844/7685
+    (reference: execution_payload.py:121-190). Gloas externalizes payload
+    validity to the builder path, so the hash is zero there, matching the
+    reference (:132-133)."""
+    if is_post_gloas(spec):
+        return b"\x00" * 32
+
+    header_fields = [
+        bytes(payload.parent_hash),
+        EMPTY_OMMERS_HASH,
+        bytes(payload.fee_recipient),
+        bytes(payload.state_root),
+        transactions_trie_root(payload.transactions),
+        bytes(payload.receipts_root),
+        bytes(payload.logs_bloom),
+        0,  # difficulty is zero post-merge
+        int(payload.block_number),
+        int(payload.gas_limit),
+        int(payload.gas_used),
+        int(payload.timestamp),
+        bytes(payload.extra_data),
+        bytes(payload.prev_randao),
+        b"\x00" * 8,  # nonce is zero post-merge
+        int(payload.base_fee_per_gas),
+    ]
+    if is_post_capella(spec):
+        header_fields.append(withdrawals_trie_root(payload.withdrawals))
+    if is_post_deneb(spec):
+        header_fields.append(int(payload.blob_gas_used))
+        header_fields.append(int(payload.excess_blob_gas))
+        header_fields.append(bytes(parent_beacon_block_root or b"\x00" * 32))
+    if is_post_electra(spec):
+        header_fields.append(bytes(requests_hash or compute_requests_hash([])))
+    return keccak_256(rlp_encode(header_fields))
+
+
+def _parent_beacon_block_root(spec, pre_state) -> bytes:
+    """EIP-4788 parent root as the EL sees it: the pre-state's latest block
+    header with its state root filled in (reference: execution_payload.py:286-295)."""
+    header = pre_state.latest_block_header.copy()
+    if bytes(header.state_root) == b"\x00" * 32:
+        header.state_root = hash_tree_root(pre_state)
+    return hash_tree_root(header)
+
+
+def compute_el_block_hash(spec, payload, pre_state=None) -> bytes:
+    """EL block hash for a payload carrying no execution requests
+    (reference: execution_payload.py:286-300)."""
+    parent_root = None
+    if is_post_deneb(spec) and pre_state is not None:
+        parent_root = _parent_beacon_block_root(spec, pre_state)
+    return compute_el_header_block_hash(
+        spec, payload, parent_beacon_block_root=parent_root
+    )
+
+
+def compute_el_block_hash_for_block(spec, block) -> bytes:
+    """EL block hash honoring the block's execution requests and parent root
+    (reference: execution_payload.py:303-313)."""
+    requests_hash = None
+    if is_post_electra(spec):
+        requests_list = spec.get_execution_requests_list(block.body.execution_requests)
+        requests_hash = compute_requests_hash(requests_list)
+    return compute_el_header_block_hash(
+        spec,
+        block.body.execution_payload,
+        parent_beacon_block_root=bytes(block.parent_root),
+        requests_hash=requests_hash,
     )
 
 
@@ -62,5 +212,5 @@ def build_empty_execution_payload(spec, state, randao_mix=None):
     elif is_post_capella(spec):
         # process_withdrawals checks the payload against the state's sweep
         payload.withdrawals = spec.get_expected_withdrawals(state)
-    payload.block_hash = Bytes32(compute_el_block_hash(spec, payload))
+    payload.block_hash = Bytes32(compute_el_block_hash(spec, payload, state))
     return payload

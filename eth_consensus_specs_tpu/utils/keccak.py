@@ -1,0 +1,92 @@
+"""First-party Keccak-256 (the pre-NIST-padding SHA-3 variant Ethereum's
+execution layer uses for every hash: block hashes, trie node refs, RLP
+commitment roots).
+
+The reference repo pulls this from the ``eth-hash`` pip package
+(reference: tests/core/pyspec/eth2spec/test/helpers/execution_payload.py:3);
+that package is not in this environment, and the EL-fake machinery
+(RLP header hashing, Merkle-Patricia trie roots) needs it, so this is a
+self-contained implementation of Keccak-f[1600] with rate 1088 / capacity
+512 and the legacy 0x01 domain padding.
+
+Host-side only: these hashes run a handful of times per test to fake EL
+data structures — never in the TPU compute path (the consensus layer's
+hash is SHA-256, see ssz/hashing.py).
+"""
+
+from __future__ import annotations
+
+_ROUND_CONSTANTS = (
+    0x0000000000000001, 0x0000000000008082, 0x800000000000808A,
+    0x8000000080008000, 0x000000000000808B, 0x0000000080000001,
+    0x8000000080008081, 0x8000000000008009, 0x000000000000008A,
+    0x0000000000000088, 0x0000000080008009, 0x000000008000000A,
+    0x000000008000808B, 0x800000000000008B, 0x8000000000008089,
+    0x8000000000008003, 0x8000000000008002, 0x8000000000000080,
+    0x000000000000800A, 0x800000008000000A, 0x8000000080008081,
+    0x8000000000008080, 0x0000000080000001, 0x8000000080008008,
+)
+
+# Rotation offsets r[x][y] from the Keccak specification.
+_ROTATIONS = (
+    (0, 36, 3, 41, 18),
+    (1, 44, 10, 45, 2),
+    (62, 6, 43, 15, 61),
+    (28, 55, 25, 21, 56),
+    (27, 20, 39, 8, 14),
+)
+
+_MASK = (1 << 64) - 1
+_RATE_BYTES = 136  # 1088-bit rate for Keccak-256
+
+
+def _rotl(value: int, shift: int) -> int:
+    return ((value << shift) | (value >> (64 - shift))) & _MASK
+
+
+def _keccak_f(lanes: list[list[int]]) -> None:
+    """In-place Keccak-f[1600] permutation over a 5x5 lane state."""
+    for rc in _ROUND_CONSTANTS:
+        # theta
+        c = [lanes[x][0] ^ lanes[x][1] ^ lanes[x][2] ^ lanes[x][3] ^ lanes[x][4] for x in range(5)]
+        d = [c[(x - 1) % 5] ^ _rotl(c[(x + 1) % 5], 1) for x in range(5)]
+        for x in range(5):
+            for y in range(5):
+                lanes[x][y] ^= d[x]
+        # rho + pi
+        b = [[0] * 5 for _ in range(5)]
+        for x in range(5):
+            for y in range(5):
+                b[y][(2 * x + 3 * y) % 5] = _rotl(lanes[x][y], _ROTATIONS[x][y])
+        # chi
+        for x in range(5):
+            for y in range(5):
+                lanes[x][y] = b[x][y] ^ ((~b[(x + 1) % 5][y] & _MASK) & b[(x + 2) % 5][y])
+        # iota
+        lanes[0][0] ^= rc
+
+
+def keccak_256(data: bytes) -> bytes:
+    """Keccak-256 digest with the legacy 0x01 multi-rate padding
+    (NOT the NIST SHA3-256 0x06 padding)."""
+    lanes = [[0] * 5 for _ in range(5)]
+
+    # Absorb full rate-sized blocks of the padded message.
+    padded = bytearray(data)
+    pad_len = _RATE_BYTES - (len(padded) % _RATE_BYTES)
+    padded += b"\x01" + b"\x00" * (pad_len - 2) + b"\x80" if pad_len >= 2 else b"\x81"
+
+    for block_start in range(0, len(padded), _RATE_BYTES):
+        block = padded[block_start : block_start + _RATE_BYTES]
+        for i in range(_RATE_BYTES // 8):
+            lane = int.from_bytes(block[8 * i : 8 * i + 8], "little")
+            x, y = i % 5, i // 5
+            lanes[x][y] ^= lane
+        _keccak_f(lanes)
+
+    # Squeeze 32 bytes (fits inside one rate block).
+    out = bytearray()
+    for i in range(4):
+        x, y = i % 5, i // 5
+        out += lanes[x][y].to_bytes(8, "little")
+    return bytes(out)

@@ -1,0 +1,111 @@
+"""First-party hexary Merkle-Patricia trie root computation.
+
+The reference repo computes EL transaction/receipt/withdrawal roots with
+the ``trie`` pip package's ``HexaryTrie`` (reference: tests/core/pyspec/
+eth2spec/test/helpers/execution_payload.py:6, 100-110); this is a
+self-contained equivalent that builds the trie functionally from the full
+key set and returns the root hash, which is all the EL fakes need (no
+incremental updates, no proofs, no deletions).
+
+Node model per the Ethereum yellow paper, appendix D:
+- leaf:      [hex-prefix(remaining-nibbles, t=1), value]
+- extension: [hex-prefix(shared-nibbles,    t=0), ref(child)]
+- branch:    [ref(child_0) ... ref(child_15), value]
+- ref(node): rlp(node) if len(rlp(node)) < 32 else keccak256(rlp(node)),
+  except the root, which is always hashed.
+Empty trie root: keccak256(rlp(b'')).
+"""
+
+from __future__ import annotations
+
+from .keccak import keccak_256
+from .rlp import rlp_encode
+
+EMPTY_TRIE_ROOT = keccak_256(rlp_encode(b""))
+
+
+def _nibbles(key: bytes) -> tuple[int, ...]:
+    out = []
+    for byte in key:
+        out.append(byte >> 4)
+        out.append(byte & 0x0F)
+    return tuple(out)
+
+
+def _hex_prefix(nibbles: tuple[int, ...], is_leaf: bool) -> bytes:
+    """Yellow-paper hex-prefix encoding: flag nibble carries parity + leaf bit."""
+    flag = 2 * int(is_leaf)
+    if len(nibbles) % 2 == 1:
+        packed = [(flag + 1) << 4 | nibbles[0]]
+        rest = nibbles[1:]
+    else:
+        packed = [flag << 4]
+        rest = nibbles
+    for i in range(0, len(rest), 2):
+        packed.append(rest[i] << 4 | rest[i + 1])
+    return bytes(packed)
+
+
+def _node_ref(node) -> bytes | list:
+    encoded = rlp_encode(node)
+    if len(encoded) < 32:
+        return node
+    return keccak_256(encoded)
+
+
+def _build(items: list[tuple[tuple[int, ...], bytes]], depth: int):
+    """Structural node for the given (nibble-key, value) set; keys distinct."""
+    if not items:
+        return b""
+    if len(items) == 1:
+        key, value = items[0]
+        return [_hex_prefix(key[depth:], True), value]
+
+    # Longest common prefix below `depth` across all keys → extension node.
+    first_key = items[0][0]
+    common = 0
+    while all(
+        len(key) > depth + common and key[depth + common] == first_key[depth + common]
+        for key, _ in items
+    ):
+        common += 1
+    if common > 0:
+        child = _build(items, depth + common)
+        return [_hex_prefix(first_key[depth : depth + common], False), _node_ref(child)]
+
+    # Branch node: split on the nibble at `depth`.
+    buckets: list[list] = [[] for _ in range(16)]
+    branch_value = b""
+    for key, value in items:
+        if len(key) == depth:
+            branch_value = value
+        else:
+            buckets[key[depth]].append((key, value))
+    slots = []
+    for bucket in buckets:
+        if not bucket:
+            slots.append(b"")
+        else:
+            slots.append(_node_ref(_build(bucket, depth + 1)))
+    return slots + [branch_value]
+
+
+def trie_root(entries: dict[bytes, bytes]) -> bytes:
+    """Root hash of the MPT mapping each key to its value.
+
+    Empty values are skipped, matching HexaryTrie.set semantics where
+    setting b'' deletes the key (reference: execution_payload.py:105-106).
+    """
+    items = sorted(
+        (_nibbles(key), value) for key, value in entries.items() if value != b""
+    )
+    if not items:
+        return EMPTY_TRIE_ROOT
+    return keccak_256(rlp_encode(_build(items, 0)))
+
+
+def indexed_trie_root(values: list[bytes]) -> bytes:
+    """Root of patriciaTrie(rlp(index) => value), the EIP-2718 shape used
+    for transaction/receipt/withdrawal roots (reference:
+    execution_payload.py:100-110)."""
+    return trie_root({rlp_encode(i): value for i, value in enumerate(values)})

@@ -1,0 +1,43 @@
+"""First-party RLP (Recursive Length Prefix) encoding.
+
+The reference repo uses the ``rlp`` pip package with explicit sedes
+schemas (reference: tests/core/pyspec/eth2spec/test/helpers/
+execution_payload.py:4-5, 134-190); here the helpers pass plain Python
+values — ``bytes`` and non-negative ``int`` (big-endian minimal) and
+(nested) lists thereof — which covers every EL structure the test fakes
+build: block headers, withdrawals, EIP-7685 request payloads, and trie
+keys. Encoding only: the consensus layer never decodes RLP.
+"""
+
+from __future__ import annotations
+
+
+def encode_int(value: int) -> bytes:
+    """Big-endian minimal integer payload (0 encodes as the empty string)."""
+    if value < 0:
+        raise ValueError("RLP integers are non-negative")
+    if value == 0:
+        return b""
+    return value.to_bytes((value.bit_length() + 7) // 8, "big")
+
+
+def _length_prefix(length: int, short_offset: int) -> bytes:
+    if length < 56:
+        return bytes([short_offset + length])
+    length_bytes = encode_int(length)
+    return bytes([short_offset + 55 + len(length_bytes)]) + length_bytes
+
+
+def rlp_encode(item) -> bytes:
+    """RLP-encode bytes / int / (nested) list-or-tuple of the same."""
+    if isinstance(item, int) and not isinstance(item, bool):
+        item = encode_int(item)
+    if isinstance(item, (bytes, bytearray, memoryview)):
+        payload = bytes(item)
+        if len(payload) == 1 and payload[0] < 0x80:
+            return payload
+        return _length_prefix(len(payload), 0x80) + payload
+    if isinstance(item, (list, tuple)):
+        payload = b"".join(rlp_encode(sub) for sub in item)
+        return _length_prefix(len(payload), 0xC0) + payload
+    raise TypeError(f"cannot RLP-encode {type(item).__name__}")

@@ -1,0 +1,267 @@
+"""First-party EL data-structure fakes: keccak-256, RLP, Merkle-Patricia
+trie, and the EL block-hash machinery built from them.
+
+Reference analogue: the eth-hash/rlp/trie pip packages wired through
+test/helpers/execution_payload.py:100-313. Known-answer vectors come from
+the upstream Keccak reference vectors and ethereum/tests TrieTests.
+"""
+
+import pytest
+
+from eth_consensus_specs_tpu.ssz import Bytes32
+from eth_consensus_specs_tpu.test_infra.context import spec_state_test, with_phases
+from eth_consensus_specs_tpu.test_infra.execution_payload import (
+    build_empty_execution_payload,
+    compute_el_block_hash,
+    compute_el_block_hash_for_block,
+    compute_requests_hash,
+    consolidation_request_rlp_bytes,
+    deposit_request_rlp_bytes,
+    withdrawal_request_rlp_bytes,
+    transactions_trie_root,
+    withdrawal_rlp,
+    withdrawals_trie_root,
+)
+from eth_consensus_specs_tpu.test_infra.block import build_empty_block_for_next_slot
+from eth_consensus_specs_tpu.test_infra.state import next_slot
+from eth_consensus_specs_tpu.utils.keccak import keccak_256
+from eth_consensus_specs_tpu.utils.mpt import EMPTY_TRIE_ROOT, indexed_trie_root, trie_root
+from eth_consensus_specs_tpu.utils.rlp import rlp_encode
+
+
+# ---------------------------------------------------------------- keccak-256
+
+KECCAK_VECTORS = [
+    # (message, digest) — legacy 0x01 padding, NOT NIST SHA3-256
+    (b"", "c5d2460186f7233c927e7db2dcc703c0e500b653ca82273b7bfad8045d85a470"),
+    (b"abc", "4e03657aea45a94fc7d47ba826c8d667c0d1e6e33a64a036ec44f58fa12d6c45"),
+    (
+        b"The quick brown fox jumps over the lazy dog",
+        "4d741b6f1eb29cb2a9b9911c82f56fa8d73b04959d3d9d222895df6c0b28aa15",
+    ),
+]
+
+
+@pytest.mark.parametrize("message,digest", KECCAK_VECTORS, ids=["empty", "abc", "fox"])
+def test_keccak_known_answer(message, digest):
+    assert keccak_256(message).hex() == digest
+
+
+@pytest.mark.parametrize("length", [0, 1, 135, 136, 137, 271, 272, 273, 500])
+def test_keccak_rate_boundaries(length):
+    # Every length near a 136-byte rate multiple must absorb cleanly and
+    # produce distinct digests from its neighbors.
+    a = keccak_256(b"\x5a" * length)
+    b = keccak_256(b"\x5a" * (length + 1))
+    assert len(a) == 32 and a != b
+
+
+# ---------------------------------------------------------------------- RLP
+
+
+RLP_VECTORS = [
+    (b"", "80"),
+    (b"\x00", "00"),
+    (b"\x7f", "7f"),
+    (b"\x80", "8180"),
+    (b"dog", "83646f67"),
+    (0, "80"),
+    (15, "0f"),
+    (1024, "820400"),
+    ([], "c0"),
+    ([b"cat", b"dog"], "c88363617483646f67"),
+    (b"a" * 55, "b7" + "61" * 55),
+    (b"a" * 56, "b838" + "61" * 56),
+    ([[], [[]], [[], [[]]]], "c7c0c1c0c3c0c1c0"),
+]
+
+
+@pytest.mark.parametrize("value,expected", RLP_VECTORS)
+def test_rlp_known_answer(value, expected):
+    assert rlp_encode(value).hex() == expected
+
+
+def test_rlp_rejects_negative_and_foreign_types():
+    with pytest.raises(ValueError):
+        rlp_encode(-1)
+    with pytest.raises(TypeError):
+        rlp_encode(1.5)
+
+
+# -------------------------------------------------------- Merkle-Patricia trie
+
+
+def test_empty_trie_root():
+    assert (
+        EMPTY_TRIE_ROOT.hex()
+        == "56e81f171bcc55a6ff8345e692c0f86e5b48e01b996cadc001622fb5e363b421"
+    )
+    assert trie_root({}) == EMPTY_TRIE_ROOT
+    # Empty values delete: a trie of only-empty values is the empty trie.
+    assert trie_root({b"k": b""}) == EMPTY_TRIE_ROOT
+
+
+TRIE_VECTORS = [
+    # ethereum/tests TrieTests/trietest.json shapes (insert-any-order roots)
+    (
+        {b"do": b"verb", b"dog": b"puppy", b"doge": b"coin", b"horse": b"stallion"},
+        "5991bb8c6514148a29db676a14ac506cd2cd5775ace63c30a4fe457715e9ac84",
+    ),
+    (
+        {b"foo": b"bar", b"food": b"bass"},
+        "17beaa1648bafa633cda809c90c04af50fc8aed3cb40d16efbddee6fdf63c4c3",
+    ),
+    (
+        {b"be": b"e", b"dog": b"puppy", b"bed": b"d"},
+        "3f67c7a47520f79faa29255d2d3c084a7a6df0453116ed7232ff10277a8be68b",
+    ),
+    (
+        {b"test": b"test"},
+        "85d106d4edff3b7a4889e91251d0a87d7c17a1dda648ebdba8c6060825be23b8",
+    ),
+]
+
+
+@pytest.mark.parametrize("entries,root", TRIE_VECTORS, ids=["doge", "foo", "bed", "single"])
+def test_trie_known_answer(entries, root):
+    assert trie_root(entries).hex() == root
+
+
+def test_trie_insertion_order_free_and_value_sensitive():
+    entries = {bytes([i]): bytes([i]) * 4 for i in range(32)}
+    base = trie_root(entries)
+    mutated = dict(entries)
+    mutated[b"\x07"] = b"\xff" * 4
+    assert trie_root(mutated) != base
+
+
+def test_indexed_trie_matches_manual_keys():
+    values = [b"tx-%d" % i for i in range(20)]
+    manual = trie_root({rlp_encode(i): v for i, v in enumerate(values)})
+    assert indexed_trie_root(values) == manual
+
+
+def test_indexed_trie_distinguishes_order_and_content():
+    a = indexed_trie_root([b"one", b"two"])
+    b = indexed_trie_root([b"two", b"one"])
+    c = indexed_trie_root([b"one"])
+    assert len({a, b, c}) == 3
+
+
+# ------------------------------------------------------- EL header block hash
+
+
+def test_requests_hash_empty_and_skip_rule():
+    # sha256 of nothing concatenated — EIP-7685 empty commitment
+    import hashlib
+
+    assert compute_requests_hash([]) == hashlib.sha256().digest()
+    # single-byte requests are skipped (type byte alone carries no payload)
+    assert compute_requests_hash([b"\x00"]) == compute_requests_hash([])
+    assert compute_requests_hash([b"\x00\x01"]) != compute_requests_hash([])
+
+
+@with_phases(["bellatrix", "capella", "deneb", "electra"])
+@spec_state_test
+def test_el_block_hash_depends_on_payload_fields(spec, state):
+    next_slot(spec, state)
+    payload = build_empty_execution_payload(spec, state)
+    base = compute_el_block_hash(spec, payload, state)
+    assert payload.block_hash == Bytes32(base)
+
+    mutated = payload.copy()
+    mutated.gas_limit = int(payload.gas_limit) + 1
+    assert compute_el_block_hash(spec, mutated, state) != base
+
+    mutated = payload.copy()
+    mutated.transactions = [b"\x02" + b"\x01" * 40]
+    assert compute_el_block_hash(spec, mutated, state) != base
+
+
+@with_phases(["capella", "deneb", "electra"])
+@spec_state_test
+def test_el_block_hash_covers_withdrawals_trie(spec, state):
+    next_slot(spec, state)
+    payload = build_empty_execution_payload(spec, state)
+    base = compute_el_block_hash(spec, payload, state)
+    mutated = payload.copy()
+    mutated.withdrawals = [
+        spec.Withdrawal(index=7, validator_index=3, address=b"\x22" * 20, amount=1)
+    ]
+    assert compute_el_block_hash(spec, mutated, state) != base
+    # and the trie over withdrawals is order/content sensitive
+    w = spec.Withdrawal(index=1, validator_index=2, address=b"\x33" * 20, amount=9)
+    assert withdrawals_trie_root([w]) != withdrawals_trie_root([])
+    assert len(withdrawal_rlp(w)) > 0
+
+
+@with_phases(["deneb", "electra"])
+@spec_state_test
+def test_el_block_hash_binds_parent_beacon_root(spec, state):
+    # EIP-4788: the same payload under a different parent beacon block root
+    # hashes differently (reference: execution_payload.py:286-295).
+    next_slot(spec, state)
+    payload = build_empty_execution_payload(spec, state)
+    with_state = compute_el_block_hash(spec, payload, state)
+    without_state = compute_el_block_hash(spec, payload, None)
+    assert with_state != without_state
+
+
+@with_phases(["electra"])
+@spec_state_test
+def test_el_block_hash_binds_execution_requests(spec, state):
+    next_slot(spec, state)
+    block = build_empty_block_for_next_slot(spec, state)
+    base = compute_el_block_hash_for_block(spec, block)
+    block.body.execution_requests.deposits = [
+        spec.DepositRequest(
+            pubkey=b"\x11" * 48,
+            withdrawal_credentials=b"\x22" * 32,
+            amount=32_000_000_000,
+            signature=b"\x33" * 96,
+            index=0,
+        )
+    ]
+    assert compute_el_block_hash_for_block(spec, block) != base
+    req = block.body.execution_requests.deposits[0]
+    assert deposit_request_rlp_bytes(req)[0] == 0x00
+
+
+@with_phases(["electra"])
+@spec_state_test
+def test_typed_request_rlp_encodings(spec, state):
+    # EIP-7685 typed request payloads: type byte + rlp(fields), matching the
+    # reference's test fakes (reference: execution_payload.py:213-262).
+    dep = spec.DepositRequest(
+        pubkey=b"\x11" * 48,
+        withdrawal_credentials=b"\x22" * 32,
+        amount=32_000_000_000,
+        signature=b"\x33" * 96,
+        index=5,
+    )
+    enc = deposit_request_rlp_bytes(dep)
+    assert enc == b"\x00" + rlp_encode(
+        [b"\x11" * 48, b"\x22" * 32, 32_000_000_000, b"\x33" * 96, 5]
+    )
+
+    wr = spec.WithdrawalRequest(
+        source_address=b"\x44" * 20, validator_pubkey=b"\x55" * 48, amount=7
+    )
+    enc = withdrawal_request_rlp_bytes(wr)
+    assert enc == b"\x01" + rlp_encode([b"\x44" * 20, b"\x55" * 48])
+
+    cr = spec.ConsolidationRequest(
+        source_address=b"\x66" * 20,
+        source_pubkey=b"\x77" * 48,
+        target_pubkey=b"\x88" * 48,
+    )
+    enc = consolidation_request_rlp_bytes(cr)
+    assert enc == b"\x02" + rlp_encode([b"\x66" * 20, b"\x77" * 48, b"\x88" * 48])
+    # distinct type bytes keep the three request kinds domain-separated
+    assert {deposit_request_rlp_bytes(dep)[0], enc[0], withdrawal_request_rlp_bytes(wr)[0]} == {0, 1, 2}
+
+
+@with_phases(["bellatrix"])
+@spec_state_test
+def test_transactions_trie_empty_matches_empty_trie(spec, state):
+    assert transactions_trie_root([]) == EMPTY_TRIE_ROOT
