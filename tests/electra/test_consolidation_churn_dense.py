@@ -1,0 +1,316 @@
+"""Consolidation churn-accounting suite, electra+ (reference analogue:
+test/electra/block_processing/test_process_consolidation_request.py —
+the churn-arithmetic families: current/new consolidation epoch,
+preexisting churn, multi-epoch spillover, and the switch-to-compounding
+excess-queueing flows).
+
+Spec: specs/electra/beacon-chain.md compute_consolidation_epoch_and_update_churn
+— consolidations consume a per-epoch balance budget
+(get_consolidation_churn_limit); oversize balances push the exit epoch out
+by whole epochs of budget."""
+
+from eth_consensus_specs_tpu.test_infra.context import spec_state_test, with_phases
+from eth_consensus_specs_tpu.test_infra.state import next_slots
+
+ELECTRA_FORKS = ["electra", "fulu"]
+GWEI = 1_000_000_000
+
+
+def _mature(spec, state):
+    state.slot = int(spec.config.SHARD_COMMITTEE_PERIOD) * int(spec.SLOTS_PER_EPOCH)
+
+
+def _compounding(spec, state, index, tag, balance=None):
+    address = bytes([0x70 + tag]) * 20
+    state.validators[index].withdrawal_credentials = (
+        bytes(spec.COMPOUNDING_WITHDRAWAL_PREFIX) + b"\x00" * 11 + address
+    )
+    if balance is not None:
+        state.validators[index].effective_balance = balance
+        state.balances[index] = balance
+    return address
+
+
+def _eth1(spec, state, index, tag):
+    address = bytes([0x80 + tag]) * 20
+    state.validators[index].withdrawal_credentials = (
+        bytes(spec.ETH1_ADDRESS_WITHDRAWAL_PREFIX) + b"\x00" * 11 + address
+    )
+    return address
+
+
+def _request(spec, state, src, dst):
+    return spec.ConsolidationRequest(
+        source_address=bytes(state.validators[src].withdrawal_credentials)[12:],
+        source_pubkey=state.validators[src].pubkey,
+        target_pubkey=state.validators[dst].pubkey,
+    )
+
+
+def _consolidate(spec, state, src=1, dst=2, src_balance=None):
+    _mature(spec, state)
+    _compounding(spec, state, src, src, balance=src_balance)
+    _compounding(spec, state, dst, dst)
+    req = _request(spec, state, src, dst)
+    spec.process_consolidation_request(state, req)
+    return state.validators[src]
+
+
+# ----------------------------------------------------------- churn budget
+
+
+@with_phases(ELECTRA_FORKS)
+@spec_state_test
+def test_consolidation_sets_earliest_epoch_floor(spec, state):
+    source = _consolidate(spec, state)
+    floor = int(spec.compute_activation_exit_epoch(spec.get_current_epoch(state)))
+    assert int(source.exit_epoch) >= floor
+    assert int(state.earliest_consolidation_epoch) == int(source.exit_epoch)
+    assert int(source.withdrawable_epoch) == int(source.exit_epoch) + int(
+        spec.config.MIN_VALIDATOR_WITHDRAWABILITY_DELAY
+    )
+
+
+@with_phases(ELECTRA_FORKS)
+@spec_state_test
+def test_consolidation_consumes_budget(spec, state):
+    limit = int(spec.get_consolidation_churn_limit(state))
+    source = _consolidate(spec, state)
+    eb = int(source.effective_balance)
+    # fresh epoch: budget = limit, consumed = effective balance
+    assert int(state.consolidation_balance_to_consume) == limit - eb
+
+
+@with_phases(ELECTRA_FORKS)
+@spec_state_test
+def test_second_consolidation_same_epoch_shares_budget(spec, state):
+    _mature(spec, state)
+    for i in (1, 2, 3, 4):
+        _compounding(spec, state, i, i)
+    spec.process_consolidation_request(state, _request(spec, state, 1, 2))
+    first_epoch = int(state.validators[1].exit_epoch)
+    budget_after_first = int(state.consolidation_balance_to_consume)
+    spec.process_consolidation_request(state, _request(spec, state, 3, 4))
+    eb = int(state.validators[3].effective_balance)
+    if budget_after_first >= eb:
+        # fits in the same epoch's leftover budget
+        assert int(state.validators[3].exit_epoch) == first_epoch
+        assert (
+            int(state.consolidation_balance_to_consume) == budget_after_first - eb
+        )
+    else:
+        assert int(state.validators[3].exit_epoch) > first_epoch
+
+
+@with_phases(ELECTRA_FORKS)
+@spec_state_test
+def test_consolidation_with_preexisting_churn(spec, state):
+    """Pre-seeded consolidation_balance_to_consume at the current earliest
+    epoch is honored, not reset."""
+    _mature(spec, state)
+    floor = int(spec.compute_activation_exit_epoch(spec.get_current_epoch(state)))
+    state.earliest_consolidation_epoch = floor
+    preexisting = 2 * int(spec.EFFECTIVE_BALANCE_INCREMENT)
+    state.consolidation_balance_to_consume = preexisting
+    eb = int(state.validators[1].effective_balance)
+    assert eb > preexisting  # source doesn't fit the leftover budget
+    source = _consolidate(spec, state)
+    # budget exhausted: epoch pushed past the floor
+    assert int(source.exit_epoch) > floor
+
+
+@with_phases(ELECTRA_FORKS)
+@spec_state_test
+def test_consolidation_balance_through_multiple_churn_epochs(spec, state):
+    """Source balance worth several epochs of churn pushes earliest epoch
+    out by ceil(balance/limit) epochs."""
+    _mature(spec, state)
+    limit = int(spec.get_consolidation_churn_limit(state))
+    big = 3 * limit
+    source = _consolidate(spec, state, src_balance=big)
+    floor = int(spec.compute_activation_exit_epoch(spec.get_current_epoch(state)))
+    assert int(source.exit_epoch) >= floor + 2
+    # leftover budget for the final epoch is nonnegative and below the limit
+    leftover = int(state.consolidation_balance_to_consume)
+    assert 0 <= leftover < limit
+
+
+@with_phases(ELECTRA_FORKS)
+@spec_state_test
+def test_consolidation_exact_churn_limit_balance(spec, state):
+    _mature(spec, state)
+    _compounding(spec, state, 1, 1)
+    _compounding(spec, state, 2, 2)
+    # fixpoint: the source's own effective balance feeds total active
+    # balance, which feeds the churn limit — iterate until stable
+    for _ in range(10):
+        limit = int(spec.get_consolidation_churn_limit(state))
+        if int(state.validators[1].effective_balance) == limit:
+            break
+        state.validators[1].effective_balance = limit
+        state.balances[1] = limit
+    assert int(state.validators[1].effective_balance) == limit
+    spec.process_consolidation_request(state, _request(spec, state, 1, 2))
+    floor = int(spec.compute_activation_exit_epoch(spec.get_current_epoch(state)))
+    assert int(state.validators[1].exit_epoch) == floor
+    assert int(state.consolidation_balance_to_consume) == 0
+
+
+@with_phases(ELECTRA_FORKS)
+@spec_state_test
+def test_consolidation_source_below_max_effective_balance(spec, state):
+    """A source with less than the eth1 cap still consolidates (its
+    effective balance is what churns)."""
+    small = int(spec.MIN_ACTIVATION_BALANCE) - 2 * int(
+        spec.EFFECTIVE_BALANCE_INCREMENT
+    )
+    source = _consolidate(spec, state, src_balance=small)
+    assert int(source.exit_epoch) != int(spec.FAR_FUTURE_EPOCH)
+    assert len(state.pending_consolidations) == 1
+
+
+@with_phases(ELECTRA_FORKS)
+@spec_state_test
+def test_consolidation_pending_entry_records_pair(spec, state):
+    _consolidate(spec, state, src=5, dst=6)
+    entry = state.pending_consolidations[0]
+    assert int(entry.source_index) == 5 and int(entry.target_index) == 6
+
+
+# ------------------------------------------------- switch to compounding
+
+
+@with_phases(ELECTRA_FORKS)
+@spec_state_test
+def test_switch_to_compounding_queues_excess(spec, state):
+    _mature(spec, state)
+    _eth1(spec, state, 1, 1)
+    extra = 3 * GWEI
+    state.balances[1] = int(spec.MIN_ACTIVATION_BALANCE) + extra
+    req = spec.ConsolidationRequest(
+        source_address=bytes(state.validators[1].withdrawal_credentials)[12:],
+        source_pubkey=state.validators[1].pubkey,
+        target_pubkey=state.validators[1].pubkey,
+    )
+    pre_deposits = len(state.pending_deposits)
+    spec.process_consolidation_request(state, req)
+    creds = bytes(state.validators[1].withdrawal_credentials)
+    assert creds[:1] == bytes(spec.COMPOUNDING_WITHDRAWAL_PREFIX)
+    # excess moved to the pending-deposit queue, balance clipped to min
+    assert int(state.balances[1]) == int(spec.MIN_ACTIVATION_BALANCE)
+    assert len(state.pending_deposits) == pre_deposits + 1
+    assert int(state.pending_deposits[-1].amount) == extra
+
+
+@with_phases(ELECTRA_FORKS)
+@spec_state_test
+def test_switch_to_compounding_no_excess_no_deposit(spec, state):
+    _mature(spec, state)
+    _eth1(spec, state, 1, 1)
+    state.balances[1] = int(spec.MIN_ACTIVATION_BALANCE)
+    req = spec.ConsolidationRequest(
+        source_address=bytes(state.validators[1].withdrawal_credentials)[12:],
+        source_pubkey=state.validators[1].pubkey,
+        target_pubkey=state.validators[1].pubkey,
+    )
+    pre_deposits = len(state.pending_deposits)
+    spec.process_consolidation_request(state, req)
+    assert bytes(state.validators[1].withdrawal_credentials)[:1] == bytes(
+        spec.COMPOUNDING_WITHDRAWAL_PREFIX
+    )
+    assert len(state.pending_deposits) == pre_deposits
+
+
+@with_phases(ELECTRA_FORKS)
+@spec_state_test
+def test_switch_to_compounding_works_when_pending_queue_full(spec, state):
+    """Switch requests bypass the pending_consolidations limit — they never
+    enqueue a consolidation."""
+    limit = int(spec.PENDING_CONSOLIDATIONS_LIMIT)
+    if limit > 64:
+        return
+    _mature(spec, state)
+    for _ in range(limit):
+        state.pending_consolidations.append(
+            spec.PendingConsolidation(source_index=8, target_index=9)
+        )
+    _eth1(spec, state, 1, 1)
+    req = spec.ConsolidationRequest(
+        source_address=bytes(state.validators[1].withdrawal_credentials)[12:],
+        source_pubkey=state.validators[1].pubkey,
+        target_pubkey=state.validators[1].pubkey,
+    )
+    spec.process_consolidation_request(state, req)
+    assert bytes(state.validators[1].withdrawal_credentials)[:1] == bytes(
+        spec.COMPOUNDING_WITHDRAWAL_PREFIX
+    )
+
+
+@with_phases(ELECTRA_FORKS)
+@spec_state_test
+def test_switch_to_compounding_already_compounding_falls_through(spec, state):
+    """Self-request from a validator already holding 0x02 creds is NOT a
+    valid switch (needs 0x01) and then fails source==target — full noop."""
+    _mature(spec, state)
+    _compounding(spec, state, 1, 1)
+    pre_root = bytes(spec.hash_tree_root(state)) if hasattr(spec, "hash_tree_root") else None
+    req = spec.ConsolidationRequest(
+        source_address=bytes(state.validators[1].withdrawal_credentials)[12:],
+        source_pubkey=state.validators[1].pubkey,
+        target_pubkey=state.validators[1].pubkey,
+    )
+    pre_deposits = len(state.pending_deposits)
+    pre_pending = len(state.pending_consolidations)
+    spec.process_consolidation_request(state, req)
+    assert bytes(state.validators[1].withdrawal_credentials)[:1] == bytes(
+        spec.COMPOUNDING_WITHDRAWAL_PREFIX
+    )
+    assert len(state.pending_deposits) == pre_deposits
+    assert len(state.pending_consolidations) == pre_pending
+
+
+@with_phases(ELECTRA_FORKS)
+@spec_state_test
+def test_switch_to_compounding_exited_source_noop(spec, state):
+    _mature(spec, state)
+    _eth1(spec, state, 1, 1)
+    state.validators[1].exit_epoch = int(spec.get_current_epoch(state)) + 3
+    req = spec.ConsolidationRequest(
+        source_address=bytes(state.validators[1].withdrawal_credentials)[12:],
+        source_pubkey=state.validators[1].pubkey,
+        target_pubkey=state.validators[1].pubkey,
+    )
+    spec.process_consolidation_request(state, req)
+    assert bytes(state.validators[1].withdrawal_credentials)[:1] == bytes(
+        spec.ETH1_ADDRESS_WITHDRAWAL_PREFIX
+    )
+
+
+# --------------------------------------------------------------- blockers
+
+
+@with_phases(ELECTRA_FORKS)
+@spec_state_test
+def test_consolidation_blocked_by_pending_withdrawal(spec, state):
+    _mature(spec, state)
+    _compounding(spec, state, 1, 1)
+    _compounding(spec, state, 2, 2)
+    state.pending_partial_withdrawals.append(
+        spec.PendingPartialWithdrawal(
+            validator_index=1, amount=GWEI, withdrawable_epoch=10
+        )
+    )
+    spec.process_consolidation_request(state, _request(spec, state, 1, 2))
+    assert int(state.validators[1].exit_epoch) == int(spec.FAR_FUTURE_EPOCH)
+    assert len(state.pending_consolidations) == 0
+
+
+@with_phases(ELECTRA_FORKS)
+@spec_state_test
+def test_consolidation_source_too_young_noop(spec, state):
+    # no _mature: activation + SHARD_COMMITTEE_PERIOD gate fails at genesis
+    _compounding(spec, state, 1, 1)
+    _compounding(spec, state, 2, 2)
+    spec.process_consolidation_request(state, _request(spec, state, 1, 2))
+    assert int(state.validators[1].exit_epoch) == int(spec.FAR_FUTURE_EPOCH)
+    assert len(state.pending_consolidations) == 0

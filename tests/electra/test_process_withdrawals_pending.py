@@ -1,0 +1,272 @@
+"""Dense electra process_withdrawals suite: the pending-partial-withdrawal
+queue drain interacting with the capella sweep (reference analogue:
+test/electra/block_processing/test_process_withdrawals.py — the 27-variant
+EIP-7251 file: skipped-vs-effective queue entries, per-sweep caps,
+compounding boundary arithmetic, same-validator double drains).
+
+Spec: specs/electra/beacon-chain.md get_expected_withdrawals — pending
+partials are consumed FIRST (skippable per-entry), then the sweep runs on
+balances net of what the queue already withdrew."""
+
+from eth_consensus_specs_tpu.test_infra.context import (
+    spec_state_test,
+    with_phases,
+)
+from eth_consensus_specs_tpu.test_infra.execution_payload import (
+    build_empty_execution_payload,
+)
+from eth_consensus_specs_tpu.test_infra.state import next_slot
+from eth_consensus_specs_tpu.test_infra.withdrawals import (
+    run_withdrawals_processing,
+    set_compounding_withdrawal_credential_with_balance,
+    set_validator_fully_withdrawable,
+    set_validator_partially_withdrawable,
+)
+
+ELECTRA_FORKS = ["electra", "fulu"]
+GWEI = 1_000_000_000
+
+
+def _queue(spec, state, index, amount, epochs_ahead=0):
+    state.pending_partial_withdrawals.append(
+        spec.PendingPartialWithdrawal(
+            validator_index=index,
+            amount=amount,
+            withdrawable_epoch=int(spec.get_current_epoch(state)) + epochs_ahead,
+        )
+    )
+
+
+def _compounding_with_excess(spec, state, index, excess):
+    cap = int(spec.MIN_ACTIVATION_BALANCE)
+    set_compounding_withdrawal_credential_with_balance(
+        spec, state, index, balance=cap + excess, effective_balance=cap
+    )
+
+
+def _run(spec, state, valid=True):
+    next_slot(spec, state)
+    payload = build_empty_execution_payload(spec, state)
+    for _ in run_withdrawals_processing(spec, state, payload, valid=valid):
+        pass
+    return payload
+
+
+# ------------------------------------------------------------- queue drain
+
+
+@with_phases(ELECTRA_FORKS)
+@spec_state_test
+def test_pending_withdrawal_effective(spec, state):
+    _compounding_with_excess(spec, state, 1, 3 * GWEI)
+    _queue(spec, state, 1, 2 * GWEI)
+    payload = _run(spec, state)
+    drained = [w for w in payload.withdrawals if int(w.validator_index) == 1]
+    assert len(drained) == 1 and int(drained[0].amount) == 2 * GWEI
+    assert len(state.pending_partial_withdrawals) == 0
+
+
+@with_phases(ELECTRA_FORKS)
+@spec_state_test
+def test_pending_withdrawal_next_epoch_not_drained(spec, state):
+    _compounding_with_excess(spec, state, 1, 3 * GWEI)
+    _queue(spec, state, 1, 2 * GWEI, epochs_ahead=2)
+    payload = _run(spec, state)
+    assert not any(int(w.validator_index) == 1 for w in payload.withdrawals)
+    assert len(state.pending_partial_withdrawals) == 1
+
+
+@with_phases(ELECTRA_FORKS)
+@spec_state_test
+def test_pending_withdrawal_exiting_validator_skipped(spec, state):
+    _compounding_with_excess(spec, state, 1, 3 * GWEI)
+    _queue(spec, state, 1, 2 * GWEI)
+    state.validators[1].exit_epoch = int(spec.get_current_epoch(state)) + 5
+    payload = _run(spec, state)
+    # entry is consumed (popped from the queue) but yields no withdrawal
+    assert not any(int(w.validator_index) == 1 for w in payload.withdrawals)
+    assert len(state.pending_partial_withdrawals) == 0
+
+
+@with_phases(ELECTRA_FORKS)
+@spec_state_test
+def test_pending_withdrawal_low_effective_balance_skipped(spec, state):
+    _compounding_with_excess(spec, state, 1, 3 * GWEI)
+    state.validators[1].effective_balance = (
+        int(spec.MIN_ACTIVATION_BALANCE) - int(spec.EFFECTIVE_BALANCE_INCREMENT)
+    )
+    _queue(spec, state, 1, 2 * GWEI)
+    payload = _run(spec, state)
+    assert not any(int(w.validator_index) == 1 for w in payload.withdrawals)
+    assert len(state.pending_partial_withdrawals) == 0
+
+
+@with_phases(ELECTRA_FORKS)
+@spec_state_test
+def test_pending_withdrawal_no_excess_balance_skipped(spec, state):
+    _compounding_with_excess(spec, state, 1, 0)
+    _queue(spec, state, 1, 2 * GWEI)
+    payload = _run(spec, state)
+    assert not any(int(w.validator_index) == 1 for w in payload.withdrawals)
+    assert len(state.pending_partial_withdrawals) == 0
+
+
+@with_phases(ELECTRA_FORKS)
+@spec_state_test
+def test_pending_one_skipped_one_effective(spec, state):
+    _compounding_with_excess(spec, state, 1, 0)          # will be skipped
+    _compounding_with_excess(spec, state, 2, 3 * GWEI)   # will drain
+    _queue(spec, state, 1, GWEI)
+    _queue(spec, state, 2, GWEI)
+    payload = _run(spec, state)
+    assert not any(int(w.validator_index) == 1 for w in payload.withdrawals)
+    assert any(int(w.validator_index) == 2 for w in payload.withdrawals)
+    assert len(state.pending_partial_withdrawals) == 0
+
+
+@with_phases(ELECTRA_FORKS)
+@spec_state_test
+def test_pending_withdrawals_at_sweep_cap(spec, state):
+    cap = int(spec.MAX_PENDING_PARTIALS_PER_WITHDRAWALS_SWEEP)
+    for i in range(cap + 1):
+        _compounding_with_excess(spec, state, i, 3 * GWEI)
+        _queue(spec, state, i, GWEI)
+    payload = _run(spec, state)
+    queue_drains = [
+        w for w in payload.withdrawals if int(w.validator_index) <= cap
+    ]
+    # only `cap` of the cap+1 queued entries drain this slot
+    assert len(queue_drains) == cap
+    assert len(state.pending_partial_withdrawals) == 1
+
+
+@with_phases(ELECTRA_FORKS)
+@spec_state_test
+def test_pending_two_partials_same_validator_share_balance(spec, state):
+    """Second queue entry for the same validator sees the balance NET of the
+    first drain (total_withdrawn accounting)."""
+    _compounding_with_excess(spec, state, 1, 3 * GWEI)
+    _queue(spec, state, 1, 2 * GWEI)
+    _queue(spec, state, 1, 2 * GWEI)
+    payload = _run(spec, state)
+    drained = [w for w in payload.withdrawals if int(w.validator_index) == 1]
+    assert [int(w.amount) for w in drained] == [2 * GWEI, GWEI]
+    assert len(state.pending_partial_withdrawals) == 0
+
+
+@with_phases(ELECTRA_FORKS)
+@spec_state_test
+def test_pending_second_partial_same_validator_starved(spec, state):
+    _compounding_with_excess(spec, state, 1, 2 * GWEI)
+    _queue(spec, state, 1, 2 * GWEI)
+    _queue(spec, state, 1, 2 * GWEI)
+    payload = _run(spec, state)
+    drained = [w for w in payload.withdrawals if int(w.validator_index) == 1]
+    # first takes the whole excess; second finds no excess and is skipped
+    assert [int(w.amount) for w in drained] == [2 * GWEI]
+
+
+# ----------------------------------------------------- queue + sweep on top
+
+
+@with_phases(ELECTRA_FORKS)
+@spec_state_test
+def test_pending_then_ineffective_sweep_same_validator(spec, state):
+    """Queue drains the excess; the sweep then finds the SAME validator no
+    longer partially withdrawable (balance net of queue = cap)."""
+    cap = int(spec.MAX_EFFECTIVE_BALANCE_ELECTRA)
+    set_compounding_withdrawal_credential_with_balance(
+        spec, state, 1, balance=cap + 2 * GWEI, effective_balance=cap
+    )
+    _queue(spec, state, 1, 2 * GWEI)
+    payload = _run(spec, state)
+    drains = [w for w in payload.withdrawals if int(w.validator_index) == 1]
+    assert len(drains) == 1  # queue drain only, no sweep duplicate
+
+
+@with_phases(ELECTRA_FORKS)
+@spec_state_test
+def test_pending_then_effective_sweep_same_validator(spec, state):
+    """Excess larger than the queued amount: queue drains its part, the
+    sweep withdraws the remainder above the compounding cap."""
+    cap = int(spec.MAX_EFFECTIVE_BALANCE_ELECTRA)
+    set_compounding_withdrawal_credential_with_balance(
+        spec, state, 1, balance=cap + 5 * GWEI, effective_balance=cap
+    )
+    _queue(spec, state, 1, 2 * GWEI)
+    payload = _run(spec, state)
+    drains = [w for w in payload.withdrawals if int(w.validator_index) == 1]
+    assert len(drains) == 2
+    assert int(drains[1].amount) == 3 * GWEI
+
+
+@with_phases(ELECTRA_FORKS)
+@spec_state_test
+def test_pending_with_sweep_different_validator(spec, state):
+    _compounding_with_excess(spec, state, 1, 3 * GWEI)
+    _queue(spec, state, 1, 2 * GWEI)
+    set_validator_partially_withdrawable(spec, state, 2)
+    payload = _run(spec, state)
+    assert any(int(w.validator_index) == 1 for w in payload.withdrawals)
+    assert any(int(w.validator_index) == 2 for w in payload.withdrawals)
+
+
+@with_phases(ELECTRA_FORKS)
+@spec_state_test
+def test_pending_mixed_with_fully_withdrawable_sweep(spec, state):
+    _compounding_with_excess(spec, state, 1, 3 * GWEI)
+    _queue(spec, state, 1, 2 * GWEI)
+    set_validator_fully_withdrawable(spec, state, 3)
+    pre_balance = int(state.balances[3])
+    payload = _run(spec, state)
+    assert any(int(w.validator_index) == 1 for w in payload.withdrawals)
+    full = [w for w in payload.withdrawals if int(w.validator_index) == 3]
+    assert len(full) == 1 and int(full[0].amount) == pre_balance
+    # full withdrawal zeroes the balance
+    assert int(state.balances[3]) == 0
+
+
+# ------------------------------------------- compounding boundary arithmetic
+
+
+def _boundary_case(delta: int, expect_partial: bool):
+    from eth_consensus_specs_tpu.test_infra.template import instantiate  # noqa: F401
+
+    @with_phases(ELECTRA_FORKS)
+    @spec_state_test
+    def case(spec, state):
+        cap = int(spec.MAX_EFFECTIVE_BALANCE_ELECTRA)
+        set_compounding_withdrawal_credential_with_balance(
+            spec, state, 1, balance=cap + delta, effective_balance=cap
+        )
+        is_partial = spec.is_partially_withdrawable_validator(
+            state.validators[1], state.balances[1]
+        )
+        assert is_partial == expect_partial
+        payload = _run(spec, state)
+        swept = [w for w in payload.withdrawals if int(w.validator_index) == 1]
+        assert (len(swept) == 1) == expect_partial
+
+    name = f"test_compounding_boundary_{'plus' if delta >= 0 else 'minus'}_{abs(delta)}"
+    return case, name
+
+
+from eth_consensus_specs_tpu.test_infra.template import instantiate  # noqa: E402
+
+for _delta, _expect in ((1, True), (0, False), (-1, False)):
+    instantiate(_boundary_case, _delta, _expect)
+
+
+# ----------------------------------------------------------------- invalid
+
+
+@with_phases(ELECTRA_FORKS)
+@spec_state_test
+def test_invalid_pending_drain_missing_from_payload(spec, state):
+    _compounding_with_excess(spec, state, 1, 3 * GWEI)
+    _queue(spec, state, 1, 2 * GWEI)
+    next_slot(spec, state)
+    payload = build_empty_execution_payload(spec, state)
+    payload.withdrawals = []
+    for _ in run_withdrawals_processing(spec, state, payload, valid=False):
+        pass

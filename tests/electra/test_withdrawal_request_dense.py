@@ -1,0 +1,337 @@
+"""Dense process_withdrawal_request suite, electra+ (reference analogue:
+test/electra/block_processing/test_process_withdrawal_request.py — the
+29-variant EIP-7002 file; this covers its partial-withdrawal amount
+arithmetic, pending-queue interactions, noop gating, and churn families).
+
+Spec: specs/electra/beacon-chain.md process_withdrawal_request — every
+failed precondition is a silent noop (EL-sourced requests can't be
+'invalid'), so assertions check state deltas, not exceptions."""
+
+from eth_consensus_specs_tpu.test_infra.context import (
+    spec_state_test,
+    with_phases,
+)
+from eth_consensus_specs_tpu.test_infra.withdrawals import (
+    prepare_withdrawal_request,
+    set_compounding_withdrawal_credential_with_balance,
+    set_eth1_withdrawal_credential_with_balance,
+)
+
+ELECTRA_FORKS = ["electra", "fulu"]
+
+
+def _mature(spec, state):
+    """Jump past the SHARD_COMMITTEE_PERIOD activity gate. Direct slot bump:
+    process_withdrawal_request reads only get_current_epoch(state), so full
+    slot processing buys nothing here."""
+    state.slot = int(spec.config.SHARD_COMMITTEE_PERIOD) * int(spec.SLOTS_PER_EPOCH)
+
+
+def _unchanged(spec, state, fn):
+    """Run fn and assert it was a perfect noop on exits and the partial queue."""
+    pre_exits = [int(v.exit_epoch) for v in state.validators]
+    pre_queue = len(state.pending_partial_withdrawals)
+    fn()
+    assert [int(v.exit_epoch) for v in state.validators] == pre_exits
+    assert len(state.pending_partial_withdrawals) == pre_queue
+
+
+def _compounding(spec, state, idx, excess=2_000_000_000):
+    cap = int(spec.MIN_ACTIVATION_BALANCE)
+    return set_compounding_withdrawal_credential_with_balance(
+        spec, state, idx, balance=cap + excess, effective_balance=cap
+    )
+
+
+# ------------------------------------------------------------- full exits
+
+
+@with_phases(ELECTRA_FORKS)
+@spec_state_test
+def test_full_exit_first_validator(spec, state):
+    _mature(spec, state)
+    req = prepare_withdrawal_request(spec, state, 0)
+    spec.process_withdrawal_request(state, req)
+    assert int(state.validators[0].exit_epoch) != int(spec.FAR_FUTURE_EPOCH)
+
+
+@with_phases(ELECTRA_FORKS)
+@spec_state_test
+def test_full_exit_with_compounding_credentials(spec, state):
+    _mature(spec, state)
+    _compounding(spec, state, 3, excess=0)
+    req = prepare_withdrawal_request(spec, state, 3)
+    spec.process_withdrawal_request(state, req)
+    assert int(state.validators[3].exit_epoch) != int(spec.FAR_FUTURE_EPOCH)
+
+
+@with_phases(ELECTRA_FORKS)
+@spec_state_test
+def test_full_exit_blocked_by_pending_partial(spec, state):
+    """A full exit while the validator still has a pending partial
+    withdrawal is a noop (pending_balance_to_withdraw != 0)."""
+    _mature(spec, state)
+    addr = _compounding(spec, state, 4)
+    state.pending_partial_withdrawals.append(
+        spec.PendingPartialWithdrawal(
+            validator_index=4, amount=1_000_000_000, withdrawable_epoch=10
+        )
+    )
+    req = spec.WithdrawalRequest(
+        source_address=addr,
+        validator_pubkey=state.validators[4].pubkey,
+        amount=spec.FULL_EXIT_REQUEST_AMOUNT,
+    )
+    spec.process_withdrawal_request(state, req)
+    assert int(state.validators[4].exit_epoch) == int(spec.FAR_FUTURE_EPOCH)
+
+
+@with_phases(ELECTRA_FORKS)
+@spec_state_test
+def test_full_exit_queue_full_still_processed(spec, state):
+    """The pending-queue-full early return gates only PARTIAL requests;
+    full exits still go through."""
+    _mature(spec, state)
+    limit = int(spec.PENDING_PARTIAL_WITHDRAWALS_LIMIT)
+    if limit > 64:  # only the minimal preset makes saturation practical
+        return
+    for _ in range(limit):
+        state.pending_partial_withdrawals.append(
+            spec.PendingPartialWithdrawal(
+                validator_index=9, amount=1, withdrawable_epoch=10
+            )
+        )
+    req = prepare_withdrawal_request(spec, state, 0)
+    spec.process_withdrawal_request(state, req)
+    assert int(state.validators[0].exit_epoch) != int(spec.FAR_FUTURE_EPOCH)
+
+
+# ------------------------------------------------------- partial arithmetic
+
+
+@with_phases(ELECTRA_FORKS)
+@spec_state_test
+def test_partial_amount_below_excess(spec, state):
+    _mature(spec, state)
+    _compounding(spec, state, 2, excess=2_000_000_000)
+    req = prepare_withdrawal_request(spec, state, 2, amount=1_000_000_000)
+    spec.process_withdrawal_request(state, req)
+    assert len(state.pending_partial_withdrawals) == 1
+    # requested amount fits inside excess: withdraw exactly the request
+    assert int(state.pending_partial_withdrawals[0].amount) == 1_000_000_000
+
+
+@with_phases(ELECTRA_FORKS)
+@spec_state_test
+def test_partial_amount_above_excess_clamped(spec, state):
+    _mature(spec, state)
+    _compounding(spec, state, 2, excess=1_500_000_000)
+    req = prepare_withdrawal_request(spec, state, 2, amount=5_000_000_000)
+    spec.process_withdrawal_request(state, req)
+    assert len(state.pending_partial_withdrawals) == 1
+    # clamped to balance - MIN_ACTIVATION_BALANCE - pending
+    assert int(state.pending_partial_withdrawals[0].amount) == 1_500_000_000
+
+
+@with_phases(ELECTRA_FORKS)
+@spec_state_test
+def test_partial_with_pending_withdrawals_reduces_headroom(spec, state):
+    _mature(spec, state)
+    _compounding(spec, state, 2, excess=3_000_000_000)
+    state.pending_partial_withdrawals.append(
+        spec.PendingPartialWithdrawal(
+            validator_index=2, amount=2_000_000_000, withdrawable_epoch=10
+        )
+    )
+    req = prepare_withdrawal_request(spec, state, 2, amount=5_000_000_000)
+    spec.process_withdrawal_request(state, req)
+    assert len(state.pending_partial_withdrawals) == 2
+    # headroom = 3 ETH excess - 2 ETH already pending = 1 ETH
+    assert int(state.pending_partial_withdrawals[1].amount) == 1_000_000_000
+
+
+@with_phases(ELECTRA_FORKS)
+@spec_state_test
+def test_partial_withdrawable_epoch_includes_delay(spec, state):
+    _mature(spec, state)
+    _compounding(spec, state, 2)
+    req = prepare_withdrawal_request(spec, state, 2, amount=1_000_000_000)
+    spec.process_withdrawal_request(state, req)
+    pending = state.pending_partial_withdrawals[0]
+    exit_epoch = int(pending.withdrawable_epoch) - int(
+        spec.config.MIN_VALIDATOR_WITHDRAWABILITY_DELAY
+    )
+    assert exit_epoch >= int(spec.get_current_epoch(state))
+
+
+@with_phases(ELECTRA_FORKS)
+@spec_state_test
+def test_partial_low_amount_exact(spec, state):
+    _mature(spec, state)
+    _compounding(spec, state, 2, excess=10_000_000_000)
+    req = prepare_withdrawal_request(spec, state, 2, amount=1)
+    spec.process_withdrawal_request(state, req)
+    assert int(state.pending_partial_withdrawals[0].amount) == 1
+
+
+@with_phases(ELECTRA_FORKS)
+@spec_state_test
+def test_two_partials_accumulate(spec, state):
+    _mature(spec, state)
+    _compounding(spec, state, 2, excess=4_000_000_000)
+    req = prepare_withdrawal_request(spec, state, 2, amount=1_000_000_000)
+    spec.process_withdrawal_request(state, req)
+    spec.process_withdrawal_request(state, req)
+    assert len(state.pending_partial_withdrawals) == 2
+    # validator exit is NOT initiated by partial requests
+    assert int(state.validators[2].exit_epoch) == int(spec.FAR_FUTURE_EPOCH)
+
+
+@with_phases(ELECTRA_FORKS)
+@spec_state_test
+def test_partial_churn_shares_exit_queue(spec, state):
+    """Successive partial withdrawals consume exit churn: a later large
+    request lands at the same or later exit epoch, never earlier."""
+    _mature(spec, state)
+    _compounding(spec, state, 2, excess=50_000_000_000)
+    req = prepare_withdrawal_request(spec, state, 2, amount=20_000_000_000)
+    spec.process_withdrawal_request(state, req)
+    first = int(state.pending_partial_withdrawals[0].withdrawable_epoch)
+    spec.process_withdrawal_request(state, req)
+    second = int(state.pending_partial_withdrawals[1].withdrawable_epoch)
+    assert second >= first
+
+
+# ----------------------------------------------------------- partial noops
+
+
+@with_phases(ELECTRA_FORKS)
+@spec_state_test
+def test_partial_queue_full_noop(spec, state):
+    _mature(spec, state)
+    limit = int(spec.PENDING_PARTIAL_WITHDRAWALS_LIMIT)
+    if limit > 64:
+        return
+    for _ in range(limit):
+        state.pending_partial_withdrawals.append(
+            spec.PendingPartialWithdrawal(
+                validator_index=9, amount=1, withdrawable_epoch=10
+            )
+        )
+    _compounding(spec, state, 2)
+    req = prepare_withdrawal_request(spec, state, 2, amount=1_000_000_000)
+    pre = len(state.pending_partial_withdrawals)
+    spec.process_withdrawal_request(state, req)
+    assert len(state.pending_partial_withdrawals) == pre
+
+
+@with_phases(ELECTRA_FORKS)
+@spec_state_test
+def test_partial_without_compounding_creds_noop(spec, state):
+    _mature(spec, state)
+    # 0x01 credentials: full exits only, partial requests are noops
+    set_eth1_withdrawal_credential_with_balance(
+        spec,
+        state,
+        2,
+        balance=int(spec.MIN_ACTIVATION_BALANCE) + 2_000_000_000,
+        effective_balance=int(spec.MIN_ACTIVATION_BALANCE),
+    )
+    req = prepare_withdrawal_request(spec, state, 2, amount=1_000_000_000)
+    _unchanged(spec, state, lambda: spec.process_withdrawal_request(state, req))
+
+
+@with_phases(ELECTRA_FORKS)
+@spec_state_test
+def test_partial_no_excess_balance_noop(spec, state):
+    _mature(spec, state)
+    _compounding(spec, state, 2, excess=0)
+    req = prepare_withdrawal_request(spec, state, 2, amount=1_000_000_000)
+    _unchanged(spec, state, lambda: spec.process_withdrawal_request(state, req))
+
+
+@with_phases(ELECTRA_FORKS)
+@spec_state_test
+def test_partial_pending_consumes_all_excess_noop(spec, state):
+    _mature(spec, state)
+    _compounding(spec, state, 2, excess=2_000_000_000)
+    state.pending_partial_withdrawals.append(
+        spec.PendingPartialWithdrawal(
+            validator_index=2, amount=2_000_000_000, withdrawable_epoch=10
+        )
+    )
+    req = prepare_withdrawal_request(spec, state, 2, amount=1_000_000_000)
+    pre = len(state.pending_partial_withdrawals)
+    spec.process_withdrawal_request(state, req)
+    assert len(state.pending_partial_withdrawals) == pre
+
+
+@with_phases(ELECTRA_FORKS)
+@spec_state_test
+def test_partial_insufficient_effective_balance_noop(spec, state):
+    _mature(spec, state)
+    cap = int(spec.MIN_ACTIVATION_BALANCE)
+    set_compounding_withdrawal_credential_with_balance(
+        spec,
+        state,
+        2,
+        balance=cap + 2_000_000_000,
+        effective_balance=cap - int(spec.EFFECTIVE_BALANCE_INCREMENT),
+    )
+    req = prepare_withdrawal_request(spec, state, 2, amount=1_000_000_000)
+    _unchanged(spec, state, lambda: spec.process_withdrawal_request(state, req))
+
+
+# --------------------------------------------------------- gating (shared)
+
+
+@with_phases(ELECTRA_FORKS)
+@spec_state_test
+def test_partial_wrong_source_address_noop(spec, state):
+    _mature(spec, state)
+    _compounding(spec, state, 2)
+    req = prepare_withdrawal_request(spec, state, 2, amount=1_000_000_000)
+    req.source_address = b"\x99" * 20
+    _unchanged(spec, state, lambda: spec.process_withdrawal_request(state, req))
+
+
+@with_phases(ELECTRA_FORKS)
+@spec_state_test
+def test_partial_exit_initiated_noop(spec, state):
+    _mature(spec, state)
+    _compounding(spec, state, 2)
+    spec.initiate_validator_exit(state, 2)
+    req = prepare_withdrawal_request(spec, state, 2, amount=1_000_000_000)
+    pre = len(state.pending_partial_withdrawals)
+    spec.process_withdrawal_request(state, req)
+    assert len(state.pending_partial_withdrawals) == pre
+
+
+@with_phases(ELECTRA_FORKS)
+@spec_state_test
+def test_partial_activation_too_recent_noop(spec, state):
+    _mature(spec, state)
+    _compounding(spec, state, 2)
+    state.validators[2].activation_epoch = int(spec.get_current_epoch(state))
+    req = prepare_withdrawal_request(spec, state, 2, amount=1_000_000_000)
+    _unchanged(spec, state, lambda: spec.process_withdrawal_request(state, req))
+
+
+@with_phases(ELECTRA_FORKS)
+@spec_state_test
+def test_inactive_validator_noop(spec, state):
+    _mature(spec, state)
+    _compounding(spec, state, 2)
+    state.validators[2].activation_epoch = int(spec.FAR_FUTURE_EPOCH)
+    req = prepare_withdrawal_request(spec, state, 2, amount=1_000_000_000)
+    _unchanged(spec, state, lambda: spec.process_withdrawal_request(state, req))
+
+
+@with_phases(ELECTRA_FORKS)
+@spec_state_test
+def test_unknown_pubkey_noop(spec, state):
+    _mature(spec, state)
+    _compounding(spec, state, 2)
+    req = prepare_withdrawal_request(spec, state, 2, amount=1_000_000_000)
+    req.validator_pubkey = b"\xab" * 48
+    _unchanged(spec, state, lambda: spec.process_withdrawal_request(state, req))
