@@ -1,0 +1,127 @@
+"""Sync-committee scenario helpers, altair+ (reference analogue:
+test/helpers/sync_committee.py — aggregate construction, dual-mode
+processing runner, and the per-participant reward oracle the reward
+suites assert against)."""
+
+from __future__ import annotations
+
+from eth_consensus_specs_tpu.utils import bls
+
+from .context import expect_assertion_error
+from .keys import pubkey_to_privkey
+
+
+def compute_sync_committee_signature(
+    spec, state, slot, privkey, block_root=None, domain_type=None
+):
+    """Signature one committee member contributes for `slot` (reference:
+    helpers/sync_committee.py compute_sync_committee_signature)."""
+    domain = spec.get_domain(
+        state,
+        domain_type or spec.DOMAIN_SYNC_COMMITTEE,
+        spec.compute_epoch_at_slot(slot),
+    )
+    if block_root is None:
+        if slot == state.slot:
+            block_root = build_root_for_current_slot(spec, state)
+        else:
+            block_root = spec.get_block_root_at_slot(state, slot)
+    signing_root = spec.compute_signing_root(spec.Root(block_root), domain)
+    return bls.Sign(privkey, signing_root)
+
+
+def build_root_for_current_slot(spec, state):
+    """The root the committee signs when the state sits AT the slot."""
+    return spec.get_block_root_at_slot(state, max(int(state.slot), 1) - 1)
+
+
+def make_sync_aggregate(spec, state, participation_bits, slot=None, block_root=None):
+    """Signed aggregate for `slot` (default: previous slot's root at the
+    current state slot) over state.current_sync_committee."""
+    if slot is None:
+        slot = max(int(state.slot), 1) - 1
+    if block_root is None:
+        block_root = spec.get_block_root_at_slot(state, slot)
+    domain = spec.get_domain(
+        state, spec.DOMAIN_SYNC_COMMITTEE, spec.compute_epoch_at_slot(slot)
+    )
+    signing_root = spec.compute_signing_root(spec.Root(block_root), domain)
+    sigs = [
+        bls.Sign(pubkey_to_privkey(bytes(pk)), signing_root)
+        for pk, bit in zip(state.current_sync_committee.pubkeys, participation_bits)
+        if bit
+    ]
+    signature = bls.Aggregate(sigs) if sigs else bls.G2_POINT_AT_INFINITY
+    return spec.SyncAggregate(
+        sync_committee_bits=participation_bits, sync_committee_signature=signature
+    )
+
+
+def run_sync_aggregate_processing(spec, state, sync_aggregate, valid=True):
+    """Dual-mode runner (reference: sync_aggregate tests'
+    run_sync_committee_processing)."""
+    yield "pre", state
+    yield "sync_aggregate", sync_aggregate
+    if not valid:
+        expect_assertion_error(
+            lambda: spec.process_sync_aggregate(state, sync_aggregate)
+        )
+        yield "post", None
+        return
+    spec.process_sync_aggregate(state, sync_aggregate)
+    yield "post", state
+
+
+def committee_indices(spec, state):
+    """Validator index per committee POSITION (duplicates preserved)."""
+    all_pubkeys = [bytes(v.pubkey) for v in state.validators]
+    return [
+        all_pubkeys.index(bytes(pk))
+        for pk in state.current_sync_committee.pubkeys
+    ]
+
+
+def compute_sync_reward_and_penalty(spec, state):
+    """(participant_reward, proposer_reward) per the spec's formula
+    (specs/altair/beacon-chain.md process_sync_aggregate)."""
+    total_active_increments = spec.get_total_active_balance(state) // int(
+        spec.EFFECTIVE_BALANCE_INCREMENT
+    )
+    total_base_rewards = int(
+        spec.get_base_reward_per_increment(state)
+    ) * int(total_active_increments)
+    max_participant_rewards = (
+        total_base_rewards
+        * int(spec.SYNC_REWARD_WEIGHT)
+        // int(spec.WEIGHT_DENOMINATOR)
+        // int(spec.SLOTS_PER_EPOCH)
+    )
+    participant_reward = max_participant_rewards // int(spec.SYNC_COMMITTEE_SIZE)
+    proposer_reward = (
+        participant_reward
+        * int(spec.PROPOSER_WEIGHT)
+        // (int(spec.WEIGHT_DENOMINATOR) - int(spec.PROPOSER_WEIGHT))
+    )
+    return participant_reward, proposer_reward
+
+
+def validate_sync_committee_rewards(
+    spec, pre_state, post_state, committee, committee_bits, proposer_index
+):
+    """Every validator's balance delta equals participation rewards minus
+    non-participation penalties, plus the proposer's cut per participant
+    bit — applied SEQUENTIALLY per position, because decrease_balance
+    floors at zero at each application (reference: sync_aggregate tests'
+    validate_sync_committee_rewards)."""
+    participant_reward, proposer_reward = compute_sync_reward_and_penalty(
+        spec, pre_state
+    )
+    balances = [int(b) for b in pre_state.balances]
+    for position, bit in zip(committee, committee_bits):
+        if bit:
+            balances[position] += participant_reward
+            balances[proposer_index] += proposer_reward
+        else:
+            balances[position] = max(0, balances[position] - participant_reward)
+    for index in range(len(post_state.validators)):
+        assert int(post_state.balances[index]) == balances[index]

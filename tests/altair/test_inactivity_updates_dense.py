@@ -1,0 +1,162 @@
+"""Randomized-property inactivity-score table, altair+ (reference analogue:
+test/altair/epoch_processing/test_process_inactivity_updates.py — the
+21-variant file crossing {zero, random} scores x {empty, random, full}
+participation x {leaking, leak-free}, plus slashed/exited overlays).
+
+Each case drives process_inactivity_updates directly and checks every
+index against a pure oracle of the spec rule
+(specs/altair/beacon-chain.md process_inactivity_updates)."""
+
+import random
+
+from eth_consensus_specs_tpu.test_infra.context import spec_state_test, with_phases
+from eth_consensus_specs_tpu.test_infra.epoch_processing import (
+    run_epoch_processing_to,
+)
+from eth_consensus_specs_tpu.test_infra.state import next_epoch
+from eth_consensus_specs_tpu.test_infra.template import instantiate
+
+ALTAIR_PLUS = ["altair", "bellatrix", "capella", "deneb", "electra"]
+
+
+def _set_participation(spec, state, mode: str, rng):
+    """Previous-epoch TIMELY_TARGET participation per `mode`."""
+    target = int(spec.TIMELY_TARGET_FLAG_INDEX)
+    for i in range(len(state.previous_epoch_participation)):
+        if mode == "full":
+            bits = 1 << target
+        elif mode == "empty":
+            bits = 0
+        else:
+            bits = (1 << target) if rng.random() < 0.5 else 0
+        state.previous_epoch_participation[i] = bits
+
+
+def _set_scores(spec, state, mode: str, rng):
+    for i in range(len(state.inactivity_scores)):
+        state.inactivity_scores[i] = (
+            0 if mode == "zero" else rng.randint(0, 100)
+        )
+
+
+def _force_leak(spec, state):
+    """Finality stuck far in the past: is_in_inactivity_leak becomes true."""
+    state.finalized_checkpoint.epoch = 0
+    # move forward enough epochs that finality_delay > MIN_EPOCHS_TO_INACTIVITY_PENALTY
+    target = int(spec.MIN_EPOCHS_TO_INACTIVITY_PENALTY) + 3
+    while int(spec.get_current_epoch(state)) < target:
+        next_epoch(spec, state)
+
+
+def _oracle(spec, state):
+    """Expected post-scores per the spec rule, computed index-by-index."""
+    participating = spec.get_unslashed_participating_indices(
+        state, spec.TIMELY_TARGET_FLAG_INDEX, spec.get_previous_epoch(state)
+    )
+    eligible = set(spec.get_eligible_validator_indices(state))
+    leak_free = not spec.is_in_inactivity_leak(state)
+    bias = int(spec.config.INACTIVITY_SCORE_BIAS)
+    recovery = int(spec.config.INACTIVITY_SCORE_RECOVERY_RATE)
+    expected = []
+    for index in range(len(state.inactivity_scores)):
+        score = int(state.inactivity_scores[index])
+        if index in eligible:
+            if index in participating:
+                score -= min(1, score)
+            else:
+                score += bias
+            if leak_free:
+                score -= min(recovery, score)
+        expected.append(score)
+    return expected
+
+
+def _inactivity_case(scores: str, participation: str, leaking: bool, seed: int):
+    @with_phases(ALTAIR_PLUS)
+    @spec_state_test
+    def case(spec, state):
+        rng = random.Random(seed)
+        if leaking:
+            _force_leak(spec, state)
+        else:
+            next_epoch(spec, state)
+            next_epoch(spec, state)
+        run_epoch_processing_to(spec, state, "process_inactivity_updates")
+        _set_scores(spec, state, scores, rng)
+        _set_participation(spec, state, participation, rng)
+        expected = _oracle(spec, state)
+        spec.process_inactivity_updates(state)
+        got = [int(s) for s in state.inactivity_scores]
+        assert got == expected
+        if leaking:
+            assert spec.is_in_inactivity_leak(state)
+
+    leak_tag = "leaking" if leaking else "leak_free"
+    return case, f"test_{scores}_scores_{participation}_participation_{leak_tag}"
+
+
+for _scores in ("zero", "random"):
+    for _participation in ("empty", "random", "full"):
+        for _leaking in (False, True):
+            instantiate(
+                _inactivity_case,
+                _scores,
+                _participation,
+                _leaking,
+                seed=hash((_scores, _participation, _leaking)) % 10_000,
+            )
+
+
+def _overlay_case(overlay: str, leaking: bool):
+    """Slashed/exited overlays: slashed validators never count as
+    participating; exited-but-unwithdrawn stay eligible."""
+
+    @with_phases(ALTAIR_PLUS)
+    @spec_state_test
+    def case(spec, state):
+        rng = random.Random(99)
+        if leaking:
+            _force_leak(spec, state)
+        else:
+            next_epoch(spec, state)
+            next_epoch(spec, state)
+        run_epoch_processing_to(spec, state, "process_inactivity_updates")
+        _set_scores(spec, state, "random", rng)
+        _set_participation(spec, state, "full", rng)
+        n = len(state.validators)
+        picks = rng.sample(range(n), max(1, n // 8))
+        for i in picks:
+            if overlay == "slashed":
+                state.validators[i].slashed = True
+            else:
+                state.validators[i].exit_epoch = int(spec.get_previous_epoch(state))
+                state.validators[i].withdrawable_epoch = (
+                    int(spec.get_previous_epoch(state)) + 8
+                )
+        expected = _oracle(spec, state)
+        bias = int(spec.config.INACTIVITY_SCORE_BIAS)
+        spec.process_inactivity_updates(state)
+        got = [int(s) for s in state.inactivity_scores]
+        assert got == expected
+        if overlay == "slashed" and leaking:
+            # slashed validators are eligible non-participants: bias applies
+            for i in picks:
+                assert got[i] >= bias
+
+    leak_tag = "leaking" if leaking else "leak_free"
+    return case, f"test_some_{overlay}_full_participation_{leak_tag}"
+
+
+for _overlay in ("slashed", "exited"):
+    for _leaking in (False, True):
+        instantiate(_overlay_case, _overlay, _leaking)
+
+
+@with_phases(ALTAIR_PLUS)
+@spec_state_test
+def test_genesis_epoch_noop(spec, state):
+    # still inside GENESIS_EPOCH: the function must return untouched
+    assert int(spec.get_current_epoch(state)) == int(spec.GENESIS_EPOCH)
+    state.inactivity_scores[0] = 55
+    spec.process_inactivity_updates(state)
+    assert int(state.inactivity_scores[0]) == 55

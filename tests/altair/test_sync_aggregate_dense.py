@@ -1,0 +1,294 @@
+"""Dense process_sync_aggregate suite, altair+ (reference analogue:
+test/altair/block_processing/sync_aggregate/test_process_sync_aggregate.py
+— the 25-variant file: duplicate-committee reward accounting, exited /
+withdrawable members, proposer-in-committee, domain binding, and
+infinite-signature invalids)."""
+
+from eth_consensus_specs_tpu.test_infra.context import (
+    always_bls,
+    spec_state_test,
+    with_phases,
+)
+from eth_consensus_specs_tpu.test_infra.keys import pubkeys
+from eth_consensus_specs_tpu.test_infra.state import next_epoch, next_slot
+from eth_consensus_specs_tpu.test_infra.sync_committee import (
+    committee_indices,
+    compute_sync_reward_and_penalty,
+    make_sync_aggregate,
+    run_sync_aggregate_processing,
+    validate_sync_committee_rewards,
+)
+from eth_consensus_specs_tpu.test_infra.template import instantiate
+from eth_consensus_specs_tpu.utils import bls
+
+ALTAIR_FORKS = ["altair", "bellatrix", "capella"]
+
+
+def _run_rewards_case(spec, state, bits):
+    next_slot(spec, state)
+    committee = committee_indices(spec, state)
+    aggregate = make_sync_aggregate(spec, state, bits)
+    pre = state.copy()
+    proposer = int(spec.get_beacon_proposer_index(state))
+    for _ in run_sync_aggregate_processing(spec, state, aggregate):
+        pass
+    validate_sync_committee_rewards(spec, pre, state, committee, bits, proposer)
+
+
+# -------------------------------------------------------- reward accounting
+
+
+@with_phases(ALTAIR_FORKS)
+@spec_state_test
+def test_rewards_nonduplicate_committee(spec, state):
+    _run_rewards_case(spec, state, [True] * int(spec.SYNC_COMMITTEE_SIZE))
+
+
+@with_phases(ALTAIR_FORKS)
+@spec_state_test
+def test_rewards_not_full_participants(spec, state):
+    size = int(spec.SYNC_COMMITTEE_SIZE)
+    _run_rewards_case(spec, state, [i % 4 != 0 for i in range(size)])
+
+
+@with_phases(ALTAIR_FORKS)
+@spec_state_test
+def test_rewards_empty_participants(spec, state):
+    _run_rewards_case(spec, state, [False] * int(spec.SYNC_COMMITTEE_SIZE))
+
+
+def _duplicate_committee_case(participation: str):
+    """Factory: every committee position points at the SAME validator —
+    rewards/penalties stack once per position (reference:
+    test_process_sync_aggregate.py duplicate_committee family)."""
+
+    @with_phases(ALTAIR_FORKS)
+    @spec_state_test
+    def case(spec, state):
+        size = int(spec.SYNC_COMMITTEE_SIZE)
+        # point the whole committee at validator 0
+        state.current_sync_committee.pubkeys = [pubkeys[0]] * size
+        if participation == "full":
+            bits = [True] * size
+        elif participation == "half":
+            bits = [i % 2 == 0 for i in range(size)]
+        else:
+            bits = [False] * size
+        _run_rewards_case(spec, state, bits)
+
+    return case, f"test_rewards_duplicate_committee_{participation}_participation"
+
+
+for _participation in ("no", "half", "full"):
+    instantiate(_duplicate_committee_case, _participation)
+
+
+@with_phases(ALTAIR_FORKS)
+@spec_state_test
+def test_rewards_duplicate_committee_zero_balance_floor(spec, state):
+    """A zero-balance duplicated non-participant is penalized once per
+    position but floors at zero each time, not once at the end."""
+    size = int(spec.SYNC_COMMITTEE_SIZE)
+    state.current_sync_committee.pubkeys = [pubkeys[0]] * size
+    state.balances[0] = 0
+    _run_rewards_case(spec, state, [False] * size)
+    assert int(state.balances[0]) == 0
+
+
+@with_phases(ALTAIR_FORKS)
+@spec_state_test
+def test_proposer_in_committee_with_participation(spec, state):
+    """When the proposer sits in the committee, it collects both the
+    participant reward and its proposer cut."""
+    next_slot(spec, state)
+    proposer = int(spec.get_beacon_proposer_index(state))
+    size = int(spec.SYNC_COMMITTEE_SIZE)
+    state.current_sync_committee.pubkeys = [
+        state.validators[proposer].pubkey
+    ] * size
+    committee = committee_indices(spec, state)
+    bits = [True] * size
+    aggregate = make_sync_aggregate(spec, state, bits)
+    pre = state.copy()
+    for _ in run_sync_aggregate_processing(spec, state, aggregate):
+        pass
+    validate_sync_committee_rewards(spec, pre, state, committee, bits, proposer)
+    participant_reward, proposer_reward = compute_sync_reward_and_penalty(spec, pre)
+    assert int(state.balances[proposer]) == int(pre.balances[proposer]) + size * (
+        participant_reward + proposer_reward
+    )
+
+
+@with_phases(ALTAIR_FORKS)
+@spec_state_test
+def test_proposer_in_committee_without_participation(spec, state):
+    next_slot(spec, state)
+    proposer = int(spec.get_beacon_proposer_index(state))
+    size = int(spec.SYNC_COMMITTEE_SIZE)
+    state.current_sync_committee.pubkeys = [
+        state.validators[proposer].pubkey
+    ] * size
+    bits = [False] * size
+    aggregate = make_sync_aggregate(spec, state, bits)
+    pre_balance = int(state.balances[proposer])
+    participant_reward, _ = compute_sync_reward_and_penalty(spec, state)
+    for _ in run_sync_aggregate_processing(spec, state, aggregate):
+        pass
+    assert int(state.balances[proposer]) == max(
+        0, pre_balance - size * participant_reward
+    )
+
+
+# ------------------------------------------------------- lifecycle members
+
+
+def _lifecycle_member_case(status: str, participating: bool):
+    """Exited/withdrawable committee members still sign and still earn or
+    lose — committee membership outlives the validator lifecycle within
+    the period (reference: sync_committee_with_*_exited/withdrawable)."""
+
+    @with_phases(ALTAIR_FORKS)
+    @spec_state_test
+    def case(spec, state):
+        next_slot(spec, state)
+        committee = committee_indices(spec, state)
+        target = committee[0]
+        validator = state.validators[target]
+        epoch = int(spec.get_current_epoch(state))
+        validator.exit_epoch = max(epoch - 1, 0)
+        if status == "withdrawable":
+            validator.withdrawable_epoch = max(epoch - 1, 0)
+        else:
+            validator.withdrawable_epoch = epoch + 4
+        size = int(spec.SYNC_COMMITTEE_SIZE)
+        bits = [True] * size
+        if not participating:
+            for position, idx in enumerate(committee):
+                if idx == target:
+                    bits[position] = False
+        aggregate = make_sync_aggregate(spec, state, bits)
+        pre = state.copy()
+        proposer = int(spec.get_beacon_proposer_index(state))
+        for _ in run_sync_aggregate_processing(spec, state, aggregate):
+            pass
+        validate_sync_committee_rewards(spec, pre, state, committee, bits, proposer)
+
+    tag = "participating" if participating else "nonparticipating"
+    return case, f"test_committee_with_{tag}_{status}_member"
+
+
+for _status in ("exited", "withdrawable"):
+    for _participating in (True, False):
+        instantiate(_lifecycle_member_case, _status, _participating)
+
+
+# ----------------------------------------------------------- domain binding
+
+
+@with_phases(ALTAIR_FORKS)
+@always_bls
+@spec_state_test
+def test_invalid_signature_bad_domain(spec, state):
+    next_slot(spec, state)
+    size = int(spec.SYNC_COMMITTEE_SIZE)
+    bits = [True] * size
+    previous_slot = int(state.slot) - 1
+    block_root = spec.get_block_root_at_slot(state, previous_slot)
+    # sign under the RANDAO domain instead of SYNC_COMMITTEE
+    domain = spec.get_domain(
+        state, spec.DOMAIN_RANDAO, spec.compute_epoch_at_slot(previous_slot)
+    )
+    signing_root = spec.compute_signing_root(spec.Root(block_root), domain)
+    from eth_consensus_specs_tpu.test_infra.keys import pubkey_to_privkey
+
+    sigs = [
+        bls.Sign(pubkey_to_privkey(bytes(pk)), signing_root)
+        for pk in state.current_sync_committee.pubkeys
+    ]
+    aggregate = spec.SyncAggregate(
+        sync_committee_bits=bits, sync_committee_signature=bls.Aggregate(sigs)
+    )
+    for _ in run_sync_aggregate_processing(spec, state, aggregate, valid=False):
+        pass
+
+
+@with_phases(ALTAIR_FORKS)
+@always_bls
+@spec_state_test
+def test_invalid_signature_past_block_root(spec, state):
+    next_slot(spec, state)
+    next_slot(spec, state)
+    size = int(spec.SYNC_COMMITTEE_SIZE)
+    bits = [True] * size
+    # sign a root two slots back instead of the previous slot
+    stale_root = spec.get_block_root_at_slot(state, int(state.slot) - 2)
+    fresh_root = spec.get_block_root_at_slot(state, int(state.slot) - 1)
+    if bytes(stale_root) == bytes(fresh_root):
+        return  # empty-slot chain: roots coincide, nothing to distinguish
+    aggregate = make_sync_aggregate(
+        spec, state, bits, slot=int(state.slot) - 1, block_root=stale_root
+    )
+    for _ in run_sync_aggregate_processing(spec, state, aggregate, valid=False):
+        pass
+
+
+@with_phases(ALTAIR_FORKS)
+@always_bls
+@spec_state_test
+def test_invalid_infinite_signature_with_all_participants(spec, state):
+    next_slot(spec, state)
+    bits = [True] * int(spec.SYNC_COMMITTEE_SIZE)
+    aggregate = spec.SyncAggregate(
+        sync_committee_bits=bits,
+        sync_committee_signature=bls.G2_POINT_AT_INFINITY,
+    )
+    for _ in run_sync_aggregate_processing(spec, state, aggregate, valid=False):
+        pass
+
+
+@with_phases(ALTAIR_FORKS)
+@always_bls
+@spec_state_test
+def test_invalid_infinite_signature_with_single_participant(spec, state):
+    next_slot(spec, state)
+    bits = [False] * int(spec.SYNC_COMMITTEE_SIZE)
+    bits[0] = True
+    aggregate = spec.SyncAggregate(
+        sync_committee_bits=bits,
+        sync_committee_signature=bls.G2_POINT_AT_INFINITY,
+    )
+    for _ in run_sync_aggregate_processing(spec, state, aggregate, valid=False):
+        pass
+
+
+@with_phases(ALTAIR_FORKS)
+@always_bls
+@spec_state_test
+def test_invalid_signature_missing_participant(spec, state):
+    """All bits set but one member's signature absent from the aggregate."""
+    next_slot(spec, state)
+    size = int(spec.SYNC_COMMITTEE_SIZE)
+    bits = [True] * size
+    partial = list(bits)
+    partial[0] = False
+    aggregate = make_sync_aggregate(spec, state, partial)
+    aggregate.sync_committee_bits = bits
+    for _ in run_sync_aggregate_processing(spec, state, aggregate, valid=False):
+        pass
+
+
+@with_phases(ALTAIR_FORKS)
+@always_bls
+@spec_state_test
+def test_valid_signature_future_committee(spec, state):
+    """After a committee-period rotation the NEW current committee signs —
+    membership is read from the post-rotation state (reference:
+    valid_signature_future_committee)."""
+    period_epochs = int(spec.EPOCHS_PER_SYNC_COMMITTEE_PERIOD)
+    for _ in range(period_epochs):
+        next_epoch(spec, state)
+    next_slot(spec, state)
+    bits = [True] * int(spec.SYNC_COMMITTEE_SIZE)
+    aggregate = make_sync_aggregate(spec, state, bits)
+    for _ in run_sync_aggregate_processing(spec, state, aggregate):
+        pass
