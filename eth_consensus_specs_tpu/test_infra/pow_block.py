@@ -1,0 +1,67 @@
+"""PoW-chain fakes for merge-transition tests (reference analogue:
+test/helpers/pow_block.py — a deterministic fake chain plus a
+get_pow_block monkeypatch context, since the spec leaves the accessor
+implementation-defined)."""
+
+from __future__ import annotations
+
+import contextlib
+from random import Random
+
+
+class PowChain:
+    """Ordered fake PoW chain; head(-1) addressing like the reference."""
+
+    def __init__(self, blocks):
+        self.blocks = list(blocks)
+
+    def __iter__(self):
+        return iter(self.blocks)
+
+    def head(self, offset=0):
+        assert offset <= 0
+        return self.blocks[offset - 1]
+
+    def to_dict(self):
+        return {bytes(block.block_hash): block for block in self.blocks}
+
+
+def prepare_random_pow_block(spec, rng=None):
+    rng = rng or Random(3131)
+    return spec.PowBlock(
+        block_hash=spec.hash(bytes(rng.getrandbits(8) for _ in range(32))),
+        parent_hash=spec.hash(bytes(rng.getrandbits(8) for _ in range(32))),
+        total_difficulty=0,
+    )
+
+
+def prepare_random_pow_chain(spec, length, rng=None) -> PowChain:
+    rng = rng or Random(3131)
+    assert length > 0
+    chain = [prepare_random_pow_block(spec, rng)]
+    for i in range(1, length):
+        block = prepare_random_pow_block(spec, rng)
+        block.parent_hash = chain[i - 1].block_hash
+        chain.append(block)
+    return PowChain(chain)
+
+
+@contextlib.contextmanager
+def pow_block_store(spec, chain: PowChain):
+    """Temporarily back spec.get_pow_block with the fake chain; unknown
+    hashes raise (the spec treats a failed lookup as an invalid merge
+    block, reference: test_validate_merge_block.py:29-47)."""
+    table = chain.to_dict()
+
+    def get_pow_block(block_hash):
+        key = bytes(block_hash)
+        if key not in table:
+            raise AssertionError("PoW block not found")
+        return table[key]
+
+    original = spec.get_pow_block
+    spec.get_pow_block = get_pow_block
+    try:
+        yield table
+    finally:
+        spec.get_pow_block = original

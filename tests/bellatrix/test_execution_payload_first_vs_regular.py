@@ -1,0 +1,194 @@
+"""First-payload (merge transition) vs regular-payload families
+(reference analogue: test/bellatrix/block_processing/
+test_process_execution_payload.py — the first/regular split, gap slots,
+zero-length transactions, randomized non-validated fields).
+
+'First payload' = state whose latest_execution_payload_header is empty
+(merge not yet complete): parent-hash linkage is NOT checked there
+(specs/bellatrix/beacon-chain.md process_execution_payload)."""
+
+import random
+
+from eth_consensus_specs_tpu.ssz import Bytes32
+from eth_consensus_specs_tpu.test_infra.context import (
+    expect_assertion_error,
+    spec_state_test,
+    with_phases,
+)
+from eth_consensus_specs_tpu.test_infra.execution_payload import (
+    build_empty_execution_payload,
+    compute_el_block_hash,
+)
+from eth_consensus_specs_tpu.test_infra.state import next_slot, next_slots
+from eth_consensus_specs_tpu.test_infra.template import instantiate
+
+BELLATRIX = ["bellatrix"]
+
+
+def _incomplete_transition(spec, state):
+    """Wipe the header: merge not complete (reference:
+    helpers/execution_payload.py build_state_with_incomplete_transition)."""
+    state.latest_execution_payload_header = spec.ExecutionPayloadHeader()
+    assert not spec.is_merge_transition_complete(state)
+
+
+def _build_payload(spec, state, first: bool):
+    if first:
+        _incomplete_transition(spec, state)
+    payload = build_empty_execution_payload(spec, state)
+    if first:
+        # transition block: parent is an arbitrary PoW hash, not the header
+        payload.parent_hash = Bytes32(b"\x77" * 32)
+        payload.block_hash = Bytes32(compute_el_block_hash(spec, payload))
+    return payload
+
+
+def _process(spec, state, payload, valid=True):
+    body = spec.BeaconBlockBody(execution_payload=payload)
+    if valid:
+        spec.process_execution_payload(state, body, spec.EXECUTION_ENGINE)
+    else:
+        expect_assertion_error(
+            lambda: spec.process_execution_payload(state, body, spec.EXECUTION_ENGINE)
+        )
+
+
+def _success_case(first: bool, gap: bool):
+    @with_phases(BELLATRIX)
+    @spec_state_test
+    def case(spec, state):
+        next_slots(spec, state, 4 if gap else 1)
+        payload = _build_payload(spec, state, first)
+        _process(spec, state, payload)
+        assert state.latest_execution_payload_header.block_hash == payload.block_hash
+
+    kind = "first" if first else "regular"
+    suffix = "_with_gap_slot" if gap else ""
+    return case, f"test_success_{kind}_payload{suffix}"
+
+
+for _first in (True, False):
+    for _gap in (False, True):
+        instantiate(_success_case, _first, _gap)
+
+
+@with_phases(BELLATRIX)
+@spec_state_test
+def test_first_payload_skips_parent_hash_check(spec, state):
+    """Pre-merge the parent-hash linkage is unchecked: any parent works."""
+    next_slot(spec, state)
+    payload = _build_payload(spec, state, first=True)
+    payload.parent_hash = Bytes32(b"\x12" * 32)
+    payload.block_hash = Bytes32(compute_el_block_hash(spec, payload))
+    _process(spec, state, payload)
+
+
+@with_phases(BELLATRIX)
+@spec_state_test
+def test_invalid_parent_hash_regular_payload(spec, state):
+    next_slot(spec, state)
+    payload = _build_payload(spec, state, first=False)
+    payload.parent_hash = Bytes32(b"\x12" * 32)
+    payload.block_hash = Bytes32(compute_el_block_hash(spec, payload))
+    _process(spec, state, payload, valid=False)
+
+
+def _bad_field_case(first: bool, field: str):
+    @with_phases(BELLATRIX)
+    @spec_state_test
+    def case(spec, state):
+        next_slot(spec, state)
+        payload = _build_payload(spec, state, first)
+        if field == "prev_randao":
+            payload.prev_randao = Bytes32(b"\x13" * 32)
+        elif field == "timestamp_future":
+            payload.timestamp = int(payload.timestamp) + 1000
+        elif field == "timestamp_past":
+            payload.timestamp = max(0, int(payload.timestamp) - 1000)
+        else:  # everything
+            payload.prev_randao = Bytes32(b"\x13" * 32)
+            payload.timestamp = int(payload.timestamp) + 7
+            if not first:
+                payload.parent_hash = Bytes32(b"\x14" * 32)
+        payload.block_hash = Bytes32(compute_el_block_hash(spec, payload))
+        _process(spec, state, payload, valid=False)
+
+    kind = "first" if first else "regular"
+    return case, f"test_invalid_{field}_{kind}_payload"
+
+
+for _first in (True, False):
+    for _field in ("prev_randao", "timestamp_future", "timestamp_past", "everything"):
+        instantiate(_bad_field_case, _first, _field)
+
+
+def _transactions_case(first: bool, shape: str):
+    """Opaque transaction payloads are NOT validated by the CL — any byte
+    strings (including zero-length) pass; only the engine judges them."""
+
+    @with_phases(BELLATRIX)
+    @spec_state_test
+    def case(spec, state):
+        next_slot(spec, state)
+        payload = _build_payload(spec, state, first)
+        if shape == "nonempty":
+            payload.transactions = [b"\x02" + b"\x55" * 30, b"\x01" * 12]
+        else:
+            payload.transactions = [b""]
+        payload.block_hash = Bytes32(compute_el_block_hash(spec, payload))
+        _process(spec, state, payload)
+
+    kind = "first" if first else "regular"
+    return case, f"test_{shape}_transactions_{kind}_payload"
+
+
+for _first in (True, False):
+    for _shape in ("nonempty", "zero_length"):
+        instantiate(_transactions_case, _first, _shape)
+
+
+def _randomized_nonvalidated_case(first: bool, execution_valid: bool, seed: int):
+    """Fuzz the fields the CL never reads (fee_recipient, state_root,
+    receipts_root, logs_bloom, extra_data, gas fields): processing outcome
+    depends only on the engine verdict."""
+
+    @with_phases(BELLATRIX)
+    @spec_state_test
+    def case(spec, state):
+        rng = random.Random(seed)
+        next_slot(spec, state)
+        payload = _build_payload(spec, state, first)
+        payload.fee_recipient = bytes(rng.getrandbits(8) for _ in range(20))
+        payload.state_root = bytes(rng.getrandbits(8) for _ in range(32))
+        payload.receipts_root = bytes(rng.getrandbits(8) for _ in range(32))
+        payload.logs_bloom = bytes(rng.getrandbits(8) for _ in range(256))
+        payload.extra_data = bytes(rng.getrandbits(8) for _ in range(rng.randint(0, 32)))
+        payload.gas_limit = rng.randint(0, 2**32)
+        payload.gas_used = rng.randint(0, int(payload.gas_limit))
+        payload.block_hash = Bytes32(compute_el_block_hash(spec, payload))
+
+        class FlakyEngine(type(spec.EXECUTION_ENGINE)):
+            def notify_new_payload(self, *args, **kwargs) -> bool:
+                return execution_valid
+
+            def verify_and_notify_new_payload(self, *args, **kwargs) -> bool:
+                return execution_valid
+
+        body = spec.BeaconBlockBody(execution_payload=payload)
+        if execution_valid:
+            spec.process_execution_payload(state, body, FlakyEngine())
+        else:
+            expect_assertion_error(
+                lambda: spec.process_execution_payload(state, body, FlakyEngine())
+            )
+
+    kind = "first" if first else "regular"
+    verdict = "execution_valid" if execution_valid else "execution_invalid"
+    return case, f"test_randomized_non_validated_fields_{kind}_payload_{verdict}"
+
+
+for _first in (True, False):
+    for _ok in (True, False):
+        instantiate(
+            _randomized_nonvalidated_case, _first, _ok, seed=7 + int(_first) * 2 + int(_ok)
+        )

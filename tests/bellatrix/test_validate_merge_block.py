@@ -1,0 +1,136 @@
+"""validate_merge_block unit suite (reference analogue:
+test/bellatrix/unittests/test_validate_merge_block.py — terminal
+total-difficulty and terminal-block-hash-override families; spec:
+specs/bellatrix/fork-choice.md validate_merge_block)."""
+
+from eth_consensus_specs_tpu.test_infra.block import build_empty_block_for_next_slot
+from eth_consensus_specs_tpu.test_infra.context import (
+    expect_assertion_error,
+    spec_state_test,
+    with_config_overrides,
+    with_phases,
+)
+from eth_consensus_specs_tpu.test_infra.pow_block import (
+    prepare_random_pow_chain,
+    pow_block_store,
+)
+
+BELLATRIX = ["bellatrix"]
+TTD = 10  # tests run with a tiny overridden terminal total difficulty
+
+
+def _merge_block(spec, state, parent_hash):
+    block = build_empty_block_for_next_slot(spec, state)
+    block.body.execution_payload.parent_hash = parent_hash
+    block.body.execution_payload.block_hash = b"\x42" * 32
+    return block
+
+
+@with_phases(BELLATRIX)
+@with_config_overrides({"TERMINAL_TOTAL_DIFFICULTY": TTD})
+@spec_state_test
+def test_validate_merge_block_success(spec, state):
+    chain = prepare_random_pow_chain(spec, 2)
+    chain.head(-1).total_difficulty = TTD - 1
+    chain.head().total_difficulty = TTD
+    block = _merge_block(spec, state, chain.head().block_hash)
+    with pow_block_store(spec, chain):
+        spec.validate_merge_block(block)
+
+
+@with_phases(BELLATRIX)
+@with_config_overrides({"TERMINAL_TOTAL_DIFFICULTY": TTD})
+@spec_state_test
+def test_validate_merge_block_fail_block_lookup(spec, state):
+    chain = prepare_random_pow_chain(spec, 2)
+    block = _merge_block(spec, state, b"\x11" * 32)  # unknown hash
+    with pow_block_store(spec, chain):
+        expect_assertion_error(lambda: spec.validate_merge_block(block))
+
+
+@with_phases(BELLATRIX)
+@with_config_overrides({"TERMINAL_TOTAL_DIFFICULTY": TTD})
+@spec_state_test
+def test_validate_merge_block_fail_parent_block_lookup(spec, state):
+    # chain of one: the terminal block's parent can't be found
+    chain = prepare_random_pow_chain(spec, 1)
+    chain.head().total_difficulty = TTD
+    block = _merge_block(spec, state, chain.head().block_hash)
+    with pow_block_store(spec, chain):
+        expect_assertion_error(lambda: spec.validate_merge_block(block))
+
+
+@with_phases(BELLATRIX)
+@with_config_overrides({"TERMINAL_TOTAL_DIFFICULTY": TTD})
+@spec_state_test
+def test_validate_merge_block_fail_after_terminal(spec, state):
+    # parent of the referenced block ALREADY crossed TTD: not terminal
+    chain = prepare_random_pow_chain(spec, 2)
+    chain.head(-1).total_difficulty = TTD
+    chain.head().total_difficulty = TTD + 1
+    block = _merge_block(spec, state, chain.head().block_hash)
+    with pow_block_store(spec, chain):
+        expect_assertion_error(lambda: spec.validate_merge_block(block))
+
+
+@with_phases(BELLATRIX)
+@with_config_overrides({"TERMINAL_TOTAL_DIFFICULTY": TTD})
+@spec_state_test
+def test_validate_merge_block_fail_difficulty_not_reached(spec, state):
+    chain = prepare_random_pow_chain(spec, 2)
+    chain.head(-1).total_difficulty = TTD - 2
+    chain.head().total_difficulty = TTD - 1
+    block = _merge_block(spec, state, chain.head().block_hash)
+    with pow_block_store(spec, chain):
+        expect_assertion_error(lambda: spec.validate_merge_block(block))
+
+
+# ------------------------------------------- terminal-block-hash override
+
+
+_TBH = b"\x66" * 32
+
+
+@with_phases(BELLATRIX)
+@with_config_overrides({
+        "TERMINAL_BLOCK_HASH": _TBH,
+        "TERMINAL_BLOCK_HASH_ACTIVATION_EPOCH": 0,
+    })
+@spec_state_test
+def test_validate_merge_block_tbh_override_success(spec, state):
+    block = _merge_block(spec, state, _TBH)
+    # no PoW store needed: the override path never consults it
+    spec.validate_merge_block(block)
+
+
+@with_phases(BELLATRIX)
+@with_config_overrides({
+        "TERMINAL_BLOCK_HASH": _TBH,
+        "TERMINAL_BLOCK_HASH_ACTIVATION_EPOCH": 0,
+    })
+@spec_state_test
+def test_validate_merge_block_fail_parent_hash_is_not_tbh(spec, state):
+    block = _merge_block(spec, state, b"\x67" * 32)
+    expect_assertion_error(lambda: spec.validate_merge_block(block))
+
+
+@with_phases(BELLATRIX)
+@with_config_overrides({
+        "TERMINAL_BLOCK_HASH": _TBH,
+        "TERMINAL_BLOCK_HASH_ACTIVATION_EPOCH": 1000,
+    })
+@spec_state_test
+def test_validate_merge_block_tbh_activation_not_reached(spec, state):
+    block = _merge_block(spec, state, _TBH)
+    expect_assertion_error(lambda: spec.validate_merge_block(block))
+
+
+@with_phases(BELLATRIX)
+@with_config_overrides({
+        "TERMINAL_BLOCK_HASH": _TBH,
+        "TERMINAL_BLOCK_HASH_ACTIVATION_EPOCH": 1000,
+    })
+@spec_state_test
+def test_validate_merge_block_tbh_activation_not_reached_and_wrong_hash(spec, state):
+    block = _merge_block(spec, state, b"\x67" * 32)
+    expect_assertion_error(lambda: spec.validate_merge_block(block))
