@@ -1,0 +1,165 @@
+"""Deneb process_execution_payload families: blob-gas fields, versioned
+hashes, commitment caps (reference analogue:
+test/deneb/block_processing/test_process_execution_payload.py — 14
+variants; spec: specs/deneb/beacon-chain.md:436-455)."""
+
+from eth_consensus_specs_tpu.ssz import Bytes32
+from eth_consensus_specs_tpu.test_infra.context import (
+    expect_assertion_error,
+    spec_state_test,
+    with_phases,
+)
+from eth_consensus_specs_tpu.test_infra.execution_payload import (
+    build_empty_execution_payload,
+    compute_el_block_hash,
+)
+from eth_consensus_specs_tpu.test_infra.state import next_slot
+from eth_consensus_specs_tpu.test_infra.template import instantiate
+
+DENEB_FORKS = ["deneb", "electra"]
+
+
+def _payload_and_body(spec, state, commitments=()):
+    next_slot(spec, state)
+    payload = build_empty_execution_payload(spec, state)
+    payload.block_hash = Bytes32(compute_el_block_hash(spec, payload, state))
+    body_kwargs = dict(execution_payload=payload)
+    body = spec.BeaconBlockBody(**body_kwargs)
+    body.blob_kzg_commitments = list(commitments)
+    return payload, body
+
+
+def _process(spec, state, body, valid=True):
+    if valid:
+        spec.process_execution_payload(state, body, spec.EXECUTION_ENGINE)
+    else:
+        expect_assertion_error(
+            lambda: spec.process_execution_payload(state, body, spec.EXECUTION_ENGINE)
+        )
+
+
+@with_phases(DENEB_FORKS)
+@spec_state_test
+def test_success_no_blobs(spec, state):
+    _, body = _payload_and_body(spec, state)
+    _process(spec, state, body)
+
+
+@with_phases(DENEB_FORKS)
+@spec_state_test
+def test_success_with_blob_commitments(spec, state):
+    commitments = [b"\xc0" + b"\x11" * 47, b"\xc0" + b"\x22" * 47]
+    _, body = _payload_and_body(spec, state, commitments)
+    _process(spec, state, body)
+
+
+@with_phases(DENEB_FORKS)
+@spec_state_test
+def test_success_max_blob_commitments(spec, state):
+    cap = int(spec.max_blobs_per_block())
+    commitments = [b"\xc0" + bytes([i]) * 47 for i in range(cap)]
+    _, body = _payload_and_body(spec, state, commitments)
+    _process(spec, state, body)
+
+
+@with_phases(DENEB_FORKS)
+@spec_state_test
+def test_invalid_exceed_max_blob_commitments(spec, state):
+    cap = int(spec.max_blobs_per_block())
+    limit = int(spec.MAX_BLOB_COMMITMENTS_PER_BLOCK)
+    if cap >= limit:
+        return  # SSZ list limit already prevents over-cap bodies
+    commitments = [b"\xc0" + bytes([i]) * 47 for i in range(cap + 1)]
+    _, body = _payload_and_body(spec, state, commitments)
+    _process(spec, state, body, valid=False)
+
+
+@with_phases(DENEB_FORKS)
+@spec_state_test
+def test_blob_gas_fields_carried_into_header(spec, state):
+    payload, body = _payload_and_body(spec, state)
+    payload.blob_gas_used = 131072
+    payload.excess_blob_gas = 262144
+    payload.block_hash = Bytes32(compute_el_block_hash(spec, payload, state))
+    body.execution_payload = payload
+    _process(spec, state, body)
+    header = state.latest_execution_payload_header
+    assert int(header.blob_gas_used) == 131072
+    assert int(header.excess_blob_gas) == 262144
+
+
+@with_phases(DENEB_FORKS)
+@spec_state_test
+def test_versioned_hashes_passed_to_engine(spec, state):
+    """The engine receives one KZG_COMMITMENT-versioned hash per
+    commitment, bound to the parent beacon block root."""
+    commitments = [b"\xc0" + b"\x33" * 47]
+    _, body = _payload_and_body(spec, state, commitments)
+    seen = {}
+
+    class RecordingEngine(type(spec.EXECUTION_ENGINE)):
+        def verify_and_notify_new_payload(self, request) -> bool:
+            seen["hashes"] = list(request.versioned_hashes)
+            seen["parent_root"] = bytes(request.parent_beacon_block_root)
+            return True
+
+    engine = RecordingEngine.__new__(RecordingEngine)
+    engine.__dict__.update(getattr(spec.EXECUTION_ENGINE, '__dict__', {}))
+    spec.process_execution_payload(state, body, engine)
+    assert seen["hashes"] == [
+        spec.kzg_commitment_to_versioned_hash(commitments[0])
+    ]
+    assert bytes(seen["hashes"][0])[:1] == bytes(spec.VERSIONED_HASH_VERSION_KZG)
+    assert seen["parent_root"] == bytes(state.latest_block_header.parent_root)
+
+
+@with_phases(DENEB_FORKS)
+@spec_state_test
+def test_invalid_engine_rejects_versioned_hashes(spec, state):
+    commitments = [b"\xc0" + b"\x44" * 47]
+    _, body = _payload_and_body(spec, state, commitments)
+
+    class RejectingEngine(type(spec.EXECUTION_ENGINE)):
+        def verify_and_notify_new_payload(self, request) -> bool:
+            return False
+
+    engine = RejectingEngine.__new__(RejectingEngine)
+    engine.__dict__.update(getattr(spec.EXECUTION_ENGINE, '__dict__', {}))
+
+    expect_assertion_error(
+        lambda: spec.process_execution_payload(state, body, engine)
+    )
+
+
+def _invalid_field_case(field: str):
+    @with_phases(DENEB_FORKS)
+    @spec_state_test
+    def case(spec, state):
+        payload, body = _payload_and_body(spec, state)
+        if field == "parent_hash":
+            payload.parent_hash = Bytes32(b"\x55" * 32)
+        elif field == "prev_randao":
+            payload.prev_randao = Bytes32(b"\x56" * 32)
+        else:
+            payload.timestamp = int(payload.timestamp) + 3
+        payload.block_hash = Bytes32(compute_el_block_hash(spec, payload, state))
+        body.execution_payload = payload
+        _process(spec, state, body, valid=False)
+
+    return case, f"test_invalid_{field}"
+
+
+for _field in ("parent_hash", "prev_randao", "timestamp"):
+    instantiate(_invalid_field_case, _field)
+
+
+@with_phases(DENEB_FORKS)
+@spec_state_test
+def test_el_block_hash_binds_blob_gas_fields(spec, state):
+    """EIP-4844 header RLP covers blob_gas_used/excess_blob_gas — mutating
+    them changes the EL hash."""
+    next_slot(spec, state)
+    payload = build_empty_execution_payload(spec, state)
+    base = compute_el_block_hash(spec, payload, state)
+    payload.excess_blob_gas = 999
+    assert compute_el_block_hash(spec, payload, state) != base

@@ -1,0 +1,114 @@
+"""Cell-proof batch verification and recovery edge-case tables, fulu
+(reference analogue: test/fulu/kzg/test_verify_cell_kzg_proof_batch.py
+and test_recover_cells_and_kzg_proofs.py — the corruption-pattern
+families; spec: specs/fulu/polynomial-commitments-sampling.md:617-828).
+
+Shares the per-process blob fixture; marked slow (pure-python pairing
+per batch check)."""
+
+import pytest
+
+from eth_consensus_specs_tpu.crypto import das
+
+from .das_fixtures import sample_cells_and_proofs, sample_commitment
+
+pytestmark = pytest.mark.slow
+
+CELLS_PER_EXT_BLOB = das.CELLS_PER_EXT_BLOB
+HALF = CELLS_PER_EXT_BLOB // 2
+
+
+def _verify(indices, cells, proofs, commitment=None):
+    commitment = commitment or sample_commitment()
+    return das.verify_cell_kzg_proof_batch(
+        [commitment] * len(indices), list(indices), list(cells), list(proofs)
+    )
+
+
+def test_batch_accepts_empty():
+    assert _verify([], [], [])
+
+
+def test_batch_accepts_single_cell():
+    cells, proofs = sample_cells_and_proofs()
+    assert _verify([0], [cells[0]], [proofs[0]])
+
+
+def test_batch_accepts_duplicate_cell_indices():
+    """The same (commitment, index, cell, proof) tuple twice is fine — the
+    commitment dedup + RLC handle repeats (reference:
+    verify_cell_kzg_proof_batch's deduplication, sampling.md:620-667)."""
+    cells, proofs = sample_cells_and_proofs()
+    assert _verify([3, 3], [cells[3], cells[3]], [proofs[3], proofs[3]])
+
+
+def test_batch_rejects_cell_index_out_of_range():
+    cells, proofs = sample_cells_and_proofs()
+    with pytest.raises((AssertionError, IndexError, ValueError)):
+        _verify([CELLS_PER_EXT_BLOB], [cells[0]], [proofs[0]])
+
+
+def test_batch_rejects_mismatched_lengths():
+    cells, proofs = sample_cells_and_proofs()
+    with pytest.raises((AssertionError, ValueError)):
+        das.verify_cell_kzg_proof_batch(
+            [sample_commitment()], [0, 1], [cells[0]], [proofs[0]]
+        )
+
+
+def test_batch_rejects_malformed_commitment_length():
+    cells, proofs = sample_cells_and_proofs()
+    with pytest.raises((AssertionError, ValueError)):
+        das.verify_cell_kzg_proof_batch(
+            [b"\x01" * 47], [0], [cells[0]], [proofs[0]]
+        )
+
+
+def test_batch_rejects_cross_assigned_proofs():
+    cells, proofs = sample_cells_and_proofs()
+    assert not _verify([0, 1], [cells[0], cells[1]], [proofs[1], proofs[0]])
+
+
+def test_batch_rejects_corrupted_cell_byte():
+    cells, proofs = sample_cells_and_proofs()
+    bad = bytearray(bytes(cells[2]))
+    # flip a low-order bit of the first field element, keeping it canonical
+    bad[31] ^= 0x01
+    assert not _verify([2], [bytes(bad)], [proofs[2]])
+
+
+def test_recover_from_exactly_half_even_indices():
+    cells, proofs = sample_cells_and_proofs()
+    indices = list(range(0, CELLS_PER_EXT_BLOB, 2))
+    assert len(indices) == HALF
+    rec_cells, rec_proofs = das.recover_cells_and_kzg_proofs(
+        indices, [cells[i] for i in indices]
+    )
+    assert [bytes(c) for c in rec_cells] == [bytes(c) for c in cells]
+    assert [bytes(p) for p in rec_proofs] == [bytes(p) for p in proofs]
+
+
+def test_recover_rejects_one_below_half():
+    cells, _ = sample_cells_and_proofs()
+    indices = list(range(HALF - 1))
+    with pytest.raises((AssertionError, ValueError)):
+        das.recover_cells_and_kzg_proofs(indices, [cells[i] for i in indices])
+
+
+def test_recover_from_second_half_only():
+    """Recovery from ONLY extension cells reconstructs the systematic half."""
+    cells, _ = sample_cells_and_proofs()
+    indices = list(range(HALF, CELLS_PER_EXT_BLOB))
+    rec_cells, _ = das.recover_cells_and_kzg_proofs(
+        indices, [cells[i] for i in indices]
+    )
+    assert [bytes(c) for c in rec_cells[:HALF]] == [bytes(c) for c in cells[:HALF]]
+
+
+def test_recover_rejects_non_canonical_cell_bytes():
+    cells, _ = sample_cells_and_proofs()
+    indices = list(range(HALF))
+    donors = [bytes(cells[i]) for i in indices]
+    donors[0] = b"\xff" * len(donors[0])  # field elements >= BLS_MODULUS
+    with pytest.raises((AssertionError, ValueError)):
+        das.recover_cells_and_kzg_proofs(indices, donors)
