@@ -1,0 +1,60 @@
+"""Cross-fork transition helpers (reference analogue:
+test/helpers/fork_transition.py — do_fork / transition_until_fork,
+driving a state THROUGH an upgrade boundary with blocks on both sides)."""
+
+from __future__ import annotations
+
+from eth_consensus_specs_tpu.ssz import hash_tree_root
+
+from .block import (
+    build_empty_block,
+    build_empty_block_for_next_slot,
+    sign_block,
+    state_transition_and_sign_block,
+)
+from .state import transition_to
+
+
+def transition_until_fork(spec, state, fork_epoch: int):
+    """Advance to the last slot BEFORE the fork epoch's first slot
+    (reference: fork_transition.py:264-266)."""
+    to_slot = int(fork_epoch) * int(spec.SLOTS_PER_EPOCH) - 1
+    transition_to(spec, state, to_slot)
+
+
+def _sign_block_at_current_slot(post_spec, state, block):
+    """Apply a block whose slot EQUALS state.slot (the fork slot): no slot
+    processing, just process_block + state-root fill (reference:
+    fork_transition.py _state_transition_and_sign_block_at_slot)."""
+    trial = state.copy()
+    post_spec.process_block(trial, block)
+    block.state_root = hash_tree_root(trial)
+    signed = sign_block(post_spec, state, block)
+    post_spec.process_block(state, block)
+    return signed
+
+
+def do_fork(spec, post_spec, state, fork_epoch: int, with_block: bool = True):
+    """Cross the boundary: one more slot under the PRE spec lands exactly on
+    the fork slot, upgrade, then (optionally) apply the fork's first block
+    under the POST spec (reference: fork_transition.py:194-224)."""
+    spec.process_slots(state, int(state.slot) + 1)
+    assert int(state.slot) % int(spec.SLOTS_PER_EPOCH) == 0
+    assert int(spec.get_current_epoch(state)) == int(fork_epoch)
+
+    state = post_spec.upgrade_from_parent(state)
+    assert int(state.fork.epoch) == int(fork_epoch)
+
+    block = None
+    if with_block:
+        block = build_empty_block(post_spec, state, int(state.slot))
+        block = _sign_block_at_current_slot(post_spec, state, block)
+    return state, block
+
+
+def transition_to_next_epoch_and_append_blocks(spec, state, blocks, count: int = 2):
+    """Fill `count` slots with empty signed blocks under `spec`."""
+    for _ in range(count):
+        block = build_empty_block_for_next_slot(spec, state)
+        blocks.append(state_transition_and_sign_block(spec, state, block))
+    return blocks
