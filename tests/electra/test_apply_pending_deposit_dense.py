@@ -1,0 +1,255 @@
+"""Dense apply_pending_deposit suite, electra+ (reference analogue:
+test/electra/epoch_processing/pending_deposits/test_apply_pending_deposit.py
+— the 26-variant file: effective-balance boundary arithmetic per
+credential kind, signature gating for new deposits vs top-ups, and
+malformed-pubkey robustness; spec: specs/electra/beacon-chain.md
+apply_pending_deposit)."""
+
+from eth_consensus_specs_tpu.test_infra.context import (
+    always_bls,
+    spec_state_test,
+    with_phases,
+)
+from eth_consensus_specs_tpu.test_infra.keys import privkeys, pubkeys
+from eth_consensus_specs_tpu.test_infra.template import instantiate
+from eth_consensus_specs_tpu.utils import bls
+
+ELECTRA_FORKS = ["electra", "fulu"]
+GWEI = 1_000_000_000
+
+ETH1_CREDS = b"\x01" + b"\x00" * 11 + b"\x42" * 20
+COMPOUNDING_CREDS = b"\x02" + b"\x00" * 11 + b"\x42" * 20
+BLS_CREDS = b"\x00" + b"\x99" * 31  # non-versioned / legacy
+
+
+def _new_key_index(state):
+    """A keypair index not present in the registry."""
+    return len(state.validators) + 10
+
+
+def _signed_new_deposit(spec, state, creds, amount, privkey_index=None, good_sig=True):
+    idx = privkey_index if privkey_index is not None else _new_key_index(state)
+    pubkey = pubkeys[idx]
+    message = spec.DepositMessage(
+        pubkey=pubkey, withdrawal_credentials=creds, amount=amount
+    )
+    domain = spec.compute_domain(spec.DOMAIN_DEPOSIT)
+    root = spec.compute_signing_root(message, domain)
+    key = privkeys[idx] if good_sig else privkeys[idx + 1]
+    return spec.PendingDeposit(
+        pubkey=pubkey,
+        withdrawal_credentials=creds,
+        amount=amount,
+        signature=bls.Sign(key, root),
+        slot=spec.GENESIS_SLOT,
+    )
+
+
+# ----------------------------------------- new-validator balance boundaries
+
+
+def _boundary_case(creds_kind: str, where: str):
+    @with_phases(ELECTRA_FORKS)
+    @always_bls
+    @spec_state_test
+    def case(spec, state):
+        inc = int(spec.EFFECTIVE_BALANCE_INCREMENT)
+        if creds_kind == "compounding":
+            creds = COMPOUNDING_CREDS
+            cap = int(spec.MAX_EFFECTIVE_BALANCE_ELECTRA)
+        else:
+            creds = ETH1_CREDS
+            cap = int(spec.MIN_ACTIVATION_BALANCE)
+        amount = {
+            "under": cap - inc,
+            "at": cap,
+            "over": cap + inc,
+            "over_next_increment": cap + inc + inc // 2,
+        }[where]
+        deposit = _signed_new_deposit(spec, state, creds, amount)
+        pre_count = len(state.validators)
+        spec.apply_pending_deposit(state, deposit)
+        assert len(state.validators) == pre_count + 1
+        new = state.validators[pre_count]
+        assert int(state.balances[pre_count]) == amount
+        # effective balance: floor to increment, clamp at the creds cap
+        assert int(new.effective_balance) == min(amount - amount % inc, cap)
+
+    return case, f"test_new_deposit_{creds_kind}_{where}_cap"
+
+
+for _kind in ("eth1", "compounding"):
+    for _where in ("under", "at", "over", "over_next_increment"):
+        instantiate(_boundary_case, _kind, _where)
+
+
+@with_phases(ELECTRA_FORKS)
+@always_bls
+@spec_state_test
+def test_new_deposit_non_versioned_credentials(spec, state):
+    """Legacy 0x00 creds still register; cap is MIN_ACTIVATION_BALANCE."""
+    amount = int(spec.MIN_ACTIVATION_BALANCE) + 2 * int(
+        spec.EFFECTIVE_BALANCE_INCREMENT
+    )
+    deposit = _signed_new_deposit(spec, state, BLS_CREDS, amount)
+    pre_count = len(state.validators)
+    spec.apply_pending_deposit(state, deposit)
+    new = state.validators[pre_count]
+    assert int(new.effective_balance) == int(spec.MIN_ACTIVATION_BALANCE)
+
+
+# -------------------------------------------------------- signature gating
+
+
+@with_phases(ELECTRA_FORKS)
+@always_bls
+@spec_state_test
+def test_new_deposit_bad_signature_dropped(spec, state):
+    deposit = _signed_new_deposit(
+        spec, state, ETH1_CREDS, 32 * GWEI, good_sig=False
+    )
+    pre_count = len(state.validators)
+    spec.apply_pending_deposit(state, deposit)
+    # silently skipped: no registry growth, no balance anywhere
+    assert len(state.validators) == pre_count
+
+
+@with_phases(ELECTRA_FORKS)
+@always_bls
+@spec_state_test
+def test_top_up_skips_signature_check(spec, state):
+    """Top-ups to a known pubkey apply WITHOUT signature verification —
+    possession was proven by the original deposit."""
+    v = state.validators[3]
+    deposit = spec.PendingDeposit(
+        pubkey=v.pubkey,
+        withdrawal_credentials=v.withdrawal_credentials,
+        amount=GWEI,
+        signature=b"\xde" * 96,  # garbage signature
+        slot=spec.GENESIS_SLOT,
+    )
+    pre = int(state.balances[3])
+    spec.apply_pending_deposit(state, deposit)
+    assert int(state.balances[3]) == pre + GWEI
+
+
+@with_phases(ELECTRA_FORKS)
+@always_bls
+@spec_state_test
+def test_top_up_ignores_mismatched_credentials(spec, state):
+    """A top-up's credentials are NOT checked against the registry's."""
+    v = state.validators[3]
+    deposit = spec.PendingDeposit(
+        pubkey=v.pubkey,
+        withdrawal_credentials=COMPOUNDING_CREDS,
+        amount=GWEI,
+        signature=b"\xde" * 96,
+        slot=spec.GENESIS_SLOT,
+    )
+    pre_creds = bytes(v.withdrawal_credentials)
+    pre = int(state.balances[3])
+    spec.apply_pending_deposit(state, deposit)
+    assert int(state.balances[3]) == pre + GWEI
+    assert bytes(state.validators[3].withdrawal_credentials) == pre_creds
+
+
+@with_phases(ELECTRA_FORKS)
+@always_bls
+@spec_state_test
+def test_top_up_does_not_change_effective_balance(spec, state):
+    """apply_pending_deposit only raises the raw balance; the effective
+    balance catches up at process_effective_balance_updates."""
+    v = state.validators[3]
+    pre_eff = int(v.effective_balance)
+    deposit = spec.PendingDeposit(
+        pubkey=v.pubkey,
+        withdrawal_credentials=v.withdrawal_credentials,
+        amount=5 * GWEI,
+        signature=bls.G2_POINT_AT_INFINITY,
+        slot=spec.GENESIS_SLOT,
+    )
+    spec.apply_pending_deposit(state, deposit)
+    assert int(state.validators[3].effective_balance) == pre_eff
+
+
+@with_phases(ELECTRA_FORKS)
+@always_bls
+@spec_state_test
+def test_top_up_to_withdrawn_validator_applies(spec, state):
+    """Even fully-withdrawn validators accept top-ups (the sweep will
+    reclaim them next slot)."""
+    epoch = int(spec.get_current_epoch(state))
+    state.validators[3].exit_epoch = max(0, epoch - 1)
+    state.validators[3].withdrawable_epoch = max(0, epoch - 1)
+    state.balances[3] = 0
+    v = state.validators[3]
+    deposit = spec.PendingDeposit(
+        pubkey=v.pubkey,
+        withdrawal_credentials=v.withdrawal_credentials,
+        amount=GWEI,
+        signature=bls.G2_POINT_AT_INFINITY,
+        slot=spec.GENESIS_SLOT,
+    )
+    spec.apply_pending_deposit(state, deposit)
+    assert int(state.balances[3]) == GWEI
+
+
+# ------------------------------------------------------- malformed pubkeys
+
+
+@with_phases(ELECTRA_FORKS)
+@always_bls
+@spec_state_test
+def test_new_deposit_invalid_pubkey_decompression_dropped(spec, state):
+    """A pubkey that fails point decompression must be skipped, not crash
+    (reference: apply_pending_deposit_key_validate_invalid_decompression)."""
+    deposit = spec.PendingDeposit(
+        pubkey=b"\xff" * 48,  # invalid compression flags
+        withdrawal_credentials=ETH1_CREDS,
+        amount=32 * GWEI,
+        signature=b"\xaa" * 96,
+        slot=spec.GENESIS_SLOT,
+    )
+    pre_count = len(state.validators)
+    spec.apply_pending_deposit(state, deposit)
+    assert len(state.validators) == pre_count
+
+
+@with_phases(ELECTRA_FORKS)
+@always_bls
+@spec_state_test
+def test_new_deposit_identity_pubkey_dropped(spec, state):
+    """The G1 identity is not a valid deposit pubkey (KeyValidate)."""
+    identity = b"\xc0" + b"\x00" * 47
+    deposit = spec.PendingDeposit(
+        pubkey=identity,
+        withdrawal_credentials=ETH1_CREDS,
+        amount=32 * GWEI,
+        signature=b"\xaa" * 96,
+        slot=spec.GENESIS_SLOT,
+    )
+    pre_count = len(state.validators)
+    spec.apply_pending_deposit(state, deposit)
+    assert len(state.validators) == pre_count
+
+
+# ------------------------------------------------------------ queue driver
+
+
+@with_phases(ELECTRA_FORKS)
+@always_bls
+@spec_state_test
+def test_process_pending_deposits_new_validator_signature_checked(spec, state):
+    """End-to-end through the queue: good-sig deposit registers, bad-sig is
+    consumed without registering."""
+    good = _signed_new_deposit(spec, state, ETH1_CREDS, 32 * GWEI)
+    bad = _signed_new_deposit(
+        spec, state, ETH1_CREDS, 32 * GWEI, privkey_index=_new_key_index(state) + 3,
+        good_sig=False,
+    )
+    state.pending_deposits.append(good)
+    state.pending_deposits.append(bad)
+    pre_count = len(state.validators)
+    spec.process_pending_deposits(state)
+    assert len(state.validators) == pre_count + 1
+    assert len(state.pending_deposits) == 0
