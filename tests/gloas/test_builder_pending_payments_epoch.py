@@ -1,0 +1,158 @@
+"""process_builder_pending_payments epoch table, gloas (reference
+analogue: test/gloas/epoch_processing/test_process_builder_pending_payments.py
+— quorum boundaries, queue rotation, churn impact; spec:
+specs/gloas/beacon-chain.md process_builder_pending_payments)."""
+
+from eth_consensus_specs_tpu.test_infra.context import spec_state_test, with_phases
+from eth_consensus_specs_tpu.test_infra.template import instantiate
+
+GLOAS = ["gloas"]
+GWEI = 1_000_000_000
+
+
+def _payment(spec, state, slot_pos: int, weight: int, amount: int, builder: int = 1):
+    payment = spec.BuilderPendingPayment(
+        weight=weight,
+        withdrawal=spec.BuilderPendingWithdrawal(
+            fee_recipient=b"\x42" * 20,
+            amount=amount,
+            builder_index=builder,
+        ),
+    )
+    state.builder_pending_payments[slot_pos] = payment
+    return payment
+
+
+@with_phases(GLOAS)
+@spec_state_test
+def test_empty_queue_rotates(spec, state):
+    slots = int(spec.SLOTS_PER_EPOCH)
+    assert len(state.builder_pending_payments) == 2 * slots
+    spec.process_builder_pending_payments(state)
+    assert len(state.builder_pending_payments) == 2 * slots
+    assert len(state.builder_pending_withdrawals) == 0
+    assert all(
+        int(p.weight) == 0 and int(p.withdrawal.amount) == 0
+        for p in state.builder_pending_payments
+    )
+
+
+def _quorum_case(relation: str):
+    @with_phases(GLOAS)
+    @spec_state_test
+    def case(spec, state):
+        quorum = int(spec.get_builder_payment_quorum_threshold(state))
+        weight = {
+            "below": max(quorum - 1, 0),
+            "equal": quorum,
+            "above": quorum + 1,
+        }[relation]
+        _payment(spec, state, 0, weight, 7 * GWEI)
+        spec.process_builder_pending_payments(state)
+        settled = len(state.builder_pending_withdrawals)
+        # STRICTLY-above quorum settles; equal and below are dropped
+        assert settled == (1 if relation == "above" else 0)
+        if relation == "above":
+            w = state.builder_pending_withdrawals[0]
+            assert int(w.amount) == 7 * GWEI
+            assert int(w.withdrawable_epoch) >= int(
+                spec.get_current_epoch(state)
+            ) + int(spec.config.MIN_VALIDATOR_WITHDRAWABILITY_DELAY)
+
+    return case, f"test_payment_{relation}_quorum"
+
+
+for _relation in ("below", "equal", "above"):
+    instantiate(_quorum_case, _relation)
+
+
+@with_phases(GLOAS)
+@spec_state_test
+def test_multiple_above_quorum_all_settle(spec, state):
+    quorum = int(spec.get_builder_payment_quorum_threshold(state))
+    slots = int(spec.SLOTS_PER_EPOCH)
+    for pos in range(min(3, slots)):
+        _payment(spec, state, pos, quorum + 1, (pos + 1) * GWEI, builder=pos + 1)
+    spec.process_builder_pending_payments(state)
+    assert len(state.builder_pending_withdrawals) == min(3, slots)
+    amounts = [int(w.amount) for w in state.builder_pending_withdrawals]
+    assert amounts == [(i + 1) * GWEI for i in range(min(3, slots))]
+
+
+@with_phases(GLOAS)
+@spec_state_test
+def test_mixed_weights_settle_selectively(spec, state):
+    quorum = int(spec.get_builder_payment_quorum_threshold(state))
+    _payment(spec, state, 0, quorum + 5, 2 * GWEI)
+    _payment(spec, state, 1, max(quorum - 5, 0), 3 * GWEI)
+    _payment(spec, state, 2, quorum + 1, 4 * GWEI)
+    spec.process_builder_pending_payments(state)
+    amounts = [int(w.amount) for w in state.builder_pending_withdrawals]
+    assert amounts == [2 * GWEI, 4 * GWEI]
+
+
+@with_phases(GLOAS)
+@spec_state_test
+def test_only_previous_epoch_window_settles(spec, state):
+    """Only the FIRST SLOTS_PER_EPOCH entries (previous epoch) settle;
+    current-epoch entries rotate into the previous-epoch window."""
+    quorum = int(spec.get_builder_payment_quorum_threshold(state))
+    slots = int(spec.SLOTS_PER_EPOCH)
+    _payment(spec, state, slots, quorum + 1, 9 * GWEI)  # current-epoch slot 0
+    spec.process_builder_pending_payments(state)
+    assert len(state.builder_pending_withdrawals) == 0
+    # rotated into the settlement window, preserved
+    assert int(state.builder_pending_payments[0].withdrawal.amount) == 9 * GWEI
+    # a second epoch pass settles it
+    spec.process_builder_pending_payments(state)
+    assert len(state.builder_pending_withdrawals) == 1
+
+
+@with_phases(GLOAS)
+@spec_state_test
+def test_queue_rotation_clears_tail(spec, state):
+    quorum = int(spec.get_builder_payment_quorum_threshold(state))
+    slots = int(spec.SLOTS_PER_EPOCH)
+    for pos in range(2 * slots):
+        _payment(spec, state, pos, quorum + 1, GWEI)
+    spec.process_builder_pending_payments(state)
+    # previous window settled; current window shifted down; tail zeroed
+    assert len(state.builder_pending_withdrawals) == slots
+    assert all(
+        int(p.withdrawal.amount) == GWEI
+        for p in state.builder_pending_payments[:slots]
+    )
+    assert all(
+        int(p.withdrawal.amount) == 0
+        for p in state.builder_pending_payments[slots:]
+    )
+
+
+@with_phases(GLOAS)
+@spec_state_test
+def test_large_amount_consumes_exit_churn(spec, state):
+    """A settled payment larger than the per-epoch churn pushes
+    earliest_exit_epoch out — builder payments share the EIP-7251 exit
+    churn budget."""
+    quorum = int(spec.get_builder_payment_quorum_threshold(state))
+    churn = int(spec.get_activation_exit_churn_limit(state))
+    _payment(spec, state, 0, quorum + 1, 3 * churn)
+    pre_earliest = int(state.earliest_exit_epoch)
+    spec.process_builder_pending_payments(state)
+    assert len(state.builder_pending_withdrawals) == 1
+    assert int(state.earliest_exit_epoch) >= max(
+        pre_earliest,
+        int(spec.compute_activation_exit_epoch(spec.get_current_epoch(state))),
+    ) + 2
+
+
+@with_phases(GLOAS)
+@spec_state_test
+def test_settled_withdrawable_epoch_tracks_churned_exit(spec, state):
+    quorum = int(spec.get_builder_payment_quorum_threshold(state))
+    _payment(spec, state, 0, quorum + 1, GWEI)
+    spec.process_builder_pending_payments(state)
+    w = state.builder_pending_withdrawals[0]
+    assert int(w.withdrawable_epoch) == int(state.earliest_exit_epoch) + int(
+        spec.config.MIN_VALIDATOR_WITHDRAWABILITY_DELAY
+    )

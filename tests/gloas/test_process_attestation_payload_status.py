@@ -1,0 +1,129 @@
+"""Gloas attestation payload-status families (reference analogue:
+test/gloas/block_processing/test_process_attestation.py — the 13-variant
+data.index-as-payload-availability file; spec: specs/gloas/beacon-chain.md
+process_attestation / get_attestation_participation_flag_indices)."""
+
+from eth_consensus_specs_tpu.test_infra.attestations import get_valid_attestation
+from eth_consensus_specs_tpu.test_infra.context import (
+    expect_assertion_error,
+    spec_state_test,
+    with_phases,
+)
+from eth_consensus_specs_tpu.test_infra.state import next_slots
+
+GLOAS = ["gloas"]
+
+
+def _aged_attestation(spec, state, index_value=0, available=None):
+    next_slots(spec, state, 5)
+    attestation = get_valid_attestation(spec, state, signed=True)
+    slot_index = int(attestation.data.slot) % int(spec.SLOTS_PER_HISTORICAL_ROOT)
+    if available is not None:
+        state.execution_payload_availability[slot_index] = available
+    attestation.data.index = index_value
+    next_slots(spec, state, int(spec.MIN_ATTESTATION_INCLUSION_DELAY))
+    return attestation
+
+
+@with_phases(GLOAS)
+@spec_state_test
+def test_invalid_index_too_high(spec, state):
+    attestation = _aged_attestation(spec, state)
+    attestation.data.index = 2
+    expect_assertion_error(lambda: spec.process_attestation(state, attestation))
+
+
+@with_phases(GLOAS)
+@spec_state_test
+def test_index_zero_previous_slot_payload_absent(spec, state):
+    """index=0 (payload absent) matches an availability bit of 0."""
+    attestation = _aged_attestation(spec, state, index_value=0, available=0)
+    spec.process_attestation(state, attestation)
+    participation = (
+        state.current_epoch_participation
+        if attestation.data.target.epoch == spec.get_current_epoch(state)
+        else state.previous_epoch_participation
+    )
+    attesters = spec.get_attesting_indices(state, attestation)
+    assert all(
+        spec.has_flag(participation[i], spec.TIMELY_TARGET_FLAG_INDEX)
+        for i in attesters
+    )
+
+
+@with_phases(GLOAS)
+@spec_state_test
+def test_index_one_previous_slot_payload_present(spec, state):
+    attestation = _aged_attestation(spec, state, index_value=1, available=1)
+    spec.process_attestation(state, attestation)
+
+
+@with_phases(GLOAS)
+@spec_state_test
+def test_mismatched_payload_status_no_head_flag(spec, state):
+    """index disagreeing with the availability bit: attestation is still
+    VALID (target counts) but earns no head credit."""
+    attestation = _aged_attestation(spec, state, index_value=1, available=0)
+    spec.process_attestation(state, attestation)
+    participation = (
+        state.current_epoch_participation
+        if attestation.data.target.epoch == spec.get_current_epoch(state)
+        else state.previous_epoch_participation
+    )
+    attesters = spec.get_attesting_indices(state, attestation)
+    assert all(
+        not spec.has_flag(participation[i], spec.TIMELY_HEAD_FLAG_INDEX)
+        for i in attesters
+    )
+
+
+@with_phases(GLOAS)
+@spec_state_test
+def test_matching_payload_gets_head_flag(spec, state):
+    """index agreeing with the availability bit + timely inclusion + right
+    head root earns the head flag."""
+    attestation = _aged_attestation(spec, state, index_value=1, available=1)
+    spec.process_attestation(state, attestation)
+    participation = (
+        state.current_epoch_participation
+        if attestation.data.target.epoch == spec.get_current_epoch(state)
+        else state.previous_epoch_participation
+    )
+    attesters = spec.get_attesting_indices(state, attestation)
+    assert all(
+        spec.has_flag(participation[i], spec.TIMELY_HEAD_FLAG_INDEX)
+        for i in attesters
+    )
+
+
+def _same_slot_attestation(spec, state, index_value):
+    """An attestation voting for the block PROPOSED AT its own slot: apply a
+    real block so the slot's root differs from its parent's, then attest to
+    it (is_attestation_same_slot, specs/gloas/beacon-chain.md:362-374)."""
+    from eth_consensus_specs_tpu.test_infra.block import apply_empty_block
+
+    next_slots(spec, state, 4)
+    apply_empty_block(spec, state, int(state.slot) + 1)
+    attestation = get_valid_attestation(spec, state, slot=int(state.slot), signed=True)
+    attestation.data.index = index_value
+    next_slots(spec, state, int(spec.MIN_ATTESTATION_INCLUSION_DELAY))
+    assert spec.is_attestation_same_slot(state, attestation.data)
+    return attestation
+
+
+@with_phases(GLOAS)
+@spec_state_test
+def test_same_slot_attestation_index_zero_valid(spec, state):
+    attestation = _same_slot_attestation(spec, state, index_value=0)
+    spec.process_attestation(state, attestation)
+
+
+@with_phases(GLOAS)
+@spec_state_test
+def test_same_slot_attestation_index_one_invalid(spec, state):
+    """Same-slot attestations must carry index 0 — the payload for that
+    slot can't be known at attestation time."""
+    attestation = _same_slot_attestation(spec, state, index_value=1)
+    slot_index = int(attestation.data.slot) % int(spec.SLOTS_PER_HISTORICAL_ROOT)
+    state.execution_payload_availability[slot_index] = 1
+    expect_assertion_error(lambda: spec.process_attestation(state, attestation))
