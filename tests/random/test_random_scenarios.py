@@ -1,0 +1,132 @@
+"""Seeded randomized-scenario table: randomized STATE shapes (exits,
+slashings, balance spreads, participation), optional inactivity leak,
+then randomized block activity — the reference's generated
+random/test_random.py scenario matrix in table form
+(reference: test/utils/randomized_block_tests.py:63-124, 191-320).
+
+Nightly lane (slow): each case drives multi-epoch full transitions."""
+
+import pytest
+
+pytestmark = pytest.mark.slow
+
+import random
+
+from eth_consensus_specs_tpu.ssz import hash_tree_root
+from eth_consensus_specs_tpu.test_infra.context import spec_state_test, with_phases
+from eth_consensus_specs_tpu.test_infra.forks import is_post_altair
+from eth_consensus_specs_tpu.test_infra.state import next_epoch, next_slot
+from eth_consensus_specs_tpu.test_infra.template import instantiate
+
+from .test_random_blocks import _random_chain
+
+PHASES = ["phase0", "altair", "bellatrix", "capella", "deneb", "electra"]
+
+
+def randomize_state(spec, state, rng, exit_fraction=0.1, slash_fraction=0.1):
+    """Mirror of the reference's randomize_state: scatter balances, exit
+    and slash random fractions, scramble participation (reference:
+    randomized_block_tests.py:63-124)."""
+    cap = int(spec.MAX_EFFECTIVE_BALANCE)
+    inc = int(spec.EFFECTIVE_BALANCE_INCREMENT)
+    epoch = int(spec.get_current_epoch(state))
+    for index in range(len(state.validators)):
+        roll = rng.random()
+        if roll < exit_fraction:
+            # exited but not withdrawn
+            state.validators[index].exit_epoch = max(epoch - 1, 0)
+            state.validators[index].withdrawable_epoch = epoch + 16
+        elif roll < exit_fraction + slash_fraction:
+            state.validators[index].slashed = True
+            state.validators[index].exit_epoch = max(epoch - 1, 0)
+            state.validators[index].withdrawable_epoch = epoch + 16
+        state.balances[index] = rng.choice(
+            [cap // 2, cap - inc, cap, cap + inc, cap + 4 * inc]
+        )
+    if is_post_altair(spec):
+        for i in range(len(state.previous_epoch_participation)):
+            state.previous_epoch_participation[i] = rng.getrandbits(3)
+            state.current_epoch_participation[i] = rng.getrandbits(3)
+
+
+def _force_leak(spec, state):
+    state.finalized_checkpoint.epoch = 0
+    target = int(spec.MIN_EPOCHS_TO_INACTIVITY_PENALTY) + 3
+    while int(spec.get_current_epoch(state)) < target:
+        next_epoch(spec, state)
+
+
+def _check_invariants(spec, state):
+    for validator in state.validators:
+        if validator.slashed:
+            assert int(validator.exit_epoch) != int(spec.FAR_FUTURE_EPOCH)
+    assert int(state.latest_block_header.slot) <= int(state.slot)
+    # balances stay representable and effective balances stay on increments
+    inc = int(spec.EFFECTIVE_BALANCE_INCREMENT)
+    for validator in state.validators:
+        assert int(validator.effective_balance) % inc == 0
+
+
+def _scenario_case(seed: int, leak: bool, epochs_of_blocks: int):
+    @with_phases(PHASES)
+    @spec_state_test
+    def case(spec, state):
+        rng = random.Random(seed)
+        next_epoch(spec, state)
+        randomize_state(spec, state, rng)
+        if leak:
+            _force_leak(spec, state)
+            assert spec.is_in_inactivity_leak(state)
+        else:
+            next_epoch(spec, state)
+        # randomized activity, then settle with one clean epoch
+        slots = epochs_of_blocks * int(spec.SLOTS_PER_EPOCH)
+        _random_chain(spec, state, rng, slots)
+        next_epoch(spec, state)
+        _check_invariants(spec, state)
+        # determinism: state root is a pure function of the seed
+        root_1 = bytes(hash_tree_root(state))
+        assert root_1 == bytes(hash_tree_root(state))
+
+    leak_tag = "leak" if leak else "no_leak"
+    return case, f"test_randomized_{seed}_{leak_tag}_{epochs_of_blocks}ep"
+
+
+_SCENARIOS = [
+    (0, False, 1),
+    (1, False, 1),
+    (2, False, 2),
+    (3, True, 1),
+    (4, True, 1),
+    (5, True, 2),
+    (6, False, 1),
+    (7, True, 1),
+]
+
+for _seed, _leak, _epochs in _SCENARIOS:
+    instantiate(_scenario_case, _seed, _leak, _epochs)
+
+
+@with_phases(PHASES)
+@spec_state_test
+def test_randomized_state_survives_empty_epochs(spec, state):
+    """A randomized state with NO block activity transitions cleanly
+    through three epoch boundaries (reference scenario: randomized state +
+    epochs_until_leak + empty epochs)."""
+    rng = random.Random(42)
+    next_epoch(spec, state)
+    randomize_state(spec, state, rng)
+    for _ in range(3):
+        next_epoch(spec, state)
+    _check_invariants(spec, state)
+
+
+@with_phases(PHASES)
+@spec_state_test
+def test_randomized_state_single_empty_slots(spec, state):
+    rng = random.Random(43)
+    next_epoch(spec, state)
+    randomize_state(spec, state, rng)
+    for _ in range(int(spec.SLOTS_PER_EPOCH) + 2):
+        next_slot(spec, state)
+    _check_invariants(spec, state)
