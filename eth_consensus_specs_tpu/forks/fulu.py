@@ -334,6 +334,22 @@ class FuluSpec(ElectraSpec):
         assert len(custody_groups) == len(set(custody_groups))
         return sorted(custody_groups)
 
+    def get_validators_custody_requirement(self, state, validator_indices) -> int:
+        """Nodes with attached validators custody more groups, scaled by
+        total attached effective balance (reference:
+        specs/fulu/validator.md:124-131)."""
+        total_node_balance = sum(
+            int(state.validators[int(index)].effective_balance)
+            for index in validator_indices
+        )
+        count = total_node_balance // int(
+            self.config.BALANCE_PER_ADDITIONAL_CUSTODY_GROUP
+        )
+        return min(
+            max(count, int(self.config.VALIDATOR_CUSTODY_REQUIREMENT)),
+            int(self.config.NUMBER_OF_CUSTODY_GROUPS),
+        )
+
     def compute_columns_for_custody_group(self, custody_group: int):
         assert custody_group < self.config.NUMBER_OF_CUSTODY_GROUPS
         columns_per_group = self.NUMBER_OF_COLUMNS // self.config.NUMBER_OF_CUSTODY_GROUPS
