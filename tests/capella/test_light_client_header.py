@@ -1,0 +1,111 @@
+"""Light-client header validity across the capella+ execution era
+(reference analogue: test/capella/light_client/test_single_merkle_proof.py
++ per-fork light_client suites; spec:
+specs/capella/light-client/sync-protocol.md:129-156)."""
+
+from eth_consensus_specs_tpu.ssz import hash_tree_root
+from eth_consensus_specs_tpu.test_infra.block import (
+    apply_empty_block,
+    build_empty_block_for_next_slot,
+    state_transition_and_sign_block,
+)
+from eth_consensus_specs_tpu.test_infra.context import (
+    spec_state_test_with_matching_config,
+    with_phases,
+)
+from eth_consensus_specs_tpu.test_infra.state import next_slot
+
+EXECUTION_FORKS = ["capella", "deneb", "electra"]
+
+
+def _header_from_block(spec, state):
+    block = build_empty_block_for_next_slot(spec, state)
+    signed = state_transition_and_sign_block(spec, state, block)
+    return spec.block_to_light_client_header(signed)
+
+
+@with_phases(EXECUTION_FORKS)
+@spec_state_test_with_matching_config
+def test_header_from_real_block_is_valid(spec, state):
+    header = _header_from_block(spec, state)
+    assert spec.is_valid_light_client_header(header)
+
+
+@with_phases(EXECUTION_FORKS)
+@spec_state_test_with_matching_config
+def test_header_execution_root_matches_payload_header(spec, state):
+    header = _header_from_block(spec, state)
+    assert bytes(spec.get_lc_execution_root(header)) == bytes(
+        hash_tree_root(header.execution)
+    )
+
+
+@with_phases(EXECUTION_FORKS)
+@spec_state_test_with_matching_config
+def test_header_corrupted_branch_invalid(spec, state):
+    header = _header_from_block(spec, state)
+    branch = list(header.execution_branch)
+    branch[0] = b"\xaa" * 32
+    header.execution_branch = branch
+    assert not spec.is_valid_light_client_header(header)
+
+
+@with_phases(EXECUTION_FORKS)
+@spec_state_test_with_matching_config
+def test_header_mutated_execution_invalid(spec, state):
+    header = _header_from_block(spec, state)
+    header.execution.gas_limit = int(header.execution.gas_limit) + 1
+    assert not spec.is_valid_light_client_header(header)
+
+
+@with_phases(EXECUTION_FORKS)
+@spec_state_test_with_matching_config
+def test_header_execution_branch_depth_matches_gindex(spec, state):
+    from eth_consensus_specs_tpu.forks.light_client import floorlog2
+
+    header = _header_from_block(spec, state)
+    assert len(header.execution_branch) == floorlog2(spec.EXECUTION_PAYLOAD_GINDEX)
+
+
+@with_phases(["deneb", "electra"])
+@spec_state_test_with_matching_config
+def test_header_carries_blob_gas_fields(spec, state):
+    """Deneb LC headers surface blob_gas_used/excess_blob_gas — mutating
+    them breaks the proof."""
+    header = _header_from_block(spec, state)
+    assert hasattr(header.execution, "blob_gas_used")
+    header.execution.excess_blob_gas = int(header.execution.excess_blob_gas) + 1
+    assert not spec.is_valid_light_client_header(header)
+
+
+@with_phases(EXECUTION_FORKS)
+@spec_state_test_with_matching_config
+def test_header_valid_after_multiple_blocks(spec, state):
+    for _ in range(3):
+        apply_empty_block(spec, state, int(state.slot) + 1)
+    next_slot(spec, state)
+    header = _header_from_block(spec, state)
+    assert spec.is_valid_light_client_header(header)
+
+
+@with_phases(EXECUTION_FORKS)
+@spec_state_test_with_matching_config
+def test_bootstrap_header_roundtrip(spec, state):
+    """A bootstrap built from a block's header initializes a store whose
+    finalized header passes validity."""
+    header = _header_from_block(spec, state)
+    bootstrap = spec.LightClientBootstrap(
+        header=header,
+        current_sync_committee=state.current_sync_committee,
+    )
+    # current-sync-committee branch for the bootstrap state
+    from eth_consensus_specs_tpu.ssz.merkle import compute_merkle_proof
+
+    gindex = spec.current_sync_committee_gindex_at_slot(int(state.slot))
+    branch = compute_merkle_proof(state, gindex)
+    bootstrap.current_sync_committee_branch = spec.normalize_merkle_branch(
+        branch, gindex
+    )
+    trusted_root = bytes(hash_tree_root(header.beacon))
+    store = spec.initialize_light_client_store(trusted_root, bootstrap)
+    assert spec.is_valid_light_client_header(store.finalized_header)
