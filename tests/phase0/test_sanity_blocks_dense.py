@@ -1,0 +1,404 @@
+"""Dense sanity-block suite, all forks (reference analogue:
+test/phase0/sanity/test_blocks.py — the 45-variant whole-block file:
+invalid transition shapes, signature/proposer-index corruption,
+multi-operation blocks with duplicate/overlap rules, eth1 voting, and
+seeded full-random operation blocks)."""
+
+import random
+
+from eth_consensus_specs_tpu.ssz import hash_tree_root
+from eth_consensus_specs_tpu.test_infra.attestations import (
+    get_valid_attestation,
+)
+from eth_consensus_specs_tpu.test_infra.block import (
+    build_empty_block,
+    build_empty_block_for_next_slot,
+    sign_block,
+    state_transition_and_sign_block,
+)
+from eth_consensus_specs_tpu.test_infra.context import (
+    always_bls,
+    expect_assertion_error,
+    spec_state_test,
+    with_all_phases,
+    with_phases,
+)
+from eth_consensus_specs_tpu.test_infra.deposits import prepare_state_and_deposit
+from eth_consensus_specs_tpu.test_infra.keys import privkeys
+from eth_consensus_specs_tpu.test_infra.slashings import (
+    get_valid_attester_slashing,
+    get_valid_proposer_slashing,
+)
+from eth_consensus_specs_tpu.test_infra.state import next_slot, next_slots, transition_to
+from eth_consensus_specs_tpu.test_infra.template import instantiate
+from eth_consensus_specs_tpu.test_infra.voluntary_exits import prepare_signed_exits
+from eth_consensus_specs_tpu.utils import bls
+
+PHASES = ["phase0", "altair", "bellatrix", "capella", "deneb", "electra"]
+
+
+def _apply(spec, state, block, expect_fail=False):
+    return state_transition_and_sign_block(spec, state, block, expect_fail=expect_fail)
+
+
+# ------------------------------------------------------ transition shapes
+
+
+@with_phases(PHASES)
+@spec_state_test
+def test_invalid_prev_slot_block_transition(spec, state):
+    block = build_empty_block(spec, state, int(state.slot))  # block AT current slot
+    next_slot(spec, state)  # state moves past it
+    signed = sign_block(spec, state, block)
+    expect_assertion_error(lambda: spec.state_transition(state, signed))
+
+
+@with_phases(PHASES)
+@spec_state_test
+def test_invalid_same_slot_block_transition(spec, state):
+    next_slot(spec, state)
+    block = build_empty_block(spec, state, int(state.slot))
+    signed = sign_block(spec, state, block)
+    # state already AT the block slot: process_slots must reject
+    expect_assertion_error(lambda: spec.state_transition(state, signed))
+
+
+@with_phases(PHASES)
+@spec_state_test
+def test_invalid_proposal_for_genesis_slot(spec, state):
+    assert int(state.slot) == int(spec.GENESIS_SLOT)
+    block = build_empty_block(spec, state, int(spec.GENESIS_SLOT))
+    block.parent_root = state.latest_block_header.parent_root
+    signed = sign_block(spec, state, block)
+    expect_assertion_error(lambda: spec.state_transition(state, signed))
+
+
+@with_phases(PHASES)
+@spec_state_test
+def test_invalid_parent_from_same_slot(spec, state):
+    """Two blocks at consecutive slots where the second names the FIRST's
+    parent (a same-slot sibling) as its parent."""
+    original = build_empty_block_for_next_slot(spec, state)
+    signed_original = _apply(spec, state, original)
+    sibling = build_empty_block_for_next_slot(spec, state)
+    sibling.parent_root = original.parent_root  # skips the applied block
+    signed = sign_block(spec, state, sibling)
+    expect_assertion_error(lambda: spec.state_transition(state, signed))
+    assert signed_original is not None
+
+
+@with_phases(PHASES)
+@spec_state_test
+def test_invalid_incorrect_state_root(spec, state):
+    block = build_empty_block_for_next_slot(spec, state)
+    trial = state.copy()
+    spec.process_slots(trial, int(block.slot))
+    spec.process_block(trial, block)
+    block.state_root = b"\x11" * 32
+    signed = sign_block(spec, state, block)
+    expect_assertion_error(lambda: spec.state_transition(state, signed))
+
+
+def _bad_signature_case(kind: str):
+    @with_phases(PHASES)
+    @always_bls
+    @spec_state_test
+    def case(spec, state):
+        block = build_empty_block_for_next_slot(spec, state)
+        trial = state.copy()
+        spec.process_slots(trial, int(block.slot))
+        spec.process_block(trial, block)
+        block.state_root = hash_tree_root(trial)
+        if kind == "zeroed":
+            signed = spec.SignedBeaconBlock(message=block, signature=b"\x00" * 96)
+        elif kind == "wrong_key":
+            wrong = (int(block.proposer_index) + 1) % len(state.validators)
+            domain = spec.get_domain(
+                state,
+                spec.DOMAIN_BEACON_PROPOSER,
+                spec.compute_epoch_at_slot(block.slot),
+            )
+            signed = spec.SignedBeaconBlock(
+                message=block,
+                signature=bls.Sign(
+                    privkeys[wrong], spec.compute_signing_root(block, domain)
+                ),
+            )
+        else:  # wrong proposer index, signed by that wrong index
+            block.proposer_index = (int(block.proposer_index) + 1) % len(
+                state.validators
+            )
+            signed = sign_block(spec, state, block)
+        expect_assertion_error(lambda: spec.state_transition(state, signed))
+
+    return case, f"test_invalid_block_sig_{kind}"
+
+
+for _kind in ("zeroed", "wrong_key", "wrong_proposer_index"):
+    instantiate(_bad_signature_case, _kind)
+
+
+@with_phases(PHASES)
+@spec_state_test
+def test_skipped_slots_then_block(spec, state):
+    next_slots(spec, state, 3)
+    block = build_empty_block_for_next_slot(spec, state)
+    _apply(spec, state, block)
+    assert int(state.slot) == int(block.slot)
+
+
+@with_phases(PHASES)
+@spec_state_test
+def test_empty_epoch_then_block(spec, state):
+    transition_to(spec, state, int(spec.SLOTS_PER_EPOCH) * 2 - 1)
+    block = build_empty_block_for_next_slot(spec, state)
+    _apply(spec, state, block)
+    assert int(spec.get_current_epoch(state)) == 2
+
+
+# --------------------------------------------------- multi-operation blocks
+
+
+@with_phases(PHASES)
+@spec_state_test
+def test_invalid_duplicate_proposer_slashings_same_block(spec, state):
+    slashing = get_valid_proposer_slashing(spec, state, signed_1=True, signed_2=True)
+    block = build_empty_block_for_next_slot(spec, state)
+    block.body.proposer_slashings = [slashing, slashing]
+    _apply(spec, state, block, expect_fail=True)
+
+
+@with_phases(PHASES)
+@spec_state_test
+def test_invalid_similar_proposer_slashings_same_block(spec, state):
+    """Two distinct slashings for the SAME proposer: the second finds the
+    validator already slashed."""
+    index = int(spec.get_beacon_proposer_index(state))
+    a = get_valid_proposer_slashing(
+        spec, state, signed_1=True, signed_2=True, proposer_index=index
+    )
+    b = get_valid_proposer_slashing(
+        spec, state, signed_1=True, signed_2=True, proposer_index=index
+    )
+    b.signed_header_2.message.body_root = b"\x77" * 32
+    b.signed_header_2 = spec.SignedBeaconBlockHeader(
+        message=b.signed_header_2.message,
+        signature=bls.Sign(
+            privkeys[index],
+            spec.compute_signing_root(
+                b.signed_header_2.message,
+                spec.get_domain(
+                    state,
+                    spec.DOMAIN_BEACON_PROPOSER,
+                    spec.compute_epoch_at_slot(b.signed_header_2.message.slot),
+                ),
+            ),
+        ),
+    )
+    block = build_empty_block_for_next_slot(spec, state)
+    block.body.proposer_slashings = [a, b]
+    _apply(spec, state, block, expect_fail=True)
+
+
+@with_phases(PHASES)
+@spec_state_test
+def test_multiple_different_proposer_slashings_same_block(spec, state):
+    next_slot(spec, state)
+    proposer = int(spec.get_beacon_proposer_index(state))
+    targets = [i for i in range(len(state.validators)) if i != proposer][:2]
+    slashings = [
+        get_valid_proposer_slashing(
+            spec, state, signed_1=True, signed_2=True, proposer_index=i
+        )
+        for i in targets
+    ]
+    block = build_empty_block_for_next_slot(spec, state)
+    block.body.proposer_slashings = slashings
+    _apply(spec, state, block)
+    for i in targets:
+        assert state.validators[i].slashed
+
+
+@with_phases(PHASES)
+@spec_state_test
+def test_invalid_duplicate_attester_slashing_same_block(spec, state):
+    slashing = get_valid_attester_slashing(spec, state, signed_1=True, signed_2=True)
+    block = build_empty_block_for_next_slot(spec, state)
+
+    def build_and_apply():
+        # electra shrinks the list cap to 1: the duplicate pair is already
+        # rejected at SSZ construction, which is equally "invalid"
+        block.body.attester_slashings = [slashing, slashing]
+        _apply(spec, state, block)
+
+    expect_assertion_error(build_and_apply)
+
+
+@with_phases(PHASES)
+@spec_state_test
+def test_invalid_duplicate_deposit_same_block(spec, state):
+    index = len(state.validators)
+    amount = int(spec.MAX_EFFECTIVE_BALANCE)
+    deposit = prepare_state_and_deposit(spec, state, index, amount, signed=True)
+    block = build_empty_block_for_next_slot(spec, state)
+    block.body.eth1_data.deposit_count = int(state.eth1_deposit_index) + 2
+    block.body.deposits = [deposit, deposit]  # second proof no longer matches
+    _apply(spec, state, block, expect_fail=True)
+
+
+@with_phases(PHASES)
+@spec_state_test
+def test_deposit_in_block_registers_validator(spec, state):
+    index = len(state.validators)
+    amount = int(spec.MAX_EFFECTIVE_BALANCE)
+    deposit = prepare_state_and_deposit(spec, state, index, amount, signed=True)
+    block = build_empty_block_for_next_slot(spec, state)
+    block.body.eth1_data.deposit_count = int(state.eth1_deposit_index) + 1
+    block.body.deposits = [deposit]
+    _apply(spec, state, block)
+    from eth_consensus_specs_tpu.test_infra.forks import is_post_electra
+
+    if is_post_electra(spec):
+        assert len(state.pending_deposits) > 0
+    else:
+        assert len(state.validators) == index + 1
+
+
+@with_phases(PHASES)
+@spec_state_test
+def test_duplicate_attestation_same_block(spec, state):
+    next_slots(spec, state, 5)
+    attestation = get_valid_attestation(spec, state, signed=True)
+    next_slots(spec, state, int(spec.MIN_ATTESTATION_INCLUSION_DELAY))
+    block = build_empty_block_for_next_slot(spec, state)
+    block.body.attestations = [attestation, attestation]
+    # duplicate attestations are wasteful but VALID
+    _apply(spec, state, block)
+
+
+@with_phases(PHASES)
+@spec_state_test
+def test_invalid_duplicate_exit_same_block(spec, state):
+    state.slot = int(spec.config.SHARD_COMMITTEE_PERIOD) * int(spec.SLOTS_PER_EPOCH)
+    exits = prepare_signed_exits(spec, state, [len(state.validators) - 1])
+    block = build_empty_block_for_next_slot(spec, state)
+    block.body.voluntary_exits = exits + exits
+    _apply(spec, state, block, expect_fail=True)
+
+
+@with_phases(PHASES)
+@spec_state_test
+def test_multiple_different_exits_same_block(spec, state):
+    state.slot = int(spec.config.SHARD_COMMITTEE_PERIOD) * int(spec.SLOTS_PER_EPOCH)
+    n = len(state.validators)
+    exits = prepare_signed_exits(spec, state, [n - 1, n - 2, n - 3])
+    block = build_empty_block_for_next_slot(spec, state)
+    block.body.voluntary_exits = exits
+    _apply(spec, state, block)
+    for i in (n - 1, n - 2, n - 3):
+        assert int(state.validators[i].exit_epoch) != int(spec.FAR_FUTURE_EPOCH)
+
+
+@with_phases(PHASES)
+@spec_state_test
+def test_slash_and_exit_same_index_invalid(spec, state):
+    """Slashing and a voluntary exit for the same validator in one block:
+    the exit must be rejected (slashed validators cannot exit)."""
+    state.slot = int(spec.config.SHARD_COMMITTEE_PERIOD) * int(spec.SLOTS_PER_EPOCH)
+    next_slot(spec, state)
+    proposer = int(spec.get_beacon_proposer_index(state))
+    target = next(i for i in range(len(state.validators) - 1, -1, -1) if i != proposer)
+    slashing = get_valid_proposer_slashing(
+        spec, state, signed_1=True, signed_2=True, proposer_index=target
+    )
+    exits = prepare_signed_exits(spec, state, [target])
+    block = build_empty_block_for_next_slot(spec, state)
+    block.body.proposer_slashings = [slashing]
+    block.body.voluntary_exits = exits
+    _apply(spec, state, block, expect_fail=True)
+
+
+@with_phases(PHASES)
+@spec_state_test
+def test_slash_and_exit_diff_index_valid(spec, state):
+    state.slot = int(spec.config.SHARD_COMMITTEE_PERIOD) * int(spec.SLOTS_PER_EPOCH)
+    next_slot(spec, state)
+    proposer = int(spec.get_beacon_proposer_index(state))
+    candidates = [i for i in range(len(state.validators)) if i != proposer]
+    slash_target, exit_target = candidates[0], candidates[-1]
+    slashing = get_valid_proposer_slashing(
+        spec, state, signed_1=True, signed_2=True, proposer_index=slash_target
+    )
+    exits = prepare_signed_exits(spec, state, [exit_target])
+    block = build_empty_block_for_next_slot(spec, state)
+    block.body.proposer_slashings = [slashing]
+    block.body.voluntary_exits = exits
+    _apply(spec, state, block)
+    assert state.validators[slash_target].slashed
+    assert int(state.validators[exit_target].exit_epoch) != int(spec.FAR_FUTURE_EPOCH)
+
+
+# ------------------------------------------------------------- eth1 voting
+
+
+@with_phases(PHASES)
+@spec_state_test
+def test_eth1_data_votes_reach_consensus(spec, state):
+    """A majority of identical votes within the voting period adopts the
+    eth1 data (reference: sanity eth1_data_votes_consensus)."""
+    period_slots = int(spec.EPOCHS_PER_ETH1_VOTING_PERIOD) * int(spec.SLOTS_PER_EPOCH)
+    if period_slots > 64:
+        return  # mainnet-preset voting period too long for a sanity case
+    candidate = spec.Eth1Data(
+        deposit_root=b"\x61" * 32,
+        deposit_count=int(state.eth1_deposit_index),
+        block_hash=b"\x62" * 32,
+    )
+    needed = period_slots // 2 + 1
+    for _ in range(needed):
+        block = build_empty_block_for_next_slot(spec, state)
+        block.body.eth1_data = candidate
+        _apply(spec, state, block)
+    assert bytes(state.eth1_data.block_hash) == b"\x62" * 32
+
+
+# -------------------------------------------------- random operation blocks
+
+
+def _full_random_operations_case(seed: int):
+    @with_all_phases
+    @spec_state_test
+    def case(spec, state):
+        rng = random.Random(seed)
+        state.slot = int(spec.config.SHARD_COMMITTEE_PERIOD) * int(
+            spec.SLOTS_PER_EPOCH
+        )
+        next_slot(spec, state)
+        proposer = int(spec.get_beacon_proposer_index(state))
+        block = build_empty_block_for_next_slot(spec, state)
+        used = {proposer}
+        if rng.random() < 0.8:
+            target = rng.choice([i for i in range(len(state.validators)) if i not in used])
+            used.add(target)
+            block.body.proposer_slashings = [
+                get_valid_proposer_slashing(
+                    spec, state, signed_1=True, signed_2=True, proposer_index=target
+                )
+            ]
+        if rng.random() < 0.8:
+            free = [i for i in range(len(state.validators)) if i not in used]
+            exit_target = rng.choice(free)
+            used.add(exit_target)
+            block.body.voluntary_exits = prepare_signed_exits(
+                spec, state, [exit_target]
+            )
+        _apply(spec, state, block)
+        for i in used - {proposer}:
+            v = state.validators[i]
+            assert v.slashed or int(v.exit_epoch) != int(spec.FAR_FUTURE_EPOCH)
+
+    return case, f"test_full_random_operations_{seed}"
+
+
+for _seed in (0, 1, 2, 3):
+    instantiate(_full_random_operations_case, _seed)
