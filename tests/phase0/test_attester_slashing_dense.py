@@ -1,0 +1,241 @@
+"""Dense process_attester_slashing table, all forks (reference analogue:
+test/phase0/block_processing/test_process_attester_slashing.py — the
+30-variant file: per-attestation index corruption, signature corruption,
+lifecycle overlays; spec: specs/phase0/beacon-chain.md
+process_attester_slashing / is_valid_indexed_attestation)."""
+
+from eth_consensus_specs_tpu.test_infra.attestations import sign_attestation
+from eth_consensus_specs_tpu.test_infra.context import (
+    always_bls,
+    spec_state_test,
+    with_all_phases,
+)
+from eth_consensus_specs_tpu.test_infra.slashings import (
+    get_valid_attester_slashing,
+    run_attester_slashing_processing,
+)
+from eth_consensus_specs_tpu.test_infra.state import next_epoch, next_slots
+from eth_consensus_specs_tpu.test_infra.template import instantiate
+
+
+def _fresh_slashing(spec, state, signed=True):
+    next_slots(spec, state, 10)
+    slashing = get_valid_attester_slashing(
+        spec, state, signed_1=signed, signed_2=signed
+    )
+    next_slots(spec, state, int(spec.MIN_ATTESTATION_INCLUSION_DELAY))
+    return slashing
+
+
+def _drain(gen):
+    for _ in gen:
+        pass
+
+
+# ------------------------------------------------------ lifecycle overlays
+
+
+@with_all_phases
+@spec_state_test
+def test_already_exited_recent_still_slashable(spec, state):
+    """Validators in the exit queue (not yet withdrawable) remain
+    slashable."""
+    slashing = _fresh_slashing(spec, state)
+    indices = [int(i) for i in slashing.attestation_1.attesting_indices]
+    for index in indices:
+        spec.initiate_validator_exit(state, index)
+    _drain(run_attester_slashing_processing(spec, state, slashing))
+    for index in indices:
+        assert state.validators[index].slashed
+
+
+@with_all_phases
+@spec_state_test
+def test_invalid_already_exited_long_ago(spec, state):
+    """Fully withdrawable validators are PAST the slashability window."""
+    slashing = _fresh_slashing(spec, state)
+    indices = [int(i) for i in slashing.attestation_1.attesting_indices]
+    epoch = int(spec.get_current_epoch(state))
+    for index in indices:
+        state.validators[index].exit_epoch = max(epoch - 4, 0)
+        state.validators[index].withdrawable_epoch = max(epoch - 1, 0)
+    _drain(run_attester_slashing_processing(spec, state, slashing, valid=False))
+
+
+@with_all_phases
+@spec_state_test
+def test_invalid_participants_already_slashed(spec, state):
+    slashing = _fresh_slashing(spec, state)
+    indices = [int(i) for i in slashing.attestation_1.attesting_indices]
+    epoch = int(spec.get_current_epoch(state))
+    for index in indices:
+        state.validators[index].slashed = True
+        state.validators[index].exit_epoch = epoch
+        state.validators[index].withdrawable_epoch = epoch + 8
+    # no NEW slashable participant: the operation is rejected
+    _drain(run_attester_slashing_processing(spec, state, slashing, valid=False))
+
+
+@with_all_phases
+@spec_state_test
+def test_one_of_many_already_slashed_rest_slashed(spec, state):
+    """If SOME participants were already slashed, the rest still get
+    slashed and the operation is valid."""
+    slashing = _fresh_slashing(spec, state)
+    indices = [int(i) for i in slashing.attestation_1.attesting_indices]
+    if len(indices) < 2:
+        return  # need at least two participants to split
+    epoch = int(spec.get_current_epoch(state))
+    pre_slashed = indices[0]
+    state.validators[pre_slashed].slashed = True
+    state.validators[pre_slashed].exit_epoch = epoch
+    state.validators[pre_slashed].withdrawable_epoch = epoch + 8
+    _drain(run_attester_slashing_processing(spec, state, slashing))
+    for index in indices[1:]:
+        assert state.validators[index].slashed
+
+
+@with_all_phases
+@spec_state_test
+def test_attestation_from_future_slashable(spec, state):
+    """The spec never checks the slashing's slot against the state — a
+    pair dated in the future is still slashable evidence (reference:
+    test_process_attester_slashing.py attestation_from_future, a VALID
+    case)."""
+    slashing = _fresh_slashing(spec, state, signed=False)
+    indices = [int(i) for i in slashing.attestation_1.attesting_indices]
+    slashing.attestation_1.data.slot = int(state.slot) + 100
+    slashing.attestation_2.data.slot = int(state.slot) + 100
+    _drain(run_attester_slashing_processing(spec, state, slashing))
+    for index in indices:
+        assert state.validators[index].slashed
+
+
+# -------------------------------------------------------- index corruption
+
+
+def _index_corruption_case(which: str, mode: str):
+    # "extra" smuggles a legitimate validator into the list: only the
+    # aggregate signature betrays it, so that mode pins real BLS
+    needs_bls = mode == "extra"
+
+    def body(spec, state):
+        if needs_bls:
+            next_slots(spec, state, 10)
+            slashing = get_valid_attester_slashing(
+                spec, state, signed_1=True, signed_2=True
+            )
+            next_slots(spec, state, int(spec.MIN_ATTESTATION_INCLUSION_DELAY))
+        else:
+            slashing = _fresh_slashing(spec, state)
+        att = getattr(slashing, f"attestation_{which}")
+        indices = [int(i) for i in att.attesting_indices]
+        if mode == "high_index":
+            indices.append(len(state.validators) + 5)
+        elif mode == "empty":
+            indices = []
+        elif mode == "extra":
+            extra = next(
+                i for i in range(len(state.validators)) if i not in set(indices)
+            )
+            indices.append(extra)
+            indices.sort()
+        elif mode == "duplicate":
+            indices = indices + [indices[-1]]
+        else:  # unsorted
+            if len(indices) < 2:
+                return
+            indices = [indices[-1]] + indices[:-1]
+        att.attesting_indices = indices
+        _drain(run_attester_slashing_processing(spec, state, slashing, valid=False))
+
+    if needs_bls:
+        case = with_all_phases(always_bls(spec_state_test(body)))
+    else:
+        case = with_all_phases(spec_state_test(body))
+    return case, f"test_invalid_att{which}_{mode}"
+
+
+for _which in ("1", "2"):
+    for _mode in ("high_index", "empty", "extra", "duplicate", "unsorted"):
+        instantiate(_index_corruption_case, _which, _mode)
+
+
+@with_all_phases
+@spec_state_test
+def test_invalid_all_empty_indices(spec, state):
+    slashing = _fresh_slashing(spec, state)
+    slashing.attestation_1.attesting_indices = []
+    slashing.attestation_2.attesting_indices = []
+    _drain(run_attester_slashing_processing(spec, state, slashing, valid=False))
+
+
+# ---------------------------------------------------- signature corruption
+
+
+def _sig_corruption_case(which: tuple):
+    @with_all_phases
+    @always_bls
+    @spec_state_test
+    def case(spec, state):
+        next_slots(spec, state, 10)
+        slashing = get_valid_attester_slashing(
+            spec, state, signed_1=True, signed_2=True
+        )
+        next_slots(spec, state, int(spec.MIN_ATTESTATION_INCLUSION_DELAY))
+        for n in which:
+            att = getattr(slashing, f"attestation_{n}")
+            att.signature = b"\xaa" * 96 if n == "1" else bytes(att.signature[:-1]) + b"\x01"
+        _drain(run_attester_slashing_processing(spec, state, slashing, valid=False))
+
+    tag = "_and_".join(which)
+    return case, f"test_invalid_incorrect_sig_{tag}"
+
+
+for _which in (("1",), ("2",), ("1", "2")):
+    instantiate(_sig_corruption_case, _which)
+
+
+# ----------------------------------------------------------- relation rules
+
+
+@with_all_phases
+@spec_state_test
+def test_invalid_no_double_or_surround(spec, state):
+    next_epoch(spec, state)
+    slashing = _fresh_slashing(spec, state)
+    # make attestation_2 a LATER-target vote that neither doubles nor
+    # surrounds attestation_1
+    slashing.attestation_2 = slashing.attestation_1.copy()
+    slashing.attestation_2.data.target.epoch = (
+        int(slashing.attestation_1.data.target.epoch) + 1
+    )
+    slashing.attestation_2.data.source.epoch = (
+        int(slashing.attestation_1.data.target.epoch)
+    )
+    _drain(run_attester_slashing_processing(spec, state, slashing, valid=False))
+
+
+@with_all_phases
+@spec_state_test
+def test_surround_vote_both_directions(spec, state):
+    """att1 surrounding att2 is slashable; the REVERSE pairing (att1
+    surrounded BY att2) is not — surround is checked as att1 surrounds
+    att2 only."""
+    next_epoch(spec, state)
+    next_epoch(spec, state)
+    next_slots(spec, state, 10)
+    slashing = get_valid_attester_slashing(spec, state)
+    # craft: att1 source 0 → target N (wide); att2 source 1 → target N-1 (inner)
+    a1, a2 = slashing.attestation_1, slashing.attestation_2
+    a2.data = a1.data.copy()
+    a1.data.source.epoch = 0
+    target = int(a1.data.target.epoch)
+    if target < 2:
+        return
+    a2.data.source.epoch = 1
+    a2.data.target.epoch = target - 1
+    a2.data.target.root = b"\x02" * 32
+    next_slots(spec, state, int(spec.MIN_ATTESTATION_INCLUSION_DELAY))
+    assert spec.is_slashable_attestation_data(a1.data, a2.data)
+    assert not spec.is_slashable_attestation_data(a2.data, a1.data)
