@@ -1,0 +1,202 @@
+"""Dense process_registry_updates table, all forks (reference analogue:
+test/phase0/epoch_processing/test_process_registry_updates.py — queue
+sorting, churn-limit saturation, combined activation+ejection families;
+spec: specs/phase0/beacon-chain.md process_registry_updates, electra's
+churn-free variant)."""
+
+from eth_consensus_specs_tpu.test_infra.context import (
+    spec_state_test,
+    with_all_phases,
+    with_phases,
+)
+from eth_consensus_specs_tpu.test_infra.epoch_processing import (
+    run_epoch_processing_with,
+)
+from eth_consensus_specs_tpu.test_infra.forks import is_post_electra
+from eth_consensus_specs_tpu.test_infra.state import next_epoch
+from eth_consensus_specs_tpu.test_infra.template import instantiate
+
+
+def _drain(gen):
+    for _ in gen:
+        pass
+
+
+def _queue_validator(spec, state, index, epochs_ago=3):
+    """Fresh depositor: eligible but not yet queued."""
+    v = state.validators[index]
+    v.activation_eligibility_epoch = spec.FAR_FUTURE_EPOCH
+    v.activation_epoch = spec.FAR_FUTURE_EPOCH
+    v.effective_balance = spec.MAX_EFFECTIVE_BALANCE
+
+
+def _mark_eligible(spec, state, index, eligibility_epoch):
+    v = state.validators[index]
+    v.activation_eligibility_epoch = eligibility_epoch
+    v.activation_epoch = spec.FAR_FUTURE_EPOCH
+
+
+def _finalize(spec, state, epoch=None):
+    if epoch is None:
+        epoch = max(int(spec.get_current_epoch(state)) - 1, 0)
+    state.finalized_checkpoint.epoch = epoch
+
+
+@with_all_phases
+@spec_state_test
+def test_add_to_activation_queue(spec, state):
+    _queue_validator(spec, state, 2)
+    _drain(run_epoch_processing_with(spec, state, "process_registry_updates"))
+    assert int(state.validators[2].activation_eligibility_epoch) != int(
+        spec.FAR_FUTURE_EPOCH
+    )
+
+
+@with_all_phases
+@spec_state_test
+def test_activation_queue_requires_finality(spec, state):
+    next_epoch(spec, state)
+    next_epoch(spec, state)
+    _mark_eligible(spec, state, 2, 1)
+    state.finalized_checkpoint.epoch = 0  # eligibility NOT finalized
+    _drain(run_epoch_processing_with(spec, state, "process_registry_updates"))
+    assert int(state.validators[2].activation_epoch) == int(spec.FAR_FUTURE_EPOCH)
+
+
+@with_all_phases
+@spec_state_test
+def test_activation_when_eligibility_finalized(spec, state):
+    for _ in range(4):
+        next_epoch(spec, state)
+    _mark_eligible(spec, state, 2, 1)
+    _finalize(spec, state, 2)
+    _drain(run_epoch_processing_with(spec, state, "process_registry_updates"))
+    assert int(state.validators[2].activation_epoch) != int(spec.FAR_FUTURE_EPOCH)
+
+
+@with_phases(["phase0", "altair", "bellatrix", "capella", "deneb"])
+@spec_state_test
+def test_activation_queue_sorted_by_eligibility_then_index(spec, state):
+    """Dequeue order: eligibility epoch asc, then index asc — validators
+    queued later must not activate earlier (pre-electra churn path)."""
+    for _ in range(4):
+        next_epoch(spec, state)
+    picks = [5, 3, 7]
+    epochs = [3, 1, 1]
+    for index, epoch in zip(picks, epochs):
+        _mark_eligible(spec, state, index, epoch)
+    _finalize(spec, state)
+    _drain(run_epoch_processing_with(spec, state, "process_registry_updates"))
+    a = {i: int(state.validators[i].activation_epoch) for i in picks}
+    # index 3 (epoch 1) and 7 (epoch 1) precede or tie 5 (epoch 3)
+    assert a[3] <= a[5] and a[7] <= a[5]
+    assert a[3] <= a[7]  # same epoch: lower index first
+
+
+@with_all_phases
+@spec_state_test
+def test_ejection_below_threshold(spec, state):
+    next_epoch(spec, state)
+    state.validators[4].effective_balance = int(spec.config.EJECTION_BALANCE)
+    _drain(run_epoch_processing_with(spec, state, "process_registry_updates"))
+    assert int(state.validators[4].exit_epoch) != int(spec.FAR_FUTURE_EPOCH)
+
+
+def _ejection_churn_case(count_mode: str):
+    @with_phases(["phase0", "altair", "bellatrix", "capella", "deneb"])
+    @spec_state_test
+    def case(spec, state):
+        next_epoch(spec, state)
+        churn = int(spec.get_validator_churn_limit(state))
+        count = churn if count_mode == "at_churn" else churn + 2
+        count = min(count, len(state.validators) - 2)
+        for i in range(count):
+            state.validators[i].effective_balance = int(spec.config.EJECTION_BALANCE)
+        _drain(run_epoch_processing_with(spec, state, "process_registry_updates"))
+        exit_epochs = [
+            int(state.validators[i].exit_epoch) for i in range(count)
+        ]
+        assert all(e != int(spec.FAR_FUTURE_EPOCH) for e in exit_epochs)
+        if count_mode == "past_churn":
+            # exit epochs spill into multiple epochs once churn is exceeded
+            assert len(set(exit_epochs)) >= 2
+
+    return case, f"test_ejection_{count_mode}"
+
+
+for _mode in ("at_churn", "past_churn"):
+    instantiate(_ejection_churn_case, _mode)
+
+
+@with_all_phases
+@spec_state_test
+def test_activation_and_ejection_same_epoch(spec, state):
+    for _ in range(4):
+        next_epoch(spec, state)
+    _mark_eligible(spec, state, 2, 1)
+    state.validators[9].effective_balance = int(spec.config.EJECTION_BALANCE)
+    _finalize(spec, state)
+    _drain(run_epoch_processing_with(spec, state, "process_registry_updates"))
+    assert int(state.validators[2].activation_epoch) != int(spec.FAR_FUTURE_EPOCH)
+    assert int(state.validators[9].exit_epoch) != int(spec.FAR_FUTURE_EPOCH)
+
+
+@with_phases(["electra"])
+@spec_state_test
+def test_electra_activates_all_eligible_no_churn_cap(spec, state):
+    """EIP-7251 removes the per-epoch activation churn: every finalized-
+    eligible validator activates (balance churn moved to deposit queue)."""
+    for _ in range(4):
+        next_epoch(spec, state)
+    picks = list(range(2, 12))
+    for index in picks:
+        _mark_eligible(spec, state, index, 1)
+    _finalize(spec, state)
+    _drain(run_epoch_processing_with(spec, state, "process_registry_updates"))
+    for index in picks:
+        assert int(state.validators[index].activation_epoch) != int(
+            spec.FAR_FUTURE_EPOCH
+        )
+
+
+@with_phases(["phase0", "altair", "bellatrix", "capella", "deneb"])
+@spec_state_test
+def test_pre_electra_activations_capped_by_churn(spec, state):
+    for _ in range(4):
+        next_epoch(spec, state)
+    picks = list(range(2, 2 + int(spec.get_validator_churn_limit(state)) + 3))
+    if picks[-1] >= len(state.validators):
+        return
+    for index in picks:
+        _mark_eligible(spec, state, index, 1)
+    _finalize(spec, state)
+    # churn shrinks with the deactivations above: snapshot it as the
+    # transition will see it. Deneb (EIP-7514) caps ACTIVATION churn
+    # separately from exit churn.
+    if hasattr(spec, "get_validator_activation_churn_limit"):
+        churn = int(spec.get_validator_activation_churn_limit(state))
+    else:
+        churn = int(spec.get_validator_churn_limit(state))
+    _drain(run_epoch_processing_with(spec, state, "process_registry_updates"))
+    activated_now = [
+        i
+        for i in picks
+        if int(state.validators[i].activation_epoch) != int(spec.FAR_FUTURE_EPOCH)
+    ]
+    assert len(activated_now) == min(churn, len(picks))
+
+
+@with_all_phases
+@spec_state_test
+def test_activation_epoch_has_lookahead_delay(spec, state):
+    """Activations land at compute_activation_exit_epoch(current), i.e.
+    1 + MAX_SEED_LOOKAHEAD epochs out — never sooner."""
+    for _ in range(4):
+        next_epoch(spec, state)
+    _mark_eligible(spec, state, 2, 1)
+    _finalize(spec, state)
+    _drain(run_epoch_processing_with(spec, state, "process_registry_updates"))
+    current = int(spec.get_current_epoch(state))
+    assert int(state.validators[2].activation_epoch) == current + 1 + int(
+        spec.MAX_SEED_LOOKAHEAD
+    )
