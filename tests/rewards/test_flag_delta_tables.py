@@ -1,0 +1,116 @@
+"""Per-index flag-delta oracle table, altair+ (reference analogue:
+test/altair/rewards/* + rewards/test_basic.py's participation-fraction
+matrix — empty/quarter/half/almost-full/full, with slashed and exited
+overlays; spec: specs/altair/beacon-chain.md get_flag_index_deltas).
+
+Each case paints previous-epoch participation to a target fraction, then
+checks EVERY validator's (reward, penalty) for EVERY flag against an
+independent oracle of the spec formula."""
+
+import random
+
+from eth_consensus_specs_tpu.test_infra.context import spec_state_test, with_phases
+from eth_consensus_specs_tpu.test_infra.state import next_epoch
+from eth_consensus_specs_tpu.test_infra.template import instantiate
+
+ALTAIR_PLUS = ["altair", "bellatrix", "capella", "deneb", "electra"]
+ALL_FLAGS = 0b0000_0111
+
+
+def _paint_participation(spec, state, rng, fraction: float):
+    for i in range(len(state.previous_epoch_participation)):
+        state.previous_epoch_participation[i] = (
+            ALL_FLAGS if rng.random() < fraction else 0
+        )
+
+
+def _oracle_flag_deltas(spec, state, flag_index: int):
+    """Independent restatement of get_flag_index_deltas (beacon-chain.md)."""
+    previous_epoch = spec.get_previous_epoch(state)
+    unslashed = spec.get_unslashed_participating_indices(
+        state, flag_index, previous_epoch
+    )
+    weight = int(spec.PARTICIPATION_FLAG_WEIGHTS[flag_index])
+    wd = int(spec.WEIGHT_DENOMINATOR)
+    inc = int(spec.EFFECTIVE_BALANCE_INCREMENT)
+    unslashed_increments = (
+        sum(int(state.validators[i].effective_balance) for i in unslashed) // inc
+    )
+    active_increments = int(spec.get_total_active_balance(state)) // inc
+    in_leak = spec.is_in_inactivity_leak(state)
+    rewards = [0] * len(state.validators)
+    penalties = [0] * len(state.validators)
+    for index in spec.get_eligible_validator_indices(state):
+        base_reward = int(spec.get_base_reward(state, index))
+        if index in unslashed:
+            if in_leak:
+                continue
+            reward_numerator = base_reward * weight * unslashed_increments
+            rewards[index] = reward_numerator // (active_increments * wd)
+        elif flag_index != int(spec.TIMELY_HEAD_FLAG_INDEX):
+            penalties[index] = base_reward * weight // wd
+    return rewards, penalties
+
+
+def _check_all_flags(spec, state):
+    for flag_index in range(len(spec.PARTICIPATION_FLAG_WEIGHTS)):
+        got_rewards, got_penalties = spec.get_flag_index_deltas(state, flag_index)
+        want_rewards, want_penalties = _oracle_flag_deltas(spec, state, flag_index)
+        assert [int(r) for r in got_rewards] == want_rewards, f"flag {flag_index} rewards"
+        assert [int(p) for p in got_penalties] == want_penalties, f"flag {flag_index} penalties"
+
+
+def _fraction_case(name: str, fraction: float, overlay: str, leak: bool, seed: int):
+    @with_phases(ALTAIR_PLUS)
+    @spec_state_test
+    def case(spec, state):
+        rng = random.Random(seed)
+        next_epoch(spec, state)
+        next_epoch(spec, state)
+        if leak:
+            state.finalized_checkpoint.epoch = 0
+            target = int(spec.MIN_EPOCHS_TO_INACTIVITY_PENALTY) + 3
+            while int(spec.get_current_epoch(state)) < target:
+                next_epoch(spec, state)
+            assert spec.is_in_inactivity_leak(state)
+        _paint_participation(spec, state, rng, fraction)
+        n = len(state.validators)
+        if overlay == "slashed":
+            for i in rng.sample(range(n), n // 8):
+                state.validators[i].slashed = True
+        elif overlay == "exited":
+            epoch = int(spec.get_current_epoch(state))
+            for i in rng.sample(range(n), n // 8):
+                state.validators[i].exit_epoch = max(epoch - 1, 0)
+                state.validators[i].withdrawable_epoch = epoch + 16
+        elif overlay == "mixed_balance":
+            inc = int(spec.EFFECTIVE_BALANCE_INCREMENT)
+            cap = int(spec.MAX_EFFECTIVE_BALANCE)
+            for i in rng.sample(range(n), n // 4):
+                state.validators[i].effective_balance = rng.choice(
+                    [cap // 2, cap - inc, cap]
+                )
+        _check_all_flags(spec, state)
+
+    leak_tag = "_leak" if leak else ""
+    return case, f"test_deltas_{name}_{overlay}{leak_tag}"
+
+
+_CASES = [
+    ("empty", 0.0, "none", False, 1),
+    ("quarter", 0.25, "none", False, 2),
+    ("half", 0.5, "none", False, 3),
+    ("almost_full", 0.9, "none", False, 4),
+    ("full", 1.0, "none", False, 5),
+    ("half", 0.5, "slashed", False, 6),
+    ("half", 0.5, "exited", False, 7),
+    ("half", 0.5, "mixed_balance", False, 8),
+    ("full", 1.0, "slashed", False, 9),
+    ("empty", 0.0, "none", True, 10),
+    ("half", 0.5, "none", True, 11),
+    ("full", 1.0, "none", True, 12),
+    ("half", 0.5, "mixed_balance", True, 13),
+]
+
+for _name, _fraction, _overlay, _leak, _seed in _CASES:
+    instantiate(_fraction_case, _name, _fraction, _overlay, _leak, _seed)
