@@ -1,0 +1,129 @@
+"""Multi-period light-client sync scenario driver (reference analogue:
+eth2spec/test/helpers/light_client_sync.py — the harness behind
+test/altair/light_client/test_sync.py; spec:
+specs/altair/light-client/sync-protocol.md).
+
+The driver owns a mutable head state and remembers every signed block it
+produced (and the post-state of blocks that may later serve as attested
+headers), so a LightClientUpdate can be assembled for any point of the
+chain: attested block = chain head, signature block = one fresh block
+whose sync aggregate signs the attested root, finalized block = whatever
+the attested state's finalized checkpoint names.
+"""
+
+from __future__ import annotations
+
+from eth_consensus_specs_tpu.ssz import hash_tree_root
+
+from .attestations import next_epoch_with_attestations
+from .block import build_empty_block, state_transition_and_sign_block
+from .state import transition_to
+from .sync_committee import make_sync_aggregate
+
+
+def _full_participation_aggregate(spec, state, attested_root):
+    """A fully-participating SyncAggregate over `state.current_sync_committee`
+    signing `attested_root` for the previous slot (the state must already
+    sit at the signature block's slot)."""
+    return make_sync_aggregate(
+        spec,
+        state,
+        [True] * int(spec.SYNC_COMMITTEE_SIZE),
+        slot=max(int(state.slot), 1) - 1,
+        block_root=attested_root,
+    )
+
+
+class LCSyncDriver:
+    """Drives one chain and builds light-client artifacts from it."""
+
+    def __init__(self, spec, state):
+        self.spec = spec
+        self.state = state  # mutated in place as the chain advances
+        self.blocks = {}  # block root -> signed block
+        self.states = {}  # block root -> post-state copy (attested candidates)
+        self.head_root = None
+        self._produce_block()  # anchor: the store needs a trusted head block
+
+    # -- chain building ----------------------------------------------------
+
+    def _record(self, signed, keep_state=True):
+        root = bytes(hash_tree_root(signed.message))
+        self.blocks[root] = signed
+        if keep_state:
+            self.states[root] = self.state.copy()
+        self.head_root = root
+        return signed
+
+    def _produce_block(self):
+        """One empty block on the head (post-state remembered)."""
+        spec, state = self.spec, self.state
+        block = build_empty_block(spec, state, slot=int(state.slot) + 1)
+        return self._record(state_transition_and_sign_block(spec, state, block))
+
+    def skip_to_epoch_start(self, epoch):
+        """Fast-forward through empty slots (no blocks) to an epoch start."""
+        target = int(self.spec.compute_start_slot_at_epoch(epoch))
+        assert target >= int(self.state.slot)
+        transition_to(self.spec, self.state, target)
+
+    def finalize_epochs(self, n=3):
+        """Run `n` epochs of fully-attested blocks (enough for finality
+        when n >= 3), recording every block so finalized roots resolve."""
+        spec, state = self.spec, self.state
+        if int(state.slot) % int(spec.SLOTS_PER_EPOCH) != 0:
+            self.skip_to_epoch_start(int(spec.get_current_epoch(state)) + 1)
+        for _ in range(n):
+            _, signed_blocks, _ = next_epoch_with_attestations(spec, state, True, True)
+            for b in signed_blocks:
+                root = bytes(hash_tree_root(b.message))
+                self.blocks[root] = b
+            self.head_root = root
+        # the head block's post-state is the epoch-end state
+        self.states[self.head_root] = state.copy()
+
+    # -- light-client artifacts --------------------------------------------
+
+    def bootstrap_store(self):
+        signed = self.blocks[self.head_root]
+        bootstrap = self.spec.create_light_client_bootstrap(self.state, signed)
+        return self.spec.initialize_light_client_store(
+            hash_tree_root(signed.message), bootstrap
+        )
+
+    def emit_update(self, with_finality=True):
+        """Signature block on top of the head; update attesting the head.
+
+        Returns (update, signature_slot_state). The chain advances by one
+        slot (the signature block becomes the new head)."""
+        spec, state = self.spec, self.state
+        attested_root = self.head_root
+        attested_block = self.blocks[attested_root]
+        attested_state = self.states[attested_root]
+
+        sig_block = build_empty_block(spec, state, slot=int(state.slot) + 1)
+        # the committee that signs is the one active AT the signature slot
+        # (process_slots may rotate it at a period boundary)
+        sign_state = state.copy()
+        spec.process_slots(sign_state, sig_block.slot)
+        sig_block.body.sync_aggregate = _full_participation_aggregate(
+            spec, sign_state, attested_root
+        )
+        signed_sig = state_transition_and_sign_block(spec, state, sig_block)
+        self._record(signed_sig)
+
+        finalized_block = None
+        if with_finality:
+            fin_root = bytes(attested_state.finalized_checkpoint.root)
+            if fin_root != b"\x00" * 32:
+                finalized_block = self.blocks.get(fin_root)
+        update = spec.create_light_client_update(
+            state, signed_sig, attested_state, attested_block, finalized_block
+        )
+        return update, state
+
+    def process(self, store, update, current_slot=None):
+        slot = int(self.state.slot) + 1 if current_slot is None else current_slot
+        self.spec.process_light_client_update(
+            store, update, slot, self.state.genesis_validators_root
+        )

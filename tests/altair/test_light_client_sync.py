@@ -1,0 +1,240 @@
+"""Multi-period light-client SYNC scenarios: a store following a live
+chain across sync-committee periods with and without finality (reference
+analogue: eth2spec/test/altair/light_client/test_sync.py driven by
+helpers/light_client_sync.py; spec:
+specs/altair/light-client/sync-protocol.md `process_light_client_update`,
+`process_light_client_store_force_update`).
+
+The period-crossing drives are chain-heavy, so the fork matrix covers the
+two gindex eras (altair = pre-execution header, electra = post-6110
+gindices) rather than every fork; the per-fork header shape itself is
+exercised by tests/altair/test_light_client.py across LC_FORKS.
+"""
+
+import pytest
+
+from eth_consensus_specs_tpu.ssz import hash_tree_root
+from eth_consensus_specs_tpu.test_infra.context import (
+    spec_state_test_with_matching_config,
+    with_phases,
+)
+from eth_consensus_specs_tpu.test_infra.light_client_sync import LCSyncDriver
+
+SYNC_FORKS = ["altair", "electra"]
+
+
+def _store_period(spec, store):
+    return int(
+        spec.compute_sync_committee_period_at_slot(store.finalized_header.beacon.slot)
+    )
+
+
+# == finality advance within one period ====================================
+
+
+@with_phases(SYNC_FORKS)
+@spec_state_test_with_matching_config
+def test_sync_finality_advance(spec, state):
+    """Three attested epochs finalize; a finality update moves the store's
+    finalized header forward and clears best_valid_update."""
+    drv = LCSyncDriver(spec, state)
+    store = drv.bootstrap_store()
+    start_fin_slot = int(store.finalized_header.beacon.slot)
+
+    drv.finalize_epochs(4)
+    update, _ = drv.emit_update()
+    assert spec.is_finality_update(update)
+    drv.process(store, update)
+
+    assert int(store.finalized_header.beacon.slot) > start_fin_slot
+    assert store.best_valid_update is None
+    assert bytes(hash_tree_root(store.finalized_header.beacon)) == bytes(
+        drv.state.finalized_checkpoint.root
+    )
+    # optimistic head follows the attested header
+    assert int(store.optimistic_header.beacon.slot) >= int(
+        store.finalized_header.beacon.slot
+    )
+
+
+@with_phases(SYNC_FORKS)
+@spec_state_test_with_matching_config
+def test_sync_optimistic_only_update_held_as_best_valid(spec, state):
+    """A non-finality update advances only the optimistic head; the update
+    is retained as best_valid_update for a later force update."""
+    drv = LCSyncDriver(spec, state)
+    store = drv.bootstrap_store()
+    fin_before = int(store.finalized_header.beacon.slot)
+
+    drv.finalize_epochs(1)  # produce blocks but no new finality
+    update, _ = drv.emit_update(with_finality=False)
+    assert not spec.is_finality_update(update)
+    drv.process(store, update)
+
+    assert int(store.finalized_header.beacon.slot) == fin_before
+    assert store.best_valid_update is not None
+    assert int(store.optimistic_header.beacon.slot) == int(
+        update.attested_header.beacon.slot
+    )
+
+
+# == period crossing =======================================================
+
+
+@pytest.mark.slow
+@with_phases(SYNC_FORKS)
+@spec_state_test_with_matching_config
+def test_sync_across_sync_committee_period(spec, state):
+    """Drive the chain into the next sync-committee period with finality;
+    the applied update rotates current/next sync committees."""
+    drv = LCSyncDriver(spec, state)
+    store = drv.bootstrap_store()
+
+    # finalize inside period 0 so the store's next committee becomes known
+    drv.finalize_epochs(4)
+    upd0, _ = drv.emit_update()
+    drv.process(store, upd0)
+    assert _store_period(spec, store) == 0
+    assert spec.is_next_sync_committee_known(store)
+    committee_before = store.next_sync_committee.copy()
+
+    # cross into period 1 and finalize there
+    period_epochs = int(spec.EPOCHS_PER_SYNC_COMMITTEE_PERIOD)
+    drv.skip_to_epoch_start(period_epochs)
+    drv.finalize_epochs(4)
+    upd1, _ = drv.emit_update()
+    drv.process(store, upd1)
+
+    assert _store_period(spec, store) == 1
+    # the old next committee became the current one
+    assert bytes(hash_tree_root(store.current_sync_committee)) == bytes(
+        hash_tree_root(committee_before)
+    )
+    assert store.best_valid_update is None
+
+
+@pytest.mark.slow
+@with_phases(SYNC_FORKS)
+@spec_state_test_with_matching_config
+def test_sync_supply_committee_from_past_update(spec, state):
+    """A store bootstrapped WITHOUT next-committee knowledge learns it from
+    an update whose attested and finalized periods match the store's."""
+    drv = LCSyncDriver(spec, state)
+    store = drv.bootstrap_store()
+    # forget the next committee (as after a bootstrap from an old snapshot)
+    store.next_sync_committee = spec.SyncCommittee()
+    assert not spec.is_next_sync_committee_known(store)
+
+    drv.finalize_epochs(4)
+    update, _ = drv.emit_update()
+    assert spec.is_sync_committee_update(update) and spec.is_finality_update(update)
+    drv.process(store, update)
+
+    assert spec.is_next_sync_committee_known(store)
+    assert bytes(hash_tree_root(store.next_sync_committee)) == bytes(
+        hash_tree_root(drv.state.next_sync_committee)
+    )
+
+
+@pytest.mark.slow
+@with_phases(SYNC_FORKS)
+@spec_state_test_with_matching_config
+def test_sync_force_update_after_timeout(spec, state):
+    """With no finality for > UPDATE_TIMEOUT slots, the force-update path
+    promotes best_valid_update using its attested header as finalized."""
+    drv = LCSyncDriver(spec, state)
+    store = drv.bootstrap_store()
+
+    drv.finalize_epochs(1)
+    update, _ = drv.emit_update(with_finality=False)
+    drv.process(store, update)
+    assert store.best_valid_update is not None
+    fin_before = int(store.finalized_header.beacon.slot)
+
+    timeout_slot = (
+        int(store.finalized_header.beacon.slot) + int(spec.UPDATE_TIMEOUT) + 1
+    )
+    spec.process_light_client_store_force_update(store, timeout_slot)
+
+    assert store.best_valid_update is None
+    assert int(store.finalized_header.beacon.slot) > fin_before
+    # the promoted finalized header is the update's attested header
+    assert bytes(hash_tree_root(store.finalized_header.beacon)) == bytes(
+        hash_tree_root(update.attested_header.beacon)
+    )
+
+
+@with_phases(SYNC_FORKS)
+@spec_state_test_with_matching_config
+def test_sync_no_force_update_before_timeout(spec, state):
+    """Before UPDATE_TIMEOUT elapses the force-update path must not fire."""
+    drv = LCSyncDriver(spec, state)
+    store = drv.bootstrap_store()
+
+    drv.finalize_epochs(1)
+    update, _ = drv.emit_update(with_finality=False)
+    drv.process(store, update)
+    fin_before = int(store.finalized_header.beacon.slot)
+
+    not_yet = int(store.finalized_header.beacon.slot) + int(spec.UPDATE_TIMEOUT)
+    spec.process_light_client_store_force_update(store, not_yet)
+
+    assert store.best_valid_update is not None
+    assert int(store.finalized_header.beacon.slot) == fin_before
+
+
+@with_phases(SYNC_FORKS)
+@spec_state_test_with_matching_config
+def test_sync_repeated_updates_keep_best(spec, state):
+    """Feeding the same non-finality update twice neither regresses the
+    optimistic head nor duplicates best_valid_update state."""
+    drv = LCSyncDriver(spec, state)
+    store = drv.bootstrap_store()
+
+    drv.finalize_epochs(1)
+    update, _ = drv.emit_update(with_finality=False)
+    drv.process(store, update)
+    opt_slot = int(store.optimistic_header.beacon.slot)
+    best = store.best_valid_update.copy()
+
+    drv.process(store, update)  # replay
+    assert int(store.optimistic_header.beacon.slot) == opt_slot
+    assert bytes(hash_tree_root(store.best_valid_update)) == bytes(
+        hash_tree_root(best)
+    )
+
+
+@with_phases(SYNC_FORKS)
+@spec_state_test_with_matching_config
+def test_sync_finality_then_optimistic_ahead(spec, state):
+    """After a finality update, later optimistic updates keep moving the
+    optimistic head past the finalized one."""
+    drv = LCSyncDriver(spec, state)
+    store = drv.bootstrap_store()
+
+    drv.finalize_epochs(4)
+    upd_fin, _ = drv.emit_update()
+    drv.process(store, upd_fin)
+    fin_slot = int(store.finalized_header.beacon.slot)
+
+    upd_opt, _ = drv.emit_update(with_finality=False)
+    drv.process(store, upd_opt)
+    assert int(store.finalized_header.beacon.slot) == fin_slot
+    assert int(store.optimistic_header.beacon.slot) > fin_slot
+
+
+@with_phases(SYNC_FORKS)
+@spec_state_test_with_matching_config
+def test_sync_participation_tracks_safety_threshold(spec, state):
+    """current_max_active_participants follows the strongest seen update;
+    the safety threshold is half the max of the two windows."""
+    drv = LCSyncDriver(spec, state)
+    store = drv.bootstrap_store()
+
+    drv.finalize_epochs(1)
+    update, _ = drv.emit_update(with_finality=False)
+    drv.process(store, update)
+
+    size = int(spec.SYNC_COMMITTEE_SIZE)
+    assert int(store.current_max_active_participants) == size
+    assert int(spec.get_safety_threshold(store)) == size // 2
