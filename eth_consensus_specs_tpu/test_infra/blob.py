@@ -1,0 +1,20 @@
+"""Blob construction helpers shared by KZG/DAS suites (reference
+analogue: test/helpers/blob.py get_sample_blob)."""
+
+import hashlib
+
+from eth_consensus_specs_tpu.crypto import kzg
+
+
+def sample_blob(tag: bytes) -> bytes:
+    """Deterministic pseudo-random blob: one canonical field element per
+    position, seeded by `tag`."""
+    out = []
+    for i in range(kzg.FIELD_ELEMENTS_PER_BLOB):
+        h = hashlib.sha256(tag + i.to_bytes(4, "big")).digest()
+        out.append((int.from_bytes(h, "big") % kzg.BLS_MODULUS).to_bytes(32, "big"))
+    return b"".join(out)
+
+
+def constant_blob(value: int) -> bytes:
+    return value.to_bytes(32, "big") * kzg.FIELD_ELEMENTS_PER_BLOB

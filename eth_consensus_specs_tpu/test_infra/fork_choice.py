@@ -5,6 +5,8 @@ tests/formats/fork_choice/README.md:28-80)."""
 
 from __future__ import annotations
 
+from contextlib import contextmanager
+
 from eth_consensus_specs_tpu.ssz import hash_tree_root
 
 from .context import expect_assertion_error
@@ -90,3 +92,32 @@ def apply_next_epoch_with_attestations(spec, store, state):
     # realize unrealized checkpoints at the epoch boundary tick
     tick_to_slot(spec, store, int(post_state.slot))
     return post_state, last_root
+
+
+@contextmanager
+def with_blob_data(spec, blobs, proofs):
+    """Serve `blobs`/`proofs` from the spec's retrieval stub while active
+    (reference: helpers/fork_choice.py with_blob_data monkeypatching —
+    fork-choice tests model data availability by substituting
+    retrieve_blobs_and_proofs)."""
+    orig = spec.retrieve_blobs_and_proofs
+    spec.retrieve_blobs_and_proofs = lambda beacon_block_root: (blobs, proofs)
+    try:
+        yield
+    finally:
+        spec.retrieve_blobs_and_proofs = orig
+
+
+@contextmanager
+def with_blob_data_unavailable(spec):
+    """Make every blob retrieval fail, modelling unavailable sidecars."""
+
+    def _unavailable(beacon_block_root):
+        raise AssertionError("blob data unavailable")
+
+    orig = spec.retrieve_blobs_and_proofs
+    spec.retrieve_blobs_and_proofs = _unavailable
+    try:
+        yield
+    finally:
+        spec.retrieve_blobs_and_proofs = orig

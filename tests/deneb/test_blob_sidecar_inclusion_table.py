@@ -1,0 +1,101 @@
+"""Blob-sidecar inclusion-proof corruption table (reference analogue:
+eth2spec/test/deneb/unittests/validator/test_validator.py
+`test_blob_sidecar_inclusion_proof_{correct,incorrect_*}`; spec:
+specs/deneb/p2p-interface.md verify_blob_sidecar_inclusion_proof)."""
+
+from eth_consensus_specs_tpu.crypto import curve
+from eth_consensus_specs_tpu.ssz import hash_tree_root
+from eth_consensus_specs_tpu.ssz.merkle import get_merkle_proof
+from eth_consensus_specs_tpu.test_infra.block import build_empty_block_for_next_slot
+from eth_consensus_specs_tpu.test_infra.context import spec_state_test, with_phases
+
+BLOB_FORKS = ["deneb", "electra", "fulu"]  # gloas moves commitments into the ePBS envelope
+
+COMMITMENT = curve.g1_to_bytes(curve.g1_generator())
+
+
+def _make_sidecar(spec, state, n_commitments=3, index=1):
+    """Build a sidecar for commitment `index` of a block carrying
+    `n_commitments`, with a correct inclusion proof."""
+    block = build_empty_block_for_next_slot(spec, state)
+    for _ in range(n_commitments):
+        block.body.blob_kzg_commitments.append(COMMITMENT)
+    body = block.body
+
+    commitment_roots = [bytes(hash_tree_root(c)) for c in body.blob_kzg_commitments]
+    list_branch = get_merkle_proof(
+        commitment_roots, index, limit=spec.MAX_BLOB_COMMITMENTS_PER_BLOCK
+    )
+    length_chunk = len(body.blob_kzg_commitments).to_bytes(32, "little")
+    field_roots = [bytes(hash_tree_root(getattr(body, n))) for n in body.fields()]
+    field_index = list(body.fields()).index("blob_kzg_commitments")
+    body_branch = get_merkle_proof(field_roots, field_index, limit=16)
+    proof = list_branch + [length_chunk] + body_branch
+    assert len(proof) == spec.KZG_COMMITMENT_INCLUSION_PROOF_DEPTH
+
+    header = spec.BeaconBlockHeader(
+        slot=block.slot,
+        proposer_index=block.proposer_index,
+        parent_root=block.parent_root,
+        state_root=block.state_root,
+        body_root=hash_tree_root(body),
+    )
+    sidecar = spec.BlobSidecar(
+        index=index,
+        kzg_commitment=COMMITMENT,
+        signed_block_header=spec.SignedBeaconBlockHeader(message=header),
+        kzg_commitment_inclusion_proof=proof,
+    )
+    return sidecar
+
+
+@with_phases(BLOB_FORKS)
+@spec_state_test
+def test_inclusion_proof_correct(spec, state):
+    sidecar = _make_sidecar(spec, state)
+    assert spec.verify_blob_sidecar_inclusion_proof(sidecar)
+
+
+@with_phases(BLOB_FORKS)
+@spec_state_test
+def test_inclusion_proof_correct_first_and_last(spec, state):
+    n = 4
+    for index in (0, n - 1):
+        sidecar = _make_sidecar(spec, state.copy(), n_commitments=n, index=index)
+        assert spec.verify_blob_sidecar_inclusion_proof(sidecar)
+
+
+@with_phases(BLOB_FORKS)
+@spec_state_test
+def test_inclusion_proof_incorrect_wrong_body(spec, state):
+    """A different body root (e.g. the block was re-packed) invalidates
+    the proof."""
+    sidecar = _make_sidecar(spec, state)
+    sidecar.signed_block_header.message.body_root = b"\x42" * 32
+    assert not spec.verify_blob_sidecar_inclusion_proof(sidecar)
+
+
+@with_phases(BLOB_FORKS)
+@spec_state_test
+def test_inclusion_proof_incorrect_proof_node(spec, state):
+    sidecar = _make_sidecar(spec, state)
+    sidecar.kzg_commitment_inclusion_proof[2] = b"\x99" * 32
+    assert not spec.verify_blob_sidecar_inclusion_proof(sidecar)
+
+
+@with_phases(BLOB_FORKS)
+@spec_state_test
+def test_inclusion_proof_incorrect_index(spec, state):
+    """The proof is position-bound: the same branch with a different
+    sidecar index fails."""
+    sidecar = _make_sidecar(spec, state)
+    sidecar.index = 2
+    assert not spec.verify_blob_sidecar_inclusion_proof(sidecar)
+
+
+@with_phases(BLOB_FORKS)
+@spec_state_test
+def test_inclusion_proof_incorrect_commitment(spec, state):
+    sidecar = _make_sidecar(spec, state)
+    sidecar.kzg_commitment = curve.g1_to_bytes(curve.g1_generator().double())
+    assert not spec.verify_blob_sidecar_inclusion_proof(sidecar)

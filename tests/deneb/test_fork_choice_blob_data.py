@@ -1,0 +1,125 @@
+"""Deneb fork-choice data-availability gating: `on_block` must refuse a
+block whose blob data cannot be retrieved and verified (reference
+analogue: eth2spec/test/deneb/fork_choice/test_on_block.py; spec:
+specs/deneb/fork-choice.md is_data_available + on_block)."""
+
+
+import pytest
+
+from eth_consensus_specs_tpu.crypto import curve, kzg
+from eth_consensus_specs_tpu.test_infra.blob import sample_blob
+from eth_consensus_specs_tpu.test_infra.block import (
+    build_empty_block_for_next_slot,
+    state_transition_and_sign_block,
+)
+from eth_consensus_specs_tpu.test_infra.context import (
+    spec_state_test,
+    with_phases,
+)
+from eth_consensus_specs_tpu.test_infra.fork_choice import (
+    get_genesis_forkchoice_store,
+    tick_and_add_block,
+    with_blob_data,
+    with_blob_data_unavailable,
+)
+
+# fulu replaces blob retrieval with column sampling — covered in
+# tests/fulu/test_data_column_sidecars.py
+BLOB_FORKS = ["deneb", "electra"]
+
+
+def _block_with_commitments(spec, state, commitments):
+    block = build_empty_block_for_next_slot(spec, state)
+    for c in commitments:
+        block.body.blob_kzg_commitments.append(c)
+    return state_transition_and_sign_block(spec, state, block)
+
+
+
+@with_phases(BLOB_FORKS)
+@spec_state_test
+def test_on_block_no_blobs(spec, state):
+    """A block without commitments needs no retrieval at all."""
+    store, _ = get_genesis_forkchoice_store(spec, state)
+    signed = _block_with_commitments(spec, state, [])
+    with with_blob_data(spec, [], []):
+        assert tick_and_add_block(spec, store, signed) is not None
+
+
+@with_phases(BLOB_FORKS)
+@spec_state_test
+def test_on_block_data_unavailable(spec, state):
+    """Commitments present but sidecars unavailable: the block is refused."""
+    store, _ = get_genesis_forkchoice_store(spec, state)
+    commitment = curve.g1_to_bytes(curve.g1_generator())
+    signed = _block_with_commitments(spec, state, [commitment])
+    with with_blob_data_unavailable(spec):
+        tick_and_add_block(spec, store, signed, valid=False)
+
+
+@with_phases(BLOB_FORKS)
+@spec_state_test
+def test_on_block_wrong_proofs_length(spec, state):
+    """Retrieved proof count must match the blob count."""
+    store, _ = get_genesis_forkchoice_store(spec, state)
+    commitment = curve.g1_to_bytes(curve.g1_generator())
+    signed = _block_with_commitments(spec, state, [commitment])
+    blob = b"\x00" * (32 * kzg.FIELD_ELEMENTS_PER_BLOB)
+    with with_blob_data(spec, [blob], []):
+        tick_and_add_block(spec, store, signed, valid=False)
+
+
+@with_phases(BLOB_FORKS)
+@spec_state_test
+def test_on_block_wrong_blobs_length(spec, state):
+    """Retrieved blob count must match the commitment count."""
+    store, _ = get_genesis_forkchoice_store(spec, state)
+    commitment = curve.g1_to_bytes(curve.g1_generator())
+    signed = _block_with_commitments(spec, state, [commitment])
+    proof = curve.g1_to_bytes(curve.g1_infinity())
+    with with_blob_data(spec, [], [proof]):
+        tick_and_add_block(spec, store, signed, valid=False)
+
+
+@pytest.mark.slow
+@with_phases(BLOB_FORKS)
+@spec_state_test
+def test_on_block_simple_blob_data(spec, state):
+    """One real blob with a correct proof passes the availability gate."""
+    store, _ = get_genesis_forkchoice_store(spec, state)
+    blob = sample_blob(b"fc")
+    commitment = kzg.blob_to_kzg_commitment(blob)
+    proof = kzg.compute_blob_kzg_proof(blob, commitment)
+    signed = _block_with_commitments(spec, state, [commitment])
+    with with_blob_data(spec, [blob], [proof]):
+        assert tick_and_add_block(spec, store, signed) is not None
+
+
+@pytest.mark.slow
+@with_phases(BLOB_FORKS)
+@spec_state_test
+def test_on_block_incorrect_proof(spec, state):
+    """A proof for the wrong quotient (infinity) fails verification and
+    the block is refused."""
+    store, _ = get_genesis_forkchoice_store(spec, state)
+    blob = sample_blob(b"fc")
+    commitment = kzg.blob_to_kzg_commitment(blob)
+    bad_proof = curve.g1_to_bytes(curve.g1_infinity())
+    signed = _block_with_commitments(spec, state, [commitment])
+    with with_blob_data(spec, [blob], [bad_proof]):
+        tick_and_add_block(spec, store, signed, valid=False)
+
+
+@pytest.mark.slow
+@with_phases(BLOB_FORKS)
+@spec_state_test
+def test_on_block_zero_poly_blob(spec, state):
+    """The all-zero blob (infinity commitment + infinity proof) is valid
+    blob data end-to-end through the store."""
+    store, _ = get_genesis_forkchoice_store(spec, state)
+    blob = b"\x00" * (32 * kzg.FIELD_ELEMENTS_PER_BLOB)
+    commitment = kzg.blob_to_kzg_commitment(blob)
+    proof = kzg.compute_blob_kzg_proof(blob, commitment)
+    signed = _block_with_commitments(spec, state, [commitment])
+    with with_blob_data(spec, [blob], [proof]):
+        assert tick_and_add_block(spec, store, signed) is not None

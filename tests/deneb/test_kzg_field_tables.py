@@ -1,0 +1,151 @@
+"""KZG scalar/point plumbing edge tables — the cheap (non-MSM) half of
+the reference's deneb KZG edge cases (reference analogue:
+eth2spec/test/deneb/unittests/polynomial_commitments/
+test_polynomial_commitments.py `test_validate_kzg_g1_*`,
+`test_bytes_to_bls_field_*`, and deneb/kzg/test_compute_challenge.py;
+spec: specs/deneb/polynomial-commitments.md bytes_to_bls_field,
+validate_kzg_g1, compute_challenge)."""
+
+import pytest
+
+from eth_consensus_specs_tpu.crypto import curve, kzg
+
+
+# == bytes_to_bls_field boundary table =====================================
+
+
+def test_bytes_to_bls_field_zero():
+    assert kzg.bytes_to_bls_field(b"\x00" * 32) == 0
+
+
+def test_bytes_to_bls_field_modulus_minus_one():
+    b = (kzg.BLS_MODULUS - 1).to_bytes(32, "big")
+    assert kzg.bytes_to_bls_field(b) == kzg.BLS_MODULUS - 1
+
+
+def test_bytes_to_bls_field_modulus_rejected():
+    b = kzg.BLS_MODULUS.to_bytes(32, "big")
+    with pytest.raises(AssertionError):
+        kzg.bytes_to_bls_field(b)
+
+
+def test_bytes_to_bls_field_max_rejected():
+    with pytest.raises(AssertionError):
+        kzg.bytes_to_bls_field(b"\xff" * 32)
+
+
+def test_hash_to_bls_field_always_canonical():
+    for seed in range(8):
+        x = kzg.hash_to_bls_field(bytes([seed]) * 17)
+        assert 0 <= x < kzg.BLS_MODULUS
+
+
+# == validate_kzg_g1 table =================================================
+
+
+def test_validate_kzg_g1_generator():
+    kzg.validate_kzg_g1(curve.g1_to_bytes(curve.g1_generator()))
+
+
+def test_validate_kzg_g1_neutral_element():
+    kzg.validate_kzg_g1(curve.g1_to_bytes(curve.g1_infinity()))
+
+
+def test_validate_kzg_g1_not_on_curve():
+    # x with no matching y: flip bits of a valid encoding until decompression
+    # fails structurally (compressed flag kept, x mutated)
+    good = bytearray(curve.g1_to_bytes(curve.g1_generator()))
+    good[-1] ^= 0x01
+    with pytest.raises(AssertionError):
+        kzg.validate_kzg_g1(bytes(good))
+
+
+def test_validate_kzg_g1_not_in_subgroup():
+    # find an on-curve point OUTSIDE the r-order subgroup by scanning x
+    from eth_consensus_specs_tpu.crypto.fields import Fq
+    from eth_consensus_specs_tpu.crypto.fields import P as FP_P
+
+    x = 2
+    pt = None
+    while pt is None:
+        rhs = (pow(x, 3, FP_P) + 4) % FP_P
+        y = pow(rhs, (FP_P + 1) // 4, FP_P)
+        if (y * y) % FP_P == rhs:
+            cand = curve.Point(Fq(x), Fq(y), Fq(4))
+            if not curve.in_subgroup(cand):
+                pt = cand
+        x += 1
+    with pytest.raises(AssertionError):
+        kzg.validate_kzg_g1(curve.g1_to_bytes(pt))
+
+
+def test_validate_kzg_g1_bad_length():
+    with pytest.raises(AssertionError):
+        kzg.validate_kzg_g1(b"\xc0" + b"\x00" * 46)  # 47 bytes
+
+
+# == compute_challenge =====================================================
+
+
+def _tiny_blob(fill: int) -> bytes:
+    return (fill.to_bytes(32, "big")) * kzg.FIELD_ELEMENTS_PER_BLOB
+
+
+def test_compute_challenge_deterministic():
+    blob = _tiny_blob(3)
+    commitment = curve.g1_to_bytes(curve.g1_generator())
+    assert kzg.compute_challenge(blob, commitment) == kzg.compute_challenge(
+        blob, commitment
+    )
+
+
+def test_compute_challenge_mismatched_commitment():
+    """The Fiat-Shamir challenge binds the commitment: a different
+    commitment over the same blob must give a different challenge."""
+    blob = _tiny_blob(3)
+    c1 = curve.g1_to_bytes(curve.g1_generator())
+    c2 = curve.g1_to_bytes(curve.g1_generator().double())
+    assert kzg.compute_challenge(blob, c1) != kzg.compute_challenge(blob, c2)
+
+
+def test_compute_challenge_commitment_at_infinity():
+    """An infinity commitment is still hashable — the challenge is a
+    canonical field element (reference kzg
+    test_compute_challenge_case_commitment_at_infinity)."""
+    blob = _tiny_blob(0)
+    inf = curve.g1_to_bytes(curve.g1_infinity())
+    x = kzg.compute_challenge(blob, inf)
+    assert 0 <= x < kzg.BLS_MODULUS
+
+
+def test_compute_challenge_binds_blob():
+    commitment = curve.g1_to_bytes(curve.g1_generator())
+    assert kzg.compute_challenge(_tiny_blob(1), commitment) != kzg.compute_challenge(
+        _tiny_blob(2), commitment
+    )
+
+
+# == polynomial/domain plumbing ============================================
+
+
+def test_blob_to_polynomial_length():
+    poly = kzg.blob_to_polynomial(_tiny_blob(5))
+    assert len(poly) == kzg.FIELD_ELEMENTS_PER_BLOB
+    assert all(v == 5 for v in poly)
+
+
+def test_compute_powers_matches_pow():
+    xs = kzg.compute_powers(7, 6)
+    assert xs == [pow(7, i, kzg.BLS_MODULUS) for i in range(6)]
+
+
+def test_roots_of_unity_order_divides():
+    roots = kzg.compute_roots_of_unity(kzg.FIELD_ELEMENTS_PER_BLOB)
+    w = roots[1]
+    assert pow(w, kzg.FIELD_ELEMENTS_PER_BLOB, kzg.BLS_MODULUS) == 1
+    assert pow(w, kzg.FIELD_ELEMENTS_PER_BLOB // 2, kzg.BLS_MODULUS) != 1
+
+
+def test_bit_reversal_permutation_rejects_non_power_of_two():
+    with pytest.raises(AssertionError):
+        kzg.bit_reversal_permutation(list(range(3)))

@@ -1,0 +1,118 @@
+"""KZG blob-proof EDGE cases needing real MSM work (reference analogue:
+eth2spec/test/deneb/kzg/test_verify_blob_kzg_proof.py infinity cases and
+test_verify_blob_kzg_proof_batch.py length/corruption tables; spec:
+specs/deneb/polynomial-commitments.md verify_blob_kzg_proof[_batch])."""
+
+
+import pytest
+
+from eth_consensus_specs_tpu.crypto import curve, kzg
+from eth_consensus_specs_tpu.test_infra.blob import constant_blob, sample_blob
+
+# pure-python MSM per commit/prove — nightly lane
+pytestmark = pytest.mark.slow
+
+INFINITY = curve.g1_to_bytes(curve.g1_infinity())
+
+
+
+
+@pytest.fixture(scope="module")
+def random_case():
+    blob = sample_blob(b"edge")
+    commitment = kzg.blob_to_kzg_commitment(blob)
+    proof = kzg.compute_blob_kzg_proof(blob, commitment)
+    return blob, commitment, proof
+
+
+# == point-at-infinity proof cases =========================================
+
+
+def test_incorrect_proof_point_at_infinity(random_case):
+    """A non-constant polynomial can never have the zero quotient — an
+    infinity proof must be rejected."""
+    blob, commitment, _ = random_case
+    assert not kzg.verify_blob_kzg_proof(blob, commitment, INFINITY)
+
+
+def test_correct_proof_point_at_infinity_for_zero_poly():
+    """The zero polynomial commits to infinity and proves with infinity."""
+    blob = constant_blob(0)
+    commitment = kzg.blob_to_kzg_commitment(blob)
+    assert commitment == INFINITY
+    proof = kzg.compute_blob_kzg_proof(blob, commitment)
+    assert proof == INFINITY
+    assert kzg.verify_blob_kzg_proof(blob, commitment, proof)
+
+
+def test_correct_proof_point_at_infinity_for_twos_poly():
+    """Any CONSTANT polynomial has zero quotient: proof = infinity but a
+    non-infinity commitment."""
+    blob = constant_blob(2)
+    commitment = kzg.blob_to_kzg_commitment(blob)
+    assert commitment != INFINITY
+    proof = kzg.compute_blob_kzg_proof(blob, commitment)
+    assert proof == INFINITY
+    assert kzg.verify_blob_kzg_proof(blob, commitment, proof)
+
+
+# == batch verification table ==============================================
+
+
+def test_batch_incorrect_proof_add_one(random_case):
+    blob, commitment, proof = random_case
+    bumped = curve.g1_to_bytes(
+        curve.g1_from_bytes(proof) + curve.g1_generator()
+    )
+    assert not kzg.verify_blob_kzg_proof_batch([blob], [commitment], [bumped])
+
+
+def test_batch_incorrect_proof_point_at_infinity(random_case):
+    blob, commitment, _ = random_case
+    assert not kzg.verify_blob_kzg_proof_batch([blob], [commitment], [INFINITY])
+
+
+def test_batch_blob_length_different(random_case):
+    blob, commitment, proof = random_case
+    with pytest.raises(AssertionError):
+        kzg.verify_blob_kzg_proof_batch([blob, blob], [commitment], [proof])
+
+
+def test_batch_commitment_length_different(random_case):
+    blob, commitment, proof = random_case
+    with pytest.raises(AssertionError):
+        kzg.verify_blob_kzg_proof_batch([blob], [commitment, commitment], [proof])
+
+
+def test_batch_proof_length_different(random_case):
+    blob, commitment, proof = random_case
+    with pytest.raises(AssertionError):
+        kzg.verify_blob_kzg_proof_batch([blob], [commitment], [proof, proof])
+
+
+def test_batch_mixed_constant_and_random(random_case):
+    """A batch combining the infinity-proof constant case with a normal
+    case must still verify — the RLC covers both."""
+    blob, commitment, proof = random_case
+    cblob = constant_blob(2)
+    ccommit = kzg.blob_to_kzg_commitment(cblob)
+    cproof = kzg.compute_blob_kzg_proof(cblob, ccommit)
+    assert kzg.verify_blob_kzg_proof_batch(
+        [blob, cblob], [commitment, ccommit], [proof, cproof]
+    )
+
+
+def test_batch_one_bad_poisons_all(random_case):
+    """One wrong proof (infinity for a non-constant poly) fails the whole
+    batch even when the other member is fully valid."""
+    blob, commitment, proof = random_case
+    cblob = constant_blob(2)
+    ccommit = kzg.blob_to_kzg_commitment(cblob)
+    cproof = kzg.compute_blob_kzg_proof(cblob, ccommit)
+    assert not kzg.verify_blob_kzg_proof_batch(
+        [blob, cblob], [commitment, ccommit], [INFINITY, cproof]
+    )
+
+
+def test_batch_empty_is_vacuously_true():
+    assert kzg.verify_blob_kzg_proof_batch([], [], [])
