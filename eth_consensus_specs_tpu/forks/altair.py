@@ -226,6 +226,14 @@ class AltairSpec(LightClientMixin, Phase0Spec):
 
     # == misc helpers ======================================================
 
+    # -- validator timing (specs/altair/fork-choice.md:21-32) --------------
+
+    def get_sync_message_due_ms(self, epoch: int) -> int:
+        return self.get_slot_component_duration_ms(self.config.SYNC_MESSAGE_DUE_BPS)
+
+    def get_contribution_due_ms(self, epoch: int) -> int:
+        return self.get_slot_component_duration_ms(self.config.CONTRIBUTION_DUE_BPS)
+
     @staticmethod
     def add_flag(flags: int, flag_index: int) -> int:
         return int(flags) | (1 << flag_index)

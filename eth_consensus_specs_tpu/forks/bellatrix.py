@@ -304,6 +304,64 @@ class BellatrixSpec(AltairSpec):
 
     # == genesis (reference: bellatrix beacon-chain.md Testing section) ====
 
+    # == proposer re-org fcU suppression (specs/bellatrix/fork-choice.md:98-175)
+
+    def validator_is_connected(self, validator_index: int) -> bool:
+        """Whether the local node manages `validator_index` (reference
+        injects a constant-True stub into the generated spec; tests may
+        monkeypatch)."""
+        return True
+
+    def should_override_forkchoice_update(self, store, head_root) -> bool:
+        """Suppress notify_forkchoice_updated when the next proposal we
+        control is expected to re-org a late, weak head
+        (specs/bellatrix/fork-choice.md:117-175)."""
+        head_block = store.blocks[head_root]
+        parent_root = head_block.parent_root
+        parent_block = store.blocks[parent_root]
+        current_slot = self.get_current_slot(store)
+        proposal_slot = int(head_block.slot) + 1
+
+        head_late = self.is_head_late(store, head_root)
+        shuffling_stable = self.is_shuffling_stable(proposal_slot)
+        ffg_competitive = self.is_ffg_competitive(store, head_root, parent_root)
+        finalization_ok = self.is_finalization_ok(store, proposal_slot)
+
+        # only suppress when we expect to propose the next slot ourselves
+        parent_state_advanced = store.block_states[parent_root].copy()
+        self.process_slots(parent_state_advanced, proposal_slot)
+        proposer_index = self.get_beacon_proposer_index(parent_state_advanced)
+        proposing_reorg_slot = self.validator_is_connected(proposer_index)
+
+        parent_slot_ok = int(parent_block.slot) + 1 == int(head_block.slot)
+        proposing_on_time = self.is_proposing_on_time(store)
+        # unlike get_proposer_head, the head's own slot also qualifies
+        current_time_ok = int(head_block.slot) == current_slot or (
+            proposal_slot == current_slot and proposing_on_time
+        )
+        single_slot_reorg = parent_slot_ok and current_time_ok
+
+        # weigh the head only once its slot's attestations are in the store
+        if current_slot > int(head_block.slot):
+            head_weak = self.is_head_weak(store, head_root)
+            parent_strong = self.is_parent_strong(store, parent_root)
+        else:
+            head_weak = True
+            parent_strong = True
+
+        return all(
+            [
+                head_late,
+                shuffling_stable,
+                ffg_competitive,
+                finalization_ok,
+                proposing_reorg_slot,
+                single_slot_reorg,
+                head_weak,
+                parent_strong,
+            ]
+        )
+
     def initialize_beacon_state_from_eth1(
         self, eth1_block_hash, eth1_timestamp, deposits, execution_payload_header=None
     ):

@@ -227,6 +227,44 @@ class GloasSpec(FuluSpec):
                 typ.__name__ = name
                 setattr(self, name, typ)
 
+    # == slot-component timing (specs/gloas/fork-choice.md:437-485) ========
+
+    def _fork_due_ms(self, epoch: int, pre_bps: int, post_bps: int) -> int:
+        """Epoch-gated slot component: gloas tightens every deadline."""
+        bps = post_bps if int(epoch) >= self.config.GLOAS_FORK_EPOCH else pre_bps
+        return self.get_slot_component_duration_ms(bps)
+
+    def get_attestation_due_ms(self, epoch: int) -> int:
+        return self._fork_due_ms(
+            epoch,
+            self.config.ATTESTATION_DUE_BPS,
+            self.config.ATTESTATION_DUE_BPS_GLOAS,
+        )
+
+    def get_aggregate_due_ms(self, epoch: int) -> int:
+        return self._fork_due_ms(
+            epoch, self.config.AGGREGATE_DUE_BPS, self.config.AGGREGATE_DUE_BPS_GLOAS
+        )
+
+    def get_sync_message_due_ms(self, epoch: int) -> int:
+        return self._fork_due_ms(
+            epoch,
+            self.config.SYNC_MESSAGE_DUE_BPS,
+            self.config.SYNC_MESSAGE_DUE_BPS_GLOAS,
+        )
+
+    def get_contribution_due_ms(self, epoch: int) -> int:
+        return self._fork_due_ms(
+            epoch,
+            self.config.CONTRIBUTION_DUE_BPS,
+            self.config.CONTRIBUTION_DUE_BPS_GLOAS,
+        )
+
+    def get_payload_attestation_due_ms(self, epoch: int) -> int:
+        return self.get_slot_component_duration_ms(
+            self.config.PAYLOAD_ATTESTATION_DUE_BPS
+        )
+
     # == predicates (:323-408) =============================================
 
     def is_builder_withdrawal_credential(self, withdrawal_credentials) -> bool:

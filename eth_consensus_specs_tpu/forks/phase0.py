@@ -1584,7 +1584,6 @@ class Phase0Spec:
         latest_messages: dict = field(default_factory=dict)
         unrealized_justifications: dict = field(default_factory=dict)
 
-    INTERVALS_PER_SLOT = 3
     PROPOSER_SCORE_BOOST = 40
 
     def get_forkchoice_store(self, anchor_state, anchor_block):
@@ -1770,9 +1769,124 @@ class Phase0Spec:
                     store.unrealized_finalized_checkpoint,
                 )
 
+    # -- millisecond slot components (specs/phase0/fork-choice.md:457-492) --
+
+    BASIS_POINTS = 10_000
+    UINT64_MAX = 2**64 - 1
+
+    def seconds_to_milliseconds(self, seconds: int) -> int:
+        """Overflow-safe s→ms (specs/phase0/fork-choice.md:457-466)."""
+        if int(seconds) > self.UINT64_MAX // 1000:
+            return self.UINT64_MAX
+        return int(seconds) * 1000
+
+    def get_slot_component_duration_ms(self, basis_points: int) -> int:
+        return int(basis_points) * self.config.SLOT_DURATION_MS // self.BASIS_POINTS
+
+    def get_attestation_due_ms(self, epoch: int) -> int:
+        return self.get_slot_component_duration_ms(self.config.ATTESTATION_DUE_BPS)
+
+    def get_proposer_reorg_cutoff_ms(self, epoch: int) -> int:
+        return self.get_slot_component_duration_ms(self.config.PROPOSER_REORG_CUTOFF_BPS)
+
+    def get_aggregate_due_ms(self, epoch: int) -> int:
+        return self.get_slot_component_duration_ms(self.config.AGGREGATE_DUE_BPS)
+
+    def _time_into_slot_ms(self, store) -> int:
+        seconds_since_genesis = int(store.time) - int(store.genesis_time)
+        return (
+            self.seconds_to_milliseconds(seconds_since_genesis)
+            % self.config.SLOT_DURATION_MS
+        )
+
     def is_before_attesting_interval(self, store) -> bool:
-        time_into_slot = (store.time - store.genesis_time) % self.config.SECONDS_PER_SLOT
-        return time_into_slot < self.config.SECONDS_PER_SLOT // self.INTERVALS_PER_SLOT
+        epoch = self.get_current_store_epoch(store)
+        return self._time_into_slot_ms(store) < self.get_attestation_due_ms(epoch)
+
+    # -- proposer head / re-org helpers (specs/phase0/fork-choice.md:500-612,
+    # optional for clients, normative shape) --------------------------------
+
+    def calculate_committee_fraction(self, state, committee_percent: int) -> int:
+        committee_weight = self.get_total_active_balance(state) // self.SLOTS_PER_EPOCH
+        return (committee_weight * int(committee_percent)) // 100
+
+    def is_head_late(self, store, head_root) -> bool:
+        return not store.block_timeliness[head_root]
+
+    def is_shuffling_stable(self, slot: int) -> bool:
+        return int(slot) % self.SLOTS_PER_EPOCH != 0
+
+    def is_ffg_competitive(self, store, head_root, parent_root) -> bool:
+        return (
+            store.unrealized_justifications[head_root]
+            == store.unrealized_justifications[parent_root]
+        )
+
+    def is_finalization_ok(self, store, slot: int) -> bool:
+        epochs_since_finalization = (
+            self.compute_epoch_at_slot(slot) - store.finalized_checkpoint.epoch
+        )
+        return (
+            epochs_since_finalization
+            <= self.config.REORG_MAX_EPOCHS_SINCE_FINALIZATION
+        )
+
+    def is_proposing_on_time(self, store) -> bool:
+        epoch = self.get_current_store_epoch(store)
+        return self._time_into_slot_ms(store) <= self.get_proposer_reorg_cutoff_ms(epoch)
+
+    def is_head_weak(self, store, head_root) -> bool:
+        justified_state = store.checkpoint_states[store.justified_checkpoint]
+        reorg_threshold = self.calculate_committee_fraction(
+            justified_state, self.config.REORG_HEAD_WEIGHT_THRESHOLD
+        )
+        return self.get_weight(store, head_root) < reorg_threshold
+
+    def is_parent_strong(self, store, parent_root) -> bool:
+        justified_state = store.checkpoint_states[store.justified_checkpoint]
+        parent_threshold = self.calculate_committee_fraction(
+            justified_state, self.config.REORG_PARENT_WEIGHT_THRESHOLD
+        )
+        return self.get_weight(store, parent_root) > parent_threshold
+
+    def get_proposer_head(self, store, head_root, slot: int):
+        """The root a proposer should build on: the head's parent when the
+        head arrived late and is weak enough for a single-slot re-org
+        (specs/phase0/fork-choice.md:565-612)."""
+        head_block = store.blocks[head_root]
+        parent_root = head_block.parent_root
+        parent_block = store.blocks[parent_root]
+
+        head_late = self.is_head_late(store, head_root)
+        shuffling_stable = self.is_shuffling_stable(slot)
+        ffg_competitive = self.is_ffg_competitive(store, head_root, parent_root)
+        finalization_ok = self.is_finalization_ok(store, slot)
+        proposing_on_time = self.is_proposing_on_time(store)
+
+        # single-slot re-org only
+        parent_slot_ok = int(parent_block.slot) + 1 == int(head_block.slot)
+        current_time_ok = int(head_block.slot) + 1 == int(slot)
+        single_slot_reorg = parent_slot_ok and current_time_ok
+
+        # proposer boost must have worn off before weighing the head
+        assert store.proposer_boost_root != head_root
+        head_weak = self.is_head_weak(store, head_root)
+        parent_strong = self.is_parent_strong(store, parent_root)
+
+        if all(
+            [
+                head_late,
+                shuffling_stable,
+                ffg_competitive,
+                finalization_ok,
+                proposing_on_time,
+                single_slot_reorg,
+                head_weak,
+                parent_strong,
+            ]
+        ):
+            return parent_root
+        return head_root
 
     def on_block(self, store, signed_block) -> None:
         block = signed_block.message
@@ -1798,12 +1912,11 @@ class Phase0Spec:
         store.blocks[block_root] = block.copy()
         store.block_states[block_root] = state
 
-        # proposer boost for timely first-seen blocks
-        time_into_slot = (store.time - store.genesis_time) % self.config.SECONDS_PER_SLOT
-        is_before_attesting_interval = (
-            time_into_slot < self.config.SECONDS_PER_SLOT // self.INTERVALS_PER_SLOT
-        )
-        is_timely = self.get_current_slot(store) == block.slot and is_before_attesting_interval
+        # proposer boost for timely first-seen blocks (ms-based threshold,
+        # specs/phase0/fork-choice.md:790-796)
+        is_timely = self.get_current_slot(
+            store
+        ) == block.slot and self.is_before_attesting_interval(store)
         store.block_timeliness[block_root] = is_timely
         is_first_block = store.proposer_boost_root == Root()
         if is_timely and is_first_block:

@@ -1,0 +1,296 @@
+"""Electra whole-block sanity: execution-layer requests interacting with
+CL operations inside one block (reference analogue:
+eth2spec/test/electra/sanity/blocks/test_blocks.py; spec:
+specs/electra/beacon-chain.md process_operations + request processing)."""
+
+from eth_consensus_specs_tpu.ssz import hash_tree_root
+from eth_consensus_specs_tpu.test_infra.block import (
+    build_empty_block_for_next_slot,
+    state_transition_and_sign_block,
+)
+from eth_consensus_specs_tpu.test_infra.context import spec_state_test, with_phases
+from eth_consensus_specs_tpu.test_infra.keys import privkeys, pubkeys
+from eth_consensus_specs_tpu.utils import bls
+
+ELECTRA_ON = ["electra", "fulu"]
+
+ADDRESS = b"\x42" * 20
+
+
+def _give_execution_creds(spec, state, index, address=ADDRESS, compounding=False):
+    prefix = (
+        spec.COMPOUNDING_WITHDRAWAL_PREFIX
+        if compounding
+        else spec.ETH1_ADDRESS_WITHDRAWAL_PREFIX
+    )
+    state.validators[index].withdrawal_credentials = prefix + b"\x00" * 11 + address
+
+
+def _age_state(spec, state):
+    if spec.get_current_epoch(state) < spec.config.SHARD_COMMITTEE_PERIOD:
+        state.slot = spec.config.SHARD_COMMITTEE_PERIOD * spec.SLOTS_PER_EPOCH
+
+
+def _apply_block_with_requests(
+    spec, state, withdrawals=(), deposits=(), consolidations=()
+):
+    block = build_empty_block_for_next_slot(spec, state)
+    for r in withdrawals:
+        block.body.execution_requests.withdrawals.append(r)
+    for r in deposits:
+        block.body.execution_requests.deposits.append(r)
+    for r in consolidations:
+        block.body.execution_requests.consolidations.append(r)
+    return state_transition_and_sign_block(spec, state, block)
+
+
+def _withdrawal_request(spec, state, index, amount, address=ADDRESS):
+    return spec.WithdrawalRequest(
+        source_address=address,
+        validator_pubkey=state.validators[index].pubkey,
+        amount=amount,
+    )
+
+
+# == withdrawal requests in blocks =========================================
+
+
+@with_phases(ELECTRA_ON)
+@spec_state_test
+def test_block_with_el_withdrawal_request(spec, state):
+    index = 1
+    _give_execution_creds(spec, state, index)
+    _age_state(spec, state)
+    req = _withdrawal_request(spec, state, index, spec.FULL_EXIT_REQUEST_AMOUNT)
+    _apply_block_with_requests(spec, state, withdrawals=[req])
+    assert state.validators[index].exit_epoch != spec.FAR_FUTURE_EPOCH
+
+
+@with_phases(ELECTRA_ON)
+@spec_state_test
+def test_block_cl_exit_and_el_withdrawal_same_validator(spec, state):
+    """A voluntary exit and an EL full-exit request for the same validator
+    in one block: the CL exit wins, the request becomes a no-op, and the
+    block remains valid."""
+    index = 1
+    _give_execution_creds(spec, state, index)
+    _age_state(spec, state)
+
+    exit_epoch_domain = spec.get_domain(
+        state, spec.DOMAIN_VOLUNTARY_EXIT, spec.get_current_epoch(state)
+    )
+    voluntary = spec.VoluntaryExit(
+        epoch=spec.get_current_epoch(state), validator_index=index
+    )
+    signed_exit = spec.SignedVoluntaryExit(
+        message=voluntary,
+        signature=bls.Sign(
+            privkeys[index], spec.compute_signing_root(voluntary, exit_epoch_domain)
+        ),
+    )
+    req = _withdrawal_request(spec, state, index, spec.FULL_EXIT_REQUEST_AMOUNT)
+
+    block = build_empty_block_for_next_slot(spec, state)
+    block.body.voluntary_exits.append(signed_exit)
+    block.body.execution_requests.withdrawals.append(req)
+    state_transition_and_sign_block(spec, state, block)
+
+    assert state.validators[index].exit_epoch != spec.FAR_FUTURE_EPOCH
+
+
+@with_phases(ELECTRA_ON)
+@spec_state_test
+def test_block_multiple_partials_same_validator(spec, state):
+    """Two partial requests for one compounding validator in a single
+    block both enter the pending queue."""
+    index = 1
+    _give_execution_creds(spec, state, index, compounding=True)
+    _age_state(spec, state)
+    state.balances[index] = spec.MIN_ACTIVATION_BALANCE + 3 * spec.EFFECTIVE_BALANCE_INCREMENT
+    state.validators[index].effective_balance = spec.MIN_ACTIVATION_BALANCE
+
+    reqs = [
+        _withdrawal_request(spec, state, index, spec.EFFECTIVE_BALANCE_INCREMENT),
+        _withdrawal_request(spec, state, index, spec.EFFECTIVE_BALANCE_INCREMENT),
+    ]
+    before = len(state.pending_partial_withdrawals)
+    _apply_block_with_requests(spec, state, withdrawals=reqs)
+    assert len(state.pending_partial_withdrawals) == before + 2
+
+
+@with_phases(ELECTRA_ON)
+@spec_state_test
+def test_block_partials_different_validators(spec, state):
+    for index in (1, 2):
+        _give_execution_creds(spec, state, index, compounding=True)
+        state.balances[index] = (
+            spec.MIN_ACTIVATION_BALANCE + 2 * spec.EFFECTIVE_BALANCE_INCREMENT
+        )
+        state.validators[index].effective_balance = spec.MIN_ACTIVATION_BALANCE
+    _age_state(spec, state)
+    reqs = [
+        _withdrawal_request(spec, state, 1, spec.EFFECTIVE_BALANCE_INCREMENT),
+        _withdrawal_request(spec, state, 2, spec.EFFECTIVE_BALANCE_INCREMENT),
+    ]
+    _apply_block_with_requests(spec, state, withdrawals=reqs)
+    assert len(state.pending_partial_withdrawals) == 2
+    assert {int(w.validator_index) for w in state.pending_partial_withdrawals} == {1, 2}
+
+
+# == BTEC ordering =========================================================
+
+
+@with_phases(ELECTRA_ON)
+@spec_state_test
+def test_block_btec_then_el_withdrawal_request_same_block(spec, state):
+    """BLS-to-execution changes process BEFORE execution requests inside
+    one block, so a request against the fresh address takes effect."""
+    index = 1
+    _age_state(spec, state)
+
+    # give the validator BLS credentials matching the test key
+    from eth_consensus_specs_tpu.ssz.hashing import hash_bytes as sha256
+
+    bls_pubkey = bytes(pubkeys[index])
+    state.validators[index].withdrawal_credentials = (
+        spec.BLS_WITHDRAWAL_PREFIX + sha256(bls_pubkey)[1:]
+    )
+
+    change = spec.BLSToExecutionChange(
+        validator_index=index,
+        from_bls_pubkey=bls_pubkey,
+        to_execution_address=ADDRESS,
+    )
+    domain = spec.compute_domain(
+        spec.DOMAIN_BLS_TO_EXECUTION_CHANGE,
+        spec.config.GENESIS_FORK_VERSION,
+        state.genesis_validators_root,
+    )
+    signed_change = spec.SignedBLSToExecutionChange(
+        message=change,
+        signature=bls.Sign(
+            privkeys[index], spec.compute_signing_root(change, domain)
+        ),
+    )
+    req = _withdrawal_request(spec, state, index, spec.FULL_EXIT_REQUEST_AMOUNT)
+
+    block = build_empty_block_for_next_slot(spec, state)
+    block.body.bls_to_execution_changes.append(signed_change)
+    block.body.execution_requests.withdrawals.append(req)
+    state_transition_and_sign_block(spec, state, block)
+
+    assert state.validators[index].exit_epoch != spec.FAR_FUTURE_EPOCH
+
+
+# == deposit requests in blocks ============================================
+
+
+def _deposit_request(spec, index, creds, amount, slot=0):
+    pubkey_bytes = bytes(pubkeys[index])
+    deposit_message = spec.DepositMessage(
+        pubkey=pubkey_bytes, withdrawal_credentials=creds, amount=amount
+    )
+    domain = spec.compute_domain(spec.DOMAIN_DEPOSIT)
+    signature = bls.Sign(
+        privkeys[index], spec.compute_signing_root(deposit_message, domain)
+    )
+    return spec.DepositRequest(
+        pubkey=pubkey_bytes,
+        withdrawal_credentials=creds,
+        amount=amount,
+        signature=signature,
+        index=slot,
+    )
+
+
+@with_phases(ELECTRA_ON)
+@spec_state_test
+def test_block_deposit_request_same_pubkey_different_creds(spec, state):
+    """Two requests for one pubkey with different credentials both enter
+    the pending queue (dedup happens at apply time, not enqueue)."""
+    n = len(state.validators)
+    creds_a = spec.ETH1_ADDRESS_WITHDRAWAL_PREFIX + b"\x00" * 11 + b"\xaa" * 20
+    creds_b = spec.ETH1_ADDRESS_WITHDRAWAL_PREFIX + b"\x00" * 11 + b"\xbb" * 20
+    reqs = [
+        _deposit_request(spec, n + 1, creds_a, spec.MIN_ACTIVATION_BALANCE, slot=0),
+        _deposit_request(spec, n + 1, creds_b, spec.EFFECTIVE_BALANCE_INCREMENT, slot=1),
+    ]
+    before = len(state.pending_deposits)
+    _apply_block_with_requests(spec, state, deposits=reqs)
+    assert len(state.pending_deposits) == before + 2
+
+
+@with_phases(ELECTRA_ON)
+@spec_state_test
+def test_block_deposit_request_max_per_payload(spec, state):
+    cap = int(spec.MAX_DEPOSIT_REQUESTS_PER_PAYLOAD)
+    n = len(state.validators)
+    creds = spec.ETH1_ADDRESS_WITHDRAWAL_PREFIX + b"\x00" * 11 + ADDRESS
+    reqs = [
+        _deposit_request(
+            spec, n + 1 + i, creds, spec.EFFECTIVE_BALANCE_INCREMENT, slot=i
+        )
+        for i in range(cap)
+    ]
+    before = len(state.pending_deposits)
+    _apply_block_with_requests(spec, state, deposits=reqs)
+    assert len(state.pending_deposits) == before + cap
+
+
+# == consolidation requests in blocks ======================================
+
+
+@with_phases(ELECTRA_ON)
+@spec_state_test
+def test_block_consolidation_request(spec, state):
+    src, dst = 1, 2
+    _give_execution_creds(spec, state, src)
+    _give_execution_creds(spec, state, dst, compounding=True)
+    _age_state(spec, state)
+    req = spec.ConsolidationRequest(
+        source_address=ADDRESS,
+        source_pubkey=state.validators[src].pubkey,
+        target_pubkey=state.validators[dst].pubkey,
+    )
+    before = len(state.pending_consolidations)
+    _apply_block_with_requests(spec, state, consolidations=[req])
+    assert len(state.pending_consolidations) == before + 1
+    assert state.validators[src].exit_epoch != spec.FAR_FUTURE_EPOCH
+
+
+@with_phases(ELECTRA_ON)
+@spec_state_test
+def test_block_switch_to_compounding_request(spec, state):
+    """source == target: an in-block switch request flips the credential
+    prefix without queueing a consolidation."""
+    index = 1
+    _give_execution_creds(spec, state, index)
+    _age_state(spec, state)
+    req = spec.ConsolidationRequest(
+        source_address=ADDRESS,
+        source_pubkey=state.validators[index].pubkey,
+        target_pubkey=state.validators[index].pubkey,
+    )
+    before = len(state.pending_consolidations)
+    _apply_block_with_requests(spec, state, consolidations=[req])
+    assert len(state.pending_consolidations) == before
+    assert state.validators[index].withdrawal_credentials[:1] == (
+        spec.COMPOUNDING_WITHDRAWAL_PREFIX
+    )
+
+
+@with_phases(ELECTRA_ON)
+@spec_state_test
+def test_block_requests_roundtrip_root(spec, state):
+    """Blocks carrying requests merkleize deterministically — the body
+    root changes with the request content."""
+    index = 1
+    _give_execution_creds(spec, state, index)
+    _age_state(spec, state)
+
+    block_a = build_empty_block_for_next_slot(spec, state)
+    root_empty = hash_tree_root(block_a.body)
+    block_a.body.execution_requests.withdrawals.append(
+        _withdrawal_request(spec, state, index, spec.FULL_EXIT_REQUEST_AMOUNT)
+    )
+    assert hash_tree_root(block_a.body) != root_empty

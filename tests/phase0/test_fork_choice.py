@@ -82,7 +82,7 @@ def test_split_tie_broken_by_root(spec, state):
     time = (
         store.genesis_time
         + int(block_a.slot) * spec.config.SECONDS_PER_SLOT
-        + spec.config.SECONDS_PER_SLOT // spec.INTERVALS_PER_SLOT
+        + -(-spec.get_attestation_due_ms(0) // 1000)  # first whole second past the deadline
     )
     spec.on_tick(store, time)
     root_a = add_block(spec, store, signed_a)
@@ -198,7 +198,7 @@ def test_proposer_boost_not_applied_when_late(spec, state):
     time = (
         store.genesis_time
         + int(block.slot) * spec.config.SECONDS_PER_SLOT
-        + spec.config.SECONDS_PER_SLOT // spec.INTERVALS_PER_SLOT
+        + -(-spec.get_attestation_due_ms(0) // 1000)  # first whole second past the deadline
     )
     spec.on_tick(store, time)
     root = add_block(spec, store, signed)

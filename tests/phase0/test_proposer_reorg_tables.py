@@ -1,0 +1,217 @@
+"""Proposer-head re-org decision tables (reference analogue:
+eth2spec/test/bellatrix/fork_choice/test_should_override_forkchoice_update.py
+and the phase0 get_proposer_head helper family; spec:
+specs/phase0/fork-choice.md:500-612 `get_proposer_head` + predicates,
+specs/bellatrix/fork-choice.md:98-175 `should_override_forkchoice_update`)."""
+
+from eth_consensus_specs_tpu.test_infra.block import (
+    build_empty_block_for_next_slot,
+    state_transition_and_sign_block,
+)
+from eth_consensus_specs_tpu.test_infra.context import (
+    expect_assertion_error,
+    spec_state_test,
+    with_phases,
+)
+from eth_consensus_specs_tpu.test_infra.fork_choice import (
+    get_genesis_forkchoice_store,
+    tick_and_add_block,
+    tick_to_slot,
+)
+
+# gloas re-keys fork-choice weights by (root, payload_status) nodes; the
+# optional proposer-reorg helper family is specified through fulu only
+PRE_GLOAS = ["phase0", "altair", "bellatrix", "capella", "deneb", "electra", "fulu"]
+BELLATRIX_ON = ["bellatrix", "capella", "deneb", "electra", "fulu"]
+
+
+def _chain_two_blocks(spec, state, store):
+    """parent(slot1) <- head(slot2); returns (parent_root, head_root)."""
+    parent = build_empty_block_for_next_slot(spec, state)
+    signed_parent = state_transition_and_sign_block(spec, state, parent)
+    parent_root = tick_and_add_block(spec, store, signed_parent)
+    head = build_empty_block_for_next_slot(spec, state)
+    signed_head = state_transition_and_sign_block(spec, state, head)
+    head_root = tick_and_add_block(spec, store, signed_head)
+    return parent_root, head_root
+
+
+def _make_head_late(store, head_root):
+    store.block_timeliness[head_root] = False
+
+
+# == timing helpers ========================================================
+
+
+@with_phases(PRE_GLOAS)
+@spec_state_test
+def test_slot_component_durations(spec, state):
+    ms = spec.config.SLOT_DURATION_MS
+    assert spec.get_attestation_due_ms(0) == spec.config.ATTESTATION_DUE_BPS * ms // 10_000
+    assert spec.get_aggregate_due_ms(0) == spec.config.AGGREGATE_DUE_BPS * ms // 10_000
+    assert (
+        spec.get_proposer_reorg_cutoff_ms(0)
+        == spec.config.PROPOSER_REORG_CUTOFF_BPS * ms // 10_000
+    )
+    # component ordering: reorg cutoff < attestation due < aggregate due
+    assert (
+        spec.get_proposer_reorg_cutoff_ms(0)
+        < spec.get_attestation_due_ms(0)
+        < spec.get_aggregate_due_ms(0)
+        <= ms
+    )
+
+
+@with_phases(PRE_GLOAS)
+@spec_state_test
+def test_seconds_to_milliseconds_overflow_saturates(spec, state):
+    assert spec.seconds_to_milliseconds(12) == 12_000
+    assert spec.seconds_to_milliseconds(2**64 - 1) == 2**64 - 1
+    assert spec.seconds_to_milliseconds((2**64 - 1) // 1000) == ((2**64 - 1) // 1000) * 1000
+
+
+@with_phases(PRE_GLOAS)
+@spec_state_test
+def test_calculate_committee_fraction(spec, state):
+    total = spec.get_total_active_balance(state)
+    per_slot = total // spec.SLOTS_PER_EPOCH
+    assert spec.calculate_committee_fraction(state, 100) == per_slot
+    assert spec.calculate_committee_fraction(state, 20) == per_slot * 20 // 100
+    assert spec.calculate_committee_fraction(state, 0) == 0
+
+
+# == predicate table =======================================================
+
+
+@with_phases(PRE_GLOAS)
+@spec_state_test
+def test_is_shuffling_stable_epoch_boundary(spec, state):
+    assert not spec.is_shuffling_stable(spec.SLOTS_PER_EPOCH)
+    assert spec.is_shuffling_stable(spec.SLOTS_PER_EPOCH + 1)
+    assert not spec.is_shuffling_stable(0)
+
+
+@with_phases(PRE_GLOAS)
+@spec_state_test
+def test_head_late_follows_timeliness(spec, state):
+    store, _ = get_genesis_forkchoice_store(spec, state)
+    _, head_root = _chain_two_blocks(spec, state, store)
+    # blocks applied exactly at their slot start are timely
+    assert not spec.is_head_late(store, head_root)
+    _make_head_late(store, head_root)
+    assert spec.is_head_late(store, head_root)
+
+
+@with_phases(PRE_GLOAS)
+@spec_state_test
+def test_head_weak_parent_strong_without_votes(spec, state):
+    """With no attestations in the store, every head is weak and no parent
+    is strong (weight 0 on both sides)."""
+    store, _ = get_genesis_forkchoice_store(spec, state)
+    parent_root, head_root = _chain_two_blocks(spec, state, store)
+    # advance one slot so the head's proposer boost wears off
+    tick_to_slot(spec, store, int(state.slot) + 1)
+    assert spec.is_head_weak(store, head_root)
+    assert not spec.is_parent_strong(store, parent_root)
+
+
+# == get_proposer_head =====================================================
+
+
+@with_phases(PRE_GLOAS)
+@spec_state_test
+def test_proposer_head_keeps_timely_head(spec, state):
+    store, _ = get_genesis_forkchoice_store(spec, state)
+    _, head_root = _chain_two_blocks(spec, state, store)
+    next_slot = int(state.slot) + 1
+    tick_to_slot(spec, store, next_slot)  # boost wears off at the tick
+    assert spec.get_proposer_head(store, head_root, next_slot) == head_root
+
+
+@with_phases(PRE_GLOAS)
+@spec_state_test
+def test_proposer_head_never_reorgs_without_parent_votes(spec, state):
+    """Even a late weak head survives when the parent holds no votes —
+    the missing-vote-hoarding guard (is_parent_strong) blocks the reorg."""
+    store, _ = get_genesis_forkchoice_store(spec, state)
+    _, head_root = _chain_two_blocks(spec, state, store)
+    _make_head_late(store, head_root)
+    next_slot = int(state.slot) + 1
+    tick_to_slot(spec, store, next_slot)
+    assert spec.get_proposer_head(store, head_root, next_slot) == head_root
+
+
+@with_phases(PRE_GLOAS)
+@spec_state_test
+def test_proposer_head_boost_must_wear_off(spec, state):
+    store, _ = get_genesis_forkchoice_store(spec, state)
+    _, head_root = _chain_two_blocks(spec, state, store)
+    store.proposer_boost_root = head_root
+    next_slot = int(state.slot) + 1
+    expect_assertion_error(
+        lambda: spec.get_proposer_head(store, head_root, next_slot)
+    )
+
+
+@with_phases(PRE_GLOAS)
+@spec_state_test
+def test_proposer_head_epoch_boundary_no_reorg(spec, state):
+    """At an epoch start the shuffling may change: never re-org."""
+    store, _ = get_genesis_forkchoice_store(spec, state)
+    _, head_root = _chain_two_blocks(spec, state, store)
+    _make_head_late(store, head_root)
+    boundary = spec.SLOTS_PER_EPOCH * (int(state.slot) // spec.SLOTS_PER_EPOCH + 1)
+    tick_to_slot(spec, store, boundary)
+    assert not spec.is_shuffling_stable(boundary)
+    assert spec.get_proposer_head(store, head_root, boundary) == head_root
+
+
+# == should_override_forkchoice_update =====================================
+
+
+@with_phases(BELLATRIX_ON)
+@spec_state_test
+def test_should_override_timely_head_false(spec, state):
+    store, _ = get_genesis_forkchoice_store(spec, state)
+    _, head_root = _chain_two_blocks(spec, state, store)
+    assert not spec.should_override_forkchoice_update(store, head_root)
+
+
+@with_phases(BELLATRIX_ON)
+@spec_state_test
+def test_should_override_late_head_within_head_slot(spec, state):
+    """During the head block's own slot the weight checks are assumed
+    true: a late head on a stable shuffling slot is overridden."""
+    store, _ = get_genesis_forkchoice_store(spec, state)
+    _, head_root = _chain_two_blocks(spec, state, store)
+    _make_head_late(store, head_root)
+    proposal_slot = int(store.blocks[head_root].slot) + 1
+    expected = spec.is_shuffling_stable(proposal_slot)
+    assert spec.should_override_forkchoice_update(store, head_root) == expected
+
+
+@with_phases(BELLATRIX_ON)
+@spec_state_test
+def test_should_override_false_once_head_votes_land(spec, state):
+    """After the head's slot, weight checks apply: with no parent votes
+    the parent is not strong, so no override."""
+    store, _ = get_genesis_forkchoice_store(spec, state)
+    _, head_root = _chain_two_blocks(spec, state, store)
+    _make_head_late(store, head_root)
+    tick_to_slot(spec, store, int(store.blocks[head_root].slot) + 2)
+    assert not spec.should_override_forkchoice_update(store, head_root)
+
+
+@with_phases(BELLATRIX_ON)
+@spec_state_test
+def test_should_override_disconnected_proposer_false(spec, state):
+    """If the next proposer is not ours, never suppress the fcU."""
+    store, _ = get_genesis_forkchoice_store(spec, state)
+    _, head_root = _chain_two_blocks(spec, state, store)
+    _make_head_late(store, head_root)
+    orig = spec.validator_is_connected
+    spec.validator_is_connected = lambda index: False
+    try:
+        assert not spec.should_override_forkchoice_update(store, head_root)
+    finally:
+        spec.validator_is_connected = orig
