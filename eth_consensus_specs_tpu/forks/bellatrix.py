@@ -302,8 +302,6 @@ class BellatrixSpec(AltairSpec):
         pow_parent = self.get_pow_block(pow_block.parent_hash)
         assert self.is_valid_terminal_pow_block(pow_block, pow_parent), "invalid terminal block"
 
-    # == genesis (reference: bellatrix beacon-chain.md Testing section) ====
-
     # == proposer re-org fcU suppression (specs/bellatrix/fork-choice.md:98-175)
 
     def validator_is_connected(self, validator_index: int) -> bool:
@@ -361,6 +359,8 @@ class BellatrixSpec(AltairSpec):
                 parent_strong,
             ]
         )
+
+    # == genesis (reference: bellatrix beacon-chain.md Testing section) ====
 
     def initialize_beacon_state_from_eth1(
         self, eth1_block_hash, eth1_timestamp, deposits, execution_payload_header=None

@@ -179,6 +179,17 @@ class ElectraSpec(DenebSpec):
             attestation_1: IndexedAttestation
             attestation_2: IndexedAttestation
 
+        # [Modified in Electra:EIP7549] aggregate carries the new Attestation
+        # (specs/electra/validator.md AggregateAndProof)
+        class AggregateAndProof(Container):
+            aggregator_index: ValidatorIndex
+            aggregate: Attestation
+            selection_proof: BLSSignature
+
+        class SignedAggregateAndProof(Container):
+            message: AggregateAndProof
+            signature: BLSSignature
+
         class BeaconBlockBody(Container):
             randao_reveal: BLSSignature
             eth1_data: P.Eth1Data

@@ -227,6 +227,27 @@ class GloasSpec(FuluSpec):
                 typ.__name__ = name
                 setattr(self, name, typ)
 
+    # gloas re-keys fork-choice weights by (root, payload_status) nodes; the
+    # optional proposer re-org helper family is specified only through fulu
+    # (specs/gloas/fork-choice.md modifies get_weight but not these), so the
+    # inherited root-keyed versions would crash — fail loudly instead.
+    _REORG_HELPERS_UNSPECIFIED = (
+        "the proposer re-org helpers are not specified for gloas "
+        "(get_weight is keyed by ForkChoiceNode, not Root)"
+    )
+
+    def is_head_weak(self, store, head_root) -> bool:
+        raise NotImplementedError(self._REORG_HELPERS_UNSPECIFIED)
+
+    def is_parent_strong(self, store, parent_root) -> bool:
+        raise NotImplementedError(self._REORG_HELPERS_UNSPECIFIED)
+
+    def get_proposer_head(self, store, head_root, slot: int):
+        raise NotImplementedError(self._REORG_HELPERS_UNSPECIFIED)
+
+    def should_override_forkchoice_update(self, store, head_root) -> bool:
+        raise NotImplementedError(self._REORG_HELPERS_UNSPECIFIED)
+
     # == slot-component timing (specs/gloas/fork-choice.md:437-485) ========
 
     def _fork_due_ms(self, epoch: int, pre_bps: int, post_bps: int) -> int:

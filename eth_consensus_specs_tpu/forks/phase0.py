@@ -1995,6 +1995,11 @@ class Phase0Spec:
 
     # == honest validator (specs/phase0/validator.md) ======================
 
+    def check_if_validator_active(self, state, validator_index: int) -> bool:
+        """specs/phase0/validator.md `check_if_validator_active`."""
+        validator = state.validators[validator_index]
+        return self.is_active_validator(validator, self.get_current_epoch(state))
+
     def get_committee_assignment(self, state, epoch: int, validator_index: int):
         next_epoch = self.get_current_epoch(state) + 1
         assert epoch <= next_epoch

@@ -6,11 +6,27 @@ from __future__ import annotations
 from eth_consensus_specs_tpu.utils import bls
 
 from .context import expect_assertion_error
+from .forks import is_post_deneb
 from .keys import privkeys
 
 
-def sign_voluntary_exit(spec, state, voluntary_exit, privkey):
-    domain = spec.get_domain(state, spec.DOMAIN_VOLUNTARY_EXIT, voluntary_exit.epoch)
+def sign_voluntary_exit(spec, state, voluntary_exit, privkey, fork_version=None):
+    """Sign an exit with the fork-correct domain: post-deneb exits are
+    locked to the capella fork version (EIP-7044,
+    specs/deneb/beacon-chain.md process_voluntary_exit; reference:
+    helpers/voluntary_exits.py sign_voluntary_exit)."""
+    if fork_version is not None:
+        domain = spec.compute_domain(
+            spec.DOMAIN_VOLUNTARY_EXIT, fork_version, state.genesis_validators_root
+        )
+    elif is_post_deneb(spec):
+        domain = spec.compute_domain(
+            spec.DOMAIN_VOLUNTARY_EXIT,
+            spec.config.CAPELLA_FORK_VERSION,
+            state.genesis_validators_root,
+        )
+    else:
+        domain = spec.get_domain(state, spec.DOMAIN_VOLUNTARY_EXIT, voluntary_exit.epoch)
     return spec.SignedVoluntaryExit(
         message=voluntary_exit,
         signature=bls.Sign(privkey, spec.compute_signing_root(voluntary_exit, domain)),

@@ -10,6 +10,12 @@ from eth_consensus_specs_tpu.test_infra.block import (
 )
 from eth_consensus_specs_tpu.test_infra.context import spec_state_test, with_phases
 from eth_consensus_specs_tpu.test_infra.keys import privkeys, pubkeys
+from eth_consensus_specs_tpu.test_infra.voluntary_exits import sign_voluntary_exit
+from eth_consensus_specs_tpu.test_infra.withdrawals import (
+    prepare_withdrawal_request,
+    set_compounding_withdrawal_credential_with_balance,
+    set_eth1_withdrawal_credential_with_balance,
+)
 from eth_consensus_specs_tpu.utils import bls
 
 ELECTRA_ON = ["electra", "fulu"]
@@ -18,12 +24,12 @@ ADDRESS = b"\x42" * 20
 
 
 def _give_execution_creds(spec, state, index, address=ADDRESS, compounding=False):
-    prefix = (
-        spec.COMPOUNDING_WITHDRAWAL_PREFIX
-        if compounding
-        else spec.ETH1_ADDRESS_WITHDRAWAL_PREFIX
-    )
-    state.validators[index].withdrawal_credentials = prefix + b"\x00" * 11 + address
+    if compounding:
+        set_compounding_withdrawal_credential_with_balance(
+            spec, state, index, address=address
+        )
+    else:
+        set_eth1_withdrawal_credential_with_balance(spec, state, index, address=address)
 
 
 def _age_state(spec, state):
@@ -45,10 +51,8 @@ def _apply_block_with_requests(
 
 
 def _withdrawal_request(spec, state, index, amount, address=ADDRESS):
-    return spec.WithdrawalRequest(
-        source_address=address,
-        validator_pubkey=state.validators[index].pubkey,
-        amount=amount,
+    return prepare_withdrawal_request(
+        spec, state, index, address=address, amount=amount
     )
 
 
@@ -76,18 +80,10 @@ def test_block_cl_exit_and_el_withdrawal_same_validator(spec, state):
     _give_execution_creds(spec, state, index)
     _age_state(spec, state)
 
-    exit_epoch_domain = spec.get_domain(
-        state, spec.DOMAIN_VOLUNTARY_EXIT, spec.get_current_epoch(state)
-    )
     voluntary = spec.VoluntaryExit(
         epoch=spec.get_current_epoch(state), validator_index=index
     )
-    signed_exit = spec.SignedVoluntaryExit(
-        message=voluntary,
-        signature=bls.Sign(
-            privkeys[index], spec.compute_signing_root(voluntary, exit_epoch_domain)
-        ),
-    )
+    signed_exit = sign_voluntary_exit(spec, state, voluntary, privkeys[index])
     req = _withdrawal_request(spec, state, index, spec.FULL_EXIT_REQUEST_AMOUNT)
 
     block = build_empty_block_for_next_slot(spec, state)
@@ -172,7 +168,13 @@ def test_block_btec_then_el_withdrawal_request_same_block(spec, state):
             privkeys[index], spec.compute_signing_root(change, domain)
         ),
     )
-    req = _withdrawal_request(spec, state, index, spec.FULL_EXIT_REQUEST_AMOUNT)
+    # raw request against the address the BTEC will install — built by hand
+    # because prepare_withdrawal_request would overwrite the BLS creds
+    req = spec.WithdrawalRequest(
+        source_address=ADDRESS,
+        validator_pubkey=state.validators[index].pubkey,
+        amount=spec.FULL_EXIT_REQUEST_AMOUNT,
+    )
 
     block = build_empty_block_for_next_slot(spec, state)
     block.body.bls_to_execution_changes.append(signed_change)

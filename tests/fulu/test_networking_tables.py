@@ -1,0 +1,182 @@
+"""Fulu p2p structural-verification tables: data-column sidecar shape
+checks, subnet mapping, and custody boundary cases (reference analogue:
+eth2spec/test/fulu/unittests/test_networking.py and
+fulu/networking/test_get_custody_groups.py; spec:
+specs/fulu/p2p-interface.md verify_data_column_sidecar,
+specs/fulu/das-core.md get_custody_groups)."""
+
+import pytest
+
+from eth_consensus_specs_tpu.crypto import curve
+from eth_consensus_specs_tpu.test_infra.context import (
+    spec_state_test,
+    spec_test,
+    with_phases,
+)
+
+FULU = ["fulu"]
+
+COMMITMENT = curve.g1_to_bytes(curve.g1_generator())
+
+
+def _structural_sidecar(spec, n_blobs=1, index=0):
+    """A sidecar that satisfies the SHAPE checks (no KZG validity):
+    lengths consistent across column/commitments/proofs."""
+    cell = b"\x00" * spec.BYTES_PER_CELL
+    return spec.DataColumnSidecar(
+        index=index,
+        column=[cell] * n_blobs,
+        kzg_commitments=[COMMITMENT] * n_blobs,
+        kzg_proofs=[COMMITMENT] * n_blobs,
+        signed_block_header=spec.SignedBeaconBlockHeader(),
+    )
+
+
+# == verify_data_column_sidecar shape table ================================
+
+
+@with_phases(FULU)
+@spec_test
+def test_sidecar_shape_valid(spec):
+    assert spec.verify_data_column_sidecar(_structural_sidecar(spec, n_blobs=2))
+
+
+@with_phases(FULU)
+@spec_test
+def test_sidecar_shape_invalid_zero_blobs(spec):
+    assert not spec.verify_data_column_sidecar(_structural_sidecar(spec, n_blobs=0))
+
+
+@with_phases(FULU)
+@spec_test
+def test_sidecar_shape_invalid_index(spec):
+    sidecar = _structural_sidecar(spec, index=int(spec.NUMBER_OF_COLUMNS))
+    assert not spec.verify_data_column_sidecar(sidecar)
+
+
+@with_phases(FULU)
+@spec_test
+def test_sidecar_shape_invalid_mismatch_len_column(spec):
+    sidecar = _structural_sidecar(spec, n_blobs=2)
+    sidecar.column.pop()
+    assert not spec.verify_data_column_sidecar(sidecar)
+
+
+@with_phases(FULU)
+@spec_test
+def test_sidecar_shape_invalid_mismatch_len_commitments(spec):
+    sidecar = _structural_sidecar(spec, n_blobs=2)
+    sidecar.kzg_commitments.pop()
+    assert not spec.verify_data_column_sidecar(sidecar)
+
+
+@with_phases(FULU)
+@spec_test
+def test_sidecar_shape_invalid_mismatch_len_proofs(spec):
+    sidecar = _structural_sidecar(spec, n_blobs=2)
+    sidecar.kzg_proofs.pop()
+    assert not spec.verify_data_column_sidecar(sidecar)
+
+
+# == subnet mapping ========================================================
+
+
+@with_phases(FULU)
+@spec_test
+def test_subnet_for_data_column_sidecar_wraps(spec):
+    n_subnets = int(spec.config.DATA_COLUMN_SIDECAR_SUBNET_COUNT)
+    seen = set()
+    for column in range(int(spec.NUMBER_OF_COLUMNS)):
+        subnet = int(spec.compute_subnet_for_data_column_sidecar(column))
+        assert 0 <= subnet < n_subnets
+        seen.add(subnet)
+    assert seen == set(range(n_subnets))
+
+
+@with_phases(FULU)
+@spec_test
+def test_subnet_mapping_is_modular(spec):
+    n_subnets = int(spec.config.DATA_COLUMN_SIDECAR_SUBNET_COUNT)
+    for column in (0, 1, n_subnets, n_subnets + 1):
+        assert (
+            int(spec.compute_subnet_for_data_column_sidecar(column))
+            == column % n_subnets
+        )
+
+
+# == custody boundary table ================================================
+
+U256_MAX = 2**256 - 1
+
+
+@with_phases(FULU)
+@spec_test
+def test_custody_groups_min_node_id_min_count(spec):
+    groups = spec.get_custody_groups(0, int(spec.config.CUSTODY_REQUIREMENT))
+    assert len(groups) == int(spec.config.CUSTODY_REQUIREMENT)
+    assert groups == sorted(groups)
+
+
+@with_phases(FULU)
+@spec_test
+def test_custody_groups_min_node_id_max_count(spec):
+    total = int(spec.config.NUMBER_OF_CUSTODY_GROUPS)
+    assert spec.get_custody_groups(0, total) == list(range(total))
+
+
+@with_phases(FULU)
+@spec_test
+def test_custody_groups_max_node_id_min_count(spec):
+    groups = spec.get_custody_groups(U256_MAX, int(spec.config.CUSTODY_REQUIREMENT))
+    assert len(groups) == int(spec.config.CUSTODY_REQUIREMENT)
+    assert all(0 <= g < int(spec.config.NUMBER_OF_CUSTODY_GROUPS) for g in groups)
+
+
+@with_phases(FULU)
+@spec_test
+def test_custody_groups_max_node_id_max_count(spec):
+    total = int(spec.config.NUMBER_OF_CUSTODY_GROUPS)
+    assert spec.get_custody_groups(U256_MAX, total) == list(range(total))
+
+
+@with_phases(FULU)
+@spec_test
+def test_custody_groups_adjacent_max_node_ids_well_formed(spec):
+    """Adjacent max-range ids each derive a deterministic, sorted,
+    duplicate-free set (with minimal's small group space the two sets may
+    legitimately coincide)."""
+    count = max(1, int(spec.config.NUMBER_OF_CUSTODY_GROUPS) // 4)
+    for node_id in (U256_MAX, U256_MAX - 1):
+        groups = spec.get_custody_groups(node_id, count)
+        assert groups == sorted(set(groups))
+        assert len(groups) == count
+        assert groups == spec.get_custody_groups(node_id, count)
+
+
+@with_phases(FULU)
+@spec_test
+def test_custody_groups_short_node_id(spec):
+    """Small ids must be padded, not truncated — 0x01 is a distinct seed
+    from 0x0100."""
+    count = max(1, int(spec.config.NUMBER_OF_CUSTODY_GROUPS) // 4)
+    assert spec.get_custody_groups(1, count) != spec.get_custody_groups(256, count)
+
+
+@with_phases(FULU)
+@spec_test
+def test_custody_groups_count_over_total_rejected(spec):
+    with pytest.raises(AssertionError):
+        spec.get_custody_groups(0, int(spec.config.NUMBER_OF_CUSTODY_GROUPS) + 1)
+
+
+@with_phases(FULU)
+@spec_test
+def test_sampling_columns_superset_of_custody(spec):
+    """Sampling size is max(SAMPLES_PER_SLOT, custody) groups' columns."""
+    count = int(spec.config.CUSTODY_REQUIREMENT)
+    cols = spec.get_sampling_columns(1234, count)
+    groups = spec.get_custody_groups(1234, count)
+    custody_cols = set()
+    for g in groups:
+        custody_cols.update(spec.compute_columns_for_custody_group(g))
+    assert custody_cols <= set(cols)

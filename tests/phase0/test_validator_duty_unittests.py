@@ -1,0 +1,229 @@
+"""Honest-validator duty unittables (reference analogue:
+eth2spec/test/phase0/unittests/validator/test_validator_unittest.py; spec:
+specs/phase0/validator.md — assignments, proposal, signatures, selection,
+aggregation, subnet subscription)."""
+
+from eth_consensus_specs_tpu.ssz import hash_tree_root, uint64
+from eth_consensus_specs_tpu.test_infra.attestations import get_valid_attestation
+from eth_consensus_specs_tpu.test_infra.block import build_empty_block_for_next_slot
+from eth_consensus_specs_tpu.test_infra.context import (
+    always_bls,
+    expect_assertion_error,
+    spec_state_test,
+    with_all_phases,
+    with_phases,
+)
+from eth_consensus_specs_tpu.test_infra.keys import privkeys
+from eth_consensus_specs_tpu.test_infra.state import next_epoch
+from eth_consensus_specs_tpu.utils import bls
+
+PRE_GLOAS = ["phase0", "altair", "bellatrix", "capella", "deneb", "electra", "fulu"]
+
+
+# == liveness / assignment =================================================
+
+
+@with_all_phases
+@spec_state_test
+def test_check_if_validator_active(spec, state):
+    assert spec.check_if_validator_active(state, 0)
+    exited = 1
+    state.validators[exited].exit_epoch = spec.get_current_epoch(state)
+    next_epoch(spec, state)
+    assert not spec.check_if_validator_active(state, exited)
+
+
+@with_all_phases
+@spec_state_test
+def test_committee_assignment_current_epoch(spec, state):
+    epoch = spec.get_current_epoch(state)
+    committee, index, slot = spec.get_committee_assignment(state, epoch, 0)
+    assert 0 in [int(c) for c in committee]
+    assert spec.compute_epoch_at_slot(slot) == epoch
+    assert index < spec.get_committee_count_per_slot(state, epoch)
+
+
+@with_all_phases
+@spec_state_test
+def test_committee_assignment_next_epoch(spec, state):
+    epoch = spec.get_current_epoch(state) + 1
+    committee, _, slot = spec.get_committee_assignment(state, epoch, 0)
+    assert 0 in [int(c) for c in committee]
+    assert spec.compute_epoch_at_slot(slot) == epoch
+
+
+@with_all_phases
+@spec_state_test
+def test_committee_assignment_out_of_bound_epoch(spec, state):
+    expect_assertion_error(
+        lambda: spec.get_committee_assignment(
+            state, spec.get_current_epoch(state) + 2, 0
+        )
+    )
+
+
+@with_all_phases
+@spec_state_test
+def test_is_proposer_exactly_one(spec, state):
+    proposer = spec.get_beacon_proposer_index(state)
+    assert spec.is_proposer(state, proposer)
+    others = [i for i in range(len(state.validators)) if i != int(proposer)]
+    assert not any(spec.is_proposer(state, i) for i in others[:8])
+
+
+# == signatures (domain correctness, bls pinned on) ========================
+
+
+@with_all_phases
+@always_bls
+@spec_state_test
+def test_epoch_signature_verifies_against_randao_domain(spec, state):
+    block = build_empty_block_for_next_slot(spec, state)
+    proposer = int(block.proposer_index)
+    privkey = privkeys[proposer]
+    sig = spec.get_epoch_signature(state, block, privkey)
+    domain = spec.get_domain(
+        state, spec.DOMAIN_RANDAO, spec.compute_epoch_at_slot(block.slot)
+    )
+    signing_root = spec.compute_signing_root(
+        uint64(spec.compute_epoch_at_slot(block.slot)), domain
+    )
+    assert bls.Verify(state.validators[proposer].pubkey, signing_root, sig)
+
+
+@with_all_phases
+@always_bls
+@spec_state_test
+def test_block_signature_verifies_against_proposer_domain(spec, state):
+    block = build_empty_block_for_next_slot(spec, state)
+    proposer = int(block.proposer_index)
+    sig = spec.get_block_signature(state, block, privkeys[proposer])
+    domain = spec.get_domain(
+        state, spec.DOMAIN_BEACON_PROPOSER, spec.compute_epoch_at_slot(block.slot)
+    )
+    assert bls.Verify(
+        state.validators[proposer].pubkey,
+        spec.compute_signing_root(block, domain),
+        sig,
+    )
+
+
+@with_all_phases
+@always_bls
+@spec_state_test
+def test_attestation_signature_binds_target_epoch_domain(spec, state):
+    attestation = get_valid_attestation(spec, state, signed=False)
+    data = attestation.data
+    sig = spec.get_attestation_signature(state, data, privkeys[0])
+    domain = spec.get_domain(state, spec.DOMAIN_BEACON_ATTESTER, data.target.epoch)
+    assert bls.Verify(
+        state.validators[0].pubkey, spec.compute_signing_root(data, domain), sig
+    )
+
+
+@with_all_phases
+@always_bls
+@spec_state_test
+def test_slot_signature_selection_proof_domain(spec, state):
+    slot = int(state.slot)
+    sig = spec.get_slot_signature(state, slot, privkeys[0])
+    domain = spec.get_domain(
+        state, spec.DOMAIN_SELECTION_PROOF, spec.compute_epoch_at_slot(slot)
+    )
+    assert bls.Verify(
+        state.validators[0].pubkey,
+        spec.compute_signing_root(uint64(slot), domain),
+        sig,
+    )
+
+
+# == aggregation ===========================================================
+
+
+@with_phases(PRE_GLOAS)
+@spec_state_test
+def test_is_aggregator_deterministic_subset(spec, state):
+    """Selection depends only on the slot signature; some committee size
+    yields a stable aggregator subset."""
+    slot = int(state.slot)
+    committee_count = spec.get_committee_count_per_slot(
+        state, spec.get_current_epoch(state)
+    )
+    results = []
+    for index in range(committee_count):
+        sig = spec.get_slot_signature(state, slot, privkeys[index])
+        results.append(spec.is_aggregator(state, slot, index, sig))
+        # deterministic on repeat
+        assert results[-1] == spec.is_aggregator(state, slot, index, sig)
+    assert all(isinstance(r, bool) for r in results)
+
+
+@with_phases(PRE_GLOAS)
+@spec_state_test
+def test_aggregate_and_proof_roundtrip(spec, state):
+    attestation = get_valid_attestation(spec, state, signed=True)
+    proof = spec.get_aggregate_and_proof(state, 0, attestation, privkeys[0])
+    assert int(proof.aggregator_index) == 0
+    assert hash_tree_root(proof.aggregate) == hash_tree_root(attestation)
+    # selection proof is the slot signature
+    assert bytes(proof.selection_proof) == bytes(
+        spec.get_slot_signature(state, attestation.data.slot, privkeys[0])
+    )
+
+
+@with_phases(PRE_GLOAS)
+@always_bls
+@spec_state_test
+def test_aggregate_and_proof_signature_verifies(spec, state):
+    attestation = get_valid_attestation(spec, state, signed=True)
+    proof = spec.get_aggregate_and_proof(state, 0, attestation, privkeys[0])
+    sig = spec.get_aggregate_and_proof_signature(state, proof, privkeys[0])
+    domain = spec.get_domain(
+        state,
+        spec.DOMAIN_AGGREGATE_AND_PROOF,
+        spec.compute_epoch_at_slot(attestation.data.slot),
+    )
+    assert bls.Verify(
+        state.validators[0].pubkey, spec.compute_signing_root(proof, domain), sig
+    )
+
+
+# == state root / subnets ==================================================
+
+
+@with_all_phases
+@spec_state_test
+def test_compute_new_state_root_matches_transition(spec, state):
+    block = build_empty_block_for_next_slot(spec, state)
+    root = spec.compute_new_state_root(state, block)
+    post = state.copy()
+    spec.state_transition(
+        post, spec.SignedBeaconBlock(message=block), validate_result=False
+    )
+    assert root == hash_tree_root(post)
+    # the original state is untouched
+    assert int(state.slot) == int(block.slot) - 1
+
+
+@with_all_phases
+@spec_state_test
+def test_compute_subnet_for_attestation_bounds(spec, state):
+    epoch = spec.get_current_epoch(state)
+    committees_per_slot = spec.get_committee_count_per_slot(state, epoch)
+    for slot in range(int(state.slot), int(state.slot) + int(spec.SLOTS_PER_EPOCH)):
+        for index in range(committees_per_slot):
+            subnet = spec.compute_subnet_for_attestation(
+                committees_per_slot, slot, index
+            )
+            assert 0 <= int(subnet) < int(spec.config.ATTESTATION_SUBNET_COUNT)
+
+
+@with_all_phases
+@spec_state_test
+def test_subscribed_subnets_deterministic_window(spec, state):
+    node_id = 123456789
+    epoch = 42
+    subnets = spec.compute_subscribed_subnets(node_id, epoch)
+    assert subnets == spec.compute_subscribed_subnets(node_id, epoch)
+    assert len(subnets) == int(spec.config.SUBNETS_PER_NODE)
+    assert all(0 <= int(s) < int(spec.config.ATTESTATION_SUBNET_COUNT) for s in subnets)
