@@ -215,12 +215,16 @@ def bench_das_fft(batch: int = 16, n: int = 8192, chain: int = 8) -> tuple[float
 
 
 def bench_batch_verify(n_aggregates: int = 16, committee: int = 8) -> tuple[float, float]:
-    """Secondary: aggregate-signature batch verification throughput under
-    the tpu backend (device G1 MSM for the RLC combine, one host pairing
-    per batch). Returns (aggregates_per_sec, seconds_per_batch)."""
+    """Secondary: aggregate-signature batch verification throughput in the
+    production-default configuration — native-C multi-Miller pairing with
+    batched tangent inversions, native hash-to-curve map stage, cached
+    pubkey decompression (crypto/signature._load_pk), one RLC pairing per
+    batch. The per-item DEVICE MSM path (bls.use_tpu) exists for meshes
+    where dispatch cost amortizes; over a tunneled single chip its
+    round-trips dominate, so benching it would measure the tunnel, not the
+    framework. Returns (aggregates_per_sec, seconds_per_batch)."""
     from eth_consensus_specs_tpu.crypto import signature as sig_mod
     from eth_consensus_specs_tpu.ops.bls_batch import batch_verify_aggregates
-    from eth_consensus_specs_tpu.utils import bls
 
     items = []
     sk = 1
@@ -232,19 +236,15 @@ def bench_batch_verify(n_aggregates: int = 16, committee: int = 8) -> tuple[floa
         sigs = [sig_mod.sign(k, msg) for k in group]
         items.append((pks, msg, sig_mod.aggregate(sigs)))
 
-    bls.use_tpu()
-    try:
-        if not batch_verify_aggregates(items):  # warm (compiles the MSM)
+    if not batch_verify_aggregates(items):  # warm (fills the pk cache)
+        raise RuntimeError("batch verification rejected valid signatures")
+    best = float("inf")
+    for _ in range(3):
+        t0 = time.perf_counter()
+        ok = batch_verify_aggregates(items)
+        best = min(best, time.perf_counter() - t0)
+        if not ok:
             raise RuntimeError("batch verification rejected valid signatures")
-        best = float("inf")
-        for _ in range(2):
-            t0 = time.perf_counter()
-            ok = batch_verify_aggregates(items)
-            best = min(best, time.perf_counter() - t0)
-            if not ok:
-                raise RuntimeError("batch verification rejected valid signatures")
-    finally:
-        bls.use_pyspec()
     return n_aggregates / best, best
 
 
@@ -290,9 +290,19 @@ def _run_section(section: str, on_cpu: bool, no_cache: bool = False) -> None:
         per_epoch_s, total_s = bench_device_resident_epochs(n_validators=n, epochs=epochs)
         payload = {"per_epoch_s": per_epoch_s, "total_s": total_s, "n": n, "epochs": epochs}
     elif section == "bls":
-        n = 4 if on_cpu else 16
+        # one block's worth of attestation aggregates — but without the
+        # native C core every hash-to-curve/Miller step is pure Python, so
+        # scale down to respect the section budget
+        from eth_consensus_specs_tpu.native import get_bls_lib
+
+        n = 64 if get_bls_lib() is not None else 4
         aggs_per_sec, batch_s = bench_batch_verify(n_aggregates=n)
-        payload = {"aggs_per_sec": aggs_per_sec, "batch_s": batch_s, "n": n}
+        payload = {
+            "aggs_per_sec": aggs_per_sec,
+            "batch_s": batch_s,
+            "n": n,
+            "pairing": "host-native-multi-miller",
+        }
     elif section == "das":
         batch = 2 if on_cpu else 16
         n = 1024 if on_cpu else 8192

@@ -287,6 +287,18 @@ def g2_to_bytes(p: Point) -> bytes:
 def g2_from_bytes(data: bytes, subgroup_check: bool = True) -> Point:
     if len(data) != 96:
         raise ValueError("G2 compressed point must be 96 bytes")
+    if subgroup_check:
+        # one native call for the common (checked) path: parse + sqrt +
+        # sign + psi subgroup check; ValueError semantics preserved.
+        # The pure path below stays the oracle (tests cross-check both).
+        from eth_consensus_specs_tpu.crypto import native_bridge as nb
+
+        if nb.enabled():
+            raw = nb.g2_decompress(bytes(data))
+            if raw is None:
+                return g2_infinity()
+            (x0, x1), (y0, y1) = raw
+            return Point(Fq2(Fq(x0), Fq(x1)), Fq2(Fq(y0), Fq(y1)), B2)
     flags = data[0]
     if not flags & 0x80:
         raise ValueError("uncompressed G2 encoding not supported")

@@ -215,12 +215,35 @@ def clear_cofactor_g2(p: Point) -> Point:
     return p.mul(H_EFF)
 
 
+def _native_map_params_blob() -> bytes:
+    """The 18 ciphersuite Fq2 constants, marshaled for the C map stage."""
+    vals = [A_PRIME, B_PRIME, Z_SSWU, *_K1, *_K2, *_K3, *_K4]
+    out = bytearray()
+    for v in vals:
+        out += v.c0.n.to_bytes(48, "big") + v.c1.n.to_bytes(48, "big")
+    return bytes(out)
+
+
 def hash_to_g2(msg: bytes, dst: bytes = DST_G2) -> Point:
     """RFC 9380 hash_to_curve for BLS12381G2_XMD:SHA-256_SSWU_RO_.
 
-    Subgroup membership of the result is structurally guaranteed by the
-    h_eff clearing validated once at import, not re-proven per call."""
+    The map stage (SSWU + isogeny + cofactor clearing) routes through the
+    native core when available — bit-identical to the Python path below
+    (the isogeny is a homomorphism, so adding on E2' before one isogeny
+    evaluation equals mapping each u then adding on E2; cross-checked in
+    tests/test_hash_to_curve.py). Subgroup membership of the result is
+    structurally guaranteed by the h_eff clearing validated at import."""
+    from eth_consensus_specs_tpu.crypto import native_bridge as nb
+
     u0, u1 = hash_to_field_fq2(msg, 2, dst)
+    if nb.enabled():
+        if not nb.g2_map_params_sent():
+            nb.g2_map_set_params(_native_map_params_blob())
+        raw = nb.g2_map_from_fields((u0.c0.n, u0.c1.n), (u1.c0.n, u1.c1.n))
+        if raw is None:
+            return Point.infinity(B2)
+        (x0, x1), (y0, y1) = raw
+        return Point(Fq2(Fq(x0), Fq(x1)), Fq2(Fq(y0), Fq(y1)), B2)
     q = map_to_curve_g2(u0) + map_to_curve_g2(u1)
     return clear_cofactor_g2(q)
 

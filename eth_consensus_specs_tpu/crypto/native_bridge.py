@@ -298,3 +298,51 @@ def pairing_gt_coeffs(g1, g2) -> list[tuple[int, int]]:
         )
         for i in range(6)
     ]
+
+
+# --- RFC 9380 G2 map stage -------------------------------------------------
+
+_map_params_sent = False
+
+
+def g2_map_set_params(blob: bytes) -> None:
+    """Ship the SSWU/isogeny ciphersuite constants (18 Fq2 values, 96 bytes
+    each: A', B', Z, K1[0..3], K2[0..2], K3[0..3], K4[0..3]) into the C
+    core. The Python copies are structurally validated at import
+    (crypto/hash_to_curve.py _validate_ciphersuite)."""
+    global _map_params_sent
+    lib = get_bls_lib()
+    assert len(blob) == 18 * 96
+    lib.bls_g2_map_set_params(_buf(blob))
+    _map_params_sent = True
+
+
+def g2_map_params_sent() -> bool:
+    return _map_params_sent
+
+
+def g2_map_from_fields(u0: tuple[int, int], u1: tuple[int, int]):
+    """SSWU + 3-isogeny + cofactor clearing for two hash_to_field outputs.
+    Returns the affine E2 point (or None for infinity)."""
+    lib = get_bls_lib()
+    buf = _b48(u0[0]) + _b48(u0[1]) + _b48(u1[0]) + _b48(u1[1])
+    out = (ctypes.c_uint8 * 192)()
+    inf = ctypes.c_uint8()
+    rc = lib.bls_g2_map_from_fields(_buf(buf), out, ctypes.byref(inf))
+    if rc != 0:
+        raise RuntimeError("bls_g2_map_from_fields called before set_params")
+    return _g2_out(out, inf)
+
+
+def g2_decompress(data: bytes):
+    """Full IETF G2 decompression (x parse + sqrt + sign + subgroup) in one
+    native call. Returns the affine point tuple, None for the canonical
+    infinity encoding; raises ValueError on malformed/out-of-subgroup input
+    (mirroring curve.g2_from_bytes)."""
+    lib = get_bls_lib()
+    out = (ctypes.c_uint8 * 192)()
+    inf = ctypes.c_uint8()
+    ok = lib.bls_g2_decompress(_buf(bytes(data)), out, ctypes.byref(inf))
+    if not ok:
+        raise ValueError("invalid G2 compressed encoding")
+    return _g2_out(out, inf)

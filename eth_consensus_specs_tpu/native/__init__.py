@@ -168,6 +168,11 @@ def get_bls_lib() -> ctypes.CDLL | None:
     lib.bls_g2_in_subgroup.argtypes = [u8p]
     lib.bls_g2_in_subgroup.restype = c.c_int
     lib.bls_g2_clear_cofactor.argtypes = [u8p, u8p, u8p]
+    lib.bls_g2_decompress.argtypes = [u8p, u8p, u8p]
+    lib.bls_g2_decompress.restype = c.c_int
+    lib.bls_g2_map_set_params.argtypes = [u8p]
+    lib.bls_g2_map_from_fields.argtypes = [u8p, u8p, u8p]
+    lib.bls_g2_map_from_fields.restype = c.c_int
     lib.bls_g1_on_curve.argtypes = [u8p]
     lib.bls_g1_on_curve.restype = c.c_int
     lib.bls_g2_on_curve.argtypes = [u8p]

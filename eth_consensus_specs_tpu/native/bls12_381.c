@@ -1494,6 +1494,262 @@ void bls_g2_clear_cofactor(const uint8_t in[192], uint8_t out[192], uint8_t *out
     g2_store(out, &ox, &oy);
 }
 
+/* G2 decompression: x from the 96-byte IETF compressed form, y via
+ * fp2_sqrt + the lexicographic-largest flag, then the psi-based subgroup
+ * check. Returns 1 ok / 0 malformed; out is the 192-byte affine point,
+ * out_inf set for the canonical infinity encoding. */
+int bls_g2_decompress(const uint8_t in[96], uint8_t out[192], uint8_t *out_inf) {
+    ensure_init();
+    int flags = in[0];
+    if (!(flags & 0x80)) return 0;
+    if (flags & 0x40) {
+        if (flags & 0x3F) return 0;
+        for (int i = 1; i < 96; i++)
+            if (in[i]) return 0;
+        memset(out, 0, 192);
+        *out_inf = 1;
+        return 1;
+    }
+    uint8_t xb[96];
+    memcpy(xb, in, 96);
+    xb[0] &= 0x1F;
+    /* canonical-range check BEFORE the Montgomery conversion */
+    {
+        /* compare both 48-byte limbs against p big-endian */
+        uint8_t pbe[48];
+        for (int i = 0; i < 6; i++)
+            for (int j = 0; j < 8; j++)
+                pbe[48 - 1 - (8 * i + j)] = (uint8_t)(FP_P[i] >> (8 * j));
+        if (memcmp(xb, pbe, 48) >= 0) return 0;      /* x.c1 (imaginary first) */
+        if (memcmp(in + 48, pbe, 48) >= 0) return 0; /* x.c0 */
+    }
+    fp2 x, y2, y;
+    /* serialization order: c1 (imaginary) first, then c0 */
+    fp_from_be(&x.c1, xb);
+    fp_from_be(&x.c0, in + 48);
+    /* y^2 = x^3 + B2 with B2 = 4 + 4u (Montgomery 4 built from one) */
+    fp2 t, b2;
+    fp2_sqr(&t, &x);
+    fp2_mul(&y2, &t, &x);
+    {
+        fp four;
+        fp_one(&four);
+        fp_add(&four, &four, &four);
+        fp_add(&four, &four, &four);
+        b2.c0 = four;
+        b2.c1 = four;
+    }
+    fp2_add(&y2, &y2, &b2);
+    if (!fp2_sqrt(&y, &y2)) return 0;
+    /* lexicographic-largest flag: compare c1 first (imaginary most
+     * significant), then c0, against (p-1)/2 — in canonical form */
+    {
+        uint8_t yb[96];
+        fp_to_be(yb, &y.c1);
+        fp_to_be(yb + 48, &y.c0);
+        /* (p-1)/2 = p >> 1 (p odd) */
+        uint64_t half[6];
+        for (int i = 0; i < 6; i++) {
+            half[i] = FP_P[i] >> 1;
+            if (i < 5) half[i] |= FP_P[i + 1] << 63;
+        }
+        uint8_t halfbe[48];
+        for (int i = 0; i < 6; i++)
+            for (int j = 0; j < 8; j++)
+                halfbe[48 - 1 - (8 * i + j)] = (uint8_t)(half[i] >> (8 * j));
+        int is_zero_c1 = 1;
+        for (int i = 0; i < 48; i++)
+            if (yb[i]) { is_zero_c1 = 0; break; }
+        int largest;
+        if (!is_zero_c1)
+            largest = memcmp(yb, halfbe, 48) > 0;
+        else
+            largest = memcmp(yb + 48, halfbe, 48) > 0;
+        int want = (flags & 0x20) ? 1 : 0;
+        if (largest != want) fp2_neg(&y, &y);
+    }
+    /* subgroup membership (psi check) */
+    {
+        uint8_t tmp[192];
+        g2_store(tmp, &x, &y);
+        if (!bls_g2_in_subgroup(tmp)) return 0;
+    }
+    g2_store(out, &x, &y);
+    *out_inf = 0;
+    return 1;
+}
+
+/* --------------------- RFC 9380 G2 map stage (SSWU + 3-isogeny) ---------
+ * The hash-to-field half (expand_message_xmd) stays in Python (hashlib's
+ * C SHA-256 is already fast); this entry performs everything after it:
+ * SSWU on E2' for both field elements, addition on E2', the 3-isogeny to
+ * E2, and Budroni-Pintore cofactor clearing. Ciphersuite parameters are
+ * marshaled once from the Python side, whose copies are structurally
+ * validated at import (crypto/hash_to_curve.py _validate_ciphersuite);
+ * cross-check tests keep the two paths bit-identical. */
+
+static fp2 MAP_A, MAP_B, MAP_Z;
+static fp2 MAP_K[15]; /* K1[0..3], K2[0..2], K3[0..3], K4[0..3] */
+static int map_params_set = 0;
+
+void bls_g2_map_set_params(const uint8_t *in /* 18 * 96 bytes */) {
+    ensure_init();
+    fp2 *dst3[3] = {&MAP_A, &MAP_B, &MAP_Z};
+    const uint8_t *p = in;
+    for (int i = 0; i < 3; i++, p += 96) {
+        fp_from_be(&dst3[i]->c0, p);
+        fp_from_be(&dst3[i]->c1, p + 48);
+    }
+    for (int i = 0; i < 15; i++, p += 96) {
+        fp_from_be(&MAP_K[i].c0, p);
+        fp_from_be(&MAP_K[i].c1, p + 48);
+    }
+    map_params_set = 1;
+}
+
+/* RFC 9380 section 4.1 sgn0 for m=2: parity of the first nonzero limb
+ * (parity read from the canonical, non-Montgomery representation). */
+static int fp2_sgn0(const fp2 *a) {
+    uint8_t b0[48], b1[48];
+    fp_to_be(b0, &a->c0);
+    fp_to_be(b1, &a->c1);
+    int zero0 = 1;
+    for (int i = 0; i < 48; i++)
+        if (b0[i]) { zero0 = 0; break; }
+    int s0 = b0[47] & 1;
+    int s1 = b1[47] & 1;
+    return s0 | (zero0 & s1);
+}
+
+/* Simplified SWU on E2' (RFC 9380 section 6.6.2), affine output. */
+static void g2_sswu(fp2 *xo, fp2 *yo, const fp2 *u) {
+    fp2 one, u2, tv1, tv2, t, x1, gx1, y;
+    fp2_one(&one);
+    fp2_sqr(&u2, u);
+    fp2_mul(&tv1, &MAP_Z, &u2);
+    fp2_sqr(&t, &tv1);
+    fp2_add(&tv2, &t, &tv1);
+    if (fp2_is_zero(&tv2)) {
+        fp2 za, zai;
+        fp2_mul(&za, &MAP_Z, &MAP_A);
+        fp2_inv(&zai, &za);
+        fp2_mul(&x1, &MAP_B, &zai);
+    } else {
+        fp2 tv2i, s, nb, ai;
+        fp2_inv(&tv2i, &tv2);
+        fp2_add(&s, &one, &tv2i);
+        fp2_neg(&nb, &MAP_B);
+        fp2_inv(&ai, &MAP_A);
+        fp2_mul(&t, &nb, &ai);
+        fp2_mul(&x1, &t, &s);
+    }
+    fp2_sqr(&t, &x1);
+    fp2_add(&t, &t, &MAP_A);
+    fp2_mul(&gx1, &t, &x1);
+    fp2_add(&gx1, &gx1, &MAP_B);
+    if (fp2_sqrt(&y, &gx1)) {
+        *xo = x1;
+    } else {
+        fp2 x2, gx2;
+        fp2_mul(&x2, &tv1, &x1);
+        fp2_sqr(&t, &x2);
+        fp2_add(&t, &t, &MAP_A);
+        fp2_mul(&gx2, &t, &x2);
+        fp2_add(&gx2, &gx2, &MAP_B);
+        fp2_sqrt(&y, &gx2); /* gx1 non-square implies gx2 square */
+        *xo = x2;
+    }
+    if (fp2_sgn0(u) != fp2_sgn0(&y)) fp2_neg(&y, &y);
+    *yo = y;
+}
+
+/* Generic affine addition on E2' (a = MAP_A). Returns 0 when the sum is
+ * the point at infinity. */
+static int eprime_add(fp2 *rx, fp2 *ry, const fp2 *ax, const fp2 *ay,
+                      const fp2 *bx, const fp2 *by) {
+    fp2 lam, num, den, t;
+    if (fp2_eq(ax, bx)) {
+        fp2 nby;
+        fp2_neg(&nby, by);
+        if (fp2_eq(ay, &nby)) return 0;
+        /* doubling: lam = (3 x^2 + A) / (2 y) */
+        fp2_sqr(&t, ax);
+        fp2_add(&num, &t, &t);
+        fp2_add(&num, &num, &t);
+        fp2_add(&num, &num, &MAP_A);
+        fp2_add(&den, ay, ay);
+    } else {
+        fp2_sub(&num, by, ay);
+        fp2_sub(&den, bx, ax);
+    }
+    fp2 deni;
+    fp2_inv(&deni, &den);
+    fp2_mul(&lam, &num, &deni);
+    fp2 x3, y3;
+    fp2_sqr(&x3, &lam);
+    fp2_sub(&x3, &x3, ax);
+    fp2_sub(&x3, &x3, bx);
+    fp2_sub(&t, ax, &x3);
+    fp2_mul(&y3, &lam, &t);
+    fp2_sub(&y3, &y3, ay);
+    *rx = x3;
+    *ry = y3;
+    return 1;
+}
+
+static void fp2_horner(fp2 *r, const fp2 *k, int n, const fp2 *x) {
+    *r = k[n - 1];
+    for (int i = n - 2; i >= 0; i--) {
+        fp2 t;
+        fp2_mul(&t, r, x);
+        fp2_add(r, &t, &k[i]);
+    }
+}
+
+/* u_in: u0.c0 | u0.c1 | u1.c0 | u1.c1, 48-byte big-endian canonical each.
+ * out: affine E2 point (192 bytes) after cofactor clearing. Returns -1 if
+ * parameters were never set, 0 otherwise. */
+int bls_g2_map_from_fields(const uint8_t u_in[192], uint8_t out[192],
+                           uint8_t *out_inf) {
+    ensure_init();
+    if (!map_params_set) return -1;
+    fp2 u0, u1, x0, y0, x1, y1, rx, ry;
+    fp_from_be(&u0.c0, u_in);
+    fp_from_be(&u0.c1, u_in + 48);
+    fp_from_be(&u1.c0, u_in + 96);
+    fp_from_be(&u1.c1, u_in + 144);
+    g2_sswu(&x0, &y0, &u0);
+    g2_sswu(&x1, &y1, &u1);
+    if (!eprime_add(&rx, &ry, &x0, &y0, &x1, &y1)) {
+        memset(out, 0, 192);
+        *out_inf = 1;
+        return 0;
+    }
+    /* 3-isogeny E2' -> E2 (a homomorphism, so adding before the map equals
+     * the per-u mapping followed by addition on E2) */
+    fp2 xn, xd, yn, yd;
+    fp2_horner(&xn, &MAP_K[0], 4, &rx);
+    fp2_horner(&xd, &MAP_K[4], 3, &rx);
+    fp2_horner(&yn, &MAP_K[7], 4, &rx);
+    fp2_horner(&yd, &MAP_K[11], 4, &rx);
+    if (fp2_is_zero(&xd) || fp2_is_zero(&yd)) {
+        /* isogeny pole = kernel point: maps to O */
+        memset(out, 0, 192);
+        *out_inf = 1;
+        return 0;
+    }
+    fp2 xdi, ydi, ex, ey, t;
+    fp2_inv(&xdi, &xd);
+    fp2_mul(&ex, &xn, &xdi);
+    fp2_inv(&ydi, &yd);
+    fp2_mul(&t, &ry, &yn);
+    fp2_mul(&ey, &t, &ydi);
+    uint8_t tmp[192];
+    g2_store(tmp, &ex, &ey);
+    bls_g2_clear_cofactor(tmp, out, out_inf);
+    return 0;
+}
+
 int bls_g1_on_curve(const uint8_t in[96]) {
     ensure_init();
     fp x, y, lhs, rhs, b;
@@ -1522,23 +1778,96 @@ int bls_g2_on_curve(const uint8_t in[192]) {
 }
 
 /* inf_flags[i]: bit0 = G1 point i at infinity, bit1 = G2 point i. */
+/* Multi-pairing: one SHARED Miller accumulator for all pairs, so the
+ * fp12 squaring per loop iteration is paid once instead of once per pair
+ * (the loop bits are identical for every pair; the accumulated product
+ * equals the product of per-pair Miller values, and the x<0 conjugation
+ * distributes over the product). The affine tangent denominators (2y,
+ * never zero in odd-order G2) of all pairs are inverted together with the
+ * Montgomery batch trick — 1 inversion + 3(m-1) muls per iteration
+ * instead of m inversions. Addition steps keep per-pair inversion: the
+ * BLS x parameter has Hamming weight 6, so they are rare. */
+typedef struct { fp px, py; e2a q, t; } mpair;
+
+/* In-place batch inversion of m nonzero values (Montgomery trick). */
+static void fp2_batch_inv(fp2 *vals, fp2 *scratch, uint64_t m) {
+    if (m == 0) return;
+    scratch[0] = vals[0];
+    for (uint64_t i = 1; i < m; i++) fp2_mul(&scratch[i], &scratch[i - 1], &vals[i]);
+    fp2 inv;
+    fp2_inv(&inv, &scratch[m - 1]);
+    for (uint64_t i = m - 1; i > 0; i--) {
+        fp2 t;
+        fp2_mul(&t, &inv, &scratch[i - 1]); /* vals[i]^-1 */
+        fp2_mul(&inv, &inv, &vals[i]);      /* running inv of prefix */
+        vals[i] = t;
+    }
+    vals[0] = inv;
+}
+
 int bls_pairing_check(uint64_t n, const uint8_t *g1s, const uint8_t *g2s,
                       const uint8_t *inf_flags) {
     ensure_init();
-    fp12 f, m;
-    fp12_one(&f);
+    mpair stack_pairs[16];
+    fp2 stack_den[2 * 16];
+    uint64_t stack_idx[16];
+    mpair *pairs = n <= 16 ? stack_pairs : malloc(n * sizeof(mpair));
+    fp2 *den = n <= 16 ? stack_den : malloc(2 * n * sizeof(fp2));
+    uint64_t *idx = n <= 16 ? stack_idx : malloc(n * sizeof(uint64_t));
+    if (pairs == NULL || den == NULL || idx == NULL) {
+        /* fail CLOSED: a check that cannot run must never report valid */
+        if (pairs != stack_pairs) free(pairs);
+        if (den != stack_den) free(den);
+        if (idx != stack_idx) free(idx);
+        return 0;
+    }
+    fp2 *scratch = den + n;
+    uint64_t live = 0;
     for (uint64_t i = 0; i < n; i++) {
         int g1_inf = inf_flags[i] & 1;
         int g2_inf = (inf_flags[i] >> 1) & 1;
         if (g1_inf || g2_inf) continue;
-        fp px, py;
-        fp2 qx, qy;
-        g1_load(&px, &py, g1s + 96 * i);
-        g2_load(&qx, &qy, g2s + 192 * i);
-        miller_loop(&m, &px, &py, 0, &qx, &qy, 0);
-        fp12_mul(&f, &f, &m);
+        mpair *m = &pairs[live++];
+        g1_load(&m->px, &m->py, g1s + 96 * i);
+        g2_load(&m->q.x, &m->q.y, g2s + 192 * i);
+        m->q.inf = 0;
+        m->t = m->q;
     }
-    return final_exp_is_one_fast(&f);
+    fp12 f;
+    fp12_one(&f);
+    for (int bit = 62; bit >= 0; bit--) {
+        fp12_sqr(&f, &f);
+        /* gather 2y denominators of the still-finite accumulators */
+        uint64_t m = 0;
+        for (uint64_t i = 0; i < live; i++) {
+            if (pairs[i].t.inf) continue;
+            fp2_add(&den[m], &pairs[i].t.y, &pairs[i].t.y);
+            idx[m++] = i;
+        }
+        fp2_batch_inv(den, scratch, m);
+        for (uint64_t j = 0; j < m; j++) {
+            mpair *p = &pairs[idx[j]];
+            fp2 num, t3, lam, tx;
+            fp2_sqr(&num, &p->t.x);
+            fp2_add(&t3, &num, &num);
+            fp2_add(&num, &t3, &num); /* 3 x^2 */
+            fp2_mul(&lam, &num, &den[j]);
+            tx = p->t.x;
+            miller_apply(&f, &p->t, &lam, &tx, &p->px, &p->py);
+        }
+        if ((BLS_X_ABS >> bit) & 1) {
+            for (uint64_t i = 0; i < live; i++) {
+                mpair *p = &pairs[i];
+                miller_step_add(&f, &p->t, &p->q, &p->px, &p->py);
+            }
+        }
+    }
+    if (pairs != stack_pairs) free(pairs);
+    if (den != stack_den) free(den);
+    if (idx != stack_idx) free(idx);
+    fp12 c;
+    fp12_conj(&c, &f);
+    return final_exp_is_one_fast(&c);
 }
 
 /* Single full pairing, result written as 12 * 48 bytes (flattened w^i

@@ -26,7 +26,7 @@ from __future__ import annotations
 import secrets
 
 from eth_consensus_specs_tpu.crypto.curve import (
-    g1_from_bytes,
+    Point,
     g1_generator,
     g1_infinity,
     g2_from_bytes,
@@ -100,15 +100,23 @@ def batch_verify_aggregates(items: list[tuple[list[bytes], bytes, bytes]]) -> bo
     """
     if not items:
         return True
+    from eth_consensus_specs_tpu.crypto.signature import _load_pk
+
     g1 = g1_generator()
     parsed = []
     for pks, msg, sig_b in items:
         if len(pks) == 0:
             return False
-        try:
-            points = [g1_from_bytes(bytes(pk)) for pk in pks]
-            if any(p.is_infinity() for p in points):
+        # _load_pk rejects malformed AND infinity keys (same outcome as the
+        # previous inline parse) and caches decompression — registry keys
+        # repeat every block, so steady-state parsing is dict lookups
+        points = []
+        for pk in pks:
+            p = _load_pk(bytes(pk))
+            if p is None:
                 return False
+            points.append(p)
+        try:
             sig = g2_from_bytes(bytes(sig_b))
         except ValueError:
             return False
@@ -125,22 +133,41 @@ def batch_verify_aggregates(items: list[tuple[list[bytes], bytes, bytes]]) -> bo
         # point-mul instead of n)
         rpk = [sum_g1_device(points).mul(r) for points, _, _, r in parsed]
     else:
+        from eth_consensus_specs_tpu.crypto import native_bridge as nb
+        from eth_consensus_specs_tpu.crypto.fields import Fq
+
         rpk = []
+        native = nb.enabled()
         for points, _, _, r in parsed:
-            aggpk = g1_infinity()
-            for p in points:
-                aggpk = aggpk + p
+            if native:
+                # one C call sums the committee (vs n affine adds, each a
+                # field inversion round-trip through the bridge)
+                raw = nb.g1_aggregate(
+                    [None if p.is_infinity() else (p.x.n, p.y.n) for p in points]
+                )
+                aggpk = (
+                    g1_infinity()
+                    if raw is None
+                    else Point(Fq(raw[0]), Fq(raw[1]), points[0].b)
+                )
+            else:
+                aggpk = g1_infinity()
+                for p in points:
+                    aggpk = aggpk + p
             rpk.append(aggpk.mul(r))
 
     # merge same-message items into one pairing input (block attestations
     # often share AttestationData): k items with m distinct messages ->
     # m+1 pairs, one hash-to-curve per distinct message
     merged: dict[bytes, object] = {}
-    sig_acc = None
     for (points, msg, sig, r), rp in zip(parsed, rpk):
         merged[msg] = rp if msg not in merged else merged[msg] + rp
-        term = sig.mul(r)
-        sig_acc = term if sig_acc is None else sig_acc + term
+    # sum_i r_i * sig_i in ONE native Pippenger MSM (64-bit scalars are
+    # always < r, so the reduced path is exact); multi_exp falls back to
+    # the bit-exact per-point path without the native core
+    from eth_consensus_specs_tpu.utils.bls import multi_exp
+
+    sig_acc = multi_exp([sig for _, _, sig, _ in parsed], [r for _, _, _, r in parsed])
     pairs = [(rp, hash_to_g2(msg)) for msg, rp in merged.items()]
     pairs.append((-g1, sig_acc))
     return _pairing_check_routed(pairs)

@@ -1,0 +1,174 @@
+"""Operations straddling a fork boundary (reference analogue:
+test/altair/transition/test_operations.py — each operation included in
+the first post-fork block, constructed against the pre-fork state — and
+test_leaking.py / test_activations_and_exits.py state-shape variants),
+generated for every mainline upgrade pair by the template machinery."""
+
+from eth_consensus_specs_tpu import ssz
+from eth_consensus_specs_tpu.forks import get_spec
+from eth_consensus_specs_tpu.test_infra.block import (
+    build_empty_block_for_next_slot,
+    state_transition_and_sign_block,
+)
+from eth_consensus_specs_tpu.test_infra.fork_transition import (
+    do_fork,
+    transition_until_fork,
+)
+from eth_consensus_specs_tpu.test_infra.genesis import create_genesis_state
+from eth_consensus_specs_tpu.test_infra.slashings import (
+    get_valid_attester_slashing,
+    get_valid_proposer_slashing,
+)
+from eth_consensus_specs_tpu.test_infra.template import for_each_upgrade
+from eth_consensus_specs_tpu.test_infra.voluntary_exits import prepare_signed_exits
+from eth_consensus_specs_tpu.utils import bls
+
+FORK_EPOCH = 2
+
+
+def _state_at_fork(pre_fork: str, post_fork: str):
+    """Pre-state advanced to the last pre-fork slot, then upgraded (no
+    boundary block — the op rides the first post-fork block)."""
+    spec = get_spec(pre_fork, "minimal")
+    state = create_genesis_state(
+        spec,
+        [int(spec.MAX_EFFECTIVE_BALANCE)] * 32,
+        int(spec.config.EJECTION_BALANCE),
+    )
+    post_spec = get_spec(post_fork, "minimal")
+    transition_until_fork(spec, state, FORK_EPOCH)
+    state, _ = do_fork(spec, post_spec, state, FORK_EPOCH, with_block=False)
+    return spec, post_spec, state
+
+
+def _apply_post_fork_block_with(post_spec, state, attach):
+    block = build_empty_block_for_next_slot(post_spec, state)
+    attach(block)
+    return state_transition_and_sign_block(post_spec, state, block)
+
+
+def _with_bls_off(fn):
+    def run():
+        prev = bls.bls_active
+        bls.bls_active = False
+        try:
+            fn()
+        finally:
+            bls.bls_active = prev
+
+    return run
+
+
+def _proposer_slashing_after_fork(pre_fork: str, post_fork: str):
+    @_with_bls_off
+    def test_fn():
+        spec, post_spec, state = _state_at_fork(pre_fork, post_fork)
+        slashing = get_valid_proposer_slashing(post_spec, state, signed_1=True, signed_2=True)
+        idx = int(slashing.signed_header_1.message.proposer_index)
+        _apply_post_fork_block_with(
+            post_spec, state, lambda b: b.body.proposer_slashings.append(slashing)
+        )
+        assert state.validators[idx].slashed
+
+    return test_fn, f"test_proposer_slashing_after_fork_{pre_fork}_to_{post_fork}"
+
+
+def _attester_slashing_after_fork(pre_fork: str, post_fork: str):
+    @_with_bls_off
+    def test_fn():
+        spec, post_spec, state = _state_at_fork(pre_fork, post_fork)
+        slashing = get_valid_attester_slashing(
+            post_spec, state, signed_1=True, signed_2=True
+        )
+        targets = set(slashing.attestation_1.attesting_indices) & set(
+            slashing.attestation_2.attesting_indices
+        )
+        assert targets
+        _apply_post_fork_block_with(
+            post_spec, state, lambda b: b.body.attester_slashings.append(slashing)
+        )
+        assert all(state.validators[int(i)].slashed for i in targets)
+
+    return test_fn, f"test_attester_slashing_after_fork_{pre_fork}_to_{post_fork}"
+
+
+def _voluntary_exit_after_fork(pre_fork: str, post_fork: str):
+    @_with_bls_off
+    def test_fn():
+        spec, post_spec, state = _state_at_fork(pre_fork, post_fork)
+        # old enough to exit
+        state.slot = max(
+            int(state.slot),
+            int(post_spec.config.SHARD_COMMITTEE_PERIOD) * post_spec.SLOTS_PER_EPOCH,
+        )
+        signed_exits = prepare_signed_exits(post_spec, state, [1])
+        _apply_post_fork_block_with(
+            post_spec, state, lambda b: b.body.voluntary_exits.append(signed_exits[0])
+        )
+        assert state.validators[1].exit_epoch != post_spec.FAR_FUTURE_EPOCH
+
+    return test_fn, f"test_voluntary_exit_after_fork_{pre_fork}_to_{post_fork}"
+
+
+def _leak_across_fork(pre_fork: str, post_fork: str):
+    @_with_bls_off
+    def test_fn():
+        """A chain leaking before the fork keeps leaking after it: the
+        finality-delay signal survives the upgrade."""
+        spec, post_spec, state = _state_at_fork(pre_fork, post_fork)
+        # no attestations before or after the boundary -> leak sets in
+        from eth_consensus_specs_tpu.test_infra.state import next_epoch
+
+        for _ in range(int(post_spec.MIN_EPOCHS_TO_INACTIVITY_PENALTY) + 2):
+            next_epoch(post_spec, state)
+        assert post_spec.is_in_inactivity_leak(state)
+        assert int(state.finalized_checkpoint.epoch) == 0
+
+    return test_fn, f"test_leak_across_fork_{pre_fork}_to_{post_fork}"
+
+
+def _exits_at_fork(pre_fork: str, post_fork: str):
+    @_with_bls_off
+    def test_fn():
+        """Validators whose exit lands AT the fork epoch leave the active
+        set under the post spec."""
+        spec = get_spec(pre_fork, "minimal")
+        state = create_genesis_state(
+            spec,
+            [int(spec.MAX_EFFECTIVE_BALANCE)] * 32,
+            int(spec.config.EJECTION_BALANCE),
+        )
+        post_spec = get_spec(post_fork, "minimal")
+        quarter = len(state.validators) // 4
+        for i in range(quarter):
+            state.validators[i].exit_epoch = FORK_EPOCH
+        transition_until_fork(spec, state, FORK_EPOCH)
+        state, _ = do_fork(spec, post_spec, state, FORK_EPOCH, with_block=False)
+        active = post_spec.get_active_validator_indices(
+            state, post_spec.get_current_epoch(state)
+        )
+        assert len(active) == len(state.validators) - quarter
+        assert all(int(i) >= quarter for i in active)
+
+    return test_fn, f"test_exits_at_fork_{pre_fork}_to_{post_fork}"
+
+
+def _historical_roots_preserved(pre_fork: str, post_fork: str):
+    @_with_bls_off
+    def test_fn():
+        """Accumulated history survives the upgrade byte-for-byte."""
+        spec, post_spec, state = _state_at_fork(pre_fork, post_fork)
+        assert int(state.fork.epoch) == FORK_EPOCH
+        # roots written before the fork are still addressable post-fork
+        root = post_spec.get_block_root_at_slot(state, int(state.slot) - 1)
+        assert bytes(root) != b"\x00" * 32
+
+    return test_fn, f"test_historical_roots_preserved_{pre_fork}_to_{post_fork}"
+
+
+for_each_upgrade(_proposer_slashing_after_fork, "altair")
+for_each_upgrade(_attester_slashing_after_fork, "altair")
+for_each_upgrade(_voluntary_exit_after_fork, "altair")
+for_each_upgrade(_leak_across_fork, "altair")
+for_each_upgrade(_exits_at_fork, "altair")
+for_each_upgrade(_historical_roots_preserved, "altair")
