@@ -289,8 +289,9 @@ def g2_from_bytes(data: bytes, subgroup_check: bool = True) -> Point:
         raise ValueError("G2 compressed point must be 96 bytes")
     if subgroup_check:
         # one native call for the common (checked) path: parse + sqrt +
-        # sign + psi subgroup check; ValueError semantics preserved.
-        # The pure path below stays the oracle (tests cross-check both).
+        # sign + psi subgroup check; ValueError semantics preserved. The
+        # pure path below stays the oracle — every accept/reject class is
+        # cross-checked in tests/test_native_g2_decompress.py.
         from eth_consensus_specs_tpu.crypto import native_bridge as nb
 
         if nb.enabled():

@@ -230,8 +230,9 @@ def hash_to_g2(msg: bytes, dst: bytes = DST_G2) -> Point:
     The map stage (SSWU + isogeny + cofactor clearing) routes through the
     native core when available — bit-identical to the Python path below
     (the isogeny is a homomorphism, so adding on E2' before one isogeny
-    evaluation equals mapping each u then adding on E2; cross-checked in
-    tests/test_hash_to_curve.py). Subgroup membership of the result is
+    evaluation equals mapping each u then adding on E2; adversarial
+    native-vs-oracle cross-checks incl. the SSWU exceptional and doubling
+    branches: tests/test_native_g2_decompress.py). Subgroup membership is
     structurally guaranteed by the h_eff clearing validated at import."""
     from eth_consensus_specs_tpu.crypto import native_bridge as nb
 

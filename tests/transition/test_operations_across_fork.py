@@ -4,7 +4,6 @@ the first post-fork block, constructed against the pre-fork state — and
 test_leaking.py / test_activations_and_exits.py state-shape variants),
 generated for every mainline upgrade pair by the template machinery."""
 
-from eth_consensus_specs_tpu import ssz
 from eth_consensus_specs_tpu.forks import get_spec
 from eth_consensus_specs_tpu.test_infra.block import (
     build_empty_block_for_next_slot,
