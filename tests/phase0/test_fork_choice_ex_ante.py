@@ -1,0 +1,206 @@
+"""Ex-ante re-org resistance scenarios: proposer boost vs attestation
+weight, and get_proposer_head with REAL vote weights (reference analogue:
+eth2spec/test/phase0/fork_choice/test_ex_ante.py and
+test_get_proposer_head.py; spec: specs/phase0/fork-choice.md proposer
+boost in get_weight + the proposer-head helper family)."""
+
+from eth_consensus_specs_tpu.test_infra.attestations import (
+    get_valid_attestation,
+    get_valid_attestations_at_slot,
+)
+from eth_consensus_specs_tpu.test_infra.block import (
+    build_empty_block_for_next_slot,
+    state_transition_and_sign_block,
+)
+from eth_consensus_specs_tpu.test_infra.context import (
+    spec_state_test,
+    with_phases,
+)
+from eth_consensus_specs_tpu.test_infra.fork_choice import (
+    add_attestation,
+    add_block,
+    get_genesis_forkchoice_store,
+    tick_and_add_block,
+    tick_to_slot,
+)
+
+PRE_GLOAS = ["phase0", "altair", "bellatrix", "capella", "deneb", "electra", "fulu"]
+
+
+def _build_child(spec, parent_state, graffiti=None):
+    st = parent_state.copy()
+    block = build_empty_block_for_next_slot(spec, st)
+    if graffiti is not None:
+        block.body.graffiti = graffiti
+    signed = state_transition_and_sign_block(spec, st, block)
+    return st, signed
+
+
+# == ex-ante scenarios =====================================================
+
+
+@with_phases(PRE_GLOAS)
+@spec_state_test
+def test_ex_ante_vanilla_boost_defends(spec, state):
+    """Two rival blocks for the same slot: only the FIRST applied earns
+    the proposer boost (first-block rule), and it keeps the head even
+    though neither branch has attestations."""
+    store, _ = get_genesis_forkchoice_store(spec, state)
+    _, signed_base = _build_child(spec, state)
+    tick_and_add_block(spec, store, signed_base)
+    base_state = state.copy()
+    spec.state_transition(base_state, signed_base, True)
+
+    # attacker's block, built for slot N+1 but revealed late
+    _, signed_attacker = _build_child(spec, base_state, graffiti=b"\xaa" * 32)
+    # honest block for the same slot
+    _, signed_honest = _build_child(spec, base_state, graffiti=b"\xcc" * 32)
+
+    slot = int(signed_honest.message.slot)
+    # tick to the slot start: the FIRST block applied gets the boost
+    tick_to_slot(spec, store, slot)
+    honest_root = add_block(spec, store, signed_honest)
+    attacker_root = add_block(spec, store, signed_attacker)  # second: no boost
+
+    assert store.proposer_boost_root == honest_root
+    assert spec.get_head_root(store) == honest_root
+    assert attacker_root != honest_root
+
+
+@with_phases(PRE_GLOAS)
+@spec_state_test
+def test_ex_ante_attestation_beats_boost(spec, state):
+    """A full-committee attestation for the rival outweighs the proposer
+    boost once applied (committee weight > boost fraction on minimal)."""
+    store, _ = get_genesis_forkchoice_store(spec, state)
+    _, signed_base = _build_child(spec, state)
+    tick_and_add_block(spec, store, signed_base)
+    base_state = state.copy()
+    spec.state_transition(base_state, signed_base, True)
+
+    # rival B at slot N+1; honest C at slot N+2 (reference shape: both are
+    # received at N+2, C first, so C carries a LIVE boost when B's votes
+    # arrive)
+    rival_state, signed_rival = _build_child(spec, base_state, graffiti=b"\xbb" * 32)
+    honest_state = base_state.copy()
+    spec.process_slots(honest_state, int(base_state.slot) + 1)
+    _, signed_honest = _build_child(spec, honest_state, graffiti=b"\xcc" * 32)
+
+    slot_c = int(signed_honest.message.slot)
+    tick_to_slot(spec, store, slot_c)
+    honest_root = add_block(spec, store, signed_honest)  # timely: boosted
+    rival_root = add_block(spec, store, signed_rival)
+    assert spec.get_head_root(store) == honest_root
+
+    # full-slot votes for B from ITS slot (N+1 < current slot N+2, so they
+    # are valid now) outweigh C's still-active boost
+    rival_atts = get_valid_attestations_at_slot(
+        spec, rival_state, int(rival_state.slot), signed=True
+    )
+    assert store.proposer_boost_root == honest_root  # boost is live
+    for att in rival_atts:
+        add_attestation(spec, store, att)
+    assert spec.get_head_root(store) == rival_root
+
+
+@with_phases(PRE_GLOAS)
+@spec_state_test
+def test_ex_ante_sandwich_without_attestations(spec, state):
+    """Attacker reveals a withheld block AFTER the honest one in the same
+    slot: without attestations the honest boost keeps the head through the
+    next slot's proposal."""
+    store, _ = get_genesis_forkchoice_store(spec, state)
+    _, signed_base = _build_child(spec, state)
+    tick_and_add_block(spec, store, signed_base)
+    base_state = state.copy()
+    spec.state_transition(base_state, signed_base, True)
+
+    _, signed_withheld = _build_child(spec, base_state, graffiti=b"\xdd" * 32)
+    honest_state, signed_honest = _build_child(spec, base_state, graffiti=b"\xee" * 32)
+
+    slot = int(signed_honest.message.slot)
+    tick_to_slot(spec, store, slot)
+    honest_root = add_block(spec, store, signed_honest)
+    add_block(spec, store, signed_withheld)
+    assert spec.get_head_root(store) == honest_root
+
+    # next honest proposer builds on the boosted head; after its block the
+    # chain continues from honest_root
+    _, signed_next = _build_child(spec, honest_state)
+    tick_and_add_block(spec, store, signed_next)
+    head = spec.get_head_root(store)
+    assert bytes(store.blocks[head].parent_root) == bytes(honest_root)
+
+
+# == get_proposer_head with real weights ===================================
+
+
+@with_phases(PRE_GLOAS)
+@spec_state_test
+def test_proposer_head_reorgs_weak_late_head(spec, state):
+    """The positive re-org case: the parent holds a full slot of votes
+    (strong), the late head holds none (weak, boost worn off) — the next
+    proposer builds on the PARENT."""
+    store, _ = get_genesis_forkchoice_store(spec, state)
+    parent_state, signed_parent = _build_child(spec, state)
+    parent_root = tick_and_add_block(spec, store, signed_parent)
+
+    # TWO slots of full attestations (every committee) voting for the
+    # parent — the strong-parent threshold is 160% of one slot's committee
+    # weight, so a single slot of votes can never satisfy it
+    atts_parent_slot = get_valid_attestations_at_slot(
+        spec, parent_state, int(parent_state.slot), signed=True
+    )
+    empty_next = parent_state.copy()
+    spec.process_slots(empty_next, int(parent_state.slot) + 1)
+    atts_next_slot = get_valid_attestations_at_slot(
+        spec, empty_next, int(empty_next.slot), signed=True
+    )
+
+    # late head on top of the parent
+    _, signed_head = _build_child(spec, parent_state)
+    head_slot = int(signed_head.message.slot)
+    tick_to_slot(spec, store, head_slot)
+    head_root = add_block(spec, store, signed_head)
+    store.block_timeliness[head_root] = False  # arrived past the deadline
+    store.proposer_boost_root = spec.Root()  # no boost for a late block
+
+    for att in atts_parent_slot:
+        add_attestation(spec, store, att)
+    tick_to_slot(spec, store, head_slot + 1)
+    for att in atts_next_slot:
+        add_attestation(spec, store, att)
+
+    proposal_slot = head_slot + 1
+    tick_to_slot(spec, store, proposal_slot)
+    assert spec.is_shuffling_stable(proposal_slot)  # genesis+3: mid-epoch
+    assert spec.is_head_weak(store, head_root)
+    assert spec.is_parent_strong(store, parent_root)
+    assert spec.get_proposer_head(store, head_root, proposal_slot) == parent_root
+
+
+@with_phases(PRE_GLOAS)
+@spec_state_test
+def test_proposer_head_keeps_head_with_votes(spec, state):
+    """Same shape but the HEAD carries the votes: no re-org."""
+    store, _ = get_genesis_forkchoice_store(spec, state)
+    parent_state, signed_parent = _build_child(spec, state)
+    tick_and_add_block(spec, store, signed_parent)
+
+    head_state, signed_head = _build_child(spec, parent_state)
+    head_slot = int(signed_head.message.slot)
+    tick_to_slot(spec, store, head_slot)
+    head_root = add_block(spec, store, signed_head)
+    store.block_timeliness[head_root] = False
+    store.proposer_boost_root = spec.Root()
+
+    attestation = get_valid_attestation(
+        spec, head_state, slot=int(head_state.slot), signed=True
+    )
+    tick_to_slot(spec, store, head_slot + 1)
+    add_attestation(spec, store, attestation)
+
+    proposal_slot = head_slot + 1
+    assert spec.is_shuffling_stable(proposal_slot)  # genesis+3: mid-epoch
+    assert not spec.is_head_weak(store, head_root)
+    assert spec.get_proposer_head(store, head_root, proposal_slot) == head_root
