@@ -1,0 +1,133 @@
+"""Math primitive and on_tick unittables (reference analogue:
+eth2spec/test/phase0/unittests/math/test_integer_squareroot.py and
+unittests/fork_choice/test_on_tick.py; spec: specs/phase0/beacon-chain.md
+integer_squareroot, fork-choice.md on_tick)."""
+
+
+from eth_consensus_specs_tpu.test_infra.block import (
+    build_empty_block_for_next_slot,
+    state_transition_and_sign_block,
+)
+from eth_consensus_specs_tpu.test_infra.context import (
+    spec_state_test,
+    spec_test,
+    with_all_phases,
+    with_phases,
+)
+from eth_consensus_specs_tpu.test_infra.fork_choice import (
+    get_genesis_forkchoice_store,
+    tick_and_add_block,
+)
+
+UINT64_MAX = 2**64 - 1
+
+
+# == integer_squareroot ====================================================
+
+
+@with_phases(["phase0"])
+@spec_test
+def test_integer_squareroot_small_values(spec):
+    for n in range(0, 1000):
+        x = int(spec.integer_squareroot(n))
+        assert x * x <= n < (x + 1) * (x + 1)
+
+
+@with_phases(["phase0"])
+@spec_test
+def test_integer_squareroot_hits_perfect_squares(spec):
+    for r in (1, 2, 255, 65535, 2**31 - 1, 2**32 - 1):
+        assert int(spec.integer_squareroot(r * r)) == r
+
+
+@with_phases(["phase0"])
+@spec_test
+def test_integer_squareroot_large_boundaries(spec):
+    """The uint64 extremes: isqrt(2^64-1) = 2^32-1; one below/above a
+    large perfect square round correctly."""
+    assert int(spec.integer_squareroot(UINT64_MAX)) == 2**32 - 1
+    big = (2**32 - 5) ** 2
+    assert int(spec.integer_squareroot(big)) == 2**32 - 5
+    assert int(spec.integer_squareroot(big - 1)) == 2**32 - 6
+    assert int(spec.integer_squareroot(big + 1)) == 2**32 - 5
+
+
+# == on_tick ===============================================================
+
+
+@with_all_phases
+@spec_state_test
+def test_on_tick_basic_advances_time(spec, state):
+    store, _ = get_genesis_forkchoice_store(spec, state)
+    spec.on_tick(store, int(store.time) + int(spec.config.SECONDS_PER_SLOT))
+    assert spec.get_current_slot(store) == 1
+
+
+@with_all_phases
+@spec_state_test
+def test_on_tick_intra_slot_keeps_slot(spec, state):
+    store, _ = get_genesis_forkchoice_store(spec, state)
+    spec.on_tick(store, int(store.time) + 1)
+    assert spec.get_current_slot(store) == 0
+
+
+@with_all_phases
+@spec_state_test
+def test_on_tick_updates_justified_from_unrealized(spec, state):
+    """Crossing an epoch boundary promotes store.unrealized checkpoints
+    into the realized ones (reference on_tick test family)."""
+    store, _ = get_genesis_forkchoice_store(spec, state)
+    better = spec.Checkpoint(
+        epoch=int(store.justified_checkpoint.epoch) + 1,
+        root=store.justified_checkpoint.root,
+    )
+    store.unrealized_justified_checkpoint = better
+    # tick to the start of the NEXT epoch
+    next_epoch_slot = int(spec.SLOTS_PER_EPOCH)
+    spec.on_tick(
+        store,
+        int(store.genesis_time) + next_epoch_slot * int(spec.config.SECONDS_PER_SLOT),
+    )
+    assert int(store.justified_checkpoint.epoch) == int(better.epoch)
+
+
+@with_all_phases
+@spec_state_test
+def test_on_tick_mid_epoch_no_promotion(spec, state):
+    store, _ = get_genesis_forkchoice_store(spec, state)
+    before = store.justified_checkpoint.copy()
+    better = spec.Checkpoint(epoch=int(before.epoch) + 1, root=before.root)
+    store.unrealized_justified_checkpoint = better
+    spec.on_tick(
+        store, int(store.genesis_time) + 2 * int(spec.config.SECONDS_PER_SLOT)
+    )
+    assert int(store.justified_checkpoint.epoch) == int(before.epoch)
+
+
+@with_all_phases
+@spec_state_test
+def test_on_tick_earlier_time_is_plain_time_set(spec, state):
+    """The spec's on_tick does not guard against time rewinds: an earlier
+    time skips the catch-up loop and fires no slot-boundary side effects
+    (boost reset / checkpoint promotion) — byte-for-byte the reference's
+    behavior (specs/phase0/fork-choice.md:748-756)."""
+    store, _ = get_genesis_forkchoice_store(spec, state)
+    spec.on_tick(store, int(store.time) + 5 * int(spec.config.SECONDS_PER_SLOT))
+    store.proposer_boost_root = spec.Root(b"\x01" * 32)
+    before_justified = store.justified_checkpoint.copy()
+    spec.on_tick(store, int(store.time) - 1)
+    # no slot-boundary side effects fired
+    assert store.proposer_boost_root == spec.Root(b"\x01" * 32)
+    assert store.justified_checkpoint == before_justified
+
+
+@with_all_phases
+@spec_state_test
+def test_on_tick_boost_cleared_even_across_many_slots(spec, state):
+    store, _ = get_genesis_forkchoice_store(spec, state)
+    block = build_empty_block_for_next_slot(spec, state)
+    signed = state_transition_and_sign_block(spec, state, block)
+    root = tick_and_add_block(spec, store, signed)
+    assert store.proposer_boost_root == root
+    spec.on_tick(store, int(store.time) + 3 * int(spec.config.SECONDS_PER_SLOT))
+    assert store.proposer_boost_root == spec.Root()

@@ -2,6 +2,8 @@
 (reference: eth2spec/test/phase0/rewards/* via rewards/helpers; altair+
 flag-delta semantics specs/altair/beacon-chain.md:398-486)."""
 
+import pytest
+
 from eth_consensus_specs_tpu.test_infra.attestations import next_epoch_with_attestations
 from eth_consensus_specs_tpu.test_infra.context import (
     spec_state_test,
@@ -14,6 +16,7 @@ from eth_consensus_specs_tpu.test_infra.state import next_epoch
 ALTAIR_ON = ["altair", "bellatrix", "capella", "deneb", "electra", "fulu", "gloas"]
 
 
+@pytest.mark.slow  # multi-epoch full-attestation drive across the fork matrix
 @with_phases(ALTAIR_ON)
 @spec_state_test
 def test_flag_deltas_full_participation(spec, state):
@@ -54,6 +57,7 @@ def test_flag_deltas_empty_participation(spec, state):
                 assert penalties[index] > 0
 
 
+@pytest.mark.slow  # multi-epoch full-attestation drive across the fork matrix
 @with_phases(ALTAIR_ON)
 @spec_state_test
 def test_inactivity_deltas_zero_outside_leak(spec, state):
@@ -88,6 +92,7 @@ def test_inactivity_scores_grow_in_leak(spec, state):
     assert any(p > 0 for p in penalties)
 
 
+@pytest.mark.slow  # multi-epoch full-attestation drive across the fork matrix
 @with_phases(ALTAIR_ON)
 @spec_state_test
 def test_rewards_and_penalties_conservation(spec, state):
@@ -107,6 +112,7 @@ def test_rewards_and_penalties_conservation(spec, state):
     assert [int(b) for b in state.balances] == expected
 
 
+@pytest.mark.slow  # multi-epoch full-attestation drive
 @with_phases(["phase0"])
 @spec_state_test
 def test_phase0_attestation_deltas_full(spec, state):
