@@ -1,0 +1,224 @@
+"""Capella whole-block sanity: BLS-to-execution changes and withdrawals
+interacting with other operations inside full blocks (reference analogue:
+eth2spec/test/capella/sanity/test_blocks.py; spec:
+specs/capella/beacon-chain.md process_withdrawals +
+process_bls_to_execution_change inside process_operations)."""
+
+from eth_consensus_specs_tpu.ssz.hashing import hash_bytes as sha256
+from eth_consensus_specs_tpu.test_infra.block import (
+    build_empty_block_for_next_slot,
+    state_transition_and_sign_block,
+)
+from eth_consensus_specs_tpu.test_infra.context import (
+    spec_state_test,
+    with_phases,
+)
+from eth_consensus_specs_tpu.test_infra.keys import privkeys, pubkeys
+from eth_consensus_specs_tpu.test_infra.state import next_epoch, transition_to
+from eth_consensus_specs_tpu.test_infra.sync_committee import committee_indices
+from eth_consensus_specs_tpu.test_infra.voluntary_exits import sign_voluntary_exit
+from eth_consensus_specs_tpu.test_infra.withdrawals import (
+    set_validator_fully_withdrawable,
+    set_validator_partially_withdrawable,
+)
+from eth_consensus_specs_tpu.utils import bls
+
+# the BTEC/withdrawal block mechanics are capella-born and carry through
+# the execution era (electra's pending-queue variants have their own suite)
+CAPELLA_ON = ["capella", "deneb", "electra"]
+
+TO_ADDRESS = b"\x59" * 20
+
+
+def _non_sync_committee_index(spec, state) -> int:
+    """A validator outside the current sync committee: empty blocks carry a
+    zero-participation sync aggregate, which penalizes committee members
+    and would perturb exact balance assertions."""
+    members = {int(i) for i in committee_indices(spec, state)}
+    return next(i for i in range(len(state.validators)) if i not in members)
+
+
+def _set_bls_creds(spec, state, index: int):
+    state.validators[index].withdrawal_credentials = (
+        spec.BLS_WITHDRAWAL_PREFIX + sha256(bytes(pubkeys[index]))[1:]
+    )
+
+
+def _signed_change(spec, state, index: int, to_address: bytes = TO_ADDRESS):
+    change = spec.BLSToExecutionChange(
+        validator_index=index,
+        from_bls_pubkey=pubkeys[index],
+        to_execution_address=to_address,
+    )
+    domain = spec.compute_domain(
+        spec.DOMAIN_BLS_TO_EXECUTION_CHANGE,
+        genesis_validators_root=state.genesis_validators_root,
+    )
+    return spec.SignedBLSToExecutionChange(
+        message=change,
+        signature=bls.Sign(privkeys[index], spec.compute_signing_root(change, domain)),
+    )
+
+
+def _apply_block(spec, state, mutate, expect_fail=False):
+    block = build_empty_block_for_next_slot(spec, state)
+    mutate(block)
+    return state_transition_and_sign_block(spec, state, block, expect_fail=expect_fail)
+
+
+# == BTEC in blocks ========================================================
+
+
+@with_phases(CAPELLA_ON)
+@spec_state_test
+def test_block_bls_change(spec, state):
+    index = 1
+    _set_bls_creds(spec, state, index)
+    signed_change = _signed_change(spec, state, index)
+    _apply_block(spec, state, lambda b: b.body.bls_to_execution_changes.append(signed_change))
+    creds = bytes(state.validators[index].withdrawal_credentials)
+    assert creds[:1] == bytes(spec.ETH1_ADDRESS_WITHDRAWAL_PREFIX)
+    assert creds[12:] == TO_ADDRESS
+
+
+@with_phases(CAPELLA_ON)
+@spec_state_test
+def test_block_exit_and_bls_change_same_block(spec, state):
+    """A voluntary exit and a credential change for the same validator in
+    one block: both apply."""
+    index = 1
+    _set_bls_creds(spec, state, index)
+    transition_to(
+        spec,
+        state,
+        int(spec.config.SHARD_COMMITTEE_PERIOD) * int(spec.SLOTS_PER_EPOCH),
+    )
+    signed_change = _signed_change(spec, state, index)
+    exit_msg = spec.VoluntaryExit(
+        epoch=spec.get_current_epoch(state), validator_index=index
+    )
+    signed_exit = sign_voluntary_exit(spec, state, exit_msg, privkeys[index])
+
+    def mutate(b):
+        b.body.voluntary_exits.append(signed_exit)
+        b.body.bls_to_execution_changes.append(signed_change)
+
+    _apply_block(spec, state, mutate)
+    assert state.validators[index].exit_epoch != spec.FAR_FUTURE_EPOCH
+    creds = bytes(state.validators[index].withdrawal_credentials)
+    assert creds[:1] == bytes(spec.ETH1_ADDRESS_WITHDRAWAL_PREFIX)
+
+
+@with_phases(CAPELLA_ON)
+@spec_state_test
+def test_block_invalid_duplicate_bls_changes(spec, state):
+    """The same change twice in one block: second application fails (creds
+    already rotated)."""
+    index = 1
+    _set_bls_creds(spec, state, index)
+    signed_change = _signed_change(spec, state, index)
+
+    def mutate(b):
+        b.body.bls_to_execution_changes.append(signed_change)
+        b.body.bls_to_execution_changes.append(signed_change.copy())
+
+    _apply_block(spec, state, mutate, expect_fail=True)
+
+
+@with_phases(CAPELLA_ON)
+@spec_state_test
+def test_block_invalid_two_changes_different_addresses(spec, state):
+    """Two changes for one validator to different addresses in one block:
+    the second must fail against the already-rotated credential."""
+    index = 1
+    _set_bls_creds(spec, state, index)
+    change_a = _signed_change(spec, state, index, to_address=b"\x11" * 20)
+    change_b = _signed_change(spec, state, index, to_address=b"\x22" * 20)
+
+    def mutate(b):
+        b.body.bls_to_execution_changes.append(change_a)
+        b.body.bls_to_execution_changes.append(change_b)
+
+    _apply_block(spec, state, mutate, expect_fail=True)
+
+
+# == withdrawals at the epoch boundary =====================================
+
+
+@with_phases(CAPELLA_ON)
+@spec_state_test
+def test_full_withdrawal_in_epoch_transition(spec, state):
+    """A fully-withdrawable validator is swept by the first block of the
+    next epoch; its balance zeroes."""
+    index = 0
+    set_validator_fully_withdrawable(spec, state, index)
+    assert int(state.balances[index]) > 0
+
+    transition_to(
+        spec, state, int(state.slot) + int(spec.SLOTS_PER_EPOCH) - 1
+    )
+    _apply_block(spec, state, lambda b: None)
+    assert int(state.balances[index]) == 0
+
+
+@with_phases(CAPELLA_ON)
+@spec_state_test
+def test_partial_withdrawal_in_epoch_transition(spec, state):
+    """An over-cap validator sheds exactly the excess in the sweep."""
+    index = _non_sync_committee_index(spec, state)
+    excess = 1_000_000_000
+    set_validator_partially_withdrawable(spec, state, index, excess_balance=excess)
+    cap = int(state.validators[index].effective_balance)
+
+    _apply_block(spec, state, lambda b: None)
+    # swept down to the max effective balance for its credential type
+    assert int(state.balances[index]) == cap
+
+
+@with_phases(CAPELLA_ON)
+@spec_state_test
+def test_withdrawals_across_two_blocks(spec, state):
+    """The withdrawal index advances monotonically across consecutive
+    blocks sweeping different validators."""
+    set_validator_partially_withdrawable(spec, state, 0)
+    set_validator_partially_withdrawable(spec, state, 1)
+    start_index = int(state.next_withdrawal_index)
+    _apply_block(spec, state, lambda b: None)
+    mid_index = int(state.next_withdrawal_index)
+    _apply_block(spec, state, lambda b: None)
+    end_index = int(state.next_withdrawal_index)
+    assert start_index < mid_index <= end_index
+
+
+@with_phases(CAPELLA_ON)
+@spec_state_test
+def test_bls_change_then_swept_next_epoch(spec, state):
+    """A validator whose creds rotate via BTEC becomes sweepable: rotate,
+    make it over-cap, and the next epoch's block withdraws the excess."""
+    index = _non_sync_committee_index(spec, state)
+    _set_bls_creds(spec, state, index)
+    signed_change = _signed_change(spec, state, index)
+    _apply_block(spec, state, lambda b: b.body.bls_to_execution_changes.append(signed_change))
+
+    cap = int(state.validators[index].effective_balance)
+    state.balances[index] = cap + 777_000_000
+    next_epoch(spec, state)
+    # the target may have rotated INTO the new epoch's committee
+    if index in {int(i) for i in committee_indices(spec, state)}:
+        return
+    # aim the bounded sweep window (MAX_VALIDATORS_PER_WITHDRAWALS_SWEEP)
+    # at the target so one block suffices
+    state.next_withdrawal_validator_index = index
+    _apply_block(spec, state, lambda b: None)
+    assert int(state.balances[index]) == cap
+
+
+@with_phases(CAPELLA_ON)
+@spec_state_test
+def test_historical_summary_accumulates(spec, state):
+    """Crossing a SLOTS_PER_HISTORICAL_ROOT boundary appends a historical
+    summary (capella's replacement for historical roots)."""
+    period = int(spec.SLOTS_PER_HISTORICAL_ROOT)
+    before = len(state.historical_summaries)
+    transition_to(spec, state, period)
+    assert len(state.historical_summaries) == before + 1
