@@ -53,6 +53,23 @@ def backend_name() -> str:
     return _backend
 
 
+from contextlib import contextmanager
+
+
+@contextmanager
+def inactive():
+    """Stub-signature mode for the enclosed block (save/restore of the
+    bls_active kill-switch — the shared form of the toggle the scenario
+    drivers need; reference analogue: utils/bls.py bls_active handling)."""
+    global bls_active
+    prev = bls_active
+    bls_active = False
+    try:
+        yield
+    finally:
+        bls_active = prev
+
+
 def only_with_bls(alt_return=None):
     """Decorator: run the wrapped check only when bls_active (reference
     analogue: utils/bls.py:124-138)."""

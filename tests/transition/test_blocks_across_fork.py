@@ -23,23 +23,17 @@ FORK_EPOCH = 2
 
 def _pre_state(pre_fork: str, balances=None):
     spec = get_spec(pre_fork, "minimal")
-    prev = bls.bls_active
-    bls.bls_active = False
-    try:
+    with bls.inactive():
         if balances is None:
             balances = [int(spec.MAX_EFFECTIVE_BALANCE)] * 32
         state = create_genesis_state(spec, balances, int(spec.config.EJECTION_BALANCE))
-    finally:
-        bls.bls_active = prev
     return spec, state
 
 
 def _run_boundary(pre_fork, post_fork, balances=None, blocks_after=2):
     spec, state = _pre_state(pre_fork, balances)
     post_spec = get_spec(post_fork, "minimal")
-    prev = bls.bls_active
-    bls.bls_active = False
-    try:
+    with bls.inactive():
         transition_until_fork(spec, state, FORK_EPOCH)
         state, fork_block = do_fork(spec, post_spec, state, FORK_EPOCH)
         assert fork_block is not None
@@ -47,8 +41,6 @@ def _run_boundary(pre_fork, post_fork, balances=None, blocks_after=2):
         transition_to_next_epoch_and_append_blocks(
             post_spec, state, blocks, count=blocks_after
         )
-    finally:
-        bls.bls_active = prev
     return post_spec, state, blocks
 
 
@@ -89,9 +81,7 @@ def _fork_many_epochs_later(pre_fork: str, post_fork: str):
     def test_fn():
         spec, state = _pre_state(pre_fork)
         post_spec = get_spec(post_fork, "minimal")
-        prev = bls.bls_active
-        bls.bls_active = False
-        try:
+        with bls.inactive():
             late_epoch = FORK_EPOCH + 3
             for _ in range(late_epoch):
                 next_epoch(spec, state)
@@ -101,8 +91,6 @@ def _fork_many_epochs_later(pre_fork: str, post_fork: str):
             state, fork_block = do_fork(spec, post_spec, state, late_epoch + 1)
             assert int(state.fork.epoch) == late_epoch + 1
             assert fork_block is not None
-        finally:
-            bls.bls_active = prev
 
     return test_fn, f"test_fork_many_epochs_later_{pre_fork}_to_{post_fork}"
 

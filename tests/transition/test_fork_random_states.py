@@ -1,0 +1,88 @@
+"""Randomized pre-states through every mainline upgrade (reference
+analogue: the per-fork fork/test_*_fork_random.py families — randomized
+balances/exits/slashings/participation upgraded and then driven —
+generated for every upgrade pair by the template machinery). Each case
+randomizes a state, upgrades it, and drives randomized blocks on the
+post-fork spec (cheap with the BLS stub: ~0.3 s per case)."""
+
+import random
+
+from eth_consensus_specs_tpu import ssz
+from eth_consensus_specs_tpu.forks import get_spec
+from eth_consensus_specs_tpu.test_infra.fork_transition import (
+    do_fork,
+    transition_until_fork,
+)
+from eth_consensus_specs_tpu.test_infra.genesis import create_genesis_state
+from eth_consensus_specs_tpu.test_infra.template import for_each_upgrade
+from eth_consensus_specs_tpu.utils import bls
+
+from ..random.test_random_blocks import _random_chain
+from ..random.test_random_scenarios import _check_invariants, randomize_state
+
+FORK_EPOCH = 2
+
+
+def _bls_off(fn):
+    def run():
+        with bls.inactive():
+            fn()
+
+    return run
+
+
+def _upgrade_randomized(pre_fork: str, post_fork: str, seed: int, balances: str):
+    spec = get_spec(pre_fork, "minimal")
+    rng = random.Random(seed)
+    cap = int(spec.MAX_EFFECTIVE_BALANCE)
+    inc = int(spec.EFFECTIVE_BALANCE_INCREMENT)
+    low = int(spec.config.EJECTION_BALANCE)
+    if balances == "low":
+        bal = [rng.choice([low, low + inc]) for _ in range(32)]
+    elif balances == "misc":
+        bal = [rng.choice([low, cap // 2, cap, cap + inc]) for _ in range(32)]
+    else:
+        bal = [cap] * 32
+    state = create_genesis_state(spec, bal, low)
+    randomize_state(spec, state, rng)
+    post_spec = get_spec(post_fork, "minimal")
+    transition_until_fork(spec, state, FORK_EPOCH)
+    state, _ = do_fork(spec, post_spec, state, FORK_EPOCH, with_block=False)
+    return post_spec, state, rng
+
+
+def _fork_random_full(pre_fork: str, post_fork: str):
+    @_bls_off
+    def test_fn():
+        post_spec, state, rng = _upgrade_randomized(pre_fork, post_fork, 71, "full")
+        _check_invariants(post_spec, state)
+        _random_chain(post_spec, state, rng, int(post_spec.SLOTS_PER_EPOCH) + 2)
+        _check_invariants(post_spec, state)
+        # post state serializes through the post type
+        rt = ssz.deserialize(post_spec.BeaconState, ssz.serialize(state))
+        assert bytes(ssz.hash_tree_root(rt)) == bytes(ssz.hash_tree_root(state))
+
+    return test_fn, f"test_fork_random_full_{pre_fork}_to_{post_fork}"
+
+
+def _fork_random_balances(variant: str, seed: int):
+    """Factory-of-factories: one body serves every balance profile."""
+
+    def factory(pre_fork: str, post_fork: str):
+        @_bls_off
+        def test_fn():
+            post_spec, state, rng = _upgrade_randomized(
+                pre_fork, post_fork, seed, variant
+            )
+            _check_invariants(post_spec, state)
+            _random_chain(post_spec, state, rng, int(post_spec.SLOTS_PER_EPOCH))
+            _check_invariants(post_spec, state)
+
+        return test_fn, f"test_fork_random_{variant}_balances_{pre_fork}_to_{post_fork}"
+
+    return factory
+
+
+for_each_upgrade(_fork_random_full, "altair")
+for_each_upgrade(_fork_random_balances("low", 72), "altair")
+for_each_upgrade(_fork_random_balances("misc", 73), "altair")

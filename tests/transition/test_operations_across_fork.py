@@ -48,12 +48,8 @@ def _apply_post_fork_block_with(post_spec, state, attach):
 
 def _with_bls_off(fn):
     def run():
-        prev = bls.bls_active
-        bls.bls_active = False
-        try:
+        with bls.inactive():
             fn()
-        finally:
-            bls.bls_active = prev
 
     return run
 
