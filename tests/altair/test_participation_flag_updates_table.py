@@ -1,0 +1,99 @@
+"""process_participation_flag_updates shape table (reference analogue:
+eth2spec/test/altair/epoch_processing/
+test_process_participation_flag_updates.py; spec:
+specs/altair/beacon-chain.md process_participation_flag_updates — the
+epoch rotation current->previous with a zeroed current)."""
+
+import random
+
+from eth_consensus_specs_tpu.test_infra.context import spec_state_test, with_phases
+from eth_consensus_specs_tpu.test_infra.epoch_processing import (
+    run_epoch_processing_with,
+)
+from eth_consensus_specs_tpu.test_infra.state import next_epoch
+
+ALTAIR_ON = ["altair", "bellatrix", "capella", "deneb", "electra", "fulu", "gloas"]
+
+FULL_FLAGS = 0b111
+
+
+def _set_flags(state, previous, current):
+    for i in range(len(state.validators)):
+        state.previous_epoch_participation[i] = previous(i)
+        state.current_epoch_participation[i] = current(i)
+
+
+def _run_and_check(spec, state):
+    """Drive the sub-transition and assert the rotation semantics."""
+    expected_previous = [int(v) for v in state.current_epoch_participation]
+    for _ in run_epoch_processing_with(
+        spec, state, "process_participation_flag_updates"
+    ):
+        pass
+    assert [int(v) for v in state.previous_epoch_participation] == expected_previous
+    assert all(int(v) == 0 for v in state.current_epoch_participation)
+    assert len(state.current_epoch_participation) == len(state.validators)
+
+
+@with_phases(ALTAIR_ON)
+@spec_state_test
+def test_flag_rotation_all_zeroed(spec, state):
+    _set_flags(state, lambda i: 0, lambda i: 0)
+    _run_and_check(spec, state)
+
+
+@with_phases(ALTAIR_ON)
+@spec_state_test
+def test_flag_rotation_filled(spec, state):
+    _set_flags(state, lambda i: FULL_FLAGS, lambda i: FULL_FLAGS)
+    _run_and_check(spec, state)
+
+
+@with_phases(ALTAIR_ON)
+@spec_state_test
+def test_flag_rotation_previous_filled_only(spec, state):
+    """The old previous-epoch flags are DISCARDED by the rotation."""
+    _set_flags(state, lambda i: FULL_FLAGS, lambda i: 0)
+    _run_and_check(spec, state)
+
+
+@with_phases(ALTAIR_ON)
+@spec_state_test
+def test_flag_rotation_current_filled_only(spec, state):
+    _set_flags(state, lambda i: 0, lambda i: FULL_FLAGS)
+    _run_and_check(spec, state)
+
+
+@with_phases(ALTAIR_ON)
+@spec_state_test
+def test_flag_rotation_alternating_pattern(spec, state):
+    _set_flags(
+        state,
+        lambda i: FULL_FLAGS if i % 2 == 0 else 0,
+        lambda i: 0 if i % 2 == 0 else FULL_FLAGS,
+    )
+    _run_and_check(spec, state)
+
+
+@with_phases(ALTAIR_ON)
+@spec_state_test
+def test_flag_rotation_random_patterns(spec, state):
+    for seed in (10, 11, 12):
+        rng = random.Random(seed)
+        _set_flags(
+            state,
+            lambda i: rng.getrandbits(3),
+            lambda i: rng.getrandbits(3),
+        )
+        _run_and_check(spec, state)
+        next_epoch(spec, state)  # leave the boundary before the next round
+
+
+@with_phases(ALTAIR_ON)
+@spec_state_test
+def test_flag_rotation_single_bit_lanes(spec, state):
+    """Each individual flag bit survives the rotation positionally."""
+    for bit in range(3):
+        _set_flags(state, lambda i: 0, lambda i, b=bit: 1 << b)
+        _run_and_check(spec, state)
+        next_epoch(spec, state)  # leave the boundary before the next round
