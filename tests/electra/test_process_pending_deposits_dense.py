@@ -1,0 +1,236 @@
+"""Pending-deposit queue DENSE table: eth1-bridge transition gating,
+churn boundary cases, and skipped/exiting interleavings (reference
+analogue: eth2spec/test/electra/epoch_processing/pending_deposits/
+test_process_pending_deposits.py — the scenarios the basic suite in
+test_pending_deposits.py does not cover; spec:
+specs/electra/beacon-chain.md process_pending_deposits)."""
+
+from eth_consensus_specs_tpu.test_infra.context import spec_state_test, with_phases
+from eth_consensus_specs_tpu.utils import bls
+
+ELECTRA = ["electra"]
+
+
+def _pd(spec, state, index: int, amount: int, slot=None):
+    v = state.validators[index]
+    return spec.PendingDeposit(
+        pubkey=v.pubkey,
+        withdrawal_credentials=v.withdrawal_credentials,
+        amount=amount,
+        signature=bls.G2_POINT_AT_INFINITY,
+        slot=spec.GENESIS_SLOT if slot is None else slot,
+    )
+
+
+def _total_balance(state) -> int:
+    return sum(int(b) for b in state.balances)
+
+
+# == eth1-bridge transition gating =========================================
+
+
+@with_phases(ELECTRA)
+@spec_state_test
+def test_bridge_transition_pending_blocks_requests(spec, state):
+    """While eth1_deposit_index < deposit_requests_start_index, post-genesis
+    deposits (i.e. from deposit requests) stay queued."""
+    state.deposit_requests_start_index = int(state.eth1_deposit_index) + 10
+    pd = _pd(spec, state, 0, int(spec.EFFECTIVE_BALANCE_INCREMENT), slot=1)
+    state.pending_deposits.append(pd)
+    before = int(state.balances[0])
+    spec.process_pending_deposits(state)
+    assert int(state.balances[0]) == before
+    assert len(state.pending_deposits) == 1
+
+
+@with_phases(ELECTRA)
+@spec_state_test
+def test_bridge_transition_genesis_deposits_pass(spec, state):
+    """GENESIS_SLOT deposits bypass the bridge gate even mid-transition."""
+    state.deposit_requests_start_index = int(state.eth1_deposit_index) + 10
+    pd = _pd(spec, state, 0, int(spec.EFFECTIVE_BALANCE_INCREMENT))
+    state.pending_deposits.append(pd)
+    before = int(state.balances[0])
+    spec.process_pending_deposits(state)
+    assert int(state.balances[0]) == before + int(spec.EFFECTIVE_BALANCE_INCREMENT)
+    assert len(state.pending_deposits) == 0
+
+
+@with_phases(ELECTRA)
+@spec_state_test
+def test_bridge_transition_complete_requests_pass(spec, state):
+    """Once the bridge is drained (eth1_deposit_index >= start index),
+    request-era deposits process normally (up to finality)."""
+    state.deposit_requests_start_index = int(state.eth1_deposit_index)
+    state.finalized_checkpoint.epoch = 1
+    state.slot = 2 * int(spec.SLOTS_PER_EPOCH)
+    pd = _pd(spec, state, 0, int(spec.EFFECTIVE_BALANCE_INCREMENT), slot=1)
+    state.pending_deposits.append(pd)
+    before = int(state.balances[0])
+    spec.process_pending_deposits(state)
+    assert int(state.balances[0]) == before + int(spec.EFFECTIVE_BALANCE_INCREMENT)
+
+
+# == churn boundaries ======================================================
+
+
+@with_phases(ELECTRA)
+@spec_state_test
+def test_balance_exactly_equal_churn(spec, state):
+    """A deposit consuming EXACTLY the available churn processes fully
+    and leaves zero to consume."""
+    churn = int(spec.get_activation_exit_churn_limit(state))
+    state.pending_deposits.append(_pd(spec, state, 0, churn))
+    spec.process_pending_deposits(state)
+    assert len(state.pending_deposits) == 0
+    assert int(state.deposit_balance_to_consume) == 0
+
+
+@with_phases(ELECTRA)
+@spec_state_test
+def test_balance_one_above_churn_postponed(spec, state):
+    """churn+1 cannot process this epoch; the unconsumed churn carries."""
+    churn = int(spec.get_activation_exit_churn_limit(state))
+    state.pending_deposits.append(_pd(spec, state, 0, churn + 1))
+    before = int(state.balances[0])
+    spec.process_pending_deposits(state)
+    assert int(state.balances[0]) == before
+    assert len(state.pending_deposits) == 1
+    assert int(state.deposit_balance_to_consume) == churn
+
+
+@with_phases(ELECTRA)
+@spec_state_test
+def test_preexisting_churn_credit_unblocks(spec, state):
+    """deposit_balance_to_consume from an earlier epoch adds headroom."""
+    churn = int(spec.get_activation_exit_churn_limit(state))
+    state.deposit_balance_to_consume = 2
+    state.pending_deposits.append(_pd(spec, state, 0, churn + 1))
+    before = int(state.balances[0])
+    spec.process_pending_deposits(state)
+    assert int(state.balances[0]) == before + churn + 1
+    assert int(state.deposit_balance_to_consume) == 0
+
+
+@with_phases(ELECTRA)
+@spec_state_test
+def test_multiple_below_churn_all_apply(spec, state):
+    inc = int(spec.EFFECTIVE_BALANCE_INCREMENT)
+    for i in (0, 1, 2):
+        state.pending_deposits.append(_pd(spec, state, i, inc))
+    total_before = _total_balance(state)
+    spec.process_pending_deposits(state)
+    assert _total_balance(state) == total_before + 3 * inc
+    assert len(state.pending_deposits) == 0
+
+
+@with_phases(ELECTRA)
+@spec_state_test
+def test_multiple_above_churn_stops_at_boundary(spec, state):
+    """Processing stops at the FIRST deposit that would cross the limit;
+    later deposits wait even if they individually fit."""
+    churn = int(spec.get_activation_exit_churn_limit(state))
+    inc = int(spec.EFFECTIVE_BALANCE_INCREMENT)
+    state.pending_deposits.append(_pd(spec, state, 0, churn))
+    state.pending_deposits.append(_pd(spec, state, 1, churn))  # crosses
+    state.pending_deposits.append(_pd(spec, state, 2, inc))  # would fit alone
+    before_1 = int(state.balances[1])
+    before_2 = int(state.balances[2])
+    spec.process_pending_deposits(state)
+    assert int(state.balances[1]) == before_1
+    assert int(state.balances[2]) == before_2
+    assert len(state.pending_deposits) == 2
+
+
+# == exiting/withdrawn interleavings =======================================
+
+
+def _make_exiting(spec, state, index: int):
+    state.validators[index].exit_epoch = int(spec.get_current_epoch(state)) + 4
+    state.validators[index].withdrawable_epoch = int(
+        state.validators[index].exit_epoch
+    ) + int(spec.config.MIN_VALIDATOR_WITHDRAWABILITY_DELAY)
+
+
+def _make_withdrawn(spec, state, index: int):
+    state.validators[index].exit_epoch = max(int(spec.get_current_epoch(state)) - 2, 0)
+    state.validators[index].withdrawable_epoch = int(spec.get_current_epoch(state))
+
+
+@with_phases(ELECTRA)
+@spec_state_test
+def test_exiting_validator_deposit_postponed_behind_normal(spec, state):
+    """A deposit for an exiting validator is postponed to the queue TAIL;
+    deposits after it still process this epoch."""
+    inc = int(spec.EFFECTIVE_BALANCE_INCREMENT)
+    _make_exiting(spec, state, 0)
+    state.pending_deposits.append(_pd(spec, state, 0, inc))
+    state.pending_deposits.append(_pd(spec, state, 1, inc))
+    before_1 = int(state.balances[1])
+    spec.process_pending_deposits(state)
+    assert int(state.balances[1]) == before_1 + inc
+    # the postponed deposit survives at the tail
+    assert len(state.pending_deposits) == 1
+    assert bytes(state.pending_deposits[0].pubkey) == bytes(state.validators[0].pubkey)
+
+
+@with_phases(ELECTRA)
+@spec_state_test
+def test_multiple_exiting_all_postponed_in_order(spec, state):
+    inc = int(spec.EFFECTIVE_BALANCE_INCREMENT)
+    for i in (0, 1):
+        _make_exiting(spec, state, i)
+        state.pending_deposits.append(_pd(spec, state, i, inc))
+    spec.process_pending_deposits(state)
+    assert len(state.pending_deposits) == 2
+    assert bytes(state.pending_deposits[0].pubkey) == bytes(state.validators[0].pubkey)
+    assert bytes(state.pending_deposits[1].pubkey) == bytes(state.validators[1].pubkey)
+
+
+@with_phases(ELECTRA)
+@spec_state_test
+def test_mixture_skipped_and_above_churn(spec, state):
+    """An exiting-validator skip does NOT consume churn; a later over-limit
+    deposit still stops the sweep with the skip preserved."""
+    churn = int(spec.get_activation_exit_churn_limit(state))
+    _make_exiting(spec, state, 0)
+    state.pending_deposits.append(_pd(spec, state, 0, churn))  # skipped
+    state.pending_deposits.append(_pd(spec, state, 1, churn))  # consumes all churn
+    state.pending_deposits.append(_pd(spec, state, 2, churn))  # over limit now
+    before_1 = int(state.balances[1])
+    spec.process_pending_deposits(state)
+    assert int(state.balances[1]) == before_1 + churn
+    # remaining: the over-limit deposit (head) + postponed skip (tail)
+    assert len(state.pending_deposits) == 2
+    assert bytes(state.pending_deposits[0].pubkey) == bytes(state.validators[2].pubkey)
+    assert bytes(state.pending_deposits[1].pubkey) == bytes(state.validators[0].pubkey)
+
+
+@with_phases(ELECTRA)
+@spec_state_test
+def test_withdrawable_validator_bypasses_churn(spec, state):
+    """A fully-withdrawn validator's deposit applies without consuming
+    churn — the balance can never re-activate."""
+    churn = int(spec.get_activation_exit_churn_limit(state))
+    _make_withdrawn(spec, state, 0)
+    state.pending_deposits.append(_pd(spec, state, 0, churn * 2))
+    before = int(state.balances[0])
+    spec.process_pending_deposits(state)
+    assert int(state.balances[0]) == before + churn * 2
+    assert int(state.deposit_balance_to_consume) == 0
+    assert len(state.pending_deposits) == 0
+
+
+@with_phases(ELECTRA)
+@spec_state_test
+def test_withdrawable_then_normal_churn_intact(spec, state):
+    """The churn-free withdrawn-validator application leaves the full
+    budget for subsequent normal deposits."""
+    churn = int(spec.get_activation_exit_churn_limit(state))
+    _make_withdrawn(spec, state, 0)
+    state.pending_deposits.append(_pd(spec, state, 0, churn))
+    state.pending_deposits.append(_pd(spec, state, 1, churn))
+    before_1 = int(state.balances[1])
+    spec.process_pending_deposits(state)
+    assert int(state.balances[1]) == before_1 + churn
+    assert len(state.pending_deposits) == 0
