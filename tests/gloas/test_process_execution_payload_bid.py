@@ -147,3 +147,185 @@ def test_bid_wrong_slot_invalid(spec, state):
     block = _prepared_block(spec, state)
     block.body.signed_execution_payload_bid.message.slot = int(block.slot) + 1
     expect_assertion_error(lambda: spec.process_execution_payload_bid(state, block))
+
+
+# == round-4 extensions: balance boundaries with outstanding obligations ===
+
+
+def _builder_bid(spec, state, block, builder_index: int, value: int):
+    bid = block.body.signed_execution_payload_bid.message
+    bid.builder_index = builder_index
+    bid.value = value
+    block.body.signed_execution_payload_bid = spec.SignedExecutionPayloadBid(
+        message=bid, signature=b"\x00" * 96
+    )
+    return bid
+
+
+@with_phases(["gloas"])
+@spec_state_test
+def test_builder_bid_zero_value_valid(spec, state):
+    """An external builder may bid zero: no payment is recorded but the
+    bid is committed."""
+    block = _prepared_block(spec, state)
+    builder_index = (int(block.proposer_index) + 1) % len(state.validators)
+    _make_builder(spec, state, builder_index, 2 * spec.MIN_ACTIVATION_BALANCE)
+    bid = _builder_bid(spec, state, block, builder_index, 0)
+    payments_before = state.builder_pending_payments.copy()
+    spec.process_execution_payload_bid(state, block)
+    assert state.latest_execution_payload_bid == bid
+    assert state.builder_pending_payments == payments_before
+
+
+@with_phases(["gloas"])
+@spec_state_test
+def test_builder_bid_inactive_builder_invalid(spec, state):
+    block = _prepared_block(spec, state)
+    builder_index = (int(block.proposer_index) + 1) % len(state.validators)
+    _make_builder(spec, state, builder_index, 2 * spec.MIN_ACTIVATION_BALANCE)
+    state.validators[builder_index].activation_epoch = (
+        spec.get_current_epoch(state) + 1
+    )
+    _builder_bid(spec, state, block, builder_index, spec.EFFECTIVE_BALANCE_INCREMENT)
+    expect_assertion_error(lambda: spec.process_execution_payload_bid(state, block))
+
+
+@with_phases(["gloas"])
+@spec_state_test
+def test_builder_bid_exact_balance_boundary(spec, state):
+    """balance == value + MIN_ACTIVATION_BALANCE is exactly sufficient;
+    one Gwei less is not."""
+    pristine = state.copy()  # BEFORE part 1 dirties payments/bid state
+    block = _prepared_block(spec, state)
+    builder_index = (int(block.proposer_index) + 1) % len(state.validators)
+    value = int(spec.EFFECTIVE_BALANCE_INCREMENT)
+    _make_builder(
+        spec, state, builder_index, value + int(spec.MIN_ACTIVATION_BALANCE)
+    )
+    _builder_bid(spec, state, block, builder_index, value)
+    spec.process_execution_payload_bid(state, block)
+
+    # fresh pristine state, one Gwei short — no carried pending payment
+    state2 = pristine
+    block2 = _prepared_block(spec, state2)
+    builder2 = (int(block2.proposer_index) + 1) % len(state2.validators)
+    _make_builder(
+        spec, state2, builder2, value + int(spec.MIN_ACTIVATION_BALANCE) - 1
+    )
+    _builder_bid(spec, state2, block2, builder2, value)
+    expect_assertion_error(lambda: spec.process_execution_payload_bid(state2, block2))
+
+
+@with_phases(["gloas"])
+@spec_state_test
+def test_builder_bid_insufficient_with_pending_payments(spec, state):
+    """Outstanding pending payments count against the builder's cover."""
+    block = _prepared_block(spec, state)
+    builder_index = (int(block.proposer_index) + 1) % len(state.validators)
+    value = int(spec.EFFECTIVE_BALANCE_INCREMENT)
+    _make_builder(
+        spec, state, builder_index, value + int(spec.MIN_ACTIVATION_BALANCE)
+    )
+    # an outstanding payment eats the headroom
+    state.builder_pending_payments[0] = spec.BuilderPendingPayment(
+        weight=0,
+        withdrawal=spec.BuilderPendingWithdrawal(
+            fee_recipient=b"\x42" * 20,
+            amount=1,
+            builder_index=builder_index,
+            withdrawable_epoch=spec.FAR_FUTURE_EPOCH,
+        ),
+    )
+    _builder_bid(spec, state, block, builder_index, value)
+    expect_assertion_error(lambda: spec.process_execution_payload_bid(state, block))
+
+
+@with_phases(["gloas"])
+@spec_state_test
+def test_builder_bid_sufficient_with_pending_payments(spec, state):
+    block = _prepared_block(spec, state)
+    builder_index = (int(block.proposer_index) + 1) % len(state.validators)
+    value = int(spec.EFFECTIVE_BALANCE_INCREMENT)
+    outstanding = 5
+    _make_builder(
+        spec,
+        state,
+        builder_index,
+        value + outstanding + int(spec.MIN_ACTIVATION_BALANCE),
+    )
+    state.builder_pending_payments[0] = spec.BuilderPendingPayment(
+        weight=0,
+        withdrawal=spec.BuilderPendingWithdrawal(
+            fee_recipient=b"\x42" * 20,
+            amount=outstanding,
+            builder_index=builder_index,
+            withdrawable_epoch=spec.FAR_FUTURE_EPOCH,
+        ),
+    )
+    _builder_bid(spec, state, block, builder_index, value)
+    spec.process_execution_payload_bid(state, block)  # must not raise
+
+
+@with_phases(["gloas"])
+@spec_state_test
+def test_builder_bid_insufficient_with_pending_withdrawals(spec, state):
+    """Queued builder withdrawals also count against the cover."""
+    block = _prepared_block(spec, state)
+    builder_index = (int(block.proposer_index) + 1) % len(state.validators)
+    value = int(spec.EFFECTIVE_BALANCE_INCREMENT)
+    _make_builder(
+        spec, state, builder_index, value + int(spec.MIN_ACTIVATION_BALANCE)
+    )
+    state.builder_pending_withdrawals.append(
+        spec.BuilderPendingWithdrawal(
+            fee_recipient=b"\x42" * 20,
+            amount=1,
+            builder_index=builder_index,
+            withdrawable_epoch=spec.FAR_FUTURE_EPOCH,
+        )
+    )
+    _builder_bid(spec, state, block, builder_index, value)
+    expect_assertion_error(lambda: spec.process_execution_payload_bid(state, block))
+
+
+@with_phases(["gloas"])
+@spec_state_test
+def test_builder_bid_sufficient_with_pending_withdrawals(spec, state):
+    block = _prepared_block(spec, state)
+    builder_index = (int(block.proposer_index) + 1) % len(state.validators)
+    value = int(spec.EFFECTIVE_BALANCE_INCREMENT)
+    outstanding = 7
+    _make_builder(
+        spec,
+        state,
+        builder_index,
+        value + outstanding + int(spec.MIN_ACTIVATION_BALANCE),
+    )
+    state.builder_pending_withdrawals.append(
+        spec.BuilderPendingWithdrawal(
+            fee_recipient=b"\x42" * 20,
+            amount=outstanding,
+            builder_index=builder_index,
+            withdrawable_epoch=spec.FAR_FUTURE_EPOCH,
+        )
+    )
+    _builder_bid(spec, state, block, builder_index, value)
+    spec.process_execution_payload_bid(state, block)  # must not raise
+
+
+@with_phases(["gloas"])
+@spec_state_test
+def test_bid_wrong_parent_block_root_invalid(spec, state):
+    block = _prepared_block(spec, state)
+    bid = block.body.signed_execution_payload_bid.message
+    bid.parent_block_root = b"\x66" * 32
+    expect_assertion_error(lambda: spec.process_execution_payload_bid(state, block))
+
+
+@with_phases(["gloas"])
+@spec_state_test
+def test_bid_wrong_prev_randao_invalid(spec, state):
+    block = _prepared_block(spec, state)
+    bid = block.body.signed_execution_payload_bid.message
+    bid.prev_randao = b"\x77" * 32
+    expect_assertion_error(lambda: spec.process_execution_payload_bid(state, block))

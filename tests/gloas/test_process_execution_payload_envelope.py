@@ -123,3 +123,136 @@ def test_envelope_queues_builder_payment(spec, state):
     assert int(w.withdrawable_epoch) < spec.FAR_FUTURE_EPOCH
     # the slot's payment box is cleared
     assert int(state.builder_pending_payments[payment_index].withdrawal.amount) == 0
+
+
+# == round-4 extensions: remaining consistency checks ======================
+
+
+def _envelope_after_bid(spec, state):
+    _state_with_committed_bid(spec, state)
+    return build_signed_execution_payload_envelope(spec, state)
+
+
+@with_phases(["gloas"])
+@spec_state_test
+def test_envelope_wrong_gas_limit_invalid(spec, state):
+    env = _envelope_after_bid(spec, state)
+    env.message.payload.gas_limit = int(env.message.payload.gas_limit) + 1
+    expect_assertion_error(
+        lambda: spec.process_execution_payload(state, env, spec.EXECUTION_ENGINE)
+    )
+
+
+@with_phases(["gloas"])
+@spec_state_test
+def test_envelope_wrong_parent_hash_invalid(spec, state):
+    env = _envelope_after_bid(spec, state)
+    env.message.payload.parent_hash = b"\x99" * 32
+    expect_assertion_error(
+        lambda: spec.process_execution_payload(state, env, spec.EXECUTION_ENGINE)
+    )
+
+
+@with_phases(["gloas"])
+@spec_state_test
+def test_envelope_wrong_prev_randao_invalid(spec, state):
+    env = _envelope_after_bid(spec, state)
+    env.message.payload.prev_randao = b"\x88" * 32
+    expect_assertion_error(
+        lambda: spec.process_execution_payload(state, env, spec.EXECUTION_ENGINE)
+    )
+
+
+@with_phases(["gloas"])
+@spec_state_test
+def test_envelope_wrong_timestamp_invalid(spec, state):
+    env = _envelope_after_bid(spec, state)
+    env.message.payload.timestamp = int(env.message.payload.timestamp) + 1
+    expect_assertion_error(
+        lambda: spec.process_execution_payload(state, env, spec.EXECUTION_ENGINE)
+    )
+
+
+@with_phases(["gloas"])
+@spec_state_test
+def test_envelope_wrong_beacon_block_root_invalid(spec, state):
+    env = _envelope_after_bid(spec, state)
+    env.message.beacon_block_root = b"\x55" * 32
+    expect_assertion_error(
+        lambda: spec.process_execution_payload(state, env, spec.EXECUTION_ENGINE)
+    )
+
+
+@with_phases(["gloas"])
+@spec_state_test
+def test_envelope_engine_rejection_invalid(spec, state):
+    """The engine's verdict gates the import (invalid EL payload)."""
+    env = _envelope_after_bid(spec, state)
+
+    class _Rejecting:
+        def verify_and_notify_new_payload(self, request) -> bool:
+            return False
+
+    expect_assertion_error(
+        lambda: spec.process_execution_payload(state, env, _Rejecting())
+    )
+
+
+@with_phases(["gloas"])
+@spec_state_test
+def test_envelope_too_many_blob_commitments_invalid(spec, state):
+    """Commitment count above the epoch's blob cap fails even when the
+    committed bid agreed to it (the cap is a consensus rule)."""
+    _state_with_committed_bid(spec, state)
+    # freeze the header root first: later bid mutation must not shift the
+    # beacon_block_root the envelope binds to
+    state.latest_block_header.state_root = hash_tree_root(state)
+
+    cap = int(spec.get_blob_parameters(spec.get_current_epoch(state)).max_blobs_per_block)
+    oversized = spec.ExecutionPayloadEnvelope().blob_kzg_commitments
+    for _ in range(cap + 1):
+        oversized.append(b"\xc0" + b"\x00" * 47)
+    bid = state.latest_execution_payload_bid
+    bid.blob_kzg_commitments_root = hash_tree_root(oversized)
+
+    # hand-built envelope (the normal builder's dry run would itself trip
+    # the cap): every check BEFORE the cap assert is satisfied, and the
+    # state_root check sits after it, so only the cap can fail
+    payload = spec.ExecutionPayload(
+        parent_hash=state.latest_block_hash,
+        fee_recipient=bid.fee_recipient,
+        prev_randao=bid.prev_randao,
+        block_number=1,
+        gas_limit=bid.gas_limit,
+        gas_used=0,
+        timestamp=spec.compute_timestamp_at_slot(state, state.slot),
+        base_fee_per_gas=0,
+        block_hash=bid.block_hash,
+        transactions=[],
+        withdrawals=[],
+    )
+    env = spec.SignedExecutionPayloadEnvelope(
+        message=spec.ExecutionPayloadEnvelope(
+            payload=payload,
+            builder_index=bid.builder_index,
+            beacon_block_root=hash_tree_root(state.latest_block_header),
+            slot=state.slot,
+            blob_kzg_commitments=oversized,
+        )
+    )
+    expect_assertion_error(
+        lambda: spec.process_execution_payload(state, env, spec.EXECUTION_ENGINE)
+    )
+
+
+@with_phases(["gloas"])
+@spec_state_test
+def test_envelope_self_build_zero_value_no_payment(spec, state):
+    """A self-build import must leave the builder payment queues alone."""
+    _state_with_committed_bid(spec, state)
+    payments_before = state.builder_pending_payments.copy()
+    withdrawals_before = len(state.builder_pending_withdrawals)
+    env = build_signed_execution_payload_envelope(spec, state)
+    spec.process_execution_payload(state, env, spec.EXECUTION_ENGINE)
+    assert state.builder_pending_payments == payments_before
+    assert len(state.builder_pending_withdrawals) == withdrawals_before
