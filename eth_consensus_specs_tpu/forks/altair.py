@@ -108,6 +108,96 @@ class AltairSpec(LightClientMixin, Phase0Spec):
             for index in sync_committee_indices
         }
 
+    # == sync-committee duties (specs/altair/validator.md:347-560) =========
+
+    def get_sync_committee_message(
+        self, state, block_root, validator_index: int, privkey: int
+    ):
+        """specs/altair/validator.md:347-361."""
+        epoch = self.get_current_epoch(state)
+        domain = self.get_domain(state, self.DOMAIN_SYNC_COMMITTEE, epoch)
+        signing_root = self.compute_signing_root(Root(block_root), domain)
+        return self.SyncCommitteeMessage(
+            slot=state.slot,
+            beacon_block_root=block_root,
+            validator_index=validator_index,
+            signature=bls.Sign(privkey, signing_root),
+        )
+
+    def get_sync_committee_selection_proof(
+        self, state, slot: int, subcommittee_index: int, privkey: int
+    ):
+        """specs/altair/validator.md:425-435."""
+        domain = self.get_domain(
+            state,
+            self.DOMAIN_SYNC_COMMITTEE_SELECTION_PROOF,
+            self.compute_epoch_at_slot(slot),
+        )
+        signing_data = self.SyncAggregatorSelectionData(
+            slot=slot, subcommittee_index=subcommittee_index
+        )
+        return bls.Sign(privkey, self.compute_signing_root(signing_data, domain))
+
+    def is_sync_committee_aggregator(self, signature) -> bool:
+        """specs/altair/validator.md:438-446."""
+        modulo = max(
+            1,
+            self.SYNC_COMMITTEE_SIZE
+            // self.SYNC_COMMITTEE_SUBNET_COUNT
+            // self.TARGET_AGGREGATORS_PER_SYNC_SUBCOMMITTEE,
+        )
+        return self.bytes_to_uint64(self.hash(bytes(signature))[0:8]) % modulo == 0
+
+    def get_contribution_and_proof(
+        self, state, aggregator_index: int, contribution, privkey: int
+    ):
+        """specs/altair/validator.md:528-545."""
+        selection_proof = self.get_sync_committee_selection_proof(
+            state,
+            contribution.slot,
+            contribution.subcommittee_index,
+            privkey,
+        )
+        return self.ContributionAndProof(
+            aggregator_index=aggregator_index,
+            contribution=contribution,
+            selection_proof=selection_proof,
+        )
+
+    def get_contribution_and_proof_signature(
+        self, state, contribution_and_proof, privkey: int
+    ):
+        """specs/altair/validator.md:551-560."""
+        contribution = contribution_and_proof.contribution
+        domain = self.get_domain(
+            state,
+            self.DOMAIN_CONTRIBUTION_AND_PROOF,
+            self.compute_epoch_at_slot(contribution.slot),
+        )
+        return bls.Sign(
+            privkey, self.compute_signing_root(contribution_and_proof, domain)
+        )
+
+    def process_sync_committee_contributions(self, block, contributions) -> None:
+        """Fold per-subnet contributions into the block's SyncAggregate
+        (specs/altair/validator.md:271-289)."""
+        sync_aggregate = self.SyncAggregate()
+        signatures = []
+        sync_subcommittee_size = (
+            self.SYNC_COMMITTEE_SIZE // self.SYNC_COMMITTEE_SUBNET_COUNT
+        )
+        for contribution in contributions:
+            subcommittee_index = int(contribution.subcommittee_index)
+            for index, participated in enumerate(contribution.aggregation_bits):
+                if participated:
+                    participant_index = (
+                        sync_subcommittee_size * subcommittee_index + index
+                    )
+                    sync_aggregate.sync_committee_bits[participant_index] = True
+            signatures.append(contribution.signature)
+        sync_aggregate.sync_committee_signature = bls.Aggregate(signatures)
+        block.body.sync_aggregate = sync_aggregate
+
     # == type system ======================================================
 
     def _build_types(self) -> None:

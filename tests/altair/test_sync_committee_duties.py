@@ -1,0 +1,204 @@
+"""Altair sync-committee validator duties (reference analogue:
+eth2spec/test/altair/unittests/validator/test_validator.py; spec:
+specs/altair/validator.md — messages, selection proofs, aggregator
+selection, contributions, contribution-and-proof envelopes)."""
+
+from eth_consensus_specs_tpu.ssz import hash_tree_root
+from eth_consensus_specs_tpu.test_infra.context import (
+    always_bls,
+    spec_state_test,
+    with_phases,
+)
+from eth_consensus_specs_tpu.test_infra.keys import privkeys
+from eth_consensus_specs_tpu.test_infra.sync_committee import committee_indices
+from eth_consensus_specs_tpu.utils import bls
+
+ALTAIR_ON = ["altair", "bellatrix", "capella", "deneb", "electra", "fulu"]
+
+
+def _subcommittee_size(spec) -> int:
+    return int(spec.SYNC_COMMITTEE_SIZE) // int(spec.SYNC_COMMITTEE_SUBNET_COUNT)
+
+
+# == sync committee messages ===============================================
+
+
+@with_phases(ALTAIR_ON)
+@always_bls
+@spec_state_test
+def test_sync_committee_message_verifies(spec, state):
+    root = b"\x12" * 32
+    msg = spec.get_sync_committee_message(state, root, 0, privkeys[0])
+    assert int(msg.slot) == int(state.slot)
+    assert bytes(msg.beacon_block_root) == root
+    domain = spec.get_domain(
+        state, spec.DOMAIN_SYNC_COMMITTEE, spec.get_current_epoch(state)
+    )
+    signing_root = spec.compute_signing_root(spec.Root(root), domain)
+    assert bls.Verify(state.validators[0].pubkey, signing_root, msg.signature)
+
+
+# == selection proofs and aggregator selection =============================
+
+
+@with_phases(ALTAIR_ON)
+@always_bls
+@spec_state_test
+def test_selection_proof_binds_slot_and_subcommittee(spec, state):
+    proof_a = spec.get_sync_committee_selection_proof(state, 0, 0, privkeys[0])
+    proof_b = spec.get_sync_committee_selection_proof(state, 0, 1, privkeys[0])
+    proof_c = spec.get_sync_committee_selection_proof(state, 1, 0, privkeys[0])
+    assert len({bytes(proof_a), bytes(proof_b), bytes(proof_c)}) == 3
+    domain = spec.get_domain(
+        state, spec.DOMAIN_SYNC_COMMITTEE_SELECTION_PROOF, spec.compute_epoch_at_slot(0)
+    )
+    data = spec.SyncAggregatorSelectionData(slot=0, subcommittee_index=0)
+    assert bls.Verify(
+        state.validators[0].pubkey,
+        spec.compute_signing_root(data, domain),
+        proof_a,
+    )
+
+
+@with_phases(ALTAIR_ON)
+@spec_state_test
+def test_sync_aggregator_selection_deterministic(spec, state):
+    """Selection is a pure function of the proof bytes with the spec's
+    modulo (minimal: subcommittee 8 / target 16 -> modulo 1: everyone)."""
+    modulo = max(
+        1,
+        _subcommittee_size(spec) // int(spec.TARGET_AGGREGATORS_PER_SYNC_SUBCOMMITTEE),
+    )
+    results = []
+    for i in range(8):
+        sig = spec.get_sync_committee_selection_proof(state, 0, 0, privkeys[i])
+        got = spec.is_sync_committee_aggregator(sig)
+        assert got == spec.is_sync_committee_aggregator(sig)
+        results.append(got)
+    if modulo == 1:
+        assert all(results)
+
+
+# == contributions =========================================================
+
+
+def _full_contribution(spec, state, subcommittee_index=0, block_root=b"\x34" * 32):
+    size = _subcommittee_size(spec)
+    members = committee_indices(spec, state)[
+        subcommittee_index * size : (subcommittee_index + 1) * size
+    ]
+    sigs = []
+    contribution = spec.SyncCommitteeContribution(
+        slot=state.slot,
+        beacon_block_root=block_root,
+        subcommittee_index=subcommittee_index,
+    )
+    for pos, validator_index in enumerate(members):
+        contribution.aggregation_bits[pos] = True
+        msg = spec.get_sync_committee_message(
+            state, block_root, validator_index, privkeys[int(validator_index)]
+        )
+        sigs.append(msg.signature)
+    contribution.signature = bls.Aggregate(sigs)
+    return contribution
+
+
+@with_phases(ALTAIR_ON)
+@spec_state_test
+def test_process_sync_committee_contributions_sets_bits(spec, state):
+    """One full contribution per subnet reassembles the FULL aggregate."""
+    block = spec.BeaconBlock(slot=state.slot)
+    contributions = [
+        _full_contribution(spec, state, subcommittee_index=i)
+        for i in range(int(spec.SYNC_COMMITTEE_SUBNET_COUNT))
+    ]
+    spec.process_sync_committee_contributions(block, contributions)
+    agg = block.body.sync_aggregate
+    assert all(bool(b) for b in agg.sync_committee_bits)
+
+
+@with_phases(ALTAIR_ON)
+@spec_state_test
+def test_process_contributions_partial_subnets(spec, state):
+    """A single subnet's contribution sets exactly its bit window."""
+    block = spec.BeaconBlock(slot=state.slot)
+    sub = 1
+    spec.process_sync_committee_contributions(
+        block, [_full_contribution(spec, state, subcommittee_index=sub)]
+    )
+    size = _subcommittee_size(spec)
+    bits = block.body.sync_aggregate.sync_committee_bits
+    for i in range(int(spec.SYNC_COMMITTEE_SIZE)):
+        expected = sub * size <= i < (sub + 1) * size
+        assert bool(bits[i]) == expected
+
+
+@with_phases(ALTAIR_ON)
+@always_bls
+@spec_state_test
+def test_contribution_roundtrip_through_sync_aggregate_processing(spec, state):
+    """Contributions assembled by the duty pipeline verify as a real
+    block-level sync aggregate."""
+    from eth_consensus_specs_tpu.test_infra.state import next_slot
+    from eth_consensus_specs_tpu.test_infra.sync_committee import (
+        build_root_for_current_slot,
+    )
+
+    next_slot(spec, state)  # genesis slot has no previous block root
+    root = build_root_for_current_slot(spec, state)
+    block = spec.BeaconBlock(slot=state.slot)
+    contributions = [
+        _full_contribution(spec, state, subcommittee_index=i, block_root=root)
+        for i in range(int(spec.SYNC_COMMITTEE_SUBNET_COUNT))
+    ]
+    spec.process_sync_committee_contributions(block, contributions)
+    spec.process_sync_aggregate(state, block.body.sync_aggregate)
+
+
+# == contribution-and-proof envelopes ======================================
+
+
+@with_phases(ALTAIR_ON)
+@spec_state_test
+def test_contribution_and_proof_carries_selection(spec, state):
+    contribution = _full_contribution(spec, state)
+    cap = spec.get_contribution_and_proof(state, 5, contribution, privkeys[5])
+    assert int(cap.aggregator_index) == 5
+    assert hash_tree_root(cap.contribution) == hash_tree_root(contribution)
+    assert bytes(cap.selection_proof) == bytes(
+        spec.get_sync_committee_selection_proof(
+            state, contribution.slot, contribution.subcommittee_index, privkeys[5]
+        )
+    )
+
+
+@with_phases(ALTAIR_ON)
+@always_bls
+@spec_state_test
+def test_contribution_and_proof_signature_verifies(spec, state):
+    contribution = _full_contribution(spec, state)
+    cap = spec.get_contribution_and_proof(state, 5, contribution, privkeys[5])
+    sig = spec.get_contribution_and_proof_signature(state, cap, privkeys[5])
+    domain = spec.get_domain(
+        state,
+        spec.DOMAIN_CONTRIBUTION_AND_PROOF,
+        spec.compute_epoch_at_slot(contribution.slot),
+    )
+    assert bls.Verify(
+        state.validators[5].pubkey, spec.compute_signing_root(cap, domain), sig
+    )
+
+
+@with_phases(ALTAIR_ON)
+@spec_state_test
+def test_compute_subnets_cover_all_members(spec, state):
+    """Every sync-committee member maps to at least one subnet, and all
+    subnet ids are in range."""
+    n_subnets = int(spec.SYNC_COMMITTEE_SUBNET_COUNT)
+    seen = set()
+    for validator_index in set(int(i) for i in committee_indices(spec, state)):
+        subnets = spec.compute_subnets_for_sync_committee(state, validator_index)
+        assert subnets
+        assert all(0 <= int(s) < n_subnets for s in subnets)
+        seen.update(int(s) for s in subnets)
+    assert seen == set(range(n_subnets))
