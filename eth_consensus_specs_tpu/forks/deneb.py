@@ -302,6 +302,48 @@ class DenebSpec(CapellaSpec):
 
     # == misc ==============================================================
 
+    # == blob sidecar construction (specs/deneb/validator.md:170-199,
+    # p2p-interface.md verify seam) ========================================
+
+    def compute_signed_block_header(self, signed_block):
+        """specs/deneb/p2p-interface.md compute_signed_block_header."""
+        block = signed_block.message
+        block_header = self.BeaconBlockHeader(
+            slot=block.slot,
+            proposer_index=block.proposer_index,
+            parent_root=block.parent_root,
+            state_root=block.state_root,
+            body_root=hash_tree_root(block.body),
+        )
+        return self.SignedBeaconBlockHeader(
+            message=block_header, signature=signed_block.signature
+        )
+
+    def get_blob_sidecars(self, signed_block, blobs, blob_kzg_proofs):
+        """Sidecars for a block's blobs, inclusion proofs included
+        (specs/deneb/validator.md:170-188)."""
+        from eth_consensus_specs_tpu.ssz.gindex import get_generalized_index
+        from eth_consensus_specs_tpu.ssz.merkle import compute_merkle_proof
+
+        block = signed_block.message
+        signed_block_header = self.compute_signed_block_header(signed_block)
+        return [
+            self.BlobSidecar(
+                index=index,
+                blob=blob,
+                kzg_commitment=block.body.blob_kzg_commitments[index],
+                kzg_proof=blob_kzg_proofs[index],
+                signed_block_header=signed_block_header,
+                kzg_commitment_inclusion_proof=compute_merkle_proof(
+                    block.body,
+                    get_generalized_index(
+                        type(block.body), "blob_kzg_commitments", index
+                    ),
+                ),
+            )
+            for index, blob in enumerate(blobs)
+        ]
+
     def compute_subnet_for_blob_sidecar(self, blob_index: int) -> int:
         """reference: specs/deneb/validator.md:197-199."""
         return int(blob_index) % int(self.config.BLOB_SIDECAR_SUBNET_COUNT)

@@ -446,19 +446,6 @@ class FuluSpec(ElectraSpec):
 
     # == sidecar construction (specs/fulu/validator.md:207-265) ============
 
-    def compute_signed_block_header(self, signed_block):
-        block = signed_block.message
-        block_header = self.BeaconBlockHeader(
-            slot=block.slot,
-            proposer_index=block.proposer_index,
-            parent_root=block.parent_root,
-            state_root=block.state_root,
-            body_root=hash_tree_root(block.body),
-        )
-        return self.SignedBeaconBlockHeader(
-            message=block_header, signature=signed_block.signature
-        )
-
     def get_data_column_sidecars(
         self,
         signed_block_header,

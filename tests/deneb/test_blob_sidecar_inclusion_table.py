@@ -99,3 +99,47 @@ def test_inclusion_proof_incorrect_commitment(spec, state):
     sidecar = _make_sidecar(spec, state)
     sidecar.kzg_commitment = curve.g1_to_bytes(curve.g1_generator().double())
     assert not spec.verify_blob_sidecar_inclusion_proof(sidecar)
+
+
+# == duty-constructed sidecars (specs/deneb/validator.md get_blob_sidecars)
+
+
+@with_phases(BLOB_FORKS)
+@spec_state_test
+def test_get_blob_sidecars_produce_valid_inclusion_proofs(spec, state):
+    """Sidecars built by the VALIDATOR DUTY pipeline pass the p2p
+    verification — the gindex walker and the hand-rolled proof agree."""
+    from eth_consensus_specs_tpu.test_infra.block import (
+        state_transition_and_sign_block,
+    )
+
+    block = build_empty_block_for_next_slot(spec, state)
+    n = 3
+    for _ in range(n):
+        block.body.blob_kzg_commitments.append(COMMITMENT)
+    signed = state_transition_and_sign_block(spec, state, block)
+
+    blob = b"\x00" * (32 * 4096)
+    sidecars = spec.get_blob_sidecars(signed, [blob] * n, [COMMITMENT] * n)
+    assert len(sidecars) == n
+    for sidecar in sidecars:
+        assert spec.verify_blob_sidecar_inclusion_proof(sidecar)
+    # indices are positional
+    assert [int(s.index) for s in sidecars] == list(range(n))
+
+
+@with_phases(BLOB_FORKS)
+@spec_state_test
+def test_get_blob_sidecars_header_binds_block(spec, state):
+    from eth_consensus_specs_tpu.ssz import hash_tree_root as htr
+    from eth_consensus_specs_tpu.test_infra.block import (
+        state_transition_and_sign_block,
+    )
+
+    block = build_empty_block_for_next_slot(spec, state)
+    block.body.blob_kzg_commitments.append(COMMITMENT)
+    signed = state_transition_and_sign_block(spec, state, block)
+    blob = b"\x00" * (32 * 4096)
+    (sidecar,) = spec.get_blob_sidecars(signed, [blob], [COMMITMENT])
+    assert htr(sidecar.signed_block_header.message) == htr(signed.message)
+    assert bytes(sidecar.signed_block_header.signature) == bytes(signed.signature)
