@@ -1,0 +1,169 @@
+"""Eth1-bridge deposit transition in whole blocks: legacy Merkle-proof
+deposits and EIP-6110 deposit requests coexisting while the bridge drains
+(reference analogue: eth2spec/test/electra/sanity/blocks/
+test_deposit_transition.py; spec: specs/electra/beacon-chain.md
+process_operations' eth1_deposit_index_limit interlock)."""
+
+from eth_consensus_specs_tpu.test_infra.block import (
+    build_empty_block_for_next_slot,
+    state_transition_and_sign_block,
+)
+from eth_consensus_specs_tpu.test_infra.context import spec_state_test, with_phases
+from eth_consensus_specs_tpu.test_infra.deposits import (
+    build_deposit_data,
+    build_deposit_proof,
+)
+from eth_consensus_specs_tpu.test_infra.keys import privkeys, pubkeys
+from eth_consensus_specs_tpu.utils import bls
+
+ELECTRA_ON = ["electra", "fulu"]
+
+CREDS = lambda spec: spec.ETH1_ADDRESS_WITHDRAWAL_PREFIX + b"\x00" * 11 + b"\x42" * 20  # noqa: E731
+
+
+def _bridge_deposits(spec, state, count: int, start_key: int):
+    """`count` legacy bridge deposits whose proofs all verify against the
+    FINAL tree root (proofs built after every leaf is known)."""
+    deposit_data_list = [
+        build_deposit_data(
+            spec,
+            bytes(pubkeys[start_key + i]),
+            privkeys[start_key + i],
+            int(spec.MIN_ACTIVATION_BALANCE),
+            CREDS(spec),
+            signed=True,
+        )
+        for i in range(count)
+    ]
+    deposits = []
+    root = None
+    for i in range(count):
+        proof, root = build_deposit_proof(spec, deposit_data_list, i)
+        deposits.append(spec.Deposit(proof=proof, data=deposit_data_list[i]))
+    return deposits, root, count
+
+
+def _deposit_request(spec, key_index: int, index: int):
+    data = build_deposit_data(
+        spec,
+        bytes(pubkeys[key_index]),
+        privkeys[key_index],
+        int(spec.MIN_ACTIVATION_BALANCE),
+        CREDS(spec),
+        signed=True,
+    )
+    return spec.DepositRequest(
+        pubkey=data.pubkey,
+        withdrawal_credentials=data.withdrawal_credentials,
+        amount=data.amount,
+        signature=data.signature,
+        index=index,
+    )
+
+
+def _mid_transition_state(spec, state, bridge_pending: int, start_key: int):
+    """State where `bridge_pending` legacy deposits are still undrained."""
+    deposits, root, count = _bridge_deposits(spec, state, bridge_pending, start_key)
+    state.eth1_deposit_index = 0
+    state.eth1_data.deposit_root = root
+    state.eth1_data.deposit_count = count
+    state.deposit_requests_start_index = count
+    return deposits
+
+
+def _apply(spec, state, deposits=(), requests=(), expect_fail=False):
+    block = build_empty_block_for_next_slot(spec, state)
+    for d in deposits:
+        block.body.deposits.append(d)
+    for r in requests:
+        block.body.execution_requests.deposits.append(r)
+    return state_transition_and_sign_block(
+        spec, state, block, expect_fail=expect_fail
+    )
+
+
+@with_phases(ELECTRA_ON)
+@spec_state_test
+def test_transition_block_drains_bridge_deposits(spec, state):
+    """Undrained legacy deposits MUST ride the block (up to the limit);
+    they enter the pending queue, not the balances directly."""
+    n = len(state.validators)
+    deposits = _mid_transition_state(spec, state, 2, n + 1)
+    queued_before = len(state.pending_deposits)
+    _apply(spec, state, deposits=deposits)
+    assert int(state.eth1_deposit_index) == 2
+    assert len(state.pending_deposits) == queued_before + 2
+
+
+@with_phases(ELECTRA_ON)
+@spec_state_test
+def test_transition_block_missing_bridge_deposits_invalid(spec, state):
+    """While the bridge holds deposits, a block without them is invalid."""
+    n = len(state.validators)
+    _mid_transition_state(spec, state, 2, n + 1)
+    _apply(spec, state, deposits=(), expect_fail=True)
+
+
+@with_phases(ELECTRA_ON)
+@spec_state_test
+def test_transition_block_too_many_bridge_deposits_invalid(spec, state):
+    """More deposits than the remaining bridge backlog is invalid."""
+    n = len(state.validators)
+    deposits, root, count = _bridge_deposits(spec, state, 3, n + 1)
+    state.eth1_deposit_index = 0
+    state.eth1_data.deposit_root = root
+    state.eth1_data.deposit_count = count
+    state.deposit_requests_start_index = 2  # only 2 legacy slots remain
+    _apply(spec, state, deposits=deposits, expect_fail=True)
+
+
+@with_phases(ELECTRA_ON)
+@spec_state_test
+def test_transition_block_requests_alongside_bridge(spec, state):
+    """A block may carry BOTH the remaining legacy deposits and new
+    deposit requests; both funnel into the pending queue in order."""
+    n = len(state.validators)
+    deposits = _mid_transition_state(spec, state, 1, n + 1)
+    request = _deposit_request(spec, n + 5, 1)
+    queued_before = len(state.pending_deposits)
+    _apply(spec, state, deposits=deposits, requests=[request])
+    assert len(state.pending_deposits) == queued_before + 2
+    # bridge deposit first, request after
+    assert bytes(state.pending_deposits[-2].pubkey) == bytes(pubkeys[n + 1])
+    assert bytes(state.pending_deposits[-1].pubkey) == bytes(pubkeys[n + 5])
+
+
+@with_phases(ELECTRA_ON)
+@spec_state_test
+def test_post_transition_requests_only(spec, state):
+    """Bridge fully drained: blocks carry no legacy deposits and requests
+    flow through alone."""
+    n = len(state.validators)
+    request = _deposit_request(spec, n + 7, 0)
+    queued_before = len(state.pending_deposits)
+    _apply(spec, state, requests=[request])
+    assert len(state.pending_deposits) == queued_before + 1
+
+
+@with_phases(ELECTRA_ON)
+@spec_state_test
+def test_post_transition_stray_bridge_deposit_invalid(spec, state):
+    """After the bridge drained, a legacy deposit has no slot to fill —
+    the per-block expected count is zero, so including one is invalid."""
+    n = len(state.validators)
+    deposits, _, _ = _bridge_deposits(spec, state, 1, n + 9)
+    # state believes the bridge is fully consumed
+    _apply(spec, state, deposits=deposits, expect_fail=True)
+
+
+@with_phases(ELECTRA_ON)
+@spec_state_test
+def test_transition_same_pubkey_bridge_and_request(spec, state):
+    """The same NEW pubkey via the bridge and a request in one block:
+    both queue (dedup happens at apply time)."""
+    n = len(state.validators)
+    deposits = _mid_transition_state(spec, state, 1, n + 1)
+    request = _deposit_request(spec, n + 1, 1)  # same key as the bridge deposit
+    queued_before = len(state.pending_deposits)
+    _apply(spec, state, deposits=deposits, requests=[request])
+    assert len(state.pending_deposits) == queued_before + 2

@@ -1,0 +1,132 @@
+"""on_block finalization-boundary table: blocks behind or outside the
+finalized chain must be refused, and justification advances through the
+store (reference analogue: eth2spec/test/phase0/fork_choice/
+test_on_block.py finalized-slot/descendant cases; spec:
+specs/phase0/fork-choice.md on_block asserts)."""
+
+import pytest
+
+from eth_consensus_specs_tpu.test_infra.block import (
+    build_empty_block_for_next_slot,
+    state_transition_and_sign_block,
+)
+from eth_consensus_specs_tpu.test_infra.context import (
+    spec_state_test,
+    with_phases,
+)
+from eth_consensus_specs_tpu.test_infra.fork_choice import (
+    add_block,
+    apply_next_epoch_with_attestations,
+    get_genesis_forkchoice_store,
+    tick_and_add_block,
+)
+
+# finality drives cost ~4 epochs of full-attestation blocks per fork; the
+# on_block asserts under test are fork-invariant, so three representative
+# eras (pre-altair, execution, maxeb) bound the nightly cost
+FINALITY_FORKS = ["phase0", "capella", "electra"]
+
+pytestmark = pytest.mark.slow  # multi-epoch finality drives per fork
+
+
+def _finalize_some_epochs(spec, state, store, epochs=4):
+    """Drive enough fully-attested epochs for the store to finalize."""
+    for _ in range(epochs):
+        state, last_root = apply_next_epoch_with_attestations(spec, store, state)
+    assert int(store.finalized_checkpoint.epoch) > 0
+    return state, last_root
+
+
+@with_phases(FINALITY_FORKS)
+@spec_state_test
+def test_on_block_behind_finalized_slot_rejected(spec, state):
+    """A (well-signed) block whose slot is at/behind the finalized slot
+    can never enter the store."""
+    fork_state = state.copy()  # pre-finality branch point
+    store, _ = get_genesis_forkchoice_store(spec, state)
+    state, _ = _finalize_some_epochs(spec, state, store)
+
+    # a competing block built at the old branch point
+    stale_block = build_empty_block_for_next_slot(spec, fork_state)
+    signed_stale = state_transition_and_sign_block(spec, fork_state, stale_block)
+    finalized_slot = spec.compute_start_slot_at_epoch(store.finalized_checkpoint.epoch)
+    assert int(signed_stale.message.slot) <= int(finalized_slot)
+    add_block(spec, store, signed_stale, valid=False)
+
+
+@with_phases(FINALITY_FORKS)
+@spec_state_test
+def test_on_block_non_descendant_of_finalized_rejected(spec, state):
+    """A branch that forked off BEFORE finalization is refused even when
+    its slot is past the finalized slot."""
+    fork_state = state.copy()
+    store, _ = get_genesis_forkchoice_store(spec, state)
+    state, _ = _finalize_some_epochs(spec, state, store)
+
+    # grow the stale branch past the finalized slot WITHOUT attestations
+    finalized_slot = int(
+        spec.compute_start_slot_at_epoch(store.finalized_checkpoint.epoch)
+    )
+    spec.process_slots(fork_state, finalized_slot + 1)
+    stale_block = build_empty_block_for_next_slot(spec, fork_state)
+    signed_stale = state_transition_and_sign_block(spec, fork_state, stale_block)
+    assert int(signed_stale.message.slot) > finalized_slot
+    add_block(spec, store, signed_stale, valid=False)
+
+
+@with_phases(FINALITY_FORKS)
+@spec_state_test
+def test_on_block_descendant_after_finality_accepted(spec, state):
+    """The canonical chain keeps extending after finalization."""
+    store, _ = get_genesis_forkchoice_store(spec, state)
+    state, last_root = _finalize_some_epochs(spec, state, store)
+    block = build_empty_block_for_next_slot(spec, state)
+    signed = state_transition_and_sign_block(spec, state, block)
+    root = tick_and_add_block(spec, store, signed)
+    assert root is not None
+    assert spec.get_head_root(store) == root
+
+
+@with_phases(FINALITY_FORKS)
+@spec_state_test
+def test_on_block_justification_advances_store(spec, state):
+    """Justified/finalized checkpoints realized through on_block + ticks
+    match the post-state's view."""
+    store, _ = get_genesis_forkchoice_store(spec, state)
+    state, _ = _finalize_some_epochs(spec, state, store)
+    assert int(store.justified_checkpoint.epoch) >= int(
+        state.finalized_checkpoint.epoch
+    )
+    assert int(store.finalized_checkpoint.epoch) == int(
+        state.finalized_checkpoint.epoch
+    )
+    assert bytes(store.finalized_checkpoint.root) == bytes(
+        state.finalized_checkpoint.root
+    )
+
+
+@with_phases(FINALITY_FORKS)
+@spec_state_test
+def test_on_block_checkpoint_state_cached(spec, state):
+    """The justified checkpoint's epoch-boundary state is materialized in
+    store.checkpoint_states for weighting."""
+    store, _ = get_genesis_forkchoice_store(spec, state)
+    state, _ = _finalize_some_epochs(spec, state, store)
+    spec.get_head_root(store)  # forces checkpoint-state materialization
+    assert store.justified_checkpoint in store.checkpoint_states
+    cp_state = store.checkpoint_states[store.justified_checkpoint]
+    assert int(cp_state.slot) == int(
+        spec.compute_start_slot_at_epoch(store.justified_checkpoint.epoch)
+    )
+
+
+@with_phases(FINALITY_FORKS)
+@spec_state_test
+def test_on_block_skipped_slots_after_finality(spec, state):
+    """Skip several slots post-finality; the next block still imports."""
+    store, _ = get_genesis_forkchoice_store(spec, state)
+    state, _ = _finalize_some_epochs(spec, state, store)
+    spec.process_slots(state, int(state.slot) + 3)
+    block = build_empty_block_for_next_slot(spec, state)
+    signed = state_transition_and_sign_block(spec, state, block)
+    assert tick_and_add_block(spec, store, signed) is not None
