@@ -21,7 +21,7 @@ ELECTRA_ON = ["electra", "fulu"]
 CREDS = lambda spec: spec.ETH1_ADDRESS_WITHDRAWAL_PREFIX + b"\x00" * 11 + b"\x42" * 20  # noqa: E731
 
 
-def _bridge_deposits(spec, state, count: int, start_key: int):
+def _bridge_deposits(spec, count: int, start_key: int):
     """`count` legacy bridge deposits whose proofs all verify against the
     FINAL tree root (proofs built after every leaf is known)."""
     deposit_data_list = [
@@ -40,7 +40,7 @@ def _bridge_deposits(spec, state, count: int, start_key: int):
     for i in range(count):
         proof, root = build_deposit_proof(spec, deposit_data_list, i)
         deposits.append(spec.Deposit(proof=proof, data=deposit_data_list[i]))
-    return deposits, root, count
+    return deposits, root
 
 
 def _deposit_request(spec, key_index: int, index: int):
@@ -61,13 +61,19 @@ def _deposit_request(spec, key_index: int, index: int):
     )
 
 
-def _mid_transition_state(spec, state, bridge_pending: int, start_key: int):
-    """State where `bridge_pending` legacy deposits are still undrained."""
-    deposits, root, count = _bridge_deposits(spec, state, bridge_pending, start_key)
+def _mid_transition_state(
+    spec, state, bridge_pending: int, start_key: int, start_index=None
+):
+    """State where `bridge_pending` legacy deposits are still undrained;
+    `start_index` overrides deposit_requests_start_index (default: the
+    full backlog)."""
+    deposits, root = _bridge_deposits(spec, bridge_pending, start_key)
     state.eth1_deposit_index = 0
     state.eth1_data.deposit_root = root
-    state.eth1_data.deposit_count = count
-    state.deposit_requests_start_index = count
+    state.eth1_data.deposit_count = bridge_pending
+    state.deposit_requests_start_index = (
+        bridge_pending if start_index is None else start_index
+    )
     return deposits
 
 
@@ -109,11 +115,8 @@ def test_transition_block_missing_bridge_deposits_invalid(spec, state):
 def test_transition_block_too_many_bridge_deposits_invalid(spec, state):
     """More deposits than the remaining bridge backlog is invalid."""
     n = len(state.validators)
-    deposits, root, count = _bridge_deposits(spec, state, 3, n + 1)
-    state.eth1_deposit_index = 0
-    state.eth1_data.deposit_root = root
-    state.eth1_data.deposit_count = count
-    state.deposit_requests_start_index = 2  # only 2 legacy slots remain
+    # only 2 legacy slots remain but the block carries 3
+    deposits = _mid_transition_state(spec, state, 3, n + 1, start_index=2)
     _apply(spec, state, deposits=deposits, expect_fail=True)
 
 
@@ -151,7 +154,7 @@ def test_post_transition_stray_bridge_deposit_invalid(spec, state):
     """After the bridge drained, a legacy deposit has no slot to fill —
     the per-block expected count is zero, so including one is invalid."""
     n = len(state.validators)
-    deposits, _, _ = _bridge_deposits(spec, state, 1, n + 9)
+    deposits, _ = _bridge_deposits(spec, 1, n + 9)
     # state believes the bridge is fully consumed
     _apply(spec, state, deposits=deposits, expect_fail=True)
 

@@ -1,0 +1,174 @@
+"""Pending deposits applied through EPOCH TRANSITIONS driven by empty
+slot processing (reference analogue:
+eth2spec/test/electra/sanity/test_slots.py — queue semantics observable
+without any blocks; spec: specs/electra/beacon-chain.md
+process_pending_deposits inside process_epoch)."""
+
+from eth_consensus_specs_tpu.test_infra.context import spec_state_test, with_phases
+from eth_consensus_specs_tpu.test_infra.deposits import build_deposit_data
+from eth_consensus_specs_tpu.test_infra.keys import privkeys, pubkeys
+from eth_consensus_specs_tpu.test_infra.state import next_epoch
+
+ELECTRA_ON = ["electra", "fulu"]
+
+ETH1_CREDS = lambda spec: (  # noqa: E731
+    spec.ETH1_ADDRESS_WITHDRAWAL_PREFIX + b"\x00" * 11 + b"\x42" * 20
+)
+COMP_CREDS = lambda spec: (  # noqa: E731
+    spec.COMPOUNDING_WITHDRAWAL_PREFIX + b"\x00" * 11 + b"\x42" * 20
+)
+
+
+def _queue_deposit(spec, state, key_index: int, amount: int, creds=None, signed=True):
+    data = build_deposit_data(
+        spec,
+        bytes(pubkeys[key_index]),
+        privkeys[key_index],
+        amount,
+        creds if creds is not None else ETH1_CREDS(spec),
+        signed=signed,
+    )
+    state.pending_deposits.append(
+        spec.PendingDeposit(
+            pubkey=data.pubkey,
+            withdrawal_credentials=data.withdrawal_credentials,
+            amount=amount,
+            signature=data.signature,
+            slot=spec.GENESIS_SLOT,
+        )
+    )
+
+
+@with_phases(ELECTRA_ON)
+@spec_state_test
+def test_pending_deposit_extra_gwei(spec, state):
+    """A non-increment amount lands gwei-exact in the balance."""
+    n = len(state.validators)
+    amount = int(spec.MIN_ACTIVATION_BALANCE) + 1  # 1 extra gwei
+    _queue_deposit(spec, state, n + 1, amount)
+    next_epoch(spec, state)
+    assert len(state.validators) == n + 1
+    assert int(state.balances[n]) == amount
+
+
+@with_phases(ELECTRA_ON)
+@spec_state_test
+def test_multiple_pending_deposits_same_pubkey(spec, state):
+    """First deposit creates the validator; the rest top up — one new
+    validator total."""
+    n = len(state.validators)
+    inc = int(spec.EFFECTIVE_BALANCE_INCREMENT)
+    _queue_deposit(spec, state, n + 1, int(spec.MIN_ACTIVATION_BALANCE))
+    _queue_deposit(spec, state, n + 1, inc)
+    _queue_deposit(spec, state, n + 1, inc)
+    next_epoch(spec, state)
+    assert len(state.validators) == n + 1
+    assert int(state.balances[n]) == int(spec.MIN_ACTIVATION_BALANCE) + 2 * inc
+
+
+@with_phases(ELECTRA_ON)
+@spec_state_test
+def test_multiple_same_pubkey_second_signature_invalid(spec, state):
+    """Top-ups skip signature verification: a second deposit with a BAD
+    signature still credits the existing validator."""
+    n = len(state.validators)
+    inc = int(spec.EFFECTIVE_BALANCE_INCREMENT)
+    _queue_deposit(spec, state, n + 1, int(spec.MIN_ACTIVATION_BALANCE), signed=True)
+    _queue_deposit(spec, state, n + 1, inc, signed=False)  # junk signature
+    next_epoch(spec, state)
+    assert len(state.validators) == n + 1
+    assert int(state.balances[n]) == int(spec.MIN_ACTIVATION_BALANCE) + inc
+
+
+@with_phases(ELECTRA_ON)
+@spec_state_test
+def test_same_pubkey_compounding_creds_from_first_deposit(spec, state):
+    """The FIRST applied deposit fixes the credentials; later deposits
+    with different creds only top up."""
+    n = len(state.validators)
+    _queue_deposit(
+        spec, state, n + 1, int(spec.MIN_ACTIVATION_BALANCE), creds=COMP_CREDS(spec)
+    )
+    _queue_deposit(
+        spec,
+        state,
+        n + 1,
+        int(spec.EFFECTIVE_BALANCE_INCREMENT),
+        creds=ETH1_CREDS(spec),
+    )
+    next_epoch(spec, state)
+    assert len(state.validators) == n + 1
+    creds = bytes(state.validators[n].withdrawal_credentials)
+    assert creds[:1] == bytes(spec.COMPOUNDING_WITHDRAWAL_PREFIX)
+
+
+@with_phases(ELECTRA_ON)
+@spec_state_test
+def test_top_up_below_upward_hysteresis_threshold(spec, state):
+    """A small top-up below the hysteresis window leaves the effective
+    balance untouched at the next update."""
+    inc = int(spec.EFFECTIVE_BALANCE_INCREMENT)
+    upward = inc // int(spec.HYSTERESIS_QUOTIENT) * int(
+        spec.HYSTERESIS_UPWARD_MULTIPLIER
+    )
+    target = 0
+    state.balances[target] = int(spec.MIN_ACTIVATION_BALANCE)
+    state.validators[target].effective_balance = int(spec.MIN_ACTIVATION_BALANCE)
+    _queue_deposit(spec, state, target, upward - 1)
+    next_epoch(spec, state)
+    assert int(state.validators[target].effective_balance) == int(
+        spec.MIN_ACTIVATION_BALANCE
+    )
+
+
+@with_phases(ELECTRA_ON)
+@spec_state_test
+def test_top_up_above_upward_hysteresis_threshold(spec, state):
+    """Crossing the upward threshold re-floors the effective balance to
+    the full new balance (not a single-increment step)."""
+    inc = int(spec.EFFECTIVE_BALANCE_INCREMENT)
+    upward = inc // int(spec.HYSTERESIS_QUOTIENT) * int(
+        spec.HYSTERESIS_UPWARD_MULTIPLIER
+    )
+    target = 0
+    from eth_consensus_specs_tpu.test_infra.withdrawals import (
+        set_compounding_withdrawal_credential_with_balance,
+    )
+
+    start = int(spec.MIN_ACTIVATION_BALANCE)
+    set_compounding_withdrawal_credential_with_balance(
+        spec, state, target, balance=start, effective_balance=start
+    )
+    _queue_deposit(spec, state, target, inc + upward)
+    next_epoch(spec, state)
+    # new balance = start + inc + upward; effective re-floors to whole
+    # increments: start + 2*inc (upward = 1.25 inc on mainnet params)
+    expected = (start + inc + upward) // inc * inc
+    assert int(state.validators[target].effective_balance) == expected
+
+
+@with_phases(ELECTRA_ON)
+@spec_state_test
+def test_pending_consolidation_through_slots(spec, state):
+    """A matured pending consolidation sweeps the source balance into the
+    target at the epoch boundary, no blocks involved."""
+    src, dst = 1, 2
+    from eth_consensus_specs_tpu.test_infra.withdrawals import (
+        set_compounding_withdrawal_credential_with_balance,
+    )
+
+    set_compounding_withdrawal_credential_with_balance(spec, state, dst)
+    state.validators[src].exit_epoch = spec.get_current_epoch(state)
+    state.validators[src].withdrawable_epoch = spec.get_current_epoch(state) + 1
+    state.pending_consolidations.append(
+        spec.PendingConsolidation(source_index=src, target_index=dst)
+    )
+    src_balance = int(state.balances[src])
+    src_effective = int(state.validators[src].effective_balance)
+    dst_balance = int(state.balances[dst])
+    moved = min(src_balance, src_effective)
+
+    next_epoch(spec, state)
+    assert len(state.pending_consolidations) == 0
+    assert int(state.balances[dst]) == dst_balance + moved
+    assert int(state.balances[src]) == src_balance - moved

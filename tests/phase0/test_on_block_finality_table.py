@@ -29,12 +29,46 @@ FINALITY_FORKS = ["phase0", "capella", "electra"]
 pytestmark = pytest.mark.slow  # multi-epoch finality drives per fork
 
 
+# The 4-epoch fully-attested drive is identical for every test of a fork
+# (~2 min each): run it once per fork and hand out deep copies.
+_FINALITY_CACHE: dict = {}
+
+
 def _finalize_some_epochs(spec, state, store, epochs=4):
-    """Drive enough fully-attested epochs for the store to finalize."""
-    for _ in range(epochs):
-        state, last_root = apply_next_epoch_with_attestations(spec, store, state)
-    assert int(store.finalized_checkpoint.epoch) > 0
-    return state, last_root
+    """Drive enough fully-attested epochs for the store to finalize
+    (memoized per fork; copies returned so tests stay independent)."""
+    import copy
+
+    key = (spec.fork_name, epochs)
+    if key not in _FINALITY_CACHE:
+        st = state
+        last_root = None
+        for _ in range(epochs):
+            st, last_root = apply_next_epoch_with_attestations(spec, store, st)
+        assert int(store.finalized_checkpoint.epoch) > 0
+        # snapshot NOW — the caller will go on mutating its store
+        _FINALITY_CACHE[key] = (st.copy(), copy.deepcopy(store), last_root)
+    st, cached_store, last_root = _FINALITY_CACHE[key]
+    fresh_store = copy.deepcopy(cached_store)
+    # graft the fresh store's contents onto the caller's store object
+    for field in (
+        "time",
+        "justified_checkpoint",
+        "finalized_checkpoint",
+        "unrealized_justified_checkpoint",
+        "unrealized_finalized_checkpoint",
+        "proposer_boost_root",
+        "equivocating_indices",
+        "blocks",
+        "block_states",
+        "block_timeliness",
+        "checkpoint_states",
+        "latest_messages",
+        "unrealized_justifications",
+    ):
+        if hasattr(fresh_store, field):
+            setattr(store, field, getattr(fresh_store, field))
+    return st.copy(), last_root
 
 
 @with_phases(FINALITY_FORKS)
