@@ -1,0 +1,92 @@
+"""Sync-aggregate RANDOM participation and lifecycle overlays (reference
+analogue: eth2spec/test/altair/block_processing/sync_aggregate/
+test_process_sync_aggregate_random.py; spec:
+specs/altair/beacon-chain.md process_sync_aggregate — participation is
+independent of the members' exit/slash status)."""
+
+import random
+
+from eth_consensus_specs_tpu.test_infra.context import spec_state_test, with_phases
+from eth_consensus_specs_tpu.test_infra.state import next_slot
+from eth_consensus_specs_tpu.test_infra.sync_committee import (
+    committee_indices,
+    make_sync_aggregate,
+    run_sync_aggregate_processing,
+)
+
+ALTAIR_ON = ["altair", "bellatrix", "capella", "deneb", "electra", "fulu"]
+
+
+def _run_with_bits(spec, state, bits):
+    next_slot(spec, state)  # a previous block root must exist
+    aggregate = make_sync_aggregate(spec, state, bits)
+    for _ in run_sync_aggregate_processing(spec, state, aggregate):
+        pass
+
+
+def _random_bits(spec, rng):
+    return [rng.random() < 0.5 for _ in range(int(spec.SYNC_COMMITTEE_SIZE))]
+
+
+@with_phases(ALTAIR_ON)
+@spec_state_test
+def test_random_participation_seeds(spec, state):
+    for seed in (400, 401, 402):
+        rng = random.Random(seed)
+        _run_with_bits(spec, state.copy(), _random_bits(spec, rng))
+
+
+@with_phases(ALTAIR_ON)
+@spec_state_test
+def test_only_one_participant(spec, state):
+    bits = [False] * int(spec.SYNC_COMMITTEE_SIZE)
+    bits[3] = True
+    _run_with_bits(spec, state, bits)
+
+
+@with_phases(ALTAIR_ON)
+@spec_state_test
+def test_all_but_one_participant(spec, state):
+    bits = [True] * int(spec.SYNC_COMMITTEE_SIZE)
+    bits[3] = False
+    _run_with_bits(spec, state, bits)
+
+
+@with_phases(ALTAIR_ON)
+@spec_state_test
+def test_slashed_member_still_participates(spec, state):
+    """Slashing does not remove a member from the committee for the
+    period: its signature stays valid and it still earns the reward."""
+    member = int(committee_indices(spec, state)[0])
+    state.validators[member].slashed = True
+    before = int(state.balances[member])
+    bits = [True] * int(spec.SYNC_COMMITTEE_SIZE)
+    _run_with_bits(spec, state, bits)
+    assert int(state.balances[member]) > before
+
+
+@with_phases(ALTAIR_ON)
+@spec_state_test
+def test_random_with_exits_and_slashings(spec, state):
+    """Random participation over a committee with scattered exits and
+    slashings: participants gain, sole non-participants lose."""
+    rng = random.Random(403)
+    for member in set(int(i) for i in committee_indices(spec, state)):
+        roll = rng.random()
+        if roll < 0.15:
+            state.validators[member].exit_epoch = spec.get_current_epoch(state)
+        elif roll < 0.3:
+            state.validators[member].slashed = True
+    bits = _random_bits(spec, rng)
+    members = [int(i) for i in committee_indices(spec, state)]
+    before = [int(b) for b in state.balances]
+    _run_with_bits(spec, state, bits)
+    proposer = int(spec.get_beacon_proposer_index(state))
+    # participants gained, non-participants lost (proposer may offset)
+    for pos, member in enumerate(members):
+        if member == proposer:
+            continue
+        if bits[pos]:
+            assert int(state.balances[member]) > before[member], member
+        elif members.count(member) == 1:
+            assert int(state.balances[member]) < before[member], member

@@ -112,13 +112,19 @@ def test_top_up_below_upward_hysteresis_threshold(spec, state):
         spec.HYSTERESIS_UPWARD_MULTIPLIER
     )
     target = 0
-    state.balances[target] = int(spec.MIN_ACTIVATION_BALANCE)
-    state.validators[target].effective_balance = int(spec.MIN_ACTIVATION_BALANCE)
+    from eth_consensus_specs_tpu.test_infra.withdrawals import (
+        set_compounding_withdrawal_credential_with_balance,
+    )
+
+    # compounding creds: the cap sits far above, so only the hysteresis
+    # window can hold the effective balance back
+    start = int(spec.MIN_ACTIVATION_BALANCE)
+    set_compounding_withdrawal_credential_with_balance(
+        spec, state, target, balance=start, effective_balance=start
+    )
     _queue_deposit(spec, state, target, upward - 1)
     next_epoch(spec, state)
-    assert int(state.validators[target].effective_balance) == int(
-        spec.MIN_ACTIVATION_BALANCE
-    )
+    assert int(state.validators[target].effective_balance) == start
 
 
 @with_phases(ELECTRA_ON)
