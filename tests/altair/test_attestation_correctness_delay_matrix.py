@@ -1,0 +1,169 @@
+"""Attestation correctness x inclusion-delay matrix: which participation
+flags each (head/target correctness, delay) combination earns (reference
+analogue: eth2spec/test/phase0/block_processing/test_process_attestation.py
+`test_{correct,incorrect_head,incorrect_target,...}_included_at_*`; spec:
+specs/altair/beacon-chain.md get_attestation_participation_flag_indices,
+deneb's removal of the target-flag delay cap)."""
+
+from eth_consensus_specs_tpu.test_infra.attestations import get_valid_attestation
+from eth_consensus_specs_tpu.test_infra.context import (
+    expect_assertion_error,
+    spec_state_test,
+    with_phases,
+)
+from eth_consensus_specs_tpu.test_infra.forks import is_post_deneb
+from eth_consensus_specs_tpu.test_infra.state import next_slots
+
+ALTAIR_ON = ["altair", "bellatrix", "capella", "deneb", "electra", "fulu"]
+
+
+def _prepared_attestation(spec, state, wrong_head=False, wrong_target=False):
+    """Attestation for the current slot, optionally corrupted in the
+    LMD/FFG vote (still includable — correctness only affects flags)."""
+    attestation = get_valid_attestation(spec, state, signed=False)
+    if wrong_head:
+        attestation.data.beacon_block_root = b"\x99" * 32
+    if wrong_target:
+        attestation.data.target.root = b"\x88" * 32
+    return attestation
+
+
+def _include_at_delay(spec, state, attestation, delay: int):
+    next_slots(spec, state, delay)
+    spec.process_attestation(state, attestation)
+
+
+def _attester_flags(spec, state, attestation):
+    """The flag set of the first attesting validator (all attesters in a
+    committee share the same flag outcome)."""
+    committee = spec.get_beacon_committee(
+        state, attestation.data.slot, attestation.data.index
+    )
+    epoch_bits = (
+        state.current_epoch_participation
+        if int(attestation.data.target.epoch) == int(spec.get_current_epoch(state))
+        else state.previous_epoch_participation
+    )
+    return int(epoch_bits[int(committee[0])])
+
+
+# == correct vote ==========================================================
+
+
+@with_phases(ALTAIR_ON)
+@spec_state_test
+def test_correct_at_min_delay_all_flags(spec, state):
+    attestation = _prepared_attestation(spec, state)
+    _include_at_delay(spec, state, attestation, int(spec.MIN_ATTESTATION_INCLUSION_DELAY))
+    flags = _attester_flags(spec, state, attestation)
+    assert spec.has_flag(flags, int(spec.TIMELY_SOURCE_FLAG_INDEX))
+    assert spec.has_flag(flags, int(spec.TIMELY_TARGET_FLAG_INDEX))
+    assert spec.has_flag(flags, int(spec.TIMELY_HEAD_FLAG_INDEX))
+
+
+@with_phases(ALTAIR_ON)
+@spec_state_test
+def test_correct_at_sqrt_epoch_delay_drops_head(spec, state):
+    delay = int(spec.integer_squareroot(spec.SLOTS_PER_EPOCH))
+    attestation = _prepared_attestation(spec, state)
+    _include_at_delay(spec, state, attestation, delay)
+    flags = _attester_flags(spec, state, attestation)
+    assert spec.has_flag(flags, int(spec.TIMELY_SOURCE_FLAG_INDEX))
+    assert spec.has_flag(flags, int(spec.TIMELY_TARGET_FLAG_INDEX))
+    assert not spec.has_flag(flags, int(spec.TIMELY_HEAD_FLAG_INDEX))
+
+
+@with_phases(ALTAIR_ON)
+@spec_state_test
+def test_correct_at_epoch_delay_target_only_plus_deneb_rule(spec, state):
+    """At a full-epoch delay the source window has passed; the target flag
+    survives (for deneb+ it has NO delay cap at all)."""
+    delay = int(spec.SLOTS_PER_EPOCH)
+    attestation = _prepared_attestation(spec, state)
+    _include_at_delay(spec, state, attestation, delay)
+    flags = _attester_flags(spec, state, attestation)
+    assert not spec.has_flag(flags, int(spec.TIMELY_SOURCE_FLAG_INDEX))
+    assert spec.has_flag(flags, int(spec.TIMELY_TARGET_FLAG_INDEX))
+    assert not spec.has_flag(flags, int(spec.TIMELY_HEAD_FLAG_INDEX))
+
+
+@with_phases(ALTAIR_ON)
+@spec_state_test
+def test_invalid_after_max_inclusion_window(spec, state):
+    """Pre-deneb the inclusion window is one epoch; deneb+ allows any
+    delay within the previous-epoch target rule (EIP-7045)."""
+    attestation = _prepared_attestation(spec, state)
+    delay = int(spec.SLOTS_PER_EPOCH) + 1
+    if is_post_deneb(spec):
+        # still includable: target is the previous epoch now
+        _include_at_delay(spec, state, attestation, delay)
+        flags = _attester_flags(spec, state, attestation)
+        assert spec.has_flag(flags, int(spec.TIMELY_TARGET_FLAG_INDEX))
+    else:
+        next_slots(spec, state, delay)
+        expect_assertion_error(lambda: spec.process_attestation(state, attestation))
+
+
+# == incorrect head ========================================================
+
+
+@with_phases(ALTAIR_ON)
+@spec_state_test
+def test_incorrect_head_at_min_delay(spec, state):
+    attestation = _prepared_attestation(spec, state, wrong_head=True)
+    _include_at_delay(spec, state, attestation, int(spec.MIN_ATTESTATION_INCLUSION_DELAY))
+    flags = _attester_flags(spec, state, attestation)
+    assert spec.has_flag(flags, int(spec.TIMELY_SOURCE_FLAG_INDEX))
+    assert spec.has_flag(flags, int(spec.TIMELY_TARGET_FLAG_INDEX))
+    assert not spec.has_flag(flags, int(spec.TIMELY_HEAD_FLAG_INDEX))
+
+
+@with_phases(ALTAIR_ON)
+@spec_state_test
+def test_incorrect_head_at_sqrt_epoch_delay(spec, state):
+    delay = int(spec.integer_squareroot(spec.SLOTS_PER_EPOCH))
+    attestation = _prepared_attestation(spec, state, wrong_head=True)
+    _include_at_delay(spec, state, attestation, delay)
+    flags = _attester_flags(spec, state, attestation)
+    assert spec.has_flag(flags, int(spec.TIMELY_SOURCE_FLAG_INDEX))
+    assert spec.has_flag(flags, int(spec.TIMELY_TARGET_FLAG_INDEX))
+    assert not spec.has_flag(flags, int(spec.TIMELY_HEAD_FLAG_INDEX))
+
+
+# == incorrect target ======================================================
+
+
+@with_phases(ALTAIR_ON)
+@spec_state_test
+def test_incorrect_target_at_min_delay_source_only(spec, state):
+    attestation = _prepared_attestation(spec, state, wrong_target=True)
+    _include_at_delay(spec, state, attestation, int(spec.MIN_ATTESTATION_INCLUSION_DELAY))
+    flags = _attester_flags(spec, state, attestation)
+    assert spec.has_flag(flags, int(spec.TIMELY_SOURCE_FLAG_INDEX))
+    assert not spec.has_flag(flags, int(spec.TIMELY_TARGET_FLAG_INDEX))
+    # head can never match when the target doesn't
+    assert not spec.has_flag(flags, int(spec.TIMELY_HEAD_FLAG_INDEX))
+
+
+@with_phases(ALTAIR_ON)
+@spec_state_test
+def test_incorrect_target_at_epoch_delay_no_flags(spec, state):
+    delay = int(spec.SLOTS_PER_EPOCH)
+    attestation = _prepared_attestation(spec, state, wrong_target=True)
+    _include_at_delay(spec, state, attestation, delay)
+    flags = _attester_flags(spec, state, attestation)
+    assert flags == 0
+
+
+# == incorrect head AND target =============================================
+
+
+@with_phases(ALTAIR_ON)
+@spec_state_test
+def test_incorrect_head_and_target_at_min_delay(spec, state):
+    attestation = _prepared_attestation(spec, state, wrong_head=True, wrong_target=True)
+    _include_at_delay(spec, state, attestation, int(spec.MIN_ATTESTATION_INCLUSION_DELAY))
+    flags = _attester_flags(spec, state, attestation)
+    assert spec.has_flag(flags, int(spec.TIMELY_SOURCE_FLAG_INDEX))
+    assert not spec.has_flag(flags, int(spec.TIMELY_TARGET_FLAG_INDEX))
+    assert not spec.has_flag(flags, int(spec.TIMELY_HEAD_FLAG_INDEX))
