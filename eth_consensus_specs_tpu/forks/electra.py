@@ -750,6 +750,70 @@ class ElectraSpec(DenebSpec):
             if len(request_data) != 0
         ]
 
+    def get_execution_requests(self, execution_requests_list):
+        """Inverse of the flat encoding: typed EL request bytes →
+        ExecutionRequests, enforcing strictly-ascending unique types and
+        non-empty payloads (specs/electra/validator.md:270-305)."""
+        from eth_consensus_specs_tpu.ssz import deserialize
+
+        deposits = []
+        withdrawals = []
+        consolidations = []
+        request_types = [
+            self.DEPOSIT_REQUEST_TYPE,
+            self.WITHDRAWAL_REQUEST_TYPE,
+            self.CONSOLIDATION_REQUEST_TYPE,
+        ]
+        prev_request_type = None
+        for request in execution_requests_list:
+            request_type, request_data = bytes(request[0:1]), bytes(request[1:])
+            assert request_type in request_types, "unknown request type"
+            assert len(request_data) != 0, "empty request data"
+            assert prev_request_type is None or prev_request_type < request_type, (
+                "request types must be strictly ascending"
+            )
+            prev_request_type = request_type
+            if request_type == self.DEPOSIT_REQUEST_TYPE:
+                deposits = deserialize(
+                    List[self.DepositRequest, self.MAX_DEPOSIT_REQUESTS_PER_PAYLOAD],
+                    request_data,
+                )
+            elif request_type == self.WITHDRAWAL_REQUEST_TYPE:
+                withdrawals = deserialize(
+                    List[
+                        self.WithdrawalRequest,
+                        self.MAX_WITHDRAWAL_REQUESTS_PER_PAYLOAD,
+                    ],
+                    request_data,
+                )
+            else:
+                consolidations = deserialize(
+                    List[
+                        self.ConsolidationRequest,
+                        self.MAX_CONSOLIDATION_REQUESTS_PER_PAYLOAD,
+                    ],
+                    request_data,
+                )
+        return self.ExecutionRequests(
+            deposits=deposits,
+            withdrawals=withdrawals,
+            consolidations=consolidations,
+        )
+
+    def get_eth1_pending_deposit_count(self, state) -> int:
+        """How many legacy bridge deposits the next block must carry
+        (specs/electra/validator.md:157-165)."""
+        eth1_deposit_index_limit = min(
+            int(state.eth1_data.deposit_count),
+            int(state.deposit_requests_start_index),
+        )
+        if int(state.eth1_deposit_index) < eth1_deposit_index_limit:
+            return min(
+                int(self.MAX_DEPOSITS),
+                eth1_deposit_index_limit - int(state.eth1_deposit_index),
+            )
+        return 0
+
     def process_execution_payload(self, state, body, execution_engine) -> None:
         payload = body.execution_payload
         assert (

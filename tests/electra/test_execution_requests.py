@@ -241,3 +241,84 @@ def test_process_pending_consolidations_skips_slashed(spec, state):
     spec.process_pending_consolidations(state)
     assert int(state.balances[source]) == src_balance  # nothing moved
     assert len(state.pending_consolidations) == 0  # but the entry is consumed
+
+
+# == round-4: typed flat encoding round-trip (validator.md:270-305) ========
+
+
+@with_phases(ELECTRA)
+@spec_state_test
+def test_execution_requests_list_roundtrip(spec, state):
+    """get_execution_requests inverts get_execution_requests_list."""
+    reqs = spec.ExecutionRequests()
+    reqs.withdrawals.append(
+        spec.WithdrawalRequest(
+            source_address=b"\x42" * 20,
+            validator_pubkey=state.validators[1].pubkey,
+            amount=spec.FULL_EXIT_REQUEST_AMOUNT,
+        )
+    )
+    reqs.consolidations.append(
+        spec.ConsolidationRequest(
+            source_address=b"\x42" * 20,
+            source_pubkey=state.validators[1].pubkey,
+            target_pubkey=state.validators[2].pubkey,
+        )
+    )
+    encoded = spec.get_execution_requests_list(reqs)
+    # empty deposit list is omitted from the flat encoding
+    assert len(encoded) == 2
+    back = spec.get_execution_requests(encoded)
+    from eth_consensus_specs_tpu.ssz import hash_tree_root
+
+    assert hash_tree_root(back) == hash_tree_root(reqs)
+
+
+@with_phases(ELECTRA)
+@spec_state_test
+def test_execution_requests_decode_rejects_disorder(spec, state):
+    from eth_consensus_specs_tpu.test_infra.context import expect_assertion_error
+
+    reqs = spec.ExecutionRequests()
+    reqs.withdrawals.append(
+        spec.WithdrawalRequest(
+            source_address=b"\x42" * 20,
+            validator_pubkey=state.validators[1].pubkey,
+            amount=0,
+        )
+    )
+    reqs.consolidations.append(
+        spec.ConsolidationRequest(
+            source_address=b"\x42" * 20,
+            source_pubkey=state.validators[1].pubkey,
+            target_pubkey=state.validators[2].pubkey,
+        )
+    )
+    encoded = spec.get_execution_requests_list(reqs)
+    # reversed type order must be refused
+    expect_assertion_error(lambda: spec.get_execution_requests(encoded[::-1]))
+    # duplicate type must be refused
+    expect_assertion_error(
+        lambda: spec.get_execution_requests([encoded[0], encoded[0]])
+    )
+    # empty payload must be refused
+    expect_assertion_error(
+        lambda: spec.get_execution_requests([bytes(spec.WITHDRAWAL_REQUEST_TYPE)])
+    )
+
+
+@with_phases(ELECTRA)
+@spec_state_test
+def test_eth1_pending_deposit_count_windows(spec, state):
+    """Bridge draining: count tracks min(deposit_count, start_index) minus
+    the consumed index, clamped by MAX_DEPOSITS."""
+    state.eth1_data.deposit_count = 10
+    state.deposit_requests_start_index = 6
+    state.eth1_deposit_index = 4
+    assert int(spec.get_eth1_pending_deposit_count(state)) == 2
+    state.eth1_deposit_index = 6
+    assert int(spec.get_eth1_pending_deposit_count(state)) == 0
+    state.eth1_deposit_index = 0
+    state.deposit_requests_start_index = 2**64 - 1  # pre-transition
+    state.eth1_data.deposit_count = 100
+    assert int(spec.get_eth1_pending_deposit_count(state)) == int(spec.MAX_DEPOSITS)
