@@ -27,6 +27,7 @@ from eth_consensus_specs_tpu.ssz import (
     List,
     Vector,
     hash_tree_root,
+    deserialize,
     serialize,
     uint64,
 )
@@ -754,8 +755,6 @@ class ElectraSpec(DenebSpec):
         """Inverse of the flat encoding: typed EL request bytes →
         ExecutionRequests, enforcing strictly-ascending unique types and
         non-empty payloads (specs/electra/validator.md:270-305)."""
-        from eth_consensus_specs_tpu.ssz import deserialize
-
         deposits = []
         withdrawals = []
         consolidations = []

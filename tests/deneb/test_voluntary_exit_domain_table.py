@@ -1,0 +1,91 @@
+"""Voluntary-exit signing-domain lock table (EIP-7044): post-deneb exits
+verify ONLY against the capella fork domain, regardless of the exit's
+epoch or the state's fork (reference analogue:
+eth2spec/test/deneb/block_processing/test_process_voluntary_exit.py;
+spec: specs/deneb/beacon-chain.md modified process_voluntary_exit)."""
+
+from eth_consensus_specs_tpu.test_infra.context import (
+    always_bls,
+    expect_assertion_error,
+    spec_state_test,
+    with_phases,
+)
+from eth_consensus_specs_tpu.test_infra.keys import privkeys
+from eth_consensus_specs_tpu.test_infra.state import transition_to
+from eth_consensus_specs_tpu.test_infra.voluntary_exits import sign_voluntary_exit
+
+POST_DENEB = ["deneb", "electra", "fulu"]
+
+
+def _agable_exit(spec, state, index=1):
+    transition_to(
+        spec,
+        state,
+        int(spec.config.SHARD_COMMITTEE_PERIOD) * int(spec.SLOTS_PER_EPOCH),
+    )
+    return spec.VoluntaryExit(
+        epoch=spec.get_current_epoch(state), validator_index=index
+    )
+
+
+@with_phases(POST_DENEB)
+@always_bls
+@spec_state_test
+def test_exit_locked_capella_domain_valid(spec, state):
+    exit_msg = _agable_exit(spec, state)
+    signed = sign_voluntary_exit(
+        spec, state, exit_msg, privkeys[1],
+        fork_version=spec.config.CAPELLA_FORK_VERSION,
+    )
+    spec.process_voluntary_exit(state, signed)
+    assert state.validators[1].exit_epoch != spec.FAR_FUTURE_EPOCH
+
+
+@with_phases(POST_DENEB)
+@always_bls
+@spec_state_test
+def test_exit_signed_with_current_fork_version_invalid(spec, state):
+    """The state's CURRENT fork version is the wrong domain post-deneb."""
+    exit_msg = _agable_exit(spec, state)
+    signed = sign_voluntary_exit(
+        spec, state, exit_msg, privkeys[1],
+        fork_version=state.fork.current_version,
+    )
+    if bytes(state.fork.current_version) == bytes(spec.config.CAPELLA_FORK_VERSION):
+        return  # degenerate config: nothing to distinguish
+    expect_assertion_error(lambda: spec.process_voluntary_exit(state, signed))
+
+
+@with_phases(POST_DENEB)
+@always_bls
+@spec_state_test
+def test_exit_signed_with_bellatrix_version_invalid(spec, state):
+    exit_msg = _agable_exit(spec, state)
+    signed = sign_voluntary_exit(
+        spec, state, exit_msg, privkeys[1],
+        fork_version=spec.config.BELLATRIX_FORK_VERSION,
+    )
+    expect_assertion_error(lambda: spec.process_voluntary_exit(state, signed))
+
+
+@with_phases(POST_DENEB)
+@always_bls
+@spec_state_test
+def test_exit_default_helper_signs_capella_domain(spec, state):
+    """The shared helper's default path produces the locked domain."""
+    exit_msg = _agable_exit(spec, state)
+    signed = sign_voluntary_exit(spec, state, exit_msg, privkeys[1])
+    spec.process_voluntary_exit(state, signed)
+    assert state.validators[1].exit_epoch != spec.FAR_FUTURE_EPOCH
+
+
+@with_phases(["capella"])
+@always_bls
+@spec_state_test
+def test_capella_exit_uses_state_fork_domain(spec, state):
+    """Pre-deneb the exit domain still follows the state fork (control
+    case for the lock)."""
+    exit_msg = _agable_exit(spec, state)
+    signed = sign_voluntary_exit(spec, state, exit_msg, privkeys[1])
+    spec.process_voluntary_exit(state, signed)
+    assert state.validators[1].exit_epoch != spec.FAR_FUTURE_EPOCH
