@@ -444,6 +444,37 @@ class GloasSpec(FuluSpec):
             participation_flag_indices.append(self.TIMELY_HEAD_FLAG_INDEX)
         return participation_flag_indices
 
+    def get_ptc_assignment(self, state, epoch: int, validator_index: int):
+        """The slot in `epoch` where the validator sits on the PTC, or
+        None (specs/gloas/validator.md:57-73; assignments are computable
+        one epoch ahead)."""
+        next_epoch = self.get_current_epoch(state) + 1
+        assert epoch <= next_epoch
+        start_slot = self.compute_start_slot_at_epoch(epoch)
+        for slot in range(start_slot, start_slot + self.SLOTS_PER_EPOCH):
+            if int(validator_index) in self.get_ptc(state, slot):
+                return slot
+        return None
+
+    def get_payload_attestation_message_signature(
+        self, state, attestation, privkey: int
+    ):
+        """specs/gloas/validator.md:213-219.
+
+        NOTE upstream asymmetry, mirrored faithfully: this helper derives
+        the domain from the ATTESTATION SLOT's epoch, while the on-chain
+        verifier is_valid_indexed_payload_attestation uses
+        get_domain(..., None) = the state's CURRENT epoch
+        (specs/gloas/beacon-chain.md:393). PTC attestations are same-slot
+        messages, so the two agree except across an epoch boundary."""
+        domain = self.get_domain(
+            state,
+            self.DOMAIN_PTC_ATTESTER,
+            self.compute_epoch_at_slot(attestation.data.slot),
+        )
+        signing_root = self.compute_signing_root(attestation.data, domain)
+        return bls.Sign(privkey, signing_root)
+
     def get_ptc(self, state, slot: int):
         """Payload-timeliness committee (:587-602)."""
         epoch = self.compute_epoch_at_slot(int(slot))

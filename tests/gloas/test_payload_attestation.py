@@ -7,6 +7,7 @@ from eth_consensus_specs_tpu.test_infra.block import (
     state_transition_and_sign_block,
 )
 from eth_consensus_specs_tpu.test_infra.context import (
+    always_bls,
     expect_assertion_error,
     spec_state_test,
     with_phases,
@@ -125,3 +126,74 @@ def test_block_carries_payload_attestation(spec, state):
     )
     block.body.payload_attestations = [att]
     state_transition_and_sign_block(spec, state, block)
+
+
+# == round-4: PTC duty helpers (specs/gloas/validator.md:57-73, 213-219) ===
+
+
+@with_phases(["gloas"])
+@spec_state_test
+def test_ptc_assignment_covers_every_member(spec, state):
+    """Every PTC member maps back to a slot whose committee contains it
+    (the FIRST such slot in the epoch)."""
+    epoch = spec.get_current_epoch(state)
+    start = int(spec.compute_start_slot_at_epoch(epoch))
+    for slot in range(start, start + 2):  # two slots keep it cheap
+        for member in set(spec.get_ptc(state, slot)):
+            assigned = spec.get_ptc_assignment(state, epoch, member)
+            assert assigned is not None
+            # the assignment is a slot whose PTC really contains the member
+            assert int(member) in set(
+                int(i) for i in spec.get_ptc(state, int(assigned))
+            )
+
+
+@with_phases(["gloas"])
+@spec_state_test
+def test_ptc_assignment_next_epoch_allowed_beyond_rejected(spec, state):
+    epoch = spec.get_current_epoch(state)
+    spec.get_ptc_assignment(state, epoch + 1, 0)  # computable one ahead
+    expect_assertion_error(lambda: spec.get_ptc_assignment(state, epoch + 2, 0))
+
+
+@with_phases(["gloas"])
+@spec_state_test
+def test_ptc_assignment_none_for_unassigned(spec, state):
+    """An index on no PTC of the epoch gets None."""
+    epoch = spec.get_current_epoch(state)
+    start = int(spec.compute_start_slot_at_epoch(epoch))
+    members = set()
+    for slot in range(start, start + int(spec.SLOTS_PER_EPOCH)):
+        members.update(int(i) for i in spec.get_ptc(state, slot))
+    outsiders = [i for i in range(len(state.validators)) if i not in members]
+    if outsiders:
+        assert spec.get_ptc_assignment(state, epoch, outsiders[0]) is None
+
+
+@with_phases(["gloas"])
+@always_bls
+@spec_state_test
+def test_payload_attestation_message_signature_verifies(spec, state):
+    """Signature verifies under the slot-epoch domain; within one epoch
+    (the PTC's same-slot regime) it equals the on-chain verifier's
+    current-epoch domain — the upstream asymmetry pinned here."""
+    data = spec.PayloadAttestationData(
+        beacon_block_root=b"\x12" * 32,
+        slot=state.slot,
+        payload_present=True,
+        blob_data_available=True,
+    )
+    msg = spec.PayloadAttestationMessage(
+        validator_index=3, data=data, signature=b"\x00" * 96
+    )
+    sig = spec.get_payload_attestation_message_signature(state, msg, privkeys[3])
+    helper_domain = spec.get_domain(
+        state, spec.DOMAIN_PTC_ATTESTER, spec.compute_epoch_at_slot(data.slot)
+    )
+    verifier_domain = spec.get_domain(state, spec.DOMAIN_PTC_ATTESTER, None)
+    assert bytes(helper_domain) == bytes(verifier_domain)  # same-epoch regime
+    assert bls.Verify(
+        state.validators[3].pubkey,
+        spec.compute_signing_root(data, helper_domain),
+        sig,
+    )
