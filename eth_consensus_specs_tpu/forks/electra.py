@@ -799,6 +799,14 @@ class ElectraSpec(DenebSpec):
             consolidations=consolidations,
         )
 
+    def get_eth1_vote(self, state, eth1_chain):
+        """[Modified in Electra:EIP6110] once the bridge is fully drained
+        the vote freezes at the current eth1_data — clients can then drop
+        the polling mechanism (specs/electra/validator.md:173-177)."""
+        if int(state.eth1_deposit_index) == int(state.deposit_requests_start_index):
+            return state.eth1_data
+        return super().get_eth1_vote(state, eth1_chain)
+
     def get_eth1_pending_deposit_count(self, state) -> int:
         """How many legacy bridge deposits the next block must carry
         (specs/electra/validator.md:157-165)."""

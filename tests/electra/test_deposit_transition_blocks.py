@@ -170,3 +170,23 @@ def test_transition_same_pubkey_bridge_and_request(spec, state):
     queued_before = len(state.pending_deposits)
     _apply(spec, state, deposits=deposits, requests=[request])
     assert len(state.pending_deposits) == queued_before + 2
+
+
+@with_phases(ELECTRA_ON)
+@spec_state_test
+def test_eth1_vote_freezes_after_bridge_drained(spec, state):
+    """[EIP-6110] eth1 polling ends with the bridge: the vote returns the
+    state's own eth1_data verbatim even when a candidate chain with a
+    DIFFERENT winning vote is available."""
+    from ..phase0.test_eth1_vote import _candidate_chain
+
+    chain = _candidate_chain(spec, state, 4)
+    live_vote = spec.get_eth1_data(chain[-1])
+    assert live_vote != state.eth1_data  # the chain would win if polled
+
+    state.deposit_requests_start_index = int(state.eth1_deposit_index)  # drained
+    assert spec.get_eth1_vote(state, chain) == state.eth1_data
+
+    # mid-transition the normal voting path still tallies the chain
+    state.deposit_requests_start_index = int(state.eth1_deposit_index) + 4
+    assert spec.get_eth1_vote(state, chain) == live_vote
