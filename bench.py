@@ -295,13 +295,27 @@ def _run_section(section: str, on_cpu: bool, no_cache: bool = False) -> None:
         # scale down to respect the section budget
         from eth_consensus_specs_tpu.native import get_bls_lib
 
+        device_pairing = False
+        if not on_cpu:
+            # hybrid mode: host C does aggregation/hash-to-curve/prepare,
+            # the one RLC Miller/final-exp batch runs on the accelerator.
+            # Only attempted when a prior completed run left the compiled
+            # chain in the persistent cache (sentinel) — a cold compile
+            # can exceed the whole section budget.
+            from eth_consensus_specs_tpu.utils.cache import pairing_warm_sentinel
+
+            if os.path.exists(pairing_warm_sentinel(jax.default_backend())):
+                os.environ["ETH_SPECS_TPU_DEVICE_PAIRING"] = "1"
+                device_pairing = True
         n = 64 if get_bls_lib() is not None else 4
         aggs_per_sec, batch_s = bench_batch_verify(n_aggregates=n)
         payload = {
             "aggs_per_sec": aggs_per_sec,
             "batch_s": batch_s,
             "n": n,
-            "pairing": "host-native-multi-miller",
+            "pairing": (
+                "device-miller" if device_pairing else "host-native-multi-miller"
+            ),
         }
     elif section == "das":
         batch = 2 if on_cpu else 16
@@ -493,9 +507,48 @@ def main() -> None:
     # — never attributed to the accelerator.
     bls_res = _section_in_subprocess("bls", on_cpu=True, timeout_s=_CPU_TIMEOUT_S)
     platforms["bls"] = "host-native" if bls_res is not None else "none"
+    # Opportunistic hybrid attempt — host C aggregation/hash-to-curve/
+    # prepare with the one RLC Miller/final-exp batch on the accelerator.
+    # Gated on the warm sentinel a previous completed device run leaves
+    # next to the persistent cache, so a cold compile (which can exceed
+    # the section budget) is never attempted blind.
+    if not acc.dead:
+        import glob as _glob
+
+        from eth_consensus_specs_tpu.utils.cache import cache_dir_path
+
+        if _glob.glob(_os.path.join(cache_dir_path(), "device_pairing_warm.*")):
+            dev_bls = _section_in_subprocess(
+                "bls", on_cpu=False, timeout_s=_ACC_TIMEOUT_S
+            )
+            if (
+                dev_bls is not None
+                and dev_bls.get("backend") not in (None, "cpu")
+                and dev_bls.get("pairing") == "device-miller"
+            ):
+                if dev_bls["aggs_per_sec"] > (
+                    bls_res["aggs_per_sec"] if bls_res else 0.0
+                ):
+                    bls_res = dev_bls
+                    platforms["bls"] = "accelerator-hybrid"
+                _store_lkg(
+                    {
+                        "bls": {
+                            "aggs_per_sec": round(dev_bls["aggs_per_sec"], 1),
+                            "pairing": "device-miller",
+                            "backend": dev_bls.get("backend"),
+                        }
+                    }
+                )
+            elif dev_bls is None:
+                # count only a dead/hung subprocess against the budget; a
+                # child that ran but chose host pairing (sentinel/backend
+                # mismatch) is not a tunnel failure
+                acc.failures += 1
     if bls_res is not None:
         print(
-            f"[bench] RLC batch verify ({bls_res['n']} aggregates, host-native): "
+            f"[bench] RLC batch verify ({bls_res['n']} aggregates, "
+            f"{bls_res.get('pairing', 'host-native')}): "
             f"{bls_res['aggs_per_sec']:.1f} aggregates/s "
             f"({bls_res['batch_s']*1e3:.0f} ms/batch, one pairing)",
             file=sys.stderr,

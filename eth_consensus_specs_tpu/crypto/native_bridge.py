@@ -281,6 +281,40 @@ def pairing_check_raw(pairs) -> bool:
     )
 
 
+def g2_prepare_many(points) -> "np.ndarray | None":
+    """Batched native producer of the device Miller kernel's per-step line
+    coefficients (the C side of ops/pairing_device: one lockstep affine ate
+    walk across all points with Montgomery batch inversions, emitting limbs
+    already in the device's 2^390-Montgomery 26-bit encoding).
+
+    points: list of ((x0, x1), (y0, y1)) affine subgroup G2 points (no
+    infinities — callers mask those out).  Returns u64[n, N_STEPS, 2, 2, 15]
+    or None when the native core is unavailable or the walk degenerated
+    (callers fall back to the per-point host oracle prepare_g2)."""
+    import numpy as np
+
+    if not enabled() or not points:
+        return None
+    lib = get_bls_lib()
+    if lib is None or not hasattr(lib, "bls_g2_prepare_many"):
+        return None
+    n = len(points)
+    g2s = bytearray()
+    for g2 in points:
+        b2, i2 = _g2_buf(g2)
+        if i2:
+            return None
+        g2s += b2
+    n_steps = 68  # 63 doublings + 5 additions (low set bits of |x|)
+    out = (ctypes.c_uint64 * (n * n_steps * 2 * 2 * 15))()
+    written = lib.bls_g2_prepare_many(
+        ctypes.c_uint64(n), _buf(bytes(g2s)), out
+    )
+    if written != n_steps:
+        return None
+    return np.frombuffer(out, dtype=np.uint64).reshape(n, n_steps, 2, 2, 15).copy()
+
+
 def pairing_gt_coeffs(g1, g2) -> list[tuple[int, int]]:
     """Full pairing; returns the six flattened w^i Fq2 coefficients of the
     GT element (exact value — matches the Python oracle bit-for-bit)."""

@@ -12,6 +12,7 @@ import ctypes
 import hashlib
 import os
 import subprocess
+import sys as _sys
 import sysconfig
 
 _DIR = os.path.dirname(os.path.abspath(__file__))
@@ -30,7 +31,47 @@ def _src_digest(*srcs: str) -> str:
     return h.hexdigest()
 
 
-def _ensure_shared(out: str, srcs: tuple[str, ...], opt: str, timeout: int) -> bool:
+def _cpu_isa_token() -> str:
+    """Coarse CPU-capability fingerprint for the build stamp (x86 ISA
+    extensions the optimized builds may use; empty off-x86/Linux)."""
+    try:
+        with open("/proc/cpuinfo") as f:
+            flags = ""
+            for line in f:
+                if line.startswith("flags"):
+                    flags = line
+                    break
+        return "+".join(t for t in ("bmi2", "adx") if f" {t}" in flags)
+    except OSError:
+        return "unknown"
+
+
+def _probe_ok(lib_path: str, symbol: str) -> bool:
+    """Run ``symbol()`` from the candidate library in a THROWAWAY child
+    process and require exit 0.  An ISA-extension build on a CPU without
+    those opcodes dies with SIGILL — isolating the first call keeps the
+    crash out of the importing process and lets the flag ladder fall back
+    to the portable build."""
+    code = (
+        "import ctypes,sys;"
+        f"sys.exit(0 if ctypes.CDLL({lib_path!r}).{symbol}() == 0 else 1)"
+    )
+    try:
+        res = subprocess.run(
+            [_sys.executable, "-c", code], capture_output=True, timeout=60
+        )
+        return res.returncode == 0
+    except (OSError, subprocess.SubprocessError):
+        return False
+
+
+def _ensure_shared(
+    out: str,
+    srcs: tuple[str, ...],
+    opt: str,
+    timeout: int,
+    probe_symbol: str | None = None,
+) -> bool:
     """Compile ``srcs[0]`` into ``out`` unless an object built from exactly
     these sources already exists. Freshness is a content-hash stamp file
     (``out + '.sha256'``), not mtimes: git does not preserve mtimes, so a
@@ -38,7 +79,12 @@ def _ensure_shared(out: str, srcs: tuple[str, ...], opt: str, timeout: int) -> b
     for consensus-critical code. Links to a per-process temp name, then
     atomically renames: concurrent first-use compilations (pytest-xdist,
     parallel imports) must never let a reader dlopen a partial object."""
-    want = _src_digest(*srcs)
+    # The stamp encodes source content AND the build variant AND the CPU
+    # capability the variant relies on: a checkout (or baked image) moved
+    # to a CPU without BMI2/ADX must MISS the stamp, re-enter the flag
+    # ladder, and let the crash-isolated probe reject the ISA build —
+    # never dlopen a mulx/adcx object into the importing process blind.
+    want = f"{_src_digest(*srcs)}:{opt}:{_cpu_isa_token()}"
     stamp = out + ".sha256"
     try:
         with open(stamp) as f:
@@ -48,15 +94,34 @@ def _ensure_shared(out: str, srcs: tuple[str, ...], opt: str, timeout: int) -> b
         pass
     cc = os.environ.get("CC") or sysconfig.get_config_var("CC") or "cc"
     tmp = f"{out}.{os.getpid()}.tmp"
-    cmd = cc.split() + [opt, "-fPIC", "-shared", "-o", tmp, srcs[0]]
-    try:
-        subprocess.run(cmd, check=True, capture_output=True, timeout=timeout)
-        os.replace(tmp, out)
-    except (OSError, subprocess.SubprocessError):
+    built = False
+    candidates = [opt.split(), [opt.split()[0]]]
+    if candidates[1] == candidates[0]:
+        candidates.pop()  # single-flag opt: no distinct fallback to try
+    for flags in candidates:
+        # first choice may carry ISA-extension flags (BMI2/ADX measurably
+        # speed the Montgomery carry chains); retry with the bare -O level
+        # for compilers that reject them or CPUs that trap on the opcodes
+        # (the probe below catches the latter in a crash-isolated child)
+        cmd = cc.split() + flags + ["-fPIC", "-shared", "-o", tmp, srcs[0]]
         try:
-            os.unlink(tmp)
-        except OSError:
-            pass
+            subprocess.run(cmd, check=True, capture_output=True, timeout=timeout)
+        except (OSError, subprocess.SubprocessError):
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+            continue
+        if probe_symbol is not None and not _probe_ok(tmp, probe_symbol):
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+            continue
+        os.replace(tmp, out)
+        built = True
+        break
+    if not built:
         return False
     # Stamp failure must not discard a successfully installed library —
     # worst case the next process recompiles once more.
@@ -135,7 +200,13 @@ _bls_tried = False
 
 def _compile_bls() -> bool:
     hdr = os.path.join(_DIR, "bls12_381_consts.h")
-    return _ensure_shared(_BLS_LIB_PATH, (_BLS_SRC, hdr), "-O3", 300)
+    return _ensure_shared(
+        _BLS_LIB_PATH,
+        (_BLS_SRC, hdr),
+        "-O3 -mbmi2 -madx -mtune=skylake-avx512",
+        300,
+        probe_symbol="bls_selftest",
+    )
 
 
 def get_bls_lib() -> ctypes.CDLL | None:
@@ -179,6 +250,8 @@ def get_bls_lib() -> ctypes.CDLL | None:
     lib.bls_g2_on_curve.restype = c.c_int
     lib.bls_pairing_check.argtypes = [c.c_uint64, u8p, u8p, u8p]
     lib.bls_pairing_check.restype = c.c_int
+    lib.bls_g2_prepare_many.argtypes = [c.c_uint64, u8p, c.POINTER(c.c_uint64)]
+    lib.bls_g2_prepare_many.restype = c.c_uint64
     lib.bls_pairing.argtypes = [u8p, u8p, u8p]
     lib.bls_fp_sqrt.argtypes = [u8p, u8p]
     lib.bls_fp_sqrt.restype = c.c_int

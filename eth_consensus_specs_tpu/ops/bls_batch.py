@@ -45,13 +45,21 @@ def _pairing_check_routed(pairs) -> bool:
     """Device Miller loop + membership check under the tpu backend; the
     host/native pairing elsewhere. Both are bit-equivalent implementations
     of the same check (tests/test_pairing_device.py), so routing can never
-    flip a verification result. ETH_SPECS_TPU_NO_DEVICE_PAIRING=1 forces
-    the host pairing even under the tpu backend (used by bench's XLA:CPU
-    fallback, where the device pairing's one-time compile would eat the
-    whole section budget)."""
+    flip a verification result. Env overrides (both read per call, so a
+    parent process can steer a child):
+
+      ETH_SPECS_TPU_NO_DEVICE_PAIRING=1  force HOST pairing even under the
+        tpu backend (bench's XLA:CPU fallback, where the device pairing's
+        one-time compile would eat the whole section budget);
+      ETH_SPECS_TPU_DEVICE_PAIRING=1     force DEVICE pairing even when the
+        bls backend switch is elsewhere — the bench's hybrid mode: host C
+        aggregation (one core, no dispatch round-trips) + the one batched
+        Miller/final-exp on the accelerator."""
     import os
 
-    if _use_device() and not os.environ.get("ETH_SPECS_TPU_NO_DEVICE_PAIRING"):
+    if os.environ.get("ETH_SPECS_TPU_NO_DEVICE_PAIRING"):
+        return pairing_check(pairs)
+    if _use_device() or os.environ.get("ETH_SPECS_TPU_DEVICE_PAIRING"):
         from eth_consensus_specs_tpu.ops.pairing_device import pairing_check_device
 
         return pairing_check_device(pairs)

@@ -307,9 +307,51 @@ def _miller_chunk_fold(coeffs, px, py, active):
     return fs_v[0]
 
 
+def _prepare_all(pairs: list) -> None:
+    """Fill _PREP_CACHE for every live G2 point in `pairs` in ONE native
+    lockstep walk (bls_g2_prepare_many: Montgomery batch inversions across
+    all points, limbs emitted directly in the device encoding).  Fresh Qs
+    are the common case on the signature path — every distinct message is
+    a fresh hash-to-curve point, and the per-point host oracle walk costs
+    ~5 ms each — so this is what makes the device pairing win on fresh
+    batches, not just on cache-friendly fixed-setup (KZG) workloads.
+    Falls back silently to per-point prepare_g2 inside _prepared()."""
+    from eth_consensus_specs_tpu.crypto import native_bridge as nb
+
+    fresh = []
+    seen = set()
+    for p, q in pairs:
+        if p.is_infinity() or q.is_infinity():
+            continue
+        key = (q.x, q.y)
+        if key in _PREP_CACHE or key in seen:
+            continue
+        seen.add(key)
+        fresh.append(q)
+    if len(fresh) < 2:  # a single walk gains nothing over the oracle path
+        return
+    rows = nb.g2_prepare_many(
+        [((q.x.c0.n, q.x.c1.n), (q.y.c0.n, q.y.c1.n)) for q in fresh]
+    )
+    if rows is None:
+        return
+    if len(_PREP_CACHE) + len(fresh) > 256:
+        # evict only entries THIS batch does not need — clearing wholesale
+        # would push the batch's own cached points back onto the ~5 ms
+        # per-point host walk the pre-fill exists to avoid
+        needed = seen | {
+            (q.x, q.y) for p, q in pairs if not (p.is_infinity() or q.is_infinity())
+        }
+        for key in [k for k in _PREP_CACHE if k not in needed]:
+            del _PREP_CACHE[key]
+    for q, row in zip(fresh, rows):
+        _PREP_CACHE[(q.x, q.y)] = row
+
+
 def _miller_product(pairs: list):
     """Product of Miller values over (G1, G2) pairs as a normalized limb
     array, chunked to the fixed-size kernel."""
+    _prepare_all(pairs)
     n_chunks = (len(pairs) + _CHUNK - 1) // _CHUNK
     total = None
     for ci in range(n_chunks):
@@ -331,13 +373,45 @@ def _miller_product(pairs: list):
     return total
 
 
+_WARM_MARKED = False
+
+
+def _mark_warm() -> None:
+    """Record (once per process) that the full device chain has executed —
+    with the persistent cache enabled this means a later process gets a
+    warm start, which is what the bench's sentinel check keys off."""
+    global _WARM_MARKED
+    if _WARM_MARKED:
+        return
+    _WARM_MARKED = True
+    try:
+        from eth_consensus_specs_tpu.utils import cache as _cache
+
+        if not _cache._enabled:
+            return
+        import jax
+
+        backend = jax.default_backend()
+        if backend == "cpu":
+            # enable_persistent_cache refuses the cpu backend, so this is
+            # unreachable today — kept as a guard so a cpu sentinel can
+            # never tease the bench into a doomed accelerator attempt
+            return
+        with open(_cache.pairing_warm_sentinel(backend), "w") as fh:
+            fh.write("ok\n")
+    except Exception:
+        pass
+
+
 def pairing_check_device(pairs: list) -> bool:
     """prod e(P_i, Q_i) == 1 with the Miller accumulation and final-exp
     membership check on device. Pairs are (G1 Point, G2 Point) host
     objects (subgroup-checked at deserialization)."""
     if not pairs:
         return True
-    return final_exp_is_one(_miller_product(pairs))
+    ok = final_exp_is_one(_miller_product(pairs))
+    _mark_warm()
+    return ok
 
 
 _PREP_CACHE: dict = {}

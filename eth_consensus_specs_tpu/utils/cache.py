@@ -9,6 +9,7 @@ from __future__ import annotations
 import os
 
 _enabled = False
+_enabled_dir: str | None = None
 
 
 def enable_persistent_cache(cache_dir: str | None = None) -> str | None:
@@ -31,9 +32,31 @@ def enable_persistent_cache(cache_dir: str | None = None) -> str | None:
     if cache_dir is None:
         repo_root = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
         cache_dir = os.path.join(repo_root, ".jax_cache")
+    global _enabled_dir
     if not _enabled:
         os.makedirs(cache_dir, exist_ok=True)
         jax.config.update("jax_compilation_cache_dir", cache_dir)
         jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
         _enabled = True
-    return cache_dir
+        _enabled_dir = cache_dir
+    return _enabled_dir
+
+
+def cache_dir_path() -> str:
+    """The cache directory actually enabled this process, falling back to
+    the default location — keeps the warm sentinel co-located with the
+    executables it vouches for even under a custom cache_dir."""
+    if _enabled_dir is not None:
+        return _enabled_dir
+    repo_root = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    return os.path.join(repo_root, ".jax_cache")
+
+
+def pairing_warm_sentinel(backend: str) -> str:
+    """Marker file recording that the device pairing chain compiled to
+    completion for `backend` with the entries persisted in the cache.
+    Lets the bench attempt the device pairing only when a warm start is
+    plausible — a cold compile of the Miller/final-exp chain can exceed
+    the whole section budget (round-3 lesson: never let one slow compile
+    strand a measurement)."""
+    return os.path.join(cache_dir_path(), f"device_pairing_warm.{backend}")

@@ -1,0 +1,69 @@
+"""Native batched G2 line-coefficient producer vs the host oracle.
+
+`bls_g2_prepare_many` (native/bls12_381.c) walks all G2 points of a
+pairing batch in lockstep — Montgomery batch inversions across walks,
+limbs emitted directly in the device kernel's 2^390-Montgomery 26-bit
+encoding — and must reproduce ops/pairing_device.prepare_g2 (the
+per-point host oracle) BIT-FOR-BIT, because both feed the same device
+Miller kernel.  Reference seam being accelerated: the per-verification
+pairing inputs of utils/bls.py:224-296.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from eth_consensus_specs_tpu.crypto import native_bridge as nb
+from eth_consensus_specs_tpu.crypto.curve import g2_generator
+from eth_consensus_specs_tpu.crypto.hash_to_curve import hash_to_g2
+
+pytestmark = pytest.mark.skipif(
+    not nb.enabled(), reason="native core unavailable"
+)
+
+
+def _tuples(q):
+    return ((q.x.c0.n, q.x.c1.n), (q.y.c0.n, q.y.c1.n))
+
+
+def test_native_prepare_matches_host_oracle():
+    from eth_consensus_specs_tpu.ops.pairing_device import prepare_g2
+
+    qs = [g2_generator().mul(i + 3) for i in range(3)]
+    qs += [hash_to_g2(bytes([i])) for i in range(3)]
+    rows = nb.g2_prepare_many([_tuples(q) for q in qs])
+    assert rows is not None
+    assert rows.shape[0] == len(qs)
+    for i, q in enumerate(qs):
+        ref = prepare_g2(q)
+        assert ref.shape == rows[i].shape
+        assert np.array_equal(ref, rows[i]), f"row mismatch for point {i}"
+
+
+def test_native_prepare_rejects_infinity():
+    # callers mask infinities before batching; the bridge refuses them
+    assert nb.g2_prepare_many([None]) is None
+
+
+def test_native_prepare_empty():
+    assert nb.g2_prepare_many([]) is None
+
+
+def test_prepare_all_fills_cache_identically():
+    """The batch pre-fill path must leave exactly what per-point prepare
+    would have computed (a wrong cache entry would silently corrupt every
+    later pairing that hits it)."""
+    from eth_consensus_specs_tpu.ops import pairing_device as pd
+
+    g1 = __import__(
+        "eth_consensus_specs_tpu.crypto.curve", fromlist=["g1_generator"]
+    ).g1_generator()
+    qs = [hash_to_g2(b"cache-%d" % i) for i in range(3)]
+    pairs = [(g1, q) for q in qs]
+    pd._PREP_CACHE.clear()
+    pd._prepare_all(pairs)
+    assert len(pd._PREP_CACHE) == len(qs)
+    for q in qs:
+        assert np.array_equal(pd._PREP_CACHE[(q.x, q.y)], pd.prepare_g2(q))
+    pd._PREP_CACHE.clear()
