@@ -302,6 +302,15 @@ class BellatrixSpec(AltairSpec):
         pow_parent = self.get_pow_block(pow_block.parent_hash)
         assert self.is_valid_terminal_pow_block(pow_block, pow_parent), "invalid terminal block"
 
+    def _merge_block_gate(self, store, block) -> None:
+        """[New in Bellatrix] fork-choice on_block runs validate_merge_block
+        for the transition block, judged against the PARENT (pre) state —
+        the post-state is always merge-complete once the block carries a
+        payload (specs/bellatrix/fork-choice.md on_block:303-304)."""
+        pre_state = store.block_states[block.parent_root]
+        if self.is_merge_transition_block(pre_state, block.body):
+            self.validate_merge_block(block)
+
     # == proposer re-org fcU suppression (specs/bellatrix/fork-choice.md:98-175)
 
     def validator_is_connected(self, validator_index: int) -> bool:

@@ -1908,6 +1908,11 @@ class Phase0Spec:
 
         self.state_transition(state, signed_block, True)
 
+        # merge-transition gate: no-op pre-bellatrix (overridden to run
+        # validate_merge_block against the PRE-state, specs/bellatrix/
+        # fork-choice.md on_block "[New in Bellatrix]")
+        self._merge_block_gate(store, block)
+
         block_root = hash_tree_root(block)
         store.blocks[block_root] = block.copy()
         store.block_states[block_root] = state
@@ -1929,6 +1934,10 @@ class Phase0Spec:
 
     def _data_availability_check(self, block) -> None:
         """Fork-choice data-availability gate; phase0 has no blob data."""
+
+    def _merge_block_gate(self, store, block) -> None:
+        """Terminal-PoW-block gate for merge-transition blocks; phase0 has
+        no execution payloads."""
 
     def validate_target_epoch_against_current_time(self, store, attestation) -> None:
         target = attestation.data.target

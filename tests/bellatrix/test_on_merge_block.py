@@ -1,0 +1,128 @@
+"""Fork-choice gate for the merge-transition block.
+
+[New in Bellatrix] `on_block` must run `validate_merge_block` for a block
+whose body carries the FIRST execution payload, judged against the parent
+(pre) state — the terminal PoW block referenced by the payload must reach
+TERMINAL_TOTAL_DIFFICULTY while its own parent stays below it.  Reference
+surface: specs/bellatrix/fork-choice.md on_block:271-304 +
+validate_merge_block:236-268; scenario analogue:
+eth2spec/test/bellatrix/fork_choice/test_on_merge_block.py.
+"""
+
+from __future__ import annotations
+
+from eth_consensus_specs_tpu.ssz import Bytes32
+from eth_consensus_specs_tpu.test_infra.block import (
+    build_empty_block_for_next_slot,
+    state_transition_and_sign_block,
+)
+from eth_consensus_specs_tpu.test_infra.context import (
+    spec_state_test,
+    with_phases,
+)
+from eth_consensus_specs_tpu.test_infra.execution_payload import (
+    build_empty_execution_payload,
+    compute_el_block_hash,
+)
+from eth_consensus_specs_tpu.test_infra.fork_choice import (
+    get_genesis_forkchoice_store,
+    tick_and_add_block,
+)
+from eth_consensus_specs_tpu.test_infra.pow_block import (
+    pow_block_store,
+    prepare_random_pow_chain,
+)
+
+BELLATRIX = ["bellatrix"]
+
+
+def _ttd(spec) -> int:
+    return int(spec.config.TERMINAL_TOTAL_DIFFICULTY)
+
+
+def _merge_chain(spec, pow_td: int, parent_td: int):
+    """Two-block fake PoW chain with chosen total difficulties."""
+    chain = prepare_random_pow_chain(spec, 2)
+    chain.head(-1).total_difficulty = parent_td
+    chain.head().total_difficulty = pow_td
+    return chain
+
+
+def _run_transition_block(spec, state, chain, drop_pow_block=False, valid=True):
+    """Drive the transition block through fork-choice on_block with the
+    fake PoW accessor installed."""
+    state.latest_execution_payload_header = spec.ExecutionPayloadHeader()
+    assert not spec.is_merge_transition_complete(state)
+    store, _ = get_genesis_forkchoice_store(spec, state)
+
+    block = build_empty_block_for_next_slot(spec, state)
+    shifted = state.copy()
+    spec.process_slots(shifted, block.slot)  # payload fields are slot-relative
+    payload = build_empty_execution_payload(spec, shifted)
+    payload.parent_hash = Bytes32(bytes(chain.head().block_hash))
+    payload.block_hash = Bytes32(compute_el_block_hash(spec, payload))
+    block.body.execution_payload = payload
+    # fills the post-state root and signs; the PoW gate lives only in the
+    # fork-choice handler, so signing succeeds even for gated blocks
+    signed = state_transition_and_sign_block(spec, state.copy(), block)
+
+    blocks = chain.blocks[:-1] if drop_pow_block else chain.blocks
+    with pow_block_store(spec, type(chain)(blocks)):
+        root = tick_and_add_block(spec, store, signed, valid=valid)
+    if valid:
+        assert root is not None
+    return store, block
+
+
+@with_phases(BELLATRIX)
+@spec_state_test
+def test_on_merge_block_all_valid(spec, state):
+    chain = _merge_chain(spec, pow_td=_ttd(spec), parent_td=_ttd(spec) - 1)
+    _run_transition_block(spec, state, chain, valid=True)
+
+
+@with_phases(BELLATRIX)
+@spec_state_test
+def test_on_merge_block_pow_lookup_failed(spec, state):
+    chain = _merge_chain(spec, pow_td=_ttd(spec), parent_td=_ttd(spec) - 1)
+    _run_transition_block(spec, state, chain, drop_pow_block=True, valid=False)
+
+
+@with_phases(BELLATRIX)
+@spec_state_test
+def test_on_merge_block_too_early(spec, state):
+    # terminal candidate has not reached TTD yet
+    chain = _merge_chain(spec, pow_td=_ttd(spec) - 1, parent_td=_ttd(spec) - 2)
+    _run_transition_block(spec, state, chain, valid=False)
+
+
+@with_phases(BELLATRIX)
+@spec_state_test
+def test_on_merge_block_too_late(spec, state):
+    # parent already reached TTD: the referenced block is not terminal
+    chain = _merge_chain(spec, pow_td=_ttd(spec) + 1, parent_td=_ttd(spec))
+    _run_transition_block(spec, state, chain, valid=False)
+
+
+@with_phases(BELLATRIX)
+@spec_state_test
+def test_on_merge_block_post_merge_no_gate(spec, state):
+    """A regular post-merge block never consults the PoW accessor — the
+    gate keys off is_merge_transition_block(pre_state, body)."""
+    assert spec.is_merge_transition_complete(state)
+    store, _ = get_genesis_forkchoice_store(spec, state)
+    block = build_empty_block_for_next_slot(spec, state)
+    shifted = state.copy()
+    spec.process_slots(shifted, block.slot)
+    block.body.execution_payload = build_empty_execution_payload(spec, shifted)
+    signed = state_transition_and_sign_block(spec, state.copy(), block)
+
+    def exploding_accessor(block_hash):
+        raise AssertionError("post-merge on_block must not fetch PoW blocks")
+
+    original = spec.get_pow_block
+    spec.get_pow_block = exploding_accessor
+    try:
+        tick_and_add_block(spec, store, signed, valid=True)
+    finally:
+        spec.get_pow_block = original
