@@ -1,0 +1,145 @@
+"""ExecutionEngine protocol semantics through process_execution_payload.
+
+The engine is the one implementation-defined seam of the state machine;
+these unittests pin how verdicts and the composite verify flow couple
+into block processing (reference surface: specs/bellatrix/beacon-chain.md
+process_execution_payload + the engine protocol; scenario analogue:
+eth2spec/test/bellatrix/unittests/test_execution_engine_interface.py).
+"""
+
+from __future__ import annotations
+
+from eth_consensus_specs_tpu.ssz import Bytes32
+from eth_consensus_specs_tpu.test_infra.context import (
+    expect_assertion_error,
+    spec_state_test,
+    with_phases,
+)
+from eth_consensus_specs_tpu.test_infra.execution_payload import (
+    build_empty_execution_payload,
+    compute_el_block_hash,
+)
+from eth_consensus_specs_tpu.test_infra.forks import is_post_deneb
+from eth_consensus_specs_tpu.test_infra.state import next_slot
+
+BELLATRIX_ON = ["bellatrix", "capella", "deneb", "electra", "fulu"]
+POST_DENEB = ["deneb", "electra", "fulu"]
+
+
+class VerdictEngine:
+    """Test double recording calls and returning scripted verdicts."""
+
+    def __init__(self, spec, notify=True, block_hash=True, versioned=True):
+        self._spec = spec
+        self.notify_verdict = notify
+        self.block_hash_verdict = block_hash
+        self.versioned_verdict = versioned
+        self.calls: list[str] = []
+
+    def notify_new_payload(self, execution_payload, *args) -> bool:
+        self.calls.append("notify_new_payload")
+        return self.notify_verdict
+
+    def is_valid_block_hash(self, execution_payload, *args) -> bool:
+        self.calls.append("is_valid_block_hash")
+        return self.block_hash_verdict
+
+    def is_valid_versioned_hashes(self, new_payload_request) -> bool:
+        self.calls.append("is_valid_versioned_hashes")
+        return self.versioned_verdict
+
+    def verify_and_notify_new_payload(self, new_payload_request) -> bool:
+        self.calls.append("verify_and_notify_new_payload")
+        return self.spec_composite_verify(new_payload_request)
+
+
+def _engine(spec, **verdicts):
+    eng = VerdictEngine(spec, **verdicts)
+    # Bind the PHASE'S normative composite so the flow under test is the
+    # real per-fork one, with this double supplying the sub-verdicts.
+    # Bellatrix/capella keep the normative flow in spec_composite_verify
+    # (their injected verify_and_notify is the permissive test engine);
+    # deneb+ engines' verify_and_notify_new_payload IS the normative
+    # composite (adds is_valid_versioned_hashes, electra adds requests).
+    cls = type(spec.EXECUTION_ENGINE)
+    if is_post_deneb(spec):
+        composite = cls.verify_and_notify_new_payload
+    else:
+        composite = cls.spec_composite_verify
+    eng.spec_composite_verify = composite.__get__(eng)
+    return eng
+
+
+def _payload_body(spec, state):
+    next_slot(spec, state)
+    payload = build_empty_execution_payload(spec, state)
+    return spec.BeaconBlockBody(execution_payload=payload), payload
+
+
+@with_phases(BELLATRIX_ON)
+@spec_state_test
+def test_engine_accept_updates_header(spec, state):
+    body, payload = _payload_body(spec, state)
+    eng = _engine(spec)
+    spec.process_execution_payload(state, body, eng)
+    assert "verify_and_notify_new_payload" in eng.calls
+    assert state.latest_execution_payload_header.block_hash == payload.block_hash
+
+
+@with_phases(BELLATRIX_ON)
+@spec_state_test
+def test_engine_notify_reject_invalidates_block(spec, state):
+    body, _ = _payload_body(spec, state)
+    eng = _engine(spec, notify=False)
+    expect_assertion_error(lambda: spec.process_execution_payload(state, body, eng))
+    assert "notify_new_payload" in eng.calls
+
+
+@with_phases(BELLATRIX_ON)
+@spec_state_test
+def test_engine_bad_block_hash_short_circuits_notify(spec, state):
+    """The composite checks the block hash BEFORE notifying — a payload
+    with an invalid hash must never reach the engine's notifier."""
+    body, _ = _payload_body(spec, state)
+    eng = _engine(spec, block_hash=False)
+    expect_assertion_error(lambda: spec.process_execution_payload(state, body, eng))
+    assert "is_valid_block_hash" in eng.calls
+    assert "notify_new_payload" not in eng.calls
+
+
+@with_phases(BELLATRIX_ON)
+@spec_state_test
+def test_engine_empty_transaction_rejected_by_composite(spec, state):
+    """A zero-length transaction is malformed RLP by definition; the
+    normative composite rejects it before any engine callback."""
+    body, _ = _payload_body(spec, state)
+    body.execution_payload.transactions = [b""]
+    body.execution_payload.block_hash = Bytes32(
+        compute_el_block_hash(spec, body.execution_payload)
+    )
+    eng = _engine(spec)
+    expect_assertion_error(lambda: spec.process_execution_payload(state, body, eng))
+    assert "notify_new_payload" not in eng.calls
+
+
+@with_phases(POST_DENEB)
+@spec_state_test
+def test_engine_bad_versioned_hashes_invalidates_block(spec, state):
+    """Deneb+: the versioned-hash check sits between the block-hash check
+    and the notifier in the normative flow."""
+    body, _ = _payload_body(spec, state)
+    eng = _engine(spec, versioned=False)
+    expect_assertion_error(lambda: spec.process_execution_payload(state, body, eng))
+    assert "is_valid_block_hash" in eng.calls
+    assert "is_valid_versioned_hashes" in eng.calls
+    assert "notify_new_payload" not in eng.calls
+
+
+@with_phases(BELLATRIX_ON)
+@spec_state_test
+def test_engine_noop_accepts_everything(spec, state):
+    """The injected test engine mirrors the reference's NoopExecutionEngine:
+    every verdict is True, so an empty payload body processes cleanly."""
+    body, payload = _payload_body(spec, state)
+    spec.process_execution_payload(state, body, spec.EXECUTION_ENGINE)
+    assert state.latest_execution_payload_header.block_hash == payload.block_hash

@@ -1,0 +1,88 @@
+"""Pre-EIP-7044 voluntary-exit domain selection.
+
+Before deneb, the exit's signing domain follows the fork version ACTIVE AT
+THE EXIT'S EPOCH (get_domain with epoch=exit.epoch picks previous_version
+for epochs before state.fork.epoch) — so after an upgrade, old exits
+remain valid only under the old fork version and new exits only under the
+new one.  Deneb then freezes the domain at capella
+(tests/deneb/test_voluntary_exit_domain_table.py covers that side).
+Reference analogue: eth2spec/test/bellatrix/block_processing/
+test_process_voluntary_exit.py; spec: specs/phase0/beacon-chain.md
+get_domain + process_voluntary_exit.
+"""
+
+from __future__ import annotations
+
+from eth_consensus_specs_tpu.test_infra.context import (
+    always_bls,
+    expect_assertion_error,
+    spec_state_test,
+    with_phases,
+)
+from eth_consensus_specs_tpu.test_infra.keys import privkeys
+from eth_consensus_specs_tpu.test_infra.state import transition_to
+from eth_consensus_specs_tpu.test_infra.voluntary_exits import sign_voluntary_exit
+
+PRE_7044 = ["bellatrix", "capella"]
+
+
+def _setup(spec, state, exit_epoch_before_fork: bool):
+    """Age the validator set past the shard-committee period and place the
+    state's fork boundary so the exit epoch falls on the requested side."""
+    transition_to(
+        spec,
+        state,
+        int(spec.config.SHARD_COMMITTEE_PERIOD) * int(spec.SLOTS_PER_EPOCH) + 1,
+    )
+    current = int(spec.get_current_epoch(state))
+    if exit_epoch_before_fork:
+        # pretend the current fork activated last epoch; exit one before
+        state.fork.epoch = current
+        exit_epoch = current - 1
+    else:
+        state.fork.epoch = 0
+        exit_epoch = current
+    return spec.VoluntaryExit(epoch=exit_epoch, validator_index=1)
+
+
+def _run(spec, state, exit_msg, fork_version, valid: bool):
+    signed = sign_voluntary_exit(
+        spec, state, exit_msg, privkeys[1], fork_version=fork_version
+    )
+    if valid:
+        spec.process_voluntary_exit(state, signed)
+        assert state.validators[1].exit_epoch != spec.FAR_FUTURE_EPOCH
+    else:
+        expect_assertion_error(lambda: spec.process_voluntary_exit(state, signed))
+
+
+@with_phases(PRE_7044)
+@always_bls
+@spec_state_test
+def test_exit_before_fork_epoch_signed_with_previous_version(spec, state):
+    exit_msg = _setup(spec, state, exit_epoch_before_fork=True)
+    _run(spec, state, exit_msg, state.fork.previous_version, valid=True)
+
+
+@with_phases(PRE_7044)
+@always_bls
+@spec_state_test
+def test_exit_before_fork_epoch_signed_with_current_version_invalid(spec, state):
+    exit_msg = _setup(spec, state, exit_epoch_before_fork=True)
+    _run(spec, state, exit_msg, state.fork.current_version, valid=False)
+
+
+@with_phases(PRE_7044)
+@always_bls
+@spec_state_test
+def test_exit_after_fork_epoch_signed_with_current_version(spec, state):
+    exit_msg = _setup(spec, state, exit_epoch_before_fork=False)
+    _run(spec, state, exit_msg, state.fork.current_version, valid=True)
+
+
+@with_phases(PRE_7044)
+@always_bls
+@spec_state_test
+def test_exit_after_fork_epoch_signed_with_previous_version_invalid(spec, state):
+    exit_msg = _setup(spec, state, exit_epoch_before_fork=False)
+    _run(spec, state, exit_msg, state.fork.previous_version, valid=False)

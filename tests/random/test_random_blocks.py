@@ -40,10 +40,16 @@ def _random_chain(spec, state, rng, n_slots: int):
         if action < 0.25:
             next_slot(spec, state)  # empty slot
             continue
-        # a slashed proposer cannot produce a block; the slot stays empty
+        # a slashed proposer cannot produce a block, and under the
+        # EIP-7917 lookahead (fulu+) a proposer pinned before a
+        # randomized exit may no longer be active — gloas then rejects
+        # its self-built bid ("builder not active"); both slots stay empty
         probe = state.copy()
         spec.process_slots(probe, int(state.slot) + 1)
-        if probe.validators[spec.get_beacon_proposer_index(probe)].slashed:
+        proposer = probe.validators[spec.get_beacon_proposer_index(probe)]
+        if proposer.slashed or not spec.is_active_validator(
+            proposer, spec.get_current_epoch(probe)
+        ):
             next_slot(spec, state)
             continue
         block = build_empty_block_for_next_slot(spec, state)
@@ -58,14 +64,27 @@ def _random_chain(spec, state, rng, n_slots: int):
             slashing = get_valid_proposer_slashing(
                 spec, state, signed_1=True, signed_2=True
             )
-            block.body.proposer_slashings.append(slashing)
-            slashed_proposer = True
+            # randomized states may have exited/slashed the helper's pick
+            target = state.validators[
+                int(slashing.signed_header_1.message.proposer_index)
+            ]
+            if spec.is_slashable_validator(target, spec.get_current_epoch(state)):
+                block.body.proposer_slashings.append(slashing)
+                slashed_proposer = True
         elif action > 0.9 and not slashed_attester:
             slashing = get_valid_attester_slashing(
                 spec, state, signed_1=True, signed_2=True
             )
-            block.body.attester_slashings.append(slashing)
-            slashed_attester = True
+            indices = set(
+                int(i) for i in slashing.attestation_1.attesting_indices
+            ) & set(int(i) for i in slashing.attestation_2.attesting_indices)
+            epoch = spec.get_current_epoch(state)
+            if any(
+                spec.is_slashable_validator(state.validators[i], epoch)
+                for i in indices
+            ):
+                block.body.attester_slashings.append(slashing)
+                slashed_attester = True
         elif action > 0.85 and not exited and int(state.slot) > (
             spec.config.SHARD_COMMITTEE_PERIOD * spec.SLOTS_PER_EPOCH
         ):

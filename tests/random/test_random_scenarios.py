@@ -21,6 +21,9 @@ from eth_consensus_specs_tpu.test_infra.template import instantiate
 from .test_random_blocks import _random_chain
 
 PHASES = ["phase0", "altair", "bellatrix", "capella", "deneb", "electra"]
+# the newest forks run the same scenarios (gloas blocks carry bids/PTC
+# machinery through the same helpers, fulu adds nothing block-shaped)
+ALL_PHASES = PHASES + ["fulu", "gloas"]
 
 
 def randomize_state(spec, state, rng, exit_fraction=0.1, slash_fraction=0.1):
@@ -67,13 +70,31 @@ def _check_invariants(spec, state):
         assert int(validator.effective_balance) % inc == 0
 
 
-def _scenario_case(seed: int, leak: bool, epochs_of_blocks: int):
-    @with_phases(PHASES)
+def _scenario_case(
+    seed: int,
+    leak: bool,
+    epochs_of_blocks: int,
+    exit_fraction: float = 0.1,
+    slash_fraction: float = 0.1,
+    shape: str = "mixed",
+    phases=None,
+):
+    @with_phases(phases or PHASES)
     @spec_state_test
     def case(spec, state):
         rng = random.Random(seed)
         next_epoch(spec, state)
-        randomize_state(spec, state, rng)
+        randomize_state(
+            spec,
+            state,
+            rng,
+            exit_fraction=exit_fraction,
+            slash_fraction=slash_fraction,
+        )
+        if shape == "low_balance":
+            cap = int(spec.MAX_EFFECTIVE_BALANCE)
+            for index in range(len(state.balances)):
+                state.balances[index] = cap // 2
         if leak:
             _force_leak(spec, state)
             assert spec.is_in_inactivity_leak(state)
@@ -89,7 +110,8 @@ def _scenario_case(seed: int, leak: bool, epochs_of_blocks: int):
         assert root_1 == bytes(hash_tree_root(state))
 
     leak_tag = "leak" if leak else "no_leak"
-    return case, f"test_randomized_{seed}_{leak_tag}_{epochs_of_blocks}ep"
+    tag = "" if shape == "mixed" else f"_{shape}"
+    return case, f"test_randomized_{seed}_{leak_tag}_{epochs_of_blocks}ep{tag}"
 
 
 _SCENARIOS = [
@@ -105,6 +127,53 @@ _SCENARIOS = [
 
 for _seed, _leak, _epochs in _SCENARIOS:
     instantiate(_scenario_case, _seed, _leak, _epochs)
+
+# shape variants (the reference random matrix varies the randomized-state
+# mix the same way: exit-heavy, slashing-heavy, low-balance worlds)
+_SHAPED = [
+    (10, False, 1, 0.4, 0.05, "exit_heavy"),
+    (11, True, 1, 0.4, 0.05, "exit_heavy"),
+    (12, False, 1, 0.05, 0.4, "slash_heavy"),
+    (13, True, 1, 0.05, 0.4, "slash_heavy"),
+    (14, False, 1, 0.1, 0.1, "low_balance"),
+    (15, True, 1, 0.1, 0.1, "low_balance"),
+]
+
+for _seed, _leak, _epochs, _ef, _sf, _shape in _SHAPED:
+    instantiate(_scenario_case, _seed, _leak, _epochs, _ef, _sf, _shape)
+
+# the newest forks, default mix (separate rows so a gloas/fulu-only break
+# is visible as its own failing case)
+for _seed in (20, 21):
+    instantiate(_scenario_case, _seed, False, 1, 0.1, 0.1, "mixed", ALL_PHASES)
+instantiate(_scenario_case, 22, True, 1, 0.1, 0.1, "mixed", ALL_PHASES)
+
+
+@with_phases(PHASES)
+@spec_state_test
+def test_randomized_leak_then_recovery(spec, state):
+    """Leak ends when finality resumes: inactivity scores must stop
+    growing and the chain processes cleanly afterwards (reference
+    scenario family: leak → epochs_until_no_leak → blocks)."""
+    rng = random.Random(50)
+    next_epoch(spec, state)
+    randomize_state(spec, state, rng, exit_fraction=0.05, slash_fraction=0.05)
+    _force_leak(spec, state)
+    assert spec.is_in_inactivity_leak(state)
+    # finality resumes: justify recent epochs via the justification bits
+    epoch = int(spec.get_current_epoch(state))
+    state.finalized_checkpoint.epoch = max(epoch - 2, 0)
+    state.current_justified_checkpoint.epoch = max(epoch - 1, 0)
+    assert not spec.is_in_inactivity_leak(state)
+    if is_post_altair(spec):
+        before = list(state.inactivity_scores)[:8]
+    _random_chain(spec, state, rng, int(spec.SLOTS_PER_EPOCH))
+    next_epoch(spec, state)
+    _check_invariants(spec, state)
+    if is_post_altair(spec):
+        # out of leak, scores only decay (or stay) for our sampled set
+        after = list(state.inactivity_scores)[:8]
+        assert all(int(a) <= max(int(b), 4) for a, b in zip(after, before))
 
 
 @with_phases(PHASES)

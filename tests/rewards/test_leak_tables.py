@@ -1,0 +1,142 @@
+"""Inactivity-leak reward/penalty tables.
+
+During a leak (finality older than MIN_EPOCHS_TO_INACTIVITY_PENALTY),
+attestation REWARDS vanish while penalties and the inactivity-score
+quadratic penalty keep draining non-participants — so full participants
+tread water (post-altair: exactly zero attestation delta) and everyone
+else bleeds proportionally to score x effective balance.  Reference
+analogue: eth2spec/test/phase0/rewards/test_leak.py (leak variants of the
+participation classes); spec: specs/altair/beacon-chain.md
+get_flag_index_deltas + process_inactivity_updates,
+specs/phase0/beacon-chain.md get_attestation_deltas leak branch.
+"""
+
+from __future__ import annotations
+
+from eth_consensus_specs_tpu.test_infra.context import (
+    spec_state_test,
+    with_all_phases,
+    with_phases,
+)
+from eth_consensus_specs_tpu.test_infra.state import next_epoch
+from eth_consensus_specs_tpu.test_infra.template import instantiate
+
+POST_ALTAIR = ["altair", "bellatrix", "capella", "deneb", "electra", "fulu", "gloas"]
+
+
+def _enter_leak(spec, state):
+    state.finalized_checkpoint.epoch = 0
+    target = int(spec.MIN_EPOCHS_TO_INACTIVITY_PENALTY) + 3
+    while int(spec.get_current_epoch(state)) < target:
+        next_epoch(spec, state)
+    assert spec.is_in_inactivity_leak(state)
+
+
+def _set_participation(spec, state, fraction: float, flags: int = 0b0000_0111):
+    n = len(state.validators)
+    cut = int(n * fraction)
+    for i in range(n):
+        state.previous_epoch_participation[i] = flags if i < cut else 0
+    return cut
+
+
+def _epoch_boundary_deltas(spec, state):
+    pre = [int(b) for b in state.balances]
+    boundary = int(state.slot) + (
+        spec.SLOTS_PER_EPOCH - int(state.slot) % spec.SLOTS_PER_EPOCH
+    )
+    spec.process_slots(state, boundary)
+    return [int(b) - a for a, b in zip(pre, state.balances)]
+
+
+def _leak_participation_case(fraction: float):
+    @with_phases(POST_ALTAIR)
+    @spec_state_test
+    def case(spec, state):
+        _enter_leak(spec, state)
+        # fresh scores: participants decay to 0, absentees accumulate
+        cut = _set_participation(spec, state, fraction)
+        for i in range(len(state.inactivity_scores)):
+            state.inactivity_scores[i] = 0 if i < cut else 20
+        deltas = _epoch_boundary_deltas(spec, state)
+        # full participants earn NO attestation rewards during a leak
+        # (get_flag_index_deltas leak branch pays zero), so their balance
+        # never grows
+        for i in range(cut):
+            assert deltas[i] <= 0
+        # absentees additionally pay the quadratic inactivity penalty
+        if cut < len(deltas):
+            assert all(d < 0 for d in deltas[cut:])
+        if 0 < cut < len(deltas):
+            # a participant never loses more than an absentee of equal EB
+            assert max(deltas[cut:]) <= min(deltas[:cut])
+
+    return case, f"test_leak_participation_{int(fraction * 100)}pct"
+
+
+for _f in (1.0, 0.75, 0.5, 0.25, 0.0):
+    instantiate(_leak_participation_case, _f)
+
+
+@with_phases(POST_ALTAIR)
+@spec_state_test
+def test_leak_inactivity_penalty_scales_with_score(spec, state):
+    """Equal-balance absentees with different scores: the higher score
+    pays the strictly larger quadratic penalty."""
+    _enter_leak(spec, state)
+    _set_participation(spec, state, 0.0)
+    state.inactivity_scores[1] = 8
+    state.inactivity_scores[2] = 64
+    deltas = _epoch_boundary_deltas(spec, state)
+    assert deltas[2] < deltas[1] < 0
+
+
+@with_phases(POST_ALTAIR)
+@spec_state_test
+def test_leak_scores_grow_for_absentees_only(spec, state):
+    _enter_leak(spec, state)
+    cut = _set_participation(spec, state, 0.5)
+    for i in range(len(state.inactivity_scores)):
+        state.inactivity_scores[i] = 12
+    _epoch_boundary_deltas(spec, state)
+    bias = int(spec.config.INACTIVITY_SCORE_BIAS)
+    for i, score in enumerate(state.inactivity_scores):
+        if i < cut:
+            # timely-target participants decay by 1 in-leak (no recovery)
+            assert int(score) == 11
+        else:
+            assert int(score) == 12 + bias
+
+
+@with_all_phases
+@spec_state_test
+def test_leak_ends_exactly_at_threshold(spec, state):
+    """is_in_inactivity_leak flips exactly when finality_delay exceeds
+    MIN_EPOCHS_TO_INACTIVITY_PENALTY."""
+    limit = int(spec.MIN_EPOCHS_TO_INACTIVITY_PENALTY)
+    while int(spec.get_current_epoch(state)) < limit + 3:
+        next_epoch(spec, state)
+    epoch = int(spec.get_previous_epoch(state))
+    state.finalized_checkpoint.epoch = epoch - limit
+    assert not spec.is_in_inactivity_leak(state)
+    state.finalized_checkpoint.epoch = epoch - limit - 1
+    assert spec.is_in_inactivity_leak(state)
+
+
+@with_phases(POST_ALTAIR)
+@spec_state_test
+def test_leak_slashed_validator_gets_no_flag_rewards_after_leak(spec, state):
+    """A slashed validator is excluded from unslashed participating sets
+    both in and out of a leak: flag deltas never reward it."""
+    _enter_leak(spec, state)
+    _set_participation(spec, state, 1.0)
+    epoch = int(spec.get_current_epoch(state))
+    state.validators[3].slashed = True
+    state.validators[3].withdrawable_epoch = epoch + 16
+    for i in range(len(state.inactivity_scores)):
+        state.inactivity_scores[i] = 0
+    deltas = _epoch_boundary_deltas(spec, state)
+    # slashed: treated as non-participating — penalized while peers tread water
+    assert deltas[3] < 0
+    assert deltas[4] <= 0  # unslashed participant: no growth in-leak
+    assert deltas[3] < deltas[4]
