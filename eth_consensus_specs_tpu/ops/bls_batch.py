@@ -23,6 +23,7 @@ attestation surfaces at the exact spec assertion.
 
 from __future__ import annotations
 
+import os
 import secrets
 
 from eth_consensus_specs_tpu.crypto.curve import (
@@ -39,6 +40,32 @@ def _use_device() -> bool:
     from eth_consensus_specs_tpu.utils import bls
 
     return bls.backend_name() == "tpu"
+
+
+# hash-to-G2 results keyed by message — primed in one batched device
+# dispatch when ETH_SPECS_TPU_DEVICE_H2C is on; host fallback per miss
+_H2G2_CACHE: dict[bytes, object] = {}
+
+
+def _prime_h2g2_cache(msgs: list[bytes], batch_fn) -> None:
+    # evict BEFORE deciding what to batch: clearing afterwards would drop
+    # this very call's cached messages and push them onto the serial host
+    # path — the opposite of what the batched dispatch is for
+    if len(_H2G2_CACHE) + len(msgs) > 512:
+        keep = {m: _H2G2_CACHE[m] for m in msgs if m in _H2G2_CACHE}
+        _H2G2_CACHE.clear()
+        _H2G2_CACHE.update(keep)
+    fresh = [m for m in msgs if m not in _H2G2_CACHE]
+    if not fresh:
+        return
+    points = batch_fn(fresh)
+    for m, p in zip(fresh, points):
+        _H2G2_CACHE[m] = p
+
+
+def _h2g2(msg: bytes):
+    hit = _H2G2_CACHE.get(msg)
+    return hit if hit is not None else hash_to_g2(msg)
 
 
 def _pairing_check_routed(pairs) -> bool:
@@ -170,12 +197,20 @@ def batch_verify_aggregates(items: list[tuple[list[bytes], bytes, bytes]]) -> bo
     merged: dict[bytes, object] = {}
     for (points, msg, sig, r), rp in zip(parsed, rpk):
         merged[msg] = rp if msg not in merged else merged[msg] + rp
+    # optional device hash-to-curve: one batched dispatch maps every
+    # distinct message (ops/h2c_device — bit-equal to the host path, so
+    # routing can never flip a result); opt-in via env because the
+    # one-time compile only pays off on a real accelerator
+    if os.environ.get("ETH_SPECS_TPU_DEVICE_H2C") and len(merged) > 1:
+        from eth_consensus_specs_tpu.ops.h2c_device import hash_to_g2_device
+
+        _prime_h2g2_cache(list(merged.keys()), hash_to_g2_device)
     # sum_i r_i * sig_i in ONE native Pippenger MSM (64-bit scalars are
     # always < r, so the reduced path is exact); multi_exp falls back to
     # the bit-exact per-point path without the native core
     from eth_consensus_specs_tpu.utils.bls import multi_exp
 
     sig_acc = multi_exp([sig for _, _, sig, _ in parsed], [r for _, _, _, r in parsed])
-    pairs = [(rp, hash_to_g2(msg)) for msg, rp in merged.items()]
+    pairs = [(rp, _h2g2(msg)) for msg, rp in merged.items()]
     pairs.append((-g1, sig_acc))
     return _pairing_check_routed(pairs)

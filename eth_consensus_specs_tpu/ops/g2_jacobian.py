@@ -1,0 +1,231 @@
+"""Batched G2 (E'(Fq2)) Jacobian arithmetic in lazy limbs — device side.
+
+Building block for the device hash-to-curve pipeline (ops/h2c_device) and
+any future fully-device G2 walk: doubling, branchless complete addition,
+the fixed [|x|]-ladder (the BLS parameter z has Hamming weight 6), the
+psi endomorphism, and batched Jacobian→affine via one Fermat inversion
+per lane.  All values are LF limb arrays of shape [..., 2, 15] per Fq2
+coordinate; infinity is represented by Z == 0 exactly as the host's
+native core does (native/bls12_381.c g2p), so results convert 1:1.
+
+Formulas mirror native/bls12_381.c g2_dbl/g2_add so a device walk is
+value-equal to the C core (and hence to crypto/curve.Point) for every
+input, including the doubling and infinity edge cases, which are resolved
+with lane masks instead of branches.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import eth_consensus_specs_tpu  # noqa: F401
+import jax.numpy as jnp
+from jax import lax
+
+from eth_consensus_specs_tpu.crypto.fields import Fq2, P as P_INT
+from eth_consensus_specs_tpu.ops import fq12_tower as tw
+from eth_consensus_specs_tpu.ops import lazy_limbs as lz
+from eth_consensus_specs_tpu.ops.lazy_limbs import LF, lf
+
+BLS_X_ABS = 0xD201000000010000
+
+
+def _canon(x: LF) -> LF:
+    """Canonical LF: normalized limbs, value < 2p (safe scan carry /
+    select operand — static bounds then mean the same thing on both
+    sides of a jnp.where)."""
+    return lz.shrink(x)
+
+
+def fq2_is_zero(a: LF):
+    return tw.fq2_is_zero(a)
+
+
+def _sel(mask, a: LF, b: LF) -> LF:
+    """Lane-select between two Fq2 LFs; mask has the batch shape, values
+    are [..., 2, 15]."""
+    m = mask[..., None, None]
+    return LF(jnp.where(m, a.v, b.v), max(a.max, b.max), max(a.val, b.val))
+
+
+class G2J:
+    """Jacobian point batch: X, Y, Z are LF of shape [..., 2, 15]."""
+
+    __slots__ = ("x", "y", "z")
+
+    def __init__(self, x: LF, y: LF, z: LF):
+        self.x, self.y, self.z = x, y, z
+
+    def is_inf(self):
+        return fq2_is_zero(self.z)
+
+
+_ONE_L = tw.fq2_to_limbs(Fq2.one())
+
+
+def g2_from_affine(x: LF, y: LF, active=None) -> G2J:
+    """active=False lanes become infinity (Z=0)."""
+    z = lf(jnp.broadcast_to(jnp.asarray(_ONE_L), x.v.shape))
+    if active is not None:
+        z = _sel(active, z, LF(jnp.zeros_like(z.v), 0, 0))
+    return G2J(x, y, z)
+
+
+def g2_dbl(p: G2J) -> G2J:
+    """2P — Jacobian doubling (a=0 curve), exact mirror of C g2_dbl.
+    Y == 0 or Z == 0 lanes produce Z3 == 0 naturally (Z3 = 2YZ)."""
+    A = tw.fq2_sqr(p.x)
+    B = tw.fq2_sqr(p.y)
+    C = tw.fq2_sqr(B)
+    t = tw.fq2_sqr(tw.fq2_add(p.x, B))
+    D = tw.fq2_sub(tw.fq2_sub(t, A), C)
+    D = tw.fq2_add(D, D)
+    E = tw.fq2_add(tw.fq2_add(A, A), A)
+    F = tw.fq2_sqr(E)
+    x3 = tw.fq2_sub(tw.fq2_sub(F, D), D)
+    eight_c = tw.fq2_add(C, C)
+    eight_c = tw.fq2_add(eight_c, eight_c)
+    eight_c = tw.fq2_add(eight_c, eight_c)
+    y3 = tw.fq2_sub(tw.fq2_mul(E, tw.fq2_sub(D, x3)), eight_c)
+    z3 = tw.fq2_mul(p.y, p.z)
+    z3 = tw.fq2_add(z3, z3)
+    return G2J(x3, y3, z3)
+
+
+def g2_add(p: G2J, q: G2J) -> G2J:
+    """P + Q — complete branchless addition mirroring C g2_add's case
+    analysis with lane masks: infinity passthroughs, doubling fallback
+    when U1==U2 & S1==S2, infinity when U1==U2 & S1!=S2."""
+    z1z1 = tw.fq2_sqr(p.z)
+    z2z2 = tw.fq2_sqr(q.z)
+    u1 = tw.fq2_mul(p.x, z2z2)
+    u2 = tw.fq2_mul(q.x, z1z1)
+    s1 = tw.fq2_mul(tw.fq2_mul(p.y, q.z), z2z2)
+    s2 = tw.fq2_mul(tw.fq2_mul(q.y, p.z), z1z1)
+    h = tw.fq2_sub(u2, u1)
+    rr = tw.fq2_sub(s2, s1)
+    x_eq = fq2_is_zero(h)
+    y_eq = fq2_is_zero(rr)
+
+    i = tw.fq2_sqr(tw.fq2_add(h, h))
+    j = tw.fq2_mul(h, i)
+    rr2 = tw.fq2_add(rr, rr)
+    v = tw.fq2_mul(u1, i)
+    x3 = tw.fq2_sub(tw.fq2_sub(tw.fq2_sqr(rr2), j), tw.fq2_add(v, v))
+    s1j = tw.fq2_mul(s1, j)
+    y3 = tw.fq2_sub(
+        tw.fq2_mul(rr2, tw.fq2_sub(v, x3)), tw.fq2_add(s1j, s1j)
+    )
+    z3 = tw.fq2_sqr(tw.fq2_add(p.z, q.z))
+    z3 = tw.fq2_sub(tw.fq2_sub(z3, z1z1), z2z2)
+    z3 = tw.fq2_mul(z3, h)
+    added = G2J(x3, y3, z3)
+
+    dbl = g2_dbl(p)
+    # same-x selection: doubling when y matches, infinity otherwise
+    zero = LF(jnp.zeros_like(z3.v), 0, 0)
+    sx = G2J(
+        _sel(y_eq, dbl.x, added.x),
+        _sel(y_eq, dbl.y, added.y),
+        _sel(y_eq, dbl.z, zero),
+    )
+    out = G2J(
+        _sel(x_eq, sx.x, added.x),
+        _sel(x_eq, sx.y, added.y),
+        _sel(x_eq, sx.z, added.z),
+    )
+    # infinity passthroughs
+    p_inf = p.is_inf()
+    q_inf = q.is_inf()
+    out = G2J(
+        _sel(p_inf, q.x, out.x),
+        _sel(p_inf, q.y, out.y),
+        _sel(p_inf, q.z, out.z),
+    )
+    return G2J(
+        _sel(q_inf, p.x, out.x),
+        _sel(q_inf, p.y, out.y),
+        _sel(q_inf, p.z, out.z),
+    )
+
+
+def g2_neg(p: G2J) -> G2J:
+    return G2J(p.x, tw.fq2_neg(p.y), p.z)
+
+
+def g2_mul_z(p: G2J) -> G2J:
+    """[|x|]P by the fixed double-and-add ladder (63 doublings, adds at
+    the 5 set low bits) as ONE lax.scan — the step body (dbl + selected
+    add) compiles once and runs 63 times, keeping the XLA graph small
+    (unrolling the adds was measured to blow compile memory through the
+    roof).  The carry crosses the scan boundary in canonical form (limbs
+    < 2^26, value < 2p) so the re-wrap on entry tells the truth about
+    static bounds.  Value-equal to the C g2_mul_z ladder."""
+    add_bits = np.array(
+        [(BLS_X_ABS >> bit) & 1 for bit in range(62, -1, -1)], np.uint8
+    )
+    base = G2J(_canon(p.x), _canon(p.y), _canon(p.z))
+
+    def step(carry, bit):
+        acc = G2J(lf(carry[0]), lf(carry[1]), lf(carry[2]))
+        acc = g2_dbl(acc)
+        withadd = g2_add(acc, base)
+        nx = jnp.where(bit != 0, _canon(withadd.x).v, _canon(acc.x).v)
+        ny = jnp.where(bit != 0, _canon(withadd.y).v, _canon(acc.y).v)
+        nz = jnp.where(bit != 0, _canon(withadd.z).v, _canon(acc.z).v)
+        return (nx, ny, nz), None
+
+    init = (base.x.v, base.y.v, base.z.v)
+    (ox, oy, oz), _ = lax.scan(step, init, jnp.asarray(add_bits))
+    return G2J(lf(ox), lf(oy), lf(oz))
+
+
+# psi endomorphism constants (same values the C core's tables hold)
+def _psi_consts():
+    from eth_consensus_specs_tpu.crypto.fields import Fq2, XI
+
+    psi_x = XI.pow((P_INT - 1) // 3).inv()
+    psi_y = XI.pow((P_INT - 1) // 2).inv()
+    return tw.fq2_to_limbs(psi_x), tw.fq2_to_limbs(psi_y)
+
+
+_PSI_X_L, _PSI_Y_L = None, None
+
+
+def g2_psi(p: G2J) -> G2J:
+    """psi on Jacobian coords: conj each coordinate, scale X and Y by the
+    untwist-frobenius-twist constants (native/bls12_381.c g2_psi_jac)."""
+    global _PSI_X_L, _PSI_Y_L
+    if _PSI_X_L is None:
+        _PSI_X_L, _PSI_Y_L = _psi_consts()
+    px = lf(jnp.broadcast_to(jnp.asarray(_PSI_X_L), p.x.v.shape))
+    py = lf(jnp.broadcast_to(jnp.asarray(_PSI_Y_L), p.y.v.shape))
+    return G2J(
+        tw.fq2_mul(tw.fq2_conj(p.x), px),
+        tw.fq2_mul(tw.fq2_conj(p.y), py),
+        tw.fq2_conj(p.z),
+    )
+
+
+def g2_clear_cofactor(p: G2J) -> G2J:
+    """[h_eff]P via Budroni-Pintore with the shared-ladder decomposition —
+    identical group element to the C core's bls_g2_clear_cofactor:
+    [z^2]P + [z]P - P - psi([z+1]P) + psi^2([2]P)."""
+    a = g2_mul_z(p)  # [z]P
+    b = g2_mul_z(a)  # [z^2]P
+    apq = g2_add(a, p)  # [z+1]P
+    t = g2_psi(apq)
+    acc = g2_add(b, a)
+    acc = g2_add(acc, g2_neg(p))
+    acc = g2_add(acc, g2_neg(t))
+    p2 = g2_psi(g2_psi(g2_dbl(p)))
+    return g2_add(acc, p2)
+
+
+def g2_to_affine(p: G2J) -> tuple[LF, LF, "jnp.ndarray"]:
+    """(x, y, inf_mask) — one Fermat Fq2 inversion per lane (batched in
+    the limb lanes, so the 380-step pow scan runs once for the batch)."""
+    zi = tw.fq2_inv(p.z)
+    zi2 = tw.fq2_sqr(zi)
+    zi3 = tw.fq2_mul(zi2, zi)
+    return tw.fq2_mul(p.x, zi2), tw.fq2_mul(p.y, zi3), p.is_inf()

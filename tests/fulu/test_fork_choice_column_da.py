@@ -1,0 +1,116 @@
+"""Fork-choice column-sampled data-availability gate (fulu).
+
+[Modified in Fulu:EIP7594] on_block's availability check consumes DATA
+COLUMN sidecars from the sampling seam: every retrieved sidecar must pass
+structural and KZG-batch verification or the block is rejected.  An empty
+retrieval is vacuously available — how many columns to sample is custody
+policy, not the gate's concern (same shape as the upstream handler).
+Reference surface: specs/fulu/fork-choice.md is_data_available:19-34 +
+eth2spec/test/fulu/fork_choice/test_on_block.py.
+"""
+
+from __future__ import annotations
+
+import pytest
+
+from eth_consensus_specs_tpu.ssz import hash_tree_root
+from eth_consensus_specs_tpu.test_infra.block import (
+    build_empty_block_for_next_slot,
+    state_transition_and_sign_block,
+)
+from eth_consensus_specs_tpu.test_infra.context import spec_state_test, with_phases
+from eth_consensus_specs_tpu.test_infra.fork_choice import (
+    get_genesis_forkchoice_store,
+    tick_and_add_block,
+)
+
+from .das_fixtures import sample_cells_and_proofs, sample_commitment
+
+# real KZG pairings per case — nightly lane
+pytestmark = pytest.mark.slow
+
+FULU = ["fulu"]
+
+
+def _signed_blob_block(spec, state):
+    block = build_empty_block_for_next_slot(spec, state)
+    block.body.blob_kzg_commitments = [sample_commitment()]
+    return state_transition_and_sign_block(spec, state, block)
+
+
+def _run_with_columns(spec, state, columns_fn, valid: bool):
+    """Drive a blob block through on_block with `columns_fn(sidecars)`
+    selecting/corrupting what the sampling seam serves."""
+    store, _ = get_genesis_forkchoice_store(spec, state)
+    signed = _signed_blob_block(spec, state)
+    sidecars = spec.get_data_column_sidecars_from_block(
+        signed, [sample_cells_and_proofs()]
+    )
+    served = columns_fn(sidecars)
+    spec._column_retriever = lambda root: served
+    try:
+        tick_and_add_block(spec, store, signed, valid=valid)
+        if valid:
+            assert hash_tree_root(signed.message) in store.blocks
+    finally:
+        spec._column_retriever = None
+
+
+@with_phases(FULU)
+@spec_state_test
+def test_on_block_columns_available(spec, state):
+    _run_with_columns(spec, state, lambda scs: [scs[0], scs[64]], valid=True)
+
+
+@with_phases(FULU)
+@spec_state_test
+def test_on_block_no_columns_sampled_vacuous(spec, state):
+    _run_with_columns(spec, state, lambda scs: [], valid=True)
+
+
+@with_phases(FULU)
+@spec_state_test
+def test_on_block_corrupted_cell_rejected(spec, state):
+    def corrupt(scs):
+        bad = scs[3].copy()
+        cell = bytearray(bytes(bad.column[0]))
+        cell[7] ^= 0x01
+        bad.column[0] = bytes(cell)
+        return [bad]
+
+    _run_with_columns(spec, state, corrupt, valid=False)
+
+
+@with_phases(FULU)
+@spec_state_test
+def test_on_block_wrong_proof_rejected(spec, state):
+    def swap_proof(scs):
+        bad = scs[5].copy()
+        bad.kzg_proofs[0] = bytes(scs[6].kzg_proofs[0])
+        return [bad]
+
+    _run_with_columns(spec, state, swap_proof, valid=False)
+
+
+@with_phases(FULU)
+@spec_state_test
+def test_on_block_out_of_range_index_rejected(spec, state):
+    def bad_index(scs):
+        bad = scs[0].copy()
+        bad.index = int(spec.NUMBER_OF_COLUMNS)
+        return [bad]
+
+    _run_with_columns(spec, state, bad_index, valid=False)
+
+
+@with_phases(FULU)
+@spec_state_test
+def test_on_block_one_bad_column_poisons_batch(spec, state):
+    def mixed(scs):
+        bad = scs[2].copy()
+        cell = bytearray(bytes(bad.column[0]))
+        cell[11] ^= 0x80
+        bad.column[0] = bytes(cell)
+        return [scs[0], bad, scs[9]]
+
+    _run_with_columns(spec, state, mixed, valid=False)

@@ -142,3 +142,111 @@ def test_proposer_boost_set_for_timely_block(spec, state):
     assert bytes(store.proposer_boost_root) == bytes(
         hash_tree_root(signed.message)
     )
+
+
+@with_phases(FC_FORKS)
+@spec_state_test
+def test_on_block_before_finalized_slot_rejected(spec, state):
+    """A block at or before the finalized checkpoint's start slot can never
+    enter the store (fork-choice.md on_block finalized-slot assert)."""
+    store, _ = _store_with_block(spec, state)
+    block = build_empty_block_for_next_slot(spec, state)
+    signed = state_transition_and_sign_block(spec, state, block)
+    # finalize an epoch ahead of the block's slot after signing
+    store.finalized_checkpoint.epoch = (
+        spec.compute_epoch_at_slot(int(signed.message.slot)) + 1
+    )
+    _tick_to(spec, store, state, int(signed.message.slot) + 1)
+    expect_assertion_error(lambda: spec.on_block(store, signed))
+
+
+@with_phases(FC_FORKS)
+@spec_state_test
+def test_proposer_boost_not_set_for_late_block(spec, state):
+    """A block arriving after the attesting interval gets no boost."""
+    anchor = spec.BeaconBlock(state_root=hash_tree_root(state))
+    store = spec.get_forkchoice_store(state.copy(), anchor)
+    block = build_empty_block_for_next_slot(spec, state)
+    signed = state_transition_and_sign_block(spec, state, block)
+    # tick well past the block's slot start: late arrival
+    t = (
+        int(store.genesis_time)
+        + (int(signed.message.slot) + 1) * int(spec.config.SECONDS_PER_SLOT)
+    )
+    spec.on_tick(store, t)
+    spec.on_block(store, signed)
+    assert bytes(store.proposer_boost_root) == b"\x00" * 32
+    assert store.block_timeliness[hash_tree_root(signed.message)] in (False, 0)
+
+
+@with_phases(FC_FORKS)
+@spec_state_test
+def test_proposer_boost_only_first_timely_block(spec, state):
+    """Equivocating second timely block in the same slot must not steal
+    the boost (is_first_block check)."""
+    store, signed = _store_with_block(spec, state)
+    boosted = bytes(store.proposer_boost_root)
+    assert boosted == bytes(hash_tree_root(signed.message))
+    # second block for the same slot from the same proposer (different
+    # graffiti), timely by store clock
+    fork_state = store.block_states[
+        signed.message.parent_root
+    ].copy()
+    block2 = build_empty_block_for_next_slot(spec, fork_state)
+    block2.body.graffiti = b"\x42" * 32
+    signed2 = state_transition_and_sign_block(spec, fork_state, block2)
+    spec.on_block(store, signed2)
+    assert bytes(store.proposer_boost_root) == boosted  # unchanged
+
+
+@with_phases(FC_FORKS)
+@spec_state_test
+def test_on_block_updates_justified_from_state(spec, state):
+    """on_block pulls a NEWER justified checkpoint out of the post-state
+    into the store (update_checkpoints) — driven through two attested
+    epochs so justification actually advances past genesis."""
+    from eth_consensus_specs_tpu.test_infra.fork_choice import (
+        apply_next_epoch_with_attestations,
+        get_genesis_forkchoice_store,
+    )
+
+    store, _ = get_genesis_forkchoice_store(spec, state)
+    post = state
+    for _ in range(3):
+        post, _ = apply_next_epoch_with_attestations(spec, store, post)
+    assert int(post.current_justified_checkpoint.epoch) > 0
+    assert int(store.justified_checkpoint.epoch) == int(
+        post.current_justified_checkpoint.epoch
+    )
+
+
+@with_phases(FC_FORKS)
+@spec_state_test
+def test_on_attestation_wrong_target_epoch_vs_slot_rejected(spec, state):
+    """target.epoch must equal compute_epoch_at_slot(data.slot)."""
+    store, _ = _store_with_block(spec, state)
+    att = get_valid_attestation(spec, state, signed=True)
+    att.data.target.epoch = int(att.data.target.epoch) + 1
+    _tick_to(spec, store, state, int(att.data.slot) + spec.SLOTS_PER_EPOCH + 2)
+    expect_assertion_error(lambda: spec.on_attestation(store, att))
+
+
+@with_phases(FC_FORKS)
+@spec_state_test
+def test_on_attestation_unknown_target_root_rejected(spec, state):
+    store, _ = _store_with_block(spec, state)
+    att = get_valid_attestation(spec, state, signed=True)
+    att.data.target.root = b"\x37" * 32
+    _tick_to(spec, store, state, int(att.data.slot) + 2)
+    expect_assertion_error(lambda: spec.on_attestation(store, att))
+
+
+@with_phases(FC_FORKS)
+@spec_state_test
+def test_on_tick_advances_time_monotonically(spec, state):
+    anchor = spec.BeaconBlock(state_root=hash_tree_root(state))
+    store = spec.get_forkchoice_store(state.copy(), anchor)
+    t0 = int(store.time)
+    spec.on_tick(store, t0 + int(spec.config.SECONDS_PER_SLOT))
+    assert int(store.time) == t0 + int(spec.config.SECONDS_PER_SLOT)
+    assert spec.get_current_slot(store) == int(state.slot) + 1

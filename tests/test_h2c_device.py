@@ -1,0 +1,66 @@
+"""Device hash-to-G2 vs the host oracle — bit-exactness.
+
+The batched device pipeline (ops/h2c_device: stacked-lane SSWU with the
+branchless norm-method Fq2 sqrt, isogeny into Jacobian, device cofactor
+ladder) must produce EXACTLY the host hash_to_g2 point for every message,
+because verification results may never depend on which backend hashed the
+message (reference seam: the per-message G2 input of utils/bls.py
+Verify/FastAggregateVerify).
+
+Compile-heavy (two jits, ~8 scans): nightly lane.
+"""
+
+from __future__ import annotations
+
+import pytest
+
+pytestmark = pytest.mark.slow
+
+import numpy as np
+
+from eth_consensus_specs_tpu.crypto.fields import Fq, Fq2, P
+from eth_consensus_specs_tpu.crypto.hash_to_curve import hash_to_g2
+
+
+def test_fq2_sqrt_batch_matches_host():
+    """The branchless sqrt must reproduce the host's root CHOICE (not
+    just a root) on residues, and flag non-residues, across the b==0 and
+    general branches."""
+    import jax.numpy as jnp
+
+    from eth_consensus_specs_tpu.ops import fq12_tower as tw
+    from eth_consensus_specs_tpu.ops.h2c_device import _fq2_sqrt_batch
+    from eth_consensus_specs_tpu.ops.lazy_limbs import lf
+
+    cases = [
+        Fq2(Fq(5), Fq(7)).square(),            # general residue
+        Fq2(Fq(11), Fq(0)).square(),           # b == 0, a residue
+        Fq2(Fq(0), Fq(13)).square(),           # (= -169): b == 0 branch
+        Fq2(Fq(3), Fq(1)),                     # likely non-residue probe
+        Fq2(Fq(0), Fq(0)),                     # zero
+        Fq2(Fq(P - 2), Fq(P - 5)).square(),    # general residue, big limbs
+    ]
+    arr = jnp.asarray(np.stack([tw.fq2_to_limbs(c) for c in cases]))
+    root, ok = _fq2_sqrt_batch(lf(arr))
+    from eth_consensus_specs_tpu.ops.h2c_device import _canon_fq
+
+    got_ok = np.asarray(ok)
+    got_roots = np.asarray(_canon_fq(root))
+    for i, c in enumerate(cases):
+        host = c.sqrt()
+        assert bool(got_ok[i]) == (host is not None), f"ok mismatch at {i}"
+        if host is not None:
+            got = tw.limbs_to_fq2(got_roots[i])
+            assert got == host, f"root mismatch at {i}: {got} vs {host}"
+
+
+def test_hash_to_g2_device_bit_exact():
+    from eth_consensus_specs_tpu.ops.h2c_device import hash_to_g2_device
+
+    # B=2 keeps the one-time XLA compile as small as possible; coverage
+    # breadth comes from the sqrt-branch unit table above, not from more
+    # lanes through the same traced program
+    msgs = [b"", b"device-h2c \xff" * 3]
+    got = hash_to_g2_device(msgs)
+    for i, m in enumerate(msgs):
+        assert got[i] == hash_to_g2(m), f"mismatch for message {i}"
