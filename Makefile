@@ -61,6 +61,11 @@ reftests:
 bench:
 	$(PYTHON) bench.py
 
+# one-time device-kernel compile into .jax_cache (accelerator required);
+# after this the bench's hybrid BLS section uses the device stages
+seed-device:
+	$(PYTHON) scripts/seed_device_cache.py
+
 multichip:
 	$(PYTHON) -c "import __graft_entry__ as g; g.dryrun_multichip(8); print('ok')"
 

@@ -296,17 +296,25 @@ def _run_section(section: str, on_cpu: bool, no_cache: bool = False) -> None:
         from eth_consensus_specs_tpu.native import get_bls_lib
 
         device_pairing = False
-        if not on_cpu:
-            # hybrid mode: host C does aggregation/hash-to-curve/prepare,
-            # the one RLC Miller/final-exp batch runs on the accelerator.
-            # Only attempted when a prior completed run left the compiled
-            # chain in the persistent cache (sentinel) — a cold compile
-            # can exceed the whole section budget.
-            from eth_consensus_specs_tpu.utils.cache import pairing_warm_sentinel
+        device_h2c = False
+        if not on_cpu and not no_cache:
+            # hybrid mode: host C does aggregation/prepare, the RLC
+            # Miller/final-exp batch — and optionally the batched
+            # hash-to-G2 — run on the accelerator.  Each stage is only
+            # attempted when a prior completed run left its compiled
+            # chain in the persistent cache (sentinels) — a cold compile
+            # can exceed the whole section budget.  --nocache disables
+            # the persistent cache, so a warm start is impossible and
+            # the sentinels must not opt anything in.
+            from eth_consensus_specs_tpu.utils.cache import warm_sentinel
 
-            if os.path.exists(pairing_warm_sentinel(jax.default_backend())):
+            backend = jax.default_backend()
+            if os.path.exists(warm_sentinel("pairing", backend)):
                 os.environ["ETH_SPECS_TPU_DEVICE_PAIRING"] = "1"
                 device_pairing = True
+            if os.path.exists(warm_sentinel("h2c", backend)):
+                os.environ["ETH_SPECS_TPU_DEVICE_H2C"] = "1"
+                device_h2c = True
         n = 64 if get_bls_lib() is not None else 4
         aggs_per_sec, batch_s = bench_batch_verify(n_aggregates=n)
         payload = {
@@ -316,6 +324,7 @@ def _run_section(section: str, on_cpu: bool, no_cache: bool = False) -> None:
             "pairing": (
                 "device-miller" if device_pairing else "host-native-multi-miller"
             ),
+            "h2c": "device" if device_h2c else "host-native",
         }
     elif section == "das":
         batch = 2 if on_cpu else 16
@@ -517,14 +526,20 @@ def main() -> None:
 
         from eth_consensus_specs_tpu.utils.cache import cache_dir_path
 
-        if _glob.glob(_os.path.join(cache_dir_path(), "device_pairing_warm.*")):
+        if _glob.glob(
+            _os.path.join(cache_dir_path(), "device_pairing_warm.*")
+        ) or _glob.glob(_os.path.join(cache_dir_path(), "device_h2c_warm.*")):
             dev_bls = _section_in_subprocess(
                 "bls", on_cpu=False, timeout_s=_ACC_TIMEOUT_S
+            )
+            used_device_stage = dev_bls is not None and (
+                dev_bls.get("pairing") == "device-miller"
+                or dev_bls.get("h2c") == "device"
             )
             if (
                 dev_bls is not None
                 and dev_bls.get("backend") not in (None, "cpu")
-                and dev_bls.get("pairing") == "device-miller"
+                and used_device_stage
             ):
                 if dev_bls["aggs_per_sec"] > (
                     bls_res["aggs_per_sec"] if bls_res else 0.0
@@ -535,14 +550,15 @@ def main() -> None:
                     {
                         "bls": {
                             "aggs_per_sec": round(dev_bls["aggs_per_sec"], 1),
-                            "pairing": "device-miller",
+                            "pairing": dev_bls.get("pairing"),
+                            "h2c": dev_bls.get("h2c"),
                             "backend": dev_bls.get("backend"),
                         }
                     }
                 )
             elif dev_bls is None:
                 # count only a dead/hung subprocess against the budget; a
-                # child that ran but chose host pairing (sentinel/backend
+                # child that ran but chose host stages (sentinel/backend
                 # mismatch) is not a tunnel failure
                 acc.failures += 1
     if bls_res is not None:

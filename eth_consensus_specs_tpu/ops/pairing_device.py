@@ -373,44 +373,19 @@ def _miller_product(pairs: list):
     return total
 
 
-_WARM_MARKED = False
-
-
-def _mark_warm() -> None:
-    """Record (once per process) that the full device chain has executed —
-    with the persistent cache enabled this means a later process gets a
-    warm start, which is what the bench's sentinel check keys off."""
-    global _WARM_MARKED
-    if _WARM_MARKED:
-        return
-    _WARM_MARKED = True
-    try:
-        from eth_consensus_specs_tpu.utils import cache as _cache
-
-        if not _cache._enabled:
-            return
-        import jax
-
-        backend = jax.default_backend()
-        if backend == "cpu":
-            # enable_persistent_cache refuses the cpu backend, so this is
-            # unreachable today — kept as a guard so a cpu sentinel can
-            # never tease the bench into a doomed accelerator attempt
-            return
-        with open(_cache.pairing_warm_sentinel(backend), "w") as fh:
-            fh.write("ok\n")
-    except Exception:
-        pass
-
-
 def pairing_check_device(pairs: list) -> bool:
     """prod e(P_i, Q_i) == 1 with the Miller accumulation and final-exp
     membership check on device. Pairs are (G1 Point, G2 Point) host
     objects (subgroup-checked at deserialization)."""
     if not pairs:
         return True
-    ok = final_exp_is_one(_miller_product(pairs))
-    _mark_warm()
+    ok = bool(final_exp_is_one(_miller_product(pairs)))
+    # the bool() above materialized the device result — record the warm
+    # chain for the bench's sentinel gating (utils/cache.mark_warm is a
+    # no-op without the persistent cache or on cpu)
+    from eth_consensus_specs_tpu.utils.cache import mark_warm
+
+    mark_warm("pairing")
     return ok
 
 

@@ -52,11 +52,36 @@ def cache_dir_path() -> str:
     return os.path.join(repo_root, ".jax_cache")
 
 
+def warm_sentinel(stage: str, backend: str) -> str:
+    """Marker file recording that a device chain (`pairing`, `h2c`, ...)
+    compiled AND executed to completion for `backend` with the entries
+    persisted in the cache.  Lets the bench attempt a device stage only
+    when a warm start is plausible — a cold compile of these chains can
+    exceed a whole section budget (round-3 lesson: never let one slow
+    compile strand a measurement).  The filename is built HERE only, so
+    producers (the kernels' mark_warm) and consumers (bench) can never
+    drift apart."""
+    return os.path.join(cache_dir_path(), f"device_{stage}_warm.{backend}")
+
+
 def pairing_warm_sentinel(backend: str) -> str:
-    """Marker file recording that the device pairing chain compiled to
-    completion for `backend` with the entries persisted in the cache.
-    Lets the bench attempt the device pairing only when a warm start is
-    plausible — a cold compile of the Miller/final-exp chain can exceed
-    the whole section budget (round-3 lesson: never let one slow compile
-    strand a measurement)."""
-    return os.path.join(cache_dir_path(), f"device_pairing_warm.{backend}")
+    return warm_sentinel("pairing", backend)
+
+
+def mark_warm(stage: str) -> None:
+    """Write the warm sentinel for `stage` — call strictly AFTER the
+    chain's results have been materialized on host (a sentinel written
+    before a runtime failure would keep steering later runs into the
+    broken path).  No-op without the persistent cache or on cpu."""
+    try:
+        if not _enabled:
+            return
+        import jax
+
+        backend = jax.default_backend()
+        if backend == "cpu":
+            return
+        with open(warm_sentinel(stage, backend), "w") as fh:
+            fh.write("ok\n")
+    except Exception:
+        pass
