@@ -12,6 +12,7 @@ help:
 	@echo "lint       - ruff check (if installed)"
 	@echo "reftests   - emit test vectors to ./test_vectors"
 	@echo "bench      - run the driver benchmark"
+	@echo "seed-device- one-time device-kernel compile into .jax_cache"
 	@echo "multichip  - 8-virtual-device sharding dry run"
 	@echo "clean      - remove caches and generated vectors"
 
