@@ -1913,15 +1913,20 @@ int bls_pairing_check(uint64_t n, const uint8_t *g1s, const uint8_t *g2s,
     }
     fp12 f;
     fp12_one(&f);
+    int degenerate = 0;
     for (int bit = 62; bit >= 0; bit--) {
         fp12_sqr(&f, &f);
-        /* gather 2y denominators of the still-finite accumulators */
+        /* gather 2y denominators of the still-finite accumulators; a
+         * y==0 accumulator (order-2 point, unreachable for subgroup
+         * inputs) would poison the whole batch inversion — fail CLOSED */
         uint64_t m = 0;
         for (uint64_t i = 0; i < live; i++) {
             if (pairs[i].t.inf) continue;
             fp2_add(&den[m], &pairs[i].t.y, &pairs[i].t.y);
+            if (fp2_is_zero(&den[m])) { degenerate = 1; break; }
             idx[m++] = i;
         }
+        if (degenerate) break;
         fp2_batch_inv(den, scratch, m);
         for (uint64_t j = 0; j < m; j++) {
             mpair *p = &pairs[idx[j]];
@@ -1943,6 +1948,7 @@ int bls_pairing_check(uint64_t n, const uint8_t *g1s, const uint8_t *g2s,
     if (pairs != stack_pairs) free(pairs);
     if (den != stack_den) free(den);
     if (idx != stack_idx) free(idx);
+    if (degenerate) return 0;
     fp12 c;
     fp12_conj(&c, &f);
     return final_exp_is_one_fast(&c);
@@ -2004,9 +2010,14 @@ uint64_t bls_g2_prepare_many(uint64_t n, const uint8_t *g2s, uint64_t *out) {
     int ok = 1;
     uint64_t step = 0;
     for (int bit = 62; bit >= 0 && ok; bit--) {
-        /* doubling: tangent at pre-doubling T */
-        for (uint64_t i = 0; i < n; i++)
+        /* doubling: tangent at pre-doubling T.  A y==0 point (order 2)
+         * would feed a zero denominator into the batch inversion and emit
+         * garbage lines — honor the degenerate-step contract instead. */
+        for (uint64_t i = 0; i < n; i++) {
             fp2_add(&den[i], &t[i].y, &t[i].y);
+            if (fp2_is_zero(&den[i])) { ok = 0; break; }
+        }
+        if (!ok) break;
         fp2_batch_inv(den, scratch, n);
         for (uint64_t i = 0; i < n; i++) {
             fp2 num, t3, lam, a3, tmp, x3, y3;

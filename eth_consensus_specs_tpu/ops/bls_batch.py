@@ -32,7 +32,7 @@ from eth_consensus_specs_tpu.crypto.curve import (
     g1_infinity,
     g2_from_bytes,
 )
-from eth_consensus_specs_tpu.crypto.hash_to_curve import hash_to_g2
+from eth_consensus_specs_tpu.crypto.hash_to_curve import DST_G2, hash_to_g2
 from eth_consensus_specs_tpu.crypto.pairing import pairing_check
 
 
@@ -42,30 +42,33 @@ def _use_device() -> bool:
     return bls.backend_name() == "tpu"
 
 
-# hash-to-G2 results keyed by message — primed in one batched device
-# dispatch when ETH_SPECS_TPU_DEVICE_H2C is on; host fallback per miss
-_H2G2_CACHE: dict[bytes, object] = {}
+# hash-to-G2 results keyed by (dst, message) — primed in one batched
+# device dispatch when ETH_SPECS_TPU_DEVICE_H2C is on; host fallback per
+# miss.  The dst is part of the key so a caller priming under one domain
+# can never serve a point to a reader under another.
+_H2G2_CACHE: dict[tuple[bytes, bytes], object] = {}
 
 
-def _prime_h2g2_cache(msgs: list[bytes], batch_fn) -> None:
+def _prime_h2g2_cache(msgs: list[bytes], batch_fn, dst: bytes = DST_G2) -> None:
     # evict BEFORE deciding what to batch: clearing afterwards would drop
     # this very call's cached messages and push them onto the serial host
     # path — the opposite of what the batched dispatch is for
-    if len(_H2G2_CACHE) + len(msgs) > 512:
-        keep = {m: _H2G2_CACHE[m] for m in msgs if m in _H2G2_CACHE}
+    keys = [(dst, m) for m in msgs]
+    if len(_H2G2_CACHE) + len(keys) > 512:
+        keep = {k: _H2G2_CACHE[k] for k in keys if k in _H2G2_CACHE}
         _H2G2_CACHE.clear()
         _H2G2_CACHE.update(keep)
-    fresh = [m for m in msgs if m not in _H2G2_CACHE]
+    fresh = [m for m in msgs if (dst, m) not in _H2G2_CACHE]
     if not fresh:
         return
-    points = batch_fn(fresh)
+    points = batch_fn(fresh, dst)
     for m, p in zip(fresh, points):
-        _H2G2_CACHE[m] = p
+        _H2G2_CACHE[(dst, m)] = p
 
 
-def _h2g2(msg: bytes):
-    hit = _H2G2_CACHE.get(msg)
-    return hit if hit is not None else hash_to_g2(msg)
+def _h2g2(msg: bytes, dst: bytes = DST_G2):
+    hit = _H2G2_CACHE.get((dst, msg))
+    return hit if hit is not None else hash_to_g2(msg, dst)
 
 
 def _pairing_check_routed(pairs) -> bool:
