@@ -12,6 +12,11 @@
 #include <stdint.h>
 #include <string.h>
 
+#if defined(__x86_64__)
+#include <immintrin.h>
+#define HAVE_SHA_NI_PATH 1
+#endif
+
 #define ROTR(x, n) (((x) >> (n)) | ((x) << (32 - (n))))
 
 static const uint32_t K[64] = {
@@ -56,9 +61,7 @@ static void compress(uint32_t state[8], const uint8_t block[64]) {
     state[4] += e; state[5] += f; state[6] += g; state[7] += h;
 }
 
-/* SHA-256 of exactly 64 bytes (one Merkle pair): the padding block is
- * constant, so hash = compress(compress(H0, msg), PAD64). */
-void sha256_pair(const uint8_t *in64, uint8_t *out32) {
+static void sha256_pair_scalar(const uint8_t *in64, uint8_t *out32) {
     uint32_t st[8];
     memcpy(st, H0, sizeof st);
     compress(st, in64);
@@ -74,10 +77,210 @@ void sha256_pair(const uint8_t *in64, uint8_t *out32) {
     }
 }
 
+#ifdef HAVE_SHA_NI_PATH
+/* SHA-NI fast path.  The 64-byte-message padding block is a CONSTANT, so
+ * its 64-round message schedule (plus the K constants) collapses to a
+ * precomputed W+K table: the second compression runs 32 sha256rnds2 with
+ * no msg1/msg2 schedule work at all. */
+
+static uint32_t WK_PAD[64]; /* w[i] + K[i] for the constant pad block */
+static int wk_pad_ready = 0;
+
+static void init_wk_pad(void) {
+    uint8_t pad[64] = {0};
+    pad[0] = 0x80;
+    pad[62] = 0x02;
+    uint32_t w[64];
+    for (int i = 0; i < 16; i++)
+        w[i] = ((uint32_t)pad[4 * i] << 24) | ((uint32_t)pad[4 * i + 1] << 16) |
+               ((uint32_t)pad[4 * i + 2] << 8) | (uint32_t)pad[4 * i + 3];
+    for (int i = 16; i < 64; i++) {
+        uint32_t s0 = ROTR(w[i - 15], 7) ^ ROTR(w[i - 15], 18) ^ (w[i - 15] >> 3);
+        uint32_t s1 = ROTR(w[i - 2], 17) ^ ROTR(w[i - 2], 19) ^ (w[i - 2] >> 10);
+        w[i] = w[i - 16] + s0 + w[i - 7] + s1;
+    }
+    for (int i = 0; i < 64; i++) WK_PAD[i] = w[i] + K[i];
+    wk_pad_ready = 1;
+}
+
+/* Two sha256 rounds x2 halves for one 4-round group with schedule values
+ * already K-added in `wk`; the canonical ABEF/CDGH register split. */
+#define RNDS4(S0, S1, WKV)                                   \
+    do {                                                     \
+        __m128i _wk = (WKV);                                 \
+        (S1) = _mm_sha256rnds2_epu32((S1), (S0), _wk);       \
+        _wk = _mm_shuffle_epi32(_wk, 0x0E);                  \
+        (S0) = _mm_sha256rnds2_epu32((S0), (S1), _wk);       \
+    } while (0)
+
+__attribute__((target("sha,ssse3,sse4.1"))) static void
+sha_ni_pair(const uint8_t *in64, uint8_t *out32) {
+    const __m128i MASK =
+        _mm_set_epi64x(0x0c0d0e0f08090a0bULL, 0x0405060700010203ULL);
+    /* pack H0 into ABEF / CDGH */
+    __m128i abcd = _mm_loadu_si128((const __m128i *)&H0[0]);
+    __m128i efgh = _mm_loadu_si128((const __m128i *)&H0[4]);
+    __m128i tmp = _mm_shuffle_epi32(abcd, 0xB1); /* CDAB */
+    efgh = _mm_shuffle_epi32(efgh, 0x1B);        /* HGFE -> EFGH rev */
+    __m128i st0 = _mm_alignr_epi8(tmp, efgh, 8); /* ABEF */
+    __m128i st1 = _mm_blend_epi16(efgh, tmp, 0xF0); /* CDGH */
+    const __m128i abef_h0 = st0, cdgh_h0 = st1;
+
+    /* compression 1: the message block, rolling 4-word schedule */
+    __m128i msgs[4];
+    for (int i = 0; i < 4; i++)
+        msgs[i] = _mm_shuffle_epi8(
+            _mm_loadu_si128((const __m128i *)(in64 + 16 * i)), MASK);
+    for (int g = 0; g < 16; g++) {
+        __m128i wk = _mm_add_epi32(msgs[g & 3],
+                                   _mm_loadu_si128((const __m128i *)&K[4 * g]));
+        RNDS4(st0, st1, wk);
+        if (g < 12) {
+            /* msgs[g&3] <- W[4g+16 .. 4g+19] */
+            __m128i x = _mm_sha256msg1_epu32(msgs[g & 3], msgs[(g + 1) & 3]);
+            x = _mm_add_epi32(
+                x, _mm_alignr_epi8(msgs[(g + 3) & 3], msgs[(g + 2) & 3], 4));
+            msgs[g & 3] = _mm_sha256msg2_epu32(x, msgs[(g + 3) & 3]);
+        }
+    }
+    st0 = _mm_add_epi32(st0, abef_h0);
+    st1 = _mm_add_epi32(st1, cdgh_h0);
+
+    /* compression 2: constant pad block, precomputed W+K */
+    const __m128i abef_s = st0, cdgh_s = st1;
+    for (int g = 0; g < 16; g++)
+        RNDS4(st0, st1, _mm_loadu_si128((const __m128i *)&WK_PAD[4 * g]));
+    st0 = _mm_add_epi32(st0, abef_s);
+    st1 = _mm_add_epi32(st1, cdgh_s);
+
+    /* unpack ABEF/CDGH -> big-endian digest bytes */
+    tmp = _mm_shuffle_epi32(st0, 0x1B);            /* FEBA */
+    st1 = _mm_shuffle_epi32(st1, 0xB1);            /* DCHG */
+    __m128i dcba = _mm_blend_epi16(tmp, st1, 0xF0); /* ABCD (le lanes) */
+    __m128i hgfe = _mm_alignr_epi8(st1, tmp, 8);    /* EFGH (le lanes) */
+    _mm_storeu_si128((__m128i *)out32, _mm_shuffle_epi8(dcba, MASK));
+    _mm_storeu_si128((__m128i *)(out32 + 16), _mm_shuffle_epi8(hgfe, MASK));
+}
+
+/* Two independent messages interleaved to hide sha256rnds2 latency (the
+ * two dependency chains share no registers). */
+__attribute__((target("sha,ssse3,sse4.1"))) static void
+sha_ni_pair2(const uint8_t *a64, const uint8_t *b64, uint8_t *aout,
+             uint8_t *bout) {
+    const __m128i MASK =
+        _mm_set_epi64x(0x0c0d0e0f08090a0bULL, 0x0405060700010203ULL);
+    __m128i abcd = _mm_loadu_si128((const __m128i *)&H0[0]);
+    __m128i efgh = _mm_loadu_si128((const __m128i *)&H0[4]);
+    __m128i tmp = _mm_shuffle_epi32(abcd, 0xB1);
+    efgh = _mm_shuffle_epi32(efgh, 0x1B);
+    const __m128i abef_h0 = _mm_alignr_epi8(tmp, efgh, 8);
+    const __m128i cdgh_h0 = _mm_blend_epi16(efgh, tmp, 0xF0);
+
+    __m128i a0 = abef_h0, a1 = cdgh_h0, b0 = abef_h0, b1 = cdgh_h0;
+    __m128i ma[4], mb[4];
+    for (int i = 0; i < 4; i++) {
+        ma[i] = _mm_shuffle_epi8(
+            _mm_loadu_si128((const __m128i *)(a64 + 16 * i)), MASK);
+        mb[i] = _mm_shuffle_epi8(
+            _mm_loadu_si128((const __m128i *)(b64 + 16 * i)), MASK);
+    }
+    for (int g = 0; g < 16; g++) {
+        __m128i kv = _mm_loadu_si128((const __m128i *)&K[4 * g]);
+        __m128i wka = _mm_add_epi32(ma[g & 3], kv);
+        __m128i wkb = _mm_add_epi32(mb[g & 3], kv);
+        a1 = _mm_sha256rnds2_epu32(a1, a0, wka);
+        b1 = _mm_sha256rnds2_epu32(b1, b0, wkb);
+        wka = _mm_shuffle_epi32(wka, 0x0E);
+        wkb = _mm_shuffle_epi32(wkb, 0x0E);
+        a0 = _mm_sha256rnds2_epu32(a0, a1, wka);
+        b0 = _mm_sha256rnds2_epu32(b0, b1, wkb);
+        if (g < 12) {
+            __m128i xa = _mm_sha256msg1_epu32(ma[g & 3], ma[(g + 1) & 3]);
+            __m128i xb = _mm_sha256msg1_epu32(mb[g & 3], mb[(g + 1) & 3]);
+            xa = _mm_add_epi32(
+                xa, _mm_alignr_epi8(ma[(g + 3) & 3], ma[(g + 2) & 3], 4));
+            xb = _mm_add_epi32(
+                xb, _mm_alignr_epi8(mb[(g + 3) & 3], mb[(g + 2) & 3], 4));
+            ma[g & 3] = _mm_sha256msg2_epu32(xa, ma[(g + 3) & 3]);
+            mb[g & 3] = _mm_sha256msg2_epu32(xb, mb[(g + 3) & 3]);
+        }
+    }
+    a0 = _mm_add_epi32(a0, abef_h0);
+    a1 = _mm_add_epi32(a1, cdgh_h0);
+    b0 = _mm_add_epi32(b0, abef_h0);
+    b1 = _mm_add_epi32(b1, cdgh_h0);
+
+    const __m128i as0 = a0, as1 = a1, bs0 = b0, bs1 = b1;
+    for (int g = 0; g < 16; g++) {
+        __m128i wk = _mm_loadu_si128((const __m128i *)&WK_PAD[4 * g]);
+        a1 = _mm_sha256rnds2_epu32(a1, a0, wk);
+        b1 = _mm_sha256rnds2_epu32(b1, b0, wk);
+        wk = _mm_shuffle_epi32(wk, 0x0E);
+        a0 = _mm_sha256rnds2_epu32(a0, a1, wk);
+        b0 = _mm_sha256rnds2_epu32(b0, b1, wk);
+    }
+    a0 = _mm_add_epi32(a0, as0);
+    a1 = _mm_add_epi32(a1, as1);
+    b0 = _mm_add_epi32(b0, bs0);
+    b1 = _mm_add_epi32(b1, bs1);
+
+    tmp = _mm_shuffle_epi32(a0, 0x1B);
+    a1 = _mm_shuffle_epi32(a1, 0xB1);
+    _mm_storeu_si128((__m128i *)aout,
+                     _mm_shuffle_epi8(_mm_blend_epi16(tmp, a1, 0xF0), MASK));
+    _mm_storeu_si128((__m128i *)(aout + 16),
+                     _mm_shuffle_epi8(_mm_alignr_epi8(a1, tmp, 8), MASK));
+    tmp = _mm_shuffle_epi32(b0, 0x1B);
+    b1 = _mm_shuffle_epi32(b1, 0xB1);
+    _mm_storeu_si128((__m128i *)bout,
+                     _mm_shuffle_epi8(_mm_blend_epi16(tmp, b1, 0xF0), MASK));
+    _mm_storeu_si128((__m128i *)(bout + 16),
+                     _mm_shuffle_epi8(_mm_alignr_epi8(b1, tmp, 8), MASK));
+}
+
+static int have_sha_ni(void) {
+    /* v is published only AFTER WK_PAD is fully initialized (ctypes
+     * releases the GIL, so first use can race): a second thread either
+     * sees v < 0 and redoes the idempotent init, or sees v >= 0 with the
+     * table already filled (x86-TSO orders the table stores first). */
+    static volatile int v = -1;
+    if (v < 0) {
+        int have = __builtin_cpu_supports("sha") ? 1 : 0;
+        if (have && !wk_pad_ready) init_wk_pad();
+        v = have;
+    }
+    return v;
+}
+#else
+static int have_sha_ni(void) { return 0; }
+#endif
+
+/* SHA-256 of exactly 64 bytes (one Merkle pair): the padding block is
+ * constant, so hash = compress(compress(H0, msg), PAD64). */
+void sha256_pair(const uint8_t *in64, uint8_t *out32) {
+#ifdef HAVE_SHA_NI_PATH
+    if (have_sha_ni()) {
+        sha_ni_pair(in64, out32);
+        return;
+    }
+#endif
+    sha256_pair_scalar(in64, out32);
+}
+
 /* n independent 64-byte messages -> n 32-byte digests. */
 void sha256_pairs(const uint8_t *in, uint8_t *out, uint64_t n) {
+#ifdef HAVE_SHA_NI_PATH
+    if (have_sha_ni()) {
+        uint64_t i = 0;
+        for (; i + 2 <= n; i += 2)
+            sha_ni_pair2(in + 64 * i, in + 64 * (i + 1), out + 32 * i,
+                         out + 32 * (i + 1));
+        if (i < n) sha_ni_pair(in + 64 * i, out + 32 * i);
+        return;
+    }
+#endif
     for (uint64_t i = 0; i < n; i++)
-        sha256_pair(in + 64 * i, out + 32 * i);
+        sha256_pair_scalar(in + 64 * i, out + 32 * i);
 }
 
 /* One level of a Merkle tree: 2n chunks in, n parents out (in-place safe

@@ -38,11 +38,24 @@ def hash_bytes(data: bytes) -> bytes:
     return hashlib.sha256(data).digest()
 
 
+# Below this the per-call ctypes setup outweighs the native core's SHA-NI
+# batch win over hashlib's (also-C) one-shot path.
+_NATIVE_THRESHOLD = 8
+
+
 def _hash_pairs_host(pairs: np.ndarray) -> np.ndarray:
-    """pairs: uint8[N, 64] -> uint8[N, 32] via hashlib."""
-    out = np.empty((pairs.shape[0], 32), dtype=np.uint8)
+    """pairs: uint8[N, 64] -> uint8[N, 32] via the native C sha core (one
+    call per batch, SHA-NI when the host has it) or hashlib."""
+    n = pairs.shape[0]
+    if n >= _NATIVE_THRESHOLD:
+        from eth_consensus_specs_tpu import native
+
+        if native.available():
+            out = native.sha256_pairs(np.ascontiguousarray(pairs).tobytes())
+            return np.frombuffer(out, dtype=np.uint8).reshape(n, 32)
+    out = np.empty((n, 32), dtype=np.uint8)
     sha = hashlib.sha256
-    for i in range(pairs.shape[0]):
+    for i in range(n):
         out[i] = np.frombuffer(sha(pairs[i].tobytes()).digest(), dtype=np.uint8)
     return out
 
