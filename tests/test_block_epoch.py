@@ -1,0 +1,171 @@
+"""Columnar block-processing kernel (ops/block_epoch.py) vs the object
+path and vs the numpy host oracle — BASELINE config #4's bit-exactness
+gates (an epoch of blocks: attestations, sync rewards, deposits,
+withdrawal sweep, per-slot dirty roots)."""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from eth_consensus_specs_tpu.ops import block_epoch as bek
+from eth_consensus_specs_tpu.ops import block_epoch_host as bekh
+from eth_consensus_specs_tpu.test_infra.attestations import get_valid_attestations_at_slot
+from eth_consensus_specs_tpu.test_infra.block import (
+    build_empty_block_for_next_slot,
+    state_transition_and_sign_block,
+)
+from eth_consensus_specs_tpu.test_infra.context import spec_state_test, with_phases
+
+
+def _build_epoch_blocks(spec, state, n_blocks=None):
+    """Blocks for slots epoch_start+1 .. epoch_start+n inside ONE epoch
+    (no boundary crossing), full attestations each slot."""
+    if n_blocks is None:
+        n_blocks = int(spec.SLOTS_PER_EPOCH) - 1
+    blocks = []
+    for _ in range(n_blocks):
+        block = build_empty_block_for_next_slot(spec, state)
+        if int(state.slot) >= spec.MIN_ATTESTATION_INCLUSION_DELAY:
+            slot_to_attest = int(state.slot) - spec.MIN_ATTESTATION_INCLUSION_DELAY + 1
+            if slot_to_attest >= spec.compute_start_slot_at_epoch(
+                spec.get_current_epoch(state)
+            ):
+                for att in get_valid_attestations_at_slot(spec, state, slot_to_attest):
+                    block.body.attestations.append(att)
+        blocks.append(state_transition_and_sign_block(spec, state, block))
+    return blocks
+
+
+def _static_from_state(spec, params, state):
+    n = len(state.validators)
+    eff = np.array([int(v.effective_balance) for v in state.validators], np.uint64)
+    wd = np.array(
+        [min(int(v.withdrawable_epoch), 2**64 - 1) for v in state.validators], np.uint64
+    )
+    cred = np.array(
+        [bytes(v.withdrawal_credentials)[:1] == b"\x01" for v in state.validators], bool
+    )
+    static = bek.make_epoch_static(
+        params,
+        jnp.asarray(eff),
+        jnp.asarray(wd),
+        jnp.asarray(cred),
+        int(spec.get_current_epoch(state)),
+    )
+    return static, eff, wd, cred
+
+
+def _run_parity(spec, state, with_withdrawals):
+    pre_state = state.copy()
+    blocks = _build_epoch_blocks(spec, state)
+    obj = state  # advanced in place by the builder
+
+    params = bek.BlockEpochParams.from_spec(spec)
+    n = len(pre_state.validators)
+    cols, st0 = bek.extract_block_columns(spec, pre_state, blocks)
+    static, eff, wd, cred = _static_from_state(spec, params, pre_state)
+
+    st, _acc = bek.block_epoch_chain(
+        params, n, st0, cols, static, root_ctx=None, with_withdrawals=with_withdrawals
+    )
+
+    assert np.array_equal(
+        np.asarray(st.balance), np.array([int(b) for b in obj.balances], np.uint64)
+    ), "balances diverge from the object path"
+    assert np.array_equal(
+        np.asarray(st.cur_part),
+        np.array([int(f) for f in obj.current_epoch_participation], np.uint8),
+    )
+    assert np.array_equal(
+        np.asarray(st.prev_part),
+        np.array([int(f) for f in obj.previous_epoch_participation], np.uint8),
+    )
+    if with_withdrawals:
+        assert int(np.asarray(st.next_wd_index)) == int(obj.next_withdrawal_index)
+        assert int(np.asarray(st.next_wd_validator)) == int(
+            obj.next_withdrawal_validator_index
+        )
+
+    # triangle leg 2: the numpy host oracle replays the same columns
+    bal_h, cur_h, prev_h, wdi_h, wdv_h, _ = bekh.replay_block_epoch_np(
+        params,
+        n,
+        st0,
+        cols,
+        eff,
+        wd,
+        cred,
+        int(spec.get_current_epoch(pre_state)),
+        with_withdrawals=with_withdrawals,
+    )
+    assert np.array_equal(np.asarray(st.balance), bal_h)
+    assert np.array_equal(np.asarray(st.cur_part), cur_h)
+    assert np.array_equal(np.asarray(st.prev_part), prev_h)
+    if with_withdrawals:
+        assert int(np.asarray(st.next_wd_index)) == wdi_h
+        assert int(np.asarray(st.next_wd_validator)) == wdv_h
+
+
+@with_phases(["altair"])
+@spec_state_test
+def test_block_epoch_parity_altair(spec, state):
+    _run_parity(spec, state, with_withdrawals=False)
+
+
+@with_phases(["deneb"])
+@spec_state_test
+def test_block_epoch_parity_deneb_withdrawals(spec, state):
+    # make the sweep actually pay: eth1 credentials + excess balances on a
+    # stripe of validators, two fully-withdrawable ones
+    for i in range(0, len(state.validators), 5):
+        state.validators[i].withdrawal_credentials = b"\x01" + b"\x00" * 11 + bytes(
+            [i % 256]
+        ) * 20
+        state.balances[i] = int(state.balances[i]) + 1_000_000_000
+    state.validators[2].withdrawable_epoch = 0
+    state.validators[7].withdrawable_epoch = 0
+    _run_parity(spec, state, with_withdrawals=True)
+
+
+def test_synthetic_chain_kernel_vs_oracle_with_roots():
+    """Full synthetic chain at small n: device kernel with per-slot dirty
+    roots == numpy oracle with native-SHA roots (the exact coupling the
+    block_epoch bench section publishes under)."""
+    from eth_consensus_specs_tpu.forks import get_spec
+    from eth_consensus_specs_tpu.ops.state_root import synthetic_static
+
+    spec = get_spec("deneb", "mainnet")
+    n = 1 << 10
+    cols, st0, static = bek.synthetic_block_columns(spec, n, seed=3, atts_per_slot=8)
+    params = bek.BlockEpochParams.from_spec(spec)
+
+    import __graft_entry__ as graft
+
+    _, just = graft._example_altair_inputs(n)
+    scores = jnp.asarray(
+        np.random.default_rng(9).integers(0, 50, n, dtype=np.int64).astype(np.uint64)
+    )
+    arrays, meta = synthetic_static(spec, n)
+    ctx = bek.make_root_ctx(spec, arrays, meta, static, scores, just)
+
+    st, acc = bek.block_epoch_chain(params, n, st0, cols, static, root_ctx=ctx)
+
+    root_fn = bekh.slot_root_fn_np(spec, arrays, meta, static, scores, just)
+    bal_h, cur_h, prev_h, wdi_h, wdv_h, acc_h = bekh.replay_block_epoch_np(
+        params,
+        n,
+        st0,
+        cols,
+        np.asarray(static.eff_balance),
+        np.asarray(static.withdrawable_epoch),
+        np.asarray(static.has_eth1_cred),
+        int(np.asarray(static.epoch)),
+        root_fn=root_fn,
+    )
+    assert np.array_equal(np.asarray(st.balance), bal_h)
+    assert np.array_equal(np.asarray(st.cur_part), cur_h)
+    assert np.array_equal(np.asarray(st.prev_part), prev_h)
+    assert int(np.asarray(st.next_wd_index)) == wdi_h
+    assert int(np.asarray(st.next_wd_validator)) == wdv_h
+    assert np.array_equal(np.asarray(acc), acc_h), "per-slot root xor-chain diverges"
