@@ -12,27 +12,102 @@ level) — measured on a 2**16 subtree and scaled per-hash (hashlib cost is
 size-independent per 64B message).
 
 vs_baseline is the speedup of the device tree over that host loop (>1 is
-faster than the reference path). Secondary numbers go to stderr and into
-the JSON payload's "secondary" object.
+faster than the reference path).
 
-Methodology (round-3 fix): every device section uses CHAINED-DEPENDENCY
-timing — K iterations inside one jit where iteration i+1 consumes
-iteration i's output — so the number is sustained throughput; a lone
-dispatch's apparently-instant completion (round-2 verdict: ~7x inflation)
-cannot leak in.
+Methodology (round-5: correctness-coupled, roofline-gated):
+
+* CHAINED-DEPENDENCY timing — K iterations inside one jit where
+  iteration i+1 consumes iteration i's output, so a lone dispatch's
+  apparently-instant completion cannot leak in (round-2: ~7x inflation).
+* CORRECTNESS COUPLING — every device section's final chained output is
+  recomputed on the host from the SAME salted inputs (native-SHA oracle
+  for tree/resident roots, ops/state_root_host.py; an XLA:CPU re-run for
+  the epoch/das carries) and the number is REFUSED when the device result
+  does not match bit-for-bit.  If the device didn't do the work, the
+  metric dies (round-4 verdict weak #1: 878 Ghash/s published from a
+  platform that plausibly returned before executing).
+* ROOFLINE GATE — each accelerator section reports the implied HBM
+  traffic of its measured rate; entries exceeding a configured
+  single-chip bound (2x v5e-class 819 GB/s) are refused from both the
+  headline and BENCH_LKG.json.  Real hash work is reported alongside
+  logical nodes (the fused tree hashes full buffer width every level:
+  depth * 2**(depth-1) compressions per tree, not 2**depth - 1).
+* BLS timing uses FRESH messages every timed repeat — all hash-to-G2 and
+  G2-prepare work happens inside the timed region (round-4 ADVICE: the
+  old loop re-verified cached messages, measuring a cache, not the
+  pipeline); pubkeys stay fixed across repeats (registry keys repeat
+  every block — decompression caching is genuine steady-state).
 """
 
 from __future__ import annotations
 
 import hashlib
 import json
+import os
 import sys
 import time
 
 import numpy as np
 
+# Single-chip HBM roofline gate, bytes/s. The axon accelerator is
+# v5e-class (~819 GB/s); a measured rate implying more than 2x that
+# sustained traffic cannot be a real execution. XLA:CPU numbers are far
+# below any such bound; the gate applies to accelerator-labeled runs.
+ACCEL_ROOFLINE_BYTES_S = 1.64e12
+
+_LKG_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)), "BENCH_LKG.json")
+
+_ACC_TIMEOUT_S = int(os.environ.get("ETH_SPECS_BENCH_ACC_TIMEOUT", "480"))
+_CPU_TIMEOUT_S = int(os.environ.get("ETH_SPECS_BENCH_CPU_TIMEOUT", "300"))
+_VERIFY_TIMEOUT_S = int(os.environ.get("ETH_SPECS_BENCH_VERIFY_TIMEOUT", "420"))
+_MAX_ACC_FAILURES = 3
+
+
+def _digest(arr: np.ndarray) -> str:
+    return hashlib.sha256(np.ascontiguousarray(arr).tobytes()).hexdigest()[:32]
+
+
+def sizes_for(section: str, on_cpu: bool) -> dict:
+    """Work sizes per backend class. CPU-fallback sizes are chosen so every
+    section finishes well inside its budget with a quotable number
+    (round-4 verdict weak #4: fallback produced timeouts and 0.000-lines)."""
+    if section == "tree":
+        return (
+            {"depth": 14, "chain": 4, "repeats": 2}
+            if on_cpu
+            else {"depth": 21, "chain": 8, "repeats": 2}
+        )
+    if section == "epoch":
+        return (
+            {"n": 1 << 16, "chain": 4, "repeats": 2}
+            if on_cpu
+            else {"n": 1_000_000, "chain": 8, "repeats": 3}
+        )
+    if section == "resident":
+        return (
+            {"n": 1 << 16, "epochs": 2, "repeats": 2}
+            if on_cpu
+            else {"n": 1 << 20, "epochs": 8, "repeats": 2}
+        )
+    if section == "das":
+        return (
+            {"batch": 2, "n": 1024, "chain": 8, "repeats": 2}
+            if on_cpu
+            else {"batch": 16, "n": 8192, "chain": 8, "repeats": 2}
+        )
+    if section == "block_epoch":
+        return (
+            {"n": 1 << 14, "atts": 8, "repeats": 2}
+            if on_cpu
+            else {"n": 1 << 20, "atts": 128, "repeats": 2}
+        )
+    if section == "bls":
+        return {}  # sizes itself: native-core presence picks the batch
+    raise SystemExit(f"unknown section {section}")
+
 
 def host_hashes_per_sec(n_pairs: int = 1 << 16) -> float:
+    """The reference's host path: one hashlib.sha256 per tree node."""
     rng = np.random.default_rng(0)
     pairs = rng.integers(0, 256, size=(n_pairs, 64), dtype=np.uint8)
     blobs = [p.tobytes() for p in pairs]
@@ -44,67 +119,93 @@ def host_hashes_per_sec(n_pairs: int = 1 << 16) -> float:
     return n_pairs / dt
 
 
-def device_tree_hashes_per_sec(
-    depth: int = 21, chain: int = 16, repeats: int = 3
-) -> tuple[float, float]:
-    """Sustained per-tree time via CHAINED-DEPENDENCY timing: `chain` trees
-    run inside one jit, each tree's leaves XORed with the previous tree's
-    root, so no tree can start before the previous one finishes and a lone
-    dispatch's apparent completion cannot deflate the number (round-2
-    verdict: single-call block_until_ready under-measured ~7x on this
-    platform).  Inputs are re-salted between repeats to defeat any
-    (executable, input) result caching."""
+def native_hashes_per_sec(n_pairs: int = 1 << 19) -> float | None:
+    """This framework's host path: one C call per level, SHA-NI inside."""
+    from eth_consensus_specs_tpu import native
+
+    if not native.available():
+        return None
+    rng = np.random.default_rng(0)
+    data = rng.integers(0, 256, size=n_pairs * 64, dtype=np.uint8).tobytes()
+    t0 = time.perf_counter()
+    native.sha256_pairs(data)
+    dt = time.perf_counter() - t0
+    return n_pairs / dt
+
+
+# --------------------------------------------------------------- sections --
+
+
+def run_tree(p: dict) -> dict:
+    """Chained device trees; the final chained root is recomputed through
+    the native-SHA host oracle from the same salted leaves — a hash
+    engine that shares nothing with XLA — and must match bit-for-bit."""
     import jax
     import jax.numpy as jnp
     from jax import lax
 
     from eth_consensus_specs_tpu.ops.merkle import _tree_root_fused
+    from eth_consensus_specs_tpu.ops.state_root_host import tree_root_chain_np
 
+    depth, chain, repeats = p["depth"], p["chain"], p["repeats"]
     rng = np.random.default_rng(1)
-    base = jax.device_put(
-        jnp.asarray(
-            rng.integers(0, 2**32, size=(1 << depth, 8), dtype=np.uint64).astype(np.uint32)
-        )
+    base_np = rng.integers(0, 2**32, size=(1 << depth, 8), dtype=np.uint64).astype(
+        np.uint32
     )
+    base = jax.device_put(jnp.asarray(base_np))
 
     @jax.jit
     def run(lv, acc0):
         def body(_, carry):
             lv, acc = carry
-            fresh = lv ^ acc  # (N, 8) ^ (8,): every leaf depends on the prior root
-            return lv, _tree_root_fused(fresh, depth)
+            return lv, _tree_root_fused(lv ^ acc, depth)
 
         return lax.fori_loop(0, chain, body, (lv, acc0))[1]
 
-    warm = jnp.zeros(8, jnp.uint32)
-    jax.block_until_ready(run(base, warm))  # compile + warm
+    run_salt = p.get("salt", 0)
+    jax.block_until_ready(run(base, jnp.zeros(8, jnp.uint32)))  # compile + warm
     best = float("inf")
+    final = None
     for i in range(repeats):
-        salt = jnp.full(8, np.uint32(i + 1), jnp.uint32)
+        salt = jnp.full(8, np.uint32(run_salt + i + 1), jnp.uint32)
         t0 = time.perf_counter()
-        jax.block_until_ready(run(base, salt))
+        final = jax.block_until_ready(run(base, salt))
         best = min(best, time.perf_counter() - t0)
     per_tree = best / chain
-    n_hashes = (1 << depth) - 1  # logical tree nodes
-    return n_hashes / per_tree, per_tree
+
+    expected = tree_root_chain_np(
+        base_np, depth, chain, np.full(8, run_salt + repeats, np.uint32)
+    )
+    verified = bool(np.array_equal(np.asarray(final), expected))
+
+    logical = (1 << depth) - 1
+    real = depth * (1 << (depth - 1))  # full-width loop: ops/merkle.py
+    return {
+        "hps": logical / per_tree,
+        "real_hps": real / per_tree,
+        "tree_s": per_tree,
+        "depth": depth,
+        "chain": chain,
+        "work_bytes": real * 96,  # 64B read + 32B write per compression
+        "verified": verified,
+        "verify_how": "native-sha host oracle, same salted leaves",
+    }
 
 
-def bench_epoch_accounting(n_validators: int = 1_000_000, chain: int = 8) -> float:
-    """Secondary: fused 1M-validator accounting epoch, sustained
-    seconds/epoch via chained-dependency timing (each epoch consumes the
-    previous epoch's balances inside one jit)."""
+def _epoch_setup(p: dict):
+    """ONE builder for both the timed run and the verify recompute — the
+    two sides can never drift apart."""
     import jax
-    import jax.numpy as jnp
     from jax import lax
 
     import __graft_entry__ as graft
     from eth_consensus_specs_tpu.forks import get_spec
     from eth_consensus_specs_tpu.ops.state_columns import EpochParams, epoch_accounting
 
+    n, chain = p["n"], p["chain"]
     params = EpochParams.from_spec(get_spec("phase0", "mainnet"))
-    cols, just = graft._example_inputs(n_validators)
-    cols = jax.device_put(cols)
-    just = jax.device_put(just)
+    cols, just = graft._example_inputs(n)
+    work_bytes = 2 * sum(a.nbytes for a in jax.tree_util.tree_leaves(cols))
 
     @jax.jit
     def run(cols, just):
@@ -116,29 +217,62 @@ def bench_epoch_accounting(n_validators: int = 1_000_000, chain: int = 8) -> flo
 
         return lax.fori_loop(0, chain, body, cols).balance
 
+    return run, cols, just, work_bytes
+
+
+def run_epoch(p: dict) -> dict:
+    """Fused accounting epochs, chained; returns the final balance digest
+    for the parent's XLA:CPU recompute to match."""
+    import jax
+    import jax.numpy as jnp
+
+    run, cols, just, work_bytes = _epoch_setup(p)
+    chain, repeats, salt = p["chain"], p["repeats"], p.get("salt", 0)
+    cols = jax.device_put(cols)
+    just = jax.device_put(just)
     salt_fn = jax.jit(lambda c, s: c._replace(balance=c.balance + s))
     jax.block_until_ready(run(cols, just))
     best = float("inf")
-    for i in range(3):
-        fresh = salt_fn(cols, jnp.uint64(i + 1))  # defeat result caching
+    final = None
+    for i in range(repeats):
+        fresh = salt_fn(cols, jnp.uint64(salt + i + 1))
         jax.block_until_ready(fresh)
         t0 = time.perf_counter()
-        jax.block_until_ready(run(fresh, just))
+        final = jax.block_until_ready(run(fresh, just))
         best = min(best, time.perf_counter() - t0)
-    return best / chain
+    return {
+        "epoch_s": best / chain,
+        "n": p["n"],
+        "chain": chain,
+        "work_bytes": work_bytes,
+        "digest": _digest(np.asarray(final)),
+        "verify_how": "XLA:CPU re-run, same salted columns",
+    }
 
 
-def bench_device_resident_epochs(
-    n_validators: int = 1 << 20, epochs: int = 8
-) -> tuple[float, float]:
-    """The BASELINE.json north-star shape: accounting epoch + the FULL
-    post-epoch BeaconState root (dirty-path device merkleization,
-    ops/state_root.py) at ~1M validators, state DEVICE-RESIDENT across
-    epochs through the PUBLIC framework API (parallel/resident.py
-    run_epochs(with_root='state')).  Chained-dependency by construction:
-    each epoch consumes the previous epoch's balances and the per-epoch
-    state root xor-chains into the carry.  Returns
-    (seconds_per_epoch_with_full_root, seconds_total)."""
+def _resident_work_bytes(n: int, cols) -> int:
+    """Lower-bound device traffic per resident epoch: column reads/writes
+    plus 96 B per REAL hash of the dirty-path state root (full-width tree
+    levels counted as the kernel executes them)."""
+    import jax
+
+    def fullwidth(depth):
+        return depth * (1 << max(depth - 1, 0))
+
+    d_val = max(n - 1, 0).bit_length()
+    hashes = 3 * n + fullwidth(d_val)  # validator nodes + registry tree
+    d_bal = (max(n // 4, 1) - 1).bit_length()
+    hashes += 2 * fullwidth(d_bal)  # balances + inactivity trees
+    hashes += fullwidth((max(n // 32, 1) - 1).bit_length())  # participation
+    col_bytes = 2 * sum(a.nbytes for a in jax.tree_util.tree_leaves(cols))
+    return col_bytes + 96 * hashes
+
+
+def run_resident(p: dict) -> dict:
+    """Device-resident epochs + FULL per-epoch state root (the north-star
+    shape).  Verified at FULL SIZE: the parent recomputes root_acc with
+    accounting on XLA:CPU and every state root through the native-SHA
+    host oracle (ops/state_root_host.resident_root_acc_host)."""
     import jax
     import jax.numpy as jnp
 
@@ -147,35 +281,46 @@ def bench_device_resident_epochs(
     from eth_consensus_specs_tpu.ops.state_root import synthetic_static
     from eth_consensus_specs_tpu.parallel import resident
 
+    n, epochs, repeats = p["n"], p["epochs"], p["repeats"]
     spec = get_spec("deneb", "mainnet")
-    cols, just = graft._example_altair_inputs(n_validators)
+    cols, just = graft._example_altair_inputs(n)
+    work_bytes = _resident_work_bytes(n, cols)
     cols = jax.device_put(cols)
     just = jax.device_put(just)
-    static = synthetic_static(spec, n_validators)
+    static = synthetic_static(spec, n)
 
+    run_salt = p.get("salt", 0)
     salt_fn = jax.jit(lambda c, s: c._replace(balance=c.balance + s))
     jax.block_until_ready(
         resident.run_epochs(spec, cols, just, epochs, with_root="state", static=static).root_acc
-    )  # compile + warm
+    )
     best = float("inf")
-    for i in range(3):
-        fresh = salt_fn(cols, jnp.uint64(i + 1))  # defeat result caching
+    final = None
+    for i in range(repeats):
+        fresh = salt_fn(cols, jnp.uint64(run_salt + i + 1))
         jax.block_until_ready(fresh)
         t0 = time.perf_counter()
-        jax.block_until_ready(
+        final = jax.block_until_ready(
             resident.run_epochs(
                 spec, fresh, just, epochs, with_root="state", static=static
             ).root_acc
         )
         best = min(best, time.perf_counter() - t0)
-    return best / epochs, best
+    return {
+        "per_epoch_s": best / epochs,
+        "total_s": best,
+        "n": n,
+        "epochs": epochs,
+        "work_bytes": work_bytes,
+        "digest": _digest(np.asarray(final)),
+        "verify_how": "XLA:CPU accounting + native-SHA state roots, same salted columns",
+    }
 
 
-def bench_das_fft(batch: int = 16, n: int = 8192, chain: int = 8) -> tuple[float, float]:
-    """Secondary: batched 8192-point BLS-scalar-field FFT (the DAS erasure
-    recovery kernel, ops/fr_fft.py), chained-dependency timed: K rounds
-    inside one jit, each round re-transforming its own output.  Returns
-    (ffts_per_sec, seconds_per_round_of_batch)."""
+def _das_setup(p: dict):
+    """Shared builder: the chained-FFT jit plus a per-repeat input maker
+    seeded from the run salt (fresh field elements every repeat and every
+    bench invocation — nothing a result cache could replay)."""
     import jax
     import jax.numpy as jnp
     from jax import lax
@@ -184,171 +329,393 @@ def bench_das_fft(batch: int = 16, n: int = 8192, chain: int = 8) -> tuple[float
     from eth_consensus_specs_tpu.ops import fr_fft
     from eth_consensus_specs_tpu.ops.fr_fft import FR
 
+    batch, n, chain = p["batch"], p["n"], p["chain"]
     roots = tuple(compute_roots_of_unity(n))
     rev = jnp.asarray(fr_fft._bit_reversal_indices(n))
     twiddles = [jnp.asarray(t) for t in fr_fft._stage_twiddles(roots, n)]
 
-    rng = np.random.default_rng(7)
-    vals = FR.ints_to_mont_batch(
-        rng.integers(1, 1 << 62, size=(batch, n), dtype=np.int64)
-    )
+    def make_vals(rep: int) -> np.ndarray:
+        rng = np.random.default_rng((7, p.get("salt", 0), rep))
+        return FR.ints_to_mont_batch(
+            rng.integers(1, 1 << 62, size=(batch, n), dtype=np.int64)
+        )
 
     @jax.jit
     def run(v):
         def body(_, v):
-            # the SAME kernel body the DAS path runs (fr_fft.fft_stages),
-            # re-transforming its own output for the dependency chain
             return fr_fft.fft_stages(jnp.take(v, rev, axis=1), twiddles, n)
 
         return lax.fori_loop(0, chain, body, v)
 
-    dev = jax.device_put(jnp.asarray(vals))
+    return run, make_vals
+
+
+def run_das(p: dict) -> dict:
+    """Batched Fr FFT rounds, chained; final coefficient digest checked
+    against an XLA:CPU re-run by the parent."""
+    import jax
+    import jax.numpy as jnp
+
+    run, make_vals = _das_setup(p)
+    batch, n, chain, repeats = p["batch"], p["n"], p["chain"], p["repeats"]
+    dev = jax.device_put(jnp.asarray(make_vals(0)))
+    work_bytes = 2 * int(np.asarray(dev).nbytes) * max(n.bit_length() - 1, 1)
     jax.block_until_ready(run(dev))  # compile + warm
     best = float("inf")
-    for i in range(2):
-        salted = dev + jnp.uint64(0)  # fresh buffer identity
+    final = None
+    for rep in range(1, repeats + 1):
+        fresh = jax.device_put(jnp.asarray(make_vals(rep)))
         t0 = time.perf_counter()
-        jax.block_until_ready(run(salted))
+        final = jax.block_until_ready(run(fresh))
         best = min(best, time.perf_counter() - t0)
     per_round = best / chain
-    return batch / per_round, per_round
+    return {
+        "ffts_per_sec": batch / per_round,
+        "round_s": per_round,
+        "batch": batch,
+        "n": n,
+        "work_bytes": work_bytes,
+        "digest": _digest(np.asarray(final)),
+        "verify_how": "XLA:CPU re-run, same salted inputs",
+    }
 
 
-def bench_batch_verify(n_aggregates: int = 16, committee: int = 8) -> tuple[float, float]:
-    """Secondary: aggregate-signature batch verification throughput in the
-    production-default configuration — native-C multi-Miller pairing with
-    batched tangent inversions, native hash-to-curve map stage, cached
-    pubkey decompression (crypto/signature._load_pk), one RLC pairing per
-    batch. The per-item DEVICE MSM path (bls.use_tpu) exists for meshes
-    where dispatch cost amortizes; over a tunneled single chip its
-    round-trips dominate, so benching it would measure the tunnel, not the
-    framework. Returns (aggregates_per_sec, seconds_per_batch)."""
+def _block_epoch_setup(p: dict):
+    import __graft_entry__ as graft
+    from eth_consensus_specs_tpu.forks import get_spec
+    from eth_consensus_specs_tpu.ops import block_epoch as bek
+    from eth_consensus_specs_tpu.ops.state_root import synthetic_static
+
+    n, atts = p["n"], p["atts"]
+    spec = get_spec("deneb", "mainnet")
+    cols, st0, static = bek.synthetic_block_columns(spec, n, seed=11, atts_per_slot=atts)
+    acols, just = graft._example_altair_inputs(n)
+    scores = acols.inactivity_scores
+    arrays, meta = synthetic_static(spec, n)
+    return spec, bek, cols, st0, static, scores, just, arrays, meta
+
+
+def run_block_epoch(p: dict) -> dict:
+    """An epoch of BLOCKS on device (BASELINE config #4): 32 slots x
+    `atts` attestations of committee bit-accumulation, proposer rewards,
+    sync rewards, deposits, the capella withdrawal sweep — with a dirty
+    state root every slot, all inside one jit (lax.scan over slots).
+    Verified against the pure-numpy + native-SHA oracle at FULL size."""
+    import jax
+    import jax.numpy as jnp
+
+    spec, bek, cols, st0, static, scores, just, arrays, meta = _block_epoch_setup(p)
+    n, repeats = p["n"], p["repeats"]
+    params = bek.BlockEpochParams.from_spec(spec)
+    ctx = bek.make_root_ctx(spec, arrays, meta, static, scores, just)
+
+    @jax.jit
+    def run(st):
+        out, acc = bek.block_epoch_chain(params, n, st, cols, static, root_ctx=ctx)
+        return out.balance, acc
+
+    run_salt = p.get("salt", 0)
+    st0 = jax.device_put(st0)
+    jax.block_until_ready(run(st0))  # compile + warm
+    best = float("inf")
+    final = None
+    for i in range(repeats):
+        fresh = st0._replace(balance=st0.balance + jnp.uint64(run_salt + i + 1))
+        jax.block_until_ready(fresh)
+        t0 = time.perf_counter()
+        final = run(fresh)
+        jax.block_until_ready(final)
+        best = min(best, time.perf_counter() - t0)
+    bal, acc = (np.asarray(final[0]), np.asarray(final[1]))
+
+    slots = params.slots_per_epoch
+
+    def fullwidth(depth):
+        return depth * (1 << max(depth - 1, 0))
+
+    root_hashes = (
+        fullwidth((max(n // 4, 1) - 1).bit_length())
+        + 2 * fullwidth((max(n // 32, 1) - 1).bit_length())
+        + (1 << meta.top_depth)
+    )
+    col_bytes = 2 * sum(
+        a.nbytes for a in jax.tree_util.tree_leaves((st0.balance, st0.cur_part, st0.prev_part))
+    )
+    work_bytes = slots * (96 * root_hashes + col_bytes)
+    return {
+        "epoch_s": best,
+        "slot_ms": best / slots * 1e3,
+        "n": n,
+        "atts": p["atts"],
+        "slots": slots,
+        "work_bytes": work_bytes,
+        "digest": hashlib.sha256(acc.tobytes() + bal.tobytes()).hexdigest()[:32],
+        "verify_how": "numpy replay + native-SHA slot roots, same salted inputs",
+    }
+
+
+def verify_block_epoch_digest(p: dict) -> str:
+    from eth_consensus_specs_tpu.ops import block_epoch_host as bekh
+
+    spec, bek, cols, st0, static, scores, just, arrays, meta = _block_epoch_setup(p)
+    n = p["n"]
+    params = bek.BlockEpochParams.from_spec(spec)
+    fresh = st0._replace(
+        balance=np.asarray(st0.balance) + np.uint64(p.get("salt", 0) + p["repeats"])
+    )
+    root_fn = bekh.slot_root_fn_np(spec, arrays, meta, static, scores, just)
+    bal, _cur, _prev, _wi, _wv, acc = bekh.replay_block_epoch_np(
+        params,
+        n,
+        fresh,
+        cols,
+        np.asarray(static.eff_balance),
+        np.asarray(static.withdrawable_epoch),
+        np.asarray(static.has_eth1_cred),
+        int(np.asarray(static.epoch)),
+        root_fn=root_fn,
+    )
+    return hashlib.sha256(acc.tobytes() + bal.tobytes()).hexdigest()[:32]
+
+
+def verify_digest(section: str, p: dict) -> str:
+    """Recompute the section's expected final digest on THIS backend
+    (the parent runs this in a CPU-pinned child). Inputs are rebuilt from
+    the same fixed seeds; the salt is the final repeat's."""
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    if section == "block_epoch":
+        return verify_block_epoch_digest(p)
+
+    if section == "epoch":
+        run, cols, just, _wb = _epoch_setup(p)
+        fresh = cols._replace(
+            balance=cols.balance + np.uint64(p.get("salt", 0) + p["repeats"])
+        )
+        return _digest(np.asarray(run(fresh, just)))
+
+    if section == "resident":
+        import __graft_entry__ as graft
+        from eth_consensus_specs_tpu.forks import get_spec
+        from eth_consensus_specs_tpu.ops.state_root import synthetic_static
+        from eth_consensus_specs_tpu.ops.state_root_host import resident_root_acc_host
+
+        n, epochs, repeats = p["n"], p["epochs"], p["repeats"]
+        spec = get_spec("deneb", "mainnet")
+        cols, just = graft._example_altair_inputs(n)
+        static = synthetic_static(spec, n)
+        fresh = cols._replace(
+            balance=cols.balance + np.uint64(p.get("salt", 0) + repeats)
+        )
+        return _digest(resident_root_acc_host(spec, fresh, just, epochs, static))
+
+    if section == "das":
+        run, make_vals = _das_setup(p)
+        return _digest(np.asarray(run(jnp.asarray(make_vals(p["repeats"])))))
+
+    raise SystemExit(f"no verify mode for section {section}")
+
+
+def bench_batch_verify(n_aggregates: int, committee: int = 8, reps: int = 3):
+    """Aggregate-signature batch verification with FRESH messages every
+    timed repeat: hash-to-G2 and G2-prepare run inside the timed region
+    (nothing is served from a message cache).  Pubkeys are fixed across
+    repeats — registry keys repeat every block, so decompression caching
+    is genuine steady-state, and signing happens outside the timed
+    region.  Every timed call must ACCEPT its (valid) batch; a tampered
+    batch must REJECT through the same configured path afterwards, or
+    the child exits nonzero and no number is published."""
     from eth_consensus_specs_tpu.crypto import signature as sig_mod
     from eth_consensus_specs_tpu.ops.bls_batch import batch_verify_aggregates
 
-    items = []
-    sk = 1
-    for i in range(n_aggregates):
-        msg = i.to_bytes(32, "big")
-        group = list(range(sk, sk + committee))
-        sk += committee
-        pks = [sig_mod.sk_to_pk(k) for k in group]
-        sigs = [sig_mod.sign(k, msg) for k in group]
-        items.append((pks, msg, sig_mod.aggregate(sigs)))
+    def msg_for(rep: int, i: int) -> bytes:
+        return hashlib.sha256(b"bench-bls-%d-%d" % (rep, i)).digest()
 
-    if not batch_verify_aggregates(items):  # warm (fills the pk cache)
-        raise RuntimeError("batch verification rejected valid signatures")
+    groups, pks = [], []
+    sk = 1
+    for _ in range(n_aggregates):
+        g = list(range(sk, sk + committee))
+        sk += committee
+        groups.append(g)
+        pks.append([sig_mod.sk_to_pk(k) for k in g])
+
+    def items_for(rep: int):
+        out = []
+        for i, g in enumerate(groups):
+            m = msg_for(rep, i)
+            out.append((pks[i], m, sig_mod.aggregate([sig_mod.sign(k, m) for k in g])))
+        return out
+
+    if not batch_verify_aggregates(items_for(-1)):  # warm: compiles + pk cache
+        raise RuntimeError("batch verification rejected valid signatures (warm)")
     best = float("inf")
-    for _ in range(3):
+    last = None
+    for r in range(reps):
+        last = items_for(r)  # fresh messages — built OUTSIDE the timed region
         t0 = time.perf_counter()
-        ok = batch_verify_aggregates(items)
+        ok = batch_verify_aggregates(last)
         best = min(best, time.perf_counter() - t0)
         if not ok:
             raise RuntimeError("batch verification rejected valid signatures")
-    return n_aggregates / best, best
+    bad = list(last)
+    bad[0] = (bad[0][0], hashlib.sha256(b"tampered").digest(), bad[0][2])
+    if batch_verify_aggregates(bad):
+        raise RuntimeError("batch verification ACCEPTED a tampered batch")
+    return n_aggregates / best, best, last
 
 
-def _run_section(section: str, on_cpu: bool, no_cache: bool = False) -> None:
-    """Child mode: run one device-bench section, print a JSON fragment.
+def _run_bls(on_cpu: bool, no_cache: bool) -> dict:
+    import jax
 
-    The fragment always carries the backend the section ACTUALLY ran on —
-    the parent refuses to label a silently-CPU-executed attempt as an
-    accelerator measurement."""
-    import os
+    from eth_consensus_specs_tpu.native import get_bls_lib
+
+    device_pairing = False
+    device_h2c = False
+    if not on_cpu and not no_cache:
+        # hybrid mode: host C does aggregation/prepare; the RLC
+        # Miller/final-exp batch — and optionally batched hash-to-G2 —
+        # run on the accelerator.  Stages opt in only when a completed
+        # prior run left its compiled chain in the persistent cache
+        # (warm sentinels): a cold compile can exceed the whole budget.
+        from eth_consensus_specs_tpu.utils.cache import warm_sentinel
+
+        backend = jax.default_backend()
+        if os.path.exists(warm_sentinel("pairing", backend)):
+            os.environ["ETH_SPECS_TPU_DEVICE_PAIRING"] = "1"
+            device_pairing = True
+        if os.path.exists(warm_sentinel("h2c", backend)):
+            os.environ["ETH_SPECS_TPU_DEVICE_H2C"] = "1"
+            device_h2c = True
+    n = 64 if get_bls_lib() is not None else 4
+    aggs_per_sec, batch_s, last_items = bench_batch_verify(n_aggregates=n)
+    cross_checked = None
+    if device_pairing or device_h2c:
+        # the device-stage verdicts must agree with the host path on the
+        # SAME inputs: re-verify the last timed batch with device stages
+        # forced OFF — both paths must accept.  The h2c cache still holds
+        # DEVICE-computed points for these messages; clear it so the host
+        # leg genuinely recomputes hash-to-G2 instead of echoing them.
+        from eth_consensus_specs_tpu.ops import bls_batch
+        from eth_consensus_specs_tpu.ops.bls_batch import batch_verify_aggregates
+
+        bls_batch._H2G2_CACHE.clear()
+        os.environ["ETH_SPECS_TPU_NO_DEVICE_PAIRING"] = "1"
+        h2c_was = os.environ.pop("ETH_SPECS_TPU_DEVICE_H2C", None)
+        try:
+            cross_checked = bool(batch_verify_aggregates(last_items))
+        finally:
+            del os.environ["ETH_SPECS_TPU_NO_DEVICE_PAIRING"]
+            if h2c_was is not None:
+                os.environ["ETH_SPECS_TPU_DEVICE_H2C"] = h2c_was
+        if not cross_checked:
+            raise RuntimeError("device and host BLS paths disagree on the same batch")
+    return {
+        "aggs_per_sec": aggs_per_sec,
+        "batch_s": batch_s,
+        "n": n,
+        "fresh_messages": True,
+        "pairing": "device-miller" if device_pairing else "host-native-multi-miller",
+        "h2c": "device" if device_h2c else "host-native",
+        "cross_checked": cross_checked,
+        "verified": True,  # in-band: every timed batch accepted, tamper rejected
+    }
+
+
+# ------------------------------------------------------------ child modes --
+
+
+def _child_main(argv: list[str]) -> None:
+    """Child mode: run one section (or a --verify recompute), print a JSON
+    fragment carrying the backend it ACTUALLY ran on."""
+    section = argv[argv.index("--section") + 1]
+    on_cpu = "--cpu" in argv
+    no_cache = "--nocache" in argv
+    verify = "--verify" in argv
+    params = None
+    if "--params" in argv:
+        params = json.loads(argv[argv.index("--params") + 1])
+    salt = int(argv[argv.index("--salt") + 1]) if "--salt" in argv else 0
 
     if on_cpu:
         # env before the import, config after it: the axon sitecustomize
         # pins jax_platforms programmatically (config beats env)
         os.environ["JAX_PLATFORMS"] = "cpu"
-        # the device pairing's one-time compile dwarfs the CPU budget;
-        # fall back to the native host pairing for the bls section
+        # device-pairing one-time compiles dwarf the CPU budget
         os.environ["ETH_SPECS_TPU_NO_DEVICE_PAIRING"] = "1"
     import jax
 
     if on_cpu:
         jax.config.update("jax_platforms", "cpu")
     elif not no_cache:
-        # --nocache: a corrupt/stale .jax_cache entry must not be able to
-        # hang every accelerator attempt (round-3 failure mode)
+        # a corrupt/stale .jax_cache entry must not hang every
+        # accelerator attempt (round-3 failure mode)
         from eth_consensus_specs_tpu.utils.cache import enable_persistent_cache
 
         enable_persistent_cache()
 
-    # CPU fallback exists to produce *a* real measured number when the
-    # accelerator is gone — scale the work to what XLA:CPU finishes fast
+    if verify:
+        print(json.dumps({"digest": verify_digest(section, params)}))
+        return
+
+    if not on_cpu and jax.default_backend() == "cpu":
+        # an accelerator run was requested but the backend fell back to
+        # CPU — abort BEFORE burning the section budget on full-size
+        # shapes XLA:CPU cannot finish; the parent counts this failure
+        # and reruns with CPU-scaled sizes
+        print(json.dumps({"backend": "cpu", "aborted": True}))
+        return
+
+    if params is None:
+        params = sizes_for(section, on_cpu)
+        # run-unique salt: every bench invocation submits DIFFERENT salted
+        # inputs, so a platform-side (program, input) result cache can
+        # never replay a previous run's output through the verifier
+        params["salt"] = salt
     if section == "tree":
-        depth = 16 if on_cpu else 21
-        hps, tree_s = device_tree_hashes_per_sec(depth=depth)
-        payload = {"hps": hps, "tree_s": tree_s, "depth": depth}
+        payload = run_tree(params)
     elif section == "epoch":
-        n = 1 << 16 if on_cpu else 1_000_000
-        epoch_s = bench_epoch_accounting(n_validators=n)
-        payload = {"epoch_s": epoch_s, "n": n}
+        payload = run_epoch(params)
     elif section == "resident":
-        n = 1 << 16 if on_cpu else 1 << 20
-        epochs = 4 if on_cpu else 8
-        per_epoch_s, total_s = bench_device_resident_epochs(n_validators=n, epochs=epochs)
-        payload = {"per_epoch_s": per_epoch_s, "total_s": total_s, "n": n, "epochs": epochs}
-    elif section == "bls":
-        # one block's worth of attestation aggregates — but without the
-        # native C core every hash-to-curve/Miller step is pure Python, so
-        # scale down to respect the section budget
-        from eth_consensus_specs_tpu.native import get_bls_lib
-
-        device_pairing = False
-        device_h2c = False
-        if not on_cpu and not no_cache:
-            # hybrid mode: host C does aggregation/prepare, the RLC
-            # Miller/final-exp batch — and optionally the batched
-            # hash-to-G2 — run on the accelerator.  Each stage is only
-            # attempted when a prior completed run left its compiled
-            # chain in the persistent cache (sentinels) — a cold compile
-            # can exceed the whole section budget.  --nocache disables
-            # the persistent cache, so a warm start is impossible and
-            # the sentinels must not opt anything in.
-            from eth_consensus_specs_tpu.utils.cache import warm_sentinel
-
-            backend = jax.default_backend()
-            if os.path.exists(warm_sentinel("pairing", backend)):
-                os.environ["ETH_SPECS_TPU_DEVICE_PAIRING"] = "1"
-                device_pairing = True
-            if os.path.exists(warm_sentinel("h2c", backend)):
-                os.environ["ETH_SPECS_TPU_DEVICE_H2C"] = "1"
-                device_h2c = True
-        n = 64 if get_bls_lib() is not None else 4
-        aggs_per_sec, batch_s = bench_batch_verify(n_aggregates=n)
-        payload = {
-            "aggs_per_sec": aggs_per_sec,
-            "batch_s": batch_s,
-            "n": n,
-            "pairing": (
-                "device-miller" if device_pairing else "host-native-multi-miller"
-            ),
-            "h2c": "device" if device_h2c else "host-native",
-        }
+        payload = run_resident(params)
     elif section == "das":
-        batch = 2 if on_cpu else 16
-        n = 1024 if on_cpu else 8192
-        ffts_per_sec, round_s = bench_das_fft(batch=batch, n=n)
-        payload = {"ffts_per_sec": ffts_per_sec, "round_s": round_s, "batch": batch, "n": n}
+        payload = run_das(params)
+    elif section == "block_epoch":
+        payload = run_block_epoch(params)
+    elif section == "bls":
+        payload = _run_bls(on_cpu, no_cache)
     else:
         raise SystemExit(f"unknown section {section}")
     payload["backend"] = jax.default_backend()
+    payload["params"] = params
     print(json.dumps(payload))
 
 
+_RUN_SALT = int.from_bytes(os.urandom(3), "big")
+
+
 def _section_in_subprocess(
-    section: str, on_cpu: bool, timeout_s: int, no_cache: bool = False
+    section: str,
+    on_cpu: bool,
+    timeout_s: int,
+    no_cache: bool = False,
+    verify: bool = False,
+    params: dict | None = None,
 ) -> dict | None:
-    """Run a bench section in its own process with a hard timeout — a hung
-    device tunnel must never prevent the final JSON line."""
+    """Run a bench child with a hard timeout — a hung device tunnel must
+    never prevent the final JSON line."""
     import subprocess
 
-    cmd = [sys.executable, __file__, "--section", section]
+    cmd = [sys.executable, __file__, "--section", section, "--salt", str(_RUN_SALT)]
     if on_cpu:
         cmd.append("--cpu")
     if no_cache:
         cmd.append("--nocache")
+    if verify:
+        cmd.append("--verify")
+    if params is not None:
+        cmd += ["--params", json.dumps(params)]
     try:
         out = subprocess.run(cmd, capture_output=True, text=True, timeout=timeout_s)
     except subprocess.TimeoutExpired:
@@ -364,19 +731,7 @@ def _section_in_subprocess(
         return None
 
 
-# Accelerator attempts get the SAME budget as a full section (the round-3
-# probe gave itself 120s while sections got 480s, and one slow backend
-# boot wrote off the whole round). A bounded number of failed attempts is
-# spread across the run — tree (with the persistent cache), tree again
-# with --nocache (a corrupt cache entry must not doom every attempt), and
-# one mid-run retry — so a tunnel that comes up late is still caught.
-import os as _os
-
-_ACC_TIMEOUT_S = int(_os.environ.get("ETH_SPECS_BENCH_ACC_TIMEOUT", "480"))
-_CPU_TIMEOUT_S = int(_os.environ.get("ETH_SPECS_BENCH_CPU_TIMEOUT", "300"))
-_MAX_ACC_FAILURES = 3
-
-_LKG_PATH = __file__.rsplit("/", 1)[0] + "/BENCH_LKG.json"
+# ------------------------------------------------------------ orchestration --
 
 
 class _AccState:
@@ -387,16 +742,46 @@ class _AccState:
 
     @property
     def dead(self) -> bool:
-        # an early success does NOT exempt later failures from the budget:
-        # a tunnel that dies mid-run must not burn 480s on every remaining
-        # section
+        # an early success does NOT exempt later failures from the
+        # budget: a tunnel that dies mid-run must not burn 480s on every
+        # remaining section
         return self.failures >= _MAX_ACC_FAILURES
 
 
+def _apply_gates(section: str, frag: dict, unit_key: str) -> dict:
+    """Attach implied-traffic and roofline verdicts to an accelerator
+    fragment. unit_key names the per-unit seconds field."""
+    wb = frag.get("work_bytes")
+    unit_s = frag.get(unit_key)
+    if wb and unit_s:
+        implied = wb / unit_s
+        frag["implied_gbps"] = round(implied / 1e9, 1)
+        frag["roofline_ok"] = implied <= ACCEL_ROOFLINE_BYTES_S
+        if not frag["roofline_ok"]:
+            print(
+                f"[bench] section {section}: REFUSED — implied "
+                f"{implied/1e9:.0f} GB/s exceeds the {ACCEL_ROOFLINE_BYTES_S/1e9:.0f} "
+                "GB/s single-chip roofline; the timing cannot reflect real "
+                "execution",
+                file=sys.stderr,
+            )
+    return frag
+
+
+_UNIT_KEY = {
+    "tree": "tree_s",
+    "epoch": "epoch_s",
+    "resident": "per_epoch_s",
+    "das": "round_s",
+    "block_epoch": "epoch_s",
+}
+
+
 def _run_section_auto(section: str, acc: _AccState) -> tuple[dict | None, str]:
-    """Try the accelerator first (subject to the failure budget), fall back
-    to XLA:CPU. Returns (fragment, 'accelerator'|'cpu'|'none')."""
-    attempts: list[bool] = []  # no_cache flags for accelerator attempts
+    """Accelerator first (subject to the failure budget), with digest
+    verification against a CPU-pinned recompute; XLA:CPU fallback.
+    Returns (fragment, 'accelerator'|'cpu'|'none')."""
+    attempts: list[bool] = []
     if not acc.dead:
         attempts.append(False)
         # a corrupt persistent-cache entry must not hang every attempt:
@@ -404,13 +789,52 @@ def _run_section_auto(section: str, acc: _AccState) -> tuple[dict | None, str]:
         if not acc.succeeded and acc.failures == 0:
             attempts.append(True)
     for no_cache in attempts:
-        frag = _section_in_subprocess(section, on_cpu=False, timeout_s=_ACC_TIMEOUT_S, no_cache=no_cache)
+        frag = _section_in_subprocess(
+            section, on_cpu=False, timeout_s=_ACC_TIMEOUT_S, no_cache=no_cache
+        )
         if frag is not None and frag.get("backend") not in (None, "cpu"):
+            # correctness coupling: tree verifies in-child (native sha);
+            # epoch/resident/das against a CPU-pinned recompute of the
+            # same salted inputs
+            if "digest" in frag and "verified" not in frag:
+                exp = _section_in_subprocess(
+                    section,
+                    on_cpu=True,
+                    timeout_s=_VERIFY_TIMEOUT_S,
+                    verify=True,
+                    params=frag.get("params"),
+                )
+                if exp is None:
+                    # the VERIFY recompute itself failed or timed out —
+                    # the device measurement is unusable (unverifiable)
+                    # but this says nothing about the tunnel's health
+                    print(
+                        f"[bench] section {section}: host verify recompute "
+                        "failed/timed out; discarding the (unverifiable) "
+                        "device measurement",
+                        file=sys.stderr,
+                    )
+                    break
+                frag["verified"] = exp.get("digest") == frag["digest"]
+            if not frag.get("verified"):
+                print(
+                    f"[bench] section {section}: REFUSED — device result does "
+                    "not match the host recompute on the same inputs; the "
+                    "device did not do the work being timed",
+                    file=sys.stderr,
+                )
+                acc.failures += 1
+                continue
+            frag = _apply_gates(section, frag, _UNIT_KEY[section])
+            if not frag.get("roofline_ok", True):
+                # a verified result at an impossible rate still means the
+                # TIMING is not a real execution time — never publish it
+                acc.failures += 1
+                continue
             acc.succeeded = True
             acc.backend = frag["backend"]
             return frag, "accelerator"
         if frag is not None:
-            # child ran but silently on CPU — honest but not an accelerator number
             print(
                 f"[bench] section {section}: accelerator attempt executed on "
                 f"backend={frag.get('backend')!r}; treating as fallback",
@@ -420,6 +844,8 @@ def _run_section_auto(section: str, acc: _AccState) -> tuple[dict | None, str]:
         if acc.dead:
             break
     frag = _section_in_subprocess(section, on_cpu=True, timeout_s=_CPU_TIMEOUT_S)
+    if frag is not None and "verified" not in frag:
+        frag["verified"] = "same-backend (CPU lane; coupling applies to accelerator runs)"
     return frag, ("cpu" if frag is not None else "none")
 
 
@@ -432,11 +858,9 @@ def _load_lkg() -> dict | None:
 
 
 def _store_lkg(section_updates: dict) -> None:
-    """Merge accelerator-measured numbers into BENCH_LKG.json so a later
-    fallback run can report the last KNOWN device performance alongside the
-    honestly-labeled live CPU measurement. Provenance is PER SECTION (each
-    entry keeps its own backend + timestamp) — numbers from different runs
-    are never silently presented as one measurement."""
+    """Merge accelerator-measured numbers into BENCH_LKG.json. Only
+    VERIFIED, roofline-sane entries are ever stored; provenance is per
+    section."""
     cur = _load_lkg() or {}
     sections = cur.setdefault("sections", {})
     now = time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
@@ -444,48 +868,51 @@ def _store_lkg(section_updates: dict) -> None:
         entry["measured_utc"] = now
         sections[name] = entry
     cur["note"] = (
-        "last-known-good ACCELERATOR measurements, per section with "
-        "individual provenance; updated automatically by bench.py whenever "
-        "a section executes on an accelerator backend"
+        "last-known-good ACCELERATOR measurements; every entry was "
+        "correctness-coupled (device result == host recompute on the same "
+        "inputs) and within the single-chip roofline when recorded"
     )
     try:
         tmp = _LKG_PATH + ".tmp"
         with open(tmp, "w") as fh:
             json.dump(cur, fh, indent=1, sort_keys=True)
             fh.write("\n")
-        import os
-
         os.replace(tmp, _LKG_PATH)
     except OSError as e:
         print(f"[bench] could not update BENCH_LKG.json: {e}", file=sys.stderr)
 
 
+def _fmt_rate(hps: float) -> str:
+    return f"{hps/1e9:.3f} Ghash/s" if hps >= 1e8 else f"{hps/1e6:.2f} Mhash/s"
+
+
 def main() -> None:
     if "--section" in sys.argv:
-        idx = sys.argv.index("--section")
-        _run_section(
-            sys.argv[idx + 1], on_cpu="--cpu" in sys.argv, no_cache="--nocache" in sys.argv
-        )
+        _child_main(sys.argv)
         return
 
     error = None
     dev_hps = 0.0
     host_hps = host_hashes_per_sec()
+    nat_hps = native_hashes_per_sec()
     print(f"[bench] host hashlib: {host_hps/1e6:.2f} Mhash/s", file=sys.stderr)
+    if nat_hps:
+        print(f"[bench] host native sha core: {nat_hps/1e6:.2f} Mhash/s", file=sys.stderr)
 
     acc = _AccState()
     platforms: dict[str, str] = {}
 
-    # The first accelerator ATTEMPT is the probe — full section budget, on
-    # the real workload, with a --nocache retry (round-3 lesson: two 120s
-    # import probes decided the whole round).
+    # the first accelerator ATTEMPT is the probe — full section budget, on
+    # the real workload, with a --nocache retry
     tree, src = _run_section_auto("tree", acc)
     platforms["tree"] = src
     if tree is not None:
         dev_hps, tree_s = tree["hps"], tree["tree_s"]
         print(
-            f"[bench] device tree (2^{tree['depth']} chunks, {src}): "
-            f"{dev_hps/1e9:.3f} Ghash/s, {tree_s*1e3:.1f} ms/tree",
+            f"[bench] device tree (2^{tree['depth']} chunks, {src}, "
+            f"verified={tree['verified']}): {_fmt_rate(dev_hps)} logical "
+            f"({_fmt_rate(tree['real_hps'])} real full-width), "
+            f"{tree_s*1e3:.1f} ms/tree",
             file=sys.stderr,
         )
     else:
@@ -495,8 +922,8 @@ def main() -> None:
     platforms["epoch"] = src
     if epoch is not None:
         print(
-            f"[bench] fused epoch @{epoch['n']} validators ({src}): "
-            f"{epoch['epoch_s']*1e3:.1f} ms",
+            f"[bench] fused epoch @{epoch['n']} validators ({src}, "
+            f"verified={epoch['verified']}): {epoch['epoch_s']*1e3:.1f} ms",
             file=sys.stderr,
         )
 
@@ -504,46 +931,48 @@ def main() -> None:
     platforms["resident"] = src
     if resident is not None:
         print(
-            f"[bench] device-resident epoch+FULL-state-root @{resident['n']} validators ({src}): "
+            f"[bench] device-resident epoch+FULL-state-root @{resident['n']} "
+            f"validators ({src}, verified={resident['verified']}): "
             f"{resident['per_epoch_s']*1e3:.2f} ms/epoch "
             f"({resident['epochs']} epochs chained: {resident['total_s']*1e3:.1f} ms)",
             file=sys.stderr,
         )
 
-    # The signature workload is HOST work by design (native-C multi-Miller
-    # pairing; the per-item device MSM only pays off on real meshes), so it
-    # is measured once in the CPU-pinned subprocess and labeled host-native
-    # — never attributed to the accelerator.
+    blockep, src = _run_section_auto("block_epoch", acc)
+    platforms["block_epoch"] = src
+    if blockep is not None:
+        print(
+            f"[bench] BLOCK epoch @{blockep['n']} validators x "
+            f"{blockep['atts']} atts/slot w/ per-slot dirty roots ({src}, "
+            f"verified={blockep['verified']}): {blockep['epoch_s']*1e3:.1f} ms/epoch "
+            f"({blockep['slot_ms']:.2f} ms/slot)",
+            file=sys.stderr,
+        )
+
+    # BLS: the host-native path is the production default (native C
+    # multi-Miller pairing); the hybrid device path is attempted when its
+    # compiled chains are warm. Both use fresh messages per timed repeat.
     bls_res = _section_in_subprocess("bls", on_cpu=True, timeout_s=_CPU_TIMEOUT_S)
     platforms["bls"] = "host-native" if bls_res is not None else "none"
-    # Opportunistic hybrid attempt — host C aggregation/hash-to-curve/
-    # prepare with the one RLC Miller/final-exp batch on the accelerator.
-    # Gated on the warm sentinel a previous completed device run leaves
-    # next to the persistent cache, so a cold compile (which can exceed
-    # the section budget) is never attempted blind.
     if not acc.dead:
         import glob as _glob
 
         from eth_consensus_specs_tpu.utils.cache import cache_dir_path
 
-        if _glob.glob(
-            _os.path.join(cache_dir_path(), "device_pairing_warm.*")
-        ) or _glob.glob(_os.path.join(cache_dir_path(), "device_h2c_warm.*")):
-            dev_bls = _section_in_subprocess(
-                "bls", on_cpu=False, timeout_s=_ACC_TIMEOUT_S
-            )
+        if _glob.glob(os.path.join(cache_dir_path(), "device_pairing_warm.*")) or _glob.glob(
+            os.path.join(cache_dir_path(), "device_h2c_warm.*")
+        ):
+            dev_bls = _section_in_subprocess("bls", on_cpu=False, timeout_s=_ACC_TIMEOUT_S)
             used_device_stage = dev_bls is not None and (
-                dev_bls.get("pairing") == "device-miller"
-                or dev_bls.get("h2c") == "device"
+                dev_bls.get("pairing") == "device-miller" or dev_bls.get("h2c") == "device"
             )
             if (
                 dev_bls is not None
                 and dev_bls.get("backend") not in (None, "cpu")
                 and used_device_stage
+                and dev_bls.get("cross_checked")
             ):
-                if dev_bls["aggs_per_sec"] > (
-                    bls_res["aggs_per_sec"] if bls_res else 0.0
-                ):
+                if dev_bls["aggs_per_sec"] > (bls_res["aggs_per_sec"] if bls_res else 0.0):
                     bls_res = dev_bls
                     platforms["bls"] = "accelerator-hybrid"
                 _store_lkg(
@@ -553,17 +982,16 @@ def main() -> None:
                             "pairing": dev_bls.get("pairing"),
                             "h2c": dev_bls.get("h2c"),
                             "backend": dev_bls.get("backend"),
+                            "fresh_messages": True,
+                            "verified": True,
                         }
                     }
                 )
             elif dev_bls is None:
-                # count only a dead/hung subprocess against the budget; a
-                # child that ran but chose host stages (sentinel/backend
-                # mismatch) is not a tunnel failure
                 acc.failures += 1
     if bls_res is not None:
         print(
-            f"[bench] RLC batch verify ({bls_res['n']} aggregates, "
+            f"[bench] RLC batch verify ({bls_res['n']} aggregates, fresh messages, "
             f"{bls_res.get('pairing', 'host-native')}): "
             f"{bls_res['aggs_per_sec']:.1f} aggregates/s "
             f"({bls_res['batch_s']*1e3:.0f} ms/batch, one pairing)",
@@ -574,13 +1002,16 @@ def main() -> None:
     platforms["das"] = src
     if das_res is not None:
         print(
-            f"[bench] DAS field FFT ({das_res['batch']}x{das_res['n']}-point batch, {src}): "
+            f"[bench] DAS field FFT ({das_res['batch']}x{das_res['n']}-point batch, "
+            f"{src}, verified={das_res['verified']}): "
             f"{das_res['ffts_per_sec']:.1f} FFTs/s "
             f"({das_res['round_s']*1e3:.1f} ms/batch-round)",
             file=sys.stderr,
         )
 
-    on_acc = platforms.get("tree") == "accelerator"
+    on_acc = platforms.get("tree") == "accelerator" and bool(tree) and tree.get(
+        "roofline_ok", True
+    )
     if not on_acc and error is None:
         error = (
             "accelerator backend unavailable after "
@@ -597,44 +1028,76 @@ def main() -> None:
         "platform": (acc.backend or "unknown") if on_acc else "cpu-fallback",
         "section_platforms": platforms,
         "method": (
-            "chained-dependency timing: K data-dependent iterations inside one "
-            "jit, wall-clock/K (sustained, not single-dispatch latency)"
+            "chained-dependency timing (K data-dependent iterations in one jit), "
+            "device result REQUIRED to match a host recompute of the same salted "
+            "inputs, accelerator rates gated by a single-chip HBM roofline"
         ),
+        "verification": {
+            name: frag.get("verified")
+            for name, frag in (
+                ("tree", tree),
+                ("epoch", epoch),
+                ("resident", resident),
+                ("block_epoch", blockep),
+                ("bls", bls_res),
+                ("das", das_res),
+            )
+            if frag is not None
+        },
         "secondary": {
             "host_hashlib_hashes_per_sec": round(host_hps, 0),
-            "bls_aggregates_per_sec": (
-                round(bls_res["aggs_per_sec"], 1) if bls_res else None
-            ),
+            "host_native_sha_hashes_per_sec": round(nat_hps, 0) if nat_hps else None,
+            "tree_real_hashes_per_sec": round(tree["real_hps"], 0) if tree else None,
+            "bls_aggregates_per_sec": round(bls_res["aggs_per_sec"], 1) if bls_res else None,
             "resident_epoch_plus_root_ms": (
                 round(resident["per_epoch_s"] * 1e3, 3) if resident else None
             ),
+            "block_epoch_s": round(blockep["epoch_s"], 4) if blockep else None,
             "fused_epoch_ms": round(epoch["epoch_s"] * 1e3, 3) if epoch else None,
             "das_ffts_per_sec": round(das_res["ffts_per_sec"], 1) if das_res else None,
         },
     }
 
-    # Persist accelerator-measured numbers; surface them when falling back.
+    # persist verified, roofline-sane accelerator numbers
     acc_update: dict = {}
-    if platforms.get("tree") == "accelerator" and tree is not None:
+    if platforms.get("tree") == "accelerator" and tree and tree.get("roofline_ok"):
         acc_update["tree"] = {
             "ssz_merkle_tree_hashes_per_sec": round(dev_hps, 0),
+            "real_hashes_per_sec": round(tree["real_hps"], 0),
+            "implied_gbps": tree.get("implied_gbps"),
             "vs_host_hashlib": round(dev_hps / host_hps, 2) if host_hps else None,
             "backend": tree.get("backend"),
+            "verified": True,
         }
-    if platforms.get("epoch") == "accelerator" and epoch is not None:
+    if platforms.get("epoch") == "accelerator" and epoch and epoch.get("roofline_ok"):
         acc_update["epoch"] = {
             "fused_epoch_ms": round(epoch["epoch_s"] * 1e3, 3),
+            "implied_gbps": epoch.get("implied_gbps"),
             "backend": epoch.get("backend"),
+            "verified": True,
         }
-    if platforms.get("resident") == "accelerator" and resident is not None:
+    if platforms.get("resident") == "accelerator" and resident and resident.get("roofline_ok"):
         acc_update["resident"] = {
             "resident_epoch_plus_root_ms": round(resident["per_epoch_s"] * 1e3, 3),
+            "implied_gbps": resident.get("implied_gbps"),
             "backend": resident.get("backend"),
+            "verified": True,
         }
-    if platforms.get("das") == "accelerator" and das_res is not None:
+    if platforms.get("das") == "accelerator" and das_res and das_res.get("roofline_ok"):
         acc_update["das"] = {
             "das_ffts_per_sec": round(das_res["ffts_per_sec"], 1),
+            "implied_gbps": das_res.get("implied_gbps"),
             "backend": das_res.get("backend"),
+            "verified": True,
+        }
+    if platforms.get("block_epoch") == "accelerator" and blockep and blockep.get("roofline_ok"):
+        acc_update["block_epoch"] = {
+            "block_epoch_s": round(blockep["epoch_s"], 4),
+            "n": blockep["n"],
+            "atts_per_slot": blockep["atts"],
+            "implied_gbps": blockep.get("implied_gbps"),
+            "backend": blockep.get("backend"),
+            "verified": True,
         }
     if acc_update:
         _store_lkg(acc_update)
