@@ -169,6 +169,256 @@ def mutate_duplicate_attestations(steps: list[dict], rng: random.Random) -> list
 MUTATIONS = (mutate_reorder_parent_after_child, mutate_duplicate_attestations)
 
 
+# ------------------------------------------------------------- SM links --
+
+
+def enumerate_sm_links(anchor_epoch: int = 0, n_epochs: int = 5, max_links: int = 4):
+    """Super-majority-link sets per the reference constraint model
+    (compliance_runners/fork_choice/model/SM_links.mzn): sources < targets,
+    every source is the anchor or an earlier target, targets strictly
+    increase, no surround votes, and the Gasper-unreachable (1, 2) link is
+    excluded.  Enumeration is restricted to SINGLE-CHAIN-realizable sets —
+    each link's source is the highest justification VISIBLE when its
+    target epoch is being attested (epoch u's justification lands at the
+    end of u for u >= 2 but only at the end of u+1 for u == 1, the
+    weigh_justification genesis guard) — so every yielded set is directly
+    instantiable by filling its target epochs with attestations.  Yields
+    tuples of (source, target) links."""
+    from itertools import combinations
+
+    epochs = range(anchor_epoch + 1, anchor_epoch + n_epochs)
+    for k in range(1, max_links + 1):
+        for targets in combinations(epochs, k):
+            links = []
+            for t in targets:
+                visible = [
+                    u for u in targets if u < t and (u >= 2 or t >= 3)
+                ]
+                src = max(visible) if visible else anchor_epoch
+                links.append((src, t))
+            assert all(s < t for s, t in links)
+            assert (1, 2) not in links  # Gasper-unreachable by construction
+            yield tuple(links)
+
+
+def expected_justification(links, last_epoch: int, anchor_epoch: int = 0):
+    """The abstract finality automaton
+    (specs/phase0/beacon-chain.md weigh_justification_and_finalization)
+    applied to a link pattern whose target epochs reach the 2/3 target
+    supermajority: returns the (justified_epoch, finalized_epoch) a chain
+    realizing the pattern must reach by the end of `last_epoch`."""
+    filled = {t for _, t in links}
+    pj = cj = fin = anchor_epoch
+    bits = [0, 0, 0, 0]
+    for e in range(anchor_epoch, last_epoch + 1):
+        if e <= 1:  # current_epoch <= GENESIS_EPOCH + 1 guard
+            continue
+        old_pj, old_cj = pj, cj
+        pj = cj
+        bits = [0] + bits[:3]
+        if (e - 1) in filled:
+            cj = e - 1
+            bits[1] = 1
+        if e in filled:
+            cj = e
+            bits[0] = 1
+        if all(bits[1:4]) and old_pj + 3 == e:
+            fin = old_pj
+        if all(bits[1:3]) and old_pj + 2 == e:
+            fin = old_pj
+        if all(bits[0:3]) and old_cj + 2 == e:
+            fin = old_cj
+        if all(bits[0:2]) and old_cj + 1 == e:
+            fin = old_cj
+    return cj, fin
+
+
+def instantiate_sm_links(spec, state, links, extra_epochs: int = 1):
+    """Realize a link pattern on one chain: fill each target epoch with
+    full attestations (next_epoch_with_attestations), leave the others
+    empty.  `state` must sit on an epoch boundary; it is advanced in
+    place.  Returns (signed_blocks, last_epoch)."""
+    from eth_consensus_specs_tpu.test_infra.attestations import (
+        next_epoch_with_attestations,
+    )
+    from eth_consensus_specs_tpu.test_infra.state import next_epoch
+
+    targets = {t for _, t in links}
+    last = max(targets) + extra_epochs
+    blocks = []
+    epoch = int(spec.get_current_epoch(state))
+    while epoch <= last:
+        if epoch in targets:
+            # fills the CURRENT epoch's slots with target-epoch == `epoch`
+            # attestations and advances to the next boundary
+            _, bs, _ = next_epoch_with_attestations(
+                spec, state, fill_cur_epoch=True, fill_prev_epoch=False
+            )
+            blocks.extend(bs)
+        else:
+            next_epoch(spec, state)
+        epoch += 1
+    return blocks, last
+
+
+def replay_blocks_into_store(spec, anchor_state, signed_blocks, tick_to_epoch=None):
+    """Deliver blocks in order with slot-accurate ticks; returns the
+    store."""
+    from eth_consensus_specs_tpu.test_infra.fork_choice import (
+        get_genesis_forkchoice_store,
+    )
+
+    store, _anchor = get_genesis_forkchoice_store(spec, anchor_state)
+    for signed in signed_blocks:
+        time = (
+            store.genesis_time
+            + int(signed.message.slot) * spec.config.SECONDS_PER_SLOT
+        )
+        if time > store.time:
+            spec.on_tick(store, time)
+        spec.on_block(store, signed)
+    if tick_to_epoch is not None:
+        time = (
+            store.genesis_time
+            + tick_to_epoch * spec.SLOTS_PER_EPOCH * spec.config.SECONDS_PER_SLOT
+        )
+        if time > store.time:
+            spec.on_tick(store, time)
+    # get_weight reads checkpoint_states[justified]; in production the
+    # entry appears with the first on_attestation for that target — warm
+    # it through the same spec function a block-only replay never calls
+    spec.store_target_checkpoint_state(store, store.justified_checkpoint)
+    return store
+
+
+# ----------------------------------------------------------- block cover --
+
+
+def block_cover_scenarios(spec, genesis_state):
+    """Store states covering the reference block-cover predicate space
+    (compliance_runners/fork_choice/model/Block_cover.mzn): every
+    satisfiable combination of
+
+      store_je_eq_zero            store justified epoch == 0
+      block_vse_eq_store_je       target block's voting source == store JE
+      block_vse_plus_two_ge_curr  the filter_block_tree clock window
+      block_is_leaf               target has no children in the store
+
+    (je == 0 forces vse == je, so 12 of the 16 combinations are
+    satisfiable — the same exclusions the reference's solver finds).
+    Yields dicts {name, blocks, target_root, tick_to_epoch, expect}."""
+    from eth_consensus_specs_tpu.ssz import hash_tree_root
+    from eth_consensus_specs_tpu.test_infra.attestations import (
+        next_epoch_with_attestations,
+    )
+    from eth_consensus_specs_tpu.test_infra.block import (
+        build_empty_block,
+        build_empty_block_for_next_slot,
+        state_transition_and_sign_block,
+    )
+
+    # --- group A: unjustified store (je == 0): a 2-block epoch-0 chain
+    base = genesis_state.copy()
+    a_blocks = []
+    for _ in range(2):
+        block = build_empty_block_for_next_slot(spec, base)
+        a_blocks.append(state_transition_and_sign_block(spec, base, block))
+    inner_root = bytes(hash_tree_root(a_blocks[0].message))
+    leaf_root = bytes(hash_tree_root(a_blocks[1].message))
+    for clock_epoch, near in ((1, True), (5, False)):
+        for root, is_leaf in ((leaf_root, True), (inner_root, False)):
+            yield {
+                "name": f"je0_leaf{is_leaf}_near{near}",
+                "blocks": list(a_blocks),
+                "target_root": root,
+                "tick_to_epoch": clock_epoch,
+                "expect": {
+                    "store_je_eq_zero": True,
+                    "block_vse_eq_store_je": True,
+                    "block_vse_plus_two_ge_curr_e": 0 + 2 >= clock_epoch,
+                    "block_is_leaf": is_leaf,
+                },
+            }
+
+    # --- group B: justified store (je == 2) + a fork stuck on je == 1.
+    # Two consecutive justified epochs on the canonical chain; the fork
+    # branches after epoch 1's fill, so its blocks carry voting source 1
+    # while the store advances to 2 — the only satisfiable shape for
+    # (je != 0, vse != je, vse + 2 >= curr_e): a stale-but-in-window
+    # branch.  Epoch-N blocks only SEE epoch N-1's supermajority from
+    # epoch N+1 states (weigh_justification's genesis guard pins epoch-1
+    # states to 0), so every target is one epoch past its fill.
+    just = genesis_state.copy()
+    b_blocks = []
+    for _ in range(int(spec.SLOTS_PER_EPOCH)):
+        block = build_empty_block_for_next_slot(spec, just)
+        b_blocks.append(state_transition_and_sign_block(spec, just, block))
+    _, filled1, _ = next_epoch_with_attestations(
+        spec, just, fill_cur_epoch=True, fill_prev_epoch=False
+    )
+    b_blocks.extend(filled1)
+    fork_base = just.copy()
+    _, filled2, _ = next_epoch_with_attestations(
+        spec, just, fill_cur_epoch=True, fill_prev_epoch=False
+    )
+    b_blocks.extend(filled2)
+    tail_block = build_empty_block_for_next_slot(spec, just)
+    b_blocks.append(state_transition_and_sign_block(spec, just, tail_block))
+    canon_leaf = bytes(hash_tree_root(b_blocks[-1].message))  # epoch-3 tail
+    canon_inner = bytes(hash_tree_root(filled2[-1].message))  # boundary block
+    # the fork: two unattested epoch-2 blocks from the post-epoch-1 state
+    fork_blocks = []
+    fstate = fork_base.copy()
+    for _ in range(2):
+        block = build_empty_block_for_next_slot(spec, fstate)
+        fork_blocks.append(state_transition_and_sign_block(spec, fstate, block))
+    fork_inner = bytes(hash_tree_root(fork_blocks[0].message))
+    fork_leaf = bytes(hash_tree_root(fork_blocks[1].message))
+
+    all_blocks = b_blocks + fork_blocks
+    for root, is_leaf, on_canon in (
+        (canon_leaf, True, True),
+        (canon_inner, False, True),
+        (fork_leaf, True, False),
+        (fork_inner, False, False),
+    ):
+        vse = 2 if on_canon else 1
+        for clock_epoch, near in ((3, True), (6, False)):
+            yield {
+                "name": f"je2_canon{on_canon}_leaf{is_leaf}_near{near}",
+                "blocks": list(all_blocks),
+                "target_root": root,
+                "tick_to_epoch": clock_epoch,
+                "expect": {
+                    "store_je_eq_zero": False,
+                    "block_vse_eq_store_je": on_canon,
+                    "block_vse_plus_two_ge_curr_e": vse + 2 >= clock_epoch,
+                    "block_is_leaf": is_leaf,
+                },
+            }
+
+
+def evaluate_block_cover_predicates(spec, store, target_root: bytes) -> dict:
+    """The actual predicate values a store realizes for a target block —
+    compared against a scenario's `expect` by the compliance tests."""
+    current_epoch = spec.compute_epoch_at_slot(
+        spec.get_current_slot(store)
+    )
+    vse = int(spec.get_voting_source(store, target_root).epoch)
+    je = int(store.justified_checkpoint.epoch)
+    children = [
+        r
+        for r, b in store.blocks.items()
+        if bytes(b.parent_root) == bytes(target_root)
+    ]
+    return {
+        "store_je_eq_zero": je == 0,
+        "block_vse_eq_store_je": vse == je,
+        "block_vse_plus_two_ge_curr_e": vse + 2 >= int(current_epoch),
+        "block_is_leaf": not children,
+    }
+
+
 def run_scenario(spec, genesis_state, steps: list[dict]) -> dict:
     """Replay a step sequence into a fresh store, asserting the universal
     invariants. Returns {'head': root, 'applied': n, 'rejected': n}."""

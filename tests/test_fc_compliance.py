@@ -72,3 +72,109 @@ def test_forked_tree_head_is_leaf(spec, state):
     blocks = [s["block"].message for s in steps if "block" in s]
     leaf_roots = {bytes(ssz.hash_tree_root(b)) for b in blocks}
     assert result["head"] in leaf_roots
+
+
+# ------------------------------------------------------------- SM links --
+
+
+def test_enumerate_sm_links_constraints():
+    """Every enumerated link set satisfies the reference SM_links.mzn
+    constraints (model/SM_links.mzn): source < target, chainable sources,
+    strictly increasing targets, no surround votes, no (1, 2) link."""
+    from eth_consensus_specs_tpu.gen.compliance import enumerate_sm_links
+
+    seen = set()
+    for links in enumerate_sm_links(n_epochs=5, max_links=4):
+        assert links not in seen
+        seen.add(links)
+        targets = [t for _, t in links]
+        assert targets == sorted(set(targets)), "targets strictly increase"
+        for s, t in links:
+            assert s < t
+            assert s == 0 or s in targets, "source anchors or chains"
+            assert (s, t) != (1, 2)
+        for i, (s1, t1) in enumerate(links):
+            for j, (s2, t2) in enumerate(links):
+                if i != j:
+                    assert not (s1 < s2 and t2 < t1), "surround vote"
+    assert len(seen) == 15  # all non-empty target subsets of {1,2,3,4}
+
+
+def test_expected_justification_automaton():
+    from eth_consensus_specs_tpu.gen.compliance import (
+        enumerate_sm_links,
+        expected_justification,
+    )
+
+    # fill every epoch 1..4 -> justified 4, finalized 3 by end of 5
+    links = [l for l in enumerate_sm_links() if [t for _, t in l] == [1, 2, 3, 4]][0]
+    assert expected_justification(links, 5) == (4, 3)
+    # a lone early justification never finalizes
+    links = [l for l in enumerate_sm_links() if [t for _, t in l] == [2]][0]
+    assert expected_justification(links, 5) == (2, 0)
+
+
+@with_phases(["electra"])
+@spec_state_test
+def test_sm_links_store_reaches_modeled_checkpoints(spec, state):
+    """THE SM-links compliance gate: every single-chain-realizable
+    justification pattern, instantiated with real blocks/attestations and
+    replayed through the store, must land exactly on the justified and
+    finalized epochs the abstract finality automaton predicts
+    (reference: compliance_runners/fork_choice/model/SM_links.mzn +
+    instantiators)."""
+    from eth_consensus_specs_tpu.gen.compliance import (
+        enumerate_sm_links,
+        expected_justification,
+        instantiate_sm_links,
+        replay_blocks_into_store,
+    )
+
+    for links in enumerate_sm_links(n_epochs=4, max_links=3):
+        chain_state = state.copy()
+        blocks, last = instantiate_sm_links(spec, chain_state, links)
+        exp_j, exp_f = expected_justification(links, last)
+        store = replay_blocks_into_store(spec, state, blocks, tick_to_epoch=last + 1)
+        assert int(store.justified_checkpoint.epoch) == exp_j, (
+            f"links={links}: store justified "
+            f"{int(store.justified_checkpoint.epoch)} != modeled {exp_j}"
+        )
+        assert int(store.finalized_checkpoint.epoch) == exp_f, (
+            f"links={links}: store finalized "
+            f"{int(store.finalized_checkpoint.epoch)} != modeled {exp_f}"
+        )
+        # the realized chain itself must agree with the store
+        assert int(chain_state.current_justified_checkpoint.epoch) == exp_j
+        assert int(chain_state.finalized_checkpoint.epoch) == exp_f
+
+
+# ----------------------------------------------------------- block cover --
+
+
+@with_phases(["electra", "fulu"])
+@spec_state_test
+def test_block_cover_predicates_realized(spec, state):
+    """THE block-cover compliance gate: each scenario's store must realize
+    exactly the filter_block_tree predicate combination it was built for
+    (reference: compliance_runners/fork_choice/model/Block_cover.mzn),
+    and get_head must still run clean on the resulting store."""
+    from eth_consensus_specs_tpu.gen.compliance import (
+        block_cover_scenarios,
+        evaluate_block_cover_predicates,
+        replay_blocks_into_store,
+    )
+
+    combos_seen = set()
+    count = 0
+    for sc in block_cover_scenarios(spec, state):
+        store = replay_blocks_into_store(
+            spec, state, sc["blocks"], tick_to_epoch=sc["tick_to_epoch"]
+        )
+        actual = evaluate_block_cover_predicates(spec, store, sc["target_root"])
+        assert actual == sc["expect"], f"{sc['name']}: {actual} != {sc['expect']}"
+        combos_seen.add(tuple(sorted(sc["expect"].items())))
+        head = spec.get_head_root(store)
+        assert head in store.blocks
+        count += 1
+    assert count == 12
+    assert len(combos_seen) == 12, "every satisfiable predicate combo covered once"
