@@ -14,12 +14,19 @@ its tree; pysetup/spec_builders/eip7441.py:12):
   proofs (Fiat-Shamir): prove knowledge of k with k_r_G == k * r_G and
   k_commitment == k * G. Sound and complete; 128-byte serialization.
 
-* Shuffle proofs use a TRANSPARENT backend: the serialized proof reveals
-  the permutation and per-element rerandomization scalars, and the
-  verifier checks post[i] == s_i * pre[perm[i]] componentwise. This is
-  binding (exactly the shuffle relation curdleproofs proves) but NOT
-  zero-knowledge — a production deployment swaps in a curdleproofs-class
-  prover behind the same byte-level interface.
+* Shuffle proofs default to the ZERO-KNOWLEDGE backend
+  (crypto/curdleproofs.py): a first-party curdleproofs-class
+  same-permutation + same-scalar argument — permutation committed before
+  any challenge, grand-product argument binding the committed
+  permutation to Fiat-Shamir weights, generalized-Schnorr linkage to the
+  tracker equations.  The proof reveals nothing beyond validity; the
+  secret-leader-election property Whisk exists for survives the proof.
+
+* A TRANSPARENT backend (proof == serialized permutation + per-element
+  scalars) remains as a TEST-ONLY mode: generation falls back to it only
+  for the legacy per-element-scalar call shape, and verification accepts
+  it only when `ALLOW_TRANSPARENT_SHUFFLE_PROOFS` is set on the spec
+  (tests exercising the legacy byte format flip it explicitly).
 """
 
 from eth_consensus_specs_tpu.crypto.curve import (
@@ -157,10 +164,42 @@ class EIP7441Spec(CapellaSpec):
         c = self._fiat_shamir(bytes(tracker.r_G), bytes(tracker.k_r_G), proof[0:48], proof[48:96])
         return r_G.mul(s) == a1 + k_r_G.mul(c) and g1_generator().mul(s) == a2 + k_C.mul(c)
 
+    # Verification of the legacy transparent byte format is TEST-ONLY
+    # (see module doc); the ZK backend needs no opt-in.
+    ALLOW_TRANSPARENT_SHUFFLE_PROOFS = False
+
+    def _tracker_pairs(self, trackers):
+        return [
+            (g1_from_bytes(bytes(t.r_G)), g1_from_bytes(bytes(t.k_r_G)))
+            for t in trackers
+        ]
+
     def whisk_generate_shuffle_proof(self, pre_shuffle_trackers, permutation, scalars):
-        """Transparent shuffle: post[i] = scalars[i] * pre[permutation[i]];
-        the proof serializes (permutation, scalars)."""
+        """post[i] = scalars[i] * pre[permutation[i]].  With a uniform
+        scalar (the Whisk relation: one secret k per shuffle) the proof is
+        the ZERO-KNOWLEDGE curdleproofs-class argument; distinct
+        per-element scalars fall back to the transparent test-only
+        format."""
         assert len(permutation) == len(scalars) == len(pre_shuffle_trackers)
+        if len(set(int(s) for s in scalars)) == 1:
+            from eth_consensus_specs_tpu.crypto import curdleproofs
+
+            post_pairs, proof = curdleproofs.prove_shuffle(
+                self._tracker_pairs(pre_shuffle_trackers),
+                [int(p) for p in permutation],
+                int(scalars[0]),
+            )
+            post = [
+                self.WhiskTracker(r_G=g1_to_bytes(r), k_r_G=g1_to_bytes(krg))
+                for r, krg in post_pairs
+            ]
+            return post, proof
+        # the transparent format is gated at BOTH ends: generating a proof
+        # the default verifier rejects would be a silent footgun
+        assert self.ALLOW_TRANSPARENT_SHUFFLE_PROOFS, (
+            "per-element scalars produce the transparent TEST-ONLY proof "
+            "format; set ALLOW_TRANSPARENT_SHUFFLE_PROOFS to use it"
+        )
         post = []
         proof = b""
         for i, (p, s) in enumerate(zip(permutation, scalars)):
@@ -178,9 +217,24 @@ class EIP7441Spec(CapellaSpec):
         self, pre_shuffle_trackers, post_shuffle_trackers, shuffle_proof
     ) -> bool:
         """Verify post is a rerandomized permutation of pre
-        (beacon-chain.md:106-121; transparent backend, see module doc)."""
+        (beacon-chain.md:106-121).  ZK proofs (crypto/curdleproofs.py)
+        are the production path; the transparent format verifies only
+        under ALLOW_TRANSPARENT_SHUFFLE_PROOFS."""
+        from eth_consensus_specs_tpu.crypto import curdleproofs
+
         proof = bytes(shuffle_proof)
         n = len(pre_shuffle_trackers)
+        if proof[: len(curdleproofs.MAGIC)] == curdleproofs.MAGIC:
+            if len(post_shuffle_trackers) != n:
+                return False
+            try:
+                pre_pairs = self._tracker_pairs(pre_shuffle_trackers)
+                post_pairs = self._tracker_pairs(post_shuffle_trackers)
+            except (ValueError, AssertionError):
+                return False
+            return curdleproofs.verify_shuffle(pre_pairs, post_pairs, proof)
+        if not self.ALLOW_TRANSPARENT_SHUFFLE_PROOFS:
+            return False
         if len(proof) != n * 40 or len(post_shuffle_trackers) != n:
             return False
         seen = set()

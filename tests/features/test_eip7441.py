@@ -77,20 +77,80 @@ def test_opening_proof_roundtrip():
     assert not spec.IsValidWhiskOpeningProof(tracker, commitment, bytes(tampered))
 
 
-def test_shuffle_proof_roundtrip():
+def test_shuffle_proof_roundtrip_transparent_testonly():
+    """Legacy transparent byte format: verifies only under the explicit
+    test-only opt-in, never by default."""
     spec, state = _spec_state()
     pre = [state.whisk_candidate_trackers[i] for i in range(spec.VALIDATORS_PER_SHUFFLE)]
     perm = list(reversed(range(len(pre))))
-    scalars = [3 + i for i in range(len(pre))]
+    scalars = [3 + i for i in range(len(pre))]  # distinct -> transparent
+    import pytest
+
+    with pytest.raises(AssertionError):
+        # generation is gated too — no silent generate-then-fail roundtrip
+        spec.whisk_generate_shuffle_proof(pre, perm, scalars)
+    spec.ALLOW_TRANSPARENT_SHUFFLE_PROOFS = True
     post, proof = spec.whisk_generate_shuffle_proof(pre, perm, scalars)
+    spec.ALLOW_TRANSPARENT_SHUFFLE_PROOFS = False
+    assert not spec.IsValidWhiskShuffleProof(pre, post, proof), (
+        "transparent proofs must be rejected without the test-only opt-in"
+    )
+    spec.ALLOW_TRANSPARENT_SHUFFLE_PROOFS = True
+    try:
+        assert spec.IsValidWhiskShuffleProof(pre, post, proof)
+        # tampering with a post tracker fails
+        bad_post = [t.copy() for t in post]
+        bad_post[0].r_G = g1_to_bytes(g1_generator())
+        assert not spec.IsValidWhiskShuffleProof(pre, bad_post, proof)
+        # non-permutation (duplicate source) fails
+        dup_proof = proof[:40] + proof[:40] + proof[80:]
+        assert not spec.IsValidWhiskShuffleProof(pre, post, dup_proof)
+    finally:
+        spec.ALLOW_TRANSPARENT_SHUFFLE_PROOFS = False
+
+
+def test_shuffle_proof_roundtrip_zk():
+    """The production ZK backend: a uniform rerandomization scalar (the
+    Whisk relation) yields a curdleproofs-class proof that verifies by
+    default and reveals neither the permutation nor k."""
+    from eth_consensus_specs_tpu.crypto import curdleproofs
+
+    spec, state = _spec_state()
+    pre = [state.whisk_candidate_trackers[i] for i in range(spec.VALIDATORS_PER_SHUFFLE)]
+    perm = [2, 0, 3, 1] if len(pre) == 4 else list(reversed(range(len(pre))))
+    k = 0x5EC12E7
+
+    post, proof = spec.whisk_generate_shuffle_proof(pre, perm, [k] * len(pre))
+    assert proof[:4] == curdleproofs.MAGIC
+    assert len(proof) <= spec.MAX_SHUFFLE_PROOF_SIZE
     assert spec.IsValidWhiskShuffleProof(pre, post, proof)
-    # tampering with a post tracker fails
+
+    # the proof is not the transparent serialization: neither the
+    # permutation indices nor k appear anywhere in the bytes
+    assert int(k).to_bytes(32, "big") not in bytes(proof)
+
+    # tampered post tracker rejected
     bad_post = [t.copy() for t in post]
     bad_post[0].r_G = g1_to_bytes(g1_generator())
     assert not spec.IsValidWhiskShuffleProof(pre, bad_post, proof)
-    # non-permutation (duplicate source) fails
-    dup_proof = proof[:40] + proof[:40] + proof[80:]
-    assert not spec.IsValidWhiskShuffleProof(pre, post, dup_proof)
+
+    # swapped post elements (wrong permutation for this proof) rejected
+    swapped = [t.copy() for t in post]
+    swapped[0], swapped[1] = swapped[1], swapped[0]
+    assert not spec.IsValidWhiskShuffleProof(pre, swapped, proof)
+
+    # any single proof bit flip rejected (spot-check a few offsets)
+    for off in (10, 200, len(proof) - 5):
+        flipped = bytearray(proof)
+        flipped[off] ^= 1
+        assert not spec.IsValidWhiskShuffleProof(pre, post, bytes(flipped))
+
+    # two proofs of the same statement differ (blinders are random) and
+    # both verify — the bytes carry no deterministic witness image
+    post2, proof2 = spec.whisk_generate_shuffle_proof(pre, perm, [k] * len(pre))
+    assert [bytes(t.r_G) for t in post2] == [bytes(t.r_G) for t in post]
+    assert proof != proof2
+    assert spec.IsValidWhiskShuffleProof(pre, post, proof2)
 
 
 def test_whisk_full_block():
