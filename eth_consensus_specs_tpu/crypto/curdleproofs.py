@@ -40,7 +40,7 @@ Protocol sketch (standard components, composed for this relation):
     forces T_i = k R_sigma(i) and U_i = k S_sigma(i) for every i, with
     one shared k.
 
-Proof size is linear: 8 group elements + (3n + 6) scalars ≈ 96n + 600
+Proof size is linear: 8 group elements + (3n + 7) scalars ≈ 96n + 600
 bytes — ~12.5 KB at the mainnet VALIDATORS_PER_SHUFFLE = 124, inside the
 spec's MAX_SHUFFLE_PROOF_SIZE = 2**15 (presets/mainnet/features/
 eip7441.yaml).  The CRS generators are nothing-up-my-sleeve points
@@ -219,11 +219,8 @@ def prove_shuffle(pre_pairs, permutation, k: int):
     gamma = [secrets.randbelow(FR_MOD) for _ in range(n)]
     rho_c = secrets.randbelow(FR_MOD)
     kappa = secrets.randbelow(FR_MOD)
-    r_star = g1_infinity()
-    s_star = g1_infinity()
-    for j in range(n):
-        r_star = r_star + pre_pairs[j][0].mul(xs[j])
-        s_star = s_star + pre_pairs[j][1].mul(xs[j])
+    r_star = _msm([r for r, _ in pre_pairs], xs)
+    s_star = _msm([s for _, s in pre_pairs], xs)
     D_C = _commit(gs, h, gamma, rho_c)
     D_T = _msm([t for t, _ in post_pairs], gamma) + (-r_star.mul(kappa))
     D_U = _msm([u_ for _, u_ in post_pairs], gamma) + (-s_star.mul(kappa))

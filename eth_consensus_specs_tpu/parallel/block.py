@@ -1,0 +1,95 @@
+"""Sharded per-slot BLOCK processing — the dense block plane
+(ops/block_epoch.py) over a device mesh.
+
+Sharding layout: the mutable state plane (balance, participation
+columns) shards over the flattened validator axes like every other
+registry column (parallel/epoch.py); the per-slot block tensors
+(committee indices, aggregation bits, sync bits, deposits) are SMALL —
+~128 x committee u32s per slot — and replicate.
+
+The interesting op is the scatter: a committee's validator indices span
+every shard, so flag/balance scatters are GLOBAL. This module routes
+them through jit + NamedSharding and lets XLA's SPMD partitioner insert
+the communication (index-matched scatter lowering; on real meshes this
+is an all-to-all-sized exchange proportional to the ATTESTING set, not
+the registry). The scalable refinement — bucketing committee indices by
+owning shard so each device scatters only its residents, the same trick
+sharded embedding lookups use — drops in behind this function's
+signature without changing callers.
+
+Bit-exactness vs the unsharded kernel is asserted by
+tests/test_parallel.py and the driver's dryrun_multichip."""
+
+from __future__ import annotations
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from eth_consensus_specs_tpu.ops.block_epoch import (
+    BlockEpochParams,
+    BlockState,
+    process_slot_columnar,
+)
+from eth_consensus_specs_tpu.parallel import DP_AXIS, SP_AXIS
+
+_VALIDATOR_AXES = (DP_AXIS, SP_AXIS)
+
+
+def block_state_specs():
+    """PartitionSpec pytree for BlockState: validator columns sharded,
+    the withdrawal-pointer scalars replicated."""
+    vec = P(_VALIDATOR_AXES)
+    rep = P()
+    return BlockState(
+        balance=vec, cur_part=vec, prev_part=vec, next_wd_index=rep, next_wd_validator=rep
+    )
+
+
+def make_sharded_block_slot_fn(
+    mesh: Mesh,
+    params: BlockEpochParams,
+    n: int,
+    with_withdrawals: bool = True,
+):
+    """Jitted one-slot block step with the state plane sharded over the
+    mesh and block inputs replicated.  Static per-epoch columns
+    (base_reward, effective balances, withdrawal predicates) shard with
+    the state."""
+    st_spec = block_state_specs()
+    vec = NamedSharding(mesh, P(_VALIDATOR_AXES))
+    rep = NamedSharding(mesh, P())
+    to_sh = lambda tree: jax.tree_util.tree_map(
+        lambda s: NamedSharding(mesh, s), tree, is_leaf=lambda x: isinstance(x, P)
+    )
+
+    def step(st, slot_blk, base_reward, eff, wd_epoch, has_cred, epoch, part_r, prop_r):
+        return process_slot_columnar(
+            params,
+            n,
+            st,
+            slot_blk,
+            base_reward,
+            eff,
+            wd_epoch,
+            has_cred,
+            epoch,
+            part_r,
+            prop_r,
+            with_withdrawals=with_withdrawals,
+        )
+
+    return jax.jit(
+        step,
+        in_shardings=(
+            to_sh(st_spec),
+            rep,  # the slot's block tensors (small, replicated)
+            vec,  # base_reward
+            vec,  # effective balances
+            vec,  # withdrawable epochs
+            vec,  # eth1-credential mask
+            rep,
+            rep,
+            rep,
+        ),
+        out_shardings=to_sh(st_spec),
+    )

@@ -132,3 +132,52 @@ def test_sharded_epoch_scatter_add_proposer_rewards_cross_shard():
     res = fn(cols, just)
     ref = epoch_accounting(params, cols, just)
     np.testing.assert_array_equal(np.asarray(res.balance), np.asarray(ref.balance))
+
+
+def test_sharded_block_slot_bit_exact():
+    """One slot of the block plane (attestation scatters, sync rewards,
+    deposits, withdrawal sweep) over the mesh == the unsharded kernel.
+    Committee indices span every shard, so this exercises the global
+    scatter path the SPMD partitioner must communicate for."""
+    import jax.numpy as jnp
+
+    from eth_consensus_specs_tpu.ops import block_epoch as bek
+    from eth_consensus_specs_tpu.parallel.block import make_sharded_block_slot_fn
+
+    mesh = _mesh()
+    spec = get_spec("deneb", "mainnet")
+    n = 64 * N_DEVICES
+    cols, st0, static = bek.synthetic_block_columns(spec, n, seed=5, atts_per_slot=4)
+    params = bek.BlockEpochParams.from_spec(spec)
+    slot_blk = jax.tree_util.tree_map(lambda a: a[0], cols)  # first slot
+
+    fn = make_sharded_block_slot_fn(mesh, params, n)
+    out = fn(
+        st0,
+        slot_blk,
+        static.base_reward,
+        static.eff_balance,
+        static.withdrawable_epoch,
+        static.has_eth1_cred,
+        static.epoch,
+        static.part_reward,
+        static.prop_reward,
+    )
+    ref = bek.process_slot_columnar(
+        params,
+        n,
+        st0,
+        slot_blk,
+        static.base_reward,
+        static.eff_balance,
+        static.withdrawable_epoch,
+        static.has_eth1_cred,
+        static.epoch,
+        static.part_reward,
+        static.prop_reward,
+    )
+    np.testing.assert_array_equal(np.asarray(out.balance), np.asarray(ref.balance))
+    np.testing.assert_array_equal(np.asarray(out.cur_part), np.asarray(ref.cur_part))
+    np.testing.assert_array_equal(np.asarray(out.prev_part), np.asarray(ref.prev_part))
+    assert int(out.next_wd_index) == int(ref.next_wd_index)
+    assert int(out.next_wd_validator) == int(ref.next_wd_validator)
