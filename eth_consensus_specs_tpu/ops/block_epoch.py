@@ -101,6 +101,11 @@ class BlockColumns(NamedTuple):
     att_bits: jnp.ndarray  # bool[S, A, C] aggregation bits
     att_flags: jnp.ndarray  # u8[S, A] participation flag bits conferred
     att_is_current: jnp.ndarray  # bool[S, A] target epoch == current epoch
+    # True on the LAST row of an on-chain attestation: electra aggregates
+    # expand into one row per committee, and the spec divides the
+    # proposer-reward numerator ONCE per attestation — rows accumulate
+    # until the pay boundary (pre-electra: every row pays)
+    att_pay: jnp.ndarray  # bool[S, A]
     proposer: jnp.ndarray  # u32[S]
     sync_idx: jnp.ndarray  # u32[S, SYNC] sync-committee validator indices
     sync_bits: jnp.ndarray  # bool[S, SYNC]
@@ -154,12 +159,14 @@ def sync_rewards(params: BlockEpochParams, total_active):
     return participant_reward, proposer_reward
 
 
-def _apply_attestation(params, n, base_reward, part, balance, proposer, att):
-    """One attestation against one participation column: set newly-earned
-    flags for attesting committee members, pay the proposer.  Committee
-    indices are unique within an attestation, so the scatter is
-    write-once; pad lanes (idx == n) write back their own read."""
-    idx, bits, flags = att
+def _apply_attestation(params, n, base_reward, part, balance, proposer, att, carry_num):
+    """One attestation row against one participation column: set
+    newly-earned flags for attesting committee members, accumulate the
+    proposer-reward numerator, and pay (one floor division per on-chain
+    attestation) at the row group's pay boundary.  Committee indices are
+    unique within a row, so the scatter is write-once; pad lanes
+    (idx == n) add zero."""
+    idx, bits, flags, pay = att
     safe = jnp.minimum(idx, jnp.uint32(n - 1))
     live = (idx < jnp.uint32(n)) & bits & (flags != jnp.uint8(0))
     pre = part[safe]
@@ -173,14 +180,15 @@ def _apply_attestation(params, n, base_reward, part, balance, proposer, att):
         weight_sum = weight_sum + jnp.where(
             (new_bits >> b) & 1, U64(w), U64(0)
         )
-    numerator = jnp.sum(weight_sum * base_reward[safe])
+    carry_num = carry_num + jnp.sum(weight_sum * base_reward[safe])
     denominator = U64(
         (params.weight_denominator - params.proposer_weight)
         * params.weight_denominator
         // params.proposer_weight
     )
-    balance = balance.at[proposer].add(numerator // denominator)
-    return part, balance
+    balance = balance.at[proposer].add(jnp.where(pay, carry_num // denominator, U64(0)))
+    carry_num = jnp.where(pay, U64(0), carry_num)
+    return part, balance, carry_num
 
 
 def _apply_sync(params, st: BlockState, proposer, sync_idx, sync_bits, part_r, prop_r, n):
@@ -269,37 +277,37 @@ def process_slot_columnar(
     """One slot's block against the dense plane, in spec order:
     withdrawals -> (randao/eth1: no dense effect) -> operations
     (attestations, deposits) -> sync aggregate."""
-    (att_idx, att_bits, att_flags, att_is_current, proposer, sync_idx, sync_bits,
-     dep_idx, dep_amt) = slot_blk
+    (att_idx, att_bits, att_flags, att_is_current, att_pay, proposer, sync_idx,
+     sync_bits, dep_idx, dep_amt) = slot_blk
     if with_withdrawals:
         st = _apply_withdrawals(
             params, st, epoch, eff_balance, withdrawable_epoch, has_eth1_cred, n
         )
 
     def att_step(carry, att):
-        cur, prev, bal = carry
-        idx, bits, flags, is_cur = att
+        cur, prev, bal, num = carry
+        idx, bits, flags, is_cur, pay = att
 
         def on_cur(args):
-            cur, prev, bal = args
-            cur, bal = _apply_attestation(
-                params, n, base_reward, cur, bal, proposer, (idx, bits, flags)
+            cur, prev, bal, num = args
+            cur, bal, num = _apply_attestation(
+                params, n, base_reward, cur, bal, proposer, (idx, bits, flags, pay), num
             )
-            return cur, prev, bal
+            return cur, prev, bal, num
 
         def on_prev(args):
-            cur, prev, bal = args
-            prev, bal = _apply_attestation(
-                params, n, base_reward, prev, bal, proposer, (idx, bits, flags)
+            cur, prev, bal, num = args
+            prev, bal, num = _apply_attestation(
+                params, n, base_reward, prev, bal, proposer, (idx, bits, flags, pay), num
             )
-            return cur, prev, bal
+            return cur, prev, bal, num
 
-        return lax.cond(is_cur, on_cur, on_prev, (cur, prev, bal)), None
+        return lax.cond(is_cur, on_cur, on_prev, (cur, prev, bal, num)), None
 
-    (cur, prev, bal), _ = lax.scan(
+    (cur, prev, bal, _num), _ = lax.scan(
         att_step,
-        (st.cur_part, st.prev_part, st.balance),
-        (att_idx, att_bits, att_flags, att_is_current),
+        (st.cur_part, st.prev_part, st.balance, U64(0)),
+        (att_idx, att_bits, att_flags, att_is_current, att_pay),
     )
     st = st._replace(cur_part=cur, prev_part=prev, balance=bal)
     st = _apply_deposits(st, dep_idx, dep_amt, n)
@@ -483,72 +491,135 @@ def extract_block_columns(spec, pre_state, signed_blocks):
     """Harvest an epoch of object blocks into BlockColumns + the initial
     BlockState, replaying the object path for state-dependent context
     (committees, participation-flag indices, proposer/sync membership).
-    Altair..deneb block shapes (electra's committee-bit on-chain
-    aggregates need a different ingest)."""
+    Electra's committee-bit on-chain aggregates (EIP-7549) expand into
+    one ROW per named committee, sharing a proposer-reward numerator up
+    to the aggregate's pay boundary — beacon committees partition a
+    slot's attesters, so the per-committee rows reproduce the spec's
+    union exactly."""
     from eth_consensus_specs_tpu.config import is_post_fork
 
-    assert not is_post_fork(spec.fork_name, "electra"), "electra ingest TBD"
+    post_electra = is_post_fork(spec.fork_name, "electra")
     state = pre_state.copy()
     n = len(state.validators)
     S = len(signed_blocks)
-    A = max((len(b.message.body.attestations) for b in signed_blocks), default=1) or 1
-    C = 1
-    for blk in signed_blocks:
-        for att in blk.message.body.attestations:
-            C = max(C, len(att.aggregation_bits))
+
+    def _rows_of(state_now, att):
+        """[(committee, bits_slice)] — one row per committee."""
+        if not post_electra:
+            committee = spec.get_beacon_committee(state_now, att.data.slot, att.data.index)
+            return [(committee, [bool(b) for b in att.aggregation_bits])]
+        rows = []
+        offset = 0
+        for ci in spec.get_committee_indices(att.committee_bits):
+            committee = spec.get_beacon_committee(state_now, att.data.slot, ci)
+            rows.append(
+                (
+                    committee,
+                    [bool(att.aggregation_bits[offset + i]) for i in range(len(committee))],
+                )
+            )
+            offset += len(committee)
+        return rows or [([], [])]
+
+    if post_electra:
+        # the columnar plane models deneb-shaped deposit/withdrawal
+        # semantics; electra's EIP-7251 queues change both — guard the
+        # parts this ingest does NOT yet cover instead of mis-modeling
+        # them silently (attestation semantics ARE fully covered)
+        assert all(
+            len(b.message.body.deposits) == 0 for b in signed_blocks
+        ), "electra deposits route through pending_deposits — not columnar yet"
+        assert len(getattr(pre_state, "pending_partial_withdrawals", [])) == 0, (
+            "electra pending partial withdrawals not modeled in the sweep"
+        )
+        assert all(
+            bytes(v.withdrawal_credentials)[:1] != b"\x02" for v in pre_state.validators
+        ), "compounding (0x02) credentials not modeled in the sweep"
+
     SY = int(spec.SYNC_COMMITTEE_SIZE) if hasattr(spec, "SYNC_COMMITTEE_SIZE") else 0
-    D = max((len(b.message.body.deposits) for b in signed_blocks), default=0)
-    D = max(D, 1)
-
-    att_idx = np.full((S, A, C), n, np.uint32)
-    att_bits = np.zeros((S, A, C), bool)
-    att_flags = np.zeros((S, A), np.uint8)
-    att_is_current = np.zeros((S, A), bool)
-    proposer = np.zeros(S, np.uint32)
-    sync_idx = np.zeros((S, max(SY, 1)), np.uint32)
-    sync_bits = np.zeros((S, max(SY, 1)), bool)
-    dep_idx = np.full((S, D), n, np.uint32)
-    dep_amt = np.zeros((S, D), np.uint64)
-
     pk_to_index = {bytes(v.pubkey): i for i, v in enumerate(state.validators)}
 
-    for s, signed in enumerate(signed_blocks):
+    # ONE replay pass: buffer ragged per-slot rows, then pad into the
+    # fixed-shape tensors (sizing needs no second pyspec replay)
+    slot_rows: list[list[tuple]] = []  # (committee, bits, flags, is_cur, pay)
+    slot_deps: list[list[tuple[int, int]]] = []
+    proposer_l: list[int] = []
+    sync_rows: list[tuple[list[int], list[bool]]] = []
+    for signed in signed_blocks:
         block = signed.message
         if int(block.slot) > int(state.slot):
             spec.process_slots(state, int(block.slot))
-        proposer[s] = int(block.proposer_index)
+        proposer_l.append(int(block.proposer_index))
         cur_epoch = spec.get_current_epoch(state)
-        for a, att in enumerate(block.body.attestations):
+        rows_here: list[tuple] = []
+        for att in block.body.attestations:
             data = att.data
-            committee = spec.get_beacon_committee(state, data.slot, data.index)
             flag_indices = spec.get_attestation_participation_flag_indices(
                 state, data, int(state.slot) - int(data.slot)
             )
             flags = 0
             for fi in flag_indices:
                 flags |= 1 << fi
-            att_flags[s, a] = flags
-            att_is_current[s, a] = int(data.target.epoch) == int(cur_epoch)
-            for c, v in enumerate(committee):
-                att_idx[s, a, c] = int(v)
-                att_bits[s, a, c] = bool(att.aggregation_bits[c])
+            rows = _rows_of(state, att)
+            is_cur = int(data.target.epoch) == int(cur_epoch)
+            for r, (committee, bits) in enumerate(rows):
+                rows_here.append(
+                    ([int(v) for v in committee], bits, flags, is_cur, r == len(rows) - 1)
+                )
+        slot_rows.append(rows_here)
         if SY:
             agg = block.body.sync_aggregate
-            for c, pk in enumerate(state.current_sync_committee.pubkeys):
-                sync_idx[s, c] = pk_to_index[bytes(pk)]
-                sync_bits[s, c] = bool(agg.sync_committee_bits[c])
-        for d, dep in enumerate(block.body.deposits):
+            sync_rows.append(
+                (
+                    [pk_to_index[bytes(pk)] for pk in state.current_sync_committee.pubkeys],
+                    [bool(b) for b in agg.sync_committee_bits],
+                )
+            )
+        deps_here = []
+        for dep in block.body.deposits:
             idx = pk_to_index.get(bytes(dep.data.pubkey))
             assert idx is not None, "columnar ingest covers existing-key deposits"
-            dep_idx[s, d] = idx
-            dep_amt[s, d] = int(dep.data.amount)
+            deps_here.append((idx, int(dep.data.amount)))
+        slot_deps.append(deps_here)
         spec.process_block(state, block)
+
+    A = max((len(rows) for rows in slot_rows), default=1) or 1
+    C = max(
+        (len(cm) for rows in slot_rows for cm, *_ in rows), default=1
+    ) or 1
+    D = max((len(d) for d in slot_deps), default=0) or 1
+
+    att_idx = np.full((S, A, C), n, np.uint32)
+    att_bits = np.zeros((S, A, C), bool)
+    att_flags = np.zeros((S, A), np.uint8)
+    att_is_current = np.zeros((S, A), bool)
+    att_pay = np.ones((S, A), bool)
+    proposer = np.asarray(proposer_l, np.uint32)
+    sync_idx = np.zeros((S, max(SY, 1)), np.uint32)
+    sync_bits = np.zeros((S, max(SY, 1)), bool)
+    dep_idx = np.full((S, D), n, np.uint32)
+    dep_amt = np.zeros((S, D), np.uint64)
+    for s in range(S):
+        for a, (committee, bits, flags, is_cur, pay) in enumerate(slot_rows[s]):
+            att_flags[s, a] = flags
+            att_is_current[s, a] = is_cur
+            att_pay[s, a] = pay
+            if committee:
+                att_idx[s, a, : len(committee)] = committee
+                att_bits[s, a, : len(bits)] = bits
+        if SY:
+            sync_idx[s] = sync_rows[s][0]
+            sync_bits[s] = sync_rows[s][1]
+        for d, (idx, amt) in enumerate(slot_deps[s]):
+            dep_idx[s, d] = idx
+            dep_amt[s, d] = amt
 
     cols = BlockColumns(
         att_idx=jnp.asarray(att_idx),
         att_bits=jnp.asarray(att_bits),
         att_flags=jnp.asarray(att_flags),
         att_is_current=jnp.asarray(att_is_current),
+        att_pay=jnp.asarray(att_pay),
         proposer=jnp.asarray(proposer),
         sync_idx=jnp.asarray(sync_idx),
         sync_bits=jnp.asarray(sync_bits),
@@ -599,6 +670,14 @@ def synthetic_block_columns(
         att_bits[s] = rng.random((A, C)) < 0.9
     att_flags = np.full((S, A), 0b111, np.uint8)
     att_is_current = rng.random((S, A)) < 0.7
+    # ~1/4 of rows continue into the next row's aggregate (the electra
+    # multi-committee shape), exercising the carried numerator; rows of
+    # one aggregate share their attestation data's target epoch
+    att_pay = rng.random((S, A)) < 0.75
+    att_pay[:, -1] = True
+    for a in range(1, A):
+        cont = ~att_pay[:, a - 1]
+        att_is_current[cont, a] = att_is_current[cont, a - 1]
 
     SY = params.sync_committee_size
     cols = BlockColumns(
@@ -606,6 +685,7 @@ def synthetic_block_columns(
         att_bits=jnp.asarray(att_bits),
         att_flags=jnp.asarray(att_flags),
         att_is_current=jnp.asarray(att_is_current),
+        att_pay=jnp.asarray(att_pay),
         proposer=jnp.asarray(rng.integers(0, n, S, dtype=np.int64).astype(np.uint32)),
         sync_idx=jnp.asarray(rng.integers(0, n, (S, SY), dtype=np.int64).astype(np.uint32)),
         sync_bits=jnp.asarray(rng.random((S, SY)) < 0.95),

@@ -82,6 +82,7 @@ def replay_block_epoch_np(
     b_att_bits = np.asarray(blocks.att_bits)
     b_att_flags = np.asarray(blocks.att_flags)
     b_att_is_current = np.asarray(blocks.att_is_current)
+    b_att_pay = np.asarray(blocks.att_pay)
     b_proposer = np.asarray(blocks.proposer)
     b_sync_idx = np.asarray(blocks.sync_idx)
     b_sync_bits = np.asarray(blocks.sync_bits)
@@ -121,26 +122,31 @@ def replay_block_epoch_np(
                 ) % n
             wd_index += n_taken
 
-        # attestations, in block order
+        # attestations, in block order; the proposer numerator carries
+        # across an aggregate's per-committee rows and divides once at
+        # the pay boundary (electra EIP-7549 shape)
         A = b_att_idx.shape[1]
         proposer = int(b_proposer[s])
+        carry_num = 0
         for a in range(A):
             idx = b_att_idx[s, a]
             bits = b_att_bits[s, a]
             flags = int(b_att_flags[s, a])
-            if flags == 0:
-                continue
-            live = (idx < n) & bits
-            part = cur if bool(b_att_is_current[s, a]) else prev
-            li = idx[live].astype(np.int64)
-            pre = part[li]
-            new_bits = np.uint8(flags) & ~pre
-            part[li] = pre | new_bits
-            weight_sum = np.zeros(li.shape[0], np.uint64)
-            for b, w in enumerate(params.weights):
-                weight_sum += np.where((new_bits >> b) & 1, np.uint64(w), np.uint64(0))
-            numerator = int((weight_sum * base_reward[li]).sum())
-            bal[proposer] += np.uint64(numerator // denom)
+            pay = bool(b_att_pay[s, a])
+            if flags != 0:
+                live = (idx < n) & bits
+                part = cur if bool(b_att_is_current[s, a]) else prev
+                li = idx[live].astype(np.int64)
+                pre = part[li]
+                new_bits = np.uint8(flags) & ~pre
+                part[li] = pre | new_bits
+                weight_sum = np.zeros(li.shape[0], np.uint64)
+                for b, w in enumerate(params.weights):
+                    weight_sum += np.where((new_bits >> b) & 1, np.uint64(w), np.uint64(0))
+                carry_num += int((weight_sum * base_reward[li]).sum())
+            if pay:
+                bal[proposer] += np.uint64(carry_num // denom)
+                carry_num = 0
 
         # deposits (existing-key top-ups)
         didx = b_dep_idx[s]

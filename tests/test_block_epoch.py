@@ -113,6 +113,15 @@ def test_block_epoch_parity_altair(spec, state):
     _run_parity(spec, state, with_withdrawals=False)
 
 
+@with_phases(["electra"])
+@spec_state_test
+def test_block_epoch_parity_electra_onchain_aggregates(spec, state):
+    """EIP-7549 on-chain aggregates: multi-committee attestations expand
+    into per-committee rows with one proposer-reward division per
+    aggregate (the carried-numerator path)."""
+    _run_parity(spec, state, with_withdrawals=True)
+
+
 @with_phases(["deneb"])
 @spec_state_test
 def test_block_epoch_parity_deneb_withdrawals(spec, state):
