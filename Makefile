@@ -2,7 +2,7 @@
 
 PYTHON ?= python
 
-.PHONY: help install test test-fast lint reftests bench multichip serve_docs coverage clean
+.PHONY: help install test test-fast lint reftests bytediff bench multichip serve_docs coverage clean
 
 help:
 	@echo "install    - editable install with test extras"
@@ -11,6 +11,7 @@ help:
 	@echo "test-slow  - only the @slow modules"
 	@echo "lint       - ruff check (if installed)"
 	@echo "reftests   - emit test vectors to ./test_vectors"
+	@echo "bytediff   - conformance byte-diff vs the compiled reference spec"
 	@echo "bench      - run the driver benchmark"
 	@echo "seed-device- one-time device-kernel compile into .jax_cache"
 	@echo "multichip  - 8-virtual-device sharding dry run"
@@ -58,6 +59,14 @@ lint:
 
 reftests:
 	$(PYTHON) -m eth_consensus_specs_tpu.gen -o test_vectors -v
+
+# cross-generator conformance byte-diff (docs/conformance-bytediff.md):
+# emit the agreed slice, replay every vector through the specc-compiled
+# reference markdown, require byte-identical post-states.  The script's
+# exit code IS the gate — no pipeline may mask it.
+bytediff:
+	$(PYTHON) scripts/cross_gen_bytediff.py > BYTEDIFF_RESULT.json; \
+	s=$$?; cat BYTEDIFF_RESULT.json; exit $$s
 
 bench:
 	$(PYTHON) bench.py

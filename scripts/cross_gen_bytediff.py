@@ -1,0 +1,204 @@
+"""Cross-generator byte-diff (round-5 verdict item #6).
+
+Strongest-possible conformance artifact for the agreed slice
+(operations/attestation + sanity/blocks, phase0 + electra, minimal):
+
+MODE A — always available (this environment has no eth2spec install and
+no network): CONSUMER-SIDE REPLAY.  This framework's generator emits the
+vector tree; then every emitted case is re-executed by the REFERENCE'S
+OWN SPEC — the normative markdown under /root/reference/specs compiled
+by specc/ (sha256-pinned against drift) — consuming the vectors exactly
+as a client's reftest runner would: deserialize pre + inputs from the
+.ssz_snappy bytes, run the reference's process_attestation /
+state_transition, and require the serialized post-state to be
+BYTE-IDENTICAL to the emitted post.ssz_snappy payload (invalid cases
+must make the reference spec raise).  A divergence in enumeration,
+serialization, or transition semantics fails the run.
+
+MODE B — literal two-tree diff: where the reference's own pyspec
+package (eth2spec + remerkleable/py_ecc/...) is importable (NOT in this
+image, and installs are forbidden), run the reference's generator for
+the same slice (`python tests/generators/main.py` filtered to the
+slice) and `diff -r` the two emitted trees.  This script only REPORTS
+whether that environment exists — the invocation is a documented manual
+step, not an automatic one.
+
+Usage:  python scripts/cross_gen_bytediff.py [--output DIR]
+Exit 0 = every case byte-identical; nonzero otherwise.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+
+# this is a pure-CPU conformance artifact: the spec's columnar kernels
+# must not dispatch at an experimental accelerator backend (a half-up
+# tunnel turns each jit call into a stall)
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+from eth_consensus_specs_tpu import ssz
+from eth_consensus_specs_tpu.gen.gen_from_tests import discover_test_cases
+from eth_consensus_specs_tpu.gen.gen_runner import run_generator
+from eth_consensus_specs_tpu.gen.snappy_codec import frame_decompress
+from eth_consensus_specs_tpu.specc import compile_fork
+from eth_consensus_specs_tpu.utils import bls
+
+FORKS = ("phase0", "electra")
+SLICE = (("operations", "attestation"), ("sanity", "blocks"))
+
+
+def _read_ssz(case_dir: str, name: str) -> bytes | None:
+    path = os.path.join(case_dir, f"{name}.ssz_snappy")
+    if not os.path.exists(path):
+        return None
+    with open(path, "rb") as f:
+        return frame_decompress(f.read())
+
+
+def _read_meta(case_dir: str) -> dict:
+    path = os.path.join(case_dir, "meta.yaml")
+    if not os.path.exists(path):
+        return {}
+    import yaml
+
+    with open(path) as f:
+        return yaml.safe_load(f) or {}
+
+
+def _replay_case(ref, runner: str, case_dir: str) -> tuple[bool, str]:
+    """Replay one emitted case through the compiled reference spec.
+    Returns (ok, detail).  Only the REFERENCE SPEC'S execution sits in
+    the try: a harness-side failure (yaml, snappy, ssz parse) must be a
+    hard failure, never mistaken for the spec rejecting an invalid
+    case."""
+    pre = _read_ssz(case_dir, "pre")
+    if pre is None:
+        return False, "missing pre"
+    meta = _read_meta(case_dir)
+    # honor the vector's bls_setting (reference formats/README.md): 1 =
+    # signatures are load-bearing for this case, verify them; otherwise
+    # the vectors were emitted without real signatures
+    bls.bls_active = int(meta.get("bls_setting", 0)) == 1
+    state = ssz.deserialize(ref.BeaconState, pre)
+    post = _read_ssz(case_dir, "post")
+    if runner == "operations":
+        att_bytes = _read_ssz(case_dir, "attestation")
+        if att_bytes is None:
+            return False, "missing attestation"
+        attestation = ssz.deserialize(ref.Attestation, att_bytes)
+        steps = [lambda: ref.process_attestation(state, attestation)]
+    else:  # sanity/blocks
+        signed_blocks = []
+        for i in range(int(meta.get("blocks_count", 0))):
+            blk = _read_ssz(case_dir, f"blocks_{i}")
+            if blk is None:
+                return False, f"missing blocks_{i}"
+            signed_blocks.append(ssz.deserialize(ref.SignedBeaconBlock, blk))
+        # the compiled markdown's state_transition mutates in place
+        steps = [
+            (lambda signed=signed: ref.state_transition(state, signed, True))
+            for signed in signed_blocks
+        ]
+    try:
+        for step in steps:
+            step()
+    except Exception as e:  # the reference spec REJECTED the input
+        if post is None:
+            return True, "invalid case rejected by reference spec"
+        return False, f"reference spec raised on a valid case: {e!r:.120}"
+    if post is None:
+        return False, "reference spec ACCEPTED an invalid case"
+    got = ssz.serialize(state)
+    if got != post:
+        return False, "post-state bytes differ"
+    return True, "byte-identical post"
+
+
+def _literal_tree_diff(out_ours: str) -> dict | None:
+    """MODE B availability probe.  The literal diff itself is a MANUAL
+    step on a machine with the reference venv (see module docstring);
+    this only reports whether that environment exists."""
+    try:
+        import eth2spec  # noqa: F401
+    except ImportError:
+        return None
+    return {
+        "note": (
+            "eth2spec importable — MANUAL step: run the reference generator "
+            f"for the slice and `diff -r` its tree against {out_ours}"
+        )
+    }
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--output", default=None, help="vector output dir (default: temp)")
+    args = ap.parse_args()
+    out = args.output or tempfile.mkdtemp(prefix="bytediff_vectors_")
+
+    # signatures: generation and replay must agree on the bls switch; the
+    # compiled reference spec shares this framework's kill-switch
+    bls.bls_active = False
+
+    cases = [
+        c
+        for c in discover_test_cases(presets=("minimal",), forks=FORKS)
+        if (c.runner, c.handler) in SLICE
+    ]
+    print(f"[bytediff] generating {len(cases)} cases -> {out}", file=sys.stderr)
+    stats = run_generator(cases, out)
+    gen_failed = int(stats.get("failed", 0))
+    if gen_failed:
+        # a case that failed to GENERATE must fail the gate — the replay
+        # loop only walks directories that exist
+        print(f"[bytediff] {gen_failed} cases failed to generate", file=sys.stderr)
+
+    refs = {fork: compile_fork(fork, "minimal") for fork in FORKS}
+    total = ok = 0
+    failures: list[str] = []
+    for fork in FORKS:
+        for runner, handler in SLICE:
+            base = os.path.join(out, "minimal", fork, runner, handler)
+            if not os.path.isdir(base):
+                continue
+            for suite in sorted(os.listdir(base)):
+                for case_name in sorted(os.listdir(os.path.join(base, suite))):
+                    case_dir = os.path.join(base, suite, case_name)
+                    total += 1
+                    good, detail = _replay_case(refs[fork], runner, case_dir)
+                    if good:
+                        ok += 1
+                    else:
+                        failures.append(f"{fork}/{runner}/{handler}/{case_name}: {detail}")
+
+    literal = _literal_tree_diff(out)
+    summary = {
+        "mode": "consumer-side replay through the specc-compiled reference markdown",
+        "slice": [f"{r}/{h}" for r, h in SLICE],
+        "forks": list(FORKS),
+        "preset": "minimal",
+        "cases": total,
+        "byte_identical": ok,
+        "generation_failures": gen_failed,
+        "failures": failures[:20],
+        "literal_tree_diff": literal
+        or "unavailable here: eth2spec and its deps are not installed and the "
+        "environment forbids installs; MODE B is a manual step where they exist "
+        "(see script docstring)",
+    }
+    print(json.dumps(summary, indent=1))
+    return 0 if ok == total and total > 0 and gen_failed == 0 else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -152,8 +152,7 @@ def test_attestation_in_block(spec, state):
     next_slots(spec, state, 1)
     attestation = get_valid_attestation(spec, state, slot=int(state.slot), signed=True)
     next_slots(spec, state, spec.MIN_ATTESTATION_INCLUSION_DELAY)
-    block = build_empty_block(spec, state, slot=int(state.slot) + 0)
-    # place the attestation in a block at the current slot
+    yield "pre", state
     block = build_empty_block_for_next_slot(spec, state)
     block.body.attestations.append(attestation)
     signed_block = state_transition_and_sign_block(spec, state, block)
