@@ -295,9 +295,14 @@ class AltairSpec(LightClientMixin, Phase0Spec):
     # == BLS extensions (specs/altair/bls.md) ==============================
 
     def eth_aggregate_pubkeys(self, pubkeys) -> bytes:
-        """Elliptic-curve sum of pubkeys (always real group math — the
-        result lands in state as SyncCommittee.aggregate_pubkey, so it must
-        be deterministic regardless of the bls_active test switch)."""
+        """Elliptic-curve sum of pubkeys — ALWAYS real group math, on both
+        sides of the parity seam.  The aggregate lands in state as
+        SyncCommittee.aggregate_pubkey, and upstream's PUBLISHED vectors
+        (generated with bls on) carry the real sum, so state bytes must
+        not depend on the bls_active test switch; the specc preamble
+        unconditionally binds the compiled reference's AggregatePKs to
+        the same ungated sum (the round-5 conformance byte-diff caught
+        the two sides disagreeing on an 8-epoch electra chain)."""
         assert len(pubkeys) > 0
         from eth_consensus_specs_tpu.crypto.curve import g1_from_bytes, g1_to_bytes
 

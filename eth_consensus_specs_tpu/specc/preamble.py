@@ -18,6 +18,27 @@ from eth_consensus_specs_tpu.ssz.hashing import hash_bytes
 from eth_consensus_specs_tpu.utils import bls
 
 
+class _SpecBLSProxy:
+    """utils.bls with AggregatePKs UNGATED.  The aggregate pubkey lands in
+    state bytes (SyncCommittee.aggregate_pubkey), and upstream's published
+    vectors (generated with bls on) carry the real elliptic-curve sum —
+    state content must not depend on the bls_active test switch, on
+    either side of the parity seam (forks/altair.py
+    eth_aggregate_pubkeys makes the same choice)."""
+
+    def __getattr__(self, name):
+        return getattr(bls, name)
+
+    @staticmethod
+    def AggregatePKs(pubkeys):
+        from eth_consensus_specs_tpu.crypto import signature as _sig
+
+        return _sig.aggregate_pks([bytes(p) for p in pubkeys])
+
+
+_SPEC_BLS = _SpecBLSProxy()
+
+
 def floorlog2(x: int) -> ssz.uint64:
     if x < 1:
         raise ValueError(f"floorlog2 accepts only positive values, x={x}")
@@ -120,7 +141,7 @@ def build_namespace() -> dict:
         "ProgressiveContainer": ssz.ProgressiveContainer,
         "ProgressiveByteList": ssz.ProgressiveByteList,
         # runtime verbs (reference L2 layer)
-        "bls": bls,
+        "bls": _SPEC_BLS,
         "hash": lambda data: ssz.Bytes32(hash_bytes(bytes(data))),
         "hash_tree_root": ssz.hash_tree_root,
         "get_generalized_index": _get_generalized_index,

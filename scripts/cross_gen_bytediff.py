@@ -1,7 +1,9 @@
 """Cross-generator byte-diff (round-5 verdict item #6).
 
-Strongest-possible conformance artifact for the agreed slice
-(operations/attestation + sanity/blocks, phase0 + electra, minimal):
+Strongest-possible conformance artifact for the agreed slice —
+operations/attestation, sanity/blocks, sanity/slots, finality/finality
+and epoch_processing/pending_deposits, over phase0 + electra, minimal
+(the SLICE tuple below is the source of truth):
 
 MODE A — always available (this environment has no eth2spec install and
 no network): CONSUMER-SIDE REPLAY.  This framework's generator emits the
@@ -54,7 +56,13 @@ from eth_consensus_specs_tpu.specc import compile_fork
 from eth_consensus_specs_tpu.utils import bls
 
 FORKS = ("phase0", "electra")
-SLICE = (("operations", "attestation"), ("sanity", "blocks"))
+SLICE = (
+    ("operations", "attestation"),
+    ("sanity", "blocks"),
+    ("sanity", "slots"),
+    ("finality", "finality"),
+    ("epoch_processing", "pending_deposits"),
+)
 
 
 def _read_ssz(case_dir: str, name: str) -> bytes | None:
@@ -75,7 +83,7 @@ def _read_meta(case_dir: str) -> dict:
         return yaml.safe_load(f) or {}
 
 
-def _replay_case(ref, runner: str, case_dir: str) -> tuple[bool, str]:
+def _replay_case(ref, runner: str, case_dir: str, handler: str = "") -> tuple[bool, str]:
     """Replay one emitted case through the compiled reference spec.
     Returns (ok, detail).  Only the REFERENCE SPEC'S execution sits in
     the try: a harness-side failure (yaml, snappy, ssz parse) must be a
@@ -97,7 +105,14 @@ def _replay_case(ref, runner: str, case_dir: str) -> tuple[bool, str]:
             return False, "missing attestation"
         attestation = ssz.deserialize(ref.Attestation, att_bytes)
         steps = [lambda: ref.process_attestation(state, attestation)]
-    else:  # sanity/blocks
+    elif runner == "epoch_processing":
+        # pre is the state immediately before the named sub-transition
+        sub = getattr(ref, f"process_{handler}")
+        steps = [lambda: sub(state)]
+    elif handler == "slots":  # sanity/slots: advance N empty slots
+        target = int(state.slot) + int(meta["slots"])
+        steps = [lambda: ref.process_slots(state, target)]
+    else:  # blocks format (sanity/blocks, finality, random)
         signed_blocks = []
         for i in range(int(meta.get("blocks_count", 0))):
             blk = _read_ssz(case_dir, f"blocks_{i}")
@@ -145,6 +160,10 @@ def main() -> int:
     ap.add_argument("--output", default=None, help="vector output dir (default: temp)")
     args = ap.parse_args()
     out = args.output or tempfile.mkdtemp(prefix="bytediff_vectors_")
+    if os.path.isdir(out) and os.listdir(out):
+        # stale case dirs from a previous run would be replayed and
+        # counted — the gate must only ever see this run's emission
+        raise SystemExit(f"output dir {out!r} is not empty; remove it first")
 
     # signatures: generation and replay must agree on the bls switch; the
     # compiled reference spec shares this framework's kill-switch
@@ -175,7 +194,7 @@ def main() -> int:
                 for case_name in sorted(os.listdir(os.path.join(base, suite))):
                     case_dir = os.path.join(base, suite, case_name)
                     total += 1
-                    good, detail = _replay_case(refs[fork], runner, case_dir)
+                    good, detail = _replay_case(refs[fork], runner, case_dir, handler)
                     if good:
                         ok += 1
                     else:

@@ -24,8 +24,13 @@ def _epoch(spec, state):
 @spec_state_test
 def test_no_finality_at_genesis_epochs(spec, state):
     """The genesis guard blocks justification for the first two epochs."""
+    yield "pre", state
+    blocks = []
     for _ in range(2):
-        next_epoch_with_attestations(spec, state, True, False)
+        _, bs, _ = next_epoch_with_attestations(spec, state, True, False)
+        blocks.extend(bs)
+    yield "blocks", blocks
+    yield "post", state
     assert int(state.current_justified_checkpoint.epoch) == spec.GENESIS_EPOCH
     assert int(state.finalized_checkpoint.epoch) == spec.GENESIS_EPOCH
 
@@ -35,8 +40,13 @@ def test_no_finality_at_genesis_epochs(spec, state):
 def test_finality_rule_4(spec, state):
     """Consecutive current-epoch justification finalizes the older of the
     pair (rule 4): after 4 full epochs, justified=3, finalized=2."""
+    yield "pre", state
+    blocks = []
     for _ in range(4):
-        next_epoch_with_attestations(spec, state, True, False)
+        _, bs, _ = next_epoch_with_attestations(spec, state, True, False)
+        blocks.extend(bs)
+    yield "blocks", blocks
+    yield "post", state
     assert _epoch(spec, state) == 4
     assert int(state.current_justified_checkpoint.epoch) == 3
     assert int(state.finalized_checkpoint.epoch) == 2
@@ -49,10 +59,16 @@ def test_finality_rule_1_previous_epoch_attestations(spec, state):
     """Justification exclusively through previous-epoch attestations lags
     one epoch; finalization follows via rule 1 (prev_justified with bits
     [1..3] set)."""
+    yield "pre", state
+    blocks = []
     for _ in range(2):
-        next_epoch_with_attestations(spec, state, True, False)
+        _, bs, _ = next_epoch_with_attestations(spec, state, True, False)
+        blocks.extend(bs)
     for _ in range(3):
-        next_epoch_with_attestations(spec, state, False, True)
+        _, bs, _ = next_epoch_with_attestations(spec, state, False, True)
+        blocks.extend(bs)
+    yield "blocks", blocks
+    yield "post", state
     assert _epoch(spec, state) == 5
     assert int(state.current_justified_checkpoint.epoch) == 3
     assert int(state.finalized_checkpoint.epoch) == 1
@@ -74,8 +90,13 @@ def test_no_attestations_no_justification(spec, state):
 @spec_state_test
 def test_justification_bits_rotate(spec, state):
     """The 4-bit justification window shifts every epoch."""
+    yield "pre", state
+    blocks = []
     for _ in range(3):
-        next_epoch_with_attestations(spec, state, True, False)
+        _, bs, _ = next_epoch_with_attestations(spec, state, True, False)
+        blocks.extend(bs)
+    yield "blocks", blocks
+    yield "post", state
     assert [int(b) for b in state.justification_bits] == [1, 1, 0, 0]
     next_epoch(spec, state)  # an empty epoch shifts the window
     assert [int(b) for b in state.justification_bits] == [0, 1, 1, 0]
@@ -86,13 +107,19 @@ def test_justification_bits_rotate(spec, state):
 def test_finality_stalls_then_recovers(spec, state):
     """Finality stops during an empty period and resumes once attestations
     return (the liveness half of the FFG story)."""
+    yield "pre", state
+    blocks = []
     for _ in range(4):
-        next_epoch_with_attestations(spec, state, True, False)
+        _, bs, _ = next_epoch_with_attestations(spec, state, True, False)
+        blocks.extend(bs)
     finalized_before = int(state.finalized_checkpoint.epoch)
     assert finalized_before == 2
     for _ in range(2):
-        next_epoch(spec, state)
+        next_epoch(spec, state)  # stall: spanned by the next block's slot jump
     assert int(state.finalized_checkpoint.epoch) == finalized_before
     for _ in range(3):
-        next_epoch_with_attestations(spec, state, True, False)
+        _, bs, _ = next_epoch_with_attestations(spec, state, True, False)
+        blocks.extend(bs)
+    yield "blocks", blocks
+    yield "post", state
     assert int(state.finalized_checkpoint.epoch) > finalized_before

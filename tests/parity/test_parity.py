@@ -416,12 +416,11 @@ def test_fork_upgrade_parity(fork):
     spec, ref = specs(fork)
     state = genesis_state(prev)
     next_epoch(spec_prev, state)
-    # BLS on: under bls-off the reference stores STUB aggregates in sync
-    # committees (utils/bls.py _AggregatePKs alt_return) while this
-    # framework always computes the real aggregate — a deliberate
-    # divergence confined to test-stub mode; conformance vectors are
-    # generated with BLS active, where both sides agree
-    bls.bls_active = True
+    # NOTE: since round 5 BOTH sides compute the real aggregate pubkey
+    # regardless of the bls switch (specc preamble _SpecBLSProxy ungates
+    # AggregatePKs to match forks/altair.py eth_aggregate_pubkeys — state
+    # bytes must not depend on a test switch), so no bls-on workaround is
+    # needed here anymore.
     ours = spec.upgrade_from_parent(state.copy())
     # the compiled module reads the pre-state with the PREVIOUS fork's type
     from eth_consensus_specs_tpu.specc import compile_fork
