@@ -30,8 +30,9 @@ Methodology (round-5: correctness-coupled, roofline-gated):
   traffic of its measured rate; entries exceeding a configured
   single-chip bound (2x v5e-class 819 GB/s) are refused from both the
   headline and BENCH_LKG.json.  Real hash work is reported alongside
-  logical nodes (the fused tree hashes full buffer width every level:
-  depth * 2**(depth-1) compressions per tree, not 2**depth - 1).
+  logical nodes (the hybrid unroll+loop tree executes
+  ops/merkle.tree_real_hashes(depth) compressions, ~1.1x the exact
+  2**depth - 1 at depth 20+).
 * BLS timing uses FRESH messages every timed repeat — all hash-to-G2 and
   G2-prepare work happens inside the timed region (round-4 ADVICE: the
   old loop re-verified cached messages, measuring a cache, not the
@@ -178,8 +179,10 @@ def run_tree(p: dict) -> dict:
     )
     verified = bool(np.array_equal(np.asarray(final), expected))
 
+    from eth_consensus_specs_tpu.ops.merkle import tree_real_hashes
+
     logical = (1 << depth) - 1
-    real = depth * (1 << (depth - 1))  # full-width loop: ops/merkle.py
+    real = tree_real_hashes(depth)  # hybrid unroll+loop: ops/merkle.py
     return {
         "hps": logical / per_tree,
         "real_hps": real / per_tree,
@@ -252,12 +255,11 @@ def run_epoch(p: dict) -> dict:
 
 def _resident_work_bytes(n: int, cols) -> int:
     """Lower-bound device traffic per resident epoch: column reads/writes
-    plus 96 B per REAL hash of the dirty-path state root (full-width tree
-    levels counted as the kernel executes them)."""
+    plus 96 B per REAL hash of the dirty-path state root (tree levels
+    counted as the hybrid unroll+loop kernel executes them)."""
     import jax
 
-    def fullwidth(depth):
-        return depth * (1 << max(depth - 1, 0))
+    from eth_consensus_specs_tpu.ops.merkle import tree_real_hashes as fullwidth
 
     d_val = max(n - 1, 0).bit_length()
     hashes = 3 * n + fullwidth(d_val)  # validator nodes + registry tree
@@ -430,8 +432,7 @@ def run_block_epoch(p: dict) -> dict:
 
     slots = params.slots_per_epoch
 
-    def fullwidth(depth):
-        return depth * (1 << max(depth - 1, 0))
+    from eth_consensus_specs_tpu.ops.merkle import tree_real_hashes as fullwidth
 
     root_hashes = (
         fullwidth((max(n // 4, 1) - 1).bit_length())

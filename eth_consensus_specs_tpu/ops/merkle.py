@@ -3,12 +3,12 @@
 Profiling on v5e (remote chip behind a tunnel) showed per-dispatch latency
 of ~80ms dominating everything else (upload of a 32MB leaf level: 20ms;
 the hashes themselves: ~milliseconds). So the whole binary reduction runs
-as a single jitted call: `lax.fori_loop` over levels carrying a fixed-width
-node buffer. Each iteration compresses the full buffer width even as the
-live level shrinks — ~2x total-work overhead vs the exact tree (sum over
-levels), a few ms at the kernel's ~2.9 Ghash/s, bought for a 35x drop in
-dispatch count. Graph size stays one compression (rounds unrolled, see
-ops/sha256.py) + the loop, so compile time is flat in depth.
+as a single jitted call: the few widest levels unrolled with exact
+shrinking shapes, then a `lax.fori_loop` over the narrow tail carrying a
+fixed-width node buffer (see _unroll_levels — total work ~1.1x the exact
+tree at depth 20, vs ~10x for a pure fixed-width loop), bought for a 35x
+drop in dispatch count. Graph size stays a handful of compressions
+(rounds unrolled on TPU, see ops/sha256.py) + the loop.
 
 Environment note (axon tunnel, measured): device-side allocations DEGRADE
 to ~1.2s/32MB after loop-heavy kernel executions (fresh-process uploads are
@@ -36,6 +36,31 @@ from jax import lax
 from .sha256 import sha256_pair_words
 
 
+def _unroll_levels(depth: int) -> int:
+    """How many TOP (wide) levels to unroll with exact shrinking widths.
+
+    The fixed-width loop costs 2^(d-1) compressions per level regardless
+    of the live width, so at depth 20 a pure loop does ~10x the exact
+    tree's work. Unrolling the k widest levels (each its own compression
+    instance in the graph) brings total work to
+    (2^d - 2^(d-k)) + (d-k)*2^(d-k-1) — 1.09x exact at d=20, k=6 — at
+    the cost of k extra compression bodies (~10s one-time TPU compile
+    each, persistently cached). Shallow trees keep the single-body graph:
+    their absolute overhead is small and graph size stays minimal under
+    big fused outer jits (parallel/resident.py fuses several trees)."""
+    return min(6, max(0, depth - 8))
+
+
+def tree_real_hashes(depth: int) -> int:
+    """Compressions tree_root_words actually executes at `depth` — the
+    honest work count for bench roofline/throughput accounting."""
+    if depth == 0:
+        return 0
+    k = _unroll_levels(depth)
+    unrolled = (1 << depth) - (1 << (depth - k))
+    return unrolled + (depth - k) * (1 << max(depth - k - 1, 0))
+
+
 def tree_root_words(leaves: jnp.ndarray, depth: int) -> jnp.ndarray:
     """Traceable tree reduction: uint32[2**depth, 8] -> uint32[8] root.
 
@@ -44,13 +69,18 @@ def tree_root_words(leaves: jnp.ndarray, depth: int) -> jnp.ndarray:
     then all-gathers the per-device roots)."""
     if depth == 0:
         return leaves[0]
-    w = leaves.shape[0] // 2
+    buf = leaves
+    for _ in range(_unroll_levels(depth)):
+        buf = sha256_pair_words(buf.reshape(buf.shape[0] // 2, 16))
+    rem = depth - _unroll_levels(depth)
+    if rem:
+        w = buf.shape[0] // 2
 
-    def level(_, buf):
-        h = sha256_pair_words(buf.reshape(w, 16))
-        return jnp.concatenate([h, jnp.zeros_like(h)], axis=0)
+        def level(_, b):
+            h = sha256_pair_words(b.reshape(w, 16))
+            return jnp.concatenate([h, jnp.zeros_like(h)], axis=0)
 
-    buf = lax.fori_loop(0, depth, level, leaves)
+        buf = lax.fori_loop(0, rem, level, buf)
     return buf[0]
 
 

@@ -1,0 +1,114 @@
+"""Multi-host scaling: jax.distributed bootstrap + hybrid ICI/DCN meshes.
+
+The reference has no communication backend at all (SURVEY §2.3 — no
+NCCL/MPI/Gloo anywhere; it is a single-process executable spec). Here the
+"backend" is XLA collectives, and multi-host is the same SPMD code the
+single-host meshes run, over a mesh whose axes are laid out so that the
+high-traffic collectives ride ICI (within a host's chips) and only the
+low-traffic ones cross DCN (between hosts):
+
+  * ``dp`` (validator axis) spans HOSTS: the epoch kernel's cross-shard
+    traffic is two psums per epoch — one u64 scalar and one dense
+    O(n_validators) scatter-add (parallel/epoch.py MeshReductions) — a
+    few MB/epoch, comfortably inside DCN budgets.
+  * ``sp`` (chunk/sequence axis) stays WITHIN a host: the sharded merkle
+    tree all-gathers per-device subtree roots every level pair
+    (parallel/merkle.py), the latency-sensitive path that wants ICI.
+
+This is the scaling-book recipe: pick the mesh, put bandwidth-hungry
+axes on ICI, let pjit/shard_map insert the collectives.
+
+Process bootstrap wraps `jax.distributed.initialize`, which speaks the
+same coordinator protocol on TPU pods (host metadata autodetection) and
+CPU/GPU clusters (explicit coordinator + process count, e.g. from a job
+scheduler's env). Single-process callers get a no-op, so every entry
+point in this module is safe to call unconditionally.
+"""
+
+from __future__ import annotations
+
+import os
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+from . import DP_AXIS, SP_AXIS
+
+_initialized = False
+
+
+def initialize_distributed(
+    coordinator_address: str | None = None,
+    num_processes: int | None = None,
+    process_id: int | None = None,
+) -> bool:
+    """Join (or skip joining) the multi-host runtime. Returns True when a
+    multi-process runtime is live after the call.
+
+    Resolution order: explicit args > JAX_COORDINATOR_ADDRESS /
+    JAX_NUM_PROCESSES / JAX_PROCESS_ID env > TPU-pod autodetection
+    (jax.distributed.initialize with no args works on TPU pods) > no-op
+    single process."""
+    global _initialized
+    if _initialized or jax.process_count() > 1:
+        _initialized = True
+        return jax.process_count() > 1
+    coordinator_address = coordinator_address or os.environ.get("JAX_COORDINATOR_ADDRESS")
+    if num_processes is None and "JAX_NUM_PROCESSES" in os.environ:
+        num_processes = int(os.environ["JAX_NUM_PROCESSES"])
+    if process_id is None and "JAX_PROCESS_ID" in os.environ:
+        process_id = int(os.environ["JAX_PROCESS_ID"])
+    if coordinator_address is None and num_processes is None:
+        # no explicit cluster config: on a TPU pod slice, initialize()
+        # autodetects; everywhere else stay single-process
+        if jax.default_backend() in ("tpu", "axon"):
+            try:
+                jax.distributed.initialize()
+                _initialized = True
+            except Exception:
+                return False
+        return jax.process_count() > 1
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes,
+        process_id=process_id,
+    )
+    _initialized = True
+    return jax.process_count() > 1
+
+
+def make_hybrid_mesh(sp_per_host: int | None = None) -> Mesh:
+    """A (dp, sp) mesh laid out host-major: sp varies WITHIN each host's
+    devices (collective-heavy axis on ICI), dp spans hosts (scalar psums
+    cross DCN).
+
+    Single-process fallback degrades to the flat make_mesh layout, so
+    tests and the virtual CPU mesh exercise the same entry point."""
+    devices = jax.devices()
+    n_local = len(jax.local_devices())
+    n_hosts = max(jax.process_count(), 1)
+    if sp_per_host is None:
+        sp_per_host = 2 if n_local % 2 == 0 and n_local >= 2 else 1
+    if n_hosts <= 1:
+        from . import make_mesh
+
+        return make_mesh()
+    # [host, local] grid: host-major ordering keeps each host's devices
+    # contiguous along the trailing (sp) axis
+    dp_per_host = n_local // sp_per_host
+    grid = np.asarray(devices).reshape(n_hosts * dp_per_host, sp_per_host)
+    return Mesh(grid, (DP_AXIS, SP_AXIS))
+
+
+def host_local_slice(mesh: Mesh, n_global: int) -> tuple[int, int]:
+    """[start, stop) of the validator rows this process owns under a
+    dp-sharded array on `mesh` — the addressable block a host feeds or
+    reads without cross-host transfers (jax.Array per-shard semantics)."""
+    n_shards = mesh.shape[DP_AXIS] * mesh.shape[SP_AXIS]
+    per = n_global // n_shards
+    local_ids = {
+        i for i, d in enumerate(mesh.devices.flat) if d.process_index == jax.process_index()
+    }
+    lo, hi = min(local_ids), max(local_ids)
+    return lo * per, (hi + 1) * per

@@ -6,44 +6,56 @@ from __future__ import annotations
 
 
 def get_process_calls(spec):
-    from .forks import is_post_altair
+    """Fork-accurate process_epoch sub-transition sequence (mirrors each
+    fork's process_epoch body: specs/phase0/beacon-chain.md:1724-1846,
+    specs/altair/beacon-chain.md:669-684, specs/capella/beacon-chain.md
+    historical summaries, specs/electra/beacon-chain.md:943,1022 pending
+    queues)."""
+    from .forks import is_post_altair, is_post_capella, is_post_electra, is_post_gloas
 
+    calls = ["process_justification_and_finalization"]
     if is_post_altair(spec):
-        return [
-            "process_justification_and_finalization",
-            "process_inactivity_updates",
-            "process_rewards_and_penalties",
-            "process_registry_updates",
-            "process_slashings",
-            "process_eth1_data_reset",
-            "process_effective_balance_updates",
-            "process_slashings_reset",
-            "process_randao_mixes_reset",
-            "process_historical_roots_update",
-            "process_participation_flag_updates",
-            "process_sync_committee_updates",
-        ]
-    return [
-        "process_justification_and_finalization",
+        calls.append("process_inactivity_updates")
+    calls += [
         "process_rewards_and_penalties",
         "process_registry_updates",
         "process_slashings",
         "process_eth1_data_reset",
+    ]
+    if is_post_electra(spec):
+        calls += ["process_pending_deposits", "process_pending_consolidations"]
+    if is_post_gloas(spec):
+        calls.append("process_builder_pending_payments")
+    calls += [
         "process_effective_balance_updates",
         "process_slashings_reset",
         "process_randao_mixes_reset",
-        "process_historical_roots_update",
-        "process_participation_record_updates",
     ]
+    calls.append(
+        "process_historical_summaries_update"
+        if is_post_capella(spec)
+        else "process_historical_roots_update"
+    )
+    if is_post_altair(spec):
+        calls += [
+            "process_participation_flag_updates",
+            "process_sync_committee_updates",
+        ]
+    else:
+        calls.append("process_participation_record_updates")
+    return calls
 
 
 def run_epoch_processing_to(spec, state, process_name: str):
     """Advance to the final slot of the epoch, then run sub-transitions up
     to (excluding) `process_name`."""
+    calls = get_process_calls(spec)
+    if process_name not in calls:
+        raise ValueError(f"{process_name} is not a {spec.fork_name} epoch sub-transition")
     slot = int(state.slot) + (spec.SLOTS_PER_EPOCH - int(state.slot) % spec.SLOTS_PER_EPOCH)
     if int(state.slot) < slot - 1:
         spec.process_slots(state, slot - 1)
-    for name in get_process_calls(spec):
+    for name in calls:
         if name == process_name:
             break
         getattr(spec, name)(state)

@@ -1,9 +1,11 @@
 """Cross-generator byte-diff (round-5 verdict item #6).
 
-Strongest-possible conformance artifact for the agreed slice —
-operations/attestation, sanity/blocks, sanity/slots, finality/finality
-and epoch_processing/pending_deposits, over phase0 + electra, minimal
-(the SLICE tuple below is the source of truth):
+Strongest-possible conformance artifact for the agreed slice — every
+operations handler with a direct reference sub-transition
+(attestation, sync_aggregate, bls_to_execution_change, withdrawals),
+sanity/blocks, sanity/slots, finality/finality, random/random and
+epoch_processing/pending_deposits, over phase0 + altair + capella +
+electra, minimal (the SLICE tuple below is the source of truth):
 
 MODE A — always available (this environment has no eth2spec install and
 no network): CONSUMER-SIDE REPLAY.  This framework's generator emits the
@@ -55,14 +57,38 @@ from eth_consensus_specs_tpu.gen.snappy_codec import frame_decompress
 from eth_consensus_specs_tpu.specc import compile_fork
 from eth_consensus_specs_tpu.utils import bls
 
-FORKS = ("phase0", "electra")
+FORKS = ("phase0", "altair", "capella", "electra")
 SLICE = (
     ("operations", "attestation"),
+    ("operations", "sync_aggregate"),
+    ("operations", "bls_to_execution_change"),
+    ("operations", "withdrawals"),
     ("sanity", "blocks"),
     ("sanity", "slots"),
     ("finality", "finality"),
-    ("epoch_processing", "pending_deposits"),
+    ("random", "random"),
+    # every epoch_processing handler the test corpus emits: the replay
+    # dispatches process_<handler> generically
+    ("epoch_processing", "*"),
 )
+
+
+def _in_slice(runner: str, handler: str) -> bool:
+    return (runner, handler) in SLICE or (runner, "*") in SLICE
+
+# operations handler -> (input .ssz_snappy name, SSZ type attr on the
+# compiled spec, sub-transition attr).  Names follow the reference's
+# vector format (tests/formats/operations/README.md there).
+OP_TABLE = {
+    "attestation": ("attestation", "Attestation", "process_attestation"),
+    "sync_aggregate": ("sync_aggregate", "SyncAggregate", "process_sync_aggregate"),
+    "bls_to_execution_change": (
+        "address_change",
+        "SignedBLSToExecutionChange",
+        "process_bls_to_execution_change",
+    ),
+    "withdrawals": ("execution_payload", "ExecutionPayload", "process_withdrawals"),
+}
 
 
 def _read_ssz(case_dir: str, name: str) -> bytes | None:
@@ -100,11 +126,13 @@ def _replay_case(ref, runner: str, case_dir: str, handler: str = "") -> tuple[bo
     state = ssz.deserialize(ref.BeaconState, pre)
     post = _read_ssz(case_dir, "post")
     if runner == "operations":
-        att_bytes = _read_ssz(case_dir, "attestation")
-        if att_bytes is None:
-            return False, "missing attestation"
-        attestation = ssz.deserialize(ref.Attestation, att_bytes)
-        steps = [lambda: ref.process_attestation(state, attestation)]
+        input_name, type_attr, fn_attr = OP_TABLE[handler]
+        op_bytes = _read_ssz(case_dir, input_name)
+        if op_bytes is None:
+            return False, f"missing {input_name}"
+        operation = ssz.deserialize(getattr(ref, type_attr), op_bytes)
+        sub = getattr(ref, fn_attr)
+        steps = [lambda: sub(state, operation)]
     elif runner == "epoch_processing":
         # pre is the state immediately before the named sub-transition
         sub = getattr(ref, f"process_{handler}")
@@ -172,7 +200,7 @@ def main() -> int:
     cases = [
         c
         for c in discover_test_cases(presets=("minimal",), forks=FORKS)
-        if (c.runner, c.handler) in SLICE
+        if _in_slice(c.runner, c.handler)
     ]
     print(f"[bytediff] generating {len(cases)} cases -> {out}", file=sys.stderr)
     stats = run_generator(cases, out)
@@ -186,10 +214,20 @@ def main() -> int:
     total = ok = 0
     failures: list[str] = []
     for fork in FORKS:
-        for runner, handler in SLICE:
-            base = os.path.join(out, "minimal", fork, runner, handler)
-            if not os.path.isdir(base):
-                continue
+        fork_dir = os.path.join(out, "minimal", fork)
+        if not os.path.isdir(fork_dir):
+            continue
+        emitted = [
+            (runner, handler)
+            for runner in sorted(os.listdir(fork_dir))
+            for handler in sorted(os.listdir(os.path.join(fork_dir, runner)))
+        ]
+        for runner, handler in emitted:
+            if not _in_slice(runner, handler):
+                raise SystemExit(
+                    f"emitted {runner}/{handler} is outside the declared slice"
+                )
+            base = os.path.join(fork_dir, runner, handler)
             for suite in sorted(os.listdir(base)):
                 for case_name in sorted(os.listdir(os.path.join(base, suite))):
                     case_dir = os.path.join(base, suite, case_name)

@@ -4,6 +4,7 @@ sync-committee rotation (reference analogue: test/altair/epoch_processing/*)."""
 from eth_consensus_specs_tpu.ssz import hash_tree_root
 from eth_consensus_specs_tpu.test_infra.attestations import next_epoch_with_attestations
 from eth_consensus_specs_tpu.test_infra.context import spec_state_test, with_phases
+from eth_consensus_specs_tpu.test_infra.manifest import manifest
 from eth_consensus_specs_tpu.test_infra.epoch_processing import (
     run_epoch_processing_to,
     run_epoch_processing_with,
@@ -11,6 +12,7 @@ from eth_consensus_specs_tpu.test_infra.epoch_processing import (
 from eth_consensus_specs_tpu.test_infra.state import next_epoch
 
 
+@manifest(handler="inactivity_updates")
 @with_phases(["altair"])
 @spec_state_test
 def test_inactivity_scores_increase_when_absent(spec, state):
@@ -58,6 +60,7 @@ def test_participation_flag_rotation(spec, state):
     assert all(int(f) == 0 for f in state.current_epoch_participation)
 
 
+@manifest(handler="sync_committee_updates")
 @with_phases(["altair"])
 @spec_state_test
 def test_sync_committee_rotation_at_period_boundary(spec, state):
@@ -71,6 +74,7 @@ def test_sync_committee_rotation_at_period_boundary(spec, state):
     assert hash_tree_root(state.current_sync_committee) == hash_tree_root(old_next)
 
 
+@manifest(handler="sync_committee_updates")
 @with_phases(["altair"])
 @spec_state_test
 def test_sync_committee_no_rotation_mid_period(spec, state):

@@ -1,0 +1,226 @@
+"""Per-sub-transition epoch-processing vectors, full fork matrix.
+
+Each test pins its vector coordinates to epoch_processing/<handler> via
+@manifest, so the generator emits the reference's epoch_processing runner
+taxonomy (reference analogue: one module per sub-transition under
+tests/core/pyspec/eth2spec/test/*/epoch_processing/ and generator
+tests/generators/runners/epoch_processing.py; format
+tests/formats/epoch_processing/README.md: pre.ssz_snappy is the state
+immediately before the named sub-transition, post.ssz_snappy immediately
+after).  Dual-mode: plain assertions under pytest, vector parts in
+generator mode — the cross-generator byte-diff gate replays every case
+through the specc-compiled reference markdown.
+"""
+
+from eth_consensus_specs_tpu.test_infra.context import spec_state_test, with_phases
+from eth_consensus_specs_tpu.test_infra.epoch_processing import (
+    run_epoch_processing_with,
+)
+from eth_consensus_specs_tpu.test_infra.manifest import manifest
+from eth_consensus_specs_tpu.test_infra.state import next_epoch
+from eth_consensus_specs_tpu.test_infra.template import instantiate
+from eth_consensus_specs_tpu.utils import bls
+
+MAINLINE = ["phase0", "altair", "bellatrix", "capella", "deneb", "electra", "fulu", "gloas"]
+POST_ALTAIR = MAINLINE[1:]
+PRE_CAPELLA = MAINLINE[:3]
+POST_CAPELLA = MAINLINE[3:]
+POST_ELECTRA = MAINLINE[5:]
+PHASE0 = MAINLINE[:1]
+
+
+# ----------------------------------------------------------- state preps --
+
+
+def _prep_noop(spec, state):
+    pass
+
+
+def _prep_inactivity_scores(spec, state):
+    for i in range(min(4, len(state.inactivity_scores))):
+        state.inactivity_scores[i] = 7 + i
+
+
+def _prep_registry_mixed(spec, state):
+    # one fresh depositor entering the activation pipeline...
+    v = state.validators[1]
+    v.activation_eligibility_epoch = spec.FAR_FUTURE_EPOCH
+    v.activation_epoch = spec.FAR_FUTURE_EPOCH
+    # ...and one validator at the ejection threshold
+    state.validators[2].effective_balance = spec.config.EJECTION_BALANCE
+
+
+def _prep_slashed_at_halfway(spec, state):
+    # withdrawable at current + vector/2 puts the correlation window's
+    # midpoint on this epoch — the proportional-penalty sweep is live
+    epoch = int(spec.get_current_epoch(state))
+    v = state.validators[3]
+    v.slashed = True
+    v.withdrawable_epoch = epoch + spec.EPOCHS_PER_SLASHINGS_VECTOR // 2
+    total = int(v.effective_balance)
+    state.slashings[epoch % spec.EPOCHS_PER_SLASHINGS_VECTOR] = total
+
+
+def _prep_eth1_boundary(spec, state):
+    # advance so the NEXT epoch is a voting-period boundary, with a vote
+    # pending in the window that reset will clear
+    period = int(spec.EPOCHS_PER_ETH1_VOTING_PERIOD)
+    while (int(spec.get_current_epoch(state)) + 1) % period != 0:
+        next_epoch(spec, state)
+    state.eth1_data_votes.append(state.eth1_data)
+
+
+def _prep_pending_deposit(spec, state):
+    v = state.validators[0]
+    state.pending_deposits.append(
+        spec.PendingDeposit(
+            pubkey=v.pubkey,
+            withdrawal_credentials=v.withdrawal_credentials,
+            amount=spec.EFFECTIVE_BALANCE_INCREMENT,
+            signature=bls.G2_POINT_AT_INFINITY,
+            slot=spec.GENESIS_SLOT,
+        )
+    )
+
+
+def _prep_pending_consolidation(spec, state):
+    # source already withdrawable -> the consolidation applies this epoch
+    epoch = int(spec.get_current_epoch(state))
+    src = state.validators[4]
+    src.exit_epoch = max(epoch - 1, 0)
+    src.withdrawable_epoch = epoch
+    state.pending_consolidations.append(
+        spec.PendingConsolidation(source_index=4, target_index=5)
+    )
+
+
+def _prep_balance_drift(spec, state):
+    # push balances across the hysteresis bands in both directions
+    inc = int(spec.EFFECTIVE_BALANCE_INCREMENT)
+    state.balances[0] = int(state.balances[0]) + 2 * inc
+    state.balances[1] = max(int(state.balances[1]) - 2 * inc, 0)
+    state.balances[2] = int(state.balances[2]) + inc // 2  # inside the band
+
+
+def _prep_nonzero_slashings(spec, state):
+    state.slashings[0] = spec.EFFECTIVE_BALANCE_INCREMENT
+
+
+def _prep_historical_boundary(spec, state):
+    period = int(spec.SLOTS_PER_HISTORICAL_ROOT) // int(spec.SLOTS_PER_EPOCH)
+    while (int(spec.get_current_epoch(state)) + 1) % period != 0:
+        next_epoch(spec, state)
+
+
+def _prep_sync_period_boundary(spec, state):
+    period = int(spec.EPOCHS_PER_SYNC_COMMITTEE_PERIOD)
+    while (int(spec.get_current_epoch(state)) + 1) % period != 0:
+        next_epoch(spec, state)
+
+
+def _prep_participation_flags(spec, state):
+    for i in range(min(8, len(state.previous_epoch_participation))):
+        state.previous_epoch_participation[i] = 0b111
+        state.current_epoch_participation[i] = 0b101
+
+
+def _prep_pending_attestation(spec, state):
+    # a minimal pending record from the previous epoch for the reset to drop
+    data = spec.AttestationData(
+        slot=state.slot,
+        index=0,
+        beacon_block_root=spec.get_block_root_at_slot(state, int(state.slot) - 1)
+        if int(state.slot) > 0
+        else state.latest_block_header.parent_root,
+        source=state.current_justified_checkpoint,
+        target=spec.Checkpoint(
+            epoch=spec.get_current_epoch(state),
+            root=spec.get_block_root(state, spec.get_current_epoch(state))
+            if int(state.slot) >= spec.SLOTS_PER_EPOCH
+            else state.latest_block_header.parent_root,
+        ),
+    )
+    committee = spec.get_beacon_committee(state, data.slot, 0)
+    state.current_epoch_attestations.append(
+        spec.PendingAttestation(
+            aggregation_bits=[True] * len(committee),
+            data=data,
+            inclusion_delay=1,
+            proposer_index=0,
+        )
+    )
+
+
+# ------------------------------------------------------------- the matrix --
+
+# handler -> (fork list, {variant: prep})
+MATRIX = {
+    "justification_and_finalization": (MAINLINE, {"genesis_epoch": _prep_noop}),
+    "inactivity_updates": (
+        POST_ALTAIR,
+        {"basic": _prep_noop, "nonzero_scores": _prep_inactivity_scores},
+    ),
+    "rewards_and_penalties": (MAINLINE, {"genesis_no_attestations": _prep_noop}),
+    "registry_updates": (
+        MAINLINE,
+        {"basic": _prep_noop, "activation_and_ejection": _prep_registry_mixed},
+    ),
+    "slashings": (
+        MAINLINE,
+        {"basic": _prep_noop, "slashed_at_halfway_window": _prep_slashed_at_halfway},
+    ),
+    "eth1_data_reset": (
+        MAINLINE,
+        {"basic": _prep_noop, "at_period_boundary": _prep_eth1_boundary},
+    ),
+    "pending_deposits": (
+        POST_ELECTRA,
+        {"basic": _prep_noop, "queued_deposit": _prep_pending_deposit},
+    ),
+    "pending_consolidations": (
+        POST_ELECTRA,
+        {"basic": _prep_noop, "queued_consolidation": _prep_pending_consolidation},
+    ),
+    "effective_balance_updates": (
+        MAINLINE,
+        {"basic": _prep_noop, "hysteresis_drift": _prep_balance_drift},
+    ),
+    "slashings_reset": (MAINLINE, {"nonzero_entry": _prep_nonzero_slashings}),
+    "randao_mixes_reset": (MAINLINE, {"basic": _prep_noop}),
+    "historical_roots_update": (
+        PRE_CAPELLA,
+        {"basic": _prep_noop, "at_accumulator_boundary": _prep_historical_boundary},
+    ),
+    "historical_summaries_update": (
+        POST_CAPELLA,
+        {"basic": _prep_noop, "at_accumulator_boundary": _prep_historical_boundary},
+    ),
+    "participation_record_updates": (
+        PHASE0,
+        {"basic": _prep_noop, "with_pending_attestation": _prep_pending_attestation},
+    ),
+    "participation_flag_updates": (
+        POST_ALTAIR,
+        {"basic": _prep_noop, "flags_rotate": _prep_participation_flags},
+    ),
+    "sync_committee_updates": (
+        POST_ALTAIR,
+        {"basic": _prep_noop, "at_period_boundary": _prep_sync_period_boundary},
+    ),
+}
+
+
+def _case(handler, variant, phases, prep):
+    @manifest(runner="epoch_processing", handler=handler)
+    @with_phases(phases)
+    @spec_state_test
+    def the_test(spec, state):
+        prep(spec, state)
+        yield from run_epoch_processing_with(spec, state, f"process_{handler}")
+
+    return the_test, f"test_{handler}_{variant}"
+
+
+for _handler, (_phases, _variants) in MATRIX.items():
+    for _variant, _prep in _variants.items():
+        instantiate(_case, _handler, _variant, _phases, _prep)

@@ -30,8 +30,11 @@ from eth_consensus_specs_tpu.test_infra.voluntary_exits import prepare_signed_ex
 
 
 def _random_chain(spec, state, rng, n_slots: int):
-    """Drive `n_slots` of randomized activity; returns applied block roots."""
+    """Drive `n_slots` of randomized activity; returns (applied block
+    roots, signed blocks) — the blocks double as sanity-format vector
+    parts."""
     roots = []
+    blocks = []
     slashed_attester = False
     slashed_proposer = False
     exited = False
@@ -93,8 +96,9 @@ def _random_chain(spec, state, rng, n_slots: int):
             )
             exited = True
         signed = state_transition_and_sign_block(spec, state, block)
+        blocks.append(signed)
         roots.append(bytes(hash_tree_root(signed.message)))
-    return roots
+    return roots, blocks
 
 
 @with_all_phases
@@ -102,8 +106,8 @@ def _random_chain(spec, state, rng, n_slots: int):
 def test_random_chain_deterministic(spec, state):
     """The same seed yields the same chain and the same final state root."""
     state2 = state.copy()
-    roots1 = _random_chain(spec, state, random.Random(1234), 12)
-    roots2 = _random_chain(spec, state2, random.Random(1234), 12)
+    roots1, _ = _random_chain(spec, state, random.Random(1234), 12)
+    roots2, _ = _random_chain(spec, state2, random.Random(1234), 12)
     assert roots1 == roots2
     assert hash_tree_root(state) == hash_tree_root(state2)
 
@@ -115,7 +119,10 @@ def test_random_chain_across_epochs(spec, state):
     state: balances within bounds, slashed validators exited, header chain
     linked."""
     rng = random.Random(99)
-    _random_chain(spec, state, rng, 2 * spec.SLOTS_PER_EPOCH + 3)
+    yield "pre", state
+    _, blocks = _random_chain(spec, state, rng, 2 * spec.SLOTS_PER_EPOCH + 3)
+    yield "blocks", blocks
+    yield "post", state
     assert int(state.slot) >= 2 * spec.SLOTS_PER_EPOCH
     for index, validator in enumerate(state.validators):
         if validator.slashed:
@@ -128,6 +135,9 @@ def test_random_chain_across_epochs(spec, state):
 @spec_state_test
 def test_random_blocks_differ_across_seeds(spec, state):
     state2 = state.copy()
-    _random_chain(spec, state, random.Random(5), 8)
+    yield "pre", state
+    _, blocks = _random_chain(spec, state, random.Random(5), 8)
+    yield "blocks", blocks
+    yield "post", state
     _random_chain(spec, state2, random.Random(6), 8)
     assert hash_tree_root(state) != hash_tree_root(state2)
