@@ -50,11 +50,12 @@ import time
 
 import numpy as np
 
-# Single-chip HBM roofline gate, bytes/s. The axon accelerator is
-# v5e-class (~819 GB/s); a measured rate implying more than 2x that
-# sustained traffic cannot be a real execution. XLA:CPU numbers are far
-# below any such bound; the gate applies to accelerator-labeled runs.
-ACCEL_ROOFLINE_BYTES_S = 1.64e12
+# Gate logic (roofline verdicts, result digests) is framework
+# infrastructure now — obs/gates.py is the single implementation this
+# driver, the obs span registry, the watchdog, and the tests all share.
+from eth_consensus_specs_tpu.obs import gates
+
+ACCEL_ROOFLINE_BYTES_S = gates.ACCEL_ROOFLINE_BYTES_S
 
 _LKG_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)), "BENCH_LKG.json")
 
@@ -64,8 +65,7 @@ _VERIFY_TIMEOUT_S = int(os.environ.get("ETH_SPECS_BENCH_VERIFY_TIMEOUT", "420"))
 _MAX_ACC_FAILURES = 3
 
 
-def _digest(arr: np.ndarray) -> str:
-    return hashlib.sha256(np.ascontiguousarray(arr).tobytes()).hexdigest()[:32]
+_digest = gates.digest
 
 
 def sizes_for(section: str, on_cpu: bool) -> dict:
@@ -253,21 +253,18 @@ def run_epoch(p: dict) -> dict:
     }
 
 
-def _resident_work_bytes(n: int, cols) -> int:
+def _resident_work_bytes(meta, cols) -> int:
     """Lower-bound device traffic per resident epoch: column reads/writes
-    plus 96 B per REAL hash of the dirty-path state root (tree levels
-    counted as the hybrid unroll+loop kernel executes them)."""
+    plus 96 B per REAL hash of the dirty-path state root. The hash count
+    comes from ops/state_root.state_root_real_hashes — the same
+    accounting the state_root.post_epoch span's roofline verdict uses,
+    so bench and the obs registry can never disagree on a timing."""
     import jax
 
-    from eth_consensus_specs_tpu.ops.merkle import tree_real_hashes as fullwidth
+    from eth_consensus_specs_tpu.ops.state_root import state_root_real_hashes
 
-    d_val = max(n - 1, 0).bit_length()
-    hashes = 3 * n + fullwidth(d_val)  # validator nodes + registry tree
-    d_bal = (max(n // 4, 1) - 1).bit_length()
-    hashes += 2 * fullwidth(d_bal)  # balances + inactivity trees
-    hashes += fullwidth((max(n // 32, 1) - 1).bit_length())  # participation
     col_bytes = 2 * sum(a.nbytes for a in jax.tree_util.tree_leaves(cols))
-    return col_bytes + 96 * hashes
+    return col_bytes + 96 * state_root_real_hashes(meta)
 
 
 def run_resident(p: dict) -> dict:
@@ -286,10 +283,10 @@ def run_resident(p: dict) -> dict:
     n, epochs, repeats = p["n"], p["epochs"], p["repeats"]
     spec = get_spec("deneb", "mainnet")
     cols, just = graft._example_altair_inputs(n)
-    work_bytes = _resident_work_bytes(n, cols)
     cols = jax.device_put(cols)
     just = jax.device_put(just)
     static = synthetic_static(spec, n)
+    work_bytes = _resident_work_bytes(static[1], cols)
 
     run_salt = p.get("salt", 0)
     salt_fn = jax.jit(lambda c, s: c._replace(balance=c.balance + s))
@@ -432,17 +429,14 @@ def run_block_epoch(p: dict) -> dict:
 
     slots = params.slots_per_epoch
 
-    from eth_consensus_specs_tpu.ops.merkle import tree_real_hashes as fullwidth
+    # per-slot root accounting shared with the block_epoch.chain span
+    # (ops/state_root.slot_root_real_hashes): one implementation, one verdict
+    from eth_consensus_specs_tpu.ops.state_root import slot_root_real_hashes
 
-    root_hashes = (
-        fullwidth((max(n // 4, 1) - 1).bit_length())
-        + 2 * fullwidth((max(n // 32, 1) - 1).bit_length())
-        + (1 << meta.top_depth)
-    )
     col_bytes = 2 * sum(
         a.nbytes for a in jax.tree_util.tree_leaves((st0.balance, st0.cur_part, st0.prev_part))
     )
-    work_bytes = slots * (96 * root_hashes + col_bytes)
+    work_bytes = slots * (96 * slot_root_real_hashes(n, meta.top_depth) + col_bytes)
     return {
         "epoch_s": best,
         "slot_ms": best / slots * 1e3,
@@ -551,6 +545,14 @@ def bench_batch_verify(n_aggregates: int, committee: int = 8, reps: int = 3):
 
     if not batch_verify_aggregates(items_for(-1)):  # warm: compiles + pk cache
         raise RuntimeError("batch verification rejected valid signatures (warm)")
+    # belt + braces on top of the fresh messages: drop the warm call's
+    # hash-to-G2 and G2-prepare entries so NOTHING timed below can be
+    # served from a message-derived cache (ADVICE round-4 medium)
+    from eth_consensus_specs_tpu.ops import bls_batch as _bls_mod
+    from eth_consensus_specs_tpu.ops import pairing_device as _pd_mod
+
+    _bls_mod._H2G2_CACHE.clear()
+    _pd_mod._PREP_CACHE.clear()
     best = float("inf")
     last = None
     for r in range(reps):
@@ -560,11 +562,27 @@ def bench_batch_verify(n_aggregates: int, committee: int = 8, reps: int = 3):
         best = min(best, time.perf_counter() - t0)
         if not ok:
             raise RuntimeError("batch verification rejected valid signatures")
+    # supplementary CACHE-WARM number, reported separately and clearly
+    # labeled: the same (already-verified) batch again, h2c/prepare served
+    # from the caches — the steady-state ceiling, never the headline.
+    # Only meaningful when a message-derived cache is actually in play
+    # (device h2c / prepared pairing): the plain host path recomputes
+    # hash_to_g2 per call, and publishing a "warm" rate that is really a
+    # 4th cold rep would just be noise — report null instead.
+    warm_rate = None
+    if _bls_mod._H2G2_CACHE or _pd_mod._PREP_CACHE:
+        warm_best = float("inf")
+        for _ in range(2):  # min-of-2: same best-of-N discipline as cold
+            t0 = time.perf_counter()
+            if not batch_verify_aggregates(last):
+                raise RuntimeError("batch verification rejected valid signatures (warm rep)")
+            warm_best = min(warm_best, time.perf_counter() - t0)
+        warm_rate = n_aggregates / warm_best
     bad = list(last)
     bad[0] = (bad[0][0], hashlib.sha256(b"tampered").digest(), bad[0][2])
     if batch_verify_aggregates(bad):
         raise RuntimeError("batch verification ACCEPTED a tampered batch")
-    return n_aggregates / best, best, last
+    return n_aggregates / best, best, last, warm_rate
 
 
 def _run_bls(on_cpu: bool, no_cache: bool) -> dict:
@@ -590,7 +608,7 @@ def _run_bls(on_cpu: bool, no_cache: bool) -> dict:
             os.environ["ETH_SPECS_TPU_DEVICE_H2C"] = "1"
             device_h2c = True
     n = 64 if get_bls_lib() is not None else 4
-    aggs_per_sec, batch_s, last_items = bench_batch_verify(n_aggregates=n)
+    aggs_per_sec, batch_s, last_items, warm_aggs_per_sec = bench_batch_verify(n_aggregates=n)
     cross_checked = None
     if device_pairing or device_h2c:
         # the device-stage verdicts must agree with the host path on the
@@ -615,6 +633,9 @@ def _run_bls(on_cpu: bool, no_cache: bool) -> dict:
     return {
         "aggs_per_sec": aggs_per_sec,
         "batch_s": batch_s,
+        # supplementary, repeated msgs; null when no message-derived cache
+        # was in play (host h2c recomputes per call — nothing to warm)
+        "aggs_per_sec_cache_warm": warm_aggs_per_sec,
         "n": n,
         "fresh_messages": True,
         "pairing": "device-miller" if device_pairing else "host-native-multi-miller",
@@ -749,33 +770,9 @@ class _AccState:
         return self.failures >= _MAX_ACC_FAILURES
 
 
-def _apply_gates(section: str, frag: dict, unit_key: str) -> dict:
-    """Attach implied-traffic and roofline verdicts to an accelerator
-    fragment. unit_key names the per-unit seconds field."""
-    wb = frag.get("work_bytes")
-    unit_s = frag.get(unit_key)
-    if wb and unit_s:
-        implied = wb / unit_s
-        frag["implied_gbps"] = round(implied / 1e9, 1)
-        frag["roofline_ok"] = implied <= ACCEL_ROOFLINE_BYTES_S
-        if not frag["roofline_ok"]:
-            print(
-                f"[bench] section {section}: REFUSED — implied "
-                f"{implied/1e9:.0f} GB/s exceeds the {ACCEL_ROOFLINE_BYTES_S/1e9:.0f} "
-                "GB/s single-chip roofline; the timing cannot reflect real "
-                "execution",
-                file=sys.stderr,
-            )
-    return frag
-
-
-_UNIT_KEY = {
-    "tree": "tree_s",
-    "epoch": "epoch_s",
-    "resident": "per_epoch_s",
-    "das": "round_s",
-    "block_epoch": "epoch_s",
-}
+# one implementation, shared with the obs registry and the watchdog
+_apply_gates = gates.apply_gates
+_UNIT_KEY = gates.UNIT_KEY
 
 
 def _run_section_auto(section: str, acc: _AccState) -> tuple[dict | None, str]:
@@ -816,7 +813,7 @@ def _run_section_auto(section: str, acc: _AccState) -> tuple[dict | None, str]:
                         file=sys.stderr,
                     )
                     break
-                frag["verified"] = exp.get("digest") == frag["digest"]
+                frag["verified"] = gates.digests_match(exp.get("digest"), frag["digest"])
             if not frag.get("verified"):
                 print(
                     f"[bench] section {section}: REFUSED — device result does "

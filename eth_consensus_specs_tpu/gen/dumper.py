@@ -16,6 +16,8 @@ import os
 
 import yaml
 
+from eth_consensus_specs_tpu import obs
+from eth_consensus_specs_tpu.obs import gates
 from eth_consensus_specs_tpu.ssz import serialize
 from eth_consensus_specs_tpu.ssz.types import View
 
@@ -42,6 +44,12 @@ class Dumper:
         )
 
     def dump_ssz(self, case_dir: str, name: str, encoded: bytes) -> None:
+        if obs.obs_enabled():
+            # fingerprint through the shared gate digest so a cross-generator
+            # byte-diff can compare runs from the observability stream alone
+            obs.count("gen.parts", 1)
+            obs.count("gen.bytes_serialized", len(encoded))
+            obs.event("gen.part", part=name, digest=gates.digest(encoded), nbytes=len(encoded))
         with open(os.path.join(case_dir, f"{name}.ssz_snappy"), "wb") as f:
             f.write(frame_compress(encoded))
 

@@ -18,6 +18,8 @@ import sys
 import time
 import traceback
 
+from eth_consensus_specs_tpu import obs
+
 from .dumper import Dumper
 from .gen_from_tests import TestCase
 
@@ -31,25 +33,31 @@ def execute_case(case: TestCase, dumper: Dumper) -> str | None:
     dir, or None if the case was skipped."""
     from eth_consensus_specs_tpu.test_infra.context import SkippedTest
 
-    try:
-        gen = case.case_fn()
-        if gen is None:
-            return None  # test yielded nothing (pure-assertion case)
-        # snapshot each part AT YIELD TIME: tests yield live state objects
-        # ("pre" and "post" are often the same mutated instance), so views
-        # must be copied before the generator advances (the reference
-        # serializes eagerly for the same reason, yield_generator.py:10-43)
-        parts = []
-        for name, value in gen:
-            parts.append((name, _snapshot(value)))
-    except SkippedTest:
-        return None
-    if not parts:
-        # plain-assertion test (no yielded vector parts): nothing to emit
-        return None
-    if case.bls_setting:
-        parts.insert(0, ("bls_setting", case.bls_setting))
-    return dumper.dump_case(case, parts)
+    with obs.span("gen.case", runner=case.runner, handler=case.handler):
+        try:
+            gen = case.case_fn()
+            if gen is None:
+                obs.count("gen.cases_skipped", 1)
+                return None  # test yielded nothing (pure-assertion case)
+            # snapshot each part AT YIELD TIME: tests yield live state objects
+            # ("pre" and "post" are often the same mutated instance), so views
+            # must be copied before the generator advances (the reference
+            # serializes eagerly for the same reason, yield_generator.py:10-43)
+            parts = []
+            for name, value in gen:
+                parts.append((name, _snapshot(value)))
+        except SkippedTest:
+            obs.count("gen.cases_skipped", 1)
+            return None
+        if not parts:
+            # plain-assertion test (no yielded vector parts): nothing to emit
+            obs.count("gen.cases_skipped", 1)
+            return None
+        if case.bls_setting:
+            parts.insert(0, ("bls_setting", case.bls_setting))
+        out = dumper.dump_case(case, parts)
+    obs.count("gen.cases_written", 1)
+    return out
 
 
 def _snapshot(value):
@@ -82,6 +90,7 @@ def _run_sequential(cases, output_dir: str, verbose: bool) -> dict:
             out = execute_case(case, dumper)
         except Exception:
             failed += 1
+            obs.count("gen.cases_failed", 1)
             if verbose:
                 print(f"[gen] FAILED {case.runner}/{case.handler}/{case.case_name}",
                       file=sys.stderr)
@@ -119,20 +128,42 @@ def _pool_init(output_dir: str, presets: tuple, forks: tuple | None, package: st
     _WORKER_DUMPER = Dumper(output_dir)
 
 
+_WORKER_OBS_BASE: dict = {}
+
+
+def _worker_obs_delta() -> dict:
+    """Delta of ALL this worker's obs counters since the previous case —
+    shipped with each result so pool mode reports what sequential mode
+    does: dumper totals (gen.parts, gen.bytes_serialized), kernel
+    counters, and above all watchdog.checks/.divergences (a divergence
+    detected inside a worker MUST reach the parent registry). Only
+    gen.cases_* stay out: the parent mirrors those from its own
+    authoritative status counts."""
+    global _WORKER_OBS_BASE
+    now = {
+        k: v
+        for k, v in obs.snapshot()["counters"].items()
+        if not k.startswith("gen.cases_")
+    }
+    delta = {k: v - _WORKER_OBS_BASE.get(k, 0) for k, v in now.items()}
+    _WORKER_OBS_BASE = now
+    return {k: v for k, v in delta.items() if v}
+
+
 def _pool_exec(key: tuple) -> tuple:
-    """Run one case in the worker; returns (key, status, rss_mb)."""
+    """Run one case in the worker; returns (key, status, rss_mb, obs_delta)."""
     import resource
 
     case = _WORKER_CASES.get(key)
     rss = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss // 1024
     if case is None:
-        return key, "failed", rss
+        return key, "failed", rss, _worker_obs_delta()
     try:
         out = execute_case(case, _WORKER_DUMPER)
     except Exception:
         traceback.print_exc()
-        return key, "failed", rss
-    return key, ("written" if out is not None else "skipped"), rss
+        return key, "failed", rss, _worker_obs_delta()
+    return key, ("written" if out is not None else "skipped"), rss, _worker_obs_delta()
 
 
 def _run_pool(cases, output_dir: str, verbose: bool, n_workers: int) -> dict:
@@ -155,11 +186,13 @@ def _run_pool(cases, output_dir: str, verbose: bool, n_workers: int) -> dict:
         initargs=(output_dir, presets, forks, "tests"),
         maxtasksperchild=100,
     ) as pool:
-        for i, (key, status, rss) in enumerate(
+        for i, (key, status, rss, obs_delta) in enumerate(
             pool.imap_unordered(_pool_exec, keys, chunksize=4), start=1
         ):
             counts[status] += 1
             max_rss = max(max_rss, rss)
+            for cname, n in obs_delta.items():
+                obs.count(cname, n)
             if status == "failed" and verbose:
                 print(f"[gen] FAILED {'/'.join(map(str, key))}", file=sys.stderr)
             now = time.monotonic()
@@ -172,4 +205,10 @@ def _run_pool(cases, output_dir: str, verbose: bool, n_workers: int) -> dict:
                     f"w={counts['written']} s={counts['skipped']} f={counts['failed']})",
                     file=sys.stderr,
                 )
+    # dumper counters were shipped per-result above; per-part digest
+    # events reach the shared JSONL sink directly from each worker.
+    # gen.cases_* mirror the parent's authoritative status counts.
+    for status, n in counts.items():
+        obs.count(f"gen.cases_{status}", n)
+    obs.event("gen.pool_summary", workers=n_workers, max_rss_mb=max_rss, **counts)
     return counts

@@ -1,0 +1,79 @@
+"""obs — kernel-level observability: spans, op counters, divergence watchdog.
+
+The reference pyspec has no tracing at all (SURVEY §5); this repo spent
+four rounds publishing a physically impossible 878 Ghash/s because the
+only correctness/roofline gates lived in a private bench script. This
+package makes the discipline ambient:
+
+  * ``obs.span("epoch.justification", work_bytes=...)`` — nested timed
+    regions with block_until_ready semantics, mirrored into the jax
+    profiler (Perfetto/TensorBoard) via utils/profiling.annotate, with a
+    roofline verdict attached to every timing that declares its traffic;
+  * ``obs.count("sha256.compressions", n)`` / ``obs.bytes_moved(...)``
+    — thread-safe process counters the hot paths report into;
+  * ``obs.gates`` — the roofline/digest gate logic (extracted from
+    bench.py) as the single shared implementation;
+  * ``obs.watchdog`` — always-on sampled device-vs-host recompute of
+    result slices, recording match/mismatch as first-class metrics;
+  * a JSONL event sink (``ETH_SPECS_OBS_JSONL=<path>``) and a pytest
+    plugin (test_infra/obs_plugin.py) that emits ``obs_report.json``.
+
+Environment:
+    ETH_SPECS_OBS=0              disable all recording
+    ETH_SPECS_OBS_JSONL=<path>   stream structured events as JSON lines
+    ETH_SPECS_OBS_WATCHDOG=<r>   watchdog sampling rate (default 0.05;
+                                 0 disables, 1 checks every call)
+    ETH_SPECS_OBS_REPORT=<path>  pytest run-level report destination
+"""
+
+from __future__ import annotations
+
+from . import gates, watchdog  # noqa: F401  (public submodules)
+from .registry import Registry, get_registry, obs_enabled  # noqa: F401
+
+
+def span(name: str, **attrs):
+    """Timed, nestable region. Assign ``.result`` inside the block to make
+    the span block on device completion before the clock stops:
+
+        with obs.span("merkle.subtree", work_bytes=wb) as sp:
+            sp.result = kernel(x)
+    """
+    return get_registry().span(name, **attrs)
+
+
+def count(name: str, n: int | float = 1) -> None:
+    """Bump a named process counter (thread-safe, monotonic)."""
+    get_registry().count(name, n)
+
+
+def bytes_moved(name: str, nbytes: int) -> None:
+    """Record device traffic attributed to `name` (``<name>.bytes_moved``)."""
+    get_registry().bytes_moved(name, nbytes)
+
+
+def event(kind: str, **fields) -> None:
+    """Emit a structured event to the in-memory ring + JSONL sink."""
+    get_registry().emit({"kind": kind, **fields})
+
+
+def snapshot() -> dict:
+    """{counters, spans, watchdog} view of the process registry."""
+    return get_registry().snapshot()
+
+
+def tracing(x) -> bool:
+    """True when `x` is a jax tracer — instrumentation sites inside
+    traceable functions use this to skip wall-clock recording at trace
+    time (a trace is compiled once; counting it as an execution lies)."""
+    try:
+        import jax
+
+        return isinstance(x, jax.core.Tracer)
+    except Exception:
+        # probe unavailable (no jax, or the jax.core alias removed): fall
+        # back to the MRO. This must still CATCH tracers — misclassifying
+        # a concrete array merely skips one timing, but missing a tracer
+        # records a compile as an execution, the exact lie this guard
+        # exists to prevent.
+        return any("Tracer" in c.__name__ for c in type(x).__mro__)

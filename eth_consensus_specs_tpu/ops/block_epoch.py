@@ -51,6 +51,8 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from eth_consensus_specs_tpu import obs
+
 U64 = jnp.uint64
 
 
@@ -362,7 +364,41 @@ def block_epoch_chain(
     chunk over the cached static tree) and xor-chains the root — the
     chained-dependency shape bench.py times.  Returns (BlockState,
     root_acc u32[8])."""
+    if obs.tracing(st.balance):
+        obs.count("block_epoch.traces", 1)
+        return _block_epoch_chain_impl(
+            params, n, st, blocks, static, root_ctx, with_withdrawals
+        )
+    slots = params.slots_per_epoch
+    work_bytes = slots * 2 * sum(
+        int(getattr(a, "nbytes", 0)) for a in (st.balance, st.cur_part, st.prev_part)
+    )
+    if root_ctx is not None:
+        from eth_consensus_specs_tpu.ops.state_root import slot_root_real_hashes
 
+        work_bytes += slots * 96 * slot_root_real_hashes(n, root_ctx.top_depth)
+    with obs.span(
+        "block_epoch.chain", work_bytes=work_bytes, n_validators=n, slots=slots
+    ) as sp:
+        out = _block_epoch_chain_impl(
+            params, n, st, blocks, static, root_ctx, with_withdrawals
+        )
+        sp.result = out
+    obs.count("block_epoch.epochs", 1)
+    obs.count("block_epoch.slots", slots)
+    obs.count("block_epoch.validator_slots", n * slots)
+    return out
+
+
+def _block_epoch_chain_impl(
+    params: BlockEpochParams,
+    n: int,
+    st: BlockState,
+    blocks: BlockColumns,
+    static: BlockEpochStatic,
+    root_ctx,
+    with_withdrawals: bool,
+):
     def slot_step(carry, xs):
         st, acc, slot_no = carry
         st = process_slot_columnar(
@@ -502,6 +538,8 @@ def extract_block_columns(spec, pre_state, signed_blocks):
     state = pre_state.copy()
     n = len(state.validators)
     S = len(signed_blocks)
+    obs.count("block_epoch.ingests", 1)
+    obs.count("block_epoch.blocks_ingested", S)
 
     def _rows_of(state_now, att):
         """[(committee, bits_slice)] — one row per committee."""

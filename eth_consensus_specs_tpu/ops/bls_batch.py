@@ -26,6 +26,7 @@ from __future__ import annotations
 import os
 import secrets
 
+from eth_consensus_specs_tpu import obs
 from eth_consensus_specs_tpu.crypto.curve import (
     Point,
     g1_generator,
@@ -34,6 +35,7 @@ from eth_consensus_specs_tpu.crypto.curve import (
 )
 from eth_consensus_specs_tpu.crypto.hash_to_curve import DST_G2, hash_to_g2
 from eth_consensus_specs_tpu.crypto.pairing import pairing_check
+from eth_consensus_specs_tpu.obs import watchdog
 
 
 def _use_device() -> bool:
@@ -116,10 +118,13 @@ def fast_aggregate_verify_device(pks: list[bytes], message: bytes, sig: bytes) -
     sig_pt = _load_sig(bytes(sig))
     if sig_pt is None:
         return False
-    aggpk = sum_g1_device(points)
-    return _pairing_check_routed(
-        [(aggpk, hash_to_g2(bytes(message))), (-g1_generator(), sig_pt)]
-    )
+    with obs.span("bls.fast_aggregate_verify", pubkeys=len(pks)):
+        obs.count("bls.fast_aggregate_verifies", 1)
+        obs.count("bls.pubkeys_aggregated", len(pks))
+        aggpk = sum_g1_device(points)
+        return _pairing_check_routed(
+            [(aggpk, hash_to_g2(bytes(message))), (-g1_generator(), sig_pt)]
+        )
 
 
 def batch_verify_aggregates(items: list[tuple[list[bytes], bytes, bytes]]) -> bool:
@@ -138,13 +143,32 @@ def batch_verify_aggregates(items: list[tuple[list[bytes], bytes, bytes]]) -> bo
     """
     if not items:
         return True
+    with obs.span("bls.batch_verify", items=len(items)):
+        obs.count("bls.batches", 1)
+        obs.count("bls.batch_items", len(items))
+        ok, parsed = _batch_verify_impl(items)
+    # the watchdog's host-pairing recompute runs AFTER the span closes
+    # (like sha256/merkle/shuffle): the probe must never be clocked as
+    # kernel time — in the obs report or in bench's timed region
+    if ok and parsed and watchdog.should_check("bls_batch"):
+        # a True batch verdict must reproduce for any member item through
+        # the plain host pairing (no device MSM, no routed pairing, no
+        # h2g2 cache) — the sampled item rotates with the call counter
+        points, msg, sig, _r = parsed[watchdog.call_salt("bls_batch") % len(parsed)]
+        watchdog.check_bls_item(points, msg, sig, ok)
+    return ok
+
+
+def _batch_verify_impl(
+    items: list[tuple[list[bytes], bytes, bytes]],
+) -> tuple[bool, list | None]:
     from eth_consensus_specs_tpu.crypto.signature import _load_pk
 
     g1 = g1_generator()
     parsed = []
     for pks, msg, sig_b in items:
         if len(pks) == 0:
-            return False
+            return False, None
         # _load_pk rejects malformed AND infinity keys (same outcome as the
         # previous inline parse) and caches decompression — registry keys
         # repeat every block, so steady-state parsing is dict lookups
@@ -152,12 +176,12 @@ def batch_verify_aggregates(items: list[tuple[list[bytes], bytes, bytes]]) -> bo
         for pk in pks:
             p = _load_pk(bytes(pk))
             if p is None:
-                return False
+                return False, None
             points.append(p)
         try:
             sig = g2_from_bytes(bytes(sig_b))
         except ValueError:
-            return False
+            return False, None
         r = secrets.randbits(64) | 1
         parsed.append((points, bytes(msg), sig, r))
 
@@ -216,4 +240,7 @@ def batch_verify_aggregates(items: list[tuple[list[bytes], bytes, bytes]]) -> bo
     sig_acc = multi_exp([sig for _, _, sig, _ in parsed], [r for _, _, _, r in parsed])
     pairs = [(rp, _h2g2(msg)) for msg, rp in merged.items()]
     pairs.append((-g1, sig_acc))
-    return _pairing_check_routed(pairs)
+    obs.count("bls.pairings", 1)
+    obs.count("bls.pairing_inputs", len(pairs))
+    obs.count("bls.messages_distinct", len(merged))
+    return _pairing_check_routed(pairs), parsed

@@ -33,6 +33,9 @@ import jax.numpy as jnp
 import numpy as np
 from jax import lax
 
+from eth_consensus_specs_tpu import obs
+from eth_consensus_specs_tpu.obs import watchdog
+
 from .sha256 import sha256_pair_words
 
 
@@ -100,8 +103,18 @@ def merkleize_subtree_device(chunks: np.ndarray, depth: int) -> bytes:
     words = np.ascontiguousarray(chunks).view(">u4").astype(np.uint32).reshape(n, 8)
     if n < cap:
         words = np.concatenate([words, np.zeros((cap - n, 8), dtype=np.uint32)], axis=0)
-    root_words = np.asarray(_tree_root_fused(jnp.asarray(words), depth))
-    return root_words.astype(">u4", order="C").view(np.uint8).tobytes()
+    real = tree_real_hashes(depth)
+    with obs.span(
+        "merkle.subtree_root", work_bytes=96 * real, tree_depth=depth, leaf_chunks=n
+    ) as sp:
+        sp.result = root_words = np.asarray(_tree_root_fused(jnp.asarray(words), depth))
+    obs.count("merkle.trees", 1)
+    obs.count("merkle.real_hashes", real)
+    obs.count("merkle.leaf_chunks", n)
+    root = root_words.astype(">u4", order="C").view(np.uint8).tobytes()
+    if watchdog.should_check("merkle"):
+        watchdog.check_merkle_root(words, depth, root)
+    return root
 
 
 # Above this leaf count the device tree kernel beats per-level hashlib.

@@ -23,6 +23,9 @@ from functools import lru_cache as _lru_cache
 
 import numpy as np
 
+from eth_consensus_specs_tpu import obs
+from eth_consensus_specs_tpu.obs import watchdog
+
 
 def shuffle_permutation(index_count: int, seed: bytes, rounds: int) -> np.ndarray:
     """perm[i] == compute_shuffled_index(i, index_count, seed) for all i."""
@@ -138,4 +141,14 @@ def shuffle_permutation_device(index_count: int, seed: bytes, rounds: int):
     ]
     blocks = _single_block_words(msgs)
 
-    return _device_shuffle_kernel(n, rounds, num_chunks)(blocks, pivots)
+    # lower-bound traffic: one compression per decision hash (96 B) plus
+    # the int32 index plane read+written every round
+    work_bytes = 96 * rounds * num_chunks + 8 * n * rounds
+    with obs.span("shuffle.permutation", work_bytes=work_bytes, lanes=n, rounds=rounds) as sp:
+        sp.result = perm = _device_shuffle_kernel(n, rounds, num_chunks)(blocks, pivots)
+    obs.count("shuffle.permutations", 1)
+    obs.count("shuffle.lanes", n)
+    obs.count("shuffle.decision_hashes", rounds * num_chunks)
+    if watchdog.should_check("shuffle"):
+        watchdog.check_shuffle_slice(perm, n, seed, rounds)
+    return perm

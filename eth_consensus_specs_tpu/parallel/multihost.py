@@ -33,6 +33,8 @@ import jax
 import numpy as np
 from jax.sharding import Mesh
 
+from eth_consensus_specs_tpu import obs
+
 from . import DP_AXIS, SP_AXIS
 
 _initialized = False
@@ -54,6 +56,19 @@ def initialize_distributed(
     if _initialized or jax.process_count() > 1:
         _initialized = True
         return jax.process_count() > 1
+    with obs.span("multihost.initialize"):
+        live = _initialize_distributed(coordinator_address, num_processes, process_id)
+    obs.count("multihost.initializations", 1)
+    obs.count("multihost.processes", jax.process_count())
+    return live
+
+
+def _initialize_distributed(
+    coordinator_address: str | None,
+    num_processes: int | None,
+    process_id: int | None,
+) -> bool:
+    global _initialized
     coordinator_address = coordinator_address or os.environ.get("JAX_COORDINATOR_ADDRESS")
     if num_processes is None and "JAX_NUM_PROCESSES" in os.environ:
         num_processes = int(os.environ["JAX_NUM_PROCESSES"])
@@ -93,11 +108,20 @@ def make_hybrid_mesh(sp_per_host: int | None = None) -> Mesh:
     if n_hosts <= 1:
         from . import make_mesh
 
+        obs.count("multihost.meshes_flat", 1)
         return make_mesh()
     # [host, local] grid: host-major ordering keeps each host's devices
     # contiguous along the trailing (sp) axis
     dp_per_host = n_local // sp_per_host
     grid = np.asarray(devices).reshape(n_hosts * dp_per_host, sp_per_host)
+    obs.count("multihost.meshes_hybrid", 1)
+    obs.event(
+        "multihost.mesh",
+        dp=n_hosts * dp_per_host,
+        sp=sp_per_host,
+        hosts=n_hosts,
+        devices=len(devices),
+    )
     return Mesh(grid, (DP_AXIS, SP_AXIS))
 
 

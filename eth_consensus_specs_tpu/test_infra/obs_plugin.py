@@ -1,0 +1,114 @@
+"""Pytest plugin: per-test kernel counters + run-level obs_report.json.
+
+Registered by tests/conftest.py (``config.pluginmanager.register``). For
+every test it snapshots the obs counter set before and after the call
+phase and attaches the nonzero delta to the item's ``user_properties``
+(visible in junit XML and to reporting hooks). At session end it writes
+a run-level report:
+
+    {
+      "counters":  process totals (sha256.*, merkle.*, bls.*, ...),
+      "spans":     per-span aggregates incl. roofline verdicts,
+      "watchdog":  {checks, divergences, kernels},
+      "per_test":  up to _MAX_PER_TEST tests ranked by kernel activity,
+      "meta":      backend / watchdog rate / exit status
+    }
+
+Destination: ``ETH_SPECS_OBS_REPORT`` (a path; ``0``/empty disables),
+default ``obs_report.json`` under the pytest rootdir — always-on is the
+point: every tier-1 run leaves an auditable record that the kernels it
+exercised were watched and did not diverge.
+
+A ``kernel_counters`` fixture is exposed for tests that want to assert
+on their own kernel activity: it returns a callable producing the
+counter delta since the fixture was set up.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+import pytest
+
+from eth_consensus_specs_tpu import obs
+
+_MAX_PER_TEST = 200
+
+
+def report_path(rootdir: str) -> str | None:
+    env = os.environ.get("ETH_SPECS_OBS_REPORT")
+    if env is not None:
+        return env if env not in ("", "0") else None
+    return os.path.join(rootdir, "obs_report.json")
+
+
+def _counter_delta(before: dict, after: dict) -> dict:
+    return {
+        k: after[k] - before.get(k, 0)
+        for k in after
+        if after[k] != before.get(k, 0)
+    }
+
+
+class ObsPlugin:
+    def __init__(self, rootdir: str):
+        self._path = report_path(rootdir)
+        self.per_test: list[tuple[str, dict]] = []
+
+    @pytest.hookimpl(hookwrapper=True)
+    def pytest_runtest_call(self, item):
+        before = dict(obs.snapshot()["counters"])
+        yield
+        delta = _counter_delta(before, obs.snapshot()["counters"])
+        if delta:
+            item.user_properties.append(("obs_counters", delta))
+            self.per_test.append((item.nodeid, delta))
+
+    def pytest_sessionfinish(self, session, exitstatus):
+        if self._path is None:
+            return
+        snap = obs.snapshot()
+        ranked = sorted(
+            self.per_test, key=lambda kv: -sum(v for v in kv[1].values())
+        )[:_MAX_PER_TEST]
+        try:
+            import jax
+
+            backend = jax.default_backend()
+        except Exception:
+            backend = None
+        from eth_consensus_specs_tpu.obs import watchdog
+
+        report = {
+            "counters": snap["counters"],
+            "spans": snap["spans"],
+            "watchdog": snap["watchdog"],
+            "per_test": {nodeid: delta for nodeid, delta in ranked},
+            "meta": {
+                "backend": backend,
+                "watchdog_rate": watchdog.sampling_rate(),
+                "exitstatus": int(exitstatus),
+                "tests_with_kernel_activity": len(self.per_test),
+            },
+        }
+        try:
+            tmp = self._path + ".tmp"
+            with open(tmp, "w") as fh:
+                json.dump(report, fh, indent=1, sort_keys=True)
+                fh.write("\n")
+            os.replace(tmp, self._path)
+        except OSError:
+            pass
+
+
+@pytest.fixture
+def kernel_counters():
+    """Callable returning the obs counter delta since fixture setup —
+    lets a test assert which kernels it actually drove."""
+    before = dict(obs.snapshot()["counters"])
+
+    def delta() -> dict:
+        return _counter_delta(before, obs.snapshot()["counters"])
+
+    return delta

@@ -18,3 +18,15 @@ jax.config.update("jax_platforms", "cpu")
 from eth_consensus_specs_tpu.utils.cache import enable_persistent_cache
 
 enable_persistent_cache()
+
+# Observability: per-test kernel counters + run-level obs_report.json
+# (eth_consensus_specs_tpu/test_infra/obs_plugin.py). The fixture import
+# makes `kernel_counters` available suite-wide.
+from eth_consensus_specs_tpu.test_infra.obs_plugin import (  # noqa: E402,F401
+    ObsPlugin,
+    kernel_counters,
+)
+
+
+def pytest_configure(config):
+    config.pluginmanager.register(ObsPlugin(str(config.rootpath)), "eth-specs-obs")

@@ -97,3 +97,44 @@ def test_stub_mode():
         assert bls.FastAggregateVerify([], MSG_A, bls.STUB_SIGNATURE)
     finally:
         bls.bls_active = True
+
+
+def test_h2g2_cache_keys_include_dst():
+    """Regression (ADVICE round-4 low): the hash-to-G2 cache must key on
+    (dst, message) — a caller priming under one domain-separation tag
+    must never serve its points to a reader under another."""
+    from eth_consensus_specs_tpu.ops import bls_batch
+
+    msg = b"\xaa" * 32
+    dst_a, dst_b = b"DST-A", b"DST-B"
+    saved = dict(bls_batch._H2G2_CACHE)
+    bls_batch._H2G2_CACHE.clear()
+    try:
+        bls_batch._prime_h2g2_cache([msg], lambda ms, dst: ["A-point"] * len(ms), dst=dst_a)
+        bls_batch._prime_h2g2_cache([msg], lambda ms, dst: ["B-point"] * len(ms), dst=dst_b)
+        # both entries coexist — neither aliased the other
+        assert bls_batch._h2g2(msg, dst_a) == "A-point"
+        assert bls_batch._h2g2(msg, dst_b) == "B-point"
+        assert (dst_a, msg) in bls_batch._H2G2_CACHE
+        assert (dst_b, msg) in bls_batch._H2G2_CACHE
+        # a third DST misses the cache entirely (falls through to a real
+        # hash_to_g2 — a point object, never one of the sentinels)
+        real = bls_batch._h2g2(msg, b"DST-C" + bls_batch.DST_G2)
+        assert real not in ("A-point", "B-point")
+    finally:
+        bls_batch._H2G2_CACHE.clear()
+        bls_batch._H2G2_CACHE.update(saved)
+
+
+def test_batch_verify_emits_obs_counters(kernel_counters):
+    from eth_consensus_specs_tpu import obs
+
+    sks = [5, 6]
+    pks = [bls.SkToPk(s) for s in sks]
+    agg = bls.Aggregate([bls.Sign(s, MSG_A) for s in sks])
+    assert batch_verify_aggregates([(pks, MSG_A, agg)])
+    delta = kernel_counters()
+    assert delta["bls.batches"] == 1
+    assert delta["bls.batch_items"] == 1
+    assert delta["bls.pairings"] == 1
+    assert "bls.batch_verify" in obs.snapshot()["spans"]

@@ -1,0 +1,63 @@
+"""utils/profiling.py: timed() warmup/repeat semantics, trace/annotate
+no-op safety on the CPU backend (the obs span layer enters annotate on
+every span, so it must never throw where there's no profiler)."""
+
+import numpy as np
+
+from eth_consensus_specs_tpu.utils import profiling
+
+
+def test_timed_warmup_and_repeat_counts():
+    calls = []
+
+    def fn(x):
+        calls.append(1)
+        return x * 2
+
+    best, result = profiling.timed(fn, np.arange(4), repeats=3, warmup=2)
+    assert len(calls) == 2 + 3  # warmup calls then timed repeats
+    assert best >= 0.0 and np.array_equal(result, np.arange(4) * 2)
+
+
+def test_timed_zero_warmup_min_one_repeat():
+    calls = []
+
+    def fn():
+        calls.append(1)
+        return 7
+
+    best, result = profiling.timed(fn, repeats=0, warmup=0)
+    assert len(calls) == 1  # repeats clamps to >= 1
+    assert result == 7
+    assert best < float("inf")
+
+
+def test_timed_blocks_on_device_results():
+    import jax.numpy as jnp
+
+    best, result = profiling.timed(lambda: jnp.arange(8) + 1, repeats=2, warmup=1)
+    assert np.array_equal(np.asarray(result), np.arange(8) + 1)
+
+
+def test_annotate_noop_safe_on_cpu():
+    with profiling.annotate("test.region"):
+        acc = sum(range(10))
+    assert acc == 45
+
+
+def test_annotate_nested():
+    with profiling.annotate("outer"):
+        with profiling.annotate("inner"):
+            pass
+
+
+def test_trace_writes_and_exits_cleanly_on_cpu(tmp_path):
+    import jax.numpy as jnp
+
+    logdir = str(tmp_path / "jax-trace")
+    with profiling.trace(logdir):
+        (jnp.arange(16) * 2).block_until_ready()
+    # the context must have closed the profiler; a second trace region
+    # must be startable (stop_trace really ran)
+    with profiling.trace(str(tmp_path / "jax-trace-2")):
+        pass
