@@ -1,0 +1,44 @@
+"""fault — deterministic fault injection, retry, and graceful degradation.
+
+The generation pipeline and kernel runtime are only trustworthy under
+failure if failure is REHEARSABLE: a worker OOM-kill, a hung device
+compile, or a mid-write SIGKILL must be reproducible in a test, and
+every recovery action must leave an observable trace (PR-1 obs
+registry). This package provides the three legs:
+
+  * **injection** (`fault.check(site)` / `fault.corrupt(site, data)`) —
+    an env/config-driven harness (``ETH_SPECS_FAULT=<spec>``, grammar in
+    fault/spec.py and docs/robustness.md) that can raise at a named
+    site, SIGKILL the current process on the Nth hit, stall a case past
+    its deadline, or flip a byte of serialized output. Deterministic:
+    per-rule hit counters, no RNG; an optional ``latch=<path>`` key
+    coordinates "exactly once across processes" through an O_EXCL file.
+  * **retry** (`fault.retrying(fn, ...)`) — capped exponential backoff
+    with deterministic jitter, the single helper every recovery path in
+    the repo goes through (pool re-dispatch, dumper write-verify,
+    manifest append, worker respawn, degrade's device re-try).
+  * **degradation** (`fault.degrade(site, device_fn, host_fn)`) — run
+    the device path; on a device-side failure (compile, OOM, injected)
+    retry once, then fall back to the host oracle with a
+    ``fault.degraded`` counter + event, so a run completes slower
+    rather than not at all.
+
+Counters: ``fault.injected``, ``fault.retries``, ``fault.degraded`` (+
+``fault.degraded.<site>``). Events: ``fault.injected``, ``fault.retry``,
+``fault.degraded``.
+"""
+
+from .degrade import degrade, is_device_failure  # noqa: F401
+from .retry import backoff_delays, retrying  # noqa: F401
+from .spec import (  # noqa: F401
+    FaultInjected,
+    FaultRule,
+    active,
+    check,
+    corrupt,
+    injected,
+    install,
+    parse,
+    refresh,
+    rules,
+)

@@ -1,0 +1,77 @@
+"""Graceful degradation: device path -> host oracle.
+
+`degrade(site, device_fn, host_fn)` runs the device path; when it dies
+of a DEVICE-side failure (XLA compile/runtime error, OOM, or an injected
+fault) it retries once through `retrying` — transient allocator pressure
+and nth-shot injections recover here — then falls back to the host
+oracle so the run completes slower rather than not at all. Logic errors
+(anything that doesn't classify as a device failure) propagate: masking
+a real bug behind the oracle would un-couple the two legs the bench
+correctness story depends on.
+"""
+
+from __future__ import annotations
+
+import re
+
+from eth_consensus_specs_tpu import obs
+
+from .retry import retrying
+from .spec import FaultInjected
+
+# substrings of RuntimeError messages that identify device-side death.
+# Deliberately NARROW (allocator/compiler failure vocabulary only): a
+# marker like "device" would also match shape/transfer logic errors
+# ("incompatible shapes when transferring to device") and silently mask
+# real kernel bugs behind the host oracle.
+_DEVICE_ERROR_MARKERS = (
+    "resource_exhausted",
+    "resource exhausted",
+    "out of memory",
+    "failed to compile",
+    "compilation failure",
+    "failed to allocate",
+)
+# "oom" needs a word boundary: plain containment would also match
+# "room"/"bloom" in unrelated error messages
+_OOM_RE = re.compile(r"\boom\b")
+
+
+def is_device_failure(exc: BaseException) -> bool:
+    """True for failures of the accelerator runtime (safe to degrade),
+    False for logic errors (must propagate)."""
+    if isinstance(exc, (FaultInjected, MemoryError)):
+        return True
+    msg = str(exc).lower()
+    if "xla" in type(exc).__name__.lower():
+        # jaxlib.xla_extension.XlaRuntimeError et al. — but XLA also routes
+        # argument/shape LOGIC errors through the same type; those must
+        # still propagate
+        return "invalid_argument" not in msg and "invalid argument" not in msg
+    if isinstance(exc, RuntimeError):
+        return bool(_OOM_RE.search(msg)) or any(
+            marker in msg for marker in _DEVICE_ERROR_MARKERS
+        )
+    return False
+
+
+def degrade(site: str, device_fn, host_fn, *, attempts: int = 2):
+    """Run ``device_fn()`` with `attempts` tries (retrying on device-side
+    failures only), then fall back to ``host_fn()`` with a
+    ``fault.degraded`` counter + event breadcrumb."""
+    try:
+        return retrying(
+            device_fn,
+            name=site,
+            attempts=attempts,
+            retry_on=is_device_failure,
+            base_delay=0.02,
+            max_delay=0.5,
+        )
+    except BaseException as exc:
+        if not is_device_failure(exc):
+            raise
+        obs.count("fault.degraded", 1)
+        obs.count(f"fault.degraded.{site}", 1)
+        obs.event("fault.degraded", site=site, error=repr(exc)[:200])
+        return host_fn()

@@ -1,0 +1,78 @@
+"""Capped exponential backoff with deterministic jitter.
+
+One retry helper for every recovery path (pool re-dispatch, dumper
+write-verify, manifest append, worker respawn, degrade's device
+re-try). Jitter is derived from a hash of ``(name, attempt)`` instead of
+an RNG: two retriers with different names de-sync (no thundering herd),
+and the same name replays the exact same schedule — the property the
+deterministic fault harness needs to keep chaos tests reproducible.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import time
+from typing import Callable
+
+from eth_consensus_specs_tpu import obs
+
+
+def backoff_delays(
+    name: str,
+    attempts: int,
+    base_delay: float = 0.05,
+    max_delay: float = 2.0,
+    jitter: float = 0.5,
+) -> list[float]:
+    """The full sleep schedule between `attempts` tries: base * 2**i
+    capped at `max_delay`, stretched by up to ``jitter`` of itself by the
+    hash-derived fraction."""
+    out = []
+    for i in range(max(attempts - 1, 0)):
+        frac = int.from_bytes(hashlib.sha256(f"{name}:{i}".encode()).digest()[:4], "big") / 2**32
+        out.append(min(base_delay * (2**i), max_delay) * (1.0 + jitter * frac))
+    return out
+
+
+def retrying(
+    fn: Callable,
+    *,
+    name: str = "retry",
+    attempts: int = 3,
+    retry_on=(Exception,),
+    base_delay: float = 0.05,
+    max_delay: float = 2.0,
+    jitter: float = 0.5,
+    sleep: Callable[[float], None] = time.sleep,
+    on_retry: Callable | None = None,
+):
+    """Call ``fn()`` up to `attempts` times, sleeping the backoff_delays
+    schedule between failures; re-raises the last error when the budget
+    is exhausted. ``retry_on`` is a tuple of exception types or a
+    predicate ``exc -> bool`` (non-matching errors propagate
+    immediately). Each retry records ``fault.retries`` + a
+    ``fault.retry`` event."""
+    if attempts < 1:
+        raise ValueError(f"retrying needs attempts >= 1, got {attempts}")
+    if isinstance(retry_on, type):
+        retry_on = (retry_on,)
+    predicate = retry_on if not isinstance(retry_on, tuple) else None
+    delays = backoff_delays(name, attempts, base_delay, max_delay, jitter)
+    for i in range(attempts):
+        try:
+            return fn()
+        except BaseException as exc:
+            retriable = predicate(exc) if predicate is not None else isinstance(exc, retry_on)
+            if not retriable or i + 1 >= attempts:
+                raise
+            obs.count("fault.retries", 1)
+            obs.event(
+                "fault.retry",
+                name=name,
+                attempt=i + 1,
+                error=type(exc).__name__,
+                delay_s=round(delays[i], 4),
+            )
+            if on_retry is not None:
+                on_retry(exc, i + 1)
+            sleep(delays[i])

@@ -1,0 +1,208 @@
+"""Deterministic fault-injection engine behind ``ETH_SPECS_FAULT``.
+
+Grammar::
+
+    spec  := rule (";" rule)*
+    rule  := site ":" mode (":" key "=" value)*
+    mode  := raise | kill | stall | corrupt
+    keys  := nth    1-based hit index that first fires (default 1)
+             times  consecutive hits that fire (default 1; "inf" = every
+                    hit from `nth` on)
+             delay  stall duration in seconds (default 30)
+             latch  file path: the rule fires only while the file can be
+                    created O_CREAT|O_EXCL — first process wins, so a
+                    fleet of pool workers injects exactly one fault
+
+A `site` is a dotted name the instrumented code passes to `check()`
+(``gen.case``, ``state_root.device``, ...); a trailing ``*`` makes the
+rule a prefix match. Rules are parsed once from the environment at
+import (`refresh()` re-reads; `install()` sets programmatically;
+`injected()` is the scoped test helper). Hit counters are per-process —
+forked pool workers inherit the parent's rules and count their own
+executions, which is exactly the "SIGKILL a worker on ITS Nth case"
+semantics the chaos tests want.
+
+Every fire records ``fault.injected`` (counter + event) through the obs
+registry BEFORE acting, so even a self-SIGKILL leaves a breadcrumb in a
+configured JSONL sink.
+"""
+
+from __future__ import annotations
+
+import os
+import signal
+import threading
+import time
+from contextlib import contextmanager
+from dataclasses import dataclass
+
+from eth_consensus_specs_tpu import obs
+
+_MODES = ("raise", "kill", "stall", "corrupt")
+
+
+class FaultInjected(RuntimeError):
+    """Raised by a `raise`-mode rule (and treated as a device-side
+    failure by fault.degrade)."""
+
+    def __init__(self, site: str, hit: int = 0):
+        super().__init__(f"injected fault at {site} (hit {hit})")
+        self.site = site
+        self.hit = hit
+
+
+@dataclass
+class FaultRule:
+    site: str
+    mode: str
+    nth: int = 1
+    times: float = 1
+    delay: float = 30.0
+    latch: str | None = None
+    hits: int = 0
+
+    def matches(self, site: str) -> bool:
+        if self.site.endswith("*"):
+            return site.startswith(self.site[:-1])
+        return site == self.site
+
+    def in_window(self) -> bool:
+        return self.nth <= self.hits < self.nth + self.times
+
+
+_LOCK = threading.Lock()
+_RULES: list[FaultRule] = []
+
+
+def parse(spec_str: str) -> list[FaultRule]:
+    """Parse a fault spec string into rules (raises ValueError on a
+    malformed spec — a typo'd chaos run must not silently run clean)."""
+    out: list[FaultRule] = []
+    for chunk in spec_str.split(";"):
+        chunk = chunk.strip()
+        if not chunk:
+            continue
+        parts = chunk.split(":")
+        if len(parts) < 2:
+            raise ValueError(f"fault rule needs at least site:mode — got {chunk!r}")
+        site, mode = parts[0].strip(), parts[1].strip()
+        if not site:
+            raise ValueError(f"empty site in fault rule {chunk!r}")
+        if mode not in _MODES:
+            raise ValueError(f"unknown fault mode {mode!r} in {chunk!r} (want {_MODES})")
+        rule = FaultRule(site=site, mode=mode)
+        for kv in parts[2:]:
+            key, sep, value = kv.partition("=")
+            key, value = key.strip(), value.strip()
+            if not sep:
+                raise ValueError(f"fault key {kv!r} in {chunk!r} is not key=value")
+            if key == "nth":
+                rule.nth = int(value)
+            elif key == "times":
+                rule.times = float("inf") if value in ("inf", "forever") else int(value)
+            elif key == "delay":
+                rule.delay = float(value)
+            elif key == "latch":
+                rule.latch = value
+            else:
+                raise ValueError(f"unknown fault key {key!r} in {chunk!r}")
+        out.append(rule)
+    return out
+
+
+def install(spec_str: str | None) -> list[FaultRule]:
+    """Install rules programmatically (None/empty clears). Resets hit
+    counters — an install is the start of a new deterministic scenario."""
+    global _RULES
+    with _LOCK:
+        _RULES = parse(spec_str) if spec_str else []
+        return list(_RULES)
+
+
+def refresh() -> list[FaultRule]:
+    """(Re-)read ``ETH_SPECS_FAULT`` from the environment."""
+    return install(os.environ.get("ETH_SPECS_FAULT") or None)
+
+
+refresh()
+
+
+def active() -> bool:
+    return bool(_RULES)
+
+
+def rules() -> list[FaultRule]:
+    return list(_RULES)
+
+
+@contextmanager
+def injected(spec_str: str):
+    """Scoped install for tests; restores the env-derived rules on exit."""
+    install(spec_str)
+    try:
+        yield
+    finally:
+        refresh()
+
+
+def _acquire_latch(path: str) -> bool:
+    try:
+        fd = os.open(path, os.O_CREAT | os.O_EXCL | os.O_WRONLY)
+    except OSError:
+        return False
+    os.close(fd)
+    return True
+
+
+def _count_hit(rule: FaultRule) -> bool:
+    """Bump the rule's hit counter and decide whether this hit fires
+    (window + latch)."""
+    with _LOCK:
+        rule.hits += 1
+        if not rule.in_window():
+            return False
+    # latch probe outside the lock: O_EXCL is itself the atomic arbiter
+    if rule.latch is not None and not _acquire_latch(rule.latch):
+        return False
+    return True
+
+
+def check(site: str, tag: str | None = None) -> None:
+    """Injection point for raise/kill/stall rules. A no-op (one list
+    check) when no rules are installed, so hot paths can call it
+    unconditionally."""
+    if not _RULES:
+        return
+    for rule in _RULES:
+        if rule.mode == "corrupt" or not rule.matches(site):
+            continue
+        if not _count_hit(rule):
+            continue
+        # breadcrumb FIRST: a kill-mode fire must still reach the JSONL sink
+        obs.count("fault.injected", 1)
+        obs.event("fault.injected", site=site, mode=rule.mode, hit=rule.hits, tag=tag or "")
+        if rule.mode == "raise":
+            raise FaultInjected(site, rule.hits)
+        if rule.mode == "stall":
+            time.sleep(rule.delay)
+        elif rule.mode == "kill":
+            os.kill(os.getpid(), signal.SIGKILL)
+
+
+def corrupt(site: str, data: bytes) -> bytes:
+    """Injection point for corrupt-mode rules: returns `data` with one
+    byte flipped when a matching rule fires, `data` unchanged otherwise."""
+    if not _RULES:
+        return data
+    for rule in _RULES:
+        if rule.mode != "corrupt" or not rule.matches(site):
+            continue
+        if not _count_hit(rule):
+            continue
+        obs.count("fault.injected", 1)
+        obs.event("fault.injected", site=site, mode="corrupt", hit=rule.hits, nbytes=len(data))
+        if not data:
+            return b"\xff"
+        i = len(data) // 2
+        return data[:i] + bytes([data[i] ^ 0xFF]) + data[i + 1 :]
+    return data

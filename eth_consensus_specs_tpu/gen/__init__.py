@@ -17,5 +17,13 @@ binding is baked into the image.
 from .dumper import Dumper
 from .gen_from_tests import discover_test_cases
 from .gen_runner import run_generator
+from .manifest import RunManifest, load_manifest, manifest_path
 
-__all__ = ["Dumper", "discover_test_cases", "run_generator"]
+__all__ = [
+    "Dumper",
+    "RunManifest",
+    "discover_test_cases",
+    "load_manifest",
+    "manifest_path",
+    "run_generator",
+]

@@ -6,6 +6,8 @@ from __future__ import annotations
 import argparse
 import json
 
+from eth_consensus_specs_tpu import fault, obs
+
 from .gen_from_tests import discover_test_cases
 from .gen_runner import run_generator
 
@@ -23,7 +25,35 @@ def main() -> None:
         help='process-pool size or "auto" (reference: pathos pool, '
         "gen_base/gen_runner.py:288-302); default sequential",
     )
+    parser.add_argument(
+        "--resume",
+        action="store_true",
+        help="skip cases already durable in the output dir's run manifest "
+        "(gen_manifest.jsonl) from a previous, possibly interrupted run",
+    )
+    parser.add_argument(
+        "--case-timeout",
+        type=float,
+        default=None,
+        help="pool-mode wall-clock deadline per case (seconds); a hung case "
+        "gets its worker killed and is re-dispatched",
+    )
+    parser.add_argument(
+        "--case-retries",
+        type=int,
+        default=1,
+        help="extra attempts for a failed/lost/hung case (default 1)",
+    )
+    parser.add_argument(
+        "--fault",
+        default=None,
+        help="fault-injection spec (overrides ETH_SPECS_FAULT; grammar in "
+        "docs/robustness.md) — chaos/CI use",
+    )
     args = parser.parse_args()
+
+    if args.fault is not None:
+        fault.install(args.fault)
 
     runners = tuple(args.runners) if args.runners else None
     cases = discover_test_cases(
@@ -42,8 +72,22 @@ def main() -> None:
     workers = args.workers
     if workers is not None and workers != "auto":
         workers = int(workers)
-    stats = run_generator(cases, args.output, verbose=args.verbose, workers=workers)
-    print(json.dumps({"cases": len(cases), **stats}))
+    stats = run_generator(
+        cases,
+        args.output,
+        verbose=args.verbose,
+        workers=workers,
+        case_timeout=args.case_timeout,
+        case_retries=args.case_retries,
+        resume=args.resume,
+    )
+    # recovery counters ride along so CI chaos jobs can assert on them
+    counters = {
+        k: v
+        for k, v in obs.snapshot()["counters"].items()
+        if k.startswith(("gen.", "fault."))
+    }
+    print(json.dumps({"cases": len(cases), **stats, "counters": counters}))
 
 
 if __name__ == "__main__":

@@ -8,29 +8,55 @@ A test case's yielded parts land in one case directory:
   * plain values       -> collected into `meta.yaml`
   * `post` = None      -> omitted (the invalid-case convention, reference
                           tests/formats/operations/README.md:24-28)
+
+Crash safety: every part is written into a ``<case_dir>.__tmp<pid>``
+staging dir and each `.ssz_snappy` write is verified by read-back
+(snappy-decode must round-trip to the input bytes — a fault-injected or
+disk-level corruption is caught and retried through fault.retrying
+before it can become a torn vector). The case directory itself is
+committed LAST via `os.replace`, so a SIGKILL at any point leaves either
+no case dir or a complete one — never a partial tree. The per-part
+sha256 digests collected during the write feed the run manifest
+(gen/manifest.py) and the obs `gen.part` events.
 """
 
 from __future__ import annotations
 
 import os
+import shutil
 
 import yaml
 
-from eth_consensus_specs_tpu import obs
+from eth_consensus_specs_tpu import fault, obs
 from eth_consensus_specs_tpu.obs import gates
 from eth_consensus_specs_tpu.ssz import serialize
 from eth_consensus_specs_tpu.ssz.types import View
 
-from .snappy_codec import frame_compress
+from .snappy_codec import frame_compress, frame_decompress
 
 
 def _is_view(value) -> bool:
     return isinstance(value, View)
 
 
+class TornWriteError(OSError):
+    """A written `.ssz_snappy` failed its read-back verification."""
+
+
+# suffix of the stash a committed case dir is moved to during an
+# overwrite commit; manifest.clean_stale_tmp knows to restore it
+OLD_SUFFIX = ".__old"
+
+
 class Dumper:
     def __init__(self, output_dir: str):
         self.output_dir = output_dir
+        self._digests: dict[str, str] = {}
+
+    def pop_digests(self) -> dict[str, str]:
+        """{part name: digest} of the most recent dump_case (consumed)."""
+        digests, self._digests = self._digests, {}
+        return digests
 
     def case_dir(self, case) -> str:
         return os.path.join(
@@ -44,14 +70,41 @@ class Dumper:
         )
 
     def dump_ssz(self, case_dir: str, name: str, encoded: bytes) -> None:
+        digest = gates.digest(encoded)
+        self._digests[name] = digest
         if obs.obs_enabled():
             # fingerprint through the shared gate digest so a cross-generator
             # byte-diff can compare runs from the observability stream alone
             obs.count("gen.parts", 1)
             obs.count("gen.bytes_serialized", len(encoded))
-            obs.event("gen.part", part=name, digest=gates.digest(encoded), nbytes=len(encoded))
-        with open(os.path.join(case_dir, f"{name}.ssz_snappy"), "wb") as f:
-            f.write(frame_compress(encoded))
+            obs.event("gen.part", part=name, digest=digest, nbytes=len(encoded))
+        # writes land inside the staging dir (dump_case), which only
+        # becomes visible via the final atomic commit — no per-file tmp
+        # dance needed, just the read-back verification
+        path = os.path.join(case_dir, f"{name}.ssz_snappy")
+
+        def _write_verified():
+            frame = fault.corrupt("gen.dump_bytes", frame_compress(encoded))
+            with open(path, "wb") as f:
+                f.write(frame)
+            with open(path, "rb") as f:
+                written = f.read()
+            try:
+                intact = frame_decompress(written) == encoded
+            except Exception:
+                intact = False
+            if not intact:
+                os.unlink(path)  # never leave torn bytes behind
+                obs.count("gen.torn_writes", 1)
+                raise TornWriteError(f"read-back mismatch writing {path}")
+
+        fault.retrying(
+            _write_verified,
+            name=f"gen.dump:{name}",
+            attempts=3,
+            retry_on=(TornWriteError, OSError),
+            base_delay=0.01,
+        )
 
     def dump_meta(self, case_dir: str, meta: dict) -> None:
         if not meta:
@@ -60,10 +113,16 @@ class Dumper:
             yaml.safe_dump(meta, f, default_flow_style=None)
 
     def dump_case(self, case, parts) -> str:
-        """Write all (name, value) parts of one executed case; returns the
-        case directory."""
-        case_dir = self.case_dir(case)
-        os.makedirs(case_dir, exist_ok=True)
+        """Write all (name, value) parts of one executed case into a
+        staging dir, then commit the case dir atomically; returns the
+        final case directory."""
+        final_dir = self.case_dir(case)
+        os.makedirs(os.path.dirname(final_dir), exist_ok=True)
+        tmp_dir = final_dir + f".__tmp{os.getpid()}"
+        if os.path.isdir(tmp_dir):
+            shutil.rmtree(tmp_dir)
+        os.makedirs(tmp_dir)
+        self._digests = {}
         meta: dict = {}
         for name, value in parts:
             if value is None:
@@ -72,23 +131,38 @@ class Dumper:
                 # standalone yaml part (the bls/shuffling/ssz_generic
                 # format families dump `data.yaml` per case, reference
                 # tests/formats/{bls,shuffling}/README.md)
-                with open(os.path.join(case_dir, name), "w") as f:
+                with open(os.path.join(tmp_dir, name), "w") as f:
                     yaml.safe_dump(_yamlable(value), f, default_flow_style=None)
                 continue
             if _is_view(value):
-                self.dump_ssz(case_dir, name, serialize(value))
+                self.dump_ssz(tmp_dir, name, serialize(value))
             elif isinstance(value, (bytes, bytearray)):
-                self.dump_ssz(case_dir, name, bytes(value))
+                self.dump_ssz(tmp_dir, name, bytes(value))
             elif isinstance(value, (list, tuple)) and (not value or _is_view(value[0])):
                 # view lists (incl. empty: the zero-block sanity convention
                 # still needs `<name>_count: 0` in meta)
                 meta[f"{name}_count"] = len(value)
                 for i, item in enumerate(value):
-                    self.dump_ssz(case_dir, f"{name}_{i}", serialize(item))
+                    self.dump_ssz(tmp_dir, f"{name}_{i}", serialize(item))
             else:
                 meta[name] = _yamlable(value)
-        self.dump_meta(case_dir, meta)
-        return case_dir
+        self.dump_meta(tmp_dir, meta)
+        # commit LAST: the case dir appears fully-formed or not at all.
+        # Overwrites move the old dir aside FIRST (atomic rename) so a
+        # committed case is never destroyed before its replacement is in
+        # place; a kill between the two renames leaves the stash, which
+        # clean_stale_tmp RESTORES (not deletes) when the final dir is
+        # missing — a durable vector can only be superseded, never lost
+        old_dir = None
+        if os.path.isdir(final_dir):
+            old_dir = final_dir + OLD_SUFFIX
+            if os.path.isdir(old_dir):
+                shutil.rmtree(old_dir)
+            os.replace(final_dir, old_dir)
+        os.replace(tmp_dir, final_dir)
+        if old_dir is not None:
+            shutil.rmtree(old_dir, ignore_errors=True)
+        return final_dir
 
 
 def _yamlable(value):

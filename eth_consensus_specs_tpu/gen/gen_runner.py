@@ -4,12 +4,25 @@ gen_base/gen_runner.py:113-320).
 Two modes:
 
 * sequential (default) — simple, in-process;
-* process pool (``workers=N`` or ``"auto"``) — mirrors the reference's
-  pathos pool with ``maxtasksperchild`` recycling, live progress and
-  per-worker RSS telemetry (reference gen_runner.py:183-302). Cases are
-  addressed by coordinate key and re-discovered inside each worker (the
-  case closures themselves don't pickle, exactly why the reference uses
-  a dill-based pool; re-discovery is one import pass per worker)."""
+* process pool (``workers=N`` or ``"auto"``) — a crash-safe pool built
+  on raw worker processes with per-worker task queues and async result
+  collection. Unlike the reference's pathos pool (which loses in-flight
+  work when a worker hard-crashes), the parent here runs a deadline
+  sweep: a SIGKILLed/OOM-killed worker is detected via its exitcode, a
+  case that blows its wall-clock deadline gets its worker killed, and in
+  both paths the lost case is re-dispatched up to a retry budget while a
+  replacement worker spawns. Cases are addressed by coordinate key and
+  re-discovered inside each worker (the case closures themselves don't
+  pickle, exactly why the reference uses a dill-based pool; re-discovery
+  is one import pass per worker).
+
+Durability: the parent appends every completed case (key + part
+digests) to a JSONL run manifest (gen/manifest.py) AFTER its case dir
+is atomically committed by the dumper, so ``resume=True`` (CLI
+``--resume``) skips already-durable cases and a re-run after a crash
+regenerates only what is missing. Fault-injection sites (``gen.case``,
+``gen.dump_bytes`` — see fault/) make all of this rehearsable in tests.
+"""
 
 from __future__ import annotations
 
@@ -17,11 +30,23 @@ import os
 import sys
 import time
 import traceback
+from collections import deque
 
-from eth_consensus_specs_tpu import obs
+from eth_consensus_specs_tpu import fault, obs
 
 from .dumper import Dumper
 from .gen_from_tests import TestCase
+from .manifest import RunManifest
+
+# workers recycle after this many cases (the reference's maxtasksperchild
+# leak guard, gen_runner.py:288)
+_MAX_TASKS_PER_WORKER = 100
+
+# extra deadline slack for a case dispatched to a worker that is still
+# starting up (_pool_init's discovery pass + first-call compiles must not
+# count against the case's own wall-clock budget); the deadline tightens
+# to `case_timeout` when the worker's "started" message arrives
+_STARTUP_GRACE_S = 120.0
 
 
 class SkippedCase(Exception):
@@ -33,6 +58,8 @@ def execute_case(case: TestCase, dumper: Dumper) -> str | None:
     dir, or None if the case was skipped."""
     from eth_consensus_specs_tpu.test_infra.context import SkippedTest
 
+    fault.check("gen.case", tag=f"{case.runner}/{case.handler}/{case.case_name}")
+    dumper.pop_digests()  # drop stale digests a mid-dump failure left behind
     with obs.span("gen.case", runner=case.runner, handler=case.handler):
         try:
             gen = case.case_fn()
@@ -71,23 +98,78 @@ def _snapshot(value):
 
 
 def run_generator(
-    cases, output_dir: str, verbose: bool = False, workers: int | str | None = None
+    cases,
+    output_dir: str,
+    verbose: bool = False,
+    workers: int | str | None = None,
+    *,
+    case_timeout: float | None = None,
+    case_retries: int = 1,
+    resume: bool = False,
 ) -> dict:
-    """Execute all cases; returns {written, skipped, failed} counts.
+    """Execute all cases; returns {written, skipped, failed, resumed}.
 
-    ``workers``: None/0/1 = sequential; an int or "auto" = process pool."""
-    if workers in (None, 0, 1):
-        return _run_sequential(cases, output_dir, verbose)
-    n_workers = os.cpu_count() - 1 if workers == "auto" else int(workers)
-    return _run_pool(cases, output_dir, verbose, max(n_workers, 1))
+    ``workers``: None/0/1 = sequential; an int or "auto" = process pool.
+    ``case_timeout``: pool-mode wall-clock deadline per case (seconds);
+    a case past it gets its worker killed and is re-dispatched.
+    ``case_retries``: extra attempts for a failed/lost/hung case.
+    ``resume``: skip cases already recorded in the output dir's run
+    manifest (gen/manifest.py) from a previous, possibly interrupted run."""
+    cases = list(cases)
+    case_retries = max(case_retries, 0)
+    manifest = RunManifest(output_dir, resume=resume)
+    if resume:
+        pending_cases = [c for c in cases if case_key(c) not in manifest.completed]
+    else:
+        pending_cases = cases
+    resumed = len(cases) - len(pending_cases)
+    if resumed:
+        obs.count("gen.cases_resumed", resumed)
+        obs.event("gen.resume", resumed=resumed, pending=len(pending_cases))
+    try:
+        if workers in (None, 0, 1):
+            stats = _run_sequential(pending_cases, output_dir, verbose, case_retries, manifest)
+        else:
+            # os.cpu_count() may return None (unknown topology): default to
+            # one worker rather than crashing on None - 1
+            n_workers = ((os.cpu_count() or 2) - 1) if workers == "auto" else int(workers)
+            stats = _run_pool(
+                pending_cases,
+                output_dir,
+                verbose,
+                max(n_workers, 1),
+                case_timeout,
+                case_retries,
+                manifest,
+            )
+    finally:
+        manifest.close()
+        # a worker killed mid-dump leaves an uncommitted staging dir; the
+        # final tree must hold only fully-committed case dirs
+        from .manifest import clean_stale_tmp
+
+        clean_stale_tmp(output_dir)
+    stats["resumed"] = resumed
+    return stats
 
 
-def _run_sequential(cases, output_dir: str, verbose: bool) -> dict:
+def _run_sequential(
+    cases, output_dir: str, verbose: bool, case_retries: int, manifest: RunManifest
+) -> dict:
     dumper = Dumper(output_dir)
     written = skipped = failed = 0
     for case in cases:
+        attempts_used = 0
+
+        def _attempt(case=case):
+            nonlocal attempts_used
+            attempts_used += 1
+            return execute_case(case, dumper)
+
         try:
-            out = execute_case(case, dumper)
+            out = fault.retrying(
+                _attempt, name="gen.case_retry", attempts=case_retries + 1, base_delay=0.02
+            )
         except Exception:
             failed += 1
             obs.count("gen.cases_failed", 1)
@@ -96,10 +178,17 @@ def _run_sequential(cases, output_dir: str, verbose: bool) -> dict:
                       file=sys.stderr)
                 traceback.print_exc()
             continue
+        if attempts_used > 1:
+            obs.count("gen.cases_retried", 1)
+        digests = dumper.pop_digests()
         if out is None:
             skipped += 1
+            manifest.record(case_key(case), "skipped", {})
         else:
             written += 1
+            manifest.record(
+                case_key(case), "written", digests, os.path.relpath(out, output_dir)
+            )
             if verbose:
                 print(f"[gen] wrote {out}", file=sys.stderr)
     return {"written": written, "skipped": skipped, "failed": failed}
@@ -151,64 +240,307 @@ def _worker_obs_delta() -> dict:
 
 
 def _pool_exec(key: tuple) -> tuple:
-    """Run one case in the worker; returns (key, status, rss_mb, obs_delta)."""
+    """Run one case in the worker; returns
+    (key, status, rss_mb, obs_delta, part_digests, case_dir|None)."""
     import resource
 
     case = _WORKER_CASES.get(key)
     rss = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss // 1024
     if case is None:
-        return key, "failed", rss, _worker_obs_delta()
+        return key, "failed", rss, _worker_obs_delta(), {}, None
     try:
         out = execute_case(case, _WORKER_DUMPER)
     except Exception:
         traceback.print_exc()
-        return key, "failed", rss, _worker_obs_delta()
-    return key, ("written" if out is not None else "skipped"), rss, _worker_obs_delta()
+        return key, "failed", rss, _worker_obs_delta(), {}, None
+    digests = _WORKER_DUMPER.pop_digests()
+    status = "written" if out is not None else "skipped"
+    return key, status, rss, _worker_obs_delta(), digests, out
 
 
-def _run_pool(cases, output_dir: str, verbose: bool, n_workers: int) -> dict:
-    """Process-parallel execution with progress + RSS telemetry. Workers
-    recycle after 100 cases (the reference's maxtasksperchild leak guard,
-    gen_runner.py:288)."""
+def _worker_main(task_q, result_q, output_dir: str, presets: tuple, forks: tuple, package: str):
+    """Crash-safe pool worker loop: serve case keys one at a time until
+    the sentinel or the recycling point."""
+    _pool_init(output_dir, presets, forks, package)
+    # swallow counters inherited from the parent across fork: the first
+    # shipped delta must cover THIS worker's work only
+    _worker_obs_delta()
+    done = 0
+    while True:
+        key = task_q.get()
+        if key is None:
+            break
+        try:
+            # the case's wall clock starts HERE, not at dispatch: init and
+            # queue latency must not eat the case's deadline budget
+            result_q.put(("started", os.getpid(), key))
+        except Exception:
+            break
+        try:
+            res = _pool_exec(key)
+        except BaseException:
+            # _pool_exec already catches case errors; this guards the
+            # machinery itself — report and keep serving
+            traceback.print_exc()
+            res = (key, "failed", 0, {}, {}, None)
+        try:
+            result_q.put(("done", os.getpid(), res))
+        except Exception:
+            break
+        done += 1
+        if done >= _MAX_TASKS_PER_WORKER:
+            result_q.put(("recycle", os.getpid(), None))
+            break
+
+
+class _Worker:
+    __slots__ = ("proc", "task_q", "res_q", "busy_key", "deadline", "dead_since")
+
+    def __init__(self, proc, task_q, res_q):
+        self.proc = proc
+        self.task_q = task_q
+        self.res_q = res_q
+        self.busy_key = None
+        self.deadline = None
+        self.dead_since = None
+
+
+def _run_pool(
+    cases,
+    output_dir: str,
+    verbose: bool,
+    n_workers: int,
+    case_timeout: float | None,
+    case_retries: int,
+    manifest: RunManifest,
+) -> dict:
+    """Process-parallel execution with crash/hang recovery, progress and
+    RSS telemetry. The parent collects results asynchronously and sweeps
+    for dead (exitcode != 0) and hung (past `case_timeout`) workers;
+    their in-flight case re-dispatches up to `case_retries` times."""
     import multiprocessing as mp
+    from queue import Empty
 
     presets = tuple(sorted({c.preset for c in cases}))
     forks = tuple(sorted({c.fork for c in cases}))
     ctx = mp.get_context("fork")
     counts = {"written": 0, "skipped": 0, "failed": 0}
-    keys = [case_key(c) for c in cases]
+    # dedup while preserving order: the resolved SET is compared against
+    # len(keys), so a duplicate key could otherwise never terminate
+    keys = list(dict.fromkeys(case_key(c) for c in cases))
+    pending: deque = deque(keys)
+    attempts: dict[tuple, int] = dict.fromkeys(keys, 0)
+    resolved: set[tuple] = set()
+    workers: dict[int, _Worker] = {}
     t0 = time.monotonic()
     last_print = 0.0
     max_rss = 0
-    with ctx.Pool(
-        processes=n_workers,
-        initializer=_pool_init,
-        initargs=(output_dir, presets, forks, "tests"),
-        maxtasksperchild=100,
-    ) as pool:
-        for i, (key, status, rss, obs_delta) in enumerate(
-            pool.imap_unordered(_pool_exec, keys, chunksize=4), start=1
-        ):
-            counts[status] += 1
-            max_rss = max(max_rss, rss)
-            for cname, n in obs_delta.items():
-                obs.count(cname, n)
-            if status == "failed" and verbose:
+    replaced = retried = timeouts = 0
+    # circuit breaker: worker losses with NO completed case in between.
+    # A systemic startup failure (broken import in the discovery pass,
+    # fork-time resource exhaustion) would otherwise respawn forever.
+    losses_since_progress = 0
+    max_consecutive_losses = max(3 * n_workers, 6)
+
+    def spawn():
+        # one PRIVATE result queue per worker: a worker killed mid-write
+        # can desync a queue's byte stream permanently, and on a shared
+        # queue that would poison every other worker's results too
+        task_q = ctx.Queue()
+        res_q = ctx.Queue()
+        proc = ctx.Process(
+            target=_worker_main,
+            args=(task_q, res_q, output_dir, presets, forks, "tests"),
+            daemon=True,
+        )
+        fault.retrying(proc.start, name="gen.worker_spawn", attempts=3)
+        workers[proc.pid] = _Worker(proc, task_q, res_q)
+
+    def requeue_or_fail(key: tuple):
+        nonlocal retried
+        attempts[key] += 1
+        if attempts[key] <= case_retries:
+            retried += 1
+            obs.count("gen.cases_retried", 1)
+            pending.appendleft(key)
+        else:
+            resolved.add(key)
+            counts["failed"] += 1
+            if verbose:
                 print(f"[gen] FAILED {'/'.join(map(str, key))}", file=sys.stderr)
+
+    for _ in range(min(n_workers, len(pending))):
+        spawn()
+
+    try:
+        while len(resolved) < len(keys):
+            # 1. dispatch: one in-flight case per idle LIVE worker (a dead
+            # worker would charge the case a retry attempt it never used)
+            for w in workers.values():
+                if w.busy_key is not None or not w.proc.is_alive():
+                    continue
+                while pending and pending[0] in resolved:
+                    pending.popleft()  # late duplicate of a re-dispatched case
+                if not pending:
+                    break
+                key = pending.popleft()
+                w.task_q.put(key)
+                w.busy_key = key
+                w.deadline = (
+                    time.monotonic() + case_timeout + _STARTUP_GRACE_S
+                    if case_timeout
+                    else None
+                )
+            # 2. collect: drain every worker's private result queue — a
+            # dead worker's already-delivered result must resolve its case
+            # before the sweep below can requeue (and re-run) it, and a
+            # torn stream from a mid-write kill only ever loses that
+            # worker's own messages
+            got_any = False
+            for pid, w in list(workers.items()):
+                while True:
+                    try:
+                        msg, _pid, payload = w.res_q.get_nowait()
+                    except Empty:
+                        break
+                    except Exception:
+                        # truncated pickle frame from a killed writer
+                        # (UnpicklingError/EOFError/OSError): the stream is
+                        # dead; the sweep re-dispatches its in-flight case
+                        obs.count("gen.result_stream_errors", 1)
+                        break
+                    got_any = True
+                    if msg == "started":
+                        # the worker began executing: tighten the dispatch-
+                        # time deadline (startup grace) to the case's budget
+                        if w.busy_key == payload and case_timeout:
+                            w.deadline = time.monotonic() + case_timeout
+                    elif msg == "done":
+                        key, status, rss, obs_delta, digests, case_dir = payload
+                        if w.busy_key == key:
+                            w.busy_key = None
+                            w.deadline = None
+                        losses_since_progress = 0
+                        max_rss = max(max_rss, rss)
+                        for cname, nv in obs_delta.items():
+                            obs.count(cname, nv)
+                        if key in resolved:
+                            pass  # late duplicate of a re-dispatched case
+                        elif status == "failed":
+                            requeue_or_fail(key)
+                        else:
+                            resolved.add(key)
+                            counts[status] += 1
+                            rel = (
+                                os.path.relpath(case_dir, output_dir)
+                                if case_dir
+                                else None
+                            )
+                            manifest.record(key, status, digests, rel)
+                    elif msg == "recycle":
+                        workers.pop(pid, None)
+                        w.proc.join(timeout=10)
+                        obs.count("gen.workers_recycled", 1)
+                        if w.busy_key is not None and w.busy_key not in resolved:
+                            # dispatched between the worker's last result and
+                            # its recycle notice: the case never ran — requeue
+                            pending.appendleft(w.busy_key)
+                        if len(resolved) < len(keys):
+                            spawn()
+                        break  # worker gone; nothing more on its queue
+            if not got_any:
+                time.sleep(0.05)
+            # 3. sweep: dead workers (crash/OOM/SIGKILL) and hung cases
             now = time.monotonic()
-            if verbose and (now - last_print > 2 or i == len(keys)):
+            for pid, w in list(workers.items()):
+                alive = w.proc.is_alive()
+                hung = (
+                    alive
+                    and w.busy_key is not None
+                    and w.deadline is not None
+                    and now > w.deadline
+                )
+                if alive and not hung:
+                    continue
+                if hung:
+                    timeouts += 1
+                    obs.count("gen.cases_timeout", 1)
+                    obs.event(
+                        "gen.case_timeout",
+                        case="/".join(map(str, w.busy_key)),
+                        timeout_s=case_timeout,
+                    )
+                    w.proc.kill()
+                elif w.proc.exitcode == 0:
+                    # clean exit: give its recycle message a grace window to
+                    # arrive; past that, treat it as lost (a worker that died
+                    # after a failed result_q.put must not hang the run)
+                    if w.dead_since is None:
+                        w.dead_since = now
+                        continue
+                    if now - w.dead_since < 5.0:
+                        continue
+                w.proc.join(timeout=10)
+                workers.pop(pid)
+                replaced += 1
+                losses_since_progress += 1
+                obs.count("gen.workers_replaced", 1)
+                obs.event(
+                    "gen.worker_lost",
+                    exitcode=w.proc.exitcode,
+                    case="/".join(map(str, w.busy_key or ())),
+                    hung=hung,
+                )
+                if w.busy_key is not None and w.busy_key not in resolved:
+                    requeue_or_fail(w.busy_key)
+                if losses_since_progress > max_consecutive_losses:
+                    # systemic failure (every replacement dies before
+                    # completing anything): abort loudly instead of
+                    # respawning forever
+                    obs.event(
+                        "gen.pool_aborted", consecutive_losses=losses_since_progress
+                    )
+                    raise RuntimeError(
+                        f"generation pool aborted: {losses_since_progress} worker "
+                        "losses without a completed case — workers are failing "
+                        "systematically (startup/import error or resource "
+                        "exhaustion), see stderr for worker tracebacks"
+                    )
+                if len(resolved) < len(keys):
+                    spawn()
+            if verbose and (now - last_print > 2):
                 last_print = now
-                rate = i / max(now - t0, 1e-9)
+                done_n = len(resolved)
+                rate = done_n / max(now - t0, 1e-9)
                 print(
-                    f"[gen] {i}/{len(keys)} ({rate:.1f} case/s, "
+                    f"[gen] {done_n}/{len(keys)} ({rate:.1f} case/s, "
                     f"worker rss {max_rss} MB, "
                     f"w={counts['written']} s={counts['skipped']} f={counts['failed']})",
                     file=sys.stderr,
                 )
+    finally:
+        for w in workers.values():
+            try:
+                w.task_q.put(None)
+            except Exception:
+                pass
+        for w in workers.values():
+            w.proc.join(timeout=5)
+            if w.proc.is_alive():
+                w.proc.kill()
+                w.proc.join(timeout=5)
     # dumper counters were shipped per-result above; per-part digest
     # events reach the shared JSONL sink directly from each worker.
     # gen.cases_* mirror the parent's authoritative status counts.
-    for status, n in counts.items():
-        obs.count(f"gen.cases_{status}", n)
-    obs.event("gen.pool_summary", workers=n_workers, max_rss_mb=max_rss, **counts)
+    for status, nv in counts.items():
+        obs.count(f"gen.cases_{status}", nv)
+    obs.event(
+        "gen.pool_summary",
+        workers=n_workers,
+        max_rss_mb=max_rss,
+        replaced=replaced,
+        retried=retried,
+        timeouts=timeouts,
+        **counts,
+    )
     return counts

@@ -1,0 +1,116 @@
+"""Durable run manifest for the vector generator.
+
+One JSONL line per COMPLETED case (written or skipped), appended by the
+parent only after the case directory is durably committed (atomic
+rename, see dumper.py) — so `--resume` can trust every entry::
+
+    {"key": [preset, fork, runner, handler, case_name],
+     "status": "written" | "skipped",
+     "dir": "<case dir relative to output_dir>" | null,
+     "parts": {"<part name>": "<sha256[:32] of the raw SSZ bytes>"}}
+
+The part digests are the same fingerprints the obs `gen.part` events
+carry (obs/gates.digest), which is what lets CI byte-diff a
+fault-injected run against a clean one from the manifests alone. A
+crash mid-append leaves at most one torn tail line; `load_manifest`
+skips unparseable lines, which only means the interrupted case is
+regenerated on resume — never that a torn entry is trusted.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shutil
+
+from eth_consensus_specs_tpu import fault, obs
+
+MANIFEST_NAME = "gen_manifest.jsonl"
+
+
+def manifest_path(output_dir: str) -> str:
+    return os.path.join(output_dir, MANIFEST_NAME)
+
+
+def load_manifest(path: str) -> dict[tuple, dict]:
+    """{case key tuple: record} of every well-formed line (later lines
+    win, matching append order)."""
+    out: dict[tuple, dict] = {}
+    if not os.path.exists(path):
+        return out
+    with open(path) as fh:
+        for line in fh:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+                key = tuple(rec["key"])
+            except (json.JSONDecodeError, KeyError, TypeError):
+                continue  # torn tail line from a crash mid-append
+            out[key] = rec
+    return out
+
+
+def clean_stale_tmp(output_dir: str) -> int:
+    """Remove uncommitted ``*.__tmp*`` staging dirs a killed worker left
+    behind (never renamed into place — nothing durable is touched), and
+    handle ``*.__old`` overwrite stashes: RESTORED when the final dir is
+    missing (the writer died between its two commit renames — the stash
+    is the only surviving copy of a durable vector), deleted otherwise."""
+    from .dumper import OLD_SUFFIX
+
+    removed = restored = 0
+    for root, dirs, _files in os.walk(output_dir):
+        for d in list(dirs):
+            path = os.path.join(root, d)
+            if d.endswith(OLD_SUFFIX):
+                target = path[: -len(OLD_SUFFIX)]
+                if not os.path.isdir(target):
+                    os.replace(path, target)
+                    restored += 1
+                else:
+                    shutil.rmtree(path, ignore_errors=True)
+                    removed += 1
+                dirs.remove(d)
+            elif ".__tmp" in d:
+                shutil.rmtree(path, ignore_errors=True)
+                dirs.remove(d)
+                removed += 1
+    if removed or restored:
+        obs.event("gen.tmp_cleaned", dirs=removed, restored=restored)
+    return removed
+
+
+class RunManifest:
+    """Append-side handle held by the generation parent process."""
+
+    def __init__(self, output_dir: str, resume: bool = False):
+        os.makedirs(output_dir, exist_ok=True)
+        self.output_dir = output_dir
+        self.path = manifest_path(output_dir)
+        self.completed: dict[tuple, dict] = {}
+        if resume:
+            self.completed = load_manifest(self.path)
+            clean_stale_tmp(output_dir)
+        # non-resume runs start a fresh manifest: stale entries from an
+        # older tree must not leak into a later --resume
+        self._fh = open(self.path, "a" if resume else "w")
+
+    def record(self, key: tuple, status: str, digests: dict, rel_dir: str | None = None):
+        rec = {"key": list(key), "status": status, "dir": rel_dir, "parts": digests}
+        line = json.dumps(rec, sort_keys=True) + "\n"
+
+        def _append():
+            self._fh.write(line)
+            self._fh.flush()
+            os.fsync(self._fh.fileno())
+
+        fault.retrying(_append, name="gen.manifest_append", attempts=3, retry_on=OSError)
+        self.completed[tuple(key)] = rec
+
+    def close(self):
+        try:
+            self._fh.close()
+        except OSError:
+            pass

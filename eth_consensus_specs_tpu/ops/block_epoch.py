@@ -51,7 +51,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-from eth_consensus_specs_tpu import obs
+from eth_consensus_specs_tpu import fault, obs
 
 U64 = jnp.uint64
 
@@ -377,13 +377,26 @@ def block_epoch_chain(
         from eth_consensus_specs_tpu.ops.state_root import slot_root_real_hashes
 
         work_bytes += slots * 96 * slot_root_real_hashes(n, root_ctx.top_depth)
-    with obs.span(
-        "block_epoch.chain", work_bytes=work_bytes, n_validators=n, slots=slots
-    ) as sp:
-        out = _block_epoch_chain_impl(
+    def _device():
+        fault.check("block_epoch.device")
+        with obs.span(
+            "block_epoch.chain", work_bytes=work_bytes, n_validators=n, slots=slots
+        ) as sp:
+            out = _block_epoch_chain_impl(
+                params, n, st, blocks, static, root_ctx, with_withdrawals
+            )
+            sp.result = out
+        return out
+
+    # device-side death (compile/OOM/injected) degrades to the numpy
+    # replay + native-sha slot roots (ops/block_epoch_host.py)
+    out = fault.degrade(
+        "block_epoch.device",
+        _device,
+        lambda: _block_epoch_chain_host(
             params, n, st, blocks, static, root_ctx, with_withdrawals
-        )
-        sp.result = out
+        ),
+    )
     obs.count("block_epoch.epochs", 1)
     obs.count("block_epoch.slots", slots)
     obs.count("block_epoch.validator_slots", n * slots)
@@ -424,6 +437,48 @@ def _block_epoch_chain_impl(
     slot0 = static.epoch * U64(params.slots_per_epoch) + U64(1)
     (st, acc, _), _ = lax.scan(slot_step, (st, acc0, slot0), blocks)
     return st, acc
+
+
+def _block_epoch_chain_host(
+    params: BlockEpochParams,
+    n: int,
+    st: BlockState,
+    blocks: BlockColumns,
+    static: BlockEpochStatic,
+    root_ctx,
+    with_withdrawals: bool,
+):
+    """fault.degrade fallback for block_epoch_chain: the sequential numpy
+    replay + native-sha slot roots (ops/block_epoch_host.py) — the same
+    independent leg the bench correctness coupling uses, repackaged into
+    the kernel's (BlockState, root_acc) contract."""
+    from eth_consensus_specs_tpu.ops.block_epoch_host import (
+        replay_block_epoch_np,
+        slot_root_fn_from_ctx,
+    )
+
+    root_fn = slot_root_fn_from_ctx(root_ctx) if root_ctx is not None else None
+    with obs.span("block_epoch.chain_host", n_validators=n):
+        bal, cur, prev, wd_index, wd_validator, acc = replay_block_epoch_np(
+            params,
+            n,
+            st,
+            blocks,
+            np.asarray(static.eff_balance),
+            np.asarray(static.withdrawable_epoch),
+            np.asarray(static.has_eth1_cred),
+            int(np.asarray(static.epoch)),
+            with_withdrawals=with_withdrawals,
+            root_fn=root_fn,
+        )
+    new_st = BlockState(
+        balance=jnp.asarray(bal),
+        cur_part=jnp.asarray(cur),
+        prev_part=jnp.asarray(prev),
+        next_wd_index=U64(wd_index),
+        next_wd_validator=U64(wd_validator),
+    )
+    return new_st, jnp.asarray(acc)
 
 
 # ------------------------------------------------------- per-slot rooting --

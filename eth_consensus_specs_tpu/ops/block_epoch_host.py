@@ -173,6 +173,45 @@ def replay_block_epoch_np(
     return bal, cur, prev, wd_index, wd_validator, acc
 
 
+def slot_root_fn_from_ctx(ctx):
+    """Host slot-root fn straight from a device SlotRootCtx
+    (block_epoch.make_root_ctx output): the per-epoch-constant top chunks
+    are already filled on the ctx, so the host leg only re-reduces the
+    dirty columns — this is fault.degrade's fallback, which must work
+    from exactly the inputs the device path had."""
+    from eth_consensus_specs_tpu.ops.state_root import (
+        BALANCE_LIMIT_CHUNKS_LOG2,
+        PARTICIPATION_LIMIT_CHUNKS_LOG2,
+    )
+    from eth_consensus_specs_tpu.ops.state_root_host import (
+        tree_root_np,
+        u8_list_root_np,
+        u64_chunk_words_np,
+        u64_list_root_np,
+        zerohash_words,
+    )
+
+    n = ctx.n
+    zh = zerohash_words(41)
+    chunks = np.array(np.asarray(ctx.top_chunks), np.uint32, copy=True)
+
+    def root_fn(bal, cur, prev, slot_no):
+        c = chunks.copy()
+        c[ctx.slot_field_index] = u64_chunk_words_np(int(slot_no))
+        c[ctx.balances_slot] = u64_list_root_np(
+            np.asarray(bal), n, BALANCE_LIMIT_CHUNKS_LOG2, zh
+        )
+        c[ctx.cur_part_slot] = u8_list_root_np(
+            np.asarray(cur), n, PARTICIPATION_LIMIT_CHUNKS_LOG2, zh
+        )
+        c[ctx.prev_part_slot] = u8_list_root_np(
+            np.asarray(prev), n, PARTICIPATION_LIMIT_CHUNKS_LOG2, zh
+        )
+        return tree_root_np(c, ctx.top_depth)
+
+    return root_fn
+
+
 def slot_root_fn_np(spec, arrays, meta, static, scores, just):
     """Host mirror of block_epoch.make_root_ctx + _slot_root: fill the
     per-epoch-constant top chunks once, then per-slot reduce only the

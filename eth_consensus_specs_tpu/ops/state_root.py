@@ -38,7 +38,7 @@ import eth_consensus_specs_tpu  # noqa: F401
 import jax.numpy as jnp
 from jax import lax
 
-from eth_consensus_specs_tpu import obs
+from eth_consensus_specs_tpu import fault, obs
 from eth_consensus_specs_tpu.ops.merkle import tree_root_words
 from eth_consensus_specs_tpu.ops.sha256 import sha256_pair_words
 
@@ -426,15 +426,57 @@ def post_epoch_state_root(
             arrays, meta, balances, effective_balance, inactivity_scores, just
         )
     real = state_root_real_hashes(meta)
-    with obs.span(
-        "state_root.post_epoch", work_bytes=96 * real, n_validators=meta.n_validators
-    ) as sp:
-        sp.result = out = _post_epoch_state_root_impl(
+
+    def _device():
+        fault.check("state_root.device")
+        with obs.span(
+            "state_root.post_epoch", work_bytes=96 * real, n_validators=meta.n_validators
+        ) as sp:
+            sp.result = out = _post_epoch_state_root_impl(
+                arrays, meta, balances, effective_balance, inactivity_scores, just
+            )
+        return out
+
+    # device-side death (compile/OOM/injected) degrades to the host
+    # oracle: the run completes slower rather than not at all
+    out = fault.degrade(
+        "state_root.device",
+        _device,
+        lambda: _post_epoch_state_root_host(
             arrays, meta, balances, effective_balance, inactivity_scores, just
-        )
+        ),
+    )
     obs.count("state_root.roots", 1)
     obs.count("state_root.real_hashes", real)
     return out
+
+
+def _post_epoch_state_root_host(
+    arrays: StateRootArrays,
+    meta: StateRootMeta,
+    balances,
+    effective_balance,
+    inactivity_scores,
+    just,
+) -> jnp.ndarray:
+    """fault.degrade fallback: the SAME tree through the host oracle's
+    native-sha path (ops/state_root_host.py) — no XLA anywhere."""
+    import jax
+
+    from eth_consensus_specs_tpu.ops.state_root_host import post_epoch_state_root_np
+
+    arrays_np = jax.tree_util.tree_map(np.asarray, arrays)
+    just_np = jax.tree_util.tree_map(np.asarray, just)
+    with obs.span("state_root.post_epoch_host", n_validators=meta.n_validators):
+        out = post_epoch_state_root_np(
+            arrays_np,
+            meta,
+            np.asarray(balances),
+            np.asarray(effective_balance),
+            np.asarray(inactivity_scores),
+            just_np,
+        )
+    return jnp.asarray(out)
 
 
 def _post_epoch_state_root_impl(

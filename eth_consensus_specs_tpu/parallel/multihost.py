@@ -81,7 +81,12 @@ def _initialize_distributed(
             try:
                 jax.distributed.initialize()
                 _initialized = True
-            except Exception:
+            except Exception as exc:
+                # autodetection failing on a pod slice is a real operational
+                # signal (mis-set env, dead coordinator) — leave a breadcrumb
+                # instead of degrading to single-process silently
+                obs.count("multihost.init_failures", 1)
+                obs.event("multihost.init_failed", error=repr(exc)[:200])
                 return False
         return jax.process_count() > 1
     jax.distributed.initialize(
@@ -134,5 +139,15 @@ def host_local_slice(mesh: Mesh, n_global: int) -> tuple[int, int]:
     local_ids = {
         i for i, d in enumerate(mesh.devices.flat) if d.process_index == jax.process_index()
     }
+    if not local_ids:
+        # a process can legitimately own no devices of this mesh (e.g. a
+        # coordinator-only host, or a mesh built from a device subset):
+        # its addressable block is empty, not a min()-over-nothing crash
+        obs.event(
+            "multihost.no_local_devices",
+            process=jax.process_index(),
+            mesh_devices=int(mesh.devices.size),
+        )
+        return 0, 0
     lo, hi = min(local_ids), max(local_ids)
     return lo * per, (hi + 1) * per

@@ -1,0 +1,99 @@
+"""CI chaos smoke for the fault-tolerant generator (checks.yml `chaos-gen`).
+
+Runs a small pool generation twice — once clean, once with a worker
+SIGKILL and a stall-past-deadline injected (ETH_SPECS_FAULT) — and
+asserts the recovery contract:
+
+  * written == the clean run's written count (nothing silently lost);
+  * gen.workers_replaced > 0 (the kill actually happened and was healed);
+  * fault-injected part digests == clean part digests (byte-identical
+    vectors, from the run manifests alone);
+  * zero torn files: every emitted `.ssz_snappy` snappy-decodes.
+
+Exit code 0 on success; prints a one-line JSON summary.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import tempfile
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def run_gen(out_dir: str, fault_spec: str, extra_args: tuple = ()) -> dict:
+    env = dict(os.environ, ETH_SPECS_FAULT=fault_spec, JAX_PLATFORMS="cpu")
+    cmd = [
+        sys.executable, "-m", "eth_consensus_specs_tpu.gen",
+        "--output", out_dir,
+        "--presets", "minimal", "--forks", "phase0", "--runners", "operations",
+        "--workers", "2",
+        *extra_args,
+    ]
+    proc = subprocess.run(cmd, env=env, capture_output=True, text=True, timeout=1800, cwd=REPO)
+    if proc.returncode != 0:
+        print(proc.stdout)
+        print(proc.stderr, file=sys.stderr)
+        raise SystemExit(f"generator exited rc={proc.returncode}")
+    return json.loads(proc.stdout.strip().splitlines()[-1])
+
+
+def main() -> None:
+    sys.path.insert(0, REPO)
+    from eth_consensus_specs_tpu.gen.manifest import load_manifest, manifest_path
+    from eth_consensus_specs_tpu.gen.snappy_codec import frame_decompress
+
+    base = tempfile.mkdtemp(prefix="chaos_gen_")
+    clean_dir = os.path.join(base, "clean")
+    chaos_dir = os.path.join(base, "chaos")
+
+    clean = run_gen(clean_dir, "")
+    assert clean["failed"] == 0, f"clean run failed cases: {clean}"
+    assert clean["written"] > 0, f"clean run wrote nothing: {clean}"
+
+    kill_latch = os.path.join(base, "kill.latch")
+    stall_latch = os.path.join(base, "stall.latch")
+    fault_spec = (
+        f"gen.case:kill:nth=3:latch={kill_latch};"
+        f"gen.case:stall:nth=5:delay=60:latch={stall_latch}"
+    )
+    chaos = run_gen(
+        chaos_dir, fault_spec, extra_args=("--case-timeout", "20", "--case-retries", "3")
+    )
+
+    assert chaos["written"] == clean["written"], f"lost vectors: {clean} vs {chaos}"
+    assert chaos["failed"] == 0, f"unrecovered failures: {chaos}"
+    counters = chaos["counters"]
+    assert counters.get("gen.workers_replaced", 0) > 0, f"no worker was replaced: {counters}"
+    assert counters.get("gen.cases_retried", 0) > 0, f"no case was retried: {counters}"
+
+    digests = lambda d: {  # noqa: E731
+        "/".join(k): r["parts"] for k, r in load_manifest(manifest_path(d)).items()
+    }
+    clean_digests, chaos_digests = digests(clean_dir), digests(chaos_dir)
+    assert clean_digests == chaos_digests, "fault-injected digests differ from clean run"
+
+    torn_checked = 0
+    for root, _dirs, files in os.walk(chaos_dir):
+        for name in files:
+            if name.endswith(".ssz_snappy"):
+                with open(os.path.join(root, name), "rb") as f:
+                    frame_decompress(f.read())  # raises on a torn file
+                torn_checked += 1
+            assert not name.endswith(".tmp"), f"stray tmp file: {os.path.join(root, name)}"
+    assert torn_checked > 0, "no parts to verify"
+
+    print(json.dumps({
+        "written": chaos["written"],
+        "parts_decoded": torn_checked,
+        "workers_replaced": counters.get("gen.workers_replaced"),
+        "cases_retried": counters.get("gen.cases_retried"),
+        "cases_timeout": counters.get("gen.cases_timeout", 0),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,201 @@
+"""Chaos paths of the vector generator (gen/gen_runner.py crash-safe
+pool + gen/manifest.py + gen/dumper.py atomic writes):
+
+* a SIGKILLed pool worker mid-run still yields ALL vectors (the lost
+  case re-dispatches, a replacement worker spawns);
+* a case past its wall-clock deadline is marked failed without hanging
+  the pool;
+* --resume after a simulated SIGKILL regenerates only the missing
+  cases, rewriting zero already-durable ones;
+* a fault-injected run's vectors are byte-identical (part digests) to a
+  clean run's;
+* corrupt-injected writes are caught by the dumper's read-back
+  verification and retried — never left torn on disk.
+"""
+
+import multiprocessing as mp
+import os
+import signal
+import time
+
+import pytest
+
+from eth_consensus_specs_tpu import fault, obs
+from eth_consensus_specs_tpu.gen import (
+    discover_test_cases,
+    load_manifest,
+    manifest_path,
+    run_generator,
+)
+
+
+@pytest.fixture(scope="module")
+def att_cases():
+    cases = discover_test_cases(
+        presets=("minimal",), forks=("phase0",), runners=("operations",)
+    )
+    cases = [c for c in cases if c.handler == "attestation"]
+    assert len(cases) >= 5, "need a handful of attestation cases for chaos runs"
+    return cases
+
+
+def _digests(out_dir: str) -> dict:
+    return {k: r["parts"] for k, r in load_manifest(manifest_path(out_dir)).items()}
+
+
+def _counter(name: str) -> float:
+    return obs.snapshot()["counters"].get(name, 0)
+
+
+def test_pool_worker_kill_still_yields_all_vectors(att_cases, tmp_path):
+    sub = att_cases[:6]
+    clean_dir, chaos_dir = str(tmp_path / "clean"), str(tmp_path / "chaos")
+    clean = run_generator(sub, clean_dir)
+    assert clean["failed"] == 0
+
+    replaced0, retried0 = _counter("gen.workers_replaced"), _counter("gen.cases_retried")
+    latch = tmp_path / "kill.latch"
+    # one worker SIGKILLs itself on its 2nd case (latch: exactly one kill
+    # across the whole pool); forked workers inherit the installed rules
+    with fault.injected(f"gen.case:kill:nth=2:latch={latch}"):
+        chaos = run_generator(sub, chaos_dir, workers=2, case_retries=3)
+
+    assert chaos["written"] == clean["written"]
+    assert chaos["failed"] == 0
+    assert _counter("gen.workers_replaced") - replaced0 >= 1
+    assert _counter("gen.cases_retried") - retried0 >= 1
+    # fault-injected vectors are byte-identical to the clean run's
+    assert _digests(chaos_dir) == _digests(clean_dir)
+
+
+def test_case_timeout_fails_without_hanging_pool(att_cases, tmp_path):
+    sub = att_cases[:4]
+    latch = tmp_path / "stall.latch"
+    timeouts0 = _counter("gen.cases_timeout")
+    t0 = time.monotonic()
+    # one case stalls 60s against a 3s deadline, zero retries: the sweep
+    # must kill the hung worker, fail the case, and finish the rest
+    with fault.injected(f"gen.case:stall:nth=1:delay=60:latch={latch}"):
+        stats = run_generator(
+            sub, str(tmp_path / "out"), workers=2, case_timeout=3.0, case_retries=0
+        )
+    assert time.monotonic() - t0 < 45, "pool hung on the stalled case"
+    assert stats["failed"] == 1
+    assert stats["written"] + stats["skipped"] == len(sub) - 1
+    assert _counter("gen.cases_timeout") - timeouts0 == 1
+
+
+def test_timed_out_case_recovers_within_retry_budget(att_cases, tmp_path):
+    sub = att_cases[:4]
+    latch = tmp_path / "stall.latch"
+    with fault.injected(f"gen.case:stall:nth=1:delay=60:latch={latch}"):
+        stats = run_generator(
+            sub, str(tmp_path / "out"), workers=2, case_timeout=3.0, case_retries=2
+        )
+    # the latch makes the stall one-shot: the re-dispatched case runs clean
+    assert stats["failed"] == 0
+    assert stats["written"] + stats["skipped"] == len(sub)
+
+
+def test_resume_regenerates_only_missing_cases(att_cases, tmp_path):
+    sub = att_cases[:5]
+    out = str(tmp_path / "out")
+    latch = str(tmp_path / "kill.latch")
+
+    def interrupted():
+        # sequential run that SIGKILLs itself on its 4th case — the
+        # "operator's generation box died mid-run" scenario
+        fault.install(f"gen.case:kill:nth=4:latch={latch}")
+        run_generator(sub, out)
+
+    proc = mp.get_context("fork").Process(target=interrupted)
+    proc.start()
+    proc.join(300)
+    assert proc.exitcode == -signal.SIGKILL
+
+    durable = load_manifest(manifest_path(out))
+    assert 0 < len(durable) < len(sub)
+    # snapshot every durable byte: resume must not rewrite any of them
+    mtimes = {}
+    for rec in durable.values():
+        if rec["dir"] is None:
+            continue
+        case_dir = os.path.join(out, rec["dir"])
+        for name in os.listdir(case_dir):
+            p = os.path.join(case_dir, name)
+            mtimes[p] = os.stat(p).st_mtime_ns
+
+    stats = run_generator(sub, out, resume=True)
+    assert stats["resumed"] == len(durable)
+    assert stats["failed"] == 0
+    assert stats["written"] + stats["skipped"] == len(sub) - len(durable)
+    for p, mt in mtimes.items():
+        assert os.stat(p).st_mtime_ns == mt, f"resume rewrote durable {p}"
+    # the resumed tree is complete and matches a clean run byte-for-byte
+    assert len(load_manifest(manifest_path(out))) == len(sub)
+    clean_dir = str(tmp_path / "clean")
+    run_generator(sub, clean_dir)
+    assert _digests(out) == _digests(clean_dir)
+
+
+def test_corrupt_write_is_caught_and_retried(att_cases, tmp_path):
+    from eth_consensus_specs_tpu.gen.snappy_codec import frame_decompress
+
+    sub = att_cases[:2]
+    retries0 = _counter("gen.torn_writes")
+    with fault.injected("gen.dump_bytes:corrupt:nth=1"):
+        stats = run_generator(sub, str(tmp_path / "out"))
+    assert stats["failed"] == 0
+    assert _counter("gen.torn_writes") - retries0 == 1
+    # nothing torn survived: every emitted part snappy-decodes
+    checked = 0
+    for root, _dirs, files in os.walk(tmp_path / "out"):
+        for name in files:
+            if name.endswith(".ssz_snappy"):
+                with open(os.path.join(root, name), "rb") as f:
+                    frame_decompress(f.read())
+                checked += 1
+            assert not name.endswith(".tmp"), f"stray tmp file {name}"
+    assert checked > 0
+
+
+def test_systemic_worker_death_aborts_instead_of_spinning(att_cases, tmp_path):
+    # every worker dies on its first case (no latch) and the retry budget
+    # can't be exhausted fast: the pool's circuit breaker must abort
+    # loudly rather than respawn workers forever
+    with fault.injected("gen.case:kill:nth=1:times=inf"):
+        with pytest.raises(RuntimeError, match="failing systematically"):
+            run_generator(att_cases[:3], str(tmp_path), workers=2, case_retries=50)
+
+
+def test_stale_tmp_cleanup_restores_orphaned_overwrite_stash(tmp_path):
+    from eth_consensus_specs_tpu.gen.dumper import OLD_SUFFIX
+    from eth_consensus_specs_tpu.gen.manifest import clean_stale_tmp
+
+    out = tmp_path / "tree"
+    # killed mid-staging: uncommitted tmp dir -> deleted
+    (out / "a" / "case.__tmp123").mkdir(parents=True)
+    # killed between an overwrite's two renames: the stash is the only
+    # copy of the durable vector -> restored to the final name
+    orphan = out / "a" / ("case2" + OLD_SUFFIX)
+    orphan.mkdir(parents=True)
+    (orphan / "pre.ssz_snappy").write_bytes(b"x")
+    # normal leftover stash next to a committed dir -> deleted
+    (out / "a" / "case3").mkdir(parents=True)
+    (out / "a" / ("case3" + OLD_SUFFIX)).mkdir(parents=True)
+
+    clean_stale_tmp(str(out))
+    assert not (out / "a" / "case.__tmp123").exists()
+    assert (out / "a" / "case2" / "pre.ssz_snappy").read_bytes() == b"x"
+    assert not (out / "a" / ("case2" + OLD_SUFFIX)).exists()
+    assert (out / "a" / "case3").exists()
+    assert not (out / "a" / ("case3" + OLD_SUFFIX)).exists()
+
+
+def test_workers_auto_survives_unknown_cpu_count(att_cases, tmp_path, monkeypatch):
+    # os.cpu_count() may return None: "auto" must fall back to one
+    # worker, not crash on None - 1
+    monkeypatch.setattr(os, "cpu_count", lambda: None)
+    stats = run_generator(att_cases[:2], str(tmp_path), workers="auto")
+    assert stats["failed"] == 0
+    assert stats["written"] + stats["skipped"] == 2
