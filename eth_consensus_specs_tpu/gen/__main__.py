@@ -81,11 +81,13 @@ def main() -> None:
         case_retries=args.case_retries,
         resume=args.resume,
     )
-    # recovery counters ride along so CI chaos jobs can assert on them
+    # recovery + serving counters ride along so CI chaos jobs can assert
+    # on them (serve.* arrives from pool workers via the per-case counter
+    # shipping when ETH_SPECS_SERVE=1)
     counters = {
         k: v
         for k, v in obs.snapshot()["counters"].items()
-        if k.startswith(("gen.", "fault."))
+        if k.startswith(("gen.", "fault.", "serve."))
     }
     print(json.dumps({"cases": len(cases), **stats, "counters": counters}))
 

@@ -200,12 +200,13 @@ def case_key(case: TestCase) -> tuple:
 
 _WORKER_CASES: dict | None = None
 _WORKER_DUMPER: Dumper | None = None
+_WORKER_SERVICE = None
 
 
 def _pool_init(output_dir: str, presets: tuple, forks: tuple | None, package: str):
     """Worker initializer: rebuild the case index once per worker
     process (closures don't pickle; coordinates do)."""
-    global _WORKER_CASES, _WORKER_DUMPER
+    global _WORKER_CASES, _WORKER_DUMPER, _WORKER_SERVICE
     from .gen_from_tests import discover_test_cases
     from .runners import get_runner_cases
 
@@ -215,6 +216,34 @@ def _pool_init(output_dir: str, presets: tuple, forks: tuple | None, package: st
     found += get_runner_cases(presets=presets)
     _WORKER_CASES = {case_key(c): c for c in found}
     _WORKER_DUMPER = Dumper(output_dir)
+    from eth_consensus_specs_tpu import serve
+
+    if serve.serve_enabled():
+        # per-worker verification service: this worker's spec-code BLS
+        # verifies (utils/bls.FastAggregateVerify) coalesce in its own
+        # micro-batcher. idle_flush because a pool worker is a SINGLE
+        # synchronous submitter — without it every verify would pay the
+        # full deadline wait for co-riders that cannot exist. serve.*
+        # counters land in the worker's obs registry and ship to the
+        # parent with every case result via the existing
+        # _worker_obs_delta counter shipping.
+        _WORKER_SERVICE = serve.VerifyService(
+            serve.ServeConfig.from_env(idle_flush=True),
+            name=f"gen-worker-{os.getpid()}",
+        )
+        serve.install_routing(_WORKER_SERVICE)
+
+
+def _pool_shutdown():
+    """Worker teardown: drain + close the per-worker service (emits its
+    serve.stats event) before the process exits or recycles."""
+    global _WORKER_SERVICE
+    if _WORKER_SERVICE is not None:
+        from eth_consensus_specs_tpu import serve
+
+        serve.uninstall_routing()
+        _WORKER_SERVICE.close()
+        _WORKER_SERVICE = None
 
 
 _WORKER_OBS_BASE: dict = {}
@@ -266,31 +295,34 @@ def _worker_main(task_q, result_q, output_dir: str, presets: tuple, forks: tuple
     # shipped delta must cover THIS worker's work only
     _worker_obs_delta()
     done = 0
-    while True:
-        key = task_q.get()
-        if key is None:
-            break
-        try:
-            # the case's wall clock starts HERE, not at dispatch: init and
-            # queue latency must not eat the case's deadline budget
-            result_q.put(("started", os.getpid(), key))
-        except Exception:
-            break
-        try:
-            res = _pool_exec(key)
-        except BaseException:
-            # _pool_exec already catches case errors; this guards the
-            # machinery itself — report and keep serving
-            traceback.print_exc()
-            res = (key, "failed", 0, {}, {}, None)
-        try:
-            result_q.put(("done", os.getpid(), res))
-        except Exception:
-            break
-        done += 1
-        if done >= _MAX_TASKS_PER_WORKER:
-            result_q.put(("recycle", os.getpid(), None))
-            break
+    try:
+        while True:
+            key = task_q.get()
+            if key is None:
+                break
+            try:
+                # the case's wall clock starts HERE, not at dispatch: init and
+                # queue latency must not eat the case's deadline budget
+                result_q.put(("started", os.getpid(), key))
+            except Exception:
+                break
+            try:
+                res = _pool_exec(key)
+            except BaseException:
+                # _pool_exec already catches case errors; this guards the
+                # machinery itself — report and keep serving
+                traceback.print_exc()
+                res = (key, "failed", 0, {}, {}, None)
+            try:
+                result_q.put(("done", os.getpid(), res))
+            except Exception:
+                break
+            done += 1
+            if done >= _MAX_TASKS_PER_WORKER:
+                result_q.put(("recycle", os.getpid(), None))
+                break
+    finally:
+        _pool_shutdown()
 
 
 class _Worker:

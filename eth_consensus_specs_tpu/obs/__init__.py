@@ -52,6 +52,12 @@ def bytes_moved(name: str, nbytes: int) -> None:
     get_registry().bytes_moved(name, nbytes)
 
 
+def gauge(name: str, value: int | float) -> None:
+    """Record a point-in-time level (can go down, unlike a counter); the
+    snapshot keeps last + max per gauge."""
+    get_registry().gauge(name, value)
+
+
 def event(kind: str, **fields) -> None:
     """Emit a structured event to the in-memory ring + JSONL sink."""
     get_registry().emit({"kind": kind, **fields})

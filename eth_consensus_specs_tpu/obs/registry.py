@@ -143,6 +143,7 @@ class Registry:
         self._lock = threading.Lock()
         self._local = threading.local()
         self.counters: dict[str, float] = {}
+        self.gauges: dict[str, dict] = {}
         self.spans: dict[str, dict] = {}
         self.events: list[dict] = []
         self._jsonl_path: str | None = os.environ.get("ETH_SPECS_OBS_JSONL") or None
@@ -220,6 +221,20 @@ class Registry:
     def bytes_moved(self, name: str, nbytes: int) -> None:
         self.count(f"{name}.bytes_moved", int(nbytes))
 
+    def gauge(self, name: str, value: int | float) -> None:
+        """Record a point-in-time level (queue depth, in-flight bytes):
+        unlike a counter it can go down — the snapshot keeps the last and
+        the max, which is what capacity questions ("did the queue ever
+        hit the cap?") actually need."""
+        if not obs_enabled():
+            return
+        with self._lock:
+            g = self.gauges.get(name)
+            if g is None:
+                g = self.gauges[name] = {"last": 0.0, "max": 0.0}
+            g["last"] = value
+            g["max"] = max(g["max"], value)
+
     # ------------------------------------------------------------ events --
 
     def emit(self, event: dict) -> None:
@@ -268,6 +283,7 @@ class Registry:
         pytest report, bench, and ad-hoc inspection."""
         with self._lock:
             counters = dict(self.counters)
+            gauges = {name: dict(g) for name, g in self.gauges.items()}
             spans = {
                 name: {k: (round(v, 9) if isinstance(v, float) else v) for k, v in agg.items()}
                 for name, agg in self.spans.items()
@@ -281,6 +297,7 @@ class Registry:
                 kernels.setdefault(parts[1], {})[parts[2]] = val
         return {
             "counters": counters,
+            "gauges": gauges,
             "spans": spans,
             "watchdog": {
                 "checks": counters.get("watchdog.checks", 0),
@@ -292,6 +309,7 @@ class Registry:
     def reset(self) -> None:
         with self._lock:
             self.counters.clear()
+            self.gauges.clear()
             self.spans.clear()
             self.events.clear()
 

@@ -25,6 +25,7 @@ from __future__ import annotations
 
 import os
 import secrets
+import threading
 
 from eth_consensus_specs_tpu import obs
 from eth_consensus_specs_tpu.crypto.curve import (
@@ -39,6 +40,10 @@ from eth_consensus_specs_tpu.obs import watchdog
 
 
 def _use_device() -> bool:
+    # snapshot the backend switch ONCE per batch (callers read it a single
+    # time and thread the answer through): a concurrent use_tpu()/
+    # use_pyspec() flip mid-batch must not route half a batch's items
+    # through each backend
     from eth_consensus_specs_tpu.utils import bls
 
     return bls.backend_name() == "tpu"
@@ -47,8 +52,12 @@ def _use_device() -> bool:
 # hash-to-G2 results keyed by (dst, message) — primed in one batched
 # device dispatch when ETH_SPECS_TPU_DEVICE_H2C is on; host fallback per
 # miss.  The dst is part of the key so a caller priming under one domain
-# can never serve a point to a reader under another.
+# can never serve a point to a reader under another.  All mutation holds
+# _H2G2_LOCK: the serving layer's micro-batcher verifies off-thread, and
+# an unlocked evict (clear + update) racing a concurrent prime could
+# publish a half-rebuilt dict.
 _H2G2_CACHE: dict[tuple[bytes, bytes], object] = {}
+_H2G2_LOCK = threading.Lock()
 
 
 def _prime_h2g2_cache(msgs: list[bytes], batch_fn, dst: bytes = DST_G2) -> None:
@@ -56,20 +65,32 @@ def _prime_h2g2_cache(msgs: list[bytes], batch_fn, dst: bytes = DST_G2) -> None:
     # this very call's cached messages and push them onto the serial host
     # path — the opposite of what the batched dispatch is for
     keys = [(dst, m) for m in msgs]
-    if len(_H2G2_CACHE) + len(keys) > 512:
-        keep = {k: _H2G2_CACHE[k] for k in keys if k in _H2G2_CACHE}
-        _H2G2_CACHE.clear()
-        _H2G2_CACHE.update(keep)
-    fresh = [m for m in msgs if (dst, m) not in _H2G2_CACHE]
+    with _H2G2_LOCK:
+        if len(_H2G2_CACHE) + len(keys) > 512:
+            keep = {k: _H2G2_CACHE[k] for k in keys if k in _H2G2_CACHE}
+            _H2G2_CACHE.clear()
+            _H2G2_CACHE.update(keep)
+        fresh = [m for m in msgs if (dst, m) not in _H2G2_CACHE]
     if not fresh:
         return
+    # the batched dispatch runs OUTSIDE the lock (it can be slow; two
+    # racing primes at worst both compute — idempotent, never corrupt)
     points = batch_fn(fresh, dst)
-    for m, p in zip(fresh, points):
-        _H2G2_CACHE[(dst, m)] = p
+    with _H2G2_LOCK:
+        # re-check the bound at insert time: N racing primes could each
+        # have passed the pre-dispatch check, and unbounded overshoot
+        # would defeat the cap (evicting here keeps THIS call's keys)
+        if len(_H2G2_CACHE) + len(fresh) > 512:
+            keep = {k: _H2G2_CACHE[k] for k in keys if k in _H2G2_CACHE}
+            _H2G2_CACHE.clear()
+            _H2G2_CACHE.update(keep)
+        for m, p in zip(fresh, points):
+            _H2G2_CACHE[(dst, m)] = p
 
 
 def _h2g2(msg: bytes, dst: bytes = DST_G2):
-    hit = _H2G2_CACHE.get((dst, msg))
+    with _H2G2_LOCK:
+        hit = _H2G2_CACHE.get((dst, msg))
     return hit if hit is not None else hash_to_g2(msg, dst)
 
 
@@ -159,32 +180,49 @@ def batch_verify_aggregates(items: list[tuple[list[bytes], bytes, bytes]]) -> bo
     return ok
 
 
+def _parse_item(item: tuple[list[bytes], bytes, bytes]):
+    """(pubkeys, message, signature) -> (points, msg, sig, r) or None on
+    any malformed/empty input — the exact accept/reject rules of the
+    inline parse this was extracted from."""
+    from eth_consensus_specs_tpu.crypto.signature import _load_pk
+
+    pks, msg, sig_b = item
+    if len(pks) == 0:
+        return None
+    # _load_pk rejects malformed AND infinity keys (same outcome as the
+    # previous inline parse) and caches decompression — registry keys
+    # repeat every block, so steady-state parsing is dict lookups
+    points = []
+    for pk in pks:
+        p = _load_pk(bytes(pk))
+        if p is None:
+            return None
+        points.append(p)
+    try:
+        sig = g2_from_bytes(bytes(sig_b))
+    except ValueError:
+        return None
+    r = secrets.randbits(64) | 1
+    return (points, bytes(msg), sig, r)
+
+
 def _batch_verify_impl(
     items: list[tuple[list[bytes], bytes, bytes]],
 ) -> tuple[bool, list | None]:
-    from eth_consensus_specs_tpu.crypto.signature import _load_pk
-
-    g1 = g1_generator()
     parsed = []
-    for pks, msg, sig_b in items:
-        if len(pks) == 0:
+    for item in items:
+        p = _parse_item(item)
+        if p is None:
             return False, None
-        # _load_pk rejects malformed AND infinity keys (same outcome as the
-        # previous inline parse) and caches decompression — registry keys
-        # repeat every block, so steady-state parsing is dict lookups
-        points = []
-        for pk in pks:
-            p = _load_pk(bytes(pk))
-            if p is None:
-                return False, None
-            points.append(p)
-        try:
-            sig = g2_from_bytes(bytes(sig_b))
-        except ValueError:
-            return False, None
-        r = secrets.randbits(64) | 1
-        parsed.append((points, bytes(msg), sig, r))
+        parsed.append(p)
+    rpk = _rlc_pubkey_terms(parsed)
+    return _rlc_pairing_check(parsed, rpk), parsed
 
+
+def _rlc_pubkey_terms(parsed: list) -> list:
+    """Per-item r_i * aggpk_i — independent of which subset of the batch
+    a later check verifies, so verify_many's bisection computes these
+    ONCE per item and re-checks subsets with only the G2 MSM + pairing."""
     if _use_device():
         from eth_consensus_specs_tpu.ops.g1_msm import sum_g1_device
 
@@ -217,7 +255,11 @@ def _batch_verify_impl(
                 for p in points:
                     aggpk = aggpk + p
             rpk.append(aggpk.mul(r))
+    return rpk
 
+
+def _rlc_pairing_check(parsed: list, rpk: list) -> bool:
+    g1 = g1_generator()
     # merge same-message items into one pairing input (block attestations
     # often share AttestationData): k items with m distinct messages ->
     # m+1 pairs, one hash-to-curve per distinct message
@@ -243,4 +285,50 @@ def _batch_verify_impl(
     obs.count("bls.pairings", 1)
     obs.count("bls.pairing_inputs", len(pairs))
     obs.count("bls.messages_distinct", len(merged))
-    return _pairing_check_routed(pairs), parsed
+    return _pairing_check_routed(pairs)
+
+
+def verify_many(items: list[tuple[list[bytes], bytes, bytes]]) -> list[bool]:
+    """Per-item verdicts for many (pubkeys, message, aggregate_signature)
+    triples — the serving layer's batch entry point. Parsing and the
+    per-item G1 MSM terms are computed ONCE; one RLC pairing settles an
+    all-valid batch (the overwhelmingly common case), and a reject
+    bisects with only the G2 MSM + pairing per subset, so each invalid
+    item costs ~2*log2(n) pairings instead of n.
+
+    Per-item results are exactly what ``batch_verify_aggregates([item])``
+    returns: a singleton RLC check is ``X^r == 1`` in the prime-order
+    pairing group with odd 64-bit r, which holds iff ``X == 1`` — i.e.
+    the singleton batch is deterministic, not probabilistic, so bisection
+    verdicts are bit-identical to per-request direct calls."""
+    if not items:
+        return []
+    with obs.span("bls.verify_many", items=len(items)):
+        obs.count("bls.verify_many_items", len(items))
+        out = [False] * len(items)
+        parsed = [_parse_item(it) for it in items]
+        live = [i for i, p in enumerate(parsed) if p is not None]
+        if not live:
+            return out
+        sub = [parsed[i] for i in live]
+        rpk = _rlc_pubkey_terms(sub)
+        verdicts = _bisect_rlc(sub, rpk)
+        for i, v in zip(live, verdicts):
+            out[i] = v
+    # sampled device/host coupling on the serving path too (outside the
+    # span, same as batch_verify_aggregates): one item's verdict must
+    # reproduce through the plain host pairing
+    if live and watchdog.should_check("bls_batch"):
+        k = live[watchdog.call_salt("bls_batch") % len(live)]
+        points, msg, sig, _r = parsed[k]
+        watchdog.check_bls_item(points, msg, sig, out[k])
+    return out
+
+
+def _bisect_rlc(parsed: list, rpk: list) -> list[bool]:
+    if _rlc_pairing_check(parsed, rpk):
+        return [True] * len(parsed)
+    if len(parsed) == 1:
+        return [False]
+    mid = len(parsed) // 2
+    return _bisect_rlc(parsed[:mid], rpk[:mid]) + _bisect_rlc(parsed[mid:], rpk[mid:])

@@ -90,6 +90,66 @@ def tree_root_words(leaves: jnp.ndarray, depth: int) -> jnp.ndarray:
 _tree_root_fused = partial(jax.jit, static_argnums=(1,))(tree_root_words)
 
 
+def many_tree_root_words(leaves: jnp.ndarray, depth: int) -> jnp.ndarray:
+    """Batched tree reduction: uint32[B, 2**depth, 8] -> uint32[B, 8]
+    roots, ONE dispatch for B independent subtrees (the serving layer's
+    bucket-padded flush shape — compiles once per (B, depth))."""
+    return jax.vmap(lambda level: tree_root_words(level, depth))(leaves)
+
+
+_many_tree_root_fused = partial(jax.jit, static_argnums=(1,))(many_tree_root_words)
+
+
+def _chunks_to_words(chunks: np.ndarray, cap: int) -> np.ndarray:
+    """uint8[N, 32] chunks (or pre-packed uint32[N, 8] BE words) ->
+    uint32[cap, 8], zero-padded. Exposed so the service's host-prep
+    stage can pack off the dispatch thread."""
+    if chunks.dtype == np.uint32:
+        words = np.ascontiguousarray(chunks)
+    else:
+        n = chunks.shape[0]
+        words = np.ascontiguousarray(chunks).view(">u4").astype(np.uint32).reshape(n, 8)
+    n = words.shape[0]
+    assert n <= cap
+    if n < cap:
+        words = np.concatenate([words, np.zeros((cap - n, 8), dtype=np.uint32)], axis=0)
+    return words
+
+
+def merkleize_many_device(
+    trees: list[np.ndarray], depth: int, pad_batch: int | None = None
+) -> list[bytes]:
+    """Merkleize many independent subtrees of one depth in a single
+    dispatch. Each entry is uint8[N_i, 32] chunks (N_i <= 2**depth) or a
+    pre-packed uint32[N_i, 8] word array; the batch dimension is padded
+    with all-zero trees up to `pad_batch` so the compiled executable is
+    shared across every flush in the same bucket. Roots are bit-identical
+    to per-tree `merkleize_subtree_device` (same kernel, vmapped)."""
+    b = len(trees)
+    cap = 1 << depth
+    batch = pad_batch or b
+    assert b <= batch
+    words = np.zeros((batch, cap, 8), np.uint32)
+    for i, chunks in enumerate(trees):
+        words[i] = _chunks_to_words(chunks, cap)
+    real = batch * tree_real_hashes(depth)
+    with obs.span(
+        "merkle.many_subtree_root",
+        work_bytes=96 * real,
+        tree_depth=depth,
+        trees=b,
+        padded_trees=batch,
+    ) as sp:
+        sp.result = roots = np.asarray(_many_tree_root_fused(jnp.asarray(words), depth))
+    obs.count("merkle.trees", b)
+    obs.count("merkle.real_hashes", real)
+    out = [roots[i].astype(">u4", order="C").view(np.uint8).tobytes() for i in range(b)]
+    if b and watchdog.should_check("merkle"):
+        i = watchdog.call_salt("merkle") % b
+        watchdog.check_merkle_root(words[i], depth, out[i])
+    return out
+
+
 def merkleize_subtree_device(chunks: np.ndarray, depth: int) -> bytes:
     """Merkleize uint8[N, 32] chunks into the root of a depth-`depth` subtree.
 
@@ -117,9 +177,20 @@ def merkleize_subtree_device(chunks: np.ndarray, depth: int) -> bytes:
     return root
 
 
-# Above this leaf count the device tree kernel beats per-level hashlib.
-DEVICE_SUBTREE_THRESHOLD = 4096
+# Device/host crossover: ONE cost model shared with the serving layer's
+# bucket planner (serve/buckets.py is the home; re-exported here so ops
+# callers keep their import path and the two can never disagree).
+from eth_consensus_specs_tpu.serve.buckets import (  # noqa: E402
+    DEVICE_SUBTREE_THRESHOLD,
+    device_subtree_worthwhile,
+)
 
-
-def device_subtree_worthwhile(n_chunks: int) -> bool:
-    return n_chunks >= DEVICE_SUBTREE_THRESHOLD
+__all__ = [
+    "DEVICE_SUBTREE_THRESHOLD",
+    "device_subtree_worthwhile",
+    "merkleize_many_device",
+    "merkleize_subtree_device",
+    "many_tree_root_words",
+    "tree_real_hashes",
+    "tree_root_words",
+]

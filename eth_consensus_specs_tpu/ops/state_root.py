@@ -451,6 +451,29 @@ def post_epoch_state_root(
     return out
 
 
+def state_root_compile_key(meta: StateRootMeta) -> tuple:
+    """Shape key the jitted state-root graph compiles under. The serving
+    layer groups queued state-root requests by this key so every request
+    for the same registry shape hits the same compiled executable, and
+    counts first sightings as `serve.compiles` (serve/buckets.py)."""
+    return ("state_root", meta.n_validators, meta.top_depth, len(meta.dynamic_slots))
+
+
+def post_epoch_state_root_host(
+    arrays: StateRootArrays,
+    meta: StateRootMeta,
+    balances,
+    effective_balance,
+    inactivity_scores,
+    just,
+) -> jnp.ndarray:
+    """Public host-oracle entry (no XLA anywhere) — what the serving
+    layer's whole-batch degradation falls back to on device death."""
+    return _post_epoch_state_root_host(
+        arrays, meta, balances, effective_balance, inactivity_scores, just
+    )
+
+
 def _post_epoch_state_root_host(
     arrays: StateRootArrays,
     meta: StateRootMeta,

@@ -1,0 +1,72 @@
+"""serve — in-process async verification service with micro-batching.
+
+The ROADMAP north star is serving heavy verification traffic; every
+device entry point (``ops/bls_batch``, ``ops/merkle``,
+``ops/state_root``) is a synchronous call paying per-request dispatch —
+and, off the bucket grid, per-shape recompile. This package puts an
+async service in front of them:
+
+  * **futures in, batches out** — ``submit_bls_aggregate`` /
+    ``submit_hash_tree_root`` / ``submit_state_root`` return
+    ``concurrent.futures.Future``s; a dynamic micro-batcher
+    (serve/batcher.py) coalesces submissions and flushes on max batch
+    size, a max-latency deadline, or queue pressure;
+  * **shape buckets** — each flush is padded into a small set of
+    power-of-two batch buckets (serve/buckets.py) so jitted kernels
+    compile once per bucket, with a persistent warmup list +
+    ``precompile()``; the device/host crossover cost model lives here
+    too and is re-exported by ``ops/merkle``;
+  * **backpressure** — an admission controller (serve/admission.py)
+    bounds queued+in-flight requests and bytes, shedding load with a
+    typed ``Overloaded`` (retry-after hint) instead of unbounded RAM;
+  * **stays up** — device death degrades the WHOLE in-flight batch to
+    the host oracles through ``fault.degrade("serve.dispatch", ...)``,
+    bit-identical results, ``fault.degraded.serve.dispatch`` counters;
+  * **observable** — ``serve.*`` counters/gauges/events throughout
+    (see serve/service.py's docstring and docs/serving.md).
+
+Module layout keeps imports acyclic: ``ops/merkle`` imports
+``serve.buckets`` (the cost model), so this ``__init__`` must not
+import ops at module scope — the service class and routing helpers load
+lazily via ``__getattr__``.
+"""
+
+from __future__ import annotations
+
+from .admission import Overloaded  # noqa: F401  (pure stdlib+obs, cycle-safe)
+from .config import ServeConfig, serve_enabled  # noqa: F401
+
+_ROUTED = None
+
+
+def __getattr__(name: str):
+    if name == "VerifyService":
+        from .service import VerifyService
+
+        return VerifyService
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
+
+
+def install_routing(service) -> None:
+    """Make `service` the process's routed verifier: entry points that
+    opt in (utils/bls.FastAggregateVerify) submit through it instead of
+    calling ops directly. One service per process; installing replaces."""
+    global _ROUTED
+    _ROUTED = service
+
+
+def uninstall_routing() -> None:
+    global _ROUTED
+    _ROUTED = None
+
+
+def routed():
+    """The installed service, or None — and always None on the service's
+    own worker threads (a dispatch-thread re-submit would deadlock on
+    its own future)."""
+    svc = _ROUTED
+    if svc is None:
+        return None
+    from .service import on_service_thread
+
+    return None if on_service_thread() else svc

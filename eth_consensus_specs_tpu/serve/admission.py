@@ -1,0 +1,102 @@
+"""Admission control: bounded queue depth + in-flight bytes, typed shed.
+
+The service must stay up under any offered load; what gives is
+admission. A request is admitted when the tracked depth (queued AND
+in-flight — a request only releases its slot when its future resolves,
+so a stalled device can't hide load in the dispatch pipeline) is under
+``max_queue`` and its payload fits the in-flight byte budget. Past
+either cap, ``submit_*`` raises :class:`Overloaded` — a typed rejection
+carrying a ``retry_after_s`` hint derived from the EWMA per-request
+service time, so a well-behaved client backs off for roughly one
+queue-drain instead of hammering.
+
+One deliberate asymmetry: a request larger than the whole byte budget
+is still admitted when the service is otherwise EMPTY — rejecting it
+unconditionally would make it unservable forever, and an empty service
+has the entire budget to give.
+"""
+
+from __future__ import annotations
+
+import threading
+
+from eth_consensus_specs_tpu import obs
+
+
+class Overloaded(RuntimeError):
+    """Load-shed rejection. ``retry_after_s`` is the backoff hint;
+    ``reason`` is ``"queue"`` or ``"bytes"``."""
+
+    def __init__(self, reason: str, retry_after_s: float, depth: int, in_flight_bytes: int):
+        super().__init__(
+            f"service overloaded ({reason}): depth={depth}, "
+            f"in_flight_bytes={in_flight_bytes}, retry after {retry_after_s:.3f}s"
+        )
+        self.reason = reason
+        self.retry_after_s = retry_after_s
+        self.depth = depth
+        self.in_flight_bytes = in_flight_bytes
+
+
+class AdmissionController:
+    def __init__(self, max_queue: int, max_bytes: int):
+        self.max_queue = max_queue
+        self.max_bytes = max_bytes
+        self._lock = threading.Lock()
+        self._depth = 0
+        self._bytes = 0
+        # seeded pessimistically high so the first rejections under a
+        # cold cache suggest a real backoff, then tracks measurements
+        self._ewma_service_s = 0.01
+
+    def depth(self) -> int:
+        with self._lock:
+            return self._depth
+
+    def in_flight_bytes(self) -> int:
+        with self._lock:
+            return self._bytes
+
+    def retry_after_s(self) -> float:
+        """Roughly one queue-drain at the recent per-request rate."""
+        with self._lock:
+            return max(self._depth * self._ewma_service_s, 0.001)
+
+    def admit(self, cost_bytes: int) -> None:
+        """Reserve a slot or raise Overloaded. The slot is held until
+        :meth:`release` — i.e. until the request's future resolves."""
+        with self._lock:
+            reason = None
+            if self._depth + 1 > self.max_queue:
+                reason = "queue"
+            elif self._depth > 0 and self._bytes + cost_bytes > self.max_bytes:
+                reason = "bytes"
+            if reason is None:
+                self._depth += 1
+                self._bytes += cost_bytes
+                depth, in_bytes = self._depth, self._bytes
+            else:
+                depth, in_bytes = self._depth, self._bytes
+                retry = max(depth * self._ewma_service_s, 0.001)
+        if reason is not None:
+            obs.count("serve.rejected", 1)
+            obs.count(f"serve.rejected.{reason}", 1)
+            obs.event(
+                "serve.overloaded",
+                reason=reason,
+                depth=depth,
+                in_flight_bytes=in_bytes,
+                retry_after_s=round(retry, 6),
+            )
+            raise Overloaded(reason, retry, depth, in_bytes)
+        obs.gauge("serve.queue_depth", depth)
+        obs.gauge("serve.in_flight_bytes", in_bytes)
+
+    def release(self, cost_bytes: int, service_s: float | None = None) -> None:
+        with self._lock:
+            self._depth = max(self._depth - 1, 0)
+            self._bytes = max(self._bytes - cost_bytes, 0)
+            if service_s is not None and service_s >= 0:
+                self._ewma_service_s = 0.8 * self._ewma_service_s + 0.2 * service_s
+            depth = self._depth
+        obs.gauge("serve.queue_depth", depth)

@@ -1,0 +1,175 @@
+"""Shape buckets, the shared device/host cost model, and compile accounting.
+
+Every flush the service dispatches is padded into a SMALL set of
+power-of-two shapes so the jitted kernels compile once per bucket
+instead of once per observed batch size (XLA compiles per static shape;
+an unbucketed service would recompile on every distinct (batch, depth)
+it ever sees and spend its latency budget in the compiler). Two axes:
+
+  * **tree depth** is intrinsic — padding a subtree to a deeper depth
+    changes its root (the zero-hash fold differs), so depth is never
+    padded; distinct depths are distinct buckets by construction;
+  * **batch count** (trees per dispatch, requests per flush) IS padded:
+    extra all-zero trees ride along and their roots are discarded.
+
+This module is also the single home of the device/host *crossover cost
+model*: ``DEVICE_SUBTREE_THRESHOLD`` (the leaf count above which the
+device tree kernel beats per-level hashlib) lives here and is
+re-exported by ``ops/merkle.py``, so the serving planner and the ops
+entry point can never disagree about when the device is worth a
+dispatch (tests/test_serve.py pins the crossover).
+
+Compile accounting: every first dispatch of a new (op, *dims) shape key
+is counted as ``serve.compiles`` (the jit cache makes later dispatches
+free), appended to a persistent warmup list when
+``ETH_SPECS_SERVE_WARMUP`` names a file, and ``precompile()`` replays
+that list at startup so a restarted service pays zero compiles on its
+steady-state buckets.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+
+from eth_consensus_specs_tpu import obs
+
+# Above this many leaf chunks PER DISPATCH the device tree kernel beats
+# per-level hashlib (measured crossover, see ops/merkle.py's module doc
+# for the dispatch-latency numbers that set it). A batched dispatch
+# amortizes its fixed cost over every tree in the batch, so the model is
+# expressed in TOTAL chunks: trees * chunks_per_tree.
+DEVICE_SUBTREE_THRESHOLD = 4096
+
+
+def device_subtree_worthwhile(n_chunks: int, trees: int = 1) -> bool:
+    """One cost model for both the ops entry point (trees=1) and the
+    service's bucket planner (trees=batch): device wins once the
+    dispatch's total leaf chunks cross the threshold."""
+    return trees * n_chunks >= DEVICE_SUBTREE_THRESHOLD
+
+
+def pow2_bucket(n: int) -> int:
+    """Smallest power of two >= n (n >= 1)."""
+    return 1 << max(n - 1, 0).bit_length()
+
+
+def batch_bucket(n: int, buckets: tuple[int, ...]) -> int:
+    """Smallest configured bucket that holds n items; the largest bucket
+    caps the batcher's flush size, so n always fits."""
+    for b in buckets:
+        if b >= n:
+            return b
+    return buckets[-1]
+
+
+def subtree_depth(n_chunks: int) -> int:
+    """Depth of the pow2 subtree holding n_chunks leaf chunks — the same
+    depth a direct ``merkleize_subtree_device`` caller would pass, so
+    service and direct roots are bit-identical."""
+    return max(n_chunks - 1, 0).bit_length()
+
+
+# ------------------------------------------------- compile accounting --
+
+_SEEN_LOCK = threading.Lock()
+_SEEN_SHAPES: set[tuple] = set()
+
+
+def note_dispatch(op: str, *dims: int) -> bool:
+    """Record a dispatch of shape key (op, *dims). Returns True (and
+    bumps ``serve.compiles``) on the FIRST sighting — the dispatch that
+    pays the jit compile — False for every shape the process has already
+    compiled. The counter is what the bench asserts 'at most
+    len(buckets) compiles after warmup' against."""
+    key = (op, *map(int, dims))
+    with _SEEN_LOCK:
+        if key in _SEEN_SHAPES:
+            return False
+        _SEEN_SHAPES.add(key)
+    obs.count("serve.compiles", 1)
+    obs.event("serve.compile", op=op, dims=",".join(map(str, dims)))
+    _warmup_append(key)
+    return True
+
+
+def seen_shapes() -> list[tuple]:
+    with _SEEN_LOCK:
+        return sorted(_SEEN_SHAPES)
+
+
+def reset_for_tests() -> None:
+    with _SEEN_LOCK:
+        _SEEN_SHAPES.clear()
+
+
+# ------------------------------------------------- persistent warmup --
+
+
+def warmup_path() -> str | None:
+    return os.environ.get("ETH_SPECS_SERVE_WARMUP") or None
+
+
+def _warmup_append(key: tuple) -> None:
+    path = warmup_path()
+    if path is None:
+        return
+    try:
+        existing = set(map(tuple, load_warmup(path)))
+        if key in existing:
+            return
+        with open(path, "a") as fh:
+            fh.write(json.dumps(list(key)) + "\n")
+    except OSError:
+        pass  # warmup persistence is best-effort; serving never blocks on it
+
+
+def load_warmup(path: str | None = None) -> list[tuple]:
+    """Shape keys recorded by previous runs (JSONL, one ``[op, *dims]``
+    per line; torn/alien lines are skipped, not trusted)."""
+    path = path or warmup_path()
+    if path is None or not os.path.exists(path):
+        return []
+    out: list[tuple] = []
+    try:
+        with open(path) as fh:
+            for line in fh:
+                try:
+                    row = json.loads(line)
+                except ValueError:
+                    continue
+                if isinstance(row, list) and row and isinstance(row[0], str):
+                    out.append(tuple(row))
+    except OSError:
+        return []
+    return out
+
+
+def precompile(keys: list[tuple] | None = None) -> int:
+    """Compile every known bucket shape ahead of traffic. With no
+    explicit `keys`, replays the persistent warmup list. Returns the
+    number of shapes warmed. Unknown ops are skipped (a warmup file
+    written by a newer version must not crash an older server)."""
+    import numpy as np
+
+    warmed = 0
+    for key in keys if keys is not None else load_warmup():
+        op, dims = key[0], key[1:]
+        try:
+            if op == "merkle_many" and len(dims) == 2:
+                from eth_consensus_specs_tpu.ops.merkle import merkleize_many_device
+
+                batch, depth = int(dims[0]), int(dims[1])
+                zero = np.zeros((1, 8), np.uint32)
+                note_dispatch("merkle_many", batch, depth)
+                merkleize_many_device([zero], depth, pad_batch=batch)
+            else:
+                continue
+        except Exception:
+            obs.event("serve.precompile_failed", op=op, dims=",".join(map(str, dims)))
+            continue
+        warmed += 1
+    if warmed:
+        obs.count("serve.precompiled", warmed)
+    return warmed

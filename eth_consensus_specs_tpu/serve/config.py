@@ -1,0 +1,108 @@
+"""Service tuning knobs — one frozen config, every knob env-overridable.
+
+Environment (all optional; defaults serve a laptop-CPU smoke as well as
+a real accelerator):
+
+    ETH_SPECS_SERVE=1                 route pool-worker BLS verifies
+                                      through a per-worker service
+                                      (gen/gen_runner.py reads this)
+    ETH_SPECS_SERVE_MAX_BATCH=64      flush when this many requests are
+                                      queued (also the largest batch
+                                      bucket)
+    ETH_SPECS_SERVE_MAX_WAIT_MS=5     flush when the oldest queued
+                                      request has waited this long
+    ETH_SPECS_SERVE_MAX_QUEUE=1024    admission cap on queued+in-flight
+                                      requests; past it submits raise
+                                      Overloaded
+    ETH_SPECS_SERVE_MAX_BYTES=67108864  admission cap on in-flight
+                                      request payload bytes
+    ETH_SPECS_SERVE_PRESSURE=0.5      fraction of MAX_QUEUE above which
+                                      the batcher flushes immediately
+                                      (queue-pressure flush) instead of
+                                      waiting out the deadline
+    ETH_SPECS_SERVE_BUCKETS=1,2,4,8,16,32,64   pow2 batch-count buckets
+                                      each flush is padded into
+    ETH_SPECS_SERVE_WARMUP=<path>     persistent JSONL of compiled
+                                      shape keys (serve/buckets.py);
+                                      precompile() replays it
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass, replace
+
+
+def _env_int(name: str, default: int) -> int:
+    raw = os.environ.get(name)
+    try:
+        return int(raw) if raw else default
+    except ValueError:
+        return default
+
+
+def _env_float(name: str, default: float) -> float:
+    raw = os.environ.get(name)
+    try:
+        return float(raw) if raw else default
+    except ValueError:
+        return default
+
+
+@dataclass(frozen=True)
+class ServeConfig:
+    max_batch: int = 64
+    max_wait_ms: float = 5.0
+    max_queue: int = 1024
+    max_bytes: int = 64 << 20
+    pressure_fraction: float = 0.5
+    buckets: tuple[int, ...] = (1, 2, 4, 8, 16, 32, 64)
+    # flush immediately when the dispatch pipeline is idle instead of
+    # waiting out the deadline: right for a SINGLE synchronous submitter
+    # (gen pool workers — batching can't help when each verify blocks on
+    # its own future), wrong as a default (it would flush the first
+    # request of every concurrent burst alone)
+    idle_flush: bool = False
+
+    def __post_init__(self):
+        # the largest bucket must hold a full flush wherever the config
+        # was built (direct construction included), or a max-size flush
+        # would not fit any padding target
+        buckets = tuple(sorted({int(b) for b in self.buckets})) or (self.max_batch,)
+        if buckets[-1] < self.max_batch:
+            buckets = buckets + (self.max_batch,)
+        object.__setattr__(self, "buckets", buckets)
+
+    @classmethod
+    def from_env(cls, **overrides) -> "ServeConfig":
+        raw_buckets = os.environ.get("ETH_SPECS_SERVE_BUCKETS", "")
+        try:
+            buckets = tuple(sorted({int(b) for b in raw_buckets.split(",") if b.strip()}))
+        except ValueError:
+            buckets = ()
+        cfg = cls(
+            max_batch=_env_int("ETH_SPECS_SERVE_MAX_BATCH", cls.max_batch),
+            max_wait_ms=_env_float("ETH_SPECS_SERVE_MAX_WAIT_MS", cls.max_wait_ms),
+            max_queue=_env_int("ETH_SPECS_SERVE_MAX_QUEUE", cls.max_queue),
+            max_bytes=_env_int("ETH_SPECS_SERVE_MAX_BYTES", cls.max_bytes),
+            pressure_fraction=_env_float("ETH_SPECS_SERVE_PRESSURE", cls.pressure_fraction),
+            buckets=buckets or cls.buckets,
+            idle_flush=os.environ.get("ETH_SPECS_SERVE_IDLE_FLUSH") == "1",
+        )
+        if overrides:
+            cfg = replace(cfg, **overrides)  # __post_init__ re-checks buckets
+        return cfg
+
+    @property
+    def max_wait_s(self) -> float:
+        return self.max_wait_ms / 1000.0
+
+    @property
+    def pressure_depth(self) -> int:
+        return max(int(self.max_queue * self.pressure_fraction), 1)
+
+
+def serve_enabled() -> bool:
+    """The gen-pipeline opt-in: route pool workers' BLS verifies through
+    a per-worker service instance."""
+    return os.environ.get("ETH_SPECS_SERVE") == "1"

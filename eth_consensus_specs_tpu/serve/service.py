@@ -1,0 +1,379 @@
+"""The in-process async verification service.
+
+Three submit verbs return ``concurrent.futures.Future``s:
+
+  * ``submit_bls_aggregate(pubkeys, message, signature) -> Future[bool]``
+  * ``submit_hash_tree_root(chunks) -> Future[bytes]`` (32-byte root)
+  * ``submit_state_root(arrays, meta, balances, eff_bal, inact, just)
+    -> Future[np.ndarray]`` (u32[8] root words)
+
+Pipeline: ``submit`` → admission (typed ``Overloaded`` shed past the
+queue/byte caps) → micro-batcher (flush on size / deadline / pressure)
+→ **batch thread** (host prep: SSZ chunk packing, pubkey decode — runs
+while the previous flush executes) → bounded hand-off queue (depth 2:
+the pipeline's backpressure seam) → **dispatch thread** (device
+execution, bucket-padded; whole-batch degradation to host oracles
+through ``fault.degrade("serve.dispatch", ...)`` on device death).
+
+Result parity is a hard invariant: every future resolves to exactly
+what the direct per-request ops call returns (tests/test_serve.py
+hammers this with concurrent submitters), on both the device path and
+the degraded host path.
+
+Counters/events: ``serve.requests``, ``serve.flushes``,
+``serve.flush.{size,deadline,pressure,idle,close}``, ``serve.batch_items``,
+``serve.compiles``, ``serve.rejected[.reason]``, gauges
+``serve.queue_depth`` / ``serve.in_flight_bytes``, a ``serve.flush``
+event per flush (batch size, reason, in-flush wait p50/p99) and a
+``serve.stats`` event at close with run-level p50/p99 wait.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from concurrent.futures import Future
+from queue import Queue
+
+import numpy as np
+
+from eth_consensus_specs_tpu import fault, obs
+
+from . import buckets
+from .admission import AdmissionController, Overloaded  # noqa: F401  (re-export)
+from .batcher import MicroBatcher, Request
+from .config import ServeConfig
+
+# marks the service's own worker threads so routed entry points
+# (utils/bls.FastAggregateVerify) never re-submit from inside a dispatch
+# — that would deadlock the single dispatch thread on its own future
+_SERVICE_TLS = threading.local()
+
+
+def on_service_thread() -> bool:
+    return getattr(_SERVICE_TLS, "active", False)
+
+
+class VerifyService:
+    def __init__(self, config: ServeConfig | None = None, name: str = "serve"):
+        self.config = config or ServeConfig.from_env()
+        self.name = name
+        self.admission = AdmissionController(self.config.max_queue, self.config.max_bytes)
+        self._batcher = MicroBatcher()
+        # depth-2 hand-off: batch N+1's host prep overlaps batch N's
+        # device execution; a third flush blocks the batch thread, which
+        # lets the queue grow and admission shed — backpressure, not RAM
+        self._dispatch_q: Queue = Queue(maxsize=2)
+        self._closed = False
+        self._close_lock = threading.Lock()
+        # guards _waits_ms: stats() sorts it while the batch thread
+        # extends it, and an unguarded deque raises mid-iteration
+        self._waits_lock = threading.Lock()
+        self._waits_ms: deque[float] = deque(maxlen=4096)
+        self._dispatch_busy = False
+        self._batch_thread = threading.Thread(
+            target=self._batch_loop, name=f"{name}-batch", daemon=True
+        )
+        self._dispatch_thread = threading.Thread(
+            target=self._dispatch_loop, name=f"{name}-dispatch", daemon=True
+        )
+        self._batch_thread.start()
+        self._dispatch_thread.start()
+
+    # ------------------------------------------------------------ submit --
+
+    def _submit(self, kind: str, payload: tuple, cost_bytes: int) -> Future:
+        if self._closed:
+            raise RuntimeError(f"service {self.name} is shut down")
+        self.admission.admit(cost_bytes)  # raises Overloaded past the caps
+        req = Request(kind=kind, payload=payload, cost_bytes=cost_bytes)
+        try:
+            self._batcher.put(req)
+        except RuntimeError:
+            self._release_once(req)
+            raise
+        obs.count("serve.requests", 1)
+        obs.count(f"serve.requests.{kind}", 1)
+        return req.future
+
+    def submit_bls_aggregate(self, pubkeys: list, message: bytes, signature: bytes) -> Future:
+        """FastAggregateVerify-shaped request; resolves to the exact bool
+        ``ops.bls_batch.batch_verify_aggregates([item])`` returns."""
+        pks = [bytes(p) for p in pubkeys]
+        item = (pks, bytes(message), bytes(signature))
+        cost = 48 * len(pks) + len(item[1]) + len(item[2])
+        return self._submit("bls", item, cost)
+
+    def submit_hash_tree_root(self, chunks: np.ndarray) -> Future:
+        """Merkleize uint8[N, 32] chunks into the root of the pow2
+        subtree holding them; resolves to the exact bytes
+        ``ops.merkle.merkleize_subtree_device(chunks, depth)`` returns
+        for depth = ceil(log2(N))."""
+        chunks = np.ascontiguousarray(chunks)
+        if chunks.ndim != 2 or chunks.shape[1] != 32 or chunks.dtype != np.uint8:
+            raise ValueError("chunks must be uint8[N, 32]")
+        depth = buckets.subtree_depth(chunks.shape[0])
+        return self._submit("htr", (chunks, depth), int(chunks.nbytes))
+
+    def submit_state_root(
+        self, arrays, meta, balances, effective_balance, inactivity_scores, just
+    ) -> Future:
+        """Post-accounting-epoch state root; resolves to the u32[8] root
+        words ``ops.state_root.post_epoch_state_root`` returns."""
+        cost = int(meta.n_validators) * 8 * 3  # the dynamic columns
+        return self._submit(
+            "state_root",
+            (arrays, meta, balances, effective_balance, inactivity_scores, just),
+            cost,
+        )
+
+    # ------------------------------------------------------- batch thread --
+
+    def _pressure(self) -> bool:
+        return self.admission.depth() >= self.config.pressure_depth
+
+    def _idle(self) -> bool:
+        return self._dispatch_q.empty() and not self._dispatch_busy
+
+    def _batch_loop(self) -> None:
+        _SERVICE_TLS.active = True
+        while True:
+            flush = self._batcher.next_flush(
+                self.config.max_batch,
+                self.config.max_wait_s,
+                self._pressure,
+                self._idle if self.config.idle_flush else None,
+            )
+            if flush is None:
+                break
+            reqs, reason = flush
+            now = time.monotonic()
+            waits = sorted((now - r.t_submit) * 1000.0 for r in reqs)
+            with self._waits_lock:
+                self._waits_ms.extend(waits)
+            obs.count("serve.flushes", 1)
+            obs.count(f"serve.flush.{reason}", 1)
+            obs.count("serve.batch_items", len(reqs))
+            obs.event(
+                "serve.flush",
+                reason=reason,
+                batch_size=len(reqs),
+                queue_depth=self.admission.depth(),
+                wait_p50_ms=round(waits[len(waits) // 2], 3),
+                wait_p99_ms=round(waits[min(len(waits) - 1, int(len(waits) * 0.99))], 3),
+            )
+            self._prep(reqs)
+            self._dispatch_q.put(reqs)  # blocks at pipeline depth 2
+        self._dispatch_q.put(None)
+
+    def _prep(self, reqs: list[Request]) -> None:
+        """Host prep, overlapped with the previous flush's device work:
+        SSZ chunk packing for htr, pubkey decompression warm-up for bls.
+        A per-request prep failure resolves THAT future exceptionally and
+        drops the request; co-batched requests are unaffected."""
+        from eth_consensus_specs_tpu.crypto.signature import _load_pk
+        from eth_consensus_specs_tpu.ops.merkle import _chunks_to_words
+
+        for r in reqs:
+            try:
+                if r.kind == "htr":
+                    chunks, depth = r.payload
+                    r.prepped = _chunks_to_words(chunks, 1 << depth)
+                elif r.kind == "bls":
+                    for pk in r.payload[0]:
+                        _load_pk(pk)  # warms the bounded decompression cache
+            except Exception as exc:  # noqa: BLE001 — resolve, don't kill the thread
+                self._resolve(r, exc=exc)
+
+    # ---------------------------------------------------- dispatch thread --
+
+    def _dispatch_loop(self) -> None:
+        _SERVICE_TLS.active = True
+        while True:
+            reqs = self._dispatch_q.get()
+            if reqs is None:
+                break
+            for r in reqs:
+                if r.future.cancelled():
+                    # cancelled while queued: nothing will resolve it, so
+                    # its admission slot must be handed back here
+                    self._release_once(r)
+                    obs.count("serve.cancelled", 1)
+            live = [r for r in reqs if not r.future.done()]
+            if not live:
+                continue
+            t0 = time.monotonic()
+            self._dispatch_busy = True
+            try:
+                with obs.span("serve.dispatch", batch=len(live)):
+                    results = fault.degrade(
+                        "serve.dispatch",
+                        lambda: self._execute(live, device=True),
+                        lambda: self._execute(live, device=False),
+                    )
+            except BaseException as exc:  # noqa: BLE001 — futures carry the error
+                for r in live:
+                    self._resolve(r, exc=exc)
+                continue
+            finally:
+                self._dispatch_busy = False
+            per_req_s = (time.monotonic() - t0) / len(live)
+            for r in live:
+                self._resolve(r, value=results[id(r)], service_s=per_req_s)
+
+    def _execute(self, reqs: list[Request], device: bool) -> dict[int, object]:
+        """Run one flush. ``device=True`` is the bucket-padded batched
+        path (and the fault-injection site); ``device=False`` is the
+        whole-batch host-oracle degradation — bit-identical results,
+        no XLA anywhere."""
+        if device:
+            fault.check("serve.dispatch")
+        results: dict[int, object] = {}
+        bls_reqs = [r for r in reqs if r.kind == "bls"]
+        if bls_reqs:
+            if device:
+                from eth_consensus_specs_tpu.ops.bls_batch import _use_device, verify_many
+
+                if _use_device():
+                    # the device G1 MSM compiles per pow2 committee size
+                    # (the kernel's own bucket grid): account first
+                    # sightings so `serve.compiles` covers BLS traffic too
+                    for r in bls_reqs:
+                        buckets.note_dispatch("bls_msm", buckets.pow2_bucket(len(r.payload[0])))
+                verdicts = verify_many([r.payload for r in bls_reqs])
+            else:
+                from eth_consensus_specs_tpu.crypto.signature import fast_aggregate_verify
+
+                obs.count("serve.degraded_items", len(bls_reqs))
+                verdicts = [fast_aggregate_verify(*r.payload) for r in bls_reqs]
+            for r, v in zip(bls_reqs, verdicts):
+                results[id(r)] = bool(v)
+
+        htr_reqs = [r for r in reqs if r.kind == "htr"]
+        by_depth: dict[int, list[Request]] = {}
+        for r in htr_reqs:
+            by_depth.setdefault(r.payload[1], []).append(r)
+        for depth, group in sorted(by_depth.items()):
+            if device:
+                from eth_consensus_specs_tpu.ops.merkle import merkleize_many_device
+
+                pad = buckets.batch_bucket(len(group), self.config.buckets)
+                buckets.note_dispatch("merkle_many", pad, depth)
+                trees = [r.prepped if r.prepped is not None else r.payload[0] for r in group]
+                roots = merkleize_many_device(trees, depth, pad_batch=pad)
+            else:
+                from eth_consensus_specs_tpu.obs.watchdog import host_tree_root_words
+                from eth_consensus_specs_tpu.ops.merkle import _chunks_to_words
+
+                obs.count("serve.degraded_items", len(group))
+                roots = [
+                    host_tree_root_words(
+                        r.prepped
+                        if r.prepped is not None
+                        else _chunks_to_words(r.payload[0], 1 << depth)
+                    )
+                    for r in group
+                ]
+            for r, root in zip(group, roots):
+                results[id(r)] = root
+
+        for r in reqs:
+            if r.kind != "state_root":
+                continue
+            arrays, meta, balances, eff, inact, just = r.payload
+            if device:
+                from eth_consensus_specs_tpu.ops.state_root import (
+                    post_epoch_state_root,
+                    state_root_compile_key,
+                )
+
+                buckets.note_dispatch(*state_root_compile_key(meta))
+                results[id(r)] = np.asarray(
+                    post_epoch_state_root(arrays, meta, balances, eff, inact, just)
+                )
+            else:
+                from eth_consensus_specs_tpu.ops.state_root import post_epoch_state_root_host
+
+                obs.count("serve.degraded_items", 1)
+                results[id(r)] = np.asarray(
+                    post_epoch_state_root_host(arrays, meta, balances, eff, inact, just)
+                )
+        return results
+
+    def _release_once(self, req: Request, service_s: float | None = None) -> None:
+        """Each request's admission slot is released exactly once, however
+        many paths observe its end (prep failure, cancellation sweep,
+        dispatch resolution) — double release would undercount live load
+        and let admission overshoot the caps."""
+        if req.released:
+            return
+        req.released = True
+        self.admission.release(req.cost_bytes, service_s)
+
+    def _resolve(
+        self, req: Request, value=None, exc: BaseException | None = None,
+        service_s: float | None = None,
+    ) -> None:
+        self._release_once(req, service_s)
+        try:
+            if exc is not None:
+                req.future.set_exception(exc)
+            else:
+                req.future.set_result(value)
+        except Exception:
+            # a caller cancelled the pending future: its slot is already
+            # released above; the worker threads must outlive the rudeness
+            obs.count("serve.cancelled", 1)
+
+    # ------------------------------------------------------------- admin --
+
+    def stats(self) -> dict:
+        with self._waits_lock:
+            waits = sorted(self._waits_ms)
+        counters = obs.snapshot()["counters"]
+        return {
+            "queue_depth": self.admission.depth(),
+            "in_flight_bytes": self.admission.in_flight_bytes(),
+            "p50_wait_ms": round(waits[len(waits) // 2], 3) if waits else None,
+            "p99_wait_ms": (
+                round(waits[min(len(waits) - 1, int(len(waits) * 0.99))], 3) if waits else None
+            ),
+            "flushes": {
+                reason: counters.get(f"serve.flush.{reason}", 0)
+                for reason in ("size", "deadline", "pressure", "idle", "close")
+            },
+            "compiles": counters.get("serve.compiles", 0),
+            "rejected": counters.get("serve.rejected", 0),
+        }
+
+    def precompile(self, keys: list[tuple] | None = None) -> int:
+        """Warm the compile cache from the persistent warmup list (or
+        explicit keys) before taking traffic."""
+        return buckets.precompile(keys)
+
+    def close(self, timeout: float = 30.0) -> None:
+        """Drain queued requests (a final ``close`` flush), stop both
+        threads, emit the run-level ``serve.stats`` event."""
+        with self._close_lock:
+            if self._closed:
+                return
+            self._closed = True
+        self._batcher.close()
+        self._batch_thread.join(timeout=timeout)
+        self._dispatch_thread.join(timeout=timeout)
+        st = self.stats()
+        obs.event(
+            "serve.stats",
+            name=self.name,
+            p50_wait_ms=st["p50_wait_ms"] or 0.0,
+            p99_wait_ms=st["p99_wait_ms"] or 0.0,
+            rejected=st["rejected"],
+            compiles=st["compiles"],
+        )
+
+    def __enter__(self) -> "VerifyService":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()

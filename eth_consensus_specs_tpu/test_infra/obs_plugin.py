@@ -82,6 +82,7 @@ class ObsPlugin:
 
         report = {
             "counters": snap["counters"],
+            "gauges": snap["gauges"],
             "spans": snap["spans"],
             "watchdog": snap["watchdog"],
             "per_test": {nodeid: delta for nodeid, delta in ranked},

@@ -111,6 +111,16 @@ def AggregateVerify(pks: list, messages: list, sig: bytes) -> bool:
 
 @only_with_bls(alt_return=True)
 def FastAggregateVerify(pks: list, message: bytes, sig: bytes) -> bool:
+    # routed service (serve/): concurrent callers coalesce into one RLC
+    # pairing per flush. routed() is None on the service's own threads,
+    # so the service's internal verification never re-enters here.
+    from eth_consensus_specs_tpu import serve
+
+    svc = serve.routed()
+    if svc is not None:
+        return svc.submit_bls_aggregate(
+            [bytes(p) for p in pks], bytes(message), bytes(sig)
+        ).result()
     if _backend == "tpu":
         from eth_consensus_specs_tpu.ops import bls_batch
 

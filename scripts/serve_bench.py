@@ -1,0 +1,230 @@
+"""Closed-loop load generator for the serve/ verification service.
+
+Measures requests/sec of the batched async service against sequential
+per-request ops calls on the SAME payloads, with bit-exact result
+parity enforced, and writes a JSON report (default BENCH_SERVE.json)
+including a request-latency histogram.
+
+Phases:
+
+  1. direct sequential baseline (one thread, per-request ops calls);
+  2. service warmup: ``precompile()`` every (batch-bucket, depth) shape,
+     snapshot the ``serve.compiles`` counter;
+  3. trickle: one submitter, spaced submits — must produce a DEADLINE
+     flush (low-load latency bound);
+  4. load: N closed-loop submitters (each waits for its future before
+     submitting the next) — must produce a SIZE flush and the headline
+     throughput;
+  5. gates: zero watchdog divergences, zero compiles after warmup
+     (so total compiles <= len(buckets) per depth), and — full mode —
+     batched BLS throughput >= 2x sequential.
+
+``--smoke`` shrinks everything for CI (the serve-smoke job in
+checks.yml) and skips the 2x gate; correctness/flush/compile gates
+always apply. Exit code 0 only if every gate passes.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import math
+import os
+import sys
+import threading
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import numpy as np  # noqa: E402
+
+from eth_consensus_specs_tpu import obs, serve  # noqa: E402
+from eth_consensus_specs_tpu.ops import bls_batch  # noqa: E402
+from eth_consensus_specs_tpu.ops.merkle import merkleize_subtree_device  # noqa: E402
+from eth_consensus_specs_tpu.serve.config import ServeConfig  # noqa: E402
+from eth_consensus_specs_tpu.utils import bls  # noqa: E402
+
+
+def build_bls_items(n: int, committee: int, distinct_msgs: int) -> list[tuple]:
+    sks = list(range(1, committee + 1))
+    pks = [bls.SkToPk(sk) for sk in sks]
+    msgs = [bytes([i + 1]) * 32 for i in range(distinct_msgs)]
+    items = []
+    for i in range(n):
+        m = msgs[i % distinct_msgs]
+        sig = bls.Aggregate([bls.Sign(sk, m) for sk in sks])
+        if i % 64 == 7:  # sparse invalid items keep bisection honest
+            sig = b"\x01" + bytes(sig)[1:]
+        items.append((pks, m, sig))
+    return items
+
+
+def build_trees(n: int, depth: int, seed: int = 0) -> list[np.ndarray]:
+    rng = np.random.default_rng(seed)
+    cap = 1 << depth
+    lo = cap // 2 + 1
+    return [
+        rng.integers(0, 256, size=(int(rng.integers(lo, cap + 1)), 32)).astype(np.uint8)
+        for _ in range(n)
+    ]
+
+
+def closed_loop(svc, payloads: list[tuple], submitters: int) -> tuple[float, list, list]:
+    """Each submitter thread works through its share, one outstanding
+    request at a time (closed loop). Returns (seconds, results in
+    payload order, per-request latencies seconds)."""
+    results: list = [None] * len(payloads)
+    latencies: list = [0.0] * len(payloads)
+    shards = [list(range(i, len(payloads), submitters)) for i in range(submitters)]
+    start = threading.Barrier(submitters + 1)
+
+    def run(shard):
+        start.wait()
+        for idx in shard:
+            kind, payload = payloads[idx]
+            t0 = time.perf_counter()
+            while True:
+                try:
+                    if kind == "bls":
+                        fut = svc.submit_bls_aggregate(*payload)
+                    else:
+                        fut = svc.submit_hash_tree_root(payload)
+                    break
+                except serve.Overloaded as exc:
+                    time.sleep(exc.retry_after_s)  # closed loop honors the shed hint
+            results[idx] = fut.result()
+            latencies[idx] = time.perf_counter() - t0
+
+    threads = [threading.Thread(target=run, args=(s,), daemon=True) for s in shards]
+    for t in threads:
+        t.start()
+    start.wait()
+    t0 = time.perf_counter()
+    for t in threads:
+        t.join()
+    return time.perf_counter() - t0, results, latencies
+
+
+def latency_histogram(latencies_s: list[float]) -> dict:
+    """Log2 millisecond buckets: {"<=1ms": n, "<=2ms": n, ...}."""
+    hist: dict[str, int] = {}
+    for lat in latencies_s:
+        ms = lat * 1000.0
+        edge = 1 << max(math.ceil(math.log2(max(ms, 0.001))), 0)
+        hist[f"<={edge}ms"] = hist.get(f"<={edge}ms", 0) + 1
+    return dict(sorted(hist.items(), key=lambda kv: int(kv[0][2:-2])))
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--smoke", action="store_true", help="small CI run, skip the 2x gate")
+    ap.add_argument("--submitters", type=int, default=64)
+    ap.add_argument("--requests", type=int, default=256)
+    ap.add_argument("--tree-depth", type=int, default=10)
+    ap.add_argument("--committee", type=int, default=3)
+    ap.add_argument("--out", default="BENCH_SERVE.json")
+    args = ap.parse_args()
+    if args.smoke:
+        args.submitters = min(args.submitters, 16)
+        args.requests = min(args.requests, 64)
+        args.tree_depth = min(args.tree_depth, 6)
+
+    # max_batch strictly below the submitter count guarantees full (size-
+    # flushed) buckets at steady state instead of racing the deadline
+    cfg = ServeConfig.from_env(max_batch=min(max(args.submitters // 2, 1), 32))
+    bls_items = build_bls_items(args.requests, args.committee, distinct_msgs=4)
+    trees = build_trees(args.requests, args.tree_depth)
+
+    # --- phase 1: sequential per-request direct ops baseline ------------
+    bls_batch.batch_verify_aggregates([bls_items[0]])  # warm parse/h2g2 caches
+    merkleize_subtree_device(trees[0], args.tree_depth)  # pay the direct compile
+    t0 = time.perf_counter()
+    direct_bls = [bls_batch.batch_verify_aggregates([it]) for it in bls_items]
+    seq_bls_s = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    direct_roots = [merkleize_subtree_device(t, args.tree_depth) for t in trees]
+    seq_htr_s = time.perf_counter() - t0
+
+    # --- phase 2: service + bucket warmup -------------------------------
+    svc = serve.VerifyService(cfg, name="bench")
+    warm_keys = [("merkle_many", b, args.tree_depth) for b in cfg.buckets]
+    svc.precompile(warm_keys)
+    compiles_after_warmup = obs.snapshot()["counters"].get("serve.compiles", 0)
+
+    # --- phase 3: trickle (deadline flushes) ----------------------------
+    for it in bls_items[:3]:
+        assert svc.submit_bls_aggregate(*it).result() == bls_batch.batch_verify_aggregates([it])
+        time.sleep(cfg.max_wait_s * 2)
+
+    # --- phase 4: closed-loop load --------------------------------------
+    load_bls = [("bls", it) for it in bls_items]
+    svc_bls_s, got_bls, lat_bls = closed_loop(svc, load_bls, args.submitters)
+    load_htr = [("htr", t) for t in trees]
+    svc_htr_s, got_roots, lat_htr = closed_loop(svc, load_htr, args.submitters)
+    svc.close()
+
+    # --- phase 5: gates --------------------------------------------------
+    failures = []
+    if got_bls != direct_bls:
+        failures.append("BLS parity: service results != direct ops results")
+    if got_roots != direct_roots:
+        failures.append("HTR parity: service roots != direct ops roots")
+    snap = obs.snapshot()
+    counters = snap["counters"]
+    if snap["watchdog"]["divergences"] != 0:
+        failures.append(f"watchdog divergences: {snap['watchdog']}")
+    if counters.get("serve.flush.deadline", 0) < 1:
+        failures.append("no deadline flush observed (trickle phase)")
+    if counters.get("serve.flush.size", 0) < 1:
+        failures.append("no size flush observed (load phase)")
+    extra = counters.get("serve.compiles", 0) - compiles_after_warmup
+    if extra > 0:
+        failures.append(f"{extra} compiles AFTER warmup (shape escaped the buckets)")
+
+    speedup_bls = (args.requests / svc_bls_s) / (args.requests / seq_bls_s)
+    speedup_htr = (args.requests / svc_htr_s) / (args.requests / seq_htr_s)
+    if not args.smoke and speedup_bls < 2.0:
+        failures.append(f"BLS speedup {speedup_bls:.2f}x < 2x over sequential ops calls")
+
+    report = {
+        "mode": "smoke" if args.smoke else "full",
+        "submitters": args.submitters,
+        "requests": args.requests,
+        "bls": {
+            "sequential_rps": round(args.requests / seq_bls_s, 2),
+            "service_rps": round(args.requests / svc_bls_s, 2),
+            "speedup": round(speedup_bls, 3),
+            "latency_ms_histogram": latency_histogram(lat_bls),
+        },
+        "htr": {
+            "tree_depth": args.tree_depth,
+            "sequential_rps": round(args.requests / seq_htr_s, 2),
+            "service_rps": round(args.requests / svc_htr_s, 2),
+            "speedup": round(speedup_htr, 3),
+            "latency_ms_histogram": latency_histogram(lat_htr),
+        },
+        "flushes": {
+            r: counters.get(f"serve.flush.{r}", 0)
+            for r in ("size", "deadline", "pressure", "close")
+        },
+        "compiles": counters.get("serve.compiles", 0),
+        "compiles_after_warmup": max(extra, 0),
+        "buckets": list(cfg.buckets),
+        "rejected": counters.get("serve.rejected", 0),
+        "watchdog": snap["watchdog"],
+        "queue_depth_max": snap["gauges"].get("serve.queue_depth", {}).get("max", 0),
+        "failures": failures,
+    }
+    with open(args.out, "w") as fh:
+        json.dump(report, fh, indent=2, sort_keys=True)
+    print(json.dumps(report, sort_keys=True))
+    if failures:
+        print("FAILED:", *failures, sep="\n  ", file=sys.stderr)
+        raise SystemExit(1)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,317 @@
+"""serve/ — batching semantics, parity, backpressure, degradation.
+
+The service's contract: every future resolves to exactly what the
+direct per-request ops call returns — under concurrency, under load
+shed, and on the degraded host path — while flush behavior (size /
+deadline / pressure) stays observable through serve.* counters.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from concurrent.futures import wait
+
+import numpy as np
+import pytest
+
+from eth_consensus_specs_tpu import fault, obs, serve
+from eth_consensus_specs_tpu.ops import bls_batch
+from eth_consensus_specs_tpu.ops import merkle as ops_merkle
+from eth_consensus_specs_tpu.serve import buckets
+from eth_consensus_specs_tpu.serve.admission import AdmissionController, Overloaded
+from eth_consensus_specs_tpu.serve.config import ServeConfig
+from eth_consensus_specs_tpu.utils import bls
+
+
+def _counter(name: str) -> float:
+    return obs.snapshot()["counters"].get(name, 0)
+
+
+@pytest.fixture(scope="module")
+def bls_items():
+    """8 committee aggregates over 3 distinct messages, two invalid
+    (tampered sig, wrong message)."""
+    sks = [1, 2, 3]
+    pks = [bls.SkToPk(sk) for sk in sks]
+    msgs = [bytes([i + 1]) * 32 for i in range(3)]
+    items = []
+    for i in range(8):
+        m = msgs[i % 3]
+        sig = bls.Aggregate([bls.Sign(sk, m) for sk in sks])
+        if i == 2:
+            sig = b"\x01" + bytes(sig)[1:]  # tampered signature
+        if i == 5:
+            m = bytes([0xEE]) * 32  # signed message != claimed message
+        items.append((pks, m, sig))
+    return items
+
+
+@pytest.fixture(scope="module")
+def trees():
+    rng = np.random.default_rng(7)
+    return [
+        rng.integers(0, 256, size=(n, 32)).astype(np.uint8) for n in (1, 5, 17, 64, 100)
+    ]
+
+
+def _direct_bls(items):
+    return [bls_batch.batch_verify_aggregates([it]) for it in items]
+
+
+def _direct_roots(trees):
+    return [
+        ops_merkle.merkleize_subtree_device(t, buckets.subtree_depth(t.shape[0]))
+        for t in trees
+    ]
+
+
+# --------------------------------------------------------- cost model --
+
+
+def test_crossover_shared_and_pinned():
+    """ops/merkle and the bucket planner share ONE crossover constant,
+    pinned: regressing either side silently would unshare the model."""
+    assert buckets.DEVICE_SUBTREE_THRESHOLD == 4096
+    assert ops_merkle.DEVICE_SUBTREE_THRESHOLD == buckets.DEVICE_SUBTREE_THRESHOLD
+    assert ops_merkle.device_subtree_worthwhile is buckets.device_subtree_worthwhile
+    assert not buckets.device_subtree_worthwhile(4095)
+    assert buckets.device_subtree_worthwhile(4096)
+    # a batched dispatch amortizes: total chunks across trees is what counts
+    assert buckets.device_subtree_worthwhile(1024, trees=4)
+    assert not buckets.device_subtree_worthwhile(1024, trees=3)
+
+
+def test_bucket_helpers():
+    assert [buckets.pow2_bucket(n) for n in (1, 2, 3, 5, 64, 65)] == [1, 2, 4, 8, 64, 128]
+    assert buckets.batch_bucket(3, (1, 2, 4, 8)) == 4
+    assert buckets.batch_bucket(9, (1, 2, 4, 8)) == 8  # capped at the top bucket
+    assert [buckets.subtree_depth(n) for n in (1, 2, 3, 64, 100)] == [0, 1, 2, 6, 7]
+
+
+def test_compile_accounting_dedupes(tmp_path, monkeypatch):
+    monkeypatch.setenv("ETH_SPECS_SERVE_WARMUP", str(tmp_path / "warm.jsonl"))
+    buckets.reset_for_tests()
+    before = _counter("serve.compiles")
+    assert buckets.note_dispatch("merkle_many", 4, 3)
+    assert not buckets.note_dispatch("merkle_many", 4, 3)  # same shape: no recount
+    assert buckets.note_dispatch("merkle_many", 8, 3)
+    assert _counter("serve.compiles") - before == 2
+    assert set(buckets.load_warmup()) == {("merkle_many", 4, 3), ("merkle_many", 8, 3)}
+    # precompile replays the persisted list without crashing
+    buckets.reset_for_tests()
+    assert buckets.precompile() == 2
+    buckets.reset_for_tests()
+
+
+# ------------------------------------------------------------- parity --
+
+
+def test_concurrent_submitters_bit_identical(bls_items, trees):
+    """N concurrent submitters through the service == direct ops calls,
+    bit for bit, with at least one size flush under the burst."""
+    direct_b, direct_r = _direct_bls(bls_items), _direct_roots(trees)
+    flushes_before = _counter("serve.flushes")
+    svc = serve.VerifyService(ServeConfig.from_env(max_batch=8, max_wait_ms=10))
+    results_b = [None] * len(bls_items)
+    results_r = [None] * len(trees)
+    barrier = threading.Barrier(len(bls_items) + len(trees))
+
+    def submit_bls(i):
+        barrier.wait()
+        results_b[i] = svc.submit_bls_aggregate(*bls_items[i]).result(timeout=60)
+
+    def submit_htr(i):
+        barrier.wait()
+        results_r[i] = svc.submit_hash_tree_root(trees[i]).result(timeout=60)
+
+    threads = [
+        threading.Thread(target=submit_bls, args=(i,)) for i in range(len(bls_items))
+    ] + [threading.Thread(target=submit_htr, args=(i,)) for i in range(len(trees))]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=120)
+    svc.close()
+    assert results_b == direct_b
+    assert results_r == direct_r
+    assert _counter("serve.flushes") > flushes_before
+
+
+def test_verify_many_parity(bls_items):
+    direct = _direct_bls(bls_items)
+    assert bls_batch.verify_many(bls_items) == direct
+    # malformed inputs short-circuit to False without poisoning the batch
+    bad = [(bls_items[0][0], bls_items[0][1], b"\x00" * 96), ([], b"\x01" * 32, b"\x00" * 96)]
+    assert bls_batch.verify_many(bls_items + bad) == direct + [False, False]
+    assert bls_batch.verify_many([]) == []
+
+
+def test_merkleize_many_matches_single(trees):
+    depth = 7
+    many = ops_merkle.merkleize_many_device(trees, depth, pad_batch=8)
+    single = [ops_merkle.merkleize_subtree_device(t, depth) for t in trees]
+    assert many == single
+
+
+# ---------------------------------------------------- flush semantics --
+
+
+def test_deadline_flush_under_low_load(bls_items):
+    """A lone request must not wait for co-riders that aren't coming."""
+    deadline_before = _counter("serve.flush.deadline")
+    with serve.VerifyService(ServeConfig.from_env(max_batch=64, max_wait_ms=15)) as svc:
+        t0 = time.monotonic()
+        assert svc.submit_bls_aggregate(*bls_items[0]).result(timeout=30) is True
+        elapsed = time.monotonic() - t0
+    assert _counter("serve.flush.deadline") > deadline_before
+    assert elapsed < 10  # deadline-bounded, not size-starved
+
+
+def test_idle_flush_single_submitter(bls_items):
+    """idle_flush (the gen-worker mode): a lone synchronous submitter
+    flushes immediately instead of paying the deadline every request."""
+    idle_before = _counter("serve.flush.idle")
+    cfg = ServeConfig.from_env(max_batch=64, max_wait_ms=500, idle_flush=True)
+    with serve.VerifyService(cfg) as svc:
+        t0 = time.monotonic()
+        for _ in range(3):
+            assert svc.submit_bls_aggregate(*bls_items[0]).result(timeout=30) is True
+        elapsed = time.monotonic() - t0
+    assert _counter("serve.flush.idle") > idle_before
+    assert elapsed < 1.0  # 3 requests, 500ms deadline never paid
+
+
+def test_config_direct_construction_keeps_bucket_invariant():
+    """A directly-constructed config (not from_env) must still hold a
+    full flush in its largest bucket."""
+    cfg = ServeConfig(max_batch=128)
+    assert cfg.buckets[-1] >= cfg.max_batch
+    assert buckets.batch_bucket(cfg.max_batch, cfg.buckets) >= cfg.max_batch
+
+
+def test_overloaded_at_cap(trees):
+    """Past max_queue, submit raises a typed Overloaded with a
+    retry-after hint; admitted work still completes correctly."""
+    rejected_before = _counter("serve.rejected")
+    with fault.injected("serve.dispatch:stall:delay=2:times=1"):
+        svc = serve.VerifyService(
+            ServeConfig.from_env(max_batch=2, max_wait_ms=1, max_queue=4)
+        )
+        futs, overload = [], None
+        for _ in range(12):
+            try:
+                futs.append(svc.submit_hash_tree_root(trees[3]))
+            except Overloaded as exc:
+                overload = exc
+                break
+            time.sleep(0.005)
+        assert overload is not None, "cap never shed"
+        assert overload.retry_after_s > 0
+        assert overload.reason == "queue"
+        wait(futs, timeout=60)
+        direct = ops_merkle.merkleize_subtree_device(trees[3], 6)
+        assert all(f.result() == direct for f in futs)
+        svc.close()
+    assert _counter("serve.rejected") > rejected_before
+
+
+def test_admission_bytes_cap_admits_singleton():
+    """A request bigger than the whole byte budget is admitted when the
+    service is empty (it could otherwise never run) but rejected when
+    anything is in flight."""
+    ctrl = AdmissionController(max_queue=10, max_bytes=100)
+    ctrl.admit(1000)  # empty service: the budget is all yours
+    with pytest.raises(Overloaded) as exc_info:
+        ctrl.admit(50)
+    assert exc_info.value.reason == "bytes"
+    ctrl.release(1000)
+    ctrl.admit(50)
+    ctrl.release(50)
+
+
+# --------------------------------------------------------- degradation --
+
+
+def test_device_kill_degrades_whole_batch(bls_items, trees):
+    """ETH_SPECS_FAULT=serve.dispatch:raise:times=inf kills the device
+    path every attempt: the WHOLE batch must degrade to host oracles
+    with bit-identical results and a fault.degraded breadcrumb."""
+    direct_b, direct_r = _direct_bls(bls_items), _direct_roots(trees)
+    degraded_before = _counter("fault.degraded.serve.dispatch")
+    with fault.injected("serve.dispatch:raise:times=inf"):
+        with serve.VerifyService(ServeConfig.from_env(max_batch=8, max_wait_ms=5)) as svc:
+            bf = [svc.submit_bls_aggregate(*it) for it in bls_items]
+            rf = [svc.submit_hash_tree_root(t) for t in trees]
+            wait(bf + rf, timeout=120)
+            assert [f.result() for f in bf] == direct_b
+            assert [f.result() for f in rf] == direct_r
+    assert _counter("fault.degraded.serve.dispatch") > degraded_before
+    assert _counter("serve.degraded_items") > 0
+
+
+# ------------------------------------------------------------- routing --
+
+
+def test_routed_fast_aggregate_verify(bls_items):
+    """With a routed service installed, utils/bls.FastAggregateVerify
+    coalesces through it — same verdicts, serve.requests.bls counted."""
+    pks, msg, sig = bls_items[0]
+    direct = bls.FastAggregateVerify(pks, msg, sig)
+    before = _counter("serve.requests.bls")
+    svc = serve.VerifyService(ServeConfig.from_env(max_batch=4, max_wait_ms=2))
+    serve.install_routing(svc)
+    try:
+        assert bls.FastAggregateVerify(pks, msg, sig) == direct
+        assert bls.FastAggregateVerify(*bls_items[2]) is False  # tampered
+    finally:
+        serve.uninstall_routing()
+        svc.close()
+    assert _counter("serve.requests.bls") - before == 2
+    assert serve.routed() is None
+
+
+# ------------------------------------------------------- thread safety --
+
+
+def test_h2g2_cache_concurrent_prime():
+    """Concurrent primes under distinct DSTs must never corrupt the
+    (dst, message)-keyed cache or blow its bound."""
+    sentinel = object()
+
+    def batch_fn(msgs, dst):
+        return [sentinel] * len(msgs)
+
+    errors = []
+
+    def hammer(worker: int):
+        try:
+            for i in range(50):
+                dst = b"DST-%d" % (worker % 3)
+                msgs = [bytes([worker, i, j]) for j in range(8)]
+                bls_batch._prime_h2g2_cache(msgs, batch_fn, dst=dst)
+                for m in msgs:
+                    with bls_batch._H2G2_LOCK:
+                        hit = bls_batch._H2G2_CACHE.get((dst, m))
+                    assert hit is None or hit is sentinel
+        except Exception as exc:  # pragma: no cover - failure path
+            errors.append(exc)
+
+    threads = [threading.Thread(target=hammer, args=(w,)) for w in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=60)
+    assert not errors
+    with bls_batch._H2G2_LOCK:
+        size = len(bls_batch._H2G2_CACHE)
+        bls_batch._H2G2_CACHE.clear()  # don't leak sentinels into later tests
+    assert size <= 512 + 8  # bound holds modulo one in-flight batch per thread
+
+
+def test_obs_gauge_last_and_max():
+    obs.gauge("test.depth", 3)
+    obs.gauge("test.depth", 7)
+    obs.gauge("test.depth", 2)
+    g = obs.snapshot()["gauges"]["test.depth"]
+    assert g["last"] == 2 and g["max"] == 7
