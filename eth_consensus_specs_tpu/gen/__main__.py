@@ -72,6 +72,7 @@ def main() -> None:
     workers = args.workers
     if workers is not None and workers != "auto":
         workers = int(workers)
+    obs.export.maybe_serve_http()  # scrapeable during a long run (env-gated)
     stats = run_generator(
         cases,
         args.output,

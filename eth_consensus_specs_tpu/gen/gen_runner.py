@@ -33,6 +33,7 @@ import traceback
 from collections import deque
 
 from eth_consensus_specs_tpu import fault, obs
+from eth_consensus_specs_tpu.obs import trace
 
 from .dumper import Dumper
 from .gen_from_tests import TestCase
@@ -126,9 +127,18 @@ def run_generator(
     if resumed:
         obs.count("gen.cases_resumed", resumed)
         obs.event("gen.resume", resumed=resumed, pending=len(pending_cases))
+    # one trace per run: every case (sequential or in a pool worker)
+    # runs under a child context, so all gen.case spans — including
+    # those recorded in worker processes and shipped via the shared
+    # JSONL sink — stitch back to this root
+    run_ctx = trace.new_trace()
+    obs.event("gen.run", cases=len(pending_cases), **trace.event_fields(run_ctx))
     try:
         if workers in (None, 0, 1):
-            stats = _run_sequential(pending_cases, output_dir, verbose, case_retries, manifest)
+            with trace.activate(run_ctx):
+                stats = _run_sequential(
+                    pending_cases, output_dir, verbose, case_retries, manifest
+                )
         else:
             # os.cpu_count() may return None (unknown topology): default to
             # one worker rather than crashing on None - 1
@@ -141,6 +151,7 @@ def run_generator(
                 case_timeout,
                 case_retries,
                 manifest,
+                run_ctx,
             )
     finally:
         manifest.close()
@@ -164,7 +175,9 @@ def _run_sequential(
         def _attempt(case=case):
             nonlocal attempts_used
             attempts_used += 1
-            return execute_case(case, dumper)
+            # per-case trace span: child of the run root active here
+            with trace.activate(trace.child()):
+                return execute_case(case, dumper)
 
         try:
             out = fault.retrying(
@@ -247,25 +260,72 @@ def _pool_shutdown():
 
 
 _WORKER_OBS_BASE: dict = {}
+_WORKER_GAUGE_BASE: dict = {}
+_WORKER_HIST_BASE: dict = {}
 
 
 def _worker_obs_delta() -> dict:
-    """Delta of ALL this worker's obs counters since the previous case —
+    """Delta of ALL this worker's obs state since the previous case —
     shipped with each result so pool mode reports what sequential mode
-    does: dumper totals (gen.parts, gen.bytes_serialized), kernel
-    counters, and above all watchdog.checks/.divergences (a divergence
-    detected inside a worker MUST reach the parent registry). Only
-    gen.cases_* stay out: the parent mirrors those from its own
-    authoritative status counts."""
+    does. Three sections:
+
+    * ``counters`` — dumper totals (gen.parts, gen.bytes_serialized),
+      kernel counters, and above all watchdog.checks/.divergences (a
+      divergence detected inside a worker MUST reach the parent
+      registry). Only gen.cases_* stay out: the parent mirrors those
+      from its own authoritative status counts.
+    * ``gauges`` — current {last, max} per gauge (queue depth etc.)
+      that CHANGED since the previous ship (gauges inherited across the
+      fork are swallowed at init like counters — a stale forked ``last``
+      must not overwrite the parent's fresher one); the parent merges
+      last as latest-wins and max monotonically.
+    * ``histograms`` — bucket-count deltas of every histogram (the
+      worker's serve.wait_ms distribution): min/max ship as current
+      values (they only tighten, so repeated min/max-merging is
+      idempotent), counts/sum as differences — without this a pool
+      worker's whole wait distribution died with the process."""
     global _WORKER_OBS_BASE
+    snap = obs.snapshot()
     now = {
         k: v
-        for k, v in obs.snapshot()["counters"].items()
+        for k, v in snap["counters"].items()
         if not k.startswith("gen.cases_")
     }
-    delta = {k: v - _WORKER_OBS_BASE.get(k, 0) for k, v in now.items()}
+    counters = {k: v - _WORKER_OBS_BASE.get(k, 0) for k, v in now.items()}
     _WORKER_OBS_BASE = now
-    return {k: v for k, v in delta.items() if v}
+    gauges = {}
+    for name, g in snap["gauges"].items():
+        if _WORKER_GAUGE_BASE.get(name) != g:
+            _WORKER_GAUGE_BASE[name] = g
+            gauges[name] = g
+    hists = {}
+    for name, hsnap in snap["histograms"].items():
+        base = _WORKER_HIST_BASE.get(name)
+        if base is not None and hsnap["count"] == base["count"]:
+            continue
+        delta = dict(hsnap)
+        if base is not None:
+            delta["counts"] = [c - b for c, b in zip(hsnap["counts"], base["counts"])]
+            delta["count"] = hsnap["count"] - base["count"]
+            delta["sum"] = hsnap["sum"] - base["sum"]
+        _WORKER_HIST_BASE[name] = hsnap
+        hists[name] = delta
+    return {
+        "counters": {k: v for k, v in counters.items() if v},
+        "gauges": gauges,
+        "histograms": hists,
+    }
+
+
+def _merge_worker_obs(delta: dict) -> None:
+    """Fold one worker result's obs delta into the parent registry."""
+    reg = obs.get_registry()
+    for name, nv in delta.get("counters", {}).items():
+        obs.count(name, nv)
+    for name, g in delta.get("gauges", {}).items():
+        reg.merge_gauge(name, g)
+    for name, hsnap in delta.get("histograms", {}).items():
+        reg.merge_histogram(name, hsnap)
 
 
 def _pool_exec(key: tuple) -> tuple:
@@ -297,9 +357,14 @@ def _worker_main(task_q, result_q, output_dir: str, presets: tuple, forks: tuple
     done = 0
     try:
         while True:
-            key = task_q.get()
-            if key is None:
+            task = task_q.get()
+            if task is None:
                 break
+            # tasks ship as (key, trace-wire): the parent's per-case
+            # context crosses the process boundary in the payload, so
+            # worker-side gen.case spans (shared JSONL sink) stitch
+            # into the parent's trace tree
+            key, wire = task
             try:
                 # the case's wall clock starts HERE, not at dispatch: init and
                 # queue latency must not eat the case's deadline budget
@@ -307,7 +372,8 @@ def _worker_main(task_q, result_q, output_dir: str, presets: tuple, forks: tuple
             except Exception:
                 break
             try:
-                res = _pool_exec(key)
+                with trace.activate(trace.from_wire(wire)):
+                    res = _pool_exec(key)
             except BaseException:
                 # _pool_exec already catches case errors; this guards the
                 # machinery itself — report and keep serving
@@ -345,6 +411,7 @@ def _run_pool(
     case_timeout: float | None,
     case_retries: int,
     manifest: RunManifest,
+    run_ctx=None,
 ) -> dict:
     """Process-parallel execution with crash/hang recovery, progress and
     RSS telemetry. The parent collects results asynchronously and sweeps
@@ -416,7 +483,9 @@ def _run_pool(
                 if not pending:
                     break
                 key = pending.popleft()
-                w.task_q.put(key)
+                # ship the per-case trace context with the task: the
+                # worker activates it around the case execution
+                w.task_q.put((key, trace.to_wire(trace.child(run_ctx))))
                 w.busy_key = key
                 w.deadline = (
                     time.monotonic() + case_timeout + _STARTUP_GRACE_S
@@ -454,8 +523,7 @@ def _run_pool(
                             w.deadline = None
                         losses_since_progress = 0
                         max_rss = max(max_rss, rss)
-                        for cname, nv in obs_delta.items():
-                            obs.count(cname, nv)
+                        _merge_worker_obs(obs_delta)
                         if key in resolved:
                             pass  # late duplicate of a re-dispatched case
                         elif status == "failed":

@@ -18,17 +18,34 @@ package makes the discipline ambient:
   * a JSONL event sink (``ETH_SPECS_OBS_JSONL=<path>``) and a pytest
     plugin (test_infra/obs_plugin.py) that emits ``obs_report.json``.
 
+Export/attribution layer on top (this PR's tentpole):
+
+  * ``obs.observe("serve.wait_ms", ms)`` — mergeable fixed-log-bucket
+    histograms (obs/histogram.py): run-level quantiles from buckets,
+    cross-process merge (gen-pool workers ship bucket deltas);
+  * ``obs.trace`` — trace contexts that survive thread hand-offs and
+    process boundaries; spans under an active context carry
+    trace_id/span_id/parent_span in their events;
+  * ``obs.export`` — Prometheus text exposition of the full snapshot
+    (textfile and/or stdlib HTTP ``/metrics``);
+  * ``obs.slo`` — declarative SLOs evaluated from any snapshot.
+
 Environment:
     ETH_SPECS_OBS=0              disable all recording
     ETH_SPECS_OBS_JSONL=<path>   stream structured events as JSON lines
     ETH_SPECS_OBS_WATCHDOG=<r>   watchdog sampling rate (default 0.05;
                                  0 disables, 1 checks every call)
     ETH_SPECS_OBS_REPORT=<path>  pytest run-level report destination
+    ETH_SPECS_OBS_PROM=<path>    Prometheus textfile destination
+    ETH_SPECS_OBS_HTTP_PORT=<p>  serve GET /metrics on 127.0.0.1:<p>
+    ETH_SPECS_SLO_WAIT_P99_MS    serve wait p99 SLO bound (default 250)
+    ETH_SPECS_SLO_DEGRADED_RATE  degraded-per-request SLO bound (0.01)
 """
 
 from __future__ import annotations
 
-from . import gates, watchdog  # noqa: F401  (public submodules)
+from . import export, gates, slo, trace, watchdog  # noqa: F401  (public submodules)
+from .histogram import Histogram  # noqa: F401
 from .registry import Registry, get_registry, obs_enabled  # noqa: F401
 
 
@@ -56,6 +73,18 @@ def gauge(name: str, value: int | float) -> None:
     """Record a point-in-time level (can go down, unlike a counter); the
     snapshot keeps last + max per gauge."""
     get_registry().gauge(name, value)
+
+
+def observe(name: str, value: float) -> None:
+    """Record a sample into the named mergeable log-bucket histogram
+    (obs/histogram.py): O(1), lock-cheap, quantiles from buckets —
+    the primitive behind run-level latency p50/p99."""
+    get_registry().observe(name, value)
+
+
+def histogram(name: str) -> Histogram | None:
+    """The named registry histogram, or None if nothing observed yet."""
+    return get_registry().histogram(name)
 
 
 def event(kind: str, **fields) -> None:

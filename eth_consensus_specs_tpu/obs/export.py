@@ -1,0 +1,271 @@
+"""Prometheus text exposition of the registry snapshot.
+
+Turns ``obs.snapshot()`` into the Prometheus text format (version
+0.0.4): counters as ``<name>_total``, gauges as ``<name>`` +
+``<name>_max``, mergeable histograms (obs/histogram.py) as classic
+``<name>_bucket{le="..."}`` series with **cumulative** counts ending in
+``le="+Inf"``, plus ``_sum``/``_count``, and span aggregates as the
+``<name>_calls_total`` / ``<name>_seconds_total`` counter pair. Every
+family gets well-formed ``# HELP`` and ``# TYPE`` lines.
+
+Two delivery modes, both env-gated and both optional:
+
+  * **textfile** — ``ETH_SPECS_OBS_PROM=<path>`` names a file that
+    :func:`write_textfile` atomically replaces (tmp + ``os.replace``);
+    point a node-exporter textfile collector (or CI assertion) at it.
+    The pytest plugin and scripts/serve_bench.py call this at exit.
+  * **HTTP** — ``ETH_SPECS_OBS_HTTP_PORT=<port>`` (or an explicit
+    port) starts a stdlib ThreadingHTTPServer on 127.0.0.1 serving
+    ``GET /metrics`` from a fresh snapshot per scrape; ``0`` picks a
+    free port (tests). Daemon threads: never blocks process exit.
+
+:func:`validate_text` is the shared parser-side checker (tests and the
+CI obs-report job use it): metric-name grammar, HELP/TYPE present and
+consistent, histogram buckets cumulative and capped by ``+Inf`` ==
+``_count``.
+"""
+
+from __future__ import annotations
+
+import math
+import os
+import re
+import threading
+
+from .registry import get_registry
+
+_NAME_RE = re.compile(r"^[a-zA-Z_:][a-zA-Z0-9_:]*$")
+_SAMPLE_RE = re.compile(
+    r"^(?P<name>[a-zA-Z_:][a-zA-Z0-9_:]*)"
+    r"(?:\{(?P<labels>[^}]*)\})?\s+(?P<value>[^ ]+)$"
+)
+
+
+def metric_name(name: str) -> str:
+    """obs names are dotted (``serve.wait_ms``); Prometheus names are
+    underscore-y (``serve_wait_ms``). Anything else illegal collapses to
+    ``_`` and a leading digit gets a prefix."""
+    out = re.sub(r"[^a-zA-Z0-9_:]", "_", name)
+    if not out or not re.match(r"[a-zA-Z_:]", out[0]):
+        out = "_" + out
+    return out
+
+
+def _fmt(v: float) -> str:
+    if v == math.inf:
+        return "+Inf"
+    if isinstance(v, float) and v.is_integer() and abs(v) < 1e15:
+        return str(int(v))
+    return repr(float(v)) if isinstance(v, float) else str(v)
+
+
+def prometheus_text(snap: dict | None = None) -> str:
+    """Render a registry snapshot (default: the live registry) as
+    Prometheus text exposition."""
+    if snap is None:
+        snap = get_registry().snapshot()
+    lines: list[str] = []
+
+    def family(name: str, typ: str, help_text: str):
+        lines.append(f"# HELP {name} {help_text}")
+        lines.append(f"# TYPE {name} {typ}")
+
+    for cname in sorted(snap.get("counters", ())):
+        name = metric_name(cname) + "_total"
+        family(name, "counter", f"obs counter {cname}")
+        lines.append(f"{name} {_fmt(snap['counters'][cname])}")
+
+    for gname in sorted(snap.get("gauges", ())):
+        g = snap["gauges"][gname]
+        name = metric_name(gname)
+        family(name, "gauge", f"obs gauge {gname} (last observed level)")
+        lines.append(f"{name} {_fmt(g.get('last', 0.0))}")
+        family(name + "_max", "gauge", f"obs gauge {gname} (max observed level)")
+        lines.append(f"{name}_max {_fmt(g.get('max', 0.0))}")
+
+    from .histogram import Histogram
+
+    for hname in sorted(snap.get("histograms", ())):
+        h = Histogram.from_snapshot(snap["histograms"][hname])
+        name = metric_name(hname)
+        family(name, "histogram", f"obs log-bucket histogram {hname}")
+        cum = 0
+        prev_edge = None
+        for edge, count in zip(h.upper_edges(), h.counts):
+            cum += count
+            # empty-range buckets are noise at scrape time; keep any
+            # nonzero bucket, the first, and the +Inf cap
+            if count or prev_edge is None or edge == math.inf:
+                lines.append(f'{name}_bucket{{le="{_fmt(edge)}"}} {cum}')
+            prev_edge = edge
+        lines.append(f"{name}_sum {_fmt(h.sum)}")
+        lines.append(f"{name}_count {h.count}")
+
+    for sname in sorted(snap.get("spans", ())):
+        agg = snap["spans"][sname]
+        name = metric_name(sname)
+        family(name + "_calls_total", "counter", f"obs span {sname} call count")
+        lines.append(f"{name}_calls_total {_fmt(agg.get('count', 0))}")
+        family(name + "_seconds_total", "counter", f"obs span {sname} total wall seconds")
+        lines.append(f"{name}_seconds_total {_fmt(agg.get('total_s', 0.0))}")
+
+    return "\n".join(lines) + "\n"
+
+
+def write_textfile(path: str | None = None, snap: dict | None = None) -> str | None:
+    """Atomically write the exposition to ``path`` (default:
+    ``ETH_SPECS_OBS_PROM``; unset → no-op returning None)."""
+    path = path or os.environ.get("ETH_SPECS_OBS_PROM") or None
+    if not path:
+        return None
+    text = prometheus_text(snap)
+    tmp = path + ".tmp"
+    with open(tmp, "w") as fh:
+        fh.write(text)
+    os.replace(tmp, path)
+    return path
+
+
+# ------------------------------------------------------------------- http --
+
+_HTTP_SERVER = None
+_HTTP_LOCK = threading.Lock()
+
+
+def maybe_serve_http():
+    """Idempotent env-gated starter: the first caller in a process with
+    ``ETH_SPECS_OBS_HTTP_PORT`` set starts the endpoint, later callers
+    get the running server back. Entry points that stay alive long
+    enough to scrape (pytest sessions, serve_bench, the gen CLI) call
+    this so the documented knob works without wiring."""
+    global _HTTP_SERVER
+    with _HTTP_LOCK:
+        if _HTTP_SERVER is None:
+            try:
+                _HTTP_SERVER = serve_http()
+            except OSError:  # port taken (another process owns the scrape)
+                return None
+        return _HTTP_SERVER
+
+
+def serve_http(port: int | None = None):
+    """Start a daemon metrics endpoint on 127.0.0.1 serving
+    ``GET /metrics``; returns the server (``.server_address[1]`` is the
+    bound port, ``.shutdown()`` stops it) or None when no port is
+    configured. ``port=0`` binds an ephemeral port."""
+    if port is None:
+        raw = os.environ.get("ETH_SPECS_OBS_HTTP_PORT")
+        if raw is None or raw == "":
+            return None
+        port = int(raw)
+    from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+    class _Handler(BaseHTTPRequestHandler):
+        def do_GET(self):  # noqa: N802 — stdlib handler naming
+            if self.path.split("?")[0] not in ("/metrics", "/"):
+                self.send_error(404)
+                return
+            body = prometheus_text().encode()
+            self.send_response(200)
+            self.send_header("Content-Type", "text/plain; version=0.0.4; charset=utf-8")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def log_message(self, *args):  # silence per-scrape stderr chatter
+            pass
+
+    server = ThreadingHTTPServer(("127.0.0.1", port), _Handler)
+    server.daemon_threads = True
+    threading.Thread(target=server.serve_forever, name="obs-metrics-http",
+                     daemon=True).start()
+    return server
+
+
+# -------------------------------------------------------------- validation --
+
+
+def validate_text(text: str) -> dict:
+    """Parse an exposition and raise ValueError on any malformation:
+    unknown-family samples, missing/duplicated HELP or TYPE, illegal
+    names, non-cumulative histogram buckets, missing ``+Inf`` cap, or
+    ``+Inf`` != ``_count``. Returns {families, samples} tallies (handy
+    for asserts)."""
+    helps: dict[str, str] = {}
+    types: dict[str, str] = {}
+    samples: list[tuple[str, str | None, float]] = []
+    for lineno, line in enumerate(text.splitlines(), 1):
+        if not line.strip():
+            continue
+        if line.startswith("# HELP "):
+            _, _, rest = line.partition("# HELP ")
+            name, _, help_text = rest.partition(" ")
+            if not _NAME_RE.match(name):
+                raise ValueError(f"line {lineno}: illegal metric name {name!r}")
+            if name in helps:
+                raise ValueError(f"line {lineno}: duplicate HELP for {name}")
+            helps[name] = help_text
+        elif line.startswith("# TYPE "):
+            _, _, rest = line.partition("# TYPE ")
+            name, _, typ = rest.partition(" ")
+            if typ not in ("counter", "gauge", "histogram", "summary", "untyped"):
+                raise ValueError(f"line {lineno}: unknown type {typ!r} for {name}")
+            if name in types:
+                raise ValueError(f"line {lineno}: duplicate TYPE for {name}")
+            types[name] = typ
+        elif line.startswith("#"):
+            continue
+        else:
+            m = _SAMPLE_RE.match(line)
+            if m is None:
+                raise ValueError(f"line {lineno}: unparseable sample {line!r}")
+            value = float(m.group("value"))
+            labels = m.group("labels")
+            samples.append((m.group("name"), labels, value))
+
+    for name in helps:
+        if name not in types:
+            raise ValueError(f"HELP without TYPE for {name}")
+    for name in types:
+        if name not in helps:
+            raise ValueError(f"TYPE without HELP for {name}")
+
+    def _family(sample_name: str) -> str:
+        for suffix in ("_bucket", "_sum", "_count"):
+            base = sample_name[: -len(suffix)] if sample_name.endswith(suffix) else None
+            if base and types.get(base) == "histogram":
+                return base
+        return sample_name
+
+    by_family: dict[str, list] = {}
+    for sname, labels, value in samples:
+        fam = _family(sname)
+        if fam not in types:
+            raise ValueError(f"sample {sname} belongs to no declared family")
+        by_family.setdefault(fam, []).append((sname, labels, value))
+
+    for fam, typ in types.items():
+        if typ != "histogram":
+            continue
+        buckets: list[tuple[float, float]] = []
+        count = None
+        for sname, labels, value in by_family.get(fam, ()):
+            if sname == fam + "_bucket":
+                lem = re.search(r'le="([^"]+)"', labels or "")
+                if lem is None:
+                    raise ValueError(f"{fam}: bucket sample without le label")
+                le = math.inf if lem.group(1) == "+Inf" else float(lem.group(1))
+                buckets.append((le, value))
+            elif sname == fam + "_count":
+                count = value
+        if not buckets or buckets[-1][0] != math.inf:
+            raise ValueError(f"{fam}: histogram without +Inf bucket")
+        for (le0, c0), (le1, c1) in zip(buckets, buckets[1:]):
+            if le1 <= le0:
+                raise ValueError(f"{fam}: bucket edges not increasing ({le0} -> {le1})")
+            if c1 < c0:
+                raise ValueError(f"{fam}: bucket counts not cumulative ({c0} -> {c1})")
+        if count is None or buckets[-1][1] != count:
+            raise ValueError(f"{fam}: +Inf bucket != _count ({buckets[-1][1]} != {count})")
+
+    return {"families": len(types), "samples": len(samples)}

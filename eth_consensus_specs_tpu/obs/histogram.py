@@ -1,0 +1,207 @@
+"""Mergeable fixed-log-bucket histogram — the latency primitive.
+
+A reservoir (serve/service.py's old 4096-sample deque) answers "p99 of
+the last 4096 waits", silently truncates history under load, and has to
+sort under a lock to answer anything. A fixed-layout log-bucket
+histogram answers "p99 of the whole run" in O(buckets), records in
+O(1), and — because every histogram with the same ``(lo, growth)``
+layout has bit-identical bucket edges — two of them **merge** by adding
+counts. That last property is what lets a gen-pool worker ship its wait
+distribution to the parent as a delta (gen/gen_runner.py) and lets a
+run-level report aggregate per-process histograms without ever seeing a
+raw sample.
+
+Layout: bucket ``i`` covers ``(lo * growth**(i-1), lo * growth**i]``;
+values ``<= lo`` land in bucket 0, values past the last edge in the
+overflow bucket (whose upper edge is +Inf). The default layout —
+``lo=1e-3, hi=1e7, growth=2**(1/4)`` — spans sub-microsecond to ~3 h
+when recording milliseconds, in 134 buckets, with quantile relative
+error bounded by ``sqrt(growth)-1`` ≈ 9 % (quantiles report the
+geometric midpoint of the winning bucket, clamped to the observed
+min/max so small samples stay exact-ish).
+
+Thread safety: one lock per histogram, held for an O(1) list increment
+— no sorting, no allocation, no global registry lock on the record
+path.
+
+Serialization: :meth:`snapshot` is a plain JSON-able dict;
+:meth:`from_snapshot` reconstructs (derived convenience fields are
+ignored), so a snapshot that crossed a process boundary or a JSON file
+still answers quantile queries (obs/slo.py evaluates SLOs from exactly
+such snapshots).
+"""
+
+from __future__ import annotations
+
+import math
+import threading
+
+# default layout: shared by every histogram the registry auto-creates,
+# so any two registries' same-named histograms are always mergeable
+DEFAULT_LO = 1e-3
+DEFAULT_HI = 1e7
+DEFAULT_GROWTH = 2.0 ** 0.25
+
+
+class Histogram:
+    __slots__ = ("lo", "growth", "counts", "count", "sum", "min", "max",
+                 "_log_growth", "_lock")
+
+    def __init__(self, lo: float = DEFAULT_LO, hi: float = DEFAULT_HI,
+                 growth: float = DEFAULT_GROWTH):
+        if not (lo > 0 and hi > lo and growth > 1):
+            raise ValueError("need lo > 0, hi > lo, growth > 1")
+        self.lo = float(lo)
+        self.growth = float(growth)
+        self._log_growth = math.log(self.growth)
+        n = int(math.ceil(math.log(hi / lo) / self._log_growth))
+        # counts[0] covers (-inf, lo]; counts[n+1] is the overflow bucket
+        self.counts: list[int] = [0] * (n + 2)
+        self.count = 0
+        self.sum = 0.0
+        self.min = math.inf
+        self.max = -math.inf
+        self._lock = threading.Lock()
+
+    # ------------------------------------------------------------ record --
+
+    def _index(self, value: float) -> int:
+        if value <= self.lo:
+            return 0
+        i = int(math.ceil(math.log(value / self.lo) / self._log_growth))
+        # float fuzz at an exact edge can land one bucket high/low; both
+        # stay within the layout's error bound, so only clamp the range
+        return min(max(i, 0), len(self.counts) - 1)
+
+    def record(self, value: float) -> None:
+        value = float(value)
+        i = self._index(value)
+        with self._lock:
+            self.counts[i] += 1
+            self.count += 1
+            self.sum += value
+            if value < self.min:
+                self.min = value
+            if value > self.max:
+                self.max = value
+
+    # ------------------------------------------------------------- query --
+
+    def upper_edges(self) -> list[float]:
+        """Inclusive upper bucket edges, last one +Inf — the Prometheus
+        ``le`` sequence."""
+        n = len(self.counts) - 1
+        return [self.lo * self.growth ** i for i in range(n)] + [math.inf]
+
+    def quantile(self, q: float) -> float | None:
+        """q in [0, 1]; None when empty. Returns the geometric midpoint
+        of the bucket holding the q-th sample, clamped to the observed
+        [min, max] (so p0/p100 are exact and tiny samples don't report
+        an edge nobody hit)."""
+        if not 0.0 <= q <= 1.0:
+            raise ValueError("quantile must be in [0, 1]")
+        with self._lock:
+            total = self.count
+            counts = list(self.counts)
+            lo_seen, hi_seen = self.min, self.max
+        if total == 0:
+            return None
+        if q == 0.0:
+            return float(lo_seen)
+        if q == 1.0:
+            return float(hi_seen)
+        rank = max(q * total, 1.0)  # 1-based rank of the target sample
+        acc = 0
+        idx = len(counts) - 1
+        for i, c in enumerate(counts):
+            acc += c
+            if acc >= rank:
+                idx = i
+                break
+        if idx == 0:
+            mid = self.lo
+        elif idx == len(counts) - 1:
+            mid = hi_seen  # overflow bucket: the observed max is the bound
+        else:
+            upper = self.lo * self.growth ** idx
+            mid = upper / math.sqrt(self.growth)  # geometric bucket midpoint
+        return float(min(max(mid, lo_seen), hi_seen))
+
+    def mean(self) -> float | None:
+        with self._lock:
+            return (self.sum / self.count) if self.count else None
+
+    # ------------------------------------------------------------- merge --
+
+    def _layout(self) -> tuple:
+        return (self.lo, self.growth, len(self.counts))
+
+    def merge(self, other: "Histogram | dict") -> None:
+        """Add another histogram's (or snapshot's) counts into this one.
+        Layouts must match exactly — same lo, growth, bucket count —
+        which every registry-default histogram satisfies."""
+        if isinstance(other, Histogram):
+            other = other.snapshot()  # takes other's lock: a consistent view
+        layout = (float(other["lo"]), float(other["growth"]), len(other["counts"]))
+        if layout != self._layout():
+            raise ValueError(f"histogram layout mismatch: {layout} != {self._layout()}")
+        with self._lock:
+            for i, c in enumerate(other["counts"]):
+                self.counts[i] += c
+            self.count += other["count"]
+            self.sum += other["sum"]
+            if other["count"]:
+                self.min = min(self.min, other["min"])
+                self.max = max(self.max, other["max"])
+
+    # --------------------------------------------------------- serialize --
+
+    def snapshot(self) -> dict:
+        """JSON-able full state + derived p50/p99/mean convenience fields
+        (ignored by from_snapshot/merge)."""
+        with self._lock:
+            snap = {
+                "lo": self.lo,
+                "growth": self.growth,
+                "counts": list(self.counts),
+                "count": self.count,
+                "sum": self.sum,
+                "min": self.min if self.count else None,
+                "max": self.max if self.count else None,
+            }
+        if snap["count"]:
+            snap["mean"] = round(snap["sum"] / snap["count"], 9)
+            p50, p99 = self.quantile(0.5), self.quantile(0.99)
+            snap["p50"] = round(p50, 9) if p50 is not None else None
+            snap["p99"] = round(p99, 9) if p99 is not None else None
+        return snap
+
+    def delta_since(self, base: dict | None) -> dict | None:
+        """Snapshot of everything recorded since ``base`` (an earlier
+        snapshot of THIS histogram), or None when nothing changed — the
+        worker→parent shipping unit. min/max are shipped as current
+        values: they only tighten monotonically, so merging them
+        repeatedly with min/max is idempotent."""
+        snap = self.snapshot()
+        if base is None:
+            return snap if snap["count"] else None
+        if snap["count"] == base["count"]:
+            return None
+        snap["counts"] = [c - b for c, b in zip(snap["counts"], base["counts"])]
+        snap["count"] -= base["count"]
+        snap["sum"] -= base["sum"]
+        return snap
+
+    @classmethod
+    def from_snapshot(cls, snap: dict) -> "Histogram":
+        h = cls.__new__(cls)
+        h.lo = float(snap["lo"])
+        h.growth = float(snap["growth"])
+        h._log_growth = math.log(h.growth)
+        h.counts = [int(c) for c in snap["counts"]]
+        h.count = int(snap["count"])
+        h.sum = float(snap["sum"])
+        h.min = snap["min"] if snap.get("min") is not None else math.inf
+        h.max = snap["max"] if snap.get("max") is not None else -math.inf
+        h._lock = threading.Lock()
+        return h

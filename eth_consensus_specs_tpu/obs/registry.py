@@ -31,7 +31,8 @@ import os
 import threading
 import time
 
-from . import gates
+from . import gates, trace
+from .histogram import Histogram
 
 _MAX_EVENTS = 10_000
 
@@ -59,7 +60,8 @@ class _SpanHandle:
     so the exit path can block on it (dispatch-acknowledged-but-not-
     executed work then shows up as time, not as a suspiciously free op)."""
 
-    __slots__ = ("name", "attrs", "t0", "parent", "depth", "result", "_annotation", "_registry")
+    __slots__ = ("name", "attrs", "t0", "parent", "depth", "result", "_annotation",
+                 "_registry", "_trace")
 
     def __init__(self, registry: "Registry", name: str, attrs: dict):
         self.name = name
@@ -73,6 +75,10 @@ class _SpanHandle:
         self.parent = stack[-1] if stack else None
         self.depth = len(stack)
         stack.append(self.name)
+        # under an active trace context (obs/trace.py) the span becomes a
+        # trace span: its event carries trace_id/span_id/parent_span so
+        # it stitches across thread and process boundaries
+        self._trace = trace.enter_span()
         self._annotation = _enter_annotation(self.name)
         self.t0 = time.perf_counter()
         return self
@@ -86,12 +92,14 @@ class _SpanHandle:
                 self._annotation.__exit__(exc_type, exc, tb)
             except Exception:
                 pass
+        trace.exit_span(self._trace)
         stack = self._registry._span_stack()
         if stack and stack[-1] == self.name:
             stack.pop()
         if exc_type is None:
             self._registry.record_span(
-                self.name, seconds, self.attrs, parent=self.parent, depth=self.depth
+                self.name, seconds, self.attrs, parent=self.parent, depth=self.depth,
+                trace_ctx=self._trace,
             )
         return False
 
@@ -144,6 +152,7 @@ class Registry:
         self._local = threading.local()
         self.counters: dict[str, float] = {}
         self.gauges: dict[str, dict] = {}
+        self.histograms: dict[str, Histogram] = {}
         self.spans: dict[str, dict] = {}
         self.events: list[dict] = []
         self._jsonl_path: str | None = os.environ.get("ETH_SPECS_OBS_JSONL") or None
@@ -165,6 +174,7 @@ class Registry:
     def record_span(
         self, name: str, seconds: float, attrs: dict | None = None,
         parent: str | None = None, depth: int = 0,
+        trace_ctx=None,
     ) -> None:
         attrs = attrs or {}
         verdict = None
@@ -202,6 +212,7 @@ class Registry:
         event = {"kind": "span", "name": name, "s": round(seconds, 9), "depth": depth}
         if parent:
             event["parent"] = parent
+        event.update(trace.event_fields(trace_ctx))
         for k, v in attrs.items():
             # reserved event fields can't be shadowed by span attributes
             if k not in event and isinstance(v, (int, float, str, bool)):
@@ -234,6 +245,47 @@ class Registry:
                 g = self.gauges[name] = {"last": 0.0, "max": 0.0}
             g["last"] = value
             g["max"] = max(g["max"], value)
+
+    # -------------------------------------------------------- histograms --
+
+    def observe(self, name: str, value: float) -> None:
+        """Record a sample into the named mergeable log-bucket histogram
+        (auto-created with the shared default layout, so same-named
+        histograms from any process always merge). The record path takes
+        only the histogram's own O(1) lock — never the registry lock."""
+        if not obs_enabled():
+            return
+        h = self.histograms.get(name)
+        if h is None:
+            with self._lock:
+                h = self.histograms.setdefault(name, Histogram())
+        h.record(value)
+
+    def histogram(self, name: str) -> Histogram | None:
+        return self.histograms.get(name)
+
+    def merge_histogram(self, name: str, snap: dict) -> None:
+        """Fold a serialized histogram delta (Histogram.delta_since) from
+        another process into this registry's same-named histogram."""
+        if not obs_enabled():
+            return
+        h = self.histograms.get(name)
+        if h is None:
+            with self._lock:
+                h = self.histograms.setdefault(
+                    name, Histogram(lo=snap["lo"], growth=snap["growth"])
+                )
+        h.merge(snap)
+
+    def merge_gauge(self, name: str, g: dict) -> None:
+        """Fold another process's gauge state in: ``last`` is latest-wins
+        (the shipper is the fresher observation), ``max`` is monotonic."""
+        if not obs_enabled():
+            return
+        with self._lock:
+            cur = self.gauges.setdefault(name, {"last": 0.0, "max": 0.0})
+            cur["last"] = g.get("last", cur["last"])
+            cur["max"] = max(cur["max"], g.get("max", 0.0))
 
     # ------------------------------------------------------------ events --
 
@@ -284,6 +336,7 @@ class Registry:
         with self._lock:
             counters = dict(self.counters)
             gauges = {name: dict(g) for name, g in self.gauges.items()}
+            hist_refs = dict(self.histograms)
             spans = {
                 name: {k: (round(v, 9) if isinstance(v, float) else v) for k, v in agg.items()}
                 for name, agg in self.spans.items()
@@ -298,6 +351,9 @@ class Registry:
         return {
             "counters": counters,
             "gauges": gauges,
+            # each histogram serializes under its own lock (post-snapshot
+            # records may slip in — a snapshot is a point-in-time-ish view)
+            "histograms": {name: h.snapshot() for name, h in hist_refs.items()},
             "spans": spans,
             "watchdog": {
                 "checks": counters.get("watchdog.checks", 0),
@@ -310,6 +366,7 @@ class Registry:
         with self._lock:
             self.counters.clear()
             self.gauges.clear()
+            self.histograms.clear()
             self.spans.clear()
             self.events.clear()
 

@@ -42,6 +42,10 @@ class Request:
     t_submit: float = field(default_factory=time.monotonic)
     prepped: Any = None  # host-prep artifact (packed words etc.)
     released: bool = False  # admission slot handed back (exactly once)
+    # trace context captured at submit time (obs/trace.py): carried
+    # through the batcher hand-off so flush/dispatch events can link
+    # this request across the submit→batch→dispatch thread boundaries
+    trace: Any = None
 
 
 class MicroBatcher:

@@ -26,19 +26,34 @@ Counters/events: ``serve.requests``, ``serve.flushes``,
 ``serve.queue_depth`` / ``serve.in_flight_bytes``, a ``serve.flush``
 event per flush (batch size, reason, in-flush wait p50/p99) and a
 ``serve.stats`` event at close with run-level p50/p99 wait.
+
+Latency accounting: every request's batcher wait lands in the
+**mergeable log-bucket histogram** ``serve.wait_ms`` (obs/histogram.py)
+— run-level p50/p99 come from bucket quantiles over the WHOLE run (no
+reservoir truncation, no sort-under-lock), per-flush p50/p99 from a
+throwaway per-flush histogram, and gen-pool workers' wait
+distributions merge into the parent registry bucket-by-bucket.
+
+Tracing: ``submit_*`` captures a trace context (child of the caller's
+active context, or a fresh root) into the Request; the flush event
+links its members' wire ids under ``flows`` and the ``serve.dispatch``
+span runs under its own context carrying the same flow links — the
+Perfetto flow-event idiom across the submit→batch→dispatch thread
+hand-offs.
 """
 
 from __future__ import annotations
 
 import threading
 import time
-from collections import deque
 from concurrent.futures import Future
 from queue import Queue
 
 import numpy as np
 
 from eth_consensus_specs_tpu import fault, obs
+from eth_consensus_specs_tpu.obs import trace
+from eth_consensus_specs_tpu.obs.histogram import Histogram
 
 from . import buckets
 from .admission import AdmissionController, Overloaded  # noqa: F401  (re-export)
@@ -67,10 +82,11 @@ class VerifyService:
         self._dispatch_q: Queue = Queue(maxsize=2)
         self._closed = False
         self._close_lock = threading.Lock()
-        # guards _waits_ms: stats() sorts it while the batch thread
-        # extends it, and an unguarded deque raises mid-iteration
-        self._waits_lock = threading.Lock()
-        self._waits_ms: deque[float] = deque(maxlen=4096)
+        # run-level wait distribution: a mergeable log-bucket histogram
+        # (every wait of the whole run, O(1) record, quantiles from
+        # buckets — the old 4096-sample deque truncated history under
+        # load and had to sort under a lock to answer p99)
+        self._waits = Histogram()
         self._dispatch_busy = False
         self._batch_thread = threading.Thread(
             target=self._batch_loop, name=f"{name}-batch", daemon=True
@@ -87,7 +103,10 @@ class VerifyService:
         if self._closed:
             raise RuntimeError(f"service {self.name} is shut down")
         self.admission.admit(cost_bytes)  # raises Overloaded past the caps
-        req = Request(kind=kind, payload=payload, cost_bytes=cost_bytes)
+        # child of the caller's active trace (or a fresh root): the ids
+        # ride the Request through the batch/dispatch thread hand-offs
+        req = Request(kind=kind, payload=payload, cost_bytes=cost_bytes,
+                      trace=trace.child())
         try:
             self._batcher.put(req)
         except RuntimeError:
@@ -149,9 +168,12 @@ class VerifyService:
                 break
             reqs, reason = flush
             now = time.monotonic()
-            waits = sorted((now - r.t_submit) * 1000.0 for r in reqs)
-            with self._waits_lock:
-                self._waits_ms.extend(waits)
+            flush_hist = Histogram()  # per-flush quantiles, same buckets
+            for r in reqs:
+                wait_ms = (now - r.t_submit) * 1000.0
+                flush_hist.record(wait_ms)
+                self._waits.record(wait_ms)
+                obs.observe("serve.wait_ms", wait_ms)
             obs.count("serve.flushes", 1)
             obs.count(f"serve.flush.{reason}", 1)
             obs.count("serve.batch_items", len(reqs))
@@ -160,8 +182,12 @@ class VerifyService:
                 reason=reason,
                 batch_size=len(reqs),
                 queue_depth=self.admission.depth(),
-                wait_p50_ms=round(waits[len(waits) // 2], 3),
-                wait_p99_ms=round(waits[min(len(waits) - 1, int(len(waits) * 0.99))], 3),
+                wait_p50_ms=round(flush_hist.quantile(0.5), 3),
+                wait_p99_ms=round(flush_hist.quantile(0.99), 3),
+                # Perfetto-style flow links: each member request's wire
+                # id, so a JSONL consumer can stitch submit-side traces
+                # to this flush and its dispatch span
+                flows=[trace.to_wire(r.trace) for r in reqs if r.trace],
             )
             self._prep(reqs)
             self._dispatch_q.put(reqs)  # blocks at pipeline depth 2
@@ -206,12 +232,22 @@ class VerifyService:
             t0 = time.monotonic()
             self._dispatch_busy = True
             try:
-                with obs.span("serve.dispatch", batch=len(live)):
-                    results = fault.degrade(
+                # the dispatch span can't BELONG to the N requests it
+                # serves, so it runs under its own context and LINKS
+                # them: the flows attr carries each member's wire id
+                with trace.activate(trace.child()):
+                    with obs.span(
                         "serve.dispatch",
-                        lambda: self._execute(live, device=True),
-                        lambda: self._execute(live, device=False),
-                    )
+                        batch=len(live),
+                        flows=",".join(
+                            trace.to_wire(r.trace) for r in live if r.trace
+                        ),
+                    ):
+                        results = fault.degrade(
+                            "serve.dispatch",
+                            lambda: self._execute(live, device=True),
+                            lambda: self._execute(live, device=False),
+                        )
             except BaseException as exc:  # noqa: BLE001 — futures carry the error
                 for r in live:
                     self._resolve(r, exc=exc)
@@ -329,16 +365,15 @@ class VerifyService:
     # ------------------------------------------------------------- admin --
 
     def stats(self) -> dict:
-        with self._waits_lock:
-            waits = sorted(self._waits_ms)
+        p50 = self._waits.quantile(0.5)
+        p99 = self._waits.quantile(0.99)
         counters = obs.snapshot()["counters"]
         return {
             "queue_depth": self.admission.depth(),
             "in_flight_bytes": self.admission.in_flight_bytes(),
-            "p50_wait_ms": round(waits[len(waits) // 2], 3) if waits else None,
-            "p99_wait_ms": (
-                round(waits[min(len(waits) - 1, int(len(waits) * 0.99))], 3) if waits else None
-            ),
+            "wait_samples": self._waits.count,
+            "p50_wait_ms": round(p50, 3) if p50 is not None else None,
+            "p99_wait_ms": round(p99, 3) if p99 is not None else None,
             "flushes": {
                 reason: counters.get(f"serve.flush.{reason}", 0)
                 for reason in ("size", "deadline", "pressure", "idle", "close")

@@ -7,17 +7,25 @@ phase and attaches the nonzero delta to the item's ``user_properties``
 a run-level report:
 
     {
-      "counters":  process totals (sha256.*, merkle.*, bls.*, ...),
-      "spans":     per-span aggregates incl. roofline verdicts,
-      "watchdog":  {checks, divergences, kernels},
-      "per_test":  up to _MAX_PER_TEST tests ranked by kernel activity,
-      "meta":      backend / watchdog rate / exit status
+      "counters":   process totals (sha256.*, merkle.*, bls.*, ...),
+      "gauges":     point-in-time levels, last + max per gauge,
+      "histograms": mergeable log-bucket distributions (bucket counts +
+                    p50/p99) — serve.wait_ms etc.,
+      "spans":      per-span aggregates incl. roofline verdicts,
+      "watchdog":   {checks, divergences, kernels},
+      "per_test":   up to _MAX_PER_TEST tests ranked by kernel activity,
+      "meta":       backend / watchdog rate / exit status
     }
 
 Destination: ``ETH_SPECS_OBS_REPORT`` (a path; ``0``/empty disables),
 default ``obs_report.json`` under the pytest rootdir — always-on is the
 point: every tier-1 run leaves an auditable record that the kernels it
-exercised were watched and did not diverge.
+exercised were watched and did not diverge. The report's sections
+mirror ``obs.snapshot()`` exactly, so obs/slo.py evaluates SLOs from a
+loaded report the same way it evaluates the live registry (the CI
+obs-report job does exactly that). When ``ETH_SPECS_OBS_PROM`` names a
+file, session finish also writes the Prometheus text exposition there
+(obs/export.py).
 
 A ``kernel_counters`` fixture is exposed for tests that want to assert
 on their own kernel activity: it returns a callable producing the
@@ -55,6 +63,11 @@ class ObsPlugin:
     def __init__(self, rootdir: str):
         self._path = report_path(rootdir)
         self.per_test: list[tuple[str, dict]] = []
+        # env-gated, no-op when ETH_SPECS_OBS_HTTP_PORT is unset: a
+        # long tier-1 run is scrapeable while it executes
+        from eth_consensus_specs_tpu.obs import export
+
+        export.maybe_serve_http()
 
     @pytest.hookimpl(hookwrapper=True)
     def pytest_runtest_call(self, item):
@@ -66,9 +79,17 @@ class ObsPlugin:
             self.per_test.append((item.nodeid, delta))
 
     def pytest_sessionfinish(self, session, exitstatus):
+        snap = obs.snapshot()
+        # the Prometheus textfile knob is independent of the JSON report
+        # knob: honor ETH_SPECS_OBS_PROM even when the report is disabled
+        try:
+            from eth_consensus_specs_tpu.obs import export
+
+            export.write_textfile(snap=snap)
+        except OSError:
+            pass
         if self._path is None:
             return
-        snap = obs.snapshot()
         ranked = sorted(
             self.per_test, key=lambda kv: -sum(v for v in kv[1].values())
         )[:_MAX_PER_TEST]
@@ -83,6 +104,10 @@ class ObsPlugin:
         report = {
             "counters": snap["counters"],
             "gauges": snap["gauges"],
+            # histograms ride along (bucket counts + derived p50/p99) so
+            # run-level CI assertions can see wait distributions — not
+            # just spans/counters
+            "histograms": snap["histograms"],
             "spans": snap["spans"],
             "watchdog": snap["watchdog"],
             "per_test": {nodeid: delta for nodeid, delta in ranked},

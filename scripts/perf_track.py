@@ -1,0 +1,271 @@
+"""Perf-trajectory tracker: every BENCH_r*.json round on one timeline.
+
+The bench history already burned this repo once: four rounds published
+a physically impossible 878 Ghash/s because nothing compared runs to
+each other. This script ingests every ``BENCH_r*.json`` (plus
+``BENCH_LKG.json``), normalizes each round to (round, platform,
+metrics), and emits:
+
+  * ``PERF_TRAJECTORY.json`` — the machine-readable trajectory: one
+    entry per round, the same-platform regression verdicts, and the
+    last-known-good accelerator reference;
+  * a markdown table (``PERF_TRAJECTORY.md`` + stdout) for humans.
+
+**Platform awareness is the whole point.** A run that fell back to
+XLA:CPU must compare against *cpu history only* — never against
+``last_known_good`` TPU numbers or a TPU round, otherwise every
+fallback run reads as a million-x regression (and a lucky TPU run
+after a cpu round reads as a million-x win). Platform is taken from
+``parsed.platform`` when present, inferred from the CPU-fallback error
+marker otherwise, and defaults to the accelerator.
+
+Regression policy: the PRIMARY metric gates (exit 1) when it drops
+more than ``--threshold`` (default 30 %) against the most recent prior
+round **of the same platform**; metrics whose name ends in ``_ms``
+compare in the lower-is-better direction. Secondary metrics produce
+*advisories* in the JSON (and gate only under ``--strict``): they are
+measured with less care (single rep, shared warmup) and a hard gate on
+them would make the tracker cry wolf. Quarantined LKG sections
+(BENCH_LKG's round-5 revision) are reported but never compared
+against.
+
+CI runs this in the ``perf-track`` step (checks.yml) and fails only on
+a same-platform primary regression.
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import re
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+_CPU_MARKERS = ("cpu fallback", "xla:cpu", "cpu-fallback")
+
+
+def _lower_is_better(metric: str) -> bool:
+    return metric.endswith("_ms") or metric.endswith("_s")
+
+
+def infer_platform(parsed: dict) -> str:
+    plat = str(parsed.get("platform", "")).lower()
+    if plat:
+        return "cpu" if "cpu" in plat else plat
+    err = str(parsed.get("error", "")).lower()
+    if any(m in err for m in _CPU_MARKERS):
+        return "cpu"
+    return "tpu"
+
+
+def load_rounds(repo_dir: str) -> list[dict]:
+    entries = []
+    for path in sorted(glob.glob(os.path.join(repo_dir, "BENCH_r*.json"))):
+        m = re.search(r"BENCH_r(\d+)\.json$", path)
+        if m is None:
+            continue
+        try:
+            raw = json.load(open(path))
+        except (OSError, json.JSONDecodeError) as exc:
+            entries.append({"round": int(m.group(1)), "file": os.path.basename(path),
+                            "status": "unreadable", "error": str(exc)})
+            continue
+        parsed = raw.get("parsed")
+        entry = {
+            "round": int(m.group(1)),
+            "file": os.path.basename(path),
+            "rc": raw.get("rc"),
+        }
+        if not parsed or not isinstance(parsed, dict) or "value" not in parsed:
+            entry["status"] = "no-data"  # e.g. r01: backend died before measuring
+            entries.append(entry)
+            continue
+        metrics = {parsed["metric"]: parsed["value"]}
+        for name, value in (parsed.get("secondary") or {}).items():
+            if isinstance(value, (int, float)):
+                metrics[name] = value
+        entry.update(
+            status="ok",
+            platform=infer_platform(parsed),
+            primary=parsed["metric"],
+            metrics=metrics,
+            method=parsed.get("method"),
+        )
+        entries.append(entry)
+    return entries
+
+
+def load_lkg(repo_dir: str) -> dict:
+    path = os.path.join(repo_dir, "BENCH_LKG.json")
+    try:
+        raw = json.load(open(path))
+    except (OSError, json.JSONDecodeError):
+        return {"present": False}
+    return {
+        "present": True,
+        "sections": raw.get("sections") or {},
+        "quarantined": sorted((raw.get("quarantined") or {}).get("sections", {})),
+    }
+
+
+def compare(entries: list[dict], threshold: float, strict: bool) -> tuple[list, list]:
+    """Same-platform metric comparisons. Returns (regressions,
+    advisories): regressions gate, advisories inform. Each record:
+    {round, vs_round, platform, metric, prev, value, change}."""
+    regressions, advisories = [], []
+    # last seen value per (platform, metric) — a cpu round can never be
+    # compared against a tpu round by construction of this key
+    last: dict[tuple, tuple] = {}
+    for e in entries:
+        if e.get("status") != "ok":
+            continue
+        for metric, value in e["metrics"].items():
+            key = (e["platform"], metric)
+            prev = last.get(key)
+            last[key] = (e["round"], value)
+            if prev is None or not value or not prev[1]:
+                continue
+            prev_round, prev_value = prev
+            if _lower_is_better(metric):
+                change = value / prev_value - 1.0  # positive = slower
+                regressed = change > threshold
+            else:
+                change = 1.0 - value / prev_value  # positive = slower
+                regressed = change > threshold
+            if not regressed:
+                continue
+            rec = {
+                "round": e["round"],
+                "vs_round": prev_round,
+                "platform": e["platform"],
+                "metric": metric,
+                "prev": prev_value,
+                "value": value,
+                "change_pct": round(change * 100.0, 1),
+                "gates": strict or metric == e["primary"],
+            }
+            (regressions if rec["gates"] else advisories).append(rec)
+    return regressions, advisories
+
+
+def _fmt_val(v: float) -> str:
+    if v is None:
+        return "—"
+    if abs(v) >= 1e6:
+        return f"{v:.3g}"
+    return f"{v:g}"
+
+
+def markdown_table(entries: list[dict], regressions: list, advisories: list,
+                   lkg: dict) -> str:
+    lines = [
+        "# Perf trajectory",
+        "",
+        "| round | platform | primary metric | value | status |",
+        "|---|---|---|---|---|",
+    ]
+    flagged = {(r["round"], r["metric"]) for r in regressions}
+    for e in entries:
+        if e.get("status") != "ok":
+            lines.append(
+                f"| r{e['round']:02d} | — | — | — | {e.get('status')} (rc={e.get('rc')}) |"
+            )
+            continue
+        mark = "**REGRESSED**" if (e["round"], e["primary"]) in flagged else "ok"
+        lines.append(
+            f"| r{e['round']:02d} | {e['platform']} | {e['primary']} "
+            f"| {_fmt_val(e['metrics'][e['primary']])} | {mark} |"
+        )
+    if lkg.get("present"):
+        usable = sorted(lkg.get("sections", {}))
+        lines += [
+            "",
+            f"Last-known-good accelerator sections: {usable or 'none'} "
+            f"(quarantined: {lkg.get('quarantined') or 'none'}). "
+            "LKG numbers are an accelerator reference only — cpu-fallback "
+            "rounds are never compared against them.",
+        ]
+    if regressions:
+        lines += ["", "## Same-platform regressions", ""]
+        for r in regressions:
+            lines.append(
+                f"- r{r['round']:02d} vs r{r['vs_round']:02d} [{r['platform']}] "
+                f"{r['metric']}: {_fmt_val(r['prev'])} → {_fmt_val(r['value'])} "
+                f"({r['change_pct']:+.1f}% slower)"
+            )
+    if advisories:
+        lines += ["", "## Advisories (secondary metrics, non-gating)", ""]
+        for r in advisories:
+            lines.append(
+                f"- r{r['round']:02d} vs r{r['vs_round']:02d} [{r['platform']}] "
+                f"{r['metric']}: {_fmt_val(r['prev'])} → {_fmt_val(r['value'])} "
+                f"({r['change_pct']:+.1f}% slower)"
+            )
+    return "\n".join(lines) + "\n"
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--repo-dir", default=REPO)
+    ap.add_argument("--threshold", type=float, default=0.30,
+                    help="same-platform fractional drop that flags a regression")
+    ap.add_argument("--strict", action="store_true",
+                    help="secondary-metric regressions gate too")
+    ap.add_argument("--out", default=None, help="default <repo>/PERF_TRAJECTORY.json")
+    ap.add_argument("--md", default=None, help="default <repo>/PERF_TRAJECTORY.md")
+    args = ap.parse_args()
+
+    entries = load_rounds(args.repo_dir)
+    if not entries:
+        print("no BENCH_r*.json found", file=sys.stderr)
+        raise SystemExit(2)
+    lkg = load_lkg(args.repo_dir)
+
+    # TPU rounds may also be checked against the (non-quarantined) LKG
+    # sections by seeding the comparison history with a pseudo-round 0
+    seeded = []
+    if lkg.get("present") and lkg.get("sections"):
+        metrics = {}
+        for section in lkg["sections"].values():
+            for k, v in section.items():
+                if isinstance(v, (int, float)):
+                    metrics[k] = v
+        if metrics:
+            seeded.append({
+                "round": 0, "file": "BENCH_LKG.json", "status": "ok",
+                "platform": "tpu", "primary": next(iter(metrics)),
+                "metrics": metrics,
+            })
+    regressions, advisories = compare(seeded + entries, args.threshold, args.strict)
+    regressions = [r for r in regressions if r["round"] != 0]
+
+    out = args.out or os.path.join(args.repo_dir, "PERF_TRAJECTORY.json")
+    md_path = args.md or os.path.join(args.repo_dir, "PERF_TRAJECTORY.md")
+    trajectory = {
+        "threshold": args.threshold,
+        "strict": args.strict,
+        "rounds": entries,
+        "regressions": regressions,
+        "advisories": advisories,
+        "last_known_good": lkg,
+    }
+    with open(out, "w") as fh:
+        json.dump(trajectory, fh, indent=1, sort_keys=True)
+        fh.write("\n")
+    md = markdown_table(entries, regressions, advisories, lkg)
+    with open(md_path, "w") as fh:
+        fh.write(md)
+    print(md)
+    print(f"wrote {out} and {md_path}", file=sys.stderr)
+    if regressions:
+        print("FAILED: same-platform regression(s):", file=sys.stderr)
+        for r in regressions:
+            print(f"  {r}", file=sys.stderr)
+        raise SystemExit(1)
+
+
+if __name__ == "__main__":
+    main()

@@ -16,11 +16,19 @@ Phases:
      submitting the next) — must produce a SIZE flush and the headline
      throughput;
   5. gates: zero watchdog divergences, zero compiles after warmup
-     (so total compiles <= len(buckets) per depth), and — full mode —
-     batched BLS throughput >= 2x sequential.
+     (so total compiles <= len(buckets) per depth), declarative SLOs
+     (obs/slo.py: wait p99 bound, degraded rate, divergences,
+     compiles-after-warmup) evaluated from the registry snapshot, and —
+     full mode — batched BLS throughput >= 2x sequential.
+
+Run-level wait p50/p99 come from the mergeable ``serve.wait_ms``
+log-bucket histogram (every wait of the run — no reservoir
+truncation), and the full registry snapshot is emitted as a Prometheus
+textfile next to the JSON report (``<out>.prom``, overridable via
+``ETH_SPECS_OBS_PROM``) and validated before the script exits.
 
 ``--smoke`` shrinks everything for CI (the serve-smoke job in
-checks.yml) and skips the 2x gate; correctness/flush/compile gates
+checks.yml) and skips the 2x gate; correctness/flush/compile/SLO gates
 always apply. Exit code 0 only if every gate passes.
 """
 
@@ -42,6 +50,7 @@ os.environ.setdefault("JAX_PLATFORMS", "cpu")
 import numpy as np  # noqa: E402
 
 from eth_consensus_specs_tpu import obs, serve  # noqa: E402
+from eth_consensus_specs_tpu.obs import export, slo  # noqa: E402
 from eth_consensus_specs_tpu.ops import bls_batch  # noqa: E402
 from eth_consensus_specs_tpu.ops.merkle import merkleize_subtree_device  # noqa: E402
 from eth_consensus_specs_tpu.serve.config import ServeConfig  # noqa: E402
@@ -132,6 +141,7 @@ def main() -> None:
         args.requests = min(args.requests, 64)
         args.tree_depth = min(args.tree_depth, 6)
 
+    export.maybe_serve_http()  # scrapeable while the bench runs (env-gated)
     # max_batch strictly below the submitter count guarantees full (size-
     # flushed) buckets at steady state instead of racing the deadline
     cfg = ServeConfig.from_env(max_batch=min(max(args.submitters // 2, 1), 32))
@@ -183,6 +193,21 @@ def main() -> None:
     extra = counters.get("serve.compiles", 0) - compiles_after_warmup
     if extra > 0:
         failures.append(f"{extra} compiles AFTER warmup (shape escaped the buckets)")
+    # feed the declarative SLO set (obs/slo.py): the counter is the
+    # snapshot-visible form of the "zero compiles after warmup" contract
+    obs.count("serve.compiles_after_warmup", max(extra, 0))
+    snap = obs.snapshot()
+    counters = snap["counters"]
+    slo_results = slo.evaluate(snap)
+    for r in slo_results:
+        if not r.ok:
+            failures.append(
+                f"SLO {r.name}: observed {r.observed} > bound {r.bound} ({r.detail})"
+            )
+
+    # run-level wait quantiles: bucket quantiles over EVERY wait of the
+    # run (the old 4096-sample reservoir is gone)
+    wait_hist = snap["histograms"].get("serve.wait_ms", {})
 
     speedup_bls = (args.requests / svc_bls_s) / (args.requests / seq_bls_s)
     speedup_htr = (args.requests / svc_htr_s) / (args.requests / seq_htr_s)
@@ -216,8 +241,27 @@ def main() -> None:
         "rejected": counters.get("serve.rejected", 0),
         "watchdog": snap["watchdog"],
         "queue_depth_max": snap["gauges"].get("serve.queue_depth", {}).get("max", 0),
-        "failures": failures,
+        "wait_ms": {
+            "samples": wait_hist.get("count", 0),
+            "p50": wait_hist.get("p50"),
+            "p99": wait_hist.get("p99"),
+        },
+        "slo": slo.report(slo_results),
     }
+
+    # Prometheus textfile of the final snapshot, validated before the
+    # report (an invalid exposition is a gate failure like any other)
+    prom_path = os.environ.get("ETH_SPECS_OBS_PROM") or (
+        os.path.splitext(args.out)[0] + ".prom"
+    )
+    export.write_textfile(prom_path, snap=snap)
+    try:
+        export.validate_text(open(prom_path).read())
+    except ValueError as exc:
+        failures.append(f"prometheus exposition invalid: {exc}")
+    report["prometheus_textfile"] = prom_path
+    report["failures"] = failures
+
     with open(args.out, "w") as fh:
         json.dump(report, fh, indent=2, sort_keys=True)
     print(json.dumps(report, sort_keys=True))

@@ -1,0 +1,377 @@
+"""Telemetry export layer: mergeable histograms, trace propagation,
+Prometheus exposition, SLO gates.
+
+The acceptance story: (1) log-bucket histogram quantiles track numpy
+percentiles within the layout's error bound and MERGE exactly (shards
+== whole); (2) the Prometheus text exposition is well-formed — names,
+HELP/TYPE pairs, cumulative ``le`` buckets capped by ``+Inf`` ==
+``_count`` — both as a textfile and over the stdlib HTTP endpoint;
+(3) a trace context survives serve submit → flush → dispatch (flow
+links) and the gen-pool parent → worker process boundary (stitched
+JSONL spans, shipped histograms/gauges); (4) SLOs evaluated from a
+snapshot take both the pass and the fail path.
+"""
+
+import json
+import math
+import threading
+import urllib.request
+
+import numpy as np
+import pytest
+
+from eth_consensus_specs_tpu import obs
+from eth_consensus_specs_tpu.obs import export, slo, trace
+from eth_consensus_specs_tpu.obs.histogram import Histogram
+
+# --------------------------------------------------------------- histogram --
+
+
+def test_histogram_quantiles_track_numpy_percentiles():
+    rng = np.random.default_rng(7)
+    xs = rng.lognormal(mean=2.0, sigma=1.2, size=50_000)
+    h = Histogram()
+    for x in xs:
+        h.record(float(x))
+    # geometric-midpoint quantiles are bounded by sqrt(growth)-1 (~9 %
+    # for the default layout); allow a little sampling slack on top
+    bound = math.sqrt(h.growth) - 1 + 0.02
+    for q in (0.01, 0.25, 0.5, 0.9, 0.99):
+        est = h.quantile(q)
+        ref = float(np.percentile(xs, q * 100))
+        assert abs(est - ref) / ref < bound, (q, est, ref)
+    assert h.quantile(0.0) == pytest.approx(float(xs.min()))
+    assert h.quantile(1.0) == pytest.approx(float(xs.max()))
+    assert h.mean() == pytest.approx(float(xs.mean()))
+
+
+def test_histogram_merge_equals_whole():
+    rng = np.random.default_rng(11)
+    xs = rng.exponential(50.0, size=9_000)
+    whole = Histogram()
+    shards = [Histogram() for _ in range(3)]
+    for i, x in enumerate(xs):
+        whole.record(float(x))
+        shards[i % 3].record(float(x))
+    merged = Histogram()
+    merged.merge(shards[0])  # live-instance merge
+    for s in shards[1:]:
+        merged.merge(s.snapshot())  # snapshot merge (the wire form)
+    assert merged.counts == whole.counts
+    assert merged.count == whole.count
+    assert merged.sum == pytest.approx(whole.sum)
+    assert merged.min == whole.min and merged.max == whole.max
+    for q in (0.5, 0.99):
+        assert merged.quantile(q) == whole.quantile(q)
+
+
+def test_histogram_merge_rejects_layout_mismatch():
+    a, b = Histogram(), Histogram(lo=1e-2)
+    b.record(1.0)
+    with pytest.raises(ValueError, match="layout mismatch"):
+        a.merge(b)
+
+
+def test_histogram_delta_since_ships_only_new_samples():
+    h = Histogram()
+    for v in (1.0, 10.0, 100.0):
+        h.record(v)
+    base = h.snapshot()
+    assert h.delta_since(base) is None  # nothing new
+    h.record(7.0)
+    h.record(0.5)
+    delta = h.delta_since(base)
+    assert delta["count"] == 2
+    assert delta["sum"] == pytest.approx(7.5)
+    assert sum(delta["counts"]) == 2
+    # folding the delta into a copy of the base reproduces the current state
+    rebuilt = Histogram.from_snapshot(base)
+    rebuilt.merge(delta)
+    assert rebuilt.counts == h.counts and rebuilt.count == h.count
+    assert rebuilt.min == h.min and rebuilt.max == h.max
+
+
+def test_histogram_record_thread_safe():
+    h = Histogram()
+
+    def pound():
+        for i in range(2_000):
+            h.record(0.1 * (i % 37 + 1))
+
+    threads = [threading.Thread(target=pound) for _ in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert h.count == 16_000
+    assert sum(h.counts) == 16_000
+
+
+def test_histogram_json_roundtrip_answers_quantiles():
+    h = Histogram()
+    for v in (2.0, 4.0, 8.0, 16.0):
+        h.record(v)
+    wire = json.loads(json.dumps(h.snapshot()))
+    back = Histogram.from_snapshot(wire)
+    assert back.quantile(0.5) == h.quantile(0.5)
+    assert wire["p50"] is not None and wire["p99"] is not None
+
+
+def test_registry_observe_and_merge():
+    reg = obs.Registry()
+    for v in (1.0, 2.0, 3.0):
+        reg.observe("t.lat_ms", v)
+    snap = reg.snapshot()
+    assert snap["histograms"]["t.lat_ms"]["count"] == 3
+    # merge a foreign delta (another process's shipped histogram)
+    other = Histogram()
+    other.record(50.0)
+    reg.merge_histogram("t.lat_ms", other.snapshot())
+    assert reg.histogram("t.lat_ms").count == 4
+    # gauge merge: last is latest-wins, max monotonic
+    reg.gauge("t.depth", 9)
+    reg.merge_gauge("t.depth", {"last": 2, "max": 5})
+    g = reg.snapshot()["gauges"]["t.depth"]
+    assert g["last"] == 2 and g["max"] == 9
+
+
+# -------------------------------------------------------------- exposition --
+
+
+def _populated_registry() -> obs.Registry:
+    reg = obs.Registry()
+    reg.count("t.requests", 42)
+    reg.count("watchdog.divergences", 0)
+    reg.gauge("t.queue_depth", 7)
+    for v in (0.5, 3.0, 3.1, 250.0, 9_999.0):
+        reg.observe("t.wait_ms", v)
+    with reg.span("t.dispatch"):
+        pass
+    return reg
+
+
+def test_prometheus_exposition_well_formed():
+    text = export.prometheus_text(_populated_registry().snapshot())
+    tallies = export.validate_text(text)
+    assert tallies["families"] >= 5
+    lines = text.splitlines()
+    # counter naming + HELP/TYPE discipline
+    assert "# TYPE t_requests_total counter" in lines
+    assert "t_requests_total 42" in lines
+    assert "# TYPE t_queue_depth gauge" in lines
+    # histogram: cumulative le buckets, +Inf cap == count
+    buckets = [ln for ln in lines if ln.startswith("t_wait_ms_bucket")]
+    assert buckets[-1] == 't_wait_ms_bucket{le="+Inf"} 5'
+    cums = [float(ln.rsplit(" ", 1)[1]) for ln in buckets]
+    assert cums == sorted(cums)
+    assert "t_wait_ms_count 5" in lines
+    # spans export as the calls/seconds counter pair
+    assert "t_dispatch_calls_total 1" in lines
+
+
+def test_prometheus_validator_rejects_malformations():
+    good = export.prometheus_text(_populated_registry().snapshot())
+    with pytest.raises(ValueError, match="cumulative"):
+        export.validate_text(good.replace('le="+Inf"} 5', 'le="+Inf"} 1', 1)
+                             .replace("t_wait_ms_count 5", "t_wait_ms_count 1"))
+    with pytest.raises(ValueError, match="no declared family"):
+        export.validate_text(good + "undeclared_metric 1\n")
+    with pytest.raises(ValueError, match="TYPE without HELP"):
+        export.validate_text("# TYPE foo counter\nfoo 1\n")
+
+
+def test_prometheus_textfile_and_http_endpoint(tmp_path, monkeypatch):
+    monkeypatch.setenv("ETH_SPECS_OBS_PROM", str(tmp_path / "metrics.prom"))
+    path = export.write_textfile(snap=_populated_registry().snapshot())
+    assert path == str(tmp_path / "metrics.prom")
+    export.validate_text(open(path).read())
+
+    server = export.serve_http(0)  # ephemeral port
+    try:
+        port = server.server_address[1]
+        with urllib.request.urlopen(f"http://127.0.0.1:{port}/metrics", timeout=10) as rsp:
+            assert rsp.status == 200
+            assert "text/plain" in rsp.headers["Content-Type"]
+            export.validate_text(rsp.read().decode())
+    finally:
+        server.shutdown()
+
+
+def test_http_endpoint_disabled_without_env(monkeypatch):
+    monkeypatch.delenv("ETH_SPECS_OBS_HTTP_PORT", raising=False)
+    assert export.serve_http() is None
+    # the idempotent entry-point starter is equally env-gated
+    assert export.maybe_serve_http() is None
+
+
+def test_plugin_writes_prom_even_without_report(tmp_path, monkeypatch):
+    from eth_consensus_specs_tpu.test_infra.obs_plugin import ObsPlugin
+
+    monkeypatch.setenv("ETH_SPECS_OBS_REPORT", "0")  # JSON report disabled
+    prom = tmp_path / "metrics.prom"
+    monkeypatch.setenv("ETH_SPECS_OBS_PROM", str(prom))
+    obs.count("t.plugin_probe", 1)
+    plugin = ObsPlugin(str(tmp_path))
+    assert plugin._path is None
+    plugin.pytest_sessionfinish(session=None, exitstatus=0)
+    export.validate_text(prom.read_text())
+
+
+# ------------------------------------------------------------------- trace --
+
+
+def test_trace_wire_roundtrip_and_children():
+    root = trace.new_trace()
+    wire = trace.to_wire(root)
+    back = trace.from_wire(wire)
+    assert back.trace_id == root.trace_id and back.span_id == root.span_id
+    assert trace.from_wire(None) is None and trace.to_wire(None) is None
+    kid = trace.child(root)
+    assert kid.trace_id == root.trace_id and kid.parent_id == root.span_id
+    # child with no context anywhere = fresh root
+    orphan = trace.child()
+    assert orphan.parent_id is None and orphan.trace_id != root.trace_id
+
+
+def test_spans_under_active_context_carry_trace_ids():
+    reg = obs.Registry()
+    ctx = trace.new_trace()
+    with trace.activate(ctx):
+        with reg.span("tt.outer"):
+            with reg.span("tt.inner"):
+                pass
+    spans = {e["name"]: e for e in reg.events if e.get("kind") == "span"}
+    outer, inner = spans["tt.outer"], spans["tt.inner"]
+    assert outer["trace_id"] == inner["trace_id"] == ctx.trace_id
+    assert outer["parent_span"] == ctx.span_id
+    assert inner["parent_span"] == outer["span_id"]
+    # context restored after the block: spans outside record no ids
+    with reg.span("tt.free"):
+        pass
+    assert "trace_id" not in {e["name"]: e for e in reg.events}["tt.free"]
+
+
+def test_trace_survives_serve_submit_flush_dispatch():
+    from eth_consensus_specs_tpu import serve
+    from eth_consensus_specs_tpu.serve.config import ServeConfig
+
+    rng = np.random.default_rng(3)
+    chunks = rng.integers(0, 256, size=(13, 32)).astype(np.uint8)
+    ctx = trace.new_trace()
+    svc = serve.VerifyService(ServeConfig.from_env(max_batch=4, max_wait_ms=2))
+    try:
+        with trace.activate(ctx):
+            fut = svc.submit_hash_tree_root(chunks)
+        assert fut.result(timeout=60) is not None
+    finally:
+        svc.close()
+    events = list(obs.get_registry().events)
+    # the flush event links the request by its wire id (trace_id-span_id)
+    flushes = [
+        e for e in events
+        if e.get("kind") == "serve.flush"
+        and any(f.startswith(ctx.trace_id + "-") for f in e.get("flows", ()))
+    ]
+    assert flushes, "no flush event carried the submitted request's flow link"
+    # the dispatch span (another thread) carries the same flow link and
+    # its own trace ids
+    dispatches = [
+        e for e in events
+        if e.get("kind") == "span" and e.get("name") == "serve.dispatch"
+        and ctx.trace_id in e.get("flows", "")
+    ]
+    assert dispatches, "dispatch span lost the request's flow link"
+    assert all(d.get("trace_id") for d in dispatches)
+
+
+def test_trace_and_histograms_cross_gen_pool_boundary(tmp_path, monkeypatch):
+    """One pool run: worker gen.case spans stitch to the parent's run
+    trace through the shared JSONL sink, and the workers' serve wait
+    histogram + queue gauges merge into the parent registry (the
+    worker→parent delta now ships more than counters)."""
+    from eth_consensus_specs_tpu.gen import discover_test_cases, run_generator
+
+    monkeypatch.setenv("ETH_SPECS_SERVE", "1")
+    cases = discover_test_cases(
+        presets=("minimal",), forks=("phase0",), runners=("operations",)
+    )
+    cases = [c for c in cases if c.handler == "attestation"][:3]
+    assert cases, "need attestation cases for a pool run"
+    before_hist = obs.snapshot()["histograms"].get("serve.wait_ms", {}).get("count", 0)
+    jsonl = tmp_path / "events.jsonl"
+    reg = obs.get_registry()
+    reg.configure_jsonl(str(jsonl))
+    try:
+        stats = run_generator(cases, str(tmp_path / "out"), workers=2)
+    finally:
+        reg.configure_jsonl(None)
+    assert stats["failed"] == 0 and stats["written"] >= 1
+
+    lines = [json.loads(line) for line in open(jsonl)]
+    runs = [e for e in lines if e.get("kind") == "gen.run"]
+    assert runs and runs[-1].get("trace_id")
+    tid = runs[-1]["trace_id"]
+    case_spans = [
+        e for e in lines if e.get("kind") == "span" and e.get("name") == "gen.case"
+    ]
+    assert case_spans, "no gen.case spans reached the shared JSONL sink"
+    assert all(e.get("trace_id") == tid for e in case_spans), (
+        "worker-side case spans did not stitch to the parent run trace"
+    )
+    snap = obs.snapshot()
+    # the workers' wait distribution merged into the parent registry
+    assert snap["histograms"].get("serve.wait_ms", {}).get("count", 0) > before_hist
+    assert "serve.queue_depth" in snap["gauges"]
+
+
+# --------------------------------------------------------------------- slo --
+
+
+def _snapshot_with(p99_ms: float, divergences: int = 0, degraded: int = 0,
+                   requests: int = 100) -> dict:
+    h = Histogram()
+    for _ in range(99):
+        h.record(p99_ms / 2)
+    for _ in range(2):
+        h.record(p99_ms)
+    return {
+        "counters": {
+            "watchdog.divergences": divergences,
+            "serve.degraded_items": degraded,
+            "serve.requests": requests,
+        },
+        "histograms": {"serve.wait_ms": h.snapshot()},
+    }
+
+
+def test_slo_pass_path():
+    results = slo.evaluate(_snapshot_with(p99_ms=10.0))
+    assert slo.passed(results)
+    rep = slo.report(results)
+    assert rep["ok"] and rep["violations"] == []
+    json.dumps(rep)  # CI writes this verbatim
+
+
+def test_slo_fail_paths():
+    bad = slo.evaluate(_snapshot_with(p99_ms=100_000.0, divergences=2, degraded=50))
+    rep = slo.report(bad)
+    assert not rep["ok"]
+    assert {"serve_wait_p99", "watchdog_divergences", "degraded_rate"} <= set(
+        rep["violations"]
+    )
+    # degradations with zero traffic to amortize them violate the ratio SLO
+    silent = slo.evaluate(_snapshot_with(p99_ms=1.0, degraded=3, requests=0))
+    assert "degraded_rate" in slo.report(silent)["violations"]
+
+
+def test_slo_vacuous_pass_on_missing_histogram():
+    results = slo.evaluate({"counters": {}, "histograms": {}})
+    assert slo.passed(results)
+    wait = next(r for r in results if r.name == "serve_wait_p99")
+    assert wait.observed is None and "vacuous" in wait.detail
+
+
+def test_slo_env_bound_override(monkeypatch):
+    monkeypatch.setenv("ETH_SPECS_SLO_WAIT_P99_MS", "1.5")
+    results = slo.evaluate(_snapshot_with(p99_ms=10.0))
+    assert "serve_wait_p99" in slo.report(results)["violations"]
