@@ -2,7 +2,7 @@
 
 PYTHON ?= python
 
-.PHONY: help install test test-fast lint reftests bytediff bench multichip serve_docs coverage clean
+.PHONY: help install test test-fast lint reftests bytediff bench multichip postmortem serve_docs coverage clean
 
 help:
 	@echo "install    - editable install with test extras"
@@ -15,6 +15,7 @@ help:
 	@echo "bench      - run the driver benchmark"
 	@echo "seed-device- one-time device-kernel compile into .jax_cache"
 	@echo "multichip  - 8-virtual-device sharding dry run"
+	@echo "postmortem - pretty-print the most recent flight-recorder bundle"
 	@echo "clean      - remove caches and generated vectors"
 
 install:
@@ -78,6 +79,11 @@ seed-device:
 
 multichip:
 	$(PYTHON) -c "import __graft_entry__ as g; g.dryrun_multichip(8); print('ok')"
+
+# most recent flight-recorder bundle ($ETH_SPECS_OBS_POSTMORTEM_DIR or
+# ./postmortems); `scripts/postmortem.py --list` / `A B` to diff
+postmortem:
+	$(PYTHON) scripts/postmortem.py
 
 serve_docs:
 	$(PYTHON) -m mkdocs serve

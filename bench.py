@@ -884,6 +884,53 @@ def _fmt_rate(hps: float) -> str:
     return f"{hps/1e9:.3f} Ghash/s" if hps >= 1e8 else f"{hps/1e6:.2f} Mhash/s"
 
 
+def xprof_capture() -> dict:
+    """Targeted XLA attribution (obs/xprof.py) of the flagship kernels on
+    THIS process's backend: AOT compile timing + executable memory for
+    one sha256 tile and one merkle depth. Feeds the round's ``xprof``
+    section, which scripts/perf_track.py ingests as non-gating secondary
+    advisories (compile-time / memory blow-ups surface on the same
+    same-platform timeline as throughput). ``ETH_SPECS_OBS_XPROF=0``
+    skips it; any failure degrades to an empty section."""
+    if os.environ.get("ETH_SPECS_OBS_XPROF", "1") in ("0", "false"):
+        return {}
+    try:
+        import jax
+        import jax.numpy as jnp
+
+        from eth_consensus_specs_tpu.obs import xprof
+        from eth_consensus_specs_tpu.ops import merkle as _mk
+        from eth_consensus_specs_tpu.ops import sha256 as _sh
+
+        tile = _sh.TILES[-1]  # the small fixed tile: bounded compile cost
+        depth = 10
+        captures = (
+            xprof.analyze(
+                "sha256", _sh._kernel,
+                (jax.ShapeDtypeStruct((tile, 16), jnp.uint32),),
+                hand_bytes=96 * tile, dims=(tile,), force=True,
+            ),
+            xprof.analyze(
+                "merkle", _mk._tree_root_fused,
+                (jax.ShapeDtypeStruct((1 << depth, 8), jnp.uint32), depth),
+                hand_bytes=96 * _mk.tree_real_hashes(depth), dims=(depth,),
+                force=True,
+            ),
+        )
+        out: dict = {}
+        for cap in captures:
+            if not cap:
+                continue
+            name = cap["kernel"]
+            if "compile_ms" in cap:
+                out[f"{name}_compile_ms"] = cap["compile_ms"]
+            if "peak_bytes" in cap:
+                out[f"{name}_peak_bytes"] = cap["peak_bytes"]
+        return out
+    except Exception:
+        return {}
+
+
 def main() -> None:
     if "--section" in sys.argv:
         _child_main(sys.argv)
@@ -1103,6 +1150,9 @@ def main() -> None:
         lkg = _load_lkg()
         if lkg is not None:
             result["last_known_good"] = lkg
+    xsec = xprof_capture()
+    if xsec:
+        result["xprof"] = xsec
     if error is not None:
         result["error"] = error
     print(json.dumps(result))

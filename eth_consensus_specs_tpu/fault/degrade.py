@@ -74,4 +74,10 @@ def degrade(site: str, device_fn, host_fn, *, attempts: int = 2):
         obs.count("fault.degraded", 1)
         obs.count(f"fault.degraded.{site}", 1)
         obs.event("fault.degraded", site=site, error=repr(exc)[:200])
+        # black-box the moment of device death: what the process was
+        # doing when the accelerator gave out (obs/flight.py; no-op
+        # without ETH_SPECS_OBS_POSTMORTEM_DIR)
+        obs.flight.trigger_dump(
+            "fault.degrade", detail=site, extra={"error": repr(exc)[:500]}
+        )
         return host_fn()

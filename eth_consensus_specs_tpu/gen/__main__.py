@@ -82,13 +82,14 @@ def main() -> None:
         case_retries=args.case_retries,
         resume=args.resume,
     )
-    # recovery + serving counters ride along so CI chaos jobs can assert
-    # on them (serve.* arrives from pool workers via the per-case counter
-    # shipping when ETH_SPECS_SERVE=1)
+    # recovery + serving + flight-recorder counters ride along so CI
+    # chaos jobs can assert on them (serve.* arrives from pool workers
+    # via the per-case counter shipping when ETH_SPECS_SERVE=1;
+    # flight.dumps says how many postmortem bundles the run left)
     counters = {
         k: v
         for k, v in obs.snapshot()["counters"].items()
-        if k.startswith(("gen.", "fault.", "serve."))
+        if k.startswith(("gen.", "fault.", "serve.", "flight."))
     }
     print(json.dumps({"cases": len(cases), **stats, "counters": counters}))
 

@@ -33,7 +33,7 @@ import traceback
 from collections import deque
 
 from eth_consensus_specs_tpu import fault, obs
-from eth_consensus_specs_tpu.obs import trace
+from eth_consensus_specs_tpu.obs import flight, trace
 
 from .dumper import Dumper
 from .gen_from_tests import TestCase
@@ -186,6 +186,11 @@ def _run_sequential(
         except Exception:
             failed += 1
             obs.count("gen.cases_failed", 1)
+            flight.trigger_dump(
+                "gen.case_failed",
+                detail=f"{case.runner}/{case.handler}/{case.case_name}",
+                extra={"traceback": traceback.format_exc()[-4000:]},
+            )
             if verbose:
                 print(f"[gen] FAILED {case.runner}/{case.handler}/{case.case_name}",
                       file=sys.stderr)
@@ -262,6 +267,7 @@ def _pool_shutdown():
 _WORKER_OBS_BASE: dict = {}
 _WORKER_GAUGE_BASE: dict = {}
 _WORKER_HIST_BASE: dict = {}
+_WORKER_FLIGHT_BASE = 0
 
 
 def _worker_obs_delta() -> dict:
@@ -283,8 +289,13 @@ def _worker_obs_delta() -> dict:
       worker's serve.wait_ms distribution): min/max ship as current
       values (they only tighten, so repeated min/max-merging is
       idempotent), counts/sum as differences — without this a pool
-      worker's whole wait distribution died with the process."""
-    global _WORKER_OBS_BASE
+      worker's whole wait distribution died with the process.
+    * ``flight`` — the worker's flight-recorder ring entries since the
+      previous ship (obs/flight.py). The parent retains a bounded
+      per-worker copy, so when a worker is SIGKILLed/OOM-killed the
+      postmortem bundle it can no longer write itself still contains
+      its last recorded events — the black box survives the crash."""
+    global _WORKER_OBS_BASE, _WORKER_FLIGHT_BASE
     snap = obs.snapshot()
     now = {
         k: v
@@ -310,15 +321,19 @@ def _worker_obs_delta() -> dict:
             delta["sum"] = hsnap["sum"] - base["sum"]
         _WORKER_HIST_BASE[name] = hsnap
         hists[name] = delta
+    _WORKER_FLIGHT_BASE, ring_delta = flight.ship_since(_WORKER_FLIGHT_BASE)
     return {
         "counters": {k: v for k, v in counters.items() if v},
         "gauges": gauges,
         "histograms": hists,
+        "flight": ring_delta,
     }
 
 
-def _merge_worker_obs(delta: dict) -> None:
-    """Fold one worker result's obs delta into the parent registry."""
+def _merge_worker_obs(delta: dict, worker_ring: deque | None = None) -> None:
+    """Fold one worker result's obs delta into the parent registry; the
+    worker's shipped flight entries append to the parent's bounded
+    per-worker ring copy (the crash black box)."""
     reg = obs.get_registry()
     for name, nv in delta.get("counters", {}).items():
         obs.count(name, nv)
@@ -326,6 +341,8 @@ def _merge_worker_obs(delta: dict) -> None:
         reg.merge_gauge(name, g)
     for name, hsnap in delta.get("histograms", {}).items():
         reg.merge_histogram(name, hsnap)
+    if worker_ring is not None:
+        worker_ring.extend(delta.get("flight", ()))
 
 
 def _pool_exec(key: tuple) -> tuple:
@@ -341,6 +358,14 @@ def _pool_exec(key: tuple) -> tuple:
         out = execute_case(case, _WORKER_DUMPER)
     except Exception:
         traceback.print_exc()
+        # the worker survived the exception, so it writes its own black
+        # box (a SIGKILLed worker can't — the parent dumps for it from
+        # the ring entries shipped with previous results)
+        flight.trigger_dump(
+            "gen.worker_exception",
+            detail="/".join(map(str, key)),
+            extra={"traceback": traceback.format_exc()[-4000:]},
+        )
         return key, "failed", rss, _worker_obs_delta(), {}, None
     digests = _WORKER_DUMPER.pop_digests()
     status = "written" if out is not None else "skipped"
@@ -431,6 +456,9 @@ def _run_pool(
     attempts: dict[tuple, int] = dict.fromkeys(keys, 0)
     resolved: set[tuple] = set()
     workers: dict[int, _Worker] = {}
+    # each worker's last-shipped flight ring (bounded like the ring
+    # itself): the black box the parent dumps when the worker dies
+    worker_rings: dict[int, deque] = {}
     t0 = time.monotonic()
     last_print = 0.0
     max_rss = 0
@@ -454,6 +482,7 @@ def _run_pool(
         )
         fault.retrying(proc.start, name="gen.worker_spawn", attempts=3)
         workers[proc.pid] = _Worker(proc, task_q, res_q)
+        worker_rings[proc.pid] = deque(maxlen=max(flight.capacity(), 1))
 
     def requeue_or_fail(key: tuple):
         nonlocal retried
@@ -523,7 +552,7 @@ def _run_pool(
                             w.deadline = None
                         losses_since_progress = 0
                         max_rss = max(max_rss, rss)
-                        _merge_worker_obs(obs_delta)
+                        _merge_worker_obs(obs_delta, worker_rings.get(pid))
                         if key in resolved:
                             pass  # late duplicate of a re-dispatched case
                         elif status == "failed":
@@ -539,6 +568,7 @@ def _run_pool(
                             manifest.record(key, status, digests, rel)
                     elif msg == "recycle":
                         workers.pop(pid, None)
+                        worker_rings.pop(pid, None)  # clean exit: no black box
                         w.proc.join(timeout=10)
                         obs.count("gen.workers_recycled", 1)
                         if w.busy_key is not None and w.busy_key not in resolved:
@@ -591,6 +621,21 @@ def _run_pool(
                     case="/".join(map(str, w.busy_key or ())),
                     hung=hung,
                 )
+                # the dead worker's black box: it can't dump its own ring
+                # any more, so the parent dumps the copy shipped with its
+                # results (plus the parent's own ring for pool context)
+                flight.trigger_dump(
+                    "gen.worker_lost",
+                    detail="/".join(map(str, w.busy_key or ())) or "idle",
+                    extra={
+                        "worker_pid": pid,
+                        "exitcode": w.proc.exitcode,
+                        "hung": hung,
+                        "in_flight_case": list(w.busy_key) if w.busy_key else None,
+                        "worker_ring": list(worker_rings.get(pid, ())),
+                    },
+                )
+                worker_rings.pop(pid, None)
                 if w.busy_key is not None and w.busy_key not in resolved:
                     requeue_or_fail(w.busy_key)
                 if losses_since_progress > max_consecutive_losses:

@@ -30,6 +30,24 @@ Export/attribution layer on top (this PR's tentpole):
     (textfile and/or stdlib HTTP ``/metrics``);
   * ``obs.slo`` — declarative SLOs evaluated from any snapshot.
 
+Postmortem/attribution layer (obs/flight.py + obs/xprof.py):
+
+  * ``obs.flight`` — an always-on bounded ring of recent structured
+    events (every emitted event + counter mega-bumps), dumped as a
+    postmortem bundle (ring + registry + env + platform) to
+    ``ETH_SPECS_OBS_POSTMORTEM_DIR`` on trigger: watchdog divergence,
+    ``fault.degrade`` fallback, live SLO breach, lost gen-pool worker
+    (workers ship their rings to the parent incrementally, so a
+    SIGKILLed worker still leaves a black box), pytest failure, or the
+    explicit ``flight.dump()`` API. ``scripts/postmortem.py`` inspects
+    and diffs bundles.
+  * ``obs.xprof`` — XLA-derived attribution: AOT compile timing into
+    ``xprof.compile_ms`` histograms, ``cost_analysis``/
+    ``memory_analysis`` published as per-kernel gauges, and a
+    cross-check of the hand ``work_bytes`` floor against the
+    compiler's bytes-accessed (advisory
+    ``xprof.cost_model_mismatch`` counter past tolerance).
+
 Environment:
     ETH_SPECS_OBS=0              disable all recording
     ETH_SPECS_OBS_JSONL=<path>   stream structured events as JSON lines
@@ -38,13 +56,28 @@ Environment:
     ETH_SPECS_OBS_REPORT=<path>  pytest run-level report destination
     ETH_SPECS_OBS_PROM=<path>    Prometheus textfile destination
     ETH_SPECS_OBS_HTTP_PORT=<p>  serve GET /metrics on 127.0.0.1:<p>
+    ETH_SPECS_OBS_POSTMORTEM_DIR=<dir>  flight-recorder bundle dir
+                                 (unset: postmortem dumps are no-ops)
+    ETH_SPECS_OBS_FLIGHT=<n>     flight ring capacity (default 512; 0 off)
+    ETH_SPECS_OBS_FLIGHT_COUNTER_FLOOR=<n>  counter increment that rates
+                                 a ring entry (default 65536)
+    ETH_SPECS_OBS_XPROF=1        enable ambient XLA attribution capture
+    ETH_SPECS_OBS_XPROF_TOL=<f>  cost-model mismatch tolerance (0.25)
     ETH_SPECS_SLO_WAIT_P99_MS    serve wait p99 SLO bound (default 250)
     ETH_SPECS_SLO_DEGRADED_RATE  degraded-per-request SLO bound (0.01)
 """
 
 from __future__ import annotations
 
-from . import export, gates, slo, trace, watchdog  # noqa: F401  (public submodules)
+from . import (  # noqa: F401  (public submodules)
+    export,
+    flight,
+    gates,
+    slo,
+    trace,
+    watchdog,
+    xprof,
+)
 from .histogram import Histogram  # noqa: F401
 from .registry import Registry, get_registry, obs_enabled  # noqa: F401
 

@@ -1,0 +1,301 @@
+"""Flight recorder: an always-on bounded ring of recent structured
+events, dumped as a postmortem bundle on trigger.
+
+Counters and the JSONL sink tell you what happened *in aggregate*; the
+moment something actually goes wrong — a watchdog divergence, a
+whole-batch degrade, an OOM-killed gen worker — the question is "what
+were the last N things this process did", and by then it is too late to
+turn tracing on. So the ring records continuously:
+
+  * every emitted obs event (span ends with their trace ids, flush
+    compositions, admission sheds, fault/degrade breadcrumbs) — the
+    registry's ``emit`` feeds the ring unconditionally;
+  * counter bumps whose increment clears a floor
+    (``ETH_SPECS_OBS_FLIGHT_COUNTER_FLOOR``, default 65536) — the rare
+    mega-bumps (a 100MB transfer, a million-hash batch) are flight
+    events, the per-call pennies are not;
+  * explicit :func:`record` calls from anywhere.
+
+Each entry carries a process-monotonic ``seq``, wall time, thread name,
+and — when a trace context is active — trace/span ids, so a dumped ring
+stitches into the same trees the JSONL stream does.
+
+**Postmortem bundles.** :func:`dump` writes ring + registry snapshot +
+filtered env + platform/device info as one JSON file into
+``ETH_SPECS_OBS_POSTMORTEM_DIR`` (unset → dumps are no-ops; nothing in
+a default run writes to disk). :func:`trigger_dump` is the rate-limited
+form the failure paths call — watchdog mismatch (obs/watchdog.py),
+``fault.degrade`` fallback (fault/degrade.py), live SLO breach
+(obs/slo.py), a lost gen-pool worker (gen/gen_runner.py, which ships
+each worker's ring to the parent incrementally so a SIGKILLed worker
+still leaves a black box), and pytest session failure
+(test_infra/obs_plugin.py). ``scripts/postmortem.py`` pretty-prints and
+diffs bundles; ``make postmortem`` shows the most recent one.
+
+Cost discipline: with ``ETH_SPECS_OBS=0`` the registry never calls the
+taps, so the hot record path is an allocation-free no-op; with
+``ETH_SPECS_OBS_FLIGHT=0`` the ring itself is disabled (taps return on
+an int compare). Recording is one small dict + one deque append under a
+lock held for the append only.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+import sys
+import threading
+import time
+from collections import deque
+
+from . import trace
+
+_DEFAULT_CAPACITY = 512
+_DEFAULT_COUNTER_FLOOR = 65536
+# dump-storm guard: a divergence inside a hot loop must not write
+# thousands of near-identical bundles
+_MAX_DUMPS_PER_TRIGGER = 8
+
+_LOCK = threading.Lock()
+_RING: deque = deque(maxlen=_DEFAULT_CAPACITY)
+_SEQ = 0
+_DUMP_N = 0  # per-process bundle ordinal (unique filenames within a second)
+_DUMPS_BY_TRIGGER: dict[str, int] = {}
+
+
+def _env_int(name: str, default: int) -> int:
+    raw = os.environ.get(name, "")
+    try:
+        return int(raw) if raw else default
+    except ValueError:
+        return default
+
+
+_CAPACITY = _env_int("ETH_SPECS_OBS_FLIGHT", _DEFAULT_CAPACITY)
+_COUNTER_FLOOR = _env_int("ETH_SPECS_OBS_FLIGHT_COUNTER_FLOOR", _DEFAULT_COUNTER_FLOOR)
+
+
+def refresh_env() -> None:
+    """Re-read the flight env knobs (capacity, counter floor) — resolved
+    once at import for the hot paths; tests that flip them call this."""
+    global _CAPACITY, _COUNTER_FLOOR, _RING
+    _CAPACITY = _env_int("ETH_SPECS_OBS_FLIGHT", _DEFAULT_CAPACITY)
+    _COUNTER_FLOOR = _env_int(
+        "ETH_SPECS_OBS_FLIGHT_COUNTER_FLOOR", _DEFAULT_COUNTER_FLOOR
+    )
+    with _LOCK:
+        _RING = deque(_RING, maxlen=max(_CAPACITY, 1))
+
+
+def capacity() -> int:
+    return _CAPACITY
+
+
+def dump_dir() -> str | None:
+    return os.environ.get("ETH_SPECS_OBS_POSTMORTEM_DIR") or None
+
+
+# ------------------------------------------------------------------ record --
+
+
+def _append(entry: dict) -> None:
+    global _SEQ
+    with _LOCK:
+        _SEQ += 1
+        entry["seq"] = _SEQ
+        _RING.append(entry)
+
+
+def note_event(event: dict) -> None:
+    """Registry tap: called by ``Registry.emit`` for every event (the
+    registry already checked obs_enabled). Copies, never mutates — the
+    same dict was just written to the JSONL sink."""
+    if _CAPACITY <= 0:
+        return
+    _append({"t": time.time(), "thread": threading.current_thread().name, **event})
+
+
+def note_count(name: str, n: int | float) -> None:
+    """Registry tap for counter bumps: only increments clearing the
+    floor become flight events (obs_enabled already checked)."""
+    if _CAPACITY <= 0 or n < _COUNTER_FLOOR:
+        return
+    entry = {
+        "kind": "count",
+        "name": name,
+        "n": n,
+        "t": time.time(),
+        "thread": threading.current_thread().name,
+    }
+    entry.update(trace.event_fields(trace.current()))
+    _append(entry)
+
+
+def record(kind: str, **fields) -> None:
+    """Explicit flight entry from anywhere (no registry involvement);
+    no-op when obs is disabled or the ring is off."""
+    from .registry import obs_enabled
+
+    if not obs_enabled() or _CAPACITY <= 0:
+        return
+    entry = {"kind": kind, "t": time.time(),
+             "thread": threading.current_thread().name, **fields}
+    entry.update(trace.event_fields(trace.current()))
+    _append(entry)
+
+
+def ring() -> list[dict]:
+    """Point-in-time copy of the ring, oldest first."""
+    with _LOCK:
+        return list(_RING)
+
+
+def ship_since(seq: int) -> tuple[int, list[dict]]:
+    """Entries newer than ``seq`` plus the new high-water mark — the
+    cross-process shipping unit (gen pool workers send this with every
+    result so the parent always holds their recent ring)."""
+    with _LOCK:
+        entries = [e for e in _RING if e.get("seq", 0) > seq]
+        return _SEQ, entries
+
+
+def reset_for_tests() -> None:
+    global _SEQ, _DUMP_N
+    with _LOCK:
+        _RING.clear()
+        _SEQ = 0
+        _DUMP_N = 0
+        _DUMPS_BY_TRIGGER.clear()
+
+
+# -------------------------------------------------------------------- dump --
+
+
+def _platform_info() -> dict:
+    import platform as _pl
+
+    info = {
+        "system": _pl.system(),
+        "release": _pl.release(),
+        "machine": _pl.machine(),
+        "python": _pl.python_version(),
+    }
+    # device identity is the first question a postmortem reader asks;
+    # best-effort so a jax-less process still dumps
+    try:
+        import jax
+
+        info["jax_version"] = jax.__version__
+        info["jax_backend"] = jax.default_backend()
+        info["devices"] = [str(d) for d in jax.devices()]
+    except Exception:
+        pass
+    return info
+
+
+def _env_section() -> dict:
+    """Only the knobs that shape this repo's runtime — never the whole
+    environ (tokens/credentials must not land in an uploaded artifact)."""
+    return {
+        k: v
+        for k, v in sorted(os.environ.items())
+        if k.startswith(("ETH_SPECS_", "JAX_", "XLA_", "SPEC_TEST_"))
+    }
+
+
+_SECRET_ARG = re.compile(r"token|secret|password|passwd|api[-_]?key|bearer|credential",
+                         re.IGNORECASE)
+
+
+def _argv_section() -> list[str]:
+    """argv with secret-shaped arguments redacted — bundles ride CI
+    artifacts, so the same exposure rule as the env section applies: a
+    `--token=...` (or the value following `--api-key`) must not leak."""
+    out: list[str] = []
+    redact_next = False
+    for arg in sys.argv:
+        if redact_next:
+            out.append("<redacted>")
+            redact_next = False
+            continue
+        if _SECRET_ARG.search(arg):
+            if "=" in arg:
+                out.append(arg.split("=", 1)[0] + "=<redacted>")
+            else:
+                out.append(arg)
+                redact_next = arg.startswith("-")
+            continue
+        out.append(arg)
+    return out
+
+
+def dump(
+    trigger: str,
+    detail: str | None = None,
+    extra: dict | None = None,
+    ring_events: list[dict] | None = None,
+    out_dir: str | None = None,
+) -> str | None:
+    """Write a postmortem bundle; returns the path, or None when no
+    destination is configured. Never raises — a failing black box must
+    not take the plane down with it."""
+    out_dir = out_dir or dump_dir()
+    if not out_dir:
+        return None
+    from .registry import get_registry
+
+    try:
+        os.makedirs(out_dir, exist_ok=True)
+        bundle = {
+            "bundle": "eth-specs-postmortem",
+            "version": 1,
+            "trigger": trigger,
+            "detail": detail,
+            "unix_time": time.time(),
+            "pid": os.getpid(),
+            "argv": _argv_section(),
+            "platform": _platform_info(),
+            "env": _env_section(),
+            "ring": ring_events if ring_events is not None else ring(),
+            "registry": get_registry().snapshot(),
+        }
+        if extra:
+            bundle["extra"] = extra
+        stamp = time.strftime("%Y%m%dT%H%M%S", time.gmtime())
+        slug = "".join(c if c.isalnum() else "-" for c in trigger)
+        global _DUMP_N
+        with _LOCK:
+            _DUMP_N += 1
+            n = _DUMP_N
+        path = os.path.join(out_dir, f"postmortem-{stamp}-{os.getpid()}-{slug}-{n}.json")
+        tmp = path + ".tmp"
+        with open(tmp, "w") as fh:
+            json.dump(bundle, fh, indent=1, sort_keys=True, default=str)
+            fh.write("\n")
+        os.replace(tmp, path)
+    except Exception:
+        return None
+    reg = get_registry()
+    reg.count("flight.dumps", 1)
+    reg.emit({"kind": "flight.dump", "trigger": trigger, "path": path})
+    return path
+
+
+def trigger_dump(
+    trigger: str,
+    detail: str | None = None,
+    extra: dict | None = None,
+    ring_events: list[dict] | None = None,
+) -> str | None:
+    """The failure-path entry: no-op without a configured dump dir, and
+    capped per trigger kind so a divergence storm can't fill the disk
+    with near-identical bundles."""
+    if not dump_dir():
+        return None
+    with _LOCK:
+        n = _DUMPS_BY_TRIGGER.get(trigger, 0)
+        if n >= _MAX_DUMPS_PER_TRIGGER:
+            return None
+        _DUMPS_BY_TRIGGER[trigger] = n + 1
+    return dump(trigger, detail=detail, extra=extra, ring_events=ring_events)

@@ -31,7 +31,7 @@ import os
 import threading
 import time
 
-from . import gates, trace
+from . import flight, gates, trace
 from .histogram import Histogram
 
 _MAX_EVENTS = 10_000
@@ -228,6 +228,9 @@ class Registry:
             return
         with self._lock:
             self.counters[name] = self.counters.get(name, 0) + n
+        # flight-recorder tap (obs/flight.py): mega-bumps above the floor
+        # land in the postmortem ring; ETH_SPECS_OBS=0 never reaches here
+        flight.note_count(name, n)
 
     def bytes_moved(self, name: str, nbytes: int) -> None:
         self.count(f"{name}.bytes_moved", int(nbytes))
@@ -292,6 +295,9 @@ class Registry:
     def emit(self, event: dict) -> None:
         if not obs_enabled():
             return
+        # every emitted event is also a flight-recorder entry: the ring
+        # holds the last N of these when a postmortem trigger fires
+        flight.note_event(event)
         with self._lock:
             self.events.append(event)
             if len(self.events) > _MAX_EVENTS:

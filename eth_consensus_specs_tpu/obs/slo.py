@@ -132,12 +132,26 @@ def _eval_one(slo: SLO, snap: dict) -> SLOResult:
 
 def evaluate(snap: dict | None = None, slos: list[SLO] | None = None) -> list[SLOResult]:
     """Evaluate ``slos`` (default: :func:`default_slos`) against ``snap``
-    (default: the live registry snapshot)."""
+    (default: the live registry snapshot). A breach observed against the
+    LIVE registry is a flight-recorder trigger — the process just failed
+    its objectives, so it leaves a postmortem bundle; evaluating a loaded
+    report (snap passed in) is inspection, not an incident, and never
+    dumps."""
+    live = snap is None
     if snap is None:
         from .registry import get_registry
 
         snap = get_registry().snapshot()
-    return [_eval_one(s, snap) for s in (slos if slos is not None else default_slos())]
+    results = [_eval_one(s, snap) for s in (slos if slos is not None else default_slos())]
+    if live and not passed(results):
+        from . import flight
+
+        flight.trigger_dump(
+            "slo.breach",
+            detail=",".join(r.name for r in results if not r.ok),
+            extra={"slo": report(results)},
+        )
+    return results
 
 
 def passed(results: list[SLOResult]) -> bool:

@@ -28,7 +28,7 @@ import threading
 
 import numpy as np
 
-from . import gates
+from . import flight, gates
 from .registry import get_registry, obs_enabled
 
 _DEFAULT_RATE = 0.05
@@ -82,6 +82,9 @@ def record(kernel: str, ok: bool, detail: dict | None = None) -> None:
         if detail:
             event.update(detail)
         reg.emit(event)
+        # a divergence is THE postmortem moment: dump the flight ring +
+        # registry so the black box holds what led up to the wrong answer
+        flight.trigger_dump("watchdog.divergence", detail=kernel, extra={"event": event})
 
 
 # ------------------------------------------------------------ kernel checks --

@@ -34,7 +34,7 @@ import numpy as np
 from jax import lax
 
 from eth_consensus_specs_tpu import obs
-from eth_consensus_specs_tpu.obs import watchdog
+from eth_consensus_specs_tpu.obs import watchdog, xprof
 
 from .sha256 import sha256_pair_words
 
@@ -143,6 +143,16 @@ def merkleize_many_device(
         sp.result = roots = np.asarray(_many_tree_root_fused(jnp.asarray(words), depth))
     obs.count("merkle.trees", b)
     obs.count("merkle.real_hashes", real)
+    if xprof.enabled():
+        # once per (batch, depth): what XLA compiled for this bucket vs
+        # the 96 B × real-hash floor the span's roofline was judged on
+        xprof.analyze(
+            "merkle_many",
+            _many_tree_root_fused,
+            (jax.ShapeDtypeStruct((batch, cap, 8), jnp.uint32), depth),
+            hand_bytes=96 * real,
+            dims=(batch, depth),
+        )
     out = [roots[i].astype(">u4", order="C").view(np.uint8).tobytes() for i in range(b)]
     if b and watchdog.should_check("merkle"):
         i = watchdog.call_salt("merkle") % b
@@ -171,6 +181,14 @@ def merkleize_subtree_device(chunks: np.ndarray, depth: int) -> bytes:
     obs.count("merkle.trees", 1)
     obs.count("merkle.real_hashes", real)
     obs.count("merkle.leaf_chunks", n)
+    if xprof.enabled():
+        xprof.analyze(
+            "merkle",
+            _tree_root_fused,
+            (jax.ShapeDtypeStruct((cap, 8), jnp.uint32), depth),
+            hand_bytes=96 * real,
+            dims=(depth,),
+        )
     root = root_words.astype(">u4", order="C").view(np.uint8).tobytes()
     if watchdog.should_check("merkle"):
         watchdog.check_merkle_root(words, depth, root)

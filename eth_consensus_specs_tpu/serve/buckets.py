@@ -21,7 +21,9 @@ dispatch (tests/test_serve.py pins the crossover).
 
 Compile accounting: every first dispatch of a new (op, *dims) shape key
 is counted as ``serve.compiles`` (the jit cache makes later dispatches
-free), appended to a persistent warmup list when
+free), its wall time recorded into the ``serve.compile_ms`` histogram
+(via :class:`first_dispatch` — histogram count stays in lockstep with
+the counter), appended to a persistent warmup list when
 ``ETH_SPECS_SERVE_WARMUP`` names a file, and ``precompile()`` replays
 that list at startup so a restarted service pays zero compiles on its
 steady-state buckets.
@@ -32,6 +34,7 @@ from __future__ import annotations
 import json
 import os
 import threading
+import time
 
 from eth_consensus_specs_tpu import obs
 
@@ -92,6 +95,44 @@ def note_dispatch(op: str, *dims: int) -> bool:
     obs.event("serve.compile", op=op, dims=",".join(map(str, dims)))
     _warmup_append(key)
     return True
+
+
+def observe_compile_ms(op: str, ms: float, n: int = 1) -> None:
+    """Record a first-dispatch compile wall time into the
+    ``serve.compile_ms`` (+ per-op) histograms. ``n > 1`` records the
+    same wall once per first-sighted shape that paid inside it (the BLS
+    MSM case: several pow2 committee sizes can first-compile inside one
+    ``verify_many`` call) — the invariant ``serve.compile_ms.count ==
+    serve.compiles`` is what serve_bench and the CI obs-report job
+    assert."""
+    for _ in range(max(n, 0)):
+        obs.observe("serve.compile_ms", ms)
+        obs.observe(f"serve.compile_ms.{op}", ms)
+
+
+class first_dispatch:
+    """``with first_dispatch(op, *dims):`` around the dispatch call —
+    notes the shape key (``serve.compiles`` on first sighting) and, when
+    this dispatch is the one paying the jit compile, records its wall
+    time into ``serve.compile_ms``. The wall is recorded even when the
+    block raises: the compile attempt happened and the histogram must
+    stay in lockstep with the ``serve.compiles`` counter."""
+
+    __slots__ = ("op", "dims", "first", "_t0")
+
+    def __init__(self, op: str, *dims: int):
+        self.op = op
+        self.dims = dims
+
+    def __enter__(self) -> "first_dispatch":
+        self.first = note_dispatch(self.op, *self.dims)
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> bool:
+        if self.first:
+            observe_compile_ms(self.op, (time.perf_counter() - self._t0) * 1e3)
+        return False
 
 
 def seen_shapes() -> list[tuple]:
@@ -162,8 +203,10 @@ def precompile(keys: list[tuple] | None = None) -> int:
 
                 batch, depth = int(dims[0]), int(dims[1])
                 zero = np.zeros((1, 8), np.uint32)
-                note_dispatch("merkle_many", batch, depth)
-                merkleize_many_device([zero], depth, pad_batch=batch)
+                # warmup compiles are first dispatches like any other:
+                # their wall time lands in serve.compile_ms too
+                with first_dispatch("merkle_many", batch, depth):
+                    merkleize_many_device([zero], depth, pad_batch=batch)
             else:
                 continue
         except Exception:

@@ -22,7 +22,10 @@ the degraded host path.
 
 Counters/events: ``serve.requests``, ``serve.flushes``,
 ``serve.flush.{size,deadline,pressure,idle,close}``, ``serve.batch_items``,
-``serve.compiles``, ``serve.rejected[.reason]``, gauges
+``serve.compiles`` (each first dispatch's wall time lands in the
+``serve.compile_ms`` histogram — count stays in lockstep with the
+counter, ``stats()`` and serve_bench report its p50/p99),
+``serve.rejected[.reason]``, gauges
 ``serve.queue_depth`` / ``serve.in_flight_bytes``, a ``serve.flush``
 event per flush (batch size, reason, in-flush wait p50/p99) and a
 ``serve.stats`` event at close with run-level p50/p99 wait.
@@ -271,13 +274,27 @@ class VerifyService:
             if device:
                 from eth_consensus_specs_tpu.ops.bls_batch import _use_device, verify_many
 
+                firsts = 0
                 if _use_device():
                     # the device G1 MSM compiles per pow2 committee size
                     # (the kernel's own bucket grid): account first
                     # sightings so `serve.compiles` covers BLS traffic too
                     for r in bls_reqs:
-                        buckets.note_dispatch("bls_msm", buckets.pow2_bucket(len(r.payload[0])))
-                verdicts = verify_many([r.payload for r in bls_reqs])
+                        if buckets.note_dispatch(
+                            "bls_msm", buckets.pow2_bucket(len(r.payload[0]))
+                        ):
+                            firsts += 1
+                t0 = time.perf_counter()
+                try:
+                    verdicts = verify_many([r.payload for r in bls_reqs])
+                finally:
+                    if firsts:
+                        # every first-sighted committee size paid its
+                        # compile inside this one call: each records the
+                        # same wall so compile_ms.count == serve.compiles
+                        buckets.observe_compile_ms(
+                            "bls_msm", (time.perf_counter() - t0) * 1e3, n=firsts
+                        )
             else:
                 from eth_consensus_specs_tpu.crypto.signature import fast_aggregate_verify
 
@@ -295,9 +312,9 @@ class VerifyService:
                 from eth_consensus_specs_tpu.ops.merkle import merkleize_many_device
 
                 pad = buckets.batch_bucket(len(group), self.config.buckets)
-                buckets.note_dispatch("merkle_many", pad, depth)
                 trees = [r.prepped if r.prepped is not None else r.payload[0] for r in group]
-                roots = merkleize_many_device(trees, depth, pad_batch=pad)
+                with buckets.first_dispatch("merkle_many", pad, depth):
+                    roots = merkleize_many_device(trees, depth, pad_batch=pad)
             else:
                 from eth_consensus_specs_tpu.obs.watchdog import host_tree_root_words
                 from eth_consensus_specs_tpu.ops.merkle import _chunks_to_words
@@ -324,10 +341,10 @@ class VerifyService:
                     state_root_compile_key,
                 )
 
-                buckets.note_dispatch(*state_root_compile_key(meta))
-                results[id(r)] = np.asarray(
-                    post_epoch_state_root(arrays, meta, balances, eff, inact, just)
-                )
+                with buckets.first_dispatch(*state_root_compile_key(meta)):
+                    results[id(r)] = np.asarray(
+                        post_epoch_state_root(arrays, meta, balances, eff, inact, just)
+                    )
             else:
                 from eth_consensus_specs_tpu.ops.state_root import post_epoch_state_root_host
 
@@ -368,7 +385,18 @@ class VerifyService:
         p50 = self._waits.quantile(0.5)
         p99 = self._waits.quantile(0.99)
         counters = obs.snapshot()["counters"]
+        # first-dispatch compile walls (process-wide histogram: every
+        # service and precompile() in this process records into it)
+        ch = obs.histogram("serve.compile_ms")
+        compile_ms = None
+        if ch is not None and ch.count:
+            compile_ms = {
+                "count": ch.count,
+                "p50": round(ch.quantile(0.5), 3),
+                "p99": round(ch.quantile(0.99), 3),
+            }
         return {
+            "compile_ms": compile_ms,
             "queue_depth": self.admission.depth(),
             "in_flight_bytes": self.admission.in_flight_bytes(),
             "wait_samples": self._waits.count,

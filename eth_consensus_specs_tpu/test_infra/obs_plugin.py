@@ -79,7 +79,15 @@ class ObsPlugin:
             self.per_test.append((item.nodeid, delta))
 
     def pytest_sessionfinish(self, session, exitstatus):
+        from eth_consensus_specs_tpu.obs import flight
+
         snap = obs.snapshot()
+        # a failing session is a postmortem trigger: leave the flight
+        # ring + registry as a bundle for the CI `if: failure()` artifact
+        # (no-op without ETH_SPECS_OBS_POSTMORTEM_DIR; exit 5 = "no tests
+        # collected" is a config problem, not an incident)
+        if exitstatus not in (0, 5):
+            flight.trigger_dump("pytest.failure", detail=f"exitstatus={exitstatus}")
         # the Prometheus textfile knob is independent of the JSON report
         # knob: honor ETH_SPECS_OBS_PROM even when the report is disabled
         try:
@@ -116,6 +124,8 @@ class ObsPlugin:
                 "watchdog_rate": watchdog.sampling_rate(),
                 "exitstatus": int(exitstatus),
                 "tests_with_kernel_activity": len(self.per_test),
+                "postmortem_dir": os.environ.get("ETH_SPECS_OBS_POSTMORTEM_DIR"),
+                "flight_ring_depth": len(flight.ring()),
             },
         }
         try:

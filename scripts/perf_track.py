@@ -21,13 +21,23 @@ marker otherwise, and defaults to the accelerator.
 
 Regression policy: the PRIMARY metric gates (exit 1) when it drops
 more than ``--threshold`` (default 30 %) against the most recent prior
-round **of the same platform**; metrics whose name ends in ``_ms``
-compare in the lower-is-better direction. Secondary metrics produce
-*advisories* in the JSON (and gate only under ``--strict``): they are
-measured with less care (single rep, shared warmup) and a hard gate on
-them would make the tracker cry wolf. Quarantined LKG sections
-(BENCH_LKG's round-5 revision) are reported but never compared
-against.
+round **of the same platform**; metrics whose name ends in ``_ms``/
+``_s``/``_bytes`` compare in the lower-is-better direction. Secondary
+metrics produce *advisories* in the JSON (and gate only under
+``--strict``): they are measured with less care (single rep, shared
+warmup) and a hard gate on them would make the tracker cry wolf.
+Quarantined LKG sections (BENCH_LKG's round-5 revision) are reported
+but never compared against.
+
+Rounds that carry an ``xprof`` section (bench.py runs with ambient XLA
+attribution on — obs/xprof.py) also contribute per-kernel
+``xprof_<kernel>_compile_ms`` and ``xprof_<kernel>_peak_bytes`` as
+secondary metrics: a compile-time or executable-memory blow-up between
+rounds surfaces as an advisory on the same same-platform timeline as
+the throughput numbers. Like every secondary they gate only under
+``--strict`` — and compile walls are noisy run-to-run, so expect
+``--strict`` to flag them. Older rounds simply lack the section and
+are skipped by the per-(platform, metric) comparison key.
 
 CI runs this in the ``perf-track`` step (checks.yml) and fails only on
 a same-platform primary regression.
@@ -48,7 +58,7 @@ _CPU_MARKERS = ("cpu fallback", "xla:cpu", "cpu-fallback")
 
 
 def _lower_is_better(metric: str) -> bool:
-    return metric.endswith("_ms") or metric.endswith("_s")
+    return metric.endswith(("_ms", "_s", "_bytes"))
 
 
 def infer_platform(parsed: dict) -> str:
@@ -87,6 +97,13 @@ def load_rounds(repo_dir: str) -> list[dict]:
         for name, value in (parsed.get("secondary") or {}).items():
             if isinstance(value, (int, float)):
                 metrics[name] = value
+        # XLA-derived attribution (per-kernel compile_ms / peak_bytes,
+        # obs/xprof.py): secondary metrics — never a round's primary, so
+        # by default they land in the advisory list (gating only under
+        # --strict, like every secondary)
+        for name, value in (parsed.get("xprof") or {}).items():
+            if isinstance(value, (int, float)):
+                metrics[f"xprof_{name}"] = value
         entry.update(
             status="ok",
             platform=infer_platform(parsed),

@@ -16,7 +16,9 @@ Phases:
      submitting the next) — must produce a SIZE flush and the headline
      throughput;
   5. gates: zero watchdog divergences, zero compiles after warmup
-     (so total compiles <= len(buckets) per depth), declarative SLOs
+     (so total compiles <= len(buckets) per depth), serve.compile_ms
+     histogram count == serve.compiles (every first dispatch left its
+     compile wall time; p50/p99 land in the report), declarative SLOs
      (obs/slo.py: wait p99 bound, degraded rate, divergences,
      compiles-after-warmup) evaluated from the registry snapshot, and —
      full mode — batched BLS throughput >= 2x sequential.
@@ -193,6 +195,15 @@ def main() -> None:
     extra = counters.get("serve.compiles", 0) - compiles_after_warmup
     if extra > 0:
         failures.append(f"{extra} compiles AFTER warmup (shape escaped the buckets)")
+    # every first-dispatch compile must have left its wall time in the
+    # serve.compile_ms histogram — count in lockstep with the counter
+    compile_hist = snap["histograms"].get("serve.compile_ms", {})
+    if compile_hist.get("count", 0) != counters.get("serve.compiles", 0):
+        failures.append(
+            f"serve.compile_ms count {compile_hist.get('count', 0)} != "
+            f"serve.compiles {counters.get('serve.compiles', 0)} "
+            "(a first dispatch escaped the timed wrapper)"
+        )
     # feed the declarative SLO set (obs/slo.py): the counter is the
     # snapshot-visible form of the "zero compiles after warmup" contract
     obs.count("serve.compiles_after_warmup", max(extra, 0))
@@ -237,6 +248,13 @@ def main() -> None:
         },
         "compiles": counters.get("serve.compiles", 0),
         "compiles_after_warmup": max(extra, 0),
+        # first-dispatch compile walls (p50/p99 from the mergeable
+        # histogram; count == compiles is gated above)
+        "compile_ms": {
+            "count": compile_hist.get("count", 0),
+            "p50": compile_hist.get("p50"),
+            "p99": compile_hist.get("p99"),
+        },
         "buckets": list(cfg.buckets),
         "rejected": counters.get("serve.rejected", 0),
         "watchdog": snap["watchdog"],
@@ -266,6 +284,12 @@ def main() -> None:
         json.dump(report, fh, indent=2, sort_keys=True)
     print(json.dumps(report, sort_keys=True))
     if failures:
+        # any gate failure (parity, flush, compile, SLO, exposition) is
+        # an incident: leave a flight-recorder bundle for the CI
+        # `if: failure()` artifact (no-op without a postmortem dir)
+        obs.flight.trigger_dump(
+            "serve_bench.failure", detail="; ".join(failures)[:300]
+        )
         print("FAILED:", *failures, sep="\n  ", file=sys.stderr)
         raise SystemExit(1)
 

@@ -1,0 +1,287 @@
+"""Flight recorder + postmortem bundles (obs/flight.py,
+scripts/postmortem.py).
+
+The black-box contract: the ring records continuously (span ends with
+trace ids, counter mega-bumps, flush/degrade/admission events), and
+every failure trigger — watchdog divergence, fault.degrade fallback,
+live SLO breach, a SIGKILLed gen-pool worker — leaves a JSON bundle in
+``ETH_SPECS_OBS_POSTMORTEM_DIR`` that ``scripts/postmortem.py`` can
+read back, summarize, and diff. ``ETH_SPECS_OBS=0`` keeps the record
+path a no-op.
+"""
+
+from __future__ import annotations
+
+import glob
+import importlib.util
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+from eth_consensus_specs_tpu import fault, obs
+from eth_consensus_specs_tpu.obs import flight, trace, watchdog
+from eth_consensus_specs_tpu.obs.registry import Registry
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _load_postmortem_mod():
+    spec = importlib.util.spec_from_file_location(
+        "postmortem", os.path.join(REPO, "scripts", "postmortem.py")
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+@pytest.fixture(autouse=True)
+def _isolated(monkeypatch, tmp_path):
+    """Fresh ring + registry + a tmp postmortem dir per test: the
+    deliberate divergences/degrades below must never leak into the
+    process registry the run-level obs_report.json is built from."""
+    from eth_consensus_specs_tpu.obs import registry as registry_mod
+
+    monkeypatch.setattr(registry_mod, "_REGISTRY", Registry())
+    monkeypatch.setenv("ETH_SPECS_OBS_POSTMORTEM_DIR", str(tmp_path / "pm"))
+    flight.reset_for_tests()
+    watchdog.reset_for_tests()
+    yield
+    flight.reset_for_tests()
+    watchdog.reset_for_tests()
+
+
+def _bundles(trigger: str | None = None) -> list[str]:
+    d = os.environ["ETH_SPECS_OBS_POSTMORTEM_DIR"]
+    slug = "".join(c if c.isalnum() else "-" for c in trigger) if trigger else ""
+    return sorted(glob.glob(os.path.join(d, f"postmortem-*{slug}*.json")))
+
+
+# ------------------------------------------------------------------- ring --
+
+
+def test_ring_records_events_with_seq_and_trace_ids():
+    with trace.activate(trace.new_trace()):
+        with obs.span("flight.test_span"):
+            pass
+    obs.event("serve.flush", reason="size", batch_size=3)
+    ring = flight.ring()
+    assert [e["seq"] for e in ring] == sorted(e["seq"] for e in ring)
+    span_events = [e for e in ring if e.get("kind") == "span"]
+    assert span_events and span_events[0]["name"] == "flight.test_span"
+    assert span_events[0]["trace_id"]  # trace ids ride into the ring
+    assert any(e.get("kind") == "serve.flush" for e in ring)
+    assert all("t" in e and "thread" in e for e in ring)
+
+
+def test_counter_floor_filters_small_bumps():
+    obs.count("flight.small", 3)
+    assert not [e for e in flight.ring() if e.get("kind") == "count"]
+    obs.count("flight.mega", 1 << 20)
+    counts = [e for e in flight.ring() if e.get("kind") == "count"]
+    assert counts and counts[0]["name"] == "flight.mega" and counts[0]["n"] == 1 << 20
+
+
+def test_ring_is_bounded():
+    for i in range(flight.capacity() + 50):
+        flight.record("spam", i=i)
+    ring = flight.ring()
+    assert len(ring) == flight.capacity()
+    assert ring[-1]["i"] == flight.capacity() + 49  # newest survives
+
+
+def test_obs_disabled_keeps_record_path_noop(monkeypatch):
+    from eth_consensus_specs_tpu.obs import registry as registry_mod
+
+    depth = len(flight.ring())
+    monkeypatch.setenv("ETH_SPECS_OBS", "0")
+    registry_mod.refresh_enabled()
+    try:
+        obs.count("flight.mega", 1 << 30)
+        obs.event("flight.disabled_event")
+        flight.record("direct")
+        with obs.span("flight.disabled_span"):
+            pass
+        assert len(flight.ring()) == depth  # nothing recorded anywhere
+    finally:
+        monkeypatch.setenv("ETH_SPECS_OBS", "1")
+        registry_mod.refresh_enabled()
+
+
+def test_ship_since_is_the_delta_unit():
+    flight.record("a")
+    seq1, first = flight.ship_since(0)
+    assert [e["kind"] for e in first] == ["a"]
+    flight.record("b")
+    seq2, second = flight.ship_since(seq1)
+    assert [e["kind"] for e in second] == ["b"]
+    assert seq2 > seq1
+    assert flight.ship_since(seq2)[1] == []
+
+
+# ------------------------------------------------------------------ dumps --
+
+
+def test_manual_dump_bundle_contents():
+    obs.count("flight.mega", 1 << 20)
+    with obs.span("flight.pre_dump"):
+        pass
+    path = flight.dump("manual", detail="unit-test")
+    assert path and os.path.exists(path)
+    bundle = json.load(open(path))
+    assert bundle["bundle"] == "eth-specs-postmortem"
+    assert bundle["trigger"] == "manual" and bundle["detail"] == "unit-test"
+    assert bundle["pid"] == os.getpid()
+    assert any(e.get("kind") == "span" for e in bundle["ring"])
+    assert "counters" in bundle["registry"] and "watchdog" in bundle["registry"]
+    # env section carries only repo/runtime knobs — never the raw environ
+    assert all(
+        k.startswith(("ETH_SPECS_", "JAX_", "XLA_", "SPEC_TEST_")) for k in bundle["env"]
+    )
+    assert bundle["platform"]["python"]
+
+
+def test_dump_without_dir_is_noop(monkeypatch):
+    monkeypatch.delenv("ETH_SPECS_OBS_POSTMORTEM_DIR")
+    assert flight.dump("manual") is None
+    assert flight.trigger_dump("manual") is None
+
+
+def test_trigger_dump_is_rate_limited():
+    for _ in range(20):
+        flight.trigger_dump("storm")
+    assert len(_bundles("storm")) == 8  # the per-trigger cap
+
+
+def test_watchdog_divergence_triggers_dump():
+    watchdog.record("sha256", False, {"row": 0, "expected": "aa", "got": "bb"})
+    bundles = _bundles("watchdog.divergence")
+    assert len(bundles) == 1
+    bundle = json.load(open(bundles[0]))
+    assert bundle["detail"] == "sha256"
+    assert bundle["extra"]["event"]["kind"] == "watchdog.divergence"
+    # the divergence event itself made it into the ring before the dump
+    assert any(e.get("kind") == "watchdog.divergence" for e in bundle["ring"])
+    assert bundle["registry"]["watchdog"]["divergences"] == 1
+
+
+def test_degrade_triggers_dump():
+    def dying_device():
+        raise RuntimeError("RESOURCE_EXHAUSTED: out of memory")
+
+    assert fault.degrade("flight.site", dying_device, lambda: 42) == 42
+    bundles = _bundles("fault.degrade")
+    assert len(bundles) == 1
+    bundle = json.load(open(bundles[0]))
+    assert bundle["detail"] == "flight.site"
+    assert "out of memory" in bundle["extra"]["error"]
+    assert bundle["registry"]["counters"]["fault.degraded.flight.site"] == 1
+
+
+def test_live_slo_breach_triggers_dump():
+    from eth_consensus_specs_tpu.obs import slo
+
+    obs.count("watchdog.divergences", 1)  # isolated registry: see fixture
+    results = slo.evaluate()  # live evaluation → incident
+    assert not slo.passed(results)
+    bundles = _bundles("slo.breach")
+    assert len(bundles) == 1
+    bundle = json.load(open(bundles[0]))
+    assert "watchdog_divergences" in bundle["detail"]
+    # evaluating a LOADED report is inspection, never an incident
+    assert not slo.passed(slo.evaluate({"counters": {"watchdog.divergences": 2}}))
+    assert len(_bundles("slo.breach")) == 1
+
+
+# ------------------------------------------------- killed gen-pool worker --
+
+
+@pytest.fixture(scope="module")
+def att_cases():
+    from eth_consensus_specs_tpu.gen import discover_test_cases
+
+    cases = discover_test_cases(
+        presets=("minimal",), forks=("phase0",), runners=("operations",)
+    )
+    cases = [c for c in cases if c.handler == "attestation"]
+    assert len(cases) >= 5
+    return cases
+
+
+def test_sigkilled_worker_leaves_parent_side_black_box(att_cases, tmp_path):
+    """The acceptance path: a worker SIGKILLed mid-run can't write its
+    own bundle, but its ring shipped to the parent with every completed
+    case — the parent's gen.worker_lost bundle holds it, trace ids and
+    all."""
+    sub = att_cases[:6]
+    latch = tmp_path / "kill.latch"
+    with fault.injected(f"gen.case:kill:nth=2:latch={latch}"):
+        from eth_consensus_specs_tpu.gen import run_generator
+
+        stats = run_generator(sub, str(tmp_path / "out"), workers=2, case_retries=3)
+    assert stats["failed"] == 0  # the pool recovered as before
+    bundles = _bundles("gen.worker_lost")
+    assert len(bundles) >= 1
+    bundle = json.load(open(bundles[0]))
+    extra = bundle["extra"]
+    assert extra["exitcode"] is None or extra["exitcode"] != 0
+    assert extra["in_flight_case"], "the in-flight case key must be named"
+    ring = extra["worker_ring"]
+    assert ring, "the dead worker's shipped ring is the black box"
+    spans = [e for e in ring if e.get("kind") == "span"]
+    assert spans and any(e.get("trace_id") for e in spans), (
+        "worker ring events must carry trace ids for stitching"
+    )
+
+
+# ------------------------------------------------------ inspector round-trip --
+
+
+def test_postmortem_inspector_roundtrip_and_diff(tmp_path):
+    obs.count("flight.mega", 1 << 20)
+    a = flight.dump("manual", detail="first")
+    obs.count("flight.mega", 1 << 20)
+    obs.count("extra.counter", 7)
+    b = flight.dump("manual", detail="second")
+    pm = _load_postmortem_mod()
+
+    # loader + dir listing
+    d = os.environ["ETH_SPECS_OBS_POSTMORTEM_DIR"]
+    assert set(pm.list_bundles(d)) == {a, b}
+    assert pm.latest_bundle(d) in (a, b)
+    loaded = pm.load_bundle(a)
+    assert loaded["detail"] == "first"
+
+    # summarize mentions the essentials
+    text = pm.summarize(loaded, path=a)
+    assert "manual" in text and "flight.mega" in text and str(os.getpid()) in text
+
+    # diff sees the counter movement between the two bundles
+    dtext = pm.diff_bundles(pm.load_bundle(a), pm.load_bundle(b))
+    assert "extra.counter" in dtext and "flight.mega" in dtext
+
+    # CLI round-trip: --json re-emits exactly what is on disk
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "scripts", "postmortem.py"), "--json", a],
+        capture_output=True, text=True, check=True,
+    )
+    assert json.loads(out.stdout) == json.load(open(a))
+    # and the prose form exits 0 / the empty-dir probe exits 2
+    subprocess.run(
+        [sys.executable, os.path.join(REPO, "scripts", "postmortem.py"), a],
+        capture_output=True, check=True,
+    )
+    rc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "scripts", "postmortem.py"),
+         "--dir", str(tmp_path / "empty")],
+        capture_output=True,
+    ).returncode
+    assert rc == 2
+
+    # alien JSON is rejected, not trusted
+    alien = tmp_path / "alien.json"
+    alien.write_text('{"hello": "world"}')
+    with pytest.raises(ValueError):
+        pm.load_bundle(str(alien))

@@ -93,14 +93,28 @@ def test_compile_accounting_dedupes(tmp_path, monkeypatch):
     monkeypatch.setenv("ETH_SPECS_SERVE_WARMUP", str(tmp_path / "warm.jsonl"))
     buckets.reset_for_tests()
     before = _counter("serve.compiles")
-    assert buckets.note_dispatch("merkle_many", 4, 3)
-    assert not buckets.note_dispatch("merkle_many", 4, 3)  # same shape: no recount
-    assert buckets.note_dispatch("merkle_many", 8, 3)
+
+    def _hist_count():
+        h = obs.histogram("serve.compile_ms")
+        return h.count if h is not None else 0
+
+    hist0 = _hist_count()
+    # every serve.compiles bump goes through the timed first_dispatch
+    # wrapper, so the compile_ms histogram count tracks the counter 1:1
+    with buckets.first_dispatch("merkle_many", 4, 3) as fd:
+        assert fd.first
+    with buckets.first_dispatch("merkle_many", 4, 3) as fd:
+        assert not fd.first  # same shape: no recount, no duration sample
+    with buckets.first_dispatch("merkle_many", 8, 3) as fd:
+        assert fd.first
     assert _counter("serve.compiles") - before == 2
+    assert _hist_count() - hist0 == 2
     assert set(buckets.load_warmup()) == {("merkle_many", 4, 3), ("merkle_many", 8, 3)}
-    # precompile replays the persisted list without crashing
+    # precompile replays the persisted list without crashing (each replay
+    # is a first dispatch again after the reset: two more duration samples)
     buckets.reset_for_tests()
     assert buckets.precompile() == 2
+    assert _hist_count() - hist0 == 4
     buckets.reset_for_tests()
 
 
