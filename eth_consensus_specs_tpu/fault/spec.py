@@ -14,8 +14,12 @@ Grammar::
                     fleet of pool workers injects exactly one fault
 
 A `site` is a dotted name the instrumented code passes to `check()`
-(``gen.case``, ``state_root.device``, ...); a trailing ``*`` makes the
-rule a prefix match. Rules are parsed once from the environment at
+(``gen.case``, ``state_root.device``, ``serve.dispatch``, and the
+replica socket boundary ``frontdoor.rpc`` — there `stall` makes a
+replica miss the hedge deadline, `kill` SIGKILLs it mid-batch, and
+`corrupt` flips a byte of a framed payload AFTER its digest is
+computed, so the receiver must detect, count, and retry it — see
+serve/wire.py); a trailing ``*`` makes the rule a prefix match. Rules are parsed once from the environment at
 import (`refresh()` re-reads; `install()` sets programmatically;
 `injected()` is the scoped test helper). Hit counters are per-process —
 forked pool workers inherit the parent's rules and count their own
@@ -72,6 +76,17 @@ class FaultRule:
 
 _LOCK = threading.Lock()
 _RULES: list[FaultRule] = []
+
+
+def _reinit_lock_after_fork_in_child() -> None:
+    # hit counters are checked under this lock from any thread (the
+    # front-door dispatcher among them); a fork mid-check must not hand
+    # the child a lock held by a thread that doesn't exist there
+    global _LOCK
+    _LOCK = threading.Lock()
+
+
+os.register_at_fork(after_in_child=_reinit_lock_after_fork_in_child)
 
 
 def parse(spec_str: str) -> list[FaultRule]:

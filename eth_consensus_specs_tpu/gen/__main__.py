@@ -85,11 +85,13 @@ def main() -> None:
     # recovery + serving + flight-recorder counters ride along so CI
     # chaos jobs can assert on them (serve.* arrives from pool workers
     # via the per-case counter shipping when ETH_SPECS_SERVE=1;
-    # flight.dumps says how many postmortem bundles the run left)
+    # frontdoor.* covers the replicated fleet when
+    # ETH_SPECS_SERVE_REPLICAS is set; flight.dumps says how many
+    # postmortem bundles the run left)
     counters = {
         k: v
         for k, v in obs.snapshot()["counters"].items()
-        if k.startswith(("gen.", "fault.", "serve.", "flight."))
+        if k.startswith(("gen.", "fault.", "serve.", "frontdoor.", "flight."))
     }
     print(json.dumps({"cases": len(cases), **stats, "counters": counters}))
 

@@ -34,6 +34,7 @@ from collections import deque
 
 from eth_consensus_specs_tpu import fault, obs
 from eth_consensus_specs_tpu.obs import flight, trace
+from eth_consensus_specs_tpu.obs.delta import DeltaShipper, merge_delta
 
 from .dumper import Dumper
 from .gen_from_tests import TestCase
@@ -237,15 +238,20 @@ def _pool_init(output_dir: str, presets: tuple, forks: tuple | None, package: st
     from eth_consensus_specs_tpu import serve
 
     if serve.serve_enabled():
-        # per-worker verification service: this worker's spec-code BLS
-        # verifies (utils/bls.FastAggregateVerify) coalesce in its own
-        # micro-batcher. idle_flush because a pool worker is a SINGLE
-        # synchronous submitter — without it every verify would pay the
-        # full deadline wait for co-riders that cannot exist. serve.*
-        # counters land in the worker's obs registry and ship to the
-        # parent with every case result via the existing
-        # _worker_obs_delta counter shipping.
-        _WORKER_SERVICE = serve.VerifyService(
+        # With a replicated front door running (the parent exported
+        # ETH_SPECS_SERVE_FRONTDOOR before forking), this worker routes
+        # its BLS verifies ACROSS the process boundary: shape-affine,
+        # failure-aware, hedged — one shared fleet instead of one
+        # private service per worker. Otherwise, the per-worker
+        # in-process service as before. idle_flush because a pool
+        # worker is a SINGLE synchronous submitter — without it every
+        # verify would pay the full deadline wait for co-riders that
+        # cannot exist. serve.*/frontdoor.* counters land in the
+        # worker's obs registry and ship to the parent with every case
+        # result via the existing _worker_obs_delta shipping.
+        _WORKER_SERVICE = serve.maybe_frontdoor_client(
+            name=f"gen-worker-fd-{os.getpid()}"
+        ) or serve.VerifyService(
             serve.ServeConfig.from_env(idle_flush=True),
             name=f"gen-worker-{os.getpid()}",
         )
@@ -264,85 +270,32 @@ def _pool_shutdown():
         _WORKER_SERVICE = None
 
 
-_WORKER_OBS_BASE: dict = {}
-_WORKER_GAUGE_BASE: dict = {}
-_WORKER_HIST_BASE: dict = {}
-_WORKER_FLIGHT_BASE = 0
+_WORKER_SHIPPER: DeltaShipper | None = None
 
 
 def _worker_obs_delta() -> dict:
     """Delta of ALL this worker's obs state since the previous case —
     shipped with each result so pool mode reports what sequential mode
-    does. Three sections:
-
-    * ``counters`` — dumper totals (gen.parts, gen.bytes_serialized),
-      kernel counters, and above all watchdog.checks/.divergences (a
-      divergence detected inside a worker MUST reach the parent
-      registry). Only gen.cases_* stay out: the parent mirrors those
-      from its own authoritative status counts.
-    * ``gauges`` — current {last, max} per gauge (queue depth etc.)
-      that CHANGED since the previous ship (gauges inherited across the
-      fork are swallowed at init like counters — a stale forked ``last``
-      must not overwrite the parent's fresher one); the parent merges
-      last as latest-wins and max monotonically.
-    * ``histograms`` — bucket-count deltas of every histogram (the
-      worker's serve.wait_ms distribution): min/max ship as current
-      values (they only tighten, so repeated min/max-merging is
-      idempotent), counts/sum as differences — without this a pool
-      worker's whole wait distribution died with the process.
-    * ``flight`` — the worker's flight-recorder ring entries since the
-      previous ship (obs/flight.py). The parent retains a bounded
-      per-worker copy, so when a worker is SIGKILLed/OOM-killed the
-      postmortem bundle it can no longer write itself still contains
-      its last recorded events — the black box survives the crash."""
-    global _WORKER_OBS_BASE, _WORKER_FLIGHT_BASE
-    snap = obs.snapshot()
-    now = {
-        k: v
-        for k, v in snap["counters"].items()
-        if not k.startswith("gen.cases_")
-    }
-    counters = {k: v - _WORKER_OBS_BASE.get(k, 0) for k, v in now.items()}
-    _WORKER_OBS_BASE = now
-    gauges = {}
-    for name, g in snap["gauges"].items():
-        if _WORKER_GAUGE_BASE.get(name) != g:
-            _WORKER_GAUGE_BASE[name] = g
-            gauges[name] = g
-    hists = {}
-    for name, hsnap in snap["histograms"].items():
-        base = _WORKER_HIST_BASE.get(name)
-        if base is not None and hsnap["count"] == base["count"]:
-            continue
-        delta = dict(hsnap)
-        if base is not None:
-            delta["counts"] = [c - b for c, b in zip(hsnap["counts"], base["counts"])]
-            delta["count"] = hsnap["count"] - base["count"]
-            delta["sum"] = hsnap["sum"] - base["sum"]
-        _WORKER_HIST_BASE[name] = hsnap
-        hists[name] = delta
-    _WORKER_FLIGHT_BASE, ring_delta = flight.ship_since(_WORKER_FLIGHT_BASE)
-    return {
-        "counters": {k: v for k, v in counters.items() if v},
-        "gauges": gauges,
-        "histograms": hists,
-        "flight": ring_delta,
-    }
+    does. The four sections (counters / gauges / histograms / flight)
+    and their merge semantics live in obs/delta.py, shared with the
+    serving front door's replica health probes; only ``gen.cases_*``
+    counters stay out of the ship — the parent mirrors those from its
+    own authoritative status counts. The shipper swallows fork-inherited
+    registry state at init, so the first delta covers THIS worker's
+    work only and a stale forked gauge can't overwrite the parent's."""
+    global _WORKER_SHIPPER
+    if _WORKER_SHIPPER is None:
+        _WORKER_SHIPPER = DeltaShipper(
+            skip_counter_prefixes=("gen.cases_",), swallow_initial=False
+        )
+    return _WORKER_SHIPPER.delta()
 
 
 def _merge_worker_obs(delta: dict, worker_ring: deque | None = None) -> None:
     """Fold one worker result's obs delta into the parent registry; the
     worker's shipped flight entries append to the parent's bounded
     per-worker ring copy (the crash black box)."""
-    reg = obs.get_registry()
-    for name, nv in delta.get("counters", {}).items():
-        obs.count(name, nv)
-    for name, g in delta.get("gauges", {}).items():
-        reg.merge_gauge(name, g)
-    for name, hsnap in delta.get("histograms", {}).items():
-        reg.merge_histogram(name, hsnap)
-    if worker_ring is not None:
-        worker_ring.extend(delta.get("flight", ()))
+    merge_delta(delta, worker_ring)
 
 
 def _pool_exec(key: tuple) -> tuple:
@@ -447,6 +400,19 @@ def _run_pool(
 
     presets = tuple(sorted({c.preset for c in cases}))
     forks = tuple(sorted({c.fork for c in cases}))
+    from eth_consensus_specs_tpu import serve
+
+    # ETH_SPECS_SERVE=1 + ETH_SPECS_SERVE_REPLICAS=R: the parent boots
+    # ONE replicated front door and exports its addresses before forking
+    # workers — every worker routes verifies through the shared,
+    # supervised fleet instead of a private per-worker service
+    frontdoor = None
+    n_replicas = serve.FrontDoorConfig.from_env().replicas if serve.serve_enabled() else 0
+    if n_replicas > 0 and not serve.frontdoor_addrs():
+        frontdoor = serve.FrontDoor(replicas=n_replicas, name="gen-frontdoor")
+        os.environ.update(frontdoor.export_env())
+        obs.event("gen.frontdoor", replicas=n_replicas,
+                  addrs=",".join(frontdoor.addresses()))
     ctx = mp.get_context("fork")
     counts = {"written": 0, "skipped": 0, "failed": 0}
     # dedup while preserving order: the resolved SET is compared against
@@ -674,6 +640,9 @@ def _run_pool(
             if w.proc.is_alive():
                 w.proc.kill()
                 w.proc.join(timeout=5)
+        if frontdoor is not None:
+            os.environ.pop("ETH_SPECS_SERVE_FRONTDOOR", None)
+            frontdoor.close()
     # dumper counters were shipped per-result above; per-part digest
     # events reach the shared JSONL sink directly from each worker.
     # gen.cases_* mirror the parent's authoritative status counts.

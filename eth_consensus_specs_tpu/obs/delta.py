@@ -1,0 +1,107 @@
+"""Cross-process obs shipping: snapshot deltas and the parent-side fold.
+
+Two subsystems move telemetry across a process boundary — the gen pool
+(workers ship a delta with every case result, gen/gen_runner.py) and
+the replicated serving front door (replicas ship a delta with every
+health-probe response, serve/frontdoor.py). Both need exactly the same
+four sections, with the same merge semantics, so the implementation
+lives here once:
+
+  * ``counters`` — differences since the previous ship (the parent adds
+    them; a re-ship can never double-count);
+  * ``gauges`` — current ``{last, max}`` per gauge that CHANGED since
+    the previous ship; the parent merges ``last`` latest-wins and
+    ``max`` monotonically;
+  * ``histograms`` — bucket-count deltas (counts/sum as differences,
+    min/max as current values — they only tighten, so repeated merging
+    is idempotent);
+  * ``flight`` — the shipper process's flight-recorder ring entries
+    since the previous ship (obs/flight.py). The parent keeps a bounded
+    per-child copy, so a SIGKILLed child still leaves a black box the
+    parent can dump for it.
+
+``swallow_initial=True`` (the default) folds the fork-inherited
+registry state into the baseline at construction, so the first shipped
+delta covers THIS process's work only — a stale forked gauge must not
+overwrite the parent's fresher one, and inherited counters must not
+double-count.
+"""
+
+from __future__ import annotations
+
+from collections import deque
+
+from . import flight
+from .registry import get_registry
+
+
+class DeltaShipper:
+    """Tracks this process's registry against the last shipped baseline;
+    each :meth:`delta` call returns what changed and advances it."""
+
+    def __init__(
+        self,
+        *,
+        skip_counter_prefixes: tuple[str, ...] = (),
+        swallow_initial: bool = True,
+    ):
+        # counters the parent mirrors from its own authoritative state
+        # (gen.cases_* in the pool) stay out of the shipped delta
+        self._skip = tuple(skip_counter_prefixes)
+        self._counter_base: dict = {}
+        self._gauge_base: dict = {}
+        self._hist_base: dict = {}
+        self._flight_base = 0
+        if swallow_initial:
+            self.delta()
+
+    def delta(self) -> dict:
+        snap = get_registry().snapshot()
+        now = {
+            k: v
+            for k, v in snap["counters"].items()
+            if not (self._skip and k.startswith(self._skip))
+        }
+        counters = {k: v - self._counter_base.get(k, 0) for k, v in now.items()}
+        self._counter_base = now
+        gauges = {}
+        for name, g in snap["gauges"].items():
+            if self._gauge_base.get(name) != g:
+                self._gauge_base[name] = g
+                gauges[name] = g
+        hists = {}
+        for name, hsnap in snap["histograms"].items():
+            base = self._hist_base.get(name)
+            if base is not None and hsnap["count"] == base["count"]:
+                continue
+            delta = dict(hsnap)
+            if base is not None:
+                delta["counts"] = [
+                    c - b for c, b in zip(hsnap["counts"], base["counts"])
+                ]
+                delta["count"] = hsnap["count"] - base["count"]
+                delta["sum"] = hsnap["sum"] - base["sum"]
+            self._hist_base[name] = hsnap
+            hists[name] = delta
+        self._flight_base, ring_delta = flight.ship_since(self._flight_base)
+        return {
+            "counters": {k: v for k, v in counters.items() if v},
+            "gauges": gauges,
+            "histograms": hists,
+            "flight": ring_delta,
+        }
+
+
+def merge_delta(delta: dict, ring: deque | None = None) -> None:
+    """Fold one shipped delta into THIS process's registry; the child's
+    flight entries append to ``ring`` (the parent's bounded per-child
+    copy — the crash black box)."""
+    reg = get_registry()
+    for name, nv in delta.get("counters", {}).items():
+        reg.count(name, nv)
+    for name, g in delta.get("gauges", {}).items():
+        reg.merge_gauge(name, g)
+    for name, hsnap in delta.get("histograms", {}).items():
+        reg.merge_histogram(name, hsnap)
+    if ring is not None:
+        ring.extend(delta.get("flight", ()))

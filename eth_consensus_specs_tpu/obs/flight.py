@@ -76,6 +76,17 @@ _CAPACITY = _env_int("ETH_SPECS_OBS_FLIGHT", _DEFAULT_CAPACITY)
 _COUNTER_FLOOR = _env_int("ETH_SPECS_OBS_FLIGHT_COUNTER_FLOOR", _DEFAULT_COUNTER_FLOOR)
 
 
+def _reinit_lock_after_fork_in_child() -> None:
+    # a parent background thread (front-door supervisor, dispatcher)
+    # may hold the ring lock at fork time; the child would inherit it
+    # held forever — it is single-threaded here, so re-creating is safe
+    global _LOCK
+    _LOCK = threading.Lock()
+
+
+os.register_at_fork(after_in_child=_reinit_lock_after_fork_in_child)
+
+
 def refresh_env() -> None:
     """Re-read the flight env knobs (capacity, counter floor) — resolved
     once at import for the hot paths; tests that flip them call this."""

@@ -382,3 +382,26 @@ _REGISTRY = Registry()
 
 def get_registry() -> Registry:
     return _REGISTRY
+
+
+def _reinit_locks_after_fork_in_child() -> None:
+    """Fork-safety: the parent may fork (gen pool workers) while one of
+    its BACKGROUND threads — the front-door supervisor merging replica
+    deltas, a dispatcher bumping counters — holds an obs-layer lock.
+    The child inherits that lock HELD by a thread that doesn't exist
+    there, and its first obs call deadlocks forever. The child is
+    single-threaded at this moment, so unconditionally re-creating
+    every lock is safe; torn metric values are bounded (single-key dict
+    writes) and the worker's delta baseline swallows them at init. The
+    inherited JSONL handle is dropped too — its buffer may hold half a
+    line another thread was writing; the child reopens lazily in append
+    mode."""
+    reg = _REGISTRY
+    reg._lock = threading.Lock()
+    reg._local = threading.local()
+    reg._jsonl_fh = None
+    for h in list(reg.histograms.values()):
+        h._lock = threading.Lock()
+
+
+os.register_at_fork(after_in_child=_reinit_locks_after_fork_in_child)

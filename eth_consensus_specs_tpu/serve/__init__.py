@@ -34,16 +34,29 @@ lazily via ``__getattr__``.
 from __future__ import annotations
 
 from .admission import Overloaded  # noqa: F401  (pure stdlib+obs, cycle-safe)
-from .config import ServeConfig, serve_enabled  # noqa: F401
+from .config import (  # noqa: F401
+    FrontDoorConfig,
+    ServeConfig,
+    frontdoor_addrs,
+    serve_enabled,
+)
 
 _ROUTED = None
 
+_LAZY = {
+    "VerifyService": ("service", "VerifyService"),
+    "FrontDoor": ("frontdoor", "FrontDoor"),
+    "FrontDoorClient": ("frontdoor", "FrontDoorClient"),
+    "maybe_frontdoor_client": ("frontdoor", "maybe_frontdoor_client"),
+}
+
 
 def __getattr__(name: str):
-    if name == "VerifyService":
-        from .service import VerifyService
+    if name in _LAZY:
+        import importlib
 
-        return VerifyService
+        mod, attr = _LAZY[name]
+        return getattr(importlib.import_module(f".{mod}", __name__), attr)
     raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
 
 

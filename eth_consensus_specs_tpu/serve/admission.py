@@ -6,26 +6,48 @@ in-flight — a request only releases its slot when its future resolves,
 so a stalled device can't hide load in the dispatch pipeline) is under
 ``max_queue`` and its payload fits the in-flight byte budget. Past
 either cap, ``submit_*`` raises :class:`Overloaded` — a typed rejection
-carrying a ``retry_after_s`` hint derived from the EWMA per-request
-service time, so a well-behaved client backs off for roughly one
-queue-drain instead of hammering.
+carrying a ``retry_after_s`` hint so a well-behaved client (and the
+front-door router, which records it as a per-replica backoff before
+re-routing to a sibling) backs off instead of hammering.
+
+``retry_after_s`` is a drain estimate of the load AHEAD of a retrying
+client, not a bare service time:
+
+  * **queue shed** — ``depth`` requests must drain at the EWMA
+    per-request rate before a resubmit both clears admission and gets
+    served;
+  * **bytes shed** — the queue can be shallow while the bytes are fat
+    (a few huge payloads), so the hint is instead how many releases at
+    the average in-flight payload size free the byte overshoot this
+    request needs;
+  * **stalled service** — the EWMA goes stale-optimistic while a
+    dispatch hangs (nothing releases to update it), so the hint is
+    floored at the time since the last release: a service that hasn't
+    released anything for 2 s will not drain its queue in 50 ms.
 
 One deliberate asymmetry: a request larger than the whole byte budget
 is still admitted when the service is otherwise EMPTY — rejecting it
 unconditionally would make it unservable forever, and an empty service
 has the entire budget to give.
+
+``resize()`` lets the front door's SLO evaluator drive the effective
+queue cap (multiplicative shrink on a breach, additive recovery)
+instead of relying on the static configured ceiling alone.
 """
 
 from __future__ import annotations
 
+import math
 import threading
+import time
 
 from eth_consensus_specs_tpu import obs
 
 
 class Overloaded(RuntimeError):
     """Load-shed rejection. ``retry_after_s`` is the backoff hint;
-    ``reason`` is ``"queue"`` or ``"bytes"``."""
+    ``reason`` is ``"queue"``, ``"bytes"`` or (front door, every replica
+    shedding) ``"replicas"``."""
 
     def __init__(self, reason: str, retry_after_s: float, depth: int, in_flight_bytes: int):
         super().__init__(
@@ -48,6 +70,7 @@ class AdmissionController:
         # seeded pessimistically high so the first rejections under a
         # cold cache suggest a real backoff, then tracks measurements
         self._ewma_service_s = 0.01
+        self._last_release_t = time.monotonic()
 
     def depth(self) -> int:
         with self._lock:
@@ -57,10 +80,46 @@ class AdmissionController:
         with self._lock:
             return self._bytes
 
-    def retry_after_s(self) -> float:
-        """Roughly one queue-drain at the recent per-request rate."""
+    def ewma_service_s(self) -> float:
         with self._lock:
-            return max(self._depth * self._ewma_service_s, 0.001)
+            return self._ewma_service_s
+
+    def resize(self, max_queue: int) -> None:
+        """Adjust the effective queue cap (SLO-driven shedding); already
+        admitted requests are never evicted — the cap only gates new
+        admissions."""
+        with self._lock:
+            self.max_queue = max(int(max_queue), 1)
+
+    def _retry_hint_locked(self, cost_bytes: int, reason: str) -> float:
+        """Drain estimate for the load ahead of a retrying client.
+        Caller holds the lock."""
+        ahead = self._depth
+        if reason == "bytes" and self._depth > 0:
+            # releases needed to free the byte overshoot, at the average
+            # in-flight payload size — the queue length is the wrong
+            # yardstick when a few fat payloads hold the budget
+            avg = self._bytes / self._depth
+            overshoot = self._bytes + cost_bytes - self.max_bytes
+            ahead = max(min(math.ceil(overshoot / max(avg, 1.0)), self._depth), 1)
+        hint = max(ahead * self._ewma_service_s, 0.001)
+        if self._depth > 0:
+            # stalled-service floor: no release for longer than the
+            # estimate means the estimate is stale-optimistic
+            stalled_for = time.monotonic() - self._last_release_t
+            hint = max(hint, min(stalled_for, 30.0))
+        return hint
+
+    def retry_after_s(self, cost_bytes: int = 0) -> float:
+        """The backoff hint a shed WOULD carry right now (router probes
+        use this without paying a rejection)."""
+        with self._lock:
+            reason = (
+                "bytes"
+                if self._depth > 0 and self._bytes + cost_bytes > self.max_bytes
+                else "queue"
+            )
+            return self._retry_hint_locked(cost_bytes, reason)
 
     def admit(self, cost_bytes: int) -> None:
         """Reserve a slot or raise Overloaded. The slot is held until
@@ -72,12 +131,17 @@ class AdmissionController:
             elif self._depth > 0 and self._bytes + cost_bytes > self.max_bytes:
                 reason = "bytes"
             if reason is None:
+                if self._depth == 0:
+                    # depth leaving zero (re)starts the stall clock: an
+                    # idle gap is not a stall, the service just had
+                    # nothing to release
+                    self._last_release_t = time.monotonic()
                 self._depth += 1
                 self._bytes += cost_bytes
                 depth, in_bytes = self._depth, self._bytes
             else:
                 depth, in_bytes = self._depth, self._bytes
-                retry = max(depth * self._ewma_service_s, 0.001)
+                retry = self._retry_hint_locked(cost_bytes, reason)
         if reason is not None:
             obs.count("serve.rejected", 1)
             obs.count(f"serve.rejected.{reason}", 1)
@@ -96,6 +160,7 @@ class AdmissionController:
         with self._lock:
             self._depth = max(self._depth - 1, 0)
             self._bytes = max(self._bytes - cost_bytes, 0)
+            self._last_release_t = time.monotonic()
             if service_s is not None and service_s >= 0:
                 self._ewma_service_s = 0.8 * self._ewma_service_s + 0.2 * service_s
             depth = self._depth

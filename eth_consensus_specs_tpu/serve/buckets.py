@@ -166,6 +166,20 @@ def _warmup_append(key: tuple) -> None:
         pass  # warmup persistence is best-effort; serving never blocks on it
 
 
+def write_warmup(path: str, keys: list[tuple] | None = None) -> int:
+    """Write the warmup artifact in one shot (atomic replace): every
+    shape key this process has compiled, or an explicit list. This is
+    the shippable form — ``serve_bench.py --warmup-out`` emits it, CI
+    uploads it, replica boots replay it via ``precompile(path=...)``."""
+    keys = seen_shapes() if keys is None else [tuple(k) for k in keys]
+    tmp = f"{path}.tmp{os.getpid()}"
+    with open(tmp, "w") as fh:
+        for key in keys:
+            fh.write(json.dumps(list(key)) + "\n")
+    os.replace(tmp, path)
+    return len(keys)
+
+
 def load_warmup(path: str | None = None) -> list[tuple]:
     """Shape keys recorded by previous runs (JSONL, one ``[op, *dims]``
     per line; torn/alien lines are skipped, not trusted)."""
@@ -187,15 +201,18 @@ def load_warmup(path: str | None = None) -> list[tuple]:
     return out
 
 
-def precompile(keys: list[tuple] | None = None) -> int:
+def precompile(keys: list[tuple] | None = None, path: str | None = None) -> int:
     """Compile every known bucket shape ahead of traffic. With no
-    explicit `keys`, replays the persistent warmup list. Returns the
-    number of shapes warmed. Unknown ops are skipped (a warmup file
-    written by a newer version must not crash an older server)."""
+    explicit `keys`, replays the persistent warmup list — from ``path``
+    when given (the SHIPPABLE warmup artifact: one replica or a CI run
+    writes it, every later boot consumes it), else from
+    ``ETH_SPECS_SERVE_WARMUP``. Returns the number of shapes warmed.
+    Unknown ops are skipped (a warmup file written by a newer version
+    must not crash an older server)."""
     import numpy as np
 
     warmed = 0
-    for key in keys if keys is not None else load_warmup():
+    for key in keys if keys is not None else load_warmup(path):
         op, dims = key[0], key[1:]
         try:
             if op == "merkle_many" and len(dims) == 2:
@@ -207,6 +224,21 @@ def precompile(keys: list[tuple] | None = None) -> int:
                 # their wall time lands in serve.compile_ms too
                 with first_dispatch("merkle_many", batch, depth):
                     merkleize_many_device([zero], depth, pad_batch=batch)
+            elif op == "bls_msm" and len(dims) == 1:
+                from eth_consensus_specs_tpu.ops.bls_batch import _use_device, verify_many
+
+                if not _use_device():
+                    continue  # host backend: there is no MSM kernel to warm
+                n = int(dims[0])
+                from eth_consensus_specs_tpu.utils import bls as _bls
+
+                # a throwaway aggregate of n copies of one pubkey: the
+                # verdict is discarded, only the pow2-committee-size MSM
+                # compile matters
+                pk, msg = _bls.SkToPk(1), b"\x00" * 32
+                sig = bytes(_bls.Sign(1, msg))
+                with first_dispatch("bls_msm", n):
+                    verify_many([([bytes(pk)] * n, msg, sig)])
             else:
                 continue
         except Exception:

@@ -25,6 +25,25 @@ a real accelerator):
     ETH_SPECS_SERVE_WARMUP=<path>     persistent JSONL of compiled
                                       shape keys (serve/buckets.py);
                                       precompile() replays it
+
+Replicated front door (serve/frontdoor.py):
+
+    ETH_SPECS_SERVE_REPLICAS=0        >0: run R supervised replica
+                                      processes behind the front door
+                                      (gen/gen_runner.py boots one for
+                                      the pool when ETH_SPECS_SERVE=1)
+    ETH_SPECS_SERVE_FRONTDOOR=<addrs> comma-separated host:port list of
+                                      existing replicas — client mode
+                                      (pool workers read this)
+    ETH_SPECS_SERVE_HEDGE_MS=250      re-dispatch an idempotent submit
+                                      to a sibling replica when the
+                                      routed one misses this deadline
+    ETH_SPECS_SERVE_RPC_TIMEOUT_S=60  hard per-RPC timeout (past it the
+                                      replica is failed over)
+    ETH_SPECS_SERVE_PROBE_MS=200      supervisor health-probe interval
+    ETH_SPECS_SERVE_FD_CONCURRENCY=16 front-door dispatcher threads
+    ETH_SPECS_SERVE_SLO_SHED=1        0: disable SLO-driven admission
+                                      resizing (static caps only)
 """
 
 from __future__ import annotations
@@ -102,7 +121,62 @@ class ServeConfig:
         return max(int(self.max_queue * self.pressure_fraction), 1)
 
 
+@dataclass(frozen=True)
+class FrontDoorConfig:
+    """Knobs of the replicated front door (serve/frontdoor.py): replica
+    count, failover timing, and the SLO-shedding switch."""
+
+    # 0 = no replicated fleet (matches the documented env default);
+    # FrontDoor(replicas=None) floors it at 1 for explicit construction
+    replicas: int = 0
+    hedge_ms: float = 250.0
+    rpc_timeout_s: float = 60.0
+    probe_interval_ms: float = 200.0
+    concurrency: int = 16
+    ready_timeout_s: float = 180.0
+    drain_timeout_s: float = 15.0
+    # a replica marked down is retried (half-open) after this cooldown,
+    # so clients without a supervisor self-heal once it respawns
+    down_cooldown_ms: float = 500.0
+    slo_shedding: bool = True
+    # SLO shedding never shrinks the effective admission cap below this
+    min_queue: int = 8
+
+    @classmethod
+    def from_env(cls, **overrides) -> "FrontDoorConfig":
+        cfg = cls(
+            replicas=_env_int("ETH_SPECS_SERVE_REPLICAS", cls.replicas),
+            hedge_ms=_env_float("ETH_SPECS_SERVE_HEDGE_MS", cls.hedge_ms),
+            rpc_timeout_s=_env_float("ETH_SPECS_SERVE_RPC_TIMEOUT_S", cls.rpc_timeout_s),
+            probe_interval_ms=_env_float("ETH_SPECS_SERVE_PROBE_MS", cls.probe_interval_ms),
+            concurrency=_env_int("ETH_SPECS_SERVE_FD_CONCURRENCY", cls.concurrency),
+            slo_shedding=os.environ.get("ETH_SPECS_SERVE_SLO_SHED", "1") != "0",
+        )
+        if overrides:
+            cfg = replace(cfg, **overrides)
+        return cfg
+
+    @property
+    def hedge_s(self) -> float:
+        return self.hedge_ms / 1000.0
+
+    @property
+    def probe_interval_s(self) -> float:
+        return self.probe_interval_ms / 1000.0
+
+    @property
+    def down_cooldown_s(self) -> float:
+        return self.down_cooldown_ms / 1000.0
+
+
 def serve_enabled() -> bool:
     """The gen-pipeline opt-in: route pool workers' BLS verifies through
     a per-worker service instance."""
     return os.environ.get("ETH_SPECS_SERVE") == "1"
+
+
+def frontdoor_addrs() -> list[str]:
+    """Existing-replica addresses for client mode (set by a FrontDoor
+    owner for its worker processes)."""
+    raw = os.environ.get("ETH_SPECS_SERVE_FRONTDOOR", "")
+    return [a.strip() for a in raw.split(",") if a.strip()]

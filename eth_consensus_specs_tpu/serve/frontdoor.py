@@ -1,0 +1,822 @@
+"""Replicated serving front door: supervised replicas, failure-aware
+routing, hedged failover.
+
+One process is a single point of failure no matter how good its degrade
+chain is. The front door runs R replica processes (serve/replica.py,
+each hosting a full VerifyService behind the framed socket boundary of
+serve/wire.py) and gives callers the same futures API the in-process
+service has — with the failure handling BETWEEN processes:
+
+  * **supervision** — a monitor thread health-probes every replica;
+    a dead one (SIGKILL, OOM, crash) triggers a flight-recorder
+    postmortem bundle in the parent (built from the ring entries the
+    replica shipped with its health responses — the black box survives
+    the crash) and an automatic respawn through ``fault.retrying``,
+    reclaiming the old port so supervisor-less clients reconnect.
+  * **failure-aware routing** (serve/router.py) — requests hash to the
+    replica whose compile cache is warm for their shape; a typed shed's
+    ``retry_after_s`` is honored as a per-replica backoff before
+    re-routing to a sibling; connection failures fail over immediately.
+  * **hedging** — when the routed replica misses the hedge deadline on
+    an idempotent submit (bls / htr are pure functions), the SAME
+    request is re-dispatched to a sibling; whichever result arrives
+    first wins, the duplicate is suppressed, and the admission slot is
+    released exactly once.
+  * **degrade ladder** — routed replica → sibling replicas → (every
+    replica shedding: typed ``Overloaded`` with the smallest
+    retry-after) → the bit-exact host oracle in THIS process, the same
+    last rung the in-process service has. A request admitted by the
+    front door always resolves.
+  * **draining** — ``restart_replica()`` is a zero-shed planned
+    rollover: the router stops routing there first, the replica drains
+    its in-flight work, shuts down cleanly, and the replacement warms
+    from the shippable artifact before taking traffic.
+  * **SLO-driven shedding** — the monitor evaluates wait-p99 and
+    degraded-rate objectives (obs/slo.py) over each probe window of the
+    MERGED cross-process telemetry; a breach halves the effective
+    admission cap (typed sheds with honest retry-after), recovery grows
+    it back additively. The static cap is the ceiling, not the policy.
+
+W3C trace contexts ride in every submit frame, so a request's spans
+stitch across the process boundary in the shared JSONL stream.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import multiprocessing
+import threading
+import time
+from collections import deque
+from concurrent.futures import Future, ThreadPoolExecutor
+
+import numpy as np
+
+from eth_consensus_specs_tpu import fault, obs
+from eth_consensus_specs_tpu.obs import flight, slo, trace
+from eth_consensus_specs_tpu.obs.delta import DeltaShipper, merge_delta
+
+from . import buckets, wire
+from .admission import AdmissionController, Overloaded
+from .config import FrontDoorConfig, ServeConfig
+from .replica import replica_main
+from .router import Router
+
+
+class _FDRequest:
+    __slots__ = (
+        "kind", "payload", "shape_key", "cost_bytes", "future",
+        "trace", "t_submit", "released", "hedged",
+    )
+
+    def __init__(self, kind, payload, shape_key, cost_bytes):
+        self.kind = kind
+        self.payload = payload
+        self.shape_key = shape_key
+        self.cost_bytes = cost_bytes
+        self.future = Future()
+        self.trace = trace.child()
+        self.t_submit = time.monotonic()
+        self.released = False  # admission slot handed back (exactly once)
+        self.hedged = False  # at most one hedge per request
+
+
+def _host_execute(kind: str, payload):
+    """The front door's own last rung: bit-identical to what a replica
+    (device path or ITS degraded host path) would have returned."""
+    if kind == "bls":
+        from eth_consensus_specs_tpu.crypto.signature import fast_aggregate_verify
+
+        return bool(fast_aggregate_verify(*payload))
+    chunks, depth = payload
+    from eth_consensus_specs_tpu.obs.watchdog import host_tree_root_words
+    from eth_consensus_specs_tpu.ops.merkle import _chunks_to_words
+
+    return host_tree_root_words(_chunks_to_words(chunks, 1 << depth))
+
+
+class FrontDoorClient:
+    """Router + dispatcher against an EXISTING replica fleet (gen pool
+    workers use this, connecting to addresses their parent exported via
+    ``ETH_SPECS_SERVE_FRONTDOOR``). :class:`FrontDoor` subclasses it
+    with process ownership and supervision."""
+
+    def __init__(
+        self,
+        addrs: list[str],
+        config: ServeConfig | None = None,
+        fd_config: FrontDoorConfig | None = None,
+        name: str = "frontdoor",
+    ):
+        self.config = config or ServeConfig.from_env()
+        self.fdcfg = fd_config or FrontDoorConfig.from_env()
+        self.name = name
+        self._addr_lock = threading.Lock()
+        self._addrs = [wire.parse_addr(a) for a in addrs]
+        self._gens = [0] * len(self._addrs)
+        self.router = Router(
+            len(self._addrs), down_cooldown_s=self.fdcfg.down_cooldown_s
+        )
+        self.admission = AdmissionController(
+            self.config.max_queue, self.config.max_bytes
+        )
+        self._resolve_lock = threading.Lock()
+        self._tls = threading.local()
+        self._closed = False
+        self._pool = ThreadPoolExecutor(
+            max_workers=max(self.fdcfg.concurrency, 2),
+            thread_name_prefix=f"{name}-rpc",
+        )
+
+    # ------------------------------------------------------------- submit --
+
+    def _submit(self, kind, payload, shape_key, cost_bytes) -> Future:
+        if self._closed:
+            raise RuntimeError(f"front door {self.name} is shut down")
+        self.admission.admit(cost_bytes)
+        req = _FDRequest(kind, payload, shape_key, cost_bytes)
+        try:
+            self._pool.submit(self._dispatch, req)
+        except RuntimeError:
+            # close() raced the admit: nothing will ever dispatch this
+            # request, so its admission slot must be handed back here
+            req.released = True
+            self.admission.release(cost_bytes)
+            raise RuntimeError(f"front door {self.name} is shut down") from None
+        obs.count("frontdoor.requests", 1)
+        obs.count(f"frontdoor.requests.{kind}", 1)
+        return req.future
+
+    def submit_bls_aggregate(self, pubkeys: list, message: bytes, signature: bytes) -> Future:
+        pks = [bytes(p) for p in pubkeys]
+        payload = (pks, bytes(message), bytes(signature))
+        cost = 48 * len(pks) + len(payload[1]) + len(payload[2])
+        # affinity by the MSM compile shape: the pow2 committee bucket
+        return self._submit("bls", payload, ("bls_msm", buckets.pow2_bucket(max(len(pks), 1))), cost)
+
+    def submit_hash_tree_root(self, chunks: np.ndarray) -> Future:
+        chunks = np.ascontiguousarray(chunks)
+        if chunks.ndim != 2 or chunks.shape[1] != 32 or chunks.dtype != np.uint8:
+            raise ValueError("chunks must be uint8[N, 32]")
+        depth = buckets.subtree_depth(chunks.shape[0])
+        # affinity by tree depth: depth is the intrinsic compile axis
+        return self._submit("htr", (chunks, depth), ("merkle_many", depth), int(chunks.nbytes))
+
+    # ------------------------------------------------------------ dispatch --
+
+    def _dispatch(self, req: _FDRequest, exclude: frozenset = frozenset(),
+                  hedge_allowed: bool = True, is_hedge: bool = False) -> None:
+        try:
+            self._dispatch_inner(req, frozenset(exclude), hedge_allowed, is_hedge)
+        except BaseException as exc:  # noqa: BLE001 — the future carries it
+            # a hedge leg never resolves a request with a FAILURE: the
+            # primary leg still owns it and will finish its own ladder
+            if not is_hedge:
+                self._resolve(req, exc=exc)
+            else:
+                obs.count("frontdoor.hedge_abandoned", 1)
+
+    def _dispatch_inner(
+        self, req, base_exclude: frozenset, hedge_allowed: bool, is_hedge: bool
+    ) -> None:
+        hedge_allowed = (
+            hedge_allowed and self.fdcfg.hedge_ms > 0 and len(self.router) > 1
+        )
+        tried = set(base_exclude)
+        sheds: dict[int, float] = {}
+        error_replies: list[str] = []
+        hard_failures = 0
+        backoff_waits = 0
+        for _ in range(2 * len(self.router) + 4):
+            if req.released:
+                return  # the other leg already won
+            idx = self.router.pick(req.shape_key, exclude=tried)
+            if idx is None:
+                # every candidate is down, draining, tried, or backing
+                # off — honor the soonest backoff once before giving up
+                wait = self.router.backoff_remaining_s()
+                if wait > 0 and backoff_waits < 2:
+                    backoff_waits += 1
+                    # a backed-off replica may free up; the hedge leg's
+                    # hard exclude (the stalled primary) stays excluded
+                    tried = set(base_exclude)
+                    time.sleep(min(wait + 0.002, 1.0))
+                    continue
+                break
+            try:
+                resp = self._rpc_submit(idx, req, hedge_allowed)
+            except (ConnectionError, OSError, EOFError, wire.CorruptFrame) as exc:
+                # timeouts arrive as OSError subclasses (socket.timeout)
+                self.router.note_failure(idx)
+                obs.count("frontdoor.failovers", 1)
+                obs.event(
+                    "frontdoor.failover",
+                    replica=idx, req_kind=req.kind, error=type(exc).__name__,
+                )
+                tried.add(idx)
+                hard_failures += 1
+                continue
+            if resp.get("ok"):
+                self._resolve(req, value=resp["result"], is_hedge=is_hedge)
+                return
+            err = resp.get("err")
+            if err == "overloaded":
+                # honor the replica's drain estimate, try a sibling now
+                retry_after = float(resp.get("retry_after_s", 0.05))
+                self.router.note_shed(idx, retry_after)
+                sheds[idx] = retry_after
+                tried.add(idx)
+                continue
+            if err == "draining":
+                # observed, not owner-asserted: expires on its own so a
+                # supervisor-less client can't blackhole the replica
+                # past the rollover
+                self.router.note_draining(idx, ttl_s=5.0)
+                tried.add(idx)
+                continue
+            # a typed application-error reply PROVES the replica is
+            # alive — marking it down would let one poison payload
+            # blackhole every healthy replica. One sibling retry covers
+            # replica-local trouble; a second identical verdict means
+            # the REQUEST is bad, and the error belongs to its caller
+            obs.count("frontdoor.request_errors", 1)
+            error_replies.append(str(resp.get("detail", "replica error")))
+            tried.add(idx)
+            if len(error_replies) >= 2:
+                if is_hedge:
+                    obs.count("frontdoor.hedge_abandoned", 1)
+                    return
+                self._resolve(
+                    req, exc=RuntimeError(f"replicas rejected the request: "
+                                          f"{error_replies[-1]}")
+                )
+                return
+        if is_hedge:
+            # the hedge is best-effort: it only ever resolves with a
+            # RESULT that beat the primary. Reaching the shed/host-oracle
+            # endgame here means the siblings couldn't help — the
+            # still-running primary leg owns the request and will resolve
+            # it (its own result, its own ladder, or its hard timeout).
+            # A hedge resolving Overloaded would preempt a correct
+            # primary result that is milliseconds away.
+            obs.count("frontdoor.hedge_abandoned", 1)
+            return
+        if sheds and hard_failures == 0:
+            # flow control, not failure: shedding to the caller with the
+            # smallest honest hint preserves backpressure end to end —
+            # absorbing it on the host oracle would defeat admission
+            self._resolve(
+                req,
+                exc=Overloaded(
+                    "replicas", min(sheds.values()),
+                    self.admission.depth(), self.admission.in_flight_bytes(),
+                ),
+            )
+            return
+        # the last rung of the ladder: no replica can serve this, so the
+        # front door computes it host-side, bit-identically
+        obs.count("frontdoor.degraded_to_host", 1)
+        obs.count("serve.degraded_items", 1)
+        obs.event("frontdoor.degraded_to_host", req_kind=req.kind)
+        self._resolve(req, value=_host_execute(req.kind, req.payload))
+
+    def _rpc_submit(self, idx: int, req: _FDRequest, hedge_allowed: bool) -> dict:
+        msg = {
+            "op": "submit",
+            "kind": req.kind,
+            "payload": req.payload,
+            "trace": trace.to_wire(req.trace),
+        }
+        deadline = self.fdcfg.hedge_s if hedge_allowed and not req.hedged else None
+        on_deadline = (lambda: self._start_hedge(req, idx)) if deadline else None
+        for _ in range(3):
+            sock = self._conn(idx)
+            try:
+                wire.send_frame(sock, msg)
+                t0 = time.perf_counter()
+                resp = wire.recv_frame(
+                    sock,
+                    deadline_s=deadline,
+                    on_deadline=on_deadline,
+                    timeout_s=self.fdcfg.rpc_timeout_s,
+                )
+            except wire.CorruptFrame:
+                # response frame corrupt; stream still in sync — resend
+                obs.count("frontdoor.corrupt_retries", 1)
+                continue
+            except BaseException:
+                self._drop_conn(idx)
+                raise
+            if isinstance(resp, dict) and resp.get("err") == "corrupt_frame":
+                # the REQUEST frame arrived corrupt; detected, resend
+                obs.count("frontdoor.corrupt_retries", 1)
+                continue
+            self.router.note_ok(idx, time.perf_counter() - t0)
+            return resp
+        self._drop_conn(idx)
+        raise wire.CorruptFrame("frame still corrupt after 3 sends")
+
+    # ------------------------------------------------------------- hedging --
+
+    def _start_hedge(self, req: _FDRequest, primary_idx: int) -> None:
+        if req.hedged or req.released or len(self.router) < 2:
+            return
+        req.hedged = True
+        obs.count("frontdoor.hedges", 1)
+        obs.event("frontdoor.hedge", req_kind=req.kind, primary=primary_idx)
+
+        def _hedge_leg():
+            try:
+                self._dispatch(
+                    req,
+                    exclude=frozenset({primary_idx}),
+                    hedge_allowed=False,
+                    is_hedge=True,
+                )
+            finally:
+                # this thread dies with the leg: its thread-local
+                # connection cache must not wait for GC to free the fds
+                self._close_tls_conns()
+
+        # a dedicated thread, NOT the dispatcher pool: under a stall
+        # storm every pool worker is parked in recv, and a hedge queued
+        # behind them would fire after the hard timeout it exists to beat
+        threading.Thread(
+            target=_hedge_leg, daemon=True, name=f"{self.name}-hedge"
+        ).start()
+
+    def _resolve(self, req: _FDRequest, value=None, exc=None, is_hedge=False) -> bool:
+        """Exactly-once resolution across racing legs (primary, hedge):
+        the first caller releases the admission slot and sets the
+        future; every later caller is a suppressed duplicate."""
+        with self._resolve_lock:
+            if req.released:
+                first = False
+            else:
+                req.released = True
+                first = True
+        if not first:
+            obs.count("frontdoor.duplicates_suppressed", 1)
+            return False
+        e2e_s = time.monotonic() - req.t_submit
+        self.admission.release(req.cost_bytes, service_s=e2e_s)
+        obs.observe("frontdoor.e2e_ms", e2e_s * 1e3)
+        if is_hedge:
+            obs.count("frontdoor.hedge_wins", 1)
+        try:
+            if exc is not None:
+                req.future.set_exception(exc)
+            else:
+                req.future.set_result(value)
+        except Exception:
+            obs.count("frontdoor.cancelled", 1)
+        return True
+
+    # --------------------------------------------------------- connections --
+
+    def _endpoint(self, idx: int) -> tuple[int, tuple[str, int]]:
+        with self._addr_lock:
+            return self._gens[idx], self._addrs[idx]
+
+    def _set_endpoint(self, idx: int, port: int) -> None:
+        with self._addr_lock:
+            self._addrs[idx] = (self._addrs[idx][0], port)
+            self._gens[idx] += 1  # invalidates every cached connection
+
+    def _conn(self, idx: int):
+        conns = getattr(self._tls, "conns", None)
+        if conns is None:
+            conns = self._tls.conns = {}
+        gen, addr = self._endpoint(idx)
+        cached = conns.get(idx)
+        if cached is not None and cached[0] == gen:
+            return cached[1]
+        if cached is not None:
+            try:
+                cached[1].close()
+            except OSError:
+                pass
+        sock = wire.connect(addr, timeout_s=2.0)
+        conns[idx] = (gen, sock)
+        return sock
+
+    def _drop_conn(self, idx: int) -> None:
+        conns = getattr(self._tls, "conns", None)
+        if conns is None:
+            return
+        cached = conns.pop(idx, None)
+        if cached is not None:
+            try:
+                cached[1].close()
+            except OSError:
+                pass
+
+    def _close_tls_conns(self) -> None:
+        """Close every connection cached by the CURRENT thread (short-
+        lived hedge threads call this on exit so their sockets don't
+        linger until GC)."""
+        conns = getattr(self._tls, "conns", None)
+        if not conns:
+            return
+        for _gen, sock in conns.values():
+            try:
+                sock.close()
+            except OSError:
+                pass
+        conns.clear()
+
+    # --------------------------------------------------------------- admin --
+
+    def addresses(self) -> list[str]:
+        with self._addr_lock:
+            return [f"{h}:{p}" for h, p in self._addrs]
+
+    def stats(self) -> dict:
+        counters = obs.snapshot()["counters"]
+        return {
+            "queue_depth": self.admission.depth(),
+            "effective_max_queue": self.admission.max_queue,
+            "requests": counters.get("frontdoor.requests", 0),
+            "hedges": counters.get("frontdoor.hedges", 0),
+            "hedge_wins": counters.get("frontdoor.hedge_wins", 0),
+            "failovers": counters.get("frontdoor.failovers", 0),
+            "degraded_to_host": counters.get("frontdoor.degraded_to_host", 0),
+            "corrupt_frames": counters.get("frontdoor.corrupt_frames", 0),
+            "replicas": self.router.snapshot(),
+        }
+
+    def close(self, timeout: float = 30.0) -> None:
+        self._closed = True
+        self._pool.shutdown(wait=True)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+
+class FrontDoor(FrontDoorClient):
+    """Owns the replica fleet: spawn, warm, supervise, respawn, drain."""
+
+    def __init__(
+        self,
+        replicas: int | None = None,
+        config: ServeConfig | None = None,
+        fd_config: FrontDoorConfig | None = None,
+        warmup_path: str | None = None,
+        warm_keys: list | None = None,
+        replica_fault_spec: str | None = None,
+        name: str = "frontdoor",
+    ):
+        config = config or ServeConfig.from_env()
+        fd_config = fd_config or FrontDoorConfig.from_env()
+        n = max(replicas if replicas is not None else fd_config.replicas, 1)
+        # spawn, NOT fork: a forked child inherits the parent's live XLA
+        # runtime state and deadlocks on its first jitted dispatch
+        # whenever the parent has already executed device code (pytest,
+        # serve_bench after its baseline, the gen parent). A spawned
+        # replica pays a fresh-interpreter import (~seconds, overlapped
+        # across replicas) and owns a clean runtime — which also makes
+        # the zero-cold-compiles gate honest: nothing is pre-warmed by
+        # inheritance, only by the shippable warmup artifact.
+        self._ctx = multiprocessing.get_context("spawn")
+        self._warmup_path = warmup_path
+        self._warm_keys = warm_keys
+        self._fault_spec = replica_fault_spec
+        self._cfg_overrides = dataclasses.asdict(config)
+        self._fd_name = name
+        self._ready_timeout_s = fd_config.ready_timeout_s
+        self._procs: list = [None] * n
+        self._rings = [deque(maxlen=max(flight.capacity(), 1)) for _ in range(n)]
+        self._health: list = [None] * n
+        self._restarting = [False] * n
+        self._respawn_failures = [0] * n
+        self._respawn_not_before = [0.0] * n
+        ports = [0] * n
+        # replica 0 boots alone first: it writes the shippable warmup
+        # artifact (explicit warm keys + its own first dispatches); the
+        # rest boot concurrently and REPLAY it — that is what makes
+        # "zero cold compiles on replicas 2..R" hold
+        self._procs[0], ports[0] = self._spawn_replica(0)
+        rest = [
+            threading.Thread(target=self._boot_into, args=(i, ports), daemon=True)
+            for i in range(1, n)
+        ]
+        for t in rest:
+            t.start()
+        for t in rest:
+            t.join(timeout=fd_config.ready_timeout_s + 30)
+        if any(p is None for p in self._procs):
+            dead = [i for i, p in enumerate(self._procs) if p is None]
+            for p in self._procs:
+                if p is not None:
+                    p.kill()
+            raise RuntimeError(f"replicas {dead} never became ready")
+        super().__init__(
+            [f"127.0.0.1:{p}" for p in ports],
+            config=config,
+            fd_config=fd_config,
+            name=name,
+        )
+        self._stop = threading.Event()
+        self._base_max_queue = self.admission.max_queue
+        self._slo_shipper = DeltaShipper()
+        self._slo_breached_once = False
+        self._supervisor = threading.Thread(
+            target=self._supervise, daemon=True, name=f"{name}-supervisor"
+        )
+        self._supervisor.start()
+
+    def _boot_into(self, i: int, ports: list) -> None:
+        try:
+            self._procs[i], ports[i] = self._spawn_replica(i)
+        except Exception:
+            self._procs[i] = None
+
+    def _spawn_replica(self, i: int, port_hint: int = 0):
+        parent_conn, child_conn = self._ctx.Pipe()
+        proc = self._ctx.Process(
+            target=replica_main,
+            args=(
+                child_conn,
+                self._cfg_overrides,
+                f"{self._fd_name}-r{i}",
+                self._warmup_path,
+                i == 0 and self._warmup_path is not None,
+                self._warm_keys if i == 0 else None,
+                self._fault_spec,
+                port_hint,
+            ),
+            daemon=True,
+        )
+        fault.retrying(proc.start, name="frontdoor.replica_spawn", attempts=3)
+        child_conn.close()
+        try:
+            if not parent_conn.poll(self._ready_timeout_s):
+                proc.kill()
+                raise RuntimeError(f"replica {i} not ready in {self._ready_timeout_s}s")
+            msg = parent_conn.recv()
+        finally:
+            parent_conn.close()
+        _, pid, port, warmed = msg
+        obs.event(
+            "frontdoor.replica_spawned", replica=i, pid=pid, port=port, warmed=warmed
+        )
+        return proc, port
+
+    # --------------------------------------------------------- supervision --
+
+    def _supervise(self) -> None:
+        while not self._stop.wait(self.fdcfg.probe_interval_s):
+            for i in range(len(self._procs)):
+                if self._stop.is_set():
+                    return
+                if self._restarting[i]:
+                    continue
+                proc = self._procs[i]
+                if proc is None or not proc.is_alive():
+                    self._handle_replica_death(i)
+                else:
+                    self._probe(i)
+            if self.fdcfg.slo_shedding:
+                self._slo_step()
+
+    def _probe(self, i: int) -> None:
+        t0 = time.perf_counter()
+        try:
+            sock = self._conn(i)
+            # admin frames carry their own fault site: a chaos rule
+            # aimed at the request path (frontdoor.rpc) must not corrupt
+            # the supervisor's probes out from under it
+            wire.send_frame(sock, {"op": "health"}, site="frontdoor.rpc.admin")
+            resp = wire.recv_frame(sock, timeout_s=5.0)
+        except BaseException:  # noqa: BLE001 — any probe failure marks it
+            self._drop_conn(i)
+            self.router.note_failure(i)
+            obs.count("frontdoor.probe_failures", 1)
+            return
+        if not resp.get("ok"):
+            return
+        self.router.note_ok(i, time.perf_counter() - t0)
+        # the merged cross-process view: replica counters, gauges, wait
+        # histograms fold into THIS registry; the ring copy is the black
+        # box we dump if the replica dies before its next probe
+        merge_delta(resp.get("obs_delta") or {}, self._rings[i])
+        self._health[i] = {
+            k: resp.get(k)
+            for k in ("pid", "draining", "queue_depth", "compiles", "compiles_after_ready")
+        }
+
+    def _handle_replica_death(self, i: int) -> None:
+        proc = self._procs[i]
+        if proc is not None:
+            # the alive→dead TRANSITION: postmortem + replacement
+            # accounting happen exactly once per actual death, not once
+            # per supervision tick while a respawn keeps failing
+            exitcode = proc.exitcode
+            self._procs[i] = None
+            self.router.mark_down(i)
+            obs.count("frontdoor.replicas_replaced", 1)
+            obs.event("frontdoor.replica_lost", replica=i, exitcode=exitcode)
+            # the dead replica can't write its own postmortem any more:
+            # the parent dumps the ring it shipped with health responses
+            flight.trigger_dump(
+                "frontdoor.replica_lost",
+                detail=f"{self._fd_name}-r{i} exitcode={exitcode}",
+                extra={
+                    "replica": i,
+                    "exitcode": exitcode,
+                    "last_health": self._health[i],
+                    "replica_ring": list(self._rings[i]),
+                },
+            )
+            self._rings[i].clear()
+            self._respawn_failures[i] = 0
+        elif time.monotonic() < self._respawn_not_before[i]:
+            return  # a failed respawn backs off instead of re-blocking
+        # the respawn's ready-wait can take seconds (artifact replay) to
+        # ready_timeout_s (a broken boot): it runs OFF the supervisor
+        # thread so probes, SLO steps, and death detection of the OTHER
+        # replicas never freeze behind it. _restarting[i] keeps the
+        # supervisor from double-spawning while the boot is in flight.
+        self._restarting[i] = True
+        threading.Thread(
+            target=self._respawn_async, args=(i,), daemon=True,
+            name=f"{self._fd_name}-respawn-r{i}",
+        ).start()
+
+    def _respawn_async(self, i: int) -> None:
+        try:
+            if self._stop.is_set():
+                return
+            with self._addr_lock:
+                old_port = self._addrs[i][1]
+            try:
+                # ONE attempt per wakeup; failures back off
+                # exponentially across supervision ticks instead of
+                # retrying in a tight loop
+                proc, port = self._spawn_replica(i, port_hint=old_port)
+            except Exception:  # noqa: BLE001 — keep serving on the survivors
+                self._respawn_failures[i] += 1
+                self._respawn_not_before[i] = time.monotonic() + min(
+                    1.0 * (2 ** (self._respawn_failures[i] - 1)), 30.0
+                )
+                obs.count("frontdoor.respawn_failures", 1)
+                obs.event(
+                    "frontdoor.respawn_failed",
+                    replica=i,
+                    failures=self._respawn_failures[i],
+                )
+                return
+            if self._stop.is_set():
+                # the front door closed while this replica was booting:
+                # don't leak a process nobody will ever supervise
+                proc.kill()
+                proc.join(timeout=5)
+                return
+            self._respawn_failures[i] = 0
+            self._procs[i] = proc
+            self._set_endpoint(i, port)
+            self.router.mark_up(i)
+        finally:
+            self._restarting[i] = False
+
+    def _slo_step(self) -> None:
+        # objectives evaluated over THIS probe window only (the delta),
+        # so one bad minute sheds now instead of being averaged away by
+        # a long healthy history — and recovery is observable quickly
+        d = self._slo_shipper.delta()
+        window = {"counters": d["counters"], "histograms": d["histograms"]}
+        results = slo.evaluate(
+            window,
+            [s for s in slo.default_slos() if s.name in ("serve_wait_p99", "degraded_rate")],
+        )
+        cur = self.admission.max_queue
+        if not slo.passed(results):
+            new_q = max(self.fdcfg.min_queue, cur // 2)
+            if new_q < cur:
+                self.admission.resize(new_q)
+                obs.count("frontdoor.slo_sheds", 1)
+                obs.event(
+                    "frontdoor.slo_shed",
+                    violations=",".join(r.name for r in results if not r.ok),
+                    max_queue=new_q,
+                )
+            if not self._slo_breached_once:
+                self._slo_breached_once = True
+                flight.trigger_dump(
+                    "frontdoor.slo_breach",
+                    detail=",".join(r.name for r in results if not r.ok),
+                    extra={"slo": slo.report(results)},
+                )
+        elif cur < self._base_max_queue:
+            self.admission.resize(
+                min(cur + max(self._base_max_queue // 10, 1), self._base_max_queue)
+            )
+        obs.gauge("frontdoor.effective_max_queue", self.admission.max_queue)
+
+    # --------------------------------------------------------------- admin --
+
+    def _rpc_admin(self, i: int, msg: dict, timeout_s: float) -> dict:
+        with self._addr_lock:
+            addr = self._addrs[i]
+        sock = wire.connect(addr, timeout_s=2.0)
+        try:
+            wire.send_frame(sock, msg, site="frontdoor.rpc.admin")
+            return wire.recv_frame(sock, timeout_s=timeout_s)
+        finally:
+            sock.close()
+
+    def restart_replica(self, i: int, timeout_s: float | None = None) -> None:
+        """Planned zero-shed rollover: drain → shutdown → respawn (warm
+        from the artifact) → rewire. Traffic routes to siblings for the
+        duration; nothing is rejected."""
+        timeout_s = timeout_s if timeout_s is not None else self.fdcfg.drain_timeout_s
+        self._restarting[i] = True
+        obs.count("frontdoor.planned_restarts", 1)
+        obs.event("frontdoor.planned_restart", replica=i)
+        try:
+            self.router.set_draining(i, True)
+            try:
+                self._rpc_admin(i, {"op": "drain", "timeout_s": timeout_s}, timeout_s + 5.0)
+                self._rpc_admin(i, {"op": "shutdown"}, 5.0)
+            except BaseException:  # noqa: BLE001 — a dying replica restarts the hard way
+                pass
+            proc = self._procs[i]
+            if proc is not None:
+                proc.join(timeout=10)
+                if proc.is_alive():
+                    proc.kill()
+                    proc.join(timeout=5)
+            with self._addr_lock:
+                old_port = self._addrs[i][1]
+            proc, port = self._spawn_replica(i, port_hint=old_port)
+            self._procs[i] = proc
+            self._set_endpoint(i, port)
+        finally:
+            self.router.set_draining(i, False)
+            self._restarting[i] = False
+        self.router.mark_up(i)
+
+    def replica_stats(self) -> list[dict | None]:
+        """Last health-probe payload per replica (pid, queue depth,
+        compiles, compiles_after_ready)."""
+        return list(self._health)
+
+    def export_env(self) -> dict[str, str]:
+        """Env for worker processes that should route through this
+        fleet (gen pool workers read it at init)."""
+        return {"ETH_SPECS_SERVE_FRONTDOOR": ",".join(self.addresses())}
+
+    def close(self, timeout: float = 30.0) -> None:
+        if self._closed:
+            return
+        self._closed = True
+        self._stop.set()
+        self._supervisor.join(timeout=10)
+        # every already-admitted dispatch resolves before the fleet dies
+        self._pool.shutdown(wait=True)
+        for i, proc in enumerate(self._procs):
+            if proc is None or not proc.is_alive():
+                continue
+            try:
+                # final probe: fold the replica's last window into the
+                # merged cross-process telemetry before it exits
+                resp = self._rpc_admin(i, {"op": "health"}, 5.0)
+                if resp.get("ok"):
+                    merge_delta(resp.get("obs_delta") or {}, self._rings[i])
+                    self._health[i] = {
+                        k: resp.get(k)
+                        for k in (
+                            "pid", "draining", "queue_depth",
+                            "compiles", "compiles_after_ready",
+                        )
+                    }
+            except BaseException:  # noqa: BLE001
+                pass
+            try:
+                self._rpc_admin(i, {"op": "shutdown"}, 5.0)
+            except BaseException:  # noqa: BLE001
+                pass
+        for proc in self._procs:
+            if proc is None:
+                continue
+            proc.join(timeout=10)
+            if proc.is_alive():
+                proc.kill()
+                proc.join(timeout=5)
+        obs.event("frontdoor.closed", name=self._fd_name)
+
+
+def maybe_frontdoor_client(
+    config: ServeConfig | None = None, name: str = "frontdoor-client"
+) -> FrontDoorClient | None:
+    """A client for the fleet named by ``ETH_SPECS_SERVE_FRONTDOOR``,
+    or None when the env doesn't name one (gen workers call this)."""
+    from .config import frontdoor_addrs
+
+    addrs = frontdoor_addrs()
+    if not addrs:
+        return None
+    return FrontDoorClient(addrs, config=config, name=name)

@@ -1,0 +1,255 @@
+"""Replica process: a VerifyService behind the length+digest socket boundary.
+
+Each replica the front door supervises is a SPAWNED process (fresh
+interpreter — inherited live-XLA state would deadlock a forked child's
+first jitted dispatch) running :func:`replica_main`: it (re)installs
+its deterministic fault rules,
+builds a :class:`~.service.VerifyService`, warms the compile cache from
+the SHIPPABLE warmup artifact (replica 0 writes it — its
+``ETH_SPECS_SERVE_WARMUP`` env points at the artifact so every first
+dispatch appends; replicas 1..R-1 only read it at boot, which is what
+makes "zero cold compiles on replicas 2..R" a gateable property), then
+serves framed RPCs (serve/wire.py) on a loopback TCP socket:
+
+  * ``submit`` — ``fault.check("frontdoor.rpc")`` first (the injection
+    site for stall/kill/raise chaos), then the request runs under the
+    caller's W3C trace context restored ``from_wire`` — the
+    ``frontdoor.rpc`` span this handler opens carries the caller's
+    trace_id, so one request's spans stitch across the process
+    boundary in the shared JSONL stream. Sheds come back as typed
+    ``{"err": "overloaded", "retry_after_s": ...}`` payloads.
+  * ``health`` — liveness + stats + an obs **delta** (obs/delta.py):
+    counters/gauges/histogram-buckets/flight-ring since the previous
+    probe. The supervising parent folds these into its registry — the
+    cross-process merged wait histogram the SLO evaluator reads — and
+    keeps the ring copy as this replica's black box, so a SIGKILLed
+    replica still leaves a postmortem.
+  * ``drain`` — stop admitting, wait for in-flight to finish (planned
+    rollover; the router stopped sending traffic before this arrives).
+  * ``precompile`` / ``shutdown`` — warmup replay and clean exit.
+
+A corrupt request frame (digest mismatch — injected via
+``frontdoor.rpc:corrupt`` or real wire damage) is answered with
+``{"err": "corrupt_frame"}`` and the connection continues: the framing
+keeps the stream in sync, the client resends. Never silently accepted.
+"""
+
+from __future__ import annotations
+
+import os
+import socket
+import threading
+import time
+
+from eth_consensus_specs_tpu import fault, obs
+from eth_consensus_specs_tpu.obs import trace
+from eth_consensus_specs_tpu.obs.delta import DeltaShipper
+
+from . import wire
+from .admission import Overloaded
+from .config import ServeConfig
+
+
+def _compiles() -> int:
+    return obs.snapshot()["counters"].get("serve.compiles", 0)
+
+
+class ReplicaServer:
+    """The in-replica RPC server around one VerifyService."""
+
+    def __init__(self, service, name: str = "replica"):
+        self.service = service
+        self.name = name
+        self._listener = socket.create_server(("127.0.0.1", 0))
+        self.port = self._listener.getsockname()[1]
+        self._stop = threading.Event()
+        self._draining = False
+        self._compiles_ready = 0
+        # per-replica shipping baseline: swallow everything inherited
+        # across the fork (and the boot-warmup churn folds in at the
+        # first probe, attributed to this replica)
+        self._shipper = DeltaShipper()
+
+    def mark_ready(self) -> None:
+        """Snapshot the compile counter after boot warmup: everything
+        past this point is a COLD compile the warmup artifact missed."""
+        self._compiles_ready = _compiles()
+
+    # ------------------------------------------------------------ serving --
+
+    def serve_forever(self) -> None:
+        while not self._stop.is_set():
+            try:
+                conn, _ = self._listener.accept()
+            except OSError:
+                break  # listener closed by shutdown
+            conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            threading.Thread(
+                target=self._handle, args=(conn,), daemon=True,
+                name=f"{self.name}-conn",
+            ).start()
+
+    def _handle(self, sock: socket.socket) -> None:
+        with sock:
+            while not self._stop.is_set():
+                try:
+                    msg = wire.recv_frame(sock)
+                except EOFError:
+                    return
+                except wire.CorruptFrame:
+                    # stream still in sync (length was honest): tell the
+                    # caller so it can resend; never process the frame
+                    try:
+                        wire.send_frame(sock, {"ok": False, "err": "corrupt_frame"})
+                        continue
+                    except (ConnectionError, OSError):
+                        return
+                except (ConnectionError, OSError):
+                    return
+                try:
+                    resp = self._dispatch(msg)
+                except Overloaded as exc:
+                    resp = {
+                        "ok": False,
+                        "err": "overloaded",
+                        "reason": exc.reason,
+                        "retry_after_s": exc.retry_after_s,
+                    }
+                except BaseException as exc:  # noqa: BLE001 — the reply carries it
+                    resp = {"ok": False, "err": "error", "detail": repr(exc)[:300]}
+                try:
+                    # admin replies use their own fault site so a chaos
+                    # rule on the request path can't corrupt supervision
+                    site = (
+                        "frontdoor.rpc.admin"
+                        if isinstance(msg, dict) and msg.get("op") != "submit"
+                        else wire.SITE
+                    )
+                    wire.send_frame(sock, resp, site=site)
+                except (ConnectionError, OSError):
+                    # caller gone (hedge winner abandoned us, or a dying
+                    # client): drop the result, keep serving others
+                    obs.count("frontdoor.replies_dropped", 1)
+                    return
+
+    def _dispatch(self, msg: dict) -> dict:
+        op = msg.get("op")
+        if op == "submit":
+            if self._draining:
+                return {"ok": False, "err": "draining"}
+            # the chaos seam: stall (→ client hedges), kill (→ parent
+            # respawns + postmortem), raise — all via ETH_SPECS_FAULT
+            fault.check(wire.SITE, tag=msg.get("kind"))
+            with trace.activate(trace.from_wire(msg.get("trace"))):
+                with obs.span("frontdoor.rpc", kind=msg.get("kind", "?")):
+                    if msg["kind"] == "bls":
+                        fut = self.service.submit_bls_aggregate(*msg["payload"])
+                    elif msg["kind"] == "htr":
+                        # payload is (chunks, depth); the service derives
+                        # the same depth from the chunk count itself
+                        fut = self.service.submit_hash_tree_root(msg["payload"][0])
+                    else:
+                        return {"ok": False, "err": "error",
+                                "detail": f"unknown kind {msg.get('kind')!r}"}
+                    return {"ok": True, "result": fut.result(timeout=300)}
+        if op == "health":
+            now = _compiles()
+            return {
+                "ok": True,
+                "pid": os.getpid(),
+                "name": self.name,
+                "draining": self._draining,
+                "queue_depth": self.service.admission.depth(),
+                "compiles": now,
+                "compiles_after_ready": now - self._compiles_ready,
+                "obs_delta": self._shipper.delta(),
+            }
+        if op == "drain":
+            self._draining = True
+            obs.event("frontdoor.replica_draining", name=self.name)
+            deadline = time.monotonic() + float(msg.get("timeout_s", 15.0))
+            while self.service.admission.depth() > 0 and time.monotonic() < deadline:
+                time.sleep(0.01)
+            return {"ok": True, "drained": self.service.admission.depth() == 0}
+        if op == "undrain":
+            self._draining = False
+            return {"ok": True}
+        if op == "precompile":
+            warmed = self.service.precompile(msg.get("keys"), path=msg.get("path"))
+            self.mark_ready()
+            return {"ok": True, "warmed": warmed}
+        if op == "shutdown":
+            self._stop.set()
+            # reply first, then break the accept loop
+            threading.Thread(target=self._close_listener, daemon=True).start()
+            return {"ok": True}
+        return {"ok": False, "err": "error", "detail": f"unknown op {op!r}"}
+
+    def _close_listener(self) -> None:
+        time.sleep(0.05)  # let the shutdown reply flush
+        try:
+            self._listener.close()
+        except OSError:
+            pass
+
+
+def replica_main(
+    ready_conn,
+    cfg_overrides: dict | None,
+    name: str,
+    warmup_path: str | None,
+    warmup_write: bool,
+    warm_keys: list | None,
+    fault_spec: str | None,
+    port_hint: int = 0,
+) -> None:
+    """Entry point of a spawned replica process. Sends
+    ``("ready", pid, port, warmed)`` over ``ready_conn`` once the boot
+    warmup finished and the socket is listening."""
+    if fault_spec is not None:
+        # each replica's chaos schedule is ITS OWN deterministic rule
+        # set (per-process hit counters; latches arbitrate across the
+        # fleet) — inherited parent rules are replaced, not stacked
+        fault.install(fault_spec)
+    if warmup_write and warmup_path:
+        # the artifact WRITER: every first dispatch appends its shape
+        os.environ["ETH_SPECS_SERVE_WARMUP"] = warmup_path
+    else:
+        # readers replay the artifact at boot but never write it
+        os.environ.pop("ETH_SPECS_SERVE_WARMUP", None)
+
+    from .service import VerifyService  # after env: config reads it
+
+    cfg = ServeConfig.from_env(**(cfg_overrides or {}))
+    svc = VerifyService(cfg, name=name)
+    server = ReplicaServer(svc, name=name)
+    if port_hint:
+        # a respawn tries to reclaim its predecessor's port so clients
+        # without a supervisor (gen workers) reconnect transparently
+        try:
+            relisten = socket.create_server(("127.0.0.1", port_hint))
+        except OSError:
+            pass
+        else:
+            server._listener.close()
+            server._listener = relisten
+            server.port = relisten.getsockname()[1]
+    warmed = 0
+    try:
+        if warm_keys:
+            warmed += svc.precompile([tuple(k) for k in warm_keys])
+        if warmup_path and os.path.exists(warmup_path):
+            warmed += svc.precompile(path=warmup_path)
+    except Exception:  # noqa: BLE001 — a cold boot is degraded, not dead
+        obs.event("frontdoor.warmup_failed", name=name)
+    server.mark_ready()
+    obs.event("frontdoor.replica_ready", name=name, port=server.port, warmed=warmed)
+    try:
+        ready_conn.send(("ready", os.getpid(), server.port, warmed))
+        ready_conn.close()
+    except OSError:
+        pass  # parent died during boot; serve_forever will exit on its own
+    try:
+        server.serve_forever()
+    finally:
+        svc.close()

@@ -1,0 +1,195 @@
+"""Failure-aware, compile-cache-affine request routing.
+
+The router answers one question — "which replica should THIS request
+go to?" — with three inputs:
+
+  * **shape affinity** — requests hash by their compile-shape key (the
+    pow2 committee size for BLS, the tree depth for merkleization), so
+    every shape has ONE preferred replica whose jit cache is warm for
+    it. Siblings only see a shape when its home replica is down,
+    draining, or backing off — which is exactly when the shippable
+    warmup artifact (every replica precompiled the same list at boot)
+    makes the detour free anyway. ``frontdoor.route.affinity`` vs
+    ``.fallback`` counters make the hit rate observable.
+  * **health** — a replica marked down (connection failure, death) is
+    skipped; after ``down_cooldown_s`` one trial request may probe it
+    again (half-open), so supervisor-less clients self-heal when the
+    replica respawns on its old port.
+  * **backoff** — a typed shed's ``retry_after_s`` (serve/admission.py)
+    is recorded as a per-replica not-before: the router HONORS the
+    replica's own drain estimate before sending it more work, routing
+    to a sibling meanwhile.
+
+Per-replica EWMA latency is tracked from both request RPCs and health
+probes; it feeds the hedge deadline decision and the stats surface.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import threading
+import time
+
+from eth_consensus_specs_tpu import obs
+
+
+class _Replica:
+    __slots__ = (
+        "up", "draining", "draining_until", "not_before", "down_until",
+        "ewma_s", "failures",
+    )
+
+    def __init__(self):
+        self.up = True
+        self.draining = False  # owner-asserted (planned rollover), sticky
+        self.draining_until = 0.0  # observed from a "draining" reply, expires
+        self.not_before = 0.0  # shed backoff (monotonic deadline)
+        self.down_until = 0.0  # half-open probe gate while down
+        self.ewma_s = 0.0
+        self.failures = 0
+
+
+def stable_hash(key: tuple) -> int:
+    """Deterministic across processes and runs (unlike ``hash()``, which
+    is salted per process — affinity must agree between restarts)."""
+    return int.from_bytes(
+        hashlib.sha256(repr(tuple(key)).encode()).digest()[:8], "big"
+    )
+
+
+class Router:
+    def __init__(self, n: int, *, down_cooldown_s: float = 0.5, ewma_alpha: float = 0.2):
+        self._lock = threading.Lock()
+        self._reps = [_Replica() for _ in range(n)]
+        self._down_cooldown_s = down_cooldown_s
+        self._alpha = ewma_alpha
+
+    def __len__(self) -> int:
+        return len(self._reps)
+
+    # ------------------------------------------------------------- picking --
+
+    def pick(self, shape_key: tuple, exclude: set | frozenset = frozenset()) -> int | None:
+        """The replica index for this shape, or None when nothing is
+        routable. Walks outward from the shape's home replica."""
+        n = len(self._reps)
+        if n == 0:
+            return None
+        home = stable_hash(shape_key) % n
+        now = time.monotonic()
+        with self._lock:
+            for k in range(n):
+                idx = (home + k) % n
+                if idx in exclude:
+                    continue
+                rep = self._reps[idx]
+                if rep.draining or rep.draining_until > now or rep.not_before > now:
+                    continue
+                if not rep.up:
+                    if rep.down_until > now:
+                        continue
+                    # half-open: one trial may go through; push the next
+                    # trial out a cooldown so a dead replica isn't hammered
+                    rep.down_until = now + self._down_cooldown_s
+                obs.count(
+                    "frontdoor.route.affinity" if k == 0 else "frontdoor.route.fallback",
+                    1,
+                )
+                return idx
+        return None
+
+    def backoff_remaining_s(self) -> float:
+        """Seconds until the soonest backing-off UP replica frees, 0.0
+        when none is backing off (or none is up)."""
+        now = time.monotonic()
+        with self._lock:
+            waits = [
+                rep.not_before - now
+                for rep in self._reps
+                if rep.up and not rep.draining and rep.not_before > now
+            ]
+        return min(waits) if waits else 0.0
+
+    # ----------------------------------------------------------- feedback --
+
+    def note_shed(self, idx: int, retry_after_s: float) -> None:
+        """Honor the replica's own drain estimate: no more traffic to it
+        until retry_after elapses (bounded — a wild hint must not
+        blackhole a healthy replica for minutes)."""
+        retry_after_s = min(max(retry_after_s, 0.001), 5.0)
+        with self._lock:
+            self._reps[idx].not_before = time.monotonic() + retry_after_s
+        obs.count("frontdoor.backoffs", 1)
+        obs.event("frontdoor.backoff", replica=idx, retry_after_s=round(retry_after_s, 4))
+
+    def note_ok(self, idx: int, latency_s: float | None = None) -> None:
+        with self._lock:
+            rep = self._reps[idx]
+            if not rep.up:
+                obs.event("frontdoor.replica_recovered", replica=idx)
+            rep.up = True
+            rep.failures = 0
+            rep.down_until = 0.0
+            if latency_s is not None:
+                rep.ewma_s = (
+                    latency_s
+                    if rep.ewma_s == 0.0
+                    else (1 - self._alpha) * rep.ewma_s + self._alpha * latency_s
+                )
+
+    def note_failure(self, idx: int) -> None:
+        with self._lock:
+            rep = self._reps[idx]
+            rep.failures += 1
+            rep.up = False
+            rep.down_until = time.monotonic() + self._down_cooldown_s
+
+    def mark_down(self, idx: int) -> None:
+        with self._lock:
+            self._reps[idx].up = False
+            self._reps[idx].down_until = float("inf")  # supervisor owns recovery
+
+    def mark_up(self, idx: int) -> None:
+        with self._lock:
+            rep = self._reps[idx]
+            rep.up = True
+            rep.failures = 0
+            rep.down_until = 0.0
+            rep.not_before = 0.0
+            rep.draining_until = 0.0  # a fresh replica is not draining
+
+    def set_draining(self, idx: int, draining: bool) -> None:
+        """Owner-asserted draining (planned rollover): sticky until the
+        owner clears it."""
+        with self._lock:
+            self._reps[idx].draining = draining
+            if not draining:
+                self._reps[idx].draining_until = 0.0
+
+    def note_draining(self, idx: int, ttl_s: float = 5.0) -> None:
+        """A ``draining`` REPLY observed by a supervisor-less client:
+        expires on its own — the rollover finishes without anyone to
+        clear a sticky flag, and the replica must not be blackholed
+        forever."""
+        with self._lock:
+            self._reps[idx].draining_until = time.monotonic() + ttl_s
+
+    # -------------------------------------------------------------- stats --
+
+    def ewma_s(self, idx: int) -> float:
+        with self._lock:
+            return self._reps[idx].ewma_s
+
+    def snapshot(self) -> list[dict]:
+        now = time.monotonic()
+        with self._lock:
+            return [
+                {
+                    "up": rep.up,
+                    "draining": rep.draining,
+                    "backoff_s": round(max(rep.not_before - now, 0.0), 4),
+                    "ewma_ms": round(rep.ewma_s * 1e3, 3),
+                    "failures": rep.failures,
+                }
+                for rep in self._reps
+            ]

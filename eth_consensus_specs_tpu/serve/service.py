@@ -410,10 +410,11 @@ class VerifyService:
             "rejected": counters.get("serve.rejected", 0),
         }
 
-    def precompile(self, keys: list[tuple] | None = None) -> int:
-        """Warm the compile cache from the persistent warmup list (or
-        explicit keys) before taking traffic."""
-        return buckets.precompile(keys)
+    def precompile(self, keys: list[tuple] | None = None, path: str | None = None) -> int:
+        """Warm the compile cache from the persistent warmup list (or an
+        explicit shippable artifact ``path``, or explicit keys) before
+        taking traffic."""
+        return buckets.precompile(keys, path=path)
 
     def close(self, timeout: float = 30.0) -> None:
         """Drain queued requests (a final ``close`` flush), stop both

@@ -1,0 +1,138 @@
+"""Length+digest framed messaging for the replica socket boundary.
+
+Frame layout (everything big-endian)::
+
+    2 bytes   magic  b"EF"
+    4 bytes   payload length (u32; bounded by MAX_FRAME)
+    8 bytes   sha256(payload)[:8]
+    N bytes   payload (pickle protocol 5 — both ends are processes the
+              front door spawned from this same codebase on loopback,
+              never an untrusted peer)
+
+The digest makes wire corruption a DETECTED failure instead of a silent
+one: ``fault.corrupt`` at site ``frontdoor.rpc`` (the deterministic
+``ETH_SPECS_FAULT`` machinery, fault/spec.py) flips a payload byte
+AFTER the digest is computed, so the receiver's check fails and raises
+:class:`CorruptFrame` — counted as ``frontdoor.corrupt_frames`` and
+retried by the caller, never accepted. Because only payload bytes are
+flipped (header intact, length honest), the stream stays in sync after
+a corrupt frame: a server can answer ``{"err": "corrupt_frame"}`` and
+keep the connection, and a client can simply resend.
+
+Deadline support: :func:`recv_frame` takes an optional
+``(deadline_s, on_deadline)`` pair — after ``deadline_s`` without a
+complete frame it invokes ``on_deadline()`` ONCE (the front door's
+hedging hook) and keeps waiting up to ``timeout_s``. A second expiry
+raises ``socket.timeout``, which the caller treats as replica failure.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import pickle
+import socket
+import struct
+import time
+from typing import Callable
+
+from eth_consensus_specs_tpu import fault, obs
+
+MAGIC = b"EF"
+HEADER = struct.Struct("!2sI8s")
+MAX_FRAME = 256 << 20  # a frame claiming more than 256 MiB is corrupt, not big
+SITE = "frontdoor.rpc"  # the fault-injection site name for this boundary
+
+
+class CorruptFrame(RuntimeError):
+    """A frame failed its digest (or sanity) check. The connection is
+    still usable — only the payload bytes were wrong."""
+
+
+def send_frame(sock: socket.socket, obj, *, site: str = SITE) -> None:
+    payload = pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL)
+    digest = hashlib.sha256(payload).digest()[:8]
+    # corruption injects AFTER the digest: the receiver must catch it
+    payload = fault.corrupt(site, payload)
+    sock.sendall(HEADER.pack(MAGIC, len(payload), digest) + payload)
+
+
+def _recv_exact(
+    sock: socket.socket,
+    n: int,
+    *,
+    hedge_at: list,
+    on_deadline: Callable[[], None] | None,
+    hard_at: float | None,
+) -> bytes:
+    """Read exactly n bytes under ABSOLUTE deadlines: ``hedge_at`` (a
+    one-element list, cleared after firing once per frame) and
+    ``hard_at`` bound the WHOLE frame's wall clock — a peer trickling
+    one byte per timeout window must not re-arm them (the documented
+    'hard per-RPC timeout' has to actually be hard)."""
+    buf = bytearray()
+    while len(buf) < n:
+        now = time.monotonic()
+        if hard_at is not None and now >= hard_at:
+            raise socket.timeout("rpc hard deadline exceeded")
+        if hedge_at and now >= hedge_at[0]:
+            hedge_at.clear()
+            if on_deadline is not None:
+                on_deadline()
+            continue
+        bounds = [t for t in (hedge_at[0] if hedge_at else None, hard_at) if t is not None]
+        sock.settimeout(min(bounds) - now if bounds else None)
+        try:
+            chunk = sock.recv(n - len(buf))
+        except socket.timeout:
+            continue  # the loop head decides: fire the hedge or raise
+        if not chunk:
+            if not buf and n == HEADER.size:
+                raise EOFError("peer closed the connection")
+            raise ConnectionError("peer closed mid-frame")
+        buf += chunk
+    return bytes(buf)
+
+
+def recv_frame(
+    sock: socket.socket,
+    *,
+    deadline_s: float | None = None,
+    on_deadline: Callable[[], None] | None = None,
+    timeout_s: float | None = None,
+):
+    """Read one frame. Raises EOFError on a clean close before any
+    bytes, ConnectionError on a mid-frame close, CorruptFrame on a
+    digest/sanity failure (stream still in sync), socket.timeout past
+    the hard ``timeout_s`` — measured over the WHOLE frame, not per
+    chunk."""
+    now = time.monotonic()
+    kw = dict(
+        # one-shot: the first expiry fires on_deadline, then only the
+        # hard deadline remains
+        hedge_at=[now + deadline_s] if deadline_s is not None else [],
+        on_deadline=on_deadline,
+        hard_at=now + timeout_s if timeout_s is not None else None,
+    )
+    header = _recv_exact(sock, HEADER.size, **kw)
+    magic, length, digest = HEADER.unpack(header)
+    if magic != MAGIC or length > MAX_FRAME:
+        # a mangled header desyncs the stream: unrecoverable connection
+        obs.count("frontdoor.corrupt_frames", 1)
+        raise ConnectionError(f"unrecognized frame header {header!r}")
+    payload = _recv_exact(sock, length, **kw)
+    if hashlib.sha256(payload).digest()[:8] != digest:
+        obs.count("frontdoor.corrupt_frames", 1)
+        obs.event("frontdoor.corrupt_frame", nbytes=length)
+        raise CorruptFrame(f"digest mismatch on a {length}-byte frame")
+    return pickle.loads(payload)
+
+
+def connect(addr: tuple[str, int], timeout_s: float = 5.0) -> socket.socket:
+    sock = socket.create_connection(addr, timeout=timeout_s)
+    sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+    return sock
+
+
+def parse_addr(spec: str) -> tuple[str, int]:
+    host, _, port = spec.rpartition(":")
+    return (host or "127.0.0.1", int(port))

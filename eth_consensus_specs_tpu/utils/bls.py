@@ -118,9 +118,19 @@ def FastAggregateVerify(pks: list, message: bytes, sig: bytes) -> bool:
 
     svc = serve.routed()
     if svc is not None:
-        return svc.submit_bls_aggregate(
-            [bytes(p) for p in pks], bytes(message), bytes(sig)
-        ).result()
+        # a typed shed (queue caps, or every front-door replica
+        # overloaded) is flow control, not an answer: honor the
+        # retry-after hint and resubmit — a synchronous spec-code caller
+        # has nothing better to do with its slot than wait its turn
+        import time as _time
+
+        while True:
+            try:
+                return svc.submit_bls_aggregate(
+                    [bytes(p) for p in pks], bytes(message), bytes(sig)
+                ).result()
+            except serve.Overloaded as exc:
+                _time.sleep(min(exc.retry_after_s, 5.0))
     if _backend == "tpu":
         from eth_consensus_specs_tpu.ops import bls_batch
 

@@ -32,6 +32,27 @@ textfile next to the JSON report (``<out>.prom``, overridable via
 ``--smoke`` shrinks everything for CI (the serve-smoke job in
 checks.yml) and skips the 2x gate; correctness/flush/compile/SLO gates
 always apply. Exit code 0 only if every gate passes.
+
+Replicated mode (``--replicas R``, the serve-replica-chaos CI job):
+boots a supervised R-replica front door (serve/frontdoor.py), runs the
+same closed-loop load THROUGH the socket boundary, and gates the
+distributed-systems contract instead of the batching contract:
+
+  * zero lost requests — every submitted future resolves;
+  * byte parity with the clean single-process direct run;
+  * ``--chaos``: one replica SIGKILLs itself mid-load
+    (``frontdoor.rpc:kill`` + latch, the deterministic fault grammar),
+    and the run must additionally show ``frontdoor.replicas_replaced
+    > 0``, a ``frontdoor.replica_lost`` postmortem bundle from the
+    parent, zero host-oracle degrades (the fleet absorbed the kill),
+    and zero compiles-after-warmup on every surviving replica (the
+    shippable warmup artifact did its job — including for the
+    respawned replacement);
+  * wait-p99 SLO evaluated from the MERGED cross-process histogram
+    (replica deltas folded into the parent registry via health probes).
+
+``--warmup-out`` writes the shippable warmup artifact (every compiled
+shape key) for CI to upload; replicated runs also boot FROM it.
 """
 
 from __future__ import annotations
@@ -55,6 +76,7 @@ from eth_consensus_specs_tpu import obs, serve  # noqa: E402
 from eth_consensus_specs_tpu.obs import export, slo  # noqa: E402
 from eth_consensus_specs_tpu.ops import bls_batch  # noqa: E402
 from eth_consensus_specs_tpu.ops.merkle import merkleize_subtree_device  # noqa: E402
+from eth_consensus_specs_tpu.serve import buckets as serve_buckets  # noqa: E402
 from eth_consensus_specs_tpu.serve.config import ServeConfig  # noqa: E402
 from eth_consensus_specs_tpu.utils import bls  # noqa: E402
 
@@ -83,11 +105,18 @@ def build_trees(n: int, depth: int, seed: int = 0) -> list[np.ndarray]:
     ]
 
 
-def closed_loop(svc, payloads: list[tuple], submitters: int) -> tuple[float, list, list]:
+_LOST = object()  # sentinel: a future that never resolved (a LOST request)
+
+
+def closed_loop(
+    svc, payloads: list[tuple], submitters: int, result_timeout: float = 300.0
+) -> tuple[float, list, list]:
     """Each submitter thread works through its share, one outstanding
     request at a time (closed loop). Returns (seconds, results in
-    payload order, per-request latencies seconds)."""
-    results: list = [None] * len(payloads)
+    payload order, per-request latencies seconds). A future that fails
+    or times out leaves the ``_LOST`` sentinel — the replicated gates
+    assert none exist."""
+    results: list = [_LOST] * len(payloads)
     latencies: list = [0.0] * len(payloads)
     shards = [list(range(i, len(payloads), submitters)) for i in range(submitters)]
     start = threading.Barrier(submitters + 1)
@@ -103,10 +132,20 @@ def closed_loop(svc, payloads: list[tuple], submitters: int) -> tuple[float, lis
                         fut = svc.submit_bls_aggregate(*payload)
                     else:
                         fut = svc.submit_hash_tree_root(payload)
-                    break
                 except serve.Overloaded as exc:
                     time.sleep(exc.retry_after_s)  # closed loop honors the shed hint
-            results[idx] = fut.result()
+                    continue
+                try:
+                    results[idx] = fut.result(timeout=result_timeout)
+                except serve.Overloaded as exc:
+                    # the front door resolved the future with a typed
+                    # shed (every replica overloaded): flow control, not
+                    # loss — back off and resubmit like any other shed
+                    time.sleep(exc.retry_after_s)
+                    continue
+                except Exception:  # noqa: BLE001 — recorded as lost, gated below
+                    pass
+                break
             latencies[idx] = time.perf_counter() - t0
 
     threads = [threading.Thread(target=run, args=(s,), daemon=True) for s in shards]
@@ -129,6 +168,180 @@ def latency_histogram(latencies_s: list[float]) -> dict:
     return dict(sorted(hist.items(), key=lambda kv: int(kv[0][2:-2])))
 
 
+def finish_report(report: dict, failures: list, out: str, trigger: str, snap: dict) -> None:
+    """Shared epilogue of both bench modes: validated Prometheus
+    textfile of the final snapshot, report JSON + stdout line, and — on
+    any gate failure — a flight-recorder bundle plus exit code 1."""
+    prom_path = os.environ.get("ETH_SPECS_OBS_PROM") or (
+        os.path.splitext(out)[0] + ".prom"
+    )
+    export.write_textfile(prom_path, snap=snap)
+    try:
+        export.validate_text(open(prom_path).read())
+    except ValueError as exc:
+        failures.append(f"prometheus exposition invalid: {exc}")
+    report["prometheus_textfile"] = prom_path
+    report["failures"] = failures
+    with open(out, "w") as fh:
+        json.dump(report, fh, indent=2, sort_keys=True)
+    print(json.dumps(report, sort_keys=True))
+    if failures:
+        # any gate failure is an incident: leave a flight-recorder
+        # bundle for the CI `if: failure()` artifact (no-op without a
+        # postmortem dir)
+        obs.flight.trigger_dump(trigger, detail="; ".join(failures)[:300])
+        print("FAILED:", *failures, sep="\n  ", file=sys.stderr)
+        raise SystemExit(1)
+
+
+def run_replicated(args) -> None:
+    """The --replicas path: closed-loop load through a supervised
+    replica fleet, optionally with a deterministic mid-load SIGKILL."""
+    from eth_consensus_specs_tpu.obs import slo as slo_mod
+    from eth_consensus_specs_tpu.serve.config import FrontDoorConfig
+    from eth_consensus_specs_tpu.serve.frontdoor import FrontDoor
+
+    out_dir = os.path.dirname(os.path.abspath(args.out)) or "."
+    pm_dir = os.environ.get("ETH_SPECS_OBS_POSTMORTEM_DIR")
+    if not pm_dir:
+        pm_dir = os.path.join(out_dir, "postmortems")
+        os.environ["ETH_SPECS_OBS_POSTMORTEM_DIR"] = pm_dir
+    warmup_path = args.warmup_out or os.path.join(out_dir, "warmup_shapes.jsonl")
+
+    export.maybe_serve_http()
+    cfg = ServeConfig.from_env(max_batch=min(max(args.submitters // 2, 1), 32))
+    fault_spec = None
+    if args.chaos:
+        # deterministic mid-load kill: exactly ONE replica (the latch
+        # arbitrates) SIGKILLs itself on its Nth request RPC
+        nth = max(args.requests // 8, 2)
+        latch = os.path.join(out_dir, f"chaos_kill_{os.getpid()}.latch")
+        if os.path.exists(latch):
+            os.unlink(latch)
+        fault_spec = f"frontdoor.rpc:kill:nth={nth}:latch={latch}"
+
+    fd = FrontDoor(
+        replicas=args.replicas,
+        config=cfg,
+        fd_config=FrontDoorConfig.from_env(),
+        warmup_path=warmup_path,
+        # the bls_msm key matters on device backends (the MSM kernel
+        # compiles per pow2 committee size; precompile skips it when
+        # _use_device() is off) — without it the bls home replica's
+        # first dispatch would be a cold compile after mark_ready and
+        # fail this run's own compiles_after_ready gate
+        warm_keys=[("merkle_many", b, args.tree_depth) for b in cfg.buckets]
+        + [("bls_msm", serve_buckets.pow2_bucket(args.committee))],
+        replica_fault_spec=fault_spec,
+        name="bench-fd",
+    )
+
+    # clean single-process truth on the SAME payloads (replicas are
+    # spawned with fresh runtimes, so parent-side work can't pre-warm
+    # them — the zero-cold-compile gate stays honest)
+    bls_items = build_bls_items(args.requests, args.committee, distinct_msgs=4)
+    trees = build_trees(args.requests, args.tree_depth)
+    direct_bls = [bls_batch.batch_verify_aggregates([it]) for it in bls_items]
+    direct_roots = [merkleize_subtree_device(t, args.tree_depth) for t in trees]
+
+    load = [("bls", it) for it in bls_items] + [("htr", t) for t in trees]
+    wall_s, got, _lat = closed_loop(fd, load, args.submitters)
+    time.sleep(max(fd.fdcfg.probe_interval_s * 3, 0.5))  # one last probe round
+    stats = fd.stats()
+    replica_stats = fd.replica_stats()
+    fd.close()  # merges each survivor's final obs delta
+
+    failures = []
+    lost = sum(1 for r in got if r is _LOST)
+    if lost:
+        failures.append(f"{lost} requests lost (futures never resolved)")
+    if got[: len(bls_items)] != direct_bls:
+        failures.append("BLS parity: replicated results != direct ops results")
+    if got[len(bls_items):] != direct_roots:
+        failures.append("HTR parity: replicated roots != direct ops roots")
+
+    snap = obs.snapshot()
+    counters = snap["counters"]
+    if snap["watchdog"]["divergences"] != 0:
+        failures.append(f"watchdog divergences: {snap['watchdog']}")
+    replaced = counters.get("frontdoor.replicas_replaced", 0)
+    degraded_host = counters.get("frontdoor.degraded_to_host", 0)
+    bundles = []
+    if os.path.isdir(pm_dir):
+        for name in sorted(os.listdir(pm_dir)):
+            if name.startswith("postmortem-") and "frontdoor-replica-lost" in name:
+                bundles.append(os.path.join(pm_dir, name))
+    if args.chaos:
+        if replaced < 1:
+            failures.append("chaos run but frontdoor.replicas_replaced == 0 "
+                            "(the kill never happened or was never healed)")
+        if not bundles:
+            failures.append(f"no frontdoor.replica_lost postmortem bundle in {pm_dir}")
+        if degraded_host:
+            failures.append(
+                f"{degraded_host} host-oracle degrades: the fleet did NOT absorb "
+                "the kill (siblings should have served every failover)"
+            )
+    # zero cold compiles on every replica that answered its last probe:
+    # survivors AND the respawned replacement warmed from the artifact
+    cold = {
+        i: s["compiles_after_ready"]
+        for i, s in enumerate(replica_stats)
+        if s is not None and s.get("compiles_after_ready")
+    }
+    if cold:
+        failures.append(f"cold compiles after warmup on replicas: {cold}")
+    surveyed = sum(1 for s in replica_stats if s is not None)
+    if surveyed < args.replicas:
+        failures.append(
+            f"only {surveyed}/{args.replicas} replicas answered a health probe"
+        )
+    obs.count("serve.compiles_after_warmup", sum(cold.values()))
+
+    # the wait-p99 SLO over the MERGED cross-process histogram (replica
+    # deltas folded in via health probes + the final close() probe)
+    snap = obs.snapshot()
+    wait_hist = snap["histograms"].get("serve.wait_ms", {})
+    if not wait_hist.get("count"):
+        failures.append("merged serve.wait_ms histogram is empty — replica "
+                        "telemetry never reached the parent")
+    slo_results = slo_mod.evaluate(snap)
+    for r in slo_results:
+        if not r.ok:
+            failures.append(
+                f"SLO {r.name}: observed {r.observed} > bound {r.bound} ({r.detail})"
+            )
+
+    report = {
+        "mode": "replicated-chaos" if args.chaos else "replicated",
+        "replicas": args.replicas,
+        "submitters": args.submitters,
+        "requests": len(load),
+        "rps": round(len(load) / wall_s, 2),
+        "lost": lost,
+        "replicas_replaced": replaced,
+        "postmortem_bundles": bundles,
+        "degraded_to_host": degraded_host,
+        "hedges": stats["hedges"],
+        "hedge_wins": stats["hedge_wins"],
+        "failovers": stats["failovers"],
+        "corrupt_frames": stats["corrupt_frames"],
+        "route_affinity": counters.get("frontdoor.route.affinity", 0),
+        "route_fallback": counters.get("frontdoor.route.fallback", 0),
+        "replica_stats": replica_stats,
+        "warmup_artifact": warmup_path,
+        "warmup_keys": len(serve_buckets.load_warmup(warmup_path)),
+        "wait_ms": {
+            "samples": wait_hist.get("count", 0),
+            "p50": wait_hist.get("p50"),
+            "p99": wait_hist.get("p99"),
+        },
+        "slo": slo_mod.report(slo_results),
+    }
+
+    finish_report(report, failures, args.out, "serve_bench.replicated_failure", snap)
+
+
 def main() -> None:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--smoke", action="store_true", help="small CI run, skip the 2x gate")
@@ -137,11 +350,20 @@ def main() -> None:
     ap.add_argument("--tree-depth", type=int, default=10)
     ap.add_argument("--committee", type=int, default=3)
     ap.add_argument("--out", default="BENCH_SERVE.json")
+    ap.add_argument("--replicas", type=int, default=0,
+                    help="run the load through an R-replica front door")
+    ap.add_argument("--chaos", action="store_true",
+                    help="with --replicas: SIGKILL one replica mid-load")
+    ap.add_argument("--warmup-out", default=None,
+                    help="write the shippable warmup artifact here")
     args = ap.parse_args()
     if args.smoke:
         args.submitters = min(args.submitters, 16)
         args.requests = min(args.requests, 64)
         args.tree_depth = min(args.tree_depth, 6)
+    if args.replicas > 0:
+        run_replicated(args)
+        return
 
     export.maybe_serve_http()  # scrapeable while the bench runs (env-gated)
     # max_batch strictly below the submitter count guarantees full (size-
@@ -267,31 +489,12 @@ def main() -> None:
         "slo": slo.report(slo_results),
     }
 
-    # Prometheus textfile of the final snapshot, validated before the
-    # report (an invalid exposition is a gate failure like any other)
-    prom_path = os.environ.get("ETH_SPECS_OBS_PROM") or (
-        os.path.splitext(args.out)[0] + ".prom"
-    )
-    export.write_textfile(prom_path, snap=snap)
-    try:
-        export.validate_text(open(prom_path).read())
-    except ValueError as exc:
-        failures.append(f"prometheus exposition invalid: {exc}")
-    report["prometheus_textfile"] = prom_path
-    report["failures"] = failures
-
-    with open(args.out, "w") as fh:
-        json.dump(report, fh, indent=2, sort_keys=True)
-    print(json.dumps(report, sort_keys=True))
-    if failures:
-        # any gate failure (parity, flush, compile, SLO, exposition) is
-        # an incident: leave a flight-recorder bundle for the CI
-        # `if: failure()` artifact (no-op without a postmortem dir)
-        obs.flight.trigger_dump(
-            "serve_bench.failure", detail="; ".join(failures)[:300]
-        )
-        print("FAILED:", *failures, sep="\n  ", file=sys.stderr)
-        raise SystemExit(1)
+    if args.warmup_out:
+        # the shippable warmup artifact: every shape this run compiled,
+        # for CI to upload and later boots (replicas!) to replay
+        report["warmup_artifact"] = args.warmup_out
+        report["warmup_keys"] = serve_buckets.write_warmup(args.warmup_out)
+    finish_report(report, failures, args.out, "serve_bench.failure", snap)
 
 
 if __name__ == "__main__":

@@ -1,0 +1,533 @@
+"""Replicated front door — the failure matrix.
+
+Contract under test (serve/frontdoor.py + replica.py + router.py +
+wire.py): every admitted request resolves to exactly what the direct
+ops call returns — through a healthy fleet, through a SIGKILLed
+replica, through a stalled replica (hedged), through corrupt frames,
+through a planned rollover (zero shed), and with no replica at all
+(host-oracle last rung). Admission slots release exactly once however
+many legs race.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import socket
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from eth_consensus_specs_tpu import fault, obs, serve
+from eth_consensus_specs_tpu.obs import trace
+from eth_consensus_specs_tpu.ops import bls_batch
+from eth_consensus_specs_tpu.ops import merkle as ops_merkle
+from eth_consensus_specs_tpu.serve import buckets, wire
+from eth_consensus_specs_tpu.serve.admission import AdmissionController, Overloaded
+from eth_consensus_specs_tpu.serve.config import FrontDoorConfig, ServeConfig
+from eth_consensus_specs_tpu.serve.frontdoor import FrontDoor, FrontDoorClient
+from eth_consensus_specs_tpu.serve.router import Router
+from eth_consensus_specs_tpu.utils import bls
+
+TREE_DEPTH = 5
+
+
+def _counter(name: str) -> float:
+    return obs.snapshot()["counters"].get(name, 0)
+
+
+def _serve_cfg(**kw) -> ServeConfig:
+    kw.setdefault("max_batch", 4)
+    kw.setdefault("max_wait_ms", 5)
+    kw.setdefault("buckets", (1, 2, 4))
+    return ServeConfig.from_env(**kw)
+
+
+def _fd_cfg(**kw) -> FrontDoorConfig:
+    kw.setdefault("hedge_ms", 0.0)  # hedging is its own test
+    kw.setdefault("probe_interval_ms", 100.0)
+    kw.setdefault("slo_shedding", False)  # _slo_step driven by hand
+    kw.setdefault("down_cooldown_ms", 200.0)
+    return FrontDoorConfig.from_env(**kw)
+
+
+@pytest.fixture(scope="module")
+def trees():
+    rng = np.random.default_rng(11)
+    cap = 1 << TREE_DEPTH
+    return [
+        rng.integers(0, 256, size=(n, 32)).astype(np.uint8)
+        for n in (cap // 2 + 1, cap - 3, cap, 19, 23, 29)
+    ]
+
+
+@pytest.fixture(scope="module")
+def bls_items():
+    sks = [1, 2, 3]
+    pks = [bls.SkToPk(sk) for sk in sks]
+    msgs = [bytes([i + 1]) * 32 for i in range(2)]
+    items = []
+    for i in range(4):
+        m = msgs[i % 2]
+        sig = bls.Aggregate([bls.Sign(sk, m) for sk in sks])
+        if i == 2:
+            sig = b"\x01" + bytes(sig)[1:]  # tampered: must verify False
+        items.append((pks, m, sig))
+    return items
+
+
+def _direct(trees, bls_items):
+    roots = [
+        ops_merkle.merkleize_subtree_device(t, buckets.subtree_depth(t.shape[0]))
+        for t in trees
+    ]
+    verdicts = [
+        bls_batch.batch_verify_aggregates([(list(map(bytes, p)), m, bytes(s))])
+        for p, m, s in bls_items
+    ]
+    return roots, verdicts
+
+
+@pytest.fixture(scope="module")
+def shared_fd(tmp_path_factory):
+    """One fleet for the healthy-path tests: 2 replicas, a shippable
+    warmup artifact, and a shared JSONL sink configured BEFORE the fork
+    so replica events land in the same stream as the parent's."""
+    tmp = tmp_path_factory.mktemp("frontdoor")
+    jsonl = tmp / "events.jsonl"
+    # spawned replicas configure their JSONL sink from the env at
+    # import — what makes the cross-process stitching test possible
+    old_jsonl = os.environ.get("ETH_SPECS_OBS_JSONL")
+    os.environ["ETH_SPECS_OBS_JSONL"] = str(jsonl)
+    warmup = tmp / "warmup.jsonl"
+    fd = FrontDoor(
+        replicas=2,
+        config=_serve_cfg(),
+        fd_config=_fd_cfg(),
+        warmup_path=str(warmup),
+        warm_keys=[("merkle_many", b, TREE_DEPTH) for b in (1, 2, 4)],
+        name="fd-test",
+    )
+    try:
+        yield fd, jsonl, warmup
+    finally:
+        fd.close()
+        if old_jsonl is None:
+            os.environ.pop("ETH_SPECS_OBS_JSONL", None)
+        else:
+            os.environ["ETH_SPECS_OBS_JSONL"] = old_jsonl
+
+
+# ------------------------------------------------------------------ units --
+
+
+def test_wire_roundtrip_and_corrupt_detection():
+    a, b = socket.socketpair()
+    try:
+        wire.send_frame(a, {"op": "x", "blob": b"\x00" * 1000})
+        assert wire.recv_frame(b, timeout_s=5)["op"] == "x"
+        # a corrupt-mode rule flips a payload byte after the digest:
+        # the receiver must detect it, never deliver it
+        with fault.injected("frontdoor.rpc:corrupt"):
+            wire.send_frame(a, {"op": "y"})
+        before = _counter("frontdoor.corrupt_frames")
+        with pytest.raises(wire.CorruptFrame):
+            wire.recv_frame(b, timeout_s=5)
+        assert _counter("frontdoor.corrupt_frames") == before + 1
+        # the stream stays in sync: the next clean frame still parses
+        wire.send_frame(a, {"op": "z"})
+        assert wire.recv_frame(b, timeout_s=5)["op"] == "z"
+    finally:
+        a.close()
+        b.close()
+
+
+def test_admission_retry_after_accounts_for_queue_depth():
+    ctrl = AdmissionController(max_queue=4, max_bytes=1 << 30)
+    for _ in range(4):
+        ctrl.admit(10)
+    with pytest.raises(Overloaded) as exc_info:
+        ctrl.admit(10)
+    # 4 requests ahead at the (seeded) 10ms EWMA: the hint must scale
+    # with the queue ahead, not quote a bare per-request service time
+    assert exc_info.value.reason == "queue"
+    assert exc_info.value.retry_after_s >= 4 * 0.01 * 0.9
+    for _ in range(4):
+        ctrl.release(10, service_s=0.01)
+
+
+def test_admission_retry_after_bytes_reason_scales_with_overshoot():
+    ctrl = AdmissionController(max_queue=100, max_bytes=100)
+    ctrl.admit(90)
+    with pytest.raises(Overloaded) as exc_info:
+        ctrl.admit(50)
+    assert exc_info.value.reason == "bytes"
+    # one release of the (avg 90-byte) in-flight payload frees the
+    # overshoot: the hint is ~1 release, not the 1-deep queue times x
+    assert 0 < exc_info.value.retry_after_s < 1.0
+    ctrl.release(90, service_s=0.005)
+
+
+def test_admission_retry_after_floors_at_stall_age():
+    ctrl = AdmissionController(max_queue=1, max_bytes=1 << 30)
+    ctrl.admit(1)
+    time.sleep(0.15)  # nothing releases: the service is stalled
+    with pytest.raises(Overloaded) as exc_info:
+        ctrl.admit(1)
+    # EWMA says 10ms — but nothing has released for 150ms, and a hint
+    # below the observed stall age is a lie
+    assert exc_info.value.retry_after_s >= 0.14
+    ctrl.release(1)
+
+
+def test_admission_resize_gates_new_admissions_only():
+    ctrl = AdmissionController(max_queue=8, max_bytes=1 << 30)
+    for _ in range(6):
+        ctrl.admit(1)
+    ctrl.resize(2)  # SLO shed: below current depth — nothing is evicted
+    assert ctrl.depth() == 6
+    with pytest.raises(Overloaded):
+        ctrl.admit(1)
+    for _ in range(6):
+        ctrl.release(1)
+    ctrl.resize(8)
+    ctrl.admit(1)
+    ctrl.release(1)
+
+
+def test_router_affinity_backoff_and_draining():
+    r = Router(3, down_cooldown_s=0.1)
+    key = ("merkle_many", 5)
+    home = r.pick(key)
+    assert all(r.pick(key) == home for _ in range(5))  # stable affinity
+    # a shed's retry_after is HONORED: the home replica is skipped until
+    # the backoff elapses, siblings serve meanwhile
+    r.note_shed(home, 0.15)
+    sibling = r.pick(key)
+    assert sibling is not None and sibling != home
+    assert r.backoff_remaining_s() > 0
+    time.sleep(0.16)
+    assert r.pick(key) == home
+    # draining replicas take no new work at all
+    r.set_draining(home, True)
+    assert r.pick(key) != home
+    r.set_draining(home, False)
+    # a client-OBSERVED "draining" reply expires on its own: a
+    # supervisor-less client must not blackhole the replica forever
+    r.note_draining(home, ttl_s=0.1)
+    assert r.pick(key) != home
+    time.sleep(0.12)
+    assert r.pick(key) == home
+    # a down replica is skipped, then probed half-open after cooldown
+    r.mark_down(home)
+    assert r.pick(key) != home
+    r.note_failure(home)  # failure path: cooldown-gated, not supervisor-gated
+    assert r.pick(key) != home
+    time.sleep(0.11)
+    assert r.pick(key) == home  # one half-open trial
+    assert r.pick(key) != home  # next trial gated again
+    r.mark_up(home)
+    assert r.pick(key) == home
+
+
+def test_all_replicas_shedding_propagates_typed_overloaded():
+    client = FrontDoorClient(
+        ["127.0.0.1:9", "127.0.0.1:10"], config=_serve_cfg(), fd_config=_fd_cfg()
+    )
+    client._rpc_submit = lambda idx, req, hedge: {
+        "ok": False, "err": "overloaded", "reason": "queue", "retry_after_s": 0.07,
+    }
+    fut = client.submit_hash_tree_root(np.zeros((4, 32), np.uint8))
+    with pytest.raises(Overloaded) as exc_info:
+        fut.result(timeout=30)
+    # flow control propagates typed, with the smallest honest hint —
+    # absorbing an overload on the host oracle would defeat backpressure
+    assert exc_info.value.retry_after_s == pytest.approx(0.07)
+    assert client.admission.depth() == 0  # the slot released exactly once
+    client.close()
+
+
+def test_host_oracle_is_the_last_rung(trees, bls_items):
+    """No replica listening at all: every submit still resolves,
+    bit-identical, via the front door's own host oracle."""
+    direct_roots, direct_verdicts = _direct(trees[:2], bls_items[:2])
+    degraded_before = _counter("frontdoor.degraded_to_host")
+    client = FrontDoorClient(
+        ["127.0.0.1:9"], config=_serve_cfg(), fd_config=_fd_cfg()
+    )
+    roots = [client.submit_hash_tree_root(t).result(timeout=60) for t in trees[:2]]
+    verdicts = [
+        client.submit_bls_aggregate(*it).result(timeout=60) for it in bls_items[:2]
+    ]
+    client.close()
+    assert roots == direct_roots
+    assert verdicts == direct_verdicts
+    assert _counter("frontdoor.degraded_to_host") - degraded_before == 4
+    assert client.admission.depth() == 0
+
+
+# ------------------------------------------------------------ healthy path --
+
+
+def test_parity_bit_identical_through_replicas(shared_fd, trees, bls_items):
+    fd, _, _ = shared_fd
+    direct_roots, direct_verdicts = _direct(trees, bls_items)
+    degraded_before = _counter("frontdoor.degraded_to_host")
+    rfuts = [fd.submit_hash_tree_root(t) for t in trees]
+    bfuts = [fd.submit_bls_aggregate(*it) for it in bls_items]
+    assert [f.result(timeout=60) for f in rfuts] == direct_roots
+    assert [f.result(timeout=60) for f in bfuts] == direct_verdicts
+    # served by the fleet, not by the fallback rung
+    assert _counter("frontdoor.degraded_to_host") == degraded_before
+    assert _counter("frontdoor.route.affinity") > 0
+
+
+def test_warmup_artifact_zero_cold_compiles_on_consumers(shared_fd, trees):
+    """The artifact is the shippable warmup: replica 0 wrote it, every
+    other replica replayed it at boot — traffic then causes ZERO cold
+    compiles on any replica."""
+    fd, _, warmup = shared_fd
+    for t in trees:
+        fd.submit_hash_tree_root(t).result(timeout=60)
+    keys = {tuple(k) for k in buckets.load_warmup(str(warmup))}
+    assert {("merkle_many", b, TREE_DEPTH) for b in (1, 2, 4)} <= keys
+    deadline = time.monotonic() + 10
+    stats = fd.replica_stats()
+    while (
+        any(s is None for s in stats) and time.monotonic() < deadline
+    ):  # wait for one probe round
+        time.sleep(0.1)
+        stats = fd.replica_stats()
+    assert all(s is not None for s in stats), stats
+    for s in stats:
+        assert s["compiles_after_ready"] == 0, stats
+
+
+def test_trace_stitches_across_the_process_boundary(shared_fd, trees):
+    """A submit under an active trace context reaches the replica with
+    the same trace_id: its frontdoor.rpc span in the shared JSONL sink
+    is a child of the caller's trace."""
+    fd, jsonl, _ = shared_fd
+    ctx = trace.new_trace()
+    with trace.activate(ctx):
+        fd.submit_hash_tree_root(trees[0]).result(timeout=60)
+    deadline = time.monotonic() + 10
+    spans = []
+    while not spans and time.monotonic() < deadline:
+        time.sleep(0.1)
+        with open(jsonl) as fh:
+            lines = [json.loads(ln) for ln in fh if ln.strip()]
+        spans = [
+            e
+            for e in lines
+            if e.get("name") == "frontdoor.rpc" and e.get("trace_id") == ctx.trace_id
+        ]
+    assert spans, "no replica-side span carried the caller's trace id"
+    parent_pid_events = [e for e in lines if e.get("kind") == "frontdoor.replica_ready"]
+    assert parent_pid_events, "replica boot events missing from the shared sink"
+
+
+def test_corrupt_request_frame_detected_counted_retried(shared_fd, trees):
+    """frontdoor.rpc:corrupt on the client's next submit frame: the
+    replica detects the digest mismatch, answers typed, the client
+    resends — the result is still bit-identical, never silent garbage."""
+    fd, _, _ = shared_fd
+    direct = ops_merkle.merkleize_subtree_device(
+        trees[3], buckets.subtree_depth(trees[3].shape[0])
+    )
+    retries_before = _counter("frontdoor.corrupt_retries")
+    with fault.injected("frontdoor.rpc:corrupt"):
+        root = fd.submit_hash_tree_root(trees[3]).result(timeout=60)
+    assert root == direct
+    assert _counter("frontdoor.corrupt_retries") - retries_before >= 1
+
+
+def test_router_backoff_honored_before_rerouting(shared_fd, trees):
+    """Both replicas shedding (simulated backoff): the dispatcher waits
+    out the soonest retry_after instead of hammering, then serves."""
+    fd, _, _ = shared_fd
+    direct = ops_merkle.merkleize_subtree_device(
+        trees[4], buckets.subtree_depth(trees[4].shape[0])
+    )
+    fd.router.note_shed(0, 0.3)
+    fd.router.note_shed(1, 0.3)
+    t0 = time.monotonic()
+    assert fd.submit_hash_tree_root(trees[4]).result(timeout=60) == direct
+    assert time.monotonic() - t0 >= 0.25
+
+
+def test_slo_breach_shrinks_admission_and_recovers(shared_fd, monkeypatch):
+    """SLO breaches drive shedding: a breached probe window halves the
+    effective admission cap; clean windows grow it back to the ceiling."""
+    fd, _, _ = shared_fd
+    monkeypatch.setenv("ETH_SPECS_SLO_WAIT_P99_MS", "5")
+    base = fd._base_max_queue
+    fd._slo_shipper.delta()  # start a fresh window
+    for _ in range(20):
+        obs.observe("serve.wait_ms", 50.0)  # way past the 5ms objective
+    fd._slo_step()
+    shrunk = fd.admission.max_queue
+    assert shrunk == base // 2
+    assert _counter("frontdoor.slo_sheds") >= 1
+    # clean windows: additive recovery back to the configured ceiling
+    for _ in range(30):
+        fd._slo_step()
+        if fd.admission.max_queue == base:
+            break
+    assert fd.admission.max_queue == base
+
+
+def test_drain_on_restart_zero_shed(shared_fd, trees):
+    """Planned rollover under continuous traffic: no request is shed,
+    no request fails, every result stays bit-identical."""
+    fd, _, _ = shared_fd
+    direct = [
+        ops_merkle.merkleize_subtree_device(t, buckets.subtree_depth(t.shape[0]))
+        for t in trees
+    ]
+    rejected_before = _counter("serve.rejected")
+    stop = threading.Event()
+    errors: list = []
+    done = [0]
+
+    def submitter():
+        i = 0
+        while not stop.is_set():
+            idx = i % len(trees)
+            try:
+                got = fd.submit_hash_tree_root(trees[idx]).result(timeout=60)
+                if got != direct[idx]:
+                    errors.append(f"mismatch at {i}")
+            except Exception as exc:  # noqa: BLE001
+                errors.append(repr(exc))
+            i += 1
+            done[0] = i
+
+    t = threading.Thread(target=submitter, daemon=True)
+    t.start()
+    time.sleep(0.3)
+    fd.restart_replica(0, timeout_s=5)
+    time.sleep(0.3)
+    stop.set()
+    t.join(timeout=60)
+    assert not errors, errors[:3]
+    assert done[0] > 0
+    assert _counter("serve.rejected") == rejected_before
+    assert _counter("frontdoor.planned_restarts") >= 1
+
+
+# ------------------------------------------------------------ chaos paths --
+
+
+def test_replica_sigkill_mid_batch_every_future_resolves(
+    tmp_path, monkeypatch, trees
+):
+    """frontdoor.rpc:kill on a replica's 3rd request, a burst in flight:
+    every future (including the ones mid-batch on the killed replica)
+    resolves bit-identically via failover; the supervisor respawns the
+    replica and the parent leaves a postmortem bundle for it."""
+    pm_dir = tmp_path / "postmortems"
+    monkeypatch.setenv("ETH_SPECS_OBS_POSTMORTEM_DIR", str(pm_dir))
+    replaced_before = _counter("frontdoor.replicas_replaced")
+    payloads = [trees[i % len(trees)] for i in range(10)]
+    direct = [
+        ops_merkle.merkleize_subtree_device(t, buckets.subtree_depth(t.shape[0]))
+        for t in payloads
+    ]
+    fd = FrontDoor(
+        replicas=2,
+        config=_serve_cfg(),
+        fd_config=_fd_cfg(),
+        replica_fault_spec=(
+            f"frontdoor.rpc:kill:nth=3:latch={tmp_path / 'kill.latch'}"
+        ),
+        name="fd-kill",
+    )
+    try:
+        futs = [fd.submit_hash_tree_root(t) for t in payloads]
+        got = [f.result(timeout=120) for f in futs]
+        assert got == direct  # zero lost, bit-identical through the kill
+        deadline = time.monotonic() + 15
+        while (
+            _counter("frontdoor.replicas_replaced") == replaced_before
+            and time.monotonic() < deadline
+        ):
+            time.sleep(0.1)
+        assert _counter("frontdoor.replicas_replaced") > replaced_before
+        # the replacement serves traffic again (routed, not host oracle)
+        assert fd.submit_hash_tree_root(payloads[0]).result(timeout=60) == direct[0]
+    finally:
+        fd.close()
+    bundles = sorted(pm_dir.glob("postmortem-*.json")) if pm_dir.exists() else []
+    lost = [
+        b for b in bundles if json.load(open(b))["trigger"] == "frontdoor.replica_lost"
+    ]
+    assert lost, f"no replica_lost postmortem bundle in {bundles}"
+    assert fd.admission.depth() == 0
+
+
+def test_hedged_failover_one_result_wins_no_double_release(tmp_path, trees):
+    """One replica stalls past the hedge deadline (exactly once, latch):
+    the hedge re-dispatches to the sibling, the first result wins, the
+    late duplicate is suppressed, and the admission slot releases
+    exactly once."""
+    hedges_before = _counter("frontdoor.hedges")
+    wins_before = _counter("frontdoor.hedge_wins")
+    dup_before = _counter("frontdoor.duplicates_suppressed")
+    stall_s = 2.0
+    fd = FrontDoor(
+        replicas=2,
+        config=_serve_cfg(),
+        fd_config=_fd_cfg(hedge_ms=120.0),
+        replica_fault_spec=(
+            f"frontdoor.rpc:stall:delay={stall_s}:latch={tmp_path / 'stall.latch'}"
+        ),
+        name="fd-hedge",
+    )
+    try:
+        direct = ops_merkle.merkleize_subtree_device(
+            trees[0], buckets.subtree_depth(trees[0].shape[0])
+        )
+        t0 = time.monotonic()
+        got = fd.submit_hash_tree_root(trees[0]).result(timeout=60)
+        elapsed = time.monotonic() - t0
+        assert got == direct
+        # the hedge beat the stall: well under the stall duration
+        assert elapsed < stall_s, f"hedge never rescued the request ({elapsed:.2f}s)"
+        assert _counter("frontdoor.hedges") > hedges_before
+        assert _counter("frontdoor.hedge_wins") > wins_before
+        # wait for the stalled primary's late reply: suppressed, slot
+        # NOT double-released
+        deadline = time.monotonic() + stall_s + 3
+        while (
+            _counter("frontdoor.duplicates_suppressed") == dup_before
+            and time.monotonic() < deadline
+        ):
+            time.sleep(0.1)
+        assert _counter("frontdoor.duplicates_suppressed") > dup_before
+        assert fd.admission.depth() == 0
+    finally:
+        fd.close()
+
+
+def test_gen_worker_routing_through_frontdoor(shared_fd, bls_items, monkeypatch):
+    """The gen-pool client mode: ETH_SPECS_SERVE_FRONTDOOR set, a
+    FrontDoorClient installs as the routed verifier and
+    utils/bls.FastAggregateVerify crosses the process boundary."""
+    fd, _, _ = shared_fd
+    monkeypatch.setenv("ETH_SPECS_SERVE_FRONTDOOR", ",".join(fd.addresses()))
+    pks, msg, sig = bls_items[0]
+    direct = bls.FastAggregateVerify(pks, msg, sig)
+    before = _counter("frontdoor.requests.bls")
+    client = serve.maybe_frontdoor_client(name="fd-worker-test")
+    assert client is not None
+    serve.install_routing(client)
+    try:
+        assert bls.FastAggregateVerify(pks, msg, sig) == direct
+        assert bls.FastAggregateVerify(*bls_items[2]) is False  # tampered
+    finally:
+        serve.uninstall_routing()
+        client.close()
+    assert _counter("frontdoor.requests.bls") - before == 2
