@@ -2,14 +2,15 @@
 
 PYTHON ?= python
 
-.PHONY: help install test test-fast lint reftests bytediff bench multichip postmortem serve_docs coverage clean
+.PHONY: help install test test-fast lint speclint reftests bytediff bench multichip postmortem serve_docs coverage clean
 
 help:
 	@echo "install    - editable install with test extras"
 	@echo "test       - FAST lane: suite minus @slow (CPU, 8 virtual devices)"
 	@echo "test-full  - everything incl. @slow (the nightly lane)"
 	@echo "test-slow  - only the @slow modules"
-	@echo "lint       - ruff check (if installed)"
+	@echo "lint       - ruff check (if installed) + speclint + env-docs diff"
+	@echo "speclint   - project-native static analysis only (docs/analysis.md)"
 	@echo "reftests   - emit test vectors to ./test_vectors"
 	@echo "bytediff   - conformance byte-diff vs the compiled reference spec"
 	@echo "bench      - run the driver benchmark"
@@ -55,8 +56,16 @@ mainnet-smoke:
 
 test-fast: test
 
+# ruff (style, best-effort) then speclint (project invariants, GATING:
+# fork-safety, lock-order, jit-purity, obs/env/fault registries —
+# docs/analysis.md); env-reference.md must match the env registry
 lint:
 	-$(PYTHON) -m ruff check eth_consensus_specs_tpu/ tests/
+	$(PYTHON) scripts/speclint.py
+	$(PYTHON) scripts/gen_env_docs.py --check
+
+speclint:
+	$(PYTHON) scripts/speclint.py
 
 reftests:
 	$(PYTHON) -m eth_consensus_specs_tpu.gen -o test_vectors -v

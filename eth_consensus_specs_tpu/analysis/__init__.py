@@ -1,0 +1,29 @@
+"""analysis — project-native static analysis (speclint) + runtime lock watch.
+
+Every scale PR so far shipped a review-hardening paragraph fixing the
+same bug classes by hand: PR 6 needed ``os.register_at_fork`` hooks
+because the gen pool forked children holding supervisor locks, PR 3
+moved ``_H2G2_CACHE`` mutations under a lock, PR 4 deleted a deque
+reservoir that mutated under a lock. This package machine-checks those
+invariants so the next subsystem inherits them instead of re-learning
+them:
+
+  * ``analysis.lint`` — an AST lint engine with project-native rules
+    (fork-safety, blocking-under-lock, lock-order, jit-purity,
+    obs-discipline, env-registry, fault-site-registry), inline
+    ``# speclint: disable=<rule>`` suppressions, and a ratcheting
+    baseline. ``scripts/speclint.py`` is the CLI; CI gates zero
+    non-baselined findings.
+  * ``analysis.lockwatch`` — the runtime counterpart of the static
+    lock-order rule: an opt-in (``ETH_SPECS_ANALYSIS_LOCKWATCH=1``)
+    instrumented-lock wrapper that records per-thread acquisition
+    orders and flags inversions observed live, cross-checking the
+    static graph during tier-1 and serve_bench.
+
+See docs/analysis.md for the rule table and the PR-history bug each
+rule encodes.
+"""
+
+from __future__ import annotations
+
+from . import lockwatch  # noqa: F401  (public submodule; import-light)

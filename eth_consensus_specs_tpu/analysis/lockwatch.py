@@ -1,0 +1,268 @@
+"""Runtime lock-order watchdog — the live counterpart of the static
+``lock-order`` lint rule.
+
+Static analysis sees every *possible* nesting; it cannot see orders
+that only materialize through callbacks, thread hand-offs, or dynamic
+dispatch. This module watches the orders that actually happen:
+
+  * ``wrap(lock, "serve.admission.AdmissionController._lock")`` returns
+    the raw lock unchanged unless ``ETH_SPECS_ANALYSIS_LOCKWATCH=1`` —
+    the disabled hot path costs nothing, not even an attribute hop;
+  * when enabled, the returned :class:`WatchedLock` records a
+    per-thread held stack and, on every acquisition of B while holding
+    A, the edge ``A -> B``. The FIRST time the reverse edge of an
+    already-seen edge appears — from any thread — it is an
+    **inversion**: ``lockwatch.inversions`` is bumped and a
+    ``lockwatch.inversion`` event carries both edges' thread names and
+    call sites. Two threads running those orders concurrently is the
+    textbook ABBA deadlock; seeing both orders live, even sequentially,
+    means the schedule exists;
+  * lock names deliberately share the static rule's identity namespace
+    (``<module>.<NAME>`` / ``<module>.<Class>.<attr>``), so
+    :func:`edges` can be diffed directly against
+    ``analysis.lint.build_lock_graph`` — tier-1 and serve_bench assert
+    the union stays acyclic (runtime confirms the static order, static
+    explains the runtime one).
+
+The obs registry / flight / histogram locks are NOT wrapped: they are
+terminal by design (they never acquire another lock while held — the
+static rule proves it), and the watch tap itself reports through them,
+so wrapping them would recurse. Everything above that floor — fault,
+serve, ops caches — wraps its locks through :func:`wrap`.
+
+Condition variables wrap their *inner* lock:
+``threading.Condition(wrap(threading.RLock(), name))`` — ``wait()``
+releases through the wrapper (the full ``_release_save`` protocol), so
+the held stack stays truthful across a wait.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+
+_ENV = "ETH_SPECS_ANALYSIS_LOCKWATCH"
+
+_WATCH_LOCK = threading.Lock()  # guards the edge/inversion tables only
+_EDGES: dict[tuple[str, str], int] = {}
+_EDGE_SITES: dict[tuple[str, str], str] = {}
+_INVERSIONS: list[dict] = []
+_ACQUISITIONS = 0
+_TLS = threading.local()
+
+
+def _reinit_after_fork_in_child() -> None:
+    # same contract as every other module lock in this repo (the
+    # fork-safety rule's own discipline applies here first)
+    global _WATCH_LOCK, _TLS
+    _WATCH_LOCK = threading.Lock()
+    _TLS = threading.local()
+
+
+os.register_at_fork(after_in_child=_reinit_after_fork_in_child)
+
+
+def enabled() -> bool:
+    return os.environ.get(_ENV, "0") not in ("0", "false", "")
+
+
+def _held() -> list[str]:
+    stack = getattr(_TLS, "stack", None)
+    if stack is None:
+        stack = _TLS.stack = []
+    return stack
+
+
+def _note_acquired(name: str) -> None:
+    global _ACQUISITIONS
+    stack = _held()
+    inversion = None
+    with _WATCH_LOCK:
+        _ACQUISITIONS += 1
+        # a reentrant RLock acquire anywhere in the held stack is not an
+        # edge: it cannot block (the lock is already owned), so it can
+        # never participate in a deadlock schedule
+        if stack and name not in stack:
+            edge = (stack[-1], name)
+            _EDGES[edge] = _EDGES.get(edge, 0) + 1
+            if edge not in _EDGE_SITES:
+                _EDGE_SITES[edge] = threading.current_thread().name
+            rev = (name, stack[-1])
+            if rev in _EDGES and _EDGES[edge] == 1:
+                inversion = {
+                    "edge": f"{edge[0]} -> {edge[1]}",
+                    "reverse": f"{rev[0]} -> {rev[1]}",
+                    "thread": threading.current_thread().name,
+                    "reverse_thread": _EDGE_SITES.get(rev, "?"),
+                }
+                _INVERSIONS.append(inversion)
+    stack.append(name)
+    if inversion is not None:
+        # report OUTSIDE the watch lock: the obs registry lock is a leaf
+        # lock and must never nest under ours
+        from eth_consensus_specs_tpu import obs
+
+        obs.count("lockwatch.inversions", 1)
+        obs.event("lockwatch.inversion", **inversion)
+
+
+def _note_released(name: str) -> None:
+    stack = _held()
+    # remove the LAST occurrence: Condition.wait releases out of LIFO
+    # order relative to locks taken after the condition was entered
+    for i in range(len(stack) - 1, -1, -1):
+        if stack[i] == name:
+            del stack[i]
+            break
+
+
+class WatchedLock:
+    """Order-tracking proxy over a ``threading.Lock``/``RLock``. Exposes
+    the subset of the lock API this codebase (and ``Condition``) uses."""
+
+    __slots__ = ("_lock", "name")
+
+    def __init__(self, lock, name: str):
+        self._lock = lock
+        self.name = name
+
+    def acquire(self, blocking: bool = True, timeout: float = -1) -> bool:
+        got = self._lock.acquire(blocking, timeout)
+        if got:
+            _note_acquired(self.name)
+        return got
+
+    def release(self) -> None:
+        self._lock.release()
+        _note_released(self.name)
+
+    def locked(self) -> bool:
+        return self._lock.locked()
+
+    def __enter__(self) -> "WatchedLock":
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc) -> bool:
+        self.release()
+        return False
+
+    # Condition-variable protocol: threading.Condition prefers these
+    # over plain acquire/release when present, and an RLock inner needs
+    # them to release EVERY recursion level across a wait(). The held
+    # stack drops all levels of this name on save and restores them on
+    # reacquire, so orders observed across a wait stay truthful.
+
+    def _release_save(self):
+        inner = getattr(self._lock, "_release_save", None)
+        if inner is not None:
+            state = inner()
+        else:
+            self._lock.release()
+            state = None
+        stack = _held()
+        levels = stack.count(self.name)
+        for _ in range(levels):
+            _note_released(self.name)
+        return (state, levels)
+
+    def _acquire_restore(self, saved) -> None:
+        state, levels = saved
+        inner = getattr(self._lock, "_acquire_restore", None)
+        if inner is not None and state is not None:
+            inner(state)
+        else:
+            self._lock.acquire()
+        if levels:
+            _note_acquired(self.name)  # the reacquire can form new edges
+            _held().extend([self.name] * (levels - 1))
+
+    def _is_owned(self) -> bool:
+        inner = getattr(self._lock, "_is_owned", None)
+        if inner is not None:
+            return inner()
+        if self._lock.acquire(False):
+            self._lock.release()
+            return False
+        return True
+
+
+def wrap(lock, name: str):
+    """Instrument `lock` under its static-graph identity; a no-op
+    passthrough (returns `lock` itself) unless the watchdog env knob is
+    on — creation sites call this unconditionally."""
+    if not enabled():
+        return lock
+    return WatchedLock(lock, name)
+
+
+# --------------------------------------------------------------- reporting --
+
+
+def edges() -> dict[tuple[str, str], int]:
+    with _WATCH_LOCK:
+        return dict(_EDGES)
+
+
+def inversions() -> list[dict]:
+    with _WATCH_LOCK:
+        return list(_INVERSIONS)
+
+
+def acquisitions() -> int:
+    with _WATCH_LOCK:
+        return _ACQUISITIONS
+
+
+def reset() -> None:
+    global _ACQUISITIONS
+    with _WATCH_LOCK:
+        _EDGES.clear()
+        _EDGE_SITES.clear()
+        _INVERSIONS.clear()
+        _ACQUISITIONS = 0
+    _TLS.stack = []
+
+
+def publish() -> None:
+    """Fold the watch totals into the obs registry (gauges — lazy, so
+    the per-acquisition hot path never pays an obs call): run epilogues
+    (serve_bench, the pytest obs plugin) call this once, making the
+    acquisition/edge counts visible in snapshots and expositions next
+    to the live ``lockwatch.inversions`` counter."""
+    if not enabled():
+        return
+    from eth_consensus_specs_tpu import obs
+
+    with _WATCH_LOCK:
+        acq, nedges = _ACQUISITIONS, len(_EDGES)
+    obs.gauge("lockwatch.acquisitions", acq)
+    obs.gauge("lockwatch.edges", nedges)
+
+
+def report() -> dict:
+    """Snapshot for gates and the serve_bench report: edge list, counts,
+    inversion details."""
+    with _WATCH_LOCK:
+        return {
+            "enabled": enabled(),
+            "acquisitions": _ACQUISITIONS,
+            "edges": {f"{a} -> {b}": n for (a, b), n in sorted(_EDGES.items())},
+            "inversions": list(_INVERSIONS),
+        }
+
+
+def check_against_static(static_edges) -> dict:
+    """Cross-check: the union of the static graph and the live edges
+    must stay acyclic — a live edge whose reverse is statically
+    derivable (or vice versa) is a deadlock schedule the other analysis
+    alone could not prove. Returns {"ok": bool, "cycles": [...]}."""
+    from . import lint
+
+    union: dict[tuple[str, str], list] = {}
+    for (a, b), locs in dict(static_edges).items():
+        union[(a, b)] = list(locs) if isinstance(locs, list) else [locs]
+    for (a, b), n in edges().items():
+        union.setdefault((a, b), []).append(("runtime", n))
+    cycles = lint.find_cycles(union)
+    return {"ok": not cycles, "cycles": cycles}

@@ -1,0 +1,189 @@
+"""envreg — the single registry of every ``ETH_SPECS_*`` environment knob.
+
+Forty-plus env vars steer this codebase; before this registry they were
+documented in three hand-maintained tables (docs/observability.md,
+docs/serving.md, docs/robustness.md) that nothing diffed against the
+code — a renamed or added knob silently rotted out of the operator's
+view. Now:
+
+  * every ``os.environ`` read of an ``ETH_SPECS_*`` name must have a
+    declaration here — the ``env-registry`` speclint rule
+    (analysis/lint.py) fails on undeclared reads AND on stale
+    declarations nothing reads;
+  * ``scripts/gen_env_docs.py`` generates docs/env-reference.md (the
+    one table) from this registry; CI diffs generated vs committed, so
+    the docs literally cannot drift;
+  * the three per-subsystem docs pages link into the generated table
+    instead of maintaining their own copies.
+
+``default`` is the human-readable effective default (what an unset var
+behaves like), not necessarily a parseable literal. ``anchor`` is the
+docs page whose prose explains the knob in context.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+
+@dataclass(frozen=True)
+class EnvVar:
+    name: str
+    default: str
+    description: str
+    anchor: str  # docs page (with optional #fragment) that explains it
+
+
+def _v(name: str, default: str, description: str, anchor: str) -> EnvVar:
+    return EnvVar(name, default, description, anchor)
+
+
+ENV_VARS: tuple[EnvVar, ...] = (
+    # -------------------------------------------------------------- obs --
+    _v("ETH_SPECS_OBS", "1",
+       "`0` disables all obs recording (read once at import; "
+       "`obs.registry.refresh_enabled()` re-reads)", "observability.md"),
+    _v("ETH_SPECS_OBS_WATCHDOG", "0.05",
+       "divergence-watchdog sampling rate in [0, 1]; `0` off, `1` checks every "
+       "call; the first call per kernel per process is always checked",
+       "observability.md#divergence-watchdog"),
+    _v("ETH_SPECS_OBS_JSONL", "unset",
+       "stream structured events (spans, divergences, gen part digests) as "
+       "JSON lines to this path", "observability.md"),
+    _v("ETH_SPECS_OBS_REPORT", "`<rootdir>/obs_report.json`",
+       "pytest run-level report destination; `0`/empty disables",
+       "observability.md#reading-obs_reportjson"),
+    _v("ETH_SPECS_OBS_PROM", "unset",
+       "Prometheus textfile destination (written atomically by the pytest "
+       "plugin and serve_bench at session end)",
+       "observability.md#metrics-exposition-prometheus"),
+    _v("ETH_SPECS_OBS_HTTP_PORT", "unset",
+       "serve `GET /metrics` on 127.0.0.1:port (stdlib, daemon threads; `0` = "
+       "ephemeral port)", "observability.md#metrics-exposition-prometheus"),
+    _v("ETH_SPECS_OBS_POSTMORTEM_DIR", "unset",
+       "flight-recorder bundle directory; unset makes every postmortem dump a "
+       "no-op", "observability.md#flight-recorder"),
+    _v("ETH_SPECS_OBS_FLIGHT", "512",
+       "flight ring capacity (entries); `0` disables the ring",
+       "observability.md#flight-recorder"),
+    _v("ETH_SPECS_OBS_FLIGHT_COUNTER_FLOOR", "65536",
+       "smallest counter increment that becomes a flight-ring entry",
+       "observability.md#flight-recorder"),
+    _v("ETH_SPECS_OBS_XPROF", "0",
+       "`1` enables ambient XLA attribution capture on the instrumented "
+       "kernels (AOT compile ≈ doubles per-shape compile cost)",
+       "observability.md#compile--memory-attribution-xprof"),
+    _v("ETH_SPECS_OBS_XPROF_TOL", "0.25",
+       "cost-model rel-err tolerance before `xprof.cost_model_mismatch` fires",
+       "observability.md#compile--memory-attribution-xprof"),
+    _v("ETH_SPECS_SLO_WAIT_P99_MS", "250",
+       "`serve_wait_p99` SLO bound, milliseconds", "observability.md#slos"),
+    _v("ETH_SPECS_SLO_DEGRADED_RATE", "0.01",
+       "`degraded_rate` SLO bound (`serve.degraded_items` per serve request)",
+       "observability.md#slos"),
+    # ------------------------------------------------------------ serve --
+    _v("ETH_SPECS_SERVE", "off",
+       "`1`: gen pool workers route BLS verifies through a per-worker service "
+       "(or the shared front door when `ETH_SPECS_SERVE_REPLICAS` > 0)",
+       "serving.md#tuning-knobs"),
+    _v("ETH_SPECS_SERVE_MAX_BATCH", "64",
+       "size-flush threshold / largest bucket", "serving.md#tuning-knobs"),
+    _v("ETH_SPECS_SERVE_MAX_WAIT_MS", "5",
+       "deadline-flush latency bound", "serving.md#tuning-knobs"),
+    _v("ETH_SPECS_SERVE_MAX_QUEUE", "1024",
+       "admission cap, queued + in-flight requests", "serving.md#tuning-knobs"),
+    _v("ETH_SPECS_SERVE_MAX_BYTES", "64 MiB",
+       "admission cap, in-flight payload bytes", "serving.md#tuning-knobs"),
+    _v("ETH_SPECS_SERVE_PRESSURE", "0.5",
+       "pressure-flush fraction of `MAX_QUEUE`", "serving.md#tuning-knobs"),
+    _v("ETH_SPECS_SERVE_BUCKETS", "1,2,…,64",
+       "pow2 batch-count buckets", "serving.md#tuning-knobs"),
+    _v("ETH_SPECS_SERVE_WARMUP", "unset",
+       "persistent compiled-shape list (JSONL); `precompile()` replays it",
+       "serving.md#tuning-knobs"),
+    _v("ETH_SPECS_SERVE_IDLE_FLUSH", "off",
+       "`1`: flush immediately when the dispatch pipeline is idle (single "
+       "synchronous submitter; gen workers enable it automatically)",
+       "serving.md#tuning-knobs"),
+    _v("ETH_SPECS_SERVE_REPLICAS", "0",
+       ">0: run R supervised replica processes behind the front door (gen "
+       "pool boots one fleet for all workers)",
+       "serving.md#replicated-front-door"),
+    _v("ETH_SPECS_SERVE_FRONTDOOR", "unset",
+       "comma-separated `host:port` replica addresses — client mode (exported "
+       "by the owner for its workers)", "serving.md#replicated-front-door"),
+    _v("ETH_SPECS_SERVE_HEDGE_MS", "250",
+       "hedge deadline: re-dispatch an idempotent submit to a sibling past it "
+       "(`0` disables hedging)", "serving.md#replicated-front-door"),
+    _v("ETH_SPECS_SERVE_RPC_TIMEOUT_S", "60",
+       "hard per-RPC timeout; past it the replica is failed over",
+       "serving.md#replicated-front-door"),
+    _v("ETH_SPECS_SERVE_PROBE_MS", "200",
+       "supervisor health-probe / SLO-window interval",
+       "serving.md#replicated-front-door"),
+    _v("ETH_SPECS_SERVE_FD_CONCURRENCY", "16",
+       "front-door dispatcher threads", "serving.md#replicated-front-door"),
+    _v("ETH_SPECS_SERVE_SLO_SHED", "on",
+       "`0`: disable SLO-driven admission resizing (static caps only)",
+       "serving.md#replicated-front-door"),
+    # ------------------------------------------------------------ fault --
+    _v("ETH_SPECS_FAULT", "unset",
+       "deterministic fault-injection spec: `site:mode[:key=value...]` rules "
+       "joined by `;` (modes raise/kill/stall/corrupt)",
+       "robustness.md#fault-spec-grammar"),
+    # --------------------------------------------------------- analysis --
+    _v("ETH_SPECS_ANALYSIS_LOCKWATCH", "0",
+       "`1`: wrap project locks in the runtime lock-order watchdog "
+       "(acquisition-order edges, inversion counters, static-graph "
+       "cross-check)", "analysis.md#runtime-lock-order-watchdog"),
+    # ----------------------------------------------------------- kernels --
+    _v("ETH_SPECS_TPU_NO_NATIVE", "0",
+       "`1`: skip the native (CFFI) BLS fast paths, pure-python/device only",
+       "tpu.md"),
+    _v("ETH_SPECS_TPU_DEVICE_H2C", "0",
+       "`1`: prime hash-to-G2 through the batched device kernel (host "
+       "fallback per miss)", "tpu.md"),
+    _v("ETH_SPECS_TPU_DEVICE_PAIRING", "0",
+       "`1`: force DEVICE pairing even when the bls backend switch is "
+       "elsewhere (bench hybrid mode)", "tpu.md"),
+    _v("ETH_SPECS_TPU_NO_DEVICE_PAIRING", "0",
+       "`1`: force HOST pairing even under the tpu backend (XLA:CPU fallback "
+       "benches)", "tpu.md"),
+    _v("ETH_SPECS_TPU_OBJECT_EPOCH", "0",
+       "`1`: route epoch accounting through the object-mode reference path "
+       "instead of the columnar kernel", "tpu.md"),
+    # ------------------------------------------------------------- misc --
+    _v("ETH_SPECS_ALLOW_UNPINNED", "0",
+       "`1`: allow building spec modules from unpinned reference markdown "
+       "(development only)", "testing.md"),
+    _v("ETH_SPECS_REFERENCE", "unset",
+       "path to a reference consensus-specs checkout for specc compilation",
+       "testing.md"),
+    _v("ETH_SPECS_BENCH_CPU_TIMEOUT", "120",
+       "bench section budget on CPU, seconds", "tpu.md"),
+    _v("ETH_SPECS_BENCH_ACC_TIMEOUT", "600",
+       "bench section budget on accelerators, seconds", "tpu.md"),
+    _v("ETH_SPECS_BENCH_VERIFY_TIMEOUT", "60",
+       "bench correctness-verification budget, seconds", "tpu.md"),
+)
+
+
+def by_name() -> dict[str, EnvVar]:
+    return {v.name: v for v in ENV_VARS}
+
+
+def names() -> set[str]:
+    return {v.name for v in ENV_VARS}
+
+
+def markdown_table(prefix: str | None = None) -> str:
+    """The generated reference table (docs/env-reference.md body).
+    ``prefix`` narrows to one subsystem (e.g. ``ETH_SPECS_SERVE``)."""
+    rows = [v for v in ENV_VARS if prefix is None or v.name.startswith(prefix)]
+    out = ["| variable | default | meaning | details |", "|---|---|---|---|"]
+    for v in sorted(rows, key=lambda v: v.name):
+        out.append(
+            f"| `{v.name}` | {v.default} | {v.description} | "
+            f"[{v.anchor.split('#')[0].removesuffix('.md')}]({v.anchor}) |"
+        )
+    return "\n".join(out) + "\n"

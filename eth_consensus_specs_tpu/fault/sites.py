@@ -1,0 +1,97 @@
+"""fault/sites — the registry of every fault-injection site.
+
+A fault site is a contract: "this seam can fail, and something proves
+the system survives it." Before this registry the site strings lived
+only at their ``fault.check(...)`` call sites and in the docs failure
+matrix, with nothing keeping the three views consistent. Now:
+
+  * every literal passed to ``fault.check`` / ``fault.corrupt`` (and
+    every ``site=`` keyword at the wire layer) must be declared here —
+    the ``fault-site-registry`` speclint rule fails on undeclared
+    sites;
+  * every declared site must be *referenced* by a chaos test or the
+    docs failure matrix (the rule's project-level completeness check) —
+    an injection point nothing exercises is a dead invariant;
+  * docs/robustness.md's instrumented-sites list links here.
+
+``exercised_by`` is the human pointer to the chaos coverage; the lint
+rule independently verifies the site string appears under tests/ or
+docs/.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+
+@dataclass(frozen=True)
+class FaultSite:
+    name: str
+    description: str
+    modes: tuple[str, ...]  # modes that are meaningful at this seam
+    exercised_by: str  # chaos test / docs failure-matrix pointer
+
+
+_S = FaultSite
+
+SITES: dict[str, FaultSite] = {
+    s.name: s
+    for s in (
+        _S(
+            "gen.case",
+            "before each generation case executes on a pool worker",
+            ("raise", "kill", "stall"),
+            "tests/test_gen_faults.py, scripts/chaos_smoke.py",
+        ),
+        _S(
+            "gen.dump_bytes",
+            "the compressed frame of each .ssz_snappy write (read-back "
+            "verification must catch the flip)",
+            ("corrupt",),
+            "tests/test_gen_faults.py",
+        ),
+        _S(
+            "state_root.device",
+            "the device state-root kernel's eager entry point (raise "
+            "triggers bit-exact host degradation)",
+            ("raise", "stall"),
+            "tests/test_fault.py",
+        ),
+        _S(
+            "block_epoch.device",
+            "the device block/epoch chain kernel's eager entry point",
+            ("raise", "stall"),
+            "tests/test_fault.py",
+        ),
+        _S(
+            "serve.dispatch",
+            "the verification service's batched device dispatch (raise "
+            "degrades the whole in-flight batch to host oracles)",
+            ("raise", "stall"),
+            "tests/test_serve.py",
+        ),
+        _S(
+            "frontdoor.rpc",
+            "the replica socket boundary: stall misses the hedge deadline, "
+            "kill SIGKILLs the replica mid-batch, corrupt flips a framed "
+            "payload byte after its digest (must be detected, never accepted)",
+            ("raise", "kill", "stall", "corrupt"),
+            "tests/test_frontdoor.py, scripts/serve_bench.py --chaos",
+        ),
+        _S(
+            "frontdoor.rpc.admin",
+            "replica admin replies (health/drain/shutdown) — a separate "
+            "site so chaos on the request path cannot corrupt supervision",
+            ("corrupt",),
+            "docs/robustness.md failure matrix",
+        ),
+    )
+}
+
+
+def declared(name: str) -> bool:
+    return name in SITES
+
+
+def names() -> set[str]:
+    return set(SITES)

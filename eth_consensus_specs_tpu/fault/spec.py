@@ -41,6 +41,7 @@ from contextlib import contextmanager
 from dataclasses import dataclass
 
 from eth_consensus_specs_tpu import obs
+from eth_consensus_specs_tpu.analysis import lockwatch
 
 _MODES = ("raise", "kill", "stall", "corrupt")
 
@@ -74,7 +75,7 @@ class FaultRule:
         return self.nth <= self.hits < self.nth + self.times
 
 
-_LOCK = threading.Lock()
+_LOCK = lockwatch.wrap(threading.Lock(), "fault.spec._LOCK")
 _RULES: list[FaultRule] = []
 
 
@@ -83,7 +84,7 @@ def _reinit_lock_after_fork_in_child() -> None:
     # front-door dispatcher among them); a fork mid-check must not hand
     # the child a lock held by a thread that doesn't exist there
     global _LOCK
-    _LOCK = threading.Lock()
+    _LOCK = lockwatch.wrap(threading.Lock(), "fault.spec._LOCK")
 
 
 os.register_at_fork(after_in_child=_reinit_lock_after_fork_in_child)

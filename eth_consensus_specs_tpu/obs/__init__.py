@@ -27,7 +27,11 @@ Export/attribution layer on top (this PR's tentpole):
     process boundaries; spans under an active context carry
     trace_id/span_id/parent_span in their events;
   * ``obs.export`` — Prometheus text exposition of the full snapshot
-    (textfile and/or stdlib HTTP ``/metrics``);
+    (textfile and/or stdlib HTTP ``/metrics``); ``validate_text``
+    rejects families absent from the central metric catalog
+    (``obs/catalog.py`` — every counter/gauge/histogram/span name is
+    declared there once, enforced by the ``obs-discipline`` speclint
+    rule, docs/analysis.md);
   * ``obs.slo`` — declarative SLOs evaluated from any snapshot.
 
 Postmortem/attribution layer (obs/flight.py + obs/xprof.py):

@@ -1,0 +1,246 @@
+"""Central metric catalog: every counter/gauge/histogram/span name.
+
+Declaring names in one place buys two machine checks the hand-maintained
+way kept losing:
+
+  * the ``obs-discipline`` speclint rule (analysis/lint.py) fails the
+    build when code emits a metric name absent from this catalog — new
+    instrumentation lands HERE first, with a help string, where a
+    reviewer and a dashboard can see it;
+  * :func:`eth_consensus_specs_tpu.obs.export.validate_text` rejects
+    expositions containing families this catalog doesn't know — a
+    renamed counter breaks CI instead of silently orphaning every
+    recording rule and SLO that referenced the old name.
+
+A ``*`` segment matches one or more name characters (``watchdog.*.checks``
+covers ``watchdog.sha256.checks``); patterns exist for the families that
+are keyed by kernel/op/site at runtime. The ``t.*`` / ``test.*``
+namespaces are sanctioned scratch space for tests — production code may
+not emit into them (the lint rule has no such carve-out; only the
+exposition validator does).
+"""
+
+from __future__ import annotations
+
+import re
+from dataclasses import dataclass
+
+
+@dataclass(frozen=True)
+class Metric:
+    kind: str  # "counter" | "gauge" | "histogram" | "span"
+    name: str  # dotted obs name; '*' segments are runtime-keyed
+    help: str
+
+
+def _c(name: str, help: str) -> Metric:
+    return Metric("counter", name, help)
+
+
+def _g(name: str, help: str) -> Metric:
+    return Metric("gauge", name, help)
+
+
+def _h(name: str, help: str) -> Metric:
+    return Metric("histogram", name, help)
+
+
+def _s(name: str, help: str) -> Metric:
+    return Metric("span", name, help)
+
+
+CATALOG: tuple[Metric, ...] = (
+    # ------------------------------------------------------------ kernels --
+    _c("sha256.compressions", "sha256 compression function evaluations"),
+    _c("sha256.dispatches", "device sha256 kernel dispatches"),
+    _c("sha256.messages", "messages hashed through the tiled kernel"),
+    _s("sha256.tiled", "tiled device sha256 dispatch"),
+    _c("merkle.leaf_chunks", "leaf chunks merkleized"),
+    _c("merkle.real_hashes", "non-padding hashes in merkle trees"),
+    _c("merkle.trees", "merkle trees computed"),
+    _s("merkle.subtree_root", "single-tree device merkleization"),
+    _s("merkle.many_subtree_root", "vmapped multi-tree device merkleization"),
+    _c("shuffle.decision_hashes", "swap-or-not decision hashes"),
+    _c("shuffle.lanes", "shuffle lanes processed"),
+    _c("shuffle.permutations", "full committee permutations"),
+    _s("shuffle.permutation", "device shuffle permutation"),
+    _c("state_root.real_hashes", "hashes in post-epoch state roots"),
+    _c("state_root.roots", "post-epoch state roots computed"),
+    _c("state_root.traces", "state-root kernel (re)traces"),
+    _s("state_root.post_epoch", "device post-epoch state root"),
+    _s("state_root.post_epoch_host", "host-oracle post-epoch state root"),
+    _c("block_epoch.blocks_ingested", "blocks ingested into the chain kernel"),
+    _c("block_epoch.epochs", "epoch transitions in block_epoch chains"),
+    _c("block_epoch.ingests", "block_epoch ingest calls"),
+    _c("block_epoch.slots", "slots advanced in block_epoch chains"),
+    _c("block_epoch.traces", "block_epoch kernel (re)traces"),
+    _c("block_epoch.validator_slots", "validator-slots processed"),
+    _s("block_epoch.chain", "device block/epoch chain run"),
+    _s("block_epoch.chain_host", "host-oracle block/epoch chain run"),
+    # ---------------------------------------------------------------- bls --
+    _c("bls.batch_items", "items in batched aggregate verifications"),
+    _c("bls.batches", "batched aggregate verification calls"),
+    _c("bls.fast_aggregate_verifies", "FastAggregateVerify calls"),
+    _c("bls.messages_distinct", "distinct messages across a batch"),
+    _c("bls.pairing_inputs", "pairing inputs accumulated"),
+    _c("bls.pairings", "pairing evaluations"),
+    _c("bls.pubkeys_aggregated", "pubkeys aggregated"),
+    _c("bls.verify_many_items", "items through verify_many"),
+    _s("bls.batch_verify", "batched RLC aggregate verification"),
+    _s("bls.fast_aggregate_verify", "single FastAggregateVerify"),
+    _s("bls.verify_many", "multi-item verify_many with bisection"),
+    # ------------------------------------------------------------- fault --
+    _c("fault.degraded", "device->host degradations"),
+    _c("fault.degraded.*", "degradations per site"),
+    _c("fault.injected", "injected faults fired"),
+    _c("fault.retries", "fault.retrying attempts"),
+    # --------------------------------------------------------------- gen --
+    _c("gen.bytes_serialized", "vector bytes serialized"),
+    _c("gen.cases_*", "case outcomes by status (written/failed/skipped/...)"),
+    _c("gen.parts", "vector parts written"),
+    _c("gen.result_stream_errors", "malformed worker result frames"),
+    _c("gen.torn_writes", "read-back-verification catches"),
+    _c("gen.workers_recycled", "pool workers recycled at case cap"),
+    _c("gen.workers_replaced", "dead/hung pool workers respawned"),
+    _s("gen.case", "one generation case"),
+    # --------------------------------------------------------- multihost --
+    _c("multihost.init_failures", "jax.distributed init failures"),
+    _c("multihost.initializations", "jax.distributed initializations"),
+    _c("multihost.meshes_flat", "flat device meshes built"),
+    _c("multihost.meshes_hybrid", "hybrid device meshes built"),
+    _c("multihost.processes", "processes seen at mesh build"),
+    _s("multihost.initialize", "jax.distributed initialization"),
+    # ------------------------------------------------------------- serve --
+    _c("serve.batch_items", "requests across all flushes"),
+    _c("serve.cancelled", "futures cancelled by callers"),
+    _c("serve.compiles", "first dispatches of a new bucket shape"),
+    _c("serve.compiles_after_warmup", "bucket compiles after the warmup phase"),
+    _c("serve.degraded_items", "requests served by host oracles"),
+    _c("serve.flushes", "micro-batcher flushes"),
+    _c("serve.flush.*", "flushes by reason (size/deadline/pressure/idle/close)"),
+    _c("serve.precompiled", "bucket shapes warmed by precompile()"),
+    _c("serve.rejected", "admission sheds"),
+    _c("serve.rejected.*", "admission sheds by reason (queue/bytes)"),
+    _c("serve.requests", "submits admitted"),
+    _c("serve.requests.*", "submits by kind (bls/htr/state_root)"),
+    _g("serve.in_flight_bytes", "admitted payload bytes in flight"),
+    _g("serve.queue_depth", "admitted requests queued + in flight"),
+    _h("serve.compile_ms", "first-dispatch compile wall ms"),
+    _h("serve.compile_ms.*", "first-dispatch compile wall ms per op"),
+    _h("serve.wait_ms", "request wait from submit to flush, ms"),
+    _s("serve.dispatch", "one batched device dispatch"),
+    # --------------------------------------------------------- frontdoor --
+    _c("frontdoor.backoffs", "router backoffs honored"),
+    _c("frontdoor.cancelled", "front-door futures cancelled"),
+    _c("frontdoor.corrupt_frames", "corrupt frames detected at the wire"),
+    _c("frontdoor.corrupt_retries", "corrupt-frame resends"),
+    _c("frontdoor.degraded_to_host", "requests served by the front-door host oracle"),
+    _c("frontdoor.duplicates_suppressed", "hedge duplicates suppressed"),
+    _c("frontdoor.failovers", "requests failed over to a sibling"),
+    _c("frontdoor.hedge_abandoned", "hedge legs abandoned (primary owns the slot)"),
+    _c("frontdoor.hedge_wins", "hedge legs that resolved first"),
+    _c("frontdoor.hedges", "hedged re-dispatches launched"),
+    _c("frontdoor.planned_restarts", "zero-shed drain rollovers"),
+    _c("frontdoor.probe_failures", "supervisor health-probe failures"),
+    _c("frontdoor.replicas_replaced", "dead replicas respawned"),
+    _c("frontdoor.replies_dropped", "replica replies to vanished callers"),
+    _c("frontdoor.request_errors", "typed application errors returned"),
+    _c("frontdoor.requests", "front-door submits"),
+    _c("frontdoor.requests.*", "front-door submits by kind"),
+    _c("frontdoor.respawn_failures", "replica respawn attempts that failed"),
+    _c("frontdoor.route.affinity", "requests routed to their shape-affine replica"),
+    _c("frontdoor.route.fallback", "requests routed past their affine replica"),
+    _c("frontdoor.slo_sheds", "SLO-driven admission shrinks"),
+    _g("frontdoor.effective_max_queue", "SLO-adjusted admission cap"),
+    _h("frontdoor.e2e_ms", "front-door end-to-end latency, ms"),
+    _s("frontdoor.rpc", "one framed RPC at the replica boundary"),
+    # ---------------------------------------------------------- watchdog --
+    _c("watchdog.checks", "device/host divergence probes"),
+    _c("watchdog.divergences", "device/host mismatches"),
+    _c("watchdog.*.checks", "divergence probes per kernel"),
+    _c("watchdog.*.divergences", "mismatches per kernel"),
+    # ------------------------------------------------------------- xprof --
+    _c("xprof.analysis_unavailable", "XLA analyses missing on this backend"),
+    _c("xprof.cost_model_mismatch", "hand work_bytes outside tolerance of XLA"),
+    _c("xprof.cost_model_mismatch.*", "cost-model mismatches per kernel"),
+    _g("xprof.*.*", "per-kernel XLA cost/memory attribution (flops, bytes_accessed, peak_bytes, ...)"),
+    _h("xprof.compile_ms", "AOT compile wall ms"),
+    _h("xprof.compile_ms.*", "AOT compile wall ms per kernel"),
+    # ------------------------------------------------------------ flight --
+    _c("flight.dumps", "postmortem bundles written"),
+    # ---------------------------------------------------------- lockwatch --
+    _c("lockwatch.inversions", "live lock-order inversions observed"),
+    _g("lockwatch.acquisitions", "watched-lock acquisitions (published at epilogue)"),
+    _g("lockwatch.edges", "distinct live lock-order edges (published at epilogue)"),
+    # ------------------------------------------------------- cross-cutting --
+    _c("*.bytes_moved", "device traffic attributed via obs.bytes_moved"),
+)
+
+# test scratch namespaces: allowed in EXPOSITIONS (tests write through the
+# global registry on purpose), never emitted by package code (the lint
+# rule checks package code against CATALOG alone)
+_TEST_NAMESPACES = ("t.", "test.")
+
+_BY_KIND: dict[str, list[Metric]] = {}
+for _m in CATALOG:
+    _BY_KIND.setdefault(_m.kind, []).append(_m)
+
+
+def _pattern_re(name: str) -> re.Pattern:
+    rx = "".join(
+        re.escape(c) if c != "*" else r"[a-z0-9_.]+" for c in name
+    )
+    return re.compile("^" + rx + "$")
+
+
+_KIND_RES: dict[str, list[re.Pattern]] = {
+    kind: [_pattern_re(m.name) for m in ms] for kind, ms in _BY_KIND.items()
+}
+
+
+def declared(kind: str, name: str) -> bool:
+    """Is `name` (possibly with '*' placeholders from an f-string emit
+    site) covered by a catalog entry of `kind`? A placeholder is matched
+    as a representative token, so ``serve.flush.*`` (emit site) matches
+    the catalog's ``serve.flush.*`` and ``*.bytes_moved`` matches
+    ``*.bytes_moved``."""
+    sample = name.replace("*", "x0")
+    return any(rx.match(sample) for rx in _KIND_RES.get(kind, ()))
+
+
+# ------------------------------------------------------- exposition check --
+
+
+def _prom_family_res() -> list[re.Pattern]:
+    out: list[re.Pattern] = []
+    for m in CATALOG:
+        # prom-space: dots collapse to underscores, so '*' must match
+        # underscores too (translate around the placeholder — the plain
+        # metric_name() would collapse '*' itself to '_')
+        prom = m.name.replace(".", "_")
+        base = "".join(
+            re.escape(c) if c != "*" else "[a-zA-Z0-9_]+" for c in prom
+        )
+        suffixes = {
+            "counter": ("_total",),
+            "gauge": ("", "_max"),
+            "histogram": ("",),
+            "span": ("_calls_total", "_seconds_total"),
+        }[m.kind]
+        for suf in suffixes:
+            out.append(re.compile("^" + base + re.escape(suf) + "$"))
+    for ns in _TEST_NAMESPACES:
+        out.append(re.compile("^" + re.escape(ns.replace(".", "_")) + ".*$"))
+    return out
+
+
+_PROM_RES: list[re.Pattern] | None = None
+
+
+def prom_family_known(family: str) -> bool:
+    """Used by export.validate_text: is this Prometheus family name one
+    the catalog (or the test scratch namespace) declares?"""
+    global _PROM_RES
+    if _PROM_RES is None:
+        _PROM_RES = _prom_family_res()
+    return any(rx.match(family) for rx in _PROM_RES)

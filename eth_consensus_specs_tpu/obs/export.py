@@ -132,6 +132,19 @@ _HTTP_SERVER = None
 _HTTP_LOCK = threading.Lock()
 
 
+def _reinit_lock_after_fork_in_child() -> None:
+    # fork-safety (speclint rule of the same name): a parent thread may
+    # hold this lock mid-maybe_serve_http at fork time; the child also
+    # drops the inherited server handle — its serving thread does not
+    # exist there, and a fresh maybe_serve_http must be able to bind
+    global _HTTP_LOCK, _HTTP_SERVER
+    _HTTP_LOCK = threading.Lock()
+    _HTTP_SERVER = None
+
+
+os.register_at_fork(after_in_child=_reinit_lock_after_fork_in_child)
+
+
 def maybe_serve_http():
     """Idempotent env-gated starter: the first caller in a process with
     ``ETH_SPECS_OBS_HTTP_PORT`` set starts the endpoint, later callers
@@ -185,12 +198,20 @@ def serve_http(port: int | None = None):
 # -------------------------------------------------------------- validation --
 
 
-def validate_text(text: str) -> dict:
+def validate_text(text: str, catalog="project") -> dict:
     """Parse an exposition and raise ValueError on any malformation:
     unknown-family samples, missing/duplicated HELP or TYPE, illegal
     names, non-cumulative histogram buckets, missing ``+Inf`` cap, or
     ``+Inf`` != ``_count``. Returns {families, samples} tallies (handy
-    for asserts)."""
+    for asserts).
+
+    ``catalog`` additionally rejects families absent from the central
+    metric catalog (obs/catalog.py) — exposition drift fails fast
+    instead of silently orphaning dashboards/SLOs. The default
+    ``"project"`` uses the project catalog (the ``t.*``/``test.*``
+    scratch namespaces stay allowed); pass ``None`` to skip the catalog
+    check (synthetic expositions in tests), or any object with a
+    ``prom_family_known(name) -> bool``."""
     helps: dict[str, str] = {}
     types: dict[str, str] = {}
     samples: list[tuple[str, str | None, float]] = []
@@ -243,6 +264,20 @@ def validate_text(text: str) -> dict:
         if fam not in types:
             raise ValueError(f"sample {sname} belongs to no declared family")
         by_family.setdefault(fam, []).append((sname, labels, value))
+
+    if catalog == "project":
+        from . import catalog as catalog_mod
+
+        catalog = catalog_mod
+    if catalog is not None:
+        undeclared = sorted(
+            fam for fam in types if not catalog.prom_family_known(fam)
+        )
+        if undeclared:
+            raise ValueError(
+                f"families not declared in obs/catalog.py: {undeclared} — "
+                "declare the metric (with a help string) or fix the emitter"
+            )
 
     for fam, typ in types.items():
         if typ != "histogram":

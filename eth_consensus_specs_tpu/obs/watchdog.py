@@ -37,6 +37,17 @@ _lock = threading.Lock()
 _calls: dict[str, int] = {}
 
 
+def _reinit_lock_after_fork_in_child() -> None:
+    # fork-safety: a serving thread can be inside should_check when the
+    # gen pool forks; the child's first sampled kernel call must not
+    # block on a lock held by a thread that does not exist there
+    global _lock
+    _lock = threading.Lock()
+
+
+os.register_at_fork(after_in_child=_reinit_lock_after_fork_in_child)
+
+
 def sampling_rate() -> float:
     raw = os.environ.get("ETH_SPECS_OBS_WATCHDOG", "")
     if not raw:

@@ -59,6 +59,16 @@ _SEEN: set[tuple] = set()
 _DEFAULT_TOL = 0.25
 
 
+def _reinit_lock_after_fork_in_child() -> None:
+    # fork-safety: ambient capture can run on any serving thread; a
+    # child forked mid-analysis must get a fresh, unheld lock
+    global _SEEN_LOCK
+    _SEEN_LOCK = threading.Lock()
+
+
+os.register_at_fork(after_in_child=_reinit_lock_after_fork_in_child)
+
+
 def enabled() -> bool:
     """Ambient capture gate (explicit ``analyze(..., force=True)`` calls
     ignore it)."""

@@ -28,6 +28,7 @@ import secrets
 import threading
 
 from eth_consensus_specs_tpu import obs
+from eth_consensus_specs_tpu.analysis import lockwatch
 from eth_consensus_specs_tpu.crypto.curve import (
     Point,
     g1_generator,
@@ -57,7 +58,18 @@ def _use_device() -> bool:
 # an unlocked evict (clear + update) racing a concurrent prime could
 # publish a half-rebuilt dict.
 _H2G2_CACHE: dict[tuple[bytes, bytes], object] = {}
-_H2G2_LOCK = threading.Lock()
+_H2G2_LOCK = lockwatch.wrap(threading.Lock(), "ops.bls_batch._H2G2_LOCK")
+
+
+def _reinit_lock_after_fork_in_child() -> None:
+    # fork-safety: the serving layer's batch thread primes this cache
+    # off-thread; a gen-pool fork mid-prime must not hand the child a
+    # held lock (the cache contents are read-only-safe to inherit)
+    global _H2G2_LOCK
+    _H2G2_LOCK = lockwatch.wrap(threading.Lock(), "ops.bls_batch._H2G2_LOCK")
+
+
+os.register_at_fork(after_in_child=_reinit_lock_after_fork_in_child)
 
 
 def _prime_h2g2_cache(msgs: list[bytes], batch_fn, dst: bytes = DST_G2) -> None:

@@ -42,6 +42,7 @@ import threading
 import time
 
 from eth_consensus_specs_tpu import obs
+from eth_consensus_specs_tpu.analysis import lockwatch
 
 
 class Overloaded(RuntimeError):
@@ -64,7 +65,9 @@ class AdmissionController:
     def __init__(self, max_queue: int, max_bytes: int):
         self.max_queue = max_queue
         self.max_bytes = max_bytes
-        self._lock = threading.Lock()
+        self._lock = lockwatch.wrap(
+            threading.Lock(), "serve.admission.AdmissionController._lock"
+        )
         self._depth = 0
         self._bytes = 0
         # seeded pessimistically high so the first rejections under a

@@ -32,6 +32,8 @@ from concurrent.futures import Future
 from dataclasses import dataclass, field
 from typing import Any, Callable
 
+from eth_consensus_specs_tpu.analysis import lockwatch
+
 
 @dataclass
 class Request:
@@ -50,7 +52,13 @@ class Request:
 
 class MicroBatcher:
     def __init__(self):
-        self._cond = threading.Condition()
+        # under ETH_SPECS_ANALYSIS_LOCKWATCH the condition's INNER lock
+        # is order-watched (wait() releases through the wrapper, so the
+        # per-thread held stack stays truthful across waits); an RLock
+        # because next_flush re-enters the condition recursively
+        self._cond = threading.Condition(
+            lockwatch.wrap(threading.RLock(), "serve.batcher.MicroBatcher._cond")
+        )
         self._queue: deque[Request] = deque()
         self._closed = False
 

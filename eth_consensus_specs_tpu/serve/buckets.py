@@ -37,6 +37,7 @@ import threading
 import time
 
 from eth_consensus_specs_tpu import obs
+from eth_consensus_specs_tpu.analysis import lockwatch
 
 # Above this many leaf chunks PER DISPATCH the device tree kernel beats
 # per-level hashlib (measured crossover, see ops/merkle.py's module doc
@@ -76,8 +77,18 @@ def subtree_depth(n_chunks: int) -> int:
 
 # ------------------------------------------------- compile accounting --
 
-_SEEN_LOCK = threading.Lock()
+_SEEN_LOCK = lockwatch.wrap(threading.Lock(), "serve.buckets._SEEN_LOCK")
 _SEEN_SHAPES: set[tuple] = set()
+
+
+def _reinit_lock_after_fork_in_child() -> None:
+    # fork-safety: replica boots and gen-pool forks happen while serving
+    # threads may be inside note_dispatch; the child re-creates the lock
+    global _SEEN_LOCK
+    _SEEN_LOCK = lockwatch.wrap(threading.Lock(), "serve.buckets._SEEN_LOCK")
+
+
+os.register_at_fork(after_in_child=_reinit_lock_after_fork_in_child)
 
 
 def note_dispatch(op: str, *dims: int) -> bool:

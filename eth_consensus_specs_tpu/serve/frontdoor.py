@@ -53,6 +53,7 @@ from concurrent.futures import Future, ThreadPoolExecutor
 import numpy as np
 
 from eth_consensus_specs_tpu import fault, obs
+from eth_consensus_specs_tpu.analysis import lockwatch
 from eth_consensus_specs_tpu.obs import flight, slo, trace
 from eth_consensus_specs_tpu.obs.delta import DeltaShipper, merge_delta
 
@@ -111,7 +112,9 @@ class FrontDoorClient:
         self.config = config or ServeConfig.from_env()
         self.fdcfg = fd_config or FrontDoorConfig.from_env()
         self.name = name
-        self._addr_lock = threading.Lock()
+        self._addr_lock = lockwatch.wrap(
+            threading.Lock(), "serve.frontdoor.FrontDoorClient._addr_lock"
+        )
         self._addrs = [wire.parse_addr(a) for a in addrs]
         self._gens = [0] * len(self._addrs)
         self.router = Router(
@@ -120,7 +123,9 @@ class FrontDoorClient:
         self.admission = AdmissionController(
             self.config.max_queue, self.config.max_bytes
         )
-        self._resolve_lock = threading.Lock()
+        self._resolve_lock = lockwatch.wrap(
+            threading.Lock(), "serve.frontdoor.FrontDoorClient._resolve_lock"
+        )
         self._tls = threading.local()
         self._closed = False
         self._pool = ThreadPoolExecutor(

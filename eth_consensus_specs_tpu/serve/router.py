@@ -31,6 +31,7 @@ import threading
 import time
 
 from eth_consensus_specs_tpu import obs
+from eth_consensus_specs_tpu.analysis import lockwatch
 
 
 class _Replica:
@@ -59,7 +60,7 @@ def stable_hash(key: tuple) -> int:
 
 class Router:
     def __init__(self, n: int, *, down_cooldown_s: float = 0.5, ewma_alpha: float = 0.2):
-        self._lock = threading.Lock()
+        self._lock = lockwatch.wrap(threading.Lock(), "serve.router.Router._lock")
         self._reps = [_Replica() for _ in range(n)]
         self._down_cooldown_s = down_cooldown_s
         self._alpha = ewma_alpha

@@ -55,6 +55,7 @@ from queue import Queue
 import numpy as np
 
 from eth_consensus_specs_tpu import fault, obs
+from eth_consensus_specs_tpu.analysis import lockwatch
 from eth_consensus_specs_tpu.obs import trace
 from eth_consensus_specs_tpu.obs.histogram import Histogram
 
@@ -84,7 +85,9 @@ class VerifyService:
         # lets the queue grow and admission shed — backpressure, not RAM
         self._dispatch_q: Queue = Queue(maxsize=2)
         self._closed = False
-        self._close_lock = threading.Lock()
+        self._close_lock = lockwatch.wrap(
+            threading.Lock(), "serve.service.VerifyService._close_lock"
+        )
         # run-level wait distribution: a mergeable log-bucket histogram
         # (every wait of the whole run, O(1) record, quantiles from
         # buckets — the old 4096-sample deque truncated history under

@@ -79,8 +79,14 @@ class ObsPlugin:
             self.per_test.append((item.nodeid, delta))
 
     def pytest_sessionfinish(self, session, exitstatus):
+        from eth_consensus_specs_tpu.analysis import lockwatch
         from eth_consensus_specs_tpu.obs import flight
 
+        # under ETH_SPECS_ANALYSIS_LOCKWATCH=1 the run-level report
+        # carries the watch totals (gauges) next to the live
+        # lockwatch.inversions counter — CI gates zero inversions on
+        # the tier-1 report (a no-op when the watchdog is off)
+        lockwatch.publish()
         snap = obs.snapshot()
         # a failing session is a postmortem trigger: leave the flight
         # ring + registry as a bundle for the CI `if: failure()` artifact

@@ -1,0 +1,77 @@
+#!/usr/bin/env python
+"""Generate docs/env-reference.md from the env registry (envreg.py).
+
+The registry is the single source of truth for every ``ETH_SPECS_*``
+knob (the ``env-registry`` speclint rule enforces declared == read);
+this script renders it into the one docs table the subsystem pages link
+to. Modes:
+
+    python scripts/gen_env_docs.py           # rewrite docs/env-reference.md
+    python scripts/gen_env_docs.py --check   # exit 1 if committed != generated
+
+CI's ``static-analysis`` job runs ``--check`` so the committed table
+literally cannot drift from the code.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO_ROOT)
+
+from eth_consensus_specs_tpu import envreg  # noqa: E402
+
+OUT = os.path.join(REPO_ROOT, "docs", "env-reference.md")
+
+HEADER = """\
+# Environment variable reference
+
+<!-- GENERATED FILE — do not edit. Regenerate with:
+         python scripts/gen_env_docs.py
+     Source of truth: eth_consensus_specs_tpu/envreg.py (the env
+     registry; the `env-registry` speclint rule keeps it in lockstep
+     with every os.environ read). CI diffs this file against a fresh
+     generation. -->
+
+Every `ETH_SPECS_*` knob in one table, generated from the
+[env registry](analysis.md#env-registry). The *details* column links to
+the subsystem page whose prose explains the knob in context.
+
+"""
+
+
+def render() -> str:
+    return HEADER + envreg.markdown_table()
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--check", action="store_true",
+                    help="diff generated vs committed; exit 1 on drift")
+    args = ap.parse_args()
+    text = render()
+    if args.check:
+        try:
+            with open(OUT, encoding="utf-8") as fh:
+                committed = fh.read()
+        except OSError:
+            committed = ""
+        if committed != text:
+            sys.stderr.write(
+                "docs/env-reference.md is stale — run "
+                "`python scripts/gen_env_docs.py` and commit the result\n"
+            )
+            return 1
+        print(f"docs/env-reference.md up to date ({len(envreg.ENV_VARS)} vars)")
+        return 0
+    with open(OUT, "w", encoding="utf-8") as fh:
+        fh.write(text)
+    print(f"wrote {OUT} ({len(envreg.ENV_VARS)} vars)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

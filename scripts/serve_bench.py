@@ -73,6 +73,7 @@ os.environ.setdefault("JAX_PLATFORMS", "cpu")
 import numpy as np  # noqa: E402
 
 from eth_consensus_specs_tpu import obs, serve  # noqa: E402
+from eth_consensus_specs_tpu.analysis import lint, lockwatch  # noqa: E402
 from eth_consensus_specs_tpu.obs import export, slo  # noqa: E402
 from eth_consensus_specs_tpu.ops import bls_batch  # noqa: E402
 from eth_consensus_specs_tpu.ops.merkle import merkleize_subtree_device  # noqa: E402
@@ -175,6 +176,25 @@ def finish_report(report: dict, failures: list, out: str, trigger: str, snap: di
     prom_path = os.environ.get("ETH_SPECS_OBS_PROM") or (
         os.path.splitext(out)[0] + ".prom"
     )
+    if lockwatch.enabled():
+        # runtime lock-order gate (ETH_SPECS_ANALYSIS_LOCKWATCH=1, the
+        # CI serve-smoke configuration): zero inversions observed live,
+        # and the union of the static lock graph with the orders this
+        # run actually exercised stays acyclic (docs/analysis.md)
+        lockwatch.publish()
+        snap = obs.snapshot()  # re-snapshot WITH the published gauges
+        lw = lockwatch.report()
+        static = lint.build_lock_graph(lint.collect_modules(REPO))
+        agreement = lockwatch.check_against_static(static["edges"])
+        lw["static_agreement"] = agreement
+        report["lockwatch"] = lw
+        if lw["inversions"]:
+            failures.append(f"lock-order inversions observed live: {lw['inversions']}")
+        if not agreement["ok"]:
+            failures.append(
+                f"static/runtime lock graphs disagree (union has a cycle): "
+                f"{agreement['cycles']}"
+            )
     export.write_textfile(prom_path, snap=snap)
     try:
         export.validate_text(open(prom_path).read())
