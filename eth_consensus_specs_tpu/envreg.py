@@ -126,6 +126,23 @@ ENV_VARS: tuple[EnvVar, ...] = (
     _v("ETH_SPECS_SERVE_SLO_SHED", "on",
        "`0`: disable SLO-driven admission resizing (static caps only)",
        "serving.md#replicated-front-door"),
+    _v("ETH_SPECS_SERVE_CHIPS", "0",
+       "chips the serve dispatch mesh spans (0 = every local device; 1 = "
+       "single-device dispatch); `serve_bench.py --chips` forces the matching "
+       "virtual CPU device count", "serving.md#mesh-sharded-dispatch"),
+    # ------------------------------------------------------------- mesh --
+    _v("ETH_SPECS_MESH", "1",
+       "`0`: disable mesh-sharded kernel dispatch entirely (every entry point "
+       "takes the bit-identical single-device path)",
+       "serving.md#mesh-sharded-dispatch"),
+    _v("ETH_SPECS_MESH_MIN_ITEMS", "2",
+       "smallest live batch a sharded dispatch is worth; below it the "
+       "single-device bucket path is cheaper than the mesh padding",
+       "serving.md#mesh-sharded-dispatch"),
+    _v("ETH_SPECS_MESH_SCALING_MIN", "0.7",
+       "mesh bench gate: minimum per-effective-chip scaling factor "
+       "(`serve_bench.py --chips N` fails below it)",
+       "serving.md#mesh-sharded-dispatch"),
     # ------------------------------------------------------------ fault --
     _v("ETH_SPECS_FAULT", "unset",
        "deterministic fault-injection spec: `site:mode[:key=value...]` rules "

@@ -109,7 +109,12 @@ CATALOG: tuple[Metric, ...] = (
     _c("multihost.meshes_flat", "flat device meshes built"),
     _c("multihost.meshes_hybrid", "hybrid device meshes built"),
     _c("multihost.processes", "processes seen at mesh build"),
+    _c("multihost.slice_remainder", "rows beyond an even host_local_slice shard split"),
     _s("multihost.initialize", "jax.distributed initialization"),
+    # -------------------------------------------------------------- mesh --
+    _c("mesh.dispatches", "mesh-sharded kernel dispatches"),
+    _c("mesh.sharded_items", "live items (trees/MSM items/pairs) through sharded kernels"),
+    _g("mesh.devices", "devices in the live serve mesh"),
     # ------------------------------------------------------------- serve --
     _c("serve.batch_items", "requests across all flushes"),
     _c("serve.cancelled", "futures cancelled by callers"),

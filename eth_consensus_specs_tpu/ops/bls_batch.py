@@ -106,7 +106,7 @@ def _h2g2(msg: bytes, dst: bytes = DST_G2):
     return hit if hit is not None else hash_to_g2(msg, dst)
 
 
-def _pairing_check_routed(pairs) -> bool:
+def _pairing_check_routed(pairs, mesh=None) -> bool:
     """Device Miller loop + membership check under the tpu backend; the
     host/native pairing elsewhere. Both are bit-equivalent implementations
     of the same check (tests/test_pairing_device.py), so routing can never
@@ -127,7 +127,7 @@ def _pairing_check_routed(pairs) -> bool:
     if _use_device() or os.environ.get("ETH_SPECS_TPU_DEVICE_PAIRING"):
         from eth_consensus_specs_tpu.ops.pairing_device import pairing_check_device
 
-        return pairing_check_device(pairs)
+        return pairing_check_device(pairs, mesh=mesh)
     return pairing_check(pairs)
 
 
@@ -160,7 +160,9 @@ def fast_aggregate_verify_device(pks: list[bytes], message: bytes, sig: bytes) -
         )
 
 
-def batch_verify_aggregates(items: list[tuple[list[bytes], bytes, bytes]]) -> bool:
+def batch_verify_aggregates(
+    items: list[tuple[list[bytes], bytes, bytes]], mesh=None
+) -> bool:
     """Verify many (pubkeys, message, aggregate_signature) triples with ONE
     pairing check via random linear combination:
 
@@ -179,7 +181,7 @@ def batch_verify_aggregates(items: list[tuple[list[bytes], bytes, bytes]]) -> bo
     with obs.span("bls.batch_verify", items=len(items)):
         obs.count("bls.batches", 1)
         obs.count("bls.batch_items", len(items))
-        ok, parsed = _batch_verify_impl(items)
+        ok, parsed = _batch_verify_impl(items, mesh=mesh)
     # the watchdog's host-pairing recompute runs AFTER the span closes
     # (like sha256/merkle/shuffle): the probe must never be clocked as
     # kernel time — in the obs report or in bench's timed region
@@ -220,6 +222,7 @@ def _parse_item(item: tuple[list[bytes], bytes, bytes]):
 
 def _batch_verify_impl(
     items: list[tuple[list[bytes], bytes, bytes]],
+    mesh=None,
 ) -> tuple[bool, list | None]:
     parsed = []
     for item in items:
@@ -227,23 +230,42 @@ def _batch_verify_impl(
         if p is None:
             return False, None
         parsed.append(p)
-    rpk = _rlc_pubkey_terms(parsed)
-    return _rlc_pairing_check(parsed, rpk), parsed
+    rpk = _rlc_pubkey_terms(parsed, mesh=mesh)
+    return _rlc_pairing_check(parsed, rpk, mesh=mesh), parsed
 
 
-def _rlc_pubkey_terms(parsed: list) -> list:
+def _rlc_pubkey_terms(parsed: list, mesh=None) -> list:
     """Per-item r_i * aggpk_i — independent of which subset of the batch
     a later check verifies, so verify_many's bisection computes these
     ONCE per item and re-checks subsets with only the G2 MSM + pairing."""
+    if not parsed:
+        return []
     if _use_device():
-        from eth_consensus_specs_tpu.ops.g1_msm import sum_g1_device
+        from eth_consensus_specs_tpu.ops.g1_msm import many_sum_shape, sum_g1_many_device
+        from eth_consensus_specs_tpu.parallel.mesh_ops import mesh_signature, shard_count
+        from eth_consensus_specs_tpu.serve import buckets
 
-        # the scalar is uniform within an item, so r_i * aggpk_i factors to
-        # r_i * sum(points): the device pairwise-sum kernel does the O(n)
-        # group work, and the single 64-bit host multiply replaces an
-        # n-lane 256-bit double-and-add (4x fewer device iterations, one
-        # point-mul instead of n)
-        rpk = [sum_g1_device(points).mul(r) for points, _, _, r in parsed]
+        # the scalar is uniform within an item, so r_i * aggpk_i factors
+        # to r_i * sum(points): ONE batched device dispatch sums every
+        # item's committee (item axis sharded over `mesh` when live),
+        # and the single 64-bit host multiply per item replaces an
+        # n-lane 256-bit double-and-add. The dispatch shape is the
+        # shared many_sum_shape bucket; its first sighting is the
+        # compile this process pays for that (items, lanes[, mesh])
+        # key — accounted here so serve and direct callers agree.
+        shards = shard_count(mesh)
+        shape = many_sum_shape(
+            len(parsed), max(len(points) for points, _, _, _ in parsed), shards
+        )
+        sig = mesh_signature(mesh) if shards > 1 else ""
+        key = (*shape, sig) if sig else shape
+        with buckets.first_dispatch("bls_msm", *key):
+            sums = sum_g1_many_device(
+                [points for points, _, _, _ in parsed],
+                mesh=mesh if shards > 1 else None,
+                pad_shape=shape,
+            )
+        rpk = [s.mul(r) for s, (_, _, _, r) in zip(sums, parsed)]
     else:
         from eth_consensus_specs_tpu.crypto import native_bridge as nb
         from eth_consensus_specs_tpu.crypto.fields import Fq
@@ -270,7 +292,7 @@ def _rlc_pubkey_terms(parsed: list) -> list:
     return rpk
 
 
-def _rlc_pairing_check(parsed: list, rpk: list) -> bool:
+def _rlc_pairing_check(parsed: list, rpk: list, mesh=None) -> bool:
     g1 = g1_generator()
     # merge same-message items into one pairing input (block attestations
     # often share AttestationData): k items with m distinct messages ->
@@ -297,16 +319,24 @@ def _rlc_pairing_check(parsed: list, rpk: list) -> bool:
     obs.count("bls.pairings", 1)
     obs.count("bls.pairing_inputs", len(pairs))
     obs.count("bls.messages_distinct", len(merged))
-    return _pairing_check_routed(pairs)
+    return _pairing_check_routed(pairs, mesh=mesh)
 
 
-def verify_many(items: list[tuple[list[bytes], bytes, bytes]]) -> list[bool]:
+def verify_many(
+    items: list[tuple[list[bytes], bytes, bytes]], mesh=None
+) -> list[bool]:
     """Per-item verdicts for many (pubkeys, message, aggregate_signature)
     triples — the serving layer's batch entry point. Parsing and the
     per-item G1 MSM terms are computed ONCE; one RLC pairing settles an
     all-valid batch (the overwhelmingly common case), and a reject
     bisects with only the G2 MSM + pairing per subset, so each invalid
     item costs ~2*log2(n) pairings instead of n.
+
+    With a multi-device ``mesh`` the per-item G1 terms shard their item
+    axis and the device pairing's Miller chunks shard across chips; the
+    terms are mesh-independent values (canonical affine points), so the
+    bisection re-checks subsets with the SAME terms and verdicts stay
+    bit-identical whatever the mesh shape.
 
     Per-item results are exactly what ``batch_verify_aggregates([item])``
     returns: a singleton RLC check is ``X^r == 1`` in the prime-order
@@ -323,8 +353,8 @@ def verify_many(items: list[tuple[list[bytes], bytes, bytes]]) -> list[bool]:
         if not live:
             return out
         sub = [parsed[i] for i in live]
-        rpk = _rlc_pubkey_terms(sub)
-        verdicts = _bisect_rlc(sub, rpk)
+        rpk = _rlc_pubkey_terms(sub, mesh=mesh)
+        verdicts = _bisect_rlc(sub, rpk, mesh=mesh)
         for i, v in zip(live, verdicts):
             out[i] = v
     # sampled device/host coupling on the serving path too (outside the
@@ -337,10 +367,12 @@ def verify_many(items: list[tuple[list[bytes], bytes, bytes]]) -> list[bool]:
     return out
 
 
-def _bisect_rlc(parsed: list, rpk: list) -> list[bool]:
-    if _rlc_pairing_check(parsed, rpk):
+def _bisect_rlc(parsed: list, rpk: list, mesh=None) -> list[bool]:
+    if _rlc_pairing_check(parsed, rpk, mesh=mesh):
         return [True] * len(parsed)
     if len(parsed) == 1:
         return [False]
     mid = len(parsed) // 2
-    return _bisect_rlc(parsed[:mid], rpk[:mid]) + _bisect_rlc(parsed[mid:], rpk[mid:])
+    return _bisect_rlc(parsed[:mid], rpk[:mid], mesh=mesh) + _bisect_rlc(
+        parsed[mid:], rpk[mid:], mesh=mesh
+    )

@@ -22,6 +22,7 @@ on host; the single final Jacobian->affine inversion also stays host-side
 
 from __future__ import annotations
 
+import os
 from functools import partial
 
 import jax
@@ -30,7 +31,10 @@ import numpy as np
 import eth_consensus_specs_tpu  # noqa: F401  (enables x64)
 import jax.numpy as jnp
 from jax import lax
+from jax.experimental.shard_map import shard_map
+from jax.sharding import Mesh, PartitionSpec as P
 
+from eth_consensus_specs_tpu import obs
 from eth_consensus_specs_tpu.crypto.curve import Point, B1, g1_infinity
 from eth_consensus_specs_tpu.crypto.fields import Fq, P as P_INT
 
@@ -158,6 +162,114 @@ def sum_kernel(X, Y, Z):
     return _tree_sum(X, Y, Z)
 
 
+@jax.jit
+def sum_many_kernel(X, Y, Z):
+    """Per-item point sums over [I, L, 13] lane arrays (L a power of
+    two): the batched aggregate-pubkey kernel — one dispatch sums every
+    committee of a flush instead of one dispatch per item."""
+    return jax.vmap(_tree_sum)(X, Y, Z)
+
+
+# == mesh-sharded kernels ==================================================
+#
+# Two shard axes, matching the two hot call patterns:
+#   * ITEM axis (sum_g1_many_device): the RLC batch's per-item committee
+#     sums are independent — shard items, no collectives;
+#   * LANE axis (msm_g1_device): one big MSM splits its (scalar, point)
+#     lanes — each shard tree-sums its lanes, then a cross-shard Jacobian
+#     reduction (all_gather of the 3x13-limb partials + the same pairwise
+#     tree) combines them. Jacobian addition is exact group math and the
+#     final affine conversion is canonical, so any shard count returns
+#     byte-identical points.
+
+
+def _cross_shard_tree_sum(rX, rY, rZ, axes):
+    """all_gather per-shard Jacobian partials ([13] each) and tree-sum
+    them; non-pow2 shard counts pad with infinity lanes (Z = 0)."""
+    gX = lax.all_gather(rX, axes)
+    gY = lax.all_gather(rY, axes)
+    gZ = lax.all_gather(rZ, axes)
+    s = gX.shape[0]
+    cap = 1 << max(s - 1, 0).bit_length()
+    if cap != s:
+        pad = ((0, cap - s), (0, 0))
+        gX = jnp.pad(gX, pad)
+        gY = jnp.pad(gY, pad)
+        gZ = jnp.pad(gZ, pad)
+    return _tree_sum(gX, gY, gZ)
+
+
+_SHARDED_FNS: dict[tuple, object] = {}
+
+
+def _sharded_fn(mesh: Mesh, kind: str):
+    """Per-(mesh, kernel) jitted shard_map entry (cached: the jit cache
+    then dedupes per input shape)."""
+    key = (mesh, kind)
+    fn = _SHARDED_FNS.get(key)
+    if fn is not None:
+        return fn
+    from eth_consensus_specs_tpu.parallel.mesh_ops import BATCH_AXES
+
+    spec = P(BATCH_AXES)
+    if kind == "msm":
+
+        def local(bits, X, Y, Z):
+            mX, mY, mZ = jax.vmap(_scalar_mul_lane)(bits, X, Y, Z)
+            return _cross_shard_tree_sum(*_tree_sum(mX, mY, mZ), BATCH_AXES)
+
+        fn = jax.jit(
+            shard_map(local, mesh=mesh, in_specs=spec, out_specs=P(), check_rep=False)
+        )
+    elif kind == "sum":
+
+        def local(X, Y, Z):
+            return _cross_shard_tree_sum(*_tree_sum(X, Y, Z), BATCH_AXES)
+
+        fn = jax.jit(
+            shard_map(local, mesh=mesh, in_specs=spec, out_specs=P(), check_rep=False)
+        )
+    else:  # "sum_many": item axis sharded, no collectives
+
+        def local(X, Y, Z):
+            return jax.vmap(_tree_sum)(X, Y, Z)
+
+        fn = jax.jit(
+            shard_map(local, mesh=mesh, in_specs=spec, out_specs=spec, check_rep=False)
+        )
+    _SHARDED_FNS[key] = fn
+    return fn
+
+
+def _clear_sharded_after_fork_in_child() -> None:
+    # fork-safety: compiled executables reference the parent's devices
+    _SHARDED_FNS.clear()
+
+
+os.register_at_fork(after_in_child=_clear_sharded_after_fork_in_child)
+
+
+def mesh_lane_pad(n: int, shards: int) -> int:
+    """Lane padding target under `shards`: per-shard lane counts padded
+    to a power of two (the per-shard tree reduce needs pow2), total =
+    shards * per-shard. For pow2 shard counts this equals the global
+    pow2; for non-pow2 meshes it pads strictly less."""
+    if shards <= 1:
+        n = max(n, 1)
+        return 1 << max(n - 1, 0).bit_length()
+    per = -(-n // shards)
+    per = max(per, 1)
+    return shards * (1 << max(per - 1, 0).bit_length())
+
+
+def many_sum_shape(n_items: int, max_lanes: int, shards: int = 1) -> tuple[int, int]:
+    """(item_pad, lane_pad) the batched per-item sum kernel compiles at:
+    items pad to per-shard pow2 (x shards), lanes to global pow2 — ONE
+    shared shape model for the ops entry point and the serve layer's
+    compile accounting, so they can never disagree."""
+    return mesh_lane_pad(n_items, shards), mesh_lane_pad(max_lanes, 1)
+
+
 # == host conversion boundary ==============================================
 
 
@@ -198,35 +310,96 @@ def _jacobian_to_point(X, Y, Z) -> Point:
     return Point(Fq(x * zinv2 % P_INT), Fq(y * zinv2 % P_INT * zinv % P_INT), B1)
 
 
-def _pad_pow2(arrs, n):
-    """Pad lane arrays to the next power of two with infinity lanes (Z=0,
-    zero scalars) — ONE compiled executable per pow2 bucket instead of one
-    per exact committee size."""
-    cap = 1 << max(n - 1, 0).bit_length() if n > 1 else 1
+def _pad_lanes(arrs, n: int, cap: int):
+    """Pad lane arrays to exactly `cap` lanes with infinity lanes (Z = 0,
+    zero scalars)."""
     if cap == n:
         return arrs
-    return [np.concatenate([a, np.zeros((cap - n,) + a.shape[1:], a.dtype)]) for a in arrs]
+    return [
+        np.concatenate([a, np.zeros((cap - n,) + a.shape[1:], a.dtype)]) for a in arrs
+    ]
 
 
-def msm_g1_device(points: list, scalars: list[int]) -> Point:
-    """Device MSM entry: sum_i scalars[i] * points[i] over G1."""
+def msm_g1_device(points: list, scalars: list[int], mesh: Mesh | None = None) -> Point:
+    """Device MSM entry: sum_i scalars[i] * points[i] over G1. With a
+    multi-device `mesh` the lanes shard over it (per-shard double-and-add
+    + local tree sum, then the cross-shard Jacobian reduction) — the
+    affine result is byte-identical to the single-device dispatch."""
     assert len(points) == len(scalars)
     if not points:
         return g1_infinity()
+    from eth_consensus_specs_tpu.parallel.mesh_ops import shard_count
+
+    shards = shard_count(mesh)
+    if shards <= 1:
+        mesh = None
     X, Y, Z = _points_to_limbs(points)
+    cap = mesh_lane_pad(len(points), shards)
     if all(int(k) == 1 for k in scalars):
         # aggregate-pubkey fast path: tree sum only, no scalar loop
-        X, Y, Z = _pad_pow2([X, Y, Z], len(points))
-        rX, rY, rZ = sum_kernel(jnp.asarray(X), jnp.asarray(Y), jnp.asarray(Z))
+        X, Y, Z = _pad_lanes([X, Y, Z], len(points), cap)
+        args = (jnp.asarray(X), jnp.asarray(Y), jnp.asarray(Z))
+        if mesh is not None:
+            obs.count("mesh.dispatches", 1)
+            obs.count("mesh.sharded_items", len(points))
+            rX, rY, rZ = _sharded_fn(mesh, "sum")(*args)
+        else:
+            rX, rY, rZ = sum_kernel(*args)
     else:
         bits = _scalars_to_bits(scalars)
-        bits, X, Y, Z = _pad_pow2([bits, X, Y, Z], len(points))
-        rX, rY, rZ = msm_kernel(
-            jnp.asarray(bits), jnp.asarray(X), jnp.asarray(Y), jnp.asarray(Z)
-        )
+        bits, X, Y, Z = _pad_lanes([bits, X, Y, Z], len(points), cap)
+        args = (jnp.asarray(bits), jnp.asarray(X), jnp.asarray(Y), jnp.asarray(Z))
+        if mesh is not None:
+            obs.count("mesh.dispatches", 1)
+            obs.count("mesh.sharded_items", len(points))
+            rX, rY, rZ = _sharded_fn(mesh, "msm")(*args)
+        else:
+            rX, rY, rZ = msm_kernel(*args)
     return _jacobian_to_point(np.asarray(rX), np.asarray(rY), np.asarray(rZ))
 
 
-def sum_g1_device(points: list) -> Point:
+def sum_g1_device(points: list, mesh: Mesh | None = None) -> Point:
     """Device point sum (unit-scalar MSM): sum_i points[i]."""
-    return msm_g1_device(points, [1] * len(points))
+    return msm_g1_device(points, [1] * len(points), mesh=mesh)
+
+
+def sum_g1_many_device(
+    point_lists: list[list], mesh: Mesh | None = None, pad_shape: tuple | None = None
+) -> list[Point]:
+    """Per-item point sums for many committees in ONE dispatch:
+    ``[sum(points) for points in point_lists]``. Lanes pad to the pow2 of
+    the largest committee, items to the :func:`many_sum_shape` bucket
+    (``pad_shape`` overrides — the serve layer passes its own bucket so
+    accounting and dispatch agree); a multi-device `mesh` shards the item
+    axis. Each result is byte-identical to ``sum_g1_device(points)``."""
+    n = len(point_lists)
+    if n == 0:
+        return []
+    from eth_consensus_specs_tpu.parallel.mesh_ops import shard_count
+
+    shards = shard_count(mesh)
+    if shards <= 1:
+        mesh = None
+    max_lanes = max(len(p) for p in point_lists)
+    item_pad, lane_pad = pad_shape or many_sum_shape(n, max_lanes, shards)
+    assert item_pad >= n and lane_pad >= max_lanes
+    X = np.zeros((item_pad, lane_pad, N_LIMBS), np.uint64)
+    Y = np.zeros((item_pad, lane_pad, N_LIMBS), np.uint64)
+    Z = np.zeros((item_pad, lane_pad, N_LIMBS), np.uint64)
+    one = to_mont(1)
+    for i, points in enumerate(point_lists):
+        for j, p in enumerate(points):
+            if p.is_infinity():
+                continue  # Z stays zero
+            X[i, j] = to_mont(p.x.n)
+            Y[i, j] = to_mont(p.y.n)
+            Z[i, j] = one
+    args = (jnp.asarray(X), jnp.asarray(Y), jnp.asarray(Z))
+    if mesh is not None:
+        obs.count("mesh.dispatches", 1)
+        obs.count("mesh.sharded_items", n)
+        rX, rY, rZ = _sharded_fn(mesh, "sum_many")(*args)
+    else:
+        rX, rY, rZ = sum_many_kernel(*args)
+    rX, rY, rZ = np.asarray(rX), np.asarray(rY), np.asarray(rZ)
+    return [_jacobian_to_point(rX[i], rY[i], rZ[i]) for i in range(n)]

@@ -26,6 +26,7 @@ positions) never reaches them.
 
 from __future__ import annotations
 
+import os
 from functools import partial
 
 import jax
@@ -100,6 +101,46 @@ def many_tree_root_words(leaves: jnp.ndarray, depth: int) -> jnp.ndarray:
 _many_tree_root_fused = partial(jax.jit, static_argnums=(1,))(many_tree_root_words)
 
 
+# -- mesh-sharded multi-tree dispatch: the batch (tree) axis splits over
+# the serve mesh; every tree is independent, so there are NO collectives
+# and the per-tree roots are trivially byte-identical to the vmapped
+# single-device kernel. One jitted shard_map per (mesh, depth), the jit
+# cache dedupes per batch shape.
+_SHARDED_MANY: dict[tuple, object] = {}
+
+
+def _many_tree_root_sharded(mesh, depth: int):
+    key = (mesh, depth)
+    fn = _SHARDED_MANY.get(key)
+    if fn is not None:
+        return fn
+    from jax.experimental.shard_map import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    from eth_consensus_specs_tpu.parallel.mesh_ops import BATCH_AXES
+
+    spec = P(BATCH_AXES)
+    fn = jax.jit(
+        shard_map(
+            lambda words: many_tree_root_words(words, depth),
+            mesh=mesh,
+            in_specs=spec,
+            out_specs=spec,
+            check_rep=False,
+        )
+    )
+    _SHARDED_MANY[key] = fn
+    return fn
+
+
+def _clear_sharded_after_fork_in_child() -> None:
+    # fork-safety: compiled executables reference the parent's devices
+    _SHARDED_MANY.clear()
+
+
+os.register_at_fork(after_in_child=_clear_sharded_after_fork_in_child)
+
+
 def _chunks_to_words(chunks: np.ndarray, cap: int) -> np.ndarray:
     """uint8[N, 32] chunks (or pre-packed uint32[N, 8] BE words) ->
     uint32[cap, 8], zero-padded. Exposed so the service's host-prep
@@ -117,17 +158,31 @@ def _chunks_to_words(chunks: np.ndarray, cap: int) -> np.ndarray:
 
 
 def merkleize_many_device(
-    trees: list[np.ndarray], depth: int, pad_batch: int | None = None
+    trees: list[np.ndarray], depth: int, pad_batch: int | None = None, mesh=None
 ) -> list[bytes]:
     """Merkleize many independent subtrees of one depth in a single
     dispatch. Each entry is uint8[N_i, 32] chunks (N_i <= 2**depth) or a
     pre-packed uint32[N_i, 8] word array; the batch dimension is padded
     with all-zero trees up to `pad_batch` so the compiled executable is
-    shared across every flush in the same bucket. Roots are bit-identical
-    to per-tree `merkleize_subtree_device` (same kernel, vmapped)."""
+    shared across every flush in the same bucket. With a multi-device
+    `mesh` the tree axis shards over it (pad_batch then rounds up to a
+    multiple of the shard count — serve/buckets.py's mesh-aware buckets
+    already are). Roots are bit-identical to per-tree
+    `merkleize_subtree_device` (same kernel, vmapped) on every path."""
+    from eth_consensus_specs_tpu.parallel.mesh_ops import (
+        mesh_signature,
+        pad_to_shards,
+        shard_count,
+    )
+
     b = len(trees)
     cap = 1 << depth
+    shards = shard_count(mesh)
+    if shards <= 1:
+        mesh = None
     batch = pad_batch or b
+    if mesh is not None:
+        batch = pad_to_shards(batch, shards)
     assert b <= batch
     words = np.zeros((batch, cap, 8), np.uint32)
     for i, chunks in enumerate(trees):
@@ -139,20 +194,41 @@ def merkleize_many_device(
         tree_depth=depth,
         trees=b,
         padded_trees=batch,
+        mesh=mesh_signature(mesh),
+        mesh_shards=shards,
+        per_shard_trees=batch // shards,
     ) as sp:
-        sp.result = roots = np.asarray(_many_tree_root_fused(jnp.asarray(words), depth))
+        if mesh is not None:
+            obs.count("mesh.dispatches", 1)
+            obs.count("mesh.sharded_items", b)
+            fn = _many_tree_root_sharded(mesh, depth)
+            sp.result = roots = np.asarray(fn(jnp.asarray(words)))
+        else:
+            sp.result = roots = np.asarray(
+                _many_tree_root_fused(jnp.asarray(words), depth)
+            )
     obs.count("merkle.trees", b)
     obs.count("merkle.real_hashes", real)
     if xprof.enabled():
-        # once per (batch, depth): what XLA compiled for this bucket vs
-        # the 96 B × real-hash floor the span's roofline was judged on
-        xprof.analyze(
-            "merkle_many",
-            _many_tree_root_fused,
-            (jax.ShapeDtypeStruct((batch, cap, 8), jnp.uint32), depth),
-            hand_bytes=96 * real,
-            dims=(batch, depth),
-        )
+        # once per (batch, depth[, mesh shape]): what XLA compiled for
+        # this bucket vs the 96 B × real-hash floor the span's roofline
+        # was judged on — sharded shapes attribute per (op, mesh-shape)
+        if mesh is not None:
+            xprof.analyze(
+                "merkle_many",
+                _many_tree_root_sharded(mesh, depth),
+                (jax.ShapeDtypeStruct((batch, cap, 8), jnp.uint32),),
+                hand_bytes=96 * real,
+                dims=(batch, depth, *(int(mesh.shape[a]) for a in mesh.axis_names)),
+            )
+        else:
+            xprof.analyze(
+                "merkle_many",
+                _many_tree_root_fused,
+                (jax.ShapeDtypeStruct((batch, cap, 8), jnp.uint32), depth),
+                hand_bytes=96 * real,
+                dims=(batch, depth),
+            )
     out = [roots[i].astype(">u4", order="C").view(np.uint8).tobytes() for i in range(b)]
     if b and watchdog.should_check("merkle"):
         i = watchdog.call_salt("merkle") % b

@@ -37,6 +37,8 @@ native call every reference verification funnels into.
 
 from __future__ import annotations
 
+import os
+
 import numpy as np
 
 import eth_consensus_specs_tpu  # noqa: F401  (enables x64)
@@ -307,6 +309,71 @@ def _miller_chunk_fold(coeffs, px, py, active):
     return fs_v[0]
 
 
+# -- mesh-sharded Miller accumulation: the chunk axis splits over the
+# serve mesh; each shard scans its chunks through the SAME fixed-B=_CHUNK
+# Miller body, folding a per-shard partial product, and the partials
+# combine with a psum-style reduction (all_gather + Fq12 multiply — the
+# reduction monoid here is multiplicative, so there is no literal psum).
+# Fq12 multiplication is commutative/associative over an exact field and
+# _norm12 is canonical, so the folded product — and the final membership
+# verdict — is bit-identical to the sequential chunk walk.
+_MILLER_SHARDED: dict[tuple, object] = {}
+
+
+def _miller_sharded_fn(mesh, chunks_per_shard: int):
+    key = (mesh, chunks_per_shard)
+    fn = _MILLER_SHARDED.get(key)
+    if fn is not None:
+        return fn
+    from jax.experimental.shard_map import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    from eth_consensus_specs_tpu.parallel.mesh_ops import BATCH_AXES
+
+    def _fold_chunk(fs_v):
+        n = _CHUNK
+        while n > 1:
+            half = n // 2
+            fs_v = tw._norm12(tw.fq12_mul(lf(fs_v[:half]), lf(fs_v[half:n]))).v
+            n = half
+        return fs_v[0]
+
+    def local(coeffs, px, py, active):
+        def step(carry, x):
+            co, px_, py_, act = x
+            part = _fold_chunk(miller_from_coeffs(co, px_, py_, act))
+            return tw._norm12(tw.fq12_mul(lf(carry), lf(part))).v, None
+
+        init = tw.fq12_one(()).v
+        part, _ = lax.scan(step, init, (coeffs, px, py, active))
+        parts = jax.lax.all_gather(part, BATCH_AXES)  # [S, 2, 3, 2, 15]
+        total = parts[0]
+        for i in range(1, parts.shape[0]):
+            total = tw._norm12(tw.fq12_mul(lf(total), lf(parts[i]))).v
+        return total
+
+    spec = P(BATCH_AXES)
+    fn = jax.jit(
+        shard_map(
+            local,
+            mesh=mesh,
+            in_specs=(spec, spec, spec, spec),
+            out_specs=P(),
+            check_rep=False,
+        )
+    )
+    _MILLER_SHARDED[key] = fn
+    return fn
+
+
+def _clear_sharded_after_fork_in_child() -> None:
+    # fork-safety: compiled executables reference the parent's devices
+    _MILLER_SHARDED.clear()
+
+
+os.register_at_fork(after_in_child=_clear_sharded_after_fork_in_child)
+
+
 def _prepare_all(pairs: list) -> None:
     """Fill _PREP_CACHE for every live G2 point in `pairs` in ONE native
     lockstep walk (bls_g2_prepare_many: Montgomery batch inversions across
@@ -348,38 +415,65 @@ def _prepare_all(pairs: list) -> None:
         _PREP_CACHE[(q.x, q.y)] = row
 
 
-def _miller_product(pairs: list):
+def _fill_chunks(pairs: list, n_chunks: int):
+    """Pack pairs into [n_chunks, _CHUNK, ...] kernel inputs; unfilled
+    slots stay inactive (their Miller value folds as 1)."""
+    coeffs = np.zeros((n_chunks, _CHUNK, N_STEPS, 2, 2, N_LIMBS), np.uint64)
+    px = np.zeros((n_chunks, _CHUNK, N_LIMBS), np.uint64)
+    py = np.zeros((n_chunks, _CHUNK, N_LIMBS), np.uint64)
+    active = np.zeros((n_chunks, _CHUNK), bool)
+    for i, (p, q) in enumerate(pairs):
+        if p.is_infinity() or q.is_infinity():
+            continue
+        ci, j = divmod(i, _CHUNK)
+        coeffs[ci, j] = _prepared(q)
+        px[ci, j], py[ci, j] = g1_affine_limbs(p)
+        active[ci, j] = True
+    return coeffs, px, py, active
+
+
+def _miller_product(pairs: list, mesh=None):
     """Product of Miller values over (G1, G2) pairs as a normalized limb
-    array, chunked to the fixed-size kernel."""
+    array, chunked to the fixed-size kernel. With a multi-device `mesh`
+    and more than one chunk of pairs, the chunk axis shards over the mesh
+    (per-shard partial products, psum-style Fq12 combine)."""
+    from eth_consensus_specs_tpu import obs
+    from eth_consensus_specs_tpu.parallel.mesh_ops import pad_to_shards, shard_count
+
     _prepare_all(pairs)
     n_chunks = (len(pairs) + _CHUNK - 1) // _CHUNK
+    shards = shard_count(mesh)
+    if shards > 1 and n_chunks > 1:
+        # one chunk gains nothing from S shards; past that, pad the
+        # chunk count to the mesh and let every shard walk its share
+        padded = pad_to_shards(n_chunks, shards)
+        coeffs, px, py, active = _fill_chunks(pairs, padded)
+        obs.count("mesh.dispatches", 1)
+        obs.count("mesh.sharded_items", len(pairs))
+        fn = _miller_sharded_fn(mesh, padded // shards)
+        return fn(
+            jnp.asarray(coeffs), jnp.asarray(px), jnp.asarray(py), jnp.asarray(active)
+        )
+    coeffs, px, py, active = _fill_chunks(pairs, n_chunks)
     total = None
     for ci in range(n_chunks):
-        chunk = pairs[ci * _CHUNK : (ci + 1) * _CHUNK]
-        coeffs = np.zeros((_CHUNK, N_STEPS, 2, 2, N_LIMBS), np.uint64)
-        px = np.zeros((_CHUNK, N_LIMBS), np.uint64)
-        py = np.zeros((_CHUNK, N_LIMBS), np.uint64)
-        active = np.zeros(_CHUNK, bool)
-        for i, (p, q) in enumerate(chunk):
-            if p.is_infinity() or q.is_infinity():
-                continue
-            coeffs[i] = _prepared(q)
-            px[i], py[i] = g1_affine_limbs(p)
-            active[i] = True
         part = _miller_chunk_fold(
-            jnp.asarray(coeffs), jnp.asarray(px), jnp.asarray(py), jnp.asarray(active)
+            jnp.asarray(coeffs[ci]),
+            jnp.asarray(px[ci]),
+            jnp.asarray(py[ci]),
+            jnp.asarray(active[ci]),
         )
         total = part if total is None else _mul_j(total, part)
     return total
 
 
-def pairing_check_device(pairs: list) -> bool:
+def pairing_check_device(pairs: list, mesh=None) -> bool:
     """prod e(P_i, Q_i) == 1 with the Miller accumulation and final-exp
     membership check on device. Pairs are (G1 Point, G2 Point) host
     objects (subgroup-checked at deserialization)."""
     if not pairs:
         return True
-    ok = bool(final_exp_is_one(_miller_product(pairs)))
+    ok = bool(final_exp_is_one(_miller_product(pairs, mesh=mesh)))
     # the bool() above materialized the device result — record the warm
     # chain for the bench's sentinel gating (utils/cache.mark_warm is a
     # no-op without the persistent cache or on cpu)

@@ -1,0 +1,125 @@
+"""Mesh selection for the sharded hot-kernel dispatch: one serve layer, N chips.
+
+The three hot kernels (BLS RLC pairing, multi-tree merkleization, G1 MSM)
+accept an optional ``mesh``; this module is where the serve layer decides
+WHICH mesh that is. One accessor, :func:`serve_mesh`, snapshots the env
+knobs per call (never inside a traced function — jit-purity) and hands
+back a cached ``(dp, sp)`` mesh over the chips the operator asked for:
+
+    ETH_SPECS_MESH=0           disable sharded dispatch entirely (every
+                               entry point falls back to the bit-identical
+                               single-device path)
+    ETH_SPECS_SERVE_CHIPS=N    chips the serve mesh spans (0/unset = every
+                               local device); ``serve_bench.py --chips``
+                               forces the matching virtual device count
+    ETH_SPECS_MESH_MIN_ITEMS=K smallest live batch worth a sharded
+                               dispatch (below it the single-device bucket
+                               path is cheaper than the padding)
+
+Batch axes shard over BOTH mesh axes (``PartitionSpec((dp, sp))``): the
+hot kernels' batch dimensions (pairing chunks, trees, MSM items/lanes)
+have no preferred axis, so the full device count is the shard count.
+The mesh *signature* (``cpu4x2`` and friends) tags serve bucket shapes
+and warmup keys — a replica must never replay another mesh's compiled
+shapes (serve/buckets.py).
+"""
+
+from __future__ import annotations
+
+import os
+
+import jax
+from jax.sharding import Mesh
+
+from eth_consensus_specs_tpu import obs
+
+from . import DP_AXIS, SP_AXIS, make_mesh
+
+# the shard axes of every batch-sharded hot kernel: one logical axis over
+# the whole device grid
+BATCH_AXES = (DP_AXIS, SP_AXIS)
+
+_MESH_CACHE: dict[int, Mesh] = {}
+
+
+def _clear_cache_after_fork_in_child() -> None:
+    # fork-safety: a gen-pool child must rebuild meshes against ITS
+    # runtime's device objects, not the parent's
+    _MESH_CACHE.clear()
+
+
+os.register_at_fork(after_in_child=_clear_cache_after_fork_in_child)
+
+
+def mesh_enabled() -> bool:
+    return os.environ.get("ETH_SPECS_MESH", "1") != "0"
+
+
+def chips_requested() -> int:
+    """Operator-requested serve-mesh chip count; 0 = every local device."""
+    raw = os.environ.get("ETH_SPECS_SERVE_CHIPS", "")
+    try:
+        return max(int(raw), 0) if raw else 0
+    except ValueError:
+        return 0
+
+
+def min_items() -> int:
+    """Smallest live batch a sharded dispatch is worth (crossover knob)."""
+    raw = os.environ.get("ETH_SPECS_MESH_MIN_ITEMS", "")
+    try:
+        return max(int(raw), 1) if raw else 2
+    except ValueError:
+        return 2
+
+
+def serve_mesh(chips: int | None = None) -> Mesh | None:
+    """The serve layer's dispatch mesh, or None for the single-device
+    path. ``chips`` overrides ``ETH_SPECS_SERVE_CHIPS`` (the bench builds
+    a chips=1 and a chips=N service in one process); the count is capped
+    at the local device count. Env is snapshotted per call — a flip
+    mid-flush changes the NEXT dispatch, never a traced one."""
+    if not mesh_enabled():
+        return None
+    n_local = len(jax.local_devices())
+    want = chips_requested() if chips is None else max(int(chips), 0)
+    n = min(want, n_local) if want else n_local
+    if n < 2:
+        return None
+    mesh = _MESH_CACHE.get(n)
+    if mesh is None:
+        mesh = make_mesh(n)
+        _MESH_CACHE[n] = mesh
+        obs.gauge("mesh.devices", n)
+        obs.event(
+            "mesh.serve_mesh",
+            devices=n,
+            dp=int(mesh.shape[DP_AXIS]),
+            sp=int(mesh.shape[SP_AXIS]),
+            signature=mesh_signature(mesh),
+        )
+    return mesh
+
+
+def shard_count(mesh: Mesh | None) -> int:
+    """Total shards a batch axis splits into (1 for no mesh)."""
+    if mesh is None:
+        return 1
+    return int(mesh.shape[DP_AXIS]) * int(mesh.shape[SP_AXIS])
+
+
+def mesh_signature(mesh: Mesh | None) -> str:
+    """Compact identity of a mesh for bucket/warmup keys: platform plus
+    the (dp, sp) grid — ``cpu4x2``, ``tpu8x2``. Single-device dispatch
+    has NO signature (bucket keys stay byte-compatible with every run
+    before mesh dispatch existed)."""
+    if mesh is None:
+        return ""
+    platform = next(iter(mesh.devices.flat)).platform
+    return f"{platform}{int(mesh.shape[DP_AXIS])}x{int(mesh.shape[SP_AXIS])}"
+
+
+def pad_to_shards(n: int, shards: int) -> int:
+    """Smallest multiple of ``shards`` >= n (the divisibility floor every
+    batch-sharded kernel pads to)."""
+    return shards * -(-n // shards)

@@ -130,12 +130,56 @@ def make_hybrid_mesh(sp_per_host: int | None = None) -> Mesh:
     return Mesh(grid, (DP_AXIS, SP_AXIS))
 
 
-def host_local_slice(mesh: Mesh, n_global: int) -> tuple[int, int]:
+class ShardRemainderError(ValueError):
+    """`n_global` does not divide the mesh's shard count — an even
+    per-shard split would silently orphan the remainder rows. Pad the
+    global axis to :func:`padded_global` (and pass ``pad=True``) or keep
+    the axis divisible."""
+
+    def __init__(self, n_global: int, n_shards: int):
+        self.n_global = n_global
+        self.n_shards = n_shards
+        self.remainder = n_global % n_shards
+        super().__init__(
+            f"n_global={n_global} leaves {self.remainder} rows beyond an even "
+            f"{n_shards}-shard split; pad to {padded_global(n_global, n_shards)} "
+            "(host_local_slice(..., pad=True) slices the padded domain) or "
+            "keep the axis divisible"
+        )
+
+
+def padded_global(n_global: int, n_shards: int) -> int:
+    """Smallest multiple of the shard count >= n_global — the padded
+    domain ``host_local_slice(..., pad=True)`` slices."""
+    return n_shards * -(-n_global // n_shards)
+
+
+def host_local_slice(mesh: Mesh, n_global: int, pad: bool = False) -> tuple[int, int]:
     """[start, stop) of the validator rows this process owns under a
     dp-sharded array on `mesh` — the addressable block a host feeds or
-    reads without cross-host transfers (jax.Array per-shard semantics)."""
+    reads without cross-host transfers (jax.Array per-shard semantics).
+
+    A `n_global` that does not divide the shard count used to silently
+    truncate: every shard got ``n_global // n_shards`` rows and the
+    remainder belonged to nobody. Now the remainder is counted
+    (``multihost.slice_remainder``) and either raises the typed
+    :class:`ShardRemainderError` (default) or, with ``pad=True``, slices
+    the :func:`padded_global` domain — callers pad their arrays to it,
+    exactly like the kernels pad their batch axes."""
     n_shards = mesh.shape[DP_AXIS] * mesh.shape[SP_AXIS]
-    per = n_global // n_shards
+    rem = n_global % n_shards
+    if rem:
+        obs.count("multihost.slice_remainder", rem)
+        obs.event(
+            "multihost.slice_remainder",
+            n_global=int(n_global),
+            n_shards=int(n_shards),
+            remainder=int(rem),
+            padded=bool(pad),
+        )
+        if not pad:
+            raise ShardRemainderError(n_global, n_shards)
+    per = padded_global(n_global, n_shards) // n_shards if rem else n_global // n_shards
     local_ids = {
         i for i, d in enumerate(mesh.devices.flat) if d.process_index == jax.process_index()
     }

@@ -54,6 +54,22 @@ def device_subtree_worthwhile(n_chunks: int, trees: int = 1) -> bool:
     return trees * n_chunks >= DEVICE_SUBTREE_THRESHOLD
 
 
+# Above this many TOTAL leaf chunks per dispatch the mesh-sharded path
+# beats the single-device one (measured on the 8-virtual-device CPU
+# mesh: 512 chunks = 0.4x — pure shard_map/collective overhead — while
+# 2048 chunks already wins 7x; real accelerator meshes only move the
+# crossover DOWN). Below it the service keeps the single-device bucket
+# path; correctness is identical either way.
+MESH_SUBTREE_THRESHOLD = 2048
+
+
+def mesh_dispatch_worthwhile(n_chunks: int, trees: int = 1) -> bool:
+    """Is a flush of `trees` subtrees x `n_chunks` leaf chunks big
+    enough that sharding its tree axis over the mesh pays for the
+    collective machinery?"""
+    return trees * n_chunks >= MESH_SUBTREE_THRESHOLD
+
+
 def pow2_bucket(n: int) -> int:
     """Smallest power of two >= n (n >= 1)."""
     return 1 << max(n - 1, 0).bit_length()
@@ -66,6 +82,21 @@ def batch_bucket(n: int, buckets: tuple[int, ...]) -> int:
         if b >= n:
             return b
     return buckets[-1]
+
+
+def mesh_batch_bucket(n: int, shards: int, buckets: tuple[int, ...]) -> int:
+    """Mesh-aware padding target: the PER-SHARD tree count is what gets
+    bucketed (smallest configured bucket >= ceil(n / shards)), and the
+    dispatch pads to shards x that. For pow2 shard counts this equals the
+    global bucket — same total padding, now split evenly — and for
+    non-pow2 meshes it pads strictly less than the global pow2 would
+    (an N-chip mesh must not 2x the padding waste just to stay pow2
+    globally). Compile keys built from this carry the mesh signature, so
+    a warmup artifact can never replay another mesh's shapes."""
+    if shards <= 1:
+        return batch_bucket(n, buckets)
+    per = -(-n // shards)
+    return shards * batch_bucket(per, buckets)
 
 
 def subtree_depth(n_chunks: int) -> int:
@@ -91,13 +122,16 @@ def _reinit_lock_after_fork_in_child() -> None:
 os.register_at_fork(after_in_child=_reinit_lock_after_fork_in_child)
 
 
-def note_dispatch(op: str, *dims: int) -> bool:
+def note_dispatch(op: str, *dims) -> bool:
     """Record a dispatch of shape key (op, *dims). Returns True (and
     bumps ``serve.compiles``) on the FIRST sighting — the dispatch that
     pays the jit compile — False for every shape the process has already
-    compiled. The counter is what the bench asserts 'at most
-    len(buckets) compiles after warmup' against."""
-    key = (op, *map(int, dims))
+    compiled. Dims are ints plus, for mesh-sharded shapes, the mesh
+    signature string (parallel/mesh_ops.mesh_signature) — the same
+    padded batch compiles per mesh, and the warmup artifact must say
+    which. The counter is what the bench asserts 'at most len(buckets)
+    compiles after warmup' against."""
+    key = (op, *(d if isinstance(d, str) else int(d) for d in dims))
     with _SEEN_LOCK:
         if key in _SEEN_SHAPES:
             return False
@@ -131,7 +165,7 @@ class first_dispatch:
 
     __slots__ = ("op", "dims", "first", "_t0")
 
-    def __init__(self, op: str, *dims: int):
+    def __init__(self, op: str, *dims):
         self.op = op
         self.dims = dims
 
@@ -212,44 +246,80 @@ def load_warmup(path: str | None = None) -> list[tuple]:
     return out
 
 
-def precompile(keys: list[tuple] | None = None, path: str | None = None) -> int:
+def _key_mesh(dims: tuple, chips: int | None = None):
+    """Split (.., sig?) trailing mesh signature off a shape key and
+    resolve it against the live serve mesh — `chips` overrides the env
+    default so a caller dispatching on an explicit sub-mesh (bench
+    --chips, ServeConfig.mesh_chips) warms ITS mesh's keys, not the
+    whole host's: (int_dims, mesh, ok). A key from another mesh shape
+    (or a mesh key replayed without a live mesh) is skipped, never
+    compiled wrong — ok=False."""
+    from eth_consensus_specs_tpu.parallel.mesh_ops import mesh_signature, serve_mesh
+
+    if not (dims and isinstance(dims[-1], str)):
+        return tuple(int(d) for d in dims), None, True
+    sig = dims[-1]
+    mesh = serve_mesh(chips)
+    if mesh is None or mesh_signature(mesh) != sig:
+        return tuple(int(d) for d in dims[:-1]), None, False
+    return tuple(int(d) for d in dims[:-1]), mesh, True
+
+
+def precompile(
+    keys: list[tuple] | None = None, path: str | None = None, chips: int | None = None
+) -> int:
     """Compile every known bucket shape ahead of traffic. With no
     explicit `keys`, replays the persistent warmup list — from ``path``
     when given (the SHIPPABLE warmup artifact: one replica or a CI run
     writes it, every later boot consumes it), else from
     ``ETH_SPECS_SERVE_WARMUP``. Returns the number of shapes warmed.
     Unknown ops are skipped (a warmup file written by a newer version
-    must not crash an older server)."""
+    must not crash an older server), and mesh-signed keys are replayed
+    ONLY when the live serve mesh matches the signature — an 8-chip
+    artifact must not poison a single-chip boot with alien shapes
+    (``serve.precompile_skipped`` event per skip)."""
     import numpy as np
 
     warmed = 0
     for key in keys if keys is not None else load_warmup(path):
         op, dims = key[0], key[1:]
         try:
-            if op == "merkle_many" and len(dims) == 2:
+            int_dims, mesh, ok = _key_mesh(tuple(dims), chips)
+            if not ok:
+                obs.event(
+                    "serve.precompile_skipped",
+                    op=op,
+                    dims=",".join(map(str, dims)),
+                    reason="mesh-signature mismatch",
+                )
+                continue
+            if op == "merkle_many" and len(int_dims) == 2:
                 from eth_consensus_specs_tpu.ops.merkle import merkleize_many_device
 
-                batch, depth = int(dims[0]), int(dims[1])
+                batch, depth = int_dims
                 zero = np.zeros((1, 8), np.uint32)
                 # warmup compiles are first dispatches like any other:
                 # their wall time lands in serve.compile_ms too
-                with first_dispatch("merkle_many", batch, depth):
-                    merkleize_many_device([zero], depth, pad_batch=batch)
-            elif op == "bls_msm" and len(dims) == 1:
+                with first_dispatch(op, *dims):
+                    merkleize_many_device([zero], depth, pad_batch=batch, mesh=mesh)
+            elif op == "bls_msm" and len(int_dims) in (1, 2):
                 from eth_consensus_specs_tpu.ops.bls_batch import _use_device, verify_many
 
                 if not _use_device():
                     continue  # host backend: there is no MSM kernel to warm
-                n = int(dims[0])
+                # legacy 1-dim keys are (lanes,); current keys are
+                # (items, lanes[, sig]) — the many_sum_shape bucket
+                items, lanes = (1, int_dims[0]) if len(int_dims) == 1 else int_dims
                 from eth_consensus_specs_tpu.utils import bls as _bls
 
-                # a throwaway aggregate of n copies of one pubkey: the
-                # verdict is discarded, only the pow2-committee-size MSM
-                # compile matters
+                # a throwaway aggregate repeated `items` times with
+                # `lanes` copies of one pubkey: verdicts are discarded,
+                # only the (items, lanes) sum-kernel compile matters.
+                # verify_many's own first_dispatch accounts the compile
+                # (bls_batch._rlc_pubkey_terms), so none is taken here.
                 pk, msg = _bls.SkToPk(1), b"\x00" * 32
-                sig = bytes(_bls.Sign(1, msg))
-                with first_dispatch("bls_msm", n):
-                    verify_many([([bytes(pk)] * n, msg, sig)])
+                sig_b = bytes(_bls.Sign(1, msg))
+                verify_many([([bytes(pk)] * lanes, msg, sig_b)] * items, mesh=mesh)
             else:
                 continue
         except Exception:

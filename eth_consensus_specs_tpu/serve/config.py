@@ -25,6 +25,10 @@ a real accelerator):
     ETH_SPECS_SERVE_WARMUP=<path>     persistent JSONL of compiled
                                       shape keys (serve/buckets.py);
                                       precompile() replays it
+    ETH_SPECS_SERVE_CHIPS=0           chips the dispatch mesh spans
+                                      (parallel/mesh_ops.serve_mesh;
+                                      0 = every local device, 1 =
+                                      single-device dispatch)
 
 Replicated front door (serve/frontdoor.py):
 
@@ -82,6 +86,11 @@ class ServeConfig:
     # its own future), wrong as a default (it would flush the first
     # request of every concurrent burst alone)
     idle_flush: bool = False
+    # chips the dispatch mesh spans: 0 = the process-wide default
+    # (ETH_SPECS_SERVE_CHIPS via parallel/mesh_ops.serve_mesh), 1 =
+    # force the single-device path for THIS service (the mesh bench
+    # runs a chips=1 and a chips=N service in one process)
+    mesh_chips: int = 0
 
     def __post_init__(self):
         # the largest bucket must hold a full flush wherever the config

@@ -58,6 +58,7 @@ from eth_consensus_specs_tpu import fault, obs
 from eth_consensus_specs_tpu.analysis import lockwatch
 from eth_consensus_specs_tpu.obs import trace
 from eth_consensus_specs_tpu.obs.histogram import Histogram
+from eth_consensus_specs_tpu.parallel import mesh_ops
 
 from . import buckets
 from .admission import AdmissionController, Overloaded  # noqa: F401  (re-export)
@@ -271,33 +272,22 @@ class VerifyService:
         no XLA anywhere."""
         if device:
             fault.check("serve.dispatch")
+        mesh = mesh_ops.serve_mesh(self.config.mesh_chips or None) if device else None
         results: dict[int, object] = {}
         bls_reqs = [r for r in reqs if r.kind == "bls"]
         if bls_reqs:
             if device:
-                from eth_consensus_specs_tpu.ops.bls_batch import _use_device, verify_many
+                from eth_consensus_specs_tpu.ops.bls_batch import verify_many
 
-                firsts = 0
-                if _use_device():
-                    # the device G1 MSM compiles per pow2 committee size
-                    # (the kernel's own bucket grid): account first
-                    # sightings so `serve.compiles` covers BLS traffic too
-                    for r in bls_reqs:
-                        if buckets.note_dispatch(
-                            "bls_msm", buckets.pow2_bucket(len(r.payload[0]))
-                        ):
-                            firsts += 1
-                t0 = time.perf_counter()
-                try:
-                    verdicts = verify_many([r.payload for r in bls_reqs])
-                finally:
-                    if firsts:
-                        # every first-sighted committee size paid its
-                        # compile inside this one call: each records the
-                        # same wall so compile_ms.count == serve.compiles
-                        buckets.observe_compile_ms(
-                            "bls_msm", (time.perf_counter() - t0) * 1e3, n=firsts
-                        )
+                # the device G1 MSM seam accounts its own compiles now
+                # (bls_batch._rlc_pubkey_terms wraps the ONE batched
+                # many-sum dispatch in first_dispatch, keyed by the
+                # shared many_sum_shape bucket + mesh signature), so the
+                # service just routes — mesh live shards the item axis
+                verdicts = verify_many(
+                    [r.payload for r in bls_reqs],
+                    mesh=mesh if len(bls_reqs) >= mesh_ops.min_items() else None,
+                )
             else:
                 from eth_consensus_specs_tpu.crypto.signature import fast_aggregate_verify
 
@@ -314,10 +304,29 @@ class VerifyService:
             if device:
                 from eth_consensus_specs_tpu.ops.merkle import merkleize_many_device
 
-                pad = buckets.batch_bucket(len(group), self.config.buckets)
                 trees = [r.prepped if r.prepped is not None else r.payload[0] for r in group]
-                with buckets.first_dispatch("merkle_many", pad, depth):
-                    roots = merkleize_many_device(trees, depth, pad_batch=pad)
+                if (
+                    mesh is not None
+                    and len(group) >= mesh_ops.min_items()
+                    and buckets.mesh_dispatch_worthwhile(1 << depth, len(group))
+                ):
+                    # mesh-sharded dispatch: pad the tree axis to the
+                    # per-shard bucket (not the global pow2) and tag the
+                    # compile key with the mesh signature so warmup
+                    # artifacts stay honest across mesh shapes
+                    shards = mesh_ops.shard_count(mesh)
+                    pad = buckets.mesh_batch_bucket(
+                        len(group), shards, self.config.buckets
+                    )
+                    sig = mesh_ops.mesh_signature(mesh)
+                    with buckets.first_dispatch("merkle_many", pad, depth, sig):
+                        roots = merkleize_many_device(
+                            trees, depth, pad_batch=pad, mesh=mesh
+                        )
+                else:
+                    pad = buckets.batch_bucket(len(group), self.config.buckets)
+                    with buckets.first_dispatch("merkle_many", pad, depth):
+                        roots = merkleize_many_device(trees, depth, pad_batch=pad)
             else:
                 from eth_consensus_specs_tpu.obs.watchdog import host_tree_root_words
                 from eth_consensus_specs_tpu.ops.merkle import _chunks_to_words
@@ -416,8 +425,9 @@ class VerifyService:
     def precompile(self, keys: list[tuple] | None = None, path: str | None = None) -> int:
         """Warm the compile cache from the persistent warmup list (or an
         explicit shippable artifact ``path``, or explicit keys) before
-        taking traffic."""
-        return buckets.precompile(keys, path=path)
+        taking traffic. Mesh-signed keys resolve against THIS service's
+        dispatch mesh (``mesh_chips``), not the host-wide default."""
+        return buckets.precompile(keys, path=path, chips=self.config.mesh_chips or None)
 
     def close(self, timeout: float = 30.0) -> None:
         """Drain queued requests (a final ``close`` flush), stop both

@@ -104,6 +104,15 @@ def load_rounds(repo_dir: str) -> list[dict]:
         for name, value in (parsed.get("xprof") or {}).items():
             if isinstance(value, (int, float)):
                 metrics[f"xprof_{name}"] = value
+        # mesh-sharded dispatch (serve_bench --chips): the per-chip
+        # scaling factors ride the same platform-keyed timeline — a cpu
+        # virtual-mesh factor never compares against an accelerator's —
+        # and, as secondaries, regress to advisories, not gates
+        for name, value in (parsed.get("mesh") or {}).items():
+            if isinstance(value, (int, float)) and (
+                name.endswith("_scaling") or name == "chip_scaling"
+            ):
+                metrics[f"mesh_{name}"] = value
         entry.update(
             status="ok",
             platform=infer_platform(parsed),

@@ -53,6 +53,20 @@ distributed-systems contract instead of the batching contract:
 
 ``--warmup-out`` writes the shippable warmup artifact (every compiled
 shape key) for CI to upload; replicated runs also boot FROM it.
+
+Mesh mode (``--chips N``, the mesh-smoke CI job): forces N virtual CPU
+devices (``--xla_force_host_platform_device_count``; real devices on
+accelerators), then measures every hot kernel chips=1 vs chips=N in one
+process — merkleization through a 1-chip and an N-chip VerifyService
+(mesh-aware buckets, signed warmup keys), the G1 MSM as a direct kernel
+loop, and the sharded RLC pairing when the backend affords the Miller
+compile (``--mesh-pairing`` opts the CPU mesh in). Gates: byte parity
+on every sharded result, zero cold compiles after the mesh-aware warmup
+replay, zero watchdog divergences, and best per-effective-chip scaling
+>= ``--scaling-min`` (effective chips = min(chips, cores) on the
+virtual CPU mesh — 8 virtual devices on 2 cores cannot honestly beat
+2x). The report's ``mesh`` section feeds perf_track.py as
+platform-aware secondary metrics.
 """
 
 from __future__ import annotations
@@ -69,6 +83,43 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
 
 os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+
+def _force_chip_count() -> None:
+    """``--chips N`` (or ETH_SPECS_SERVE_CHIPS) needs N devices; on the
+    CPU platform that means the virtual device count must be forced
+    BEFORE the XLA backend initializes — XLA reads XLA_FLAGS once at
+    client init, so this runs ahead of every jax-touching import."""
+    n = 0
+    argv = sys.argv
+    for i, a in enumerate(argv):
+        if a == "--chips" and i + 1 < len(argv):
+            try:
+                n = int(argv[i + 1])
+            except ValueError:
+                pass
+        elif a.startswith("--chips="):
+            try:
+                n = int(a.split("=", 1)[1])
+            except ValueError:
+                pass
+    if n <= 1:
+        try:
+            n = int(os.environ.get("ETH_SPECS_SERVE_CHIPS", "0") or 0)
+        except ValueError:
+            n = 0
+    flags = os.environ.get("XLA_FLAGS", "")
+    if (
+        n > 1
+        and os.environ.get("JAX_PLATFORMS") == "cpu"
+        and "xla_force_host_platform_device_count" not in flags
+    ):
+        os.environ["XLA_FLAGS"] = (
+            flags + f" --xla_force_host_platform_device_count={n}"
+        ).strip()
+
+
+_force_chip_count()
 
 import numpy as np  # noqa: E402
 
@@ -245,13 +296,17 @@ def run_replicated(args) -> None:
         config=cfg,
         fd_config=FrontDoorConfig.from_env(),
         warmup_path=warmup_path,
-        # the bls_msm key matters on device backends (the MSM kernel
-        # compiles per pow2 committee size; precompile skips it when
-        # _use_device() is off) — without it the bls home replica's
-        # first dispatch would be a cold compile after mark_ready and
-        # fail this run's own compiles_after_ready gate
+        # the bls_msm keys matter on device backends (the batched G1
+        # many-sum kernel compiles per (flush-items, committee-lanes)
+        # bucket; precompile skips them when _use_device() is off) —
+        # without them the bls home replica's first dispatch would be a
+        # cold compile after mark_ready and fail this run's own
+        # compiles_after_ready gate
         warm_keys=[("merkle_many", b, args.tree_depth) for b in cfg.buckets]
-        + [("bls_msm", serve_buckets.pow2_bucket(args.committee))],
+        + [
+            ("bls_msm", b, serve_buckets.pow2_bucket(args.committee))
+            for b in cfg.buckets
+        ],
         replica_fault_spec=fault_spec,
         name="bench-fd",
     )
@@ -362,6 +417,278 @@ def run_replicated(args) -> None:
     finish_report(report, failures, args.out, "serve_bench.replicated_failure", snap)
 
 
+def _timed_reps(fn, reps: int) -> float:
+    """Median-free simple wall: one warm call (pays any compile), then
+    `reps` timed calls; returns seconds per call."""
+    fn()
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        fn()
+    return (time.perf_counter() - t0) / reps
+
+
+def run_mesh(args) -> None:
+    """The --chips N closed-loop mode: every kernel measured chips=1 vs
+    chips=N IN ONE PROCESS (a 1-device mesh service vs an N-device mesh
+    service; direct kernel loops for MSM/pairing), gating
+
+      * byte parity — every sharded result identical to the
+        single-device path (and to the direct per-request ops calls);
+      * zero cold compiles after the mesh-aware warmup replay;
+      * zero watchdog divergences;
+      * scaling: best per-effective-chip factor >= --scaling-min, where
+        effective chips = min(chips, cpu cores) on the virtual CPU mesh
+        (8 virtual devices on 2 cores cannot beat 2x — gating against
+        physical parallelism is what keeps this honest) and = chips on
+        real accelerators.
+
+    The report's ``mesh`` section is what perf_track.py ingests as
+    platform-aware secondary metrics (``mesh_*``)."""
+    import jax
+
+    from eth_consensus_specs_tpu.crypto.curve import g1_generator
+    from eth_consensus_specs_tpu.crypto.msm import msm_g1
+    from eth_consensus_specs_tpu.ops.g1_msm import (
+        msm_g1_device,
+        sum_g1_device,
+        sum_g1_many_device,
+    )
+    from eth_consensus_specs_tpu.parallel import mesh_ops
+
+    export.maybe_serve_http()
+    chips = args.chips
+    devices = jax.local_devices()
+    platform = devices[0].platform
+    mesh = mesh_ops.serve_mesh(chips)
+    shards = mesh_ops.shard_count(mesh)
+    sig = mesh_ops.mesh_signature(mesh)
+    failures = []
+    if shards < 2:
+        failures.append(
+            f"--chips {chips} but only {len(devices)} {platform} devices — no mesh"
+        )
+    cores = os.cpu_count() or 1
+    effective = min(chips, cores, max(shards, 1)) if platform == "cpu" else chips
+    reps = 2 if args.smoke else 4
+    sections: dict = {}
+
+    # --- merkle: through the REAL serve path, 1-chip vs N-chip service --
+    # The serve leg uses trees big enough to clear the mesh crossover
+    # (depth >= 9: a max_batch flush of 512-chunk trees passes
+    # MESH_SUBTREE_THRESHOLD) so the N-chip service genuinely shards —
+    # a smoke that only ever took the single-device fallback would gate
+    # nothing about the mesh routing or the signed warmup keys.
+    serve_depth = max(args.tree_depth, 9)
+    cfg1 = ServeConfig.from_env(
+        max_batch=min(max(args.submitters // 2, 1), 32), mesh_chips=1
+    )
+    cfgN = ServeConfig.from_env(max_batch=cfg1.max_batch, mesh_chips=chips)
+    trees = build_trees(args.requests, serve_depth)
+    direct_roots = [merkleize_subtree_device(t, serve_depth) for t in trees]
+    warm = [("merkle_many", b, serve_depth) for b in cfg1.buckets]
+    if mesh is not None:
+        # signed keys only for the flush sizes the service will actually
+        # shard (the mesh crossover keeps toy flushes single-device)
+        warm += [
+            ("merkle_many", pad, serve_depth, sig)
+            for pad in sorted(
+                {
+                    serve_buckets.mesh_batch_bucket(n, shards, cfgN.buckets)
+                    for n in range(1, cfgN.max_batch + 1)
+                    if n >= mesh_ops.min_items()
+                    and serve_buckets.mesh_dispatch_worthwhile(1 << serve_depth, n)
+                }
+            )
+        ]
+    if args.mesh_pairing or platform != "cpu":
+        # the pairing section's verify_many pays the batched G1 many-sum
+        # compile under the device bls backend — warm its exact
+        # many_sum_shape keys (unsigned + signed) or those dispatches
+        # would land AFTER the compile snapshot and fail the gate (a
+        # parse-rejected item can shrink the live count across a pow2
+        # boundary, so the n-1 shapes are warmed too)
+        from eth_consensus_specs_tpu.ops.bls_batch import _use_device
+        from eth_consensus_specs_tpu.ops.g1_msm import many_sum_shape
+
+        if _use_device():
+            n_p = max(args.requests // 8, 8)
+            pair_shapes = {many_sum_shape(n, args.committee, 1) for n in (n_p, n_p - 1)}
+            warm += [("bls_msm", *shape) for shape in sorted(pair_shapes)]
+            if mesh is not None:
+                mesh_shapes = {
+                    many_sum_shape(n, args.committee, shards) for n in (n_p, n_p - 1)
+                }
+                warm += [("bls_msm", *shape, sig) for shape in sorted(mesh_shapes)]
+    serve_buckets.precompile(warm, chips=chips)
+    compiles_after_warmup = obs.snapshot()["counters"].get("serve.compiles", 0)
+
+    load_htr = [("htr", t) for t in trees]
+    svc1 = serve.VerifyService(cfg1, name="mesh1")
+    s1_wall, got1, _ = closed_loop(svc1, load_htr, args.submitters)
+    svc1.close()
+    svcN = serve.VerifyService(cfgN, name=f"mesh{chips}")
+    sN_wall, gotN, _ = closed_loop(svcN, load_htr, args.submitters)
+    svcN.close()
+    if got1 != direct_roots:
+        failures.append("merkle parity: 1-chip service roots != direct ops roots")
+    if gotN != direct_roots:
+        failures.append(f"merkle parity: {chips}-chip service roots != direct ops roots")
+    sections["merkle_serve"] = {
+        "rps_1chip": round(len(load_htr) / s1_wall, 2),
+        "rps_nchip": round(len(load_htr) / sN_wall, 2),
+        "speedup": round(s1_wall / sN_wall, 3),
+        "parity": got1 == direct_roots and gotN == direct_roots,
+    }
+
+    # --- merkle kernel scaling: bucket-sized trees, direct dispatch -----
+    # The serve smoke runs toy depths for the parity/compile gates; the
+    # SCALING measurement needs real bucket sizes (a depth-6 tree is 64
+    # hashes — pure dispatch overhead, which an 8-shard mesh can only
+    # lose on). Depth 10-12 x 64 trees is the beacon-state subtree
+    # regime the sharded path exists for.
+    from eth_consensus_specs_tpu.ops.merkle import merkleize_many_device
+
+    rng = np.random.default_rng(7)
+    scale_depth = 10 if args.smoke else 12
+    scale_b = 64
+    big = [
+        rng.integers(0, 256, size=(1 << scale_depth, 32)).astype(np.uint8)
+        for _ in range(scale_b)
+    ]
+    roots_1 = merkleize_many_device(big, scale_depth, pad_batch=scale_b)
+    roots_n = merkleize_many_device(big, scale_depth, pad_batch=scale_b, mesh=mesh)
+    if roots_1 != roots_n:
+        failures.append("merkle parity: sharded kernel roots != single-device roots")
+    t1 = _timed_reps(
+        lambda: merkleize_many_device(big, scale_depth, pad_batch=scale_b), reps
+    )
+    tn = _timed_reps(
+        lambda: merkleize_many_device(big, scale_depth, pad_batch=scale_b, mesh=mesh),
+        reps,
+    )
+    speedup = t1 / tn
+    sections["merkle"] = {
+        "depth": scale_depth,
+        "trees": scale_b,
+        "rps_1chip": round(scale_b / t1, 2),
+        "rps_nchip": round(scale_b / tn, 2),
+        "speedup": round(speedup, 3),
+        "scaling_factor": round(speedup / effective, 3),
+        "parity": roots_1 == roots_n,
+    }
+
+    # --- G1 MSM: direct kernel loop, batched many-sum + scalar MSM ------
+    # End-to-end walls include the host limb packing both paths share
+    # (the service overlaps that prep with dispatch, a kernel loop
+    # cannot), so this section's factor understates the device scaling —
+    # reported, and gated only through best-of-kernels.
+    G = g1_generator()
+    lanes = 32 if args.smoke else 64
+    items = 32 if args.smoke else 64
+    lists = [
+        [G.mul(1 + ((7 * i + j) % 961)) for j in range(lanes)] for i in range(items)
+    ]
+    per_item = [sum_g1_device(pts) for pts in lists]
+    sums_1 = sum_g1_many_device(lists)
+    sums_n = sum_g1_many_device(lists, mesh=mesh)
+    if not (sums_1 == per_item and sums_n == per_item):
+        failures.append("msm parity: sharded/batched committee sums diverge")
+    t1 = _timed_reps(lambda: sum_g1_many_device(lists), reps)
+    tn = _timed_reps(lambda: sum_g1_many_device(lists, mesh=mesh), reps)
+    msm_speedup = t1 / tn
+    sections["msm"] = {
+        "items": items,
+        "lanes": lanes,
+        "rps_1chip": round(items / t1, 2),
+        "rps_nchip": round(items / tn, 2),
+        "speedup": round(msm_speedup, 3),
+        "scaling_factor": round(msm_speedup / effective, 3),
+        "parity": sums_1 == per_item and sums_n == per_item,
+    }
+    if not args.smoke:
+        # scalar-MSM parity (the 256-bit double-and-add lanes + the
+        # cross-shard Jacobian reduction); compile-heavy, full mode only
+        pts = [G.mul(i + 3) for i in range(lanes)]
+        ks = [(1 << 62) + 977 * i for i in range(lanes)]
+        if not (msm_g1_device(pts, ks, mesh=mesh) == msm_g1_device(pts, ks) == msm_g1(pts, ks)):
+            failures.append("msm parity: sharded scalar MSM != single-device != host")
+
+    # --- RLC pairing: device Miller chunks sharded over the mesh --------
+    # The Miller scan's one-time XLA:CPU compile is minutes — the virtual
+    # CPU mesh runs it only on request (--mesh-pairing); accelerator
+    # backends always do. Bit-parity incl. the bisection invalid-item
+    # path is covered on the CPU mesh by tests/test_mesh_ops.py.
+    if args.mesh_pairing or platform != "cpu":
+        os.environ["ETH_SPECS_TPU_DEVICE_PAIRING"] = "1"
+        items_p = build_bls_items(max(args.requests // 8, 8), args.committee, 4)
+        v1 = bls_batch.verify_many(items_p)
+        vn = bls_batch.verify_many(items_p, mesh=mesh)
+        if v1 != vn:
+            failures.append("pairing parity: sharded verify_many verdicts diverge")
+        tp1 = _timed_reps(lambda: bls_batch.verify_many(items_p), 1)
+        tpn = _timed_reps(lambda: bls_batch.verify_many(items_p, mesh=mesh), 1)
+        p_speedup = tp1 / tpn
+        sections["pairing"] = {
+            "items": len(items_p),
+            "speedup": round(p_speedup, 3),
+            "scaling_factor": round(p_speedup / effective, 3),
+            "parity": v1 == vn,
+        }
+    else:
+        sections["pairing"] = {"skipped": "cpu Miller compile is minutes; "
+                               "run with --mesh-pairing to include it"}
+
+    # --- gates -----------------------------------------------------------
+    snap = obs.snapshot()
+    counters = snap["counters"]
+    extra = counters.get("serve.compiles", 0) - compiles_after_warmup
+    if extra > 0:
+        failures.append(
+            f"{extra} compiles AFTER the mesh-aware warmup replay "
+            "(a shape escaped the mesh buckets or the signature)"
+        )
+    obs.count("serve.compiles_after_warmup", max(extra, 0))
+    if snap["watchdog"]["divergences"] != 0:
+        failures.append(f"watchdog divergences: {snap['watchdog']}")
+    factors = [
+        s["scaling_factor"] for s in sections.values() if "scaling_factor" in s
+    ]
+    best = max(factors) if factors else 0.0
+    if best < args.scaling_min:
+        failures.append(
+            f"best per-effective-chip scaling {best} < {args.scaling_min} "
+            f"(chips={chips}, effective={effective}, platform={platform})"
+        )
+    snap = obs.snapshot()
+
+    report = {
+        "mode": "mesh-smoke" if args.smoke else "mesh",
+        "platform": platform,
+        "requests": args.requests,
+        "submitters": args.submitters,
+        "mesh": {
+            "chips": chips,
+            "devices": len(devices),
+            "shards": shards,
+            "signature": sig,
+            "effective_parallelism": effective,
+            "chip_scaling": best,
+            "merkle_scaling": sections["merkle"]["scaling_factor"],
+            "msm_scaling": sections["msm"]["scaling_factor"],
+        },
+        "sections": sections,
+        "compiles": counters.get("serve.compiles", 0),
+        "compiles_after_warmup": max(extra, 0),
+        "mesh_dispatches": counters.get("mesh.dispatches", 0),
+        "watchdog": snap["watchdog"],
+        "scaling_min": args.scaling_min,
+    }
+    if args.warmup_out:
+        report["warmup_artifact"] = args.warmup_out
+        report["warmup_keys"] = serve_buckets.write_warmup(args.warmup_out)
+    finish_report(report, failures, args.out, "serve_bench.mesh_failure", snap)
+
+
 def main() -> None:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--smoke", action="store_true", help="small CI run, skip the 2x gate")
@@ -376,11 +703,25 @@ def main() -> None:
                     help="with --replicas: SIGKILL one replica mid-load")
     ap.add_argument("--warmup-out", default=None,
                     help="write the shippable warmup artifact here")
+    ap.add_argument("--chips", type=int,
+                    default=int(os.environ.get("ETH_SPECS_SERVE_CHIPS", "0") or 0),
+                    help="mesh mode: gate chips=1 -> N scaling (virtual CPU "
+                         "devices locally, real devices on accelerators)")
+    ap.add_argument("--scaling-min", type=float,
+                    default=float(os.environ.get("ETH_SPECS_MESH_SCALING_MIN", "0.7")
+                                  or 0.7),
+                    help="minimum per-effective-chip scaling factor")
+    ap.add_argument("--mesh-pairing", action="store_true",
+                    help="include the sharded device pairing on the CPU mesh "
+                         "(one-time Miller compile is minutes)")
     args = ap.parse_args()
     if args.smoke:
         args.submitters = min(args.submitters, 16)
         args.requests = min(args.requests, 64)
         args.tree_depth = min(args.tree_depth, 6)
+    if args.chips > 1:
+        run_mesh(args)
+        return
     if args.replicas > 0:
         run_replicated(args)
         return
