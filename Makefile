@@ -2,15 +2,17 @@
 
 PYTHON ?= python
 
-.PHONY: help install test test-fast lint speclint reftests bytediff bench multichip postmortem serve_docs coverage clean
+.PHONY: help install test test-fast lint speclint jaxlint reftests bytediff bench multichip postmortem serve_docs coverage clean
 
 help:
 	@echo "install    - editable install with test extras"
 	@echo "test       - FAST lane: suite minus @slow (CPU, 8 virtual devices)"
 	@echo "test-full  - everything incl. @slow (the nightly lane)"
 	@echo "test-slow  - only the @slow modules"
-	@echo "lint       - ruff check (if installed) + speclint + env-docs diff"
-	@echo "speclint   - project-native static analysis only (docs/analysis.md)"
+	@echo "lint       - ruff check (if installed) + speclint + jaxlint + env-docs diff"
+	@echo "speclint   - AST-level project-native static analysis (docs/analysis.md)"
+	@echo "jaxlint    - trace-level kernel analysis: transfers, donation,"
+	@echo "             recompile surfaces, mesh collectives (docs/analysis.md)"
 	@echo "reftests   - emit test vectors to ./test_vectors"
 	@echo "bytediff   - conformance byte-diff vs the compiled reference spec"
 	@echo "bench      - run the driver benchmark"
@@ -56,16 +58,25 @@ mainnet-smoke:
 
 test-fast: test
 
-# ruff (style, best-effort) then speclint (project invariants, GATING:
-# fork-safety, lock-order, jit-purity, obs/env/fault registries —
-# docs/analysis.md); env-reference.md must match the env registry
+# ruff (style, best-effort) then speclint (AST-level project invariants,
+# GATING: fork-safety, lock-order, jit-purity, obs/env/fault registries)
+# then jaxlint (trace-level kernel invariants, GATING: transfer-free,
+# donation-audit, recompile-surface, collective-audit, constant-bloat,
+# x64-drift — docs/analysis.md); env-reference.md must match the registry
 lint:
 	-$(PYTHON) -m ruff check eth_consensus_specs_tpu/ tests/
 	$(PYTHON) scripts/speclint.py
+	$(PYTHON) scripts/jaxlint.py
 	$(PYTHON) scripts/gen_env_docs.py --check
 
 speclint:
 	$(PYTHON) scripts/speclint.py
+
+# trace-level analysis of every registered kernel (analysis/kernels.py);
+# --chips 8 is the CLI default, so the three mesh-sharded variants are
+# analyzed on 8 virtual CPU devices even on a 1-device dev box
+jaxlint:
+	$(PYTHON) scripts/jaxlint.py
 
 reftests:
 	$(PYTHON) -m eth_consensus_specs_tpu.gen -o test_vectors -v

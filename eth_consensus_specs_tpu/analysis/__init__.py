@@ -19,8 +19,16 @@ them:
     instrumented-lock wrapper that records per-thread acquisition
     orders and flags inversions observed live, cross-checking the
     static graph during tier-1 and serve_bench.
+  * ``analysis.jaxlint`` + ``analysis.kernels`` — trace-level analysis
+    BELOW the AST: every registered kernel family is abstract-evaled
+    (``jax.make_jaxpr``, no execution) and its jaxprs checked for
+    host transfers, missed/undeclared buffer donation, compile-key
+    injectivity over the serve bucket grid, mesh-collective axis
+    binding, constant bloat, and 64-bit dtype drift.
+    ``scripts/jaxlint.py`` is the CLI; it shares speclint's baseline
+    machinery and argparse front end (``analysis.cli``).
 
-See docs/analysis.md for the rule table and the PR-history bug each
+See docs/analysis.md for the rule tables and the PR-history bug each
 rule encodes.
 """
 

@@ -1,0 +1,124 @@
+"""One argparse front end for the two static-analysis CLIs.
+
+``scripts/speclint.py`` (AST-level) and ``scripts/jaxlint.py``
+(trace-level) share the same contract — findings diffed against a
+ratcheting baseline, ``--json`` machine reports for CI, a
+``--write-baseline`` that refuses growth — and before this module each
+tool carried its own copy of the flag set and the exit-code protocol.
+Two copies drift: a flag renamed in one tool silently breaks the CI
+invocation of the other. So the front end lives HERE once:
+
+  * :func:`add_common_args` installs ``--json`` / ``--rules`` /
+    ``--baseline`` / ``--write-baseline`` (``--update-baseline`` kept as
+    a compatibility alias) / ``--force`` on any parser;
+  * :func:`finish` runs the whole post-findings flow — baseline write
+    (ratchet errors -> exit 1), diff, human printout, JSON report — and
+    returns the shared exit code: 0 clean, 1 usage/ratchet error,
+    2 non-baselined findings.
+
+The report dict layout is identical for both tools (``findings``,
+``counts_by_rule``, ``total``, ``baselined``, ``new``,
+``stale_baseline_entries`` + tool-specific ``extra``), so CI jobs and
+dashboards parse one schema.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+
+from . import lint
+
+
+def add_common_args(
+    ap: argparse.ArgumentParser, *, default_baseline: str, all_rules: tuple[str, ...]
+) -> None:
+    """The shared flag set. ``default_baseline`` is each tool's ratchet
+    file (speclint_baseline.json / jaxlint_baseline.json)."""
+    ap.add_argument("--json", dest="json_out", help="write a JSON report here")
+    ap.add_argument(
+        "--rules",
+        help=f"comma-separated rule subset (default: all of {', '.join(all_rules)})",
+    )
+    ap.add_argument(
+        "--baseline",
+        default=default_baseline,
+        help=f"baseline path (default: {default_baseline})",
+    )
+    ap.add_argument(
+        "--write-baseline",
+        "--update-baseline",  # compatibility alias (pre-extraction speclint)
+        dest="write_baseline",
+        action="store_true",
+        help="rewrite the baseline from current findings (ratchet: a rule's "
+        "count may only decrease; --force overrides for bootstrap)",
+    )
+    ap.add_argument("--force", action="store_true", help="override the ratchet")
+
+
+def parse_rules(args, all_rules: tuple[str, ...]) -> set[str] | None:
+    """``--rules`` -> validated set (None = all). Raises SystemExit-free:
+    returns None and prints on unknown rules so callers can exit 1."""
+    if not args.rules:
+        return None
+    rules = {r.strip() for r in args.rules.split(",") if r.strip()}
+    unknown = rules - set(all_rules)
+    if unknown:
+        raise ValueError(f"unknown rules: {sorted(unknown)} (have {all_rules})")
+    return rules
+
+
+def finish(
+    args,
+    findings: list[lint.Finding],
+    *,
+    tool: str,
+    extra: dict | None = None,
+) -> int:
+    """Shared post-findings flow: baseline write OR diff + report.
+    Exit codes: 0 clean, 1 ratchet refusal, 2 non-baselined findings."""
+    if args.write_baseline:
+        try:
+            payload = lint.write_baseline(args.baseline, findings, force=args.force)
+        except ValueError as exc:
+            print(f"REFUSED: {exc}")
+            return 1
+        print(f"baseline updated: {len(payload['findings'])} fingerprints")
+        return 0
+
+    baseline = lint.load_baseline(args.baseline)
+    diff = lint.baseline_diff(findings, baseline)
+    by_rule: dict[str, int] = {}
+    for f in findings:
+        by_rule[f.rule] = by_rule.get(f.rule, 0) + 1
+
+    report = {
+        "tool": tool,
+        "findings": [f.to_dict() for f in findings],
+        "counts_by_rule": dict(sorted(by_rule.items())),
+        "total": len(findings),
+        "baselined": len(findings) - len(diff["new"]),
+        "new": [f.to_dict() for f in diff["new"]],
+        "stale_baseline_entries": diff["stale"],
+    }
+    if extra:
+        report["extra"] = extra
+    if args.json_out:
+        with open(args.json_out, "w", encoding="utf-8") as fh:
+            json.dump(report, fh, indent=1, sort_keys=True)
+            fh.write("\n")
+
+    for f in diff["new"]:
+        print(f"{f.path}:{f.line}: [{f.rule}] {f.message}")
+    if diff["stale"]:
+        print(
+            f"note: {len(diff['stale'])} stale baseline entr"
+            f"{'y' if len(diff['stale']) == 1 else 'ies'} (fixed findings) — "
+            "run --write-baseline to ratchet them out"
+        )
+    summary = ", ".join(f"{r}={n}" for r, n in sorted(by_rule.items())) or "clean"
+    print(
+        f"{tool}: {len(findings)} finding(s) ({summary}); "
+        f"{len(diff['new'])} non-baselined"
+    )
+    return 2 if diff["new"] else 0

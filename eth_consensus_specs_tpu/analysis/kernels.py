@@ -1,0 +1,502 @@
+"""The kernel registry: every registered device kernel, declaratively.
+
+jaxlint (analysis/jaxlint.py) abstract-evals each entry here — no
+execution, no XLA compile — and runs its trace-level rules over the
+jaxprs. The registry is therefore the place where a kernel family makes
+its accelerator contract EXPLICIT:
+
+  * ``dtypes`` — the aval dtypes the kernel is allowed to contain
+    (x64-drift: an i64 counter inside a uint32 hash kernel doubles its
+    register/HBM footprint silently);
+  * ``donate`` / ``donation_waiver`` — every family must either declare
+    the flat argnums its jit actually donates, or carry a reviewed
+    waiver string saying why no donation opportunity is taken
+    (donation-audit; the ROADMAP item-2 device-resident state work
+    lands behind this seam). The registry refuses entries that declare
+    neither — silence is not a donation policy;
+  * ``variants`` — the representative traced shapes, including the
+    mesh-sharded variant where one exists (collective-audit needs the
+    real shard_map mesh to bind axis names against);
+  * ``key_grid`` — for kernels the serve layer buckets, the LIVE
+    compile-key function (serve/buckets.merkle_many_key / bls_msm_key,
+    ops/state_root.state_root_compile_key — the same callables the
+    dispatch sites use, not copies) evaluated over the bucket grid so
+    the recompile-surface rule can prove key -> traced-signature
+    injectivity.
+
+Representative shapes are small on purpose: ``jax.make_jaxpr`` cost is
+graph-size-bound, not data-bound, so a depth-10 tree over 8 trees
+exercises exactly the primitives the depth-12x64 production bucket
+compiles. The bucket GRIDS (key_grid) do cover the production range —
+key computation is pure python.
+
+``suppress`` mirrors speclint's inline ``# speclint: disable=`` escape
+hatch at registry granularity: a reviewed, diff-visible waiver of one
+rule for one kernel. The baseline (jaxlint_baseline.json) ships EMPTY.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Callable
+
+# ---------------------------------------------------------------- specs --
+
+
+@dataclass(frozen=True)
+class Variant:
+    """One traceable entry point of a kernel family: the callable plus
+    the abstract args (ShapeDtypeStruct pytrees) to trace it with."""
+
+    label: str  # "single" | "mesh"
+    fn: Callable
+    args: tuple
+    static_argnums: tuple[int, ...] = ()
+    mesh: object = None  # jax Mesh for mesh variants (axis-name binding)
+
+
+@dataclass(frozen=True)
+class KernelSpec:
+    name: str
+    help: str
+    # aval dtypes the kernel's jaxpr may contain (0-d weak-typed scalars
+    # — literal-derived trace constants — are exempt in the rule)
+    dtypes: frozenset
+    # flat positional argnums the kernel's jit declares donated
+    donate: tuple[int, ...] = ()
+    # reviewed reason why donation opportunities are NOT taken (required
+    # when donate is empty — the registry refuses silent entries)
+    donation_waiver: str | None = None
+    # registry-level rule suppressions (reviewed escape hatch)
+    suppress: tuple[str, ...] = ()
+    # (mesh | None) -> list[Variant]; mesh variants only when mesh given
+    # — whether a family HAS a mesh variant is determined here and only
+    # here (callers inspect Variant.mesh; no duplicate flag to drift)
+    build_variants: Callable = None
+    # (mesh | None) -> list[(key tuple, signature tuple)] over the
+    # serve bucket grid; None = the serve layer never keys this kernel
+    key_grid: Callable | None = None
+
+
+def _sds(shape, dtype):
+    import jax
+    import jax.numpy as jnp
+
+    return jax.ShapeDtypeStruct(shape, getattr(jnp, dtype))
+
+
+def _default_buckets() -> tuple[int, ...]:
+    from eth_consensus_specs_tpu.serve.config import ServeConfig
+
+    return ServeConfig().buckets
+
+
+# ------------------------------------------------------------- builders --
+
+
+def _sha256_variants(mesh):
+    from eth_consensus_specs_tpu.ops import sha256
+
+    return [
+        Variant(f"single:tile{t}", sha256._kernel, (_sds((t, 16), "uint32"),))
+        for t in sha256.TILES
+    ]
+
+
+def _merkle_variants(mesh):
+    from eth_consensus_specs_tpu.ops import merkle
+
+    return [
+        Variant(
+            f"single:d{d}",
+            merkle._tree_root_fused,
+            (_sds((1 << d, 8), "uint32"), d),
+            static_argnums=(1,),
+        )
+        for d in (6, 10)
+    ]
+
+
+def _merkle_many_args(batch: int, depth: int):
+    return (_sds((batch, 1 << depth, 8), "uint32"),)
+
+
+def _merkle_many_variants(mesh):
+    from eth_consensus_specs_tpu.ops import merkle
+    from eth_consensus_specs_tpu.parallel import mesh_ops
+
+    depth = 10
+    out = [
+        Variant(
+            "single",
+            merkle._many_tree_root_fused,
+            (*_merkle_many_args(8, depth), depth),
+            static_argnums=(1,),
+        )
+    ]
+    if mesh is not None:
+        batch = mesh_ops.pad_to_shards(8, mesh_ops.shard_count(mesh))
+        out.append(
+            Variant(
+                "mesh",
+                merkle._many_tree_root_sharded(mesh, depth),
+                _merkle_many_args(batch, depth),
+                mesh=mesh,
+            )
+        )
+    return out
+
+
+def _merkle_many_key_grid(mesh):
+    """LIVE serve key fn (buckets.merkle_many_key) over the bucket grid
+    vs the traced signature the dispatch actually compiles under."""
+    from eth_consensus_specs_tpu.parallel import mesh_ops
+    from eth_consensus_specs_tpu.serve import buckets
+
+    cfg = _default_buckets()
+    out = []
+    for m in (None, mesh) if mesh is not None else (None,):
+        shards = mesh_ops.shard_count(m)
+        for depth in (4, 10, 12):
+            for n in (1, 2, 3, 5, 8, 13, 16, 33, 64):
+                key = buckets.merkle_many_key(n, depth, cfg, mesh=m)
+                pad = key[1]
+                batch = mesh_ops.pad_to_shards(pad, shards) if m is not None else pad
+                sig = (
+                    _canon_args(_merkle_many_args(batch, depth)),
+                    depth,
+                    mesh_ops.mesh_signature(m),
+                )
+                out.append((key, sig))
+    return out
+
+
+def _shuffle_variants(mesh):
+    from eth_consensus_specs_tpu.ops import shuffle
+
+    n, rounds = 512, 90
+    num_chunks = (n + 255) // 256
+    return [
+        Variant(
+            "single",
+            shuffle._device_shuffle_kernel(n, rounds, num_chunks),
+            (_sds((rounds * num_chunks, 16), "uint32"), _sds((rounds,), "int32")),
+        )
+    ]
+
+
+def _fr_fft_variants(mesh):
+    from eth_consensus_specs_tpu.ops import fr_fft
+
+    n, stages = 256, 8
+    tw = tuple(
+        _sds((1 << i, fr_fft.FR.n_limbs), "uint64") for i in range(stages)
+    )
+    return [
+        Variant(
+            "single",
+            fr_fft._compiled_fft(n, stages),
+            (_sds((4, n, fr_fft.FR.n_limbs), "uint64"), *tw),
+        )
+    ]
+
+
+def _g1_msm_variants(mesh):
+    from eth_consensus_specs_tpu.ops import g1_msm
+    from eth_consensus_specs_tpu.parallel import mesh_ops
+
+    def args(lanes):
+        return (
+            _sds((lanes, 256), "uint64"),
+            *[_sds((lanes, 13), "uint64")] * 3,
+        )
+
+    out = [Variant("single", g1_msm.msm_kernel, args(8))]
+    if mesh is not None:
+        lanes = g1_msm.mesh_lane_pad(8, mesh_ops.shard_count(mesh))
+        out.append(
+            Variant("mesh", g1_msm._sharded_fn(mesh, "msm"), args(lanes), mesh=mesh)
+        )
+    return out
+
+
+def _bls_msm_args(items: int, lanes: int):
+    return tuple([_sds((items, lanes, 13), "uint64")] * 3)
+
+
+def _bls_msm_variants(mesh):
+    from eth_consensus_specs_tpu.ops import g1_msm
+    from eth_consensus_specs_tpu.parallel import mesh_ops
+
+    out = [Variant("single", g1_msm.sum_many_kernel, _bls_msm_args(4, 8))]
+    if mesh is not None:
+        items = mesh_ops.pad_to_shards(4, mesh_ops.shard_count(mesh))
+        out.append(
+            Variant(
+                "mesh",
+                g1_msm._sharded_fn(mesh, "sum_many"),
+                _bls_msm_args(items, 8),
+                mesh=mesh,
+            )
+        )
+    return out
+
+
+def _bls_msm_key_grid(mesh):
+    """LIVE serve key fn (buckets.bls_msm_key) over the committee grid
+    vs the many_sum_shape padded avals the dispatch compiles under."""
+    from eth_consensus_specs_tpu.ops.g1_msm import many_sum_shape
+    from eth_consensus_specs_tpu.parallel import mesh_ops
+    from eth_consensus_specs_tpu.serve import buckets
+
+    out = []
+    for m in (None, mesh) if mesh is not None else (None,):
+        shards = mesh_ops.shard_count(m)
+        for items in (1, 2, 3, 5, 9, 16, 33):
+            for lanes in (1, 3, 8, 64, 100):
+                key = buckets.bls_msm_key(items, lanes, mesh=m)
+                item_pad, lane_pad = many_sum_shape(items, lanes, shards)
+                sig = (
+                    _canon_args(_bls_msm_args(item_pad, lane_pad)),
+                    mesh_ops.mesh_signature(m),
+                )
+                out.append((key, sig))
+    return out
+
+
+def _pairing_variants(mesh):
+    from eth_consensus_specs_tpu.ops import pairing_device as pd
+
+    def chunk_args(n_chunks):
+        lead = (n_chunks,) if n_chunks else ()
+        return (
+            _sds((*lead, pd._CHUNK, pd.N_STEPS, 2, 2, pd.N_LIMBS), "uint64"),
+            _sds((*lead, pd._CHUNK, pd.N_LIMBS), "uint64"),
+            _sds((*lead, pd._CHUNK, pd.N_LIMBS), "uint64"),
+            _sds((*lead, pd._CHUNK), "bool"),
+        )
+
+    out = [Variant("single", pd._miller_chunk_fold, chunk_args(0))]
+    if mesh is not None:
+        from eth_consensus_specs_tpu.parallel import mesh_ops
+
+        shards = mesh_ops.shard_count(mesh)
+        out.append(
+            Variant(
+                "mesh",
+                pd._miller_sharded_fn(mesh, 1),
+                chunk_args(shards),
+                mesh=mesh,
+            )
+        )
+    return out
+
+
+def synthetic_state_root_meta(n: int = 64, extra_static: int = 0):
+    """A StateRootMeta with every dynamic slot the altair+ impl resolves,
+    without building a spec/object state. ``extra_static`` grows the
+    top-level container (and so top_depth) — the key grid uses it to
+    prove the compile key discriminates container shapes."""
+    from eth_consensus_specs_tpu.ops.state_root import StateRootMeta
+
+    dynamic = (
+        "validators",
+        "balances",
+        "inactivity_scores",
+        "previous_epoch_participation",
+        "current_epoch_participation",
+        "justification_bits",
+        "previous_justified_checkpoint",
+        "current_justified_checkpoint",
+        "finalized_checkpoint",
+    )
+    n_fields = len(dynamic) + 16 + extra_static
+    top_depth = max(n_fields - 1, 0).bit_length()
+    return StateRootMeta(
+        dynamic_slots=tuple(enumerate(dynamic)),
+        n_validators=n,
+        top_depth=top_depth,
+    )
+
+
+def _state_root_args(meta):
+    from eth_consensus_specs_tpu.ops.state_root import StateRootArrays
+    from eth_consensus_specs_tpu.ops.state_columns import JustificationState
+
+    n = meta.n_validators
+    arrays = StateRootArrays(
+        val_node_a=_sds((n, 8), "uint32"),
+        val_node_f=_sds((n, 8), "uint32"),
+        slashed_chunk=_sds((n, 8), "uint32"),
+        prev_part_flags=_sds((n,), "uint8"),
+        top_chunks=_sds((1 << meta.top_depth, 8), "uint32"),
+        zerohashes=_sds((41, 8), "uint32"),
+    )
+    just = JustificationState(
+        current_epoch=_sds((), "uint64"),
+        justification_bits=_sds((4,), "bool_"),
+        prev_justified_epoch=_sds((), "uint64"),
+        prev_justified_root=_sds((32,), "uint8"),
+        cur_justified_epoch=_sds((), "uint64"),
+        cur_justified_root=_sds((32,), "uint8"),
+        finalized_epoch=_sds((), "uint64"),
+        finalized_root=_sds((32,), "uint8"),
+        block_root_prev=_sds((32,), "uint8"),
+        block_root_cur=_sds((32,), "uint8"),
+        slashings_sum=_sds((), "uint64"),
+    )
+    cols = (_sds((n,), "uint64"), _sds((n,), "uint64"), _sds((n,), "uint64"))
+    return arrays, cols, just
+
+
+def _state_root_variants(mesh):
+    from eth_consensus_specs_tpu.ops import state_root as sr
+
+    meta = synthetic_state_root_meta(64)
+    arrays, (bal, eff, inact), just = _state_root_args(meta)
+
+    def run(arrays, balances, effective_balance, inactivity_scores, just):
+        return sr._post_epoch_state_root_impl(
+            arrays, meta, balances, effective_balance, inactivity_scores, just
+        )
+
+    return [Variant("single", run, (arrays, bal, eff, inact, just))]
+
+
+def _state_root_key_grid(mesh):
+    """LIVE ops/state_root.state_root_compile_key over registry shapes
+    vs the flattened input avals the graph traces under."""
+    from eth_consensus_specs_tpu.ops.state_root import state_root_compile_key
+
+    out = []
+    for n in (64, 128, 256):
+        for extra in (0, 40):  # two container widths -> two top_depths
+            meta = synthetic_state_root_meta(n, extra_static=extra)
+            key = state_root_compile_key(meta)
+            sig = (
+                _canon_args(_state_root_args(meta)),
+                meta.top_depth,
+                meta.dynamic_slots,
+            )
+            out.append((key, sig))
+    return out
+
+
+def _canon_args(args) -> tuple:
+    """Canonical hashable form of a ShapeDtypeStruct pytree — the part
+    of the jit cache key the shape grid varies."""
+    import jax
+
+    return tuple(
+        (tuple(leaf.shape), str(leaf.dtype)) for leaf in jax.tree_util.tree_leaves(args)
+    )
+
+
+# ------------------------------------------------------------- registry --
+
+_LIMB_DTYPES = frozenset({"uint64", "uint32", "int32", "bool"})
+
+REGISTRY: tuple[KernelSpec, ...] = (
+    KernelSpec(
+        name="sha256",
+        help="tiled vectorized SHA-256 (ops/sha256.sha256_tiled)",
+        dtypes=frozenset({"uint32"}),
+        donation_waiver="message (N,16) and digest (N,8) avals never alias; "
+        "tiles are transient host uploads reused across levels",
+        build_variants=_sha256_variants,
+    ),
+    KernelSpec(
+        name="merkle",
+        help="single-subtree device merkleization (ops/merkle)",
+        # bool: the fori_loop predicate scalar; int32: its counter
+        dtypes=frozenset({"uint32", "int32", "bool"}),
+        donation_waiver="leaf buffer (2^d,8) vs root (8,) never alias; the "
+        "resident-state seam (ROADMAP item 2) donates at the column level, "
+        "not here",
+        build_variants=_merkle_variants,
+    ),
+    KernelSpec(
+        name="merkle_many",
+        help="vmapped multi-tree merkleization, mesh tree-axis sharded",
+        dtypes=frozenset({"uint32", "int32", "bool"}),
+        donation_waiver="batched leaves (B,2^d,8) vs roots (B,8) never alias",
+        build_variants=_merkle_many_variants,
+        key_grid=_merkle_many_key_grid,
+    ),
+    KernelSpec(
+        name="shuffle",
+        help="whole-permutation swap-or-not shuffle (ops/shuffle)",
+        dtypes=frozenset({"uint32", "int32", "bool"}),
+        donation_waiver="decision blocks and pivots are read-only; the index "
+        "plane lives in the loop carry, not an argument buffer",
+        build_variants=_shuffle_variants,
+    ),
+    KernelSpec(
+        name="fr_fft",
+        help="batched BLS-scalar-field FFT (ops/fr_fft)",
+        dtypes=_LIMB_DTYPES,
+        donate=(0,),  # vals: private bit-reversed copy, aval == output
+        build_variants=_fr_fft_variants,
+    ),
+    KernelSpec(
+        name="g1_msm",
+        help="G1 multi-scalar multiplication, mesh lane-axis sharded",
+        dtypes=_LIMB_DTYPES,
+        donation_waiver="lane arrays (N,13)x3 + bits (N,256) vs one Jacobian "
+        "point (13,)x3 — no aval ever aliases an output",
+        build_variants=_g1_msm_variants,
+    ),
+    KernelSpec(
+        name="bls_msm",
+        help="batched per-item G1 committee sums (the serve RLC seam), "
+        "mesh item-axis sharded",
+        dtypes=_LIMB_DTYPES,
+        donation_waiver="committee lanes (I,L,13)x3 vs per-item points "
+        "(I,13)x3 — shapes never alias",
+        build_variants=_bls_msm_variants,
+        key_grid=_bls_msm_key_grid,
+    ),
+    KernelSpec(
+        name="pairing",
+        help="chunked Miller accumulation + fold, mesh chunk-axis sharded",
+        dtypes=frozenset({"uint64", "uint32", "uint8", "int32", "bool"}),
+        donation_waiver="prepared coefficients are cached host constants "
+        "(_PREP_CACHE) reused across batches — donating them would corrupt "
+        "the cache",
+        build_variants=_pairing_variants,
+    ),
+    KernelSpec(
+        name="state_root",
+        help="post-accounting-epoch BeaconState root (ops/state_root)",
+        dtypes=frozenset({"uint32", "uint64", "uint8", "int32", "bool"}),
+        donation_waiver="static tree arrays are reused every epoch "
+        "(device-resident by design); donation lands with the in-place "
+        "per-slot updates of ROADMAP item 2",
+        build_variants=_state_root_variants,
+        key_grid=_state_root_key_grid,
+    ),
+)
+
+for _spec in REGISTRY:
+    if not _spec.donate and not _spec.donation_waiver:
+        raise AssertionError(
+            f"kernel registry entry {_spec.name!r} declares neither donated "
+            "argnums nor a donation waiver — silence is not a donation policy"
+        )
+
+
+def by_name() -> dict[str, KernelSpec]:
+    return {s.name: s for s in REGISTRY}
+
+
+def mesh_families(mesh) -> set[str]:
+    """Families whose builders emit a mesh variant on this mesh —
+    derived from the builders themselves (the authoritative source),
+    not a hand-maintained list."""
+    if mesh is None:
+        return set()
+    return {
+        s.name
+        for s in REGISTRY
+        if any(v.mesh is not None for v in s.build_variants(mesh))
+    }

@@ -646,38 +646,121 @@ def rule_lock_order(
 _JIT_WRAPPERS = {"jit", "vmap", "pmap", "shard_map"}
 
 
+def _is_jit_expr(node: ast.AST) -> bool:
+    if isinstance(node, ast.Attribute):
+        return node.attr in _JIT_WRAPPERS
+    if isinstance(node, ast.Name):
+        return node.id in _JIT_WRAPPERS
+    if isinstance(node, ast.Call):
+        # partial(jax.jit, ...) / functools.partial(jax.jit, ...)
+        fn = node.func
+        is_partial = (isinstance(fn, ast.Name) and fn.id == "partial") or (
+            isinstance(fn, ast.Attribute) and fn.attr == "partial"
+        )
+        if is_partial and node.args:
+            return _is_jit_expr(node.args[0])
+        return _is_jit_expr(fn)
+    return False
+
+
 def _jit_root_names(mi: ModuleInfo) -> dict[str, int]:
     """Function names in this module wrapped by jax.jit/vmap — via
     decorator, ``jax.jit(f)`` call, or ``partial(jax.jit, ...)(f)``."""
     roots: dict[str, int] = {}
-
-    def is_jit_expr(node: ast.AST) -> bool:
-        if isinstance(node, ast.Attribute):
-            return node.attr in _JIT_WRAPPERS
-        if isinstance(node, ast.Name):
-            return node.id in _JIT_WRAPPERS
-        if isinstance(node, ast.Call):
-            # partial(jax.jit, ...) / functools.partial(jax.jit, ...)
-            fn = node.func
-            is_partial = (isinstance(fn, ast.Name) and fn.id == "partial") or (
-                isinstance(fn, ast.Attribute) and fn.attr == "partial"
-            )
-            if is_partial and node.args:
-                return is_jit_expr(node.args[0])
-            return is_jit_expr(fn)
-        return False
-
     for node in mi.tree.body:
         if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
             for dec in node.decorator_list:
-                if is_jit_expr(dec):
+                if _is_jit_expr(dec):
                     roots[node.name] = node.lineno
     for node in ast.walk(mi.tree):
-        if isinstance(node, ast.Call) and is_jit_expr(node.func):
+        if isinstance(node, ast.Call) and _is_jit_expr(node.func):
             for arg in node.args:
                 if isinstance(arg, ast.Name):
                     roots.setdefault(arg.id, node.lineno)
     return roots
+
+
+def _nested_defs(mi: ModuleInfo) -> dict[str, ast.AST]:
+    """FunctionDefs NOT at module/class level (the shard_map-closure
+    factories' `local` pattern), by name — reachable only through the
+    wrap sites, so outside the module-level root scan."""
+    top: set[int] = set()
+    for node in mi.tree.body:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            top.add(id(node))
+        elif isinstance(node, ast.ClassDef):
+            for sub in node.body:
+                if isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    top.add(id(sub))
+    out: dict[str, ast.AST] = {}
+    for node in ast.walk(mi.tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            if id(node) not in top:
+                out[node.name] = node
+    return out
+
+
+def _shard_map_closures(
+    mi: ModuleInfo, table: dict[str, FuncInfo]
+) -> tuple[list[Finding], set[str]]:
+    """Traced bodies reachable ONLY through a wrap site (PR 8's
+    shard_map idiom): lambdas passed to jit/vmap/shard_map, and nested
+    function defs referenced by name. Returns the purity findings inside
+    those bodies plus the module-level functions they call — extra
+    reachability roots for :func:`rule_jit_purity`. Bare-name calls
+    resolve through the import map first (``from ops.x import f`` then
+    ``shard_map(lambda v: f(v), ...)`` roots ``ops.x.f``)."""
+    nested = _nested_defs(mi)
+    roots: set[str] = set()
+    findings: list[Finding] = []
+    visited: set[int] = set()
+
+    def visit(node: ast.AST, label: str) -> None:
+        if id(node) in visited:
+            return
+        visited.add(id(node))
+        for lineno, what in _purity_violations(mi, node, None):
+            findings.append(
+                Finding(
+                    "jit-purity",
+                    mi.relpath,
+                    lineno,
+                    f"{label}:{what.split()[0]}",
+                    f"{mi.modname}.{label} is traced through a "
+                    f"jit/vmap/shard_map wrap site and {what}: the value is "
+                    "read ONCE at trace time and baked into every later "
+                    "execution of the compiled program",
+                )
+            )
+        for sub in ast.walk(node):
+            if not isinstance(sub, ast.Call):
+                continue
+            fn = sub.func
+            if isinstance(fn, ast.Name):
+                target = mi.import_map.get(fn.id)
+                qual = target if target is not None else f"{mi.modname}.{fn.id}"
+                if qual in table:
+                    roots.add(qual)
+                elif fn.id in nested:
+                    visit(nested[fn.id], fn.id)
+            elif isinstance(fn, ast.Attribute) and isinstance(fn.value, ast.Name):
+                target_mod = mi.import_map.get(fn.value.id)
+                if target_mod is not None and f"{target_mod}.{fn.attr}" in table:
+                    roots.add(f"{target_mod}.{fn.attr}")
+
+    for node in ast.walk(mi.tree):
+        if not (isinstance(node, ast.Call) and _is_jit_expr(node.func)):
+            continue
+        for arg in node.args:
+            if isinstance(arg, ast.Lambda):
+                visit(arg, "<lambda>")
+            elif (
+                isinstance(arg, ast.Name)
+                and arg.id in nested
+                and f"{mi.modname}.{arg.id}" not in table
+            ):
+                visit(nested[arg.id], arg.id)
+    return findings, roots
 
 
 def _purity_violations(mi: ModuleInfo, fn: ast.AST, cls: str | None) -> list[tuple[int, str]]:
@@ -715,11 +798,20 @@ def rule_jit_purity(
     if table is None:
         table = build_function_table(modules)
     roots: dict[str, int] = {}
+    closure_findings: list[Finding] = []
     for mi in modules:
         for name, lineno in _jit_root_names(mi).items():
             qual = f"{mi.modname}.{name}"
             if qual in table:
                 roots[qual] = lineno
+        # shard_map/jit wrap sites whose traced body is a lambda or a
+        # nested def (the PR 8 sharded-kernel factories): the body is
+        # purity-checked directly and the module-level functions it
+        # calls join the root set
+        extra_findings, extra_roots = _shard_map_closures(mi, table)
+        closure_findings.extend(extra_findings)
+        for qual in extra_roots:
+            roots.setdefault(qual, 0)
     # reachability over intra-package call edges
     reachable: set[str] = set()
     frontier = list(roots)
@@ -730,7 +822,7 @@ def rule_jit_purity(
         reachable.add(q)
         frontier.extend(table[q].calls - reachable)
     by_mod = {mi.modname: mi for mi in modules}
-    findings: list[Finding] = []
+    findings: list[Finding] = list(closure_findings)
     for qual in sorted(reachable):
         fi = table[qual]
         mi = by_mod[fi.modname]
@@ -785,6 +877,17 @@ def _literal_names(node: ast.AST) -> list[str]:
     return []
 
 
+# helpers that EMIT a derived metric family: calling them is emitting.
+# observe_compile_ms(op, ...) / first_dispatch(op, *dims) record into the
+# serve.compile_ms.<op> histograms (serve/buckets.py) — before this scan
+# those call sites were invisible to the catalog check (a PR 5 gap: the
+# metric literal lives in the helper, the FAMILY key at the call site)
+_DERIVED_EMITTERS = {
+    "observe_compile_ms": ("histogram", "serve.compile_ms.{}"),
+    "first_dispatch": ("histogram", "serve.compile_ms.{}"),
+}
+
+
 def rule_obs_discipline(mi: ModuleInfo, catalog) -> list[Finding]:
     if mi.modname in ("obs.catalog",):
         return []
@@ -794,6 +897,42 @@ def rule_obs_discipline(mi: ModuleInfo, catalog) -> list[Finding]:
         if not isinstance(node, ast.Call):
             continue
         fn = node.func
+        attr = fn.attr if isinstance(fn, ast.Attribute) else (
+            fn.id if isinstance(fn, ast.Name) else None
+        )
+        if attr in _DERIVED_EMITTERS and mi.modname != "serve.buckets":
+            # serve.buckets itself is the helper's home: its internal
+            # obs.observe(...) literals are scanned by the branch below
+            kind, template = _DERIVED_EMITTERS[attr]
+            for op in _literal_names(node.args[0]) if node.args else []:
+                name = template.format(op)
+                if not _METRIC_GRAMMAR_RE.match(name):
+                    findings.append(
+                        Finding(
+                            "obs-discipline",
+                            mi.relpath,
+                            node.lineno,
+                            f"grammar:{name}",
+                            f"derived metric name {name!r} (via {attr}) "
+                            "violates the grammar "
+                            "[a-z][a-z0-9_]*(.[a-z0-9_]+)* — it would "
+                            "collapse lossily in the Prometheus exposition",
+                        )
+                    )
+                elif catalog is not None and not catalog.declared(kind, name):
+                    findings.append(
+                        Finding(
+                            "obs-discipline",
+                            mi.relpath,
+                            node.lineno,
+                            f"undeclared:{name}",
+                            f"{kind} {name!r} (emitted through {attr}) is not "
+                            "declared in obs/catalog.py — compile-timing "
+                            "families added at dispatch sites must be "
+                            "visible to exposition consumers too",
+                        )
+                    )
+            continue
         if not (
             isinstance(fn, ast.Attribute)
             and fn.attr in _METRIC_METHODS

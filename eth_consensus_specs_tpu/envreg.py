@@ -153,6 +153,14 @@ ENV_VARS: tuple[EnvVar, ...] = (
        "`1`: wrap project locks in the runtime lock-order watchdog "
        "(acquisition-order edges, inversion counters, static-graph "
        "cross-check)", "analysis.md#runtime-lock-order-watchdog"),
+    _v("ETH_SPECS_ANALYSIS_CONST_MAX_BYTES", "1048576",
+       "jaxlint constant-bloat threshold: largest literal constant a traced "
+       "kernel body may bake into its jaxpr",
+       "analysis.md#trace-level-rules-jaxlint"),
+    _v("ETH_SPECS_ANALYSIS_DONATE_MIN_BYTES", "1048576",
+       "jaxlint donation-audit threshold: an undonated input aliasing an "
+       "output aval at or above this many bytes is a missed-donation finding",
+       "analysis.md#trace-level-rules-jaxlint"),
     # ----------------------------------------------------------- kernels --
     _v("ETH_SPECS_TPU_NO_NATIVE", "0",
        "`1`: skip the native (CFFI) BLS fast paths, pure-python/device only",

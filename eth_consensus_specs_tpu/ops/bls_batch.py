@@ -241,29 +241,29 @@ def _rlc_pubkey_terms(parsed: list, mesh=None) -> list:
     if not parsed:
         return []
     if _use_device():
-        from eth_consensus_specs_tpu.ops.g1_msm import many_sum_shape, sum_g1_many_device
-        from eth_consensus_specs_tpu.parallel.mesh_ops import mesh_signature, shard_count
+        from eth_consensus_specs_tpu.ops.g1_msm import sum_g1_many_device
+        from eth_consensus_specs_tpu.parallel.mesh_ops import shard_count
         from eth_consensus_specs_tpu.serve import buckets
 
         # the scalar is uniform within an item, so r_i * aggpk_i factors
         # to r_i * sum(points): ONE batched device dispatch sums every
         # item's committee (item axis sharded over `mesh` when live),
         # and the single 64-bit host multiply per item replaces an
-        # n-lane 256-bit double-and-add. The dispatch shape is the
-        # shared many_sum_shape bucket; its first sighting is the
-        # compile this process pays for that (items, lanes[, mesh])
-        # key — accounted here so serve and direct callers agree.
+        # n-lane 256-bit double-and-add. The dispatch shape/key is the
+        # LIVE serve key fn (serve/buckets.bls_msm_key — the same
+        # callable jaxlint's recompile-surface check exercises); its
+        # first sighting is the compile this process pays for that
+        # (items, lanes[, mesh]) key — accounted here so serve and
+        # direct callers agree.
         shards = shard_count(mesh)
-        shape = many_sum_shape(
-            len(parsed), max(len(points) for points, _, _, _ in parsed), shards
+        key = buckets.bls_msm_key(
+            len(parsed), max(len(points) for points, _, _, _ in parsed), mesh=mesh
         )
-        sig = mesh_signature(mesh) if shards > 1 else ""
-        key = (*shape, sig) if sig else shape
-        with buckets.first_dispatch("bls_msm", *key):
+        with buckets.first_dispatch(*key):
             sums = sum_g1_many_device(
                 [points for points, _, _, _ in parsed],
                 mesh=mesh if shards > 1 else None,
-                pad_shape=shape,
+                pad_shape=(key[1], key[2]),
             )
         rpk = [s.mul(r) for s, (_, _, _, r) in zip(sums, parsed)]
     else:

@@ -132,7 +132,9 @@ def _scalar_mul_lane(bits, X, Y, Z):
         )
 
     inf = (jnp.zeros_like(X), jnp.zeros_like(Y), jnp.zeros_like(Z))
-    return lax.fori_loop(0, SCALAR_BITS, body, inf)
+    # i32 loop bounds: python-int bounds widen the bit counter to i64
+    # under the package-wide x64 flag (jaxlint x64-drift)
+    return lax.fori_loop(jnp.int32(0), jnp.int32(SCALAR_BITS), body, inf)
 
 
 def _tree_sum(mX, mY, mZ):

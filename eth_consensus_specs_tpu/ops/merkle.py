@@ -84,7 +84,10 @@ def tree_root_words(leaves: jnp.ndarray, depth: int) -> jnp.ndarray:
             h = sha256_pair_words(b.reshape(w, 16))
             return jnp.concatenate([h, jnp.zeros_like(h)], axis=0)
 
-        buf = lax.fori_loop(0, rem, level, buf)
+        # i32 loop bounds: python-int bounds widen the counter to i64
+        # under the package-wide x64 flag — the jaxlint x64-drift rule
+        # keeps this kernel's jaxpr pure 32-bit
+        buf = lax.fori_loop(jnp.int32(0), jnp.int32(rem), level, buf)
     return buf[0]
 
 

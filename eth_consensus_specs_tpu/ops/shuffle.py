@@ -100,7 +100,10 @@ def _device_shuffle_kernel(n: int, rounds: int, num_chunks: int):
             bit = (byte_val >> (pos % 8).astype(jnp.uint32)) & 1
             return jnp.where(bit == 1, flip, idx)
 
-        return jax.lax.fori_loop(0, rounds, body, idx0)
+        # i32 loop bounds: python-int bounds widen the round counter —
+        # and everything indexed by it — to i64 under the x64 flag (the
+        # jaxlint x64-drift rule pins this kernel to 32-bit avals)
+        return jax.lax.fori_loop(jnp.int32(0), jnp.int32(rounds), body, idx0)
 
     return run
 

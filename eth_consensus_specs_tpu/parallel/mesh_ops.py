@@ -120,6 +120,9 @@ def mesh_signature(mesh: Mesh | None) -> str:
 
 
 def pad_to_shards(n: int, shards: int) -> int:
-    """Smallest multiple of ``shards`` >= n (the divisibility floor every
-    batch-sharded kernel pads to)."""
-    return shards * -(-n // shards)
+    """Smallest multiple of ``shards`` >= n that keeps every shard
+    non-empty — the divisibility floor every batch-sharded kernel pads
+    to. Degenerate inputs (n == 0, or fewer items than shards) still pad
+    to ONE item per shard: a zero-extent shard axis is an invalid
+    shard_map operand shape, so the floor is `shards`, never 0."""
+    return shards * max(-(-n // shards), 1)

@@ -106,6 +106,50 @@ def subtree_depth(n_chunks: int) -> int:
     return max(n_chunks - 1, 0).bit_length()
 
 
+# ------------------------------------------------- live compile-key fns --
+#
+# The serve/bucket compile keys are FUNCTIONS here, not inline tuple
+# construction at the dispatch sites, for one reason: the jaxlint
+# recompile-surface rule (analysis/jaxlint.py) checks these exact
+# callables for injectivity over the bucket grid — two traced signatures
+# sharing one key is how the PR 8 mesh-signature bug class ships. The
+# dispatch sites (serve/service.py, ops/bls_batch.py) and the analyzer
+# calling the SAME function is what makes the check honest: a key edit
+# that under-discriminates fails jaxlint before it can poison a warmup
+# artifact.
+
+
+def merkle_many_key(n_trees: int, depth: int, buckets_cfg: tuple[int, ...],
+                    mesh=None) -> tuple:
+    """The compile/bucket/warmup key of a merkle_many flush: bucket-padded
+    tree count + depth, plus the mesh signature when the tree axis shards
+    (same padded batch compiles once PER MESH — the signature is what
+    keeps an 8-chip warmup artifact out of a 1-chip boot)."""
+    from eth_consensus_specs_tpu.parallel import mesh_ops
+
+    shards = mesh_ops.shard_count(mesh)
+    if shards > 1:
+        pad = mesh_batch_bucket(n_trees, shards, buckets_cfg)
+        return ("merkle_many", pad, depth, mesh_ops.mesh_signature(mesh))
+    return ("merkle_many", batch_bucket(n_trees, buckets_cfg), depth)
+
+
+def bls_msm_key(n_items: int, max_lanes: int, mesh=None) -> tuple:
+    """The compile/bucket/warmup key of the batched per-item G1 many-sum
+    dispatch: the shared many_sum_shape (items, lanes) bucket, mesh-signed
+    when the item axis shards. Single-device keys carry NO signature —
+    byte-compatible with every warmup artifact written before mesh
+    dispatch existed."""
+    from eth_consensus_specs_tpu.ops.g1_msm import many_sum_shape
+    from eth_consensus_specs_tpu.parallel import mesh_ops
+
+    shards = mesh_ops.shard_count(mesh)
+    shape = many_sum_shape(n_items, max_lanes, shards)
+    if shards > 1:
+        return ("bls_msm", *shape, mesh_ops.mesh_signature(mesh))
+    return ("bls_msm", *shape)
+
+
 # ------------------------------------------------- compile accounting --
 
 _SEEN_LOCK = lockwatch.wrap(threading.Lock(), "serve.buckets._SEEN_LOCK")

@@ -305,28 +305,26 @@ class VerifyService:
                 from eth_consensus_specs_tpu.ops.merkle import merkleize_many_device
 
                 trees = [r.prepped if r.prepped is not None else r.payload[0] for r in group]
-                if (
+                sharded = (
                     mesh is not None
                     and len(group) >= mesh_ops.min_items()
                     and buckets.mesh_dispatch_worthwhile(1 << depth, len(group))
-                ):
-                    # mesh-sharded dispatch: pad the tree axis to the
-                    # per-shard bucket (not the global pow2) and tag the
-                    # compile key with the mesh signature so warmup
-                    # artifacts stay honest across mesh shapes
-                    shards = mesh_ops.shard_count(mesh)
-                    pad = buckets.mesh_batch_bucket(
-                        len(group), shards, self.config.buckets
+                )
+                # mesh-sharded dispatch pads the tree axis to the
+                # per-shard bucket (not the global pow2) and signs the
+                # compile key with the mesh signature so warmup
+                # artifacts stay honest across mesh shapes; the key
+                # comes from the LIVE key fn jaxlint's injectivity
+                # check runs against (serve/buckets.merkle_many_key)
+                key = buckets.merkle_many_key(
+                    len(group), depth, self.config.buckets,
+                    mesh=mesh if sharded else None,
+                )
+                with buckets.first_dispatch(*key):
+                    roots = merkleize_many_device(
+                        trees, depth, pad_batch=key[1],
+                        mesh=mesh if sharded else None,
                     )
-                    sig = mesh_ops.mesh_signature(mesh)
-                    with buckets.first_dispatch("merkle_many", pad, depth, sig):
-                        roots = merkleize_many_device(
-                            trees, depth, pad_batch=pad, mesh=mesh
-                        )
-                else:
-                    pad = buckets.batch_bucket(len(group), self.config.buckets)
-                    with buckets.first_dispatch("merkle_many", pad, depth):
-                        roots = merkleize_many_device(trees, depth, pad_batch=pad)
             else:
                 from eth_consensus_specs_tpu.obs.watchdog import host_tree_root_words
                 from eth_consensus_specs_tpu.ops.merkle import _chunks_to_words

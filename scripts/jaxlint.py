@@ -1,0 +1,100 @@
+#!/usr/bin/env python
+"""jaxlint CLI — trace-level static analysis of the registered kernels.
+
+Usage:
+    python scripts/jaxlint.py                    # every family, 8 virtual chips
+    python scripts/jaxlint.py --chips 1          # single-device variants only
+    python scripts/jaxlint.py --json r.json      # machine-readable report
+    python scripts/jaxlint.py --rules x64-drift,donation-audit
+    python scripts/jaxlint.py --only merkle_many,bls_msm
+    python scripts/jaxlint.py --write-baseline
+
+Abstract evaluation only (jax.make_jaxpr) — nothing executes, nothing
+compiles. ``--chips N`` forces N virtual CPU devices BEFORE jax
+initializes (the serve_bench idiom) so the mesh-sharded kernel variants
+are analyzable on any dev box; on a real accelerator host the live
+devices are used as-is. Defaults to 8 so `make jaxlint` always covers
+the mesh variants.
+
+Exit codes (shared with speclint via analysis/cli.py): 0 clean,
+1 usage/ratchet error, 2 non-baselined findings. The baseline
+(jaxlint_baseline.json) ships EMPTY and may only shrink; CI additionally
+asserts transfer-free/collective-audit findings are NEVER baselined.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO_ROOT)
+# the --chips pre-parse must run before the first jax import (XLA reads
+# XLA_FLAGS once, at backend init); ONE copy shared with serve_bench.py
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+from prejax import force_virtual_chips  # noqa: E402
+
+
+def main() -> int:
+    # no env fallback: the analysis grid is a CLI decision, and the
+    # argparse default below must agree with what was forced here
+    chips = force_virtual_chips(default=8, env_var=None)
+
+    from eth_consensus_specs_tpu.analysis import cli, jaxlint
+
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument(
+        "--chips",
+        type=int,
+        default=8,
+        help="virtual device count for the mesh variants (forced before "
+        "jax init on cpu; 1 = single-device variants only; default 8)",
+    )
+    ap.add_argument(
+        "--only", help="comma-separated kernel-family subset (default: all)"
+    )
+    cli.add_common_args(
+        ap,
+        default_baseline=os.path.join(REPO_ROOT, "jaxlint_baseline.json"),
+        all_rules=jaxlint.ALL_RULES,
+    )
+    args = ap.parse_args()
+
+    try:
+        rules = cli.parse_rules(args, jaxlint.ALL_RULES)
+    except ValueError as exc:
+        print(exc)
+        return 1
+    only = (
+        {k.strip() for k in args.only.split(",") if k.strip()} if args.only else None
+    )
+    if only:
+        from eth_consensus_specs_tpu.analysis import kernels
+
+        unknown = only - set(kernels.by_name())
+        if unknown:
+            # a silently-ignored family name would let the mesh-smoke CI
+            # gate pass green while analyzing nothing — fail loudly, like
+            # --rules does
+            print(
+                f"unknown kernel families: {sorted(unknown)} "
+                f"(have {sorted(kernels.by_name())})"
+            )
+            return 1
+
+    from eth_consensus_specs_tpu.parallel.mesh_ops import mesh_signature, serve_mesh
+
+    mesh = serve_mesh(chips) if chips > 1 else None
+    findings, stats = jaxlint.analyze(mesh=mesh, rules=rules, only=only)
+    stats["mesh"] = mesh_signature(mesh)
+    print(
+        f"jaxlint: {stats['kernels']} kernel families, {stats['variants']} "
+        f"variants ({stats['mesh_variants']} mesh @ {stats['mesh'] or 'none'}), "
+        f"{stats['keys']} bucket keys checked"
+    )
+    return cli.finish(args, findings, tool="jaxlint", extra=stats)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,64 @@
+"""Pre-jax-init bootstrap shared by the chips-aware CLIs.
+
+``serve_bench.py`` and ``jaxlint.py`` both need N virtual CPU devices,
+and XLA reads ``XLA_FLAGS`` exactly once — at backend init — so the
+``--chips`` pre-parse must run BEFORE the first jax-touching import.
+Two argv pre-parsers had already drifted (one honored
+``ETH_SPECS_SERVE_CHIPS``, the other forced flags off-platform); this
+module is the single copy. It deliberately imports nothing heavy: the
+package ``__init__`` pulls in jax, so this must stay importable first.
+
+Usage (from a script in scripts/):
+
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    from prejax import force_virtual_chips
+    chips = force_virtual_chips()          # serve_bench: env fallback
+    chips = force_virtual_chips(default=8, env_var=None)  # jaxlint
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+
+def force_virtual_chips(
+    default: int = 0, env_var: str | None = "ETH_SPECS_SERVE_CHIPS"
+) -> int:
+    """Pre-parse ``--chips N`` from argv (falling back to ``env_var``,
+    then ``default``) and force that many virtual CPU devices via
+    ``XLA_FLAGS`` — only on the cpu platform, only when the flag is not
+    already set, and only for N > 1. Defaults ``JAX_PLATFORMS`` to cpu
+    (real-accelerator hosts override it and are left alone). Returns
+    the resolved chip count."""
+    n = 0
+    argv = sys.argv
+    for i, a in enumerate(argv):
+        if a == "--chips" and i + 1 < len(argv):
+            try:
+                n = int(argv[i + 1])
+            except ValueError:
+                pass
+        elif a.startswith("--chips="):
+            try:
+                n = int(a.split("=", 1)[1])
+            except ValueError:
+                pass
+    if n <= 0 and env_var:
+        try:
+            n = int(os.environ.get(env_var, "0") or 0)
+        except ValueError:
+            n = 0
+    if n <= 0:
+        n = default
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    flags = os.environ.get("XLA_FLAGS", "")
+    if (
+        n > 1
+        and os.environ.get("JAX_PLATFORMS") == "cpu"
+        and "xla_force_host_platform_device_count" not in flags
+    ):
+        os.environ["XLA_FLAGS"] = (
+            flags + f" --xla_force_host_platform_device_count={n}"
+        ).strip()
+    return n

@@ -82,44 +82,14 @@ import time
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# --chips / ETH_SPECS_SERVE_CHIPS need N virtual devices forced BEFORE
+# the XLA backend initializes; the pre-parse lives in scripts/prejax.py
+# (ONE copy, shared with scripts/jaxlint.py — the two had started to
+# drift) and also defaults JAX_PLATFORMS to cpu
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+from prejax import force_virtual_chips  # noqa: E402
 
-
-def _force_chip_count() -> None:
-    """``--chips N`` (or ETH_SPECS_SERVE_CHIPS) needs N devices; on the
-    CPU platform that means the virtual device count must be forced
-    BEFORE the XLA backend initializes — XLA reads XLA_FLAGS once at
-    client init, so this runs ahead of every jax-touching import."""
-    n = 0
-    argv = sys.argv
-    for i, a in enumerate(argv):
-        if a == "--chips" and i + 1 < len(argv):
-            try:
-                n = int(argv[i + 1])
-            except ValueError:
-                pass
-        elif a.startswith("--chips="):
-            try:
-                n = int(a.split("=", 1)[1])
-            except ValueError:
-                pass
-    if n <= 1:
-        try:
-            n = int(os.environ.get("ETH_SPECS_SERVE_CHIPS", "0") or 0)
-        except ValueError:
-            n = 0
-    flags = os.environ.get("XLA_FLAGS", "")
-    if (
-        n > 1
-        and os.environ.get("JAX_PLATFORMS") == "cpu"
-        and "xla_force_host_platform_device_count" not in flags
-    ):
-        os.environ["XLA_FLAGS"] = (
-            flags + f" --xla_force_host_platform_device_count={n}"
-        ).strip()
-
-
-_force_chip_count()
+force_virtual_chips()
 
 import numpy as np  # noqa: E402
 

@@ -4,101 +4,51 @@
 Usage:
     python scripts/speclint.py                 # lint the package, human output
     python scripts/speclint.py --json r.json   # machine-readable report
-    python scripts/speclint.py --update-baseline
+    python scripts/speclint.py --write-baseline
     python scripts/speclint.py --rules lock-order,fork-safety path/to/file.py
 
 Exit codes: 0 clean (every finding baselined), 1 usage/ratchet error,
 2 non-baselined findings. CI's ``static-analysis`` job runs ``--json``
 over the tree and fails on exit != 0; ``make lint`` chains it after
 ruff. The baseline (speclint_baseline.json) may only shrink — see
-analysis/lint.py's module docs for the ratchet contract.
+analysis/lint.py's module docs for the ratchet contract. The flag set
+and exit protocol are shared with the trace-level tool
+(scripts/jaxlint.py) through analysis/cli.py, so the two CLIs cannot
+drift.
 """
 
 from __future__ import annotations
 
 import argparse
-import json
 import os
 import sys
 
 REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO_ROOT)
 
-from eth_consensus_specs_tpu.analysis import lint  # noqa: E402
+from eth_consensus_specs_tpu.analysis import cli, lint  # noqa: E402
 
 
 def main() -> int:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("paths", nargs="*", help="files/dirs to lint (default: the package)")
-    ap.add_argument("--json", dest="json_out", help="write a JSON report here")
-    ap.add_argument("--rules", help="comma-separated rule subset (default: all)")
-    ap.add_argument(
-        "--baseline",
-        default=os.path.join(REPO_ROOT, "speclint_baseline.json"),
-        help="baseline path (default: speclint_baseline.json at the repo root)",
+    cli.add_common_args(
+        ap,
+        default_baseline=os.path.join(REPO_ROOT, "speclint_baseline.json"),
+        all_rules=lint.ALL_RULES,
     )
-    ap.add_argument(
-        "--update-baseline", action="store_true",
-        help="rewrite the baseline from current findings (ratchet: a rule's "
-             "count may only decrease; --force overrides for bootstrap)",
-    )
-    ap.add_argument("--force", action="store_true", help="override the ratchet")
     args = ap.parse_args()
 
-    rules = None
-    if args.rules:
-        rules = {r.strip() for r in args.rules.split(",") if r.strip()}
-        unknown = rules - set(lint.ALL_RULES)
-        if unknown:
-            print(f"unknown rules: {sorted(unknown)} (have {lint.ALL_RULES})")
-            return 1
+    try:
+        rules = cli.parse_rules(args, lint.ALL_RULES)
+    except ValueError as exc:
+        print(exc)
+        return 1
 
     paths = [os.path.abspath(p) for p in args.paths] or None
     findings = lint.run(REPO_ROOT, paths=paths, rules=rules,
                         project_checks=paths is None)
-
-    if args.update_baseline:
-        try:
-            payload = lint.write_baseline(args.baseline, findings, force=args.force)
-        except ValueError as exc:
-            print(f"REFUSED: {exc}")
-            return 1
-        print(f"baseline updated: {len(payload['findings'])} fingerprints")
-        return 0
-
-    baseline = lint.load_baseline(args.baseline)
-    diff = lint.baseline_diff(findings, baseline)
-    by_rule: dict[str, int] = {}
-    for f in findings:
-        by_rule[f.rule] = by_rule.get(f.rule, 0) + 1
-
-    report = {
-        "findings": [f.to_dict() for f in findings],
-        "counts_by_rule": dict(sorted(by_rule.items())),
-        "total": len(findings),
-        "baselined": len(findings) - len(diff["new"]),
-        "new": [f.to_dict() for f in diff["new"]],
-        "stale_baseline_entries": diff["stale"],
-    }
-    if args.json_out:
-        with open(args.json_out, "w", encoding="utf-8") as fh:
-            json.dump(report, fh, indent=1, sort_keys=True)
-            fh.write("\n")
-
-    for f in diff["new"]:
-        print(f"{f.path}:{f.line}: [{f.rule}] {f.message}")
-    if diff["stale"]:
-        print(
-            f"note: {len(diff['stale'])} stale baseline entr"
-            f"{'y' if len(diff['stale']) == 1 else 'ies'} (fixed findings) — "
-            "run --update-baseline to ratchet them out"
-        )
-    summary = ", ".join(f"{r}={n}" for r, n in sorted(by_rule.items())) or "clean"
-    print(
-        f"speclint: {len(findings)} finding(s) ({summary}); "
-        f"{len(diff['new'])} non-baselined"
-    )
-    return 2 if diff["new"] else 0
+    return cli.finish(args, findings, tool="speclint")
 
 
 if __name__ == "__main__":

@@ -270,6 +270,85 @@ def test_jit_purity_positive_and_negative(tmp_path):
     assert "helper" in findings[0].symbol
 
 
+def test_jit_purity_shard_map_lambda_and_nested_roots(tmp_path):
+    """PR 8's sharded-kernel factories wrap lambdas and nested defs —
+    bodies the module-level root scan can't reach. Positive: an impure
+    helper reached only through a shard_map lambda, and an env read
+    directly inside a nested wrapped def. Negative: the pure factory."""
+    findings = _lint(
+        tmp_path,
+        {
+            "mod.py": """\
+            import os
+            import jax
+            from jax.experimental.shard_map import shard_map
+
+            def helper(x):
+                flag = os.environ.get("ETH_SPECS_DECLARED")
+                return x if flag else -x
+
+            def pure_helper(x):
+                return x * 2
+
+            def factory(mesh, spec):
+                # impure helper reached ONLY through the lambda wrap site
+                return shard_map(
+                    lambda v: helper(v), mesh=mesh, in_specs=spec, out_specs=spec
+                )
+
+            def clean_factory(mesh, spec):
+                def local(v):
+                    return pure_helper(v)
+
+                return shard_map(local, mesh=mesh, in_specs=spec, out_specs=spec)
+
+            def dirty_factory(mesh, spec):
+                def local(v):
+                    flag = os.environ.get("ETH_SPECS_DECLARED")
+                    return v
+
+                return shard_map(local, mesh=mesh, in_specs=spec, out_specs=spec)
+            """,
+        },
+        {"jit-purity"},
+    )
+    symbols = sorted(f.symbol for f in findings)
+    assert symbols == ["helper:reads", "local:reads"], symbols
+
+
+def test_jit_purity_shard_map_nested_sibling_calls(tmp_path):
+    """A wrapped nested def calling a SIBLING nested def (the pairing
+    _fold_chunk idiom) and an imported function: both resolve."""
+    findings = _lint(
+        tmp_path,
+        {
+            "impure_dep.py": """\
+            import os
+
+            def imported_impure(x):
+                return os.environ.get("ETH_SPECS_DECLARED", x)
+            """,
+            "mod.py": """\
+            from eth_consensus_specs_tpu.impure_dep import imported_impure
+            from jax.experimental.shard_map import shard_map
+
+            def factory(mesh, spec):
+                def fold(v):
+                    return imported_impure(v)
+
+                def local(v):
+                    return fold(v)
+
+                return shard_map(local, mesh=mesh, in_specs=spec, out_specs=spec)
+            """,
+        },
+        {"jit-purity"},
+    )
+    assert any("imported_impure" in f.symbol for f in findings), [
+        f.symbol for f in findings
+    ]
+
+
 # ---------------------------------------------------------- obs-discipline --
 
 
@@ -302,6 +381,61 @@ def test_obs_discipline_names_and_work_bytes(tmp_path):
         "no-work-bytes:ok.untimed",
         "undeclared:not.in_catalog",
     ]
+
+
+def test_obs_discipline_compile_ms_call_sites(tmp_path):
+    """first_dispatch / observe_compile_ms call sites emit the derived
+    serve.compile_ms.<op> histogram family — the PR 5 gap: the metric
+    literal lives in the helper, the family key at the call site."""
+    findings = _lint(
+        tmp_path,
+        {
+            "mod.py": """\
+            from eth_consensus_specs_tpu.serve import buckets
+            from eth_consensus_specs_tpu.serve.buckets import first_dispatch
+
+            def good(n):
+                with buckets.first_dispatch("merkle_many", n, 10):
+                    pass
+                buckets.observe_compile_ms("bls_msm", 3.0)
+
+            def bad(n):
+                with first_dispatch("Rogue-Op", n):
+                    pass
+
+            def dynamic(op, n):
+                with buckets.first_dispatch(op, n):  # non-literal: skipped
+                    pass
+            """,
+        },
+        {"obs-discipline"},
+    )
+    assert [f.symbol for f in findings] == ["grammar:serve.compile_ms.Rogue-Op"]
+
+
+def test_obs_discipline_compile_ms_undeclared(tmp_path):
+    class _NoCat:
+        def declared(self, kind, name):
+            return False
+
+    findings = _lint(
+        tmp_path,
+        {
+            "mod.py": """\
+            from eth_consensus_specs_tpu.serve import buckets
+
+            def f(n):
+                with buckets.first_dispatch("alien_op", n):
+                    pass
+            """,
+        },
+        {"obs-discipline"},
+        catalog=_NoCat(),
+    )
+    assert [f.symbol for f in findings] == ["undeclared:serve.compile_ms.alien_op"]
+    assert findings[0].fingerprint.endswith(
+        "::obs-discipline::undeclared:serve.compile_ms.alien_op"
+    )
 
 
 # ------------------------------------------------------------ env-registry --

@@ -54,6 +54,37 @@ def test_mesh_helpers_and_signature():
     assert mesh_ops.pad_to_shards(16, 8) == 16
 
 
+def test_pad_to_shards_degenerate_pads_one_per_shard():
+    """shards > items (per-shard count would be 0): every shard still
+    gets at least one (padding) item — a zero-extent shard axis is an
+    invalid shard_map operand shape, so the floor is `shards`, never 0."""
+    assert mesh_ops.pad_to_shards(0, 8) == 8
+    assert mesh_ops.pad_to_shards(1, 8) == 8
+    assert mesh_ops.pad_to_shards(3, 8) == 8
+    for n in range(0, 20):
+        padded = mesh_ops.pad_to_shards(n, 8)
+        assert padded % 8 == 0 and padded // 8 >= 1  # non-empty shards
+        assert padded >= n
+
+
+def test_mesh_batch_bucket_degenerate_pads_one_per_shard():
+    from eth_consensus_specs_tpu.serve import buckets
+
+    cfg = (1, 2, 4, 8, 16, 32, 64)
+    # fewer trees than shards: the PER-SHARD count buckets to 1, the
+    # dispatch pads to shards x 1 — never an empty shard
+    for n in (1, 2, 3, 7):
+        assert buckets.mesh_batch_bucket(n, 8, cfg) == 8
+    assert buckets.mesh_batch_bucket(0, 8, cfg) == 8
+    # and the mesh-aware live key fn agrees with the dispatch padding
+    mesh = _mesh()
+    key = buckets.merkle_many_key(3, 10, cfg, mesh=mesh)
+    assert key[0] == "merkle_many" and key[1] == N_DEVICES
+    assert key[3] == mesh_ops.mesh_signature(mesh)
+    per_shard = mesh_ops.pad_to_shards(key[1], N_DEVICES) // N_DEVICES
+    assert per_shard >= 1
+
+
 def test_serve_mesh_env_gates(monkeypatch):
     _mesh()
     monkeypatch.setenv("ETH_SPECS_MESH", "0")
