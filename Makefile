@@ -2,17 +2,19 @@
 
 PYTHON ?= python
 
-.PHONY: help install test test-fast lint speclint jaxlint reftests bytediff bench multichip postmortem serve_docs coverage clean
+.PHONY: help install test test-fast lint speclint jaxlint rangelint reftests bytediff bench multichip postmortem serve_docs coverage clean
 
 help:
 	@echo "install    - editable install with test extras"
 	@echo "test       - FAST lane: suite minus @slow (CPU, 8 virtual devices)"
 	@echo "test-full  - everything incl. @slow (the nightly lane)"
 	@echo "test-slow  - only the @slow modules"
-	@echo "lint       - ruff check (if installed) + speclint + jaxlint + env-docs diff"
+	@echo "lint       - ruff check (if installed) + speclint + jaxlint + rangelint + env-docs diff"
 	@echo "speclint   - AST-level project-native static analysis (docs/analysis.md)"
 	@echo "jaxlint    - trace-level kernel analysis: transfers, donation,"
 	@echo "             recompile surfaces, mesh collectives (docs/analysis.md)"
+	@echo "rangelint  - value-range kernel analysis: interval proof that no"
+	@echo "             limb intermediate wraps a lane (docs/analysis.md)"
 	@echo "reftests   - emit test vectors to ./test_vectors"
 	@echo "bytediff   - conformance byte-diff vs the compiled reference spec"
 	@echo "bench      - run the driver benchmark"
@@ -62,11 +64,14 @@ test-fast: test
 # GATING: fork-safety, lock-order, jit-purity, obs/env/fault registries)
 # then jaxlint (trace-level kernel invariants, GATING: transfer-free,
 # donation-audit, recompile-surface, collective-audit, constant-bloat,
-# x64-drift — docs/analysis.md); env-reference.md must match the registry
+# x64-drift — docs/analysis.md) then rangelint (value-range invariants,
+# GATING: lane-overflow, mask-consistency, lazy-bound-audit);
+# env-reference.md must match the registry
 lint:
 	-$(PYTHON) -m ruff check eth_consensus_specs_tpu/ tests/
 	$(PYTHON) scripts/speclint.py
 	$(PYTHON) scripts/jaxlint.py
+	$(PYTHON) scripts/rangelint.py
 	$(PYTHON) scripts/gen_env_docs.py --check
 
 speclint:
@@ -77,6 +82,12 @@ speclint:
 # analyzed on 8 virtual CPU devices even on a 1-device dev box
 jaxlint:
 	$(PYTHON) scripts/jaxlint.py
+
+# value-range analysis: interval abstract interpretation over every
+# registered kernel's jaxpr, seeded from the registry's declared input
+# domains — proves no intermediate can wrap a u64/u32 lane
+rangelint:
+	$(PYTHON) scripts/rangelint.py
 
 reftests:
 	$(PYTHON) -m eth_consensus_specs_tpu.gen -o test_vectors -v

@@ -427,9 +427,17 @@ def rule_x64_drift(spec, variant, closed) -> list[Finding]:
         name = str(dt)
         if name in spec.dtypes or name in seen:
             continue
-        # 0-d weak-typed scalars are literal-derived trace constants
-        # (python ints riding a mask or a shift) — not real buffers
-        if getattr(av, "ndim", None) == 0 and getattr(av, "weak_type", False):
+        # 0-d weak-typed INTEGER scalars are literal-derived trace
+        # constants (python ints riding a mask or a shift) — not real
+        # buffers. Float weaks get no exemption: a python float leaking
+        # into a u32 kernel is a weak f64 (f32 under jax's default-dtype
+        # demotion is still drift in an integer kernel), exactly the
+        # class the rule exists for
+        if (
+            getattr(av, "ndim", None) == 0
+            and getattr(av, "weak_type", False)
+            and getattr(dt, "kind", None) in ("i", "u")
+        ):
             continue
         seen.add(name)
         findings.append(

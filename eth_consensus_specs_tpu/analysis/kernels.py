@@ -40,19 +40,32 @@ from __future__ import annotations
 from dataclasses import dataclass
 from typing import Callable
 
+from .ranges import Domain, Wrap
+
 # ---------------------------------------------------------------- specs --
 
 
 @dataclass(frozen=True)
 class Variant:
     """One traceable entry point of a kernel family: the callable plus
-    the abstract args (ShapeDtypeStruct pytrees) to trace it with."""
+    the abstract args (ShapeDtypeStruct pytrees) to trace it with.
+
+    ``domains`` seed rangelint's interval analysis: one
+    :class:`~eth_consensus_specs_tpu.analysis.ranges.Domain` per TRACED
+    input pytree leaf (flatten order, static argnums excluded), each
+    carrying the inclusive elementwise bound the kernel assumes of that
+    argument ("Montgomery limbs in [0, 2p) limb-wise", "scalar bits in
+    {0, 1}") plus the concrete boundary members
+    (tests/test_range_domains.py executes every family at these corners
+    against its host oracle, so a stale domain fails at runtime too,
+    not just in the prover)."""
 
     label: str  # "single" | "mesh"
     fn: Callable
     args: tuple
     static_argnums: tuple[int, ...] = ()
     mesh: object = None  # jax Mesh for mesh variants (axis-name binding)
+    domains: tuple = ()  # one ranges.Domain per traced input leaf
 
 
 @dataclass(frozen=True)
@@ -69,6 +82,12 @@ class KernelSpec:
     donation_waiver: str | None = None
     # registry-level rule suppressions (reviewed escape hatch)
     suppress: tuple[str, ...] = ()
+    # sanctioned-wraparound primitive sites for rangelint: each Wrap
+    # names ONE primitive at ONE ``file.py::function`` site where
+    # exceeding the lane is the algorithm (sha256's mod-2^32 adds, the
+    # borrow-chain transient underflow) — reviewed per site, never
+    # blanket
+    wraps: tuple = ()
     # (mesh | None) -> list[Variant]; mesh variants only when mesh given
     # — whether a family HAS a mesh variant is determined here and only
     # here (callers inspect Variant.mesh; no duplicate flag to drift)
@@ -91,6 +110,101 @@ def _default_buckets() -> tuple[int, ...]:
     return ServeConfig().buckets
 
 
+# ------------------------------------------------------- range domains --
+
+
+def limb_caps(value_max: int, limb_bits: int, n_limbs: int):
+    """Inclusive per-limb bound for base-2^limb_bits digit vectors of
+    values <= value_max. Elementwise on purpose: the top limb of a
+    value < 2p is ~2^22, not the limb mask, and several overflow proofs
+    (fat-p lend cover, column sums) need exactly that precision."""
+    import numpy as np
+
+    mask = (1 << limb_bits) - 1
+    return np.array(
+        [min(mask, value_max >> (limb_bits * i)) for i in range(n_limbs)],
+        dtype=object,
+    )
+
+
+def limb_digits(x: int, limb_bits: int, n_limbs: int):
+    """Concrete digit vector of x (a VALID domain member — corner data)."""
+    import numpy as np
+
+    mask = (1 << limb_bits) - 1
+    return np.array(
+        [(x >> (limb_bits * i)) & mask for i in range(n_limbs)], dtype=np.uint64
+    )
+
+
+def mont_domain(
+    name: str, modulus: int, limb_bits: int, n_limbs: int, *, canonical: bool = False
+) -> Domain:
+    """Montgomery limb vectors, limb-wise. The default is the REDUNDANT
+    range [0, 2p) every reduced device field element satisfies; pass
+    ``canonical=True`` for boundaries that require host-converted
+    elements < p (the pairing's prepared inputs: ``_fat_p``'s top-limb
+    lend cover is sized from ``val=p-1``, and rangelint proves a
+    [p, 2p) input would underflow it — the declaration IS the
+    precondition). Corners are the boundary members of the range."""
+    vmax = (modulus - 1) if canonical else (2 * modulus - 1)
+    corners = (
+        ("zero", 0),
+        ("p-1", limb_digits(modulus - 1, limb_bits, n_limbs)),
+    )
+    if not canonical:
+        corners += (("2p-1", limb_digits(2 * modulus - 1, limb_bits, n_limbs)),)
+    return Domain(name, hi=limb_caps(vmax, limb_bits, n_limbs), corners=corners)
+
+
+def limb_borrow_wraps(file: str, mask: int) -> tuple:
+    """The reviewed wrap pair for a borrow-chain subtraction: the
+    ``x - y - borrow`` step transiently underflows (two's complement, by
+    design) and the restore add ``cur + (under << LIMB_BITS)`` provably
+    lands back under the limb ``mask`` — the carry-separation argument
+    the mask-consistency rule checks."""
+    return (
+        Wrap("sub", f"{file}::_sub_limbs"),
+        Wrap("add", f"{file}::_sub_limbs", bound=mask),
+    )
+
+
+def lazy_lend_wraps() -> tuple:
+    """lazy_limbs sanctioned sites: the borrow chain (shrink's cond-sub)
+    plus the ``sub`` lend path. ``fat - y`` is sound because a
+    NORMALIZED y's top digit is bounded by ``y.val >> 364`` — a
+    value-level fact the interval domain cannot represent after norm's
+    re-masking — so the site is declared trusted with the bound
+    ``lazy_limbs._LEND_LIMB_CAP`` (1 << 30) that ``sub`` now enforces at
+    trace time on every call — auto-shrinking a subtrahend whose fat
+    cover would exceed it (tests pin the two constants equal)."""
+    return limb_borrow_wraps("lazy_limbs.py", _MASK26) + (
+        Wrap("sub", "lazy_limbs.py::sub", bound=1 << 30),
+    )
+
+
+# u32 hash words: the full lane is the domain (message/chunk words)
+_WORDS32 = Domain(
+    "hash words (full u32 lane)",
+    hi=0xFFFFFFFF,
+    corners=(("zero", 0), ("all-ones", 0xFFFFFFFF)),
+)
+
+# sha256 wraps BY DESIGN: every add is mod 2^32 (the algorithm), and
+# _rotr's left shift drops high bits that the or re-introduces rotated.
+# Declared per primitive site; families that hash (merkle, state_root)
+# reach these frames through their call stacks.
+_SHA_WRAPS = (
+    Wrap("add", "sha256.py::_compress"),
+    Wrap("add", "sha256.py::rnd"),
+    Wrap("add", "sha256.py::_compress_scan"),
+    Wrap("add", "sha256.py::sha256_pair_words_scan"),
+    Wrap("add", "sha256.py::sha256_pair_words_unrolled"),
+    Wrap("add", "sha256.py::sha256_single_block"),
+    Wrap("shift_left", "sha256.py::_rotr"),
+)
+
+
 # ------------------------------------------------------------- builders --
 
 
@@ -98,7 +212,12 @@ def _sha256_variants(mesh):
     from eth_consensus_specs_tpu.ops import sha256
 
     return [
-        Variant(f"single:tile{t}", sha256._kernel, (_sds((t, 16), "uint32"),))
+        Variant(
+            f"single:tile{t}",
+            sha256._kernel,
+            (_sds((t, 16), "uint32"),),
+            domains=(_WORDS32,),
+        )
         for t in sha256.TILES
     ]
 
@@ -112,6 +231,7 @@ def _merkle_variants(mesh):
             merkle._tree_root_fused,
             (_sds((1 << d, 8), "uint32"), d),
             static_argnums=(1,),
+            domains=(_WORDS32,),
         )
         for d in (6, 10)
     ]
@@ -132,6 +252,7 @@ def _merkle_many_variants(mesh):
             merkle._many_tree_root_fused,
             (*_merkle_many_args(8, depth), depth),
             static_argnums=(1,),
+            domains=(_WORDS32,),
         )
     ]
     if mesh is not None:
@@ -142,6 +263,7 @@ def _merkle_many_variants(mesh):
                 merkle._many_tree_root_sharded(mesh, depth),
                 _merkle_many_args(batch, depth),
                 mesh=mesh,
+                domains=(_WORDS32,),
             )
         )
     return out
@@ -181,6 +303,14 @@ def _shuffle_variants(mesh):
             "single",
             shuffle._device_shuffle_kernel(n, rounds, num_chunks),
             (_sds((rounds * num_chunks, 16), "uint32"), _sds((rounds,), "int32")),
+            domains=(
+                _WORDS32,
+                Domain(
+                    "round pivots in [0, n)",
+                    hi=n - 1,
+                    corners=(("zero", 0), ("n-1", n - 1)),
+                ),
+            ),
         )
     ]
 
@@ -189,16 +319,44 @@ def _fr_fft_variants(mesh):
     from eth_consensus_specs_tpu.ops import fr_fft
 
     n, stages = 256, 8
+    fr = fr_fft.FR
     tw = tuple(
-        _sds((1 << i, fr_fft.FR.n_limbs), "uint64") for i in range(stages)
+        _sds((1 << i, fr.n_limbs), "uint64") for i in range(stages)
+    )
+    # twiddle tables are CANONICAL Montgomery (< r, built by to_mont);
+    # no corners — the runtime corner test needs the real tables (a
+    # boundary "twiddle" would just be a different polynomial basis)
+    tw_dom = Domain(
+        "twiddles: canonical Montgomery Fr (< r limb-wise)",
+        hi=limb_caps(fr.modulus - 1, 30, fr.n_limbs),
     )
     return [
         Variant(
             "single",
             fr_fft._compiled_fft(n, stages),
-            (_sds((4, n, fr_fft.FR.n_limbs), "uint64"), *tw),
+            (_sds((4, n, fr.n_limbs), "uint64"), *tw),
+            domains=(
+                mont_domain("values: Montgomery Fr in [0, 2r)", fr.modulus, 30, fr.n_limbs),
+                *([tw_dom] * stages),
+            ),
         )
     ]
+
+
+def _fq_jacobian_domains() -> tuple:
+    from eth_consensus_specs_tpu.crypto.fields import P
+
+    return tuple(
+        mont_domain(f"Jacobian {c}: Montgomery Fq in [0, 2p)", P, 30, 13)
+        for c in ("X", "Y", "Z")
+    )
+
+
+_SCALAR_BITS_DOMAIN = Domain(
+    "scalar bits in {0, 1}",
+    hi=1,
+    corners=(("zero", 0), ("one", 1)),
+)
 
 
 def _g1_msm_variants(mesh):
@@ -211,11 +369,18 @@ def _g1_msm_variants(mesh):
             *[_sds((lanes, 13), "uint64")] * 3,
         )
 
-    out = [Variant("single", g1_msm.msm_kernel, args(8))]
+    doms = (_SCALAR_BITS_DOMAIN, *_fq_jacobian_domains())
+    out = [Variant("single", g1_msm.msm_kernel, args(8), domains=doms)]
     if mesh is not None:
         lanes = g1_msm.mesh_lane_pad(8, mesh_ops.shard_count(mesh))
         out.append(
-            Variant("mesh", g1_msm._sharded_fn(mesh, "msm"), args(lanes), mesh=mesh)
+            Variant(
+                "mesh",
+                g1_msm._sharded_fn(mesh, "msm"),
+                args(lanes),
+                mesh=mesh,
+                domains=doms,
+            )
         )
     return out
 
@@ -228,7 +393,12 @@ def _bls_msm_variants(mesh):
     from eth_consensus_specs_tpu.ops import g1_msm
     from eth_consensus_specs_tpu.parallel import mesh_ops
 
-    out = [Variant("single", g1_msm.sum_many_kernel, _bls_msm_args(4, 8))]
+    doms = _fq_jacobian_domains()
+    out = [
+        Variant(
+            "single", g1_msm.sum_many_kernel, _bls_msm_args(4, 8), domains=doms
+        )
+    ]
     if mesh is not None:
         items = mesh_ops.pad_to_shards(4, mesh_ops.shard_count(mesh))
         out.append(
@@ -237,6 +407,7 @@ def _bls_msm_variants(mesh):
                 g1_msm._sharded_fn(mesh, "sum_many"),
                 _bls_msm_args(items, 8),
                 mesh=mesh,
+                domains=doms,
             )
         )
     return out
@@ -264,6 +435,22 @@ def _bls_msm_key_grid(mesh):
     return out
 
 
+def _pairing_domains() -> tuple:
+    from eth_consensus_specs_tpu.crypto.fields import P
+    from eth_consensus_specs_tpu.ops import lazy_limbs as lz
+
+    # CANONICAL (< p): miller_from_coeffs claims val=P-1 for the
+    # prepared inputs, and _fat_p's lend cover is sized from that claim
+    # — a [p, 2p) input would underflow the borrow-free sub
+    lazy = lambda name: mont_domain(name, P, lz.LIMB_BITS, lz.N_LIMBS, canonical=True)
+    return (
+        lazy("prepared coefficients: canonical Montgomery Fq (< p)"),
+        lazy("G1 x: canonical Montgomery Fq (< p)"),
+        lazy("G1 y: canonical Montgomery Fq (< p)"),
+        Domain("active mask", hi=1, corners=(("inactive", 0), ("active", 1))),
+    )
+
+
 def _pairing_variants(mesh):
     from eth_consensus_specs_tpu.ops import pairing_device as pd
 
@@ -276,7 +463,8 @@ def _pairing_variants(mesh):
             _sds((*lead, pd._CHUNK), "bool"),
         )
 
-    out = [Variant("single", pd._miller_chunk_fold, chunk_args(0))]
+    doms = _pairing_domains()
+    out = [Variant("single", pd._miller_chunk_fold, chunk_args(0), domains=doms)]
     if mesh is not None:
         from eth_consensus_specs_tpu.parallel import mesh_ops
 
@@ -287,6 +475,7 @@ def _pairing_variants(mesh):
                 pd._miller_sharded_fn(mesh, 1),
                 chunk_args(shards),
                 mesh=mesh,
+                domains=doms,
             )
         )
     return out
@@ -349,6 +538,55 @@ def _state_root_args(meta):
     return arrays, cols, just
 
 
+_U64_FULL = Domain(
+    "u64 SSZ value (full lane)",
+    hi=(1 << 64) - 1,
+    corners=(("zero", 0), ("max", (1 << 64) - 1)),
+)
+_BYTES_FULL = Domain(
+    "opaque bytes (full u8 lane)",
+    hi=255,
+    corners=(("zero", 0), ("max", 255)),
+)
+_BOOL_DOMAIN = Domain("bit", hi=1, corners=(("false", 0), ("true", 1)))
+
+
+def _state_root_domains() -> tuple:
+    """One Domain per flat leaf of (arrays, bal, eff, inact, just) — the
+    kernel only HASHES these (byte-swap + sha256 wraps), so every leaf's
+    domain is its full lane; a future arithmetic epoch-accounting step
+    would have to tighten these to survive rangelint."""
+    return (
+        # StateRootArrays: val_node_a, val_node_f, slashed_chunk,
+        # prev_part_flags, top_chunks, zerohashes
+        _WORDS32,
+        _WORDS32,
+        _WORDS32,
+        _BYTES_FULL,
+        _WORDS32,
+        _WORDS32,
+        # balances / effective_balance / inactivity_scores columns
+        _U64_FULL,
+        _U64_FULL,
+        _U64_FULL,
+        # JustificationState: current_epoch, justification_bits,
+        # prev_justified_epoch, prev_justified_root, cur_justified_epoch,
+        # cur_justified_root, finalized_epoch, finalized_root,
+        # block_root_prev, block_root_cur, slashings_sum
+        _U64_FULL,
+        _BOOL_DOMAIN,
+        _U64_FULL,
+        _BYTES_FULL,
+        _U64_FULL,
+        _BYTES_FULL,
+        _U64_FULL,
+        _BYTES_FULL,
+        _BYTES_FULL,
+        _BYTES_FULL,
+        _U64_FULL,
+    )
+
+
 def _state_root_variants(mesh):
     from eth_consensus_specs_tpu.ops import state_root as sr
 
@@ -360,7 +598,14 @@ def _state_root_variants(mesh):
             arrays, meta, balances, effective_balance, inactivity_scores, just
         )
 
-    return [Variant("single", run, (arrays, bal, eff, inact, just))]
+    return [
+        Variant(
+            "single",
+            run,
+            (arrays, bal, eff, inact, just),
+            domains=_state_root_domains(),
+        )
+    ]
 
 
 def _state_root_key_grid(mesh):
@@ -396,6 +641,9 @@ def _canon_args(args) -> tuple:
 
 _LIMB_DTYPES = frozenset({"uint64", "uint32", "int32", "bool"})
 
+_MASK30 = (1 << 30) - 1  # field_limbs / limb_field limb mask
+_MASK26 = (1 << 26) - 1  # lazy_limbs limb mask
+
 REGISTRY: tuple[KernelSpec, ...] = (
     KernelSpec(
         name="sha256",
@@ -403,6 +651,7 @@ REGISTRY: tuple[KernelSpec, ...] = (
         dtypes=frozenset({"uint32"}),
         donation_waiver="message (N,16) and digest (N,8) avals never alias; "
         "tiles are transient host uploads reused across levels",
+        wraps=_SHA_WRAPS,
         build_variants=_sha256_variants,
     ),
     KernelSpec(
@@ -413,6 +662,7 @@ REGISTRY: tuple[KernelSpec, ...] = (
         donation_waiver="leaf buffer (2^d,8) vs root (8,) never alias; the "
         "resident-state seam (ROADMAP item 2) donates at the column level, "
         "not here",
+        wraps=_SHA_WRAPS,
         build_variants=_merkle_variants,
     ),
     KernelSpec(
@@ -420,6 +670,7 @@ REGISTRY: tuple[KernelSpec, ...] = (
         help="vmapped multi-tree merkleization, mesh tree-axis sharded",
         dtypes=frozenset({"uint32", "int32", "bool"}),
         donation_waiver="batched leaves (B,2^d,8) vs roots (B,8) never alias",
+        wraps=_SHA_WRAPS,
         build_variants=_merkle_many_variants,
         key_grid=_merkle_many_key_grid,
     ),
@@ -429,6 +680,7 @@ REGISTRY: tuple[KernelSpec, ...] = (
         dtypes=frozenset({"uint32", "int32", "bool"}),
         donation_waiver="decision blocks and pivots are read-only; the index "
         "plane lives in the loop carry, not an argument buffer",
+        wraps=_SHA_WRAPS,
         build_variants=_shuffle_variants,
     ),
     KernelSpec(
@@ -436,6 +688,7 @@ REGISTRY: tuple[KernelSpec, ...] = (
         help="batched BLS-scalar-field FFT (ops/fr_fft)",
         dtypes=_LIMB_DTYPES,
         donate=(0,),  # vals: private bit-reversed copy, aval == output
+        wraps=limb_borrow_wraps("limb_field.py", _MASK30),
         build_variants=_fr_fft_variants,
     ),
     KernelSpec(
@@ -444,6 +697,7 @@ REGISTRY: tuple[KernelSpec, ...] = (
         dtypes=_LIMB_DTYPES,
         donation_waiver="lane arrays (N,13)x3 + bits (N,256) vs one Jacobian "
         "point (13,)x3 — no aval ever aliases an output",
+        wraps=limb_borrow_wraps("field_limbs.py", _MASK30),
         build_variants=_g1_msm_variants,
     ),
     KernelSpec(
@@ -453,6 +707,7 @@ REGISTRY: tuple[KernelSpec, ...] = (
         dtypes=_LIMB_DTYPES,
         donation_waiver="committee lanes (I,L,13)x3 vs per-item points "
         "(I,13)x3 — shapes never alias",
+        wraps=limb_borrow_wraps("field_limbs.py", _MASK30),
         build_variants=_bls_msm_variants,
         key_grid=_bls_msm_key_grid,
     ),
@@ -463,6 +718,7 @@ REGISTRY: tuple[KernelSpec, ...] = (
         donation_waiver="prepared coefficients are cached host constants "
         "(_PREP_CACHE) reused across batches — donating them would corrupt "
         "the cache",
+        wraps=lazy_lend_wraps(),
         build_variants=_pairing_variants,
     ),
     KernelSpec(
@@ -472,6 +728,7 @@ REGISTRY: tuple[KernelSpec, ...] = (
         donation_waiver="static tree arrays are reused every epoch "
         "(device-resident by design); donation lands with the in-place "
         "per-slot updates of ROADMAP item 2",
+        wraps=_SHA_WRAPS,
         build_variants=_state_root_variants,
         key_grid=_state_root_key_grid,
     ),

@@ -1,0 +1,374 @@
+"""rangelint — value-level static analysis: no limb kernel wraps a lane.
+
+The third rung of the analysis ladder. speclint (analysis/lint.py) reads
+source; jaxlint (analysis/jaxlint.py) reads trace structure; rangelint
+reads trace VALUES: an interval abstract interpreter (analysis/ranges.py)
+walks every registered kernel's jaxpr with exact python-int bounds seeded
+from the input domains the registry declares (``Variant.domains``) and
+proves, per intermediate, that no unsigned lane can silently wrap. The
+hand-reasoned comments this machine-checks are load-bearing: a u64
+wraparound in the Montgomery column sums is a wrong pairing verdict, not
+an exception.
+
+``lane-overflow``
+    Any intermediate whose interval can exceed its dtype max (or
+    underflow below zero on an unsigned lane) in a kernel not annotated
+    ``wraps`` at that primitive site. sha256's mod-2^32 adds are the
+    sanctioned wrap — declared per primitive site (``Wrap``), never
+    blanket. Widened loops (no inductive carry interval), analysis
+    timeouts, and unhandled primitives also land here: an UNPROVEN
+    kernel is indistinguishable from an overflowing one. NEVER
+    baselined (HARD_RULES).
+``mask-consistency``
+    A value AND-ed with a low-bit mask ``2^k - 1`` must be provably
+    bounded — masks may truncate only bits the interval proves are
+    separately-propagated carries. Masking a widened/unproven value is
+    how an upstream overflow hides.
+``lazy-bound-audit``
+    Every ``lazy_limbs.LF`` static ``max_limb`` claim (``add``/``dbl``
+    growth, ``sub``'s ``_fat_p`` lend path, ``mul``'s normalized
+    output) is cross-checked against the interval the analyzer infers
+    for the same chain: a claim TIGHTER than inferred is a soundness
+    bug (downstream preconditions trust it), a claim LOOSER than
+    inferred is waste (it forces premature norm/shrink sweeps).
+
+Findings reuse the speclint/jaxlint machinery end to end: line-free
+``kernel::rule::detail`` fingerprints, the ratcheting EMPTY baseline
+(``rangelint_baseline.json``), the shared CLI front end
+(analysis/cli.py), ``scripts/rangelint.py`` / ``make rangelint``.
+Abstract interpretation only — no execution, no XLA compile.
+"""
+
+from __future__ import annotations
+
+import time
+
+from . import kernels as kernels_mod
+from .lint import Finding
+from .ranges import (
+    AnalysisTimeout,
+    Ival,
+    RangeInterp,
+    range_timeout_s,
+    widen_steps_default,
+    _obj,
+)
+
+ALL_RULES = (
+    "lane-overflow",
+    "mask-consistency",
+    "lazy-bound-audit",
+)
+
+# lane-overflow may NEVER be baselined (CI asserts this): a possible
+# silent wraparound in consensus-critical arithmetic is a bug, not debt
+HARD_RULES = ("lane-overflow",)
+
+_EVENT_RULE = {
+    "overflow": "lane-overflow",
+    "widened": "lane-overflow",
+    "unhandled": "lane-overflow",
+    "masked-taint": "mask-consistency",
+}
+
+
+def _f(name: str, rule: str, detail: str, message: str) -> Finding:
+    # path = kernel family: fingerprint kernel::rule::detail, line-free
+    return Finding(rule, name, 0, detail, message)
+
+
+def seed_ivals(variant) -> list[Ival]:
+    """The flat input intervals for one variant, from its declared
+    domains (one Domain per traced-arg pytree leaf, in flatten order)."""
+    import jax
+    import numpy as np
+
+    traced = [
+        a
+        for i, a in enumerate(variant.args)
+        if i not in (variant.static_argnums or ())
+    ]
+    leaves = jax.tree_util.tree_leaves(traced)
+    if len(variant.domains) != len(leaves):
+        raise ValueError(
+            f"variant {variant.label!r} declares {len(variant.domains)} "
+            f"domains for {len(leaves)} traced input leaves"
+        )
+    out = []
+    for dom, leaf in zip(variant.domains, leaves):
+        shape = tuple(leaf.shape)
+        lo = _obj(np.asarray(dom.lo, object), shape) if np.ndim(dom.lo) else int(dom.lo)
+        hi = _obj(np.asarray(dom.hi, object), shape) if np.ndim(dom.hi) else int(dom.hi)
+        out.append(Ival(lo, hi))
+    return out
+
+
+def analyze_variant(spec, variant, *, widen_steps=None, deadline=None):
+    """(findings, interp) for one registry variant."""
+    from .jaxlint import trace_variant
+
+    findings: list[Finding] = []
+    closed = trace_variant(variant)
+    interp = RangeInterp(
+        wraps=spec.wraps, widen_steps=widen_steps, deadline=deadline
+    )
+    try:
+        interp.run(closed, seed_ivals(variant))
+    except AnalysisTimeout:
+        findings.append(
+            _f(
+                spec.name,
+                "lane-overflow",
+                f"{variant.label}:timeout",
+                f"{spec.name}/{variant.label}: interval analysis exceeded "
+                "ETH_SPECS_ANALYSIS_RANGE_TIMEOUT_S — the kernel is UNPROVEN "
+                "against lane overflow (raise the budget or shrink the "
+                "representative shapes)",
+            )
+        )
+        return findings, interp
+    for ev in interp.events:
+        rule = _EVENT_RULE.get(ev.kind)
+        if rule is None:
+            continue
+        findings.append(
+            _f(
+                spec.name,
+                rule,
+                f"{variant.label}:{ev.detail}",
+                f"{spec.name}/{variant.label}: {ev.message}",
+            )
+        )
+    return findings, interp
+
+
+# ------------------------------------------------------- lazy-bound-audit --
+
+
+def _lf_chain_cases():
+    """The audited LF chains: (label, n_inputs, fn(LF...) -> LF).
+    Kept below the shrink/norm thresholds so the claims under audit are
+    the RAW growth formulas, not post-sweep resets."""
+    from eth_consensus_specs_tpu.ops import lazy_limbs as lz
+
+    def add2(a, b):
+        return lz.add(a, b)
+
+    def dbl1(a):
+        return lz.dbl(a)
+
+    def add_chain4(a, b, c, d):
+        return lz.add(lz.add(a, b), lz.add(c, d))
+
+    def dbl_chain3(a):
+        return lz.dbl(lz.dbl(lz.dbl(a)))
+
+    def sub2(a, b):
+        return lz.sub(a, b)
+
+    def sub_of_sum(a, b, c):
+        # the lend path under a GROWN subtrahend: _fat_p must re-cover
+        return lz.sub(a, lz.add(b, c))
+
+    def mul2(a, b):
+        return lz.mul(a, b)
+
+    return [
+        ("add", 2, add2),
+        ("dbl", 1, dbl1),
+        ("add_chain4", 4, add_chain4),
+        ("dbl_chain3", 1, dbl_chain3),
+        ("sub", 2, sub2),
+        ("sub_fat_lend", 3, sub_of_sum),
+        ("mul", 2, mul2),
+    ]
+
+
+def audit_lazy_bounds(*, widen_steps=None, deadline=None):
+    """Cross-check LF ``max_limb`` claims against inferred intervals.
+
+    Each chain is traced over normalized inputs (limb-wise
+    ``[0, NORM_MAX]``, value < 2p — exactly what ``lf()`` claims); the
+    trace ITSELF computes the static claim (the LF bound algebra runs at
+    trace time), and the interpreter infers the true reachable interval
+    of the output array. claim < inferred -> soundness finding;
+    claim > inferred -> waste finding. Returns (findings, stats)."""
+    import jax
+    import numpy as np
+
+    from eth_consensus_specs_tpu.ops import lazy_limbs as lz
+
+    findings: list[Finding] = []
+    stats = {"chains": 0, "events": 0}
+    shape = (2, lz.N_LIMBS)
+    sds = jax.ShapeDtypeStruct(shape, jax.numpy.uint64)
+    # the SAME digit-cap formula the registry domains seed the family
+    # sweep with — the audit must prove against the identical input set
+    hi = kernels_mod.limb_caps(2 * lz.P_INT - 1, lz.LIMB_BITS, lz.N_LIMBS)
+    for label, n_in, chain in _lf_chain_cases():
+        stats["chains"] += 1
+        claims: list[tuple[int, int]] = []
+
+        def run(*arrs, _chain=chain):
+            out = _chain(*(lz.lf(a) for a in arrs))
+            claims.append((out.max, out.val))
+            return out.v
+
+        closed = jax.make_jaxpr(run)(*([sds] * n_in))
+        claimed_max, claimed_val = claims[0]
+        interp = RangeInterp(
+            wraps=_lazy_wraps(), widen_steps=widen_steps, deadline=deadline
+        )
+        try:
+            [out] = interp.run(
+                closed, [Ival(0, np.broadcast_to(hi, shape))] * n_in
+            )
+        except AnalysisTimeout:
+            # unproven == indistinguishable from overflowing: file under
+            # the never-baselined rule, same as the family sweep
+            findings.append(
+                _f("lazy_limbs", "lane-overflow", f"{label}:timeout",
+                   f"lazy_limbs {label}: bound audit timed out — unproven")
+            )
+            continue
+        stats["events"] += len(interp.events)
+        for ev in interp.events:
+            # overflow/widened/unhandled inside a chain is a LANE bug the
+            # audit happened to surface — it must keep the lane-overflow
+            # fingerprint so it can never be baselined away as audit debt
+            findings.append(
+                _f(
+                    "lazy_limbs",
+                    _EVENT_RULE.get(ev.kind, "lane-overflow"),
+                    f"{label}:{ev.detail}",
+                    f"lazy_limbs {label}: {ev.message}",
+                )
+            )
+        inferred = _ival_max(out)
+        if claimed_max < inferred:
+            findings.append(
+                _f(
+                    "lazy_limbs",
+                    "lazy-bound-audit",
+                    f"{label}:claim-tight",
+                    f"lazy_limbs {label}: claimed max_limb {claimed_max} is "
+                    f"TIGHTER than the inferred reachable bound {inferred} — "
+                    "every downstream norm/shrink/mul precondition trusting "
+                    "the claim is unsound (a lane can wrap where the static "
+                    "bookkeeping says it cannot)",
+                )
+            )
+        elif claimed_max > max(inferred, lz.NORM_MAX):
+            # claims never need to dip below NORM_MAX (inputs are allowed
+            # to BE normalized); above that, looseness costs real sweeps
+            findings.append(
+                _f(
+                    "lazy_limbs",
+                    "lazy-bound-audit",
+                    f"{label}:claim-loose",
+                    f"lazy_limbs {label}: claimed max_limb {claimed_max} is "
+                    f"LOOSER than the inferred bound {inferred} — the slack "
+                    "forces premature norm/shrink sweeps (each one a ~15-op "
+                    "carry subgraph the lazy design exists to avoid)",
+                )
+            )
+    return findings, stats
+
+
+def _ival_max(iv: Ival) -> int:
+    import numpy as np
+
+    return int(np.max(iv.hi)) if isinstance(iv.hi, np.ndarray) else int(iv.hi)
+
+
+def _lazy_wraps():
+    """The reviewed wrap sites for lazy_limbs chains — the SAME set the
+    pairing registry entry declares, so the audit and the family sweep
+    trust identical sites."""
+    return kernels_mod.lazy_lend_wraps()
+
+
+# ------------------------------------------------------------------ engine --
+
+
+def analyze(
+    mesh=None,
+    rules: set[str] | None = None,
+    registry: tuple | None = None,
+    only: set[str] | None = None,
+    widen_steps: int | None = None,
+    timeout_s: float | None = None,
+) -> tuple[list[Finding], dict]:
+    """Run the selected value-range rules over the kernel registry.
+    Returns (findings, stats). Same contract as jaxlint.analyze:
+    ``mesh=None`` analyzes single-device variants only, ``only`` narrows
+    to a family subset. The per-FAMILY deadline comes from
+    ``ETH_SPECS_ANALYSIS_RANGE_TIMEOUT_S`` unless ``timeout_s`` is
+    given."""
+    rules = set(rules) if rules is not None else set(ALL_RULES)
+    registry = kernels_mod.REGISTRY if registry is None else registry
+    widen_steps = widen_steps or widen_steps_default()
+    budget = range_timeout_s() if timeout_s is None else timeout_s
+    findings: list[Finding] = []
+    stats = {
+        "kernels": 0,
+        "variants": 0,
+        "mesh_variants": 0,
+        "eqns": 0,
+        "unrolled_scans": 0,
+        "widened_loops": 0,
+        "wrap_hits": 0,
+    }
+    if rules & {"lane-overflow", "mask-consistency"}:
+        for spec in registry:
+            if only is not None and spec.name not in only:
+                continue
+            stats["kernels"] += 1
+            deadline = time.monotonic() + budget
+            for variant in spec.build_variants(mesh):
+                stats["variants"] += 1
+                if variant.mesh is not None:
+                    stats["mesh_variants"] += 1
+                fs, interp = analyze_variant(
+                    spec, variant, widen_steps=widen_steps, deadline=deadline
+                )
+                # hard-rule findings always ship, even when the caller
+                # narrowed --rules: lane-overflow has no opt-out
+                findings.extend(
+                    f for f in fs if f.rule in rules or f.rule in HARD_RULES
+                )
+                for k in ("eqns", "unrolled_scans", "widened_loops", "wrap_hits"):
+                    stats[k] += interp.stats[k]
+            if spec.suppress:
+                findings = [
+                    f
+                    for f in findings
+                    if not (
+                        f.path == spec.name
+                        and f.rule in spec.suppress
+                        and f.rule not in HARD_RULES
+                    )
+                ]
+    if "lazy-bound-audit" in rules and (
+        only is None or {"lazy_limbs", "pairing"} & only
+    ):
+        deadline = time.monotonic() + budget
+        audit_findings, audit_stats = audit_lazy_bounds(
+            widen_steps=widen_steps, deadline=deadline
+        )
+        # audit-surfaced hard-rule findings always ship, even when the
+        # caller narrowed --rules: lane-overflow has no opt-out
+        findings.extend(
+            f for f in audit_findings if f.rule in rules or f.rule in HARD_RULES
+        )
+        stats["lf_chains"] = audit_stats["chains"]
+    # one finding per fingerprint (several variants repeating the same
+    # defect collapse), like jaxlint
+    seen: set[str] = set()
+    unique = []
+    for f in sorted(findings, key=lambda f: (f.path, f.rule, f.symbol)):
+        if f.fingerprint in seen:
+            continue
+        seen.add(f.fingerprint)
+        unique.append(f)
+    return unique, stats

@@ -161,6 +161,16 @@ ENV_VARS: tuple[EnvVar, ...] = (
        "jaxlint donation-audit threshold: an undonated input aliasing an "
        "output aval at or above this many bytes is a missed-donation finding",
        "analysis.md#trace-level-rules-jaxlint"),
+    _v("ETH_SPECS_ANALYSIS_RANGE_WIDEN_STEPS", "12",
+       "rangelint loop-widening budget: join-and-retry passes before a "
+       "non-inductive scan/while carry is widened to dtype-top (an "
+       "unproven-loop lane-overflow finding); sha256's 8-register "
+       "rotation needs ~9",
+       "analysis.md#value-range-rules-rangelint"),
+    _v("ETH_SPECS_ANALYSIS_RANGE_TIMEOUT_S", "300",
+       "rangelint per-family analysis deadline in seconds; exceeding it "
+       "is itself a lane-overflow finding (the kernel remains unproven)",
+       "analysis.md#value-range-rules-rangelint"),
     # ----------------------------------------------------------- kernels --
     _v("ETH_SPECS_TPU_NO_NATIVE", "0",
        "`1`: skip the native (CFFI) BLS fast paths, pure-python/device only",

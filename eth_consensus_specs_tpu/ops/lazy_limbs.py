@@ -145,6 +145,15 @@ def _fat_p(limb_bound: int, top_bound: int) -> tuple[np.ndarray, int, int]:
     return hit
 
 
+# Static cap on any _fat_p limb (f_i ~ y.max + 3*2^26 for every real
+# call site). The rangelint lend-path Wrap declares the SAME cap
+# (analysis/kernels.py), so the abstract interpreter's trusted bound for
+# `fat - y` and this trace-time assertion can never drift apart; a limb
+# this size leaves 2^34 of lane headroom for the subsequent add/mul
+# columns (15 * 2^30 * 2^26 < 2^60).
+_LEND_LIMB_CAP = 1 << 30
+
+
 def sub(x: LF, y: LF) -> LF:
     """x - y (mod p), borrow-free against y's static bounds. A very lazy
     subtrahend would force a fat multiple with a huge top-limb cover
@@ -156,6 +165,22 @@ def sub(x: LF, y: LF) -> LF:
         x = shrink(x)
     top_bound = min(y.max, y.val >> (LIMB_BITS * (N_LIMBS - 1)))
     fat, fat_max, c = _fat_p(y.max, top_bound)
+    if fat_max > _LEND_LIMB_CAP:
+        # a subtrahend can be lazy enough to outgrow the lend cap without
+        # tripping the val-triggered shrink above (a 15-term canonical sum:
+        # val = 15p < 16p, but max ~15*2^26 pushes the fat cover past 2^30)
+        # — auto-insert the sweep, per the module contract, and re-cover
+        y = shrink(y)
+        top_bound = min(y.max, y.val >> (LIMB_BITS * (N_LIMBS - 1)))
+        fat, fat_max, c = _fat_p(y.max, top_bound)
+    # bound growth on the lend path: the fat limbs must respect the cap
+    # the range analysis trusts, and the x + (fat - y) add must be
+    # provably in-lane — neither held by construction before
+    assert fat_max <= _LEND_LIMB_CAP, (
+        f"_fat_p limb {fat_max} exceeds the declared lend cap "
+        f"{_LEND_LIMB_CAP} even after shrink"
+    )
+    assert x.max + fat_max < (1 << 64), "sub: x + (fat - y) could wrap the lane"
     diff = jnp.asarray(fat) - y.v
     return LF(x.v + diff, x.max + fat_max, x.val + c * P_INT)
 
@@ -281,7 +306,12 @@ def mul(x: LF, y: LF) -> LF:
 
     pv = jnp.asarray(P_LIMBS)
     for i in range(N_LIMBS):
-        m = (t[..., i] * n0) & mask
+        # mask BEFORE the n0 product: (t_i & mask) * n0 ≡ t_i * n0
+        # (mod 2^26), so m is unchanged — but the unmasked product could
+        # exceed 2^64 and leaned on silent u64 truncation for its low
+        # bits; pre-masking keeps every intermediate provably in-lane
+        # (rangelint lane-overflow, ~2^52 vs ~2^78)
+        m = ((t[..., i] & mask) * n0) & mask
         t = t + _pad_to(m[..., None] * pv, i)
         # fold position i's full value upward before step i+1 reads i+1
         t = t + _pad_to((t[..., i] >> shift)[..., None], i + 1)

@@ -1,0 +1,99 @@
+#!/usr/bin/env python
+"""rangelint CLI — value-range static analysis of the registered kernels.
+
+Usage:
+    python scripts/rangelint.py                  # every family, 8 virtual chips
+    python scripts/rangelint.py --chips 1        # single-device variants only
+    python scripts/rangelint.py --json r.json    # machine-readable report
+    python scripts/rangelint.py --rules lane-overflow,lazy-bound-audit
+    python scripts/rangelint.py --only pairing,g1_msm
+    python scripts/rangelint.py --write-baseline
+
+Interval abstract interpretation over ``jax.make_jaxpr`` output only —
+nothing executes, nothing compiles. Input intervals come from the
+domains each registry variant declares; sanctioned wraparound comes from
+each family's per-primitive-site ``Wrap`` declarations. ``--chips N``
+forces N virtual CPU devices BEFORE jax initializes (the serve_bench
+idiom) so the four mesh-sharded variants are analyzable on any dev box.
+
+Exit codes (shared with speclint/jaxlint via analysis/cli.py): 0 clean,
+1 usage/ratchet error, 2 non-baselined findings. The baseline
+(rangelint_baseline.json) ships EMPTY and may only shrink; CI
+additionally asserts lane-overflow findings are NEVER baselined — a
+possible silent wraparound is a wrong pairing verdict, not tech debt.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO_ROOT)
+# the --chips pre-parse must run before the first jax import (XLA reads
+# XLA_FLAGS once, at backend init); ONE copy shared with serve_bench.py
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+from prejax import force_virtual_chips  # noqa: E402
+
+
+def main() -> int:
+    chips = force_virtual_chips(default=8, env_var=None)
+
+    from eth_consensus_specs_tpu.analysis import cli, rangelint
+
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument(
+        "--chips",
+        type=int,
+        default=8,
+        help="virtual device count for the mesh variants (forced before "
+        "jax init on cpu; 1 = single-device variants only; default 8)",
+    )
+    ap.add_argument(
+        "--only", help="comma-separated kernel-family subset (default: all)"
+    )
+    cli.add_common_args(
+        ap,
+        default_baseline=os.path.join(REPO_ROOT, "rangelint_baseline.json"),
+        all_rules=rangelint.ALL_RULES,
+    )
+    args = ap.parse_args()
+
+    try:
+        rules = cli.parse_rules(args, rangelint.ALL_RULES)
+    except ValueError as exc:
+        print(exc)
+        return 1
+    only = (
+        {k.strip() for k in args.only.split(",") if k.strip()} if args.only else None
+    )
+    if only:
+        from eth_consensus_specs_tpu.analysis import kernels
+
+        unknown = only - set(kernels.by_name()) - {"lazy_limbs"}
+        if unknown:
+            # a silently-ignored family would let the mesh-smoke gate
+            # pass green while proving nothing — fail loudly
+            print(
+                f"unknown kernel families: {sorted(unknown)} "
+                f"(have {sorted(kernels.by_name())} + lazy_limbs)"
+            )
+            return 1
+
+    from eth_consensus_specs_tpu.parallel.mesh_ops import mesh_signature, serve_mesh
+
+    mesh = serve_mesh(chips) if chips > 1 else None
+    findings, stats = rangelint.analyze(mesh=mesh, rules=rules, only=only)
+    stats["mesh"] = mesh_signature(mesh)
+    print(
+        f"rangelint: {stats['kernels']} kernel families, {stats['variants']} "
+        f"variants ({stats['mesh_variants']} mesh @ {stats['mesh'] or 'none'}), "
+        f"{stats['eqns']} eqns interpreted, {stats['wrap_hits']} sanctioned "
+        f"wrap hits, {stats.get('lf_chains', 0)} LF chains audited"
+    )
+    return cli.finish(args, findings, tool="rangelint", extra=stats)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -214,6 +214,37 @@ def test_x64_drift_flags_upcast_and_exempts_weak_scalars():
     assert _run(spec, rules={"x64-drift"}) == []
 
 
+def test_x64_drift_weak_float_scalar_is_not_exempt():
+    """The weak-scalar exemption is INTEGER-only: a python float creeping
+    into an integer kernel rides as a 0-d weak f32/f64 — exactly the
+    drift class the rule exists for — and must fire even though it never
+    materializes as an array."""
+
+    def drifts(flags):
+        # select between two python-float literals under a traced bool:
+        # the result is a 0-d WEAK float that would have slipped through
+        # a blanket 0-d-weak exemption
+        v = jnp.where(flags[0], 1.5, 2.5)
+        return (v > jnp.float64(2.0)).astype(jnp.uint32) + flags.astype(jnp.uint32)
+
+    spec = _spec(dtypes=("uint32", "bool"), variants=[
+        Variant("single", jax.jit(drifts), (_sds((4,), jnp.bool_),))
+    ])
+    findings = _run(spec, rules={"x64-drift"})
+    assert findings, "a 0-d weak float in an integer kernel MUST fire"
+    assert all(f.symbol.startswith("single:float") for f in findings)
+
+    # the companion negative: the same shape of kernel whose 0-d weak
+    # scalar is an INTEGER (a python shift amount) stays exempt
+    def int_weak(flags):
+        return flags.astype(jnp.uint32) << 3
+
+    spec = _spec(dtypes=("uint32", "bool"), variants=[
+        Variant("single", jax.jit(int_weak), (_sds((4,), jnp.bool_),))
+    ])
+    assert _run(spec, rules={"x64-drift"}) == []
+
+
 # --------------------------------------------------------- recompile-surface
 
 

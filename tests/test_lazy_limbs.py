@@ -75,6 +75,24 @@ def test_sub_borrow_free_on_lazy_subtrahend():
     assert got == (a_int - 3 * b_int) % P
 
 
+def test_sub_of_deep_lazy_sum_auto_shrinks_under_the_lend_cap():
+    """A 15-term canonical sum has val = 15p (under sub's 16p shrink
+    trigger) but max ~15*2^26 — a fat cover for THAT would break the
+    2^30 lend cap. sub must auto-shrink the subtrahend and stay exact,
+    not crash on a chain the lazy design explicitly allows."""
+    x, x_int = _wrap(11)
+    b, b_int = _wrap(P - 13)
+    acc = b
+    for _ in range(14):
+        acc = lz.add(acc, b)
+    assert acc.val < 16 * P, "repro needs the val-triggered shrink to skip"
+    assert acc.max + 3 * (1 << lz.LIMB_BITS) > lz._LEND_LIMB_CAP
+    out = lz.sub(x, acc)
+    assert out.max <= lz.NORM_MAX + lz._LEND_LIMB_CAP
+    assert int(np.asarray(out.v).max()) <= out.max
+    assert lz.from_mont_int(np.asarray(lz.norm(out).v)) == (x_int - 15 * b_int) % P
+
+
 def test_fat_p_encodings():
     for bound in (1 << 26, 1 << 28, 1 << 30, (1 << 30) + 12345):
         fat, fat_max, c = lz._fat_p(bound, bound >> 9)
@@ -82,3 +100,26 @@ def test_fat_p_encodings():
         total = sum(int(fat[i]) << (lz.LIMB_BITS * i) for i in range(lz.N_LIMBS))
         assert total % P == 0 and total // P == c
         assert all(int(fat[i]) >= bound for i in range(lz.N_LIMBS - 1))
+
+
+def test_claimed_bounds_match_execution_through_dbl_chains():
+    """Runtime half of the rangelint lazy-bound audit (ISSUE 10): the
+    audit proves claimed max_limb == inferred interval abstractly; here
+    a dbl chain from the p-1 boundary value runs up to the add-shrink
+    threshold and at EVERY step the claim follows the exact doubling
+    algebra while the executed limbs stay under it."""
+    a, a_int = _wrap(P - 1)  # the declared p-1 domain corner
+    b, b_int = _wrap(rng.randrange(P))
+    acc = a
+    expect_max = lz.NORM_MAX
+    steps = 0
+    while 2 * acc.val < lz.R_INT // 4:  # the add() reduction threshold
+        acc = lz.dbl(acc)
+        steps += 1
+        expect_max *= 2
+        assert acc.max == expect_max, "dbl's claim IS the doubling algebra"
+        assert int(np.asarray(acc.v).max()) <= acc.max
+    assert steps >= 5, "the lazy window shrank — the audit chains are stale"
+    out = lz.add(acc, b)  # crossing the threshold triggers the shrink
+    assert int(np.asarray(out.v).max()) <= out.max
+    assert _value(out) == ((1 << steps) * a_int + b_int) % P

@@ -1,0 +1,304 @@
+"""rangelint engine tests — the rules fire through the REGISTRY path.
+
+test_ranges.py proves the interpreter (transfer functions, loops, the
+interpreter-level deliberate findings). This file proves the ENGINE that
+CI actually gates on: a registered family whose ``wraps`` declaration is
+stripped fires lane-overflow, the synthetic 13-term column kernel fires
+through ``analyze`` at 31-bit limbs and is clean at 30, a non-inductive
+scan carry surfaces as an unproven-loop finding, the lazy-bound audit is
+CLEAN on the shipped lazy_limbs (the regression pinning inferred ==
+claimed for add/dbl chains) and fires on a deliberately lying claim, and
+the shipped baseline is empty with lane-overflow unbaselinable."""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from eth_consensus_specs_tpu.analysis import kernels, rangelint
+from eth_consensus_specs_tpu.analysis.ranges import Domain
+
+
+def _sds(shape, dtype):
+    return jax.ShapeDtypeStruct(shape, dtype)
+
+
+def _family(name):
+    return kernels.by_name()[name]
+
+
+def _small_sha(wraps):
+    """The sha256 family restricted to its small tile — same kernel,
+    same domains, cheap enough for a unit test — with ``wraps`` under
+    the test's control."""
+    spec = _family("sha256")
+    small = [v for v in spec.build_variants(None) if v.label.endswith("tile2048")]
+    assert small, "sha256 registry lost its 2048 tile"
+    return dataclasses.replace(spec, wraps=wraps, build_variants=lambda mesh: small)
+
+
+def _synth_spec(name, fn, args, domains, **kw):
+    return kernels.KernelSpec(
+        name=name,
+        help="synthetic rangelint test kernel",
+        dtypes=frozenset({"uint64"}),
+        donation_waiver="synthetic test kernel — nothing to donate",
+        build_variants=lambda mesh: [
+            kernels.Variant("single", fn, args, domains=domains)
+        ],
+        **kw,
+    )
+
+
+# ------------------------------------------------- deliberate engine findings
+
+
+def test_sha256_with_wraps_removed_fires_lane_overflow():
+    """The acceptance deliberate-finding: strip the per-site Wrap
+    declarations from sha256 and its mod-2^32 adds MUST surface as
+    lane-overflow through the registry engine; with the declarations
+    restored the very same variant proves clean."""
+    findings, _ = rangelint.analyze(
+        registry=(_small_sha(wraps=()),), rules={"lane-overflow"}
+    )
+    assert findings, "undeclared sha256 wraps MUST fire lane-overflow"
+    assert {f.rule for f in findings} == {"lane-overflow"}
+    assert {f.path for f in findings} == {"sha256"}
+
+    findings, stats = rangelint.analyze(
+        registry=(_small_sha(wraps=_family("sha256").wraps),),
+        rules={"lane-overflow", "mask-consistency"},
+    )
+    assert findings == [], [f.message for f in findings]
+    assert stats["wrap_hits"] > 0, "the declared sites must actually be hit"
+
+
+def test_synthetic_column_sum_31_bits_fires_through_engine():
+    """ISSUE acceptance kernel: a 13-term u64 column sum is provably
+    in-lane at 30-bit limbs and MUST overflow at 31 — through the full
+    registry path (domains seed the intervals, findings get kernel::rule
+    fingerprints)."""
+
+    def column(a, b):
+        acc = jnp.zeros(a.shape[:-1], jnp.uint64)
+        for i in range(13):
+            acc = acc + a[..., i] * b[..., 12 - i]
+        return acc
+
+    args = (_sds((4, 13), jnp.uint64),) * 2
+
+    def spec(bits):
+        dom = Domain(f"{bits}-bit limbs", hi=(1 << bits) - 1)
+        return _synth_spec(f"synth_column{bits}", column, args, (dom, dom))
+
+    clean, _ = rangelint.analyze(registry=(spec(30),), rules={"lane-overflow"})
+    assert clean == [], [f.message for f in clean]
+
+    dirty, _ = rangelint.analyze(registry=(spec(31),), rules={"lane-overflow"})
+    assert any(f.rule == "lane-overflow" for f in dirty), (
+        "13-term column at 31-bit limbs MUST fire through the engine"
+    )
+    assert all(f.fingerprint.startswith("synth_column31::") for f in dirty)
+
+
+def test_lane_overflow_ships_even_under_narrowed_rules():
+    """--rules mask-consistency is not an opt-out: an overflow surfaced
+    while the narrowed sweep runs must ship anyway (HARD_RULES)."""
+
+    def column(a, b):
+        acc = jnp.zeros(a.shape[:-1], jnp.uint64)
+        for i in range(13):
+            acc = acc + a[..., i] * b[..., 12 - i]
+        return acc
+
+    dom = Domain("31-bit limbs", hi=(1 << 31) - 1)
+    spec = _synth_spec(
+        "synth_column31n",
+        column,
+        (_sds((4, 13), jnp.uint64),) * 2,
+        (dom, dom),
+    )
+    findings, _ = rangelint.analyze(
+        registry=(spec,), rules={"mask-consistency"}
+    )
+    assert any(f.rule == "lane-overflow" for f in findings), (
+        "a narrowed rule set must not filter the hard rule"
+    )
+
+
+def test_non_inductive_scan_fires_through_engine():
+    """A doubling scan carry has no inductive interval: the engine must
+    report the widened loop as an unproven lane-overflow finding."""
+
+    def grower(xs):
+        def step(carry, x):
+            nxt = carry + carry + x
+            return nxt, nxt
+
+        return jax.lax.scan(step, jnp.ones((2,), jnp.uint64), xs)
+
+    spec = _synth_spec(
+        "synth_grower",
+        grower,
+        (_sds((64, 2), jnp.uint64),),
+        (Domain("u32-ish inputs", hi=1 << 32),),
+    )
+    findings, stats = rangelint.analyze(
+        registry=(spec,), rules={"lane-overflow"}, widen_steps=4
+    )
+    assert any(f.rule == "lane-overflow" for f in findings)
+    assert stats["widened_loops"] >= 1
+
+
+def test_timeout_is_an_unproven_lane_overflow_finding():
+    """An exhausted analysis budget may not pass silently: the family is
+    UNPROVEN, which the engine reports under the never-baselined rule."""
+    findings, _ = rangelint.analyze(
+        registry=(_small_sha(wraps=_family("sha256").wraps),),
+        rules={"lane-overflow"},
+        timeout_s=0.0,
+    )
+    assert any(f.symbol.endswith(":timeout") for f in findings)
+    assert {f.rule for f in findings} == {"lane-overflow"}
+
+
+# ----------------------------------------------------------- lazy-bound-audit
+
+
+def test_lazy_bound_audit_clean_is_the_regression():
+    """Satellite pin: on the shipped lazy_limbs every audited chain's
+    claimed max_limb equals (up to the sanctioned NORM_MAX floor) the
+    interval the interpreter infers — add/dbl growth, the sub lend path
+    under a grown subtrahend, and the Montgomery mul output."""
+    findings, stats = rangelint.audit_lazy_bounds()
+    assert findings == [], [f.message for f in findings]
+    assert stats["chains"] == 7
+
+
+def test_lazy_bound_audit_fires_on_tighter_claim(monkeypatch):
+    """A claim TIGHTER than the inferred reachable bound is a soundness
+    bug and must fire — downstream preconditions trust the claim."""
+    from eth_consensus_specs_tpu.ops import lazy_limbs as lz
+
+    real_add = lz.add
+
+    def lying_add(x, y):
+        out = real_add(x, y)
+        return lz.LF(out.v, max(out.max // 2, 1), out.val)
+
+    monkeypatch.setattr(lz, "add", lying_add)
+    findings, _ = rangelint.audit_lazy_bounds()
+    assert any(f.symbol == "add:claim-tight" for f in findings), [
+        f.symbol for f in findings
+    ]
+    assert all(f.rule == "lazy-bound-audit" for f in findings)
+
+
+def test_lazy_bound_audit_fires_on_looser_claim(monkeypatch):
+    """A claim LOOSER than inferred (above the NORM_MAX floor) is waste
+    — it forces premature shrink/norm sweeps — and must fire too."""
+    from eth_consensus_specs_tpu.ops import lazy_limbs as lz
+
+    real_dbl = lz.dbl
+
+    def padded_dbl(x):
+        out = real_dbl(x)
+        return lz.LF(out.v, out.max * 4, out.val)
+
+    monkeypatch.setattr(lz, "dbl", padded_dbl)
+    findings, _ = rangelint.audit_lazy_bounds()
+    assert any(
+        f.symbol.endswith(":claim-loose") and f.symbol.startswith("dbl")
+        for f in findings
+    ), [f.symbol for f in findings]
+
+
+def test_audit_surfaced_overflow_is_a_lane_overflow_finding(monkeypatch):
+    """An actual in-lane wrap inside an audited chain is a LANE bug the
+    audit happened to surface: it must fingerprint as ``lane-overflow``
+    (HARD_RULES, never baselinable), not as baselinable audit debt —
+    and it must ship even when --rules narrows to lazy-bound-audit."""
+    from eth_consensus_specs_tpu.ops import lazy_limbs as lz
+
+    real_add = lz.add
+
+    def overflowing_add(x, y):
+        out = real_add(x, y)
+        # a raw << 40 pushes a ~2^27-bounded lane past 2^64: a real
+        # unsanctioned u64 wrap inside the chain, not a lying claim
+        return lz.LF(out.v + (out.v << 40), out.max, out.val)
+
+    monkeypatch.setattr(lz, "add", overflowing_add)
+    findings, _ = rangelint.audit_lazy_bounds()
+    lane = [f for f in findings if f.rule == "lane-overflow"]
+    assert lane, [f"{f.rule}:{f.symbol}" for f in findings]
+    assert all("::lane-overflow::" in f.fingerprint for f in lane)
+    # the engine keeps hard-rule findings even under a narrowed rule set
+    narrowed, _ = rangelint.analyze(
+        registry=(), rules={"lazy-bound-audit"}, only={"lazy_limbs"}
+    )
+    assert any(f.rule == "lane-overflow" for f in narrowed), [
+        f"{f.rule}:{f.symbol}" for f in narrowed
+    ]
+
+
+def test_lend_cap_constant_is_pinned_to_the_wrap_declaration():
+    """sub's trace-time assertion and the analyzer's trusted bound for
+    the ``fat - y`` lend site must be the SAME number — if they drift,
+    one of them is lying about the other's guarantee."""
+    from eth_consensus_specs_tpu.ops import lazy_limbs as lz
+
+    lend = [
+        w
+        for w in kernels.lazy_lend_wraps()
+        if w.site == "lazy_limbs.py::sub" and w.prim == "sub"
+    ]
+    assert len(lend) == 1
+    assert lend[0].bound == lz._LEND_LIMB_CAP
+
+
+def test_sub_auto_shrinks_an_over_fat_subtrahend():
+    """The bound-growth guard on the _fat_p lend path: a subtrahend
+    whose static bound would push the fat cover past the declared cap is
+    auto-shrunk (the module's violations-insert-a-sweep contract), never
+    silently covered with an out-of-cap limb."""
+    from eth_consensus_specs_tpu.ops import lazy_limbs as lz
+
+    x = lz.lf(jnp.zeros((lz.N_LIMBS,), jnp.uint64))
+    fat_y = lz.LF(
+        jnp.asarray(lz.to_mont(7)),
+        lz._LEND_LIMB_CAP * 4,
+        2 * lz.P_INT - 1,
+    )
+    out = lz.sub(x, fat_y)
+    assert out.max <= lz.lf(x.v).max + lz._LEND_LIMB_CAP
+    assert lz.from_mont_int(np.asarray(lz.shrink(out).v)) == lz.P_INT - 7
+
+
+# ------------------------------------------------------------------- contract
+
+
+def test_shipped_baseline_is_empty_and_lane_overflow_is_hard():
+    root = os.path.join(os.path.dirname(__file__), os.pardir)
+    with open(os.path.join(root, "rangelint_baseline.json")) as fh:
+        base = json.load(fh)
+    assert base["findings"] == {}, "rangelint ships an EMPTY baseline"
+    assert "lane-overflow" in rangelint.HARD_RULES
+
+
+def test_registry_wrap_declarations_are_per_site_never_blanket():
+    """Every registered Wrap names one primitive at one file::function
+    site — a bare filename (or empty site) would be a blanket sanction,
+    exactly what the rule design forbids."""
+    for spec in kernels.REGISTRY:
+        for w in spec.wraps:
+            assert w.prim and "::" in w.site and not w.site.startswith("::"), (
+                spec.name,
+                w,
+            )
