@@ -293,6 +293,76 @@ def _merkle_many_key_grid(mesh):
     return out
 
 
+def _merkle_inc_args(shards: int, depth_local: int):
+    m = (1 << (depth_local + 1)) - 1
+    ll = 1 << depth_local
+    return (
+        _sds((shards, m, 8), "uint32"),
+        _sds((shards, ll), "bool_"),
+        _sds((shards, ll, 8), "uint32"),
+    )
+
+
+def _merkle_inc_variants(mesh):
+    from eth_consensus_specs_tpu.ops import merkle_inc
+    from eth_consensus_specs_tpu.serve import buckets
+
+    depth, cap = 10, 8
+    doms = (_WORDS32, _BOOL_DOMAIN, _WORDS32)
+    out = [
+        Variant(
+            "single",
+            merkle_inc._apply_kernel(depth, cap, buckets.inc_dense_count(depth, cap)),
+            _merkle_inc_args(1, depth),
+            domains=doms,
+        )
+    ]
+    if mesh is not None:
+        shards = merkle_inc.forest_shards(depth, mesh)
+        if shards > 1:
+            dl = depth - (shards - 1).bit_length()
+            out.append(
+                Variant(
+                    "mesh",
+                    merkle_inc._apply_kernel_mesh(
+                        mesh, depth, cap, buckets.inc_dense_count(dl, cap)
+                    ),
+                    _merkle_inc_args(shards, dl),
+                    mesh=mesh,
+                    domains=doms,
+                )
+            )
+    return out
+
+
+def _merkle_inc_key_grid(mesh):
+    """LIVE serve key fn (buckets.merkle_inc_key) over the dirty-bucket
+    grid vs the traced forest-update signature the dispatch compiles
+    under (every static knob — capacity, dense threshold, depth, mesh
+    signature — discriminates)."""
+    from eth_consensus_specs_tpu.ops import merkle_inc
+    from eth_consensus_specs_tpu.parallel import mesh_ops
+    from eth_consensus_specs_tpu.serve import buckets
+
+    out = []
+    for m in (None, mesh) if mesh is not None else (None,):
+        for depth in (8, 10, 12):
+            shards = merkle_inc.forest_shards(depth, m) if m is not None else 1
+            dl = depth - (shards - 1).bit_length()
+            for hint in (1, 5, 8, 64, 200):
+                cap = min(buckets.inc_dirty_bucket(hint), 1 << dl)
+                dense = buckets.inc_dense_count(dl, cap)
+                key = buckets.merkle_inc_key(cap, dense, depth, mesh=m)
+                sig = (
+                    _canon_args(_merkle_inc_args(shards, dl)),
+                    cap,
+                    dense,
+                    mesh_ops.mesh_signature(m),
+                )
+                out.append((key, sig))
+    return out
+
+
 def _shuffle_variants(mesh):
     from eth_consensus_specs_tpu.ops import shuffle
 
@@ -673,6 +743,19 @@ REGISTRY: tuple[KernelSpec, ...] = (
         wraps=_SHA_WRAPS,
         build_variants=_merkle_many_variants,
         key_grid=_merkle_many_key_grid,
+    ),
+    KernelSpec(
+        name="merkle_inc",
+        help="incremental dirty-subtree forest update (ops/merkle_inc), "
+        "mesh leaf-axis sharded",
+        dtypes=frozenset({"uint32", "int32", "bool"}),
+        # the forest node buffer: every epoch's update lands in place —
+        # this donation IS the resident-footprint claim the ROADMAP
+        # item-1 rework makes, proven per kernel by the audit
+        donate=(0,),
+        wraps=_SHA_WRAPS,
+        build_variants=_merkle_inc_variants,
+        key_grid=_merkle_inc_key_grid,
     ),
     KernelSpec(
         name="shuffle",

@@ -143,6 +143,20 @@ ENV_VARS: tuple[EnvVar, ...] = (
        "mesh bench gate: minimum per-effective-chip scaling factor "
        "(`serve_bench.py --chips N` fails below it)",
        "serving.md#mesh-sharded-dispatch"),
+    # -------------------------------------------- incremental merkle --
+    _v("ETH_SPECS_INC_DIRTY_BUCKETS", "8,64,256,1024,4096,16384,65536",
+       "pow2 dirty-leaf capacity buckets the incremental forest kernels "
+       "compile under (serve-buckets idiom for the dirty axis)",
+       "tpu.md#incremental-merkleization"),
+    _v("ETH_SPECS_INC_CROSSOVER", "0.25",
+       "sparse-vs-dense work-ratio crossover: fraction of hash-count "
+       "break-even at which a forest update abandons the path-update for "
+       "the dense rebuild (measured constant factor of the narrow-width "
+       "gather/hash/scatter path)", "tpu.md#incremental-merkleization"),
+    _v("ETH_SPECS_INC_SPEEDUP_MIN", "2.0",
+       "resident-smoke gate: minimum incremental-vs-full state-root "
+       "speedup factor (`scripts/resident_bench.py --speedup-min`)",
+       "tpu.md#incremental-merkleization"),
     # ------------------------------------------------------------ fault --
     _v("ETH_SPECS_FAULT", "unset",
        "deterministic fault-injection spec: `site:mode[:key=value...]` rules "

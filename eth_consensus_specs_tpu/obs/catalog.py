@@ -69,6 +69,15 @@ CATALOG: tuple[Metric, ...] = (
     _c("state_root.traces", "state-root kernel (re)traces"),
     _s("state_root.post_epoch", "device post-epoch state root"),
     _s("state_root.post_epoch_host", "host-oracle post-epoch state root"),
+    _c("state_root.inc_roots", "incremental (forest) post-epoch state roots"),
+    _c("state_root.inc_real_hashes",
+       "dirty-path hashes in incremental state roots (capacity model)"),
+    _c("merkle_inc.updates", "incremental forest path-update dispatches"),
+    _c("merkle_inc.dirty_leaves", "live dirty leaves through forest updates"),
+    _c("merkle_inc.real_hashes",
+       "hashes in incremental forest updates (capacity model)"),
+    _s("merkle_inc.update", "incremental dirty-subtree forest update"),
+    _s("resident.run_epochs", "device-resident chained epoch advance"),
     _c("block_epoch.blocks_ingested", "blocks ingested into the chain kernel"),
     _c("block_epoch.epochs", "epoch transitions in block_epoch chains"),
     _c("block_epoch.ingests", "block_epoch ingest calls"),

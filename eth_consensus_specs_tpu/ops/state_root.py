@@ -120,9 +120,18 @@ def packed_u8_leaves(vals: jnp.ndarray, n: int) -> jnp.ndarray:
 
 def fold_to_limit(root: jnp.ndarray, depth: int, limit_log2: int, zh: jnp.ndarray):
     """Chain a subtree root up to the SSZ limit depth with zero-hash
-    siblings (right sibling = zerohashes[d] at each level)."""
-    for d in range(depth, limit_log2):
-        root = _hash_rows(root[None, :], zh[d][None, :])[0]
+    siblings (right sibling = zerohashes[d] at each level). One scan
+    body instead of limit-depth unrolled compression instances — the
+    fold is sequential either way, and the state-root graph carries
+    several of these chains (a python loop here put ~25 sha bodies PER
+    CHAIN into the jaxpr, the bulk of the full-state compile wall)."""
+    if depth >= limit_log2:
+        return root
+
+    def step(r, z):
+        return _hash_rows(r[None, :], z[None, :])[0], None
+
+    root, _ = lax.scan(step, root, zh[depth:limit_log2])
     return root
 
 
@@ -131,15 +140,32 @@ def mix_length(root: jnp.ndarray, length: int) -> jnp.ndarray:
     return _hash_rows(root[None, :], len_chunk[None, :])[0]
 
 
+def _validator_leaf_rows(
+    effective_balance: jnp.ndarray,
+    slashed_chunk: jnp.ndarray,
+    node_a: jnp.ndarray,
+    node_f: jnp.ndarray,
+) -> jnp.ndarray:
+    """The per-validator root from its static nodes + the dynamic
+    effective balance — the 3-hash chain (B = H(eff_chunk, slashed),
+    E = H(A, B), root = H(E, F)). ONE implementation: the full path
+    applies it to whole columns, the incremental path to the gathered
+    dirty rows — editing the Validator leaf derivation in one place
+    cannot break full-vs-incremental root parity."""
+    eb_chunk = _u64_chunk_words(effective_balance)
+    node_b = _hash_rows(eb_chunk, slashed_chunk)
+    node_e = _hash_rows(node_a, node_b)
+    return _hash_rows(node_e, node_f)
+
+
 def validator_registry_root(
     arrays: StateRootArrays, n: int, effective_balance: jnp.ndarray
 ) -> jnp.ndarray:
     """List[Validator] root from the static nodes + the dynamic
     effective-balance column: 3 hashes per validator + the leaf tree."""
-    eb_chunk = _u64_chunk_words(effective_balance)
-    node_b = _hash_rows(eb_chunk, arrays.slashed_chunk)
-    node_e = _hash_rows(arrays.val_node_a, node_b)
-    roots = _hash_rows(node_e, arrays.val_node_f)  # [N, 8] validator roots
+    roots = _validator_leaf_rows(
+        effective_balance, arrays.slashed_chunk, arrays.val_node_a, arrays.val_node_f
+    )  # [N, 8] validator roots
     depth = max(n - 1, 0).bit_length()
     sub = tree_root_words(_pad_pow2(roots, depth), depth)
     full = fold_to_limit(sub, depth, VALIDATOR_REGISTRY_LIMIT_LOG2, arrays.zerohashes)
@@ -530,18 +556,313 @@ def _post_epoch_state_root_impl(
         dyn[slot_of["current_epoch_participation"]] = jnp.asarray(
             _zero_u8_list_root_words(n)
         )
-    dyn[slot_of["justification_bits"]] = (
-        bitvector4_chunk(just.justification_bits)
-        if just.justification_bits.dtype == jnp.bool_
-        else bitvector4_chunk(just.justification_bits.astype(bool))
-    )
-    dyn[slot_of["previous_justified_checkpoint"]] = checkpoint_root(
-        just.prev_justified_epoch, just.prev_justified_root
-    )
-    dyn[slot_of["current_justified_checkpoint"]] = checkpoint_root(
-        just.cur_justified_epoch, just.cur_justified_root
-    )
-    dyn[slot_of["finalized_checkpoint"]] = checkpoint_root(
-        just.finalized_epoch, just.finalized_root
-    )
+    dyn.update(_small_dynamic_roots(slot_of, just))
     return combine_state_root(arrays, meta, dyn)
+
+
+def _small_dynamic_roots(slot_of: dict, just) -> dict:
+    """The O(1)-sized dynamic roots (justification bits + the three
+    checkpoints) — ONE implementation shared by the full recompute and
+    the incremental path, so the two can never disagree on the cheap
+    fields while differing on the trees."""
+    dyn = {
+        slot_of["justification_bits"]: (
+            bitvector4_chunk(just.justification_bits)
+            if just.justification_bits.dtype == jnp.bool_
+            else bitvector4_chunk(just.justification_bits.astype(bool))
+        ),
+        slot_of["previous_justified_checkpoint"]: checkpoint_root(
+            just.prev_justified_epoch, just.prev_justified_root
+        ),
+        slot_of["current_justified_checkpoint"]: checkpoint_root(
+            just.cur_justified_epoch, just.cur_justified_root
+        ),
+        slot_of["finalized_checkpoint"]: checkpoint_root(
+            just.finalized_epoch, just.finalized_root
+        ),
+    }
+    return dyn
+
+
+# --------------------------------------------- incremental (forest) path --
+#
+# The full path above re-hashes every tree each epoch. The incremental
+# path keeps the three big subtrees resident as merkle_inc forests (ALL
+# internal levels in HBM, donated buffers) and re-hashes only the
+# O(dirty x depth) ancestor paths the accounting epoch actually
+# dirtied: effective balances move only on hysteresis crossings, the
+# balance/score columns diff chunk-wise, and the participation list is
+# STATIC inside the resident loop (its list root is computed once at
+# forest build and reused — the full path re-treed it every epoch for
+# the same value). Roots are bit-identical to the full recompute by
+# construction: same tree shapes, same pads, same folds, the shared
+# _small_dynamic_roots, the shared combine.
+
+
+class StateForest(NamedTuple):
+    """Device-resident incremental tree state (a pure-array pytree; the
+    resident runner donates every leaf so epoch N+1 updates epoch N's
+    buffers in place)."""
+
+    val_nodes: jnp.ndarray  # u32[S, 2^(dvl+1)-1, 8] validator-root forest
+    bal_nodes: jnp.ndarray  # u32[S, 2^(dbl+1)-1, 8] balance-chunk forest
+    inact_nodes: jnp.ndarray | None  # scores forest (None pre-altair)
+    part_root: jnp.ndarray  # u32[8] previous-participation LIST root (static)
+
+
+class ForestPlan(NamedTuple):
+    """Hashable static plan of an incremental forest — part of the
+    resident compile key. Capacities/thresholds are PER SHARD."""
+
+    depth_val: int  # validator-leaf tree depth (global)
+    depth_bal: int  # u64-chunk tree depth (global; scores share it)
+    shards: int  # pow2 leaf-axis shard count (1 = single device)
+    cap_val: int  # dirty-capacity compile bucket, validator leaves
+    cap_bal: int  # dirty-capacity compile bucket, chunk leaves
+    dense_val: int  # dirty count past which the dense rebuild wins
+    dense_bal: int
+    has_inact: bool  # spec has inactivity_scores (altair+)
+
+
+def forest_plan(meta: StateRootMeta, mesh=None, dirty_cap: int | None = None) -> ForestPlan:
+    """Plan an incremental forest for this registry shape: tree depths
+    from the leaf counts, shard count from the mesh (pow2-dividing or
+    1), dirty capacities from the serve bucket grid
+    (serve/buckets.inc_dirty_buckets — env-snapshotted HERE, never
+    inside a trace), dense-fallback thresholds from the measured
+    crossover model (buckets.inc_dense_count). `dirty_cap` overrides
+    the default per-epoch dirty-leaf hint (n/256)."""
+    from eth_consensus_specs_tpu.ops import merkle_inc
+    from eth_consensus_specs_tpu.serve import buckets
+
+    n = meta.n_validators
+    depth_val = max(n - 1, 0).bit_length()
+    chunks = (n + 3) // 4
+    depth_bal = max(chunks - 1, 0).bit_length()
+    shards = merkle_inc.forest_shards(min(depth_val, depth_bal), mesh)
+    slog2 = (shards - 1).bit_length()
+    hint = int(dirty_cap) if dirty_cap else max(n >> 8, 8)
+    cap_val = min(buckets.inc_dirty_bucket(-(-hint // shards)), (1 << depth_val) // shards)
+    cap_bal = min(
+        buckets.inc_dirty_bucket(-(-max(hint // 4, 1) // shards)),
+        (1 << depth_bal) // shards,
+    )
+    names = {name for _, name in meta.dynamic_slots}
+    return ForestPlan(
+        depth_val=depth_val,
+        depth_bal=depth_bal,
+        shards=shards,
+        cap_val=cap_val,
+        cap_bal=cap_bal,
+        dense_val=buckets.inc_dense_count(depth_val - slog2, cap_val, leaf_hashes=3),
+        dense_bal=buckets.inc_dense_count(depth_bal - slog2, cap_bal),
+        has_inact="inactivity_scores" in names,
+    )
+
+
+def _u64_chunk_leaves(vals: jnp.ndarray, n: int, depth: int) -> jnp.ndarray:
+    """u64[n] column -> u32[2^depth, 8] packed SSZ chunk leaf level
+    (zero pads past the live chunks — the same virtual padding the full
+    path's _pad_pow2 applies)."""
+    if n % 4:
+        vals = jnp.concatenate([vals, jnp.zeros(4 - n % 4, jnp.uint64)])
+    leaves = packed_u64_leaves(vals, vals.shape[0])
+    return _pad_pow2(leaves, depth)
+
+
+def _pad_col(vals: jnp.ndarray, cap: int) -> jnp.ndarray:
+    pad = cap - vals.shape[0]
+    if pad:
+        vals = jnp.concatenate([vals, jnp.zeros((pad, *vals.shape[1:]), vals.dtype)])
+    return vals
+
+
+def _validator_leaf_inputs(
+    arrays: StateRootArrays, n: int, effective_balance: jnp.ndarray, plan: ForestPlan
+) -> tuple:
+    """The sharded per-leaf sources of the validator-root leaves: the
+    new effective balances plus the static nodes, padded to the leaf
+    level and reshaped [S, Ll, ...]."""
+    lv = 1 << plan.depth_val
+    s = plan.shards
+    live = jnp.arange(lv, dtype=jnp.int32) < jnp.int32(n)
+    return (
+        _pad_col(effective_balance, lv).reshape(s, lv // s),
+        _pad_col(arrays.slashed_chunk, lv).reshape(s, lv // s, 8),
+        _pad_col(arrays.val_node_a, lv).reshape(s, lv // s, 8),
+        _pad_col(arrays.val_node_f, lv).reshape(s, lv // s, 8),
+        live.reshape(s, lv // s),
+    )
+
+
+def _validator_leaf_fn(inputs: tuple, idx: jnp.ndarray) -> jnp.ndarray:
+    """Validator-root leaves at the given (shard-local) indices — the
+    SHARED _validator_leaf_rows chain on the gathered rows; pad indices
+    past the registry produce the SSZ zero chunk, matching the full
+    path's _pad_pow2."""
+    eff_l, slashed_l, a_l, f_l, live_l = inputs
+    leaf = _validator_leaf_rows(eff_l[idx], slashed_l[idx], a_l[idx], f_l[idx])
+    return jnp.where(live_l[idx][:, None], leaf, jnp.zeros_like(leaf))
+
+
+def build_state_forest(
+    arrays: StateRootArrays,
+    meta: StateRootMeta,
+    plan: ForestPlan,
+    balances: jnp.ndarray,
+    effective_balance: jnp.ndarray,
+    inactivity_scores: jnp.ndarray,
+) -> StateForest:
+    """One-time forest ingest (traceable; jit it once per shape): every
+    validator root + all internal levels of the three big trees, plus
+    the static previous-participation list root."""
+    from eth_consensus_specs_tpu.ops import merkle_inc
+
+    n = meta.n_validators
+    s = plan.shards
+    lv = 1 << plan.depth_val
+    inputs = _validator_leaf_inputs(arrays, n, effective_balance, plan)
+    flat = tuple(a.reshape(-1, *a.shape[2:]) for a in inputs)
+    val_leaves = _validator_leaf_fn(flat, jnp.arange(lv, dtype=jnp.int32))
+    val_nodes = merkle_inc.build_forest(val_leaves, s)
+    bal_nodes = merkle_inc.build_forest(
+        _u64_chunk_leaves(balances, n, plan.depth_bal), s
+    )
+    inact_nodes = None
+    if plan.has_inact:
+        inact_nodes = merkle_inc.build_forest(
+            _u64_chunk_leaves(inactivity_scores, n, plan.depth_bal), s
+        )
+    part_root = u8_list_root(
+        arrays.prev_part_flags, n, PARTICIPATION_LIMIT_CHUNKS_LOG2, arrays.zerohashes
+    )
+    return StateForest(
+        val_nodes=val_nodes,
+        bal_nodes=bal_nodes,
+        inact_nodes=inact_nodes,
+        part_root=part_root,
+    )
+
+
+def state_root_inc_real_hashes(meta: StateRootMeta, plan: ForestPlan) -> int:
+    """Compressions one INCREMENTAL post-epoch root executes under the
+    capacity model — the honest dirty-path node count for roofline /
+    work-bytes accounting. Per tree the kernel runs either the sparse
+    path (exactly cap x (depth + leaf hashes) compressions, padding
+    duplicates included) or the dense rebuild; the static model takes
+    the MINIMUM of the two, so implied traffic is never overstated (a
+    dense epoch does more work than claimed, never less roofline-legal
+    work). Folds, length mixes, checkpoints, and the top combine are
+    counted exactly like state_root_real_hashes."""
+    from eth_consensus_specs_tpu.ops import merkle_inc
+    from eth_consensus_specs_tpu.ops.merkle import tree_real_hashes as fullwidth
+
+    n = meta.n_validators
+    s = plan.shards
+    slog2 = (s - 1).bit_length()
+
+    def tree_cost(depth: int, cap: int, leaf_hashes: int, dense_leaf_total: int) -> int:
+        sparse = s * merkle_inc.inc_update_hashes(depth - slog2, cap, leaf_hashes)
+        dense = fullwidth(depth - slog2) * s + dense_leaf_total
+        return min(sparse, dense) + max(s - 1, 0)  # + the top combine
+
+    hashes = tree_cost(plan.depth_val, plan.cap_val, 3, 3 * n)
+    hashes += tree_cost(plan.depth_bal, plan.cap_bal, 0, 0)
+    folds = (VALIDATOR_REGISTRY_LIMIT_LOG2 - plan.depth_val) + (
+        BALANCE_LIMIT_CHUNKS_LOG2 - plan.depth_bal
+    )
+    mixes = 2
+    if plan.has_inact:
+        hashes += tree_cost(plan.depth_bal, plan.cap_bal, 0, 0)
+        folds += BALANCE_LIMIT_CHUNKS_LOG2 - plan.depth_bal
+        mixes += 1
+    return hashes + folds + mixes + 3 + (1 << meta.top_depth)
+
+
+def post_epoch_state_root_inc(
+    arrays: StateRootArrays,
+    meta: StateRootMeta,
+    plan: ForestPlan,
+    forest: StateForest,
+    old_balances: jnp.ndarray,
+    old_effective_balance: jnp.ndarray,
+    old_inactivity_scores: jnp.ndarray,
+    balances: jnp.ndarray,
+    effective_balance: jnp.ndarray,
+    inactivity_scores: jnp.ndarray,
+    just,
+    mesh=None,
+) -> tuple[StateForest, jnp.ndarray]:
+    """The incremental full post-epoch state root (traceable; composes
+    under the resident epoch jit). Diffs old vs new columns into
+    per-tree dirty masks, applies them through the forest kernels
+    (sparse path rehash or dense rebuild, per shard), and combines the
+    same top-level container the full path does. Returns (forest, root)
+    with root bit-identical to post_epoch_state_root on the same
+    columns."""
+    from eth_consensus_specs_tpu.ops import merkle_inc
+
+    n = meta.n_validators
+    s = plan.shards
+    zh = arrays.zerohashes
+    slot_of = {name: i for i, name in meta.dynamic_slots}
+    dyn: dict[int, jnp.ndarray] = {}
+
+    # -- validator registry: dirty = hysteresis crossings --------------
+    lv = 1 << plan.depth_val
+    mask_val = _pad_col(old_effective_balance != effective_balance, lv)
+    inputs = _validator_leaf_inputs(arrays, n, effective_balance, plan)
+    val_nodes, sub_val = merkle_inc.forest_apply(
+        forest.val_nodes,
+        mask_val.reshape(s, lv // s),
+        inputs,
+        _validator_leaf_fn,
+        plan.cap_val,
+        plan.dense_val,
+        mesh=mesh if s > 1 else None,
+    )
+    full = fold_to_limit(sub_val, plan.depth_val, VALIDATOR_REGISTRY_LIMIT_LOG2, zh)
+    dyn[slot_of["validators"]] = mix_length(full, n)
+
+    # -- u64 list columns: chunk-wise diff ------------------------------
+    def u64_tree(nodes, old_vals, new_vals):
+        old_leaves = _u64_chunk_leaves(old_vals, n, plan.depth_bal)
+        new_leaves = _u64_chunk_leaves(new_vals, n, plan.depth_bal)
+        mask = jnp.any(old_leaves != new_leaves, axis=-1)
+        lb = 1 << plan.depth_bal
+        nodes, sub = merkle_inc.forest_apply(
+            nodes,
+            mask.reshape(s, lb // s),
+            (new_leaves.reshape(s, lb // s, 8),),
+            lambda inputs, idx: inputs[0][idx],
+            plan.cap_bal,
+            plan.dense_bal,
+            mesh=mesh if s > 1 else None,
+        )
+        full = fold_to_limit(sub, plan.depth_bal, BALANCE_LIMIT_CHUNKS_LOG2, zh)
+        return nodes, mix_length(full, n)
+
+    bal_nodes, dyn[slot_of["balances"]] = u64_tree(
+        forest.bal_nodes, old_balances, balances
+    )
+    inact_nodes = forest.inact_nodes
+    if plan.has_inact and "inactivity_scores" in slot_of:
+        inact_nodes, dyn[slot_of["inactivity_scores"]] = u64_tree(
+            forest.inact_nodes, old_inactivity_scores, inactivity_scores
+        )
+
+    # -- static-in-the-loop participation lists -------------------------
+    if "previous_epoch_participation" in slot_of:
+        dyn[slot_of["previous_epoch_participation"]] = forest.part_root
+        dyn[slot_of["current_epoch_participation"]] = jnp.asarray(
+            _zero_u8_list_root_words(n)
+        )
+
+    dyn.update(_small_dynamic_roots(slot_of, just))
+    forest = StateForest(
+        val_nodes=val_nodes,
+        bal_nodes=bal_nodes,
+        inact_nodes=inact_nodes,
+        part_root=forest.part_root,
+    )
+    return forest, combine_state_root(arrays, meta, dyn)

@@ -27,6 +27,7 @@ process_rewards_and_penalties; hash_tree_root per slot :1383-1393).
 
 from __future__ import annotations
 
+import os
 from functools import lru_cache
 from typing import NamedTuple
 
@@ -34,6 +35,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from eth_consensus_specs_tpu import obs
 from eth_consensus_specs_tpu.ops.altair_epoch import (
     AltairEpochColumns,
     AltairEpochParams,
@@ -47,6 +49,10 @@ class ResidentCarry(NamedTuple):
     cols: AltairEpochColumns
     just: JustificationState
     root_acc: jnp.ndarray  # xor-chain of per-epoch balance roots (u32[8])
+    # incremental mode only: the updated merkle_inc forest (the input
+    # forest's buffers were DONATED to the run — thread this one into
+    # the next run_epochs call, never reuse the old object)
+    forest: object = None
 
 
 def ingest(spec, state) -> tuple[AltairEpochColumns, JustificationState]:
@@ -73,6 +79,51 @@ def ingest_full(spec, state):
     return cols, just, build_static(spec, state)
 
 
+def forest_plan_for(static, mesh=None, dirty_cap: int | None = None):
+    """The incremental plan run_epochs and build_state_forest_device
+    share for one (registry shape, mesh, capacity hint) — ONE derivation
+    so a forest built here always matches the runner compiled there."""
+    from eth_consensus_specs_tpu.ops.state_root import forest_plan
+
+    return forest_plan(static[1], mesh=mesh, dirty_cap=dirty_cap)
+
+
+def build_state_forest_device(
+    static, cols: AltairEpochColumns, mesh=None, dirty_cap: int | None = None
+):
+    """One-time device forest ingest for ``with_root="state_inc"``: all
+    internal levels of the three big subtrees + the static participation
+    list root, built from the CURRENT columns (the pre-epoch state the
+    first epoch diffs against). Returns (forest, plan). The forest's
+    buffers are donated to the first run_epochs call that consumes them —
+    thread ``carry.forest`` forward for chained calls."""
+    arrays, meta = static
+    plan = forest_plan_for(static, mesh=mesh, dirty_cap=dirty_cap)
+    build = _compiled_forest_builder(plan, meta)
+    forest = build(
+        jax.device_put(arrays),
+        cols.balance,
+        cols.effective_balance,
+        cols.inactivity_scores,
+    )
+    return forest, plan
+
+
+@lru_cache(maxsize=None)
+def _compiled_forest_builder(plan, meta):
+    import jax
+
+    from eth_consensus_specs_tpu.ops.state_root import build_state_forest
+
+    @jax.jit
+    def build(arrays, balances, effective_balance, inactivity_scores):
+        return build_state_forest(
+            arrays, meta, plan, balances, effective_balance, inactivity_scores
+        )
+
+    return build
+
+
 def run_epochs(
     spec,
     cols: AltairEpochColumns,
@@ -80,6 +131,9 @@ def run_epochs(
     n_epochs: int,
     with_root=True,
     static=None,
+    forest=None,
+    mesh=None,
+    dirty_cap: int | None = None,
 ):
     """Advance `n_epochs` accounting epochs entirely on device.
 
@@ -100,37 +154,104 @@ def run_epochs(
       rotate flags), so their roots are the same tree shape/work but not
       a state any object advance produces — fine for benching, not for
       consensus use beyond epoch 1.
+    * ``with_root="state_inc"`` — the SAME full state root, bit for bit,
+      through the incremental merkle_inc forest: each epoch diffs the
+      columns against the previous epoch's, marks the dirty leaves
+      inside the jitted chain, and re-hashes only O(dirty x depth)
+      ancestor nodes per tree (dense rebuild past the measured
+      crossover). Requires ``static``; ``forest`` from
+      build_state_forest_device (built automatically when omitted —
+      outside any timing), ``mesh`` shards the forest leaf axes over
+      the serve mesh, ``dirty_cap`` overrides the pow2 dirty-capacity
+      bucket hint. The input forest's buffers are DONATED; chain from
+      ``carry.forest``.
 
     Returns a ResidentCarry of device arrays."""
+    from eth_consensus_specs_tpu.serve import buckets as serve_buckets
+
     params = AltairEpochParams.from_spec(spec)
     n = int(cols.balance.shape[0])
     if with_root is True or with_root == "balance":
         mode = "balance"
     elif with_root is False or with_root is None or with_root == "none":
         mode = "none"
-    elif with_root == "state":
-        mode = "state"
+    elif with_root in ("state", "state_inc"):
+        mode = with_root
     else:
-        raise ValueError(f"with_root must be bool, 'balance' or 'state', got {with_root!r}")
+        raise ValueError(
+            f"with_root must be bool, 'balance', 'state' or 'state_inc', got {with_root!r}"
+        )
     depth = (max(n // 4, 1) - 1).bit_length() if mode == "balance" else 0
     if mode == "balance" and n % 4 != 0:
         raise ValueError("with_root requires a multiple-of-4 validator count")
-    if mode == "state" and static is None:
-        raise ValueError('with_root="state" requires static from ingest_full()')
-    if mode == "state":
+    if mode in ("state", "state_inc") and static is None:
+        raise ValueError(f'with_root={mode!r} requires static from ingest_full()')
+
+    col_bytes = 2 * sum(a.nbytes for a in jax.tree_util.tree_leaves(cols))
+    if mode == "state_inc":
+        from eth_consensus_specs_tpu.ops.state_root import state_root_inc_real_hashes
+
         arrays, meta = static
-        run = _compiled_runner(params, int(n_epochs), mode, n, depth, meta)
-        out_cols, out_just, acc = run(cols, just, jnp.zeros(8, jnp.uint32), arrays)
+        plan = forest_plan_for(static, mesh=mesh, dirty_cap=dirty_cap)
+        if forest is None:
+            forest, _ = build_state_forest_device(
+                static, cols, mesh=mesh, dirty_cap=dirty_cap
+            )
+        real = state_root_inc_real_hashes(meta, plan)
+        run = _compiled_runner(
+            params, int(n_epochs), mode, n, depth, meta, plan, mesh
+        )
+        key = ("resident", mode, n, int(n_epochs), plan.cap_val, plan.cap_bal)
+        from eth_consensus_specs_tpu.parallel.mesh_ops import mesh_signature
+
+        if plan.shards > 1:
+            key = (*key, mesh_signature(mesh))
+        with obs.span(
+            "resident.run_epochs",
+            work_bytes=int(n_epochs) * (col_bytes + 96 * real),
+            n_validators=n,
+            epochs=int(n_epochs),
+            mode=mode,
+            shards=plan.shards,
+        ) as sp:
+            with serve_buckets.first_dispatch(*key):
+                out_cols, out_just, acc, out_forest = run(
+                    cols, just, jnp.zeros(8, jnp.uint32), jax.device_put(arrays), forest
+                )
+            sp.result = acc
+        obs.count("state_root.inc_roots", int(n_epochs))
+        obs.count("state_root.inc_real_hashes", int(n_epochs) * real)
+        return ResidentCarry(
+            cols=out_cols, just=out_just, root_acc=acc, forest=out_forest
+        )
+    if mode == "state":
+        from eth_consensus_specs_tpu.ops.state_root import state_root_real_hashes
+
+        arrays, meta = static
+        real = state_root_real_hashes(meta)
+        run = _compiled_runner(params, int(n_epochs), mode, n, depth, meta, None, None)
+        with obs.span(
+            "resident.run_epochs",
+            work_bytes=int(n_epochs) * (col_bytes + 96 * real),
+            n_validators=n,
+            epochs=int(n_epochs),
+            mode=mode,
+        ) as sp:
+            with serve_buckets.first_dispatch("resident", mode, n, int(n_epochs)):
+                out_cols, out_just, acc = run(cols, just, jnp.zeros(8, jnp.uint32), arrays)
+            sp.result = acc
     else:
-        run = _compiled_runner(params, int(n_epochs), mode, n, depth, None)
-        out_cols, out_just, acc = run(cols, just, jnp.zeros(8, jnp.uint32))
+        run = _compiled_runner(params, int(n_epochs), mode, n, depth, None, None, None)
+        with serve_buckets.first_dispatch("resident", mode, n, int(n_epochs)):
+            out_cols, out_just, acc = run(cols, just, jnp.zeros(8, jnp.uint32))
     return ResidentCarry(cols=out_cols, just=out_just, root_acc=acc)
 
 
 @lru_cache(maxsize=None)
-def _compiled_runner(params, n_epochs: int, mode: str, n: int, depth: int, meta):
-    """One compiled executable per (params, epochs, shape) — repeat calls
-    reuse it instead of retracing."""
+def _compiled_runner(params, n_epochs: int, mode: str, n: int, depth: int, meta,
+                     plan, mesh):
+    """One compiled executable per (params, epochs, shape[, forest plan,
+    mesh]) — repeat calls reuse it instead of retracing."""
 
     def _advance(cols, just):
         res = altair_epoch_accounting_impl(params, cols, just)
@@ -150,6 +271,40 @@ def _compiled_runner(params, n_epochs: int, mode: str, n: int, depth: int, meta)
             finalized_root=res.finalized_root,
         )
         return cols, just
+
+    if mode == "state_inc":
+        from functools import partial
+
+        # the forest is DONATED: epoch chains update the resident tree
+        # levels in place instead of doubling the footprint (jaxlint's
+        # donation-audit proves the alias on the registered kernels)
+        @partial(jax.jit, donate_argnums=(4,))
+        def run_state_inc(cols, just, acc0, arrays, forest):
+            from eth_consensus_specs_tpu.ops.state_root import (
+                post_epoch_state_root_inc,
+            )
+
+            def body(_, carry):
+                cols, just, acc, forest = carry
+                old = (cols.balance, cols.effective_balance, cols.inactivity_scores)
+                cols, just = _advance(cols, just)
+                forest, root = post_epoch_state_root_inc(
+                    arrays,
+                    meta,
+                    plan,
+                    forest,
+                    *old,
+                    cols.balance,
+                    cols.effective_balance,
+                    cols.inactivity_scores,
+                    just,
+                    mesh=mesh,
+                )
+                return cols, just, acc ^ root, forest
+
+            return lax.fori_loop(0, n_epochs, body, (cols, just, acc0, forest))
+
+        return run_state_inc
 
     if mode == "state":
 
@@ -187,6 +342,18 @@ def _compiled_runner(params, n_epochs: int, mode: str, n: int, depth: int, meta)
         return lax.fori_loop(0, n_epochs, body, (cols, just, acc0))
 
     return run
+
+
+def _clear_compiled_after_fork_in_child() -> None:
+    # fork-safety: cached executables (incl. mesh state_inc runners and
+    # forest builders) reference the parent's device objects — a forked
+    # gen-pool child must retrace against ITS runtime, same as every
+    # other kernel cache (ops/merkle.py, ops/merkle_inc.py, mesh_ops)
+    _compiled_runner.cache_clear()
+    _compiled_forest_builder.cache_clear()
+
+
+os.register_at_fork(after_in_child=_clear_compiled_after_fork_in_child)
 
 
 def writeback(spec, state, carry: ResidentCarry) -> None:

@@ -106,6 +106,87 @@ def subtree_depth(n_chunks: int) -> int:
     return max(n_chunks - 1, 0).bit_length()
 
 
+# ------------------------------------------- incremental dirty buckets --
+#
+# The incremental forest (ops/merkle_inc.py) compiles one path-update
+# executable per DIRTY CAPACITY — the serve-buckets idiom applied to the
+# dirty-leaf axis: a small pow2 set of capacities ever compiles, the
+# live dirty count rides the smallest bucket that holds it, and the
+# crossover cost model below decides when a dispatch should abandon the
+# sparse path for the dense rebuild.
+
+_INC_DIRTY_BUCKETS = (8, 64, 256, 1024, 4096, 16384, 65536)
+
+# Work-ratio knob for the sparse/dense crossover: the sparse path costs
+# ~(depth + leaf_hashes) compressions per dirty leaf but through
+# gather/scatter at width K, while the dense rebuild's ~2^(d+1)
+# compressions run at full vector width. Measured on this machine
+# (XLA:CPU, depth 12-16 forests): the path update holds its hash-count
+# advantage to roughly a QUARTER of break-even before the narrow-width
+# dispatches lose to one wide rebuild — hence 0.25, env-overridable.
+INC_CROSSOVER = 0.25
+
+
+def inc_dirty_buckets() -> tuple[int, ...]:
+    """The configured pow2 dirty-capacity buckets (env-snapshotted per
+    call, never inside a trace — jit-purity)."""
+    raw = os.environ.get("ETH_SPECS_INC_DIRTY_BUCKETS", "")
+    if not raw:
+        return _INC_DIRTY_BUCKETS
+    try:
+        vals = sorted({pow2_bucket(int(x)) for x in raw.split(",") if x.strip()})
+    except ValueError:
+        return _INC_DIRTY_BUCKETS
+    return tuple(v for v in vals if v > 0) or _INC_DIRTY_BUCKETS
+
+
+def inc_dirty_bucket(n_dirty: int) -> int:
+    """Smallest configured dirty-capacity bucket holding `n_dirty`
+    (the largest bucket caps it — past that the dense fallback is the
+    plan, not a bigger compile)."""
+    return batch_bucket(max(int(n_dirty), 1), inc_dirty_buckets())
+
+
+def inc_crossover() -> float:
+    """Sparse-vs-dense work-ratio crossover factor (env-snapshotted)."""
+    raw = os.environ.get("ETH_SPECS_INC_CROSSOVER", "")
+    try:
+        return float(raw) if raw else INC_CROSSOVER
+    except ValueError:
+        return INC_CROSSOVER
+
+
+def inc_dense_count(depth: int, cap: int, leaf_hashes: int = 0) -> int:
+    """Dirty count above which one dense rebuild beats the path update
+    for a depth-`depth` tree: break-even is ~2^(d+1) dense compressions
+    against (depth + leaf_hashes + 1) per dirty leaf, scaled by the
+    measured :data:`INC_CROSSOVER` constant factor and capped at the
+    compile capacity (the sparse kernel cannot address more). This is
+    the static threshold the `lax.cond` inside the update kernel routes
+    on — data decides per dispatch, the model decides per compile."""
+    dense_hashes = 2 << depth
+    per_dirty = depth + leaf_hashes + 1
+    return min(int(cap), max(1, int(inc_crossover() * dense_hashes / per_dirty)))
+
+
+def merkle_inc_key(cap: int, dense_count: int, depth: int, mesh=None) -> tuple:
+    """The compile/bucket/warmup key of one incremental forest update
+    executable: every static knob of the kernel — dirty capacity bucket,
+    dense-fallback threshold, GLOBAL tree depth — plus the mesh
+    signature when the leaf axis shards (capacity and threshold apply
+    per shard there). Single-device keys carry no signature, matching
+    every other unsigned key family."""
+    from eth_consensus_specs_tpu.parallel import mesh_ops
+
+    shards = mesh_ops.shard_count(mesh)
+    if shards > 1:
+        return (
+            "merkle_inc", int(cap), int(dense_count), int(depth),
+            mesh_ops.mesh_signature(mesh),
+        )
+    return ("merkle_inc", int(cap), int(dense_count), int(depth))
+
+
 # ------------------------------------------------- live compile-key fns --
 #
 # The serve/bucket compile keys are FUNCTIONS here, not inline tuple

@@ -29,6 +29,17 @@ warmup) and a hard gate on them would make the tracker cry wolf.
 Quarantined LKG sections (BENCH_LKG's round-5 revision) are reported
 but never compared against.
 
+The resident section reports BOTH merkleization paths since the
+incremental forest landed: ``resident_epoch_plus_root_ms`` is the
+incremental path (the headline the quarantined LKG ``resident``
+section must RE-EARN on a verified accelerator run — quarantined
+entries are reported, never compared, so the old acked-before-executed
+numbers cannot grandfather themselves back in), with
+``resident_epoch_plus_root_full_ms`` and ``incremental_root_speedup``
+riding along as same-platform secondaries — a crossover regression
+(speedup collapsing toward 1x) surfaces as an advisory on the same
+timeline.
+
 Rounds that carry an ``xprof`` section (bench.py runs with ambient XLA
 attribution on — obs/xprof.py) also contribute per-kernel
 ``xprof_<kernel>_compile_ms`` and ``xprof_<kernel>_peak_bytes`` as
@@ -58,6 +69,10 @@ _CPU_MARKERS = ("cpu fallback", "xla:cpu", "cpu-fallback")
 
 
 def _lower_is_better(metric: str) -> bool:
+    # speedup FACTORS (e.g. incremental_root_speedup, mesh scaling) are
+    # higher-is-better regardless of any suffix a later rename gives them
+    if metric.endswith("_speedup") or "_speedup_" in metric:
+        return False
     return metric.endswith(("_ms", "_s", "_bytes"))
 
 

@@ -138,6 +138,31 @@ def test_merkle_many_batch_corners_vs_hashlib():
             assert roots[b].astype(">u4").tobytes() == want, label
 
 
+def test_merkle_inc_corners_vs_hashlib():
+    """Forest-update corners from the registry's declared domains: the
+    leaf/node lanes at their hash-word corners and the dirty mask at
+    both of its corners (all-clean = identity, all-dirty = dense
+    rebuild), against the host tree oracle."""
+    from eth_consensus_specs_tpu.ops import merkle_inc as mi
+
+    spec = kernels.by_name()["merkle_inc"]
+    v = spec.build_variants(None)[0]
+    words_dom, mask_dom = v.domains[0], v.domains[1]
+    depth = 3
+    n = 1 << depth
+    for wlab, w in _corners(words_dom):
+        leaves = np.full((n, 8), w, dtype=np.uint32)
+        nodes = mi.build_forest(jnp.asarray(leaves), 1)
+        want = _host_tree_root([r.astype(">u4").tobytes() for r in leaves])
+        for mlab, m in _corners(mask_dom):
+            mask = np.full((1, n), bool(m))
+            out, root = mi._apply_kernel(depth, 2, 2)(
+                nodes, jnp.asarray(mask), jnp.asarray(leaves[None])
+            )
+            assert np.asarray(root).astype(">u4").tobytes() == want, (wlab, mlab)
+            nodes = out
+
+
 def test_shuffle_corners_stay_bijective():
     """Swap-or-not at every (decision-word, pivot) corner pair: whatever
     the digest bits say, the output must remain a permutation — the

@@ -74,3 +74,77 @@ def test_writeback_applies(altair_state):
     assert [int(b) for b in work.balances] == [
         int(x) for x in np.asarray(carry.cols.balance)
     ]
+
+
+def _acc_bytes(carry) -> bytes:
+    return bytes(np.asarray(carry.root_acc))
+
+
+def test_incremental_root_bit_identical_to_full_chain(altair_state):
+    """with_root="state_inc" == with_root="state" across N chained
+    epochs — the incremental forest's xor-chain root_acc must be the
+    full recompute's, bit for bit (64 validators: a NON-pow2-chunk
+    registry — 16 balance chunks but 64 validator leaves — exercising
+    the pad/fold corners)."""
+    spec, state = altair_state
+    cols, just, static = resident.ingest_full(spec, state)
+    for epochs in (1, 3):
+        full = resident.run_epochs(spec, cols, just, epochs, with_root="state", static=static)
+        inc = resident.run_epochs(spec, cols, just, epochs, with_root="state_inc", static=static)
+        assert _acc_bytes(inc) == _acc_bytes(full), f"epochs={epochs}"
+        assert inc.forest is not None
+
+
+def test_incremental_forest_chains_across_calls(altair_state):
+    """carry.forest threads into the next run: 1+2 chained epochs'
+    xor-accumulated roots equal one 3-epoch run's."""
+    spec, state = altair_state
+    cols, just, static = resident.ingest_full(spec, state)
+    three = resident.run_epochs(spec, cols, just, 3, with_root="state_inc", static=static)
+    one = resident.run_epochs(spec, cols, just, 1, with_root="state_inc", static=static)
+    two = resident.run_epochs(
+        spec, one.cols, one.just, 2, with_root="state_inc", static=static,
+        forest=one.forest,
+    )
+    acc = np.asarray(one.root_acc) ^ np.asarray(two.root_acc)
+    assert bytes(acc) == _acc_bytes(three)
+
+
+def test_incremental_non_pow2_registry():
+    """A 48-validator registry: non-pow2 validator leaves AND non-pow2
+    chunk counts — pads must behave exactly like the full path's
+    zero-chunk padding."""
+    spec = get_spec("altair", "minimal")
+    prev = bls.bls_active
+    bls.bls_active = False
+    try:
+        state = create_genesis_state(
+            spec, [spec.MAX_EFFECTIVE_BALANCE] * 48, spec.MAX_EFFECTIVE_BALANCE
+        )
+        spec.process_slots(state, 2 * int(spec.SLOTS_PER_EPOCH) - 1)
+    finally:
+        bls.bls_active = prev
+    cols, just, static = resident.ingest_full(spec, state)
+    full = resident.run_epochs(spec, cols, just, 2, with_root="state", static=static)
+    inc = resident.run_epochs(spec, cols, just, 2, with_root="state_inc", static=static)
+    assert _acc_bytes(inc) == _acc_bytes(full)
+
+
+def test_incremental_mesh_parity(altair_state):
+    """chips=1 vs chips=N: the forest's leaf axes shard over the
+    suite's 8-virtual-device mesh and the root_acc stays bit-identical
+    (per-shard path updates + the all-gather top combine)."""
+    from eth_consensus_specs_tpu.parallel.mesh_ops import serve_mesh
+
+    spec, state = altair_state
+    mesh = serve_mesh()
+    cols, just, static = resident.ingest_full(spec, state)
+    plan = resident.forest_plan_for(static, mesh=mesh)
+    if mesh is None or plan.shards <= 1:
+        pytest.skip("needs the 8-virtual-device mesh")
+    single = resident.run_epochs(spec, cols, just, 2, with_root="state_inc", static=static)
+    sharded = resident.run_epochs(
+        spec, cols, just, 2, with_root="state_inc", static=static, mesh=mesh
+    )
+    assert plan.shards > 1
+    assert _acc_bytes(sharded) == _acc_bytes(single)

@@ -29,10 +29,10 @@ def _to_boundary(spec, state):
         next_slots(spec, state, boundary - 1 - int(state.slot))
 
 
-def _device_vs_object(spec, state):
+def _device_vs_object(spec, state, with_root="state"):
     _to_boundary(spec, state)
     cols, just, static = resident.ingest_full(spec, state)
-    carry = resident.run_epochs(spec, cols, just, 1, with_root="state", static=static)
+    carry = resident.run_epochs(spec, cols, just, 1, with_root=with_root, static=static)
     device_root = _root_bytes(carry.root_acc)
 
     expected = state.copy()
@@ -60,6 +60,20 @@ def test_state_root_after_participation(spec, state):
         state.balances[i] = int(state.balances[i]) - 12345
     state.validators[2].slashed = True
     _device_vs_object(spec, state)
+
+
+@with_phases(["altair", "deneb"])
+@spec_state_test
+def test_state_root_incremental_vs_object_tree(spec, state):
+    """The merkle_inc forest path against ssz.hash_tree_root on the
+    equivalently-updated object state — the incremental root is the
+    OBJECT tree's root after writeback, not merely the full device
+    path's (which tests/test_resident.py already pins it to)."""
+    next_epoch_with_attestations(spec, state, fill_cur_epoch=False, fill_prev_epoch=True)
+    for i in range(0, len(state.validators), 3):
+        state.balances[i] = int(state.balances[i]) - 12345
+    state.validators[2].slashed = True
+    _device_vs_object(spec, state, with_root="state_inc")
 
 
 @with_phases(["altair"])
