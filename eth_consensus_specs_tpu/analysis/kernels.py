@@ -290,6 +290,17 @@ def _merkle_many_key_grid(mesh):
                     mesh_ops.mesh_signature(m),
                 )
                 out.append((key, sig))
+                # the router's profile-form of the SAME key fn (the
+                # front door predicts siblings' compile keys from
+                # (shards, signature) — serve/buckets): a divergence
+                # between the two forms is an `aliased` finding here,
+                # not a silent cold compile in production
+                out.append((
+                    buckets.merkle_many_key_from_profile(
+                        n, depth, cfg, shards, mesh_ops.mesh_signature(m)
+                    ),
+                    sig,
+                ))
     return out
 
 
@@ -502,6 +513,13 @@ def _bls_msm_key_grid(mesh):
                     mesh_ops.mesh_signature(m),
                 )
                 out.append((key, sig))
+                # profile-form agreement (see _merkle_many_key_grid)
+                out.append((
+                    buckets.bls_msm_key_from_profile(
+                        items, lanes, shards, mesh_ops.mesh_signature(m)
+                    ),
+                    sig,
+                ))
     return out
 
 

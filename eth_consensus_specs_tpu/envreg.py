@@ -126,6 +126,39 @@ ENV_VARS: tuple[EnvVar, ...] = (
     _v("ETH_SPECS_SERVE_SLO_SHED", "on",
        "`0`: disable SLO-driven admission resizing (static caps only)",
        "serving.md#replicated-front-door"),
+    _v("ETH_SPECS_SERVE_CHIPS_MATRIX", "unset",
+       "per-replica mesh-chip cycle (`1,8`): replica i owns "
+       "`matrix[i % len]` chips — the heterogeneous two-tier fleet",
+       "serving.md#two-tier-scale-out"),
+    _v("ETH_SPECS_SERVE_DOWN_COOLDOWN_MS", "500",
+       "half-open probe cooldown before a down replica gets a trial request",
+       "serving.md#replicated-front-door"),
+    _v("ETH_SPECS_SERVE_DRAINING_TTL_S", "5",
+       "expiry of a client-OBSERVED `draining` reply (owner-asserted "
+       "draining stays sticky)", "serving.md#replicated-front-door"),
+    _v("ETH_SPECS_SERVE_AUTOSCALE", "0",
+       "`1`: the SLO evaluator also drives replica COUNT — grow a pre-warmed "
+       "replica on sustained breach, retire one on sustained idle",
+       "serving.md#two-tier-scale-out"),
+    _v("ETH_SPECS_SERVE_MIN_REPLICAS", "1",
+       "autoscaler floor on replicas in rotation",
+       "serving.md#two-tier-scale-out"),
+    _v("ETH_SPECS_SERVE_MAX_REPLICAS", "8",
+       "autoscaler ceiling on replicas in rotation",
+       "serving.md#two-tier-scale-out"),
+    _v("ETH_SPECS_SERVE_GROW_WINDOWS", "3",
+       "consecutive breached probe windows before the autoscaler grows",
+       "serving.md#two-tier-scale-out"),
+    _v("ETH_SPECS_SERVE_RETIRE_WINDOWS", "10",
+       "consecutive idle probe windows before the autoscaler retires",
+       "serving.md#two-tier-scale-out"),
+    _v("ETH_SPECS_SERVE_SCALE_COOLDOWN_S", "5",
+       "minimum seconds between autoscaler actions",
+       "serving.md#two-tier-scale-out"),
+    _v("ETH_SPECS_SERVE_DISTRIBUTED", "0",
+       "`1`: a spawned replica joins the multi-host runtime at boot "
+       "(`jax.distributed` via parallel/multihost.py) so its mesh slice "
+       "spans a pod, not a host", "serving.md#two-tier-scale-out"),
     _v("ETH_SPECS_SERVE_CHIPS", "0",
        "chips the serve dispatch mesh spans (0 = every local device; 1 = "
        "single-device dispatch); `serve_bench.py --chips` forces the matching "

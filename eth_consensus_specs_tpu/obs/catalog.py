@@ -156,7 +156,9 @@ CATALOG: tuple[Metric, ...] = (
     _c("frontdoor.hedges", "hedged re-dispatches launched"),
     _c("frontdoor.planned_restarts", "zero-shed drain rollovers"),
     _c("frontdoor.probe_failures", "supervisor health-probe failures"),
+    _c("frontdoor.replicas_grown", "replicas added by the SLO autoscaler"),
     _c("frontdoor.replicas_replaced", "dead replicas respawned"),
+    _c("frontdoor.replicas_retired", "idle replicas retired by the SLO autoscaler"),
     _c("frontdoor.replies_dropped", "replica replies to vanished callers"),
     _c("frontdoor.request_errors", "typed application errors returned"),
     _c("frontdoor.requests", "front-door submits"),
@@ -164,8 +166,13 @@ CATALOG: tuple[Metric, ...] = (
     _c("frontdoor.respawn_failures", "replica respawn attempts that failed"),
     _c("frontdoor.route.affinity", "requests routed to their shape-affine replica"),
     _c("frontdoor.route.fallback", "requests routed past their affine replica"),
+    _c("frontdoor.route.mesh_affinity",
+       "requests routed to the mesh tier matching their width"),
+    _c("frontdoor.route.warm",
+       "requests routed to a replica already warm for their shape"),
     _c("frontdoor.slo_sheds", "SLO-driven admission shrinks"),
     _g("frontdoor.effective_max_queue", "SLO-adjusted admission cap"),
+    _g("frontdoor.replicas", "replicas currently in rotation"),
     _h("frontdoor.e2e_ms", "front-door end-to-end latency, ms"),
     _s("frontdoor.rpc", "one framed RPC at the replica boundary"),
     # ---------------------------------------------------------- watchdog --

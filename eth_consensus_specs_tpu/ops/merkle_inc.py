@@ -249,7 +249,7 @@ def forest_apply(
     cap: int,
     dense_count: int,
     mesh=None,
-) -> jnp.ndarray:
+) -> tuple[jnp.ndarray, jnp.ndarray]:
     """Apply one epoch's dirty set to a forest tree (traceable).
 
     nodes: u32[S, M, 8]; mask: bool[S, Ll] per-shard dirty leaves;

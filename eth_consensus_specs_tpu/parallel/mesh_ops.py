@@ -78,9 +78,23 @@ def serve_mesh(chips: int | None = None) -> Mesh | None:
     path. ``chips`` overrides ``ETH_SPECS_SERVE_CHIPS`` (the bench builds
     a chips=1 and a chips=N service in one process); the count is capped
     at the local device count. Env is snapshotted per call — a flip
-    mid-flush changes the NEXT dispatch, never a traced one."""
+    mid-flush changes the NEXT dispatch, never a traced one.
+
+    Multi-process runtimes (a replica that joined a pod slice via
+    ``multihost.maybe_initialize_for_replica``) get the hybrid host-major
+    mesh over EVERY process's devices instead of a local slice: the
+    replica's mesh IS its pod slice, and the chips cap does not apply —
+    per-replica width is a single-host concept."""
     if not mesh_enabled():
         return None
+    if jax.process_count() > 1:
+        mesh = _MESH_CACHE.get(-1)
+        if mesh is None:
+            from . import multihost
+
+            mesh = _MESH_CACHE[-1] = multihost.make_hybrid_mesh()
+            obs.gauge("mesh.devices", int(mesh.devices.size))
+        return mesh
     n_local = len(jax.local_devices())
     want = chips_requested() if chips is None else max(int(chips), 0)
     n = min(want, n_local) if want else n_local
@@ -117,6 +131,29 @@ def mesh_signature(mesh: Mesh | None) -> str:
         return ""
     platform = next(iter(mesh.devices.flat)).platform
     return f"{platform}{int(mesh.shape[DP_AXIS])}x{int(mesh.shape[SP_AXIS])}"
+
+
+def expected_mesh_shape(chips: int) -> tuple[int, int]:
+    """The (dp, sp) grid ``make_mesh`` lays ``chips`` devices into —
+    pure arithmetic, usable BEFORE any such mesh exists (the front door
+    predicts a replica's grid while building its warm-key list)."""
+    sp = 2 if chips % 2 == 0 and chips >= 2 else 1
+    return chips // sp, sp
+
+
+def expected_signature(chips: int, platform: str | None = None) -> str:
+    """The mesh signature a replica spawned with ``chips`` devices will
+    report, predicted PARENT-SIDE (same host, same platform) so warm-key
+    lists can be built before the replica boots. The replica's ready
+    profile is ground truth; a mismatch (e.g. a real-hardware host
+    capping the count) only costs precompile skips, never a wrong
+    compile."""
+    if chips < 2 or not mesh_enabled():
+        return ""
+    if platform is None:
+        platform = jax.local_devices()[0].platform
+    dp, sp = expected_mesh_shape(chips)
+    return f"{platform}{dp}x{sp}"
 
 
 def pad_to_shards(n: int, shards: int) -> int:

@@ -98,6 +98,20 @@ def _initialize_distributed(
     return jax.process_count() > 1
 
 
+def maybe_initialize_for_replica() -> bool:
+    """The replica-boot seam of the two-tier fleet: with
+    ``ETH_SPECS_SERVE_DISTRIBUTED=1`` a spawned replica joins the
+    multi-host runtime (coordinator env / TPU-pod autodetection, see
+    :func:`initialize_distributed`) BEFORE building its service, so its
+    serve mesh becomes a whole pod slice instead of a local-device
+    slice. Single-host fleets (the default) skip the bootstrap entirely
+    — no env, no-op. Returns True when a multi-process runtime is
+    live."""
+    if os.environ.get("ETH_SPECS_SERVE_DISTRIBUTED") != "1":
+        return False
+    return initialize_distributed()
+
+
 def make_hybrid_mesh(sp_per_host: int | None = None) -> Mesh:
     """A (dp, sp) mesh laid out host-major: sp varies WITHIN each host's
     devices (collective-heavy axis on ICI), dp spans hosts (scalar psums

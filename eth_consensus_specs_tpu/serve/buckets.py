@@ -200,6 +200,23 @@ def merkle_inc_key(cap: int, dense_count: int, depth: int, mesh=None) -> tuple:
 # artifact.
 
 
+def merkle_many_key_from_profile(
+    n_trees: int, depth: int, buckets_cfg: tuple[int, ...],
+    shards: int = 1, sig: str = "",
+) -> tuple:
+    """:func:`merkle_many_key` computed from a replica PROFILE — the
+    (shard-count, mesh-signature) pair a router knows about a remote
+    replica — instead of a live Mesh object. The front door uses this to
+    predict which compile key a sibling would pay for a flush, which is
+    what makes the warm-cache map honest; the jaxlint recompile-surface
+    grid runs BOTH forms over the same bucket range, so a divergence
+    between them is an ``aliased`` finding, not a silent cold compile."""
+    if shards > 1 and sig:
+        pad = mesh_batch_bucket(n_trees, shards, buckets_cfg)
+        return ("merkle_many", pad, depth, sig)
+    return ("merkle_many", batch_bucket(n_trees, buckets_cfg), depth)
+
+
 def merkle_many_key(n_trees: int, depth: int, buckets_cfg: tuple[int, ...],
                     mesh=None) -> tuple:
     """The compile/bucket/warmup key of a merkle_many flush: bucket-padded
@@ -208,11 +225,24 @@ def merkle_many_key(n_trees: int, depth: int, buckets_cfg: tuple[int, ...],
     keeps an 8-chip warmup artifact out of a 1-chip boot)."""
     from eth_consensus_specs_tpu.parallel import mesh_ops
 
-    shards = mesh_ops.shard_count(mesh)
-    if shards > 1:
-        pad = mesh_batch_bucket(n_trees, shards, buckets_cfg)
-        return ("merkle_many", pad, depth, mesh_ops.mesh_signature(mesh))
-    return ("merkle_many", batch_bucket(n_trees, buckets_cfg), depth)
+    return merkle_many_key_from_profile(
+        n_trees, depth, buckets_cfg,
+        mesh_ops.shard_count(mesh), mesh_ops.mesh_signature(mesh),
+    )
+
+
+def bls_msm_key_from_profile(
+    n_items: int, max_lanes: int, shards: int = 1, sig: str = ""
+) -> tuple:
+    """:func:`bls_msm_key` computed from a replica profile (shards,
+    signature) instead of a live Mesh — same contract as
+    :func:`merkle_many_key_from_profile`."""
+    from eth_consensus_specs_tpu.ops.g1_msm import many_sum_shape
+
+    shape = many_sum_shape(n_items, max_lanes, shards)
+    if shards > 1 and sig:
+        return ("bls_msm", *shape, sig)
+    return ("bls_msm", *shape)
 
 
 def bls_msm_key(n_items: int, max_lanes: int, mesh=None) -> tuple:
@@ -221,14 +251,93 @@ def bls_msm_key(n_items: int, max_lanes: int, mesh=None) -> tuple:
     when the item axis shards. Single-device keys carry NO signature —
     byte-compatible with every warmup artifact written before mesh
     dispatch existed."""
-    from eth_consensus_specs_tpu.ops.g1_msm import many_sum_shape
     from eth_consensus_specs_tpu.parallel import mesh_ops
 
-    shards = mesh_ops.shard_count(mesh)
-    shape = many_sum_shape(n_items, max_lanes, shards)
-    if shards > 1:
-        return ("bls_msm", *shape, mesh_ops.mesh_signature(mesh))
-    return ("bls_msm", *shape)
+    return bls_msm_key_from_profile(
+        n_items, max_lanes, mesh_ops.shard_count(mesh), mesh_ops.mesh_signature(mesh)
+    )
+
+
+# ------------------------------------------------- fleet routing model --
+#
+# The two-tier fleet (serve/frontdoor.py) routes by (compile-shape,
+# mesh-signature): a request's intrinsic shape decides WHICH replica
+# tier should serve it, and a replica's replayed warmup keys decide
+# whether it can serve the shape without a cold compile. Both policies
+# are LIVE functions here so the router, the bench, and the analysis
+# key grids can never disagree about them.
+
+
+def route_wide(kind: str, dim: int, max_batch: int) -> bool:
+    """Does a request of this kind / intrinsic dim belong on a WIDE
+    (mesh-sliced) replica? htr: the steady-state flush — ``max_batch``
+    trees of ``2^dim`` chunks — must clear the measured mesh crossover
+    (:func:`mesh_dispatch_worthwhile`); below it the sharded path LOSES
+    to collective overhead and the request belongs on a narrow replica.
+    bls: the mesh shards the flush's ITEM axis, so any full flush past
+    the min-items floor is wide-worthy regardless of committee size."""
+    from eth_consensus_specs_tpu.parallel import mesh_ops
+
+    if kind in ("htr", "merkle_many"):
+        return mesh_dispatch_worthwhile(1 << dim, max(int(max_batch), 1))
+    return int(max_batch) >= mesh_ops.min_items()
+
+
+def route_shape_of_key(key: tuple) -> tuple | None:
+    """The router-visible (op, intrinsic-dim) a compiled shape key warms:
+    merkle_many keys warm their DEPTH (batch padding is bucket policy,
+    not identity), bls_msm keys warm their lane bucket (the pow2
+    committee the client hashes by). Unknown ops warm nothing."""
+    op = key[0]
+    dims = [d for d in key[1:] if not isinstance(d, str)]
+    if op == "merkle_many" and len(dims) == 2:
+        return (op, int(dims[1]))
+    if op == "bls_msm" and dims:
+        return (op, int(dims[-1]))
+    return None
+
+
+def widen_warm_keys(
+    keys: list[tuple] | None, cfg, shards: int, sig: str
+) -> list[tuple]:
+    """The per-replica warm-key list for one mesh profile: the caller's
+    unsigned workload keys plus, for a wide profile, the mesh-signed
+    variants that replica's dispatches will actually compile — signed
+    merkle pads for every flush size past the crossover, signed bls_msm
+    shapes for every item bucket. A narrow profile gets the unsigned
+    list verbatim; an alien-signed key never appears (precompile would
+    skip it anyway, but the point of per-profile lists is that the
+    respawned replacement replays ONLY its own mesh's keys)."""
+    from eth_consensus_specs_tpu.parallel import mesh_ops
+
+    out = [tuple(k) for k in keys or []]
+    if shards <= 1 or not sig:
+        return out
+    floor = mesh_ops.min_items()
+    depths = sorted({k[2] for k in out if k[0] == "merkle_many" and len(k) == 3})
+    for depth in depths:
+        pads = sorted(
+            {
+                mesh_batch_bucket(n, shards, cfg.buckets)
+                for n in range(1, cfg.max_batch + 1)
+                if n >= floor and mesh_dispatch_worthwhile(1 << depth, n)
+            }
+        )
+        out += [("merkle_many", pad, int(depth), sig) for pad in pads]
+    lanes = sorted({k[2] for k in out if k[0] == "bls_msm" and len(k) == 3})
+    for lane in lanes:
+        # signed pads are generated from LIVE flush counts (like the
+        # merkle branch above), not from the unsigned keys' already-
+        # padded item counts: mesh_lane_pad is only idempotent under
+        # that round-trip for pow2 shard counts, and a 6-shard replica
+        # fed pad-of-pad keys would cold-compile its real flush shapes
+        out += [
+            bls_msm_key_from_profile(n, lane, shards, sig)
+            for n in range(1, cfg.max_batch + 1)
+            if n >= floor
+        ]
+    # distinct flush sizes can pad to one compile shape: dedupe, keep order
+    return list(dict.fromkeys(out))
 
 
 # ------------------------------------------------- compile accounting --

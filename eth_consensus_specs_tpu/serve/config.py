@@ -48,6 +48,29 @@ Replicated front door (serve/frontdoor.py):
     ETH_SPECS_SERVE_FD_CONCURRENCY=16 front-door dispatcher threads
     ETH_SPECS_SERVE_SLO_SHED=1        0: disable SLO-driven admission
                                       resizing (static caps only)
+
+Two-tier fleet (heterogeneous replicas × mesh, docs/serving.md
+"Two-tier scale-out"):
+
+    ETH_SPECS_SERVE_CHIPS_MATRIX=1,8  per-replica mesh-chip cycle:
+                                      replica i owns matrix[i % len]
+                                      chips (empty = every replica
+                                      inherits ETH_SPECS_SERVE_CHIPS)
+    ETH_SPECS_SERVE_DOWN_COOLDOWN_MS=500   half-open probe cooldown for
+                                      a down replica
+    ETH_SPECS_SERVE_DRAINING_TTL_S=5  observed-draining expiry for
+                                      supervisor-less clients
+    ETH_SPECS_SERVE_AUTOSCALE=0       1: the SLO evaluator also drives
+                                      replica COUNT (grow on sustained
+                                      breach, retire on sustained idle)
+    ETH_SPECS_SERVE_MIN_REPLICAS=1    autoscaler floor
+    ETH_SPECS_SERVE_MAX_REPLICAS=8    autoscaler ceiling
+    ETH_SPECS_SERVE_GROW_WINDOWS=3    consecutive breached probe windows
+                                      before a grow
+    ETH_SPECS_SERVE_RETIRE_WINDOWS=10 consecutive idle probe windows
+                                      before a retire
+    ETH_SPECS_SERVE_SCALE_COOLDOWN_S=5  minimum seconds between scale
+                                      actions
 """
 
 from __future__ import annotations
@@ -147,23 +170,66 @@ class FrontDoorConfig:
     # a replica marked down is retried (half-open) after this cooldown,
     # so clients without a supervisor self-heal once it respawns
     down_cooldown_ms: float = 500.0
+    # an observed "draining" reply blackholes the replica for this long
+    # at most (supervisor-less clients have nobody to clear the flag)
+    draining_ttl_s: float = 5.0
     slo_shedding: bool = True
     # SLO shedding never shrinks the effective admission cap below this
     min_queue: int = 8
+    # per-replica mesh-chip cycle: replica i owns chips_matrix[i % len]
+    # devices (empty = every replica inherits ServeConfig.mesh_chips /
+    # ETH_SPECS_SERVE_CHIPS) — the heterogeneous two-tier fleet
+    chips_matrix: tuple[int, ...] = ()
+    # the second SLO actuator: drive replica COUNT, not just admission
+    autoscale: bool = False
+    min_replicas: int = 1
+    max_replicas: int = 8
+    grow_windows: int = 3  # consecutive breached windows before a grow
+    retire_windows: int = 10  # consecutive idle windows before a retire
+    scale_cooldown_s: float = 5.0
 
     @classmethod
     def from_env(cls, **overrides) -> "FrontDoorConfig":
+        raw_matrix = os.environ.get("ETH_SPECS_SERVE_CHIPS_MATRIX", "")
+        try:
+            matrix = tuple(int(c) for c in raw_matrix.split(",") if c.strip())
+        except ValueError:
+            matrix = ()
         cfg = cls(
             replicas=_env_int("ETH_SPECS_SERVE_REPLICAS", cls.replicas),
             hedge_ms=_env_float("ETH_SPECS_SERVE_HEDGE_MS", cls.hedge_ms),
             rpc_timeout_s=_env_float("ETH_SPECS_SERVE_RPC_TIMEOUT_S", cls.rpc_timeout_s),
             probe_interval_ms=_env_float("ETH_SPECS_SERVE_PROBE_MS", cls.probe_interval_ms),
             concurrency=_env_int("ETH_SPECS_SERVE_FD_CONCURRENCY", cls.concurrency),
+            down_cooldown_ms=_env_float(
+                "ETH_SPECS_SERVE_DOWN_COOLDOWN_MS", cls.down_cooldown_ms
+            ),
+            draining_ttl_s=_env_float(
+                "ETH_SPECS_SERVE_DRAINING_TTL_S", cls.draining_ttl_s
+            ),
             slo_shedding=os.environ.get("ETH_SPECS_SERVE_SLO_SHED", "1") != "0",
+            chips_matrix=matrix,
+            autoscale=os.environ.get("ETH_SPECS_SERVE_AUTOSCALE") == "1",
+            min_replicas=_env_int("ETH_SPECS_SERVE_MIN_REPLICAS", cls.min_replicas),
+            max_replicas=_env_int("ETH_SPECS_SERVE_MAX_REPLICAS", cls.max_replicas),
+            grow_windows=_env_int("ETH_SPECS_SERVE_GROW_WINDOWS", cls.grow_windows),
+            retire_windows=_env_int(
+                "ETH_SPECS_SERVE_RETIRE_WINDOWS", cls.retire_windows
+            ),
+            scale_cooldown_s=_env_float(
+                "ETH_SPECS_SERVE_SCALE_COOLDOWN_S", cls.scale_cooldown_s
+            ),
         )
         if overrides:
             cfg = replace(cfg, **overrides)
         return cfg
+
+    def chips_for(self, i: int, default: int = 0) -> int:
+        """Replica i's mesh-chip count under the heterogeneous cycle
+        (0 = inherit the process-wide ETH_SPECS_SERVE_CHIPS default)."""
+        if not self.chips_matrix:
+            return default
+        return int(self.chips_matrix[i % len(self.chips_matrix)])
 
     @property
     def hedge_s(self) -> float:

@@ -36,6 +36,15 @@ service has — with the failure handling BETWEEN processes:
     MERGED cross-process telemetry; a breach halves the effective
     admission cap (typed sheds with honest retry-after), recovery grows
     it back additively. The static cap is the ceiling, not the policy.
+  * **two-tier fleet + autoscaling** — each replica owns its own mesh
+    slice (``chips`` / ``ETH_SPECS_SERVE_CHIPS_MATRIX``: a 1-chip and
+    an 8-chip replica coexist), the router keys on (compile-shape,
+    mesh-signature) with a warm-cache map built from the mesh-signed
+    warmup keys each replica actually replayed, and the SLO evaluator's
+    SECOND actuator drives replica count: sustained breach grows a
+    pre-warmed replica, sustained idle retires one through the same
+    zero-shed drain rollover a planned restart uses
+    (docs/serving.md#two-tier-scale-out).
 
 W3C trace contexts ride in every submit frame, so a request's spans
 stitch across the process boundary in the shared JSONL stream.
@@ -67,10 +76,10 @@ from .router import Router
 class _FDRequest:
     __slots__ = (
         "kind", "payload", "shape_key", "cost_bytes", "future",
-        "trace", "t_submit", "released", "hedged",
+        "trace", "t_submit", "released", "hedged", "wide",
     )
 
-    def __init__(self, kind, payload, shape_key, cost_bytes):
+    def __init__(self, kind, payload, shape_key, cost_bytes, wide=None):
         self.kind = kind
         self.payload = payload
         self.shape_key = shape_key
@@ -80,6 +89,7 @@ class _FDRequest:
         self.t_submit = time.monotonic()
         self.released = False  # admission slot handed back (exactly once)
         self.hedged = False  # at most one hedge per request
+        self.wide = wide  # mesh-tier preference (buckets.route_wide)
 
 
 def _host_execute(kind: str, payload):
@@ -118,7 +128,9 @@ class FrontDoorClient:
         self._addrs = [wire.parse_addr(a) for a in addrs]
         self._gens = [0] * len(self._addrs)
         self.router = Router(
-            len(self._addrs), down_cooldown_s=self.fdcfg.down_cooldown_s
+            len(self._addrs),
+            down_cooldown_s=self.fdcfg.down_cooldown_s,
+            draining_ttl_s=self.fdcfg.draining_ttl_s,
         )
         self.admission = AdmissionController(
             self.config.max_queue, self.config.max_bytes
@@ -139,7 +151,11 @@ class FrontDoorClient:
         if self._closed:
             raise RuntimeError(f"front door {self.name} is shut down")
         self.admission.admit(cost_bytes)
-        req = _FDRequest(kind, payload, shape_key, cost_bytes)
+        # mesh-tier classification (serve/buckets.route_wide): big
+        # flushes belong on mesh-sliced replicas, toy flushes on narrow
+        # ones — the signature-aware half of the routing key
+        wide = buckets.route_wide(kind, shape_key[1], self.config.max_batch)
+        req = _FDRequest(kind, payload, shape_key, cost_bytes, wide=wide)
         try:
             self._pool.submit(self._dispatch, req)
         except RuntimeError:
@@ -195,7 +211,7 @@ class FrontDoorClient:
         for _ in range(2 * len(self.router) + 4):
             if req.released:
                 return  # the other leg already won
-            idx = self.router.pick(req.shape_key, exclude=tried)
+            idx = self.router.pick(req.shape_key, exclude=tried, wide=req.wide)
             if idx is None:
                 # every candidate is down, draining, tried, or backing
                 # off — honor the soonest backoff once before giving up
@@ -233,10 +249,10 @@ class FrontDoorClient:
                 tried.add(idx)
                 continue
             if err == "draining":
-                # observed, not owner-asserted: expires on its own so a
-                # supervisor-less client can't blackhole the replica
-                # past the rollover
-                self.router.note_draining(idx, ttl_s=5.0)
+                # observed, not owner-asserted: expires on its own (the
+                # router's configured TTL) so a supervisor-less client
+                # can't blackhole the replica past the rollover
+                self.router.note_draining(idx)
                 tried.add(idx)
                 continue
             # a typed application-error reply PROVES the replica is
@@ -447,6 +463,9 @@ class FrontDoorClient:
             "failovers": counters.get("frontdoor.failovers", 0),
             "degraded_to_host": counters.get("frontdoor.degraded_to_host", 0),
             "corrupt_frames": counters.get("frontdoor.corrupt_frames", 0),
+            "replicas_grown": counters.get("frontdoor.replicas_grown", 0),
+            "replicas_retired": counters.get("frontdoor.replicas_retired", 0),
+            "route_mesh_affinity": counters.get("frontdoor.route.mesh_affinity", 0),
             "replicas": self.router.snapshot(),
         }
 
@@ -462,7 +481,11 @@ class FrontDoorClient:
 
 
 class FrontDoor(FrontDoorClient):
-    """Owns the replica fleet: spawn, warm, supervise, respawn, drain."""
+    """Owns the replica fleet: spawn, warm, supervise, respawn, drain,
+    and — the two-tier composition — give each replica its OWN mesh
+    slice (``chips`` / ``ETH_SPECS_SERVE_CHIPS_MATRIX``): a 1-chip and
+    an 8-chip replica coexist in one fleet, the router keys on their
+    mesh signatures, and the SLO autoscaler grows/retires replicas."""
 
     def __init__(
         self,
@@ -472,6 +495,7 @@ class FrontDoor(FrontDoorClient):
         warmup_path: str | None = None,
         warm_keys: list | None = None,
         replica_fault_spec: str | None = None,
+        chips: int | list | tuple | None = None,
         name: str = "frontdoor",
     ):
         config = config or ServeConfig.from_env()
@@ -487,15 +511,34 @@ class FrontDoor(FrontDoorClient):
         # inheritance, only by the shippable warmup artifact.
         self._ctx = multiprocessing.get_context("spawn")
         self._warmup_path = warmup_path
-        self._warm_keys = warm_keys
         self._fault_spec = replica_fault_spec
         self._cfg_overrides = dataclasses.asdict(config)
         self._fd_name = name
         self._ready_timeout_s = fd_config.ready_timeout_s
+        # per-replica mesh slices: an explicit `chips` wins, then the
+        # config's chips_matrix cycle, then the homogeneous default
+        # (config.mesh_chips, possibly 0 = env) — replica i owns
+        # self._chips[i] devices, forced into its child env via the
+        # prejax idiom so a 1-chip and an 8-chip replica coexist
+        if chips is None:
+            self._chips = [fd_config.chips_for(i, config.mesh_chips) for i in range(n)]
+        elif isinstance(chips, int):
+            self._chips = [chips] * n
+        else:
+            self._chips = [int(chips[i % len(chips)]) for i in range(n)]
+        # each replica warms its OWN profile's keys: the caller's
+        # unsigned workload keys plus the mesh-signed variants its slice
+        # will dispatch (a respawn replays exactly this list again)
+        self._base_warm_keys = [tuple(k) for k in warm_keys or []]
+        self._warm_keys_by_slot: list = [
+            self._profile_warm_keys(c) for c in self._chips
+        ]
+        self._profiles: list = [None] * n
         self._procs: list = [None] * n
         self._rings = [deque(maxlen=max(flight.capacity(), 1)) for _ in range(n)]
         self._health: list = [None] * n
         self._restarting = [False] * n
+        self._retired = [False] * n
         self._respawn_failures = [0] * n
         self._respawn_not_before = [0.0] * n
         ports = [0] * n
@@ -503,7 +546,7 @@ class FrontDoor(FrontDoorClient):
         # artifact (explicit warm keys + its own first dispatches); the
         # rest boot concurrently and REPLAY it — that is what makes
         # "zero cold compiles on replicas 2..R" hold
-        self._procs[0], ports[0] = self._spawn_replica(0)
+        self._procs[0], ports[0], self._profiles[0] = self._spawn_replica(0)
         rest = [
             threading.Thread(target=self._boot_into, args=(i, ports), daemon=True)
             for i in range(1, n)
@@ -524,34 +567,81 @@ class FrontDoor(FrontDoorClient):
             fd_config=fd_config,
             name=name,
         )
+        for i, profile in enumerate(self._profiles):
+            self._install_profile(i, profile)
         self._stop = threading.Event()
         self._base_max_queue = self.admission.max_queue
         self._slo_shipper = DeltaShipper()
         self._slo_breached_once = False
+        self._breach_streak = 0
+        self._idle_streak = 0
+        self._scaling = False
+        self._last_scale_t = 0.0
         self._supervisor = threading.Thread(
             target=self._supervise, daemon=True, name=f"{name}-supervisor"
         )
         self._supervisor.start()
 
+    def _profile_warm_keys(self, chips: int) -> list:
+        """The warm-key list for one replica profile, built PARENT-side
+        from the predicted mesh signature (same host, same platform —
+        the replica's ready profile confirms it). ``chips == 0`` means
+        the replica inherits the process-wide default; its keys stay
+        unsigned (the artifact covers whatever its live mesh matches)."""
+        from eth_consensus_specs_tpu.parallel import mesh_ops
+
+        if chips <= 0:
+            return list(self._base_warm_keys)
+        sig = mesh_ops.expected_signature(chips)
+        dp, sp = mesh_ops.expected_mesh_shape(chips)
+        cfg = ServeConfig.from_env(**self._cfg_overrides)
+        return buckets.widen_warm_keys(
+            self._base_warm_keys, cfg, dp * sp if sig else 1, sig
+        )
+
+    def _install_profile(self, i: int, profile: dict | None) -> None:
+        if not profile:
+            return
+        self._profiles[i] = profile
+        self.router.set_profile(
+            i,
+            chips=profile.get("chips", 1),
+            signature=profile.get("signature", ""),
+            warm_keys=profile.get("warm_keys") or (),
+        )
+
     def _boot_into(self, i: int, ports: list) -> None:
         try:
-            self._procs[i], ports[i] = self._spawn_replica(i)
+            self._procs[i], ports[i], self._profiles[i] = self._spawn_replica(i)
         except Exception:
             self._procs[i] = None
 
     def _spawn_replica(self, i: int, port_hint: int = 0):
+        from eth_consensus_specs_tpu import prejax
+
+        chips = self._chips[i] if i < len(self._chips) else 0
+        overrides = dict(self._cfg_overrides)
+        child_env = None
+        if chips > 0:
+            # an explicit per-replica slice: the child's device count and
+            # its service's mesh width are BOTH this replica's policy
+            overrides["mesh_chips"] = chips
+            child_env = prejax.replica_chips_env(chips)
         parent_conn, child_conn = self._ctx.Pipe()
         proc = self._ctx.Process(
             target=replica_main,
             args=(
                 child_conn,
-                self._cfg_overrides,
+                overrides,
                 f"{self._fd_name}-r{i}",
                 self._warmup_path,
                 i == 0 and self._warmup_path is not None,
-                self._warm_keys if i == 0 else None,
+                self._warm_keys_by_slot[i],
                 self._fault_spec,
                 port_hint,
+                # the spawn env forcing this replica's OWN device count
+                # (authoritatively replacing any inherited XLA flag)
+                child_env,
             ),
             daemon=True,
         )
@@ -564,11 +654,13 @@ class FrontDoor(FrontDoorClient):
             msg = parent_conn.recv()
         finally:
             parent_conn.close()
-        _, pid, port, warmed = msg
+        _, pid, port, warmed, profile = msg
         obs.event(
-            "frontdoor.replica_spawned", replica=i, pid=pid, port=port, warmed=warmed
+            "frontdoor.replica_spawned",
+            replica=i, pid=pid, port=port, warmed=warmed,
+            signature=profile.get("signature", ""), chips=profile.get("chips", 1),
         )
-        return proc, port
+        return proc, port, profile
 
     # --------------------------------------------------------- supervision --
 
@@ -577,14 +669,14 @@ class FrontDoor(FrontDoorClient):
             for i in range(len(self._procs)):
                 if self._stop.is_set():
                     return
-                if self._restarting[i]:
+                if self._restarting[i] or self._retired[i]:
                     continue
                 proc = self._procs[i]
                 if proc is None or not proc.is_alive():
                     self._handle_replica_death(i)
                 else:
                     self._probe(i)
-            if self.fdcfg.slo_shedding:
+            if self.fdcfg.slo_shedding or self.fdcfg.autoscale:
                 self._slo_step()
 
     def _probe(self, i: int) -> None:
@@ -661,7 +753,7 @@ class FrontDoor(FrontDoorClient):
                 # ONE attempt per wakeup; failures back off
                 # exponentially across supervision ticks instead of
                 # retrying in a tight loop
-                proc, port = self._spawn_replica(i, port_hint=old_port)
+                proc, port, profile = self._spawn_replica(i, port_hint=old_port)
             except Exception:  # noqa: BLE001 — keep serving on the survivors
                 self._respawn_failures[i] += 1
                 self._respawn_not_before[i] = time.monotonic() + min(
@@ -684,42 +776,177 @@ class FrontDoor(FrontDoorClient):
             self._procs[i] = proc
             self._set_endpoint(i, port)
             self.router.mark_up(i)
+            self._install_profile(i, profile)
         finally:
             self._restarting[i] = False
 
-    def _slo_step(self) -> None:
+    def _slo_step(self, shed: bool | None = None) -> None:
         # objectives evaluated over THIS probe window only (the delta),
         # so one bad minute sheds now instead of being averaged away by
-        # a long healthy history — and recovery is observable quickly
+        # a long healthy history — and recovery is observable quickly.
+        # `shed` overrides the config gate (tests drive breaches by hand
+        # with the supervisor's own shedding disabled)
+        shed = self.fdcfg.slo_shedding if shed is None else shed
         d = self._slo_shipper.delta()
         window = {"counters": d["counters"], "histograms": d["histograms"]}
         results = slo.evaluate(
             window,
             [s for s in slo.default_slos() if s.name in ("serve_wait_p99", "degraded_rate")],
         )
-        cur = self.admission.max_queue
-        if not slo.passed(results):
-            new_q = max(self.fdcfg.min_queue, cur // 2)
-            if new_q < cur:
-                self.admission.resize(new_q)
-                obs.count("frontdoor.slo_sheds", 1)
-                obs.event(
-                    "frontdoor.slo_shed",
-                    violations=",".join(r.name for r in results if not r.ok),
-                    max_queue=new_q,
+        breached = not slo.passed(results)
+        if shed:
+            cur = self.admission.max_queue
+            if breached:
+                new_q = max(self.fdcfg.min_queue, cur // 2)
+                if new_q < cur:
+                    self.admission.resize(new_q)
+                    obs.count("frontdoor.slo_sheds", 1)
+                    obs.event(
+                        "frontdoor.slo_shed",
+                        violations=",".join(r.name for r in results if not r.ok),
+                        max_queue=new_q,
+                    )
+                if not self._slo_breached_once:
+                    self._slo_breached_once = True
+                    flight.trigger_dump(
+                        "frontdoor.slo_breach",
+                        detail=",".join(r.name for r in results if not r.ok),
+                        extra={"slo": slo.report(results)},
+                    )
+            elif cur < self._base_max_queue:
+                self.admission.resize(
+                    min(cur + max(self._base_max_queue // 10, 1), self._base_max_queue)
                 )
-            if not self._slo_breached_once:
-                self._slo_breached_once = True
-                flight.trigger_dump(
-                    "frontdoor.slo_breach",
-                    detail=",".join(r.name for r in results if not r.ok),
-                    extra={"slo": slo.report(results)},
-                )
-        elif cur < self._base_max_queue:
-            self.admission.resize(
-                min(cur + max(self._base_max_queue // 10, 1), self._base_max_queue)
+            obs.gauge("frontdoor.effective_max_queue", self.admission.max_queue)
+        self._autoscale_step(breached, d["counters"].get("frontdoor.requests", 0))
+
+    # ----------------------------------------------------------- autoscale --
+
+    def _autoscale_step(self, breached: bool, window_requests: float) -> None:
+        """The SLO evaluator's SECOND actuator: admission shedding caps
+        the damage inside a fixed fleet; this drives the fleet SIZE.
+        Sustained p99/degraded breach grows a pre-warmed replica (widest
+        configured tier — breach means the fleet is short on throughput),
+        sustained idle retires one (LIFO, zero-shed drain rollover).
+        Streaks are consecutive probe WINDOWS, so one noisy window never
+        scales; a cooldown separates actions so a grow can prove itself
+        before the next decision."""
+        self._breach_streak = self._breach_streak + 1 if breached else 0
+        self._idle_streak = self._idle_streak + 1 if window_requests == 0 else 0
+        live = [i for i in range(len(self._procs)) if not self._retired[i]]
+        obs.gauge("frontdoor.replicas", len(live))
+        if not self.fdcfg.autoscale or self._scaling:
+            return
+        if time.monotonic() - self._last_scale_t < self.fdcfg.scale_cooldown_s:
+            return
+        if (
+            self._breach_streak >= max(self.fdcfg.grow_windows, 1)
+            and len(live) < self.fdcfg.max_replicas
+        ):
+            self._scaling = True
+            self._breach_streak = 0
+            threading.Thread(
+                target=self._grow_async, daemon=True,
+                name=f"{self._fd_name}-grow",
+            ).start()
+        elif (
+            self._idle_streak >= max(self.fdcfg.retire_windows, 1)
+            and len(live) > max(self.fdcfg.min_replicas, 1)
+        ):
+            self._scaling = True
+            self._idle_streak = 0
+            threading.Thread(
+                target=self._retire_async, daemon=True,
+                name=f"{self._fd_name}-retire",
+            ).start()
+
+    def _grow_async(self) -> None:
+        """Spawn one more replica (pre-warmed from its profile's warm
+        keys + the shippable artifact) and add it to the rotation. A
+        retired slot is reused first — indices are stable identities."""
+        try:
+            slot = next(
+                (i for i in range(len(self._procs)) if self._retired[i]), None
             )
-        obs.gauge("frontdoor.effective_max_queue", self.admission.max_queue)
+            grow_chips = max(self._chips) if self._chips else 0
+            if slot is None:
+                with self._addr_lock:
+                    slot = len(self._procs)
+                    self._chips.append(grow_chips)
+                    self._warm_keys_by_slot.append(self._profile_warm_keys(grow_chips))
+                    self._profiles.append(None)
+                    self._rings.append(deque(maxlen=max(flight.capacity(), 1)))
+                    self._health.append(None)
+                    self._restarting.append(True)
+                    self._retired.append(False)
+                    self._respawn_failures.append(0)
+                    self._respawn_not_before.append(0.0)
+                    self._addrs.append(("127.0.0.1", 0))
+                    self._gens.append(0)
+                    # _procs grows LAST: len(self._procs) is the bound
+                    # every unsynchronized reader (the supervisor loop,
+                    # live_replicas) iterates, so by the time index
+                    # `slot` is visible every sibling list already has
+                    # its entry — appending _procs first would let the
+                    # supervisor IndexError and die silently
+                    self._procs.append(None)
+                # the new slot is born DOWN: a dispatch racing this grow
+                # must not pick an endpoint that is still port 0
+                self.router.add_replica(up=False)
+            else:
+                self._restarting[slot] = True
+            try:
+                proc, port, profile = self._spawn_replica(slot)
+            except Exception:  # noqa: BLE001 — growth is best-effort
+                obs.count("frontdoor.respawn_failures", 1)
+                obs.event("frontdoor.grow_failed", replica=slot)
+                return
+            if self._stop.is_set():
+                proc.kill()
+                proc.join(timeout=5)
+                return
+            self._procs[slot] = proc
+            self._retired[slot] = False
+            self._set_endpoint(slot, port)
+            self.router.set_retired(slot, False)
+            self.router.mark_up(slot)
+            self._install_profile(slot, profile)
+            obs.count("frontdoor.replicas_grown", 1)
+            obs.event(
+                "frontdoor.replica_grown", replica=slot,
+                chips=profile.get("chips", 1),
+                signature=profile.get("signature", ""),
+            )
+        finally:
+            if slot is not None:
+                self._restarting[slot] = False
+            self._scaling = False
+            self._last_scale_t = time.monotonic()
+
+    def _retire_async(self) -> None:
+        """Retire the most recently added live replica through the SAME
+        zero-shed drain rollover a planned restart uses — router first,
+        then drain, then shutdown — minus the respawn."""
+        victim = None
+        try:
+            for i in reversed(range(len(self._procs))):
+                if not self._retired[i] and not self._restarting[i] and self._procs[i] is not None:
+                    victim = i
+                    break
+            if victim is None:
+                return
+            self._restarting[victim] = True
+            self._drain_and_stop(victim, self.fdcfg.drain_timeout_s)
+            self._retired[victim] = True
+            self.router.set_retired(victim, True)
+            self.router.set_draining(victim, False)
+            obs.count("frontdoor.replicas_retired", 1)
+            obs.event("frontdoor.replica_retired", replica=victim)
+        finally:
+            if victim is not None:
+                self._restarting[victim] = False
+            self._scaling = False
+            self._last_scale_t = time.monotonic()
 
     # --------------------------------------------------------------- admin --
 
@@ -733,6 +960,25 @@ class FrontDoor(FrontDoorClient):
         finally:
             sock.close()
 
+    def _drain_and_stop(self, i: int, timeout_s: float) -> None:
+        """The zero-shed half of a rollover, shared by planned restarts
+        and autoscaler retires: the router stops routing FIRST, the
+        replica drains its in-flight work, then shuts down cleanly
+        (killed only if it won't). Nothing is rejected along the way."""
+        self.router.set_draining(i, True)
+        try:
+            self._rpc_admin(i, {"op": "drain", "timeout_s": timeout_s}, timeout_s + 5.0)
+            self._rpc_admin(i, {"op": "shutdown"}, 5.0)
+        except BaseException:  # noqa: BLE001 — a dying replica stops the hard way
+            pass
+        proc = self._procs[i]
+        if proc is not None:
+            proc.join(timeout=10)
+            if proc.is_alive():
+                proc.kill()
+                proc.join(timeout=5)
+        self._procs[i] = None
+
     def restart_replica(self, i: int, timeout_s: float | None = None) -> None:
         """Planned zero-shed rollover: drain → shutdown → respawn (warm
         from the artifact) → rewire. Traffic routes to siblings for the
@@ -742,23 +988,13 @@ class FrontDoor(FrontDoorClient):
         obs.count("frontdoor.planned_restarts", 1)
         obs.event("frontdoor.planned_restart", replica=i)
         try:
-            self.router.set_draining(i, True)
-            try:
-                self._rpc_admin(i, {"op": "drain", "timeout_s": timeout_s}, timeout_s + 5.0)
-                self._rpc_admin(i, {"op": "shutdown"}, 5.0)
-            except BaseException:  # noqa: BLE001 — a dying replica restarts the hard way
-                pass
-            proc = self._procs[i]
-            if proc is not None:
-                proc.join(timeout=10)
-                if proc.is_alive():
-                    proc.kill()
-                    proc.join(timeout=5)
+            self._drain_and_stop(i, timeout_s)
             with self._addr_lock:
                 old_port = self._addrs[i][1]
-            proc, port = self._spawn_replica(i, port_hint=old_port)
+            proc, port, profile = self._spawn_replica(i, port_hint=old_port)
             self._procs[i] = proc
             self._set_endpoint(i, port)
+            self._install_profile(i, profile)
         finally:
             self.router.set_draining(i, False)
             self._restarting[i] = False
@@ -768,6 +1004,16 @@ class FrontDoor(FrontDoorClient):
         """Last health-probe payload per replica (pid, queue depth,
         compiles, compiles_after_ready)."""
         return list(self._health)
+
+    def replica_profiles(self) -> list[dict | None]:
+        """Each replica's ready-time mesh profile (chips, shards,
+        signature, the warm keys it replayed); None for a slot that
+        never reported (and for retired slots, the LAST profile)."""
+        return list(self._profiles)
+
+    def live_replicas(self) -> list[int]:
+        """Indices currently in rotation (not retired)."""
+        return [i for i in range(len(self._procs)) if not self._retired[i]]
 
     def export_env(self) -> dict[str, str]:
         """Env for worker processes that should route through this

@@ -202,10 +202,20 @@ def replica_main(
     warm_keys: list | None,
     fault_spec: str | None,
     port_hint: int = 0,
+    child_env: dict | None = None,
 ) -> None:
     """Entry point of a spawned replica process. Sends
-    ``("ready", pid, port, warmed)`` over ``ready_conn`` once the boot
-    warmup finished and the socket is listening."""
+    ``("ready", pid, port, warmed, profile)`` over ``ready_conn`` once
+    the boot warmup finished and the socket is listening — ``profile``
+    is the replica's mesh identity (chips/shards/signature) plus the
+    warmup keys it actually compiled, the router's warm-cache map."""
+    if child_env:
+        # the per-replica mesh slice: the parent computed these via the
+        # prejax idiom (eth_consensus_specs_tpu/prejax.py) — applied
+        # FIRST, before anything touches the XLA backend, because a
+        # spawned child inherits the parent's XLA_FLAGS and its own
+        # mesh_chips must override them, not defer
+        os.environ.update(child_env)
     if fault_spec is not None:
         # each replica's chaos schedule is ITS OWN deterministic rule
         # set (per-process hit counters; latches arbitrate across the
@@ -217,6 +227,11 @@ def replica_main(
     else:
         # readers replay the artifact at boot but never write it
         os.environ.pop("ETH_SPECS_SERVE_WARMUP", None)
+
+    # the pod-slice seam: env-gated no-op on single-host fleets
+    from eth_consensus_specs_tpu.parallel import multihost
+
+    multihost.maybe_initialize_for_replica()
 
     from .service import VerifyService  # after env: config reads it
 
@@ -243,9 +258,31 @@ def replica_main(
     except Exception:  # noqa: BLE001 — a cold boot is degraded, not dead
         obs.event("frontdoor.warmup_failed", name=name)
     server.mark_ready()
-    obs.event("frontdoor.replica_ready", name=name, port=server.port, warmed=warmed)
+    # the mesh profile the router keys on: this replica's slice identity
+    # plus the shapes its boot ACTUALLY compiled (buckets.seen_shapes is
+    # ground truth — alien-signed artifact keys were skipped, host
+    # backends never compiled their MSM shapes)
+    import jax
+
+    from eth_consensus_specs_tpu.parallel import mesh_ops
+
+    from . import buckets
+
+    mesh = mesh_ops.serve_mesh(cfg.mesh_chips or None)
+    profile = {
+        "chips": cfg.mesh_chips or len(jax.local_devices()),
+        "devices": len(jax.local_devices()),
+        "shards": mesh_ops.shard_count(mesh),
+        "signature": mesh_ops.mesh_signature(mesh),
+        "warm_keys": [list(k) for k in buckets.seen_shapes()],
+    }
+    obs.event(
+        "frontdoor.replica_ready",
+        name=name, port=server.port, warmed=warmed,
+        signature=profile["signature"], chips=profile["chips"],
+    )
     try:
-        ready_conn.send(("ready", os.getpid(), server.port, warmed))
+        ready_conn.send(("ready", os.getpid(), server.port, warmed, profile))
         ready_conn.close()
     except OSError:
         pass  # parent died during boot; serve_forever will exit on its own

@@ -1,7 +1,7 @@
-"""Failure-aware, compile-cache-affine request routing.
+"""Failure-aware, compile-cache-affine, mesh-signature-aware routing.
 
 The router answers one question — "which replica should THIS request
-go to?" — with three inputs:
+go to?" — with five inputs:
 
   * **shape affinity** — requests hash by their compile-shape key (the
     pow2 committee size for BLS, the tree depth for merkleization), so
@@ -11,14 +11,35 @@ go to?" — with three inputs:
     warmup artifact (every replica precompiled the same list at boot)
     makes the detour free anyway. ``frontdoor.route.affinity`` vs
     ``.fallback`` counters make the hit rate observable.
+  * **mesh tier** — in a heterogeneous fleet (serve/frontdoor.py spawns
+    replicas with different ``mesh_chips``), each replica carries a
+    PROFILE: its chip count and mesh signature. A request classified
+    wide (serve/buckets.route_wide — the flush it will join clears the
+    measured mesh crossover) prefers the wide tier, a toy request the
+    narrow one; ``frontdoor.route.mesh_affinity`` counts tier hits.
+    Affinity hashing then walks WITHIN the preferred tier, so each
+    shape still has one home per tier.
+  * **warm-cache map** — each replica's profile carries the (op, dim)
+    shapes its boot warmup actually compiled (derived from the
+    mesh-signed warmup keys it replayed). When any routable candidate
+    is warm for the request's shape, a cold one is never picked: the
+    fleet-wide ``compiles_after_ready == 0`` gate is a routing
+    guarantee, not luck.
   * **health** — a replica marked down (connection failure, death) is
     skipped; after ``down_cooldown_s`` one trial request may probe it
     again (half-open), so supervisor-less clients self-heal when the
-    replica respawns on its old port.
+    replica respawns on its old port. Both cooldowns are env-tunable
+    (``ETH_SPECS_SERVE_DOWN_COOLDOWN_MS`` /
+    ``ETH_SPECS_SERVE_DRAINING_TTL_S`` via serve/config.py).
   * **backoff** — a typed shed's ``retry_after_s`` (serve/admission.py)
     is recorded as a per-replica not-before: the router HONORS the
     replica's own drain estimate before sending it more work, routing
     to a sibling meanwhile.
+
+Membership is dynamic: the SLO autoscaler grows the fleet through
+:meth:`Router.add_replica` and retires idle replicas through
+:meth:`Router.set_retired` (a retired slot stays allocated — indices
+are stable identities — but is never picked until a grow reuses it).
 
 Per-replica EWMA latency is tracked from both request RPCs and health
 probes; it feeds the hedge deadline decision and the stats surface.
@@ -37,7 +58,8 @@ from eth_consensus_specs_tpu.analysis import lockwatch
 class _Replica:
     __slots__ = (
         "up", "draining", "draining_until", "not_before", "down_until",
-        "ewma_s", "failures",
+        "ewma_s", "failures", "chips", "signature", "warm", "retired",
+        "picks",
     )
 
     def __init__(self):
@@ -48,6 +70,11 @@ class _Replica:
         self.down_until = 0.0  # half-open probe gate while down
         self.ewma_s = 0.0
         self.failures = 0
+        self.chips = 1  # mesh profile: devices in this replica's slice
+        self.signature = ""  # mesh_ops.mesh_signature ("" = single-device)
+        self.warm = set()  # (op, dim) shapes its boot warmup compiled
+        self.retired = False  # autoscaler took it out of rotation
+        self.picks = 0  # requests routed here (stats surface)
 
 
 def stable_hash(key: tuple) -> int:
@@ -59,10 +86,18 @@ def stable_hash(key: tuple) -> int:
 
 
 class Router:
-    def __init__(self, n: int, *, down_cooldown_s: float = 0.5, ewma_alpha: float = 0.2):
+    def __init__(
+        self,
+        n: int,
+        *,
+        down_cooldown_s: float = 0.5,
+        draining_ttl_s: float = 5.0,
+        ewma_alpha: float = 0.2,
+    ):
         self._lock = lockwatch.wrap(threading.Lock(), "serve.router.Router._lock")
         self._reps = [_Replica() for _ in range(n)]
         self._down_cooldown_s = down_cooldown_s
+        self._draining_ttl_s = draining_ttl_s
         self._alpha = ewma_alpha
 
     def __len__(self) -> int:
@@ -70,34 +105,68 @@ class Router:
 
     # ------------------------------------------------------------- picking --
 
-    def pick(self, shape_key: tuple, exclude: set | frozenset = frozenset()) -> int | None:
+    def pick(
+        self,
+        shape_key: tuple,
+        exclude: set | frozenset = frozenset(),
+        wide: bool | None = None,
+    ) -> int | None:
         """The replica index for this shape, or None when nothing is
-        routable. Walks outward from the shape's home replica."""
+        routable. Walks outward from the shape's home replica, filtered
+        by the warm-cache map (never a cold replica while a warm sibling
+        is routable) and biased to the request's mesh tier (``wide``):
+        big flushes onto mesh-sliced replicas, toy flushes onto narrow
+        ones. With no profiles set (homogeneous fleet, no warm info)
+        both filters are vacuous and this is exactly the original
+        affinity ring walk."""
         n = len(self._reps)
         if n == 0:
             return None
         home = stable_hash(shape_key) % n
         now = time.monotonic()
         with self._lock:
+            ring = []  # (ring position, idx, rep) of every routable candidate
             for k in range(n):
                 idx = (home + k) % n
                 if idx in exclude:
                     continue
                 rep = self._reps[idx]
+                if rep.retired:
+                    continue
                 if rep.draining or rep.draining_until > now or rep.not_before > now:
                     continue
-                if not rep.up:
-                    if rep.down_until > now:
-                        continue
-                    # half-open: one trial may go through; push the next
-                    # trial out a cooldown so a dead replica isn't hammered
-                    rep.down_until = now + self._down_cooldown_s
-                obs.count(
-                    "frontdoor.route.affinity" if k == 0 else "frontdoor.route.fallback",
-                    1,
-                )
-                return idx
-        return None
+                if not rep.up and rep.down_until > now:
+                    continue
+                ring.append((k, idx, rep))
+            if not ring:
+                return None
+            # warm-cache map: while ANY routable candidate has this
+            # shape compiled, one that would cold-compile it is never
+            # picked (the fleet-wide compiles_after_ready == 0 gate)
+            cands = [c for c in ring if shape_key in c[2].warm] or ring
+            # mesh tier: wide requests prefer mesh-sliced replicas, toy
+            # requests narrow ones — only meaningful (and only counted)
+            # when the routable fleet actually HAS two tiers; an empty
+            # preferred tier falls back
+            hetero = len({c[2].chips > 1 for c in ring}) > 1
+            if wide is not None and hetero:
+                cands = [c for c in cands if (c[2].chips > 1) == wide] or cands
+            k, idx, rep = cands[0]
+            if not rep.up:
+                # half-open: one trial may go through; push the next
+                # trial out a cooldown so a dead replica isn't hammered
+                rep.down_until = now + self._down_cooldown_s
+            rep.picks += 1
+            tier_hit = wide is not None and hetero and (rep.chips > 1) == wide
+            warm_hit = shape_key in rep.warm
+        obs.count(
+            "frontdoor.route.affinity" if k == 0 else "frontdoor.route.fallback", 1
+        )
+        if tier_hit:
+            obs.count("frontdoor.route.mesh_affinity", 1)
+        if warm_hit:
+            obs.count("frontdoor.route.warm", 1)
+        return idx
 
     def backoff_remaining_s(self) -> float:
         """Seconds until the soonest backing-off UP replica frees, 0.0
@@ -110,6 +179,54 @@ class Router:
                 if rep.up and not rep.draining and rep.not_before > now
             ]
         return min(waits) if waits else 0.0
+
+    # -------------------------------------------------- fleet membership --
+
+    def set_profile(
+        self, idx: int, chips: int = 1, signature: str = "",
+        warm_keys: list | tuple = (),
+    ) -> None:
+        """Install a replica's mesh profile: chip count, mesh signature,
+        and the warm-cache map derived from the warmup keys its boot
+        actually replayed (serve/buckets.route_shape_of_key maps each
+        compiled key to the (op, dim) shape it warms)."""
+        from . import buckets
+
+        warm = set()
+        for key in warm_keys:
+            shape = buckets.route_shape_of_key(tuple(key))
+            if shape is not None:
+                warm.add(shape)
+        with self._lock:
+            rep = self._reps[idx]
+            rep.chips = max(int(chips), 1)
+            rep.signature = signature
+            rep.warm = warm
+
+    def add_replica(self, up: bool = True) -> int:
+        """Grow the fleet by one slot (the SLO autoscaler's grow path).
+        ``up=False`` births the slot down with the supervisor owning
+        recovery — the grower calls :meth:`mark_up` once the replica is
+        actually listening, so no request can route to a half-born
+        endpoint."""
+        with self._lock:
+            rep = _Replica()
+            if not up:
+                rep.up = False
+                rep.down_until = float("inf")
+            self._reps.append(rep)
+            return len(self._reps) - 1
+
+    def set_retired(self, idx: int, retired: bool = True) -> None:
+        """Take a replica out of rotation permanently-until-regrown (the
+        autoscaler's retire path): the slot keeps its index — identities
+        stay stable — but pick() never returns it."""
+        with self._lock:
+            self._reps[idx].retired = retired
+
+    def live_indices(self) -> list[int]:
+        with self._lock:
+            return [i for i, rep in enumerate(self._reps) if not rep.retired]
 
     # ----------------------------------------------------------- feedback --
 
@@ -167,11 +284,13 @@ class Router:
             if not draining:
                 self._reps[idx].draining_until = 0.0
 
-    def note_draining(self, idx: int, ttl_s: float = 5.0) -> None:
+    def note_draining(self, idx: int, ttl_s: float | None = None) -> None:
         """A ``draining`` REPLY observed by a supervisor-less client:
         expires on its own — the rollover finishes without anyone to
         clear a sticky flag, and the replica must not be blackholed
-        forever."""
+        forever. The default TTL is the router's configured
+        ``draining_ttl_s`` (``ETH_SPECS_SERVE_DRAINING_TTL_S``)."""
+        ttl_s = self._draining_ttl_s if ttl_s is None else ttl_s
         with self._lock:
             self._reps[idx].draining_until = time.monotonic() + ttl_s
 
@@ -188,9 +307,14 @@ class Router:
                 {
                     "up": rep.up,
                     "draining": rep.draining,
+                    "retired": rep.retired,
                     "backoff_s": round(max(rep.not_before - now, 0.0), 4),
                     "ewma_ms": round(rep.ewma_s * 1e3, 3),
                     "failures": rep.failures,
+                    "chips": rep.chips,
+                    "signature": rep.signature,
+                    "warm_shapes": len(rep.warm),
+                    "picks": rep.picks,
                 }
                 for rep in self._reps
             ]

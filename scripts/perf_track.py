@@ -128,6 +128,15 @@ def load_rounds(repo_dir: str) -> list[dict]:
                 name.endswith("_scaling") or name == "chip_scaling"
             ):
                 metrics[f"mesh_{name}"] = value
+        # two-tier fleet matrix (serve_bench --replicas R --chips-matrix):
+        # per-cell rps and per-effective-chip scaling factors, platform-
+        # keyed like the mesh factors — secondaries, so regressions are
+        # advisories (a cpu cell never gates an accelerator round)
+        for name, value in (parsed.get("fleet") or {}).items():
+            if isinstance(value, (int, float)) and (
+                name.endswith("_scaling") or name.endswith("_rps")
+            ):
+                metrics[f"fleet_{name}"] = value
         entry.update(
             status="ok",
             platform=infer_platform(parsed),

@@ -3,10 +3,11 @@
 ``serve_bench.py`` and ``jaxlint.py`` both need N virtual CPU devices,
 and XLA reads ``XLA_FLAGS`` exactly once — at backend init — so the
 ``--chips`` pre-parse must run BEFORE the first jax-touching import.
-Two argv pre-parsers had already drifted (one honored
-``ETH_SPECS_SERVE_CHIPS``, the other forced flags off-platform); this
-module is the single copy. It deliberately imports nothing heavy: the
-package ``__init__`` pulls in jax, so this must stay importable first.
+The implementation lives in ``eth_consensus_specs_tpu/prejax.py`` (the
+replica child boot shares it for its per-replica spawn env), loaded
+here BY FILE PATH so the package ``__init__`` (which pulls in jax)
+never executes before the flags are set. Both modules deliberately
+import nothing heavy.
 
 Usage (from a script in scripts/):
 
@@ -18,47 +19,21 @@ Usage (from a script in scripts/):
 
 from __future__ import annotations
 
+import importlib.util
 import os
-import sys
 
+_IMPL = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    "eth_consensus_specs_tpu",
+    "prejax.py",
+)
+_spec = importlib.util.spec_from_file_location("_prejax_impl", _IMPL)
+_impl = importlib.util.module_from_spec(_spec)
+_spec.loader.exec_module(_impl)
 
-def force_virtual_chips(
-    default: int = 0, env_var: str | None = "ETH_SPECS_SERVE_CHIPS"
-) -> int:
-    """Pre-parse ``--chips N`` from argv (falling back to ``env_var``,
-    then ``default``) and force that many virtual CPU devices via
-    ``XLA_FLAGS`` — only on the cpu platform, only when the flag is not
-    already set, and only for N > 1. Defaults ``JAX_PLATFORMS`` to cpu
-    (real-accelerator hosts override it and are left alone). Returns
-    the resolved chip count."""
-    n = 0
-    argv = sys.argv
-    for i, a in enumerate(argv):
-        if a == "--chips" and i + 1 < len(argv):
-            try:
-                n = int(argv[i + 1])
-            except ValueError:
-                pass
-        elif a.startswith("--chips="):
-            try:
-                n = int(a.split("=", 1)[1])
-            except ValueError:
-                pass
-    if n <= 0 and env_var:
-        try:
-            n = int(os.environ.get(env_var, "0") or 0)
-        except ValueError:
-            n = 0
-    if n <= 0:
-        n = default
-    os.environ.setdefault("JAX_PLATFORMS", "cpu")
-    flags = os.environ.get("XLA_FLAGS", "")
-    if (
-        n > 1
-        and os.environ.get("JAX_PLATFORMS") == "cpu"
-        and "xla_force_host_platform_device_count" not in flags
-    ):
-        os.environ["XLA_FLAGS"] = (
-            flags + f" --xla_force_host_platform_device_count={n}"
-        ).strip()
-    return n
+chips_xla_flags = _impl.chips_xla_flags
+force_virtual_chips = _impl.force_virtual_chips
+parse_chips = _impl.parse_chips
+parse_chips_matrix = _impl.parse_chips_matrix
+parse_replicas = _impl.parse_replicas
+replica_chips_env = _impl.replica_chips_env

@@ -54,6 +54,33 @@ distributed-systems contract instead of the batching contract:
 ``--warmup-out`` writes the shippable warmup artifact (every compiled
 shape key) for CI to upload; replicated runs also boot FROM it.
 
+Fleet-matrix mode (``--replicas R --chips-matrix 1,8``, the fleet-smoke
+CI job): the two-tier scale-out surface measured as a replicas×chips
+grid. Every cell (r, c) boots a homogeneous fleet of r replicas × c
+virtual chips each and runs the same closed-loop big-tree load;
+throughput is measured interleaved against a live 1×1 base fleet (the
+PR 11 noisy-neighbor lesson: pair the two measurements inside ONE
+noise window, alternate their order each round, and gate on the MEDIAN
+within-round ratio — a best base wall from a quiet window must never
+divide a cell wall from a throttled one) with every replica boot
+blocked on — and all replicas probe-confirmed — BEFORE the timer
+starts. Gates per cell: byte parity with the parent's direct ops calls
+(a cell that fails parity REFUSES to report throughput at all) and
+``compiles_after_ready == 0`` on every replica; across cells, the BEST
+wide (c > 1) per-effective-chip scaling must clear ``--scaling-min``
+(run_mesh's best-of-sections discipline — per-cell factors are all
+reported so a host's oversubscription cliff stays visible), where
+effective chips = min(r*c, cores - 1) on the virtual CPU mesh (the
+closed-loop client burns a core) and r*c on accelerators. A final HETEROGENEOUS
+phase boots the mixed fleet (chips cycled from the matrix), routes a
+mixed toy/big/bls load through the signature-aware router, SIGKILLs one
+replica mid-load (``--chaos``), and drives the SLO autoscaler through a
+forced breach and an idle window — gating zero lost requests, parity,
+zero cold compiles fleet-wide (respawned replacement included), p99
+within the DEFAULT SLO, and the autoscaler observably growing AND
+retiring a replica. The report's ``fleet`` section feeds perf_track.py
+as platform-aware secondary metrics.
+
 Mesh mode (``--chips N``, the mesh-smoke CI job): forces N virtual CPU
 devices (``--xla_force_host_platform_device_count``; real devices on
 accelerators), then measures every hot kernel chips=1 vs chips=N in one
@@ -387,6 +414,408 @@ def run_replicated(args) -> None:
     finish_report(report, failures, args.out, "serve_bench.replicated_failure", snap)
 
 
+def _fleet_ready(fd, replicas: int, timeout_s: float = 30.0) -> bool:
+    """Block until every replica of the fleet has answered a health
+    probe — the 'async setup blocked on before the timer starts' bench
+    discipline: FrontDoor.__init__ already joins the boot threads, this
+    additionally proves the supervision loop sees every replica alive."""
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        if sum(1 for s in fd.replica_stats() if s is not None) >= replicas:
+            return True
+        time.sleep(fd.fdcfg.probe_interval_s)
+    return False
+
+
+def run_fleet_matrix(args) -> None:
+    """The --chips-matrix mode: the replicas×chips scaling grid plus the
+    heterogeneous chaos/autoscale phase (module docstring, fleet-matrix
+    mode)."""
+    from eth_consensus_specs_tpu.obs import slo as slo_mod
+    from eth_consensus_specs_tpu.serve.config import FrontDoorConfig
+    from eth_consensus_specs_tpu.serve.frontdoor import FrontDoor
+
+    out_dir = os.path.dirname(os.path.abspath(args.out)) or "."
+    pm_dir = os.environ.get("ETH_SPECS_OBS_POSTMORTEM_DIR")
+    if not pm_dir:
+        pm_dir = os.path.join(out_dir, "postmortems")
+        os.environ["ETH_SPECS_OBS_POSTMORTEM_DIR"] = pm_dir
+    warmup_path = args.warmup_out or os.path.join(out_dir, "fleet_warmup.jsonl")
+    export.maybe_serve_http()
+
+    matrix = tuple(args.chips_matrix) or (1,)
+    R = max(args.replicas, 1)
+    reps_list = sorted({1, R}) if args.smoke else list(range(1, R + 1))
+    chips_vals = sorted(set(matrix))
+    cores = os.cpu_count() or 1
+    import jax
+
+    platform = jax.local_devices()[0].platform
+    n_rounds = 5  # odd: the gate reads the MEDIAN paired round ratio
+
+    # small bucket set bounds the per-replica warm compile count; the
+    # WIDE depths clear the mesh crossover at any flush >= min-items so
+    # the wide cells genuinely shard (and route_wide classifies them
+    # wide) — depth 9 would be RPC/prep-bound on 2 cores and show no
+    # mesh advantage at all (measured: 1.05x vs 1.8x at depth 11).
+    # TWO wide depths, not one: shape affinity sends one shape to ONE
+    # home replica, so a single-shape load would leave every sibling of
+    # a multi-replica cell idle by design
+    cfg = ServeConfig.from_env(
+        max_batch=min(max(args.submitters // 2, 2), 8), buckets=(1, 4, 8)
+    )
+    # depth 11/12 trees: device-dominant even through the socket path
+    # (measured: depth 9/10 loads are RPC/prep-bound on 2 cores and the
+    # 1.8x kernel-level mesh win disappears end-to-end)
+    wide_depths = (11, 12)
+    toy_depth = min(args.tree_depth, 6)
+    big_trees, direct_big = [], []
+    for j, d in enumerate(wide_depths):
+        per = build_trees(args.requests // len(wide_depths), d, seed=3 + j)
+        big_trees += [(t, d) for t in per]
+        direct_big += [merkleize_subtree_device(t, d) for t in per]
+    load_big = [("htr", t) for t, _ in big_trees]
+    warm = [("merkle_many", b, d) for d in wide_depths for b in cfg.buckets]
+
+    failures: list = []
+    cells: list = []
+    fleet_metrics: dict = {}
+
+    # the interleave partner: one 1-replica×1-chip fleet, alive for the
+    # whole matrix, re-measured inside every cell's window
+    base_fd = FrontDoor(
+        replicas=1, chips=[1], config=cfg,
+        fd_config=FrontDoorConfig.from_env(slo_shedding=False),
+        warmup_path=warmup_path, warm_keys=warm, name="fleet-base",
+    )
+    if not _fleet_ready(base_fd, 1):
+        failures.append("base fleet never confirmed ready")
+
+    def _measure_cell(r: int, c: int) -> dict:
+        # effective chips on cpu: the closed-loop client + supervisor
+        # burn roughly ONE core end-to-end (unlike the in-process mesh
+        # bench, where min(chips, cores) is the whole story), so the
+        # fleet's replicas share cores-1 — measured on the 2-core box:
+        # a 4-virtual-chip replica shows its 1.8x kernel-level mesh win
+        # as ~0.8-1.1x through the socket path because it never sees a
+        # second core. Accelerator fleets keep effective = r*c.
+        cell = {"replicas": r, "chips": c, "effective":
+                min(r * c, max(cores - 1, 1)) if platform == "cpu" else r * c}
+        if (r, c) == (1, 1):
+            fd = base_fd
+        else:
+            fd = FrontDoor(
+                replicas=r, chips=[c] * r, config=cfg,
+                fd_config=FrontDoorConfig.from_env(slo_shedding=False),
+                warmup_path=None, warm_keys=warm, name=f"fleet-r{r}x{c}",
+            )
+        try:
+            if not _fleet_ready(fd, r):
+                cell["ready"] = False
+                failures.append(f"cell ({r},{c}): fleet never confirmed ready")
+                return cell
+            # untimed warm pass: client connections, first flush shapes
+            _, got, _ = closed_loop(fd, load_big, args.submitters)
+            parity = got == direct_big
+            ratios, best_cell, best_base = [], None, None
+            for k in range(n_rounds):
+                # one round = one paired A/B inside one noise window:
+                # the host is shares-throttled, so comparing a best base
+                # wall from a quiet window against a cell wall from a
+                # throttled one would be fiction — only the WITHIN-round
+                # ratio is honest, and the order alternates so a
+                # decaying noisy neighbor can't favor one side
+                order = [("base", base_fd), ("cell", fd)]
+                if k % 2:
+                    order.reverse()
+                walls = {}
+                for side, target in order:
+                    w, got_s, _ = closed_loop(target, load_big, args.submitters)
+                    parity = parity and got_s == direct_big
+                    walls[side] = w
+                if not parity:
+                    break
+                ratios.append(walls["base"] / walls["cell"])
+                best_base = (
+                    walls["base"] if best_base is None
+                    else min(best_base, walls["base"])
+                )
+                best_cell = (
+                    walls["cell"] if best_cell is None
+                    else min(best_cell, walls["cell"])
+                )
+            cell["parity"] = parity
+            if not parity:
+                # a cell that failed parity reports NO throughput: a
+                # wrong-answer cell must never look like a fast cell
+                failures.append(f"cell ({r},{c}): byte parity FAILED")
+                return cell
+            time.sleep(max(fd.fdcfg.probe_interval_s * 3, 0.5))
+            cold = {
+                i: s["compiles_after_ready"]
+                for i, s in enumerate(fd.replica_stats())
+                if s is not None and s.get("compiles_after_ready")
+            }
+            if cold:
+                failures.append(f"cell ({r},{c}): cold compiles {cold}")
+            cell["cold_compiles"] = sum(cold.values())
+            speedup = sorted(ratios)[len(ratios) // 2]  # median round ratio
+            cell.update(
+                rps=round(len(load_big) / best_cell, 2),
+                base_rps=round(len(load_big) / best_base, 2),
+                round_ratios=[round(x, 3) for x in ratios],
+                speedup=round(speedup, 3),
+                scaling_factor=round(speedup / cell["effective"], 3),
+            )
+            fleet_metrics[f"r{r}x{c}_rps"] = cell["rps"]
+            fleet_metrics[f"r{r}x{c}_scaling"] = cell["scaling_factor"]
+            return cell
+        finally:
+            if fd is not base_fd:
+                fd.close()
+
+    for r in reps_list:
+        for c in chips_vals:
+            cells.append(_measure_cell(r, c))
+    base_fd.close()
+
+    het = _run_het_phase(
+        args, cfg, matrix, R, warm, warmup_path, pm_dir, wide_depths[0], toy_depth,
+        failures, slo_mod, FrontDoorConfig, FrontDoor,
+    )
+    snap = obs.snapshot()
+    counters = snap["counters"]
+    if snap["watchdog"]["divergences"] != 0:
+        failures.append(f"watchdog divergences: {snap['watchdog']}")
+    fleet_metrics["grown"] = counters.get("frontdoor.replicas_grown", 0)
+    fleet_metrics["retired"] = counters.get("frontdoor.replicas_retired", 0)
+    # the wide-cell scaling gate reads the BEST wide cell — the same
+    # discipline run_mesh applies across its sections: on a 2-core box
+    # an 8-virtual-device replica sits past the oversubscription cliff
+    # (measured (1,8) ~0.44 while (2,8) clears 0.97), and the grid's
+    # job is to RECORD that cliff per cell, not to pretend a throttled
+    # host refutes the mesh. Parity and cold-compile gates still apply
+    # to every cell individually.
+    wide_factors = [
+        c["scaling_factor"] for c in cells
+        if c.get("chips", 1) > 1 and "scaling_factor" in c
+    ]
+    if wide_factors:
+        fleet_metrics["wide_scaling"] = max(wide_factors)
+        if max(wide_factors) < args.scaling_min:
+            failures.append(
+                f"best wide-cell per-effective-chip scaling "
+                f"{max(wide_factors)} < {args.scaling_min} "
+                f"(all wide cells: {wide_factors})"
+            )
+    elif any(c > 1 for c in chips_vals):
+        failures.append("no wide cell produced a scaling factor")
+
+    report = {
+        "mode": "fleet-matrix-smoke" if args.smoke else "fleet-matrix",
+        "platform": platform,
+        "requests": args.requests,
+        "submitters": args.submitters,
+        "replicas": R,
+        "chips_matrix": list(matrix),
+        "interleaved_rounds": n_rounds,
+        "cells": cells,
+        "het": het,
+        "fleet": fleet_metrics,
+        "scaling_min": args.scaling_min,
+        "warmup_artifact": warmup_path,
+        "warmup_keys": len(serve_buckets.load_warmup(warmup_path)),
+    }
+    finish_report(report, failures, args.out, "serve_bench.fleet_failure", snap)
+
+
+def _run_het_phase(
+    args, cfg, matrix, R, warm, warmup_path, pm_dir, wide_depth, toy_depth,
+    failures, slo_mod, FrontDoorConfig, FrontDoor,
+) -> dict:
+    """The heterogeneous chaos/autoscale phase: mixed tiers in one
+    fleet, signature-aware routing under a mid-load SIGKILL, then the
+    SLO autoscaler driven through one grow (forced breach) and one
+    retire (idle)."""
+    het_chips = [matrix[i % len(matrix)] for i in range(R)]
+    fault_spec = None
+    if args.chaos:
+        nth = max(args.requests // 8, 2)
+        latch = os.path.join(os.path.dirname(warmup_path) or ".",
+                             f"fleet_kill_{os.getpid()}.latch")
+        if os.path.exists(latch):
+            os.unlink(latch)
+        fault_spec = f"frontdoor.rpc:kill:nth={nth}:latch={latch}"
+    fd_cfg = FrontDoorConfig.from_env(
+        probe_interval_ms=120.0,
+        autoscale=True,
+        min_replicas=R,
+        max_replicas=R + 1,
+        grow_windows=2,
+        retire_windows=4,
+        scale_cooldown_s=1.0,
+    )
+    # every tier's warm keys: toy + wide merkle depths, plus the bls_msm
+    # shapes (device backends; precompile skips them on host bls)
+    warm_het = warm + [("merkle_many", b, toy_depth) for b in cfg.buckets] + [
+        ("bls_msm", b, serve_buckets.pow2_bucket(args.committee))
+        for b in cfg.buckets
+    ]
+    n_each = max(args.requests // 4, 8)
+    toy_trees = build_trees(n_each, toy_depth, seed=5)
+    big_trees = build_trees(n_each, wide_depth, seed=7)
+    bls_items = build_bls_items(n_each, args.committee, distinct_msgs=2)
+    direct = (
+        [merkleize_subtree_device(t, toy_depth) for t in toy_trees]
+        + [merkleize_subtree_device(t, wide_depth) for t in big_trees]
+        + [bls_batch.batch_verify_aggregates([it]) for it in bls_items]
+    )
+    load = (
+        [("htr", t) for t in toy_trees]
+        + [("htr", t) for t in big_trees]
+        + [("bls", it) for it in bls_items]
+    )
+
+    from eth_consensus_specs_tpu.obs.delta import DeltaShipper
+
+    old_bound = os.environ.get("ETH_SPECS_SLO_WAIT_P99_MS")
+    fd = FrontDoor(
+        replicas=R, chips=het_chips, config=cfg, fd_config=fd_cfg,
+        warmup_path=warmup_path, warm_keys=warm_het,
+        replica_fault_spec=fault_spec, name="fleet-het",
+    )
+    try:
+        if not _fleet_ready(fd, R):
+            failures.append("het fleet never confirmed ready")
+        # the CHAOS window: the SIGKILL load runs under the DEFAULT SLO
+        # bounds and is the window the p99 gate reads — the deliberate
+        # breach that drives the autoscaler comes AFTER, in its own
+        # phase, so "p99 held under the kill" is not polluted by "we
+        # then overloaded it on purpose" (nor by the matrix cells)
+        chaos_ship = DeltaShipper()
+        wall_s, got, _ = closed_loop(fd, load, args.submitters)
+        time.sleep(max(fd_cfg.probe_interval_s * 3, 0.5))  # ship the last deltas
+        chaos_window = chaos_ship.delta()
+
+        def _counter(name):
+            return obs.snapshot()["counters"].get(name, 0)
+
+        # autoscale demo, actuator 1 of 2 (grow): force the breach —
+        # ANY observed wait violates a 0.001ms p99 objective
+        os.environ["ETH_SPECS_SLO_WAIT_P99_MS"] = "0.001"
+        deadline = time.monotonic() + 60
+        while _counter("frontdoor.replicas_grown") < 1 and time.monotonic() < deadline:
+            try:
+                # keep breach windows flowing while the grow boots
+                fd.submit_hash_tree_root(toy_trees[0]).result(timeout=30)
+            except serve.Overloaded as exc:
+                time.sleep(exc.retry_after_s)  # the shed actuator is live too
+            time.sleep(fd_cfg.probe_interval_s)
+        if old_bound is None:
+            os.environ.pop("ETH_SPECS_SLO_WAIT_P99_MS", None)
+        else:
+            os.environ["ETH_SPECS_SLO_WAIT_P99_MS"] = old_bound
+        # actuator 2 of 2 (retire): sustained idle
+        deadline = time.monotonic() + 60
+        while _counter("frontdoor.replicas_retired") < 1 and time.monotonic() < deadline:
+            time.sleep(fd_cfg.probe_interval_s)  # idle: no traffic at all
+        time.sleep(max(fd_cfg.probe_interval_s * 3, 0.5))
+        replica_stats = fd.replica_stats()
+        profiles = fd.replica_profiles()
+        stats = fd.stats()
+    finally:
+        if old_bound is None:
+            os.environ.pop("ETH_SPECS_SLO_WAIT_P99_MS", None)
+        else:
+            os.environ["ETH_SPECS_SLO_WAIT_P99_MS"] = old_bound
+        fd.close()
+
+    lost = sum(1 for x in got if x is _LOST)
+    if lost:
+        failures.append(f"het: {lost} requests lost")
+    if got != direct:
+        failures.append("het: byte parity FAILED vs direct ops results")
+    snap = obs.snapshot()
+    counters = snap["counters"]
+    grown = counters.get("frontdoor.replicas_grown", 0)
+    retired = counters.get("frontdoor.replicas_retired", 0)
+    if grown < 1:
+        failures.append("autoscaler never grew a replica (forced breach)")
+    if retired < 1:
+        failures.append("autoscaler never retired a replica (idle window)")
+    if counters.get("frontdoor.route.mesh_affinity", 0) < 1:
+        failures.append("het: no mesh-tier affinity hits recorded")
+    replaced = counters.get("frontdoor.replicas_replaced", 0)
+    if args.chaos and replaced < 1:
+        failures.append("het chaos: the SIGKILL never happened or never healed")
+    if args.chaos and counters.get("frontdoor.degraded_to_host", 0):
+        failures.append("het chaos: host-oracle degrades (fleet didn't absorb)")
+    cold = {
+        i: s["compiles_after_ready"]
+        for i, s in enumerate(replica_stats)
+        if s is not None and s.get("compiles_after_ready")
+    }
+    if cold:
+        failures.append(f"het: cold compiles after ready: {cold}")
+    # respawned/grown replicas replay ONLY their own mesh's keys
+    for i, p in enumerate(profiles):
+        if not p:
+            continue
+        own = p.get("signature", "")
+        alien = [
+            k for k in p.get("warm_keys") or []
+            if any(isinstance(d, str) for d in k[1:])
+            and not any(d == own for d in k[1:] if isinstance(d, str))
+        ]
+        if alien:
+            failures.append(f"het: replica {i} warmed alien-signed keys {alien[:3]}")
+    # p99 under the DEFAULT SLO bounds over the CHAOS window's merged
+    # cross-process histogram (replica deltas folded in via probes);
+    # window quantiles come from the bucket deltas — the snapshot's
+    # derived p50/p99 fields are run-global and would smear the cells
+    # and the deliberate-breach phase into the kill window
+    from eth_consensus_specs_tpu.obs.histogram import Histogram
+
+    wait_hist = dict(chaos_window["histograms"].get("serve.wait_ms", {}))
+    if not wait_hist.get("count"):
+        failures.append("het: merged serve.wait_ms histogram is empty for the "
+                        "chaos window — replica telemetry never reached the parent")
+    else:
+        h = Histogram.from_snapshot(wait_hist)
+        wait_hist["p50"] = round(h.quantile(0.5), 3)
+        wait_hist["p99"] = round(h.quantile(0.99), 3)
+    slo_results = slo_mod.evaluate(
+        {"counters": chaos_window["counters"],
+         "histograms": chaos_window["histograms"]}
+    )
+    for r_ in slo_results:
+        if not r_.ok:
+            failures.append(
+                f"chaos-window SLO {r_.name}: observed {r_.observed} > "
+                f"bound {r_.bound} ({r_.detail})"
+            )
+    return {
+        "chips": het_chips,
+        "requests": len(load),
+        "rps": round(len(load) / wall_s, 2),
+        "lost": lost,
+        "replicas_grown": grown,
+        "replicas_retired": retired,
+        "replicas_replaced": replaced,
+        "route_affinity": counters.get("frontdoor.route.affinity", 0),
+        "route_mesh_affinity": counters.get("frontdoor.route.mesh_affinity", 0),
+        "route_warm": counters.get("frontdoor.route.warm", 0),
+        "replica_stats": replica_stats,
+        "router": stats["replicas"],
+        "wait_ms": {
+            "samples": wait_hist.get("count", 0),
+            "p50": wait_hist.get("p50"),
+            "p99": wait_hist.get("p99"),
+        },
+        "slo": slo_mod.report(slo_results),
+    }
+
+
 def _timed_reps(fn, reps: int) -> float:
     """Median-free simple wall: one warm call (pays any compile), then
     `reps` timed calls; returns seconds per call."""
@@ -677,6 +1106,12 @@ def main() -> None:
                     default=int(os.environ.get("ETH_SPECS_SERVE_CHIPS", "0") or 0),
                     help="mesh mode: gate chips=1 -> N scaling (virtual CPU "
                          "devices locally, real devices on accelerators)")
+    ap.add_argument("--chips-matrix", type=lambda s: tuple(
+                        int(x) for x in s.split(",") if x.strip()),
+                    default=(),
+                    help="with --replicas: the fleet-matrix mode — "
+                         "replicas×chips scaling grid plus the heterogeneous "
+                         "chaos/autoscale phase (chips cycle, e.g. 1,8)")
     ap.add_argument("--scaling-min", type=float,
                     default=float(os.environ.get("ETH_SPECS_MESH_SCALING_MIN", "0.7")
                                   or 0.7),
@@ -689,6 +1124,11 @@ def main() -> None:
         args.submitters = min(args.submitters, 16)
         args.requests = min(args.requests, 64)
         args.tree_depth = min(args.tree_depth, 6)
+    if args.replicas > 0 and args.chips_matrix:
+        if args.smoke:
+            args.requests = min(args.requests, 48)
+        run_fleet_matrix(args)
+        return
     if args.chips > 1:
         run_mesh(args)
         return

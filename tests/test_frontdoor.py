@@ -367,13 +367,13 @@ def test_slo_breach_shrinks_admission_and_recovers(shared_fd, monkeypatch):
     fd._slo_shipper.delta()  # start a fresh window
     for _ in range(20):
         obs.observe("serve.wait_ms", 50.0)  # way past the 5ms objective
-    fd._slo_step()
+    fd._slo_step(shed=True)  # hand-driven: config shedding stays off
     shrunk = fd.admission.max_queue
     assert shrunk == base // 2
     assert _counter("frontdoor.slo_sheds") >= 1
     # clean windows: additive recovery back to the configured ceiling
     for _ in range(30):
-        fd._slo_step()
+        fd._slo_step(shed=True)
         if fd.admission.max_queue == base:
             break
     assert fd.admission.max_queue == base
