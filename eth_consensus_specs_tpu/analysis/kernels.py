@@ -523,6 +523,82 @@ def _bls_msm_key_grid(mesh):
     return out
 
 
+def _g2_agg_args(items: int, lanes: int):
+    from eth_consensus_specs_tpu.ops import lazy_limbs as lz
+
+    return tuple([_sds((items, lanes, 2, lz.N_LIMBS), "uint64")] * 3)
+
+
+def _g2_agg_domains() -> tuple:
+    from eth_consensus_specs_tpu.crypto.fields import P
+    from eth_consensus_specs_tpu.ops import lazy_limbs as lz
+
+    # REDUNDANT [0, 2p): host conversion feeds canonical (< p) limbs,
+    # but the butterfly scan's canonical carry is < 2p and the declared
+    # domain must cover what actually crosses the boundary
+    return tuple(
+        mont_domain(
+            f"G2 Jacobian {c}: Montgomery Fq2 in [0, 2p) limb-wise",
+            P, lz.LIMB_BITS, lz.N_LIMBS,
+        )
+        for c in ("X", "Y", "Z")
+    )
+
+
+def _g2_agg_variants(mesh):
+    from eth_consensus_specs_tpu.ops import g2_aggregate as ga
+    from eth_consensus_specs_tpu.serve import buckets
+
+    doms = _g2_agg_domains()
+    out = [
+        Variant("single", ga.g2_sum_many_kernel, _g2_agg_args(2, 4), domains=doms)
+    ]
+    if mesh is not None:
+        from eth_consensus_specs_tpu.parallel import mesh_ops
+
+        lanes = buckets.agg_lane_bucket(4, mesh_ops.shard_count(mesh))
+        out.append(
+            Variant(
+                "mesh",
+                ga._sharded_fn(mesh),
+                _g2_agg_args(2, lanes),
+                mesh=mesh,
+                domains=doms,
+            )
+        )
+    return out
+
+
+def _g2_agg_key_grid(mesh):
+    """LIVE serve key fn (buckets.g2_agg_key) over the committee grid
+    vs the g2_many_sum_shape padded avals the dispatch compiles under
+    (the lane axis is the mesh-sharded one here)."""
+    from eth_consensus_specs_tpu.ops.g2_aggregate import g2_many_sum_shape
+    from eth_consensus_specs_tpu.parallel import mesh_ops
+    from eth_consensus_specs_tpu.serve import buckets
+
+    out = []
+    for m in (None, mesh) if mesh is not None else (None,):
+        shards = mesh_ops.shard_count(m)
+        for items in (1, 2, 3, 5, 9, 16, 33):
+            for lanes in (1, 3, 8, 64, 100):
+                key = buckets.g2_agg_key(items, lanes, mesh=m)
+                item_pad, lane_pad = g2_many_sum_shape(items, lanes, shards)
+                sig = (
+                    _canon_args(_g2_agg_args(item_pad, lane_pad)),
+                    mesh_ops.mesh_signature(m),
+                )
+                out.append((key, sig))
+                # profile-form agreement (see _merkle_many_key_grid)
+                out.append((
+                    buckets.g2_agg_key_from_profile(
+                        items, lanes, shards, mesh_ops.mesh_signature(m)
+                    ),
+                    sig,
+                ))
+    return out
+
+
 def _pairing_domains() -> tuple:
     from eth_consensus_specs_tpu.crypto.fields import P
     from eth_consensus_specs_tpu.ops import lazy_limbs as lz
@@ -811,6 +887,17 @@ REGISTRY: tuple[KernelSpec, ...] = (
         wraps=limb_borrow_wraps("field_limbs.py", _MASK30),
         build_variants=_bls_msm_variants,
         key_grid=_bls_msm_key_grid,
+    ),
+    KernelSpec(
+        name="g2_aggregate",
+        help="batched ragged-committee G2 signature sums (the aggregation "
+        "pipeline seam), mesh lane-axis sharded",
+        dtypes=_LIMB_DTYPES,
+        donation_waiver="committee lanes (I,L,2,15)x3 vs per-item Jacobian "
+        "points (I,2,15)x3 — shapes never alias",
+        wraps=lazy_lend_wraps(),
+        build_variants=_g2_agg_variants,
+        key_grid=_g2_agg_key_grid,
     ),
     KernelSpec(
         name="pairing",

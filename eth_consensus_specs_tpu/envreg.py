@@ -176,6 +176,16 @@ ENV_VARS: tuple[EnvVar, ...] = (
        "mesh bench gate: minimum per-effective-chip scaling factor "
        "(`serve_bench.py --chips N` fails below it)",
        "serving.md#mesh-sharded-dispatch"),
+    # -------------------------------------------------------------- agg --
+    _v("ETH_SPECS_AGG_SUBNETS", "64",
+       "attestation subnets the committee-tree aggregation fans in over "
+       "(mainnet's 64; the bench/registry builders partition committees by "
+       "it)", "serving.md#aggregation-pipeline"),
+    _v("ETH_SPECS_AGG_MESH_LANES", "8",
+       "smallest ragged-committee lane count worth sharding the G2 "
+       "aggregation dispatch's lane axis over the mesh; below it the "
+       "all-gather combine costs more than the lanes it saves",
+       "serving.md#aggregation-pipeline"),
     # -------------------------------------------- incremental merkle --
     _v("ETH_SPECS_INC_DIRTY_BUCKETS", "8,64,256,1024,4096,16384,65536",
        "pow2 dirty-leaf capacity buckets the incremental forest kernels "

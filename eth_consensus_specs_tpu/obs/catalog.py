@@ -98,6 +98,15 @@ CATALOG: tuple[Metric, ...] = (
     _s("bls.batch_verify", "batched RLC aggregate verification"),
     _s("bls.fast_aggregate_verify", "single FastAggregateVerify"),
     _s("bls.verify_many", "multi-item verify_many with bisection"),
+    # ---------------------------------------------------------------- agg --
+    _c("agg.committees", "committee contributions aggregated (tier 0)"),
+    _c("agg.signatures", "member signatures through the committee tree"),
+    _c("agg.subnet_partials", "per-(subnet, root) partial aggregates (tier 1)"),
+    _c("agg.global_aggregates", "per-root global aggregates (tier 2)"),
+    _c("agg.isolated_invalid", "invalid subnet partials isolated by bisection"),
+    _g("agg.registry_validators", "validators in the live aggregation registry"),
+    _h("agg.compile_ms", "G2 aggregation kernel first-dispatch compile wall ms"),
+    _s("agg.slot", "one slot's committee-tree aggregation"),
     # ------------------------------------------------------------- fault --
     _c("fault.degraded", "device->host degradations"),
     _c("fault.degraded.*", "degradations per site"),

@@ -1,0 +1,278 @@
+"""Hierarchical committee-tree aggregation — the gossip fan-in, simulated.
+
+A consensus slot's attestations arrive as per-committee gossip
+contributions spread over attestation subnets (64 on mainnet). A real
+aggregator builds the block's aggregates bottom-up:
+
+    committee contributions          (tier 0: one ragged G2 sum per
+      -> per-subnet partials          committee, ONE batched dispatch
+      -> global aggregate             PER SUBNET — the fan-in unit)
+                                     (tier 1: subnet partials per
+                                      attestation data root, one
+                                      dispatch across all subnets)
+                                     (tier 2: global aggregate per
+                                      root, one dispatch)
+
+Every tier is a batched ``ops/g2_aggregate.sum_g2_many_device`` dispatch
+for the signatures plus the existing mesh-sharded
+``ops/g1_msm.sum_g1_many_device`` for the matching aggregate pubkeys,
+keyed/accounted through the LIVE serve key fns
+(``serve/buckets.g2_agg_key`` / ``bls_msm_key``) so direct pipeline
+callers and the serve layer can never disagree about compile shapes.
+Participation bitfields concatenate deterministically ((subnet,
+committee) order within a root), so the output per attestation data
+root is the (aggregate signature, aggregate pubkey, bits) triple a
+block producer ships.
+
+Correctness: :func:`aggregate_slot_host` computes the identical tiers
+through ``crypto/signature``'s host fold — bit-identical Points at
+every tier, which the tests and ``scripts/agg_bench.py`` enforce
+before any throughput is reported. Invalid contributions (a corrupt
+member signature) do not break aggregation — they surface in
+:func:`verify_slot`, and :func:`isolate_invalid_subnets` feeds the
+per-subnet partials through the existing mesh-sharded
+``verify_many`` bisection so each bad subnet costs ~2*log2(n)
+pairings instead of n.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from dataclasses import dataclass
+
+import numpy as np
+
+from eth_consensus_specs_tpu import obs
+from eth_consensus_specs_tpu.crypto.curve import Point, g1_to_bytes, g2_to_bytes
+
+
+def subnet_count() -> int:
+    """Attestation subnets the committee tree fans in over
+    (env-snapshotted; mainnet's 64 by default)."""
+    raw = os.environ.get("ETH_SPECS_AGG_SUBNETS", "")
+    try:
+        return max(int(raw), 1) if raw else 64
+    except ValueError:
+        return 64
+
+
+@dataclass(frozen=True)
+class CommitteeAttestation:
+    """One committee's gossip contribution: the participating members'
+    signature/pubkey points plus the participation bitfield over the
+    FULL committee (len(sigs) == len(pubkeys) == popcount(bits))."""
+
+    subnet: int
+    root: bytes  # attestation data root — the signed message
+    pubkeys: tuple  # participating members' G1 Points
+    sigs: tuple  # matching G2 signature Points
+    bits: tuple  # participation bits over the full committee
+
+
+@dataclass
+class SubnetAggregate:
+    subnet: int
+    root: bytes
+    sig: Point
+    pubkey: Point
+    bits: np.ndarray  # committee bits concatenated in arrival order
+
+    @property
+    def sig_bytes(self) -> bytes:
+        return g2_to_bytes(self.sig)
+
+    @property
+    def pubkey_bytes(self) -> bytes:
+        return g1_to_bytes(self.pubkey)
+
+
+@dataclass
+class SlotAggregate:
+    root: bytes
+    sig: Point
+    pubkey: Point
+    bits: np.ndarray  # subnet bits concatenated in subnet order
+
+    @property
+    def sig_bytes(self) -> bytes:
+        return g2_to_bytes(self.sig)
+
+    @property
+    def pubkey_bytes(self) -> bytes:
+        return g1_to_bytes(self.pubkey)
+
+
+def _sum_tier_device(g2_lists: list[list], g1_lists: list[list], mesh):
+    """One tier's paired dispatches: the ragged G2 committee sums (lane
+    axis mesh-sharded past the crossover) and the G1 pubkey sums (item
+    axis mesh-sharded, the existing bls_msm seam). Keys come from the
+    LIVE serve key fns and their first sightings are the compiles this
+    process pays — accounted so serve and pipeline callers agree; the
+    G2 first-dispatch wall also lands in ``agg.compile_ms``."""
+    from eth_consensus_specs_tpu.ops.g1_msm import sum_g1_many_device
+    from eth_consensus_specs_tpu.ops.g2_aggregate import sum_g2_many_device
+    from eth_consensus_specs_tpu.parallel import mesh_ops
+    from eth_consensus_specs_tpu.serve import buckets
+
+    n = len(g2_lists)
+    max_lanes = max((len(p) for p in g2_lists), default=1)
+    # the SAME live policy fn the serve layer and front door route by
+    # (pow2 lane bucket vs the crossover) — a private raw-lane rule here
+    # would let pipeline and serve disagree about compile shapes for
+    # raw counts just under the crossover
+    sharded = mesh is not None and buckets.route_wide(
+        "agg", buckets.pow2_bucket(max_lanes), n
+    )
+    key = buckets.g2_agg_key(n, max_lanes, mesh=mesh if sharded else None)
+    t0 = time.perf_counter()
+    with buckets.first_dispatch(*key) as fd:
+        sigs = sum_g2_many_device(
+            g2_lists, mesh=mesh if sharded else None, pad_shape=(key[1], key[2])
+        )
+    if fd.first:
+        obs.observe("agg.compile_ms", (time.perf_counter() - t0) * 1e3)
+
+    pk_sharded = mesh is not None and n >= mesh_ops.min_items()
+    pk_key = buckets.bls_msm_key(
+        n, max((len(p) for p in g1_lists), default=1),
+        mesh=mesh if pk_sharded else None,
+    )
+    with buckets.first_dispatch(*pk_key):
+        pks = sum_g1_many_device(
+            g1_lists, mesh=mesh if pk_sharded else None,
+            pad_shape=(pk_key[1], pk_key[2]),
+        )
+    return sigs, pks
+
+
+def _sum_tier_host(g2_lists: list[list], g1_lists: list[list]):
+    """The host oracle of one tier: ``crypto/signature``'s point folds
+    (native-bridge accelerated when available), no device anywhere."""
+    from eth_consensus_specs_tpu.crypto.signature import _sum_g1, _sum_g2
+
+    return [_sum_g2(pts) for pts in g2_lists], [_sum_g1(pts) for pts in g1_lists]
+
+
+def _aggregate_slot(atts: list[CommitteeAttestation], tier_fn):
+    """The committee tree over ``tier_fn`` (device or host oracle —
+    identical structure, so tier outputs compare 1:1)."""
+    # tier 0, per subnet (the gossip fan-in unit): committee partials
+    by_subnet: dict[int, list[int]] = {}
+    for i, a in enumerate(atts):
+        by_subnet.setdefault(int(a.subnet), []).append(i)
+    csig: dict[int, Point] = {}
+    cpk: dict[int, Point] = {}
+    for subnet in sorted(by_subnet):
+        idxs = by_subnet[subnet]
+        sigs, pks = tier_fn(
+            [list(atts[i].sigs) for i in idxs],
+            [list(atts[i].pubkeys) for i in idxs],
+        )
+        for i, s, p in zip(idxs, sigs, pks):
+            csig[i], cpk[i] = s, p
+
+    # tier 1: per-(subnet, root) partials across all subnets, one call
+    groups: dict[tuple[int, bytes], list[int]] = {}
+    for i, a in enumerate(atts):
+        groups.setdefault((int(a.subnet), bytes(a.root)), []).append(i)
+    gkeys = sorted(groups)
+    sigs, pks = tier_fn(
+        [[csig[i] for i in groups[k]] for k in gkeys],
+        [[cpk[i] for i in groups[k]] for k in gkeys],
+    )
+    subnet_aggs = [
+        SubnetAggregate(
+            subnet=k[0],
+            root=k[1],
+            sig=s,
+            pubkey=p,
+            bits=np.concatenate(
+                [np.asarray(atts[i].bits, bool) for i in groups[k]]
+            ),
+        )
+        for k, s, p in zip(gkeys, sigs, pks)
+    ]
+
+    # tier 2: global aggregate per attestation data root
+    by_root: dict[bytes, list[SubnetAggregate]] = {}
+    for sa in subnet_aggs:
+        by_root.setdefault(sa.root, []).append(sa)
+    roots = sorted(by_root)
+    sigs, pks = tier_fn(
+        [[sa.sig for sa in by_root[r]] for r in roots],
+        [[sa.pubkey for sa in by_root[r]] for r in roots],
+    )
+    slot_aggs = [
+        SlotAggregate(
+            root=r,
+            sig=s,
+            pubkey=p,
+            bits=np.concatenate([sa.bits for sa in by_root[r]]),
+        )
+        for r, s, p in zip(roots, sigs, pks)
+    ]
+    return slot_aggs, subnet_aggs
+
+
+def aggregate_slot(
+    atts: list[CommitteeAttestation], mesh=None
+) -> tuple[list[SlotAggregate], list[SubnetAggregate]]:
+    """Aggregate one slot's committee contributions through the
+    three-tier tree on device. Returns (per-root global aggregates,
+    per-(subnet, root) partials — the bisection inputs)."""
+    if not atts:
+        return [], []
+    with obs.span("agg.slot", attestations=len(atts)):
+        obs.count("agg.committees", len(atts))
+        obs.count("agg.signatures", sum(len(a.sigs) for a in atts))
+        slot_aggs, subnet_aggs = _aggregate_slot(
+            atts, lambda g2, g1: _sum_tier_device(g2, g1, mesh)
+        )
+        obs.count("agg.subnet_partials", len(subnet_aggs))
+        obs.count("agg.global_aggregates", len(slot_aggs))
+    return slot_aggs, subnet_aggs
+
+
+def aggregate_slot_host(
+    atts: list[CommitteeAttestation],
+) -> tuple[list[SlotAggregate], list[SubnetAggregate]]:
+    """The whole-tree host oracle: identical structure and ordering, the
+    ``crypto/signature`` fold at every tier — what the bench's parity
+    gate (and the serve degrade ladder) compares against."""
+    if not atts:
+        return [], []
+    return _aggregate_slot(atts, _sum_tier_host)
+
+
+def verify_slot(slot_aggs: list[SlotAggregate], mesh=None) -> list[bool]:
+    """Verify what was just built: FastAggregateVerify of each root's
+    global aggregate against its aggregate pubkey, through the existing
+    batched RLC path (ONE pairing for an all-valid slot)."""
+    from eth_consensus_specs_tpu.ops.bls_batch import verify_many
+
+    if not slot_aggs:
+        return []
+    items = [([sa.pubkey_bytes], sa.root, sa.sig_bytes) for sa in slot_aggs]
+    return verify_many(items, mesh=mesh)
+
+
+def isolate_invalid_subnets(
+    subnet_aggs: list[SubnetAggregate], mesh=None
+) -> list[tuple[int, bytes]]:
+    """Which (subnet, root) partials are invalid? Feeds the per-subnet
+    partials through ``verify_many``'s RLC bisection — an all-valid
+    fan-in costs ONE pairing, each bad subnet ~2*log2(n) more — and
+    returns the isolated (subnet, root) pairs."""
+    from eth_consensus_specs_tpu.ops.bls_batch import verify_many
+
+    if not subnet_aggs:
+        return []
+    items = [([sa.pubkey_bytes], sa.root, sa.sig_bytes) for sa in subnet_aggs]
+    verdicts = verify_many(items, mesh=mesh)
+    bad = [
+        (sa.subnet, sa.root) for sa, ok in zip(subnet_aggs, verdicts) if not ok
+    ]
+    if bad:
+        obs.count("agg.isolated_invalid", len(bad))
+    return bad

@@ -37,7 +37,7 @@ from eth_consensus_specs_tpu.analysis import lockwatch
 
 @dataclass
 class Request:
-    kind: str  # "bls" | "htr" | "state_root"
+    kind: str  # "bls" | "htr" | "state_root" | "agg"
     payload: tuple
     cost_bytes: int
     future: Future = field(default_factory=Future)

@@ -187,6 +187,40 @@ def merkle_inc_key(cap: int, dense_count: int, depth: int, mesh=None) -> tuple:
     return ("merkle_inc", int(cap), int(dense_count), int(depth))
 
 
+# ------------------------------------------- aggregation (G2) buckets --
+#
+# The aggregation op (submit_aggregate / ops/g2_aggregate) sums RAGGED
+# committees: the lane axis is the intrinsic compile axis (committee
+# size, padded with infinity lanes) and — unlike the bls_msm family —
+# it is also the axis the mesh shards, so the lane bucket is the
+# mesh-aware one and the item bucket is a plain pow2.
+
+
+def agg_mesh_lanes() -> int:
+    """Smallest ragged-committee lane count worth sharding the G2
+    aggregation dispatch's lane axis over the mesh (below it the
+    all-gather combine costs more than the lanes it saves;
+    env-snapshotted per call, never inside a trace — jit-purity)."""
+    raw = os.environ.get("ETH_SPECS_AGG_MESH_LANES", "")
+    try:
+        return max(int(raw), 1) if raw else 8
+    except ValueError:
+        return 8
+
+
+def agg_lane_bucket(n: int, shards: int = 1) -> int:
+    """Lane-padding target of the aggregation op's ragged committee
+    axis — :func:`mesh_batch_bucket` applied to the pow2 ladder, so the
+    PER-SHARD lane count is what gets bucketed (the per-shard butterfly
+    fold needs pow2 lanes) and the dispatch pads to shards x that. For
+    pow2 shard counts this equals the global pow2; for non-pow2 meshes
+    it pads strictly less (tests/test_serve_agg.py pins that)."""
+    n = max(int(n), 1)
+    per = -(-n // shards) if shards > 1 else n
+    ladder = tuple(1 << i for i in range(max(per - 1, 0).bit_length() + 1))
+    return mesh_batch_bucket(n, shards, ladder)
+
+
 # ------------------------------------------------- live compile-key fns --
 #
 # The serve/bucket compile keys are FUNCTIONS here, not inline tuple
@@ -258,6 +292,36 @@ def bls_msm_key(n_items: int, max_lanes: int, mesh=None) -> tuple:
     )
 
 
+def g2_agg_key_from_profile(
+    n_items: int, max_lanes: int, shards: int = 1, sig: str = ""
+) -> tuple:
+    """:func:`g2_agg_key` computed from a replica profile (shards,
+    signature) instead of a live Mesh — same contract as
+    :func:`bls_msm_key_from_profile`. Items bucket pow2 (the item axis
+    replicates across shards), lanes through the mesh-aware
+    :func:`agg_lane_bucket`."""
+    if shards > 1 and sig:
+        return (
+            "g2_agg",
+            pow2_bucket(max(n_items, 1)),
+            agg_lane_bucket(max_lanes, shards),
+            sig,
+        )
+    return ("g2_agg", pow2_bucket(max(n_items, 1)), agg_lane_bucket(max_lanes, 1))
+
+
+def g2_agg_key(n_items: int, max_lanes: int, mesh=None) -> tuple:
+    """The compile/bucket/warmup key of the batched G2 committee-sum
+    dispatch: the shared g2_many_sum_shape (items, lanes) bucket,
+    mesh-signed when the LANE axis shards. Single-device keys carry NO
+    signature, like every other unsigned key family."""
+    from eth_consensus_specs_tpu.parallel import mesh_ops
+
+    return g2_agg_key_from_profile(
+        n_items, max_lanes, mesh_ops.shard_count(mesh), mesh_ops.mesh_signature(mesh)
+    )
+
+
 # ------------------------------------------------- fleet routing model --
 #
 # The two-tier fleet (serve/frontdoor.py) routes by (compile-shape,
@@ -280,6 +344,11 @@ def route_wide(kind: str, dim: int, max_batch: int) -> bool:
 
     if kind in ("htr", "merkle_many"):
         return mesh_dispatch_worthwhile(1 << dim, max(int(max_batch), 1))
+    if kind in ("agg", "g2_agg"):
+        # the G2 aggregation shards its LANE axis: the request's
+        # intrinsic dim is its pow2 committee-lane bucket, wide once it
+        # clears the lane crossover regardless of flush size
+        return int(dim) >= agg_mesh_lanes()
     return int(max_batch) >= mesh_ops.min_items()
 
 
@@ -292,7 +361,7 @@ def route_shape_of_key(key: tuple) -> tuple | None:
     dims = [d for d in key[1:] if not isinstance(d, str)]
     if op == "merkle_many" and len(dims) == 2:
         return (op, int(dims[1]))
-    if op == "bls_msm" and dims:
+    if op in ("bls_msm", "g2_agg") and dims:
         return (op, int(dims[-1]))
     return None
 
@@ -336,6 +405,20 @@ def widen_warm_keys(
             for n in range(1, cfg.max_batch + 1)
             if n >= floor
         ]
+    agg_lanes = sorted({k[2] for k in out if k[0] == "g2_agg" and len(k) == 3})
+    for lane in agg_lanes:
+        if lane < agg_mesh_lanes():
+            continue  # lanes below the crossover never shard: no signed shape
+        # signed lane pads from the RAW lane counts that bucket to this
+        # pow2: the service pads from the live flush's raw max, and
+        # agg_lane_bucket is only pad-of-pad idempotent for pow2 shard
+        # counts — the same lesson as the bls branch above, applied to
+        # the lane axis because that is what this family shards
+        pads = sorted(
+            {agg_lane_bucket(x, shards) for x in range(lane // 2 + 1, lane + 1)}
+        )
+        items = sorted({pow2_bucket(n) for n in range(1, cfg.max_batch + 1)})
+        out += [("g2_agg", it, pad, sig) for it in items for pad in pads]
     # distinct flush sizes can pad to one compile shape: dedupe, keep order
     return list(dict.fromkeys(out))
 
@@ -554,6 +637,20 @@ def precompile(
                 pk, msg = _bls.SkToPk(1), b"\x00" * 32
                 sig_b = bytes(_bls.Sign(1, msg))
                 verify_many([([bytes(pk)] * lanes, msg, sig_b)] * items, mesh=mesh)
+            elif op == "g2_agg" and len(int_dims) == 2:
+                from eth_consensus_specs_tpu.crypto.curve import g2_generator
+                from eth_consensus_specs_tpu.ops.g2_aggregate import sum_g2_many_device
+
+                # throwaway committees at exactly the padded shape: the
+                # sums are discarded, only the (items, lanes[, mesh])
+                # kernel compile matters
+                items, lanes = int_dims
+                with first_dispatch(op, *dims):
+                    sum_g2_many_device(
+                        [[g2_generator()] * lanes] * items,
+                        mesh=mesh,
+                        pad_shape=(items, lanes),
+                    )
             else:
                 continue
         except Exception:

@@ -99,6 +99,10 @@ def _host_execute(kind: str, payload):
         from eth_consensus_specs_tpu.crypto.signature import fast_aggregate_verify
 
         return bool(fast_aggregate_verify(*payload))
+    if kind == "agg":
+        from eth_consensus_specs_tpu.crypto.signature import aggregate
+
+        return aggregate(list(payload[0]))
     chunks, depth = payload
     from eth_consensus_specs_tpu.obs.watchdog import host_tree_root_words
     from eth_consensus_specs_tpu.ops.merkle import _chunks_to_words
@@ -174,6 +178,20 @@ class FrontDoorClient:
         cost = 48 * len(pks) + len(payload[1]) + len(payload[2])
         # affinity by the MSM compile shape: the pow2 committee bucket
         return self._submit("bls", payload, ("bls_msm", buckets.pow2_bucket(max(len(pks), 1))), cost)
+
+    def submit_aggregate(self, signatures: list) -> Future:
+        """Aggregate compressed G2 signatures through the fleet;
+        resolves to the exact bytes ``crypto.signature.aggregate``
+        returns. Pure function of its inputs, so hedging/failover are
+        safe — same contract as bls/htr."""
+        sigs = tuple(bytes(s) for s in signatures)
+        # affinity by the pow2 committee-lane bucket: the compile axis
+        # the G2 many-sum pads ragged lanes into
+        return self._submit(
+            "agg", (sigs,),
+            ("g2_agg", buckets.pow2_bucket(max(len(sigs), 1))),
+            96 * max(len(sigs), 1),
+        )
 
     def submit_hash_tree_root(self, chunks: np.ndarray) -> Future:
         chunks = np.ascontiguousarray(chunks)

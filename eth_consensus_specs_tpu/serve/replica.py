@@ -148,6 +148,8 @@ class ReplicaServer:
                         # payload is (chunks, depth); the service derives
                         # the same depth from the chunk count itself
                         fut = self.service.submit_hash_tree_root(msg["payload"][0])
+                    elif msg["kind"] == "agg":
+                        fut = self.service.submit_aggregate(*msg["payload"])
                     else:
                         return {"ok": False, "err": "error",
                                 "detail": f"unknown kind {msg.get('kind')!r}"}

@@ -1,8 +1,11 @@
 """The in-process async verification service.
 
-Three submit verbs return ``concurrent.futures.Future``s:
+Four submit verbs return ``concurrent.futures.Future``s:
 
   * ``submit_bls_aggregate(pubkeys, message, signature) -> Future[bool]``
+  * ``submit_aggregate(signatures) -> Future[bytes]`` (96-byte
+    aggregate signature — the aggregation-pipeline op: ragged
+    committees batch into ONE G2 many-sum dispatch per flush)
   * ``submit_hash_tree_root(chunks) -> Future[bytes]`` (32-byte root)
   * ``submit_state_root(arrays, meta, balances, eff_bal, inact, just)
     -> Future[np.ndarray]`` (u32[8] root words)
@@ -131,6 +134,15 @@ class VerifyService:
         cost = 48 * len(pks) + len(item[1]) + len(item[2])
         return self._submit("bls", item, cost)
 
+    def submit_aggregate(self, signatures: list) -> Future:
+        """Aggregate compressed G2 signatures (one committee's gossip
+        contribution); resolves to the exact bytes
+        ``crypto.signature.aggregate(signatures)`` returns — empty or
+        malformed inputs resolve exceptionally with the same
+        ValueError the direct call raises."""
+        sigs = tuple(bytes(s) for s in signatures)
+        return self._submit("agg", (sigs,), 96 * max(len(sigs), 1))
+
     def submit_hash_tree_root(self, chunks: np.ndarray) -> Future:
         """Merkleize uint8[N, 32] chunks into the root of the pow2
         subtree holding them; resolves to the exact bytes
@@ -205,7 +217,7 @@ class VerifyService:
         SSZ chunk packing for htr, pubkey decompression warm-up for bls.
         A per-request prep failure resolves THAT future exceptionally and
         drops the request; co-batched requests are unaffected."""
-        from eth_consensus_specs_tpu.crypto.signature import _load_pk
+        from eth_consensus_specs_tpu.crypto.signature import _load_pk, _load_sig
         from eth_consensus_specs_tpu.ops.merkle import _chunks_to_words
 
         for r in reqs:
@@ -216,6 +228,21 @@ class VerifyService:
                 elif r.kind == "bls":
                     for pk in r.payload[0]:
                         _load_pk(pk)  # warms the bounded decompression cache
+                elif r.kind == "agg":
+                    # G2 decompression is the per-signature fixed cost:
+                    # pay it here, overlapped with the previous flush's
+                    # device work. The error strings mirror
+                    # crypto.signature.aggregate exactly — a rejected
+                    # future carries what the direct call would raise.
+                    if not r.payload[0]:
+                        raise ValueError("cannot aggregate zero signatures")
+                    pts = []
+                    for s in r.payload[0]:
+                        p = _load_sig(s)
+                        if p is None:
+                            raise ValueError("invalid signature in aggregate")
+                        pts.append(p)
+                    r.prepped = pts
             except Exception as exc:  # noqa: BLE001 — resolve, don't kill the thread
                 self._resolve(r, exc=exc)
 
@@ -295,6 +322,40 @@ class VerifyService:
                 verdicts = [fast_aggregate_verify(*r.payload) for r in bls_reqs]
             for r, v in zip(bls_reqs, verdicts):
                 results[id(r)] = bool(v)
+
+        agg_reqs = [r for r in reqs if r.kind == "agg"]
+        if agg_reqs:
+            if device:
+                from eth_consensus_specs_tpu.crypto.curve import g2_to_bytes
+                from eth_consensus_specs_tpu.ops.g2_aggregate import sum_g2_many_device
+
+                # _prep decompressed every member signature (or resolved
+                # the future exceptionally — those were filtered out of
+                # `reqs` as done), so prepped is the ragged point lists
+                lists = [r.prepped for r in agg_reqs]
+                max_lanes = max(len(pts) for pts in lists)
+                # the LANE axis is what shards: a wide committee clears
+                # the crossover even in a flush of one (the same LIVE
+                # policy fn the front door routes by)
+                sharded = mesh is not None and buckets.route_wide(
+                    "agg", buckets.pow2_bucket(max_lanes), len(agg_reqs)
+                )
+                key = buckets.g2_agg_key(
+                    len(agg_reqs), max_lanes, mesh=mesh if sharded else None
+                )
+                with buckets.first_dispatch(*key):
+                    sums = sum_g2_many_device(
+                        lists, mesh=mesh if sharded else None,
+                        pad_shape=(key[1], key[2]),
+                    )
+                for r, p in zip(agg_reqs, sums):
+                    results[id(r)] = g2_to_bytes(p)
+            else:
+                from eth_consensus_specs_tpu.crypto.signature import aggregate
+
+                obs.count("serve.degraded_items", len(agg_reqs))
+                for r in agg_reqs:
+                    results[id(r)] = aggregate(list(r.payload[0]))
 
         htr_reqs = [r for r in reqs if r.kind == "htr"]
         by_depth: dict[int, list[Request]] = {}

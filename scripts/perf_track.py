@@ -73,6 +73,12 @@ def _lower_is_better(metric: str) -> bool:
     # higher-is-better regardless of any suffix a later rename gives them
     if metric.endswith("_speedup") or "_speedup_" in metric:
         return False
+    # RATES are higher-is-better even though "_per_s" textually ends in
+    # "_s" — without this carve-out the agg bench's primary
+    # (attestations_agg_per_s) would gate in the WRONG direction and a
+    # throughput improvement would read as a wall-time regression
+    if metric.endswith(("_per_s", "_rps")):
+        return False
     return metric.endswith(("_ms", "_s", "_bytes"))
 
 
@@ -128,6 +134,15 @@ def load_rounds(repo_dir: str) -> list[dict]:
                 name.endswith("_scaling") or name == "chip_scaling"
             ):
                 metrics[f"mesh_{name}"] = value
+        # aggregation pipeline (scripts/agg_bench.py): the committee-tree
+        # throughput numbers (higher-is-better ``*_per_s`` rates plus the
+        # best slot wall) ride the same platform-keyed timeline as
+        # secondaries — a cpu smoke never compares against an
+        # accelerator slot, and regressions are advisories unless the
+        # round's PRIMARY is the agg metric itself
+        for name, value in (parsed.get("agg") or {}).items():
+            if isinstance(value, (int, float)):
+                metrics[f"agg_{name}" if not name.startswith("agg_") else name] = value
         # two-tier fleet matrix (serve_bench --replicas R --chips-matrix):
         # per-cell rps and per-effective-chip scaling factors, platform-
         # keyed like the mesh factors — secondaries, so regressions are

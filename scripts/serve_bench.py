@@ -179,6 +179,8 @@ def closed_loop(
                 try:
                     if kind == "bls":
                         fut = svc.submit_bls_aggregate(*payload)
+                    elif kind == "agg":
+                        fut = svc.submit_aggregate(payload)
                     else:
                         fut = svc.submit_hash_tree_root(payload)
                 except serve.Overloaded as exc:

@@ -1,0 +1,253 @@
+"""The batched G2 many-sum kernel and the committee-tree pipeline.
+
+Fast lane: g2_jacobian boundary-value coverage from the registry's
+declared domains (infinity lanes, the P+P doubling path, P+(-P) -> inf)
+executed EAGERLY against the crypto/curve host oracle, plus the host
+tiers of the committee tree against the flat signature fold.
+
+Slow lane (nightly, like the rest of the device-crypto suite): the
+kernel's scan-body compile — ragged/infinity lane parity, the device
+pipeline tier-by-tier, verification + bisection isolation of injected
+invalid committees, and mesh (lane-axis sharded) parity."""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from eth_consensus_specs_tpu.crypto import signature as sig_mod
+from eth_consensus_specs_tpu.crypto.curve import (
+    Point,
+    g1_generator,
+    g2_generator,
+    g2_infinity,
+    g2_to_bytes,
+)
+from eth_consensus_specs_tpu.crypto.fields import Fq2
+from eth_consensus_specs_tpu.ops import agg_tree
+from eth_consensus_specs_tpu.ops import fq12_tower as tw
+from eth_consensus_specs_tpu.ops import g2_jacobian as gj
+from eth_consensus_specs_tpu.ops import lazy_limbs as lz
+from eth_consensus_specs_tpu.ops.g2_aggregate import (
+    _jacobian_to_point,
+    g2_many_sum_shape,
+    sum_g2_device,
+    sum_g2_many_device,
+)
+from eth_consensus_specs_tpu.ops.lazy_limbs import lf
+
+G1 = g1_generator()
+G2 = g2_generator()
+
+
+def _g2j(points: list[Point]) -> gj.G2J:
+    """Affine host points -> one batched Jacobian lane array (infinity
+    lanes where the point is at infinity)."""
+    n = len(points)
+    x = np.zeros((n, 2, lz.N_LIMBS), np.uint64)
+    y = np.zeros_like(x)
+    z = np.zeros_like(x)
+    one = tw.fq2_to_limbs(Fq2.one())
+    for i, p in enumerate(points):
+        if p.is_infinity():
+            continue
+        x[i] = tw.fq2_to_limbs(p.x)
+        y[i] = tw.fq2_to_limbs(p.y)
+        z[i] = one
+    return gj.G2J(lf(jnp.asarray(x)), lf(jnp.asarray(y)), lf(jnp.asarray(z)))
+
+
+def _to_points(p: gj.G2J) -> list[Point]:
+    X = np.asarray(gj._canon(p.x).v)
+    Y = np.asarray(gj._canon(p.y).v)
+    Z = np.asarray(gj._canon(p.z).v)
+    return [_jacobian_to_point(X[i], Y[i], Z[i]) for i in range(X.shape[0])]
+
+
+# -------------------------------------------- g2_jacobian corner lanes --
+
+
+def test_g2_add_corner_lanes_vs_curve_oracle():
+    """One eager batched g2_add over every masked case at once: generic
+    add, P+P (the doubling fallback), P+(-P) -> infinity, and both
+    infinity passthroughs — each lane bit-equal to the host curve
+    oracle after canonical affine conversion."""
+    P7, P11 = G2.mul(7), G2.mul(11)
+    a = [P7, P7, P7, g2_infinity(), P11, g2_infinity()]
+    b = [P11, P7, -P7, P11, g2_infinity(), g2_infinity()]
+    got = _to_points(gj.g2_add(_g2j(a), _g2j(b)))
+    want = [x + y for x, y in zip(a, b)]
+    assert got == want
+
+
+def test_g2_dbl_corner_lanes_vs_curve_oracle():
+    """Doubling at the declared corners: a generic point, infinity
+    (Z = 0 in, Z3 = 0 out), and an order-2-style Y = 0 lane is absent
+    from BLS12-381 G2 — the curve group has odd order — so the oracle
+    set is {2P, inf}."""
+    pts = [G2.mul(5), g2_infinity(), G2]
+    got = _to_points(gj.g2_dbl(_g2j(pts)))
+    assert got == [p + p for p in pts]
+
+
+@pytest.mark.slow  # the scan ladder compiles its step body (~a minute on cpu)
+def test_g2_mul_z_ladder_on_small_multiples_vs_curve_oracle():
+    """The fixed [|x|]-ladder on small multiples k*G2: value-equal to
+    the host mul for every lane of one batch."""
+    ks = [1, 2, 3, 7]
+    pts = [G2.mul(k) for k in ks]
+    got = _to_points(gj.g2_mul_z(_g2j(pts)))
+    assert got == [p.mul(gj.BLS_X_ABS) for p in pts]
+
+
+# ------------------------------------------------------- shape model --
+
+
+def test_g2_many_sum_shape_is_the_serve_bucket_model():
+    from eth_consensus_specs_tpu.serve import buckets
+
+    assert g2_many_sum_shape(3, 5) == (4, 8)
+    assert g2_many_sum_shape(3, 33, 6) == (4, buckets.agg_lane_bucket(33, 6))
+    item_pad, lane_pad = g2_many_sum_shape(9, 100, 8)
+    assert item_pad == 16 and lane_pad % 8 == 0 and lane_pad >= 100
+
+
+# ------------------------------------------------ host committee tree --
+
+
+def _mk_atts(n_subnets=3, committees=2, committee=4, n_roots=2, start=1):
+    atts, k = [], start
+    for subnet in range(n_subnets):
+        for c in range(committees):
+            root = bytes([1 + (c % n_roots)]) * 32
+            bits = [True] * committee
+            bits[1] = False  # ragged participation
+            sigs = tuple(G2.mul(k + j) for j in range(committee - 1))
+            pks = tuple(G1.mul(k + j) for j in range(committee - 1))
+            k += committee
+            atts.append(
+                agg_tree.CommitteeAttestation(
+                    subnet, root, pks, sigs, tuple(bits)
+                )
+            )
+    return atts
+
+
+def test_host_tree_tiers_equal_flat_signature_fold():
+    """The committee tree's host oracle is associativity-trustworthy:
+    every global aggregate equals the FLAT signature.aggregate over the
+    same members, and participation bits concatenate (subnet,
+    committee)-deterministically to the full registry width."""
+    atts = _mk_atts()
+    slot, subs = agg_tree.aggregate_slot_host(atts)
+    assert len(subs) == 6  # 3 subnets x 2 roots
+    for sa in slot:
+        members = [
+            g2_to_bytes(p)
+            for a in atts
+            if bytes(a.root) == sa.root
+            for p in a.sigs
+        ]
+        assert sa.sig_bytes == sig_mod.aggregate(members)
+        n_bits = sum(len(a.bits) for a in atts if bytes(a.root) == sa.root)
+        assert sa.bits.shape == (n_bits,)
+        assert int(sa.bits.sum()) == sum(
+            len(a.sigs) for a in atts if bytes(a.root) == sa.root
+        )
+
+
+def test_subnet_count_env_snapshot(monkeypatch):
+    monkeypatch.delenv("ETH_SPECS_AGG_SUBNETS", raising=False)
+    assert agg_tree.subnet_count() == 64
+    monkeypatch.setenv("ETH_SPECS_AGG_SUBNETS", "8")
+    assert agg_tree.subnet_count() == 8
+    monkeypatch.setenv("ETH_SPECS_AGG_SUBNETS", "junk")
+    assert agg_tree.subnet_count() == 64
+
+
+# ------------------------------------------------- device slow lane --
+
+
+@pytest.mark.slow
+def test_sum_g2_many_device_parity_ragged_and_corners():
+    """Ragged committees, infinity members, duplicate points (the
+    doubling path inside the butterfly), and a P + (-P) committee — all
+    in ONE dispatch, each sum bit-equal to the host fold and the
+    compressed bytes equal to signature.aggregate."""
+    lists = [
+        [G2.mul(k + 1) for k in range(5)],
+        [G2.mul(7), g2_infinity(), G2.mul(9)],
+        [g2_infinity()],
+        [G2.mul(3), -G2.mul(3)],
+        [G2.mul(4), G2.mul(4)],  # equal lanes -> doubling fallback
+    ]
+    got = sum_g2_many_device(lists)
+    for pl, g in zip(lists, got):
+        assert g == sig_mod._sum_g2(list(pl))
+    # bytes-level parity where aggregate() accepts the input
+    real = [g2_to_bytes(p) for p in lists[0]]
+    assert g2_to_bytes(sum_g2_device(lists[0])) == sig_mod.aggregate(real)
+
+
+@pytest.mark.slow
+def test_device_pipeline_tiers_and_isolation():
+    """Device tiers bit-equal to the host oracle, verification of what
+    was just built, and bisection isolation of one injected invalid
+    committee."""
+    from eth_consensus_specs_tpu.crypto.hash_to_curve import hash_to_g2
+
+    root = b"\x05" * 32
+    H = hash_to_g2(root)
+    atts = []
+    for subnet in range(2):
+        sks = list(range(1 + 4 * subnet, 5 + 4 * subnet))
+        atts.append(
+            agg_tree.CommitteeAttestation(
+                subnet, root,
+                tuple(G1.mul(sk) for sk in sks),
+                tuple(H.mul(sk) for sk in sks),
+                (True,) * 4,
+            )
+        )
+    slot_d, subs_d = agg_tree.aggregate_slot(atts)
+    slot_h, subs_h = agg_tree.aggregate_slot_host(atts)
+    for d, h in zip(subs_d, subs_h):
+        assert (d.subnet, d.root, d.sig, d.pubkey) == (h.subnet, h.root, h.sig, h.pubkey)
+        assert np.array_equal(d.bits, h.bits)
+    for d, h in zip(slot_d, slot_h):
+        assert (d.root, d.sig_bytes, d.pubkey_bytes) == (h.root, h.sig_bytes, h.pubkey_bytes)
+    assert agg_tree.verify_slot(slot_d) == [True]
+    assert agg_tree.isolate_invalid_subnets(subs_d) == []
+
+    bad = agg_tree.CommitteeAttestation(
+        1, root, atts[1].pubkeys,
+        tuple(p + G2 for p in atts[1].sigs), atts[1].bits,
+    )
+    slot2, subs2 = agg_tree.aggregate_slot([atts[0], bad])
+    assert agg_tree.verify_slot(slot2) == [False]
+    assert agg_tree.isolate_invalid_subnets(subs2) == [(1, root)]
+
+
+@pytest.mark.slow
+def test_mesh_lane_sharded_parity():
+    """The lane-axis-sharded dispatch returns byte-identical points to
+    the single-device kernel — any shard count, including the
+    all-gather + replicated-top combine."""
+    import jax
+
+    from eth_consensus_specs_tpu.parallel import mesh_ops
+
+    if len(jax.devices()) < 4:
+        pytest.skip("needs >= 4 devices (conftest forces them on CPU)")
+    mesh = mesh_ops.serve_mesh(4)
+    assert mesh is not None
+    lists = [
+        [G2.mul(k + 1) for k in range(9)],
+        [G2.mul(31), g2_infinity(), G2.mul(33), -G2.mul(31)],
+    ]
+    single = sum_g2_many_device(lists)
+    sharded = sum_g2_many_device(lists, mesh=mesh)
+    assert single == sharded
+    assert [g2_to_bytes(p) for p in single] == [g2_to_bytes(p) for p in sharded]

@@ -356,6 +356,68 @@ def test_bls_msm_per_item_sums_at_corners_vs_host():
         assert gm._jacobian_to_point(outX[i], outY[i], outZ[i]) == g1_infinity()
 
 
+def test_g2_aggregate_domains_declare_redundant_corners():
+    """The G2 aggregation family declares the REDUNDANT [0, 2p) range
+    (its scan carry crosses the boundary < 2p), so all three Jacobian
+    coordinate domains must carry the zero / p-1 / 2p-1 corners."""
+    for dom in _variant("g2_aggregate").domains:
+        labels = {lab for lab, _ in _corners(dom)}
+        assert {"zero", "p-1", "2p-1"} <= labels, dom.name
+
+
+@pytest.mark.slow
+def test_g2_aggregate_corners_vs_host():
+    """Kernel execution at the declared corners: the all-zero corner is
+    the infinity encoding (Z = 0 -> every sum infinity), and redundant
+    [p, 2p) coordinate encodings — admitted by the 2p-1 corner — must
+    produce the same group elements as the canonical host fold."""
+    from eth_consensus_specs_tpu.crypto.curve import g2_generator, g2_infinity
+    from eth_consensus_specs_tpu.crypto.fields import P as P_INT
+    from eth_consensus_specs_tpu.crypto.signature import _sum_g2
+    from eth_consensus_specs_tpu.ops import g2_aggregate as ga
+    from eth_consensus_specs_tpu.ops import lazy_limbs as lz
+
+    coord_dom = _variant("g2_aggregate").domains[0]
+    items, lanes = 2, 4
+
+    # zero corner: all-zero limbs everywhere == every lane at infinity
+    zero = np.zeros((items, lanes, 2, lz.N_LIMBS), np.uint64)
+    rX, rY, rZ = (
+        np.asarray(o)
+        for o in ga.g2_sum_many_kernel(*(jnp.asarray(zero),) * 3)
+    )
+    for i in range(items):
+        assert ga._jacobian_to_point(rX[i], rY[i], rZ[i]) == g2_infinity()
+
+    # redundant encodings: every Fq limb row re-encoded as value + p,
+    # still limb-wise inside the declared [0, 2p) domain
+    pts = [[g2_generator().mul(k + 1) for k in range(lanes)] for _ in range(items)]
+    X, Y, Z = ga._points_to_lanes(pts, items, lanes)
+
+    def red(arr):
+        out = np.empty_like(arr)
+        for idx in np.ndindex(arr.shape[:-1]):
+            row = arr[idx]
+            if not row.any():
+                out[idx] = row  # infinity lanes stay the zero encoding
+                continue
+            val = lz.limbs_to_int(row) + P_INT
+            out[idx] = lz.int_to_limbs(val)
+            assert np.all(out[idx].astype(object) <= _obj(coord_dom.hi)), (
+                "redundant encoding escaped the declared [0, 2p) domain"
+            )
+        return out
+
+    rX, rY, rZ = (
+        np.asarray(o)
+        for o in ga.g2_sum_many_kernel(
+            jnp.asarray(red(X)), jnp.asarray(red(Y)), jnp.asarray(red(Z))
+        )
+    )
+    for i in range(items):
+        assert ga._jacobian_to_point(rX[i], rY[i], rZ[i]) == _sum_g2(pts[i])
+
+
 @pytest.mark.slow
 def test_pairing_active_mask_corners_vs_host_miller():
     """Both corners of the declared active-mask domain in one chunk:

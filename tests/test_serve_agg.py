@@ -1,0 +1,146 @@
+"""The serve-layer `aggregate` op: bucket model, keys, routing, and the
+host rungs of the degrade ladder — everything that gates WITHOUT paying
+the G2 kernel's scan-body compile (the device dispatch itself is
+covered by the slow lane in tests/test_g2_aggregate.py and the
+agg-smoke CI job)."""
+
+from __future__ import annotations
+
+import pytest
+
+from eth_consensus_specs_tpu import fault, obs, serve
+from eth_consensus_specs_tpu.crypto import signature as sig_mod
+from eth_consensus_specs_tpu.crypto.curve import g2_generator, g2_to_bytes
+from eth_consensus_specs_tpu.serve import buckets
+from eth_consensus_specs_tpu.serve.config import ServeConfig
+
+
+# ------------------------------------------------------- bucket model --
+
+
+def test_agg_lane_bucket_reuses_mesh_batch_bucket_semantics():
+    """Single-shard lanes bucket plain pow2; sharded lanes bucket the
+    PER-SHARD count and multiply back — every result divisible by
+    shards, >= n, per-shard pow2 (the butterfly fold's requirement)."""
+    for n in (1, 2, 3, 5, 9, 17, 33, 100):
+        assert buckets.agg_lane_bucket(n) == buckets.pow2_bucket(n)
+        for shards in (2, 3, 4, 5, 6, 7, 8):
+            pad = buckets.agg_lane_bucket(n, shards)
+            assert pad >= n
+            assert pad % shards == 0
+            per = pad // shards
+            assert per == buckets.pow2_bucket(per), (n, shards, pad)
+
+
+def test_agg_lane_bucket_non_pow2_shards_pad_strictly_less_than_global():
+    """The regression the ISSUE pins: a non-pow2 mesh bucketing its RAW
+    lane count pads strictly less than bucketing the GLOBAL pow2 would
+    (pad-of-pad) — the same non-idempotence class that once produced
+    cold compiles on 6-shard replicas in the bls family."""
+    for n, shards in ((33, 6), (9, 6), (17, 3), (33, 5), (65, 7)):
+        raw = buckets.agg_lane_bucket(n, shards)
+        of_global = buckets.agg_lane_bucket(buckets.pow2_bucket(n), shards)
+        assert raw < of_global, (n, shards, raw, of_global)
+    # pow2 shard counts ARE pad-of-pad idempotent — that equality is
+    # what lets warm-key widening enumerate from the pow2 lane bucket
+    for n, shards in ((33, 4), (9, 8), (100, 2), (5, 4)):
+        raw = buckets.agg_lane_bucket(n, shards)
+        of_global = buckets.agg_lane_bucket(buckets.pow2_bucket(n), shards)
+        assert raw == of_global, (n, shards, raw, of_global)
+
+
+def test_g2_agg_key_forms_and_profile_agreement():
+    assert buckets.g2_agg_key(3, 5) == ("g2_agg", 4, 8)
+    assert buckets.g2_agg_key_from_profile(3, 5) == ("g2_agg", 4, 8)
+    signed = buckets.g2_agg_key_from_profile(3, 33, 6, "cpu3x2")
+    assert signed == ("g2_agg", 4, 48, "cpu3x2")
+    # shards without a signature stay unsigned (single-device form)
+    assert buckets.g2_agg_key_from_profile(3, 33, 6, "") == ("g2_agg", 4, 64)
+    # the shared shape model in ops agrees with the serve key fn
+    from eth_consensus_specs_tpu.ops.g2_aggregate import g2_many_sum_shape
+
+    for items, lanes, shards in ((1, 1, 1), (3, 5, 1), (3, 33, 6), (9, 100, 8)):
+        shape = g2_many_sum_shape(items, lanes, shards)
+        key = buckets.g2_agg_key_from_profile(items, lanes, shards, "sig")
+        assert shape == (key[1], key[2]), (items, lanes, shards)
+
+
+def test_route_shape_and_route_wide_for_agg(monkeypatch):
+    assert buckets.route_shape_of_key(("g2_agg", 4, 8)) == ("g2_agg", 8)
+    assert buckets.route_shape_of_key(("g2_agg", 4, 48, "cpu3x2")) == ("g2_agg", 48)
+    # lane-crossover policy: wide iff the pow2 lane bucket clears it,
+    # REGARDLESS of flush size (the lane axis is what shards)
+    monkeypatch.delenv("ETH_SPECS_AGG_MESH_LANES", raising=False)
+    assert buckets.route_wide("agg", 8, 1)
+    assert not buckets.route_wide("agg", 4, 64)
+    monkeypatch.setenv("ETH_SPECS_AGG_MESH_LANES", "4")
+    assert buckets.route_wide("agg", 4, 1)
+
+
+def test_widen_warm_keys_emits_signed_g2_agg_shapes():
+    cfg = ServeConfig(max_batch=4, buckets=(1, 2, 4))
+    out = buckets.widen_warm_keys([("g2_agg", 2, 16)], cfg, 6, "cpu3x2")
+    signed = [k for k in out if k[0] == "g2_agg" and len(k) == 4]
+    assert signed, "no signed g2_agg keys widened"
+    assert all(k[3] == "cpu3x2" for k in signed)
+    # lane pads come from the RAW counts that bucket to 16, under 6
+    # shards: ceil(9..16 / 6) in {2, 3} -> pow2 {2, 4} -> pads {12, 24}
+    assert {k[2] for k in signed} == {12, 24}
+    assert {k[1] for k in signed} == {1, 2, 4}
+    # lanes below the crossover never shard: nothing signed to widen
+    out = buckets.widen_warm_keys([("g2_agg", 2, 4)], cfg, 6, "cpu3x2")
+    assert [k for k in out if k[0] == "g2_agg" and len(k) == 4] == []
+
+
+def test_precompile_skips_alien_signed_g2_agg_key(monkeypatch):
+    """A mesh-signed g2_agg key replayed without that live mesh must be
+    SKIPPED (never compiled wrong) — and the skip costs no compile, so
+    this stays in the fast lane."""
+    monkeypatch.setenv("ETH_SPECS_MESH", "0")
+    buckets.reset_for_tests()
+    before = obs.snapshot()["counters"].get("serve.compiles", 0)
+    warmed = buckets.precompile([("g2_agg", 2, 48, "nosuch6x1")])
+    assert warmed == 0
+    assert obs.snapshot()["counters"].get("serve.compiles", 0) == before
+
+
+# ------------------------------------------------- service host rungs --
+
+
+def _mk_sigs(n: int) -> list[bytes]:
+    G2 = g2_generator()
+    return [g2_to_bytes(G2.mul(k + 1)) for k in range(n)]
+
+
+def test_submit_aggregate_error_parity_without_dispatch():
+    """Empty and malformed inputs resolve exceptionally in _prep — the
+    exact ValueErrors the direct signature.aggregate call raises, and
+    no device dispatch ever happens (fast-lane safe)."""
+    with serve.VerifyService(ServeConfig(max_batch=2, max_wait_ms=1.0), name="t-agg-err") as svc:
+        with pytest.raises(ValueError, match="zero signatures"):
+            svc.submit_aggregate([]).result(timeout=30)
+        with pytest.raises(ValueError, match="invalid signature"):
+            svc.submit_aggregate([b"\x01" * 96]).result(timeout=30)
+
+
+def test_submit_aggregate_host_degrade_parity():
+    """Device death degrades the whole flush to the host
+    signature.aggregate fold — byte-identical results, no XLA anywhere
+    (which is also why this runs in the fast lane: the injected fault
+    fires BEFORE the kernel would compile)."""
+    sig_sets = [_mk_sigs(3), _mk_sigs(5), _mk_sigs(1)]
+    want = [sig_mod.aggregate(s) for s in sig_sets]
+    before = obs.snapshot()["counters"].get("serve.degraded_items", 0)
+    with fault.injected("serve.dispatch:raise:times=inf"):
+        with serve.VerifyService(ServeConfig(max_batch=4, max_wait_ms=1.0), name="t-agg-deg") as svc:
+            futs = [svc.submit_aggregate(s) for s in sig_sets]
+            got = [f.result(timeout=60) for f in futs]
+    assert got == want
+    assert obs.snapshot()["counters"].get("serve.degraded_items", 0) >= before + 3
+
+
+def test_frontdoor_host_execute_agg_parity():
+    from eth_consensus_specs_tpu.serve.frontdoor import _host_execute
+
+    sigs = _mk_sigs(4)
+    assert _host_execute("agg", (tuple(sigs),)) == sig_mod.aggregate(sigs)
