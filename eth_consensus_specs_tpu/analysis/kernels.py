@@ -396,14 +396,22 @@ def _shuffle_variants(mesh):
     ]
 
 
+def _fr_fft_args(batch: int, n: int, stages: int):
+    from eth_consensus_specs_tpu.ops import fr_fft
+
+    fr = fr_fft.FR
+    return (
+        _sds((batch, n, fr.n_limbs), "uint64"),
+        *(_sds((1 << i, fr.n_limbs), "uint64") for i in range(stages)),
+    )
+
+
 def _fr_fft_variants(mesh):
     from eth_consensus_specs_tpu.ops import fr_fft
+    from eth_consensus_specs_tpu.parallel import mesh_ops
 
     n, stages = 256, 8
     fr = fr_fft.FR
-    tw = tuple(
-        _sds((1 << i, fr.n_limbs), "uint64") for i in range(stages)
-    )
     # twiddle tables are CANONICAL Montgomery (< r, built by to_mont);
     # no corners — the runtime corner test needs the real tables (a
     # boundary "twiddle" would just be a different polynomial basis)
@@ -411,17 +419,59 @@ def _fr_fft_variants(mesh):
         "twiddles: canonical Montgomery Fr (< r limb-wise)",
         hi=limb_caps(fr.modulus - 1, 30, fr.n_limbs),
     )
-    return [
+    doms = (
+        mont_domain("values: Montgomery Fr in [0, 2r)", fr.modulus, 30, fr.n_limbs),
+        *([tw_dom] * stages),
+    )
+    out = [
         Variant(
             "single",
             fr_fft._compiled_fft(n, stages),
-            (_sds((4, n, fr.n_limbs), "uint64"), *tw),
-            domains=(
-                mont_domain("values: Montgomery Fr in [0, 2r)", fr.modulus, 30, fr.n_limbs),
-                *([tw_dom] * stages),
-            ),
+            _fr_fft_args(4, n, stages),
+            domains=doms,
         )
     ]
+    if mesh is not None:
+        batch = mesh_ops.pad_to_shards(4, mesh_ops.shard_count(mesh))
+        out.append(
+            Variant(
+                "mesh",
+                fr_fft._sharded_fft(mesh, n, stages),
+                _fr_fft_args(batch, n, stages),
+                mesh=mesh,
+                domains=doms,
+            )
+        )
+    return out
+
+
+def _fr_fft_key_grid(mesh):
+    """LIVE serve key fn (buckets.fr_fft_key) over the blob-flush grid
+    vs the batch-padded avals the FFT dispatch compiles under — the
+    bucket discipline the FFT never had before the DAS workload."""
+    from eth_consensus_specs_tpu.parallel import mesh_ops
+    from eth_consensus_specs_tpu.serve import buckets
+
+    out = []
+    for m in (None, mesh) if mesh is not None else (None,):
+        shards = mesh_ops.shard_count(m)
+        for n in (256, 4096):
+            stages = n.bit_length() - 1
+            for b in (1, 2, 3, 5, 8, 16, 33):
+                key = buckets.fr_fft_key(b, n, mesh=m)
+                sig = (
+                    _canon_args(_fr_fft_args(key[1], n, stages)),
+                    mesh_ops.mesh_signature(m),
+                )
+                out.append((key, sig))
+                # profile-form agreement (see _merkle_many_key_grid)
+                out.append((
+                    buckets.fr_fft_key_from_profile(
+                        b, n, shards, mesh_ops.mesh_signature(m)
+                    ),
+                    sig,
+                ))
+    return out
 
 
 def _fq_jacobian_domains() -> tuple:
@@ -520,6 +570,65 @@ def _bls_msm_key_grid(mesh):
                     ),
                     sig,
                 ))
+    return out
+
+
+def _kzg_msm_args(items: int, lanes: int):
+    return (
+        _sds((items, lanes, 256), "uint64"),
+        *[_sds((items, lanes, 13), "uint64")] * 3,
+    )
+
+
+def _kzg_msm_variants(mesh):
+    from eth_consensus_specs_tpu.ops import g1_msm
+
+    doms = (_SCALAR_BITS_DOMAIN, *_fq_jacobian_domains())
+    out = [
+        Variant(
+            "single", g1_msm.msm_many_kernel, _kzg_msm_args(2, 4), domains=doms
+        )
+    ]
+    if mesh is not None:
+        from eth_consensus_specs_tpu.parallel import mesh_ops
+
+        lanes = g1_msm.mesh_lane_pad(4, mesh_ops.shard_count(mesh))
+        out.append(
+            Variant(
+                "mesh",
+                g1_msm._sharded_fn(mesh, "msm_many"),
+                _kzg_msm_args(2, lanes),
+                mesh=mesh,
+                domains=doms,
+            )
+        )
+    return out
+
+
+def _kzg_msm_key_grid(mesh):
+    """LIVE serve key fn (buckets.kzg_msm_key) over the blob-flush grid
+    vs the 2-item lane-padded avals the RLC fold compiles under (the
+    lane axis is the mesh-sharded one, like g2_agg)."""
+    from eth_consensus_specs_tpu.parallel import mesh_ops
+    from eth_consensus_specs_tpu.serve import buckets
+
+    out = []
+    for m in (None, mesh) if mesh is not None else (None,):
+        shards = mesh_ops.shard_count(m)
+        for n in (1, 2, 3, 5, 9, 16, 33, 64):
+            key = buckets.kzg_msm_key(n, mesh=m)
+            sig = (
+                _canon_args(_kzg_msm_args(2, buckets.kzg_lane_bucket(n, shards))),
+                mesh_ops.mesh_signature(m),
+            )
+            out.append((key, sig))
+            # profile-form agreement (see _merkle_many_key_grid)
+            out.append((
+                buckets.kzg_msm_key_from_profile(
+                    n, shards, mesh_ops.mesh_signature(m)
+                ),
+                sig,
+            ))
     return out
 
 
@@ -862,11 +971,13 @@ REGISTRY: tuple[KernelSpec, ...] = (
     ),
     KernelSpec(
         name="fr_fft",
-        help="batched BLS-scalar-field FFT (ops/fr_fft)",
+        help="batched BLS-scalar-field FFT (ops/fr_fft), mesh "
+        "batch-axis sharded",
         dtypes=_LIMB_DTYPES,
         donate=(0,),  # vals: private bit-reversed copy, aval == output
         wraps=limb_borrow_wraps("limb_field.py", _MASK30),
         build_variants=_fr_fft_variants,
+        key_grid=_fr_fft_key_grid,
     ),
     KernelSpec(
         name="g1_msm",
@@ -887,6 +998,18 @@ REGISTRY: tuple[KernelSpec, ...] = (
         wraps=limb_borrow_wraps("field_limbs.py", _MASK30),
         build_variants=_bls_msm_variants,
         key_grid=_bls_msm_key_grid,
+    ),
+    KernelSpec(
+        name="kzg_msm",
+        help="batched per-item full-scalar G1 MSMs (the KZG blob RLC "
+        "fold — ops/g1_msm.msm_many_kernel), mesh lane-axis sharded",
+        dtypes=_LIMB_DTYPES,
+        donation_waiver="MSM lanes (I,L,13)x3 + bits (I,L,256) vs "
+        "per-item Jacobian points (I,13)x3 — no aval ever aliases an "
+        "output",
+        wraps=limb_borrow_wraps("field_limbs.py", _MASK30),
+        build_variants=_kzg_msm_variants,
+        key_grid=_kzg_msm_key_grid,
     ),
     KernelSpec(
         name="g2_aggregate",

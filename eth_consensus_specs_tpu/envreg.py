@@ -186,6 +186,19 @@ ENV_VARS: tuple[EnvVar, ...] = (
        "aggregation dispatch's lane axis over the mesh; below it the "
        "all-gather combine costs more than the lanes it saves",
        "serving.md#aggregation-pipeline"),
+    # -------------------------------------------------------------- kzg --
+    _v("ETH_SPECS_KZG_MESH_LANES", "16",
+       "smallest RLC lane count worth sharding the KZG blob-verification "
+       "multi-MSM's lane axis over the mesh (a flush of n blobs folds into "
+       "2n+1 lanes); below it the all-gather combine costs more than the "
+       "double-and-add lanes it saves",
+       "serving.md#blob-verification-pipeline"),
+    _v("ETH_SPECS_KZG_HOST_EVAL", "0",
+       "`1`: evaluate blob polynomials at the challenge point through the "
+       "host barycentric oracle instead of the batched device inverse FFT "
+       "(bit-identical values; the degrade/bench control for backends where "
+       "the 4096-point FFT compile is not worth paying)",
+       "serving.md#blob-verification-pipeline"),
     # -------------------------------------------- incremental merkle --
     _v("ETH_SPECS_INC_DIRTY_BUCKETS", "8,64,256,1024,4096,16384,65536",
        "pow2 dirty-leaf capacity buckets the incremental forest kernels "

@@ -107,6 +107,15 @@ CATALOG: tuple[Metric, ...] = (
     _g("agg.registry_validators", "validators in the live aggregation registry"),
     _h("agg.compile_ms", "G2 aggregation kernel first-dispatch compile wall ms"),
     _s("agg.slot", "one slot's committee-tree aggregation"),
+    # ---------------------------------------------------------------- kzg --
+    _c("kzg.batches", "RLC-combined blob KZG batch checks (one MSM + pairing each)"),
+    _c("kzg.blobs_verified", "blobs through verify_many_blobs / the batch verifier"),
+    _c("kzg.fft_rows", "blob polynomials through the batched device inverse FFT"),
+    _c("kzg.isolated_invalid", "invalid blobs isolated by RLC bisection"),
+    _s("kzg.verify_many", "batched blob KZG verification with bisection"),
+    # ---------------------------------------------------------------- das --
+    _g("das.blobs", "blobs in the live DAS bench flush"),
+    _c("das.flushes", "DAS bench blob-verification flushes"),
     # ------------------------------------------------------------- fault --
     _c("fault.degraded", "device->host degradations"),
     _c("fault.degraded.*", "degradations per site"),

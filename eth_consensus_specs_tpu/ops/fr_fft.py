@@ -15,6 +15,7 @@ order: both equal the textbook DFT in exact modular arithmetic)."""
 
 from __future__ import annotations
 
+import os
 from functools import lru_cache, partial
 
 import numpy as np
@@ -22,6 +23,8 @@ import numpy as np
 import eth_consensus_specs_tpu  # noqa: F401  (enables x64)
 import jax
 import jax.numpy as jnp
+from jax.experimental.shard_map import shard_map
+from jax.sharding import Mesh, PartitionSpec as P
 
 from .limb_field import LimbField
 
@@ -91,31 +94,98 @@ def _compiled_fft(n: int, n_stages: int):
     return run
 
 
-def batch_fft_mont(vals_mont: jnp.ndarray, roots: tuple) -> jnp.ndarray:
-    """[B, n, L] Montgomery limbs -> DFT, natural order in and out."""
+# -- mesh-sharded variant: rows of a batched FFT are independent, so the
+# BATCH axis shards with NO collectives (every shard runs the identical
+# butterfly chain over its rows) — byte-identical to the single-device
+# dispatch at any shard count. The donated vals buffer aliases per shard
+# exactly like the single-device jit.
+_SHARDED_FFT: dict[tuple, object] = {}
+
+
+def _sharded_fft(mesh: Mesh, n: int, n_stages: int):
+    key = (mesh, n, n_stages)
+    fn = _SHARDED_FFT.get(key)
+    if fn is not None:
+        return fn
+    from eth_consensus_specs_tpu.parallel.mesh_ops import BATCH_AXES
+
+    def local(vals, *twiddles):
+        return fft_stages(vals, list(twiddles), n)
+
+    fn = jax.jit(
+        shard_map(
+            local,
+            mesh=mesh,
+            in_specs=(P(BATCH_AXES),) + (P(),) * n_stages,
+            out_specs=P(BATCH_AXES),
+            check_rep=False,
+        ),
+        donate_argnums=(0,),
+    )
+    _SHARDED_FFT[key] = fn
+    return fn
+
+
+def _clear_sharded_after_fork_in_child() -> None:
+    # fork-safety: compiled executables reference the parent's devices
+    _SHARDED_FFT.clear()
+
+
+os.register_at_fork(after_in_child=_clear_sharded_after_fork_in_child)
+
+
+def batch_fft_mont(
+    vals_mont: jnp.ndarray, roots: tuple, mesh: Mesh | None = None
+) -> jnp.ndarray:
+    """[B, n, L] Montgomery limbs -> DFT, natural order in and out. With
+    a multi-device `mesh` the batch axis shards (B must divide evenly —
+    callers pad rows through serve/buckets.fr_fft_key, whose mesh-aware
+    bucket guarantees it)."""
     n = vals_mont.shape[1]
     assert n & (n - 1) == 0 and n == len(roots)
     rev = jnp.asarray(_bit_reversal_indices(n))
     vals = jnp.take(vals_mont, rev, axis=1)
     twiddles = [jnp.asarray(t) for t in _stage_twiddles(tuple(roots), n)]
+    from eth_consensus_specs_tpu.parallel.mesh_ops import shard_count
+
+    if mesh is not None and shard_count(mesh) > 1:
+        from eth_consensus_specs_tpu import obs
+
+        assert vals.shape[0] % shard_count(mesh) == 0
+        obs.count("mesh.dispatches", 1)
+        obs.count("mesh.sharded_items", int(vals.shape[0]))
+        return _sharded_fft(mesh, n, len(twiddles))(vals, *twiddles)
     return _compiled_fft(n, len(twiddles))(vals, *twiddles)
 
 
-def batch_fft_field(batches, roots_of_unity, inv: bool = False) -> list[list[int]]:
+def batch_fft_field(
+    batches,
+    roots_of_unity,
+    inv: bool = False,
+    mesh: Mesh | None = None,
+    pad_batch: int | None = None,
+) -> list[list[int]]:
     """Many same-length FFTs at once; bit-exact with crypto/das.fft_field
-    applied row-wise (host ints in, host ints out)."""
+    applied row-wise (host ints in, host ints out). ``pad_batch`` pads
+    the batch axis with zero rows to a bucketed compile shape (the serve
+    layer passes its fr_fft_key bucket so accounting and dispatch
+    agree); padded rows are discarded."""
     roots = tuple(int(r) for r in roots_of_unity)
     n = len(roots)
-    arr = FR.ints_to_mont_batch([[int(x) % BLS_MODULUS for x in row] for row in batches])
+    b = len(batches)
+    rows = [[int(x) % BLS_MODULUS for x in row] for row in batches]
+    if pad_batch is not None:
+        assert pad_batch >= b
+        rows += [[0] * n] * (pad_batch - b)
+    arr = FR.ints_to_mont_batch(rows)
     if inv:
         inv_roots = (roots[0],) + roots[:0:-1]
-        out = batch_fft_mont(jnp.asarray(arr), inv_roots)
+        out = batch_fft_mont(jnp.asarray(arr), inv_roots, mesh=mesh)
         invlen_mont = jnp.asarray(FR.to_mont(pow(n, BLS_MODULUS - 2, BLS_MODULUS)))
         out = FR.mont_mul(out, invlen_mont)
     else:
-        out = batch_fft_mont(jnp.asarray(arr), roots)
-    flat = FR.mont_batch_to_ints(np.asarray(out))
-    b = len(batches)
+        out = batch_fft_mont(jnp.asarray(arr), roots, mesh=mesh)
+    flat = FR.mont_batch_to_ints(np.asarray(out)[:b])
     return [flat[i * n : (i + 1) * n] for i in range(b)]
 
 

@@ -172,6 +172,26 @@ def sum_many_kernel(X, Y, Z):
     return jax.vmap(_tree_sum)(X, Y, Z)
 
 
+def _msm_lanes(bits, X, Y, Z):
+    """One item's MSM: vmapped double-and-add over its lanes + pairwise
+    tree reduce — the shared body of msm_kernel and the batched
+    per-item variant below."""
+    return _tree_sum(*jax.vmap(_scalar_mul_lane)(bits, X, Y, Z))
+
+
+@jax.jit
+def msm_many_kernel(bits, X, Y, Z):
+    """Per-item full-scalar MSMs over [I, L, ...] lane arrays (L a power
+    of two): bits u64[I, L, 256], X/Y/Z u64[I, L, 13]. Returns Jacobian
+    u64[I, 13] per coordinate — item i is sum_j bits[i,j] * P[i,j].
+
+    This is the KZG batch-verification fold: one flush's RLC combine
+    needs TWO independent MSMs (the proof lincomb and the commitment-
+    minus-y + proof-z lincomb) and this kernel runs both in ONE
+    dispatch instead of two msm_kernel round-trips."""
+    return jax.vmap(_msm_lanes)(bits, X, Y, Z)
+
+
 # == mesh-sharded kernels ==================================================
 #
 # Two shard axes, matching the two hot call patterns:
@@ -186,15 +206,16 @@ def sum_many_kernel(X, Y, Z):
 
 
 def _cross_shard_tree_sum(rX, rY, rZ, axes):
-    """all_gather per-shard Jacobian partials ([13] each) and tree-sum
-    them; non-pow2 shard counts pad with infinity lanes (Z = 0)."""
+    """all_gather per-shard Jacobian partials ([..., 13] each) and
+    tree-sum them over the gathered shard axis; non-pow2 shard counts
+    pad with infinity lanes (Z = 0)."""
     gX = lax.all_gather(rX, axes)
     gY = lax.all_gather(rY, axes)
     gZ = lax.all_gather(rZ, axes)
     s = gX.shape[0]
     cap = 1 << max(s - 1, 0).bit_length()
     if cap != s:
-        pad = ((0, cap - s), (0, 0))
+        pad = ((0, cap - s),) + ((0, 0),) * (gX.ndim - 1)
         gX = jnp.pad(gX, pad)
         gY = jnp.pad(gY, pad)
         gZ = jnp.pad(gZ, pad)
@@ -230,6 +251,24 @@ def _sharded_fn(mesh: Mesh, kind: str):
 
         fn = jax.jit(
             shard_map(local, mesh=mesh, in_specs=spec, out_specs=P(), check_rep=False)
+        )
+    elif kind == "msm_many":
+        # per-item MSMs with the LANE axis (axis 1) sharded: each shard
+        # double-and-adds + tree-sums its lane slice of every item, then
+        # ONE gather combines the [I, 13] partials — the per-item sums
+        # ride the same cross-shard Jacobian reduce as the single MSM,
+        # so results are byte-identical at any shard count
+        lane_spec = P(None, BATCH_AXES)
+
+        def local(bits, X, Y, Z):
+            pX, pY, pZ = jax.vmap(_msm_lanes)(bits, X, Y, Z)
+            return _cross_shard_tree_sum(pX, pY, pZ, BATCH_AXES)
+
+        fn = jax.jit(
+            shard_map(
+                local, mesh=mesh, in_specs=lane_spec, out_specs=P(),
+                check_rep=False,
+            )
         )
     else:  # "sum_many": item axis sharded, no collectives
 
@@ -363,6 +402,54 @@ def msm_g1_device(points: list, scalars: list[int], mesh: Mesh | None = None) ->
 def sum_g1_device(points: list, mesh: Mesh | None = None) -> Point:
     """Device point sum (unit-scalar MSM): sum_i points[i]."""
     return msm_g1_device(points, [1] * len(points), mesh=mesh)
+
+
+def msm_g1_many_device(
+    point_lists: list[list],
+    scalar_lists: list[list[int]],
+    mesh: Mesh | None = None,
+    pad_shape: tuple | None = None,
+) -> list[Point]:
+    """Independent full-scalar MSMs for many items in ONE dispatch:
+    ``[msm_g1(points, scalars) for ...]`` — the KZG RLC fold's seam.
+    Lanes pad to the pow2 of the widest item (``pad_shape`` overrides:
+    the serve layer passes its bucket so accounting and dispatch
+    agree); a multi-device `mesh` shards the LANE axis with the
+    cross-shard Jacobian combine, byte-identical to single-device.
+    Each result equals ``msm_g1_device(points, scalars)``."""
+    n = len(point_lists)
+    assert n == len(scalar_lists)
+    if n == 0:
+        return []
+    from eth_consensus_specs_tpu.parallel.mesh_ops import shard_count
+
+    shards = shard_count(mesh)
+    if shards <= 1:
+        mesh = None
+        shards = 1
+    max_lanes = max(len(p) for p in point_lists)
+    item_pad, lane_pad = pad_shape or (n, mesh_lane_pad(max_lanes, shards))
+    assert item_pad >= n and lane_pad >= max_lanes
+    bits = np.zeros((item_pad, lane_pad, SCALAR_BITS), np.uint64)
+    X = np.zeros((item_pad, lane_pad, N_LIMBS), np.uint64)
+    Y = np.zeros((item_pad, lane_pad, N_LIMBS), np.uint64)
+    Z = np.zeros((item_pad, lane_pad, N_LIMBS), np.uint64)
+    for i, (points, scalars) in enumerate(zip(point_lists, scalar_lists)):
+        assert len(points) == len(scalars)
+        if points:
+            X[i, : len(points)], Y[i, : len(points)], Z[i, : len(points)] = (
+                _points_to_limbs(points)
+            )
+            bits[i, : len(points)] = _scalars_to_bits([int(s) for s in scalars])
+    args = (jnp.asarray(bits), jnp.asarray(X), jnp.asarray(Y), jnp.asarray(Z))
+    if mesh is not None:
+        obs.count("mesh.dispatches", 1)
+        obs.count("mesh.sharded_items", n)
+        rX, rY, rZ = _sharded_fn(mesh, "msm_many")(*args)
+    else:
+        rX, rY, rZ = msm_many_kernel(*args)
+    rX, rY, rZ = np.asarray(rX), np.asarray(rY), np.asarray(rZ)
+    return [_jacobian_to_point(rX[i], rY[i], rZ[i]) for i in range(n)]
 
 
 def sum_g1_many_device(

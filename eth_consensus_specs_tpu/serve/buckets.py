@@ -221,6 +221,86 @@ def agg_lane_bucket(n: int, shards: int = 1) -> int:
     return mesh_batch_bucket(n, shards, ladder)
 
 
+# --------------------------------------------------- KZG / DAS buckets --
+#
+# The blob-verification op (submit_blob_verify / ops/kzg_batch) runs two
+# device dispatches per RLC check: ONE batched inverse fr_fft (blob
+# polynomial -> coefficients, batch axis = blobs per flush) and ONE
+# 2-item multi-MSM (the proof lincomb and the commitment-minus-y +
+# proof-z lincomb as lanes of a single kernel). The MSM's LANE axis is
+# what the mesh shards — a flush of n blobs folds into 2n+1 lanes — so
+# the lane bucket is the signed compile axis, like g2_agg's.
+
+
+def kzg_mesh_lanes() -> int:
+    """Smallest RLC lane count worth sharding the KZG multi-MSM's lane
+    axis over the mesh (below it the all-gather combine costs more than
+    the double-and-add lanes it saves; env-snapshotted per call, never
+    inside a trace — jit-purity)."""
+    raw = os.environ.get("ETH_SPECS_KZG_MESH_LANES", "")
+    try:
+        return max(int(raw), 1) if raw else 16
+    except ValueError:
+        return 16
+
+
+def kzg_lane_bucket(n_items: int, shards: int = 1) -> int:
+    """Lane-padding target of the KZG RLC fold: a flush of n blobs
+    needs 2n+1 lanes (commitments + proofs + the one generator lane),
+    item-bucketed pow2 first so flush sizes collapse into few compiles,
+    then padded per shard (the per-shard tree reduce needs pow2)."""
+    n = pow2_bucket(max(int(n_items), 1))
+    from eth_consensus_specs_tpu.ops.g1_msm import mesh_lane_pad
+
+    return mesh_lane_pad(2 * n + 1, shards)
+
+
+def kzg_msm_key_from_profile(n_items: int, shards: int = 1, sig: str = "") -> tuple:
+    """:func:`kzg_msm_key` computed from a replica profile (shards,
+    signature) instead of a live Mesh — same contract as
+    :func:`bls_msm_key_from_profile`."""
+    if shards > 1 and sig:
+        return ("kzg", kzg_lane_bucket(n_items, shards), sig)
+    return ("kzg", kzg_lane_bucket(n_items, 1))
+
+
+def kzg_msm_key(n_items: int, mesh=None) -> tuple:
+    """The compile/bucket/warmup key of the batched KZG RLC fold: the
+    lane bucket of a 2-item multi-MSM over 2n+1 lanes, mesh-signed when
+    the LANE axis shards. Single-device keys carry NO signature, like
+    every other unsigned key family."""
+    from eth_consensus_specs_tpu.parallel import mesh_ops
+
+    return kzg_msm_key_from_profile(
+        n_items, mesh_ops.shard_count(mesh), mesh_ops.mesh_signature(mesh)
+    )
+
+
+def fr_fft_key_from_profile(
+    batch: int, n: int, shards: int = 1, sig: str = ""
+) -> tuple:
+    """:func:`fr_fft_key` computed from a replica profile — the batch
+    axis buckets pow2 per shard (rows split evenly, no collectives)."""
+    from eth_consensus_specs_tpu.ops.g1_msm import mesh_lane_pad
+
+    if shards > 1 and sig:
+        return ("fr_fft", mesh_lane_pad(batch, shards), int(n), sig)
+    return ("fr_fft", pow2_bucket(max(int(batch), 1)), int(n))
+
+
+def fr_fft_key(batch: int, n: int, mesh=None) -> tuple:
+    """The compile/bucket/warmup key of a batched Fr FFT dispatch:
+    pow2-bucketed batch (rows per flush) + the intrinsic FFT size, plus
+    the mesh signature when the batch axis shards. The FFT had no
+    bucket/key discipline at all before the DAS workload landed — every
+    distinct blob-flush size was a fresh compile."""
+    from eth_consensus_specs_tpu.parallel import mesh_ops
+
+    return fr_fft_key_from_profile(
+        batch, n, mesh_ops.shard_count(mesh), mesh_ops.mesh_signature(mesh)
+    )
+
+
 # ------------------------------------------------- live compile-key fns --
 #
 # The serve/bucket compile keys are FUNCTIONS here, not inline tuple
@@ -349,6 +429,10 @@ def route_wide(kind: str, dim: int, max_batch: int) -> bool:
         # intrinsic dim is its pow2 committee-lane bucket, wide once it
         # clears the lane crossover regardless of flush size
         return int(dim) >= agg_mesh_lanes()
+    if kind == "kzg":
+        # the KZG RLC fold shards its LANE axis too: `dim` is the lane
+        # bucket the flush folds into (2n+1 lanes, pow2-bucketed)
+        return int(dim) >= kzg_mesh_lanes()
     return int(max_batch) >= mesh_ops.min_items()
 
 
@@ -361,8 +445,10 @@ def route_shape_of_key(key: tuple) -> tuple | None:
     dims = [d for d in key[1:] if not isinstance(d, str)]
     if op == "merkle_many" and len(dims) == 2:
         return (op, int(dims[1]))
-    if op in ("bls_msm", "g2_agg") and dims:
+    if op in ("bls_msm", "g2_agg", "kzg") and dims:
         return (op, int(dims[-1]))
+    if op == "fr_fft" and len(dims) == 2:
+        return (op, int(dims[1]))  # the intrinsic FFT size
     return None
 
 
@@ -419,6 +505,22 @@ def widen_warm_keys(
         )
         items = sorted({pow2_bucket(n) for n in range(1, cfg.max_batch + 1)})
         out += [("g2_agg", it, pad, sig) for it in items for pad in pads]
+    if any(k[0] == "kzg" and len(k) == 2 for k in out):
+        # signed RLC-fold lanes from the LIVE flush counts whose lane
+        # bucket clears the kzg crossover — the same lesson as the bls
+        # branch (pad-of-pad is only idempotent for pow2 shard counts)
+        out += [
+            kzg_msm_key_from_profile(n, shards, sig)
+            for n in range(1, cfg.max_batch + 1)
+            if kzg_lane_bucket(n, 1) >= kzg_mesh_lanes()
+        ]
+    fft_sizes = sorted({k[2] for k in out if k[0] == "fr_fft" and len(k) == 3})
+    for nfft in fft_sizes:
+        out += [
+            fr_fft_key_from_profile(b, nfft, shards, sig)
+            for b in range(1, cfg.max_batch + 1)
+            if b >= floor
+        ]
     # distinct flush sizes can pad to one compile shape: dedupe, keep order
     return list(dict.fromkeys(out))
 
@@ -637,6 +739,32 @@ def precompile(
                 pk, msg = _bls.SkToPk(1), b"\x00" * 32
                 sig_b = bytes(_bls.Sign(1, msg))
                 verify_many([([bytes(pk)] * lanes, msg, sig_b)] * items, mesh=mesh)
+            elif op == "kzg" and len(int_dims) == 1:
+                from eth_consensus_specs_tpu.crypto.curve import g1_generator
+                from eth_consensus_specs_tpu.ops.g1_msm import msm_g1_many_device
+
+                # one throwaway lane per item at exactly the padded
+                # lane shape: results discarded, only the 2-item
+                # multi-MSM kernel compile matters
+                lanes = int_dims[0]
+                with first_dispatch(op, *dims):
+                    msm_g1_many_device(
+                        [[g1_generator()]] * 2, [[1]] * 2,
+                        mesh=mesh, pad_shape=(2, lanes),
+                    )
+            elif op == "fr_fft" and len(int_dims) == 2:
+                from eth_consensus_specs_tpu.crypto.kzg import compute_roots_of_unity
+                from eth_consensus_specs_tpu.ops.fr_fft import batch_fft_field
+
+                # one zero row padded to the bucketed batch: the
+                # inverse and forward tables share one executable
+                # (twiddles are traced args), so either direction warms
+                batch, nfft = int_dims
+                with first_dispatch(op, *dims):
+                    batch_fft_field(
+                        [[0] * nfft], compute_roots_of_unity(nfft),
+                        inv=True, mesh=mesh, pad_batch=batch,
+                    )
             elif op == "g2_agg" and len(int_dims) == 2:
                 from eth_consensus_specs_tpu.crypto.curve import g2_generator
                 from eth_consensus_specs_tpu.ops.g2_aggregate import sum_g2_many_device

@@ -103,6 +103,10 @@ def _host_execute(kind: str, payload):
         from eth_consensus_specs_tpu.crypto.signature import aggregate
 
         return aggregate(list(payload[0]))
+    if kind == "kzg":
+        from eth_consensus_specs_tpu.ops.kzg_batch import verify_blob_host
+
+        return verify_blob_host(*payload)
     chunks, depth = payload
     from eth_consensus_specs_tpu.obs.watchdog import host_tree_root_words
     from eth_consensus_specs_tpu.ops.merkle import _chunks_to_words
@@ -191,6 +195,19 @@ class FrontDoorClient:
             "agg", (sigs,),
             ("g2_agg", buckets.pow2_bucket(max(len(sigs), 1))),
             96 * max(len(sigs), 1),
+        )
+
+    def submit_blob_verify(self, blob: bytes, commitment: bytes, proof: bytes) -> Future:
+        """Blob KZG verification through the fleet; resolves to the
+        exact bool ``ops.kzg_batch.verify_blob_host`` returns. Pure
+        function of its inputs, so hedging/failover are safe — same
+        contract as bls/htr. Affinity by the singleton RLC lane bucket
+        (the flush-dependent lane pad is the replica's business)."""
+        payload = (bytes(blob), bytes(commitment), bytes(proof))
+        return self._submit(
+            "kzg", payload,
+            ("kzg", buckets.kzg_lane_bucket(1)),
+            sum(len(b) for b in payload),
         )
 
     def submit_hash_tree_root(self, chunks: np.ndarray) -> Future:
@@ -747,6 +764,12 @@ class FrontDoor(FrontDoorClient):
                 },
             )
             self._rings[i].clear()
+            # the snapshot now lives in the postmortem bundle; clearing
+            # it here makes replica_stats()[i] unambiguous — None until
+            # the RESPAWNED process answers its own first probe, so a
+            # cold-compile gate can never read the dead predecessor's
+            # numbers as the replacement's
+            self._health[i] = None
             self._respawn_failures[i] = 0
         elif time.monotonic() < self._respawn_not_before[i]:
             return  # a failed respawn backs off instead of re-blocking

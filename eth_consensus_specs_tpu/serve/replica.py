@@ -150,6 +150,8 @@ class ReplicaServer:
                         fut = self.service.submit_hash_tree_root(msg["payload"][0])
                     elif msg["kind"] == "agg":
                         fut = self.service.submit_aggregate(*msg["payload"])
+                    elif msg["kind"] == "kzg":
+                        fut = self.service.submit_blob_verify(*msg["payload"])
                     else:
                         return {"ok": False, "err": "error",
                                 "detail": f"unknown kind {msg.get('kind')!r}"}

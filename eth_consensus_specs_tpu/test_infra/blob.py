@@ -18,3 +18,64 @@ def sample_blob(tag: bytes) -> bytes:
 
 def constant_blob(value: int) -> bytes:
     return value.to_bytes(32, "big") * kzg.FIELD_ELEMENTS_PER_BLOB
+
+
+# -- sparse-monomial blobs (the das_bench / kzg_batch registry builder) --
+#
+# A full-size blob whose polynomial has only `degree` monomial
+# coefficients: commitment and proof are then degree-lane MSMs over the
+# monomial setup points instead of 4096-lane ones — what makes
+# blob-scale registries constructible in seconds — while a VERIFIER
+# still does the full 4096-point work on every item.
+
+
+def sparse_poly_blob(coeffs: list[int]) -> bytes:
+    """The blob (brp evaluation form) of a low-degree monomial
+    polynomial: evaluations at the brp-ordered roots of unity, each a
+    K-term Horner."""
+    out = []
+    for w in kzg._roots_brp(kzg.FIELD_ELEMENTS_PER_BLOB):
+        acc = 0
+        for c in reversed(coeffs):
+            acc = (acc * w + c) % kzg.BLS_MODULUS
+        out.append(kzg.bls_field_to_bytes(acc))
+    return b"".join(out)
+
+
+def sparse_commit(coeffs: list[int]) -> bytes:
+    return kzg.g1_lincomb(kzg.get_setup().g1_monomial[: len(coeffs)], coeffs)
+
+
+def sparse_proof(coeffs: list[int], blob: bytes, commitment: bytes) -> bytes:
+    """The KZG proof at the Fiat-Shamir challenge via synthetic
+    division of the K coefficients — q(X) = (f(X) - f(z)) / (X - z)."""
+    z = kzg.compute_challenge(blob, commitment)
+    q = [0] * (len(coeffs) - 1)
+    acc = 0
+    for j in range(len(coeffs) - 1, 0, -1):
+        acc = (coeffs[j] + acc * z) % kzg.BLS_MODULUS
+        q[j - 1] = acc
+    if not q:
+        return kzg.G1_POINT_AT_INFINITY
+    return kzg.g1_lincomb(kzg.get_setup().g1_monomial[: len(q)], q)
+
+
+def sparse_blob_triple(
+    seed: int, degree: int = 6, tamper: bool = False
+) -> tuple[bytes, bytes, bytes]:
+    """One (blob, commitment, proof) triple from a seeded sparse
+    polynomial; ``tamper`` shifts the proof by the generator (still
+    on-curve, still subgroup — a False verdict, not a parse reject)."""
+    from eth_consensus_specs_tpu.crypto.curve import (
+        g1_from_bytes,
+        g1_generator,
+        g1_to_bytes,
+    )
+
+    coeffs = [(seed * 1009 + j * 31 + 1) % kzg.BLS_MODULUS for j in range(degree)]
+    blob = sparse_poly_blob(coeffs)
+    commitment = sparse_commit(coeffs)
+    proof = sparse_proof(coeffs, blob, commitment)
+    if tamper:
+        proof = g1_to_bytes(g1_from_bytes(proof) + g1_generator())
+    return blob, commitment, proof

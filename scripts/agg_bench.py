@@ -57,7 +57,12 @@ force_virtual_chips()
 
 import numpy as np  # noqa: E402
 
-from serve_bench import _LOST, closed_loop, finish_report  # noqa: E402
+from serve_bench import (  # noqa: E402
+    _LOST,
+    closed_loop,
+    finish_report,
+    wait_replicas_surveyed,
+)
 
 from eth_consensus_specs_tpu import obs  # noqa: E402
 from eth_consensus_specs_tpu.crypto import signature as sig_mod  # noqa: E402
@@ -337,7 +342,7 @@ def run_replicated(args) -> None:
     )
     load = [("agg", s) for s in sig_sets]
     wall_s, got, _lat = closed_loop(fd, load, args.submitters, result_timeout=600.0)
-    time.sleep(max(fd.fdcfg.probe_interval_s * 3, 0.5))  # one last probe round
+    wait_replicas_surveyed(fd)  # incl. a chaos respawn still booting
     replica_stats = fd.replica_stats()
     stats = fd.stats()
     fd.close()

@@ -2,6 +2,14 @@
 """Regenerate the INSECURE testing trusted setup (reference analogue:
 scripts/gen_kzg_trusted_setups.py).
 
+The output file documents its own provenance: its first JSON key is the
+``provenance`` string from ``crypto/kzg_setup.PROVENANCE`` stating that
+tau is derived from a public tag (the trapdoor discrete log is public —
+anyone can forge proofs), so a copied artifact still announces it is
+test-only. ``tests/test_kzg_ceremony_setup.py`` round-trips the
+generated setup: a known blob must verify against BOTH the host oracle
+(``crypto/kzg.py``) and the device path (``ops/kzg_batch.py``).
+
 Usage: python scripts/gen_kzg_trusted_setup.py [--g1 4096]
 """
 
@@ -19,7 +27,9 @@ def main() -> None:
 
     from eth_consensus_specs_tpu.crypto import kzg_setup
 
-    print(f"trusted setup written to {kzg_setup.write_setup(n=args.g1)}")
+    path = kzg_setup.write_setup(n=args.g1)
+    print(f"trusted setup written to {path}")
+    print(f"provenance (embedded in the file): {kzg_setup.PROVENANCE}")
 
 
 if __name__ == "__main__":

@@ -143,6 +143,15 @@ def load_rounds(repo_dir: str) -> list[dict]:
         for name, value in (parsed.get("agg") or {}).items():
             if isinstance(value, (int, float)):
                 metrics[f"agg_{name}" if not name.startswith("agg_") else name] = value
+        # DAS/KZG blob verification (scripts/das_bench.py): blob
+        # throughput rates (higher-is-better ``*_per_s`` plus the best
+        # flush wall) ride the same platform-keyed timeline as
+        # secondaries — the bench only EMITS them on a parity-coupled
+        # run, and the LKG re-earn rule below refuses anything else.
+        # Bools (the correctness_coupled flag) are not metrics.
+        for name, value in (parsed.get("das") or {}).items():
+            if isinstance(value, (int, float)) and not isinstance(value, bool):
+                metrics[f"das_{name}" if not name.startswith("das_") else name] = value
         # two-tier fleet matrix (serve_bench --replicas R --chips-matrix):
         # per-cell rps and per-effective-chip scaling factors, platform-
         # keyed like the mesh factors — secondaries, so regressions are
@@ -174,6 +183,36 @@ def load_lkg(repo_dir: str) -> dict:
         "sections": raw.get("sections") or {},
         "quarantined": sorted((raw.get("quarantined") or {}).get("sections", {})),
     }
+
+
+# sections the round-5 quarantine burned: their numbers were recorded
+# without correctness-coupled timing and may NEVER grandfather back in —
+# a fresh entry must come from a run that proved device/host parity
+_REEARN_ONLY = ("das", "tree", "epoch", "resident")
+
+
+def reearn_violations(lkg: dict) -> list[str]:
+    """The re-earn-never-grandfather rule (test-pinned): a usable LKG
+    section that shares a name with a quarantined entry — or with any
+    once-quarantined section — is only legitimate when its run declared
+    its device/host coupling: ``correctness_coupled: true``
+    (scripts/das_bench.py's flag) or ``verified: true`` (bench.py's
+    ``_store_lkg`` form — the literal ``True``, not the "same-backend"
+    CPU-lane string). Both emitters set their flag ONLY on runs whose
+    device result matched a host recompute; copying the quarantined
+    numbers into ``sections`` without one fails the tracker."""
+    out = []
+    quarantined = set(lkg.get("quarantined") or ())
+    for name, section in (lkg.get("sections") or {}).items():
+        if name not in quarantined and name not in _REEARN_ONLY:
+            continue
+        coupled = isinstance(section, dict) and (
+            section.get("correctness_coupled") is True
+            or section.get("verified") is True
+        )
+        if not coupled:
+            out.append(name)
+    return sorted(out)
 
 
 def compare(entries: list[dict], threshold: float, strict: bool) -> tuple[list, list]:
@@ -288,6 +327,14 @@ def main() -> None:
         print("no BENCH_r*.json found", file=sys.stderr)
         raise SystemExit(2)
     lkg = load_lkg(args.repo_dir)
+    grandfathered = reearn_violations(lkg)
+    if grandfathered:
+        print(
+            "FAILED: quarantined LKG section(s) re-entered without a "
+            f"correctness-coupled run (re-earn, never grandfather): {grandfathered}",
+            file=sys.stderr,
+        )
+        raise SystemExit(1)
 
     # TPU rounds may also be checked against the (non-quarantined) LKG
     # sections by seeding the comparison history with a pseudo-round 0

@@ -181,6 +181,8 @@ def closed_loop(
                         fut = svc.submit_bls_aggregate(*payload)
                     elif kind == "agg":
                         fut = svc.submit_aggregate(payload)
+                    elif kind == "kzg":
+                        fut = svc.submit_blob_verify(*payload)
                     else:
                         fut = svc.submit_hash_tree_root(payload)
                 except serve.Overloaded as exc:
@@ -207,6 +209,29 @@ def closed_loop(
     for t in threads:
         t.join()
     return time.perf_counter() - t0, results, latencies
+
+
+def wait_replicas_surveyed(fd, timeout_s: float = 600.0) -> None:
+    """Block until every live replica slot has answered a health probe
+    since its CURRENT process came up. A chaos respawn's boot (the
+    warmup-artifact replay — real compile time) can outlive a small
+    load phase, and the supervisor clears a dead replica's health
+    snapshot on death, so the cold-compile gate must wait for the
+    replacement's OWN stats rather than read its predecessor's.
+    Bounded: a respawn that never comes up leaves its slot None and
+    the surveyed gate fails exactly as before."""
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        time.sleep(max(fd.fdcfg.probe_interval_s * 2, 0.5))
+        # live slots FIRST, stats second: an autoscaler grow landing
+        # between the two calls may add a slot the stats snapshot does
+        # not cover yet — that slot is simply not-yet-surveyed, not an
+        # index error
+        live = getattr(fd, "live_replicas", None)
+        stats = fd.replica_stats()
+        idxs = live() if live is not None else range(len(stats))
+        if all(i < len(stats) and stats[i] is not None for i in idxs):
+            return
 
 
 def latency_histogram(latencies_s: list[float]) -> dict:
@@ -320,7 +345,7 @@ def run_replicated(args) -> None:
 
     load = [("bls", it) for it in bls_items] + [("htr", t) for t in trees]
     wall_s, got, _lat = closed_loop(fd, load, args.submitters)
-    time.sleep(max(fd.fdcfg.probe_interval_s * 3, 0.5))  # one last probe round
+    wait_replicas_surveyed(fd)  # incl. a chaos respawn still booting
     stats = fd.stats()
     replica_stats = fd.replica_stats()
     fd.close()  # merges each survivor's final obs delta
@@ -552,7 +577,7 @@ def run_fleet_matrix(args) -> None:
                 # wrong-answer cell must never look like a fast cell
                 failures.append(f"cell ({r},{c}): byte parity FAILED")
                 return cell
-            time.sleep(max(fd.fdcfg.probe_interval_s * 3, 0.5))
+            wait_replicas_surveyed(fd)
             cold = {
                 i: s["compiles_after_ready"]
                 for i, s in enumerate(fd.replica_stats())
@@ -721,7 +746,7 @@ def _run_het_phase(
         deadline = time.monotonic() + 60
         while _counter("frontdoor.replicas_retired") < 1 and time.monotonic() < deadline:
             time.sleep(fd_cfg.probe_interval_s)  # idle: no traffic at all
-        time.sleep(max(fd_cfg.probe_interval_s * 3, 0.5))
+        wait_replicas_surveyed(fd)
         replica_stats = fd.replica_stats()
         profiles = fd.replica_profiles()
         stats = fd.stats()

@@ -1,0 +1,255 @@
+"""Device-batched KZG blob verification (ops/kzg_batch) + its serve
+wiring and the insecure-setup provenance round-trip.
+
+Fast lane: parse/verdict semantics against the host oracle's reject
+surface, the live fr_fft/kzg compile-key fns, the generated setup's
+embedded provenance + generation-math round-trip at a toy size, the
+host-level full-size setup round-trip, and the serve degrade path
+(fault-forced — no XLA compiles anywhere in the fast lane).
+
+Slow lane (nightly, like the rest of the device-crypto suite): the
+device pipeline end to end — batched inverse-FFT challenge evaluation,
+the ONE RLC multi-MSM, bisection isolation — bit-identical to
+crypto/kzg.py, and the device half of the setup round-trip."""
+
+from __future__ import annotations
+
+from concurrent.futures import wait
+
+import pytest
+
+from eth_consensus_specs_tpu import fault, serve
+from eth_consensus_specs_tpu.crypto import kzg, kzg_setup
+from eth_consensus_specs_tpu.crypto.curve import (
+    g1_from_bytes,
+    g1_generator,
+    g2_from_bytes,
+    g2_generator,
+)
+from eth_consensus_specs_tpu.crypto.fields import R
+from eth_consensus_specs_tpu.ops import kzg_batch
+from eth_consensus_specs_tpu.serve import buckets
+from eth_consensus_specs_tpu.serve.config import ServeConfig
+
+# the ONE sparse-monomial construction, shared with scripts/das_bench.py
+# — this suite exercises exactly what the bench runs
+from eth_consensus_specs_tpu.test_infra.blob import sparse_blob_triple
+
+
+@pytest.fixture(scope="module")
+def triples():
+    return [sparse_blob_triple(i) for i in range(3)]
+
+
+# ----------------------------------------------------- verdict semantics --
+
+
+def test_parse_rejects_exactly_what_the_host_oracle_rejects(triples):
+    """parse_item's None set must equal verify_blob_host's False-by-
+    malformation set — the serve layer's per-item verdict contract."""
+    blob, c, p = triples[0]
+    assert kzg_batch.parse_item((blob, c, p)) is not None
+    bad = [
+        (blob[:-1], c, p),  # short blob
+        (blob, c[:-1], p),  # short commitment
+        (blob, c, p + b"\x00"),  # long proof
+        # field element >= modulus in the first blob slot
+        (R.to_bytes(32, "big") + blob[32:], c, p),
+        # not-a-point commitment (flipped compression bits)
+        (blob, b"\x01" * 48, p),
+        (blob, c, b"\x01" * 48),
+    ]
+    for item in bad:
+        assert kzg_batch.parse_item(item) is None
+        assert kzg_batch.verify_blob_host(*item) is False
+    # infinity is a VALID G1 encoding for commitment and proof
+    inf = kzg.G1_POINT_AT_INFINITY
+    assert kzg_batch.parse_item((blob, inf, inf)) is not None
+
+
+def test_host_verdicts_on_valid_and_tampered(triples):
+    blob, c, p = triples[0]
+    assert kzg_batch.verify_blob_host(blob, c, p) is True
+    _, _, bad = sparse_blob_triple(0, tamper=True)
+    assert kzg_batch.verify_blob_host(blob, c, bad) is False
+
+
+# ------------------------------------------------------------- key fns --
+
+
+def test_kzg_key_fns_bucket_and_sign():
+    # a flush of n blobs folds into 2n+1 lanes, item-bucketed pow2
+    assert buckets.kzg_lane_bucket(1) == 4
+    assert buckets.kzg_lane_bucket(2) == 8
+    assert buckets.kzg_lane_bucket(3) == 16  # pow2(3)=4 -> 2*4+1 -> 16
+    assert buckets.kzg_msm_key(3) == ("kzg", 16)
+    # flush sizes sharing an item bucket share a compile
+    assert buckets.kzg_msm_key(5) == buckets.kzg_msm_key(8)
+    # profile form agrees with the unsigned live form
+    assert buckets.kzg_msm_key_from_profile(3) == buckets.kzg_msm_key(3)
+    signed = buckets.kzg_msm_key_from_profile(8, shards=4, sig="cpu2x2")
+    assert signed[0] == "kzg" and signed[-1] == "cpu2x2"
+    # fr_fft: pow2 batch bucket + the intrinsic FFT size
+    assert buckets.fr_fft_key(3, 4096) == ("fr_fft", 4, 4096)
+    assert buckets.fr_fft_key_from_profile(3, 4096, 4, "cpu2x2") == (
+        "fr_fft", 4, 4096, "cpu2x2",
+    )
+    # the router sees the lane bucket / FFT size as the warmable shape
+    assert buckets.route_shape_of_key(("kzg", 16)) == ("kzg", 16)
+    assert buckets.route_shape_of_key(("fr_fft", 4, 4096)) == ("fr_fft", 4096)
+    # wide routing keys on the lane crossover
+    assert buckets.route_wide("kzg", buckets.kzg_lane_bucket(8), 8)
+    assert not buckets.route_wide("kzg", buckets.kzg_lane_bucket(1), 1)
+
+
+def test_widen_warm_keys_generates_signed_kzg_and_fft_variants():
+    cfg = ServeConfig(max_batch=8, buckets=(1, 2, 4, 8))
+    base = [("kzg", 4), ("fr_fft", 1, 4096)]
+    out = buckets.widen_warm_keys(base, cfg, shards=4, sig="cpu2x2")
+    assert ("kzg", 4) in out and ("fr_fft", 1, 4096) in out
+    signed_kzg = [k for k in out if k[0] == "kzg" and k[-1] == "cpu2x2"]
+    signed_fft = [k for k in out if k[0] == "fr_fft" and k[-1] == "cpu2x2"]
+    assert signed_kzg, "no signed kzg lane shapes for the wide profile"
+    assert signed_fft, "no signed fr_fft batch shapes for the wide profile"
+    # narrow profiles get the unsigned list verbatim
+    assert buckets.widen_warm_keys(base, cfg, shards=1, sig="") == base
+
+
+# ------------------------------------------------ setup provenance --
+
+
+def test_generated_setup_embeds_provenance_and_round_trips_tiny():
+    """generate_setup's first key documents the insecure provenance,
+    and the generation math round-trips: monomial points are tau-power
+    multiples of G, the Lagrange points interpolate them (checked via
+    the L_i(tau) scalar identity), and g2[1] = tau*G2."""
+    setup = kzg_setup.generate_setup(n=4, g2_length=2)
+    assert list(setup)[0] == "provenance"
+    assert "INSECURE" in setup["provenance"]
+    assert "public" in setup["provenance"]
+    assert setup["provenance"] == kzg_setup.PROVENANCE
+    tau = kzg_setup.testing_tau()
+    G, G2 = g1_generator(), g2_generator()
+    for i in range(4):
+        assert g1_from_bytes(bytes.fromhex(setup["g1_monomial"][i][2:])) == G.mul(
+            pow(tau, i, R)
+        )
+    assert g2_from_bytes(bytes.fromhex(setup["g2_monomial"][1][2:])) == G2.mul(tau)
+    # Lagrange identity: sum_i L_i(tau) = 1, so the lagrange points sum to G
+    acc = None
+    for h in setup["g1_lagrange"]:
+        p = g1_from_bytes(bytes.fromhex(h[2:]))
+        acc = p if acc is None else acc + p
+    assert acc == G
+
+
+def test_committed_setup_file_carries_provenance_and_verifies_host(triples):
+    """The committed full-size artifact: provenance embedded, and a
+    known blob round-trips through the HOST path under it (the device
+    half is the slow-lane test below)."""
+    import json
+
+    raw = json.load(open(kzg_setup.setup_path(kzg.FIELD_ELEMENTS_PER_BLOB)))
+    assert raw.get("provenance") == kzg_setup.PROVENANCE
+    blob, c, p = triples[0]
+    assert kzg.verify_blob_kzg_proof(blob, c, p)
+
+
+# ------------------------------------------------------- serve wiring --
+
+
+def test_submit_blob_verify_degraded_path_matches_host(triples):
+    """The whole-flush host degrade: with the device path fault-killed,
+    submit_blob_verify futures must resolve to exactly the
+    verify_blob_host verdicts (valid True, tampered False, malformed
+    False) — no XLA anywhere."""
+    items = [
+        triples[0],
+        sparse_blob_triple(1, tamper=True),
+        (triples[2][0][:-1], triples[2][1], triples[2][2]),  # malformed
+    ]
+    want = [kzg_batch.verify_blob_host(*it) for it in items]
+    assert want == [True, False, False]
+    with fault.injected("serve.dispatch:raise:times=inf"):
+        with serve.VerifyService(
+            ServeConfig.from_env(max_batch=4, max_wait_ms=5)
+        ) as svc:
+            futs = [svc.submit_blob_verify(*it) for it in items]
+            wait(futs, timeout=120)
+            assert [f.result() for f in futs] == want
+
+
+def test_frontdoor_host_rung_serves_kzg(triples):
+    from eth_consensus_specs_tpu.serve.frontdoor import _host_execute
+
+    blob, c, p = triples[0]
+    assert _host_execute("kzg", (blob, c, p)) is True
+    _, _, bad = sparse_blob_triple(0, tamper=True)
+    assert _host_execute("kzg", (blob, c, bad)) is False
+
+
+def test_blob_admission_accounts_full_blob_bytes(triples):
+    """Admission at blob scale: one blob costs ~131 KiB, so a small
+    byte cap sheds the second submit while the queue cap never would."""
+    from eth_consensus_specs_tpu.serve.admission import AdmissionController, Overloaded
+
+    blob, c, p = triples[0]
+    cost = len(blob) + len(c) + len(p)
+    assert cost == kzg.BYTES_PER_BLOB + 96
+    ctrl = AdmissionController(max_queue=1024, max_bytes=cost + 10)
+    ctrl.admit(cost)
+    with pytest.raises(Overloaded) as exc_info:
+        ctrl.admit(cost)
+    assert exc_info.value.reason == "bytes"
+    ctrl.release(cost)
+
+
+# ------------------------------------------------------- device parity --
+# real kernel dispatches — nightly lane like the rest of device crypto
+
+
+@pytest.mark.slow
+def test_verify_many_blobs_device_parity_and_bisection(triples):
+    """Device verdicts bit-identical to the host oracle, tampered item
+    isolated via bisection, malformed item False without poisoning the
+    flush, and the batch twin equal to verify_blob_kzg_proof_batch."""
+    items = [
+        triples[0],
+        sparse_blob_triple(1, tamper=True),
+        triples[2],
+    ]
+    want = [kzg_batch.verify_blob_host(*it) for it in items]
+    assert kzg_batch.verify_many_blobs(items) == want == [True, False, True]
+    blobs, cs, ps = map(list, zip(*[triples[0], triples[2]]))
+    assert kzg_batch.verify_blob_kzg_proof_batch_device(blobs, cs, ps) is True
+    assert kzg.verify_blob_kzg_proof_batch(blobs, cs, ps) is True
+    # malformed rides along as False
+    mixed = items + [(triples[0][0][:100], triples[0][1], triples[0][2])]
+    assert kzg_batch.verify_many_blobs(mixed) == want + [False]
+
+
+@pytest.mark.slow
+def test_device_challenge_evaluation_matches_barycentric(triples):
+    """The batched inverse-FFT Lagrange path: y values bit-identical to
+    the host barycentric oracle, including a challenge that lands ON a
+    root of unity (the host's special case; coefficient form needs
+    none)."""
+    blob, c, p = triples[0]
+    parsed = kzg_batch.parse_item((blob, c, p))
+    poly, z = parsed[3], parsed[4]
+    (y,) = kzg_batch.challenge_evaluations([parsed])
+    assert y == kzg.evaluate_polynomial_in_evaluation_form(poly, z)
+    in_domain = list(parsed)
+    in_domain[4] = kzg._roots_brp(kzg.FIELD_ELEMENTS_PER_BLOB)[7]
+    (y2,) = kzg_batch.challenge_evaluations([tuple(in_domain)])
+    assert y2 == poly[7]
+
+
+@pytest.mark.slow
+def test_generated_setup_round_trips_on_device(triples):
+    """The setup round-trip's device half: the same known blob that
+    verifies under the host oracle verifies through the device pipeline
+    (FFT evaluation + RLC multi-MSM + pairing) under the generated
+    setup."""
+    blob, c, p = triples[0]
+    assert kzg_batch.verify_many_blobs([(blob, c, p)]) == [True]

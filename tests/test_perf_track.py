@@ -78,3 +78,94 @@ def test_agg_rate_drop_gates_and_rise_does_not(tmp_path):
     # direction a bare "_s" suffix rule would have inverted)
     assert (2, "attestations_agg_per_s") in flagged
     assert not any(r == 3 for r, _ in flagged)
+
+
+def test_load_rounds_ingests_das_section(tmp_path):
+    _write_round(
+        tmp_path, 1,
+        {
+            "metric": "blobs_per_s", "value": 40.0, "platform": "cpu",
+            "das": {
+                "blobs_per_s": 40.0,
+                "ffts_per_s": 40.0,
+                "flush_wall_s": 0.2,
+                "correctness_coupled": True,
+            },
+        },
+    )
+    rounds = perf_track.load_rounds(str(tmp_path))
+    assert len(rounds) == 1 and rounds[0]["status"] == "ok"
+    m = rounds[0]["metrics"]
+    assert m["das_blobs_per_s"] == 40.0
+    assert m["das_ffts_per_s"] == 40.0
+    assert m["das_flush_wall_s"] == 0.2
+    # the parity flag is a gate marker, not a metric (bool is an int
+    # subclass — the ingest must not let it ride the timeline)
+    assert "das_correctness_coupled" not in m
+    # direction table: blob rates are higher-is-better, walls lower
+    assert not perf_track._lower_is_better("das_blobs_per_s")
+    assert not perf_track._lower_is_better("das_ffts_per_s")
+    assert perf_track._lower_is_better("das_flush_wall_s")
+
+
+def test_quarantined_das_lkg_can_only_be_replaced_by_parity_coupled_run():
+    """The re-earn-never-grandfather rule: copying the quarantined das
+    numbers back into the usable LKG sections WITHOUT the
+    correctness_coupled flag fails the tracker; a parity-coupled
+    re-earned section passes; quarantined-only stays fine."""
+    quarantined = {"quarantined": ["das"], "sections": {}, "present": True}
+    assert perf_track.reearn_violations(quarantined) == []
+    grandfathered = {
+        "present": True,
+        "quarantined": ["das"],
+        "sections": {"das": {"das_ffts_per_sec": 621.1}},
+    }
+    assert perf_track.reearn_violations(grandfathered) == ["das"]
+    # das is re-earn-only even if the quarantine note itself is deleted
+    scrubbed = {
+        "present": True,
+        "quarantined": [],
+        "sections": {"das": {"blobs_per_s": 40.0}},
+    }
+    assert perf_track.reearn_violations(scrubbed) == ["das"]
+    reearned = {
+        "present": True,
+        "quarantined": ["das"],
+        "sections": {"das": {"blobs_per_s": 40.0, "correctness_coupled": True}},
+    }
+    assert perf_track.reearn_violations(reearned) == []
+    # bench.py's _store_lkg form counts too: verified must be the
+    # literal True, NOT the "same-backend" CPU-lane string it writes
+    # when coupling did not actually run against a host recompute
+    bench_form = {
+        "present": True,
+        "quarantined": ["tree"],
+        "sections": {"tree": {"hashes_per_sec": 3e9, "verified": True}},
+    }
+    assert perf_track.reearn_violations(bench_form) == []
+    cpu_lane = {
+        "present": True,
+        "quarantined": ["epoch"],
+        "sections": {"epoch": {
+            "fused_epoch_ms": 5.0,
+            "verified": "same-backend (CPU lane; coupling applies to accelerator runs)",
+        }},
+    }
+    assert perf_track.reearn_violations(cpu_lane) == ["epoch"]
+    # a truthy-but-not-True flag is not a parity proof
+    sloppy = {
+        "present": True,
+        "quarantined": [],
+        "sections": {"das": {"correctness_coupled": 1.0}},
+    }
+    assert perf_track.reearn_violations(sloppy) == ["das"]
+
+
+def test_current_repo_lkg_passes_reearn_rule():
+    """The committed BENCH_LKG.json (das et al. quarantined, usable
+    sections empty) must satisfy the rule perf_track now gates on."""
+    repo = os.path.join(os.path.dirname(__file__), "..")
+    lkg = perf_track.load_lkg(repo)
+    assert lkg["present"]
+    assert "das" in lkg["quarantined"]
+    assert perf_track.reearn_violations(lkg) == []

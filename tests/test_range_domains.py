@@ -459,3 +459,85 @@ def test_pairing_active_mask_corners_vs_host_miller():
     )
     one = type(want).one()
     assert tw.limbs_to_fq12(np.asarray(f)) == one
+
+
+def test_kzg_msm_domains_declare_the_corners():
+    """The 12th family (the KZG RLC fold's batched multi-MSM) declares
+    the same contract as g1_msm: scalar bits in {0, 1} and redundant
+    [0, 2p) Jacobian coordinates with the zero / p-1 / 2p-1 corners —
+    the zero coordinate corner IS the infinity-lane encoding the blob
+    batch pads with."""
+    v = _variant("kzg_msm")
+    assert {int(c) for _, c in _corners(v.domains[0])} == {0, 1}
+    for dom in v.domains[1:]:
+        labels = {lab for lab, _ in _corners(dom)}
+        assert {"zero", "p-1", "2p-1"} <= labels, dom.name
+
+
+@pytest.mark.slow
+def test_kzg_msm_per_item_msms_at_corners_vs_host():
+    """msm_many_kernel at the declared corners, against the host
+    Pippenger oracle: all-zero scalar bits -> every item infinity,
+    all-one bits -> the max 256-bit scalar per lane, and an item of
+    all-zero coordinate lanes (the declared zero corner = the infinity
+    padding the blob flush uses) -> infinity regardless of bits."""
+    from eth_consensus_specs_tpu.crypto.curve import g1_generator, g1_infinity
+    from eth_consensus_specs_tpu.crypto.msm import msm_g1
+    from eth_consensus_specs_tpu.ops import g1_msm as gm
+
+    v = _variant("kzg_msm")
+    items, lanes = v.args[0].shape[:2]
+    assert items >= 2
+    G = g1_generator()
+    pts = [G.mul(j + 1) for j in range(lanes)]
+    pX, pY, pZ = gm._points_to_limbs(pts)
+    X = np.zeros((items, lanes, 13), np.uint64)
+    Y = np.zeros_like(X)
+    Z = np.zeros_like(X)
+    # item 0 carries real points; item 1.. stays the all-zero coordinate
+    # corner (infinity lanes)
+    X[0], Y[0], Z[0] = pX, pY, pZ
+    bits_dom = v.domains[0]
+    for label, bit in _corners(bits_dom):
+        bits = np.full((items, lanes, gm.SCALAR_BITS), bit, np.uint64)
+        oX, oY, oZ = (
+            np.asarray(o)
+            for o in gm.msm_many_kernel(
+                jnp.asarray(bits), jnp.asarray(X), jnp.asarray(Y), jnp.asarray(Z)
+            )
+        )
+        k = 0 if int(bit) == 0 else (1 << gm.SCALAR_BITS) - 1
+        assert gm._jacobian_to_point(oX[0], oY[0], oZ[0]) == msm_g1(
+            pts, [k] * lanes
+        ), label
+        for i in range(1, items):
+            assert gm._jacobian_to_point(oX[i], oY[i], oZ[i]) == g1_infinity(), label
+
+
+@pytest.mark.slow
+def test_kzg_challenge_evaluation_at_fr_root_of_unity_edges_vs_host_oracle():
+    """The kzg_batch evaluation path at the Fr roots-of-unity EDGE
+    values (w^0 = 1, w^1, w^(n-1) — the boundary members of the
+    evaluation domain) and at the field's own edges (0, r-1) as
+    challenges: the device inverse-FFT + Horner value must equal the
+    crypto/kzg.py barycentric oracle bit for bit, in-domain special
+    case included."""
+    from eth_consensus_specs_tpu.crypto import kzg
+    from eth_consensus_specs_tpu.ops import kzg_batch
+
+    n = kzg.FIELD_ELEMENTS_PER_BLOB
+    roots = kzg.compute_roots_of_unity(n)
+    poly = [(j * 7919 + 3) % kzg.BLS_MODULUS for j in range(n)]
+    blob = b"".join(kzg.bls_field_to_bytes(x) for x in poly)
+    base = kzg_batch.parse_item((blob, kzg.G1_POINT_AT_INFINITY,
+                                 kzg.G1_POINT_AT_INFINITY))
+    assert base is not None
+    edges = [roots[0], roots[1], roots[n - 1], 0, kzg.BLS_MODULUS - 1]
+    parsed = []
+    for z in edges:
+        row = list(base)
+        row[4] = z
+        parsed.append(tuple(row))
+    got = kzg_batch.challenge_evaluations(parsed)
+    want = [kzg.evaluate_polynomial_in_evaluation_form(poly, z) for z in edges]
+    assert got == want
