@@ -52,6 +52,22 @@ Postmortem/attribution layer (obs/flight.py + obs/xprof.py):
     compiler's bytes-accessed (advisory
     ``xprof.cost_model_mismatch`` counter past tolerance).
 
+Waterfall layer (obs/waterfall.py + obs/devprof.py + obs/ledger.py):
+
+  * ``obs.waterfall`` — the request stage clock: every serve Request
+    carries a monotonic stamp vector; resolve folds it into contiguous
+    ``serve.stage_ms.<stage>`` histograms (unattributed time is a
+    first-class ``other`` stage) and a bounded trace-id stash carries
+    durations across the replica wire, so the front door attributes
+    fleet-wide p99 by stage (docs/observability.md).
+  * ``obs.devprof`` — measured device execution time per dispatch
+    (``device.exec_ms.<kernel>``) with roofline verdicts from MEASURED
+    seconds, plus env-gated sampled ``jax.profiler`` trace windows.
+  * ``obs.ledger`` — the HBM residency ledger: long-lived device
+    buffers register bytes per owner (``hbm.resident_bytes.<owner>``
+    gauges, high-water via gauge max), embedded in every postmortem
+    bundle as ``bundle["hbm"]``.
+
 Environment:
     ETH_SPECS_OBS=0              disable all recording
     ETH_SPECS_OBS_JSONL=<path>   stream structured events as JSON lines
@@ -67,6 +83,9 @@ Environment:
                                  a ring entry (default 65536)
     ETH_SPECS_OBS_XPROF=1        enable ambient XLA attribution capture
     ETH_SPECS_OBS_XPROF_TOL=<f>  cost-model mismatch tolerance (0.25)
+    ETH_SPECS_OBS_DEVPROF=1      enable sampled jax.profiler trace windows
+    ETH_SPECS_OBS_DEVPROF_WINDOWS=<n>  trace windows per process (default 2)
+    ETH_SPECS_OBS_DEVPROF_DIR=<dir>    profiler trace destination
     ETH_SPECS_SLO_WAIT_P99_MS    serve wait p99 SLO bound (default 250)
     ETH_SPECS_SLO_DEGRADED_RATE  degraded-per-request SLO bound (0.01)
 """
@@ -74,11 +93,14 @@ Environment:
 from __future__ import annotations
 
 from . import (  # noqa: F401  (public submodules)
+    devprof,
     export,
     flight,
     gates,
+    ledger,
     slo,
     trace,
+    waterfall,
     watchdog,
     xprof,
 )

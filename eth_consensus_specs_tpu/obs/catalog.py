@@ -160,7 +160,24 @@ CATALOG: tuple[Metric, ...] = (
     _h("serve.compile_ms", "first-dispatch compile wall ms"),
     _h("serve.compile_ms.*", "first-dispatch compile wall ms per op"),
     _h("serve.wait_ms", "request wait from submit to flush, ms"),
+    _h("serve.stage_ms.*",
+       "per-request waterfall stage ms (admit/queue/prep/handoff/dispatch_wait/"
+       "device/resolve/other/total, plus the front door's wire residual)"),
     _s("serve.dispatch", "one batched device dispatch"),
+    # ------------------------------------------------------------- device --
+    _h("device.exec_ms", "measured device execution ms per dispatch (devprof)"),
+    _h("device.exec_ms.*", "measured device execution ms per kernel"),
+    _c("device.roofline_violations",
+       "measured device timings implying impossible bandwidth"),
+    _c("device.roofline_violations.*", "measured-roofline violations per kernel"),
+    _c("device.devprof.windows", "jax.profiler trace windows captured"),
+    _c("device.devprof.unavailable", "profiler trace attempts that degraded"),
+    # ---------------------------------------------------------------- hbm --
+    _g("hbm.resident_bytes.*", "ledger-registered device bytes per owner"),
+    _g("hbm.resident_bytes_total", "ledger-registered device bytes, all owners"),
+    _c("hbm.registrations", "HBM ledger buffer registrations"),
+    _c("hbm.donations", "HBM ledger buffers closed by jit donation"),
+    _c("hbm.deletions", "HBM ledger buffers closed by deletion"),
     # --------------------------------------------------------- frontdoor --
     _c("frontdoor.backoffs", "router backoffs honored"),
     _c("frontdoor.cancelled", "front-door futures cancelled"),

@@ -15,6 +15,10 @@ lives here once:
   * ``histograms`` — bucket-count deltas (counts/sum as differences,
     min/max as current values — they only tighten, so repeated merging
     is idempotent);
+  * ``spans`` — span-aggregate deltas (calls/total seconds/work bytes/
+    roofline violations as differences, min/max seconds as current
+    values): replica-side device timings — and their roofline verdicts
+    — were invisible to the parent snapshot before these shipped;
   * ``flight`` — the shipper process's flight-recorder ring entries
     since the previous ship (obs/flight.py). The parent keeps a bounded
     per-child copy, so a SIGKILLed child still leaves a black box the
@@ -51,6 +55,7 @@ class DeltaShipper:
         self._counter_base: dict = {}
         self._gauge_base: dict = {}
         self._hist_base: dict = {}
+        self._span_base: dict = {}
         self._flight_base = 0
         if swallow_initial:
             self.delta()
@@ -83,11 +88,23 @@ class DeltaShipper:
                 delta["sum"] = hsnap["sum"] - base["sum"]
             self._hist_base[name] = hsnap
             hists[name] = delta
+        spans = {}
+        for name, sagg in snap["spans"].items():
+            base = self._span_base.get(name)
+            if base is not None and sagg.get("count") == base.get("count"):
+                continue
+            sdelta = dict(sagg)
+            if base is not None:
+                for k in ("count", "total_s", "work_bytes", "roofline_violations"):
+                    sdelta[k] = sagg.get(k, 0) - base.get(k, 0)
+            self._span_base[name] = sagg
+            spans[name] = sdelta
         self._flight_base, ring_delta = flight.ship_since(self._flight_base)
         return {
             "counters": {k: v for k, v in counters.items() if v},
             "gauges": gauges,
             "histograms": hists,
+            "spans": spans,
             "flight": ring_delta,
         }
 
@@ -103,5 +120,7 @@ def merge_delta(delta: dict, ring: deque | None = None) -> None:
         reg.merge_gauge(name, g)
     for name, hsnap in delta.get("histograms", {}).items():
         reg.merge_histogram(name, hsnap)
+    for name, sagg in delta.get("spans", {}).items():
+        reg.merge_span(name, sagg)
     if ring is not None:
         ring.extend(delta.get("flight", ()))

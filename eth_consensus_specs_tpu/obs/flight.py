@@ -271,6 +271,16 @@ def dump(
             "ring": ring_events if ring_events is not None else ring(),
             "registry": get_registry().snapshot(),
         }
+        try:
+            # the HBM residency books (obs/ledger.py): pure numeric byte
+            # accounting per owner — an OOM bundle names who held the
+            # memory. Nothing env- or argv-shaped can enter via this
+            # section, so the redaction discipline above is untouched.
+            from . import ledger
+
+            bundle["hbm"] = ledger.postmortem_section()
+        except Exception:
+            pass
         if extra:
             bundle["extra"] = extra
         stamp = time.strftime("%Y%m%dT%H%M%S", time.gmtime())

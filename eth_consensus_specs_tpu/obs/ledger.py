@@ -1,0 +1,165 @@
+"""HBM residency ledger: who owns the device bytes, right now.
+
+At 1M-validator scale the device memory budget is the scarce resource:
+the resident state columns, the incremental merkle forest, the
+trusted-setup constants, and the warm jit caches all hold HBM for the
+life of the process, and an OOM today leaves nothing but an XLA
+allocator backtrace. The ledger is the owner-level account: every
+long-lived device buffer registers its bytes at creation, re-registers
+on replacement (ingest, epoch rollover), and deregisters on donation
+(``donate_argnums`` consumed it) or deletion. The books are exposed
+three ways:
+
+  * gauges — ``hbm.resident_bytes.<owner>`` per owner and
+    ``hbm.resident_bytes_total`` across owners; the registry's gauge
+    ``max`` IS the high-water mark, so the merged fleet snapshot
+    carries each replica's peak without extra machinery;
+  * counters — ``hbm.registrations`` / ``hbm.donations`` /
+    ``hbm.deletions`` for churn;
+  * :func:`postmortem_section` — a pure-numeric accounting block that
+    obs/flight.py embeds in every postmortem bundle as ``bundle["hbm"]``
+    (byte counts and owner names only — nothing env- or argv-shaped, so
+    the bundle's secret-redaction discipline is untouched), naming the
+    owners so the OOM black box answers "who held the memory".
+
+Owners in the serve stack: ``resident_state`` (parallel/resident.py),
+``merkle_forest`` (ops/merkle_inc.py epoch forests — donated buffers
+leave the books the moment run_epochs consumes them),
+``trusted_setup`` (KZG setup, FFT twiddles, sha round constants), and
+``jit_cache`` (serve/buckets.py first-dispatch live-array delta — an
+approximation of what a compile pinned, see the call site).
+
+The internal account is always live (cheap dict math) so tests can
+assert exact bytes with obs disabled; the gauges follow the usual
+``ETH_SPECS_OBS`` gate. Never raises.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+
+_LOCK = threading.Lock()
+_ENTRIES: dict = {}  # (owner, name) -> nbytes
+_HIGH_WATER = 0
+
+
+def _reinit_lock_after_fork_in_child() -> None:
+    global _LOCK
+    _LOCK = threading.Lock()
+
+
+os.register_at_fork(after_in_child=_reinit_lock_after_fork_in_child)
+
+
+def _publish_locked(owner: str) -> None:
+    """Refresh the owner + total gauges; caller holds the lock."""
+    global _HIGH_WATER
+    total = sum(_ENTRIES.values())
+    if total > _HIGH_WATER:
+        _HIGH_WATER = total
+    owner_total = sum(v for (o, _), v in _ENTRIES.items() if o == owner)
+    try:
+        from .registry import get_registry, obs_enabled
+
+        if obs_enabled():
+            reg = get_registry()
+            reg.gauge(f"hbm.resident_bytes.{owner}", owner_total)
+            reg.gauge("hbm.resident_bytes_total", total)
+    except Exception:  # noqa: BLE001 — bookkeeping must never take down a dispatch
+        pass
+
+
+def register(owner: str, name: str, nbytes: int) -> None:
+    """Record ``nbytes`` of device memory held by ``owner``'s buffer
+    ``name``. Re-registering the same (owner, name) REPLACES the entry —
+    an ingest that rebuilds its columns is an update, not a leak."""
+    if nbytes < 0:
+        return
+    with _LOCK:
+        _ENTRIES[(owner, name)] = int(nbytes)
+        _publish_locked(owner)
+    _count("hbm.registrations")
+
+
+def donate(owner: str, name: str) -> int:
+    """Close the entry because the buffer was DONATED into a jit
+    (donate_argnums consumed it); returns the bytes released."""
+    return _drop(owner, name, "hbm.donations")
+
+
+def delete(owner: str, name: str) -> int:
+    """Close the entry because the buffer was deleted/dropped."""
+    return _drop(owner, name, "hbm.deletions")
+
+
+def _drop(owner: str, name: str, counter: str) -> int:
+    with _LOCK:
+        freed = _ENTRIES.pop((owner, name), 0)
+        _publish_locked(owner)
+    if freed:
+        _count(counter)
+    return freed
+
+
+def _count(name: str) -> None:
+    try:
+        from .registry import get_registry, obs_enabled
+
+        if obs_enabled():
+            get_registry().count(name, 1)
+    except Exception:  # noqa: BLE001
+        pass
+
+
+# ----------------------------------------------------------------- reading --
+
+
+def resident_bytes(owner: str | None = None) -> int:
+    """Current resident total, for one owner or across the books."""
+    with _LOCK:
+        if owner is None:
+            return sum(_ENTRIES.values())
+        return sum(v for (o, _), v in _ENTRIES.items() if o == owner)
+
+
+def high_water_bytes() -> int:
+    with _LOCK:
+        return _HIGH_WATER
+
+
+def owners() -> dict:
+    """Per-owner resident bytes, sorted largest first."""
+    with _LOCK:
+        acc: dict = {}
+        for (o, _), v in _ENTRIES.items():
+            acc[o] = acc.get(o, 0) + v
+    return dict(sorted(acc.items(), key=lambda kv: -kv[1]))
+
+
+def postmortem_section(top: int = 10) -> dict:
+    """The bundle block: resident/high-water totals, per-owner split,
+    and the ``top`` largest entries. Pure numeric byte accounting —
+    nothing here may ever echo env values or argv."""
+    with _LOCK:
+        entries = sorted(_ENTRIES.items(), key=lambda kv: -kv[1])
+        total = sum(_ENTRIES.values())
+        hw = _HIGH_WATER
+    acc: dict = {}
+    for (o, _), v in entries:
+        acc[o] = acc.get(o, 0) + v
+    return {
+        "resident_total_bytes": total,
+        "high_water_bytes": hw,
+        "owners": dict(sorted(acc.items(), key=lambda kv: -kv[1])),
+        "top_entries": [
+            {"owner": o, "name": n, "bytes": v} for (o, n), v in entries[:top]
+        ],
+    }
+
+
+def reset_for_tests() -> None:
+    global _HIGH_WATER
+    with _LOCK:
+        _ENTRIES.clear()
+        _HIGH_WATER = 0

@@ -290,6 +290,39 @@ class Registry:
             cur["last"] = g.get("last", cur["last"])
             cur["max"] = max(cur["max"], g.get("max", 0.0))
 
+    def merge_span(self, name: str, agg: dict) -> None:
+        """Fold another process's span-aggregate DELTA in (obs/delta.py
+        ships count/total_s/work_bytes/roofline_violations as
+        differences; min_s/max_s as current values — they only tighten,
+        so repeated merging is idempotent). The merged roofline verdict
+        stays the all-calls conjunction: one replica's impossible timing
+        taints the fleet-wide span."""
+        if not obs_enabled():
+            return
+        with self._lock:
+            cur = self.spans.get(name)
+            if cur is None:
+                cur = self.spans[name] = {
+                    "count": 0,
+                    "total_s": 0.0,
+                    "min_s": float("inf"),
+                    "max_s": 0.0,
+                    "work_bytes": 0,
+                    "roofline_violations": 0,
+                    "parent": agg.get("parent"),
+                    "depth": agg.get("depth", 0),
+                }
+            cur["count"] += agg.get("count", 0)
+            cur["total_s"] += agg.get("total_s", 0.0)
+            cur["min_s"] = min(cur["min_s"], agg.get("min_s", float("inf")))
+            cur["max_s"] = max(cur["max_s"], agg.get("max_s", 0.0))
+            cur["work_bytes"] += int(agg.get("work_bytes", 0))
+            cur["roofline_violations"] += agg.get("roofline_violations", 0)
+            if "implied_gbps" in agg:
+                cur["implied_gbps"] = agg["implied_gbps"]  # shipper's last rate
+            if "roofline_ok" in agg or "roofline_ok" in cur:
+                cur["roofline_ok"] = cur["roofline_violations"] == 0
+
     # ------------------------------------------------------------ events --
 
     def emit(self, event: dict) -> None:

@@ -77,6 +77,27 @@ def fft_stages(vals, twiddles, n: int):
 
 
 @lru_cache(maxsize=None)
+def _device_twiddles(roots: tuple, n: int) -> tuple:
+    """The twiddle tables as (uncommitted) device arrays, uploaded once
+    per (roots, n) instead of per dispatch; their bytes are booked under
+    the ``trusted_setup`` owner in the HBM residency ledger — these are
+    the domain constants that live in device memory for the lifetime of
+    the process."""
+    tables = tuple(jnp.asarray(t) for t in _stage_twiddles(roots, n))
+    try:
+        from eth_consensus_specs_tpu.obs import ledger
+
+        ledger.register(
+            "trusted_setup",
+            f"fft_twiddles-{n}",
+            sum(int(t.nbytes) for t in tables),
+        )
+    except Exception:
+        pass
+    return tables
+
+
+@lru_cache(maxsize=None)
 def _compiled_fft(n: int, n_stages: int):
     """One executable per size; twiddles enter as traced args so coset
     variants and inverse roots reuse the same compilation. The input
@@ -127,8 +148,10 @@ def _sharded_fft(mesh: Mesh, n: int, n_stages: int):
 
 
 def _clear_sharded_after_fork_in_child() -> None:
-    # fork-safety: compiled executables reference the parent's devices
+    # fork-safety: compiled executables (and cached device twiddle
+    # uploads) reference the parent's devices
     _SHARDED_FFT.clear()
+    _device_twiddles.cache_clear()
 
 
 os.register_at_fork(after_in_child=_clear_sharded_after_fork_in_child)
@@ -145,7 +168,7 @@ def batch_fft_mont(
     assert n & (n - 1) == 0 and n == len(roots)
     rev = jnp.asarray(_bit_reversal_indices(n))
     vals = jnp.take(vals_mont, rev, axis=1)
-    twiddles = [jnp.asarray(t) for t in _stage_twiddles(tuple(roots), n)]
+    twiddles = list(_device_twiddles(tuple(roots), n))
     from eth_consensus_specs_tpu.parallel.mesh_ops import shard_count
 
     if mesh is not None and shard_count(mesh) > 1:

@@ -352,6 +352,16 @@ def build_static(
         top_chunks=jax.device_put(jnp.asarray(top_chunks)),
         zerohashes=jax.device_put(jnp.asarray(zerohash_words(41))),
     )
+    try:
+        from eth_consensus_specs_tpu.obs import ledger
+
+        ledger.register(
+            "resident_state",
+            f"static_tree-{n}",
+            sum(int(a.nbytes) for a in jax.tree_util.tree_leaves(arrays)),
+        )
+    except Exception:
+        pass
     meta = StateRootMeta(
         dynamic_slots=tuple(dynamic_slots), n_validators=n, top_depth=top_depth
     )
@@ -398,6 +408,19 @@ def synthetic_static(spec, n: int, seed: int = 0) -> tuple[StateRootArrays, Stat
         top_chunks=rnd((1 << top_depth, 8)),
         zerohashes=jax.device_put(jnp.asarray(zerohash_words(41))),
     )
+    try:
+        # creation-site HBM booking (obs/ledger.py): this static tree is
+        # resident for as long as the caller keeps it — bench processes
+        # hold it across the whole run
+        from eth_consensus_specs_tpu.obs import ledger
+
+        ledger.register(
+            "resident_state",
+            f"static_tree_synthetic-{n}",
+            sum(int(a.nbytes) for a in jax.tree_util.tree_leaves(arrays)),
+        )
+    except Exception:
+        pass
     return arrays, StateRootMeta(
         dynamic_slots=dynamic_slots, n_validators=n, top_depth=top_depth
     )

@@ -55,10 +55,28 @@ class ResidentCarry(NamedTuple):
     forest: object = None
 
 
+def _ledger_register(owner: str, name: str, tree) -> None:
+    """Book a device pytree's bytes in the HBM residency ledger
+    (obs/ledger.py) — host-level accounting only, never raises."""
+    try:
+        from eth_consensus_specs_tpu.obs import ledger
+
+        nbytes = sum(
+            int(getattr(a, "nbytes", 0)) for a in jax.tree_util.tree_leaves(tree)
+        )
+        if nbytes > 0:
+            ledger.register(owner, name, nbytes)
+    except Exception:
+        pass
+
+
 def ingest(spec, state) -> tuple[AltairEpochColumns, JustificationState]:
     """One host->device extraction of the columnar epoch inputs."""
     cols, just = spec.extract_epoch_columns(state)
-    return jax.device_put(cols), jax.device_put(just)
+    cols, just = jax.device_put(cols), jax.device_put(just)
+    _ledger_register("resident_state", "columns", cols)
+    _ledger_register("resident_state", "justification", just)
+    return cols, just
 
 
 def _balance_leaves(bal: jnp.ndarray, n: int) -> jnp.ndarray:
@@ -76,6 +94,7 @@ def ingest_full(spec, state):
     from eth_consensus_specs_tpu.ops.state_root import build_static
 
     cols, just = ingest(spec, state)
+    # build_static registers its own resident_state ledger entry
     return cols, just, build_static(spec, state)
 
 
@@ -106,6 +125,7 @@ def build_state_forest_device(
         cols.effective_balance,
         cols.inactivity_scores,
     )
+    _ledger_register("merkle_forest", "forest", forest)
     return forest, plan
 
 
@@ -221,6 +241,17 @@ def run_epochs(
             sp.result = acc
         obs.count("state_root.inc_roots", int(n_epochs))
         obs.count("state_root.inc_real_hashes", int(n_epochs) * real)
+        # the ledger mirrors the donation: the input forest's buffers were
+        # consumed by the run (donate_argnums above), the out_forest is the
+        # resident tree going forward — net footprint stays flat, and the
+        # hbm.donations counter records that the alias actually happened
+        try:
+            from eth_consensus_specs_tpu.obs import ledger
+
+            ledger.donate("merkle_forest", "forest")
+        except Exception:
+            pass
+        _ledger_register("merkle_forest", "forest", out_forest)
         return ResidentCarry(
             cols=out_cols, just=out_just, root_acc=acc, forest=out_forest
         )

@@ -43,6 +43,7 @@ import time
 
 from eth_consensus_specs_tpu import obs
 from eth_consensus_specs_tpu.analysis import lockwatch
+from eth_consensus_specs_tpu.obs import waterfall
 
 
 class Overloaded(RuntimeError):
@@ -124,9 +125,11 @@ class AdmissionController:
             )
             return self._retry_hint_locked(cost_bytes, reason)
 
-    def admit(self, cost_bytes: int) -> None:
+    def admit(self, cost_bytes: int, stamps: dict | None = None) -> None:
         """Reserve a slot or raise Overloaded. The slot is held until
-        :meth:`release` — i.e. until the request's future resolves."""
+        :meth:`release` — i.e. until the request's future resolves.
+        ``stamps`` is the request's waterfall vector: admission writes
+        the ``admitted`` mark, the first boundary after submit."""
         with self._lock:
             reason = None
             if self._depth + 1 > self.max_queue:
@@ -156,6 +159,7 @@ class AdmissionController:
                 retry_after_s=round(retry, 6),
             )
             raise Overloaded(reason, retry, depth, in_bytes)
+        waterfall.mark(stamps, "admitted")
         obs.gauge("serve.queue_depth", depth)
         obs.gauge("serve.in_flight_bytes", in_bytes)
 

@@ -33,6 +33,7 @@ from dataclasses import dataclass, field
 from typing import Any, Callable
 
 from eth_consensus_specs_tpu.analysis import lockwatch
+from eth_consensus_specs_tpu.obs import waterfall
 
 
 @dataclass
@@ -48,6 +49,9 @@ class Request:
     # through the batcher hand-off so flush/dispatch events can link
     # this request across the submit→batch→dispatch thread boundaries
     trace: Any = None
+    # waterfall stamp vector (obs/waterfall.py): monotonic marks written
+    # at each pipeline boundary, folded into serve.stage_ms.* at resolve
+    stamps: dict = field(default_factory=dict)
 
 
 class MicroBatcher:
@@ -67,6 +71,7 @@ class MicroBatcher:
             if self._closed:
                 raise RuntimeError("service is shut down")
             self._queue.append(req)
+            waterfall.mark(req.stamps, "queued")
             self._cond.notify_all()
 
     def qsize(self) -> int:

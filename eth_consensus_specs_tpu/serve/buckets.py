@@ -574,15 +574,34 @@ def observe_compile_ms(op: str, ms: float, n: int = 1) -> None:
         obs.observe(f"serve.compile_ms.{op}", ms)
 
 
+def _live_array_bytes() -> int:
+    """Total nbytes across the process's live device arrays; 0 when jax
+    (or the live_arrays probe) is unavailable. Only the first-dispatch
+    path pays this walk — once per compile, never per dispatch."""
+    try:
+        import jax
+
+        return sum(int(getattr(a, "nbytes", 0)) for a in jax.live_arrays())
+    except Exception:
+        return 0
+
+
 class first_dispatch:
     """``with first_dispatch(op, *dims):`` around the dispatch call —
     notes the shape key (``serve.compiles`` on first sighting) and, when
     this dispatch is the one paying the jit compile, records its wall
     time into ``serve.compile_ms``. The wall is recorded even when the
     block raises: the compile attempt happened and the histogram must
-    stay in lockstep with the ``serve.compiles`` counter."""
+    stay in lockstep with the ``serve.compiles`` counter.
 
-    __slots__ = ("op", "dims", "first", "_t0")
+    A first dispatch also posts the HBM ledger's ``jit_cache`` entry
+    (obs/ledger.py): the growth in live device-array bytes across the
+    compile — captured constants, donated staging buffers, and the
+    result the warm cache will keep reusing. An approximation (XLA's
+    executable itself is not a jax array), but it is the bytes a warm
+    cache pins that the resident-state/forest owners don't account."""
+
+    __slots__ = ("op", "dims", "first", "_t0", "_live0")
 
     def __init__(self, op: str, *dims):
         self.op = op
@@ -590,12 +609,24 @@ class first_dispatch:
 
     def __enter__(self) -> "first_dispatch":
         self.first = note_dispatch(self.op, *self.dims)
+        if self.first:
+            self._live0 = _live_array_bytes()
         self._t0 = time.perf_counter()
         return self
 
     def __exit__(self, exc_type, exc, tb) -> bool:
         if self.first:
             observe_compile_ms(self.op, (time.perf_counter() - self._t0) * 1e3)
+            if exc_type is None:
+                grown = _live_array_bytes() - self._live0
+                if grown > 0:
+                    from eth_consensus_specs_tpu.obs import ledger
+
+                    ledger.register(
+                        "jit_cache",
+                        "-".join((self.op, *map(str, self.dims))),
+                        grown,
+                    )
         return False
 
 

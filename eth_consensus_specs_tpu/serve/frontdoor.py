@@ -273,7 +273,10 @@ class FrontDoorClient:
                 hard_failures += 1
                 continue
             if resp.get("ok"):
-                self._resolve(req, value=resp["result"], is_hedge=is_hedge)
+                self._resolve(
+                    req, value=resp["result"], is_hedge=is_hedge,
+                    stages=resp.get("stages"),
+                )
                 return
             err = resp.get("err")
             if err == "overloaded":
@@ -401,7 +404,9 @@ class FrontDoorClient:
             target=_hedge_leg, daemon=True, name=f"{self.name}-hedge"
         ).start()
 
-    def _resolve(self, req: _FDRequest, value=None, exc=None, is_hedge=False) -> bool:
+    def _resolve(
+        self, req: _FDRequest, value=None, exc=None, is_hedge=False, stages=None,
+    ) -> bool:
         """Exactly-once resolution across racing legs (primary, hedge):
         the first caller releases the admission slot and sets the
         future; every later caller is a suppressed duplicate."""
@@ -417,6 +422,18 @@ class FrontDoorClient:
         e2e_s = time.monotonic() - req.t_submit
         self.admission.release(req.cost_bytes, service_s=e2e_s)
         obs.observe("frontdoor.e2e_ms", e2e_s * 1e3)
+        if stages:
+            # the replica shipped this request's per-stage DURATIONS in
+            # its reply (serve/replica.py). Its own stage histograms
+            # arrive via the obs delta — re-observing them here would
+            # double count — so the client records only what the replica
+            # cannot see: the wire residual, client e2e minus the
+            # replica's accounted total. Exactly-once by construction
+            # (the winning leg is the only one that reaches here).
+            obs.observe(
+                "serve.stage_ms.wire",
+                max(e2e_s * 1e3 - float(stages.get("total", 0.0)), 0.0),
+            )
         if is_hedge:
             obs.count("frontdoor.hedge_wins", 1)
         try:

@@ -42,7 +42,7 @@ import threading
 import time
 
 from eth_consensus_specs_tpu import fault, obs
-from eth_consensus_specs_tpu.obs import trace
+from eth_consensus_specs_tpu.obs import trace, waterfall
 from eth_consensus_specs_tpu.obs.delta import DeltaShipper
 
 from . import wire
@@ -140,7 +140,8 @@ class ReplicaServer:
             # the chaos seam: stall (→ client hedges), kill (→ parent
             # respawns + postmortem), raise — all via ETH_SPECS_FAULT
             fault.check(wire.SITE, tag=msg.get("kind"))
-            with trace.activate(trace.from_wire(msg.get("trace"))):
+            ctx = trace.from_wire(msg.get("trace"))
+            with trace.activate(ctx):
                 with obs.span("frontdoor.rpc", kind=msg.get("kind", "?")):
                     if msg["kind"] == "bls":
                         fut = self.service.submit_bls_aggregate(*msg["payload"])
@@ -155,7 +156,17 @@ class ReplicaServer:
                     else:
                         return {"ok": False, "err": "error",
                                 "detail": f"unknown kind {msg.get('kind')!r}"}
-                    return {"ok": True, "result": fut.result(timeout=300)}
+                    result = fut.result(timeout=300)
+                    # the service stashed this request's stage DURATIONS
+                    # by trace id at resolve (trace.child preserves the
+                    # id, so the Request shares it with our wire frame);
+                    # ship them in the reply — absolute monotonic stamps
+                    # would be meaningless in the client's clock domain
+                    stages = waterfall.pop(getattr(ctx, "trace_id", None))
+                    resp = {"ok": True, "result": result}
+                    if stages:
+                        resp["stages"] = stages
+                    return resp
         if op == "health":
             now = _compiles()
             return {

@@ -62,7 +62,7 @@ import numpy as np
 
 from eth_consensus_specs_tpu import fault, obs
 from eth_consensus_specs_tpu.analysis import lockwatch
-from eth_consensus_specs_tpu.obs import trace
+from eth_consensus_specs_tpu.obs import devprof, trace, waterfall
 from eth_consensus_specs_tpu.obs.histogram import Histogram
 from eth_consensus_specs_tpu.parallel import mesh_ops
 
@@ -115,11 +115,16 @@ class VerifyService:
     def _submit(self, kind: str, payload: tuple, cost_bytes: int) -> Future:
         if self._closed:
             raise RuntimeError(f"service {self.name} is shut down")
-        self.admission.admit(cost_bytes)  # raises Overloaded past the caps
+        # the waterfall anchor: t_submit and the stamp vector share one
+        # clock origin so the admit stage starts at zero, not at however
+        # long admission held its lock
+        t0 = time.monotonic()
+        stamps: dict = {}
+        self.admission.admit(cost_bytes, stamps)  # raises Overloaded past the caps
         # child of the caller's active trace (or a fresh root): the ids
         # ride the Request through the batch/dispatch thread hand-offs
         req = Request(kind=kind, payload=payload, cost_bytes=cost_bytes,
-                      trace=trace.child())
+                      t_submit=t0, trace=trace.child(), stamps=stamps)
         try:
             self._batcher.put(req)
         except RuntimeError:
@@ -205,6 +210,7 @@ class VerifyService:
             now = time.monotonic()
             flush_hist = Histogram()  # per-flush quantiles, same buckets
             for r in reqs:
+                waterfall.mark(r.stamps, "flush_assembled", now)
                 wait_ms = (now - r.t_submit) * 1000.0
                 flush_hist.record(wait_ms)
                 self._waits.record(wait_ms)
@@ -225,7 +231,11 @@ class VerifyService:
                 flows=[trace.to_wire(r.trace) for r in reqs if r.trace],
             )
             self._prep(reqs)
+            waterfall.mark_all(reqs, "prepped")
             self._dispatch_q.put(reqs)  # blocks at pipeline depth 2
+            # stamped AFTER the put so the handoff stage bills the
+            # depth-2 backpressure block, not the dispatch queue wait
+            waterfall.mark_all(reqs, "dispatch_queued")
         self._dispatch_q.put(None)
 
     def _prep(self, reqs: list[Request]) -> None:
@@ -290,6 +300,7 @@ class VerifyService:
                 continue
             t0 = time.monotonic()
             self._dispatch_busy = True
+            waterfall.mark_all(live, "device_start")
             try:
                 # the dispatch span can't BELONG to the N requests it
                 # serves, so it runs under its own context and LINKS
@@ -302,17 +313,22 @@ class VerifyService:
                             trace.to_wire(r.trace) for r in live if r.trace
                         ),
                     ):
-                        results = fault.degrade(
-                            "serve.dispatch",
-                            lambda: self._execute(live, device=True),
-                            lambda: self._execute(live, device=False),
-                        )
+                        # sampled jax.profiler window (off by default;
+                        # ETH_SPECS_OBS_DEVPROF=1 captures the first few
+                        # dispatches of the process)
+                        with devprof.trace_window("serve.dispatch"):
+                            results = fault.degrade(
+                                "serve.dispatch",
+                                lambda: self._execute(live, device=True),
+                                lambda: self._execute(live, device=False),
+                            )
             except BaseException as exc:  # noqa: BLE001 — futures carry the error
                 for r in live:
                     self._resolve(r, exc=exc)
                 continue
             finally:
                 self._dispatch_busy = False
+            waterfall.mark_all(live, "device_done")
             per_req_s = (time.monotonic() - t0) / len(live)
             for r in live:
                 self._resolve(r, value=results[id(r)], service_s=per_req_s)
@@ -336,10 +352,15 @@ class VerifyService:
                 # many-sum dispatch in first_dispatch, keyed by the
                 # shared many_sum_shape bucket + mesh signature), so the
                 # service just routes — mesh live shards the item axis
-                verdicts = verify_many(
-                    [r.payload for r in bls_reqs],
-                    mesh=mesh if len(bls_reqs) >= mesh_ops.min_items() else None,
-                )
+                # the verdicts come back as host bools, so the measured
+                # window includes the device sync — honest exec time
+                with devprof.measure(
+                    "bls_msm", work_bytes=sum(r.cost_bytes for r in bls_reqs)
+                ):
+                    verdicts = verify_many(
+                        [r.payload for r in bls_reqs],
+                        mesh=mesh if len(bls_reqs) >= mesh_ops.min_items() else None,
+                    )
             else:
                 from eth_consensus_specs_tpu.crypto.signature import fast_aggregate_verify
 
@@ -365,9 +386,12 @@ class VerifyService:
                     r.prepped[0] if r.prepped is not None else parse_item(r.payload)
                     for r in kzg_reqs
                 ]
-                verdicts = verify_many_blobs(
-                    [r.payload for r in kzg_reqs], mesh=mesh, parsed=parsed
-                )
+                with devprof.measure(
+                    "kzg", work_bytes=sum(r.cost_bytes for r in kzg_reqs)
+                ):
+                    verdicts = verify_many_blobs(
+                        [r.payload for r in kzg_reqs], mesh=mesh, parsed=parsed
+                    )
             else:
                 from eth_consensus_specs_tpu.ops.kzg_batch import verify_blob_host
 
@@ -397,10 +421,14 @@ class VerifyService:
                     len(agg_reqs), max_lanes, mesh=mesh if sharded else None
                 )
                 with buckets.first_dispatch(*key):
-                    sums = sum_g2_many_device(
-                        lists, mesh=mesh if sharded else None,
-                        pad_shape=(key[1], key[2]),
-                    )
+                    with devprof.measure(
+                        "g2_agg",
+                        work_bytes=sum(r.cost_bytes for r in agg_reqs),
+                    ):
+                        sums = sum_g2_many_device(
+                            lists, mesh=mesh if sharded else None,
+                            pad_shape=(key[1], key[2]),
+                        )
                 for r, p in zip(agg_reqs, sums):
                     results[id(r)] = g2_to_bytes(p)
             else:
@@ -435,10 +463,14 @@ class VerifyService:
                     mesh=mesh if sharded else None,
                 )
                 with buckets.first_dispatch(*key):
-                    roots = merkleize_many_device(
-                        trees, depth, pad_batch=key[1],
-                        mesh=mesh if sharded else None,
-                    )
+                    with devprof.measure(
+                        "merkle_many",
+                        work_bytes=sum(r.cost_bytes for r in group),
+                    ):
+                        roots = merkleize_many_device(
+                            trees, depth, pad_batch=key[1],
+                            mesh=mesh if sharded else None,
+                        )
             else:
                 from eth_consensus_specs_tpu.obs.watchdog import host_tree_root_words
                 from eth_consensus_specs_tpu.ops.merkle import _chunks_to_words
@@ -466,9 +498,12 @@ class VerifyService:
                 )
 
                 with buckets.first_dispatch(*state_root_compile_key(meta)):
-                    results[id(r)] = np.asarray(
-                        post_epoch_state_root(arrays, meta, balances, eff, inact, just)
-                    )
+                    # np.asarray IS the sync: the measured window closes
+                    # only once the root words are host-resident
+                    with devprof.measure("state_root", work_bytes=r.cost_bytes):
+                        results[id(r)] = np.asarray(
+                            post_epoch_state_root(arrays, meta, balances, eff, inact, just)
+                        )
             else:
                 from eth_consensus_specs_tpu.ops.state_root import post_epoch_state_root_host
 
@@ -493,6 +528,7 @@ class VerifyService:
         service_s: float | None = None,
     ) -> None:
         self._release_once(req, service_s)
+        waterfall.mark(req.stamps, "resolved")
         try:
             if exc is not None:
                 req.future.set_exception(exc)
@@ -502,6 +538,14 @@ class VerifyService:
             # a caller cancelled the pending future: its slot is already
             # released above; the worker threads must outlive the rudeness
             obs.count("serve.cancelled", 1)
+        # fold the stamp vector into the per-stage histograms, and stash
+        # the DURATIONS by trace id for the RPC layer — monotonic stamps
+        # don't cross a process boundary, durations do (obs/waterfall.py)
+        durations = waterfall.stage_durations_ms(req.t_submit, req.stamps)
+        if durations:
+            waterfall.observe(durations)
+            if req.trace is not None:
+                waterfall.stash(req.trace.trace_id, durations)
 
     # ------------------------------------------------------------- admin --
 

@@ -161,6 +161,20 @@ def load_rounds(repo_dir: str) -> list[dict]:
                 name.endswith("_scaling") or name.endswith("_rps")
             ):
                 metrics[f"fleet_{name}"] = value
+        # request waterfall (serve_bench's waterfall section,
+        # obs/waterfall.py): per-stage p50/p99 milliseconds ride the same
+        # platform-keyed timeline as secondaries — a stage-attribution
+        # blow-up (queue p99 doubling, device p99 creeping) surfaces as
+        # an advisory without crying wolf on every noisy CI box. Only the
+        # flat ``*_ms`` keys are metrics; the nested device/hbm dicts and
+        # coverage ratios are report structure, not timeline points.
+        for name, value in (parsed.get("waterfall") or {}).items():
+            if (
+                isinstance(value, (int, float))
+                and not isinstance(value, bool)
+                and name.endswith("_ms")
+            ):
+                metrics[f"stage_{name}"] = value
         entry.update(
             status="ok",
             platform=infer_platform(parsed),

@@ -90,6 +90,68 @@ def _top_counters(counters: dict, n: int = 12) -> list[tuple[str, float]]:
     return sorted(counters.items(), key=lambda kv: -abs(kv[1]))[:n]
 
 
+_STAGE_PREFIX = "serve.stage_ms."
+
+
+def _stage_rows(reg: dict) -> list[tuple[str, dict]]:
+    """(stage, histogram) rows from a bundle registry, waterfall order:
+    the named stages as obs/waterfall.py emits them, then other/total."""
+    hists = reg.get("histograms", {})
+    rows = {
+        name[len(_STAGE_PREFIX):]: h
+        for name, h in hists.items()
+        if name.startswith(_STAGE_PREFIX)
+    }
+    order = ["admit", "queue", "prep", "handoff", "dispatch_wait",
+             "device", "resolve", "wire", "other", "total"]
+    ordered = [(s, rows.pop(s)) for s in order if s in rows]
+    return ordered + sorted(rows.items())
+
+
+def _waterfall_lines(bundle: dict) -> list[str]:
+    """The waterfall view: per-stage p50/p99 table plus the HBM ledger's
+    resident/high-water marks — empty if the bundle predates either."""
+    reg = bundle.get("registry", {})
+    lines = []
+    rows = _stage_rows(reg)
+    if rows:
+        lines.append("  waterfall (serve.stage_ms):")
+        lines.append(f"    {'stage':<14} {'count':>7} {'p50_ms':>10} {'p99_ms':>10}")
+        for stage, h in rows:
+            p50, p99 = h.get("p50"), h.get("p99")
+            lines.append(
+                f"    {stage:<14} {h.get('count', 0):>7} "
+                f"{p50 if p50 is None else f'{p50:.3f}':>10} "
+                f"{p99 if p99 is None else f'{p99:.3f}':>10}"
+            )
+    dev = sorted(
+        (name[len("device.exec_ms."):], h)
+        for name, h in reg.get("histograms", {}).items()
+        if name.startswith("device.exec_ms.")
+    )
+    if dev:
+        lines.append("  device time (device.exec_ms):")
+        for kern, h in dev:
+            p50 = h.get("p50")
+            lines.append(
+                f"    {kern:<14} {h.get('count', 0):>7} runs, "
+                f"p50 {p50 if p50 is None else f'{p50:.3f}'} ms"
+            )
+    hbm = bundle.get("hbm")
+    if hbm:
+        lines.append(
+            f"  hbm ledger: resident {hbm.get('resident_total_bytes', 0):,} B, "
+            f"high water {hbm.get('high_water_bytes', 0):,} B"
+        )
+        for owner, nbytes in sorted((hbm.get("owners") or {}).items()):
+            lines.append(f"    {owner:<24} {nbytes:>14,} B")
+        for ent in hbm.get("top_entries", []) or []:
+            lines.append(
+                f"      {ent.get('owner')}/{ent.get('name')}: {ent.get('bytes', 0):,} B"
+            )
+    return lines
+
+
 def summarize(bundle: dict, path: str | None = None, ring_tail: int = _RING_TAIL) -> str:
     """Human-readable one-screen account of a bundle."""
     plat = bundle.get("platform", {})
@@ -116,6 +178,7 @@ def summarize(bundle: dict, path: str | None = None, ring_tail: int = _RING_TAIL
         lines.append("  top counters:")
         for name, val in _top_counters(counters):
             lines.append(f"    {name:<44} {val:g}")
+    lines += _waterfall_lines(bundle)
     extra = bundle.get("extra")
     if extra:
         worker_ring = extra.get("worker_ring")
@@ -151,6 +214,27 @@ def diff_bundles(a: dict, b: dict, a_name: str = "A", b_name: str = "B") -> str:
             lines.append(f"    {name:<44} {va:g} → {vb:g} ({'+' if d > 0 else ''}{d:g})")
     else:
         lines.append("  counters: identical")
+    ha = a.get("registry", {}).get("histograms", {})
+    hb = b.get("registry", {}).get("histograms", {})
+    stage_deltas = []
+    for name in sorted(set(ha) | set(hb)):
+        if not name.startswith(_STAGE_PREFIX):
+            continue
+        pa = (ha.get(name) or {}).get("p99")
+        pb = (hb.get(name) or {}).get("p99")
+        if pa != pb:
+            stage_deltas.append((name[len(_STAGE_PREFIX):], pa, pb))
+    if stage_deltas:
+        lines.append("  stage p99 deltas (serve.stage_ms):")
+        for stage, pa, pb in stage_deltas:
+            fa = "—" if pa is None else f"{pa:.3f}"
+            fb = "—" if pb is None else f"{pb:.3f}"
+            d = "" if pa is None or pb is None else f" ({pb - pa:+.3f})"
+            lines.append(f"    {stage:<14} {fa} → {fb} ms{d}")
+    wa = (a.get("hbm") or {}).get("high_water_bytes")
+    wb = (b.get("hbm") or {}).get("high_water_bytes")
+    if wa != wb:
+        lines.append(f"  hbm high water: {wa} → {wb} bytes")
     ea, eb = a.get("env", {}), b.get("env", {})
     env_drift = {
         k: (ea.get(k), eb.get(k))

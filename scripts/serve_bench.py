@@ -289,6 +289,111 @@ def finish_report(report: dict, failures: list, out: str, trigger: str, snap: di
         raise SystemExit(1)
 
 
+def waterfall_section(
+    failures: list,
+    out: str,
+    require_kernels: tuple = ("merkle_many", "bls_msm"),
+    require_resident: bool = True,
+) -> dict:
+    """The request-waterfall report section (obs/waterfall.py), shared by
+    the default, replicated and fleet modes, with its CI gates:
+
+      * per-stage p50/p99 from the ``serve.stage_ms.*`` histograms (flat
+        ``<stage>_p50_ms``/``<stage>_p99_ms`` keys — perf_track.py
+        ingests every numeric ``*_ms`` key as a secondary advisory);
+      * coverage: named-stage milliseconds must tile >= 95% of the
+        measured e2e wall (``total``), and the first-class ``other``
+        stage must stay under 20% of the e2e p50 — unattributed time is
+        reported, never silent, but it must not dominate;
+      * ``device.exec_ms.<kernel>`` populated for the headline kernel
+        families (the dispatch seams actually measured device time) with
+        zero roofline violations from MEASURED seconds;
+      * a forced postmortem bundle whose ``hbm`` section carries a
+        positive resident total — the HBM residency ledger is live and
+        rides every black box.
+
+    In replicated/fleet modes the stage and device histograms arrive via
+    the replicas' obs deltas (obs/delta.py) — this reads the MERGED
+    parent registry, the same fleet-wide view an operator would.
+    """
+    from eth_consensus_specs_tpu.obs import ledger, waterfall
+
+    snap = obs.snapshot()
+    wf = waterfall.report(snap)
+    section: dict = {}
+    for name, st in sorted(wf["stages"].items()):
+        section[f"{name}_p50_ms"] = st["p50_ms"]
+        section[f"{name}_p99_ms"] = st["p99_ms"]
+    section["coverage"] = wf["coverage"]
+    section["other_share_p50"] = wf["other_share_p50"]
+
+    cov = wf["coverage"]
+    if cov is None:
+        failures.append(
+            "waterfall: no stage histograms recorded (serve.stage_ms.total empty)"
+        )
+    elif cov < 0.95:
+        failures.append(
+            f"waterfall: named stages cover {cov:.3f} < 0.95 of measured e2e wall"
+        )
+    share = wf["other_share_p50"]
+    if share is not None and share >= 0.20:
+        failures.append(
+            f"waterfall: 'other' (unattributed) stage is {share:.1%} of e2e p50"
+        )
+
+    hists = snap["histograms"]
+    counters = snap["counters"]
+    device: dict = {}
+    for name, h in sorted(hists.items()):
+        if name.startswith("device.exec_ms."):
+            kern = name[len("device.exec_ms."):]
+            device[kern] = {
+                "count": h.get("count", 0),
+                "p50_ms": h.get("p50"),
+                "p99_ms": h.get("p99"),
+                "roofline_violations": counters.get(
+                    f"device.roofline_violations.{kern}", 0
+                ),
+            }
+    section["device"] = device
+    for kern in require_kernels:
+        if not device.get(kern, {}).get("count"):
+            failures.append(
+                f"waterfall: device.exec_ms.{kern} is empty — the dispatch seam "
+                "never measured device time for that family"
+            )
+    if counters.get("device.roofline_violations", 0):
+        failures.append(
+            "waterfall: measured device seconds violate the declared byte model "
+            f"({counters['device.roofline_violations']} roofline violations)"
+        )
+
+    # the HBM residency ledger must ride the black box: force one bundle
+    # (explicit out_dir — the default smoke sets no postmortem env) and
+    # read its hbm section back
+    out_dir = os.path.dirname(os.path.abspath(out)) or "."
+    pm_dir = os.environ.get("ETH_SPECS_OBS_POSTMORTEM_DIR") or os.path.join(
+        out_dir, "postmortems"
+    )
+    path = obs.flight.dump("serve-bench-waterfall", out_dir=pm_dir)
+    section["hbm"] = ledger.postmortem_section(top=5)
+    section["postmortem_bundle"] = path
+    if path is None:
+        failures.append("waterfall: forced postmortem bundle failed to write")
+    elif require_resident:
+        # replicated/fleet parents hold no device buffers themselves (the
+        # replicas own them), so residency is gated in the default mode only
+        with open(path) as fh:
+            hbm = (json.load(fh).get("hbm")) or {}
+        if not hbm.get("resident_total_bytes", 0) > 0:
+            failures.append(
+                "waterfall: postmortem bundle hbm.resident_total_bytes is not "
+                "positive — the residency ledger saw no device buffers"
+            )
+    return section
+
+
 def run_replicated(args) -> None:
     """The --replicas path: closed-loop load through a supervised
     replica fleet, optionally with a deterministic mid-load SIGKILL."""
@@ -436,6 +541,7 @@ def run_replicated(args) -> None:
             "p99": wait_hist.get("p99"),
         },
         "slo": slo_mod.report(slo_results),
+        "waterfall": waterfall_section(failures, args.out, require_resident=False),
     }
 
     finish_report(report, failures, args.out, "serve_bench.replicated_failure", snap)
@@ -652,6 +758,7 @@ def run_fleet_matrix(args) -> None:
         "scaling_min": args.scaling_min,
         "warmup_artifact": warmup_path,
         "warmup_keys": len(serve_buckets.load_warmup(warmup_path)),
+        "waterfall": waterfall_section(failures, args.out, require_resident=False),
     }
     finish_report(report, failures, args.out, "serve_bench.fleet_failure", snap)
 
@@ -1184,6 +1291,53 @@ def main() -> None:
     svc = serve.VerifyService(cfg, name="bench")
     warm_keys = [("merkle_many", b, args.tree_depth) for b in cfg.buckets]
     svc.precompile(warm_keys)
+
+    # --- state_root mini-phase (warm): one post-epoch state root through
+    # the service. Exercises the state_root devprof seam end to end
+    # (device.exec_ms.state_root) and — via synthetic_static's
+    # creation-site registration — puts a genuinely resident device tree
+    # on the HBM ledger for the waterfall section's residency gate. Runs
+    # BEFORE the compile snapshot: its first dispatch is a legitimate
+    # warm-phase compile.
+    import jax.numpy as jnp
+
+    from eth_consensus_specs_tpu.forks import get_spec
+    from eth_consensus_specs_tpu.ops.state_columns import JustificationState
+    from eth_consensus_specs_tpu.ops.state_root import (
+        post_epoch_state_root,
+        synthetic_static,
+    )
+
+    spec_min = get_spec("altair", "minimal")
+    sr_arrays, sr_meta = synthetic_static(spec_min, 64, seed=11)
+    sr_rng = np.random.default_rng(11)
+    sr_bal = jnp.asarray(sr_rng.integers(16, 64, size=64, dtype=np.uint64) * 10**9)
+    sr_eff = jnp.asarray(np.full(64, 32 * 10**9, np.uint64))
+    sr_inact = jnp.asarray(sr_rng.integers(0, 4, size=64, dtype=np.uint64))
+    zero_root = jnp.zeros(32, jnp.uint8)
+    sr_just = JustificationState(
+        current_epoch=jnp.uint64(5),
+        justification_bits=jnp.asarray([True, False, True, False]),
+        prev_justified_epoch=jnp.uint64(3),
+        prev_justified_root=zero_root,
+        cur_justified_epoch=jnp.uint64(4),
+        cur_justified_root=zero_root,
+        finalized_epoch=jnp.uint64(3),
+        finalized_root=zero_root,
+        block_root_prev=zero_root,
+        block_root_cur=zero_root,
+        slashings_sum=jnp.uint64(0),
+    )
+    direct_sr = np.asarray(
+        post_epoch_state_root(sr_arrays, sr_meta, sr_bal, sr_eff, sr_inact, sr_just)
+    )
+    got_sr = np.asarray(
+        svc.submit_state_root(
+            sr_arrays, sr_meta, sr_bal, sr_eff, sr_inact, sr_just
+        ).result(timeout=120)
+    )
+    sr_parity = bool(np.array_equal(got_sr, direct_sr))
+
     compiles_after_warmup = obs.snapshot()["counters"].get("serve.compiles", 0)
 
     # --- phase 3: trickle (deadline flushes) ----------------------------
@@ -1204,6 +1358,8 @@ def main() -> None:
         failures.append("BLS parity: service results != direct ops results")
     if got_roots != direct_roots:
         failures.append("HTR parity: service roots != direct ops roots")
+    if not sr_parity:
+        failures.append("state_root parity: service root != direct ops root")
     snap = obs.snapshot()
     counters = snap["counters"]
     if snap["watchdog"]["divergences"] != 0:
@@ -1285,6 +1441,7 @@ def main() -> None:
             "p99": wait_hist.get("p99"),
         },
         "slo": slo.report(slo_results),
+        "waterfall": waterfall_section(failures, args.out),
     }
 
     if args.warmup_out:
