@@ -2,7 +2,7 @@
 
 PYTHON ?= python
 
-.PHONY: help install test test-fast lint speclint jaxlint rangelint reftests bytediff bench multichip postmortem serve_docs coverage clean
+.PHONY: help install test test-fast lint speclint jaxlint rangelint reftests bytediff bench multichip recovery-smoke postmortem serve_docs coverage clean
 
 help:
 	@echo "install    - editable install with test extras"
@@ -110,6 +110,12 @@ seed-device:
 
 multichip:
 	$(PYTHON) -c "import __graft_entry__ as g; g.dryrun_multichip(8); print('ok')"
+
+# durable-resident-state chaos gate: SIGKILL the resident replica at
+# the checkpoint commit seam, restore-then-replay, bit-identical root
+# vs an uninterrupted control run (docs/robustness.md)
+recovery-smoke:
+	JAX_PLATFORMS=cpu $(PYTHON) scripts/recovery_smoke.py --out recovery_smoke.json
 
 # most recent flight-recorder bundle ($ETH_SPECS_OBS_POSTMORTEM_DIR or
 # ./postmortems); `scripts/postmortem.py --list` / `A B` to diff

@@ -900,6 +900,65 @@ def _state_root_key_grid(mesh):
     return out
 
 
+def _resident_scrub_shapes(shards: int, depth: int, sub_depth: int, k: int):
+    m = (1 << (depth + 1)) - 1
+    return (
+        _sds((shards, m, 8), "uint32"),
+        _sds((k,), "int32"),
+        _sds((k,), "int32"),
+    )
+
+
+def _resident_scrub_domains(shards: int, depth: int, sub_depth: int):
+    per_shard = 1 << (depth - sub_depth)
+    return (
+        _WORDS32,
+        Domain(
+            "shard index in [0, shards)",
+            hi=shards - 1,
+            corners=(("zero", 0), ("last", shards - 1)),
+        ),
+        Domain(
+            "subtree position in [0, per_shard)",
+            hi=per_shard - 1,
+            corners=(("zero", 0), ("last", per_shard - 1)),
+        ),
+    )
+
+
+def _resident_scrub_variants(mesh):
+    from eth_consensus_specs_tpu.ops import snapshot
+
+    depth, sub_depth, k = 10, snapshot.SCRUB_SUBTREE_DEPTH, 4
+    m = (1 << (depth + 1)) - 1
+    return [
+        Variant(
+            "single",
+            snapshot._scrub_kernel(m, sub_depth, k),
+            _resident_scrub_shapes(1, depth, sub_depth, k),
+            domains=_resident_scrub_domains(1, depth, sub_depth),
+        )
+    ]
+
+
+def _resident_scrub_key_grid(mesh):
+    """LIVE first_dispatch key of ops/snapshot.scrub_forest —
+    ("resident_scrub", shards, n_nodes, sub_depth, k) — over registry
+    shapes vs the traced (nodes, sidx, pos) signature."""
+    from eth_consensus_specs_tpu.ops import snapshot
+
+    out = []
+    for depth in (8, 10):
+        sd = min(snapshot.SCRUB_SUBTREE_DEPTH, depth)
+        m = (1 << (depth + 1)) - 1
+        for k in (4, 8):
+            kk = min(k, 1 << (depth - sd))
+            key = ("resident_scrub", 1, m, sd, kk)
+            sig = (_canon_args(_resident_scrub_shapes(1, depth, sd, kk)), sd, kk)
+            out.append((key, sig))
+    return out
+
+
 def _canon_args(args) -> tuple:
     """Canonical hashable form of a ShapeDtypeStruct pytree — the part
     of the jit cache key the shape grid varies."""
@@ -1042,6 +1101,20 @@ REGISTRY: tuple[KernelSpec, ...] = (
         wraps=_SHA_WRAPS,
         build_variants=_state_root_variants,
         key_grid=_state_root_key_grid,
+    ),
+    KernelSpec(
+        name="resident_scrub",
+        help="salted-subtree resident forest integrity scrub "
+        "(ops/snapshot._scrub_kernel): K subtrees re-hashed from their "
+        "resident leaves + the full upper region, compared against the "
+        "stored rows",
+        dtypes=frozenset({"uint32", "int32", "bool"}),
+        donation_waiver="read-only verification pass: the resident node "
+        "buffer must SURVIVE the scrub (a donated forest could not be "
+        "quarantine-rebuilt from its own leaves afterwards)",
+        wraps=_SHA_WRAPS,
+        build_variants=_resident_scrub_variants,
+        key_grid=_resident_scrub_key_grid,
     ),
 )
 

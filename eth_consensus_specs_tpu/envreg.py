@@ -175,6 +175,27 @@ ENV_VARS: tuple[EnvVar, ...] = (
        "chips the serve dispatch mesh spans (0 = every local device; 1 = "
        "single-device dispatch); `serve_bench.py --chips` forces the matching "
        "virtual CPU device count", "serving.md#mesh-sharded-dispatch"),
+    # --------------------------------------------- durable resident state --
+    _v("ETH_SPECS_RESIDENT_CKPT_DIR", "unset",
+       "checkpoint store for the durable resident state: set on a replica to "
+       "make it own a digest-gated resident forest (restore at boot, "
+       "checkpoint every interval, scrub on demand)",
+       "robustness.md#durable-resident-state"),
+    _v("ETH_SPECS_RESIDENT_VALIDATORS", "256",
+       "validator count of the deterministic resident world the durable "
+       "replica owns (seeded columns + synthetic static tree content)",
+       "robustness.md#durable-resident-state"),
+    _v("ETH_SPECS_RESIDENT_CKPT_INTERVAL", "2",
+       "epochs between durable checkpoints during a resident advance "
+       "(written outside the donated jit chain)",
+       "robustness.md#durable-resident-state"),
+    _v("ETH_SPECS_RESIDENT_SCRUB_K", "8",
+       "salted subtrees re-hashed per scrub pass (per tree, plus the full "
+       "upper region)", "robustness.md#durable-resident-state"),
+    _v("ETH_SPECS_RESIDENT_RESTORE", "prefer",
+       "boot restore policy: `prefer` degrades a torn/corrupt checkpoint to "
+       "full re-ingest, `require` refuses to boot on one, `never` always "
+       "cold-starts", "robustness.md#durable-resident-state"),
     # ------------------------------------------------------------- mesh --
     _v("ETH_SPECS_MESH", "1",
        "`0`: disable mesh-sharded kernel dispatch entirely (every entry point "

@@ -42,6 +42,12 @@ def is_device_failure(exc: BaseException) -> bool:
     False for logic errors (must propagate)."""
     if isinstance(exc, (FaultInjected, MemoryError)):
         return True
+    if getattr(exc, "degradable", False):
+        # an exception type may declare itself environmental damage
+        # rather than a logic error (ops/snapshot.py's torn/corrupt
+        # checkpoint refusals): degrading to the host path re-derives
+        # the state instead of serving a wrong answer
+        return True
     msg = str(exc).lower()
     if "xla" in type(exc).__name__.lower():
         # jaxlib.xla_extension.XlaRuntimeError et al. — but XLA also routes

@@ -85,6 +85,31 @@ SITES: dict[str, FaultSite] = {
             ("corrupt",),
             "docs/robustness.md failure matrix",
         ),
+        _S(
+            "resident.checkpoint",
+            "the durable checkpoint write path: corrupt flips a blob byte "
+            "between serialize and fsync (read-back verify must refuse the "
+            "torn write), kill dies mid-commit (the previous LATEST must "
+            "survive intact)",
+            ("raise", "kill", "stall", "corrupt"),
+            "tests/test_snapshot.py, scripts/recovery_smoke.py",
+        ),
+        _S(
+            "resident.restore",
+            "the digest-verified restore at replica boot: corrupt damages "
+            "a blob in flight (restore must REFUSE and degrade to full "
+            "host re-ingest, never serve a wrong root)",
+            ("raise", "stall", "corrupt"),
+            "tests/test_snapshot.py",
+        ),
+        _S(
+            "resident.scrub",
+            "the salted-subtree integrity scrub: corrupt flips the observed "
+            "root so the expect-root cross-check fires (mismatch counters + "
+            "postmortem + quarantine-and-rebuild)",
+            ("raise", "corrupt"),
+            "tests/test_snapshot.py",
+        ),
     )
 }
 

@@ -78,6 +78,20 @@ CATALOG: tuple[Metric, ...] = (
        "hashes in incremental forest updates (capacity model)"),
     _s("merkle_inc.update", "incremental dirty-subtree forest update"),
     _s("resident.run_epochs", "device-resident chained epoch advance"),
+    # ------------------------------------------- durable resident state --
+    _c("resident.checkpoints", "durable checkpoints committed"),
+    _c("resident.checkpoint_blobs_written", "checkpoint blobs written+verified"),
+    _c("resident.checkpoint_blobs_reused",
+       "checkpoint blobs reused by content address"),
+    _c("resident.torn_writes", "checkpoint writes failing read-back verify"),
+    _c("resident.restores", "digest-verified checkpoint restores"),
+    _c("resident.reingests", "full deterministic re-ingests (restore/scrub fallback)"),
+    _c("resident.scrub.checks", "scrub subtree+upper-region integrity checks"),
+    _c("resident.scrub.mismatches", "scrub checks that found corruption"),
+    _c("resident.scrub.quarantines", "quarantine-and-rebuild passes after scrub hits"),
+    _s("resident.checkpoint", "content-addressed forest checkpoint write"),
+    _s("resident.restore", "digest-verified forest restore"),
+    _s("resident.scrub", "salted-subtree resident integrity scrub"),
     _c("block_epoch.blocks_ingested", "blocks ingested into the chain kernel"),
     _c("block_epoch.epochs", "epoch transitions in block_epoch chains"),
     _c("block_epoch.ingests", "block_epoch ingest calls"),

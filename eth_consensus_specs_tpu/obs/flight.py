@@ -62,6 +62,10 @@ _RING: deque = deque(maxlen=_DEFAULT_CAPACITY)
 _SEQ = 0
 _DUMP_N = 0  # per-process bundle ordinal (unique filenames within a second)
 _DUMPS_BY_TRIGGER: dict[str, int] = {}
+# durable-resident-state lineage (serve/resident_owner.py): which
+# checkpoint this process restored from / last wrote, and the restore
+# verdict — the first question a recovery postmortem asks
+_LINEAGE: dict | None = None
 
 
 def _env_int(name: str, default: int) -> int:
@@ -171,12 +175,24 @@ def ship_since(seq: int) -> tuple[int, list[dict]]:
         return _SEQ, entries
 
 
+def set_lineage(lineage: dict | None) -> None:
+    """Record this process's checkpoint lineage (manifest digest, epoch
+    span, restore verdict) for inclusion in every subsequent bundle."""
+    global _LINEAGE
+    _LINEAGE = dict(lineage) if lineage else None
+
+
+def get_lineage() -> dict | None:
+    return dict(_LINEAGE) if _LINEAGE else None
+
+
 def reset_for_tests() -> None:
-    global _SEQ, _DUMP_N
+    global _SEQ, _DUMP_N, _LINEAGE
     with _LOCK:
         _RING.clear()
         _SEQ = 0
         _DUMP_N = 0
+        _LINEAGE = None
         _DUMPS_BY_TRIGGER.clear()
 
 
@@ -281,6 +297,8 @@ def dump(
             bundle["hbm"] = ledger.postmortem_section()
         except Exception:
             pass
+        if _LINEAGE:
+            bundle["checkpoint"] = dict(_LINEAGE)
         if extra:
             bundle["extra"] = extra
         stamp = time.strftime("%Y%m%dT%H%M%S", time.gmtime())

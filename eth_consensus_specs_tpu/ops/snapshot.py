@@ -1,0 +1,781 @@
+"""Durable resident state: digest-verified checkpoint/restore + scrub.
+
+PR 11 made the validator state and the merkle forest HBM-resident with
+donated in-place epoch chains; a SIGKILLed owner lost all of it and the
+respawn re-ingested from host columns with no integrity story in
+between. The forest is self-authenticating — every internal level is a
+hash of the level below — so durability can be *digest-gated* rather
+than trusted:
+
+  * :func:`checkpoint` serializes a ``StateForest`` + the resident
+    columns into a content-addressed blob store (``objects/<sha256>``)
+    with an atomically-committed manifest (per-tree level digests, the
+    combined state root, epoch lineage). Every blob write is the
+    dumper's write -> read-back -> verify -> ``os.replace`` discipline
+    (gen/dumper.py, PR 2); the manifest commits after its blobs and the
+    ``LATEST`` pointer commits last, so a mid-write SIGKILL leaves the
+    previous checkpoint intact — never a torn current one. Incremental
+    mode skips blobs whose digest already exists: unchanged subtree
+    shards (the ones ``post_epoch_state_root_inc`` never dirtied)
+    produce byte-identical buffers, so only dirty content hits disk,
+    and a full and an incremental checkpoint of the same state agree
+    on ``content_digest`` by construction.
+  * :func:`restore` verifies the manifest digest, every blob digest,
+    and then REFUSES to serve unless the forest re-verifies on device:
+    all internal levels rebuilt from the restored leaves bit-match the
+    restored buffers AND the recomputed combined state root bit-matches
+    the manifest. Failures raise :class:`SnapshotError` subclasses
+    carrying ``degradable = True`` — environmental damage, not logic
+    errors — so ``fault.degrade("resident.restore", ...)`` falls back
+    to a full host re-ingest rather than ever serving a wrong answer.
+  * :func:`scrub_forest` re-hashes K randomly-salted subtrees per call
+    against the resident parent nodes (one ``lax``-level kernel: gather
+    leaves -> build_levels -> compare, plus the full upper region above
+    the subtree cut every pass), counting
+    ``resident.scrub.{checks,mismatches}``. A mismatch is silent HBM
+    corruption caught in the act: the caller quarantines the tree
+    (:func:`quarantine_rebuild` — recompute every internal level from
+    the resident leaves) and re-verifies the root before serving again.
+
+Fault sites (fault/sites.py): ``resident.checkpoint`` (raise/kill/
+stall/corrupt at the blob-write seam), ``resident.restore`` (raise/
+stall/corrupt at the blob-read seam), ``resident.scrub`` (raise, plus
+corrupt on the root it reports — drives the mismatch path end to end
+through the deterministic grammar).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+from functools import lru_cache, partial
+from typing import NamedTuple
+
+import numpy as np
+
+from eth_consensus_specs_tpu import fault, obs
+
+MANIFEST_VERSION = 1
+_OBJECTS = "objects"
+_LATEST = "LATEST"
+_TREES = ("val_nodes", "bal_nodes", "inact_nodes")
+# subtree cut depth of one scrub check: 2^5 leaves re-hashed per sample
+SCRUB_SUBTREE_DEPTH = 5
+
+
+class SnapshotError(RuntimeError):
+    """Checkpoint/restore integrity failure. ``degradable`` marks it as
+    environmental damage (torn write, bit rot, injected corruption) —
+    NOT a logic error — so the fault.degrade ladder may fall back to a
+    full host re-ingest instead of propagating."""
+
+    degradable = True
+
+
+class TornCheckpoint(SnapshotError):
+    """A blob or manifest failed its digest check (torn/corrupt on disk
+    or on the read path)."""
+
+
+class RestoreMismatch(SnapshotError):
+    """The restored forest failed device re-verification: rebuilt
+    levels or the recomputed state root disagree with the manifest.
+    The restore REFUSES to serve this state."""
+
+
+# ------------------------------------------------------------- encoding --
+
+
+def _digest(data: bytes) -> str:
+    return hashlib.sha256(data).hexdigest()
+
+
+def _arr_bytes(a) -> bytes:
+    return np.ascontiguousarray(np.asarray(a)).tobytes()
+
+
+def _arr_meta(a) -> dict:
+    host = np.asarray(a)
+    return {"dtype": host.dtype.name, "shape": list(host.shape)}
+
+
+def _decode(data: bytes, meta: dict) -> np.ndarray:
+    return np.frombuffer(data, dtype=np.dtype(meta["dtype"])).reshape(
+        tuple(meta["shape"])
+    )
+
+
+def _words_bytes(words: np.ndarray) -> bytes:
+    """u32[8] root words -> the canonical 32 big-endian bytes."""
+    return np.asarray(words, np.uint32).astype(">u4").tobytes()
+
+
+def _host_combine(shard_roots: np.ndarray) -> bytes:
+    """[S, 8] per-shard root words -> the tree root bytes via the same
+    log-depth pairwise combine forest_root performs on device."""
+    level = [_words_bytes(shard_roots[i]) for i in range(shard_roots.shape[0])]
+    while len(level) > 1:
+        level = [
+            hashlib.sha256(level[2 * i] + level[2 * i + 1]).digest()
+            for i in range(len(level) // 2)
+        ]
+    return level[0]
+
+
+def _level_layout(n_nodes: int) -> list[tuple[int, int]]:
+    """Flat-buffer (offset, width) of every level of a tree with
+    ``n_nodes = 2^(dl+1)-1`` rows — leaves first, root last (the
+    merkle_inc layout: level k starts at cap2 - (cap2 >> k))."""
+    cap2 = n_nodes + 1
+    out = []
+    k = 0
+    while (cap2 >> (k + 1)) >= 1:
+        out.append((cap2 - (cap2 >> k), cap2 >> (k + 1)))
+        k += 1
+    return out
+
+
+def _tree_level_digests(nodes: np.ndarray) -> list[str]:
+    """Per-level content digests over ALL shards of one forest tree —
+    the manifest's self-description of the internal levels."""
+    return [
+        _digest(_arr_bytes(nodes[:, off : off + width, :]))
+        for off, width in _level_layout(nodes.shape[-2])
+    ]
+
+
+# ----------------------------------------------------------- blob store --
+
+
+def _objects_dir(root_dir: str) -> str:
+    return os.path.join(root_dir, _OBJECTS)
+
+
+def _write_verified(path: str, data: bytes, site: str, want: str) -> None:
+    """ONE verified write attempt: corrupt seam -> write -> read back ->
+    digest check -> atomic rename (the dumper's discipline)."""
+    payload = fault.corrupt(site, data)
+    tmp = f"{path}.__tmp{os.getpid()}"
+    with open(tmp, "wb") as f:
+        f.write(payload)
+    with open(tmp, "rb") as f:
+        back = f.read()
+    if _digest(back) != want:
+        os.unlink(tmp)
+        obs.count("resident.torn_writes", 1)
+        raise TornCheckpoint(f"write verify failed for {os.path.basename(path)}")
+    os.replace(tmp, path)
+
+
+def _put_blob(
+    root_dir: str, data: bytes, *, incremental: bool, site: str = "resident.checkpoint"
+) -> tuple[str, bool]:
+    """Store one content-addressed blob; returns (digest, written).
+    Incremental mode trusts an existing digest file (content addressing
+    makes the skip exact — same digest IS same bytes); full mode reads
+    any existing blob back and re-verifies it, rewriting on damage."""
+    dig = _digest(data)
+    final = os.path.join(_objects_dir(root_dir), dig)
+    if os.path.exists(final):
+        if incremental:
+            return dig, False
+        try:
+            with open(final, "rb") as f:
+                if _digest(f.read()) == dig:
+                    return dig, False
+        except OSError:
+            pass  # unreadable: fall through to the rewrite
+    fault.retrying(
+        lambda: _write_verified(final, data, site, dig),
+        name="resident.checkpoint.blob",
+        attempts=3,
+        retry_on=(TornCheckpoint, OSError),
+        base_delay=0.01,
+    )
+    return dig, True
+
+
+def _get_blob(root_dir: str, dig: str, site: str = "resident.restore") -> bytes:
+    path = os.path.join(_objects_dir(root_dir), dig)
+    try:
+        with open(path, "rb") as f:
+            data = f.read()
+    except OSError as exc:
+        raise TornCheckpoint(f"missing checkpoint blob {dig[:12]}: {exc}") from exc
+    # the read-path corrupt seam: an injected flip here must be caught
+    # by the digest check below, never restored into the forest
+    data = fault.corrupt(site, data)
+    if _digest(data) != dig:
+        raise TornCheckpoint(f"checkpoint blob {dig[:12]} failed its digest check")
+    return data
+
+
+# ---------------------------------------------------------- checkpoints --
+
+
+class CheckpointResult(NamedTuple):
+    manifest: dict
+    digest: str  # sha256 of the committed manifest file bytes
+    path: str
+    written: int  # blobs that hit disk
+    reused: int  # blobs already present (the incremental savings)
+
+
+def _checkpoint_tree(
+    root_dir: str, nodes, *, incremental: bool
+) -> tuple[dict, int, int]:
+    host = np.asarray(nodes)
+    shards, written, reused = [], 0, 0
+    for i in range(host.shape[0]):
+        dig, wrote = _put_blob(root_dir, _arr_bytes(host[i]), incremental=incremental)
+        shards.append(dig)
+        written += int(wrote)
+        reused += int(not wrote)
+    entry = {
+        **_arr_meta(host),
+        "shards": shards,
+        "levels": _tree_level_digests(host),
+        "root": _host_combine(host[:, -1, :]).hex(),
+    }
+    return entry, written, reused
+
+
+def _checkpoint_fields(
+    root_dir: str, tree, *, incremental: bool
+) -> tuple[dict, int, int]:
+    out, written, reused = {}, 0, 0
+    for name, val in tree._asdict().items():
+        if val is None:
+            out[name] = None
+            continue
+        dig, wrote = _put_blob(root_dir, _arr_bytes(val), incremental=incremental)
+        out[name] = {**_arr_meta(val), "blob": dig}
+        written += int(wrote)
+        reused += int(not wrote)
+    return out, written, reused
+
+
+def checkpoint(
+    root_dir: str,
+    forest,
+    cols,
+    just,
+    *,
+    epoch: int,
+    plan,
+    static=None,
+    state_root: bytes | None = None,
+    epoch0: int = 0,
+    incremental: bool = True,
+) -> CheckpointResult:
+    """Commit one durable checkpoint of the resident state. Runs OUTSIDE
+    the donated jit chain (host fetch of the forest + columns). `static`
+    is the (arrays, meta) pair from ingest_full — when given and
+    ``state_root`` is not, the manifest root is recomputed on device via
+    the shared state_root_from_forest gate. Returns the committed
+    manifest; crash-safe at every byte: blobs commit before the
+    manifest, the manifest before LATEST, all via os.replace."""
+    fault.check("resident.checkpoint")
+    os.makedirs(_objects_dir(root_dir), exist_ok=True)
+    if state_root is None and static is not None:
+        state_root = state_root_bytes(static, plan, forest, just)
+
+    written = reused = 0
+    trees: dict[str, dict | None] = {}
+    total_bytes = 0
+    for name in _TREES:
+        nodes = getattr(forest, name)
+        if nodes is None:
+            trees[name] = None
+            continue
+        entry, w, r = _checkpoint_tree(root_dir, nodes, incremental=incremental)
+        trees[name] = entry
+        written += w
+        reused += r
+        total_bytes += int(np.asarray(nodes).nbytes)
+    part_dig, wrote = _put_blob(
+        root_dir, _arr_bytes(forest.part_root), incremental=incremental
+    )
+    written += int(wrote)
+    reused += int(not wrote)
+    trees["part_root"] = {**_arr_meta(forest.part_root), "blob": part_dig}
+
+    cols_entry, w, r = _checkpoint_fields(root_dir, cols, incremental=incremental)
+    written += w
+    reused += r
+    just_entry, w, r = _checkpoint_fields(root_dir, just, incremental=incremental)
+    written += w
+    reused += r
+
+    content = {
+        "epoch": int(epoch),
+        "state_root": state_root.hex() if state_root else None,
+        "trees": trees,
+        "columns": {"cols": cols_entry, "just": just_entry},
+    }
+    parent = None
+    try:
+        prev = latest(root_dir)
+        if prev is not None:
+            parent = prev[1]
+    except TornCheckpoint:
+        parent = None  # a torn predecessor never blocks a NEW checkpoint
+    manifest = {
+        "version": MANIFEST_VERSION,
+        **content,
+        "content_digest": _digest(
+            json.dumps(content, sort_keys=True).encode()
+        ),
+        "epoch_span": [int(epoch0), int(epoch)],
+        "parent": parent,
+        "incremental": bool(incremental),
+        "plan": list(plan),
+        "counts": {"written": written, "reused": reused},
+    }
+    with obs.span("resident.checkpoint", work_bytes=total_bytes, epoch=int(epoch)):
+        data = json.dumps(manifest, sort_keys=True).encode()
+        dig = _digest(data)
+        name = f"manifest-{int(epoch):08d}.json"
+        path = os.path.join(root_dir, name)
+        fault.retrying(
+            lambda: _write_verified(path, data, "resident.checkpoint", dig),
+            name="resident.checkpoint.manifest",
+            attempts=3,
+            retry_on=(TornCheckpoint, OSError),
+            base_delay=0.01,
+        )
+        pointer = json.dumps({"manifest": name, "digest": dig}).encode()
+        tmp = os.path.join(root_dir, f"{_LATEST}.__tmp{os.getpid()}")
+        with open(tmp, "wb") as f:
+            f.write(pointer)
+        os.replace(tmp, os.path.join(root_dir, _LATEST))
+    obs.count("resident.checkpoints", 1)
+    obs.count("resident.checkpoint_blobs_written", written)
+    obs.count("resident.checkpoint_blobs_reused", reused)
+    return CheckpointResult(
+        manifest=manifest, digest=dig, path=path, written=written, reused=reused
+    )
+
+
+def latest(root_dir: str) -> tuple[dict, str] | None:
+    """(manifest, manifest_digest) of the committed LATEST checkpoint,
+    or None when the store has none. Raises TornCheckpoint when the
+    pointer names a manifest that is missing or fails its digest."""
+    try:
+        with open(os.path.join(root_dir, _LATEST), "rb") as f:
+            pointer = json.loads(f.read())
+    except (OSError, ValueError):
+        return None
+    name, want = pointer.get("manifest", ""), pointer.get("digest", "")
+    try:
+        with open(os.path.join(root_dir, name), "rb") as f:
+            data = f.read()
+    except OSError as exc:
+        raise TornCheckpoint(f"LATEST points at missing manifest {name}") from exc
+    if _digest(data) != want:
+        raise TornCheckpoint(f"manifest {name} failed its digest check")
+    return json.loads(data), want
+
+
+# -------------------------------------------------------------- restore --
+
+
+class RestoredState(NamedTuple):
+    forest: object  # StateForest (device)
+    cols: object  # AltairEpochColumns (device)
+    just: object  # JustificationState (device)
+    plan: object  # ForestPlan from the manifest
+    manifest: dict
+    digest: str
+    epoch: int
+    verdict: str  # "verified-device" | "verified-host"
+
+
+@lru_cache(maxsize=None)
+def _rebuild_check_kernel(n_nodes: int):
+    """jit: rebuild every internal level from the restored leaf level
+    and compare — ok iff the restored buffers are internally exact."""
+    import jax
+    import jax.numpy as jnp
+
+    from eth_consensus_specs_tpu.ops import merkle_inc
+
+    leaves_w = (n_nodes + 1) // 2
+
+    @jax.jit
+    def run(nodes):
+        rebuilt = merkle_inc.build_levels(nodes[:, :leaves_w, :])
+        return jnp.all(rebuilt == nodes)
+
+    return run
+
+
+@lru_cache(maxsize=None)
+def _rebuild_kernel(n_nodes: int):
+    """jit (donating): recompute every internal level from the resident
+    leaves — the quarantine-and-rebuild step after a scrub mismatch."""
+    import jax
+
+    from eth_consensus_specs_tpu.ops import merkle_inc
+
+    leaves_w = (n_nodes + 1) // 2
+
+    @partial(jax.jit, donate_argnums=(0,))
+    def run(nodes):
+        return merkle_inc.build_levels(nodes[:, :leaves_w, :])
+
+    return run
+
+
+@lru_cache(maxsize=None)
+def _root_kernel(plan, meta):
+    import jax
+
+    from eth_consensus_specs_tpu.ops.state_root import state_root_from_forest
+
+    @jax.jit
+    def run(arrays, forest, just):
+        return state_root_from_forest(arrays, meta, plan, forest, just)
+
+    return run
+
+
+def state_root_bytes(static, plan, forest, just) -> bytes:
+    """The canonical combined state root of a resident forest as 32
+    bytes — ONE device dispatch of the shared digest gate."""
+    import jax
+
+    from eth_consensus_specs_tpu.serve import buckets
+
+    arrays, meta = static
+    run = _root_kernel(plan, meta)
+    with buckets.first_dispatch("resident_root", meta.n_validators, plan.shards):
+        root = run(jax.device_put(arrays), forest, just)
+    return _words_bytes(np.asarray(root))
+
+
+def _restore_tree(root_dir: str, entry: dict):
+    host = np.stack(
+        [
+            _decode(_get_blob(root_dir, dig), {**entry, "shape": entry["shape"][1:]})
+            for dig in entry["shards"]
+        ]
+    )
+    return host
+
+
+def _restore_fields(root_dir: str, entry: dict, cls):
+    import jax
+
+    vals = {}
+    for name, meta in entry.items():
+        vals[name] = (
+            None
+            if meta is None
+            else jax.device_put(_decode(_get_blob(root_dir, meta["blob"]), meta))
+        )
+    return cls(**vals)
+
+
+def _host_verify_tree(name: str, host: np.ndarray, entry: dict) -> None:
+    """Host re-hash of one restored tree: every internal level recomputed
+    with hashlib from the level below, compared byte-for-byte — the
+    device-free leg of the refusal gate (plus the level digests, which
+    pin the restored bytes to the manifest's)."""
+    layout = _level_layout(host.shape[-2])
+    for k, dig in enumerate(entry["levels"]):
+        off, width = layout[k]
+        if _digest(_arr_bytes(host[:, off : off + width, :])) != dig:
+            raise RestoreMismatch(f"{name}: level {k} digest mismatch after restore")
+    be = host.astype(">u4")
+    for k in range(len(layout) - 1):
+        off, width = layout[k]
+        p_off, p_width = layout[k + 1]
+        child = be[:, off : off + width, :].reshape(host.shape[0], width // 2, 16)
+        for s in range(host.shape[0]):
+            for j in range(p_width):
+                got = hashlib.sha256(child[s, j].tobytes()).digest()
+                if got != be[s, p_off + j].tobytes():
+                    raise RestoreMismatch(
+                        f"{name}: rebuilt node ({s}, level {k + 1}, {j}) "
+                        "disagrees with the restored buffer"
+                    )
+
+
+def restore(root_dir: str, *, static=None, verify: str = "device") -> RestoredState | None:
+    """Rebuild the resident state from the LATEST checkpoint — REFUSING
+    to serve unless it re-verifies. ``verify="device"`` (requires
+    ``static``): every tree's internal levels are rebuilt on device
+    from the restored leaves and compared, and the combined state root
+    is recomputed and bit-matched against the manifest.
+    ``verify="host"`` re-hashes the level chain with hashlib instead
+    (no device work — the torn-checkpoint unit tests run here).
+    Returns None when the store holds no checkpoint at all; raises
+    TornCheckpoint / RestoreMismatch (both ``degradable``) on damage."""
+    import jax
+
+    from eth_consensus_specs_tpu.ops.altair_epoch import AltairEpochColumns
+    from eth_consensus_specs_tpu.ops.state_columns import JustificationState
+    from eth_consensus_specs_tpu.ops.state_root import ForestPlan, StateForest
+    from eth_consensus_specs_tpu.serve import buckets
+
+    fault.check("resident.restore")
+    found = latest(root_dir)
+    if found is None:
+        return None
+    manifest, dig = found
+    plan = ForestPlan(*manifest["plan"])
+    nbytes = 0
+    with obs.span(
+        "resident.restore", work_bytes=0, epoch=int(manifest["epoch"])
+    ):
+        host_trees: dict[str, np.ndarray | None] = {}
+        for name in _TREES:
+            entry = manifest["trees"][name]
+            if entry is None:
+                host_trees[name] = None
+                continue
+            host = _restore_tree(root_dir, entry)
+            nbytes += host.nbytes
+            if verify == "host":
+                _host_verify_tree(name, host, entry)
+            if _host_combine(host[:, -1, :]).hex() != entry["root"]:
+                raise RestoreMismatch(f"{name}: restored root disagrees with manifest")
+            host_trees[name] = host
+        part_entry = manifest["trees"]["part_root"]
+        part_root = _decode(_get_blob(root_dir, part_entry["blob"]), part_entry)
+        forest = StateForest(
+            val_nodes=jax.device_put(host_trees["val_nodes"]),
+            bal_nodes=jax.device_put(host_trees["bal_nodes"]),
+            inact_nodes=(
+                None
+                if host_trees["inact_nodes"] is None
+                else jax.device_put(host_trees["inact_nodes"])
+            ),
+            part_root=jax.device_put(part_root),
+        )
+        cols = _restore_fields(root_dir, manifest["columns"]["cols"], AltairEpochColumns)
+        just = _restore_fields(
+            root_dir, manifest["columns"]["just"], JustificationState
+        )
+        if verify == "device":
+            for name in _TREES:
+                nodes = getattr(forest, name)
+                if nodes is None:
+                    continue
+                run = _rebuild_check_kernel(nodes.shape[-2])
+                with buckets.first_dispatch(
+                    "resident_verify", nodes.shape[0], nodes.shape[-2]
+                ):
+                    ok = bool(run(nodes))
+                if not ok:
+                    raise RestoreMismatch(
+                        f"{name}: device-rebuilt levels disagree with the "
+                        "restored buffers"
+                    )
+            if static is not None and manifest["state_root"]:
+                got = state_root_bytes(static, plan, forest, just)
+                if got.hex() != manifest["state_root"]:
+                    raise RestoreMismatch(
+                        "recomputed state root disagrees with the manifest — "
+                        "refusing to serve this checkpoint"
+                    )
+    obs.count("resident.restores", 1)
+    return RestoredState(
+        forest=forest,
+        cols=cols,
+        just=just,
+        plan=plan,
+        manifest=manifest,
+        digest=dig,
+        epoch=int(manifest["epoch"]),
+        verdict=f"verified-{verify}",
+    )
+
+
+# ---------------------------------------------------------------- scrub --
+
+
+class ScrubReport(NamedTuple):
+    checks: int
+    mismatches: int
+    # tree name -> global subtree positions (shard*per_shard + pos) that
+    # failed their re-hash, or -1 for an upper-region mismatch
+    bad: dict[str, list[int]]
+    root: bytes  # the combined val-tree root observed during the pass
+
+
+@lru_cache(maxsize=None)
+def _scrub_kernel(n_nodes: int, sub_depth: int, k: int):
+    """jit: re-hash K subtrees of one forest tree from their resident
+    leaves and compare against the resident parent row, PLUS rebuild the
+    whole upper region (level sub_depth -> root — shrinking widths, a
+    tiny fraction of the tree) against the stored rows. A flipped word
+    anywhere ABOVE the cut is caught every pass; below it, with
+    K/coverage probability per pass."""
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    from eth_consensus_specs_tpu.ops import merkle_inc
+
+    dl = merkle_inc.tree_depth(n_nodes)
+    cap2 = n_nodes + 1
+    w = 1 << sub_depth
+    off_sd = cap2 - (cap2 >> sub_depth)
+    n_sub = 1 << (dl - sub_depth)
+
+    # resident rows of subtree `pos`, level-blocked in exactly the
+    # layout build_levels emits (leaves first, root last): level j of
+    # the tree starts at cap2 - (cap2 >> j); the subtree owns w >> j
+    # consecutive rows there starting at pos * (w >> j)
+    level_offs = [cap2 - (cap2 >> j) for j in range(sub_depth + 1)]
+    level_widths = [w >> j for j in range(sub_depth + 1)]
+
+    @jax.jit
+    def run(nodes, sidx, pos):
+        flat = nodes.reshape(-1, 8)
+        base = sidx * jnp.int32(n_nodes)
+        li = (base + pos * jnp.int32(w))[:, None] + jnp.arange(w, dtype=jnp.int32)
+        leaves = jnp.take(flat, li.reshape(-1), axis=0).reshape(k, w, 8)
+        rebuilt = merkle_inc.build_levels(leaves)  # [K, 2w-1, 8]
+        parts = [
+            (base + jnp.int32(off) + pos * jnp.int32(wj))[:, None]
+            + jnp.arange(wj, dtype=jnp.int32)
+            for off, wj in zip(level_offs, level_widths)
+        ]
+        si = jnp.concatenate(parts, axis=1)  # [K, 2w-1]
+        stored = jnp.take(flat, si.reshape(-1), axis=0).reshape(k, 2 * w - 1, 8)
+        low_bad = jnp.any(rebuilt != stored, axis=(-2, -1))
+        upper = merkle_inc.build_levels(
+            lax.slice_in_dim(nodes, off_sd, off_sd + n_sub, axis=1)
+        )
+        upper_bad = jnp.any(upper != lax.slice_in_dim(nodes, off_sd, n_nodes, axis=1))
+        return low_bad, upper_bad, merkle_inc.forest_root(nodes)
+
+    return run
+
+
+def _salted_positions(salt: int, tree: str, k: int, total: int) -> list[int]:
+    """K deterministic pseudo-random subtree positions for this (salt,
+    tree) — sha256-derived so a chaos run and its re-run scrub the same
+    subtrees (no RNG, the fault grammar's determinism rule)."""
+    out = []
+    for i in range(k):
+        h = hashlib.sha256(f"scrub:{salt}:{tree}:{i}".encode()).digest()
+        out.append(int.from_bytes(h[:8], "big") % total)
+    return out
+
+
+def scrub_forest(
+    forest,
+    *,
+    k: int = 8,
+    salt: int = 0,
+    expect_root: bytes | None = None,
+    sub_depth: int = SCRUB_SUBTREE_DEPTH,
+) -> ScrubReport:
+    """One scrub pass over every tree of a resident forest. Counts
+    ``resident.scrub.checks`` / ``resident.scrub.mismatches``; a
+    mismatch triggers a postmortem bundle (the caller quarantines via
+    :func:`quarantine_rebuild`). ``expect_root`` additionally compares
+    the observed val-tree root (after the ``resident.scrub`` corrupt
+    seam — the chaos lever) against the last known-good root."""
+    import jax.numpy as jnp
+
+    from eth_consensus_specs_tpu.ops import merkle_inc
+    from eth_consensus_specs_tpu.serve import buckets
+
+    fault.check("resident.scrub")
+    checks = mismatches = 0
+    bad: dict[str, list[int]] = {}
+    root = b""
+    nbytes = sum(
+        int(np.asarray(t).nbytes)
+        for t in (forest.val_nodes, forest.bal_nodes, forest.inact_nodes)
+        if t is not None
+    )
+    with obs.span("resident.scrub", work_bytes=nbytes, k=k, salt=salt):
+        for name in _TREES:
+            nodes = getattr(forest, name)
+            if nodes is None:
+                continue
+            s, m = nodes.shape[0], nodes.shape[-2]
+            dl = merkle_inc.tree_depth(m)
+            sd = min(sub_depth, dl)
+            per_shard = 1 << (dl - sd)
+            total = s * per_shard
+            kk = min(k, total)
+            positions = _salted_positions(salt, name, kk, total)
+            sidx = jnp.asarray([p // per_shard for p in positions], jnp.int32)
+            pos = jnp.asarray([p % per_shard for p in positions], jnp.int32)
+            run = _scrub_kernel(m, sd, kk)
+            with buckets.first_dispatch("resident_scrub", s, m, sd, kk):
+                low_bad, upper_bad, tree_root = run(nodes, sidx, pos)
+            low_bad = np.asarray(low_bad)
+            checks += kk + 1  # +1: the always-on upper-region sweep
+            tree_bad = [p for p, b in zip(positions, low_bad) if b]
+            if bool(upper_bad):
+                tree_bad.append(-1)
+            if tree_bad:
+                bad[name] = tree_bad
+                mismatches += len(tree_bad)
+            if name == "val_nodes":
+                root = _words_bytes(np.asarray(tree_root))
+    if expect_root is not None and root:
+        # the chaos seam: a resident.scrub:corrupt rule flips a byte of
+        # the observed root here — detected exactly like real HBM rot
+        observed = fault.corrupt("resident.scrub", root)
+        if observed != expect_root:
+            mismatches += 1
+            bad.setdefault("val_nodes", []).append(-1)
+    obs.count("resident.scrub.checks", checks)
+    if mismatches:
+        obs.count("resident.scrub.mismatches", mismatches)
+        obs.event("resident.scrub_mismatch", bad={k: v[:8] for k, v in bad.items()})
+        obs.flight.trigger_dump(
+            "resident.scrub", detail=",".join(sorted(bad)), extra={"bad": bad}
+        )
+    return ScrubReport(checks=checks, mismatches=mismatches, bad=bad, root=root)
+
+
+def quarantine_rebuild(forest, tree: str):
+    """Quarantine-and-rebuild one tree after a scrub mismatch: recompute
+    every internal level from the RESIDENT leaves (the leaves are the
+    authority; a corrupted internal node heals, a corrupted leaf
+    surfaces as a root mismatch the caller must re-verify — and degrade
+    to re-ingest when it persists). Donates the damaged buffers."""
+    nodes = getattr(forest, tree)
+    if nodes is None:
+        return forest
+    from eth_consensus_specs_tpu.serve import buckets
+
+    run = _rebuild_kernel(nodes.shape[-2])
+    with buckets.first_dispatch("resident_rebuild", nodes.shape[0], nodes.shape[-2]):
+        rebuilt = run(nodes)
+    obs.count("resident.scrub.quarantines", 1)
+    obs.event("resident.quarantine_rebuild", tree=tree)
+    return forest._replace(**{tree: rebuilt})
+
+
+def flip_resident_word(forest, tree: str, node: int, word: int = 0):
+    """Deliberately flip one u32 word of a resident tree (test/chaos
+    helper — the 'silent HBM corruption' the scrub pass must catch).
+    Returns the damaged forest; the original buffers are not donated."""
+    import jax.numpy as jnp
+
+    nodes = getattr(forest, tree)
+    flipped = nodes.at[0, node, word].set(nodes[0, node, word] ^ jnp.uint32(0xDEADBEEF))
+    return forest._replace(**{tree: flipped})
+
+
+def _clear_kernels_after_fork_in_child() -> None:
+    # fork-safety: cached executables reference the parent's devices
+    _rebuild_check_kernel.cache_clear()
+    _rebuild_kernel.cache_clear()
+    _root_kernel.cache_clear()
+    _scrub_kernel.cache_clear()
+
+
+os.register_at_fork(after_in_child=_clear_kernels_after_fork_in_child)

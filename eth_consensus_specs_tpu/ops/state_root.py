@@ -889,3 +889,47 @@ def post_epoch_state_root_inc(
         part_root=forest.part_root,
     )
     return forest, combine_state_root(arrays, meta, dyn)
+
+
+def state_root_from_forest(
+    arrays: StateRootArrays,
+    meta: StateRootMeta,
+    plan: ForestPlan,
+    forest: StateForest,
+    just,
+) -> jnp.ndarray:
+    """The full post-epoch state root recomputed from a RESIDENT forest
+    with ZERO dirty work (traceable) — the digest gate checkpoint
+    manifests and restore verification share with the incremental epoch
+    path. Same folds, same length mixes, same _small_dynamic_roots,
+    same top combine as post_epoch_state_root_inc, so a root computed
+    here bit-matches the one the resident chain would have produced on
+    the same forest — which is exactly what lets a restore REFUSE to
+    serve a forest whose recomputed root disagrees with its manifest."""
+    from eth_consensus_specs_tpu.ops import merkle_inc
+
+    n = meta.n_validators
+    zh = arrays.zerohashes
+    slot_of = {name: i for i, name in meta.dynamic_slots}
+    dyn: dict[int, jnp.ndarray] = {}
+
+    sub_val = merkle_inc.forest_root(forest.val_nodes)
+    full = fold_to_limit(sub_val, plan.depth_val, VALIDATOR_REGISTRY_LIMIT_LOG2, zh)
+    dyn[slot_of["validators"]] = mix_length(full, n)
+
+    sub_bal = merkle_inc.forest_root(forest.bal_nodes)
+    dyn[slot_of["balances"]] = mix_length(
+        fold_to_limit(sub_bal, plan.depth_bal, BALANCE_LIMIT_CHUNKS_LOG2, zh), n
+    )
+    if plan.has_inact and "inactivity_scores" in slot_of:
+        sub_in = merkle_inc.forest_root(forest.inact_nodes)
+        dyn[slot_of["inactivity_scores"]] = mix_length(
+            fold_to_limit(sub_in, plan.depth_bal, BALANCE_LIMIT_CHUNKS_LOG2, zh), n
+        )
+    if "previous_epoch_participation" in slot_of:
+        dyn[slot_of["previous_epoch_participation"]] = forest.part_root
+        dyn[slot_of["current_epoch_participation"]] = jnp.asarray(
+            _zero_u8_list_root_words(n)
+        )
+    dyn.update(_small_dynamic_roots(slot_of, just))
+    return combine_state_root(arrays, meta, dyn)

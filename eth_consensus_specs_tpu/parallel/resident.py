@@ -411,3 +411,72 @@ def writeback(spec, state, carry: ResidentCarry) -> None:
     spec._writeback_justification(state, shim)
     spec._writeback_balances(state, shim)
     spec._writeback_extra(state, shim)
+
+
+def run_epochs_checkpointed(
+    spec,
+    cols: AltairEpochColumns,
+    just: JustificationState,
+    n_epochs: int,
+    *,
+    static,
+    forest=None,
+    mesh=None,
+    dirty_cap: int | None = None,
+    ckpt_dir: str | None = None,
+    ckpt_interval: int = 0,
+    epoch0: int = 0,
+    incremental: bool = True,
+):
+    """``run_epochs(with_root="state_inc")`` in interval-sized chunks
+    with a durable checkpoint after each chunk — the checkpoint hook of
+    the durable-resident-state subsystem (ops/snapshot.py). Each chunk
+    threads ``carry.forest`` forward through the donated jit chain; the
+    checkpoint itself runs OUTSIDE it (host fetch + verified writes),
+    so the resident buffers are never aliased mid-write. Returns
+    ``(carry, root_bytes, epoch)`` where root_bytes is the canonical
+    combined state root of the FINAL state (the same digest gate a
+    restore verifies against) and epoch is ``epoch0 + n_epochs``.
+
+    ``ckpt_interval <= 0`` (or no ``ckpt_dir``) degenerates to one
+    uncheckpointed run — same arithmetic, same donation discipline."""
+    from eth_consensus_specs_tpu.ops import snapshot
+
+    if forest is None:
+        forest, _ = build_state_forest_device(
+            static, cols, mesh=mesh, dirty_cap=dirty_cap
+        )
+    plan = forest_plan_for(static, mesh=mesh, dirty_cap=dirty_cap)
+    carry = ResidentCarry(cols=cols, just=just, root_acc=None, forest=forest)
+    epoch = int(epoch0)
+    remaining = int(n_epochs)
+    step = int(ckpt_interval) if (ckpt_dir and ckpt_interval > 0) else remaining
+    while remaining > 0:
+        chunk = min(step, remaining)
+        carry = run_epochs(
+            spec,
+            carry.cols,
+            carry.just,
+            chunk,
+            with_root="state_inc",
+            static=static,
+            forest=carry.forest,
+            mesh=mesh,
+            dirty_cap=dirty_cap,
+        )
+        epoch += chunk
+        remaining -= chunk
+        if ckpt_dir:
+            snapshot.checkpoint(
+                ckpt_dir,
+                carry.forest,
+                carry.cols,
+                carry.just,
+                epoch=epoch,
+                plan=plan,
+                static=static,
+                epoch0=int(epoch0),
+                incremental=incremental,
+            )
+    root = snapshot.state_root_bytes(static, plan, carry.forest, carry.just)
+    return carry, root, epoch

@@ -71,6 +71,27 @@ Two-tier fleet (heterogeneous replicas × mesh, docs/serving.md
                                       before a retire
     ETH_SPECS_SERVE_SCALE_COOLDOWN_S=5  minimum seconds between scale
                                       actions
+
+Durable resident state (serve/resident_owner.py, ops/snapshot.py;
+docs/tpu.md "Durable resident state"):
+
+    ETH_SPECS_RESIDENT_CKPT_DIR=<dir> directory of the content-addressed
+                                      checkpoint store; set = each
+                                      replica owns a digest-verified
+                                      resident world (restore at boot,
+                                      checkpoint every N epochs)
+    ETH_SPECS_RESIDENT_VALIDATORS=256 registry size of the resident
+                                      world (deterministic synthetic
+                                      state — a cold re-ingest across
+                                      restarts reproduces it bit-exact)
+    ETH_SPECS_RESIDENT_CKPT_INTERVAL=2  epochs between durable
+                                      checkpoints inside one advance
+    ETH_SPECS_RESIDENT_SCRUB_K=8      randomly-salted subtrees re-hashed
+                                      per idle scrub pass
+    ETH_SPECS_RESIDENT_RESTORE=prefer restore policy at boot: prefer
+                                      (restore, degrade to re-ingest on
+                                      damage), require (refuse to boot
+                                      on damage), never (always cold)
 """
 
 from __future__ import annotations
@@ -114,6 +135,16 @@ class ServeConfig:
     # force the single-device path for THIS service (the mesh bench
     # runs a chips=1 and a chips=N service in one process)
     mesh_chips: int = 0
+    # durable resident state (serve/resident_owner.py): non-empty dir =
+    # this replica owns a digest-verified resident world backed by the
+    # content-addressed checkpoint store at that path
+    resident_ckpt_dir: str = ""
+    resident_validators: int = 256
+    resident_ckpt_interval: int = 2
+    resident_scrub_k: int = 8
+    # "prefer" restores then degrades to re-ingest on damage; "require"
+    # refuses to boot on damage; "never" always cold-ingests
+    resident_restore: str = "prefer"
 
     def __post_init__(self):
         # the largest bucket must hold a full flush wherever the config
@@ -139,6 +170,21 @@ class ServeConfig:
             pressure_fraction=_env_float("ETH_SPECS_SERVE_PRESSURE", cls.pressure_fraction),
             buckets=buckets or cls.buckets,
             idle_flush=os.environ.get("ETH_SPECS_SERVE_IDLE_FLUSH") == "1",
+            resident_ckpt_dir=os.environ.get(
+                "ETH_SPECS_RESIDENT_CKPT_DIR", cls.resident_ckpt_dir
+            ),
+            resident_validators=_env_int(
+                "ETH_SPECS_RESIDENT_VALIDATORS", cls.resident_validators
+            ),
+            resident_ckpt_interval=_env_int(
+                "ETH_SPECS_RESIDENT_CKPT_INTERVAL", cls.resident_ckpt_interval
+            ),
+            resident_scrub_k=_env_int(
+                "ETH_SPECS_RESIDENT_SCRUB_K", cls.resident_scrub_k
+            ),
+            resident_restore=os.environ.get(
+                "ETH_SPECS_RESIDENT_RESTORE", cls.resident_restore
+            ),
         )
         if overrides:
             cfg = replace(cfg, **overrides)  # __post_init__ re-checks buckets

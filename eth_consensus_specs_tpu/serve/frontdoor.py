@@ -593,6 +593,10 @@ class FrontDoor(FrontDoorClient):
         self._retired = [False] * n
         self._respawn_failures = [0] * n
         self._respawn_not_before = [0.0] * n
+        # death timestamps: the recovery stage of the waterfall is
+        # death→ready of the REPLACEMENT, measured here because the dead
+        # process obviously can't report its own outage
+        self._death_t = [0.0] * n
         ports = [0] * n
         # replica 0 boots alone first: it writes the shippable warmup
         # artifact (explicit warm keys + its own first dispatches); the
@@ -754,7 +758,8 @@ class FrontDoor(FrontDoorClient):
         merge_delta(resp.get("obs_delta") or {}, self._rings[i])
         self._health[i] = {
             k: resp.get(k)
-            for k in ("pid", "draining", "queue_depth", "compiles", "compiles_after_ready")
+            for k in ("pid", "draining", "queue_depth", "compiles",
+                      "compiles_after_ready", "resident")
         }
 
     def _handle_replica_death(self, i: int) -> None:
@@ -765,6 +770,7 @@ class FrontDoor(FrontDoorClient):
             # per supervision tick while a respawn keeps failing
             exitcode = proc.exitcode
             self._procs[i] = None
+            self._death_t[i] = time.monotonic()
             self.router.mark_down(i)
             obs.count("frontdoor.replicas_replaced", 1)
             obs.event("frontdoor.replica_lost", replica=i, exitcode=exitcode)
@@ -835,6 +841,21 @@ class FrontDoor(FrontDoorClient):
             self._set_endpoint(i, port)
             self.router.mark_up(i)
             self._install_profile(i, profile)
+            # the recovery stage of the request waterfall: how long the
+            # slot was dark, death → replacement ready. A durable
+            # resident replica's ready profile also carries its
+            # checkpoint lineage — restore-then-replay vs cold re-ingest
+            # is visible right here, per recovery
+            if self._death_t[i] > 0.0:
+                ms = (time.monotonic() - self._death_t[i]) * 1000.0
+                self._death_t[i] = 0.0
+                obs.observe("serve.stage_ms.recovery", ms)
+                obs.event(
+                    "frontdoor.replica_recovered",
+                    replica=i,
+                    recovery_ms=round(ms, 3),
+                    resident=(profile or {}).get("resident"),
+                )
         finally:
             self._restarting[i] = False
 
@@ -939,6 +960,7 @@ class FrontDoor(FrontDoorClient):
                     self._retired.append(False)
                     self._respawn_failures.append(0)
                     self._respawn_not_before.append(0.0)
+                    self._death_t.append(0.0)
                     self._addrs.append(("127.0.0.1", 0))
                     self._gens.append(0)
                     # _procs grows LAST: len(self._procs) is the bound

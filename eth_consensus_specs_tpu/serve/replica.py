@@ -65,6 +65,9 @@ class ReplicaServer:
         self._stop = threading.Event()
         self._draining = False
         self._compiles_ready = 0
+        # durable resident state (serve/resident_owner.py): set by
+        # replica_main when ETH_SPECS_RESIDENT_CKPT_DIR is configured
+        self.resident = None
         # per-replica shipping baseline: swallow everything inherited
         # across the fork (and the boot-warmup churn folds in at the
         # first probe, attributed to this replica)
@@ -137,6 +140,12 @@ class ReplicaServer:
         if op == "submit":
             if self._draining:
                 return {"ok": False, "err": "draining"}
+            if self.resident is not None and self.resident.busy:
+                # admission honesty during restore: answer busy with the
+                # MEASURED restore ETA — the router backs off for about
+                # as long as the restore really needs instead of
+                # blackholing or hammering a booting resident replica
+                raise Overloaded("restoring", self.resident.retry_after_s(), 0, 0)
             # the chaos seam: stall (→ client hedges), kill (→ parent
             # respawns + postmortem), raise — all via ETH_SPECS_FAULT
             fault.check(wire.SITE, tag=msg.get("kind"))
@@ -167,9 +176,25 @@ class ReplicaServer:
                     if stages:
                         resp["stages"] = stages
                     return resp
+        if op == "resident.status":
+            if self.resident is None:
+                return {"ok": False, "err": "error", "detail": "no resident state"}
+            return {"ok": True, **self.resident.status()}
+        if op in ("resident.epochs", "resident.scrub", "resident.checkpoint"):
+            owner = self.resident
+            if owner is None:
+                return {"ok": False, "err": "error", "detail": "no resident state"}
+            if owner.busy:
+                raise Overloaded("restoring", owner.retry_after_s(), 0, 0)
+            fault.check(wire.SITE, tag=op)
+            if op == "resident.epochs":
+                return {"ok": True, **owner.advance(int(msg.get("n", 1)))}
+            if op == "resident.scrub":
+                return {"ok": True, **owner.scrub(msg.get("k"))}
+            return {"ok": True, **owner.checkpoint_now()}
         if op == "health":
             now = _compiles()
-            return {
+            resp = {
                 "ok": True,
                 "pid": os.getpid(),
                 "name": self.name,
@@ -179,6 +204,9 @@ class ReplicaServer:
                 "compiles_after_ready": now - self._compiles_ready,
                 "obs_delta": self._shipper.delta(),
             }
+            if self.resident is not None:
+                resp["resident"] = self.resident.status()
+            return resp
         if op == "drain":
             self._draining = True
             obs.event("frontdoor.replica_draining", name=self.name)
@@ -264,6 +292,22 @@ def replica_main(
             server._listener.close()
             server._listener = relisten
             server.port = relisten.getsockname()[1]
+    serve_thread = None
+    if cfg.resident_ckpt_dir:
+        # durable resident state: start ANSWERING on the socket before
+        # the restore runs — probes arriving mid-restore get an honest
+        # restoring-busy with a measured retry_after_s (never a
+        # blackhole), while the restore itself (and its compiles) stays
+        # on this thread, BEFORE mark_ready, so the zero-cold-compiles
+        # gate covers the resident kernels too
+        from .resident_owner import ResidentOwner
+
+        server.resident = ResidentOwner(cfg, name=name)
+        serve_thread = threading.Thread(
+            target=server.serve_forever, daemon=True, name=f"{name}-serve"
+        )
+        serve_thread.start()
+        server.resident.boot()
     warmed = 0
     try:
         if warm_keys:
@@ -291,6 +335,11 @@ def replica_main(
         "signature": mesh_ops.mesh_signature(mesh),
         "warm_keys": [list(k) for k in buckets.seen_shapes()],
     }
+    if server.resident is not None:
+        # checkpoint lineage rides the ready profile: the front door
+        # learns WHICH manifest this replica restored from and whether
+        # the boot was restored / cold / reingested
+        profile["resident"] = server.resident.lineage()
     obs.event(
         "frontdoor.replica_ready",
         name=name, port=server.port, warmed=warmed,
@@ -302,6 +351,11 @@ def replica_main(
     except OSError:
         pass  # parent died during boot; serve_forever will exit on its own
     try:
-        server.serve_forever()
+        if serve_thread is not None:
+            # the resident boot already started the accept loop; this
+            # thread just waits for shutdown to close the listener
+            serve_thread.join()
+        else:
+            server.serve_forever()
     finally:
         svc.close()

@@ -103,7 +103,7 @@ def _stage_rows(reg: dict) -> list[tuple[str, dict]]:
         if name.startswith(_STAGE_PREFIX)
     }
     order = ["admit", "queue", "prep", "handoff", "dispatch_wait",
-             "device", "resolve", "wire", "other", "total"]
+             "device", "resolve", "wire", "recovery", "other", "total"]
     ordered = [(s, rows.pop(s)) for s in order if s in rows]
     return ordered + sorted(rows.items())
 
@@ -174,6 +174,17 @@ def summarize(bundle: dict, path: str | None = None, ring_tail: int = _RING_TAIL
             f"  watchdog: {wd.get('checks', 0)} checks, "
             f"{wd.get('divergences', 0)} divergences"
         )
+    ckpt = bundle.get("checkpoint")
+    if ckpt:
+        bits = [f"verdict {ckpt.get('verdict')}"]
+        if ckpt.get("manifest"):
+            bits.insert(0, f"manifest {str(ckpt['manifest'])[:16]}")
+        span = ckpt.get("epoch_span")
+        if span:
+            bits.append(f"epochs {span[0]}..{span[1]}")
+        if ckpt.get("restore_ms") is not None:
+            bits.append(f"restore {ckpt['restore_ms']:.0f} ms")
+        lines.append("  checkpoint lineage: " + ", ".join(bits))
     if counters:
         lines.append("  top counters:")
         for name, val in _top_counters(counters):
@@ -235,6 +246,13 @@ def diff_bundles(a: dict, b: dict, a_name: str = "A", b_name: str = "B") -> str:
     wb = (b.get("hbm") or {}).get("high_water_bytes")
     if wa != wb:
         lines.append(f"  hbm high water: {wa} → {wb} bytes")
+    ka, kb = a.get("checkpoint") or {}, b.get("checkpoint") or {}
+    if ka != kb:
+        lines.append("  checkpoint lineage:")
+        for key in sorted(set(ka) | set(kb)):
+            va, vb = ka.get(key), kb.get(key)
+            if va != vb:
+                lines.append(f"    {key:<12} {va} → {vb}")
     ea, eb = a.get("env", {}), b.get("env", {})
     env_drift = {
         k: (ea.get(k), eb.get(k))

@@ -1,0 +1,305 @@
+"""Durable resident state (ops/snapshot.py): digest-gated checkpoint /
+restore / scrub.
+
+Tier-1-cheap corners on one shared small world (64 validators, altair
+minimal): checkpoint→restore round trips under both verification legs,
+torn/corrupt checkpoints REFUSED (and degraded to re-ingest through the
+fault ladder, never served), commit ordering (a failed checkpoint
+leaves the previous LATEST intact), incremental ≡ full by
+content_digest, the scrub pass catching deliberately flipped resident
+words at every level class (upper region, internal subtree level,
+leaf), quarantine-and-rebuild healing exactly the internal flips, and
+the restoring replica's admission honesty. The full device epoch-chain
+parity (restore at epoch 1 + 2 replayed epochs ≡ 3 uninterrupted) runs
+on the slow lane; scripts/recovery_smoke.py drives the same gate
+end to end through a SIGKILLed replica."""
+
+import json
+import os
+from types import SimpleNamespace
+
+import numpy as np
+import pytest
+
+import jax
+
+from eth_consensus_specs_tpu import fault, obs
+from eth_consensus_specs_tpu.ops import snapshot
+from eth_consensus_specs_tpu.parallel import resident
+
+N = 64
+
+
+def _world():
+    import __graft_entry__ as graft
+
+    from eth_consensus_specs_tpu.forks import get_spec
+    from eth_consensus_specs_tpu.ops.state_root import synthetic_static
+
+    spec = get_spec("altair", "minimal")
+    cols, just = graft._example_altair_inputs(N)
+    cols, just = jax.device_put(cols), jax.device_put(just)
+    static = synthetic_static(spec, N)
+    forest, plan = resident.build_state_forest_device(static, cols)
+    root = snapshot.state_root_bytes(static, plan, forest, just)
+    val_root = snapshot._host_combine(np.asarray(forest.val_nodes)[:, -1, :])
+    return SimpleNamespace(
+        spec=spec, cols=cols, just=just, static=static,
+        forest=forest, plan=plan, root=root, val_root=val_root,
+    )
+
+
+@pytest.fixture(scope="module")
+def world():
+    return _world()
+
+
+@pytest.fixture(autouse=True)
+def _clean_rules():
+    yield
+    fault.install(None)
+
+
+def _ckpt(world, d, **kw):
+    kw.setdefault("epoch", 0)
+    kw.setdefault("plan", world.plan)
+    kw.setdefault("state_root", world.root)
+    return snapshot.checkpoint(d, world.forest, world.cols, world.just, **kw)
+
+
+# ------------------------------------------------------ checkpoint/restore --
+
+
+def test_checkpoint_restore_roundtrip_host_verified(world, tmp_path):
+    d = str(tmp_path)
+    res = _ckpt(world, d)
+    assert res.manifest["state_root"] == world.root.hex()
+    assert res.manifest["trees"]["val_nodes"]["root"] == world.val_root.hex()
+    rs = snapshot.restore(d, verify="host")
+    assert rs is not None and rs.verdict == "verified-host" and rs.epoch == 0
+    np.testing.assert_array_equal(
+        np.asarray(rs.forest.val_nodes), np.asarray(world.forest.val_nodes)
+    )
+    for got, want in zip(
+        jax.tree_util.tree_leaves(rs.cols), jax.tree_util.tree_leaves(world.cols)
+    ):
+        np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+    for got, want in zip(
+        jax.tree_util.tree_leaves(rs.just), jax.tree_util.tree_leaves(world.just)
+    ):
+        np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+
+def test_restore_device_verified_root_bit_matches_manifest(world, tmp_path):
+    d = str(tmp_path)
+    _ckpt(world, d)
+    rs = snapshot.restore(d, static=world.static, verify="device")
+    assert rs.verdict == "verified-device"
+    # the refusal gate recomputed the combined root and bit-matched the
+    # manifest; recompute once more from the restored buffers to pin it
+    assert (
+        snapshot.state_root_bytes(world.static, rs.plan, rs.forest, rs.just)
+        == world.root
+    )
+
+
+def test_empty_store_restores_none(tmp_path):
+    assert snapshot.restore(str(tmp_path), verify="host") is None
+
+
+def test_incremental_checkpoint_equals_full_by_content_digest(world, tmp_path):
+    da, db = str(tmp_path / "a"), str(tmp_path / "b")
+    inc0 = _ckpt(world, da, incremental=True)
+    full = _ckpt(world, db, incremental=False)
+    assert inc0.manifest["content_digest"] == full.manifest["content_digest"]
+    # a second incremental checkpoint of the same state writes NO blobs
+    # (same epoch: content_digest covers {epoch, root, trees, columns})
+    inc1 = _ckpt(world, da, incremental=True)
+    assert inc1.written == 0 and inc1.reused > 0
+    assert inc1.manifest["content_digest"] == full.manifest["content_digest"]
+    assert inc1.manifest["parent"] == inc0.digest
+
+
+# ------------------------------------------------------------ torn/corrupt --
+
+
+def test_corrupt_blob_on_disk_is_refused(world, tmp_path):
+    d = str(tmp_path)
+    res = _ckpt(world, d)
+    dig = res.manifest["trees"]["val_nodes"]["shards"][0]
+    path = os.path.join(d, "objects", dig)
+    raw = bytearray(open(path, "rb").read())
+    raw[len(raw) // 2] ^= 0xFF
+    open(path, "wb").write(bytes(raw))
+    with pytest.raises(snapshot.TornCheckpoint):
+        snapshot.restore(d, verify="host")
+
+
+def test_corrupt_restore_degrades_to_reingest_never_serves(world, tmp_path):
+    d = str(tmp_path)
+    _ckpt(world, d)
+    fault.install("resident.restore:corrupt:times=inf")
+    with pytest.raises(snapshot.TornCheckpoint):
+        snapshot.restore(d, verify="host")
+    # the ladder: SnapshotError declares degradable=True, so the serve
+    # boot falls back to the deterministic host re-ingest
+    before = obs.snapshot()["counters"].get("fault.degraded", 0)
+    got = fault.degrade(
+        "resident.restore",
+        lambda: snapshot.restore(d, verify="host"),
+        lambda: "reingested",
+    )
+    assert got == "reingested"
+    assert obs.snapshot()["counters"].get("fault.degraded", 0) == before + 1
+
+
+def test_tampered_manifest_state_root_is_refused(world, tmp_path):
+    d = str(tmp_path)
+    res = _ckpt(world, d)
+    # an attacker (or bit rot) rewrites the manifest with a wrong root
+    # AND a consistent digest: the device re-verification still refuses
+    bad = dict(res.manifest)
+    bad["state_root"] = ("00" * 32)
+    data = json.dumps(bad, sort_keys=True).encode()
+    name = json.loads(open(os.path.join(d, "LATEST"), "rb").read())["manifest"]
+    open(os.path.join(d, name), "wb").write(data)
+    open(os.path.join(d, "LATEST"), "w").write(
+        json.dumps({"manifest": name, "digest": snapshot._digest(data)})
+    )
+    with pytest.raises(snapshot.RestoreMismatch):
+        snapshot.restore(d, static=world.static, verify="device")
+
+
+def test_torn_write_detected_retried_and_counted(world, tmp_path):
+    d = str(tmp_path)
+    before = obs.snapshot()["counters"].get("resident.torn_writes", 0)
+    fault.install("resident.checkpoint:corrupt:times=1")
+    res = _ckpt(world, d)  # first write torn, the retry lands clean
+    assert res.manifest["state_root"] == world.root.hex()
+    assert obs.snapshot()["counters"].get("resident.torn_writes", 0) > before
+    assert snapshot.restore(d, verify="host").epoch == 0
+
+
+def test_failed_checkpoint_leaves_previous_latest_intact(world, tmp_path):
+    d = str(tmp_path)
+    _ckpt(world, d, epoch=0)
+    fault.install("resident.checkpoint:corrupt:times=inf")  # every write torn
+    with pytest.raises(snapshot.TornCheckpoint):
+        _ckpt(world, d, epoch=1)
+    fault.install(None)
+    rs = snapshot.restore(d, verify="host")
+    assert rs.epoch == 0  # commit order: blobs -> manifest -> LATEST
+
+
+# ------------------------------------------------------------------- scrub --
+
+
+def test_scrub_clean_forest_reports_no_mismatch(world):
+    rep = snapshot.scrub_forest(
+        world.forest, k=2, salt=1, expect_root=world.val_root
+    )
+    assert rep.mismatches == 0 and not rep.bad
+    assert rep.checks > 0 and rep.root == world.val_root
+
+
+def test_scrub_catches_upper_region_flip_every_pass(world):
+    # node 124 of the depth-6 val tree is level 5 — above the subtree
+    # cut, so the always-on upper sweep catches it on ANY salt
+    dmg = snapshot.flip_resident_word(world.forest, "val_nodes", 124)
+    rep = snapshot.scrub_forest(dmg, k=2, salt=3)
+    assert rep.mismatches >= 1 and -1 in rep.bad["val_nodes"]
+
+
+def test_scrub_catches_internal_flip_and_quarantine_heals(world):
+    # node 100 is level 2 — inside a sampled subtree's column; the
+    # salted positions are deterministic, so walk salts until the
+    # sampler covers the damaged subtree
+    dmg = snapshot.flip_resident_word(world.forest, "val_nodes", 100)
+    rep = None
+    for salt in range(16):
+        rep = snapshot.scrub_forest(dmg, k=2, salt=salt)
+        if rep.mismatches:
+            break
+    assert rep is not None and rep.mismatches >= 1
+    healed = snapshot.quarantine_rebuild(dmg, "val_nodes")
+    assert (
+        snapshot.state_root_bytes(world.static, world.plan, healed, world.just)
+        == world.root
+    )
+
+
+def test_scrub_leaf_flip_survives_rebuild_forcing_reingest(world):
+    # a flipped LEAF is not healable from the leaves themselves: the
+    # rebuild produces a consistent-but-wrong tree, the root check
+    # fails, and the owner's escalation is the full re-ingest
+    dmg = snapshot.flip_resident_word(world.forest, "val_nodes", 3)
+    healed = snapshot.quarantine_rebuild(dmg, "val_nodes")
+    assert (
+        snapshot.state_root_bytes(world.static, world.plan, healed, world.just)
+        != world.root
+    )
+
+
+def test_scrub_corrupt_seam_fires_through_the_grammar(world):
+    fault.install("resident.scrub:corrupt")
+    rep = snapshot.scrub_forest(
+        world.forest, k=2, salt=1, expect_root=world.val_root
+    )
+    assert rep.mismatches >= 1
+
+
+# ------------------------------------------------------- admission honesty --
+
+
+def test_restoring_owner_answers_busy_with_measured_eta(tmp_path):
+    from eth_consensus_specs_tpu.serve.config import ServeConfig
+    from eth_consensus_specs_tpu.serve.resident_owner import ResidentOwner
+
+    (tmp_path / "restore_stats.json").write_text('{"restore_s": 1.5}')
+    cfg = ServeConfig(resident_ckpt_dir=str(tmp_path))
+    owner = ResidentOwner(cfg)
+    assert owner.busy
+    eta = owner.retry_after_s()
+    assert 0 < eta <= 1.5  # the previously MEASURED wall, minus elapsed
+    st = owner.status()
+    assert st["restoring"] and st["retry_after_s"] > 0
+    assert st["lineage"]["verdict"] == "restoring"
+
+
+def test_restoring_owner_without_stats_uses_floor_eta(tmp_path):
+    from eth_consensus_specs_tpu.serve.config import ServeConfig
+    from eth_consensus_specs_tpu.serve.resident_owner import ResidentOwner
+
+    owner = ResidentOwner(ServeConfig(resident_ckpt_dir=str(tmp_path)))
+    assert 0 < owner.retry_after_s() <= 2.0
+
+
+# --------------------------------------------------------------- slow lane --
+
+
+@pytest.mark.slow  # three epoch-chain compiles (1, 2 and 3 epochs)
+def test_restore_then_replay_equals_uninterrupted(tmp_path):
+    w = _world()
+    d = str(tmp_path)
+    # control: 3 uninterrupted epochs from the same deterministic world
+    _, control_root, _ = resident.run_epochs_checkpointed(
+        w.spec, w.cols, w.just, 3, static=w.static
+    )
+    # interrupted: 1 epoch checkpointed, restore, 2 replayed epochs
+    w2 = _world()
+    _, _, epoch = resident.run_epochs_checkpointed(
+        w2.spec, w2.cols, w2.just, 1, static=w2.static, forest=w2.forest,
+        ckpt_dir=d, ckpt_interval=1,
+    )
+    assert epoch == 1
+    rs = snapshot.restore(d, static=w2.static, verify="device")
+    assert rs.epoch == 1
+    _, root, epoch = resident.run_epochs_checkpointed(
+        w2.spec, rs.cols, rs.just, 2, static=w2.static, forest=rs.forest,
+        ckpt_dir=d, ckpt_interval=2, epoch0=rs.epoch,
+    )
+    assert epoch == 3
+    assert root == control_root  # 1 + 2 restored ≡ 3 uninterrupted, bit for bit
+    final = snapshot.latest(d)
+    assert final is not None and final[0]["epoch"] == 3
+    assert final[0]["state_root"] == root.hex()
