@@ -959,6 +959,135 @@ def _resident_scrub_key_grid(mesh):
     return out
 
 
+def _forest_args(plan):
+    """ShapeDtypeStruct pytree of a StateForest under this plan — the
+    donated argument of the slot_apply family (run_epochs shares the
+    same layout)."""
+    from eth_consensus_specs_tpu.ops.state_root import StateForest
+
+    mv = (1 << (plan.depth_val + 1)) - 1
+    mb = (1 << (plan.depth_bal + 1)) - 1
+    return StateForest(
+        val_nodes=_sds((plan.shards, mv, 8), "uint32"),
+        bal_nodes=_sds((plan.shards, mb, 8), "uint32"),
+        inact_nodes=_sds((plan.shards, mb, 8), "uint32") if plan.has_inact else None,
+        part_root=_sds((8,), "uint32"),
+    )
+
+
+def _slot_apply_args(meta, plan, p_flags: int, p_rewards: int):
+    arrays, (bal, eff, inact), just = _state_root_args(meta)
+    n = meta.n_validators
+    return (
+        arrays,
+        _forest_args(plan),
+        bal,
+        eff,
+        inact,
+        _sds((n,), "uint8"),  # prev_flags participation column
+        _sds((n,), "bool_"),  # cur_tgt_att column
+        just,
+        _sds((p_flags,), "int32"),  # flag scatter indices (pad lanes -> 0)
+        _sds((p_flags,), "uint8"),  # flag_on hit bits (pad lanes -> 0)
+        _sds((p_rewards,), "int32"),  # reward scatter indices
+        _sds((p_rewards,), "uint64"),  # reward amounts (pad lanes -> 0)
+    )
+
+
+_BALANCE_GWEI = Domain(
+    "balance gwei < 2^63 (headroom for the slot's reward adds)",
+    hi=(1 << 63) - 1,
+    corners=(("zero", 0), ("max", (1 << 63) - 1)),
+)
+_REWARD_GWEI = Domain(
+    "per-validator sync reward gwei < 2^32",
+    hi=(1 << 32) - 1,
+    corners=(("zero", 0), ("max", (1 << 32) - 1)),
+)
+
+
+def _slot_apply_domains(meta, plan, p_flags: int, p_rewards: int) -> tuple:
+    n = meta.n_validators
+    idx = Domain(
+        "validator index in [0, n)",
+        hi=n - 1,
+        corners=(("zero", 0), ("last", n - 1)),
+    )
+    forest_words = (_WORDS32,) * (4 if plan.has_inact else 3)
+    return (
+        # StateRootArrays (same order as the state_root family)
+        _WORDS32,
+        _WORDS32,
+        _WORDS32,
+        _BYTES_FULL,
+        _WORDS32,
+        _WORDS32,
+        # StateForest: val_nodes, bal_nodes, [inact_nodes,] part_root
+        *forest_words,
+        # balance is ADDED to (bounded), eff/inact are only hashed
+        _BALANCE_GWEI,
+        _U64_FULL,
+        _U64_FULL,
+        _BYTES_FULL,  # prev_flags participation byte
+        _BOOL_DOMAIN,  # cur_tgt_att
+        # JustificationState (same 11 as the state_root family)
+        _U64_FULL,
+        _BOOL_DOMAIN,
+        _U64_FULL,
+        _BYTES_FULL,
+        _U64_FULL,
+        _BYTES_FULL,
+        _U64_FULL,
+        _BYTES_FULL,
+        _BYTES_FULL,
+        _BYTES_FULL,
+        _U64_FULL,
+        # scatter plan lanes
+        idx,
+        _BOOL_DOMAIN,  # flag_on hit bit (uint8 {0, 1})
+        idx,
+        _REWARD_GWEI,
+    )
+
+
+def _slot_apply_variants(mesh):
+    from eth_consensus_specs_tpu.ops import slot_pipeline
+    from eth_consensus_specs_tpu.ops.state_root import forest_plan
+
+    meta = synthetic_state_root_meta(64)
+    plan = forest_plan(meta)
+    p_flags, p_rewards = 8, 8
+    return [
+        Variant(
+            "single",
+            slot_pipeline._compiled_slot_apply(meta, plan, None, p_flags, p_rewards),
+            _slot_apply_args(meta, plan, p_flags, p_rewards),
+            domains=_slot_apply_domains(meta, plan, p_flags, p_rewards),
+        )
+    ]
+
+
+def _slot_apply_key_grid(mesh):
+    """LIVE serve/buckets.slot_key over the request-capacity grid
+    (registry size x flag/reward capacities — capacities are derived
+    from the request ALONE, so the router and the dispatch share this
+    exact surface) vs the flat traced arg shapes the jit caches on."""
+    from eth_consensus_specs_tpu.ops.state_root import forest_plan
+    from eth_consensus_specs_tpu.serve import buckets
+
+    out = []
+    for n in (64, 128):
+        meta = synthetic_state_root_meta(n)
+        plan = forest_plan(meta)
+        for flags in (1, 5, 8, 64):
+            for rewards in (1, 16):
+                key = buckets.slot_key(n, flags, rewards, plan)
+                args = _slot_apply_args(meta, plan, key[2], key[3])
+                sig = (_canon_args(args), tuple(plan))
+                out.append((key, sig))
+    return out
+
+
 def _canon_args(args) -> tuple:
     """Canonical hashable form of a ShapeDtypeStruct pytree — the part
     of the jit cache key the shape grid varies."""
@@ -1115,6 +1244,20 @@ REGISTRY: tuple[KernelSpec, ...] = (
         wraps=_SHA_WRAPS,
         build_variants=_resident_scrub_variants,
         key_grid=_resident_scrub_key_grid,
+    ),
+    KernelSpec(
+        name="slot_apply",
+        help="whole-slot fused apply (ops/slot_pipeline._compiled_slot_apply): "
+        "duplicate-safe participation scatter + sync-reward balance adds + "
+        "incremental re-root against the resident forest, one donated dispatch",
+        dtypes=frozenset({"uint32", "uint64", "uint8", "int32", "bool"}),
+        # the resident forest (flat invars 6..9 after the 6 StateRootArrays
+        # leaves): slot N+1 updates slot N's tree levels in place — the
+        # run_epochs lifecycle, same buffers
+        donate=(6, 7, 8, 9),
+        wraps=_SHA_WRAPS,
+        build_variants=_slot_apply_variants,
+        key_grid=_slot_apply_key_grid,
     ),
 )
 

@@ -175,6 +175,25 @@ ENV_VARS: tuple[EnvVar, ...] = (
        "chips the serve dispatch mesh spans (0 = every local device; 1 = "
        "single-device dispatch); `serve_bench.py --chips` forces the matching "
        "virtual CPU device count", "serving.md#mesh-sharded-dispatch"),
+    # --------------------------------------------- whole-slot pipeline --
+    _v("ETH_SPECS_SLOT_VALIDATORS", "256",
+       "registry size of the deterministic slot world `submit_slot` mutates "
+       "(the ResidentOwner recipe: same size, bit-identical state)",
+       "serving.md#whole-slot-pipeline"),
+    _v("ETH_SPECS_SLOT_CKPT_DIR", "unset",
+       "durable checkpoint store of the slot world: set on the OWNER replica "
+       "(the front door strips it from siblings — one stateful member); every "
+       "committed slot checkpoints before its result resolves",
+       "serving.md#whole-slot-pipeline"),
+    _v("ETH_SPECS_SLOT_DEDUP", "256",
+       "applied-slot idempotency window: a retried committed slot replays its "
+       "recorded result instead of double-applying (rides the checkpoint "
+       "manifest's digest-covered extra payload)",
+       "serving.md#whole-slot-pipeline"),
+    _v("ETH_SPECS_SLOT_SYNC_REWARD", "1024",
+       "per-participant gwei a VALID sync aggregate credits (the slot-level "
+       "balance mutation both the device kernel and the host fold apply)",
+       "serving.md#whole-slot-pipeline"),
     # --------------------------------------------- durable resident state --
     _v("ETH_SPECS_RESIDENT_CKPT_DIR", "unset",
        "checkpoint store for the durable resident state: set on a replica to "

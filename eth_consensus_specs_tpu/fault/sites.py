@@ -103,6 +103,24 @@ SITES: dict[str, FaultSite] = {
             "tests/test_snapshot.py",
         ),
         _S(
+            "slot.verify",
+            "the slot pipeline's device verification leg (BLS + KZG), "
+            "BEFORE any state mutation: raise degrades the WHOLE slot to "
+            "the sequential host fold, bit-identically — never a "
+            "half-applied slot",
+            ("raise", "stall"),
+            "tests/test_slot.py, scripts/slot_bench.py --chaos",
+        ),
+        _S(
+            "slot.reroot",
+            "the donated apply-and-re-root dispatch, after verdicts but "
+            "before the forest is consumed: raise retries once on device "
+            "then falls back to the host fold from the committed pre-slot "
+            "columns (the donation-consumed flag forces a forest rebuild)",
+            ("raise", "stall"),
+            "tests/test_slot.py, scripts/slot_bench.py --chaos",
+        ),
+        _S(
             "resident.scrub",
             "the salted-subtree integrity scrub: corrupt flips the observed "
             "root so the expect-root cross-check fires (mismatch counters + "

@@ -192,6 +192,15 @@ CATALOG: tuple[Metric, ...] = (
     _c("hbm.registrations", "HBM ledger buffer registrations"),
     _c("hbm.donations", "HBM ledger buffers closed by jit donation"),
     _c("hbm.deletions", "HBM ledger buffers closed by deletion"),
+    # ------------------------------------------------ whole-slot pipeline --
+    _c("slot.slots", "whole-slot requests committed by the slot world"),
+    _c("slot.attestations", "attestations carried by committed slots"),
+    _c("slot.blobs", "blob sidecars carried by committed slots"),
+    _c("slot.replays", "committed slots replayed from the dedup window"),
+    _c("slot.host_folds", "slots degraded to the sequential host fold"),
+    _c("slot.forest_rebuilds",
+       "resident forests rebuilt from committed columns after a consumed "
+       "donation (mid-dispatch device death recovery)"),
     # --------------------------------------------------------- frontdoor --
     _c("frontdoor.backoffs", "router backoffs honored"),
     _c("frontdoor.cancelled", "front-door futures cancelled"),

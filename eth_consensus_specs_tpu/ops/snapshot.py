@@ -268,14 +268,19 @@ def checkpoint(
     state_root: bytes | None = None,
     epoch0: int = 0,
     incremental: bool = True,
+    extra: dict | None = None,
 ) -> CheckpointResult:
     """Commit one durable checkpoint of the resident state. Runs OUTSIDE
     the donated jit chain (host fetch of the forest + columns). `static`
     is the (arrays, meta) pair from ingest_full — when given and
     ``state_root`` is not, the manifest root is recomputed on device via
-    the shared state_root_from_forest gate. Returns the committed
-    manifest; crash-safe at every byte: blobs commit before the
-    manifest, the manifest before LATEST, all via os.replace."""
+    the shared state_root_from_forest gate. ``extra`` is an optional
+    JSON-serializable owner payload (e.g. the slot pipeline's applied-
+    slot dedup window) stored INSIDE the digest-covered content — a
+    flipped byte in it torns the checkpoint like any other field.
+    Returns the committed manifest; crash-safe at every byte: blobs
+    commit before the manifest, the manifest before LATEST, all via
+    os.replace."""
     fault.check("resident.checkpoint")
     os.makedirs(_objects_dir(root_dir), exist_ok=True)
     if state_root is None and static is not None:
@@ -314,6 +319,8 @@ def checkpoint(
         "trees": trees,
         "columns": {"cols": cols_entry, "just": just_entry},
     }
+    if extra is not None:
+        content["extra"] = extra
     parent = None
     try:
         prev = latest(root_dir)

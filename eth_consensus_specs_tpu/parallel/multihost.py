@@ -40,6 +40,16 @@ from . import DP_AXIS, SP_AXIS
 _initialized = False
 
 
+def _runtime_client():
+    """The live ``jax.distributed`` client (or None) WITHOUT touching the
+    local backend: ``jax.process_count()`` would finalize the runtime,
+    after which ``jax.distributed.initialize`` refuses to run at all —
+    the probe must not destroy what it probes for."""
+    from jax._src import distributed as _dist
+
+    return getattr(_dist.global_state, "client", None)
+
+
 def initialize_distributed(
     coordinator_address: str | None = None,
     num_processes: int | None = None,
@@ -53,7 +63,8 @@ def initialize_distributed(
     (jax.distributed.initialize with no args works on TPU pods) > no-op
     single process."""
     global _initialized
-    if _initialized or jax.process_count() > 1:
+    if _initialized or _runtime_client() is not None:
+        # joined already (here, or by an external bootstrap)
         _initialized = True
         return jax.process_count() > 1
     with obs.span("multihost.initialize"):

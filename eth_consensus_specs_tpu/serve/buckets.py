@@ -402,6 +402,55 @@ def g2_agg_key(n_items: int, max_lanes: int, mesh=None) -> tuple:
     )
 
 
+def slot_key_from_profile(
+    n_validators: int,
+    cap_flags: int,
+    cap_rewards: int,
+    cap_val: int,
+    cap_bal: int,
+    shards: int = 1,
+    sig: str = "",
+) -> tuple:
+    """:func:`slot_key` computed from a replica profile — same contract
+    as :func:`bls_msm_key_from_profile`. The capacities are the
+    REQUEST-derived update counts (every set committee bit / sync
+    index, pre-verdict: ``ops.slot_pipeline.request_capacity``), pow2
+    bucketed; the forest-plan dirty capacities ride the key because the
+    fused re-root compiles per plan exactly like the resident runner."""
+    key = (
+        "slot_apply",
+        int(n_validators),
+        pow2_bucket(max(int(cap_flags), 1)),
+        pow2_bucket(max(int(cap_rewards), 1)),
+        int(cap_val),
+        int(cap_bal),
+    )
+    if shards > 1 and sig:
+        return (*key, sig)
+    return key
+
+
+def slot_key(n_validators: int, n_flags: int, n_rewards: int, plan, mesh=None) -> tuple:
+    """The compile/bucket/warmup key of the fused slot-apply dispatch
+    (participation/balance scatter + incremental re-root against the
+    resident forest — the whole-slot pipeline's one stateful kernel):
+    registry size + pow2-bucketed update capacities + the forest plan's
+    dirty-capacity buckets, mesh-signed only when the forest itself
+    shards (plan.shards > 1 — the slot world's forest is single-device
+    today, so live keys are unsigned like every other unsigned family)."""
+    from eth_consensus_specs_tpu.parallel import mesh_ops
+
+    return slot_key_from_profile(
+        n_validators,
+        n_flags,
+        n_rewards,
+        int(plan.cap_val),
+        int(plan.cap_bal),
+        int(plan.shards),
+        mesh_ops.mesh_signature(mesh) if int(plan.shards) > 1 else "",
+    )
+
+
 # ------------------------------------------------- fleet routing model --
 #
 # The two-tier fleet (serve/frontdoor.py) routes by (compile-shape,
@@ -433,6 +482,11 @@ def route_wide(kind: str, dim: int, max_batch: int) -> bool:
         # the KZG RLC fold shards its LANE axis too: `dim` is the lane
         # bucket the flush folds into (2n+1 lanes, pow2-bucketed)
         return int(dim) >= kzg_mesh_lanes()
+    if kind == "slot":
+        # the slot pipeline's stateful leg (the resident forest) is
+        # single-device; its verify/aggregate legs shard internally.
+        # Routing is OWNERSHIP, not width — never mesh-routed here.
+        return False
     return int(max_batch) >= mesh_ops.min_items()
 
 
@@ -449,6 +503,8 @@ def route_shape_of_key(key: tuple) -> tuple | None:
         return (op, int(dims[-1]))
     if op == "fr_fft" and len(dims) == 2:
         return (op, int(dims[1]))  # the intrinsic FFT size
+    if op == "slot_apply" and len(dims) >= 4:
+        return ("slot", int(dims[1]))  # the flag-capacity bucket
     return None
 
 
@@ -810,6 +866,14 @@ def precompile(
                         mesh=mesh,
                         pad_shape=(items, lanes),
                     )
+            elif op == "slot_apply" and len(int_dims) == 5:
+                from eth_consensus_specs_tpu.serve import slot as serve_slot
+
+                # AOT lower+compile of the fused slot-apply executable
+                # (no live forest touched); skips — not fails — when the
+                # key's forest-plan caps don't match this build
+                if not serve_slot.precompile_key((op, *int_dims), mesh=mesh):
+                    continue
             else:
                 continue
         except Exception:

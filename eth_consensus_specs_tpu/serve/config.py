@@ -92,6 +92,27 @@ docs/tpu.md "Durable resident state"):
                                       (restore, degrade to re-ingest on
                                       damage), require (refuse to boot
                                       on damage), never (always cold)
+
+Whole-slot pipeline (serve/slot.py, ops/slot_pipeline.py;
+docs/serving.md "Whole-slot pipeline"):
+
+    ETH_SPECS_SLOT_VALIDATORS=256     registry size of the slot world
+                                      (deterministic synthetic state,
+                                      the resident-world recipe)
+    ETH_SPECS_SLOT_CKPT_DIR=<dir>     content-addressed checkpoint store
+                                      of the slot world; set = every
+                                      committed slot checkpoints BEFORE
+                                      its result resolves (the zero-
+                                      lost-slots chaos discipline) and
+                                      boot restores from LATEST
+    ETH_SPECS_SLOT_DEDUP=256          applied-slot idempotency window
+                                      (replayed verbatim from the
+                                      digest-covered manifest extra on
+                                      restore — a retried committed
+                                      slot replays, never double-applies)
+    ETH_SPECS_SLOT_SYNC_REWARD=1024   per-participant gwei credited by a
+                                      valid sync aggregate (read in
+                                      ops/slot_pipeline.py)
 """
 
 from __future__ import annotations
@@ -145,6 +166,12 @@ class ServeConfig:
     # "prefer" restores then degrades to re-ingest on damage; "require"
     # refuses to boot on damage; "never" always cold-ingests
     resident_restore: str = "prefer"
+    # whole-slot pipeline world (serve/slot.py): registry size, durable
+    # checkpoint store (non-empty = durable-first commits + restore at
+    # boot), and the applied-slot idempotency window
+    slot_validators: int = 256
+    slot_ckpt_dir: str = ""
+    slot_dedup: int = 256
 
     def __post_init__(self):
         # the largest bucket must hold a full flush wherever the config
@@ -185,6 +212,13 @@ class ServeConfig:
             resident_restore=os.environ.get(
                 "ETH_SPECS_RESIDENT_RESTORE", cls.resident_restore
             ),
+            slot_validators=_env_int(
+                "ETH_SPECS_SLOT_VALIDATORS", cls.slot_validators
+            ),
+            slot_ckpt_dir=os.environ.get(
+                "ETH_SPECS_SLOT_CKPT_DIR", cls.slot_ckpt_dir
+            ),
+            slot_dedup=_env_int("ETH_SPECS_SLOT_DEDUP", cls.slot_dedup),
         )
         if overrides:
             cfg = replace(cfg, **overrides)  # __post_init__ re-checks buckets

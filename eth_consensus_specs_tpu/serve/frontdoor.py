@@ -95,6 +95,13 @@ class _FDRequest:
 def _host_execute(kind: str, payload):
     """The front door's own last rung: bit-identical to what a replica
     (device path or ITS degraded host path) would have returned."""
+    if kind == "slot":
+        # stateless host oracles stop here: the slot pipeline folds into
+        # RESIDENT state that lives on exactly one replica — the parent
+        # process has no world to apply it against, and inventing one
+        # would fork the chain. Slot requests shed typed Overloaded
+        # instead (the owner's dedup window makes the retry idempotent).
+        raise RuntimeError("slot requests cannot degrade to the front-door host")
     if kind == "bls":
         from eth_consensus_specs_tpu.crypto.signature import fast_aggregate_verify
 
@@ -218,6 +225,30 @@ class FrontDoorClient:
         # affinity by tree depth: depth is the intrinsic compile axis
         return self._submit("htr", (chunks, depth), ("merkle_many", depth), int(chunks.nbytes))
 
+    def submit_slot(self, req) -> Future:
+        """Whole-slot state transition through the fleet; resolves to
+        the exact :class:`ops.slot_pipeline.SlotResult` the owning
+        replica's world committed. STATEFUL: unlike every other verb,
+        slots route to a single owner (replica 0 — respawn-in-place
+        keeps the index stable) and never hedge, never fail over to a
+        stateless sibling, never degrade to the parent's host. A dead
+        or restoring owner sheds typed ``Overloaded``; the caller's
+        retry is idempotent against the owner's dedup window."""
+        from eth_consensus_specs_tpu.ops.slot_pipeline import SlotRequest, request_capacity
+
+        if not isinstance(req, SlotRequest):
+            raise TypeError("submit_slot takes an ops.slot_pipeline.SlotRequest")
+        flags, _rewards = request_capacity(req)
+        cost = (sum(len(part) for b in req.blobs for part in b)
+                + sum(96 + 48 * len(a.pubkeys) for a in req.attestations)
+                + 48 * len(req.sync_pubkeys))
+        # affinity by the flag-capacity bucket — the same pow2 axis
+        # buckets.slot_key compiles on, so the router's warm map and the
+        # owner's executable cache agree on what "warm" means
+        return self._submit(
+            "slot", req, ("slot", buckets.pow2_bucket(max(flags, 1))), max(cost, 1)
+        )
+
     # ------------------------------------------------------------ dispatch --
 
     def _dispatch(self, req: _FDRequest, exclude: frozenset = frozenset(),
@@ -235,6 +266,14 @@ class FrontDoorClient:
     def _dispatch_inner(
         self, req, base_exclude: frozenset, hedge_allowed: bool, is_hedge: bool
     ) -> None:
+        if req.kind == "slot":
+            # single-owner routing: the generic ladder below (sibling
+            # failover, hedging, host oracle) is WRONG for stateful
+            # traffic — a sibling has no slot world and would apply the
+            # slot against nothing, and a hedge racing the owner could
+            # double-commit. One owner, one path, typed shed on death.
+            self._dispatch_slot(req)
+            return
         hedge_allowed = (
             hedge_allowed and self.fdcfg.hedge_ms > 0 and len(self.router) > 1
         )
@@ -338,6 +377,59 @@ class FrontDoorClient:
         obs.count("serve.degraded_items", 1)
         obs.event("frontdoor.degraded_to_host", req_kind=req.kind)
         self._resolve(req, value=_host_execute(req.kind, req.payload))
+
+    def _dispatch_slot(self, req: _FDRequest) -> None:
+        """The single-owner leg: replica 0 or bust. A connection failure
+        or an owner mid-restore resolves with ``Overloaded`` carrying an
+        honest retry hint — the supervisor's respawn restores the world
+        from its durable checkpoint, and the client's retry lands in the
+        dedup window (same result bytes, ``replayed=True``)."""
+        idx = 0
+        retry_after = max(self.fdcfg.down_cooldown_s, 0.05)
+        for attempt in range(3):
+            if req.released:
+                return
+            try:
+                resp = self._rpc_submit(idx, req, hedge_allowed=False)
+            except (ConnectionError, OSError, EOFError, wire.CorruptFrame) as exc:
+                self.router.note_failure(idx)
+                obs.count("frontdoor.failovers", 1)
+                obs.event(
+                    "frontdoor.slot_owner_down",
+                    replica=idx, error=type(exc).__name__, attempt=attempt,
+                )
+                time.sleep(0.05)
+                continue
+            if resp.get("ok"):
+                self._resolve(req, value=resp["result"], stages=resp.get("stages"))
+                return
+            err = resp.get("err")
+            if err in ("overloaded", "draining"):
+                self.router.note_shed(idx, float(resp.get("retry_after_s", retry_after)))
+                self._resolve(
+                    req,
+                    exc=Overloaded(
+                        "slot-owner", float(resp.get("retry_after_s", retry_after)),
+                        self.admission.depth(), self.admission.in_flight_bytes(),
+                    ),
+                )
+                return
+            self._resolve(
+                req, exc=RuntimeError(
+                    f"slot owner rejected the request: {resp.get('detail', err)}"
+                ),
+            )
+            return
+        # owner dead across every attempt: shed, never host-execute —
+        # the respawned owner restores from its checkpoint and the
+        # caller's retry is idempotent against the dedup window
+        self._resolve(
+            req,
+            exc=Overloaded(
+                "slot-owner", retry_after,
+                self.admission.depth(), self.admission.in_flight_bytes(),
+            ),
+        )
 
     def _rpc_submit(self, idx: int, req: _FDRequest, hedge_allowed: bool) -> dict:
         msg = {
@@ -677,6 +769,12 @@ class FrontDoor(FrontDoorClient):
 
         chips = self._chips[i] if i < len(self._chips) else 0
         overrides = dict(self._cfg_overrides)
+        if overrides.get("slot_ckpt_dir") and i != 0:
+            # single-owner invariant at spawn time: the slot world (and
+            # its durable checkpoint dir) belongs to replica 0 alone —
+            # siblings never boot one, so a misrouted slot can never
+            # apply against stale state or race the owner's LATEST
+            overrides["slot_ckpt_dir"] = ""
         child_env = None
         if chips > 0:
             # an explicit per-replica slice: the child's device count and

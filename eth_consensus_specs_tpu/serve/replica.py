@@ -162,6 +162,21 @@ class ReplicaServer:
                         fut = self.service.submit_aggregate(*msg["payload"])
                     elif msg["kind"] == "kzg":
                         fut = self.service.submit_blob_verify(*msg["payload"])
+                    elif msg["kind"] == "slot":
+                        # whole-slot pipeline: stateful, single-owner —
+                        # the front door routes every slot to ONE live
+                        # replica, so this world is the fleet's only
+                        # committer (serve/slot.py dedups replays)
+                        world = self.service.slot_world()
+                        if world.busy:
+                            # eager boot in flight (a respawn restoring
+                            # its checkpoint): answer busy with the
+                            # MEASURED boot ETA instead of letting the
+                            # submit starve behind the boot lock
+                            raise Overloaded(
+                                "booting", world.retry_after_s(), 0, 0
+                            )
+                        fut = self.service.submit_slot(msg["payload"])
                     else:
                         return {"ok": False, "err": "error",
                                 "detail": f"unknown kind {msg.get('kind')!r}"}
@@ -308,6 +323,24 @@ def replica_main(
         )
         serve_thread.start()
         server.resident.boot()
+    if cfg.slot_ckpt_dir:
+        # slot-capable replica: boot (restore-or-cold) the slot world on
+        # this thread BEFORE mark_ready so the zero-cold-compiles gate
+        # covers the slot_apply executable too; a respawn finds its
+        # predecessor's durable commits in slot_ckpt_dir and resumes
+        # from the last committed slot with the dedup window intact.
+        # The socket answers DURING the boot (the resident discipline):
+        # mark_booting first, so a slot submit racing the restore gets
+        # an honest booting-busy with the measured boot ETA instead of
+        # parking in the listener backlog until the caller's RPC timeout
+        world = svc.slot_world()
+        world.mark_booting()
+        if serve_thread is None:
+            serve_thread = threading.Thread(
+                target=server.serve_forever, daemon=True, name=f"{name}-serve"
+            )
+            serve_thread.start()
+        world.boot()
     warmed = 0
     try:
         if warm_keys:
@@ -340,6 +373,11 @@ def replica_main(
         # learns WHICH manifest this replica restored from and whether
         # the boot was restored / cold / reingested
         profile["resident"] = server.resident.lineage()
+    if cfg.slot_ckpt_dir:
+        # slot capability rides the profile too: the front door's
+        # single-owner routing picks the lowest-index live replica that
+        # advertises it (stateful traffic never sprays the fleet)
+        profile["slot"] = svc.slot_world().status()
     obs.event(
         "frontdoor.replica_ready",
         name=name, port=server.port, warmed=warmed,
@@ -352,8 +390,8 @@ def replica_main(
         pass  # parent died during boot; serve_forever will exit on its own
     try:
         if serve_thread is not None:
-            # the resident boot already started the accept loop; this
-            # thread just waits for shutdown to close the listener
+            # the resident/slot boot already started the accept loop;
+            # this thread just waits for shutdown to close the listener
             serve_thread.join()
         else:
             server.serve_forever()

@@ -1,6 +1,6 @@
 """The in-process async verification service.
 
-Five submit verbs return ``concurrent.futures.Future``s:
+Six submit verbs return ``concurrent.futures.Future``s:
 
   * ``submit_bls_aggregate(pubkeys, message, signature) -> Future[bool]``
   * ``submit_aggregate(signatures) -> Future[bytes]`` (96-byte
@@ -12,6 +12,11 @@ Five submit verbs return ``concurrent.futures.Future``s:
   * ``submit_hash_tree_root(chunks) -> Future[bytes]`` (32-byte root)
   * ``submit_state_root(arrays, meta, balances, eff_bal, inact, just)
     -> Future[np.ndarray]`` (u32[8] root words)
+  * ``submit_slot(SlotRequest) -> Future[SlotResult]`` (the whole-slot
+    state-transition pipeline: verify → aggregate → column updates →
+    incremental re-root against this service's resident slot world —
+    serve/slot.py owns the state, ops/slot_pipeline.py the legs; the
+    result is bit-identical to the sequential host fold)
 
 Pipeline: ``submit`` → admission (typed ``Overloaded`` shed past the
 queue/byte caps) → micro-batcher (flush on size / deadline / pressure)
@@ -101,6 +106,11 @@ class VerifyService:
         # load and had to sort under a lock to answer p99)
         self._waits = Histogram()
         self._dispatch_busy = False
+        # the slot world is lazy: first submit_slot (or slot_world())
+        # pays boot + prewarm; None until then so slot-free services
+        # never build a registry
+        self._slot_world = None
+        self._slot_world_lock = threading.Lock()
         self._batch_thread = threading.Thread(
             target=self._batch_loop, name=f"{name}-batch", daemon=True
         )
@@ -187,6 +197,40 @@ class VerifyService:
             cost,
         )
 
+    def submit_slot(self, req) -> Future:
+        """One whole slot (ops/slot_pipeline.SlotRequest: attestations +
+        sync aggregate + blob sidecars); resolves to the SlotResult the
+        sequential host fold of the existing ops would produce —
+        verdicts, per-subnet aggregates, and the post-slot state root,
+        bit-identical. Stateful and idempotent: ``req.slot`` is the
+        dedup key, a retried committed slot replays its recorded result.
+        Admission accounts the full payload (blobs dominate)."""
+        from eth_consensus_specs_tpu.ops.slot_pipeline import SlotRequest
+
+        if not isinstance(req, SlotRequest):
+            raise TypeError("submit_slot takes an ops.slot_pipeline.SlotRequest")
+        cost = (
+            sum(len(part) for b in req.blobs for part in b)
+            + sum(96 + 48 * len(a.pubkeys) for a in req.attestations)
+            + 48 * len(req.sync_pubkeys)
+        )
+        return self._submit("slot", req, max(cost, 1))
+
+    def slot_world(self):
+        """This service's slot-pipeline world (serve/slot.py), created
+        from the config on first use. Public so replicas can boot it
+        eagerly (restore + prewarm) before marking ready."""
+        from .slot import SlotWorld
+
+        with self._slot_world_lock:
+            if self._slot_world is None:
+                self._slot_world = SlotWorld(
+                    n_validators=self.config.slot_validators,
+                    ckpt_dir=self.config.slot_ckpt_dir,
+                    dedup_cap=self.config.slot_dedup,
+                )
+            return self._slot_world
+
     # ------------------------------------------------------- batch thread --
 
     def _pressure(self) -> bool:
@@ -263,6 +307,13 @@ class VerifyService:
                     from eth_consensus_specs_tpu.ops.kzg_batch import parse_item
 
                     r.prepped = (parse_item(r.payload),)
+                elif r.kind == "slot":
+                    # the whole-slot host prep: pubkey/signature
+                    # decompression + blob parsing for every leg,
+                    # overlapped with the previous flush's device work
+                    from eth_consensus_specs_tpu.ops.slot_pipeline import prep_request
+
+                    r.prepped = prep_request(r.payload)
                 elif r.kind == "agg":
                     # G2 decompression is the per-signature fixed cost:
                     # pay it here, overlapped with the previous flush's
@@ -487,6 +538,23 @@ class VerifyService:
             for r, root in zip(group, roots):
                 results[id(r)] = root
 
+        slot_reqs = [r for r in reqs if r.kind == "slot"]
+        if slot_reqs:
+            # stateful: slots serialize against ONE world (serve/slot.py
+            # locks and commits all-or-nothing; the degrade ladder and
+            # the slot.verify/slot.reroot fault sites live INSIDE
+            # execute, so the device/host legs here are the same call —
+            # idempotent re-execution after a serve.dispatch degrade
+            # replays committed slots from the dedup window). The three
+            # phase walls ride the request into the waterfall at resolve.
+            world = self.slot_world()
+            if not device:
+                obs.count("serve.degraded_items", len(slot_reqs))
+            for r in slot_reqs:
+                result, phases = world.execute(r.payload, r.prepped, mesh=mesh)
+                r.slot_phases = phases
+                results[id(r)] = result
+
         for r in reqs:
             if r.kind != "state_root":
                 continue
@@ -542,6 +610,12 @@ class VerifyService:
         # the DURATIONS by trace id for the RPC layer — monotonic stamps
         # don't cross a process boundary, durations do (obs/waterfall.py)
         durations = waterfall.stage_durations_ms(req.t_submit, req.stamps)
+        # the slot pipeline's three phase walls (slot.verify /
+        # slot.aggregate / slot.reroot) ride the SAME stage histograms
+        # and the same per-trace stash the replica wire ships
+        phases = getattr(req, "slot_phases", None)
+        if phases:
+            durations = {**durations, **phases}
         if durations:
             waterfall.observe(durations)
             if req.trace is not None:
@@ -563,7 +637,7 @@ class VerifyService:
                 "p50": round(ch.quantile(0.5), 3),
                 "p99": round(ch.quantile(0.99), 3),
             }
-        return {
+        out = {
             "compile_ms": compile_ms,
             "queue_depth": self.admission.depth(),
             "in_flight_bytes": self.admission.in_flight_bytes(),
@@ -577,6 +651,10 @@ class VerifyService:
             "compiles": counters.get("serve.compiles", 0),
             "rejected": counters.get("serve.rejected", 0),
         }
+        world = self._slot_world
+        if world is not None:
+            out["slot"] = world.status()
+        return out
 
     def precompile(self, keys: list[tuple] | None = None, path: str | None = None) -> int:
         """Warm the compile cache from the persistent warmup list (or an

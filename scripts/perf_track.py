@@ -161,6 +161,17 @@ def load_rounds(repo_dir: str) -> list[dict]:
                 name.endswith("_scaling") or name.endswith("_rps")
             ):
                 metrics[f"fleet_{name}"] = value
+        # whole-slot pipeline (scripts/slot_bench.py): the slot-machine
+        # headline (higher-is-better ``slots_per_s``) plus the per-phase
+        # p50/p99 walls (``verify``/``aggregate``/``reroot``) on the same
+        # platform-keyed timeline. The bench REFUSES to emit
+        # ``slots_per_s`` on a parity failure, so every ingested rate is
+        # correctness-coupled by construction; the re-earn rule below
+        # holds any LKG ``slot`` section to the same standard. Bools
+        # (the coupling flag) are not metrics.
+        for name, value in (parsed.get("slot") or {}).items():
+            if isinstance(value, (int, float)) and not isinstance(value, bool):
+                metrics[f"slot_{name}" if not name.startswith("slot_") else name] = value
         # request waterfall (serve_bench's waterfall section,
         # obs/waterfall.py): per-stage p50/p99 milliseconds ride the same
         # platform-keyed timeline as secondaries — a stage-attribution
@@ -202,7 +213,7 @@ def load_lkg(repo_dir: str) -> dict:
 # sections the round-5 quarantine burned: their numbers were recorded
 # without correctness-coupled timing and may NEVER grandfather back in —
 # a fresh entry must come from a run that proved device/host parity
-_REEARN_ONLY = ("das", "tree", "epoch", "resident")
+_REEARN_ONLY = ("das", "tree", "epoch", "resident", "slot")
 
 
 def reearn_violations(lkg: dict) -> list[str]:

@@ -1,0 +1,102 @@
+"""Two real ``jax.distributed`` processes on one box: the replica-boot
+seam (``multihost.maybe_initialize_for_replica``) joins a 2-process
+runtime via the coordinator env the fleet would set, and
+``mesh_ops.serve_mesh`` takes its multi-process branch — the host-major
+hybrid mesh over EVERY process's devices, agreed byte-for-byte by both
+ranks. This is the one test that exercises the coordinator protocol for
+real instead of monkeypatching process_count."""
+
+from __future__ import annotations
+
+import json
+import os
+import socket
+import subprocess
+import sys
+
+import pytest
+
+_WORKER = r"""
+import json, os, sys
+
+import jax
+
+sys.path.insert(0, os.environ["ETH_SPECS_REPO"])
+from eth_consensus_specs_tpu.parallel import mesh_ops, multihost
+
+live = multihost.maybe_initialize_for_replica()
+mesh = mesh_ops.serve_mesh()
+print("RESULT " + json.dumps({
+    "live": bool(live),
+    "process_count": jax.process_count(),
+    "process_index": jax.process_index(),
+    "local_devices": len(jax.local_devices()),
+    "global_devices": len(jax.devices()),
+    "signature": mesh_ops.mesh_signature(mesh),
+    "shape": dict(mesh.shape) if mesh is not None else None,
+    "host_major": (
+        # host-major layout: each host's devices are contiguous along
+        # the trailing (sp) axis — every mesh row lives on ONE process
+        all(
+            len({d.process_index for d in row}) == 1
+            for row in mesh.devices
+        )
+        if mesh is not None
+        else None
+    ),
+}))
+"""
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+@pytest.mark.slow
+def test_two_process_distributed_init_and_hybrid_serve_mesh(tmp_path):
+    port = _free_port()
+    procs = []
+    for rank in range(2):
+        env = {
+            **os.environ,
+            "ETH_SPECS_REPO": os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+            "JAX_PLATFORMS": "cpu",
+            "XLA_FLAGS": "--xla_force_host_platform_device_count=4",
+            "JAX_COORDINATOR_ADDRESS": f"127.0.0.1:{port}",
+            "JAX_NUM_PROCESSES": "2",
+            "JAX_PROCESS_ID": str(rank),
+            "ETH_SPECS_SERVE_DISTRIBUTED": "1",
+            "ETH_SPECS_POSTMORTEM_DIR": str(tmp_path),
+        }
+        procs.append(
+            subprocess.Popen(
+                [sys.executable, "-c", _WORKER],
+                env=env,
+                stdout=subprocess.PIPE,
+                stderr=subprocess.STDOUT,
+                text=True,
+            )
+        )
+    outs = []
+    for p in procs:
+        out, _ = p.communicate(timeout=240)
+        outs.append(out)
+        assert p.returncode == 0, out
+    reports = []
+    for out in outs:
+        lines = [ln for ln in out.splitlines() if ln.startswith("RESULT ")]
+        assert lines, out
+        reports.append(json.loads(lines[-1][len("RESULT "):]))
+    by_rank = sorted(reports, key=lambda r: r["process_index"])
+    assert [r["process_index"] for r in by_rank] == [0, 1]
+    for r in by_rank:
+        assert r["live"] is True
+        assert r["process_count"] == 2
+        assert r["local_devices"] == 4
+        assert r["global_devices"] == 8  # the mesh IS the whole "pod"
+        assert r["host_major"] is True
+    # both ranks agree on the hybrid mesh: one identity, 8 devices
+    assert by_rank[0]["signature"] == by_rank[1]["signature"] == "cpu4x2"
+    assert by_rank[0]["shape"] == by_rank[1]["shape"] == {"dp": 4, "sp": 2}
