@@ -93,6 +93,13 @@ ENV_VARS: tuple[EnvVar, ...] = (
     _v("ETH_SPECS_SLO_DEGRADED_RATE", "0.01",
        "`degraded_rate` SLO bound (`serve.degraded_items` per serve request)",
        "observability.md#slos"),
+    _v("ETH_SPECS_OBS_TRACE_GAP_S", "120",
+       "fleet-timeline episode split: a wall-clock gap wider than this "
+       "separates re-used trace ids / slot numbers into distinct episodes",
+       "observability.md#fleet-timeline--slot-autopsy"),
+    _v("ETH_SPECS_SLOT_BUDGET_MS", "1000",
+       "per-slot latency budget the slot autopsy renders its verdict "
+       "against", "observability.md#fleet-timeline--slot-autopsy"),
     # ------------------------------------------------------------ serve --
     _v("ETH_SPECS_SERVE", "off",
        "`1`: gen pool workers route BLS verifies through a per-worker service "

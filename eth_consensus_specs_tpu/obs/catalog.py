@@ -233,6 +233,10 @@ CATALOG: tuple[Metric, ...] = (
     _g("frontdoor.replicas", "replicas currently in rotation"),
     _h("frontdoor.e2e_ms", "front-door end-to-end latency, ms"),
     _s("frontdoor.rpc", "one framed RPC at the replica boundary"),
+    # --------------------------------------------------------- slo burn --
+    _c("slo.windows", "supervision probe windows with wait samples"),
+    _c("slo.windows_breached",
+       "probe windows whose window-local wait p99 breached the objective"),
     # ---------------------------------------------------------- watchdog --
     _c("watchdog.checks", "device/host divergence probes"),
     _c("watchdog.divergences", "device/host mismatches"),

@@ -36,6 +36,11 @@ from .histogram import Histogram
 
 _MAX_EVENTS = 10_000
 
+# cached once per process (refreshed in the at-fork hook): emit() stamps
+# every event with its origin pid so the fleet timeline assembler
+# (obs/timeline.py) can group one JSONL stream's events per process
+_PID = os.getpid()
+
 
 def refresh_enabled() -> bool:
     """Re-read ETH_SPECS_OBS into the cached module flag. The flag is
@@ -328,6 +333,16 @@ class Registry:
     def emit(self, event: dict) -> None:
         if not obs_enabled():
             return
+        # paired clock stamps + process/thread identity on every event:
+        # the fleet timeline assembler (obs/timeline.py) estimates
+        # per-process clock offsets from the wall/monotonic PAIR and
+        # needs pid/tid for truthful process/thread tracks. Four scalar
+        # stores — the no-context fast path stays allocation-light.
+        if "t_mono" not in event:
+            event["t_mono"] = time.perf_counter()
+            event["t_wall"] = time.time()
+            event["pid"] = _PID
+            event["tid"] = threading.get_ident()
         # every emitted event is also a flight-recorder entry: the ring
         # holds the last N of these when a postmortem trigger fires
         flight.note_event(event)
@@ -429,10 +444,12 @@ def _reinit_locks_after_fork_in_child() -> None:
     inherited JSONL handle is dropped too — its buffer may hold half a
     line another thread was writing; the child reopens lazily in append
     mode."""
+    global _PID
     reg = _REGISTRY
     reg._lock = threading.Lock()
     reg._local = threading.local()
     reg._jsonl_fh = None
+    _PID = os.getpid()  # the child's events must carry ITS pid
     for h in list(reg.histograms.values()):
         h._lock = threading.Lock()
 

@@ -165,3 +165,32 @@ def report(results: list[SLOResult]) -> dict:
         "violations": [r.name for r in results if not r.ok],
         "results": [r.as_dict() for r in results],
     }
+
+
+def burn_rate(snap: dict | None = None) -> dict | None:
+    """Windowed burn-rate advisory: the fraction of supervision probe
+    windows (with traffic) whose window-local wait p99 breached the
+    objective. The front door supervisor bumps ``slo.windows`` /
+    ``slo.windows_breached`` per window (frontdoor._burn_step); this
+    just reads the counters from ``snap`` (default: live registry).
+
+    Returns ``{"windows", "breached", "burn_rate"}`` or None when no
+    window was ever evaluated (no supervisor, or an idle run). A p99
+    SLO that only breaches at the end of a long run looks fine in the
+    run-wide histogram; the burn rate says how much of the RUN was
+    spent out of budget. Advisory, never gating — perf_track ingests
+    it as a secondary (lower is better)."""
+    if snap is None:
+        from .registry import get_registry
+
+        snap = get_registry().snapshot()
+    counters = snap.get("counters", {})
+    windows = counters.get("slo.windows", 0)
+    if not windows:
+        return None
+    breached = counters.get("slo.windows_breached", 0)
+    return {
+        "windows": int(windows),
+        "breached": int(breached),
+        "burn_rate": round(breached / windows, 6),
+    }

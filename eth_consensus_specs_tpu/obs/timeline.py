@@ -1,0 +1,807 @@
+"""Fleet timeline: cross-process trace assembly and slot autopsy.
+
+Every process in a serving fleet writes its OWN JSONL event stream
+(obs/registry.py re-points each spawned replica at a sibling file —
+``<base>.<replica-name>.jsonl`` — because two processes appending to one
+file interleave lines unpredictably). Each event carries paired clock
+stamps (``t_mono``/``t_wall``) plus ``pid``/``tid`` identity, and the
+front door emits ``clock.sync`` events with NTP-style paired monotonic
+readings from its health round trips. This module is the other half of
+that contract: it merges the sibling streams back into ONE
+Perfetto-compatible trace in which cross-process spans nest truthfully.
+
+Clock correction
+----------------
+``perf_counter`` epochs are per-process: a replica's monotonic reading
+is meaningless next to the front door's. Two estimators, best first:
+
+  * **sync pairs** — a ``clock.sync`` event says the replica read
+    ``remote_mono`` somewhere between the parent's ``t_send`` and
+    ``t_recv``, so ``offset = remote_mono - (t_send + t_recv)/2`` with
+    uncertainty bounded by RTT/2. The sample with the smallest RTT wins
+    (the front door already emits only new-minimum samples);
+    ``src="ready"`` boot-frame pairs claim RTT 0 they didn't measure,
+    so they are used only when no probe/close sample exists for a pid.
+  * **wall anchors** — every event carries the wall/monotonic PAIR, so
+    ``median(t_wall - t_mono)`` per pid anchors its monotonic epoch to
+    the (shared) wall clock. Millisecond-grade at best (NTP steps,
+    scheduler delay between the two reads), used only for pids with no
+    sync sample at all — a truncated stream still lands on the
+    timeline, just with a wider error bar.
+
+Episode disambiguation
+----------------------
+A JSONL file appended across runs (or a bench replaying the same slot
+numbers twice) repeats identifiers whose monotonic stamps are NOT
+comparable — a new process boot is a new ``perf_counter`` epoch.
+Wall-clock gaps wider than ``ETH_SPECS_OBS_TRACE_GAP_S`` (default 120s)
+split such a sequence into episodes; the autopsy analyzes the latest
+one unless told otherwise.
+
+The autopsy itself (``autopsy`` / ``render_autopsy`` /
+``diff_reports``) reconstructs one slot's end-to-end critical path from
+the front door's terminal ``frontdoor.request_done`` events (every
+attempt, with its shipped per-stage durations), classifies the time
+BETWEEN attempts (``recovery`` when a replica death→ready interval
+overlaps it, ``retry_backoff`` otherwise), and renders a one-screen
+verdict against the slot budget. ``diff_reports`` compares two bench
+reports' stage histograms and names the stages a p99 regression hides
+in. See docs/observability.md#fleet-timeline--slot-autopsy.
+"""
+
+from __future__ import annotations
+
+import glob as _glob
+import hashlib
+import json
+import os
+import statistics
+
+# ------------------------------------------------------------- loading --
+
+
+def trace_gap_s() -> float:
+    """Episode split threshold: wall-clock silence longer than this
+    separates re-used identifiers into distinct episodes."""
+    raw = os.environ.get("ETH_SPECS_OBS_TRACE_GAP_S")
+    try:
+        return float(raw) if raw else 120.0
+    except ValueError:
+        return 120.0
+
+
+def slot_budget_ms() -> float:
+    """The per-slot latency target the autopsy verdict is rendered
+    against (the paper's 1s slot budget by default)."""
+    raw = os.environ.get("ETH_SPECS_SLOT_BUDGET_MS")
+    try:
+        return float(raw) if raw else 1000.0
+    except ValueError:
+        return 1000.0
+
+
+def load_stream(path: str) -> list[dict]:
+    """One JSONL stream, maximally tolerant: a missing file is an empty
+    stream and a torn/garbage line (the writer was SIGKILLed mid-write)
+    is skipped — a partial trace beats a crashed assembler."""
+    events: list[dict] = []
+    try:
+        fh = open(path, encoding="utf-8", errors="replace")
+    except OSError:
+        return events
+    with fh:
+        for line in fh:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                ev = json.loads(line)
+            except ValueError:
+                continue
+            if isinstance(ev, dict) and "t_mono" in ev and "pid" in ev:
+                events.append(ev)
+    return events
+
+
+def fleet_paths(path: str) -> list[str]:
+    """The parent stream plus every replica sibling
+    (``<base>.<name>.jsonl`` — the naming replica_main uses)."""
+    base, ext = os.path.splitext(path)
+    siblings = sorted(_glob.glob(f"{base}.*{ext or '.jsonl'}"))
+    return [path] + [s for s in siblings if s != path]
+
+
+def load_fleet(path: str) -> list[dict]:
+    """Every event from the parent stream and its replica siblings,
+    sorted by wall clock (the only domain shared before correction)."""
+    events: list[dict] = []
+    for p in fleet_paths(path):
+        events.extend(load_stream(p))
+    events.sort(key=lambda e: e.get("t_wall", 0.0))
+    return events
+
+
+# --------------------------------------------------------- clock model --
+
+
+class ClockModel:
+    """Per-pid mapping from that pid's ``perf_counter`` domain into the
+    REFERENCE pid's domain (the front door / bench parent — the pid
+    that emitted the ``clock.sync`` events)."""
+
+    def __init__(self, events: list[dict]):
+        syncs = [e for e in events if e.get("kind") == "clock.sync"]
+        emitters: dict[int, int] = {}
+        for s in syncs:
+            emitters[s["pid"]] = emitters.get(s["pid"], 0) + 1
+        if emitters:
+            self.ref_pid = max(emitters, key=lambda p: emitters[p])
+        elif events:
+            self.ref_pid = events[0]["pid"]
+        else:
+            self.ref_pid = 0
+        # best sync sample per remote pid: minimum measured RTT among
+        # probe/close pairs; a zero-width src="ready" boot pair only
+        # when nothing better exists (its RTT bound is unmeasured)
+        best: dict[int, tuple[float, float]] = {}  # peer -> (rtt, offset)
+        ready: dict[int, float] = {}
+        for s in syncs:
+            if s["pid"] != self.ref_pid or s.get("peer") is None:
+                continue
+            peer = s["peer"]
+            offset = s["remote_mono"] - (s["t_send"] + s["t_recv"]) / 2.0
+            if s.get("src") == "ready":
+                ready.setdefault(peer, offset)
+                continue
+            rtt = s["t_recv"] - s["t_send"]
+            if peer not in best or rtt < best[peer][0]:
+                best[peer] = (rtt, offset)
+        self._offset = {p: off for p, (_rtt, off) in best.items()}
+        for p, off in ready.items():
+            self._offset.setdefault(p, off)
+        self.synced_pids = set(self._offset)
+        # wall anchors (median t_wall - t_mono per pid): the fallback
+        # for pids with no sync sample, and the ref's own anchor that
+        # fallback is expressed against
+        per_pid: dict[int, list[float]] = {}
+        for e in events:
+            if "t_wall" in e:
+                per_pid.setdefault(e["pid"], []).append(e["t_wall"] - e["t_mono"])
+        self._anchor = {p: statistics.median(v) for p, v in per_pid.items()}
+        # replica labels: clock.sync carries the replica INDEX for its
+        # peer pid — the assembler names process tracks with it
+        self.replica_of: dict[int, int] = {}
+        for s in syncs:
+            if s.get("peer") is not None and s.get("replica") is not None:
+                self.replica_of[s["peer"]] = s["replica"]
+
+    def to_ref(self, pid: int, t_mono: float) -> float:
+        """A monotonic reading from ``pid`` mapped into the reference
+        pid's monotonic domain."""
+        if pid == self.ref_pid:
+            return t_mono
+        off = self._offset.get(pid)
+        if off is not None:
+            return t_mono - off
+        a_remote = self._anchor.get(pid)
+        a_ref = self._anchor.get(self.ref_pid)
+        if a_remote is not None and a_ref is not None:
+            return t_mono + a_remote - a_ref
+        return t_mono  # nothing to go on: at least stay monotone
+
+    def label(self, pid: int) -> str:
+        if pid == self.ref_pid:
+            return "frontdoor"
+        r = self.replica_of.get(pid)
+        return f"replica {r}" if r is not None else f"pid {pid}"
+
+
+# ------------------------------------------------------------ episodes --
+
+
+def split_episodes(items: list[dict], gap_s: float | None = None) -> list[list[dict]]:
+    """Split a wall-ordered item list into episodes on silence gaps:
+    re-used identifiers (same trace id or slot number appended across
+    runs) are NOT one logical trace — their monotonic stamps come from
+    different process boots and must never be compared."""
+    gap_s = trace_gap_s() if gap_s is None else gap_s
+    items = sorted(items, key=lambda e: e.get("t_wall", 0.0))
+    out: list[list[dict]] = []
+    for ev in items:
+        if out and ev.get("t_wall", 0.0) - out[-1][-1].get("t_wall", 0.0) > gap_s:
+            out.append([ev])
+        elif out:
+            out[-1].append(ev)
+        else:
+            out = [[ev]]
+    return out
+
+
+# ------------------------------------------------------------ assembly --
+
+
+def _flow_id(wire: str, episode: int) -> str:
+    if episode == 0:
+        return wire
+    return f"{wire}#{episode}"
+
+
+def _scalar_args(ev: dict) -> dict:
+    skip = {"kind", "name", "s", "t_mono", "t_wall", "pid", "tid"}
+    return {
+        k: v for k, v in ev.items()
+        if k not in skip and isinstance(v, (int, float, str, bool))
+    }
+
+
+class Timeline:
+    """Assembled fleet timeline: clock-corrected events from every
+    stream, with Perfetto emission and slot autopsy on top."""
+
+    def __init__(self, events: list[dict]):
+        self.events = sorted(events, key=lambda e: e.get("t_wall", 0.0))
+        self.clock = ClockModel(self.events)
+        # episode index per re-used trace id: the wall domain says which
+        # boot an event belongs to; flow ids and autopsies key on it
+        self._episode: dict[int, int] = {}
+        by_trace: dict[str, list[dict]] = {}
+        for ev in self.events:
+            tid = ev.get("trace_id")
+            if tid is None and isinstance(ev.get("trace"), str):
+                tid = ev["trace"].partition("-")[0]
+            if tid:
+                by_trace.setdefault(tid, []).append(ev)
+        for tid, evs in by_trace.items():
+            for k, episode in enumerate(split_episodes(evs)):
+                for ev in episode:
+                    self._episode[id(ev)] = k
+
+    @classmethod
+    def from_path(cls, path: str) -> "Timeline":
+        return cls(load_fleet(path))
+
+    def episode_of(self, ev: dict) -> int:
+        return self._episode.get(id(ev), 0)
+
+    def start_ref(self, ev: dict) -> float:
+        """Event start in the reference monotonic domain. Span stamps
+        (and the front door's terminal request events) are taken at the
+        END; the carried duration rewinds to the start."""
+        t = self.clock.to_ref(ev["pid"], ev["t_mono"])
+        if ev.get("kind") == "span":
+            return t - float(ev.get("s", 0.0))
+        if ev.get("kind") == "frontdoor.request_done":
+            return t - float(ev.get("e2e_ms", 0.0)) / 1e3
+        return t
+
+    # ------------------------------------------------------- perfetto --
+
+    def perfetto(self) -> dict:
+        """One Chrome/Perfetto JSON object trace for the whole fleet:
+        a process track per pid (named from clock.sync replica
+        indices), X slices for spans, instants for events, async b/e
+        envelopes for front-door requests, and s/t/f flow chains
+        stitching request → replica receipt → flush → device dispatch."""
+        if not self.events:
+            return {"traceEvents": [], "displayTimeUnit": "ms"}
+        t0 = min(self.start_ref(ev) for ev in self.events)
+
+        def us(t_ref: float) -> float:
+            return round((t_ref - t0) * 1e6, 3)
+
+        out: list[dict] = []
+        for pid in sorted({ev["pid"] for ev in self.events}):
+            out.append({
+                "ph": "M", "name": "process_name", "pid": pid, "tid": 0,
+                "args": {"name": self.clock.label(pid)},
+            })
+        # span slices are collected per thread track first: starts are
+        # reconstructed as stamp - duration, and emit-path jitter can
+        # land a parent's start microseconds AFTER its child's. The
+        # emission order + depth the registry records give the truthful
+        # structure — a depth-d span emitted after deeper spans is their
+        # parent — so parents are clamped to cover their children
+        # before anything is emitted.
+        track_slices: dict[tuple, list[tuple[dict, int]]] = {}
+        # flow anchors: wire id -> [(t_ref, pid, tid)] in time order
+        anchors: dict[str, list[tuple[float, int, int]]] = {}
+
+        def anchor(wire: str, ev: dict) -> None:
+            key = _flow_id(wire, self.episode_of(ev))
+            anchors.setdefault(key, []).append(
+                (self.start_ref(ev), ev["pid"], ev["tid"])
+            )
+
+        for ev in self.events:
+            ts = us(self.start_ref(ev))
+            if ev.get("kind") == "span":
+                sl = {
+                    "ph": "X", "name": ev.get("name", "span"), "cat": "span",
+                    "pid": ev["pid"], "tid": ev["tid"],
+                    "ts": ts, "dur": round(float(ev.get("s", 0.0)) * 1e6, 3),
+                    "args": _scalar_args(ev),
+                }
+                track_slices.setdefault((ev["pid"], ev["tid"]), []).append(
+                    (sl, int(ev.get("depth", 0)))
+                )
+                wire_self = (
+                    f"{ev['trace_id']}-{ev.get('parent_span')}"
+                    if ev.get("trace_id") and ev.get("parent_span") else None
+                )
+                if wire_self:
+                    # a span whose parent came over the wire IS the
+                    # receiving end of that wire id (from_wire restored
+                    # the sender's context as this span's parent)
+                    anchor(wire_self, ev)
+                for w in str(ev.get("flows", "")).split(","):
+                    if w:
+                        anchor(w, ev)
+            elif ev.get("kind") == "frontdoor.request_done":
+                # synthesized request envelope: async begin/end so
+                # overlapping in-flight requests never fight for slice
+                # nesting on one thread track
+                begin_ref = self.start_ref(ev)
+                end_ref = self.clock.to_ref(ev["pid"], ev["t_mono"])
+                wire = ev.get("trace") or ""
+                fid = _flow_id(wire, self.episode_of(ev)) or f"req@{ts}"
+                name = f"req.{ev.get('req_kind', '?')}"
+                args = _scalar_args(ev)
+                if isinstance(ev.get("stages"), dict):
+                    args["stages"] = json.dumps(ev["stages"], sort_keys=True)
+                out.append({
+                    "ph": "b", "cat": "request", "id": fid, "name": name,
+                    "pid": ev["pid"], "tid": ev["tid"],
+                    "ts": us(begin_ref), "args": args,
+                })
+                out.append({
+                    "ph": "e", "cat": "request", "id": fid, "name": name,
+                    "pid": ev["pid"], "tid": ev["tid"], "ts": us(end_ref),
+                })
+                if wire:
+                    anchors.setdefault(fid, []).append(
+                        (begin_ref, ev["pid"], ev["tid"])
+                    )
+            else:
+                inst = {
+                    "ph": "i", "name": ev.get("kind", "event"), "cat": "event",
+                    "pid": ev["pid"], "tid": ev["tid"], "ts": ts, "s": "t",
+                    "args": _scalar_args(ev),
+                }
+                out.append(inst)
+                for w in ev.get("flows") or []:
+                    if isinstance(w, str) and w:
+                        anchor(w, ev)
+        # truthful-nesting clamp: walk each track in emission order
+        # (children complete and emit BEFORE their parents); a span at
+        # depth d adopts the trailing deeper spans as children and is
+        # widened to cover them exactly
+        for slices in track_slices.values():
+            pending: list[tuple[dict, int]] = []
+            for sl, depth in slices:
+                while pending and pending[-1][1] > depth:
+                    child, _d = pending.pop()
+                    end = max(sl["ts"] + sl["dur"], child["ts"] + child["dur"])
+                    sl["ts"] = min(sl["ts"], child["ts"])
+                    sl["dur"] = round(end - sl["ts"], 3)
+                pending.append((sl, depth))
+            out.extend(sl for sl, _d in slices)
+        # flow chains: first anchor starts (s), middles step (t), last
+        # finishes (f) — binding-point "e" attaches to the enclosing
+        # slice rather than the next one
+        for fid, pts in anchors.items():
+            pts.sort(key=lambda p: p[0])
+            if len(pts) < 2:
+                continue
+            for k, (t_ref, pid, tid) in enumerate(pts):
+                ph = "s" if k == 0 else ("f" if k == len(pts) - 1 else "t")
+                ev = {
+                    "ph": ph, "id": fid, "name": "req-flow", "cat": "flow",
+                    "pid": pid, "tid": tid, "ts": us(t_ref),
+                }
+                if ph == "f":
+                    ev["bp"] = "e"
+                out.append(ev)
+        out.sort(key=lambda e: (e.get("ts", -1), e.get("ph") != "M"))
+        return {"traceEvents": out, "displayTimeUnit": "ms"}
+
+    # -------------------------------------------------------- autopsy --
+
+    def slot_attempts(self, slot: int) -> list[dict]:
+        """Every front-door terminal event for one slot number, latest
+        episode only (a slot number replayed across runs is split on
+        wall gaps like any other re-used identifier)."""
+        evs = [
+            e for e in self.events
+            if e.get("kind") == "frontdoor.request_done" and e.get("slot") == slot
+        ]
+        episodes = split_episodes(evs)
+        return episodes[-1] if episodes else []
+
+    def trace_attempts(self, trace_id: str) -> list[dict]:
+        evs = [
+            e for e in self.events
+            if e.get("kind") == "frontdoor.request_done"
+            and str(e.get("trace", "")).startswith(trace_id)
+        ]
+        episodes = split_episodes(evs)
+        return episodes[-1] if episodes else []
+
+    def slots(self) -> list[int]:
+        return sorted({
+            e["slot"] for e in self.events
+            if e.get("kind") == "frontdoor.request_done" and e.get("slot") is not None
+        })
+
+    def _recovery_windows(self) -> list[tuple[float, float]]:
+        """Replica outage intervals in the reference domain: death
+        (replica_lost) → replacement ready (replica_recovered, which
+        carries the measured recovery_ms so a lost 'lost' event still
+        yields the interval)."""
+        lost: dict[int, float] = {}
+        windows: list[tuple[float, float]] = []
+        for ev in self.events:
+            if ev.get("kind") == "frontdoor.replica_lost":
+                lost[ev.get("replica", -1)] = self.clock.to_ref(ev["pid"], ev["t_mono"])
+            elif ev.get("kind") == "frontdoor.replica_recovered":
+                end = self.clock.to_ref(ev["pid"], ev["t_mono"])
+                start = lost.pop(
+                    ev.get("replica", -1),
+                    end - float(ev.get("recovery_ms", 0.0)) / 1e3,
+                )
+                windows.append((start, end))
+        return windows
+
+    def autopsy(
+        self,
+        slot: int | None = None,
+        trace_id: str | None = None,
+        budget_ms: float | None = None,
+    ) -> dict | None:
+        """One slot's (or trace's) end-to-end critical path. Attempts
+        are ordered by completion; the window runs first-attempt start →
+        final-attempt end. The FINAL attempt contributes its shipped
+        per-stage durations (plus the wire residual); earlier failed
+        attempts contribute ``retry_shed``; the gaps between attempts
+        are ``recovery`` where a replica outage interval overlaps and
+        ``retry_backoff`` otherwise; ``checkpoint`` is carved out of
+        its containing stage from the owner's resident.checkpoint
+        spans. Returns None when nothing matches."""
+        if slot is None and trace_id is None:
+            slots = self.slots()
+            if not slots:
+                return None
+            # default: the worst-case slot — the one the budget verdict
+            # is most interesting for
+            slot = max(
+                slots,
+                key=lambda s: max(
+                    (float(a.get("e2e_ms", 0.0)) for a in self.slot_attempts(s)),
+                    default=0.0,
+                ),
+            )
+        attempts = (
+            self.slot_attempts(slot) if slot is not None
+            else self.trace_attempts(trace_id)
+        )
+        if not attempts:
+            return None
+        budget = slot_budget_ms() if budget_ms is None else budget_ms
+
+        def bounds(ev: dict) -> tuple[float, float]:
+            end = self.clock.to_ref(ev["pid"], ev["t_mono"])
+            return end - float(ev.get("e2e_ms", 0.0)) / 1e3, end
+
+        attempts = sorted(attempts, key=lambda e: bounds(e)[1])
+        w_start, w_end = bounds(attempts[0])[0], bounds(attempts[-1])[1]
+        total_ms = (w_end - w_start) * 1e3
+        final = next(
+            (a for a in reversed(attempts) if a.get("ok")), attempts[-1]
+        )
+        f_start, f_end = bounds(final)
+        stages: dict[str, float] = {}
+        shipped = final.get("stages") or {}
+        for k, v in shipped.items():
+            if k != "total" and isinstance(v, (int, float)):
+                stages[k] = stages.get(k, 0.0) + float(v)
+        wire = float(final.get("e2e_ms", 0.0)) - float(shipped.get("total", 0.0))
+        if shipped:
+            stages["wire"] = max(wire, 0.0)
+        else:
+            # no shipped breakdown (degraded-to-host, shed): the whole
+            # attempt is wire+host from out here
+            stages["wire"] = float(final.get("e2e_ms", 0.0))
+        recov = self._recovery_windows()
+
+        def overlap(a0: float, a1: float) -> float:
+            return sum(max(0.0, min(a1, r1) - max(a0, r0)) for r0, r1 in recov)
+
+        prev_end = w_start
+        for a in attempts:
+            a0, a1 = bounds(a)
+            if a1 <= f_end and a is not final:
+                # a failed attempt's own wall: a typed shed resolves
+                # fast, and what it spent is the retry tax
+                stages["retry_shed"] = stages.get("retry_shed", 0.0) \
+                    + float(a.get("e2e_ms", 0.0))
+            if a0 > prev_end:
+                rec = overlap(prev_end, a0) * 1e3
+                gap = (a0 - prev_end) * 1e3
+                if rec > 0.0:
+                    stages["recovery"] = stages.get("recovery", 0.0) + rec
+                if gap - rec > 0.0:
+                    stages["retry_backoff"] = stages.get("retry_backoff", 0.0) \
+                        + (gap - rec)
+            prev_end = max(prev_end, a1)
+        # checkpoint: carved out of whichever shipped stage contains it
+        # (the owner checkpoints inside the slot pipeline), so the sum
+        # stays exact while the durable-write cost gets its own line
+        ckpt_ms = sum(
+            float(ev.get("s", 0.0)) * 1e3
+            for ev in self.events
+            if ev.get("kind") == "span" and ev.get("name") == "resident.checkpoint"
+            and f_start <= self.start_ref(ev) <= f_end
+        )
+        if ckpt_ms > 0.0:
+            host = max(
+                (k for k in stages if k not in ("wire", "checkpoint")),
+                key=lambda k: stages[k], default=None,
+            )
+            if host is not None and stages[host] >= ckpt_ms:
+                stages[host] -= ckpt_ms
+                stages["checkpoint"] = stages.get("checkpoint", 0.0) + ckpt_ms
+        named_ms = sum(stages.values())
+        coverage = min(named_ms / total_ms, 1.0) if total_ms > 0 else 1.0
+        # per-replica device attribution inside the window (diff mode
+        # names the replica that moved, not just the stage)
+        replica_device: dict[str, float] = {}
+        for ev in self.events:
+            if ev.get("kind") != "span" or ev.get("name") != "serve.dispatch":
+                continue
+            t = self.start_ref(ev)
+            if w_start <= t <= w_end:
+                lbl = self.clock.label(ev["pid"])
+                replica_device[lbl] = replica_device.get(lbl, 0.0) \
+                    + float(ev.get("s", 0.0)) * 1e3
+        ranked = sorted(stages.items(), key=lambda kv: kv[1], reverse=True)
+        return {
+            "slot": slot,
+            "trace": final.get("trace"),
+            "ok": bool(final.get("ok")),
+            "attempts": [
+                {
+                    "trace": a.get("trace"), "ok": bool(a.get("ok")),
+                    "e2e_ms": round(float(a.get("e2e_ms", 0.0)), 3),
+                    "err": a.get("err"), "hedged": bool(a.get("hedged")),
+                    "start_ms": round((bounds(a)[0] - w_start) * 1e3, 3),
+                }
+                for a in attempts
+            ],
+            "e2e_ms": round(total_ms, 3),
+            "stages_ms": {k: round(v, 3) for k, v in ranked},
+            "coverage": round(coverage, 4),
+            "budget_ms": budget,
+            "over_ms": round(max(total_ms - budget, 0.0), 3),
+            "verdict": "within budget" if total_ms <= budget else "OVER BUDGET",
+            "critical_path": [
+                {
+                    "stage": k,
+                    "ms": round(v, 3),
+                    "share": round(v / named_ms, 4) if named_ms > 0 else 0.0,
+                }
+                for k, v in ranked if v > 0.0
+            ],
+            "replica_device_ms": {
+                k: round(v, 3) for k, v in sorted(replica_device.items())
+            },
+        }
+
+
+# ---------------------------------------------------------- validation --
+
+
+def validate(trace: dict, slack_us: float = 50.0) -> list[str]:
+    """Structural Perfetto-loadability check: required fields per
+    phase, non-negative durations, truthful X-slice nesting per
+    (pid, tid) track (with `slack_us` of tolerance for emit-path
+    jitter in reconstructed starts), matched async b/e pairs, and
+    every flow finish preceded by its start. Returns problems
+    (empty = clean)."""
+    problems: list[str] = []
+    evs = trace.get("traceEvents")
+    if not isinstance(evs, list):
+        return ["traceEvents is not a list"]
+    by_track: dict[tuple, list[dict]] = {}
+    async_open: dict[tuple, int] = {}
+    flow_started: set = set()
+    for k, ev in enumerate(evs):
+        ph = ev.get("ph")
+        if ph is None or "pid" not in ev:
+            problems.append(f"event {k}: missing ph/pid")
+            continue
+        if ph == "M":
+            continue
+        if "ts" not in ev:
+            problems.append(f"event {k}: missing ts")
+            continue
+        if ph == "X":
+            if ev.get("dur", -1) < 0:
+                problems.append(f"event {k} ({ev.get('name')}): negative dur")
+            by_track.setdefault((ev["pid"], ev.get("tid")), []).append(ev)
+        elif ph == "b":
+            async_open[(ev.get("cat"), ev.get("id"))] = \
+                async_open.get((ev.get("cat"), ev.get("id")), 0) + 1
+        elif ph == "e":
+            key = (ev.get("cat"), ev.get("id"))
+            if async_open.get(key, 0) <= 0:
+                problems.append(f"event {k}: async end without begin ({key})")
+            else:
+                async_open[key] -= 1
+        elif ph == "s":
+            flow_started.add(ev.get("id"))
+        elif ph in ("t", "f"):
+            if ev.get("id") not in flow_started:
+                problems.append(f"event {k}: flow {ph} before s ({ev.get('id')})")
+    for key, n in async_open.items():
+        if n:
+            problems.append(f"async begin without end ({key})")
+    for (pid, tid), slices in by_track.items():
+        slices.sort(key=lambda e: (e["ts"], -e.get("dur", 0)))
+        stack: list[float] = []
+        for ev in slices:
+            end = ev["ts"] + ev.get("dur", 0)
+            while stack and stack[-1] <= ev["ts"] + slack_us:
+                stack.pop()
+            if stack and end > stack[-1] + slack_us:
+                problems.append(
+                    f"pid {pid} tid {tid}: slice {ev.get('name')} "
+                    f"overlaps its parent without nesting"
+                )
+            stack.append(end)
+    return problems
+
+
+# --------------------------------------------------------------- diff --
+
+
+def diff_reports(
+    a: dict, b: dict, threshold: float = 0.2, min_ms: float = 0.5,
+) -> dict:
+    """Attribute a p99 move between two bench reports to the stages
+    (and replicas) that moved. Reads each report's ``stage_hist``
+    section (serve.stage_ms.* histogram snapshots finish_report
+    stores); a stage regresses when its p99 grew by more than
+    ``threshold`` relative AND ``min_ms`` absolute."""
+    from .histogram import Histogram
+
+    def p99s(rep: dict) -> dict[str, float]:
+        out = {}
+        for name, snap in (rep.get("stage_hist") or {}).items():
+            if snap and snap.get("count"):
+                stage = name.rpartition(".")[2]
+                out[stage] = Histogram.from_snapshot(snap).quantile(0.99)
+        return out
+    pa, pb = p99s(a), p99s(b)
+    regressed, improved = [], []
+    for stage in sorted(set(pa) | set(pb)):
+        va, vb = pa.get(stage), pb.get(stage)
+        if va is None or vb is None:
+            continue
+        delta = vb - va
+        row = {
+            "stage": stage,
+            "p99_a_ms": round(va, 3),
+            "p99_b_ms": round(vb, 3),
+            "delta_ms": round(delta, 3),
+            "ratio": round(vb / va, 3) if va > 0 else float("inf"),
+        }
+        if delta > min_ms and vb > va * (1.0 + threshold):
+            regressed.append(row)
+        elif -delta > min_ms and va > vb * (1.0 + threshold):
+            improved.append(row)
+    # the 'total' roll-up always moves when any component does: keep it
+    # in the listing for scale, but never let it claim the attribution
+    regressed.sort(
+        key=lambda r: (r["stage"] == "total", -r["delta_ms"]))
+    improved.sort(key=lambda r: (r["stage"] == "total", r["delta_ms"]))
+    replicas = []
+    ra = (a.get("autopsy") or {}).get("replica_device_ms") or {}
+    rb = (b.get("autopsy") or {}).get("replica_device_ms") or {}
+    for name in sorted(set(ra) & set(rb)):
+        d = rb[name] - ra[name]
+        if abs(d) > min_ms:
+            replicas.append({
+                "replica": name, "a_ms": round(ra[name], 3),
+                "b_ms": round(rb[name], 3), "delta_ms": round(d, 3),
+            })
+    replicas.sort(key=lambda r: r["delta_ms"], reverse=True)
+    if regressed:
+        top = regressed[0]
+        verdict = (
+            f"p99 regression attributed to stage '{top['stage']}' "
+            f"(+{top['delta_ms']}ms, x{top['ratio']})"
+        )
+    elif improved:
+        verdict = f"no regression; stage '{improved[0]['stage']}' improved"
+    else:
+        verdict = "no stage moved beyond threshold"
+    return {
+        "regressed": regressed,
+        "improved": improved,
+        "replicas_moved": replicas,
+        "verdict": verdict,
+    }
+
+
+# ----------------------------------------------------------- rendering --
+
+
+def render_autopsy(rep: dict) -> str:
+    """The one-screen budget verdict for a slot autopsy."""
+    lines = [
+        f"slot {rep.get('slot')}  trace {rep.get('trace')}  "
+        f"{'ok' if rep.get('ok') else 'FAILED'}",
+        f"e2e {rep['e2e_ms']:.1f}ms vs budget {rep['budget_ms']:.0f}ms "
+        f"-> {rep['verdict']}"
+        + (f" (+{rep['over_ms']:.1f}ms)" if rep.get("over_ms") else ""),
+        f"attempts {len(rep['attempts'])}  "
+        f"coverage {rep['coverage'] * 100:.1f}% of wall in named stages",
+        "critical path:",
+    ]
+    for row in rep["critical_path"]:
+        bar = "#" * max(int(row["share"] * 40), 1)
+        lines.append(
+            f"  {row['stage']:>14} {row['ms']:>10.2f}ms "
+            f"{row['share'] * 100:>5.1f}% {bar}"
+        )
+    for k, a in enumerate(rep["attempts"]):
+        status = "ok" if a["ok"] else f"failed ({a.get('err') or '?'})"
+        lines.append(
+            f"  attempt {k}: +{a['start_ms']:.1f}ms "
+            f"e2e {a['e2e_ms']:.1f}ms {status}"
+            + (" hedged" if a.get("hedged") else "")
+        )
+    if rep.get("replica_device_ms"):
+        lines.append("device time by replica: " + ", ".join(
+            f"{k}={v:.1f}ms" for k, v in rep["replica_device_ms"].items()
+        ))
+    return "\n".join(lines)
+
+
+def render_diff(d: dict) -> str:
+    lines = [d["verdict"]]
+    for row in d["regressed"]:
+        lines.append(
+            f"  REGRESSED {row['stage']:>14} {row['p99_a_ms']:.2f}ms -> "
+            f"{row['p99_b_ms']:.2f}ms (+{row['delta_ms']:.2f}ms, x{row['ratio']})"
+        )
+    for row in d["improved"]:
+        lines.append(
+            f"  improved  {row['stage']:>14} {row['p99_a_ms']:.2f}ms -> "
+            f"{row['p99_b_ms']:.2f}ms ({row['delta_ms']:.2f}ms)"
+        )
+    for row in d["replicas_moved"]:
+        lines.append(
+            f"  replica   {row['replica']:>14} {row['a_ms']:.1f}ms -> "
+            f"{row['b_ms']:.1f}ms ({row['delta_ms']:+.1f}ms device)"
+        )
+    return "\n".join(lines)
+
+
+def assemble_to_file(jsonl_path: str, out_path: str) -> dict | None:
+    """Assemble the fleet streams rooted at ``jsonl_path`` and write
+    the Perfetto trace to ``out_path``; returns a small summary (or
+    None when there were no events). Never raises on missing/truncated
+    streams — benches call this in epilogues that must not fail."""
+    tl = Timeline.from_path(jsonl_path)
+    if not tl.events:
+        return None
+    trace = tl.perfetto()
+    with open(out_path, "w", encoding="utf-8") as fh:
+        json.dump(trace, fh)
+    return {
+        "path": out_path,
+        "events": len(trace["traceEvents"]),
+        "processes": len({e["pid"] for e in tl.events}),
+        "synced_pids": len(tl.clock.synced_pids),
+        "streams": [p for p in fleet_paths(jsonl_path) if os.path.exists(p)],
+    }

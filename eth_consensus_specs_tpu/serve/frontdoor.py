@@ -63,7 +63,7 @@ import numpy as np
 
 from eth_consensus_specs_tpu import fault, obs
 from eth_consensus_specs_tpu.analysis import lockwatch
-from eth_consensus_specs_tpu.obs import flight, slo, trace
+from eth_consensus_specs_tpu.obs import export, flight, slo, trace
 from eth_consensus_specs_tpu.obs.delta import DeltaShipper, merge_delta
 
 from . import buckets, wire
@@ -528,6 +528,25 @@ class FrontDoorClient:
             )
         if is_hedge:
             obs.count("frontdoor.hedge_wins", 1)
+        # one terminal event per request, stamped in THIS process's clock
+        # domain: the timeline assembler (obs/timeline.py) synthesizes
+        # the end-to-end envelope slice from it, and the slot autopsy
+        # groups retry attempts of one slot by the `slot` field
+        done = {
+            "req_kind": req.kind,
+            "trace": trace.to_wire(req.trace),
+            "e2e_ms": round(e2e_s * 1e3, 3),
+            "ok": exc is None,
+            "hedged": req.hedged,
+        }
+        if exc is not None:
+            done["err"] = type(exc).__name__
+        if stages:
+            done["stages"] = dict(stages)
+        slot_no = getattr(req.payload, "slot", None)
+        if req.kind == "slot" and slot_no is not None:
+            done["slot"] = int(slot_no)
+        obs.event("frontdoor.request_done", **done)
         try:
             if exc is not None:
                 req.future.set_exception(exc)
@@ -689,6 +708,10 @@ class FrontDoor(FrontDoorClient):
         # death→ready of the REPLACEMENT, measured here because the dead
         # process obviously can't report its own outage
         self._death_t = [0.0] * n
+        # per-generation minimum probe RTT: a clock.sync event is emitted
+        # only when a probe sets a new minimum (tightest offset bound),
+        # so the flight ring never fills with routine sync chatter
+        self._clock_rtt = [float("inf")] * n
         ports = [0] * n
         # replica 0 boots alone first: it writes the shippable warmup
         # artifact (explicit warm keys + its own first dispatches); the
@@ -720,11 +743,21 @@ class FrontDoor(FrontDoorClient):
         self._stop = threading.Event()
         self._base_max_queue = self.admission.max_queue
         self._slo_shipper = DeltaShipper()
+        # the burn-rate advisory owns its OWN delta cursor: tests drive
+        # _slo_step by hand with supervision shedding disabled, and the
+        # advisory consuming their window would break them
+        self._burn_shipper = DeltaShipper()
         self._slo_breached_once = False
         self._breach_streak = 0
         self._idle_streak = 0
         self._scaling = False
         self._last_scale_t = 0.0
+        # fleet-merged /metrics: the supervisor's registry holds every
+        # replica's probe deltas, so the fleet owner is where the
+        # env-gated HTTP exporter serves the MERGED snapshot (a replica
+        # child never starts one — ETH_SPECS_OBS_HTTP_PORT is popped
+        # from its env by replica_main's child setup)
+        export.maybe_serve_http()
         self._supervisor = threading.Thread(
             target=self._supervise, daemon=True, name=f"{name}-supervisor"
         )
@@ -809,6 +842,18 @@ class FrontDoor(FrontDoorClient):
         finally:
             parent_conn.close()
         _, pid, port, warmed, profile = msg
+        if isinstance(profile, dict) and profile.get("t_mono") is not None:
+            # boot-frame clock pair. Zero-width by construction (the
+            # pipe transit is unmeasured), so its claimed RTT bound is a
+            # lie — the assembler must prefer probe/close syncs and fall
+            # back to this only for a replica that died before its first
+            # health probe. src="ready" marks it.
+            t_ready = time.perf_counter()
+            obs.event(
+                "clock.sync", replica=i, peer=pid,
+                t_send=t_ready, t_recv=t_ready,
+                remote_mono=profile["t_mono"], src="ready",
+            )
         obs.event(
             "frontdoor.replica_spawned",
             replica=i, pid=pid, port=port, warmed=warmed,
@@ -832,6 +877,50 @@ class FrontDoor(FrontDoorClient):
                     self._probe(i)
             if self.fdcfg.slo_shedding or self.fdcfg.autoscale:
                 self._slo_step()
+            self._burn_step()
+
+    def _note_clock_sync(
+        self, i: int, resp: dict, t_send: float, t_recv: float,
+        src: str, force: bool = False,
+    ) -> None:
+        """NTP-style paired reading from one health round trip: the
+        replica read ``t_mono`` on its own monotonic clock somewhere
+        between our ``t_send`` and ``t_recv``, so its offset from OUR
+        clock is ``t_mono - (t_send + t_recv)/2`` with uncertainty
+        bounded by RTT/2. Emitted only when this probe sets a new
+        per-generation minimum RTT (the tightest bound so far) or when
+        forced (the close()-time final probe — every replica gets at
+        least one sample even in runs shorter than a probe interval)."""
+        remote = resp.get("t_mono")
+        if remote is None:
+            return
+        rtt = t_recv - t_send
+        if not force and rtt >= self._clock_rtt[i]:
+            return
+        self._clock_rtt[i] = min(self._clock_rtt[i], rtt)
+        obs.event(
+            "clock.sync", replica=i, peer=resp.get("pid"),
+            t_send=t_send, t_recv=t_recv, remote_mono=remote, src=src,
+        )
+
+    def _burn_step(self) -> None:
+        """Windowed SLO burn bookkeeping (obs/slo.py burn_rate): count
+        probe windows that carried wait samples, and those whose
+        window-local wait p99 breached the objective. Advisory only —
+        never sheds, never gates — and runs on every supervision tick
+        regardless of the shedding/autoscale config."""
+        d = self._burn_shipper.delta()
+        hsnap = d["histograms"].get("serve.wait_ms")
+        if not hsnap or not hsnap.get("count"):
+            return  # idle window: no traffic, no burn verdict
+        window = {"counters": d["counters"], "histograms": d["histograms"]}
+        results = slo.evaluate(
+            window,
+            [s for s in slo.default_slos() if s.name == "serve_wait_p99"],
+        )
+        obs.count("slo.windows", 1)
+        if not slo.passed(results):
+            obs.count("slo.windows_breached", 1)
 
     def _probe(self, i: int) -> None:
         t0 = time.perf_counter()
@@ -847,9 +936,11 @@ class FrontDoor(FrontDoorClient):
             self.router.note_failure(i)
             obs.count("frontdoor.probe_failures", 1)
             return
+        t3 = time.perf_counter()
         if not resp.get("ok"):
             return
-        self.router.note_ok(i, time.perf_counter() - t0)
+        self.router.note_ok(i, t3 - t0)
+        self._note_clock_sync(i, resp, t0, t3, src="probe")
         # the merged cross-process view: replica counters, gauges, wait
         # histograms fold into THIS registry; the ring copy is the black
         # box we dump if the replica dies before its next probe
@@ -892,6 +983,9 @@ class FrontDoor(FrontDoorClient):
             # numbers as the replacement's
             self._health[i] = None
             self._respawn_failures[i] = 0
+            # the replacement is a NEW process with a new monotonic
+            # epoch: its first probe must re-establish the clock offset
+            self._clock_rtt[i] = float("inf")
         elif time.monotonic() < self._respawn_not_before[i]:
             return  # a failed respawn backs off instead of re-blocking
         # the respawn's ready-wait can take seconds (artifact replay) to
@@ -1059,6 +1153,7 @@ class FrontDoor(FrontDoorClient):
                     self._respawn_failures.append(0)
                     self._respawn_not_before.append(0.0)
                     self._death_t.append(0.0)
+                    self._clock_rtt.append(float("inf"))
                     self._addrs.append(("127.0.0.1", 0))
                     self._gens.append(0)
                     # _procs grows LAST: len(self._procs) is the bound
@@ -1212,8 +1307,15 @@ class FrontDoor(FrontDoorClient):
             try:
                 # final probe: fold the replica's last window into the
                 # merged cross-process telemetry before it exits
+                t0 = time.perf_counter()
                 resp = self._rpc_admin(i, {"op": "health"}, 5.0)
+                t3 = time.perf_counter()
                 if resp.get("ok"):
+                    # forced: even a fleet shorter-lived than one probe
+                    # interval leaves each replica one offset sample
+                    # (RTT here includes the connect — a wider bound,
+                    # still a valid pair)
+                    self._note_clock_sync(i, resp, t0, t3, src="close", force=True)
                     merge_delta(resp.get("obs_delta") or {}, self._rings[i])
                     self._health[i] = {
                         k: resp.get(k)

@@ -212,6 +212,12 @@ class ReplicaServer:
             resp = {
                 "ok": True,
                 "pid": os.getpid(),
+                # this process's monotonic clock, read while the probe
+                # is in flight: the parent stamps its own send/recv
+                # monotonics around the RPC, and the PAIR is one clock-
+                # offset sample for the fleet timeline assembler
+                # (obs/timeline.py — NTP-style midpoint estimate)
+                "t_mono": time.perf_counter(),
                 "name": self.name,
                 "draining": self._draining,
                 "queue_depth": self.service.admission.depth(),
@@ -274,6 +280,22 @@ def replica_main(
         # spawned child inherits the parent's XLA_FLAGS and its own
         # mesh_chips must override them, not defer
         os.environ.update(child_env)
+    # the fleet owner (FrontDoor) serves the MERGED /metrics snapshot;
+    # a replica inheriting the port would race it for the bind and serve
+    # a single-process view under the fleet's address
+    os.environ.pop("ETH_SPECS_OBS_HTTP_PORT", None)
+    jsonl = os.environ.get("ETH_SPECS_OBS_JSONL")
+    if jsonl:
+        # per-replica sibling stream: a spawned replica inherits the
+        # parent's JSONL path, and two processes appending to one file
+        # interleave lines unpredictably. Re-point this process at
+        # <base>.<name>.jsonl — the fleet timeline assembler
+        # (obs/timeline.py) merges the sibling streams back into one
+        # trace, with this replica on its own process track.
+        base, ext = os.path.splitext(jsonl)
+        jsonl = f"{base}.{name}{ext or '.jsonl'}"
+        os.environ["ETH_SPECS_OBS_JSONL"] = jsonl
+        obs.get_registry().configure_jsonl(jsonl)
     if fault_spec is not None:
         # each replica's chaos schedule is ITS OWN deterministic rule
         # set (per-process hit counters; latches arbitrate across the
@@ -362,6 +384,10 @@ def replica_main(
 
     mesh = mesh_ops.serve_mesh(cfg.mesh_chips or None)
     profile = {
+        # boot-frame clock sample: paired with the parent's recv stamp
+        # this is the offset estimator's low-quality fallback for a
+        # replica that dies before answering a single health probe
+        "t_mono": time.perf_counter(),
         "chips": cfg.mesh_chips or len(jax.local_devices()),
         "devices": len(jax.local_devices()),
         "shards": mesh_ops.shard_count(mesh),

@@ -597,18 +597,12 @@ class VerifyService:
     ) -> None:
         self._release_once(req, service_s)
         waterfall.mark(req.stamps, "resolved")
-        try:
-            if exc is not None:
-                req.future.set_exception(exc)
-            else:
-                req.future.set_result(value)
-        except Exception:
-            # a caller cancelled the pending future: its slot is already
-            # released above; the worker threads must outlive the rudeness
-            obs.count("serve.cancelled", 1)
         # fold the stamp vector into the per-stage histograms, and stash
         # the DURATIONS by trace id for the RPC layer — monotonic stamps
-        # don't cross a process boundary, durations do (obs/waterfall.py)
+        # don't cross a process boundary, durations do (obs/waterfall.py).
+        # The stash MUST land before the future resolves: the RPC handler
+        # blocked on fut.result() pops by trace id the instant it wakes,
+        # and a pop that beats the stash ships the reply without stages
         durations = waterfall.stage_durations_ms(req.t_submit, req.stamps)
         # the slot pipeline's three phase walls (slot.verify /
         # slot.aggregate / slot.reroot) ride the SAME stage histograms
@@ -620,6 +614,15 @@ class VerifyService:
             waterfall.observe(durations)
             if req.trace is not None:
                 waterfall.stash(req.trace.trace_id, durations)
+        try:
+            if exc is not None:
+                req.future.set_exception(exc)
+            else:
+                req.future.set_result(value)
+        except Exception:
+            # a caller cancelled the pending future: its slot is already
+            # released above; the worker threads must outlive the rudeness
+            obs.count("serve.cancelled", 1)
 
     # ------------------------------------------------------------- admin --
 

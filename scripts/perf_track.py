@@ -79,6 +79,10 @@ def _lower_is_better(metric: str) -> bool:
     # throughput improvement would read as a wall-time regression
     if metric.endswith(("_per_s", "_rps")):
         return False
+    # the SLO burn-rate fraction (obs/slo.py burn_rate) carries no unit
+    # suffix but regresses UPWARD: more windows out of budget is worse
+    if metric.endswith("_burn_rate"):
+        return True
     return metric.endswith(("_ms", "_s", "_bytes"))
 
 
@@ -179,6 +183,17 @@ def load_rounds(repo_dir: str) -> list[dict]:
         # an advisory without crying wolf on every noisy CI box. Only the
         # flat ``*_ms`` keys are metrics; the nested device/hbm dicts and
         # coverage ratios are report structure, not timeline points.
+        # SLO burn-rate advisory (obs/slo.py burn_rate, recorded by the
+        # bench epilogue): the fraction of supervision windows spent out
+        # of the wait-p99 budget. A run-wide p99 that still passes can
+        # hide a long stretch of breaching windows — the burn rate is
+        # the secondary that surfaces it. Never a primary, never gates
+        # outside --strict; the raw window counts are context, not
+        # timeline points.
+        for name, value in (parsed.get("slo") or {}).items():
+            if name == "burn_rate" and isinstance(value, (int, float)) \
+                    and not isinstance(value, bool):
+                metrics["slo_burn_rate"] = value
         for name, value in (parsed.get("waterfall") or {}).items():
             if (
                 isinstance(value, (int, float))

@@ -122,7 +122,7 @@ import numpy as np  # noqa: E402
 
 from eth_consensus_specs_tpu import obs, serve  # noqa: E402
 from eth_consensus_specs_tpu.analysis import lint, lockwatch  # noqa: E402
-from eth_consensus_specs_tpu.obs import export, slo  # noqa: E402
+from eth_consensus_specs_tpu.obs import export, slo, timeline  # noqa: E402
 from eth_consensus_specs_tpu.ops import bls_batch  # noqa: E402
 from eth_consensus_specs_tpu.ops.merkle import merkleize_subtree_device  # noqa: E402
 from eth_consensus_specs_tpu.serve import buckets as serve_buckets  # noqa: E402
@@ -276,6 +276,37 @@ def finish_report(report: dict, failures: list, out: str, trigger: str, snap: di
     except ValueError as exc:
         failures.append(f"prometheus exposition invalid: {exc}")
     report["prometheus_textfile"] = prom_path
+    # stage histogram snapshots: slot_autopsy --diff compares two runs'
+    # per-stage p99s from exactly these (full mergeable snapshots, not
+    # pre-reduced quantiles — the diff picks its own quantile)
+    stage_hist = {
+        name: h for name, h in snap.get("histograms", {}).items()
+        if name.startswith("serve.stage_ms.") and h.get("count")
+    }
+    if stage_hist:
+        report["stage_hist"] = stage_hist
+    # SLO burn-rate advisory (obs/slo.py): fraction of supervision
+    # windows spent out of the wait-p99 budget. Non-gating — perf_track
+    # ingests it as a secondary
+    burn = slo.burn_rate(snap)
+    if burn is not None:
+        report["slo"] = burn
+    # fleet timeline: when this run streamed JSONL events, assemble the
+    # parent + replica sibling streams into ONE Perfetto trace next to
+    # the report (the CI artifact; ui.perfetto.dev loads it directly)
+    jsonl = os.environ.get("ETH_SPECS_OBS_JSONL")
+    if jsonl:
+        report["events_jsonl"] = jsonl
+        try:
+            summary = timeline.assemble_to_file(
+                jsonl, os.path.splitext(out)[0] + ".trace.json"
+            )
+        except Exception as exc:  # noqa: BLE001 — the trace is an artifact,
+            # never a reason to fail an otherwise-green bench
+            summary = None
+            print(f"trace assembly failed: {exc}", file=sys.stderr)
+        if summary is not None:
+            report["trace"] = summary
     report["failures"] = failures
     with open(out, "w") as fh:
         json.dump(report, fh, indent=2, sort_keys=True)

@@ -1,0 +1,105 @@
+"""Slot autopsy CLI: why did slot N miss its budget?
+
+Thin argparse surface over :mod:`eth_consensus_specs_tpu.obs.timeline`
+(the logic lives in the package so tests exercise it directly). Three
+modes, all pure-host — no accelerator, no jax import:
+
+  * **autopsy** (default) — assemble the fleet's JSONL streams (the
+    parent file plus its ``<base>.<replica>.jsonl`` siblings), correct
+    per-process clocks from the recorded ``clock.sync`` pairs, and
+    print the critical-path budget verdict for one slot (``--slot``),
+    one trace id (``--trace``), or the worst slot in the window
+    (neither). ``--events`` names the parent stream; ``--report``
+    instead pulls the stream path (and budget context) from a
+    slot_bench/serve_bench report JSON.
+  * **--perfetto OUT** — also write the merged Perfetto trace (load it
+    at ui.perfetto.dev).
+  * **--diff A B** — compare two bench reports' stage histograms and
+    name the stages (and replicas) a p99 regression hides in.
+
+Exit status: 0 on success, 1 when nothing matched or (with
+``--min-coverage``) the attribution coverage gate failed.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from eth_consensus_specs_tpu.obs import timeline  # noqa: E402
+
+
+def _load_report(path: str) -> dict:
+    with open(path, encoding="utf-8") as fh:
+        return json.load(fh)
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--events", help="parent JSONL stream (replica siblings found next to it)")
+    ap.add_argument("--report", help="bench report JSON carrying an `events_jsonl` path")
+    ap.add_argument("--slot", type=int, help="slot number to autopsy (default: worst)")
+    ap.add_argument("--trace", help="trace id (or prefix) to autopsy instead of a slot")
+    ap.add_argument("--budget-ms", type=float, default=None,
+                    help="slot budget override (default ETH_SPECS_SLOT_BUDGET_MS)")
+    ap.add_argument("--perfetto", metavar="OUT",
+                    help="also write the merged Perfetto trace here")
+    ap.add_argument("--min-coverage", type=float, default=None,
+                    help="fail (exit 1) when named-stage coverage is below this fraction")
+    ap.add_argument("--json", action="store_true",
+                    help="print the autopsy as JSON instead of the one-screen text")
+    ap.add_argument("--diff", nargs=2, metavar=("A", "B"),
+                    help="compare two bench reports' stage histograms")
+    args = ap.parse_args(argv)
+
+    if args.diff:
+        d = timeline.diff_reports(_load_report(args.diff[0]), _load_report(args.diff[1]))
+        print(json.dumps(d, indent=2) if args.json else timeline.render_diff(d))
+        return 0
+
+    events_path = args.events
+    if args.report:
+        rep = _load_report(args.report)
+        events_path = events_path or rep.get("events_jsonl")
+        if not events_path:
+            print(f"{args.report} carries no events_jsonl path", file=sys.stderr)
+            return 1
+    if not events_path:
+        ap.error("one of --events / --report / --diff is required")
+
+    tl = timeline.Timeline.from_path(events_path)
+    if not tl.events:
+        print(f"no events found under {events_path}", file=sys.stderr)
+        return 1
+    if args.perfetto:
+        with open(args.perfetto, "w", encoding="utf-8") as fh:
+            json.dump(tl.perfetto(), fh)
+        print(f"perfetto trace -> {args.perfetto}")
+    rep = tl.autopsy(slot=args.slot, trace_id=args.trace, budget_ms=args.budget_ms)
+    if rep is None:
+        target = args.trace or args.slot
+        print(f"no terminal request events matched {target!r}", file=sys.stderr)
+        return 1
+    print(json.dumps(rep, indent=2) if args.json else timeline.render_autopsy(rep))
+    if args.min_coverage is not None and rep["coverage"] < args.min_coverage:
+        print(
+            f"attribution coverage {rep['coverage']:.3f} below the "
+            f"{args.min_coverage} gate",
+            file=sys.stderr,
+        )
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    try:
+        raise SystemExit(main())
+    except BrokenPipeError:
+        # piped into `head` and the reader hung up: that's not an error
+        os.dup2(os.open(os.devnull, os.O_WRONLY), sys.stdout.fileno())
+        raise SystemExit(0)

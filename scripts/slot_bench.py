@@ -59,7 +59,7 @@ force_virtual_chips()
 import numpy as np  # noqa: E402
 
 from eth_consensus_specs_tpu import obs  # noqa: E402
-from eth_consensus_specs_tpu.obs import export  # noqa: E402
+from eth_consensus_specs_tpu.obs import export, timeline  # noqa: E402
 from eth_consensus_specs_tpu.ops import slot_pipeline as sp  # noqa: E402
 from eth_consensus_specs_tpu.serve import buckets as serve_buckets  # noqa: E402
 from eth_consensus_specs_tpu.serve.config import ServeConfig  # noqa: E402
@@ -242,6 +242,14 @@ def run_bench(args) -> None:
     os.makedirs(ckpt_dir, exist_ok=True)
 
     export.maybe_serve_http()
+    # fleet timeline source: stream this process's events as JSONL next
+    # to the report (replicas inherit the env at spawn and re-point to
+    # sibling files), so every run leaves an assemblable trace — the
+    # autopsy epilogue and the CI Perfetto artifact both read it
+    if not os.environ.get("ETH_SPECS_OBS_JSONL"):
+        jsonl = os.path.splitext(os.path.abspath(args.out))[0] + ".events.jsonl"
+        os.environ["ETH_SPECS_OBS_JSONL"] = jsonl
+        obs.get_registry().configure_jsonl(jsonl)
     print(f"slot-machine: building {args.slots}-slot schedule "
           f"(n={args.validators}, invalid={args.invalid_rate}, "
           f"blobs~{args.blob_rate})", flush=True)
@@ -463,6 +471,28 @@ def _run_load(args, fd, reqs, oracle, failures, warmup_path, pm_dir):
         "warmup_keys": len(serve_buckets.load_warmup(warmup_path)),
         "slot": slot_section,
     }
+    # slot autopsy: the worst slot's critical path, from the fleet's
+    # own JSONL streams under corrected clocks. On a chaos run the
+    # attribution coverage GATES — a respawn whose outage doesn't land
+    # in named stages means the recovery accounting broke
+    jsonl = os.environ.get("ETH_SPECS_OBS_JSONL")
+    if jsonl:
+        autop = None
+        try:
+            autop = timeline.Timeline.from_path(jsonl).autopsy()
+        except Exception as exc:  # noqa: BLE001 — diagnose, don't crash the bench
+            failures.append(f"slot autopsy crashed: {exc!r}")
+        if autop is not None:
+            report["autopsy"] = autop
+            print(timeline.render_autopsy(autop), flush=True)
+            if args.chaos and autop["coverage"] < 0.95:
+                failures.append(
+                    f"autopsy attribution coverage {autop['coverage']:.3f} "
+                    f"< 0.95 on the chaos run (slot {autop['slot']})"
+                )
+        elif args.chaos:
+            failures.append("chaos run produced no autopsy (no slot "
+                            "request events in the JSONL streams)")
     finish_report(report, failures, args.out, "slot_bench.failure", snap)
 
 

@@ -21,7 +21,7 @@ import numpy as np
 import pytest
 
 from eth_consensus_specs_tpu import fault, obs, serve
-from eth_consensus_specs_tpu.obs import trace
+from eth_consensus_specs_tpu.obs import timeline, trace
 from eth_consensus_specs_tpu.ops import bls_batch
 from eth_consensus_specs_tpu.ops import merkle as ops_merkle
 from eth_consensus_specs_tpu.serve import buckets, wire
@@ -307,8 +307,9 @@ def test_warmup_artifact_zero_cold_compiles_on_consumers(shared_fd, trees):
 
 def test_trace_stitches_across_the_process_boundary(shared_fd, trees):
     """A submit under an active trace context reaches the replica with
-    the same trace_id: its frontdoor.rpc span in the shared JSONL sink
-    is a child of the caller's trace."""
+    the same trace_id: its frontdoor.rpc span — in the replica's own
+    sibling stream next to the configured parent sink (obs/timeline.py
+    fleet layout) — is a child of the caller's trace."""
     fd, jsonl, _ = shared_fd
     ctx = trace.new_trace()
     with trace.activate(ctx):
@@ -317,16 +318,15 @@ def test_trace_stitches_across_the_process_boundary(shared_fd, trees):
     spans = []
     while not spans and time.monotonic() < deadline:
         time.sleep(0.1)
-        with open(jsonl) as fh:
-            lines = [json.loads(ln) for ln in fh if ln.strip()]
+        lines = timeline.load_fleet(str(jsonl))
         spans = [
             e
             for e in lines
             if e.get("name") == "frontdoor.rpc" and e.get("trace_id") == ctx.trace_id
         ]
     assert spans, "no replica-side span carried the caller's trace id"
-    parent_pid_events = [e for e in lines if e.get("kind") == "frontdoor.replica_ready"]
-    assert parent_pid_events, "replica boot events missing from the shared sink"
+    boot_events = [e for e in lines if e.get("kind") == "frontdoor.replica_ready"]
+    assert boot_events, "replica boot events missing from the fleet streams"
 
 
 def test_corrupt_request_frame_detected_counted_retried(shared_fd, trees):

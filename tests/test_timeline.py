@@ -1,0 +1,362 @@
+"""Fleet timeline assembly and slot autopsy (obs/timeline.py).
+
+The tier-1 acceptance story for the cross-process trace assembler:
+deliberate clock skew between processes is corrected to truthful
+nesting, a missing or truncated replica stream degrades to a
+partial-but-valid trace (never a crash), a trace id re-used across runs
+is split into episodes and the autopsy reads the latest one, and a
+SIGKILL-respawn slot shows the ``recovery`` stage on its critical path
+with >= 95% of the end-to-end wall attributed to named stages. All
+synthetic streams, pure host — the shapes match exactly what
+obs/registry.py stamps and serve/frontdoor.py emits.
+"""
+
+import json
+
+import pytest
+
+from eth_consensus_specs_tpu.obs import timeline
+from eth_consensus_specs_tpu.obs.histogram import Histogram
+
+FD_PID, R0_PID, R1_PID = 100, 200, 300
+SKEW0, SKEW1 = 500.0, -250.0  # replica perf_counter epochs vs the parent's
+
+
+def _fd(t_mono, **kw):
+    kw.update(pid=FD_PID, tid=1, t_mono=t_mono, t_wall=1000.0 + t_mono)
+    return kw
+
+
+def _replica(pid, skew, t_parent, **kw):
+    kw.update(pid=pid, tid=9, t_mono=t_parent + skew, t_wall=1000.0 + t_parent)
+    return kw
+
+
+def _sync(pid, skew, replica, t=10.0, src="probe"):
+    return _fd(
+        t + 0.002, kind="clock.sync", replica=replica, peer=pid,
+        t_send=t, t_recv=t + 0.002, remote_mono=t + 0.001 + skew, src=src,
+    )
+
+
+def _request_done(t_end, slot, e2e_ms, ok=True, trace="t1-req1", **kw):
+    ev = _fd(
+        t_end, kind="frontdoor.request_done", req_kind="slot", trace=trace,
+        e2e_ms=e2e_ms, ok=ok, hedged=False, slot=slot,
+    )
+    ev.update(kw)
+    return ev
+
+
+def _rpc_span(pid, skew, t_end, dur_s, trace="t1", parent="req1"):
+    return _replica(
+        pid, skew, t_end, kind="span", name="frontdoor.rpc", s=dur_s,
+        depth=0, trace_id=trace, span_id="aaa", parent_span=parent,
+    )
+
+
+# ------------------------------------------------------------- clock skew --
+
+
+def test_clock_skew_corrected_to_truthful_nesting():
+    """A replica stream 500s AHEAD of the parent still nests inside the
+    request envelope once the clock.sync offset is applied."""
+    evs = [
+        _sync(R0_PID, SKEW0, replica=0),
+        _rpc_span(R0_PID, SKEW0, t_end=11.045, dur_s=0.040),
+        _request_done(
+            11.050, slot=7, e2e_ms=50.0,
+            stages={"queue": 5.0, "device": 30.0, "resolve": 5.0, "total": 40.0},
+        ),
+    ]
+    tl = timeline.Timeline(evs)
+    trace = tl.perfetto()
+    assert timeline.validate(trace) == []
+    (x,) = [e for e in trace["traceEvents"] if e["ph"] == "X"]
+    (b,) = [e for e in trace["traceEvents"] if e["ph"] == "b"]
+    (e_,) = [e for e in trace["traceEvents"] if e["ph"] == "e"]
+    # the envelope [b, e] must CONTAIN the replica's rpc slice — with
+    # raw (uncorrected) stamps the slice would sit 500s to the right
+    assert b["ts"] <= x["ts"]
+    assert x["ts"] + x["dur"] <= e_["ts"]
+    # and the whole trace JSON-serializes (the artifact contract)
+    json.dumps(trace)
+
+
+def test_two_replicas_opposite_skews_one_timeline():
+    """Two replicas skewed in OPPOSITE directions land on one timeline
+    in parent order, each on its own named process track."""
+    evs = [
+        _sync(R0_PID, SKEW0, replica=0, t=5.0),
+        _sync(R1_PID, SKEW1, replica=1, t=6.0),
+        _rpc_span(R0_PID, SKEW0, t_end=11.0, dur_s=0.01, parent="req1"),
+        _rpc_span(R1_PID, SKEW1, t_end=12.0, dur_s=0.01, parent="req2"),
+    ]
+    tl = timeline.Timeline(evs)
+    trace = tl.perfetto()
+    assert timeline.validate(trace) == []
+    xs = sorted(
+        (e for e in trace["traceEvents"] if e["ph"] == "X"),
+        key=lambda e: e["ts"],
+    )
+    assert [x["pid"] for x in xs] == [R0_PID, R1_PID]  # parent order, not raw
+    names = {
+        e["pid"]: e["args"]["name"]
+        for e in trace["traceEvents"] if e["ph"] == "M"
+    }
+    assert names[FD_PID] == "frontdoor"
+    assert names[R0_PID] == "replica 0"
+    assert names[R1_PID] == "replica 1"
+
+
+def test_wall_anchor_fallback_without_sync():
+    """A pid with NO clock.sync sample still lands via the wall/mono
+    pair every stamped event carries (millisecond-grade, but on the
+    timeline — a truncated stream must not vanish)."""
+    evs = [
+        # parent events establish the ref anchor
+        _fd(10.0, kind="frontdoor.replica_spawned", replica=0),
+        _fd(20.0, kind="frontdoor.closed"),
+        _rpc_span(R0_PID, SKEW0, t_end=15.0, dur_s=0.01),
+    ]
+    tl = timeline.Timeline(evs)
+    assert R0_PID not in tl.clock.synced_pids
+    t = tl.clock.to_ref(R0_PID, 15.0 + SKEW0)
+    assert abs(t - 15.0) < 0.05  # wall anchors, not the raw 500s skew
+    assert timeline.validate(tl.perfetto()) == []
+
+
+# -------------------------------------------------------- partial streams --
+
+
+def test_truncated_and_missing_streams_partial_valid_trace(tmp_path):
+    """A torn JSONL line (SIGKILL mid-write) is skipped, a missing
+    sibling is an empty stream, and the assembly stays valid."""
+    parent = tmp_path / "run.jsonl"
+    with open(parent, "w") as fh:
+        fh.write(json.dumps(_sync(R0_PID, SKEW0, replica=0)) + "\n")
+        fh.write(json.dumps(_request_done(11.0, slot=1, e2e_ms=10.0)) + "\n")
+        fh.write("not json at all\n")
+        fh.write('{"kind": "span", "name": "torn')  # no newline, no brace
+    with open(tmp_path / "run.slot-fd-r0.jsonl", "w") as fh:
+        fh.write(json.dumps(_rpc_span(R0_PID, SKEW0, 10.999, 0.008)) + "\n")
+        fh.write('{"torn": ')
+    # r1's stream never made it to disk at all — only r0's sibling exists
+    tl = timeline.Timeline.from_path(str(parent))
+    assert len(tl.events) == 3  # garbage dropped, good lines kept
+    trace = tl.perfetto()
+    assert timeline.validate(trace) == []
+    assert {e["pid"] for e in tl.events} == {FD_PID, R0_PID}
+    rep = tl.autopsy(slot=1)
+    assert rep is not None and rep["coverage"] > 0.0
+
+
+def test_missing_file_is_empty_stream(tmp_path):
+    assert timeline.load_stream(str(tmp_path / "nope.jsonl")) == []
+    assert timeline.Timeline.from_path(str(tmp_path / "nope.jsonl")).events == []
+    assert timeline.assemble_to_file(
+        str(tmp_path / "nope.jsonl"), str(tmp_path / "out.json")
+    ) is None
+
+
+# ------------------------------------------------------------- episodes --
+
+
+def test_duplicate_trace_ids_across_runs_disambiguated():
+    """The same trace id (and slot number) appended across two runs is
+    split on the wall gap; the autopsy reads the LATEST episode and its
+    monotonic stamps never mix with the first boot's."""
+    run1 = [
+        _sync(R0_PID, SKEW0, replica=0, t=5.0),
+        _request_done(10.0, slot=3, e2e_ms=40.0, trace="tX-req1"),
+    ]
+    # second run: same trace id, same slot, 10 minutes later, NEW
+    # monotonic epoch (the process restarted — small t_mono again)
+    run2 = [
+        {**_request_done(9.0, slot=3, e2e_ms=80.0, trace="tX-req1"),
+         "t_wall": 1000.0 + 10.0 + 600.0},
+    ]
+    tl = timeline.Timeline(run1 + run2)
+    attempts = tl.slot_attempts(3)
+    assert len(attempts) == 1  # the latest episode only
+    assert attempts[0]["e2e_ms"] == 80.0
+    rep = tl.autopsy(slot=3)
+    assert rep["e2e_ms"] == pytest.approx(80.0)
+    # flow ids of the two episodes must differ or Perfetto would draw
+    # one arrow across a 10-minute void
+    trace = tl.perfetto()
+    assert timeline.validate(trace) == []
+    ids = {e["id"] for e in trace["traceEvents"] if e["ph"] in ("b", "e")}
+    assert ids == {"tX-req1", "tX-req1#1"}
+
+
+def test_split_episodes_respects_gap_env(monkeypatch):
+    monkeypatch.setenv("ETH_SPECS_OBS_TRACE_GAP_S", "10")
+    items = [{"t_wall": 0.0}, {"t_wall": 5.0}, {"t_wall": 30.0}]
+    assert [len(ep) for ep in timeline.split_episodes(items)] == [2, 1]
+    monkeypatch.setenv("ETH_SPECS_OBS_TRACE_GAP_S", "100")
+    assert [len(ep) for ep in timeline.split_episodes(items)] == [3]
+
+
+# -------------------------------------------------------------- autopsy --
+
+
+def test_sigkill_respawn_slot_shows_recovery_on_critical_path():
+    """A slot whose owner was SIGKILLed mid-flight: shed attempt, an
+    outage gap bounded by replica_lost/replica_recovered, then the
+    successful retry. ``recovery`` must land on the critical path and
+    named stages must cover >= 95% of the wall."""
+    evs = [
+        _sync(R0_PID, SKEW0, replica=0, t=5.0),
+        # attempt 1: typed shed while the owner is dead (fast failure)
+        _request_done(10.01, slot=9, e2e_ms=10.0, ok=False,
+                      err="Overloaded", trace="tA-req1"),
+        _fd(10.02, kind="frontdoor.replica_lost", replica=0, exitcode=-9),
+        _fd(12.02, kind="frontdoor.replica_recovered", replica=0,
+            recovery_ms=2000.0, resident=True),
+        # attempt 2: resubmitted after the respawn, succeeds
+        _request_done(
+            12.30, slot=9, e2e_ms=200.0, trace="tA-req2",
+            stages={"queue": 20.0, "device": 150.0, "resolve": 10.0,
+                    "total": 180.0},
+        ),
+    ]
+    tl = timeline.Timeline(evs)
+    rep = tl.autopsy(slot=9)
+    assert rep is not None
+    assert len(rep["attempts"]) == 2
+    assert rep["attempts"][0]["err"] == "Overloaded"
+    stages = rep["stages_ms"]
+    # the outage overlapped the inter-attempt gap: death 10.02 →
+    # recovered 12.02 inside the gap [10.01, 12.10]
+    assert stages["recovery"] == pytest.approx(2000.0, rel=0.01)
+    assert "retry_shed" in stages
+    path_stages = [row["stage"] for row in rep["critical_path"]]
+    assert path_stages[0] == "recovery"  # the dominant stage BY FAR
+    assert rep["coverage"] >= 0.95
+    assert rep["verdict"] == "OVER BUDGET"  # 2.3s against the 1s budget
+    assert rep["over_ms"] > 0
+
+
+def test_autopsy_picks_worst_slot_by_default():
+    evs = [
+        _request_done(10.0, slot=1, e2e_ms=10.0, trace="t1-a"),
+        _request_done(11.0, slot=2, e2e_ms=500.0, trace="t2-a",
+                      stages={"device": 450.0, "total": 450.0}),
+        _request_done(12.0, slot=3, e2e_ms=20.0, trace="t3-a"),
+    ]
+    rep = timeline.Timeline(evs).autopsy()
+    assert rep["slot"] == 2
+    assert rep["stages_ms"]["device"] == pytest.approx(450.0)
+
+
+def test_checkpoint_carved_out_of_containing_stage():
+    evs = [
+        _sync(R0_PID, SKEW0, replica=0, t=5.0),
+        _request_done(
+            11.0, slot=4, e2e_ms=100.0, trace="t4-a",
+            stages={"device": 80.0, "resolve": 10.0, "total": 90.0},
+        ),
+        # a 30ms durable checkpoint inside the attempt window, stamped
+        # on the OWNER's skewed clock
+        _replica(R0_PID, SKEW0, 10.95, kind="span",
+                 name="resident.checkpoint", s=0.030, depth=2),
+    ]
+    rep = timeline.Timeline(evs).autopsy(slot=4)
+    assert rep["stages_ms"]["checkpoint"] == pytest.approx(30.0, rel=0.01)
+    assert rep["stages_ms"]["device"] == pytest.approx(50.0, rel=0.01)
+    # carving re-attributes, never inflates: the sum is unchanged
+    assert sum(rep["stages_ms"].values()) == pytest.approx(100.0, rel=0.01)
+
+
+def test_autopsy_by_trace_id_and_render():
+    evs = [_request_done(10.0, slot=5, e2e_ms=25.0, trace="feed-beef")]
+    tl = timeline.Timeline(evs)
+    rep = tl.autopsy(trace_id="feed")
+    assert rep is not None and rep["e2e_ms"] == pytest.approx(25.0)
+    text = timeline.render_autopsy(rep)
+    assert "within budget" in text and "critical path" in text
+    assert tl.autopsy(trace_id="no-such-trace") is None
+    assert timeline.Timeline([]).autopsy() is None
+
+
+# ----------------------------------------------------------------- diff --
+
+
+def _hist_snapshot(values):
+    h = Histogram()
+    for v in values:
+        h.record(v)
+    return h.snapshot()
+
+
+def test_diff_names_the_regressing_stage():
+    a = {"stage_hist": {
+        "serve.stage_ms.queue": _hist_snapshot([1.0] * 50),
+        "serve.stage_ms.device": _hist_snapshot([10.0] * 50),
+    }}
+    b = {"stage_hist": {
+        "serve.stage_ms.queue": _hist_snapshot([1.0] * 50),
+        "serve.stage_ms.device": _hist_snapshot([40.0] * 50),  # 4x
+    }}
+    d = timeline.diff_reports(a, b)
+    assert [r["stage"] for r in d["regressed"]] == ["device"]
+    assert "device" in d["verdict"]
+    assert not d["improved"]
+    # the reverse comparison reads as an improvement, not a regression
+    back = timeline.diff_reports(b, a)
+    assert not back["regressed"]
+    assert [r["stage"] for r in back["improved"]] == ["device"]
+    assert "no regression" in back["verdict"]
+    text = timeline.render_diff(d)
+    assert "REGRESSED" in text and "device" in text
+
+
+def test_diff_attributes_replica_movement():
+    a = {"stage_hist": {}, "autopsy": {"replica_device_ms": {
+        "replica 0": 100.0, "replica 1": 100.0}}}
+    b = {"stage_hist": {}, "autopsy": {"replica_device_ms": {
+        "replica 0": 100.0, "replica 1": 400.0}}}
+    d = timeline.diff_reports(a, b)
+    assert d["replicas_moved"][0]["replica"] == "replica 1"
+    assert d["replicas_moved"][0]["delta_ms"] == pytest.approx(300.0)
+
+
+# ------------------------------------------------------------ validation --
+
+
+def test_validate_rejects_broken_traces():
+    assert timeline.validate({}) == ["traceEvents is not a list"]
+    bad_nest = {"traceEvents": [
+        {"ph": "X", "name": "a", "pid": 1, "tid": 1, "ts": 0, "dur": 1000},
+        {"ph": "X", "name": "b", "pid": 1, "tid": 1, "ts": 500, "dur": 1000},
+    ]}
+    assert any("nest" in p for p in timeline.validate(bad_nest))
+    dangling = {"traceEvents": [
+        {"ph": "f", "id": "x", "pid": 1, "tid": 1, "ts": 0, "bp": "e"},
+    ]}
+    assert any("before s" in p for p in timeline.validate(dangling))
+    unbalanced = {"traceEvents": [
+        {"ph": "b", "cat": "request", "id": "r", "name": "q", "pid": 1,
+         "tid": 1, "ts": 0},
+    ]}
+    assert any("without end" in p for p in timeline.validate(unbalanced))
+
+
+def test_assemble_to_file_writes_loadable_trace(tmp_path):
+    parent = tmp_path / "run.jsonl"
+    with open(parent, "w") as fh:
+        for ev in (
+            _sync(R0_PID, SKEW0, replica=0),
+            _rpc_span(R0_PID, SKEW0, 11.045, 0.040),
+            _request_done(11.05, slot=7, e2e_ms=50.0,
+                          stages={"device": 40.0, "total": 40.0}),
+        ):
+            fh.write(json.dumps(ev) + "\n")
+    out = tmp_path / "run.trace.json"
+    summary = timeline.assemble_to_file(str(parent), str(out))
+    assert summary["processes"] == 2
+    assert summary["synced_pids"] == 1
+    trace = json.load(open(out))
+    assert timeline.validate(trace) == []
+    assert trace["displayTimeUnit"] == "ms"
