@@ -93,6 +93,63 @@ ENV_VARS: tuple[EnvVar, ...] = (
     _v("ETH_SPECS_SLO_DEGRADED_RATE", "0.01",
        "`degraded_rate` SLO bound (`serve.degraded_items` per serve request)",
        "observability.md#slos"),
+    # ----------------------------------------------- continuous telemetry --
+    _v("ETH_SPECS_OBS_TSDB", "1",
+       "`0`: disable the in-process metric time-series ring (and with it "
+       "the anomaly detectors and scoreboard series)",
+       "observability.md#continuous-telemetry"),
+    _v("ETH_SPECS_OBS_TSDB_RING", "600",
+       "telemetry samples the series ring retains (~2 minutes at the "
+       "default 200 ms probe interval)",
+       "observability.md#continuous-telemetry"),
+    _v("ETH_SPECS_OBS_SCOREBOARD", "unset",
+       "path the supervisor atomically rewrites a JSON fleet scoreboard "
+       "to each telemetry tick (`scripts/obs_top.py --watch` tails it)",
+       "observability.md#continuous-telemetry"),
+    _v("ETH_SPECS_CANARY_MS", "0",
+       "known-answer canary injection interval, ms (`0` = off); canaries "
+       "ride the normal front-door path but are exempt from admission "
+       "and excluded from SLO/throughput stats",
+       "observability.md#continuous-telemetry"),
+    _v("ETH_SPECS_CANARY_TIMEOUT_S", "10",
+       "a canary unresolved past this counts as `canary.errors` "
+       "(degraded, not a parity failure)",
+       "observability.md#continuous-telemetry"),
+    _v("ETH_SPECS_CANARY_SHAPES", "bls,htr,agg",
+       "canary shape cycle (csv of bls/htr/agg/kzg, or `all`); `kzg` is "
+       "opt-in because each probe costs a 4096-element blob parse",
+       "observability.md#continuous-telemetry"),
+    _v("ETH_SPECS_ANOM_DETECTORS", "all",
+       "anomaly detector set: `all`, `structural` (deterministic fault "
+       "signatures — the bench clean-run gate), `none`, or a csv of "
+       "detector names", "observability.md#continuous-telemetry"),
+    _v("ETH_SPECS_ANOM_WARMUP", "12",
+       "traffic windows before the statistical detectors arm",
+       "observability.md#continuous-telemetry"),
+    _v("ETH_SPECS_ANOM_K", "8",
+       "`latency_step` deviation multiplier (EWMA MAD-proxy)",
+       "observability.md#continuous-telemetry"),
+    _v("ETH_SPECS_ANOM_CONFIRM", "2",
+       "consecutive suspicious windows before a detector fires",
+       "observability.md#continuous-telemetry"),
+    _v("ETH_SPECS_ANOM_STALL_WINDOWS", "15",
+       "dark windows before `completion_stall` / `dead_stage` fire",
+       "observability.md#continuous-telemetry"),
+    _v("ETH_SPECS_ANOM_DRIFT_RATIO", "3",
+       "`latency_drift` fires when the p99 EWMA crosses this multiple of "
+       "its warmup anchor", "observability.md#continuous-telemetry"),
+    _v("ETH_SPECS_ANOM_RATE_RATIO", "8",
+       "`rate_spike`/`rate_stall` baseline multiple",
+       "observability.md#continuous-telemetry"),
+    _v("ETH_SPECS_ANOM_BURN", "0.5",
+       "windowed SLO burn rate that rates a `burn_accel` fire",
+       "observability.md#continuous-telemetry"),
+    _v("ETH_SPECS_ANOM_BURN_WINDOW_S", "30",
+       "the `slo.burn_rate(window_s=...)` horizon `burn_accel` watches",
+       "observability.md#continuous-telemetry"),
+    _v("ETH_SPECS_ANOM_REFRACTORY_S", "30",
+       "per-(detector, replica, stage) refire suppression window, seconds",
+       "observability.md#continuous-telemetry"),
     _v("ETH_SPECS_OBS_TRACE_GAP_S", "120",
        "fleet-timeline episode split: a wall-clock gap wider than this "
        "separates re-used trace ids / slot numbers into distinct episodes",

@@ -1,0 +1,654 @@
+"""Streaming anomaly detectors over the in-process telemetry series.
+
+The tsdb ring (obs/tsdb.py) holds the last few minutes of per-window
+metric deltas; this module watches it continuously and turns a
+suspicious shape into a *fired anomaly*: counters
+(``anomaly.fires[.<detector>]``), an ``anomaly.fired`` event, and — the
+point of the exercise — an **exemplar bundle** via
+``flight.trigger_dump``: the triggering series window, the nearest
+trace ids from the flight ring, and the anomaly's attribution (replica,
+waterfall stage). An alert always arrives with its evidence attached.
+
+Two detector families, selected by ``ETH_SPECS_ANOM_DETECTORS``
+(``all`` | ``structural`` | csv of names):
+
+**Structural** — deterministic fault signatures that should never fire
+on a clean run regardless of load shape (this is the set benches gate
+at zero on clean runs):
+
+  * ``dead_replica`` — a ``frontdoor.replica_lost`` breadcrumb in the
+    window (the supervisor's death handler emits it); fires within ONE
+    probe window of the supervisor observing the death, attributed to
+    the replica index with stage ``recovery`` (the waterfall stage that
+    bills the outage).
+  * ``probe_stall`` — the same replica failed its health probe for
+    ``confirm`` consecutive windows (each probe bounded by the 5 s RPC
+    timeout); attributed replica + stage ``wire``.
+  * ``completion_stall`` — requests were submitted but NOTHING
+    completed for ``stall_windows`` consecutive windows ("zero-traffic"
+    in the traffic-in/no-traffic-out sense; a quiet fleet is idle, not
+    stalled). A window that finishes a compile resets the streak — a
+    first-dispatch wall is progress, not a stall.
+  * ``dead_stage`` — completions continue but a previously-active
+    waterfall stage recorded zero samples for ``stall_windows``
+    windows; attributed to the first dark stage in pipeline order.
+
+**Statistical** — EWMA/MAD-style baselines for long-running fleets
+(benches sweep load shapes on purpose, so these are excluded from the
+bench clean-run gate; the synthetic-series tests in
+tests/test_telemetry.py pin their firing horizons and a zero
+false-positive budget on clean noise):
+
+  * ``latency_step`` — window p99 of the wait/e2e histogram exceeds
+    ``baseline + k*dev`` (dev = EWMA of |x − baseline|, floored at
+    10% of baseline) AND 2× baseline, sustained ``confirm`` windows.
+    Horizon: fires within ``confirm`` windows of a step once warmed.
+  * ``latency_drift`` — fast EWMA of window p99 crosses
+    ``drift_ratio`` × a frozen warmup anchor (the anchor is the median
+    of the first ``warmup`` traffic windows, re-anchored after a
+    fire). Horizon for per-window growth r:
+    ``ceil(log(drift_ratio)/log(1+r)) + confirm + 3`` windows.
+  * ``rate_spike`` / ``rate_stall`` — request rate vs a slow EWMA
+    baseline: > ``rate_ratio``× (spike) or < 1/``rate_ratio``× while
+    still nonzero (stall; a zero rate decays the baseline instead —
+    idleness is not an anomaly), sustained ``confirm`` windows.
+  * ``burn_accel`` — the *windowed* SLO burn rate
+    (``slo.burn_rate(window_s=...)``, satellite of this PR) exceeds
+    ``burn_threshold`` AND 2× the all-time burn rate: breaches are
+    accelerating, not amortizing.
+
+Every threshold is an env knob (see :class:`AnomalyConfig`); the
+detector table with defaults lives in
+docs/observability.md#continuous-telemetry.
+"""
+
+from __future__ import annotations
+
+import os
+import statistics
+import time
+from collections import deque
+from dataclasses import dataclass
+
+from . import flight
+from .waterfall import STAGE_NAMES
+
+STRUCTURAL = ("dead_replica", "probe_stall", "completion_stall", "dead_stage")
+STATISTICAL = ("latency_step", "latency_drift", "rate_spike", "rate_stall",
+               "burn_accel")
+ALL = STRUCTURAL + STATISTICAL
+
+
+def _env_int(name: str, default: int) -> int:
+    raw = os.environ.get(name, "")
+    try:
+        return int(raw) if raw else default
+    except ValueError:
+        return default
+
+
+def _env_float(name: str, default: float) -> float:
+    raw = os.environ.get(name, "")
+    try:
+        return float(raw) if raw else default
+    except ValueError:
+        return default
+
+
+@dataclass(frozen=True)
+class AnomalyConfig:
+    """Detector tuning knobs (each an ``ETH_SPECS_ANOM_*`` env var)."""
+
+    warmup: int = 12            # traffic windows before statistical detectors arm
+    k: float = 8.0              # MAD-proxy multiplier for latency_step
+    confirm: int = 2            # consecutive suspicious windows to fire
+    stall_windows: int = 15     # dark windows for completion_stall/dead_stage
+    drift_ratio: float = 3.0    # latency_drift anchor multiple
+    rate_ratio: float = 8.0     # rate_spike/rate_stall baseline multiple
+    burn_threshold: float = 0.5  # windowed burn rate that rates a fire
+    burn_window_s: float = 30.0  # the burn_rate(window_s=...) horizon
+    refractory_s: float = 30.0  # per-(detector, attribution) refire suppression
+
+    @classmethod
+    def from_env(cls, **overrides) -> "AnomalyConfig":
+        cfg = cls(
+            warmup=_env_int("ETH_SPECS_ANOM_WARMUP", cls.warmup),
+            k=_env_float("ETH_SPECS_ANOM_K", cls.k),
+            confirm=_env_int("ETH_SPECS_ANOM_CONFIRM", cls.confirm),
+            stall_windows=_env_int("ETH_SPECS_ANOM_STALL_WINDOWS", cls.stall_windows),
+            drift_ratio=_env_float("ETH_SPECS_ANOM_DRIFT_RATIO", cls.drift_ratio),
+            rate_ratio=_env_float("ETH_SPECS_ANOM_RATE_RATIO", cls.rate_ratio),
+            burn_threshold=_env_float("ETH_SPECS_ANOM_BURN", cls.burn_threshold),
+            burn_window_s=_env_float("ETH_SPECS_ANOM_BURN_WINDOW_S", cls.burn_window_s),
+            refractory_s=_env_float("ETH_SPECS_ANOM_REFRACTORY_S", cls.refractory_s),
+        )
+        if overrides:
+            from dataclasses import replace
+
+            cfg = replace(cfg, **overrides)
+        return cfg
+
+
+@dataclass
+class Anomaly:
+    detector: str
+    detail: str
+    replica: int | None = None
+    stage: str | None = None
+    severity: str = "warn"
+    windows: int | None = None  # suspicious windows observed before firing
+
+    def to_dict(self) -> dict:
+        d = {"detector": self.detector, "detail": self.detail,
+             "severity": self.severity}
+        if self.replica is not None:
+            d["replica"] = self.replica
+        if self.stage is not None:
+            d["stage"] = self.stage
+        if self.windows is not None:
+            d["windows"] = self.windows
+        return d
+
+
+def _worst_stage(sample, ring) -> str | None:
+    """Attribute a latency anomaly to the waterfall stage whose window
+    p99 moved the most relative to its own ring history."""
+    worst, worst_ratio = None, 0.0
+    for st in STAGE_NAMES:
+        name = f"serve.stage_ms.{st}"
+        now = sample.quantile(name, 0.99)
+        if now is None:
+            continue
+        hist = [v for _, v in ring.quantile_series(name, 0.99)[:-1]]
+        if len(hist) < 3:
+            continue
+        base = statistics.median(hist)
+        ratio = now / max(base, 1e-6)
+        if ratio > worst_ratio:
+            worst, worst_ratio = st, ratio
+    return worst
+
+
+# --------------------------------------------------------------- detectors --
+
+
+class DeadReplica:
+    name = "dead_replica"
+    severity = "page"
+
+    def __init__(self, cfg: AnomalyConfig):
+        self.cfg = cfg
+
+    def step(self, sample, ring) -> list[Anomaly]:
+        out = []
+        for e in sample.events:
+            if e.get("kind") != "frontdoor.replica_lost":
+                continue
+            out.append(Anomaly(
+                self.name,
+                detail=(f"replica {e.get('replica')} lost"
+                        f" (exitcode={e.get('exitcode')})"),
+                replica=e.get("replica"), stage="recovery",
+                severity=self.severity, windows=1,
+            ))
+        return out
+
+
+class ProbeStall:
+    name = "probe_stall"
+    severity = "warn"
+
+    def __init__(self, cfg: AnomalyConfig):
+        self.cfg = cfg
+        self._streak: dict = {}
+
+    def step(self, sample, ring) -> list[Anomaly]:
+        failed = {e.get("replica") for e in sample.events
+                  if e.get("kind") == "frontdoor.probe_failed"}
+        out = []
+        for r in list(self._streak):
+            if r not in failed:
+                self._streak[r] = 0
+        for r in failed:
+            self._streak[r] = self._streak.get(r, 0) + 1
+            if self._streak[r] == self.cfg.confirm:
+                out.append(Anomaly(
+                    self.name,
+                    detail=f"replica {r} failed {self.cfg.confirm} consecutive probes",
+                    replica=r, stage="wire", severity=self.severity,
+                    windows=self.cfg.confirm,
+                ))
+        return out
+
+
+class CompletionStall:
+    name = "completion_stall"
+    severity = "page"
+
+    def __init__(self, cfg: AnomalyConfig, submits: str, completions: str):
+        self.cfg = cfg
+        self.submits = submits
+        self.completions = completions
+        self._streak = 0
+
+    def step(self, sample, ring) -> list[Anomaly]:
+        done = sample.hist_count(self.completions)
+        submitted = sample.counters.get(self.submits, 0)
+        if done > 0 or sample.counters.get("serve.compiles", 0) > 0:
+            self._streak = 0
+            return []
+        if submitted > 0 or self._streak > 0:
+            self._streak += 1
+        if self._streak == self.cfg.stall_windows:
+            return [Anomaly(
+                self.name,
+                detail=(f"requests submitted but zero {self.completions}"
+                        f" completions for {self._streak} windows"),
+                stage=self._dark_stage(ring), severity=self.severity,
+                windows=self._streak,
+            )]
+        return []
+
+    def _dark_stage(self, ring) -> str | None:
+        """First stage in pipeline order that stopped ticking — where
+        the pipeline is wedged."""
+        recent = ring.last(self.cfg.stall_windows)
+        for st in STAGE_NAMES:
+            if not any(s.hist_count(f"serve.stage_ms.{st}") for s in recent):
+                return st
+        return None
+
+
+class DeadStage:
+    name = "dead_stage"
+    severity = "warn"
+
+    def __init__(self, cfg: AnomalyConfig, completions: str):
+        self.cfg = cfg
+        self.completions = completions
+        self._active: set = set()
+        self._streak: dict = {}
+
+    def step(self, sample, ring) -> list[Anomaly]:
+        if sample.hist_count(self.completions) == 0:
+            return []  # no completions: every stage is legitimately dark
+        out = []
+        for st in STAGE_NAMES:
+            if sample.hist_count(f"serve.stage_ms.{st}") > 0:
+                self._active.add(st)
+                self._streak[st] = 0
+            elif st in self._active:
+                self._streak[st] = self._streak.get(st, 0) + 1
+                if self._streak[st] == self.cfg.stall_windows:
+                    out.append(Anomaly(
+                        self.name,
+                        detail=(f"stage {st} dark for {self.cfg.stall_windows}"
+                                " windows while completions continue"),
+                        stage=st, severity=self.severity,
+                        windows=self.cfg.stall_windows,
+                    ))
+        return out
+
+
+class LatencyStep:
+    name = "latency_step"
+    severity = "warn"
+
+    def __init__(self, cfg: AnomalyConfig, metric: str):
+        self.cfg = cfg
+        self.metric = metric
+        self.baseline: float | None = None
+        self.dev = 0.0
+        self.n = 0
+        self._streak = 0
+
+    def _update(self, x: float) -> None:
+        a = 0.1
+        self.baseline = (1 - a) * self.baseline + a * x
+        self.dev = (1 - a) * self.dev + a * abs(x - self.baseline)
+        self.n += 1
+
+    def step(self, sample, ring) -> list[Anomaly]:
+        x = sample.quantile(self.metric, 0.99)
+        if x is None:
+            return []
+        if self.baseline is None:
+            self.baseline, self.n = x, 1
+            return []
+        if self.n < self.cfg.warmup:
+            self._update(x)
+            return []
+        floor = 0.1 * self.baseline + 0.1
+        threshold = self.baseline + self.cfg.k * max(self.dev, floor)
+        if x > threshold and x > 2.0 * self.baseline:
+            self._streak += 1
+            if self._streak >= self.cfg.confirm:
+                a = Anomaly(
+                    self.name,
+                    detail=(f"{self.metric} window p99 {x:.1f}ms vs baseline"
+                            f" {self.baseline:.1f}ms (k={self.cfg.k:g})"),
+                    stage=_worst_stage(sample, ring), severity=self.severity,
+                    windows=self._streak,
+                )
+                # adopt the new level: a persistent shift pages once, and
+                # the detector re-arms against the post-shift baseline
+                self.baseline, self.dev, self._streak = x, floor, 0
+                return [a]
+        else:
+            self._streak = 0
+            self._update(x)
+        return []
+
+
+class LatencyDrift:
+    name = "latency_drift"
+    severity = "warn"
+
+    def __init__(self, cfg: AnomalyConfig, metric: str):
+        self.cfg = cfg
+        self.metric = metric
+        self.anchor: float | None = None
+        self._warm: list[float] = []
+        self.ewma: float | None = None
+        self._streak = 0
+
+    def step(self, sample, ring) -> list[Anomaly]:
+        x = sample.quantile(self.metric, 0.99)
+        if x is None:
+            return []
+        if self.anchor is None:
+            self._warm.append(x)
+            if len(self._warm) >= self.cfg.warmup:
+                self.anchor = statistics.median(self._warm)
+                self._warm = []
+            return []
+        self.ewma = x if self.ewma is None else 0.7 * self.ewma + 0.3 * x
+        if self.ewma > self.cfg.drift_ratio * max(self.anchor, 1e-6):
+            self._streak += 1
+            if self._streak >= self.cfg.confirm:
+                a = Anomaly(
+                    self.name,
+                    detail=(f"{self.metric} p99 EWMA {self.ewma:.1f}ms crossed"
+                            f" {self.cfg.drift_ratio:g}x warmup anchor"
+                            f" {self.anchor:.1f}ms"),
+                    stage=_worst_stage(sample, ring), severity=self.severity,
+                    windows=self._streak,
+                )
+                self.anchor, self._streak = self.ewma, 0  # re-anchor
+                return [a]
+        else:
+            self._streak = 0
+        return []
+
+
+class _RateBase:
+    def __init__(self, cfg: AnomalyConfig, metric: str):
+        self.cfg = cfg
+        self.metric = metric
+        self.ewma: float | None = None
+        self.n = 0
+        self._streak = 0
+
+    def _decay(self, x: float) -> None:
+        a = 0.05
+        self.ewma = (1 - a) * self.ewma + a * x
+        self.n += 1
+
+    def step(self, sample, ring) -> list[Anomaly]:
+        x = sample.rates.get(self.metric, 0.0)
+        if x <= 0.0:
+            # idleness is not an anomaly: decay the baseline so a later
+            # warm-up re-learns the new regime instead of comparing
+            # against ancient traffic
+            if self.ewma is not None:
+                self._decay(0.0)
+            self._streak = 0
+            return []
+        if self.ewma is None:
+            self.ewma, self.n = x, 1
+            return []
+        if self.n < self.cfg.warmup:
+            self._decay(x)
+            return []
+        if self._suspicious(x):
+            self._streak += 1
+            if self._streak >= self.cfg.confirm:
+                a = self._fire(x)
+                self.ewma, self._streak = x, 0  # adopt the new regime
+                return [a]
+        else:
+            self._streak = 0
+            self._decay(x)
+        return []
+
+
+class RateSpike(_RateBase):
+    name = "rate_spike"
+    severity = "warn"
+
+    def _suspicious(self, x: float) -> bool:
+        return x > self.cfg.rate_ratio * self.ewma and x > 1.0
+
+    def _fire(self, x: float) -> Anomaly:
+        return Anomaly(
+            self.name,
+            detail=(f"{self.metric} rate {x:.1f}/s is"
+                    f" {x / max(self.ewma, 1e-9):.1f}x the baseline"
+                    f" {self.ewma:.1f}/s"),
+            severity=self.severity, windows=self._streak,
+        )
+
+
+class RateStall(_RateBase):
+    name = "rate_stall"
+    severity = "warn"
+
+    def _suspicious(self, x: float) -> bool:
+        return self.ewma > 1.0 and x < self.ewma / self.cfg.rate_ratio
+
+    def _fire(self, x: float) -> Anomaly:
+        return Anomaly(
+            self.name,
+            detail=(f"{self.metric} rate collapsed to {x:.2f}/s vs baseline"
+                    f" {self.ewma:.1f}/s"),
+            severity=self.severity, windows=self._streak,
+        )
+
+
+class BurnAccel:
+    name = "burn_accel"
+    severity = "warn"
+
+    def __init__(self, cfg: AnomalyConfig):
+        self.cfg = cfg
+        self._streak = 0
+
+    def step(self, sample, ring) -> list[Anomaly]:
+        from . import slo
+
+        recent = slo.burn_rate(window_s=self.cfg.burn_window_s)
+        if not recent or recent["windows"] < 4:
+            self._streak = 0
+            return []
+        overall = slo.burn_rate()
+        accelerating = (
+            recent["burn_rate"] >= self.cfg.burn_threshold
+            and (not overall
+                 or recent["burn_rate"] > 2.0 * overall["burn_rate"] + 0.05)
+        )
+        if accelerating:
+            self._streak += 1
+            if self._streak == self.cfg.confirm:
+                return [Anomaly(
+                    self.name,
+                    detail=(f"burn rate {recent['burn_rate']:.2f} over last"
+                            f" {self.cfg.burn_window_s:g}s vs"
+                            f" {overall['burn_rate'] if overall else 0:.2f}"
+                            " all-time"),
+                    severity=self.severity, windows=self._streak,
+                )]
+        else:
+            self._streak = 0
+        return []
+
+
+# ------------------------------------------------------------------ engine --
+
+
+def default_detectors(cfg: AnomalyConfig, source: str = "frontdoor",
+                      names=None) -> list:
+    """Build the selected detector set wired to ``source``-appropriate
+    metric names (``frontdoor`` = the fleet owner's merged registry,
+    ``service`` = a single in-process VerifyService)."""
+    submits = "frontdoor.requests" if source == "frontdoor" else "serve.requests"
+    completions = ("frontdoor.e2e_ms" if source == "frontdoor"
+                   else "serve.stage_ms.total")
+    latency = "serve.wait_ms"  # merged from replicas; the SLO metric
+    builders = {
+        "dead_replica": lambda: DeadReplica(cfg),
+        "probe_stall": lambda: ProbeStall(cfg),
+        "completion_stall": lambda: CompletionStall(cfg, submits, completions),
+        "dead_stage": lambda: DeadStage(cfg, completions),
+        "latency_step": lambda: LatencyStep(cfg, latency),
+        "latency_drift": lambda: LatencyDrift(cfg, latency),
+        "rate_spike": lambda: RateSpike(cfg, submits),
+        "rate_stall": lambda: RateStall(cfg, submits),
+        "burn_accel": lambda: BurnAccel(cfg),
+    }
+    if names is None:
+        names = ALL
+    return [builders[n]() for n in names if n in builders]
+
+
+def detector_names_from_env() -> tuple[str, ...]:
+    raw = os.environ.get("ETH_SPECS_ANOM_DETECTORS", "all").strip().lower()
+    if raw in ("", "all"):
+        return ALL
+    if raw == "structural":
+        return STRUCTURAL
+    if raw == "none":
+        return ()
+    return tuple(n.strip() for n in raw.split(",") if n.strip() in ALL)
+
+
+@dataclass
+class _Fired:
+    anomaly: Anomaly
+    t: float
+    wall: float
+    bundle: str | None = None
+
+    def to_dict(self) -> dict:
+        d = self.anomaly.to_dict()
+        d["t"] = self.t
+        d["unix_time"] = self.wall
+        if self.bundle:
+            d["bundle"] = self.bundle
+        return d
+
+
+class Engine:
+    """Runs the detector set over a SeriesRing, once per telemetry tick;
+    owns refractory suppression, fire accounting, and exemplar capture."""
+
+    def __init__(self, cfg: AnomalyConfig | None = None,
+                 detectors: list | None = None, source: str = "frontdoor",
+                 capture: bool = True):
+        self.cfg = cfg or AnomalyConfig.from_env()
+        self.detectors = (detectors if detectors is not None
+                          else default_detectors(self.cfg, source,
+                                                 detector_names_from_env()))
+        self.capture = capture
+        self.fired: deque[_Fired] = deque(maxlen=256)
+        self._last_fire: dict = {}
+
+    @classmethod
+    def from_env(cls, source: str = "frontdoor", capture: bool = True) -> "Engine":
+        return cls(AnomalyConfig.from_env(), source=source, capture=capture)
+
+    def step(self, ring) -> list[Anomaly]:
+        from eth_consensus_specs_tpu import obs
+
+        samples = ring.last(1)
+        if not samples:
+            return []
+        sample = samples[0]
+        out: list[Anomaly] = []
+        for det in self.detectors:
+            try:
+                found = det.step(sample, ring)
+            except Exception:  # noqa: BLE001 — one bad detector must not kill the tick
+                obs.count("anomaly.errors", 1)
+                continue
+            for a in found or ():
+                key = (a.detector, a.replica, a.stage)
+                last = self._last_fire.get(key)
+                if last is not None and sample.t - last < self.cfg.refractory_s:
+                    obs.count("anomaly.suppressed", 1)
+                    continue
+                self._last_fire[key] = sample.t
+                self._fire(a, sample, ring)
+                out.append(a)
+        return out
+
+    def _fire(self, a: Anomaly, sample, ring) -> None:
+        from eth_consensus_specs_tpu import obs
+
+        obs.count("anomaly.fires", 1)
+        obs.count(f"anomaly.fires.{a.detector}", 1)
+        obs.event("anomaly.fired", **a.to_dict())
+        rec = _Fired(anomaly=a, t=sample.t, wall=time.time())
+        if self.capture:
+            rec.bundle = flight.trigger_dump(
+                f"anomaly.{a.detector}", detail=a.detail,
+                extra={
+                    "anomaly": a.to_dict(),
+                    "series_window": ring.tail_summary(24),
+                    "nearest_traces": nearest_traces(ring),
+                },
+            )
+        self.fired.append(rec)
+
+    # ------------------------------------------------------------ report --
+
+    def fire_counts(self) -> dict:
+        counts: dict = {}
+        for rec in self.fired:
+            counts[rec.anomaly.detector] = counts.get(rec.anomaly.detector, 0) + 1
+        return counts
+
+    def active(self, horizon_s: float = 60.0) -> list[dict]:
+        """Fires within the last ``horizon_s`` seconds — the scoreboard's
+        'active anomalies' panel."""
+        now = time.time()
+        return [rec.to_dict() for rec in self.fired
+                if now - rec.wall <= horizon_s]
+
+    def report(self) -> dict:
+        return {
+            "fires": self.fire_counts(),
+            "total": len(self.fired),
+            "fired": [rec.to_dict() for rec in self.fired],
+        }
+
+
+def nearest_traces(ring, limit: int = 8) -> list[str]:
+    """Most recent distinct trace ids seen in the series window's flight
+    events (newest first) — the exemplar bundle's pivot into the JSONL
+    stream and the Perfetto timeline."""
+    seen: list[str] = []
+    for s in reversed(ring.last(8)):
+        for e in reversed(s.events):
+            tid = e.get("trace_id")
+            if isinstance(tid, str) and tid not in seen:
+                seen.append(tid)
+                if len(seen) >= limit:
+                    return seen
+    if not seen:
+        for e in reversed(flight.ring()):
+            tid = e.get("trace_id")
+            if isinstance(tid, str) and tid not in seen:
+                seen.append(tid)
+                if len(seen) >= limit:
+                    break
+    return seen

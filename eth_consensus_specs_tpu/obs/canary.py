@@ -1,0 +1,324 @@
+"""Known-answer canaries: low-rate requests with precomputed host-oracle
+results, injected through the normal serving front door.
+
+Counters say the fleet is *fast*; only a canary says it is *right*. A
+canary request is a fixed, deterministic payload whose expected result
+was computed ONCE on the host oracle (the same
+``crypto.signature`` / ``ops.kzg_batch`` / watchdog tree-root functions
+the degrade ladder falls back to). The scheduler injects one every
+``interval_s`` through the regular submit verbs — same admission seam
+(exempted), same batcher, same device dispatch, same wire — and
+compares the resolved result **bit-exactly** against the oracle. A
+mismatch is a ``canary.parity`` page-level event plus an exemplar
+bundle, never absorbed, never retried into silence: it means the
+serving path returned a wrong answer while every latency metric looked
+healthy.
+
+Canary shapes (``ETH_SPECS_CANARY_SHAPES``, default ``bls,htr,agg``):
+
+  * ``bls`` — a 3-of-3 valid aggregate signature (keys derived from
+    fixed scalars, signed at build time); expected verdict from
+    ``fast_aggregate_verify``.
+  * ``htr`` — 64 deterministic SSZ chunks; expected root from the
+    watchdog's host tree-root fold.
+  * ``agg`` — 3 valid G2 signatures; expected 96-byte aggregate from
+    ``crypto.signature.aggregate``.
+  * ``kzg`` (opt-in: ``ETH_SPECS_CANARY_SHAPES=all``) — a well-formed
+    blob with an infinity commitment/proof; expected verdict from
+    ``verify_blob_host``. Opt-in because each probe costs a full
+    4096-field-element parse.
+  * ``slot`` is deliberately NOT a canary shape: the slot pipeline is
+    stateful and single-owner — a canary slot would commit state.
+    Slot parity is covered by slot_bench's bit-parity gates and the
+    dedup-replay invariant instead.
+
+The ``canary=True`` flag rides the request end to end (front door →
+wire → replica → service → batcher): canaries are exempt from
+admission shed accounting (a canary must never shed real traffic) and
+excluded from ``serve.requests`` / ``serve.wait_ms`` /
+``frontdoor.e2e_ms`` — so SLO windows, the autoscaler, and bench
+throughput numbers never see them. They live in their own
+``canary.*`` metric family instead.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+
+import numpy as np
+
+from . import flight
+
+DEFAULT_SHAPES = ("bls", "htr", "agg")
+ALL_SHAPES = ("bls", "htr", "agg", "kzg")
+
+
+def shapes_from_env() -> tuple[str, ...]:
+    raw = os.environ.get("ETH_SPECS_CANARY_SHAPES", "").strip().lower()
+    if not raw:
+        return DEFAULT_SHAPES
+    if raw == "all":
+        return ALL_SHAPES
+    return tuple(s.strip() for s in raw.split(",") if s.strip() in ALL_SHAPES)
+
+
+def bits(v) -> bytes:
+    """Canonical byte form for bit-exact comparison across result types
+    (bool verdicts, aggregate bytes, root words)."""
+    if isinstance(v, bool):
+        return b"\x01" if v else b"\x00"
+    if isinstance(v, (bytes, bytearray)):
+        return bytes(v)
+    try:
+        return np.asarray(v).tobytes()
+    except Exception:
+        return repr(v).encode()
+
+
+def _hex(v, limit: int = 96) -> str:
+    h = bits(v).hex()
+    return h if len(h) <= limit else h[:limit] + "..."
+
+
+# ------------------------------------------------------------------ shapes --
+
+
+def _build_bls() -> tuple[tuple, object]:
+    from eth_consensus_specs_tpu.crypto import signature
+
+    message = b"eth-specs-canary/bls/known-answer".ljust(32, b"\x00")[:32]
+    sks = (0x1501, 0x1502, 0x1503)
+    pks = [signature.sk_to_pk(sk) for sk in sks]
+    sig = signature.aggregate([signature.sign(sk, message) for sk in sks])
+    expected = signature.fast_aggregate_verify(pks, message, sig)
+    return (pks, message, sig), expected
+
+
+def _build_htr() -> tuple[tuple, object]:
+    from eth_consensus_specs_tpu.obs.watchdog import host_tree_root_words
+    from eth_consensus_specs_tpu.ops.merkle import _chunks_to_words
+
+    n = 64  # a pow2 subtree: depth 6, one fixed compile bucket
+    chunks = (np.arange(n * 32, dtype=np.uint64) * 131 + 17) % 251
+    chunks = chunks.astype(np.uint8).reshape(n, 32)
+    expected = host_tree_root_words(_chunks_to_words(chunks, n))
+    return (chunks,), expected
+
+
+def _build_agg() -> tuple[tuple, object]:
+    from eth_consensus_specs_tpu.crypto import signature
+
+    sigs = [
+        signature.sign(sk, b"eth-specs-canary/agg/%d" % i)
+        for i, sk in enumerate((0x2501, 0x2502, 0x2503))
+    ]
+    expected = signature.aggregate(list(sigs))
+    return (sigs,), expected
+
+
+def _build_kzg() -> tuple[tuple, object]:
+    from eth_consensus_specs_tpu.ops.kzg_batch import verify_blob_host
+
+    # 4096 field elements, each with a zero top byte so every one is
+    # canonical; commitment/proof are the compressed point at infinity —
+    # a structurally valid input whose verdict the oracle decides
+    fe = bytearray((i * 31 + 7) % 256 for i in range(4096 * 32))
+    for i in range(0, len(fe), 32):
+        fe[i] = 0
+    blob = bytes(fe)
+    commitment = b"\xc0" + b"\x00" * 47
+    proof = b"\xc0" + b"\x00" * 47
+    expected = verify_blob_host(blob, commitment, proof)
+    return (blob, commitment, proof), expected
+
+
+_BUILDERS = {
+    "bls": _build_bls,
+    "htr": _build_htr,
+    "agg": _build_agg,
+    "kzg": _build_kzg,
+}
+
+
+def warm_keys(shapes=None) -> list[tuple]:
+    """Unsigned compile/bucket keys the canary stream can touch. At most
+    one canary is ever in flight, so its flush-group size is always 1 —
+    item/batch buckets are fixed at 1 and the lane/depth axes are the
+    builders' constants. Benches and fleets add these to their warmup
+    keys so injecting canaries never trips a zero-cold-compile gate."""
+    from eth_consensus_specs_tpu.serve import buckets
+
+    out: list[tuple] = []
+    for kind in (shapes if shapes is not None else shapes_from_env()):
+        if kind == "htr":
+            out.append(("merkle_many", 1, 6))  # 64 chunks = depth 6
+        elif kind == "bls":
+            out.append(("bls_msm", 1, buckets.pow2_bucket(3)))
+        elif kind == "agg":
+            out.append(("g2_agg", 1, buckets.agg_lane_bucket(3)))
+        elif kind == "kzg":
+            from eth_consensus_specs_tpu.ops.kzg_batch import N_BLOB
+
+            # a 1-blob flush touches BOTH kzg seam kernels: the RLC
+            # multi-MSM and the batched inverse FFT
+            out.append(("kzg", buckets.kzg_lane_bucket(1)))
+            out.append(buckets.fr_fft_key_from_profile(1, N_BLOB))
+    return out
+
+
+# --------------------------------------------------------------- scheduler --
+
+
+class CanaryScheduler:
+    """Tick-driven injector: at most one canary in flight, one sent per
+    ``interval_s``, cycling the configured shapes. ``pump()`` is called
+    from the front-door supervisor tick (or a bench loop) — it never
+    blocks: sends go through the client's async submit verbs and
+    completed futures are reaped on a later pump.
+
+    ``client`` is anything with the four submit verbs accepting
+    ``canary=True`` — a ``FrontDoorClient`` or an in-process
+    ``VerifyService``.
+    """
+
+    def __init__(self, client, interval_s: float = 2.0, timeout_s: float = 10.0,
+                 shapes=None):
+        self.client = client
+        self.interval_s = float(interval_s)
+        self.timeout_s = float(timeout_s)
+        self.shapes = list(shapes if shapes is not None else shapes_from_env())
+        self.sent = 0
+        self.ok = 0
+        self.parity_failures = 0
+        self.errors = 0
+        self._specs: dict = {}
+        self._idx = 0
+        self._pending = None  # (kind, future, expected, t_sent)
+        self._next_t = time.monotonic() + self.interval_s
+
+    # ------------------------------------------------------------- pump --
+
+    def pump(self, now: float | None = None) -> None:
+        now = time.monotonic() if now is None else now
+        self._reap(now)
+        if self._pending is None and self.shapes and now >= self._next_t:
+            self._send(now)
+
+    def drain(self, timeout_s: float = 10.0) -> None:
+        """Bench epilogue: wait for the in-flight canary (if any) so the
+        run's pass-rate covers every canary it sent."""
+        deadline = time.monotonic() + timeout_s
+        while self._pending is not None and time.monotonic() < deadline:
+            self._reap(time.monotonic())
+            if self._pending is not None:
+                time.sleep(0.02)
+
+    # ------------------------------------------------------------- send --
+
+    def _spec(self, kind: str) -> tuple[tuple, object]:
+        spec = self._specs.get(kind)
+        if spec is None:
+            spec = self._specs[kind] = _BUILDERS[kind]()
+        return spec
+
+    def _send(self, now: float) -> None:
+        from eth_consensus_specs_tpu import obs
+
+        kind = self.shapes[self._idx % len(self.shapes)]
+        self._idx += 1
+        self._next_t = now + self.interval_s
+        try:
+            payload, expected = self._spec(kind)
+            fut = self._submit(kind, payload)
+        except Exception as exc:  # noqa: BLE001 — a shed/closed client is an error, not parity
+            self.errors += 1
+            obs.count("canary.errors", 1)
+            obs.event("canary.error", shape=kind, err=repr(exc)[:160])
+            self._gauge()
+            return
+        self.sent += 1
+        obs.count("canary.sent", 1)
+        obs.count(f"canary.sent.{kind}", 1)
+        self._pending = (kind, fut, expected, now)
+
+    def _submit(self, kind: str, payload: tuple):
+        if kind == "bls":
+            return self.client.submit_bls_aggregate(*payload, canary=True)
+        if kind == "htr":
+            return self.client.submit_hash_tree_root(*payload, canary=True)
+        if kind == "agg":
+            return self.client.submit_aggregate(*payload, canary=True)
+        if kind == "kzg":
+            return self.client.submit_blob_verify(*payload, canary=True)
+        raise ValueError(f"unknown canary shape {kind!r}")
+
+    # ------------------------------------------------------------- reap --
+
+    def _reap(self, now: float) -> None:
+        from eth_consensus_specs_tpu import obs
+
+        if self._pending is None:
+            return
+        kind, fut, expected, t0 = self._pending
+        if fut.done():
+            self._pending = None
+            try:
+                result = fut.result()
+            except Exception as exc:  # noqa: BLE001 — errored canary: degraded, not wrong
+                self.errors += 1
+                obs.count("canary.errors", 1)
+                obs.event("canary.error", shape=kind, err=repr(exc)[:160])
+                self._gauge()
+                return
+            if bits(result) == bits(expected):
+                self.ok += 1
+                obs.count("canary.ok", 1)
+            else:
+                # the page: the serving path returned DIFFERENT BITS than
+                # the host oracle for a known-answer request
+                self.parity_failures += 1
+                obs.count("canary.parity_failures", 1)
+                obs.event(
+                    "canary.parity", shape=kind, severity="page",
+                    expected=_hex(expected), got=_hex(result),
+                )
+                flight.trigger_dump(
+                    "canary.parity",
+                    detail=f"canary {kind} bit-mismatch vs host oracle",
+                    extra={
+                        "kind": kind,
+                        "expected": _hex(expected, 256),
+                        "got": _hex(result, 256),
+                    },
+                )
+            self._gauge()
+        elif now - t0 > self.timeout_s:
+            self._pending = None
+            self.errors += 1
+            obs.count("canary.errors", 1)
+            obs.event("canary.timeout", shape=kind, waited_s=round(now - t0, 3))
+            self._gauge()
+
+    def _gauge(self) -> None:
+        from eth_consensus_specs_tpu import obs
+
+        rate = self.pass_rate()
+        if rate is not None:
+            obs.gauge("canary.pass_rate", rate)
+
+    # ------------------------------------------------------------ report --
+
+    def pass_rate(self) -> float | None:
+        done = self.ok + self.parity_failures + self.errors
+        return (self.ok / done) if done else None
+
+    def stats(self) -> dict:
+        return {
+            "shapes": list(self.shapes),
+            "sent": self.sent,
+            "ok": self.ok,
+            "parity_failures": self.parity_failures,
+            "errors": self.errors,
+            "pass_rate": self.pass_rate(),
+        }

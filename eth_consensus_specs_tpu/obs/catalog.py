@@ -237,6 +237,24 @@ CATALOG: tuple[Metric, ...] = (
     _c("slo.windows", "supervision probe windows with wait samples"),
     _c("slo.windows_breached",
        "probe windows whose window-local wait p99 breached the objective"),
+    # --------------------------------------------- continuous telemetry --
+    _c("tsdb.samples", "telemetry windows folded into the series ring"),
+    _c("telemetry.errors", "guarded telemetry-tick failures (never fatal)"),
+    _c("anomaly.fires", "anomalies fired (post refractory suppression)"),
+    _c("anomaly.fires.*", "anomaly fires per detector"),
+    _c("anomaly.suppressed", "anomalies suppressed by the refractory window"),
+    _c("anomaly.errors", "detector step exceptions swallowed"),
+    _c("canary.sent", "known-answer canary requests injected"),
+    _c("canary.sent.*", "canary sends per shape (bls/htr/agg/kzg)"),
+    _c("canary.ok", "canaries whose result matched the host oracle bit-exactly"),
+    _c("canary.parity_failures",
+       "canaries whose result MISMATCHED the host oracle (page-level)"),
+    _c("canary.errors", "canaries that errored or timed out (degraded, not wrong)"),
+    _c("canary.requests", "canary submits through the service pipeline"),
+    _c("canary.host_served", "canaries absorbed by the front-door host oracle"),
+    _g("canary.pass_rate", "ok / completed canaries, cumulative"),
+    _h("canary.wait_ms", "canary wait from submit to flush, ms"),
+    _h("canary.e2e_ms", "canary front-door end-to-end latency, ms"),
     # ---------------------------------------------------------- watchdog --
     _c("watchdog.checks", "device/host divergence probes"),
     _c("watchdog.divergences", "device/host mismatches"),

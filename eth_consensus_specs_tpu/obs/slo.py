@@ -34,9 +34,30 @@ warmup phase; absent in runs without a warmup notion → passes).
 from __future__ import annotations
 
 import os
+import threading
+import time
+from collections import deque
 from dataclasses import dataclass
 
 from .histogram import Histogram
+
+# timestamped window verdicts behind the burn-rate advisory: the
+# counters alone can't support a time cap (one ancient breached window
+# would dominate forever), so note_window() keeps a bounded in-process
+# record of (monotonic t, breached) per evaluated window
+_WINDOWS_CAP = 4096
+_WINDOWS_LOCK = threading.Lock()
+_WINDOWS: deque = deque(maxlen=_WINDOWS_CAP)
+
+
+def _reinit_lock_after_fork_in_child() -> None:
+    # same idiom as obs/flight.py: a supervisor thread may hold the
+    # record lock at fork time; the child is single-threaded here
+    global _WINDOWS_LOCK
+    _WINDOWS_LOCK = threading.Lock()
+
+
+os.register_at_fork(after_in_child=_reinit_lock_after_fork_in_child)
 
 
 @dataclass(frozen=True)
@@ -167,19 +188,59 @@ def report(results: list[SLOResult]) -> dict:
     }
 
 
-def burn_rate(snap: dict | None = None) -> dict | None:
+def note_window(breached: bool, t: float | None = None) -> None:
+    """Record one evaluated supervision window's verdict: bumps the
+    ``slo.windows[_breached]`` counters AND appends a timestamped record
+    so :func:`burn_rate` can answer time-capped queries. The front door
+    supervisor calls this once per probe window with traffic
+    (frontdoor._burn_step)."""
+    from .registry import get_registry
+
+    reg = get_registry()
+    reg.count("slo.windows", 1)
+    if breached:
+        reg.count("slo.windows_breached", 1)
+    with _WINDOWS_LOCK:
+        _WINDOWS.append((time.monotonic() if t is None else t, bool(breached)))
+
+
+def reset_windows_for_tests() -> None:
+    with _WINDOWS_LOCK:
+        _WINDOWS.clear()
+
+
+def burn_rate(snap: dict | None = None, window_s: float | None = None) -> dict | None:
     """Windowed burn-rate advisory: the fraction of supervision probe
     windows (with traffic) whose window-local wait p99 breached the
-    objective. The front door supervisor bumps ``slo.windows`` /
-    ``slo.windows_breached`` per window (frontdoor._burn_step); this
-    just reads the counters from ``snap`` (default: live registry).
+    objective (recorded via :func:`note_window`).
 
-    Returns ``{"windows", "breached", "burn_rate"}`` or None when no
-    window was ever evaluated (no supervisor, or an idle run). A p99
+    With ``window_s=None`` this reads the cumulative ``slo.windows`` /
+    ``slo.windows_breached`` counters from ``snap`` (default: live
+    registry) — the whole-run advisory. With ``window_s`` set, only
+    windows recorded within the last ``window_s`` seconds count, so one
+    ancient breached window can't dominate the advisory forever; this
+    uses the live in-process records and therefore ignores ``snap``
+    (a loaded report has no timestamps to cap by).
+
+    Returns ``{"windows", "breached", "burn_rate"}`` (plus
+    ``"window_s"`` when capped) or None when no window qualifies. A p99
     SLO that only breaches at the end of a long run looks fine in the
     run-wide histogram; the burn rate says how much of the RUN was
     spent out of budget. Advisory, never gating — perf_track ingests
     it as a secondary (lower is better)."""
+    if window_s is not None:
+        cutoff = time.monotonic() - float(window_s)
+        with _WINDOWS_LOCK:
+            records = [b for (t, b) in _WINDOWS if t >= cutoff]
+        if not records:
+            return None
+        breached = sum(1 for b in records if b)
+        return {
+            "windows": len(records),
+            "breached": breached,
+            "burn_rate": round(breached / len(records), 6),
+            "window_s": float(window_s),
+        }
     if snap is None:
         from .registry import get_registry
 

@@ -1,0 +1,216 @@
+"""In-process metric time-series: a bounded ring of timestamped windows.
+
+Everything the registry holds is cumulative — counters only grow,
+histograms only accumulate — which answers "what happened since boot"
+but not "what is happening NOW". The continuous-telemetry plane
+(docs/observability.md#continuous-telemetry) needs the latter: anomaly
+detectors (obs/anomaly.py) reason about the last few minutes, and the
+fleet scoreboard (scripts/obs_top.py) draws sparklines from per-window
+deltas.
+
+This module turns the existing :class:`~.delta.DeltaShipper` machinery
+into a time series. Each supervisor probe tick the sampler takes one
+delta — exactly the shipping unit replicas already produce — and folds
+it into a :class:`Sample`:
+
+  * ``counters`` — raw per-window counter increments, and ``rates``
+    (increments / window seconds);
+  * ``gauges`` — the ``{last, max}`` levels that changed this window;
+  * ``hists`` — histogram **bucket deltas** for the window, so a
+    window-local p99 comes from :meth:`Histogram.from_snapshot
+    <..obs.histogram.Histogram.from_snapshot>` over just this window's
+    samples (no cumulative smearing);
+  * ``events`` — the flight-ring entries shipped in the window (the
+    anomaly engine reads replica-death breadcrumbs and nearby trace ids
+    straight from here).
+
+The ring is bounded (``ETH_SPECS_OBS_TSDB_RING`` samples, default 600 —
+two minutes at the default 200 ms probe interval) and entirely
+in-process: nothing is written to disk, nothing leaves the process
+except via an exemplar bundle when a detector fires.
+
+The sampler must own its OWN shipper (the ``_slo_shipper`` /
+``_burn_shipper`` precedent in serve/frontdoor.py): shippers are
+per-consumer cursors, and sharing one would steal windows from the SLO
+evaluator.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from collections import deque
+
+from .delta import DeltaShipper
+from .histogram import Histogram
+
+_DEFAULT_RING = 600
+
+
+def ring_capacity_from_env() -> int:
+    raw = os.environ.get("ETH_SPECS_OBS_TSDB_RING", "")
+    try:
+        n = int(raw) if raw else _DEFAULT_RING
+    except ValueError:
+        n = _DEFAULT_RING
+    return max(n, 2)
+
+
+def enabled_from_env() -> bool:
+    return os.environ.get("ETH_SPECS_OBS_TSDB", "1") not in ("0", "false", "")
+
+
+class Sample:
+    """One timestamped telemetry window (all fields plain JSON-ables)."""
+
+    __slots__ = ("t", "dt", "counters", "rates", "gauges", "hists", "events")
+
+    def __init__(self, t, dt, counters=None, rates=None, gauges=None,
+                 hists=None, events=None):
+        self.t = float(t)
+        self.dt = float(dt)
+        self.counters = counters or {}
+        self.rates = rates or {}
+        self.gauges = gauges or {}
+        self.hists = hists or {}
+        self.events = events or []
+
+    def hist_count(self, name: str) -> int:
+        h = self.hists.get(name)
+        return int(h.get("count", 0)) if h else 0
+
+    def quantile(self, name: str, q: float) -> float | None:
+        """Window-local quantile from this window's bucket deltas."""
+        h = self.hists.get(name)
+        if not h or not h.get("count"):
+            return None
+        return Histogram.from_snapshot(h).quantile(q)
+
+    def summary(self) -> dict:
+        """Compact JSON view for exemplar bundles: everything except the
+        raw bucket arrays (replaced by count/p99 per histogram)."""
+        hists = {}
+        for name, h in self.hists.items():
+            if not h.get("count"):
+                continue
+            hh = Histogram.from_snapshot(h)
+            hists[name] = {
+                "count": h["count"],
+                "sum": round(h.get("sum", 0.0), 3),
+                "p99": hh.quantile(0.99),
+            }
+        return {
+            "t": self.t,
+            "dt": round(self.dt, 6),
+            "counters": dict(self.counters),
+            "rates": {k: round(v, 3) for k, v in self.rates.items()},
+            "gauges": self.gauges,
+            "hists": hists,
+        }
+
+
+class SeriesRing:
+    """Bounded ring of :class:`Sample` windows, oldest first."""
+
+    def __init__(self, capacity: int | None = None):
+        self._ring: deque[Sample] = deque(maxlen=capacity or ring_capacity_from_env())
+
+    def __len__(self) -> int:
+        return len(self._ring)
+
+    @property
+    def capacity(self) -> int:
+        return self._ring.maxlen or 0
+
+    def append(self, sample: Sample) -> Sample:
+        self._ring.append(sample)
+        return sample
+
+    def samples(self) -> list[Sample]:
+        return list(self._ring)
+
+    def last(self, n: int) -> list[Sample]:
+        if n <= 0:
+            return []
+        return list(self._ring)[-n:]
+
+    def span_s(self) -> float:
+        """Wall seconds the ring currently covers."""
+        if len(self._ring) < 2:
+            return 0.0
+        return self._ring[-1].t - self._ring[0].t
+
+    # ---------------------------------------------------------- series --
+
+    def rate_series(self, name: str) -> list[tuple[float, float]]:
+        return [(s.t, s.rates.get(name, 0.0)) for s in self._ring]
+
+    def counter_series(self, name: str) -> list[tuple[float, float]]:
+        return [(s.t, s.counters.get(name, 0)) for s in self._ring]
+
+    def gauge_series(self, name: str) -> list[tuple[float, float]]:
+        """Gauge ``last`` levels, carried forward across windows where
+        the gauge did not change (deltas only ship changes)."""
+        out: list[tuple[float, float]] = []
+        level: float | None = None
+        for s in self._ring:
+            g = s.gauges.get(name)
+            if g is not None:
+                level = g.get("last") if isinstance(g, dict) else g
+            if level is not None:
+                out.append((s.t, float(level)))
+        return out
+
+    def quantile_series(self, name: str, q: float) -> list[tuple[float, float]]:
+        """Window-local quantiles for one histogram; windows with no
+        samples are skipped (a quiet window has no latency, not zero)."""
+        out: list[tuple[float, float]] = []
+        for s in self._ring:
+            v = s.quantile(name, q)
+            if v is not None:
+                out.append((s.t, v))
+        return out
+
+    def tail_summary(self, n: int = 32) -> list[dict]:
+        """The last ``n`` windows as compact dicts — the 'triggering
+        series window' section of an anomaly exemplar bundle."""
+        return [s.summary() for s in self.last(n)]
+
+
+def sample_from_delta(delta: dict, t: float, dt: float) -> Sample:
+    """Fold one DeltaShipper delta into a timestamped window sample."""
+    dt = max(float(dt), 1e-9)
+    counters = dict(delta.get("counters", {}))
+    return Sample(
+        t=t,
+        dt=dt,
+        counters=counters,
+        rates={k: v / dt for k, v in counters.items()},
+        gauges=dict(delta.get("gauges", {})),
+        hists=dict(delta.get("histograms", {})),
+        events=list(delta.get("flight", ())),
+    )
+
+
+class Sampler:
+    """Owns a delta cursor + ring; one :meth:`sample` per probe tick.
+
+    ``swallow_initial`` (the shipper default) applies: the first sample
+    covers construction → first tick only, so boot churn from before the
+    telemetry plane existed never lands in the series.
+    """
+
+    def __init__(self, capacity: int | None = None, shipper: DeltaShipper | None = None):
+        self.ring = SeriesRing(capacity)
+        self._shipper = shipper or DeltaShipper()
+        self._last_t = time.monotonic()
+
+    def sample(self, t: float | None = None) -> Sample:
+        from eth_consensus_specs_tpu import obs
+
+        t = time.monotonic() if t is None else t
+        dt = max(t - self._last_t, 1e-9)
+        self._last_t = t
+        s = self.ring.append(sample_from_delta(self._shipper.delta(), t, dt))
+        obs.count("tsdb.samples", 1)
+        return s

@@ -52,6 +52,11 @@ class Request:
     # waterfall stamp vector (obs/waterfall.py): monotonic marks written
     # at each pipeline boundary, folded into serve.stage_ms.* at resolve
     stamps: dict = field(default_factory=dict)
+    # known-answer canary (obs/canary.py): rides the normal pipeline but
+    # is exempt from admission accounting and excluded from the SLO-fed
+    # serve.requests / serve.wait_ms stats — a canary must never shed
+    # real traffic or move the latency objectives
+    canary: bool = False
 
 
 class MicroBatcher:

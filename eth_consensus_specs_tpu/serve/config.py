@@ -48,6 +48,13 @@ Replicated front door (serve/frontdoor.py):
     ETH_SPECS_SERVE_FD_CONCURRENCY=16 front-door dispatcher threads
     ETH_SPECS_SERVE_SLO_SHED=1        0: disable SLO-driven admission
                                       resizing (static caps only)
+    ETH_SPECS_CANARY_MS=0             >0: inject one known-answer canary
+                                      request (obs/canary.py) every this
+                                      many ms through the normal front
+                                      door; 0 = canaries off
+    ETH_SPECS_CANARY_TIMEOUT_S=10     a canary unresolved past this is
+                                      counted canary.errors (degraded,
+                                      not a parity failure)
 
 Two-tier fleet (heterogeneous replicas × mesh, docs/serving.md
 "Two-tier scale-out"):
@@ -256,6 +263,10 @@ class FrontDoorConfig:
     slo_shedding: bool = True
     # SLO shedding never shrinks the effective admission cap below this
     min_queue: int = 8
+    # known-answer canary injection (obs/canary.py): interval between
+    # canary sends (0 = off) and the unresolved-canary timeout
+    canary_interval_ms: float = 0.0
+    canary_timeout_s: float = 10.0
     # per-replica mesh-chip cycle: replica i owns chips_matrix[i % len]
     # devices (empty = every replica inherits ServeConfig.mesh_chips /
     # ETH_SPECS_SERVE_CHIPS) — the heterogeneous two-tier fleet
@@ -288,6 +299,12 @@ class FrontDoorConfig:
                 "ETH_SPECS_SERVE_DRAINING_TTL_S", cls.draining_ttl_s
             ),
             slo_shedding=os.environ.get("ETH_SPECS_SERVE_SLO_SHED", "1") != "0",
+            canary_interval_ms=_env_float(
+                "ETH_SPECS_CANARY_MS", cls.canary_interval_ms
+            ),
+            canary_timeout_s=_env_float(
+                "ETH_SPECS_CANARY_TIMEOUT_S", cls.canary_timeout_s
+            ),
             chips_matrix=matrix,
             autoscale=os.environ.get("ETH_SPECS_SERVE_AUTOSCALE") == "1",
             min_replicas=_env_int("ETH_SPECS_SERVE_MIN_REPLICAS", cls.min_replicas),
@@ -322,6 +339,10 @@ class FrontDoorConfig:
     @property
     def down_cooldown_s(self) -> float:
         return self.down_cooldown_ms / 1000.0
+
+    @property
+    def canary_interval_s(self) -> float:
+        return self.canary_interval_ms / 1000.0
 
 
 def serve_enabled() -> bool:

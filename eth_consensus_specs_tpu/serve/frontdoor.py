@@ -53,7 +53,9 @@ stitch across the process boundary in the shared JSONL stream.
 from __future__ import annotations
 
 import dataclasses
+import json
 import multiprocessing
+import os
 import threading
 import time
 from collections import deque
@@ -63,8 +65,10 @@ import numpy as np
 
 from eth_consensus_specs_tpu import fault, obs
 from eth_consensus_specs_tpu.analysis import lockwatch
-from eth_consensus_specs_tpu.obs import export, flight, slo, trace
+from eth_consensus_specs_tpu.obs import anomaly, export, flight, slo, trace, tsdb
+from eth_consensus_specs_tpu.obs.canary import CanaryScheduler
 from eth_consensus_specs_tpu.obs.delta import DeltaShipper, merge_delta
+from eth_consensus_specs_tpu.obs.waterfall import STAGE_NAMES
 
 from . import buckets, wire
 from .admission import AdmissionController, Overloaded
@@ -76,10 +80,11 @@ from .router import Router
 class _FDRequest:
     __slots__ = (
         "kind", "payload", "shape_key", "cost_bytes", "future",
-        "trace", "t_submit", "released", "hedged", "wide",
+        "trace", "t_submit", "released", "hedged", "wide", "canary",
     )
 
-    def __init__(self, kind, payload, shape_key, cost_bytes, wide=None):
+    def __init__(self, kind, payload, shape_key, cost_bytes, wide=None,
+                 canary=False):
         self.kind = kind
         self.payload = payload
         self.shape_key = shape_key
@@ -90,6 +95,9 @@ class _FDRequest:
         self.released = False  # admission slot handed back (exactly once)
         self.hedged = False  # at most one hedge per request
         self.wide = wide  # mesh-tier preference (buckets.route_wide)
+        # known-answer canary (obs/canary.py): exempt from admission
+        # accounting and excluded from the SLO-fed latency stats
+        self.canary = canary
 
 
 def _host_execute(kind: str, payload):
@@ -162,35 +170,48 @@ class FrontDoorClient:
 
     # ------------------------------------------------------------- submit --
 
-    def _submit(self, kind, payload, shape_key, cost_bytes) -> Future:
+    def _submit(self, kind, payload, shape_key, cost_bytes, canary=False) -> Future:
         if self._closed:
             raise RuntimeError(f"front door {self.name} is shut down")
-        self.admission.admit(cost_bytes)
+        if not canary:
+            # a canary must never shed real traffic: it bypasses the
+            # admission seam entirely (and therefore never releases)
+            self.admission.admit(cost_bytes)
         # mesh-tier classification (serve/buckets.route_wide): big
         # flushes belong on mesh-sliced replicas, toy flushes on narrow
         # ones — the signature-aware half of the routing key
         wide = buckets.route_wide(kind, shape_key[1], self.config.max_batch)
-        req = _FDRequest(kind, payload, shape_key, cost_bytes, wide=wide)
+        req = _FDRequest(kind, payload, shape_key, cost_bytes, wide=wide,
+                         canary=canary)
         try:
             self._pool.submit(self._dispatch, req)
         except RuntimeError:
             # close() raced the admit: nothing will ever dispatch this
             # request, so its admission slot must be handed back here
             req.released = True
-            self.admission.release(cost_bytes)
+            if not canary:
+                self.admission.release(cost_bytes)
             raise RuntimeError(f"front door {self.name} is shut down") from None
-        obs.count("frontdoor.requests", 1)
-        obs.count(f"frontdoor.requests.{kind}", 1)
+        if not canary:
+            # canaries live in the canary.* family (obs/canary.py counts
+            # sends) so throughput and SLO windows never see them
+            obs.count("frontdoor.requests", 1)
+            obs.count(f"frontdoor.requests.{kind}", 1)
         return req.future
 
-    def submit_bls_aggregate(self, pubkeys: list, message: bytes, signature: bytes) -> Future:
+    def submit_bls_aggregate(self, pubkeys: list, message: bytes, signature: bytes,
+                             canary: bool = False) -> Future:
         pks = [bytes(p) for p in pubkeys]
         payload = (pks, bytes(message), bytes(signature))
         cost = 48 * len(pks) + len(payload[1]) + len(payload[2])
         # affinity by the MSM compile shape: the pow2 committee bucket
-        return self._submit("bls", payload, ("bls_msm", buckets.pow2_bucket(max(len(pks), 1))), cost)
+        return self._submit(
+            "bls", payload,
+            ("bls_msm", buckets.pow2_bucket(max(len(pks), 1))), cost,
+            canary=canary,
+        )
 
-    def submit_aggregate(self, signatures: list) -> Future:
+    def submit_aggregate(self, signatures: list, canary: bool = False) -> Future:
         """Aggregate compressed G2 signatures through the fleet;
         resolves to the exact bytes ``crypto.signature.aggregate``
         returns. Pure function of its inputs, so hedging/failover are
@@ -202,9 +223,11 @@ class FrontDoorClient:
             "agg", (sigs,),
             ("g2_agg", buckets.pow2_bucket(max(len(sigs), 1))),
             96 * max(len(sigs), 1),
+            canary=canary,
         )
 
-    def submit_blob_verify(self, blob: bytes, commitment: bytes, proof: bytes) -> Future:
+    def submit_blob_verify(self, blob: bytes, commitment: bytes, proof: bytes,
+                           canary: bool = False) -> Future:
         """Blob KZG verification through the fleet; resolves to the
         exact bool ``ops.kzg_batch.verify_blob_host`` returns. Pure
         function of its inputs, so hedging/failover are safe — same
@@ -215,15 +238,19 @@ class FrontDoorClient:
             "kzg", payload,
             ("kzg", buckets.kzg_lane_bucket(1)),
             sum(len(b) for b in payload),
+            canary=canary,
         )
 
-    def submit_hash_tree_root(self, chunks: np.ndarray) -> Future:
+    def submit_hash_tree_root(self, chunks: np.ndarray, canary: bool = False) -> Future:
         chunks = np.ascontiguousarray(chunks)
         if chunks.ndim != 2 or chunks.shape[1] != 32 or chunks.dtype != np.uint8:
             raise ValueError("chunks must be uint8[N, 32]")
         depth = buckets.subtree_depth(chunks.shape[0])
         # affinity by tree depth: depth is the intrinsic compile axis
-        return self._submit("htr", (chunks, depth), ("merkle_many", depth), int(chunks.nbytes))
+        return self._submit(
+            "htr", (chunks, depth), ("merkle_many", depth), int(chunks.nbytes),
+            canary=canary,
+        )
 
     def submit_slot(self, req) -> Future:
         """Whole-slot state transition through the fleet; resolves to
@@ -372,10 +399,16 @@ class FrontDoorClient:
             )
             return
         # the last rung of the ladder: no replica can serve this, so the
-        # front door computes it host-side, bit-identically
-        obs.count("frontdoor.degraded_to_host", 1)
-        obs.count("serve.degraded_items", 1)
-        obs.event("frontdoor.degraded_to_host", req_kind=req.kind)
+        # front door computes it host-side, bit-identically. A canary
+        # answered here proved nothing about the fleet (the oracle is
+        # comparing against itself) — it counts in its own family and
+        # never inflates the degraded-rate SLO numerator
+        if req.canary:
+            obs.count("canary.host_served", 1)
+        else:
+            obs.count("frontdoor.degraded_to_host", 1)
+            obs.count("serve.degraded_items", 1)
+            obs.event("frontdoor.degraded_to_host", req_kind=req.kind)
         self._resolve(req, value=_host_execute(req.kind, req.payload))
 
     def _dispatch_slot(self, req: _FDRequest) -> None:
@@ -438,6 +471,8 @@ class FrontDoorClient:
             "payload": req.payload,
             "trace": trace.to_wire(req.trace),
         }
+        if req.canary:
+            msg["canary"] = True
         deadline = self.fdcfg.hedge_s if hedge_allowed and not req.hedged else None
         on_deadline = (lambda: self._start_hedge(req, idx)) if deadline else None
         for _ in range(3):
@@ -512,9 +547,15 @@ class FrontDoorClient:
             obs.count("frontdoor.duplicates_suppressed", 1)
             return False
         e2e_s = time.monotonic() - req.t_submit
-        self.admission.release(req.cost_bytes, service_s=e2e_s)
-        obs.observe("frontdoor.e2e_ms", e2e_s * 1e3)
-        if stages:
+        if req.canary:
+            # never admitted → nothing to release; latency lands in the
+            # canary.* family so SLO windows and the autoscaler's merged
+            # e2e stats stay canary-blind
+            obs.observe("canary.e2e_ms", e2e_s * 1e3)
+        else:
+            self.admission.release(req.cost_bytes, service_s=e2e_s)
+            obs.observe("frontdoor.e2e_ms", e2e_s * 1e3)
+        if stages and not req.canary:
             # the replica shipped this request's per-stage DURATIONS in
             # its reply (serve/replica.py). Its own stage histograms
             # arrive via the obs delta — re-observing them here would
@@ -539,6 +580,8 @@ class FrontDoorClient:
             "ok": exc is None,
             "hedged": req.hedged,
         }
+        if req.canary:
+            done["canary"] = True
         if exc is not None:
             done["err"] = type(exc).__name__
         if stages:
@@ -758,6 +801,28 @@ class FrontDoor(FrontDoorClient):
         # child never starts one — ETH_SPECS_OBS_HTTP_PORT is popped
         # from its env by replica_main's child setup)
         export.maybe_serve_http()
+        # the continuous-telemetry plane (docs/observability.md
+        # #continuous-telemetry): a tsdb sampler turns each probe window's
+        # merged delta into a ring sample, the anomaly engine watches the
+        # ring, and the canary scheduler injects known-answer requests
+        # through the NORMAL front-door path. Each piece is independently
+        # env-gated; all run on the existing supervisor tick.
+        self._tele_sampler = (
+            tsdb.Sampler(tsdb.ring_capacity_from_env())
+            if tsdb.enabled_from_env() else None
+        )
+        self._anomaly = (
+            anomaly.Engine.from_env(source="frontdoor")
+            if self._tele_sampler is not None else None
+        )
+        self._canary = (
+            CanaryScheduler(
+                self, interval_s=fd_config.canary_interval_s,
+                timeout_s=fd_config.canary_timeout_s,
+            )
+            if fd_config.canary_interval_ms > 0 else None
+        )
+        self._scoreboard_path = os.environ.get("ETH_SPECS_OBS_SCOREBOARD") or None
         self._supervisor = threading.Thread(
             target=self._supervise, daemon=True, name=f"{name}-supervisor"
         )
@@ -878,6 +943,7 @@ class FrontDoor(FrontDoorClient):
             if self.fdcfg.slo_shedding or self.fdcfg.autoscale:
                 self._slo_step()
             self._burn_step()
+            self._telemetry_step()
 
     def _note_clock_sync(
         self, i: int, resp: dict, t_send: float, t_recv: float,
@@ -918,9 +984,94 @@ class FrontDoor(FrontDoorClient):
             window,
             [s for s in slo.default_slos() if s.name == "serve_wait_p99"],
         )
-        obs.count("slo.windows", 1)
-        if not slo.passed(results):
-            obs.count("slo.windows_breached", 1)
+        # one timestamped verdict per traffic window: the counters feed
+        # the whole-run advisory, the timestamp feeds the windowed
+        # burn_rate(window_s=...) cap the burn_accel detector reads
+        slo.note_window(not slo.passed(results))
+
+    # ----------------------------------------------------------- telemetry --
+
+    def _telemetry_step(self) -> None:
+        """One continuous-telemetry tick, on the supervisor cadence:
+        pump the canary scheduler (send/reap known-answer probes), fold
+        this window's merged delta into the series ring, run the anomaly
+        detectors over it, and refresh the scoreboard file. Guarded —
+        telemetry must never take the supervision loop down."""
+        try:
+            if self._canary is not None:
+                self._canary.pump()
+            if self._tele_sampler is not None:
+                self._tele_sampler.sample()
+                if self._anomaly is not None:
+                    self._anomaly.step(self._tele_sampler.ring)
+            self._write_scoreboard()
+        except Exception:  # noqa: BLE001 — observability, not control
+            obs.count("telemetry.errors", 1)
+
+    def scoreboard(self) -> dict:
+        """One-screen fleet view (scripts/obs_top.py renders it):
+        per-replica health, stage-p99 sparkline series, canary pass
+        rate, and active anomalies."""
+        board = {
+            "unix_time": time.time(),
+            "name": self._fd_name,
+            "replicas": [],
+            "canary": self._canary.stats() if self._canary is not None else None,
+            "anomalies": (self._anomaly.active() if self._anomaly is not None
+                          else []),
+            "anomaly_fires": (self._anomaly.fire_counts()
+                              if self._anomaly is not None else {}),
+            "burn": slo.burn_rate(window_s=60.0),
+            "queue_depth": self.admission.depth(),
+            "effective_max_queue": self.admission.max_queue,
+        }
+        router = self.router.snapshot()  # index-ordered, like _procs
+        for i in range(len(self._procs)):
+            if self._retired[i]:
+                continue
+            proc = self._procs[i]
+            board["replicas"].append({
+                "replica": i,
+                "alive": bool(proc is not None and proc.is_alive()),
+                "restarting": self._restarting[i],
+                "health": self._health[i],
+                "router": router[i] if i < len(router) else None,
+            })
+        if self._tele_sampler is not None:
+            ring = self._tele_sampler.ring
+            board["span_s"] = round(ring.span_s(), 1)
+            board["series"] = {
+                "rps": [v for _, v in ring.rate_series("frontdoor.requests")[-48:]],
+                "stage_p99_ms": {
+                    st: [round(v, 2) for _, v in
+                         ring.quantile_series(f"serve.stage_ms.{st}", 0.99)[-48:]]
+                    for st in STAGE_NAMES
+                },
+                "wait_p99_ms": [round(v, 2) for _, v in
+                                ring.quantile_series("serve.wait_ms", 0.99)[-48:]],
+                "canary_pass_rate": [v for _, v in
+                                     ring.gauge_series("canary.pass_rate")[-48:]],
+            }
+        return board
+
+    def telemetry_report(self) -> dict:
+        """Bench/CI epilogue view: canary stats, anomaly fires (with
+        exemplar bundle paths), and the series span covered."""
+        return {
+            "canary": self._canary.stats() if self._canary is not None else None,
+            "anomaly": self._anomaly.report() if self._anomaly is not None else None,
+            "series_span_s": (round(self._tele_sampler.ring.span_s(), 1)
+                              if self._tele_sampler is not None else 0.0),
+            "scoreboard": self.scoreboard(),
+        }
+
+    def _write_scoreboard(self) -> None:
+        if not self._scoreboard_path:
+            return
+        tmp = f"{self._scoreboard_path}.tmp"
+        with open(tmp, "w") as f:
+            json.dump(self.scoreboard(), f)
+        os.replace(tmp, self._scoreboard_path)  # atomic: no torn reads
 
     def _probe(self, i: int) -> None:
         t0 = time.perf_counter()
@@ -935,6 +1086,11 @@ class FrontDoor(FrontDoorClient):
             self._drop_conn(i)
             self.router.note_failure(i)
             obs.count("frontdoor.probe_failures", 1)
+            # the breadcrumb the probe_stall detector keys on: it rides
+            # the flight ring into the same tick's tsdb sample, so a
+            # wedged-but-alive replica is attributed within confirm
+            # probe windows
+            obs.event("frontdoor.probe_failed", replica=i)
             return
         t3 = time.perf_counter()
         if not resp.get("ok"):
@@ -1301,6 +1457,10 @@ class FrontDoor(FrontDoorClient):
         self._supervisor.join(timeout=10)
         # every already-admitted dispatch resolves before the fleet dies
         self._pool.shutdown(wait=True)
+        if self._canary is not None:
+            # reap the in-flight canary (its dispatch just resolved) so
+            # the run's pass rate covers every canary it sent
+            self._canary.drain(timeout_s=2.0)
         for i, proc in enumerate(self._procs):
             if proc is None or not proc.is_alive():
                 continue
@@ -1337,6 +1497,10 @@ class FrontDoor(FrontDoorClient):
             if proc.is_alive():
                 proc.kill()
                 proc.join(timeout=5)
+        # one final telemetry window over the close()-time probes above,
+        # so a fleet shorter-lived than a supervision tick still leaves
+        # a series sample and a scoreboard snapshot behind
+        self._telemetry_step()
         obs.event("frontdoor.closed", name=self._fd_name)
 
 

@@ -150,18 +150,26 @@ class ReplicaServer:
             # respawns + postmortem), raise — all via ETH_SPECS_FAULT
             fault.check(wire.SITE, tag=msg.get("kind"))
             ctx = trace.from_wire(msg.get("trace"))
+            # canary traffic class (obs/canary.py): the flag crosses the
+            # wire so the replica-side service keeps canaries out of its
+            # admission accounting and SLO-fed stats too
+            canary = bool(msg.get("canary"))
             with trace.activate(ctx):
                 with obs.span("frontdoor.rpc", kind=msg.get("kind", "?")):
                     if msg["kind"] == "bls":
-                        fut = self.service.submit_bls_aggregate(*msg["payload"])
+                        fut = self.service.submit_bls_aggregate(
+                            *msg["payload"], canary=canary)
                     elif msg["kind"] == "htr":
                         # payload is (chunks, depth); the service derives
                         # the same depth from the chunk count itself
-                        fut = self.service.submit_hash_tree_root(msg["payload"][0])
+                        fut = self.service.submit_hash_tree_root(
+                            msg["payload"][0], canary=canary)
                     elif msg["kind"] == "agg":
-                        fut = self.service.submit_aggregate(*msg["payload"])
+                        fut = self.service.submit_aggregate(
+                            *msg["payload"], canary=canary)
                     elif msg["kind"] == "kzg":
-                        fut = self.service.submit_blob_verify(*msg["payload"])
+                        fut = self.service.submit_blob_verify(
+                            *msg["payload"], canary=canary)
                     elif msg["kind"] == "slot":
                         # whole-slot pipeline: stateful, single-owner —
                         # the front door routes every slot to ONE live

@@ -122,7 +122,8 @@ class VerifyService:
 
     # ------------------------------------------------------------ submit --
 
-    def _submit(self, kind: str, payload: tuple, cost_bytes: int) -> Future:
+    def _submit(self, kind: str, payload: tuple, cost_bytes: int,
+                canary: bool = False) -> Future:
         if self._closed:
             raise RuntimeError(f"service {self.name} is shut down")
         # the waterfall anchor: t_submit and the stamp vector share one
@@ -130,39 +131,50 @@ class VerifyService:
         # long admission held its lock
         t0 = time.monotonic()
         stamps: dict = {}
-        self.admission.admit(cost_bytes, stamps)  # raises Overloaded past the caps
+        if canary:
+            # canary traffic class (obs/canary.py): exempt from admission
+            # shed accounting — a canary occupying a queue slot could shed
+            # a real request, which inverts the monitor/monitored roles
+            waterfall.mark(stamps, "admitted", t0)
+        else:
+            self.admission.admit(cost_bytes, stamps)  # raises Overloaded past the caps
         # child of the caller's active trace (or a fresh root): the ids
         # ride the Request through the batch/dispatch thread hand-offs
         req = Request(kind=kind, payload=payload, cost_bytes=cost_bytes,
-                      t_submit=t0, trace=trace.child(), stamps=stamps)
+                      t_submit=t0, trace=trace.child(), stamps=stamps,
+                      canary=canary)
         try:
             self._batcher.put(req)
         except RuntimeError:
             self._release_once(req)
             raise
-        obs.count("serve.requests", 1)
-        obs.count(f"serve.requests.{kind}", 1)
+        if canary:
+            obs.count("canary.requests", 1)
+        else:
+            obs.count("serve.requests", 1)
+            obs.count(f"serve.requests.{kind}", 1)
         return req.future
 
-    def submit_bls_aggregate(self, pubkeys: list, message: bytes, signature: bytes) -> Future:
+    def submit_bls_aggregate(self, pubkeys: list, message: bytes, signature: bytes,
+                             canary: bool = False) -> Future:
         """FastAggregateVerify-shaped request; resolves to the exact bool
         ``ops.bls_batch.batch_verify_aggregates([item])`` returns."""
         pks = [bytes(p) for p in pubkeys]
         item = (pks, bytes(message), bytes(signature))
         cost = 48 * len(pks) + len(item[1]) + len(item[2])
-        return self._submit("bls", item, cost)
+        return self._submit("bls", item, cost, canary=canary)
 
-    def submit_aggregate(self, signatures: list) -> Future:
+    def submit_aggregate(self, signatures: list, canary: bool = False) -> Future:
         """Aggregate compressed G2 signatures (one committee's gossip
         contribution); resolves to the exact bytes
         ``crypto.signature.aggregate(signatures)`` returns — empty or
         malformed inputs resolve exceptionally with the same
         ValueError the direct call raises."""
         sigs = tuple(bytes(s) for s in signatures)
-        return self._submit("agg", (sigs,), 96 * max(len(sigs), 1))
+        return self._submit("agg", (sigs,), 96 * max(len(sigs), 1), canary=canary)
 
     def submit_blob_verify(
-        self, blob: bytes, commitment: bytes, proof: bytes
+        self, blob: bytes, commitment: bytes, proof: bytes, canary: bool = False
     ) -> Future:
         """Blob KZG verification (the DAS workload op); resolves to the
         exact bool ``ops.kzg_batch.verify_blob_host`` returns —
@@ -172,9 +184,9 @@ class VerifyService:
         Admission accounts the FULL blob payload (131 KiB each), so the
         byte cap — not the queue cap — is what sheds at blob scale."""
         item = (bytes(blob), bytes(commitment), bytes(proof))
-        return self._submit("kzg", item, sum(len(b) for b in item))
+        return self._submit("kzg", item, sum(len(b) for b in item), canary=canary)
 
-    def submit_hash_tree_root(self, chunks: np.ndarray) -> Future:
+    def submit_hash_tree_root(self, chunks: np.ndarray, canary: bool = False) -> Future:
         """Merkleize uint8[N, 32] chunks into the root of the pow2
         subtree holding them; resolves to the exact bytes
         ``ops.merkle.merkleize_subtree_device(chunks, depth)`` returns
@@ -183,7 +195,8 @@ class VerifyService:
         if chunks.ndim != 2 or chunks.shape[1] != 32 or chunks.dtype != np.uint8:
             raise ValueError("chunks must be uint8[N, 32]")
         depth = buckets.subtree_depth(chunks.shape[0])
-        return self._submit("htr", (chunks, depth), int(chunks.nbytes))
+        return self._submit("htr", (chunks, depth), int(chunks.nbytes),
+                            canary=canary)
 
     def submit_state_root(
         self, arrays, meta, balances, effective_balance, inactivity_scores, just
@@ -256,19 +269,27 @@ class VerifyService:
             for r in reqs:
                 waterfall.mark(r.stamps, "flush_assembled", now)
                 wait_ms = (now - r.t_submit) * 1000.0
+                if r.canary:
+                    # canaries ride the flush but never the SLO metric:
+                    # serve.wait_ms feeds the burn-rate windows and the
+                    # wait-p99 objective (obs/canary.py)
+                    obs.observe("canary.wait_ms", wait_ms)
+                    continue
                 flush_hist.record(wait_ms)
                 self._waits.record(wait_ms)
                 obs.observe("serve.wait_ms", wait_ms)
             obs.count("serve.flushes", 1)
             obs.count(f"serve.flush.{reason}", 1)
             obs.count("serve.batch_items", len(reqs))
+            p50 = flush_hist.quantile(0.5)  # None for an all-canary flush
+            p99 = flush_hist.quantile(0.99)
             obs.event(
                 "serve.flush",
                 reason=reason,
                 batch_size=len(reqs),
                 queue_depth=self.admission.depth(),
-                wait_p50_ms=round(flush_hist.quantile(0.5), 3),
-                wait_p99_ms=round(flush_hist.quantile(0.99), 3),
+                wait_p50_ms=round(p50, 3) if p50 is not None else 0.0,
+                wait_p99_ms=round(p99, 3) if p99 is not None else 0.0,
                 # Perfetto-style flow links: each member request's wire
                 # id, so a JSONL consumer can stitch submit-side traces
                 # to this flush and its dispatch span
@@ -415,7 +436,10 @@ class VerifyService:
             else:
                 from eth_consensus_specs_tpu.crypto.signature import fast_aggregate_verify
 
-                obs.count("serve.degraded_items", len(bls_reqs))
+                # canaries stay out of the degraded_rate SLO numerator
+                # (they are out of its serve.requests denominator too)
+                obs.count("serve.degraded_items",
+                          sum(1 for r in bls_reqs if not r.canary))
                 verdicts = [fast_aggregate_verify(*r.payload) for r in bls_reqs]
             for r, v in zip(bls_reqs, verdicts):
                 results[id(r)] = bool(v)
@@ -446,7 +470,8 @@ class VerifyService:
             else:
                 from eth_consensus_specs_tpu.ops.kzg_batch import verify_blob_host
 
-                obs.count("serve.degraded_items", len(kzg_reqs))
+                obs.count("serve.degraded_items",
+                          sum(1 for r in kzg_reqs if not r.canary))
                 verdicts = [verify_blob_host(*r.payload) for r in kzg_reqs]
             for r, v in zip(kzg_reqs, verdicts):
                 results[id(r)] = bool(v)
@@ -485,7 +510,8 @@ class VerifyService:
             else:
                 from eth_consensus_specs_tpu.crypto.signature import aggregate
 
-                obs.count("serve.degraded_items", len(agg_reqs))
+                obs.count("serve.degraded_items",
+                          sum(1 for r in agg_reqs if not r.canary))
                 for r in agg_reqs:
                     results[id(r)] = aggregate(list(r.payload[0]))
 
@@ -526,7 +552,8 @@ class VerifyService:
                 from eth_consensus_specs_tpu.obs.watchdog import host_tree_root_words
                 from eth_consensus_specs_tpu.ops.merkle import _chunks_to_words
 
-                obs.count("serve.degraded_items", len(group))
+                obs.count("serve.degraded_items",
+                          sum(1 for r in group if not r.canary))
                 roots = [
                     host_tree_root_words(
                         r.prepped
@@ -589,6 +616,8 @@ class VerifyService:
         if req.released:
             return
         req.released = True
+        if req.canary:
+            return  # never admitted: nothing to release, no EWMA sample
         self.admission.release(req.cost_bytes, service_s)
 
     def _resolve(

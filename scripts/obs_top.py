@@ -1,0 +1,197 @@
+"""One-screen fleet scoreboard: render the continuous-telemetry plane.
+
+Reads the scoreboard JSON a running FrontDoor writes atomically when
+``ETH_SPECS_OBS_SCOREBOARD`` is set (``--watch`` tails it live, top(1)
+style), or digs the embedded ``telemetry.scoreboard`` section out of a
+bench report JSON (serve_bench/slot_bench ``--out`` files) for a
+post-hoc snapshot — the CI artifact path.
+
+The screen, top to bottom:
+
+  * header — fleet name, snapshot age, SLO burn rate over the last
+    minute, admission queue depth vs the effective cap;
+  * canary line — known-answer pass rate plus sent/ok/parity/error
+    counts (a parity failure renders as PAGE: the fleet returned wrong
+    bits for a request with a precomputed host-oracle answer);
+  * one row per replica — alive/restarting/dead glyph, probe health,
+    router EWMA latency and pick share;
+  * sparklines — requests/sec, wait p99, per-stage p99s, canary pass
+    rate over the series ring's last 48 telemetry windows;
+  * active anomalies — detector fires within the last minute, with
+    replica/stage attribution and the exemplar bundle path when one
+    was captured.
+
+Plain ASCII + the eight-step block glyphs; no curses, no deps — CI
+logs and terminals render it identically.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+BLOCKS = "▁▂▃▄▅▆▇█"
+
+
+def spark(values, width: int = 48) -> str:
+    """Eight-level unicode sparkline of the last ``width`` values."""
+    vals = [v for v in values if v is not None][-width:]
+    if not vals:
+        return "(no data)"
+    lo, hi = min(vals), max(vals)
+    if hi <= lo:
+        return BLOCKS[0] * len(vals)
+    return "".join(
+        BLOCKS[min(int((v - lo) / (hi - lo) * (len(BLOCKS) - 1)), len(BLOCKS) - 1)]
+        for v in vals
+    )
+
+
+def _fmt(v, suffix: str = "") -> str:
+    if v is None:
+        return "-"
+    if isinstance(v, float):
+        return f"{v:.2f}{suffix}"
+    return f"{v}{suffix}"
+
+
+def load_scoreboard(path: str) -> dict:
+    """A scoreboard file, or a bench report carrying one inside its
+    telemetry section."""
+    with open(path) as f:
+        doc = json.load(f)
+    if "replicas" in doc and "unix_time" in doc:
+        return doc
+    tele = doc.get("telemetry") or {}
+    board = tele.get("scoreboard")
+    if board is None and (tele.get("canary") or tele.get("anomaly")):
+        # in-process bench reports carry canary/anomaly but no fleet
+        # scoreboard — synthesize a board so the snapshot still renders
+        anom = tele.get("anomaly") or {}
+        board = {
+            "name": doc.get("mode", "bench"),
+            "replicas": [],
+            "canary": tele.get("canary"),
+            "anomalies": anom.get("fired", []),
+            "anomaly_fires": anom.get("fires", {}),
+        }
+    if board is None:
+        raise SystemExit(
+            f"{path}: neither a scoreboard file nor a bench report with a "
+            "telemetry section"
+        )
+    return board
+
+
+def render(board: dict) -> str:
+    lines = []
+    burn = board.get("burn") or {}
+    head = f"== {board.get('name', 'fleet')}"
+    if board.get("unix_time"):
+        head += f" | snapshot {time.time() - board['unix_time']:.1f}s ago"
+    if burn:
+        head += (f" | burn {_fmt(burn.get('burn_rate'))}"
+                 f" over {_fmt(burn.get('window_s'), 's')}")
+    if "queue_depth" in board:
+        head += (f" | queue {board['queue_depth']}"
+                 f"/{board.get('effective_max_queue', '-')}")
+    lines.append(head)
+
+    can = board.get("canary")
+    if can:
+        rate = can.get("pass_rate")
+        flag = " PAGE: parity failure" if can.get("parity_failures") else ""
+        lines.append(
+            f"canary  pass {_fmt(rate)}  sent {can.get('sent', 0)} "
+            f"ok {can.get('ok', 0)} parity {can.get('parity_failures', 0)} "
+            f"err {can.get('errors', 0)}  shapes {','.join(can.get('shapes', []))}"
+            f"{flag}"
+        )
+
+    for rep in board.get("replicas", []):
+        glyph = ("~" if rep.get("restarting")
+                 else "*" if rep.get("alive") else "X")
+        router = rep.get("router") or {}
+        health = rep.get("health")
+        if isinstance(health, dict):
+            health = (f"q{health.get('queue_depth', '-')}"
+                      f" c{health.get('compiles', '-')}"
+                      f"+{health.get('compiles_after_ready', '-')}")
+        lines.append(
+            f"  [{glyph}] replica {rep.get('replica')}  "
+            f"health {_fmt(health)}  "
+            f"ewma {_fmt(router.get('ewma_ms'), 'ms')}  "
+            f"picks {router.get('picks', 0)}  "
+            f"failures {router.get('failures', 0)}"
+        )
+
+    series = board.get("series")
+    if series:
+        lines.append(f"-- series (last {len(series.get('rps', []))} windows, "
+                     f"{board.get('span_s', 0)}s span)")
+        lines.append(f"  rps        {spark(series.get('rps', []))}")
+        lines.append(f"  wait p99   {spark(series.get('wait_p99_ms', []))}")
+        for st, vals in (series.get("stage_p99_ms") or {}).items():
+            if any(v is not None for v in vals):
+                lines.append(f"  {st:<10} {spark(vals)}")
+        cpr = series.get("canary_pass_rate")
+        if cpr:
+            lines.append(f"  canary     {spark(cpr)}")
+
+    active = board.get("anomalies") or []
+    fires = board.get("anomaly_fires") or {}
+    if active:
+        lines.append("-- ACTIVE ANOMALIES")
+        for a in active:
+            where = []
+            if a.get("replica") is not None:
+                where.append(f"replica {a['replica']}")
+            if a.get("stage"):
+                where.append(f"stage {a['stage']}")
+            loc = f" [{', '.join(where)}]" if where else ""
+            lines.append(f"  ! {a.get('detector')}{loc}: {a.get('detail')}")
+            if a.get("bundle"):
+                lines.append(f"      exemplar: {a['bundle']}")
+    elif fires:
+        lines.append(f"-- past anomaly fires: {fires}")
+    else:
+        lines.append("-- no anomalies")
+    return "\n".join(lines)
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    ap.add_argument("path", help="scoreboard JSON (ETH_SPECS_OBS_SCOREBOARD "
+                                 "file) or a bench report with a telemetry "
+                                 "section")
+    ap.add_argument("--watch", action="store_true",
+                    help="re-render on every file change, top(1) style")
+    ap.add_argument("--interval", type=float, default=1.0,
+                    help="watch poll interval in seconds")
+    args = ap.parse_args()
+
+    if not args.watch:
+        print(render(load_scoreboard(args.path)))
+        return
+    last_mtime = 0.0
+    try:
+        while True:
+            try:
+                mtime = os.path.getmtime(args.path)
+            except OSError:
+                time.sleep(args.interval)
+                continue
+            if mtime != last_mtime:
+                last_mtime = mtime
+                sys.stdout.write("\x1b[2J\x1b[H")  # clear + home
+                print(render(load_scoreboard(args.path)), flush=True)
+            time.sleep(args.interval)
+    except KeyboardInterrupt:
+        pass
+
+
+if __name__ == "__main__":
+    main()

@@ -83,6 +83,10 @@ def _lower_is_better(metric: str) -> bool:
     # suffix but regresses UPWARD: more windows out of budget is worse
     if metric.endswith("_burn_rate"):
         return True
+    # telemetry-plane counts (canary parity failures, anomaly detector
+    # fires) also regress UPWARD: any increase is worse
+    if metric.endswith(("_failures", "_fires")):
+        return True
     return metric.endswith(("_ms", "_s", "_bytes"))
 
 
@@ -194,6 +198,24 @@ def load_rounds(repo_dir: str) -> list[dict]:
             if name == "burn_rate" and isinstance(value, (int, float)) \
                     and not isinstance(value, bool):
                 metrics["slo_burn_rate"] = value
+        # continuous-telemetry advisories (the plane the benches run:
+        # obs/canary.py + obs/anomaly.py). Canary pass rate is
+        # higher-is-better; parity failures and per-detector fire
+        # counts regress upward (_lower_is_better suffix rule). The
+        # benches already hard-gate parity == 0 and zero clean-run
+        # fires, so these timeline points exist to surface slow erosion
+        # — a detector that starts firing once per round — not to gate.
+        tele = parsed.get("telemetry") or {}
+        can = tele.get("canary") or {}
+        if isinstance(can.get("pass_rate"), (int, float)) \
+                and not isinstance(can.get("pass_rate"), bool):
+            metrics["canary_pass_rate"] = can["pass_rate"]
+        if isinstance(can.get("parity_failures"), int) \
+                and not isinstance(can.get("parity_failures"), bool):
+            metrics["canary_parity_failures"] = can["parity_failures"]
+        for det, n in ((tele.get("anomaly") or {}).get("fires") or {}).items():
+            if isinstance(n, (int, float)) and not isinstance(n, bool):
+                metrics[f"anomaly_{det}_fires"] = n
         for name, value in (parsed.get("waterfall") or {}).items():
             if (
                 isinstance(value, (int, float))

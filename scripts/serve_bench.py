@@ -99,6 +99,7 @@ platform-aware secondary metrics.
 from __future__ import annotations
 
 import argparse
+import dataclasses
 import json
 import math
 import os
@@ -122,7 +123,10 @@ import numpy as np  # noqa: E402
 
 from eth_consensus_specs_tpu import obs, serve  # noqa: E402
 from eth_consensus_specs_tpu.analysis import lint, lockwatch  # noqa: E402
+from eth_consensus_specs_tpu.obs import anomaly as anomaly_mod  # noqa: E402
+from eth_consensus_specs_tpu.obs import canary as canary_mod  # noqa: E402
 from eth_consensus_specs_tpu.obs import export, slo, timeline  # noqa: E402
+from eth_consensus_specs_tpu.obs import tsdb as tsdb_mod  # noqa: E402
 from eth_consensus_specs_tpu.ops import bls_batch  # noqa: E402
 from eth_consensus_specs_tpu.ops.merkle import merkleize_subtree_device  # noqa: E402
 from eth_consensus_specs_tpu.serve import buckets as serve_buckets  # noqa: E402
@@ -242,6 +246,77 @@ def latency_histogram(latencies_s: list[float]) -> dict:
         edge = 1 << max(math.ceil(math.log2(max(ms, 0.001))), 0)
         hist[f"<={edge}ms"] = hist.get(f"<={edge}ms", 0) + 1
     return dict(sorted(hist.items(), key=lambda kv: int(kv[0][2:-2])))
+
+
+class BenchTelemetry:
+    """The continuous-telemetry plane for the in-process bench mode: a
+    tsdb sampler feeding the STRUCTURAL anomaly detectors plus a
+    known-answer canary stream through the same client the load uses.
+
+    Structural detectors only: the statistical set (latency step/drift,
+    rate spike/stall) assumes organic traffic, and a bench sweeps load
+    shapes by design — trickle then closed-loop IS a rate spike. The
+    structural detectors (dead replica, probe/completion stall, dark
+    stage) must stay silent on any clean run regardless of load shape,
+    which is exactly what the bench gates."""
+
+    def __init__(self, client, source: str, canary_ms: float, shapes=None):
+        cfg = anomaly_mod.AnomalyConfig.from_env()
+        self.sampler = tsdb_mod.Sampler(tsdb_mod.ring_capacity_from_env())
+        self.engine = anomaly_mod.Engine(
+            cfg,
+            detectors=anomaly_mod.default_detectors(
+                cfg, source, anomaly_mod.STRUCTURAL),
+            source=source,
+        )
+        self.canary = canary_mod.CanaryScheduler(
+            client, interval_s=canary_ms / 1000.0, shapes=shapes)
+        self._stop = threading.Event()
+        self._last_sample = time.monotonic()
+        self._thread = threading.Thread(
+            target=self._loop, name="bench-telemetry", daemon=True)
+
+    def start(self) -> "BenchTelemetry":
+        self._thread.start()
+        return self
+
+    def _loop(self) -> None:
+        while not self._stop.is_set():
+            now = time.monotonic()
+            self.canary.pump(now)
+            if now - self._last_sample >= 0.25:
+                self._last_sample = now
+                self.sampler.sample(now)
+                self.engine.step(self.sampler.ring)
+            self._stop.wait(0.05)
+
+    def stop(self) -> None:
+        """Call BEFORE closing the service: the drain needs the serving
+        path alive to resolve the in-flight canary."""
+        self._stop.set()
+        self._thread.join(timeout=5.0)
+        self.canary.drain(timeout_s=10.0)
+        self.sampler.sample()  # fold the tail window
+        self.engine.step(self.sampler.ring)
+
+    def section(self) -> dict:
+        return {
+            "canary": self.canary.stats(),
+            "anomaly": self.engine.report(),
+            "series_span_s": round(self.sampler.ring.span_s(), 1),
+        }
+
+    def gate(self, failures: list) -> None:
+        st = self.canary.stats()
+        if st["sent"] < 1:
+            failures.append("no canaries sent (the scheduler never fired)")
+        if st["parity_failures"]:
+            failures.append(
+                f"{st['parity_failures']} canary parity failures — the serving "
+                "path returned different bits than the host oracle")
+        fires = self.engine.fire_counts()
+        if fires:
+            failures.append(f"anomaly fires on a clean run: {fires}")
 
 
 def finish_report(report: dict, failures: list, out: str, trigger: str, snap: dict) -> None:
@@ -441,6 +516,15 @@ def run_replicated(args) -> None:
 
     export.maybe_serve_http()
     cfg = ServeConfig.from_env(max_batch=min(max(args.submitters // 2, 1), 32))
+    # continuous telemetry plane: structural detectors only (the
+    # statistical set assumes organic traffic — a bench sweeps load
+    # shapes by design) unless the caller pinned their own detector
+    # set; canaries ride the supervisor tick at --canary-ms
+    os.environ.setdefault("ETH_SPECS_ANOM_DETECTORS", "structural")
+    fd_cfg = FrontDoorConfig.from_env()
+    if args.canary_ms > 0 and fd_cfg.canary_interval_ms <= 0:
+        fd_cfg = dataclasses.replace(
+            fd_cfg, canary_interval_ms=float(args.canary_ms))
     fault_spec = None
     if args.chaos:
         # deterministic mid-load kill: exactly ONE replica (the latch
@@ -454,7 +538,7 @@ def run_replicated(args) -> None:
     fd = FrontDoor(
         replicas=args.replicas,
         config=cfg,
-        fd_config=FrontDoorConfig.from_env(),
+        fd_config=fd_cfg,
         warmup_path=warmup_path,
         # the bls_msm keys matter on device backends (the batched G1
         # many-sum kernel compiles per (flush-items, committee-lanes)
@@ -466,7 +550,10 @@ def run_replicated(args) -> None:
         + [
             ("bls_msm", b, serve_buckets.pow2_bucket(args.committee))
             for b in cfg.buckets
-        ],
+        ]
+        # canary compile shapes (flush-group size 1), so the canary
+        # stream can't trip a replica's compiles_after_ready gate
+        + (canary_mod.warm_keys() if fd_cfg.canary_interval_ms > 0 else []),
         replica_fault_spec=fault_spec,
         name="bench-fd",
     )
@@ -485,6 +572,7 @@ def run_replicated(args) -> None:
     stats = fd.stats()
     replica_stats = fd.replica_stats()
     fd.close()  # merges each survivor's final obs delta
+    telemetry = fd.telemetry_report()  # close() took the final window
 
     failures = []
     lost = sum(1 for r in got if r is _LOST)
@@ -547,6 +635,50 @@ def run_replicated(args) -> None:
                 f"SLO {r.name}: observed {r.observed} > bound {r.bound} ({r.detail})"
             )
 
+    # telemetry-plane gates: canaries resolved bit-exactly through the
+    # fleet, and the anomaly engine told the truth — silent on a clean
+    # run, attributing the kill on a chaos run
+    can = telemetry.get("canary")
+    if fd_cfg.canary_interval_ms > 0 and can is not None:
+        if can.get("sent", 0) < 1:
+            failures.append("no canaries sent through the front door")
+        if can.get("parity_failures"):
+            failures.append(
+                f"{can['parity_failures']} canary parity failures — the fleet "
+                "returned different bits than the host oracle for a "
+                "known-answer request")
+    anom = telemetry.get("anomaly")
+    if anom is not None:
+        fires = dict(anom.get("fires") or {})
+        if args.chaos:
+            dead = [f for f in anom.get("fired", ())
+                    if f.get("detector") == "dead_replica"]
+            if not dead:
+                failures.append(
+                    "chaos run but the dead_replica detector never fired — "
+                    "the kill went undetected by the telemetry plane")
+            else:
+                rec = dead[0]
+                if rec.get("replica") is None or rec.get("stage") != "recovery":
+                    failures.append(
+                        f"dead_replica fired without attribution: {rec}")
+                if rec.get("windows", 99) > 2:
+                    failures.append(
+                        f"dead_replica detection took {rec['windows']} probe "
+                        "windows (documented horizon is 2)")
+                if not rec.get("bundle"):
+                    failures.append(
+                        "dead_replica fired without an exemplar bundle "
+                        f"(ETH_SPECS_OBS_POSTMORTEM_DIR={pm_dir})")
+            # the kill legitimately trips the death + probe detectors;
+            # anything else firing is a telemetry false positive
+            unexpected = {k: v for k, v in fires.items()
+                          if k not in ("dead_replica", "probe_stall")}
+        else:
+            unexpected = fires
+        if unexpected:
+            failures.append(f"unexpected anomaly fires: {unexpected}")
+
     report = {
         "mode": "replicated-chaos" if args.chaos else "replicated",
         "replicas": args.replicas,
@@ -572,6 +704,7 @@ def run_replicated(args) -> None:
             "p99": wait_hist.get("p99"),
         },
         "slo": slo_mod.report(slo_results),
+        "telemetry": telemetry,
         "waterfall": waterfall_section(failures, args.out, require_resident=False),
     }
 
@@ -607,6 +740,9 @@ def run_fleet_matrix(args) -> None:
     warmup_path = args.warmup_out or os.path.join(out_dir, "fleet_warmup.jsonl")
     export.maybe_serve_http()
 
+    # bench fleets run structural detectors only (statistical ones
+    # assume organic traffic; the matrix sweeps load shapes by design)
+    os.environ.setdefault("ETH_SPECS_ANOM_DETECTORS", "structural")
     matrix = tuple(args.chips_matrix) or (1,)
     R = max(args.replicas, 1)
     reps_list = sorted({1, R}) if args.smoke else list(range(1, R + 1))
@@ -1284,6 +1420,9 @@ def main() -> None:
     ap.add_argument("--mesh-pairing", action="store_true",
                     help="include the sharded device pairing on the CPU mesh "
                          "(one-time Miller compile is minutes)")
+    ap.add_argument("--canary-ms", type=float, default=150.0,
+                    help="known-answer canary interval in ms (0 disables the "
+                         "telemetry plane; shapes via ETH_SPECS_CANARY_SHAPES)")
     args = ap.parse_args()
     if args.smoke:
         args.submitters = min(args.submitters, 16)
@@ -1321,6 +1460,11 @@ def main() -> None:
     # --- phase 2: service + bucket warmup -------------------------------
     svc = serve.VerifyService(cfg, name="bench")
     warm_keys = [("merkle_many", b, args.tree_depth) for b in cfg.buckets]
+    if args.canary_ms > 0:
+        # the canary stream's own compile shapes (flush-group size is
+        # always 1) — warmed here so injecting canaries through the
+        # load phase cannot trip the zero-cold-compile gate below
+        warm_keys += canary_mod.warm_keys()
     svc.precompile(warm_keys)
 
     # --- state_root mini-phase (warm): one post-epoch state root through
@@ -1371,6 +1515,15 @@ def main() -> None:
 
     compiles_after_warmup = obs.snapshot()["counters"].get("serve.compiles", 0)
 
+    # continuous telemetry plane: known-answer canaries + structural
+    # anomaly detectors ride the whole trickle/load run. Starts AFTER
+    # the compile snapshot (its shapes are pre-warmed above); stopped
+    # and drained before svc.close() so every canary resolves
+    tele = None
+    if args.canary_ms > 0:
+        tele = BenchTelemetry(svc, source="service",
+                              canary_ms=args.canary_ms).start()
+
     # --- phase 3: trickle (deadline flushes) ----------------------------
     for it in bls_items[:3]:
         assert svc.submit_bls_aggregate(*it).result() == bls_batch.batch_verify_aggregates([it])
@@ -1381,6 +1534,8 @@ def main() -> None:
     svc_bls_s, got_bls, lat_bls = closed_loop(svc, load_bls, args.submitters)
     load_htr = [("htr", t) for t in trees]
     svc_htr_s, got_roots, lat_htr = closed_loop(svc, load_htr, args.submitters)
+    if tele is not None:
+        tele.stop()
     svc.close()
 
     # --- phase 5: gates --------------------------------------------------
@@ -1422,6 +1577,10 @@ def main() -> None:
             failures.append(
                 f"SLO {r.name}: observed {r.observed} > bound {r.bound} ({r.detail})"
             )
+    if tele is not None:
+        # the telemetry contract on a clean run: every canary resolved
+        # with the oracle's exact bits, zero structural anomaly fires
+        tele.gate(failures)
 
     # run-level wait quantiles: bucket quantiles over EVERY wait of the
     # run (the old 4096-sample reservoir is gone)
@@ -1474,6 +1633,8 @@ def main() -> None:
         "slo": slo.report(slo_results),
         "waterfall": waterfall_section(failures, args.out),
     }
+    if tele is not None:
+        report["telemetry"] = tele.section()
 
     if args.warmup_out:
         # the shippable warmup artifact: every shape this run compiled,

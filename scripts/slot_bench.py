@@ -44,6 +44,7 @@ checks.yml). Exit code 0 only if every gate passes.
 from __future__ import annotations
 
 import argparse
+import dataclasses
 import os
 import signal
 import sys
@@ -59,6 +60,7 @@ force_virtual_chips()
 import numpy as np  # noqa: E402
 
 from eth_consensus_specs_tpu import obs  # noqa: E402
+from eth_consensus_specs_tpu.obs import canary as canary_mod  # noqa: E402
 from eth_consensus_specs_tpu.obs import export, timeline  # noqa: E402
 from eth_consensus_specs_tpu.ops import slot_pipeline as sp  # noqa: E402
 from eth_consensus_specs_tpu.serve import buckets as serve_buckets  # noqa: E402
@@ -262,12 +264,27 @@ def run_bench(args) -> None:
         slot_validators=args.validators,
         slot_ckpt_dir=ckpt_dir,
     )
+    # continuous telemetry plane: structural detectors only (bench load
+    # is not organic traffic), and a generous completion-stall horizon —
+    # a single slot apply is legitimately seconds long on CPU, so the
+    # default 15×200ms window would page on healthy full-scale runs
+    os.environ.setdefault("ETH_SPECS_ANOM_DETECTORS", "structural")
+    os.environ.setdefault("ETH_SPECS_ANOM_STALL_WINDOWS", "150")
+    fd_cfg = FrontDoorConfig.from_env()
+    if args.canary_ms > 0 and fd_cfg.canary_interval_ms <= 0:
+        fd_cfg = dataclasses.replace(
+            fd_cfg, canary_interval_ms=float(args.canary_ms))
+    warm = slot_warm_keys(args, reqs)
+    if fd_cfg.canary_interval_ms > 0:
+        # canary compile shapes (flush-group size 1): the canary stream
+        # rides the slot fleet's stateless verbs and must not cold-compile
+        warm = sorted(set(warm) | set(canary_mod.warm_keys()))
     fd = FrontDoor(
         replicas=args.replicas,
         config=cfg,
-        fd_config=FrontDoorConfig.from_env(),
+        fd_config=fd_cfg,
         warmup_path=warmup_path,
-        warm_keys=slot_warm_keys(args, reqs),
+        warm_keys=warm,
         name="slot-fd",
     )
     failures: list[str] = []
@@ -429,6 +446,42 @@ def _run_load(args, fd, reqs, oracle, failures, warmup_path, pm_dir):
     if snap["watchdog"]["divergences"] != 0:
         failures.append(f"watchdog divergences: {snap['watchdog']}")
 
+    # telemetry plane: canaries resolved bit-exactly through the slot
+    # fleet's stateless verbs; structural detectors silent on a clean
+    # run, and on a chaos run the owner kill is detected and attributed
+    telemetry = fd.telemetry_report()
+    can = telemetry.get("canary")
+    if can is not None:
+        if can.get("sent", 0) < 1:
+            failures.append("no canaries sent through the slot front door")
+        if can.get("parity_failures"):
+            failures.append(
+                f"{can['parity_failures']} canary parity failures — the fleet "
+                "returned different bits than the host oracle")
+    anom = telemetry.get("anomaly")
+    if anom is not None:
+        fires = dict(anom.get("fires") or {})
+        if args.chaos:
+            dead = [f for f in anom.get("fired", ())
+                    if f.get("detector") == "dead_replica"]
+            if not dead:
+                failures.append("chaos run but the dead_replica detector "
+                                "never fired on the owner kill")
+            elif dead[0].get("replica") != 0 or dead[0].get("stage") != "recovery":
+                failures.append(
+                    f"dead_replica fired without owner attribution: {dead[0]}")
+            # the owner kill legitimately trips the death/probe/stall
+            # detectors (slots have no failover — completions stop until
+            # the respawn-restore finishes); anything else is a lie
+            unexpected = {
+                k: v for k, v in fires.items()
+                if k not in ("dead_replica", "probe_stall", "completion_stall")
+            }
+        else:
+            unexpected = fires
+        if unexpected:
+            failures.append(f"unexpected anomaly fires: {unexpected}")
+
     phases = {}
     for ph in ("verify", "aggregate", "reroot"):
         h = snap["histograms"].get(f"serve.stage_ms.slot.{ph}", {})
@@ -470,6 +523,7 @@ def _run_load(args, fd, reqs, oracle, failures, warmup_path, pm_dir):
         "warmup_artifact": warmup_path,
         "warmup_keys": len(serve_buckets.load_warmup(warmup_path)),
         "slot": slot_section,
+        "telemetry": telemetry,
     }
     # slot autopsy: the worst slot's critical path, from the fleet's
     # own JSONL streams under corrected clocks. On a chaos run the
@@ -520,6 +574,9 @@ def main() -> None:
     ap.add_argument("--ckpt-dir", default="")
     ap.add_argument("--out", default="BENCH_SLOT.json")
     ap.add_argument("--warmup-out", default="")
+    ap.add_argument("--canary-ms", type=float, default=250.0,
+                    help="known-answer canary interval in ms through the "
+                         "fleet's stateless verbs (0 disables)")
     args = ap.parse_args()
     if args.smoke:
         args.slots = min(args.slots, 10)
